@@ -1,0 +1,58 @@
+"""Benchmark: TPC-H q1 pipeline through the full engine on the TPU vs the
+pandas CPU baseline (the "Spark CPU" proxy — BASELINE.md).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+vs_baseline: speedup vs CPU divided by the 3x target from BASELINE.md
+(>= 1.0 means the round's target is met)."""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import spark_rapids_tpu  # noqa: F401
+    from spark_rapids_tpu.models.tpch import lineitem_table, q1_dataframe, q1_pandas
+    from spark_rapids_tpu.session import TpuSession
+
+    rows = int(sys.argv[1]) if len(sys.argv) > 1 else 4_000_000
+    table = lineitem_table(rows, seed=0)
+
+    session = TpuSession()
+
+    # warmup: compile + first run
+    df = q1_dataframe(session, table)
+    _ = df.collect_table()
+
+    t0 = time.perf_counter()
+    tpu_result = q1_dataframe(session, table).collect_table()
+    tpu_s = time.perf_counter() - t0
+
+    # CPU baseline (pandas proxy for Spark CPU)
+    _ = q1_pandas(table)  # warmup caches
+    t0 = time.perf_counter()
+    cpu_result = q1_pandas(table)
+    cpu_s = time.perf_counter() - t0
+
+    # sanity: same group count and close sums
+    assert tpu_result.num_rows == len(cpu_result), \
+        f"group mismatch {tpu_result.num_rows} vs {len(cpu_result)}"
+    tpu_sum = sorted(tpu_result.to_pydict()["sum_qty"])
+    cpu_sum = sorted(cpu_result["sum_qty"].tolist())
+    for a, b in zip(tpu_sum, cpu_sum):
+        assert abs(a - b) <= 1e-6 * max(1.0, abs(b)), f"sum_qty mismatch {a} vs {b}"
+
+    speedup = cpu_s / tpu_s if tpu_s > 0 else 0.0
+    print(json.dumps({
+        "metric": "tpch_q1_speedup_vs_cpu",
+        "value": round(speedup, 3),
+        "unit": "x",
+        "vs_baseline": round(speedup / 3.0, 3),
+        "detail": {"rows": rows, "tpu_s": round(tpu_s, 4), "cpu_s": round(cpu_s, 4)},
+    }))
+
+
+if __name__ == "__main__":
+    main()

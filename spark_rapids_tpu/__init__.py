@@ -1,0 +1,40 @@
+"""spark_rapids_tpu — a TPU-native SQL/columnar execution engine.
+
+A from-scratch framework with the capabilities of the RAPIDS Accelerator for
+Apache Spark (reference: /root/reference, NVIDIA spark-rapids): a plan-rewrite
+engine that converts SQL physical plans into columnar operators executing on
+TPUs via JAX/XLA (Pallas for custom kernels), with per-operator CPU fallback,
+bit-for-bit Spark-compatible semantics, an HBM buffer catalog with host/disk
+spill and OOM split-and-retry, TPU-aware shuffle (host path + ICI collectives),
+and accelerated Parquet/ORC/CSV/JSON/Avro IO.
+
+Architecture mirrors the reference's proven shape (see SURVEY.md):
+  plan -> meta/tag/convert (overrides/) -> columnar execs (execs/)
+       -> runtime (semaphore, spill catalog, retry) -> shuffle (parallel/)
+but the substrate is XLA: expression trees are fused into single jitted
+computations over statically-bucketed device columns, strings ride an
+order-preserving dictionary encoding so the device only touches fixed-width
+data, and distributed exchange uses jax.sharding collectives over ICI/DCN.
+"""
+
+import jax
+
+# Spark semantics are 64-bit (LongType, TimestampType micros, DoubleType).
+# Bit-for-bit parity requires x64 mode; TPU emulates i64/f64 (slower but
+# exact), and opt-in 32-bit fast paths can be layered on later.
+jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"
+
+from spark_rapids_tpu.conf import RapidsConf  # noqa: E402,F401
+from spark_rapids_tpu import types  # noqa: E402,F401
+
+
+def __getattr__(name):
+    # lazy heavy imports so `import spark_rapids_tpu` stays light
+    import importlib
+    if name == "TpuSession":
+        return importlib.import_module("spark_rapids_tpu.session").TpuSession
+    if name == "functions":
+        return importlib.import_module("spark_rapids_tpu.functions")
+    raise AttributeError(name)

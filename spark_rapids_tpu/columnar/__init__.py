@@ -1,0 +1,26 @@
+"""Columnar data model: host (numpy/Arrow) and device (XLA buffer) columns.
+
+Reference surface being replaced: ai.rapids.cudf Table / ColumnVector /
+HostColumnVector (SURVEY.md §2.9). TPU-first redesign:
+
+* Static shapes: device columns are padded to lane-aligned power-of-two
+  "buckets" so XLA compiles one program per (schema, bucket) instead of one
+  per row count. The live row count rides along as a traced int32 scalar.
+* Strings are order-preserving dictionary encoded per batch: the device only
+  ever touches fixed-width int32 codes; the (small) dictionary stays on the
+  host where variable-length work is cheap. Comparisons, sorts, group-bys and
+  joins ride the code path; per-entry derived values (hashes, lengths,
+  transformed strings) are computed host-side over the dictionary and
+  gathered on device.
+"""
+
+from spark_rapids_tpu.columnar.column import (  # noqa: F401
+    HostColumn,
+    DeviceColumn,
+    bucket_for,
+    MIN_BUCKET,
+)
+from spark_rapids_tpu.columnar.table import (  # noqa: F401
+    HostTable,
+    DeviceTable,
+)

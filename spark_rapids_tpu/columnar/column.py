@@ -1,0 +1,173 @@
+"""Host and device column representations."""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.errors import ColumnarProcessingError
+
+# Lane width on TPU is 128; keep every device buffer a multiple of it so XLA
+# tiles cleanly onto the VPU/MXU.
+MIN_BUCKET = 128
+
+
+def bucket_for(n: int) -> int:
+    """Smallest power-of-two >= n (and >= MIN_BUCKET).
+
+    Power-of-two buckets bound the number of distinct compiled programs per
+    (schema, expression) to log2(max_rows) — the XLA analog of cuDF's
+    precompiled kernels (SURVEY.md §7 hard parts)."""
+    b = MIN_BUCKET
+    while b < n:
+        b <<= 1
+    return b
+
+
+class HostColumn:
+    """A column on the host: numpy values + validity mask.
+
+    For STRING, ``data`` is a numpy object array of Python str (None allowed
+    at invalid slots). For everything else ``data`` is the Spark internal
+    representation (see types.py)."""
+
+    __slots__ = ("dtype", "data", "validity")
+
+    def __init__(self, dtype: T.DataType, data: np.ndarray, validity: Optional[np.ndarray] = None):
+        self.dtype = dtype
+        self.data = data
+        if validity is None:
+            validity = np.ones(len(data), dtype=np.bool_)
+        self.validity = validity
+        if len(data) != len(validity):
+            raise ColumnarProcessingError("data/validity length mismatch")
+
+    def __len__(self) -> int:
+        return len(self.data)
+
+    @property
+    def null_count(self) -> int:
+        return int(len(self.validity) - self.validity.sum())
+
+    @staticmethod
+    def from_pylist(values, dtype: Optional[T.DataType] = None) -> "HostColumn":
+        if dtype is None:
+            sample = next((v for v in values if v is not None), None)
+            dtype = T.python_to_spark_type(sample) if sample is not None else T.NULL
+        validity = np.array([v is not None for v in values], dtype=np.bool_)
+        if isinstance(dtype, T.StringType):
+            data = np.array([v if v is not None else None for v in values], dtype=object)
+        else:
+            np_dtype = dtype.np_dtype
+            fill = np.zeros((), dtype=np_dtype).item()
+            data = np.array([v if v is not None else fill for v in values], dtype=np_dtype)
+        return HostColumn(dtype, data, validity)
+
+    @staticmethod
+    def from_numpy(values: np.ndarray, validity: Optional[np.ndarray] = None,
+                   dtype: Optional[T.DataType] = None) -> "HostColumn":
+        if dtype is None:
+            dtype = T.from_numpy(values.dtype)
+        return HostColumn(dtype, values, validity)
+
+    def to_pylist(self):
+        out = []
+        for i in range(len(self)):
+            if not self.validity[i]:
+                out.append(None)
+            else:
+                v = self.data[i]
+                out.append(v.item() if isinstance(v, np.generic) else v)
+        return out
+
+    def slice(self, start: int, length: int) -> "HostColumn":
+        return HostColumn(self.dtype, self.data[start:start + length],
+                          self.validity[start:start + length])
+
+    def nbytes(self) -> int:
+        if isinstance(self.dtype, T.StringType):
+            return int(sum(len(s.encode("utf-8")) for s, v in zip(self.data, self.validity) if v)) + len(self)
+        return int(self.data.nbytes + self.validity.nbytes)
+
+
+class DeviceColumn:
+    """A column resident on device as XLA buffers.
+
+    ``data``     : jnp array of length ``capacity`` (padded bucket)
+    ``validity`` : jnp bool array, True = valid; padding region is False at
+                   upload time; operators maintain correctness on [0, n).
+    ``dictionary``: for STRING columns, host numpy object array such that the
+                   logical value of row i is dictionary[data[i]]. When
+                   ``dict_sorted`` is True the dictionary is sorted+unique so
+                   code order == Spark UTF-8 byte order (order-preserving).
+    """
+
+    __slots__ = ("dtype", "data", "validity", "dictionary", "dict_sorted")
+
+    def __init__(self, dtype: T.DataType, data, validity,
+                 dictionary: Optional[np.ndarray] = None, dict_sorted: bool = True):
+        self.dtype = dtype
+        self.data = data
+        self.validity = validity
+        self.dictionary = dictionary
+        self.dict_sorted = dict_sorted
+
+    @property
+    def capacity(self) -> int:
+        return int(self.data.shape[0])
+
+    def device_nbytes(self) -> int:
+        return int(self.data.size * self.data.dtype.itemsize + self.validity.size)
+
+    @staticmethod
+    def _encode_strings(host: HostColumn) -> Tuple[np.ndarray, np.ndarray]:
+        """Order-preserving dictionary encode. Returns (codes int32, dict).
+
+        Python str comparison is by code point, which equals UTF-8 byte order
+        — the order Spark's UTF8String.compareTo uses — so a sorted-unique
+        dictionary makes code comparisons match Spark string comparisons."""
+        vals = np.where(host.validity, host.data, "")
+        # np.unique on object arrays of str sorts lexicographically by
+        # code point; return_inverse gives the codes directly.
+        dictionary, codes = np.unique(vals.astype(object), return_inverse=True)
+        return codes.astype(np.int32), dictionary
+
+    @staticmethod
+    def from_host(host: HostColumn, capacity: Optional[int] = None) -> "DeviceColumn":
+        n = len(host)
+        cap = capacity or bucket_for(n)
+        if cap < n:
+            raise ColumnarProcessingError(f"capacity {cap} < rows {n}")
+        validity = np.zeros(cap, dtype=np.bool_)
+        validity[:n] = host.validity
+        if isinstance(host.dtype, T.StringType):
+            codes, dictionary = DeviceColumn._encode_strings(host)
+            data = np.zeros(cap, dtype=np.int32)
+            data[:n] = codes
+            return DeviceColumn(host.dtype, jnp.asarray(data), jnp.asarray(validity),
+                                dictionary=dictionary, dict_sorted=True)
+        np_dtype = host.dtype.np_dtype
+        data = np.zeros(cap, dtype=np_dtype)
+        data[:n] = host.data
+        return DeviceColumn(host.dtype, jnp.asarray(data), jnp.asarray(validity))
+
+    def to_host(self, num_rows: int) -> HostColumn:
+        data = np.asarray(self.data)[:num_rows]
+        validity = np.asarray(self.validity)[:num_rows]
+        if isinstance(self.dtype, T.StringType):
+            if self.dictionary is None:
+                raise ColumnarProcessingError("string column missing dictionary")
+            # Clip: padding/invalid slots may hold arbitrary codes.
+            codes = np.clip(data, 0, max(len(self.dictionary) - 1, 0))
+            vals = np.empty(num_rows, dtype=object)
+            if len(self.dictionary):
+                vals[:] = self.dictionary[codes]
+            vals[~validity] = None
+            return HostColumn(self.dtype, vals, validity.copy())
+        return HostColumn(self.dtype, data.copy(), validity.copy())
+
+    def with_arrays(self, data, validity) -> "DeviceColumn":
+        return DeviceColumn(self.dtype, data, validity, self.dictionary, self.dict_sorted)

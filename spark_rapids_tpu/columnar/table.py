@@ -1,0 +1,171 @@
+"""Host and device tables (batches of columns).
+
+Reference surface: ai.rapids.cudf Table + Spark ColumnarBatch. A DeviceTable
+is the unit that flows between TPU execs; a HostTable is the CPU-fallback /
+transition representation (GpuRowToColumnarExec / GpuColumnarToRowExec analog
+lives in overrides/transitions.py).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.column import DeviceColumn, HostColumn, bucket_for
+from spark_rapids_tpu.errors import ColumnarProcessingError
+
+
+class HostTable:
+    """Named host columns with a shared row count."""
+
+    __slots__ = ("names", "columns")
+
+    def __init__(self, names: Sequence[str], columns: Sequence[HostColumn]):
+        self.names: Tuple[str, ...] = tuple(names)
+        self.columns: Tuple[HostColumn, ...] = tuple(columns)
+        if len(self.names) != len(self.columns):
+            raise ColumnarProcessingError("names/columns mismatch")
+        lens = {len(c) for c in self.columns}
+        if len(lens) > 1:
+            raise ColumnarProcessingError(f"ragged columns: {lens}")
+
+    @property
+    def num_rows(self) -> int:
+        return len(self.columns[0]) if self.columns else 0
+
+    @property
+    def num_columns(self) -> int:
+        return len(self.columns)
+
+    def schema(self) -> List[Tuple[str, T.DataType]]:
+        return [(n, c.dtype) for n, c in zip(self.names, self.columns)]
+
+    def column(self, name: str) -> HostColumn:
+        return self.columns[self.names.index(name)]
+
+    @staticmethod
+    def from_pydict(data: Dict[str, list], dtypes: Optional[Dict[str, T.DataType]] = None) -> "HostTable":
+        names, cols = [], []
+        for name, values in data.items():
+            dt = (dtypes or {}).get(name)
+            names.append(name)
+            cols.append(HostColumn.from_pylist(values, dt))
+        return HostTable(names, cols)
+
+    def to_pydict(self) -> Dict[str, list]:
+        return {n: c.to_pylist() for n, c in zip(self.names, self.columns)}
+
+    @staticmethod
+    def from_pandas(df) -> "HostTable":
+        names, cols = [], []
+        for name in df.columns:
+            s = df[name]
+            if s.dtype == object or str(s.dtype) in ("string", "str"):
+                cols.append(HostColumn.from_pylist(
+                    [None if v is None or (isinstance(v, float) and np.isnan(v)) else str(v)
+                     for v in s.tolist()], T.STRING))
+            else:
+                validity = ~s.isna().to_numpy()
+                vals = s.to_numpy()
+                if vals.dtype == np.float64 and not validity.all():
+                    vals = np.where(validity, vals, 0.0)
+                cols.append(HostColumn.from_numpy(np.ascontiguousarray(vals), validity))
+            names.append(name)
+        return HostTable(names, cols)
+
+    def to_pandas(self):
+        import pandas as pd
+        return pd.DataFrame({n: c.to_pylist() for n, c in zip(self.names, self.columns)})
+
+    def slice(self, start: int, length: int) -> "HostTable":
+        return HostTable(self.names, [c.slice(start, length) for c in self.columns])
+
+    @staticmethod
+    def concat(tables: Sequence["HostTable"]) -> "HostTable":
+        if not tables:
+            raise ColumnarProcessingError("concat of zero tables")
+        names = tables[0].names
+        cols = []
+        for i in range(len(names)):
+            dtype = tables[0].columns[i].dtype
+            datas = [t.columns[i].data for t in tables]
+            vals = [t.columns[i].validity for t in tables]
+            cols.append(HostColumn(dtype, np.concatenate(datas), np.concatenate(vals)))
+        return HostTable(names, cols)
+
+    def nbytes(self) -> int:
+        return sum(c.nbytes() for c in self.columns)
+
+
+class DeviceTable:
+    """Named device columns padded to a common capacity bucket.
+
+    ``num_rows`` is tracked both as a device int32 scalar (``nrows_dev``,
+    usable inside jitted kernels without host sync) and, lazily, as a host
+    int (``num_rows`` property — blocks on the device the first time it is
+    read after a data-dependent op such as filter)."""
+
+    __slots__ = ("names", "columns", "nrows_dev", "_nrows_host", "capacity")
+
+    def __init__(self, names: Sequence[str], columns: Sequence[DeviceColumn],
+                 nrows, capacity: Optional[int] = None):
+        self.names: Tuple[str, ...] = tuple(names)
+        self.columns: Tuple[DeviceColumn, ...] = tuple(columns)
+        if self.columns:
+            caps = {c.capacity for c in self.columns}
+            if len(caps) != 1:
+                raise ColumnarProcessingError(f"ragged capacities {caps}")
+            self.capacity = caps.pop()
+        else:
+            self.capacity = int(capacity or 0)
+        if isinstance(nrows, (int, np.integer)):
+            self._nrows_host: Optional[int] = int(nrows)
+            self.nrows_dev = jnp.asarray(np.int32(nrows))
+        else:
+            self._nrows_host = None
+            self.nrows_dev = nrows
+
+    @property
+    def num_rows(self) -> int:
+        if self._nrows_host is None:
+            self._nrows_host = int(jax.device_get(self.nrows_dev))
+        return self._nrows_host
+
+    @property
+    def num_columns(self) -> int:
+        return len(self.columns)
+
+    def schema(self) -> List[Tuple[str, T.DataType]]:
+        return [(n, c.dtype) for n, c in zip(self.names, self.columns)]
+
+    def schema_key(self) -> tuple:
+        """Structural key for the compile cache: column dtypes + capacity +
+        which columns are dictionary-encoded."""
+        return (
+            tuple((str(c.dtype), c.dictionary is not None) for c in self.columns),
+            self.capacity,
+        )
+
+    def column(self, name: str) -> DeviceColumn:
+        return self.columns[self.names.index(name)]
+
+    def device_nbytes(self) -> int:
+        return sum(c.device_nbytes() for c in self.columns)
+
+    @staticmethod
+    def from_host(host: HostTable, capacity: Optional[int] = None) -> "DeviceTable":
+        cap = capacity or bucket_for(host.num_rows)
+        cols = [DeviceColumn.from_host(c, cap) for c in host.columns]
+        return DeviceTable(host.names, cols, host.num_rows, cap)
+
+    def to_host(self) -> HostTable:
+        n = self.num_rows
+        return HostTable(self.names, [c.to_host(n) for c in self.columns])
+
+    def row_mask(self):
+        """Bool mask of live rows — usable inside jit (no host sync)."""
+        return jnp.arange(self.capacity, dtype=jnp.int32) < self.nrows_dev

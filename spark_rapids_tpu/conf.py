@@ -1,0 +1,270 @@
+"""Typed configuration registry (reference: RapidsConf.scala, 3,270 LoC,
+236 spark.rapids.* keys -- SURVEY.md §2.10/§5).
+
+Same design: a global registry of typed ConfEntry objects with defaults and
+doc strings, a RapidsConf view over a plain dict, per-operator kill switches
+registered dynamically by the rules layer, and markdown doc generation.
+Keys keep the spark.rapids.* prefix so reference users can carry configs over.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional
+
+_REGISTRY: Dict[str, "ConfEntry"] = {}
+
+
+@dataclass(frozen=True)
+class ConfEntry:
+    key: str
+    default: Any
+    doc: str
+    conv: Callable[[str], Any]
+    startup_only: bool = False
+    commonly_used: bool = False
+    internal: bool = False
+
+    def get(self, conf: "RapidsConf") -> Any:
+        return conf.get(self.key)
+
+
+def _to_bool(v) -> bool:
+    if isinstance(v, bool):
+        return v
+    return str(v).strip().lower() in ("true", "1", "yes", "on")
+
+
+def _conf(key, default, doc, conv, **kw) -> ConfEntry:
+    e = ConfEntry(key=key, default=default, doc=doc, conv=conv, **kw)
+    if key in _REGISTRY:
+        raise ValueError(f"duplicate conf key {key}")
+    _REGISTRY[key] = e
+    return e
+
+
+def bool_conf(key, default, doc, **kw):
+    return _conf(key, default, doc, _to_bool, **kw)
+
+
+def int_conf(key, default, doc, **kw):
+    return _conf(key, default, doc, int, **kw)
+
+
+def float_conf(key, default, doc, **kw):
+    return _conf(key, default, doc, float, **kw)
+
+
+def str_conf(key, default, doc, **kw):
+    return _conf(key, default, doc, str, **kw)
+
+
+def register_op_kill_switch(kind: str, name: str, default_enabled: bool, doc: str) -> ConfEntry:
+    """Per-operator kill switch, auto-generated from rule registration like
+    the reference's spark.rapids.sql.expression.* / sql.exec.* keys."""
+    key = f"spark.rapids.sql.{kind}.{name}"
+    if key in _REGISTRY:
+        return _REGISTRY[key]
+    return bool_conf(key, default_enabled, doc)
+
+
+# ---------------------------------------------------------------------------
+# Core entries (the ~30-key starter set from SURVEY.md §7 phase 2, growing
+# toward the reference's full 236).
+# ---------------------------------------------------------------------------
+
+SQL_ENABLED = bool_conf(
+    "spark.rapids.sql.enabled", True,
+    "Master enable for plan rewriting onto the TPU.", commonly_used=True)
+
+SQL_MODE = str_conf(
+    "spark.rapids.sql.mode", "executeongpu",
+    "executeongpu: rewrite and run on TPU; explainonly: tag the plan and "
+    "report what would run on TPU without converting.")
+
+EXPLAIN = str_conf(
+    "spark.rapids.sql.explain", "NONE",
+    "NONE, NOT_ON_GPU (log reasons for fallbacks) or ALL.", commonly_used=True)
+
+BATCH_SIZE_BYTES = int_conf(
+    "spark.rapids.sql.batchSizeBytes", 1 << 30,
+    "Target device batch size in bytes for coalescing.", commonly_used=True)
+
+MAX_READER_BATCH_SIZE_ROWS = int_conf(
+    "spark.rapids.sql.reader.batchSizeRows", 1 << 20,
+    "Soft cap on rows per batch produced by scans.")
+
+CONCURRENT_TPU_TASKS = int_conf(
+    "spark.rapids.sql.concurrentGpuTasks", 2,
+    "Number of tasks that may hold the device semaphore concurrently "
+    "(reference: GpuSemaphore).", commonly_used=True)
+
+HBM_POOL_FRACTION = float_conf(
+    "spark.rapids.memory.gpu.allocFraction", 0.9,
+    "Fraction of visible HBM the engine may use.", startup_only=True)
+
+HBM_RESERVE_BYTES = int_conf(
+    "spark.rapids.memory.gpu.reserve", 640 << 20,
+    "HBM held back from the pool for XLA scratch/fragmentation.",
+    startup_only=True)
+
+HOST_SPILL_STORAGE_SIZE = int_conf(
+    "spark.rapids.memory.host.spillStorageSize", 1 << 31,
+    "Bytes of host memory used for spilled device buffers before disk.")
+
+PINNED_POOL_SIZE = int_conf(
+    "spark.rapids.memory.pinnedPool.size", 0,
+    "Host staging pool for H2D/D2H transfers (0 = unpooled).",
+    startup_only=True)
+
+RETRY_OOM_MAX_RETRIES = int_conf(
+    "spark.rapids.memory.gpu.oomMaxRetries", 2,
+    "Synchronous-spill retries before escalating to split-and-retry.")
+
+SHUFFLE_MANAGER_MODE = str_conf(
+    "spark.rapids.shuffle.mode", "MULTITHREADED",
+    "MULTITHREADED (threaded host serialization over local shuffle files), "
+    "ICI (collective all-to-all over the device mesh when all partitions "
+    "live on one slice), or CACHE_ONLY.")
+
+SHUFFLE_MT_WRITER_THREADS = int_conf(
+    "spark.rapids.shuffle.multiThreaded.writer.threads", 8,
+    "Thread pool size for multithreaded shuffle writes.")
+
+SHUFFLE_MT_READER_THREADS = int_conf(
+    "spark.rapids.shuffle.multiThreaded.reader.threads", 8,
+    "Thread pool size for multithreaded shuffle reads.")
+
+SHUFFLE_COMPRESSION_CODEC = str_conf(
+    "spark.rapids.shuffle.compression.codec", "none",
+    "none, lz4 or zstd for serialized shuffle batches.")
+
+PARQUET_READER_TYPE = str_conf(
+    "spark.rapids.sql.format.parquet.reader.type", "AUTO",
+    "PERFILE, COALESCING, MULTITHREADED or AUTO (reference: "
+    "GpuParquetScan reader modes).")
+
+MULTITHREADED_READ_NUM_THREADS = int_conf(
+    "spark.rapids.sql.multiThreadedRead.numThreads", 20,
+    "Thread pool for multithreaded file prefetch.")
+
+READER_COALESCE_TARGET_BYTES = int_conf(
+    "spark.rapids.sql.reader.coalescing.targetBytes", 256 << 20,
+    "Target bytes when stitching small files/row-groups into one decode.")
+
+HAS_NANS = bool_conf(
+    "spark.rapids.sql.hasNans", False,
+    "Assume float data may contain NaNs (affects some agg/join support).")
+
+IMPROVED_FLOAT_OPS = bool_conf(
+    "spark.rapids.sql.variableFloatAgg.enabled", True,
+    "Allow float aggregations whose result may differ in ULPs from CPU "
+    "due to parallel reduction order.")
+
+ENABLE_CAST_STRING_TO_TIMESTAMP = bool_conf(
+    "spark.rapids.sql.castStringToTimestamp.enabled", False,
+    "String->timestamp cast has corner cases; off by default like the "
+    "reference.")
+
+DECIMAL_ENABLED = bool_conf(
+    "spark.rapids.sql.decimalType.enabled", True,
+    "Enable decimal processing on device (int64 unscaled, p<=18).")
+
+TEST_INJECT_RETRY_OOM = str_conf(
+    "spark.rapids.sql.test.injectRetryOOM", "",
+    "Test-only: 'retry[:N]' or 'split[:N]' to force OOM exceptions on the "
+    "Nth device allocation (reference: RmmSpark.forceRetryOOM).",
+    internal=True)
+
+METRICS_LEVEL = str_conf(
+    "spark.rapids.sql.metrics.level", "MODERATE",
+    "ESSENTIAL, MODERATE or DEBUG metric collection.")
+
+LORE_DUMP_IDS = str_conf(
+    "spark.rapids.sql.lore.idsToDump", "",
+    "LORE operator ids whose input batches should be dumped for replay.")
+
+LORE_DUMP_PATH = str_conf(
+    "spark.rapids.sql.lore.dumpPath", "",
+    "Directory for LORE dumps.")
+
+CPU_ORACLE_STRICT = bool_conf(
+    "spark.rapids.sql.test.strictOracle", True,
+    "Test-only: compare device results bit-for-bit against the CPU path.",
+    internal=True)
+
+
+class RapidsConf:
+    """Immutable-ish view over a plain {key: value} dict with typed access."""
+
+    def __init__(self, settings: Optional[Dict[str, Any]] = None):
+        self._settings = dict(settings or {})
+
+    def get(self, key: str) -> Any:
+        entry = _REGISTRY.get(key)
+        if key in self._settings:
+            raw = self._settings[key]
+            return entry.conv(raw) if entry is not None and isinstance(raw, str) else raw
+        if entry is None:
+            raise KeyError(f"unknown conf key {key}")
+        return entry.default
+
+    def get_entry(self, entry: ConfEntry) -> Any:
+        return self.get(entry.key)
+
+    def set(self, key: str, value: Any) -> "RapidsConf":
+        s = dict(self._settings)
+        s[key] = value
+        return RapidsConf(s)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dict(self._settings)
+
+    # Convenience accessors used throughout the engine.
+    @property
+    def sql_enabled(self) -> bool:
+        return self.get_entry(SQL_ENABLED)
+
+    @property
+    def explain_mode(self) -> str:
+        return str(self.get_entry(EXPLAIN)).upper()
+
+    @property
+    def is_explain_only(self) -> bool:
+        return str(self.get_entry(SQL_MODE)).lower() == "explainonly"
+
+    @property
+    def batch_size_bytes(self) -> int:
+        return self.get_entry(BATCH_SIZE_BYTES)
+
+    @property
+    def concurrent_tpu_tasks(self) -> int:
+        return self.get_entry(CONCURRENT_TPU_TASKS)
+
+    def is_op_enabled(self, kind: str, name: str) -> bool:
+        key = f"spark.rapids.sql.{kind}.{name}"
+        if key in self._settings:
+            return _to_bool(self._settings[key])
+        entry = _REGISTRY.get(key)
+        return bool(entry.default) if entry else True
+
+
+def registry() -> Dict[str, ConfEntry]:
+    return dict(_REGISTRY)
+
+
+def generate_docs() -> str:
+    """Markdown table of all configs (reference: docs/configs.md generation
+    from RapidsConf.help)."""
+    lines = [
+        "# spark_rapids_tpu configuration",
+        "",
+        "| Key | Default | Description |",
+        "|---|---|---|",
+    ]
+    for key in sorted(_REGISTRY):
+        e = _REGISTRY[key]
+        if e.internal:
+            continue
+        lines.append(f"| `{e.key}` | `{e.default}` | {e.doc} |")
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,49 @@
+"""Exception hierarchy, mirroring the reference's OOM/retry protocol.
+
+Reference: spark-rapids-jni exception types (SURVEY.md §2.9) --
+GpuRetryOOM / GpuSplitAndRetryOOM / CpuRetryOOM / CpuSplitAndRetryOOM /
+GpuOOM -- thrown by the RmmSpark per-thread state machine and caught by
+RmmRapidsRetryIterator.withRetry (RmmRapidsRetryIterator.scala:33-757).
+
+On TPU the analogs are raised when a PJRT/XLA device allocation fails (or
+when the runtime's HBM budget tracker decides a batch will not fit), and by
+the test-only OOM injection hooks.
+"""
+
+from __future__ import annotations
+
+
+class RapidsTpuError(Exception):
+    """Base for all engine errors."""
+
+
+class RetryOOM(RapidsTpuError):
+    """Device allocation failed; caller should spill and replay the same
+    input (reference: GpuRetryOOM)."""
+
+
+class SplitAndRetryOOM(RapidsTpuError):
+    """Device allocation failed and replay alone will not help; caller should
+    split the input (halve rows) and replay (reference: GpuSplitAndRetryOOM)."""
+
+
+class CpuRetryOOM(RapidsTpuError):
+    """Host allocation failed; spill host buffers and replay."""
+
+
+class CpuSplitAndRetryOOM(RapidsTpuError):
+    """Host allocation failed; split input and replay."""
+
+
+class FatalDeviceOOM(RapidsTpuError):
+    """Unrecoverable device OOM after retries exhausted (reference: GpuOOM)."""
+
+
+class ColumnarProcessingError(RapidsTpuError):
+    """An operator failed on device in a way that is not an OOM."""
+
+
+class UnsupportedOnTpu(RapidsTpuError):
+    """Raised when an operator/expression is asked to run on device but was
+    tagged unsupported; indicates a bug in the plan-rewrite layer (normal
+    operation converts such nodes back to CPU)."""

@@ -1,0 +1,17 @@
+"""TPU physical operators (reference: the ~35 GpuExec operators, SURVEY.md
+§2.3). Each exec consumes/produces DeviceTable batches; expression work is
+fused into single jitted XLA computations via ops/expr.py."""
+
+from spark_rapids_tpu.execs.base import TpuExec, HostToDevice, DeviceToHost, InputAdapter  # noqa: F401
+from spark_rapids_tpu.execs.basic import (  # noqa: F401
+    TpuScanExec,
+    TpuRangeExec,
+    TpuProjectExec,
+    TpuFilterExec,
+    TpuLimitExec,
+    TpuUnionExec,
+    TpuCoalesceExec,
+    TpuExpandExec,
+)
+from spark_rapids_tpu.execs.aggregate import TpuHashAggregateExec  # noqa: F401
+from spark_rapids_tpu.execs.sort import TpuSortExec  # noqa: F401

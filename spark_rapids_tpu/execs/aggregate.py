@@ -1,0 +1,302 @@
+"""TPU hash aggregate (reference: GpuHashAggregateExec / GpuMergeAggregate-
+Iterator, GpuAggregateExec.scala — SURVEY.md §2.3).
+
+TPU-first design: instead of a hash table (pointer-chasing is hostile to the
+VPU), grouping is SORT-SEGMENT based — the XLA-friendly classic:
+
+  1. evaluate key/value expressions (fused, ops/expr.py);
+  2. lexicographic multi-operand ``lax.sort`` over (live, key-validity,
+     key-data...) with a row-index payload;
+  3. segment boundaries -> dense group ids via cumsum;
+  4. ``jax.ops.segment_*`` reductions with static num_segments=capacity;
+  5. scatter per-group results to [0, ngroups) positions.
+
+Everything is static-shaped; the live group count rides out as a device
+scalar. String keys group by dictionary code (order-preserving per batch).
+Requires a single coalesced input batch (RequireSingleBatch goal) in v1;
+partial-per-batch + merge is the planned widening."""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar import DeviceColumn, DeviceTable
+from spark_rapids_tpu.errors import ColumnarProcessingError
+from spark_rapids_tpu.execs.base import TpuExec
+from spark_rapids_tpu.ops import aggregates as agg
+from spark_rapids_tpu.ops.expr import (
+    DevVal,
+    EvalCtx,
+    Expression,
+    NodePrep,
+    PrepCtx,
+    _prep_trace_key,
+    _walk_eval,
+    _walk_prep,
+)
+
+DEVICE_SUPPORTED_AGGS = (agg.Sum, agg.Min, agg.Max, agg.Count, agg.Average,
+                         agg.First, agg.Last, agg.StddevPop, agg.StddevSamp,
+                         agg.VariancePop, agg.VarianceSamp)
+
+
+def _sortable(data, validity):
+    """Transform (data, validity) into sort operands grouping nulls
+    together: (invalid_first_flag, data_with_nulls_zeroed). Floats are
+    normalized so -0.0 groups with 0.0 (Spark NormalizeFloatingNumbers)."""
+    if jnp.issubdtype(data.dtype, jnp.floating):
+        data = jnp.where(data == 0.0, jnp.zeros_like(data), data)
+    return [(~validity).astype(jnp.int32), jnp.where(validity, data, jnp.zeros_like(data))]
+
+
+class TpuHashAggregateExec(TpuExec):
+    def __init__(self, child: TpuExec, grouping: Sequence[Expression],
+                 agg_specs: Sequence[Tuple[str, agg.AggregateFunction]],
+                 grouping_names: Sequence[str]):
+        super().__init__()
+        self.children = (child,)
+        self.grouping = list(grouping)
+        self.agg_specs = list(agg_specs)
+        self.grouping_names = list(grouping_names)
+        self._traces = {}
+
+    def output_schema(self):
+        out = [(n, g.data_type) for n, g in zip(self.grouping_names, self.grouping)]
+        out += [(n, fn.data_type) for n, fn in self.agg_specs]
+        return out
+
+    def execute(self):
+        batches = list(self.children[0].execute())
+        if len(batches) != 1:
+            from spark_rapids_tpu.execs.basic import TpuCoalesceExec
+            raise ColumnarProcessingError(
+                "TpuHashAggregateExec requires a single coalesced batch")
+        yield self._aggregate(batches[0])
+
+    # -- core ---------------------------------------------------------------
+    def _aggregate(self, table: DeviceTable) -> DeviceTable:
+        value_exprs: List[Expression] = []
+        for _, fn in self.agg_specs:
+            value_exprs.append(fn.child if fn.child is not None else None)
+
+        pctx = PrepCtx(table)
+        key_preps: List[List[NodePrep]] = []
+        for g in self.grouping:
+            preps: List[NodePrep] = []
+            _walk_prep(g, pctx, preps)
+            key_preps.append(preps)
+        val_preps: List[List[NodePrep]] = []
+        for ve in value_exprs:
+            if ve is None:
+                val_preps.append([])
+            else:
+                preps = []
+                _walk_prep(ve, pctx, preps)
+                val_preps.append(preps)
+
+        cols = tuple(DevVal(c.data, c.validity) for c in table.columns)
+        aux = tuple(jnp.asarray(a) for a in pctx.aux_arrays)
+        capacity = table.capacity
+
+        tkey = (capacity,
+                tuple(_prep_trace_key(p) for p in key_preps),
+                tuple(_prep_trace_key(p) for p in val_preps))
+        fn = self._traces.get(tkey)
+        if fn is None:
+            fn = jax.jit(self._build_kernel(capacity, key_preps, val_preps))
+            self._traces[tkey] = fn
+
+        out_arrays, ngroups = fn(cols, aux, table.nrows_dev)
+
+        out_cols: List[DeviceColumn] = []
+        names: List[str] = []
+        for i, (g, name) in enumerate(zip(self.grouping, self.grouping_names)):
+            data, validity = out_arrays[i]
+            root = key_preps[i][-1]
+            out_cols.append(DeviceColumn(g.data_type, data, validity,
+                                         dictionary=root.out_dict,
+                                         dict_sorted=root.dict_sorted))
+            names.append(name)
+        for j, (name, fnagg) in enumerate(self.agg_specs):
+            data, validity = out_arrays[len(self.grouping) + j]
+            dictionary = None
+            dict_sorted = True
+            if isinstance(fnagg.data_type, T.StringType) and val_preps[j]:
+                dictionary = val_preps[j][-1].out_dict
+                dict_sorted = val_preps[j][-1].dict_sorted
+            out_cols.append(DeviceColumn(fnagg.data_type, data, validity,
+                                         dictionary=dictionary, dict_sorted=dict_sorted))
+            names.append(name)
+        return DeviceTable(names, out_cols, ngroups, capacity)
+
+    def _build_kernel(self, capacity: int, key_preps, val_preps):
+        grouping = self.grouping
+        agg_specs = self.agg_specs
+        value_exprs = [fn.child for _, fn in agg_specs]
+
+        def kernel(cols, aux, nrows):
+            live = jnp.arange(capacity, dtype=jnp.int32) < nrows
+
+            key_vals: List[DevVal] = []
+            for g, preps in zip(grouping, key_preps):
+                ctx = EvalCtx(cols, aux, nrows, capacity)
+                ctx._prep_iter = iter(preps)
+                key_vals.append(_walk_eval(g, ctx))
+            val_vals: List[DevVal] = []
+            for ve, preps in zip(value_exprs, val_preps):
+                if ve is None:
+                    val_vals.append(None)
+                else:
+                    ctx = EvalCtx(cols, aux, nrows, capacity)
+                    ctx._prep_iter = iter(preps)
+                    val_vals.append(_walk_eval(ve, ctx))
+
+            # normalize float keys so grouping matches the CPU oracle
+            norm = []
+            for kv in key_vals:
+                d = kv.data
+                if jnp.issubdtype(d.dtype, jnp.floating):
+                    d = jnp.where(d == 0.0, jnp.zeros_like(d), d)
+                norm.append(DevVal(d, kv.validity))
+            key_vals = norm
+
+            if grouping:
+                operands = [(~live).astype(jnp.int32)]  # dead rows last
+                for kv in key_vals:
+                    operands.extend(_sortable(kv.data, kv.validity))
+                payload = jnp.arange(capacity, dtype=jnp.int32)
+                sorted_all = jax.lax.sort(operands + [payload],
+                                          num_keys=len(operands))
+                perm = sorted_all[-1]
+                s_live = live[perm]
+                s_keys = [DevVal(kv.data[perm], kv.validity[perm]) for kv in key_vals]
+
+                # group boundaries among live rows
+                first = jnp.arange(capacity) == 0
+                changed = jnp.zeros(capacity, dtype=jnp.bool_)
+                for kv in s_keys:
+                    d, v = kv.data, kv.validity
+                    dprev = jnp.roll(d, 1)
+                    vprev = jnp.roll(v, 1)
+                    diff = (jnp.where(v & vprev, d != dprev, v != vprev))
+                    changed = changed | diff
+                new_group = (first | changed) & s_live
+                gid = jnp.cumsum(new_group.astype(jnp.int32)) - 1
+                gid = jnp.where(s_live, gid, capacity - 1)  # park dead rows
+                ngroups = jnp.sum(new_group.astype(jnp.int32))
+            else:
+                perm = jnp.arange(capacity, dtype=jnp.int32)
+                s_live = live
+                s_keys = []
+                gid = jnp.zeros(capacity, dtype=jnp.int32)
+                ngroups = jnp.asarray(1, dtype=jnp.int32)
+
+            group_live = jnp.arange(capacity, dtype=jnp.int32) < ngroups
+
+            outs = []
+            # key columns: scatter first-occurrence values to gid slots
+            for kv in s_keys:
+                tgt = jnp.where(s_live, gid, capacity)
+                kd = jnp.zeros_like(kv.data).at[tgt].set(kv.data, mode="drop")
+                kvv = jnp.zeros_like(kv.validity).at[tgt].set(kv.validity, mode="drop")
+                outs.append((kd, kvv & group_live))
+
+            for (name, fnagg), vv in zip(agg_specs, val_vals):
+                outs.append(self._agg_device(fnagg, vv, perm, gid, s_live,
+                                             group_live, ngroups, capacity))
+            return outs, ngroups
+
+        return kernel
+
+    @staticmethod
+    def _agg_device(fnagg, vv, perm, gid, s_live, group_live, ngroups, capacity):
+        seg = jax.ops
+        if isinstance(fnagg, agg.Count):
+            if fnagg.child is None:
+                w = s_live.astype(jnp.int64)
+            else:
+                w = (vv.validity[perm] & s_live).astype(jnp.int64)
+            cnt = seg.segment_sum(w, gid, num_segments=capacity)
+            return (cnt, group_live)
+
+        sd = vv.data[perm]
+        sv = vv.validity[perm] & s_live
+        nonnull = seg.segment_sum(sv.astype(jnp.int64), gid, num_segments=capacity)
+        has_any = (nonnull > 0) & group_live
+
+        if isinstance(fnagg, agg.Sum):
+            if isinstance(fnagg.data_type, T.LongType):
+                v = jnp.where(sv, sd.astype(jnp.int64), 0)
+                s = seg.segment_sum(v, gid, num_segments=capacity)
+                return (s, has_any)
+            v = jnp.where(sv, sd.astype(jnp.float64), 0.0)
+            s = seg.segment_sum(v, gid, num_segments=capacity)
+            return (jnp.where(has_any, s, 0.0), has_any)
+
+        if isinstance(fnagg, agg.Average):
+            v = jnp.where(sv, sd.astype(jnp.float64), 0.0)
+            s = seg.segment_sum(v, gid, num_segments=capacity)
+            return (jnp.where(has_any, s / jnp.maximum(nonnull, 1), 0.0), has_any)
+
+        if isinstance(fnagg, (agg.StddevPop, agg.StddevSamp, agg.VariancePop, agg.VarianceSamp)):
+            v = jnp.where(sv, sd.astype(jnp.float64), 0.0)
+            s = seg.segment_sum(v, gid, num_segments=capacity)
+            mean = s / jnp.maximum(nonnull, 1)
+            centered = jnp.where(sv, (sd.astype(jnp.float64) - mean[gid]) ** 2, 0.0)
+            m2 = seg.segment_sum(centered, gid, num_segments=capacity)
+            if isinstance(fnagg, (agg.StddevPop, agg.VariancePop)):
+                denom = jnp.maximum(nonnull, 1)
+                validity = has_any
+            else:
+                denom = jnp.maximum(nonnull - 1, 1)
+                validity = (nonnull > 1) & group_live
+            var = m2 / denom
+            out = jnp.sqrt(var) if isinstance(fnagg, (agg.StddevPop, agg.StddevSamp)) else var
+            return (jnp.where(validity, out, 0.0), validity)
+
+        if isinstance(fnagg, (agg.Min, agg.Max)):
+            dt = sd.dtype
+            if jnp.issubdtype(dt, jnp.floating):
+                ident = jnp.asarray(jnp.inf if isinstance(fnagg, agg.Min) else -jnp.inf, dtype=dt)
+            elif dt == jnp.bool_:
+                sd = sd.astype(jnp.int32)
+                dt = jnp.int32
+                ident = jnp.asarray(1 if isinstance(fnagg, agg.Min) else 0, dtype=dt)
+            else:
+                info = jnp.iinfo(dt)
+                ident = jnp.asarray(info.max if isinstance(fnagg, agg.Min) else info.min, dtype=dt)
+            v = jnp.where(sv, sd, ident)
+            if isinstance(fnagg, agg.Min):
+                r = seg.segment_min(v, gid, num_segments=capacity)
+            else:
+                r = seg.segment_max(v, gid, num_segments=capacity)
+            if isinstance(fnagg.data_type, T.BooleanType):
+                r = r.astype(jnp.bool_)
+            zero = jnp.zeros_like(r)
+            return (jnp.where(has_any, r, zero), has_any)
+
+        if isinstance(fnagg, (agg.First, agg.Last)):
+            idx = jnp.arange(capacity, dtype=jnp.int64)
+            pick_mask = sv if fnagg.ignore_nulls else s_live
+            sentinel = capacity if isinstance(fnagg, agg.First) else -1
+            pos = jnp.where(pick_mask, idx, sentinel)
+            if isinstance(fnagg, agg.First):
+                chosen = seg.segment_min(pos, gid, num_segments=capacity)
+            else:
+                chosen = seg.segment_max(pos, gid, num_segments=capacity)
+            got = (chosen >= 0) & (chosen < capacity) & group_live
+            safe = jnp.clip(chosen, 0, capacity - 1)
+            data = sd[safe]
+            validity = got & sv[safe] if fnagg.ignore_nulls else got & vv.validity[perm][safe]
+            return (jnp.where(validity, data, jnp.zeros_like(data)), validity)
+
+        raise ColumnarProcessingError(f"device aggregate {type(fnagg).__name__}")
+
+    def describe(self):
+        return (f"TpuHashAggregate[keys={self.grouping_names}, "
+                f"aggs={[n for n, _ in self.agg_specs]}]")

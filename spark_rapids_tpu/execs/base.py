@@ -1,0 +1,110 @@
+"""Exec base + host<->device transitions (reference: GpuExec.scala,
+GpuRowToColumnarExec / GpuColumnarToRowExec — SURVEY.md §2.2/§2.3)."""
+
+from __future__ import annotations
+
+import time
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar import DeviceTable, HostTable
+from spark_rapids_tpu.plan.nodes import PlanNode, Schema
+
+
+class TpuExec:
+    """Base of device operators. ``execute`` yields DeviceTable batches."""
+
+    children: Tuple[object, ...] = ()  # TpuExec or HostToDevice
+
+    def __init__(self):
+        self.metrics = {}
+
+    def output_schema(self) -> Schema:
+        raise NotImplementedError
+
+    def execute(self) -> Iterator[DeviceTable]:
+        raise NotImplementedError
+
+    @property
+    def name(self):
+        return type(self).__name__
+
+    def describe(self) -> str:
+        return self.name
+
+    def tree_string(self, indent: int = 0) -> str:
+        s = "  " * indent + "* " + self.describe() + "\n"
+        for c in self.children:
+            s += c.tree_string(indent + 1)
+        return s
+
+    def add_metric(self, key: str, value):
+        self.metrics[key] = self.metrics.get(key, 0) + value
+
+
+class HostToDevice(TpuExec):
+    """Transition: wraps a CPU PlanNode, uploading its host batches
+    (GpuRowToColumnarExec analog; columnar host->HBM copy)."""
+
+    def __init__(self, cpu_node: PlanNode):
+        super().__init__()
+        self.cpu_node = cpu_node
+
+    def output_schema(self):
+        return self.cpu_node.output_schema()
+
+    def execute(self):
+        for batch in self.cpu_node.execute_cpu():
+            t0 = time.perf_counter()
+            dt = DeviceTable.from_host(batch)
+            self.add_metric("h2dTime", time.perf_counter() - t0)
+            self.add_metric("h2dBatches", 1)
+            yield dt
+
+    def describe(self):
+        return f"HostToDevice[{self.cpu_node.describe()}]"
+
+    def tree_string(self, indent: int = 0):
+        s = "  " * indent + "* " + "HostToDevice\n"
+        return s + self.cpu_node.tree_string(indent + 1)
+
+
+class DeviceToHost:
+    """Transition: device exec -> host batches (GpuColumnarToRowExec analog)."""
+
+    def __init__(self, tpu_exec: TpuExec):
+        self.tpu_exec = tpu_exec
+
+    def output_schema(self):
+        return self.tpu_exec.output_schema()
+
+    def execute_cpu(self) -> Iterator[HostTable]:
+        for dt in self.tpu_exec.execute():
+            yield dt.to_host()
+
+    def describe(self):
+        return "DeviceToHost"
+
+    def tree_string(self, indent: int = 0):
+        return "  " * indent + "DeviceToHost\n" + self.tpu_exec.tree_string(indent + 1)
+
+
+class InputAdapter(PlanNode):
+    """CPU plan node that sources batches from an arbitrary executable
+    (used when a CPU fallback node sits above converted children)."""
+
+    def __init__(self, source, schema: Schema):
+        self.source = source
+        self._schema = schema
+
+    def output_schema(self):
+        return self._schema
+
+    def execute_cpu(self):
+        return self.source.execute_cpu()
+
+    def describe(self):
+        return "InputAdapter"
+
+    def tree_string(self, indent: int = 0):
+        return "  " * indent + "InputAdapter\n" + self.source.tree_string(indent + 1)

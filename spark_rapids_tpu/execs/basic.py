@@ -1,0 +1,263 @@
+"""Basic TPU execs: scan, range, project, filter, limit, union, coalesce,
+expand (reference: basicPhysicalOperators.scala, GpuCoalesceBatches.scala,
+GpuExpandExec.scala — SURVEY.md §2.3)."""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar import DeviceColumn, DeviceTable, HostTable, bucket_for
+from spark_rapids_tpu.columnar.column import MIN_BUCKET
+from spark_rapids_tpu.execs.base import TpuExec
+from spark_rapids_tpu.ops.expr import (
+    DevVal,
+    EvalCtx,
+    Expression,
+    NodePrep,
+    PrepCtx,
+    _walk_eval,
+    _walk_prep,
+    _prep_trace_key,
+    compile_project,
+    output_name,
+)
+
+
+class TpuScanExec(TpuExec):
+    """Uploads pre-built host batches (LocalScan analog)."""
+
+    def __init__(self, batches: Sequence[HostTable]):
+        super().__init__()
+        self.batches = list(batches)
+
+    def output_schema(self):
+        return self.batches[0].schema()
+
+    def execute(self):
+        for b in self.batches:
+            yield DeviceTable.from_host(b)
+
+    def describe(self):
+        return f"TpuScan[{len(self.batches)} batches]"
+
+
+class TpuRangeExec(TpuExec):
+    """Device-side range generation (reference: GpuRangeExec)."""
+
+    def __init__(self, start: int, end: int, step: int, batch_rows: int, name: str):
+        super().__init__()
+        self.start, self.end, self.step = start, end, step
+        self.batch_rows = batch_rows
+        self.col_name = name
+
+    def output_schema(self):
+        return [(self.col_name, T.LONG)]
+
+    def execute(self):
+        total = max(0, -(-(self.end - self.start) // self.step))
+        pos = 0
+        while True:
+            cnt = min(self.batch_rows, total - pos) if total else 0
+            cap = bucket_for(max(cnt, 1))
+            data = jnp.arange(cap, dtype=jnp.int64) * self.step + (self.start + pos * self.step)
+            validity = jnp.arange(cap, dtype=jnp.int32) < cnt
+            yield DeviceTable([self.col_name], [DeviceColumn(T.LONG, data, validity)], cnt, cap)
+            pos += cnt
+            if pos >= total:
+                break
+
+
+class TpuProjectExec(TpuExec):
+    def __init__(self, child: TpuExec, exprs: Sequence[Expression], names: Sequence[str]):
+        super().__init__()
+        self.children = (child,)
+        self.exprs = list(exprs)
+        self.names = list(names)
+
+    def output_schema(self):
+        return [(n, e.data_type) for n, e in zip(self.names, self.exprs)]
+
+    def execute(self):
+        for batch in self.children[0].execute():
+            cols = compile_project(self.exprs, batch)
+            yield DeviceTable(self.names, cols, batch.nrows_dev, batch.capacity)
+
+    def describe(self):
+        return f"TpuProject{self.names}"
+
+
+class _FilterKernel:
+    """Fused predicate evaluation + row compaction, one jit per
+    (schema, predicate, bucket, prep structure).
+
+    Compaction is O(n): scatter kept rows to cumsum positions (dropped rows
+    scatter out of bounds with mode='drop') — no sort needed."""
+
+    def __init__(self, condition: Expression):
+        self.condition = condition
+        self._traces = {}
+
+    def __call__(self, table: DeviceTable):
+        pctx = PrepCtx(table)
+        preps: List[NodePrep] = []
+        _walk_prep(self.condition, pctx, preps)
+        cols = tuple(DevVal(c.data, c.validity) for c in table.columns)
+        aux = tuple(jnp.asarray(a) for a in pctx.aux_arrays)
+        capacity = table.capacity
+
+        tkey = (capacity, _prep_trace_key(preps))
+        fn = self._traces.get(tkey)
+        if fn is None:
+            cond = self.condition
+
+            def run(cols, aux, nrows):
+                ctx = EvalCtx(cols, aux, nrows, capacity)
+                ctx._prep_iter = iter(preps)
+                pred = _walk_eval(cond, ctx)
+                live = jnp.arange(capacity, dtype=jnp.int32) < nrows
+                keep = pred.data & pred.validity & live
+                pos = jnp.cumsum(keep.astype(jnp.int32)) - 1
+                tgt = jnp.where(keep, pos, capacity)
+                new_n = jnp.sum(keep.astype(jnp.int32))
+                outs = []
+                for data, validity in cols:
+                    od = jnp.zeros_like(data).at[tgt].set(data, mode="drop")
+                    ov = jnp.zeros_like(validity).at[tgt].set(validity, mode="drop")
+                    outs.append((od, ov))
+                return outs, new_n
+
+            fn = jax.jit(run)
+            self._traces[tkey] = fn
+
+        outs, new_n = fn(cols, aux, table.nrows_dev)
+        new_cols = [c.with_arrays(d, v) for c, (d, v) in zip(table.columns, outs)]
+        return DeviceTable(table.names, new_cols, new_n, capacity)
+
+
+class TpuFilterExec(TpuExec):
+    def __init__(self, child: TpuExec, condition: Expression):
+        super().__init__()
+        self.children = (child,)
+        self.condition = condition
+        self._kernel = _FilterKernel(condition)
+
+    def output_schema(self):
+        return self.children[0].output_schema()
+
+    def execute(self):
+        for batch in self.children[0].execute():
+            yield self._kernel(batch)
+
+    def describe(self):
+        return f"TpuFilter[{self.condition!r}]"
+
+
+class TpuLimitExec(TpuExec):
+    def __init__(self, child: TpuExec, limit: int):
+        super().__init__()
+        self.children = (child,)
+        self.limit = limit
+
+    def output_schema(self):
+        return self.children[0].output_schema()
+
+    def execute(self):
+        remaining = self.limit
+        for batch in self.children[0].execute():
+            if remaining <= 0:
+                return
+            n = batch.num_rows  # host sync at the limit boundary only
+            take = min(n, remaining)
+            if take == n:
+                yield batch
+            else:
+                yield DeviceTable(batch.names, batch.columns, take, batch.capacity)
+            remaining -= take
+            if remaining <= 0:
+                return
+
+    def describe(self):
+        return f"TpuLimit[{self.limit}]"
+
+
+class TpuUnionExec(TpuExec):
+    def __init__(self, children: Sequence[TpuExec]):
+        super().__init__()
+        self.children = tuple(children)
+
+    def output_schema(self):
+        return self.children[0].output_schema()
+
+    def execute(self):
+        for c in self.children:
+            yield from c.execute()
+
+
+class TpuExpandExec(TpuExec):
+    """Each input batch produces one output batch per projection
+    (reference: GpuExpandExec)."""
+
+    def __init__(self, child: TpuExec, projections: Sequence[Sequence[Expression]],
+                 names: Sequence[str]):
+        super().__init__()
+        self.children = (child,)
+        self.projections = [list(p) for p in projections]
+        self.names = list(names)
+
+    def output_schema(self):
+        return [(n, e.data_type) for n, e in zip(self.names, self.projections[0])]
+
+    def execute(self):
+        for batch in self.children[0].execute():
+            for proj in self.projections:
+                cols = compile_project(proj, batch)
+                yield DeviceTable(self.names, cols, batch.nrows_dev, batch.capacity)
+
+
+class TpuCoalesceExec(TpuExec):
+    """Concatenate child batches up to a target size — or into ONE batch when
+    ``require_single`` (reference: GpuCoalesceBatches with
+    TargetSize/RequireSingleBatch goals).
+
+    v1 concatenates via host round-trip when more than one batch arrives
+    (string dictionaries must be re-merged anyway); single-batch passthrough
+    stays on device. Device-side concat for non-string columns is a planned
+    fast path."""
+
+    def __init__(self, child: TpuExec, target_bytes: int = 1 << 30,
+                 require_single: bool = False):
+        super().__init__()
+        self.children = (child,)
+        self.target_bytes = target_bytes
+        self.require_single = require_single
+
+    def output_schema(self):
+        return self.children[0].output_schema()
+
+    def execute(self):
+        pending: List[DeviceTable] = []
+        pending_bytes = 0
+        for batch in self.children[0].execute():
+            pending.append(batch)
+            pending_bytes += batch.device_nbytes()
+            if not self.require_single and pending_bytes >= self.target_bytes:
+                yield self._flush(pending)
+                pending, pending_bytes = [], 0
+        if pending:
+            yield self._flush(pending)
+
+    def _flush(self, batches: List[DeviceTable]) -> DeviceTable:
+        if len(batches) == 1:
+            return batches[0]
+        self.add_metric("concatBatches", len(batches))
+        host = HostTable.concat([b.to_host() for b in batches])
+        return DeviceTable.from_host(host)
+
+    def describe(self):
+        goal = "RequireSingleBatch" if self.require_single else f"TargetSize({self.target_bytes})"
+        return f"TpuCoalesce[{goal}]"

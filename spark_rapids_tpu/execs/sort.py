@@ -1,0 +1,113 @@
+"""TPU sort (reference: GpuSortExec.scala / SortUtils.scala — SURVEY.md
+§2.3; out-of-core spill variant comes with the memory runtime).
+
+Multi-operand ``lax.sort`` does the lexicographic work directly on the MXU-
+adjacent sort network. Each sort key is transformed into ascending operands:
+descending order negates/complements the key; nulls-first/last becomes an
+explicit leading flag operand; padding rows always sort last. A row-index
+payload yields the permutation used to gather every output column."""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar import DeviceTable
+from spark_rapids_tpu.execs.base import TpuExec
+from spark_rapids_tpu.ops.expr import (
+    DevVal,
+    EvalCtx,
+    NodePrep,
+    PrepCtx,
+    _prep_trace_key,
+    _walk_eval,
+    _walk_prep,
+)
+from spark_rapids_tpu.plan.nodes import SortOrder
+
+
+def _directional(data, validity, ascending: bool, nulls_first: bool, capacity: int):
+    """Make (null_flag, key) operands for an ascending lax.sort that realize
+    the requested direction and null placement."""
+    if jnp.issubdtype(data.dtype, jnp.floating):
+        # Spark normalizes -0.0 == 0.0 for ordering; lax.sort's total order
+        # would otherwise put -0.0 first and diverge from the CPU oracle.
+        data = jnp.where(data == 0.0, jnp.zeros_like(data), data)
+    if ascending:
+        d = data
+    else:
+        if jnp.issubdtype(data.dtype, jnp.floating):
+            d = -data
+            d = jnp.where(d == 0.0, jnp.zeros_like(d), d)
+        elif data.dtype == jnp.bool_:
+            d = ~data
+        else:
+            d = ~data  # bitwise complement reverses two's-complement order
+    # null flag sorts ahead of the key: 0 sorts first, so invalid rows get 0
+    # when nulls_first else 1.
+    nf = jnp.where(validity, 1 if nulls_first else 0, 0 if nulls_first else 1)
+    d = jnp.where(validity, d, jnp.zeros_like(d))
+    return [nf, d]
+
+
+class TpuSortExec(TpuExec):
+    def __init__(self, child: TpuExec, orders: Sequence[SortOrder]):
+        super().__init__()
+        self.children = (child,)
+        self.orders = list(orders)
+        self._traces = {}
+
+    def output_schema(self):
+        return self.children[0].output_schema()
+
+    def execute(self):
+        batches = list(self.children[0].execute())
+        if len(batches) > 1:
+            from spark_rapids_tpu.execs.basic import TpuCoalesceExec
+            from spark_rapids_tpu.errors import ColumnarProcessingError
+            raise ColumnarProcessingError("TpuSortExec requires a single coalesced batch")
+        yield self._sort(batches[0])
+
+    def _sort(self, table: DeviceTable) -> DeviceTable:
+        pctx = PrepCtx(table)
+        key_preps: List[List[NodePrep]] = []
+        for o in self.orders:
+            preps: List[NodePrep] = []
+            _walk_prep(o.expr, pctx, preps)
+            key_preps.append(preps)
+        cols = tuple(DevVal(c.data, c.validity) for c in table.columns)
+        aux = tuple(jnp.asarray(a) for a in pctx.aux_arrays)
+        capacity = table.capacity
+
+        tkey = (capacity, tuple(_prep_trace_key(p) for p in key_preps))
+        fn = self._traces.get(tkey)
+        if fn is None:
+            orders = self.orders
+
+            def run(cols, aux, nrows):
+                live = jnp.arange(capacity, dtype=jnp.int32) < nrows
+                operands = [(~live).astype(jnp.int32)]  # padding last
+                for o, preps in zip(orders, key_preps):
+                    ctx = EvalCtx(cols, aux, nrows, capacity)
+                    ctx._prep_iter = iter(preps)
+                    kv = _walk_eval(o.expr, ctx)
+                    operands.extend(_directional(kv.data, kv.validity, o.ascending,
+                                                 o.resolved_nulls_first(), capacity))
+                payload = jnp.arange(capacity, dtype=jnp.int32)
+                res = jax.lax.sort(operands + [payload], num_keys=len(operands))
+                perm = res[-1]
+                return [(d[perm], v[perm]) for d, v in cols]
+
+            fn = jax.jit(run)
+            self._traces[tkey] = fn
+
+        outs = fn(cols, aux, table.nrows_dev)
+        new_cols = [c.with_arrays(d, v) for c, (d, v) in zip(table.columns, outs)]
+        return DeviceTable(table.names, new_cols, table.nrows_dev, capacity)
+
+    def describe(self):
+        return f"TpuSort[{len(self.orders)} keys]"

@@ -1,0 +1,173 @@
+"""PySpark-style function namespace (the user-facing expression builders)."""
+
+from __future__ import annotations
+
+from spark_rapids_tpu.ops.expr import col, lit, Expression  # noqa: F401
+from spark_rapids_tpu.ops import aggregates as _agg
+from spark_rapids_tpu.ops import conditional as _cond
+from spark_rapids_tpu.ops import math as _math
+from spark_rapids_tpu.ops import predicates as _pred
+
+
+def _e(x) -> Expression:
+    return x if isinstance(x, Expression) else col(x) if isinstance(x, str) else lit(x)
+
+
+# aggregates
+def sum(e):  # noqa: A001
+    return _agg.Sum(_e(e))
+
+
+def min(e):  # noqa: A001
+    return _agg.Min(_e(e))
+
+
+def max(e):  # noqa: A001
+    return _agg.Max(_e(e))
+
+
+def count(e="*"):
+    if e == "*" or e == 1:
+        return _agg.Count()
+    return _agg.Count(_e(e))
+
+
+def avg(e):
+    return _agg.Average(_e(e))
+
+
+mean = avg
+
+
+def first(e, ignore_nulls=False):
+    return _agg.First(_e(e), ignore_nulls)
+
+
+def last(e, ignore_nulls=False):
+    return _agg.Last(_e(e), ignore_nulls)
+
+
+def stddev(e):
+    return _agg.StddevSamp(_e(e))
+
+
+def stddev_pop(e):
+    return _agg.StddevPop(_e(e))
+
+
+def variance(e):
+    return _agg.VarianceSamp(_e(e))
+
+
+def var_pop(e):
+    return _agg.VariancePop(_e(e))
+
+
+# conditionals
+def when(cond, value):
+    return WhenBuilder().when(cond, value)
+
+
+class WhenBuilder:
+    def __init__(self):
+        self._branches = []
+
+    def when(self, cond, value):
+        self._branches.extend([_e(cond), _e(value)])
+        return self
+
+    def otherwise(self, value):
+        return _cond.CaseWhen(*self._branches, _e(value))
+
+    def end(self):
+        return _cond.CaseWhen(*self._branches)
+
+
+def coalesce(*exprs):
+    return _cond.Coalesce(*[_e(e) for e in exprs])
+
+
+def greatest(*exprs):
+    return _cond.Greatest(*[_e(e) for e in exprs])
+
+
+def least(*exprs):
+    return _cond.Least(*[_e(e) for e in exprs])
+
+
+def nanvl(a, b):
+    return _cond.NaNvl(_e(a), _e(b))
+
+
+def if_(cond, a, b):
+    return _cond.If(_e(cond), _e(a), _e(b))
+
+
+def isnull(e):
+    return _pred.IsNull(_e(e))
+
+
+def isnan(e):
+    return _pred.IsNaN(_e(e))
+
+
+def is_in(e, *items):
+    return _pred.In(_e(e), [_e(i) for i in items])
+
+
+# math
+def sqrt(e):
+    return _math.Sqrt(_e(e))
+
+
+def exp(e):
+    return _math.Exp(_e(e))
+
+
+def log(e):
+    return _math.Log(_e(e))
+
+
+def log10(e):
+    return _math.Log10(_e(e))
+
+
+def log2(e):
+    return _math.Log2(_e(e))
+
+
+def pow(a, b):  # noqa: A001
+    return _math.Pow(_e(a), _e(b))
+
+
+def abs(e):  # noqa: A001
+    from spark_rapids_tpu.ops.arithmetic import Abs
+    return Abs(_e(e))
+
+
+def ceil(e):
+    return _math.Ceil(_e(e))
+
+
+def floor(e):
+    return _math.Floor(_e(e))
+
+
+def round(e, scale=0):  # noqa: A001
+    return _math.Round(_e(e), lit(scale))
+
+
+def bround(e, scale=0):
+    return _math.BRound(_e(e), lit(scale))
+
+
+def signum(e):
+    return _math.Signum(_e(e))
+
+
+def shiftleft(e, n):
+    return _math.ShiftLeft(_e(e), _e(n))
+
+
+def shiftright(e, n):
+    return _math.ShiftRight(_e(e), _e(n))

@@ -1,0 +1,7 @@
+"""Flagship workloads (the reference's benchmark targets: TPC-H/TPC-DS-style
+query pipelines, ScaleTest queries, mortgage ETL — SURVEY.md §6).
+
+These are the "models" of a SQL engine: end-to-end query pipelines used for
+benchmarking, the driver's compile checks, and multi-chip dry runs."""
+
+from spark_rapids_tpu.models.tpch import lineitem_table, q1_dataframe, q1_kernel  # noqa: F401

@@ -1,0 +1,145 @@
+"""TPC-H-style flagship pipeline (q1: scan -> filter -> project -> group-by
+aggregate) — the reference's headline workload shape (pricing summary
+report). Used by bench.py and __graft_entry__.py.
+
+Two forms:
+* ``q1_dataframe``  — through the full engine (plan -> overrides -> execs);
+* ``q1_kernel``     — the same computation as one explicit jittable XLA
+  program (filter mask + segment reduction), the distilled hot path."""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar import HostColumn, HostTable
+
+
+RETURNFLAGS = np.array(["A", "N", "R"], dtype=object)
+LINESTATUS = np.array(["F", "O"], dtype=object)
+Q1_CUTOFF_DAYS = 10471  # 1998-09-02 as days since epoch
+
+
+def lineitem_table(num_rows: int, seed: int = 0) -> HostTable:
+    """Deterministic lineitem-ish generator (datagen analog)."""
+    rng = np.random.default_rng(seed)
+    qty = rng.integers(1, 51, size=num_rows).astype(np.float64)
+    price = (rng.random(num_rows) * 100000.0).round(2)
+    disc = (rng.integers(0, 11, size=num_rows) / 100.0)
+    tax = (rng.integers(0, 9, size=num_rows) / 100.0)
+    rf = RETURNFLAGS[rng.integers(0, 3, size=num_rows)]
+    ls = LINESTATUS[rng.integers(0, 2, size=num_rows)]
+    ship = rng.integers(8766, 10957, size=num_rows).astype(np.int32)  # 1994..1999
+    cols = {
+        "l_quantity": HostColumn(T.DOUBLE, qty),
+        "l_extendedprice": HostColumn(T.DOUBLE, price),
+        "l_discount": HostColumn(T.DOUBLE, disc),
+        "l_tax": HostColumn(T.DOUBLE, tax),
+        "l_returnflag": HostColumn(T.STRING, rf),
+        "l_linestatus": HostColumn(T.STRING, ls),
+        "l_shipdate": HostColumn(T.DATE, ship),
+    }
+    return HostTable(list(cols.keys()), list(cols.values()))
+
+
+def q1_dataframe(session, table: HostTable, num_batches: int = 1):
+    """TPC-H q1 through the engine (reference:
+    integration_tests qa_nightly-style SQL; the scan->filter->agg slice of
+    SURVEY.md §7 phase 2)."""
+    from spark_rapids_tpu import functions as F
+    from spark_rapids_tpu.ops.expr import col, lit
+    from spark_rapids_tpu.plan import from_host_table
+
+    df = from_host_table(table, session, num_batches)
+    return (
+        df.filter(col("l_shipdate") <= lit(Q1_CUTOFF_DAYS, T.DATE))
+        .select(
+            col("l_returnflag"), col("l_linestatus"), col("l_quantity"),
+            col("l_extendedprice"), col("l_discount"),
+            (col("l_extendedprice") * (lit(1.0) - col("l_discount"))).alias("disc_price"),
+            (col("l_extendedprice") * (lit(1.0) - col("l_discount"))
+             * (lit(1.0) + col("l_tax"))).alias("charge"),
+        )
+        .group_by("l_returnflag", "l_linestatus")
+        .agg(
+            F.sum(F.col("l_quantity")).alias("sum_qty"),
+            F.sum(F.col("l_extendedprice")).alias("sum_base_price"),
+            F.sum(F.col("disc_price")).alias("sum_disc_price"),
+            F.sum(F.col("charge")).alias("sum_charge"),
+            F.avg(F.col("l_quantity")).alias("avg_qty"),
+            F.avg(F.col("l_extendedprice")).alias("avg_price"),
+            F.avg(F.col("l_discount")).alias("avg_disc"),
+            F.count().alias("count_order"),
+        )
+        .sort("l_returnflag", "l_linestatus")
+    )
+
+
+NUM_Q1_GROUPS = 8  # 3 flags x 2 statuses padded to a static bound
+
+
+def q1_kernel(qty, price, disc, tax, flag_code, status_code, shipdate, nrows):
+    """The distilled q1 device program: one fused XLA computation.
+
+    Group keys ride as small dictionary codes (the engine's string strategy)
+    so gid = flag*2 + status is a direct index — segment reductions with a
+    static group bound, no sort needed for low-cardinality keys (the engine's
+    sort-segment aggregate generalizes to arbitrary keys)."""
+    n = qty.shape[0]
+    live = jnp.arange(n, dtype=jnp.int32) < nrows
+    keep = live & (shipdate <= Q1_CUTOFF_DAYS)
+    gid = flag_code * 2 + status_code
+    w = keep.astype(jnp.float64)
+    disc_price = price * (1.0 - disc)
+    charge = disc_price * (1.0 + tax)
+
+    def seg(v):
+        return jax.ops.segment_sum(v * w, gid, num_segments=NUM_Q1_GROUPS)
+
+    cnt = jax.ops.segment_sum(keep.astype(jnp.int64), gid, num_segments=NUM_Q1_GROUPS)
+    sum_qty = seg(qty)
+    sum_price = seg(price)
+    sum_disc_price = seg(disc_price)
+    sum_charge = seg(charge)
+    sum_disc = seg(disc)
+    denom = jnp.maximum(cnt, 1).astype(jnp.float64)
+    return (sum_qty, sum_price, sum_disc_price, sum_charge,
+            sum_qty / denom, sum_price / denom, sum_disc / denom, cnt)
+
+
+def q1_kernel_example_args(num_rows: int = 1 << 16, seed: int = 0):
+    table = lineitem_table(num_rows, seed)
+    rf = np.searchsorted(np.sort(RETURNFLAGS.astype(str)), table.column("l_returnflag").data.astype(str))
+    ls = np.searchsorted(np.sort(LINESTATUS.astype(str)), table.column("l_linestatus").data.astype(str))
+    return (
+        jnp.asarray(table.column("l_quantity").data),
+        jnp.asarray(table.column("l_extendedprice").data),
+        jnp.asarray(table.column("l_discount").data),
+        jnp.asarray(table.column("l_tax").data),
+        jnp.asarray(rf.astype(np.int32)),
+        jnp.asarray(ls.astype(np.int32)),
+        jnp.asarray(table.column("l_shipdate").data),
+        jnp.asarray(np.int32(num_rows)),
+    )
+
+
+def q1_pandas(table: HostTable):
+    """CPU baseline via pandas (the "Spark CPU" proxy for bench.py)."""
+    df = table.to_pandas()
+    df = df[df.l_shipdate <= Q1_CUTOFF_DAYS].copy()
+    df["disc_price"] = df.l_extendedprice * (1.0 - df.l_discount)
+    df["charge"] = df.disc_price * (1.0 + df.l_tax)
+    g = df.groupby(["l_returnflag", "l_linestatus"], sort=True)
+    out = g.agg(
+        sum_qty=("l_quantity", "sum"),
+        sum_base_price=("l_extendedprice", "sum"),
+        sum_disc_price=("disc_price", "sum"),
+        sum_charge=("charge", "sum"),
+        avg_qty=("l_quantity", "mean"),
+        avg_price=("l_extendedprice", "mean"),
+        avg_disc=("l_discount", "mean"),
+        count_order=("l_quantity", "size"),
+    ).reset_index()
+    return out

@@ -1,0 +1,30 @@
+"""Expression IR + kernels (reference: the 221 GpuOverrides.expr rules and
+their Gpu* implementations, SURVEY.md §2.3/Appendix A).
+
+Every expression implements three coordinated evaluation paths:
+
+* ``eval_cpu``  — Spark-exact semantics over HostColumns (numpy). This is the
+  CPU fallback substrate AND the test oracle (the reference compares against
+  CPU Spark; we compare against this path).
+* ``prep``      — a host-side pass over a DeviceTable that mirrors the
+  string-dictionary dataflow: computes each node's output dictionary and
+  emits per-batch auxiliary device inputs (dictionary remaps, per-entry
+  hashes/lengths, literal codes). Aux arrays are padded to buckets so
+  compiled programs are reused across batches.
+* ``eval_dev``  — traced JAX evaluation; the whole tree is fused into a
+  single jitted XLA computation per (schema, expr, bucket) by the compile
+  cache (the cuDF-AST analog: SURVEY.md §2.9 ast.*).
+"""
+
+from spark_rapids_tpu.ops.expr import (  # noqa: F401
+    Expression,
+    BoundReference,
+    Literal,
+    Alias,
+    AttributeReference,
+    col,
+    lit,
+    bind,
+    evaluate_cpu,
+    compile_project,
+)

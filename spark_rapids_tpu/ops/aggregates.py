@@ -1,0 +1,143 @@
+"""Aggregate function expressions (reference: aggregateFunctions.scala,
+GpuOverrides rules Sum Min Max Count Average First Last CollectList
+CollectSet StddevPop StddevSamp VariancePop VarianceSamp PivotFirst ... —
+SURVEY.md §2.3 / Appendix A).
+
+These are declarations: row-wise eval is meaningless; the Aggregate plan
+node (CPU path) and TpuHashAggregateExec (device path) interpret them.
+
+Spark result-type rules implemented: sum(integral) -> LONG, sum(float/
+double) -> DOUBLE, avg -> DOUBLE, count -> LONG (never null), min/max keep
+the input type."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.ops.expr import Expression
+
+
+class AggregateFunction(Expression):
+    """Base; child is the aggregated value expression (row-wise)."""
+
+    def __init__(self, child: Optional[Expression] = None):
+        self.children = (child,) if child is not None else ()
+
+    @property
+    def child(self):
+        return self.children[0] if self.children else None
+
+    def with_children(self, children):
+        return type(self)(children[0]) if children else type(self)()
+
+    @property
+    def nullable(self):
+        return True
+
+
+class Sum(AggregateFunction):
+    @property
+    def data_type(self):
+        ct = self.child.data_type
+        if isinstance(ct, T.IntegralType):
+            return T.LONG
+        if isinstance(ct, (T.FloatType, T.DoubleType)):
+            return T.DOUBLE
+        if isinstance(ct, T.DecimalType):
+            return T.DecimalType(min(ct.precision + 10, T.DecimalType.MAX_PRECISION), ct.scale)
+        raise TypeError(f"sum of {ct}")
+
+
+class Min(AggregateFunction):
+    @property
+    def data_type(self):
+        return self.child.data_type
+
+
+class Max(AggregateFunction):
+    @property
+    def data_type(self):
+        return self.child.data_type
+
+
+class Count(AggregateFunction):
+    """count(expr); Count() with no child is COUNT(*)."""
+
+    @property
+    def data_type(self):
+        return T.LONG
+
+    @property
+    def nullable(self):
+        return False
+
+    def key(self):
+        return ("count", tuple(c.key() for c in self.children))
+
+
+class Average(AggregateFunction):
+    @property
+    def data_type(self):
+        return T.DOUBLE
+
+
+class First(AggregateFunction):
+    def __init__(self, child=None, ignore_nulls: bool = False):
+        super().__init__(child)
+        self.ignore_nulls = ignore_nulls
+
+    def with_children(self, children):
+        return First(children[0], self.ignore_nulls)
+
+    def key(self):
+        return ("first", self.ignore_nulls, tuple(c.key() for c in self.children))
+
+    @property
+    def data_type(self):
+        return self.child.data_type
+
+
+class Last(AggregateFunction):
+    def __init__(self, child=None, ignore_nulls: bool = False):
+        super().__init__(child)
+        self.ignore_nulls = ignore_nulls
+
+    def with_children(self, children):
+        return Last(children[0], self.ignore_nulls)
+
+    def key(self):
+        return ("last", self.ignore_nulls, tuple(c.key() for c in self.children))
+
+    @property
+    def data_type(self):
+        return self.child.data_type
+
+
+class _CentralMoment(AggregateFunction):
+    @property
+    def data_type(self):
+        return T.DOUBLE
+
+
+class StddevPop(_CentralMoment):
+    pass
+
+
+class StddevSamp(_CentralMoment):
+    pass
+
+
+class VariancePop(_CentralMoment):
+    pass
+
+
+class VarianceSamp(_CentralMoment):
+    pass
+
+
+def is_aggregate(e: Expression) -> bool:
+    from spark_rapids_tpu.ops.expr import Alias
+    if isinstance(e, Alias):
+        return is_aggregate(e.children[0])
+    return isinstance(e, AggregateFunction)

@@ -1,0 +1,250 @@
+"""Arithmetic expressions with Spark (non-ANSI, Java) semantics.
+
+Reference rules: Add Subtract Multiply Divide IntegralDivide Remainder Pmod
+UnaryMinus UnaryPositive Abs (GpuOverrides + shim registry, SURVEY.md
+Appendix A). Spark-exact corners implemented here:
+
+* integer overflow wraps (two's complement, like Java);
+* Divide coerces to double and returns NULL on a zero divisor (Spark
+  deviates from IEEE here);
+* Remainder/Pmod use Java % (sign of the dividend) and NULL on zero;
+* IntegralDivide truncates toward zero and yields LongType.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar import HostColumn, HostTable
+from spark_rapids_tpu.ops.common import (
+    BinaryExpression,
+    UnaryExpression,
+    coerce_numeric_pair,
+    null_and,
+)
+from spark_rapids_tpu.ops.expr import DevVal
+
+
+class BinaryArithmetic(BinaryExpression):
+    @property
+    def data_type(self):
+        return self.left.data_type
+
+    def resolve(self, bound):
+        left, right, _ = coerce_numeric_pair(*bound)
+        return type(self)(left, right)
+
+    def _cpu_op(self, ld, rd):
+        raise NotImplementedError
+
+    def _dev_op(self, ld, rd):
+        raise NotImplementedError
+
+    def eval_cpu(self, table: HostTable) -> HostColumn:
+        l = self.left.eval_cpu(table)
+        r = self.right.eval_cpu(table)
+        with np.errstate(over="ignore", invalid="ignore", divide="ignore"):
+            data = self._cpu_op(l.data, r.data)
+        validity = l.validity & r.validity
+        zero = np.zeros((), dtype=data.dtype).item()
+        return HostColumn(self.data_type, np.where(validity, data, zero).astype(data.dtype), validity)
+
+    def eval_dev(self, ctx, child_vals, prep):
+        lval, rval = child_vals
+        validity = null_and(lval.validity, rval.validity)
+        data = self._dev_op(lval.data, rval.data)
+        return DevVal(jnp.where(validity, data, jnp.zeros_like(data)), validity)
+
+
+class Add(BinaryArithmetic):
+    def _cpu_op(self, ld, rd):
+        return ld + rd
+
+    def _dev_op(self, ld, rd):
+        return ld + rd
+
+
+class Subtract(BinaryArithmetic):
+    def _cpu_op(self, ld, rd):
+        return ld - rd
+
+    def _dev_op(self, ld, rd):
+        return ld - rd
+
+
+class Multiply(BinaryArithmetic):
+    def _cpu_op(self, ld, rd):
+        return ld * rd
+
+    def _dev_op(self, ld, rd):
+        return ld * rd
+
+
+class Divide(BinaryArithmetic):
+    """Double division; NULL on zero divisor (Spark non-ANSI)."""
+
+    @property
+    def data_type(self):
+        return T.DOUBLE
+
+    def resolve(self, bound):
+        from spark_rapids_tpu.ops.cast import Cast
+        left, right = bound
+        if left.data_type != T.DOUBLE:
+            left = Cast(left, T.DOUBLE)
+        if right.data_type != T.DOUBLE:
+            right = Cast(right, T.DOUBLE)
+        return Divide(left, right)
+
+    def eval_cpu(self, table):
+        l = self.left.eval_cpu(table)
+        r = self.right.eval_cpu(table)
+        validity = l.validity & r.validity & (r.data != 0.0)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            data = np.where(validity, l.data / np.where(r.data != 0.0, r.data, 1.0), 0.0)
+        return HostColumn(T.DOUBLE, data, validity)
+
+    def eval_dev(self, ctx, child_vals, prep):
+        lval, rval = child_vals
+        validity = lval.validity & rval.validity & (rval.data != 0.0)
+        safe = jnp.where(rval.data != 0.0, rval.data, 1.0)
+        return DevVal(jnp.where(validity, lval.data / safe, 0.0), validity)
+
+
+def _trunc_div_int(a, b, xp):
+    """C/Java truncation division on integers given a floor-dividing xp."""
+    q = xp.floor_divide(a, xp.where(b != 0, b, 1))
+    r = a - q * xp.where(b != 0, b, 1)
+    adjust = (r != 0) & ((a < 0) != (b < 0))
+    return q + adjust.astype(q.dtype)
+
+
+class IntegralDivide(BinaryArithmetic):
+    """`div` operator: operands cast to long, truncating division, NULL on
+    zero divisor."""
+
+    @property
+    def data_type(self):
+        return T.LONG
+
+    def resolve(self, bound):
+        from spark_rapids_tpu.ops.cast import Cast
+        left, right = bound
+        if left.data_type != T.LONG:
+            left = Cast(left, T.LONG)
+        if right.data_type != T.LONG:
+            right = Cast(right, T.LONG)
+        return IntegralDivide(left, right)
+
+    def eval_cpu(self, table):
+        l = self.left.eval_cpu(table)
+        r = self.right.eval_cpu(table)
+        validity = l.validity & r.validity & (r.data != 0)
+        with np.errstate(over="ignore"):
+            data = _trunc_div_int(l.data, r.data, np)
+        return HostColumn(T.LONG, np.where(validity, data, 0), validity)
+
+    def eval_dev(self, ctx, child_vals, prep):
+        lval, rval = child_vals
+        validity = lval.validity & rval.validity & (rval.data != 0)
+        data = _trunc_div_int(lval.data, rval.data, jnp)
+        return DevVal(jnp.where(validity, data, 0), validity)
+
+
+def _java_mod(a, b, xp):
+    """Java % — sign of the dividend. fmod matches for both ints and floats."""
+    if xp is np:
+        with np.errstate(invalid="ignore", divide="ignore"):
+            return np.fmod(a, np.where(b != 0, b, 1))
+    return jnp.fmod(a, jnp.where(b != 0, b, 1))
+
+
+class Remainder(BinaryArithmetic):
+    """% with Java semantics (sign of dividend), NULL on zero divisor."""
+
+    def eval_cpu(self, table):
+        l = self.left.eval_cpu(table)
+        r = self.right.eval_cpu(table)
+        validity = l.validity & r.validity & (r.data != 0)
+        data = _java_mod(l.data, r.data, np)
+        zero = np.zeros((), dtype=l.data.dtype).item()
+        return HostColumn(self.data_type, np.where(validity, data, zero).astype(l.data.dtype), validity)
+
+    def eval_dev(self, ctx, child_vals, prep):
+        lval, rval = child_vals
+        validity = lval.validity & rval.validity & (rval.data != 0)
+        data = _java_mod(lval.data, rval.data, jnp)
+        return DevVal(jnp.where(validity, data, jnp.zeros_like(data)), validity)
+
+
+class Pmod(BinaryArithmetic):
+    """Positive modulus: ((a % b) + b) % b with Java %, NULL on zero."""
+
+    def eval_cpu(self, table):
+        l = self.left.eval_cpu(table)
+        r = self.right.eval_cpu(table)
+        validity = l.validity & r.validity & (r.data != 0)
+        safe = np.where(r.data != 0, r.data, 1)
+        with np.errstate(over="ignore", invalid="ignore", divide="ignore"):
+            m = np.fmod(l.data, safe)
+            data = np.fmod(m + safe, safe)
+        zero = np.zeros((), dtype=l.data.dtype).item()
+        return HostColumn(self.data_type, np.where(validity, data, zero).astype(l.data.dtype), validity)
+
+    def eval_dev(self, ctx, child_vals, prep):
+        lval, rval = child_vals
+        validity = lval.validity & rval.validity & (rval.data != 0)
+        safe = jnp.where(rval.data != 0, rval.data, jnp.ones_like(rval.data))
+        m = jnp.fmod(lval.data, safe)
+        data = jnp.fmod(m + safe, safe)
+        return DevVal(jnp.where(validity, data, jnp.zeros_like(data)), validity)
+
+
+class UnaryMinus(UnaryExpression):
+    @property
+    def data_type(self):
+        return self.child.data_type
+
+    def eval_cpu(self, table):
+        c = self.child.eval_cpu(table)
+        with np.errstate(over="ignore"):
+            data = -c.data
+        zero = np.zeros((), dtype=c.data.dtype).item()
+        return HostColumn(self.data_type, np.where(c.validity, data, zero).astype(c.data.dtype), c.validity.copy())
+
+    def eval_dev(self, ctx, child_vals, prep):
+        (c,) = child_vals
+        return DevVal(jnp.where(c.validity, -c.data, jnp.zeros_like(c.data)), c.validity)
+
+
+class UnaryPositive(UnaryExpression):
+    @property
+    def data_type(self):
+        return self.child.data_type
+
+    def eval_cpu(self, table):
+        return self.child.eval_cpu(table)
+
+    def eval_dev(self, ctx, child_vals, prep):
+        return child_vals[0]
+
+
+class Abs(UnaryExpression):
+    """Java Math.abs: wraps at integer MIN_VALUE (non-ANSI)."""
+
+    @property
+    def data_type(self):
+        return self.child.data_type
+
+    def eval_cpu(self, table):
+        c = self.child.eval_cpu(table)
+        with np.errstate(over="ignore"):
+            data = np.abs(c.data)
+        zero = np.zeros((), dtype=c.data.dtype).item()
+        return HostColumn(self.data_type, np.where(c.validity, data, zero).astype(c.data.dtype), c.validity.copy())
+
+    def eval_dev(self, ctx, child_vals, prep):
+        (c,) = child_vals
+        return DevVal(jnp.where(c.validity, jnp.abs(c.data), jnp.zeros_like(c.data)), c.validity)

@@ -1,0 +1,145 @@
+"""Cast (reference: GpuCast.scala, 1,809 LoC + JNI CastStrings; SURVEY.md
+§2.3/§2.9). This round covers the numeric/boolean/temporal core with Java
+narrowing semantics; string<->numeric and string<->temporal casts follow the
+reference's staged approach (some off by default) and are added as they gain
+CPU-exact implementations.
+
+Java narrowing rules implemented:
+* int -> smaller int: wrap (low bits);
+* float/double -> integral: truncate toward zero, saturate at MIN/MAX,
+  NaN -> 0;
+* numeric -> boolean: v != 0; boolean -> numeric: 1/0;
+* date -> timestamp: midnight UTC micros; timestamp -> date: floor to day.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar import HostColumn, HostTable
+from spark_rapids_tpu.ops.common import UnaryExpression
+from spark_rapids_tpu.ops.expr import DevVal, Expression, NodePrep
+
+_INT_BOUNDS = {
+    np.dtype(np.int8): (-(1 << 7), (1 << 7) - 1),
+    np.dtype(np.int16): (-(1 << 15), (1 << 15) - 1),
+    np.dtype(np.int32): (-(1 << 31), (1 << 31) - 1),
+    np.dtype(np.int64): (-(1 << 63), (1 << 63) - 1),
+}
+
+MICROS_PER_DAY = 86_400_000_000
+
+
+def _cast_data_np(data: np.ndarray, src: T.DataType, dst: T.DataType) -> np.ndarray:
+    sd, dd = src.np_dtype, dst.np_dtype
+    if isinstance(dst, T.BooleanType):
+        return data != 0
+    if isinstance(src, T.BooleanType):
+        return data.astype(dd)
+    if isinstance(src, (T.FloatType, T.DoubleType)) and isinstance(dst, T.IntegralType):
+        lo, hi = _INT_BOUNDS[dd]
+        with np.errstate(invalid="ignore"):
+            t = np.trunc(data)
+            t = np.where(np.isnan(data), 0.0, t)
+            t = np.clip(t, float(lo), float(hi))
+        # float64 cannot represent 2^63-1 exactly; rely on clip + cast with
+        # saturation applied before conversion.
+        out = np.empty(data.shape, dtype=dd)
+        big = t >= float(hi)
+        small = t <= float(lo)
+        mid = ~(big | small)
+        out[big] = hi
+        out[small] = lo
+        out[mid] = t[mid].astype(dd)
+        return out
+    if isinstance(src, T.DateType) and isinstance(dst, T.TimestampType):
+        return data.astype(np.int64) * MICROS_PER_DAY
+    if isinstance(src, T.TimestampType) and isinstance(dst, T.DateType):
+        return np.floor_divide(data, MICROS_PER_DAY).astype(np.int32)
+    with np.errstate(over="ignore", invalid="ignore"):
+        return data.astype(dd)
+
+
+def _cast_data_jnp(data, src: T.DataType, dst: T.DataType):
+    dd = dst.np_dtype
+    if isinstance(dst, T.BooleanType):
+        return data != 0
+    if isinstance(src, T.BooleanType):
+        return data.astype(dd)
+    if isinstance(src, (T.FloatType, T.DoubleType)) and isinstance(dst, T.IntegralType):
+        lo, hi = _INT_BOUNDS[np.dtype(dd)]
+        t = jnp.trunc(data)
+        t = jnp.where(jnp.isnan(data), 0.0, t)
+        t = jnp.clip(t, float(lo), float(hi))
+        out = t.astype(dd)
+        out = jnp.where(t >= float(hi), hi, out)
+        out = jnp.where(t <= float(lo), lo, out)
+        return out
+    if isinstance(src, T.DateType) and isinstance(dst, T.TimestampType):
+        return data.astype(jnp.int64) * MICROS_PER_DAY
+    if isinstance(src, T.TimestampType) and isinstance(dst, T.DateType):
+        return jnp.floor_divide(data, MICROS_PER_DAY).astype(jnp.int32)
+    return data.astype(dd)
+
+
+_SUPPORTED_SIMPLE = (T.BooleanType, T.ByteType, T.ShortType, T.IntegerType,
+                     T.LongType, T.FloatType, T.DoubleType, T.DateType,
+                     T.TimestampType)
+
+
+def cast_supported(src: T.DataType, dst: T.DataType) -> bool:
+    if src == dst:
+        return True
+    if isinstance(src, _SUPPORTED_SIMPLE) and isinstance(dst, _SUPPORTED_SIMPLE):
+        # temporal <-> non-temporal numeric casts not yet implemented except
+        # the date/timestamp pair handled above.
+        temporal = (T.DateType, T.TimestampType)
+        s_t, d_t = isinstance(src, temporal), isinstance(dst, temporal)
+        if s_t != d_t:
+            return False
+        return True
+    return False
+
+
+class Cast(UnaryExpression):
+    def __init__(self, child: Expression, dtype: T.DataType):
+        super().__init__(child)
+        self._dtype = dtype
+
+    @property
+    def data_type(self):
+        return self._dtype
+
+    def with_children(self, children):
+        return Cast(children[0], self._dtype)
+
+    def key(self):
+        return ("cast", str(self._dtype), self.children[0].key())
+
+    @property
+    def device_supported(self):
+        return cast_supported(self.child.data_type, self._dtype)
+
+    def eval_cpu(self, table: HostTable) -> HostColumn:
+        c = self.child.eval_cpu(table)
+        if c.dtype == self._dtype:
+            return c
+        data = _cast_data_np(c.data, c.dtype, self._dtype)
+        zero = np.zeros((), dtype=self._dtype.np_dtype).item()
+        return HostColumn(self._dtype, np.where(c.validity, data, zero).astype(self._dtype.np_dtype),
+                          c.validity.copy())
+
+    def prep(self, pctx, child_preps):
+        return NodePrep()
+
+    def eval_dev(self, ctx, child_vals, prep):
+        (c,) = child_vals
+        if self.child.data_type == self._dtype:
+            return c
+        data = _cast_data_jnp(c.data, self.child.data_type, self._dtype)
+        return DevVal(jnp.where(c.validity, data, jnp.zeros_like(data)), c.validity)
+
+    def __repr__(self):
+        return f"cast({self.children[0]!r} as {self._dtype})"

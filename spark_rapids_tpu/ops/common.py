@@ -1,0 +1,133 @@
+"""Shared expression machinery: unary/binary bases, null propagation,
+numeric coercion, and string-dictionary alignment for comparisons."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.ops.expr import (
+    DevVal,
+    EvalCtx,
+    Expression,
+    NodePrep,
+    PrepCtx,
+)
+
+
+class UnaryExpression(Expression):
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    @property
+    def child(self) -> Expression:
+        return self.children[0]
+
+    def with_children(self, children):
+        return type(self)(children[0])
+
+
+class BinaryExpression(Expression):
+    def __init__(self, left: Expression, right: Expression):
+        self.children = (left, right)
+
+    @property
+    def left(self):
+        return self.children[0]
+
+    @property
+    def right(self):
+        return self.children[1]
+
+    def with_children(self, children):
+        return type(self)(children[0], children[1])
+
+
+def coerce_numeric_pair(left: Expression, right: Expression) -> Tuple[Expression, Expression, T.DataType]:
+    """Insert casts so both sides share the promoted numeric type (Spark
+    TypeCoercion tightest-common-type subset)."""
+    from spark_rapids_tpu.ops.cast import Cast
+
+    lt, rt = left.data_type, right.data_type
+    out = T.promote(lt, rt)
+    if lt != out:
+        left = Cast(left, out)
+    if rt != out:
+        right = Cast(right, out)
+    return left, right, out
+
+
+def null_and(*validities):
+    """Combined validity: all inputs valid (default null propagation)."""
+    out = validities[0]
+    for v in validities[1:]:
+        out = out & v
+    return out
+
+
+def cpu_null_and(*validities):
+    out = validities[0]
+    for v in validities[1:]:
+        out = out & v
+    return out
+
+
+# ---------------------------------------------------------------------------
+# String dictionary alignment
+# ---------------------------------------------------------------------------
+
+def align_string_dicts(pctx: PrepCtx, left_prep: NodePrep, right_prep: NodePrep) -> NodePrep:
+    """Host-side: merge the two child dictionaries into one sorted-unique
+    dictionary and register per-child remap tables as aux inputs. On device,
+    remap[codes] yields codes into the merged dictionary, so ordinary integer
+    comparisons implement Spark UTF-8 byte-order string comparisons.
+
+    Returns a NodePrep whose aux_slots are (left_remap, right_remap) and
+    whose out_dict is the merged dictionary (for operators like If/Coalesce
+    that produce strings)."""
+    ld = left_prep.out_dict
+    rd = right_prep.out_dict
+    if ld is None or rd is None:
+        raise ValueError("align_string_dicts on non-string children")
+    merged = np.unique(np.concatenate([ld.astype(object), rd.astype(object)]))
+    lmap = np.searchsorted(merged, ld).astype(np.int32)
+    rmap = np.searchsorted(merged, rd).astype(np.int32)
+    ls = pctx.add_aux(lmap)
+    rs = pctx.add_aux(rmap)
+    return NodePrep(out_dict=merged, dict_sorted=True, aux_slots=(ls, rs))
+
+
+def dev_aligned_codes(ctx: EvalCtx, prep: NodePrep, lval: DevVal, rval: DevVal):
+    """Traced-side companion of align_string_dicts: gather through the remap
+    tables. Codes are clipped into the padded remap range so garbage codes in
+    invalid rows cannot fault the gather."""
+    lmap = ctx.aux[prep.aux_slots[0]]
+    rmap = ctx.aux[prep.aux_slots[1]]
+    lcap = lmap.shape[0] - 1
+    rcap = rmap.shape[0] - 1
+    lc = lmap[jnp.clip(lval.data, 0, lcap)]
+    rc = rmap[jnp.clip(rval.data, 0, rcap)]
+    return lc, rc
+
+
+def align_string_dicts_many(pctx: PrepCtx, preps: Sequence[NodePrep]) -> NodePrep:
+    """N-ary version of align_string_dicts: one merged dictionary, one remap
+    aux slot per child (in order)."""
+    dicts = [p.out_dict for p in preps]
+    if any(d is None for d in dicts):
+        raise ValueError("align_string_dicts_many on non-string child")
+    merged = np.unique(np.concatenate([d.astype(object) for d in dicts]))
+    slots = tuple(pctx.add_aux(np.searchsorted(merged, d).astype(np.int32)) for d in dicts)
+    return NodePrep(out_dict=merged, dict_sorted=True, aux_slots=slots)
+
+
+def dev_remap_codes(ctx: EvalCtx, slot: int, codes):
+    remap = ctx.aux[slot]
+    return remap[jnp.clip(codes, 0, remap.shape[0] - 1)]
+
+
+def is_string_pair(left: Expression, right: Expression) -> bool:
+    return isinstance(left.data_type, T.StringType) and isinstance(right.data_type, T.StringType)

@@ -1,0 +1,276 @@
+"""Conditional expressions (reference: If CaseWhen Coalesce Least Greatest
+NaNvl — conditionalExpressions.scala; SURVEY.md Appendix A).
+
+String results are handled by merging branch dictionaries host-side and
+remapping branch codes on device (see ops/common.py)."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar import HostColumn, HostTable
+from spark_rapids_tpu.ops.common import (
+    align_string_dicts_many,
+    dev_remap_codes,
+)
+from spark_rapids_tpu.ops.expr import DevVal, Expression, NodePrep
+
+
+def _is_string(e: Expression) -> bool:
+    return isinstance(e.data_type, T.StringType)
+
+
+class If(Expression):
+    def __init__(self, pred: Expression, if_true: Expression, if_false: Expression):
+        self.children = (pred, if_true, if_false)
+
+    @property
+    def data_type(self):
+        return self.children[1].data_type
+
+    def with_children(self, children):
+        return If(*children)
+
+    def eval_cpu(self, table: HostTable) -> HostColumn:
+        p = self.children[0].eval_cpu(table)
+        a = self.children[1].eval_cpu(table)
+        b = self.children[2].eval_cpu(table)
+        take_a = p.validity & p.data.astype(np.bool_)
+        data = np.where(take_a, a.data, b.data)
+        validity = np.where(take_a, a.validity, b.validity)
+        return HostColumn(self.data_type, data, validity)
+
+    def prep(self, pctx, child_preps):
+        if child_preps[1].out_dict is not None:
+            return align_string_dicts_many(pctx, child_preps[1:3])
+        return NodePrep()
+
+    def eval_dev(self, ctx, child_vals, prep):
+        p, a, b = child_vals
+        ad, bd = a.data, b.data
+        if prep.aux_slots:
+            ad = dev_remap_codes(ctx, prep.aux_slots[0], ad)
+            bd = dev_remap_codes(ctx, prep.aux_slots[1], bd)
+        take_a = p.validity & p.data
+        return DevVal(jnp.where(take_a, ad, bd), jnp.where(take_a, a.validity, b.validity))
+
+
+class CaseWhen(Expression):
+    """children = [cond0, val0, cond1, val1, ..., (else)]. An odd child count
+    means the last child is the else branch; otherwise else is NULL."""
+
+    def __init__(self, *children: Expression):
+        self.children = tuple(children)
+
+    @property
+    def has_else(self) -> bool:
+        return len(self.children) % 2 == 1
+
+    @property
+    def data_type(self):
+        return self.children[1].data_type
+
+    def with_children(self, children):
+        return CaseWhen(*children)
+
+    def _branches(self):
+        n = len(self.children) - (1 if self.has_else else 0)
+        return [(self.children[i], self.children[i + 1]) for i in range(0, n, 2)]
+
+    def _value_child_indices(self):
+        n = len(self.children) - (1 if self.has_else else 0)
+        idx = list(range(1, n, 2))
+        if self.has_else:
+            idx.append(len(self.children) - 1)
+        return idx
+
+    def eval_cpu(self, table):
+        n = table.num_rows
+        dtype = self.data_type
+        if isinstance(dtype, T.StringType):
+            data = np.full(n, "", dtype=object)
+        else:
+            data = np.zeros(n, dtype=dtype.np_dtype)
+        validity = np.zeros(n, dtype=np.bool_)
+        decided = np.zeros(n, dtype=np.bool_)
+        for cond, val in self._branches():
+            c = cond.eval_cpu(table)
+            v = val.eval_cpu(table)
+            take = ~decided & c.validity & c.data.astype(np.bool_)
+            data = np.where(take, v.data, data)
+            validity = np.where(take, v.validity, validity)
+            decided |= take
+        if self.has_else:
+            v = self.children[-1].eval_cpu(table)
+            data = np.where(~decided, v.data, data)
+            validity = np.where(~decided, v.validity, validity)
+        return HostColumn(dtype, data, validity)
+
+    def prep(self, pctx, child_preps):
+        vidx = self._value_child_indices()
+        if child_preps[vidx[0]].out_dict is not None:
+            return align_string_dicts_many(pctx, [child_preps[i] for i in vidx])
+        return NodePrep()
+
+    def eval_dev(self, ctx, child_vals, prep):
+        vidx = self._value_child_indices()
+        remapped = {}
+        if prep.aux_slots:
+            for slot, i in zip(prep.aux_slots, vidx):
+                remapped[i] = dev_remap_codes(ctx, slot, child_vals[i].data)
+        cap = ctx.capacity
+        dtype = self.data_type
+        data = jnp.zeros(cap, dtype=jnp.int32 if isinstance(dtype, T.StringType) else dtype.np_dtype)
+        validity = jnp.zeros(cap, dtype=jnp.bool_)
+        decided = jnp.zeros(cap, dtype=jnp.bool_)
+        n_branch = len(self.children) - (1 if self.has_else else 0)
+        for i in range(0, n_branch, 2):
+            c = child_vals[i]
+            v = child_vals[i + 1]
+            vd = remapped.get(i + 1, v.data)
+            take = ~decided & c.validity & c.data
+            data = jnp.where(take, vd, data)
+            validity = jnp.where(take, v.validity, validity)
+            decided = decided | take
+        if self.has_else:
+            i = len(self.children) - 1
+            v = child_vals[i]
+            vd = remapped.get(i, v.data)
+            data = jnp.where(decided, data, vd)
+            validity = jnp.where(decided, validity, v.validity)
+        return DevVal(data, validity)
+
+
+class Coalesce(Expression):
+    def __init__(self, *children: Expression):
+        self.children = tuple(children)
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type
+
+    def with_children(self, children):
+        return Coalesce(*children)
+
+    def eval_cpu(self, table):
+        cols = [c.eval_cpu(table) for c in self.children]
+        data = cols[0].data.copy()
+        validity = cols[0].validity.copy()
+        for c in cols[1:]:
+            take = ~validity & c.validity
+            data = np.where(take, c.data, data)
+            validity |= c.validity
+        return HostColumn(self.data_type, data, validity)
+
+    def prep(self, pctx, child_preps):
+        if child_preps[0].out_dict is not None:
+            return align_string_dicts_many(pctx, child_preps)
+        return NodePrep()
+
+    def eval_dev(self, ctx, child_vals, prep):
+        datas = [v.data for v in child_vals]
+        if prep.aux_slots:
+            datas = [dev_remap_codes(ctx, s, d) for s, d in zip(prep.aux_slots, datas)]
+        data = datas[0]
+        validity = child_vals[0].validity
+        for v, d in zip(child_vals[1:], datas[1:]):
+            take = ~validity & v.validity
+            data = jnp.where(take, d, data)
+            validity = validity | v.validity
+        return DevVal(data, validity)
+
+
+class _MinMaxN(Expression):
+    """Least/Greatest: skip nulls; null only when every input is null."""
+
+    _pick_cpu = None
+    _pick_dev = None
+
+    def __init__(self, *children: Expression):
+        self.children = tuple(children)
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type
+
+    def with_children(self, children):
+        return type(self)(*children)
+
+    def prep(self, pctx, child_preps):
+        if child_preps[0].out_dict is not None:
+            return align_string_dicts_many(pctx, child_preps)
+        return NodePrep()
+
+    def eval_cpu(self, table):
+        cols = [c.eval_cpu(table) for c in self.children]
+        string = isinstance(self.data_type, T.StringType)
+        data = cols[0].data.copy()
+        if string:
+            data = np.where(cols[0].validity, data, "")
+        validity = cols[0].validity.copy()
+        for c in cols[1:]:
+            cd = np.where(c.validity, c.data, "") if string else c.data
+            better = c.validity & (~validity | type(self)._pick_cpu(cd, data))
+            data = np.where(better, cd, data)
+            validity |= c.validity
+        if string:
+            data = data.astype(object)
+            out = np.empty(len(data), dtype=object)
+            out[:] = data
+            out[~validity] = None
+            data = out
+        return HostColumn(self.data_type, data, validity)
+
+    def eval_dev(self, ctx, child_vals, prep):
+        datas = [v.data for v in child_vals]
+        if prep.aux_slots:
+            datas = [dev_remap_codes(ctx, s, d) for s, d in zip(prep.aux_slots, datas)]
+        data = datas[0]
+        validity = child_vals[0].validity
+        for v, d in zip(child_vals[1:], datas[1:]):
+            better = v.validity & (~validity | type(self)._pick_dev(d, data))
+            data = jnp.where(better, d, data)
+            validity = validity | v.validity
+        return DevVal(jnp.where(validity, data, jnp.zeros_like(data)), validity)
+
+
+class Least(_MinMaxN):
+    _pick_cpu = staticmethod(lambda new, cur: new < cur)
+    _pick_dev = staticmethod(lambda new, cur: new < cur)
+
+
+class Greatest(_MinMaxN):
+    _pick_cpu = staticmethod(lambda new, cur: new > cur)
+    _pick_dev = staticmethod(lambda new, cur: new > cur)
+
+
+class NaNvl(Expression):
+    """NaNvl(a, b): a if a is not NaN else b (types already double/float)."""
+
+    def __init__(self, left: Expression, right: Expression):
+        self.children = (left, right)
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type
+
+    def with_children(self, children):
+        return NaNvl(*children)
+
+    def eval_cpu(self, table):
+        a = self.children[0].eval_cpu(table)
+        b = self.children[1].eval_cpu(table)
+        take_b = a.validity & np.isnan(a.data)
+        data = np.where(take_b, b.data, a.data)
+        validity = np.where(take_b, b.validity, a.validity)
+        return HostColumn(self.data_type, data, validity)
+
+    def eval_dev(self, ctx, child_vals, prep):
+        a, b = child_vals
+        take_b = a.validity & jnp.isnan(a.data)
+        return DevVal(jnp.where(take_b, b.data, a.data),
+                      jnp.where(take_b, b.validity, a.validity))

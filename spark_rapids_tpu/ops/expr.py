@@ -1,0 +1,515 @@
+"""Expression base classes and the device compilation machinery."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Iterator, List, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar import DeviceColumn, DeviceTable, HostColumn, HostTable, bucket_for
+from spark_rapids_tpu.errors import ColumnarProcessingError, UnsupportedOnTpu
+
+
+class DevVal(NamedTuple):
+    """A traced intermediate: data array + validity array (bool)."""
+
+    data: jax.Array
+    validity: jax.Array
+
+
+@dataclass
+class NodePrep:
+    """Host-side per-batch preparation result for one expression node."""
+
+    out_dict: Optional[np.ndarray] = None  # dictionary if output is STRING
+    dict_sorted: bool = True
+    aux_slots: Tuple[int, ...] = ()
+    extra: dict = field(default_factory=dict)
+
+
+class PrepCtx:
+    """Accumulates auxiliary device inputs during the host prep pass."""
+
+    def __init__(self, table: DeviceTable):
+        self.table = table
+        self.aux_arrays: List[np.ndarray] = []
+
+    def add_aux(self, arr: np.ndarray) -> int:
+        """Register a host array as a device input, padded to a bucket so
+        that compiled programs are shared across batches with different
+        dictionary sizes."""
+        n = len(arr)
+        cap = bucket_for(max(n, 1))
+        if cap != n:
+            padded = np.zeros(cap, dtype=arr.dtype)
+            padded[:n] = arr
+            arr = padded
+        self.aux_arrays.append(arr)
+        return len(self.aux_arrays) - 1
+
+
+class EvalCtx:
+    """Traced-side context handed to eval_dev."""
+
+    def __init__(self, cols: Sequence[DevVal], aux: Sequence[jax.Array],
+                 nrows: jax.Array, capacity: int):
+        self.cols = tuple(cols)
+        self.aux = tuple(aux)
+        self.nrows = nrows
+        self.capacity = capacity
+        self._prep_iter: Optional[Iterator[NodePrep]] = None
+
+    def next_prep(self) -> NodePrep:
+        return next(self._prep_iter)  # type: ignore[arg-type]
+
+    def row_mask(self) -> jax.Array:
+        return jnp.arange(self.capacity, dtype=jnp.int32) < self.nrows
+
+
+class Expression:
+    """Base expression. Subclasses set ``children`` and implement the three
+    evaluation paths. Expressions are immutable; ``with_children`` rebuilds."""
+
+    children: Tuple["Expression", ...] = ()
+
+    # --- static properties -------------------------------------------------
+    @property
+    def data_type(self) -> T.DataType:
+        raise NotImplementedError(type(self).__name__)
+
+    @property
+    def nullable(self) -> bool:
+        return any(c.nullable for c in self.children) if self.children else True
+
+    @property
+    def name(self) -> str:
+        return type(self).__name__
+
+    def with_children(self, children: Sequence["Expression"]) -> "Expression":
+        raise NotImplementedError(type(self).__name__)
+
+    def key(self) -> tuple:
+        """Structural key for the compile cache. Must capture everything
+        that changes the traced computation (not per-batch data)."""
+        return (self.name, tuple(c.key() for c in self.children))
+
+    def __repr__(self):
+        args = ", ".join(repr(c) for c in self.children)
+        return f"{self.name}({args})"
+
+    # --- binding -----------------------------------------------------------
+    def bind(self, schema: Sequence[Tuple[str, T.DataType]]) -> "Expression":
+        bound = [c.bind(schema) for c in self.children]
+        return self.resolve(bound)
+
+    def resolve(self, bound_children: Sequence["Expression"]) -> "Expression":
+        """Hook for type coercion: may insert casts or rewrite. Default:
+        rebuild with bound children."""
+        return self.with_children(bound_children)
+
+    # --- CPU path (Spark-exact oracle) ------------------------------------
+    def eval_cpu(self, table: HostTable) -> HostColumn:
+        raise NotImplementedError(f"{self.name}.eval_cpu")
+
+    # --- device path -------------------------------------------------------
+    def prep(self, pctx: PrepCtx, child_preps: Sequence[NodePrep]) -> NodePrep:
+        return NodePrep()
+
+    def eval_dev(self, ctx: EvalCtx, child_vals: Sequence[DevVal],
+                 prep: NodePrep) -> DevVal:
+        raise UnsupportedOnTpu(f"{self.name} has no device implementation")
+
+    #: False for expressions that only have a CPU path; the overrides layer
+    #: uses this to tag fallbacks.
+    device_supported: bool = True
+
+    # --- operator sugar for the DataFrame API ------------------------------
+    def _bin(self, opcls, other, reflect=False):
+        other = other if isinstance(other, Expression) else Literal.of(other)
+        return opcls(other, self) if reflect else opcls(self, other)
+
+    def __add__(self, o):
+        from spark_rapids_tpu.ops.arithmetic import Add
+        return self._bin(Add, o)
+
+    def __radd__(self, o):
+        from spark_rapids_tpu.ops.arithmetic import Add
+        return self._bin(Add, o, True)
+
+    def __sub__(self, o):
+        from spark_rapids_tpu.ops.arithmetic import Subtract
+        return self._bin(Subtract, o)
+
+    def __rsub__(self, o):
+        from spark_rapids_tpu.ops.arithmetic import Subtract
+        return self._bin(Subtract, o, True)
+
+    def __mul__(self, o):
+        from spark_rapids_tpu.ops.arithmetic import Multiply
+        return self._bin(Multiply, o)
+
+    def __rmul__(self, o):
+        from spark_rapids_tpu.ops.arithmetic import Multiply
+        return self._bin(Multiply, o, True)
+
+    def __truediv__(self, o):
+        from spark_rapids_tpu.ops.arithmetic import Divide
+        return self._bin(Divide, o)
+
+    def __mod__(self, o):
+        from spark_rapids_tpu.ops.arithmetic import Remainder
+        return self._bin(Remainder, o)
+
+    def __neg__(self):
+        from spark_rapids_tpu.ops.arithmetic import UnaryMinus
+        return UnaryMinus(self)
+
+    def __eq__(self, o):  # type: ignore[override]
+        from spark_rapids_tpu.ops.predicates import EqualTo
+        return self._bin(EqualTo, o)
+
+    def __ne__(self, o):  # type: ignore[override]
+        from spark_rapids_tpu.ops.predicates import EqualTo, Not
+        return Not(self._bin(EqualTo, o))
+
+    def __lt__(self, o):
+        from spark_rapids_tpu.ops.predicates import LessThan
+        return self._bin(LessThan, o)
+
+    def __le__(self, o):
+        from spark_rapids_tpu.ops.predicates import LessThanOrEqual
+        return self._bin(LessThanOrEqual, o)
+
+    def __gt__(self, o):
+        from spark_rapids_tpu.ops.predicates import GreaterThan
+        return self._bin(GreaterThan, o)
+
+    def __ge__(self, o):
+        from spark_rapids_tpu.ops.predicates import GreaterThanOrEqual
+        return self._bin(GreaterThanOrEqual, o)
+
+    def __and__(self, o):
+        from spark_rapids_tpu.ops.predicates import And
+        return self._bin(And, o)
+
+    def __or__(self, o):
+        from spark_rapids_tpu.ops.predicates import Or
+        return self._bin(Or, o)
+
+    def __invert__(self):
+        from spark_rapids_tpu.ops.predicates import Not
+        return Not(self)
+
+    def __hash__(self):
+        return hash(self.key())
+
+    def alias(self, name: str) -> "Alias":
+        return Alias(self, name)
+
+    def cast(self, dtype: T.DataType) -> "Expression":
+        from spark_rapids_tpu.ops.cast import Cast
+        return Cast(self, dtype)
+
+    def isnull(self):
+        from spark_rapids_tpu.ops.predicates import IsNull
+        return IsNull(self)
+
+    def isnotnull(self):
+        from spark_rapids_tpu.ops.predicates import IsNotNull
+        return IsNotNull(self)
+
+
+class AttributeReference(Expression):
+    """Unresolved column-by-name (pre-binding)."""
+
+    def __init__(self, col_name: str):
+        self.col_name = col_name
+
+    @property
+    def name(self):
+        return f"'{self.col_name}"
+
+    @property
+    def data_type(self):
+        raise ColumnarProcessingError(f"unresolved attribute {self.col_name}")
+
+    def key(self):
+        return ("attr", self.col_name)
+
+    def bind(self, schema):
+        for i, (n, dt) in enumerate(schema):
+            if n == self.col_name:
+                return BoundReference(i, dt, name_hint=self.col_name)
+        raise ColumnarProcessingError(
+            f"column {self.col_name!r} not in {[n for n, _ in schema]}")
+
+    def __repr__(self):
+        return f"col({self.col_name!r})"
+
+
+class BoundReference(Expression):
+    """Input column by ordinal (post-binding)."""
+
+    def __init__(self, ordinal: int, dtype: T.DataType, nullable_: bool = True,
+                 name_hint: str = ""):
+        self.ordinal = ordinal
+        self._dtype = dtype
+        self._nullable = nullable_
+        self.name_hint = name_hint
+
+    @property
+    def data_type(self):
+        return self._dtype
+
+    @property
+    def nullable(self):
+        return self._nullable
+
+    def key(self):
+        return ("ref", self.ordinal, str(self._dtype))
+
+    def with_children(self, children):
+        return self
+
+    def eval_cpu(self, table: HostTable) -> HostColumn:
+        return table.columns[self.ordinal]
+
+    def prep(self, pctx: PrepCtx, child_preps) -> NodePrep:
+        c = pctx.table.columns[self.ordinal]
+        return NodePrep(out_dict=c.dictionary, dict_sorted=c.dict_sorted)
+
+    def eval_dev(self, ctx: EvalCtx, child_vals, prep) -> DevVal:
+        return ctx.cols[self.ordinal]
+
+    def __repr__(self):
+        return f"#{self.ordinal}:{self._dtype}"
+
+
+class Literal(Expression):
+    def __init__(self, value, dtype: Optional[T.DataType] = None):
+        self.value = value
+        self._dtype = dtype if dtype is not None else T.python_to_spark_type(value)
+
+    @staticmethod
+    def of(value, dtype: Optional[T.DataType] = None) -> "Literal":
+        return Literal(value, dtype)
+
+    @property
+    def data_type(self):
+        return self._dtype
+
+    @property
+    def nullable(self):
+        return self.value is None
+
+    def key(self):
+        # literal VALUE is part of the traced constant, so it is in the key;
+        # string literals trace as code 0 over a 1-entry dict, so only
+        # null-ness matters for them.
+        if isinstance(self._dtype, T.StringType):
+            return ("lit", "str", self.value is None)
+        return ("lit", str(self._dtype), self.value)
+
+    def with_children(self, children):
+        return self
+
+    def eval_cpu(self, table: HostTable) -> HostColumn:
+        n = table.num_rows
+        validity = np.full(n, self.value is not None, dtype=np.bool_)
+        if isinstance(self._dtype, T.StringType):
+            data = np.full(n, self.value, dtype=object)
+        else:
+            fill = self.value if self.value is not None else 0
+            data = np.full(n, fill, dtype=self._dtype.np_dtype)
+        return HostColumn(self._dtype, data, validity)
+
+    def prep(self, pctx: PrepCtx, child_preps) -> NodePrep:
+        if isinstance(self._dtype, T.StringType) and self.value is not None:
+            return NodePrep(out_dict=np.array([self.value], dtype=object))
+        return NodePrep()
+
+    def eval_dev(self, ctx: EvalCtx, child_vals, prep) -> DevVal:
+        cap = ctx.capacity
+        if isinstance(self._dtype, T.StringType):
+            data = jnp.zeros(cap, dtype=jnp.int32)
+        else:
+            fill = self.value if self.value is not None else 0
+            data = jnp.full(cap, fill, dtype=self._dtype.np_dtype)
+        validity = jnp.full(cap, self.value is not None, dtype=jnp.bool_)
+        return DevVal(data, validity)
+
+    def __repr__(self):
+        return f"lit({self.value!r})"
+
+
+class Alias(Expression):
+    def __init__(self, child: Expression, out_name: str):
+        self.children = (child,)
+        self.out_name = out_name
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type
+
+    @property
+    def nullable(self):
+        return self.children[0].nullable
+
+    def key(self):
+        return ("alias", self.children[0].key())
+
+    def with_children(self, children):
+        return Alias(children[0], self.out_name)
+
+    def eval_cpu(self, table):
+        return self.children[0].eval_cpu(table)
+
+    def prep(self, pctx, child_preps):
+        return child_preps[0]
+
+    def eval_dev(self, ctx, child_vals, prep):
+        return child_vals[0]
+
+    def __repr__(self):
+        return f"{self.children[0]!r} AS {self.out_name}"
+
+
+def col(name: str) -> AttributeReference:
+    return AttributeReference(name)
+
+
+def lit(value, dtype: Optional[T.DataType] = None) -> Literal:
+    return Literal(value, dtype)
+
+
+def output_name(expr: Expression, default: str) -> str:
+    if isinstance(expr, Alias):
+        return expr.out_name
+    if isinstance(expr, AttributeReference):
+        return expr.col_name
+    if isinstance(expr, BoundReference) and expr.name_hint:
+        return expr.name_hint
+    return default
+
+
+def bind(expr: Expression, schema: Sequence[Tuple[str, T.DataType]]) -> Expression:
+    return expr.bind(schema)
+
+
+# ---------------------------------------------------------------------------
+# Evaluation drivers
+# ---------------------------------------------------------------------------
+
+def evaluate_cpu(exprs: Sequence[Expression], table: HostTable,
+                 names: Optional[Sequence[str]] = None) -> HostTable:
+    """Project on the CPU path."""
+    out_names = list(names) if names else [
+        output_name(e, f"col{i}") for i, e in enumerate(exprs)]
+    return HostTable(out_names, [e.eval_cpu(table) for e in exprs])
+
+
+def _walk_prep(expr: Expression, pctx: PrepCtx, out: List[NodePrep]) -> NodePrep:
+    child_preps = [_walk_prep(c, pctx, out) for c in expr.children]
+    p = expr.prep(pctx, child_preps)
+    out.append(p)
+    return p
+
+
+def _walk_eval(expr: Expression, ctx: EvalCtx) -> DevVal:
+    child_vals = [_walk_eval(c, ctx) for c in expr.children]
+    p = ctx.next_prep()
+    return expr.eval_dev(ctx, child_vals, p)
+
+
+def _prep_trace_key(preps: List[NodePrep]) -> tuple:
+    """Everything in a NodePrep that eval_dev may consume at TRACE time.
+
+    Contract for eval_dev implementations: per-batch data (dictionary
+    contents, literal codes, remap tables, hashes...) must flow through aux
+    arrays; only aux slot assignment and items recorded in ``extra`` may
+    shape the trace. This is what makes the jit cache sound across batches."""
+    return tuple(
+        (p.aux_slots, p.out_dict is not None, p.dict_sorted,
+         tuple(sorted(p.extra.items())))
+        for p in preps
+    )
+
+
+class CompiledProject:
+    """A fused, jitted projection of one or more expression trees over a
+    device table. Reused across batches via ProjectCache; within one
+    CompiledProject, jitted traces are cached per (capacity, prep structure)
+    and jax.jit's signature cache handles aux shapes/dtypes."""
+
+    def __init__(self, exprs: Sequence[Expression]):
+        self.exprs = tuple(exprs)
+        self._traces = {}
+
+    def _get_traced(self, capacity: int, all_preps: List[List[NodePrep]]):
+        tkey = (capacity, tuple(_prep_trace_key(p) for p in all_preps))
+        fn = self._traces.get(tkey)
+        if fn is None:
+            exprs = self.exprs
+
+            def traced(cols, aux, nrows):
+                outs = []
+                for e, preps in zip(exprs, all_preps):
+                    ctx = EvalCtx(cols, aux, nrows, capacity)
+                    ctx._prep_iter = iter(preps)
+                    outs.append(_walk_eval(e, ctx))
+                return outs
+
+            fn = jax.jit(traced)
+            self._traces[tkey] = fn
+        return fn
+
+    def __call__(self, table: DeviceTable) -> List[DeviceColumn]:
+        pctx = PrepCtx(table)
+        all_preps: List[List[NodePrep]] = []
+        for e in self.exprs:
+            preps: List[NodePrep] = []
+            _walk_prep(e, pctx, preps)
+            all_preps.append(preps)
+        col_arrays = tuple(DevVal(c.data, c.validity) for c in table.columns)
+        aux_arrays = tuple(jnp.asarray(a) for a in pctx.aux_arrays)
+
+        fn = self._get_traced(table.capacity, all_preps)
+        out_vals = fn(col_arrays, aux_arrays, table.nrows_dev)
+
+        out_cols = []
+        for e, preps, dv in zip(self.exprs, all_preps, out_vals):
+            root_prep = preps[-1]
+            out_cols.append(DeviceColumn(
+                e.data_type, dv.data, dv.validity,
+                dictionary=root_prep.out_dict, dict_sorted=root_prep.dict_sorted))
+        return out_cols
+
+
+class ProjectCache:
+    """Compile cache keyed by (expr keys, schema key). The jitted function
+    inside CompiledProject further caches per (bucket, aux shapes) thanks to
+    jax.jit's own signature cache."""
+
+    def __init__(self):
+        self._cache = {}
+
+    def get(self, exprs: Sequence[Expression], table: DeviceTable) -> CompiledProject:
+        key = (tuple(e.key() for e in exprs), table.schema_key()[0])
+        cp = self._cache.get(key)
+        if cp is None:
+            cp = CompiledProject(exprs)
+            self._cache[key] = cp
+        return cp
+
+
+_GLOBAL_PROJECT_CACHE = ProjectCache()
+
+
+def compile_project(exprs: Sequence[Expression], table: DeviceTable):
+    """Evaluate bound expressions over a device table, returning device
+    columns. Compilation is cached globally."""
+    return _GLOBAL_PROJECT_CACHE.get(exprs, table)(table)

@@ -1,0 +1,418 @@
+"""Math expressions (reference rules: Acos Acosh Asin Asinh Atan Atanh Cbrt
+Ceil Cos Cosh Cot Exp Expm1 Floor Hypot Log Log10 Log1p Log2 Logarithm Pow
+Rint Round BRound Signum Sin Sinh Sqrt Tan Tanh ToDegrees ToRadians
+ShiftLeft ShiftRight ShiftRightUnsigned BitwiseAnd BitwiseOr BitwiseXor
+BitwiseNot — mathExpressions.scala / arithmetic.scala; SURVEY.md Appendix A).
+
+Spark-exact corners: log-family returns NULL for non-positive inputs;
+ceil/floor of double return LongType (saturating at long bounds like Java);
+round is HALF_UP, bround HALF_EVEN; shifts mask the count like Java
+(& 31 / & 63)."""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar import HostColumn, HostTable
+from spark_rapids_tpu.ops.common import BinaryExpression, UnaryExpression, coerce_numeric_pair
+from spark_rapids_tpu.ops.expr import DevVal, Expression, Literal
+
+
+class UnaryMath(UnaryExpression):
+    """double -> double elementwise math. ``null_when`` makes the result NULL
+    on a domain violation (Spark's log family)."""
+
+    np_fn = None
+    jnp_fn = None
+    null_when = None  # fn(data) -> bool mask of inputs producing NULL
+
+    @property
+    def data_type(self):
+        return T.DOUBLE
+
+    def resolve(self, bound):
+        from spark_rapids_tpu.ops.cast import Cast
+        (c,) = bound
+        if c.data_type != T.DOUBLE:
+            c = Cast(c, T.DOUBLE)
+        return type(self)(c)
+
+    def eval_cpu(self, table: HostTable) -> HostColumn:
+        c = self.child.eval_cpu(table)
+        validity = c.validity.copy()
+        with np.errstate(all="ignore"):
+            if type(self).null_when is not None:
+                validity &= ~type(self).null_when(c.data)
+            data = type(self).np_fn(np.where(validity, c.data, 1.0))
+        return HostColumn(T.DOUBLE, np.where(validity, data, 0.0), validity)
+
+    def eval_dev(self, ctx, child_vals, prep):
+        (c,) = child_vals
+        validity = c.validity
+        if type(self).null_when is not None:
+            validity = validity & ~type(self).null_when(c.data)
+        data = type(self).jnp_fn(jnp.where(validity, c.data, 1.0))
+        return DevVal(jnp.where(validity, data, 0.0), validity)
+
+
+def _mk_unary(name, np_fn, jnp_fn, null_when_np=None, null_when_jnp=None):
+    cls = type(name, (UnaryMath,), {
+        "np_fn": staticmethod(np_fn),
+        "jnp_fn": staticmethod(jnp_fn),
+    })
+    if null_when_np is not None:
+        # the mask lambdas below are pure comparisons, valid for both numpy
+        # and traced jnp arrays
+        cls.null_when = staticmethod(null_when_jnp or null_when_np)
+    return cls
+
+
+Sqrt = _mk_unary("Sqrt", np.sqrt, jnp.sqrt)
+Cbrt = _mk_unary("Cbrt", np.cbrt, jnp.cbrt)
+Exp = _mk_unary("Exp", np.exp, jnp.exp)
+Expm1 = _mk_unary("Expm1", np.expm1, jnp.expm1)
+Sin = _mk_unary("Sin", np.sin, jnp.sin)
+Cos = _mk_unary("Cos", np.cos, jnp.cos)
+Tan = _mk_unary("Tan", np.tan, jnp.tan)
+Cot = _mk_unary("Cot", lambda x: 1.0 / np.tan(x), lambda x: 1.0 / jnp.tan(x))
+Asin = _mk_unary("Asin", np.arcsin, jnp.arcsin)
+Acos = _mk_unary("Acos", np.arccos, jnp.arccos)
+Atan = _mk_unary("Atan", np.arctan, jnp.arctan)
+Sinh = _mk_unary("Sinh", np.sinh, jnp.sinh)
+Cosh = _mk_unary("Cosh", np.cosh, jnp.cosh)
+Tanh = _mk_unary("Tanh", np.tanh, jnp.tanh)
+Asinh = _mk_unary("Asinh", np.arcsinh, jnp.arcsinh)
+Acosh = _mk_unary("Acosh", np.arccosh, jnp.arccosh)
+Atanh = _mk_unary("Atanh", np.arctanh, jnp.arctanh)
+Rint = _mk_unary("Rint", np.rint, jnp.round)
+Signum = _mk_unary("Signum", np.sign, jnp.sign)
+ToDegrees = _mk_unary("ToDegrees", np.degrees, lambda x: x * (180.0 / math.pi))
+ToRadians = _mk_unary("ToRadians", np.radians, lambda x: x * (math.pi / 180.0))
+
+# Spark's log family returns NULL for non-positive input (non-ANSI).
+Log = _mk_unary("Log", np.log, jnp.log, lambda x: x <= 0.0)
+Log10 = _mk_unary("Log10", np.log10, jnp.log10, lambda x: x <= 0.0)
+Log2 = _mk_unary("Log2", np.log2, jnp.log2, lambda x: x <= 0.0)
+Log1p = _mk_unary("Log1p", np.log1p, jnp.log1p, lambda x: x <= -1.0)
+
+
+_LONG_MIN, _LONG_MAX = -(1 << 63), (1 << 63) - 1
+
+
+class _CeilFloorBase(UnaryExpression):
+    """ceil/floor of double -> LongType with Java-style saturation."""
+
+    _np_fn = None
+    _jnp_fn = None
+
+    @property
+    def data_type(self):
+        if isinstance(self.child.data_type, (T.FloatType, T.DoubleType)):
+            return T.LONG
+        return self.child.data_type
+
+    def resolve(self, bound):
+        (c,) = bound
+        if isinstance(c.data_type, T.IntegralType):
+            return c  # no-op on integers (Spark keeps the value)
+        from spark_rapids_tpu.ops.cast import Cast
+        if c.data_type == T.FLOAT:
+            c = Cast(c, T.DOUBLE)
+        return type(self)(c)
+
+    def eval_cpu(self, table):
+        c = self.child.eval_cpu(table)
+        with np.errstate(invalid="ignore"):
+            r = type(self)._np_fn(c.data)
+            r = np.where(np.isnan(c.data), 0.0, r)
+            r = np.clip(r, float(_LONG_MIN), float(_LONG_MAX))
+        out = np.empty(len(c), dtype=np.int64)
+        big = r >= float(_LONG_MAX)
+        small = r <= float(_LONG_MIN)
+        mid = ~(big | small)
+        out[big] = _LONG_MAX
+        out[small] = _LONG_MIN
+        out[mid] = r[mid].astype(np.int64)
+        return HostColumn(T.LONG, np.where(c.validity, out, 0), c.validity.copy())
+
+    def eval_dev(self, ctx, child_vals, prep):
+        (c,) = child_vals
+        r = type(self)._jnp_fn(c.data)
+        r = jnp.where(jnp.isnan(c.data), 0.0, r)
+        r = jnp.clip(r, float(_LONG_MIN), float(_LONG_MAX))
+        out = r.astype(jnp.int64)
+        out = jnp.where(r >= float(_LONG_MAX), _LONG_MAX, out)
+        out = jnp.where(r <= float(_LONG_MIN), _LONG_MIN, out)
+        return DevVal(jnp.where(c.validity, out, 0), c.validity)
+
+
+class Ceil(_CeilFloorBase):
+    _np_fn = staticmethod(np.ceil)
+    _jnp_fn = staticmethod(jnp.ceil)
+
+
+class Floor(_CeilFloorBase):
+    _np_fn = staticmethod(np.floor)
+    _jnp_fn = staticmethod(jnp.floor)
+
+
+class _RoundBase(Expression):
+    """Round(child, scale): HALF_UP (Round) / HALF_EVEN (BRound) at decimal
+    scale d. Scale must be a literal (same restriction as the reference)."""
+
+    half_even = False
+
+    def __init__(self, child: Expression, scale: Expression = None):
+        scale = scale if scale is not None else Literal.of(0)
+        self.children = (child, scale)
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type
+
+    def with_children(self, children):
+        return type(self)(children[0], children[1])
+
+    def key(self):
+        s = self.children[1]
+        sv = s.value if isinstance(s, Literal) else None
+        return (self.name, sv, self.children[0].key())
+
+    def _scale(self) -> int:
+        s = self.children[1]
+        if not isinstance(s, Literal):
+            raise ValueError("round scale must be a literal")
+        return int(s.value)
+
+    def eval_cpu(self, table):
+        c = self.children[0].eval_cpu(table)
+        d = self._scale()
+        factor = 10.0 ** d
+        with np.errstate(all="ignore"):
+            x = c.data * factor
+            if self.half_even:
+                r = np.rint(x)
+            else:
+                r = np.where(x >= 0, np.floor(x + 0.5), np.ceil(x - 0.5))
+            data = r / factor
+        if isinstance(c.dtype, T.IntegralType):
+            data = data.astype(c.dtype.np_dtype)
+        data = np.where(c.validity, data, np.zeros((), dtype=data.dtype))
+        return HostColumn(self.data_type, data.astype(c.data.dtype), c.validity.copy())
+
+    def eval_dev(self, ctx, child_vals, prep):
+        c = child_vals[0]
+        d = self._scale()
+        factor = 10.0 ** d
+        x = c.data * factor
+        if self.half_even:
+            r = jnp.round(x)
+        else:
+            r = jnp.where(x >= 0, jnp.floor(x + 0.5), jnp.ceil(x - 0.5))
+        data = (r / factor).astype(c.data.dtype)
+        return DevVal(jnp.where(c.validity, data, jnp.zeros_like(data)), c.validity)
+
+
+class Round(_RoundBase):
+    half_even = False
+
+
+class BRound(_RoundBase):
+    half_even = True
+
+
+class Pow(BinaryExpression):
+    @property
+    def data_type(self):
+        return T.DOUBLE
+
+    def resolve(self, bound):
+        from spark_rapids_tpu.ops.cast import Cast
+        l, r = bound
+        if l.data_type != T.DOUBLE:
+            l = Cast(l, T.DOUBLE)
+        if r.data_type != T.DOUBLE:
+            r = Cast(r, T.DOUBLE)
+        return Pow(l, r)
+
+    def eval_cpu(self, table):
+        l = self.left.eval_cpu(table)
+        r = self.right.eval_cpu(table)
+        validity = l.validity & r.validity
+        with np.errstate(all="ignore"):
+            data = np.power(np.where(validity, l.data, 1.0), np.where(validity, r.data, 1.0))
+        return HostColumn(T.DOUBLE, np.where(validity, data, 0.0), validity)
+
+    def eval_dev(self, ctx, child_vals, prep):
+        l, r = child_vals
+        validity = l.validity & r.validity
+        data = jnp.power(jnp.where(validity, l.data, 1.0), jnp.where(validity, r.data, 1.0))
+        return DevVal(jnp.where(validity, data, 0.0), validity)
+
+
+class Hypot(BinaryExpression):
+    @property
+    def data_type(self):
+        return T.DOUBLE
+
+    def resolve(self, bound):
+        from spark_rapids_tpu.ops.cast import Cast
+        l, r = bound
+        if l.data_type != T.DOUBLE:
+            l = Cast(l, T.DOUBLE)
+        if r.data_type != T.DOUBLE:
+            r = Cast(r, T.DOUBLE)
+        return Hypot(l, r)
+
+    def eval_cpu(self, table):
+        l = self.left.eval_cpu(table)
+        r = self.right.eval_cpu(table)
+        validity = l.validity & r.validity
+        with np.errstate(all="ignore"):
+            data = np.hypot(l.data, r.data)
+        return HostColumn(T.DOUBLE, np.where(validity, data, 0.0), validity)
+
+    def eval_dev(self, ctx, child_vals, prep):
+        l, r = child_vals
+        validity = l.validity & r.validity
+        data = jnp.hypot(l.data, r.data)
+        return DevVal(jnp.where(validity, data, 0.0), validity)
+
+
+class Logarithm(BinaryExpression):
+    """log(base, x): NULL when x <= 0 or base <= 0."""
+
+    @property
+    def data_type(self):
+        return T.DOUBLE
+
+    def resolve(self, bound):
+        from spark_rapids_tpu.ops.cast import Cast
+        l, r = bound
+        if l.data_type != T.DOUBLE:
+            l = Cast(l, T.DOUBLE)
+        if r.data_type != T.DOUBLE:
+            r = Cast(r, T.DOUBLE)
+        return Logarithm(l, r)
+
+    def eval_cpu(self, table):
+        base = self.left.eval_cpu(table)
+        x = self.right.eval_cpu(table)
+        validity = base.validity & x.validity & (x.data > 0) & (base.data > 0)
+        with np.errstate(all="ignore"):
+            data = np.log(np.where(validity, x.data, 1.0)) / np.log(np.where(validity, base.data, 2.0))
+        return HostColumn(T.DOUBLE, np.where(validity, data, 0.0), validity)
+
+    def eval_dev(self, ctx, child_vals, prep):
+        base, x = child_vals
+        validity = base.validity & x.validity & (x.data > 0) & (base.data > 0)
+        data = jnp.log(jnp.where(validity, x.data, 1.0)) / jnp.log(jnp.where(validity, base.data, 2.0))
+        return DevVal(jnp.where(validity, data, 0.0), validity)
+
+
+# ---------------------------------------------------------------------------
+# Bitwise / shifts
+# ---------------------------------------------------------------------------
+
+class _BitwiseBinary(BinaryExpression):
+    _np_op = None
+
+    @property
+    def data_type(self):
+        return self.left.data_type
+
+    def resolve(self, bound):
+        left, right, _ = coerce_numeric_pair(*bound)
+        return type(self)(left, right)
+
+    def eval_cpu(self, table):
+        l = self.left.eval_cpu(table)
+        r = self.right.eval_cpu(table)
+        validity = l.validity & r.validity
+        data = type(self)._np_op(l.data, r.data)
+        return HostColumn(self.data_type, np.where(validity, data, 0).astype(l.data.dtype), validity)
+
+    def eval_dev(self, ctx, child_vals, prep):
+        l, r = child_vals
+        validity = l.validity & r.validity
+        data = type(self)._np_op(l.data, r.data)
+        return DevVal(jnp.where(validity, data, 0), validity)
+
+
+class BitwiseAnd(_BitwiseBinary):
+    _np_op = staticmethod(lambda a, b: a & b)
+
+
+class BitwiseOr(_BitwiseBinary):
+    _np_op = staticmethod(lambda a, b: a | b)
+
+
+class BitwiseXor(_BitwiseBinary):
+    _np_op = staticmethod(lambda a, b: a ^ b)
+
+
+class BitwiseNot(UnaryExpression):
+    @property
+    def data_type(self):
+        return self.child.data_type
+
+    def eval_cpu(self, table):
+        c = self.child.eval_cpu(table)
+        return HostColumn(self.data_type, np.where(c.validity, ~c.data, 0).astype(c.data.dtype),
+                          c.validity.copy())
+
+    def eval_dev(self, ctx, child_vals, prep):
+        (c,) = child_vals
+        return DevVal(jnp.where(c.validity, ~c.data, 0), c.validity)
+
+
+class _ShiftBase(BinaryExpression):
+    """Java shift semantics: count is masked (&31 for int, &63 for long)."""
+
+    @property
+    def data_type(self):
+        return self.left.data_type
+
+    def _mask(self):
+        return 63 if self.left.data_type == T.LONG else 31
+
+    def _shift_np(self, a, cnt):
+        raise NotImplementedError
+
+    def eval_cpu(self, table):
+        l = self.left.eval_cpu(table)
+        r = self.right.eval_cpu(table)
+        validity = l.validity & r.validity
+        cnt = (r.data & self._mask()).astype(np.int64)
+        with np.errstate(over="ignore"):
+            data = self._shift_np(l.data, cnt, np)
+        return HostColumn(self.data_type, np.where(validity, data, 0).astype(l.data.dtype), validity)
+
+    def eval_dev(self, ctx, child_vals, prep):
+        l, r = child_vals
+        validity = l.validity & r.validity
+        cnt = (r.data & self._mask()).astype(l.data.dtype)
+        data = self._shift_np(l.data, cnt, jnp)
+        return DevVal(jnp.where(validity, data, 0), validity)
+
+
+class ShiftLeft(_ShiftBase):
+    def _shift_np(self, a, cnt, xp):
+        return xp.left_shift(a, cnt.astype(a.dtype))
+
+
+class ShiftRight(_ShiftBase):
+    def _shift_np(self, a, cnt, xp):
+        return xp.right_shift(a, cnt.astype(a.dtype))
+
+
+class ShiftRightUnsigned(_ShiftBase):
+    def _shift_np(self, a, cnt, xp):
+        unsigned = a.astype(np.uint64 if a.dtype == np.int64 else np.uint32) \
+            if xp is np else a.astype(jnp.uint64 if a.dtype == jnp.int64 else jnp.uint32)
+        shifted = xp.right_shift(unsigned, cnt.astype(unsigned.dtype))
+        return shifted.astype(a.dtype)

@@ -1,0 +1,17 @@
+"""Plan-rewrite engine (reference: GpuOverrides.scala 4,755 LoC +
+RapidsMeta.scala + TypeChecks.scala + GpuTransitionOverrides.scala —
+SURVEY.md §2.2, the heart of the product).
+
+Same architecture: wrap every plan node in a Meta, tag unsupported nodes
+with human-readable reasons (never fail — fall back per operator), convert
+the supported subtree to TPU execs, then insert host<->device transitions
+and coalesce nodes."""
+
+from spark_rapids_tpu.overrides.typesig import TypeSig  # noqa: F401
+from spark_rapids_tpu.overrides.rules import (  # noqa: F401
+    PlanMeta,
+    wrap_plan,
+    convert_plan,
+    apply_overrides,
+    explain_plan,
+)

@@ -1,0 +1,323 @@
+"""Meta/tag/convert rules (reference: GpuOverrides exec/expr registries +
+RapidsMeta hierarchy + GpuTransitionOverrides)."""
+
+from __future__ import annotations
+
+import copy
+from typing import Callable, Dict, List, Optional, Sequence, Type
+
+from spark_rapids_tpu import conf as C
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.conf import RapidsConf, register_op_kill_switch
+from spark_rapids_tpu.execs import (
+    DeviceToHost,
+    HostToDevice,
+    InputAdapter,
+    TpuCoalesceExec,
+    TpuExec,
+    TpuExpandExec,
+    TpuFilterExec,
+    TpuHashAggregateExec,
+    TpuLimitExec,
+    TpuProjectExec,
+    TpuRangeExec,
+    TpuScanExec,
+    TpuSortExec,
+    TpuUnionExec,
+)
+from spark_rapids_tpu.execs.aggregate import DEVICE_SUPPORTED_AGGS
+from spark_rapids_tpu.ops import aggregates as agg
+from spark_rapids_tpu.ops.expr import Expression
+from spark_rapids_tpu.overrides.typesig import COMMON, ORDERABLE, TypeSig
+from spark_rapids_tpu.plan import nodes as P
+
+# ---------------------------------------------------------------------------
+# Expression support checking
+# ---------------------------------------------------------------------------
+
+#: expression classes with device implementations; populated lazily from the
+#: ops modules. Each entry maps class -> TypeSig for its OUTPUT type.
+_EXPR_SIGS: Dict[type, TypeSig] = {}
+
+
+def _build_expr_sigs():
+    if _EXPR_SIGS:
+        return
+    from spark_rapids_tpu.ops import arithmetic, cast, conditional, math, predicates
+    from spark_rapids_tpu.ops import expr as expr_mod
+
+    def reg(cls, sig=COMMON):
+        _EXPR_SIGS[cls] = sig
+        register_op_kill_switch("expression", cls.__name__, True,
+                               f"Enable {cls.__name__} on the accelerator.")
+
+    for mod in (arithmetic, conditional, math, predicates):
+        for name in dir(mod):
+            obj = getattr(mod, name)
+            if (isinstance(obj, type) and issubclass(obj, Expression)
+                    and not name.startswith("_")
+                    and obj.__module__ == mod.__name__
+                    and "eval_dev" in {m for kls in obj.__mro__ for m in vars(kls)}
+                    and getattr(obj, "eval_dev", None) is not Expression.eval_dev):
+                reg(obj)
+    reg(expr_mod.BoundReference)
+    reg(expr_mod.Literal)
+    reg(expr_mod.Alias)
+    reg(cast.Cast)
+    for fn in DEVICE_SUPPORTED_AGGS:
+        reg(fn)
+
+
+def check_expr(e: Expression, conf: RapidsConf, reasons: List[str], context: str = ""):
+    """Recursively verify a bound expression tree can run on device."""
+    _build_expr_sigs()
+    cls = type(e)
+    where = f"{context}{cls.__name__}"
+    sig = None
+    for klass in cls.__mro__:
+        if klass in _EXPR_SIGS:
+            sig = _EXPR_SIGS[klass]
+            break
+    if sig is None:
+        reasons.append(f"expression {where} is not supported on TPU")
+        return
+    if not conf.is_op_enabled("expression", cls.__name__):
+        reasons.append(f"expression {where} is disabled by conf")
+        return
+    try:
+        dt = e.data_type
+    except Exception:
+        dt = None
+    if dt is not None and not sig.supports(dt):
+        reasons.append(f"expression {where} produces unsupported type {dt.simple_string()}")
+    if not e.device_supported:
+        reasons.append(f"expression {where} configuration is not supported on TPU")
+    for c in e.children:
+        check_expr(c, conf, reasons, context)
+
+
+# ---------------------------------------------------------------------------
+# Exec rules
+# ---------------------------------------------------------------------------
+
+class ExecRule:
+    def __init__(self, node_cls: Type[P.PlanNode],
+                 tag_fn: Callable[["PlanMeta", RapidsConf], None],
+                 convert_fn: Callable[[P.PlanNode, List[TpuExec]], TpuExec],
+                 doc: str = ""):
+        self.node_cls = node_cls
+        self.tag_fn = tag_fn
+        self.convert_fn = convert_fn
+        register_op_kill_switch("exec", node_cls.__name__, True,
+                               doc or f"Enable {node_cls.__name__} on the accelerator.")
+
+
+_EXEC_RULES: Dict[type, ExecRule] = {}
+
+
+def exec_rule(node_cls, tag_fn, convert_fn, doc=""):
+    _EXEC_RULES[node_cls] = ExecRule(node_cls, tag_fn, convert_fn, doc)
+
+
+def _check_output_schema(meta: "PlanMeta", conf: RapidsConf):
+    for name, dt in meta.node.output_schema():
+        r = COMMON.reason_if_unsupported(dt, f"output column {name}")
+        if r:
+            meta.reasons.append(r)
+
+
+def _tag_scan(meta, conf):
+    _check_output_schema(meta, conf)
+
+
+def _tag_project(meta, conf):
+    _check_output_schema(meta, conf)
+    for e in meta.node.exprs:
+        check_expr(e, conf, meta.reasons)
+
+
+def _tag_filter(meta, conf):
+    _check_output_schema(meta, conf)
+    check_expr(meta.node.condition, conf, meta.reasons)
+
+
+def _tag_aggregate(meta, conf):
+    _check_output_schema(meta, conf)
+    node: P.Aggregate = meta.node
+    for g in node.grouping:
+        check_expr(g, conf, meta.reasons, "grouping key ")
+    for name, fn in node.agg_specs:
+        if not isinstance(fn, DEVICE_SUPPORTED_AGGS):
+            meta.reasons.append(f"aggregate {type(fn).__name__} is not supported on TPU")
+            continue
+        if fn.child is not None:
+            check_expr(fn.child, conf, meta.reasons, f"aggregate {name} input ")
+
+
+def _tag_sort(meta, conf):
+    _check_output_schema(meta, conf)
+    for o in meta.node.orders:
+        check_expr(o.expr, conf, meta.reasons, "sort key ")
+        dt = o.expr.data_type
+        if not ORDERABLE.supports(dt):
+            meta.reasons.append(f"sort key type {dt.simple_string()} not orderable on TPU")
+
+
+def _tag_simple(meta, conf):
+    _check_output_schema(meta, conf)
+
+
+def _tag_expand(meta, conf):
+    _check_output_schema(meta, conf)
+    for proj in meta.node.projections:
+        for e in proj:
+            check_expr(e, conf, meta.reasons)
+
+
+def _convert_scan(node: P.LocalScan, children):
+    return TpuScanExec(node.batches)
+
+
+def _convert_range(node: P.RangeNode, children):
+    return TpuRangeExec(node.start, node.end, node.step, node.batch_rows, node.col_name)
+
+
+def _convert_project(node: P.Project, children):
+    return TpuProjectExec(children[0], node.exprs, node.names)
+
+
+def _convert_filter(node: P.Filter, children):
+    return TpuFilterExec(children[0], node.condition)
+
+
+def _convert_aggregate(node: P.Aggregate, children):
+    coalesced = TpuCoalesceExec(children[0], require_single=True)
+    return TpuHashAggregateExec(coalesced, node.grouping, node.agg_specs,
+                                node.grouping_names)
+
+
+def _convert_sort(node: P.Sort, children):
+    coalesced = TpuCoalesceExec(children[0], require_single=True)
+    return TpuSortExec(coalesced, node.orders)
+
+
+def _convert_limit(node: P.Limit, children):
+    return TpuLimitExec(children[0], node.limit)
+
+
+def _convert_union(node: P.Union, children):
+    return TpuUnionExec(children)
+
+
+def _convert_expand(node: P.Expand, children):
+    return TpuExpandExec(children[0], node.projections, node.names)
+
+
+exec_rule(P.LocalScan, _tag_scan, _convert_scan)
+exec_rule(P.RangeNode, _tag_simple, _convert_range)
+exec_rule(P.Project, _tag_project, _convert_project)
+exec_rule(P.Filter, _tag_filter, _convert_filter)
+exec_rule(P.Aggregate, _tag_aggregate, _convert_aggregate)
+exec_rule(P.Sort, _tag_sort, _convert_sort)
+exec_rule(P.Limit, _tag_simple, _convert_limit)
+exec_rule(P.Union, _tag_simple, _convert_union)
+exec_rule(P.Expand, _tag_expand, _convert_expand)
+# P.Join / P.Exchange intentionally unregistered yet -> CPU fallback with
+# reason; device joins + shuffle land next (SURVEY.md §7 phases 4-5).
+
+
+# ---------------------------------------------------------------------------
+# Meta + conversion
+# ---------------------------------------------------------------------------
+
+class PlanMeta:
+    """RapidsMeta analog for plan nodes."""
+
+    def __init__(self, node: P.PlanNode, conf: RapidsConf, parent: Optional["PlanMeta"] = None):
+        self.node = node
+        self.conf = conf
+        self.parent = parent
+        self.reasons: List[str] = []
+        self.children = [PlanMeta(c, conf, self) for c in node.children]
+
+    def tag(self):
+        rule = _EXEC_RULES.get(type(self.node))
+        if rule is None:
+            self.reasons.append(f"exec {self.node.name} is not supported on TPU")
+        elif not self.conf.is_op_enabled("exec", type(self.node).__name__):
+            self.reasons.append(f"exec {self.node.name} is disabled by conf")
+        else:
+            rule.tag_fn(self, self.conf)
+        for c in self.children:
+            c.tag()
+
+    @property
+    def can_run_on_tpu(self) -> bool:
+        return not self.reasons
+
+    def explain(self, indent: int = 0, only_fallback: bool = True) -> str:
+        mark = "*" if self.can_run_on_tpu else "!"
+        line = "  " * indent + f"{mark} {self.node.describe()}"
+        if self.reasons:
+            line += "  <-- " + "; ".join(self.reasons)
+        out = [line] if (not only_fallback or self.reasons or indent == 0) else [
+            "  " * indent + f"{mark} {self.node.describe()}"]
+        for c in self.children:
+            out.append(c.explain(indent + 1, only_fallback))
+        return "\n".join(out)
+
+
+def wrap_plan(plan: P.PlanNode, conf: RapidsConf) -> PlanMeta:
+    meta = PlanMeta(plan, conf)
+    meta.tag()
+    return meta
+
+
+def _convert(meta: PlanMeta):
+    """Returns either a TpuExec (device) or a P.PlanNode (host)."""
+    converted_children = [_convert(c) for c in meta.children]
+    if meta.can_run_on_tpu:
+        rule = _EXEC_RULES[type(meta.node)]
+        dev_children = []
+        for cc in converted_children:
+            if isinstance(cc, TpuExec):
+                dev_children.append(cc)
+            else:
+                dev_children.append(HostToDevice(cc))
+        return rule.convert_fn(meta.node, dev_children)
+    # CPU node: children must be host-side
+    host_children = []
+    for cc, cm in zip(converted_children, meta.children):
+        if isinstance(cc, TpuExec):
+            host_children.append(InputAdapter(DeviceToHost(cc), cm.node.output_schema()))
+        else:
+            host_children.append(cc)
+    if host_children:
+        node = copy.copy(meta.node)
+        node.children = tuple(host_children)
+        return node
+    return meta.node
+
+
+def convert_plan(meta: PlanMeta):
+    """Convert a tagged plan; result always exposes execute_cpu (top-level
+    DeviceToHost transition added when the root runs on device)."""
+    out = _convert(meta)
+    if isinstance(out, TpuExec):
+        return DeviceToHost(out)
+    return out
+
+
+def apply_overrides(plan: P.PlanNode, conf: RapidsConf):
+    """GpuOverrides.apply analog: tag + convert (or explain-only)."""
+    if not conf.sql_enabled:
+        return plan, None
+    meta = wrap_plan(plan, conf)
+    if conf.is_explain_only:
+        return plan, meta
+    return convert_plan(meta), meta
+
+
+def explain_plan(plan: P.PlanNode, conf: RapidsConf) -> str:
+    meta = wrap_plan(plan, conf)
+    return meta.explain(only_fallback=conf.explain_mode != "ALL")

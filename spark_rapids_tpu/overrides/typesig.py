@@ -1,0 +1,43 @@
+"""Type support matrix (reference: TypeChecks.scala / TypeSig — SURVEY.md
+§2.2). Each operator rule declares the Spark types it supports on device;
+anything else tags the node for CPU fallback with a reason."""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+from spark_rapids_tpu import types as T
+
+
+class TypeSig:
+    def __init__(self, *type_classes, max_decimal_precision: int = T.DecimalType.MAX_LONG_DIGITS):
+        self.type_classes = tuple(type_classes)
+        self.max_decimal_precision = max_decimal_precision
+
+    def supports(self, dt: T.DataType) -> bool:
+        if isinstance(dt, T.DecimalType):
+            return (T.DecimalType in self.type_classes
+                    and dt.precision <= self.max_decimal_precision)
+        return any(type(dt) is tc for tc in self.type_classes)
+
+    def reason_if_unsupported(self, dt: T.DataType, what: str) -> str:
+        if self.supports(dt):
+            return ""
+        return f"{what} has unsupported type {dt.simple_string()}"
+
+    def __add__(self, other: "TypeSig") -> "TypeSig":
+        return TypeSig(*(set(self.type_classes) | set(other.type_classes)),
+                       max_decimal_precision=max(self.max_decimal_precision,
+                                                 other.max_decimal_precision))
+
+
+_COMMON = (T.BooleanType, T.ByteType, T.ShortType, T.IntegerType, T.LongType,
+           T.FloatType, T.DoubleType, T.DateType, T.TimestampType, T.StringType)
+
+#: types fully supported by the device columnar representation today
+COMMON = TypeSig(*_COMMON)
+NUMERIC = TypeSig(T.ByteType, T.ShortType, T.IntegerType, T.LongType,
+                  T.FloatType, T.DoubleType)
+INTEGRAL = TypeSig(T.ByteType, T.ShortType, T.IntegerType, T.LongType)
+ORDERABLE = COMMON
+ALL = COMMON  # grows as nested/decimal device support lands

@@ -1,0 +1,29 @@
+"""Plan layer: the CPU-side physical plan (the "Spark plan" analog) that the
+overrides engine rewrites into TPU execs, plus the DataFrame builder API.
+
+Every node carries a Spark-exact CPU execution path (``execute_cpu``) — this
+is simultaneously the per-operator fallback substrate and the test oracle,
+playing the role CPU Spark plays for the reference (SURVEY.md §4)."""
+
+from spark_rapids_tpu.plan.nodes import (  # noqa: F401
+    PlanNode,
+    LocalScan,
+    RangeNode,
+    Project,
+    Filter,
+    Aggregate,
+    Sort,
+    SortOrder,
+    Limit,
+    Union,
+    Join,
+    Exchange,
+    Expand,
+)
+from spark_rapids_tpu.plan.dataframe import (  # noqa: F401
+    DataFrame,
+    range_df,
+    from_pydict,
+    from_pandas,
+    from_host_table,
+)

@@ -1,0 +1,148 @@
+"""DataFrame builder API over the plan layer.
+
+The reference integrates into Spark SQL transparently; standalone, this
+PySpark-flavored DataFrame API is the user surface that builds CPU plans
+which the overrides engine then rewrites onto the TPU (session.py)."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from spark_rapids_tpu.columnar import HostTable
+from spark_rapids_tpu.ops.expr import Alias, AttributeReference, Expression, col, lit
+from spark_rapids_tpu.plan import nodes as P
+
+
+class DataFrame:
+    def __init__(self, plan: P.PlanNode, session=None):
+        self.plan = plan
+        self.session = session
+
+    # -- transformations ----------------------------------------------------
+    def _wrap(self, plan: P.PlanNode) -> "DataFrame":
+        return DataFrame(plan, self.session)
+
+    def select(self, *exprs) -> "DataFrame":
+        exprs = [col(e) if isinstance(e, str) else e for e in exprs]
+        return self._wrap(P.Project(self.plan, exprs))
+
+    def with_column(self, name: str, expr: Expression) -> "DataFrame":
+        existing = [col(n) for n, _ in self.plan.output_schema() if n != name]
+        return self.select(*existing, expr.alias(name))
+
+    def filter(self, condition: Expression) -> "DataFrame":
+        return self._wrap(P.Filter(self.plan, condition))
+
+    where = filter
+
+    def group_by(self, *keys) -> "GroupedData":
+        keys = [col(k) if isinstance(k, str) else k for k in keys]
+        return GroupedData(self, keys)
+
+    groupBy = group_by
+
+    def agg(self, *aggs) -> "DataFrame":
+        return GroupedData(self, []).agg(*aggs)
+
+    def sort(self, *orders, ascending: bool = True) -> "DataFrame":
+        sos = []
+        for o in orders:
+            if isinstance(o, str):
+                o = col(o)
+            if isinstance(o, P.SortOrder):
+                sos.append(o)
+            else:
+                sos.append(P.SortOrder(o, ascending))
+        return self._wrap(P.Sort(self.plan, sos))
+
+    order_by = sort
+    orderBy = sort
+
+    def limit(self, n: int) -> "DataFrame":
+        return self._wrap(P.Limit(self.plan, n))
+
+    def union(self, other: "DataFrame") -> "DataFrame":
+        return self._wrap(P.Union([self.plan, other.plan]))
+
+    def join(self, other: "DataFrame", on=None, how: str = "inner") -> "DataFrame":
+        if on is None:
+            return self._wrap(P.Join(self.plan, other.plan, "cross", [], []))
+        if isinstance(on, str):
+            on = [on]
+        if isinstance(on, (list, tuple)) and on and isinstance(on[0], str):
+            lk = [col(k) for k in on]
+            rk = [col(k) for k in on]
+            return self._wrap(P.Join(self.plan, other.plan, how, lk, rk))
+        raise ValueError("join `on` must be a column name or list of names")
+
+    def repartition(self, num_partitions: int, *keys) -> "DataFrame":
+        keys = [col(k) if isinstance(k, str) else k for k in keys]
+        mode = "hash" if keys else "roundrobin"
+        return self._wrap(P.Exchange(self.plan, mode, num_partitions, keys))
+
+    # -- actions ------------------------------------------------------------
+    @property
+    def schema(self):
+        return self.plan.output_schema()
+
+    @property
+    def columns(self):
+        return [n for n, _ in self.plan.output_schema()]
+
+    def collect_table(self) -> HostTable:
+        if self.session is not None:
+            return self.session.execute(self.plan)
+        return self.plan.collect_cpu()
+
+    def collect(self):
+        t = self.collect_table()
+        cols = [c.to_pylist() for c in t.columns]
+        return [tuple(c[i] for c in cols) for i in range(t.num_rows)]
+
+    def to_pandas(self):
+        return self.collect_table().to_pandas()
+
+    def to_pydict(self):
+        return self.collect_table().to_pydict()
+
+    def count(self) -> int:
+        return self.collect_table().num_rows
+
+    def explain(self) -> str:
+        if self.session is not None:
+            return self.session.explain(self.plan)
+        return self.plan.tree_string()
+
+
+class GroupedData:
+    def __init__(self, df: DataFrame, keys: Sequence[Expression]):
+        self.df = df
+        self.keys = keys
+
+    def agg(self, *aggs) -> DataFrame:
+        return self.df._wrap(P.Aggregate(self.df.plan, self.keys, list(aggs)))
+
+
+def from_pydict(data, dtypes=None, session=None, num_batches: int = 1) -> DataFrame:
+    table = HostTable.from_pydict(data, dtypes)
+    return from_host_table(table, session, num_batches)
+
+
+def from_pandas(df, session=None, num_batches: int = 1) -> DataFrame:
+    return from_host_table(HostTable.from_pandas(df), session, num_batches)
+
+
+def from_host_table(table: HostTable, session=None, num_batches: int = 1) -> DataFrame:
+    if num_batches <= 1 or table.num_rows == 0:
+        batches = [table]
+    else:
+        per = -(-table.num_rows // num_batches)
+        batches = [table.slice(i * per, min(per, table.num_rows - i * per))
+                   for i in range(num_batches) if i * per < table.num_rows]
+    return DataFrame(P.LocalScan(batches), session)
+
+
+def range_df(start: int, end: Optional[int] = None, step: int = 1, session=None) -> DataFrame:
+    if end is None:
+        start, end = 0, start
+    return DataFrame(P.RangeNode(start, end, step), session)

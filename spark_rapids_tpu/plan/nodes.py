@@ -1,0 +1,367 @@
+"""CPU physical plan nodes with Spark-exact execution.
+
+Reference analog: the Spark physical operators that GpuOverrides walks
+(ProjectExec, FilterExec, HashAggregateExec, SortExec, *Join*Exec,
+ShuffleExchangeExec ... — SURVEY.md §2.3 / Appendix B). Here they double as
+the fallback implementations."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar import HostColumn, HostTable
+from spark_rapids_tpu.errors import ColumnarProcessingError
+from spark_rapids_tpu.ops import aggregates as agg
+from spark_rapids_tpu.ops.expr import (
+    Alias,
+    Expression,
+    bind,
+    evaluate_cpu,
+    output_name,
+)
+
+Schema = List[Tuple[str, T.DataType]]
+
+
+class PlanNode:
+    children: Tuple["PlanNode", ...] = ()
+
+    def output_schema(self) -> Schema:
+        raise NotImplementedError
+
+    def execute_cpu(self) -> Iterator[HostTable]:
+        raise NotImplementedError
+
+    @property
+    def name(self) -> str:
+        return type(self).__name__
+
+    def collect_cpu(self) -> HostTable:
+        batches = list(self.execute_cpu())
+        if not batches:
+            return _empty_table(self.output_schema())
+        return HostTable.concat(batches)
+
+    def tree_string(self, indent: int = 0) -> str:
+        s = "  " * indent + self.describe() + "\n"
+        for c in self.children:
+            s += c.tree_string(indent + 1)
+        return s
+
+    def describe(self) -> str:
+        return self.name
+
+
+def _empty_table(schema: Schema) -> HostTable:
+    cols = []
+    for _, dt in schema:
+        if isinstance(dt, T.StringType):
+            cols.append(HostColumn(dt, np.array([], dtype=object), np.array([], dtype=np.bool_)))
+        else:
+            cols.append(HostColumn(dt, np.array([], dtype=dt.np_dtype), np.array([], dtype=np.bool_)))
+    return HostTable([n for n, _ in schema], cols)
+
+
+class LocalScan(PlanNode):
+    """In-memory scan over pre-built host batches (test/demo source; file
+    scans live in io/)."""
+
+    def __init__(self, batches: Sequence[HostTable]):
+        if not batches:
+            raise ColumnarProcessingError("LocalScan needs at least one batch")
+        self.batches = list(batches)
+
+    def output_schema(self):
+        return self.batches[0].schema()
+
+    def execute_cpu(self):
+        yield from self.batches
+
+    def describe(self):
+        return f"LocalScan[{len(self.batches)} batches]"
+
+
+class RangeNode(PlanNode):
+    """spark.range analog (reference: GpuRangeExec)."""
+
+    def __init__(self, start: int, end: int, step: int = 1, batch_rows: int = 1 << 20,
+                 name: str = "id"):
+        self.start, self.end, self.step = start, end, step
+        self.batch_rows = batch_rows
+        self.col_name = name
+
+    def output_schema(self):
+        return [(self.col_name, T.LONG)]
+
+    def execute_cpu(self):
+        total = max(0, -(-(self.end - self.start) // self.step))
+        pos = 0
+        while pos < total:
+            cnt = min(self.batch_rows, total - pos)
+            vals = self.start + (pos + np.arange(cnt, dtype=np.int64)) * self.step
+            yield HostTable([self.col_name], [HostColumn(T.LONG, vals)])
+            pos += cnt
+
+    def describe(self):
+        return f"Range({self.start}, {self.end}, {self.step})"
+
+
+class Project(PlanNode):
+    def __init__(self, child: PlanNode, exprs: Sequence[Expression]):
+        self.children = (child,)
+        schema = child.output_schema()
+        self.exprs = [bind(e, schema) for e in exprs]
+        self.names = [output_name(e, f"col{i}") for i, e in enumerate(exprs)]
+
+    @property
+    def child(self):
+        return self.children[0]
+
+    def output_schema(self):
+        return [(n, e.data_type) for n, e in zip(self.names, self.exprs)]
+
+    def execute_cpu(self):
+        for batch in self.child.execute_cpu():
+            yield evaluate_cpu(self.exprs, batch, self.names)
+
+    def describe(self):
+        return f"Project{self.names}"
+
+
+class Filter(PlanNode):
+    def __init__(self, child: PlanNode, condition: Expression):
+        self.children = (child,)
+        self.condition = bind(condition, child.output_schema())
+
+    @property
+    def child(self):
+        return self.children[0]
+
+    def output_schema(self):
+        return self.children[0].output_schema()
+
+    def execute_cpu(self):
+        for batch in self.children[0].execute_cpu():
+            pred = self.condition.eval_cpu(batch)
+            keep = pred.validity & pred.data.astype(np.bool_)
+            idx = np.nonzero(keep)[0]
+            cols = []
+            for c in batch.columns:
+                cols.append(HostColumn(c.dtype, c.data[idx], c.validity[idx]))
+            yield HostTable(batch.names, cols)
+
+    def describe(self):
+        return f"Filter[{self.condition!r}]"
+
+
+class Aggregate(PlanNode):
+    """Hash aggregate (group-by or global)."""
+
+    def __init__(self, child: PlanNode, grouping: Sequence[Expression],
+                 aggregates: Sequence[Expression]):
+        self.children = (child,)
+        schema = child.output_schema()
+        self.grouping = [bind(g, schema) for g in grouping]
+        self.agg_specs: List[Tuple[str, agg.AggregateFunction]] = []
+        for i, a in enumerate(aggregates):
+            name = output_name(a, f"agg{i}")
+            fn = a.children[0] if isinstance(a, Alias) else a
+            if not isinstance(fn, agg.AggregateFunction):
+                raise ColumnarProcessingError(f"not an aggregate: {a!r}")
+            bound = bind(fn, schema)
+            self.agg_specs.append((name, bound))
+        self.grouping_names = [output_name(g, f"k{i}") for i, g in enumerate(self.grouping)]
+
+    @property
+    def child(self):
+        return self.children[0]
+
+    def output_schema(self):
+        out = [(n, g.data_type) for n, g in zip(self.grouping_names, self.grouping)]
+        out += [(n, fn.data_type) for n, fn in self.agg_specs]
+        return out
+
+    def execute_cpu(self):
+        from spark_rapids_tpu.plan.cpu_agg import aggregate_cpu
+        table = self.children[0].collect_cpu()
+        yield aggregate_cpu(table, self.grouping, self.agg_specs)
+
+    def describe(self):
+        return f"Aggregate[keys={self.grouping_names}, aggs={[n for n, _ in self.agg_specs]}]"
+
+
+@dataclass
+class SortOrder:
+    expr: Expression
+    ascending: bool = True
+    nulls_first: Optional[bool] = None  # Spark default: asc->first, desc->last
+
+    def resolved_nulls_first(self) -> bool:
+        return self.ascending if self.nulls_first is None else self.nulls_first
+
+
+def _stable_sort_indices(cols: List[HostColumn], orders: List[SortOrder], n: int) -> np.ndarray:
+    """Multi-key stable sort: apply keys least-significant first; each key is
+    reduced to a dense integer rank (works for strings too, and makes
+    descending order stable), with nulls ranked before/after all values per
+    the order's nulls_first."""
+    idx = np.arange(n)
+    for col, order in reversed(list(zip(cols, orders))):
+        if isinstance(col.dtype, T.StringType):
+            vals = np.where(col.validity, col.data, "")
+        else:
+            vals = col.data
+        sub_vals = vals[idx]
+        sub_valid = col.validity[idx]
+        uniq = np.unique(sub_vals)
+        rank = np.searchsorted(uniq, sub_vals).astype(np.int64)
+        if not order.ascending:
+            rank = len(uniq) - 1 - rank
+        null_rank = -1 if order.resolved_nulls_first() else len(uniq)
+        rank = np.where(sub_valid, rank, null_rank)
+        idx = idx[np.argsort(rank, kind="stable")]
+    return idx
+
+
+class Sort(PlanNode):
+    def __init__(self, child: PlanNode, orders: Sequence[SortOrder], global_sort: bool = True):
+        self.children = (child,)
+        schema = child.output_schema()
+        self.orders = [SortOrder(bind(o.expr, schema), o.ascending, o.nulls_first) for o in orders]
+        self.global_sort = global_sort
+
+    @property
+    def child(self):
+        return self.children[0]
+
+    def output_schema(self):
+        return self.children[0].output_schema()
+
+    def execute_cpu(self):
+        table = self.children[0].collect_cpu()
+        n = table.num_rows
+        key_cols = [o.expr.eval_cpu(table) for o in self.orders]
+        idx = _stable_sort_indices(key_cols, self.orders, n)
+        cols = [HostColumn(c.dtype, c.data[idx], c.validity[idx]) for c in table.columns]
+        yield HostTable(table.names, cols)
+
+    def describe(self):
+        return f"Sort[{len(self.orders)} keys]"
+
+
+class Limit(PlanNode):
+    def __init__(self, child: PlanNode, limit: int):
+        self.children = (child,)
+        self.limit = limit
+
+    def output_schema(self):
+        return self.children[0].output_schema()
+
+    def execute_cpu(self):
+        remaining = self.limit
+        for batch in self.children[0].execute_cpu():
+            if remaining <= 0:
+                return
+            if batch.num_rows <= remaining:
+                remaining -= batch.num_rows
+                yield batch
+            else:
+                yield batch.slice(0, remaining)
+                return
+
+    def describe(self):
+        return f"Limit[{self.limit}]"
+
+
+class Union(PlanNode):
+    def __init__(self, children: Sequence[PlanNode]):
+        self.children = tuple(children)
+        s0 = self.children[0].output_schema()
+        for c in self.children[1:]:
+            if [dt for _, dt in c.output_schema()] != [dt for _, dt in s0]:
+                raise ColumnarProcessingError("UNION schema mismatch")
+
+    def output_schema(self):
+        return self.children[0].output_schema()
+
+    def execute_cpu(self):
+        for c in self.children:
+            yield from c.execute_cpu()
+
+
+class Expand(PlanNode):
+    """Rollup/cube support: replicate each input row through N projections
+    (reference: GpuExpandExec)."""
+
+    def __init__(self, child: PlanNode, projections: Sequence[Sequence[Expression]],
+                 names: Sequence[str]):
+        self.children = (child,)
+        schema = child.output_schema()
+        self.projections = [[bind(e, schema) for e in proj] for proj in projections]
+        self.names = list(names)
+
+    def output_schema(self):
+        return [(n, e.data_type) for n, e in zip(self.names, self.projections[0])]
+
+    def execute_cpu(self):
+        for batch in self.children[0].execute_cpu():
+            for proj in self.projections:
+                yield evaluate_cpu(proj, batch, self.names)
+
+
+class Join(PlanNode):
+    """Equi-join (hash join analog). Types: inner, left, right, full, leftsemi,
+    leftanti, cross."""
+
+    def __init__(self, left: PlanNode, right: PlanNode, join_type: str,
+                 left_keys: Sequence[Expression], right_keys: Sequence[Expression],
+                 condition: Optional[Expression] = None):
+        self.children = (left, right)
+        self.join_type = join_type
+        ls, rs = left.output_schema(), right.output_schema()
+        self.left_keys = [bind(k, ls) for k in left_keys]
+        self.right_keys = [bind(k, rs) for k in right_keys]
+        self.condition = bind(condition, ls + rs) if condition is not None else None
+
+    def output_schema(self):
+        ls = self.children[0].output_schema()
+        rs = self.children[1].output_schema()
+        if self.join_type in ("leftsemi", "leftanti"):
+            return ls
+        return ls + rs
+
+    def execute_cpu(self):
+        from spark_rapids_tpu.plan.cpu_join import join_cpu
+        left = self.children[0].collect_cpu()
+        right = self.children[1].collect_cpu()
+        yield join_cpu(left, right, self.join_type, self.left_keys,
+                       self.right_keys, self.condition)
+
+    def describe(self):
+        return f"Join[{self.join_type}]"
+
+
+class Exchange(PlanNode):
+    """Shuffle exchange placeholder: single-process CPU path is pass-through;
+    the TPU path repartitions batches (parallel/exchange.py)."""
+
+    def __init__(self, child: PlanNode, partitioning: str, num_partitions: int,
+                 keys: Sequence[Expression] = ()):
+        self.children = (child,)
+        self.partitioning = partitioning
+        self.num_partitions = num_partitions
+        schema = child.output_schema()
+        self.keys = [bind(k, schema) for k in keys]
+
+    def output_schema(self):
+        return self.children[0].output_schema()
+
+    def execute_cpu(self):
+        yield from self.children[0].execute_cpu()
+
+    def describe(self):
+        return f"Exchange[{self.partitioning}, n={self.num_partitions}]"

@@ -1,0 +1,3 @@
+"""Runtime layer: device manager, task semaphore, spill catalog, OOM retry
+(reference: GpuDeviceManager / GpuSemaphore / RapidsBufferCatalog /
+RmmRapidsRetryIterator — SURVEY.md §2.5)."""

@@ -1,0 +1,78 @@
+"""Device acquisition & memory setup (reference: GpuDeviceManager.scala —
+picks the GPU, initializes the RMM pool, pinned pool, off-heap limits;
+SURVEY.md §2.5).
+
+TPU analog: discover devices/topology through JAX/PJRT, record HBM budget
+from the conf fraction, and expose the live-arrays accounting XLA gives us.
+XLA's allocator already pools HBM (BFC) — the engine's job is budget
+tracking + spill/retry on top (runtime/catalog.py, runtime/retry.py)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional
+
+import jax
+
+from spark_rapids_tpu.conf import (
+    CONCURRENT_TPU_TASKS,
+    HBM_POOL_FRACTION,
+    HBM_RESERVE_BYTES,
+    RapidsConf,
+)
+
+_DEFAULT_HBM_BYTES = 16 << 30  # v5e has 16 GiB per chip
+
+
+@dataclass
+class DeviceInfo:
+    device: object
+    platform: str
+    hbm_limit_bytes: int
+
+
+class TpuDeviceManager:
+    """Singleton-ish per-process device state."""
+
+    _instance: Optional["TpuDeviceManager"] = None
+
+    def __init__(self, conf: RapidsConf):
+        self.conf = conf
+        self.devices: List[object] = []
+        self.info: Optional[DeviceInfo] = None
+        self.initialized = False
+
+    def initialize(self):
+        if self.initialized:
+            return
+        self.devices = list(jax.devices())
+        dev = self.devices[0]
+        total = _DEFAULT_HBM_BYTES
+        stats = None
+        try:
+            stats = dev.memory_stats()
+        except Exception:
+            stats = None
+        if stats and "bytes_limit" in stats:
+            total = int(stats["bytes_limit"])
+        frac = self.conf.get_entry(HBM_POOL_FRACTION)
+        reserve = self.conf.get_entry(HBM_RESERVE_BYTES)
+        limit = max(int(total * frac) - reserve, 256 << 20)
+        self.info = DeviceInfo(device=dev, platform=dev.platform, hbm_limit_bytes=limit)
+        TpuDeviceManager._instance = self
+        self.initialized = True
+
+    @classmethod
+    def current(cls) -> Optional["TpuDeviceManager"]:
+        return cls._instance
+
+    def bytes_in_use(self) -> int:
+        try:
+            stats = self.info.device.memory_stats()
+            return int(stats.get("bytes_in_use", 0))
+        except Exception:
+            return 0
+
+    @property
+    def concurrent_tasks(self) -> int:
+        return self.conf.get_entry(CONCURRENT_TPU_TASKS)

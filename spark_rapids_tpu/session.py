@@ -1,0 +1,83 @@
+"""TpuSession — the user entry point (reference analog: SQLPlugin +
+RapidsDriverPlugin/RapidsExecutorPlugin lifecycle, Plugin.scala — SURVEY.md
+§2.1/§3.1). Owns the conf, the device runtime, and plan execution through
+the overrides engine."""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from spark_rapids_tpu.columnar import HostTable
+from spark_rapids_tpu.conf import RapidsConf
+from spark_rapids_tpu.overrides import apply_overrides, explain_plan
+from spark_rapids_tpu.plan import DataFrame, from_host_table
+from spark_rapids_tpu.plan import nodes as P
+
+
+class TpuSession:
+    def __init__(self, conf: Optional[Dict] = None):
+        self.conf = RapidsConf(conf)
+        self._runtime = None
+
+    # -- lifecycle ----------------------------------------------------------
+    @property
+    def runtime(self):
+        if self._runtime is None:
+            from spark_rapids_tpu.runtime.device_manager import TpuDeviceManager
+            self._runtime = TpuDeviceManager(self.conf)
+            self._runtime.initialize()
+        return self._runtime
+
+    def set_conf(self, key: str, value) -> "TpuSession":
+        self.conf = self.conf.set(key, value)
+        return self
+
+    # -- data sources -------------------------------------------------------
+    def create_dataframe(self, data, dtypes=None, num_batches: int = 1) -> DataFrame:
+        if isinstance(data, HostTable):
+            return from_host_table(data, self, num_batches)
+        if isinstance(data, dict):
+            return from_host_table(HostTable.from_pydict(data, dtypes), self, num_batches)
+        import pandas as pd
+        if isinstance(data, pd.DataFrame):
+            return from_host_table(HostTable.from_pandas(data), self, num_batches)
+        raise TypeError(f"cannot create DataFrame from {type(data)}")
+
+    def range(self, start: int, end: Optional[int] = None, step: int = 1) -> DataFrame:
+        if end is None:
+            start, end = 0, start
+        return DataFrame(P.RangeNode(start, end, step), self)
+
+    def read_parquet(self, *paths, **options) -> DataFrame:
+        from spark_rapids_tpu.io.parquet import ParquetScanNode
+        return DataFrame(ParquetScanNode(list(paths), self.conf, **options), self)
+
+    def read_csv(self, *paths, **options) -> DataFrame:
+        from spark_rapids_tpu.io.csv import CsvScanNode
+        return DataFrame(CsvScanNode(list(paths), self.conf, **options), self)
+
+    def read_json(self, *paths, **options) -> DataFrame:
+        from spark_rapids_tpu.io.json import JsonScanNode
+        return DataFrame(JsonScanNode(list(paths), self.conf, **options), self)
+
+    def read_orc(self, *paths, **options) -> DataFrame:
+        from spark_rapids_tpu.io.orc import OrcScanNode
+        return DataFrame(OrcScanNode(list(paths), self.conf, **options), self)
+
+    # -- execution ----------------------------------------------------------
+    def execute(self, plan: P.PlanNode) -> HostTable:
+        executable, meta = apply_overrides(plan, self.conf)
+        if meta is not None and self.conf.explain_mode in ("NOT_ON_GPU", "ALL"):
+            print(meta.explain(only_fallback=self.conf.explain_mode == "NOT_ON_GPU"))
+        batches = list(executable.execute_cpu())
+        if not batches:
+            from spark_rapids_tpu.plan.nodes import _empty_table
+            return _empty_table(plan.output_schema())
+        return HostTable.concat(batches)
+
+    def execute_cpu_only(self, plan: P.PlanNode) -> HostTable:
+        """Run fully on the CPU path (the oracle)."""
+        return plan.collect_cpu()
+
+    def explain(self, plan: P.PlanNode) -> str:
+        return explain_plan(plan, self.conf)

@@ -1,0 +1,321 @@
+"""Spark SQL type system for the TPU engine.
+
+Mirrors the reference's type support surface (TypeChecks.scala / TypeSig --
+see SURVEY.md §2.2): every operator declares which of these types it supports,
+and unsupported combinations fall back to CPU with a reason.
+
+Device mapping (how each Spark type lives in HBM as an XLA buffer):
+  BooleanType            -> bool_
+  ByteType               -> int8
+  ShortType              -> int16
+  IntegerType            -> int32
+  LongType               -> int64
+  FloatType              -> float32
+  DoubleType             -> float64
+  DateType               -> int32   (days since epoch, Spark-compatible)
+  TimestampType          -> int64   (microseconds since epoch, UTC)
+  StringType             -> int32 dictionary codes (order-preserving, per
+                            batch) + host-side dictionary; see columnar/
+  DecimalType(p<=18, s)  -> int64 unscaled value
+  NullType               -> int8 (all-null)
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+from dataclasses import dataclass
+
+import numpy as np
+
+
+class DataType:
+    """Base of the Spark SQL type hierarchy."""
+
+    #: numpy dtype used for the device representation of this type.
+    np_dtype: np.dtype = None  # type: ignore[assignment]
+
+    def simple_string(self) -> str:
+        return type(self).__name__.replace("Type", "").lower()
+
+    def __repr__(self) -> str:
+        return self.simple_string()
+
+    def __eq__(self, other) -> bool:
+        return type(self) is type(other)
+
+    def __hash__(self) -> int:
+        return hash(type(self).__name__)
+
+
+class NumericType(DataType):
+    pass
+
+
+class IntegralType(NumericType):
+    pass
+
+
+class FractionalType(NumericType):
+    pass
+
+
+class BooleanType(DataType):
+    np_dtype = np.dtype(np.bool_)
+
+
+class ByteType(IntegralType):
+    np_dtype = np.dtype(np.int8)
+
+    def simple_string(self):
+        return "tinyint"
+
+
+class ShortType(IntegralType):
+    np_dtype = np.dtype(np.int16)
+
+    def simple_string(self):
+        return "smallint"
+
+
+class IntegerType(IntegralType):
+    np_dtype = np.dtype(np.int32)
+
+    def simple_string(self):
+        return "int"
+
+
+class LongType(IntegralType):
+    np_dtype = np.dtype(np.int64)
+
+    def simple_string(self):
+        return "bigint"
+
+
+class FloatType(FractionalType):
+    np_dtype = np.dtype(np.float32)
+
+
+class DoubleType(FractionalType):
+    np_dtype = np.dtype(np.float64)
+
+
+class StringType(DataType):
+    # device representation is int32 dictionary codes; the logical type has
+    # no fixed-width numpy dtype of its own.
+    np_dtype = np.dtype(object)
+
+
+class DateType(DataType):
+    """Days since 1970-01-01 as int32 (Spark internal representation)."""
+
+    np_dtype = np.dtype(np.int32)
+
+
+class TimestampType(DataType):
+    """Microseconds since epoch (UTC) as int64 (Spark internal repr)."""
+
+    np_dtype = np.dtype(np.int64)
+
+
+class NullType(DataType):
+    np_dtype = np.dtype(np.int8)
+
+    def simple_string(self):
+        return "void"
+
+
+@dataclass(frozen=True)
+class DecimalType(FractionalType):
+    """Decimal with precision/scale. p<=18 fits an int64 unscaled value.
+
+    The reference uses 128-bit decimals via JNI DecimalUtils for p>18
+    (SURVEY.md §2.9); we represent p<=18 natively and 19..38 as a
+    (hi int64, lo uint64-as-int64) pair on device (phase: later).
+    """
+
+    precision: int = 10
+    scale: int = 0
+
+    MAX_PRECISION = 38
+    MAX_LONG_DIGITS = 18
+
+    def __post_init__(self):
+        if not (0 < self.precision <= self.MAX_PRECISION):
+            raise ValueError(f"bad decimal precision {self.precision}")
+        if not (0 <= self.scale <= self.precision):
+            raise ValueError(f"bad decimal scale {self.scale}")
+
+    @property
+    def np_dtype(self):  # type: ignore[override]
+        return np.dtype(np.int64)
+
+    def simple_string(self):
+        return f"decimal({self.precision},{self.scale})"
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, DecimalType)
+            and other.precision == self.precision
+            and other.scale == self.scale
+        )
+
+    def __hash__(self):
+        return hash(("decimal", self.precision, self.scale))
+
+
+@dataclass(frozen=True)
+class ArrayType(DataType):
+    element_type: DataType = None  # type: ignore[assignment]
+    contains_null: bool = True
+
+    def simple_string(self):
+        return f"array<{self.element_type.simple_string()}>"
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, ArrayType)
+            and other.element_type == self.element_type
+        )
+
+    def __hash__(self):
+        return hash(("array", self.element_type))
+
+
+@dataclass(frozen=True)
+class StructField:
+    name: str
+    data_type: DataType
+    nullable: bool = True
+
+
+class StructType(DataType):
+    def __init__(self, fields):
+        self.fields = tuple(fields)
+
+    def simple_string(self):
+        inner = ",".join(
+            f"{f.name}:{f.data_type.simple_string()}" for f in self.fields
+        )
+        return f"struct<{inner}>"
+
+    def __eq__(self, other):
+        return isinstance(other, StructType) and other.fields == self.fields
+
+    def __hash__(self):
+        return hash(("struct", self.fields))
+
+
+@dataclass(frozen=True)
+class MapType(DataType):
+    key_type: DataType = None  # type: ignore[assignment]
+    value_type: DataType = None  # type: ignore[assignment]
+    value_contains_null: bool = True
+
+    def simple_string(self):
+        return (
+            f"map<{self.key_type.simple_string()},"
+            f"{self.value_type.simple_string()}>"
+        )
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, MapType)
+            and other.key_type == self.key_type
+            and other.value_type == self.value_type
+        )
+
+    def __hash__(self):
+        return hash(("map", self.key_type, self.value_type))
+
+
+# Singletons, Spark-style.
+BOOLEAN = BooleanType()
+BYTE = ByteType()
+SHORT = ShortType()
+INT = IntegerType()
+LONG = LongType()
+FLOAT = FloatType()
+DOUBLE = DoubleType()
+STRING = StringType()
+DATE = DateType()
+TIMESTAMP = TimestampType()
+NULL = NullType()
+
+ALL_INTEGRAL = (BYTE, SHORT, INT, LONG)
+ALL_NUMERIC = ALL_INTEGRAL + (FLOAT, DOUBLE)
+ALL_ORDERABLE = ALL_NUMERIC + (BOOLEAN, STRING, DATE, TIMESTAMP)
+
+_NUMPY_TO_SPARK = {
+    np.dtype(np.bool_): BOOLEAN,
+    np.dtype(np.int8): BYTE,
+    np.dtype(np.int16): SHORT,
+    np.dtype(np.int32): INT,
+    np.dtype(np.int64): LONG,
+    np.dtype(np.float32): FLOAT,
+    np.dtype(np.float64): DOUBLE,
+}
+
+
+def from_numpy(dtype) -> DataType:
+    dt = _NUMPY_TO_SPARK.get(np.dtype(dtype))
+    if dt is None:
+        raise TypeError(f"no Spark type for numpy dtype {dtype}")
+    return dt
+
+
+def is_string(dt: DataType) -> bool:
+    return isinstance(dt, StringType)
+
+
+def is_integral(dt: DataType) -> bool:
+    return isinstance(dt, IntegralType)
+
+
+def is_numeric(dt: DataType) -> bool:
+    return isinstance(dt, NumericType)
+
+
+def is_floating(dt: DataType) -> bool:
+    return isinstance(dt, (FloatType, DoubleType))
+
+
+def is_nested(dt: DataType) -> bool:
+    return isinstance(dt, (ArrayType, StructType, MapType))
+
+
+def python_to_spark_type(value) -> DataType:
+    """Infer the Spark type of a Python literal (Spark Literal.apply analog)."""
+    if value is None:
+        return NULL
+    if isinstance(value, bool):
+        return BOOLEAN
+    if isinstance(value, int):
+        return INT if np.iinfo(np.int32).min <= value <= np.iinfo(np.int32).max else LONG
+    if isinstance(value, float):
+        return DOUBLE
+    if isinstance(value, str):
+        return STRING
+    if isinstance(value, _dt.datetime):
+        return TIMESTAMP
+    if isinstance(value, _dt.date):
+        return DATE
+    if isinstance(value, np.generic):
+        return from_numpy(value.dtype)
+    raise TypeError(f"cannot infer Spark type for {value!r}")
+
+
+# Numeric widening lattice for implicit binary-op promotion (Spark
+# TypeCoercion findTightestCommonType subset).
+_PROMOTE_ORDER = {BYTE: 0, SHORT: 1, INT: 2, LONG: 3, FLOAT: 4, DOUBLE: 5}
+
+
+def promote(a: DataType, b: DataType) -> DataType:
+    if a == b:
+        return a
+    if a in _PROMOTE_ORDER and b in _PROMOTE_ORDER:
+        return a if _PROMOTE_ORDER[a] >= _PROMOTE_ORDER[b] else b
+    if isinstance(a, NullType):
+        return b
+    if isinstance(b, NullType):
+        return a
+    raise TypeError(f"cannot promote {a} with {b}")

@@ -1,0 +1,30 @@
+"""Test fixtures. Runs JAX on a virtual 8-device CPU mesh so multi-chip
+sharding logic is exercised without TPU hardware (the driver dry-runs the
+real multi-chip path separately)."""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+# the axon site package pins JAX_PLATFORMS=axon at interpreter start; the
+# config update below overrides it reliably.
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def session():
+    from spark_rapids_tpu.session import TpuSession
+    return TpuSession()
+
+
+@pytest.fixture(scope="session")
+def cpu_session():
+    from spark_rapids_tpu.session import TpuSession
+    return TpuSession({"spark.rapids.sql.enabled": "false"})

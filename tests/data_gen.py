@@ -1,0 +1,162 @@
+"""Seeded data generators per Spark type (reference: integration_tests
+data_gen.py — SURVEY.md §4). Deterministic, nullable, corner-value-heavy."""
+
+from __future__ import annotations
+
+import string
+from typing import Dict, Optional
+
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar import HostColumn, HostTable
+
+DEFAULT_SEED = 42
+
+_INT_CORNERS = {
+    T.BYTE: [0, 1, -1, 127, -128],
+    T.SHORT: [0, 1, -1, 32767, -32768],
+    T.INT: [0, 1, -1, 2147483647, -2147483648],
+    T.LONG: [0, 1, -1, (1 << 63) - 1, -(1 << 63)],
+}
+_FLOAT_CORNERS = [0.0, -0.0, 1.0, -1.0, 1e30, -1e30, 1e-30]
+
+
+class Gen:
+    def __init__(self, dtype: T.DataType, nullable: bool = True, null_prob: float = 0.1):
+        self.dtype = dtype
+        self.nullable = nullable
+        self.null_prob = null_prob
+
+    def generate(self, n: int, rng: np.random.Generator) -> HostColumn:
+        data = self._values(n, rng)
+        if self.nullable:
+            validity = rng.random(n) >= self.null_prob
+        else:
+            validity = np.ones(n, dtype=np.bool_)
+        if isinstance(self.dtype, T.StringType):
+            out = np.empty(n, dtype=object)
+            out[:] = data
+            out[~validity] = None
+            return HostColumn(self.dtype, out, validity)
+        zero = np.zeros((), dtype=self.dtype.np_dtype).item()
+        data = np.where(validity, data, zero).astype(self.dtype.np_dtype)
+        return HostColumn(self.dtype, data, validity)
+
+    def _values(self, n, rng):
+        raise NotImplementedError
+
+
+class IntGen(Gen):
+    def __init__(self, dtype=T.INT, nullable=True, min_val=None, max_val=None,
+                 corner_prob: float = 0.05, null_prob: float = 0.1):
+        super().__init__(dtype, nullable, null_prob)
+        info = np.iinfo(dtype.np_dtype)
+        self.min_val = info.min if min_val is None else min_val
+        self.max_val = info.max if max_val is None else max_val
+        self.corner_prob = corner_prob
+
+    def _values(self, n, rng):
+        vals = rng.integers(self.min_val, self.max_val, size=n, dtype=np.int64,
+                            endpoint=True).astype(self.dtype.np_dtype)
+        corners = _INT_CORNERS.get(self.dtype)
+        if corners and self.corner_prob > 0 and self.min_val <= corners[0] <= self.max_val:
+            usable = [c for c in corners if self.min_val <= c <= self.max_val]
+            mask = rng.random(n) < self.corner_prob
+            vals[mask] = rng.choice(np.array(usable, dtype=self.dtype.np_dtype),
+                                    size=int(mask.sum()))
+        return vals
+
+
+class LongGen(IntGen):
+    def __init__(self, nullable=True, **kw):
+        super().__init__(T.LONG, nullable, **kw)
+
+
+class ByteGen(IntGen):
+    def __init__(self, nullable=True, **kw):
+        super().__init__(T.BYTE, nullable, **kw)
+
+
+class ShortGen(IntGen):
+    def __init__(self, nullable=True, **kw):
+        super().__init__(T.SHORT, nullable, **kw)
+
+
+class BooleanGen(Gen):
+    def __init__(self, nullable=True):
+        super().__init__(T.BOOLEAN, nullable)
+
+    def _values(self, n, rng):
+        return rng.integers(0, 2, size=n).astype(np.bool_)
+
+
+class FloatGen(Gen):
+    def __init__(self, dtype=T.DOUBLE, nullable=True, no_nans=True, corner_prob=0.05):
+        super().__init__(dtype, nullable)
+        self.no_nans = no_nans
+        self.corner_prob = corner_prob
+
+    def _values(self, n, rng):
+        vals = (rng.standard_normal(n) * 1e6).astype(self.dtype.np_dtype)
+        mask = rng.random(n) < self.corner_prob
+        corners = np.array(_FLOAT_CORNERS, dtype=self.dtype.np_dtype)
+        vals[mask] = rng.choice(corners, size=int(mask.sum()))
+        return vals
+
+
+class DoubleGen(FloatGen):
+    def __init__(self, nullable=True, **kw):
+        super().__init__(T.DOUBLE, nullable, **kw)
+
+
+class StringGen(Gen):
+    def __init__(self, nullable=True, max_len: int = 12, alphabet: Optional[str] = None,
+                 cardinality: Optional[int] = None):
+        super().__init__(T.STRING, nullable)
+        self.max_len = max_len
+        self.alphabet = alphabet or (string.ascii_letters + string.digits + " _")
+        self.cardinality = cardinality
+
+    def _values(self, n, rng):
+        if self.cardinality:
+            pool = self._make(self.cardinality, rng)
+            idx = rng.integers(0, len(pool), size=n)
+            return [pool[i] for i in idx]
+        return self._make(n, rng)
+
+    def _make(self, n, rng):
+        lens = rng.integers(0, self.max_len + 1, size=n)
+        chars = np.array(list(self.alphabet))
+        return ["".join(rng.choice(chars, size=l)) for l in lens]
+
+
+class DateGen(Gen):
+    def __init__(self, nullable=True):
+        super().__init__(T.DATE, nullable)
+
+    def _values(self, n, rng):
+        return rng.integers(-25000, 25000, size=n).astype(np.int32)
+
+
+class TimestampGen(Gen):
+    def __init__(self, nullable=True):
+        super().__init__(T.TIMESTAMP, nullable)
+
+    def _values(self, n, rng):
+        return rng.integers(-2_000_000_000_000_000, 4_000_000_000_000_000,
+                            size=n).astype(np.int64)
+
+
+def gen_table(gens: Dict[str, Gen], n: int, seed: int = DEFAULT_SEED) -> HostTable:
+    rng = np.random.default_rng(seed)
+    names, cols = [], []
+    for name, g in gens.items():
+        names.append(name)
+        cols.append(g.generate(n, rng))
+    return HostTable(names, cols)
+
+
+#: the standard per-type matrix used across test files
+numeric_gens = [ByteGen(), ShortGen(), IntGen(), LongGen(), FloatGen(T.FLOAT), DoubleGen()]
+all_basic_gens = numeric_gens + [BooleanGen(), StringGen(), DateGen(), TimestampGen()]

@@ -1,0 +1,185 @@
+"""Project/filter/expression oracle tests (reference analog:
+integration_tests arithmetic_ops_test.py / cmp_test.py / conditionals_test.py)."""
+
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu import functions as F
+from spark_rapids_tpu.ops.expr import col, lit
+
+from tests.asserts import assert_tpu_and_cpu_are_equal, assert_runs_on_tpu
+from tests.data_gen import (
+    BooleanGen, ByteGen, DateGen, DoubleGen, FloatGen, IntGen, LongGen,
+    ShortGen, StringGen, TimestampGen, gen_table, numeric_gens,
+)
+
+
+def _df(sess, gens, n=500, seed=7, num_batches=1):
+    from spark_rapids_tpu.plan import from_host_table
+    return from_host_table(gen_table(gens, n, seed), sess, num_batches)
+
+
+@pytest.mark.parametrize("gen", numeric_gens, ids=lambda g: g.dtype.simple_string())
+def test_add_sub_mul(session, cpu_session, gen):
+    assert_tpu_and_cpu_are_equal(
+        lambda s: _df(s, {"a": gen, "b": gen}).select(
+            (col("a") + col("b")).alias("add"),
+            (col("a") - col("b")).alias("sub"),
+            (col("a") * col("b")).alias("mul"),
+        ),
+        session, cpu_session)
+
+
+@pytest.mark.parametrize("gen", numeric_gens, ids=lambda g: g.dtype.simple_string())
+def test_division(session, cpu_session, gen):
+    assert_tpu_and_cpu_are_equal(
+        lambda s: _df(s, {"a": gen, "b": gen}).select(
+            (col("a") / col("b")).alias("div"),
+            (col("a") % col("b")).alias("mod"),
+        ),
+        session, cpu_session, approximate_float=True)
+
+
+def test_integral_divide(session, cpu_session):
+    from spark_rapids_tpu.ops.arithmetic import IntegralDivide, Pmod
+    assert_tpu_and_cpu_are_equal(
+        lambda s: _df(s, {"a": LongGen(), "b": IntGen(min_val=-100, max_val=100)}).select(
+            IntegralDivide(col("a"), col("b")).alias("div"),
+            Pmod(col("a"), col("b").cast(T.LONG)).alias("pmod"),
+        ),
+        session, cpu_session)
+
+
+@pytest.mark.parametrize("gen", [IntGen(), LongGen(), DoubleGen(), StringGen(),
+                                 BooleanGen(), DateGen(), TimestampGen()],
+                         ids=lambda g: g.dtype.simple_string())
+def test_comparisons(session, cpu_session, gen):
+    assert_tpu_and_cpu_are_equal(
+        lambda s: _df(s, {"a": gen, "b": gen}).select(
+            (col("a") == col("b")).alias("eq"),
+            (col("a") < col("b")).alias("lt"),
+            (col("a") <= col("b")).alias("le"),
+            (col("a") > col("b")).alias("gt"),
+            (col("a") >= col("b")).alias("ge"),
+        ),
+        session, cpu_session)
+
+
+def test_boolean_logic_kleene(session, cpu_session):
+    assert_tpu_and_cpu_are_equal(
+        lambda s: _df(s, {"a": BooleanGen(), "b": BooleanGen()}).select(
+            (col("a") & col("b")).alias("and"),
+            (col("a") | col("b")).alias("or"),
+            (~col("a")).alias("not"),
+            col("a").isnull().alias("isnull"),
+            col("a").isnotnull().alias("isnotnull"),
+        ),
+        session, cpu_session)
+
+
+def test_filter_basic(session, cpu_session):
+    assert_tpu_and_cpu_are_equal(
+        lambda s: _df(s, {"a": IntGen(), "b": DoubleGen()})
+        .filter((col("a") > 0) & col("b").isnotnull()),
+        session, cpu_session)
+
+
+def test_filter_string(session, cpu_session):
+    assert_tpu_and_cpu_are_equal(
+        lambda s: _df(s, {"s": StringGen(cardinality=20)})
+        .filter(col("s") > lit("H")),
+        session, cpu_session)
+
+
+def test_conditionals(session, cpu_session):
+    assert_tpu_and_cpu_are_equal(
+        lambda s: _df(s, {"a": IntGen(), "b": IntGen(), "c": BooleanGen()}).select(
+            F.if_(col("c"), col("a"), col("b")).alias("iff"),
+            F.when(col("a") > 0, col("a")).when(col("b") > 0, col("b")).otherwise(lit(0)).alias("cw"),
+            F.coalesce(col("a"), col("b"), lit(-1)).alias("coal"),
+            F.greatest(col("a"), col("b")).alias("gr"),
+            F.least(col("a"), col("b")).alias("ls"),
+        ),
+        session, cpu_session)
+
+
+def test_conditionals_string(session, cpu_session):
+    assert_tpu_and_cpu_are_equal(
+        lambda s: _df(s, {"a": StringGen(cardinality=10), "b": StringGen(cardinality=10),
+                          "c": BooleanGen()}).select(
+            F.if_(col("c"), col("a"), col("b")).alias("iff"),
+            F.coalesce(col("a"), col("b")).alias("coal"),
+            F.greatest(col("a"), col("b")).alias("gr"),
+        ),
+        session, cpu_session)
+
+
+def test_in_expr(session, cpu_session):
+    assert_tpu_and_cpu_are_equal(
+        lambda s: _df(s, {"a": IntGen(min_val=0, max_val=10)}).select(
+            F.is_in(col("a"), 1, 3, 5).alias("in135"),
+            F.is_in(col("a"), 2, lit(None)).alias("in_null"),
+        ),
+        session, cpu_session)
+
+
+def test_math_unary(session, cpu_session):
+    assert_tpu_and_cpu_are_equal(
+        lambda s: _df(s, {"a": DoubleGen()}).select(
+            F.sqrt(F.abs(col("a"))).alias("sqrt"),
+            F.log(F.abs(col("a")) + 1).alias("log"),
+            F.exp(col("a") / lit(1e7)).alias("exp"),
+            F.floor(col("a")).alias("floor"),
+            F.ceil(col("a")).alias("ceil"),
+            F.signum(col("a")).alias("sign"),
+        ),
+        session, cpu_session, approximate_float=True)
+
+
+def test_casts_numeric(session, cpu_session):
+    assert_tpu_and_cpu_are_equal(
+        lambda s: _df(s, {"a": DoubleGen(), "b": LongGen(), "c": IntGen()}).select(
+            col("a").cast(T.INT).alias("d2i"),
+            col("a").cast(T.LONG).alias("d2l"),
+            col("a").cast(T.FLOAT).alias("d2f"),
+            col("b").cast(T.INT).alias("l2i"),
+            col("b").cast(T.DOUBLE).alias("l2d"),
+            col("c").cast(T.BYTE).alias("i2b"),
+            col("c").cast(T.BOOLEAN).alias("i2bool"),
+        ),
+        session, cpu_session)
+
+
+def test_whole_plan_on_tpu(session):
+    assert_runs_on_tpu(
+        lambda s: _df(s, {"a": IntGen(), "b": DoubleGen()})
+        .filter(col("a") > 0)
+        .select((col("a") * 2).alias("x"), col("b")),
+        session)
+
+
+def test_multi_batch(session, cpu_session):
+    assert_tpu_and_cpu_are_equal(
+        lambda s: _df(s, {"a": IntGen(), "s": StringGen(cardinality=8)}, n=1000, num_batches=4)
+        .filter(col("a") > 0).select(col("s"), (col("a") + 1).alias("a1")),
+        session, cpu_session)
+
+
+def test_range(session, cpu_session):
+    def build(s):
+        from spark_rapids_tpu.plan import range_df
+        return range_df(0, 1000, 3, session=s).select((col("id") * 2).alias("x"))
+    assert_tpu_and_cpu_are_equal(build, session, cpu_session)
+
+
+def test_limit(session, cpu_session):
+    def build(s):
+        from spark_rapids_tpu.plan import range_df
+        return range_df(0, 1000, session=s).limit(17)
+    assert_tpu_and_cpu_are_equal(build, session, cpu_session, ignore_order=False)
+
+
+def test_union(session, cpu_session):
+    assert_tpu_and_cpu_are_equal(
+        lambda s: _df(s, {"a": IntGen()}, seed=1).union(_df(s, {"a": IntGen()}, seed=2)),
+        session, cpu_session)

@@ -1,0 +1,41 @@
+"""Sort oracle tests (reference analog: sort_test.py)."""
+
+import pytest
+
+from spark_rapids_tpu.ops.expr import col
+from spark_rapids_tpu.plan.nodes import SortOrder
+
+from tests.asserts import assert_tpu_and_cpu_are_equal
+from tests.data_gen import DoubleGen, IntGen, LongGen, StringGen, TimestampGen, gen_table
+
+
+def _df(sess, gens, n=600, seed=3):
+    from spark_rapids_tpu.plan import from_host_table
+    return from_host_table(gen_table(gens, n, seed), sess)
+
+
+@pytest.mark.parametrize("gen", [IntGen(), LongGen(), DoubleGen(no_nans=True),
+                                 StringGen(cardinality=15), TimestampGen()],
+                         ids=lambda g: g.dtype.simple_string())
+@pytest.mark.parametrize("ascending", [True, False], ids=["asc", "desc"])
+def test_sort_single_key(session, cpu_session, gen, ascending):
+    assert_tpu_and_cpu_are_equal(
+        lambda s: _df(s, {"a": gen, "payload": IntGen(nullable=False)})
+        .sort(SortOrder(col("a"), ascending)),
+        session, cpu_session, ignore_order=False)
+
+
+def test_sort_multi_key(session, cpu_session):
+    assert_tpu_and_cpu_are_equal(
+        lambda s: _df(s, {"a": IntGen(min_val=0, max_val=5), "b": StringGen(cardinality=6),
+                          "p": LongGen()})
+        .sort(SortOrder(col("a"), True), SortOrder(col("b"), False)),
+        session, cpu_session, ignore_order=False)
+
+
+@pytest.mark.parametrize("nulls_first", [True, False])
+def test_sort_null_placement(session, cpu_session, nulls_first):
+    assert_tpu_and_cpu_are_equal(
+        lambda s: _df(s, {"a": IntGen(null_prob=0.3)})
+        .sort(SortOrder(col("a"), True, nulls_first)),
+        session, cpu_session, ignore_order=False)
