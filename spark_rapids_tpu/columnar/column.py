@@ -54,6 +54,7 @@ class HostColumn:
 
     @staticmethod
     def from_pylist(values, dtype: Optional[T.DataType] = None) -> "HostColumn":
+        import datetime as _dt
         if dtype is None:
             sample = next((v for v in values if v is not None), None)
             dtype = T.python_to_spark_type(sample) if sample is not None else T.NULL
@@ -63,7 +64,26 @@ class HostColumn:
         else:
             np_dtype = dtype.np_dtype
             fill = np.zeros((), dtype=np_dtype).item()
-            data = np.array([v if v is not None else fill for v in values], dtype=np_dtype)
+            conv = lambda v: v  # noqa: E731
+            if isinstance(dtype, T.DateType):
+                epoch = _dt.date(1970, 1, 1)
+
+                def conv(v):
+                    if isinstance(v, _dt.datetime):  # datetime subclasses date
+                        v = v.date()
+                    return (v - epoch).days if isinstance(v, _dt.date) else v
+            elif isinstance(dtype, T.TimestampType):
+                epoch_ts = _dt.datetime(1970, 1, 1, tzinfo=_dt.timezone.utc)
+
+                def conv(v):  # noqa: E731
+                    if isinstance(v, _dt.datetime):
+                        if v.tzinfo is None:
+                            v = v.replace(tzinfo=_dt.timezone.utc)
+                        delta = v - epoch_ts
+                        return delta.days * 86_400_000_000 + delta.seconds * 1_000_000 + delta.microseconds
+                    return v
+            data = np.array([conv(v) if v is not None else fill for v in values],
+                            dtype=np_dtype)
         return HostColumn(dtype, data, validity)
 
     @staticmethod
@@ -74,13 +94,24 @@ class HostColumn:
         return HostColumn(dtype, values, validity)
 
     def to_pylist(self):
+        import datetime as _dt
+        conv = None
+        if isinstance(self.dtype, T.DateType):
+            epoch = _dt.date(1970, 1, 1)
+            conv = lambda v: epoch + _dt.timedelta(days=int(v))  # noqa: E731
+        elif isinstance(self.dtype, T.TimestampType):
+            epoch_ts = _dt.datetime(1970, 1, 1)
+            conv = lambda v: epoch_ts + _dt.timedelta(microseconds=int(v))  # noqa: E731
         out = []
         for i in range(len(self)):
             if not self.validity[i]:
                 out.append(None)
             else:
                 v = self.data[i]
-                out.append(v.item() if isinstance(v, np.generic) else v)
+                if conv is not None:
+                    out.append(conv(v))
+                else:
+                    out.append(v.item() if isinstance(v, np.generic) else v)
         return out
 
     def slice(self, start: int, length: int) -> "HostColumn":

@@ -4,6 +4,7 @@ fused into single jitted XLA computations via ops/expr.py."""
 
 from spark_rapids_tpu.execs.base import TpuExec, HostToDevice, DeviceToHost, InputAdapter  # noqa: F401
 from spark_rapids_tpu.execs.basic import (  # noqa: F401
+    TpuFileScanExec,
     TpuScanExec,
     TpuRangeExec,
     TpuProjectExec,

@@ -46,6 +46,33 @@ class TpuScanExec(TpuExec):
         return f"TpuScan[{len(self.batches)} batches]"
 
 
+class TpuFileScanExec(TpuExec):
+    """File scan on device: the scan node's reader (with its PERFILE /
+    COALESCING / MULTITHREADED prefetch behavior) feeds decoded host batches
+    that upload to HBM here (reference: GpuFileSourceScanExec +
+    MultiFile*PartitionReader — decode output lands in device memory)."""
+
+    def __init__(self, scan_node):
+        super().__init__()
+        self.scan_node = scan_node
+
+    def output_schema(self):
+        return self.scan_node.output_schema()
+
+    def execute(self):
+        import time
+        for batch in self.scan_node.execute_cpu():
+            t0 = time.perf_counter()
+            dt = DeviceTable.from_host(batch)
+            self.add_metric("scanUploadTime", time.perf_counter() - t0)
+            self.add_metric("scanBatches", 1)
+            self.add_metric("scanRows", batch.num_rows)
+            yield dt
+
+    def describe(self):
+        return f"TpuFileScan[{self.scan_node.describe()}]"
+
+
 class TpuRangeExec(TpuExec):
     """Device-side range generation (reference: GpuRangeExec)."""
 

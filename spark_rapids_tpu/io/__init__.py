@@ -1,0 +1,48 @@
+"""File IO: scans and writers.
+
+Reference surface: SURVEY.md §2.4 — GpuParquetScan/GpuOrcScan/GpuCSVScan/
+GpuJsonScan three-mode readers (PERFILE / COALESCING / MULTITHREADED),
+GpuParquetFileFormat/GpuOrcFileFormat writers, GpuFileFormatDataWriter
+dynamic partitioning.
+
+TPU design: decode happens on the host via Arrow (the TPU has no
+general-purpose byte-wrangling path worth using for format decode; the
+bandwidth win comes from batching decoded columns into large device uploads),
+with the reference's prefetch/coalescing iterator architecture kept: the
+MULTITHREADED mode overlaps decode of file k+1..k+N with device compute on
+file k, and COALESCING stitches many small files into one large host buffer
+so each H2D transfer and each downstream XLA program runs at full batch size.
+"""
+
+from spark_rapids_tpu.io.arrow_convert import (
+    arrow_to_host_table,
+    host_table_to_arrow,
+    arrow_schema_to_spark,
+)
+from spark_rapids_tpu.io.common import FileScanNode, ReaderMode
+from spark_rapids_tpu.io.parquet import ParquetScanNode, write_parquet
+from spark_rapids_tpu.io.orc import OrcScanNode, write_orc
+from spark_rapids_tpu.io.csv import CsvScanNode, write_csv
+from spark_rapids_tpu.io.json import JsonScanNode, write_json
+
+from spark_rapids_tpu.overrides.rules import register_file_scan as _register
+
+for _cls in (ParquetScanNode, OrcScanNode, CsvScanNode, JsonScanNode):
+    _register(_cls)
+del _register, _cls
+
+__all__ = [
+    "arrow_to_host_table",
+    "host_table_to_arrow",
+    "arrow_schema_to_spark",
+    "FileScanNode",
+    "ReaderMode",
+    "ParquetScanNode",
+    "OrcScanNode",
+    "CsvScanNode",
+    "JsonScanNode",
+    "write_parquet",
+    "write_orc",
+    "write_csv",
+    "write_json",
+]

@@ -1,0 +1,288 @@
+"""Shared file-scan machinery: the three reader modes.
+
+Reference architecture (SURVEY.md §2.4, GpuMultiFileReader.scala):
+  PERFILE        — decode one file at a time, one batch per file.
+  COALESCING     — stitch many small files/row-groups into one large buffer
+                   and do a single decode+upload (MultiFileCoalescingPartition-
+                   ReaderBase analog). Best for many small files on fast storage.
+  MULTITHREADED  — a thread pool prefetches and decodes a bounded window of
+                   files ahead of the consumer so host decode overlaps device
+                   compute (MultiFileCloudPartitionReaderBase analog).
+  AUTO           — MULTITHREADED when more than one file, else PERFILE.
+
+The TPU engine decodes on host via Arrow and uploads decoded columns; the
+modes govern prefetch/stitching exactly as in the reference. Hive-style
+``key=value`` directory components are recovered as partition columns
+(GpuFileSourceScanExec partition-value reconstruction analog).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures as cf
+import glob as _glob
+import os
+from typing import Iterable, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar import HostColumn, HostTable
+from spark_rapids_tpu.conf import (
+    MULTITHREADED_READ_NUM_THREADS,
+    RapidsConf,
+    READER_COALESCE_TARGET_BYTES,
+)
+from spark_rapids_tpu.errors import ColumnarProcessingError
+from spark_rapids_tpu.plan.nodes import PlanNode, Schema
+
+
+class ReaderMode:
+    PERFILE = "PERFILE"
+    COALESCING = "COALESCING"
+    MULTITHREADED = "MULTITHREADED"
+    AUTO = "AUTO"
+
+
+def expand_paths(paths: Sequence[str]) -> List[str]:
+    """Expand globs and directories into a sorted file list."""
+    out: List[str] = []
+    for p in paths:
+        if any(ch in p for ch in "*?["):
+            out.extend(sorted(_glob.glob(p)))
+        elif os.path.isdir(p):
+            for root, _dirs, files in sorted(os.walk(p)):
+                for f in sorted(files):
+                    if not f.startswith(("_", ".")):
+                        out.append(os.path.join(root, f))
+        else:
+            out.append(p)
+    if not out:
+        raise ColumnarProcessingError(f"no input files for {list(paths)}")
+    return out
+
+
+HIVE_DEFAULT_PARTITION = "__HIVE_DEFAULT_PARTITION__"
+
+
+def _unescape_partition_value(s: str) -> Optional[str]:
+    if s == HIVE_DEFAULT_PARTITION:
+        return None
+    out, i = [], 0
+    while i < len(s):
+        if s[i] == "%" and i + 3 <= len(s):
+            try:
+                out.append(chr(int(s[i + 1:i + 3], 16)))
+                i += 3
+                continue
+            except ValueError:
+                pass
+        out.append(s[i])
+        i += 1
+    return "".join(out)
+
+
+def partition_spec_of(path: str) -> List[Tuple[str, Optional[str]]]:
+    """Extract ordered (key, value) pairs from Hive-style path components."""
+    spec = []
+    for comp in os.path.dirname(path).split(os.sep):
+        if "=" in comp and not comp.startswith("."):
+            k, _, v = comp.partition("=")
+            spec.append((k, _unescape_partition_value(v)))
+    return spec
+
+
+def _infer_partition_type(values: Iterable[Optional[str]]) -> T.DataType:
+    """Spark-style partition value type inference: long -> double -> string."""
+    saw_any = False
+    all_long = all_double = True
+    for v in values:
+        if v is None:
+            continue
+        saw_any = True
+        try:
+            int(v)
+        except ValueError:
+            all_long = False
+            try:
+                float(v)
+            except ValueError:
+                all_double = False
+    if not saw_any:
+        return T.STRING
+    if all_long:
+        return T.LONG
+    if all_double:
+        return T.DOUBLE
+    return T.STRING
+
+
+def coalesce_batches(batches: Iterable[HostTable], target_bytes: int
+                     ) -> Iterator[HostTable]:
+    """Accumulate host batches until the byte target, then concat — the one
+    shared stitching loop behind every COALESCING reader."""
+    pending: List[HostTable] = []
+    pending_bytes = 0
+    for t in batches:
+        pending.append(t)
+        pending_bytes += t.nbytes()
+        if pending_bytes >= target_bytes:
+            yield HostTable.concat(pending)
+            pending, pending_bytes = [], 0
+    if pending:
+        yield HostTable.concat(pending)
+
+
+class FileScanNode(PlanNode):
+    """Base scan node. Subclasses implement ``read_file`` (whole-file decode
+    to an Arrow table) and ``file_arrow_schema``; COALESCING may be refined
+    per-format (parquet splits at row-group granularity)."""
+
+    format_name = "file"
+
+    def __init__(self, paths: Sequence[str], conf: RapidsConf,
+                 columns: Optional[Sequence[str]] = None,
+                 reader_type: Optional[str] = None, **options):
+        self.paths = expand_paths(paths)
+        self.conf = conf
+        self.columns = list(columns) if columns else None
+        self.options = options
+        self.reader_type = (reader_type or self._conf_reader_type()).upper()
+        self._schema: Optional[Schema] = None
+        self._data_schema: Optional[Schema] = None
+        self._partition_schema: Optional[Schema] = None
+
+    # -- subclass surface ---------------------------------------------------
+    def _conf_reader_type(self) -> str:
+        return ReaderMode.AUTO
+
+    def file_schema(self, path: str) -> Schema:
+        raise NotImplementedError
+
+    def read_file(self, path: str) -> HostTable:
+        """Decode one file to its data columns (partition columns appended
+        by the driver loop)."""
+        raise NotImplementedError
+
+    # -- schema -------------------------------------------------------------
+    def _resolve_schemas(self):
+        if self._schema is not None:
+            return
+        data_schema = self.file_schema(self.paths[0])
+        data_names = {n for n, _ in data_schema}
+        # partition columns from Hive-style dirs, in first-seen key order
+        part_values: dict = {}
+        for p in self.paths:
+            for k, v in partition_spec_of(p):
+                if k not in data_names:
+                    part_values.setdefault(k, []).append(v)
+        part_schema = [(k, _infer_partition_type(vs))
+                       for k, vs in part_values.items()]
+        full = data_schema + part_schema
+        if self.columns is not None:
+            by_name = dict(full)
+            for c in self.columns:
+                if c not in by_name:
+                    raise ColumnarProcessingError(
+                        f"column {c!r} not in {[n for n, _ in full]}")
+            full = [(c, by_name[c]) for c in self.columns]
+            data_schema = [(n, dt) for n, dt in data_schema
+                           if n in set(self.columns)]
+            part_schema = [(n, dt) for n, dt in part_schema
+                           if n in set(self.columns)]
+        self._schema = full
+        self._data_schema = data_schema
+        self._partition_schema = part_schema
+
+    def output_schema(self) -> Schema:
+        self._resolve_schemas()
+        return self._schema
+
+    @property
+    def data_schema(self) -> Schema:
+        """Schema of columns read from file contents (post-pruning)."""
+        self._resolve_schemas()
+        return self._data_schema
+
+    def _with_partition_columns(self, table: HostTable, path: str) -> HostTable:
+        """Append recovered partition-value columns and order to the output
+        schema."""
+        self._resolve_schemas()
+        if not self._partition_schema:
+            return table
+        spec = dict(partition_spec_of(path))
+        n = table.num_rows
+        names = list(table.names)
+        cols = list(table.columns)
+        for name, dt in self._partition_schema:
+            raw = spec.get(name)
+            if raw is None:
+                validity = np.zeros(n, dtype=np.bool_)
+                if isinstance(dt, T.StringType):
+                    data = np.full(n, None, dtype=object)
+                else:
+                    data = np.zeros(n, dtype=dt.np_dtype)
+            else:
+                validity = np.ones(n, dtype=np.bool_)
+                if isinstance(dt, T.StringType):
+                    data = np.full(n, raw, dtype=object)
+                elif isinstance(dt, T.DoubleType):
+                    data = np.full(n, float(raw), dtype=np.float64)
+                else:
+                    data = np.full(n, int(raw), dtype=np.int64)
+            names.append(name)
+            cols.append(HostColumn(dt, data, validity))
+        by_name = dict(zip(names, cols))
+        out_names = [n for n, _ in self._schema]
+        return HostTable(out_names, [by_name[n] for n in out_names])
+
+    # -- PlanNode -----------------------------------------------------------
+    def execute_cpu(self) -> Iterator[HostTable]:
+        mode = self.reader_type
+        if mode == ReaderMode.AUTO:
+            mode = (ReaderMode.MULTITHREADED if len(self.paths) > 1
+                    else ReaderMode.PERFILE)
+        if mode == ReaderMode.PERFILE:
+            it = self._perfile()
+        elif mode == ReaderMode.COALESCING:
+            it = coalesce_batches(
+                self._coalescing_chunks(),
+                self.conf.get_entry(READER_COALESCE_TARGET_BYTES))
+        elif mode == ReaderMode.MULTITHREADED:
+            it = self._multithreaded()
+        else:
+            raise ColumnarProcessingError(f"unknown reader type {mode}")
+        yield from it
+
+    def _read_with_partitions(self, path: str) -> HostTable:
+        return self._with_partition_columns(self.read_file(path), path)
+
+    def _perfile(self) -> Iterator[HostTable]:
+        for p in self.paths:
+            yield self._read_with_partitions(p)
+
+    def _coalescing_chunks(self) -> Iterator[HostTable]:
+        """Chunk stream feeding the COALESCING stitcher. Default: whole
+        files; formats with sub-file granularity (parquet row groups, ORC
+        stripes) override."""
+        return self._perfile()
+
+    def _multithreaded(self) -> Iterator[HostTable]:
+        """Ordered prefetch with a bounded in-flight window: at most
+        ~2x pool-size files are decoded ahead of the consumer, so host
+        memory stays bounded and early iterator abandonment (limits) does
+        not decode the whole dataset."""
+        nthreads = max(1, self.conf.get_entry(MULTITHREADED_READ_NUM_THREADS))
+        window = min(len(self.paths), nthreads * 2)
+        with cf.ThreadPoolExecutor(max_workers=min(nthreads, len(self.paths))) as pool:
+            futures = {}
+            next_submit = 0
+            for i in range(len(self.paths)):
+                while next_submit < len(self.paths) and next_submit < i + window:
+                    futures[next_submit] = pool.submit(
+                        self._read_with_partitions, self.paths[next_submit])
+                    next_submit += 1
+                yield futures.pop(i).result()
+
+    def describe(self):
+        return (f"{type(self).__name__}[{len(self.paths)} files, "
+                f"{self.reader_type}]")

@@ -1,0 +1,81 @@
+"""CSV scan + writer (reference: GpuCSVScan.scala over
+GpuTextBasedPartitionReader — SURVEY.md §2.4: CPU line splitting + parse).
+
+The reference splits lines on CPU and parses on device; for the TPU build
+the Arrow CSV parser is the host decode and the parsed columns upload as one
+batch. Schema may be supplied (Spark-style) or inferred by Arrow."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import pyarrow as pa
+import pyarrow.csv as pcsv
+
+from spark_rapids_tpu.columnar import HostTable
+from spark_rapids_tpu.conf import RapidsConf, str_conf
+from spark_rapids_tpu.io.arrow_convert import (
+    arrow_schema_to_spark,
+    decode_to_schema,
+    host_table_to_arrow,
+    spark_type_to_arrow,
+)
+from spark_rapids_tpu.io.common import FileScanNode
+from spark_rapids_tpu.io.writer import write_partitioned
+from spark_rapids_tpu.plan.nodes import Schema
+
+CSV_READER_TYPE = str_conf(
+    "spark.rapids.sql.format.csv.reader.type", "AUTO",
+    "PERFILE, COALESCING, MULTITHREADED or AUTO.")
+
+
+class CsvScanNode(FileScanNode):
+    format_name = "csv"
+
+    def __init__(self, paths, conf: RapidsConf, columns=None, reader_type=None,
+                 schema: Optional[Schema] = None, header: bool = True,
+                 delimiter: str = ",", **options):
+        self.user_schema = schema
+        self.header = header
+        self.delimiter = delimiter
+        super().__init__(paths, conf, columns=columns, reader_type=reader_type,
+                         **options)
+
+    def _conf_reader_type(self) -> str:
+        return self.conf.get_entry(CSV_READER_TYPE)
+
+    def _read_opts(self):
+        read_opts = pcsv.ReadOptions()
+        if not self.header:
+            if not self.user_schema:
+                raise ValueError("headerless CSV requires an explicit schema")
+            read_opts = pcsv.ReadOptions(
+                column_names=[n for n, _ in self.user_schema])
+        parse_opts = pcsv.ParseOptions(delimiter=self.delimiter)
+        convert = None
+        if self.user_schema:
+            convert = pcsv.ConvertOptions(column_types={
+                n: spark_type_to_arrow(dt) for n, dt in self.user_schema})
+        return read_opts, parse_opts, convert
+
+    def file_schema(self, path: str) -> Schema:
+        if self.user_schema:
+            return list(self.user_schema)
+        return arrow_schema_to_spark(self._read_arrow(path).schema)
+
+    def _read_arrow(self, path: str) -> pa.Table:
+        read_opts, parse_opts, convert = self._read_opts()
+        return pcsv.read_csv(path, read_options=read_opts,
+                             parse_options=parse_opts, convert_options=convert)
+
+    def read_file(self, path: str) -> HostTable:
+        return decode_to_schema(self._read_arrow(path), self.data_schema)
+
+
+def write_csv(table: HostTable, path: str,
+              partition_by: Optional[Sequence[str]] = None,
+              header: bool = True) -> List[str]:
+    def _write_one(tbl: HostTable, file_path: str):
+        opts = pcsv.WriteOptions(include_header=header)
+        pcsv.write_csv(host_table_to_arrow(tbl), file_path, opts)
+    return write_partitioned(table, path, _write_one, "csv", partition_by)

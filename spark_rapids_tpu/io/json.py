@@ -1,0 +1,68 @@
+"""JSON-lines scan + writer (reference: GpuJsonScan.scala /
+GpuTextBasedPartitionReader — SURVEY.md §2.4)."""
+
+from __future__ import annotations
+
+import json as _json
+from typing import List, Optional, Sequence
+
+import pyarrow as pa
+import pyarrow.json as pjson
+
+from spark_rapids_tpu.columnar import HostTable
+from spark_rapids_tpu.conf import RapidsConf, str_conf
+from spark_rapids_tpu.io.arrow_convert import (
+    arrow_schema_to_spark,
+    decode_to_schema,
+    spark_type_to_arrow,
+)
+from spark_rapids_tpu.io.common import FileScanNode
+from spark_rapids_tpu.io.writer import write_partitioned
+from spark_rapids_tpu.plan.nodes import Schema
+
+JSON_READER_TYPE = str_conf(
+    "spark.rapids.sql.format.json.reader.type", "AUTO",
+    "PERFILE, COALESCING, MULTITHREADED or AUTO.")
+
+
+class JsonScanNode(FileScanNode):
+    format_name = "json"
+
+    def __init__(self, paths, conf: RapidsConf, columns=None, reader_type=None,
+                 schema: Optional[Schema] = None, **options):
+        self.user_schema = schema
+        super().__init__(paths, conf, columns=columns, reader_type=reader_type,
+                         **options)
+
+    def _conf_reader_type(self) -> str:
+        return self.conf.get_entry(JSON_READER_TYPE)
+
+    def _parse_opts(self):
+        if not self.user_schema:
+            return None
+        return pjson.ParseOptions(explicit_schema=pa.schema([
+            (n, spark_type_to_arrow(dt)) for n, dt in self.user_schema]))
+
+    def file_schema(self, path: str) -> Schema:
+        if self.user_schema:
+            return list(self.user_schema)
+        return arrow_schema_to_spark(
+            pjson.read_json(path, parse_options=self._parse_opts()).schema)
+
+    def read_file(self, path: str) -> HostTable:
+        return decode_to_schema(pjson.read_json(path, parse_options=self._parse_opts()),
+                                self.data_schema)
+
+
+def write_json(table: HostTable, path: str,
+               partition_by: Optional[Sequence[str]] = None) -> List[str]:
+    """JSON-lines writer (Arrow has no JSON writer; rows serialize via the
+    host columns directly)."""
+    def _write_one(tbl: HostTable, file_path: str):
+        cols = [c.to_pylist() for c in tbl.columns]
+        with open(file_path, "w") as f:
+            for i in range(tbl.num_rows):
+                row = {n: cols[j][i] for j, n in enumerate(tbl.names)
+                       if cols[j][i] is not None}
+                f.write(_json.dumps(row, default=str) + "\n")
+    return write_partitioned(table, path, _write_one, "json", partition_by)

@@ -1,0 +1,78 @@
+"""Parquet scan + writer.
+
+Reference: GpuParquetScan.scala (2,897 LoC; three reader modes, footer parse
+on CPU, predicate pushdown), GpuParquetFileFormat.scala writer — SURVEY.md
+§2.4. Here the footer parse / row-group pruning is pyarrow metadata; the
+COALESCING mode stitches at row-group granularity like
+MultiFileParquetPartitionReader (GpuParquetScan.scala:1867)."""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Sequence
+
+import pyarrow.parquet as pq
+
+from spark_rapids_tpu.columnar import HostTable
+from spark_rapids_tpu.conf import PARQUET_READER_TYPE, RapidsConf
+from spark_rapids_tpu.io.arrow_convert import arrow_schema_to_spark, decode_to_schema
+from spark_rapids_tpu.io.common import FileScanNode
+from spark_rapids_tpu.io.writer import write_partitioned
+from spark_rapids_tpu.plan.nodes import Schema
+
+
+class ParquetScanNode(FileScanNode):
+    format_name = "parquet"
+
+    def __init__(self, paths, conf: RapidsConf, columns=None, reader_type=None,
+                 filters=None, **options):
+        #: pyarrow-style predicate pushdown filters, e.g. [("x", ">", 3)]
+        self.filters = filters
+        super().__init__(paths, conf, columns=columns, reader_type=reader_type,
+                         **options)
+
+    def _conf_reader_type(self) -> str:
+        return self.conf.get_entry(PARQUET_READER_TYPE)
+
+    def file_schema(self, path: str) -> Schema:
+        return arrow_schema_to_spark(pq.read_schema(path))
+
+    def _file_columns(self) -> Optional[List[str]]:
+        if self.columns is None:
+            return None
+        data_names = {n for n, _ in self.data_schema}
+        return [c for c in self.columns if c in data_names]
+
+    def read_file(self, path: str) -> HostTable:
+        t = pq.read_table(path, columns=self._file_columns(),
+                          filters=self.filters)
+        return decode_to_schema(t, self.data_schema)
+
+    def _coalescing_chunks(self) -> Iterator[HostTable]:
+        """Row-group-granular chunks for the stitcher (one device upload per
+        stitched group). With pushdown filters the row-group fast path is
+        bypassed so filtering stays identical across reader modes."""
+        if self.filters is not None:
+            yield from self._perfile()
+            return
+        for path in self.paths:
+            f = pq.ParquetFile(path)
+            for rg in range(f.metadata.num_row_groups):
+                t = f.read_row_group(rg, columns=self._file_columns())
+                yield self._with_partition_columns(
+                    decode_to_schema(t, self.data_schema), path)
+
+
+def write_parquet(table: HostTable, path: str,
+                  partition_by: Optional[Sequence[str]] = None,
+                  compression: str = "snappy", row_group_rows: int = 1 << 20,
+                  ) -> List[str]:
+    """Write a HostTable as parquet file(s); returns written paths.
+
+    With ``partition_by``, writes Hive-style key=value directories via the
+    dynamic-partitioning writer (GpuFileFormatDataWriter analog)."""
+    def _write_one(tbl: HostTable, file_path: str):
+        from spark_rapids_tpu.io.arrow_convert import host_table_to_arrow
+        pq.write_table(host_table_to_arrow(tbl), file_path,
+                       compression=compression, row_group_size=row_group_rows)
+
+    return write_partitioned(table, path, _write_one, "parquet", partition_by)

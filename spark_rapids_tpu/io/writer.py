@@ -1,0 +1,80 @@
+"""Dynamic-partitioning columnar writer.
+
+Reference: GpuFileFormatDataWriter.scala — the dynamic partition writer splits
+each batch by the partition-key tuple and routes rows to per-partition files
+under Hive-style key=value/ directories; single-partition writes emit
+part-00000 files. SURVEY.md §2.3 (DataWritingCommandExec row)."""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from spark_rapids_tpu.columnar import HostColumn, HostTable
+from spark_rapids_tpu.errors import ColumnarProcessingError
+
+
+def _escape_partition_value(v) -> str:
+    if v is None:
+        return "__HIVE_DEFAULT_PARTITION__"
+    s = str(v)
+    out = []
+    for ch in s:
+        if ch in '\\/:*?"<>|\x7f' or ord(ch) < 32 or ch in "%=":
+            out.append("%{:02X}".format(ord(ch)))
+        else:
+            out.append(ch)
+    return "".join(out)
+
+
+def write_partitioned(table: HostTable, path: str,
+                      write_one: Callable[[HostTable, str], None],
+                      extension: str,
+                      partition_by: Optional[Sequence[str]] = None,
+                      ) -> List[str]:
+    """Route rows to files; returns the list of files written."""
+    os.makedirs(path, exist_ok=True)
+    written: List[str] = []
+    if not partition_by:
+        out = os.path.join(path, f"part-00000.{extension}")
+        write_one(table, out)
+        return [out]
+
+    for k in partition_by:
+        if k not in table.names:
+            raise ColumnarProcessingError(f"partition column {k!r} not in table")
+    data_names = [n for n in table.names if n not in partition_by]
+    key_cols = [table.column(k) for k in partition_by]
+    n = table.num_rows
+
+    # group rows by partition tuple (host-side; the device path partitions
+    # on device then routes per-partition slices here)
+    keys = []
+    for i in range(n):
+        keys.append(tuple(
+            None if not c.validity[i] else
+            (c.data[i].item() if isinstance(c.data[i], np.generic) else c.data[i])
+            for c in key_cols))
+    order = {}
+    for i, k in enumerate(keys):
+        order.setdefault(k, []).append(i)
+
+    file_idx = 0
+    for key_tuple, rows in order.items():
+        idx = np.asarray(rows, dtype=np.int64)
+        sub_cols = []
+        for name in data_names:
+            c = table.column(name)
+            sub_cols.append(HostColumn(c.dtype, c.data[idx], c.validity[idx]))
+        sub = HostTable(data_names, sub_cols)
+        part_dir = os.path.join(path, *[
+            f"{k}={_escape_partition_value(v)}"
+            for k, v in zip(partition_by, key_tuple)])
+        os.makedirs(part_dir, exist_ok=True)
+        out = os.path.join(part_dir, f"part-{file_idx:05d}.{extension}")
+        write_one(sub, out)
+        written.append(out)
+        file_idx += 1
+    return written

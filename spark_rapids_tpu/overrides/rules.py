@@ -16,6 +16,7 @@ from spark_rapids_tpu.execs import (
     TpuCoalesceExec,
     TpuExec,
     TpuExpandExec,
+    TpuFileScanExec,
     TpuFilterExec,
     TpuHashAggregateExec,
     TpuLimitExec,
@@ -211,6 +212,19 @@ def _convert_union(node: P.Union, children):
 
 def _convert_expand(node: P.Expand, children):
     return TpuExpandExec(children[0], node.projections, node.names)
+
+
+def _convert_file_scan(node, children):
+    return TpuFileScanExec(node)
+
+
+def register_file_scan(cls):
+    """Register a FileScanNode subclass with a kill switch. Called from
+    spark_rapids_tpu.io at ITS import time so the core engine never
+    hard-requires pyarrow (reference: per-format
+    spark.rapids.sql.format.<fmt>.* keys)."""
+    exec_rule(cls, _tag_scan, _convert_file_scan,
+              f"Enable {cls.format_name} scans on the accelerator.")
 
 
 exec_rule(P.LocalScan, _tag_scan, _convert_scan)

@@ -113,6 +113,27 @@ class DataFrame:
             return self.session.explain(self.plan)
         return self.plan.tree_string()
 
+    # -- writers (reference: GpuDataWritingCommandExec + format writers) ----
+    def write_parquet(self, path: str, partition_by=None, **options):
+        from spark_rapids_tpu.io.parquet import write_parquet
+        return write_parquet(self.collect_table(), path,
+                             partition_by=partition_by, **options)
+
+    def write_orc(self, path: str, partition_by=None, **options):
+        from spark_rapids_tpu.io.orc import write_orc
+        return write_orc(self.collect_table(), path,
+                         partition_by=partition_by, **options)
+
+    def write_csv(self, path: str, partition_by=None, **options):
+        from spark_rapids_tpu.io.csv import write_csv
+        return write_csv(self.collect_table(), path,
+                         partition_by=partition_by, **options)
+
+    def write_json(self, path: str, partition_by=None, **options):
+        from spark_rapids_tpu.io.json import write_json
+        return write_json(self.collect_table(), path,
+                          partition_by=partition_by, **options)
+
 
 class GroupedData:
     def __init__(self, df: DataFrame, keys: Sequence[Expression]):

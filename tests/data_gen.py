@@ -157,6 +157,38 @@ def gen_table(gens: Dict[str, Gen], n: int, seed: int = DEFAULT_SEED) -> HostTab
     return HostTable(names, cols)
 
 
+def gen_for_type(dt: T.DataType) -> Gen:
+    """Default generator for a Spark type."""
+    if isinstance(dt, T.BooleanType):
+        return BooleanGen()
+    if isinstance(dt, T.ByteType):
+        return ByteGen()
+    if isinstance(dt, T.ShortType):
+        return ShortGen()
+    if isinstance(dt, T.IntegerType):
+        return IntGen()
+    if isinstance(dt, T.LongType):
+        return LongGen()
+    if isinstance(dt, T.FloatType):
+        return FloatGen(T.FLOAT)
+    if isinstance(dt, T.DoubleType):
+        return DoubleGen()
+    if isinstance(dt, T.StringType):
+        return StringGen()
+    if isinstance(dt, T.DateType):
+        return DateGen()
+    if isinstance(dt, T.TimestampType):
+        return TimestampGen()
+    raise TypeError(f"no default generator for {dt}")
+
+
+def table_gen(schema: Dict[str, T.DataType], n: int,
+              seed: int = DEFAULT_SEED) -> HostTable:
+    """Generate a table from a {name: DataType} schema with default gens."""
+    return gen_table({name: gen_for_type(dt) for name, dt in schema.items()},
+                     n, seed=seed)
+
+
 #: the standard per-type matrix used across test files
 numeric_gens = [ByteGen(), ShortGen(), IntGen(), LongGen(), FloatGen(T.FLOAT), DoubleGen()]
 all_basic_gens = numeric_gens + [BooleanGen(), StringGen(), DateGen(), TimestampGen()]
