@@ -1,0 +1,431 @@
+"""TPU equi-join (reference: GpuShuffledHashJoinExec / GpuBroadcastHashJoin /
+GpuHashJoin.scala gather-map machinery + JoinGatherer — SURVEY.md §2.3).
+
+TPU-first design: hash tables are pointer-chasing and hostile to the VPU, so
+the join is SORT/SEARCH based with fully static shapes:
+
+  1. evaluate key expressions on both sides (fused projections);
+  2. dense-rank both sides' keys into ONE shared integer code space
+     (device ``lax.sort`` + adjacent-change cumsum — the XLA analog of
+     cuDF's build-side hash table); string keys are first remapped into the
+     union dictionary on host (dictionary-size work, not row-size);
+  3. sort the build side's codes, ``searchsorted`` each probe code for its
+     match range [lo, hi) — the GatherMap analog;
+  4. expand ranges into (left_idx, right_idx) gather maps with a cumsum
+     offset trick at a bucketed static output capacity (JoinGatherer
+     analog — one host sync per join for the output size);
+  5. gather both sides' columns; outer rows gather index -1 -> null row.
+
+Join types: inner, left, right (as swapped left), full, leftsemi, leftanti
+(compaction, no gather maps), cross. Residual non-equi conditions apply as a
+post-filter for inner/cross; outer-with-condition falls back (tagged).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar import DeviceColumn, DeviceTable, bucket_for
+from spark_rapids_tpu.errors import ColumnarProcessingError
+from spark_rapids_tpu.execs.base import TpuExec
+from spark_rapids_tpu.ops.expr import Expression, compile_project
+
+INT64_MAX = np.iinfo(np.int64).max
+
+#: (data, validity) pair for key columns
+DevVal = Tuple[jax.Array, jax.Array]
+
+
+def _comparable_bits(data, validity):
+    """Map key data to int64 values whose equality matches Spark key
+    equality: floats canonicalize -0.0 to 0.0 and all NaNs to one pattern
+    (NaN matches NaN in Spark join keys), then bitcast."""
+    if jnp.issubdtype(data.dtype, jnp.floating):
+        data = jnp.where(data == 0.0, jnp.zeros_like(data), data)
+        data = jnp.where(jnp.isnan(data), jnp.full_like(data, jnp.nan), data)
+        itype = jnp.int32 if data.dtype == jnp.float32 else jnp.int64
+        data = jax.lax.bitcast_convert_type(data, itype)
+    if data.dtype == jnp.bool_:
+        data = data.astype(jnp.int32)
+    return data.astype(jnp.int64), validity
+
+
+def _dense_rank(vals, valid):
+    """Dense ranks [0, nvalid) over valid entries; -1 for invalid. Sort +
+    adjacent-change cumsum + scatter-back — all static shapes."""
+    n = vals.shape[0]
+    operands = [(~valid).astype(jnp.int32), vals,
+                jnp.arange(n, dtype=jnp.int32)]
+    s_flag, s_vals, perm = jax.lax.sort(operands, num_keys=2)
+    s_valid = s_flag == 0
+    first = jnp.arange(n) == 0
+    changed = first | (s_vals != jnp.roll(s_vals, 1))
+    new_grp = changed & s_valid
+    rank_sorted = jnp.cumsum(new_grp.astype(jnp.int64)) - 1
+    rank_sorted = jnp.where(s_valid, rank_sorted, -1)
+    return jnp.zeros(n, dtype=jnp.int64).at[perm].set(rank_sorted)
+
+
+class JoinKernel:
+    """Jitted phases of one join shape; caches traces per capacity tuple."""
+
+    def __init__(self, n_keys: int):
+        self.n_keys = n_keys
+        self._probe_traces = {}
+        self._gather_traces = {}
+        self._aux_traces = {}  # _right_matched/_compact/_cross helper jits
+
+    # -- phase A: shared code space + probe ranges --------------------------
+    def probe(self, lkeys: List[DevVal], rkeys, nl_dev, nr_dev,
+              cap_l: int, cap_r: int):
+        tkey = (cap_l, cap_r,
+                tuple(str(k[0].dtype) for k in lkeys),
+                tuple(str(k[0].dtype) for k in rkeys))
+        fn = self._probe_traces.get(tkey)
+        if fn is None:
+            fn = jax.jit(self._build_probe(cap_l, cap_r))
+            self._probe_traces[tkey] = fn
+        return fn(tuple(lkeys), tuple(rkeys), nl_dev, nr_dev)
+
+    def _build_probe(self, cap_l: int, cap_r: int):
+        n_keys = self.n_keys
+
+        def probe(lkeys, rkeys, nl, nr):
+            n = cap_l + cap_r
+            live_l = jnp.arange(cap_l, dtype=jnp.int32) < nl
+            live_r = jnp.arange(cap_r, dtype=jnp.int32) < nr
+
+            valid_l = live_l
+            valid_r = live_r
+            for (ld, lv), (rd, rv) in zip(lkeys, rkeys):
+                valid_l = valid_l & lv
+                valid_r = valid_r & rv
+
+            combined = None
+            for (ld, lv), (rd, rv) in zip(lkeys, rkeys):
+                lbits, _ = _comparable_bits(ld, lv)
+                rbits, _ = _comparable_bits(rd, rv)
+                allv = jnp.concatenate([lbits, rbits])
+                allvalid = jnp.concatenate([valid_l, valid_r])
+                rank = _dense_rank(allv, allvalid)
+                if combined is None:
+                    combined = rank
+                else:
+                    # < n^2 always, then re-densified to < n
+                    combined = jnp.where(rank >= 0, combined * n + rank, -1)
+                    combined = _dense_rank(combined, allvalid & (combined >= 0))
+            l_codes = combined[:cap_l]
+            r_codes = combined[cap_l:]
+            l_codes = jnp.where(valid_l, l_codes, -1)
+
+            # sort build-side codes; invalid/dead rows park at +inf
+            r_sortable = jnp.where(valid_r, r_codes, INT64_MAX)
+            rs_codes, rs_perm = jax.lax.sort(
+                [r_sortable, jnp.arange(cap_r, dtype=jnp.int32)], num_keys=1)
+
+            lo = jnp.searchsorted(rs_codes, l_codes, side="left")
+            hi = jnp.searchsorted(rs_codes, l_codes, side="right")
+            counts = jnp.where(valid_l, hi - lo, 0).astype(jnp.int64)
+            total = jnp.sum(counts)
+            matched_l = counts > 0
+            return (lo.astype(jnp.int64), counts, total, matched_l,
+                    rs_perm, live_l, live_r)
+
+        return probe
+
+    # -- phase B: gather-map expansion --------------------------------------
+    def expand(self, kind: str, out_cap: int, cap_l: int, cap_r: int, args):
+        tkey = (kind, out_cap, cap_l, cap_r)
+        fn = self._gather_traces.get(tkey)
+        if fn is None:
+            fn = jax.jit(self._build_expand(kind, out_cap, cap_l))
+            self._gather_traces[tkey] = fn
+        return fn(*args)
+
+    @staticmethod
+    def _build_expand(kind: str, out_cap: int, cap_l: int):
+        def expand_inner(lo, counts, rs_perm, live_l):
+            """(li, ri, nout) for inner; counts pre-adjusted for left-outer."""
+            csum = jnp.cumsum(counts)
+            total = csum[-1] if counts.shape[0] else jnp.asarray(0, jnp.int64)
+            off = csum - counts  # exclusive prefix
+            j = jnp.arange(out_cap, dtype=jnp.int64)
+            i = jnp.searchsorted(csum, j, side="right")
+            i = jnp.clip(i, 0, cap_l - 1)
+            delta = j - off[i]
+            rpos = lo[i] + delta
+            rpos = jnp.clip(rpos, 0, rs_perm.shape[0] - 1)
+            ri = rs_perm[rpos].astype(jnp.int64)
+            out_live = j < total
+            li = jnp.where(out_live, i, 0)
+            ri = jnp.where(out_live, ri, 0)
+            return li, ri, total, out_live
+
+        if kind == "inner":
+            def f(lo, counts, rs_perm, live_l):
+                li, ri, total, out_live = expand_inner(lo, counts, rs_perm, live_l)
+                return li, ri, jnp.zeros(out_cap, jnp.bool_), jnp.zeros(out_cap, jnp.bool_), total
+            return f
+
+        if kind == "leftouter":
+            def f(lo, counts, rs_perm, live_l):
+                # unmatched live left rows emit exactly one null-right row
+                counts2 = jnp.where(live_l & (counts == 0), 1, counts)
+                li, ri, total, out_live = expand_inner(lo, counts2, rs_perm, live_l)
+                null_r = (counts[li] == 0) & out_live
+                ri = jnp.where(null_r, 0, ri)
+                return li, ri, jnp.zeros(out_cap, jnp.bool_), null_r, total
+            return f
+
+        if kind == "fullouter":
+            def f(lo, counts, rs_perm, live_l, r_unmatched):
+                counts2 = jnp.where(live_l & (counts == 0), 1, counts)
+                li, ri, total_l, out_live = expand_inner(lo, counts2, rs_perm, live_l)
+                null_r = (counts[li] == 0) & out_live
+                # append unmatched build rows with null left
+                extra_pos = jnp.cumsum(r_unmatched.astype(jnp.int64)) - 1
+                n_extra = jnp.sum(r_unmatched.astype(jnp.int64))
+                tgt = jnp.where(r_unmatched, total_l + extra_pos, out_cap)
+                ridx = jnp.arange(r_unmatched.shape[0], dtype=jnp.int64)
+                ri = ri.at[tgt].set(ridx, mode="drop")
+                li = li.at[tgt].set(0, mode="drop")
+                null_l = jnp.zeros(out_cap, jnp.bool_).at[tgt].set(True, mode="drop")
+                null_r = null_r & ~null_l
+                total = total_l + n_extra
+                return li, ri, null_l, null_r, total
+            return f
+
+        raise ColumnarProcessingError(f"expand kind {kind}")
+
+
+class _ColumnGather:
+    """Jitted column gather per (out_cap, schema shapes)."""
+
+    _traces = {}
+
+    @classmethod
+    def run(cls, table: DeviceTable, idx, null_mask, out_live, out_cap):
+        key = (out_cap, table.capacity, table.schema_key()[0])
+        fn = cls._traces.get(key)
+        if fn is None:
+            cap = table.capacity
+
+            def gather(datas, valids, idx, null_mask, out_live):
+                safe = jnp.clip(idx, 0, cap - 1)
+                out = []
+                for d, v in zip(datas, valids):
+                    out.append((d[safe], v[safe] & ~null_mask & out_live))
+                return out
+
+            fn = jax.jit(gather)
+            cls._traces[key] = fn
+        datas = tuple(c.data for c in table.columns)
+        valids = tuple(c.validity for c in table.columns)
+        outs = fn(datas, valids, idx, null_mask, out_live)
+        return [DeviceColumn(c.dtype, d, v, dictionary=c.dictionary,
+                             dict_sorted=c.dict_sorted)
+                for c, (d, v) in zip(table.columns, outs)]
+
+
+def _unify_string_keys(lcol: DeviceColumn, rcol: DeviceColumn):
+    """Remap two dictionary-coded string columns into the union dictionary
+    so codes compare across tables. Host work is O(dict size)."""
+    ldict = lcol.dictionary if lcol.dictionary is not None else np.array([], dtype=object)
+    rdict = rcol.dictionary if rcol.dictionary is not None else np.array([], dtype=object)
+    union = np.unique(np.concatenate([ldict.astype(object), rdict.astype(object)]))
+    lmap = np.searchsorted(union, ldict).astype(np.int32)
+    rmap = np.searchsorted(union, rdict).astype(np.int32)
+    lmap_d = jnp.asarray(lmap if len(lmap) else np.zeros(1, np.int32))
+    rmap_d = jnp.asarray(rmap if len(rmap) else np.zeros(1, np.int32))
+    lcodes = lmap_d[jnp.clip(lcol.data, 0, max(len(ldict) - 1, 0))]
+    rcodes = rmap_d[jnp.clip(rcol.data, 0, max(len(rdict) - 1, 0))]
+    return (lcodes, lcol.validity), (rcodes, rcol.validity)
+
+
+class TpuJoinExec(TpuExec):
+    def __init__(self, left: TpuExec, right: TpuExec, join_type: str,
+                 left_keys: Sequence[Expression], right_keys: Sequence[Expression],
+                 condition: Optional[Expression],
+                 left_schema, right_schema):
+        super().__init__()
+        self.children = (left, right)
+        self.join_type = join_type.lower().replace("_", "")
+        self.left_keys = list(left_keys)
+        self.right_keys = list(right_keys)
+        self.condition = condition
+        self.left_names = [n for n, _ in left_schema]
+        self.right_names = [n for n, _ in right_schema]
+        self._left_schema = left_schema
+        self._right_schema = right_schema
+        self._kernel = JoinKernel(len(self.left_keys))
+        self._filter_kernel = None
+
+    def output_schema(self):
+        jt = self.join_type
+        ls = list(self._left_schema)
+        rs = list(self._right_schema)
+        if jt in ("leftsemi", "leftanti"):
+            return ls
+        # outer sides become nullable but DataType carries no nullability here
+        return ls + rs
+
+    def describe(self):
+        return f"TpuJoin[{self.join_type}, keys={len(self.left_keys)}]"
+
+    # -----------------------------------------------------------------------
+    def execute(self):
+        lt = self._single(self.children[0])
+        rt = self._single(self.children[1])
+        out = self._join(lt, rt)
+        if self.condition is not None and self.join_type in ("inner", "cross"):
+            from spark_rapids_tpu.execs.basic import _FilterKernel
+            if self._filter_kernel is None:
+                self._filter_kernel = _FilterKernel(self.condition)
+            out = self._filter_kernel(out)
+        yield out
+
+    @staticmethod
+    def _single(child: TpuExec) -> DeviceTable:
+        batches = list(child.execute())
+        if len(batches) != 1:
+            raise ColumnarProcessingError("join requires coalesced single batches")
+        return batches[0]
+
+    def _join(self, lt: DeviceTable, rt: DeviceTable) -> DeviceTable:
+        jt = self.join_type
+        if jt == "cross":
+            return self._cross(lt, rt)
+
+        swapped = jt in ("right", "rightouter")
+        if swapped:
+            lt, rt = rt, lt
+            lkeys_e, rkeys_e = self.right_keys, self.left_keys
+        else:
+            lkeys_e, rkeys_e = self.left_keys, self.right_keys
+
+        lkey_cols = compile_project(lkeys_e, lt)
+        rkey_cols = compile_project(rkeys_e, rt)
+
+        lkeys, rkeys = [], []
+        for lc, rc in zip(lkey_cols, rkey_cols):
+            if isinstance(lc.dtype, T.StringType):
+                lk, rk = _unify_string_keys(lc, rc)
+            else:
+                lk, rk = (lc.data, lc.validity), (rc.data, rc.validity)
+            lkeys.append(lk)
+            rkeys.append(rk)
+
+        (lo, counts, total_d, matched_l, rs_perm, live_l, live_r) = \
+            self._kernel.probe(lkeys, rkeys, lt.nrows_dev, rt.nrows_dev,
+                               lt.capacity, rt.capacity)
+
+        if jt in ("leftsemi", "leftanti"):
+            keep = matched_l if jt == "leftsemi" else ~matched_l
+            return self._compact(lt, keep & live_l)
+
+        total = int(jax.device_get(total_d))  # the one host sync per join
+        nl = lt.num_rows
+        if jt in ("full", "fullouter", "outer"):
+            upper = total + nl + rt.num_rows  # + unmatched build rows
+        elif jt in ("left", "leftouter", "right", "rightouter"):
+            upper = total + nl  # each unmatched probe row adds at most one
+        else:
+            upper = total
+        out_cap = bucket_for(max(upper, 1))
+
+        if jt == "inner":
+            li, ri, null_l, null_r, nout = self._kernel.expand(
+                "inner", out_cap, lt.capacity, rt.capacity,
+                (lo, counts, rs_perm, live_l))
+        elif jt in ("left", "leftouter", "right", "rightouter"):
+            li, ri, null_l, null_r, nout = self._kernel.expand(
+                "leftouter", out_cap, lt.capacity, rt.capacity,
+                (lo, counts, rs_perm, live_l))
+        else:  # full outer
+            r_matched = self._right_matched(lo, counts, rs_perm, rt.capacity,
+                                            lt.capacity)
+            r_unmatched = live_r & ~r_matched
+            li, ri, null_l, null_r, nout = self._kernel.expand(
+                "fullouter", out_cap, lt.capacity, rt.capacity,
+                (lo, counts, rs_perm, live_l, r_unmatched))
+
+        out_live = jnp.arange(out_cap, dtype=jnp.int64) < nout
+        lcols = _ColumnGather.run(lt, li, null_l, out_live, out_cap)
+        rcols = _ColumnGather.run(rt, ri, null_r, out_live, out_cap)
+
+        names = self.left_names + self.right_names
+        cols = rcols + lcols if swapped else lcols + rcols
+        return DeviceTable(names, cols, nout, out_cap)
+
+    def _right_matched(self, lo, counts, rs_perm, cap_r: int, cap_l: int):
+        """Which build rows matched at least one probe row: mark sorted
+        positions [lo_i, lo_i+count_i) then scatter through rs_perm."""
+        key = ("rmatch", cap_l, cap_r)
+        fn = self._kernel._aux_traces.get(key)
+        if fn is None:
+            def rmatch(lo, counts, rs_perm):
+                # diff trick: +1 at lo, -1 at lo+count, prefix-sum > 0
+                marks = jnp.zeros(cap_r + 1, dtype=jnp.int64)
+                marks = marks.at[jnp.clip(lo, 0, cap_r)].add(
+                    jnp.where(counts > 0, 1, 0), mode="drop")
+                ends = jnp.clip(lo + counts, 0, cap_r)
+                marks = marks.at[ends].add(jnp.where(counts > 0, -1, 0), mode="drop")
+                covered_sorted = jnp.cumsum(marks[:-1]) > 0
+                return jnp.zeros(cap_r, jnp.bool_).at[rs_perm].set(covered_sorted)
+            fn = jax.jit(rmatch)
+            self._kernel._aux_traces[key] = fn
+        return fn(lo, counts, rs_perm)
+
+    def _compact(self, table: DeviceTable, keep) -> DeviceTable:
+        """Semi/anti: compact kept rows (static capacity, like the filter
+        kernel's scatter-to-cumsum compaction)."""
+        key = ("compact", table.capacity, table.schema_key()[0])
+        fn = self._kernel._aux_traces.get(key)
+        if fn is None:
+            cap = table.capacity
+
+            def compact(datas, valids, keep):
+                pos = jnp.cumsum(keep.astype(jnp.int32)) - 1
+                tgt = jnp.where(keep, pos, cap)
+                new_n = jnp.sum(keep.astype(jnp.int32))
+                outs = []
+                for d, v in zip(datas, valids):
+                    od = jnp.zeros_like(d).at[tgt].set(d, mode="drop")
+                    ov = jnp.zeros_like(v).at[tgt].set(v, mode="drop")
+                    outs.append((od, ov))
+                return outs, new_n
+
+            fn = jax.jit(compact)
+            self._kernel._aux_traces[key] = fn
+        datas = tuple(c.data for c in table.columns)
+        valids = tuple(c.validity for c in table.columns)
+        outs, new_n = fn(datas, valids, keep)
+        cols = [c.with_arrays(d, v) for c, (d, v) in zip(table.columns, outs)]
+        return DeviceTable(table.names, cols, new_n, table.capacity)
+
+    def _cross(self, lt: DeviceTable, rt: DeviceTable) -> DeviceTable:
+        nl, nr = lt.num_rows, rt.num_rows
+        out_cap = bucket_for(max(nl * nr, 1))
+        key = ("cross", out_cap, lt.capacity, rt.capacity)
+        fn = self._kernel._aux_traces.get(key)
+        if fn is None:
+            def cross_maps(nl_d, nr_d):
+                j = jnp.arange(out_cap, dtype=jnp.int64)
+                nr64 = jnp.maximum(nr_d.astype(jnp.int64), 1)
+                li = j // nr64
+                ri = j % nr64
+                out_live = j < nl_d.astype(jnp.int64) * nr_d.astype(jnp.int64)
+                return li, ri, out_live
+            fn = jax.jit(cross_maps)
+            self._kernel._aux_traces[key] = fn
+        li, ri, out_live = fn(lt.nrows_dev, rt.nrows_dev)
+        zero = jnp.zeros(out_cap, jnp.bool_)
+        lcols = _ColumnGather.run(lt, li, zero, out_live, out_cap)
+        rcols = _ColumnGather.run(rt, ri, zero, out_live, out_cap)
+        return DeviceTable(self.left_names + self.right_names, lcols + rcols,
+                           nl * nr, out_cap)

@@ -175,6 +175,49 @@ def _tag_expand(meta, conf):
             check_expr(e, conf, meta.reasons)
 
 
+_SUPPORTED_JOIN_TYPES = {"inner", "cross", "left", "leftouter", "right",
+                         "rightouter", "full", "fullouter", "outer",
+                         "leftsemi", "leftanti"}
+
+
+def _tag_join(meta, conf):
+    _check_output_schema(meta, conf)
+    node: P.Join = meta.node
+    jt = node.join_type.lower().replace("_", "")
+    if jt not in _SUPPORTED_JOIN_TYPES:
+        meta.reasons.append(f"join type {node.join_type} is not supported on TPU")
+        return
+    if len(node.left_keys) != len(node.right_keys):
+        meta.reasons.append(
+            f"join key count mismatch: {len(node.left_keys)} vs {len(node.right_keys)}")
+        return
+    if jt != "cross" and not node.left_keys:
+        meta.reasons.append(
+            "keyless (nested-loop) non-cross join is not supported on TPU")
+        return
+    for k in list(node.left_keys) + list(node.right_keys):
+        check_expr(k, conf, meta.reasons, "join key ")
+        dt = k.data_type
+        if not ORDERABLE.supports(dt):
+            meta.reasons.append(f"join key type {dt.simple_string()} not supported on TPU")
+    for lk, rk in zip(node.left_keys, node.right_keys):
+        try:
+            if lk.data_type != rk.data_type:
+                T.promote(lk.data_type, rk.data_type)
+        except TypeError:
+            meta.reasons.append(
+                f"join key types {lk.data_type} vs {rk.data_type} incompatible")
+    if node.condition is not None:
+        if jt not in ("inner", "cross"):
+            # AST-vs-post-filter split (reference: AstUtil) — non-equi
+            # conditions on outer/semi/anti change match semantics; post-
+            # filtering is only sound for inner/cross.
+            meta.reasons.append(
+                f"non-equi condition on {jt} join is not supported on TPU")
+        else:
+            check_expr(node.condition, conf, meta.reasons, "join condition ")
+
+
 def _convert_scan(node: P.LocalScan, children):
     return TpuScanExec(node.batches)
 
@@ -214,6 +257,27 @@ def _convert_expand(node: P.Expand, children):
     return TpuExpandExec(children[0], node.projections, node.names)
 
 
+def _convert_join(node: P.Join, children):
+    from spark_rapids_tpu.execs.join import TpuJoinExec
+    from spark_rapids_tpu.ops.cast import Cast
+
+    lkeys = list(node.left_keys)
+    rkeys = list(node.right_keys)
+    for i, (lk, rk) in enumerate(zip(lkeys, rkeys)):
+        if lk.data_type != rk.data_type:
+            target = T.promote(lk.data_type, rk.data_type)
+            if lk.data_type != target:
+                lkeys[i] = Cast(lk, target)
+            if rk.data_type != target:
+                rkeys[i] = Cast(rk, target)
+    left = TpuCoalesceExec(children[0], require_single=True)
+    right = TpuCoalesceExec(children[1], require_single=True)
+    return TpuJoinExec(left, right, node.join_type, lkeys, rkeys,
+                       node.condition,
+                       node.children[0].output_schema(),
+                       node.children[1].output_schema())
+
+
 def _convert_file_scan(node, children):
     return TpuFileScanExec(node)
 
@@ -236,8 +300,9 @@ exec_rule(P.Sort, _tag_sort, _convert_sort)
 exec_rule(P.Limit, _tag_simple, _convert_limit)
 exec_rule(P.Union, _tag_simple, _convert_union)
 exec_rule(P.Expand, _tag_expand, _convert_expand)
-# P.Join / P.Exchange intentionally unregistered yet -> CPU fallback with
-# reason; device joins + shuffle land next (SURVEY.md §7 phases 4-5).
+exec_rule(P.Join, _tag_join, _convert_join)
+# P.Exchange intentionally unregistered yet -> CPU fallback with reason;
+# device shuffle lands with the shuffle layer (SURVEY.md §7 phase 4).
 
 
 # ---------------------------------------------------------------------------

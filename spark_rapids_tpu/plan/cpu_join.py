@@ -105,7 +105,9 @@ def join_cpu(left: HostTable, right: HostTable, join_type: str,
     nl, nr = left.num_rows, right.num_rows
     jt = join_type.lower().replace("_", "")
 
-    if jt == "cross":
+    if jt == "cross" or not left_keys:
+        # keyless non-cross join = nested loop: all pairs are candidates and
+        # the condition decides matches (BroadcastNestedLoopJoin analog)
         li = np.repeat(np.arange(nl, dtype=np.int64), nr)
         ri = np.tile(np.arange(nr, dtype=np.int64), nl)
     else:

@@ -1,0 +1,176 @@
+"""Device join tests vs the CPU oracle (reference: integration_tests
+join_test.py matrix — SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar import HostTable
+from spark_rapids_tpu.ops.expr import col, lit
+from tests.asserts import assert_runs_on_tpu, assert_tpu_and_cpu_are_equal
+from tests.data_gen import (
+    BooleanGen,
+    DateGen,
+    DoubleGen,
+    IntGen,
+    LongGen,
+    StringGen,
+    gen_table,
+)
+
+ALL_JOIN_TYPES = ["inner", "left", "right", "full", "leftsemi", "leftanti"]
+
+
+def _join_inputs(key_gen, n_left=300, n_right=200, seed=11):
+    left = gen_table({"k": key_gen, "lv": LongGen()}, n_left, seed=seed)
+    right = gen_table({"k": key_gen, "rv": LongGen()}, n_right, seed=seed + 1)
+    return left, right
+
+
+def _build_join(left, right, how, on="k"):
+    def build(s):
+        ldf = s.create_dataframe(left)
+        rdf = s.create_dataframe(right)
+        return ldf.join(rdf, on=on, how=how)
+    return build
+
+
+@pytest.mark.parametrize("how", ALL_JOIN_TYPES)
+@pytest.mark.parametrize("keygen", [
+    IntGen(min_val=0, max_val=50),          # many matches
+    LongGen(),                               # mostly no matches
+    StringGen(cardinality=30),
+    DateGen(),
+    BooleanGen(),
+], ids=["int_dense", "long_sparse", "string", "date", "bool"])
+def test_join_types_and_keys(session, cpu_session, how, keygen):
+    left, right = _join_inputs(keygen)
+    assert_tpu_and_cpu_are_equal(_build_join(left, right, how),
+                                 session, cpu_session)
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "full"])
+def test_join_multi_key(session, cpu_session, how):
+    left = gen_table({"a": IntGen(min_val=0, max_val=10),
+                      "b": StringGen(cardinality=5), "lv": LongGen()}, 250, seed=3)
+    right = gen_table({"a": IntGen(min_val=0, max_val=10),
+                       "b": StringGen(cardinality=5), "rv": DoubleGen()}, 150, seed=4)
+    assert_tpu_and_cpu_are_equal(
+        _build_join(left, right, how, on=["a", "b"]), session, cpu_session,
+        approximate_float=True)
+
+
+def test_join_runs_on_tpu(session):
+    left, right = _join_inputs(IntGen(min_val=0, max_val=20))
+    assert_runs_on_tpu(_build_join(left, right, "inner"), session)
+
+
+def test_join_nan_keys_match(session, cpu_session):
+    """Spark join keys: NaN == NaN, -0.0 == 0.0."""
+    left = HostTable.from_pydict(
+        {"k": [float("nan"), 0.0, 1.5, None], "lv": [1, 2, 3, 4]},
+        dtypes={"k": T.DOUBLE})
+    right = HostTable.from_pydict(
+        {"k": [float("nan"), -0.0, 2.5, None], "rv": [10, 20, 30, 40]},
+        dtypes={"k": T.DOUBLE})
+    assert_tpu_and_cpu_are_equal(_build_join(left, right, "inner"),
+                                 session, cpu_session)
+    assert_tpu_and_cpu_are_equal(_build_join(left, right, "full"),
+                                 session, cpu_session)
+
+
+def test_join_null_keys_never_match(session, cpu_session):
+    left = HostTable.from_pydict({"k": [1, None, 3], "lv": [1, 2, 3]})
+    right = HostTable.from_pydict({"k": [None, 1, 3], "rv": [10, 20, 30]})
+    for how in ALL_JOIN_TYPES:
+        assert_tpu_and_cpu_are_equal(_build_join(left, right, how),
+                                     session, cpu_session)
+
+
+def test_join_type_promotion(session, cpu_session):
+    """INT keys join LONG keys through an implicit cast."""
+    left = HostTable.from_pydict({"k": [1, 2, 3], "lv": [1, 2, 3]},
+                                 dtypes={"k": T.INT, "lv": T.LONG})
+    right = HostTable.from_pydict({"k": [2, 3, 4], "rv": [20, 30, 40]},
+                                  dtypes={"k": T.LONG, "rv": T.LONG})
+
+    def build(s):
+        ldf = s.create_dataframe(left)
+        rdf = s.create_dataframe(right)
+        from spark_rapids_tpu.plan import nodes as P
+        return ldf._wrap(P.Join(ldf.plan, rdf.plan, "inner",
+                                [col("k")], [col("k")]))
+    assert_tpu_and_cpu_are_equal(build, session, cpu_session)
+
+
+def test_cross_join(session, cpu_session):
+    left = HostTable.from_pydict({"a": [1, 2, 3]})
+    right = HostTable.from_pydict({"b": ["x", "y"]})
+    assert_tpu_and_cpu_are_equal(
+        lambda s: s.create_dataframe(left).join(s.create_dataframe(right)),
+        session, cpu_session)
+
+
+def test_inner_join_with_condition(session, cpu_session):
+    left, right = _join_inputs(IntGen(min_val=0, max_val=10))
+
+    def build(s):
+        from spark_rapids_tpu.plan import nodes as P
+        ldf = s.create_dataframe(left)
+        rdf = s.create_dataframe(right)
+        cond = col("lv") < col("rv")
+        return ldf._wrap(P.Join(ldf.plan, rdf.plan, "inner",
+                                [col("k")], [col("k")], cond))
+    assert_tpu_and_cpu_are_equal(build, session, cpu_session)
+
+
+def test_outer_join_with_condition_falls_back(session, cpu_session):
+    left, right = _join_inputs(IntGen(min_val=0, max_val=10), 50, 50)
+
+    def build(s):
+        from spark_rapids_tpu.plan import nodes as P
+        ldf = s.create_dataframe(left)
+        rdf = s.create_dataframe(right)
+        cond = col("lv") < col("rv")
+        return ldf._wrap(P.Join(ldf.plan, rdf.plan, "left",
+                                [col("k")], [col("k")], cond))
+
+    from spark_rapids_tpu.overrides import wrap_plan
+    meta = wrap_plan(build(session).plan, session.conf)
+    assert not meta.can_run_on_tpu
+    assert any("non-equi condition" in r for r in meta.reasons)
+    # correctness still holds through the CPU fallback
+    assert_tpu_and_cpu_are_equal(build, session, cpu_session)
+
+
+def test_join_empty_sides(session, cpu_session):
+    empty = HostTable.from_pydict({"k": [], "lv": []},
+                                  dtypes={"k": T.INT, "lv": T.LONG})
+    data = HostTable.from_pydict({"k": [1, 2], "rv": [10, 20]},
+                                 dtypes={"k": T.INT, "rv": T.LONG})
+    for how in ALL_JOIN_TYPES:
+        assert_tpu_and_cpu_are_equal(_build_join(empty, data, how),
+                                     session, cpu_session)
+        assert_tpu_and_cpu_are_equal(_build_join(data, empty, how),
+                                     session, cpu_session)
+
+
+def test_join_then_aggregate(session, cpu_session):
+    """Joins compose with downstream device aggregation."""
+    from spark_rapids_tpu import functions as F
+    left, right = _join_inputs(IntGen(min_val=0, max_val=5, null_prob=0.0))
+
+    def build(s):
+        j = _build_join(left, right, "inner")(s)
+        return j.group_by("k").agg(F.count("rv").alias("c"),
+                                   F.sum("lv").alias("sl"))
+    assert_tpu_and_cpu_are_equal(build, session, cpu_session)
+
+
+def test_join_duplicate_build_keys(session, cpu_session):
+    """Multiple matches per probe row expand correctly."""
+    left = HostTable.from_pydict({"k": [1, 1, 2], "lv": [1, 2, 3]})
+    right = HostTable.from_pydict({"k": [1, 1, 1, 2, 2], "rv": [1, 2, 3, 4, 5]})
+    for how in ["inner", "left", "full"]:
+        assert_tpu_and_cpu_are_equal(_build_join(left, right, how),
+                                     session, cpu_session)
