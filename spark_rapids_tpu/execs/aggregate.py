@@ -71,12 +71,14 @@ class TpuHashAggregateExec(TpuExec):
         return out
 
     def execute(self):
+        from spark_rapids_tpu.runtime.retry import retry_block
         batches = list(self.children[0].execute())
         if len(batches) != 1:
-            from spark_rapids_tpu.execs.basic import TpuCoalesceExec
             raise ColumnarProcessingError(
                 "TpuHashAggregateExec requires a single coalesced batch")
-        yield self._aggregate(batches[0])
+        # spill-and-replay on OOM; split is unsound for a single-pass agg
+        # (reference escalates to sort-fallback merge — planned widening)
+        yield retry_block(lambda: self._aggregate(batches[0]))
 
     # -- core ---------------------------------------------------------------
     def _aggregate(self, table: DeviceTable) -> DeviceTable:

@@ -110,9 +110,15 @@ class TpuProjectExec(TpuExec):
         return [(n, e.data_type) for n, e in zip(self.names, self.exprs)]
 
     def execute(self):
+        from spark_rapids_tpu.runtime.retry import with_retry
+        exprs, names = self.exprs, self.names
+
+        def run(dt):
+            cols = compile_project(exprs, dt)
+            return DeviceTable(names, cols, dt.nrows_dev, dt.capacity)
+
         for batch in self.children[0].execute():
-            cols = compile_project(self.exprs, batch)
-            yield DeviceTable(self.names, cols, batch.nrows_dev, batch.capacity)
+            yield from with_retry(batch, run)
 
     def describe(self):
         return f"TpuProject{self.names}"
@@ -177,8 +183,9 @@ class TpuFilterExec(TpuExec):
         return self.children[0].output_schema()
 
     def execute(self):
+        from spark_rapids_tpu.runtime.retry import with_retry
         for batch in self.children[0].execute():
-            yield self._kernel(batch)
+            yield from with_retry(batch, self._kernel)
 
     def describe(self):
         return f"TpuFilter[{self.condition!r}]"
@@ -267,23 +274,34 @@ class TpuCoalesceExec(TpuExec):
         return self.children[0].output_schema()
 
     def execute(self):
-        pending: List[DeviceTable] = []
+        from spark_rapids_tpu.runtime.spill import BufferCatalog, SpillableBatch
+
+        catalog = BufferCatalog.get()
+        pending: List[SpillableBatch] = []
         pending_bytes = 0
         for batch in self.children[0].execute():
-            pending.append(batch)
             pending_bytes += batch.device_nbytes()
+            # buffered batches are spillable while more input streams in
+            # (reference: coalesce inputs are SpillableColumnarBatches)
+            pending.append(SpillableBatch(batch, catalog))
             if not self.require_single and pending_bytes >= self.target_bytes:
                 yield self._flush(pending)
                 pending, pending_bytes = [], 0
         if pending:
             yield self._flush(pending)
 
-    def _flush(self, batches: List[DeviceTable]) -> DeviceTable:
+    def _flush(self, batches) -> DeviceTable:
+        from spark_rapids_tpu.runtime.retry import retry_block
         if len(batches) == 1:
-            return batches[0]
+            sb = batches[0]
+            out = retry_block(sb.get)
+            sb.release()
+            return out
         self.add_metric("concatBatches", len(batches))
-        host = HostTable.concat([b.to_host() for b in batches])
-        return DeviceTable.from_host(host)
+        host = HostTable.concat([b.get_host() for b in batches])
+        for b in batches:
+            b.release()
+        return retry_block(lambda: DeviceTable.from_host(host))
 
     def describe(self):
         goal = "RequireSingleBatch" if self.require_single else f"TargetSize({self.target_bytes})"

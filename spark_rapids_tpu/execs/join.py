@@ -279,9 +279,10 @@ class TpuJoinExec(TpuExec):
 
     # -----------------------------------------------------------------------
     def execute(self):
+        from spark_rapids_tpu.runtime.retry import retry_block
         lt = self._single(self.children[0])
         rt = self._single(self.children[1])
-        out = self._join(lt, rt)
+        out = retry_block(lambda: self._join(lt, rt))
         if self.condition is not None and self.join_type in ("inner", "cross"):
             from spark_rapids_tpu.execs.basic import _FilterKernel
             if self._filter_kernel is None:

@@ -65,12 +65,12 @@ class TpuSortExec(TpuExec):
         return self.children[0].output_schema()
 
     def execute(self):
+        from spark_rapids_tpu.runtime.retry import retry_block
         batches = list(self.children[0].execute())
         if len(batches) > 1:
-            from spark_rapids_tpu.execs.basic import TpuCoalesceExec
             from spark_rapids_tpu.errors import ColumnarProcessingError
             raise ColumnarProcessingError("TpuSortExec requires a single coalesced batch")
-        yield self._sort(batches[0])
+        yield retry_block(lambda: self._sort(batches[0]))
 
     def _sort(self, table: DeviceTable) -> DeviceTable:
         pctx = PrepCtx(table)

@@ -1,3 +1,21 @@
 """Runtime layer: device manager, task semaphore, spill catalog, OOM retry
 (reference: GpuDeviceManager / GpuSemaphore / RapidsBufferCatalog /
 RmmRapidsRetryIterator — SURVEY.md §2.5)."""
+
+from spark_rapids_tpu.runtime.device_manager import TpuDeviceManager  # noqa: F401
+from spark_rapids_tpu.runtime.semaphore import TpuSemaphore, acquired  # noqa: F401
+from spark_rapids_tpu.runtime.spill import (  # noqa: F401
+    BufferCatalog,
+    SpillableBatch,
+    TIER_DEVICE,
+    TIER_DISK,
+    TIER_HOST,
+)
+from spark_rapids_tpu.runtime.retry import (  # noqa: F401
+    RMM_TPU,
+    is_device_oom,
+    retry_block,
+    split_device_table_in_half,
+    with_retry,
+    with_retry_no_split,
+)

@@ -1,0 +1,237 @@
+"""OOM retry framework.
+
+Reference (SURVEY.md §2.5): RmmRapidsRetryIterator.scala — withRetry /
+withRetryNoSplit / withRestoreOnRetry catch GpuRetryOOM / GpuSplitAndRetryOOM
+thrown by the RmmSpark per-thread state machine; on retry the thread spills
+and replays; on split-and-retry the input halves and both halves replay.
+OOM *injection* for tests = RmmSpark.forceRetryOOM.
+
+TPU mapping: a device OOM surfaces as an XlaRuntimeError with
+RESOURCE_EXHAUSTED from PJRT. The retry driver spills registered spillables
+through the BufferCatalog and replays the jitted computation; escalation
+splits the input batch in half by rows (sound for row-wise operators; ops
+with cross-row semantics use with_retry_no_split)."""
+
+from __future__ import annotations
+
+import contextvars
+import threading
+from typing import Callable, Iterator, List, Optional, Sequence, Union
+
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar import DeviceTable, bucket_for
+from spark_rapids_tpu.errors import (
+    FatalDeviceOOM,
+    RetryOOM,
+    SplitAndRetryOOM,
+)
+from spark_rapids_tpu.runtime.spill import BufferCatalog, SpillableBatch
+
+
+def is_device_oom(exc: BaseException) -> bool:
+    """True when an exception is (or wraps) a device allocation failure."""
+    if isinstance(exc, (RetryOOM, SplitAndRetryOOM)):
+        return True
+    name = type(exc).__name__
+    msg = str(exc)
+    return ("XlaRuntimeError" in name and
+            ("RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg
+             or "out of memory" in msg))
+
+
+class RetryStateMachine:
+    """Per-thread injected-OOM bookkeeping (RmmSpark thread state analog).
+
+    ``force_retry_oom(n)`` arms n RetryOOM throws at the next n retry
+    blocks on the calling thread; ``force_split_and_retry_oom(n)``
+    likewise for the escalation path."""
+
+    def __init__(self):
+        self._local = threading.local()
+
+    def _state(self):
+        st = getattr(self._local, "st", None)
+        if st is None:
+            st = {"retry": 0, "split": 0, "retry_count": 0, "split_count": 0}
+            self._local.st = st
+        return st
+
+    def force_retry_oom(self, num_ooms: int = 1):
+        self._state()["retry"] += num_ooms
+
+    def force_split_and_retry_oom(self, num_ooms: int = 1):
+        self._state()["split"] += num_ooms
+
+    def maybe_inject(self):
+        st = self._state()
+        if st["retry"] > 0:
+            st["retry"] -= 1
+            raise RetryOOM("injected RetryOOM (test)")
+        if st["split"] > 0:
+            st["split"] -= 1
+            raise SplitAndRetryOOM("injected SplitAndRetryOOM (test)")
+
+    def note_retry(self):
+        self._state()["retry_count"] += 1
+
+    def note_split(self):
+        self._state()["split_count"] += 1
+
+    @property
+    def retry_count(self) -> int:
+        return self._state()["retry_count"]
+
+    @property
+    def split_count(self) -> int:
+        return self._state()["split_count"]
+
+    def clear(self):
+        self._local.st = None
+
+
+RMM_TPU = RetryStateMachine()
+
+#: spark.rapids.memory.gpu.oomMaxRetries, set per-query by the session so
+#: every retry site (execs have no conf handle) honors the user's setting.
+MAX_RETRIES_VAR = contextvars.ContextVar("rapids_oom_max_retries", default=2)
+
+
+def split_device_table_in_half(dt: DeviceTable) -> List[DeviceTable]:
+    """Halve a batch by rows (splitSpillableInHalfByRows analog). Slicing
+    device arrays re-buckets each half to the smaller capacity."""
+    n = dt.num_rows
+    if n < 2:
+        raise FatalDeviceOOM(
+            f"cannot split a {n}-row batch further (GpuSplitAndRetryOOM at floor)")
+    first = n // 2
+    second = n - first
+    outs = []
+    for start, cnt in ((0, first), (first, second)):
+        cap = bucket_for(cnt)
+        cols = []
+        for c in dt.columns:
+            data = jnp.zeros(cap, dtype=c.data.dtype).at[:cnt].set(
+                c.data[start:start + cnt])
+            validity = jnp.zeros(cap, dtype=jnp.bool_).at[:cnt].set(
+                c.validity[start:start + cnt])
+            cols.append(c.with_arrays(data, validity))
+        outs.append(DeviceTable(dt.names, cols, cnt, cap))
+    return outs
+
+
+SpillableOrTable = Union[SpillableBatch, DeviceTable]
+
+
+def _as_spillable(x: SpillableOrTable, catalog: BufferCatalog) -> SpillableBatch:
+    if isinstance(x, SpillableBatch):
+        return x
+    return SpillableBatch(x, catalog)
+
+
+def with_retry(
+    inputs: Union[SpillableOrTable, Sequence[SpillableOrTable]],
+    fn: Callable[[DeviceTable], object],
+    *,
+    splittable: bool = True,
+    max_retries: Optional[int] = None,
+    catalog: Optional[BufferCatalog] = None,
+) -> Iterator[object]:
+    """Run ``fn`` over input batch(es), surviving device OOM.
+
+    Per attempt: injection hook fires first (tests), then fn runs; on OOM the
+    catalog spills and the SAME input replays (up to max_retries), after
+    which the input splits in half by rows and both halves replay
+    recursively (when ``splittable``). Results stream out as an iterator —
+    one result per final (possibly split) input batch.
+
+    The reference contract this mirrors: withRetry(spillable)(fn) —
+    RmmRapidsRetryIterator.scala:62; withRetryNoSplit :126."""
+    catalog = catalog or BufferCatalog.get()
+    if max_retries is None:
+        max_retries = MAX_RETRIES_VAR.get()
+    stack: List[SpillableBatch] = []
+    if isinstance(inputs, (SpillableBatch, DeviceTable)):
+        inputs = [inputs]
+    for x in reversed(list(inputs)):
+        stack.append(_as_spillable(x, catalog))
+
+    sb = None
+    try:
+        while stack:
+            sb = stack.pop()
+            attempts = 0
+            while True:
+                try:
+                    RMM_TPU.maybe_inject()
+                    with sb.pinned_batch() as dt:
+                        result = fn(dt)
+                    sb.release()
+                    sb = None
+                    yield result
+                    break
+                except Exception as exc:
+                    if isinstance(exc, SplitAndRetryOOM) or (
+                            is_device_oom(exc) and attempts >= max_retries):
+                        if not splittable:
+                            raise FatalDeviceOOM(
+                                "device OOM and operator cannot split its input"
+                            ) from exc
+                        RMM_TPU.note_split()
+                        catalog.synchronous_spill(1 << 62)
+                        with sb.pinned_batch() as dt:
+                            halves = split_device_table_in_half(dt)
+                        sb.release()
+                        sb = None
+                        for h in reversed(halves):
+                            stack.append(_as_spillable(h, catalog))
+                        break
+                    if is_device_oom(exc):
+                        attempts += 1
+                        RMM_TPU.note_retry()
+                        # free everything we can, then replay the same input
+                        catalog.synchronous_spill(1 << 62)
+                        continue
+                    raise
+    finally:
+        # abandonment (limit upstream), FatalDeviceOOM, or any error: drop
+        # every still-registered input so the catalog never leaks buffers
+        if sb is not None:
+            sb.release()
+        for pending in stack:
+            pending.release()
+
+
+def with_retry_no_split(
+    inputs: Union[SpillableOrTable, Sequence[SpillableOrTable]],
+    fn: Callable[[DeviceTable], object],
+    *,
+    max_retries: Optional[int] = None,
+    catalog: Optional[BufferCatalog] = None,
+) -> Iterator[object]:
+    return with_retry(inputs, fn, splittable=False, max_retries=max_retries,
+                      catalog=catalog)
+
+
+def retry_block(fn: Callable[[], object], *, max_retries: Optional[int] = None,
+                catalog: Optional[BufferCatalog] = None) -> object:
+    """Retry an arbitrary device computation that has no single input batch
+    (joins, merges): spill-and-replay only, no split escalation."""
+    catalog = catalog or BufferCatalog.get()
+    if max_retries is None:
+        max_retries = MAX_RETRIES_VAR.get()
+    attempts = 0
+    while True:
+        try:
+            RMM_TPU.maybe_inject()
+            return fn()
+        except Exception as exc:
+            if is_device_oom(exc) and attempts < max_retries:
+                attempts += 1
+                RMM_TPU.note_retry()
+                catalog.synchronous_spill(1 << 62)
+                continue
+            if is_device_oom(exc):
+                raise FatalDeviceOOM(
+                    f"device OOM persisted after {attempts} spill-retries") from exc
+            raise

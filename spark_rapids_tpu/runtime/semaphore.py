@@ -1,0 +1,113 @@
+"""Task semaphore limiting concurrent device users.
+
+Reference: GpuSemaphore.scala (SURVEY.md §2.5) — bounds how many tasks hold
+device residency at once (spark.rapids.sql.concurrentGpuTasks), tracks wait
+time, and can dump stacks when acquisition stalls. Here a "task" is a query
+thread; the semaphore gates entry to device execution so concurrent queries
+do not blow HBM."""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+import traceback
+from typing import Dict, Optional
+
+
+class TpuSemaphore:
+    _instance: Optional["TpuSemaphore"] = None
+    _instance_lock = threading.Lock()
+
+    def __init__(self, max_tasks: int, stall_dump_seconds: float = 60.0):
+        self.max_tasks = max_tasks
+        self.stall_dump_seconds = stall_dump_seconds
+        self._lock = threading.Condition()
+        self._holders: Dict[int, int] = {}  # thread id -> reentrant depth
+        self.total_wait_seconds = 0.0
+        self.acquire_count = 0
+
+    @classmethod
+    def initialize(cls, max_tasks: int) -> "TpuSemaphore":
+        with cls._instance_lock:
+            if cls._instance is None:
+                cls._instance = TpuSemaphore(max_tasks)
+            elif cls._instance.max_tasks != max_tasks:
+                # adjust the LIVE semaphore in place: holders/waiters carry
+                # over so the concurrency cap is never bypassed
+                inst = cls._instance
+                with inst._lock:
+                    inst.max_tasks = max_tasks
+                    inst._lock.notify_all()
+            return cls._instance
+
+    @classmethod
+    def current(cls) -> Optional["TpuSemaphore"]:
+        return cls._instance
+
+    def acquire_if_necessary(self, timeout: Optional[float] = None):
+        """Reentrant per thread (a task that already holds it proceeds)."""
+        tid = threading.get_ident()
+        t0 = time.perf_counter()
+        deadline = None if timeout is None else t0 + timeout
+        with self._lock:
+            if tid in self._holders:
+                self._holders[tid] += 1
+                return
+            dumped = False
+            while len(self._holders) >= self.max_tasks:
+                remaining = None if deadline is None else deadline - time.perf_counter()
+                if remaining is not None and remaining <= 0:
+                    raise TimeoutError(
+                        f"TpuSemaphore: {self.max_tasks} tasks already on device")
+                waited = time.perf_counter() - t0
+                if not dumped and waited > self.stall_dump_seconds:
+                    self._dump_stacks()
+                    dumped = True
+                self._lock.wait(timeout=min(remaining or 1.0, 1.0))
+            self._holders[tid] = 1
+            self.acquire_count += 1
+            self.total_wait_seconds += time.perf_counter() - t0
+
+    def release_if_held(self):
+        tid = threading.get_ident()
+        with self._lock:
+            depth = self._holders.get(tid)
+            if depth is None:
+                return
+            if depth > 1:
+                self._holders[tid] = depth - 1
+            else:
+                del self._holders[tid]
+                self._lock.notify_all()
+
+    def _dump_stacks(self):
+        """Deadlock diagnostics (reference: dumpStackTracesOnFailureToAcquire)."""
+        frames = sys._current_frames()
+        print("TpuSemaphore: stalled acquisition; holder stacks:", file=sys.stderr)
+        for tid in self._holders:
+            frame = frames.get(tid)
+            if frame:
+                traceback.print_stack(frame, file=sys.stderr)
+
+    @property
+    def holders(self) -> int:
+        with self._lock:
+            return len(self._holders)
+
+
+class acquired:
+    """Context manager: with acquired(sem): ... (no-op when sem is None)."""
+
+    def __init__(self, sem: Optional[TpuSemaphore]):
+        self.sem = sem
+
+    def __enter__(self):
+        if self.sem is not None:
+            self.sem.acquire_if_necessary()
+        return self.sem
+
+    def __exit__(self, *exc):
+        if self.sem is not None:
+            self.sem.release_if_held()
+        return False

@@ -1,0 +1,268 @@
+"""Spill framework: spillable batches + tiered buffer catalog.
+
+Reference (SURVEY.md §2.5): SpillableColumnarBatch.scala, RapidsBufferCatalog
+(DEVICE -> HOST -> DISK demotion chain, RapidsBufferCatalog.scala:638-677),
+RapidsDeviceMemoryStore / RapidsHostMemoryStore (bounded) / RapidsDiskStore,
+SpillPriorities.
+
+TPU mapping: a DeviceTable's XLA buffers free when the last reference drops,
+so "spilling" = copy to host numpy + drop the device reference. The catalog
+keeps every registered spillable in a priority order and demotes
+device->host->disk until a byte target is met. Host tier is bounded by
+spark.rapids.memory.host.spillStorageSize; overflow goes to disk files."""
+
+from __future__ import annotations
+
+import itertools
+import os
+import pickle
+import tempfile
+import threading
+import time
+from typing import Dict, List, Optional
+
+from spark_rapids_tpu.columnar import DeviceTable, HostTable
+from spark_rapids_tpu.errors import ColumnarProcessingError
+
+TIER_DEVICE = "DEVICE"
+TIER_HOST = "HOST"
+TIER_DISK = "DISK"
+
+# Spill priorities (reference: SpillPriorities.scala): lower value spills
+# first. Inputs buffered for later re-reads spill before actively-used ones.
+PRIORITY_INPUT = 0
+PRIORITY_SHUFFLE = 10
+PRIORITY_ACTIVE = 100
+
+
+class SpillableBatch:
+    """Handle that makes a batch spillable while not actively in use.
+
+    ``get()`` brings it back to device (unspill) and returns the DeviceTable;
+    ``release()`` unregisters it from the catalog."""
+
+    _ids = itertools.count()
+
+    def __init__(self, table: DeviceTable, catalog: "BufferCatalog",
+                 priority: int = PRIORITY_INPUT):
+        self.id = next(SpillableBatch._ids)
+        self.priority = priority
+        self.catalog = catalog
+        self._device: Optional[DeviceTable] = table
+        self._host: Optional[HostTable] = None
+        self._disk_path: Optional[str] = None
+        self._device_bytes = table.device_nbytes()
+        self._host_bytes = 0
+        self._lock = threading.RLock()
+        self._pinned = 0
+        self.last_touch = time.monotonic()
+        catalog.register(self)
+
+    # -- state --------------------------------------------------------------
+    @property
+    def tier(self) -> str:
+        with self._lock:
+            if self._device is not None:
+                return TIER_DEVICE
+            if self._host is not None:
+                return TIER_HOST
+            return TIER_DISK
+
+    @property
+    def device_bytes(self) -> int:
+        with self._lock:
+            return self._device_bytes if self._device is not None else 0
+
+    @property
+    def host_bytes(self) -> int:
+        with self._lock:
+            return self._host_bytes if self._host is not None else 0
+
+    # -- access -------------------------------------------------------------
+    def get(self) -> DeviceTable:
+        """Materialize on device (unspilling as needed) and touch LRU."""
+        with self._lock:
+            self.last_touch = time.monotonic()
+            if self._device is None:
+                host = self._ensure_host_locked()
+                self._device = DeviceTable.from_host(host)
+                self._device_bytes = self._device.device_nbytes()
+                self._host = None
+                self._host_bytes = 0
+                self.catalog.on_unspill(self)
+            return self._device
+
+    def get_host(self) -> HostTable:
+        """Materialize on host WITHOUT promoting to device (shuffle reads)."""
+        with self._lock:
+            if self._device is not None:
+                return self._device.to_host()
+            return self._ensure_host_locked()
+
+    def _ensure_host_locked(self) -> HostTable:
+        if self._host is None:
+            if self._disk_path is None:
+                raise ColumnarProcessingError("spillable batch lost all tiers")
+            with open(self._disk_path, "rb") as f:
+                self._host = pickle.load(f)
+            self._host_bytes = self._host.nbytes()
+            os.unlink(self._disk_path)
+            self._disk_path = None
+        return self._host
+
+    def pin(self):
+        """While pinned the catalog will not spill this batch (the reference
+        pins buffers during kernel use)."""
+        with self._lock:
+            self._pinned += 1
+
+    def unpin(self):
+        with self._lock:
+            self._pinned -= 1
+
+    @property
+    def pinned(self) -> bool:
+        with self._lock:
+            return self._pinned > 0
+
+    # -- demotion -----------------------------------------------------------
+    def spill_to_host(self) -> int:
+        """DEVICE -> HOST; returns device bytes freed."""
+        with self._lock:
+            if self._device is None or self._pinned:
+                return 0
+            freed = self._device_bytes
+            self._host = self._device.to_host()
+            self._host_bytes = self._host.nbytes()
+            self._device = None
+            self._device_bytes = 0
+            return freed
+
+    def spill_to_disk(self) -> int:
+        """HOST -> DISK; returns host bytes freed."""
+        with self._lock:
+            if self._host is None or self._pinned:
+                return 0
+            freed = self._host_bytes
+            fd, path = tempfile.mkstemp(prefix=f"rapids_spill_{self.id}_",
+                                        suffix=".bin",
+                                        dir=self.catalog.disk_dir)
+            with os.fdopen(fd, "wb") as f:
+                pickle.dump(self._host, f, protocol=pickle.HIGHEST_PROTOCOL)
+            self._disk_path = path
+            self._host = None
+            self._host_bytes = 0
+            return freed
+
+    def release(self):
+        with self._lock:
+            self.catalog.unregister(self)
+            self._device = None
+            self._host = None
+            if self._disk_path and os.path.exists(self._disk_path):
+                os.unlink(self._disk_path)
+            self._disk_path = None
+
+    # context-manager sugar: `with sb.pinned_batch() as dt:`
+    def pinned_batch(self):
+        sb = self
+
+        class _Pin:
+            def __enter__(self):
+                sb.pin()
+                return sb.get()
+
+            def __exit__(self, *exc):
+                sb.unpin()
+                return False
+
+        return _Pin()
+
+
+class BufferCatalog:
+    """Central registry of spillables across tiers (RapidsBufferCatalog
+    analog). synchronous_spill demotes lowest-priority / least-recently
+    used device buffers until the byte target frees."""
+
+    _instance: Optional["BufferCatalog"] = None
+
+    def __init__(self, host_limit_bytes: int = 2 << 30,
+                 disk_dir: Optional[str] = None):
+        self._lock = threading.RLock()
+        self._buffers: Dict[int, SpillableBatch] = {}
+        self.host_limit_bytes = host_limit_bytes
+        self.disk_dir = disk_dir
+        self.spill_device_count = 0
+        self.spill_disk_count = 0
+        self.device_spilled_bytes = 0
+        self.disk_spilled_bytes = 0
+
+    @classmethod
+    def get(cls) -> "BufferCatalog":
+        if cls._instance is None:
+            cls._instance = BufferCatalog()
+        return cls._instance
+
+    @classmethod
+    def reset(cls, host_limit_bytes: int = 2 << 30, disk_dir=None):
+        cls._instance = BufferCatalog(host_limit_bytes, disk_dir)
+        return cls._instance
+
+    def register(self, sb: SpillableBatch):
+        with self._lock:
+            self._buffers[sb.id] = sb
+
+    def unregister(self, sb: SpillableBatch):
+        with self._lock:
+            self._buffers.pop(sb.id, None)
+
+    def on_unspill(self, sb: SpillableBatch):
+        pass  # hook for accounting/metrics
+
+    # -- accounting ---------------------------------------------------------
+    def device_bytes(self) -> int:
+        with self._lock:
+            return sum(b.device_bytes for b in self._buffers.values())
+
+    def host_bytes(self) -> int:
+        with self._lock:
+            return sum(b.host_bytes for b in self._buffers.values())
+
+    def _spill_order(self) -> List[SpillableBatch]:
+        with self._lock:
+            bufs = [b for b in self._buffers.values()]
+        return sorted(bufs, key=lambda b: (b.priority, b.last_touch))
+
+    # -- the demotion chain -------------------------------------------------
+    def synchronous_spill(self, target_bytes: int) -> int:
+        """Free at least target_bytes of device memory by demoting
+        device->host (then host->disk if the host tier overflows). Returns
+        bytes actually freed (reference: synchronousSpill,
+        RapidsBufferCatalog.scala:592)."""
+        freed = 0
+        for sb in self._spill_order():
+            if freed >= target_bytes:
+                break
+            if sb.tier == TIER_DEVICE and not sb.pinned:
+                got = sb.spill_to_host()
+                if got:
+                    freed += got
+                    self.spill_device_count += 1
+                    self.device_spilled_bytes += got
+        self._enforce_host_limit()
+        return freed
+
+    def _enforce_host_limit(self):
+        if self.host_bytes() <= self.host_limit_bytes:
+            return
+        for sb in self._spill_order():
+            if sb.tier == TIER_HOST and not sb.pinned:
+                got = sb.spill_to_disk()
+                if got:
+                    self.spill_disk_count += 1
+                    self.disk_spilled_bytes += got
+            if self.host_bytes() <= self.host_limit_bytes:
+                break
+
+    def spill_all_device(self) -> int:
+        return self.synchronous_spill(1 << 62)

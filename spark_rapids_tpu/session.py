@@ -14,6 +14,20 @@ from spark_rapids_tpu.plan import DataFrame, from_host_table
 from spark_rapids_tpu.plan import nodes as P
 
 
+def _uses_device(executable) -> bool:
+    """Does a converted plan contain any device exec? (Transitions wrap
+    TpuExec trees in DeviceToHost; CPU nodes may hold them via InputAdapter.)"""
+    from spark_rapids_tpu.execs.base import DeviceToHost, InputAdapter, TpuExec
+    if isinstance(executable, (DeviceToHost, TpuExec)):
+        return True
+    if isinstance(executable, InputAdapter):
+        return _uses_device(executable.source)
+    for c in getattr(executable, "children", ()):
+        if _uses_device(c):
+            return True
+    return False
+
+
 class TpuSession:
     def __init__(self, conf: Optional[Dict] = None):
         self.conf = RapidsConf(conf)
@@ -66,10 +80,34 @@ class TpuSession:
 
     # -- execution ----------------------------------------------------------
     def execute(self, plan: P.PlanNode) -> HostTable:
+        from spark_rapids_tpu.conf import RETRY_OOM_MAX_RETRIES, TEST_INJECT_RETRY_OOM
+        from spark_rapids_tpu.runtime import RMM_TPU, TpuSemaphore, acquired
+        from spark_rapids_tpu.runtime.retry import MAX_RETRIES_VAR
+
         executable, meta = apply_overrides(plan, self.conf)
         if meta is not None and self.conf.explain_mode in ("NOT_ON_GPU", "ALL"):
             print(meta.explain(only_fallback=self.conf.explain_mode == "NOT_ON_GPU"))
-        batches = list(executable.execute_cpu())
+
+        inject = str(self.conf.get_entry(TEST_INJECT_RETRY_OOM) or "")
+        if inject:
+            kind, _, num = inject.partition(":")
+            count = int(num) if num else 1
+            if kind.strip().lower() == "retry":
+                RMM_TPU.force_retry_oom(count)
+            elif kind.strip().lower() == "split":
+                RMM_TPU.force_split_and_retry_oom(count)
+
+        # the semaphore gates DEVICE residency: fully-fallen-back plans
+        # must not consume a device-concurrency slot
+        sem = None
+        if _uses_device(executable):
+            sem = TpuSemaphore.initialize(self.conf.concurrent_tpu_tasks)
+        token = MAX_RETRIES_VAR.set(self.conf.get_entry(RETRY_OOM_MAX_RETRIES))
+        try:
+            with acquired(sem):
+                batches = list(executable.execute_cpu())
+        finally:
+            MAX_RETRIES_VAR.reset(token)
         if not batches:
             from spark_rapids_tpu.plan.nodes import _empty_table
             return _empty_table(plan.output_schema())

@@ -1,0 +1,281 @@
+"""Memory runtime tests: spill tiers, OOM retry/split, semaphore.
+
+Mirrors the reference's retry suites (SURVEY.md §4: WithRetrySuite,
+HashAggregateRetrySuite, GpuSortRetrySuite, GpuCoalesceBatchesRetrySuite,
+RapidsBufferCatalogSuite, RapidsHostMemoryStoreSuite, RapidsDiskStoreSuite)
+with injected OOMs instead of real allocator pressure."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar import DeviceTable, HostTable
+from spark_rapids_tpu.errors import FatalDeviceOOM, RetryOOM
+from spark_rapids_tpu.runtime.retry import (
+    RMM_TPU,
+    retry_block,
+    split_device_table_in_half,
+    with_retry,
+    with_retry_no_split,
+)
+from spark_rapids_tpu.runtime.semaphore import TpuSemaphore, acquired
+from spark_rapids_tpu.runtime.spill import (
+    TIER_DEVICE,
+    TIER_DISK,
+    TIER_HOST,
+    BufferCatalog,
+    SpillableBatch,
+)
+from tests.data_gen import IntGen, LongGen, StringGen, gen_table
+
+
+@pytest.fixture(autouse=True)
+def clean_state():
+    RMM_TPU.clear()
+    yield
+    RMM_TPU.clear()
+
+
+@pytest.fixture()
+def catalog():
+    return BufferCatalog(host_limit_bytes=1 << 20)
+
+
+def _dev_table(n=500, seed=1):
+    host = gen_table({"a": IntGen(), "b": LongGen(), "s": StringGen()}, n, seed=seed)
+    return DeviceTable.from_host(host), host
+
+
+# -- spill framework --------------------------------------------------------
+
+def test_spill_device_host_disk_roundtrip(catalog):
+    dt, host = _dev_table()
+    sb = SpillableBatch(dt, catalog)
+    del dt
+    assert sb.tier == TIER_DEVICE
+    assert catalog.device_bytes() > 0
+
+    freed = sb.spill_to_host()
+    assert freed > 0 and sb.tier == TIER_HOST
+    assert catalog.device_bytes() == 0
+
+    freed2 = sb.spill_to_disk()
+    assert freed2 > 0 and sb.tier == TIER_DISK
+    assert catalog.host_bytes() == 0
+
+    back = sb.get()  # disk -> device
+    assert sb.tier == TIER_DEVICE
+    assert back.to_host().to_pydict() == host.to_pydict()
+    sb.release()
+
+
+def test_synchronous_spill_frees_by_priority(catalog):
+    tables = [_dev_table(200, seed=i)[0] for i in range(4)]
+    sbs = [SpillableBatch(t, catalog, priority=i) for i, t in enumerate(tables)]
+    del tables
+    target = sbs[0].device_bytes + 1
+    catalog.synchronous_spill(target)
+    # lowest priority spilled first
+    assert sbs[0].tier == TIER_HOST
+    assert sbs[3].tier == TIER_DEVICE
+    for sb in sbs:
+        sb.release()
+
+
+def test_pinned_batches_do_not_spill(catalog):
+    dt, _ = _dev_table(100)
+    sb = SpillableBatch(dt, catalog)
+    sb.pin()
+    assert catalog.synchronous_spill(1 << 62) == 0
+    assert sb.tier == TIER_DEVICE
+    sb.unpin()
+    assert catalog.synchronous_spill(1 << 62) > 0
+    assert sb.tier == TIER_HOST
+    sb.release()
+
+
+def test_host_limit_overflows_to_disk():
+    catalog = BufferCatalog(host_limit_bytes=1)  # everything overflows
+    dt, _ = _dev_table(300)
+    sb = SpillableBatch(dt, catalog)
+    del dt
+    catalog.synchronous_spill(1 << 62)
+    assert sb.tier == TIER_DISK
+    assert catalog.spill_disk_count == 1
+    sb.release()
+
+
+# -- split ------------------------------------------------------------------
+
+def test_split_in_half_preserves_rows():
+    dt, host = _dev_table(333)
+    a, b = split_device_table_in_half(dt)
+    assert a.num_rows + b.num_rows == 333
+    merged = HostTable.concat([a.to_host(), b.to_host()])
+    assert merged.to_pydict() == host.to_pydict()
+
+
+def test_split_single_row_raises():
+    dt, _ = _dev_table(1)
+    with pytest.raises(FatalDeviceOOM):
+        split_device_table_in_half(dt)
+
+
+# -- with_retry -------------------------------------------------------------
+
+def test_with_retry_replays_same_input(catalog):
+    dt, host = _dev_table(100)
+    RMM_TPU.force_retry_oom(2)
+    calls = []
+
+    def fn(t):
+        calls.append(t.num_rows)
+        return t.to_host().to_pydict()
+
+    outs = list(with_retry(dt, fn, catalog=catalog))
+    assert len(outs) == 1 and outs[0] == host.to_pydict()
+    assert RMM_TPU.retry_count == 2
+
+
+def test_with_retry_split_escalation(catalog):
+    dt, host = _dev_table(100)
+    RMM_TPU.force_split_and_retry_oom(1)
+    outs = list(with_retry(dt, lambda t: t.to_host(), catalog=catalog))
+    assert len(outs) == 2  # halves
+    assert HostTable.concat(outs).to_pydict() == host.to_pydict()
+    assert RMM_TPU.split_count == 1
+
+
+def test_with_retry_exhaustion_splits_after_max_retries(catalog):
+    dt, _ = _dev_table(64)
+
+    class FakeOOM(Exception):
+        pass
+
+    FakeOOM.__name__ = "XlaRuntimeError"
+    fails = {"n": 3}
+
+    def fn(t):
+        if fails["n"] > 0:
+            fails["n"] -= 1
+            raise FakeOOM("RESOURCE_EXHAUSTED: out of memory")
+        return t.num_rows
+
+    outs = list(with_retry(dt, fn, max_retries=2, catalog=catalog))
+    assert sum(outs) == 64 and len(outs) == 2  # split happened once
+
+
+def test_with_retry_no_split_raises_fatal(catalog):
+    dt, _ = _dev_table(64)
+    RMM_TPU.force_split_and_retry_oom(1)
+    with pytest.raises(FatalDeviceOOM):
+        list(with_retry_no_split(dt, lambda t: t, catalog=catalog))
+
+
+def test_with_retry_passes_through_other_errors(catalog):
+    dt, _ = _dev_table(16)
+    with pytest.raises(ValueError):
+        list(with_retry(dt, lambda t: (_ for _ in ()).throw(ValueError("x")),
+                        catalog=catalog))
+
+
+def test_retry_block_spills_then_succeeds(catalog):
+    other, _ = _dev_table(512, seed=9)
+    sb = SpillableBatch(other, catalog)
+    del other
+    RMM_TPU.force_retry_oom(1)
+    out = retry_block(lambda: 42, catalog=catalog)
+    assert out == 42
+    assert sb.tier == TIER_HOST  # the retry spilled registered buffers
+    sb.release()
+
+
+# -- operator integration (injection through the conf) ----------------------
+
+@pytest.mark.parametrize("inject", ["retry:2", "split:1"])
+def test_query_survives_injected_oom(session, cpu_session, inject):
+    from spark_rapids_tpu import functions as F
+    from spark_rapids_tpu.ops.expr import col
+    from spark_rapids_tpu.session import TpuSession
+
+    host = gen_table({"k": IntGen(min_val=0, max_val=9), "v": LongGen()}, 2000, seed=5)
+    inj_session = TpuSession({"spark.rapids.sql.test.injectRetryOOM": inject})
+
+    def build(s):
+        return (s.create_dataframe(host, num_batches=3)
+                .filter(col("v").isnotnull())
+                .group_by("k").agg(F.sum("v").alias("sv"),
+                                   F.count("v").alias("c")))
+
+    got = sorted(map(str, build(inj_session).collect()))
+    want = sorted(map(str, build(cpu_session).collect()))
+    assert got == want
+
+
+def test_join_survives_injected_oom(cpu_session):
+    from spark_rapids_tpu.session import TpuSession
+    host_l = gen_table({"k": IntGen(min_val=0, max_val=20), "lv": LongGen()}, 300, seed=1)
+    host_r = gen_table({"k": IntGen(min_val=0, max_val=20), "rv": LongGen()}, 200, seed=2)
+    inj = TpuSession({"spark.rapids.sql.test.injectRetryOOM": "retry:1"})
+
+    def build(s):
+        return s.create_dataframe(host_l).join(s.create_dataframe(host_r),
+                                               on="k", how="inner")
+    got = sorted(map(str, build(inj).collect()))
+    want = sorted(map(str, build(cpu_session).collect()))
+    assert got == want
+
+
+# -- semaphore --------------------------------------------------------------
+
+def test_semaphore_limits_concurrency():
+    sem = TpuSemaphore(2)
+    active = []
+    peak = []
+    lock = threading.Lock()
+
+    def work(i):
+        with acquired(sem):
+            with lock:
+                active.append(i)
+                peak.append(len(active))
+            import time
+            time.sleep(0.02)
+            with lock:
+                active.remove(i)
+
+    threads = [threading.Thread(target=work, args=(i,)) for i in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert max(peak) <= 2
+    assert sem.acquire_count == 6
+
+
+def test_semaphore_reentrant():
+    sem = TpuSemaphore(1)
+    with acquired(sem):
+        with acquired(sem):  # same thread re-enters
+            assert sem.holders == 1
+    assert sem.holders == 0
+
+
+def test_semaphore_timeout():
+    sem = TpuSemaphore(1)
+    sem.acquire_if_necessary()
+    err = []
+
+    def blocked():
+        try:
+            sem.acquire_if_necessary(timeout=0.05)
+        except TimeoutError as e:
+            err.append(e)
+
+    t = threading.Thread(target=blocked)
+    t.start()
+    t.join()
+    assert err
+    sem.release_if_held()
