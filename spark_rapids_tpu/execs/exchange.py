@@ -1,0 +1,107 @@
+"""Shuffle exchange exec.
+
+Reference (SURVEY.md §3.4): GpuShuffleExchangeExecBase — device partition
+split (GpuPartitioning.sliceInternalOnGpuAndClose), serialized write through
+the shuffle manager, then the read side's GpuShuffleCoalesceExec concats a
+reduce partition's serialized tables ON HOST to the target size before one
+device upload (GpuShuffleCoalesceExec.scala:43-229).
+
+The exec yields one device batch per (non-empty) reduce partition."""
+
+from __future__ import annotations
+
+from time import perf_counter
+from typing import List, Sequence
+
+from spark_rapids_tpu.columnar import DeviceTable, HostTable
+from spark_rapids_tpu.conf import RapidsConf
+from spark_rapids_tpu.errors import ColumnarProcessingError
+from spark_rapids_tpu.execs.base import TpuExec
+from spark_rapids_tpu.ops.expr import Expression
+from spark_rapids_tpu.shuffle.manager import get_shuffle_manager
+from spark_rapids_tpu.shuffle.partitioning import (
+    HashPartitioner,
+    Partitioner,
+    RangePartitioner,
+    RoundRobinPartitioner,
+    SinglePartitioner,
+    split_by_partition,
+)
+
+
+def make_partitioner(mode: str, keys: Sequence[Expression],
+                     num_partitions: int) -> Partitioner:
+    mode = mode.lower()
+    if mode == "hash":
+        if not keys:
+            raise ColumnarProcessingError("hash partitioning requires keys")
+        return HashPartitioner(keys, num_partitions)
+    if mode == "range":
+        return RangePartitioner(keys, num_partitions)
+    if mode == "roundrobin":
+        return RoundRobinPartitioner(num_partitions)
+    if mode == "single":
+        return SinglePartitioner()
+    raise ColumnarProcessingError(f"unknown partitioning {mode}")
+
+
+class TpuShuffleExchangeExec(TpuExec):
+    def __init__(self, child: TpuExec, mode: str, num_partitions: int,
+                 keys: Sequence[Expression], conf: RapidsConf,
+                 target_batch_bytes: int = 1 << 30):
+        super().__init__()
+        self.children = (child,)
+        self.mode = mode
+        self.num_partitions = 1 if mode == "single" else num_partitions
+        self.keys = list(keys)
+        self.conf = conf
+        self.target_batch_bytes = target_batch_bytes
+
+    def output_schema(self):
+        return self.children[0].output_schema()
+
+    def describe(self):
+        return f"TpuShuffleExchange[{self.mode}, n={self.num_partitions}]"
+
+    def execute(self):
+        manager = get_shuffle_manager(self.conf)
+        partitioner = make_partitioner(self.mode, self.keys, self.num_partitions)
+        handle = manager.new_shuffle(self.num_partitions)
+        try:
+            t0 = perf_counter()
+            batches = self.children[0].execute()
+            if isinstance(partitioner, RangePartitioner):
+                # range bounds must sample the WHOLE input, not the first
+                # batch (Spark samples per-partition across the input)
+                batches = list(batches)
+                partitioner.compute_bounds_multi(batches)
+            for batch in batches:
+                parts = split_by_partition(batch, partitioner)
+                handle.write_partitions(parts)
+            self.add_metric("shuffleWriteTime", perf_counter() - t0)
+            self.add_metric("shuffleBytesWritten", handle.bytes_written)
+
+            reader = manager.reader(handle)
+            t0 = perf_counter()
+            for p in range(self.num_partitions):
+                # GpuShuffleCoalesce: concat a partition's tables on host up
+                # to the target batch size, one H2D upload per flush
+                pending: List[HostTable] = []
+                pending_bytes = 0
+                for t in reader.read_partition(p):
+                    pending.append(t)
+                    pending_bytes += t.nbytes()
+                    if pending_bytes >= self.target_batch_bytes:
+                        yield self._upload(pending)
+                        pending, pending_bytes = [], 0
+                if pending:
+                    yield self._upload(pending)
+            self.add_metric("shuffleReadTime", perf_counter() - t0)
+            self.add_metric("shuffleBytesRead", reader.bytes_read)
+        finally:
+            manager.remove_shuffle(handle)
+
+    @staticmethod
+    def _upload(tables: List[HostTable]) -> DeviceTable:
+        host = tables[0] if len(tables) == 1 else HostTable.concat(tables)
+        return DeviceTable.from_host(host)

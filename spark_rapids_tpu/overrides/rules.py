@@ -218,46 +218,66 @@ def _tag_join(meta, conf):
             check_expr(node.condition, conf, meta.reasons, "join condition ")
 
 
-def _convert_scan(node: P.LocalScan, children):
+def _convert_scan(node: P.LocalScan, children, conf):
     return TpuScanExec(node.batches)
 
 
-def _convert_range(node: P.RangeNode, children):
+def _convert_range(node: P.RangeNode, children, conf):
     return TpuRangeExec(node.start, node.end, node.step, node.batch_rows, node.col_name)
 
 
-def _convert_project(node: P.Project, children):
+def _convert_project(node: P.Project, children, conf):
     return TpuProjectExec(children[0], node.exprs, node.names)
 
 
-def _convert_filter(node: P.Filter, children):
+def _convert_filter(node: P.Filter, children, conf):
     return TpuFilterExec(children[0], node.condition)
 
 
-def _convert_aggregate(node: P.Aggregate, children):
+def _convert_aggregate(node: P.Aggregate, children, conf):
     coalesced = TpuCoalesceExec(children[0], require_single=True)
     return TpuHashAggregateExec(coalesced, node.grouping, node.agg_specs,
                                 node.grouping_names)
 
 
-def _convert_sort(node: P.Sort, children):
+def _convert_sort(node: P.Sort, children, conf):
     coalesced = TpuCoalesceExec(children[0], require_single=True)
     return TpuSortExec(coalesced, node.orders)
 
 
-def _convert_limit(node: P.Limit, children):
+def _convert_limit(node: P.Limit, children, conf):
     return TpuLimitExec(children[0], node.limit)
 
 
-def _convert_union(node: P.Union, children):
+def _convert_union(node: P.Union, children, conf):
     return TpuUnionExec(children)
 
 
-def _convert_expand(node: P.Expand, children):
+def _convert_expand(node: P.Expand, children, conf):
     return TpuExpandExec(children[0], node.projections, node.names)
 
 
-def _convert_join(node: P.Join, children):
+def _tag_exchange(meta, conf):
+    _check_output_schema(meta, conf)
+    node: P.Exchange = meta.node
+    if node.partitioning not in ("hash", "range", "roundrobin", "single"):
+        meta.reasons.append(
+            f"partitioning {node.partitioning} is not supported on TPU")
+        return
+    if node.partitioning == "hash" and not node.keys:
+        meta.reasons.append("hash partitioning requires keys")
+    for k in node.keys:
+        check_expr(k, conf, meta.reasons, "partition key ")
+
+
+def _convert_exchange(node: P.Exchange, children, conf):
+    from spark_rapids_tpu.execs.exchange import TpuShuffleExchangeExec
+    return TpuShuffleExchangeExec(children[0], node.partitioning,
+                                  node.num_partitions, node.keys, conf,
+                                  target_batch_bytes=conf.batch_size_bytes)
+
+
+def _convert_join(node: P.Join, children, conf):
     from spark_rapids_tpu.execs.join import TpuJoinExec
     from spark_rapids_tpu.ops.cast import Cast
 
@@ -278,7 +298,7 @@ def _convert_join(node: P.Join, children):
                        node.children[1].output_schema())
 
 
-def _convert_file_scan(node, children):
+def _convert_file_scan(node, children, conf):
     return TpuFileScanExec(node)
 
 
@@ -301,8 +321,7 @@ exec_rule(P.Limit, _tag_simple, _convert_limit)
 exec_rule(P.Union, _tag_simple, _convert_union)
 exec_rule(P.Expand, _tag_expand, _convert_expand)
 exec_rule(P.Join, _tag_join, _convert_join)
-# P.Exchange intentionally unregistered yet -> CPU fallback with reason;
-# device shuffle lands with the shuffle layer (SURVEY.md §7 phase 4).
+exec_rule(P.Exchange, _tag_exchange, _convert_exchange)
 
 
 # ---------------------------------------------------------------------------
@@ -363,7 +382,7 @@ def _convert(meta: PlanMeta):
                 dev_children.append(cc)
             else:
                 dev_children.append(HostToDevice(cc))
-        return rule.convert_fn(meta.node, dev_children)
+        return rule.convert_fn(meta.node, dev_children, meta.conf)
     # CPU node: children must be host-side
     host_children = []
     for cc, cm in zip(converted_children, meta.children):

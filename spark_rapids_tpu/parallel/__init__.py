@@ -1,0 +1,13 @@
+"""Distributed execution over a jax.sharding.Mesh.
+
+Reference (SURVEY.md §2.6 TPU equivalent): the UCX peer-to-peer transport's
+TPU analog — when all shuffle partitions live on one pod slice, a shuffle
+exchange is ONE all-to-all collective over ICI instead of host files; DCN /
+host shuffle (shuffle/manager.py) remains the cross-slice fallback."""
+
+from spark_rapids_tpu.parallel.exchange import (
+    mesh_hash_exchange,
+    mesh_partial_then_merge,
+)
+
+__all__ = ["mesh_hash_exchange", "mesh_partial_then_merge"]

@@ -1,0 +1,122 @@
+"""ICI shuffle exchange: hash-partition rows across a device mesh with ONE
+all-to-all collective.
+
+Reference mapping (SURVEY.md §2.6): GpuShuffleExchangeExec's UCX fast path
+becomes `jax.lax.all_to_all` over the mesh axis — each device bucketizes its
+row shard by Spark-exact murmur3 target, pads buckets to the static shard
+size, and the collective delivers every device its partition. All shapes are
+static (bucket = local shard capacity, the worst case); validity masks carry
+the live counts. This is the building block the distributed engine uses when
+all partitions live on one slice; host-file shuffle covers the general case.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.shuffle.hashing import SPARK_SEED, murmur3_hash_device
+
+
+def _shard_map():
+    sm = getattr(jax, "shard_map", None)
+    if sm is None:
+        from jax.experimental.shard_map import shard_map as sm
+    return sm
+
+
+def _bucketize(pid, live, ndev: int, cap: int):
+    """Per-row scatter target into a (ndev*cap) padded send buffer:
+    pid*cap + rank-within-bucket; dead rows drop."""
+    spid = jnp.where(live, pid, ndev)
+    order = jnp.argsort(spid, stable=True)
+    sorted_pid = spid[order]
+    idx = jnp.arange(cap, dtype=jnp.int32)
+    is_first = jnp.concatenate([jnp.ones(1, jnp.bool_),
+                                sorted_pid[1:] != sorted_pid[:-1]])
+    run_start = jnp.where(is_first, idx, 0)
+    run_start = jax.lax.associative_scan(jnp.maximum, run_start)
+    slot_sorted = idx - run_start
+    slot = jnp.zeros(cap, jnp.int32).at[order].set(slot_sorted)
+    return jnp.where(live, pid * cap + slot, ndev * cap)
+
+
+def mesh_hash_exchange(mesh,
+                       dtypes: Sequence[T.DataType],
+                       key_idx: Sequence[int],
+                       axis_name: str = "data"):
+    """Build a jitted exchange: global arrays sharded on axis 0 are
+    re-partitioned so device d holds exactly the rows with
+    pmod(murmur3(keys), ndev) == d.
+
+    Returns run(datas, valids) -> (out_datas, out_valids, out_live); output
+    shards are padded to ndev * local_cap with out_live marking real rows.
+    (String keys need dictionary byte-matrix plumbing — non-string keys for
+    now; the host-shuffle path covers strings.)"""
+    from jax.sharding import NamedSharding, PartitionSpec as P_
+
+    ndev = mesh.shape[axis_name]
+    dts = list(dtypes)
+    kset = list(key_idx)
+    ncols = len(dts)
+
+    def shard_fn(*flat):
+        datas = flat[:ncols]
+        valids = flat[ncols:]
+        cap = datas[0].shape[0]
+        live = jnp.ones(cap, jnp.bool_)
+
+        keys = [(datas[i], valids[i], dts[i]) for i in kset]
+        h = murmur3_hash_device(keys, SPARK_SEED)
+        pid = h % jnp.int32(ndev)
+        pid = jnp.where(pid < 0, pid + ndev, pid)
+        tgt = _bucketize(pid, live, ndev, cap)
+
+        send_live = jnp.zeros((ndev * cap,), jnp.bool_).at[tgt].set(
+            True, mode="drop").reshape(ndev, cap)
+        recv_live = jax.lax.all_to_all(send_live, axis_name, 0, 0)
+
+        out_datas, out_valids = [], []
+        for d, v in zip(datas, valids):
+            send = jnp.zeros((ndev * cap,), d.dtype).at[tgt].set(
+                d, mode="drop").reshape(ndev, cap)
+            send_v = jnp.zeros((ndev * cap,), jnp.bool_).at[tgt].set(
+                v, mode="drop").reshape(ndev, cap)
+            out_datas.append(
+                jax.lax.all_to_all(send, axis_name, 0, 0).reshape(ndev * cap))
+            out_valids.append(
+                jax.lax.all_to_all(send_v, axis_name, 0, 0).reshape(ndev * cap))
+        return tuple(out_datas) + tuple(out_valids) + (recv_live.reshape(ndev * cap),)
+
+    sm = _shard_map()
+    fn = jax.jit(sm(shard_fn, mesh=mesh,
+                    in_specs=tuple(P_(axis_name) for _ in range(2 * ncols)),
+                    out_specs=tuple(P_(axis_name) for _ in range(2 * ncols + 1))))
+
+    def run(datas: List[jax.Array], valids: List[jax.Array]):
+        sharding = NamedSharding(mesh, P_(axis_name))
+        flat = [jax.device_put(x, sharding) for x in list(datas) + list(valids)]
+        out = fn(*flat)
+        return list(out[:ncols]), list(out[ncols:2 * ncols]), out[2 * ncols]
+
+    return run
+
+
+def mesh_partial_then_merge(mesh, axis_name: str = "data"):
+    """Partial-aggregate-per-shard + psum merge (the distributed two-phase
+    GpuHashAggregate shape); used by the multichip dry run."""
+    from jax.sharding import PartitionSpec as P_
+
+    def build(local_fn):
+        def wrapper(*args):
+            partial_out = local_fn(*args)
+            return jax.tree.map(lambda x: jax.lax.psum(x, axis_name),
+                                partial_out)
+
+        sm = _shard_map()
+        return jax.jit(sm(wrapper, mesh=mesh,
+                          in_specs=P_(axis_name), out_specs=P_()))
+    return build

@@ -1,0 +1,28 @@
+"""Shuffle layer (reference: SURVEY.md §2.6 — RapidsShuffleManager
+MULTITHREADED mode, GpuPartitioning device split, JCudfSerialization wire
+format, shuffle coalesce; the ICI collective path lives in parallel/)."""
+
+from spark_rapids_tpu.shuffle.hashing import murmur3_hash_device, murmur3_hash_host
+from spark_rapids_tpu.shuffle.partitioning import (
+    HashPartitioner,
+    RangePartitioner,
+    RoundRobinPartitioner,
+    SinglePartitioner,
+    split_by_partition,
+)
+from spark_rapids_tpu.shuffle.serializer import pack_table, unpack_table
+from spark_rapids_tpu.shuffle.manager import ShuffleManager, get_shuffle_manager
+
+__all__ = [
+    "murmur3_hash_device",
+    "murmur3_hash_host",
+    "HashPartitioner",
+    "RangePartitioner",
+    "RoundRobinPartitioner",
+    "SinglePartitioner",
+    "split_by_partition",
+    "pack_table",
+    "unpack_table",
+    "ShuffleManager",
+    "get_shuffle_manager",
+]

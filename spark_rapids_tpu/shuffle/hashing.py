@@ -1,0 +1,234 @@
+"""Spark-exact Murmur3 (x86_32) on device.
+
+Reference: the jni Hash kernels (SURVEY.md §2.9 — "murmur3/xxhash64/hiveHash
+Spark-exact") and GpuHashPartitioningBase.scala ("murmur3-compatible").
+Spark's algorithm is Murmur3_x86_32 with seed 42, hashed column-by-column
+with each column's hash seeding the next:
+
+  int/short/byte/bool/date -> hashInt(v)
+  long/timestamp           -> hashLong(v)
+  float                    -> hashInt(floatToIntBits(f)), -0.0 -> 0.0
+  double                   -> hashLong(doubleToLongBits(d)), -0.0 -> 0.0
+  string                   -> hashUnsafeBytes(utf8): full 4-byte words get a
+                              mix round, then EACH tail byte (sign-extended)
+                              gets its own full mix round — Spark's
+                              non-standard tail, kept bit-exact.
+  null                     -> hash unchanged (seed passes through)
+
+Device mapping: all arithmetic in uint32 lanes on the VPU. String bytes live
+in a host-built (dict_size x padded_len) uint8 matrix uploaded once per
+dictionary; rows gather their byte row by dictionary code so per-row seeds
+work. A numpy mirror of the same algorithm validates the device kernels.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu import types as T
+
+C1 = 0xCC9E2D51
+C2 = 0x1B873593
+SPARK_SEED = 42
+
+
+# -- device (jnp, uint32) ---------------------------------------------------
+
+def _rotl(x, r):
+    return (x << r) | (x >> (32 - r))
+
+
+def _mix_k1(k1):
+    k1 = (k1 * C1).astype(jnp.uint32)
+    k1 = _rotl(k1, 15)
+    return (k1 * C2).astype(jnp.uint32)
+
+
+def _mix_h1(h1, k1):
+    h1 = h1 ^ k1
+    h1 = _rotl(h1, 13)
+    return (h1 * jnp.uint32(5) + jnp.uint32(0xE6546B64)).astype(jnp.uint32)
+
+
+def _fmix(h1, length):
+    h1 = h1 ^ length.astype(jnp.uint32)
+    h1 = h1 ^ (h1 >> 16)
+    h1 = (h1 * jnp.uint32(0x85EBCA6B)).astype(jnp.uint32)
+    h1 = h1 ^ (h1 >> 13)
+    h1 = (h1 * jnp.uint32(0xC2B2AE35)).astype(jnp.uint32)
+    return h1 ^ (h1 >> 16)
+
+
+def _hash_int(v_u32, seed_u32):
+    return _fmix(_mix_h1(seed_u32, _mix_k1(v_u32)), jnp.full_like(seed_u32, 4))
+
+
+def _hash_long(v_i64, seed_u32):
+    low = (v_i64 & 0xFFFFFFFF).astype(jnp.uint32)
+    high = ((v_i64 >> 32) & 0xFFFFFFFF).astype(jnp.uint32)
+    h1 = _mix_h1(seed_u32, _mix_k1(low))
+    h1 = _mix_h1(h1, _mix_k1(high))
+    return _fmix(h1, jnp.full_like(seed_u32, 8))
+
+
+def _float_bits(data):
+    data = jnp.where(data == 0.0, jnp.zeros_like(data), data)  # -0.0 -> 0.0
+    if data.dtype == jnp.float32:
+        return jax.lax.bitcast_convert_type(data, jnp.int32)
+    return jax.lax.bitcast_convert_type(data, jnp.int64)
+
+
+def _hash_string_bytes(byte_rows, lengths, seed_u32):
+    """murmur3 over per-row byte sequences.
+
+    byte_rows: (n, L) uint8 with L a static multiple of 4 (zero-padded);
+    lengths:   (n,) int32 actual byte lengths;
+    Spark tail semantics: bytes beyond the last aligned word are hashed one
+    by one as SIGN-EXTENDED ints, each with a full mix round."""
+    n, L = byte_rows.shape
+    h1 = seed_u32
+    aligned = (lengths // 4) * 4
+    for w in range(L // 4):
+        base = w * 4
+        b = byte_rows[:, base:base + 4].astype(jnp.uint32)
+        word = b[:, 0] | (b[:, 1] << 8) | (b[:, 2] << 16) | (b[:, 3] << 24)
+        h1_next = _mix_h1(h1, _mix_k1(word))
+        h1 = jnp.where(base + 4 <= aligned, h1_next, h1)
+    for i in range(3):  # tail is at most 3 bytes
+        pos = jnp.clip(aligned + i, 0, L - 1)
+        byte = jnp.take_along_axis(byte_rows, pos[:, None], axis=1)[:, 0]
+        signed = byte.astype(jnp.int8).astype(jnp.int32)
+        h1_next = _mix_h1(h1, _mix_k1(signed.astype(jnp.uint32)))
+        h1 = jnp.where(aligned + i < lengths, h1_next, h1)
+    return _fmix(h1, lengths.astype(jnp.uint32))
+
+
+def murmur3_hash_device(cols: List[Tuple[object, object, T.DataType]],
+                        seed: int = SPARK_SEED,
+                        string_bytes: Optional[dict] = None):
+    """Row hash over multiple columns (inside jit).
+
+    cols: list of (data, validity, DataType); for STRING columns data is the
+    code array and string_bytes[i] = (byte_matrix, length_vector) built from
+    the dictionary (host prep, uploaded as aux).
+    Returns int32 hashes (Spark's hash() value)."""
+    n = cols[0][0].shape[0]
+    h = jnp.full(n, seed, dtype=jnp.uint32)
+    for i, (data, validity, dt) in enumerate(cols):
+        if isinstance(dt, T.StringType):
+            byte_matrix, len_vec = string_bytes[i]
+            codes = jnp.clip(data, 0, byte_matrix.shape[0] - 1)
+            rows = byte_matrix[codes]
+            lengths = len_vec[codes]
+            nh = _hash_string_bytes(rows, lengths, h)
+        elif isinstance(dt, (T.LongType, T.TimestampType)) or \
+                (isinstance(dt, T.DecimalType)):
+            nh = _hash_long(data.astype(jnp.int64), h)
+        elif isinstance(dt, T.DoubleType):
+            nh = _hash_long(_float_bits(data), h)
+        elif isinstance(dt, T.FloatType):
+            nh = _hash_int(_float_bits(data).astype(jnp.uint32), h)
+        elif isinstance(dt, T.BooleanType):
+            nh = _hash_int(data.astype(jnp.uint32), h)
+        else:  # byte/short/int/date: int widening
+            nh = _hash_int(data.astype(jnp.int32).astype(jnp.uint32), h)
+        h = jnp.where(validity, nh, h)  # null: seed passes through
+    return h.astype(jnp.int32)
+
+
+def string_dict_bytes(dictionary: np.ndarray, max_bytes: int = 1 << 16
+                      ) -> Tuple[np.ndarray, np.ndarray]:
+    """Host prep: encode a string dictionary as a (d, L) uint8 matrix +
+    lengths, L padded to a multiple of 4."""
+    if dictionary is None or len(dictionary) == 0:
+        return np.zeros((1, 4), dtype=np.uint8), np.zeros(1, dtype=np.int32)
+    encoded = [s.encode("utf-8") if s is not None else b"" for s in dictionary]
+    lens = np.array([len(b) for b in encoded], dtype=np.int32)
+    L = int(max(4, -(-int(lens.max()) // 4) * 4))
+    if L > max_bytes:
+        raise ValueError(f"string too long for device hash: {lens.max()} bytes")
+    mat = np.zeros((len(encoded), L), dtype=np.uint8)
+    for i, b in enumerate(encoded):
+        mat[i, :len(b)] = np.frombuffer(b, dtype=np.uint8)
+    return mat, lens
+
+
+# -- numpy mirror (validation + host-side hashing) --------------------------
+
+def _np_u32(x):
+    return np.uint32(int(x) & 0xFFFFFFFF)
+
+
+def _np_mix_k1(k1):
+    k1 = np.uint32((int(k1) * C1) & 0xFFFFFFFF)
+    k1 = np.uint32(((int(k1) << 15) | (int(k1) >> 17)) & 0xFFFFFFFF)
+    return np.uint32((int(k1) * C2) & 0xFFFFFFFF)
+
+
+def _np_mix_h1(h1, k1):
+    h1 = np.uint32(int(h1) ^ int(k1))
+    h1 = np.uint32(((int(h1) << 13) | (int(h1) >> 19)) & 0xFFFFFFFF)
+    return np.uint32((int(h1) * 5 + 0xE6546B64) & 0xFFFFFFFF)
+
+
+def _np_fmix(h1, length):
+    h1 = int(h1) ^ length
+    h1 ^= h1 >> 16
+    h1 = (h1 * 0x85EBCA6B) & 0xFFFFFFFF
+    h1 ^= h1 >> 13
+    h1 = (h1 * 0xC2B2AE35) & 0xFFFFFFFF
+    h1 ^= h1 >> 16
+    return np.uint32(h1)
+
+
+def _np_hash_int(v, seed):
+    return _np_fmix(_np_mix_h1(seed, _np_mix_k1(_np_u32(v))), 4)
+
+
+def _np_hash_long(v, seed):
+    v = int(np.int64(v))
+    low = _np_u32(v)
+    high = _np_u32((v >> 32))
+    h1 = _np_mix_h1(seed, _np_mix_k1(low))
+    h1 = _np_mix_h1(h1, _np_mix_k1(high))
+    return _np_fmix(h1, 8)
+
+
+def _np_hash_bytes(b: bytes, seed):
+    h1 = np.uint32(seed)
+    aligned = len(b) - len(b) % 4
+    for i in range(0, aligned, 4):
+        word = int.from_bytes(b[i:i + 4], "little")
+        h1 = _np_mix_h1(h1, _np_mix_k1(np.uint32(word)))
+    for i in range(aligned, len(b)):
+        byte = b[i] - 256 if b[i] >= 128 else b[i]  # signed
+        h1 = _np_mix_h1(h1, _np_mix_k1(_np_u32(byte)))
+    return _np_fmix(h1, len(b))
+
+
+def murmur3_hash_host(values: List[Tuple[object, bool, T.DataType]],
+                      seed: int = SPARK_SEED) -> int:
+    """One ROW's hash on host (oracle for tests / CPU partitioner path)."""
+    h = np.uint32(seed)
+    for v, valid, dt in values:
+        if not valid:
+            continue
+        if isinstance(dt, T.StringType):
+            h = _np_hash_bytes(str(v).encode("utf-8"), h)
+        elif isinstance(dt, (T.LongType, T.TimestampType, T.DecimalType)):
+            h = _np_hash_long(v, h)
+        elif isinstance(dt, T.DoubleType):
+            d = 0.0 if v == 0.0 else float(v)
+            h = _np_hash_long(np.float64(d).view(np.int64), h)
+        elif isinstance(dt, T.FloatType):
+            f = 0.0 if v == 0.0 else float(v)
+            h = _np_hash_int(np.float32(f).view(np.int32), h)
+        elif isinstance(dt, T.BooleanType):
+            h = _np_hash_int(1 if v else 0, h)
+        else:
+            h = _np_hash_int(int(v), h)
+    return int(np.int32(h))

@@ -1,0 +1,177 @@
+"""MULTITHREADED shuffle manager over local spill files.
+
+Reference (SURVEY.md §2.6): RapidsShuffleInternalManagerBase — the
+MULTITHREADED mode (RapidsShuffleThreadedWriterBase :238 /
+ReaderBase :613) parallelizes serialization and IO over Spark's sort-shuffle
+file layout: per map task ONE data file of concatenated per-partition
+segments plus an index of offsets. This module keeps that exact layout
+(data + index) with a thread pool for ser/deser, plus optional compression
+(TableCompressionCodec analog via zlib/zstd when available).
+
+A shuffle here is: N map outputs (one per input batch) x P reduce
+partitions. The reader streams a reduce partition's segments from every map
+output, deserializing in parallel, ordered by map id."""
+
+from __future__ import annotations
+
+import concurrent.futures as cf
+import os
+import tempfile
+import threading
+import zlib
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional
+
+import numpy as np
+
+from spark_rapids_tpu.columnar import HostTable
+from spark_rapids_tpu.conf import (
+    RapidsConf,
+    SHUFFLE_COMPRESSION_CODEC,
+    SHUFFLE_MT_READER_THREADS,
+    SHUFFLE_MT_WRITER_THREADS,
+)
+from spark_rapids_tpu.errors import ColumnarProcessingError
+from spark_rapids_tpu.shuffle.serializer import pack_table, unpack_table
+
+
+def _compress(codec: str, data: bytes) -> bytes:
+    if codec == "none":
+        return data
+    if codec in ("zlib", "lz4", "zstd"):
+        # lz4/zstd native codecs arrive with the C++ layer; zlib level 1 is
+        # the stand-in so the wire protocol (codec byte in the index) holds
+        return zlib.compress(data, level=1)
+    raise ColumnarProcessingError(f"unknown shuffle codec {codec}")
+
+
+def _decompress(codec: str, data: bytes) -> bytes:
+    if codec == "none":
+        return data
+    return zlib.decompress(data)
+
+
+@dataclass
+class MapOutput:
+    data_path: str
+    #: offsets[p] .. offsets[p+1] = partition p's byte range
+    offsets: List[int] = field(default_factory=list)
+
+
+class ShuffleWriteHandle:
+    """Writer for one shuffle: each written batch becomes one map output."""
+
+    def __init__(self, shuffle_id: int, num_partitions: int, workdir: str,
+                 codec: str, pool: cf.ThreadPoolExecutor):
+        self.shuffle_id = shuffle_id
+        self.num_partitions = num_partitions
+        self.workdir = workdir
+        self.codec = codec
+        self.pool = pool
+        self.map_outputs: List[MapOutput] = []
+        self.bytes_written = 0
+
+    def write_partitions(self, partitions: List[HostTable]) -> MapOutput:
+        """Serialize per-partition tables (in parallel) and append one map
+        output file (data + in-memory index)."""
+        if len(partitions) != self.num_partitions:
+            raise ColumnarProcessingError("partition count mismatch")
+        codec = self.codec
+        blobs = list(self.pool.map(
+            lambda t: _compress(codec, pack_table(t)), partitions))
+        map_id = len(self.map_outputs)
+        path = os.path.join(self.workdir,
+                            f"shuffle_{self.shuffle_id}_{map_id}.data")
+        offsets = [0]
+        with open(path, "wb") as f:
+            for b in blobs:
+                f.write(b)
+                offsets.append(offsets[-1] + len(b))
+        out = MapOutput(path, offsets)
+        self.map_outputs.append(out)
+        self.bytes_written += offsets[-1]
+        return out
+
+
+class ShuffleReadHandle:
+    def __init__(self, handle: ShuffleWriteHandle, codec: str,
+                 pool: cf.ThreadPoolExecutor):
+        self.write_handle = handle
+        self.codec = codec
+        self.pool = pool
+        self.bytes_read = 0
+
+    def read_partition(self, p: int) -> Iterator[HostTable]:
+        """All map outputs' segments for reduce partition p, deserialized in
+        parallel, yielded in map order."""
+        def fetch(mo: MapOutput):
+            start, end = mo.offsets[p], mo.offsets[p + 1]
+            if end <= start:
+                return None, 0
+            with open(mo.data_path, "rb") as f:
+                f.seek(start)
+                blob = f.read(end - start)
+            table, _ = unpack_table(_decompress(self.codec, blob))
+            return table, len(blob)
+
+        for t, nbytes in self.pool.map(fetch, self.write_handle.map_outputs):
+            self.bytes_read += nbytes  # consumer thread only: no races
+            if t is not None and t.num_rows > 0:
+                yield t
+
+
+class ShuffleManager:
+    """Process-wide registry of shuffles (GpuShuffleEnv analog)."""
+
+    def __init__(self, conf: RapidsConf):
+        self.conf = conf
+        self._lock = threading.Lock()
+        self._next_id = 0
+        self._shuffles: Dict[int, ShuffleWriteHandle] = {}
+        self.workdir = tempfile.mkdtemp(prefix="rapids_tpu_shuffle_")
+        self.codec = str(conf.get_entry(SHUFFLE_COMPRESSION_CODEC)).lower()
+        self._writer_pool = cf.ThreadPoolExecutor(
+            max_workers=max(1, conf.get_entry(SHUFFLE_MT_WRITER_THREADS)),
+            thread_name_prefix="shuffle-writer")
+        self._reader_pool = cf.ThreadPoolExecutor(
+            max_workers=max(1, conf.get_entry(SHUFFLE_MT_READER_THREADS)),
+            thread_name_prefix="shuffle-reader")
+
+    def new_shuffle(self, num_partitions: int) -> ShuffleWriteHandle:
+        with self._lock:
+            sid = self._next_id
+            self._next_id += 1
+            h = ShuffleWriteHandle(sid, num_partitions, self.workdir,
+                                   self.codec, self._writer_pool)
+            self._shuffles[sid] = h
+            return h
+
+    def reader(self, handle: ShuffleWriteHandle) -> ShuffleReadHandle:
+        return ShuffleReadHandle(handle, self.codec, self._reader_pool)
+
+    def remove_shuffle(self, handle: ShuffleWriteHandle):
+        with self._lock:
+            self._shuffles.pop(handle.shuffle_id, None)
+        for mo in handle.map_outputs:
+            try:
+                os.unlink(mo.data_path)
+            except OSError:
+                pass
+
+
+_MANAGERS: Dict[tuple, ShuffleManager] = {}
+_MANAGER_LOCK = threading.Lock()
+
+
+def get_shuffle_manager(conf: RapidsConf) -> ShuffleManager:
+    """One manager per distinct (codec, thread pools) configuration, so a
+    session's shuffle settings always take effect."""
+    key = (str(conf.get_entry(SHUFFLE_COMPRESSION_CODEC)).lower(),
+           conf.get_entry(SHUFFLE_MT_WRITER_THREADS),
+           conf.get_entry(SHUFFLE_MT_READER_THREADS))
+    with _MANAGER_LOCK:
+        mgr = _MANAGERS.get(key)
+        if mgr is None:
+            mgr = ShuffleManager(conf)
+            _MANAGERS[key] = mgr
+        return mgr

@@ -1,0 +1,130 @@
+"""Packed host-table wire format ("TPAK").
+
+Reference: JCudfSerialization (SURVEY.md §2.9 — "shuffle wire format:
+header + packed host buffer", GpuColumnarBatchSerializer.scala:25-26).
+Layout (little-endian):
+
+  magic  b"TPAK"  | version u32 | ncols u32 | nrows u64
+  per column header: name_len u16 + name utf8, dtype tag u8
+                     (+ precision u8, scale u8 for decimal)
+  per column body:   validity bitmask ceil(n/8) bytes, then
+     fixed-width: raw array bytes (n * itemsize)
+     string:      offsets int64[n+1] + utf8 blob (int64: blobs may pass 2GiB)
+
+The format is self-describing so shuffle readers need no schema exchange.
+A C++ implementation with the same layout is the planned native fast path.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import List, Tuple
+
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar import HostColumn, HostTable
+from spark_rapids_tpu.errors import ColumnarProcessingError
+
+MAGIC = b"TPAK"
+VERSION = 1
+
+_TAGS = [
+    (T.BooleanType, 1), (T.ByteType, 2), (T.ShortType, 3), (T.IntegerType, 4),
+    (T.LongType, 5), (T.FloatType, 6), (T.DoubleType, 7), (T.StringType, 8),
+    (T.DateType, 9), (T.TimestampType, 10), (T.NullType, 11),
+    (T.DecimalType, 12),
+]
+_TAG_OF = {cls: tag for cls, tag in _TAGS}
+_CLS_OF = {tag: cls for cls, tag in _TAGS}
+
+
+def _dtype_of_tag(tag: int, extra: Tuple[int, int]) -> T.DataType:
+    cls = _CLS_OF[tag]
+    if cls is T.DecimalType:
+        return T.DecimalType(extra[0], extra[1])
+    return cls()
+
+
+def pack_table(table: HostTable) -> bytes:
+    out: List[bytes] = [MAGIC, struct.pack("<IIQ", VERSION, table.num_columns,
+                                           table.num_rows)]
+    n = table.num_rows
+    for name, col in zip(table.names, table.columns):
+        nb = name.encode("utf-8")
+        tag = _TAG_OF[type(col.dtype)]
+        out.append(struct.pack("<H", len(nb)))
+        out.append(nb)
+        if isinstance(col.dtype, T.DecimalType):
+            out.append(struct.pack("<BBB", tag, col.dtype.precision, col.dtype.scale))
+        else:
+            out.append(struct.pack("<BBB", tag, 0, 0))
+    for col in table.columns:
+        out.append(np.packbits(col.validity.astype(np.uint8),
+                               bitorder="little").tobytes())
+        if isinstance(col.dtype, T.StringType):
+            encoded = [(s.encode("utf-8") if s is not None and v else b"")
+                       for s, v in zip(col.data, col.validity)]
+            offsets = np.zeros(n + 1, dtype=np.int64)
+            if n:
+                offsets[1:] = np.cumsum([len(b) for b in encoded], dtype=np.int64)
+            out.append(offsets.tobytes())
+            out.append(b"".join(encoded))
+        elif isinstance(col.dtype, T.NullType):
+            pass  # validity only
+        else:
+            arr = np.ascontiguousarray(col.data, dtype=col.dtype.np_dtype)
+            out.append(arr.tobytes())
+    return b"".join(out)
+
+
+def unpack_table(buf: bytes, offset: int = 0) -> Tuple[HostTable, int]:
+    """Returns (table, bytes consumed from offset)."""
+    view = memoryview(buf)
+    pos = offset
+    if bytes(view[pos:pos + 4]) != MAGIC:
+        raise ColumnarProcessingError("bad TPAK magic")
+    pos += 4
+    version, ncols, nrows = struct.unpack_from("<IIQ", view, pos)
+    pos += 16
+    if version != VERSION:
+        raise ColumnarProcessingError(f"TPAK version {version}")
+    names: List[str] = []
+    dtypes: List[T.DataType] = []
+    for _ in range(ncols):
+        (nlen,) = struct.unpack_from("<H", view, pos)
+        pos += 2
+        names.append(bytes(view[pos:pos + nlen]).decode("utf-8"))
+        pos += nlen
+        tag, p, s = struct.unpack_from("<BBB", view, pos)
+        pos += 3
+        dtypes.append(_dtype_of_tag(tag, (p, s)))
+    cols: List[HostColumn] = []
+    vbytes = (nrows + 7) // 8
+    for dt in dtypes:
+        validity = np.unpackbits(
+            np.frombuffer(view, dtype=np.uint8, count=vbytes, offset=pos),
+            bitorder="little")[:nrows].astype(np.bool_)
+        pos += vbytes
+        if isinstance(dt, T.StringType):
+            offsets = np.frombuffer(view, dtype=np.int64, count=nrows + 1,
+                                    offset=pos)
+            pos += offsets.nbytes
+            blob_len = int(offsets[-1]) if nrows else 0
+            blob = bytes(view[pos:pos + blob_len])
+            pos += blob_len
+            data = np.empty(nrows, dtype=object)
+            for i in range(nrows):
+                if validity[i]:
+                    data[i] = blob[offsets[i]:offsets[i + 1]].decode("utf-8")
+                else:
+                    data[i] = None
+            cols.append(HostColumn(dt, data, validity))
+        elif isinstance(dt, T.NullType):
+            cols.append(HostColumn(dt, np.zeros(nrows, dtype=np.int8), validity))
+        else:
+            np_dt = dt.np_dtype
+            data = np.frombuffer(view, dtype=np_dt, count=nrows, offset=pos).copy()
+            pos += int(nrows) * np_dt.itemsize
+            cols.append(HostColumn(dt, data, validity))
+    return HostTable(names, cols), pos - offset
