@@ -1,0 +1,419 @@
+"""TPU window exec.
+
+Reference: GpuWindowExec + its specialized iterators (SURVEY.md §2.3,
+window/ — running window, batched bounded, unbounded-to-unbounded).
+
+TPU-first design — everything is ONE jitted kernel over a sorted batch:
+  1. lax.sort by (live, partition keys, order keys) with a row payload;
+  2. partition boundaries -> segment starts via an associative max-scan;
+     peer boundaries (order-key ties) -> peer-group ids;
+  3. per function:
+     row_number   = idx - seg_start + 1
+     rank         = peer_start - seg_start + 1 (propagated over peers)
+     dense_rank   = segmented cumsum of peer boundaries
+     lag/lead     = shifted gather masked to the segment
+     whole-part.  = jax.ops.segment_* + gather by segment id
+     running      = segmented inclusive prefix (cumsum / scan-min / scan-max),
+                    RANGE frames read the value at the LAST PEER row
+     bounded rows = prefix-sum differences against clamped segment bounds
+                    (sum/count/avg; bounded min/max falls back)
+  4. results ride out positionally with the sorted child columns.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar import DeviceColumn, DeviceTable
+from spark_rapids_tpu.errors import ColumnarProcessingError
+from spark_rapids_tpu.execs.base import TpuExec
+from spark_rapids_tpu.ops import aggregates as agg
+from spark_rapids_tpu.ops.window import (
+    DenseRank,
+    Lag,
+    Lead,
+    Rank,
+    RowNumber,
+    WindowExpression,
+)
+from spark_rapids_tpu.ops.expr import (
+    DevVal,
+    EvalCtx,
+    NodePrep,
+    PrepCtx,
+    _prep_trace_key,
+    _walk_eval,
+    _walk_prep,
+)
+
+#: window aggregates with device support
+DEVICE_WINDOW_AGGS = (agg.Sum, agg.Count, agg.Min, agg.Max, agg.Average)
+
+
+def device_window_supported(w: WindowExpression) -> Tuple[bool, str]:
+    fn = w.function
+    frame = w.spec.resolved_frame()
+    if isinstance(fn, (RowNumber, Rank, DenseRank)):
+        if not w.spec.orders:
+            return False, "ranking window function requires an ORDER BY"
+        return True, ""
+    if isinstance(fn, (Lag, Lead)):
+        if fn.default is not None and isinstance(fn.data_type, T.StringType):
+            return False, "lag/lead string default value is not supported on TPU"
+        return True, ""
+    if isinstance(fn, DEVICE_WINDOW_AGGS):
+        kind, lo, hi = frame
+        if kind == "range" and not (lo is None and (hi in (0, None))):
+            return False, "only UNBOUNDED..CURRENT/UNBOUNDED range frames"
+        if kind == "rows" and (lo is not None or hi is not None):
+            if isinstance(fn, (agg.Min, agg.Max)) and not (
+                    lo is None and hi == 0):
+                return False, "bounded rows min/max window is not supported on TPU"
+            if (lo is not None and hi is not None and (hi - lo + 1) > 512
+                    and isinstance(fn, (agg.Sum, agg.Average))
+                    and isinstance(fn.data_type, (T.FloatType, T.DoubleType))):
+                return False, ("float both-bounded rows frame wider than 512 "
+                               "is not supported on TPU")
+        return True, ""
+    return False, f"window function {type(fn).__name__} is not supported on TPU"
+
+
+def _seg_scan_max(flags_idx):
+    return jax.lax.associative_scan(jnp.maximum, flags_idx)
+
+
+def _segmented_cumsum(v, seg_start_idx):
+    """Inclusive prefix sum restarting at each segment: cumsum(v) minus the
+    exclusive total at the segment start."""
+    c = jnp.cumsum(v, dtype=v.dtype)
+    base = c[seg_start_idx] - v[seg_start_idx]
+    return c - base
+
+
+def _segmented_scan(op, v, new_seg):
+    """Generic segmented inclusive scan via flagged associative combine."""
+    def combine(a, b):
+        af, av = a
+        bf, bv = b
+        return af | bf, jnp.where(bf, bv, op(av, bv))
+    _, out = jax.lax.associative_scan(combine, (new_seg, v))
+    return out
+
+
+class TpuWindowExec(TpuExec):
+    def __init__(self, child: TpuExec, window_cols: Sequence[Tuple[str, WindowExpression]]):
+        super().__init__()
+        self.children = (child,)
+        self.window_cols = list(window_cols)
+        self._traces = {}
+
+    def output_schema(self):
+        return (self.children[0].output_schema()
+                + [(n, w.data_type) for n, w in self.window_cols])
+
+    def describe(self):
+        return f"TpuWindow[{[n for n, _ in self.window_cols]}]"
+
+    def execute(self):
+        from spark_rapids_tpu.runtime.retry import retry_block
+        batches = list(self.children[0].execute())
+        if len(batches) != 1:
+            raise ColumnarProcessingError("TpuWindowExec requires a single batch")
+        yield retry_block(lambda: self._window(batches[0]))
+
+    # -----------------------------------------------------------------------
+    def _window(self, table: DeviceTable) -> DeviceTable:
+        # all window exprs share ONE spec sort per distinct spec; v1 sorts
+        # once per expr group with identical (partition, order) — common case
+        # is a single spec.
+        pctx = PrepCtx(table)
+        expr_preps = []
+        for _, w in self.window_cols:
+            pp = [self._prep_tree(e, pctx) for e in w.spec.partition_exprs]
+            op = [self._prep_tree(o.expr, pctx) for o in w.spec.orders]
+            vp = self._prep_value(w, pctx)
+            expr_preps.append((pp, op, vp))
+
+        cols = tuple(DevVal(c.data, c.validity) for c in table.columns)
+        aux = tuple(jnp.asarray(a) for a in pctx.aux_arrays)
+        capacity = table.capacity
+
+        tkey = (capacity, tuple(
+            (tuple(_prep_trace_key(p) for p in pp),
+             tuple(_prep_trace_key(p) for p in op),
+             tuple(_prep_trace_key(p) for p in vp))
+            for pp, op, vp in expr_preps))
+        fn = self._traces.get(tkey)
+        if fn is None:
+            fn = jax.jit(self._build_kernel(capacity, expr_preps))
+            self._traces[tkey] = fn
+        col_outs, win_outs = fn(cols, aux, table.nrows_dev)
+
+        out_cols = [c.with_arrays(d, v) for c, (d, v) in zip(table.columns, col_outs)]
+        names = list(table.names)
+        for (name, w), (d, v), (pp, op, vp) in zip(self.window_cols, win_outs,
+                                                   expr_preps):
+            dictionary = None
+            dict_sorted = True
+            if isinstance(w.data_type, T.StringType) and vp:
+                # lag/lead of a string expr: the value prep's root carries
+                # the output dictionary (same as aggregate outputs)
+                dictionary = vp[0][-1].out_dict
+                dict_sorted = vp[0][-1].dict_sorted
+            out_cols.append(DeviceColumn(w.data_type, d, v,
+                                         dictionary=dictionary,
+                                         dict_sorted=dict_sorted))
+            names.append(name)
+        return DeviceTable(names, out_cols, table.nrows_dev, capacity)
+
+    @staticmethod
+    def _prep_tree(e, pctx):
+        preps: List[NodePrep] = []
+        _walk_prep(e, pctx, preps)
+        return preps
+
+    def _prep_value(self, w: WindowExpression, pctx):
+        fn = w.function
+        if isinstance(fn, (Lag, Lead)):
+            return [self._prep_tree(fn.children[0], pctx)]
+        if isinstance(fn, agg.AggregateFunction) and fn.child is not None:
+            return [self._prep_tree(fn.child, pctx)]
+        return []
+
+    # -----------------------------------------------------------------------
+    def _build_kernel(self, capacity: int, expr_preps):
+        window_cols = self.window_cols
+
+        def kernel(cols, aux, nrows):
+            idx = jnp.arange(capacity, dtype=jnp.int32)
+            live = idx < nrows
+
+            def eval_tree(e, preps):
+                ctx = EvalCtx(cols, aux, nrows, capacity)
+                ctx._prep_iter = iter(preps)
+                return _walk_eval(e, ctx)
+
+            outs = []
+            for (name, w), (pp, op, vp) in zip(window_cols, expr_preps):
+                spec = w.spec
+                pvals = [eval_tree(e, p) for e, p in zip(spec.partition_exprs, pp)]
+                ovals = [eval_tree(o.expr, p) for o, p in zip(spec.orders, op)]
+
+                # ---- sort by (dead-last, partition, order) ----------------
+                operands = [(~live).astype(jnp.int32)]
+                for kv in pvals:
+                    operands.extend(self._sortable(kv))
+                from spark_rapids_tpu.execs.sort import _directional
+                for o, kv in zip(spec.orders, ovals):
+                    operands.extend(_directional(
+                        kv.data, kv.validity, o.ascending,
+                        o.resolved_nulls_first(), capacity))
+                res = jax.lax.sort(operands + [idx], num_keys=len(operands),
+                                   is_stable=True)
+                perm = res[-1]
+                s_live = live[perm]
+
+                # ---- segment & peer structure -----------------------------
+                first = idx == 0
+                new_seg = first
+                for kv in pvals:
+                    d, v = kv.data[perm], kv.validity[perm]
+                    dp, vpv = jnp.roll(d, 1), jnp.roll(v, 1)
+                    new_seg = new_seg | jnp.where(v & vpv, d != dp, v != vpv)
+                new_seg = new_seg & s_live | first
+                gid = jnp.cumsum(new_seg.astype(jnp.int32)) - 1
+                seg_start = _seg_scan_max(jnp.where(new_seg, idx, 0))
+
+                new_peer = new_seg
+                for kv in ovals:
+                    d, v = kv.data[perm], kv.validity[perm]
+                    dp, vpv = jnp.roll(d, 1), jnp.roll(v, 1)
+                    new_peer = new_peer | jnp.where(v & vpv, d != dp, v != vpv)
+                peer_id = jnp.cumsum(new_peer.astype(jnp.int32)) - 1
+                peer_start = _seg_scan_max(jnp.where(new_peer, idx, 0))
+                # last row index of each peer group
+                peer_last = jax.ops.segment_max(
+                    jnp.where(s_live, idx, -1), peer_id,
+                    num_segments=capacity)[peer_id]
+
+                d, v = self._eval_window_fn(
+                    w, vp, eval_tree, perm, idx, s_live, gid, seg_start,
+                    peer_start, peer_last, nrows, capacity)
+                # scatter back to INPUT row order so multiple window exprs
+                # with different specs stay positionally aligned with the
+                # child columns
+                d_in = jnp.zeros_like(d).at[perm].set(d)
+                v_in = jnp.zeros_like(v).at[perm].set(v)
+                outs.append((d_in, v_in))
+
+            col_outs = [(d, v) for d, v in cols]  # original order
+            return col_outs, outs
+
+        return kernel
+
+    @staticmethod
+    def _sortable(kv):
+        d = kv.data
+        if jnp.issubdtype(d.dtype, jnp.floating):
+            d = jnp.where(d == 0.0, jnp.zeros_like(d), d)
+        if d.dtype == jnp.bool_:
+            d = d.astype(jnp.int32)
+        return [(~kv.validity).astype(jnp.int32),
+                jnp.where(kv.validity, d, jnp.zeros_like(d))]
+
+    def _eval_window_fn(self, w, vp, eval_tree, perm, idx, s_live, gid,
+                        seg_start, peer_start, peer_last, nrows, capacity):
+        fn = w.function
+        kind, lo, hi = w.spec.resolved_frame()
+
+        if isinstance(fn, RowNumber):
+            return ((idx - seg_start + 1).astype(jnp.int32), s_live)
+        if isinstance(fn, Rank):
+            return ((peer_start - seg_start + 1).astype(jnp.int32), s_live)
+        if isinstance(fn, DenseRank):
+            # segmented count of peer-group starts
+            new_peer_int = (peer_start == idx).astype(jnp.int32)
+            dense = _segmented_cumsum(new_peer_int, seg_start)
+            return (dense.astype(jnp.int32), s_live)
+
+        if isinstance(fn, (Lag, Lead)):
+            src = eval_tree(fn.children[0], vp[0])
+            sd, sv = src.data[perm], src.validity[perm]
+            off = fn.offset if isinstance(fn, Lead) else -fn.offset
+            j = idx + off
+            safe = jnp.clip(j, 0, capacity - 1)
+            in_seg = (j >= 0) & (j < capacity) & (gid[safe] == gid) & s_live
+            in_seg = in_seg & (safe < nrows)
+            data = jnp.where(in_seg, sd[safe], jnp.zeros_like(sd))
+            valid = in_seg & sv[safe]
+            if fn.default is not None:
+                dflt = jnp.asarray(fn.default, dtype=sd.dtype)
+                data = jnp.where(~in_seg & s_live, dflt, data)
+                valid = valid | (~in_seg & s_live)
+            return (data, valid)
+
+        # aggregates
+        if isinstance(fn, agg.Count) and fn.child is None:
+            v = s_live.astype(jnp.int64)
+            sv = s_live
+        else:
+            src = eval_tree(fn.child, vp[0])
+            sd, sv = src.data[perm], src.validity[perm] & s_live
+            if isinstance(fn, agg.Count):
+                v = sv.astype(jnp.int64)
+            elif isinstance(fn.data_type, T.LongType) and isinstance(fn, agg.Sum):
+                v = jnp.where(sv, sd.astype(jnp.int64), 0)
+            elif isinstance(fn, (agg.Sum, agg.Average)):
+                v = jnp.where(sv, sd.astype(jnp.float64), 0.0)
+            else:  # min/max keep dtype
+                v = sd
+
+        whole = (lo is None and hi is None)
+        running = (lo is None and hi == 0)
+        new_seg = seg_start == idx
+
+        def seg_prefix(x):
+            """Inclusive prefix restarting at each segment — never crosses
+            partitions, so float sums cannot catastrophically cancel against
+            other partitions' values (int stays exact too)."""
+            return _segmented_scan(jnp.add, x, new_seg)
+
+        if isinstance(fn, (agg.Min, agg.Max)):
+            op = jnp.minimum if isinstance(fn, agg.Min) else jnp.maximum
+            ident = self._ident(v.dtype, isinstance(fn, agg.Min))
+            vv = jnp.where(sv, v, ident)
+            if whole:
+                seg_fn = jax.ops.segment_min if isinstance(fn, agg.Min) else jax.ops.segment_max
+                r = seg_fn(vv, gid, num_segments=capacity)[gid]
+                nn = jax.ops.segment_sum(sv.astype(jnp.int32), gid,
+                                         num_segments=capacity)[gid]
+                valid = (nn > 0) & s_live
+            else:  # running
+                new_seg = seg_start == idx
+                r = _segmented_scan(op, vv, new_seg)
+                cnt = _segmented_scan(jnp.add, sv.astype(jnp.int32), new_seg)
+                if kind == "range":
+                    r = r[peer_last]
+                    cnt = cnt[peer_last]
+                valid = (cnt > 0) & s_live
+            r = jnp.where(valid, r, jnp.zeros_like(r))
+            if isinstance(fn.data_type, T.BooleanType):
+                r = r.astype(jnp.bool_)
+            return (r, valid)
+
+        # sum / count / average via prefix sums
+        if isinstance(fn, agg.Count) and fn.child is None:
+            cnt_all = s_live.astype(jnp.int64)
+        else:
+            cnt_all = sv.astype(jnp.int64)
+        if whole:
+            total = jax.ops.segment_sum(v, gid, num_segments=capacity)[gid]
+            nn = jax.ops.segment_sum(cnt_all, gid, num_segments=capacity)[gid]
+        elif running:
+            total = seg_prefix(v)
+            nn = seg_prefix(cnt_all)
+            if kind == "range":
+                total = total[peer_last]
+                nn = nn[peer_last]
+        else:
+            # bounded rows frame [lo, hi] relative to current row
+            seg_end = jax.ops.segment_max(jnp.where(s_live, idx, -1), gid,
+                                          num_segments=capacity)[gid]
+            a = seg_start if lo is None else jnp.maximum(seg_start, idx + lo)
+            b = seg_end if hi is None else jnp.minimum(seg_end, idx + hi)
+            a = jnp.clip(a, 0, capacity - 1)
+            b = jnp.clip(b, 0, capacity - 1)
+            nonempty = b >= a
+            is_float = jnp.issubdtype(v.dtype, jnp.floating)
+
+            # counts (int, exact) always go prefix-diff
+            prefc = seg_prefix(cnt_all)
+            past_start = a > seg_start
+            lo_exclc = jnp.where(past_start, prefc[jnp.maximum(a - 1, 0)], 0)
+            nn = jnp.where(nonempty, prefc[b] - lo_exclc, 0)
+
+            if not is_float:
+                pref = seg_prefix(v)
+                lo_excl = jnp.where(past_start, pref[jnp.maximum(a - 1, 0)], 0)
+                total = jnp.where(nonempty, pref[b] - lo_excl, 0)
+            elif lo is None:
+                # frame starts at segment start: prefix read, NO subtraction
+                # (prefix-diff on floats can catastrophically cancel)
+                total = jnp.where(nonempty, seg_prefix(v)[b], 0.0)
+            elif hi is None:
+                # frame ends at segment end: reverse segmented prefix
+                seg_last = idx == seg_end
+                rpref = jnp.flip(_segmented_scan(
+                    jnp.add, jnp.flip(v), jnp.flip(seg_last)))
+                total = jnp.where(nonempty, rpref[a], 0.0)
+            else:
+                # both-bounded small frame: exact per-frame unrolled sum
+                total = jnp.zeros_like(v)
+                for k in range(lo, hi + 1):
+                    j = idx + k
+                    safe = jnp.clip(j, 0, capacity - 1)
+                    inside = (j >= seg_start) & (j <= seg_end) & s_live
+                    total = total + jnp.where(inside, v[safe], 0.0)
+
+        if isinstance(fn, agg.Count):
+            return (nn.astype(jnp.int64), s_live)
+        valid = (nn > 0) & s_live
+        if isinstance(fn, agg.Average):
+            r = total / jnp.maximum(nn, 1).astype(jnp.float64)
+        else:
+            r = total
+        return (jnp.where(valid, r, jnp.zeros_like(r)), valid)
+
+    @staticmethod
+    def _ident(dtype, is_min: bool):
+        if jnp.issubdtype(dtype, jnp.floating):
+            return jnp.asarray(jnp.inf if is_min else -jnp.inf, dtype=dtype)
+        if dtype == jnp.bool_:
+            return jnp.asarray(True if is_min else False, dtype=dtype)
+        info = jnp.iinfo(dtype)
+        return jnp.asarray(info.max if is_min else info.min, dtype=dtype)

@@ -171,3 +171,30 @@ def shiftleft(e, n):
 
 def shiftright(e, n):
     return _math.ShiftRight(_e(e), _e(n))
+
+
+# window functions: thin delegates to the single implementations in
+# ops/window.py (reference: window/ package exprs)
+def row_number():
+    from spark_rapids_tpu.ops import window as _w
+    return _w.row_number()
+
+
+def rank():
+    from spark_rapids_tpu.ops import window as _w
+    return _w.rank()
+
+
+def dense_rank():
+    from spark_rapids_tpu.ops import window as _w
+    return _w.dense_rank()
+
+
+def lag(e, offset: int = 1, default=None):
+    from spark_rapids_tpu.ops import window as _w
+    return _w.lag(_e(e), offset, default)
+
+
+def lead(e, offset: int = 1, default=None):
+    from spark_rapids_tpu.ops import window as _w
+    return _w.lead(_e(e), offset, default)

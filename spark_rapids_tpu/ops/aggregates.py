@@ -35,6 +35,11 @@ class AggregateFunction(Expression):
     def nullable(self):
         return True
 
+    def over(self, spec):
+        """agg OVER window-spec -> WindowExpression (ops/window.py)."""
+        from spark_rapids_tpu.ops.window import WindowExpression
+        return WindowExpression(self, spec)
+
 
 class Sum(AggregateFunction):
     @property

@@ -1,0 +1,366 @@
+"""Window functions and specs.
+
+Reference: the window/ package (SURVEY.md §2.3 — GpuWindowExec + specialized
+iterators: running window, batched bounded, unbounded-to-unbounded) and the
+WindowExpression/WindowSpecDefinition expressions (Appendix A).
+
+Frames: ("rows" | "range", lo, hi) with None = unbounded, 0 = current row,
+negative = preceding, positive = following. Spark defaults: with an ORDER BY
+the frame is RANGE UNBOUNDED PRECEDING..CURRENT ROW; without it the frame is
+the whole partition."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar import HostColumn, HostTable
+from spark_rapids_tpu.errors import ColumnarProcessingError
+from spark_rapids_tpu.ops import aggregates as agg
+from spark_rapids_tpu.ops.expr import Expression
+from spark_rapids_tpu.plan.nodes import SortOrder
+
+
+class WindowSpec:
+    """Builder: Window.partition_by(...).order_by(...).rows_between(a, b)."""
+
+    def __init__(self, partition_by: Sequence[Expression] = (),
+                 order_by: Sequence[SortOrder] = (),
+                 frame: Optional[Tuple[str, Optional[int], Optional[int]]] = None):
+        self.partition_exprs = list(partition_by)
+        self.orders = list(order_by)
+        self.frame = frame
+
+    def partition_by(self, *cols) -> "WindowSpec":
+        from spark_rapids_tpu.ops.expr import col
+        exprs = [col(c) if isinstance(c, str) else c for c in cols]
+        return WindowSpec(exprs, self.orders, self.frame)
+
+    def order_by(self, *cols, ascending: bool = True) -> "WindowSpec":
+        from spark_rapids_tpu.ops.expr import col
+        orders = []
+        for c in cols:
+            if isinstance(c, SortOrder):
+                orders.append(c)
+            else:
+                e = col(c) if isinstance(c, str) else c
+                orders.append(SortOrder(e, ascending))
+        return WindowSpec(self.partition_exprs, orders, self.frame)
+
+    def rows_between(self, lo: Optional[int], hi: Optional[int]) -> "WindowSpec":
+        return WindowSpec(self.partition_exprs, self.orders, ("rows", lo, hi))
+
+    def range_between(self, lo: Optional[int], hi: Optional[int]) -> "WindowSpec":
+        return WindowSpec(self.partition_exprs, self.orders, ("range", lo, hi))
+
+    def resolved_frame(self) -> Tuple[str, Optional[int], Optional[int]]:
+        if self.frame is not None:
+            return self.frame
+        if self.orders:
+            return ("range", None, 0)  # Spark default with ORDER BY
+        return ("rows", None, None)
+
+
+#: Spark-style entry: Window.partition_by(...)
+class Window:
+    unbounded_preceding = None
+    unbounded_following = None
+    current_row = 0
+
+    @staticmethod
+    def partition_by(*cols) -> WindowSpec:
+        return WindowSpec().partition_by(*cols)
+
+    @staticmethod
+    def order_by(*cols, **kw) -> WindowSpec:
+        return WindowSpec().order_by(*cols, **kw)
+
+
+class WindowFunction(Expression):
+    """Base of rank/offset window functions (not evaluable standalone)."""
+
+    needs_order = True
+
+    def over(self, spec: WindowSpec) -> "WindowExpression":
+        return WindowExpression(self, spec)
+
+
+class RowNumber(WindowFunction):
+    children = ()
+
+    @property
+    def data_type(self):
+        return T.INT
+
+    def key(self):
+        return ("row_number",)
+
+    def with_children(self, children):
+        return self
+
+
+class Rank(WindowFunction):
+    children = ()
+
+    @property
+    def data_type(self):
+        return T.INT
+
+    def key(self):
+        return ("rank",)
+
+    def with_children(self, children):
+        return self
+
+
+class DenseRank(WindowFunction):
+    children = ()
+
+    @property
+    def data_type(self):
+        return T.INT
+
+    def key(self):
+        return ("dense_rank",)
+
+    def with_children(self, children):
+        return self
+
+
+class Lag(WindowFunction):
+    def __init__(self, child: Expression, offset: int = 1, default=None):
+        self.children = (child,)
+        self.offset = offset
+        self.default = default
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type
+
+    def key(self):
+        return ("lag", self.children[0].key(), self.offset, self.default)
+
+    def with_children(self, children):
+        return Lag(children[0], self.offset, self.default)
+
+
+class Lead(WindowFunction):
+    def __init__(self, child: Expression, offset: int = 1, default=None):
+        self.children = (child,)
+        self.offset = offset
+        self.default = default
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type
+
+    def key(self):
+        return ("lead", self.children[0].key(), self.offset, self.default)
+
+    def with_children(self, children):
+        return Lead(children[0], self.offset, self.default)
+
+
+class WindowExpression(Expression):
+    """function OVER spec. Carries the bound spec; binding descends into the
+    function child, partition exprs and order exprs."""
+
+    def __init__(self, function: Expression, spec: WindowSpec):
+        self.function = function
+        self.spec = spec
+        self.children = tuple(function.children)
+
+    @property
+    def data_type(self):
+        return self.function.data_type
+
+    def key(self):
+        frame = self.spec.resolved_frame()
+        return ("winexpr", self.function.key() if not isinstance(
+            self.function, agg.AggregateFunction) else
+            (type(self.function).__name__,), frame)
+
+    def bind(self, schema):
+        if isinstance(self.function, agg.AggregateFunction):
+            fn = type(self.function)(self.function.child.bind(schema)) \
+                if self.function.child is not None else self.function
+        else:
+            bound_children = [c.bind(schema) for c in self.function.children]
+            fn = self.function.with_children(bound_children) \
+                if bound_children else self.function
+        spec = WindowSpec(
+            [p.bind(schema) for p in self.spec.partition_exprs],
+            [SortOrder(o.expr.bind(schema), o.ascending, o.nulls_first)
+             for o in self.spec.orders],
+            self.spec.frame)
+        return WindowExpression(fn, spec)
+
+
+# -- CPU oracle -------------------------------------------------------------
+
+def eval_window_cpu(table: HostTable, wexpr: WindowExpression) -> HostColumn:
+    """Numpy reference for every supported window function (the fallback
+    path and the test oracle). Rows are processed in (partition, order)
+    sorted position but results return in the INPUT row order, matching
+    Spark's WindowExec + downstream ordering behavior."""
+    n = table.num_rows
+    spec = wexpr.spec
+    fn = wexpr.function
+
+    # partition codes
+    if spec.partition_exprs:
+        pcols = [p.eval_cpu(table) for p in spec.partition_exprs]
+        pkeys = []
+        for c in pcols:
+            vals = np.where(c.validity, c.data, None if c.data.dtype == object else 0)
+            pkeys.append([(bool(c.validity[i]), vals[i]) for i in range(n)])
+        part_of = {}
+        pid = np.zeros(n, dtype=np.int64)
+        for i in range(n):
+            key = tuple(pk[i] for pk in pkeys)
+            pid[i] = part_of.setdefault(key, len(part_of))
+    else:
+        pid = np.zeros(n, dtype=np.int64)
+
+    # sorted order within partitions
+    from spark_rapids_tpu.plan.nodes import _stable_sort_indices
+    if spec.orders:
+        ocols = [o.expr.eval_cpu(table) for o in spec.orders]
+        order_idx = _stable_sort_indices(
+            [HostColumn(T.LONG, pid, np.ones(n, dtype=np.bool_))] + ocols,
+            [SortOrder(None, True)] + list(spec.orders), n)
+    else:
+        ocols = []
+        order_idx = np.argsort(pid, kind="stable")
+
+    frame = spec.resolved_frame()
+
+    # peer flags (for rank/range frames): equal order-key values
+    def order_tuple(i):
+        return tuple(
+            (bool(c.validity[i]), None if not c.validity[i] else c.data[i])
+            for c in ocols) if spec.orders else ()
+
+    result = np.empty(n, dtype=object)
+    valid = np.ones(n, dtype=np.bool_)
+
+    pos = 0
+    while pos < n:
+        # find partition run in sorted order
+        p = pid[order_idx[pos]]
+        end = pos
+        while end < n and pid[order_idx[end]] == p:
+            end += 1
+        rows = order_idx[pos:end]
+        m = len(rows)
+
+        if isinstance(fn, RowNumber):
+            for j, r in enumerate(rows):
+                result[r] = j + 1
+        elif isinstance(fn, (Rank, DenseRank)):
+            rank = 0
+            dense = 0
+            prev = object()
+            for j, r in enumerate(rows):
+                cur = order_tuple(r)
+                if cur != prev:
+                    rank = j + 1
+                    dense += 1
+                    prev = cur
+                result[r] = rank if isinstance(fn, Rank) else dense
+        elif isinstance(fn, (Lag, Lead)):
+            src = fn.children[0].eval_cpu(table)
+            off = fn.offset if isinstance(fn, Lead) else -fn.offset
+            for j, r in enumerate(rows):
+                k = j + off
+                if 0 <= k < m:
+                    rr = rows[k]
+                    result[r] = src.data[rr] if src.validity[rr] else None
+                    valid[r] = bool(src.validity[rr])
+                else:
+                    result[r] = fn.default
+                    valid[r] = fn.default is not None
+        elif isinstance(fn, agg.AggregateFunction):
+            src = fn.child.eval_cpu(table) if fn.child is not None else None
+            kind, lo, hi = frame
+            # per-row frame bounds in sorted positions
+            if kind == "range":
+                if not ((lo is None and (hi == 0 or hi is None))):
+                    raise ColumnarProcessingError(
+                        "only UNBOUNDED..CURRENT/UNBOUNDED range frames supported")
+            for j, r in enumerate(rows):
+                if kind == "rows":
+                    a = 0 if lo is None else max(0, j + lo)
+                    b = m - 1 if hi is None else min(m - 1, j + hi)
+                else:  # range: unbounded preceding .. current-row peers / unbounded
+                    a = 0
+                    if hi is None:
+                        b = m - 1
+                    else:  # current row incl peers
+                        b = j
+                        while b + 1 < m and order_tuple(rows[b + 1]) == order_tuple(r):
+                            b += 1
+                window_rows = rows[a:b + 1] if b >= a else rows[0:0]
+                result[r], valid[r] = _agg_window_cpu(fn, src, window_rows)
+        else:
+            raise ColumnarProcessingError(
+                f"window function {type(fn).__name__} unsupported")
+        pos = end
+
+    dt = wexpr.data_type
+    if isinstance(dt, T.StringType):
+        data = np.array([result[i] if valid[i] else None for i in range(n)],
+                        dtype=object)
+        return HostColumn(dt, data, valid)
+    np_dt = dt.np_dtype
+    data = np.array([result[i] if valid[i] and result[i] is not None else 0
+                     for i in range(n)], dtype=np_dt)
+    valid = valid & np.array([result[i] is not None for i in range(n)])
+    return HostColumn(dt, data, valid)
+
+
+def _agg_window_cpu(fn, src, rows):
+    if isinstance(fn, agg.Count):
+        if fn.child is None:
+            return len(rows), True
+        return int(np.sum(src.validity[rows])), True
+    vals = [src.data[r] for r in rows if src.validity[r]]
+    if not vals:
+        return None, False
+    if isinstance(fn, agg.Sum):
+        if isinstance(fn.data_type, T.LongType):
+            # exact python sum, wrapped to int64 like Spark non-ANSI overflow
+            total = sum(int(v) for v in vals)
+            return ((total + (1 << 63)) % (1 << 64)) - (1 << 63), True
+        return float(sum(float(v) for v in vals)), True
+    if isinstance(fn, agg.Min):
+        return min(vals), True
+    if isinstance(fn, agg.Max):
+        return max(vals), True
+    if isinstance(fn, agg.Average):
+        return float(sum(float(v) for v in vals)) / len(vals), True
+    raise ColumnarProcessingError(f"window agg {type(fn).__name__}")
+
+
+def row_number() -> RowNumber:
+    return RowNumber()
+
+
+def rank() -> Rank:
+    return Rank()
+
+
+def dense_rank() -> DenseRank:
+    return DenseRank()
+
+
+def lag(e, offset: int = 1, default=None) -> Lag:
+    from spark_rapids_tpu.ops.expr import col
+    return Lag(col(e) if isinstance(e, str) else e, offset, default)
+
+
+def lead(e, offset: int = 1, default=None) -> Lead:
+    from spark_rapids_tpu.ops.expr import col
+    return Lead(col(e) if isinstance(e, str) else e, offset, default)

@@ -320,7 +320,31 @@ exec_rule(P.Sort, _tag_sort, _convert_sort)
 exec_rule(P.Limit, _tag_simple, _convert_limit)
 exec_rule(P.Union, _tag_simple, _convert_union)
 exec_rule(P.Expand, _tag_expand, _convert_expand)
+def _tag_window(meta, conf):
+    from spark_rapids_tpu.execs.window import device_window_supported
+    _check_output_schema(meta, conf)
+    node: P.WindowNode = meta.node
+    for name, w in node.window_cols:
+        ok, reason = device_window_supported(w)
+        if not ok:
+            meta.reasons.append(f"window {name}: {reason}")
+            continue
+        for p in w.spec.partition_exprs:
+            check_expr(p, conf, meta.reasons, f"window {name} partition key ")
+        for o in w.spec.orders:
+            check_expr(o.expr, conf, meta.reasons, f"window {name} order key ")
+        for c in w.function.children:  # covers aggregate inputs too
+            check_expr(c, conf, meta.reasons, f"window {name} input ")
+
+
+def _convert_window(node: P.WindowNode, children, conf):
+    from spark_rapids_tpu.execs.window import TpuWindowExec
+    coalesced = TpuCoalesceExec(children[0], require_single=True)
+    return TpuWindowExec(coalesced, node.window_cols)
+
+
 exec_rule(P.Join, _tag_join, _convert_join)
+exec_rule(P.WindowNode, _tag_window, _convert_window)
 exec_rule(P.Exchange, _tag_exchange, _convert_exchange)
 
 

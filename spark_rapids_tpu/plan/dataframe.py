@@ -75,6 +75,11 @@ class DataFrame:
             return self._wrap(P.Join(self.plan, other.plan, how, lk, rk))
         raise ValueError("join `on` must be a column name or list of names")
 
+    def with_windows(self, **named_exprs) -> "DataFrame":
+        """Append window-function columns:
+        df.with_windows(rn=F.row_number().over(W.partition_by("k").order_by("v")))"""
+        return self._wrap(P.WindowNode(self.plan, list(named_exprs.items())))
+
     def repartition(self, num_partitions: int, *keys) -> "DataFrame":
         keys = [col(k) if isinstance(k, str) else k for k in keys]
         mode = "hash" if keys else "roundrobin"

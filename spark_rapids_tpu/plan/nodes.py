@@ -313,6 +313,33 @@ class Expand(PlanNode):
                 yield evaluate_cpu(proj, batch, self.names)
 
 
+class WindowNode(PlanNode):
+    """Appends window-function columns (reference: GpuWindowExec appends
+    window expressions to the child's output)."""
+
+    def __init__(self, child: PlanNode, window_cols: Sequence[Tuple[str, "object"]]):
+        self.children = (child,)
+        schema = child.output_schema()
+        self.window_cols = [(name, w.bind(schema)) for name, w in window_cols]
+
+    def output_schema(self):
+        return (self.children[0].output_schema()
+                + [(n, w.data_type) for n, w in self.window_cols])
+
+    def execute_cpu(self):
+        from spark_rapids_tpu.ops.window import eval_window_cpu
+        table = self.children[0].collect_cpu()
+        cols = list(table.columns)
+        names = list(table.names)
+        for name, w in self.window_cols:
+            cols.append(eval_window_cpu(table, w))
+            names.append(name)
+        yield HostTable(names, cols)
+
+    def describe(self):
+        return f"Window[{[n for n, _ in self.window_cols]}]"
+
+
 class Join(PlanNode):
     """Equi-join (hash join analog). Types: inner, left, right, full, leftsemi,
     leftanti, cross."""

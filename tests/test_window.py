@@ -1,0 +1,160 @@
+"""Window exec tests vs the CPU oracle (reference: window_function_test.py
+matrix — SURVEY.md §4)."""
+
+import pytest
+
+from spark_rapids_tpu import functions as F
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar import HostTable
+from spark_rapids_tpu.ops.window import Window
+from tests.asserts import assert_runs_on_tpu, assert_tpu_and_cpu_are_equal
+from tests.data_gen import DoubleGen, IntGen, LongGen, StringGen, gen_table
+
+
+def _t(n=400, seed=0):
+    return gen_table({"k": IntGen(min_val=0, max_val=8, null_prob=0.05),
+                      "o": LongGen(min_val=-100, max_val=100),
+                      "v": LongGen(),
+                      "d": DoubleGen(),
+                      "s": StringGen(cardinality=12)}, n, seed=seed)
+
+
+W_KO = lambda: Window.partition_by("k").order_by("o")  # noqa: E731
+
+
+@pytest.mark.parametrize("fn", [
+    lambda: F.row_number(), lambda: F.rank(), lambda: F.dense_rank(),
+], ids=["row_number", "rank", "dense_rank"])
+def test_ranking_functions(session, cpu_session, fn):
+    host = _t()
+    assert_tpu_and_cpu_are_equal(
+        lambda s: s.create_dataframe(host).with_windows(
+            r=fn().over(W_KO())), session, cpu_session)
+
+
+def test_rank_with_ties(session, cpu_session):
+    host = HostTable.from_pydict({
+        "k": [1, 1, 1, 1, 2, 2], "o": [5, 5, 7, 9, 1, 1]})
+    assert_tpu_and_cpu_are_equal(
+        lambda s: s.create_dataframe(host).with_windows(
+            rn=F.row_number().over(W_KO()),
+            rk=F.rank().over(W_KO()),
+            dr=F.dense_rank().over(W_KO())), session, cpu_session)
+
+
+@pytest.mark.parametrize("off,default", [(1, None), (2, None), (1, -99)],
+                         ids=["lag1", "lag2", "lag1_default"])
+def test_lag_lead(session, cpu_session, off, default):
+    host = _t(300, seed=2)
+    assert_tpu_and_cpu_are_equal(
+        lambda s: s.create_dataframe(host).with_windows(
+            lg=F.lag("v", off, default).over(W_KO()),
+            ld=F.lead("v", off, default).over(W_KO())),
+        session, cpu_session)
+
+
+def test_lag_string(session, cpu_session):
+    host = _t(200, seed=3)
+    assert_tpu_and_cpu_are_equal(
+        lambda s: s.create_dataframe(host).with_windows(
+            p=F.lag("s").over(W_KO())), session, cpu_session)
+
+
+@pytest.mark.parametrize("make_agg", [
+    lambda: F.sum("v"), lambda: F.count("v"), lambda: F.min("v"),
+    lambda: F.max("v"), lambda: F.avg("d"),
+], ids=["sum", "count", "min", "max", "avg"])
+def test_whole_partition_aggs(session, cpu_session, make_agg):
+    host = _t(350, seed=4)
+    w = Window.partition_by("k")  # no order -> whole partition frame
+    assert_tpu_and_cpu_are_equal(
+        lambda s: s.create_dataframe(host).with_windows(
+            a=make_agg().over(w)), session, cpu_session,
+        approximate_float=True)
+
+
+@pytest.mark.parametrize("make_agg", [
+    lambda: F.sum("v"), lambda: F.count("v"), lambda: F.min("v"),
+    lambda: F.max("v"), lambda: F.avg("d"),
+], ids=["sum", "count", "min", "max", "avg"])
+def test_running_aggs_default_range_frame(session, cpu_session, make_agg):
+    """ORDER BY default frame = RANGE UNBOUNDED..CURRENT (peers included)."""
+    host = _t(300, seed=5)
+    assert_tpu_and_cpu_are_equal(
+        lambda s: s.create_dataframe(host).with_windows(
+            a=make_agg().over(W_KO())), session, cpu_session,
+        approximate_float=True)
+
+
+def test_running_rows_frame(session, cpu_session):
+    host = _t(300, seed=6)
+    w = W_KO().rows_between(None, 0)
+    assert_tpu_and_cpu_are_equal(
+        lambda s: s.create_dataframe(host).with_windows(
+            rsum=F.sum("v").over(w), rmin=F.min("v").over(w)),
+        session, cpu_session)
+
+
+@pytest.mark.parametrize("lo,hi", [(-2, 2), (-3, 0), (0, 3), (None, 1)],
+                         ids=["pm2", "m3_0", "0_p3", "unb_p1"])
+def test_bounded_rows_frames(session, cpu_session, lo, hi):
+    host = _t(250, seed=7)
+    w = W_KO().rows_between(lo, hi)
+    assert_tpu_and_cpu_are_equal(
+        lambda s: s.create_dataframe(host).with_windows(
+            bs=F.sum("v").over(w), bc=F.count("v").over(w),
+            ba=F.avg("d").over(w)),
+        session, cpu_session, approximate_float=True)
+
+
+def test_window_runs_on_tpu(session):
+    host = _t(100)
+    assert_runs_on_tpu(
+        lambda s: s.create_dataframe(host).with_windows(
+            rn=F.row_number().over(W_KO()),
+            sm=F.sum("v").over(W_KO())), session)
+
+
+def test_bounded_min_falls_back(session):
+    from spark_rapids_tpu.overrides import wrap_plan
+    host = _t(50)
+    df = session.create_dataframe(host).with_windows(
+        bm=F.min("v").over(W_KO().rows_between(-2, 2)))
+    meta = wrap_plan(df.plan, session.conf)
+    assert not meta.can_run_on_tpu
+    assert any("bounded rows min/max" in r for r in meta.reasons)
+    # CPU fallback still answers
+    assert df.count() == 50
+
+
+def test_mixed_specs_stay_aligned(session, cpu_session):
+    """Two window exprs with DIFFERENT partition/order specs in one node."""
+    host = _t(200, seed=9)
+    assert_tpu_and_cpu_are_equal(
+        lambda s: s.create_dataframe(host).with_windows(
+            by_k=F.sum("v").over(Window.partition_by("k")),
+            by_s=F.count("v").over(Window.partition_by("s"))),
+        session, cpu_session)
+
+
+def test_window_no_partition(session, cpu_session):
+    """Global window (single partition)."""
+    host = _t(150, seed=10)
+    assert_tpu_and_cpu_are_equal(
+        lambda s: s.create_dataframe(host).with_windows(
+            rn=F.row_number().over(Window.order_by("o")),
+            tot=F.sum("v").over(Window.partition_by())),
+        session, cpu_session)
+
+
+def test_window_then_filter_pipeline(session, cpu_session):
+    """Classic top-N per group: window + filter + project."""
+    from spark_rapids_tpu.ops.expr import col
+    host = _t(400, seed=11)
+
+    def build(s):
+        return (s.create_dataframe(host)
+                .with_windows(rn=F.row_number().over(W_KO()))
+                .filter(col("rn") <= 3)
+                .select("k", "o", "rn"))
+    assert_tpu_and_cpu_are_equal(build, session, cpu_session)
