@@ -198,3 +198,218 @@ def lag(e, offset: int = 1, default=None):
 def lead(e, offset: int = 1, default=None):
     from spark_rapids_tpu.ops import window as _w
     return _w.lead(_e(e), offset, default)
+
+
+# string functions (ops/strings.py)
+def _str_fns():
+    from spark_rapids_tpu.ops import strings as s
+    return s
+
+
+def upper(e):
+    return _str_fns().Upper(_e(e))
+
+
+def lower(e):
+    return _str_fns().Lower(_e(e))
+
+
+def length(e):
+    return _str_fns().Length(_e(e))
+
+
+def bit_length(e):
+    return _str_fns().BitLength(_e(e))
+
+
+def octet_length(e):
+    return _str_fns().OctetLength(_e(e))
+
+
+def ascii(e):  # noqa: A001
+    return _str_fns().Ascii(_e(e))
+
+
+def reverse(e):
+    return _str_fns().Reverse(_e(e))
+
+
+def initcap(e):
+    return _str_fns().InitCap(_e(e))
+
+
+def trim(e):
+    return _str_fns().StringTrim(_e(e))
+
+
+def ltrim(e):
+    return _str_fns().StringTrimLeft(_e(e))
+
+
+def rtrim(e):
+    return _str_fns().StringTrimRight(_e(e))
+
+
+def substring(e, pos, length):  # noqa: A002
+    return _str_fns().Substring(_e(e), lit(pos), lit(length))
+
+
+def repeat(e, n):
+    return _str_fns().StringRepeat(_e(e), lit(n))
+
+
+def replace(e, search, replacement=""):
+    return _str_fns().StringReplace(_e(e), lit(search), lit(replacement))
+
+
+def lpad(e, length, pad=" "):  # noqa: A002
+    return _str_fns().StringLPad(_e(e), lit(length), lit(pad))
+
+
+def rpad(e, length, pad=" "):  # noqa: A002
+    return _str_fns().StringRPad(_e(e), lit(length), lit(pad))
+
+
+def substring_index(e, delim, count):
+    return _str_fns().SubstringIndex(_e(e), lit(delim), lit(count))
+
+
+def translate(e, matching, replace):  # noqa: A002
+    return _str_fns().StringTranslate(_e(e), lit(matching), lit(replace))
+
+
+def concat(*exprs):
+    return _str_fns().Concat(*[_e(x) for x in exprs])
+
+
+def contains(e, sub):
+    return _str_fns().Contains(_e(e), lit(sub))
+
+
+def startswith(e, prefix):
+    return _str_fns().StartsWith(_e(e), lit(prefix))
+
+
+def endswith(e, suffix):
+    return _str_fns().EndsWith(_e(e), lit(suffix))
+
+
+def like(e, pattern):
+    return _str_fns().Like(_e(e), lit(pattern))
+
+
+def rlike(e, pattern):
+    return _str_fns().RLike(_e(e), lit(pattern))
+
+
+def instr(e, sub):
+    return _str_fns().StringInstr(_e(e), lit(sub))
+
+
+def locate(sub, e, pos=1):
+    return _str_fns().StringLocate(lit(sub), _e(e), lit(pos))
+
+
+def regexp_replace(e, pattern, replacement):
+    return _str_fns().RegExpReplace(_e(e), lit(pattern), lit(replacement))
+
+
+def regexp_extract(e, pattern, idx=1):
+    return _str_fns().RegExpExtract(_e(e), lit(pattern), lit(idx))
+
+
+# datetime functions (ops/datetime.py)
+def _dt_fns():
+    from spark_rapids_tpu.ops import datetime as d
+    return d
+
+
+def year(e):
+    return _dt_fns().Year(_e(e))
+
+
+def month(e):
+    return _dt_fns().Month(_e(e))
+
+
+def dayofmonth(e):
+    return _dt_fns().DayOfMonth(_e(e))
+
+
+def dayofweek(e):
+    return _dt_fns().DayOfWeek(_e(e))
+
+
+def weekday(e):
+    return _dt_fns().WeekDay(_e(e))
+
+
+def dayofyear(e):
+    return _dt_fns().DayOfYear(_e(e))
+
+
+def quarter(e):
+    return _dt_fns().Quarter(_e(e))
+
+
+def last_day(e):
+    return _dt_fns().LastDay(_e(e))
+
+
+def date_add(e, n):
+    return _dt_fns().DateAdd(_e(e), _e(n))
+
+
+def date_sub(e, n):
+    return _dt_fns().DateSub(_e(e), _e(n))
+
+
+def datediff(end, start):
+    return _dt_fns().DateDiff(_e(end), _e(start))
+
+
+def add_months(e, n):
+    return _dt_fns().AddMonths(_e(e), _e(n))
+
+
+def hour(e):
+    return _dt_fns().Hour(_e(e))
+
+
+def minute(e):
+    return _dt_fns().Minute(_e(e))
+
+
+def second(e):
+    return _dt_fns().Second(_e(e))
+
+
+def to_unix_timestamp(e):
+    return _dt_fns().UnixTimestampFromTs(_e(e))
+
+
+def timestamp_seconds(e):
+    return _dt_fns().SecondsToTimestamp(_e(e))
+
+
+def timestamp_millis(e):
+    return _dt_fns().MillisToTimestamp(_e(e))
+
+
+def timestamp_micros(e):
+    return _dt_fns().MicrosToTimestamp(_e(e))
+
+
+def to_date(e):
+    return _dt_fns().TsToDate(_e(e))
+
+
+# hash functions (ops/hashfns.py)
+def hash(*exprs):  # noqa: A001
+    from spark_rapids_tpu.ops.hashfns import Murmur3Hash
+    return Murmur3Hash(*[_e(x) for x in exprs])
+
+
+def xxhash64(*exprs):
+    from spark_rapids_tpu.ops.hashfns import XxHash64
+    return XxHash64(*[_e(x) for x in exprs])

@@ -1,0 +1,429 @@
+"""Date/time expressions (reference: datetimeExpressions.scala +
+GpuTimeZoneDB — SURVEY.md §2.3/§2.9; Appendix A datetime rules).
+
+TPU-first: DATE is int32 days and TIMESTAMP is int64 UTC micros, so the
+calendar functions are pure integer arithmetic on the VPU using the
+days-from-civil / civil-from-days algorithms (Howard Hinnant's public
+algorithms — branch-free and fully vectorizable). Timestamps are UTC-only
+like the reference's default carve-out (non-UTC session timezones fall back
+— the reference gates most of these on UTC too, GpuTimeZoneDB being the
+exception it ships natively)."""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar import HostColumn, HostTable
+from spark_rapids_tpu.errors import ColumnarProcessingError
+from spark_rapids_tpu.ops.common import BinaryExpression, UnaryExpression, null_and
+from spark_rapids_tpu.ops.expr import DevVal, Expression
+
+MICROS_PER_DAY = 86_400_000_000
+MICROS_PER_SECOND = 1_000_000
+
+
+def civil_from_days(days):
+    """(year, month, day) from days-since-epoch. Integer-only, vectorized;
+    valid over the whole int32 day range."""
+    z = days.astype(jnp.int64) + 719468
+    era = jnp.floor_divide(z, 146097)
+    doe = z - era * 146097                                   # [0, 146096]
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)          # [0, 365]
+    mp = (5 * doy + 2) // 153                                # [0, 11]
+    d = doy - (153 * mp + 2) // 5 + 1                        # [1, 31]
+    m = jnp.where(mp < 10, mp + 3, mp - 9)                   # [1, 12]
+    y = jnp.where(m <= 2, y + 1, y)
+    return y.astype(jnp.int32), m.astype(jnp.int32), d.astype(jnp.int32)
+
+
+def days_from_civil(y, m, d):
+    """days-since-epoch from (year, month, day); inverse of civil_from_days."""
+    y = y.astype(jnp.int64)
+    m = m.astype(jnp.int64)
+    d = d.astype(jnp.int64)
+    y = jnp.where(m <= 2, y - 1, y)
+    era = jnp.floor_divide(y, 400)
+    yoe = y - era * 400
+    mp = jnp.where(m > 2, m - 3, m + 9)
+    doy = (153 * mp + 2) // 5 + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return (era * 146097 + doe - 719468).astype(jnp.int32)
+
+
+def _np_civil(days: np.ndarray) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    z = days.astype(np.int64) + 719468
+    era = np.floor_divide(z, 146097)
+    doe = z - era * 146097
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+    mp = (5 * doy + 2) // 153
+    d = doy - (153 * mp + 2) // 5 + 1
+    m = np.where(mp < 10, mp + 3, mp - 9)
+    y = np.where(m <= 2, y + 1, y)
+    return y.astype(np.int32), m.astype(np.int32), d.astype(np.int32)
+
+
+def _np_days_from_civil(y, m, d):
+    y = y.astype(np.int64)
+    m = m.astype(np.int64)
+    d = d.astype(np.int64)
+    y = np.where(m <= 2, y - 1, y)
+    era = np.floor_divide(y, 400)
+    yoe = y - era * 400
+    mp = np.where(m > 2, m - 3, m + 9)
+    doy = (153 * mp + 2) // 5 + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return (era * 146097 + doe - 719468).astype(np.int32)
+
+
+class _DateField(UnaryExpression):
+    """Base: DATE -> INT field extraction."""
+
+    @property
+    def data_type(self):
+        return T.INT
+
+    def resolve(self, bound_children):
+        c = bound_children[0]
+        if not isinstance(c.data_type, T.DateType):
+            raise ColumnarProcessingError(
+                f"{self.name} requires a date input, got {c.data_type}")
+        return self.with_children(bound_children)
+
+    def _field_np(self, days: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+    def _field_dev(self, days):
+        raise NotImplementedError
+
+    def eval_cpu(self, table: HostTable) -> HostColumn:
+        c = self.children[0].eval_cpu(table)
+        return HostColumn(self.data_type,
+                          self._field_np(c.data).astype(np.int32),
+                          c.validity.copy())
+
+    def eval_dev(self, ctx, child_vals, prep) -> DevVal:
+        cv = child_vals[0]
+        return DevVal(self._field_dev(cv.data).astype(jnp.int32), cv.validity)
+
+
+class Year(_DateField):
+    def _field_np(self, days):
+        return _np_civil(days)[0]
+
+    def _field_dev(self, days):
+        return civil_from_days(days)[0]
+
+
+class Month(_DateField):
+    def _field_np(self, days):
+        return _np_civil(days)[1]
+
+    def _field_dev(self, days):
+        return civil_from_days(days)[1]
+
+
+class DayOfMonth(_DateField):
+    def _field_np(self, days):
+        return _np_civil(days)[2]
+
+    def _field_dev(self, days):
+        return civil_from_days(days)[2]
+
+
+class Quarter(_DateField):
+    def _field_np(self, days):
+        return (_np_civil(days)[1] - 1) // 3 + 1
+
+    def _field_dev(self, days):
+        return (civil_from_days(days)[1] - 1) // 3 + 1
+
+
+class DayOfWeek(_DateField):
+    """Sunday = 1 .. Saturday = 7 (1970-01-01 was a Thursday = 5)."""
+
+    def _field_np(self, days):
+        return np.mod(days.astype(np.int64) + 4, 7).astype(np.int32) + 1
+
+    def _field_dev(self, days):
+        return jnp.mod(days.astype(jnp.int64) + 4, 7).astype(jnp.int32) + 1
+
+
+class WeekDay(_DateField):
+    """Monday = 0 .. Sunday = 6."""
+
+    def _field_np(self, days):
+        return np.mod(days.astype(np.int64) + 3, 7).astype(np.int32)
+
+    def _field_dev(self, days):
+        return jnp.mod(days.astype(jnp.int64) + 3, 7).astype(jnp.int32)
+
+
+class DayOfYear(_DateField):
+    def _field_np(self, days):
+        y, _, _ = _np_civil(days)
+        jan1 = _np_days_from_civil(y, np.full_like(y, 1), np.full_like(y, 1))
+        return (days - jan1 + 1).astype(np.int32)
+
+    def _field_dev(self, days):
+        y, _, _ = civil_from_days(days)
+        one = jnp.ones_like(y)
+        return (days - days_from_civil(y, one, one) + 1).astype(jnp.int32)
+
+
+class LastDay(_DateField):
+    """Last day of the input date's month (returns DATE)."""
+
+    @property
+    def data_type(self):
+        return T.DATE
+
+    def _field_np(self, days):
+        y, m, _ = _np_civil(days)
+        ny = np.where(m == 12, y + 1, y)
+        nm = np.where(m == 12, 1, m + 1)
+        return (_np_days_from_civil(ny, nm, np.ones_like(ny)) - 1).astype(np.int32)
+
+    def _field_dev(self, days):
+        y, m, _ = civil_from_days(days)
+        ny = jnp.where(m == 12, y + 1, y)
+        nm = jnp.where(m == 12, 1, m + 1)
+        return (days_from_civil(ny, nm, jnp.ones_like(ny)) - 1).astype(jnp.int32)
+
+
+class DateAdd(BinaryExpression):
+    """date + n days (n negative for DateSub)."""
+
+    @property
+    def data_type(self):
+        return T.DATE
+
+    def eval_cpu(self, table):
+        d = self.children[0].eval_cpu(table)
+        n = self.children[1].eval_cpu(table)
+        validity = d.validity & n.validity
+        return HostColumn(T.DATE,
+                          (d.data.astype(np.int64) + n.data.astype(np.int64)
+                           ).astype(np.int32),
+                          validity)
+
+    def eval_dev(self, ctx, child_vals, prep):
+        d, n = child_vals
+        out = (d.data.astype(jnp.int64) + n.data.astype(jnp.int64)).astype(jnp.int32)
+        return DevVal(out, null_and(d.validity, n.validity))
+
+
+class DateSub(BinaryExpression):
+    @property
+    def data_type(self):
+        return T.DATE
+
+    def eval_cpu(self, table):
+        d = self.children[0].eval_cpu(table)
+        n = self.children[1].eval_cpu(table)
+        return HostColumn(T.DATE,
+                          (d.data.astype(np.int64) - n.data.astype(np.int64)
+                           ).astype(np.int32),
+                          d.validity & n.validity)
+
+    def eval_dev(self, ctx, child_vals, prep):
+        d, n = child_vals
+        out = (d.data.astype(jnp.int64) - n.data.astype(jnp.int64)).astype(jnp.int32)
+        return DevVal(out, null_and(d.validity, n.validity))
+
+
+class DateDiff(BinaryExpression):
+    """datediff(end, start) = end - start in days."""
+
+    @property
+    def data_type(self):
+        return T.INT
+
+    def eval_cpu(self, table):
+        e = self.children[0].eval_cpu(table)
+        s = self.children[1].eval_cpu(table)
+        return HostColumn(T.INT, (e.data - s.data).astype(np.int32),
+                          e.validity & s.validity)
+
+    def eval_dev(self, ctx, child_vals, prep):
+        e, s = child_vals
+        return DevVal((e.data - s.data).astype(jnp.int32),
+                      null_and(e.validity, s.validity))
+
+
+class AddMonths(BinaryExpression):
+    """add_months(date, n): clamps the day to the target month's last day."""
+
+    @property
+    def data_type(self):
+        return T.DATE
+
+    @staticmethod
+    def _add(y, m, d, n, np_mod):
+        total = (m - 1) + n
+        ny = y + np_mod.floor_divide(total, 12)
+        nm = np_mod.mod(total, 12) + 1
+        return ny, nm, d
+
+    def eval_cpu(self, table):
+        dcol = self.children[0].eval_cpu(table)
+        ncol = self.children[1].eval_cpu(table)
+        y, m, d = _np_civil(dcol.data)
+        ny, nm, nd = self._add(y.astype(np.int64), m.astype(np.int64),
+                               d.astype(np.int64),
+                               ncol.data.astype(np.int64), np)
+        # clamp to last day of target month
+        last = _np_civil(_np_days_from_civil(
+            np.where(nm == 12, ny + 1, ny), np.where(nm == 12, 1, nm + 1),
+            np.ones_like(ny)) - 1)[2]
+        nd = np.minimum(nd, last.astype(np.int64))
+        out = _np_days_from_civil(ny, nm, nd)
+        return HostColumn(T.DATE, out, dcol.validity & ncol.validity)
+
+    def eval_dev(self, ctx, child_vals, prep):
+        dv, nv = child_vals
+        y, m, d = civil_from_days(dv.data)
+        ny, nm, nd = self._add(y.astype(jnp.int64), m.astype(jnp.int64),
+                               d.astype(jnp.int64),
+                               nv.data.astype(jnp.int64), jnp)
+        last = civil_from_days(days_from_civil(
+            jnp.where(nm == 12, ny + 1, ny), jnp.where(nm == 12, 1, nm + 1),
+            jnp.ones_like(ny)) - 1)[2]
+        nd = jnp.minimum(nd, last.astype(jnp.int64))
+        return DevVal(days_from_civil(ny, nm, nd),
+                      null_and(dv.validity, nv.validity))
+
+
+class _TimestampField(UnaryExpression):
+    """TIMESTAMP (UTC micros) -> INT field."""
+
+    divisor = 1
+    modulus = 0
+
+    @property
+    def data_type(self):
+        return T.INT
+
+    def resolve(self, bound_children):
+        c = bound_children[0]
+        if not isinstance(c.data_type, T.TimestampType):
+            raise ColumnarProcessingError(
+                f"{self.name} requires a timestamp input, got {c.data_type}")
+        return self.with_children(bound_children)
+
+    def eval_cpu(self, table):
+        c = self.children[0].eval_cpu(table)
+        v = np.floor_divide(c.data, self.divisor)
+        if self.modulus:
+            v = np.mod(v, self.modulus)
+        return HostColumn(T.INT, v.astype(np.int32), c.validity.copy())
+
+    def eval_dev(self, ctx, child_vals, prep):
+        cv = child_vals[0]
+        v = jnp.floor_divide(cv.data, self.divisor)
+        if self.modulus:
+            v = jnp.mod(v, self.modulus)
+        return DevVal(v.astype(jnp.int32), cv.validity)
+
+
+class Hour(_TimestampField):
+    divisor = 3_600_000_000
+    modulus = 24
+
+
+class Minute(_TimestampField):
+    divisor = 60_000_000
+    modulus = 60
+
+
+class Second(_TimestampField):
+    divisor = MICROS_PER_SECOND
+    modulus = 60
+
+
+class UnixTimestampFromTs(UnaryExpression):
+    """to_unix_timestamp(ts): floor seconds since epoch as LONG."""
+
+    @property
+    def data_type(self):
+        return T.LONG
+
+    def eval_cpu(self, table):
+        c = self.children[0].eval_cpu(table)
+        return HostColumn(T.LONG, np.floor_divide(c.data, MICROS_PER_SECOND),
+                          c.validity.copy())
+
+    def eval_dev(self, ctx, child_vals, prep):
+        cv = child_vals[0]
+        return DevVal(jnp.floor_divide(cv.data, MICROS_PER_SECOND), cv.validity)
+
+
+class SecondsToTimestamp(UnaryExpression):
+    @property
+    def data_type(self):
+        return T.TIMESTAMP
+
+    def eval_cpu(self, table):
+        c = self.children[0].eval_cpu(table)
+        return HostColumn(T.TIMESTAMP,
+                          c.data.astype(np.int64) * MICROS_PER_SECOND,
+                          c.validity.copy())
+
+    def eval_dev(self, ctx, child_vals, prep):
+        cv = child_vals[0]
+        return DevVal(cv.data.astype(jnp.int64) * MICROS_PER_SECOND, cv.validity)
+
+
+class MillisToTimestamp(UnaryExpression):
+    @property
+    def data_type(self):
+        return T.TIMESTAMP
+
+    def eval_cpu(self, table):
+        c = self.children[0].eval_cpu(table)
+        return HostColumn(T.TIMESTAMP, c.data.astype(np.int64) * 1000,
+                          c.validity.copy())
+
+    def eval_dev(self, ctx, child_vals, prep):
+        cv = child_vals[0]
+        return DevVal(cv.data.astype(jnp.int64) * 1000, cv.validity)
+
+
+class MicrosToTimestamp(UnaryExpression):
+    @property
+    def data_type(self):
+        return T.TIMESTAMP
+
+    def eval_cpu(self, table):
+        c = self.children[0].eval_cpu(table)
+        return HostColumn(T.TIMESTAMP, c.data.astype(np.int64), c.validity.copy())
+
+    def eval_dev(self, ctx, child_vals, prep):
+        cv = child_vals[0]
+        return DevVal(cv.data.astype(jnp.int64), cv.validity)
+
+
+class TsToDate(UnaryExpression):
+    """Cast-helper: timestamp -> date (UTC floor to day)."""
+
+    @property
+    def data_type(self):
+        return T.DATE
+
+    def eval_cpu(self, table):
+        c = self.children[0].eval_cpu(table)
+        return HostColumn(T.DATE,
+                          np.floor_divide(c.data, MICROS_PER_DAY).astype(np.int32),
+                          c.validity.copy())
+
+    def eval_dev(self, ctx, child_vals, prep):
+        cv = child_vals[0]
+        return DevVal(jnp.floor_divide(cv.data, MICROS_PER_DAY).astype(jnp.int32),
+                      cv.validity)

@@ -39,13 +39,13 @@ class PrepCtx:
         self.aux_arrays: List[np.ndarray] = []
 
     def add_aux(self, arr: np.ndarray) -> int:
-        """Register a host array as a device input, padded to a bucket so
-        that compiled programs are shared across batches with different
-        dictionary sizes."""
+        """Register a host array as a device input, padded (on the leading
+        dim) to a bucket so that compiled programs are shared across batches
+        with different dictionary sizes."""
         n = len(arr)
         cap = bucket_for(max(n, 1))
         if cap != n:
-            padded = np.zeros(cap, dtype=arr.dtype)
+            padded = np.zeros((cap,) + arr.shape[1:], dtype=arr.dtype)
             padded[:n] = arr
             arr = padded
         self.aux_arrays.append(arr)

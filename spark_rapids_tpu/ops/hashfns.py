@@ -1,0 +1,404 @@
+"""Hash expressions: Murmur3Hash and XxHash64, Spark-exact on device.
+
+Reference: HashFunctions.scala + jni Hash kernels (SURVEY.md §2.9 —
+"murmur3/xxhash64 Spark-exact"). Murmur3 reuses the shuffle layer's device
+kernel (shuffle/hashing.py, validated against Spark's documented composite
+vector). XxHash64 implements Spark's XXH64 variant with seed 42: fixed-width
+types hash as single 8/4-byte "tail" rounds; strings run full XXH64 over
+UTF-8 bytes via the dictionary byte-matrix gather."""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar import HostColumn, HostTable
+from spark_rapids_tpu.ops.expr import (
+    DevVal,
+    EvalCtx,
+    Expression,
+    NodePrep,
+    PrepCtx,
+)
+from spark_rapids_tpu.shuffle.hashing import (
+    murmur3_hash_device,
+    murmur3_hash_host,
+    string_dict_bytes,
+)
+
+P1 = 0x9E3779B185EBCA87
+P2 = 0xC2B2AE3D27D4EB4F
+P3 = 0x165667B19E3779F9
+P4 = 0x85EBCA77C2B2AE63
+P5 = 0x27D4EB2F165667C5
+M64 = (1 << 64) - 1
+XX_SEED = 42
+
+
+class _HashBase(Expression):
+    """n-ary row hash; children hash in order, each output seeding the next."""
+
+    def __init__(self, *children: Expression):
+        self.children = tuple(children)
+
+    def with_children(self, children):
+        return type(self)(*children)
+
+    @property
+    def nullable(self):
+        return False
+
+    def key(self):
+        return (type(self).__name__.lower(),
+                tuple(c.key() for c in self.children))
+
+    def prep(self, pctx: PrepCtx, child_preps) -> NodePrep:
+        slots = []
+        for c, p in zip(self.children, child_preps):
+            if isinstance(c.data_type, T.StringType):
+                mat, lens = string_dict_bytes(
+                    p.out_dict if p.out_dict is not None
+                    else np.array([], dtype=object))
+                slots.append((pctx.add_aux(mat), pctx.add_aux(lens)))
+            else:
+                slots.append(None)
+        flat = tuple(s for pair in slots if pair for s in pair)
+        return NodePrep(aux_slots=flat,
+                        extra={"string_ix": tuple(
+                            i for i, s in enumerate(slots) if s)})
+
+    def _string_bytes(self, ctx: EvalCtx, prep: NodePrep):
+        out = {}
+        it = iter(prep.aux_slots)
+        for i in prep.extra["string_ix"]:
+            out[i] = (ctx.aux[next(it)], ctx.aux[next(it)])
+        return out
+
+
+class Murmur3Hash(_HashBase):
+    @property
+    def data_type(self):
+        return T.INT
+
+    def eval_cpu(self, table: HostTable) -> HostColumn:
+        cols = [c.eval_cpu(table) for c in self.children]
+        n = table.num_rows
+        out = np.empty(n, dtype=np.int32)
+        for r in range(n):
+            out[r] = murmur3_hash_host(
+                [(cols[j].data[r], bool(cols[j].validity[r]),
+                  self.children[j].data_type) for j in range(len(cols))])
+        return HostColumn(T.INT, out, np.ones(n, dtype=np.bool_))
+
+    def eval_dev(self, ctx: EvalCtx, child_vals, prep: NodePrep) -> DevVal:
+        cols = [(v.data, v.validity, c.data_type)
+                for c, v in zip(self.children, child_vals)]
+        h = murmur3_hash_device(cols, string_bytes=self._string_bytes(ctx, prep))
+        return DevVal(h, jnp.ones(ctx.capacity, dtype=jnp.bool_))
+
+
+# -- xxhash64 ---------------------------------------------------------------
+
+def _u64(x):
+    return x.astype(jnp.uint64)
+
+
+def _rotl64(x, r):
+    r = jnp.uint64(r)
+    return (x << r) | (x >> (jnp.uint64(64) - r))
+
+
+def _xx_fmix(h):
+    h = h ^ (h >> jnp.uint64(33))
+    h = (h * jnp.uint64(P2)).astype(jnp.uint64)
+    h = h ^ (h >> jnp.uint64(29))
+    h = (h * jnp.uint64(P3)).astype(jnp.uint64)
+    return h ^ (h >> jnp.uint64(32))
+
+
+def _xx_process_long(value_u64, seed_u64):
+    """Spark XXH64 hashLong: one 8-byte round + avalanche."""
+    h = seed_u64 + jnp.uint64(P5) + jnp.uint64(8)
+    k1 = (value_u64 * jnp.uint64(P2)).astype(jnp.uint64)
+    k1 = _rotl64(k1, 31)
+    k1 = (k1 * jnp.uint64(P1)).astype(jnp.uint64)
+    h = h ^ k1
+    h = (_rotl64(h, 27) * jnp.uint64(P1) + jnp.uint64(P4)).astype(jnp.uint64)
+    return _xx_fmix(h)
+
+
+def _xx_process_int(value_u32, seed_u64):
+    """Spark XXH64 hashInt: one 4-byte round + avalanche."""
+    h = seed_u64 + jnp.uint64(P5) + jnp.uint64(4)
+    k1 = (value_u32.astype(jnp.uint64) * jnp.uint64(P1)).astype(jnp.uint64)
+    h = h ^ k1
+    h = (_rotl64(h, 23) * jnp.uint64(P2) + jnp.uint64(P3)).astype(jnp.uint64)
+    return _xx_fmix(h)
+
+
+def _xx_hash_bytes_device(byte_rows, lengths, seed_u64):
+    """Full XXH64 over per-row byte sequences (dictionary byte matrix,
+    leading dim padded; L static)."""
+    n, L = byte_rows.shape
+    lengths = lengths.astype(jnp.int32)
+
+    def word64(base):
+        b = byte_rows[:, base:base + 8].astype(jnp.uint64)
+        out = jnp.zeros(n, dtype=jnp.uint64)
+        for k in range(8):
+            out = out | (b[:, k] << jnp.uint64(8 * k))
+        return out
+
+    # 32-byte stripes with 4 accumulators
+    seed = seed_u64
+    v1 = seed + jnp.uint64(P1) + jnp.uint64(P2)
+    v2 = seed + jnp.uint64(P2)
+    v3 = seed
+    v4 = seed - jnp.uint64(P1)
+    nstripes = lengths // 32
+    has_stripes = nstripes > 0
+
+    def stripe_round(v, w):
+        v = (v + w * jnp.uint64(P2)).astype(jnp.uint64)
+        v = _rotl64(v, 31)
+        return (v * jnp.uint64(P1)).astype(jnp.uint64)
+
+    for s in range(L // 32):
+        base = s * 32
+        active = (s < nstripes)
+        nv1 = stripe_round(v1, word64(base))
+        nv2 = stripe_round(v2, word64(base + 8))
+        nv3 = stripe_round(v3, word64(base + 16))
+        nv4 = stripe_round(v4, word64(base + 24))
+        v1 = jnp.where(active, nv1, v1)
+        v2 = jnp.where(active, nv2, v2)
+        v3 = jnp.where(active, nv3, v3)
+        v4 = jnp.where(active, nv4, v4)
+
+    merged = (_rotl64(v1, 1) + _rotl64(v2, 7) + _rotl64(v3, 12)
+              + _rotl64(v4, 18)).astype(jnp.uint64)
+
+    def merge_round(h, v):
+        v = stripe_round(jnp.zeros_like(v), v)  # mixK-style
+        h = h ^ v
+        return (h * jnp.uint64(P1) + jnp.uint64(P4)).astype(jnp.uint64)
+
+    merged = merge_round(merged, v1)
+    merged = merge_round(merged, v2)
+    merged = merge_round(merged, v3)
+    merged = merge_round(merged, v4)
+
+    h = jnp.where(has_stripes, merged, seed + jnp.uint64(P5))
+    h = (h + lengths.astype(jnp.uint64)).astype(jnp.uint64)
+
+    # 8-byte tail words: walk gated 8-aligned positions after the stripes
+    pos = nstripes * 32
+    max_tail_words = 3  # < 32 bytes remain => at most 3 full 8-byte words
+    for _ in range(max_tail_words):
+        idx8 = jnp.clip(pos, 0, max(L - 8, 0))
+        b = jnp.stack([jnp.take_along_axis(
+            byte_rows, jnp.clip(idx8 + k, 0, L - 1)[:, None], axis=1)[:, 0]
+            for k in range(8)], axis=1).astype(jnp.uint64)
+        word = jnp.zeros(n, dtype=jnp.uint64)
+        for k in range(8):
+            word = word | (b[:, k] << jnp.uint64(8 * k))
+        active = (pos + 8) <= lengths
+        k1 = stripe_round(jnp.zeros(n, dtype=jnp.uint64), word)
+        nh = ((_rotl64(h ^ k1, 27) * jnp.uint64(P1)) + jnp.uint64(P4)).astype(jnp.uint64)
+        h = jnp.where(active, nh, h)
+        pos = jnp.where(active, pos + 8, pos)
+
+    # 4-byte tail
+    idx4 = jnp.clip(pos, 0, max(L - 4, 0))
+    b4 = jnp.stack([jnp.take_along_axis(
+        byte_rows, jnp.clip(idx4 + k, 0, L - 1)[:, None], axis=1)[:, 0]
+        for k in range(4)], axis=1).astype(jnp.uint64)
+    word4 = jnp.zeros(n, dtype=jnp.uint64)
+    for k in range(4):
+        word4 = word4 | (b4[:, k] << jnp.uint64(8 * k))
+    active4 = (pos + 4) <= lengths
+    nh = h ^ ((word4 * jnp.uint64(P1)).astype(jnp.uint64))
+    nh = ((_rotl64(nh, 23) * jnp.uint64(P2)) + jnp.uint64(P3)).astype(jnp.uint64)
+    h = jnp.where(active4, nh, h)
+    pos = jnp.where(active4, pos + 4, pos)
+
+    # byte tail
+    for _ in range(3):
+        idxb = jnp.clip(pos, 0, L - 1)
+        byte = jnp.take_along_axis(byte_rows, idxb[:, None], axis=1)[:, 0]
+        active1 = pos < lengths
+        nh = h ^ ((byte.astype(jnp.uint64) * jnp.uint64(P5)).astype(jnp.uint64))
+        nh = ((_rotl64(nh, 11) * jnp.uint64(P1))).astype(jnp.uint64)
+        h = jnp.where(active1, nh, h)
+        pos = jnp.where(active1, pos + 1, pos)
+
+    return _xx_fmix(h)
+
+
+def _bitcast(x, dtype):
+    return jax.lax.bitcast_convert_type(x, dtype)
+
+
+def xxhash64_device(cols: List, seed: int = XX_SEED, string_bytes=None):
+    n = cols[0][0].shape[0]
+    h = jnp.full(n, np.uint64(seed), dtype=jnp.uint64)
+    for i, (data, validity, dt) in enumerate(cols):
+        if isinstance(dt, T.StringType):
+            mat, lens = string_bytes[i]
+            codes = jnp.clip(data, 0, mat.shape[0] - 1)
+            nh = _xx_hash_bytes_device(mat[codes], lens[codes], h)
+        elif isinstance(dt, (T.LongType, T.TimestampType, T.DecimalType)):
+            nh = _xx_process_long(_bitcast(data.astype(jnp.int64), jnp.uint64), h)
+        elif isinstance(dt, T.DoubleType):
+            d = jnp.where(data == 0.0, jnp.zeros_like(data), data)
+            nh = _xx_process_long(_bitcast(d, jnp.uint64), h)
+        elif isinstance(dt, T.FloatType):
+            d = jnp.where(data == 0.0, jnp.zeros_like(data), data)
+            nh = _xx_process_int(_bitcast(d, jnp.uint32), h)
+        elif isinstance(dt, T.BooleanType):
+            nh = _xx_process_int(data.astype(jnp.uint32), h)
+        else:
+            nh = _xx_process_int(_bitcast(data.astype(jnp.int32), jnp.uint32), h)
+        h = jnp.where(validity, nh, h)
+    return _bitcast(h, jnp.int64)
+
+
+# -- numpy mirror -----------------------------------------------------------
+
+def _np_rotl64(x, r):
+    x = int(x) & M64
+    return ((x << r) | (x >> (64 - r))) & M64
+
+
+def _np_xx_fmix(h):
+    h = int(h) & M64
+    h ^= h >> 33
+    h = (h * P2) & M64
+    h ^= h >> 29
+    h = (h * P3) & M64
+    h ^= h >> 32
+    return h
+
+
+def _np_xx_long(v, seed):
+    v = int(np.int64(v)) & M64
+    h = (seed + P5 + 8) & M64
+    k1 = (v * P2) & M64
+    k1 = _np_rotl64(k1, 31)
+    k1 = (k1 * P1) & M64
+    h ^= k1
+    h = (_np_rotl64(h, 27) * P1 + P4) & M64
+    return _np_xx_fmix(h)
+
+
+def _np_xx_int(v, seed):
+    v = int(np.uint32(np.int32(v)))
+    h = (seed + P5 + 4) & M64
+    h ^= (v * P1) & M64
+    h = (_np_rotl64(h, 23) * P2 + P3) & M64
+    return _np_xx_fmix(h)
+
+
+def _np_xx_bytes(b: bytes, seed: int) -> int:
+    length = len(b)
+    if length >= 32:
+        v1 = (seed + P1 + P2) & M64
+        v2 = (seed + P2) & M64
+        v3 = seed & M64
+        v4 = (seed - P1) & M64
+        i = 0
+        while i + 32 <= length:
+            for vi, off in ((1, 0), (2, 8), (3, 16), (4, 24)):
+                w = int.from_bytes(b[i + off:i + off + 8], "little")
+                v = {1: v1, 2: v2, 3: v3, 4: v4}[vi]
+                v = (v + w * P2) & M64
+                v = _np_rotl64(v, 31)
+                v = (v * P1) & M64
+                if vi == 1:
+                    v1 = v
+                elif vi == 2:
+                    v2 = v
+                elif vi == 3:
+                    v3 = v
+                else:
+                    v4 = v
+            i += 32
+        h = (_np_rotl64(v1, 1) + _np_rotl64(v2, 7) + _np_rotl64(v3, 12)
+             + _np_rotl64(v4, 18)) & M64
+        for v in (v1, v2, v3, v4):
+            k = (v * P2) & M64
+            k = _np_rotl64(k, 31)
+            k = (k * P1) & M64
+            h ^= k
+            h = (h * P1 + P4) & M64
+        pos = i
+    else:
+        h = (seed + P5) & M64
+        pos = 0
+    h = (h + length) & M64
+    while pos + 8 <= length:
+        w = int.from_bytes(b[pos:pos + 8], "little")
+        k1 = (w * P2) & M64
+        k1 = _np_rotl64(k1, 31)
+        k1 = (k1 * P1) & M64
+        h ^= k1
+        h = (_np_rotl64(h, 27) * P1 + P4) & M64
+        pos += 8
+    if pos + 4 <= length:
+        w = int.from_bytes(b[pos:pos + 4], "little")
+        h ^= (w * P1) & M64
+        h = (_np_rotl64(h, 23) * P2 + P3) & M64
+        pos += 4
+    while pos < length:
+        h ^= (b[pos] * P5) & M64
+        h = (_np_rotl64(h, 11) * P1) & M64
+        pos += 1
+    return _np_xx_fmix(h)
+
+
+def xxhash64_host(values, seed: int = XX_SEED) -> int:
+    h = seed
+    for v, valid, dt in values:
+        if not valid:
+            continue
+        if isinstance(dt, T.StringType):
+            h = _np_xx_bytes(str(v).encode("utf-8"), h)
+        elif isinstance(dt, (T.LongType, T.TimestampType, T.DecimalType)):
+            h = _np_xx_long(v, h)
+        elif isinstance(dt, T.DoubleType):
+            d = 0.0 if v == 0.0 else float(v)
+            h = _np_xx_long(np.float64(d).view(np.int64), h)
+        elif isinstance(dt, T.FloatType):
+            f = 0.0 if v == 0.0 else float(v)
+            h = _np_xx_int(np.float32(f).view(np.int32), h)
+        elif isinstance(dt, T.BooleanType):
+            h = _np_xx_int(1 if v else 0, h)
+        else:
+            h = _np_xx_int(int(v), h)
+    return int(np.uint64(h).view(np.int64))
+
+
+class XxHash64(_HashBase):
+    @property
+    def data_type(self):
+        return T.LONG
+
+    def eval_cpu(self, table: HostTable) -> HostColumn:
+        cols = [c.eval_cpu(table) for c in self.children]
+        n = table.num_rows
+        out = np.empty(n, dtype=np.int64)
+        for r in range(n):
+            out[r] = xxhash64_host(
+                [(cols[j].data[r], bool(cols[j].validity[r]),
+                  self.children[j].data_type) for j in range(len(cols))])
+        return HostColumn(T.LONG, out, np.ones(n, dtype=np.bool_))
+
+    def eval_dev(self, ctx: EvalCtx, child_vals, prep: NodePrep) -> DevVal:
+        cols = [(v.data, v.validity, c.data_type)
+                for c, v in zip(self.children, child_vals)]
+        h = xxhash64_device(cols, string_bytes=self._string_bytes(ctx, prep))
+        return DevVal(h, jnp.ones(ctx.capacity, dtype=jnp.bool_))

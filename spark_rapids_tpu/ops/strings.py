@@ -1,0 +1,628 @@
+"""String expressions (reference: stringFunctions.scala + cudf strings —
+SURVEY.md §2.3 "Misc exprs by family", Appendix A).
+
+TPU-first design: device strings are order-preserving DICTIONARY CODES
+(columnar/column.py), so every elementwise string function evaluates by
+transforming the dictionary ON HOST (O(cardinality), not O(rows)) and
+remapping codes on device with one gather. String->value functions
+(length/ascii/instr/predicates) become an aux lookup table per dictionary
+entry. This is the idiomatic mapping of cuDF's per-row string kernels onto
+an accelerator whose strength is dense integer gathers: the dictionary IS
+the compressed representation.
+
+Functions whose result depends on MULTIPLE string columns per row (e.g.
+concat of two columns) cannot use the dictionary transform and fall back
+(device_supported=False) until a byte-matrix kernel lands.
+
+Regex semantics note: Like is Spark-exact (translated to a Python regex with
+escaped specials). RLike / RegExpExtract / RegExpReplace evaluate the
+pattern with Python `re`, which matches Java regex for the common subset;
+the reference ships a 2,186-line Java->cudf regex transpiler
+(RegexParser.scala) — the same guard-and-translate layer is future work, so
+these are registered but documented as compat-risky like the reference's
+`regexp` incompat flags."""
+
+from __future__ import annotations
+
+import re
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar import HostColumn, HostTable
+from spark_rapids_tpu.ops.common import UnaryExpression
+from spark_rapids_tpu.ops.expr import (
+    DevVal,
+    EvalCtx,
+    Expression,
+    Literal,
+    NodePrep,
+    PrepCtx,
+)
+
+
+# ---------------------------------------------------------------------------
+# Dictionary-transform machinery
+# ---------------------------------------------------------------------------
+
+class DictStringToString(Expression):
+    """str -> str via host dictionary transform + device code remap.
+    Subclasses implement ``transform(s) -> Optional[str]`` (None = null)."""
+
+    _is_expr_base = True  # excluded from the rules registry
+
+    @property
+    def data_type(self):
+        return T.STRING
+
+    def transform(self, s: str) -> Optional[str]:
+        raise NotImplementedError
+
+    def _child_string(self):
+        return self.children[0]
+
+    def eval_cpu(self, table: HostTable) -> HostColumn:
+        c = self._child_string().eval_cpu(table)
+        n = len(c)
+        out = np.empty(n, dtype=object)
+        validity = c.validity.copy()
+        for i in range(n):
+            if validity[i]:
+                r = self.transform(c.data[i])
+                if r is None:
+                    validity[i] = False
+                    out[i] = None
+                else:
+                    out[i] = r
+            else:
+                out[i] = None
+        return HostColumn(T.STRING, out, validity)
+
+    def prep(self, pctx: PrepCtx, child_preps) -> NodePrep:
+        d = child_preps[0].out_dict
+        if d is None:
+            d = np.array([], dtype=object)
+        transformed = [self.transform(s) for s in d]
+        # nulls in the transform become an invalid-marker remap of -1
+        non_null = [t for t in transformed if t is not None]
+        out_dict = np.unique(np.array(non_null, dtype=object)) if non_null \
+            else np.array([], dtype=object)
+        remap = np.array(
+            [np.searchsorted(out_dict, t) if t is not None else -1
+             for t in transformed], dtype=np.int32)
+        slot = pctx.add_aux(remap if len(remap) else np.zeros(1, np.int32))
+        return NodePrep(out_dict=out_dict, dict_sorted=True, aux_slots=(slot,))
+
+    def eval_dev(self, ctx: EvalCtx, child_vals, prep: NodePrep) -> DevVal:
+        remap = ctx.aux[prep.aux_slots[0]]
+        cv = child_vals[0]
+        codes = remap[jnp.clip(cv.data, 0, remap.shape[0] - 1)]
+        validity = cv.validity & (codes >= 0)
+        return DevVal(jnp.maximum(codes, 0), validity)
+
+
+class DictStringToValue(Expression):
+    """str -> fixed-width value via host lookup table + device gather.
+    Subclasses implement ``value_of(s)`` and set ``out_type``."""
+
+    _is_expr_base = True  # excluded from the rules registry
+
+    out_type: T.DataType = T.INT
+
+    @property
+    def data_type(self):
+        return self.out_type
+
+    def value_of(self, s: str):
+        raise NotImplementedError
+
+    def eval_cpu(self, table: HostTable) -> HostColumn:
+        c = self.children[0].eval_cpu(table)
+        n = len(c)
+        np_dt = self.out_type.np_dtype
+        out = np.zeros(n, dtype=np_dt)
+        validity = c.validity.copy()
+        for i in range(n):
+            if validity[i]:
+                v = self.value_of(c.data[i])
+                if v is None:
+                    validity[i] = False
+                else:
+                    out[i] = v
+        return HostColumn(self.out_type, out, validity)
+
+    def prep(self, pctx: PrepCtx, child_preps) -> NodePrep:
+        d = child_preps[0].out_dict
+        if d is None:
+            d = np.array([], dtype=object)
+        np_dt = self.out_type.np_dtype
+        vals = np.zeros(max(len(d), 1), dtype=np_dt)
+        ok = np.ones(max(len(d), 1), dtype=np.bool_)
+        for i, s in enumerate(d):
+            v = self.value_of(s)
+            if v is None:
+                ok[i] = False
+            else:
+                vals[i] = v
+        vslot = pctx.add_aux(vals)
+        oslot = pctx.add_aux(ok)
+        return NodePrep(aux_slots=(vslot, oslot))
+
+    def eval_dev(self, ctx: EvalCtx, child_vals, prep: NodePrep) -> DevVal:
+        vals = ctx.aux[prep.aux_slots[0]]
+        ok = ctx.aux[prep.aux_slots[1]]
+        cv = child_vals[0]
+        idx = jnp.clip(cv.data, 0, vals.shape[0] - 1)
+        return DevVal(vals[idx], cv.validity & ok[idx])
+
+
+class _LiteralParams:
+    """Mixin: every child after the first must be a literal (the dictionary
+    transform folds parameters at prep time)."""
+
+    @property
+    def device_supported(self):
+        return all(isinstance(c, Literal) for c in self.children[1:])
+
+
+# ---------------------------------------------------------------------------
+# str -> str
+# ---------------------------------------------------------------------------
+
+class Upper(DictStringToString, UnaryExpression):
+    def transform(self, s):
+        return s.upper()
+
+
+class Lower(DictStringToString, UnaryExpression):
+    def transform(self, s):
+        return s.lower()
+
+
+class Reverse(DictStringToString, UnaryExpression):
+    def transform(self, s):
+        return s[::-1]
+
+
+class InitCap(DictStringToString, UnaryExpression):
+    def transform(self, s):
+        # Spark initcap: first letter of each whitespace-separated word
+        return " ".join(w.capitalize() for w in s.split(" "))
+
+
+class StringTrim(DictStringToString, UnaryExpression):
+    def transform(self, s):
+        return s.strip(" ")
+
+
+class StringTrimLeft(DictStringToString, UnaryExpression):
+    def transform(self, s):
+        return s.lstrip(" ")
+
+
+class StringTrimRight(DictStringToString, UnaryExpression):
+    def transform(self, s):
+        return s.rstrip(" ")
+
+
+class Substring(_LiteralParams, DictStringToString):
+    """Spark substring: 1-based pos; pos 0 treated as 1; negative from end."""
+
+    def __init__(self, child: Expression, pos: Expression, length: Expression):
+        self.children = (child, pos, length)
+
+    def with_children(self, children):
+        return Substring(*children)
+
+    def key(self):
+        return ("substring", self.children[0].key(),
+                _lit_str_key(self.children[1]), _lit_str_key(self.children[2]))
+
+    def transform(self, s):
+        pos = self.children[1].value
+        ln = self.children[2].value
+        if ln < 0:
+            return ""
+        # Spark substringSQL: end is computed BEFORE clamping a negative
+        # start, so substring('abcd', -5, 3) = 'ab' (start -1, end 2)
+        if pos > 0:
+            start = pos - 1
+        elif pos == 0:
+            start = 0
+        else:
+            start = len(s) + pos
+        end = start + ln
+        return s[max(start, 0):max(end, 0)]
+
+
+class StringRepeat(_LiteralParams, DictStringToString):
+    def __init__(self, child: Expression, times: Expression):
+        self.children = (child, times)
+
+    def with_children(self, children):
+        return StringRepeat(*children)
+
+    def key(self):
+        return ("repeat", self.children[0].key(), _lit_str_key(self.children[1]))
+
+    def transform(self, s):
+        return s * max(int(self.children[1].value), 0)
+
+
+class StringReplace(_LiteralParams, DictStringToString):
+    def __init__(self, child: Expression, search: Expression, replace: Expression):
+        self.children = (child, search, replace)
+
+    def with_children(self, children):
+        return StringReplace(*children)
+
+    def key(self):
+        return ("replace", self.children[0].key(),
+                _lit_str_key(self.children[1]), _lit_str_key(self.children[2]))
+
+    def transform(self, s):
+        search = self.children[1].value
+        if search == "":
+            return s
+        return s.replace(search, self.children[2].value or "")
+
+
+class StringLPad(_LiteralParams, DictStringToString):
+    def __init__(self, child: Expression, length: Expression, pad: Expression):
+        self.children = (child, length, pad)
+
+    def with_children(self, children):
+        return StringLPad(*children)
+
+    def key(self):
+        return ("lpad", self.children[0].key(),
+                _lit_str_key(self.children[1]), _lit_str_key(self.children[2]))
+
+    def transform(self, s):
+        ln = int(self.children[1].value)
+        if ln <= 0:
+            return ""  # Spark: non-positive target length yields empty
+        pad = self.children[2].value
+        if len(s) >= ln:
+            return s[:ln]
+        if not pad:
+            return s
+        fill = (pad * ln)[: ln - len(s)]
+        return fill + s
+
+
+class StringRPad(_LiteralParams, DictStringToString):
+    def __init__(self, child: Expression, length: Expression, pad: Expression):
+        self.children = (child, length, pad)
+
+    def with_children(self, children):
+        return StringRPad(*children)
+
+    def key(self):
+        return ("rpad", self.children[0].key(),
+                _lit_str_key(self.children[1]), _lit_str_key(self.children[2]))
+
+    def transform(self, s):
+        ln = int(self.children[1].value)
+        if ln <= 0:
+            return ""  # Spark: non-positive target length yields empty
+        pad = self.children[2].value
+        if len(s) >= ln:
+            return s[:ln]
+        if not pad:
+            return s
+        fill = (pad * ln)[: ln - len(s)]
+        return s + fill
+
+
+class SubstringIndex(_LiteralParams, DictStringToString):
+    def __init__(self, child: Expression, delim: Expression, count: Expression):
+        self.children = (child, delim, count)
+
+    def with_children(self, children):
+        return SubstringIndex(*children)
+
+    def key(self):
+        return ("substring_index", self.children[0].key(),
+                _lit_str_key(self.children[1]), _lit_str_key(self.children[2]))
+
+    def transform(self, s):
+        delim = self.children[1].value
+        cnt = int(self.children[2].value)
+        if not delim or cnt == 0:
+            return ""
+        parts = s.split(delim)
+        if cnt > 0:
+            return delim.join(parts[:cnt])
+        return delim.join(parts[cnt:])
+
+
+class StringTranslate(_LiteralParams, DictStringToString):
+    def __init__(self, child: Expression, matching: Expression, replace: Expression):
+        self.children = (child, matching, replace)
+
+    def with_children(self, children):
+        return StringTranslate(*children)
+
+    def key(self):
+        return ("translate", self.children[0].key(),
+                _lit_str_key(self.children[1]), _lit_str_key(self.children[2]))
+
+    def transform(self, s):
+        matching = self.children[1].value
+        replace = self.children[2].value or ""
+        table = {}
+        for i, ch in enumerate(matching):
+            if ord(ch) not in table:  # Spark: FIRST mapping of a char wins
+                table[ord(ch)] = replace[i] if i < len(replace) else None
+        return s.translate(table)
+
+
+class RegExpReplace(_LiteralParams, DictStringToString):
+    def __init__(self, child: Expression, pattern: Expression, replacement: Expression):
+        self.children = (child, pattern, replacement)
+
+    def with_children(self, children):
+        return RegExpReplace(*children)
+
+    def key(self):
+        return ("regexp_replace", self.children[0].key(),
+                _lit_str_key(self.children[1]), _lit_str_key(self.children[2]))
+
+    @staticmethod
+    def _java_replacement_to_python(rep: str) -> str:
+        """Java replacement semantics: $N = group ref (incl $0 = whole
+        match), backslash escapes the next char; everything else literal."""
+        out = []
+        i = 0
+        while i < len(rep):
+            ch = rep[i]
+            if ch == "\\" and i + 1 < len(rep):
+                nxt = rep[i + 1]
+                out.append("\\\\" if nxt == "\\" else nxt)
+                i += 2
+                continue
+            if ch == "$" and i + 1 < len(rep) and rep[i + 1].isdigit():
+                j = i + 1
+                while j < len(rep) and rep[j].isdigit():
+                    j += 1
+                out.append(f"\\g<{rep[i + 1:j]}>")
+                i = j
+                continue
+            out.append("\\\\" if ch == "\\" else ch)
+            i += 1
+        return "".join(out)
+
+    def transform(self, s):
+        pat = self.children[1].value
+        rep = self._java_replacement_to_python(self.children[2].value or "")
+        return re.sub(pat, rep, s)
+
+
+class RegExpExtract(_LiteralParams, DictStringToString):
+    def __init__(self, child: Expression, pattern: Expression, idx: Expression):
+        self.children = (child, pattern, idx)
+
+    def with_children(self, children):
+        return RegExpExtract(*children)
+
+    def key(self):
+        return ("regexp_extract", self.children[0].key(),
+                _lit_str_key(self.children[1]), _lit_str_key(self.children[2]))
+
+    def transform(self, s):
+        m = re.search(self.children[1].value, s)
+        if m is None:
+            return ""
+        g = int(self.children[2].value)
+        return m.group(g) or ""
+
+
+class Concat(DictStringToString):
+    """concat of strings: dictionary transform when at most ONE child is a
+    non-literal column; multi-column concat falls back."""
+
+    def __init__(self, *children: Expression):
+        self.children = tuple(children)
+
+    def with_children(self, children):
+        return Concat(*children)
+
+    def key(self):
+        return ("concat",) + tuple(
+            c.key() if not isinstance(c, Literal) else ("lit", c.value)
+            for c in self.children)
+
+    @property
+    def device_supported(self):
+        non_lit = [c for c in self.children if not isinstance(c, Literal)]
+        return len(non_lit) <= 1
+
+    def _child_string(self):
+        for c in self.children:
+            if not isinstance(c, Literal):
+                return c
+        return self.children[0]
+
+    def transform(self, s):
+        parts = []
+        for c in self.children:
+            if isinstance(c, Literal):
+                if c.value is None:
+                    return None  # concat with null -> null
+                parts.append(str(c.value))
+            else:
+                parts.append(s)
+        return "".join(parts)
+
+    def eval_cpu(self, table: HostTable) -> HostColumn:
+        cols = [c.eval_cpu(table) for c in self.children]
+        n = table.num_rows
+        out = np.empty(n, dtype=object)
+        validity = np.ones(n, dtype=np.bool_)
+        for i in range(n):
+            parts = []
+            for c in cols:
+                if not c.validity[i]:
+                    validity[i] = False
+                    break
+                parts.append(str(c.data[i]))
+            out[i] = "".join(parts) if validity[i] else None
+        return HostColumn(T.STRING, out, validity)
+
+    def prep(self, pctx, child_preps):
+        # the non-literal child's prep is the one with the dictionary
+        for c, p in zip(self.children, child_preps):
+            if not isinstance(c, Literal):
+                return DictStringToString.prep(self, pctx, [p])
+        return DictStringToString.prep(self, pctx, [child_preps[0]])
+
+    def eval_dev(self, ctx, child_vals, prep):
+        for c, v in zip(self.children, child_vals):
+            if not isinstance(c, Literal):
+                return DictStringToString.eval_dev(self, ctx, [v], prep)
+        return DictStringToString.eval_dev(self, ctx, [child_vals[0]], prep)
+
+
+# ---------------------------------------------------------------------------
+# str -> int / bool
+# ---------------------------------------------------------------------------
+
+class Length(DictStringToValue, UnaryExpression):
+    out_type = T.INT
+
+    def value_of(self, s):
+        return len(s)
+
+
+class BitLength(DictStringToValue, UnaryExpression):
+    out_type = T.INT
+
+    def value_of(self, s):
+        return len(s.encode("utf-8")) * 8
+
+
+class OctetLength(DictStringToValue, UnaryExpression):
+    out_type = T.INT
+
+    def value_of(self, s):
+        return len(s.encode("utf-8"))
+
+
+class Ascii(DictStringToValue, UnaryExpression):
+    out_type = T.INT
+
+    def value_of(self, s):
+        return ord(s[0]) if s else 0
+
+
+class _StringPredicate(_LiteralParams, DictStringToValue):
+    out_type = T.BOOLEAN
+
+    def __init__(self, child: Expression, param: Expression):
+        self.children = (child, param)
+
+    def with_children(self, children):
+        return type(self)(*children)
+
+    def key(self):
+        return (type(self).__name__.lower(), self.children[0].key(),
+                _lit_str_key(self.children[1]))
+
+    @property
+    def param(self) -> str:
+        return self.children[1].value
+
+
+class Contains(_StringPredicate):
+    def value_of(self, s):
+        return self.param in s
+
+
+class StartsWith(_StringPredicate):
+    def value_of(self, s):
+        return s.startswith(self.param)
+
+
+class EndsWith(_StringPredicate):
+    def value_of(self, s):
+        return s.endswith(self.param)
+
+
+def like_to_regex(pattern: str, escape: str = "\\") -> str:
+    """Spark-exact LIKE -> regex translation (% = .*, _ = ., escape char)."""
+    out = ["^"]
+    i = 0
+    while i < len(pattern):
+        ch = pattern[i]
+        if ch == escape and i + 1 < len(pattern):
+            out.append(re.escape(pattern[i + 1]))
+            i += 2
+            continue
+        if ch == "%":
+            out.append(".*")
+        elif ch == "_":
+            out.append(".")
+        else:
+            out.append(re.escape(ch))
+        i += 1
+    out.append("$")
+    return "".join(out)
+
+
+class Like(_StringPredicate):
+    def value_of(self, s):
+        return re.match(like_to_regex(self.param), s, re.DOTALL) is not None
+
+
+class RLike(_StringPredicate):
+    def value_of(self, s):
+        return re.search(self.param, s) is not None
+
+
+class StringInstr(_LiteralParams, DictStringToValue):
+    """instr: 1-based position of first occurrence, 0 if absent."""
+
+    out_type = T.INT
+
+    def __init__(self, child: Expression, substr: Expression):
+        self.children = (child, substr)
+
+    def with_children(self, children):
+        return StringInstr(*children)
+
+    def key(self):
+        return ("instr", self.children[0].key(), _lit_str_key(self.children[1]))
+
+    def value_of(self, s):
+        return s.find(self.children[1].value) + 1
+
+
+class StringLocate(_LiteralParams, DictStringToValue):
+    """locate(substr, str, start): 1-based, start 1-based."""
+
+    out_type = T.INT
+
+    def __init__(self, substr: Expression, child: Expression, start: Expression):
+        self.children = (child, substr, start)
+
+    def with_children(self, children):
+        return StringLocate(children[1], children[0], children[2])
+
+    def key(self):
+        return ("locate", self.children[0].key(),
+                _lit_str_key(self.children[1]), _lit_str_key(self.children[2]))
+
+    def value_of(self, s):
+        start = int(self.children[2].value)
+        if start <= 0:
+            return 0
+        return s.find(self.children[1].value, start - 1) + 1
+
+
+def _lit_str_key(e: Expression):
+    if isinstance(e, Literal):
+        return ("lit", e.value)
+    return e.key()

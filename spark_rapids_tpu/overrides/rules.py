@@ -44,7 +44,16 @@ _EXPR_SIGS: Dict[type, TypeSig] = {}
 def _build_expr_sigs():
     if _EXPR_SIGS:
         return
-    from spark_rapids_tpu.ops import arithmetic, cast, conditional, math, predicates
+    from spark_rapids_tpu.ops import (
+        arithmetic,
+        cast,
+        conditional,
+        datetime as datetime_ops,
+        hashfns,
+        math,
+        predicates,
+        strings,
+    )
     from spark_rapids_tpu.ops import expr as expr_mod
 
     def reg(cls, sig=COMMON):
@@ -52,12 +61,14 @@ def _build_expr_sigs():
         register_op_kill_switch("expression", cls.__name__, True,
                                f"Enable {cls.__name__} on the accelerator.")
 
-    for mod in (arithmetic, conditional, math, predicates):
+    for mod in (arithmetic, conditional, math, predicates, strings,
+                datetime_ops, hashfns):
         for name in dir(mod):
             obj = getattr(mod, name)
             if (isinstance(obj, type) and issubclass(obj, Expression)
                     and not name.startswith("_")
                     and obj.__module__ == mod.__name__
+                    and "_is_expr_base" not in vars(obj)  # skip abstract bases
                     and "eval_dev" in {m for kls in obj.__mro__ for m in vars(kls)}
                     and getattr(obj, "eval_dev", None) is not Expression.eval_dev):
                 reg(obj)

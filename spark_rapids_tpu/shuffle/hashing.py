@@ -148,7 +148,10 @@ def string_dict_bytes(dictionary: np.ndarray, max_bytes: int = 1 << 16
         return np.zeros((1, 4), dtype=np.uint8), np.zeros(1, dtype=np.int32)
     encoded = [s.encode("utf-8") if s is not None else b"" for s in dictionary]
     lens = np.array([len(b) for b in encoded], dtype=np.int32)
-    L = int(max(4, -(-int(lens.max()) // 4) * 4))
+    # power-of-two width so varying max-string-lengths share compiled traces
+    L = 4
+    while L < int(lens.max()):
+        L <<= 1
     if L > max_bytes:
         raise ValueError(f"string too long for device hash: {lens.max()} bytes")
     mat = np.zeros((len(encoded), L), dtype=np.uint8)

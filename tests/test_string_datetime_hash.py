@@ -1,0 +1,215 @@
+"""String / datetime / hash expression tests vs the CPU oracle
+(reference: string_test.py, date_time_test.py, hashing_test.py — SURVEY §4)."""
+
+import datetime
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import functions as F
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar import HostTable
+from spark_rapids_tpu.ops.expr import col
+from tests.asserts import assert_runs_on_tpu, assert_tpu_and_cpu_are_equal
+from tests.data_gen import (
+    DateGen,
+    IntGen,
+    LongGen,
+    StringGen,
+    TimestampGen,
+    gen_table,
+)
+
+
+def _s_table(n=400, seed=0):
+    return gen_table({"s": StringGen(max_len=15), "v": LongGen()}, n, seed=seed)
+
+
+STRING_FNS = [
+    ("upper", lambda: F.upper("s")),
+    ("lower", lambda: F.lower("s")),
+    ("length", lambda: F.length("s")),
+    ("bit_length", lambda: F.bit_length("s")),
+    ("octet_length", lambda: F.octet_length("s")),
+    ("ascii", lambda: F.ascii("s")),
+    ("reverse", lambda: F.reverse("s")),
+    ("initcap", lambda: F.initcap("s")),
+    ("trim", lambda: F.trim("s")),
+    ("ltrim", lambda: F.ltrim("s")),
+    ("rtrim", lambda: F.rtrim("s")),
+    ("substr_2_3", lambda: F.substring("s", 2, 3)),
+    ("substr_neg", lambda: F.substring("s", -4, 2)),
+    ("substr_0", lambda: F.substring("s", 0, 5)),
+    ("repeat", lambda: F.repeat("s", 2)),
+    ("replace", lambda: F.replace("s", "a", "XY")),
+    ("lpad", lambda: F.lpad("s", 8, "*-")),
+    ("rpad", lambda: F.rpad("s", 8, "*-")),
+    ("substring_index", lambda: F.substring_index("s", "a", 1)),
+    ("substring_index_neg", lambda: F.substring_index("s", "a", -1)),
+    ("translate", lambda: F.translate("s", "abc", "XY")),
+    ("concat_lit", lambda: F.concat(F.lit("pre_"), col("s"), F.lit("_post"))),
+    ("contains", lambda: F.contains("s", "ab")),
+    ("startswith", lambda: F.startswith("s", "A")),
+    ("endswith", lambda: F.endswith("s", "z")),
+    ("like", lambda: F.like("s", "%a_b%")),
+    ("instr", lambda: F.instr("s", "ab")),
+    ("locate", lambda: F.locate("a", "s", 2)),
+    ("regexp_extract", lambda: F.regexp_extract("s", r"([A-Za-z]+)", 1)),
+    ("regexp_replace", lambda: F.regexp_replace("s", r"[0-9]+", "#")),
+]
+
+
+@pytest.mark.parametrize("name,make", STRING_FNS, ids=[n for n, _ in STRING_FNS])
+def test_string_functions(session, cpu_session, name, make):
+    host = _s_table()
+    assert_tpu_and_cpu_are_equal(
+        lambda s: s.create_dataframe(host).select(col("s"), make().alias("r")),
+        session, cpu_session)
+
+
+def test_string_fn_runs_on_tpu(session):
+    host = _s_table(100)
+    assert_runs_on_tpu(
+        lambda s: s.create_dataframe(host).select(
+            F.upper("s").alias("u"), F.length("s").alias("l"),
+            F.like("s", "a%").alias("p")), session)
+
+
+def test_string_fn_composes_with_filter_agg(session, cpu_session):
+    host = _s_table(600, seed=3)
+    assert_tpu_and_cpu_are_equal(
+        lambda s: (s.create_dataframe(host)
+                   .filter(F.length("s") > 5)
+                   .group_by(F.substring("s", 1, 1).alias("first"))
+                   .agg(F.count("s").alias("c"))),
+        session, cpu_session)
+
+
+def test_multicolumn_concat_falls_back(session):
+    from spark_rapids_tpu.overrides import wrap_plan
+    host = gen_table({"a": StringGen(), "b": StringGen()}, 50)
+    df = session.create_dataframe(host).select(
+        F.concat(col("a"), col("b")).alias("ab"))
+    meta = wrap_plan(df.plan, session.conf)
+    assert not meta.can_run_on_tpu
+    # still correct through CPU
+    rows = df.collect()
+    assert len(rows) == 50
+
+
+def test_empty_and_unicode_strings(session, cpu_session):
+    host = HostTable.from_pydict(
+        {"s": ["", "héllo wörld", "日本語", None, "  pad  ", "ABC123xyz"]})
+    for name, make in [("upper", lambda: F.upper("s")),
+                       ("len", lambda: F.length("s")),
+                       ("octet", lambda: F.octet_length("s")),
+                       ("rev", lambda: F.reverse("s")),
+                       ("trim", lambda: F.trim("s"))]:
+        assert_tpu_and_cpu_are_equal(
+            lambda s: s.create_dataframe(host).select(make().alias("r")),
+            session, cpu_session)
+
+
+# -- datetime ---------------------------------------------------------------
+
+def _d_table(n=500, seed=1):
+    return gen_table({"d": DateGen(), "ts": TimestampGen(),
+                      "n": IntGen(min_val=-1000, max_val=1000, null_prob=0.0)},
+                     n, seed=seed)
+
+
+DATE_FNS = [
+    ("year", lambda: F.year("d")),
+    ("month", lambda: F.month("d")),
+    ("dayofmonth", lambda: F.dayofmonth("d")),
+    ("dayofweek", lambda: F.dayofweek("d")),
+    ("weekday", lambda: F.weekday("d")),
+    ("dayofyear", lambda: F.dayofyear("d")),
+    ("quarter", lambda: F.quarter("d")),
+    ("last_day", lambda: F.last_day("d")),
+    ("date_add", lambda: F.date_add("d", col("n"))),
+    ("date_sub", lambda: F.date_sub("d", col("n"))),
+    ("add_months", lambda: F.add_months("d", col("n"))),
+    ("hour", lambda: F.hour("ts")),
+    ("minute", lambda: F.minute("ts")),
+    ("second", lambda: F.second("ts")),
+    ("to_unix", lambda: F.to_unix_timestamp("ts")),
+    ("to_date", lambda: F.to_date("ts")),
+]
+
+
+@pytest.mark.parametrize("name,make", DATE_FNS, ids=[n for n, _ in DATE_FNS])
+def test_datetime_functions(session, cpu_session, name, make):
+    host = _d_table()
+    assert_tpu_and_cpu_are_equal(
+        lambda s: s.create_dataframe(host).select(make().alias("r")),
+        session, cpu_session)
+
+
+def test_civil_calendar_against_python_datetime(session):
+    """Device calendar math vs python datetime over known dates."""
+    dates = [datetime.date(1970, 1, 1), datetime.date(2000, 2, 29),
+             datetime.date(1900, 3, 1), datetime.date(2024, 12, 31),
+             datetime.date(1, 1, 1), datetime.date(9999, 12, 31),
+             datetime.date(1969, 12, 31)]
+    host = HostTable.from_pydict({"d": dates}, dtypes={"d": T.DATE})
+    rows = session.create_dataframe(host).select(
+        F.year("d").alias("y"), F.month("d").alias("m"),
+        F.dayofmonth("d").alias("dd"), F.dayofweek("d").alias("dw"),
+        F.dayofyear("d").alias("dy")).collect()
+    for date, (y, m, dd, dw, dy) in zip(dates, rows):
+        assert (y, m, dd) == (date.year, date.month, date.day)
+        assert dw == date.isoweekday() % 7 + 1
+        assert dy == date.timetuple().tm_yday
+
+
+def test_datediff_and_roundtrips(session, cpu_session):
+    host = _d_table(300, seed=5)
+    assert_tpu_and_cpu_are_equal(
+        lambda s: s.create_dataframe(host).select(
+            F.datediff(F.date_add("d", col("n")), col("d")).alias("dd"),
+            F.timestamp_seconds(F.to_unix_timestamp("ts")).alias("trunc_s")),
+        session, cpu_session)
+
+
+# -- hash expressions -------------------------------------------------------
+
+def test_xxhash64_spark_documented_vector():
+    from spark_rapids_tpu.ops.hashfns import xxhash64_host
+    # Spark SQL docs: SELECT xxhash64('Spark', array(123), 2)
+    from spark_rapids_tpu.ops.hashfns import _np_xx_bytes, _np_xx_int
+    h = _np_xx_bytes(b"Spark", 42)
+    h = _np_xx_int(123, h)
+    h = _np_xx_int(2, h)
+    assert int(np.uint64(h).view(np.int64)) == 5602566077635097486
+
+
+@pytest.mark.parametrize("fn", ["hash", "xxhash64"])
+def test_hash_exprs_device_matches_host(session, cpu_session, fn):
+    host = gen_table({"i": IntGen(), "l": LongGen(), "s": StringGen(max_len=40),
+                      "d": DateGen()}, 400, seed=7)
+    make = getattr(F, fn)
+    assert_tpu_and_cpu_are_equal(
+        lambda s: s.create_dataframe(host).select(
+            make(col("i"), col("l"), col("s"), col("d")).alias("h")),
+        session, cpu_session)
+
+
+def test_hash_runs_on_tpu(session):
+    host = gen_table({"i": IntGen(), "s": StringGen()}, 100)
+    assert_runs_on_tpu(
+        lambda s: s.create_dataframe(host).select(
+            F.hash(col("i"), col("s")).alias("h"),
+            F.xxhash64(col("i"), col("s")).alias("x")), session)
+
+
+def test_xxhash64_long_strings(session, cpu_session):
+    """Strings past the 32-byte stripe threshold exercise the full XXH64."""
+    host = HostTable.from_pydict({"s": [
+        "x" * 100, "abcdefgh" * 5, "", "short", None,
+        "0123456789abcdefghijklmnopqrstuv",  # exactly 32
+        "0123456789abcdefghijklmnopqrstuvw",  # 33
+    ]})
+    assert_tpu_and_cpu_are_equal(
+        lambda s: s.create_dataframe(host).select(F.xxhash64(col("s")).alias("h")),
+        session, cpu_session)
