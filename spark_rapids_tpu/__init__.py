@@ -17,12 +17,27 @@ order-preserving dictionary encoding so the device only touches fixed-width
 data, and distributed exchange uses jax.sharding collectives over ICI/DCN.
 """
 
+import os as _os
+
 import jax
 
 # Spark semantics are 64-bit (LongType, TimestampType micros, DoubleType).
 # Bit-for-bit parity requires x64 mode; TPU emulates i64/f64 (slower but
 # exact), and opt-in 32-bit fast paths can be layered on later.
 jax.config.update("jax_enable_x64", True)
+
+# Persistent compilation cache: TPU backend compiles of sort-bearing kernels
+# run ~50s each; caching them on disk amortizes across processes (the
+# reference's CUDA kernels are precompiled — this is the XLA counterpart,
+# SURVEY.md §7 "XLA compile-time amortization").
+try:
+    _cache_dir = _os.environ.get(
+        "SPARK_RAPIDS_TPU_CACHE",
+        _os.path.join(_os.path.dirname(__file__), "..", ".jax_cache"))
+    jax.config.update("jax_compilation_cache_dir", _os.path.abspath(_cache_dir))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+except Exception:  # cache is best-effort; older jax may lack the knobs
+    pass
 
 __version__ = "0.1.0"
 

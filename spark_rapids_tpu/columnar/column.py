@@ -186,8 +186,15 @@ class DeviceColumn:
         return DeviceColumn(host.dtype, jnp.asarray(data), jnp.asarray(validity))
 
     def to_host(self, num_rows: int) -> HostColumn:
-        data = np.asarray(self.data)[:num_rows]
-        validity = np.asarray(self.validity)[:num_rows]
+        # device-slice down to the live bucket BEFORE the transfer: results
+        # are often tiny (an aggregate's groups) while capacity is the input
+        # bucket, and D2H bandwidth is the scarcest resource on a tunneled
+        # TPU — never ship padding.
+        k = bucket_for(max(num_rows, 1))
+        dev_data = self.data[:k] if k < self.capacity else self.data
+        dev_valid = self.validity[:k] if k < self.capacity else self.validity
+        data = np.asarray(dev_data)[:num_rows]
+        validity = np.asarray(dev_valid)[:num_rows]
         if isinstance(self.dtype, T.StringType):
             if self.dictionary is None:
                 raise ColumnarProcessingError("string column missing dictionary")

@@ -508,6 +508,23 @@ class ProjectCache:
 
 _GLOBAL_PROJECT_CACHE = ProjectCache()
 
+#: process-wide cache of jitted exec kernels keyed by STRUCTURE (expression
+#: keys + schema + capacity + prep trace keys). Exec instances are per-query,
+#: but two queries with the same shape must share one trace/compile — without
+#: this every query re-traces and re-fetches from the compile cache (the
+#: XLA analog of cuDF's precompiled kernels, SURVEY.md §7).
+_GLOBAL_KERNEL_CACHE: dict = {}
+
+
+def cached_kernel(key: tuple, build):
+    """Return the jitted kernel for ``key``, building (and jitting) it on
+    first use. ``build`` must close only over values captured by the key."""
+    fn = _GLOBAL_KERNEL_CACHE.get(key)
+    if fn is None:
+        fn = jax.jit(build())
+        _GLOBAL_KERNEL_CACHE[key] = fn
+    return fn
+
 
 def compile_project(exprs: Sequence[Expression], table: DeviceTable):
     """Evaluate bound expressions over a device table, returning device

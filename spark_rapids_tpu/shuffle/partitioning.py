@@ -259,8 +259,12 @@ def split_by_partition(table: DeviceTable, partitioner: Partitioner
     pids = partitioner.partition_ids(table)
     outs, counts = _SplitKernel.run(table, pids, partitioner.num_partitions)
     counts = np.asarray(jax.device_get(counts))
-    host_datas = [np.asarray(jax.device_get(d)) for d, _ in outs]
-    host_valids = [np.asarray(jax.device_get(v)) for _, v in outs]
+    # live rows sort to the front: transfer only the live bucket, not padding
+    from spark_rapids_tpu.columnar import bucket_for
+    k = bucket_for(max(int(counts.sum()), 1))
+    k = min(k, table.capacity)
+    host_datas = [np.asarray(jax.device_get(d[:k])) for d, _ in outs]
+    host_valids = [np.asarray(jax.device_get(v[:k])) for _, v in outs]
 
     results: List[HostTable] = []
     start = 0
