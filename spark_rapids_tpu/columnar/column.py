@@ -32,9 +32,13 @@ class HostColumn:
 
     For STRING, ``data`` is a numpy object array of Python str (None allowed
     at invalid slots). For everything else ``data`` is the Spark internal
-    representation (see types.py)."""
+    representation (see types.py).
 
-    __slots__ = ("dtype", "data", "validity")
+    ``_cache`` memoizes derived per-column artifacts (dictionary encoding,
+    all-valid flag) so repeated uploads of the same host column — re-collects,
+    multi-query reuse of an in-memory table — don't redo O(n) host work."""
+
+    __slots__ = ("dtype", "data", "validity", "_cache")
 
     def __init__(self, dtype: T.DataType, data: np.ndarray, validity: Optional[np.ndarray] = None):
         self.dtype = dtype
@@ -42,8 +46,17 @@ class HostColumn:
         if validity is None:
             validity = np.ones(len(data), dtype=np.bool_)
         self.validity = validity
+        self._cache = {}
         if len(data) != len(validity):
             raise ColumnarProcessingError("data/validity length mismatch")
+
+    @property
+    def all_valid(self) -> bool:
+        got = self._cache.get("all_valid")
+        if got is None:
+            got = bool(self.validity.all())
+            self._cache["all_valid"] = got
+        return got
 
     def __len__(self) -> int:
         return len(self.data)
@@ -160,11 +173,16 @@ class DeviceColumn:
         Python str comparison is by code point, which equals UTF-8 byte order
         — the order Spark's UTF8String.compareTo uses — so a sorted-unique
         dictionary makes code comparisons match Spark string comparisons."""
+        got = host._cache.get("encode")
+        if got is not None:
+            return got
         vals = np.where(host.validity, host.data, "")
         # np.unique on object arrays of str sorts lexicographically by
         # code point; return_inverse gives the codes directly.
         dictionary, codes = np.unique(vals.astype(object), return_inverse=True)
-        return codes.astype(np.int32), dictionary
+        got = (codes.astype(np.int32), dictionary)
+        host._cache["encode"] = got
+        return got
 
     @staticmethod
     def from_host(host: HostColumn, capacity: Optional[int] = None) -> "DeviceColumn":
@@ -209,3 +227,52 @@ class DeviceColumn:
 
     def with_arrays(self, data, validity) -> "DeviceColumn":
         return DeviceColumn(self.dtype, data, validity, self.dictionary, self.dict_sorted)
+
+
+def stage_upload(host: HostColumn, cap: int, split_f64: bool):
+    """Host side of the fast H2D path: turn one column into (recipe, staged
+    numpy arrays, dictionary). The tunneled TPU transfers raw f32/i64/u32/i8
+    at full bandwidth but converts f64 (its on-device form is an f32 pair),
+    i32, and bool slowly on the host — so stage every column as a
+    fast-transferring dtype and let the jitted assemble kernel (table.py)
+    rebuild the logical dtype on device:
+
+      f64   -> (hi, lo) f32 pair with hi = f32(x), lo = f32(x - hi); the
+               device sum hi+lo is bit-identical to what the native f64
+               transfer produces on TPU (verified), and exact f64 rides
+               unchanged on CPU backends (split_f64=False there);
+      i32   -> u32 view (astype back is value-exact mod 2^32 = bit-exact);
+      bool  -> i8 (compare != 0 on device);
+      rest  -> direct (i8/i16/i64/f32 transfer fast natively);
+      validity -> omitted when all-valid (device row mask), else i8.
+    """
+    n = len(host)
+    if isinstance(host.dtype, T.StringType):
+        codes, dictionary = DeviceColumn._encode_strings(host)
+        padded = np.zeros(cap, dtype=np.int32)
+        padded[:n] = codes
+        kind, arrays = "u32", [padded.view(np.uint32)]
+    else:
+        np_dtype = host.dtype.np_dtype
+        dictionary = None
+        padded = np.zeros(cap, dtype=np_dtype)
+        padded[:n] = host.data
+        if np_dtype == np.float64 and split_f64:
+            hi = padded.astype(np.float32)
+            lo = (padded - hi.astype(np.float64)).astype(np.float32)
+            kind, arrays = "f64split", [hi, lo]
+        elif np_dtype == np.int32:
+            kind, arrays = "u32", [padded.view(np.uint32)]
+        elif np_dtype == np.bool_:
+            kind, arrays = "bool8", [padded.astype(np.int8)]
+        else:
+            kind, arrays = "direct", [padded]
+    if host.all_valid:
+        vkind = "ones"
+    else:
+        vpad = np.zeros(cap, dtype=np.int8)
+        vpad[:n] = host.validity
+        vkind = "i8"
+        arrays.append(vpad)
+    recipe = (kind, vkind, str(host.dtype))
+    return recipe, arrays, dictionary

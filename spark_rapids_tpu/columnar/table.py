@@ -15,8 +15,52 @@ import jax.numpy as jnp
 import numpy as np
 
 from spark_rapids_tpu import types as T
-from spark_rapids_tpu.columnar.column import DeviceColumn, HostColumn, bucket_for
+from spark_rapids_tpu.columnar.column import (
+    DeviceColumn,
+    HostColumn,
+    bucket_for,
+    stage_upload,
+)
 from spark_rapids_tpu.errors import ColumnarProcessingError
+
+#: jitted per-(recipe, capacity) H2D assemble kernels (see stage_upload):
+#: one device program rebuilds every column's logical dtype + validity from
+#: the fast-transferring staged arrays in a single dispatch.
+_ASSEMBLE_CACHE: Dict[tuple, object] = {}
+
+
+def _get_assemble(recipes: tuple, cap: int):
+    key = (recipes, cap)
+    fn = _ASSEMBLE_CACHE.get(key)
+    if fn is None:
+        def assemble(arrays, nrows):
+            row_mask = jnp.arange(cap, dtype=jnp.int32) < nrows
+            outs = []
+            i = 0
+            for kind, vkind, _ in recipes:
+                if kind == "f64split":
+                    data = arrays[i].astype(jnp.float64) + arrays[i + 1].astype(jnp.float64)
+                    i += 2
+                elif kind == "u32":
+                    data = arrays[i].astype(jnp.int32)
+                    i += 1
+                elif kind == "bool8":
+                    data = arrays[i] != 0
+                    i += 1
+                else:
+                    data = arrays[i]
+                    i += 1
+                if vkind == "ones":
+                    validity = row_mask
+                else:
+                    validity = arrays[i] != 0
+                    i += 1
+                outs.append((data, validity))
+            return outs
+
+        fn = jax.jit(assemble)
+        _ASSEMBLE_CACHE[key] = fn
+    return fn
 
 
 class HostTable:
@@ -159,7 +203,22 @@ class DeviceTable:
     @staticmethod
     def from_host(host: HostTable, capacity: Optional[int] = None) -> "DeviceTable":
         cap = capacity or bucket_for(host.num_rows)
-        cols = [DeviceColumn.from_host(c, cap) for c in host.columns]
+        if not host.columns:
+            return DeviceTable(host.names, [], host.num_rows, cap)
+        split_f64 = jax.default_backend() != "cpu"
+        recipes, staged, dicts = [], [], []
+        for c in host.columns:
+            recipe, arrays, dictionary = stage_upload(c, cap, split_f64)
+            recipes.append(recipe)
+            staged.extend(arrays)
+            dicts.append(dictionary)
+        dev_arrays = tuple(jnp.asarray(a) for a in staged)
+        fn = _get_assemble(tuple(recipes), cap)
+        outs = fn(dev_arrays, jnp.asarray(np.int32(host.num_rows)))
+        cols = [
+            DeviceColumn(c.dtype, data, validity, dictionary=d)
+            for c, (data, validity), d in zip(host.columns, outs, dicts)
+        ]
         return DeviceTable(host.names, cols, host.num_rows, cap)
 
     def to_host(self) -> HostTable:
@@ -169,3 +228,15 @@ class DeviceTable:
     def row_mask(self):
         """Bool mask of live rows — usable inside jit (no host sync)."""
         return jnp.arange(self.capacity, dtype=jnp.int32) < self.nrows_dev
+
+    def shrink(self) -> "DeviceTable":
+        """Re-bucket to the smallest capacity holding the live rows. Syncs
+        the row count (host round-trip) — worth it after cardinality-
+        collapsing ops (aggregate output of a few groups must not drag the
+        input's multi-million-row bucket through downstream sorts/uploads)."""
+        n = self.num_rows
+        k = bucket_for(max(n, 1))
+        if k >= self.capacity:
+            return self
+        cols = [c.with_arrays(c.data[:k], c.validity[:k]) for c in self.columns]
+        return DeviceTable(self.names, cols, n, k)

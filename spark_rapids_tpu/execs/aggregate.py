@@ -63,7 +63,6 @@ class TpuHashAggregateExec(TpuExec):
         self.grouping = list(grouping)
         self.agg_specs = list(agg_specs)
         self.grouping_names = list(grouping_names)
-        self._traces = {}
 
     def output_schema(self):
         out = [(n, g.data_type) for n, g in zip(self.grouping_names, self.grouping)]
@@ -105,6 +104,12 @@ class TpuHashAggregateExec(TpuExec):
         aux = tuple(jnp.asarray(a) for a in pctx.aux_arrays)
         capacity = table.capacity
 
+        from spark_rapids_tpu.ops.expr import shared_traces
+        self._traces = shared_traces(
+            ("agg",
+             tuple(g.key() for g in self.grouping),
+             tuple(fn.key() for _, fn in self.agg_specs),
+             table.schema_key()[0]))
         tkey = (capacity,
                 tuple(_prep_trace_key(p) for p in key_preps),
                 tuple(_prep_trace_key(p) for p in val_preps))
@@ -134,7 +139,9 @@ class TpuHashAggregateExec(TpuExec):
             out_cols.append(DeviceColumn(fnagg.data_type, data, validity,
                                          dictionary=dictionary, dict_sorted=dict_sorted))
             names.append(name)
-        return DeviceTable(names, out_cols, ngroups, capacity)
+        # group counts are usually tiny vs the input bucket; re-bucket so
+        # downstream sorts/transfers don't run at input capacity
+        return DeviceTable(names, out_cols, ngroups, capacity).shrink()
 
     def _build_kernel(self, capacity: int, key_preps, val_preps):
         grouping = self.grouping

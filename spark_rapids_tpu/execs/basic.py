@@ -133,9 +133,9 @@ class _FilterKernel:
 
     def __init__(self, condition: Expression):
         self.condition = condition
-        self._traces = {}
 
     def __call__(self, table: DeviceTable):
+        from spark_rapids_tpu.ops.expr import shared_traces
         pctx = PrepCtx(table)
         preps: List[NodePrep] = []
         _walk_prep(self.condition, pctx, preps)
@@ -143,6 +143,8 @@ class _FilterKernel:
         aux = tuple(jnp.asarray(a) for a in pctx.aux_arrays)
         capacity = table.capacity
 
+        self._traces = shared_traces(
+            ("filter", self.condition.key(), table.schema_key()[0]))
         tkey = (capacity, _prep_trace_key(preps))
         fn = self._traces.get(tkey)
         if fn is None:

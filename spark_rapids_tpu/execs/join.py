@@ -72,7 +72,21 @@ def _dense_rank(vals, valid):
 
 
 class JoinKernel:
-    """Jitted phases of one join shape; caches traces per capacity tuple."""
+    """Jitted phases of one join shape; caches traces per capacity tuple.
+
+    Instances are pooled process-wide by ``n_keys`` (``JoinKernel.get``):
+    every trace depends only on n_keys + capacities + dtypes, so all joins
+    of the same key arity share one compiled set across queries."""
+
+    _instances = {}
+
+    @classmethod
+    def get(cls, n_keys: int) -> "JoinKernel":
+        k = cls._instances.get(n_keys)
+        if k is None:
+            k = cls(n_keys)
+            cls._instances[n_keys] = k
+        return k
 
     def __init__(self, n_keys: int):
         self.n_keys = n_keys
@@ -262,7 +276,7 @@ class TpuJoinExec(TpuExec):
         self.right_names = [n for n, _ in right_schema]
         self._left_schema = left_schema
         self._right_schema = right_schema
-        self._kernel = JoinKernel(len(self.left_keys))
+        self._kernel = JoinKernel.get(len(self.left_keys))
         self._filter_kernel = None
 
     def output_schema(self):

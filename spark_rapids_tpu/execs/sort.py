@@ -59,7 +59,6 @@ class TpuSortExec(TpuExec):
         super().__init__()
         self.children = (child,)
         self.orders = list(orders)
-        self._traces = {}
 
     def output_schema(self):
         return self.children[0].output_schema()
@@ -73,6 +72,12 @@ class TpuSortExec(TpuExec):
         yield retry_block(lambda: self._sort(batches[0]))
 
     def _sort(self, table: DeviceTable) -> DeviceTable:
+        from spark_rapids_tpu.ops.expr import shared_traces
+        self._traces = shared_traces(
+            ("sort",
+             tuple((o.expr.key(), o.ascending, o.resolved_nulls_first())
+                   for o in self.orders),
+             table.schema_key()[0]))
         pctx = PrepCtx(table)
         key_preps: List[List[NodePrep]] = []
         for o in self.orders:

@@ -110,7 +110,6 @@ class TpuWindowExec(TpuExec):
         super().__init__()
         self.children = (child,)
         self.window_cols = list(window_cols)
-        self._traces = {}
 
     def output_schema(self):
         return (self.children[0].output_schema()
@@ -143,6 +142,10 @@ class TpuWindowExec(TpuExec):
         aux = tuple(jnp.asarray(a) for a in pctx.aux_arrays)
         capacity = table.capacity
 
+        from spark_rapids_tpu.ops.expr import shared_traces
+        self._traces = shared_traces(
+            ("window", tuple(w.key() for _, w in self.window_cols),
+             table.schema_key()[0]))
         tkey = (capacity, tuple(
             (tuple(_prep_trace_key(p) for p in pp),
              tuple(_prep_trace_key(p) for p in op),
