@@ -212,18 +212,26 @@ class DeviceColumn:
         dev_data = self.data[:k] if k < self.capacity else self.data
         dev_valid = self.validity[:k] if k < self.capacity else self.validity
         data = np.asarray(dev_data)[:num_rows]
-        validity = np.asarray(dev_valid)[:num_rows]
+        validity = np.ascontiguousarray(np.asarray(dev_valid)[:num_rows])
+        return self.decode_host(data, validity)
+
+    def decode_host(self, data: np.ndarray, validity: np.ndarray) -> HostColumn:
+        """Build the logical HostColumn from downloaded raw arrays (shared
+        by the per-column path above and DeviceTable's packed to_host)."""
         if isinstance(self.dtype, T.StringType):
             if self.dictionary is None:
                 raise ColumnarProcessingError("string column missing dictionary")
             # Clip: padding/invalid slots may hold arbitrary codes.
             codes = np.clip(data, 0, max(len(self.dictionary) - 1, 0))
-            vals = np.empty(num_rows, dtype=object)
+            vals = np.empty(len(data), dtype=object)
             if len(self.dictionary):
                 vals[:] = self.dictionary[codes]
             vals[~validity] = None
-            return HostColumn(self.dtype, vals, validity.copy())
-        return HostColumn(self.dtype, data.copy(), validity.copy())
+            return HostColumn(self.dtype, vals, validity)
+        arr = np.ascontiguousarray(data)
+        if arr.dtype != self.dtype.np_dtype:
+            arr = arr.astype(self.dtype.np_dtype)
+        return HostColumn(self.dtype, arr, validity)
 
     def with_arrays(self, data, validity) -> "DeviceColumn":
         return DeviceColumn(self.dtype, data, validity, self.dictionary, self.dict_sorted)
@@ -249,9 +257,20 @@ def stage_upload(host: HostColumn, cap: int, split_f64: bool):
     n = len(host)
     if isinstance(host.dtype, T.StringType):
         codes, dictionary = DeviceColumn._encode_strings(host)
-        padded = np.zeros(cap, dtype=np.int32)
-        padded[:n] = codes
-        kind, arrays = "u32", [padded.view(np.uint32)]
+        # narrow the code transfer to the dictionary's width: low-cardinality
+        # string columns (the common case) ship 1 byte/row instead of 4
+        if len(dictionary) <= 0xFF:
+            padded = np.zeros(cap, dtype=np.uint8)
+            padded[:n] = codes
+            kind, arrays = "u8codes", [padded]
+        elif len(dictionary) <= 0xFFFF:
+            padded = np.zeros(cap, dtype=np.uint16)
+            padded[:n] = codes
+            kind, arrays = "u16codes", [padded]
+        else:
+            padded = np.zeros(cap, dtype=np.int32)
+            padded[:n] = codes
+            kind, arrays = "u32", [padded.view(np.uint32)]
     else:
         np_dtype = host.dtype.np_dtype
         dictionary = None
@@ -259,7 +278,12 @@ def stage_upload(host: HostColumn, cap: int, split_f64: bool):
         padded[:n] = host.data
         if np_dtype == np.float64 and split_f64:
             hi = padded.astype(np.float32)
-            lo = (padded - hi.astype(np.float64)).astype(np.float32)
+            # inf/overflowed values: hi is +/-inf and x - hi would be NaN;
+            # lo=0 keeps hi+lo == +/-inf on device (NaN hi propagates fine)
+            with np.errstate(invalid="ignore", over="ignore"):
+                lo = np.where(np.isfinite(hi),
+                              padded - hi.astype(np.float64),
+                              0.0).astype(np.float32)
             kind, arrays = "f64split", [hi, lo]
         elif np_dtype == np.int32:
             kind, arrays = "u32", [padded.view(np.uint32)]

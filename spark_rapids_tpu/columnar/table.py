@@ -41,7 +41,7 @@ def _get_assemble(recipes: tuple, cap: int):
                 if kind == "f64split":
                     data = arrays[i].astype(jnp.float64) + arrays[i + 1].astype(jnp.float64)
                     i += 2
-                elif kind == "u32":
+                elif kind in ("u32", "u8codes", "u16codes"):
                     data = arrays[i].astype(jnp.int32)
                     i += 1
                 elif kind == "bool8":
@@ -63,14 +63,167 @@ def _get_assemble(recipes: tuple, cap: int):
     return fn
 
 
-class HostTable:
-    """Named host columns with a shared row count."""
+#: jitted pack kernels for DeviceTable.to_host, keyed by (kinds, k, cap)
+_PACK_CACHE: Dict[tuple, object] = {}
 
-    __slots__ = ("names", "columns")
+#: host tables holding a device-resident cache (weak: dropping the table
+#: drops its device image); evicted under memory pressure (runtime/retry.py)
+_CACHED_TABLES = None  # lazy weakref.WeakSet
+
+
+def register_device_cache(host: "HostTable") -> None:
+    global _CACHED_TABLES
+    if _CACHED_TABLES is None:
+        import weakref
+        _CACHED_TABLES = weakref.WeakSet()
+    _CACHED_TABLES.add(host)
+
+
+def evict_device_caches() -> int:
+    """Drop every cached device image (called on device OOM before spill
+    replay — cached scans are the lowest-priority device residents)."""
+    if _CACHED_TABLES is None:
+        return 0
+    n = 0
+    for t in list(_CACHED_TABLES):
+        if t._cache.pop("device", None) is not None:
+            n += 1
+    return n
+
+
+def _pack_kind(c: DeviceColumn) -> str:
+    dt = c.data.dtype
+    for kind, want in (("f64", jnp.float64), ("i64", jnp.int64),
+                       ("i32", jnp.int32), ("f32", jnp.float32),
+                       ("i16", jnp.int16), ("i8", jnp.int8),
+                       ("bool", jnp.bool_)):
+        if dt == want:
+            return kind
+    raise ColumnarProcessingError(f"unpackable device dtype {dt}")
+
+
+def _u32_units(kind: str) -> int:
+    return {"f64": 2, "i64": 2, "i32": 1, "f32": 1}.get(kind, 0)
+
+
+def _get_pack(kinds: tuple, k: int, cap: int):
+    """One jitted program bitcasting every column (data + validity) into a
+    single u32 buffer: f64 as an exact hi/lo f32 split on TPU (f64 storage
+    IS an f32 pair there; CPU bitcasts natively), i64 as hi/lo words, small
+    ints and validities byte-packed 4-per-u32 at the tail."""
+    cpu = jax.default_backend() == "cpu"
+    key = (kinds, k, cap, cpu)
+    fn = _PACK_CACHE.get(key)
+    if fn is None:
+        def pack(cols):
+            u32s, u8s = [], []
+            for (data, _), kind in zip(cols, kinds):
+                d = data[:k]
+                if kind == "f64":
+                    if cpu:
+                        u32s.append(jax.lax.bitcast_convert_type(
+                            d, jnp.uint32).reshape(-1))
+                    else:
+                        hi = d.astype(jnp.float32)
+                        # inf: hi-hi would be NaN; lo=0 keeps hi+lo == inf
+                        lo = jnp.where(
+                            jnp.isfinite(hi),
+                            (d - hi.astype(jnp.float64)).astype(jnp.float32),
+                            0.0)
+                        u32s.append(jax.lax.bitcast_convert_type(hi, jnp.uint32))
+                        u32s.append(jax.lax.bitcast_convert_type(lo, jnp.uint32))
+                elif kind == "i64":
+                    hi = (d >> 32).astype(jnp.int32)
+                    lo = (d & 0xFFFFFFFF).astype(jnp.uint32)
+                    u32s.append(jax.lax.bitcast_convert_type(hi, jnp.uint32))
+                    u32s.append(lo)
+                elif kind in ("i32", "f32"):
+                    u32s.append(jax.lax.bitcast_convert_type(d, jnp.uint32))
+                elif kind == "i16":
+                    u8s.append(jax.lax.bitcast_convert_type(
+                        d, jnp.uint8).reshape(-1))
+                elif kind == "i8":
+                    u8s.append(jax.lax.bitcast_convert_type(d, jnp.uint8))
+                else:  # bool
+                    u8s.append(d.astype(jnp.uint8))
+            for (_, validity), _kind in zip(cols, kinds):
+                u8s.append(validity[:k].astype(jnp.uint8))
+            u8cat = jnp.concatenate(u8s)
+            padlen = (-u8cat.shape[0]) % 4
+            if padlen:
+                u8cat = jnp.concatenate(
+                    [u8cat, jnp.zeros(padlen, dtype=jnp.uint8)])
+            tail = jax.lax.bitcast_convert_type(
+                u8cat.reshape(-1, 4), jnp.uint32)
+            parts = [a for a in u32s] + [tail]
+            return jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+
+        fn = jax.jit(pack)
+        _PACK_CACHE[key] = fn
+    return fn
+
+
+def _unpack_host(buf: np.ndarray, kinds: tuple, k: int):
+    cpu = jax.default_backend() == "cpu"
+    nu32 = sum(_u32_units(kd) for kd in kinds) * k
+    u32part = buf[:nu32]
+    bytes_part = buf.view(np.uint8)[4 * nu32:]
+    datas = []
+    o32 = 0
+    o8 = 0
+    for kind in kinds:
+        if kind == "f64":
+            if cpu:
+                data = u32part[o32:o32 + 2 * k].view(np.float64)
+                o32 += 2 * k
+            else:
+                hi = u32part[o32:o32 + k].view(np.float32).astype(np.float64)
+                o32 += k
+                lo = u32part[o32:o32 + k].view(np.float32).astype(np.float64)
+                o32 += k
+                data = hi + lo
+        elif kind == "i64":
+            hi = u32part[o32:o32 + k].view(np.int32).astype(np.int64)
+            o32 += k
+            lo = u32part[o32:o32 + k].astype(np.int64)
+            o32 += k
+            data = (hi << 32) | lo
+        elif kind == "i32":
+            data = u32part[o32:o32 + k].view(np.int32)
+            o32 += k
+        elif kind == "f32":
+            data = u32part[o32:o32 + k].view(np.float32)
+            o32 += k
+        elif kind == "i16":
+            data = bytes_part[o8:o8 + 2 * k].view(np.int16)
+            o8 += 2 * k
+        elif kind == "i8":
+            data = bytes_part[o8:o8 + k].view(np.int8)
+            o8 += k
+        else:  # bool
+            data = bytes_part[o8:o8 + k] != 0
+            o8 += k
+        datas.append(data)
+    valids = []
+    for _ in kinds:
+        valids.append(bytes_part[o8:o8 + k] != 0)
+        o8 += k
+    return datas, valids
+
+
+class HostTable:
+    """Named host columns with a shared row count.
+
+    ``_cache`` holds derived artifacts — notably the device-resident image
+    of the table (see DeviceTable.from_host cache wiring in
+    execs/basic.TpuScanExec), the GpuInMemoryTableScanExec analog."""
+
+    __slots__ = ("names", "columns", "_cache", "__weakref__")
 
     def __init__(self, names: Sequence[str], columns: Sequence[HostColumn]):
         self.names: Tuple[str, ...] = tuple(names)
         self.columns: Tuple[HostColumn, ...] = tuple(columns)
+        self._cache = {}
         if len(self.names) != len(self.columns):
             raise ColumnarProcessingError("names/columns mismatch")
         lens = {len(c) for c in self.columns}
@@ -222,6 +375,33 @@ class DeviceTable:
         return DeviceTable(host.names, cols, host.num_rows, cap)
 
     def to_host(self) -> HostTable:
+        """Download as one packed transfer.
+
+        The tunneled TPU pays ~0.1s latency PER d2h fetch, so per-column
+        (data + validity) fetches are ruinous. A jitted pack kernel bitcasts
+        every column into one u32 buffer (f64/i64 as exact hi/lo splits —
+        TPU f64 storage is an f32 pair; small ints byte-packed 4-per-u32)
+        sliced to the live bucket, fetched with ONE device_get, and the host
+        unpacks by numpy views."""
+        n = self.num_rows
+        if not self.columns:
+            return HostTable(self.names, [])
+        k = min(bucket_for(max(n, 1)), self.capacity)
+        kinds = tuple(_pack_kind(c) for c in self.columns)
+        fn = _get_pack(kinds, k, self.capacity)
+        buf = np.asarray(fn(tuple((c.data, c.validity) for c in self.columns)))
+        datas, valids = _unpack_host(buf, kinds, k)
+        cols = []
+        for c, data, validity in zip(self.columns, datas, valids):
+            cols.append(c.decode_host(
+                data[:n], np.ascontiguousarray(validity[:n])))
+        return HostTable(self.names, cols)
+
+    def to_host_per_column(self) -> HostTable:
+        """Low-allocation download: transfer each column's existing buffers
+        (no pack kernel, no table-sized staging allocation). Used by spill
+        demotion during OOM recovery, where allocating on the exhausted
+        device would fail (the packed path is for collects)."""
         n = self.num_rows
         return HostTable(self.names, [c.to_host(n) for c in self.columns])
 

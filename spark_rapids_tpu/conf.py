@@ -193,6 +193,32 @@ CPU_ORACLE_STRICT = bool_conf(
     "Test-only: compare device results bit-for-bit against the CPU path.",
     internal=True)
 
+SPLIT_F64_SUM = str_conf(
+    "spark.rapids.tpu.sum.splitF64", "auto",
+    "f64 SUM/AVG/VAR reduction mode. 'auto': on TPU (where f64 compute is "
+    "emulated) run the fast exact hi/lo f32 decomposition with blocked "
+    "accumulation (~1e-9 typical, <=~1e-7 worst-case relative error; "
+    "batches with |x|>1e34 reroute to the exact path at runtime); CPU "
+    "backends keep native f64. 'true'/'false' force the mode. The same "
+    "trade the reference gates with variableFloatAgg.enabled.")
+
+AGG_MAX_DICT_GROUPS = int_conf(
+    "spark.rapids.tpu.agg.maxDictGroups", 1 << 16,
+    "Max key-domain product for the no-sort dictionary-code aggregation "
+    "fast path (grouping keys that are dictionary-encoded strings or "
+    "booleans aggregate by direct segment reduction, no sort).")
+
+AGG_FUSE_INPUT = bool_conf(
+    "spark.rapids.tpu.agg.fuseInput", True,
+    "Fuse Project/Filter chains feeding an aggregate into the aggregate "
+    "kernel: one XLA program evaluates predicates as weight masks (no row "
+    "compaction) and value expressions inline (WholeStageCodegen analog).")
+
+SCAN_DEVICE_CACHE = bool_conf(
+    "spark.rapids.tpu.scan.deviceCache", True,
+    "Cache the uploaded device image of in-memory scan batches on the host "
+    "table (GpuInMemoryTableScanExec analog); evicted on device OOM.")
+
 
 class RapidsConf:
     """Immutable-ish view over a plain {key: value} dict with typed access."""

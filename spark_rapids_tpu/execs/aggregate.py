@@ -1,24 +1,34 @@
 """TPU hash aggregate (reference: GpuHashAggregateExec / GpuMergeAggregate-
 Iterator, GpuAggregateExec.scala — SURVEY.md §2.3).
 
-TPU-first design: instead of a hash table (pointer-chasing is hostile to the
-VPU), grouping is SORT-SEGMENT based — the XLA-friendly classic:
+TPU-first design, two device strategies (neither is a hash table —
+pointer-chasing is hostile to the VPU):
 
-  1. evaluate key/value expressions (fused, ops/expr.py);
-  2. lexicographic multi-operand ``lax.sort`` over (live, key-validity,
-     key-data...) with a row-index payload;
-  3. segment boundaries -> dense group ids via cumsum;
-  4. ``jax.ops.segment_*`` reductions with static num_segments=capacity;
-  5. scatter per-group results to [0, ngroups) positions.
+FAST PATH (dictionary-code grouping, no sort): when every grouping key is a
+dictionary-encoded string or a boolean, the key domain is known on the host
+(dict sizes), so each row's group id is a mixed-radix combination of its
+codes — ``gid = sum(code_i * stride_i)`` with one extra slot per key for
+null. Aggregation is then direct ``segment_*`` reductions with
+``num_segments = padded domain product`` (small!), group compaction is a
+cumsum scatter, and the live group count stays on device — no sort, no
+host sync, no capacity-sized outputs. f64 sums run through the exact-
+decomposition blocked f32 path (ops/segsum.py).
 
-Everything is static-shaped; the live group count rides out as a device
-scalar. String keys group by dictionary code (order-preserving per batch).
+SORT-SEGMENT PATH (general keys): lexicographic multi-operand ``lax.sort``
+over (live, key-validity, key-data...) with a row-index payload; segment
+boundaries -> dense group ids via cumsum; ``jax.ops.segment_*`` reductions.
+
+Input fusion: Project/Filter chains feeding the aggregate are substituted
+into the kernel (execs/fuse.py) — predicates become weight masks evaluated
+in the same XLA program, so a filter+project+aggregate pipeline is ONE
+device dispatch with no intermediate materialization.
+
 Requires a single coalesced input batch (RequireSingleBatch goal) in v1;
 partial-per-batch + merge is the planned widening."""
 
 from __future__ import annotations
 
-from typing import List, Sequence, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -39,6 +49,7 @@ from spark_rapids_tpu.ops.expr import (
     _walk_eval,
     _walk_prep,
 )
+from spark_rapids_tpu.ops.segsum import batched_segment_sum_f64, segment_sum_f64
 
 DEVICE_SUPPORTED_AGGS = (agg.Sum, agg.Min, agg.Max, agg.Count, agg.Average,
                          agg.First, agg.Last, agg.StddevPop, agg.StddevSamp,
@@ -57,12 +68,18 @@ def _sortable(data, validity):
 class TpuHashAggregateExec(TpuExec):
     def __init__(self, child: TpuExec, grouping: Sequence[Expression],
                  agg_specs: Sequence[Tuple[str, agg.AggregateFunction]],
-                 grouping_names: Sequence[str]):
+                 grouping_names: Sequence[str],
+                 filters: Sequence[Expression] = (),
+                 use_split: bool = False,
+                 max_dict_groups: int = 1 << 16):
         super().__init__()
         self.children = (child,)
         self.grouping = list(grouping)
         self.agg_specs = list(agg_specs)
         self.grouping_names = list(grouping_names)
+        self.filters = list(filters)
+        self.use_split = use_split
+        self.max_dict_groups = max_dict_groups
 
     def output_schema(self):
         out = [(n, g.data_type) for n, g in zip(self.grouping_names, self.grouping)]
@@ -80,45 +97,100 @@ class TpuHashAggregateExec(TpuExec):
         yield retry_block(lambda: self._aggregate(batches[0]))
 
     # -- core ---------------------------------------------------------------
-    def _aggregate(self, table: DeviceTable) -> DeviceTable:
-        value_exprs: List[Expression] = []
-        for _, fn in self.agg_specs:
-            value_exprs.append(fn.child if fn.child is not None else None)
-
+    def _prep_all(self, table: DeviceTable):
         pctx = PrepCtx(table)
+        filter_preps: List[List[NodePrep]] = []
+        for f in self.filters:
+            preps: List[NodePrep] = []
+            _walk_prep(f, pctx, preps)
+            filter_preps.append(preps)
         key_preps: List[List[NodePrep]] = []
         for g in self.grouping:
-            preps: List[NodePrep] = []
+            preps = []
             _walk_prep(g, pctx, preps)
             key_preps.append(preps)
         val_preps: List[List[NodePrep]] = []
-        for ve in value_exprs:
-            if ve is None:
+        for _, fn in self.agg_specs:
+            if fn.child is None:
                 val_preps.append([])
             else:
                 preps = []
-                _walk_prep(ve, pctx, preps)
+                _walk_prep(fn.child, pctx, preps)
                 val_preps.append(preps)
+        return pctx, filter_preps, key_preps, val_preps
 
+    def _fast_layout(self, key_preps) -> Optional[tuple]:
+        """Dictionary-code layout if every key has a small known domain:
+        (kinds, sizes, strides, padded_num_segments)."""
+        if not self.grouping or self.max_dict_groups <= 0:
+            return None
+        kinds: List[str] = []
+        sizes: List[int] = []
+        for g, preps in zip(self.grouping, key_preps):
+            dt = g.data_type
+            root = preps[-1]
+            if isinstance(dt, T.StringType) and root.out_dict is not None:
+                kinds.append("str")
+                sizes.append(len(root.out_dict) + 1)  # +1: null slot
+            elif isinstance(dt, T.BooleanType):
+                kinds.append("bool")
+                sizes.append(3)  # False, True, null
+            else:
+                return None
+        total = 1
+        for s in sizes:
+            total *= max(s, 1)
+        if total > self.max_dict_groups:
+            return None
+        strides = [1] * len(sizes)
+        for i in range(len(sizes) - 2, -1, -1):
+            strides[i] = strides[i + 1] * sizes[i + 1]
+        # tight power-of-two segment count (NOT the 128-row table bucket):
+        # one-hot einsum traffic scales with it, and a q1-style 12-slot
+        # domain must pad to 16, not 128
+        gpad = max(8, 1 << (max(total - 1, 1)).bit_length())
+        return tuple(kinds), sizes, strides, gpad
+
+    def _aggregate(self, table: DeviceTable) -> DeviceTable:
+        pctx, filter_preps, key_preps, val_preps = self._prep_all(table)
         cols = tuple(DevVal(c.data, c.validity) for c in table.columns)
         aux = tuple(jnp.asarray(a) for a in pctx.aux_arrays)
         capacity = table.capacity
+
+        fast = self._fast_layout(key_preps)
 
         from spark_rapids_tpu.ops.expr import shared_traces
         self._traces = shared_traces(
             ("agg",
              tuple(g.key() for g in self.grouping),
              tuple(fn.key() for _, fn in self.agg_specs),
+             tuple(f.key() for f in self.filters),
              table.schema_key()[0]))
-        tkey = (capacity,
+        mode_key = ("fast", fast[0], fast[3]) if fast else ("sorted",)
+        tkey = (capacity, self.use_split, mode_key,
+                tuple(_prep_trace_key(p) for p in filter_preps),
                 tuple(_prep_trace_key(p) for p in key_preps),
                 tuple(_prep_trace_key(p) for p in val_preps))
         fn = self._traces.get(tkey)
         if fn is None:
-            fn = jax.jit(self._build_kernel(capacity, key_preps, val_preps))
+            if fast:
+                fn = jax.jit(self._build_fast_kernel(
+                    capacity, fast[0], fast[3], filter_preps, key_preps, val_preps))
+            else:
+                fn = jax.jit(self._build_kernel(
+                    capacity, filter_preps, key_preps, val_preps))
             self._traces[tkey] = fn
 
-        out_arrays, ngroups = fn(cols, aux, table.nrows_dev)
+        if fast:
+            _, sizes, strides, gpad = fast
+            out_arrays, ngroups = fn(
+                cols, aux, table.nrows_dev,
+                jnp.asarray(np.asarray(sizes, dtype=np.int32)),
+                jnp.asarray(np.asarray(strides, dtype=np.int32)))
+            out_capacity = gpad
+        else:
+            out_arrays, ngroups = fn(cols, aux, table.nrows_dev)
+            out_capacity = capacity
 
         out_cols: List[DeviceColumn] = []
         names: List[str] = []
@@ -139,17 +211,170 @@ class TpuHashAggregateExec(TpuExec):
             out_cols.append(DeviceColumn(fnagg.data_type, data, validity,
                                          dictionary=dictionary, dict_sorted=dict_sorted))
             names.append(name)
-        # group counts are usually tiny vs the input bucket; re-bucket so
-        # downstream sorts/transfers don't run at input capacity
-        return DeviceTable(names, out_cols, ngroups, capacity).shrink()
+        out = DeviceTable(names, out_cols, ngroups, out_capacity)
+        if fast:
+            # outputs are already domain-sized; the group count stays a
+            # device scalar (no host sync on the hot path)
+            return out
+        # sorted path emits capacity-sized outputs; re-bucket so downstream
+        # sorts/transfers don't run at input capacity
+        return out.shrink()
 
-    def _build_kernel(self, capacity: int, key_preps, val_preps):
+    def _eval_live(self, capacity, cols, aux, nrows, filter_preps):
+        """Row-liveness mask: in-bounds AND every fused predicate true."""
+        live = jnp.arange(capacity, dtype=jnp.int32) < nrows
+        for f, preps in zip(self.filters, filter_preps):
+            ctx = EvalCtx(cols, aux, nrows, capacity)
+            ctx._prep_iter = iter(preps)
+            pred = _walk_eval(f, ctx)
+            live = live & pred.data & pred.validity
+        return live
+
+    # -- fast path: dictionary-code grouping, no sort -----------------------
+    def _build_fast_kernel(self, capacity: int, kinds, gpad: int,
+                           filter_preps, key_preps, val_preps):
         grouping = self.grouping
         agg_specs = self.agg_specs
         value_exprs = [fn.child for _, fn in agg_specs]
+        use_split = self.use_split
+
+        def kernel(cols, aux, nrows, sizes, strides):
+            live = self._eval_live(capacity, cols, aux, nrows, filter_preps)
+
+            gid = jnp.zeros(capacity, dtype=jnp.int32)
+            for i, (g, preps, kind) in enumerate(zip(grouping, key_preps, kinds)):
+                ctx = EvalCtx(cols, aux, nrows, capacity)
+                ctx._prep_iter = iter(preps)
+                kv = _walk_eval(g, ctx)
+                code = kv.data.astype(jnp.int32) if kind == "bool" else kv.data
+                code = jnp.where(kv.validity, code, sizes[i] - 1)
+                gid = gid + code * strides[i]
+
+            # ---- batched value aggregation ------------------------------
+            # All sum-class f64 reductions (Sum/Average/Stddev/Variance)
+            # ride ONE batched device pass (ops/segsum.py); validity counts
+            # for every spec plus group existence ride one 2-D i32
+            # segment_sum. Min/Max/First/Last and i64 sums stay per-spec
+            # (_agg_one).
+            vvs = []
+            for ve, preps in zip(value_exprs, val_preps):
+                if ve is None:
+                    vvs.append(None)
+                else:
+                    ctx = EvalCtx(cols, aux, nrows, capacity)
+                    ctx._prep_iter = iter(preps)
+                    vvs.append(_walk_eval(ve, ctx))
+            svs = [(vv.validity & live) if vv is not None else None
+                   for vv in vvs]
+
+            # one scatter for live-count + every spec's nonnull count
+            masks = [live] + [sv for sv in svs if sv is not None]
+            mix = {}
+            k = 1
+            for j, sv in enumerate(svs):
+                if sv is not None:
+                    mix[j] = k
+                    k += 1
+            mcnt = jax.ops.segment_sum(
+                jnp.stack(masks, axis=1).astype(jnp.int32), gid,
+                num_segments=gpad)
+            nonnulls = {j: mcnt[:, i] for j, i in mix.items()}
+
+            exists = mcnt[:, 0] > 0
+            ngroups = jnp.sum(exists.astype(jnp.int32))
+            pos = jnp.cumsum(exists.astype(jnp.int32)) - 1
+            tgt = jnp.where(exists, pos, gpad)  # compact: slot -> dense rank
+            out_live = jnp.arange(gpad, dtype=jnp.int32) < ngroups
+
+            def compact(data, validity):
+                cd = jnp.zeros_like(data).at[tgt].set(data, mode="drop")
+                cv = jnp.zeros_like(validity).at[tgt].set(validity, mode="drop")
+                return cd, cv & out_live
+
+            outs = []
+            slot_ix = jnp.arange(gpad, dtype=jnp.int32)
+            for i, kind in enumerate(kinds):
+                slot = (slot_ix // strides[i]) % sizes[i]
+                kvalid = slot != (sizes[i] - 1)
+                kdata = (slot == 1) if kind == "bool" else slot
+                outs.append(compact(kdata, kvalid))
+
+            fplan = []  # (spec index, kind) riding the batched f64 pass
+            for j, (_, fnagg) in enumerate(agg_specs):
+                if isinstance(fnagg, (agg.StddevPop, agg.StddevSamp,
+                                      agg.VariancePop, agg.VarianceSamp)):
+                    fplan.append((j, "var"))
+                elif isinstance(fnagg, agg.Average):
+                    fplan.append((j, "avg"))
+                elif isinstance(fnagg, agg.Sum) and not isinstance(
+                        fnagg.data_type, T.LongType):
+                    fplan.append((j, "sum"))
+            fcols = [jnp.where(svs[j], vvs[j].data.astype(jnp.float64), 0.0)
+                     for j, _ in fplan]
+            fsums = batched_segment_sum_f64(fcols, gid, gpad, capacity,
+                                            use_split)
+
+            # second batched pass: centered moments for stddev/variance
+            vplan = [(i, j) for i, (j, kind) in enumerate(fplan)
+                     if kind == "var"]
+            ccols = []
+            for i, j in vplan:
+                mean = fsums[:, i] / jnp.maximum(nonnulls[j], 1)
+                ccols.append(jnp.where(
+                    svs[j],
+                    (vvs[j].data.astype(jnp.float64) - mean[gid]) ** 2, 0.0))
+            csums = batched_segment_sum_f64(ccols, gid, gpad, capacity,
+                                            use_split)
+            m2s = {j: csums[:, i2] for i2, (_, j) in enumerate(vplan)}
+
+            fres = {}
+            for i, (j, kind) in enumerate(fplan):
+                fnagg = agg_specs[j][1]
+                nonnull = nonnulls[j]
+                has_any = (nonnull > 0) & exists
+                s = fsums[:, i]
+                if kind == "sum":
+                    fres[j] = (jnp.where(has_any, s, 0.0), has_any)
+                elif kind == "avg":
+                    fres[j] = (jnp.where(has_any, s / jnp.maximum(nonnull, 1), 0.0),
+                               has_any)
+                else:
+                    if isinstance(fnagg, (agg.StddevPop, agg.VariancePop)):
+                        denom = jnp.maximum(nonnull, 1)
+                        validity = has_any
+                    else:
+                        denom = jnp.maximum(nonnull - 1, 1)
+                        validity = (nonnull > 1) & exists
+                    var = m2s[j] / denom
+                    out = jnp.sqrt(var) if isinstance(
+                        fnagg, (agg.StddevPop, agg.StddevSamp)) else var
+                    fres[j] = (jnp.where(validity, out, 0.0), validity)
+
+            for j, (_, fnagg) in enumerate(agg_specs):
+                if j in fres:
+                    data, validity = fres[j]
+                elif isinstance(fnagg, agg.Count):
+                    w = mcnt[:, 0] if fnagg.child is None else nonnulls[j]
+                    data, validity = w.astype(jnp.int64), exists
+                else:
+                    sd = vvs[j].data if vvs[j] is not None else None
+                    data, validity = self._agg_one(
+                        fnagg, sd, svs[j], live, gid, gpad, exists,
+                        capacity, use_split)
+                outs.append(compact(data, validity))
+            return outs, ngroups
+
+        return kernel
+
+    # -- general path: sort-segment -----------------------------------------
+    def _build_kernel(self, capacity: int, filter_preps, key_preps, val_preps):
+        grouping = self.grouping
+        agg_specs = self.agg_specs
+        value_exprs = [fn.child for _, fn in agg_specs]
+        use_split = self.use_split
 
         def kernel(cols, aux, nrows):
-            live = jnp.arange(capacity, dtype=jnp.int32) < nrows
+            live = self._eval_live(capacity, cols, aux, nrows, filter_preps)
 
             key_vals: List[DevVal] = []
             for g, preps in zip(grouping, key_preps):
@@ -216,48 +441,52 @@ class TpuHashAggregateExec(TpuExec):
                 outs.append((kd, kvv & group_live))
 
             for (name, fnagg), vv in zip(agg_specs, val_vals):
-                outs.append(self._agg_device(fnagg, vv, perm, gid, s_live,
-                                             group_live, ngroups, capacity))
+                sd = vv.data[perm] if vv is not None else None
+                sv = (vv.validity[perm] & s_live) if vv is not None else None
+                outs.append(self._agg_one(fnagg, sd, sv, s_live, gid, capacity,
+                                          group_live, capacity, use_split))
             return outs, ngroups
 
         return kernel
 
     @staticmethod
-    def _agg_device(fnagg, vv, perm, gid, s_live, group_live, ngroups, capacity):
+    def _agg_one(fnagg, sd, sv, live, gid, nseg, group_live, capacity, use_split):
+        """One aggregate over segment ids. ``sd``/``sv``: value data and
+        validity aligned with ``gid`` (``sv`` already excludes dead rows);
+        ``live``: row liveness (COUNT(*)); ``nseg``: number of segments;
+        ``group_live``: which segment slots are real groups."""
         seg = jax.ops
         if isinstance(fnagg, agg.Count):
-            if fnagg.child is None:
-                w = s_live.astype(jnp.int64)
-            else:
-                w = (vv.validity[perm] & s_live).astype(jnp.int64)
-            cnt = seg.segment_sum(w, gid, num_segments=capacity)
+            w = live if fnagg.child is None else sv
+            # capacity < 2^31 always (power-of-two row buckets), so count
+            # accumulates natively in i32 and widens to Spark's LONG after
+            cnt = seg.segment_sum(w.astype(jnp.int32), gid,
+                                  num_segments=nseg).astype(jnp.int64)
             return (cnt, group_live)
 
-        sd = vv.data[perm]
-        sv = vv.validity[perm] & s_live
-        nonnull = seg.segment_sum(sv.astype(jnp.int64), gid, num_segments=capacity)
+        nonnull = seg.segment_sum(sv.astype(jnp.int32), gid, num_segments=nseg)
         has_any = (nonnull > 0) & group_live
 
         if isinstance(fnagg, agg.Sum):
             if isinstance(fnagg.data_type, T.LongType):
                 v = jnp.where(sv, sd.astype(jnp.int64), 0)
-                s = seg.segment_sum(v, gid, num_segments=capacity)
+                s = seg.segment_sum(v, gid, num_segments=nseg)
                 return (s, has_any)
             v = jnp.where(sv, sd.astype(jnp.float64), 0.0)
-            s = seg.segment_sum(v, gid, num_segments=capacity)
+            s = segment_sum_f64(v, gid, nseg, capacity, use_split)
             return (jnp.where(has_any, s, 0.0), has_any)
 
         if isinstance(fnagg, agg.Average):
             v = jnp.where(sv, sd.astype(jnp.float64), 0.0)
-            s = seg.segment_sum(v, gid, num_segments=capacity)
+            s = segment_sum_f64(v, gid, nseg, capacity, use_split)
             return (jnp.where(has_any, s / jnp.maximum(nonnull, 1), 0.0), has_any)
 
         if isinstance(fnagg, (agg.StddevPop, agg.StddevSamp, agg.VariancePop, agg.VarianceSamp)):
             v = jnp.where(sv, sd.astype(jnp.float64), 0.0)
-            s = seg.segment_sum(v, gid, num_segments=capacity)
+            s = segment_sum_f64(v, gid, nseg, capacity, use_split)
             mean = s / jnp.maximum(nonnull, 1)
             centered = jnp.where(sv, (sd.astype(jnp.float64) - mean[gid]) ** 2, 0.0)
-            m2 = seg.segment_sum(centered, gid, num_segments=capacity)
+            m2 = segment_sum_f64(centered, gid, nseg, capacity, use_split)
             if isinstance(fnagg, (agg.StddevPop, agg.VariancePop)):
                 denom = jnp.maximum(nonnull, 1)
                 validity = has_any
@@ -281,31 +510,34 @@ class TpuHashAggregateExec(TpuExec):
                 ident = jnp.asarray(info.max if isinstance(fnagg, agg.Min) else info.min, dtype=dt)
             v = jnp.where(sv, sd, ident)
             if isinstance(fnagg, agg.Min):
-                r = seg.segment_min(v, gid, num_segments=capacity)
+                r = seg.segment_min(v, gid, num_segments=nseg)
             else:
-                r = seg.segment_max(v, gid, num_segments=capacity)
+                r = seg.segment_max(v, gid, num_segments=nseg)
             if isinstance(fnagg.data_type, T.BooleanType):
                 r = r.astype(jnp.bool_)
             zero = jnp.zeros_like(r)
             return (jnp.where(has_any, r, zero), has_any)
 
         if isinstance(fnagg, (agg.First, agg.Last)):
-            idx = jnp.arange(capacity, dtype=jnp.int64)
-            pick_mask = sv if fnagg.ignore_nulls else s_live
+            idx = jnp.arange(capacity, dtype=jnp.int32)
+            pick_mask = sv if fnagg.ignore_nulls else live
             sentinel = capacity if isinstance(fnagg, agg.First) else -1
             pos = jnp.where(pick_mask, idx, sentinel)
             if isinstance(fnagg, agg.First):
-                chosen = seg.segment_min(pos, gid, num_segments=capacity)
+                chosen = seg.segment_min(pos, gid, num_segments=nseg)
             else:
-                chosen = seg.segment_max(pos, gid, num_segments=capacity)
+                chosen = seg.segment_max(pos, gid, num_segments=nseg)
             got = (chosen >= 0) & (chosen < capacity) & group_live
             safe = jnp.clip(chosen, 0, capacity - 1)
             data = sd[safe]
-            validity = got & sv[safe] if fnagg.ignore_nulls else got & vv.validity[perm][safe]
+            # chosen rows are live by construction, so sv at them equals the
+            # raw value validity — right for both ignore_nulls modes
+            validity = got & sv[safe]
             return (jnp.where(validity, data, jnp.zeros_like(data)), validity)
 
         raise ColumnarProcessingError(f"device aggregate {type(fnagg).__name__}")
 
     def describe(self):
+        fused = f", fusedFilters={len(self.filters)}" if self.filters else ""
         return (f"TpuHashAggregate[keys={self.grouping_names}, "
-                f"aggs={[n for n, _ in self.agg_specs]}]")
+                f"aggs={[n for n, _ in self.agg_specs]}{fused}]")

@@ -29,18 +29,38 @@ from spark_rapids_tpu.ops.expr import (
 
 
 class TpuScanExec(TpuExec):
-    """Uploads pre-built host batches (LocalScan analog)."""
+    """Uploads pre-built host batches (LocalScan analog).
 
-    def __init__(self, batches: Sequence[HostTable]):
+    With ``device_cache`` the uploaded DeviceTable is memoized on the host
+    table itself, so repeated queries over one in-memory table skip the
+    H2D transfer entirely — the GpuInMemoryTableScanExec / DataFrame.cache
+    analog (reference: InMemoryTableScanExec override, GpuOverrides.scala).
+    Cached images are dropped on device OOM (columnar.table.
+    evict_device_caches, wired into the retry framework)."""
+
+    def __init__(self, batches: Sequence[HostTable], device_cache: bool = True):
         super().__init__()
         self.batches = list(batches)
+        self.device_cache = device_cache
 
     def output_schema(self):
         return self.batches[0].schema()
 
     def execute(self):
+        from spark_rapids_tpu.columnar.table import register_device_cache
         for b in self.batches:
-            yield DeviceTable.from_host(b)
+            if not self.device_cache:
+                yield DeviceTable.from_host(b)
+                continue
+            dt = b._cache.get("device")
+            if dt is None:
+                dt = DeviceTable.from_host(b)
+                b._cache["device"] = dt
+                register_device_cache(b)
+                self.add_metric("scanCacheMiss", 1)
+            else:
+                self.add_metric("scanCacheHit", 1)
+            yield dt
 
     def describe(self):
         return f"TpuScan[{len(self.batches)} batches]"

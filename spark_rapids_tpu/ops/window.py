@@ -177,10 +177,17 @@ class WindowExpression(Expression):
         return self.function.data_type
 
     def key(self):
+        # Must capture EVERYTHING that shapes the traced kernel — function
+        # (incl. the aggregate's child ordinals), partition exprs, and
+        # orders — because window traces are shared process-wide
+        # (shared_traces); a weak key silently reuses another query's
+        # compiled kernel.
         frame = self.spec.resolved_frame()
-        return ("winexpr", self.function.key() if not isinstance(
-            self.function, agg.AggregateFunction) else
-            (type(self.function).__name__,), frame)
+        return ("winexpr", self.function.key(),
+                tuple(p.key() for p in self.spec.partition_exprs),
+                tuple((o.expr.key(), o.ascending, o.resolved_nulls_first())
+                      for o in self.spec.orders),
+                frame)
 
     def bind(self, schema):
         if isinstance(self.function, agg.AggregateFunction):

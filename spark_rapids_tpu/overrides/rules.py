@@ -230,7 +230,9 @@ def _tag_join(meta, conf):
 
 
 def _convert_scan(node: P.LocalScan, children, conf):
-    return TpuScanExec(node.batches)
+    from spark_rapids_tpu.conf import SCAN_DEVICE_CACHE
+    return TpuScanExec(node.batches,
+                       device_cache=conf.get_entry(SCAN_DEVICE_CACHE))
 
 
 def _convert_range(node: P.RangeNode, children, conf):
@@ -246,9 +248,26 @@ def _convert_filter(node: P.Filter, children, conf):
 
 
 def _convert_aggregate(node: P.Aggregate, children, conf):
-    coalesced = TpuCoalesceExec(children[0], require_single=True)
-    return TpuHashAggregateExec(coalesced, node.grouping, node.agg_specs,
-                                node.grouping_names)
+    from spark_rapids_tpu.conf import AGG_FUSE_INPUT, AGG_MAX_DICT_GROUPS
+    from spark_rapids_tpu.execs.fuse import peel_input_chain
+    from spark_rapids_tpu.ops.segsum import resolve_split_mode
+
+    child = children[0]
+    grouping = list(node.grouping)
+    agg_specs = list(node.agg_specs)
+    filters = []
+    if conf.get_entry(AGG_FUSE_INPUT):
+        exprs = grouping + [fn for _, fn in agg_specs]
+        child, exprs, filters = peel_input_chain(child, exprs)
+        grouping = exprs[:len(grouping)]
+        agg_specs = [(n, fn) for (n, _), fn in
+                     zip(agg_specs, exprs[len(grouping):])]
+    coalesced = TpuCoalesceExec(child, require_single=True)
+    return TpuHashAggregateExec(coalesced, grouping, agg_specs,
+                                node.grouping_names,
+                                filters=filters,
+                                use_split=resolve_split_mode(conf),
+                                max_dict_groups=conf.get_entry(AGG_MAX_DICT_GROUPS))
 
 
 def _convert_sort(node: P.Sort, children, conf):

@@ -129,6 +129,15 @@ def _as_spillable(x: SpillableOrTable, catalog: BufferCatalog) -> SpillableBatch
     return SpillableBatch(x, catalog)
 
 
+
+def _free_device_memory(catalog: BufferCatalog) -> None:
+    """Release everything releasable before a replay: cached scan images
+    first (lowest priority), then registered spillables through the
+    catalog tiers."""
+    from spark_rapids_tpu.columnar.table import evict_device_caches
+    evict_device_caches()
+    catalog.synchronous_spill(1 << 62)
+
 def with_retry(
     inputs: Union[SpillableOrTable, Sequence[SpillableOrTable]],
     fn: Callable[[DeviceTable], object],
@@ -178,7 +187,7 @@ def with_retry(
                                 "device OOM and operator cannot split its input"
                             ) from exc
                         RMM_TPU.note_split()
-                        catalog.synchronous_spill(1 << 62)
+                        _free_device_memory(catalog)
                         with sb.pinned_batch() as dt:
                             halves = split_device_table_in_half(dt)
                         sb.release()
@@ -190,7 +199,7 @@ def with_retry(
                         attempts += 1
                         RMM_TPU.note_retry()
                         # free everything we can, then replay the same input
-                        catalog.synchronous_spill(1 << 62)
+                        _free_device_memory(catalog)
                         continue
                     raise
     finally:
@@ -229,7 +238,7 @@ def retry_block(fn: Callable[[], object], *, max_retries: Optional[int] = None,
             if is_device_oom(exc) and attempts < max_retries:
                 attempts += 1
                 RMM_TPU.note_retry()
-                catalog.synchronous_spill(1 << 62)
+                _free_device_memory(catalog)
                 continue
             if is_device_oom(exc):
                 raise FatalDeviceOOM(

@@ -132,7 +132,10 @@ class SpillableBatch:
             if self._device is None or self._pinned:
                 return 0
             freed = self._device_bytes
-            self._host = self._device.to_host()
+            # per-column transfer: spill runs on an exhausted device, and
+            # the packed to_host would have to ALLOCATE a table-sized
+            # staging buffer there
+            self._host = self._device.to_host_per_column()
             self._host_bytes = self._host.nbytes()
             self._device = None
             self._device_bytes = 0

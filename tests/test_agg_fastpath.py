@@ -1,0 +1,184 @@
+"""Dictionary-code aggregation fast path + split-f64 sums + input fusion
+(reference analog: hash_aggregate_test.py; the fast path is the TPU-first
+no-sort grouping of execs/aggregate.py, split sums are ops/segsum.py)."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import functions as F
+from spark_rapids_tpu.ops.expr import col, lit
+
+from tests.asserts import assert_tpu_and_cpu_are_equal
+from tests.data_gen import (
+    BooleanGen, DoubleGen, IntGen, LongGen, StringGen, gen_table,
+)
+
+
+def _df(sess, gens, n=800, seed=11, num_batches=1):
+    from spark_rapids_tpu.plan import from_host_table
+    return from_host_table(gen_table(gens, n, seed), sess, num_batches)
+
+
+GENS = {"s": StringGen(cardinality=7), "b": BooleanGen(),
+        "v": LongGen(min_val=-1000, max_val=1000), "d": DoubleGen()}
+
+ALL_AGGS = [
+    F.count().alias("cnt"), F.count(col("v")).alias("cntv"),
+    F.sum(col("v")).alias("sumv"), F.sum(col("d")).alias("sumd"),
+    F.avg(col("d")).alias("avgd"), F.min(col("d")).alias("mind"),
+    F.max(col("v")).alias("maxv"), F.first(col("v")).alias("fv"),
+    F.last(col("d")).alias("ld"),
+]
+
+
+@pytest.fixture(scope="module")
+def split_session():
+    """Force the split-f64 sum path even on the CPU backend."""
+    from spark_rapids_tpu.session import TpuSession
+    return TpuSession({"spark.rapids.tpu.sum.splitF64": "true"})
+
+
+@pytest.fixture(scope="module")
+def sorted_session():
+    """Disable the dict fast path to pin the sort-segment path."""
+    from spark_rapids_tpu.session import TpuSession
+    return TpuSession({"spark.rapids.tpu.agg.maxDictGroups": "0"})
+
+
+def test_fast_path_string_key(session, cpu_session):
+    assert_tpu_and_cpu_are_equal(
+        lambda s: _df(s, GENS).group_by("s").agg(*ALL_AGGS),
+        session, cpu_session)
+
+
+def test_fast_path_bool_key(session, cpu_session):
+    assert_tpu_and_cpu_are_equal(
+        lambda s: _df(s, GENS).group_by("b").agg(*ALL_AGGS),
+        session, cpu_session)
+
+
+def test_fast_path_string_bool_multi_key(session, cpu_session):
+    assert_tpu_and_cpu_are_equal(
+        lambda s: _df(s, GENS).group_by("s", "b").agg(*ALL_AGGS),
+        session, cpu_session)
+
+
+def test_fast_path_matches_sorted_path(session, sorted_session):
+    """The no-sort dict path and the general sort-segment path must agree."""
+    assert_tpu_and_cpu_are_equal(
+        lambda s: _df(s, GENS).group_by("s", "b").agg(*ALL_AGGS),
+        session, sorted_session)
+
+
+def test_fast_path_with_fused_filter_project(session, cpu_session):
+    def build(s):
+        return (
+            _df(s, GENS)
+            .filter(col("v") > lit(-500))
+            .select(col("s"), col("b"), col("v"),
+                    (col("d") * lit(2.0)).alias("d2"))
+            .filter(col("v") < lit(500))
+            .group_by("s", "b")
+            .agg(F.count().alias("cnt"), F.sum(col("d2")).alias("sd2"),
+                 F.avg(col("v")).alias("av"))
+        )
+    assert_tpu_and_cpu_are_equal(build, session, cpu_session)
+
+
+def test_fusion_peels_project_and_filter(session):
+    """The converted exec tree should contain no Project/Filter above the
+    scan once fusion inlines them into the aggregate."""
+    from spark_rapids_tpu.overrides import apply_overrides
+    from spark_rapids_tpu.execs.aggregate import TpuHashAggregateExec
+    from spark_rapids_tpu.execs.basic import TpuFilterExec, TpuProjectExec
+
+    df = (_df(session, GENS)
+          .filter(col("v") > lit(0))
+          .select(col("s"), (col("d") + lit(1.0)).alias("d1"))
+          .group_by("s").agg(F.sum(col("d1")).alias("sd")))
+    executable, _ = apply_overrides(df.plan, session.conf)
+
+    aggs, others = [], []
+
+    def walk(e):
+        if isinstance(e, TpuHashAggregateExec):
+            aggs.append(e)
+        if isinstance(e, (TpuFilterExec, TpuProjectExec)):
+            others.append(e)
+        for c in getattr(e, "children", ()):
+            walk(c)
+        for attr in ("source", "tpu_exec", "cpu_node"):
+            nxt = getattr(e, attr, None)
+            if nxt is not None:
+                walk(nxt)
+
+    walk(executable)
+    assert len(aggs) == 1
+    assert aggs[0].filters, "filter should be fused into the aggregate"
+    assert not others, f"unfused execs remain: {others}"
+
+
+def test_split_sum_accuracy(split_session, cpu_session):
+    """Split-f64 sums must stay within ~1e-7 relative of the exact path."""
+    assert_tpu_and_cpu_are_equal(
+        lambda s: _df(s, {"s": StringGen(cardinality=5), "d": DoubleGen()},
+                      n=5000)
+        .group_by("s").agg(F.sum(col("d")).alias("sd"),
+                           F.avg(col("d")).alias("ad")),
+        split_session, cpu_session, approximate_float=True)
+
+
+def test_split_sum_huge_values_reroute_exact(split_session, cpu_session):
+    """|x| > 1e34 must reroute to the exact path at runtime (lax.cond)."""
+    from spark_rapids_tpu.plan import from_host_table
+    from spark_rapids_tpu.columnar import HostColumn, HostTable
+    from spark_rapids_tpu import types as T
+
+    n = 512
+    vals = np.full(n, 1e300)
+    vals[::2] = -1e300
+    vals[0] = 12345.0
+    keys = np.array(["a"] * n, dtype=object)
+    table = HostTable(["s", "d"], [HostColumn(T.STRING, keys),
+                                   HostColumn(T.DOUBLE, vals)])
+
+    def build(s):
+        return from_host_table(table, s).group_by("s").agg(
+            F.sum(col("d")).alias("sd"))
+
+    assert_tpu_and_cpu_are_equal(build, split_session, cpu_session)
+
+
+def test_split_segment_sum_unit():
+    """Direct unit check of segment_sum_f64 against numpy, forced split."""
+    import jax.numpy as jnp
+    from spark_rapids_tpu.ops.segsum import segment_sum_f64
+
+    rng = np.random.default_rng(3)
+    cap = 4096
+    vals = rng.random(cap) * 1e5 - 5e4
+    gid = (rng.random(cap) * 11).astype(np.int32)
+    got = np.asarray(segment_sum_f64(
+        jnp.asarray(vals), jnp.asarray(gid), 16, cap, use_split=True))
+    ref = np.zeros(16)
+    np.add.at(ref, gid, vals)
+    np.testing.assert_allclose(got, ref, rtol=1e-7, atol=1e-4)
+
+
+def test_sorted_path_nulls_in_keys(session, cpu_session):
+    gens = {"s": StringGen(cardinality=4), "b": BooleanGen(),
+            "v": IntGen(min_val=-50, max_val=50, null_prob=0.3)}
+    assert_tpu_and_cpu_are_equal(
+        lambda s: _df(s, gens).group_by("s", "b").agg(
+            F.count().alias("c"), F.sum(col("v")).alias("sv")),
+        session, cpu_session)
+
+
+def test_large_dict_falls_back_to_sorted(session, cpu_session):
+    """Key domain above maxDictGroups must take the sort-segment path and
+    still be correct."""
+    from spark_rapids_tpu.session import TpuSession
+    limited = TpuSession({"spark.rapids.tpu.agg.maxDictGroups": 4})
+    assert_tpu_and_cpu_are_equal(
+        lambda s: _df(s, GENS).group_by("s").agg(F.count().alias("c")),
+        limited, cpu_session)
