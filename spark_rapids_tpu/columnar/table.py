@@ -124,12 +124,8 @@ def _get_pack(kinds: tuple, k: int, cap: int):
                         u32s.append(jax.lax.bitcast_convert_type(
                             d, jnp.uint32).reshape(-1))
                     else:
-                        hi = d.astype(jnp.float32)
-                        # inf: hi-hi would be NaN; lo=0 keeps hi+lo == inf
-                        lo = jnp.where(
-                            jnp.isfinite(hi),
-                            (d - hi.astype(jnp.float64)).astype(jnp.float32),
-                            0.0)
+                        from spark_rapids_tpu.ops.segsum import split_f64_hi_lo
+                        hi, lo = split_f64_hi_lo(d)
                         u32s.append(jax.lax.bitcast_convert_type(hi, jnp.uint32))
                         u32s.append(jax.lax.bitcast_convert_type(lo, jnp.uint32))
                 elif kind == "i64":
@@ -209,6 +205,96 @@ def _unpack_host(buf: np.ndarray, kinds: tuple, k: int):
         valids.append(bytes_part[o8:o8 + k] != 0)
         o8 += k
     return datas, valids
+
+
+#: jitted concat kernels keyed by (schema kinds, input caps, out cap)
+_CONCAT_CACHE: Dict[tuple, object] = {}
+
+
+def concat_device(tables: Sequence["DeviceTable"]) -> "DeviceTable":
+    """Concatenate device tables ON DEVICE (no host round trip).
+
+    Row counts stay device scalars: each table's rows scatter at the
+    running dynamic offset (sum of predecessors' nrows_dev), so no host
+    sync happens. String columns are remapped into the union dictionary
+    first (host work is O(dict size), device work one gather per column).
+    Output capacity is the bucket of the capacity sum — a static upper
+    bound that avoids syncing the live counts."""
+    if not tables:
+        raise ColumnarProcessingError("concat of zero tables")
+    if len(tables) == 1:
+        return tables[0]
+    names = tables[0].names
+    ncols = len(tables[0].columns)
+    caps = tuple(t.capacity for t in tables)
+    out_cap = bucket_for(sum(caps))
+
+    # unify string dictionaries; build per-(table, col) remap aux arrays
+    out_dicts: List[Optional[np.ndarray]] = []
+    remaps: List[List[Optional[np.ndarray]]] = [[None] * ncols
+                                                for _ in tables]
+    for ci in range(ncols):
+        col0 = tables[0].columns[ci]
+        if not isinstance(col0.dtype, T.StringType):
+            out_dicts.append(None)
+            continue
+        dicts = [(t.columns[ci].dictionary if t.columns[ci].dictionary
+                  is not None else np.array([], dtype=object))
+                 for t in tables]
+        union = np.unique(np.concatenate([d.astype(object) for d in dicts])) \
+            if any(len(d) for d in dicts) else np.array([], dtype=object)
+        for ti, d in enumerate(dicts):
+            m = np.searchsorted(union, d).astype(np.int32) if len(d) else \
+                np.zeros(1, np.int32)
+            remaps[ti][ci] = m
+        out_dicts.append(union)
+
+    kinds = tuple((str(c.dtype), c.dictionary is not None)
+                  for c in tables[0].columns)
+    key = (kinds, caps, out_cap)
+    fn = _CONCAT_CACHE.get(key)
+    if fn is None:
+        def concat(cols_per_table, remap_per_table, nrows_list):
+            outs = []
+            for ci in range(ncols):
+                od = None
+                ov = jnp.zeros(out_cap, dtype=jnp.bool_)
+                offset = jnp.asarray(0, dtype=jnp.int32)
+                for ti in range(len(cols_per_table)):
+                    data, valid = cols_per_table[ti][ci]
+                    rm = remap_per_table[ti][ci]
+                    if rm is not None:
+                        data = rm[jnp.clip(data, 0, rm.shape[0] - 1)]
+                    if od is None:
+                        od = jnp.zeros(out_cap, dtype=data.dtype)
+                    n = nrows_list[ti]
+                    idx = jnp.arange(data.shape[0], dtype=jnp.int32)
+                    tgt = jnp.where(idx < n, idx + offset, out_cap)
+                    od = od.at[tgt].set(data, mode="drop")
+                    ov = ov.at[tgt].set(valid, mode="drop")
+                    offset = offset + n
+                outs.append((od, ov))
+            total = jnp.asarray(0, dtype=jnp.int32)
+            for n in nrows_list:
+                total = total + n
+            return outs, total
+
+        fn = jax.jit(concat)
+        _CONCAT_CACHE[key] = fn
+
+    cols_per_table = tuple(
+        tuple((c.data, c.validity) for c in t.columns) for t in tables)
+    remap_per_table = tuple(
+        tuple(jnp.asarray(m) if m is not None else None for m in row)
+        for row in remaps)
+    nrows_list = tuple(t.nrows_dev for t in tables)
+    outs, total = fn(cols_per_table, remap_per_table, nrows_list)
+    out_cols = [
+        DeviceColumn(c.dtype, d, v, dictionary=out_dicts[ci],
+                     dict_sorted=True if out_dicts[ci] is not None
+                     else c.dict_sorted)
+        for ci, (c, (d, v)) in enumerate(zip(tables[0].columns, outs))]
+    return DeviceTable(names, out_cols, total, out_cap)
 
 
 class HostTable:

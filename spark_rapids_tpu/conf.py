@@ -193,14 +193,24 @@ CPU_ORACLE_STRICT = bool_conf(
     "Test-only: compare device results bit-for-bit against the CPU path.",
     internal=True)
 
+JOIN_SUBPARTITION_BYTES = int_conf(
+    "spark.rapids.sql.join.subPartition.targetBytes", 1 << 30,
+    "Build sides larger than this sub-partition by Spark-exact key hash "
+    "into ceil(size/target) buckets; probe batches split the same way and "
+    "bucket pairs join independently with spillable build partitions "
+    "(GpuSubPartitionHashJoin analog). 0 disables.")
+
 SPLIT_F64_SUM = str_conf(
     "spark.rapids.tpu.sum.splitF64", "auto",
-    "f64 SUM/AVG/VAR reduction mode. 'auto': on TPU (where f64 compute is "
+    "f64 SUM/AVG reduction mode. 'auto': on TPU (where f64 compute is "
     "emulated) run the fast exact hi/lo f32 decomposition with blocked "
-    "accumulation (~1e-9 typical, <=~1e-7 worst-case relative error; "
-    "batches with |x|>1e34 reroute to the exact path at runtime); CPU "
-    "backends keep native f64. 'true'/'false' force the mode. The same "
-    "trade the reference gates with variableFloatAgg.enabled.")
+    "accumulation (~1e-9 typical relative error; a runtime guard reroutes "
+    "to the exact path on huge magnitudes or cancellation). Variance/"
+    "stddev MEANS always use the exact path (a mean error amplifies "
+    "quadratically in the centered pass); only the positive-valued "
+    "centered sums split. CPU backends keep native f64. 'true'/'false' "
+    "force the mode. The same trade the reference gates with "
+    "variableFloatAgg.enabled.")
 
 AGG_MAX_DICT_GROUPS = int_conf(
     "spark.rapids.tpu.agg.maxDictGroups", 1 << 16,

@@ -23,8 +23,9 @@ into the kernel (execs/fuse.py) — predicates become weight masks evaluated
 in the same XLA program, so a filter+project+aggregate pipeline is ONE
 device dispatch with no intermediate materialization.
 
-Requires a single coalesced input batch (RequireSingleBatch goal) in v1;
-partial-per-batch + merge is the planned widening."""
+Multi-batch inputs STREAM (GpuMergeAggregateIterator analog): one batch in
+HBM at a time aggregates to a spillable partial, and a merge aggregation +
+finalize projection combines the partials (see _merge_plan)."""
 
 from __future__ import annotations
 
@@ -87,46 +88,187 @@ class TpuHashAggregateExec(TpuExec):
         return out
 
     def execute(self):
+        from itertools import chain
         from spark_rapids_tpu.runtime.retry import retry_block
-        batches = list(self.children[0].execute())
-        if len(batches) != 1:
-            raise ColumnarProcessingError(
-                "TpuHashAggregateExec requires a single coalesced batch")
-        # spill-and-replay on OOM; split is unsound for a single-pass agg
-        # (reference escalates to sort-fallback merge — planned widening)
-        yield retry_block(lambda: self._aggregate(batches[0]))
+        from spark_rapids_tpu.runtime.spill import BufferCatalog, SpillableBatch
+
+        it = self.children[0].execute()
+        first = next(it, None)
+        if first is None:
+            return
+        second = next(it, None)
+        if second is None:
+            # single batch: aggregate directly (spill-and-replay on OOM)
+            yield retry_block(lambda: self._aggregate(
+                first, self.grouping, self.agg_specs, self.grouping_names,
+                self.filters))
+            return
+
+        # STREAMING multi-batch path (GpuMergeAggregateIterator analog,
+        # GpuAggregateExec.scala:718-950): each input batch aggregates
+        # immediately to a per-batch PARTIAL table (bounded HBM — only one
+        # input batch is resident at a time), partials are spillable, and
+        # one merge aggregation re-groups the concatenated partials with
+        # merge semantics (sum-of-sums, min-of-mins, Chan-style moment
+        # combination), followed by a finalize projection (avg = s/n, ...).
+        plan = self._merge_plan()
+        catalog = BufferCatalog.get()
+        partials = []
+        try:
+            for batch in chain([first, second], it):
+                pt = retry_block(lambda b=batch: self._aggregate(
+                    b, self.grouping, plan.partial_specs,
+                    self.grouping_names, self.filters))
+                partials.append(SpillableBatch(pt, catalog))
+                self.add_metric("partialAggBatches", 1)
+
+            from spark_rapids_tpu.columnar.table import concat_device
+
+            def merge():
+                merged = concat_device([p.get() for p in partials])
+                return self._aggregate(
+                    merged, plan.merge_grouping, plan.merge_specs,
+                    self.grouping_names, [])
+
+            mt = retry_block(merge)
+        finally:
+            for p in partials:
+                p.release()
+
+        from spark_rapids_tpu.ops.expr import bind, compile_project
+        bound = [bind(e, mt.schema()) for e in plan.final_exprs]
+        out_cols = compile_project(bound, mt)
+        out_names = self.grouping_names + [n for n, _ in self.agg_specs]
+        yield DeviceTable(out_names, out_cols, mt.nrows_dev, mt.capacity)
+
+    # -- streaming merge plan ----------------------------------------------
+    def _merge_plan(self):
+        """Decompose each aggregate into (partial specs, merge specs, final
+        projection) so multi-batch inputs stream:
+
+          Count  -> partial Count          ; merge Sum          ; identity
+          Sum    -> partial Sum            ; merge Sum          ; identity
+          Min/Max-> partial Min/Max        ; merge Min/Max      ; identity
+          First/ -> partial First/Last     ; merge First/Last   ; identity
+           Last     (concat preserves batch order, and a group row exists
+                     in a partial iff the batch had rows for it)
+          Avg    -> partial Sum+Count      ; merge Sum each     ; s / n
+          Var*/  -> partial Count+Sum+VarPop; merge N=Σn plus the stable
+          Stddev*   Chan combination m2 = Σm2_i + Σn_i(mean_i - mean_tot)²
+                    via the internal MergeMoments aggregate (the naive
+                    M + Q - S²/N form cancels catastrophically when
+                    |mean| >> stddev); finalize m2/denom (+ sqrt)
+        """
+        from types import SimpleNamespace
+        from spark_rapids_tpu.ops.cast import Cast
+        from spark_rapids_tpu.ops.expr import BoundReference, col, lit
+        from spark_rapids_tpu.ops.math import Sqrt
+
+        pschema = [(n, g.data_type)
+                   for n, g in zip(self.grouping_names, self.grouping)]
+        partial_specs: List[Tuple[str, agg.AggregateFunction]] = []
+        merge_specs: List[Tuple[str, agg.AggregateFunction]] = []
+        final_exprs: List[Expression] = [col(n) for n in self.grouping_names]
+
+        def add_partial(pname, pfn):
+            partial_specs.append((pname, pfn))
+            pschema.append((pname, pfn.data_type))
+            return len(pschema) - 1
+
+        def pref(idx):
+            name, dt = pschema[idx]
+            return BoundReference(idx, dt, name_hint=name)
+
+        for j, (name, fn) in enumerate(self.agg_specs):
+            t = type(fn)
+            if isinstance(fn, agg.Count):
+                i = add_partial(f"__p{j}c", agg.Count(fn.child))
+                merge_specs.append((name, agg.Sum(pref(i))))
+                final_exprs.append(col(name))
+            elif isinstance(fn, agg.Sum):
+                i = add_partial(f"__p{j}s", agg.Sum(fn.child))
+                merge_specs.append((name, agg.Sum(pref(i))))
+                final_exprs.append(col(name))
+            elif isinstance(fn, (agg.Min, agg.Max)):
+                i = add_partial(f"__p{j}m", t(fn.child))
+                merge_specs.append((name, t(pref(i))))
+                final_exprs.append(col(name))
+            elif isinstance(fn, (agg.First, agg.Last)):
+                i = add_partial(f"__p{j}f", t(fn.child, fn.ignore_nulls))
+                merge_specs.append((name, t(pref(i), fn.ignore_nulls)))
+                final_exprs.append(col(name))
+            elif isinstance(fn, agg.Average):
+                si = add_partial(f"__p{j}s", agg.Sum(fn.child))
+                ci = add_partial(f"__p{j}n", agg.Count(fn.child))
+                merge_specs.append((f"__m{j}s", agg.Sum(pref(si))))
+                merge_specs.append((f"__m{j}n", agg.Sum(pref(ci))))
+                final_exprs.append(
+                    (col(f"__m{j}s").cast(T.DOUBLE)
+                     / col(f"__m{j}n").cast(T.DOUBLE)).alias(name))
+            elif isinstance(fn, (agg.StddevPop, agg.StddevSamp,
+                                 agg.VariancePop, agg.VarianceSamp)):
+                ni = add_partial(f"__p{j}n", agg.Count(fn.child))
+                si = add_partial(f"__p{j}s",
+                                 agg.Sum(Cast(fn.child, T.DOUBLE)))
+                vi = add_partial(f"__p{j}v", agg.VariancePop(fn.child))
+                n_d = Cast(pref(ni), T.DOUBLE)
+                merge_specs.append((f"__m{j}n", agg.Sum(pref(ni))))
+                merge_specs.append((f"__m{j}m", agg.MergeMoments(
+                    pref(ni), pref(si), pref(vi) * n_d)))
+                N = col(f"__m{j}n").cast(T.DOUBLE)
+                m2 = col(f"__m{j}m")
+                if isinstance(fn, (agg.StddevPop, agg.VariancePop)):
+                    var = m2 / N
+                else:
+                    var = m2 / (N - lit(1.0))
+                out = Sqrt(var) if isinstance(
+                    fn, (agg.StddevPop, agg.StddevSamp)) else var
+                final_exprs.append(out.alias(name))
+            else:
+                raise ColumnarProcessingError(
+                    f"no merge decomposition for {t.__name__}")
+
+        merge_grouping = [
+            BoundReference(i, g.data_type, name_hint=n)
+            for i, (g, n) in enumerate(zip(self.grouping, self.grouping_names))]
+        return SimpleNamespace(partial_specs=partial_specs,
+                               merge_specs=merge_specs,
+                               merge_grouping=merge_grouping,
+                               final_exprs=final_exprs)
 
     # -- core ---------------------------------------------------------------
-    def _prep_all(self, table: DeviceTable):
+    def _prep_all(self, table: DeviceTable, grouping, agg_specs, filters):
         pctx = PrepCtx(table)
         filter_preps: List[List[NodePrep]] = []
-        for f in self.filters:
+        for f in filters:
             preps: List[NodePrep] = []
             _walk_prep(f, pctx, preps)
             filter_preps.append(preps)
         key_preps: List[List[NodePrep]] = []
-        for g in self.grouping:
+        for g in grouping:
             preps = []
             _walk_prep(g, pctx, preps)
             key_preps.append(preps)
-        val_preps: List[List[NodePrep]] = []
-        for _, fn in self.agg_specs:
-            if fn.child is None:
-                val_preps.append([])
-            else:
+        # per spec: one prep list PER CHILD expression (Count() has none,
+        # most aggs have one, MergeMoments has three)
+        val_preps: List[List[List[NodePrep]]] = []
+        for _, fn in agg_specs:
+            per_child = []
+            for c in fn.children:
                 preps = []
-                _walk_prep(fn.child, pctx, preps)
-                val_preps.append(preps)
+                _walk_prep(c, pctx, preps)
+                per_child.append(preps)
+            val_preps.append(per_child)
         return pctx, filter_preps, key_preps, val_preps
 
-    def _fast_layout(self, key_preps) -> Optional[tuple]:
+    def _fast_layout(self, grouping, key_preps) -> Optional[tuple]:
         """Dictionary-code layout if every key has a small known domain:
         (kinds, sizes, strides, padded_num_segments)."""
-        if not self.grouping or self.max_dict_groups <= 0:
+        if not grouping or self.max_dict_groups <= 0:
             return None
         kinds: List[str] = []
         sizes: List[int] = []
-        for g, preps in zip(self.grouping, key_preps):
+        for g, preps in zip(grouping, key_preps):
             dt = g.data_type
             root = preps[-1]
             if isinstance(dt, T.StringType) and root.out_dict is not None:
@@ -151,34 +293,39 @@ class TpuHashAggregateExec(TpuExec):
         gpad = max(8, 1 << (max(total - 1, 1)).bit_length())
         return tuple(kinds), sizes, strides, gpad
 
-    def _aggregate(self, table: DeviceTable) -> DeviceTable:
-        pctx, filter_preps, key_preps, val_preps = self._prep_all(table)
+    def _aggregate(self, table: DeviceTable, grouping, agg_specs,
+                   grouping_names, filters) -> DeviceTable:
+        pctx, filter_preps, key_preps, val_preps = self._prep_all(
+            table, grouping, agg_specs, filters)
         cols = tuple(DevVal(c.data, c.validity) for c in table.columns)
         aux = tuple(jnp.asarray(a) for a in pctx.aux_arrays)
         capacity = table.capacity
 
-        fast = self._fast_layout(key_preps)
+        fast = self._fast_layout(grouping, key_preps)
 
         from spark_rapids_tpu.ops.expr import shared_traces
         self._traces = shared_traces(
             ("agg",
-             tuple(g.key() for g in self.grouping),
-             tuple(fn.key() for _, fn in self.agg_specs),
-             tuple(f.key() for f in self.filters),
+             tuple(g.key() for g in grouping),
+             tuple(fn.key() for _, fn in agg_specs),
+             tuple(f.key() for f in filters),
              table.schema_key()[0]))
         mode_key = ("fast", fast[0], fast[3]) if fast else ("sorted",)
         tkey = (capacity, self.use_split, mode_key,
                 tuple(_prep_trace_key(p) for p in filter_preps),
                 tuple(_prep_trace_key(p) for p in key_preps),
-                tuple(_prep_trace_key(p) for p in val_preps))
+                tuple(tuple(_prep_trace_key(p) for p in per_child)
+                      for per_child in val_preps))
         fn = self._traces.get(tkey)
         if fn is None:
             if fast:
                 fn = jax.jit(self._build_fast_kernel(
-                    capacity, fast[0], fast[3], filter_preps, key_preps, val_preps))
+                    capacity, fast[0], fast[3], filter_preps, key_preps,
+                    val_preps, grouping, agg_specs, filters))
             else:
                 fn = jax.jit(self._build_kernel(
-                    capacity, filter_preps, key_preps, val_preps))
+                    capacity, filter_preps, key_preps, val_preps,
+                    grouping, agg_specs, filters))
             self._traces[tkey] = fn
 
         if fast:
@@ -194,20 +341,20 @@ class TpuHashAggregateExec(TpuExec):
 
         out_cols: List[DeviceColumn] = []
         names: List[str] = []
-        for i, (g, name) in enumerate(zip(self.grouping, self.grouping_names)):
+        for i, (g, name) in enumerate(zip(grouping, grouping_names)):
             data, validity = out_arrays[i]
             root = key_preps[i][-1]
             out_cols.append(DeviceColumn(g.data_type, data, validity,
                                          dictionary=root.out_dict,
                                          dict_sorted=root.dict_sorted))
             names.append(name)
-        for j, (name, fnagg) in enumerate(self.agg_specs):
-            data, validity = out_arrays[len(self.grouping) + j]
+        for j, (name, fnagg) in enumerate(agg_specs):
+            data, validity = out_arrays[len(grouping) + j]
             dictionary = None
             dict_sorted = True
             if isinstance(fnagg.data_type, T.StringType) and val_preps[j]:
-                dictionary = val_preps[j][-1].out_dict
-                dict_sorted = val_preps[j][-1].dict_sorted
+                dictionary = val_preps[j][-1][-1].out_dict
+                dict_sorted = val_preps[j][-1][-1].dict_sorted
             out_cols.append(DeviceColumn(fnagg.data_type, data, validity,
                                          dictionary=dictionary, dict_sorted=dict_sorted))
             names.append(name)
@@ -220,10 +367,10 @@ class TpuHashAggregateExec(TpuExec):
         # sorts/transfers don't run at input capacity
         return out.shrink()
 
-    def _eval_live(self, capacity, cols, aux, nrows, filter_preps):
+    def _eval_live(self, filters, capacity, cols, aux, nrows, filter_preps):
         """Row-liveness mask: in-bounds AND every fused predicate true."""
         live = jnp.arange(capacity, dtype=jnp.int32) < nrows
-        for f, preps in zip(self.filters, filter_preps):
+        for f, preps in zip(filters, filter_preps):
             ctx = EvalCtx(cols, aux, nrows, capacity)
             ctx._prep_iter = iter(preps)
             pred = _walk_eval(f, ctx)
@@ -232,14 +379,14 @@ class TpuHashAggregateExec(TpuExec):
 
     # -- fast path: dictionary-code grouping, no sort -----------------------
     def _build_fast_kernel(self, capacity: int, kinds, gpad: int,
-                           filter_preps, key_preps, val_preps):
-        grouping = self.grouping
-        agg_specs = self.agg_specs
-        value_exprs = [fn.child for _, fn in agg_specs]
+                           filter_preps, key_preps, val_preps,
+                           grouping, agg_specs, filters):
+        value_exprs = [list(fn.children) for _, fn in agg_specs]
         use_split = self.use_split
 
         def kernel(cols, aux, nrows, sizes, strides):
-            live = self._eval_live(capacity, cols, aux, nrows, filter_preps)
+            live = self._eval_live(filters, capacity, cols, aux, nrows,
+                                   filter_preps)
 
             gid = jnp.zeros(capacity, dtype=jnp.int32)
             for i, (g, preps, kind) in enumerate(zip(grouping, key_preps, kinds)):
@@ -257,15 +404,14 @@ class TpuHashAggregateExec(TpuExec):
             # segment_sum. Min/Max/First/Last and i64 sums stay per-spec
             # (_agg_one).
             vvs = []
-            for ve, preps in zip(value_exprs, val_preps):
-                if ve is None:
-                    vvs.append(None)
-                else:
+            for ves, per_child in zip(value_exprs, val_preps):
+                vals = []
+                for ve, preps in zip(ves, per_child):
                     ctx = EvalCtx(cols, aux, nrows, capacity)
                     ctx._prep_iter = iter(preps)
-                    vvs.append(_walk_eval(ve, ctx))
-            svs = [(vv.validity & live) if vv is not None else None
-                   for vv in vvs]
+                    vals.append(_walk_eval(ve, ctx))
+                vvs.append(vals)
+            svs = [(vv[0].validity & live) if vv else None for vv in vvs]
 
             # one scatter for live-count + every spec's nonnull count
             masks = [live] + [sv for sv in svs if sv is not None]
@@ -299,7 +445,7 @@ class TpuHashAggregateExec(TpuExec):
                 kdata = (slot == 1) if kind == "bool" else slot
                 outs.append(compact(kdata, kvalid))
 
-            fplan = []  # (spec index, kind) riding the batched f64 pass
+            fplan = []  # (spec index, kind) riding a batched f64 pass
             for j, (_, fnagg) in enumerate(agg_specs):
                 if isinstance(fnagg, (agg.StddevPop, agg.StddevSamp,
                                       agg.VariancePop, agg.VarianceSamp)):
@@ -309,30 +455,44 @@ class TpuHashAggregateExec(TpuExec):
                 elif isinstance(fnagg, agg.Sum) and not isinstance(
                         fnagg.data_type, T.LongType):
                     fplan.append((j, "sum"))
-            fcols = [jnp.where(svs[j], vvs[j].data.astype(jnp.float64), 0.0)
-                     for j, _ in fplan]
-            fsums = batched_segment_sum_f64(fcols, gid, gpad, capacity,
-                                            use_split)
+            # sum/avg ride the split pass; variance means must be EXACT —
+            # a mean error d inflates the centered pass by n*d^2 (quadratic
+            # amplification the split guard cannot bound)
+            splan = [(j, kind) for j, kind in fplan if kind != "var"]
+            vplan_j = [j for j, kind in fplan if kind == "var"]
+            fcols = [jnp.where(svs[j], vvs[j][0].data.astype(jnp.float64), 0.0)
+                     for j, _ in splan]
+            fsums_s = batched_segment_sum_f64(fcols, gid, gpad, capacity,
+                                              use_split)
+            vcols = [jnp.where(svs[j], vvs[j][0].data.astype(jnp.float64), 0.0)
+                     for j in vplan_j]
+            fsums_v = batched_segment_sum_f64(vcols, gid, gpad, capacity,
+                                              use_split=False)
+            fsums = {}
+            for i, (j, _) in enumerate(splan):
+                fsums[j] = fsums_s[:, i]
+            for i, j in enumerate(vplan_j):
+                fsums[j] = fsums_v[:, i]
 
-            # second batched pass: centered moments for stddev/variance
-            vplan = [(i, j) for i, (j, kind) in enumerate(fplan)
-                     if kind == "var"]
+            # second batched pass: centered moments (positive values, so the
+            # split path's relative-error guard applies cleanly)
             ccols = []
-            for i, j in vplan:
-                mean = fsums[:, i] / jnp.maximum(nonnulls[j], 1)
+            for j in vplan_j:
+                mean = fsums[j] / jnp.maximum(nonnulls[j], 1)
                 ccols.append(jnp.where(
                     svs[j],
-                    (vvs[j].data.astype(jnp.float64) - mean[gid]) ** 2, 0.0))
+                    (vvs[j][0].data.astype(jnp.float64) - mean[gid]) ** 2,
+                    0.0))
             csums = batched_segment_sum_f64(ccols, gid, gpad, capacity,
                                             use_split)
-            m2s = {j: csums[:, i2] for i2, (_, j) in enumerate(vplan)}
+            m2s = {j: csums[:, i2] for i2, j in enumerate(vplan_j)}
 
             fres = {}
-            for i, (j, kind) in enumerate(fplan):
+            for j, kind in fplan:
                 fnagg = agg_specs[j][1]
                 nonnull = nonnulls[j]
                 has_any = (nonnull > 0) & exists
-                s = fsums[:, i]
+                s = fsums[j]
                 if kind == "sum":
                     fres[j] = (jnp.where(has_any, s, 0.0), has_any)
                 elif kind == "avg":
@@ -356,8 +516,11 @@ class TpuHashAggregateExec(TpuExec):
                 elif isinstance(fnagg, agg.Count):
                     w = mcnt[:, 0] if fnagg.child is None else nonnulls[j]
                     data, validity = w.astype(jnp.int64), exists
+                elif isinstance(fnagg, agg.MergeMoments):
+                    data, validity = self._merge_moments(
+                        vvs[j], live, gid, gpad, exists)
                 else:
-                    sd = vvs[j].data if vvs[j] is not None else None
+                    sd = vvs[j][0].data if vvs[j] else None
                     data, validity = self._agg_one(
                         fnagg, sd, svs[j], live, gid, gpad, exists,
                         capacity, use_split)
@@ -367,28 +530,28 @@ class TpuHashAggregateExec(TpuExec):
         return kernel
 
     # -- general path: sort-segment -----------------------------------------
-    def _build_kernel(self, capacity: int, filter_preps, key_preps, val_preps):
-        grouping = self.grouping
-        agg_specs = self.agg_specs
-        value_exprs = [fn.child for _, fn in agg_specs]
+    def _build_kernel(self, capacity: int, filter_preps, key_preps, val_preps,
+                      grouping, agg_specs, filters):
+        value_exprs = [list(fn.children) for _, fn in agg_specs]
         use_split = self.use_split
 
         def kernel(cols, aux, nrows):
-            live = self._eval_live(capacity, cols, aux, nrows, filter_preps)
+            live = self._eval_live(filters, capacity, cols, aux, nrows,
+                                   filter_preps)
 
             key_vals: List[DevVal] = []
             for g, preps in zip(grouping, key_preps):
                 ctx = EvalCtx(cols, aux, nrows, capacity)
                 ctx._prep_iter = iter(preps)
                 key_vals.append(_walk_eval(g, ctx))
-            val_vals: List[DevVal] = []
-            for ve, preps in zip(value_exprs, val_preps):
-                if ve is None:
-                    val_vals.append(None)
-                else:
+            val_vals = []
+            for ves, per_child in zip(value_exprs, val_preps):
+                vals = []
+                for ve, preps in zip(ves, per_child):
                     ctx = EvalCtx(cols, aux, nrows, capacity)
                     ctx._prep_iter = iter(preps)
-                    val_vals.append(_walk_eval(ve, ctx))
+                    vals.append(_walk_eval(ve, ctx))
+                val_vals.append(vals)
 
             # normalize float keys so grouping matches the CPU oracle
             norm = []
@@ -441,13 +604,39 @@ class TpuHashAggregateExec(TpuExec):
                 outs.append((kd, kvv & group_live))
 
             for (name, fnagg), vv in zip(agg_specs, val_vals):
-                sd = vv.data[perm] if vv is not None else None
-                sv = (vv.validity[perm] & s_live) if vv is not None else None
+                if isinstance(fnagg, agg.MergeMoments):
+                    pv = [DevVal(x.data[perm], x.validity[perm]) for x in vv]
+                    outs.append(self._merge_moments(pv, s_live, gid,
+                                                    capacity, group_live))
+                    continue
+                sd = vv[0].data[perm] if vv else None
+                sv = (vv[0].validity[perm] & s_live) if vv else None
                 outs.append(self._agg_one(fnagg, sd, sv, s_live, gid, capacity,
                                           group_live, capacity, use_split))
             return outs, ngroups
 
         return kernel
+
+    @staticmethod
+    def _merge_moments(vv3, live, gid, nseg, group_live):
+        """Numerically stable merge of per-batch moment partials
+        (n_i, s_i, m2_i) -> total m2, via Chan's combination
+        m2 = sum(m2_i) + sum(n_i * (mean_i - mean_total)^2). All sums run
+        exact emulated f64 — the merge table is partials-sized, tiny."""
+        nvv, svv, mvv = vv3
+        sv = nvv.validity & svv.validity & mvv.validity & live
+        n = jnp.where(sv, nvv.data.astype(jnp.float64), 0.0)
+        s = jnp.where(sv, svv.data.astype(jnp.float64), 0.0)
+        m2 = jnp.where(sv, mvv.data.astype(jnp.float64), 0.0)
+        N = jax.ops.segment_sum(n, gid, num_segments=nseg)
+        S = jax.ops.segment_sum(s, gid, num_segments=nseg)
+        mean_tot = S / jnp.maximum(N, 1.0)
+        mean_i = s / jnp.maximum(n, 1.0)
+        c = jnp.where(sv, m2 + n * (mean_i - mean_tot[gid]) ** 2, 0.0)
+        M2 = jax.ops.segment_sum(c, gid, num_segments=nseg)
+        has = (jax.ops.segment_sum(sv.astype(jnp.int32), gid,
+                                   num_segments=nseg) > 0) & group_live
+        return (jnp.where(has, M2, 0.0), has)
 
     @staticmethod
     def _agg_one(fnagg, sd, sv, live, gid, nseg, group_live, capacity, use_split):
@@ -483,7 +672,9 @@ class TpuHashAggregateExec(TpuExec):
 
         if isinstance(fnagg, (agg.StddevPop, agg.StddevSamp, agg.VariancePop, agg.VarianceSamp)):
             v = jnp.where(sv, sd.astype(jnp.float64), 0.0)
-            s = segment_sum_f64(v, gid, nseg, capacity, use_split)
+            # EXACT mean: a split-sum mean error d would inflate the
+            # centered pass by n*d^2 (quadratic amplification)
+            s = segment_sum_f64(v, gid, nseg, capacity, use_split=False)
             mean = s / jnp.maximum(nonnull, 1)
             centered = jnp.where(sv, (sd.astype(jnp.float64) - mean[gid]) ** 2, 0.0)
             m2 = segment_sum_f64(centered, gid, nseg, capacity, use_split)

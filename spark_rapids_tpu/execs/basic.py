@@ -301,18 +301,26 @@ class TpuCoalesceExec(TpuExec):
         catalog = BufferCatalog.get()
         pending: List[SpillableBatch] = []
         pending_bytes = 0
-        for batch in self.children[0].execute():
-            pending_bytes += batch.device_nbytes()
-            # buffered batches are spillable while more input streams in
-            # (reference: coalesce inputs are SpillableColumnarBatches)
-            pending.append(SpillableBatch(batch, catalog))
-            if not self.require_single and pending_bytes >= self.target_bytes:
+        try:
+            for batch in self.children[0].execute():
+                pending_bytes += batch.device_nbytes()
+                # buffered batches are spillable while more input streams in
+                # (reference: coalesce inputs are SpillableColumnarBatches)
+                pending.append(SpillableBatch(batch, catalog))
+                if not self.require_single and pending_bytes >= self.target_bytes:
+                    yield self._flush(pending)
+                    pending, pending_bytes = [], 0
+            if pending:
                 yield self._flush(pending)
-                pending, pending_bytes = [], 0
-        if pending:
-            yield self._flush(pending)
+                pending = []
+        finally:
+            # abandonment (downstream limit stopped consuming) or an error
+            # mid-flush must not leak catalog registrations/spill files
+            for b in pending:
+                b.release()
 
     def _flush(self, batches) -> DeviceTable:
+        from spark_rapids_tpu.columnar.table import concat_device
         from spark_rapids_tpu.runtime.retry import retry_block
         if len(batches) == 1:
             sb = batches[0]
@@ -320,10 +328,14 @@ class TpuCoalesceExec(TpuExec):
             sb.release()
             return out
         self.add_metric("concatBatches", len(batches))
-        host = HostTable.concat([b.get_host() for b in batches])
-        for b in batches:
-            b.release()
-        return retry_block(lambda: DeviceTable.from_host(host))
+        try:
+            # device-side concat: no host round trip; string dictionaries
+            # union-remap with O(dict) host work
+            return retry_block(
+                lambda: concat_device([b.get() for b in batches]))
+        finally:
+            for b in batches:
+                b.release()
 
     def describe(self):
         goal = "RequireSingleBatch" if self.require_single else f"TargetSize({self.target_bytes})"

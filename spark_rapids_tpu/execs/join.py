@@ -265,7 +265,8 @@ class TpuJoinExec(TpuExec):
     def __init__(self, left: TpuExec, right: TpuExec, join_type: str,
                  left_keys: Sequence[Expression], right_keys: Sequence[Expression],
                  condition: Optional[Expression],
-                 left_schema, right_schema):
+                 left_schema, right_schema,
+                 subpartition_bytes: int = 1 << 30):
         super().__init__()
         self.children = (left, right)
         self.join_type = join_type.lower().replace("_", "")
@@ -276,6 +277,7 @@ class TpuJoinExec(TpuExec):
         self.right_names = [n for n, _ in right_schema]
         self._left_schema = left_schema
         self._right_schema = right_schema
+        self.subpartition_bytes = subpartition_bytes
         self._kernel = JoinKernel.get(len(self.left_keys))
         self._filter_kernel = None
 
@@ -293,32 +295,147 @@ class TpuJoinExec(TpuExec):
 
     # -----------------------------------------------------------------------
     def execute(self):
+        """Probe-side STREAMING execution: the build side is one coalesced
+        (spillable-protected) table; probe batches stream through one at a
+        time — the reference's join iterator shape (GpuShuffledHashJoinExec
+        streams the streamed side against the built hash table). Full-outer
+        joins accumulate a build-side match bitmap across probe batches and
+        emit unmatched build rows as a final batch."""
         from spark_rapids_tpu.runtime.retry import retry_block
-        lt = self._single(self.children[0])
-        rt = self._single(self.children[1])
-        out = retry_block(lambda: self._join(lt, rt))
+
+        jt = self.join_type
+        swapped = jt in ("right", "rightouter")
+        build_child = self.children[0] if swapped else self.children[1]
+        probe_child = self.children[1] if swapped else self.children[0]
+
+        build = self._single(build_child)
+
+        nparts = 1
+        if (jt != "cross" and self.subpartition_bytes > 0
+                and build.device_nbytes() > self.subpartition_bytes):
+            nparts = min(
+                -(-build.device_nbytes() // self.subpartition_bytes), 64)
+        if nparts > 1:
+            yield from self._execute_subpartitioned(
+                build, probe_child, swapped, int(nparts))
+            return
+
+        full_outer = jt in ("full", "fullouter", "outer")
+        r_matched_accum = None
+        for pb in probe_child.execute():
+            out, r_matched = retry_block(
+                lambda b=pb: self._join_batch(b, build, swapped))
+            if full_outer:
+                r_matched_accum = (r_matched if r_matched_accum is None
+                                   else r_matched_accum | r_matched)
+            if out is not None:
+                yield self._apply_condition(out)
+            self.add_metric("probeBatches", 1)
+
+        if full_outer:
+            if r_matched_accum is None:
+                r_matched_accum = jnp.zeros(build.capacity, jnp.bool_)
+            yield self._unmatched_build_batch(build, r_matched_accum, swapped)
+
+    def _execute_subpartitioned(self, build: DeviceTable, probe_child,
+                                swapped: bool, nparts: int):
+        """Sub-partitioned escalation (GpuSubPartitionHashJoin analog): the
+        build table splits by Spark-exact key hash into ``nparts`` SPILLABLE
+        partitions; each probe batch splits the same way, and bucket pairs
+        join independently — peak HBM is one build partition + one probe
+        sub-batch, not the whole build."""
+        from spark_rapids_tpu.runtime.retry import retry_block
+        from spark_rapids_tpu.runtime.spill import BufferCatalog, SpillableBatch
+        from spark_rapids_tpu.shuffle.partitioning import HashPartitioner
+
+        jt = self.join_type
+        full_outer = jt in ("full", "fullouter", "outer")
+        build_keys = self.left_keys if swapped else self.right_keys
+        probe_keys = self.right_keys if swapped else self.left_keys
+        bparter = HashPartitioner(build_keys, nparts)
+        pparter = HashPartitioner(probe_keys, nparts)
+        catalog = BufferCatalog.get()
+
+        build_parts = [SpillableBatch(t, catalog)
+                       for t in self._split(build, bparter)]
+        del build
+        self.add_metric("subPartitions", nparts)
+        r_matched = [None] * nparts
+        try:
+            for pb in probe_child.execute():
+                for p, pp in enumerate(self._split(pb, pparter)):
+                    with build_parts[p].pinned_batch() as bt:
+                        out, rm = retry_block(
+                            lambda a=pp, b=bt: self._join_batch(a, b, swapped))
+                    if full_outer and rm is not None:
+                        r_matched[p] = (rm if r_matched[p] is None
+                                        else r_matched[p] | rm)
+                    if out is not None:
+                        yield self._apply_condition(out)
+                self.add_metric("probeBatches", 1)
+
+            if full_outer:
+                for p in range(nparts):
+                    with build_parts[p].pinned_batch() as bt:
+                        rm = (r_matched[p] if r_matched[p] is not None
+                              else jnp.zeros(bt.capacity, jnp.bool_))
+                        yield self._unmatched_build_batch(bt, rm, swapped)
+        finally:
+            for sb in build_parts:
+                sb.release()
+
+    def _split(self, table: DeviceTable, parter) -> List[DeviceTable]:
+        """Split a table into per-partition compacted tables, re-bucketed
+        to their live size (one host sync for the count vector)."""
+        pids = parter.partition_ids(table)
+        live = table.row_mask()
+        nparts = parter.num_partitions
+        key = ("splitcnt", table.capacity, nparts)
+        fn = self._kernel._aux_traces.get(key)
+        if fn is None:
+            def counts_fn(pids, live):
+                return jax.ops.segment_sum(
+                    live.astype(jnp.int32), jnp.clip(pids, 0, nparts - 1),
+                    num_segments=nparts)
+            fn = jax.jit(counts_fn)
+            self._kernel._aux_traces[key] = fn
+        counts = np.asarray(jax.device_get(fn(pids, live)))
+        parts = []
+        for p in range(nparts):
+            compacted = self._compact(table, (pids == p) & live)
+            k = bucket_for(max(int(counts[p]), 1))
+            if k < compacted.capacity:
+                cols = [c.with_arrays(c.data[:k], c.validity[:k])
+                        for c in compacted.columns]
+                compacted = DeviceTable(compacted.names, cols,
+                                        int(counts[p]), k)
+            parts.append(compacted)
+        return parts
+
+
+    def _apply_condition(self, out: DeviceTable) -> DeviceTable:
         if self.condition is not None and self.join_type in ("inner", "cross"):
             from spark_rapids_tpu.execs.basic import _FilterKernel
             if self._filter_kernel is None:
                 self._filter_kernel = _FilterKernel(self.condition)
             out = self._filter_kernel(out)
-        yield out
+        return out
 
     @staticmethod
     def _single(child: TpuExec) -> DeviceTable:
         batches = list(child.execute())
         if len(batches) != 1:
-            raise ColumnarProcessingError("join requires coalesced single batches")
+            raise ColumnarProcessingError("join requires a coalesced build side")
         return batches[0]
 
-    def _join(self, lt: DeviceTable, rt: DeviceTable) -> DeviceTable:
+    def _join_batch(self, lt: DeviceTable, rt: DeviceTable, swapped: bool):
+        """Join ONE probe batch (lt) against the build table (rt). Returns
+        (output table or None, build-match bitmap or None)."""
         jt = self.join_type
         if jt == "cross":
-            return self._cross(lt, rt)
+            return self._cross(lt, rt, swapped), None
 
-        swapped = jt in ("right", "rightouter")
         if swapped:
-            lt, rt = rt, lt
             lkeys_e, rkeys_e = self.right_keys, self.left_keys
         else:
             lkeys_e, rkeys_e = self.left_keys, self.right_keys
@@ -339,16 +456,22 @@ class TpuJoinExec(TpuExec):
             self._kernel.probe(lkeys, rkeys, lt.nrows_dev, rt.nrows_dev,
                                lt.capacity, rt.capacity)
 
+        full_outer = jt in ("full", "fullouter", "outer")
+        r_matched = None
+        if full_outer:
+            r_matched = self._right_matched(lo, counts, rs_perm, rt.capacity,
+                                            lt.capacity)
+
         if jt in ("leftsemi", "leftanti"):
             keep = matched_l if jt == "leftsemi" else ~matched_l
-            return self._compact(lt, keep & live_l)
+            return self._compact(lt, keep & live_l), None
 
-        total = int(jax.device_get(total_d))  # the one host sync per join
-        nl = lt.num_rows
-        if jt in ("full", "fullouter", "outer"):
-            upper = total + nl + rt.num_rows  # + unmatched build rows
-        elif jt in ("left", "leftouter", "right", "rightouter"):
-            upper = total + nl  # each unmatched probe row adds at most one
+        total = int(jax.device_get(total_d))  # the one host sync per batch
+        if jt in ("left", "leftouter", "right", "rightouter") or full_outer:
+            # each unmatched probe row adds at most one output row; use the
+            # probe CAPACITY as the static bound rather than paying a second
+            # tunnel round trip for the exact row count (<=2x bucket cost)
+            upper = total + lt.capacity
         else:
             upper = total
         out_cap = bucket_for(max(upper, 1))
@@ -357,17 +480,11 @@ class TpuJoinExec(TpuExec):
             li, ri, null_l, null_r, nout = self._kernel.expand(
                 "inner", out_cap, lt.capacity, rt.capacity,
                 (lo, counts, rs_perm, live_l))
-        elif jt in ("left", "leftouter", "right", "rightouter"):
+        else:  # left/right outer per batch; full outer = left outer per
+            # batch + deferred unmatched-build batch
             li, ri, null_l, null_r, nout = self._kernel.expand(
                 "leftouter", out_cap, lt.capacity, rt.capacity,
                 (lo, counts, rs_perm, live_l))
-        else:  # full outer
-            r_matched = self._right_matched(lo, counts, rs_perm, rt.capacity,
-                                            lt.capacity)
-            r_unmatched = live_r & ~r_matched
-            li, ri, null_l, null_r, nout = self._kernel.expand(
-                "fullouter", out_cap, lt.capacity, rt.capacity,
-                (lo, counts, rs_perm, live_l, r_unmatched))
 
         out_live = jnp.arange(out_cap, dtype=jnp.int64) < nout
         lcols = _ColumnGather.run(lt, li, null_l, out_live, out_cap)
@@ -375,7 +492,31 @@ class TpuJoinExec(TpuExec):
 
         names = self.left_names + self.right_names
         cols = rcols + lcols if swapped else lcols + rcols
-        return DeviceTable(names, cols, nout, out_cap)
+        return DeviceTable(names, cols, nout, out_cap), r_matched
+
+    def _unmatched_build_batch(self, rt: DeviceTable, r_matched,
+                               swapped: bool) -> DeviceTable:
+        """Full outer tail: build rows no probe batch matched, with an
+        all-null probe side."""
+        live_r = rt.row_mask()
+        compacted = self._compact(rt, live_r & ~r_matched)
+        probe_schema = self._right_schema if swapped else self._left_schema
+        null_cols = []
+        for _, dt in probe_schema:
+            if isinstance(dt, T.StringType):
+                data = jnp.zeros(compacted.capacity, dtype=jnp.int32)
+                null_cols.append(DeviceColumn(
+                    dt, data, jnp.zeros(compacted.capacity, jnp.bool_),
+                    dictionary=np.array([], dtype=object)))
+            else:
+                data = jnp.zeros(compacted.capacity, dtype=dt.np_dtype)
+                null_cols.append(DeviceColumn(
+                    dt, data, jnp.zeros(compacted.capacity, jnp.bool_)))
+        names = self.left_names + self.right_names
+        cols = (list(compacted.columns) + null_cols if swapped
+                else null_cols + list(compacted.columns))
+        return DeviceTable(names, cols, compacted.nrows_dev,
+                           compacted.capacity)
 
     def _right_matched(self, lo, counts, rs_perm, cap_r: int, cap_l: int):
         """Which build rows matched at least one probe row: mark sorted
@@ -423,7 +564,8 @@ class TpuJoinExec(TpuExec):
         cols = [c.with_arrays(d, v) for c, (d, v) in zip(table.columns, outs)]
         return DeviceTable(table.names, cols, new_n, table.capacity)
 
-    def _cross(self, lt: DeviceTable, rt: DeviceTable) -> DeviceTable:
+    def _cross(self, lt: DeviceTable, rt: DeviceTable,
+               swapped: bool = False) -> DeviceTable:
         nl, nr = lt.num_rows, rt.num_rows
         out_cap = bucket_for(max(nl * nr, 1))
         key = ("cross", out_cap, lt.capacity, rt.capacity)
@@ -442,5 +584,6 @@ class TpuJoinExec(TpuExec):
         zero = jnp.zeros(out_cap, jnp.bool_)
         lcols = _ColumnGather.run(lt, li, zero, out_live, out_cap)
         rcols = _ColumnGather.run(rt, ri, zero, out_live, out_cap)
-        return DeviceTable(self.left_names + self.right_names, lcols + rcols,
+        cols = rcols + lcols if swapped else lcols + rcols
+        return DeviceTable(self.left_names + self.right_names, cols,
                            nl * nr, out_cap)

@@ -64,12 +64,41 @@ class TpuSortExec(TpuExec):
         return self.children[0].output_schema()
 
     def execute(self):
+        """Multi-batch inputs accumulate as SPILLABLE batches (bounded HBM
+        while upstream streams; reference: GpuSortExec pending pool,
+        GpuSortExec.scala:281), then a device concat + one lax.sort
+        produces the output. The final sort materializes the full table on
+        device under OOM retry — emitting range-split output batches
+        without full materialization is the planned widening."""
         from spark_rapids_tpu.runtime.retry import retry_block
-        batches = list(self.children[0].execute())
-        if len(batches) > 1:
-            from spark_rapids_tpu.errors import ColumnarProcessingError
-            raise ColumnarProcessingError("TpuSortExec requires a single coalesced batch")
-        yield retry_block(lambda: self._sort(batches[0]))
+        from spark_rapids_tpu.runtime.spill import BufferCatalog, SpillableBatch
+
+        it = self.children[0].execute()
+        first = next(it, None)
+        if first is None:
+            return
+        second = next(it, None)
+        if second is None:
+            yield retry_block(lambda: self._sort(first))
+            return
+
+        from itertools import chain
+        from spark_rapids_tpu.columnar.table import concat_device
+        catalog = BufferCatalog.get()
+        pending = []
+        try:
+            for batch in chain([first, second], it):
+                pending.append(SpillableBatch(batch, catalog))
+                self.add_metric("sortInputBatches", 1)
+
+            def merge_and_sort():
+                tables = [sb.get() for sb in pending]
+                return self._sort(concat_device(tables))
+
+            yield retry_block(merge_and_sort)
+        finally:
+            for sb in pending:
+                sb.release()
 
     def _sort(self, table: DeviceTable) -> DeviceTable:
         from spark_rapids_tpu.ops.expr import shared_traces

@@ -146,3 +146,32 @@ def is_aggregate(e: Expression) -> bool:
     if isinstance(e, Alias):
         return is_aggregate(e.children[0])
     return isinstance(e, AggregateFunction)
+
+
+class MergeMoments(AggregateFunction):
+    """INTERNAL (streaming merge only, never planner-visible): combines
+    per-batch moment partials. Children are (count, sum, m2) expressions
+    over the concatenated partial table; the device kernels compute the
+    numerically stable Chan combination
+    ``m2_total = sum(m2_i) + sum(n_i * (mean_i - mean_total)^2)``
+    (reference: GpuM2 merge aggregation buffers, aggregateFunctions.scala
+    CudfMergeM2)."""
+
+    def __init__(self, count_expr: Expression, sum_expr: Expression,
+                 m2_expr: Expression):
+        self.children = (count_expr, sum_expr, m2_expr)
+
+    @property
+    def data_type(self):
+        return T.DOUBLE
+
+    @property
+    def child(self):
+        # single-child accessors don't apply; the kernels special-case this
+        return None
+
+    def with_children(self, children):
+        return MergeMoments(children[0], children[1], children[2])
+
+    def key(self):
+        return ("mergemoments", tuple(c.key() for c in self.children))

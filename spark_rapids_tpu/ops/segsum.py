@@ -52,6 +52,19 @@ ATOL = 1e-12
 MAX_PARTIALS = 1 << 22
 
 
+def split_f64_hi_lo(x):
+    """EXACT hi/lo f32 decomposition of a device f64 array (TPU f64 storage
+    is an (f32, f32) pair, so x == hi + lo exactly). Non-finite hi (inf from
+    overflow, NaN) gets lo=0 so hi+lo reproduces the special value instead
+    of inf-inf=NaN. Single source of truth for every device-side split
+    (segmented sums here, the d2h pack in columnar/table.py); the numpy
+    staging variant lives in columnar/column.py stage_upload."""
+    hi = x.astype(jnp.float32)
+    lo = jnp.where(jnp.isfinite(hi),
+                   (x - hi.astype(jnp.float64)).astype(jnp.float32), 0.0)
+    return hi, lo
+
+
 def resolve_split_mode(conf) -> bool:
     """Resolve spark.rapids.tpu.sum.splitF64 ('auto' = split on non-CPU
     backends, where f64 is emulated; CPU f64 is native and exact)."""
@@ -92,9 +105,9 @@ def batched_segment_sum_f64(cols, gid, num_segments: int, capacity: int,
 
     his, los, abss = [], [], []
     for c in cols:
-        hi = c.astype(jnp.float32)
+        hi, lo = split_f64_hi_lo(c)
         his.append(hi)
-        los.append((c - hi.astype(jnp.float64)).astype(jnp.float32))
+        los.append(lo)
         abss.append(jnp.abs(hi))
     x = jnp.stack(his + los + abss, axis=1)  # (capacity, 3m)
 
@@ -140,8 +153,7 @@ def segment_sum_f64(v, gid, num_segments: int, capacity: int, use_split: bool):
     if nb * block != capacity or nb * num_segments > MAX_PARTIALS:
         return jax.ops.segment_sum(v, gid, num_segments=num_segments)
 
-    hi = v.astype(jnp.float32)
-    lo = (v - hi.astype(jnp.float64)).astype(jnp.float32)
+    hi, lo = split_f64_hi_lo(v)
     blk = jnp.arange(capacity, dtype=jnp.int32) // block
     ids = blk * num_segments + gid
     phi = jax.ops.segment_sum(hi, ids, num_segments=nb * num_segments)

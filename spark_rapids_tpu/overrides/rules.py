@@ -262,7 +262,9 @@ def _convert_aggregate(node: P.Aggregate, children, conf):
         grouping = exprs[:len(grouping)]
         agg_specs = [(n, fn) for (n, _), fn in
                      zip(agg_specs, exprs[len(grouping):])]
-    coalesced = TpuCoalesceExec(child, require_single=True)
+    # target-size coalesce (NOT RequireSingleBatch): inputs above the batch
+    # target stream through the partial-per-batch merge path
+    coalesced = TpuCoalesceExec(child, target_bytes=conf.batch_size_bytes)
     return TpuHashAggregateExec(coalesced, grouping, agg_specs,
                                 node.grouping_names,
                                 filters=filters,
@@ -271,7 +273,7 @@ def _convert_aggregate(node: P.Aggregate, children, conf):
 
 
 def _convert_sort(node: P.Sort, children, conf):
-    coalesced = TpuCoalesceExec(children[0], require_single=True)
+    coalesced = TpuCoalesceExec(children[0], target_bytes=conf.batch_size_bytes)
     return TpuSortExec(coalesced, node.orders)
 
 
@@ -320,12 +322,23 @@ def _convert_join(node: P.Join, children, conf):
                 lkeys[i] = Cast(lk, target)
             if rk.data_type != target:
                 rkeys[i] = Cast(rk, target)
-    left = TpuCoalesceExec(children[0], require_single=True)
-    right = TpuCoalesceExec(children[1], require_single=True)
+    # the BUILD side must be a single coalesced table; the PROBE side
+    # streams target-sized batches through the join iterator
+    jt = node.join_type.lower().replace("_", "")
+    swapped = jt in ("right", "rightouter")
+    target = conf.batch_size_bytes
+    if swapped:
+        left = TpuCoalesceExec(children[0], require_single=True)
+        right = TpuCoalesceExec(children[1], target_bytes=target)
+    else:
+        left = TpuCoalesceExec(children[0], target_bytes=target)
+        right = TpuCoalesceExec(children[1], require_single=True)
+    from spark_rapids_tpu.conf import JOIN_SUBPARTITION_BYTES
     return TpuJoinExec(left, right, node.join_type, lkeys, rkeys,
                        node.condition,
                        node.children[0].output_schema(),
-                       node.children[1].output_schema())
+                       node.children[1].output_schema(),
+                       subpartition_bytes=conf.get_entry(JOIN_SUBPARTITION_BYTES))
 
 
 def _convert_file_scan(node, children, conf):

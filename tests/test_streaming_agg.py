@@ -1,0 +1,148 @@
+"""Streaming multi-batch aggregation: partial-per-batch + merge
+(reference analog: GpuAggregateExec partial/merge modes,
+HashAggregateRetrySuite). A tiny batchSizeBytes forces the coalesce to
+stream batches so the merge path actually runs."""
+
+import pytest
+
+from spark_rapids_tpu import functions as F
+from spark_rapids_tpu.ops.expr import col, lit
+
+from tests.asserts import assert_tpu_and_cpu_are_equal
+from tests.data_gen import (
+    BooleanGen, DoubleGen, IntGen, LongGen, StringGen, gen_table,
+)
+
+
+@pytest.fixture(scope="module")
+def stream_session():
+    """Batch target of 1 byte => every input batch streams separately."""
+    from spark_rapids_tpu.session import TpuSession
+    return TpuSession({"spark.rapids.sql.batchSizeBytes": 1})
+
+
+def _df(sess, gens, n=900, seed=23, num_batches=4):
+    from spark_rapids_tpu.plan import from_host_table
+    return from_host_table(gen_table(gens, n, seed), sess, num_batches)
+
+
+# corner_prob=0: +/-1e30 corner values make f64 sums ORDER-DEPENDENT (a
+# small running sum absorbs into 1e30 and is lost when the pair cancels), so
+# partial-per-batch order legitimately differs from the oracle's sequential
+# order — the exact variance the reference gates with variableFloatAgg.
+GENS = {"k": StringGen(cardinality=6), "b": BooleanGen(),
+        "i": IntGen(min_val=-100, max_val=100),
+        "v": LongGen(min_val=-1000, max_val=1000),
+        "d": DoubleGen(corner_prob=0.0)}
+
+
+def test_streaming_all_aggs(stream_session, cpu_session):
+    assert_tpu_and_cpu_are_equal(
+        lambda s: _df(s, GENS).group_by("k").agg(
+            F.count().alias("cnt"), F.count(col("v")).alias("cntv"),
+            F.sum(col("v")).alias("sv"), F.sum(col("d")).alias("sd"),
+            F.min(col("d")).alias("mn"), F.max(col("v")).alias("mx"),
+            F.first(col("v")).alias("fv"), F.last(col("d")).alias("ld"),
+        ),
+        stream_session, cpu_session, approximate_float=True)
+
+
+def test_streaming_order_insensitive_aggs_corner_doubles(
+        stream_session, cpu_session):
+    """Corner-heavy doubles (inf/1e30/-0.0): count/min/max/first/last are
+    order-insensitive and must match bit-for-bit even when streamed."""
+    gens = {"k": StringGen(cardinality=5), "d": DoubleGen()}
+    assert_tpu_and_cpu_are_equal(
+        lambda s: _df(s, gens, num_batches=5).group_by("k").agg(
+            F.count(col("d")).alias("c"), F.min(col("d")).alias("mn"),
+            F.max(col("d")).alias("mx"), F.first(col("d")).alias("f"),
+            F.last(col("d")).alias("l")),
+        stream_session, cpu_session)
+
+
+def test_streaming_avg(stream_session, cpu_session):
+    assert_tpu_and_cpu_are_equal(
+        lambda s: _df(s, GENS).group_by("k", "b").agg(
+            F.avg(col("d")).alias("ad"), F.avg(col("i")).alias("ai")),
+        stream_session, cpu_session, approximate_float=True)
+
+
+def test_streaming_stddev_variance(stream_session, cpu_session):
+    assert_tpu_and_cpu_are_equal(
+        lambda s: _df(s, GENS).group_by("k").agg(
+            F.stddev(col("d")).alias("sd"),
+            F.stddev_pop(col("d")).alias("sp"),
+            F.variance(col("d")).alias("vr"),
+            F.var_pop(col("d")).alias("vp"),
+        ),
+        stream_session, cpu_session, approximate_float=True)
+
+
+def test_streaming_global_agg(stream_session, cpu_session):
+    assert_tpu_and_cpu_are_equal(
+        lambda s: _df(s, GENS).agg(
+            F.count().alias("c"), F.sum(col("v")).alias("sv"),
+            F.min(col("i")).alias("mn"), F.avg(col("d")).alias("ad")),
+        stream_session, cpu_session, approximate_float=True)
+
+
+def test_streaming_with_fused_filter(stream_session, cpu_session):
+    assert_tpu_and_cpu_are_equal(
+        lambda s: _df(s, GENS)
+        .filter(col("v") > lit(-500))
+        .select(col("k"), (col("d") * lit(3.0)).alias("d3"), col("v"))
+        .group_by("k")
+        .agg(F.sum(col("d3")).alias("s3"), F.count().alias("c")),
+        stream_session, cpu_session, approximate_float=True)
+
+
+def test_streaming_sorted_path_int_keys(stream_session, cpu_session):
+    """Int keys take the sort-segment path per batch; merge still works."""
+    assert_tpu_and_cpu_are_equal(
+        lambda s: _df(s, GENS).group_by("i").agg(
+            F.count().alias("c"), F.sum(col("d")).alias("sd"),
+            F.max(col("v")).alias("mx")),
+        stream_session, cpu_session, approximate_float=True)
+
+
+def test_streaming_with_injected_oom(cpu_session):
+    """Partials replay after injected OOM (HashAggregateRetrySuite analog)."""
+    from spark_rapids_tpu.session import TpuSession
+    inj = TpuSession({"spark.rapids.sql.batchSizeBytes": 1,
+                      "spark.rapids.sql.test.injectRetryOOM": "retry:2"})
+    assert_tpu_and_cpu_are_equal(
+        lambda s: _df(s, GENS).group_by("k").agg(
+            F.count().alias("c"), F.sum(col("v")).alias("sv")),
+        inj, cpu_session)
+
+
+def test_streaming_nulls_in_keys_and_values(stream_session, cpu_session):
+    gens = {"k": StringGen(cardinality=4),
+            "v": IntGen(min_val=-50, max_val=50, null_prob=0.4),
+            "d": DoubleGen(corner_prob=0.0)}
+    assert_tpu_and_cpu_are_equal(
+        lambda s: _df(s, gens, num_batches=6).group_by("k").agg(
+            F.count(col("v")).alias("cv"), F.sum(col("v")).alias("sv"),
+            F.avg(col("v")).alias("av"), F.first(col("v")).alias("fv")),
+        stream_session, cpu_session, approximate_float=True)
+
+
+def test_variance_large_mean_stability(stream_session, cpu_session):
+    """|mean| >> stddev is the catastrophic case for naive moment merging;
+    the MergeMoments Chan combination and exact variance means must hold
+    (code-review r2 finding: M + Q - S^2/N cancelled to garbage)."""
+    import numpy as np
+    from spark_rapids_tpu.plan import from_host_table
+    from spark_rapids_tpu.columnar import HostColumn, HostTable
+    from spark_rapids_tpu import types as T
+
+    n = 2000
+    vals = 1e9 + np.arange(n) * 1e-6
+    true_std = float(np.std(vals, ddof=1))
+    t = HostTable(["k", "d"],
+                  [HostColumn(T.STRING, np.array(["g"] * n, dtype=object)),
+                   HostColumn(T.DOUBLE, vals)])
+    for nb in (1, 4):
+        got = from_host_table(t, stream_session, nb).group_by("k").agg(
+            F.stddev(col("d")).alias("sd")).collect()[0][1]
+        assert abs(got - true_std) <= 1e-3 * true_std, (nb, got, true_std)

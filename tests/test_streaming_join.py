@@ -1,0 +1,133 @@
+"""Probe-side streaming joins: the probe side flows through in several
+batches while the build side is one coalesced table (reference analog:
+GpuShuffledHashJoinExec streamed-side iterator). A 1-byte batch target
+forces real streaming."""
+
+import pytest
+
+from spark_rapids_tpu.ops.expr import col, lit
+
+from tests.asserts import assert_tpu_and_cpu_are_equal
+from tests.data_gen import DoubleGen, IntGen, StringGen, gen_table
+
+
+@pytest.fixture(scope="module")
+def stream_session():
+    from spark_rapids_tpu.session import TpuSession
+    return TpuSession({"spark.rapids.sql.batchSizeBytes": 1})
+
+
+def _dfs(sess, n_left=600, n_right=200, nb=4, seed=31):
+    from spark_rapids_tpu.plan import from_host_table
+    lg = {"k": IntGen(min_val=0, max_val=50), "s": StringGen(cardinality=8),
+          "lv": DoubleGen(corner_prob=0.0)}
+    rg = {"k": IntGen(min_val=0, max_val=50), "rv": IntGen()}
+    left = from_host_table(gen_table(lg, n_left, seed), sess, nb)
+    right = from_host_table(gen_table(rg, n_right, seed + 1), sess, 2)
+    return left, right
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "right", "full",
+                                 "leftsemi", "leftanti"])
+def test_streaming_join_types(stream_session, cpu_session, how):
+    def build(s):
+        left, right = _dfs(s)
+        return left.join(right, on="k", how=how)
+    assert_tpu_and_cpu_are_equal(build, stream_session, cpu_session)
+
+
+def test_streaming_inner_with_condition(stream_session, cpu_session):
+    def build(s):
+        left, right = _dfs(s)
+        return left.join(right, on="k", how="inner").filter(
+            col("rv") > col("lv"))
+    assert_tpu_and_cpu_are_equal(build, stream_session, cpu_session)
+
+
+def test_streaming_cross_join(stream_session, cpu_session):
+    def build(s):
+        from spark_rapids_tpu.plan import from_host_table
+        left = from_host_table(
+            gen_table({"a": IntGen(min_val=0, max_val=9)}, 40, 7), s, 4)
+        right = from_host_table(
+            gen_table({"b": IntGen(min_val=0, max_val=9)}, 15, 8), s, 1)
+        return left.join(right)
+    assert_tpu_and_cpu_are_equal(build, stream_session, cpu_session)
+
+
+def test_streaming_full_outer_no_probe_matches(stream_session, cpu_session):
+    """Disjoint key ranges: every build row lands in the unmatched tail."""
+    def build(s):
+        from spark_rapids_tpu.plan import from_host_table
+        left = from_host_table(
+            gen_table({"k": IntGen(min_val=0, max_val=10)}, 60, 3), s, 3)
+        right = from_host_table(
+            gen_table({"k": IntGen(min_val=100, max_val=110),
+                       "rv": IntGen()}, 30, 4), s, 1)
+        return left.join(right, on="k", how="full")
+    assert_tpu_and_cpu_are_equal(build, stream_session, cpu_session)
+
+
+def test_streaming_join_with_injected_oom(cpu_session):
+    from spark_rapids_tpu.session import TpuSession
+    inj = TpuSession({"spark.rapids.sql.batchSizeBytes": 1,
+                      "spark.rapids.sql.test.injectRetryOOM": "retry:2"})
+
+    def build(s):
+        left, right = _dfs(s)
+        return left.join(right, on="k", how="left")
+    assert_tpu_and_cpu_are_equal(build, inj, cpu_session)
+
+
+@pytest.fixture(scope="module")
+def subpart_session():
+    """Tiny sub-partition target forces the bucketed join escalation."""
+    from spark_rapids_tpu.session import TpuSession
+    return TpuSession({"spark.rapids.sql.batchSizeBytes": 1,
+                       "spark.rapids.sql.join.subPartition.targetBytes": 512})
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "right", "full",
+                                 "leftsemi", "leftanti"])
+def test_subpartitioned_join_types(subpart_session, cpu_session, how):
+    def build(s):
+        left, right = _dfs(s, n_left=500, n_right=300, nb=3)
+        return left.join(right, on="k", how=how)
+    assert_tpu_and_cpu_are_equal(build, subpart_session, cpu_session)
+
+
+def test_subpartitioned_join_string_key(subpart_session, cpu_session):
+    def build(s):
+        from spark_rapids_tpu.plan import from_host_table
+        lg = {"s": StringGen(cardinality=20), "lv": IntGen()}
+        rg = {"s": StringGen(cardinality=20), "rv": IntGen()}
+        left = from_host_table(gen_table(lg, 400, 41), s, 3)
+        right = from_host_table(gen_table(rg, 250, 42), s, 2)
+        return left.join(right, on="s", how="full")
+    assert_tpu_and_cpu_are_equal(build, subpart_session, cpu_session)
+
+
+def test_subpartitioned_join_actually_partitions(subpart_session):
+    """The escalation must really engage (metric check)."""
+    from spark_rapids_tpu.overrides import apply_overrides
+    from spark_rapids_tpu.execs.join import TpuJoinExec
+    left, right = _dfs(subpart_session, n_left=500, n_right=300, nb=3)
+    df = left.join(right, on="k", how="inner")
+    executable, _ = apply_overrides(df.plan, subpart_session.conf)
+
+    joins = []
+
+    def walk(e):
+        if isinstance(e, TpuJoinExec):
+            joins.append(e)
+        for c in getattr(e, "children", ()):
+            walk(c)
+        for attr in ("source", "tpu_exec", "cpu_node"):
+            nxt = getattr(e, attr, None)
+            if nxt is not None:
+                walk(nxt)
+
+    walk(executable)
+    assert len(joins) == 1
+    list(executable.execute_cpu())
+    assert joins[0].metrics.get("subPartitions", 0) > 1
