@@ -193,6 +193,14 @@ CPU_ORACLE_STRICT = bool_conf(
     "Test-only: compare device results bit-for-bit against the CPU path.",
     internal=True)
 
+BROADCAST_SIZE_BYTES = int_conf(
+    "spark.rapids.sql.broadcastSizeBytes", 10 << 20,
+    "Join build sides whose plan-size estimate is at or below this "
+    "threshold are broadcast: materialized once through TpuBroadcastExchangeExec "
+    "(spillable, reused across replays; replicated across the mesh in "
+    "sharded plans) instead of coalesced per-query "
+    "(autoBroadcastJoinThreshold analog).", commonly_used=True)
+
 JOIN_SUBPARTITION_BYTES = int_conf(
     "spark.rapids.sql.join.subPartition.targetBytes", 1 << 30,
     "Build sides larger than this sub-partition by Spark-exact key hash "

@@ -34,41 +34,35 @@ from spark_rapids_tpu.columnar import DeviceColumn, DeviceTable, bucket_for
 from spark_rapids_tpu.errors import ColumnarProcessingError
 from spark_rapids_tpu.execs.base import TpuExec
 from spark_rapids_tpu.ops.expr import Expression, compile_project
+from spark_rapids_tpu.ops.ordering import (
+    comparable_operands,
+    operands_equal_adjacent,
+)
 
-INT64_MAX = np.iinfo(np.int64).max
+INT32_MAX = np.iinfo(np.int32).max
 
 #: (data, validity) pair for key columns
 DevVal = Tuple[jax.Array, jax.Array]
 
 
-def _comparable_bits(data, validity):
-    """Map key data to int64 values whose equality matches Spark key
-    equality: floats canonicalize -0.0 to 0.0 and all NaNs to one pattern
-    (NaN matches NaN in Spark join keys), then bitcast."""
-    if jnp.issubdtype(data.dtype, jnp.floating):
-        data = jnp.where(data == 0.0, jnp.zeros_like(data), data)
-        data = jnp.where(jnp.isnan(data), jnp.full_like(data, jnp.nan), data)
-        itype = jnp.int32 if data.dtype == jnp.float32 else jnp.int64
-        data = jax.lax.bitcast_convert_type(data, itype)
-    if data.dtype == jnp.bool_:
-        data = data.astype(jnp.int32)
-    return data.astype(jnp.int64), validity
-
-
-def _dense_rank(vals, valid):
-    """Dense ranks [0, nvalid) over valid entries; -1 for invalid. Sort +
-    adjacent-change cumsum + scatter-back — all static shapes."""
-    n = vals.shape[0]
-    operands = [(~valid).astype(jnp.int32), vals,
-                jnp.arange(n, dtype=jnp.int32)]
-    s_flag, s_vals, perm = jax.lax.sort(operands, num_keys=2)
-    s_valid = s_flag == 0
+def _dense_rank_ops(ops, valid):
+    """Dense ranks [0, nvalid) over valid entries; -1 for invalid. One
+    multi-operand native-width lax.sort (ops/ordering.py — no emulated
+    64-bit compares) + adjacent-change cumsum + scatter-back. Output ranks
+    are i32: row counts never exceed 2^31 (power-of-two row buckets)."""
+    n = ops[0].shape[0]
+    zops = [jnp.where(valid, o, jnp.zeros_like(o)) for o in ops]
+    operands = [(~valid).astype(jnp.int32)] + zops + [
+        jnp.arange(n, dtype=jnp.int32)]
+    res = jax.lax.sort(operands, num_keys=1 + len(zops))
+    perm = res[-1]
+    s_valid = res[0] == 0
     first = jnp.arange(n) == 0
-    changed = first | (s_vals != jnp.roll(s_vals, 1))
+    changed = first | ~operands_equal_adjacent(res[1:-1])
     new_grp = changed & s_valid
-    rank_sorted = jnp.cumsum(new_grp.astype(jnp.int64)) - 1
+    rank_sorted = jnp.cumsum(new_grp.astype(jnp.int32)) - 1
     rank_sorted = jnp.where(s_valid, rank_sorted, -1)
-    return jnp.zeros(n, dtype=jnp.int64).at[perm].set(rank_sorted)
+    return jnp.zeros(n, dtype=jnp.int32).at[perm].set(rank_sorted)
 
 
 class JoinKernel:
@@ -120,34 +114,36 @@ class JoinKernel:
                 valid_l = valid_l & lv
                 valid_r = valid_r & rv
 
+            allvalid = jnp.concatenate([valid_l, valid_r])
             combined = None
             for (ld, lv), (rd, rv) in zip(lkeys, rkeys):
-                lbits, _ = _comparable_bits(ld, lv)
-                rbits, _ = _comparable_bits(rd, rv)
-                allv = jnp.concatenate([lbits, rbits])
-                allvalid = jnp.concatenate([valid_l, valid_r])
-                rank = _dense_rank(allv, allvalid)
+                ops_l = comparable_operands(ld)
+                ops_r = comparable_operands(rd)
+                allops = [jnp.concatenate([a, b])
+                          for a, b in zip(ops_l, ops_r)]
+                rank = _dense_rank_ops(allops, allvalid)
                 if combined is None:
                     combined = rank
                 else:
-                    # < n^2 always, then re-densified to < n
-                    combined = jnp.where(rank >= 0, combined * n + rank, -1)
-                    combined = _dense_rank(combined, allvalid & (combined >= 0))
+                    # re-densify the (combined, rank) pair — two i32 keys,
+                    # no overflow-prone combined*n arithmetic
+                    combined = _dense_rank_ops(
+                        [combined, rank], allvalid & (rank >= 0))
             l_codes = combined[:cap_l]
             r_codes = combined[cap_l:]
             l_codes = jnp.where(valid_l, l_codes, -1)
 
             # sort build-side codes; invalid/dead rows park at +inf
-            r_sortable = jnp.where(valid_r, r_codes, INT64_MAX)
+            r_sortable = jnp.where(valid_r, r_codes, INT32_MAX)
             rs_codes, rs_perm = jax.lax.sort(
                 [r_sortable, jnp.arange(cap_r, dtype=jnp.int32)], num_keys=1)
 
             lo = jnp.searchsorted(rs_codes, l_codes, side="left")
             hi = jnp.searchsorted(rs_codes, l_codes, side="right")
-            counts = jnp.where(valid_l, hi - lo, 0).astype(jnp.int64)
-            total = jnp.sum(counts)
+            counts = jnp.where(valid_l, hi - lo, 0).astype(jnp.int32)
+            total = jnp.sum(counts.astype(jnp.int64))
             matched_l = counts > 0
-            return (lo.astype(jnp.int64), counts, total, matched_l,
+            return (lo.astype(jnp.int32), counts, total, matched_l,
                     rs_perm, live_l, live_r)
 
         return probe
@@ -164,17 +160,25 @@ class JoinKernel:
     @staticmethod
     def _build_expand(kind: str, out_cap: int, cap_l: int):
         def expand_inner(lo, counts, rs_perm, live_l):
-            """(li, ri, nout) for inner; counts pre-adjusted for left-outer."""
+            """(li, ri, nout) for inner; counts pre-adjusted for left-outer.
+            All i32: per-batch output capacities stay under 2^31 (bigger
+            couldn't be materialized)."""
             csum = jnp.cumsum(counts)
-            total = csum[-1] if counts.shape[0] else jnp.asarray(0, jnp.int64)
+            total = csum[-1] if counts.shape[0] else jnp.asarray(0, jnp.int32)
             off = csum - counts  # exclusive prefix
-            j = jnp.arange(out_cap, dtype=jnp.int64)
-            i = jnp.searchsorted(csum, j, side="right")
+            j = jnp.arange(out_cap, dtype=jnp.int32)
+            # source row per output slot: scatter each emitting row's index
+            # at its start offset, then a running max fills the gaps — one
+            # scan instead of a log(n)-gather searchsorted
+            starts = jnp.where(counts > 0, off, out_cap)
+            marks = jnp.zeros(out_cap, dtype=jnp.int32).at[starts].max(
+                jnp.arange(counts.shape[0], dtype=jnp.int32), mode="drop")
+            i = jax.lax.associative_scan(jnp.maximum, marks)
             i = jnp.clip(i, 0, cap_l - 1)
             delta = j - off[i]
             rpos = lo[i] + delta
             rpos = jnp.clip(rpos, 0, rs_perm.shape[0] - 1)
-            ri = rs_perm[rpos].astype(jnp.int64)
+            ri = rs_perm[rpos].astype(jnp.int32)
             out_live = j < total
             li = jnp.where(out_live, i, 0)
             ri = jnp.where(out_live, ri, 0)
@@ -202,10 +206,10 @@ class JoinKernel:
                 li, ri, total_l, out_live = expand_inner(lo, counts2, rs_perm, live_l)
                 null_r = (counts[li] == 0) & out_live
                 # append unmatched build rows with null left
-                extra_pos = jnp.cumsum(r_unmatched.astype(jnp.int64)) - 1
-                n_extra = jnp.sum(r_unmatched.astype(jnp.int64))
+                extra_pos = jnp.cumsum(r_unmatched.astype(jnp.int32)) - 1
+                n_extra = jnp.sum(r_unmatched.astype(jnp.int32))
                 tgt = jnp.where(r_unmatched, total_l + extra_pos, out_cap)
-                ridx = jnp.arange(r_unmatched.shape[0], dtype=jnp.int64)
+                ridx = jnp.arange(r_unmatched.shape[0], dtype=jnp.int32)
                 ri = ri.at[tgt].set(ridx, mode="drop")
                 li = li.at[tgt].set(0, mode="drop")
                 null_l = jnp.zeros(out_cap, jnp.bool_).at[tgt].set(True, mode="drop")
@@ -486,7 +490,7 @@ class TpuJoinExec(TpuExec):
                 "leftouter", out_cap, lt.capacity, rt.capacity,
                 (lo, counts, rs_perm, live_l))
 
-        out_live = jnp.arange(out_cap, dtype=jnp.int64) < nout
+        out_live = jnp.arange(out_cap, dtype=jnp.int32) < nout
         lcols = _ColumnGather.run(lt, li, null_l, out_live, out_cap)
         rcols = _ColumnGather.run(rt, ri, null_r, out_live, out_cap)
 
@@ -526,7 +530,7 @@ class TpuJoinExec(TpuExec):
         if fn is None:
             def rmatch(lo, counts, rs_perm):
                 # diff trick: +1 at lo, -1 at lo+count, prefix-sum > 0
-                marks = jnp.zeros(cap_r + 1, dtype=jnp.int64)
+                marks = jnp.zeros(cap_r + 1, dtype=jnp.int32)
                 marks = marks.at[jnp.clip(lo, 0, cap_r)].add(
                     jnp.where(counts > 0, 1, 0), mode="drop")
                 ends = jnp.clip(lo + counts, 0, cap_r)

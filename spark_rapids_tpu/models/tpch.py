@@ -146,3 +146,91 @@ def q1_pandas(table: HostTable):
         count_order=("l_quantity", "size"),
     ).reset_index()
     return out
+
+
+# ---------------------------------------------------------------------------
+# q3-style multi-join pipeline (customer JOIN orders JOIN lineitem with
+# filters, aggregation and sort — the broadcast-join-heavy plan shape;
+# reference: NDS/TPC-DS plans are broadcast-heavy per VERDICT r1)
+# ---------------------------------------------------------------------------
+
+SEGMENTS = np.array(["AUTOMOBILE", "BUILDING", "FURNITURE", "HOUSEHOLD",
+                     "MACHINERY"], dtype=object)
+Q3_DATE = 9204  # 1995-03-15
+
+
+def q3_tables(num_rows: int, seed: int = 0):
+    """lineitem (num_rows), orders (num_rows // 4), customer (num_rows // 40)."""
+    rng = np.random.default_rng(seed)
+    n_ord = max(num_rows // 4, 1)
+    n_cust = max(num_rows // 40, 1)
+
+    cust = HostTable(["c_custkey", "c_mktsegment"], [
+        HostColumn(T.LONG, np.arange(n_cust, dtype=np.int64)),
+        HostColumn(T.STRING, SEGMENTS[rng.integers(0, len(SEGMENTS), n_cust)]),
+    ])
+    orders = HostTable(["o_orderkey", "o_custkey", "o_orderdate"], [
+        HostColumn(T.LONG, np.arange(n_ord, dtype=np.int64)),
+        HostColumn(T.LONG, rng.integers(0, n_cust, n_ord)),
+        HostColumn(T.DATE, rng.integers(8766, 9855, n_ord).astype(np.int32)),
+    ])
+    lineitem = HostTable(
+        ["l_orderkey", "l_extendedprice", "l_discount", "l_shipdate"], [
+            HostColumn(T.LONG, rng.integers(0, n_ord, num_rows)),
+            HostColumn(T.DOUBLE, (rng.random(num_rows) * 100000.0).round(2)),
+            HostColumn(T.DOUBLE, rng.integers(0, 11, num_rows) / 100.0),
+            HostColumn(T.DATE, rng.integers(8766, 9855, num_rows).astype(np.int32)),
+        ])
+    return cust, orders, lineitem
+
+
+def q3_dataframe(session, cust, orders, lineitem, segment: str = "BUILDING"):
+    from spark_rapids_tpu import functions as F
+    from spark_rapids_tpu.ops.expr import col, lit
+    from spark_rapids_tpu.plan import from_host_table
+
+    c = from_host_table(cust, session).filter(
+        col("c_mktsegment") == lit(segment))
+    o = from_host_table(orders, session).filter(
+        col("o_orderdate") < lit(Q3_DATE, T.DATE))
+    li = from_host_table(lineitem, session).filter(
+        col("l_shipdate") > lit(Q3_DATE, T.DATE))
+    joined = (li.join(o.with_column("l_orderkey", col("o_orderkey")),
+                      on="l_orderkey", how="inner")
+              .join(c.with_column("o_custkey", col("c_custkey")),
+                    on="o_custkey", how="inner"))
+    return (joined
+            .select(col("l_orderkey"), col("o_orderdate"),
+                    (col("l_extendedprice") * (lit(1.0) - col("l_discount")))
+                    .alias("volume"))
+            .group_by("l_orderkey")
+            .agg(F.sum(col("volume")).alias("revenue"),
+                 F.count().alias("n"))
+            .sort(P_REV_DESC())
+            .limit(10))
+
+
+def P_REV_DESC():
+    from spark_rapids_tpu.ops.expr import col
+    from spark_rapids_tpu.plan.nodes import SortOrder
+    return SortOrder(col("revenue"), ascending=False)
+
+
+def q3_pandas(cust, orders, lineitem, segment: str = "BUILDING"):
+    import pandas as pd
+    c = pd.DataFrame({n: col.data for n, col in zip(cust.names, cust.columns)})
+    o = pd.DataFrame({n: col.data for n, col in zip(orders.names, orders.columns)})
+    li = pd.DataFrame({n: col.data for n, col in
+                       zip(lineitem.names, lineitem.columns)})
+    c = c[c.c_mktsegment == segment]
+    o = o[o.o_orderdate < Q3_DATE]
+    li = li[li.l_shipdate > Q3_DATE].copy()
+    j = li.merge(o, left_on="l_orderkey", right_on="o_orderkey")
+    j = j.merge(c, left_on="o_custkey", right_on="c_custkey")
+    j["volume"] = j.l_extendedprice * (1.0 - j.l_discount)
+    g = (j.groupby("l_orderkey")
+         .agg(revenue=("volume", "sum"), n=("volume", "size"))
+         .reset_index()
+         .sort_values("revenue", ascending=False)
+         .head(10))
+    return g

@@ -202,10 +202,6 @@ def _tag_join(meta, conf):
         meta.reasons.append(
             f"join key count mismatch: {len(node.left_keys)} vs {len(node.right_keys)}")
         return
-    if jt != "cross" and not node.left_keys:
-        meta.reasons.append(
-            "keyless (nested-loop) non-cross join is not supported on TPU")
-        return
     for k in list(node.left_keys) + list(node.right_keys):
         check_expr(k, conf, meta.reasons, "join key ")
         dt = k.data_type
@@ -219,12 +215,13 @@ def _tag_join(meta, conf):
             meta.reasons.append(
                 f"join key types {lk.data_type} vs {rk.data_type} incompatible")
     if node.condition is not None:
-        if jt not in ("inner", "cross"):
-            # AST-vs-post-filter split (reference: AstUtil) — non-equi
-            # conditions on outer/semi/anti change match semantics; post-
-            # filtering is only sound for inner/cross.
+        if node.left_keys and jt not in ("inner", "cross"):
+            # equi keys + residual non-equi condition on outer/semi/anti:
+            # post-filtering changes match semantics (reference: AstUtil
+            # splits AST-able conditions; this engine runs KEYLESS
+            # conditioned joins on the nested-loop exec instead)
             meta.reasons.append(
-                f"non-equi condition on {jt} join is not supported on TPU")
+                f"non-equi condition on equi {jt} join is not supported on TPU")
         else:
             check_expr(node.condition, conf, meta.reasons, "join condition ")
 
@@ -322,18 +319,50 @@ def _convert_join(node: P.Join, children, conf):
                 lkeys[i] = Cast(lk, target)
             if rk.data_type != target:
                 rkeys[i] = Cast(rk, target)
-    # the BUILD side must be a single coalesced table; the PROBE side
-    # streams target-sized batches through the join iterator
+    from spark_rapids_tpu.conf import (
+        BROADCAST_SIZE_BYTES,
+        JOIN_SUBPARTITION_BYTES,
+    )
+    from spark_rapids_tpu.execs.broadcast import (
+        TpuBroadcastExchangeExec,
+        TpuNestedLoopJoinExec,
+    )
+
     jt = node.join_type.lower().replace("_", "")
     swapped = jt in ("right", "rightouter")
     target = conf.batch_size_bytes
+
+    if not lkeys and (node.condition is not None or jt != "cross"):
+        # keyless conditioned join -> broadcast nested-loop
+        if swapped:
+            left = TpuBroadcastExchangeExec(children[0])
+            right = TpuCoalesceExec(children[1], target_bytes=target)
+        else:
+            left = TpuCoalesceExec(children[0], target_bytes=target)
+            right = TpuBroadcastExchangeExec(children[1])
+        return TpuNestedLoopJoinExec(left, right, node.join_type,
+                                     node.condition,
+                                     node.children[0].output_schema(),
+                                     node.children[1].output_schema())
+
+    # equi join (and pure cross): the BUILD side is a single table — a
+    # BROADCAST exchange when its size estimate is under the threshold
+    # (GpuBroadcastHashJoinExec planning), else a coalesce with
+    # sub-partition escalation; the PROBE side streams target-sized batches
+    build_node = node.children[0] if swapped else node.children[1]
+    est = build_node.estimate_bytes()
+    broadcast = est is not None and est <= conf.get_entry(BROADCAST_SIZE_BYTES)
+
+    def wrap_build(child):
+        return (TpuBroadcastExchangeExec(child) if broadcast
+                else TpuCoalesceExec(child, require_single=True))
+
     if swapped:
-        left = TpuCoalesceExec(children[0], require_single=True)
+        left = wrap_build(children[0])
         right = TpuCoalesceExec(children[1], target_bytes=target)
     else:
         left = TpuCoalesceExec(children[0], target_bytes=target)
-        right = TpuCoalesceExec(children[1], require_single=True)
-    from spark_rapids_tpu.conf import JOIN_SUBPARTITION_BYTES
+        right = wrap_build(children[1])
     return TpuJoinExec(left, right, node.join_type, lkeys, rkeys,
                        node.condition,
                        node.children[0].output_schema(),

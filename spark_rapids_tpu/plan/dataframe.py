@@ -67,13 +67,19 @@ class DataFrame:
     def join(self, other: "DataFrame", on=None, how: str = "inner") -> "DataFrame":
         if on is None:
             return self._wrap(P.Join(self.plan, other.plan, "cross", [], []))
+        if isinstance(on, Expression):
+            # arbitrary condition over both sides -> nested-loop join
+            return self._wrap(P.Join(self.plan, other.plan, how, [], [],
+                                     condition=on))
         if isinstance(on, str):
             on = [on]
         if isinstance(on, (list, tuple)) and on and isinstance(on[0], str):
             lk = [col(k) for k in on]
             rk = [col(k) for k in on]
             return self._wrap(P.Join(self.plan, other.plan, how, lk, rk))
-        raise ValueError("join `on` must be a column name or list of names")
+        raise ValueError(
+            "join `on` must be a column name, list of names, or a condition "
+            "Expression")
 
     def with_windows(self, **named_exprs) -> "DataFrame":
         """Append window-function columns:

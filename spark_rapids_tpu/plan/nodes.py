@@ -36,6 +36,13 @@ class PlanNode:
     def execute_cpu(self) -> Iterator[HostTable]:
         raise NotImplementedError
 
+    def estimate_bytes(self) -> Optional[int]:
+        """Rough output-size upper bound for physical planning (broadcast
+        vs shuffle — the stats the reference reads from Spark's logical
+        plan). None = unknown. Row-preserving/shrinking unary nodes
+        propagate their child's estimate."""
+        return None
+
     @property
     def name(self) -> str:
         return type(self).__name__
@@ -84,6 +91,9 @@ class LocalScan(PlanNode):
     def describe(self):
         return f"LocalScan[{len(self.batches)} batches]"
 
+    def estimate_bytes(self):
+        return sum(b.nbytes() for b in self.batches)
+
 
 class RangeNode(PlanNode):
     """spark.range analog (reference: GpuRangeExec)."""
@@ -131,6 +141,17 @@ class Project(PlanNode):
     def describe(self):
         return f"Project{self.names}"
 
+    def estimate_bytes(self):
+        # projections can WIDEN rows (duplicated/derived columns); scale the
+        # child estimate by the column-count ratio so the broadcast
+        # threshold check stays an upper-bound-ish heuristic
+        est = self.children[0].estimate_bytes()
+        if est is None:
+            return None
+        n_in = max(len(self.children[0].output_schema()), 1)
+        return int(est * max(len(self.names), 1) / n_in) \
+            if len(self.names) > n_in else est
+
 
 class Filter(PlanNode):
     def __init__(self, child: PlanNode, condition: Expression):
@@ -156,6 +177,9 @@ class Filter(PlanNode):
 
     def describe(self):
         return f"Filter[{self.condition!r}]"
+
+    def estimate_bytes(self):
+        return self.children[0].estimate_bytes()
 
 
 class Aggregate(PlanNode):
@@ -252,6 +276,9 @@ class Sort(PlanNode):
     def describe(self):
         return f"Sort[{len(self.orders)} keys]"
 
+    def estimate_bytes(self):
+        return self.children[0].estimate_bytes()
+
 
 class Limit(PlanNode):
     def __init__(self, child: PlanNode, limit: int):
@@ -275,6 +302,9 @@ class Limit(PlanNode):
 
     def describe(self):
         return f"Limit[{self.limit}]"
+
+    def estimate_bytes(self):
+        return self.children[0].estimate_bytes()
 
 
 class Union(PlanNode):

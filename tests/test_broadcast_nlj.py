@@ -1,0 +1,119 @@
+"""Broadcast exchange + conditioned nested-loop joins (reference analog:
+GpuBroadcastExchangeExec / GpuBroadcastNestedLoopJoinExec)."""
+
+import pytest
+
+from spark_rapids_tpu.ops.expr import col, lit
+
+from tests.asserts import assert_tpu_and_cpu_are_equal
+from tests.data_gen import DoubleGen, IntGen, StringGen, gen_table
+
+
+def _dfs(sess, n_left=300, n_right=40, nb=3, seed=53):
+    from spark_rapids_tpu.plan import from_host_table
+    lg = {"a": IntGen(min_val=0, max_val=60), "lv": DoubleGen(corner_prob=0.0)}
+    rg = {"b": IntGen(min_val=0, max_val=60), "rv": IntGen(min_val=0, max_val=60)}
+    left = from_host_table(gen_table(lg, n_left, seed), sess, nb)
+    right = from_host_table(gen_table(rg, n_right, seed + 1), sess, 1)
+    return left, right
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "right", "full",
+                                 "leftsemi", "leftanti"])
+def test_nlj_condition_join_types(session, cpu_session, how):
+    def build(s):
+        left, right = _dfs(s)
+        return left.join(right, on=col("a") < col("rv"), how=how)
+    assert_tpu_and_cpu_are_equal(build, session, cpu_session)
+
+
+def test_nlj_range_band_condition(session, cpu_session):
+    """Band join: a BETWEEN b-5 AND b+5 — the classic NLJ workload."""
+    def build(s):
+        left, right = _dfs(s)
+        cond = (col("a") >= col("b") - lit(5)) & (col("a") <= col("b") + lit(5))
+        return left.join(right, on=cond, how="inner")
+    assert_tpu_and_cpu_are_equal(build, session, cpu_session)
+
+
+def test_nlj_condition_with_nulls(session, cpu_session):
+    def build(s):
+        from spark_rapids_tpu.plan import from_host_table
+        lg = {"a": IntGen(min_val=0, max_val=20, null_prob=0.3)}
+        rg = {"b": IntGen(min_val=0, max_val=20, null_prob=0.3)}
+        left = from_host_table(gen_table(lg, 120, 5), s, 2)
+        right = from_host_table(gen_table(rg, 30, 6), s, 1)
+        return left.join(right, on=col("a") == col("b") + lit(1), how="full")
+    assert_tpu_and_cpu_are_equal(build, session, cpu_session)
+
+
+def test_nlj_runs_on_device(session):
+    from tests.asserts import assert_runs_on_tpu
+    def build(s):
+        left, right = _dfs(s)
+        return left.join(right, on=col("a") < col("rv"), how="left")
+    assert_runs_on_tpu(build, session)
+
+
+def test_broadcast_exchange_selected_for_small_build(session):
+    """Small build sides (LocalScan size estimate) go through the broadcast
+    exchange; the table materializes once and is reused."""
+    from spark_rapids_tpu.overrides import apply_overrides
+    from spark_rapids_tpu.execs.broadcast import TpuBroadcastExchangeExec
+
+    from spark_rapids_tpu.plan import from_host_table
+    l2 = {"k": IntGen(min_val=0, max_val=9), "x": IntGen()}
+    r2 = {"k": IntGen(min_val=0, max_val=9), "y": IntGen()}
+    left = from_host_table(gen_table(l2, 200, 1), session, 1)
+    right = from_host_table(gen_table(r2, 50, 2), session, 1)
+    j = left.join(right, on="k", how="inner")
+    executable, _ = apply_overrides(j.plan, session.conf)
+
+    found = []
+
+    def walk(e):
+        if isinstance(e, TpuBroadcastExchangeExec):
+            found.append(e)
+        for c in getattr(e, "children", ()):
+            walk(c)
+        for attr in ("source", "tpu_exec", "cpu_node"):
+            nxt = getattr(e, attr, None)
+            if nxt is not None:
+                walk(nxt)
+
+    walk(executable)
+    assert len(found) == 1, "build side should broadcast"
+    list(executable.execute_cpu())
+    assert found[0]._cached is not None
+    cached = found[0]._cached
+    list(executable.execute_cpu())
+    assert found[0]._cached is cached  # reused, not rebuilt
+
+
+def test_broadcast_disabled_by_threshold(session):
+    from spark_rapids_tpu.session import TpuSession
+    from spark_rapids_tpu.overrides import apply_overrides
+    from spark_rapids_tpu.execs.broadcast import TpuBroadcastExchangeExec
+    from spark_rapids_tpu.plan import from_host_table
+
+    off = TpuSession({"spark.rapids.sql.broadcastSizeBytes": 0})
+    l2 = {"k": IntGen(min_val=0, max_val=9)}
+    left = from_host_table(gen_table(l2, 100, 1), off, 1)
+    right = from_host_table(gen_table(l2, 20, 2), off, 1)
+    executable, _ = apply_overrides(
+        left.join(right, on="k", how="inner").plan, off.conf)
+
+    found = []
+
+    def walk(e):
+        if isinstance(e, TpuBroadcastExchangeExec):
+            found.append(e)
+        for c in getattr(e, "children", ()):
+            walk(c)
+        for attr in ("source", "tpu_exec", "cpu_node"):
+            nxt = getattr(e, attr, None)
+            if nxt is not None:
+                walk(nxt)
+
+    walk(executable)
+    assert not found
