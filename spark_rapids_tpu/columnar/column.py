@@ -284,6 +284,8 @@ def stage_upload(host: HostColumn, cap: int, split_f64: bool):
                 lo = np.where(np.isfinite(hi),
                               padded - hi.astype(np.float64),
                               0.0).astype(np.float32)
+                # keep -0.0: lo carries the signed zero so hi+lo preserves it
+                lo = np.where(padded == 0.0, hi, lo)
             kind, arrays = "f64split", [hi, lo]
         elif np_dtype == np.int32:
             kind, arrays = "u32", [padded.view(np.uint32)]

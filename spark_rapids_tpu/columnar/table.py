@@ -39,7 +39,12 @@ def _get_assemble(recipes: tuple, cap: int):
             i = 0
             for kind, vkind, _ in recipes:
                 if kind == "f64split":
-                    data = arrays[i].astype(jnp.float64) + arrays[i + 1].astype(jnp.float64)
+                    h64 = arrays[i].astype(jnp.float64)
+                    l64 = arrays[i + 1].astype(jnp.float64)
+                    # emulated f64 add flushes -0.0 + -0.0 to +0.0; take hi
+                    # directly for zeros so the signed zero survives
+                    data = jnp.where((h64 == 0.0) & (l64 == 0.0), h64,
+                                     h64 + l64)
                     i += 2
                 elif kind in ("u32", "u8codes", "u16codes"):
                     data = arrays[i].astype(jnp.int32)

@@ -59,11 +59,13 @@ DEVICE_SUPPORTED_AGGS = (agg.Sum, agg.Min, agg.Max, agg.Count, agg.Average,
 
 def _sortable(data, validity):
     """Transform (data, validity) into sort operands grouping nulls
-    together: (invalid_first_flag, data_with_nulls_zeroed). Floats are
-    normalized so -0.0 groups with 0.0 (Spark NormalizeFloatingNumbers)."""
-    if jnp.issubdtype(data.dtype, jnp.floating):
-        data = jnp.where(data == 0.0, jnp.zeros_like(data), data)
-    return [(~validity).astype(jnp.int32), jnp.where(validity, data, jnp.zeros_like(data))]
+    together: (invalid_first_flag, *native-width key operands). The
+    ordering decomposition canonicalizes floats (-0.0 == 0.0, one NaN
+    pattern — Spark NormalizeFloatingNumbers groups NaNs together) and
+    keeps every compare at <=32 bits (ops/ordering.py)."""
+    from spark_rapids_tpu.ops.ordering import comparable_operands
+    zeroed = jnp.where(validity, data, jnp.zeros_like(data))
+    return [(~validity).astype(jnp.int32)] + comparable_operands(zeroed)
 
 
 class TpuHashAggregateExec(TpuExec):
@@ -564,8 +566,11 @@ class TpuHashAggregateExec(TpuExec):
 
             if grouping:
                 operands = [(~live).astype(jnp.int32)]  # dead rows last
+                per_key_ops = []
                 for kv in key_vals:
-                    operands.extend(_sortable(kv.data, kv.validity))
+                    kops = _sortable(kv.data, kv.validity)
+                    per_key_ops.append(kops)
+                    operands.extend(kops)
                 payload = jnp.arange(capacity, dtype=jnp.int32)
                 sorted_all = jax.lax.sort(operands + [payload],
                                           num_keys=len(operands))
@@ -573,15 +578,14 @@ class TpuHashAggregateExec(TpuExec):
                 s_live = live[perm]
                 s_keys = [DevVal(kv.data[perm], kv.validity[perm]) for kv in key_vals]
 
-                # group boundaries among live rows
+                # group boundaries on the CANONICAL operands (raw float
+                # compares would split NaN groups: NaN != NaN)
                 first = jnp.arange(capacity) == 0
                 changed = jnp.zeros(capacity, dtype=jnp.bool_)
-                for kv in s_keys:
-                    d, v = kv.data, kv.validity
-                    dprev = jnp.roll(d, 1)
-                    vprev = jnp.roll(v, 1)
-                    diff = (jnp.where(v & vprev, d != dprev, v != vprev))
-                    changed = changed | diff
+                for kops in per_key_ops:
+                    for o in kops:
+                        so = o[perm]
+                        changed = changed | (so != jnp.roll(so, 1))
                 new_group = (first | changed) & s_live
                 gid = jnp.cumsum(new_group.astype(jnp.int32)) - 1
                 gid = jnp.where(s_live, gid, capacity - 1)  # park dead rows

@@ -31,27 +31,23 @@ from spark_rapids_tpu.plan.nodes import SortOrder
 
 
 def _directional(data, validity, ascending: bool, nulls_first: bool, capacity: int):
-    """Make (null_flag, key) operands for an ascending lax.sort that realize
-    the requested direction and null placement."""
-    if jnp.issubdtype(data.dtype, jnp.floating):
-        # Spark normalizes -0.0 == 0.0 for ordering; lax.sort's total order
-        # would otherwise put -0.0 first and diverge from the CPU oracle.
-        data = jnp.where(data == 0.0, jnp.zeros_like(data), data)
-    if ascending:
-        d = data
-    else:
-        if jnp.issubdtype(data.dtype, jnp.floating):
-            d = -data
-            d = jnp.where(d == 0.0, jnp.zeros_like(d), d)
-        elif data.dtype == jnp.bool_:
-            d = ~data
-        else:
-            d = ~data  # bitwise complement reverses two's-complement order
+    """Make (null_flag, *key_operands) for an ascending lax.sort realizing
+    the requested direction and null placement. Keys decompose into
+    native <=32-bit order-isomorphic operands (ops/ordering.py) — i64/f64
+    sort ~1.6x faster than emulated 64-bit compares, and f64 gets -0.0/NaN
+    canonicalization (Spark NormalizeFloatingNumbers / NaN-last) for free."""
+    from spark_rapids_tpu.ops.ordering import (
+        comparable_operands,
+        descending_operands,
+    )
+    zeroed = jnp.where(validity, data, jnp.zeros_like(data))
+    ops = comparable_operands(zeroed)
+    if not ascending:
+        ops = descending_operands(ops)
     # null flag sorts ahead of the key: 0 sorts first, so invalid rows get 0
     # when nulls_first else 1.
     nf = jnp.where(validity, 1 if nulls_first else 0, 0 if nulls_first else 1)
-    d = jnp.where(validity, d, jnp.zeros_like(d))
-    return [nf, d]
+    return [nf] + ops
 
 
 class TpuSortExec(TpuExec):

@@ -223,20 +223,34 @@ class TpuWindowExec(TpuExec):
 
                 # ---- segment & peer structure -----------------------------
                 first = idx == 0
+                def _peer_eq_break(kv):
+                    """rows[i] != rows[i-1] with Spark peer semantics:
+                    -0.0 == 0.0 and NaN == NaN (canonicalize before the
+                    compare — raw float != would split NaN ties into
+                    singleton peer groups; ADVICE r1)."""
+                    d, v = kv.data[perm], kv.validity[perm]
+                    if jnp.issubdtype(d.dtype, jnp.floating):
+                        d = jnp.where(d == 0.0, jnp.zeros_like(d), d)
+                        nan_mask = jnp.isnan(d)
+                        d = jnp.where(nan_mask, jnp.zeros_like(d), d)
+                        dp, vpv = jnp.roll(d, 1), jnp.roll(v, 1)
+                        np_mask = jnp.roll(nan_mask, 1)
+                        diff = (d != dp) | (nan_mask != np_mask)
+                    else:
+                        dp, vpv = jnp.roll(d, 1), jnp.roll(v, 1)
+                        diff = d != dp
+                    return jnp.where(v & vpv, diff, v != vpv)
+
                 new_seg = first
                 for kv in pvals:
-                    d, v = kv.data[perm], kv.validity[perm]
-                    dp, vpv = jnp.roll(d, 1), jnp.roll(v, 1)
-                    new_seg = new_seg | jnp.where(v & vpv, d != dp, v != vpv)
+                    new_seg = new_seg | _peer_eq_break(kv)
                 new_seg = new_seg & s_live | first
                 gid = jnp.cumsum(new_seg.astype(jnp.int32)) - 1
                 seg_start = _seg_scan_max(jnp.where(new_seg, idx, 0))
 
                 new_peer = new_seg
                 for kv in ovals:
-                    d, v = kv.data[perm], kv.validity[perm]
-                    dp, vpv = jnp.roll(d, 1), jnp.roll(v, 1)
-                    new_peer = new_peer | jnp.where(v & vpv, d != dp, v != vpv)
+                    new_peer = new_peer | _peer_eq_break(kv)
                 peer_id = jnp.cumsum(new_peer.astype(jnp.int32)) - 1
                 peer_start = _seg_scan_max(jnp.where(new_peer, idx, 0))
                 # last row index of each peer group

@@ -14,6 +14,10 @@ Java narrowing rules implemented:
 
 from __future__ import annotations
 
+import datetime
+import math
+import re
+
 import numpy as np
 import jax.numpy as jnp
 
@@ -88,10 +92,138 @@ _SUPPORTED_SIMPLE = (T.BooleanType, T.ByteType, T.ShortType, T.IntegerType,
                      T.LongType, T.FloatType, T.DoubleType, T.DateType,
                      T.TimestampType)
 
+# ---------------------------------------------------------------------------
+# String <-> X casts (reference: GpuCast.scala castStringToInts/Floats/Bool/
+# Date + JNI CastStrings; Spark CPU: Cast.scala with UTF8String.trimAll +
+# strict toInt/toLong, processFloatingPointSpecialLiterals)
+# ---------------------------------------------------------------------------
+
+#: Java's trimAll strips every char <= U+0020
+_JAVA_WS = "".join(chr(i) for i in range(0x21))
+
+#: Hive-compatible integral string: optional fraction is TRUNCATED
+#: (UTF8String.toLong accepts trailing .digits for Hive back-compat)
+_INT_RE = re.compile(r"([+-]?)(\d*)(?:\.(\d*))?")
+_DATE_RE = re.compile(r"(\d{4,5})(?:-(\d{1,2})(?:-(\d{1,2})(?:[T ].*)?)?)?")
+
+_TRUE_STRINGS = frozenset(("t", "true", "y", "yes", "1"))
+_FALSE_STRINGS = frozenset(("f", "false", "n", "no", "0"))
+
+_FLOAT_SPECIALS = {"inf": np.inf, "+inf": np.inf, "infinity": np.inf,
+                   "+infinity": np.inf, "-inf": -np.inf,
+                   "-infinity": -np.inf, "nan": np.nan}
+
+
+def parse_string_cast(s: str, dst: T.DataType):
+    """Spark-exact string -> value parse; None = cast yields null."""
+    t = s.strip(_JAVA_WS)
+    if isinstance(dst, T.IntegralType):
+        m = _INT_RE.fullmatch(t)
+        if not m or (not m.group(2) and not m.group(3)):
+            return None  # needs at least one digit somewhere
+        v = int(m.group(2) or "0")
+        if m.group(1) == "-":
+            v = -v
+        lo, hi = _INT_BOUNDS[np.dtype(dst.np_dtype)]
+        return v if lo <= v <= hi else None  # overflow -> null (toInt fails)
+    if isinstance(dst, (T.FloatType, T.DoubleType)):
+        low = t.lower()
+        if low in _FLOAT_SPECIALS:
+            v = _FLOAT_SPECIALS[low]
+        else:
+            body = t
+            # Java parseDouble accepts a trailing f/F/d/D suffix
+            if body and body[-1] in "fFdD" and any(c.isdigit() for c in body[:-1]):
+                body = body[:-1]
+            if not body or "_" in body or body.lower() in ("", "+", "-"):
+                return None
+            try:
+                v = float(body)
+            except ValueError:
+                return None
+        if isinstance(dst, T.FloatType):
+            v = float(np.float32(v))
+        return v
+    if isinstance(dst, T.BooleanType):
+        low = t.lower()
+        if low in _TRUE_STRINGS:
+            return True
+        if low in _FALSE_STRINGS:
+            return False
+        return None
+    if isinstance(dst, T.DateType):
+        m = _DATE_RE.fullmatch(t)
+        if not m:
+            return None
+        y = int(m.group(1))
+        mo = int(m.group(2)) if m.group(2) else 1
+        d = int(m.group(3)) if m.group(3) else 1
+        try:
+            return (datetime.date(y, mo, d) - datetime.date(1970, 1, 1)).days
+        except ValueError:
+            return None
+    return None
+
+
+def _java_float_str(x: float, is_float: bool) -> str:
+    """Java Float/Double.toString formatting (what Spark emits for
+    float -> string casts): positional for 1e-3 <= |x| < 1e7, otherwise
+    'd.dddE[-]e' scientific; NaN/Infinity spelled out; >=1 fractional
+    digit always present."""
+    if math.isnan(x):
+        return "NaN"
+    if math.isinf(x):
+        return "Infinity" if x > 0 else "-Infinity"
+    if x == 0.0:
+        return "-0.0" if math.copysign(1.0, x) < 0 else "0.0"
+    ax = abs(x)
+    if 1e-3 <= ax < 1e7:
+        if is_float:
+            s = np.format_float_positional(np.float32(x), unique=True,
+                                           trim="0")
+        else:
+            s = np.format_float_positional(x, unique=True, trim="0")
+        if "." not in s:
+            s += ".0"
+        if s.endswith("."):
+            s += "0"
+        return s
+    if is_float:
+        s = np.format_float_scientific(np.float32(x), unique=True, trim="0")
+    else:
+        s = np.format_float_scientific(x, unique=True, trim="0")
+    mant, _, exp = s.partition("e")
+    if "." not in mant:
+        mant += ".0"
+    if mant.endswith("."):
+        mant += "0"
+    e = int(exp)
+    return f"{mant}E{e}"
+
+
+def format_value_as_string(v, src: T.DataType):
+    """Spark-exact X -> string rendering."""
+    if isinstance(src, T.BooleanType):
+        return "true" if v else "false"
+    if isinstance(src, (T.FloatType, T.DoubleType)):
+        return _java_float_str(float(v), isinstance(src, T.FloatType))
+    if isinstance(src, T.DateType):
+        return (datetime.date(1970, 1, 1)
+                + datetime.timedelta(days=int(v))).isoformat()
+    return str(int(v))
+
 
 def cast_supported(src: T.DataType, dst: T.DataType) -> bool:
     if src == dst:
         return True
+    if isinstance(src, T.StringType):
+        # device path: dictionary-transform (host parse of dict entries +
+        # device gather); timestamps stay off like the reference default
+        return isinstance(dst, (T.IntegralType, T.FloatType, T.DoubleType,
+                                T.BooleanType, T.DateType))
+    if isinstance(dst, T.StringType):
+        # X -> string builds an unbounded output dictionary; CPU fallback
+        return False
     if isinstance(src, _SUPPORTED_SIMPLE) and isinstance(dst, _SUPPORTED_SIMPLE):
         # temporal <-> non-temporal numeric casts not yet implemented except
         # the date/timestamp pair handled above.
@@ -126,18 +258,69 @@ class Cast(UnaryExpression):
         c = self.child.eval_cpu(table)
         if c.dtype == self._dtype:
             return c
+        if isinstance(c.dtype, T.StringType):
+            return self._cpu_from_string(c)
+        if isinstance(self._dtype, T.StringType):
+            return self._cpu_to_string(c)
         data = _cast_data_np(c.data, c.dtype, self._dtype)
         zero = np.zeros((), dtype=self._dtype.np_dtype).item()
         return HostColumn(self._dtype, np.where(c.validity, data, zero).astype(self._dtype.np_dtype),
                           c.validity.copy())
 
+    def _cpu_from_string(self, c: HostColumn) -> HostColumn:
+        n = len(c)
+        out = np.zeros(n, dtype=self._dtype.np_dtype)
+        validity = c.validity.copy()
+        for i in range(n):
+            if validity[i]:
+                v = parse_string_cast(c.data[i], self._dtype)
+                if v is None:
+                    validity[i] = False
+                else:
+                    out[i] = v
+        return HostColumn(self._dtype, out, validity)
+
+    def _cpu_to_string(self, c: HostColumn) -> HostColumn:
+        n = len(c)
+        out = np.empty(n, dtype=object)
+        for i in range(n):
+            out[i] = (format_value_as_string(c.data[i], c.dtype)
+                      if c.validity[i] else None)
+        return HostColumn(T.STRING, out, c.validity.copy())
+
     def prep(self, pctx, child_preps):
+        if isinstance(self.child.data_type, T.StringType) and \
+                not isinstance(self._dtype, T.StringType):
+            # dictionary transform: parse each dict entry ONCE on host, the
+            # device gathers parsed values/validity by code (O(dict) host
+            # work — the string-cast analog of DictStringToValue)
+            d = child_preps[0].out_dict
+            if d is None:
+                d = np.array([], dtype=object)
+            vals = np.zeros(max(len(d), 1), dtype=self._dtype.np_dtype)
+            ok = np.ones(max(len(d), 1), dtype=np.bool_)
+            for i, s in enumerate(d):
+                v = parse_string_cast(s, self._dtype)
+                if v is None:
+                    ok[i] = False
+                else:
+                    vals[i] = v
+            return NodePrep(aux_slots=(pctx.add_aux(vals),
+                                       pctx.add_aux(ok)))
         return NodePrep()
 
     def eval_dev(self, ctx, child_vals, prep):
         (c,) = child_vals
         if self.child.data_type == self._dtype:
             return c
+        if prep.aux_slots:
+            vals = ctx.aux[prep.aux_slots[0]]
+            ok = ctx.aux[prep.aux_slots[1]]
+            codes = jnp.clip(c.data, 0, vals.shape[0] - 1)
+            data = vals[codes]
+            validity = c.validity & ok[codes]
+            return DevVal(jnp.where(validity, data, jnp.zeros_like(data)),
+                          validity)
         data = _cast_data_jnp(c.data, self.child.data_type, self._dtype)
         return DevVal(jnp.where(c.validity, data, jnp.zeros_like(data)), c.validity)
 

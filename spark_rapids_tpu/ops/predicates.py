@@ -25,6 +25,24 @@ from spark_rapids_tpu.ops.common import (
 from spark_rapids_tpu.ops.expr import DevVal, EvalCtx, Expression, NodePrep, PrepCtx
 
 
+def _spark_float_cmp(op, ld, rd, xp):
+    """Spark total-order float comparison: NaN == NaN is TRUE and NaN is
+    greater than every other value (SQL ref 'NaN semantics'); raw IEEE
+    compares would return false for all NaN comparisons."""
+    nl, nr = xp.isnan(ld), xp.isnan(rd)
+    if op is operator.eq:
+        return (ld == rd) | (nl & nr)
+    if op is operator.lt:
+        return (~nl & nr) | (ld < rd)
+    if op is operator.le:
+        return (~nl & nr) | (nl & nr) | (ld <= rd)
+    if op is operator.gt:
+        return (nl & ~nr) | (ld > rd)
+    if op is operator.ge:
+        return (nl & ~nr) | (nl & nr) | (ld >= rd)
+    return op(ld, rd)
+
+
 def _cpu_cmp_data(left: HostColumn, right: HostColumn, op):
     ld, rd = left.data, right.data
     if isinstance(left.dtype, T.StringType):
@@ -32,6 +50,8 @@ def _cpu_cmp_data(left: HostColumn, right: HostColumn, op):
         # (Python str, code-point order == Spark UTF-8 byte order) is safe.
         ld = np.where(left.validity, ld, "")
         rd = np.where(right.validity, rd, "")
+    elif np.issubdtype(np.asarray(ld).dtype, np.floating):
+        return _spark_float_cmp(op, ld, rd, np)
     return op(ld, rd)
 
 
@@ -71,7 +91,10 @@ class BinaryComparison(BinaryExpression):
         else:
             ld, rd = lval.data, rval.data
         validity = null_and(lval.validity, rval.validity)
-        data = type(self).op(ld, rd)
+        if jnp.issubdtype(ld.dtype, jnp.floating):
+            data = _spark_float_cmp(type(self).op, ld, rd, jnp)
+        else:
+            data = type(self).op(ld, rd)
         return DevVal(jnp.where(validity, data, False), validity)
 
 
@@ -119,9 +142,13 @@ class EqualNullSafe(BinaryComparison):
             ld, rd = dev_aligned_codes(ctx, prep, lval, rval)
         else:
             ld, rd = lval.data, rval.data
+        if jnp.issubdtype(ld.dtype, jnp.floating):
+            eq_data = _spark_float_cmp(operator.eq, ld, rd, jnp)
+        else:
+            eq_data = ld == rd
         both_valid = lval.validity & rval.validity
         both_null = ~lval.validity & ~rval.validity
-        data = jnp.where(both_valid, ld == rd, both_null)
+        data = jnp.where(both_valid, eq_data, both_null)
         return DevVal(data, jnp.ones_like(data, dtype=jnp.bool_))
 
 

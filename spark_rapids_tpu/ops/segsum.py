@@ -62,6 +62,9 @@ def split_f64_hi_lo(x):
     hi = x.astype(jnp.float32)
     lo = jnp.where(jnp.isfinite(hi),
                    (x - hi.astype(jnp.float64)).astype(jnp.float32), 0.0)
+    # signed zero: -0.0 - (-0.0) = +0.0, and -0.0 + 0.0 = +0.0 would lose
+    # the sign on reconstruction; carry the signed zero in lo too
+    lo = jnp.where(x == 0.0, hi, lo)
     return hi, lo
 
 

@@ -214,6 +214,19 @@ def _tag_join(meta, conf):
         except TypeError:
             meta.reasons.append(
                 f"join key types {lk.data_type} vs {rk.data_type} incompatible")
+    if node.condition is not None and not node.left_keys:
+        # keyless nested-loop join: the build side broadcasts whole; a
+        # KNOWN-oversized build must not OOM the device (unknown estimates
+        # proceed — Spark also runs BNLJ as a last resort)
+        from spark_rapids_tpu.conf import BROADCAST_SIZE_BYTES
+        swapped_nlj = jt in ("right", "rightouter")
+        build = node.children[0] if swapped_nlj else node.children[1]
+        est = build.estimate_bytes()
+        limit = 8 * conf.get_entry(BROADCAST_SIZE_BYTES)
+        if est is not None and est > limit:
+            meta.reasons.append(
+                f"nested-loop build side estimate {est}B exceeds "
+                f"8x broadcastSizeBytes ({limit}B)")
     if node.condition is not None:
         if node.left_keys and jt not in ("inner", "cross"):
             # equi keys + residual non-equi condition on outer/semi/anti:

@@ -35,20 +35,32 @@ from spark_rapids_tpu.errors import ColumnarProcessingError
 from spark_rapids_tpu.shuffle.serializer import pack_table, unpack_table
 
 
+def resolve_codec(requested: str) -> str:
+    """Map the requested codec conf to the codec that actually runs, so the
+    wire metadata never lies about the on-disk format (ADVICE r1). lz4/zstd
+    resolve to zlib until the native codecs land; the resolved name is what
+    gets recorded and used for decompression."""
+    if requested == "none":
+        return "none"
+    if requested in ("zlib", "lz4", "zstd"):
+        return "zlib"
+    raise ColumnarProcessingError(f"unknown shuffle codec {requested}")
+
+
 def _compress(codec: str, data: bytes) -> bytes:
     if codec == "none":
         return data
-    if codec in ("zlib", "lz4", "zstd"):
-        # lz4/zstd native codecs arrive with the C++ layer; zlib level 1 is
-        # the stand-in so the wire protocol (codec byte in the index) holds
+    if codec == "zlib":
         return zlib.compress(data, level=1)
-    raise ColumnarProcessingError(f"unknown shuffle codec {codec}")
+    raise ColumnarProcessingError(f"unresolved shuffle codec {codec}")
 
 
 def _decompress(codec: str, data: bytes) -> bytes:
     if codec == "none":
         return data
-    return zlib.decompress(data)
+    if codec == "zlib":
+        return zlib.decompress(data)
+    raise ColumnarProcessingError(f"unresolved shuffle codec {codec}")
 
 
 @dataclass
@@ -129,7 +141,8 @@ class ShuffleManager:
         self._next_id = 0
         self._shuffles: Dict[int, ShuffleWriteHandle] = {}
         self.workdir = tempfile.mkdtemp(prefix="rapids_tpu_shuffle_")
-        self.codec = str(conf.get_entry(SHUFFLE_COMPRESSION_CODEC)).lower()
+        self.codec = resolve_codec(
+            str(conf.get_entry(SHUFFLE_COMPRESSION_CODEC)).lower())
         self._writer_pool = cf.ThreadPoolExecutor(
             max_workers=max(1, conf.get_entry(SHUFFLE_MT_WRITER_THREADS)),
             thread_name_prefix="shuffle-writer")

@@ -176,11 +176,14 @@ class RangePartitioner(Partitioner):
                                 list(reversed(self._bounds)),
                                 list(reversed(self.ascending))):
             d, v = self._comparable(c)
-            bd, bv = self._comparable_bounds(bcol, c)
+            bd, bv, bexact = self._comparable_bounds(bcol, c)
             dd = d[:, None]
             vv = v[:, None]
-            # Spark null ordering in range partitioning: nulls first (asc)
-            gt = jnp.where(vv & bv, dd > bd, vv & ~bv)
+            # Spark null ordering in range partitioning: nulls first (asc).
+            # Inexact bounds (absent from this batch's dictionary) sit just
+            # BELOW the entry whose code they borrowed: >= means after.
+            cmp_gt = jnp.where(bexact, dd > bd, dd >= bd)
+            gt = jnp.where(vv & bv, cmp_gt, vv & ~bv)
             lt = jnp.where(vv & bv, dd < bd, ~vv & bv)
             if not asc:
                 gt, lt = lt, gt
@@ -199,25 +202,40 @@ class RangePartitioner(Partitioner):
         return d, c.validity
 
     def _comparable_bounds(self, bcol: HostColumn, dev_col):
-        """Bounds as device row-vectors; strings map into the column's
-        dictionary code space (bounds were sampled from the same data, but
-        re-coding guards dictionary drift across batches)."""
+        """Bounds as device row-vectors (values, validity, is_exact);
+        strings map into the column's dictionary code space. A bound value
+        ABSENT from this batch's dictionary takes the code of the next
+        larger entry with is_exact=False: rows carrying that code are
+        strictly greater than the bound, and the comparison kernel treats
+        code >= bound_code as 'after' — without the flag, equal-to-next-
+        entry rows would land in different partitions across batches
+        (ADVICE r1: breaks the range-partition ordering invariant)."""
         if isinstance(bcol.dtype, T.StringType):
             dictionary = dev_col.dictionary
             if dictionary is None or len(dictionary) == 0:
                 codes = np.zeros(len(bcol.data), dtype=np.int32)
+                exact = np.zeros(len(bcol.data), dtype=np.bool_)
             else:
                 codes = np.searchsorted(dictionary, bcol.data.astype(object),
                                         side="left").astype(np.int32)
+                safe = np.minimum(codes, len(dictionary) - 1)
+                exact = (codes < len(dictionary)) & (
+                    dictionary[safe] == bcol.data.astype(object))
+                # codes == len(dictionary) stays UN-clamped: the bound is
+                # above every entry of this batch, so no row may compare
+                # 'after' it (clamping to the last entry would push rows
+                # equal to that entry across the bound)
             return (jnp.asarray(codes)[None, :],
-                    jnp.asarray(bcol.validity)[None, :])
+                    jnp.asarray(bcol.validity)[None, :],
+                    jnp.asarray(exact)[None, :])
         vals = bcol.data
         if np.issubdtype(vals.dtype, np.floating):
             vals = np.where(vals == 0.0, 0.0, vals)
         if vals.dtype == np.bool_:
             vals = vals.astype(np.int32)
         return (jnp.asarray(vals)[None, :],
-                jnp.asarray(bcol.validity)[None, :])
+                jnp.asarray(bcol.validity)[None, :],
+                jnp.ones((1, len(bcol.data)), dtype=jnp.bool_))
 
 
 class _SplitKernel:

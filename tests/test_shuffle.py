@@ -296,3 +296,39 @@ def test_mesh_hash_exchange_partitions_by_murmur3():
         dev = r // per_dev
         h = murmur3_hash_host([(int(out_k[r]), True, T.INT)])
         assert ((h % ndev) + ndev) % ndev == dev
+
+
+def test_range_partition_string_bounds_consistent_across_batches():
+    """A bound value absent from one batch's dictionary must not split
+    equal keys across partitions (ADVICE r1: inexact bound codes)."""
+    import numpy as np
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.columnar import HostColumn, HostTable
+    from spark_rapids_tpu.columnar.table import DeviceTable
+    from spark_rapids_tpu.ops.expr import col
+    from spark_rapids_tpu.shuffle.partitioning import RangePartitioner
+
+    # batch1 lacks "banana" (a likely sampled bound from batch2)
+    b1 = DeviceTable.from_host(HostTable(["s"], [HostColumn(
+        T.STRING, np.array(["apple", "cherry", "apple", "date"] * 30,
+                           dtype=object))]))
+    b2 = DeviceTable.from_host(HostTable(["s"], [HostColumn(
+        T.STRING, np.array(["banana", "cherry", "banana", "elder"] * 30,
+                           dtype=object))]))
+
+    parter = RangePartitioner([col("s").bind([("s", T.STRING)])], 3,
+                              samples_per_partition=40)
+    parter.compute_bounds_multi([b1, b2])
+
+    mapping = {}
+    for b in (b1, b2):
+        pids = np.asarray(parter.partition_ids(b))[:b.num_rows]
+        vals = b.to_host().columns[0].data
+        for v, p in zip(vals, pids):
+            assert mapping.setdefault(v, int(p)) == int(p), \
+                f"{v!r} landed in partitions {mapping[v]} and {int(p)}"
+    # ordering invariant: lexicographically larger values never map to a
+    # smaller partition
+    items = sorted(mapping.items())
+    pids_in_order = [p for _, p in items]
+    assert pids_in_order == sorted(pids_in_order), items
