@@ -182,11 +182,14 @@ METRICS_LEVEL = str_conf(
 
 LORE_DUMP_IDS = str_conf(
     "spark.rapids.sql.lore.idsToDump", "",
-    "LORE operator ids whose input batches should be dumped for replay.")
+    "Comma-separated LORE operator ids (session.last_metrics shows each "
+    "operator's id) whose input batches + pickled operator dump to "
+    "lore.dumpPath during execution; spark_rapids_tpu.lore.replay() "
+    "re-executes one dumped operator, including in a fresh process.")
 
 LORE_DUMP_PATH = str_conf(
     "spark.rapids.sql.lore.dumpPath", "",
-    "Directory for LORE dumps.")
+    "Directory for LORE dumps (one lore-<id> subdirectory per operator).")
 
 CPU_ORACLE_STRICT = bool_conf(
     "spark.rapids.sql.test.strictOracle", True,

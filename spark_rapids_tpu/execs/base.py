@@ -11,6 +11,18 @@ from spark_rapids_tpu.columnar import DeviceTable, HostTable
 from spark_rapids_tpu.plan.nodes import PlanNode, Schema
 
 
+#: metric collection levels (reference: GpuMetric ESSENTIAL/MODERATE/DEBUG,
+#: GpuExec.scala:52-342). The session sets the active level from
+#: spark.rapids.sql.metrics.level; add_metric drops records above it.
+METRIC_LEVELS = {"ESSENTIAL": 0, "MODERATE": 1, "DEBUG": 2}
+_ACTIVE_METRIC_LEVEL = [1]  # MODERATE default
+
+
+def set_metrics_level(name: str) -> None:
+    _ACTIVE_METRIC_LEVEL[0] = METRIC_LEVELS.get(
+        str(name).upper(), METRIC_LEVELS["MODERATE"])
+
+
 class TpuExec:
     """Base of device operators. ``execute`` yields DeviceTable batches."""
 
@@ -38,7 +50,9 @@ class TpuExec:
             s += c.tree_string(indent + 1)
         return s
 
-    def add_metric(self, key: str, value):
+    def add_metric(self, key: str, value, level: str = "MODERATE"):
+        if METRIC_LEVELS.get(level, 1) > _ACTIVE_METRIC_LEVEL[0]:
+            return
         self.metrics[key] = self.metrics.get(key, 0) + value
 
 
@@ -74,13 +88,24 @@ class DeviceToHost:
 
     def __init__(self, tpu_exec: TpuExec):
         self.tpu_exec = tpu_exec
+        self.metrics = {}
 
     def output_schema(self):
         return self.tpu_exec.output_schema()
 
     def execute_cpu(self) -> Iterator[HostTable]:
         for dt in self.tpu_exec.execute():
-            yield dt.to_host()
+            t0 = time.perf_counter()
+            host = dt.to_host()
+            # incremental so an early-terminating consumer (limit) still
+            # leaves accurate numbers; measures ONLY the d2h conversion
+            self.metrics["d2hTime"] = (self.metrics.get("d2hTime", 0.0)
+                                       + time.perf_counter() - t0)
+            self.metrics["numOutputBatches"] = \
+                self.metrics.get("numOutputBatches", 0) + 1
+            self.metrics["numOutputRows"] = \
+                self.metrics.get("numOutputRows", 0) + host.num_rows
+            yield host
 
     def describe(self):
         return "DeviceToHost"

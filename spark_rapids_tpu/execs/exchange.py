@@ -63,13 +63,102 @@ class TpuShuffleExchangeExec(TpuExec):
     def describe(self):
         return f"TpuShuffleExchange[{self.mode}, n={self.num_partitions}]"
 
+    def _ici_eligible(self) -> bool:
+        """The collective path runs when the user asked for ICI mode, the
+        partitioning is hash, and every partition maps onto one device of
+        the local slice (SURVEY §2.6: 'partitions on one slice ->
+        collective, else host shuffle')."""
+        import jax
+        from spark_rapids_tpu.conf import SHUFFLE_MANAGER_MODE
+        mode = str(self.conf.get_entry(SHUFFLE_MANAGER_MODE)).upper()
+        return (mode == "ICI" and self.mode == "hash"
+                and 1 < self.num_partitions <= len(jax.devices())
+                and (self.num_partitions & (self.num_partitions - 1)) == 0)
+
     def execute(self):
+        if self._ici_eligible():
+            yield from self._execute_ici()
+            return
+        yield from self._execute_host_shuffle()
+
+    def _execute_ici(self):
+        """ONE all-to-all collective over a device mesh instead of the
+        host-file shuffle: coalesce input, evaluate key columns, exchange
+        every column's rows to its murmur3 partition's device, emit one
+        front-compacted batch per partition (parallel/exchange.py)."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from jax.sharding import Mesh
+
+        from spark_rapids_tpu import types as T
+        from spark_rapids_tpu.columnar import DeviceColumn, bucket_for
+        from spark_rapids_tpu.columnar.table import concat_device
+        from spark_rapids_tpu.ops.expr import compile_project
+        from spark_rapids_tpu.parallel.exchange import MeshExchange
+        from spark_rapids_tpu.shuffle.partitioning import string_dict_bytes
+        from spark_rapids_tpu.runtime.retry import retry_block
+
+        t0 = perf_counter()
+        batches = list(self.children[0].execute())
+        if not batches:
+            return
+        table = retry_block(lambda: concat_device(batches)) \
+            if len(batches) > 1 else batches[0]
+        ndev = self.num_partitions
+        if table.capacity % ndev != 0:
+            # pow2 capacities and pow2 ndev: only tiny tables (< ndev rows
+            # per shard) miss this; fall back for them
+            yield from self._execute_host_shuffle(prefetched=[table])
+            return
+
+        key_cols = compile_project(self.keys, table)
+        string_bytes = {}
+        for i, c in enumerate(key_cols):
+            if isinstance(c.dtype, T.StringType):
+                mat, lens = string_dict_bytes(c.dictionary)
+                string_bytes[i] = (jnp.asarray(mat), jnp.asarray(lens))
+
+        mesh = Mesh(np.array(jax.devices()[:ndev]), ("data",))
+        ex = MeshExchange.get(
+            mesh,
+            tuple(str(c.dtype) for c in table.columns),
+            tuple(range(len(key_cols))),
+            tuple(c.dtype for c in key_cols),
+            tuple(sorted((i, v[0].shape) for i, v in string_bytes.items())),
+            table.capacity)
+        out_d, out_v, counts = ex.run(
+            [c.data for c in table.columns],
+            [c.validity for c in table.columns],
+            [c.data for c in key_cols],
+            [c.validity for c in key_cols],
+            table.row_mask(),
+            string_bytes)
+        self.add_metric("iciExchangeTime", perf_counter() - t0)
+        self.add_metric("iciPartitions", ndev)
+
+        shard = len(out_d[0]) // ndev if out_d else 0
+        for p in range(ndev):
+            n = int(counts[p])
+            if n == 0:
+                continue
+            k = min(bucket_for(max(n, 1)), shard)
+            cols = []
+            for c, d, v in zip(table.columns, out_d, out_v):
+                sl = slice(p * shard, p * shard + k)
+                cols.append(DeviceColumn(c.dtype, d[sl], v[sl],
+                                         dictionary=c.dictionary,
+                                         dict_sorted=c.dict_sorted))
+            yield DeviceTable(table.names, cols, n, k)
+
+    def _execute_host_shuffle(self, prefetched=None):
         manager = get_shuffle_manager(self.conf)
         partitioner = make_partitioner(self.mode, self.keys, self.num_partitions)
         handle = manager.new_shuffle(self.num_partitions)
         try:
             t0 = perf_counter()
-            batches = self.children[0].execute()
+            batches = (iter(prefetched) if prefetched is not None
+                       else self.children[0].execute())
             if isinstance(partitioner, RangePartitioner):
                 # range bounds must sample the WHOLE input, not the first
                 # batch (Spark samples per-partition across the input)

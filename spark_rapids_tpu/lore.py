@@ -1,0 +1,188 @@
+"""LORE — Local Operator Replay (reference: lore/GpuLore.scala,
+GpuLoreDumpExec / GpuLoreReplayExec; SURVEY.md §2.10).
+
+The reference assigns every GPU operator a LORE id, dumps a tagged
+operator's input batches + plan meta to a directory during a real run, and
+can later re-execute JUST that operator from the dump. Same shape here:
+
+* every converted exec gets a ``lore_id`` (pre-order over the exec tree),
+  shown in ``session.explain`` output;
+* ``spark.rapids.sql.lore.idsToDump`` = comma-separated ids;
+  ``spark.rapids.sql.lore.dumpPath`` = target directory. During execution
+  each tagged exec's child batches are tee'd to
+  ``<path>/lore-<id>/input-<child>/batch-<n>.pkl`` (host-side pickles) and
+  the exec itself is pickled (jitted kernel caches stripped — they rebuild
+  lazily) with a meta.json describing the operator;
+* ``replay(dump_dir)`` reloads the exec, replaces its children with scans
+  over the dumped batches, re-runs it, and returns the result HostTable —
+  in a fresh process if desired.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+from typing import List, Optional
+
+from spark_rapids_tpu.columnar import HostTable
+
+#: attributes holding per-process jit/kernel caches — stripped before
+#: pickling, rebuilt lazily on first execute after unpickle
+_STRIP_ATTRS = ("_traces", "_filter_kernel", "_kernel", "metrics", "_cached")
+
+
+def assign_lore_ids(executable) -> None:
+    """Pre-order numbering over the converted tree (TpuExec and
+    transition/adapter wrappers all get ids so explain can show them)."""
+    counter = [0]
+
+    def walk(e):
+        counter[0] += 1
+        e._lore_id = counter[0]
+        for c in getattr(e, "children", ()):
+            walk(c)
+        for attr in ("source", "tpu_exec", "cpu_node", "scan_node"):
+            nxt = getattr(e, attr, None)
+            if nxt is not None:
+                walk(nxt)
+
+    walk(executable)
+
+
+def _iter_tree(e):
+    yield e
+    for c in getattr(e, "children", ()):
+        yield from _iter_tree(c)
+    for attr in ("source", "tpu_exec", "cpu_node", "scan_node"):
+        nxt = getattr(e, attr, None)
+        if nxt is not None:
+            yield from _iter_tree(nxt)
+
+
+class _TeeChild:
+    """Wraps a child exec: passes batches through while dumping each one
+    (host-side) to the lore directory."""
+
+    def __init__(self, inner, outdir: str):
+        self.inner = inner
+        self.outdir = outdir
+        self.children = getattr(inner, "children", ())
+
+    def output_schema(self):
+        return self.inner.output_schema()
+
+    def execute(self):
+        os.makedirs(self.outdir, exist_ok=True)
+        for i, batch in enumerate(self.inner.execute()):
+            host = batch.to_host_per_column() if hasattr(
+                batch, "to_host_per_column") else batch
+            with open(os.path.join(self.outdir, f"batch-{i}.pkl"), "wb") as f:
+                pickle.dump(host, f)
+            yield batch
+
+    def describe(self):
+        return f"LoreDump[{self.inner.describe()}]"
+
+    def tree_string(self, indent=0):
+        return self.inner.tree_string(indent)
+
+
+def _strip_for_pickle(exec_obj):
+    import copy
+    clone = copy.copy(exec_obj)
+    for a in _STRIP_ATTRS:
+        if hasattr(clone, a):
+            try:
+                setattr(clone, a, None if a != "metrics" else {})
+            except AttributeError:
+                pass
+    # children are replaced by scans at replay; drop them from the pickle
+    if hasattr(clone, "children"):
+        clone.children = ()
+    return clone
+
+
+def install_dumpers(executable, conf) -> List[int]:
+    """Wrap children of every exec whose lore id is in
+    spark.rapids.sql.lore.idsToDump; returns the ids that were armed."""
+    from spark_rapids_tpu.conf import LORE_DUMP_IDS, LORE_DUMP_PATH
+
+    raw = str(conf.get_entry(LORE_DUMP_IDS) or "").strip()
+    if not raw:
+        return []
+    path = str(conf.get_entry(LORE_DUMP_PATH) or "").strip()
+    if not path:
+        raise ValueError(
+            "spark.rapids.sql.lore.idsToDump is set but "
+            "spark.rapids.sql.lore.dumpPath is empty")
+    want = {int(x) for x in raw.split(",") if x.strip()}
+    armed = []
+    # snapshot BEFORE arming: wrapping children mid-walk would hide a
+    # tagged exec nested under another tagged exec
+    for e in list(_iter_tree(executable)):
+        lid = getattr(e, "_lore_id", None)
+        if lid not in want or not hasattr(e, "execute"):
+            continue
+        outdir = os.path.join(path, f"lore-{lid}")
+        os.makedirs(outdir, exist_ok=True)
+        kids = list(getattr(e, "children", ()))
+        e.children = tuple(
+            _TeeChild(c, os.path.join(outdir, f"input-{ci}"))
+            for ci, c in enumerate(kids))
+        meta = {
+            "lore_id": lid,
+            "exec_class": type(e).__name__,
+            "describe": e.describe(),
+            "num_children": len(kids),
+            "output_schema": [(n, str(dt)) for n, dt in e.output_schema()],
+        }
+        with open(os.path.join(outdir, "meta.json"), "w") as f:
+            json.dump(meta, f, indent=2)
+        with open(os.path.join(outdir, "exec.pkl"), "wb") as f:
+            # schema dtype OBJECTS ride along so empty-result replay can
+            # build a typed empty table (meta.json only has display strings)
+            pickle.dump({"exec": _strip_for_pickle(e),
+                         "schema": list(e.output_schema())}, f)
+        armed.append(lid)
+    return armed
+
+
+def replay(dump_dir: str) -> HostTable:
+    """Re-execute ONE dumped operator from its lore directory (works in a
+    fresh process): loads the pickled exec, replaces its children with
+    scans over the dumped input batches, runs, and returns the collected
+    HostTable."""
+    from spark_rapids_tpu.execs.basic import TpuScanExec
+
+    with open(os.path.join(dump_dir, "meta.json")) as f:
+        meta = json.load(f)
+    with open(os.path.join(dump_dir, "exec.pkl"), "rb") as f:
+        payload = pickle.load(f)
+    exec_obj = payload["exec"]
+    schema = payload["schema"]
+
+    kids = []
+    for ci in range(meta["num_children"]):
+        indir = os.path.join(dump_dir, f"input-{ci}")
+        batches = []
+        i = 0
+        while os.path.exists(os.path.join(indir, f"batch-{i}.pkl")):
+            with open(os.path.join(indir, f"batch-{i}.pkl"), "rb") as f:
+                batches.append(pickle.load(f))
+            i += 1
+        kids.append(TpuScanExec(batches, device_cache=False))
+    exec_obj.children = tuple(kids)
+    if not hasattr(exec_obj, "metrics") or exec_obj.metrics is None:
+        exec_obj.metrics = {}
+    # per-process kernel caches rebuild lazily; joins re-pool their kernel
+    if hasattr(exec_obj, "left_keys") and getattr(exec_obj, "_kernel", 1) is None:
+        from spark_rapids_tpu.execs.join import JoinKernel
+        exec_obj._kernel = JoinKernel.get(len(exec_obj.left_keys))
+
+    out = [b.to_host_per_column() if hasattr(b, "to_host_per_column") else b
+           for b in exec_obj.execute()]
+    if not out:
+        from spark_rapids_tpu.plan.nodes import _empty_table
+        return _empty_table(schema)
+    return HostTable.concat(out)

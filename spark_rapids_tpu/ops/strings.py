@@ -14,17 +14,19 @@ Functions whose result depends on MULTIPLE string columns per row (e.g.
 concat of two columns) cannot use the dictionary transform and fall back
 (device_supported=False) until a byte-matrix kernel lands.
 
-Regex semantics note: Like is Spark-exact (translated to a Python regex with
-escaped specials). RLike / RegExpExtract / RegExpReplace evaluate the
-pattern with Python `re`, which matches Java regex for the common subset;
-the reference ships a 2,186-line Java->cudf regex transpiler
-(RegexParser.scala) — the same guard-and-translate layer is future work, so
-these are registered but documented as compat-risky like the reference's
-`regexp` incompat flags."""
+Regex semantics note: Like is Spark-exact (translated to a Python regex
+with escaped specials). RLike / RegExpExtract / RegExpReplace run ONLY
+patterns the Java->Python transpiler (ops/regex_transpiler.py) can prove
+semantics-exact; anything else tags the expression unsupported so the
+plan falls back with the transpiler's reason — the same
+guard-or-translate contract as the reference's RegexParser.scala. The CPU
+fallback evaluates the raw pattern with a RuntimeWarning noting possible
+Java/Python divergence (there is no JVM here to be exactly right)."""
 
 from __future__ import annotations
 
 import re
+import warnings
 from typing import Optional
 
 import jax.numpy as jnp
@@ -360,6 +362,32 @@ class StringTranslate(_LiteralParams, DictStringToString):
         return s.translate(table)
 
 
+import functools
+
+
+@functools.lru_cache(maxsize=1024)
+def _guarded_regex_cached(pattern: str):
+    from spark_rapids_tpu.ops.regex_transpiler import try_transpile
+    transpiled, reason = try_transpile(pattern)
+    if transpiled is not None:
+        return re.compile(transpiled, re.ASCII), True, None
+    return re.compile(pattern), False, reason
+
+
+def _guarded_regex(pattern: str):
+    """(compiled python regex, device_ok, reason). Transpiled patterns
+    compile with re.ASCII (Java default char classes); rejected patterns
+    compile raw with a divergence warning and force CPU fallback. Cached —
+    dictionary transforms call this once per dict ENTRY."""
+    rx, ok, reason = _guarded_regex_cached(pattern)
+    if not ok:
+        warnings.warn(
+            f"regex {pattern!r} is outside the transpilable subset "
+            f"({reason}); evaluating with Python re — results may diverge "
+            "from Spark", RuntimeWarning, stacklevel=3)
+    return rx, ok, reason
+
+
 class RegExpReplace(_LiteralParams, DictStringToString):
     def __init__(self, child: Expression, pattern: Expression, replacement: Expression):
         self.children = (child, pattern, replacement)
@@ -395,10 +423,18 @@ class RegExpReplace(_LiteralParams, DictStringToString):
             i += 1
         return "".join(out)
 
+    @property
+    def device_supported(self):
+        from spark_rapids_tpu.ops.expr import Literal
+        from spark_rapids_tpu.ops.regex_transpiler import try_transpile
+        if not all(isinstance(c, Literal) for c in self.children[1:]):
+            return False  # _LiteralParams contract: params must be literals
+        return try_transpile(self.children[1].value)[1] is None
+
     def transform(self, s):
-        pat = self.children[1].value
+        rx, _, _ = _guarded_regex(self.children[1].value)
         rep = self._java_replacement_to_python(self.children[2].value or "")
-        return re.sub(pat, rep, s)
+        return rx.sub(rep, s)
 
 
 class RegExpExtract(_LiteralParams, DictStringToString):
@@ -412,8 +448,17 @@ class RegExpExtract(_LiteralParams, DictStringToString):
         return ("regexp_extract", self.children[0].key(),
                 _lit_str_key(self.children[1]), _lit_str_key(self.children[2]))
 
+    @property
+    def device_supported(self):
+        from spark_rapids_tpu.ops.expr import Literal
+        from spark_rapids_tpu.ops.regex_transpiler import try_transpile
+        if not all(isinstance(c, Literal) for c in self.children[1:]):
+            return False  # _LiteralParams contract: params must be literals
+        return try_transpile(self.children[1].value)[1] is None
+
     def transform(self, s):
-        m = re.search(self.children[1].value, s)
+        rx, _, _ = _guarded_regex(self.children[1].value)
+        m = rx.search(s)
         if m is None:
             return ""
         g = int(self.children[2].value)
@@ -578,8 +623,17 @@ class Like(_StringPredicate):
 
 
 class RLike(_StringPredicate):
+    @property
+    def device_supported(self):
+        from spark_rapids_tpu.ops.expr import Literal
+        from spark_rapids_tpu.ops.regex_transpiler import try_transpile
+        if not all(isinstance(c, Literal) for c in self.children[1:]):
+            return False
+        return try_transpile(self.param)[1] is None
+
     def value_of(self, s):
-        return re.search(self.param, s) is not None
+        rx, _, _ = _guarded_regex(self.param)
+        return rx.search(s) is not None
 
 
 class StringInstr(_LiteralParams, DictStringToValue):

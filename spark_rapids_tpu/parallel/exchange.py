@@ -2,23 +2,33 @@
 all-to-all collective.
 
 Reference mapping (SURVEY.md §2.6): GpuShuffleExchangeExec's UCX fast path
-becomes `jax.lax.all_to_all` over the mesh axis — each device bucketizes its
-row shard by Spark-exact murmur3 target, pads buckets to the static shard
-size, and the collective delivers every device its partition. All shapes are
-static (bucket = local shard capacity, the worst case); validity masks carry
-the live counts. This is the building block the distributed engine uses when
-all partitions live on one slice; host-file shuffle covers the general case.
+becomes ``jax.lax.all_to_all`` over the mesh axis — each device bucketizes
+its row shard by Spark-exact murmur3 target, pads buckets to the static
+shard size, and the collective delivers every device its partition. All
+shapes are static (bucket = local shard capacity, the worst case); validity
+masks carry the live counts. The plan-integrated entry point is
+``MeshExchange`` (used by TpuShuffleExchangeExec when
+spark.rapids.shuffle.mode=ICI and the partition count fits the mesh);
+the host-file shuffle covers every other case.
+
+String keys hash by their dictionary BYTE matrix (replicated across the
+mesh — O(dict) bytes), so Spark-exact murmur3 applies to strings too.
 """
 
 from __future__ import annotations
 
-from typing import List, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from spark_rapids_tpu import types as T
-from spark_rapids_tpu.shuffle.hashing import SPARK_SEED, murmur3_hash_device
+from spark_rapids_tpu.shuffle.hashing import (
+    SPARK_SEED,
+    murmur3_hash_device,
+    string_dict_bytes,
+)
 
 
 def _shard_map():
@@ -44,63 +54,151 @@ def _bucketize(pid, live, ndev: int, cap: int):
     return jnp.where(live, pid * cap + slot, ndev * cap)
 
 
-def mesh_hash_exchange(mesh,
-                       dtypes: Sequence[T.DataType],
-                       key_idx: Sequence[int],
-                       axis_name: str = "data"):
-    """Build a jitted exchange: global arrays sharded on axis 0 are
-    re-partitioned so device d holds exactly the rows with
-    pmod(murmur3(keys), ndev) == d.
+class MeshExchange:
+    """Plan-integrated all-to-all exchange over a device mesh.
 
-    Returns run(datas, valids) -> (out_datas, out_valids, out_live); output
-    shards are padded to ndev * local_cap with out_live marking real rows.
-    (String keys need dictionary byte-matrix plumbing — non-string keys for
-    now; the host-shuffle path covers strings.)"""
-    from jax.sharding import NamedSharding, PartitionSpec as P_
+    One instance is built per (mesh, column dtypes, key layout) — the
+    jitted shard_map program is cached on the instance. ``run`` takes the
+    coalesced input table's column arrays plus the live-row mask and
+    returns, per partition, front-compacted output arrays + live counts.
+    """
 
-    ndev = mesh.shape[axis_name]
+    _cache: Dict[tuple, "MeshExchange"] = {}
+
+    @classmethod
+    def get(cls, mesh, col_dtypes: Tuple[str, ...], key_cols: Tuple[int, ...],
+            key_dtypes, string_key_shapes: tuple, cap: int,
+            axis_name: str = "data"):
+        dev_ids = tuple(d.id for d in np.asarray(mesh.devices).flat)
+        key = (dev_ids, col_dtypes, key_cols, tuple(map(str, key_dtypes)),
+               string_key_shapes, cap, axis_name)
+        inst = cls._cache.get(key)
+        if inst is None:
+            inst = cls(mesh, key_dtypes, axis_name)
+            cls._cache[key] = inst
+        return inst
+
+    def __init__(self, mesh, key_dtypes, axis_name: str = "data"):
+        self.mesh = mesh
+        self.axis_name = axis_name
+        self.ndev = mesh.shape[axis_name]
+        self.key_dtypes = list(key_dtypes)
+        self._fn = None
+
+    def _build(self, ncols: int, nkeys: int, has_sbytes: Tuple[bool, ...]):
+        from jax.sharding import PartitionSpec as P_
+
+        ndev = self.ndev
+        axis = self.axis_name
+        key_dts = self.key_dtypes
+
+        def shard_fn(*flat):
+            pos = 0
+            datas = flat[pos:pos + ncols]; pos += ncols
+            valids = flat[pos:pos + ncols]; pos += ncols
+            kdatas = flat[pos:pos + nkeys]; pos += nkeys
+            kvalids = flat[pos:pos + nkeys]; pos += nkeys
+            live = flat[pos]; pos += 1
+            sbytes = {}
+            for i, has in enumerate(has_sbytes):
+                if has:
+                    sbytes[i] = (flat[pos], flat[pos + 1])
+                    pos += 2
+            cap = datas[0].shape[0] if datas else kdatas[0].shape[0]
+
+            keys = [(kdatas[i], kvalids[i], key_dts[i]) for i in range(nkeys)]
+            h = murmur3_hash_device(keys, SPARK_SEED, sbytes)
+            pid = h % jnp.int32(ndev)
+            pid = jnp.where(pid < 0, pid + ndev, pid)
+            tgt = _bucketize(pid, live, ndev, cap)
+
+            send_live = jnp.zeros((ndev * cap,), jnp.bool_).at[tgt].set(
+                True, mode="drop").reshape(ndev, cap)
+            recv_live = jax.lax.all_to_all(send_live, axis, 0, 0)
+
+            out_datas, out_valids = [], []
+            for d, v in zip(datas, valids):
+                send = jnp.zeros((ndev * cap,), d.dtype).at[tgt].set(
+                    d, mode="drop").reshape(ndev, cap)
+                send_v = jnp.zeros((ndev * cap,), jnp.bool_).at[tgt].set(
+                    v, mode="drop").reshape(ndev, cap)
+                out_datas.append(jax.lax.all_to_all(
+                    send, axis, 0, 0).reshape(ndev * cap))
+                out_valids.append(jax.lax.all_to_all(
+                    send_v, axis, 0, 0).reshape(ndev * cap))
+
+            # per-shard compaction: received blocks are front-compacted per
+            # source device but gapped between blocks; one scatter compacts
+            # the whole shard and counts the live rows
+            flat_live = recv_live.reshape(ndev * cap)
+            cpos = jnp.cumsum(flat_live.astype(jnp.int32)) - 1
+            ctgt = jnp.where(flat_live, cpos, ndev * cap)
+            n_live = jnp.sum(flat_live.astype(jnp.int32))
+            comp_d, comp_v = [], []
+            for d, v in zip(out_datas, out_valids):
+                comp_d.append(jnp.zeros_like(d).at[ctgt].set(d, mode="drop"))
+                comp_v.append(jnp.zeros_like(v).at[ctgt].set(v, mode="drop"))
+            return tuple(comp_d) + tuple(comp_v) + (n_live[None],)
+
+        n_row_args = 2 * ncols + 2 * nkeys + 1
+        in_specs = [P_(axis)] * n_row_args
+        for has in has_sbytes:
+            if has:
+                in_specs += [P_(), P_()]  # replicated dictionary bytes
+        out_specs = [P_(axis)] * (2 * ncols) + [P_(axis)]
+        sm = _shard_map()
+        return jax.jit(sm(shard_fn, mesh=self.mesh,
+                          in_specs=tuple(in_specs),
+                          out_specs=tuple(out_specs)))
+
+    def run(self, datas, valids, key_datas, key_valids, live,
+            string_bytes: Optional[Dict[int, tuple]] = None):
+        """All arrays are GLOBAL row arrays (length divisible by the mesh
+        size). Returns (out_datas, out_valids, counts) where each output is
+        global with per-device shards front-compacted and ``counts`` holds
+        one live count per partition."""
+        from jax.sharding import NamedSharding, PartitionSpec as P_
+
+        string_bytes = string_bytes or {}
+        has_sbytes = tuple(i in string_bytes for i in range(len(key_datas)))
+        if self._fn is None:
+            self._fn = self._build(len(datas), len(key_datas), has_sbytes)
+        sharding = NamedSharding(self.mesh, P_(self.axis_name))
+        rep = NamedSharding(self.mesh, P_())
+        flat = [jax.device_put(x, sharding)
+                for x in (*datas, *valids, *key_datas, *key_valids, live)]
+        for i, has in enumerate(has_sbytes):
+            if has:
+                mat, lens = string_bytes[i]
+                flat.append(jax.device_put(mat, rep))
+                flat.append(jax.device_put(lens, rep))
+        out = self._fn(*flat)
+        ncols = len(datas)
+        return (list(out[:ncols]), list(out[ncols:2 * ncols]),
+                np.asarray(out[2 * ncols]))
+
+
+def mesh_hash_exchange(mesh, dtypes: Sequence[T.DataType],
+                       key_idx: Sequence[int], axis_name: str = "data"):
+    """Back-compat wrapper over MeshExchange for non-string columns where
+    the hash keys are table columns (older tests / dryrun helper)."""
     dts = list(dtypes)
     kset = list(key_idx)
-    ncols = len(dts)
-
-    def shard_fn(*flat):
-        datas = flat[:ncols]
-        valids = flat[ncols:]
-        cap = datas[0].shape[0]
-        live = jnp.ones(cap, jnp.bool_)
-
-        keys = [(datas[i], valids[i], dts[i]) for i in kset]
-        h = murmur3_hash_device(keys, SPARK_SEED)
-        pid = h % jnp.int32(ndev)
-        pid = jnp.where(pid < 0, pid + ndev, pid)
-        tgt = _bucketize(pid, live, ndev, cap)
-
-        send_live = jnp.zeros((ndev * cap,), jnp.bool_).at[tgt].set(
-            True, mode="drop").reshape(ndev, cap)
-        recv_live = jax.lax.all_to_all(send_live, axis_name, 0, 0)
-
-        out_datas, out_valids = [], []
-        for d, v in zip(datas, valids):
-            send = jnp.zeros((ndev * cap,), d.dtype).at[tgt].set(
-                d, mode="drop").reshape(ndev, cap)
-            send_v = jnp.zeros((ndev * cap,), jnp.bool_).at[tgt].set(
-                v, mode="drop").reshape(ndev, cap)
-            out_datas.append(
-                jax.lax.all_to_all(send, axis_name, 0, 0).reshape(ndev * cap))
-            out_valids.append(
-                jax.lax.all_to_all(send_v, axis_name, 0, 0).reshape(ndev * cap))
-        return tuple(out_datas) + tuple(out_valids) + (recv_live.reshape(ndev * cap),)
-
-    sm = _shard_map()
-    fn = jax.jit(sm(shard_fn, mesh=mesh,
-                    in_specs=tuple(P_(axis_name) for _ in range(2 * ncols)),
-                    out_specs=tuple(P_(axis_name) for _ in range(2 * ncols + 1))))
 
     def run(datas: List[jax.Array], valids: List[jax.Array]):
-        sharding = NamedSharding(mesh, P_(axis_name))
-        flat = [jax.device_put(x, sharding) for x in list(datas) + list(valids)]
-        out = fn(*flat)
-        return list(out[:ncols]), list(out[ncols:2 * ncols]), out[2 * ncols]
+        ex = MeshExchange(mesh, [dts[i] for i in kset], axis_name)
+        live = jnp.ones(datas[0].shape[0], jnp.bool_)
+        out_d, out_v, counts = ex.run(
+            datas, valids, [datas[i] for i in kset],
+            [valids[i] for i in kset], live)
+        ndev = mesh.shape[axis_name]
+        cap = datas[0].shape[0] // ndev
+        out_live = []
+        shard = ndev * cap
+        liv = np.zeros(ndev * shard, dtype=bool)
+        for d in range(ndev):
+            liv[d * shard:d * shard + int(counts[d])] = True
+        return out_d, out_v, jnp.asarray(liv)
 
     return run
 

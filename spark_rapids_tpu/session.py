@@ -88,6 +88,16 @@ class TpuSession:
         if meta is not None and self.conf.explain_mode in ("NOT_ON_GPU", "ALL"):
             print(meta.explain(only_fallback=self.conf.explain_mode == "NOT_ON_GPU"))
 
+        from spark_rapids_tpu.conf import METRICS_LEVEL
+        from spark_rapids_tpu.execs.base import set_metrics_level
+        set_metrics_level(self.conf.get_entry(METRICS_LEVEL))
+
+        # LORE: number every operator; arm input dumping for tagged ids
+        from spark_rapids_tpu import lore
+        lore.assign_lore_ids(executable)
+        lore.install_dumpers(executable, self.conf)
+        self._last_executable = executable
+
         inject = str(self.conf.get_entry(TEST_INJECT_RETRY_OOM) or "")
         if inject:
             kind, _, num = inject.partition(":")
@@ -116,6 +126,35 @@ class TpuSession:
     def execute_cpu_only(self, plan: P.PlanNode) -> HostTable:
         """Run fully on the CPU path (the oracle)."""
         return plan.collect_cpu()
+
+    def last_metrics(self) -> str:
+        """Per-operator metrics of the most recent execute(), rendered as a
+        tree with lore ids (reference: GpuExec metrics + LORE ids shown in
+        the Spark UI / explain output)."""
+        ex = getattr(self, "_last_executable", None)
+        if ex is None:
+            return "(no query executed yet)"
+        lines = []
+
+        def walk(e, indent):
+            lid = getattr(e, "_lore_id", "?")
+            desc = e.describe() if hasattr(e, "describe") else type(e).__name__
+            m = getattr(e, "metrics", None)
+            mtxt = ""
+            if m:
+                parts = [f"{k}={v:.4f}" if isinstance(v, float) else f"{k}={v}"
+                         for k, v in sorted(m.items())]
+                mtxt = "  [" + ", ".join(parts) + "]"
+            lines.append("  " * indent + f"[loreId={lid}] {desc}{mtxt}")
+            for c in getattr(e, "children", ()):
+                walk(c, indent + 1)
+            for attr in ("source", "tpu_exec", "cpu_node", "scan_node"):
+                nxt = getattr(e, attr, None)
+                if nxt is not None:
+                    walk(nxt, indent + 1)
+
+        walk(ex, 0)
+        return "\n".join(lines)
 
     def explain(self, plan: P.PlanNode) -> str:
         return explain_plan(plan, self.conf)

@@ -1,0 +1,140 @@
+"""Java->Python regex transpiler guard (reference analog: RegexParser.scala
++ RegularExpressionTranspilerSuite — transpile exactly or reject)."""
+
+import re
+import warnings
+
+import pytest
+
+from spark_rapids_tpu.ops.regex_transpiler import (
+    RegexUnsupported,
+    transpile_java_regex,
+    try_transpile,
+)
+
+
+def _match(java_pattern, s):
+    t = transpile_java_regex(java_pattern)
+    return re.search(t, s, re.ASCII) is not None
+
+
+# -- semantics the transpiler must PRESERVE (Java behavior) -----------------
+
+def test_digit_class_is_ascii_only():
+    assert _match(r"^\d+$", "123")
+    assert not _match(r"^\d+$", "١٢")  # Arabic-Indic digits
+    assert not _match(r"^\w+$", "café")     # é not in Java \w
+
+
+def test_dot_excludes_all_java_line_terminators():
+    assert _match("a.b", "axb")
+    for terminator in ("\n", "\r", "", " ", " "):
+        assert not _match("a.b", f"a{terminator}b"), repr(terminator)
+
+
+def test_dollar_matches_before_final_terminator():
+    # Java: 'abc$' finds a match in 'abc\n', 'abc\r\n', and 'abc\r'
+    assert _match("abc$", "abc")
+    assert _match("abc$", "abc\n")
+    assert _match("abc$", "abc\r\n")
+    assert _match("abc$", "abc\r")      # python's raw $ would miss this
+    assert not _match("abc$", "abc\nx")
+
+
+def test_quote_literal_block():
+    assert _match(r"\Q1+1\E", "1+1")
+    assert not _match(r"\Q1+1\E", "111")
+
+
+def test_named_group_syntax_converts():
+    t = transpile_java_regex("(?<year>[0-9]+)-x")
+    m = re.search(t, "2024-x", re.ASCII)
+    assert m and m.group("year") == "2024"
+
+
+def test_char_class_expansions_inside_brackets():
+    assert _match(r"^[\d_]+$", "12_3")
+    assert not _match(r"^[\d_]+$", "١")
+
+
+def test_escaped_specials_and_quantifiers_pass():
+    assert _match(r"a\.b", "a.b")
+    assert not _match(r"a\.b", "axb")
+    assert _match(r"^a{2,3}$", "aaa")
+    assert _match(r"(ab|cd)+", "abcd")
+    assert _match(r"x(?=y)", "xy")
+    assert not _match(r"x(?=y)", "xz")
+
+
+def test_leading_dotall_flag():
+    assert _match(r"(?s)a.b", "a\nb")
+
+
+# -- constructs the guard must REJECT ---------------------------------------
+
+@pytest.mark.parametrize("pattern", [
+    "a*+",                 # possessive quantifier
+    "[a-z&&[^bc]]",        # class intersection
+    "[[:alpha:]]",         # POSIX class
+    r"\p{Alpha}+",         # unicode property
+    r"\bword\b",           # Java ASCII word boundary
+    r"\x{0041}",           # Java hex syntax
+    "(?i)abc",             # inline flags (non-(?s))
+    r"a\0101",             # octal escape
+    r"\Gabc",              # \G anchor
+    r"[\W]",               # negated class inside brackets
+    r"(?m)^a$",            # multiline changes anchors
+])
+def test_rejected_constructs(pattern):
+    with pytest.raises(RegexUnsupported):
+        transpile_java_regex(pattern)
+
+
+def test_try_transpile_returns_reason():
+    pat, reason = try_transpile("a*+")
+    assert pat is None and "possessive" in reason
+
+
+# -- plan integration -------------------------------------------------------
+
+def test_untranspilable_rlike_falls_back(session):
+    from spark_rapids_tpu import functions as F
+    from spark_rapids_tpu.ops.expr import col
+    from tests.asserts import assert_falls_back
+    from tests.data_gen import StringGen, gen_table
+
+    def build(s):
+        from spark_rapids_tpu.plan import from_host_table
+        df = from_host_table(gen_table({"s": StringGen(cardinality=5)}, 50, 3), s)
+        return df.select(F.rlike(col("s"), r"\bword\b").alias("m"))
+
+    assert_falls_back(build, session, "Project")
+
+
+def test_transpilable_rlike_runs_on_device(session, cpu_session):
+    from spark_rapids_tpu import functions as F
+    from spark_rapids_tpu.ops.expr import col
+    from tests.asserts import assert_runs_on_tpu, assert_tpu_and_cpu_are_equal
+    from tests.data_gen import StringGen, gen_table
+
+    def build(s):
+        from spark_rapids_tpu.plan import from_host_table
+        df = from_host_table(gen_table({"s": StringGen(cardinality=8)}, 80, 4), s)
+        return df.select(col("s"), F.rlike(col("s"), r"^[A-M]\d*").alias("m"))
+
+    assert_runs_on_tpu(build, session)
+    assert_tpu_and_cpu_are_equal(build, session, cpu_session)
+
+
+def test_fallback_emits_divergence_warning(cpu_session):
+    from spark_rapids_tpu import functions as F
+    from spark_rapids_tpu.ops.expr import col
+    from spark_rapids_tpu.plan import from_host_table
+    from tests.data_gen import StringGen, gen_table
+
+    df = from_host_table(gen_table({"s": StringGen(cardinality=4)}, 20, 5),
+                         cpu_session)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        df.select(F.rlike(col("s"), r"\bx\b").alias("m")).collect()
+    assert any("diverge from Spark" in str(x.message) for x in w)
