@@ -72,8 +72,14 @@ class HostColumn:
             sample = next((v for v in values if v is not None), None)
             dtype = T.python_to_spark_type(sample) if sample is not None else T.NULL
         validity = np.array([v is not None for v in values], dtype=np.bool_)
-        if isinstance(dtype, T.StringType):
-            data = np.array([v if v is not None else None for v in values], dtype=object)
+        if isinstance(dtype, T.ArrayType):
+            ec = HostColumn._element_conv(dtype.element_type)
+            data = np.empty(len(values), dtype=object)
+            data[:] = [[ec(x) if x is not None else None for x in v]
+                       if v is not None else None for v in values]
+        elif isinstance(dtype, T.StringType):
+            data = np.empty(len(values), dtype=object)
+            data[:] = [v if v is not None else None for v in values]
         else:
             np_dtype = dtype.np_dtype
             fill = np.zeros((), dtype=np_dtype).item()
@@ -100,6 +106,33 @@ class HostColumn:
         return HostColumn(dtype, data, validity)
 
     @staticmethod
+    def _element_conv(dtype: T.DataType):
+        """Python value -> internal representation for ARRAY elements
+        (dates to epoch days, timestamps to epoch micros)."""
+        import datetime as _dt
+        if isinstance(dtype, T.DateType):
+            epoch = _dt.date(1970, 1, 1)
+
+            def conv(v):
+                if isinstance(v, _dt.datetime):
+                    v = v.date()
+                return (v - epoch).days if isinstance(v, _dt.date) else v
+            return conv
+        if isinstance(dtype, T.TimestampType):
+            epoch_ts = _dt.datetime(1970, 1, 1, tzinfo=_dt.timezone.utc)
+
+            def conv(v):
+                if isinstance(v, _dt.datetime):
+                    if v.tzinfo is None:
+                        v = v.replace(tzinfo=_dt.timezone.utc)
+                    d = v - epoch_ts
+                    return (d.days * 86_400_000_000 + d.seconds * 1_000_000
+                            + d.microseconds)
+                return v
+            return conv
+        return lambda v: v
+
+    @staticmethod
     def from_numpy(values: np.ndarray, validity: Optional[np.ndarray] = None,
                    dtype: Optional[T.DataType] = None) -> "HostColumn":
         if dtype is None:
@@ -109,7 +142,19 @@ class HostColumn:
     def to_pylist(self):
         import datetime as _dt
         conv = None
-        if isinstance(self.dtype, T.DateType):
+        if isinstance(self.dtype, T.ArrayType):
+            edt = self.dtype.element_type
+            if isinstance(edt, T.DateType):
+                epoch = _dt.date(1970, 1, 1)
+                conv = lambda lst: [  # noqa: E731
+                    epoch + _dt.timedelta(days=int(x)) if x is not None
+                    else None for x in lst]
+            elif isinstance(edt, T.TimestampType):
+                epoch_ts = _dt.datetime(1970, 1, 1)
+                conv = lambda lst: [  # noqa: E731
+                    epoch_ts + _dt.timedelta(microseconds=int(x))
+                    if x is not None else None for x in lst]
+        elif isinstance(self.dtype, T.DateType):
             epoch = _dt.date(1970, 1, 1)
             conv = lambda v: epoch + _dt.timedelta(days=int(v))  # noqa: E731
         elif isinstance(self.dtype, T.TimestampType):
@@ -134,6 +179,10 @@ class HostColumn:
     def nbytes(self) -> int:
         if isinstance(self.dtype, T.StringType):
             return int(sum(len(s.encode("utf-8")) for s, v in zip(self.data, self.validity) if v)) + len(self)
+        if isinstance(self.dtype, T.ArrayType):
+            elem = np.dtype(self.dtype.element_type.np_dtype).itemsize
+            total = sum(len(x) for x, v in zip(self.data, self.validity) if v)
+            return int(total * elem + 4 * (len(self) + 1) + len(self))
         return int(self.data.nbytes + self.validity.nbytes)
 
 
@@ -160,10 +209,20 @@ class DeviceColumn:
         self.dict_sorted = dict_sorted
 
     @property
+    def is_array(self) -> bool:
+        return isinstance(self.data, tuple)
+
+    @property
     def capacity(self) -> int:
-        return int(self.data.shape[0])
+        # array columns store data as (offsets, elem_data, elem_validity);
+        # row capacity always equals the validity length
+        return int(self.validity.shape[0])
 
     def device_nbytes(self) -> int:
+        if self.is_array:
+            off, ed, ev = self.data
+            return int(off.size * 4 + ed.size * ed.dtype.itemsize
+                       + ev.size + self.validity.size)
         return int(self.data.size * self.data.dtype.itemsize + self.validity.size)
 
     @staticmethod
@@ -185,11 +244,45 @@ class DeviceColumn:
         return got
 
     @staticmethod
+    def _array_parts(host: HostColumn, cap: int):
+        """Flatten host lists to (offsets[cap+1] i32, elem_data, elem_valid);
+        null/padding rows get ZERO length (the engine invariant: only live
+        valid rows own elements)."""
+        n = len(host)
+        lengths = np.zeros(cap + 1, dtype=np.int64)
+        for i in range(n):
+            if host.validity[i]:
+                lengths[i + 1] = len(host.data[i])
+        offsets = np.cumsum(lengths).astype(np.int32)
+        total = int(offsets[cap])
+        ecap = bucket_for(max(total, 1))
+        edt = host.dtype.element_type.np_dtype
+        elems = np.zeros(ecap, dtype=edt)
+        evalid = np.zeros(ecap, dtype=np.bool_)
+        pos = 0
+        for i in range(n):
+            if host.validity[i]:
+                for v in host.data[i]:
+                    if v is not None:
+                        elems[pos] = v
+                        evalid[pos] = True
+                    pos += 1
+        return offsets, elems, evalid
+
+    @staticmethod
     def from_host(host: HostColumn, capacity: Optional[int] = None) -> "DeviceColumn":
         n = len(host)
         cap = capacity or bucket_for(n)
         if cap < n:
             raise ColumnarProcessingError(f"capacity {cap} < rows {n}")
+        if isinstance(host.dtype, T.ArrayType):
+            offsets, elems, evalid = DeviceColumn._array_parts(host, cap)
+            validity = np.zeros(cap, dtype=np.bool_)
+            validity[:n] = host.validity
+            return DeviceColumn(host.dtype,
+                                (jnp.asarray(offsets), jnp.asarray(elems),
+                                 jnp.asarray(evalid)),
+                                jnp.asarray(validity))
         validity = np.zeros(cap, dtype=np.bool_)
         validity[:n] = host.validity
         if isinstance(host.dtype, T.StringType):
@@ -204,6 +297,8 @@ class DeviceColumn:
         return DeviceColumn(host.dtype, jnp.asarray(data), jnp.asarray(validity))
 
     def to_host(self, num_rows: int) -> HostColumn:
+        if self.is_array:
+            return self._array_to_host(num_rows)
         # device-slice down to the live bucket BEFORE the transfer: results
         # are often tiny (an aggregate's groups) while capacity is the input
         # bucket, and D2H bandwidth is the scarcest resource on a tunneled
@@ -214,6 +309,19 @@ class DeviceColumn:
         data = np.asarray(dev_data)[:num_rows]
         validity = np.ascontiguousarray(np.asarray(dev_valid)[:num_rows])
         return self.decode_host(data, validity)
+
+    def _array_to_host(self, num_rows: int) -> HostColumn:
+        off = np.asarray(self.data[0])
+        elems = np.asarray(self.data[1])
+        evalid = np.asarray(self.data[2])
+        validity = np.ascontiguousarray(np.asarray(self.validity)[:num_rows])
+        out = np.empty(num_rows, dtype=object)
+        for i in range(num_rows):
+            if validity[i]:
+                s, e = int(off[i]), int(off[i + 1])
+                out[i] = [elems[j].item() if evalid[j] else None
+                          for j in range(s, e)]
+        return HostColumn(self.dtype, out, validity)
 
     def decode_host(self, data: np.ndarray, validity: np.ndarray) -> HostColumn:
         """Build the logical HostColumn from downloaded raw arrays (shared

@@ -449,6 +449,10 @@ class DeviceTable:
         cap = capacity or bucket_for(host.num_rows)
         if not host.columns:
             return DeviceTable(host.names, [], host.num_rows, cap)
+        if any(isinstance(c.dtype, T.ArrayType) for c in host.columns):
+            # nested columns bypass the staged fast path (per-column upload)
+            cols = [DeviceColumn.from_host(c, cap) for c in host.columns]
+            return DeviceTable(host.names, cols, host.num_rows, cap)
         split_f64 = jax.default_backend() != "cpu"
         recipes, staged, dicts = [], [], []
         for c in host.columns:
@@ -477,6 +481,8 @@ class DeviceTable:
         n = self.num_rows
         if not self.columns:
             return HostTable(self.names, [])
+        if any(c.is_array for c in self.columns):
+            return self.to_host_per_column()
         k = min(bucket_for(max(n, 1)), self.capacity)
         kinds = tuple(_pack_kind(c) for c in self.columns)
         fn = _get_pack(kinds, k, self.capacity)

@@ -413,3 +413,60 @@ def hash(*exprs):  # noqa: A001
 def xxhash64(*exprs):
     from spark_rapids_tpu.ops.hashfns import XxHash64
     return XxHash64(*[_e(x) for x in exprs])
+
+
+# -- collections ------------------------------------------------------------
+
+def size(e):
+    from spark_rapids_tpu.ops.collections import Size
+    return Size(_e(e))
+
+
+def array(*exprs):
+    from spark_rapids_tpu.ops.collections import CreateArray
+    return CreateArray(*[_e(x) for x in exprs])
+
+
+def array_contains(e, value):
+    from spark_rapids_tpu.ops.collections import ArrayContains
+    return ArrayContains(_e(e), _e(value))
+
+
+def array_min(e):
+    from spark_rapids_tpu.ops.collections import ArrayMin
+    return ArrayMin(_e(e))
+
+
+def array_max(e):
+    from spark_rapids_tpu.ops.collections import ArrayMax
+    return ArrayMax(_e(e))
+
+
+def sort_array(e, asc: bool = True):
+    from spark_rapids_tpu.ops.collections import SortArray
+    return SortArray(_e(e), lit(asc))
+
+
+def get_item(e, index):
+    from spark_rapids_tpu.ops.collections import GetArrayItem
+    return GetArrayItem(_e(e), _e(index))
+
+
+def explode(e):
+    from spark_rapids_tpu.ops.collections import Explode
+    return Explode(_e(e))
+
+
+def explode_outer(e):
+    from spark_rapids_tpu.ops.collections import ExplodeOuter
+    return ExplodeOuter(_e(e))
+
+
+def posexplode(e):
+    from spark_rapids_tpu.ops.collections import PosExplode
+    return PosExplode(_e(e))
+
+
+def posexplode_outer(e):
+    from spark_rapids_tpu.ops.collections import PosExplodeOuter
+    return PosExplodeOuter(_e(e))

@@ -1,0 +1,431 @@
+"""Collection (array) expressions + generator markers.
+
+Reference: collectionOperations.scala (ArraySize/Contains/Min/Max/SortArray/
+CreateArray...), GpuGenerateExec.scala (explode/posexplode) — SURVEY.md
+§2.3 / VERDICT r1 item 6.
+
+TPU-first representation: a device array column is
+``data = (offsets[cap+1] i32, elem_data[ecap], elem_validity[ecap])`` with
+the row validity mask as usual (columnar/column.py). Canonical invariant at
+upload: null/padding rows own ZERO elements, so live elements are the
+prefix [0, offsets[cap]). Elementwise collection functions evaluate with
+segment reductions keyed by each element's row id
+(``searchsorted(offsets, arange(ecap)) - 1``) — dense integer work the VPU
+is good at, no per-row loops."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar import HostColumn, HostTable
+from spark_rapids_tpu.errors import UnsupportedOnTpu
+from spark_rapids_tpu.ops.common import UnaryExpression
+from spark_rapids_tpu.ops.expr import DevVal, Expression, Literal, NodePrep
+
+#: element types the device representation supports (fixed width)
+FIXED_ELEMENT_TYPES = (T.BooleanType, T.ByteType, T.ShortType, T.IntegerType,
+                       T.LongType, T.FloatType, T.DoubleType, T.DateType,
+                       T.TimestampType)
+
+
+def is_fixed_array(dt) -> bool:
+    return (isinstance(dt, T.ArrayType)
+            and isinstance(dt.element_type, FIXED_ELEMENT_TYPES))
+
+
+def _elem_rids(off, ecap: int, cap: int):
+    """Row id per element slot; slots beyond the live prefix get ``cap``
+    (an overflow segment callers must ignore)."""
+    j = jnp.arange(ecap, dtype=jnp.int32)
+    rid = jnp.searchsorted(off, j, side="right").astype(jnp.int32) - 1
+    return jnp.where(j < off[-1], jnp.clip(rid, 0, cap - 1), cap)
+
+
+class Size(UnaryExpression):
+    """size(array) — Spark 3 default (legacy.sizeOfNull=false): null in,
+    null out."""
+
+    @property
+    def data_type(self):
+        return T.INT
+
+    def key(self):
+        return ("size", self.children[0].key())
+
+    @property
+    def device_supported(self):
+        return is_fixed_array(self.children[0].data_type)
+
+    def eval_cpu(self, table: HostTable) -> HostColumn:
+        c = self.children[0].eval_cpu(table)
+        out = np.zeros(len(c), dtype=np.int32)
+        for i in range(len(c)):
+            if c.validity[i]:
+                out[i] = len(c.data[i])
+        return HostColumn(T.INT, out, c.validity.copy())
+
+    def eval_dev(self, ctx, child_vals, prep) -> DevVal:
+        (c,) = child_vals
+        off, _, _ = c.data
+        return DevVal((off[1:] - off[:-1]).astype(jnp.int32), c.validity)
+
+
+class GetArrayItem(Expression):
+    """arr[i] — 0-based; out-of-bounds or negative index -> null."""
+
+    def __init__(self, child: Expression, index: Expression):
+        self.children = (child, index)
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type.element_type
+
+    def key(self):
+        return ("getarrayitem", tuple(c.key() for c in self.children))
+
+    def with_children(self, children):
+        return GetArrayItem(children[0], children[1])
+
+    @property
+    def device_supported(self):
+        return (is_fixed_array(self.children[0].data_type)
+                and isinstance(self.children[1], Literal))
+
+    def eval_cpu(self, table):
+        c = self.children[0].eval_cpu(table)
+        idx = self.children[1].eval_cpu(table)
+        np_dt = self.data_type.np_dtype
+        out = np.zeros(len(c), dtype=np_dt)
+        validity = np.zeros(len(c), dtype=np.bool_)
+        for i in range(len(c)):
+            if c.validity[i] and idx.validity[i]:
+                k = int(idx.data[i])
+                if 0 <= k < len(c.data[i]) and c.data[i][k] is not None:
+                    out[i] = c.data[i][k]
+                    validity[i] = True
+        return HostColumn(self.data_type, out, validity)
+
+    def eval_dev(self, ctx, child_vals, prep) -> DevVal:
+        c, ix = child_vals
+        off, ed, ev = c.data
+        k = ix.data[0].astype(jnp.int32)  # literal broadcast
+        pos = off[:-1] + k
+        inb = (k >= 0) & (pos < off[1:])
+        safe = jnp.clip(pos, 0, ed.shape[0] - 1)
+        validity = c.validity & ix.validity & inb & ev[safe]
+        data = ed[safe]
+        return DevVal(jnp.where(validity, data, jnp.zeros_like(data)), validity)
+
+
+class ArrayContains(Expression):
+    """array_contains(arr, v): true on match; null if arr is null, v is
+    null, or no match while the array has a null element; else false."""
+
+    def __init__(self, child: Expression, value: Expression):
+        self.children = (child, value)
+
+    @property
+    def data_type(self):
+        return T.BOOLEAN
+
+    def key(self):
+        return ("arraycontains", tuple(c.key() for c in self.children))
+
+    def with_children(self, children):
+        return ArrayContains(children[0], children[1])
+
+    @property
+    def device_supported(self):
+        return (is_fixed_array(self.children[0].data_type)
+                and isinstance(self.children[1], Literal))
+
+    def eval_cpu(self, table):
+        c = self.children[0].eval_cpu(table)
+        v = self.children[1].eval_cpu(table)
+        out = np.zeros(len(c), dtype=np.bool_)
+        validity = np.zeros(len(c), dtype=np.bool_)
+        for i in range(len(c)):
+            if not (c.validity[i] and v.validity[i]):
+                continue
+            arr = c.data[i]
+            found = any(x is not None and x == v.data[i] for x in arr)
+            has_null = any(x is None for x in arr)
+            if found:
+                out[i] = True
+                validity[i] = True
+            elif not has_null:
+                validity[i] = True
+        return HostColumn(T.BOOLEAN, out, validity)
+
+    def eval_dev(self, ctx, child_vals, prep) -> DevVal:
+        c, v = child_vals
+        off, ed, ev = c.data
+        cap = ctx.capacity
+        rid = _elem_rids(off, ed.shape[0], cap)
+        val = v.data[0]
+        hit = ((ed == val) & ev).astype(jnp.int32)
+        nul = (~ev).astype(jnp.int32)
+        hits = jax.ops.segment_sum(hit, rid, num_segments=cap + 1)[:cap]
+        nulls = jax.ops.segment_sum(nul * (rid < cap), rid,
+                                    num_segments=cap + 1)[:cap]
+        found = hits > 0
+        validity = c.validity & v.validity & (found | (nulls == 0))
+        return DevVal(found & validity, validity)
+
+
+class _ArrayMinMax(UnaryExpression):
+    is_min = True
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type.element_type
+
+    def key(self):
+        return ("arraymin" if self.is_min else "arraymax",
+                self.children[0].key())
+
+    @property
+    def device_supported(self):
+        return is_fixed_array(self.children[0].data_type)
+
+    def eval_cpu(self, table):
+        import math
+        c = self.children[0].eval_cpu(table)
+        np_dt = self.data_type.np_dtype
+        out = np.zeros(len(c), dtype=np_dt)
+        validity = np.zeros(len(c), dtype=np.bool_)
+
+        def isnan(x):
+            return isinstance(x, float) and math.isnan(x)
+
+        for i in range(len(c)):
+            if c.validity[i]:
+                vals = [x for x in c.data[i] if x is not None]
+                if vals:
+                    # Spark total order: NaN is the GREATEST value
+                    key = lambda x: (isnan(x), x if not isnan(x) else 0.0)  # noqa: E731
+                    out[i] = (min if self.is_min else max)(vals, key=key)
+                    validity[i] = True
+        return HostColumn(self.data_type, out, validity)
+
+    def eval_dev(self, ctx, child_vals, prep) -> DevVal:
+        (c,) = child_vals
+        off, ed, ev = c.data
+        cap = ctx.capacity
+        rid = _elem_rids(off, ed.shape[0], cap)
+        d = ed
+        if d.dtype == jnp.bool_:
+            d = d.astype(jnp.int32)
+        use = ev & (rid < cap)
+        is_float = jnp.issubdtype(d.dtype, jnp.floating)
+        if is_float:
+            # Spark total order: NaN is GREATEST. min: NaNs never win
+            # (unless all values are NaN); max: a single NaN wins.
+            nanmask = jnp.isnan(d)
+            if self.is_min:
+                d = jnp.where(nanmask, jnp.inf, d)
+            else:
+                d = jnp.where(nanmask, jnp.inf, d)  # +inf stands in for NaN
+            ident = jnp.asarray(jnp.inf if self.is_min else -jnp.inf, d.dtype)
+        else:
+            info = jnp.iinfo(d.dtype)
+            ident = jnp.asarray(info.max if self.is_min else info.min, d.dtype)
+        vv = jnp.where(use, d, ident)
+        seg = jax.ops.segment_min if self.is_min else jax.ops.segment_max
+        r = seg(vv, rid, num_segments=cap + 1)[:cap]
+        nonnull = jax.ops.segment_sum(use.astype(jnp.int32),
+                                      rid, num_segments=cap + 1)[:cap]
+        if is_float:
+            n_nan = jax.ops.segment_sum((use & nanmask).astype(jnp.int32),
+                                        rid, num_segments=cap + 1)[:cap]
+            if self.is_min:
+                # all-NaN array: the min IS NaN
+                r = jnp.where(n_nan == nonnull, jnp.nan, r)
+            else:
+                # any NaN: the max IS NaN (r holds the +inf stand-in)
+                r = jnp.where(n_nan > 0, jnp.nan, r)
+        validity = c.validity & (nonnull > 0)
+        if isinstance(self.data_type, T.BooleanType):
+            r = r.astype(jnp.bool_)
+        return DevVal(jnp.where(validity, r, jnp.zeros_like(r)), validity)
+
+
+class ArrayMin(_ArrayMinMax):
+    is_min = True
+
+
+class ArrayMax(_ArrayMinMax):
+    is_min = False
+
+
+class SortArray(Expression):
+    """sort_array(arr, asc): elements sorted within each row; Spark places
+    nulls FIRST ascending, LAST descending."""
+
+    def __init__(self, child: Expression, ascending: Expression = None):
+        asc = ascending if ascending is not None else Literal(True, T.BOOLEAN)
+        self.children = (child, asc)
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type
+
+    def key(self):
+        return ("sortarray", tuple(c.key() for c in self.children))
+
+    def with_children(self, children):
+        return SortArray(children[0], children[1] if len(children) > 1 else None)
+
+    @property
+    def device_supported(self):
+        return (is_fixed_array(self.children[0].data_type)
+                and isinstance(self.children[1], Literal))
+
+    def eval_cpu(self, table):
+        import math
+        c = self.children[0].eval_cpu(table)
+        asc = bool(self.children[1].value)
+        out = np.empty(len(c), dtype=object)
+
+        def key(x):
+            # Spark total order: NaN greatest (and -0.0 == 0.0)
+            if isinstance(x, float):
+                if math.isnan(x):
+                    return (1, 0.0)
+                return (0, x + 0.0)
+            return (0, x)
+
+        for i in range(len(c)):
+            if c.validity[i]:
+                vals = sorted((x for x in c.data[i] if x is not None),
+                              key=key, reverse=not asc)
+                nulls = [None] * (len(c.data[i]) - len(vals))
+                out[i] = (nulls + vals) if asc else (vals + nulls)
+        return HostColumn(self.data_type, out, c.validity.copy())
+
+    def eval_dev(self, ctx, child_vals, prep) -> DevVal:
+        from spark_rapids_tpu.ops.ordering import (
+            comparable_operands,
+            descending_operands,
+        )
+        c = child_vals[0]  # children[1] is the static asc literal
+        off, ed, ev = c.data
+        cap = ctx.capacity
+        ecap = ed.shape[0]
+        asc = bool(self.children[1].value)
+        rid = _elem_rids(off, ecap, cap)
+        zeroed = jnp.where(ev, ed, jnp.zeros_like(ed))
+        ops = comparable_operands(zeroed)
+        if not asc:
+            ops = descending_operands(ops)
+        nf = jnp.where(ev, 1 if asc else 0, 0 if asc else 1)
+        idx = jnp.arange(ecap, dtype=jnp.int32)
+        res = jax.lax.sort([rid, nf] + ops + [idx], num_keys=2 + len(ops))
+        perm = res[-1]
+        return DevVal((off, ed[perm], ev[perm]), c.validity)
+
+
+class CreateArray(Expression):
+    """array(e1, e2, ...) — fixed element count per row."""
+
+    def __init__(self, *children: Expression):
+        self.children = tuple(children)
+
+    @property
+    def data_type(self):
+        return T.ArrayType(self.children[0].data_type)
+
+    def key(self):
+        return ("createarray", tuple(c.key() for c in self.children))
+
+    def with_children(self, children):
+        return CreateArray(*children)
+
+    def resolve(self, bound_children):
+        # coerce every element expression to the common promoted type
+        # (Spark: implicit cast to the tightest common type)
+        from spark_rapids_tpu.ops.cast import Cast
+        target = bound_children[0].data_type
+        for c in bound_children[1:]:
+            if c.data_type != target:
+                target = T.promote(target, c.data_type)
+        coerced = [c if c.data_type == target else Cast(c, target)
+                   for c in bound_children]
+        return CreateArray(*coerced)
+
+    @property
+    def device_supported(self):
+        dts = [c.data_type for c in self.children]
+        return (len(self.children) > 0
+                and all(isinstance(dt, FIXED_ELEMENT_TYPES) for dt in dts)
+                and all(dt == dts[0] for dt in dts))
+
+    @property
+    def nullable(self):
+        return False
+
+    def eval_cpu(self, table):
+        kids = [c.eval_cpu(table) for c in self.children]
+        n = table.num_rows
+        out = np.empty(n, dtype=object)
+        for i in range(n):
+            out[i] = [
+                (k.data[i].item() if hasattr(k.data[i], "item") else k.data[i])
+                if k.validity[i] else None for k in kids]
+        return HostColumn(self.data_type, out, np.ones(n, dtype=np.bool_))
+
+    def eval_dev(self, ctx, child_vals, prep) -> DevVal:
+        from spark_rapids_tpu.columnar import bucket_for
+        cap = ctx.capacity
+        k = len(child_vals)
+        ecap = bucket_for(max(cap * k, 1))
+        ed = jnp.zeros(ecap, dtype=child_vals[0].data.dtype)
+        ev = jnp.zeros(ecap, dtype=jnp.bool_)
+        data = jnp.stack([cv.data for cv in child_vals],
+                         axis=1).reshape(cap * k)
+        valid = jnp.stack([cv.validity for cv in child_vals],
+                          axis=1).reshape(cap * k)
+        ed = ed.at[:cap * k].set(data)
+        ev = ev.at[:cap * k].set(valid)
+        off = (jnp.arange(cap + 1, dtype=jnp.int32) * k)
+        return DevVal((off, ed, ev),
+                      jnp.ones(cap, dtype=jnp.bool_) & ctx.row_mask())
+
+
+class Explode(UnaryExpression):
+    """Generator marker: consumed by the Generate plan node, never
+    evaluated as a row expression."""
+
+    pos = False
+    outer = False
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type.element_type
+
+    def key(self):
+        return ("explode", self.pos, self.outer, self.children[0].key())
+
+    def eval_cpu(self, table):
+        raise UnsupportedOnTpu("Explode must be planned as a Generate node")
+
+    def eval_dev(self, ctx, child_vals, prep):
+        raise UnsupportedOnTpu("Explode must be planned as a Generate node")
+
+
+class PosExplode(Explode):
+    pos = True
+
+
+class ExplodeOuter(Explode):
+    outer = True
+
+
+class PosExplodeOuter(Explode):
+    pos = True
+    outer = True

@@ -29,7 +29,12 @@ from spark_rapids_tpu.execs import (
 from spark_rapids_tpu.execs.aggregate import DEVICE_SUPPORTED_AGGS
 from spark_rapids_tpu.ops import aggregates as agg
 from spark_rapids_tpu.ops.expr import Expression
-from spark_rapids_tpu.overrides.typesig import COMMON, ORDERABLE, TypeSig
+from spark_rapids_tpu.overrides.typesig import (
+    COMMON,
+    COMMON_PLUS_ARRAYS,
+    ORDERABLE,
+    TypeSig,
+)
 from spark_rapids_tpu.plan import nodes as P
 
 # ---------------------------------------------------------------------------
@@ -72,10 +77,18 @@ def _build_expr_sigs():
                     and "eval_dev" in {m for kls in obj.__mro__ for m in vars(kls)}
                     and getattr(obj, "eval_dev", None) is not Expression.eval_dev):
                 reg(obj)
-    reg(expr_mod.BoundReference)
+    reg(expr_mod.BoundReference, COMMON_PLUS_ARRAYS)
     reg(expr_mod.Literal)
-    reg(expr_mod.Alias)
+    reg(expr_mod.Alias, COMMON_PLUS_ARRAYS)
     reg(cast.Cast)
+    from spark_rapids_tpu.ops import collections as coll
+    reg(coll.Size)
+    reg(coll.GetArrayItem)
+    reg(coll.ArrayContains)
+    reg(coll.ArrayMin)
+    reg(coll.ArrayMax)
+    reg(coll.SortArray, COMMON_PLUS_ARRAYS)
+    reg(coll.CreateArray, COMMON_PLUS_ARRAYS)
     for fn in DEVICE_SUPPORTED_AGGS:
         reg(fn)
 
@@ -131,21 +144,40 @@ def exec_rule(node_cls, tag_fn, convert_fn, doc=""):
     _EXEC_RULES[node_cls] = ExecRule(node_cls, tag_fn, convert_fn, doc)
 
 
-def _check_output_schema(meta: "PlanMeta", conf: RapidsConf):
+def _check_output_schema(meta: "PlanMeta", conf: RapidsConf, sig=COMMON):
     for name, dt in meta.node.output_schema():
-        r = COMMON.reason_if_unsupported(dt, f"output column {name}")
+        r = sig.reason_if_unsupported(dt, f"output column {name}")
         if r:
             meta.reasons.append(r)
 
 
 def _tag_scan(meta, conf):
-    _check_output_schema(meta, conf)
+    # scans may carry fixed-element array columns (device (offsets, values,
+    # validity) representation)
+    _check_output_schema(meta, conf, COMMON_PLUS_ARRAYS)
 
 
 def _tag_project(meta, conf):
-    _check_output_schema(meta, conf)
+    _check_output_schema(meta, conf, COMMON_PLUS_ARRAYS)
     for e in meta.node.exprs:
         check_expr(e, conf, meta.reasons)
+
+
+def _tag_generate(meta, conf):
+    from spark_rapids_tpu.ops.collections import is_fixed_array
+    node = meta.node
+    _check_output_schema(meta, conf, COMMON_PLUS_ARRAYS)
+    check_expr(node.gen_child, conf, meta.reasons, "generator input ")
+    if not is_fixed_array(node.gen_child.data_type):
+        meta.reasons.append(
+            f"generator over {node.gen_child.data_type.simple_string()} "
+            "requires fixed-width array elements on TPU")
+    child_schema = dict(node.children[0].output_schema())
+    for n in node.required:
+        if isinstance(child_schema[n], T.ArrayType):
+            meta.reasons.append(
+                f"array column {n} passing THROUGH a generator is not "
+                "supported on TPU (prune it or explode it)")
 
 
 def _tag_filter(meta, conf):
@@ -237,6 +269,12 @@ def _tag_join(meta, conf):
                 f"non-equi condition on equi {jt} join is not supported on TPU")
         else:
             check_expr(node.condition, conf, meta.reasons, "join condition ")
+
+
+def _convert_generate(node: P.Generate, children, conf):
+    from spark_rapids_tpu.execs.generate import TpuGenerateExec
+    return TpuGenerateExec(children[0], node.gen_child, node.pos,
+                           node.outer, node.out_names, node.required)
 
 
 def _convert_scan(node: P.LocalScan, children, conf):
@@ -429,6 +467,7 @@ def _convert_window(node: P.WindowNode, children, conf):
 
 
 exec_rule(P.Join, _tag_join, _convert_join)
+exec_rule(P.Generate, _tag_generate, _convert_generate)
 exec_rule(P.WindowNode, _tag_window, _convert_window)
 exec_rule(P.Exchange, _tag_exchange, _convert_exchange)
 

@@ -41,3 +41,34 @@ NUMERIC = TypeSig(T.ByteType, T.ShortType, T.IntegerType, T.LongType,
 INTEGRAL = TypeSig(T.ByteType, T.ShortType, T.IntegerType, T.LongType)
 ORDERABLE = COMMON
 ALL = COMMON  # grows as nested/decimal device support lands
+
+
+class ArrayFixedSig(TypeSig):
+    """Arrays of fixed-width elements (the device (offsets, values,
+    validity) representation — columnar/column.py)."""
+
+    def __init__(self):
+        super().__init__()
+
+    def supports(self, dt: T.DataType) -> bool:
+        from spark_rapids_tpu.ops.collections import is_fixed_array
+        return is_fixed_array(dt)
+
+
+class AnyOfSig(TypeSig):
+    """Union of signatures."""
+
+    def __init__(self, *sigs):
+        super().__init__()
+        self.sigs = sigs
+
+    def supports(self, dt: T.DataType) -> bool:
+        return any(s.supports(dt) for s in self.sigs)
+
+
+ARRAY_FIXED = ArrayFixedSig()
+
+#: scalar COMMON plus fixed-element arrays — the surface Scan/Project/
+#: Generate handle on device (other execs keep COMMON: their kernels
+#: compact/gather/sort flat buffers only)
+COMMON_PLUS_ARRAYS = AnyOfSig(COMMON, ARRAY_FIXED)

@@ -9,7 +9,14 @@ from __future__ import annotations
 from typing import Optional, Sequence
 
 from spark_rapids_tpu.columnar import HostTable
-from spark_rapids_tpu.ops.expr import Alias, AttributeReference, Expression, col, lit
+from spark_rapids_tpu.ops.expr import (
+    Alias,
+    AttributeReference,
+    Expression,
+    col,
+    lit,
+    output_name,
+)
 from spark_rapids_tpu.plan import nodes as P
 
 
@@ -23,7 +30,44 @@ class DataFrame:
         return DataFrame(plan, self.session)
 
     def select(self, *exprs) -> "DataFrame":
+        from spark_rapids_tpu.ops.collections import Explode
         exprs = [col(e) if isinstance(e, str) else e for e in exprs]
+
+        # Spark rule: a generator (explode/posexplode) in the select list
+        # plans as Generate(child) + Project; at most one generator
+        gens = [(i, e) for i, e in enumerate(exprs)
+                if isinstance(e, Explode)
+                or (isinstance(e, Alias) and isinstance(e.children[0], Explode))]
+        if gens:
+            if len(gens) > 1:
+                raise ValueError("only one generator per select (Spark rule)")
+            i, e = gens[0]
+            gen = e.children[0] if isinstance(e, Alias) else e
+            if gen.pos:
+                names = ["pos", output_name(e, "col")]
+            else:
+                names = [output_name(e, "col")]
+
+            # requiredChildOutput: only columns the surrounding select
+            # references pass through the Generate
+            refs = set()
+
+            def _walk_refs(x):
+                if isinstance(x, AttributeReference):
+                    refs.add(x.col_name)
+                for ch in x.children:
+                    _walk_refs(ch)
+
+            for j, other in enumerate(exprs):
+                if j != i:
+                    _walk_refs(other)
+            g = P.Generate(self.plan, gen.children[0], gen.pos, gen.outer,
+                           names, required=sorted(refs))
+            out = [col(n) if isinstance(n, str) else n
+                   for n in ([*exprs[:i]]
+                             + [col(n2) for n2 in names]
+                             + [*exprs[i + 1:]])]
+            return DataFrame(g, self.session)._wrap(P.Project(g, out))
         return self._wrap(P.Project(self.plan, exprs))
 
     def with_column(self, name: str, expr: Expression) -> "DataFrame":

@@ -122,6 +122,22 @@ class RangeNode(PlanNode):
 
 class Project(PlanNode):
     def __init__(self, child: PlanNode, exprs: Sequence[Expression]):
+        from spark_rapids_tpu.ops.collections import Explode
+
+        def _no_generators(e, top=False):
+            if isinstance(e, Explode) and not top:
+                raise ColumnarProcessingError(
+                    "generators (explode/posexplode) are only valid as "
+                    "top-level select expressions (Spark rule); use "
+                    "df.select(..., F.explode(col))")
+            for c in e.children:
+                _no_generators(c)
+
+        for e in exprs:
+            # Alias(Explode) and bare Explode at top level are rewritten to
+            # Generate by DataFrame.select BEFORE Project sees them; any
+            # generator reaching here is misplaced
+            _no_generators(e)
         self.children = (child,)
         schema = child.output_schema()
         self.exprs = [bind(e, schema) for e in exprs]
@@ -400,6 +416,85 @@ class Join(PlanNode):
 
     def describe(self):
         return f"Join[{self.join_type}]"
+
+
+class Generate(PlanNode):
+    """Generator node (explode/posexplode [outer]) — reference:
+    GpuGenerateExec.scala. Output = child columns + [pos] + element column;
+    non-outer drops rows with null/empty arrays, outer emits one null row."""
+
+    def __init__(self, child: PlanNode, gen_child: Expression,
+                 pos: bool, outer: bool, out_names: Sequence[str],
+                 required: Optional[Sequence[str]] = None):
+        self.children = (child,)
+        schema = child.output_schema()
+        self.gen_child = bind(gen_child, schema)
+        if not isinstance(self.gen_child.data_type, T.ArrayType):
+            raise ColumnarProcessingError(
+                f"explode input must be an array, got "
+                f"{self.gen_child.data_type.simple_string()}")
+        self.pos = pos
+        self.outer = outer
+        self.out_names = list(out_names)
+        # requiredChildOutput pruning (Spark Generate): only child columns
+        # consumers actually reference pass through
+        names = [n for n, _ in schema]
+        self.required = [n for n in names
+                         if required is None or n in set(required)]
+
+    def output_schema(self):
+        child_schema = dict(self.children[0].output_schema())
+        out = [(n, child_schema[n]) for n in self.required]
+        i = 0
+        if self.pos:
+            out.append((self.out_names[i], T.INT))
+            i += 1
+        out.append((self.out_names[i], self.gen_child.data_type.element_type))
+        return out
+
+    def execute_cpu(self):
+        for full in self.children[0].execute_cpu():
+            arr = self.gen_child.eval_cpu(full)
+            keep = [full.names.index(n) for n in self.required]
+            batch = HostTable([full.names[i] for i in keep],
+                              [full.columns[i] for i in keep])
+            e_dt = self.gen_child.data_type.element_type
+            rows_idx, poss, vals, vvalid, pvalid = [], [], [], [], []
+            for i in range(batch.num_rows):
+                if arr.validity[i] and len(arr.data[i]):
+                    for k, v in enumerate(arr.data[i]):
+                        rows_idx.append(i)
+                        poss.append(k)
+                        vals.append(v if v is not None else 0)
+                        vvalid.append(v is not None)
+                        pvalid.append(True)
+                elif self.outer:
+                    rows_idx.append(i)
+                    poss.append(0)
+                    vals.append(0)
+                    vvalid.append(False)
+                    pvalid.append(False)  # pos null ONLY on outer null rows
+            idx = np.asarray(rows_idx, dtype=np.int64)
+            cols = [HostColumn(c.dtype, c.data[idx], c.validity[idx])
+                    for c in batch.columns]
+            names = list(batch.names)
+            i = 0
+            if self.pos:
+                pv = np.asarray(poss, dtype=np.int32)
+                cols.append(HostColumn(
+                    T.INT, pv, np.asarray(pvalid, dtype=np.bool_)))
+                names.append(self.out_names[i])
+                i += 1
+            cols.append(HostColumn(
+                e_dt, np.asarray(vals, dtype=e_dt.np_dtype),
+                np.asarray(vvalid, dtype=np.bool_)))
+            names.append(self.out_names[i])
+            yield HostTable(names, cols)
+
+    def describe(self):
+        kind = ("posexplode" if self.pos else "explode") + \
+            ("_outer" if self.outer else "")
+        return f"Generate[{kind}({self.gen_child!r})]"
 
 
 class Exchange(PlanNode):

@@ -100,6 +100,10 @@ MAX_RETRIES_VAR = contextvars.ContextVar("rapids_oom_max_retries", default=2)
 def split_device_table_in_half(dt: DeviceTable) -> List[DeviceTable]:
     """Halve a batch by rows (splitSpillableInHalfByRows analog). Slicing
     device arrays re-buckets each half to the smaller capacity."""
+    if any(getattr(c, "is_array", False) for c in dt.columns):
+        raise FatalDeviceOOM(
+            "cannot row-split a batch with array columns (rebuilding "
+            "offsets under OOM is unsupported; reduce batch size instead)")
     n = dt.num_rows
     if n < 2:
         raise FatalDeviceOOM(

@@ -1,0 +1,123 @@
+"""Array types on device + GenerateExec + collection expressions
+(reference analog: array_test.py / generate_expr_test.py;
+GpuGenerateExec.scala, collectionOperations.scala)."""
+
+import pytest
+
+from spark_rapids_tpu import functions as F
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar import HostColumn, HostTable
+from spark_rapids_tpu.ops.expr import col, lit
+from spark_rapids_tpu.plan import from_host_table
+
+from tests.asserts import assert_runs_on_tpu, assert_tpu_and_cpu_are_equal
+
+
+def _arr_table():
+    arrays = [[1, 2, 3], None, [], [4, None, 6], [7], [None], [8, 9],
+              [10, 2, 10], [3], None, [5, 5, 5, 5], [11, -2]]
+    ids = list(range(len(arrays)))
+    return HostTable(
+        ["id", "a"],
+        [HostColumn.from_pylist(ids, T.INT),
+         HostColumn.from_pylist(arrays, T.ArrayType(T.INT))])
+
+
+def _df(sess, nb=1):
+    return from_host_table(_arr_table(), sess, nb)
+
+
+def test_array_scan_roundtrip(session):
+    out = _df(session).collect_table()
+    assert out.columns[1].to_pylist() == _arr_table().columns[1].to_pylist()
+
+
+def test_explode(session, cpu_session):
+    assert_tpu_and_cpu_are_equal(
+        lambda s: _df(s).select("id", F.explode(col("a")).alias("e")),
+        session, cpu_session)
+
+
+def test_explode_runs_on_device(session):
+    assert_runs_on_tpu(
+        lambda s: _df(s).select("id", F.explode(col("a")).alias("e")),
+        session)
+
+
+def test_posexplode(session, cpu_session):
+    assert_tpu_and_cpu_are_equal(
+        lambda s: _df(s).select("id", F.posexplode(col("a")).alias("e")),
+        session, cpu_session)
+
+
+def test_explode_outer(session, cpu_session):
+    assert_tpu_and_cpu_are_equal(
+        lambda s: _df(s).select("id", F.explode_outer(col("a")).alias("e")),
+        session, cpu_session)
+
+
+def test_posexplode_outer(session, cpu_session):
+    assert_tpu_and_cpu_are_equal(
+        lambda s: _df(s).select("id", F.posexplode_outer(col("a")).alias("e")),
+        session, cpu_session)
+
+
+def test_explode_then_aggregate(session, cpu_session):
+    assert_tpu_and_cpu_are_equal(
+        lambda s: _df(s)
+        .select("id", F.explode(col("a")).alias("e"))
+        .group_by("id")
+        .agg(F.count().alias("n"), F.sum(col("e")).alias("se")),
+        session, cpu_session)
+
+
+def test_size_and_minmax(session, cpu_session):
+    assert_tpu_and_cpu_are_equal(
+        lambda s: _df(s).select(
+            "id", F.size(col("a")).alias("sz"),
+            F.array_min(col("a")).alias("mn"),
+            F.array_max(col("a")).alias("mx")),
+        session, cpu_session)
+
+
+def test_array_contains_and_get_item(session, cpu_session):
+    assert_tpu_and_cpu_are_equal(
+        lambda s: _df(s).select(
+            "id", F.array_contains(col("a"), lit(2)).alias("has2"),
+            F.get_item(col("a"), lit(0)).alias("first"),
+            F.get_item(col("a"), lit(5)).alias("oob")),
+        session, cpu_session)
+
+
+def test_sort_array(session, cpu_session):
+    assert_tpu_and_cpu_are_equal(
+        lambda s: _df(s).select(
+            "id", F.sort_array(col("a")).alias("asc"),
+            F.sort_array(col("a"), asc=False).alias("desc")),
+        session, cpu_session)
+
+
+def test_create_array_and_explode(session, cpu_session):
+    def build(s):
+        from tests.data_gen import IntGen, gen_table
+        df = from_host_table(gen_table(
+            {"x": IntGen(min_val=0, max_val=50),
+             "y": IntGen(min_val=0, max_val=50)}, 100, 13), s)
+        return df.select(
+            "x", F.explode(F.array(col("x"), col("y"), lit(7))).alias("e"))
+    assert_tpu_and_cpu_are_equal(build, session, cpu_session)
+
+
+def test_array_through_generator_falls_back(session):
+    """Selecting the array column itself past a generator is unsupported
+    on device: the plan must fall back, results still correct."""
+    from tests.asserts import assert_falls_back
+    assert_falls_back(
+        lambda s: _df(s).select("a", F.explode(col("a")).alias("e")),
+        session, "Generate")
+
+
+def test_array_multi_batch(session, cpu_session):
+    assert_tpu_and_cpu_are_equal(
+        lambda s: _df(s, nb=3).select("id", F.explode(col("a")).alias("e")),
+        session, cpu_session)
