@@ -340,3 +340,71 @@ class TpuCoalesceExec(TpuExec):
     def describe(self):
         goal = "RequireSingleBatch" if self.require_single else f"TargetSize({self.target_bytes})"
         return f"TpuCoalesce[{goal}]"
+
+
+class TpuSampleExec(TpuExec):
+    """Bernoulli sample (reference: GpuSampleExec). The device kernel uses
+    the SAME counter-based RNG stream as the CPU path cannot (numpy
+    Philox vs threefry differ), so the mask is drawn ON HOST per batch
+    from the plan's seeded generator and shipped as a bitmask — tiny
+    (1 byte/row) and bit-identical to the CPU oracle."""
+
+    def __init__(self, child: TpuExec, fraction: float, seed: int):
+        super().__init__()
+        self.children = (child,)
+        self.fraction = float(fraction)
+        self.seed = int(seed)
+
+    def output_schema(self):
+        return self.children[0].output_schema()
+
+    def describe(self):
+        return f"TpuSample[{self.fraction}]"
+
+    def execute(self):
+        import numpy as _np
+        from spark_rapids_tpu.runtime.retry import with_retry
+        rng = _np.random.default_rng(self.seed)
+
+        def make_run(keep_host):
+            def run(dt):
+                keep = jnp.asarray(keep_host)
+                kernel = _compaction_kernel(dt.capacity, dt.schema_key()[0])
+                outs, new_n = kernel(
+                    tuple(c.data for c in dt.columns),
+                    tuple(c.validity for c in dt.columns),
+                    keep & dt.row_mask())
+                cols = [c.with_arrays(d, v)
+                        for c, (d, v) in zip(dt.columns, outs)]
+                return DeviceTable(dt.names, cols, new_n, dt.capacity)
+            return run
+
+        for batch in self.children[0].execute():
+            n = batch.num_rows  # host count drives the CPU-identical draw
+            keep_host = np.zeros(batch.capacity, dtype=np.bool_)
+            keep_host[:n] = rng.random(n) < self.fraction
+            yield from with_retry(batch, make_run(keep_host),
+                                  splittable=False)
+
+
+_COMPACT_KERNELS = {}
+
+
+def _compaction_kernel(capacity: int, schema_key):
+    key = (capacity, schema_key)
+    fn = _COMPACT_KERNELS.get(key)
+    if fn is None:
+        def run(datas, valids, keep):
+            pos = jnp.cumsum(keep.astype(jnp.int32)) - 1
+            tgt = jnp.where(keep, pos, capacity)
+            new_n = jnp.sum(keep.astype(jnp.int32))
+            outs = []
+            for d, v in zip(datas, valids):
+                od = jnp.zeros_like(d).at[tgt].set(d, mode="drop")
+                ov = jnp.zeros_like(v).at[tgt].set(v, mode="drop")
+                outs.append((od, ov))
+            return outs, new_n
+
+        fn = jax.jit(run)
+        _COMPACT_KERNELS[key] = fn
+    return fn

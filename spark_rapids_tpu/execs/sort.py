@@ -141,3 +141,58 @@ class TpuSortExec(TpuExec):
 
     def describe(self):
         return f"TpuSort[{len(self.orders)} keys]"
+
+
+class TpuTakeOrderedAndProjectExec(TpuExec):
+    """ORDER BY + LIMIT n (+ projection): per-batch device top-k via the
+    sort kernel, keep only k rows per batch, then one final k*batches
+    merge-sort — the reference's GpuTakeOrderedAndProjectExec shape
+    (never materializes the full sorted input)."""
+
+    def __init__(self, child: TpuExec, orders, limit: int,
+                 project=None, project_names=None):
+        super().__init__()
+        self.children = (child,)
+        self.orders = list(orders)
+        self.limit = int(limit)
+        self.project = list(project) if project is not None else None
+        self.project_names = list(project_names) if project_names else None
+        self._sorter = TpuSortExec(child, orders)  # reuse the sort kernel
+
+    def output_schema(self):
+        if self.project is None:
+            return self.children[0].output_schema()
+        return [(n, e.data_type)
+                for n, e in zip(self.project_names, self.project)]
+
+    def describe(self):
+        return f"TpuTakeOrderedAndProject[limit={self.limit}]"
+
+    def execute(self):
+        from spark_rapids_tpu.columnar import bucket_for
+        from spark_rapids_tpu.columnar.table import concat_device
+        from spark_rapids_tpu.ops.expr import compile_project
+        from spark_rapids_tpu.runtime.retry import retry_block
+
+        k = self.limit
+        tops = []
+        for batch in self.children[0].execute():
+            srt = retry_block(lambda b=batch: self._sorter._sort(b))
+            cap = min(bucket_for(max(k, 1)), srt.capacity)
+            cols = [c.with_arrays(c.data[:cap], c.validity[:cap])
+                    for c in srt.columns]
+            nrows = jnp.minimum(srt.nrows_dev, jnp.int32(k))
+            tops.append(DeviceTable(srt.names, cols, nrows, cap))
+
+        if not tops:
+            return
+        merged = tops[0] if len(tops) == 1 else retry_block(
+            lambda: concat_device(tops))
+        final = retry_block(lambda: self._sorter._sort(merged))
+        nrows = jnp.minimum(final.nrows_dev, jnp.int32(k))
+        out = DeviceTable(final.names, final.columns, nrows, final.capacity)
+        if self.project is not None:
+            cols = compile_project(self.project, out)
+            out = DeviceTable(self.project_names, cols, out.nrows_dev,
+                              out.capacity)
+        yield out

@@ -470,3 +470,12 @@ def posexplode(e):
 def posexplode_outer(e):
     from spark_rapids_tpu.ops.collections import PosExplodeOuter
     return PosExplodeOuter(_e(e))
+
+
+# -- UDF compiler -----------------------------------------------------------
+
+def udf(fn, return_type=None):
+    """Compile a Python lambda/function into an engine expression builder
+    (udf-compiler analog); see spark_rapids_tpu.udf."""
+    from spark_rapids_tpu.udf import udf as _udf
+    return _udf(fn, return_type)

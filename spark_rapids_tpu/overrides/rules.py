@@ -277,6 +277,30 @@ def _convert_generate(node: P.Generate, children, conf):
                            node.outer, node.out_names, node.required)
 
 
+def _convert_sample(node: P.Sample, children, conf):
+    from spark_rapids_tpu.execs.basic import TpuSampleExec
+    return TpuSampleExec(children[0], node.fraction, node.seed)
+
+
+def _convert_take_ordered(node: P.TakeOrderedAndProject, children, conf):
+    from spark_rapids_tpu.execs.sort import TpuTakeOrderedAndProjectExec
+    return TpuTakeOrderedAndProjectExec(children[0], node.orders, node.limit,
+                                        node.project, node.project_names)
+
+
+def _convert_cached(node: P.CachedRelation, children, conf):
+    from spark_rapids_tpu.conf import SCAN_DEVICE_CACHE
+    return TpuScanExec([node.materialize()],
+                       device_cache=conf.get_entry(SCAN_DEVICE_CACHE))
+
+
+def _tag_take_ordered(meta, conf):
+    _tag_sort(meta, conf)  # same output-schema + sort-key rules
+    if meta.node.project is not None:
+        for e in meta.node.project:
+            check_expr(e, conf, meta.reasons)
+
+
 def _convert_scan(node: P.LocalScan, children, conf):
     from spark_rapids_tpu.conf import SCAN_DEVICE_CACHE
     return TpuScanExec(node.batches,
@@ -468,6 +492,11 @@ def _convert_window(node: P.WindowNode, children, conf):
 
 exec_rule(P.Join, _tag_join, _convert_join)
 exec_rule(P.Generate, _tag_generate, _convert_generate)
+exec_rule(P.Sample, _tag_simple, _convert_sample)
+exec_rule(P.TakeOrderedAndProject, _tag_take_ordered, _convert_take_ordered)
+exec_rule(P.CollectLimit, _tag_simple,
+          lambda node, children, conf: TpuLimitExec(children[0], node.limit))
+exec_rule(P.CachedRelation, _tag_scan, _convert_cached)
 exec_rule(P.WindowNode, _tag_window, _convert_window)
 exec_rule(P.Exchange, _tag_exchange, _convert_exchange)
 
@@ -484,7 +513,14 @@ class PlanMeta:
         self.conf = conf
         self.parent = parent
         self.reasons: List[str] = []
-        self.children = [PlanMeta(c, conf, self) for c in node.children]
+        # CachedRelation is a planning LEAF: its child executes through its
+        # own session at materialize() time; tagging/converting the subtree
+        # here would duplicate planning and (on fallback) re-point the
+        # memoized table at a throwaway copy of the node
+        if isinstance(node, P.CachedRelation):
+            self.children = []
+        else:
+            self.children = [PlanMeta(c, conf, self) for c in node.children]
 
     def tag(self):
         rule = _EXEC_RULES.get(type(self.node))

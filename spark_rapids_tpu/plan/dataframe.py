@@ -103,7 +103,19 @@ class DataFrame:
     orderBy = sort
 
     def limit(self, n: int) -> "DataFrame":
-        return self._wrap(P.Limit(self.plan, n))
+        if isinstance(self.plan, P.Sort):
+            # ORDER BY + LIMIT plans as TakeOrderedAndProject (per-batch
+            # top-k, no full sorted materialization — Spark's planner rule)
+            return self._wrap(P.TakeOrderedAndProject(
+                self.plan.children[0], self.plan.orders, n))
+        # LIMIT without ordering = CollectLimit (Spark's planner shape)
+        return self._wrap(P.CollectLimit(self.plan, n))
+
+    def sample(self, fraction: float, seed: int = 0) -> "DataFrame":
+        return self._wrap(P.Sample(self.plan, fraction, seed))
+
+    def cache(self) -> "DataFrame":
+        return self._wrap(P.CachedRelation(self.plan, self.session))
 
     def union(self, other: "DataFrame") -> "DataFrame":
         return self._wrap(P.Union([self.plan, other.plan]))

@@ -497,6 +497,129 @@ class Generate(PlanNode):
         return f"Generate[{kind}({self.gen_child!r})]"
 
 
+class Sample(PlanNode):
+    """Bernoulli sample without replacement (reference: GpuSampleExec /
+    Spark SampleExec). Deterministic per (seed, row position)."""
+
+    def __init__(self, child: PlanNode, fraction: float, seed: int = 0):
+        self.children = (child,)
+        self.fraction = float(fraction)
+        self.seed = int(seed)
+
+    def output_schema(self):
+        return self.children[0].output_schema()
+
+    def execute_cpu(self):
+        rng = np.random.default_rng(self.seed)
+        for batch in self.children[0].execute_cpu():
+            keep = rng.random(batch.num_rows) < self.fraction
+            idx = np.nonzero(keep)[0]
+            yield HostTable(batch.names,
+                            [HostColumn(c.dtype, c.data[idx], c.validity[idx])
+                             for c in batch.columns])
+
+    def describe(self):
+        return f"Sample[fraction={self.fraction}, seed={self.seed}]"
+
+
+class TakeOrderedAndProject(PlanNode):
+    """ORDER BY ... LIMIT n (+ optional projection) — reference:
+    GpuTakeOrderedAndProjectExec: per-batch top-k, then merge."""
+
+    def __init__(self, child: PlanNode, orders: Sequence["SortOrder"],
+                 limit: int, project: Optional[Sequence[Expression]] = None):
+        self.children = (child,)
+        schema = child.output_schema()
+        self.orders = [SortOrder(bind(o.expr, schema), o.ascending,
+                                 o.nulls_first) for o in orders]
+        self.limit = int(limit)
+        self.project = ([bind(e, schema) for e in project]
+                        if project is not None else None)
+        self.project_names = ([output_name(e, f"col{i}")
+                               for i, e in enumerate(project)]
+                              if project is not None else None)
+
+    def output_schema(self):
+        if self.project is None:
+            return self.children[0].output_schema()
+        return [(n, e.data_type)
+                for n, e in zip(self.project_names, self.project)]
+
+    def execute_cpu(self):
+        table = self.children[0].collect_cpu()
+        cols = [o.expr.eval_cpu(table) for o in self.orders]
+        perm = _stable_sort_indices(cols, self.orders, table.num_rows)
+        take = perm[:self.limit]
+        out = HostTable(table.names,
+                        [HostColumn(c.dtype, c.data[take], c.validity[take])
+                         for c in table.columns])
+        if self.project is None:
+            yield out
+        else:
+            yield evaluate_cpu(self.project, out, self.project_names)
+
+    def describe(self):
+        return f"TakeOrderedAndProject[limit={self.limit}]"
+
+
+class CollectLimit(PlanNode):
+    """LIMIT without ordering (reference: GpuCollectLimitExec)."""
+
+    def __init__(self, child: PlanNode, limit: int):
+        self.children = (child,)
+        self.limit = int(limit)
+
+    def output_schema(self):
+        return self.children[0].output_schema()
+
+    def execute_cpu(self):
+        remaining = self.limit
+        for batch in self.children[0].execute_cpu():
+            if remaining <= 0:
+                return
+            take = min(batch.num_rows, remaining)
+            yield batch.slice(0, take)
+            remaining -= take
+
+    def describe(self):
+        return f"CollectLimit[{self.limit}]"
+
+
+class CachedRelation(PlanNode):
+    """df.cache(): lazily materializes the child ONCE (through the full
+    engine when a session is attached) and serves the result from memory;
+    re-uploads hit the scan device cache, so repeated queries stay device-
+    resident (reference: InMemoryTableScanExec + GpuInMemoryTableScan)."""
+
+    def __init__(self, child: PlanNode, session=None):
+        self.children = (child,)
+        self._session = session
+        self._table: Optional[HostTable] = None
+
+    def materialize(self) -> HostTable:
+        if self._table is None:
+            if self._session is not None:
+                self._table = self._session.execute(self.children[0])
+            else:
+                self._table = self.children[0].collect_cpu()
+        return self._table
+
+    def output_schema(self):
+        return self.children[0].output_schema()
+
+    def execute_cpu(self):
+        yield self.materialize()
+
+    def estimate_bytes(self):
+        if self._table is not None:
+            return self._table.nbytes()
+        return self.children[0].estimate_bytes()
+
+    def describe(self):
+        state = "materialized" if self._table is not None else "lazy"
+        return f"CachedRelation[{state}]"
+
+
 class Exchange(PlanNode):
     """Shuffle exchange placeholder: single-process CPU path is pass-through;
     the TPU path repartitions batches (parallel/exchange.py)."""

@@ -1,0 +1,79 @@
+"""Sample / TakeOrderedAndProject / CollectLimit / df.cache
+(reference analogs: GpuSampleExec, GpuTakeOrderedAndProjectExec,
+GpuCollectLimitExec, GpuInMemoryTableScanExec)."""
+
+import pytest
+
+from spark_rapids_tpu import functions as F
+from spark_rapids_tpu.ops.expr import col, lit
+from spark_rapids_tpu.plan import from_host_table
+from spark_rapids_tpu.plan.nodes import SortOrder
+
+from tests.asserts import assert_runs_on_tpu, assert_tpu_and_cpu_are_equal
+from tests.data_gen import DoubleGen, IntGen, StringGen, gen_table
+
+
+def _df(sess, n=600, nb=1, seed=77):
+    gens = {"k": IntGen(min_val=0, max_val=100), "s": StringGen(cardinality=7),
+            "d": DoubleGen(corner_prob=0.0)}
+    return from_host_table(gen_table(gens, n, seed), sess, nb)
+
+
+def test_sample_deterministic_and_matches_oracle(session, cpu_session):
+    assert_tpu_and_cpu_are_equal(
+        lambda s: _df(s).sample(0.3, seed=42),
+        session, cpu_session, ignore_order=False)
+
+
+def test_sample_runs_on_device(session):
+    assert_runs_on_tpu(lambda s: _df(s).sample(0.5, seed=1), session)
+
+
+def test_take_ordered(session, cpu_session):
+    assert_tpu_and_cpu_are_equal(
+        lambda s: _df(s).sort("k", "d").limit(17),
+        session, cpu_session, ignore_order=False)
+
+
+def test_take_ordered_desc_multi_batch(session, cpu_session):
+    assert_tpu_and_cpu_are_equal(
+        lambda s: _df(s, nb=4).sort(
+            SortOrder(col("d"), ascending=False)).limit(9),
+        session, cpu_session, ignore_order=False)
+
+
+def test_take_ordered_plans_as_topk(session):
+    from spark_rapids_tpu.plan.nodes import TakeOrderedAndProject
+    df = _df(session).sort("k").limit(5)
+    assert isinstance(df.plan, TakeOrderedAndProject)
+
+
+def test_take_ordered_limit_larger_than_input(session, cpu_session):
+    assert_tpu_and_cpu_are_equal(
+        lambda s: _df(s, n=30).sort("k", "d").limit(100),
+        session, cpu_session, ignore_order=False)
+
+
+def test_cache_materializes_once(session):
+    base = _df(session).filter(col("k") > lit(50))
+    cached = base.cache()
+    r1 = sorted(cached.collect(), key=str)
+    from spark_rapids_tpu.plan.nodes import CachedRelation
+    assert isinstance(cached.plan, CachedRelation)
+    assert cached.plan._table is not None  # materialized on first action
+    table_obj = cached.plan._table
+    r2 = sorted(cached.group_by("s").agg(F.count().alias("c"))
+                .collect(), key=str)
+    assert cached.plan._table is table_obj  # not re-executed
+    r3 = sorted(cached.collect(), key=str)
+    assert r1 == r3
+
+
+def test_cache_results_match_uncached(session, cpu_session):
+    uncached = sorted(
+        _df(cpu_session).filter(col("k") > lit(30))
+        .group_by("s").agg(F.sum(col("k")).alias("sk")).collect(), key=str)
+    cached = sorted(
+        _df(session).filter(col("k") > lit(30)).cache()
+        .group_by("s").agg(F.sum(col("k")).alias("sk")).collect(), key=str)
+    assert cached == uncached

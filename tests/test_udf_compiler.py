@@ -1,0 +1,131 @@
+"""UDF compiler: Python lambdas -> engine expressions (reference analog:
+udf-compiler/CatalystExpressionBuilder + its opcode suite)."""
+
+import warnings
+
+import pytest
+
+from spark_rapids_tpu import functions as F
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.ops.expr import col
+from spark_rapids_tpu.plan import from_host_table
+
+from tests.asserts import assert_runs_on_tpu, assert_tpu_and_cpu_are_equal
+from tests.data_gen import DoubleGen, IntGen, StringGen, gen_table
+
+
+def _df(sess, n=400, seed=3):
+    gens = {"x": IntGen(min_val=-100, max_val=100),
+            "y": IntGen(min_val=1, max_val=50),
+            "d": DoubleGen(corner_prob=0.0),
+            "s": StringGen(cardinality=8)}
+    return from_host_table(gen_table(gens, n, seed), sess)
+
+
+def test_arithmetic_udf_compiles_and_runs_on_device(session, cpu_session):
+    f = F.udf(lambda x, y: x * 2 + y - 1)
+    assert f.compiled
+    assert_runs_on_tpu(
+        lambda s: _df(s).select("x", f(col("x"), col("y")).alias("u")),
+        session)
+    assert_tpu_and_cpu_are_equal(
+        lambda s: _df(s).select("x", f(col("x"), col("y")).alias("u")),
+        session, cpu_session)
+
+
+def test_udf_matches_rowwise_python(session):
+    fn = lambda x, y: (x % y) + abs(x) if x > 0 else y * 3  # noqa: E731
+    f = F.udf(fn)
+    assert f.compiled
+    out = _df(session).select("x", "y", f(col("x"), col("y")).alias("u")) \
+        .collect()
+    for x, y, u in out:
+        # null inputs follow SQL semantics (null condition -> else branch),
+        # not Python (which would crash on None) — documented divergence
+        if x is not None and y is not None:
+            assert u == fn(x, y), (x, y, u)
+
+
+def test_conditional_and_comparison_chain(session, cpu_session):
+    f = F.udf(lambda x: 1 if 0 < x <= 50 else 0)
+    assert f.compiled
+    assert_tpu_and_cpu_are_equal(
+        lambda s: _df(s).select(f(col("x")).alias("u")),
+        session, cpu_session)
+
+
+def test_string_method_udf(session, cpu_session):
+    f = F.udf(lambda s: s.upper().strip())
+    assert f.compiled
+    assert_tpu_and_cpu_are_equal(
+        lambda s: _df(s).select(f(col("s")).alias("u")),
+        session, cpu_session)
+
+
+def test_def_function_compiles():
+    def my_udf(a, b):
+        return (a + b) * 2 - abs(a - b)
+
+    f = F.udf(my_udf)
+    assert f.compiled
+
+
+def test_min_max_rejected_for_null_semantics(session):
+    """min()/max() would compile to null-SKIPPING Least/Greatest while the
+    row-wise path null-propagates — the compiler must refuse."""
+    import warnings
+    from spark_rapids_tpu import types as T
+    f = F.udf(lambda a, b: min(a, b), return_type=T.LONG)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        expr = f(col("x"), col("y"))
+    assert any("row-wise" in str(x.message) for x in w)
+    out = _df(session).select("x", "y", expr.alias("u")).collect()
+    for x, y, u in out:
+        if x is not None and y is not None:
+            assert u == min(x, y)
+
+
+def test_uncompilable_falls_back_with_warning(session):
+    def loopy(x):
+        t = 0
+        for i in range(3):
+            t += x
+        return t
+
+    f = F.udf(loopy, return_type=T.LONG)
+    assert not f.compiled
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        expr = f(col("x"))
+    assert any("row-wise" in str(x.message) for x in w)
+    out = _df(session).select("x", expr.alias("u")).collect()
+    for x, u in out:
+        if x is not None:
+            assert u == 3 * x
+
+
+def test_uncompilable_without_return_type_raises():
+    from spark_rapids_tpu.udf import UdfCompileError
+
+    def loopy(x):
+        t = 0
+        for i in range(2):
+            t += x
+        return t
+
+    f = F.udf(loopy)
+    with pytest.raises(UdfCompileError):
+        f(col("x"))
+
+
+def test_closure_falls_back(session):
+    k = 7
+    f = F.udf(lambda x: x + k, return_type=T.LONG)
+    with warnings.catch_warnings(record=True):
+        warnings.simplefilter("always")
+        expr = f(col("x"))
+    out = _df(session).select("x", expr.alias("u")).collect()
+    for x, u in out:
+        if x is not None:
+            assert u == x + 7
