@@ -344,6 +344,14 @@ class DeviceColumn:
     def with_arrays(self, data, validity) -> "DeviceColumn":
         return DeviceColumn(self.dtype, data, validity, self.dictionary, self.dict_sorted)
 
+    def sliced_rows(self, k: int) -> "DeviceColumn":
+        """First k row slots (array columns keep their element buffers and
+        slice only the offsets — the shape every row-slicer must use)."""
+        if self.is_array:
+            off, ed, ev = self.data
+            return self.with_arrays((off[:k + 1], ed, ev), self.validity[:k])
+        return self.with_arrays(self.data[:k], self.validity[:k])
+
 
 def stage_upload(host: HostColumn, cap: int, split_f64: bool):
     """Host side of the fast H2D path: turn one column into (recipe, staged

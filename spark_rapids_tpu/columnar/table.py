@@ -515,5 +515,5 @@ class DeviceTable:
         k = bucket_for(max(n, 1))
         if k >= self.capacity:
             return self
-        cols = [c.with_arrays(c.data[:k], c.validity[:k]) for c in self.columns]
+        cols = [c.sliced_rows(k) for c in self.columns]
         return DeviceTable(self.names, cols, n, k)

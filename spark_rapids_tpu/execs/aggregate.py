@@ -54,7 +54,12 @@ from spark_rapids_tpu.ops.segsum import batched_segment_sum_f64, segment_sum_f64
 
 DEVICE_SUPPORTED_AGGS = (agg.Sum, agg.Min, agg.Max, agg.Count, agg.Average,
                          agg.First, agg.Last, agg.StddevPop, agg.StddevSamp,
-                         agg.VariancePop, agg.VarianceSamp)
+                         agg.VariancePop, agg.VarianceSamp,
+                         agg.CollectList, agg.CollectSet, agg.Percentile)
+
+#: aggregates needing the SORT-SEGMENT path (contiguous groups / per-group
+#: value order) and a single coalesced input (no streaming merge decomposition)
+SORT_ONLY_AGGS = (agg.CollectList, agg.CollectSet, agg.Percentile)
 
 
 def _sortable(data, validity):
@@ -268,6 +273,8 @@ class TpuHashAggregateExec(TpuExec):
         (kinds, sizes, strides, padded_num_segments)."""
         if not grouping or self.max_dict_groups <= 0:
             return None
+        if any(isinstance(fn, SORT_ONLY_AGGS) for _, fn in self.agg_specs):
+            return None  # collect/percentile need contiguous sorted groups
         kinds: List[str] = []
         sizes: List[int] = []
         for g, preps in zip(grouping, key_preps):
@@ -712,6 +719,68 @@ class TpuHashAggregateExec(TpuExec):
                 r = r.astype(jnp.bool_)
             zero = jnp.zeros_like(r)
             return (jnp.where(has_any, r, zero), has_any)
+
+        if isinstance(fnagg, (agg.CollectList, agg.CollectSet)):
+            from spark_rapids_tpu.ops.ordering import comparable_operands
+            keep = sv
+            sdv = sd
+            gidv = gid
+            if isinstance(fnagg, agg.CollectSet):
+                # distinct: re-sort by (gid, value) and keep group-local
+                # first occurrences
+                ops = comparable_operands(
+                    jnp.where(sv, sd, jnp.zeros_like(sd)))
+                res = jax.lax.sort(
+                    [gid, (~sv).astype(jnp.int32)] + ops +
+                    [jnp.arange(capacity, dtype=jnp.int32)],
+                    num_keys=2 + len(ops))
+                gidv = res[0]
+                sflag = res[1] == 0
+                perm2 = res[-1]
+                sdv = sd[perm2]
+                same = gidv == jnp.roll(gidv, 1)
+                for o in res[2:-1]:
+                    same = same & (o == jnp.roll(o, 1))
+                first = jnp.arange(capacity) == 0
+                keep = sflag & (first | ~same)
+            cpos = jnp.cumsum(keep.astype(jnp.int32)) - 1
+            etgt = jnp.where(keep, cpos, capacity)
+            elements = jnp.zeros(capacity, dtype=sd.dtype).at[etgt].set(
+                sdv, mode="drop")
+            evalid = jnp.zeros(capacity, dtype=jnp.bool_).at[etgt].set(
+                True, mode="drop")
+            counts = seg.segment_sum(keep.astype(jnp.int32), gidv,
+                                     num_segments=nseg)
+            offsets = jnp.concatenate(
+                [jnp.zeros(1, dtype=jnp.int32),
+                 jnp.cumsum(counts).astype(jnp.int32)])
+            # empty array (not null) for groups whose values were all null
+            return ((offsets, elements, evalid), group_live)
+
+        if isinstance(fnagg, agg.Percentile):
+            from spark_rapids_tpu.ops.ordering import comparable_operands
+            ops = comparable_operands(jnp.where(sv, sd, jnp.zeros_like(sd)))
+            res = jax.lax.sort(
+                [gid, (~sv).astype(jnp.int32)] + ops +
+                [jnp.arange(capacity, dtype=jnp.int32)],
+                num_keys=2 + len(ops))
+            gidv = res[0]
+            perm2 = res[-1]
+            sdv = sd[perm2].astype(jnp.float64)
+            svv = sv[perm2]
+            nn2 = seg.segment_sum(svv.astype(jnp.int32), gidv,
+                                  num_segments=nseg)
+            start = seg.segment_min(jnp.arange(capacity, dtype=jnp.int32),
+                                    gidv, num_segments=nseg)
+            k = (nn2 - 1).astype(jnp.float64) * fnagg.percentage
+            klo = jnp.floor(k).astype(jnp.int32)
+            khi = jnp.ceil(k).astype(jnp.int32)
+            safe_s = jnp.clip(start, 0, capacity - 1)
+            vlo = sdv[jnp.clip(safe_s + klo, 0, capacity - 1)]
+            vhi = sdv[jnp.clip(safe_s + khi, 0, capacity - 1)]
+            out = vlo + (vhi - vlo) * (k - klo)
+            validity = (nn2 > 0) & group_live
+            return (jnp.where(validity, out, 0.0), validity)
 
         if isinstance(fnagg, (agg.First, agg.Last)):
             idx = jnp.arange(capacity, dtype=jnp.int32)

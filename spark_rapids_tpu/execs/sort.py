@@ -179,8 +179,7 @@ class TpuTakeOrderedAndProjectExec(TpuExec):
         for batch in self.children[0].execute():
             srt = retry_block(lambda b=batch: self._sorter._sort(b))
             cap = min(bucket_for(max(k, 1)), srt.capacity)
-            cols = [c.with_arrays(c.data[:cap], c.validity[:cap])
-                    for c in srt.columns]
+            cols = [c.sliced_rows(cap) for c in srt.columns]
             nrows = jnp.minimum(srt.nrows_dev, jnp.int32(k))
             tops.append(DeviceTable(srt.names, cols, nrows, cap))
 

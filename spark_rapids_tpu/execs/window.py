@@ -34,6 +34,8 @@ from spark_rapids_tpu.errors import ColumnarProcessingError
 from spark_rapids_tpu.execs.base import TpuExec
 from spark_rapids_tpu.ops import aggregates as agg
 from spark_rapids_tpu.ops.window import (
+    NthValue,
+    PercentRank,
     DenseRank,
     Lag,
     Lead,
@@ -58,9 +60,16 @@ DEVICE_WINDOW_AGGS = (agg.Sum, agg.Count, agg.Min, agg.Max, agg.Average)
 def device_window_supported(w: WindowExpression) -> Tuple[bool, str]:
     fn = w.function
     frame = w.spec.resolved_frame()
-    if isinstance(fn, (RowNumber, Rank, DenseRank)):
+    if isinstance(fn, (RowNumber, Rank, DenseRank, PercentRank)):
         if not w.spec.orders:
             return False, "ranking window function requires an ORDER BY"
+        return True, ""
+    if isinstance(fn, NthValue):
+        if fn.ignore_nulls:
+            return False, "nth_value IGNORE NULLS is not supported on TPU"
+        if frame != ("range", None, 0):
+            return False, ("nth_value supports only the default running "
+                           "frame on TPU")
         return True, ""
     if isinstance(fn, (Lag, Lead)):
         if fn.default is not None and isinstance(fn.data_type, T.StringType):
@@ -70,15 +79,13 @@ def device_window_supported(w: WindowExpression) -> Tuple[bool, str]:
         kind, lo, hi = frame
         if kind == "range" and not (lo is None and (hi in (0, None))):
             return False, "only UNBOUNDED..CURRENT/UNBOUNDED range frames"
-        if kind == "rows" and (lo is not None or hi is not None):
-            if isinstance(fn, (agg.Min, agg.Max)) and not (
-                    lo is None and hi == 0):
-                return False, "bounded rows min/max window is not supported on TPU"
-            if (lo is not None and hi is not None and (hi - lo + 1) > 512
-                    and isinstance(fn, (agg.Sum, agg.Average))
-                    and isinstance(fn.data_type, (T.FloatType, T.DoubleType))):
-                return False, ("float both-bounded rows frame wider than 512 "
-                               "is not supported on TPU")
+        if kind == "rows":
+            # sparse-table / unroll widths are bounded by the frame's
+            # FINITE endpoints; gate them so table levels can't exhaust HBM
+            for bound in (lo, hi):
+                if bound is not None and abs(bound) > (1 << 16):
+                    return False, ("rows frame bound beyond 65536 is not "
+                                   "supported on TPU")
         return True, ""
     return False, f"window function {type(fn).__name__} is not supported on TPU"
 
@@ -182,7 +189,7 @@ class TpuWindowExec(TpuExec):
 
     def _prep_value(self, w: WindowExpression, pctx):
         fn = w.function
-        if isinstance(fn, (Lag, Lead)):
+        if isinstance(fn, (Lag, Lead, NthValue)):
             return [self._prep_tree(fn.children[0], pctx)]
         if isinstance(fn, agg.AggregateFunction) and fn.child is not None:
             return [self._prep_tree(fn.child, pctx)]
@@ -283,6 +290,29 @@ class TpuWindowExec(TpuExec):
         return [(~kv.validity).astype(jnp.int32),
                 jnp.where(kv.validity, d, jnp.zeros_like(d))]
 
+    @staticmethod
+    def _rmq(op, ident, vv, a, b, width: int, capacity: int):
+        """Range min/max over [a, b] per row via a doubling sparse table of
+        ceil(log2(width))+1 levels. Queries satisfy b - a + 1 <= width and
+        stay inside one partition, so table entries crossing partition
+        boundaries are never read by a query that could be contaminated."""
+        levels = [vv]
+        span = 1
+        while span < width:
+            prev = levels[-1]
+            shifted = jnp.concatenate(
+                [prev[span:], jnp.full(span, ident, dtype=prev.dtype)])
+            levels.append(op(prev, shifted))
+            span <<= 1
+        table = jnp.stack(levels)  # (L, capacity)
+        length = jnp.maximum(b - a + 1, 1)
+        k = jnp.floor(jnp.log2(length.astype(jnp.float32))).astype(jnp.int32)
+        k = jnp.clip(k, 0, len(levels) - 1)
+        pow_k = (jnp.int32(1) << k)
+        left = table[k, a]
+        right = table[k, jnp.clip(b - pow_k + 1, 0, capacity - 1)]
+        return op(left, right)
+
     def _eval_window_fn(self, w, vp, eval_tree, perm, idx, s_live, gid,
                         seg_start, peer_start, peer_last, nrows, capacity):
         fn = w.function
@@ -297,6 +327,24 @@ class TpuWindowExec(TpuExec):
             new_peer_int = (peer_start == idx).astype(jnp.int32)
             dense = _segmented_cumsum(new_peer_int, seg_start)
             return (dense.astype(jnp.int32), s_live)
+        if isinstance(fn, PercentRank):
+            seg_end_pr = jax.ops.segment_max(
+                jnp.where(s_live, idx, -1), gid, num_segments=capacity)[gid]
+            m = (seg_end_pr - seg_start + 1).astype(jnp.float64)
+            rank = (peer_start - seg_start + 1).astype(jnp.float64)
+            pr = jnp.where(m > 1, (rank - 1.0) / jnp.maximum(m - 1.0, 1.0),
+                           0.0)
+            return (pr, s_live)
+        if isinstance(fn, NthValue):
+            src = eval_tree(fn.children[0], vp[0])
+            sd_n, sv_n = src.data[perm], src.validity[perm]
+            pos = seg_start + (fn.n - 1)
+            safe = jnp.clip(pos, 0, capacity - 1)
+            seg_end_nv = jax.ops.segment_max(
+                jnp.where(s_live, idx, -1), gid, num_segments=capacity)[gid]
+            avail = (pos <= peer_last) & (pos <= seg_end_nv) & s_live
+            data = jnp.where(avail, sd_n[safe], jnp.zeros_like(sd_n))
+            return (data, avail & sv_n[safe])
 
         if isinstance(fn, (Lag, Lead)):
             src = eval_tree(fn.children[0], vp[0])
@@ -350,7 +398,7 @@ class TpuWindowExec(TpuExec):
                 nn = jax.ops.segment_sum(sv.astype(jnp.int32), gid,
                                          num_segments=capacity)[gid]
                 valid = (nn > 0) & s_live
-            else:  # running
+            elif running:
                 new_seg = seg_start == idx
                 r = _segmented_scan(op, vv, new_seg)
                 cnt = _segmented_scan(jnp.add, sv.astype(jnp.int32), new_seg)
@@ -358,6 +406,46 @@ class TpuWindowExec(TpuExec):
                     r = r[peer_last]
                     cnt = cnt[peer_last]
                 valid = (cnt > 0) & s_live
+            else:
+                # bounded rows min/max (GpuBatchedBoundedWindowExec analog):
+                # clip the frame to the partition, then
+                #   hi unbounded  -> reverse segmented running scan read at a
+                #   lo unbounded  -> forward scan at idx combined with a
+                #                    sparse-table query over (idx, b]
+                #   both bounded  -> classic RMQ sparse-table query on [a, b]
+                seg_end = jax.ops.segment_max(
+                    jnp.where(s_live, idx, -1), gid,
+                    num_segments=capacity)[gid]
+                a = seg_start if lo is None else jnp.maximum(seg_start, idx + lo)
+                b = seg_end if hi is None else jnp.minimum(seg_end, idx + hi)
+                a = jnp.clip(a, 0, capacity - 1)
+                b = jnp.clip(b, 0, capacity - 1)
+                nonempty = (b >= a) & s_live
+                new_seg = seg_start == idx
+
+                prefc = _segmented_scan(jnp.add, sv.astype(jnp.int32), new_seg)
+                lo_exclc = jnp.where(a > seg_start,
+                                     prefc[jnp.maximum(a - 1, 0)], 0)
+                nn = jnp.where(nonempty, prefc[b] - lo_exclc, 0)
+
+                if hi is None:
+                    rscan = jnp.flip(_segmented_scan(
+                        op, jnp.flip(vv), jnp.flip(idx == seg_end)))
+                    r = rscan[a]
+                else:
+                    width = (hi - (lo if lo is not None else 0)) + 1 \
+                        if lo is not None else hi + 1
+                    width = max(int(width), 1)
+                    qa = a if lo is not None else jnp.minimum(idx + 1, b)
+                    r_tab = self._rmq(op, ident, vv, qa, b, width, capacity)
+                    if lo is None:
+                        fwd = _segmented_scan(op, vv, new_seg)
+                        head = fwd[jnp.minimum(idx, b)]
+                        tail = jnp.where(b > idx, r_tab, ident)
+                        r = op(head, tail)
+                    else:
+                        r = r_tab
+                valid = (nn > 0) & nonempty
             r = jnp.where(valid, r, jnp.zeros_like(r))
             if isinstance(fn.data_type, T.BooleanType):
                 r = r.astype(jnp.bool_)
@@ -408,7 +496,7 @@ class TpuWindowExec(TpuExec):
                 rpref = jnp.flip(_segmented_scan(
                     jnp.add, jnp.flip(v), jnp.flip(seg_last)))
                 total = jnp.where(nonempty, rpref[a], 0.0)
-            else:
+            elif (hi - lo + 1) <= 512:
                 # both-bounded small frame: exact per-frame unrolled sum
                 total = jnp.zeros_like(v)
                 for k in range(lo, hi + 1):
@@ -416,6 +504,14 @@ class TpuWindowExec(TpuExec):
                     safe = jnp.clip(j, 0, capacity - 1)
                     inside = (j >= seg_start) & (j <= seg_end) & s_live
                     total = total + jnp.where(inside, v[safe], 0.0)
+            else:
+                # wide float frame: segmented-prefix DIFFERENCE — same
+                # reduction-order float variance class the reference gates
+                # with variableFloatAgg (ulp-level, partition-local)
+                pref = seg_prefix(v)
+                lo_excl = jnp.where(past_start,
+                                    pref[jnp.maximum(a - 1, 0)], 0.0)
+                total = jnp.where(nonempty, pref[b] - lo_excl, 0.0)
 
         if isinstance(fn, agg.Count):
             return (nn.astype(jnp.int64), s_live)

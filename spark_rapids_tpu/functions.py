@@ -47,6 +47,18 @@ def last(e, ignore_nulls=False):
     return _agg.Last(_e(e), ignore_nulls)
 
 
+def collect_list(e):
+    return _agg.CollectList(_e(e))
+
+
+def collect_set(e):
+    return _agg.CollectSet(_e(e))
+
+
+def percentile(e, p: float):
+    return _agg.Percentile(_e(e), p)
+
+
 def stddev(e):
     return _agg.StddevSamp(_e(e))
 
@@ -188,6 +200,16 @@ def rank():
 def dense_rank():
     from spark_rapids_tpu.ops import window as _w
     return _w.dense_rank()
+
+
+def percent_rank():
+    from spark_rapids_tpu.ops.window import PercentRank
+    return PercentRank()
+
+
+def nth_value(e, n: int):
+    from spark_rapids_tpu.ops.window import NthValue
+    return NthValue(_e(e), n)
 
 
 def lag(e, offset: int = 1, default=None):
@@ -479,3 +501,42 @@ def udf(fn, return_type=None):
     (udf-compiler analog); see spark_rapids_tpu.udf."""
     from spark_rapids_tpu.udf import udf as _udf
     return _udf(fn, return_type)
+
+
+# -- misc -------------------------------------------------------------------
+
+def monotonically_increasing_id():
+    from spark_rapids_tpu.ops.misc import MonotonicallyIncreasingID
+    return MonotonicallyIncreasingID()
+
+
+def spark_partition_id():
+    from spark_rapids_tpu.ops.misc import SparkPartitionID
+    return SparkPartitionID()
+
+
+def rand(seed: int = 0):
+    from spark_rapids_tpu.ops.misc import Rand
+    return Rand(seed)
+
+
+def md5(e):
+    from spark_rapids_tpu.ops.misc import Md5
+    return Md5(_e(e))
+
+
+def concat_ws(sep, *exprs):
+    from spark_rapids_tpu.ops.misc import ConcatWs
+    # the separator is a VALUE (PySpark signature), not a column name
+    sep_expr = sep if isinstance(sep, Expression) else lit(sep)
+    return ConcatWs(sep_expr, *[_e(x) for x in exprs])
+
+
+def from_utc_timestamp(e, tz):
+    from spark_rapids_tpu.ops.misc import FromUTCTimestamp
+    return FromUTCTimestamp(_e(e), _e(tz))
+
+
+def to_utc_timestamp(e, tz):
+    from spark_rapids_tpu.ops.misc import ToUTCTimestamp
+    return ToUTCTimestamp(_e(e), _e(tz))

@@ -175,3 +175,53 @@ class MergeMoments(AggregateFunction):
 
     def key(self):
         return ("mergemoments", tuple(c.key() for c in self.children))
+
+
+class CollectList(AggregateFunction):
+    """collect_list(e) -> array of non-null values in input order
+    (reference: GpuCollectList)."""
+
+    @property
+    def data_type(self):
+        return T.ArrayType(self.child.data_type)
+
+    @property
+    def nullable(self):
+        return False  # empty array, never null
+
+
+class CollectSet(AggregateFunction):
+    """collect_set(e) -> array of distinct non-null values
+    (reference: GpuCollectSet; order unspecified, this engine emits
+    value-sorted)."""
+
+    @property
+    def data_type(self):
+        return T.ArrayType(self.child.data_type)
+
+    @property
+    def nullable(self):
+        return False
+
+
+class Percentile(AggregateFunction):
+    """percentile(e, p) exact, with linear interpolation
+    (reference: GpuPercentile / ApproximatePercentile's exact cousin)."""
+
+    def __init__(self, child: Expression, percentage: float):
+        super().__init__(child)
+        self.percentage = float(percentage)
+        if not (0.0 <= self.percentage <= 1.0):
+            raise ValueError(
+                f"percentile percentage must be in [0, 1], got {percentage}")
+
+    def with_children(self, children):
+        return Percentile(children[0], self.percentage)
+
+    def key(self):
+        return ("percentile", self.percentage,
+                tuple(c.key() for c in self.children))
+
+    @property
+    def data_type(self):
+        return T.DOUBLE

@@ -1,0 +1,499 @@
+"""Misc expressions: nondeterministic ids/random, float normalization
+markers, null guards, timezone shifts, string hashes, concat_ws.
+
+Reference: GpuMonotonicallyIncreasingID / GpuSparkPartitionID /
+GpuRand (nondeterministicExpressions.scala), NormalizeNaNAndZero /
+KnownFloatingPointNormalized (GpuNormalizeNanAndZero), AtLeastNNonNulls,
+GpuFromUTCTimestamp/GpuToUTCTimestamp (+ GpuTimeZoneDB — the UTC-offset
+subset runs on device, DST zones tag fallback exactly like the reference's
+carve-out), Md5 (HashFunctions), ConcatWs (stringFunctions.scala).
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import hashlib
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar import HostColumn, HostTable
+from spark_rapids_tpu.ops.common import UnaryExpression
+from spark_rapids_tpu.ops.expr import (
+    DevVal,
+    Expression,
+    Literal,
+    NodePrep,
+    PrepCtx,
+    lit,
+)
+
+# ---------------------------------------------------------------------------
+# float normalization / null guards
+# ---------------------------------------------------------------------------
+
+
+class NormalizeNaNAndZero(UnaryExpression):
+    """-0.0 -> 0.0 and all NaNs -> one canonical NaN (Spark inserts this
+    before grouping/joining on floats)."""
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type
+
+    def key(self):
+        return ("normnanzero", self.children[0].key())
+
+    def eval_cpu(self, table):
+        c = self.children[0].eval_cpu(table)
+        d = np.where(c.data == 0.0, 0.0, c.data)
+        d = np.where(np.isnan(c.data), np.nan, d)
+        return HostColumn(c.dtype, d.astype(c.data.dtype), c.validity.copy())
+
+    def eval_dev(self, ctx, child_vals, prep):
+        (c,) = child_vals
+        d = jnp.where(c.data == 0.0, jnp.zeros_like(c.data), c.data)
+        d = jnp.where(jnp.isnan(c.data), jnp.full_like(d, jnp.nan), d)
+        return DevVal(d, c.validity)
+
+
+class KnownFloatingPointNormalized(UnaryExpression):
+    """Planner marker: input is already normalized — identity."""
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type
+
+    def key(self):
+        return ("knownnormalized", self.children[0].key())
+
+    def eval_cpu(self, table):
+        return self.children[0].eval_cpu(table)
+
+    def eval_dev(self, ctx, child_vals, prep):
+        return child_vals[0]
+
+
+class KnownNotNull(UnaryExpression):
+    """Planner marker: input is known non-null — identity with
+    non-nullable typing."""
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type
+
+    @property
+    def nullable(self):
+        return False
+
+    def key(self):
+        return ("knownnotnull", self.children[0].key())
+
+    def eval_cpu(self, table):
+        return self.children[0].eval_cpu(table)
+
+    def eval_dev(self, ctx, child_vals, prep):
+        return child_vals[0]
+
+
+class AtLeastNNonNulls(Expression):
+    """True when at least n of the children are non-null (Spark uses it
+    for DataFrame.dropna)."""
+
+    def __init__(self, n: int, *children: Expression):
+        self.n = int(n)
+        self.children = tuple(children)
+
+    @property
+    def data_type(self):
+        return T.BOOLEAN
+
+    @property
+    def nullable(self):
+        return False
+
+    def key(self):
+        return ("atleastnnonnulls", self.n,
+                tuple(c.key() for c in self.children))
+
+    def with_children(self, children):
+        return AtLeastNNonNulls(self.n, *children)
+
+    def eval_cpu(self, table):
+        kids = [c.eval_cpu(table) for c in self.children]
+        cnt = np.zeros(table.num_rows, dtype=np.int32)
+        for k in kids:
+            cnt += k.validity
+        return HostColumn(T.BOOLEAN, cnt >= self.n,
+                          np.ones(table.num_rows, dtype=np.bool_))
+
+    def eval_dev(self, ctx, child_vals, prep):
+        cnt = jnp.zeros(ctx.capacity, dtype=jnp.int32)
+        for cv in child_vals:
+            cnt = cnt + cv.validity.astype(jnp.int32)
+        return DevVal(cnt >= self.n, jnp.ones(ctx.capacity, dtype=jnp.bool_))
+
+
+# ---------------------------------------------------------------------------
+# nondeterministic
+# ---------------------------------------------------------------------------
+
+
+#: live nondeterministic expression instances; session.execute resets them
+#: so re-collecting a DataFrame reproduces the same stream (Spark rand(seed)
+#: is per-query deterministic)
+_NONDETERMINISTIC = None
+
+
+def _register_nondeterministic(e):
+    global _NONDETERMINISTIC
+    if _NONDETERMINISTIC is None:
+        import weakref
+        _NONDETERMINISTIC = weakref.WeakSet()
+    _NONDETERMINISTIC.add(e)
+
+
+def reset_nondeterministic_streams() -> None:
+    if _NONDETERMINISTIC is None:
+        return
+    for e in list(_NONDETERMINISTIC):
+        e.reset_stream()
+
+
+class MonotonicallyIncreasingID(Expression):
+    """Per-batch monotonically increasing ids: (partition << 33) + row
+    offset, continuing across batches (the engine is single-partition per
+    stream, so the running row offset carries the Spark shape)."""
+
+    children = ()
+
+    def __init__(self):
+        self._offset = {"n": 0}
+        _register_nondeterministic(self)
+
+    def reset_stream(self):
+        self._offset["n"] = 0
+
+    @property
+    def data_type(self):
+        return T.LONG
+
+    @property
+    def nullable(self):
+        return False
+
+    def key(self):
+        return ("monotonicid", id(self._offset))
+
+    def with_children(self, children):
+        return self
+
+    def eval_cpu(self, table):
+        n = table.num_rows
+        base = self._offset["n"]
+        self._offset["n"] += n
+        return HostColumn(T.LONG, base + np.arange(n, dtype=np.int64))
+
+    def prep(self, pctx: PrepCtx, child_preps):
+        base = self._offset["n"]
+        self._offset["n"] += pctx.table.num_rows
+        slot = pctx.add_aux(np.asarray([base], dtype=np.int64))
+        return NodePrep(aux_slots=(slot,))
+
+    def eval_dev(self, ctx, child_vals, prep):
+        base = ctx.aux[prep.aux_slots[0]][0]
+        data = base + jnp.arange(ctx.capacity, dtype=jnp.int64)
+        return DevVal(data, jnp.ones(ctx.capacity, dtype=jnp.bool_))
+
+
+class SparkPartitionID(Expression):
+    """Partition id of the executing task (0 in the single-stream engine;
+    exchanges renumber per output partition)."""
+
+    children = ()
+
+    def __init__(self, pid: int = 0):
+        self.pid = pid
+
+    @property
+    def data_type(self):
+        return T.INT
+
+    @property
+    def nullable(self):
+        return False
+
+    def key(self):
+        return ("sparkpartitionid", self.pid)
+
+    def with_children(self, children):
+        return self
+
+    def eval_cpu(self, table):
+        return HostColumn(
+            T.INT, np.full(table.num_rows, self.pid, dtype=np.int32))
+
+    def eval_dev(self, ctx, child_vals, prep):
+        return DevVal(jnp.full(ctx.capacity, self.pid, dtype=jnp.int32),
+                      jnp.ones(ctx.capacity, dtype=jnp.bool_))
+
+
+class Rand(Expression):
+    """rand([seed]) — uniform [0, 1). The stream draws ON HOST from the
+    seeded generator (like GpuSampleExec's mask) so the device result is
+    bit-identical to the CPU path; values ride as an aux array."""
+
+    children = ()
+
+    def __init__(self, seed: int = 0):
+        self.seed = int(seed)
+        self._rng = np.random.default_rng(self.seed)
+        _register_nondeterministic(self)
+
+    def reset_stream(self):
+        self._rng = np.random.default_rng(self.seed)
+
+    @property
+    def data_type(self):
+        return T.DOUBLE
+
+    @property
+    def nullable(self):
+        return False
+
+    def key(self):
+        return ("rand", self.seed, id(self._rng))
+
+    def with_children(self, children):
+        return self
+
+    def eval_cpu(self, table):
+        return HostColumn(T.DOUBLE, self._rng.random(table.num_rows))
+
+    def prep(self, pctx: PrepCtx, child_preps):
+        vals = np.zeros(pctx.table.capacity)
+        vals[:pctx.table.num_rows] = self._rng.random(pctx.table.num_rows)
+        slot = pctx.add_aux(vals)
+        return NodePrep(aux_slots=(slot,))
+
+    def eval_dev(self, ctx, child_vals, prep):
+        vals = ctx.aux[prep.aux_slots[0]][:ctx.capacity]
+        return DevVal(vals, jnp.ones(ctx.capacity, dtype=jnp.bool_))
+
+
+# ---------------------------------------------------------------------------
+# timezone shifts (UTC-offset subset on device; DST zones fall back —
+# the reference's GpuTimeZoneDB carve-out pattern)
+# ---------------------------------------------------------------------------
+
+
+def _fixed_offset_micros(tz: str) -> Optional[int]:
+    """Micros offset for fixed-offset zone spellings (UTC, GMT, +hh:mm,
+    UTC+h, GMT-hh:mm); None for named/DST zones."""
+    t = tz.strip()
+    up = t.upper()
+    if up in ("UTC", "GMT", "Z"):
+        return 0
+    for prefix in ("UTC", "GMT"):
+        if up.startswith(prefix):
+            t = t[len(prefix):]
+            break
+    if not t:
+        return 0
+    sign = 1
+    if t[0] == "+":
+        t = t[1:]
+    elif t[0] == "-":
+        sign = -1
+        t = t[1:]
+    else:
+        return None
+    parts = t.split(":")
+    try:
+        hh = int(parts[0])
+        mm = int(parts[1]) if len(parts) > 1 else 0
+        ss = int(parts[2]) if len(parts) > 2 else 0
+    except ValueError:
+        return None
+    if hh > 18 or mm > 59 or ss > 59:
+        return None
+    return sign * ((hh * 3600 + mm * 60 + ss) * 1_000_000)
+
+
+class _TzShift(Expression):
+    to_utc = False
+
+    def __init__(self, child: Expression, tz: Expression):
+        self.children = (child, tz)
+
+    @property
+    def data_type(self):
+        return T.TIMESTAMP
+
+    def key(self):
+        name = "toutc" if self.to_utc else "fromutc"
+        return (name, tuple(c.key() for c in self.children))
+
+    def with_children(self, children):
+        return type(self)(children[0], children[1])
+
+    @property
+    def device_supported(self):
+        tz = self.children[1]
+        return (isinstance(tz, Literal) and tz.value is not None
+                and _fixed_offset_micros(str(tz.value)) is not None)
+
+    def _offset(self) -> Optional[int]:
+        tz = self.children[1]
+        if not isinstance(tz, Literal) or tz.value is None:
+            return None
+        return _fixed_offset_micros(str(tz.value))
+
+    def eval_cpu(self, table):
+        c = self.children[0].eval_cpu(table)
+        off = self._offset()
+        if off is None:
+            # named zone: zoneinfo on host (DST-correct CPU fallback)
+            from zoneinfo import ZoneInfo
+            zone = ZoneInfo(str(self.children[1].value))
+            out = np.zeros(len(c), dtype=np.int64)
+            epoch = _dt.datetime(1970, 1, 1, tzinfo=_dt.timezone.utc)
+            for i in range(len(c)):
+                if c.validity[i]:
+                    ts = epoch + _dt.timedelta(microseconds=int(c.data[i]))
+                    if self.to_utc:
+                        local = ts.replace(tzinfo=zone)
+                        out[i] = int((local - epoch).total_seconds() * 1e6)
+                    else:
+                        shifted = ts.astimezone(zone)
+                        naive = shifted.replace(tzinfo=_dt.timezone.utc)
+                        out[i] = int((naive - epoch).total_seconds() * 1e6)
+            return HostColumn(T.TIMESTAMP, out, c.validity.copy())
+        delta = -off if self.to_utc else off
+        return HostColumn(T.TIMESTAMP, c.data + delta, c.validity.copy())
+
+    def eval_dev(self, ctx, child_vals, prep):
+        c, _tz = child_vals
+        off = self._offset()
+        delta = -off if self.to_utc else off
+        return DevVal(c.data + jnp.int64(delta), c.validity)
+
+
+class FromUTCTimestamp(_TzShift):
+    to_utc = False
+
+
+class ToUTCTimestamp(_TzShift):
+    to_utc = True
+
+
+# ---------------------------------------------------------------------------
+# md5 / concat_ws
+# ---------------------------------------------------------------------------
+
+
+from spark_rapids_tpu.ops.strings import DictStringToString  # noqa: E402
+
+
+class Md5(DictStringToString, UnaryExpression):
+    """md5(string) -> lowercase hex digest (dictionary transform)."""
+
+    def transform(self, s):
+        return hashlib.md5(s.encode("utf-8")).hexdigest()
+
+
+class ConcatWs(Expression):
+    """concat_ws(sep, e1, e2, ...) — null children SKIP (unlike Concat);
+    never returns null when sep is non-null. Device path: dictionary
+    transform when at most one child is a non-literal string column."""
+
+    def __init__(self, sep: Expression, *children: Expression):
+        self.children = (sep,) + tuple(children)
+
+    @property
+    def data_type(self):
+        return T.STRING
+
+    @property
+    def nullable(self):
+        return self.children[0].nullable
+
+    def key(self):
+        return ("concatws", tuple(c.key() for c in self.children))
+
+    def with_children(self, children):
+        return ConcatWs(children[0], *children[1:])
+
+    @property
+    def device_supported(self):
+        sep = self.children[0]
+        if not isinstance(sep, Literal) or sep.value is None:
+            return False  # null separator: CPU path returns all-null
+        non_lit = [c for c in self.children[1:]
+                   if not isinstance(c, Literal)]
+        # the dictionary transform only applies to STRING columns
+        if any(not isinstance(c.data_type, T.StringType) for c in non_lit):
+            return False
+        return len(non_lit) <= 1
+
+    def eval_cpu(self, table):
+        kids = [c.eval_cpu(table) for c in self.children]
+        sep, vals = kids[0], kids[1:]
+        n = table.num_rows
+        out = np.empty(n, dtype=object)
+        validity = sep.validity.copy()
+        for i in range(n):
+            if validity[i]:
+                parts = [str(k.data[i]) for k in vals if k.validity[i]]
+                out[i] = str(sep.data[i]).join(parts)
+        return HostColumn(T.STRING, out, validity)
+
+    def prep(self, pctx: PrepCtx, child_preps):
+        sep = self.children[0].value
+        if sep is None:
+            return NodePrep(out_dict=np.array([], dtype=object))
+        col_idx = None
+        for j, c in enumerate(self.children[1:]):
+            if not isinstance(c, Literal):
+                col_idx = j
+        lits = [(j, c.value) for j, c in enumerate(self.children[1:])
+                if isinstance(c, Literal)]
+        if col_idx is None:
+            parts = [v for _, v in sorted(lits) if v is not None]
+            return NodePrep(out_dict=np.array([sep.join(map(str, parts))],
+                                              dtype=object),
+                            extra={"constant": True})
+        d = child_preps[col_idx + 1].out_dict
+        if d is None:
+            d = np.array([], dtype=object)
+        out = np.empty(max(len(d), 1), dtype=object)
+        with_col = [(j, v) for j, v in lits] + [(col_idx, None)]
+        order = sorted(with_col)
+        for i in range(max(len(d), 1)):
+            parts = []
+            for j, v in order:
+                if j == col_idx:
+                    parts.append(str(d[i]) if len(d) else "")
+                elif v is not None:
+                    parts.append(str(v))
+            out[i] = sep.join(parts)
+        # the version where the column value is null: skip it entirely
+        no_col = sep.join(str(v) for _, v in sorted(lits) if v is not None)
+        # null_code rides as aux so the trace is shared across dict sizes
+        slot = pctx.add_aux(np.asarray([len(out)], dtype=np.int32))
+        return NodePrep(out_dict=np.append(out, no_col), dict_sorted=False,
+                        aux_slots=(slot,), extra={"col_idx": col_idx})
+
+    def eval_dev(self, ctx, child_vals, prep):
+        if prep.extra.get("constant"):
+            cap = ctx.capacity
+            sep_valid = self.children[0].value is not None
+            return DevVal(jnp.zeros(cap, dtype=jnp.int32),
+                          jnp.full(cap, sep_valid, dtype=jnp.bool_))
+        col_idx = prep.extra["col_idx"]
+        cv = child_vals[col_idx + 1]
+        null_code = ctx.aux[prep.aux_slots[0]][0]
+        codes = jnp.where(cv.validity, cv.data, null_code)
+        return DevVal(codes, jnp.ones(ctx.capacity, dtype=jnp.bool_))

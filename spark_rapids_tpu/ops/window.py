@@ -129,6 +129,47 @@ class DenseRank(WindowFunction):
         return self
 
 
+class PercentRank(WindowFunction):
+    """percent_rank() = (rank - 1) / (partition rows - 1); 0 for single-row
+    partitions (reference: GpuWindowExpression rank family)."""
+
+    children = ()
+
+    @property
+    def data_type(self):
+        return T.DOUBLE
+
+    def key(self):
+        return ("percentrank",)
+
+    def with_children(self, children):
+        return self
+
+
+class NthValue(WindowFunction):
+    """nth_value(e, n) over the default running frame: the n-th row's value
+    of the partition, visible once the frame reaches it (reference:
+    GpuNthValue)."""
+
+    def __init__(self, child: Expression, n: int, ignore_nulls: bool = False):
+        self.children = (child,)
+        self.n = int(n)
+        self.ignore_nulls = bool(ignore_nulls)
+        if self.n < 1:
+            raise ValueError("nth_value n must be >= 1")
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type
+
+    def key(self):
+        return ("nthvalue", self.n, self.ignore_nulls,
+                self.children[0].key())
+
+    def with_children(self, children):
+        return NthValue(children[0], self.n, self.ignore_nulls)
+
+
 class Lag(WindowFunction):
     def __init__(self, child: Expression, offset: int = 1, default=None):
         self.children = (child,)
@@ -277,6 +318,35 @@ def eval_window_cpu(table: HostTable, wexpr: WindowExpression) -> HostColumn:
                     dense += 1
                     prev = cur
                 result[r] = rank if isinstance(fn, Rank) else dense
+        elif isinstance(fn, PercentRank):
+            rank = 0
+            prev = object()
+            for j, r in enumerate(rows):
+                cur = order_tuple(r)
+                if cur != prev:
+                    rank = j + 1
+                    prev = cur
+                result[r] = 0.0 if m == 1 else (rank - 1) / (m - 1)
+        elif isinstance(fn, NthValue):
+            if frame != ("range", None, 0):
+                raise ColumnarProcessingError(
+                    "nth_value supports only the default running frame")
+            src = fn.children[0].eval_cpu(table)
+            # default running frame (range unbounded preceding..current):
+            # the nth partition row becomes visible at its peer group
+            pos = fn.n - 1
+            for j, r in enumerate(rows):
+                # frame end = last peer of r
+                e = j
+                while e + 1 < m and order_tuple(rows[e + 1]) == order_tuple(r):
+                    e += 1
+                if pos <= e:
+                    rr = rows[pos]
+                    result[r] = src.data[rr] if src.validity[rr] else None
+                    valid[r] = bool(src.validity[rr])
+                else:
+                    result[r] = None
+                    valid[r] = False
         elif isinstance(fn, (Lag, Lead)):
             src = fn.children[0].eval_cpu(table)
             off = fn.offset if isinstance(fn, Lead) else -fn.offset

@@ -81,6 +81,12 @@ def _build_expr_sigs():
     reg(expr_mod.Literal)
     reg(expr_mod.Alias, COMMON_PLUS_ARRAYS)
     reg(cast.Cast)
+    from spark_rapids_tpu.ops import misc as misc_ops
+    for name in ("NormalizeNaNAndZero", "KnownFloatingPointNormalized",
+                 "KnownNotNull", "AtLeastNNonNulls",
+                 "MonotonicallyIncreasingID", "SparkPartitionID", "Rand",
+                 "FromUTCTimestamp", "ToUTCTimestamp", "Md5", "ConcatWs"):
+        reg(getattr(misc_ops, name))
     from spark_rapids_tpu.ops import collections as coll
     reg(coll.Size)
     reg(coll.GetArrayItem)
@@ -186,7 +192,8 @@ def _tag_filter(meta, conf):
 
 
 def _tag_aggregate(meta, conf):
-    _check_output_schema(meta, conf)
+    # collect_list/set emit fixed-element arrays
+    _check_output_schema(meta, conf, COMMON_PLUS_ARRAYS)
     node: P.Aggregate = meta.node
     for g in node.grouping:
         check_expr(g, conf, meta.reasons, "grouping key ")
@@ -335,8 +342,13 @@ def _convert_aggregate(node: P.Aggregate, children, conf):
         agg_specs = [(n, fn) for (n, _), fn in
                      zip(agg_specs, exprs[len(grouping):])]
     # target-size coalesce (NOT RequireSingleBatch): inputs above the batch
-    # target stream through the partial-per-batch merge path
-    coalesced = TpuCoalesceExec(child, target_bytes=conf.batch_size_bytes)
+    # target stream through the partial-per-batch merge path. Collect/
+    # percentile have no merge decomposition yet -> one coalesced batch.
+    from spark_rapids_tpu.execs.aggregate import SORT_ONLY_AGGS
+    if any(isinstance(fn, SORT_ONLY_AGGS) for _, fn in agg_specs):
+        coalesced = TpuCoalesceExec(child, require_single=True)
+    else:
+        coalesced = TpuCoalesceExec(child, target_bytes=conf.batch_size_bytes)
     return TpuHashAggregateExec(coalesced, grouping, agg_specs,
                                 node.grouping_names,
                                 filters=filters,

@@ -157,6 +157,32 @@ def _agg_one(fn: agg.AggregateFunction, value: HostColumn, gid: np.ndarray,
         zero = np.zeros((), dtype=value.dtype.np_dtype).item()
         return HostColumn(value.dtype, np.where(validity, data, zero).astype(value.dtype.np_dtype), validity)
 
+    if isinstance(fn, (agg.CollectList, agg.CollectSet)):
+        out = np.empty(ngroups, dtype=object)
+        for g in range(ngroups):
+            out[g] = []
+        valid = value.validity
+        for i in range(n):
+            if valid[i]:
+                v = value.data[i]
+                out[gid[i]].append(v.item() if hasattr(v, "item") else v)
+        if isinstance(fn, agg.CollectSet):
+            for g in range(ngroups):
+                out[g] = sorted(set(out[g]))
+        return HostColumn(fn.data_type, out, np.ones(ngroups, dtype=np.bool_))
+
+    if isinstance(fn, agg.Percentile):
+        outv = np.zeros(ngroups)
+        validity = np.zeros(ngroups, dtype=np.bool_)
+        for g in range(ngroups):
+            vals = np.sort(value.data[(gid == g) & value.validity].astype(np.float64))
+            if len(vals):
+                k = (len(vals) - 1) * fn.percentage
+                lo, hi = int(np.floor(k)), int(np.ceil(k))
+                outv[g] = vals[lo] + (vals[hi] - vals[lo]) * (k - lo)
+                validity[g] = True
+        return HostColumn(T.DOUBLE, outv, validity)
+
     raise NotImplementedError(f"cpu aggregate {type(fn).__name__}")
 
 

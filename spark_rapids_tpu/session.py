@@ -92,6 +92,10 @@ class TpuSession:
         from spark_rapids_tpu.execs.base import set_metrics_level
         set_metrics_level(self.conf.get_entry(METRICS_LEVEL))
 
+        # rand(seed)/monotonically_increasing_id reproduce per query
+        from spark_rapids_tpu.ops.misc import reset_nondeterministic_streams
+        reset_nondeterministic_streams()
+
         # LORE: number every operator; arm input dumping for tagged ids
         from spark_rapids_tpu import lore
         lore.assign_lore_ids(executable)

@@ -128,3 +128,45 @@ def test_boolean_minmax(session, cpu_session):
         lambda s: _df(s, {"k": IntGen(min_val=0, max_val=5), "b": BooleanGen()})
         .group_by("k").agg(F.min(col("b")).alias("minb"), F.max(col("b")).alias("maxb")),
         session, cpu_session)
+
+
+def test_collect_list_set_percentile(session, cpu_session):
+    """collect_list / collect_set / exact percentile on device (sort-
+    segment path; reference: GpuCollectList/Set, GpuPercentile)."""
+    from tests.asserts import assert_runs_on_tpu
+    gens = {"k": StringGen(cardinality=5),
+            "v": IntGen(min_val=-30, max_val=30, null_prob=0.2),
+            "d": DoubleGen(corner_prob=0.0)}
+
+    def build(s):
+        return _df(s, gens, n=300).group_by("k").agg(
+            F.collect_list(col("v")).alias("cl"),
+            F.collect_set(col("v")).alias("cs"),
+            F.percentile(col("d"), 0.5).alias("med"),
+            F.percentile(col("d"), 0.9).alias("p90"),
+        )
+
+    assert_runs_on_tpu(build, session)
+    tpu = build(session).collect_table().to_pydict()
+    cpu = build(cpu_session).collect_table().to_pydict()
+    tkey = sorted(range(len(tpu["k"])), key=lambda i: str(tpu["k"][i]))
+    ckey = sorted(range(len(cpu["k"])), key=lambda i: str(cpu["k"][i]))
+    for ti, ci in zip(tkey, ckey):
+        assert tpu["k"][ti] == cpu["k"][ci]
+        # list preserves input order; set is value-sorted on both paths
+        assert tpu["cl"][ti] == cpu["cl"][ci]
+        assert tpu["cs"][ti] == sorted(set(cpu["cs"][ci]))
+        for name in ("med", "p90"):
+            a, b = tpu[name][ti], cpu[name][ci]
+            assert (a is None) == (b is None)
+            if a is not None:
+                assert abs(a - b) <= 1e-9 * max(1.0, abs(b)), (name, a, b)
+
+
+def test_collect_list_empty_groups(session, cpu_session):
+    """All-null value groups produce EMPTY arrays, not null."""
+    gens = {"k": StringGen(cardinality=3),
+            "v": IntGen(null_prob=1.0)}  # every value null
+    tpu = _df(session, gens, n=60).group_by("k").agg(
+        F.collect_list(col("v")).alias("cl")).collect_table().to_pydict()
+    assert all(x == [] for x in tpu["cl"])

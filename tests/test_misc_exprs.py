@@ -1,0 +1,107 @@
+"""Misc expression batch: nondeterministic ids/rand, normalization markers,
+timezone shifts (fixed-offset device subset), md5, concat_ws."""
+
+import datetime as dt
+
+import pytest
+
+from spark_rapids_tpu import functions as F
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.ops.expr import col, lit
+from spark_rapids_tpu.plan import from_host_table
+
+from tests.asserts import (
+    assert_falls_back,
+    assert_runs_on_tpu,
+    assert_tpu_and_cpu_are_equal,
+)
+from tests.data_gen import DoubleGen, IntGen, StringGen, gen_table
+
+
+def _df(sess, n=300, seed=8):
+    gens = {"x": IntGen(min_val=-50, max_val=50),
+            "d": DoubleGen(), "s": StringGen(cardinality=6)}
+    return from_host_table(gen_table(gens, n, seed), sess)
+
+
+def test_monotonic_id_and_partition_id(session):
+    out = _df(session).select(
+        F.monotonically_increasing_id().alias("id"),
+        F.spark_partition_id().alias("p"), "x").collect()
+    ids = [r[0] for r in out]
+    assert ids == sorted(ids) and len(set(ids)) == len(ids)
+    assert all(r[1] == 0 for r in out)
+
+
+def test_rand_bit_identical_to_cpu(session, cpu_session):
+    assert_tpu_and_cpu_are_equal(
+        lambda s: _df(s).select("x", F.rand(seed=7).alias("r")),
+        session, cpu_session, ignore_order=False)
+
+
+def test_normalize_nan_and_zero(session, cpu_session):
+    from spark_rapids_tpu.ops.misc import NormalizeNaNAndZero
+    assert_tpu_and_cpu_are_equal(
+        lambda s: _df(s).select(
+            NormalizeNaNAndZero(col("d")).alias("n")),
+        session, cpu_session)
+
+
+def test_at_least_n_non_nulls(session, cpu_session):
+    from spark_rapids_tpu.ops.misc import AtLeastNNonNulls
+    gens = {"a": IntGen(null_prob=0.4), "b": IntGen(null_prob=0.4)}
+    assert_tpu_and_cpu_are_equal(
+        lambda s: from_host_table(gen_table(gens, 200, 2), s).select(
+            AtLeastNNonNulls(2, col("a"), col("b")).alias("ok")),
+        session, cpu_session)
+
+
+def test_md5(session, cpu_session):
+    import hashlib
+    build = lambda s: _df(s).select("s", F.md5(col("s")).alias("h"))  # noqa: E731
+    assert_runs_on_tpu(build, session)
+    out = build(session).collect()
+    for s, h in out:
+        if s is not None:
+            assert h == hashlib.md5(s.encode()).hexdigest()
+
+
+def test_concat_ws_skips_nulls(session, cpu_session):
+    gens = {"s": StringGen(cardinality=5, nullable=True)}
+    build = lambda s: from_host_table(gen_table(gens, 150, 3), s).select(  # noqa: E731
+        F.concat_ws("-", lit("a"), col("s"), lit("z")).alias("c"))
+    assert_runs_on_tpu(build, session)
+    out = build(session).collect()
+    ref = [(f"a-{s}-z" if s is not None else "a-z",)
+           for (s,) in from_host_table(
+               gen_table(gens, 150, 3), session).collect()]
+    assert out == ref
+
+
+def test_timezone_fixed_offset_on_device(session, cpu_session):
+    base = dt.datetime(2024, 3, 1, 12, 0, 0)
+    table = {"t": [base + dt.timedelta(hours=i) for i in range(48)]}
+    def build(s):
+        df = s.create_dataframe(table, {"t": T.TIMESTAMP})
+        return df.select(
+            F.from_utc_timestamp(col("t"), lit("+05:30")).alias("ist"),
+            F.to_utc_timestamp(col("t"), lit("GMT-8")).alias("utc8"))
+    assert_runs_on_tpu(build, session)
+    assert_tpu_and_cpu_are_equal(build, session, cpu_session)
+    out = build(session).collect()
+    assert out[0][0] == base + dt.timedelta(hours=5, minutes=30)
+    assert out[0][1] == base + dt.timedelta(hours=8)
+
+
+def test_timezone_named_zone_falls_back(session):
+    base = dt.datetime(2024, 7, 1, 12, 0, 0)
+    table = {"t": [base]}
+    def build(s):
+        df = s.create_dataframe(table, {"t": T.TIMESTAMP})
+        return df.select(
+            F.from_utc_timestamp(col("t"),
+                                 lit("America/New_York")).alias("et"))
+    assert_falls_back(build, session, "Project")
+    out = build(session).collect()
+    # EDT in July: UTC-4
+    assert out[0][0] == base - dt.timedelta(hours=4)

@@ -115,16 +115,49 @@ def test_window_runs_on_tpu(session):
             sm=F.sum("v").over(W_KO())), session)
 
 
-def test_bounded_min_falls_back(session):
+def test_bounded_minmax_runs_on_device(session, cpu_session):
+    """Bounded rows min/max frames run on device via the sparse-table RMQ
+    (GpuBatchedBoundedWindowExec analog; was an r1 fallback carve-out)."""
     from spark_rapids_tpu.overrides import wrap_plan
-    host = _t(50)
+    host = _t(80)
     df = session.create_dataframe(host).with_windows(
-        bm=F.min("v").over(W_KO().rows_between(-2, 2)))
+        bm=F.min("v").over(W_KO().rows_between(-2, 2)),
+        bx=F.max("v").over(W_KO().rows_between(-3, 1)),
+        lead_min=F.min("v").over(W_KO().rows_between(1, 4)),
+        tail_max=F.max("v").over(W_KO().rows_between(-1, None)),
+        head_min=F.min("v").over(W_KO().rows_between(None, 2)),
+    )
     meta = wrap_plan(df.plan, session.conf)
-    assert not meta.can_run_on_tpu
-    assert any("bounded rows min/max" in r for r in meta.reasons)
-    # CPU fallback still answers
-    assert df.count() == 50
+    assert meta.can_run_on_tpu, meta.explain(only_fallback=False)
+
+    def build(s):
+        return s.create_dataframe(host).with_windows(
+            bm=F.min("v").over(W_KO().rows_between(-2, 2)),
+            bx=F.max("v").over(W_KO().rows_between(-3, 1)),
+            lead_min=F.min("v").over(W_KO().rows_between(1, 4)),
+            tail_max=F.max("v").over(W_KO().rows_between(-1, None)),
+            head_min=F.min("v").over(W_KO().rows_between(None, 2)),
+        )
+    assert_tpu_and_cpu_are_equal(build, session, cpu_session)
+
+
+def test_wide_float_bounded_sum_runs_on_device(session, cpu_session):
+    """Float both-bounded frames wider than the exact unroll window use
+    segmented-prefix differences (was an r1 fallback carve-out)."""
+    # corner-free doubles: +/-1e30 corners make prefix-difference sums
+    # diverge from direct per-frame sums by design (variableFloatAgg class)
+    host = gen_table({"k": IntGen(min_val=0, max_val=8),
+                      "o": LongGen(min_val=-100, max_val=100),
+                      "d": DoubleGen(corner_prob=0.0)}, 2000, seed=4)
+    def build(s):
+        return s.create_dataframe(host).with_windows(
+            ws=F.sum("d").over(W_KO().rows_between(-600, 600)),
+            wa=F.avg("d").over(W_KO().rows_between(-700, 10)))
+    from spark_rapids_tpu.overrides import wrap_plan
+    meta = wrap_plan(build(session).plan, session.conf)
+    assert meta.can_run_on_tpu, meta.explain(only_fallback=False)
+    assert_tpu_and_cpu_are_equal(build, session, cpu_session,
+                                 approximate_float=True)
 
 
 def test_mixed_specs_stay_aligned(session, cpu_session):
@@ -157,4 +190,17 @@ def test_window_then_filter_pipeline(session, cpu_session):
                 .with_windows(rn=F.row_number().over(W_KO()))
                 .filter(col("rn") <= 3)
                 .select("k", "o", "rn"))
+    assert_tpu_and_cpu_are_equal(build, session, cpu_session)
+
+
+def test_percent_rank_and_nth_value(session, cpu_session):
+    host = _t(300)
+    def build(s):
+        return s.create_dataframe(host).with_windows(
+            pr=F.percent_rank().over(W_KO()),
+            nv=F.nth_value("v", 2).over(W_KO()),
+            nv5=F.nth_value("v", 5).over(W_KO()))
+    from spark_rapids_tpu.overrides import wrap_plan
+    meta = wrap_plan(build(session).plan, session.conf)
+    assert meta.can_run_on_tpu, meta.explain(only_fallback=False)
     assert_tpu_and_cpu_are_equal(build, session, cpu_session)
