@@ -236,10 +236,11 @@ class DeviceColumn:
         if got is not None:
             return got
         vals = np.where(host.validity, host.data, "")
-        # np.unique on object arrays of str sorts lexicographically by
-        # code point; return_inverse gives the codes directly.
-        dictionary, codes = np.unique(vals.astype(object), return_inverse=True)
-        got = (codes.astype(np.int32), dictionary)
+        # hash-dedupe + (native UTF-32 sort | numpy argsort) — 5-6x the old
+        # np.unique-over-objects; order is code-point order == UTF-8 byte
+        # order either way (spark_rapids_tpu/native.py)
+        from spark_rapids_tpu.native import encode_sorted_dict
+        got = encode_sorted_dict(np.asarray(vals, dtype=object))
         host._cache["encode"] = got
         return got
 

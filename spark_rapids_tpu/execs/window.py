@@ -57,7 +57,8 @@ from spark_rapids_tpu.ops.expr import (
 DEVICE_WINDOW_AGGS = (agg.Sum, agg.Count, agg.Min, agg.Max, agg.Average)
 
 
-def device_window_supported(w: WindowExpression) -> Tuple[bool, str]:
+def device_window_supported(w: WindowExpression,
+                            variable_float_agg: bool = True) -> Tuple[bool, str]:
     fn = w.function
     frame = w.spec.resolved_frame()
     if isinstance(fn, (RowNumber, Rank, DenseRank, PercentRank)):
@@ -86,6 +87,13 @@ def device_window_supported(w: WindowExpression) -> Tuple[bool, str]:
                 if bound is not None and abs(bound) > (1 << 16):
                     return False, ("rows frame bound beyond 65536 is not "
                                    "supported on TPU")
+            if (lo is not None and hi is not None and (hi - lo + 1) > 512
+                    and isinstance(fn, (agg.Sum, agg.Average))
+                    and isinstance(fn.data_type, (T.FloatType, T.DoubleType))
+                    and not variable_float_agg):
+                return False, ("wide float rows frame uses prefix-difference "
+                               "sums (reduction-order variance); enable "
+                               "spark.rapids.sql.variableFloatAgg.enabled")
         return True, ""
     return False, f"window function {type(fn).__name__} is not supported on TPU"
 
@@ -418,9 +426,11 @@ class TpuWindowExec(TpuExec):
                     num_segments=capacity)[gid]
                 a = seg_start if lo is None else jnp.maximum(seg_start, idx + lo)
                 b = seg_end if hi is None else jnp.minimum(seg_end, idx + hi)
+                # emptiness must be judged BEFORE clipping into the index
+                # range (clipping turns an empty edge frame into a 1-row one)
+                nonempty = (b >= a) & s_live
                 a = jnp.clip(a, 0, capacity - 1)
                 b = jnp.clip(b, 0, capacity - 1)
-                nonempty = (b >= a) & s_live
                 new_seg = seg_start == idx
 
                 prefc = _segmented_scan(jnp.add, sv.astype(jnp.int32), new_seg)
@@ -471,9 +481,11 @@ class TpuWindowExec(TpuExec):
                                           num_segments=capacity)[gid]
             a = seg_start if lo is None else jnp.maximum(seg_start, idx + lo)
             b = seg_end if hi is None else jnp.minimum(seg_end, idx + hi)
+            # emptiness judged BEFORE clipping (empty edge frames must
+            # stay empty)
+            nonempty = b >= a
             a = jnp.clip(a, 0, capacity - 1)
             b = jnp.clip(b, 0, capacity - 1)
-            nonempty = b >= a
             is_float = jnp.issubdtype(v.dtype, jnp.floating)
 
             # counts (int, exact) always go prefix-diff

@@ -264,7 +264,9 @@ class Rand(Expression):
         return False
 
     def key(self):
-        return ("rand", self.seed, id(self._rng))
+        # id(self): unique per instance but STABLE across reset_stream()
+        # (an id on the rng object would re-trace every query)
+        return ("rand", self.seed, id(self))
 
     def with_children(self, children):
         return self
@@ -360,16 +362,17 @@ class _TzShift(Expression):
             zone = ZoneInfo(str(self.children[1].value))
             out = np.zeros(len(c), dtype=np.int64)
             epoch = _dt.datetime(1970, 1, 1, tzinfo=_dt.timezone.utc)
+            one_us = _dt.timedelta(microseconds=1)
             for i in range(len(c)):
                 if c.validity[i]:
                     ts = epoch + _dt.timedelta(microseconds=int(c.data[i]))
                     if self.to_utc:
                         local = ts.replace(tzinfo=zone)
-                        out[i] = int((local - epoch).total_seconds() * 1e6)
+                        out[i] = (local - epoch) // one_us
                     else:
                         shifted = ts.astimezone(zone)
                         naive = shifted.replace(tzinfo=_dt.timezone.utc)
-                        out[i] = int((naive - epoch).total_seconds() * 1e6)
+                        out[i] = (naive - epoch) // one_us
             return HostColumn(T.TIMESTAMP, out, c.validity.copy())
         delta = -off if self.to_utc else off
         return HostColumn(T.TIMESTAMP, c.data + delta, c.validity.copy())

@@ -192,17 +192,27 @@ def _tag_filter(meta, conf):
 
 
 def _tag_aggregate(meta, conf):
-    # collect_list/set emit fixed-element arrays
+    # collect_list/set OUTPUT fixed-element arrays; array-typed grouping
+    # keys / other agg inputs stay CPU (flat-buffer kernels)
     _check_output_schema(meta, conf, COMMON_PLUS_ARRAYS)
     node: P.Aggregate = meta.node
     for g in node.grouping:
         check_expr(g, conf, meta.reasons, "grouping key ")
+        if isinstance(g.data_type, T.ArrayType):
+            meta.reasons.append("array-typed grouping keys are not "
+                                "supported on TPU")
+    from spark_rapids_tpu.execs.aggregate import SORT_ONLY_AGGS
     for name, fn in node.agg_specs:
         if not isinstance(fn, DEVICE_SUPPORTED_AGGS):
             meta.reasons.append(f"aggregate {type(fn).__name__} is not supported on TPU")
             continue
         if fn.child is not None:
             check_expr(fn.child, conf, meta.reasons, f"aggregate {name} input ")
+            if isinstance(fn.child.data_type, T.ArrayType) and not isinstance(
+                    fn, (agg.CollectList, agg.CollectSet)):
+                meta.reasons.append(
+                    f"aggregate {name} over an array input is not "
+                    "supported on TPU")
 
 
 def _tag_sort(meta, conf):
@@ -483,8 +493,10 @@ def _tag_window(meta, conf):
     from spark_rapids_tpu.execs.window import device_window_supported
     _check_output_schema(meta, conf)
     node: P.WindowNode = meta.node
+    from spark_rapids_tpu.conf import IMPROVED_FLOAT_OPS
+    vfa = bool(conf.get_entry(IMPROVED_FLOAT_OPS))
     for name, w in node.window_cols:
-        ok, reason = device_window_supported(w)
+        ok, reason = device_window_supported(w, variable_float_agg=vfa)
         if not ok:
             meta.reasons.append(f"window {name}: {reason}")
             continue

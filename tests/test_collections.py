@@ -121,3 +121,19 @@ def test_array_multi_batch(session, cpu_session):
     assert_tpu_and_cpu_are_equal(
         lambda s: _df(s, nb=3).select("id", F.explode(col("a")).alias("e")),
         session, cpu_session)
+
+
+def test_array_grouping_key_falls_back(session):
+    """Grouping BY an array column is unsupported on device; results come
+    from the CPU path (code-review r2: loosened schema check leak)."""
+    from tests.asserts import assert_falls_back
+    assert_falls_back(
+        lambda s: _df(s).group_by("a").agg(F.count().alias("c")),
+        session, "Aggregate")
+
+
+def test_first_over_array_input_falls_back(session):
+    from tests.asserts import assert_falls_back
+    assert_falls_back(
+        lambda s: _df(s).group_by("id").agg(F.first(col("a")).alias("f")),
+        session, "Aggregate")

@@ -204,3 +204,15 @@ def test_percent_rank_and_nth_value(session, cpu_session):
     meta = wrap_plan(build(session).plan, session.conf)
     assert meta.can_run_on_tpu, meta.explain(only_fallback=False)
     assert_tpu_and_cpu_are_equal(build, session, cpu_session)
+
+
+def test_empty_edge_frames_are_null(session, cpu_session):
+    """Frames that are empty at partition edges must yield NULL, not a
+    clipped 1-row frame (code-review r2: clip-before-emptiness bug)."""
+    host = _t(120)
+    def build(s):
+        return s.create_dataframe(host).with_windows(
+            trail=F.min("v").over(W_KO().rows_between(None, -2)),
+            ahead=F.sum("v").over(W_KO().rows_between(5, 7)),
+            tcnt=F.count("v").over(W_KO().rows_between(None, -2)))
+    assert_tpu_and_cpu_are_equal(build, session, cpu_session)
