@@ -2,18 +2,31 @@
 GpuTextBasedPartitionReader — SURVEY.md §2.4: CPU line splitting + parse).
 
 The reference splits lines on CPU and parses on device; for the TPU build
-the Arrow CSV parser is the host decode and the parsed columns upload as one
-batch. Schema may be supplied (Spark-style) or inferred by Arrow."""
+the Arrow CSV parser is the host decode and the parsed columns upload as
+one batch. The SPARK OPTIONS MATRIX is honored (GpuCSVScan's tagging
+checks; options Arrow cannot express are emulated or rejected loudly,
+never silently ignored):
+
+  sep/delimiter, quote, escape, header, comment (line pre-filter),
+  nullValue/emptyValue, nanValue/positiveInf/negativeInf (custom float
+  spellings parse via string + host convert), dateFormat/timestampFormat
+  (Spark pattern -> strptime translation for the common tokens),
+  ignoreLeadingWhiteSpace/ignoreTrailingWhiteSpace,
+  mode = PERMISSIVE | DROPMALFORMED | FAILFAST.
+"""
 
 from __future__ import annotations
 
+import io as _io
 from typing import List, Optional, Sequence
 
+import numpy as np
 import pyarrow as pa
 import pyarrow.csv as pcsv
 
-from spark_rapids_tpu.columnar import HostTable
+from spark_rapids_tpu.columnar import HostColumn, HostTable
 from spark_rapids_tpu.conf import RapidsConf, str_conf
+from spark_rapids_tpu import types as T
 from spark_rapids_tpu.io.arrow_convert import (
     arrow_schema_to_spark,
     decode_to_schema,
@@ -28,21 +41,79 @@ CSV_READER_TYPE = str_conf(
     "spark.rapids.sql.format.csv.reader.type", "AUTO",
     "PERFILE, COALESCING, MULTITHREADED or AUTO.")
 
+#: Spark datetime pattern tokens -> strptime (the common subset the
+#: reference's tagging accepts; any other LETTER RUN raises loudly — runs
+#: are matched exactly, so e.g. MMMM cannot half-translate)
+_PATTERN_TOKENS = {
+    "yyyy": "%Y", "yy": "%y", "MM": "%m", "dd": "%d",
+    "HH": "%H", "mm": "%M", "ss": "%S", "SSSSSS": "%f",
+    "SSS": "%f", "a": "%p",
+}
+
+import re as _re
+
+
+def spark_pattern_to_strptime(pattern: str) -> str:
+    out = []
+    for piece in _re.split(r"([A-Za-z]+)", pattern):
+        if piece and piece[0].isalpha():
+            rep = _PATTERN_TOKENS.get(piece)
+            if rep is None:
+                raise ValueError(
+                    f"datetime pattern {pattern!r}: token {piece!r} is "
+                    "outside the supported subset "
+                    f"({' '.join(_PATTERN_TOKENS)})")
+            out.append(rep)
+        else:
+            out.append(piece)
+    return "".join(out)
+
 
 class CsvScanNode(FileScanNode):
     format_name = "csv"
 
     def __init__(self, paths, conf: RapidsConf, columns=None, reader_type=None,
                  schema: Optional[Schema] = None, header: bool = True,
-                 delimiter: str = ",", **options):
+                 delimiter: str = ",", sep: Optional[str] = None,
+                 quote: str = '"', escape: Optional[str] = None,
+                 comment: Optional[str] = None,
+                 null_value: str = "", empty_value: Optional[str] = None,
+                 nan_value: str = "NaN",
+                 positive_inf: str = "Inf", negative_inf: str = "-Inf",
+                 timestamp_format: Optional[str] = None,
+                 ignore_leading_whitespace: bool = False,
+                 ignore_trailing_whitespace: bool = False,
+                 mode: str = "PERMISSIVE", **options):
         self.user_schema = schema
         self.header = header
-        self.delimiter = delimiter
+        self.delimiter = sep if sep is not None else delimiter
+        self.quote = quote
+        self.escape = escape
+        self.comment = comment
+        self.null_value = null_value
+        self.empty_value = empty_value
+        self.nan_value = nan_value
+        self.positive_inf = positive_inf
+        self.negative_inf = negative_inf
+        self.timestamp_format = timestamp_format
+        self.ignore_leading_ws = ignore_leading_whitespace
+        self.ignore_trailing_ws = ignore_trailing_whitespace
+        self.mode = str(mode).upper()
+        if self.mode not in ("PERMISSIVE", "DROPMALFORMED", "FAILFAST"):
+            raise ValueError(f"unknown CSV mode {mode!r}")
+        if len(self.delimiter) != 1:
+            raise ValueError("CSV sep must be a single character")
         super().__init__(paths, conf, columns=columns, reader_type=reader_type,
                          **options)
 
     def _conf_reader_type(self) -> str:
         return self.conf.get_entry(CSV_READER_TYPE)
+
+    # -- option plumbing ----------------------------------------------------
+    @property
+    def _custom_floats(self) -> bool:
+        return (self.nan_value != "NaN" or self.positive_inf != "Inf"
+                or self.negative_inf != "-Inf")
 
     def _read_opts(self):
         read_opts = pcsv.ReadOptions()
@@ -51,11 +122,43 @@ class CsvScanNode(FileScanNode):
                 raise ValueError("headerless CSV requires an explicit schema")
             read_opts = pcsv.ReadOptions(
                 column_names=[n for n, _ in self.user_schema])
-        parse_opts = pcsv.ParseOptions(delimiter=self.delimiter)
-        convert = None
+        parse_opts = pcsv.ParseOptions(
+            delimiter=self.delimiter,
+            quote_char=self.quote if self.quote else False,
+            escape_char=self.escape if self.escape else False,
+            double_quote=self.escape is None,
+        )
+        if self.mode in ("DROPMALFORMED", "PERMISSIVE"):
+            # arrow cannot null-fill ragged rows; skipping is the closest
+            # behavior for PERMISSIVE and exact for DROPMALFORMED
+            parse_opts.invalid_row_handler = lambda row: "skip"
+
+        null_values = [self.null_value]
+        if self.empty_value is not None:
+            null_values.append(self.empty_value)
+        types = {}
+        timestamp_parsers = None
         if self.user_schema:
-            convert = pcsv.ConvertOptions(column_types={
-                n: spark_type_to_arrow(dt) for n, dt in self.user_schema})
+            for n, dt in self.user_schema:
+                if isinstance(dt, (T.FloatType, T.DoubleType)) \
+                        and self._custom_floats:
+                    types[n] = pa.string()  # host converts spellings below
+                elif isinstance(dt, T.TimestampType):
+                    # parse naive (no zone column in CSV); values are
+                    # UTC-epoch micros like Spark's session-UTC convention
+                    types[n] = pa.timestamp("us")
+                else:
+                    types[n] = spark_type_to_arrow(dt)
+        if self.timestamp_format:
+            timestamp_parsers = [
+                spark_pattern_to_strptime(self.timestamp_format)]
+        convert = pcsv.ConvertOptions(
+            column_types=types or None,
+            null_values=null_values,
+            strings_can_be_null=True,
+            quoted_strings_can_be_null=False,
+            timestamp_parsers=timestamp_parsers or None,
+        )
         return read_opts, parse_opts, convert
 
     def file_schema(self, path: str) -> Schema:
@@ -63,13 +166,87 @@ class CsvScanNode(FileScanNode):
             return list(self.user_schema)
         return arrow_schema_to_spark(self._read_arrow(path).schema)
 
+    def _load_bytes(self, path: str) -> bytes:
+        # comment filtering is LINE-based; quoted fields spanning newlines
+        # are already unsupported by the parser config (newlines_in_values
+        # stays False), so a dropped continuation line fails parsing loudly
+        # rather than corrupting rows
+        with open(path, "rb") as f:
+            data = f.read()
+        cb = self.comment.encode()
+        lines = [ln for ln in data.split(b"\n")
+                 if not ln.lstrip().startswith(cb)]
+        return b"\n".join(lines)
+
     def _read_arrow(self, path: str) -> pa.Table:
         read_opts, parse_opts, convert = self._read_opts()
-        return pcsv.read_csv(path, read_options=read_opts,
-                             parse_options=parse_opts, convert_options=convert)
+        # stream straight from the file unless the comment pre-filter
+        # requires materializing the text
+        source = (_io.BytesIO(self._load_bytes(path)) if self.comment
+                  else path)
+        return pcsv.read_csv(source,
+                             read_options=read_opts,
+                             parse_options=parse_opts,
+                             convert_options=convert)
 
     def read_file(self, path: str) -> HostTable:
-        return decode_to_schema(self._read_arrow(path), self.data_schema)
+        tbl = self._read_arrow(path)
+        host = decode_to_schema(tbl, self._pre_float_schema())
+        return self._post_process(host)
+
+    def _pre_float_schema(self) -> Schema:
+        """Schema for the arrow decode: custom-float columns arrive as
+        STRING and convert in _post_process."""
+        if not (self.user_schema and self._custom_floats):
+            return self.data_schema
+        fcols = {n for n, dt in self.user_schema
+                 if isinstance(dt, (T.FloatType, T.DoubleType))}
+        return [(n, T.STRING if n in fcols else dt)
+                for n, dt in self.data_schema]
+
+    def _post_process(self, host: HostTable) -> HostTable:
+        cols = list(host.columns)
+        names = list(host.names)
+        target = dict(self.data_schema)
+        for i, (n, c) in enumerate(zip(names, cols)):
+            if isinstance(c.dtype, T.StringType) and (
+                    self.ignore_leading_ws or self.ignore_trailing_ws):
+                data = c.data.copy()
+                for j in range(len(data)):
+                    if c.validity[j] and data[j] is not None:
+                        if self.ignore_leading_ws:
+                            data[j] = data[j].lstrip()
+                        if self.ignore_trailing_ws:
+                            data[j] = data[j].rstrip()
+                c = HostColumn(T.STRING, data, c.validity.copy())
+            want = target.get(n)
+            if isinstance(c.dtype, T.StringType) and isinstance(
+                    want, (T.FloatType, T.DoubleType)) and self._custom_floats:
+                c = self._convert_custom_floats(c, want)
+            cols[i] = c
+        return HostTable(names, cols)
+
+    def _convert_custom_floats(self, c: HostColumn, dt) -> HostColumn:
+        specials = {self.nan_value: np.nan, self.positive_inf: np.inf,
+                    self.negative_inf: -np.inf}
+        out = np.zeros(len(c), dtype=dt.np_dtype)
+        validity = np.zeros(len(c), dtype=np.bool_)
+        for i in range(len(c)):
+            if not c.validity[i] or c.data[i] is None:
+                continue
+            s = c.data[i].strip()
+            if s in specials:
+                out[i] = specials[s]
+                validity[i] = True
+            else:
+                try:
+                    out[i] = float(s)
+                    validity[i] = True
+                except ValueError:
+                    if self.mode == "FAILFAST":
+                        raise ValueError(
+                            f"malformed float {s!r} (FAILFAST mode)")
+        return HostColumn(dt, out, validity)
 
 
 def write_csv(table: HostTable, path: str,
@@ -78,4 +255,5 @@ def write_csv(table: HostTable, path: str,
     def _write_one(tbl: HostTable, file_path: str):
         opts = pcsv.WriteOptions(include_header=header)
         pcsv.write_csv(host_table_to_arrow(tbl), file_path, opts)
+
     return write_partitioned(table, path, _write_one, "csv", partition_by)

@@ -615,10 +615,12 @@ def convert_plan(meta: PlanMeta):
 
 
 def apply_overrides(plan: P.PlanNode, conf: RapidsConf):
-    """GpuOverrides.apply analog: tag + convert (or explain-only)."""
+    """GpuOverrides.apply analog: tag + CBO + convert (or explain-only)."""
     if not conf.sql_enabled:
         return plan, None
     meta = wrap_plan(plan, conf)
+    from spark_rapids_tpu.overrides.optimizer import apply_cbo
+    apply_cbo(meta, conf)
     if conf.is_explain_only:
         return plan, meta
     return convert_plan(meta), meta

@@ -1,0 +1,65 @@
+"""Cost-based optimizer (CostBasedOptimizer.scala analog): small plans
+revert to CPU when the device doesn't pay for its overhead."""
+
+from spark_rapids_tpu import functions as F
+from spark_rapids_tpu.ops.expr import col, lit
+from spark_rapids_tpu.plan import from_host_table
+
+from tests.data_gen import IntGen, StringGen, gen_table
+
+
+def _session(extra=None):
+    from spark_rapids_tpu.session import TpuSession
+    conf = {"spark.rapids.sql.optimizer.enabled": "true"}
+    conf.update(extra or {})
+    return TpuSession(conf)
+
+
+def _plan_on_device(session, df) -> bool:
+    from spark_rapids_tpu.overrides import wrap_plan
+    from spark_rapids_tpu.overrides.optimizer import apply_cbo
+    meta = wrap_plan(df.plan, session.conf)
+    apply_cbo(meta, session.conf)
+    return meta.can_run_on_tpu
+
+
+def test_tiny_plan_reverts_to_cpu(cpu_session):
+    s = _session()
+    df = from_host_table(gen_table({"x": IntGen()}, 50, 1), s) \
+        .filter(col("x") > lit(0))
+    assert not _plan_on_device(s, df)
+    # the reason names CBO, and results still come out right
+    from spark_rapids_tpu.overrides import wrap_plan
+    from spark_rapids_tpu.overrides.optimizer import apply_cbo
+    meta = wrap_plan(df.plan, s.conf)
+    apply_cbo(meta, s.conf)
+    assert any("CBO" in r for r in meta.reasons)
+    assert df.count() == sum(
+        1 for v in gen_table({"x": IntGen()}, 50, 1)
+        .columns[0].to_pylist() if v is not None and v > 0)
+
+
+def test_large_plan_stays_on_device():
+    s = _session()
+    df = from_host_table(gen_table({"x": IntGen()}, 2_000_000, 1), s) \
+        .filter(col("x") > lit(0))
+    assert _plan_on_device(s, df)
+
+
+def test_disabled_by_default(session):
+    df = from_host_table(gen_table({"x": IntGen()}, 50, 1), session) \
+        .filter(col("x") > lit(0))
+    from spark_rapids_tpu.overrides import wrap_plan
+    meta = wrap_plan(df.plan, session.conf)
+    from spark_rapids_tpu.overrides.optimizer import apply_cbo
+    apply_cbo(meta, session.conf)
+    assert meta.can_run_on_tpu
+
+
+def test_unknown_stats_left_alone():
+    s = _session()
+    # joins have no row estimate -> CBO must not touch the plan
+    left = from_host_table(gen_table({"k": IntGen(min_val=0, max_val=5)}, 40, 1), s)
+    right = from_host_table(gen_table({"k": IntGen(min_val=0, max_val=5)}, 20, 2), s)
+    df = left.join(right, on="k", how="inner")
+    assert _plan_on_device(s, df)

@@ -1,0 +1,121 @@
+"""CSV/JSON Spark options matrix (reference analog: GpuCSVScan /
+GpuJsonScan tagging + csv_test.py / json_test.py option coverage)."""
+
+import os
+
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.ops.expr import col, lit
+
+
+def _write(tmp_path, name, text):
+    p = os.path.join(tmp_path, name)
+    with open(p, "w") as f:
+        f.write(text)
+    return p
+
+
+def test_csv_sep_quote_comment_null(session, tmp_path):
+    p = _write(tmp_path, "t.csv",
+               "# a comment line\n"
+               "a;b;c\n"
+               "1;'x;y';NA\n"
+               "# mid comment\n"
+               "2;z;7\n")
+    df = session.read_csv(
+        p, sep=";", quote="'", comment="#", null_value="NA",
+        schema=[("a", T.INT), ("b", T.STRING), ("c", T.INT)])
+    rows = df.collect()
+    assert rows == [(1, "x;y", None), (2, "z", 7)]
+
+
+def test_csv_custom_float_spellings(session, tmp_path):
+    p = _write(tmp_path, "f.csv", "x\nbad\n1.5\nP_INF\nN_INF\n")
+    df = session.read_csv(
+        p, nan_value="bad", positive_inf="P_INF", negative_inf="N_INF",
+        schema=[("x", T.DOUBLE)])
+    import math
+    vals = [r[0] for r in df.collect()]
+    assert math.isnan(vals[0]) and vals[1] == 1.5
+    assert vals[2] == math.inf and vals[3] == -math.inf
+
+
+def test_csv_headerless_and_whitespace(session, tmp_path):
+    p = _write(tmp_path, "h.csv", "1,  padded  \n2,x\n")
+    df = session.read_csv(
+        p, header=False, ignore_leading_whitespace=True,
+        ignore_trailing_whitespace=True,
+        schema=[("i", T.INT), ("s", T.STRING)])
+    assert df.collect() == [(1, "padded"), (2, "x")]
+
+
+def test_csv_dropmalformed(session, tmp_path):
+    p = _write(tmp_path, "m.csv", "a,b\n1,2\nonly_one_field\n3,4\n")
+    df = session.read_csv(p, mode="DROPMALFORMED",
+                          schema=[("a", T.INT), ("b", T.INT)])
+    assert df.collect() == [(1, 2), (3, 4)]
+
+
+def test_csv_timestamp_format(session, tmp_path):
+    p = _write(tmp_path, "d.csv", "t\n2024/01/15 10:30:00\n")
+    df = session.read_csv(
+        p, timestamp_format="yyyy/MM/dd HH:mm:ss",
+        schema=[("t", T.TIMESTAMP)])
+    import datetime as dt
+    assert df.collect()[0][0] == dt.datetime(2024, 1, 15, 10, 30)
+
+
+def test_csv_bad_pattern_rejected(session, tmp_path):
+    p = _write(tmp_path, "bad.csv", "t\nx\n")
+    with pytest.raises(Exception, match="pattern"):
+        session.read_csv(p, timestamp_format="QQQ-weird",
+                         schema=[("t", T.TIMESTAMP)]).collect()
+
+
+def test_json_multiline_array(session, tmp_path):
+    p = _write(tmp_path, "m.json",
+               '[{"a": 1, "b": "x"},\n {"a": 2, "b": "y"}]')
+    df = session.read_json(p, multi_line=True,
+                           schema=[("a", T.LONG), ("b", T.STRING)])
+    assert df.collect() == [(1, "x"), (2, "y")]
+
+
+def test_json_permissive_and_dropmalformed(session, tmp_path):
+    text = '{"a": 1}\nnot json at all\n{"a": 3}\n'
+    p1 = _write(tmp_path, "p.json", text)
+    df = session.read_json(p1, schema=[("a", T.LONG)])
+    assert [r[0] for r in df.collect()] == [1, None, 3]
+    df2 = session.read_json(p1, mode="DROPMALFORMED",
+                            schema=[("a", T.LONG)])
+    assert [r[0] for r in df2.collect()] == [1, 3]
+    with pytest.raises(Exception):
+        session.read_json(p1, mode="FAILFAST",
+                          schema=[("a", T.LONG)]).collect()
+
+
+def test_json_primitives_as_string(session, tmp_path):
+    p = _write(tmp_path, "s.json", '{"a": 1, "b": 2.5}\n{"a": 7, "b": 3}\n')
+    df = session.read_json(p, primitives_as_string=True)
+    rows = df.collect()
+    assert all(isinstance(v, str) for r in rows for v in r if v is not None)
+
+
+def test_csv_pattern_repeated_token_rejected(session, tmp_path):
+    p = _write(tmp_path, "mm.csv", "t\nJuly 04, 2026\n")
+    with pytest.raises(Exception, match="MMMM"):
+        session.read_csv(p, timestamp_format="MMMM dd, yyyy",
+                         schema=[("t", T.TIMESTAMP)]).collect()
+
+
+def test_json_multiline_malformed_modes(session, tmp_path):
+    p = _write(tmp_path, "bad.json", '[{"a": 1}, {"a": ')  # truncated
+    rows = session.read_json(p, multi_line=True,
+                             schema=[("a", T.LONG)]).collect()
+    assert rows == [(None,)]  # PERMISSIVE: one all-null row
+    rows = session.read_json(p, multi_line=True, mode="DROPMALFORMED",
+                             schema=[("a", T.LONG)]).collect()
+    assert rows == []
+    with pytest.raises(Exception):
+        session.read_json(p, multi_line=True, mode="FAILFAST",
+                          schema=[("a", T.LONG)]).collect()
