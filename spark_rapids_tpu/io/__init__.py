@@ -24,10 +24,12 @@ from spark_rapids_tpu.io.parquet import ParquetScanNode, write_parquet
 from spark_rapids_tpu.io.orc import OrcScanNode, write_orc
 from spark_rapids_tpu.io.csv import CsvScanNode, write_csv
 from spark_rapids_tpu.io.json import JsonScanNode, write_json
+from spark_rapids_tpu.io.hive_text import HiveTextScanNode, write_hive_text
 
 from spark_rapids_tpu.overrides.rules import register_file_scan as _register
 
-for _cls in (ParquetScanNode, OrcScanNode, CsvScanNode, JsonScanNode):
+for _cls in (ParquetScanNode, OrcScanNode, CsvScanNode, JsonScanNode,
+             HiveTextScanNode):
     _register(_cls)
 del _register, _cls
 

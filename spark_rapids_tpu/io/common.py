@@ -253,8 +253,30 @@ class FileScanNode(PlanNode):
             raise ColumnarProcessingError(f"unknown reader type {mode}")
         yield from it
 
+    def _cache_key_extra(self) -> tuple:
+        """Subclasses add every decode-affecting option here (named kwargs
+        consumed before **options never reach self.options)."""
+        return ()
+
+    def _cache_key(self) -> tuple:
+        return (type(self).__name__, tuple(self.columns or ()),
+                tuple(sorted((k, str(v)) for k, v in self.options.items())),
+                self._cache_key_extra())
+
+    def _read_decoded(self, path: str) -> HostTable:
+        from spark_rapids_tpu.io.filecache import (
+            FILE_CACHE,
+            FILECACHE_ENABLED,
+            FILECACHE_MAX_BYTES,
+        )
+        if not self.conf.get_entry(FILECACHE_ENABLED):
+            return self.read_file(path)
+        return FILE_CACHE.get_or_decode(
+            path, self._cache_key(), lambda: self.read_file(path),
+            self.conf.get_entry(FILECACHE_MAX_BYTES))
+
     def _read_with_partitions(self, path: str) -> HostTable:
-        return self._with_partition_columns(self.read_file(path), path)
+        return self._with_partition_columns(self._read_decoded(path), path)
 
     def _perfile(self) -> Iterator[HostTable]:
         for p in self.paths:

@@ -41,6 +41,8 @@ CSV_READER_TYPE = str_conf(
     "spark.rapids.sql.format.csv.reader.type", "AUTO",
     "PERFILE, COALESCING, MULTITHREADED or AUTO.")
 
+import re as _re
+
 #: Spark datetime pattern tokens -> strptime (the common subset the
 #: reference's tagging accepts; any other LETTER RUN raises loudly — runs
 #: are matched exactly, so e.g. MMMM cannot half-translate)
@@ -49,9 +51,6 @@ _PATTERN_TOKENS = {
     "HH": "%H", "mm": "%M", "ss": "%S", "SSSSSS": "%f",
     "SSS": "%f", "a": "%p",
 }
-
-import re as _re
-
 
 def spark_pattern_to_strptime(pattern: str) -> str:
     out = []
@@ -109,6 +108,13 @@ class CsvScanNode(FileScanNode):
     def _conf_reader_type(self) -> str:
         return self.conf.get_entry(CSV_READER_TYPE)
 
+    def _cache_key_extra(self) -> tuple:
+        return (tuple(self.user_schema or ()), self.header, self.delimiter,
+                self.quote, self.escape, self.comment, self.null_value,
+                self.empty_value, self.nan_value, self.positive_inf,
+                self.negative_inf, self.timestamp_format,
+                self.ignore_leading_ws, self.ignore_trailing_ws, self.mode)
+
     # -- option plumbing ----------------------------------------------------
     @property
     def _custom_floats(self) -> bool:
@@ -128,10 +134,17 @@ class CsvScanNode(FileScanNode):
             escape_char=self.escape if self.escape else False,
             double_quote=self.escape is None,
         )
-        if self.mode in ("DROPMALFORMED", "PERMISSIVE"):
-            # arrow cannot null-fill ragged rows; skipping is the closest
-            # behavior for PERMISSIVE and exact for DROPMALFORMED
+        salvage = []
+        if self.mode == "DROPMALFORMED":
             parse_opts.invalid_row_handler = lambda row: "skip"
+        elif self.mode == "PERMISSIVE":
+            # Spark PERMISSIVE null-fills ragged rows: capture the row text
+            # and rebuild it with nulls appended after the arrow pass
+            def _capture(row, _s=salvage):
+                if row.text is not None:
+                    _s.append(row.text)
+                return "skip"
+            parse_opts.invalid_row_handler = _capture
 
         null_values = [self.null_value]
         if self.empty_value is not None:
@@ -159,7 +172,7 @@ class CsvScanNode(FileScanNode):
             quoted_strings_can_be_null=False,
             timestamp_parsers=timestamp_parsers or None,
         )
-        return read_opts, parse_opts, convert
+        return read_opts, parse_opts, convert, salvage
 
     def file_schema(self, path: str) -> Schema:
         if self.user_schema:
@@ -178,21 +191,58 @@ class CsvScanNode(FileScanNode):
                  if not ln.lstrip().startswith(cb)]
         return b"\n".join(lines)
 
-    def _read_arrow(self, path: str) -> pa.Table:
-        read_opts, parse_opts, convert = self._read_opts()
+    def _read_arrow(self, path: str):
+        read_opts, parse_opts, convert, salvage = self._read_opts()
         # stream straight from the file unless the comment pre-filter
         # requires materializing the text
         source = (_io.BytesIO(self._load_bytes(path)) if self.comment
                   else path)
-        return pcsv.read_csv(source,
-                             read_options=read_opts,
-                             parse_options=parse_opts,
-                             convert_options=convert)
+        tbl = pcsv.read_csv(source,
+                            read_options=read_opts,
+                            parse_options=parse_opts,
+                            convert_options=convert)
+        return tbl, salvage
 
     def read_file(self, path: str) -> HostTable:
-        tbl = self._read_arrow(path)
+        tbl, salvage = self._read_arrow(path)
         host = decode_to_schema(tbl, self._pre_float_schema())
-        return self._post_process(host)
+        host = self._post_process(host)
+        if salvage:
+            host = self._append_null_filled(host, salvage)
+        return host
+
+    def _append_null_filled(self, host: HostTable, rows) -> HostTable:
+        """PERMISSIVE ragged rows: parse what fields exist (naive split —
+        these rows already failed structured parsing) and null-fill the
+        rest; appended at the end (row order within a file is not part of
+        the engine's contract)."""
+        schema = [(n, c.dtype) for n, c in zip(host.names, host.columns)]
+        extra = []
+        for text in rows:
+            parts = text.split(self.delimiter)
+            row = []
+            for j, (_, dt) in enumerate(schema):
+                raw = parts[j].strip() if j < len(parts) else None
+                if raw in (None, self.null_value):
+                    row.append(None)
+                    continue
+                try:
+                    from spark_rapids_tpu.ops.cast import parse_string_cast
+                    v = (raw if isinstance(dt, T.StringType)
+                         else parse_string_cast(raw, dt))
+                except Exception:
+                    v = None
+                row.append(v)
+            extra.append(row)
+        cols = []
+        for j, (n, dt) in enumerate(schema):
+            vals = [r[j] for r in extra]
+            cols.append(HostColumn.from_pylist(vals, dt))
+        return HostTable(host.names, [
+            HostColumn(c.dtype,
+                       np.concatenate([c.data, e.data]),
+                       np.concatenate([c.validity, e.validity]))
+            for c, e in zip(host.columns, cols)])
 
     def _pre_float_schema(self) -> Schema:
         """Schema for the arrow decode: custom-float columns arrive as
@@ -208,6 +258,7 @@ class CsvScanNode(FileScanNode):
         cols = list(host.columns)
         names = list(host.names)
         target = dict(self.data_schema)
+        drop_mask = None  # DROPMALFORMED: rows with unparseable floats
         for i, (n, c) in enumerate(zip(names, cols)):
             if isinstance(c.dtype, T.StringType) and (
                     self.ignore_leading_ws or self.ignore_trailing_ws):
@@ -222,15 +273,25 @@ class CsvScanNode(FileScanNode):
             want = target.get(n)
             if isinstance(c.dtype, T.StringType) and isinstance(
                     want, (T.FloatType, T.DoubleType)) and self._custom_floats:
-                c = self._convert_custom_floats(c, want)
+                c, bad = self._convert_custom_floats(c, want)
+                if drop_mask is None:
+                    drop_mask = bad
+                else:
+                    drop_mask = drop_mask | bad
             cols[i] = c
+        if self.mode == "DROPMALFORMED" and drop_mask is not None \
+                and drop_mask.any():
+            keep = ~drop_mask
+            cols = [HostColumn(c.dtype, c.data[keep], c.validity[keep])
+                    for c in cols]
         return HostTable(names, cols)
 
-    def _convert_custom_floats(self, c: HostColumn, dt) -> HostColumn:
+    def _convert_custom_floats(self, c: HostColumn, dt):
         specials = {self.nan_value: np.nan, self.positive_inf: np.inf,
                     self.negative_inf: -np.inf}
         out = np.zeros(len(c), dtype=dt.np_dtype)
         validity = np.zeros(len(c), dtype=np.bool_)
+        malformed = np.zeros(len(c), dtype=np.bool_)
         for i in range(len(c)):
             if not c.validity[i] or c.data[i] is None:
                 continue
@@ -246,7 +307,8 @@ class CsvScanNode(FileScanNode):
                     if self.mode == "FAILFAST":
                         raise ValueError(
                             f"malformed float {s!r} (FAILFAST mode)")
-        return HostColumn(dt, out, validity)
+                    malformed[i] = True
+        return HostColumn(dt, out, validity), malformed
 
 
 def write_csv(table: HostTable, path: str,

@@ -1,0 +1,73 @@
+"""FileCache — decoded-batch cache for file scans.
+
+Reference: the FileCache subsystem (filecache/FileCache.scala) caches
+remote file data/footers on local disk so repeated scans skip the slow
+fetch. Here the slow layer is host DECODE (parse/convert to columns), so
+the cache holds decoded HostTables keyed by (path, mtime, scan options),
+LRU-bounded by ``spark.rapids.filecache.maxBytes``. Off by default like
+the reference; decoded batches also warm the scan DEVICE cache upstream.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import OrderedDict
+from typing import Callable, Optional
+
+from spark_rapids_tpu.columnar import HostTable
+from spark_rapids_tpu.conf import bool_conf, int_conf
+
+FILECACHE_ENABLED = bool_conf(
+    "spark.rapids.filecache.enabled", False,
+    "Cache decoded file batches in host memory keyed by (path, mtime, "
+    "scan options); repeated scans skip the decode (FileCache analog).")
+
+FILECACHE_MAX_BYTES = int_conf(
+    "spark.rapids.filecache.maxBytes", 1 << 30,
+    "LRU budget for the decoded-batch file cache.")
+
+
+class _FileCache:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[tuple, HostTable]" = OrderedDict()
+        self._bytes = 0
+        self.hits = 0
+        self.misses = 0
+
+    def get_or_decode(self, path: str, options_key: tuple,
+                      decode: Callable[[], HostTable],
+                      max_bytes: int) -> HostTable:
+        try:
+            mtime = os.path.getmtime(path)
+        except OSError:
+            return decode()
+        key = (os.path.abspath(path), mtime, options_key)
+        with self._lock:
+            got = self._entries.get(key)
+            if got is not None:
+                self._entries.move_to_end(key)
+                self.hits += 1
+                return got
+            self.misses += 1
+        table = decode()
+        size = table.nbytes()
+        if size > max_bytes:
+            return table  # too big to cache
+        with self._lock:
+            if key not in self._entries:  # concurrent decode of same key
+                self._entries[key] = table
+                self._bytes += size
+            while self._bytes > max_bytes and self._entries:
+                _, old = self._entries.popitem(last=False)
+                self._bytes -= old.nbytes()
+        return table
+
+    def clear(self):
+        with self._lock:
+            self._entries.clear()
+            self._bytes = 0
+
+
+FILE_CACHE = _FileCache()

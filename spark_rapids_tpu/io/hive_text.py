@@ -1,0 +1,58 @@
+"""Hive text (LazySimpleSerDe delimited) scan + writer.
+
+Reference: org.apache.spark.sql.hive.rapids (GpuHiveTextFileFormat /
+GpuHiveTableScanExec) — Hive's default text layout: \\x01 field delimiter,
+no header, '\\N' as the null marker, no quoting/escaping of delimiters.
+Rides the CSV machinery with Hive defaults pinned (the reference routes it
+through the same text-reader base)."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from spark_rapids_tpu.columnar import HostTable
+from spark_rapids_tpu.conf import RapidsConf, str_conf
+from spark_rapids_tpu.io.csv import CsvScanNode
+from spark_rapids_tpu.io.writer import write_partitioned
+from spark_rapids_tpu.plan.nodes import Schema
+
+HIVE_TEXT_READER_TYPE = str_conf(
+    "spark.rapids.sql.format.hiveText.reader.type", "AUTO",
+    "PERFILE, COALESCING, MULTITHREADED or AUTO.")
+
+HIVE_DELIM = "\x01"
+HIVE_NULL = "\\N"
+
+
+class HiveTextScanNode(CsvScanNode):
+    format_name = "hiveText"
+
+    def __init__(self, paths, conf: RapidsConf, schema: Schema,
+                 columns=None, reader_type=None,
+                 delimiter: str = HIVE_DELIM, null_value: str = HIVE_NULL,
+                 **options):
+        if schema is None:
+            raise ValueError("Hive text tables require an explicit schema "
+                             "(the format carries no header)")
+        super().__init__(paths, conf, columns=columns,
+                         reader_type=reader_type, schema=schema,
+                         header=False, sep=delimiter, null_value=null_value,
+                         quote="", escape=None, **options)
+
+    def _conf_reader_type(self) -> str:
+        return self.conf.get_entry(HIVE_TEXT_READER_TYPE)
+
+
+def write_hive_text(table: HostTable, path: str,
+                    partition_by: Optional[Sequence[str]] = None,
+                    delimiter: str = HIVE_DELIM,
+                    null_value: str = HIVE_NULL) -> List[str]:
+    def _write_one(tbl: HostTable, file_path: str):
+        cols = [c.to_pylist() for c in tbl.columns]
+        with open(file_path, "w") as f:
+            for i in range(tbl.num_rows):
+                f.write(delimiter.join(
+                    null_value if cols[j][i] is None else str(cols[j][i])
+                    for j in range(len(cols))) + "\n")
+
+    return write_partitioned(table, path, _write_one, "txt", partition_by)

@@ -50,21 +50,26 @@ class JsonScanNode(FileScanNode):
     def _conf_reader_type(self) -> str:
         return self.conf.get_entry(JSON_READER_TYPE)
 
+    def _cache_key_extra(self) -> tuple:
+        return (tuple(self.user_schema or ()), self.multi_line,
+                self.primitives_as_string, self.mode)
+
     def _parse_opts(self):
         if self.primitives_as_string and self.user_schema is None:
             return None  # schema inference happens post-stringify
         if not self.user_schema:
             return None
+        from spark_rapids_tpu import types as T
         schema = []
         for n, dt in self.user_schema:
-            at = (pa.string() if self.primitives_as_string
+            nested = isinstance(dt, (T.ArrayType, T.StructType, T.MapType))
+            at = (pa.string() if self.primitives_as_string and not nested
                   else spark_type_to_arrow(dt))
             schema.append((n, at))
         return pjson.ParseOptions(explicit_schema=pa.schema(schema))
 
     def _normalized_lines(self, path: str) -> bytes:
         """Apply multiLine + mode to produce clean JSON-lines bytes."""
-        import io as _io
         with open(path, "rb") as f:
             raw = f.read()
         if self.multi_line:
@@ -82,13 +87,19 @@ class JsonScanNode(FileScanNode):
                 if ln.strip():
                     _json.loads(ln)  # raises on malformed
             return raw
+
+        def _reject_const(_):
+            raise _json.JSONDecodeError("non-standard constant", "", 0)
+
         out = []
         for ln in raw.splitlines():
             s = ln.strip()
             if not s:
                 continue
             try:
-                _json.loads(s)
+                # parse_constant: Python json accepts NaN/Infinity that
+                # Arrow rejects — treat them as malformed consistently
+                _json.loads(s, parse_constant=_reject_const)
                 out.append(ln)
             except _json.JSONDecodeError:
                 if self.mode == "PERMISSIVE":
@@ -98,6 +109,15 @@ class JsonScanNode(FileScanNode):
 
     def _read_arrow(self, path: str) -> pa.Table:
         import io as _io
+        if not self.multi_line:
+            # fast path: stream straight through arrow; the per-line
+            # salvage pass only runs if arrow rejects the file
+            try:
+                return pjson.read_json(path,
+                                       parse_options=self._parse_opts())
+            except pa.ArrowInvalid:
+                if self.mode == "FAILFAST":
+                    raise
         data = self._normalized_lines(path)
         if not data.strip():
             # every row dropped (DROPMALFORMED): an empty typed table

@@ -181,25 +181,29 @@ class DataFrame:
         return self.plan.tree_string()
 
     # -- writers (reference: GpuDataWritingCommandExec + format writers) ----
+    def _write(self, fmt: str, path: str, partition_by, options):
+        """Plan a WriteFiles command: the CHILD runs through the overrides
+        engine (device when convertible), the write commits atomically
+        (staging dir + rename + _SUCCESS), and the stats row returns."""
+        node = P.WriteFiles(self.plan, fmt, path, partition_by, options)
+        if self.session is not None:
+            return self.session.execute(node)
+        return node.collect_cpu()
+
     def write_parquet(self, path: str, partition_by=None, **options):
-        from spark_rapids_tpu.io.parquet import write_parquet
-        return write_parquet(self.collect_table(), path,
-                             partition_by=partition_by, **options)
+        return self._write("parquet", path, partition_by, options)
 
     def write_orc(self, path: str, partition_by=None, **options):
-        from spark_rapids_tpu.io.orc import write_orc
-        return write_orc(self.collect_table(), path,
-                         partition_by=partition_by, **options)
+        return self._write("orc", path, partition_by, options)
 
     def write_csv(self, path: str, partition_by=None, **options):
-        from spark_rapids_tpu.io.csv import write_csv
-        return write_csv(self.collect_table(), path,
-                         partition_by=partition_by, **options)
+        return self._write("csv", path, partition_by, options)
 
     def write_json(self, path: str, partition_by=None, **options):
-        from spark_rapids_tpu.io.json import write_json
-        return write_json(self.collect_table(), path,
-                          partition_by=partition_by, **options)
+        return self._write("json", path, partition_by, options)
+
+    def write_hive_text(self, path: str, partition_by=None, **options):
+        return self._write("hive_text", path, partition_by, options)
 
 
 class GroupedData:

@@ -7,6 +7,8 @@ the fallback implementations."""
 
 from __future__ import annotations
 
+import os
+
 from dataclasses import dataclass
 from typing import Iterator, List, Optional, Sequence, Tuple
 
@@ -618,6 +620,74 @@ class CachedRelation(PlanNode):
     def describe(self):
         state = "materialized" if self._table is not None else "lazy"
         return f"CachedRelation[{state}]"
+
+
+class WriteFiles(PlanNode):
+    """Data-writing command (reference: GpuDataWritingCommandExec +
+    GpuFileFormatDataWriter): runs the child (on device when convertible —
+    this node itself stays host-side like the reference's write encode),
+    writes files under a Spark-style COMMIT PROTOCOL (stage into
+    _temporary/<uuid>, atomic rename on success, _SUCCESS marker), and
+    returns one stats row (numFiles, numRows, numBytes)."""
+
+    def __init__(self, child: PlanNode, fmt: str, path: str,
+                 partition_by: Optional[Sequence[str]] = None,
+                 options: Optional[dict] = None):
+        self.children = (child,)
+        self.fmt = fmt
+        self.path = path
+        self.partition_by = list(partition_by) if partition_by else None
+        self.options = dict(options or {})
+
+    def output_schema(self):
+        return [("numFiles", T.LONG), ("numRows", T.LONG),
+                ("numBytes", T.LONG)]
+
+    def _writer(self):
+        from spark_rapids_tpu import io as _io_pkg
+        return {
+            "parquet": _io_pkg.write_parquet,
+            "orc": _io_pkg.write_orc,
+            "csv": _io_pkg.write_csv,
+            "json": _io_pkg.write_json,
+            "hive_text": _io_pkg.write_hive_text,
+        }[self.fmt]
+
+    def execute_cpu(self):
+        import shutil
+        import uuid
+
+        table = self.children[0].collect_cpu()
+        staging = os.path.join(self.path,
+                               f"_temporary-{uuid.uuid4().hex[:12]}")
+        os.makedirs(staging, exist_ok=True)
+        try:
+            files = self._writer()(table, staging,
+                                   partition_by=self.partition_by,
+                                   **self.options)
+            os.makedirs(self.path, exist_ok=True)
+            final_files = []
+            for f in files:
+                rel = os.path.relpath(f, staging)
+                dst = os.path.join(self.path, rel)
+                os.makedirs(os.path.dirname(dst), exist_ok=True)
+                os.replace(f, dst)  # atomic per-file commit
+                final_files.append(dst)
+            with open(os.path.join(self.path, "_SUCCESS"), "w"):
+                pass
+        finally:
+            shutil.rmtree(staging, ignore_errors=True)
+
+        nbytes = sum(os.path.getsize(f) for f in final_files)
+        yield HostTable(
+            ["numFiles", "numRows", "numBytes"],
+            [HostColumn(T.LONG, np.asarray([len(final_files)], dtype=np.int64)),
+             HostColumn(T.LONG, np.asarray([table.num_rows], dtype=np.int64)),
+             HostColumn(T.LONG, np.asarray([nbytes], dtype=np.int64))])
+
+    def describe(self):
+        part = f", partitionBy={self.partition_by}" if self.partition_by else ""
+        return f"WriteFiles[{self.fmt} -> {self.path}{part}]"
 
 
 class Exchange(PlanNode):

@@ -78,6 +78,11 @@ class TpuSession:
         from spark_rapids_tpu.io.orc import OrcScanNode
         return DataFrame(OrcScanNode(list(paths), self.conf, **options), self)
 
+    def read_hive_text(self, *paths, schema=None, **options) -> DataFrame:
+        from spark_rapids_tpu.io.hive_text import HiveTextScanNode
+        return DataFrame(HiveTextScanNode(list(paths), self.conf,
+                                          schema=schema, **options), self)
+
     # -- execution ----------------------------------------------------------
     def execute(self, plan: P.PlanNode) -> HostTable:
         from spark_rapids_tpu.conf import RETRY_OOM_MAX_RETRIES, TEST_INJECT_RETRY_OOM

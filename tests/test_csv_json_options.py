@@ -119,3 +119,38 @@ def test_json_multiline_malformed_modes(session, tmp_path):
     with pytest.raises(Exception):
         session.read_json(p, multi_line=True, mode="FAILFAST",
                           schema=[("a", T.LONG)]).collect()
+
+
+def test_csv_permissive_null_fills_ragged_rows(session, tmp_path):
+    """Spark PERMISSIVE null-fills short rows rather than dropping them."""
+    p = _write(tmp_path, "rag.csv", "a,b\n1,2\n3\n5,6\n")
+    rows = sorted(session.read_csv(
+        p, schema=[("a", T.INT), ("b", T.INT)]).collect())
+    assert rows == [(1, 2), (3, None), (5, 6)]
+
+
+def test_csv_dropmalformed_custom_float_drops_row(session, tmp_path):
+    p = _write(tmp_path, "cf.csv", "x,y\n1.5,a\nxyz,b\n2.5,c\n")
+    rows = session.read_csv(
+        p, mode="DROPMALFORMED", nan_value="strange",
+        schema=[("x", T.DOUBLE), ("y", T.STRING)]).collect()
+    assert rows == [(1.5, "a"), (2.5, "c")]
+
+
+def test_json_nan_constant_is_malformed(session, tmp_path):
+    p = _write(tmp_path, "nan.json", '{"a": 1}\n{"a": NaN}\n{"a": 3}\n')
+    rows = [r[0] for r in session.read_json(
+        p, schema=[("a", T.LONG)]).collect()]
+    assert rows == [1, None, 3]  # PERMISSIVE: NaN line -> null row
+
+
+def test_filecache_distinguishes_options(tmp_path):
+    from spark_rapids_tpu.io.filecache import FILE_CACHE
+    from spark_rapids_tpu.session import TpuSession
+    p = _write(str(tmp_path), "o.csv", "a\nNA\n5\n")
+    s = TpuSession({"spark.rapids.filecache.enabled": "true"})
+    FILE_CACHE.clear()
+    r1 = s.read_csv(p, null_value="NA", schema=[("a", T.STRING)]).collect()
+    r2 = s.read_csv(p, null_value="zz", schema=[("a", T.STRING)]).collect()
+    assert r1 == [(None,), ("5",)]
+    assert r2 == [("NA",), ("5",)]  # options must NOT share a cache entry

@@ -1,0 +1,85 @@
+"""WriteFiles commit protocol, Hive text scan, FileCache
+(reference analogs: GpuDataWritingCommandExec, GpuHiveText, FileCache)."""
+
+import os
+
+import pytest
+
+from spark_rapids_tpu import functions as F
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.ops.expr import col, lit
+from spark_rapids_tpu.plan import from_host_table
+
+from tests.data_gen import IntGen, StringGen, gen_table
+
+
+def _df(sess, n=200, seed=4):
+    return from_host_table(
+        gen_table({"k": StringGen(cardinality=4, nullable=False),
+                   "v": IntGen(nullable=False)}, n, seed), sess)
+
+
+def test_write_parquet_commit_protocol(session, tmp_path):
+    out = str(tmp_path / "t")
+    stats = _df(session).filter(col("v") > lit(0)).write_parquet(out)
+    assert os.path.exists(os.path.join(out, "_SUCCESS"))
+    assert not any(d.startswith("_temporary") for d in os.listdir(out))
+    row = stats.to_pydict()
+    assert row["numFiles"][0] >= 1 and row["numBytes"][0] > 0
+    back = session.read_parquet(out + "/part-00000.parquet").count()
+    assert back == row["numRows"][0]
+
+
+def test_write_partitioned_commit(session, tmp_path):
+    out = str(tmp_path / "p")
+    stats = _df(session).write_parquet(out, partition_by=["k"])
+    assert os.path.exists(os.path.join(out, "_SUCCESS"))
+    parts = [d for d in os.listdir(out) if d.startswith("k=")]
+    assert len(parts) >= 2
+    assert stats.to_pydict()["numRows"][0] == 200
+
+
+def test_hive_text_roundtrip(session, tmp_path):
+    out = str(tmp_path / "h")
+    _df(session).write_hive_text(out)
+    schema = [("k", T.STRING), ("v", T.INT)]
+    files = [os.path.join(out, f) for f in os.listdir(out)
+             if f.endswith(".txt")]
+    back = session.read_hive_text(*files, schema=schema)
+    a = sorted(back.collect())
+    b = sorted(_df(session).collect())
+    assert a == b
+
+
+def test_hive_text_null_marker(session, tmp_path):
+    p = str(tmp_path / "n.txt")
+    with open(p, "w") as f:
+        f.write("a\x015\n\\N\x017\nb\x01\\N\n")
+    df = session.read_hive_text(p, schema=[("s", T.STRING), ("i", T.INT)])
+    assert df.collect() == [("a", 5), (None, 7), ("b", None)]
+
+
+def test_filecache_hits(tmp_path):
+    from spark_rapids_tpu.io.filecache import FILE_CACHE
+    from spark_rapids_tpu.session import TpuSession
+
+    s = TpuSession({"spark.rapids.filecache.enabled": "true"})
+    out = str(tmp_path / "c")
+    _df(s).write_parquet(out)
+    f = os.path.join(out, "part-00000.parquet")
+    FILE_CACHE.clear()
+    h0, m0 = FILE_CACHE.hits, FILE_CACHE.misses
+    s.read_parquet(f).count()
+    s.read_parquet(f).count()
+    assert FILE_CACHE.misses == m0 + 1
+    assert FILE_CACHE.hits >= h0 + 1
+
+
+def test_filecache_disabled_by_default(session, tmp_path):
+    from spark_rapids_tpu.io.filecache import FILE_CACHE
+    out = str(tmp_path / "d")
+    _df(session).write_parquet(out)
+    FILE_CACHE.clear()
+    m0 = FILE_CACHE.misses
+    session.read_parquet(os.path.join(out, "part-00000.parquet")).count()
+    assert FILE_CACHE.misses == m0  # cache never consulted
