@@ -135,15 +135,25 @@ class JoinKernel:
 
             # sort build-side codes; invalid/dead rows park at +inf
             r_sortable = jnp.where(valid_r, r_codes, INT32_MAX)
-            rs_codes, rs_perm = jax.lax.sort(
+            _, rs_perm = jax.lax.sort(
                 [r_sortable, jnp.arange(cap_r, dtype=jnp.int32)], num_keys=1)
 
-            lo = jnp.searchsorted(rs_codes, l_codes, side="left")
-            hi = jnp.searchsorted(rs_codes, l_codes, side="right")
-            counts = jnp.where(valid_l, hi - lo, 0).astype(jnp.int32)
+            # codes are DENSE ranks < cap_l + cap_r, so per-code build
+            # counts + an exclusive prefix give each probe code's sorted
+            # range with two GATHERS — no log(n) searchsorted passes
+            n_codes = cap_l + cap_r
+            park = jnp.where(valid_r, r_codes, n_codes)
+            bc = jax.ops.segment_sum(
+                jnp.ones(cap_r, dtype=jnp.int32), park,
+                num_segments=n_codes + 1)[:n_codes]
+            starts = jnp.cumsum(bc) - bc  # exclusive prefix in code order
+            safe_l = jnp.clip(l_codes, 0, n_codes - 1)
+            lo = starts[safe_l].astype(jnp.int32)
+            counts = jnp.where(valid_l & (l_codes >= 0), bc[safe_l],
+                               0).astype(jnp.int32)
             total = jnp.sum(counts.astype(jnp.int64))
             matched_l = counts > 0
-            return (lo.astype(jnp.int32), counts, total, matched_l,
+            return (lo, counts, total, matched_l,
                     rs_perm, live_l, live_r)
 
         return probe

@@ -177,7 +177,8 @@ class CsvScanNode(FileScanNode):
     def file_schema(self, path: str) -> Schema:
         if self.user_schema:
             return list(self.user_schema)
-        return arrow_schema_to_spark(self._read_arrow(path).schema)
+        tbl, _ = self._read_arrow(path)
+        return arrow_schema_to_spark(tbl.schema)
 
     def _load_bytes(self, path: str) -> bytes:
         # comment filtering is LINE-based; quoted fields spanning newlines
@@ -213,16 +214,23 @@ class CsvScanNode(FileScanNode):
 
     def _append_null_filled(self, host: HostTable, rows) -> HostTable:
         """PERMISSIVE ragged rows: parse what fields exist (naive split —
-        these rows already failed structured parsing) and null-fill the
-        rest; appended at the end (row order within a file is not part of
-        the engine's contract)."""
+        these rows already failed structured parsing) against the FILE's
+        physical column order, then project into the (possibly pruned or
+        reordered) output columns; appended at the end (row order within a
+        file is not part of the engine's contract)."""
+        # physical file order = the full user/file schema, NOT host.names
+        file_schema = list(self.user_schema) if self.user_schema else \
+            list(self.data_schema)
+        file_pos = {n: j for j, (n, _) in enumerate(file_schema)}
         schema = [(n, c.dtype) for n, c in zip(host.names, host.columns)]
         extra = []
         for text in rows:
             parts = text.split(self.delimiter)
             row = []
-            for j, (_, dt) in enumerate(schema):
-                raw = parts[j].strip() if j < len(parts) else None
+            for n, dt in schema:
+                j = file_pos.get(n)
+                raw = (parts[j].strip()
+                       if j is not None and j < len(parts) else None)
                 if raw in (None, self.null_value):
                     row.append(None)
                     continue

@@ -27,11 +27,15 @@ class ParquetScanNode(FileScanNode):
                  filters=None, **options):
         #: pyarrow-style predicate pushdown filters, e.g. [("x", ">", 3)]
         self.filters = filters
+
         super().__init__(paths, conf, columns=columns, reader_type=reader_type,
                          **options)
 
     def _conf_reader_type(self) -> str:
         return self.conf.get_entry(PARQUET_READER_TYPE)
+
+    def _cache_key_extra(self) -> tuple:
+        return (repr(self.filters),)
 
     def file_schema(self, path: str) -> Schema:
         return arrow_schema_to_spark(pq.read_schema(path))

@@ -154,3 +154,32 @@ def test_filecache_distinguishes_options(tmp_path):
     r2 = s.read_csv(p, null_value="zz", schema=[("a", T.STRING)]).collect()
     assert r1 == [(None,), ("5",)]
     assert r2 == [("NA",), ("5",)]  # options must NOT share a cache entry
+
+
+def test_csv_schema_inference_still_works(session, tmp_path):
+    p = _write(tmp_path, "inf.csv", "a,b\n1,x\n2,y\n")
+    assert session.read_csv(p).collect() == [(1, "x"), (2, "y")]
+
+
+def test_csv_permissive_ragged_with_pruning(session, tmp_path):
+    """Null-filled ragged fields map by the FILE's physical order even when
+    columns are pruned (code-review: positional misalignment)."""
+    p = _write(tmp_path, "prune.csv", "a,b\n1,2\n3\n")
+    rows = sorted(session.read_csv(
+        p, schema=[("a", T.INT), ("b", T.INT)], columns=["b"]).collect(),
+        key=lambda r: (r[0] is None, r[0]))
+    assert rows == [(2,), (None,)]
+
+
+def test_parquet_filters_not_cached_together(tmp_path):
+    from spark_rapids_tpu.io.filecache import FILE_CACHE
+    from spark_rapids_tpu.session import TpuSession
+    import os
+    s = TpuSession({"spark.rapids.filecache.enabled": "true"})
+    out = str(tmp_path / "pq")
+    s.create_dataframe({"x": [1, 2, 3, 4]}).write_parquet(out)
+    f = os.path.join(out, "part-00000.parquet")
+    FILE_CACHE.clear()
+    filtered = s.read_parquet(f, filters=[("x", ">", 2)]).count()
+    unfiltered = s.read_parquet(f).count()
+    assert filtered == 2 and unfiltered == 4
