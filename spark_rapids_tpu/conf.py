@@ -196,6 +196,16 @@ CPU_ORACLE_STRICT = bool_conf(
     "Test-only: compare device results bit-for-bit against the CPU path.",
     internal=True)
 
+AQE_COALESCE_PARTITIONS = bool_conf(
+    "spark.rapids.sql.adaptive.coalescePartitions.enabled", False,
+    "Adaptive shuffle-partition coalescing: adjacent undersized reduce "
+    "partitions merge into shared output batches at read time (AQE "
+    "CoalesceShufflePartitions analog). OFF by default because this "
+    "engine's shuffles all come from explicit repartition(n) calls, which "
+    "the reference's AQE exempts from coalescing; enable when batch count "
+    "need not match the requested partition count. Partitions larger than "
+    "the batch target still split either way.")
+
 BROADCAST_SIZE_BYTES = int_conf(
     "spark.rapids.sql.broadcastSizeBytes", 10 << 20,
     "Join build sides whose plan-size estimate is at or below this "

@@ -6,7 +6,10 @@ the shuffle manager, then the read side's GpuShuffleCoalesceExec concats a
 reduce partition's serialized tables ON HOST to the target size before one
 device upload (GpuShuffleCoalesceExec.scala:43-229).
 
-The exec yields one device batch per (non-empty) reduce partition."""
+The exec yields batches per reduce partition: oversized partitions split
+at the batch target; with adaptive coalescing enabled, adjacent
+undersized partitions share output batches (so batch count can be far
+below the partition count)."""
 
 from __future__ import annotations
 
@@ -62,6 +65,10 @@ class TpuShuffleExchangeExec(TpuExec):
 
     def describe(self):
         return f"TpuShuffleExchange[{self.mode}, n={self.num_partitions}]"
+
+    def _aqe_coalesce_enabled(self) -> bool:
+        from spark_rapids_tpu.conf import AQE_COALESCE_PARTITIONS
+        return bool(self.conf.get_entry(AQE_COALESCE_PARTITIONS))
 
     def _ici_eligible(self) -> bool:
         """The collective path runs when the user asked for ICI mode, the
@@ -172,19 +179,41 @@ class TpuShuffleExchangeExec(TpuExec):
 
             reader = manager.reader(handle)
             t0 = perf_counter()
+            # AQE partition coalescing (reference: AQE
+            # CoalesceShufflePartitions / ShufflePartitionsUtil): with the
+            # conf enabled, ADJACENT undersized reduce partitions share
+            # output batches, so a 200-partition shuffle of a small dataset
+            # emits a handful of full batches instead of 200 slivers. NOTE:
+            # a flush can land mid-partition, so batches are NOT
+            # partition-aligned in this mode (keyed co-location still holds
+            # per ROW, just not per batch). The within-partition target-
+            # size split (GpuShuffleCoalesce) applies in both modes.
+            coalesce_parts = self._aqe_coalesce_enabled()
+            pending: List[HostTable] = []
+            pending_bytes = 0
+            nonempty_parts = 0
+            emitted = 0
             for p in range(self.num_partitions):
-                # GpuShuffleCoalesce: concat a partition's tables on host up
-                # to the target batch size, one H2D upload per flush
-                pending: List[HostTable] = []
-                pending_bytes = 0
+                saw_rows = False
                 for t in reader.read_partition(p):
+                    saw_rows = True
                     pending.append(t)
                     pending_bytes += t.nbytes()
                     if pending_bytes >= self.target_batch_bytes:
                         yield self._upload(pending)
+                        emitted += 1
                         pending, pending_bytes = [], 0
-                if pending:
+                nonempty_parts += saw_rows
+                if pending and not coalesce_parts:
                     yield self._upload(pending)
+                    emitted += 1
+                    pending, pending_bytes = [], 0
+            if pending:
+                yield self._upload(pending)
+                emitted += 1
+            if coalesce_parts and nonempty_parts > emitted:
+                self.add_metric("aqeCoalescedPartitions",
+                                nonempty_parts - emitted)
             self.add_metric("shuffleReadTime", perf_counter() - t0)
             self.add_metric("shuffleBytesRead", reader.bytes_read)
         finally:

@@ -332,3 +332,40 @@ def test_range_partition_string_bounds_consistent_across_batches():
     items = sorted(mapping.items())
     pids_in_order = [p for _, p in items]
     assert pids_in_order == sorted(pids_in_order), items
+
+
+def test_aqe_partition_coalescing(session, cpu_session):
+    """Small adjacent shuffle partitions merge at read time (AQE analog);
+    results unchanged, far fewer output batches."""
+    from spark_rapids_tpu import functions as F
+    from spark_rapids_tpu.ops.expr import col
+    from spark_rapids_tpu.overrides import apply_overrides
+    from spark_rapids_tpu.execs.exchange import TpuShuffleExchangeExec
+    from spark_rapids_tpu.plan import from_host_table
+    from tests.data_gen import IntGen, gen_table
+
+    from spark_rapids_tpu.session import TpuSession
+    t = gen_table({"k": IntGen(min_val=0, max_val=40), "v": IntGen()}, 400, 5)
+
+    # default (off, matching AQE's user-repartition exemption): one batch
+    # per non-empty partition
+    df = from_host_table(t, session).repartition(64, "k")
+    executable, _ = apply_overrides(df.plan, session.conf)
+    default_batches = list(executable.execute_cpu())
+    assert sum(b.num_rows for b in default_batches) == 400
+
+    on = TpuSession({
+        "spark.rapids.sql.adaptive.coalescePartitions.enabled": "true"})
+    df2 = from_host_table(t, on).repartition(64, "k")
+    ex2, _ = apply_overrides(df2.plan, on.conf)
+    batches = list(ex2.execute_cpu())
+    assert len(batches) <= 4 < len(default_batches)
+    assert sum(b.num_rows for b in batches) == 400
+
+    # correctness through a grouped aggregate with coalescing ON
+    from tests.asserts import assert_tpu_and_cpu_are_equal
+    assert_tpu_and_cpu_are_equal(
+        lambda s: from_host_table(t, s if s is not on else on)
+        .repartition(64, "k")
+        .group_by("k").agg(F.count().alias("c"), F.sum(col("v")).alias("s")),
+        on, cpu_session)
