@@ -137,7 +137,9 @@ SHUFFLE_MT_READER_THREADS = int_conf(
 
 SHUFFLE_COMPRESSION_CODEC = str_conf(
     "spark.rapids.shuffle.compression.codec", "none",
-    "none, lz4 or zstd for serialized shuffle batches.")
+    "Codec for serialized shuffle batches: none, lz4 (native C++ block "
+    "codec), zstd, or zlib. lz4/zstd degrade to zlib when their backend "
+    "is unavailable; the resolved codec is what gets recorded on disk.")
 
 PARQUET_READER_TYPE = str_conf(
     "spark.rapids.sql.format.parquet.reader.type", "AUTO",

@@ -19,39 +19,47 @@ import numpy as np
 
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "native")
-_SO_PATH = os.path.join(_NATIVE_DIR, "libstrcodec.so")
-_SRC_PATH = os.path.join(_NATIVE_DIR, "strcodec.cpp")
 
 _lock = threading.Lock()
-_lib = None
-_lib_failed = False
+_libs: dict = {}
+
+
+def _load_lib(stem: str, configure) -> Optional[ctypes.CDLL]:
+    """Build native/<stem>.cpp into lib<stem>.so (if stale) and load it.
+    ``configure(lib)`` declares the ctypes signatures. Returns None (and
+    remembers the failure) when the toolchain or build is unavailable."""
+    if stem in _libs:
+        return _libs[stem]
+    with _lock:
+        if stem in _libs:
+            return _libs[stem]
+        src = os.path.join(_NATIVE_DIR, f"{stem}.cpp")
+        so = os.path.join(_NATIVE_DIR, f"lib{stem}.so")
+        try:
+            if not os.path.exists(so) or (
+                    os.path.exists(src)
+                    and os.path.getmtime(src) > os.path.getmtime(so)):
+                subprocess.run(
+                    ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+                     src, "-o", so],
+                    check=True, capture_output=True, timeout=120)
+            lib = ctypes.CDLL(so)
+            configure(lib)
+            _libs[stem] = lib
+        except Exception:
+            _libs[stem] = None
+    return _libs[stem]
+
+
+def _configure_strcodec(lib):
+    lib.encode_sorted_dict_u32.restype = ctypes.c_int64
+    lib.encode_sorted_dict_u32.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_void_p, ctypes.c_void_p]
 
 
 def _load() -> Optional[ctypes.CDLL]:
-    global _lib, _lib_failed
-    if _lib is not None or _lib_failed:
-        return _lib
-    with _lock:
-        if _lib is not None or _lib_failed:
-            return _lib
-        try:
-            if not os.path.exists(_SO_PATH) or (
-                    os.path.exists(_SRC_PATH)
-                    and os.path.getmtime(_SRC_PATH) > os.path.getmtime(_SO_PATH)):
-                subprocess.run(
-                    ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
-                     _SRC_PATH, "-o", _SO_PATH],
-                    check=True, capture_output=True, timeout=120)
-            lib = ctypes.CDLL(_SO_PATH)
-            lib.encode_sorted_dict_u32.restype = ctypes.c_int64
-            lib.encode_sorted_dict_u32.argtypes = [
-                ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
-                ctypes.c_void_p, ctypes.c_void_p]
-            _lib = lib
-        except Exception:
-            _lib_failed = True
-            _lib = None
-    return _lib
+    return _load_lib("strcodec", _configure_strcodec)
 
 
 def native_available() -> bool:
@@ -114,3 +122,51 @@ def encode_sorted_dict(values: np.ndarray):
     dictionary = np.empty(k, dtype=object)
     dictionary[rank] = keys
     return codes, dictionary
+
+
+# ---------------------------------------------------------------------------
+# LZ4 block codec (native/lz4codec.cpp) — shuffle wire compression.
+# Reference analog: nvcomp BatchedLZ4Compressor (TableCompressionCodec.scala).
+# ---------------------------------------------------------------------------
+
+def _configure_lz4(lib):
+    lib.lz4_compress_bound.restype = ctypes.c_int64
+    lib.lz4_compress_bound.argtypes = [ctypes.c_int64]
+    lib.lz4_compress.restype = ctypes.c_int64
+    lib.lz4_compress.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64, ctypes.c_void_p, ctypes.c_int64]
+    lib.lz4_decompress.restype = ctypes.c_int64
+    lib.lz4_decompress.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64, ctypes.c_void_p, ctypes.c_int64]
+
+
+def lz4_available() -> bool:
+    return _load_lib("lz4codec", _configure_lz4) is not None
+
+
+def lz4_compress(data: bytes) -> Optional[bytes]:
+    """Compress to a raw LZ4 block; None if the native lib is unavailable.
+    The caller must track the uncompressed size (the block format does not)."""
+    lib = _load_lib("lz4codec", _configure_lz4)
+    if lib is None:
+        return None
+    n = len(data)
+    out = ctypes.create_string_buffer(lib.lz4_compress_bound(n))
+    written = lib.lz4_compress(data, n, out, len(out))
+    if written < 0:
+        raise RuntimeError("lz4_compress failed")
+    return out.raw[:written]
+
+
+def lz4_decompress(data: bytes, out_size: int) -> Optional[bytes]:
+    """Decompress a raw LZ4 block of known uncompressed size; None if the
+    native lib is unavailable; raises on corrupt input."""
+    lib = _load_lib("lz4codec", _configure_lz4)
+    if lib is None:
+        return None
+    out = ctypes.create_string_buffer(out_size) if out_size else b""
+    written = lib.lz4_decompress(data, len(data), out, out_size)
+    if written != out_size:
+        raise RuntimeError(
+            f"lz4_decompress: expected {out_size} bytes, got {written}")
+    return bytes(out.raw[:out_size]) if out_size else b""

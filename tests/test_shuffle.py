@@ -369,3 +369,44 @@ def test_aqe_partition_coalescing(session, cpu_session):
         .repartition(64, "k")
         .group_by("k").agg(F.count().alias("c"), F.sum(col("v")).alias("s")),
         on, cpu_session)
+
+
+def test_codec_resolution_and_roundtrip(session):
+    """lz4 resolves to the native C++ block codec, zstd to zstandard; the
+    resolved name must round-trip the data it claims to describe."""
+    from spark_rapids_tpu.shuffle.manager import (
+        _compress, _decompress, resolve_codec)
+    import numpy as np
+    payload = np.arange(10000, dtype=np.int64).tobytes() + b"tail" * 321
+    for requested in ("none", "zlib", "lz4", "zstd"):
+        resolved = resolve_codec(requested)
+        blob = _compress(resolved, payload)
+        assert _decompress(resolved, blob) == payload
+        if requested == "none":
+            assert resolved == "none" and blob == payload
+        else:
+            assert len(blob) < len(payload)
+
+
+def test_lz4_resolves_native(session):
+    from spark_rapids_tpu.native import lz4_available
+    from spark_rapids_tpu.shuffle.manager import resolve_codec
+    if lz4_available():
+        assert resolve_codec("lz4") == "lz4"
+    else:
+        assert resolve_codec("lz4") == "zlib"
+
+
+def test_shuffle_manager_lz4(session):
+    from spark_rapids_tpu.shuffle.manager import ShuffleManager
+    conf = session.conf.set("spark.rapids.shuffle.compression.codec", "lz4")
+    mgr = ShuffleManager(conf)
+    host = _id_table(500)
+    dt = DeviceTable.from_host(host)
+    h = mgr.new_shuffle(2)
+    h.write_partitions(split_by_partition(
+        dt, HashPartitioner([col("k").bind(host.schema())], 2)))
+    rows = sum(t.num_rows for p in range(2)
+               for t in mgr.reader(h).read_partition(p))
+    assert rows == 500
+    mgr.remove_shuffle(h)
