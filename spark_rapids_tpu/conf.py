@@ -125,7 +125,25 @@ SHUFFLE_MANAGER_MODE = str_conf(
     "spark.rapids.shuffle.mode", "MULTITHREADED",
     "MULTITHREADED (threaded host serialization over local shuffle files), "
     "ICI (collective all-to-all over the device mesh when all partitions "
-    "live on one slice), or CACHE_ONLY.")
+    "live on one slice), or P2P (cached map output served to peers through "
+    "the bounce-buffer transport — the UCX-mode analog).")
+
+P2P_TRANSPORT = str_conf(
+    "spark.rapids.shuffle.p2p.transport", "inprocess",
+    "P2P shuffle wire: tcp (length-prefixed frames over sockets, the DCN "
+    "path) or inprocess (direct calls; single-process and tests).")
+
+P2P_BOUNCE_BUFFER_SIZE = int_conf(
+    "spark.rapids.shuffle.p2p.bounceBufferSize", 4 << 20,
+    "Bytes per bounce buffer; also the transfer window size.")
+
+P2P_BOUNCE_BUFFERS = int_conf(
+    "spark.rapids.shuffle.p2p.bounceBuffers", 4,
+    "Bounce buffers per pool (bounds in-flight transfer memory).")
+
+P2P_CACHE_LIMIT = int_conf(
+    "spark.rapids.shuffle.p2p.cacheLimitBytes", 1 << 30,
+    "Host bytes of cached shuffle blocks before spilling to disk.")
 
 SHUFFLE_MT_WRITER_THREADS = int_conf(
     "spark.rapids.shuffle.multiThreaded.writer.threads", 8,

@@ -158,8 +158,19 @@ class TpuShuffleExchangeExec(TpuExec):
                                          dict_sorted=c.dict_sorted))
             yield DeviceTable(table.names, cols, n, k)
 
+    def _shuffle_manager(self):
+        """MULTITHREADED -> file-backed manager; P2P -> cached blocks
+        served through the client/server transport (UCX-mode analog). Both
+        expose the same write/read handle interface."""
+        from spark_rapids_tpu.conf import SHUFFLE_MANAGER_MODE
+        mode = str(self.conf.get_entry(SHUFFLE_MANAGER_MODE)).upper()
+        if mode == "P2P":
+            from spark_rapids_tpu.shuffle.p2p import get_p2p_env
+            return get_p2p_env(self.conf)
+        return get_shuffle_manager(self.conf)
+
     def _execute_host_shuffle(self, prefetched=None):
-        manager = get_shuffle_manager(self.conf)
+        manager = self._shuffle_manager()
         partitioner = make_partitioner(self.mode, self.keys, self.num_partitions)
         handle = manager.new_shuffle(self.num_partitions)
         try:

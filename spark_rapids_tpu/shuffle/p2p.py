@@ -1,0 +1,219 @@
+"""P2P (cached, transport-served) shuffle mode.
+
+Reference (SURVEY.md §2.6): UCX mode — ``RapidsCachingWriter``
+(RapidsShuffleInternalManagerBase.scala:1078) keeps map output resident in
+the ShuffleBufferCatalog instead of writing shuffle files; readers fetch
+blocks from peer executors through RapidsShuffleClient/Server over the
+transport, discovered via driver heartbeats.
+
+TPU mapping: one ``P2PShuffleEnv`` per executor wires catalog + server +
+transport + heartbeat endpoint. Within one engine process (one executor)
+the fetch still runs the full client/server protocol over the in-process
+transport (or TCP loopback), so the wire path is exercised in production
+use, not just tests; multi-executor topologies connect the same pieces
+over TCP (tests/test_shuffle_transport.py builds 2-3 executor meshes)."""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Iterator, List, Optional
+
+from spark_rapids_tpu.columnar import HostTable
+from spark_rapids_tpu.conf import (
+    RapidsConf,
+    SHUFFLE_COMPRESSION_CODEC,
+    P2P_BOUNCE_BUFFER_SIZE,
+    P2P_BOUNCE_BUFFERS,
+    P2P_CACHE_LIMIT,
+    P2P_TRANSPORT,
+)
+from spark_rapids_tpu.errors import ColumnarProcessingError
+from spark_rapids_tpu.shuffle.catalogs import (
+    ShuffleBufferCatalog,
+    ShuffleReceivedBufferCatalog,
+)
+from spark_rapids_tpu.shuffle.client_server import ShuffleClient, ShuffleServer
+from spark_rapids_tpu.shuffle.heartbeat import (
+    ShuffleHeartbeatEndpoint,
+    ShuffleHeartbeatManager,
+)
+from spark_rapids_tpu.shuffle.manager import (
+    _compress,
+    _decompress,
+    resolve_codec,
+)
+from spark_rapids_tpu.shuffle.serializer import pack_table, unpack_table
+from spark_rapids_tpu.shuffle.transport import (
+    BounceBufferManager,
+    Connection,
+    InProcessTransport,
+    PeerInfo,
+    TcpShuffleServerListener,
+    TcpTransport,
+)
+
+
+class P2PShuffleEnv:
+    """Executor-side wiring of the p2p shuffle (GpuShuffleEnv analog for
+    UCX mode). ``driver`` is the shared heartbeat manager; standalone use
+    (single executor) creates a private one."""
+
+    def __init__(self, conf: RapidsConf, executor_id: str = "exec-0",
+                 driver: Optional[ShuffleHeartbeatManager] = None):
+        self.executor_id = executor_id
+        self.codec = resolve_codec(
+            str(conf.get_entry(SHUFFLE_COMPRESSION_CODEC)).lower())
+        bounce_size = int(conf.get_entry(P2P_BOUNCE_BUFFER_SIZE))
+        bounce_n = int(conf.get_entry(P2P_BOUNCE_BUFFERS))
+        self.catalog = ShuffleBufferCatalog(
+            host_limit_bytes=int(conf.get_entry(P2P_CACHE_LIMIT)))
+        self.send_pool = BounceBufferManager(bounce_size, bounce_n)
+        self.recv_pool = BounceBufferManager(bounce_size, bounce_n)
+        self.server = ShuffleServer(self.catalog, self.send_pool)
+        self.window_size = bounce_size
+
+        kind = str(conf.get_entry(P2P_TRANSPORT)).lower()
+        self._listener: Optional[TcpShuffleServerListener] = None
+        if kind == "tcp":
+            self._listener = TcpShuffleServerListener(self.server)
+            self.transport = TcpTransport(self.recv_pool)
+            self.me = PeerInfo(executor_id, self._listener.host,
+                               self._listener.port)
+        elif kind == "inprocess":
+            InProcessTransport.register_server(executor_id, self.server)
+            self.transport = InProcessTransport(self.recv_pool)
+            self.me = PeerInfo(executor_id)
+        else:
+            raise ColumnarProcessingError(f"unknown p2p transport {kind}")
+
+        self._peers: Dict[str, PeerInfo] = {}
+        self._connections: Dict[str, Connection] = {}
+        self._conn_lock = threading.Lock()
+        self._shuffle_id_lock = threading.Lock()
+        self._next_shuffle = 0
+        self.driver = driver or ShuffleHeartbeatManager()
+        self.heartbeat = ShuffleHeartbeatEndpoint(
+            self.driver, self.me, self._on_new_peer)
+        self.heartbeat.start()
+
+    def _on_new_peer(self, peer: PeerInfo):
+        self._peers[peer.executor_id] = peer
+
+    def connection_to(self, executor_id: str) -> Connection:
+        with self._conn_lock:
+            conn = self._connections.get(executor_id)
+        if conn is not None:
+            return conn
+        peer = self.me if executor_id == self.executor_id \
+            else self._peers.get(executor_id)
+        if peer is None:
+            raise ColumnarProcessingError(
+                f"unknown peer {executor_id} (not heartbeat-discovered)")
+        # connect OUTSIDE the lock: a slow/unreachable peer must not stall
+        # connections to healthy ones (TCP connect can block for seconds)
+        conn = self.transport.connect(peer)
+        with self._conn_lock:
+            existing = self._connections.setdefault(executor_id, conn)
+        return existing
+
+    def client_for(self, executor_id: str) -> ShuffleClient:
+        return ShuffleClient(self.connection_to(executor_id),
+                             window_size=self.window_size)
+
+    def peers(self) -> List[str]:
+        return list(self._peers)
+
+    # -- engine ShuffleManager interface ------------------------------------
+    def new_shuffle(self, num_partitions: int) -> "P2PWriteHandle":
+        with self._shuffle_id_lock:
+            sid = self._next_shuffle
+            self._next_shuffle = sid + 1
+        return P2PWriteHandle(self, sid, num_partitions)
+
+    def reader(self, handle: "P2PWriteHandle") -> "P2PReadHandle":
+        return P2PReadHandle(self, handle)
+
+    def remove_shuffle(self, handle: "P2PWriteHandle"):
+        self.catalog.remove_shuffle(handle.shuffle_id)
+
+    def close(self):
+        self.heartbeat.close()
+        if self._listener is not None:
+            self._listener.close()
+        else:
+            InProcessTransport.unregister_server(self.executor_id)
+
+
+class P2PWriteHandle:
+    """Caching writer: each batch's partition split lands in the local
+    spillable catalog as one block per (map, partition)."""
+
+    def __init__(self, env: P2PShuffleEnv, shuffle_id: int,
+                 num_partitions: int):
+        self.env = env
+        self.shuffle_id = shuffle_id
+        self.num_partitions = num_partitions
+        self.num_maps = 0
+        self.bytes_written = 0
+
+    def write_partitions(self, partitions: List[HostTable]):
+        if len(partitions) != self.num_partitions:
+            raise ColumnarProcessingError("partition count mismatch")
+        map_id = self.num_maps
+        self.num_maps += 1
+        for p, table in enumerate(partitions):
+            if table.num_rows == 0:
+                continue
+            blob = _compress(self.env.codec, pack_table(table))
+            self.env.catalog.add_block((self.shuffle_id, map_id, p), blob)
+            self.bytes_written += len(blob)
+
+    @property
+    def map_outputs(self):  # parity with ShuffleWriteHandle for metrics
+        return list(range(self.num_maps))
+
+
+class P2PReadHandle:
+    """Reader: fetches a reduce partition through the full client/server
+    protocol from every executor that holds blocks for it."""
+
+    def __init__(self, env: P2PShuffleEnv, handle: P2PWriteHandle):
+        self.env = env
+        self.handle = handle
+        self.bytes_read = 0
+
+    def read_partition(self, p: int) -> Iterator[HostTable]:
+        sources = [self.env.executor_id] + [
+            ex for ex in self.env.peers() if ex != self.env.executor_id]
+        for executor_id in sources:
+            client = self.env.client_for(executor_id)
+            received = ShuffleReceivedBufferCatalog()
+            blocks = client.fetch_metadata(self.handle.shuffle_id, p)
+            if not blocks:
+                continue
+            # stream on this thread; drain inline (single-peer sequential
+            # fetch — the multi-peer overlap lives in the tests' threads)
+            client.fetch_blocks(blocks, received)
+            for _bid, blob in received.drain():
+                self.bytes_read += len(blob)
+                table, _ = unpack_table(_decompress(self.env.codec, blob))
+                if table.num_rows > 0:
+                    yield table
+
+
+_P2P_ENVS: Dict[tuple, P2PShuffleEnv] = {}
+_P2P_LOCK = threading.Lock()
+
+
+def get_p2p_env(conf: RapidsConf) -> P2PShuffleEnv:
+    key = (str(conf.get_entry(SHUFFLE_COMPRESSION_CODEC)).lower(),
+           str(conf.get_entry(P2P_TRANSPORT)).lower(),
+           int(conf.get_entry(P2P_BOUNCE_BUFFER_SIZE)),
+           int(conf.get_entry(P2P_BOUNCE_BUFFERS)),
+           int(conf.get_entry(P2P_CACHE_LIMIT)))
+    with _P2P_LOCK:
+        env = _P2P_ENVS.get(key)
+        if env is None:
+            env = P2PShuffleEnv(conf, executor_id=f"exec-local-{len(_P2P_ENVS)}")
+            _P2P_ENVS[key] = env
+        return env

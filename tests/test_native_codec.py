@@ -30,8 +30,7 @@ def test_high_cardinality_native_sort():
 
 
 def test_fallback_without_library(monkeypatch):
-    monkeypatch.setattr(native, "_lib", None)
-    monkeypatch.setattr(native, "_lib_failed", True)
+    monkeypatch.setattr(native, "_libs", {"strcodec": None})
     _check(["x", "a", "x", "b"] * 50)
     rng = np.random.default_rng(2)
     vals = [f"v{rng.integers(0, 10**6)}" for i in range(5000)]
