@@ -78,6 +78,10 @@ class TpuSession:
         from spark_rapids_tpu.io.orc import OrcScanNode
         return DataFrame(OrcScanNode(list(paths), self.conf, **options), self)
 
+    def read_avro(self, *paths, **options) -> DataFrame:
+        from spark_rapids_tpu.io.avro import AvroScanNode
+        return DataFrame(AvroScanNode(list(paths), self.conf, **options), self)
+
     def read_hive_text(self, *paths, schema=None, **options) -> DataFrame:
         from spark_rapids_tpu.io.hive_text import HiveTextScanNode
         return DataFrame(HiveTextScanNode(list(paths), self.conf,

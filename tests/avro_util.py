@@ -1,0 +1,91 @@
+"""Minimal Avro container-file WRITER for test data generation (the image
+has no Avro library; the reference generates avro test data with
+spark-avro in its integration suite). Supports what the scan supports:
+records of primitives, ["null", T] unions, date/timestamp logical types,
+codecs null/deflate/zstandard."""
+
+import io
+import json
+import struct
+import zlib
+
+
+def _zigzag(n: int) -> bytes:
+    u = (n << 1) ^ (n >> 63)
+    out = bytearray()
+    while True:
+        b = u & 0x7F
+        u >>= 7
+        if u:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _encode_value(field_schema, v, out: io.BytesIO):
+    if isinstance(field_schema, list):
+        null_index = field_schema.index("null")
+        if v is None:
+            out.write(_zigzag(null_index))
+            return
+        branch = [b for b in field_schema if b != "null"][0]
+        out.write(_zigzag(1 - null_index))
+        _encode_value(branch, v, out)
+        return
+    if isinstance(field_schema, dict):
+        logical = field_schema.get("logicalType")
+        if logical == "timestamp-millis":
+            out.write(_zigzag(int(v)))
+            return
+        _encode_value(field_schema["type"], v, out)
+        return
+    if field_schema in ("int", "long"):
+        out.write(_zigzag(int(v)))
+    elif field_schema == "boolean":
+        out.write(b"\x01" if v else b"\x00")
+    elif field_schema == "float":
+        out.write(struct.pack("<f", v))
+    elif field_schema == "double":
+        out.write(struct.pack("<d", v))
+    elif field_schema == "string":
+        b = v.encode("utf-8")
+        out.write(_zigzag(len(b)) + b)
+    else:
+        raise ValueError(f"unsupported avro type {field_schema!r}")
+
+
+def write_avro(path, schema: dict, rows, codec="null", rows_per_block=1000,
+               sync=b"0123456789abcdef"):
+    """rows: list of dicts keyed by field name."""
+    fields = schema["fields"]
+    meta = {"avro.schema": json.dumps(schema).encode(),
+            "avro.codec": codec.encode()}
+    with open(path, "wb") as f:
+        f.write(b"Obj\x01")
+        f.write(_zigzag(len(meta)))
+        for k, v in meta.items():
+            kb = k.encode()
+            f.write(_zigzag(len(kb)) + kb)
+            f.write(_zigzag(len(v)) + v)
+        f.write(_zigzag(0))
+        f.write(sync)
+        for start in range(0, len(rows), rows_per_block):
+            chunk = rows[start:start + rows_per_block]
+            body = io.BytesIO()
+            for row in chunk:
+                for fld in fields:
+                    _encode_value(fld["type"], row[fld["name"]], body)
+            data = body.getvalue()
+            if codec == "deflate":
+                c = zlib.compressobj(wbits=-15)
+                data = c.compress(data) + c.flush()
+            elif codec == "zstandard":
+                import zstandard
+                data = zstandard.ZstdCompressor().compress(data)
+            elif codec != "null":
+                raise ValueError(f"unsupported codec {codec}")
+            f.write(_zigzag(len(chunk)))
+            f.write(_zigzag(len(data)))
+            f.write(data)
+            f.write(sync)
