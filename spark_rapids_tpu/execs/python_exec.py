@@ -1,0 +1,216 @@
+"""Pandas/Arrow Python UDF execs.
+
+Reference (SURVEY.md §2.3/§3.5): ``GpuArrowEvalPythonExec.scala`` and the
+``execution/python/`` family — device batch → Arrow → Python worker →
+Arrow → device, gated by ``PythonWorkerSemaphore.scala`` (limits how many
+Python workers hold device resources concurrently).
+
+TPU mapping: the device batch round-trips through pyarrow exactly as the
+reference's Arrow IPC boundary does (device columnar → host Arrow →
+pandas → user fn → pandas → Arrow → device upload); the semaphore analog
+bounds concurrent UDF evaluations per process. The user function runs
+in-process (the engine IS Python), which removes the worker-daemon
+plumbing but keeps every data-movement boundary the reference models."""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Iterator, List, Optional
+
+from spark_rapids_tpu.columnar import DeviceTable, HostTable
+from spark_rapids_tpu.conf import int_conf
+from spark_rapids_tpu.errors import ColumnarProcessingError
+from spark_rapids_tpu.execs.base import TpuExec
+from spark_rapids_tpu.plan.pandas_udf import (
+    AggregateInPandas,
+    ArrowEvalPython,
+    FlatMapGroupsInPandas,
+    MapInPandas,
+    _pandas_to_host,
+)
+
+CONCURRENT_PYTHON_WORKERS = int_conf(
+    "spark.rapids.python.concurrentPythonWorkers", 0,
+    "Max concurrent Python UDF evaluations holding device data "
+    "(0 = unlimited; PythonWorkerSemaphore analog).")
+
+
+class PythonWorkerSemaphore:
+    """Process-wide gate on concurrent Python UDF work
+    (PythonWorkerSemaphore.scala analog). One persistent semaphore per
+    configured permit count — never rebuilt while permits are held, so a
+    config's cap always holds and releases always reach the semaphore
+    they were acquired from."""
+
+    _lock = threading.Lock()
+    _sems: dict = {}
+
+    @classmethod
+    def acquire_if_necessary(cls, permits: int):
+        if permits <= 0:
+            return None
+        with cls._lock:
+            sem = cls._sems.get(permits)
+            if sem is None:
+                sem = cls._sems[permits] = threading.Semaphore(permits)
+        sem.acquire()
+        return sem
+
+    @staticmethod
+    def release(sem):
+        if sem is not None:
+            sem.release()
+
+
+def _arrow_roundtrip_to_pandas(table: HostTable):
+    """Host columnar → Arrow → pandas (the GpuArrowWriter direction)."""
+    from spark_rapids_tpu.io.arrow_convert import host_table_to_arrow
+    return host_table_to_arrow(table).to_pandas()
+
+
+class _PythonExecBase(TpuExec):
+    def __init__(self, child: TpuExec, node, conf):
+        super().__init__()
+        self.children = (child,)
+        self.node = node
+        self.permits = int(conf.get_entry(CONCURRENT_PYTHON_WORKERS))
+
+    def output_schema(self):
+        return self.node.output_schema()
+
+    def _run_udf(self, fn, *args):
+        sem = PythonWorkerSemaphore.acquire_if_necessary(self.permits)
+        t0 = time.perf_counter()
+        try:
+            return fn(*args)
+        finally:
+            PythonWorkerSemaphore.release(sem)
+            self.add_metric("pythonUdfTime", time.perf_counter() - t0)
+
+    def _download(self, batch: DeviceTable):
+        t0 = time.perf_counter()
+        pdf = _arrow_roundtrip_to_pandas(batch.to_host())
+        self.add_metric("d2hArrowTime", time.perf_counter() - t0)
+        return pdf
+
+    def _upload(self, host: HostTable) -> DeviceTable:
+        t0 = time.perf_counter()
+        dt = DeviceTable.from_host(host)
+        self.add_metric("h2dArrowTime", time.perf_counter() - t0)
+        return dt
+
+    def describe(self):
+        return f"Tpu{type(self.node).__name__}Exec"
+
+
+class TpuMapInPandasExec(_PythonExecBase):
+    def execute(self) -> Iterator[DeviceTable]:
+        node: MapInPandas = self.node
+
+        def pdfs():
+            for batch in self.children[0].execute():
+                yield self._download(batch)
+
+        # the user generator holds the worker slot for its whole stream
+        # (the reference's python worker owns its task for the task's life)
+        sem = PythonWorkerSemaphore.acquire_if_necessary(self.permits)
+        t0 = time.perf_counter()
+        try:
+            for out in node.fn(pdfs()):
+                host = _pandas_to_host(out, node.schema)
+                if host.num_rows:
+                    yield self._upload(host)
+        finally:
+            PythonWorkerSemaphore.release(sem)
+            self.add_metric("pythonUdfTime", time.perf_counter() - t0)
+
+
+class TpuFlatMapGroupsInPandasExec(_PythonExecBase):
+    def execute(self) -> Iterator[DeviceTable]:
+        node: FlatMapGroupsInPandas = self.node
+        batches = [self._download(b) for b in self.children[0].execute()]
+        if not batches:
+            return
+        import pandas as pd
+        pdf = pd.concat(batches, ignore_index=True) if len(batches) > 1 \
+            else batches[0]
+        if len(pdf) == 0:
+            return
+        for _key, group in pdf.groupby(node.keys, dropna=False, sort=True):
+            out = self._run_udf(node.fn, group.reset_index(drop=True))
+            if len(out):
+                yield self._upload(_pandas_to_host(out, node.schema))
+
+
+class TpuAggregateInPandasExec(_PythonExecBase):
+    def execute(self) -> Iterator[DeviceTable]:
+        node: AggregateInPandas = self.node
+        import pandas as pd
+        batches = [self._download(b) for b in self.children[0].execute()]
+        schema = node.output_schema()
+        if not batches:
+            yield self._upload(_pandas_to_host(
+                pd.DataFrame(columns=[n for n, _ in schema]), schema))
+            return
+        pdf = pd.concat(batches, ignore_index=True) if len(batches) > 1 \
+            else batches[0]
+        rows = []
+        if len(pdf):
+            for key, group in pdf.groupby(node.keys, dropna=False,
+                                          sort=True):
+                if not isinstance(key, tuple):
+                    key = (key,)
+                row = dict(zip(node.keys, key))
+                for name, fn, _rt, args in node.aggs:
+                    row[name] = self._run_udf(
+                        fn, *[group[a] for a in args])
+                rows.append(row)
+        out = pd.DataFrame(rows, columns=[n for n, _ in schema])
+        yield self._upload(_pandas_to_host(out, schema))
+
+
+class TpuArrowEvalPythonExec(_PythonExecBase):
+    """Child columns pass through ON DEVICE; only UDF argument columns
+    round-trip through Arrow, results upload and append — the reference's
+    batch-queue + zip design (GpuArrowEvalPythonExec BatchQueue)."""
+
+    def execute(self) -> Iterator[DeviceTable]:
+        import pandas as pd
+        node: ArrowEvalPython = self.node
+        from spark_rapids_tpu.ops.expr import compile_project
+        for batch in self.children[0].execute():
+            extra_schema = [(name, rt) for name, _f, rt, _a in node.udfs]
+            frames = {}
+            for name, fn, rt, args in node.udfs:
+                # evaluate arg exprs on DEVICE, download just those columns
+                arg_cols = compile_project(list(args), batch)
+                arg_table = DeviceTable(
+                    [f"a{i}" for i in range(len(arg_cols))], arg_cols,
+                    batch.num_rows, batch.capacity)
+                arg_pdf = self._download(arg_table)
+                result = self._run_udf(
+                    fn, *[arg_pdf[c] for c in arg_pdf.columns])
+                if len(result) != len(arg_pdf):
+                    raise ColumnarProcessingError(
+                        f"scalar pandas UDF {name} returned {len(result)} "
+                        f"rows for a {len(arg_pdf)}-row batch")
+                frames[name] = (result if hasattr(result, "reset_index")
+                                else pd.Series(result))
+            extra = _pandas_to_host(pd.DataFrame(frames), extra_schema)
+            from spark_rapids_tpu.columnar import bucket_for
+            if bucket_for(max(extra.num_rows, 1)) == batch.capacity:
+                # common case: zip on device, pass-through columns never
+                # leave HBM
+                extra_dev = self._upload(extra)
+                yield DeviceTable(
+                    list(batch.names) + list(extra_dev.names),
+                    list(batch.columns) + list(extra_dev.columns),
+                    batch.num_rows, batch.capacity)
+            else:
+                # capacity buckets differ (batch padded past num_rows):
+                # align on host, one upload
+                host = batch.to_host()
+                yield self._upload(HostTable(
+                    list(host.names) + list(extra.names),
+                    list(host.columns) + list(extra.columns)))

@@ -540,3 +540,12 @@ def from_utc_timestamp(e, tz):
 def to_utc_timestamp(e, tz):
     from spark_rapids_tpu.ops.misc import ToUTCTimestamp
     return ToUTCTimestamp(_e(e), _e(tz))
+
+
+def pandas_udf(return_type, function_type: str = "scalar"):
+    """Pandas UDF factory (reference: execution/python/ pandas UDF execs).
+    @F.pandas_udf("double") for scalar (Series -> Series per batch);
+    @F.pandas_udf("double", "grouped_agg") for group aggregates
+    (Series -> scalar per group, used in group_by().agg())."""
+    from spark_rapids_tpu.plan.pandas_udf import pandas_udf as _pu
+    return _pu(return_type, function_type)

@@ -525,6 +525,51 @@ exec_rule(P.WindowNode, _tag_window, _convert_window)
 exec_rule(P.Exchange, _tag_exchange, _convert_exchange)
 
 
+# -- pandas/Arrow Python UDF execs (execution/python/ analogs) ---------------
+
+def _tag_python_udf(meta, conf):
+    _check_output_schema(meta, conf)
+    # ArrowEvalPython evaluates its UDF ARGUMENT expressions on device
+    # (compile_project); they must pass the same expression checks as a
+    # project, or the whole node falls back
+    udfs = getattr(meta.node, "udfs", None)
+    if udfs:
+        for name, _fn, _rt, args in udfs:
+            for a in args:
+                check_expr(a, conf, meta.reasons, f"pandas UDF {name} arg ")
+
+
+def _convert_python_exec(cls):
+    def convert(node, children, conf):
+        return cls(children[0], node, conf)
+    return convert
+
+
+def _register_pandas_udf_rules():
+    from spark_rapids_tpu.execs.python_exec import (
+        TpuAggregateInPandasExec,
+        TpuArrowEvalPythonExec,
+        TpuFlatMapGroupsInPandasExec,
+        TpuMapInPandasExec,
+    )
+    from spark_rapids_tpu.plan import pandas_udf as PU
+    exec_rule(PU.MapInPandas, _tag_python_udf,
+              _convert_python_exec(TpuMapInPandasExec),
+              "Enable MapInPandas on the accelerator.")
+    exec_rule(PU.FlatMapGroupsInPandas, _tag_python_udf,
+              _convert_python_exec(TpuFlatMapGroupsInPandasExec),
+              "Enable FlatMapGroupsInPandas on the accelerator.")
+    exec_rule(PU.AggregateInPandas, _tag_python_udf,
+              _convert_python_exec(TpuAggregateInPandasExec),
+              "Enable AggregateInPandas on the accelerator.")
+    exec_rule(PU.ArrowEvalPython, _tag_python_udf,
+              _convert_python_exec(TpuArrowEvalPythonExec),
+              "Enable scalar pandas UDF eval on the accelerator.")
+
+
+_register_pandas_udf_rules()
+
+
 # ---------------------------------------------------------------------------
 # Meta + conversion
 # ---------------------------------------------------------------------------

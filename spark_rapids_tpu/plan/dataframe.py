@@ -68,7 +68,31 @@ class DataFrame:
                              + [col(n2) for n2 in names]
                              + [*exprs[i + 1:]])]
             return DataFrame(g, self.session)._wrap(P.Project(g, out))
+
+        # scalar pandas UDFs in the select list plan as ArrowEvalPython +
+        # Project (the reference splits PythonUDF out of projects the same
+        # way — GpuArrowEvalPythonExec)
+        from spark_rapids_tpu.plan.pandas_udf import (
+            PandasUDFExpr,
+            extract_scalar_udfs,
+        )
+        def _contains_udf(e):
+            return isinstance(e, PandasUDFExpr) or any(
+                _contains_udf(c) for c in e.children)
+
+        if any(_contains_udf(e) for e in exprs):
+            names = [output_name(e, f"col{i}") for i, e in enumerate(exprs)]
+            plan, rewritten = extract_scalar_udfs(self.plan, exprs, names)
+            return self._wrap(P.Project(plan, rewritten))
         return self._wrap(P.Project(self.plan, exprs))
+
+    def map_in_pandas(self, fn, schema) -> "DataFrame":
+        """fn(iterator of pandas DataFrames) -> iterator of pandas
+        DataFrames (Spark mapInPandas; GpuMapInPandasExec analog)."""
+        from spark_rapids_tpu.plan.pandas_udf import MapInPandas
+        return self._wrap(MapInPandas(self.plan, fn, schema))
+
+    mapInPandas = map_in_pandas
 
     def with_column(self, name: str, expr: Expression) -> "DataFrame":
         existing = [col(n) for n, _ in self.plan.output_schema() if n != name]
@@ -211,8 +235,57 @@ class GroupedData:
         self.df = df
         self.keys = keys
 
+    def _key_names(self, what: str):
+        names = []
+        for k in self.keys:
+            if not isinstance(k, AttributeReference):
+                raise ValueError(
+                    f"{what} requires plain column-name grouping keys")
+            names.append(k.col_name)
+        return names
+
     def agg(self, *aggs) -> DataFrame:
+        from spark_rapids_tpu.plan.pandas_udf import (
+            AggregateInPandas,
+            PandasUDFExpr,
+        )
+
+        def _udf_of(e):
+            inner = e.children[0] if isinstance(e, Alias) else e
+            return inner if isinstance(inner, PandasUDFExpr) else None
+
+        udfs = [_udf_of(e) for e in aggs]
+        if any(u is not None for u in udfs):
+            if not all(u is not None and u.kind == "grouped_agg"
+                       for u in udfs):
+                raise ValueError(
+                    "pandas grouped-agg UDFs cannot mix with built-in "
+                    "aggregates in one agg() (Spark restriction)")
+            keys = self._key_names("agg with pandas UDFs")
+            entries = []
+            for e, u in zip(aggs, udfs):
+                out = output_name(e, u.udf_name)
+                args = []
+                for a in u.children:
+                    if not isinstance(a, AttributeReference):
+                        raise ValueError(
+                            "pandas grouped-agg UDF args must be plain "
+                            "columns")
+                    args.append(a.col_name)
+                entries.append((out, u.fn, u.data_type, args))
+            return self.df._wrap(
+                AggregateInPandas(self.df.plan, keys, entries))
         return self.df._wrap(P.Aggregate(self.df.plan, self.keys, list(aggs)))
+
+    def apply_in_pandas(self, fn, schema) -> DataFrame:
+        """fn(pandas DataFrame of one group) -> pandas DataFrame
+        (Spark applyInPandas; GpuFlatMapGroupsInPandasExec analog)."""
+        from spark_rapids_tpu.plan.pandas_udf import FlatMapGroupsInPandas
+        keys = self._key_names("apply_in_pandas")
+        return self.df._wrap(
+            FlatMapGroupsInPandas(self.df.plan, keys, fn, schema))
+
+    applyInPandas = apply_in_pandas
 
 
 def from_pydict(data, dtypes=None, session=None, num_batches: int = 1) -> DataFrame:

@@ -1,0 +1,200 @@
+"""Pandas/Arrow Python UDF exec tests (reference: udf_test.py +
+execution/python/ execs — SURVEY.md §2.3/§3.5)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu import functions as F
+from spark_rapids_tpu.errors import ColumnarProcessingError
+from spark_rapids_tpu.ops.expr import col
+from spark_rapids_tpu import types as T
+
+
+def _df(s, n=600, batches=3, seed=0):
+    rng = np.random.default_rng(seed)
+    return s.create_dataframe(
+        {"k": rng.integers(0, 8, n).astype(np.int64),
+         "v": rng.standard_normal(n),
+         "w": rng.integers(-50, 50, n).astype(np.int64)},
+        num_batches=batches)
+
+
+# -- map_in_pandas -----------------------------------------------------------
+
+def test_map_in_pandas(session, cpu_session):
+    def fn(pdfs):
+        for pdf in pdfs:
+            out = pdf[pdf.v > 0][["k", "v"]].copy()
+            out["v2"] = out.v * 2
+            yield out
+
+    def q(s):
+        return _df(s).map_in_pandas(
+            fn, [("k", T.LONG), ("v", T.DOUBLE), ("v2", T.DOUBLE)])
+
+    got = sorted(q(session).collect())
+    want = sorted(q(cpu_session).collect())
+    assert got == want
+    assert len(got) > 0
+
+
+def test_map_in_pandas_runs_on_tpu(session):
+    df = _df(session).map_in_pandas(
+        lambda it: (pdf[["k"]] for pdf in it), [("k", T.LONG)])
+    plan = df.explain()
+    assert "TpuMapInPandasExec" in plan or "MapInPandas" in plan
+    assert df.count() == 600
+
+
+def test_map_in_pandas_schema_mismatch_raises(session):
+    df = _df(session).map_in_pandas(
+        lambda it: (pdf[["k"]] for pdf in it),
+        [("missing", T.STRING)])
+    with pytest.raises(ColumnarProcessingError, match="declared schema"):
+        df.collect()
+
+
+# -- apply_in_pandas (FlatMapGroupsInPandas) --------------------------------
+
+def test_apply_in_pandas(session, cpu_session):
+    def center(pdf):
+        out = pdf.copy()
+        out["v"] = out.v - out.v.mean()
+        return out[["k", "v"]]
+
+    def q(s):
+        return (_df(s).group_by("k")
+                .apply_in_pandas(center, [("k", T.LONG), ("v", T.DOUBLE)]))
+
+    got = sorted(q(session).collect())
+    want = sorted(q(cpu_session).collect())
+    assert len(got) == len(want) == 600
+    for g, w in zip(got, want):
+        assert g[0] == w[0]
+        assert abs(g[1] - w[1]) <= 1e-9 * max(1.0, abs(w[1]))
+
+
+def test_apply_in_pandas_shrinking_groups(session):
+    # fn returning one row per group (top-1 by v)
+    def top1(pdf):
+        return pdf.nlargest(1, "v")[["k", "v"]]
+
+    df = (_df(session).group_by("k")
+          .apply_in_pandas(top1, [("k", T.LONG), ("v", T.DOUBLE)]))
+    rows = df.collect()
+    assert len(rows) == 8  # one per key
+
+
+# -- grouped-agg pandas UDFs (AggregateInPandas) ----------------------------
+
+def test_aggregate_in_pandas(session, cpu_session):
+    @F.pandas_udf("double", "grouped_agg")
+    def mean_udf(v: pd.Series) -> float:
+        return float(v.mean())
+
+    @F.pandas_udf("long", "grouped_agg")
+    def span_udf(w: pd.Series) -> int:
+        return int(w.max() - w.min())
+
+    def q(s):
+        return (_df(s).group_by("k")
+                .agg(mean_udf("v").alias("m"), span_udf("w").alias("s")))
+
+    got = sorted(q(session).collect())
+    want = sorted(q(cpu_session).collect())
+    assert len(got) == len(want) == 8
+    for g, w in zip(got, want):
+        assert g[0] == w[0] and g[2] == w[2]
+        assert abs(g[1] - w[1]) <= 1e-9 * max(1.0, abs(w[1]))
+
+
+def test_mixing_pandas_and_builtin_aggs_rejected(session):
+    @F.pandas_udf("double", "grouped_agg")
+    def m(v):
+        return float(v.mean())
+
+    with pytest.raises(ValueError, match="cannot mix"):
+        _df(session).group_by("k").agg(m("v"), F.sum("v").alias("s"))
+
+
+# -- scalar pandas UDFs (ArrowEvalPython) -----------------------------------
+
+def test_scalar_pandas_udf_in_select(session, cpu_session):
+    @F.pandas_udf("double")
+    def plus_one(v: pd.Series) -> pd.Series:
+        return v + 1.0
+
+    @F.pandas_udf("string")
+    def fmt(k: pd.Series, w: pd.Series) -> pd.Series:
+        return k.astype(str) + ":" + w.astype(str)
+
+    def q(s):
+        return _df(s).select("k", plus_one("v").alias("v1"),
+                             fmt("k", "w").alias("t"))
+
+    got = sorted(q(session).collect())
+    want = sorted(q(cpu_session).collect())
+    assert got == want
+    assert isinstance(got[0][2], str) and ":" in got[0][2]
+
+
+def test_scalar_udf_over_expression_args(session, cpu_session):
+    @F.pandas_udf("double")
+    def square(x: pd.Series) -> pd.Series:
+        return x * x
+
+    def q(s):
+        return _df(s).select(square(col("v") + col("w")).alias("sq"))
+
+    got = sorted(q(session).collect())
+    want = sorted(q(cpu_session).collect())
+    assert len(got) == len(want)
+    for g, w in zip(got, want):
+        assert abs(g[0] - w[0]) <= 1e-9 * max(1.0, abs(w[0]))
+
+
+def test_nested_scalar_udf_rejected(session):
+    @F.pandas_udf("double")
+    def p1(v):
+        return v + 1
+
+    with pytest.raises(ColumnarProcessingError, match="top-level"):
+        _df(session).select((p1("v") + col("w")).alias("x"))
+
+
+def test_wrong_length_result_raises(session):
+    @F.pandas_udf("double")
+    def bad(v: pd.Series) -> pd.Series:
+        return v.head(3)
+
+    df = _df(session).select(bad("v").alias("x"))
+    with pytest.raises(ColumnarProcessingError, match="rows"):
+        df.collect()
+
+
+# -- worker semaphore --------------------------------------------------------
+
+def test_python_worker_semaphore_bounds_concurrency(session):
+    import threading
+    from spark_rapids_tpu.session import TpuSession
+
+    live = [0]
+    peak = [0]
+    lock = threading.Lock()
+
+    def probe(pdf):
+        with lock:
+            live[0] += 1
+            peak[0] = max(peak[0], live[0])
+        import time
+        time.sleep(0.02)
+        with lock:
+            live[0] -= 1
+        return pdf[["k", "v"]]
+
+    s = TpuSession({"spark.rapids.python.concurrentPythonWorkers": "1"})
+    df = (_df(s).group_by("k")
+          .apply_in_pandas(probe, [("k", T.LONG), ("v", T.DOUBLE)]))
+    assert df.count() == 600
+    assert peak[0] == 1
