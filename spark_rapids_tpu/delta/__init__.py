@@ -1,0 +1,25 @@
+"""Delta Lake connector (reference: delta-lake/ module family, 35k LoC —
+SURVEY.md §2.8). Native implementation of the Delta protocol (JSON log +
+parquet checkpoints + deletion vectors) over this engine's scan/write
+paths: snapshot reads with time travel, append/overwrite writes with
+per-file stats, DELETE (deletion-vector path), UPDATE, MERGE, OPTIMIZE
+(+Z-ORDER), VACUUM, DESCRIBE HISTORY."""
+
+from spark_rapids_tpu.delta.commands import DeltaTable, MergeBuilder
+from spark_rapids_tpu.delta.log import (
+    DeltaConcurrentModificationException,
+    DeltaLog,
+    Snapshot,
+)
+from spark_rapids_tpu.delta.table import DeltaScanNode, write_delta
+
+__all__ = [
+    "DeltaTable", "MergeBuilder", "DeltaLog", "Snapshot",
+    "DeltaConcurrentModificationException", "DeltaScanNode", "write_delta",
+]
+
+# register the scan with the overrides engine (kill switch:
+# spark.rapids.sql.exec.DeltaScanNode)
+from spark_rapids_tpu.overrides.rules import register_file_scan
+
+register_file_scan(DeltaScanNode)

@@ -1,0 +1,424 @@
+"""Delta DML commands: DELETE, UPDATE, MERGE, OPTIMIZE (+Z-ORDER), VACUUM.
+
+Reference (SURVEY.md §2.8): ``GpuDeleteCommand`` / ``GpuUpdateCommand`` /
+``GpuMergeIntoCommand`` (+``GpuLowShuffleMergeCommand``), ``GpuOptimize``
+/auto-compact, Z-ORDER via the zorder kernel, all inside
+``GpuOptimisticTransaction`` commits.
+
+TPU mapping kept per-file, like the reference's copy-on-write:
+- DELETE: files whose every row matches are removed; partially-matched
+  files get a deletion vector (merged with any existing one) — the
+  deletion-vector write path.
+- UPDATE: matched files are rewritten (surviving rows + updates applied).
+- MERGE: equi-key merge — matched rows update/delete, unmatched source
+  rows insert; touched target files rewrite.
+- OPTIMIZE: bin-packs small files to the target size; ZORDER BY reorders
+  rows by the interleaved-bits key before rewriting.
+- VACUUM: removes data files no longer referenced by the latest snapshot.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from spark_rapids_tpu.columnar import HostColumn, HostTable
+from spark_rapids_tpu.errors import ColumnarProcessingError
+from spark_rapids_tpu.delta.log import AddFile, DeltaLog, RemoveFile
+from spark_rapids_tpu.delta.table import (
+    DeltaScanNode,
+    OptimisticTransaction,
+    _mask_table,
+    _write_data_file,
+    read_dv,
+    write_dv_file,
+)
+from spark_rapids_tpu.delta.zorder import zorder_sort_indexes
+from spark_rapids_tpu.ops.expr import Expression, bind
+
+
+def _cast_col(col: HostColumn, dt) -> HostColumn:
+    if col.dtype.simple_string() == dt.simple_string():
+        return col
+    from spark_rapids_tpu.ops.cast import _cast_data_np
+    return HostColumn(dt, _cast_data_np(col.data, col.dtype, dt),
+                      col.validity)
+
+
+def _read_physical(table_path: str, add: AddFile, schema) -> HostTable:
+    """One data file's PHYSICAL rows (no DV applied) as the data schema."""
+    import pyarrow.parquet as pq
+
+    from spark_rapids_tpu.io.arrow_convert import decode_to_schema
+    t = pq.read_table(os.path.join(table_path, add.path))
+    return decode_to_schema(t, schema)
+
+
+from spark_rapids_tpu.delta.table import attach_partition_columns as \
+    _with_partitions  # shared with the scan path
+
+
+class DeltaTable:
+    """User API (io.delta.tables.DeltaTable analog)."""
+
+    def __init__(self, session, table_path: str):
+        self.session = session
+        self.table_path = table_path
+        self.log = DeltaLog(table_path)
+        if not self.log.exists():
+            raise ColumnarProcessingError(
+                f"{table_path} is not a delta table")
+
+    # -- read ----------------------------------------------------------------
+    def to_df(self, version_as_of: Optional[int] = None):
+        from spark_rapids_tpu.plan.dataframe import DataFrame
+        return DataFrame(
+            DeltaScanNode(self.table_path, self.session.conf,
+                          version_as_of=version_as_of), self.session)
+
+    def history(self) -> List[dict]:
+        return self.log.history()
+
+    def version(self) -> int:
+        return self.log.latest_version()
+
+    # -- shared helpers ------------------------------------------------------
+    def _ctx(self):
+        snap = self.log.snapshot()
+        parts = set(snap.metadata.partition_columns)
+        data_schema = [(n, dt) for n, dt in snap.schema if n not in parts]
+        part_schema = [(n, dt) for n, dt in snap.schema if n in parts]
+        return snap, data_schema, part_schema
+
+    def _eval_mask(self, cond: Expression, table: HostTable) -> np.ndarray:
+        bound = bind(cond, table.schema())
+        res = bound.eval_cpu(table)
+        return np.asarray(res.data, dtype=bool) & res.validity
+
+    # -- DELETE --------------------------------------------------------------
+    def delete(self, condition: Optional[Expression] = None) -> dict:
+        """Returns {"num_affected_rows": N}; deletion-vector write path
+        for partial files (GpuDeleteCommand + DV support)."""
+        snap, data_schema, part_schema = self._ctx()
+        txn = OptimisticTransaction(self.log, self.session.conf,
+                                    read_version=snap.version)
+        now = int(time.time() * 1000)
+        affected = 0
+        for add in snap.files:
+            if condition is None:
+                n = add.num_records
+                if n is None:
+                    n = _read_physical(self.table_path, add,
+                                       data_schema).num_rows
+                if add.deletion_vector:
+                    # stats count PHYSICAL rows; already-deleted ones are
+                    # not affected by this delete
+                    n -= add.deletion_vector.get("cardinality", 0)
+                affected += max(n, 0)
+                txn.stage(RemoveFile(add.path, now))
+                continue
+            phys = _read_physical(self.table_path, add, data_schema)
+            full = _with_partitions(phys, add, part_schema)
+            matched = self._eval_mask(condition, full)
+            already = np.zeros(phys.num_rows, dtype=bool)
+            if add.deletion_vector:
+                dv = read_dv(self.table_path, add.deletion_vector)
+                already[dv[dv < phys.num_rows]] = True
+            new_hits = matched & ~already
+            if not new_hits.any():
+                continue
+            affected += int(new_hits.sum())
+            total = already | matched
+            if total.all():
+                txn.stage(RemoveFile(add.path, now))
+            else:
+                desc = write_dv_file(self.table_path,
+                                     np.flatnonzero(total).astype(np.int64))
+                txn.stage(RemoveFile(add.path, now, data_change=False))
+                txn.stage(AddFile(
+                    path=add.path, partition_values=add.partition_values,
+                    size=add.size, modification_time=now,
+                    data_change=False, stats=add.stats,
+                    deletion_vector=desc))
+        if txn.actions:
+            txn.commit("DELETE")
+        return {"num_affected_rows": affected}
+
+    # -- UPDATE --------------------------------------------------------------
+    def update(self, condition: Optional[Expression],
+               set: Dict[str, Expression]) -> dict:  # noqa: A002
+        """Copy-on-write rewrite of matched files (GpuUpdateCommand)."""
+        snap, data_schema, part_schema = self._ctx()
+        part_names = {n for n, _ in part_schema}
+        for c in set:
+            if c in part_names:
+                raise ColumnarProcessingError(
+                    f"cannot UPDATE partition column {c!r}")
+        txn = OptimisticTransaction(self.log, self.session.conf,
+                                    read_version=snap.version)
+        now = int(time.time() * 1000)
+        affected = 0
+        for add in snap.files:
+            phys = _read_physical(self.table_path, add, data_schema)
+            live = np.ones(phys.num_rows, dtype=bool)
+            if add.deletion_vector:
+                dv = read_dv(self.table_path, add.deletion_vector)
+                live[dv[dv < phys.num_rows]] = False
+            full = _with_partitions(phys, add, part_schema)
+            matched = (np.ones(phys.num_rows, dtype=bool)
+                       if condition is None
+                       else self._eval_mask(condition, full)) & live
+            if not matched.any():
+                continue
+            affected += int(matched.sum())
+            # apply updates to matched rows over the LIVE subset
+            out_cols = []
+            schema = full.schema()
+            for name, col in zip(full.names, full.columns):
+                if name in set:
+                    val = _cast_col(bind(set[name], schema).eval_cpu(full),
+                                    col.dtype)
+                    data = col.data.copy()
+                    data[matched] = val.data[matched]
+                    validity = np.where(matched, val.validity, col.validity)
+                    out_cols.append(HostColumn(col.dtype, data, validity))
+                else:
+                    out_cols.append(col)
+            updated = HostTable(list(full.names), out_cols)
+            survivors = _mask_table(updated, live)
+            data_only = HostTable(
+                [n for n, _ in data_schema],
+                [survivors.columns[list(survivors.names).index(n)]
+                 for n, _ in data_schema])
+            new_add = _write_data_file(
+                self.table_path, data_only, add.partition_values,
+                os.path.dirname(add.path))
+            txn.stage(RemoveFile(add.path, now), new_add)
+        if txn.actions:
+            txn.commit("UPDATE")
+        return {"num_affected_rows": affected}
+
+    # -- MERGE ---------------------------------------------------------------
+    def merge(self, source_df, on: Sequence[str]) -> "MergeBuilder":
+        return MergeBuilder(self, source_df, list(on))
+
+    # -- OPTIMIZE ------------------------------------------------------------
+    def optimize(self, zorder_by: Optional[Sequence[str]] = None,
+                 target_file_size: int = 128 << 20) -> dict:
+        """Bin-pack small files; with zorder_by, rewrite ALL files in
+        z-order (GpuOptimize / Z-ORDER BY)."""
+        snap, data_schema, part_schema = self._ctx()
+        txn = OptimisticTransaction(self.log, self.session.conf,
+                                    read_version=snap.version)
+        now = int(time.time() * 1000)
+        # group files by partition (optimize never crosses partitions)
+        groups: Dict[tuple, List[AddFile]] = {}
+        for add in snap.files:
+            key = tuple(sorted(add.partition_values.items()))
+            groups.setdefault(key, []).append(add)
+        removed = added = 0
+        for key, adds in groups.items():
+            if zorder_by is None:
+                small = [a for a in adds if a.size < target_file_size]
+                if len(small) < 2:
+                    continue
+                batch = small
+            else:
+                batch = adds
+                if not batch:
+                    continue
+            tables = []
+            for a in batch:
+                phys = _read_physical(self.table_path, a, data_schema)
+                live = np.ones(phys.num_rows, dtype=bool)
+                if a.deletion_vector:
+                    dv = read_dv(self.table_path, a.deletion_vector)
+                    live[dv[dv < phys.num_rows]] = False
+                tables.append(_mask_table(phys, live))
+            merged = HostTable.concat(tables) if len(tables) > 1 \
+                else tables[0]
+            if zorder_by is not None:
+                zcols = [c for c in zorder_by
+                         if c in [n for n, _ in data_schema]]
+                if zcols:
+                    order = zorder_sort_indexes(merged, zcols)
+                    merged = _mask_permute(merged, order)
+            pv = dict(key)
+            subdir = os.path.dirname(batch[0].path)
+            new_add = _write_data_file(self.table_path, merged, pv, subdir)
+            for a in batch:
+                txn.stage(RemoveFile(a.path, now, data_change=False))
+            new_add.data_change = False
+            txn.stage(new_add)
+            removed += len(batch)
+            added += 1
+        if txn.actions:
+            txn.commit("OPTIMIZE" if zorder_by is None
+                       else "OPTIMIZE ZORDER")
+        return {"files_removed": removed, "files_added": added}
+
+    # -- VACUUM --------------------------------------------------------------
+    def vacuum(self) -> dict:
+        """Delete data files not referenced by the LATEST snapshot (the
+        retention check is the caller's concern in this engine)."""
+        snap = self.log.snapshot()
+        live = {a.path for a in snap.files}
+        live |= {a.deletion_vector["pathOrInlineDv"] for a in snap.files
+                 if a.deletion_vector}
+        deleted = 0
+        for root, _dirs, files in os.walk(self.table_path):
+            if "_delta_log" in root:
+                continue
+            for f in files:
+                full = os.path.join(root, f)
+                rel = os.path.relpath(full, self.table_path)
+                if rel.startswith("_delta_log"):
+                    continue
+                if rel not in live:
+                    os.unlink(full)
+                    deleted += 1
+        return {"files_deleted": deleted}
+
+
+def _mask_permute(table: HostTable, order: np.ndarray) -> HostTable:
+    cols = [HostColumn(c.dtype, c.data[order], c.validity[order])
+            for c in table.columns]
+    return HostTable(list(table.names), cols)
+
+
+class MergeBuilder:
+    """merge(source, on).when_matched_update(set=...)
+    .when_matched_delete().when_not_matched_insert().execute()"""
+
+    def __init__(self, table: DeltaTable, source_df, on: List[str]):
+        self.table = table
+        self.source_df = source_df
+        self.on = on
+        self._update_set: Optional[Dict[str, str]] = None
+        self._delete = False
+        self._insert = False
+
+    def when_matched_update(self, set: Dict[str, str]):  # noqa: A002
+        """set maps target column -> SOURCE column name."""
+        self._update_set = dict(set)
+        return self
+
+    def when_matched_delete(self):
+        self._delete = True
+        return self
+
+    def when_not_matched_insert(self):
+        self._insert = True
+        return self
+
+    def execute(self) -> dict:
+        t = self.table
+        snap, data_schema, part_schema = t._ctx()
+        if part_schema and self._insert:
+            raise ColumnarProcessingError(
+                "MERGE insert into partitioned tables is not supported yet")
+        src = self.source_df.collect_table()
+        src_names = list(src.names)
+        for k in self.on:
+            if k not in src_names:
+                raise ColumnarProcessingError(
+                    f"merge key {k!r} not in source {src_names}")
+        key_idx = [src_names.index(k) for k in self.on]
+        src_keys: Dict[tuple, int] = {}
+        for r in range(src.num_rows):
+            key = tuple(src.columns[i].data[r] for i in key_idx)
+            if key in src_keys and (self._update_set or self._delete):
+                # Delta semantics: a target row must not match multiple
+                # source rows when matched-clauses exist
+                raise ColumnarProcessingError(
+                    f"MERGE source has multiple rows for key {key} "
+                    "(ambiguous matched-clause application)")
+            src_keys[key] = r
+
+        txn = OptimisticTransaction(t.log, t.session.conf,
+                                    read_version=snap.version)
+        now = int(time.time() * 1000)
+        matched_rows = deleted_rows = 0
+        matched_src: set = set()
+        for add in snap.files:
+            phys = _read_physical(t.table_path, add, data_schema)
+            live = np.ones(phys.num_rows, dtype=bool)
+            if add.deletion_vector:
+                dv = read_dv(t.table_path, add.deletion_vector)
+                live[dv[dv < phys.num_rows]] = False
+            full = _with_partitions(phys, add, part_schema)
+            tgt_idx = [list(full.names).index(k) for k in self.on]
+            hit = np.zeros(full.num_rows, dtype=np.int64) - 1
+            for r in range(full.num_rows):
+                if not live[r]:
+                    continue
+                key = tuple(full.columns[i].data[r] for i in tgt_idx)
+                s = src_keys.get(key)
+                if s is not None:
+                    hit[r] = s
+                    matched_src.add(s)
+            matched = hit >= 0
+            if not matched.any():
+                continue
+            matched_rows += int(matched.sum())
+            if self._delete:
+                deleted_rows += int(matched.sum())
+                keep = live & ~matched
+            else:
+                keep = live
+            out_cols = []
+            for name, col in zip(full.names, full.columns):
+                if (self._update_set and name in self._update_set
+                        and not self._delete):
+                    sc = src.columns[src_names.index(
+                        self._update_set[name])]
+                    data = col.data.copy()
+                    validity = col.validity.copy()
+                    rows = np.flatnonzero(matched)
+                    data[rows] = sc.data[hit[rows]]
+                    validity[rows] = sc.validity[hit[rows]]
+                    out_cols.append(HostColumn(col.dtype, data, validity))
+                else:
+                    out_cols.append(col)
+            updated = _mask_table(HostTable(list(full.names), out_cols),
+                                  keep)
+            data_only = HostTable(
+                [n for n, _ in data_schema],
+                [updated.columns[list(updated.names).index(n)]
+                 for n, _ in data_schema])
+            if data_only.num_rows:
+                txn.stage(_write_data_file(
+                    t.table_path, data_only, add.partition_values,
+                    os.path.dirname(add.path)))
+            txn.stage(RemoveFile(add.path, now))
+
+        inserted = 0
+        if self._insert:
+            unmatched = [r for r in range(src.num_rows)
+                         if r not in matched_src]
+            if unmatched:
+                mask = np.zeros(src.num_rows, dtype=bool)
+                mask[unmatched] = True
+                ins = _mask_table(src, mask)
+                # project source to the target data schema by name
+                cols = []
+                for n, dt in data_schema:
+                    if n not in src_names:
+                        raise ColumnarProcessingError(
+                            f"insert requires source column {n!r}")
+                    cols.append(_cast_col(ins.columns[src_names.index(n)],
+                                          dt))
+                txn.stage(_write_data_file(
+                    t.table_path,
+                    HostTable([n for n, _ in data_schema], cols), {}))
+                inserted = len(unmatched)
+
+        if txn.actions:
+            txn.commit("MERGE")
+        return {"num_matched_rows": matched_rows,
+                "num_deleted_rows": deleted_rows,
+                "num_inserted_rows": inserted}

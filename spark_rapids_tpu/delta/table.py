@@ -1,0 +1,388 @@
+"""Delta table read/write through the engine.
+
+Reference (SURVEY.md §2.8): delta-lake module scan + write path —
+``GpuDelta*Scan`` reads the snapshot's parquet files with deletion-vector
+filtering; ``GpuOptimisticTransaction`` stages parquet writes and commits
+add/remove actions with per-file column stats
+(``GpuStatisticsCollection``). Same architecture here: the scan node
+feeds the engine's standard overrides/exec machinery, writes go through
+the parquet writer, and commits are optimistic with retry."""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import uuid
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar import HostColumn, HostTable
+from spark_rapids_tpu.conf import RapidsConf, int_conf
+from spark_rapids_tpu.errors import ColumnarProcessingError
+from spark_rapids_tpu.delta.log import (
+    AddFile,
+    DeltaConcurrentModificationException,
+    DeltaLog,
+    Metadata,
+    PROTOCOL_ACTION,
+    RemoveFile,
+    Snapshot,
+    schema_to_json,
+)
+from spark_rapids_tpu.delta.roaring import deserialize_dv, serialize_dv
+from spark_rapids_tpu.io.common import FileScanNode
+from spark_rapids_tpu.plan.nodes import PlanNode, Schema
+
+DELTA_CHECKPOINT_INTERVAL = int_conf(
+    "spark.rapids.delta.checkpointInterval", 10,
+    "Write a delta checkpoint every N commits.")
+
+
+# -- deletion vectors --------------------------------------------------------
+
+def write_dv_file(table_path: str, row_indexes: np.ndarray) -> dict:
+    """Persist a deletion vector; returns the add-action descriptor."""
+    blob = serialize_dv(row_indexes)
+    name = f"deletion_vector_{uuid.uuid4().hex}.bin"
+    dv_path = os.path.join(table_path, name)
+    with open(dv_path, "wb") as f:
+        f.write(blob)
+    return {"storageType": "p", "pathOrInlineDv": name, "offset": 0,
+            "sizeInBytes": len(blob), "cardinality": int(len(row_indexes))}
+
+
+def read_dv(table_path: str, descriptor: dict) -> np.ndarray:
+    if descriptor["storageType"] != "p":
+        raise ColumnarProcessingError(
+            f"deletion-vector storage {descriptor['storageType']!r} not "
+            "supported (only path-based)")
+    p = os.path.join(table_path, descriptor["pathOrInlineDv"])
+    with open(p, "rb") as f:
+        f.seek(descriptor.get("offset", 0))
+        buf = f.read()
+    return deserialize_dv(buf)
+
+
+# -- scan --------------------------------------------------------------------
+
+def attach_partition_columns(table: HostTable, add: AddFile,
+                             part_schema) -> HostTable:
+    """Append typed partition-value columns from an add action's
+    partitionValues (one shared implementation for the scan and the DML
+    commands)."""
+    if not part_schema:
+        return table
+    n = table.num_rows
+    names = list(table.names)
+    cols = list(table.columns)
+    for name, dt in part_schema:
+        raw = add.partition_values.get(name)
+        if raw is None:
+            validity = np.zeros(n, dtype=np.bool_)
+            data = (np.full(n, None, dtype=object)
+                    if isinstance(dt, T.StringType)
+                    else np.zeros(n, dtype=dt.np_dtype))
+        else:
+            validity = np.ones(n, dtype=np.bool_)
+            if isinstance(dt, T.StringType):
+                data = np.full(n, raw, dtype=object)
+            elif isinstance(dt, (T.FloatType, T.DoubleType)):
+                data = np.full(n, float(raw), dtype=dt.np_dtype)
+            elif isinstance(dt, T.BooleanType):
+                data = np.full(n, raw == "true", dtype=np.bool_)
+            else:
+                data = np.full(n, int(raw), dtype=dt.np_dtype)
+        names.append(name)
+        cols.append(HostColumn(dt, data, validity))
+    return HostTable(names, cols)
+
+
+class DeltaScanNode(FileScanNode):
+    """Snapshot scan: file list + partition values + deletion vectors come
+    from the LOG, not from directory structure."""
+
+    format_name = "delta"
+
+    def __init__(self, table_path: str, conf: RapidsConf,
+                 version_as_of: Optional[int] = None,
+                 columns: Optional[Sequence[str]] = None,
+                 snapshot: Optional[Snapshot] = None, **options):
+        self.table_path = table_path
+        self.delta_log = DeltaLog(table_path)
+        self.snap = snapshot if snapshot is not None \
+            else self.delta_log.snapshot(version_as_of)
+        self._adds = {os.path.join(table_path, a.path): a
+                      for a in self.snap.files}
+        if not self._adds:
+            # empty table: synthesize an empty scan over the schema
+            paths = []
+        else:
+            paths = sorted(self._adds)
+        self._empty = not paths
+        super().__init__(paths or ["<empty>"], conf, columns=columns,
+                         **options)
+
+    # expand_paths would reject []; bypass for the empty-table case
+    def output_schema(self) -> Schema:
+        full = list(self.snap.schema)
+        if self.columns is not None:
+            by_name = dict(full)
+            for c in self.columns:
+                if c not in by_name:
+                    raise ColumnarProcessingError(
+                        f"column {c!r} not in {[n for n, _ in full]}")
+            full = [(c, by_name[c]) for c in self.columns]
+        return full
+
+    def file_schema(self, path: str) -> Schema:
+        # data columns = schema minus partition columns
+        parts = set(self.snap.metadata.partition_columns)
+        return [(n, dt) for n, dt in self.snap.schema if n not in parts]
+
+    def _cache_key_extra(self) -> tuple:
+        # deletion vectors change what a FILE decodes to between versions
+        return (self.snap.version,)
+
+    def _resolve_schemas(self):
+        if self._schema is not None:
+            return
+        parts = set(self.snap.metadata.partition_columns)
+        full = self.output_schema()
+        self._schema = full
+        self._data_schema = [(n, dt) for n, dt in full if n not in parts]
+        self._partition_schema = [(n, dt) for n, dt in full if n in parts]
+
+    def read_file(self, path: str) -> HostTable:
+        import pyarrow.parquet as pq
+
+        from spark_rapids_tpu.io.arrow_convert import decode_to_schema
+        self._resolve_schemas()
+        t = pq.read_table(path,
+                          columns=[n for n, _ in self._data_schema] or None)
+        table = decode_to_schema(t, self._data_schema)
+        add = self._adds[path]
+        if add.deletion_vector:
+            deleted = read_dv(self.table_path, add.deletion_vector)
+            keep = np.ones(table.num_rows, dtype=bool)
+            keep[deleted[deleted < table.num_rows]] = False
+            table = table.filter_rows(keep) if hasattr(table, "filter_rows") \
+                else _mask_table(table, keep)
+        return table
+
+    def _with_partition_columns(self, table: HostTable, path: str) -> HostTable:
+        """Partition values come from the add action, typed per schema."""
+        self._resolve_schemas()
+        if not self._partition_schema:
+            return table
+        full = attach_partition_columns(table, self._adds[path],
+                                        self._partition_schema)
+        by_name = dict(zip(full.names, full.columns))
+        out = [n2 for n2, _ in self._schema]
+        return HostTable(out, [by_name[n2] for n2 in out])
+
+    def execute_cpu(self) -> Iterator[HostTable]:
+        if self._empty:
+            from spark_rapids_tpu.plan.nodes import _empty_table
+            yield _empty_table(self.output_schema())
+            return
+        yield from super().execute_cpu()
+
+    def estimate_bytes(self):
+        return sum(a.size for a in self.snap.files)
+
+    def describe(self):
+        return (f"DeltaScan[v{self.snap.version}, "
+                f"{len(self.snap.files)} files]")
+
+
+def _mask_table(table: HostTable, keep: np.ndarray) -> HostTable:
+    cols = [HostColumn(c.dtype, c.data[keep], c.validity[keep])
+            for c in table.columns]
+    return HostTable(list(table.names), cols)
+
+
+# -- write transaction -------------------------------------------------------
+
+def _column_stats(table: HostTable) -> str:
+    """Per-file stats JSON (numRecords + min/max per leaf column) — the
+    GpuStatisticsCollection analog used for data skipping."""
+    stats = {"numRecords": int(table.num_rows), "minValues": {},
+             "maxValues": {}, "nullCount": {}}
+    for name, col in zip(table.names, table.columns):
+        valid = col.validity
+        stats["nullCount"][name] = int((~valid).sum())
+        if not valid.any():
+            continue
+        vals = col.data[valid]
+        if isinstance(col.dtype, T.StringType):
+            svals = [v for v in vals if v is not None]
+            if svals:
+                stats["minValues"][name] = min(svals)
+                stats["maxValues"][name] = max(svals)
+        elif isinstance(col.dtype, (T.FloatType, T.DoubleType)):
+            finite = vals[np.isfinite(vals)]
+            if len(finite):
+                stats["minValues"][name] = float(finite.min())
+                stats["maxValues"][name] = float(finite.max())
+        else:
+            stats["minValues"][name] = int(vals.min())
+            stats["maxValues"][name] = int(vals.max())
+    return json.dumps(stats)
+
+
+def _write_data_file(table_path: str, table: HostTable,
+                     partition_values: Dict[str, str],
+                     subdir: str = "") -> AddFile:
+    from spark_rapids_tpu.io.arrow_convert import host_table_to_arrow
+    import pyarrow.parquet as pq
+    rel_dir = subdir
+    os.makedirs(os.path.join(table_path, rel_dir) if rel_dir else table_path,
+                exist_ok=True)
+    rel = os.path.join(rel_dir, f"part-{uuid.uuid4().hex}.parquet") \
+        if rel_dir else f"part-{uuid.uuid4().hex}.parquet"
+    full = os.path.join(table_path, rel)
+    pq.write_table(host_table_to_arrow(table), full)
+    return AddFile(path=rel, partition_values=dict(partition_values),
+                   size=os.path.getsize(full),
+                   modification_time=int(time.time() * 1000),
+                   stats=_column_stats(table))
+
+
+class OptimisticTransaction:
+    """Stage file writes, then commit with conflict retry
+    (GpuOptimisticTransaction analog)."""
+
+    def __init__(self, log: DeltaLog, conf: RapidsConf,
+                 read_version: Optional[int] = None):
+        self.log = log
+        self.conf = conf
+        self.read_version = read_version
+        self.actions: List[dict] = []
+
+    def stage(self, *actions):
+        for a in actions:
+            self.actions.append(a.to_action() if hasattr(a, "to_action")
+                                else a)
+
+    def commit(self, op_name: str, max_retries: int = 10) -> int:
+        base = self.read_version
+        if base is None:
+            try:
+                base = self.log.latest_version()
+            except ColumnarProcessingError:
+                base = -1
+        # blind retry is only safe for PURE APPENDS (unique new files can
+        # never conflict on content). Anything staging removes (DELETE/
+        # UPDATE/MERGE/overwrite) read table state a concurrent winner may
+        # have changed — retrying its stale actions would silently lose the
+        # winner's changes, so the conflict surfaces to the caller.
+        pure_append = all("remove" not in a for a in self.actions)
+        attempt = base + 1
+        for _ in range(max_retries):
+            try:
+                v = self.log.commit(self.actions, attempt, op_name)
+                self._maybe_checkpoint(v)
+                return v
+            except DeltaConcurrentModificationException:
+                if not pure_append:
+                    raise
+                attempt += 1
+        raise DeltaConcurrentModificationException(
+            f"gave up committing to {self.log.table_path} after "
+            f"{max_retries} attempts")
+
+    def _maybe_checkpoint(self, version: int):
+        interval = int(self.conf.get_entry(DELTA_CHECKPOINT_INTERVAL))
+        if interval > 0 and version > 0 and version % interval == 0:
+            self.log.write_checkpoint(self.log.snapshot(version))
+
+
+def _split_partitions(table: HostTable, partition_by: List[str]):
+    """Yield (partition_values dict, subdir, subtable-without-partition-
+    columns)."""
+    if not partition_by:
+        yield {}, "", table
+        return
+    pdf_cols = {n: c for n, c in zip(table.names, table.columns)}
+    keys = [pdf_cols[k] for k in partition_by]
+    n = table.num_rows
+    tags = np.zeros(n, dtype=object)
+    for i in range(n):
+        tags[i] = tuple(
+            None if not k.validity[i] else k.data[i] for k in keys)
+    data_names = [nm for nm in table.names if nm not in set(partition_by)]
+    for tag in sorted(set(tags.tolist()), key=repr):
+        mask = np.array([t == tag for t in tags.tolist()])
+        vals = {k: (None if v is None else str(v))
+                for k, v in zip(partition_by, tag)}
+        subdir = "/".join(
+            f"{k}={'__HIVE_DEFAULT_PARTITION__' if v is None else v}"
+            for k, v in vals.items())
+        sub = _mask_table(table, mask)
+        idx = {nm: i for i, nm in enumerate(sub.names)}
+        sub = HostTable(data_names,
+                        [sub.columns[idx[nm]] for nm in data_names])
+        yield vals, subdir, sub
+
+
+def write_delta(df_plan: PlanNode, session, table_path: str,
+                mode: str = "error",
+                partition_by: Optional[List[str]] = None) -> int:
+    """modes: error | append | overwrite (Spark writer semantics)."""
+    if mode not in ("error", "append", "overwrite", "ignore"):
+        raise ColumnarProcessingError(
+            f"unknown write mode {mode!r} (error|append|overwrite|ignore)")
+    partition_by = list(partition_by or [])
+    log = DeltaLog(table_path)
+    schema = df_plan.output_schema()
+    for k in partition_by:
+        if k not in [n for n, _ in schema]:
+            raise ColumnarProcessingError(
+                f"partition column {k!r} not in output {schema}")
+    exists = log.exists()
+    if exists and mode == "error":
+        raise ColumnarProcessingError(
+            f"delta table already exists at {table_path} (mode=error)")
+    if exists and mode == "ignore":
+        return log.latest_version()
+
+    os.makedirs(table_path, exist_ok=True)
+    table = session.execute(df_plan) if session is not None \
+        else df_plan.collect_cpu()
+
+    txn = OptimisticTransaction(log, session.conf if session else
+                                RapidsConf())
+    if not exists:
+        txn.stage(PROTOCOL_ACTION,
+                  Metadata(schema_to_json(schema), partition_by,
+                           table_id=uuid.uuid4().hex))
+        op = "CREATE TABLE AS SELECT"
+    elif mode == "overwrite":
+        snap = log.snapshot()
+        existing = [n for n, _ in snap.schema]
+        if existing != [n for n, _ in schema]:
+            raise ColumnarProcessingError(
+                f"schema mismatch overwriting {table_path}: table has "
+                f"{existing}, write has {[n for n, _ in schema]} "
+                "(schema evolution is not supported)")
+        now = int(time.time() * 1000)
+        for a in snap.files:
+            txn.stage(RemoveFile(a.path, now))
+        op = "WRITE (overwrite)"
+    else:
+        op = "WRITE (append)"
+        snap = log.snapshot()
+        existing = [n for n, _ in snap.schema]
+        if existing != [n for n, _ in schema]:
+            raise ColumnarProcessingError(
+                f"schema mismatch appending to {table_path}: table has "
+                f"{existing}, write has {[n for n, _ in schema]}")
+
+    for vals, subdir, sub in _split_partitions(table, partition_by):
+        if sub.num_rows == 0:
+            continue
+        txn.stage(_write_data_file(table_path, sub, vals, subdir))
+    return txn.commit(op)

@@ -45,21 +45,30 @@ class PythonWorkerSemaphore:
 
     _lock = threading.Lock()
     _sems: dict = {}
+    _held = threading.local()  # re-entrancy: nested UDF execs on one
+    # thread (map_in_pandas pulling a child UDF exec) must not self-deadlock
 
     @classmethod
     def acquire_if_necessary(cls, permits: int):
         if permits <= 0:
             return None
+        held = getattr(cls._held, "sems", None)
+        if held is None:
+            held = cls._held.sems = set()
         with cls._lock:
             sem = cls._sems.get(permits)
             if sem is None:
                 sem = cls._sems[permits] = threading.Semaphore(permits)
+        if sem in held:
+            return None  # this thread already owns a permit
         sem.acquire()
+        held.add(sem)
         return sem
 
-    @staticmethod
-    def release(sem):
+    @classmethod
+    def release(cls, sem):
         if sem is not None:
+            cls._held.sems.discard(sem)
             sem.release()
 
 

@@ -229,6 +229,14 @@ class DataFrame:
     def write_hive_text(self, path: str, partition_by=None, **options):
         return self._write("hive_text", path, partition_by, options)
 
+    def write_delta(self, path: str, mode: str = "error",
+                    partition_by=None) -> int:
+        """Write as a Delta table; returns the committed version
+        (reference: delta-lake module write path)."""
+        from spark_rapids_tpu.delta import write_delta
+        return write_delta(self.plan, self.session, path, mode=mode,
+                           partition_by=partition_by)
+
 
 class GroupedData:
     def __init__(self, df: DataFrame, keys: Sequence[Expression]):

@@ -78,6 +78,16 @@ class TpuSession:
         from spark_rapids_tpu.io.orc import OrcScanNode
         return DataFrame(OrcScanNode(list(paths), self.conf, **options), self)
 
+    def read_delta(self, path, version_as_of=None, **options) -> DataFrame:
+        from spark_rapids_tpu.delta import DeltaScanNode
+        return DataFrame(DeltaScanNode(path, self.conf,
+                                       version_as_of=version_as_of,
+                                       **options), self)
+
+    def delta_table(self, path) -> "object":
+        from spark_rapids_tpu.delta import DeltaTable
+        return DeltaTable(self, path)
+
     def read_avro(self, *paths, **options) -> DataFrame:
         from spark_rapids_tpu.io.avro import AvroScanNode
         return DataFrame(AvroScanNode(list(paths), self.conf, **options), self)

@@ -198,3 +198,20 @@ def test_python_worker_semaphore_bounds_concurrency(session):
           .apply_in_pandas(probe, [("k", T.LONG), ("v", T.DOUBLE)]))
     assert df.count() == 600
     assert peak[0] == 1
+
+
+def test_nested_udf_execs_do_not_deadlock():
+    """map_in_pandas over a child scalar-UDF exec with ONE worker permit:
+    the semaphore must be thread-reentrant (review fix)."""
+    from spark_rapids_tpu.session import TpuSession
+    s = TpuSession({"spark.rapids.python.concurrentPythonWorkers": "1"})
+
+    @F.pandas_udf("double")
+    def plus_one(v):
+        return v + 1.0
+
+    inner = _df(s).select("k", plus_one("v").alias("v1"))
+    out = inner.map_in_pandas(
+        lambda it: (pdf[pdf.v1 > 1.0] for pdf in it),
+        [("k", T.LONG), ("v1", T.DOUBLE)])
+    assert out.count() > 0
