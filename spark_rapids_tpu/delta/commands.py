@@ -304,10 +304,18 @@ class MergeBuilder:
 
     def when_matched_update(self, set: Dict[str, str]):  # noqa: A002
         """set maps target column -> SOURCE column name."""
+        if self._delete:
+            raise ColumnarProcessingError(
+                "cannot combine when_matched_update with "
+                "when_matched_delete (unconditional clauses are ambiguous)")
         self._update_set = dict(set)
         return self
 
     def when_matched_delete(self):
+        if self._update_set is not None:
+            raise ColumnarProcessingError(
+                "cannot combine when_matched_update with "
+                "when_matched_delete (unconditional clauses are ambiguous)")
         self._delete = True
         return self
 
@@ -327,17 +335,24 @@ class MergeBuilder:
             if k not in src_names:
                 raise ColumnarProcessingError(
                     f"merge key {k!r} not in source {src_names}")
+        import pandas as pd
         key_idx = [src_names.index(k) for k in self.on]
-        src_keys: Dict[tuple, int] = {}
-        for r in range(src.num_rows):
-            key = tuple(src.columns[i].data[r] for i in key_idx)
-            if key in src_keys and (self._update_set or self._delete):
-                # Delta semantics: a target row must not match multiple
-                # source rows when matched-clauses exist
-                raise ColumnarProcessingError(
-                    f"MERGE source has multiple rows for key {key} "
-                    "(ambiguous matched-clause application)")
-            src_keys[key] = r
+        # SQL null semantics: a NULL key never matches — exclude null-keyed
+        # source rows from the probe side entirely
+        src_valid = np.ones(src.num_rows, dtype=bool)
+        for i in key_idx:
+            src_valid &= src.columns[i].validity
+        src_probe = pd.DataFrame(
+            {k: src.columns[i].data[src_valid]
+             for k, i in zip(self.on, key_idx)})
+        src_probe["__src_row"] = np.flatnonzero(src_valid)
+        if (self._update_set or self._delete) and \
+                src_probe.duplicated(subset=self.on).any():
+            # Delta semantics: a target row must not match multiple source
+            # rows when matched-clauses exist
+            raise ColumnarProcessingError(
+                "MERGE source has multiple rows for at least one key "
+                "(ambiguous matched-clause application)")
 
         txn = OptimisticTransaction(t.log, t.session.conf,
                                     read_version=snap.version)
@@ -352,15 +367,18 @@ class MergeBuilder:
                 live[dv[dv < phys.num_rows]] = False
             full = _with_partitions(phys, add, part_schema)
             tgt_idx = [list(full.names).index(k) for k in self.on]
+            tgt_valid = live.copy()
+            for i in tgt_idx:
+                tgt_valid &= full.columns[i].validity
+            probe = pd.DataFrame(
+                {k: full.columns[i].data[tgt_valid]
+                 for k, i in zip(self.on, tgt_idx)})
+            probe["__tgt_row"] = np.flatnonzero(tgt_valid)
+            joined = probe.merge(src_probe, on=self.on, how="inner")
             hit = np.zeros(full.num_rows, dtype=np.int64) - 1
-            for r in range(full.num_rows):
-                if not live[r]:
-                    continue
-                key = tuple(full.columns[i].data[r] for i in tgt_idx)
-                s = src_keys.get(key)
-                if s is not None:
-                    hit[r] = s
-                    matched_src.add(s)
+            hit[joined["__tgt_row"].to_numpy()] = \
+                joined["__src_row"].to_numpy()
+            matched_src.update(joined["__src_row"].tolist())
             matched = hit >= 0
             if not matched.any():
                 continue

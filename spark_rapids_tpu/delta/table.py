@@ -160,9 +160,17 @@ class DeltaScanNode(FileScanNode):
 
         from spark_rapids_tpu.io.arrow_convert import decode_to_schema
         self._resolve_schemas()
-        t = pq.read_table(path,
-                          columns=[n for n, _ in self._data_schema] or None)
-        table = decode_to_schema(t, self._data_schema)
+        if not self._data_schema:
+            # projection touches only partition columns: the row COUNT
+            # still comes from the file (partition columns replicate per
+            # row), carried by a placeholder column
+            n = pq.ParquetFile(path).metadata.num_rows
+            table = HostTable(["__rows__"], [HostColumn(
+                T.LONG, np.zeros(n, dtype=np.int64))])
+        else:
+            t = pq.read_table(path,
+                              columns=[n for n, _ in self._data_schema])
+            table = decode_to_schema(t, self._data_schema)
         add = self._adds[path]
         if add.deletion_vector:
             deleted = read_dv(self.table_path, add.deletion_vector)
@@ -328,6 +336,22 @@ def _split_partitions(table: HostTable, partition_by: List[str]):
         yield vals, subdir, sub
 
 
+def _check_write_compat(snap: Snapshot, schema, partition_by,
+                        table_path: str, verb: str):
+    existing = [(n, dt.simple_string()) for n, dt in snap.schema]
+    incoming = [(n, dt.simple_string()) for n, dt in schema]
+    if existing != incoming:
+        raise ColumnarProcessingError(
+            f"schema mismatch {verb} {table_path}: table has {existing}, "
+            f"write has {incoming} (schema evolution is not supported)")
+    table_parts = list(snap.metadata.partition_columns)
+    if list(partition_by) != table_parts:
+        raise ColumnarProcessingError(
+            f"partitioning mismatch {verb} {table_path}: table is "
+            f"partitioned by {table_parts}, write specified "
+            f"{list(partition_by)}")
+
+
 def write_delta(df_plan: PlanNode, session, table_path: str,
                 mode: str = "error",
                 partition_by: Optional[List[str]] = None) -> int:
@@ -362,12 +386,12 @@ def write_delta(df_plan: PlanNode, session, table_path: str,
         op = "CREATE TABLE AS SELECT"
     elif mode == "overwrite":
         snap = log.snapshot()
-        existing = [n for n, _ in snap.schema]
-        if existing != [n for n, _ in schema]:
-            raise ColumnarProcessingError(
-                f"schema mismatch overwriting {table_path}: table has "
-                f"{existing}, write has {[n for n, _ in schema]} "
-                "(schema evolution is not supported)")
+        _check_write_compat(snap, schema, partition_by, table_path,
+                            "overwriting")
+        # conflict detection: the removes below are vs THIS snapshot; a
+        # concurrent commit must surface, not silently survive the
+        # overwrite (commit() refuses blind retry when removes are staged)
+        txn.read_version = snap.version
         now = int(time.time() * 1000)
         for a in snap.files:
             txn.stage(RemoveFile(a.path, now))
@@ -375,11 +399,8 @@ def write_delta(df_plan: PlanNode, session, table_path: str,
     else:
         op = "WRITE (append)"
         snap = log.snapshot()
-        existing = [n for n, _ in snap.schema]
-        if existing != [n for n, _ in schema]:
-            raise ColumnarProcessingError(
-                f"schema mismatch appending to {table_path}: table has "
-                f"{existing}, write has {[n for n, _ in schema]}")
+        _check_write_compat(snap, schema, partition_by, table_path,
+                            "appending to")
 
     for vals, subdir, sub in _split_partitions(table, partition_by):
         if sub.num_rows == 0:

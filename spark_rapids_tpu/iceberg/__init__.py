@@ -1,0 +1,19 @@
+"""Iceberg read path (reference: sql-plugin/.../iceberg + java iceberg
+classes, ~8k LoC — SURVEY.md §2.8): table metadata JSON, manifest-list /
+manifest Avro parsing, data-file scan through the engine's parquet
+reader, positional + equality delete application (GpuDeleteFilter /
+GpuIcebergReader / GpuMultiFileBatchReader analogs)."""
+
+from spark_rapids_tpu.iceberg.metadata import (
+    IcebergSnapshot,
+    IcebergTableMetadata,
+    load_table_metadata,
+)
+from spark_rapids_tpu.iceberg.scan import IcebergScanNode
+
+__all__ = ["IcebergScanNode", "IcebergTableMetadata", "IcebergSnapshot",
+           "load_table_metadata"]
+
+from spark_rapids_tpu.overrides.rules import register_file_scan
+
+register_file_scan(IcebergScanNode)

@@ -263,3 +263,106 @@ class AvroScanNode(FileScanNode):
             table = HostTable([n for n in data_names],
                               [table.columns[idx[n]] for n in data_names])
         return table
+
+
+# -- generic (nested) record decoding ----------------------------------------
+# The COLUMNAR decode above intentionally stays flat (device types); this
+# generic decoder handles full Avro recursion (nested records, arrays,
+# maps, enums, fixed, multi-branch unions) into Python dicts — what the
+# Iceberg connector needs for manifest-list/manifest files
+# (AvroDataFileReader's generic datum path).
+
+def _generic_decoder(schema: Any, named: Optional[dict] = None):
+    named = {} if named is None else named
+    if isinstance(schema, str):
+        prim = {"null": lambda r: None,
+                "boolean": lambda r: r.read(1) == b"\x01",
+                "int": ByteReader.read_long,
+                "long": ByteReader.read_long,
+                "float": lambda r: _F32.unpack(r.read(4))[0],
+                "double": lambda r: _F64.unpack(r.read(8))[0],
+                "bytes": ByteReader.read_bytes,
+                "string": lambda r: r.read_bytes().decode("utf-8")}
+        if schema in prim:
+            return prim[schema]
+        if schema in named:
+            return lambda r: named[schema](r)
+        raise ColumnarProcessingError(f"unknown avro type {schema!r}")
+    if isinstance(schema, list):
+        branches = [_generic_decoder(b, named) for b in schema]
+
+        def dec_union(r: ByteReader):
+            return branches[r.read_long()](r)
+        return dec_union
+    t = schema["type"]
+    if t == "record":
+        field_decs = []
+        names = []
+        placeholder = [None]
+        if "name" in schema:
+            named[schema["name"]] = lambda r: placeholder[0](r)
+        for f in schema["fields"]:
+            names.append(f["name"])
+            field_decs.append(_generic_decoder(f["type"], named))
+
+        def dec_record(r: ByteReader):
+            return {n: d(r) for n, d in zip(names, field_decs)}
+        placeholder[0] = dec_record
+        return dec_record
+    if t == "array":
+        item = _generic_decoder(schema["items"], named)
+
+        def dec_array(r: ByteReader):
+            out = []
+            while True:
+                n = r.read_long()
+                if n == 0:
+                    return out
+                if n < 0:
+                    n = -n
+                    r.read_long()  # block byte size
+                for _ in range(n):
+                    out.append(item(r))
+        return dec_array
+    if t == "map":
+        val = _generic_decoder(schema["values"], named)
+
+        def dec_map(r: ByteReader):
+            out = {}
+            while True:
+                n = r.read_long()
+                if n == 0:
+                    return out
+                if n < 0:
+                    n = -n
+                    r.read_long()
+                for _ in range(n):
+                    k = r.read_bytes().decode("utf-8")
+                    out[k] = val(r)
+        return dec_map
+    if t == "enum":
+        symbols = schema["symbols"]
+        return lambda r: symbols[r.read_long()]
+    if t == "fixed":
+        size = schema["size"]
+        return lambda r: r.read(size)
+    # logical types / wrapped primitives
+    return _generic_decoder(t, named)
+
+
+def decode_records(buf: bytes) -> List[dict]:
+    """Decode a container file of arbitrary (possibly nested) records to a
+    list of Python dicts."""
+    info = read_header(buf)
+    dec = _generic_decoder(info.schema_json)
+    out: List[dict] = []
+    r = ByteReader(buf, info.blocks_offset)
+    while not r.at_end():
+        count = r.read_long()
+        size = r.read_long()
+        block = ByteReader(_decompress_block(info.codec, r.read(size)))
+        if r.read(16) != info.sync:
+            raise ColumnarProcessingError("avro sync marker mismatch")
+        for _ in range(count):
+            out.append(dec(block))
+    return out

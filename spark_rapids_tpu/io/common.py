@@ -308,3 +308,12 @@ class FileScanNode(PlanNode):
     def describe(self):
         return (f"{type(self).__name__}[{len(self.paths)} files, "
                 f"{self.reader_type}]")
+
+
+def row_carrier_table(n: int) -> HostTable:
+    """Placeholder 1-column table carrying only a row count — used when a
+    projection touches no data columns (e.g. only Hive partition columns):
+    the count still comes from the file, and the carrier column is dropped
+    when _with_partition_columns re-selects the output schema."""
+    return HostTable(["__rows__"], [
+        HostColumn(T.LONG, np.zeros(n, dtype=np.int64))])

@@ -38,7 +38,11 @@ class OrcScanNode(FileScanNode):
         return [c for c in self.columns if c in data_names]
 
     def read_file(self, path: str) -> HostTable:
-        t = po.ORCFile(path).read(columns=self._file_columns())
+        cols = self._file_columns()
+        if cols is not None and not cols:
+            from spark_rapids_tpu.io.common import row_carrier_table
+            return row_carrier_table(po.ORCFile(path).nrows)
+        t = po.ORCFile(path).read(columns=cols)
         return decode_to_schema(t, self.data_schema)
 
     def _coalescing_chunks(self) -> Iterator[HostTable]:

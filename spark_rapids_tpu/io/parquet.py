@@ -47,8 +47,11 @@ class ParquetScanNode(FileScanNode):
         return [c for c in self.columns if c in data_names]
 
     def read_file(self, path: str) -> HostTable:
-        t = pq.read_table(path, columns=self._file_columns(),
-                          filters=self.filters)
+        cols = self._file_columns()
+        if cols is not None and not cols:
+            from spark_rapids_tpu.io.common import row_carrier_table
+            return row_carrier_table(pq.ParquetFile(path).metadata.num_rows)
+        t = pq.read_table(path, columns=cols, filters=self.filters)
         return decode_to_schema(t, self.data_schema)
 
     def _coalescing_chunks(self) -> Iterator[HostTable]:

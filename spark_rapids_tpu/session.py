@@ -88,6 +88,12 @@ class TpuSession:
         from spark_rapids_tpu.delta import DeltaTable
         return DeltaTable(self, path)
 
+    def read_iceberg(self, path, snapshot_id=None, **options) -> DataFrame:
+        from spark_rapids_tpu.iceberg import IcebergScanNode
+        return DataFrame(IcebergScanNode(path, self.conf,
+                                         snapshot_id=snapshot_id,
+                                         **options), self)
+
     def read_avro(self, *paths, **options) -> DataFrame:
         from spark_rapids_tpu.io.avro import AvroScanNode
         return DataFrame(AvroScanNode(list(paths), self.conf, **options), self)

@@ -34,6 +34,27 @@ def _encode_value(field_schema, v, out: io.BytesIO):
         _encode_value(branch, v, out)
         return
     if isinstance(field_schema, dict):
+        t = field_schema.get("type")
+        if t == "record":
+            for fld in field_schema["fields"]:
+                _encode_value(fld["type"], v[fld["name"]], out)
+            return
+        if t == "array":
+            if v:
+                out.write(_zigzag(len(v)))
+                for item in v:
+                    _encode_value(field_schema["items"], item, out)
+            out.write(_zigzag(0))
+            return
+        if t == "map":
+            if v:
+                out.write(_zigzag(len(v)))
+                for k, item in v.items():
+                    kb = k.encode("utf-8")
+                    out.write(_zigzag(len(kb)) + kb)
+                    _encode_value(field_schema["values"], item, out)
+            out.write(_zigzag(0))
+            return
         logical = field_schema.get("logicalType")
         if logical == "timestamp-millis":
             out.write(_zigzag(int(v)))

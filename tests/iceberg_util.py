@@ -1,0 +1,127 @@
+"""Minimal Iceberg table BUILDER for tests (the reference generates
+Iceberg test tables with Spark+Iceberg; neither is in this image).
+Builds the v2 protocol shape the scan consumes: metadata JSON,
+manifest-list Avro, manifest Avro with nested data_file records,
+parquet data + delete files."""
+
+import json
+import os
+import uuid
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from tests.avro_util import write_avro
+
+_ICEBERG_TYPES = {"int64": "long", "int32": "int", "double": "double",
+                  "float": "float", "bool": "boolean", "string": "string",
+                  "large_string": "string"}
+
+MANIFEST_ENTRY_SCHEMA = {
+    "type": "record", "name": "manifest_entry", "fields": [
+        {"name": "status", "type": "int"},
+        {"name": "sequence_number", "type": ["null", "long"]},
+        {"name": "data_file", "type": {
+            "type": "record", "name": "r2", "fields": [
+                {"name": "content", "type": "int"},
+                {"name": "file_path", "type": "string"},
+                {"name": "file_format", "type": "string"},
+                {"name": "record_count", "type": "long"},
+                {"name": "file_size_in_bytes", "type": "long"},
+                {"name": "equality_ids",
+                 "type": ["null", {"type": "array", "items": "int"}]},
+            ]}},
+    ]}
+
+MANIFEST_LIST_SCHEMA = {
+    "type": "record", "name": "manifest_file", "fields": [
+        {"name": "manifest_path", "type": "string"},
+        {"name": "manifest_length", "type": "long"},
+        {"name": "content", "type": "int"},
+    ]}
+
+
+class IcebergTableBuilder:
+    def __init__(self, path: str, arrow_schema: pa.Schema):
+        self.path = path
+        self.arrow_schema = arrow_schema
+        self.entries = []          # manifest entries (dicts)
+        self.snapshot_id = 1
+        os.makedirs(os.path.join(path, "data"), exist_ok=True)
+        os.makedirs(os.path.join(path, "metadata"), exist_ok=True)
+
+    def add_data_file(self, table: pa.Table, sequence_number=1) -> str:
+        rel = f"data/{uuid.uuid4().hex}.parquet"
+        full = os.path.join(self.path, rel)
+        pq.write_table(table, full)
+        self.entries.append({
+            "status": 1, "sequence_number": sequence_number,
+            "data_file": {
+                "content": 0, "file_path": full,
+                "file_format": "PARQUET",
+                "record_count": table.num_rows,
+                "file_size_in_bytes": os.path.getsize(full),
+                "equality_ids": None}})
+        return full
+
+    def add_position_deletes(self, deletes, sequence_number=2):
+        """deletes: list of (data_file_path, row_pos)."""
+        t = pa.table({"file_path": [p for p, _ in deletes],
+                      "pos": pa.array([i for _, i in deletes],
+                                      type=pa.int64())})
+        rel = f"data/{uuid.uuid4().hex}-deletes.parquet"
+        full = os.path.join(self.path, rel)
+        pq.write_table(t, full)
+        self.entries.append({
+            "status": 1, "sequence_number": sequence_number,
+            "data_file": {
+                "content": 1, "file_path": full,
+                "file_format": "PARQUET", "record_count": t.num_rows,
+                "file_size_in_bytes": os.path.getsize(full),
+                "equality_ids": None}})
+
+    def add_equality_deletes(self, table: pa.Table, equality_ids,
+                             sequence_number=2):
+        rel = f"data/{uuid.uuid4().hex}-eqdeletes.parquet"
+        full = os.path.join(self.path, rel)
+        pq.write_table(table, full)
+        self.entries.append({
+            "status": 1, "sequence_number": sequence_number,
+            "data_file": {
+                "content": 2, "file_path": full,
+                "file_format": "PARQUET", "record_count": table.num_rows,
+                "file_size_in_bytes": os.path.getsize(full),
+                "equality_ids": list(equality_ids)}})
+
+    def commit(self):
+        mdir = os.path.join(self.path, "metadata")
+        manifest = os.path.join(mdir, f"manifest-{uuid.uuid4().hex}.avro")
+        write_avro(manifest, MANIFEST_ENTRY_SCHEMA, self.entries)
+        mlist = os.path.join(mdir, f"snap-{self.snapshot_id}.avro")
+        write_avro(mlist, MANIFEST_LIST_SCHEMA, [{
+            "manifest_path": manifest,
+            "manifest_length": os.path.getsize(manifest),
+            "content": 0}])
+        fields = []
+        for i, f in enumerate(self.arrow_schema):
+            fields.append({"id": i + 1, "name": f.name, "required": False,
+                           "type": _ICEBERG_TYPES[str(f.type)]})
+        meta = {
+            "format-version": 2,
+            "table-uuid": uuid.uuid4().hex,
+            "location": self.path,
+            "schemas": [{"schema-id": 0, "type": "struct",
+                         "fields": fields}],
+            "current-schema-id": 0,
+            "partition-specs": [{"spec-id": 0, "fields": []}],
+            "default-spec-id": 0,
+            "current-snapshot-id": self.snapshot_id,
+            "snapshots": [{"snapshot-id": self.snapshot_id,
+                           "manifest-list": mlist,
+                           "timestamp-ms": 0}],
+        }
+        with open(os.path.join(mdir, "v1.metadata.json"), "w") as f:
+            json.dump(meta, f)
+        with open(os.path.join(mdir, "version-hint.text"), "w") as f:
+            f.write("1")
+        return self.path

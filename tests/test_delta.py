@@ -365,3 +365,50 @@ def test_overwrite_schema_mismatch_rejected(tmp_path, session):
     # mode=ignore is a no-op on existing tables
     v = other.write_delta(path, mode="ignore")
     assert v == 0 and session.read_delta(path).count() == 10
+
+
+def test_partition_only_projection(tmp_path, session):
+    path = str(tmp_path / "t20")
+    session.create_dataframe(_data(100, seed=80)).write_delta(
+        path, partition_by=["k"])
+    t = session.read_delta(path, columns=["k"]).collect_table()
+    assert t.num_rows == 100 and list(t.names) == ["k"]
+
+
+def test_append_partitioning_mismatch_rejected(tmp_path, session):
+    path = str(tmp_path / "t21")
+    session.create_dataframe(_data(20, seed=81)).write_delta(
+        path, partition_by=["k"])
+    with pytest.raises(ColumnarProcessingError, match="partitioning"):
+        session.create_dataframe(_data(20, seed=82)).write_delta(
+            path, mode="append")
+    # matching partition_by appends fine
+    session.create_dataframe(_data(20, seed=83)).write_delta(
+        path, mode="append", partition_by=["k"])
+    assert session.read_delta(path).count() == 40
+
+
+def test_merge_null_keys_never_match(tmp_path, session):
+    path = str(tmp_path / "t22")
+    import pandas as pd
+    pdf = pd.DataFrame({"id": pd.array([0, 1, None], dtype="Int64"),
+                        "v": [1.0, 2.0, 3.0]})
+    session.create_dataframe(pdf).write_delta(path)
+    src = session.create_dataframe(
+        {"id": np.array([0], dtype=np.int64), "v": np.array([99.0])})
+    res = (session.delta_table(path).merge(src, on=["id"])
+           .when_matched_update(set={"v": "v"}).execute())
+    assert res["num_matched_rows"] == 1  # NULL-keyed row did NOT match id=0
+    rows = session.read_delta(path).select("id", "v").collect()
+    by_id = {r[0]: r[1] for r in rows}
+    assert by_id[0] == 99.0 and by_id[1] == 2.0 and by_id[None] == 3.0
+
+
+def test_merge_update_and_delete_combination_rejected(tmp_path, session):
+    path = str(tmp_path / "t23")
+    session.create_dataframe(_data(5, seed=84)).write_delta(path)
+    src = session.create_dataframe({"id": np.array([1], dtype=np.int64)})
+    mb = session.delta_table(path).merge(src, on=["id"])
+    mb.when_matched_update(set={})
+    with pytest.raises(ColumnarProcessingError, match="cannot combine"):
+        mb.when_matched_delete()
