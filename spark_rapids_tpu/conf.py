@@ -117,6 +117,13 @@ PINNED_POOL_SIZE = int_conf(
     "Host staging pool for H2D/D2H transfers (0 = unpooled).",
     startup_only=True)
 
+HOST_MEMORY_LIMIT = int_conf(
+    "spark.rapids.memory.host.limit", 4 << 30,
+    "Host-memory arbiter budget for engine host buffers (shuffle "
+    "serialization, cached blocks). Exhaustion spills the host tier to "
+    "disk, then blocks, then raises CpuRetryOOM (HostAlloc analog).",
+    startup_only=True)
+
 RETRY_OOM_MAX_RETRIES = int_conf(
     "spark.rapids.memory.gpu.oomMaxRetries", 2,
     "Synchronous-spill retries before escalating to split-and-retry.")

@@ -68,9 +68,11 @@ class HostToDevice(TpuExec):
         return self.cpu_node.output_schema()
 
     def execute(self):
+        from spark_rapids_tpu.runtime.profiler import op_range
         for batch in self.cpu_node.execute_cpu():
             t0 = time.perf_counter()
-            dt = DeviceTable.from_host(batch)
+            with op_range("HostToDevice"):
+                dt = DeviceTable.from_host(batch)
             self.add_metric("h2dTime", time.perf_counter() - t0)
             self.add_metric("h2dBatches", 1)
             yield dt
@@ -94,9 +96,11 @@ class DeviceToHost:
         return self.tpu_exec.output_schema()
 
     def execute_cpu(self) -> Iterator[HostTable]:
+        from spark_rapids_tpu.runtime.profiler import op_range
         for dt in self.tpu_exec.execute():
             t0 = time.perf_counter()
-            host = dt.to_host()
+            with op_range("DeviceToHost"):
+                host = dt.to_host()
             # incremental so an early-terminating consumer (limit) still
             # leaves accurate numbers; measures ONLY the d2h conversion
             self.metrics["d2hTime"] = (self.metrics.get("d2hTime", 0.0)

@@ -182,9 +182,12 @@ class TpuShuffleExchangeExec(TpuExec):
                 # batch (Spark samples per-partition across the input)
                 batches = list(batches)
                 partitioner.compute_bounds_multi(batches)
+            from spark_rapids_tpu.runtime.retry import retry_block
             for batch in batches:
                 parts = split_by_partition(batch, partitioner)
-                handle.write_partitions(parts)
+                # host-memory pressure (CpuRetryOOM from the arbiter)
+                # retries through the same framework as device OOM
+                retry_block(lambda p=parts: handle.write_partitions(p))
             self.add_metric("shuffleWriteTime", perf_counter() - t0)
             self.add_metric("shuffleBytesWritten", handle.bytes_written)
 

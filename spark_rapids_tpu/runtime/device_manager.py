@@ -59,10 +59,20 @@ class TpuDeviceManager:
         reserve = self.conf.get_entry(HBM_RESERVE_BYTES)
         limit = max(int(total * frac) - reserve, 256 << 20)
         self.info = DeviceInfo(device=dev, platform=dev.platform, hbm_limit_bytes=limit)
-        from spark_rapids_tpu.conf import HOST_SPILL_STORAGE_SIZE
+        from spark_rapids_tpu.conf import (
+            HOST_MEMORY_LIMIT,
+            HOST_SPILL_STORAGE_SIZE,
+            PINNED_POOL_SIZE,
+        )
+        from spark_rapids_tpu.runtime.host_alloc import (
+            HostMemoryArbiter,
+            PinnedMemoryPool,
+        )
         from spark_rapids_tpu.runtime.spill import BufferCatalog
         BufferCatalog.get().host_limit_bytes = \
             self.conf.get_entry(HOST_SPILL_STORAGE_SIZE)
+        HostMemoryArbiter.reset(self.conf.get_entry(HOST_MEMORY_LIMIT))
+        PinnedMemoryPool.initialize(self.conf.get_entry(PINNED_POOL_SIZE))
         TpuDeviceManager._instance = self
         self.initialized = True
 

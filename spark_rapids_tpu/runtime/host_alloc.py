@@ -1,0 +1,173 @@
+"""Host memory arbiter + pinned staging pool.
+
+Reference (SURVEY.md §2.5): ``HostAlloc.scala`` (349 LoC) — a host-memory
+arbiter with a configured limit; allocations past the limit first try to
+free host memory (spilling the host tier to disk), then block briefly for
+other tasks to release, then surface a CPU retry-OOM that the retry
+framework handles like a device OOM. ``PinnedMemoryPool`` — fixed-size
+pool of transfer staging buffers.
+
+TPU mapping: identical arbiter semantics over Python buffers. The pinned
+pool hands out reusable bytearrays for H2D/D2H staging (conf
+``spark.rapids.memory.pinnedPool.size``); when exhausted, callers fall
+back to unpooled allocation, exactly the reference's behavior."""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from spark_rapids_tpu.errors import ColumnarProcessingError, CpuRetryOOM
+
+
+class HostAllocation:
+    """Grant handle; release returns the bytes to the arbiter. Usable as a
+    context manager."""
+
+    def __init__(self, arbiter: "HostMemoryArbiter", nbytes: int):
+        self.arbiter = arbiter
+        self.nbytes = nbytes
+        self._released = False
+
+    def release(self):
+        if not self._released:
+            self._released = True
+            self.arbiter._release(self.nbytes)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+
+class HostMemoryArbiter:
+    """Process-wide host-memory budget (HostAlloc analog)."""
+
+    _instance: Optional["HostMemoryArbiter"] = None
+    _instance_lock = threading.Lock()
+
+    def __init__(self, limit_bytes: int):
+        self.limit_bytes = limit_bytes
+        self._used = 0
+        self._cv = threading.Condition()
+        self.alloc_count = 0
+        self.blocked_count = 0
+        self.spill_triggered_count = 0
+
+    @classmethod
+    def get(cls) -> "HostMemoryArbiter":
+        with cls._instance_lock:
+            if cls._instance is None:
+                cls._instance = HostMemoryArbiter(4 << 30)
+            return cls._instance
+
+    @classmethod
+    def reset(cls, limit_bytes: int) -> "HostMemoryArbiter":
+        with cls._instance_lock:
+            cls._instance = HostMemoryArbiter(limit_bytes)
+            return cls._instance
+
+    @property
+    def used_bytes(self) -> int:
+        with self._cv:
+            return self._used
+
+    def _release(self, nbytes: int):
+        with self._cv:
+            self._used -= nbytes
+            self._cv.notify_all()
+
+    def _try_free_host_memory(self) -> int:
+        """Demote the spill framework's host tier to disk (the arbiter's
+        'free some host memory' hook — HostAlloc's spill integration)."""
+        from spark_rapids_tpu.runtime.spill import BufferCatalog
+        self.spill_triggered_count += 1
+        return BufferCatalog.get().spill_host_to_disk()
+
+    def alloc(self, nbytes: int, timeout_s: float = 10.0) -> HostAllocation:
+        """Grant ``nbytes`` of host budget. Oversized single requests are
+        granted anyway (a single allocation larger than the pool must not
+        deadlock — reference behavior); contended requests spill the host
+        tier, then wait, then raise CpuRetryOOM."""
+        if nbytes < 0:
+            raise ColumnarProcessingError("negative host allocation")
+        with self._cv:
+            self.alloc_count += 1
+            if nbytes >= self.limit_bytes:
+                # whole-pool+ request: grant standalone (tracked, may push
+                # used over limit; concurrent allocs will block until free)
+                self._used += nbytes
+                return HostAllocation(self, nbytes)
+            if self._used + nbytes <= self.limit_bytes:
+                self._used += nbytes
+                return HostAllocation(self, nbytes)
+        # over budget: try to free spillable host memory first
+        self._try_free_host_memory()
+        with self._cv:
+            if self._used + nbytes <= self.limit_bytes:
+                self._used += nbytes
+                return HostAllocation(self, nbytes)
+            self.blocked_count += 1
+            ok = self._cv.wait_for(
+                lambda: self._used + nbytes <= self.limit_bytes,
+                timeout=timeout_s)
+            if not ok:
+                raise CpuRetryOOM(
+                    f"host memory exhausted: want {nbytes}B, "
+                    f"{self._used}/{self.limit_bytes}B in use")
+            self._used += nbytes
+            return HostAllocation(self, nbytes)
+
+
+class PinnedMemoryPool:
+    """Staging-buffer pool for H2D/D2H transfers (PinnedMemoryPool
+    analog). Fixed total size; buffers are reusable bytearrays. When the
+    pool is exhausted or a request exceeds the buffer size, returns None
+    and the caller allocates unpooled (the reference's fallback)."""
+
+    _instance: Optional["PinnedMemoryPool"] = None
+    _instance_lock = threading.Lock()
+
+    def __init__(self, total_bytes: int, buffer_bytes: int = 8 << 20):
+        self.buffer_bytes = buffer_bytes
+        n = max(total_bytes // buffer_bytes, 0)
+        self._free = [bytearray(buffer_bytes) for _ in range(n)]
+        self._lock = threading.Lock()
+        self.total_buffers = n
+        self.hits = 0
+        self.misses = 0
+
+    @classmethod
+    def initialize(cls, total_bytes: int,
+                   buffer_bytes: int = 8 << 20) -> Optional["PinnedMemoryPool"]:
+        with cls._instance_lock:
+            if total_bytes <= 0:
+                cls._instance = None  # unpooled mode; drop any old pool
+            else:
+                cls._instance = PinnedMemoryPool(total_bytes, buffer_bytes)
+            return cls._instance
+
+    @classmethod
+    def get(cls) -> Optional["PinnedMemoryPool"]:
+        return cls._instance
+
+    def acquire(self, nbytes: int) -> Optional[bytearray]:
+        if nbytes > self.buffer_bytes:
+            with self._lock:
+                self.misses += 1
+            return None
+        with self._lock:
+            if not self._free:
+                self.misses += 1
+                return None
+            self.hits += 1
+            return self._free.pop()
+
+    def release(self, buf: bytearray):
+        with self._lock:
+            if len(self._free) >= self.total_buffers:
+                raise ColumnarProcessingError(
+                    "double release of pinned buffer")
+            self._free.append(buf)

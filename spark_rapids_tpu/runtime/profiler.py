@@ -1,0 +1,116 @@
+"""Profiler / tracing subsystem.
+
+Reference (SURVEY.md §5): (a) NVTX ranges everywhere
+(``NvtxWithMetrics.scala``) for Nsight timelines; (b) the built-in async
+profiler — ``profiler.scala`` ProfilerOnExecutor/OnDriver: JNI CUPTI
+trace collection to a ProfileWriter, with driver-coordinated enable
+windows keyed by job/time ranges (``spark.rapids.profile.*`` confs).
+
+TPU mapping: XLA's profiler (Xprof) plays CUPTI's role —
+``jax.profiler.start_trace/stop_trace`` writes a TensorBoard/Xprof trace
+directory; ``jax.profiler.TraceAnnotation`` is the NVTX-range analog and
+shows engine operators on the device timeline. Enable windows: every
+query, or a query-index range (``spark.rapids.profile.queryRanges`` e.g.
+"2-5,8" — RangeConfMatcher semantics)."""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+from typing import Optional, Set
+
+from spark_rapids_tpu.conf import RapidsConf, bool_conf, str_conf
+
+PROFILE_ENABLED = bool_conf(
+    "spark.rapids.profile.enabled", False,
+    "Collect XLA (Xprof) device traces for queries (profiler.scala "
+    "analog).")
+
+PROFILE_PATH = str_conf(
+    "spark.rapids.profile.pathPrefix", "/tmp/rapids_tpu_profile",
+    "Directory prefix for collected trace sessions.")
+
+PROFILE_QUERY_RANGES = str_conf(
+    "spark.rapids.profile.queryRanges", "",
+    "Query-index ranges to profile, e.g. \"0-2,5\" (empty = all queries "
+    "when profiling is enabled). RangeConfMatcher syntax.")
+
+
+def parse_ranges(spec: str) -> Optional[Set[int]]:
+    """\"1-3,8\" -> {1,2,3,8}; empty/blank -> None (match all)
+    (RangeConfMatcher.scala analog)."""
+    spec = (spec or "").strip()
+    if not spec:
+        return None
+    out: Set[int] = set()
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "-" in part:
+            lo, _, hi = part.partition("-")
+            out.update(range(int(lo), int(hi) + 1))
+        else:
+            out.add(int(part))
+    return out
+
+
+class TpuProfiler:
+    """Per-session profiler driver (ProfilerOnExecutor analog)."""
+
+    def __init__(self, conf: RapidsConf):
+        self.enabled = bool(conf.get_entry(PROFILE_ENABLED))
+        self.path_prefix = str(conf.get_entry(PROFILE_PATH))
+        self.ranges = parse_ranges(str(conf.get_entry(PROFILE_QUERY_RANGES)))
+        self._query_index = 0
+        self._lock = threading.Lock()
+        self._active_path: Optional[str] = None
+        self.sessions_written = 0
+
+    def should_profile(self, query_index: int) -> bool:
+        return self.enabled and (self.ranges is None
+                                 or query_index in self.ranges)
+
+    @contextlib.contextmanager
+    def profile_query(self):
+        """Wrap one query execution in a trace session; traces land under
+        <prefix>/query_<N>/."""
+        with self._lock:
+            idx = self._query_index
+            self._query_index += 1
+        if not self.should_profile(idx):
+            yield None
+            return
+        import jax
+        path = os.path.join(self.path_prefix, f"query_{idx}")
+        with self._lock:
+            if self._active_path is not None:
+                claimed = False
+            else:
+                self._active_path = path
+                claimed = True
+        if not claimed:
+            # XLA allows one trace session per process; nested/concurrent
+            # queries (cached-relation materialization) ride the outer
+            # session — and run OUTSIDE the lock
+            yield None
+            return
+        os.makedirs(path, exist_ok=True)
+        try:
+            jax.profiler.start_trace(path)
+            try:
+                yield path
+            finally:
+                jax.profiler.stop_trace()
+                self.sessions_written += 1
+        finally:
+            with self._lock:
+                self._active_path = None
+
+
+def op_range(name: str):
+    """Operator range on the device timeline (NvtxRange analog). Usable
+    whether or not a trace session is active — zero-cost when inactive."""
+    import jax
+    return jax.profiler.TraceAnnotation(name)

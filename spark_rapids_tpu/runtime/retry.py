@@ -22,6 +22,7 @@ import jax.numpy as jnp
 
 from spark_rapids_tpu.columnar import DeviceTable, bucket_for
 from spark_rapids_tpu.errors import (
+    CpuRetryOOM,
     FatalDeviceOOM,
     RetryOOM,
     SplitAndRetryOOM,
@@ -30,8 +31,11 @@ from spark_rapids_tpu.runtime.spill import BufferCatalog, SpillableBatch
 
 
 def is_device_oom(exc: BaseException) -> bool:
-    """True when an exception is (or wraps) a device allocation failure."""
-    if isinstance(exc, (RetryOOM, SplitAndRetryOOM)):
+    """True when an exception is a retryable allocation failure — device
+    (XLA RESOURCE_EXHAUSTED / RetryOOM family) or host (CpuRetryOOM from
+    the HostAlloc arbiter; the reference routes CpuRetryOOM through the
+    same retry framework)."""
+    if isinstance(exc, (RetryOOM, SplitAndRetryOOM, CpuRetryOOM)):
         return True
     name = type(exc).__name__
     msg = str(exc)
@@ -134,13 +138,54 @@ def _as_spillable(x: SpillableOrTable, catalog: BufferCatalog) -> SpillableBatch
 
 
 
-def _free_device_memory(catalog: BufferCatalog) -> None:
+class DeviceMemoryEventHandler:
+    """Allocator-failure callback (DeviceMemoryEventHandler.scala:108
+    analog): on an allocation failure, spill synchronously and report
+    whether the allocation should be retried. Retrying stops when a spill
+    pass frees nothing twice in a row ON THE SAME CATALOG — the state the
+    reference escalates to the OOM state machine. Thread-safe; the
+    catalog is a call argument, never shared mutable state."""
+
+    def __init__(self, catalog: Optional[BufferCatalog] = None):
+        self._default_catalog = catalog
+        self._lock = threading.Lock()
+        self.alloc_failure_count = 0
+        self.spilled_bytes = 0
+        self._fruitless: dict = {}  # id(catalog) -> consecutive count
+
+    def on_alloc_failure(self, catalog: Optional[BufferCatalog] = None
+                         ) -> bool:
+        from spark_rapids_tpu.columnar.table import evict_device_caches
+        catalog = catalog or self._default_catalog or BufferCatalog.get()
+        evict_device_caches()
+        freed = catalog.synchronous_spill(1 << 62)
+        with self._lock:
+            self.alloc_failure_count += 1
+            self.spilled_bytes += freed
+            key = id(catalog)
+            if freed > 0:
+                self._fruitless[key] = 0
+                return True
+            n = self._fruitless.get(key, 0) + 1
+            self._fruitless[key] = n
+            return n < 2
+
+    def reset_fruitless(self, catalog: BufferCatalog):
+        """Called at retry-block entry: a new operator's memory pressure is
+        a fresh situation; stale fruitless counts must not pre-escalate."""
+        with self._lock:
+            self._fruitless.pop(id(catalog), None)
+
+
+DEVICE_MEMORY_EVENT_HANDLER = DeviceMemoryEventHandler()
+
+
+def _free_device_memory(catalog: BufferCatalog) -> bool:
     """Release everything releasable before a replay: cached scan images
     first (lowest priority), then registered spillables through the
-    catalog tiers."""
-    from spark_rapids_tpu.columnar.table import evict_device_caches
-    evict_device_caches()
-    catalog.synchronous_spill(1 << 62)
+    catalog tiers. Returns False when further same-size retries are
+    pointless (two fruitless spill passes on this catalog)."""
+    return DEVICE_MEMORY_EVENT_HANDLER.on_alloc_failure(catalog)
 
 def with_retry(
     inputs: Union[SpillableOrTable, Sequence[SpillableOrTable]],
@@ -163,6 +208,7 @@ def with_retry(
     catalog = catalog or BufferCatalog.get()
     if max_retries is None:
         max_retries = MAX_RETRIES_VAR.get()
+    DEVICE_MEMORY_EVENT_HANDLER.reset_fruitless(catalog)
     stack: List[SpillableBatch] = []
     if isinstance(inputs, (SpillableBatch, DeviceTable)):
         inputs = [inputs]
@@ -184,8 +230,20 @@ def with_retry(
                     yield result
                     break
                 except Exception as exc:
-                    if isinstance(exc, SplitAndRetryOOM) or (
-                            is_device_oom(exc) and attempts >= max_retries):
+                    oom = is_device_oom(exc)
+                    escalate = isinstance(exc, SplitAndRetryOOM) or (
+                        oom and attempts >= max_retries)
+                    if oom and not escalate:
+                        attempts += 1
+                        RMM_TPU.note_retry()
+                        # free everything we can, then replay the same
+                        # input — unless spilling freed nothing twice on
+                        # this catalog, in which case a same-size replay
+                        # is pointless and we escalate straight to split
+                        if _free_device_memory(catalog):
+                            continue
+                        escalate = True
+                    if escalate:
                         if not splittable:
                             raise FatalDeviceOOM(
                                 "device OOM and operator cannot split its input"
@@ -199,12 +257,6 @@ def with_retry(
                         for h in reversed(halves):
                             stack.append(_as_spillable(h, catalog))
                         break
-                    if is_device_oom(exc):
-                        attempts += 1
-                        RMM_TPU.note_retry()
-                        # free everything we can, then replay the same input
-                        _free_device_memory(catalog)
-                        continue
                     raise
     finally:
         # abandonment (limit upstream), FatalDeviceOOM, or any error: drop
@@ -233,6 +285,7 @@ def retry_block(fn: Callable[[], object], *, max_retries: Optional[int] = None,
     catalog = catalog or BufferCatalog.get()
     if max_retries is None:
         max_retries = MAX_RETRIES_VAR.get()
+    DEVICE_MEMORY_EVENT_HANDLER.reset_fruitless(catalog)
     attempts = 0
     while True:
         try:
@@ -242,8 +295,11 @@ def retry_block(fn: Callable[[], object], *, max_retries: Optional[int] = None,
             if is_device_oom(exc) and attempts < max_retries:
                 attempts += 1
                 RMM_TPU.note_retry()
-                _free_device_memory(catalog)
-                continue
+                if _free_device_memory(catalog):
+                    continue
+                raise FatalDeviceOOM(
+                    "OOM and spilling freed nothing (no spillable "
+                    "buffers remain)") from exc
             if is_device_oom(exc):
                 raise FatalDeviceOOM(
                     f"device OOM persisted after {attempts} spill-retries") from exc

@@ -269,3 +269,16 @@ class BufferCatalog:
 
     def spill_all_device(self) -> int:
         return self.synchronous_spill(1 << 62)
+
+    def spill_host_to_disk(self) -> int:
+        """Demote the whole HOST tier to disk (HostAlloc's free-host-memory
+        hook); returns host bytes freed. Does not touch host_limit_bytes."""
+        freed = 0
+        for sb in self._spill_order():
+            if sb.tier == TIER_HOST and not sb.pinned:
+                got = sb.spill_to_disk()
+                if got:
+                    freed += got
+                    self.spill_disk_count += 1
+                    self.disk_spilled_bytes += got
+        return freed

@@ -32,6 +32,14 @@ class TpuSession:
     def __init__(self, conf: Optional[Dict] = None):
         self.conf = RapidsConf(conf)
         self._runtime = None
+        self._profiler = None
+
+    @property
+    def profiler(self):
+        if self._profiler is None:
+            from spark_rapids_tpu.runtime.profiler import TpuProfiler
+            self._profiler = TpuProfiler(self.conf)
+        return self._profiler
 
     # -- lifecycle ----------------------------------------------------------
     @property
@@ -143,8 +151,18 @@ class TpuSession:
             sem = TpuSemaphore.initialize(self.conf.concurrent_tpu_tasks)
         token = MAX_RETRIES_VAR.set(self.conf.get_entry(RETRY_OOM_MAX_RETRIES))
         try:
-            with acquired(sem):
-                batches = list(executable.execute_cpu())
+            with self.profiler.profile_query():
+                with acquired(sem):
+                    batches = list(executable.execute_cpu())
+        except Exception as exc:
+            from spark_rapids_tpu.runtime.crash_handler import (
+                handle_fatal,
+                is_fatal_device_error,
+            )
+            if is_fatal_device_error(exc):
+                handle_fatal(exc, self.conf,
+                             plan_description=executable.tree_string())
+            raise
         finally:
             MAX_RETRIES_VAR.reset(token)
         if not batches:

@@ -83,7 +83,8 @@ def _decompress(codec: str, data: bytes) -> bytes:
         return zlib.decompress(data)
     if codec == "lz4":
         from spark_rapids_tpu.native import lz4_decompress
-        out = lz4_decompress(data[8:], int.from_bytes(data[:8], "little"))
+        out = lz4_decompress(bytes(data[8:]),
+                             int.from_bytes(bytes(data[:8]), "little"))
         if out is None:
             raise ColumnarProcessingError("native lz4 codec unavailable")
         return out
@@ -114,24 +115,35 @@ class ShuffleWriteHandle:
 
     def write_partitions(self, partitions: List[HostTable]) -> MapOutput:
         """Serialize per-partition tables (in parallel) and append one map
-        output file (data + in-memory index)."""
+        output file (data + in-memory index). Serialized bytes are held
+        under a host-memory grant until flushed (HostAlloc integration)."""
         if len(partitions) != self.num_partitions:
             raise ColumnarProcessingError("partition count mismatch")
+        from spark_rapids_tpu.runtime.host_alloc import HostMemoryArbiter
         codec = self.codec
-        blobs = list(self.pool.map(
-            lambda t: _compress(codec, pack_table(t)), partitions))
-        map_id = len(self.map_outputs)
-        path = os.path.join(self.workdir,
-                            f"shuffle_{self.shuffle_id}_{map_id}.data")
-        offsets = [0]
-        with open(path, "wb") as f:
-            for b in blobs:
-                f.write(b)
-                offsets.append(offsets[-1] + len(b))
-        out = MapOutput(path, offsets)
-        self.map_outputs.append(out)
-        self.bytes_written += offsets[-1]
-        return out
+        grant = HostMemoryArbiter.get().alloc(
+            sum(t.nbytes() for t in partitions))
+        try:
+            blobs = list(self.pool.map(
+                lambda t: _compress(codec, pack_table(t)), partitions))
+        except BaseException:
+            grant.release()
+            raise
+        try:
+            map_id = len(self.map_outputs)
+            path = os.path.join(self.workdir,
+                                f"shuffle_{self.shuffle_id}_{map_id}.data")
+            offsets = [0]
+            with open(path, "wb") as f:
+                for b in blobs:
+                    f.write(b)
+                    offsets.append(offsets[-1] + len(b))
+            out = MapOutput(path, offsets)
+            self.map_outputs.append(out)
+            self.bytes_written += offsets[-1]
+            return out
+        finally:
+            grant.release()
 
 
 class ShuffleReadHandle:
@@ -149,11 +161,31 @@ class ShuffleReadHandle:
             start, end = mo.offsets[p], mo.offsets[p + 1]
             if end <= start:
                 return None, 0
-            with open(mo.data_path, "rb") as f:
-                f.seek(start)
-                blob = f.read(end - start)
-            table, _ = unpack_table(_decompress(self.codec, blob))
-            return table, len(blob)
+            size = end - start
+            # pinned staging for the compressed read (PinnedMemoryPool):
+            # safe only when a decompression copy follows — the codec
+            # "none" path would alias the reusable buffer
+            pinned = None
+            if self.codec != "none":
+                from spark_rapids_tpu.runtime.host_alloc import (
+                    PinnedMemoryPool,
+                )
+                pool = PinnedMemoryPool.get()
+                pinned = pool.acquire(size) if pool is not None else None
+            try:
+                with open(mo.data_path, "rb") as f:
+                    f.seek(start)
+                    if pinned is not None:
+                        view = memoryview(pinned)[:size]
+                        f.readinto(view)
+                        raw = _decompress(self.codec, view)
+                    else:
+                        raw = _decompress(self.codec, f.read(size))
+            finally:
+                if pinned is not None:
+                    pool.release(pinned)
+            table, _ = unpack_table(raw)
+            return table, size
 
         for t, nbytes in self.pool.map(fetch, self.write_handle.map_outputs):
             self.bytes_read += nbytes  # consumer thread only: no races

@@ -1,0 +1,185 @@
+"""HostAlloc arbiter + pinned pool + allocator event-handler tests
+(reference: HostAlloc.scala, PinnedMemoryPool, DeviceMemoryEventHandler
+— SURVEY.md §2.5)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.errors import CpuRetryOOM
+from spark_rapids_tpu.runtime.host_alloc import (
+    HostMemoryArbiter,
+    PinnedMemoryPool,
+)
+
+
+def test_alloc_within_budget():
+    arb = HostMemoryArbiter(1000)
+    with arb.alloc(400):
+        with arb.alloc(400):
+            assert arb.used_bytes == 800
+    assert arb.used_bytes == 0
+
+
+def test_oversized_single_request_granted():
+    arb = HostMemoryArbiter(100)
+    g = arb.alloc(1000)  # must not deadlock
+    assert arb.used_bytes == 1000
+    g.release()
+    assert arb.used_bytes == 0
+
+
+def test_blocked_alloc_wakes_on_release():
+    arb = HostMemoryArbiter(1000)
+    g = arb.alloc(900)
+    got = []
+
+    def worker():
+        with arb.alloc(500, timeout_s=5):
+            got.append(True)
+
+    t = threading.Thread(target=worker)
+    t.start()
+    time.sleep(0.1)
+    assert not got  # still blocked
+    g.release()
+    t.join(timeout=5)
+    assert got and arb.blocked_count == 1
+
+
+def test_exhaustion_raises_cpu_retry_oom():
+    arb = HostMemoryArbiter(1000)
+    g = arb.alloc(900)
+    with pytest.raises(CpuRetryOOM, match="host memory exhausted"):
+        arb.alloc(500, timeout_s=0.1)
+    g.release()
+
+
+def test_contention_spills_host_tier_to_disk():
+    """Going over budget triggers a host->disk demotion of the spill
+    framework's host tier before blocking."""
+    import jax.numpy as jnp
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.columnar import DeviceTable, HostTable
+    from spark_rapids_tpu.runtime.spill import BufferCatalog, SpillableBatch
+
+    catalog = BufferCatalog.reset(host_limit_bytes=1 << 30)
+    host = HostTable.from_pydict({"x": np.arange(1000, dtype=np.int64)})
+    sb = SpillableBatch(DeviceTable.from_host(host), catalog)
+    sb.spill_to_host()
+    assert sb.tier == "HOST"
+
+    arb = HostMemoryArbiter(1000)
+    g = arb.alloc(900)
+    with pytest.raises(CpuRetryOOM):
+        arb.alloc(200, timeout_s=0.05)
+    assert arb.spill_triggered_count == 1
+    assert sb.tier == "DISK"  # host tier was demoted
+    sb.release()
+    g.release()
+    BufferCatalog.reset()
+
+
+def test_pinned_pool_acquire_release_and_fallback():
+    pool = PinnedMemoryPool(32 << 20, buffer_bytes=8 << 20)  # 4 buffers
+    bufs = [pool.acquire(1 << 20) for _ in range(4)]
+    assert all(b is not None for b in bufs)
+    assert pool.acquire(1 << 20) is None       # exhausted -> fallback
+    assert pool.acquire(100 << 20) is None     # oversized -> fallback
+    for b in bufs:
+        pool.release(b)
+    assert pool.acquire(1) is not None
+    assert pool.hits == 5 and pool.misses == 2
+
+
+def test_device_event_handler_stops_after_fruitless_spills():
+    from spark_rapids_tpu.runtime.retry import DeviceMemoryEventHandler
+    from spark_rapids_tpu.runtime.spill import BufferCatalog
+    h = DeviceMemoryEventHandler(BufferCatalog.reset())
+    # empty catalog: nothing to spill; first fruitless pass still allows
+    # one retry, the second does not
+    assert h.on_alloc_failure() is True
+    assert h.on_alloc_failure() is False
+    assert h.alloc_failure_count == 2
+    BufferCatalog.reset()
+
+
+def test_device_event_handler_spills_and_allows_retry():
+    import jax.numpy as jnp
+    from spark_rapids_tpu.columnar import DeviceTable, HostTable
+    from spark_rapids_tpu.runtime.retry import DeviceMemoryEventHandler
+    from spark_rapids_tpu.runtime.spill import BufferCatalog, SpillableBatch
+
+    catalog = BufferCatalog.reset()
+    host = HostTable.from_pydict({"x": np.arange(100, dtype=np.int64)})
+    sb = SpillableBatch(DeviceTable.from_host(host), catalog)
+    h = DeviceMemoryEventHandler(catalog)
+    assert h.on_alloc_failure() is True
+    assert h.spilled_bytes > 0
+    assert sb.tier in ("HOST", "DISK")
+    sb.release()
+    BufferCatalog.reset()
+
+
+def test_shuffle_write_uses_arbiter(session):
+    from spark_rapids_tpu.columnar import DeviceTable, HostTable
+    from spark_rapids_tpu.ops.expr import col
+    from spark_rapids_tpu.shuffle.manager import ShuffleManager
+    from spark_rapids_tpu.shuffle.partitioning import (
+        HashPartitioner,
+        split_by_partition,
+    )
+
+    arb = HostMemoryArbiter.reset(1 << 30)
+    before = arb.alloc_count
+    host = HostTable.from_pydict(
+        {"k": np.arange(500, dtype=np.int64),
+         "v": np.arange(500, dtype=np.int64)})
+    dt = DeviceTable.from_host(host)
+    mgr = ShuffleManager(session.conf)
+    h = mgr.new_shuffle(3)
+    h.write_partitions(split_by_partition(
+        dt, HashPartitioner([col("k").bind(host.schema())], 3)))
+    assert arb.alloc_count == before + 1
+    assert arb.used_bytes == 0  # grant released after flush
+    mgr.remove_shuffle(h)
+
+
+def test_pinned_pool_used_by_shuffle_read(session):
+    from spark_rapids_tpu.columnar import DeviceTable, HostTable
+    from spark_rapids_tpu.ops.expr import col
+    from spark_rapids_tpu.shuffle.manager import ShuffleManager
+    from spark_rapids_tpu.shuffle.partitioning import (
+        HashPartitioner,
+        split_by_partition,
+    )
+
+    pool = PinnedMemoryPool.initialize(16 << 20, buffer_bytes=8 << 20)
+    try:
+        conf = session.conf.set(
+            "spark.rapids.shuffle.compression.codec", "zstd")
+        mgr = ShuffleManager(conf)
+        host = HostTable.from_pydict(
+            {"k": np.arange(800, dtype=np.int64),
+             "v": np.arange(800, dtype=np.int64)})
+        dt = DeviceTable.from_host(host)
+        h = mgr.new_shuffle(2)
+        h.write_partitions(split_by_partition(
+            dt, HashPartitioner([col("k").bind(host.schema())], 2)))
+        rows = sum(t.num_rows for p in range(2)
+                   for t in mgr.reader(h).read_partition(p))
+        assert rows == 800
+        assert pool.hits > 0          # reads staged through pinned buffers
+        assert len(pool._free) == pool.total_buffers  # all released
+        mgr.remove_shuffle(h)
+    finally:
+        PinnedMemoryPool.initialize(0)
+
+
+def test_pinned_pool_initialize_zero_clears():
+    PinnedMemoryPool.initialize(16 << 20)
+    assert PinnedMemoryPool.get() is not None
+    PinnedMemoryPool.initialize(0)
+    assert PinnedMemoryPool.get() is None
