@@ -18,6 +18,7 @@ import jax.numpy as jnp
 
 from spark_rapids_tpu import types as T
 from spark_rapids_tpu.columnar import HostColumn, HostTable
+from spark_rapids_tpu.errors import ColumnarProcessingError
 from spark_rapids_tpu.ops.common import (
     BinaryExpression,
     UnaryExpression,
@@ -28,11 +29,45 @@ from spark_rapids_tpu.ops.expr import DevVal
 
 
 class BinaryArithmetic(BinaryExpression):
+    #: decimal-specific expression this op rewrites to when either
+    #: operand is a DecimalType (DecimalArithmeticOverrides analog)
+    decimal_impl: type = None
+
     @property
     def data_type(self):
         return self.left.data_type
 
     def resolve(self, bound):
+        from spark_rapids_tpu.ops.cast import Cast
+        lt, rt = bound[0].data_type, bound[1].data_type
+        if isinstance(lt, T.DecimalType) or isinstance(rt, T.DecimalType):
+            # Spark coercion: decimal mixed with float/double promotes the
+            # DECIMAL side to double and runs float arithmetic
+            if isinstance(lt, (T.FloatType, T.DoubleType)) or \
+                    isinstance(rt, (T.FloatType, T.DoubleType)):
+                bound = [Cast(e, T.DOUBLE) if e.data_type != T.DOUBLE
+                         else e for e in bound]
+                return type(self)(bound[0], bound[1])
+            from spark_rapids_tpu.ops import decimal as dec
+            impl = self.decimal_impl
+            if impl is None:
+                if isinstance(self, Pmod):
+                    impl = dec.DecimalPmod
+                elif isinstance(self, Remainder):
+                    impl = dec.DecimalRemainder
+                else:
+                    raise ColumnarProcessingError(
+                        f"{type(self).__name__} does not support decimal "
+                        "operands")
+            out = []
+            for e, dt in zip(bound, (lt, rt)):
+                d = dec.decimal_for(dt)
+                if d is None:
+                    raise ColumnarProcessingError(
+                        f"cannot mix {dt.simple_string()} with decimal "
+                        "arithmetic (cast explicitly)")
+                out.append(e if d == dt else Cast(e, d))
+            return impl(out[0], out[1])
         left, right, _ = coerce_numeric_pair(*bound)
         return type(self)(left, right)
 
@@ -91,6 +126,17 @@ class Divide(BinaryArithmetic):
 
     def resolve(self, bound):
         from spark_rapids_tpu.ops.cast import Cast
+        if any(isinstance(e.data_type, T.DecimalType) for e in bound):
+            from spark_rapids_tpu.ops import decimal as dec
+            out = []
+            for e in bound:
+                d = dec.decimal_for(e.data_type)
+                if d is None:
+                    raise ColumnarProcessingError(
+                        f"cannot mix {e.data_type.simple_string()} with "
+                        "decimal division (cast explicitly)")
+                out.append(e if d == e.data_type else Cast(e, d))
+            return dec.DecimalDivide(out[0], out[1])
         left, right = bound
         if left.data_type != T.DOUBLE:
             left = Cast(left, T.DOUBLE)
@@ -248,3 +294,12 @@ class Abs(UnaryExpression):
     def eval_dev(self, ctx, child_vals, prep):
         (c,) = child_vals
         return DevVal(jnp.where(c.validity, jnp.abs(c.data), jnp.zeros_like(c.data)), c.validity)
+
+
+# decimal rewrites (DecimalArithmeticOverrides analog); Divide keeps its
+# own resolve, so its decimal branch is spliced there
+from spark_rapids_tpu.ops import decimal as _dec  # noqa: E402
+
+Add.decimal_impl = _dec.DecimalAdd
+Subtract.decimal_impl = _dec.DecimalSubtract
+Multiply.decimal_impl = _dec.DecimalMultiply

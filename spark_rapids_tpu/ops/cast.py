@@ -216,6 +216,18 @@ def format_value_as_string(v, src: T.DataType):
 def cast_supported(src: T.DataType, dst: T.DataType) -> bool:
     if src == dst:
         return True
+    dec_max = T.DecimalType.MAX_LONG_DIGITS
+    if isinstance(src, T.DecimalType) or isinstance(dst, T.DecimalType):
+        # decimal64 device tier (GpuCast decimal branches + DecimalUtils)
+        if isinstance(src, T.DecimalType) and isinstance(dst, T.DecimalType):
+            return (src.precision <= dec_max and dst.precision <= dec_max
+                    and abs(src.scale - dst.scale) <= 18)
+        if isinstance(dst, T.DecimalType):
+            return (dst.precision <= dec_max
+                    and isinstance(src, T.IntegralType))
+        return (src.precision <= dec_max
+                and isinstance(dst, (T.IntegralType, T.DoubleType,
+                                     T.FloatType)))
     if isinstance(src, T.StringType):
         # device path: dictionary-transform (host parse of dict entries +
         # device gather); timestamps stay off like the reference default
@@ -258,6 +270,9 @@ class Cast(UnaryExpression):
         c = self.child.eval_cpu(table)
         if c.dtype == self._dtype:
             return c
+        if isinstance(c.dtype, T.DecimalType) or \
+                isinstance(self._dtype, T.DecimalType):
+            return _cpu_decimal_cast(c, self._dtype)
         if isinstance(c.dtype, T.StringType):
             return self._cpu_from_string(c)
         if isinstance(self._dtype, T.StringType):
@@ -313,6 +328,9 @@ class Cast(UnaryExpression):
         (c,) = child_vals
         if self.child.data_type == self._dtype:
             return c
+        if isinstance(self.child.data_type, T.DecimalType) or \
+                isinstance(self._dtype, T.DecimalType):
+            return _dev_decimal_cast(c, self.child.data_type, self._dtype)
         if prep.aux_slots:
             vals = ctx.aux[prep.aux_slots[0]]
             ok = ctx.aux[prep.aux_slots[1]]
@@ -326,3 +344,166 @@ class Cast(UnaryExpression):
 
     def __repr__(self):
         return f"cast({self.children[0]!r} as {self._dtype})"
+
+
+# ---------------------------------------------------------------------------
+# decimal casts (GpuCast decimal branches; exact host path at any
+# precision, decimal64 device tier)
+# ---------------------------------------------------------------------------
+
+def _cpu_decimal_cast(c: HostColumn, dst: T.DataType) -> HostColumn:
+    from decimal import Decimal, InvalidOperation
+
+    from spark_rapids_tpu.ops.decimal import (
+        _POW10,
+        host_store,
+        host_unscaled,
+        rescale_int,
+    )
+    src = c.dtype
+    n = len(c.data)
+    validity = c.validity.copy()
+    if isinstance(src, T.DecimalType) and isinstance(dst, T.DecimalType):
+        vals = host_unscaled(c)
+        out = [0] * n
+        bound = _POW10[dst.precision]
+        for i in range(n):
+            if validity[i]:
+                v = rescale_int(int(vals[i]), src.scale, dst.scale)
+                if abs(v) >= bound:
+                    validity[i] = False
+                else:
+                    out[i] = v
+        return host_store(out, validity, dst)
+    if isinstance(dst, T.DecimalType):
+        if isinstance(src, T.StringType):
+            out = [0] * n
+            bound = _POW10[dst.precision]
+            for i in range(n):
+                if validity[i]:
+                    try:
+                        d = Decimal(str(c.data[i]).strip())
+                        v = int(d.scaleb(dst.scale).to_integral_value(
+                            rounding="ROUND_HALF_UP"))
+                    except (InvalidOperation, ValueError):
+                        validity[i] = False
+                        continue
+                    if abs(v) >= bound:
+                        validity[i] = False
+                    else:
+                        out[i] = v
+            return host_store(out, validity, dst)
+        if isinstance(src, (T.FloatType, T.DoubleType)):
+            # Spark: BigDecimal.valueOf(double) then HALF_UP to scale
+            out = [0] * n
+            bound = _POW10[dst.precision]
+            for i in range(n):
+                if validity[i]:
+                    f = float(c.data[i])
+                    if not np.isfinite(f):
+                        validity[i] = False
+                        continue
+                    d = Decimal(repr(f))
+                    v = int(d.scaleb(dst.scale).to_integral_value(
+                        rounding="ROUND_HALF_UP"))
+                    if abs(v) >= bound:
+                        validity[i] = False
+                    else:
+                        out[i] = v
+            return host_store(out, validity, dst)
+        # integral -> decimal
+        out = [0] * n
+        bound = _POW10[dst.precision]
+        scale = _POW10[dst.scale]
+        for i in range(n):
+            if validity[i]:
+                v = int(c.data[i]) * scale
+                if abs(v) >= bound:
+                    validity[i] = False
+                else:
+                    out[i] = v
+        return host_store(out, validity, dst)
+    # decimal -> other
+    vals = host_unscaled(c)
+    scale = _POW10[src.scale]
+    if isinstance(dst, (T.DoubleType, T.FloatType)):
+        data = np.zeros(n, dtype=dst.np_dtype)
+        for i in range(n):
+            if validity[i]:
+                data[i] = int(vals[i]) / scale
+        return HostColumn(dst, data, validity)
+    if isinstance(dst, T.StringType):
+        out = np.empty(n, dtype=object)
+        for i in range(n):
+            if not validity[i]:
+                out[i] = None
+                continue
+            v = int(vals[i])
+            if src.scale == 0:
+                out[i] = str(v)
+            else:
+                sign = "-" if v < 0 else ""
+                a = abs(v)
+                out[i] = f"{sign}{a // scale}." \
+                         f"{a % scale:0{src.scale}d}"
+        return HostColumn(T.STRING, out, validity)
+    if isinstance(dst, T.IntegralType):
+        data = np.zeros(n, dtype=dst.np_dtype)
+        info = np.iinfo(dst.np_dtype)
+        for i in range(n):
+            if validity[i]:
+                v = int(vals[i])
+                q = abs(v) // scale  # truncate toward zero
+                q = -q if v < 0 else q
+                if not (info.min <= q <= info.max):
+                    validity[i] = False  # overflow -> null (non-ANSI)
+                else:
+                    data[i] = q
+        return HostColumn(dst, data, validity)
+    raise ColumnarProcessingError(
+        f"cast {src.simple_string()} -> {dst.simple_string()} not supported")
+
+
+def _dev_decimal_cast(c, src: T.DataType, dst: T.DataType):
+    from spark_rapids_tpu.ops.decimal import (
+        _POW10,
+        i128_abs_fits_pow10,
+        i128_div_pow10_half_up,
+        i128_fits_int64,
+        i128_mul_pow10,
+        i128_to_i64,
+    )
+    if isinstance(src, T.DecimalType) and isinstance(dst, T.DecimalType):
+        d = dst.scale - src.scale
+        hi = jnp.where(c.data < 0, jnp.int64(-1), jnp.int64(0))
+        lo = c.data.astype(jnp.uint64)
+        if d >= 0:
+            hi, lo = i128_mul_pow10(hi, lo, d)
+        else:
+            hi, lo = i128_div_pow10_half_up(hi, lo, -d)
+        validity = c.validity & i128_fits_int64(hi, lo) & \
+            i128_abs_fits_pow10(hi, lo, dst.precision)
+        return DevVal(jnp.where(validity, i128_to_i64(hi, lo),
+                                jnp.int64(0)), validity)
+    if isinstance(dst, T.DecimalType):
+        # integral -> decimal: value * 10^s, bound check
+        v = c.data.astype(jnp.int64)
+        hi = jnp.where(v < 0, jnp.int64(-1), jnp.int64(0))
+        hi, lo = i128_mul_pow10(hi, v.astype(jnp.uint64), dst.scale)
+        validity = c.validity & i128_fits_int64(hi, lo) & \
+            i128_abs_fits_pow10(hi, lo, dst.precision)
+        return DevVal(jnp.where(validity, i128_to_i64(hi, lo),
+                                jnp.int64(0)), validity)
+    # decimal -> double/float/integral
+    scale = _POW10[src.scale]
+    if isinstance(dst, (T.DoubleType, T.FloatType)):
+        data = c.data.astype(jnp.float64) / jnp.float64(scale)
+        return DevVal(jnp.where(c.validity, data.astype(dst.np_dtype),
+                                jnp.zeros((), dst.np_dtype)), c.validity)
+    # integral: truncate toward zero, overflow -> null
+    mag = jnp.abs(c.data) // jnp.int64(scale)
+    q = jnp.where(c.data < 0, -mag, mag)
+    info = np.iinfo(dst.np_dtype)
+    validity = c.validity & (q >= info.min) & (q <= info.max)
+    return DevVal(jnp.where(validity, q.astype(dst.np_dtype),
+                            jnp.zeros((), dst.np_dtype)), validity)

@@ -336,7 +336,12 @@ class _TzShift(Expression):
 
     def key(self):
         name = "toutc" if self.to_utc else "fromutc"
-        return (name, tuple(c.key() for c in self.children))
+        # the zone NAME must be part of the compile key: string literals
+        # key only by null-ness, but each zone bakes different transition
+        # tables into the traced kernel
+        tz = self.children[1]
+        zone = str(tz.value) if isinstance(tz, Literal) else None
+        return (name, zone, tuple(c.key() for c in self.children))
 
     def with_children(self, children):
         return type(self)(children[0], children[1])
@@ -344,8 +349,14 @@ class _TzShift(Expression):
     @property
     def device_supported(self):
         tz = self.children[1]
-        return (isinstance(tz, Literal) and tz.value is not None
-                and _fixed_offset_micros(str(tz.value)) is not None)
+        if not isinstance(tz, Literal) or tz.value is None:
+            return False
+        name = str(tz.value)
+        if _fixed_offset_micros(name) is not None:
+            return True
+        # named/DST zones: device transition tables (GpuTimeZoneDB analog)
+        from spark_rapids_tpu.ops.tzdb import TimeZoneDB
+        return TimeZoneDB.supported(name)
 
     def _offset(self) -> Optional[int]:
         tz = self.children[1]
@@ -354,32 +365,27 @@ class _TzShift(Expression):
         return _fixed_offset_micros(str(tz.value))
 
     def eval_cpu(self, table):
+        from spark_rapids_tpu.ops import tzdb
         c = self.children[0].eval_cpu(table)
         off = self._offset()
         if off is None:
-            # named zone: zoneinfo on host (DST-correct CPU fallback)
-            from zoneinfo import ZoneInfo
-            zone = ZoneInfo(str(self.children[1].value))
-            out = np.zeros(len(c), dtype=np.int64)
-            epoch = _dt.datetime(1970, 1, 1, tzinfo=_dt.timezone.utc)
-            one_us = _dt.timedelta(microseconds=1)
-            for i in range(len(c)):
-                if c.validity[i]:
-                    ts = epoch + _dt.timedelta(microseconds=int(c.data[i]))
-                    if self.to_utc:
-                        local = ts.replace(tzinfo=zone)
-                        out[i] = (local - epoch) // one_us
-                    else:
-                        shifted = ts.astimezone(zone)
-                        naive = shifted.replace(tzinfo=_dt.timezone.utc)
-                        out[i] = (naive - epoch) // one_us
+            name = str(self.children[1].value)
+            data = np.asarray(c.data, dtype=np.int64)
+            out = (tzdb.to_utc_micros_host(data, name) if self.to_utc
+                   else tzdb.from_utc_micros_host(data, name))
             return HostColumn(T.TIMESTAMP, out, c.validity.copy())
         delta = -off if self.to_utc else off
         return HostColumn(T.TIMESTAMP, c.data + delta, c.validity.copy())
 
     def eval_dev(self, ctx, child_vals, prep):
+        from spark_rapids_tpu.ops import tzdb
         c, _tz = child_vals
         off = self._offset()
+        if off is None:
+            name = str(self.children[1].value)
+            out = (tzdb.to_utc_micros_dev(c.data, name) if self.to_utc
+                   else tzdb.from_utc_micros_dev(c.data, name))
+            return DevVal(out, c.validity)
         delta = -off if self.to_utc else off
         return DevVal(c.data + jnp.int64(delta), c.validity)
 

@@ -1,0 +1,153 @@
+"""Timezone transition tables on device.
+
+Reference (SURVEY.md §2.9): ``GpuTimeZoneDB`` (spark-rapids-jni) loads the
+Java timezone database's transition rules into device memory so
+from/to_utc_timestamp and tz-aware casts evaluate on the GPU for DST
+zones, not just fixed offsets (fixed offsets were the reference's original
+carve-out, later widened — mirrored here).
+
+TPU mapping: transitions are derived from the system zoneinfo database by
+scanning 1900..2100 at day granularity and bisecting each offset change
+to the exact second (zoneinfo does not expose raw transitions). Per zone,
+two device-resident tables:
+
+- UTC direction: (transition instant in UTC micros, offset micros) —
+  ``from_utc`` looks up by UTC instant.
+- WALL direction: (transition instant in local-wall micros, offset
+  micros) — ``to_utc`` looks up by wall clock, resolving DST overlaps to
+  the EARLIER offset and gaps to the post-transition offset (java.time
+  ``ZonedDateTime.ofLocal`` semantics, which Spark uses).
+
+Lookups are ``searchsorted`` over the tables — one gather on device."""
+
+from __future__ import annotations
+
+import datetime as _dt
+import threading
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+_EPOCH = _dt.datetime(1970, 1, 1, tzinfo=_dt.timezone.utc)
+_SCAN_START = _dt.datetime(1900, 1, 1, tzinfo=_dt.timezone.utc)
+_SCAN_END = _dt.datetime(2100, 1, 1, tzinfo=_dt.timezone.utc)
+_US = _dt.timedelta(microseconds=1)
+
+
+def _offset_micros_at(zone, utc_dt: _dt.datetime) -> int:
+    off = utc_dt.astimezone(zone).utcoffset()
+    return int(off / _US)
+
+
+def _find_transitions(zone) -> Tuple[np.ndarray, np.ndarray]:
+    """(utc transition instants in micros, offset micros AFTER each
+    instant). Index 0 is a sentinel (-inf, initial offset)."""
+    day = _dt.timedelta(days=1)
+    instants = [-(1 << 62)]
+    offsets = [_offset_micros_at(zone, _SCAN_START)]
+    t = _SCAN_START
+    prev_off = offsets[0]
+    while t < _SCAN_END:
+        nxt = t + day
+        off = _offset_micros_at(zone, nxt)
+        if off != prev_off:
+            # bisect the change point to the second
+            lo, hi = t, nxt
+            while hi - lo > _dt.timedelta(seconds=1):
+                mid = lo + (hi - lo) / 2
+                mid = mid.replace(microsecond=0)
+                if mid <= lo:
+                    break
+                if _offset_micros_at(zone, mid) == prev_off:
+                    lo = mid
+                else:
+                    hi = mid
+            instants.append(int((hi - _EPOCH) / _US))
+            offsets.append(off)
+            prev_off = off
+        t = nxt
+    return (np.asarray(instants, dtype=np.int64),
+            np.asarray(offsets, dtype=np.int64))
+
+
+class TimeZoneDB:
+    """Process-wide cache of per-zone transition tables (GpuTimeZoneDB
+    analog). ``tables(name)`` returns numpy; ``device_tables(name)``
+    returns jnp arrays cached for reuse inside jitted kernels."""
+
+    _lock = threading.Lock()
+    _cache: Dict[str, Tuple[np.ndarray, np.ndarray,
+                            np.ndarray, np.ndarray]] = {}
+
+    @classmethod
+    def supported(cls, name: str) -> bool:
+        try:
+            cls.tables(name)
+            return True
+        except Exception:
+            return False
+
+    @classmethod
+    def tables(cls, name: str):
+        """(utc_instants, utc_offsets, wall_instants, wall_offsets)."""
+        with cls._lock:
+            hit = cls._cache.get(name)
+        if hit is not None:
+            return hit
+        from zoneinfo import ZoneInfo
+        zone = ZoneInfo(name)
+        utc_instants, offsets = _find_transitions(zone)
+        # wall-clock transition table for the to-UTC direction: each
+        # transition happens at wall time (instant + NEW offset) for the
+        # gap bound and (instant + OLD offset) for the overlap bound.
+        # Using instant + max(old, new) as the boundary with the EARLIER
+        # (pre-transition) offset below it implements java.time ofLocal:
+        #  - overlap (offset decreases): wall times in the repeated hour
+        #    are below instant+old -> earlier offset. ✓
+        #  - gap (offset increases): non-existent wall times are below
+        #    instant+new -> resolved with the OLD offset, mapping them
+        #    forward past the gap. ✓ (ofLocal shifts by the gap length)
+        wall_instants = [-(1 << 62)]
+        wall_offsets = [offsets[0]]
+        for i in range(1, len(utc_instants)):
+            old, new = offsets[i - 1], offsets[i]
+            wall_instants.append(utc_instants[i] + max(old, new))
+            wall_offsets.append(new)
+        out = (utc_instants, offsets,
+               np.asarray(wall_instants, dtype=np.int64),
+               np.asarray(wall_offsets, dtype=np.int64))
+        with cls._lock:
+            cls._cache[name] = out
+        return out
+
+    # NOTE: no jnp-array cache — these functions run INSIDE jit traces,
+    # where jnp.asarray returns per-trace constants; caching one would
+    # leak a tracer into other traces (UnexpectedTracerError). The numpy
+    # tables embed as XLA constants per compiled kernel, which the compile
+    # cache already de-duplicates by expression key.
+
+
+def from_utc_micros_host(micros: np.ndarray, name: str) -> np.ndarray:
+    ui, uo, _wi, _wo = TimeZoneDB.tables(name)
+    idx = np.searchsorted(ui, micros, side="right") - 1
+    return micros + uo[idx]
+
+
+def to_utc_micros_host(micros: np.ndarray, name: str) -> np.ndarray:
+    _ui, _uo, wi, wo = TimeZoneDB.tables(name)
+    idx = np.searchsorted(wi, micros, side="right") - 1
+    return micros - wo[idx]
+
+
+def from_utc_micros_dev(micros, name: str):
+    import jax.numpy as jnp
+    ui, uo, _wi, _wo = TimeZoneDB.tables(name)
+    idx = jnp.searchsorted(jnp.asarray(ui), micros, side="right") - 1
+    return micros + jnp.asarray(uo)[idx]
+
+
+def to_utc_micros_dev(micros, name: str):
+    import jax.numpy as jnp
+    _ui, _uo, wi, wo = TimeZoneDB.tables(name)
+    idx = jnp.searchsorted(jnp.asarray(wi), micros, side="right") - 1
+    return micros - jnp.asarray(wo)[idx]

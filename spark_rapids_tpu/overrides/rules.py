@@ -81,6 +81,11 @@ def _build_expr_sigs():
     reg(expr_mod.Literal)
     reg(expr_mod.Alias, COMMON_PLUS_ARRAYS)
     reg(cast.Cast)
+    from spark_rapids_tpu.ops import decimal as decimal_ops
+    for name in ("DecimalAdd", "DecimalSubtract", "DecimalMultiply",
+                 "DecimalDivide", "UnscaledValue", "MakeDecimal",
+                 "CheckOverflow"):
+        reg(getattr(decimal_ops, name))
     from spark_rapids_tpu.ops import misc as misc_ops
     for name in ("NormalizeNaNAndZero", "KnownFloatingPointNormalized",
                  "KnownNotNull", "AtLeastNNonNulls",

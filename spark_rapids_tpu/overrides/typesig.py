@@ -32,9 +32,12 @@ class TypeSig:
 
 
 _COMMON = (T.BooleanType, T.ByteType, T.ShortType, T.IntegerType, T.LongType,
-           T.FloatType, T.DoubleType, T.DateType, T.TimestampType, T.StringType)
+           T.FloatType, T.DoubleType, T.DateType, T.TimestampType,
+           T.StringType, T.DecimalType)
 
-#: types fully supported by the device columnar representation today
+#: types fully supported by the device columnar representation today.
+#: Decimals ride the DECIMAL64 tier (p<=18, int64 unscaled storage —
+#: reference's original device tier); p>18 tags fallback.
 COMMON = TypeSig(*_COMMON)
 NUMERIC = TypeSig(T.ByteType, T.ShortType, T.IntegerType, T.LongType,
                   T.FloatType, T.DoubleType)

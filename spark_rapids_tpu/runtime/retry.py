@@ -187,6 +187,19 @@ def _free_device_memory(catalog: BufferCatalog) -> bool:
     pointless (two fruitless spill passes on this catalog)."""
     return DEVICE_MEMORY_EVENT_HANDLER.on_alloc_failure(catalog)
 
+
+def _free_memory_for(exc: BaseException, catalog: BufferCatalog) -> bool:
+    """Route the spill response to the EXHAUSTED tier: a host OOM
+    (CpuRetryOOM from the HostAlloc arbiter) frees HOST memory by pushing
+    the host tier to disk — spilling device buffers into host RAM would
+    worsen it. Device OOMs take the device demotion chain."""
+    if isinstance(exc, CpuRetryOOM):
+        catalog.spill_host_to_disk()
+        # a blocked-then-raised host alloc may succeed after other tasks
+        # release grants, so a replay is always worthwhile
+        return True
+    return _free_device_memory(catalog)
+
 def with_retry(
     inputs: Union[SpillableOrTable, Sequence[SpillableOrTable]],
     fn: Callable[[DeviceTable], object],
@@ -240,7 +253,7 @@ def with_retry(
                         # input — unless spilling freed nothing twice on
                         # this catalog, in which case a same-size replay
                         # is pointless and we escalate straight to split
-                        if _free_device_memory(catalog):
+                        if _free_memory_for(exc, catalog):
                             continue
                         escalate = True
                     if escalate:
@@ -295,12 +308,14 @@ def retry_block(fn: Callable[[], object], *, max_retries: Optional[int] = None,
             if is_device_oom(exc) and attempts < max_retries:
                 attempts += 1
                 RMM_TPU.note_retry()
-                if _free_device_memory(catalog):
+                if _free_memory_for(exc, catalog):
                     continue
                 raise FatalDeviceOOM(
                     "OOM and spilling freed nothing (no spillable "
                     "buffers remain)") from exc
             if is_device_oom(exc):
+                tier = "host" if isinstance(exc, CpuRetryOOM) else "device"
                 raise FatalDeviceOOM(
-                    f"device OOM persisted after {attempts} spill-retries") from exc
+                    f"{tier} OOM persisted after {attempts} "
+                    "spill-retries") from exc
             raise

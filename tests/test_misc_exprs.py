@@ -93,7 +93,10 @@ def test_timezone_fixed_offset_on_device(session, cpu_session):
     assert out[0][1] == base + dt.timedelta(hours=8)
 
 
-def test_timezone_named_zone_falls_back(session):
+def test_timezone_named_zone_on_device(session):
+    """Named/DST zones now run on DEVICE via the transition-table DB
+    (GpuTimeZoneDB analog — ops/tzdb.py); only unknown zones fall back."""
+    from tests.asserts import assert_runs_on_tpu
     base = dt.datetime(2024, 7, 1, 12, 0, 0)
     table = {"t": [base]}
     def build(s):
@@ -101,7 +104,13 @@ def test_timezone_named_zone_falls_back(session):
         return df.select(
             F.from_utc_timestamp(col("t"),
                                  lit("America/New_York")).alias("et"))
-    assert_falls_back(build, session, "Project")
+    assert_runs_on_tpu(build, session)
     out = build(session).collect()
     # EDT in July: UTC-4
     assert out[0][0] == base - dt.timedelta(hours=4)
+
+    def bogus(s):
+        df = s.create_dataframe(table, {"t": T.TIMESTAMP})
+        return df.select(
+            F.from_utc_timestamp(col("t"), lit("Not/AZone")).alias("x"))
+    assert_falls_back(bogus, session, "Project")

@@ -1,0 +1,114 @@
+"""Timezone DB tests (reference: GpuTimeZoneDB + timezone matrix in CI —
+SURVEY §2.9/§4): transition-table correctness vs zoneinfo, DST overlap/
+gap resolution, device == host, engine integration for named zones."""
+
+import datetime as dt
+from zoneinfo import ZoneInfo
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.ops.tzdb import (
+    TimeZoneDB,
+    from_utc_micros_host,
+    to_utc_micros_host,
+)
+
+EPOCH = dt.datetime(1970, 1, 1, tzinfo=dt.timezone.utc)
+US = dt.timedelta(microseconds=1)
+
+
+def _micros(d: dt.datetime) -> int:
+    return int((d - EPOCH) / US)
+
+
+@pytest.mark.parametrize("zone", ["America/New_York", "Europe/Berlin",
+                                  "Asia/Kolkata", "Australia/Sydney"])
+def test_from_utc_matches_zoneinfo(zone):
+    z = ZoneInfo(zone)
+    rng = np.random.default_rng(0)
+    # random instants over 1975..2035, plus points near DST edges
+    secs = rng.integers(157766400, 2051222400, 300)
+    samples = [int(s) * 1_000_000 for s in secs]
+    got = from_utc_micros_host(np.array(samples, dtype=np.int64), zone)
+    for m, g in zip(samples, got):
+        utc = EPOCH + m * US
+        local = utc.astimezone(z)
+        want = m + int(local.utcoffset() / US)
+        assert g == want, (zone, utc, g, want)
+
+
+def test_to_utc_gap_and_overlap_new_york():
+    zone = "America/New_York"
+    # 2024: spring forward Mar 10 02:00 EST -> 03:00 EDT; fall back
+    # Nov 3 02:00 EDT -> 01:00 EST
+    def wall(y, mo, d, h, mi=0):
+        return _micros(dt.datetime(y, mo, d, h, mi,
+                                   tzinfo=dt.timezone.utc))
+
+    vals = np.array([
+        wall(2024, 3, 10, 1, 30),    # before gap: EST (-5)
+        wall(2024, 3, 10, 2, 30),    # IN the gap: resolves with EST
+        wall(2024, 3, 10, 3, 30),    # after gap: EDT (-4)
+        wall(2024, 11, 3, 1, 30),    # ambiguous: earlier offset (EDT)
+        wall(2024, 11, 3, 3, 0),     # after overlap: EST
+    ], dtype=np.int64)
+    got = to_utc_micros_host(vals, zone)
+    offs = (vals - got) // 3_600_000_000  # hours
+    assert offs.tolist() == [-5, -5, -4, -4, -5]
+
+
+def test_roundtrip_outside_transitions():
+    zone = "Europe/Berlin"
+    rng = np.random.default_rng(1)
+    samples = np.array([int(s) * 1_000_000 for s in
+                        rng.integers(0, 2 * 10**9, 500)], dtype=np.int64)
+    local = from_utc_micros_host(samples, zone)
+    back = to_utc_micros_host(local, zone)
+    # ambiguous-hour wall times legitimately differ; all others roundtrip
+    mismatch = (back != samples).sum()
+    assert mismatch <= 2
+
+
+def test_device_matches_host(session):
+    import jax.numpy as jnp
+    from spark_rapids_tpu.ops.tzdb import from_utc_micros_dev, to_utc_micros_dev
+    zone = "Australia/Sydney"
+    rng = np.random.default_rng(2)
+    samples = np.array([int(s) * 1_000_000 for s in
+                        rng.integers(0, 2 * 10**9, 200)], dtype=np.int64)
+    assert np.array_equal(
+        np.asarray(from_utc_micros_dev(jnp.asarray(samples), zone)),
+        from_utc_micros_host(samples, zone))
+    assert np.array_equal(
+        np.asarray(to_utc_micros_dev(jnp.asarray(samples), zone)),
+        to_utc_micros_host(samples, zone))
+
+
+def test_engine_named_zone_on_device(session, cpu_session):
+    """from/to_utc_timestamp with a DST zone now runs on DEVICE and
+    matches the CPU oracle."""
+    from spark_rapids_tpu import functions as F
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.ops.expr import col, lit
+    from tests.asserts import assert_runs_on_tpu
+
+    rng = np.random.default_rng(3)
+    ts = (rng.integers(0, 2 * 10**9, 1000) * 1_000_000).astype(np.int64)
+
+    def q(s):
+        df = s.create_dataframe({"t": ts}, dtypes={"t": T.TIMESTAMP})
+        return df.select(
+            F.from_utc_timestamp(col("t"), lit("America/New_York"))
+            .alias("l"),
+            F.to_utc_timestamp(col("t"), lit("Europe/Berlin"))
+            .alias("u"))
+
+    got = q(session).collect()
+    want = q(cpu_session).collect()
+    assert got == want
+    assert_runs_on_tpu(q, session)
+
+
+def test_bogus_zone_falls_back():
+    assert not TimeZoneDB.supported("Not/AZone")
