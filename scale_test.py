@@ -1,0 +1,146 @@
+"""Scale Test harness (reference: integration_tests/ScaleTest.md +
+datagen scaletest — SURVEY.md §2.11/§6): a parameterized join/agg/window
+query set over generated tables, emitting a JSON timing report.
+
+Usage: python scale_test.py [--sf 0.1] [--queries q1,q5] [--cpu-baseline]
+
+Each query runs once cold (compile included) and twice warm on the TPU
+session; with --cpu-baseline the CPU-oracle session also runs and the
+report carries speedups. Results print as ONE JSON line per query plus a
+summary line (the reference harness's JSON report shape)."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def build_queries(s, tables):
+    """q1-q8: scan/filter/agg/join/window mix (ScaleTest q1-q10 style)."""
+    from spark_rapids_tpu import functions as F
+    from spark_rapids_tpu.ops.expr import col, lit
+    from spark_rapids_tpu.plan import from_host_table
+
+    cust = lambda: from_host_table(tables["customer"], s)  # noqa: E731
+    orders = lambda: from_host_table(tables["orders"], s)  # noqa: E731
+    li = lambda: from_host_table(tables["lineitem"], s)    # noqa: E731
+
+    def q1():  # pricing summary (TPC-H q1 shape)
+        import datetime as _dt
+        cutoff = _dt.date(1970, 1, 1) + _dt.timedelta(days=10500)
+        return (li().filter(col("l_shipdate") <= lit(cutoff))
+                .group_by("l_returnflag", "l_linestatus")
+                .agg(F.sum("l_quantity").alias("sum_qty"),
+                     F.sum("l_extendedprice").alias("sum_base"),
+                     F.avg("l_discount").alias("avg_disc"),
+                     F.count("l_quantity").alias("cnt")))
+
+    def q2():  # filter + project arithmetic
+        return (li().filter((col("l_discount") > lit(0.05))
+                            & (col("l_quantity") < lit(25)))
+                .select((col("l_extendedprice") * col("l_discount"))
+                        .alias("revenue"))
+                .agg(F.sum("revenue").alias("total")))
+
+    def q3():  # join orders->lineitem + agg
+        oj = orders().select("o_orderkey", "o_custkey", "o_orderdate")
+        j = li().join(oj.with_column("l_orderkey", col("o_orderkey")),
+                      on=["l_orderkey"], how="inner")
+        return (j.group_by("o_custkey")
+                .agg(F.sum("l_extendedprice").alias("spend"),
+                     F.count("l_quantity").alias("items")))
+
+    def q4():  # two-level join: customer -> orders -> lineitem
+        oj = orders().select("o_orderkey", "o_custkey")
+        cj = cust().select("c_custkey", "c_nationkey")
+        j1 = (li().select("l_orderkey", "l_extendedprice")
+              .join(oj.with_column("l_orderkey", col("o_orderkey")),
+                    on=["l_orderkey"], how="inner"))
+        j2 = j1.with_column("c_custkey", col("o_custkey")).join(
+            cj, on=["c_custkey"], how="inner")
+        return (j2.group_by("c_nationkey")
+                .agg(F.sum("l_extendedprice").alias("rev")))
+
+    def q5():  # sort + limit (TakeOrderedAndProject)
+        return (orders().sort("o_totalprice", ascending=False).limit(100))
+
+    def q6():  # window: rank orders per customer by price
+        from spark_rapids_tpu.functions import row_number
+        from spark_rapids_tpu.ops.window import Window as W
+        return orders().with_windows(
+            rn=row_number().over(
+                W.partition_by("o_custkey").order_by("o_totalprice")))\
+            .filter(col("rn") <= lit(3))
+
+    def q7():  # repartition + agg (shuffle exercise)
+        return (li().repartition(8, "l_returnflag")
+                .group_by("l_returnflag")
+                .agg(F.count("l_quantity").alias("c"),
+                     F.sum("l_quantity").alias("s")))
+
+    def q8():  # distinct-ish: group by high-cardinality key
+        return (orders().group_by("o_custkey")
+                .agg(F.max("o_totalprice").alias("m"))
+                .agg(F.count("m").alias("n_custs")))
+
+    return {"q1": q1, "q2": q2, "q3": q3, "q4": q4, "q5": q5,
+            "q6": q6, "q7": q7, "q8": q8}
+
+
+def time_query(fn, runs=2):
+    t0 = time.perf_counter()
+    fn().collect_table()
+    cold = time.perf_counter() - t0
+    warms = []
+    for _ in range(runs):
+        t0 = time.perf_counter()
+        fn().collect_table()
+        warms.append(time.perf_counter() - t0)
+    return cold, min(warms)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--sf", type=float, default=0.1)
+    ap.add_argument("--queries", type=str, default="")
+    ap.add_argument("--cpu-baseline", action="store_true")
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    from spark_rapids_tpu.datagen import scale_test_specs
+    from spark_rapids_tpu.session import TpuSession
+
+    t0 = time.perf_counter()
+    specs = scale_test_specs(args.sf)
+    tables = {name: spec.generate_table(args.sf, seed=args.seed)
+              for name, spec in specs.items()}
+    gen_s = time.perf_counter() - t0
+
+    tpu = TpuSession()
+    queries = build_queries(tpu, tables)
+    wanted = ([q.strip() for q in args.queries.split(",") if q.strip()]
+              or list(queries))
+
+    cpu_queries = None
+    if args.cpu_baseline:
+        cpu = TpuSession({"spark.rapids.sql.enabled": "false"})
+        cpu_queries = build_queries(cpu, tables)
+
+    report = {"scale_factor": args.sf, "datagen_s": round(gen_s, 3),
+              "rows": {k: t.num_rows for k, t in tables.items()},
+              "queries": {}}
+    for name in wanted:
+        cold, warm = time_query(queries[name])
+        entry = {"cold_s": round(cold, 4), "warm_s": round(warm, 4)}
+        if cpu_queries is not None:
+            _, cpu_warm = time_query(cpu_queries[name], runs=1)
+            entry["cpu_warm_s"] = round(cpu_warm, 4)
+            entry["speedup"] = round(cpu_warm / warm, 3) if warm else None
+        report["queries"][name] = entry
+        print(json.dumps({"query": name, **entry}))
+    print(json.dumps(report))
+
+
+if __name__ == "__main__":
+    main()

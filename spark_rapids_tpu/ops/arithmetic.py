@@ -127,6 +127,13 @@ class Divide(BinaryArithmetic):
     def resolve(self, bound):
         from spark_rapids_tpu.ops.cast import Cast
         if any(isinstance(e.data_type, T.DecimalType) for e in bound):
+            # decimal mixed with float/double promotes to double (Spark
+            # coercion), matching BinaryArithmetic.resolve
+            if any(isinstance(e.data_type, (T.FloatType, T.DoubleType))
+                   for e in bound):
+                bound = [Cast(e, T.DOUBLE) if e.data_type != T.DOUBLE
+                         else e for e in bound]
+                return Divide(bound[0], bound[1])
             from spark_rapids_tpu.ops import decimal as dec
             out = []
             for e in bound:
@@ -177,6 +184,19 @@ class IntegralDivide(BinaryArithmetic):
 
     def resolve(self, bound):
         from spark_rapids_tpu.ops.cast import Cast
+        if any(isinstance(e.data_type, T.DecimalType) for e in bound):
+            # Spark `div` over decimals: exact decimal division truncated
+            # to long (casting operands to LONG first would destroy the
+            # fractional part — 7.5 div 0.5 is 15, not 7 div 0)
+            from spark_rapids_tpu.ops import decimal as dec
+            out = []
+            for e in bound:
+                d = dec.decimal_for(e.data_type)
+                if d is None:
+                    e = Cast(e, T.LONG)
+                    d = dec.decimal_for(T.LONG)
+                out.append(e if e.data_type == d else Cast(e, d))
+            return Cast(dec.DecimalDivide(out[0], out[1]), T.LONG)
         left, right = bound
         if left.data_type != T.LONG:
             left = Cast(left, T.LONG)

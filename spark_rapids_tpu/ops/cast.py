@@ -467,24 +467,15 @@ def _cpu_decimal_cast(c: HostColumn, dst: T.DataType) -> HostColumn:
 def _dev_decimal_cast(c, src: T.DataType, dst: T.DataType):
     from spark_rapids_tpu.ops.decimal import (
         _POW10,
+        dev_rescale_checked,
         i128_abs_fits_pow10,
-        i128_div_pow10_half_up,
         i128_fits_int64,
         i128_mul_pow10,
         i128_to_i64,
     )
     if isinstance(src, T.DecimalType) and isinstance(dst, T.DecimalType):
-        d = dst.scale - src.scale
-        hi = jnp.where(c.data < 0, jnp.int64(-1), jnp.int64(0))
-        lo = c.data.astype(jnp.uint64)
-        if d >= 0:
-            hi, lo = i128_mul_pow10(hi, lo, d)
-        else:
-            hi, lo = i128_div_pow10_half_up(hi, lo, -d)
-        validity = c.validity & i128_fits_int64(hi, lo) & \
-            i128_abs_fits_pow10(hi, lo, dst.precision)
-        return DevVal(jnp.where(validity, i128_to_i64(hi, lo),
-                                jnp.int64(0)), validity)
+        return dev_rescale_checked(c.data, c.validity, src.scale,
+                                   dst.scale, dst.precision)
     if isinstance(dst, T.DecimalType):
         # integral -> decimal: value * 10^s, bound check
         v = c.data.astype(jnp.int64)

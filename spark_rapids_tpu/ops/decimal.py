@@ -166,10 +166,13 @@ def i128_abs(hi, lo):
     return jnp.where(neg, nhi, hi), jnp.where(neg, nlo, lo), neg
 
 
-def u128_divmod_small(hi, lo, m: int):
-    """(hi uint64, lo uint64) unsigned // m for m < 2**31, via 32-bit
-    limb long division. Returns (qhi, qlo, rem)."""
-    mm = jnp.uint64(m)
+def u128_divmod_small(hi, lo, m):
+    """(hi uint64, lo uint64) unsigned // m for m < 2**31 (python int or
+    uint64 array), via 32-bit limb long division. Returns
+    (qhi, qlo, rem); a zero divisor is guarded to 1 (callers null those
+    slots)."""
+    mm = jnp.uint64(m) if isinstance(m, int) else m
+    mm = jnp.where(mm == 0, jnp.uint64(1), mm)
     limbs = [hi >> jnp.uint64(32), hi & _MASK32,
              lo >> jnp.uint64(32), lo & _MASK32]
     q = []
@@ -254,6 +257,24 @@ def i128_abs_fits_pow10(hi, lo, p: int):
 # ---------------------------------------------------------------------------
 # expressions
 # ---------------------------------------------------------------------------
+
+def dev_rescale_checked(data, validity, from_scale: int, to_scale: int,
+                        precision: int):
+    """Shared device decimal rescale-with-overflow-check (backs both
+    CheckOverflow and the decimal->decimal Cast): 128-bit scale shift,
+    HALF_UP on scale-down, null when the result misses int64 or 10^p."""
+    d = to_scale - from_scale
+    hi = jnp.where(data < 0, jnp.int64(-1), jnp.int64(0))
+    lo = data.astype(jnp.uint64)
+    if d >= 0:
+        hi, lo = i128_mul_pow10(hi, lo, d)
+    else:
+        hi, lo = i128_div_pow10_half_up(hi, lo, -d)
+    out_valid = validity & i128_fits_int64(hi, lo) & \
+        i128_abs_fits_pow10(hi, lo, precision)
+    return DevVal(jnp.where(out_valid, i128_to_i64(hi, lo),
+                            jnp.int64(0)), out_valid)
+
 
 class DecimalBinary(BinaryExpression):
     """Base: operands are decimals (coercion inserts promotions before)."""
@@ -340,9 +361,11 @@ class DecimalAdd(DecimalBinary):
         lo = llo + rlo
         hi = lhi + rhi + jnp.where(lo < llo, 1, 0).astype(jnp.int64)
         validity = null_and(lval.validity, rval.validity)
+        # p+1-digit sums up to 10^19 may still be representable in int64
+        # (device_supported admits p = 19); bound by the RESULT precision,
+        # capped at 19 where i128_fits_int64 takes over
         fits = i128_fits_int64(hi, lo) & \
-            i128_abs_fits_pow10(hi, lo, min(self._result.precision,
-                                            MAX_LONG_DIGITS))
+            i128_abs_fits_pow10(hi, lo, min(self._result.precision, 19))
         validity = validity & fits
         data = jnp.where(validity, i128_to_i64(hi, lo), jnp.int64(0))
         return DevVal(data, validity)
@@ -445,28 +468,13 @@ def _u128_divmod_u64(hi, lo, d):
     d < 2^32; otherwise 2-limb schoolbook with estimate-and-correct."""
     small = d < jnp.uint64(1 << 31)
     # path A: limb division (exact for d < 2^31)
-    qa_hi, qa_lo, ra = _u128_divmod_small_dyn(hi, lo, d)
+    qa_hi, qa_lo, ra = u128_divmod_small(hi, lo, d)
     # path B: d >= 2^31 -> quotient fits in 64 bits iff hi < d (true for
     # our scaled decimals); use float-free iterative correction:
     qb, rb = _u128_div_u64_big(hi, lo, d)
     q = jnp.where(small, qa_lo, qb)
     r = jnp.where(small, ra, rb)
     return q, r
-
-
-def _u128_divmod_small_dyn(hi, lo, m):
-    limbs = [hi >> jnp.uint64(32), hi & _MASK32,
-             lo >> jnp.uint64(32), lo & _MASK32]
-    m = jnp.where(m == 0, jnp.uint64(1), m)
-    q = []
-    rem = jnp.zeros_like(hi)
-    for limb in limbs:
-        acc = (rem << jnp.uint64(32)) | limb
-        q.append(acc // m)
-        rem = acc % m
-    qhi = (q[0] << jnp.uint64(32)) | q[1]
-    qlo = (q[2] << jnp.uint64(32)) | q[3]
-    return qhi, qlo, rem
 
 
 def _u128_div_u64_big(hi, lo, d):
@@ -623,19 +631,9 @@ class CheckOverflow(UnaryExpression):
     def eval_dev(self, ctx, child_vals, prep):
         (c,) = child_vals
         src: T.DecimalType = self.child.data_type
-        d = self._dtype.scale - src.scale
-        if d >= 0:
-            hi, lo = i128_mul_pow10(
-                jnp.where(c.data < 0, jnp.int64(-1), jnp.int64(0)),
-                c.data.astype(jnp.uint64), d)
-        else:
-            hi = jnp.where(c.data < 0, jnp.int64(-1), jnp.int64(0))
-            lo = c.data.astype(jnp.uint64)
-            hi, lo = i128_div_pow10_half_up(hi, lo, -d)
-        validity = c.validity & i128_fits_int64(hi, lo) & \
-            i128_abs_fits_pow10(hi, lo, self._dtype.precision)
-        return DevVal(jnp.where(validity, i128_to_i64(hi, lo),
-                                jnp.int64(0)), validity)
+        return dev_rescale_checked(c.data, c.validity, src.scale,
+                                   self._dtype.scale,
+                                   self._dtype.precision)
 
 
 class DecimalRemainder(DecimalBinary):
@@ -663,11 +661,16 @@ class DecimalRemainder(DecimalBinary):
                 and self._ltype.precision <= MAX_LONG_DIGITS
                 and self._rtype.precision <= MAX_LONG_DIGITS)
 
-    def _mod(self, a: int, b: int) -> int:
+    @staticmethod
+    def _java_mod(a: int, b: int) -> int:
         r = abs(a) % abs(b)
+        return -r if a < 0 else r              # Java %: dividend sign
+
+    def _mod(self, a: int, b: int) -> int:
         if self._java_sign:
-            return -r if a < 0 else r          # Java %: dividend sign
-        return r if b > 0 or r == 0 else r - abs(b)  # pmod: divisor-positive
+            return self._java_mod(a, b)
+        # Spark pmod: ((a % b) + b) % b with Java %
+        return self._java_mod(self._java_mod(a, b) + b, b)
 
     def _host_op(self, lv, rv):
         if rv == 0:
@@ -690,11 +693,16 @@ class DecimalRemainder(DecimalBinary):
         b = rval.data * jnp.int64(_POW10[s - self._rtype.scale])
         zero = b == 0
         safe = jnp.where(zero, jnp.int64(1), b)
-        r = jnp.abs(a) % jnp.abs(safe)
+
+        def jmod(x, y):
+            r = jnp.abs(x) % jnp.abs(y)
+            return jnp.where(x < 0, -r, r)
+
         if self._java_sign:
-            data = jnp.where(a < 0, -r, r)
+            data = jmod(a, safe)
         else:
-            data = jnp.where((safe > 0) | (r == 0), r, r - jnp.abs(safe))
+            # Spark pmod: ((a % b) + b) % b with Java %
+            data = jmod(jmod(a, safe) + safe, safe)
         validity = null_and(lval.validity, rval.validity) & ~zero
         return DevVal(jnp.where(validity, data, jnp.int64(0)), validity)
 

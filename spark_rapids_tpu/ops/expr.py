@@ -291,8 +291,19 @@ class BoundReference(Expression):
 
 class Literal(Expression):
     def __init__(self, value, dtype: Optional[T.DataType] = None):
-        self.value = value
         self._dtype = dtype if dtype is not None else T.python_to_spark_type(value)
+        # temporal literals normalize to the INTERNAL representation
+        # (days / UTC micros) at construction so both eval paths fill
+        # plain ints
+        import datetime as _dt
+        if isinstance(value, _dt.datetime):
+            epoch = _dt.datetime(1970, 1, 1, tzinfo=_dt.timezone.utc)
+            v = value if value.tzinfo is not None else \
+                value.replace(tzinfo=_dt.timezone.utc)
+            value = (v - epoch) // _dt.timedelta(microseconds=1)
+        elif isinstance(value, _dt.date):
+            value = (value - _dt.date(1970, 1, 1)).days
+        self.value = value
 
     @staticmethod
     def of(value, dtype: Optional[T.DataType] = None) -> "Literal":

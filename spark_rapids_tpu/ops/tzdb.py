@@ -29,8 +29,13 @@ from typing import Dict, Optional, Tuple
 import numpy as np
 
 _EPOCH = _dt.datetime(1970, 1, 1, tzinfo=_dt.timezone.utc)
-_SCAN_START = _dt.datetime(1900, 1, 1, tzinfo=_dt.timezone.utc)
-_SCAN_END = _dt.datetime(2100, 1, 1, tzinfo=_dt.timezone.utc)
+#: table coverage window. Instants outside it use the boundary offset —
+#: a documented carve-out (the reference's GpuTimeZoneDB likewise builds
+#: transitions to a max year). 1850..2200 covers Spark's practical range;
+#: sub-day double transitions (not observed in tzdata) would be missed
+#: by the day-granularity scan.
+_SCAN_START = _dt.datetime(1850, 1, 1, tzinfo=_dt.timezone.utc)
+_SCAN_END = _dt.datetime(2200, 1, 1, tzinfo=_dt.timezone.utc)
 _US = _dt.timedelta(microseconds=1)
 
 

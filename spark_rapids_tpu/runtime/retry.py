@@ -257,12 +257,14 @@ def with_retry(
                             continue
                         escalate = True
                     if escalate:
+                        tier = ("host" if isinstance(exc, CpuRetryOOM)
+                                else "device")
                         if not splittable:
                             raise FatalDeviceOOM(
-                                "device OOM and operator cannot split its input"
-                            ) from exc
+                                f"{tier} OOM and operator cannot split "
+                                "its input") from exc
                         RMM_TPU.note_split()
-                        _free_device_memory(catalog)
+                        _free_memory_for(exc, catalog)
                         with sb.pinned_batch() as dt:
                             halves = split_device_table_in_half(dt)
                         sb.release()

@@ -312,6 +312,8 @@ _PROMOTE_ORDER = {BYTE: 0, SHORT: 1, INT: 2, LONG: 3, FLOAT: 4, DOUBLE: 5}
 def promote(a: DataType, b: DataType) -> DataType:
     if a == b:
         return a
+    if isinstance(a, DecimalType) or isinstance(b, DecimalType):
+        return _promote_decimal(a, b)
     if a in _PROMOTE_ORDER and b in _PROMOTE_ORDER:
         return a if _PROMOTE_ORDER[a] >= _PROMOTE_ORDER[b] else b
     if isinstance(a, NullType):
@@ -319,3 +321,28 @@ def promote(a: DataType, b: DataType) -> DataType:
     if isinstance(b, NullType):
         return a
     raise TypeError(f"cannot promote {a} with {b}")
+
+
+_INT_DECIMAL = {ByteType: (3, 0), ShortType: (5, 0), IntegerType: (10, 0),
+                LongType: (20, 0)}
+
+
+def _promote_decimal(a: DataType, b: DataType) -> DataType:
+    """Spark decimal coercion: decimal+decimal widens to cover both;
+    decimal+integral widens over the integral's decimal form;
+    decimal+float/double promotes to double."""
+    if isinstance(a, (FloatType, DoubleType)) or \
+            isinstance(b, (FloatType, DoubleType)):
+        return DOUBLE
+    def as_dec(t):
+        if isinstance(t, DecimalType):
+            return t
+        ps = _INT_DECIMAL.get(type(t))
+        return DecimalType(*ps) if ps else None
+    da, db = as_dec(a), as_dec(b)
+    if da is None or db is None:
+        raise TypeError(f"cannot promote {a} with {b}")
+    scale = max(da.scale, db.scale)
+    int_digits = max(da.precision - da.scale, db.precision - db.scale)
+    p = min(int_digits + scale, DecimalType.MAX_PRECISION)
+    return DecimalType(p, scale)

@@ -1,0 +1,138 @@
+"""Datagen DSL tests (reference: datagen/bigDataGen.scala properties —
+determinism, chunking invariance, column stability, distributions, key
+groups)."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar import HostTable
+from spark_rapids_tpu.datagen import (
+    DecimalRange,
+    DoubleRange,
+    Exponential,
+    Flat,
+    ForeignKey,
+    LongRange,
+    MultiModal,
+    Normal,
+    RandomString,
+    SequentialKey,
+    TableSpec,
+    Word,
+    scale_test_specs,
+)
+
+
+def _spec():
+    return (TableSpec("t", rows_per_sf=1000)
+            .col("k", SequentialKey())
+            .col("v", LongRange(lo=0, hi=100))
+            .col("s", Word(cardinality=10))
+            .col("d", DoubleRange(lo=-1.0, hi=1.0, null_prob=0.1)))
+
+
+def test_deterministic_across_runs():
+    a = _spec().generate_table(1.0, seed=7)
+    b = _spec().generate_table(1.0, seed=7)
+    for ca, cb in zip(a.columns, b.columns):
+        assert np.array_equal(ca.validity, cb.validity)
+        assert list(ca.data) == list(cb.data)
+    c = _spec().generate_table(1.0, seed=8)
+    assert list(a.columns[1].data) != list(c.columns[1].data)
+
+
+def test_chunking_invariance():
+    """The same rows come out regardless of chunk size (row_offset
+    re-seeding — the reference's scalable-generation property)."""
+    whole = _spec().generate_table(1.0, seed=3)
+    chunked = _spec().generate(1.0, seed=3, chunk_rows=137)
+    merged = HostTable.concat(chunked)
+    assert merged.num_rows == whole.num_rows
+    for cw, cm in zip(whole.columns, merged.columns):
+        assert np.array_equal(cw.validity, cm.validity)
+        assert list(cw.data) == list(cm.data)
+
+
+def test_column_stability_under_schema_changes():
+    """Adding another column must not change an existing column's
+    values (per-column seed streams)."""
+    base = (TableSpec("t", 500).col("v", LongRange(lo=0, hi=1 << 30)))
+    wide = (TableSpec("t", 500)
+            .col("extra", RandomString())
+            .col("v", LongRange(lo=0, hi=1 << 30)))
+    a = base.generate_table(1.0, seed=1)
+    b = wide.generate_table(1.0, seed=1)
+    va = a.columns[0].data
+    vb = b.columns[list(b.names).index("v")].data
+    assert list(va) == list(vb)
+
+
+def test_sequential_key_unique_and_chunk_consistent():
+    chunks = (TableSpec("t", 1000).col("k", SequentialKey())
+              .generate(1.0, seed=0, chunk_rows=333))
+    ks = np.concatenate([c.columns[0].data for c in chunks])
+    assert list(ks) == list(range(1000))
+
+
+def test_foreign_key_domain_and_skew():
+    fk = ForeignKey(parent_rows=100, distribution=Exponential(rate=6.0))
+    col = fk.generate(20000, seed=0, table="t", column="f")
+    assert col.data.min() >= 0 and col.data.max() < 100
+    # exponential skew: the hottest key much hotter than the median
+    counts = np.bincount(col.data, minlength=100)
+    assert counts.max() > 5 * np.median(counts[counts > 0])
+
+
+def test_distributions_shape():
+    rng = np.random.default_rng(0)
+    flat = Flat().sample(20000, rng)
+    norm = Normal(center=0.5, stddev=0.1).sample(20000, rng)
+    mm = MultiModal(centers=(0.2, 0.8), stddev=0.02).sample(20000, rng)
+    assert 0.45 < flat.mean() < 0.55 and flat.std() > 0.25
+    assert norm.std() < 0.12
+    hist, _ = np.histogram(mm, bins=10, range=(0, 1))
+    assert hist[2] > hist[5] * 3 and hist[7] > hist[5] * 3  # two modes
+    assert all(0 <= x < 1 for x in (flat.min(), norm.min(), mm.min()))
+
+
+def test_decimal_gen_scale():
+    g = DecimalRange(dtype=T.DecimalType(10, 2), lo=0.0, hi=10.0)
+    col = g.generate(1000, seed=0, table="t", column="d")
+    assert col.dtype == T.DecimalType(10, 2)
+    assert col.data.min() >= 0 and col.data.max() <= 1000  # unscaled
+
+
+def test_scale_test_specs_join_consistent(session, cpu_session):
+    specs = scale_test_specs(0.01)
+    tables = {k: s.generate_table(0.01, seed=0) for k, s in specs.items()}
+    assert tables["lineitem"].num_rows == 10000
+    # every l_orderkey exists in orders (FK domain)
+    li_keys = tables["lineitem"].columns[0].data
+    assert li_keys.max() < tables["orders"].num_rows
+
+    # run one end-to-end query over generated data, TPU vs CPU oracle
+    from spark_rapids_tpu import functions as F
+    from spark_rapids_tpu.plan import from_host_table
+
+    def q(s):
+        return (from_host_table(tables["lineitem"], s)
+                .group_by("l_returnflag")
+                .agg(F.count("l_quantity").alias("c"),
+                     F.sum("l_quantity").alias("sq")))
+
+    got = sorted(q(session).collect())
+    want = sorted(q(cpu_session).collect())
+    assert got == want
+
+
+def test_scale_harness_queries_build(cpu_session):
+    """scale_test.py's query set builds and runs on the CPU session at a
+    tiny SF (harness smoke; TPU timing is the driver's job)."""
+    import scale_test as st
+    specs = scale_test_specs(0.005)
+    tables = {k: s.generate_table(0.005, seed=0) for k, s in specs.items()}
+    queries = st.build_queries(cpu_session, tables)
+    for name, fn in queries.items():
+        t = fn().collect_table()
+        assert t.num_rows >= 0, name

@@ -282,3 +282,77 @@ def test_decimal_remainder_and_pmod(session, cpu_session):
     assert got == q(cpu_session).collect()
     assert got[0][0] == 150    # 1.50 (unscaled at scale 2)
     assert got[1][0] == -150   # Java %: dividend sign
+
+
+def test_decimal_pmod_negative_dividend(session, cpu_session):
+    from spark_rapids_tpu.ops.arithmetic import Pmod
+    ptype = T.DecimalType(8, 2)
+
+    def q(s):
+        df = _dec_df(s, [_pd("-7.50"), _pd("7.50")], ptype)
+        return df.select(Pmod(col("d"), lit(2)).alias("p"))
+
+    got = q(session).collect()
+    assert got == q(cpu_session).collect()
+    assert got[0][0] == 50     # pmod(-7.5, 2) = 0.50
+    assert got[1][0] == 150    # pmod(7.5, 2) = 1.50
+
+
+def test_decimal_divided_by_double_promotes(session, cpu_session):
+    ptype = T.DecimalType(8, 2)
+
+    def q(s):
+        df = _dec_df(s, [_pd("5.00")], ptype)
+        return df.select((col("d") / lit(2.0)).alias("q"))
+
+    got = q(session).collect()
+    want = q(cpu_session).collect()
+    assert abs(got[0][0] - 2.5) < 1e-12
+    assert abs(got[0][0] - want[0][0]) <= 1e-12
+    assert dict(q(session).plan.output_schema())["q"] == T.DOUBLE
+
+
+def test_decimal_add_19_digit_boundary(session, cpu_session):
+    """decimal(18,0) + decimal(18,0) -> decimal(19,0): 10^18 is a VALID
+    19-digit value; device must not null it (review fix)."""
+    ptype = T.DecimalType(18, 0)
+    v = 10**18 - 1
+
+    def q(s):
+        df = s.create_dataframe({"d": np.array([v], dtype=np.int64)},
+                                dtypes={"d": ptype})
+        return df.select((col("d") + lit(1)).alias("a"))
+
+    got = q(session).collect()
+    assert got == q(cpu_session).collect()
+    assert got[0][0] == 10**18
+
+
+def test_mixed_scale_decimal_comparison(session, cpu_session):
+    a = T.DecimalType(6, 2)
+
+    def q(s):
+        df = s.create_dataframe(
+            {"x": np.array([150, 149, 151], dtype=np.int64),
+             "y": np.array([1500, 1500, 1500], dtype=np.int64)},
+            dtypes={"x": a, "y": T.DecimalType(8, 3)})
+        return df.select((col("x") == col("y")).alias("eq"),
+                         (col("x") < col("y")).alias("lt"))
+
+    got = q(session).collect()
+    assert got == q(cpu_session).collect()
+    assert got == [(True, False), (False, True), (False, False)]
+
+
+def test_integral_divide_decimal(session, cpu_session):
+    ptype = T.DecimalType(6, 1)
+
+    def q(s):
+        df = _dec_df(s, [_pd("7.5"), _pd("-7.5")], ptype)
+        from spark_rapids_tpu.ops.arithmetic import IntegralDivide
+        return df.select(
+            IntegralDivide(col("d"), lit(5, T.DecimalType(2, 1))).alias("q"))
+
+    got = q(session).collect()
+    assert got == q(cpu_session).collect()
+    assert got[0][0] == 15 and got[1][0] == -15  # 7.5 div 0.5
