@@ -21,6 +21,7 @@ from spark_rapids_tpu.columnar import HostColumn, HostTable
 from spark_rapids_tpu.errors import ColumnarProcessingError
 from spark_rapids_tpu.ops.common import BinaryExpression, UnaryExpression, null_and
 from spark_rapids_tpu.ops.expr import DevVal, Expression
+from spark_rapids_tpu.ops.strings import DictStringToValue
 
 MICROS_PER_DAY = 86_400_000_000
 MICROS_PER_SECOND = 1_000_000
@@ -427,3 +428,184 @@ class TsToDate(UnaryExpression):
         cv = child_vals[0]
         return DevVal(jnp.floor_divide(cv.data, MICROS_PER_DAY).astype(jnp.int32),
                       cv.validity)
+
+
+# -- string timestamp parsing (UnixTimestamp family) -------------------------
+
+#: Java SimpleDateFormat token -> strptime directive (longest-first).
+#: Patterns containing tokens outside this table are untranslatable:
+#: the expression then RAISES instead of silently nulling (the reference
+#: gates device parsing to a known-compatible subset the same way —
+#: GpuToTimestamp supported formats).
+_JAVA_TOKENS = [
+    ("yyyy", "%Y"), ("yyy", "%Y"), ("yy", "%y"),
+    ("MM", "%m"), ("dd", "%d"), ("HH", "%H"), ("hh", "%I"),
+    ("mm", "%M"), ("ss", "%S"),
+    ("M", "%m"), ("d", "%d"), ("H", "%H"), ("m", "%M"), ("s", "%S"),
+]
+
+
+def translate_java_format(fmt: str):
+    """Java SimpleDateFormat -> strptime; None when a token has no
+    faithful mapping (fractions, zones, am/pm, day names, quoted text)."""
+    out = []
+    i = 0
+    while i < len(fmt):
+        ch = fmt[i]
+        if ch.isalpha():
+            for tok, rep in _JAVA_TOKENS:
+                if fmt.startswith(tok, i):
+                    out.append(rep)
+                    i += len(tok)
+                    break
+            else:
+                return None  # unsupported pattern letter
+        else:
+            if ch == "%":
+                out.append("%%")
+            else:
+                out.append(ch)
+            i += 1
+    return "".join(out)
+
+
+class UnixTimestamp(DictStringToValue, BinaryExpression):
+    """unix_timestamp(string, fmt): seconds since epoch as LONG; null on
+    parse failure (Spark non-ANSI). fmt must be a literal in the
+    supported subset; other formats tag CPU fallback."""
+
+    out_type = T.LONG
+
+    def __init__(self, child: Expression, fmt: Expression = None):
+        from spark_rapids_tpu.ops.expr import Literal
+        fmt = fmt if fmt is not None else Literal.of("yyyy-MM-dd HH:mm:ss")
+        self.children = (child, fmt)
+
+    def with_children(self, children):
+        return type(self)(children[0], children[1])
+
+    def key(self):
+        from spark_rapids_tpu.ops.expr import Literal
+        f = self.children[1]
+        return (type(self).__name__.lower(),
+                f.value if isinstance(f, Literal) else None,
+                self.children[0].key())
+
+    def _fmt(self):
+        from spark_rapids_tpu.ops.expr import Literal
+        f = self.children[1]
+        if isinstance(f, Literal) and f.value is not None:
+            return translate_java_format(str(f.value))
+        return None
+
+    @property
+    def device_supported(self):
+        return self._fmt() is not None
+
+    def value_of(self, s: str):
+        import datetime as _dt
+        fmt = self._fmt()
+        if fmt is None:
+            # untranslatable format: the CPU path is the FINAL fallback —
+            # raising loudly beats silently nulling every row
+            from spark_rapids_tpu.ops.expr import Literal
+            f = self.children[1]
+            shown = f.value if isinstance(f, Literal) else f
+            raise ColumnarProcessingError(
+                f"unix_timestamp format {shown!r} is not supported "
+                "(unsupported SimpleDateFormat tokens)")
+        try:
+            d = _dt.datetime.strptime(s.strip(), fmt)
+        except ValueError:
+            return None
+        return int((d.replace(tzinfo=_dt.timezone.utc)
+                    - _dt.datetime(1970, 1, 1,
+                                   tzinfo=_dt.timezone.utc)).total_seconds())
+
+
+class ToUnixTimestamp(UnixTimestamp):
+    """to_unix_timestamp(string, fmt) — same semantics."""
+
+
+class GetTimestamp(UnixTimestamp):
+    """to_timestamp(string, fmt): TIMESTAMP (micros) instead of seconds."""
+
+    out_type = T.TIMESTAMP
+
+    def value_of(self, s: str):
+        v = super().value_of(s)
+        return None if v is None else v * 1_000_000
+
+
+class TimeAdd(BinaryExpression):
+    """timestamp + interval (literal micros — the reference requires a
+    literal CalendarInterval without months too)."""
+
+    @property
+    def data_type(self):
+        return T.TIMESTAMP
+
+    def key(self):
+        from spark_rapids_tpu.ops.expr import Literal
+        i = self.children[1]
+        return ("time_add", i.value if isinstance(i, Literal) else None,
+                self.children[0].key())
+
+    @property
+    def device_supported(self):
+        from spark_rapids_tpu.ops.expr import Literal
+        return isinstance(self.children[1], Literal)
+
+    def _micros(self):
+        """Interval micros, or None for a null literal (null interval ->
+        null column, Spark semantics)."""
+        from spark_rapids_tpu.ops.expr import Literal
+        i = self.children[1]
+        if not isinstance(i, Literal):
+            raise ColumnarProcessingError(
+                "TimeAdd interval must be a literal")
+        return None if i.value is None else int(i.value)
+
+    def eval_cpu(self, table):
+        c = self.children[0].eval_cpu(table)
+        m = self._micros()
+        if m is None:
+            return HostColumn(T.TIMESTAMP, np.zeros_like(c.data),
+                              np.zeros(len(c.data), dtype=np.bool_))
+        return HostColumn(T.TIMESTAMP, c.data + m, c.validity.copy())
+
+    def eval_dev(self, ctx, child_vals, prep):
+        c = child_vals[0]
+        m = self._micros()
+        if m is None:
+            return DevVal(jnp.zeros_like(c.data),
+                          jnp.zeros_like(c.validity))
+        return DevVal(c.data + jnp.int64(m), c.validity)
+
+
+class PreciseTimestampConversion(UnaryExpression):
+    """Exact long<->timestamp reinterpret at micros precision (Spark
+    inserts it around window time functions)."""
+
+    def __init__(self, child: Expression, to_timestamp: bool = True):
+        super().__init__(child)
+        self._to_ts = to_timestamp
+
+    @property
+    def data_type(self):
+        return T.TIMESTAMP if self._to_ts else T.LONG
+
+    def with_children(self, children):
+        return PreciseTimestampConversion(children[0], self._to_ts)
+
+    def key(self):
+        return ("precise_ts_conv", self._to_ts, self.children[0].key())
+
+    def eval_cpu(self, table):
+        c = self.children[0].eval_cpu(table)
+        return HostColumn(self.data_type, c.data.astype(np.int64),
+                          c.validity.copy())
+
+    def eval_dev(self, ctx, child_vals, prep):
+        (c,) = child_vals
+        return DevVal(c.data.astype(jnp.int64), c.validity)

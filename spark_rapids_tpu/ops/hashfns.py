@@ -16,6 +16,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from spark_rapids_tpu import types as T
+from spark_rapids_tpu.errors import ColumnarProcessingError
 from spark_rapids_tpu.columnar import HostColumn, HostTable
 from spark_rapids_tpu.ops.expr import (
     DevVal,
@@ -401,4 +402,129 @@ class XxHash64(_HashBase):
         cols = [(v.data, v.validity, c.data_type)
                 for c, v in zip(self.children, child_vals)]
         h = xxhash64_device(cols, string_bytes=self._string_bytes(ctx, prep))
+        return DevVal(h, jnp.ones(ctx.capacity, dtype=jnp.bool_))
+
+
+# -- hive hash ---------------------------------------------------------------
+
+def _hive_string_hash(s: str) -> int:
+    """Hive HiveHasher.hashUnsafeBytes: fold SIGNED UTF-8 bytes
+    (31*h + byte), int32 wraparound. Matches String.hashCode only for
+    ASCII — non-ASCII must use the byte fold or bucketing diverges."""
+    h = 0
+    for byte in s.encode("utf-8"):
+        signed = byte - 256 if byte >= 128 else byte
+        h = (h * 31 + signed) & 0xFFFFFFFF
+    return h - (1 << 32) if h >= (1 << 31) else h
+
+
+def _hive_timestamp_value(micros: int) -> int:
+    """Hive TimestampWritable.hashCode layout: (seconds << 30) | nanos,
+    before the standard long fold."""
+    seconds, rem = divmod(int(micros), 1_000_000)
+    return (seconds << 30) | (rem * 1000)
+
+
+def _hive_field_host(value, valid: bool, dtype) -> int:
+    if not valid:
+        return 0
+    if isinstance(dtype, T.BooleanType):
+        return 1 if value else 0
+    if isinstance(dtype, (T.ByteType, T.ShortType, T.IntegerType,
+                          T.DateType)):
+        return int(np.int32(value))
+    if isinstance(dtype, T.LongType):
+        v = int(np.int64(value))
+        return int(np.int32((v ^ ((v >> 32) & 0xFFFFFFFF)) & 0xFFFFFFFF))
+    if isinstance(dtype, T.FloatType):
+        bits = np.float32(value).view(np.int32)
+        return int(bits)
+    if isinstance(dtype, (T.DoubleType, T.TimestampType)):
+        if isinstance(dtype, T.TimestampType):
+            v = _hive_timestamp_value(int(np.int64(value)))
+        else:
+            v = int(np.float64(value).view(np.int64))
+        return int(np.int32((v ^ ((v >> 32) & 0xFFFFFFFF)) & 0xFFFFFFFF))
+    if isinstance(dtype, T.StringType):
+        return _hive_string_hash(value)
+    raise ColumnarProcessingError(f"hive hash of {dtype} not supported")
+
+
+class HiveHash(_HashBase):
+    """Hive hash (reference: HashFunctions.scala hiveHash / JNI Hash):
+    row hash = fold(31 * h + fieldHash), null fields hash to 0."""
+
+    @property
+    def data_type(self):
+        return T.INT
+
+    def eval_cpu(self, table: HostTable) -> HostColumn:
+        cols = [c.eval_cpu(table) for c in self.children]
+        n = table.num_rows
+        out = np.empty(n, dtype=np.int32)
+        for r in range(n):
+            h = 0
+            for j, c in enumerate(cols):
+                f = _hive_field_host(c.data[r], bool(c.validity[r]),
+                                     self.children[j].data_type)
+                h = (h * 31 + f) & 0xFFFFFFFF
+            out[r] = np.uint32(h).astype(np.int32).item() \
+                if h < (1 << 31) else h - (1 << 32)
+        return HostColumn(T.INT, out, np.ones(n, dtype=np.bool_))
+
+    def prep(self, pctx: PrepCtx, child_preps) -> NodePrep:
+        # per-string-child: precomputed Java hashCode per DICT entry
+        slots = []
+        for c, p in zip(self.children, child_preps):
+            if isinstance(c.data_type, T.StringType):
+                d = p.out_dict if p.out_dict is not None \
+                    else np.array([], dtype=object)
+                hashes = np.array(
+                    [_hive_string_hash(s) for s in d] or [0],
+                    dtype=np.int32)
+                slots.append(pctx.add_aux(hashes))
+            else:
+                slots.append(None)
+        flat = tuple(s for s in slots if s is not None)
+        return NodePrep(aux_slots=flat,
+                        extra={"string_ix": tuple(
+                            i for i, s in enumerate(slots)
+                            if s is not None)})
+
+    def eval_dev(self, ctx: EvalCtx, child_vals, prep: NodePrep) -> DevVal:
+        it = iter(prep.aux_slots)
+        string_hash = {i: ctx.aux[next(it)]
+                       for i in prep.extra["string_ix"]}
+        h = jnp.zeros(ctx.capacity, dtype=jnp.int32)
+        for j, (c, v) in enumerate(zip(self.children, child_vals)):
+            dt = c.data_type
+            if j in string_hash:
+                tbl = string_hash[j]
+                f = tbl[jnp.clip(v.data, 0, tbl.shape[0] - 1)]
+            elif isinstance(dt, T.BooleanType):
+                f = v.data.astype(jnp.int32)
+            elif isinstance(dt, (T.ByteType, T.ShortType, T.IntegerType,
+                                 T.DateType)):
+                f = v.data.astype(jnp.int32)
+            elif isinstance(dt, T.TimestampType):
+                micros = v.data.astype(jnp.int64)
+                seconds = jnp.floor_divide(micros, 1_000_000)
+                nanos = (micros - seconds * 1_000_000) * 1000
+                x = (seconds << 30) | nanos
+                f = (x ^ ((x >> 32) & 0xFFFFFFFF)).astype(jnp.int32)
+            elif isinstance(dt, T.LongType):
+                x = v.data.astype(jnp.int64)
+                f = (x ^ ((x >> 32) & 0xFFFFFFFF)).astype(jnp.int32)
+            elif isinstance(dt, T.FloatType):
+                f = jax.lax.bitcast_convert_type(
+                    v.data.astype(jnp.float32), jnp.int32)
+            elif isinstance(dt, T.DoubleType):
+                x = jax.lax.bitcast_convert_type(
+                    v.data.astype(jnp.float64), jnp.int64)
+                f = (x ^ ((x >> 32) & 0xFFFFFFFF)).astype(jnp.int32)
+            else:
+                raise ColumnarProcessingError(
+                    f"hive hash of {dt} not supported on device")
+            f = jnp.where(v.validity, f, jnp.int32(0))
+            h = h * jnp.int32(31) + f
         return DevVal(h, jnp.ones(ctx.capacity, dtype=jnp.bool_))

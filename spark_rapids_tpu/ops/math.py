@@ -416,3 +416,37 @@ class ShiftRightUnsigned(_ShiftBase):
             if xp is np else a.astype(jnp.uint64 if a.dtype == jnp.int64 else jnp.uint32)
         shifted = xp.right_shift(unsigned, cnt.astype(unsigned.dtype))
         return shifted.astype(a.dtype)
+
+
+class _RoundDirBase(_RoundBase):
+    """ceil/floor at decimal scale (shim rules RoundCeil/RoundFloor)."""
+
+    _np_fn = None
+    _jnp_fn = None
+
+    def eval_cpu(self, table):
+        c = self.children[0].eval_cpu(table)
+        factor = 10.0 ** self._scale()
+        with np.errstate(all="ignore"):
+            data = type(self)._np_fn(c.data * factor) / factor
+        if isinstance(c.dtype, T.IntegralType):
+            data = data.astype(c.dtype.np_dtype)
+        return HostColumn(c.dtype, data, c.validity.copy())
+
+    def eval_dev(self, ctx, child_vals, prep):
+        c = child_vals[0]
+        factor = 10.0 ** self._scale()
+        data = type(self)._jnp_fn(c.data * factor) / factor
+        if isinstance(self.children[0].data_type, T.IntegralType):
+            data = data.astype(self.children[0].data_type.np_dtype)
+        return DevVal(data, c.validity)
+
+
+class RoundCeil(_RoundDirBase):
+    _np_fn = staticmethod(np.ceil)
+    _jnp_fn = staticmethod(jnp.ceil)
+
+
+class RoundFloor(_RoundDirBase):
+    _np_fn = staticmethod(np.floor)
+    _jnp_fn = staticmethod(jnp.floor)

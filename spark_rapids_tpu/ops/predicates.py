@@ -346,3 +346,10 @@ class In(Expression):
             match = match | ((ld == rd) & iv.validity)
         validity = v.validity & (match | (not has_null_item))
         return DevVal(jnp.where(validity, match, False), validity)
+
+
+class InSet(In):
+    """Optimized IN over a large literal set (Spark converts In -> InSet
+    past spark.sql.optimizer.inSetConversionThreshold). Identical
+    semantics; the device evaluation inherits In's chain, which XLA
+    fuses into one vectorized membership test."""

@@ -680,3 +680,94 @@ def _lit_str_key(e: Expression):
     if isinstance(e, Literal):
         return ("lit", e.value)
     return e.key()
+
+
+class Conv(DictStringToString):
+    """conv(numStr, fromBase, toBase): base conversion with Spark/Hive
+    semantics (bases 2..36, literal bases; invalid digits truncate at the
+    first bad char; empty -> null; toBase<0 -> signed output)."""
+
+    def __init__(self, child, from_base, to_base):
+        self.children = (child, from_base, to_base)
+
+    def with_children(self, children):
+        return Conv(children[0], children[1], children[2])
+
+    def key(self):
+        return ("conv", self._bases(), self.children[0].key())
+
+    def _bases(self):
+        from spark_rapids_tpu.ops.expr import Literal
+        fb, tb = self.children[1], self.children[2]
+        if isinstance(fb, Literal) and isinstance(tb, Literal) \
+                and fb.value is not None and tb.value is not None:
+            return int(fb.value), int(tb.value)
+        return None
+
+    @property
+    def device_supported(self):
+        b = self._bases()
+        return b is not None and 2 <= b[0] <= 36 and 2 <= abs(b[1]) <= 36
+
+    @staticmethod
+    def _convert(s: str, from_base: int, to_base: int):
+        """Hive NumberConverter semantics: empty -> null; '-' optional
+        sign; digits stop at the FIRST invalid char ('+'/whitespace are
+        invalid -> value 0 -> "0"); unsigned-64 accumulation SATURATES at
+        2^64-1; positive toBase prints unsigned, negative prints signed."""
+        if not (2 <= from_base <= 36 and 2 <= abs(to_base) <= 36):
+            return None
+        if not s:
+            return None
+        neg = s.startswith("-")
+        t = s[1:] if neg else s
+        digits = "0123456789abcdefghijklmnopqrstuvwxyz"[:from_base]
+        u64_max = (1 << 64) - 1
+        v = 0
+        for ch in t.lower():
+            d = digits.find(ch)
+            if d < 0:
+                break
+            v = v * from_base + d
+            if v > u64_max:
+                v = u64_max  # saturate (Hive overflow behavior)
+        if neg:
+            v = (-v) & u64_max  # two's-complement wrap of the negation
+        if to_base < 0 and v > (1 << 63) - 1:
+            signed = v - (1 << 64)
+            out_neg, v, base = True, -signed, -to_base
+        else:
+            out_neg, base = False, abs(to_base)
+        alphabet = "0123456789ABCDEFGHIJKLMNOPQRSTUVWXYZ"
+        out = ""
+        while True:
+            out = alphabet[v % base] + out
+            v //= base
+            if v == 0:
+                break
+        return ("-" if out_neg else "") + out
+
+    def transform(self, s):
+        b = self._bases()
+        if b is None:
+            return None
+        return self._convert(s, b[0], b[1])
+
+    def eval_cpu(self, table):
+        if self._bases() is not None:
+            return super().eval_cpu(table)
+        # non-literal bases: CPU fallback evaluates them per row
+        doc = self.children[0].eval_cpu(table)
+        fb = self.children[1].eval_cpu(table)
+        tb = self.children[2].eval_cpu(table)
+        n = len(doc)
+        out = np.empty(n, dtype=object)
+        validity = (doc.validity & fb.validity & tb.validity).copy()
+        for i in range(n):
+            r = None
+            if validity[i]:
+                r = self._convert(doc.data[i], int(fb.data[i]),
+                                  int(tb.data[i]))
+            out[i] = r
+            validity[i] = r is not None
+        return HostColumn(T.STRING, out, validity)

@@ -81,6 +81,8 @@ def _build_expr_sigs():
     reg(expr_mod.Literal)
     reg(expr_mod.Alias, COMMON_PLUS_ARRAYS)
     reg(cast.Cast)
+    from spark_rapids_tpu.ops import json_fns
+    reg(json_fns.GetJsonObject)
     from spark_rapids_tpu.ops import decimal as decimal_ops
     for name in ("DecimalAdd", "DecimalSubtract", "DecimalMultiply",
                  "DecimalDivide", "UnscaledValue", "MakeDecimal",

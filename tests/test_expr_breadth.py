@@ -1,0 +1,287 @@
+"""Expression-breadth batch tests: get_json_object/json_tuple, hive
+hash, conv, ceil/floor-at-scale, unix_timestamp parsing, time_add,
+InSet (reference: Appendix A inventory — GetJsonObject/JSONUtils,
+HashFunctions.hiveHash, Conv, RoundCeil/RoundFloor, GpuToTimestamp)."""
+
+import datetime as dt
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.ops.expr import col, lit
+from tests.asserts import assert_runs_on_tpu
+
+
+# -- get_json_object ---------------------------------------------------------
+
+DOCS = [
+    '{"a": 1, "b": {"c": "x"}, "arr": [10, 20, {"d": true}]}',
+    '{"a": "str", "arr": []}',
+    'not json',
+    '{"a": null}',
+    '{"b": {"c": "y"}, "arr": [1, 2, 3]}',
+]
+
+
+def _jdf(s):
+    return s.create_dataframe({"j": np.array(DOCS, dtype=object)})
+
+
+def test_get_json_object(session, cpu_session):
+    from spark_rapids_tpu.ops.json_fns import GetJsonObject
+
+    def q(s):
+        return _jdf(s).select(
+            GetJsonObject(col("j"), lit("$.a")).alias("a"),
+            GetJsonObject(col("j"), lit("$.b.c")).alias("bc"),
+            GetJsonObject(col("j"), lit("$.arr[1]")).alias("i1"),
+            GetJsonObject(col("j"), lit("$.arr[2].d")).alias("d"),
+            GetJsonObject(col("j"), lit("$.missing")).alias("m"))
+
+    got = q(session).collect()
+    assert got == q(cpu_session).collect()
+    assert got[0] == ("1", "x", "20", "true", None)
+    assert got[1][0] == "str"          # strings unquoted
+    assert got[2] == (None,) * 5       # invalid json -> null
+    assert got[3][0] is None           # json null -> null
+    assert got[4][2] == "2"
+    assert_runs_on_tpu(q, session)
+
+
+def test_get_json_object_objects_and_wildcard(session, cpu_session):
+    from spark_rapids_tpu.ops.json_fns import GetJsonObject
+
+    def q(s):
+        return _jdf(s).select(
+            GetJsonObject(col("j"), lit("$.b")).alias("obj"),
+            GetJsonObject(col("j"), lit("$.arr[*]")).alias("w"))
+
+    got = q(session).collect()
+    assert got == q(cpu_session).collect()
+    assert got[0][0] == '{"c":"x"}'            # objects -> compact json
+    assert got[4][1] == "[1,2,3]"              # wildcard collects
+
+
+def test_json_tuple(session, cpu_session):
+    from spark_rapids_tpu.ops.json_fns import json_tuple
+
+    def q(s):
+        return _jdf(s).select(*json_tuple(col("j"), "a", "b"))
+
+    got = q(session).collect()
+    assert got == q(cpu_session).collect()
+    assert got[0][0] == "1" and got[1][0] == "str"
+
+
+# -- hive hash ---------------------------------------------------------------
+
+def test_hive_hash_known_vectors(session, cpu_session):
+    """Java oracle: "Spark".hashCode() == 80085693 (hand-folded
+    31*h + c over S,p,a,r,k); int passes through; long folds hi^lo;
+    multi-column folds 31*h + f."""
+    from spark_rapids_tpu.ops.hashfns import HiveHash, _hive_string_hash
+    h = 0
+    for ch in "Spark":
+        h = (h * 31 + ord(ch)) & 0xFFFFFFFF
+    assert _hive_string_hash("Spark") == h == 80085693
+    assert _hive_string_hash("") == 0
+
+    def q(s):
+        df = s.create_dataframe({
+            "s": np.array(["Spark", "", None], dtype=object),
+            "i": np.array([42, -1, 7], dtype=np.int64)})
+        return df.select(HiveHash(col("s")).alias("hs"),
+                         HiveHash(col("i")).alias("hi"),
+                         HiveHash(col("s"), col("i")).alias("hsi"))
+
+    got = q(session).collect()
+    assert got == q(cpu_session).collect()
+    assert got[0][0] == 80085693
+    assert got[0][1] == 42            # long 42: 42 ^ 0 = 42
+    assert got[2][0] == 0             # null -> 0
+    w = (31 * 80085693 + 42) & 0xFFFFFFFF
+    w = w - (1 << 32) if w >= (1 << 31) else w
+    assert got[0][2] == w  # int32 wraparound
+    assert_runs_on_tpu(q, session)
+
+
+# -- conv --------------------------------------------------------------------
+
+def test_conv(session, cpu_session):
+    from spark_rapids_tpu.ops.strings import Conv
+
+    def q(s):
+        df = s.create_dataframe({"x": np.array(
+            ["100", "ff", "-10", "zz", "", "12junk"], dtype=object)})
+        return df.select(
+            Conv(col("x"), lit(2), lit(10)).alias("b2"),
+            Conv(col("x"), lit(16), lit(10)).alias("b16"),
+            Conv(col("x"), lit(10), lit(16)).alias("b10_16"))
+
+    got = q(session).collect()
+    assert got == q(cpu_session).collect()
+    assert got[0] == ("4", "256", "64")      # "100" in bases 2/16/10
+    assert got[1][1] == "255"                # ff hex
+    assert got[3] == ("0", "0", "0")         # no valid digit -> "0" (Hive)
+    assert got[4] == (None, None, None)      # empty -> null
+    assert got[5][0] == "1"                  # truncates at first bad char
+    # negative wraps to uint64 for positive toBase (Hive semantics)
+    assert got[2][1] == str((1 << 64) - 16)
+
+
+# -- ceil/floor at scale -----------------------------------------------------
+
+def test_round_ceil_floor(session, cpu_session):
+    from spark_rapids_tpu.ops.math import RoundCeil, RoundFloor
+
+    def q(s):
+        df = s.create_dataframe({"x": np.array([1.234, -1.234, 5.0])})
+        return df.select(RoundCeil(col("x"), lit(1)).alias("c"),
+                         RoundFloor(col("x"), lit(1)).alias("f"))
+
+    got = q(session).collect()
+    want = q(cpu_session).collect()
+    for g, w in zip(got, want):
+        for a, b in zip(g, w):
+            assert abs(a - b) < 1e-9
+    assert abs(got[0][0] - 1.3) < 1e-9 and abs(got[0][1] - 1.2) < 1e-9
+    assert abs(got[1][0] - (-1.2)) < 1e-9 and abs(got[1][1] - (-1.3)) < 1e-9
+
+
+# -- unix_timestamp parsing --------------------------------------------------
+
+def test_unix_timestamp_parsing(session, cpu_session):
+    from spark_rapids_tpu.ops.datetime import GetTimestamp, UnixTimestamp
+
+    def q(s):
+        df = s.create_dataframe({"t": np.array(
+            ["2024-03-10 12:34:56", "1970-01-01 00:00:00", "oops", None],
+            dtype=object)})
+        return df.select(UnixTimestamp(col("t")).alias("u"),
+                         GetTimestamp(col("t")).alias("ts"))
+
+    got = q(session).collect()
+    assert got == q(cpu_session).collect()
+    want = int(dt.datetime(2024, 3, 10, 12, 34, 56,
+                           tzinfo=dt.timezone.utc).timestamp())
+    assert got[0][0] == want
+    assert got[1][0] == 0
+    assert got[2][0] is None and got[3][0] is None
+    assert_runs_on_tpu(q, session)
+
+
+def test_unix_timestamp_custom_format(session, cpu_session):
+    from spark_rapids_tpu.ops.datetime import UnixTimestamp
+
+    def q(s):
+        df = s.create_dataframe({"t": np.array(["10/03/2024"], dtype=object)})
+        return df.select(UnixTimestamp(col("t"), lit("dd/MM/yyyy")).alias("u"))
+
+    got = q(session).collect()
+    assert got == q(cpu_session).collect()
+    assert got[0][0] == int(dt.datetime(2024, 3, 10,
+                                        tzinfo=dt.timezone.utc).timestamp())
+
+
+# -- time_add ----------------------------------------------------------------
+
+def test_time_add(session, cpu_session):
+    from spark_rapids_tpu.ops.datetime import TimeAdd
+    base = dt.datetime(2024, 1, 1, 0, 0, 0)
+
+    def q(s):
+        df = s.create_dataframe({"t": [base]}, {"t": T.TIMESTAMP})
+        return df.select(
+            TimeAdd(col("t"), lit(3_600_000_000)).alias("plus_hour"))
+
+    got = q(session).collect()
+    assert got == q(cpu_session).collect()
+    assert got[0][0] == base + dt.timedelta(hours=1)
+
+
+# -- InSet -------------------------------------------------------------------
+
+def test_inset(session, cpu_session):
+    from spark_rapids_tpu.ops.predicates import InSet
+
+    def q(s):
+        df = s.create_dataframe({"x": np.array([1, 5, 9], dtype=np.int64)})
+        return df.filter(InSet(col("x"), [lit(1), lit(9), lit(100)]))
+
+    got = sorted(q(session).collect())
+    assert got == sorted(q(cpu_session).collect())
+    assert [r[0] for r in got] == [1, 9]
+    assert_runs_on_tpu(q, session)
+
+
+def test_conv_saturation_and_signed(cpu_session):
+    """Hive NumberConverter corners (review fixes): unsigned-64
+    saturation, signed output for negative toBase, '+' not a sign."""
+    from spark_rapids_tpu.ops.strings import Conv
+    c = Conv._convert
+    assert c("99999999999999999999", 10, 16) == "FFFFFFFFFFFFFFFF"
+    assert c("99999999999999999999", 10, -16) == "-1"
+    assert c("+15", 10, 16) == "0"     # '+' stops parsing at value 0
+    assert c(" 15", 10, 16) == "0"     # whitespace is not trimmed
+    assert c("-10", 10, 10) == str((1 << 64) - 10)  # wraps unsigned
+    assert c("-10", 10, -10) == "-10"  # signed output
+
+
+def test_hive_hash_non_ascii_and_timestamp(session, cpu_session):
+    """Review fixes: UTF-8 signed-byte fold + Hive timestamp layout."""
+    from spark_rapids_tpu.ops.hashfns import (
+        HiveHash,
+        _hive_string_hash,
+        _hive_timestamp_value,
+    )
+    # 'é' = UTF-8 C3 A9 -> (-61)*31 + (-87) = -1978
+    assert _hive_string_hash("é") == -1978
+    assert _hive_timestamp_value(1_000_000) == 1 << 30
+
+    def q(s):
+        df = s.create_dataframe(
+            {"s": np.array(["café", "é"], dtype=object),
+             "t": np.array([1_000_000, 1_500_000], dtype=np.int64)},
+            dtypes={"s": T.STRING, "t": T.TIMESTAMP})
+        return df.select(HiveHash(col("s")).alias("hs"),
+                         HiveHash(col("t")).alias("ht"))
+
+    got = q(session).collect()
+    assert got == q(cpu_session).collect()
+    assert got[1][0] == -1978
+    assert got[0][1] == (1 << 30) ^ 0  # (1<<30) fits in low word
+
+
+def test_unix_timestamp_translated_format(session, cpu_session):
+    """yyyyMMdd translates generically now (review fix)."""
+    from spark_rapids_tpu.ops.datetime import UnixTimestamp
+
+    def q(s):
+        df = s.create_dataframe({"t": np.array(["20200101"], dtype=object)})
+        return df.select(UnixTimestamp(col("t"), lit("yyyyMMdd")).alias("u"))
+
+    got = q(session).collect()
+    assert got == q(cpu_session).collect()
+    assert got[0][0] == 1577836800
+
+
+def test_unix_timestamp_unsupported_format_raises(cpu_session):
+    from spark_rapids_tpu.errors import ColumnarProcessingError
+    from spark_rapids_tpu.ops.datetime import UnixTimestamp
+    df = cpu_session.create_dataframe(
+        {"t": np.array(["x"], dtype=object)})
+    with pytest.raises(ColumnarProcessingError, match="not supported"):
+        df.select(UnixTimestamp(col("t"),
+                                lit("yyyy-MM-dd'T'HH:mm:ssZ")).alias("u")
+                  ).collect()
+
+
+def test_get_json_object_per_row_path(cpu_session):
+    """Non-literal path evaluates per row on the CPU path (review fix)."""
+    from spark_rapids_tpu.ops.json_fns import GetJsonObject
+    df = cpu_session.create_dataframe({
+        "j": np.array(['{"a":1,"b":2}', '{"a":3,"b":4}'], dtype=object),
+        "p": np.array(["$.a", "$.b"], dtype=object)})
+    rows = df.select(GetJsonObject(col("j"), col("p")).alias("v")).collect()
+    assert rows == [("1",), ("4",)]
