@@ -223,6 +223,14 @@ CPU_ORACLE_STRICT = bool_conf(
     "Test-only: compare device results bit-for-bit against the CPU path.",
     internal=True)
 
+ADAPTIVE_ENABLED = bool_conf(
+    "spark.rapids.sql.adaptive.enabled", True,
+    "AQE runtime join-strategy conversion: a join build side whose STATIC "
+    "size estimate could not prove it broadcastable is measured at "
+    "runtime and converted to a cached broadcast when it lands under "
+    "spark.rapids.sql.broadcastSizeBytes (AQE DynamicJoinSelection "
+    "analog).")
+
 AQE_COALESCE_PARTITIONS = bool_conf(
     "spark.rapids.sql.adaptive.coalescePartitions.enabled", False,
     "Adaptive shuffle-partition coalescing: adjacent undersized reduce "

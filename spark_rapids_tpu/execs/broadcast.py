@@ -40,6 +40,34 @@ from spark_rapids_tpu.ops.expr import (
 PAIR_BUDGET = 1 << 20
 
 
+def _materialize_single(child: TpuExec, schema):
+    """Materialize a child into ONE device table with spill protection:
+    every buffered batch registers as a SpillableBatch so the OOM-retry
+    catalog can demote it during the concat (the coalesce path's
+    invariant — TpuJoinExec requires a spillable-protected build).
+    Returns (table, n_input_batches)."""
+    from spark_rapids_tpu.columnar.table import concat_device
+    from spark_rapids_tpu.plan.nodes import _empty_table
+    from spark_rapids_tpu.runtime.retry import retry_block
+    from spark_rapids_tpu.runtime.spill import BufferCatalog, SpillableBatch
+
+    catalog = BufferCatalog.get()
+    spills = []
+    try:
+        for b in child.execute():
+            spills.append(SpillableBatch(b, catalog))
+        if not spills:
+            return DeviceTable.from_host(_empty_table(schema)), 0
+        if len(spills) == 1:
+            return spills[0].get(), 1
+        table = retry_block(
+            lambda: concat_device([sb.get() for sb in spills]))
+        return table, len(spills)
+    finally:
+        for sb in spills:
+            sb.release()
+
+
 class TpuBroadcastExchangeExec(TpuExec):
     """Materializes the child ONCE into a single spillable table, reused
     across re-executions (multiple consumers / replays). The multi-chip
@@ -52,25 +80,19 @@ class TpuBroadcastExchangeExec(TpuExec):
         self.children = (child,)
         self._cached = None
 
-    def output_schema(self):
-        return self.children[0].output_schema()
-
     def execute(self):
-        from spark_rapids_tpu.columnar.table import concat_device
-        from spark_rapids_tpu.runtime.retry import retry_block
         from spark_rapids_tpu.runtime.spill import BufferCatalog, SpillableBatch
 
         if self._cached is None:
-            batches = list(self.children[0].execute())
-            if not batches:
-                from spark_rapids_tpu.plan.nodes import _empty_table
-                batches = [DeviceTable.from_host(
-                    _empty_table(self.output_schema()))]
-            table = retry_block(lambda: concat_device(batches))
+            table, n = _materialize_single(self.children[0],
+                                           self.output_schema())
             self._cached = SpillableBatch(table, BufferCatalog.get())
-            self.add_metric("broadcastBatches", len(batches))
+            self.add_metric("broadcastBatches", n)
             self.add_metric("broadcastBytes", table.device_nbytes())
         yield self._cached.get()
+
+    def output_schema(self):
+        return self.children[0].output_schema()
 
     def describe(self):
         return "TpuBroadcastExchange"
@@ -346,3 +368,52 @@ class _PairPrepCtx(PrepCtx):
 class _PairTableView:
     def __init__(self, lt: DeviceTable, rt: DeviceTable):
         self.columns = list(lt.columns) + list(rt.columns)
+
+
+class TpuAdaptiveBuildExec(TpuExec):
+    """AQE runtime join-strategy conversion (reference: AQE's
+    DynamicJoinSelection + GpuOverrides AQE integration,
+    GpuOverrides.scala:4577-4638): when the STATIC size estimate could
+    not prove the build side small, the decision is deferred to RUNTIME —
+    the build materializes, its ACTUAL bytes are measured, and a build
+    under the broadcast threshold is cached as a broadcast table (reused
+    across replays/consumers exactly like TpuBroadcastExchangeExec);
+    otherwise it flows on as the ordinary single-batch build feeding the
+    sub-partitioned join path."""
+
+    def __init__(self, child: TpuExec, threshold_bytes: int):
+        super().__init__()
+        self.children = (child,)
+        self.threshold_bytes = threshold_bytes
+        self._cached = None
+        self.converted: Optional[bool] = None
+
+    def output_schema(self):
+        return self.children[0].output_schema()
+
+    def execute(self):
+        from spark_rapids_tpu.runtime.spill import BufferCatalog, SpillableBatch
+
+        if self._cached is not None:
+            yield self._cached.get()
+            return
+        table, _n = _materialize_single(self.children[0],
+                                        self.output_schema())
+        measured = table.device_nbytes()
+        if self.converted is None:  # record the decision metrics ONCE
+            self.add_metric("aqeMeasuredBuildBytes", measured)
+            if measured <= self.threshold_bytes:
+                self.add_metric("aqeBroadcastConverted", 1)
+        if measured <= self.threshold_bytes:
+            # runtime conversion to broadcast: cache for reuse
+            self.converted = True
+            self._cached = SpillableBatch(table, BufferCatalog.get())
+            yield self._cached.get()
+        else:
+            self.converted = False
+            yield table
+
+    def describe(self):
+        state = {None: "undecided", True: "->broadcast",
+                 False: "->shuffle"}[self.converted]
+        return f"TpuAdaptiveBuild[{state}]"

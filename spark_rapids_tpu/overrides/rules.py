@@ -455,11 +455,19 @@ def _convert_join(node: P.Join, children, conf):
     # sub-partition escalation; the PROBE side streams target-sized batches
     build_node = node.children[0] if swapped else node.children[1]
     est = build_node.estimate_bytes()
-    broadcast = est is not None and est <= conf.get_entry(BROADCAST_SIZE_BYTES)
+    threshold = conf.get_entry(BROADCAST_SIZE_BYTES)
+    broadcast = est is not None and est <= threshold
 
     def wrap_build(child):
-        return (TpuBroadcastExchangeExec(child) if broadcast
-                else TpuCoalesceExec(child, require_single=True))
+        if broadcast:
+            return TpuBroadcastExchangeExec(child)
+        from spark_rapids_tpu.conf import ADAPTIVE_ENABLED
+        if conf.get_entry(ADAPTIVE_ENABLED):
+            # AQE: the static estimate couldn't prove broadcast; defer the
+            # strategy to runtime-measured build size
+            from spark_rapids_tpu.execs.broadcast import TpuAdaptiveBuildExec
+            return TpuAdaptiveBuildExec(child, threshold)
+        return TpuCoalesceExec(child, require_single=True)
 
     if swapped:
         left = wrap_build(children[0])

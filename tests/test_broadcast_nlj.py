@@ -112,3 +112,91 @@ def test_broadcast_disabled_by_threshold(session):
 
     found = _collect_execs(executable, TpuBroadcastExchangeExec)
     assert not found
+
+
+# -- AQE runtime broadcast conversion ---------------------------------------
+
+def _find_adaptive(e):
+    """Locate the TpuAdaptiveBuildExec in a converted plan tree."""
+    from spark_rapids_tpu.execs.broadcast import TpuAdaptiveBuildExec
+    if isinstance(e, TpuAdaptiveBuildExec):
+        return e
+    for c in getattr(e, "children", ()) or ():
+        r = _find_adaptive(c)
+        if r is not None:
+            return r
+    t = getattr(e, "tpu_exec", None)
+    return _find_adaptive(t) if t is not None else None
+
+
+def test_aqe_runtime_broadcast_conversion(session, cpu_session):
+    """A build side with NO static estimate converts to broadcast at
+    runtime when measured under the threshold (DynamicJoinSelection
+    analog); the decision is visible in the exec tree + metrics."""
+    import numpy as np
+    from spark_rapids_tpu import functions as F
+    from spark_rapids_tpu.execs.broadcast import TpuAdaptiveBuildExec
+    from spark_rapids_tpu.overrides.rules import apply_overrides
+    from spark_rapids_tpu.plan import nodes as P
+    from spark_rapids_tpu.plan import from_host_table
+    from spark_rapids_tpu.columnar import HostTable
+    from spark_rapids_tpu.ops.expr import col
+
+    rng = np.random.default_rng(0)
+    big = HostTable.from_pydict(
+        {"k": rng.integers(0, 50, 5000).astype(np.int64),
+         "v": rng.standard_normal(5000)})
+    small = HostTable.from_pydict(
+        {"k": np.arange(50, dtype=np.int64),
+         "w": np.arange(50, dtype=np.int64) * 10})
+
+    # hide the static estimate so the planner cannot prove broadcast
+    scan = P.LocalScan([small])
+    scan.estimate_bytes = lambda: None
+
+    join = P.Join(P.LocalScan([big]), scan, "inner",
+                  [col("k")], [col("k")])
+    executable, _meta = apply_overrides(join, session.conf)
+
+    ab = _find_adaptive(executable)
+    assert ab is not None, "AQE adaptive build not planned"
+    assert ab.converted is None  # undecided before execution
+
+    rows = HostTable.concat(list(executable.execute_cpu()))
+    assert rows.num_rows == 5000
+    assert ab.converted is True  # runtime-measured small -> broadcast
+    assert ab.metrics.get("aqeBroadcastConverted") == 1
+
+    # oracle: result matches CPU join
+    want = (from_host_table(big, cpu_session)
+            .join(from_host_table(small, cpu_session), on=["k"])
+            .count())
+    assert rows.num_rows == want
+
+
+def test_aqe_large_build_stays_shuffle(session):
+    import numpy as np
+    from spark_rapids_tpu.execs.broadcast import TpuAdaptiveBuildExec
+    from spark_rapids_tpu.overrides.rules import apply_overrides
+    from spark_rapids_tpu.plan import nodes as P
+    from spark_rapids_tpu.columnar import HostTable
+    from spark_rapids_tpu.ops.expr import col
+    from spark_rapids_tpu.session import TpuSession
+
+    s = TpuSession({"spark.rapids.sql.broadcastSizeBytes": "64"})
+    rng = np.random.default_rng(1)
+    left = HostTable.from_pydict(
+        {"k": rng.integers(0, 20, 500).astype(np.int64)})
+    right = HostTable.from_pydict(
+        {"k": np.arange(20, dtype=np.int64),
+         "w": np.arange(20, dtype=np.int64)})
+    scan = P.LocalScan([right])
+    scan.estimate_bytes = lambda: None
+    join = P.Join(P.LocalScan([left]), scan, "inner", [col("k")], [col("k")])
+    executable, _ = apply_overrides(join, s.conf)
+
+    ab = _find_adaptive(executable)
+    assert ab is not None
+    out = list(executable.execute_cpu())
+    assert sum(t.num_rows for t in out) == 500
+    assert ab.converted is False  # 20-row build > 64-byte threshold
