@@ -83,6 +83,8 @@ def _build_expr_sigs():
     reg(cast.Cast)
     from spark_rapids_tpu.ops import json_fns
     reg(json_fns.GetJsonObject)
+    from spark_rapids_tpu import udf as udf_mod
+    reg(udf_mod.ColumnarDeviceUDF)
     from spark_rapids_tpu.ops import decimal as decimal_ops
     for name in ("DecimalAdd", "DecimalSubtract", "DecimalMultiply",
                  "DecimalDivide", "UnscaledValue", "MakeDecimal",

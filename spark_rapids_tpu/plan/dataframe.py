@@ -193,6 +193,11 @@ class DataFrame:
     def to_pandas(self):
         return self.collect_table().to_pandas()
 
+    def to_device_arrays(self):
+        """Zero-copy device export (ColumnarRdd analog): {name: jax
+        arrays} + row count, no host round trip. See to_device_arrays()."""
+        return to_device_arrays(self)
+
     def to_pydict(self):
         return self.collect_table().to_pydict()
 
@@ -319,3 +324,44 @@ def range_df(start: int, end: Optional[int] = None, step: int = 1, session=None)
     if end is None:
         start, end = 0, start
     return DataFrame(P.RangeNode(start, end, step), session)
+
+
+def to_device_arrays(df: "DataFrame"):
+    """ColumnarRdd analog (reference: sql-plugin-api ColumnarRdd.scala:54
+    — zero-copy GPU-table export for ML/XGBoost): execute the plan on
+    device and hand back the raw jax arrays WITHOUT a host round trip:
+    {name: (data, validity)} per column, plus the live row count. String
+    columns export as (codes, validity, dictionary)."""
+    from spark_rapids_tpu.overrides.rules import apply_overrides
+    from spark_rapids_tpu.execs.base import DeviceToHost
+    if df.session is None:
+        # session-less DataFrame: CPU plan, one upload at the end
+        from spark_rapids_tpu.columnar import DeviceTable, HostTable
+        host = HostTable.concat(list(df.plan.execute_cpu()))
+        t = DeviceTable.from_host(host)
+        out = {}
+        for name, c in zip(t.names, t.columns):
+            out[name] = ((c.data, c.validity, c.dictionary)
+                         if c.dictionary is not None
+                         else (c.data, c.validity))
+        return out, t.num_rows
+    executable, _ = apply_overrides(df.plan, df.session.conf)
+    if isinstance(executable, DeviceToHost):
+        exec_dev = executable.tpu_exec
+        batches = list(exec_dev.execute())
+    else:
+        # fully-fallen-back plan: upload the host result once
+        from spark_rapids_tpu.columnar import DeviceTable, HostTable
+        host = HostTable.concat(list(executable.execute_cpu()))
+        batches = [DeviceTable.from_host(host)]
+    if len(batches) != 1:
+        from spark_rapids_tpu.columnar.table import concat_device
+        batches = [concat_device(batches)]
+    t = batches[0]
+    out = {}
+    for name, c in zip(t.names, t.columns):
+        if c.dictionary is not None:
+            out[name] = (c.data, c.validity, c.dictionary)
+        else:
+            out[name] = (c.data, c.validity)
+    return out, t.num_rows

@@ -292,3 +292,95 @@ class udf:
             f"compile to engine expressions ({self._reason}); falling back "
             "to row-wise CPU execution", RuntimeWarning, stacklevel=2)
         return PythonUDF(self.fn, self.return_type, args)
+
+
+# ---------------------------------------------------------------------------
+# Columnar device UDF (RapidsUDF analog)
+# ---------------------------------------------------------------------------
+
+class ColumnarDeviceUDF(Expression):
+    """User-implemented COLUMNAR UDF running fused on device (reference:
+    RapidsUDF.java:70 ``evaluateColumnar(ColumnVector*) -> ColumnVector``,
+    checked by GpuUserDefinedFunction/GpuScalaUDF).
+
+    The user function receives one jax array per argument (plus a boolean
+    validity array per argument) and returns (data, validity) jax arrays
+    of the same length — traced INTO the surrounding kernel, so it fuses
+    with the rest of the projection exactly like a built-in. Example::
+
+        def clamp(args, valids):
+            (x,), (xv,) = args, valids
+            return jnp.clip(x, 0.0, 1.0), xv
+
+        df.select(columnar_udf(clamp, T.DOUBLE, col("v")).alias("c"))
+    """
+
+    def __init__(self, fn: Callable, return_type: T.DataType,
+                 children: Sequence[Expression], name: str = ""):
+        self.fn = fn
+        self._return_type = return_type
+        self.children = tuple(children)
+        self._name = name or getattr(fn, "__name__", "columnar_udf")
+
+    @property
+    def data_type(self):
+        return self._return_type
+
+    @property
+    def name(self):
+        return self._name
+
+    def with_children(self, children):
+        return ColumnarDeviceUDF(self.fn, self._return_type, children,
+                                 self._name)
+
+    def resolve(self, bound_children):
+        for c in bound_children:
+            if isinstance(c.data_type, T.StringType):
+                raise UdfCompileError(
+                    "columnar device UDFs cannot take string arguments "
+                    "(strings are dictionary codes on device; use the "
+                    "row-wise udf() fallback or built-in string functions)")
+        return self.with_children(bound_children)
+
+    def key(self):
+        # the USER FUNCTION's CODE identifies the traced kernel — keying
+        # by code object (not id) lets logically identical lambdas
+        # recreated per query share one compiled kernel instead of
+        # growing the compile caches unboundedly. Closure VALUES are not
+        # in the key: a UDF whose behavior depends on captured mutable
+        # state would alias; capture constants only.
+        code = getattr(self.fn, "__code__", None)
+        fid = (code.co_filename, code.co_firstlineno,
+               hash(code.co_code)) if code is not None else id(self.fn)
+        return ("columnar_udf", fid, str(self._return_type),
+                tuple(c.key() for c in self.children))
+
+    def eval_cpu(self, table):
+        import jax.numpy as jnp
+        cols = [c.eval_cpu(table) for c in self.children]
+        data, validity = self.fn(
+            tuple(jnp.asarray(c.data) for c in cols),
+            tuple(jnp.asarray(c.validity) for c in cols))
+        return HostColumn(self._return_type,
+                          np.asarray(data).astype(
+                              self._return_type.np_dtype),
+                          np.asarray(validity).astype(np.bool_))
+
+    def eval_dev(self, ctx, child_vals, prep):
+        from spark_rapids_tpu.ops.expr import DevVal
+        data, validity = self.fn(
+            tuple(v.data for v in child_vals),
+            tuple(v.validity for v in child_vals))
+        return DevVal(data, validity)
+
+
+def columnar_udf(fn: Callable, return_type: T.DataType, *args):
+    """Factory for ColumnarDeviceUDF (fixed-width return types only —
+    string outputs would need an unbounded dictionary)."""
+    from spark_rapids_tpu.ops.expr import col as _col
+    if isinstance(return_type, T.StringType):
+        raise UdfCompileError(
+            "columnar device UDFs must return fixed-width types")
+    exprs = [_col(a) if isinstance(a, str) else a for a in args]
+    return ColumnarDeviceUDF(fn, return_type, exprs)

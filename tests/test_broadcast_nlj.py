@@ -119,14 +119,8 @@ def test_broadcast_disabled_by_threshold(session):
 def _find_adaptive(e):
     """Locate the TpuAdaptiveBuildExec in a converted plan tree."""
     from spark_rapids_tpu.execs.broadcast import TpuAdaptiveBuildExec
-    if isinstance(e, TpuAdaptiveBuildExec):
-        return e
-    for c in getattr(e, "children", ()) or ():
-        r = _find_adaptive(c)
-        if r is not None:
-            return r
-    t = getattr(e, "tpu_exec", None)
-    return _find_adaptive(t) if t is not None else None
+    found = _collect_execs(e, TpuAdaptiveBuildExec)
+    return found[0] if found else None
 
 
 def test_aqe_runtime_broadcast_conversion(session, cpu_session):

@@ -3,6 +3,8 @@ udf-compiler/CatalystExpressionBuilder + its opcode suite)."""
 
 import warnings
 
+import numpy as np
+
 import pytest
 
 from spark_rapids_tpu import functions as F
@@ -129,3 +131,81 @@ def test_closure_falls_back(session):
     for x, u in out:
         if x is not None:
             assert u == x + 7
+
+
+# -- columnar device UDF (RapidsUDF analog) ----------------------------------
+
+def test_columnar_device_udf(session, cpu_session):
+    import jax.numpy as jnp
+    from spark_rapids_tpu.udf import columnar_udf
+    from tests.asserts import assert_runs_on_tpu
+
+    def clamped_product(args, valids):
+        (x, y), (xv, yv) = args, valids
+        return jnp.clip(x * y, -10.0, 10.0), xv & yv
+
+    rng = np.random.default_rng(0)
+    data = {"a": rng.standard_normal(500) * 5,
+            "b": rng.standard_normal(500) * 5}
+
+    def q(s):
+        df = s.create_dataframe(dict(data))
+        return df.select(
+            columnar_udf(clamped_product, T.DOUBLE, "a", "b").alias("c"))
+
+    got = q(session).collect()
+    want = q(cpu_session).collect()
+    for g, w in zip(got, want):
+        assert abs(g[0] - w[0]) <= 1e-12 * max(1.0, abs(w[0]))
+    assert max(abs(g[0]) for g in got) <= 10.0
+    assert_runs_on_tpu(q, session)  # fused on device like a built-in
+
+
+def test_columnar_udf_string_return_rejected(session):
+    from spark_rapids_tpu.udf import UdfCompileError, columnar_udf
+    with pytest.raises(UdfCompileError, match="fixed-width"):
+        columnar_udf(lambda a, v: (a[0], v[0]), T.STRING, "a")
+
+
+def test_to_device_arrays_export(session):
+    """ColumnarRdd analog: results stay on device (jax arrays)."""
+    import jax
+    import numpy as np
+    from spark_rapids_tpu.ops.expr import col
+
+    df = (session.create_dataframe(
+        {"x": np.arange(1000, dtype=np.int64),
+         "s": np.array([f"v{i%5}" for i in range(1000)], dtype=object)})
+        .filter(col("x") >= 500))
+    arrays, n = df.to_device_arrays()
+    assert n == 500
+    assert isinstance(arrays["x"][0], jax.Array)       # no host round trip
+    data, validity = arrays["x"]
+    assert int(np.asarray(data[:n]).min()) == 500
+    codes, v2, dictionary = arrays["s"]                # strings: dict-coded
+    assert isinstance(codes, jax.Array) and len(dictionary) == 5
+
+
+def test_columnar_udf_string_input_rejected(session):
+    from spark_rapids_tpu.udf import UdfCompileError, columnar_udf
+    df = session.create_dataframe(
+        {"s": np.array(["a", "b"], dtype=object)})
+    with pytest.raises(UdfCompileError, match="string arguments"):
+        df.select(columnar_udf(lambda a, v: (a[0], v[0]),
+                               T.DOUBLE, "s").alias("x"))
+
+
+def test_columnar_udf_key_stable_across_lambda_recreation():
+    """Recreated lambdas with identical code share one compile key."""
+    from spark_rapids_tpu.udf import columnar_udf
+
+    def make():
+        return columnar_udf(lambda a, v: (a[0] + 1.0, v[0]), T.DOUBLE, "x")
+
+    assert make().key() == make().key()
+
+
+def test_to_device_arrays_sessionless():
+    from spark_rapids_tpu.plan import range_df
+    arrays, n = range_df(10).to_device_arrays()
+    assert n == 10
