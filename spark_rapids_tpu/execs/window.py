@@ -121,10 +121,12 @@ def _segmented_scan(op, v, new_seg):
 
 
 class TpuWindowExec(TpuExec):
-    def __init__(self, child: TpuExec, window_cols: Sequence[Tuple[str, WindowExpression]]):
+    def __init__(self, child: TpuExec, window_cols: Sequence[Tuple[str, WindowExpression]],
+                 per_batch: bool = False):
         super().__init__()
         self.children = (child,)
         self.window_cols = list(window_cols)
+        self.per_batch = per_batch
 
     def output_schema(self):
         return (self.children[0].output_schema()
@@ -135,6 +137,12 @@ class TpuWindowExec(TpuExec):
 
     def execute(self):
         from spark_rapids_tpu.runtime.retry import retry_block
+        if self.per_batch:
+            # each incoming batch holds COMPLETE partition groups
+            # (TpuKeyedBatchExec contract) and windows independently
+            for batch in self.children[0].execute():
+                yield retry_block(lambda b=batch: self._window(b))
+            return
         batches = list(self.children[0].execute())
         if len(batches) != 1:
             raise ColumnarProcessingError("TpuWindowExec requires a single batch")
@@ -542,3 +550,63 @@ class TpuWindowExec(TpuExec):
             return jnp.asarray(True if is_min else False, dtype=dtype)
         info = jnp.iinfo(dtype)
         return jnp.asarray(info.max if is_min else info.min, dtype=dtype)
+
+
+class TpuKeyedBatchExec(TpuExec):
+    """Partition-complete batching for windows (GpuKeyBatchingIterator /
+    batched-window analog — reference window/ iterators process bounded
+    batches instead of the whole input): a single-batch child passes
+    through untouched; a multi-batch child hash-exchanges on the window
+    PARTITION keys so every partition group lands whole inside exactly
+    one output batch — the window then processes each batch independently
+    and peak memory is bounded by the largest reduce partition, not the
+    whole input."""
+
+    def __init__(self, child: TpuExec, keys, conf, num_partitions: int = 8):
+        super().__init__()
+        self.children = (child,)
+        self.keys = list(keys)
+        self.conf = conf
+        self.num_partitions = num_partitions
+
+    def output_schema(self):
+        return self.children[0].output_schema()
+
+    def describe(self):
+        return f"TpuKeyedBatch[n={self.num_partitions}]"
+
+    def execute(self):
+        it = self.children[0].execute()
+        first = next(it, None)
+        if first is None:
+            return
+        second = next(it, None)
+        if second is None:
+            yield first  # common fast path: already one batch, no shuffle
+            return
+        from spark_rapids_tpu.execs.exchange import TpuShuffleExchangeExec
+
+        prefix = [first, second]
+
+        class _Replay(TpuExec):
+            def __init__(self, schema):
+                super().__init__()
+                self._schema = schema
+
+            def output_schema(self):
+                return self._schema
+
+            def execute(self):
+                yield from prefix
+                yield from it
+
+        # partition-ALIGNED batches are the contract: no AQE partition
+        # coalescing, and one batch per reduce partition (huge target)
+        conf = self.conf.set(
+            "spark.rapids.sql.adaptive.coalescePartitions.enabled", "false")
+        ex = TpuShuffleExchangeExec(
+            _Replay(self.output_schema()), "hash", self.num_partitions,
+            self.keys, conf, target_batch_bytes=1 << 62)
+        self.add_metric("keyBatchedPartitions", self.num_partitions)
+        yield from ex.execute()
+        self.metrics.update(ex.metrics)

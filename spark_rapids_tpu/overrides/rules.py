@@ -526,7 +526,21 @@ def _tag_window(meta, conf):
 
 
 def _convert_window(node: P.WindowNode, children, conf):
-    from spark_rapids_tpu.execs.window import TpuWindowExec
+    from spark_rapids_tpu.execs.window import TpuKeyedBatchExec, TpuWindowExec
+
+    # batched windows (GpuKeyBatchingIterator analog): when every window
+    # spec shares the SAME partition keys, batches can split at partition
+    # boundaries and window independently — out-of-core instead of
+    # require-single. Global (unpartitioned) or mixed-key windows keep the
+    # single-batch path.
+    specs = [w.spec for _, w in node.window_cols]
+    keys0 = [p.key() for p in specs[0].partition_exprs] if specs else []
+    same_keys = keys0 and all(
+        [p.key() for p in s.partition_exprs] == keys0 for s in specs)
+    if same_keys:
+        batched = TpuKeyedBatchExec(children[0],
+                                    specs[0].partition_exprs, conf)
+        return TpuWindowExec(batched, node.window_cols, per_batch=True)
     coalesced = TpuCoalesceExec(children[0], require_single=True)
     return TpuWindowExec(coalesced, node.window_cols)
 
