@@ -111,16 +111,20 @@ def _u32_units(kind: str) -> int:
     return {"f64": 2, "i64": 2, "i32": 1, "f32": 1}.get(kind, 0)
 
 
-def _get_pack(kinds: tuple, k: int, cap: int):
+def _get_pack(kinds: tuple, k: int, cap: int, n_extra: int = 0):
     """One jitted program bitcasting every column (data + validity) into a
     single u32 buffer: f64 as an exact hi/lo f32 split on TPU (f64 storage
     IS an f32 pair there; CPU bitcasts natively), i64 as hi/lo words, small
-    ints and validities byte-packed 4-per-u32 at the tail."""
+    ints and validities byte-packed 4-per-u32 at the tail.
+
+    ``n_extra`` i32 scalars (the live row count + pending speculation
+    flags — runtime/speculation.py) prepend as a header so the whole
+    result, its size, and its validity arrive in ONE device fetch."""
     cpu = jax.default_backend() == "cpu"
-    key = (kinds, k, cap, cpu)
+    key = (kinds, k, cap, cpu, n_extra)
     fn = _PACK_CACHE.get(key)
     if fn is None:
-        def pack(cols):
+        def pack(cols, extras):
             u32s, u8s = [], []
             for (data, _), kind in zip(cols, kinds):
                 d = data[:k]
@@ -157,6 +161,10 @@ def _get_pack(kinds: tuple, k: int, cap: int):
             tail = jax.lax.bitcast_convert_type(
                 u8cat.reshape(-1, 4), jnp.uint32)
             parts = [a for a in u32s] + [tail]
+            if n_extra:
+                head = jax.lax.bitcast_convert_type(
+                    extras.astype(jnp.int32), jnp.uint32)
+                parts = [head] + parts
             return jnp.concatenate(parts) if len(parts) > 1 else parts[0]
 
         fn = jax.jit(pack)
@@ -164,8 +172,10 @@ def _get_pack(kinds: tuple, k: int, cap: int):
     return fn
 
 
-def _unpack_host(buf: np.ndarray, kinds: tuple, k: int):
+def _unpack_host(buf: np.ndarray, kinds: tuple, k: int, n_extra: int = 0):
     cpu = jax.default_backend() == "cpu"
+    extras = buf[:n_extra].view(np.int32)
+    buf = buf[n_extra:]
     nu32 = sum(_u32_units(kd) for kd in kinds) * k
     u32part = buf[:nu32]
     bytes_part = buf.view(np.uint8)[4 * nu32:]
@@ -209,7 +219,7 @@ def _unpack_host(buf: np.ndarray, kinds: tuple, k: int):
     for _ in kinds:
         valids.append(bytes_part[o8:o8 + k] != 0)
         o8 += k
-    return datas, valids
+    return extras, datas, valids
 
 
 #: jitted concat kernels keyed by (schema kinds, input caps, out cap)
@@ -260,6 +270,7 @@ def concat_device(tables: Sequence["DeviceTable"]) -> "DeviceTable":
     fn = _CONCAT_CACHE.get(key)
     if fn is None:
         def concat(cols_per_table, remap_per_table, nrows_list):
+            from spark_rapids_tpu.ops.scatter32 import scatter_pair
             outs = []
             for ci in range(ncols):
                 od = None
@@ -275,8 +286,10 @@ def concat_device(tables: Sequence["DeviceTable"]) -> "DeviceTable":
                     n = nrows_list[ti]
                     idx = jnp.arange(data.shape[0], dtype=jnp.int32)
                     tgt = jnp.where(idx < n, idx + offset, out_cap)
-                    od = od.at[tgt].set(data, mode="drop")
-                    ov = ov.at[tgt].set(valid, mode="drop")
+                    pd, pv = scatter_pair(out_cap, tgt, data, valid)
+                    od = od + pd if jnp.issubdtype(od.dtype, jnp.number) \
+                        else od | pd
+                    ov = ov | pv
                     offset = offset + n
                 outs.append((od, ov))
             total = jnp.asarray(0, dtype=jnp.int32)
@@ -469,6 +482,11 @@ class DeviceTable:
         ]
         return DeviceTable(host.names, cols, host.num_rows, cap)
 
+    #: capacity up to which an unknown row count is fetched by embedding it
+    #: in the packed buffer (fetching the padded bucket) instead of paying a
+    #: separate ~0.1s row-count sync first
+    EMBED_NROWS_CAP = 1 << 16
+
     def to_host(self) -> HostTable:
         """Download as one packed transfer.
 
@@ -477,17 +495,38 @@ class DeviceTable:
         every column into one u32 buffer (f64/i64 as exact hi/lo splits —
         TPU f64 storage is an f32 pair; small ints byte-packed 4-per-u32)
         sliced to the live bucket, fetched with ONE device_get, and the host
-        unpacks by numpy views."""
-        n = self.num_rows
+        unpacks by numpy views.
+
+        The packed buffer carries an i32 header: the live row count plus any
+        pending speculation flags (runtime/speculation.py), so a warm query
+        whose output bucket is small performs exactly ONE round trip —
+        no separate row-count sync, no separate flag validation fetch."""
         if not self.columns:
             return HostTable(self.names, [])
         if any(c.is_array for c in self.columns):
             return self.to_host_per_column()
-        k = min(bucket_for(max(n, 1)), self.capacity)
+        from spark_rapids_tpu.runtime import speculation as spec
+        ctx = spec.current()
+        if self._nrows_host is None and self.capacity <= self.EMBED_NROWS_CAP:
+            k = self.capacity  # fetch the padded bucket; n rides the header
+        else:
+            k = min(bucket_for(max(self.num_rows, 1)), self.capacity)
+        pend = ctx.take_pending() if ctx is not None else []
+        n_extra = 1 + len(pend)
         kinds = tuple(_pack_kind(c) for c in self.columns)
-        fn = _get_pack(kinds, k, self.capacity)
-        buf = np.asarray(fn(tuple((c.data, c.validity) for c in self.columns)))
-        datas, valids = _unpack_host(buf, kinds, k)
+        fn = _get_pack(kinds, k, self.capacity, n_extra)
+        extras_dev = jnp.concatenate(
+            [jnp.reshape(self.nrows_dev.astype(jnp.int32), (1,))]
+            + [jnp.reshape(f.astype(jnp.int32), (1,)) for _, f in pend])
+        buf = np.asarray(fn(
+            tuple((c.data, c.validity) for c in self.columns), extras_dev))
+        extras, datas, valids = _unpack_host(buf, kinds, k, n_extra)
+        if pend:
+            spec.check_flag_values([s for s, _ in pend], extras[1:])
+        n = int(extras[0])
+        if self._nrows_host is None:
+            self._nrows_host = n
+        n = min(n, k)
         cols = []
         for c, data, validity in zip(self.columns, datas, valids):
             cols.append(c.decode_host(

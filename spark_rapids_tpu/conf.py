@@ -128,6 +128,19 @@ RETRY_OOM_MAX_RETRIES = int_conf(
     "spark.rapids.memory.gpu.oomMaxRetries", 2,
     "Synchronous-spill retries before escalating to split-and-retry.")
 
+SPECULATIVE_SIZING = bool_conf(
+    "spark.rapids.tpu.speculativeSizing.enabled", True,
+    "Size data-dependent outputs (join gather maps, direct-address join "
+    "tables) speculatively with device-resident validation flags instead "
+    "of a ~0.1s host sync per operator; a failed speculation replays the "
+    "query on the exact path (runtime/speculation.py).", commonly_used=True)
+
+JOIN_DIRECT_TABLE_MULT = int_conf(
+    "spark.rapids.tpu.join.directTableMultiplier", 4,
+    "Direct-address join fast path: the key-range table is this multiple "
+    "of the build side's capacity; build key ranges wider than that fall "
+    "back to the sort-based join (speculatively validated).")
+
 SHUFFLE_MANAGER_MODE = str_conf(
     "spark.rapids.shuffle.mode", "MULTITHREADED",
     "MULTITHREADED (threaded host serialization over local shuffle files), "

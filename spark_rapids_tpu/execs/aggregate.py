@@ -372,6 +372,12 @@ class TpuHashAggregateExec(TpuExec):
             # outputs are already domain-sized; the group count stays a
             # device scalar (no host sync on the hot path)
             return out
+        from spark_rapids_tpu.runtime import speculation as spec
+        if spec.current() is not None:
+            # async mode: shrink()'s row-count sync costs a ~0.1s round trip
+            # — more than any downstream op pays for the padded capacity
+            # (e.g. TakeOrdered's device sort at 1M capacity is ~0.05s)
+            return out
         # sorted path emits capacity-sized outputs; re-bucket so downstream
         # sorts/transfers don't run at input capacity
         return out.shrink()
@@ -442,8 +448,8 @@ class TpuHashAggregateExec(TpuExec):
             out_live = jnp.arange(gpad, dtype=jnp.int32) < ngroups
 
             def compact(data, validity):
-                cd = jnp.zeros_like(data).at[tgt].set(data, mode="drop")
-                cv = jnp.zeros_like(validity).at[tgt].set(validity, mode="drop")
+                from spark_rapids_tpu.ops.scatter32 import scatter_pair
+                cd, cv = scatter_pair(gpad, tgt, data, validity)
                 return cd, cv & out_live
 
             outs = []
@@ -608,10 +614,10 @@ class TpuHashAggregateExec(TpuExec):
 
             outs = []
             # key columns: scatter first-occurrence values to gid slots
+            from spark_rapids_tpu.ops.scatter32 import scatter_pair
             for kv in s_keys:
                 tgt = jnp.where(s_live, gid, capacity)
-                kd = jnp.zeros_like(kv.data).at[tgt].set(kv.data, mode="drop")
-                kvv = jnp.zeros_like(kv.validity).at[tgt].set(kv.validity, mode="drop")
+                kd, kvv = scatter_pair(capacity, tgt, kv.data, kv.validity)
                 outs.append((kd, kvv & group_live))
 
             for (name, fnagg), vv in zip(agg_specs, val_vals):
@@ -743,10 +749,10 @@ class TpuHashAggregateExec(TpuExec):
                     same = same & (o == jnp.roll(o, 1))
                 first = jnp.arange(capacity) == 0
                 keep = sflag & (first | ~same)
+            from spark_rapids_tpu.ops.scatter32 import scatter_set
             cpos = jnp.cumsum(keep.astype(jnp.int32)) - 1
             etgt = jnp.where(keep, cpos, capacity)
-            elements = jnp.zeros(capacity, dtype=sd.dtype).at[etgt].set(
-                sdv, mode="drop")
+            elements = scatter_set(capacity, etgt, sdv, mode="drop")
             evalid = jnp.zeros(capacity, dtype=jnp.bool_).at[etgt].set(
                 True, mode="drop")
             counts = seg.segment_sum(keep.astype(jnp.int32), gidv,

@@ -179,11 +179,10 @@ class _FilterKernel:
                 pos = jnp.cumsum(keep.astype(jnp.int32)) - 1
                 tgt = jnp.where(keep, pos, capacity)
                 new_n = jnp.sum(keep.astype(jnp.int32))
+                from spark_rapids_tpu.ops.scatter32 import scatter_pair
                 outs = []
                 for data, validity in cols:
-                    od = jnp.zeros_like(data).at[tgt].set(data, mode="drop")
-                    ov = jnp.zeros_like(validity).at[tgt].set(validity, mode="drop")
-                    outs.append((od, ov))
+                    outs.append(scatter_pair(capacity, tgt, data, validity))
                 return outs, new_n
 
             fn = jax.jit(run)
@@ -395,14 +394,13 @@ def _compaction_kernel(capacity: int, schema_key):
     fn = _COMPACT_KERNELS.get(key)
     if fn is None:
         def run(datas, valids, keep):
+            from spark_rapids_tpu.ops.scatter32 import scatter_pair
             pos = jnp.cumsum(keep.astype(jnp.int32)) - 1
             tgt = jnp.where(keep, pos, capacity)
             new_n = jnp.sum(keep.astype(jnp.int32))
             outs = []
             for d, v in zip(datas, valids):
-                od = jnp.zeros_like(d).at[tgt].set(d, mode="drop")
-                ov = jnp.zeros_like(v).at[tgt].set(v, mode="drop")
-                outs.append((od, ov))
+                outs.append(scatter_pair(capacity, tgt, d, v))
             return outs, new_n
 
         fn = jax.jit(run)

@@ -278,23 +278,21 @@ class TpuNestedLoopJoinExec(TpuExec):
                 tgt = jnp.where(keep, pos, cap_p)
                 nout = jnp.sum(keep.astype(jnp.int32))
                 # probe table IS the left side for semi/anti (never swapped)
+                from spark_rapids_tpu.ops.scatter32 import scatter_pair
                 outs = []
                 for d, v in (lcols if not swapped else rcols):
-                    od = jnp.zeros_like(d).at[tgt].set(d, mode="drop")
-                    ov = jnp.zeros_like(v).at[tgt].set(v, mode="drop")
-                    outs.append((od, ov))
+                    outs.append(scatter_pair(cap_p, tgt, d, v))
                 return ((tuple(outs), nout),)
 
             # matched pairs -> compact to the front
             pos = jnp.cumsum(match.astype(jnp.int32)) - 1
             tgt = jnp.where(match, pos, out_cap)
             n_pairs = jnp.sum(match.astype(jnp.int32))
+            from spark_rapids_tpu.ops.scatter32 import scatter_pair
             pair_out = []
             for pv in pair_cols:
-                od = jnp.zeros_like(pv.data).at[tgt].set(pv.data, mode="drop")
-                ov = jnp.zeros_like(pv.validity).at[tgt].set(
-                    pv.validity, mode="drop")
-                pair_out.append((od, ov))
+                pair_out.append(
+                    scatter_pair(out_cap, tgt, pv.data, pv.validity))
 
             b_match = jnp.zeros(cap_b, jnp.bool_).at[
                 jnp.where(match, b_idx, cap_b)].set(True, mode="drop")
@@ -312,9 +310,7 @@ class TpuNestedLoopJoinExec(TpuExec):
             probe_cols = rcols if swapped else lcols
             probe_out = []
             for d, v in probe_cols:
-                od = jnp.zeros_like(d).at[utgt].set(d, mode="drop")
-                ov = jnp.zeros_like(v).at[utgt].set(v, mode="drop")
-                probe_out.append((od, ov))
+                probe_out.append(scatter_pair(cap_p, utgt, d, v))
             null_build = []
             for d, v in (lcols if swapped else rcols):
                 zd = jnp.zeros(cap_p, dtype=d.dtype)
@@ -334,10 +330,10 @@ class TpuNestedLoopJoinExec(TpuExec):
         pos = jnp.cumsum(keep.astype(jnp.int32)) - 1
         tgt = jnp.where(keep, pos, bt.capacity)
         nout = jnp.sum(keep.astype(jnp.int32))
+        from spark_rapids_tpu.ops.scatter32 import scatter_pair
         build_cols = []
         for c in bt.columns:
-            od = jnp.zeros_like(c.data).at[tgt].set(c.data, mode="drop")
-            ov = jnp.zeros_like(c.validity).at[tgt].set(c.validity, mode="drop")
+            od, ov = scatter_pair(bt.capacity, tgt, c.data, c.validity)
             build_cols.append(c.with_arrays(od, ov))
         probe_schema = self._right_schema if swapped else self._left_schema
         null_cols = []

@@ -151,25 +151,19 @@ class TpuGenerateExec(TpuExec):
             tgt = jnp.where(live, cpos, out_cap)
             n_elems = jnp.sum(live.astype(jnp.int32))
 
+            from spark_rapids_tpu.ops.scatter32 import scatter_pair
             outs = []
             for data, valid in cols:
-                gd = data[rid]
-                gv = valid[rid]
-                od = jnp.zeros(out_cap, dtype=gd.dtype).at[tgt].set(
-                    gd, mode="drop")
-                ov = jnp.zeros(out_cap, dtype=jnp.bool_).at[tgt].set(
-                    gv, mode="drop")
-                outs.append([od, ov])
+                outs.append(list(scatter_pair(out_cap, tgt, data[rid],
+                                              valid[rid])))
             if pos:
                 pd = jnp.zeros(out_cap, dtype=jnp.int32).at[tgt].set(
                     pos_val, mode="drop")
                 pv = jnp.zeros(out_cap, dtype=jnp.bool_).at[tgt].set(
                     True, mode="drop")
                 outs.append([pd, pv])
-            vd = jnp.zeros(out_cap, dtype=ed.dtype).at[tgt].set(
-                jnp.where(ev, ed, jnp.zeros_like(ed)), mode="drop")
-            vv = jnp.zeros(out_cap, dtype=jnp.bool_).at[tgt].set(
-                ev, mode="drop")
+            vd, vv = scatter_pair(
+                out_cap, tgt, jnp.where(ev, ed, jnp.zeros_like(ed)), ev)
             outs.append([vd, vv])
             nout = n_elems
 

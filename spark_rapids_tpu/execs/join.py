@@ -23,6 +23,7 @@ post-filter for inner/cross; outer-with-condition falls back (tagged).
 
 from __future__ import annotations
 
+import contextvars
 from typing import List, Optional, Sequence, Tuple
 
 import jax
@@ -43,6 +44,11 @@ INT32_MAX = np.iinfo(np.int32).max
 
 #: (data, validity) pair for key columns
 DevVal = Tuple[jax.Array, jax.Array]
+
+#: spark.rapids.tpu.join.directTableMultiplier, set per-query by the
+#: session (execs have no conf handle — same pattern as MAX_RETRIES_VAR)
+DIRECT_TABLE_MULT = contextvars.ContextVar("rapids_direct_join_mult",
+                                           default=4)
 
 
 def _dense_rank_ops(ops, valid):
@@ -231,6 +237,98 @@ class JoinKernel:
         raise ColumnarProcessingError(f"expand kind {kind}")
 
 
+class _DirectJoinKernel:
+    """Dense-domain direct-address join — the TPU answer to the build-side
+    hash table (reference: GpuHashJoin.scala builds a cuDF hash table and
+    probes it). Pointer-chasing hash tables are VPU-hostile, but the common
+    case — a fact table probing a dimension/key table whose integer keys
+    occupy a bounded range (every foreign-key join) — needs no hash and no
+    sort: scatter build row ids into a static-capacity table indexed by
+    ``key - min(key)``, gather per probe key, done. One fused kernel does
+    probe + gather + compaction with ZERO host syncs; two device flags
+    (range fits, build keys unique) validate the speculation at collect
+    time (runtime/speculation.py), falling back to the sort-based join via
+    replay when the keys are too sparse or duplicated."""
+
+    _traces = {}
+
+    SUPPORTED = ("inner", "left", "leftouter", "leftsemi", "leftanti")
+
+    @classmethod
+    def run(cls, jt: str, lt: DeviceTable, rt: DeviceTable,
+            lkey: DevVal, rkey: DevVal, H: int):
+        """Returns ([(data, validity)...] for left cols [+ right cols],
+        nout_dev, fail_dev)."""
+        key = (jt, H, lt.capacity, rt.capacity,
+               lt.schema_key()[0], rt.schema_key()[0],
+               str(lkey[0].dtype), str(rkey[0].dtype))
+        fn = cls._traces.get(key)
+        if fn is None:
+            fn = jax.jit(cls._build(jt, H, lt.capacity, rt.capacity))
+            cls._traces[key] = fn
+        l_cols = tuple((c.data, c.validity) for c in lt.columns)
+        r_cols = tuple((c.data, c.validity) for c in rt.columns)
+        return fn(l_cols, lkey, r_cols, rkey, lt.nrows_dev, rt.nrows_dev)
+
+    @staticmethod
+    def _build(jt: str, H: int, cap_l: int, cap_r: int):
+        def kernel(l_cols, lk, r_cols, rk, nl, nr):
+            ld, lv = lk
+            rd, rv = rk
+            live_l = jnp.arange(cap_l, dtype=jnp.int32) < nl
+            live_r = jnp.arange(cap_r, dtype=jnp.int32) < nr
+            vl = lv & live_l
+            vr = rv & live_r
+
+            rd64 = rd.astype(jnp.int64)
+            ld64 = ld.astype(jnp.int64)
+            I64MAX = jnp.asarray(np.iinfo(np.int64).max, jnp.int64)
+            keymin = jnp.min(jnp.where(vr, rd64, I64MAX))
+            any_r = jnp.any(vr)
+            keymin = jnp.where(any_r, keymin, 0)
+            pos = rd64 - keymin
+            fits = (~any_r) | (jnp.max(jnp.where(vr, pos, 0)) < H)
+            posc = jnp.clip(pos, 0, H - 1).astype(jnp.int32)
+            tgt_r = jnp.where(vr, posc, H)
+            cnt = jnp.zeros(H, jnp.int32).at[tgt_r].add(1, mode="drop")
+            unique = jnp.max(cnt) <= 1
+            rowid = jnp.full(H, -1, jnp.int32).at[tgt_r].max(
+                jnp.arange(cap_r, dtype=jnp.int32), mode="drop")
+
+            p = ld64 - keymin
+            inb = (p >= 0) & (p < H) & vl
+            ri = rowid[jnp.clip(p, 0, H - 1).astype(jnp.int32)]
+            matched = inb & (ri >= 0)
+            fail = ~(fits & unique)
+            safe_ri = jnp.where(matched, ri, 0)
+
+            if jt == "leftouter" or jt == "left":
+                # every live probe row emits exactly one output row in place
+                outs = list(l_cols)
+                for d, v in r_cols:
+                    outs.append((d[safe_ri], v[safe_ri] & matched))
+                return tuple(outs), nl, fail
+
+            if jt in ("leftsemi", "leftanti"):
+                keep = matched if jt == "leftsemi" else (live_l & ~matched)
+            else:  # inner
+                keep = matched
+            from spark_rapids_tpu.ops.scatter32 import scatter_pair
+            cpos = jnp.cumsum(keep.astype(jnp.int32)) - 1
+            tgt = jnp.where(keep, cpos, cap_l)
+            nout = jnp.sum(keep.astype(jnp.int32))
+            outs = []
+            for d, v in l_cols:
+                outs.append(scatter_pair(cap_l, tgt, d, v))
+            if jt == "inner":
+                for d, v in r_cols:
+                    outs.append(scatter_pair(cap_l, tgt, d[safe_ri],
+                                             v[safe_ri] & matched))
+            return tuple(outs), nout, fail
+
+        return kernel
+
+
 class _ColumnGather:
     """Jitted column gather per (out_cap, schema shapes)."""
 
@@ -294,6 +392,11 @@ class TpuJoinExec(TpuExec):
         self.subpartition_bytes = subpartition_bytes
         self._kernel = JoinKernel.get(len(self.left_keys))
         self._filter_kernel = None
+        self._site_key = "join:{}:{}:{}:{}:{}".format(
+            self.join_type,
+            tuple(k.key() for k in self.left_keys),
+            tuple(k.key() for k in self.right_keys),
+            tuple(self.left_names), tuple(self.right_names))
 
     def output_schema(self):
         jt = self.join_type
@@ -466,11 +569,17 @@ class TpuJoinExec(TpuExec):
             lkeys.append(lk)
             rkeys.append(rk)
 
+        full_outer = jt in ("full", "fullouter", "outer")
+
+        direct = self._try_direct(jt, lt, rt, lkeys, rkeys, swapped,
+                                  full_outer)
+        if direct is not None:
+            return direct, None
+
         (lo, counts, total_d, matched_l, rs_perm, live_l, live_r) = \
             self._kernel.probe(lkeys, rkeys, lt.nrows_dev, rt.nrows_dev,
                                lt.capacity, rt.capacity)
 
-        full_outer = jt in ("full", "fullouter", "outer")
         r_matched = None
         if full_outer:
             r_matched = self._right_matched(lo, counts, rs_perm, rt.capacity,
@@ -480,15 +589,27 @@ class TpuJoinExec(TpuExec):
             keep = matched_l if jt == "leftsemi" else ~matched_l
             return self._compact(lt, keep & live_l), None
 
-        total = int(jax.device_get(total_d))  # the one host sync per batch
-        if jt in ("left", "leftouter", "right", "rightouter") or full_outer:
-            # each unmatched probe row adds at most one output row; use the
-            # probe CAPACITY as the static bound rather than paying a second
-            # tunnel round trip for the exact row count (<=2x bucket cost)
-            upper = total + lt.capacity
+        from spark_rapids_tpu.runtime import speculation as spec
+        size_site = self._site_key + ":size"
+        ctx = None if full_outer else spec.allowed(size_site)
+        if ctx is not None:
+            # speculative static bound: FK-join shape — output rows fit the
+            # probe side's bucket. The exact i64 total stays on device; the
+            # flag is validated by the collect's packed fetch and a miss
+            # replays this site on the exact path below.
+            out_cap = bucket_for(max(lt.capacity, 1))
+            ctx.add_flag(size_site, self._size_flag(
+                jt, total_d, counts, live_l, out_cap, lt.capacity))
         else:
-            upper = total
-        out_cap = bucket_for(max(upper, 1))
+            total = int(jax.device_get(total_d))  # one host sync per batch
+            if jt in ("left", "leftouter", "right", "rightouter") or full_outer:
+                # each unmatched probe row adds at most one output row; use
+                # the probe CAPACITY as the static bound rather than paying a
+                # second tunnel round trip for the exact count (<=2x bucket)
+                upper = total + lt.capacity
+            else:
+                upper = total
+            out_cap = bucket_for(max(upper, 1))
 
         if jt == "inner":
             li, ri, null_l, null_r, nout = self._kernel.expand(
@@ -507,6 +628,60 @@ class TpuJoinExec(TpuExec):
         names = self.left_names + self.right_names
         cols = rcols + lcols if swapped else lcols + rcols
         return DeviceTable(names, cols, nout, out_cap), r_matched
+
+    def _size_flag(self, jt, total_d, counts, live_l, out_cap, cap_l):
+        """Device bool: True iff the speculative out_cap was too small.
+        i64 throughout so a pathological many-to-many total can't wrap."""
+        key = ("sizeflag", jt, out_cap, cap_l, counts.shape[0])
+        fn = self._kernel._aux_traces.get(key)
+        if fn is None:
+            outer = jt in ("left", "leftouter", "right", "rightouter")
+
+            def flag(total_d, counts, live_l):
+                tot = total_d.astype(jnp.int64)
+                if outer:
+                    tot = tot + jnp.sum(
+                        (live_l & (counts == 0)).astype(jnp.int64))
+                return tot > out_cap
+
+            fn = jax.jit(flag)
+            self._kernel._aux_traces[key] = fn
+        return fn(total_d, counts, live_l)
+
+    def _try_direct(self, jt, lt, rt, lkeys, rkeys, swapped, full_outer):
+        """Dense-domain direct-address fast path (see _DirectJoinKernel).
+        Returns the output table, or None when the shape doesn't qualify
+        (multi-key, non-integer key, full outer, residual condition on a
+        non-inner join, or a prior failure blocklisted the site)."""
+        if (len(lkeys) != 1 or full_outer
+                or jt not in _DirectJoinKernel.SUPPORTED):
+            return None
+        if not (jnp.issubdtype(lkeys[0][0].dtype, jnp.integer)
+                and jnp.issubdtype(rkeys[0][0].dtype, jnp.integer)):
+            return None
+        from spark_rapids_tpu.runtime import speculation as spec
+        site = self._site_key + ":direct"
+        ctx = spec.allowed(site)
+        if ctx is None:
+            return None
+        H = bucket_for(max(DIRECT_TABLE_MULT.get() * rt.capacity, 1))
+        outs, nout, fail = _DirectJoinKernel.run(jt, lt, rt, lkeys[0],
+                                                 rkeys[0], H)
+        ctx.add_flag(site, fail)
+        self.add_metric("directJoinBatches", 1)
+        if jt in ("leftsemi", "leftanti"):
+            cols = [c.with_arrays(d, v)
+                    for c, (d, v) in zip(lt.columns, outs)]
+            return DeviceTable(lt.names, cols, nout, lt.capacity)
+        lcols = [c.with_arrays(d, v)
+                 for c, (d, v) in zip(lt.columns, outs[:len(lt.columns)])]
+        rcols = []
+        for c, (d, v) in zip(rt.columns, outs[len(lt.columns):]):
+            rcols.append(DeviceColumn(c.dtype, d, v, dictionary=c.dictionary,
+                                      dict_sorted=c.dict_sorted))
+        names = self.left_names + self.right_names
+        cols = rcols + lcols if swapped else lcols + rcols
+        return DeviceTable(names, cols, nout, lt.capacity)
 
     def _unmatched_build_batch(self, rt: DeviceTable, r_matched,
                                swapped: bool) -> DeviceTable:
@@ -560,14 +735,13 @@ class TpuJoinExec(TpuExec):
             cap = table.capacity
 
             def compact(datas, valids, keep):
+                from spark_rapids_tpu.ops.scatter32 import scatter_pair
                 pos = jnp.cumsum(keep.astype(jnp.int32)) - 1
                 tgt = jnp.where(keep, pos, cap)
                 new_n = jnp.sum(keep.astype(jnp.int32))
                 outs = []
                 for d, v in zip(datas, valids):
-                    od = jnp.zeros_like(d).at[tgt].set(d, mode="drop")
-                    ov = jnp.zeros_like(v).at[tgt].set(v, mode="drop")
-                    outs.append((od, ov))
+                    outs.append(scatter_pair(cap, tgt, d, v))
                 return outs, new_n
 
             fn = jax.jit(compact)

@@ -287,9 +287,8 @@ class TpuWindowExec(TpuExec):
                 # scatter back to INPUT row order so multiple window exprs
                 # with different specs stay positionally aligned with the
                 # child columns
-                d_in = jnp.zeros_like(d).at[perm].set(d)
-                v_in = jnp.zeros_like(v).at[perm].set(v)
-                outs.append((d_in, v_in))
+                from spark_rapids_tpu.ops.scatter32 import scatter_pair
+                outs.append(scatter_pair(capacity, perm, d, v))
 
             col_outs = [(d, v) for d, v in cols]  # original order
             return col_outs, outs

@@ -1,0 +1,103 @@
+"""Speculative sizing — deferred validation of data-dependent decisions.
+
+The reference sizes every join's output exactly by syncing the gather-map
+row count to the host (GpuHashJoin.scala:104-420 joinGatherer row counts,
+JoinGatherer.scala) — on a discrete GPU that sync is microseconds. On a
+tunneled TPU every host sync is a ~0.1s round trip (PERF.md), so an exact
+sync per operator puts a hard latency floor under multi-operator plans
+(the round-2 q3 regression: 10 syncs = 1s).
+
+The TPU-first answer: operators SPECULATE a static output capacity (e.g. a
+hash join's output fits the probe side's bucket — true for every
+foreign-key join), keep the real row count as a device scalar, and record
+a device boolean "speculation failed" flag. Nothing syncs mid-plan; the
+flags ride along and are validated by the ONE packed device fetch the
+query already pays at collect time (columnar/table.py to_host). If any
+flag is set the collect raises SpeculationFailed, the failing sites go on
+a process-wide blocklist, and the session replays the query — the replay
+takes the exact (sync-per-operator) path at those sites, so results are
+always exact. Warm queries therefore run fully async: N dispatched
+kernels, one round trip.
+"""
+
+from __future__ import annotations
+
+import contextvars
+from typing import List, Optional, Tuple
+
+import jax
+
+
+class SpeculationFailed(Exception):
+    """A speculative capacity/layout guess was wrong; replay exactly."""
+
+    def __init__(self, sites: List[str]):
+        super().__init__(f"speculation failed at sites: {sites}")
+        self.sites = list(sites)
+
+
+class SpecContext:
+    """Per-query-execution collection of pending speculation flags.
+
+    A flag is a device bool scalar that is True when the speculation it
+    guards FAILED. Flags are consumed (embedded into a packed fetch) by
+    DeviceTable.to_host; any left over are validated with one extra fetch
+    at the end of session.execute."""
+
+    def __init__(self):
+        self.pending: List[Tuple[str, jax.Array]] = []
+
+    def add_flag(self, site_key: str, flag) -> None:
+        self.pending.append((site_key, flag))
+
+    def take_pending(self) -> List[Tuple[str, jax.Array]]:
+        out = self.pending
+        self.pending = []
+        return out
+
+    def validate_remaining(self) -> None:
+        """Fetch + check any flags no packed fetch consumed (one sync)."""
+        pending = self.take_pending()
+        if not pending:
+            return
+        import jax.numpy as jnp
+        vals = jax.device_get(jnp.stack([f for _, f in pending]))
+        check_flag_values([s for s, _ in pending], vals)
+
+
+def check_flag_values(sites: List[str], values) -> None:
+    failed = [s for s, v in zip(sites, values) if bool(v)]
+    if failed:
+        raise SpeculationFailed(failed)
+
+
+_CTX: contextvars.ContextVar[Optional[SpecContext]] = contextvars.ContextVar(
+    "rapids_spec_ctx", default=None)
+
+#: sites whose speculation failed once — they take the exact path forever
+#: after (per process), so a repeated query shape never replays twice.
+_BLOCKLIST = set()
+
+
+def current() -> Optional[SpecContext]:
+    return _CTX.get()
+
+
+def activate() -> "contextvars.Token":
+    return _CTX.set(SpecContext())
+
+
+def deactivate(token) -> None:
+    _CTX.reset(token)
+
+
+def allowed(site_key: str) -> Optional[SpecContext]:
+    """The active context, iff speculation is enabled for this site."""
+    ctx = _CTX.get()
+    if ctx is None or site_key in _BLOCKLIST:
+        return None
+    return ctx
+
+
+def blocklist(sites) -> None:
+    _BLOCKLIST.update(sites)

@@ -153,7 +153,7 @@ class TpuSession:
         try:
             with self.profiler.profile_query():
                 with acquired(sem):
-                    batches = list(executable.execute_cpu())
+                    batches = self._run_speculative(executable)
         except Exception as exc:
             from spark_rapids_tpu.runtime.crash_handler import (
                 handle_fatal,
@@ -169,6 +169,36 @@ class TpuSession:
             from spark_rapids_tpu.plan.nodes import _empty_table
             return _empty_table(plan.output_schema())
         return HostTable.concat(batches)
+
+    def _run_speculative(self, executable):
+        """Drain the plan under a speculation context (speculative operator
+        sizing, validated by the collect's packed fetch). A failed
+        speculation blocklists the failing sites process-wide and replays
+        once — the replay takes the exact sync-per-operator path there, so
+        a repeated query shape never replays twice
+        (runtime/speculation.py)."""
+        from spark_rapids_tpu.conf import SPECULATIVE_SIZING
+        from spark_rapids_tpu.runtime import speculation as spec
+
+        if not self.conf.get_entry(SPECULATIVE_SIZING):
+            return list(executable.execute_cpu())
+        from spark_rapids_tpu.conf import JOIN_DIRECT_TABLE_MULT
+        from spark_rapids_tpu.execs.join import DIRECT_TABLE_MULT
+        DIRECT_TABLE_MULT.set(self.conf.get_entry(JOIN_DIRECT_TABLE_MULT))
+        # each failed attempt blocklists its sites, so every replay makes
+        # strict progress (a site never fails twice); the cap guards
+        # against a pathological plan by dropping to the exact path
+        for _attempt in range(8):
+            tok = spec.activate()
+            try:
+                batches = list(executable.execute_cpu())
+                spec.current().validate_remaining()
+                return batches
+            except spec.SpeculationFailed as sf:
+                spec.blocklist(sf.sites)
+            finally:
+                spec.deactivate(tok)
+        return list(executable.execute_cpu())
 
     def execute_cpu_only(self, plan: P.PlanNode) -> HostTable:
         """Run fully on the CPU path (the oracle)."""
