@@ -11,6 +11,7 @@ from __future__ import annotations
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
+from spark_rapids_tpu.dispatch import tpu_jit
 import jax.numpy as jnp
 import numpy as np
 
@@ -63,7 +64,7 @@ def _get_assemble(recipes: tuple, cap: int):
                 outs.append((data, validity))
             return outs
 
-        fn = jax.jit(assemble)
+        fn = tpu_jit(assemble)
         _ASSEMBLE_CACHE[key] = fn
     return fn
 
@@ -167,7 +168,7 @@ def _get_pack(kinds: tuple, k: int, cap: int, n_extra: int = 0):
                 parts = [head] + parts
             return jnp.concatenate(parts) if len(parts) > 1 else parts[0]
 
-        fn = jax.jit(pack)
+        fn = tpu_jit(pack)
         _PACK_CACHE[key] = fn
     return fn
 
@@ -266,10 +267,11 @@ def concat_device(tables: Sequence["DeviceTable"]) -> "DeviceTable":
 
     kinds = tuple((str(c.dtype), c.dictionary is not None)
                   for c in tables[0].columns)
-    key = (kinds, caps, out_cap)
+    masked = tuple(t.live is not None for t in tables)
+    key = (kinds, caps, out_cap, masked)
     fn = _CONCAT_CACHE.get(key)
     if fn is None:
-        def concat(cols_per_table, remap_per_table, nrows_list):
+        def concat(cols_per_table, remap_per_table, nrows_list, lives):
             from spark_rapids_tpu.ops.scatter32 import scatter_pair
             outs = []
             for ci in range(ncols):
@@ -284,8 +286,15 @@ def concat_device(tables: Sequence["DeviceTable"]) -> "DeviceTable":
                     if od is None:
                         od = jnp.zeros(out_cap, dtype=data.dtype)
                     n = nrows_list[ti]
-                    idx = jnp.arange(data.shape[0], dtype=jnp.int32)
-                    tgt = jnp.where(idx < n, idx + offset, out_cap)
+                    if lives[ti] is not None:
+                        # masked input: its deferred compaction fuses into
+                        # this scatter (slot -> rank among live rows)
+                        lv = lives[ti]
+                        pos = jnp.cumsum(lv.astype(jnp.int32)) - 1
+                        tgt = jnp.where(lv, pos + offset, out_cap)
+                    else:
+                        idx = jnp.arange(data.shape[0], dtype=jnp.int32)
+                        tgt = jnp.where(idx < n, idx + offset, out_cap)
                     pd, pv = scatter_pair(out_cap, tgt, data, valid)
                     od = od + pd if jnp.issubdtype(od.dtype, jnp.number) \
                         else od | pd
@@ -297,16 +306,18 @@ def concat_device(tables: Sequence["DeviceTable"]) -> "DeviceTable":
                 total = total + n
             return outs, total
 
-        fn = jax.jit(concat)
+        fn = tpu_jit(concat)
         _CONCAT_CACHE[key] = fn
 
     cols_per_table = tuple(
         tuple((c.data, c.validity) for c in t.columns) for t in tables)
+    from spark_rapids_tpu.dispatch import device_const
     remap_per_table = tuple(
-        tuple(jnp.asarray(m) if m is not None else None for m in row)
+        tuple(device_const(m) if m is not None else None for m in row)
         for row in remaps)
     nrows_list = tuple(t.nrows_dev for t in tables)
-    outs, total = fn(cols_per_table, remap_per_table, nrows_list)
+    lives = tuple(t.live for t in tables)
+    outs, total = fn(cols_per_table, remap_per_table, nrows_list, lives)
     out_cols = [
         DeviceColumn(c.dtype, d, v, dictionary=out_dicts[ci],
                      dict_sorted=True if out_dicts[ci] is not None
@@ -408,14 +419,29 @@ class DeviceTable:
     ``num_rows`` is tracked both as a device int32 scalar (``nrows_dev``,
     usable inside jitted kernels without host sync) and, lazily, as a host
     int (``num_rows`` property — blocks on the device the first time it is
-    read after a data-dependent op such as filter)."""
+    read after a data-dependent op such as filter).
 
-    __slots__ = ("names", "columns", "nrows_dev", "_nrows_host", "capacity")
+    ``live`` (optional device bool[capacity]) marks MASKED tables: live rows
+    sit at their original slots instead of a compacted prefix. Row
+    compaction is a scatter per column word — 64-bit columns split into
+    2-3 scatters plus emulated recombine chains, the single most expensive
+    per-row operation on TPU (PERF.md: ~0.15-0.25s per 8-column 1M-row
+    compaction). Filters and dense-key joins therefore emit masked tables
+    and downstream mask-aware execs (filter, project, join probe,
+    aggregate, sort) consume liveness from ``row_mask()`` — the scatter is
+    paid only at a boundary that truly needs the prefix invariant
+    (``compacted()``: collects, spill demotion, splits, unlearned execs).
+    The reference has no analog: cuDF compaction is bandwidth-priced, so
+    GpuFilterExec compacts eagerly (basicPhysicalOperators.scala)."""
+
+    __slots__ = ("names", "columns", "nrows_dev", "_nrows_host", "capacity",
+                 "live")
 
     def __init__(self, names: Sequence[str], columns: Sequence[DeviceColumn],
-                 nrows, capacity: Optional[int] = None):
+                 nrows, capacity: Optional[int] = None, live=None):
         self.names: Tuple[str, ...] = tuple(names)
         self.columns: Tuple[DeviceColumn, ...] = tuple(columns)
+        self.live = live
         if self.columns:
             caps = {c.capacity for c in self.columns}
             if len(caps) != 1:
@@ -424,8 +450,9 @@ class DeviceTable:
         else:
             self.capacity = int(capacity or 0)
         if isinstance(nrows, (int, np.integer)):
+            from spark_rapids_tpu.dispatch import device_scalar
             self._nrows_host: Optional[int] = int(nrows)
-            self.nrows_dev = jnp.asarray(np.int32(nrows))
+            self.nrows_dev = device_scalar(int(nrows))
         else:
             self._nrows_host = None
             self.nrows_dev = nrows
@@ -503,6 +530,8 @@ class DeviceTable:
         no separate row-count sync, no separate flag validation fetch."""
         if not self.columns:
             return HostTable(self.names, [])
+        if self.live is not None:
+            return self.compacted().to_host()
         if any(c.is_array for c in self.columns):
             return self.to_host_per_column()
         from spark_rapids_tpu.runtime import speculation as spec
@@ -538,18 +567,65 @@ class DeviceTable:
         (no pack kernel, no table-sized staging allocation). Used by spill
         demotion during OOM recovery, where allocating on the exhausted
         device would fail (the packed path is for collects)."""
+        if self.live is not None:
+            # OOM demotion path: the device is exhausted, so the deferred
+            # compaction must NOT allocate there — fetch the padded
+            # columns plus the mask and compact with numpy on host
+            mask = np.asarray(jax.device_get(self.live))
+            idx = np.nonzero(mask)[0]
+            cols = []
+            for c in self.columns:
+                full = c.to_host(self.capacity)
+                cols.append(type(full)(full.dtype, full.data[idx],
+                                       full.validity[idx]))
+            if self._nrows_host is None:
+                self._nrows_host = int(len(idx))
+            return HostTable(self.names, cols)
         n = self.num_rows
         return HostTable(self.names, [c.to_host(n) for c in self.columns])
 
     def row_mask(self):
         """Bool mask of live rows — usable inside jit (no host sync)."""
+        if self.live is not None:
+            return self.live
         return jnp.arange(self.capacity, dtype=jnp.int32) < self.nrows_dev
+
+    def compacted(self) -> "DeviceTable":
+        """Prefix form: live rows scattered to [0, nrows) in original
+        order. No-op for prefix tables; masked tables pay the one scatter
+        per column word this representation exists to defer."""
+        if self.live is None:
+            return self
+        key = ("tablecompact", self.capacity, self.schema_key()[0])
+        fn = _PACK_CACHE.get(key)
+        if fn is None:
+            cap = self.capacity
+
+            def compact(datas, valids, keep):
+                from spark_rapids_tpu.ops.scatter32 import scatter_pair
+                pos = jnp.cumsum(keep.astype(jnp.int32)) - 1
+                tgt = jnp.where(keep, pos, cap)
+                outs = []
+                for d, v in zip(datas, valids):
+                    outs.append(scatter_pair(cap, tgt, d, v))
+                return outs
+
+            fn = tpu_jit(compact)
+            _PACK_CACHE[key] = fn
+        outs = fn(tuple(c.data for c in self.columns),
+                  tuple(c.validity for c in self.columns), self.live)
+        cols = [c.with_arrays(d, v) for c, (d, v) in zip(self.columns, outs)]
+        out = DeviceTable(self.names, cols, self.nrows_dev, self.capacity)
+        out._nrows_host = self._nrows_host
+        return out
 
     def shrink(self) -> "DeviceTable":
         """Re-bucket to the smallest capacity holding the live rows. Syncs
         the row count (host round-trip) — worth it after cardinality-
         collapsing ops (aggregate output of a few groups must not drag the
         input's multi-million-row bucket through downstream sorts/uploads)."""
+        if self.live is not None:
+            return self.compacted().shrink()
         n = self.num_rows
         k = bucket_for(max(n, 1))
         if k >= self.capacity:

@@ -135,6 +135,23 @@ SPECULATIVE_SIZING = bool_conf(
     "of a ~0.1s host sync per operator; a failed speculation replays the "
     "query on the exact path (runtime/speculation.py).", commonly_used=True)
 
+COLUMN_PRUNING = bool_conf(
+    "spark.rapids.tpu.sql.columnPruning.enabled", True,
+    "Prune unreferenced columns below joins/aggregates (Spark's "
+    "ColumnPruning logical rule, which the reference inherits from Spark; "
+    "this engine owns its logical plans so it applies the rule itself — "
+    "overrides/pruning.py). Every pruned column avoids per-operator "
+    "gathers/scatters of emulated 64-bit halves on TPU.")
+
+MASKED_BATCHES = bool_conf(
+    "spark.rapids.tpu.maskedBatches.enabled", True,
+    "Defer row compaction: filters and dense-key joins emit batches whose "
+    "liveness is a device mask instead of scatter-compacting every column "
+    "(the most expensive per-row op on TPU); mask-aware downstream execs "
+    "consume the mask and the scatter is paid only at collect/spill/"
+    "split boundaries (columnar/table.py DeviceTable.live).",
+    commonly_used=True)
+
 JOIN_DIRECT_TABLE_MULT = int_conf(
     "spark.rapids.tpu.join.directTableMultiplier", 4,
     "Direct-address join fast path: the key-range table is this multiple "

@@ -1,0 +1,177 @@
+"""Device-constant interning + dispatch accounting.
+
+Measured on the tunneled TPU (PERF.md): kernel dispatches PIPELINE — eight
+chained dispatches plus one result fetch cost the same ~0.09s as one — but
+every host->device transfer in the warm path is a fresh ~0.1-3s stall (a
+tiny 4-byte scalar upload costs ~0.15s, and an upload interleaved between
+dispatches forces a pipeline flush costing seconds). The reference never
+faces this: cudaMemcpyAsync on PCIe is microseconds, so it re-uploads
+per-kernel scratch freely (e.g. JCudfSerialization headers).
+
+The TPU-first rule is therefore: NOTHING transfers host->device on a warm
+query. Every per-query host-side constant — expression aux arrays
+(dictionary codes, literal tables, remap vectors), aggregate size/stride
+vectors, row-count scalars — is interned here by CONTENT, so a repeated
+query shape reuses the device-resident copy and the warm path performs
+zero uploads.
+
+``count_dispatch`` feeds the per-query ``dispatches`` metric (VERDICT r3:
+the dispatch count must be observable)."""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_LOCK = threading.Lock()
+
+#: content-keyed device copies of host constant arrays
+_CONST_CACHE: Dict[tuple, jax.Array] = {}
+#: interned device scalars keyed by (dtype, value)
+_SCALAR_CACHE: Dict[tuple, jax.Array] = {}
+
+#: evict the const cache above this many entries (scans are cached on their
+#: host tables, not here; these are small aux/remap arrays)
+_CONST_CACHE_CAP = 8192
+
+
+def _content_key(arr: np.ndarray) -> tuple:
+    if arr.dtype == object:
+        # object arrays (string dictionaries) hash by element repr
+        h = hashlib.sha1("\x00".join(map(repr, arr.ravel().tolist()))
+                         .encode()).digest()
+        return (str(arr.dtype), arr.shape, h)
+    b = np.ascontiguousarray(arr).tobytes()
+    if len(b) <= 128:
+        return (str(arr.dtype), arr.shape, b)
+    return (str(arr.dtype), arr.shape, hashlib.sha1(b).digest())
+
+
+def device_const(arr) -> jax.Array:
+    """Device copy of a host constant array, interned by content. Safe to
+    call inside a jit trace (the cached concrete array is captured as a
+    trace constant — uploaded once at compile, never per call)."""
+    if isinstance(arr, jax.Array):
+        return arr
+    arr = np.asarray(arr)
+    key = _content_key(arr)
+    with _LOCK:
+        d = _CONST_CACHE.get(key)
+    if d is None:
+        d = jnp.asarray(arr)
+        with _LOCK:
+            if len(_CONST_CACHE) >= _CONST_CACHE_CAP:
+                _CONST_CACHE.clear()
+            _CONST_CACHE[key] = d
+    return d
+
+
+def device_scalar(value, dtype=np.int32) -> jax.Array:
+    """Interned 0-d device scalar (the DeviceTable row-count pattern:
+    ``jnp.asarray(np.int32(n))`` per table was a ~0.15s upload EACH)."""
+    dt = np.dtype(dtype)
+    key = (dt.str, value)
+    with _LOCK:
+        d = _SCALAR_CACHE.get(key)
+    if d is None:
+        d = jnp.asarray(np.asarray(value, dtype=dt))
+        with _LOCK:
+            if len(_SCALAR_CACHE) >= _CONST_CACHE_CAP:
+                _SCALAR_CACHE.clear()
+            _SCALAR_CACHE[key] = d
+    return d
+
+
+def prep_aux(pctx) -> tuple:
+    """Upload a PrepCtx's aux arrays: content-interned for deterministic
+    slots, plain per-call upload for nondeterministic ones (rand streams —
+    interning those would pin every batch's values on device forever)."""
+    intern = getattr(pctx, "aux_intern", None) or [True] * len(pctx.aux_arrays)
+    return tuple(device_const(a) if keep else jnp.asarray(a)
+                 for a, keep in zip(pctx.aux_arrays, intern))
+
+
+def clear_device_constants() -> int:
+    """Drop interned device constants (device OOM recovery hook)."""
+    with _LOCK:
+        n = len(_CONST_CACHE) + len(_SCALAR_CACHE)
+        _CONST_CACHE.clear()
+        _SCALAR_CACHE.clear()
+    return n
+
+
+# -- dispatch accounting ----------------------------------------------------
+
+_DISPATCHES = [0]
+
+
+def count_dispatch(n: int = 1) -> None:
+    """Record ``n`` device kernel dispatches. No-op inside a jit trace
+    (an inlined sub-kernel is not a dispatch)."""
+    _DISPATCHES[0] += n
+
+
+def dispatch_count() -> int:
+    return _DISPATCHES[0]
+
+
+def reset_dispatch_count() -> int:
+    old = _DISPATCHES[0]
+    _DISPATCHES[0] = 0
+    return old
+
+
+try:
+    from jax._src.core import trace_state_clean as _trace_state_clean
+except ImportError:  # pragma: no cover - jax internals moved
+    def _trace_state_clean() -> bool:
+        return True
+
+
+def tracing() -> bool:
+    """Are we inside a jax trace right now?"""
+    return not _trace_state_clean()
+
+
+#: per-kernel wall timings when SRT_PROFILE_DISPATCH=1 (each dispatch is
+#: force-synced via a scalar fetch, so entries ~= kernel compute + one RTT)
+DISPATCH_PROFILE: list = []
+
+
+def _sync_result(res):
+    leaves = jax.tree_util.tree_leaves(res)
+    for leaf in leaves:
+        if isinstance(leaf, jax.Array):
+            jax.device_get(jnp.ravel(leaf)[:1])
+            return
+
+
+def tpu_jit(fn, **kwargs):
+    """jax.jit that records a dispatch per (non-traced) call — when an
+    exec kernel runs inside a whole-plan fused trace (execs/fused.py) it
+    inlines into the outer program and is NOT a dispatch."""
+    import os
+    jf = jax.jit(fn, **kwargs)
+    name = getattr(fn, "__qualname__", getattr(fn, "__name__", "kernel"))
+    profile = bool(os.environ.get("SRT_PROFILE_DISPATCH"))
+
+    def call(*args, **kw):
+        if not _trace_state_clean():
+            return jf(*args, **kw)
+        count_dispatch()
+        if not profile:
+            return jf(*args, **kw)
+        import time
+        t0 = time.perf_counter()
+        res = jf(*args, **kw)
+        _sync_result(res)
+        DISPATCH_PROFILE.append((name, time.perf_counter() - t0))
+        return res
+
+    call.__wrapped__ = jf
+    return call

@@ -32,6 +32,7 @@ from __future__ import annotations
 from typing import List, Optional, Sequence, Tuple
 
 import jax
+from spark_rapids_tpu.dispatch import tpu_jit
 import jax.numpy as jnp
 import numpy as np
 
@@ -99,7 +100,7 @@ class TpuHashAggregateExec(TpuExec):
         from spark_rapids_tpu.runtime.retry import retry_block
         from spark_rapids_tpu.runtime.spill import BufferCatalog, SpillableBatch
 
-        it = self.children[0].execute()
+        it = self.children[0].execute_masked()
         first = next(it, None)
         if first is None:
             return
@@ -304,10 +305,17 @@ class TpuHashAggregateExec(TpuExec):
 
     def _aggregate(self, table: DeviceTable, grouping, agg_specs,
                    grouping_names, filters) -> DeviceTable:
+        if table.live is not None:
+            from spark_rapids_tpu.ops.expr import has_position_dependent
+            exprs = (list(grouping) + list(filters)
+                     + [c for _, fn in agg_specs for c in fn.children])
+            if any(has_position_dependent(e) for e in exprs):
+                table = table.compacted()  # slot ids must match prefix form
         pctx, filter_preps, key_preps, val_preps = self._prep_all(
             table, grouping, agg_specs, filters)
+        from spark_rapids_tpu.dispatch import device_const, prep_aux
         cols = tuple(DevVal(c.data, c.validity) for c in table.columns)
-        aux = tuple(jnp.asarray(a) for a in pctx.aux_arrays)
+        aux = prep_aux(pctx)
         capacity = table.capacity
 
         fast = self._fast_layout(grouping, key_preps)
@@ -320,7 +328,8 @@ class TpuHashAggregateExec(TpuExec):
              tuple(f.key() for f in filters),
              table.schema_key()[0]))
         mode_key = ("fast", fast[0], fast[3]) if fast else ("sorted",)
-        tkey = (capacity, self.use_split, mode_key,
+        has_mask = table.live is not None
+        tkey = (capacity, self.use_split, mode_key, has_mask,
                 tuple(_prep_trace_key(p) for p in filter_preps),
                 tuple(_prep_trace_key(p) for p in key_preps),
                 tuple(tuple(_prep_trace_key(p) for p in per_child)
@@ -328,11 +337,11 @@ class TpuHashAggregateExec(TpuExec):
         fn = self._traces.get(tkey)
         if fn is None:
             if fast:
-                fn = jax.jit(self._build_fast_kernel(
+                fn = tpu_jit(self._build_fast_kernel(
                     capacity, fast[0], fast[3], filter_preps, key_preps,
                     val_preps, grouping, agg_specs, filters))
             else:
-                fn = jax.jit(self._build_kernel(
+                fn = tpu_jit(self._build_kernel(
                     capacity, filter_preps, key_preps, val_preps,
                     grouping, agg_specs, filters))
             self._traces[tkey] = fn
@@ -341,11 +350,12 @@ class TpuHashAggregateExec(TpuExec):
             _, sizes, strides, gpad = fast
             out_arrays, ngroups = fn(
                 cols, aux, table.nrows_dev,
-                jnp.asarray(np.asarray(sizes, dtype=np.int32)),
-                jnp.asarray(np.asarray(strides, dtype=np.int32)))
+                device_const(np.asarray(sizes, dtype=np.int32)),
+                device_const(np.asarray(strides, dtype=np.int32)),
+                table.live)
             out_capacity = gpad
         else:
-            out_arrays, ngroups = fn(cols, aux, table.nrows_dev)
+            out_arrays, ngroups = fn(cols, aux, table.nrows_dev, table.live)
             out_capacity = capacity
 
         out_cols: List[DeviceColumn] = []
@@ -382,11 +392,16 @@ class TpuHashAggregateExec(TpuExec):
         # sorts/transfers don't run at input capacity
         return out.shrink()
 
-    def _eval_live(self, filters, capacity, cols, aux, nrows, filter_preps):
-        """Row-liveness mask: in-bounds AND every fused predicate true."""
-        live = jnp.arange(capacity, dtype=jnp.int32) < nrows
+    def _eval_live(self, filters, capacity, cols, aux, nrows, filter_preps,
+                   live_in=None):
+        """Row-liveness mask: in-bounds (or the input's deferred-compaction
+        mask) AND every fused predicate true."""
+        if live_in is not None:
+            live = live_in
+        else:
+            live = jnp.arange(capacity, dtype=jnp.int32) < nrows
         for f, preps in zip(filters, filter_preps):
-            ctx = EvalCtx(cols, aux, nrows, capacity)
+            ctx = EvalCtx(cols, aux, nrows, capacity, live=live_in)
             ctx._prep_iter = iter(preps)
             pred = _walk_eval(f, ctx)
             live = live & pred.data & pred.validity
@@ -399,13 +414,13 @@ class TpuHashAggregateExec(TpuExec):
         value_exprs = [list(fn.children) for _, fn in agg_specs]
         use_split = self.use_split
 
-        def kernel(cols, aux, nrows, sizes, strides):
+        def kernel(cols, aux, nrows, sizes, strides, live_in):
             live = self._eval_live(filters, capacity, cols, aux, nrows,
-                                   filter_preps)
+                                   filter_preps, live_in)
 
             gid = jnp.zeros(capacity, dtype=jnp.int32)
             for i, (g, preps, kind) in enumerate(zip(grouping, key_preps, kinds)):
-                ctx = EvalCtx(cols, aux, nrows, capacity)
+                ctx = EvalCtx(cols, aux, nrows, capacity, live=live_in)
                 ctx._prep_iter = iter(preps)
                 kv = _walk_eval(g, ctx)
                 code = kv.data.astype(jnp.int32) if kind == "bool" else kv.data
@@ -422,7 +437,7 @@ class TpuHashAggregateExec(TpuExec):
             for ves, per_child in zip(value_exprs, val_preps):
                 vals = []
                 for ve, preps in zip(ves, per_child):
-                    ctx = EvalCtx(cols, aux, nrows, capacity)
+                    ctx = EvalCtx(cols, aux, nrows, capacity, live=live_in)
                     ctx._prep_iter = iter(preps)
                     vals.append(_walk_eval(ve, ctx))
                 vvs.append(vals)
@@ -550,20 +565,20 @@ class TpuHashAggregateExec(TpuExec):
         value_exprs = [list(fn.children) for _, fn in agg_specs]
         use_split = self.use_split
 
-        def kernel(cols, aux, nrows):
+        def kernel(cols, aux, nrows, live_in):
             live = self._eval_live(filters, capacity, cols, aux, nrows,
-                                   filter_preps)
+                                   filter_preps, live_in)
 
             key_vals: List[DevVal] = []
             for g, preps in zip(grouping, key_preps):
-                ctx = EvalCtx(cols, aux, nrows, capacity)
+                ctx = EvalCtx(cols, aux, nrows, capacity, live=live_in)
                 ctx._prep_iter = iter(preps)
                 key_vals.append(_walk_eval(g, ctx))
             val_vals = []
             for ves, per_child in zip(value_exprs, val_preps):
                 vals = []
                 for ve, preps in zip(ves, per_child):
-                    ctx = EvalCtx(cols, aux, nrows, capacity)
+                    ctx = EvalCtx(cols, aux, nrows, capacity, live=live_in)
                     ctx._prep_iter = iter(preps)
                     vals.append(_walk_eval(ve, ctx))
                 val_vals.append(vals)
@@ -579,11 +594,8 @@ class TpuHashAggregateExec(TpuExec):
 
             if grouping:
                 operands = [(~live).astype(jnp.int32)]  # dead rows last
-                per_key_ops = []
                 for kv in key_vals:
-                    kops = _sortable(kv.data, kv.validity)
-                    per_key_ops.append(kops)
-                    operands.extend(kops)
+                    operands.extend(_sortable(kv.data, kv.validity))
                 payload = jnp.arange(capacity, dtype=jnp.int32)
                 sorted_all = jax.lax.sort(operands + [payload],
                                           num_keys=len(operands))
@@ -592,13 +604,13 @@ class TpuHashAggregateExec(TpuExec):
                 s_keys = [DevVal(kv.data[perm], kv.validity[perm]) for kv in key_vals]
 
                 # group boundaries on the CANONICAL operands (raw float
-                # compares would split NaN groups: NaN != NaN)
+                # compares would split NaN groups: NaN != NaN); the sort
+                # already emitted every operand in sorted order — compare
+                # those directly instead of re-gathering by perm
                 first = jnp.arange(capacity) == 0
                 changed = jnp.zeros(capacity, dtype=jnp.bool_)
-                for kops in per_key_ops:
-                    for o in kops:
-                        so = o[perm]
-                        changed = changed | (so != jnp.roll(so, 1))
+                for so in sorted_all[1:-1]:
+                    changed = changed | (so != jnp.roll(so, 1))
                 new_group = (first | changed) & s_live
                 gid = jnp.cumsum(new_group.astype(jnp.int32)) - 1
                 gid = jnp.where(s_live, gid, capacity - 1)  # park dead rows

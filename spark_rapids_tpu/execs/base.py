@@ -3,6 +3,7 @@ GpuRowToColumnarExec / GpuColumnarToRowExec — SURVEY.md §2.2/§2.3)."""
 
 from __future__ import annotations
 
+import contextvars
 import time
 from typing import Iterator, List, Optional, Sequence, Tuple
 
@@ -15,6 +16,11 @@ from spark_rapids_tpu.plan.nodes import PlanNode, Schema
 #: GpuExec.scala:52-342). The session sets the active level from
 #: spark.rapids.sql.metrics.level; add_metric drops records above it.
 METRIC_LEVELS = {"ESSENTIAL": 0, "MODERATE": 1, "DEBUG": 2}
+
+#: spark.rapids.tpu.maskedBatches.enabled, set per-query by the session
+#: (execs have no conf handle — same pattern as retry.MAX_RETRIES_VAR)
+MASKED_ENABLED = contextvars.ContextVar("rapids_masked_batches",
+                                        default=True)
 _ACTIVE_METRIC_LEVEL = [1]  # MODERATE default
 
 
@@ -24,9 +30,22 @@ def set_metrics_level(name: str) -> None:
 
 
 class TpuExec:
-    """Base of device operators. ``execute`` yields DeviceTable batches."""
+    """Base of device operators. ``execute`` yields DeviceTable batches.
+
+    Two output protocols (columnar/table.py DeviceTable.live):
+    ``execute()`` always yields PREFIX tables (live rows at [0, nrows));
+    ``execute_masked()`` may yield MASKED tables (liveness as a device
+    bool mask), letting mask-aware consumers skip the per-column
+    compaction scatter. The default implementations tie them together so
+    an exec only ever implements one of the two: mask-oblivious execs
+    implement ``execute`` (and ``execute_masked`` forwards to it); mask-
+    producing execs implement ``execute_masked`` (and ``execute`` compacts
+    each batch)."""
 
     children: Tuple[object, ...] = ()  # TpuExec or HostToDevice
+
+    #: set by mask-producing execs that implement execute_masked directly
+    produces_masked = False
 
     def __init__(self):
         self.metrics = {}
@@ -35,7 +54,13 @@ class TpuExec:
         raise NotImplementedError
 
     def execute(self) -> Iterator[DeviceTable]:
-        raise NotImplementedError
+        if not self.produces_masked:
+            raise NotImplementedError
+        for b in self.execute_masked():
+            yield b.compacted()
+
+    def execute_masked(self) -> Iterator[DeviceTable]:
+        return self.execute()
 
     @property
     def name(self):

@@ -7,6 +7,7 @@ from __future__ import annotations
 from typing import Iterator, List, Sequence
 
 import jax
+from spark_rapids_tpu.dispatch import tpu_jit
 import jax.numpy as jnp
 import numpy as np
 
@@ -129,15 +130,22 @@ class TpuProjectExec(TpuExec):
     def output_schema(self):
         return [(n, e.data_type) for n, e in zip(self.names, self.exprs)]
 
-    def execute(self):
+    produces_masked = True
+
+    def execute_masked(self):
+        from spark_rapids_tpu.ops.expr import has_position_dependent
         from spark_rapids_tpu.runtime.retry import with_retry
         exprs, names = self.exprs, self.names
+        pos_dep = any(has_position_dependent(e) for e in exprs)
 
         def run(dt):
+            if pos_dep:
+                dt = dt.compacted()  # slot ids must match the prefix form
             cols = compile_project(exprs, dt)
-            return DeviceTable(names, cols, dt.nrows_dev, dt.capacity)
+            return DeviceTable(names, cols, dt.nrows_dev, dt.capacity,
+                               live=dt.live)
 
-        for batch in self.children[0].execute():
+        for batch in self.children[0].execute_masked():
             yield from with_retry(batch, run)
 
     def describe(self):
@@ -154,46 +162,65 @@ class _FilterKernel:
     def __init__(self, condition: Expression):
         self.condition = condition
 
-    def __call__(self, table: DeviceTable):
-        from spark_rapids_tpu.ops.expr import shared_traces
+    def __call__(self, table: DeviceTable, emit_mask: bool = False):
+        """``emit_mask=True`` returns a MASKED table (keep-mask + count, no
+        compaction scatter — columnar/table.py DeviceTable.live); otherwise
+        the classic compacting filter. Masked INPUT is consumed either
+        way (the predicate ANDs with the input's liveness)."""
+        from spark_rapids_tpu.ops.expr import has_position_dependent, shared_traces
+        if table.live is not None and has_position_dependent(self.condition):
+            table = table.compacted()  # slot ids must match prefix form
         pctx = PrepCtx(table)
         preps: List[NodePrep] = []
         _walk_prep(self.condition, pctx, preps)
         cols = tuple(DevVal(c.data, c.validity) for c in table.columns)
-        aux = tuple(jnp.asarray(a) for a in pctx.aux_arrays)
+        from spark_rapids_tpu.dispatch import prep_aux
+        aux = prep_aux(pctx)
         capacity = table.capacity
+        has_mask = table.live is not None
 
         self._traces = shared_traces(
             ("filter", self.condition.key(), table.schema_key()[0]))
-        tkey = (capacity, _prep_trace_key(preps))
+        tkey = (capacity, emit_mask, has_mask, _prep_trace_key(preps))
         fn = self._traces.get(tkey)
         if fn is None:
             cond = self.condition
 
-            def run(cols, aux, nrows):
-                ctx = EvalCtx(cols, aux, nrows, capacity)
+            def run(cols, aux, nrows, live_in):
+                ctx = EvalCtx(cols, aux, nrows, capacity, live=live_in)
                 ctx._prep_iter = iter(preps)
                 pred = _walk_eval(cond, ctx)
-                live = jnp.arange(capacity, dtype=jnp.int32) < nrows
+                if live_in is not None:
+                    live = live_in
+                else:
+                    live = jnp.arange(capacity, dtype=jnp.int32) < nrows
                 keep = pred.data & pred.validity & live
+                new_n = jnp.sum(keep.astype(jnp.int32))
+                if emit_mask:
+                    return keep, new_n
                 pos = jnp.cumsum(keep.astype(jnp.int32)) - 1
                 tgt = jnp.where(keep, pos, capacity)
-                new_n = jnp.sum(keep.astype(jnp.int32))
                 from spark_rapids_tpu.ops.scatter32 import scatter_pair
                 outs = []
                 for data, validity in cols:
                     outs.append(scatter_pair(capacity, tgt, data, validity))
                 return outs, new_n
 
-            fn = jax.jit(run)
+            fn = tpu_jit(run)
             self._traces[tkey] = fn
 
-        outs, new_n = fn(cols, aux, table.nrows_dev)
+        if emit_mask:
+            keep, new_n = fn(cols, aux, table.nrows_dev, table.live)
+            return DeviceTable(table.names, table.columns, new_n, capacity,
+                               live=keep)
+        outs, new_n = fn(cols, aux, table.nrows_dev, table.live)
         new_cols = [c.with_arrays(d, v) for c, (d, v) in zip(table.columns, outs)]
         return DeviceTable(table.names, new_cols, new_n, capacity)
 
 
 class TpuFilterExec(TpuExec):
+    produces_masked = True
+
     def __init__(self, child: TpuExec, condition: Expression):
         super().__init__()
         self.children = (child,)
@@ -203,10 +230,13 @@ class TpuFilterExec(TpuExec):
     def output_schema(self):
         return self.children[0].output_schema()
 
-    def execute(self):
+    def execute_masked(self):
+        from spark_rapids_tpu.execs.base import MASKED_ENABLED
         from spark_rapids_tpu.runtime.retry import with_retry
-        for batch in self.children[0].execute():
-            yield from with_retry(batch, self._kernel)
+        emit = MASKED_ENABLED.get()
+        for batch in self.children[0].execute_masked():
+            yield from with_retry(
+                batch, lambda b: self._kernel(b, emit_mask=emit))
 
     def describe(self):
         return f"TpuFilter[{self.condition!r}]"
@@ -241,6 +271,8 @@ class TpuLimitExec(TpuExec):
 
 
 class TpuUnionExec(TpuExec):
+    produces_masked = True
+
     def __init__(self, children: Sequence[TpuExec]):
         super().__init__()
         self.children = tuple(children)
@@ -248,9 +280,9 @@ class TpuUnionExec(TpuExec):
     def output_schema(self):
         return self.children[0].output_schema()
 
-    def execute(self):
+    def execute_masked(self):
         for c in self.children:
-            yield from c.execute()
+            yield from c.execute_masked()
 
 
 class TpuExpandExec(TpuExec):
@@ -267,11 +299,19 @@ class TpuExpandExec(TpuExec):
     def output_schema(self):
         return [(n, e.data_type) for n, e in zip(self.names, self.projections[0])]
 
-    def execute(self):
-        for batch in self.children[0].execute():
+    produces_masked = True
+
+    def execute_masked(self):
+        from spark_rapids_tpu.ops.expr import has_position_dependent
+        pos_dep = any(has_position_dependent(e)
+                      for proj in self.projections for e in proj)
+        for batch in self.children[0].execute_masked():
+            if pos_dep:
+                batch = batch.compacted()
             for proj in self.projections:
                 cols = compile_project(proj, batch)
-                yield DeviceTable(self.names, cols, batch.nrows_dev, batch.capacity)
+                yield DeviceTable(self.names, cols, batch.nrows_dev,
+                                  batch.capacity, live=batch.live)
 
 
 class TpuCoalesceExec(TpuExec):
@@ -294,14 +334,16 @@ class TpuCoalesceExec(TpuExec):
     def output_schema(self):
         return self.children[0].output_schema()
 
-    def execute(self):
+    produces_masked = True
+
+    def execute_masked(self):
         from spark_rapids_tpu.runtime.spill import BufferCatalog, SpillableBatch
 
         catalog = BufferCatalog.get()
         pending: List[SpillableBatch] = []
         pending_bytes = 0
         try:
-            for batch in self.children[0].execute():
+            for batch in self.children[0].execute_masked():
                 pending_bytes += batch.device_nbytes()
                 # buffered batches are spillable while more input streams in
                 # (reference: coalesce inputs are SpillableColumnarBatches)
@@ -403,6 +445,6 @@ def _compaction_kernel(capacity: int, schema_key):
                 outs.append(scatter_pair(capacity, tgt, d, v))
             return outs, new_n
 
-        fn = jax.jit(run)
+        fn = tpu_jit(run)
         _COMPACT_KERNELS[key] = fn
     return fn

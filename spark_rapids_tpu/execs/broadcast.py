@@ -17,6 +17,7 @@ from __future__ import annotations
 from typing import List, Optional, Sequence
 
 import jax
+from spark_rapids_tpu.dispatch import tpu_jit
 import jax.numpy as jnp
 import numpy as np
 
@@ -207,13 +208,14 @@ class TpuNestedLoopJoinExec(TpuExec):
         traces = shared_traces(("nlj-traces",))
         fn = traces.get(tkey)
         if fn is None:
-            fn = jax.jit(self._build_tile_kernel(
+            fn = tpu_jit(self._build_tile_kernel(
                 jt, swapped, cap_p, cap_b, preps))
             traces[tkey] = fn
 
         lcols = tuple((c.data, c.validity) for c in lt.columns)
         rcols = tuple((c.data, c.validity) for c in rt.columns)
-        aux = tuple(jnp.asarray(a) for a in pair_pctx.aux_arrays)
+        from spark_rapids_tpu.dispatch import prep_aux
+        aux = prep_aux(pair_pctx)
         res = fn(lcols, rcols, aux, pt.nrows_dev, bt.nrows_dev)
 
         outs = []

@@ -14,6 +14,7 @@ from __future__ import annotations
 from typing import List, Sequence
 
 import jax
+from spark_rapids_tpu.dispatch import tpu_jit
 import jax.numpy as jnp
 
 from spark_rapids_tpu import types as T
@@ -77,7 +78,8 @@ class TpuGenerateExec(TpuExec):
         _walk_prep(self.gen_child, pctx, preps)
         gen_cols = tuple(DevVal(c.data, c.validity) for c in full.columns)
         cols = tuple(DevVal(c.data, c.validity) for c in table.columns)
-        aux = tuple(jnp.asarray(a) for a in pctx.aux_arrays)
+        from spark_rapids_tpu.dispatch import prep_aux
+        aux = prep_aux(pctx)
         cap = table.capacity
 
         # element capacity comes from the evaluated array column; for a
@@ -103,7 +105,7 @@ class TpuGenerateExec(TpuExec):
                 table.schema_key()[0])
         fn = traces.get(tkey)
         if fn is None:
-            fn = jax.jit(self._build_kernel(cap, ecap, out_cap, preps))
+            fn = tpu_jit(self._build_kernel(cap, ecap, out_cap, preps))
             traces[tkey] = fn
         out_arrays, nout = fn(gen_cols, cols, aux, table.nrows_dev)
 

@@ -27,6 +27,7 @@ import contextvars
 from typing import List, Optional, Sequence, Tuple
 
 import jax
+from spark_rapids_tpu.dispatch import tpu_jit
 import jax.numpy as jnp
 import numpy as np
 
@@ -96,22 +97,25 @@ class JoinKernel:
 
     # -- phase A: shared code space + probe ranges --------------------------
     def probe(self, lkeys: List[DevVal], rkeys, nl_dev, nr_dev,
-              cap_l: int, cap_r: int):
-        tkey = (cap_l, cap_r,
+              cap_l: int, cap_r: int, live_l_mask=None):
+        tkey = (cap_l, cap_r, live_l_mask is not None,
                 tuple(str(k[0].dtype) for k in lkeys),
                 tuple(str(k[0].dtype) for k in rkeys))
         fn = self._probe_traces.get(tkey)
         if fn is None:
-            fn = jax.jit(self._build_probe(cap_l, cap_r))
+            fn = tpu_jit(self._build_probe(cap_l, cap_r))
             self._probe_traces[tkey] = fn
-        return fn(tuple(lkeys), tuple(rkeys), nl_dev, nr_dev)
+        return fn(tuple(lkeys), tuple(rkeys), nl_dev, nr_dev, live_l_mask)
 
     def _build_probe(self, cap_l: int, cap_r: int):
         n_keys = self.n_keys
 
-        def probe(lkeys, rkeys, nl, nr):
+        def probe(lkeys, rkeys, nl, nr, live_l_mask):
             n = cap_l + cap_r
-            live_l = jnp.arange(cap_l, dtype=jnp.int32) < nl
+            if live_l_mask is not None:  # masked probe batch
+                live_l = live_l_mask
+            else:
+                live_l = jnp.arange(cap_l, dtype=jnp.int32) < nl
             live_r = jnp.arange(cap_r, dtype=jnp.int32) < nr
 
             valid_l = live_l
@@ -169,7 +173,7 @@ class JoinKernel:
         tkey = (kind, out_cap, cap_l, cap_r)
         fn = self._gather_traces.get(tkey)
         if fn is None:
-            fn = jax.jit(self._build_expand(kind, out_cap, cap_l))
+            fn = tpu_jit(self._build_expand(kind, out_cap, cap_l))
             self._gather_traces[tkey] = fn
         return fn(*args)
 
@@ -256,26 +260,35 @@ class _DirectJoinKernel:
 
     @classmethod
     def run(cls, jt: str, lt: DeviceTable, rt: DeviceTable,
-            lkey: DevVal, rkey: DevVal, H: int):
+            lkey: DevVal, rkey: DevVal, H: int, masked_out: bool):
         """Returns ([(data, validity)...] for left cols [+ right cols],
-        nout_dev, fail_dev)."""
-        key = (jt, H, lt.capacity, rt.capacity,
+        live_out_or_None, nout_dev, fail_dev). With ``masked_out`` the
+        output stays IN PLACE (live rows marked by the returned mask — no
+        compaction scatter at all, columnar/table.py DeviceTable.live);
+        otherwise inner/semi/anti compact as before."""
+        key = (jt, H, lt.capacity, rt.capacity, masked_out,
+               lt.live is not None,
                lt.schema_key()[0], rt.schema_key()[0],
                str(lkey[0].dtype), str(rkey[0].dtype))
         fn = cls._traces.get(key)
         if fn is None:
-            fn = jax.jit(cls._build(jt, H, lt.capacity, rt.capacity))
+            fn = tpu_jit(cls._build(jt, H, lt.capacity, rt.capacity,
+                                    masked_out))
             cls._traces[key] = fn
         l_cols = tuple((c.data, c.validity) for c in lt.columns)
         r_cols = tuple((c.data, c.validity) for c in rt.columns)
-        return fn(l_cols, lkey, r_cols, rkey, lt.nrows_dev, rt.nrows_dev)
+        return fn(l_cols, lkey, r_cols, rkey, lt.nrows_dev, rt.nrows_dev,
+                  lt.live)
 
     @staticmethod
-    def _build(jt: str, H: int, cap_l: int, cap_r: int):
-        def kernel(l_cols, lk, r_cols, rk, nl, nr):
+    def _build(jt: str, H: int, cap_l: int, cap_r: int, masked_out: bool):
+        def kernel(l_cols, lk, r_cols, rk, nl, nr, live_l_mask):
             ld, lv = lk
             rd, rv = rk
-            live_l = jnp.arange(cap_l, dtype=jnp.int32) < nl
+            if live_l_mask is not None:
+                live_l = live_l_mask
+            else:
+                live_l = jnp.arange(cap_l, dtype=jnp.int32) < nl
             live_r = jnp.arange(cap_r, dtype=jnp.int32) < nr
             vl = lv & live_l
             vr = rv & live_r
@@ -307,16 +320,25 @@ class _DirectJoinKernel:
                 outs = list(l_cols)
                 for d, v in r_cols:
                     outs.append((d[safe_ri], v[safe_ri] & matched))
-                return tuple(outs), nl, fail
+                nl_out = (jnp.sum(live_l.astype(jnp.int32))
+                          if live_l_mask is not None else nl)
+                return tuple(outs), live_l_mask, nl_out, fail
 
             if jt in ("leftsemi", "leftanti"):
                 keep = matched if jt == "leftsemi" else (live_l & ~matched)
             else:  # inner
                 keep = matched
+            nout = jnp.sum(keep.astype(jnp.int32))
+            if masked_out:
+                # deferred compaction: rows stay in place, keep is the mask
+                outs = list(l_cols)
+                if jt == "inner":
+                    for d, v in r_cols:
+                        outs.append((d[safe_ri], v[safe_ri] & matched))
+                return tuple(outs), keep, nout, fail
             from spark_rapids_tpu.ops.scatter32 import scatter_pair
             cpos = jnp.cumsum(keep.astype(jnp.int32)) - 1
             tgt = jnp.where(keep, cpos, cap_l)
-            nout = jnp.sum(keep.astype(jnp.int32))
             outs = []
             for d, v in l_cols:
                 outs.append(scatter_pair(cap_l, tgt, d, v))
@@ -324,7 +346,7 @@ class _DirectJoinKernel:
                 for d, v in r_cols:
                     outs.append(scatter_pair(cap_l, tgt, d[safe_ri],
                                              v[safe_ri] & matched))
-            return tuple(outs), nout, fail
+            return tuple(outs), None, nout, fail
 
         return kernel
 
@@ -348,7 +370,7 @@ class _ColumnGather:
                     out.append((d[safe], v[safe] & ~null_mask & out_live))
                 return out
 
-            fn = jax.jit(gather)
+            fn = tpu_jit(gather)
             cls._traces[key] = fn
         datas = tuple(c.data for c in table.columns)
         valids = tuple(c.validity for c in table.columns)
@@ -366,8 +388,9 @@ def _unify_string_keys(lcol: DeviceColumn, rcol: DeviceColumn):
     union = np.unique(np.concatenate([ldict.astype(object), rdict.astype(object)]))
     lmap = np.searchsorted(union, ldict).astype(np.int32)
     rmap = np.searchsorted(union, rdict).astype(np.int32)
-    lmap_d = jnp.asarray(lmap if len(lmap) else np.zeros(1, np.int32))
-    rmap_d = jnp.asarray(rmap if len(rmap) else np.zeros(1, np.int32))
+    from spark_rapids_tpu.dispatch import device_const
+    lmap_d = device_const(lmap if len(lmap) else np.zeros(1, np.int32))
+    rmap_d = device_const(rmap if len(rmap) else np.zeros(1, np.int32))
     lcodes = lmap_d[jnp.clip(lcol.data, 0, max(len(ldict) - 1, 0))]
     rcodes = rmap_d[jnp.clip(rcol.data, 0, max(len(rdict) - 1, 0))]
     return (lcodes, lcol.validity), (rcodes, rcol.validity)
@@ -411,13 +434,17 @@ class TpuJoinExec(TpuExec):
         return f"TpuJoin[{self.join_type}, keys={len(self.left_keys)}]"
 
     # -----------------------------------------------------------------------
-    def execute(self):
+    produces_masked = True
+
+    def execute_masked(self):
         """Probe-side STREAMING execution: the build side is one coalesced
         (spillable-protected) table; probe batches stream through one at a
         time — the reference's join iterator shape (GpuShuffledHashJoinExec
         streams the streamed side against the built hash table). Full-outer
         joins accumulate a build-side match bitmap across probe batches and
-        emit unmatched build rows as a final batch."""
+        emit unmatched build rows as a final batch. Probe batches may be
+        MASKED (filter output) and direct-join outputs stay masked —
+        liveness rides a device mask instead of a compaction scatter."""
         from spark_rapids_tpu.runtime.retry import retry_block
 
         jt = self.join_type
@@ -439,7 +466,7 @@ class TpuJoinExec(TpuExec):
 
         full_outer = jt in ("full", "fullouter", "outer")
         r_matched_accum = None
-        for pb in probe_child.execute():
+        for pb in probe_child.execute_masked():
             out, r_matched = retry_block(
                 lambda b=pb: self._join_batch(b, build, swapped))
             if full_outer:
@@ -479,7 +506,7 @@ class TpuJoinExec(TpuExec):
         self.add_metric("subPartitions", nparts)
         r_matched = [None] * nparts
         try:
-            for pb in probe_child.execute():
+            for pb in probe_child.execute_masked():
                 for p, pp in enumerate(self._split(pb, pparter)):
                     with build_parts[p].pinned_batch() as bt:
                         out, rm = retry_block(
@@ -514,7 +541,7 @@ class TpuJoinExec(TpuExec):
                 return jax.ops.segment_sum(
                     live.astype(jnp.int32), jnp.clip(pids, 0, nparts - 1),
                     num_segments=nparts)
-            fn = jax.jit(counts_fn)
+            fn = tpu_jit(counts_fn)
             self._kernel._aux_traces[key] = fn
         counts = np.asarray(jax.device_get(fn(pids, live)))
         parts = []
@@ -532,10 +559,12 @@ class TpuJoinExec(TpuExec):
 
     def _apply_condition(self, out: DeviceTable) -> DeviceTable:
         if self.condition is not None and self.join_type in ("inner", "cross"):
+            from spark_rapids_tpu.execs.base import MASKED_ENABLED
             from spark_rapids_tpu.execs.basic import _FilterKernel
             if self._filter_kernel is None:
                 self._filter_kernel = _FilterKernel(self.condition)
-            out = self._filter_kernel(out)
+            out = self._filter_kernel(out,
+                                      emit_mask=MASKED_ENABLED.get())
         return out
 
     @staticmethod
@@ -578,7 +607,7 @@ class TpuJoinExec(TpuExec):
 
         (lo, counts, total_d, matched_l, rs_perm, live_l, live_r) = \
             self._kernel.probe(lkeys, rkeys, lt.nrows_dev, rt.nrows_dev,
-                               lt.capacity, rt.capacity)
+                               lt.capacity, rt.capacity, lt.live)
 
         r_matched = None
         if full_outer:
@@ -586,8 +615,14 @@ class TpuJoinExec(TpuExec):
                                             lt.capacity)
 
         if jt in ("leftsemi", "leftanti"):
+            from spark_rapids_tpu.execs.base import MASKED_ENABLED
             keep = matched_l if jt == "leftsemi" else ~matched_l
-            return self._compact(lt, keep & live_l), None
+            keep = keep & live_l
+            if MASKED_ENABLED.get():
+                nkeep = self._mask_count(keep)
+                return DeviceTable(lt.names, lt.columns, nkeep,
+                                   lt.capacity, live=keep), None
+            return self._compact(lt, keep), None
 
         from spark_rapids_tpu.runtime import speculation as spec
         size_site = self._site_key + ":size"
@@ -644,7 +679,7 @@ class TpuJoinExec(TpuExec):
                         (live_l & (counts == 0)).astype(jnp.int64))
                 return tot > out_cap
 
-            fn = jax.jit(flag)
+            fn = tpu_jit(flag)
             self._kernel._aux_traces[key] = fn
         return fn(total_d, counts, live_l)
 
@@ -664,15 +699,18 @@ class TpuJoinExec(TpuExec):
         ctx = spec.allowed(site)
         if ctx is None:
             return None
+        from spark_rapids_tpu.execs.base import MASKED_ENABLED
+        masked_out = MASKED_ENABLED.get()
         H = bucket_for(max(DIRECT_TABLE_MULT.get() * rt.capacity, 1))
-        outs, nout, fail = _DirectJoinKernel.run(jt, lt, rt, lkeys[0],
-                                                 rkeys[0], H)
+        outs, live_out, nout, fail = _DirectJoinKernel.run(
+            jt, lt, rt, lkeys[0], rkeys[0], H, masked_out)
         ctx.add_flag(site, fail)
         self.add_metric("directJoinBatches", 1)
         if jt in ("leftsemi", "leftanti"):
             cols = [c.with_arrays(d, v)
                     for c, (d, v) in zip(lt.columns, outs)]
-            return DeviceTable(lt.names, cols, nout, lt.capacity)
+            return DeviceTable(lt.names, cols, nout, lt.capacity,
+                               live=live_out)
         lcols = [c.with_arrays(d, v)
                  for c, (d, v) in zip(lt.columns, outs[:len(lt.columns)])]
         rcols = []
@@ -681,7 +719,7 @@ class TpuJoinExec(TpuExec):
                                       dict_sorted=c.dict_sorted))
         names = self.left_names + self.right_names
         cols = rcols + lcols if swapped else lcols + rcols
-        return DeviceTable(names, cols, nout, lt.capacity)
+        return DeviceTable(names, cols, nout, lt.capacity, live=live_out)
 
     def _unmatched_build_batch(self, rt: DeviceTable, r_matched,
                                swapped: bool) -> DeviceTable:
@@ -722,9 +760,17 @@ class TpuJoinExec(TpuExec):
                 marks = marks.at[ends].add(jnp.where(counts > 0, -1, 0), mode="drop")
                 covered_sorted = jnp.cumsum(marks[:-1]) > 0
                 return jnp.zeros(cap_r, jnp.bool_).at[rs_perm].set(covered_sorted)
-            fn = jax.jit(rmatch)
+            fn = tpu_jit(rmatch)
             self._kernel._aux_traces[key] = fn
         return fn(lo, counts, rs_perm)
+
+    def _mask_count(self, keep):
+        key = ("maskcount", keep.shape[0])
+        fn = self._kernel._aux_traces.get(key)
+        if fn is None:
+            fn = tpu_jit(lambda k: jnp.sum(k.astype(jnp.int32)))
+            self._kernel._aux_traces[key] = fn
+        return fn(keep)
 
     def _compact(self, table: DeviceTable, keep) -> DeviceTable:
         """Semi/anti: compact kept rows (static capacity, like the filter
@@ -744,7 +790,7 @@ class TpuJoinExec(TpuExec):
                     outs.append(scatter_pair(cap, tgt, d, v))
                 return outs, new_n
 
-            fn = jax.jit(compact)
+            fn = tpu_jit(compact)
             self._kernel._aux_traces[key] = fn
         datas = tuple(c.data for c in table.columns)
         valids = tuple(c.validity for c in table.columns)
@@ -754,6 +800,7 @@ class TpuJoinExec(TpuExec):
 
     def _cross(self, lt: DeviceTable, rt: DeviceTable,
                swapped: bool = False) -> DeviceTable:
+        lt = lt.compacted()  # tiling needs the prefix invariant
         nl, nr = lt.num_rows, rt.num_rows
         out_cap = bucket_for(max(nl * nr, 1))
         key = ("cross", out_cap, lt.capacity, rt.capacity)
@@ -766,7 +813,7 @@ class TpuJoinExec(TpuExec):
                 ri = j % nr64
                 out_live = j < nl_d.astype(jnp.int64) * nr_d.astype(jnp.int64)
                 return li, ri, out_live
-            fn = jax.jit(cross_maps)
+            fn = tpu_jit(cross_maps)
             self._kernel._aux_traces[key] = fn
         li, ri, out_live = fn(lt.nrows_dev, rt.nrows_dev)
         zero = jnp.zeros(out_cap, jnp.bool_)

@@ -12,6 +12,7 @@ from __future__ import annotations
 from typing import List, Sequence
 
 import jax
+from spark_rapids_tpu.dispatch import tpu_jit
 import jax.numpy as jnp
 import numpy as np
 
@@ -69,7 +70,7 @@ class TpuSortExec(TpuExec):
         from spark_rapids_tpu.runtime.retry import retry_block
         from spark_rapids_tpu.runtime.spill import BufferCatalog, SpillableBatch
 
-        it = self.children[0].execute()
+        it = self.children[0].execute_masked()
         first = next(it, None)
         if first is None:
             return
@@ -96,8 +97,14 @@ class TpuSortExec(TpuExec):
             for sb in pending:
                 sb.release()
 
+    def _pos_dep(self) -> bool:
+        from spark_rapids_tpu.ops.expr import has_position_dependent
+        return any(has_position_dependent(o.expr) for o in self.orders)
+
     def _sort(self, table: DeviceTable) -> DeviceTable:
         from spark_rapids_tpu.ops.expr import shared_traces
+        if table.live is not None and self._pos_dep():
+            table = table.compacted()  # slot ids must match prefix form
         self._traces = shared_traces(
             ("sort",
              tuple((o.expr.key(), o.ascending, o.resolved_nulls_first())
@@ -109,20 +116,28 @@ class TpuSortExec(TpuExec):
             preps: List[NodePrep] = []
             _walk_prep(o.expr, pctx, preps)
             key_preps.append(preps)
+        from spark_rapids_tpu.dispatch import prep_aux
         cols = tuple(DevVal(c.data, c.validity) for c in table.columns)
-        aux = tuple(jnp.asarray(a) for a in pctx.aux_arrays)
+        aux = prep_aux(pctx)
         capacity = table.capacity
 
-        tkey = (capacity, tuple(_prep_trace_key(p) for p in key_preps))
+        has_mask = table.live is not None
+        tkey = (capacity, has_mask,
+                tuple(_prep_trace_key(p) for p in key_preps))
         fn = self._traces.get(tkey)
         if fn is None:
             orders = self.orders
 
-            def run(cols, aux, nrows):
-                live = jnp.arange(capacity, dtype=jnp.int32) < nrows
+            def run(cols, aux, nrows, live_in):
+                # masked input: dead rows park last via the liveness
+                # operand, so the sort doubles as the deferred compaction
+                if live_in is not None:
+                    live = live_in
+                else:
+                    live = jnp.arange(capacity, dtype=jnp.int32) < nrows
                 operands = [(~live).astype(jnp.int32)]  # padding last
                 for o, preps in zip(orders, key_preps):
-                    ctx = EvalCtx(cols, aux, nrows, capacity)
+                    ctx = EvalCtx(cols, aux, nrows, capacity, live=live_in)
                     ctx._prep_iter = iter(preps)
                     kv = _walk_eval(o.expr, ctx)
                     operands.extend(_directional(kv.data, kv.validity, o.ascending,
@@ -132,12 +147,80 @@ class TpuSortExec(TpuExec):
                 perm = res[-1]
                 return [(d[perm], v[perm]) for d, v in cols]
 
-            fn = jax.jit(run)
+            fn = tpu_jit(run)
             self._traces[tkey] = fn
 
-        outs = fn(cols, aux, table.nrows_dev)
+        outs = fn(cols, aux, table.nrows_dev, table.live)
         new_cols = [c.with_arrays(d, v) for c, (d, v) in zip(table.columns, outs)]
         return DeviceTable(table.names, new_cols, table.nrows_dev, capacity)
+
+    def _topk(self, table: DeviceTable, k: int) -> DeviceTable:
+        """Top-k rows by sort order at a k-sized capacity: sort ONLY the
+        key operands + a row-index payload, then gather the k winning rows
+        of every column. The reference's per-batch top-k sorts then slices
+        (GpuTakeOrderedAndProjectExec), but on TPU a full-width gather at
+        input capacity costs ~10-30ms per 64-bit column (PERF.md) — this
+        does O(k) gather work instead and emits a small-capacity batch,
+        which also shrinks every downstream kernel."""
+        from spark_rapids_tpu.columnar import bucket_for
+        from spark_rapids_tpu.ops.expr import shared_traces
+        if table.live is not None and self._pos_dep():
+            table = table.compacted()  # slot ids must match prefix form
+        capacity = table.capacity
+        kcap = min(bucket_for(max(k, 1)), capacity)
+        self._traces = shared_traces(
+            ("topk", kcap,
+             tuple((o.expr.key(), o.ascending, o.resolved_nulls_first())
+                   for o in self.orders),
+             table.schema_key()[0]))
+        pctx = PrepCtx(table)
+        key_preps: List[List[NodePrep]] = []
+        for o in self.orders:
+            preps: List[NodePrep] = []
+            _walk_prep(o.expr, pctx, preps)
+            key_preps.append(preps)
+        from spark_rapids_tpu.dispatch import prep_aux
+        cols = tuple(DevVal(c.data, c.validity) for c in table.columns)
+        aux = prep_aux(pctx)
+        has_mask = table.live is not None
+        tkey = (capacity, has_mask, k,
+                tuple(_prep_trace_key(p) for p in key_preps))
+        fn = self._traces.get(tkey)
+        if fn is None:
+            orders = self.orders
+
+            def run(cols, aux, nrows, live_in):
+                if live_in is not None:
+                    live = live_in
+                    n_live = jnp.sum(live.astype(jnp.int32))
+                else:
+                    live = jnp.arange(capacity, dtype=jnp.int32) < nrows
+                    n_live = nrows
+                operands = [(~live).astype(jnp.int32)]  # dead rows last
+                for o, preps in zip(orders, key_preps):
+                    ctx = EvalCtx(cols, aux, nrows, capacity, live=live_in)
+                    ctx._prep_iter = iter(preps)
+                    kv = _walk_eval(o.expr, ctx)
+                    operands.extend(_directional(
+                        kv.data, kv.validity, o.ascending,
+                        o.resolved_nulls_first(), capacity))
+                payload = jnp.arange(capacity, dtype=jnp.int32)
+                res = jax.lax.sort(operands + [payload],
+                                   num_keys=len(operands))
+                idx = res[-1][:kcap]
+                n_out = jnp.minimum(n_live, jnp.asarray(k, jnp.int32))
+                out_live = jnp.arange(kcap, dtype=jnp.int32) < n_out
+                outs = []
+                for d, v in cols:
+                    outs.append((d[idx], v[idx] & out_live))
+                return outs, n_out
+
+            fn = tpu_jit(run)
+            self._traces[tkey] = fn
+        outs, n_out = fn(cols, aux, table.nrows_dev, table.live)
+        new_cols = [c.with_arrays(d, v)
+                    for c, (d, v) in zip(table.columns, outs)]
+        return DeviceTable(table.names, new_cols, n_out, kcap)
 
     def describe(self):
         return f"TpuSort[{len(self.orders)} keys]"
@@ -176,19 +259,19 @@ class TpuTakeOrderedAndProjectExec(TpuExec):
 
         k = self.limit
         tops = []
-        for batch in self.children[0].execute():
-            srt = retry_block(lambda b=batch: self._sorter._sort(b))
-            cap = min(bucket_for(max(k, 1)), srt.capacity)
-            cols = [c.sliced_rows(cap) for c in srt.columns]
-            nrows = jnp.minimum(srt.nrows_dev, jnp.int32(k))
-            tops.append(DeviceTable(srt.names, cols, nrows, cap))
+        for batch in self.children[0].execute_masked():
+            tops.append(retry_block(lambda b=batch: self._sorter._topk(b, k)))
 
         if not tops:
             return
         merged = tops[0] if len(tops) == 1 else retry_block(
             lambda: concat_device(tops))
-        final = retry_block(lambda: self._sorter._sort(merged))
-        nrows = jnp.minimum(final.nrows_dev, jnp.int32(k))
+        if len(tops) == 1:
+            final = merged  # a single _topk batch is already sorted
+        else:
+            final = retry_block(lambda: self._sorter._sort(merged))
+        from spark_rapids_tpu.dispatch import device_scalar
+        nrows = jnp.minimum(final.nrows_dev, device_scalar(k))
         out = DeviceTable(final.names, final.columns, nrows, final.capacity)
         if self.project is not None:
             cols = compile_project(self.project, out)

@@ -25,6 +25,7 @@ from __future__ import annotations
 from typing import List, Sequence, Tuple
 
 import jax
+from spark_rapids_tpu.dispatch import tpu_jit
 import jax.numpy as jnp
 import numpy as np
 
@@ -161,8 +162,9 @@ class TpuWindowExec(TpuExec):
             vp = self._prep_value(w, pctx)
             expr_preps.append((pp, op, vp))
 
+        from spark_rapids_tpu.dispatch import prep_aux
         cols = tuple(DevVal(c.data, c.validity) for c in table.columns)
-        aux = tuple(jnp.asarray(a) for a in pctx.aux_arrays)
+        aux = prep_aux(pctx)
         capacity = table.capacity
 
         from spark_rapids_tpu.ops.expr import shared_traces
@@ -176,7 +178,7 @@ class TpuWindowExec(TpuExec):
             for pp, op, vp in expr_preps))
         fn = self._traces.get(tkey)
         if fn is None:
-            fn = jax.jit(self._build_kernel(capacity, expr_preps))
+            fn = tpu_jit(self._build_kernel(capacity, expr_preps))
             self._traces[tkey] = fn
         col_outs, win_outs = fn(cols, aux, table.nrows_dev)
 

@@ -81,6 +81,11 @@ class _TeeChild:
                 pickle.dump(host, f)
             yield batch
 
+    def execute_masked(self):
+        # the dump must record the batch as consumers would see it, so the
+        # tee compacts (execute); masked passthrough would skip the dump
+        return self.execute()
+
     def describe(self):
         return f"LoreDump[{self.inner.describe()}]"
 

@@ -6,6 +6,7 @@ from dataclasses import dataclass, field
 from typing import Iterator, List, NamedTuple, Optional, Sequence, Tuple
 
 import jax
+from spark_rapids_tpu.dispatch import tpu_jit
 import jax.numpy as jnp
 import numpy as np
 
@@ -37,8 +38,9 @@ class PrepCtx:
     def __init__(self, table: DeviceTable):
         self.table = table
         self.aux_arrays: List[np.ndarray] = []
+        self.aux_intern: List[bool] = []
 
-    def add_aux(self, arr: np.ndarray) -> int:
+    def add_aux(self, arr: np.ndarray, intern: bool = True) -> int:
         """Register a host array as a device input, padded (on the leading
         dim) to a bucket so that compiled programs are shared across batches
         with different dictionary sizes."""
@@ -49,24 +51,30 @@ class PrepCtx:
             padded[:n] = arr
             arr = padded
         self.aux_arrays.append(arr)
+        self.aux_intern.append(intern)
         return len(self.aux_arrays) - 1
 
 
 class EvalCtx:
-    """Traced-side context handed to eval_dev."""
+    """Traced-side context handed to eval_dev. ``live`` carries a masked
+    batch's liveness (DeviceTable.live); row-position semantics stay
+    slot-based either way."""
 
     def __init__(self, cols: Sequence[DevVal], aux: Sequence[jax.Array],
-                 nrows: jax.Array, capacity: int):
+                 nrows: jax.Array, capacity: int, live=None):
         self.cols = tuple(cols)
         self.aux = tuple(aux)
         self.nrows = nrows
         self.capacity = capacity
+        self.live = live
         self._prep_iter: Optional[Iterator[NodePrep]] = None
 
     def next_prep(self) -> NodePrep:
         return next(self._prep_iter)  # type: ignore[arg-type]
 
     def row_mask(self) -> jax.Array:
+        if self.live is not None:
+            return self.live
         return jnp.arange(self.capacity, dtype=jnp.int32) < self.nrows
 
 
@@ -75,6 +83,11 @@ class Expression:
     evaluation paths. Expressions are immutable; ``with_children`` rebuilds."""
 
     children: Tuple["Expression", ...] = ()
+
+    #: True for expressions whose value depends on a row's physical slot
+    #: (monotonically_increasing_id, rand): masked batches must compact
+    #: before evaluating them so slot numbering matches the prefix form
+    position_dependent = False
 
     # --- static properties -------------------------------------------------
     @property
@@ -460,21 +473,23 @@ class CompiledProject:
         self.exprs = tuple(exprs)
         self._traces = {}
 
-    def _get_traced(self, capacity: int, all_preps: List[List[NodePrep]]):
-        tkey = (capacity, tuple(_prep_trace_key(p) for p in all_preps))
+    def _get_traced(self, capacity: int, all_preps: List[List[NodePrep]],
+                    has_mask: bool):
+        tkey = (capacity, has_mask,
+                tuple(_prep_trace_key(p) for p in all_preps))
         fn = self._traces.get(tkey)
         if fn is None:
             exprs = self.exprs
 
-            def traced(cols, aux, nrows):
+            def traced(cols, aux, nrows, live):
                 outs = []
                 for e, preps in zip(exprs, all_preps):
-                    ctx = EvalCtx(cols, aux, nrows, capacity)
+                    ctx = EvalCtx(cols, aux, nrows, capacity, live=live)
                     ctx._prep_iter = iter(preps)
                     outs.append(_walk_eval(e, ctx))
                 return outs
 
-            fn = jax.jit(traced)
+            fn = tpu_jit(traced)
             self._traces[tkey] = fn
         return fn
 
@@ -486,10 +501,12 @@ class CompiledProject:
             _walk_prep(e, pctx, preps)
             all_preps.append(preps)
         col_arrays = tuple(DevVal(c.data, c.validity) for c in table.columns)
-        aux_arrays = tuple(jnp.asarray(a) for a in pctx.aux_arrays)
+        from spark_rapids_tpu.dispatch import prep_aux
+        aux_arrays = prep_aux(pctx)
 
-        fn = self._get_traced(table.capacity, all_preps)
-        out_vals = fn(col_arrays, aux_arrays, table.nrows_dev)
+        fn = self._get_traced(table.capacity, all_preps,
+                              table.live is not None)
+        out_vals = fn(col_arrays, aux_arrays, table.nrows_dev, table.live)
 
         out_cols = []
         for e, preps, dv in zip(self.exprs, all_preps, out_vals):
@@ -532,7 +549,7 @@ def cached_kernel(key: tuple, build):
     first use. ``build`` must close only over values captured by the key."""
     fn = _GLOBAL_KERNEL_CACHE.get(key)
     if fn is None:
-        fn = jax.jit(build())
+        fn = tpu_jit(build())
         _GLOBAL_KERNEL_CACHE[key] = fn
     return fn
 
@@ -550,3 +567,11 @@ def compile_project(exprs: Sequence[Expression], table: DeviceTable):
     """Evaluate bound expressions over a device table, returning device
     columns. Compilation is cached globally."""
     return _GLOBAL_PROJECT_CACHE.get(exprs, table)(table)
+
+
+def has_position_dependent(expr: "Expression") -> bool:
+    """Does any node in the tree depend on physical row position? Used to
+    force compaction before evaluating over a masked batch."""
+    if getattr(expr, "position_dependent", False):
+        return True
+    return any(has_position_dependent(c) for c in expr.children)

@@ -167,6 +167,8 @@ class MonotonicallyIncreasingID(Expression):
     offset, continuing across batches (the engine is single-partition per
     stream, so the running row offset carries the Spark shape)."""
 
+    position_dependent = True
+
     children = ()
 
     def __init__(self):
@@ -199,7 +201,8 @@ class MonotonicallyIncreasingID(Expression):
     def prep(self, pctx: PrepCtx, child_preps):
         base = self._offset["n"]
         self._offset["n"] += pctx.table.num_rows
-        slot = pctx.add_aux(np.asarray([base], dtype=np.int64))
+        slot = pctx.add_aux(np.asarray([base], dtype=np.int64),
+                            intern=False)
         return NodePrep(aux_slots=(slot,))
 
     def eval_dev(self, ctx, child_vals, prep):
@@ -245,6 +248,8 @@ class Rand(Expression):
     seeded generator (like GpuSampleExec's mask) so the device result is
     bit-identical to the CPU path; values ride as an aux array."""
 
+    position_dependent = True
+
     children = ()
 
     def __init__(self, seed: int = 0):
@@ -277,7 +282,9 @@ class Rand(Expression):
     def prep(self, pctx: PrepCtx, child_preps):
         vals = np.zeros(pctx.table.capacity)
         vals[:pctx.table.num_rows] = self._rng.random(pctx.table.num_rows)
-        slot = pctx.add_aux(vals)
+        # per-batch nondeterministic stream: interning would pin every
+        # batch's values on device forever (and never hit)
+        slot = pctx.add_aux(vals, intern=False)
         return NodePrep(aux_slots=(slot,))
 
     def eval_dev(self, ctx, child_vals, prep):

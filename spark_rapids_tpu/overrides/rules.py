@@ -694,6 +694,10 @@ def apply_overrides(plan: P.PlanNode, conf: RapidsConf):
     """GpuOverrides.apply analog: tag + CBO + convert (or explain-only)."""
     if not conf.sql_enabled:
         return plan, None
+    from spark_rapids_tpu.conf import COLUMN_PRUNING
+    if conf.get_entry(COLUMN_PRUNING):
+        from spark_rapids_tpu.overrides.pruning import prune_plan
+        plan = prune_plan(plan)
     meta = wrap_plan(plan, conf)
     from spark_rapids_tpu.overrides.optimizer import apply_cbo
     apply_cbo(meta, conf)

@@ -20,6 +20,7 @@ from __future__ import annotations
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
+from spark_rapids_tpu.dispatch import tpu_jit
 import jax.numpy as jnp
 import numpy as np
 
@@ -147,7 +148,7 @@ class MeshExchange:
                 in_specs += [P_(), P_()]  # replicated dictionary bytes
         out_specs = [P_(axis)] * (2 * ncols) + [P_(axis)]
         sm = _shard_map()
-        return jax.jit(sm(shard_fn, mesh=self.mesh,
+        return tpu_jit(sm(shard_fn, mesh=self.mesh,
                           in_specs=tuple(in_specs),
                           out_specs=tuple(out_specs)))
 
@@ -215,6 +216,6 @@ def mesh_partial_then_merge(mesh, axis_name: str = "data"):
                                 partial_out)
 
         sm = _shard_map()
-        return jax.jit(sm(wrapper, mesh=mesh,
+        return tpu_jit(sm(wrapper, mesh=mesh,
                           in_specs=P_(axis_name), out_specs=P_()))
     return build

@@ -108,6 +108,7 @@ def split_device_table_in_half(dt: DeviceTable) -> List[DeviceTable]:
         raise FatalDeviceOOM(
             "cannot row-split a batch with array columns (rebuilding "
             "offsets under OOM is unsupported; reduce batch size instead)")
+    dt = dt.compacted()  # masked batches: prefix order before row slicing
     n = dt.num_rows
     if n < 2:
         raise FatalDeviceOOM(
@@ -156,8 +157,10 @@ class DeviceMemoryEventHandler:
     def on_alloc_failure(self, catalog: Optional[BufferCatalog] = None
                          ) -> bool:
         from spark_rapids_tpu.columnar.table import evict_device_caches
+        from spark_rapids_tpu.dispatch import clear_device_constants
         catalog = catalog or self._default_catalog or BufferCatalog.get()
         evict_device_caches()
+        clear_device_constants()  # interned aux/remap arrays re-upload lazily
         freed = catalog.synchronous_spill(1 << 62)
         with self._lock:
             self.alloc_failure_count += 1

@@ -16,6 +16,7 @@ from __future__ import annotations
 from typing import List, Optional, Sequence, Tuple
 
 import jax
+from spark_rapids_tpu.dispatch import tpu_jit
 import jax.numpy as jnp
 import numpy as np
 
@@ -73,7 +74,7 @@ class HashPartitioner(Partitioner):
                 m = h % jnp.int32(n)
                 return jnp.where(m < 0, m + n, m)
 
-            fn = jax.jit(run)
+            fn = tpu_jit(run)
             self._traces[tkey] = fn
         return fn(tuple(datas), tuple(valids), string_bytes)
 
@@ -262,7 +263,7 @@ class _SplitKernel:
                 outs = [(d[perm], v[perm]) for d, v in zip(datas, valids)]
                 return outs, counts
 
-            fn = jax.jit(split)
+            fn = tpu_jit(split)
             cls._traces[key] = fn
         datas = tuple(c.data for c in table.columns)
         valids = tuple(c.validity for c in table.columns)
