@@ -514,6 +514,19 @@ class DeviceTable:
     #: separate ~0.1s row-count sync first
     EMBED_NROWS_CAP = 1 << 16
 
+    #: ...but only while the padded transfer stays under this many bytes —
+    #: a wide schema at 64k rows can be tens of MB over the ~30MB/s tunnel,
+    #: costing more than the row-count sync it avoids (ADVICE r3)
+    EMBED_MAX_BYTES = 4 << 20
+
+    def _packed_row_bytes(self) -> int:
+        """Bytes per row of the packed d2h buffer (data words + validity)."""
+        total = 0
+        for c in self.columns:
+            total += 4 * _u32_units(_pack_kind(c)) or 2  # small ints ~1-2B
+            total += 1  # validity byte
+        return max(total, 1)
+
     def to_host(self) -> HostTable:
         """Download as one packed transfer.
 
@@ -536,7 +549,10 @@ class DeviceTable:
             return self.to_host_per_column()
         from spark_rapids_tpu.runtime import speculation as spec
         ctx = spec.current()
-        if self._nrows_host is None and self.capacity <= self.EMBED_NROWS_CAP:
+        if (self._nrows_host is None
+                and self.capacity <= self.EMBED_NROWS_CAP
+                and self.capacity * self._packed_row_bytes()
+                <= self.EMBED_MAX_BYTES):
             k = self.capacity  # fetch the padded bucket; n rides the header
         else:
             k = min(bucket_for(max(self.num_rows, 1)), self.capacity)

@@ -193,8 +193,21 @@ class DeltaLog:
         except ValueError:
             return None
 
+    @staticmethod
+    def _as_pv(pv) -> dict:
+        """partitionValues may arrive as a dict (struct read) or a list of
+        (key, value) tuples (parquet map type)."""
+        if pv is None:
+            return {}
+        if isinstance(pv, dict):
+            return pv
+        return dict(pv)
+
     def _read_checkpoint(self, version: int) -> Tuple[Optional[Metadata],
                                                       Dict[str, AddFile]]:
+        """Read a checkpoint in the SPEC schema (nested metaData/add
+        structs — interoperates with real Delta readers/writers) or the
+        engine's pre-round-4 flattened metaData_*/add_* form."""
         import pyarrow.parquet as pq
         path = os.path.join(self.log_path,
                             f"{version:020d}.checkpoint.parquet")
@@ -202,8 +215,33 @@ class DeltaLog:
         rows = t.to_pylist()
         meta = None
         adds: Dict[str, AddFile] = {}
+        recognized = 0
         for r in rows:
+            md = r.get("metaData")
+            if md and md.get("schemaString"):
+                recognized += 1
+                meta = Metadata(
+                    schema_json=md["schemaString"],
+                    partition_columns=md.get("partitionColumns") or [],
+                    table_id=md.get("id") or "",
+                    name=md.get("name"),
+                    configuration=self._as_pv(md.get("configuration")))
+            a = r.get("add")
+            if a and a.get("path"):
+                recognized += 1
+                dv = a.get("deletionVector")
+                adds[a["path"]] = AddFile(
+                    path=a["path"],
+                    partition_values=self._as_pv(a.get("partitionValues")),
+                    size=a.get("size") or 0,
+                    modification_time=a.get("modificationTime") or 0,
+                    data_change=bool(a.get("dataChange", True)),
+                    stats=a.get("stats"),
+                    deletion_vector=dv if dv and dv.get("storageType")
+                    else None)
+            # legacy flattened form
             if r.get("metaData_schemaString"):
+                recognized += 1
                 meta = Metadata(
                     schema_json=r["metaData_schemaString"],
                     partition_columns=json.loads(
@@ -212,7 +250,8 @@ class DeltaLog:
                     configuration=json.loads(
                         r.get("metaData_configuration") or "{}"))
             if r.get("add_path"):
-                a = AddFile(
+                recognized += 1
+                af = AddFile(
                     path=r["add_path"],
                     partition_values=json.loads(
                         r["add_partitionValues"] or "{}"),
@@ -221,35 +260,80 @@ class DeltaLog:
                     stats=r.get("add_stats"),
                     deletion_vector=json.loads(r["add_deletionVector"])
                     if r.get("add_deletionVector") else None)
-                adds[a.path] = a
+                adds[af.path] = af
+        if meta is None or recognized == 0:
+            # schema-mismatched/foreign checkpoint: treating it as empty
+            # would silently drop every pre-checkpoint AddFile (ADVICE r2)
+            raise ValueError(
+                f"unrecognized checkpoint schema at version {version}")
         return meta, adds
 
     def write_checkpoint(self, snapshot: Snapshot):
-        """Flattened single-file checkpoint + _last_checkpoint pointer."""
+        """Single-file checkpoint in the SPEC's nested action schema
+        (metaData/add/protocol structs, partitionValues as map<str,str>) +
+        _last_checkpoint pointer — interoperable with real Delta readers
+        (ADVICE r2; reference: delta PROTOCOL.md checkpoint schema)."""
         import pyarrow as pa
         import pyarrow.parquet as pq
-        rows = []
         m = snapshot.metadata
-        rows.append({
-            "metaData_id": m.table_id, "metaData_schemaString": m.schema_json,
-            "metaData_partitionColumns": json.dumps(m.partition_columns),
-            "metaData_configuration": json.dumps(m.configuration),
-            "add_path": None, "add_partitionValues": None, "add_size": None,
-            "add_modificationTime": None, "add_stats": None,
-            "add_deletionVector": None})
+        rows = [
+            {"protocol": {"minReaderVersion": PROTOCOL_ACTION["protocol"][
+                "minReaderVersion"],
+                "minWriterVersion": PROTOCOL_ACTION["protocol"][
+                "minWriterVersion"]},
+             "metaData": None, "add": None},
+            {"protocol": None, "add": None,
+             "metaData": {
+                 "id": m.table_id, "name": m.name,
+                 "format": {"provider": "parquet", "options": []},
+                 "schemaString": m.schema_json,
+                 "partitionColumns": m.partition_columns,
+                 "configuration": list(m.configuration.items()),
+                 "createdTime": None}},
+        ]
         for a in snapshot.files:
-            rows.append({
-                "metaData_id": None, "metaData_schemaString": None,
-                "metaData_partitionColumns": None,
-                "metaData_configuration": None,
-                "add_path": a.path,
-                "add_partitionValues": json.dumps(a.partition_values),
-                "add_size": a.size,
-                "add_modificationTime": a.modification_time,
-                "add_stats": a.stats,
-                "add_deletionVector": json.dumps(a.deletion_vector)
-                if a.deletion_vector else None})
-        table = pa.Table.from_pylist(rows)
+            dv = a.deletion_vector
+            rows.append({"protocol": None, "metaData": None, "add": {
+                "path": a.path,
+                "partitionValues": list(a.partition_values.items()),
+                "size": a.size,
+                "modificationTime": a.modification_time,
+                "dataChange": False,
+                "stats": a.stats,
+                "deletionVector": {
+                    "storageType": dv["storageType"],
+                    "pathOrInlineDv": dv["pathOrInlineDv"],
+                    "offset": dv.get("offset", 0),
+                    "sizeInBytes": dv.get("sizeInBytes", 0),
+                    "cardinality": dv.get("cardinality", 0),
+                } if dv else None}})
+        dv_t = pa.struct([("storageType", pa.string()),
+                          ("pathOrInlineDv", pa.string()),
+                          ("offset", pa.int32()),
+                          ("sizeInBytes", pa.int32()),
+                          ("cardinality", pa.int64())])
+        schema = pa.schema([
+            ("protocol", pa.struct([("minReaderVersion", pa.int32()),
+                                    ("minWriterVersion", pa.int32())])),
+            ("metaData", pa.struct([
+                ("id", pa.string()), ("name", pa.string()),
+                ("format", pa.struct([("provider", pa.string()),
+                                      ("options",
+                                       pa.map_(pa.string(), pa.string()))])),
+                ("schemaString", pa.string()),
+                ("partitionColumns", pa.list_(pa.string())),
+                ("configuration", pa.map_(pa.string(), pa.string())),
+                ("createdTime", pa.int64())])),
+            ("add", pa.struct([
+                ("path", pa.string()),
+                ("partitionValues", pa.map_(pa.string(), pa.string())),
+                ("size", pa.int64()),
+                ("modificationTime", pa.int64()),
+                ("dataChange", pa.bool_()),
+                ("stats", pa.string()),
+                ("deletionVector", dv_t)])),
+        ])
+        table = pa.Table.from_pylist(rows, schema=schema)
         path = os.path.join(self.log_path,
                             f"{snapshot.version:020d}.checkpoint.parquet")
         pq.write_table(table, path)

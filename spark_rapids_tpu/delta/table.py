@@ -42,28 +42,75 @@ DELTA_CHECKPOINT_INTERVAL = int_conf(
 
 
 # -- deletion vectors --------------------------------------------------------
+#
+# Spec framing (Delta PROTOCOL.md "Deletion Vector Format", ADVICE r2):
+# a DV FILE starts with a 1-byte format version (1); each stored vector is
+# a 4-byte big-endian size, the serialized RoaringBitmapArray blob, and a
+# 4-byte big-endian CRC-32 of the blob. The descriptor's ``offset`` points
+# at the size prefix; ``sizeInBytes`` is the blob length (without
+# prefix/checksum). Storage types: 'u' = path derived from a base85 uuid
+# relative to the table (written here), 'p' = absolute path, 'i' = inline
+# base85 blob.
+
+import base64
+import zlib
+
+
+def _dv_relative_path(path_or_inline: str) -> str:
+    """'u' storage: the LAST 20 chars are the base85 (RFC 1924) uuid; any
+    leading chars are a directory prefix."""
+    enc = path_or_inline[-20:]
+    prefix = path_or_inline[:-20]
+    u = uuid.UUID(bytes=base64.b85decode(enc))
+    name = f"deletion_vector_{u}.bin"
+    return os.path.join(prefix, name) if prefix else name
+
 
 def write_dv_file(table_path: str, row_indexes: np.ndarray) -> dict:
-    """Persist a deletion vector; returns the add-action descriptor."""
+    """Persist a deletion vector with spec framing; returns the
+    deletionVector descriptor for the add action ('u' storage)."""
     blob = serialize_dv(row_indexes)
-    name = f"deletion_vector_{uuid.uuid4().hex}.bin"
+    u = uuid.uuid4()
+    enc = base64.b85encode(u.bytes).decode()
+    name = f"deletion_vector_{u}.bin"
     dv_path = os.path.join(table_path, name)
     with open(dv_path, "wb") as f:
+        f.write(b"\x01")  # format version
+        f.write(len(blob).to_bytes(4, "big"))
         f.write(blob)
-    return {"storageType": "p", "pathOrInlineDv": name, "offset": 0,
+        f.write(zlib.crc32(blob).to_bytes(4, "big"))
+    return {"storageType": "u", "pathOrInlineDv": enc, "offset": 1,
             "sizeInBytes": len(blob), "cardinality": int(len(row_indexes))}
 
 
 def read_dv(table_path: str, descriptor: dict) -> np.ndarray:
-    if descriptor["storageType"] != "p":
+    st = descriptor["storageType"]
+    if st == "i":
+        return deserialize_dv(base64.b85decode(descriptor["pathOrInlineDv"]))
+    if st == "u":
+        p = os.path.join(table_path,
+                         _dv_relative_path(descriptor["pathOrInlineDv"]))
+    elif st == "p":
+        p = descriptor["pathOrInlineDv"]
+        if not os.path.isabs(p):  # tolerate our pre-spec relative form
+            p = os.path.join(table_path, p)
+    else:
         raise ColumnarProcessingError(
-            f"deletion-vector storage {descriptor['storageType']!r} not "
-            "supported (only path-based)")
-    p = os.path.join(table_path, descriptor["pathOrInlineDv"])
+            f"deletion-vector storage {st!r} not supported")
     with open(p, "rb") as f:
-        f.seek(descriptor.get("offset", 0))
-        buf = f.read()
-    return deserialize_dv(buf)
+        off = descriptor.get("offset", 0)
+        if off == 0:
+            # pre-framing files stored the bare blob at offset 0
+            buf = f.read()
+            return deserialize_dv(buf)
+        f.seek(off)
+        size = int.from_bytes(f.read(4), "big")
+        blob = f.read(size)
+        crc = int.from_bytes(f.read(4), "big")
+    if len(blob) != size or zlib.crc32(blob) != crc:
+        raise ColumnarProcessingError(
+            f"deletion vector at {p}:{off} failed checksum")
+    return deserialize_dv(blob)
 
 
 # -- scan --------------------------------------------------------------------

@@ -382,15 +382,45 @@ class TpuHashAggregateExec(TpuExec):
             # outputs are already domain-sized; the group count stays a
             # device scalar (no host sync on the hot path)
             return out
+        from spark_rapids_tpu.columnar import bucket_for
         from spark_rapids_tpu.runtime import speculation as spec
-        if spec.current() is not None:
-            # async mode: shrink()'s row-count sync costs a ~0.1s round trip
-            # — more than any downstream op pays for the padded capacity
-            # (e.g. TakeOrdered's device sort at 1M capacity is ~0.05s)
+        if out_capacity <= DeviceTable.EMBED_NROWS_CAP:
+            # small outputs embed their row count in the collect fetch and
+            # cost downstream ops little — under async mode shrinking
+            # would only add a sync
+            return out if spec.current() is not None else out.shrink()
+        site = self._spec_site_key() + ":shrink"
+        ctx = spec.allowed(site)
+        if ctx is None:
+            if spec.current() is not None:
+                # blocklisted site under async mode: keep the padded
+                # capacity rather than paying the sync mid-plan
+                return out
+            return out.shrink()
+        # SPECULATIVE shrink (ADVICE r3): large sorted-path outputs used to
+        # keep the INPUT capacity (inflating every downstream kernel) to
+        # avoid shrink()'s ~0.1s row-count sync. Speculate that the group
+        # count fits a quarter-capacity bucket; the flag rides the collect
+        # fetch and a miss replays this site on the exact path.
+        spec_cap = max(bucket_for(max(out_capacity // 4, 1)),
+                       DeviceTable.EMBED_NROWS_CAP)
+        if spec_cap >= out_capacity:
             return out
-        # sorted path emits capacity-sized outputs; re-bucket so downstream
-        # sorts/transfers don't run at input capacity
-        return out.shrink()
+        flag_key = ("shrinkflag", out_capacity, spec_cap)
+        flag_fn = self._traces.get(flag_key)
+        if flag_fn is None:
+            flag_fn = tpu_jit(
+                lambda n: n > jnp.asarray(spec_cap, jnp.int32))
+            self._traces[flag_key] = flag_fn
+        ctx.add_flag(site, flag_fn(out.nrows_dev))
+        cols = [c.sliced_rows(spec_cap) for c in out.columns]
+        return DeviceTable(names, cols, out.nrows_dev, spec_cap)
+
+    def _spec_site_key(self) -> str:
+        return "agg:{}:{}:op{}".format(
+            tuple(g.key() for g in self.grouping),
+            tuple(fn.key() for _, fn in self.agg_specs),
+            getattr(self, "_lore_id", 0))
 
     def _eval_live(self, filters, capacity, cols, aux, nrows, filter_preps,
                    live_in=None):

@@ -415,11 +415,21 @@ class TpuJoinExec(TpuExec):
         self.subpartition_bytes = subpartition_bytes
         self._kernel = JoinKernel.get(len(self.left_keys))
         self._filter_kernel = None
-        self._site_key = "join:{}:{}:{}:{}:{}".format(
+        self._site_base = "join:{}:{}:{}:{}:{}".format(
             self.join_type,
             tuple(k.key() for k in self.left_keys),
             tuple(k.key() for k in self.right_keys),
             tuple(self.left_names), tuple(self.right_names))
+
+    @property
+    def _site_key(self) -> str:
+        """Speculation site identity: join shape + PLAN POSITION (lore id,
+        assigned deterministically per plan walk) so two same-shaped join
+        operators — repeated subqueries, look-alike joins in unrelated
+        queries — do not share one blocklist entry (ADVICE r3). A repeated
+        identical query re-assigns the same lore id, so blocklisting still
+        sticks across executions."""
+        return f"{self._site_base}:op{getattr(self, '_lore_id', 0)}"
 
     def output_schema(self):
         jt = self.join_type

@@ -419,13 +419,39 @@ class ShiftRightUnsigned(_ShiftBase):
 
 
 class _RoundDirBase(_RoundBase):
-    """ceil/floor at decimal scale (shim rules RoundCeil/RoundFloor)."""
+    """ceil/floor at decimal scale (shim rules RoundCeil/RoundFloor).
+
+    Integral inputs with scale <= 0 are EXACT Spark operations (ceil/floor
+    to a power of ten): computed in integer arithmetic — the float64 path
+    would perturb LONG values above 2^53 (ADVICE r2, ops/math.py)."""
 
     _np_fn = None
     _jnp_fn = None
 
+    #: +1 for ceil (round quotient up on remainder), 0 for floor
+    _adjust_up = 0
+
+    def _int_exact_applicable(self, np_dtype) -> bool:
+        """Exact path only when 10^-scale is representable in the column
+        dtype — otherwise wider powers wrap (int16 at scale -5) and the
+        float path's semantics apply."""
+        return 10 ** (-self._scale()) <= int(np.iinfo(np_dtype).max)
+
+    def _int_exact(self, data, xp):
+        """floor/ceil of integral ``data`` at 10^scale, scale <= 0, exact."""
+        pow10 = 10 ** (-self._scale())
+        p = xp.asarray(np.asarray(pow10, dtype=data.dtype))
+        q = data // p  # floor division (toward -inf) — floor case directly
+        if self._adjust_up:
+            q = q + ((data % p) != 0).astype(data.dtype)
+        return q * p
+
     def eval_cpu(self, table):
         c = self.children[0].eval_cpu(table)
+        if (isinstance(c.dtype, T.IntegralType) and self._scale() <= 0
+                and self._int_exact_applicable(c.dtype.np_dtype)):
+            return HostColumn(c.dtype, self._int_exact(c.data, np),
+                              c.validity.copy())
         factor = 10.0 ** self._scale()
         with np.errstate(all="ignore"):
             data = type(self)._np_fn(c.data * factor) / factor
@@ -435,16 +461,21 @@ class _RoundDirBase(_RoundBase):
 
     def eval_dev(self, ctx, child_vals, prep):
         c = child_vals[0]
+        dt = self.children[0].data_type
+        if (isinstance(dt, T.IntegralType) and self._scale() <= 0
+                and self._int_exact_applicable(dt.np_dtype)):
+            return DevVal(self._int_exact(c.data, jnp), c.validity)
         factor = 10.0 ** self._scale()
         data = type(self)._jnp_fn(c.data * factor) / factor
-        if isinstance(self.children[0].data_type, T.IntegralType):
-            data = data.astype(self.children[0].data_type.np_dtype)
+        if isinstance(dt, T.IntegralType):
+            data = data.astype(dt.np_dtype)
         return DevVal(data, c.validity)
 
 
 class RoundCeil(_RoundDirBase):
     _np_fn = staticmethod(np.ceil)
     _jnp_fn = staticmethod(jnp.ceil)
+    _adjust_up = 1
 
 
 class RoundFloor(_RoundDirBase):

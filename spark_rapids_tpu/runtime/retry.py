@@ -238,9 +238,10 @@ def with_retry(
             attempts = 0
             while True:
                 try:
+                    from spark_rapids_tpu.runtime.speculation import guard_attempt
                     RMM_TPU.maybe_inject()
                     with sb.pinned_batch() as dt:
-                        result = fn(dt)
+                        result = guard_attempt(lambda: fn(dt))
                     sb.release()
                     sb = None
                     yield result
@@ -304,11 +305,12 @@ def retry_block(fn: Callable[[], object], *, max_retries: Optional[int] = None,
     if max_retries is None:
         max_retries = MAX_RETRIES_VAR.get()
     DEVICE_MEMORY_EVENT_HANDLER.reset_fruitless(catalog)
+    from spark_rapids_tpu.runtime.speculation import guard_attempt
     attempts = 0
     while True:
         try:
             RMM_TPU.maybe_inject()
-            return fn()
+            return guard_attempt(fn)
         except Exception as exc:
             if is_device_oom(exc) and attempts < max_retries:
                 attempts += 1

@@ -101,3 +101,26 @@ def allowed(site_key: str) -> Optional[SpecContext]:
 
 def blocklist(sites) -> None:
     _BLOCKLIST.update(sites)
+
+
+def guard_attempt(fn):
+    """Run ``fn`` dropping any speculation flags it added if it raises —
+    an OOM-aborted attempt's pending flags would otherwise be validated
+    (and can spuriously blocklist the site) even though the attempt's
+    results were discarded and replayed (ADVICE r3, execs/join.py).
+
+    take_pending() REPLACES the pending list (a mid-attempt collect
+    consumes flags), so the snapshot tracks the list identity: if the list
+    changed, everything now pending was added by this attempt."""
+    ctx = _CTX.get()
+    snap_list = ctx.pending if ctx is not None else None
+    snap_len = len(snap_list) if snap_list is not None else 0
+    try:
+        return fn()
+    except BaseException:
+        if ctx is not None:
+            if ctx.pending is snap_list:
+                del ctx.pending[snap_len:]
+            else:
+                ctx.pending.clear()
+        raise

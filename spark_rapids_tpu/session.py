@@ -206,6 +206,11 @@ class TpuSession:
                 try:
                     batches = list(executable.execute_cpu())
                     spec.current().validate_remaining()
+                    if _attempt and hasattr(executable, "metrics"):
+                        # replays re-execute operators, double-counting
+                        # their metrics; record how many times so the
+                        # numbers can be interpreted (ADVICE r3)
+                        executable.metrics["speculationReplays"] = _attempt
                     return batches
                 except spec.SpeculationFailed as sf:
                     spec.blocklist(sf.sites)

@@ -138,14 +138,33 @@ class ShuffleBufferCatalog:
         with self._lock:
             return self._host_bytes
 
+    def remove_block(self, block_id: BlockId):
+        """Withdraw one block (failed-attempt cleanup — P2PWriteHandle)."""
+        with self._lock:
+            blob = self._blobs.pop(block_id, None)
+        if blob is None:
+            return
+        # blob.lock orders against a concurrent _enforce_limit spill of
+        # this blob (it flips data->disk and decrements _host_bytes)
+        with blob.lock:
+            if blob.data is not None:
+                with self._lock:
+                    self._host_bytes -= len(blob.data)
+                blob.data = None
+            if blob.disk_path and os.path.exists(blob.disk_path):
+                os.unlink(blob.disk_path)
+
     # -- lifecycle ----------------------------------------------------------
     def remove_shuffle(self, shuffle_id: int):
         with self._lock:
-            doomed = [bid for bid in self._blobs if bid[0] == shuffle_id]
-            for bid in doomed:
-                blob = self._blobs.pop(bid)
+            doomed = [self._blobs.pop(bid) for bid in list(self._blobs)
+                      if bid[0] == shuffle_id]
+        for blob in doomed:
+            with blob.lock:
                 if blob.data is not None:
-                    self._host_bytes -= len(blob.data)
+                    with self._lock:
+                        self._host_bytes -= len(blob.data)
+                    blob.data = None
                 if blob.disk_path and os.path.exists(blob.disk_path):
                     os.unlink(blob.disk_path)
 

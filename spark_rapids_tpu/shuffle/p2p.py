@@ -102,6 +102,11 @@ class P2PShuffleEnv:
     def connection_to(self, executor_id: str) -> Connection:
         with self._conn_lock:
             conn = self._connections.get(executor_id)
+            if conn is not None and getattr(conn, "broken", False):
+                # dead/desynced socket (ADVICE r2): evict so this fetch
+                # reconnects instead of failing forever
+                self._connections.pop(executor_id, None)
+                conn = None
         if conn is not None:
             return conn
         peer = self.me if executor_id == self.executor_id \
@@ -113,7 +118,15 @@ class P2PShuffleEnv:
         # connections to healthy ones (TCP connect can block for seconds)
         conn = self.transport.connect(peer)
         with self._conn_lock:
-            existing = self._connections.setdefault(executor_id, conn)
+            existing = self._connections.get(executor_id)
+            if existing is not None and getattr(existing, "broken", False):
+                existing.close()
+                existing = None
+            if existing is None:
+                self._connections[executor_id] = conn
+                return conn
+        # lost the race to a healthy connection: use it, free ours
+        conn.close()
         return existing
 
     def client_for(self, executor_id: str) -> ShuffleClient:
@@ -157,16 +170,33 @@ class P2PWriteHandle:
         self.bytes_written = 0
 
     def write_partitions(self, partitions: List[HostTable]):
+        """Idempotent under retry (ADVICE r2): all blobs are serialized
+        BEFORE the map id is claimed or any block lands in the catalog, so
+        a retryable failure mid-serialization leaves no partial map output
+        and the replay starts clean (no duplicated partitions)."""
         if len(partitions) != self.num_partitions:
             raise ColumnarProcessingError("partition count mismatch")
-        map_id = self.num_maps
-        self.num_maps += 1
+        staged = []
         for p, table in enumerate(partitions):
             if table.num_rows == 0:
                 continue
-            blob = _compress(self.env.codec, pack_table(table))
-            self.env.catalog.add_block((self.shuffle_id, map_id, p), blob)
-            self.bytes_written += len(blob)
+            staged.append((p, _compress(self.env.codec, pack_table(table))))
+        map_id = self.num_maps
+        added = []
+        try:
+            for p, blob in staged:
+                bid = (self.shuffle_id, map_id, p)
+                self.env.catalog.add_block(bid, blob)
+                added.append(bid)
+                self.bytes_written += len(blob)
+        except BaseException:
+            # leave no partial map output behind: a replay re-adds the
+            # same (map, partition) block ids and must start clean
+            for bid in added:
+                self.env.catalog.remove_block(bid)
+            self.bytes_written -= sum(len(b) for _, b in staged[:len(added)])
+            raise
+        self.num_maps += 1
 
     @property
     def map_outputs(self):  # parity with ShuffleWriteHandle for metrics

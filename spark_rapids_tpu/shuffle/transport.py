@@ -342,10 +342,23 @@ class TcpTransport(Transport):
 
 
 class _TcpConnection(Connection):
+    """``broken`` marks a connection whose wire state is undefined — a
+    transport fault (socket error) or protocol desync (unexpected frame,
+    oversized window, partially-read stream). The env evicts broken
+    connections so the next fetch reconnects instead of parsing mid-stream
+    bytes as frame headers (ADVICE r2). A clean MSG_ERROR response at a
+    frame boundary does NOT break the connection."""
+
     def __init__(self, sock: socket.socket, recv_pool: BounceBufferManager):
         self.sock = sock
         self.recv_pool = recv_pool
+        self.broken = False
         self._lock = threading.Lock()  # one request at a time per connection
+
+    def _fault(self, e) -> Transaction:
+        self.broken = True
+        self.close()
+        return Transaction(status=TX_ERROR, error_message=str(e))
 
     def request(self, msg_type: int, payload: bytes) -> Transaction:
         with self._lock:
@@ -354,7 +367,7 @@ class _TcpConnection(Connection):
                 resp_type, length = _recv_frame_header(self.sock)
                 resp = bytes(_recv_exact(self.sock, length)) if length else b""
             except (OSError, ColumnarProcessingError) as e:
-                return Transaction(status=TX_ERROR, error_message=str(e))
+                return self._fault(e)
         if resp_type == MSG_ERROR:
             return Transaction(status=TX_ERROR,
                                error_message=resp.decode("utf-8", "replace"))
@@ -398,8 +411,9 @@ class _TcpConnection(Connection):
                     finally:
                         self.recv_pool.release(buf)
             except (OSError, ColumnarProcessingError) as e:
-                return Transaction(status=TX_ERROR, error_message=str(e),
-                                   bytes_transferred=total)
+                tx = self._fault(e)
+                tx.bytes_transferred = total
+                return tx
 
     def close(self):
         try:
