@@ -72,7 +72,10 @@ class HostColumn:
             sample = next((v for v in values if v is not None), None)
             dtype = T.python_to_spark_type(sample) if sample is not None else T.NULL
         validity = np.array([v is not None for v in values], dtype=np.bool_)
-        if isinstance(dtype, T.ArrayType):
+        if isinstance(dtype, (T.StructType, T.MapType)):
+            data = np.empty(len(values), dtype=object)
+            data[:] = list(values)
+        elif isinstance(dtype, T.ArrayType):
             ec = HostColumn._element_conv(dtype.element_type)
             data = np.empty(len(values), dtype=object)
             data[:] = [[ec(x) if x is not None else None for x in v]
@@ -213,6 +216,20 @@ class DeviceColumn:
         return isinstance(self.data, tuple)
 
     @property
+    def is_struct(self) -> bool:
+        from spark_rapids_tpu.columnar.nested import StructData
+        return isinstance(self.data, StructData)
+
+    @property
+    def is_map(self) -> bool:
+        from spark_rapids_tpu.columnar.nested import MapData
+        return isinstance(self.data, MapData)
+
+    @property
+    def is_nested(self) -> bool:
+        return self.is_array or self.is_struct or self.is_map
+
+    @property
     def capacity(self) -> int:
         # array columns store data as (offsets, elem_data, elem_validity);
         # row capacity always equals the validity length
@@ -223,6 +240,9 @@ class DeviceColumn:
             off, ed, ev = self.data
             return int(off.size * 4 + ed.size * ed.dtype.itemsize
                        + ev.size + self.validity.size)
+        if self.is_struct or self.is_map:
+            from spark_rapids_tpu.columnar.nested import nested_nbytes
+            return nested_nbytes(self.data) + int(self.validity.size)
         return int(self.data.size * self.data.dtype.itemsize + self.validity.size)
 
     @staticmethod
@@ -276,6 +296,14 @@ class DeviceColumn:
         cap = capacity or bucket_for(n)
         if cap < n:
             raise ColumnarProcessingError(f"capacity {cap} < rows {n}")
+        if isinstance(host.dtype, T.StructType):
+            from spark_rapids_tpu.columnar.nested import struct_from_host
+            sd, validity = struct_from_host(host, cap)
+            return DeviceColumn(host.dtype, sd, validity)
+        if isinstance(host.dtype, T.MapType):
+            from spark_rapids_tpu.columnar.nested import map_from_host
+            md, validity = map_from_host(host, cap)
+            return DeviceColumn(host.dtype, md, validity)
         if isinstance(host.dtype, T.ArrayType):
             offsets, elems, evalid = DeviceColumn._array_parts(host, cap)
             validity = np.zeros(cap, dtype=np.bool_)
@@ -300,6 +328,14 @@ class DeviceColumn:
     def to_host(self, num_rows: int) -> HostColumn:
         if self.is_array:
             return self._array_to_host(num_rows)
+        if self.is_struct:
+            from spark_rapids_tpu.columnar.nested import struct_to_host
+            return struct_to_host(self.dtype, self.data, self.validity,
+                                  num_rows)
+        if self.is_map:
+            from spark_rapids_tpu.columnar.nested import map_to_host
+            return map_to_host(self.dtype, self.data, self.validity,
+                               num_rows)
         # device-slice down to the live bucket BEFORE the transfer: results
         # are often tiny (an aggregate's groups) while capacity is the input
         # bucket, and D2H bandwidth is the scarcest resource on a tunneled
@@ -346,11 +382,22 @@ class DeviceColumn:
         return DeviceColumn(self.dtype, data, validity, self.dictionary, self.dict_sorted)
 
     def sliced_rows(self, k: int) -> "DeviceColumn":
-        """First k row slots (array columns keep their element buffers and
-        slice only the offsets — the shape every row-slicer must use)."""
+        """First k row slots (array/map columns keep their element buffers
+        and slice only the offsets — the shape every row-slicer must use)."""
         if self.is_array:
             off, ed, ev = self.data
             return self.with_arrays((off[:k + 1], ed, ev), self.validity[:k])
+        if self.is_struct:
+            from spark_rapids_tpu.columnar.nested import StructData
+            sd = StructData(tuple((d[:k], v[:k])
+                                  for d, v in self.data.fields))
+            return self.with_arrays(sd, self.validity[:k])
+        if self.is_map:
+            from spark_rapids_tpu.columnar.nested import MapData
+            md = self.data
+            return self.with_arrays(
+                MapData(md.offsets[:k + 1], md.kdata, md.kvalid,
+                        md.vdata, md.vvalid), self.validity[:k])
         return self.with_arrays(self.data[:k], self.validity[:k])
 
 

@@ -489,7 +489,8 @@ class DeviceTable:
         cap = capacity or bucket_for(host.num_rows)
         if not host.columns:
             return DeviceTable(host.names, [], host.num_rows, cap)
-        if any(isinstance(c.dtype, T.ArrayType) for c in host.columns):
+        if any(isinstance(c.dtype, (T.ArrayType, T.StructType, T.MapType))
+               for c in host.columns):
             # nested columns bypass the staged fast path (per-column upload)
             cols = [DeviceColumn.from_host(c, cap) for c in host.columns]
             return DeviceTable(host.names, cols, host.num_rows, cap)
@@ -545,7 +546,7 @@ class DeviceTable:
             return HostTable(self.names, [])
         if self.live is not None:
             return self.compacted().to_host()
-        if any(c.is_array for c in self.columns):
+        if any(c.is_nested for c in self.columns):
             return self.to_host_per_column()
         from spark_rapids_tpu.runtime import speculation as spec
         ctx = spec.current()

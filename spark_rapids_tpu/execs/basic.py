@@ -136,11 +136,19 @@ class TpuProjectExec(TpuExec):
         from spark_rapids_tpu.ops.expr import has_position_dependent
         from spark_rapids_tpu.runtime.retry import with_retry
         exprs, names = self.exprs, self.names
-        pos_dep = any(has_position_dependent(e) for e in exprs)
+        # compact first when slot numbering matters (position-dependent
+        # exprs) or when outputs are NESTED (array/struct/map columns have
+        # no compaction scatter — they must only ever live in prefix
+        # batches; TypeSig keeps nested out of mask-producing execs)
+        must_compact = (
+            any(has_position_dependent(e) for e in exprs)
+            or any(isinstance(e.data_type,
+                              (T.ArrayType, T.StructType, T.MapType))
+                   for e in exprs))
 
         def run(dt):
-            if pos_dep:
-                dt = dt.compacted()  # slot ids must match the prefix form
+            if must_compact:
+                dt = dt.compacted()
             cols = compile_project(exprs, dt)
             return DeviceTable(names, cols, dt.nrows_dev, dt.capacity,
                                live=dt.live)

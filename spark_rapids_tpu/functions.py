@@ -549,3 +549,121 @@ def pandas_udf(return_type, function_type: str = "scalar"):
     (Series -> scalar per group, used in group_by().agg())."""
     from spark_rapids_tpu.plan.pandas_udf import pandas_udf as _pu
     return _pu(return_type, function_type)
+
+
+# -- nested types: structs, maps, higher-order functions ---------------------
+# (reference: complexTypeCreator.scala, higherOrderFunctions.scala)
+
+def _lambda(fn, n_vars: int):
+    """Build a LambdaFunction from a Python callable: F.transform(c,
+    lambda x: x + 1) — the callable runs ONCE at plan time with symbolic
+    variables (the Spark Connect / PySpark column-lambda idiom)."""
+    from spark_rapids_tpu.ops.nested import LambdaFunction, NamedLambdaVariable
+    if isinstance(fn, LambdaFunction):
+        return fn
+    import inspect
+    names = list(inspect.signature(fn).parameters)[:n_vars] or \
+        [f"x{i}" for i in range(n_vars)]
+    body = fn(*[NamedLambdaVariable(n) for n in names])
+    return LambdaFunction(_e(body), names)
+
+
+def struct(*exprs, names=None):
+    from spark_rapids_tpu.ops.expr import output_name
+    from spark_rapids_tpu.ops.nested import CreateNamedStruct
+    es = [_e(x) for x in exprs]
+    if names is None:
+        names = [output_name(e, f"col{i}") for i, e in enumerate(es)]
+    return CreateNamedStruct(names, es)
+
+
+def named_struct(*name_expr_pairs):
+    from spark_rapids_tpu.ops.nested import CreateNamedStruct
+    names = [name_expr_pairs[i] for i in range(0, len(name_expr_pairs), 2)]
+    es = [_e(name_expr_pairs[i]) for i in range(1, len(name_expr_pairs), 2)]
+    return CreateNamedStruct(names, es)
+
+
+def get_field(e, name: str):
+    from spark_rapids_tpu.ops.nested import GetStructField
+    return GetStructField(_e(e), name)
+
+
+def create_map(*exprs):
+    from spark_rapids_tpu.ops.nested import CreateMap
+    return CreateMap(*[_e(x) for x in exprs])
+
+
+def map_keys(e):
+    from spark_rapids_tpu.ops.nested import MapKeys
+    return MapKeys(_e(e))
+
+
+def map_values(e):
+    from spark_rapids_tpu.ops.nested import MapValues
+    return MapValues(_e(e))
+
+
+def map_entries(e):
+    from spark_rapids_tpu.ops.nested import MapEntries
+    return MapEntries(_e(e))
+
+
+def map_concat(*exprs):
+    from spark_rapids_tpu.ops.nested import MapConcat
+    return MapConcat(*[_e(x) for x in exprs])
+
+
+def get_map_value(m, key):
+    from spark_rapids_tpu.ops.nested import GetMapValue
+    return GetMapValue(_e(m), _e(key))
+
+
+def transform(arr, fn):
+    from spark_rapids_tpu.ops.nested import ArrayTransform
+    lam = _lambda(fn, 2 if _lambda_arity(fn) >= 2 else 1)
+    return ArrayTransform(_e(arr), lam)
+
+
+def filter_array(arr, fn):
+    from spark_rapids_tpu.ops.nested import ArrayFilter
+    return ArrayFilter(_e(arr), _lambda(fn, _lambda_arity(fn)))
+
+
+def exists(arr, fn):
+    from spark_rapids_tpu.ops.nested import ArrayExists
+    return ArrayExists(_e(arr), _lambda(fn, 1))
+
+
+def forall(arr, fn):
+    from spark_rapids_tpu.ops.nested import ArrayForAll
+    return ArrayForAll(_e(arr), _lambda(fn, 1))
+
+
+def map_filter(m, fn):
+    from spark_rapids_tpu.ops.nested import MapFilter
+    return MapFilter(_e(m), _lambda(fn, 2))
+
+
+def transform_keys(m, fn):
+    from spark_rapids_tpu.ops.nested import TransformKeys
+    return TransformKeys(_e(m), _lambda(fn, 2))
+
+
+def transform_values(m, fn):
+    from spark_rapids_tpu.ops.nested import TransformValues
+    return TransformValues(_e(m), _lambda(fn, 2))
+
+
+def arrays_zip(*exprs):
+    from spark_rapids_tpu.ops.nested import ArraysZip
+    return ArraysZip(*[_e(x) for x in exprs])
+
+
+def _lambda_arity(fn) -> int:
+    import builtins
+    from spark_rapids_tpu.ops.nested import LambdaFunction
+    if isinstance(fn, LambdaFunction):
+        return len(fn.var_names)
+    import inspect
+    return builtins.max(len(inspect.signature(fn).parameters), 1)

@@ -223,8 +223,10 @@ class Expression:
     def alias(self, name: str) -> "Alias":
         return Alias(self, name)
 
-    def cast(self, dtype: T.DataType) -> "Expression":
+    def cast(self, dtype) -> "Expression":
         from spark_rapids_tpu.ops.cast import Cast
+        if isinstance(dtype, str):
+            dtype = T.parse_type(dtype)
         return Cast(self, dtype)
 
     def isnull(self):

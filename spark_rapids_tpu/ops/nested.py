@@ -1,0 +1,1099 @@
+"""Struct/map expressions + higher-order array functions.
+
+Reference: complexTypeCreator.scala (GpuCreateNamedStruct/GpuCreateMap),
+complexTypeExtractors (GpuGetStructField/GpuGetMapValue),
+collectionOperations.scala (map_keys/map_values/map_entries/map_concat),
+higherOrderFunctions.scala (GpuArrayTransform/Exists/Filter +
+GpuLambdaFunction/GpuNamedLambdaVariable binding) — SURVEY.md §2.3 #26,
+VERDICT r3 missing #1/#2.
+
+TPU-first lambda evaluation: a lambda body is an ordinary expression tree
+evaluated over the ELEMENT space — the array's flat (elems, evalid)
+buffers — with lambda variables bound to the element streams and any
+outer-row references gathered per element by row id. The body therefore
+compiles into the same fused XLA program as everything else; there is no
+per-row interpretation (the reference reaches the same shape by evaluating
+the bound lambda over the child LIST column's child column).
+
+The body is REBOUND at resolve time: NamedLambdaVariable -> element-ctx
+ordinal 0..k-1, outer BoundReference(i) -> k + dense index. Element-space
+liveness = (slot < total elements) & parent row live."""
+
+from __future__ import annotations
+
+from types import SimpleNamespace
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar import HostColumn, HostTable, bucket_for
+from spark_rapids_tpu.columnar.nested import (
+    MapData,
+    StructData,
+    fixed_np_dtype,
+    map_device_supported,
+    struct_device_supported,
+)
+from spark_rapids_tpu.errors import ColumnarProcessingError, UnsupportedOnTpu
+from spark_rapids_tpu.ops.collections import _elem_rids, is_fixed_array
+from spark_rapids_tpu.ops.common import UnaryExpression
+from spark_rapids_tpu.ops.expr import (
+    BoundReference,
+    DevVal,
+    EvalCtx,
+    Expression,
+    NodePrep,
+    PrepCtx,
+    _prep_trace_key,
+    _walk_eval,
+    _walk_prep,
+    output_name,
+)
+
+
+# ---------------------------------------------------------------------------
+# structs
+# ---------------------------------------------------------------------------
+
+class CreateNamedStruct(Expression):
+    """named_struct(n1, e1, n2, e2, ...) — bundles existing columns; zero
+    data movement on device."""
+
+    def __init__(self, names: Sequence[str], exprs: Sequence[Expression]):
+        self.names = tuple(names)
+        self.children = tuple(exprs)
+
+    @property
+    def data_type(self):
+        return T.StructType([
+            T.StructField(n, e.data_type)
+            for n, e in zip(self.names, self.children)])
+
+    @property
+    def nullable(self):
+        return False
+
+    def key(self):
+        return ("namedstruct", self.names,
+                tuple(c.key() for c in self.children))
+
+    def with_children(self, children):
+        return CreateNamedStruct(self.names, children)
+
+    @property
+    def device_supported(self):
+        return struct_device_supported(self.data_type)
+
+    def eval_cpu(self, table: HostTable) -> HostColumn:
+        kids = [c.eval_cpu(table) for c in self.children]
+        n = table.num_rows
+        out = np.empty(n, dtype=object)
+        for i in range(n):
+            out[i] = tuple(
+                (k.data[i].item() if hasattr(k.data[i], "item")
+                 else k.data[i]) if k.validity[i] else None
+                for k in kids)
+        return HostColumn(self.data_type, out, np.ones(n, dtype=np.bool_))
+
+    def eval_dev(self, ctx, child_vals, prep) -> DevVal:
+        sd = StructData(tuple((cv.data, cv.validity) for cv in child_vals))
+        return DevVal(sd, ctx.row_mask())
+
+
+class GetStructField(Expression):
+    def __init__(self, child: Expression, name: str):
+        self.children = (child,)
+        self.field_name = name
+
+    def _field(self):
+        st: T.StructType = self.children[0].data_type
+        for i, f in enumerate(st.fields):
+            if f.name == self.field_name:
+                return i, f
+        raise ColumnarProcessingError(
+            f"no field {self.field_name!r} in {st.simple_string()}")
+
+    @property
+    def data_type(self):
+        return self._field()[1].data_type
+
+    def key(self):
+        return ("getfield", self.field_name, self.children[0].key())
+
+    def with_children(self, children):
+        return GetStructField(children[0], self.field_name)
+
+    @property
+    def device_supported(self):
+        return struct_device_supported(self.children[0].data_type)
+
+    def eval_cpu(self, table: HostTable) -> HostColumn:
+        c = self.children[0].eval_cpu(table)
+        fi, f = self._field()
+        n = len(c)
+        npdt = fixed_np_dtype(f.data_type)
+        data = np.zeros(n, dtype=npdt if npdt is not None else object)
+        validity = np.zeros(n, dtype=np.bool_)
+        for i in range(n):
+            if c.validity[i]:
+                row = c.data[i]
+                v = row.get(self.field_name) if isinstance(row, dict) \
+                    else row[fi]
+                if v is not None:
+                    data[i] = v
+                    validity[i] = True
+        return HostColumn(f.data_type, data, validity)
+
+    def eval_dev(self, ctx, child_vals, prep) -> DevVal:
+        (c,) = child_vals
+        fi, _ = self._field()
+        d, v = c.data.fields[fi]
+        return DevVal(d, v & c.validity)
+
+
+# ---------------------------------------------------------------------------
+# maps
+# ---------------------------------------------------------------------------
+
+class CreateMap(Expression):
+    """map(k1, v1, k2, v2, ...) — fixed entry count per row. Null keys are
+    invalid in Spark (runtime error): the CPU path raises at evaluation;
+    the device kernel cannot raise per-row, so a null key marks kvalid
+    False and the error surfaces at collect (columnar/nested.map_to_host)
+    — never silent wrong data."""
+
+    def __init__(self, *children: Expression):
+        if len(children) % 2 != 0 or not children:
+            raise ColumnarProcessingError("map() needs key/value pairs")
+        self.children = tuple(children)
+
+    @property
+    def data_type(self):
+        return T.MapType(key_type=self.children[0].data_type,
+                         value_type=self.children[1].data_type)
+
+    @property
+    def nullable(self):
+        return False
+
+    def key(self):
+        return ("createmap", tuple(c.key() for c in self.children))
+
+    def with_children(self, children):
+        return CreateMap(*children)
+
+    @property
+    def device_supported(self):
+        return map_device_supported(self.data_type)
+
+    def eval_cpu(self, table: HostTable) -> HostColumn:
+        kids = [c.eval_cpu(table) for c in self.children]
+        n = table.num_rows
+        out = np.empty(n, dtype=object)
+        for i in range(n):
+            m = {}
+            for j in range(0, len(kids), 2):
+                kc, vc = kids[j], kids[j + 1]
+                if not kc.validity[i]:
+                    raise ColumnarProcessingError(
+                        "Cannot use null as map key")
+                k = kc.data[i].item() if hasattr(kc.data[i], "item") \
+                    else kc.data[i]
+                m[k] = (vc.data[i].item() if hasattr(vc.data[i], "item")
+                        else vc.data[i]) if vc.validity[i] else None
+            out[i] = m
+        return HostColumn(self.data_type, out, np.ones(n, dtype=np.bool_))
+
+    def eval_dev(self, ctx, child_vals, prep) -> DevVal:
+        cap = ctx.capacity
+        k = len(child_vals) // 2
+        ecap = bucket_for(max(cap * k, 1))
+        kd = jnp.stack([child_vals[2 * j].data for j in range(k)],
+                       axis=1).reshape(cap * k)
+        kv = jnp.stack([child_vals[2 * j].validity for j in range(k)],
+                       axis=1).reshape(cap * k)
+        vd = jnp.stack([child_vals[2 * j + 1].data for j in range(k)],
+                       axis=1).reshape(cap * k)
+        vv = jnp.stack([child_vals[2 * j + 1].validity for j in range(k)],
+                       axis=1).reshape(cap * k)
+        pad = ecap - cap * k
+        if pad:
+            kd = jnp.concatenate([kd, jnp.zeros(pad, kd.dtype)])
+            kv = jnp.concatenate([kv, jnp.zeros(pad, jnp.bool_)])
+            vd = jnp.concatenate([vd, jnp.zeros(pad, vd.dtype)])
+            vv = jnp.concatenate([vv, jnp.zeros(pad, jnp.bool_)])
+        off = jnp.arange(cap + 1, dtype=jnp.int32) * k
+        md = MapData(off, kd, kv, vd, vv)
+        return DevVal(md, ctx.row_mask())
+
+
+class _MapUnary(UnaryExpression):
+    @property
+    def device_supported(self):
+        return map_device_supported(self.children[0].data_type)
+
+
+class MapKeys(_MapUnary):
+    @property
+    def data_type(self):
+        return T.ArrayType(self.children[0].data_type.key_type)
+
+    def key(self):
+        return ("mapkeys", self.children[0].key())
+
+    def eval_cpu(self, table):
+        c = self.children[0].eval_cpu(table)
+        out = np.empty(len(c), dtype=object)
+        for i in range(len(c)):
+            if c.validity[i]:
+                out[i] = list(c.data[i].keys())
+        return HostColumn(self.data_type, out, c.validity.copy())
+
+    def eval_dev(self, ctx, child_vals, prep) -> DevVal:
+        (c,) = child_vals
+        md: MapData = c.data
+        return DevVal((md.offsets, md.kdata, md.kvalid), c.validity)
+
+
+class MapValues(_MapUnary):
+    @property
+    def data_type(self):
+        return T.ArrayType(self.children[0].data_type.value_type)
+
+    def key(self):
+        return ("mapvalues", self.children[0].key())
+
+    def eval_cpu(self, table):
+        c = self.children[0].eval_cpu(table)
+        out = np.empty(len(c), dtype=object)
+        for i in range(len(c)):
+            if c.validity[i]:
+                out[i] = list(c.data[i].values())
+        return HostColumn(self.data_type, out, c.validity.copy())
+
+    def eval_dev(self, ctx, child_vals, prep) -> DevVal:
+        (c,) = child_vals
+        md: MapData = c.data
+        return DevVal((md.offsets, md.vdata, md.vvalid), c.validity)
+
+
+class MapEntries(_MapUnary):
+    """map_entries(m) -> array<struct<key,value>>. Device arrays hold
+    fixed-width elements only, so this one is CPU-path (tagged)."""
+
+    device_supported = False
+
+    @property
+    def data_type(self):
+        mt = self.children[0].data_type
+        return T.ArrayType(T.StructType([
+            T.StructField("key", mt.key_type, False),
+            T.StructField("value", mt.value_type)]))
+
+    def key(self):
+        return ("mapentries", self.children[0].key())
+
+    def eval_cpu(self, table):
+        c = self.children[0].eval_cpu(table)
+        out = np.empty(len(c), dtype=object)
+        for i in range(len(c)):
+            if c.validity[i]:
+                out[i] = [(k, v) for k, v in c.data[i].items()]
+        return HostColumn(self.data_type, out, c.validity.copy())
+
+
+class GetMapValue(Expression):
+    """m[key] — per-row lookup; missing key -> null."""
+
+    def __init__(self, child: Expression, key_expr: Expression):
+        self.children = (child, key_expr)
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type.value_type
+
+    def key(self):
+        return ("getmapvalue", tuple(c.key() for c in self.children))
+
+    def with_children(self, children):
+        return GetMapValue(children[0], children[1])
+
+    @property
+    def device_supported(self):
+        return map_device_supported(self.children[0].data_type)
+
+    def eval_cpu(self, table):
+        c = self.children[0].eval_cpu(table)
+        k = self.children[1].eval_cpu(table)
+        vt = self.data_type
+        npdt = fixed_np_dtype(vt)
+        n = len(c)
+        data = np.zeros(n, dtype=npdt if npdt is not None else object)
+        validity = np.zeros(n, dtype=np.bool_)
+        for i in range(n):
+            if c.validity[i] and k.validity[i]:
+                kk = k.data[i].item() if hasattr(k.data[i], "item") \
+                    else k.data[i]
+                if kk in c.data[i] and c.data[i][kk] is not None:
+                    data[i] = c.data[i][kk]
+                    validity[i] = True
+        return HostColumn(vt, data, validity)
+
+    def eval_dev(self, ctx, child_vals, prep) -> DevVal:
+        c, k = child_vals
+        md: MapData = c.data
+        cap = ctx.capacity
+        ecap = int(md.kdata.shape[0])
+        rid = _elem_rids(md.offsets, ecap, cap)
+        safe_rid = jnp.clip(rid, 0, cap - 1)
+        hit = (rid < cap) & md.kvalid & k.validity[safe_rid] & \
+            (md.kdata == k.data[safe_rid])
+        # last entry wins (Spark map semantics keep last duplicate)
+        pos = jnp.where(hit, jnp.arange(ecap, dtype=jnp.int32), -1)
+        best = jnp.full(cap + 1, -1, jnp.int32).at[
+            jnp.where(rid < cap, rid, cap)].max(pos, mode="drop")[:cap]
+        found = best >= 0
+        safe = jnp.clip(best, 0, ecap - 1)
+        data = md.vdata[safe]
+        validity = found & md.vvalid[safe] & c.validity & k.validity
+        return DevVal(jnp.where(validity, data, jnp.zeros_like(data)),
+                      validity)
+
+
+class MapConcat(Expression):
+    """map_concat(m1, m2, ...) — LAST_WIN dedup across inputs (Spark's
+    mapKeyDedupPolicy=LAST_WIN; the EXCEPTION default cannot raise per-row
+    on device, matching the reference's policy-gated support)."""
+
+    def __init__(self, *children: Expression):
+        self.children = tuple(children)
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type
+
+    def key(self):
+        return ("mapconcat", tuple(c.key() for c in self.children))
+
+    def with_children(self, children):
+        return MapConcat(*children)
+
+    @property
+    def device_supported(self):
+        return all(map_device_supported(c.data_type)
+                   for c in self.children)
+
+    def eval_cpu(self, table):
+        kids = [c.eval_cpu(table) for c in self.children]
+        n = table.num_rows
+        out = np.empty(n, dtype=object)
+        validity = np.ones(n, dtype=np.bool_)
+        for i in range(n):
+            if any(not k.validity[i] for k in kids):
+                validity[i] = False
+                continue
+            m = {}
+            for k in kids:
+                m.update(k.data[i])
+            out[i] = m
+        return HostColumn(self.data_type, out, validity)
+
+    def eval_dev(self, ctx, child_vals, prep) -> DevVal:
+        cap = ctx.capacity
+        validity = ctx.row_mask()
+        for cv in child_vals:
+            validity = validity & cv.validity
+        # concatenate entry streams, tagging each element with (row, order)
+        rids, kds, kvs, vds, vvs, orders = [], [], [], [], [], []
+        base = 0
+        for ci, cv in enumerate(child_vals):
+            md: MapData = cv.data
+            ecap = int(md.kdata.shape[0])
+            rid = _elem_rids(md.offsets, ecap, cap)
+            live = (rid < cap) & md.kvalid & cv.validity[
+                jnp.clip(rid, 0, cap - 1)]
+            rids.append(jnp.where(live, rid, cap))
+            kds.append(md.kdata)
+            kvs.append(live)
+            vds.append(md.vdata)
+            vvs.append(md.vvalid)
+            orders.append(jnp.arange(ecap, dtype=jnp.int32) + base)
+            base += ecap
+        rid = jnp.concatenate(rids)
+        kd = jnp.concatenate(kds)
+        kv = jnp.concatenate(kvs)
+        vd = jnp.concatenate(vds)
+        vv = jnp.concatenate(vvs)
+        order = jnp.concatenate(orders)
+        tot = int(rid.shape[0])
+        ecap_out = bucket_for(max(tot, 1))
+        from spark_rapids_tpu.ops.ordering import comparable_operands
+        kops = comparable_operands(jnp.where(kv, kd, jnp.zeros_like(kd)))
+        payload = jnp.arange(tot, dtype=jnp.int32)
+        res = jax.lax.sort([rid] + kops + [order, payload],
+                           num_keys=1 + len(kops) + 1)
+        s_rid = res[0]
+        perm = res[-1]
+        # last occurrence of each (row, key) wins: keep where the NEXT
+        # sorted entry differs in (row, key)
+        nxt_same = (s_rid == jnp.concatenate(
+            [s_rid[1:], jnp.full(1, cap + 1, s_rid.dtype)]))
+        for o in res[1:1 + len(kops)]:
+            nxt = jnp.concatenate([o[1:], jnp.zeros(1, o.dtype) - 1])
+            nxt_same = nxt_same & (o == nxt)
+        keep = (s_rid < cap) & ~nxt_same
+        new_rid = jnp.where(keep, s_rid, cap)
+        counts = jax.ops.segment_sum(keep.astype(jnp.int32), new_rid,
+                                     num_segments=cap + 1)[:cap]
+        off = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                               jnp.cumsum(counts).astype(jnp.int32)])
+        cpos = jnp.cumsum(keep.astype(jnp.int32)) - 1
+        tgt = jnp.where(keep, cpos, ecap_out)
+        from spark_rapids_tpu.ops.scatter32 import scatter_pair
+        okd, okv = scatter_pair(ecap_out, tgt, kd[perm], kv[perm])
+        ovd, ovv = scatter_pair(ecap_out, tgt, vd[perm], vv[perm])
+        return DevVal(MapData(off, okd, okv, ovd, ovv), validity)
+
+
+# ---------------------------------------------------------------------------
+# lambdas
+# ---------------------------------------------------------------------------
+
+class NamedLambdaVariable(Expression):
+    """Placeholder bound by resolve() of the enclosing HOF."""
+
+    def __init__(self, name: str, dtype: Optional[T.DataType] = None):
+        self.var_name = name
+        self._dtype = dtype
+
+    @property
+    def data_type(self):
+        if self._dtype is None:
+            raise ColumnarProcessingError(
+                f"unbound lambda variable {self.var_name}")
+        return self._dtype
+
+    def key(self):
+        return ("lambdavar", self.var_name, str(self._dtype))
+
+    def with_children(self, children):
+        return self
+
+    def bind(self, schema):
+        return self  # bound by the HOF, not by row schema
+
+    def eval_cpu(self, table):
+        raise ColumnarProcessingError(
+            f"lambda variable {self.var_name} evaluated outside a lambda")
+
+    eval_dev = eval_cpu
+
+
+class LambdaFunction(Expression):
+    """x -> body or (x, y) -> body. Never evaluated directly; the HOF
+    rebinds and evaluates the body in element space."""
+
+    def __init__(self, body: Expression, var_names: Sequence[str]):
+        self.children = (body,)
+        self.var_names = tuple(var_names)
+
+    @property
+    def body(self):
+        return self.children[0]
+
+    @property
+    def data_type(self):
+        return self.body.data_type
+
+    def key(self):
+        return ("lambda", self.var_names, self.body.key())
+
+    def with_children(self, children):
+        return LambdaFunction(children[0], self.var_names)
+
+    def bind(self, schema):
+        # binding is driven by the enclosing HOF: lambda vars must be
+        # TYPED before the body binds (type coercion consults data_type)
+        return self
+
+    def eval_cpu(self, table):
+        raise ColumnarProcessingError("LambdaFunction evaluated directly")
+
+    eval_dev = eval_cpu
+
+
+def _tree_device_supported(e: Expression) -> bool:
+    """check_expr never sees the rebound lambda body (it is not a child),
+    so the HOF vouches for the WHOLE body tree itself."""
+    if not getattr(e, "device_supported", True):
+        return False
+    return all(_tree_device_supported(c) for c in e.children)
+
+
+def _substitute_vars(e: Expression, mapping) -> Expression:
+    if isinstance(e, NamedLambdaVariable):
+        got = mapping.get(e.var_name)
+        return got if got is not None else e
+    if not e.children:
+        return e
+    return e.with_children([_substitute_vars(c, mapping)
+                            for c in e.children])
+
+
+def _collect_outer_refs(e: Expression, acc: set) -> None:
+    if isinstance(e, BoundReference):
+        acc.add(e.ordinal)
+    for c in e.children:
+        _collect_outer_refs(c, acc)
+
+
+class _HigherOrder(Expression):
+    """Shared machinery. After bind(), ``children`` = (array/map expr,
+    *outer_exprs): outer row-space subexpressions the lambda body
+    references, evaluated by the generic walkers in row space and gathered
+    per element — this keeps the fusion substitution pass (execs/fuse.py)
+    and every other generic child rewrite sound. The body lives REBOUND in
+    ``self._rebound``: lambda var i -> element-ctx ordinal i, outer expr j
+    -> ordinal n_vars + j."""
+
+    def __init__(self, child: Expression, fn: LambdaFunction,
+                 _rebound=None, _outer_children=()):
+        self.children = (child,) + tuple(_outer_children)
+        self.fn = fn
+        self._rebound = _rebound  # body with element-ctx ordinals
+
+    def _var_types(self) -> List[T.DataType]:
+        raise NotImplementedError
+
+    def key(self):
+        return (type(self).__name__.lower(),
+                tuple(c.key() for c in self.children),
+                self.fn.key() if self._rebound is None
+                else self._rebound.key())
+
+    def with_children(self, children):
+        return type(self)(children[0], self.fn, self._rebound,
+                          tuple(children[1:]))
+
+    def bind(self, schema):
+        child = self.children[0].bind(schema)
+        fn = self.fn
+        out = type(self)(child, fn)
+        # type the lambda vars FIRST (binding coerces via data_type), then
+        # bind row-space refs, then rebind into element space; outer row
+        # refs become explicit CHILDREN of this node
+        vts = out._var_types()
+        mapping = {name: NamedLambdaVariable(name, vt)
+                   for name, vt in zip(fn.var_names, vts)}
+        typed = _substitute_vars(fn.body, mapping).bind(schema)
+        outer: set = set()
+        _collect_outer_refs(typed, outer)
+        outer_sorted = sorted(outer)
+        # element ctx always carries len(vts) variable columns (map HOFs
+        # supply both streams even to a 1-arg lambda)
+        k = len(vts)
+        remap = {o: k + i for i, o in enumerate(outer_sorted)}
+
+        def rebind(e):
+            if isinstance(e, NamedLambdaVariable):
+                idx = fn.var_names.index(e.var_name)
+                return BoundReference(idx, vts[idx], name_hint=e.var_name)
+            if isinstance(e, BoundReference):
+                return BoundReference(remap[e.ordinal], e.data_type,
+                                      e.nullable, name_hint=e.name_hint)
+            if not e.children:
+                return e
+            return e.with_children([rebind(c) for c in e.children])
+
+        outer_children = tuple(
+            BoundReference(o, schema[o][1], name_hint=schema[o][0])
+            for o in outer_sorted)
+        return type(self)(child, fn, rebind(typed), outer_children)
+
+    @property
+    def device_supported(self):
+        dt = self.children[0].data_type
+        if isinstance(dt, T.MapType):
+            if not map_device_supported(dt):
+                return False
+        elif not is_fixed_array(dt):
+            return False
+        if any(fixed_np_dtype(c.data_type) is None
+               for c in self.children[1:]):
+            return False  # element-space gathers are fixed-width only
+        if self._rebound is None:
+            return True
+        return _tree_device_supported(self._rebound)
+
+    # -- element-space prep/eval shared by all HOFs -------------------------
+    def prep(self, pctx: PrepCtx, child_preps):
+        vts = self._var_types()
+        cols = [SimpleNamespace(dtype=vt, dictionary=None, dict_sorted=True,
+                                data=None, validity=None) for vt in vts]
+        for c in self.children[1:]:
+            cols.append(SimpleNamespace(
+                dtype=c.data_type, dictionary=None, dict_sorted=True,
+                data=None, validity=None))
+        facade = SimpleNamespace(columns=cols,
+                                 num_rows=getattr(pctx.table, "num_rows", 0),
+                                 capacity=getattr(pctx.table, "capacity", 0))
+        sub = PrepCtx.__new__(PrepCtx)
+        sub.table = facade
+        sub.aux_arrays = pctx.aux_arrays
+        sub.aux_intern = pctx.aux_intern
+        body_preps: List[NodePrep] = []
+        _walk_prep(self._rebound, sub, body_preps)
+        p = NodePrep(extra={"body": _prep_trace_key(body_preps)})
+        p.body_preps = body_preps
+        return p
+
+    def _eval_body(self, ctx: EvalCtx, prep, var_vals: List[DevVal],
+                   outer_vals, rid, ecap: int, elem_live):
+        """Evaluate the rebound body over element space."""
+        cap = ctx.capacity
+        safe = jnp.clip(rid, 0, cap - 1)
+        cols = list(var_vals)
+        for d, v in outer_vals:
+            cols.append(DevVal(d[safe], v[safe] & (rid < cap)))
+        ectx = EvalCtx(cols, ctx.aux, jnp.asarray(ecap, jnp.int32), ecap,
+                       live=elem_live)
+        ectx._prep_iter = iter(prep.body_preps)
+        return _walk_eval(self._rebound, ectx)
+
+    # -- CPU oracle ---------------------------------------------------------
+    def _eval_body_cpu(self, table: HostTable, var_cols: List[HostColumn],
+                       rids: np.ndarray) -> HostColumn:
+        cols = list(var_cols)
+        names = [f"__v{i}" for i in range(len(var_cols))]
+        for j, c in enumerate(self.children[1:]):
+            src = c.eval_cpu(table)
+            cols.append(HostColumn(src.dtype, src.data[rids],
+                                   src.validity[rids]))
+            names.append(f"__o{j}")
+        elem_table = HostTable(names, cols)
+        return self._rebound.eval_cpu(elem_table)
+
+
+class ArrayTransform(_HigherOrder):
+    """transform(arr, x -> f(x)) (optionally (x, i) -> ...)."""
+
+    def _var_types(self):
+        et = self.children[0].data_type.element_type
+        return [et, T.INT][:len(self.fn.var_names)]
+
+    @property
+    def data_type(self):
+        return T.ArrayType(self._rebound.data_type
+                           if self._rebound is not None
+                           else self.fn.body.data_type)
+
+    @property
+    def device_supported(self):
+        return (super().device_supported
+                and fixed_np_dtype(self.data_type.element_type) is not None)
+
+    def eval_cpu(self, table):
+        c = self.children[0].eval_cpu(table)
+        n = len(c)
+        rids, elems, evalid = _flatten_cpu(c)
+        vts = self._var_types()
+        var_cols = [HostColumn(vts[0], elems, evalid)]
+        if len(vts) > 1:
+            var_cols.append(HostColumn(T.INT, _positions_cpu(c), np.ones(
+                len(elems), dtype=np.bool_)))
+        body = self._eval_body_cpu(table, var_cols, rids)
+        out = np.empty(n, dtype=object)
+        pos = 0
+        for i in range(n):
+            if c.validity[i]:
+                ln = len(c.data[i])
+                out[i] = [
+                    (body.data[pos + j].item()
+                     if hasattr(body.data[pos + j], "item")
+                     else body.data[pos + j])
+                    if body.validity[pos + j] else None
+                    for j in range(ln)]
+                pos += ln
+        return HostColumn(self.data_type, out, c.validity.copy())
+
+    def eval_dev(self, ctx, child_vals, prep) -> DevVal:
+        c = child_vals[0]
+        off, ed, ev = c.data
+        ecap = int(ed.shape[0])
+        cap = ctx.capacity
+        rid = _elem_rids(off, ecap, cap)
+        elem_live = rid < cap
+        var_vals = [DevVal(ed, ev)]
+        if len(self.fn.var_names) > 1:
+            pos = jnp.arange(ecap, dtype=jnp.int32) - off[
+                jnp.clip(rid, 0, cap - 1)]
+            var_vals.append(DevVal(pos.astype(jnp.int32), elem_live))
+        body = self._eval_body(ctx, prep, var_vals, child_vals[1:], rid,
+                               ecap, elem_live)
+        return DevVal((off, body.data, body.validity & elem_live),
+                      c.validity)
+
+
+class ArrayFilter(_HigherOrder):
+    """filter(arr, x -> pred)."""
+
+    def _var_types(self):
+        et = self.children[0].data_type.element_type
+        return [et, T.INT][:len(self.fn.var_names)]
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type
+
+    def eval_cpu(self, table):
+        c = self.children[0].eval_cpu(table)
+        n = len(c)
+        rids, elems, evalid = _flatten_cpu(c)
+        vts = self._var_types()
+        var_cols = [HostColumn(vts[0], elems, evalid)]
+        if len(vts) > 1:
+            var_cols.append(HostColumn(T.INT, _positions_cpu(c), np.ones(
+                len(elems), dtype=np.bool_)))
+        body = self._eval_body_cpu(table, var_cols, rids)
+        out = np.empty(n, dtype=object)
+        pos = 0
+        for i in range(n):
+            if c.validity[i]:
+                ln = len(c.data[i])
+                out[i] = [c.data[i][j] for j in range(ln)
+                          if body.validity[pos + j]
+                          and bool(body.data[pos + j])]
+                pos += ln
+        return HostColumn(self.data_type, out, c.validity.copy())
+
+    def eval_dev(self, ctx, child_vals, prep) -> DevVal:
+        c = child_vals[0]
+        off, ed, ev = c.data
+        ecap = int(ed.shape[0])
+        cap = ctx.capacity
+        rid = _elem_rids(off, ecap, cap)
+        elem_live = rid < cap
+        var_vals = [DevVal(ed, ev)]
+        if len(self.fn.var_names) > 1:
+            pos = jnp.arange(ecap, dtype=jnp.int32) - off[
+                jnp.clip(rid, 0, cap - 1)]
+            var_vals.append(DevVal(pos.astype(jnp.int32), elem_live))
+        body = self._eval_body(ctx, prep, var_vals, child_vals[1:], rid,
+                               ecap, elem_live)
+        keep = body.data & body.validity & elem_live
+        counts = jax.ops.segment_sum(
+            keep.astype(jnp.int32), jnp.where(elem_live, rid, cap),
+            num_segments=cap + 1)[:cap]
+        noff = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                                jnp.cumsum(counts).astype(jnp.int32)])
+        cpos = jnp.cumsum(keep.astype(jnp.int32)) - 1
+        tgt = jnp.where(keep, cpos, ecap)
+        from spark_rapids_tpu.ops.scatter32 import scatter_pair
+        ned, nev = scatter_pair(ecap, tgt, ed, ev)
+        return DevVal((noff, ned, nev), c.validity)
+
+
+class _ArrayPredicate(_HigherOrder):
+    """exists / forall — Spark three-valued logic."""
+
+    exists = True
+
+    def _var_types(self):
+        return [self.children[0].data_type.element_type]
+
+    @property
+    def data_type(self):
+        return T.BOOLEAN
+
+    def eval_cpu(self, table):
+        c = self.children[0].eval_cpu(table)
+        n = len(c)
+        rids, elems, evalid = _flatten_cpu(c)
+        var_cols = [HostColumn(self._var_types()[0], elems, evalid)]
+        body = self._eval_body_cpu(table, var_cols, rids)
+        data = np.zeros(n, dtype=np.bool_)
+        validity = np.zeros(n, dtype=np.bool_)
+        pos = 0
+        for i in range(n):
+            if not c.validity[i]:
+                continue
+            ln = len(c.data[i])
+            vals = [bool(body.data[pos + j]) if body.validity[pos + j]
+                    else None for j in range(ln)]
+            pos += ln
+            hit = any(v is (True if self.exists else False) for v in vals)
+            has_null = any(v is None for v in vals)
+            if self.exists:
+                data[i], validity[i] = (True, True) if hit else \
+                    (False, not has_null)
+            else:
+                data[i], validity[i] = (False, True) if hit else \
+                    (True, not has_null)
+        return HostColumn(T.BOOLEAN, data, validity)
+
+    def eval_dev(self, ctx, child_vals, prep) -> DevVal:
+        c = child_vals[0]
+        off, ed, ev = c.data
+        ecap = int(ed.shape[0])
+        cap = ctx.capacity
+        rid = _elem_rids(off, ecap, cap)
+        elem_live = rid < cap
+        body = self._eval_body(ctx, prep, [DevVal(ed, ev)],
+                               child_vals[1:], rid, ecap, elem_live)
+        seg = jnp.where(elem_live, rid, cap)
+        want = body.data if self.exists else ~body.data
+        hit = jax.ops.segment_max(
+            (want & body.validity & elem_live).astype(jnp.int32), seg,
+            num_segments=cap + 1)[:cap] > 0
+        nulls = jax.ops.segment_max(
+            (~body.validity & elem_live).astype(jnp.int32), seg,
+            num_segments=cap + 1)[:cap] > 0
+        if self.exists:
+            data = hit
+            validity = (hit | ~nulls) & c.validity
+        else:
+            data = ~hit
+            validity = (hit | ~nulls) & c.validity
+        return DevVal(data & validity, validity)
+
+
+class ArrayExists(_ArrayPredicate):
+    exists = True
+
+
+class ArrayForAll(_ArrayPredicate):
+    exists = False
+
+
+class _MapLambda(_HigherOrder):
+    """Shared (k, v) lambda machinery for map HOFs."""
+
+    def _var_types(self):
+        mt = self.children[0].data_type
+        return [mt.key_type, mt.value_type]
+
+    def _map_eval(self, ctx, child_vals, prep):
+        c = child_vals[0]
+        md: MapData = c.data
+        ecap = int(md.kdata.shape[0])
+        cap = ctx.capacity
+        rid = _elem_rids(md.offsets, ecap, cap)
+        elem_live = rid < cap
+        body = self._eval_body(
+            ctx, prep, [DevVal(md.kdata, md.kvalid),
+                        DevVal(md.vdata, md.vvalid)],
+            child_vals[1:], rid, ecap, elem_live)
+        return md, rid, elem_live, body, ecap, cap
+
+    def _flatten_map_cpu(self, c):
+        rids, keys, kvalid, vals, vvalid = [], [], [], [], []
+        for i in range(len(c)):
+            if c.validity[i]:
+                for k, v in c.data[i].items():
+                    rids.append(i)
+                    keys.append(k)
+                    kvalid.append(True)
+                    vals.append(v if v is not None else 0)
+                    vvalid.append(v is not None)
+        mt = self.children[0].data_type
+        return (np.asarray(rids, dtype=np.int64),
+                HostColumn(mt.key_type,
+                           np.asarray(keys, dtype=fixed_np_dtype(
+                               mt.key_type) or object),
+                           np.asarray(kvalid, dtype=np.bool_)),
+                HostColumn(mt.value_type,
+                           np.asarray(vals, dtype=fixed_np_dtype(
+                               mt.value_type) or object),
+                           np.asarray(vvalid, dtype=np.bool_)))
+
+
+class MapFilter(_MapLambda):
+    """map_filter(m, (k, v) -> pred)."""
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type
+
+    def eval_cpu(self, table):
+        c = self.children[0].eval_cpu(table)
+        rids, kc, vc = self._flatten_map_cpu(c)
+        body = self._eval_body_cpu(table, [kc, vc], rids)
+        out = np.empty(len(c), dtype=object)
+        pos = 0
+        for i in range(len(c)):
+            if c.validity[i]:
+                m = {}
+                for k, v in c.data[i].items():
+                    if body.validity[pos] and bool(body.data[pos]):
+                        m[k] = v
+                    pos += 1
+                out[i] = m
+        return HostColumn(self.data_type, out, c.validity.copy())
+
+    def eval_dev(self, ctx, child_vals, prep) -> DevVal:
+        md, rid, elem_live, body, ecap, cap = self._map_eval(
+            ctx, child_vals, prep)
+        keep = body.data & body.validity & elem_live & md.kvalid
+        counts = jax.ops.segment_sum(
+            keep.astype(jnp.int32), jnp.where(elem_live, rid, cap),
+            num_segments=cap + 1)[:cap]
+        noff = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                                jnp.cumsum(counts).astype(jnp.int32)])
+        cpos = jnp.cumsum(keep.astype(jnp.int32)) - 1
+        tgt = jnp.where(keep, cpos, ecap)
+        from spark_rapids_tpu.ops.scatter32 import scatter_pair
+        nkd, nkv = scatter_pair(ecap, tgt, md.kdata, md.kvalid)
+        nvd, nvv = scatter_pair(ecap, tgt, md.vdata, md.vvalid)
+        return DevVal(MapData(noff, nkd, nkv, nvd, nvv),
+                      child_vals[0].validity)
+
+
+class TransformValues(_MapLambda):
+    """transform_values(m, (k, v) -> f)."""
+
+    @property
+    def data_type(self):
+        mt = self.children[0].data_type
+        vt = self._rebound.data_type if self._rebound is not None \
+            else self.fn.body.data_type
+        return T.MapType(key_type=mt.key_type, value_type=vt)
+
+    @property
+    def device_supported(self):
+        return (super().device_supported
+                and fixed_np_dtype(self.data_type.value_type) is not None)
+
+    def eval_cpu(self, table):
+        c = self.children[0].eval_cpu(table)
+        rids, kc, vc = self._flatten_map_cpu(c)
+        body = self._eval_body_cpu(table, [kc, vc], rids)
+        out = np.empty(len(c), dtype=object)
+        pos = 0
+        for i in range(len(c)):
+            if c.validity[i]:
+                m = {}
+                for k in c.data[i]:
+                    m[k] = (body.data[pos].item()
+                            if hasattr(body.data[pos], "item")
+                            else body.data[pos]) \
+                        if body.validity[pos] else None
+                    pos += 1
+                out[i] = m
+        return HostColumn(self.data_type, out, c.validity.copy())
+
+    def eval_dev(self, ctx, child_vals, prep) -> DevVal:
+        md, rid, elem_live, body, ecap, cap = self._map_eval(
+            ctx, child_vals, prep)
+        return DevVal(MapData(md.offsets, md.kdata, md.kvalid,
+                              body.data, body.validity & elem_live),
+                      child_vals[0].validity)
+
+
+class TransformKeys(_MapLambda):
+    """transform_keys(m, (k, v) -> f). Per Spark, a transform producing a
+    null key raises; duplicate new keys follow the dedup policy — the
+    device kernel applies LAST_WIN (no per-row raise on device)."""
+
+    @property
+    def data_type(self):
+        mt = self.children[0].data_type
+        kt = self._rebound.data_type if self._rebound is not None \
+            else self.fn.body.data_type
+        return T.MapType(key_type=kt, value_type=mt.value_type)
+
+    @property
+    def device_supported(self):
+        return (super().device_supported
+                and fixed_np_dtype(self.data_type.key_type) is not None)
+
+    def eval_cpu(self, table):
+        c = self.children[0].eval_cpu(table)
+        rids, kc, vc = self._flatten_map_cpu(c)
+        body = self._eval_body_cpu(table, [kc, vc], rids)
+        out = np.empty(len(c), dtype=object)
+        pos = 0
+        for i in range(len(c)):
+            if c.validity[i]:
+                m = {}
+                for k, v in c.data[i].items():
+                    if not body.validity[pos]:
+                        raise ColumnarProcessingError(
+                            "Cannot use null as map key")
+                    nk = body.data[pos].item() \
+                        if hasattr(body.data[pos], "item") else body.data[pos]
+                    m[nk] = v
+                    pos += 1
+                out[i] = m
+        return HostColumn(self.data_type, out, c.validity.copy())
+
+    def eval_dev(self, ctx, child_vals, prep) -> DevVal:
+        md, rid, elem_live, body, ecap, cap = self._map_eval(
+            ctx, child_vals, prep)
+        return DevVal(MapData(md.offsets, body.data,
+                              body.validity & elem_live,
+                              md.vdata, md.vvalid),
+                      child_vals[0].validity)
+
+
+class ArraysZip(Expression):
+    """arrays_zip(a1, a2, ...) -> array<struct<...>> — CPU path (device
+    arrays hold fixed-width elements only; array<struct> is not device-
+    representable yet, same carve-out as MapEntries)."""
+
+    device_supported = False
+
+    def __init__(self, *children: Expression):
+        self.children = tuple(children)
+
+    @property
+    def data_type(self):
+        return T.ArrayType(T.StructType([
+            T.StructField(str(i), c.data_type.element_type)
+            for i, c in enumerate(self.children)]))
+
+    def key(self):
+        return ("arrayszip", tuple(c.key() for c in self.children))
+
+    def with_children(self, children):
+        return ArraysZip(*children)
+
+    def eval_cpu(self, table):
+        kids = [c.eval_cpu(table) for c in self.children]
+        n = table.num_rows
+        out = np.empty(n, dtype=object)
+        validity = np.ones(n, dtype=np.bool_)
+        for i in range(n):
+            if any(not k.validity[i] for k in kids):
+                validity[i] = False
+                continue
+            ln = max(len(k.data[i]) for k in kids)
+            out[i] = [tuple(k.data[i][j] if j < len(k.data[i]) else None
+                            for k in kids) for j in range(ln)]
+        return HostColumn(self.data_type, out, validity)
+
+
+# -- cpu flatten helpers -----------------------------------------------------
+
+def _flatten_cpu(c: HostColumn):
+    rids, elems, evalid = [], [], []
+    edt = fixed_np_dtype(c.dtype.element_type)
+    for i in range(len(c)):
+        if c.validity[i]:
+            for v in c.data[i]:
+                rids.append(i)
+                elems.append(v if v is not None else 0)
+                evalid.append(v is not None)
+    return (np.asarray(rids, dtype=np.int64),
+            np.asarray(elems, dtype=edt or object),
+            np.asarray(evalid, dtype=np.bool_))
+
+
+def _positions_cpu(c: HostColumn):
+    pos = []
+    for i in range(len(c)):
+        if c.validity[i]:
+            pos.extend(range(len(c.data[i])))
+    return np.asarray(pos, dtype=np.int32)

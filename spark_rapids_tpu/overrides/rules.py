@@ -32,6 +32,7 @@ from spark_rapids_tpu.ops.expr import Expression
 from spark_rapids_tpu.overrides.typesig import (
     COMMON,
     COMMON_PLUS_ARRAYS,
+    COMMON_PLUS_NESTED,
     ORDERABLE,
     TypeSig,
 )
@@ -77,9 +78,9 @@ def _build_expr_sigs():
                     and "eval_dev" in {m for kls in obj.__mro__ for m in vars(kls)}
                     and getattr(obj, "eval_dev", None) is not Expression.eval_dev):
                 reg(obj)
-    reg(expr_mod.BoundReference, COMMON_PLUS_ARRAYS)
+    reg(expr_mod.BoundReference, COMMON_PLUS_NESTED)
     reg(expr_mod.Literal)
-    reg(expr_mod.Alias, COMMON_PLUS_ARRAYS)
+    reg(expr_mod.Alias, COMMON_PLUS_NESTED)
     reg(cast.Cast)
     from spark_rapids_tpu.ops import json_fns
     reg(json_fns.GetJsonObject)
@@ -104,6 +105,13 @@ def _build_expr_sigs():
     reg(coll.ArrayMax)
     reg(coll.SortArray, COMMON_PLUS_ARRAYS)
     reg(coll.CreateArray, COMMON_PLUS_ARRAYS)
+    from spark_rapids_tpu.ops import nested as nested_ops
+    for name in ("CreateNamedStruct", "GetStructField", "CreateMap",
+                 "GetMapValue", "MapKeys", "MapValues", "MapEntries",
+                 "MapConcat", "MapFilter", "TransformKeys",
+                 "TransformValues", "ArrayTransform", "ArrayFilter",
+                 "ArrayExists", "ArrayForAll", "ArraysZip"):
+        reg(getattr(nested_ops, name), COMMON_PLUS_NESTED)
     for fn in DEVICE_SUPPORTED_AGGS:
         reg(fn)
 
@@ -134,6 +142,12 @@ def check_expr(e: Expression, conf: RapidsConf, reasons: List[str], context: str
         reasons.append(f"expression {where} configuration is not supported on TPU")
     for c in e.children:
         check_expr(c, conf, reasons, context)
+    # higher-order functions carry their rebound lambda body OUTSIDE
+    # children (ops/nested.py); its expressions face the same sig/conf
+    # gating as everything else
+    body = getattr(e, "_rebound", None)
+    if body is not None:
+        check_expr(body, conf, reasons, context + "lambda body ")
 
 
 # ---------------------------------------------------------------------------
@@ -167,13 +181,13 @@ def _check_output_schema(meta: "PlanMeta", conf: RapidsConf, sig=COMMON):
 
 
 def _tag_scan(meta, conf):
-    # scans may carry fixed-element array columns (device (offsets, values,
-    # validity) representation)
-    _check_output_schema(meta, conf, COMMON_PLUS_ARRAYS)
+    # scans may carry fixed-element arrays, fixed-field structs and
+    # fixed-width maps (device representations in columnar/)
+    _check_output_schema(meta, conf, COMMON_PLUS_NESTED)
 
 
 def _tag_project(meta, conf):
-    _check_output_schema(meta, conf, COMMON_PLUS_ARRAYS)
+    _check_output_schema(meta, conf, COMMON_PLUS_NESTED)
     for e in meta.node.exprs:
         check_expr(e, conf, meta.reasons)
 

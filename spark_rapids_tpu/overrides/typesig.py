@@ -71,7 +71,41 @@ class AnyOfSig(TypeSig):
 
 ARRAY_FIXED = ArrayFixedSig()
 
+
+class StructFixedSig(TypeSig):
+    """Structs whose fields are all fixed-width (the device field-bundle
+    representation — columnar/nested.py)."""
+
+    def __init__(self):
+        super().__init__()
+
+    def supports(self, dt: T.DataType) -> bool:
+        from spark_rapids_tpu.columnar.nested import struct_device_supported
+        return (isinstance(dt, T.StructType)
+                and struct_device_supported(dt))
+
+
+class MapFixedSig(TypeSig):
+    """Maps with fixed-width keys and values (the device split-stream
+    representation — columnar/nested.py)."""
+
+    def __init__(self):
+        super().__init__()
+
+    def supports(self, dt: T.DataType) -> bool:
+        from spark_rapids_tpu.columnar.nested import map_device_supported
+        return isinstance(dt, T.MapType) and map_device_supported(dt)
+
+
+STRUCT_FIXED = StructFixedSig()
+MAP_FIXED = MapFixedSig()
+
 #: scalar COMMON plus fixed-element arrays — the surface Scan/Project/
 #: Generate handle on device (other execs keep COMMON: their kernels
 #: compact/gather/sort flat buffers only)
 COMMON_PLUS_ARRAYS = AnyOfSig(COMMON, ARRAY_FIXED)
+
+#: ...plus fixed-field structs and fixed-width maps — Scan/Project only
+#: (joins/sorts/aggs over raw nested columns tag fallback, like the
+#: reference's per-op nested carve-outs in TypeChecks.scala)
+COMMON_PLUS_NESTED = AnyOfSig(COMMON, ARRAY_FIXED, STRUCT_FIXED, MAP_FIXED)

@@ -104,10 +104,11 @@ MAX_RETRIES_VAR = contextvars.ContextVar("rapids_oom_max_retries", default=2)
 def split_device_table_in_half(dt: DeviceTable) -> List[DeviceTable]:
     """Halve a batch by rows (splitSpillableInHalfByRows analog). Slicing
     device arrays re-buckets each half to the smaller capacity."""
-    if any(getattr(c, "is_array", False) for c in dt.columns):
+    if any(getattr(c, "is_nested", False) for c in dt.columns):
         raise FatalDeviceOOM(
-            "cannot row-split a batch with array columns (rebuilding "
-            "offsets under OOM is unsupported; reduce batch size instead)")
+            "cannot row-split a batch with nested (array/struct/map) "
+            "columns (rebuilding offsets under OOM is unsupported; reduce "
+            "batch size instead)")
     dt = dt.compacted()  # masked batches: prefix order before row slicing
     n = dt.num_rows
     if n < 2:

@@ -283,6 +283,37 @@ def is_nested(dt: DataType) -> bool:
     return isinstance(dt, (ArrayType, StructType, MapType))
 
 
+_NAME_TO_TYPE = None
+
+
+def parse_type(name: str) -> DataType:
+    """Spark simple-string type names -> DataType (cast('bigint') etc.)."""
+    global _NAME_TO_TYPE
+    if _NAME_TO_TYPE is None:
+        _NAME_TO_TYPE = {
+            "boolean": BOOLEAN, "bool": BOOLEAN,
+            "tinyint": BYTE, "byte": BYTE,
+            "smallint": SHORT, "short": SHORT,
+            "int": INT, "integer": INT,
+            "bigint": LONG, "long": LONG,
+            "float": FLOAT, "real": FLOAT,
+            "double": DOUBLE,
+            "string": STRING,
+            "date": DATE,
+            "timestamp": TIMESTAMP,
+        }
+    key = name.strip().lower()
+    if key in _NAME_TO_TYPE:
+        return _NAME_TO_TYPE[key]
+    if key.startswith("decimal"):
+        import re
+        m = re.match(r"decimal\((\d+),\s*(\d+)\)", key)
+        if m:
+            return DecimalType(int(m.group(1)), int(m.group(2)))
+        return DecimalType(10, 0)
+    raise TypeError(f"cannot parse type name {name!r}")
+
+
 def python_to_spark_type(value) -> DataType:
     """Infer the Spark type of a Python literal (Spark Literal.apply analog)."""
     if value is None:

@@ -1,0 +1,201 @@
+"""Device STRUCT/MAP columns + higher-order array functions
+(columnar/nested.py, ops/nested.py — reference: complexTypeCreator.scala,
+higherOrderFunctions.scala, collectionOperations.scala map family).
+
+Every test compares the device path against the CPU oracle, including
+nested null propagation."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import functions as F
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.ops.expr import col, lit
+from spark_rapids_tpu.session import TpuSession
+
+
+@pytest.fixture(scope="module")
+def tpu():
+    return TpuSession()
+
+
+@pytest.fixture(scope="module")
+def cpu():
+    return TpuSession({"spark.rapids.sql.enabled": "false"})
+
+
+def _data(n=200, seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "a": rng.integers(-50, 50, n).astype(np.int64),
+        "b": rng.random(n),
+        "c": rng.integers(0, 5, n).astype(np.int32),
+    }
+
+
+def _check(tpu, cpu, make, data=None):
+    data = data or _data()
+    got = make(tpu.create_dataframe(data)).collect()
+    want = make(cpu.create_dataframe(data)).collect()
+    assert repr(got) == repr(want), f"\n tpu={got[:4]}\n cpu={want[:4]}"
+    return got
+
+
+# -- struct ------------------------------------------------------------------
+
+def test_struct_scan_roundtrip(tpu, cpu):
+    st = T.StructType([T.StructField("x", T.LONG),
+                       T.StructField("y", T.DOUBLE)])
+    vals = [(1, 2.5), None, (3, None), (-7, 0.0)]
+    for s in (tpu, cpu):
+        got = s.create_dataframe({"s": vals}, dtypes={"s": st}).collect()
+        assert [r[0] for r in got] == vals
+
+
+def test_create_struct_and_get_field(tpu, cpu):
+    _check(tpu, cpu, lambda df: df.select(
+        F.struct(col("a"), col("b"), names=["x", "y"]).alias("s")))
+    _check(tpu, cpu, lambda df: df.select(
+        F.get_field(F.struct(col("a"), col("b"), names=["x", "y"]),
+                    "x").alias("v")))
+    # field access null propagation: null struct row -> null field
+    st = T.StructType([T.StructField("x", T.LONG)])
+    for s in (tpu, cpu):
+        got = s.create_dataframe(
+            {"s": [(5,), None, (None,)]}, dtypes={"s": st}).select(
+            F.get_field(col("s"), "x").alias("v")).collect()
+        assert [r[0] for r in got] == [5, None, None]
+
+
+def test_struct_field_in_filter_predicate(tpu, cpu):
+    _check(tpu, cpu, lambda df: df.select(
+        F.struct(col("a"), col("c"), names=["x", "y"]).alias("s"),
+        col("a"))
+        .filter(F.get_field(col("s"), "x") > lit(0))
+        .select(col("a")))
+
+
+def test_named_struct(tpu, cpu):
+    _check(tpu, cpu, lambda df: df.select(
+        F.named_struct("p", col("a"), "q", col("c")).alias("s")))
+
+
+# -- map ---------------------------------------------------------------------
+
+def test_map_scan_roundtrip(tpu, cpu):
+    mt = T.MapType(key_type=T.LONG, value_type=T.DOUBLE)
+    vals = [{1: 2.0, 3: None}, None, {}, {9: -1.5}]
+    for s in (tpu, cpu):
+        got = s.create_dataframe({"m": vals}, dtypes={"m": mt}).collect()
+        assert [r[0] for r in got] == vals
+
+
+def test_create_map_keys_values(tpu, cpu):
+    _check(tpu, cpu, lambda df: df.select(
+        F.create_map(col("a"), col("b")).alias("m")))
+    _check(tpu, cpu, lambda df: df.select(
+        F.map_keys(F.create_map(col("a"), col("b"),
+                                col("a") + lit(100), col("b"))).alias("k")))
+    _check(tpu, cpu, lambda df: df.select(
+        F.map_values(F.create_map(col("a"), col("b"))).alias("v")))
+
+
+def test_get_map_value(tpu, cpu):
+    _check(tpu, cpu, lambda df: df.select(F.get_map_value(
+        F.create_map(col("a"), col("b"), col("a") + lit(1),
+                     col("b") + lit(1.0)),
+        col("a") + lit(1)).alias("v")))
+    # missing key -> null
+    _check(tpu, cpu, lambda df: df.select(F.get_map_value(
+        F.create_map(col("a"), col("b")), col("a") + lit(999)).alias("v")))
+
+
+def test_map_concat_last_win(tpu, cpu):
+    _check(tpu, cpu, lambda df: df.select(F.map_concat(
+        F.create_map(col("a"), col("b")),
+        F.create_map(col("a"), col("b") + lit(10.0)),  # same key: last wins
+        F.create_map(col("a") + lit(1), col("b"))).alias("m")))
+
+
+def test_map_entries_cpu_fallback(tpu, cpu):
+    got = _check(tpu, cpu, lambda df: df.select(F.map_entries(
+        F.create_map(col("a"), col("b"))).alias("e")))
+    assert isinstance(got[0][0], list)
+
+
+# -- higher-order functions --------------------------------------------------
+
+def test_transform_with_outer_ref(tpu, cpu):
+    _check(tpu, cpu, lambda df: df.select(F.transform(
+        F.array(col("a"), col("a") + lit(1), col("c").cast("bigint")),
+        lambda x: x * lit(2) + col("a")).alias("t")))
+
+
+def test_transform_with_index(tpu, cpu):
+    _check(tpu, cpu, lambda df: df.select(F.transform(
+        F.array(col("a"), col("a") * lit(3)),
+        lambda x, i: x + i).alias("t")))
+
+
+def test_transform_null_elements(tpu, cpu):
+    at = T.ArrayType(T.LONG)
+    data = {"arr": [[1, None, 3], None, [], [None]]}
+    for s in (tpu, cpu):
+        got = s.create_dataframe(data, dtypes={"arr": at}).select(
+            F.transform(col("arr"), lambda x: x + lit(10)).alias("t")
+        ).collect()
+        assert [r[0] for r in got] == [[11, None, 13], None, [], [None]]
+
+
+def test_filter_array(tpu, cpu):
+    _check(tpu, cpu, lambda df: df.select(F.filter_array(
+        F.array(col("a"), col("a") + lit(1), col("a") + lit(2)),
+        lambda x: x % lit(2) == lit(0)).alias("t")))
+
+
+def test_exists_forall_three_valued(tpu, cpu):
+    at = T.ArrayType(T.LONG)
+    data = {"arr": [[1, 2], [None, 2], [None, 5], [], None, [7]]}
+    for s in (tpu, cpu):
+        got = s.create_dataframe(data, dtypes={"arr": at}).select(
+            F.exists(col("arr"), lambda x: x == lit(2)).alias("e"),
+            F.forall(col("arr"), lambda x: x > lit(0)).alias("f"),
+        ).collect()
+        # exists: [T, T, null, F, null-row, F]
+        assert [r[0] for r in got] == [True, True, None, False, None, False]
+        # forall: [T, null, null, T, null-row, T]
+        assert [r[1] for r in got] == [True, None, None, True, None, True]
+
+
+def test_map_filter_and_transforms(tpu, cpu):
+    mk = lambda: F.create_map(col("a"), col("b"),
+                              col("a") + lit(7), col("b") + lit(2.0))
+    _check(tpu, cpu, lambda df: df.select(
+        F.map_filter(mk(), lambda k, v: k > lit(0)).alias("m")))
+    _check(tpu, cpu, lambda df: df.select(
+        F.transform_values(mk(), lambda k, v: v * lit(3.0) + k.cast(
+            "double")).alias("m")))
+    _check(tpu, cpu, lambda df: df.select(
+        F.transform_keys(mk(), lambda k, v: k * lit(2)).alias("m")))
+
+
+def test_arrays_zip_cpu(tpu, cpu):
+    _check(tpu, cpu, lambda df: df.select(F.arrays_zip(
+        F.array(col("a")), F.array(col("c").cast("bigint"),
+                                   col("a"))).alias("z")))
+
+
+def test_nested_fallback_tagging(tpu):
+    """Sorting BY a raw struct column tags fallback (device kernels sort
+    flat buffers only) but the query still answers via CPU."""
+    st = T.StructType([T.StructField("x", T.LONG)])
+    df = tpu.create_dataframe({"s": [(3,), (1,), (2,)]}, dtypes={"s": st})
+    got = df.select(F.get_field(col("s"), "x").alias("x")).sort("x").collect()
+    assert [r[0] for r in got] == [1, 2, 3]
+
+
+def test_hof_survives_masked_input(tpu, cpu):
+    """HOF over a masked (filtered, uncompacted) batch."""
+    _check(tpu, cpu, lambda df: df.filter(col("a") > lit(0)).select(
+        F.transform(F.array(col("a"), col("c").cast("bigint")),
+                    lambda x: x + lit(1)).alias("t")))
