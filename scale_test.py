@@ -84,8 +84,35 @@ def build_queries(s, tables):
                 .agg(F.max("o_totalprice").alias("m"))
                 .agg(F.count("m").alias("n_custs")))
 
+    def q9():  # TPC-H q5-like: 2-level join + filters + group + topk
+        import datetime as _dt
+        cut = _dt.date(1970, 1, 1) + _dt.timedelta(days=9000)
+        cj = cust().select("c_custkey", "c_nationkey")
+        oj = (orders().filter(col("o_orderdate") >= lit(cut))
+              .select("o_orderkey", "o_custkey"))
+        j1 = (li().select("l_orderkey", "l_extendedprice", "l_discount")
+              .join(oj.with_column("l_orderkey", col("o_orderkey")),
+                    on=["l_orderkey"], how="inner"))
+        j2 = j1.with_column("c_custkey", col("o_custkey")).join(
+            cj, on=["c_custkey"], how="inner")
+        return (j2.select(col("c_nationkey"),
+                          (col("l_extendedprice")
+                           * (lit(1.0) - col("l_discount"))).alias("rev"))
+                .group_by("c_nationkey")
+                .agg(F.sum("rev").alias("revenue"))
+                .sort("revenue", ascending=False).limit(10))
+
+    def q10():  # TPC-H q17-like: join against an aggregated subquery
+        avg_q = (li().group_by("l_orderkey")
+                 .agg(F.avg("l_quantity").alias("avg_qty")))
+        j = li().select("l_orderkey", "l_quantity", "l_extendedprice")\
+            .join(avg_q, on=["l_orderkey"], how="inner")
+        return (j.filter(col("l_quantity").cast("double")
+                         < lit(0.6) * col("avg_qty"))
+                .agg(F.sum("l_extendedprice").alias("total")))
+
     return {"q1": q1, "q2": q2, "q3": q3, "q4": q4, "q5": q5,
-            "q6": q6, "q7": q7, "q8": q8}
+            "q6": q6, "q7": q7, "q8": q8, "q9": q9, "q10": q10}
 
 
 def time_query(fn, runs=2):
@@ -106,6 +133,7 @@ def main():
     ap.add_argument("--queries", type=str, default="")
     ap.add_argument("--cpu-baseline", action="store_true")
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--out", type=str, default="")
     args = ap.parse_args()
 
     from spark_rapids_tpu.datagen import scale_test_specs
@@ -139,7 +167,20 @@ def main():
             entry["speedup"] = round(cpu_warm / warm, 3) if warm else None
         report["queries"][name] = entry
         print(json.dumps({"query": name, **entry}))
+    speedups = [e["speedup"] for e in report["queries"].values()
+                if e.get("speedup")]
+    if speedups:
+        import math
+        report["geomean_speedup"] = round(
+            math.exp(sum(math.log(x) for x in speedups) / len(speedups)), 3)
+    report["warm_total_s"] = round(
+        sum(e["warm_s"] for e in report["queries"].values()), 4)
+    report["cold_total_s"] = round(
+        sum(e["cold_s"] for e in report["queries"].values()), 4)
     print(json.dumps(report))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=1)
 
 
 if __name__ == "__main__":

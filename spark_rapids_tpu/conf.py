@@ -152,6 +152,13 @@ MASKED_BATCHES = bool_conf(
     "split boundaries (columnar/table.py DeviceTable.live).",
     commonly_used=True)
 
+DPP_ENABLED = bool_conf(
+    "spark.rapids.sql.dpp.enabled", True,
+    "Dynamic partition pruning: when a broadcast join's probe side scans "
+    "a Hive-partitioned source keyed on a partition column, prune the "
+    "scan's file list to the build side's distinct key values before "
+    "reading (GpuFileSourceScanExec DynamicPruningExpression analog).")
+
 JOIN_DIRECT_TABLE_MULT = int_conf(
     "spark.rapids.tpu.join.directTableMultiplier", 4,
     "Direct-address join fast path: the key-range table is this multiple "

@@ -238,12 +238,14 @@ class DeltaScanNode(FileScanNode):
         out = [n2 for n2, _ in self._schema]
         return HostTable(out, [by_name[n2] for n2 in out])
 
-    def execute_cpu(self) -> Iterator[HostTable]:
+    def execute_cpu(self, dynamic_prunes=None,
+                    metrics=None) -> Iterator[HostTable]:
         if self._empty:
             from spark_rapids_tpu.plan.nodes import _empty_table
             yield _empty_table(self.output_schema())
             return
-        yield from super().execute_cpu()
+        yield from super().execute_cpu(dynamic_prunes=dynamic_prunes,
+                                       metrics=metrics)
 
     def estimate_bytes(self):
         return sum(a.size for a in self.snap.files)

@@ -76,13 +76,22 @@ class TpuFileScanExec(TpuExec):
     def __init__(self, scan_node):
         super().__init__()
         self.scan_node = scan_node
+        #: execution-scoped dynamic partition pruning filters — owned by
+        #: THIS converted exec, never by the shared logical scan node
+        #: (overrides/rules._maybe_install_dpp)
+        self._dynamic_prunes: list = []
+
+    def install_dynamic_pruning(self, part_col: str, provider) -> None:
+        self._dynamic_prunes.append((part_col, provider))
 
     def output_schema(self):
         return self.scan_node.output_schema()
 
     def execute(self):
         import time
-        for batch in self.scan_node.execute_cpu():
+        for batch in self.scan_node.execute_cpu(
+                dynamic_prunes=self._dynamic_prunes or None,
+                metrics=self.metrics):
             t0 = time.perf_counter()
             dt = DeviceTable.from_host(batch)
             self.add_metric("scanUploadTime", time.perf_counter() - t0)

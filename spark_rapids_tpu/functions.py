@@ -667,3 +667,20 @@ def _lambda_arity(fn) -> int:
         return len(fn.var_names)
     import inspect
     return builtins.max(len(inspect.signature(fn).parameters), 1)
+
+
+def build_bloom_filter(df, column, num_bits=None, num_hashes=None):
+    """bloom_filter_agg analog: aggregate a DataFrame column into a
+    device-resident BloomFilter handle (ops/bloom.py)."""
+    from spark_rapids_tpu.ops import bloom as B
+    kw = {}
+    if num_bits is not None:
+        kw["num_bits"] = num_bits
+    if num_hashes is not None:
+        kw["num_hashes"] = num_hashes
+    return B.build_bloom_filter(df, column, **kw)
+
+
+def might_contain(bloom, e):
+    from spark_rapids_tpu.ops.bloom import BloomFilterMightContain
+    return BloomFilterMightContain(bloom, _e(e))

@@ -147,12 +147,13 @@ class IcebergScanNode(FileScanNode):
             names_out.append(n)
         return HostTable(names_out, cols_out)
 
-    def execute_cpu(self):
+    def execute_cpu(self, dynamic_prunes=None, metrics=None):
         if self._empty:
             from spark_rapids_tpu.plan.nodes import _empty_table
             yield _empty_table(self.output_schema())
             return
-        yield from super().execute_cpu()
+        yield from super().execute_cpu(dynamic_prunes=dynamic_prunes,
+                                       metrics=metrics)
 
     def estimate_bytes(self):
         try:

@@ -151,6 +151,43 @@ class FileScanNode(PlanNode):
         self._data_schema: Optional[Schema] = None
         self._partition_schema: Optional[Schema] = None
 
+    def _effective_paths(self, dynamic_prunes) -> list:
+        """File list after dynamic partition pruning
+        (GpuFileSourceScanExec partitionFilters with
+        DynamicPruningExpression). ``dynamic_prunes`` is a list of
+        (partition column name, provider) where provider() -> set of
+        allowed values; it is EXECUTION-scoped state owned by the calling
+        exec (execs/basic.TpuFileScanExec), never by this shared plan
+        node — a prune must not leak into other queries over the same
+        scan."""
+        paths = list(self.paths)
+        if not dynamic_prunes:
+            return paths
+        self._resolve_schemas()
+        part_types = dict(self._partition_schema or [])
+        for part_col, provider in dynamic_prunes:
+            dt = part_types.get(part_col)
+            if dt is None:
+                continue
+            allowed = provider()
+            kept = []
+            for p in paths:
+                spec = dict(partition_spec_of(p))
+                raw = spec.get(part_col)
+                if raw is None:
+                    kept.append(p)  # null partition: keep (null-safe)
+                    continue
+                if isinstance(dt, T.StringType):
+                    val = raw
+                elif isinstance(dt, T.DoubleType):
+                    val = float(raw)
+                else:
+                    val = int(raw)
+                if val in allowed:
+                    kept.append(p)
+            paths = kept
+        return paths
+
     # -- subclass surface ---------------------------------------------------
     def _conf_reader_type(self) -> str:
         return ReaderMode.AUTO
@@ -236,19 +273,28 @@ class FileScanNode(PlanNode):
         return HostTable(out_names, [by_name[n] for n in out_names])
 
     # -- PlanNode -----------------------------------------------------------
-    def execute_cpu(self) -> Iterator[HostTable]:
+    def execute_cpu(self, dynamic_prunes=None,
+                    metrics: Optional[dict] = None) -> Iterator[HostTable]:
+        paths = self._effective_paths(dynamic_prunes)
+        if metrics is not None and dynamic_prunes:
+            metrics["dppPrunedFiles"] = len(self.paths) - len(paths)
+            metrics["dppScannedFiles"] = len(paths)
+        if not paths:
+            from spark_rapids_tpu.plan.nodes import _empty_table
+            yield _empty_table(self.output_schema())
+            return
         mode = self.reader_type
         if mode == ReaderMode.AUTO:
-            mode = (ReaderMode.MULTITHREADED if len(self.paths) > 1
+            mode = (ReaderMode.MULTITHREADED if len(paths) > 1
                     else ReaderMode.PERFILE)
         if mode == ReaderMode.PERFILE:
-            it = self._perfile()
+            it = self._perfile(paths)
         elif mode == ReaderMode.COALESCING:
             it = coalesce_batches(
-                self._coalescing_chunks(),
+                self._coalescing_chunks(paths),
                 self.conf.get_entry(READER_COALESCE_TARGET_BYTES))
         elif mode == ReaderMode.MULTITHREADED:
-            it = self._multithreaded()
+            it = self._multithreaded(paths)
         else:
             raise ColumnarProcessingError(f"unknown reader type {mode}")
         yield from it
@@ -278,30 +324,32 @@ class FileScanNode(PlanNode):
     def _read_with_partitions(self, path: str) -> HostTable:
         return self._with_partition_columns(self._read_decoded(path), path)
 
-    def _perfile(self) -> Iterator[HostTable]:
-        for p in self.paths:
+    def _perfile(self, paths=None) -> Iterator[HostTable]:
+        for p in (self.paths if paths is None else paths):
             yield self._read_with_partitions(p)
 
-    def _coalescing_chunks(self) -> Iterator[HostTable]:
+    def _coalescing_chunks(self, paths=None) -> Iterator[HostTable]:
         """Chunk stream feeding the COALESCING stitcher. Default: whole
         files; formats with sub-file granularity (parquet row groups, ORC
         stripes) override."""
-        return self._perfile()
+        return self._perfile(paths)
 
-    def _multithreaded(self) -> Iterator[HostTable]:
+    def _multithreaded(self, paths=None) -> Iterator[HostTable]:
         """Ordered prefetch with a bounded in-flight window: at most
         ~2x pool-size files are decoded ahead of the consumer, so host
         memory stays bounded and early iterator abandonment (limits) does
         not decode the whole dataset."""
+        if paths is None:
+            paths = self.paths
         nthreads = max(1, self.conf.get_entry(MULTITHREADED_READ_NUM_THREADS))
-        window = min(len(self.paths), nthreads * 2)
-        with cf.ThreadPoolExecutor(max_workers=min(nthreads, len(self.paths))) as pool:
+        window = min(len(paths), nthreads * 2)
+        with cf.ThreadPoolExecutor(max_workers=min(nthreads, len(paths))) as pool:
             futures = {}
             next_submit = 0
-            for i in range(len(self.paths)):
-                while next_submit < len(self.paths) and next_submit < i + window:
+            for i in range(len(paths)):
+                while next_submit < len(paths) and next_submit < i + window:
                     futures[next_submit] = pool.submit(
-                        self._read_with_partitions, self.paths[next_submit])
+                        self._read_with_partitions, paths[next_submit])
                     next_submit += 1
                 yield futures.pop(i).result()
 

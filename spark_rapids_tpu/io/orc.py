@@ -45,9 +45,9 @@ class OrcScanNode(FileScanNode):
         t = po.ORCFile(path).read(columns=cols)
         return decode_to_schema(t, self.data_schema)
 
-    def _coalescing_chunks(self) -> Iterator[HostTable]:
+    def _coalescing_chunks(self, paths=None) -> Iterator[HostTable]:
         """Stripe-granular chunks (MultiFileOrcPartitionReader analog)."""
-        for path in self.paths:
+        for path in (self.paths if paths is None else paths):
             f = po.ORCFile(path)
             for s in range(f.nstripes):
                 batch = f.read_stripe(s, columns=self._file_columns())

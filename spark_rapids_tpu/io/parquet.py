@@ -54,14 +54,14 @@ class ParquetScanNode(FileScanNode):
         t = pq.read_table(path, columns=cols, filters=self.filters)
         return decode_to_schema(t, self.data_schema)
 
-    def _coalescing_chunks(self) -> Iterator[HostTable]:
+    def _coalescing_chunks(self, paths=None) -> Iterator[HostTable]:
         """Row-group-granular chunks for the stitcher (one device upload per
         stitched group). With pushdown filters the row-group fast path is
         bypassed so filtering stays identical across reader modes."""
         if self.filters is not None:
             yield from self._perfile()
             return
-        for path in self.paths:
+        for path in (self.paths if paths is None else paths):
             f = pq.ParquetFile(path)
             for rg in range(f.metadata.num_row_groups):
                 t = f.read_row_group(rg, columns=self._file_columns())

@@ -112,6 +112,8 @@ def _build_expr_sigs():
                  "TransformValues", "ArrayTransform", "ArrayFilter",
                  "ArrayExists", "ArrayForAll", "ArraysZip"):
         reg(getattr(nested_ops, name), COMMON_PLUS_NESTED)
+    from spark_rapids_tpu.ops.bloom import BloomFilterMightContain
+    reg(BloomFilterMightContain)
     for fn in DEVICE_SUPPORTED_AGGS:
         reg(fn)
 
@@ -491,11 +493,91 @@ def _convert_join(node: P.Join, children, conf):
     else:
         left = TpuCoalesceExec(children[0], target_bytes=target)
         right = wrap_build(children[1])
-    return TpuJoinExec(left, right, node.join_type, lkeys, rkeys,
+    join = TpuJoinExec(left, right, node.join_type, lkeys, rkeys,
                        node.condition,
                        node.children[0].output_schema(),
                        node.children[1].output_schema(),
                        subpartition_bytes=conf.get_entry(JOIN_SUBPARTITION_BYTES))
+    from spark_rapids_tpu.conf import DPP_ENABLED
+    if broadcast and conf.get_entry(DPP_ENABLED) and not swapped:
+        # only inner/leftsemi qualify (checked inside), so the probe is
+        # always the LEFT side here
+        _maybe_install_dpp(jt, left, right, lkeys, rkeys)
+    return join
+
+
+def _maybe_install_dpp(jt: str, probe_exec, build_exec, probe_keys,
+                       build_keys) -> None:
+    """Dynamic partition pruning (reference: DynamicPruningExpression /
+    SubqueryBroadcast planned into GpuFileSourceScanExec partitionFilters;
+    dpp_test.py): when the probe side of a BROADCAST join scans a
+    Hive-partitioned source and a join key resolves to a partition column,
+    install a pruning filter on the scan that reads the build side's
+    distinct key values from the (already materialized, cached) broadcast
+    — probe file IO then skips partitions that cannot match. Only join
+    types that DROP unmatched probe rows qualify."""
+    from spark_rapids_tpu.execs.basic import (
+        TpuCoalesceExec,
+        TpuFileScanExec,
+        TpuFilterExec,
+        TpuProjectExec,
+    )
+    from spark_rapids_tpu.ops.expr import Alias, BoundReference
+
+    # inner/semi drop unmatched probe rows -> pruning is sound; outer
+    # joins keep them (right-outer keeps the PROBE side) -> never prune
+    if jt not in ("inner", "leftsemi"):
+        return
+    for pk, bk in zip(probe_keys, build_keys):
+        e = pk
+        while isinstance(e, Alias):
+            e = e.children[0]
+        if not isinstance(e, BoundReference):
+            continue
+        ordinal = e.ordinal
+        cur = probe_exec
+        scan_exec = None
+        while True:
+            if isinstance(cur, (TpuCoalesceExec, TpuFilterExec)):
+                cur = cur.children[0]
+            elif isinstance(cur, TpuProjectExec):
+                pe = cur.exprs[ordinal]
+                while isinstance(pe, Alias):
+                    pe = pe.children[0]
+                if not isinstance(pe, BoundReference):
+                    break
+                ordinal = pe.ordinal
+                cur = cur.children[0]
+            elif isinstance(cur, TpuFileScanExec):
+                scan_exec = cur
+                break
+            else:
+                break
+        if scan_exec is None:
+            continue
+        scan_node = scan_exec.scan_node
+        schema = scan_node.output_schema()
+        if ordinal >= len(schema):
+            continue
+        col_name = schema[ordinal][0]
+        scan_node._resolve_schemas()
+        part_names = {n for n, _ in (scan_node._partition_schema or [])}
+        if col_name not in part_names:
+            continue
+
+        def provider(build_exec=build_exec, bk=bk):
+            from spark_rapids_tpu.ops.expr import compile_project
+            batches = list(build_exec.execute())
+            allowed = set()
+            for bt in batches:
+                kcol = compile_project([bk], bt)[0]
+                host = kcol.to_host(bt.num_rows)
+                for v, ok in zip(host.data, host.validity):
+                    if ok:
+                        allowed.add(v.item() if hasattr(v, "item") else v)
+            return allowed
+
+        scan_exec.install_dynamic_pruning(col_name, provider)
 
 
 def _convert_file_scan(node, children, conf):

@@ -1,0 +1,178 @@
+"""Dynamic partition pruning + bloom-filter join filtering
+(reference: dpp_test.py, GpuFileSourceScanExec DynamicPruningExpression;
+SURVEY §2.9 BloomFilter / InjectRuntimeFilter)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import functions as F
+from spark_rapids_tpu.ops.expr import col, lit
+from spark_rapids_tpu.session import TpuSession
+
+
+@pytest.fixture(scope="module")
+def tpu():
+    return TpuSession()
+
+
+@pytest.fixture(scope="module")
+def cpu():
+    return TpuSession({"spark.rapids.sql.enabled": "false"})
+
+
+def _write_partitioned(tmp_path, n_parts=8, rows=500, seed=0):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    rng = np.random.default_rng(seed)
+    root = str(tmp_path / "data")
+    for r in range(n_parts):
+        t = pa.table({"v": rng.random(rows),
+                      "k": rng.integers(0, 50, rows)})
+        os.makedirs(f"{root}/region={r}", exist_ok=True)
+        pq.write_table(t, f"{root}/region={r}/part-0.parquet")
+    return root
+
+
+def _scan_metrics(session):
+    """dpp metrics of every pruning-armed scan EXEC in the last plan."""
+    out = []
+
+    def walk(e):
+        if getattr(e, "_dynamic_prunes", None):
+            out.append(e.metrics)
+        for c in getattr(e, "children", ()):
+            walk(c)
+        for attr in ("scan_node", "cpu_node", "tpu_exec", "source"):
+            n = getattr(e, attr, None)
+            if n is not None:
+                walk(n)
+
+    walk(session._last_executable)
+    return out
+
+
+def test_dpp_prunes_files_inner_join(tmp_path, tpu, cpu):
+    root = _write_partitioned(tmp_path)
+    dim = {"region": np.array([1, 6], dtype=np.int64),
+           "name": np.array(["a", "b"], dtype=object)}
+    q = lambda s: sorted(
+        s.read_parquet(root)
+        .join(s.create_dataframe(dim), on="region", how="inner")
+        .group_by("name").agg(F.count().alias("c")).collect())
+    assert q(tpu) == q(cpu)
+    m = _scan_metrics(tpu)
+    assert m and m[0]["dppPrunedFiles"] == 6 and m[0]["dppScannedFiles"] == 2
+
+
+def test_dpp_through_projection(tmp_path, tpu, cpu):
+    root = _write_partitioned(tmp_path, n_parts=5)
+    dim = {"region": np.array([0], dtype=np.int64)}
+    q = lambda s: sorted(
+        s.read_parquet(root)
+        .select(col("region"), (col("v") * lit(2.0)).alias("v2"))
+        .join(s.create_dataframe(dim), on="region", how="leftsemi")
+        .agg(F.count().alias("c")).collect())
+    assert q(tpu) == q(cpu)
+    m = _scan_metrics(tpu)
+    assert m and m[0]["dppPrunedFiles"] == 4
+
+
+def test_dpp_not_installed_for_outer_join(tmp_path, tpu):
+    root = _write_partitioned(tmp_path, n_parts=4)
+    dim = {"region": np.array([2], dtype=np.int64)}
+    df = (tpu.read_parquet(root)
+          .join(tpu.create_dataframe(dim), on="region", how="left"))
+    got = df.collect_table()
+    assert got.num_rows == 4 * 500  # every probe row kept
+    assert not _scan_metrics(tpu)  # no pruning armed on an outer join
+
+
+def test_dpp_disabled_by_conf(tmp_path):
+    root = _write_partitioned(tmp_path, n_parts=4)
+    s = TpuSession({"spark.rapids.sql.dpp.enabled": "false"})
+    dim = {"region": np.array([2], dtype=np.int64)}
+    _ = (s.read_parquet(root)
+         .join(s.create_dataframe(dim), on="region", how="inner")
+         .collect())
+    assert not _scan_metrics(s)
+
+
+def test_dpp_prune_to_zero_files(tmp_path, tpu, cpu):
+    root = _write_partitioned(tmp_path, n_parts=3)
+    dim = {"region": np.array([99], dtype=np.int64)}
+    q = lambda s: (s.read_parquet(root)
+                   .join(s.create_dataframe(dim), on="region", how="inner")
+                   .collect())
+    assert q(tpu) == q(cpu) == []
+
+
+# -- bloom -------------------------------------------------------------------
+
+def test_bloom_no_false_negatives_and_oracle_match(tpu, cpu):
+    rng = np.random.default_rng(3)
+    fact = {"k": rng.integers(0, 50000, 20000).astype(np.int64)}
+    keys = rng.choice(50000, 300, replace=False).astype(np.int64)
+    bloom = F.build_bloom_filter(tpu.create_dataframe({"k": keys}), "k")
+    q = lambda s: sorted(
+        s.create_dataframe(fact)
+        .filter(F.might_contain(bloom, col("k"))).collect())
+    got, want = q(tpu), q(cpu)
+    assert got == want
+    truth = set(fact["k"][np.isin(fact["k"], keys)].tolist())
+    assert truth <= {r[0] for r in got}  # no false negatives
+
+
+def test_bloom_prefilter_preserves_join_result(tpu, cpu):
+    """Probe pre-filtering with might_contain must not change the join's
+    result (the InjectRuntimeFilter invariant)."""
+    rng = np.random.default_rng(4)
+    fact = {"k": rng.integers(0, 10000, 30000).astype(np.int64),
+            "v": rng.random(30000)}
+    keys = np.sort(rng.choice(10000, 200, replace=False).astype(np.int64))
+    dim = {"k": keys, "w": np.arange(200, dtype=np.int64)}
+    bloom = F.build_bloom_filter(tpu.create_dataframe(dim), "k")
+
+    def q(s, prefilter):
+        df = s.create_dataframe(fact)
+        if prefilter:
+            df = df.filter(F.might_contain(bloom, col("k")))
+        return sorted(df.join(s.create_dataframe(dim), on="k", how="inner")
+                      .group_by("w").agg(F.count().alias("c")).collect())
+
+    base = q(cpu, False)
+    assert q(tpu, True) == base
+    assert q(tpu, False) == base
+
+
+def test_bloom_null_propagation(tpu, cpu):
+    from spark_rapids_tpu import types as T
+    vals = [1, None, 7, 99999]
+    keys = np.array([1, 7], dtype=np.int64)
+    bloom = F.build_bloom_filter(tpu.create_dataframe({"k": keys}), "k")
+    for s in (tpu, cpu):
+        got = s.create_dataframe({"k": vals}, dtypes={"k": T.LONG}).select(
+            F.might_contain(bloom, col("k")).alias("m")).collect()
+        assert got[0][0] is True and got[1][0] is None and got[2][0] is True
+
+
+def test_dpp_does_not_leak_across_queries(tmp_path, tpu, cpu):
+    """A pruning filter installed for one query must not affect other
+    queries over the SAME shared scan (review finding: filters used to
+    accumulate on the logical plan node)."""
+    root = _write_partitioned(tmp_path, n_parts=6)
+    base = tpu.read_parquet(root)
+    dim = {"region": np.array([1], dtype=np.int64)}
+    _ = base.join(tpu.create_dataframe(dim), on="region",
+                  how="inner").collect()
+    assert _scan_metrics(tpu) and _scan_metrics(tpu)[0][
+        "dppScannedFiles"] == 1
+    # plain scan over the same DataFrame: ALL partitions
+    full = base.agg(F.count().alias("c")).collect()
+    assert full[0][0] == 6 * 500
+    # and re-running the join does not stack duplicate providers
+    _ = base.join(tpu.create_dataframe(dim), on="region",
+                  how="inner").collect()
+    m = _scan_metrics(tpu)
+    assert m and m[0]["dppScannedFiles"] == 1
