@@ -152,6 +152,15 @@ MASKED_BATCHES = bool_conf(
     "split boundaries (columnar/table.py DeviceTable.live).",
     commonly_used=True)
 
+ANSI_ENABLED = bool_conf(
+    "spark.sql.ansi.enabled", False,
+    "ANSI SQL mode: integral overflow, divide by zero, invalid numeric "
+    "casts and out-of-bounds array indexes raise AnsiViolation instead "
+    "of wrapping / returning null (reference: GpuCast ansi variants, "
+    "CheckOverflow shim rules). Device kernels accumulate a violation "
+    "flag per expression site; it rides the collect's packed fetch, so "
+    "ANSI checking adds no extra device round trips.")
+
 DPP_ENABLED = bool_conf(
     "spark.rapids.sql.dpp.enabled", True,
     "Dynamic partition pruning: when a broadcast join's probe side scans "

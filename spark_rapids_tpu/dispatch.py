@@ -30,6 +30,11 @@ import numpy as np
 
 _LOCK = threading.Lock()
 
+#: spark.sql.ansi.enabled, set per-query by the session (same pattern as
+#: the masked-batch and retry contextvars)
+import contextvars
+ANSI_MODE = contextvars.ContextVar("rapids_ansi_mode", default=False)
+
 #: content-keyed device copies of host constant arrays
 _CONST_CACHE: Dict[tuple, jax.Array] = {}
 #: interned device scalars keyed by (dtype, value)

@@ -47,3 +47,11 @@ class UnsupportedOnTpu(RapidsTpuError):
     """Raised when an operator/expression is asked to run on device but was
     tagged unsupported; indicates a bug in the plan-rewrite layer (normal
     operation converts such nodes back to CPU)."""
+
+
+class AnsiViolation(RapidsTpuError, ArithmeticError):
+    """ANSI mode (spark.sql.ansi.enabled) runtime error: overflow, divide
+    by zero, invalid cast, or array index out of bounds — the engine's
+    SparkArithmeticException. Device kernels record the violation as a
+    device flag that rides the collect fetch (like speculation flags);
+    the CPU oracle raises at evaluation."""

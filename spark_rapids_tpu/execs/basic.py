@@ -191,22 +191,29 @@ class _FilterKernel:
         preps: List[NodePrep] = []
         _walk_prep(self.condition, pctx, preps)
         cols = tuple(DevVal(c.data, c.validity) for c in table.columns)
-        from spark_rapids_tpu.dispatch import prep_aux
+        from spark_rapids_tpu.dispatch import ANSI_MODE, prep_aux
         aux = prep_aux(pctx)
         capacity = table.capacity
         has_mask = table.live is not None
+        ansi = ANSI_MODE.get()
 
         self._traces = shared_traces(
             ("filter", self.condition.key(), table.schema_key()[0]))
-        tkey = (capacity, emit_mask, has_mask, _prep_trace_key(preps))
-        fn = self._traces.get(tkey)
-        if fn is None:
+        tkey = (capacity, emit_mask, has_mask, ansi,
+                _prep_trace_key(preps))
+        got = self._traces.get(tkey)
+        if got is None:
             cond = self.condition
+            labels: List[str] = []
 
             def run(cols, aux, nrows, live_in):
-                ctx = EvalCtx(cols, aux, nrows, capacity, live=live_in)
+                ctx = EvalCtx(cols, aux, nrows, capacity, live=live_in,
+                              ansi=ansi)
                 ctx._prep_iter = iter(preps)
                 pred = _walk_eval(cond, ctx)
+                labels.clear()
+                labels.extend(lbl for lbl, _ in ctx.ansi_errors)
+                errs = tuple(f for _, f in ctx.ansi_errors)
                 if live_in is not None:
                     live = live_in
                 else:
@@ -214,23 +221,27 @@ class _FilterKernel:
                 keep = pred.data & pred.validity & live
                 new_n = jnp.sum(keep.astype(jnp.int32))
                 if emit_mask:
-                    return keep, new_n
+                    return keep, new_n, errs
                 pos = jnp.cumsum(keep.astype(jnp.int32)) - 1
                 tgt = jnp.where(keep, pos, capacity)
                 from spark_rapids_tpu.ops.scatter32 import scatter_pair
                 outs = []
                 for data, validity in cols:
                     outs.append(scatter_pair(capacity, tgt, data, validity))
-                return outs, new_n
+                return outs, new_n, errs
 
-            fn = tpu_jit(run)
-            self._traces[tkey] = fn
+            got = (tpu_jit(run), labels)
+            self._traces[tkey] = got
+        fn, labels = got
 
+        from spark_rapids_tpu.ops.expr import deliver_ansi_flags
         if emit_mask:
-            keep, new_n = fn(cols, aux, table.nrows_dev, table.live)
+            keep, new_n, errs = fn(cols, aux, table.nrows_dev, table.live)
+            deliver_ansi_flags(labels, errs)
             return DeviceTable(table.names, table.columns, new_n, capacity,
                                live=keep)
-        outs, new_n = fn(cols, aux, table.nrows_dev, table.live)
+        outs, new_n, errs = fn(cols, aux, table.nrows_dev, table.live)
+        deliver_ansi_flags(labels, errs)
         new_cols = [c.with_arrays(d, v) for c, (d, v) in zip(table.columns, outs)]
         return DeviceTable(table.names, new_cols, new_n, capacity)
 

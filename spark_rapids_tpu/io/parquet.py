@@ -59,7 +59,7 @@ class ParquetScanNode(FileScanNode):
         stitched group). With pushdown filters the row-group fast path is
         bypassed so filtering stays identical across reader modes."""
         if self.filters is not None:
-            yield from self._perfile()
+            yield from self._perfile(paths)
             return
         for path in (self.paths if paths is None else paths):
             f = pq.ParquetFile(path)

@@ -18,7 +18,7 @@ import jax.numpy as jnp
 
 from spark_rapids_tpu import types as T
 from spark_rapids_tpu.columnar import HostColumn, HostTable
-from spark_rapids_tpu.errors import ColumnarProcessingError
+from spark_rapids_tpu.errors import AnsiViolation, ColumnarProcessingError
 from spark_rapids_tpu.ops.common import (
     BinaryExpression,
     UnaryExpression,
@@ -77,12 +77,27 @@ class BinaryArithmetic(BinaryExpression):
     def _dev_op(self, ld, rd):
         raise NotImplementedError
 
+    #: ANSI overflow check on integral operands ("+"/"-"/"*" labels)
+    _ansi_symbol = None
+
     def eval_cpu(self, table: HostTable) -> HostColumn:
+        from spark_rapids_tpu.dispatch import ANSI_MODE
         l = self.left.eval_cpu(table)
         r = self.right.eval_cpu(table)
         with np.errstate(over="ignore", invalid="ignore", divide="ignore"):
             data = self._cpu_op(l.data, r.data)
         validity = l.validity & r.validity
+        if (ANSI_MODE.get() and self._ansi_symbol
+                and isinstance(self.data_type, T.IntegralType)):
+            wide = self._cpu_op(l.data.astype(object), r.data.astype(object))
+            info = np.iinfo(self.data_type.np_dtype)
+            bad = validity & np.fromiter(
+                (not (info.min <= w <= info.max) for w in wide),
+                dtype=np.bool_, count=len(wide))
+            if bad.any():
+                raise AnsiViolation(
+                    f"integer overflow in {self._ansi_symbol} "
+                    "(spark.sql.ansi.enabled)")
         zero = np.zeros((), dtype=data.dtype).item()
         return HostColumn(self.data_type, np.where(validity, data, zero).astype(data.dtype), validity)
 
@@ -90,31 +105,60 @@ class BinaryArithmetic(BinaryExpression):
         lval, rval = child_vals
         validity = null_and(lval.validity, rval.validity)
         data = self._dev_op(lval.data, rval.data)
+        if (ctx.ansi and self._ansi_symbol
+                and isinstance(self.data_type, T.IntegralType)):
+            bad = self._dev_overflow(lval.data, rval.data, data) & validity
+            ctx.ansi_check(f"integer overflow in {self._ansi_symbol}", bad)
         return DevVal(jnp.where(validity, data, jnp.zeros_like(data)), validity)
+
+    def _dev_overflow(self, ld, rd, res):
+        raise NotImplementedError
 
 
 class Add(BinaryArithmetic):
+    _ansi_symbol = "+"
+
     def _cpu_op(self, ld, rd):
         return ld + rd
 
     def _dev_op(self, ld, rd):
         return ld + rd
+
+    def _dev_overflow(self, ld, rd, res):
+        # sign trick: overflow iff operands share a sign and the result
+        # flips it (exact for two's-complement wrap)
+        return ((ld >= 0) == (rd >= 0)) & ((res >= 0) != (ld >= 0))
 
 
 class Subtract(BinaryArithmetic):
+    _ansi_symbol = "-"
+
     def _cpu_op(self, ld, rd):
         return ld - rd
 
     def _dev_op(self, ld, rd):
         return ld - rd
+
+    def _dev_overflow(self, ld, rd, res):
+        return ((ld >= 0) != (rd >= 0)) & ((res >= 0) != (ld >= 0))
 
 
 class Multiply(BinaryArithmetic):
+    _ansi_symbol = "*"
+
     def _cpu_op(self, ld, rd):
         return ld * rd
 
     def _dev_op(self, ld, rd):
         return ld * rd
+
+    def _dev_overflow(self, ld, rd, res):
+        # divide-back check (integer division is exact on device)
+        dtmin = jnp.asarray(np.iinfo(np.dtype(res.dtype)).min, res.dtype)
+        safe_r = jnp.where(rd == 0, 1, rd)
+        divback_bad = (rd != 0) & (res // safe_r != ld)
+        min_neg = (ld == dtmin) & (rd == -1) | (rd == dtmin) & (ld == -1)
+        return divback_bad | min_neg
 
 
 class Divide(BinaryArithmetic):
@@ -154,6 +198,7 @@ class Divide(BinaryArithmetic):
     def eval_cpu(self, table):
         l = self.left.eval_cpu(table)
         r = self.right.eval_cpu(table)
+        _ansi_div_zero_cpu(l, r)
         validity = l.validity & r.validity & (r.data != 0.0)
         with np.errstate(divide="ignore", invalid="ignore"):
             data = np.where(validity, l.data / np.where(r.data != 0.0, r.data, 1.0), 0.0)
@@ -161,9 +206,24 @@ class Divide(BinaryArithmetic):
 
     def eval_dev(self, ctx, child_vals, prep):
         lval, rval = child_vals
+        _ansi_div_zero_dev(ctx, lval, rval)
         validity = lval.validity & rval.validity & (rval.data != 0.0)
         safe = jnp.where(rval.data != 0.0, rval.data, 1.0)
         return DevVal(jnp.where(validity, lval.data / safe, 0.0), validity)
+
+
+def _ansi_div_zero_cpu(l, r):
+    from spark_rapids_tpu.dispatch import ANSI_MODE
+    if ANSI_MODE.get():
+        bad = l.validity & r.validity & (r.data == 0)
+        if bad.any():
+            raise AnsiViolation("divide by zero (spark.sql.ansi.enabled)")
+
+
+def _ansi_div_zero_dev(ctx, lval, rval):
+    if ctx.ansi:
+        ctx.ansi_check("divide by zero",
+                       lval.validity & rval.validity & (rval.data == 0))
 
 
 def _trunc_div_int(a, b, xp):
@@ -207,6 +267,7 @@ class IntegralDivide(BinaryArithmetic):
     def eval_cpu(self, table):
         l = self.left.eval_cpu(table)
         r = self.right.eval_cpu(table)
+        _ansi_div_zero_cpu(l, r)
         validity = l.validity & r.validity & (r.data != 0)
         with np.errstate(over="ignore"):
             data = _trunc_div_int(l.data, r.data, np)
@@ -214,6 +275,7 @@ class IntegralDivide(BinaryArithmetic):
 
     def eval_dev(self, ctx, child_vals, prep):
         lval, rval = child_vals
+        _ansi_div_zero_dev(ctx, lval, rval)
         validity = lval.validity & rval.validity & (rval.data != 0)
         data = _trunc_div_int(lval.data, rval.data, jnp)
         return DevVal(jnp.where(validity, data, 0), validity)
@@ -233,6 +295,7 @@ class Remainder(BinaryArithmetic):
     def eval_cpu(self, table):
         l = self.left.eval_cpu(table)
         r = self.right.eval_cpu(table)
+        _ansi_div_zero_cpu(l, r)
         validity = l.validity & r.validity & (r.data != 0)
         data = _java_mod(l.data, r.data, np)
         zero = np.zeros((), dtype=l.data.dtype).item()
@@ -240,6 +303,7 @@ class Remainder(BinaryArithmetic):
 
     def eval_dev(self, ctx, child_vals, prep):
         lval, rval = child_vals
+        _ansi_div_zero_dev(ctx, lval, rval)
         validity = lval.validity & rval.validity & (rval.data != 0)
         data = _java_mod(lval.data, rval.data, jnp)
         return DevVal(jnp.where(validity, data, jnp.zeros_like(data)), validity)
@@ -274,7 +338,13 @@ class UnaryMinus(UnaryExpression):
         return self.child.data_type
 
     def eval_cpu(self, table):
+        from spark_rapids_tpu.dispatch import ANSI_MODE
         c = self.child.eval_cpu(table)
+        if ANSI_MODE.get() and isinstance(self.data_type, T.IntegralType):
+            info = np.iinfo(c.data.dtype)
+            if (c.validity & (c.data == info.min)).any():
+                raise AnsiViolation(
+                    "integer overflow in negate (spark.sql.ansi.enabled)")
         with np.errstate(over="ignore"):
             data = -c.data
         zero = np.zeros((), dtype=c.data.dtype).item()
@@ -282,6 +352,10 @@ class UnaryMinus(UnaryExpression):
 
     def eval_dev(self, ctx, child_vals, prep):
         (c,) = child_vals
+        if ctx.ansi and isinstance(self.data_type, T.IntegralType):
+            info = np.iinfo(np.dtype(c.data.dtype))
+            ctx.ansi_check("integer overflow in negate",
+                           c.validity & (c.data == info.min))
         return DevVal(jnp.where(c.validity, -c.data, jnp.zeros_like(c.data)), c.validity)
 
 
@@ -305,7 +379,13 @@ class Abs(UnaryExpression):
         return self.child.data_type
 
     def eval_cpu(self, table):
+        from spark_rapids_tpu.dispatch import ANSI_MODE
         c = self.child.eval_cpu(table)
+        if ANSI_MODE.get() and np.issubdtype(c.data.dtype, np.integer):
+            info = np.iinfo(c.data.dtype)
+            if (c.validity & (c.data == info.min)).any():
+                raise AnsiViolation(
+                    "integer overflow in abs (spark.sql.ansi.enabled)")
         with np.errstate(over="ignore"):
             data = np.abs(c.data)
         zero = np.zeros((), dtype=c.data.dtype).item()
@@ -313,6 +393,10 @@ class Abs(UnaryExpression):
 
     def eval_dev(self, ctx, child_vals, prep):
         (c,) = child_vals
+        if ctx.ansi and jnp.issubdtype(c.data.dtype, jnp.integer):
+            info = np.iinfo(np.dtype(c.data.dtype))
+            ctx.ansi_check("integer overflow in abs",
+                           c.validity & (c.data == info.min))
         return DevVal(jnp.where(c.validity, jnp.abs(c.data), jnp.zeros_like(c.data)), c.validity)
 
 

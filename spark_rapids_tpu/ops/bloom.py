@@ -60,12 +60,16 @@ def _bit_indexes_dev(data, num_bits: int, k: int) -> List[jax.Array]:
 
 class BloomFilter:
     """Device-resident filter handle (the materialized
-    BloomFilterAggregate result)."""
+    BloomFilterAggregate result). ``host_bits`` backs the might_contain
+    expression's aux input so compiled traces are SHARED across filters
+    of the same shape (the device copy is content-interned by
+    dispatch.device_const)."""
 
     def __init__(self, bits: jax.Array, num_hashes: int):
         self.bits = bits
         self.num_bits = int(bits.shape[0])
         self.num_hashes = int(num_hashes)
+        self.host_bits = np.asarray(jax.device_get(bits))
 
     def approx_set_bits(self) -> int:
         return int(jax.device_get(jnp.sum(self.bits.astype(jnp.int32))))
@@ -98,9 +102,13 @@ def build_bloom_filter(df, column: str,
     """Aggregate ``df[column]`` (integral type) into a BloomFilter — the
     engine's bloom_filter_agg. Executes the DataFrame's plan on device and
     folds every batch into one bit array."""
+    schema = dict(df.select(column).plan.output_schema())
+    if not isinstance(schema[column], T.IntegralType):
+        raise ColumnarProcessingError(
+            f"bloom filter column {column} must be integral, got "
+            f"{schema[column].simple_string()}")
     cols, _nrows = df.select(column).to_device_arrays()
-    pair = cols[column]
-    data, valid = pair[0], pair[1]  # string exports carry a 3rd element
+    data, valid = cols[column][0], cols[column][1]
     fn = _build_kernel(num_bits, num_hashes, int(data.shape[0]))
     return BloomFilter(fn(data, valid), num_hashes)
 
@@ -120,9 +128,14 @@ class BloomFilterMightContain(Expression):
         return T.BOOLEAN
 
     def key(self):
-        # identity-keyed: a bloom handle is immutable once built
-        return ("mightcontain", id(self.bloom), self.bloom.num_bits,
+        # content-independent: the bit array rides as an aux input, so
+        # every bloom of the same shape shares one compiled trace
+        return ("mightcontain", self.bloom.num_bits,
                 self.bloom.num_hashes, self.children[0].key())
+
+    def prep(self, pctx, child_preps):
+        return NodePrep(
+            aux_slots=(pctx.add_aux(self.bloom.host_bits),))
 
     def with_children(self, children):
         return BloomFilterMightContain(self.bloom, children[0])
@@ -155,8 +168,9 @@ class BloomFilterMightContain(Expression):
 
     def eval_dev(self, ctx: EvalCtx, child_vals, prep) -> DevVal:
         (c,) = child_vals
+        bits = ctx.aux[prep.aux_slots[0]]
         hit = jnp.ones(ctx.capacity, jnp.bool_)
         for idx in _bit_indexes_dev(c.data, self.bloom.num_bits,
                                     self.bloom.num_hashes):
-            hit = hit & self.bloom.bits[idx]
+            hit = hit & bits[idx]
         return DevVal(hit, c.validity)

@@ -266,7 +266,26 @@ class Cast(UnaryExpression):
     def device_supported(self):
         return cast_supported(self.child.data_type, self._dtype)
 
+    def _ansi_bad_np(self, c: HostColumn):
+        """Rows whose ANSI cast would error (numeric range / NaN)."""
+        dst = self._dtype
+        if not isinstance(dst, T.IntegralType):
+            return None
+        if isinstance(c.dtype, (T.FloatType, T.DoubleType)):
+            info = np.iinfo(dst.np_dtype)
+            with np.errstate(invalid="ignore"):
+                return c.validity & (np.isnan(c.data)
+                                     | (c.data < float(info.min))
+                                     | (c.data > float(info.max)))
+        if isinstance(c.dtype, T.IntegralType) and \
+                np.dtype(dst.np_dtype).itemsize < c.data.dtype.itemsize:
+            info = np.iinfo(dst.np_dtype)
+            return c.validity & ((c.data < info.min) | (c.data > info.max))
+        return None
+
     def eval_cpu(self, table: HostTable) -> HostColumn:
+        from spark_rapids_tpu.dispatch import ANSI_MODE
+        from spark_rapids_tpu.errors import AnsiViolation
         c = self.child.eval_cpu(table)
         if c.dtype == self._dtype:
             return c
@@ -274,9 +293,21 @@ class Cast(UnaryExpression):
                 isinstance(self._dtype, T.DecimalType):
             return _cpu_decimal_cast(c, self._dtype)
         if isinstance(c.dtype, T.StringType):
-            return self._cpu_from_string(c)
+            out = self._cpu_from_string(c)
+            if ANSI_MODE.get() and (c.validity & ~out.validity).any():
+                raise AnsiViolation(
+                    f"invalid input for cast to "
+                    f"{self._dtype.simple_string()} "
+                    "(spark.sql.ansi.enabled)")
+            return out
         if isinstance(self._dtype, T.StringType):
             return self._cpu_to_string(c)
+        if ANSI_MODE.get():
+            bad = self._ansi_bad_np(c)
+            if bad is not None and bad.any():
+                raise AnsiViolation(
+                    f"cast overflow to {self._dtype.simple_string()} "
+                    "(spark.sql.ansi.enabled)")
         data = _cast_data_np(c.data, c.dtype, self._dtype)
         zero = np.zeros((), dtype=self._dtype.np_dtype).item()
         return HostColumn(self._dtype, np.where(c.validity, data, zero).astype(self._dtype.np_dtype),
@@ -326,20 +357,40 @@ class Cast(UnaryExpression):
 
     def eval_dev(self, ctx, child_vals, prep):
         (c,) = child_vals
-        if self.child.data_type == self._dtype:
+        src, dst = self.child.data_type, self._dtype
+        if src == dst:
             return c
-        if isinstance(self.child.data_type, T.DecimalType) or \
-                isinstance(self._dtype, T.DecimalType):
-            return _dev_decimal_cast(c, self.child.data_type, self._dtype)
+        if isinstance(src, T.DecimalType) or isinstance(dst, T.DecimalType):
+            return _dev_decimal_cast(c, src, dst)
         if prep.aux_slots:
             vals = ctx.aux[prep.aux_slots[0]]
             ok = ctx.aux[prep.aux_slots[1]]
             codes = jnp.clip(c.data, 0, vals.shape[0] - 1)
             data = vals[codes]
             validity = c.validity & ok[codes]
+            if ctx.ansi:
+                ctx.ansi_check(
+                    f"invalid input for cast to {dst.simple_string()}",
+                    c.validity & ~ok[codes])
             return DevVal(jnp.where(validity, data, jnp.zeros_like(data)),
                           validity)
-        data = _cast_data_jnp(c.data, self.child.data_type, self._dtype)
+        if ctx.ansi and isinstance(dst, T.IntegralType):
+            if isinstance(src, (T.FloatType, T.DoubleType)):
+                info = np.iinfo(np.dtype(dst.np_dtype))
+                ctx.ansi_check(
+                    f"cast overflow to {dst.simple_string()}",
+                    c.validity & (jnp.isnan(c.data)
+                                  | (c.data < float(info.min))
+                                  | (c.data > float(info.max))))
+            elif isinstance(src, T.IntegralType) and \
+                    np.dtype(dst.np_dtype).itemsize < \
+                    np.dtype(src.np_dtype).itemsize:
+                info = np.iinfo(np.dtype(dst.np_dtype))
+                ctx.ansi_check(
+                    f"cast overflow to {dst.simple_string()}",
+                    c.validity & ((c.data < info.min)
+                                  | (c.data > info.max)))
+        data = _cast_data_jnp(c.data, src, dst)
         return DevVal(jnp.where(c.validity, data, jnp.zeros_like(data)), c.validity)
 
     def __repr__(self):

@@ -97,17 +97,25 @@ class GetArrayItem(Expression):
                 and isinstance(self.children[1], Literal))
 
     def eval_cpu(self, table):
+        from spark_rapids_tpu.dispatch import ANSI_MODE
+        from spark_rapids_tpu.errors import AnsiViolation
         c = self.children[0].eval_cpu(table)
         idx = self.children[1].eval_cpu(table)
+        ansi = ANSI_MODE.get()
         np_dt = self.data_type.np_dtype
         out = np.zeros(len(c), dtype=np_dt)
         validity = np.zeros(len(c), dtype=np.bool_)
         for i in range(len(c)):
             if c.validity[i] and idx.validity[i]:
                 k = int(idx.data[i])
-                if 0 <= k < len(c.data[i]) and c.data[i][k] is not None:
-                    out[i] = c.data[i][k]
-                    validity[i] = True
+                if 0 <= k < len(c.data[i]):
+                    if c.data[i][k] is not None:
+                        out[i] = c.data[i][k]
+                        validity[i] = True
+                elif ansi:
+                    raise AnsiViolation(
+                        f"array index {k} out of bounds "
+                        "(spark.sql.ansi.enabled)")
         return HostColumn(self.data_type, out, validity)
 
     def eval_dev(self, ctx, child_vals, prep) -> DevVal:
@@ -116,6 +124,9 @@ class GetArrayItem(Expression):
         k = ix.data[0].astype(jnp.int32)  # literal broadcast
         pos = off[:-1] + k
         inb = (k >= 0) & (pos < off[1:])
+        if ctx.ansi:
+            ctx.ansi_check("array index out of bounds",
+                           c.validity & ix.validity & ~inb)
         safe = jnp.clip(pos, 0, ed.shape[0] - 1)
         validity = c.validity & ix.validity & inb & ev[safe]
         data = ed[safe]

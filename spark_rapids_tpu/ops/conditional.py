@@ -36,13 +36,39 @@ class If(Expression):
         return If(*children)
 
     def eval_cpu(self, table: HostTable) -> HostColumn:
+        from spark_rapids_tpu.dispatch import ANSI_MODE
         p = self.children[0].eval_cpu(table)
-        a = self.children[1].eval_cpu(table)
-        b = self.children[2].eval_cpu(table)
         take_a = p.validity & p.data.astype(np.bool_)
+        if ANSI_MODE.get():
+            # Spark evaluates branches lazily: only selected rows may
+            # raise — evaluate each branch on its row subset
+            a = _eval_branch_cpu(self.children[1], table, take_a,
+                                 self.data_type)
+            b = _eval_branch_cpu(self.children[2], table, ~take_a,
+                                 self.data_type)
+        else:
+            a = self.children[1].eval_cpu(table)
+            b = self.children[2].eval_cpu(table)
         data = np.where(take_a, a.data, b.data)
         validity = np.where(take_a, a.validity, b.validity)
         return HostColumn(self.data_type, data, validity)
+
+    def eval_walk(self, ctx):
+        """Custom device walk: branch values evaluate under an ANSI guard
+        so unselected rows cannot raise (ops/expr._walk_eval hook)."""
+        from spark_rapids_tpu.ops.expr import _walk_eval
+        p = _walk_eval(self.children[0], ctx)
+        take_a = p.validity & p.data
+        if ctx.ansi:
+            with ctx.guarded(take_a):
+                a = _walk_eval(self.children[1], ctx)
+            with ctx.guarded(~take_a):
+                b = _walk_eval(self.children[2], ctx)
+        else:
+            a = _walk_eval(self.children[1], ctx)
+            b = _walk_eval(self.children[2], ctx)
+        prep = ctx.next_prep()
+        return self.eval_dev_branches(ctx, p, a, b, prep, take_a)
 
     def prep(self, pctx, child_preps):
         if child_preps[1].out_dict is not None:
@@ -51,11 +77,14 @@ class If(Expression):
 
     def eval_dev(self, ctx, child_vals, prep):
         p, a, b = child_vals
+        return self.eval_dev_branches(ctx, p, a, b, prep,
+                                      p.validity & p.data)
+
+    def eval_dev_branches(self, ctx, p, a, b, prep, take_a):
         ad, bd = a.data, b.data
         if prep.aux_slots:
             ad = dev_remap_codes(ctx, prep.aux_slots[0], ad)
             bd = dev_remap_codes(ctx, prep.aux_slots[1], bd)
-        take_a = p.validity & p.data
         return DevVal(jnp.where(take_a, ad, bd), jnp.where(take_a, a.validity, b.validity))
 
 
@@ -88,7 +117,64 @@ class CaseWhen(Expression):
             idx.append(len(self.children) - 1)
         return idx
 
+    def eval_walk(self, ctx):
+        """Device walk with branch guards: each value expression (and the
+        else) evaluates only-raising-for rows its predicate selects."""
+        from spark_rapids_tpu.ops.expr import _walk_eval
+        if not ctx.ansi:
+            vals = [_walk_eval(c, ctx) for c in self.children]
+            return self.eval_dev(ctx, vals, ctx.next_prep())
+        vals = []
+        decided = None
+        n_branch = len(self.children) - (1 if self.has_else else 0)
+        for i in range(0, n_branch, 2):
+            c = _walk_eval(self.children[i], ctx)
+            vals.append(c)
+            take = c.validity & c.data
+            if decided is not None:
+                take = take & ~decided
+            with ctx.guarded(take):
+                vals.append(_walk_eval(self.children[i + 1], ctx))
+            decided = take if decided is None else (decided | take)
+        if self.has_else:
+            with ctx.guarded(~decided if decided is not None
+                             else jnp.ones(ctx.capacity, jnp.bool_)):
+                vals.append(_walk_eval(self.children[-1], ctx))
+        return self.eval_dev(ctx, vals, ctx.next_prep())
+
+    def _eval_cpu_ansi(self, table):
+        """Lazy-branch CPU evaluation: each value expression runs only on
+        the rows its predicate (first-match) selects."""
+        n = table.num_rows
+        decided = np.zeros(n, dtype=np.bool_)
+        dtype = self.data_type
+        npdt = np.int32 if False else None
+        data = None
+        validity = np.zeros(n, dtype=np.bool_)
+        for cond, val in self._branches():
+            c = cond.eval_cpu(table)
+            take = ~decided & c.validity & c.data.astype(np.bool_)
+            part = _eval_branch_cpu(val, table, take, dtype)
+            if data is None:
+                data = part.data.copy()
+            else:
+                data = np.where(take, part.data, data)
+            validity = np.where(take, part.validity, validity)
+            decided |= take
+        if self.has_else:
+            part = _eval_branch_cpu(self.children[-1], table, ~decided,
+                                    dtype)
+            if data is None:
+                data = part.data.copy()
+            else:
+                data = np.where(~decided, part.data, data)
+            validity = np.where(~decided, part.validity, validity)
+        return HostColumn(dtype, data, validity)
+
     def eval_cpu(self, table):
+        from spark_rapids_tpu.dispatch import ANSI_MODE
+        if ANSI_MODE.get():
+            return self._eval_cpu_ansi(table)
         n = table.num_rows
         dtype = self.data_type
         if isinstance(dtype, T.StringType):
@@ -143,6 +229,24 @@ class CaseWhen(Expression):
             data = jnp.where(decided, data, vd)
             validity = jnp.where(decided, validity, v.validity)
         return DevVal(data, validity)
+
+
+def _eval_branch_cpu(expr, table, mask, dtype):
+    """Evaluate ``expr`` over only the mask-selected rows (ANSI lazy-branch
+    semantics), scattering results back to full length."""
+    from spark_rapids_tpu.columnar import HostTable as _HT
+    idx = np.nonzero(mask)[0]
+    sub = _HT(table.names,
+              [HostColumn(c.dtype, c.data[idx], c.validity[idx])
+               for c in table.columns])
+    part = expr.eval_cpu(sub)
+    n = table.num_rows
+    data = np.zeros(n, dtype=part.data.dtype) \
+        if part.data.dtype != object else np.full(n, None, dtype=object)
+    validity = np.zeros(n, dtype=np.bool_)
+    data[idx] = part.data
+    validity[idx] = part.validity
+    return HostColumn(part.dtype, data, validity)
 
 
 class Coalesce(Expression):

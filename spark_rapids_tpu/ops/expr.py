@@ -61,13 +61,45 @@ class EvalCtx:
     slot-based either way."""
 
     def __init__(self, cols: Sequence[DevVal], aux: Sequence[jax.Array],
-                 nrows: jax.Array, capacity: int, live=None):
+                 nrows: jax.Array, capacity: int, live=None,
+                 ansi: bool = False):
         self.cols = tuple(cols)
         self.aux = tuple(aux)
         self.nrows = nrows
         self.capacity = capacity
         self.live = live
+        #: ANSI mode: expressions append (label, device bool flag) pairs
+        #: for violations in LIVE rows; the hosting kernel returns them
+        self.ansi = ansi
+        self.ansi_errors: List[tuple] = []
+        #: branch-selection mask: inside a CASE WHEN / IF branch only the
+        #: selected rows may raise (Spark evaluates branches lazily; the
+        #: engine evaluates eagerly and guards the error check instead)
+        self.ansi_guard = None
         self._prep_iter: Optional[Iterator[NodePrep]] = None
+
+    def ansi_check(self, label: str, bad) -> None:
+        """Record an ANSI violation flag (True anywhere = error). Callers
+        pass ``bad`` already masked to valid, live rows."""
+        if self.ansi_guard is not None:
+            bad = bad & self.ansi_guard
+        self.ansi_errors.append(
+            (label, jnp.any(bad & self.row_mask())))
+
+    def guarded(self, mask):
+        """Context manager scoping ansi_check to ``mask``-selected rows
+        (composes with an enclosing guard for nested conditionals)."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def cm():
+            prev = self.ansi_guard
+            self.ansi_guard = mask if prev is None else (prev & mask)
+            try:
+                yield
+            finally:
+                self.ansi_guard = prev
+        return cm()
 
     def next_prep(self) -> NodePrep:
         return next(self._prep_iter)  # type: ignore[arg-type]
@@ -446,6 +478,11 @@ def _walk_prep(expr: Expression, pctx: PrepCtx, out: List[NodePrep]) -> NodePrep
 
 
 def _walk_eval(expr: Expression, ctx: EvalCtx) -> DevVal:
+    walk = getattr(expr, "eval_walk", None)
+    if walk is not None:
+        # conditionals control their own child evaluation (branch guards);
+        # they must consume preps in the standard post-order
+        return walk(ctx)
     child_vals = [_walk_eval(c, ctx) for c in expr.children]
     p = ctx.next_prep()
     return expr.eval_dev(ctx, child_vals, p)
@@ -476,26 +513,33 @@ class CompiledProject:
         self._traces = {}
 
     def _get_traced(self, capacity: int, all_preps: List[List[NodePrep]],
-                    has_mask: bool):
-        tkey = (capacity, has_mask,
+                    has_mask: bool, ansi: bool):
+        tkey = (capacity, has_mask, ansi,
                 tuple(_prep_trace_key(p) for p in all_preps))
-        fn = self._traces.get(tkey)
-        if fn is None:
+        got = self._traces.get(tkey)
+        if got is None:
             exprs = self.exprs
+            labels: List[str] = []  # filled at trace time, stable per key
 
             def traced(cols, aux, nrows, live):
                 outs = []
+                errs = []
                 for e, preps in zip(exprs, all_preps):
-                    ctx = EvalCtx(cols, aux, nrows, capacity, live=live)
+                    ctx = EvalCtx(cols, aux, nrows, capacity, live=live,
+                                  ansi=ansi)
                     ctx._prep_iter = iter(preps)
                     outs.append(_walk_eval(e, ctx))
-                return outs
+                    errs.extend(ctx.ansi_errors)
+                labels.clear()
+                labels.extend(lbl for lbl, _ in errs)
+                return outs, tuple(f for _, f in errs)
 
-            fn = tpu_jit(traced)
-            self._traces[tkey] = fn
-        return fn
+            got = (tpu_jit(traced), labels)
+            self._traces[tkey] = got
+        return got
 
     def __call__(self, table: DeviceTable) -> List[DeviceColumn]:
+        from spark_rapids_tpu.dispatch import ANSI_MODE, prep_aux
         pctx = PrepCtx(table)
         all_preps: List[List[NodePrep]] = []
         for e in self.exprs:
@@ -503,12 +547,14 @@ class CompiledProject:
             _walk_prep(e, pctx, preps)
             all_preps.append(preps)
         col_arrays = tuple(DevVal(c.data, c.validity) for c in table.columns)
-        from spark_rapids_tpu.dispatch import prep_aux
         aux_arrays = prep_aux(pctx)
 
-        fn = self._get_traced(table.capacity, all_preps,
-                              table.live is not None)
-        out_vals = fn(col_arrays, aux_arrays, table.nrows_dev, table.live)
+        fn, labels = self._get_traced(table.capacity, all_preps,
+                                      table.live is not None,
+                                      ANSI_MODE.get())
+        out_vals, err_flags = fn(col_arrays, aux_arrays, table.nrows_dev,
+                                 table.live)
+        deliver_ansi_flags(labels, err_flags)
 
         out_cols = []
         for e, preps, dv in zip(self.exprs, all_preps, out_vals):
@@ -517,6 +563,24 @@ class CompiledProject:
                 e.data_type, dv.data, dv.validity,
                 dictionary=root_prep.out_dict, dict_sorted=root_prep.dict_sorted))
         return out_cols
+
+
+def deliver_ansi_flags(labels, err_flags) -> None:
+    """Route a kernel's ANSI violation flags: through the speculation
+    context (rides the collect's packed fetch — zero extra round trips)
+    when one is active, else one immediate device check."""
+    if not err_flags:
+        return
+    from spark_rapids_tpu.runtime import speculation as spec
+    ctx = spec.current()
+    if ctx is not None:
+        for lbl, f in zip(labels, err_flags):
+            ctx.add_flag("ansi:" + lbl, f)
+        return
+    import jax
+    import numpy as _np
+    vals = jax.device_get(jnp.stack(list(err_flags)))
+    spec.check_flag_values(["ansi:" + l for l in labels], vals)
 
 
 class ProjectCache:

@@ -67,8 +67,19 @@ class SpecContext:
 
 def check_flag_values(sites: List[str], values) -> None:
     failed = [s for s, v in zip(sites, values) if bool(v)]
-    if failed:
-        raise SpeculationFailed(failed)
+    if not failed:
+        return
+    sizing = [s for s in failed if not s.startswith("ansi:")]
+    if sizing:
+        # a sizing miss means downstream data (and any ANSI flags computed
+        # from it) is untrustworthy — replay first; the exact replay
+        # re-evaluates ANSI flags over correct intermediates
+        raise SpeculationFailed(sizing)
+    ansi = [s[len("ansi:"):] for s in failed]
+    # an ANSI violation is a USER-FACING error, not a sizing miss:
+    # raise it directly — replaying could not change the data
+    from spark_rapids_tpu.errors import AnsiViolation
+    raise AnsiViolation("[ANSI] " + "; ".join(sorted(set(ansi))))
 
 
 _CTX: contextvars.ContextVar[Optional[SpecContext]] = contextvars.ContextVar(

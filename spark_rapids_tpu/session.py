@@ -192,9 +192,12 @@ class TpuSession:
         from spark_rapids_tpu.execs.join import DIRECT_TABLE_MULT
         from spark_rapids_tpu.runtime import speculation as spec
 
+        from spark_rapids_tpu.conf import ANSI_ENABLED
+        from spark_rapids_tpu.dispatch import ANSI_MODE
         tok_m = MASKED_ENABLED.set(bool(self.conf.get_entry(MASKED_BATCHES)))
         tok_d = DIRECT_TABLE_MULT.set(
             self.conf.get_entry(JOIN_DIRECT_TABLE_MULT))
+        tok_a = ANSI_MODE.set(bool(self.conf.get_entry(ANSI_ENABLED)))
         try:
             if not self.conf.get_entry(SPECULATIVE_SIZING):
                 return list(executable.execute_cpu())
@@ -220,6 +223,7 @@ class TpuSession:
         finally:
             MASKED_ENABLED.reset(tok_m)
             DIRECT_TABLE_MULT.reset(tok_d)
+            ANSI_MODE.reset(tok_a)
 
     def execute_cpu_only(self, plan: P.PlanNode) -> HostTable:
         """Run fully on the CPU path (the oracle)."""

@@ -1,0 +1,165 @@
+"""ANSI mode (spark.sql.ansi.enabled) — overflow/cast/divide/array-index
+error semantics (reference: GpuCast ansi variants, CheckOverflow shim
+rules, ansi_cast integration tests).
+
+Both evaluation paths must raise AnsiViolation for the same inputs, and
+non-violating data must produce results identical to legacy mode."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import functions as F
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.errors import AnsiViolation
+from spark_rapids_tpu.ops.expr import col, lit
+from spark_rapids_tpu.session import TpuSession
+
+I64MAX = np.iinfo(np.int64).max
+I64MIN = np.iinfo(np.int64).min
+
+
+def _sessions():
+    return (TpuSession({"spark.sql.ansi.enabled": "true"}),
+            TpuSession({"spark.sql.ansi.enabled": "true",
+                        "spark.rapids.sql.enabled": "false"}))
+
+
+@pytest.mark.parametrize("expr_maker,vals", [
+    (lambda: col("x") + lit(1), [1, I64MAX]),
+    (lambda: col("x") - lit(1), [0, I64MIN]),
+    (lambda: col("x") * lit(3), [5, I64MAX // 2 + 1]),
+    (lambda: -col("x"), [1, I64MIN]),
+    (lambda: F.abs(col("x")), [1, I64MIN]),
+])
+def test_integral_overflow_raises_both_paths(expr_maker, vals):
+    for s in _sessions():
+        df = s.create_dataframe({"x": np.asarray(vals, dtype=np.int64)})
+        with pytest.raises(AnsiViolation):
+            df.select(expr_maker().alias("y")).collect()
+
+
+@pytest.mark.parametrize("expr_maker", [
+    lambda: col("x") / lit(0.0),
+    lambda: col("x") % lit(0),
+    lambda: F.expr_integral_divide(col("x"), lit(0))
+    if hasattr(F, "expr_integral_divide") else col("x") % lit(0),
+])
+def test_divide_by_zero_raises_both_paths(expr_maker):
+    for s in _sessions():
+        df = s.create_dataframe({"x": np.asarray([1, 2], dtype=np.int64)})
+        with pytest.raises(AnsiViolation):
+            df.select(expr_maker().alias("y")).collect()
+
+
+def test_cast_overflow_raises_both_paths():
+    for s in _sessions():
+        df = s.create_dataframe({"x": np.asarray([1, 1 << 40],
+                                                 dtype=np.int64)})
+        with pytest.raises(AnsiViolation):
+            df.select(col("x").cast("int").alias("y")).collect()
+        df2 = s.create_dataframe({"f": np.asarray([1.5, 3e18])})
+        with pytest.raises(AnsiViolation):
+            df2.select(col("f").cast("int").alias("y")).collect()
+        df3 = s.create_dataframe({"f": np.asarray([np.nan, 1.0])})
+        with pytest.raises(AnsiViolation):
+            df3.select(col("f").cast("bigint").alias("y")).collect()
+
+
+def test_string_cast_failure_raises_both_paths():
+    for s in _sessions():
+        df = s.create_dataframe({"s": ["12", "oops"]},
+                                dtypes={"s": T.STRING})
+        with pytest.raises(AnsiViolation):
+            df.select(col("s").cast("int").alias("y")).collect()
+
+
+def test_array_index_out_of_bounds():
+    for s in _sessions():
+        df = s.create_dataframe({"a": np.asarray([1, 2], dtype=np.int64)})
+        from spark_rapids_tpu.ops.collections import GetArrayItem
+        with pytest.raises(AnsiViolation):
+            df.select(GetArrayItem(
+                F.array(col("a")), lit(3)).alias("y")).collect()
+
+
+def test_ansi_error_in_filter_predicate():
+    for s in _sessions():
+        df = s.create_dataframe({"x": np.asarray([1, I64MAX],
+                                                 dtype=np.int64)})
+        with pytest.raises(AnsiViolation):
+            df.filter((col("x") + lit(1)) > lit(0)).collect()
+
+
+def test_no_violation_matches_legacy_results():
+    ansi = TpuSession({"spark.sql.ansi.enabled": "true"})
+    legacy = TpuSession()
+    cpu = TpuSession({"spark.rapids.sql.enabled": "false"})
+    rng = np.random.default_rng(0)
+    data = {"x": rng.integers(-1000, 1000, 5000).astype(np.int64),
+            "y": rng.integers(1, 50, 5000).astype(np.int64)}
+    q = lambda s: sorted(s.create_dataframe(data).select(
+        (col("x") * col("y")).alias("m"),
+        (col("x") % col("y")).alias("r"),
+        col("x").cast("int").alias("i")).collect())
+    assert q(ansi) == q(legacy) == q(cpu)
+
+
+def test_legacy_mode_still_wraps_and_nulls():
+    legacy = TpuSession()
+    cpu = TpuSession({"spark.rapids.sql.enabled": "false"})
+    df = lambda s: s.create_dataframe(
+        {"x": np.asarray([I64MAX, 4], dtype=np.int64)})
+    q = lambda s: df(s).select((col("x") + lit(1)).alias("w"),
+                               (col("x") % lit(0)).alias("z")).collect()
+    got, want = q(legacy), q(cpu)
+    assert got == want
+    assert got[0][0] == I64MIN  # wrapped
+    assert got[0][1] is None    # null on zero divisor
+
+
+def test_ansi_violation_not_blocklisted_as_speculation():
+    """An ANSI error must raise AnsiViolation (no replay, no blocklist)."""
+    from spark_rapids_tpu.runtime import speculation as spec
+    before = set(spec._BLOCKLIST)
+    s = TpuSession({"spark.sql.ansi.enabled": "true"})
+    df = s.create_dataframe({"x": np.asarray([I64MAX], dtype=np.int64)})
+    with pytest.raises(AnsiViolation):
+        df.select((col("x") + lit(1)).alias("y")).collect()
+    assert set(spec._BLOCKLIST) == before
+
+
+def test_ansi_guarded_branches_do_not_raise():
+    """The canonical guard idiom — CASE WHEN b != 0 THEN a/b ELSE 0 —
+    must NOT raise for rows the predicate excludes (review finding:
+    eager branch evaluation fired ANSI checks on unselected rows)."""
+    for s in _sessions():
+        df = s.create_dataframe({"a": np.asarray([10.0, 20.0]),
+                                 "b": np.asarray([0.0, 2.0])})
+        got = df.select(
+            F.when(col("b") != lit(0.0), col("a") / col("b"))
+            .otherwise(lit(0.0)).alias("r")).collect()
+        assert got == [(0.0,), (10.0,)]
+        # IF form
+        got2 = df.select(
+            F.expr_if(col("b") != lit(0.0), col("a") / col("b"),
+                      lit(-1.0)).alias("r")).collect() \
+            if hasattr(F, "expr_if") else None
+    # unguarded rows must still raise
+    s = _sessions()[0]
+    df = s.create_dataframe({"a": np.asarray([10.0]),
+                             "b": np.asarray([0.0])})
+    with pytest.raises(AnsiViolation):
+        df.select((col("a") / col("b")).alias("r")).collect()
+
+
+def test_ansi_nested_guards():
+    for s in _sessions():
+        df = s.create_dataframe({"a": np.asarray([1.0, 4.0]),
+                                 "b": np.asarray([0.0, 2.0]),
+                                 "c": np.asarray([0.0, 1.0])})
+        got = df.select(
+            F.when(col("b") != lit(0.0),
+                   F.when(col("c") != lit(0.0), col("a") / col("c"))
+                   .otherwise(col("a") / col("b")))
+            .otherwise(lit(0.0)).alias("r")).collect()
+        assert got == [(0.0,), (4.0,)]
