@@ -152,6 +152,14 @@ MASKED_BATCHES = bool_conf(
     "split boundaries (columnar/table.py DeviceTable.live).",
     commonly_used=True)
 
+SORT_OOC_THRESHOLD = int_conf(
+    "spark.rapids.sql.sort.outOfCoreThresholdBytes", 1 << 30,
+    "Multi-batch sorts whose input exceeds this many device bytes merge "
+    "OUT OF CORE: each batch sorts on device and demotes to a host run, "
+    "sampled key bounds split the key space into ranges, and each range "
+    "re-loads + sorts independently — peak HBM is one output range "
+    "(GpuSortExec spilled-run merge analog).")
+
 ANSI_ENABLED = bool_conf(
     "spark.sql.ansi.enabled", False,
     "ANSI SQL mode: integral overflow, divide by zero, invalid numeric "

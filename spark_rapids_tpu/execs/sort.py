@@ -60,13 +60,17 @@ class TpuSortExec(TpuExec):
     def output_schema(self):
         return self.children[0].output_schema()
 
+    #: set by the overrides conversion from
+    #: spark.rapids.sql.sort.outOfCoreThresholdBytes
+    ooc_threshold_bytes = 1 << 30
+
     def execute(self):
         """Multi-batch inputs accumulate as SPILLABLE batches (bounded HBM
         while upstream streams; reference: GpuSortExec pending pool,
-        GpuSortExec.scala:281), then a device concat + one lax.sort
-        produces the output. The final sort materializes the full table on
-        device under OOM retry — emitting range-split output batches
-        without full materialization is the planned widening."""
+        GpuSortExec.scala:281). Small totals concat on device and sort
+        once; totals above the out-of-core threshold take the spilled-run
+        range merge (``sorted_run_stream``) so peak HBM stays one output
+        range — the GpuSortExec.scala:281 merge-of-spilled-runs analog."""
         from spark_rapids_tpu.runtime.retry import retry_block
         from spark_rapids_tpu.runtime.spill import BufferCatalog, SpillableBatch
 
@@ -83,10 +87,21 @@ class TpuSortExec(TpuExec):
         from spark_rapids_tpu.columnar.table import concat_device
         catalog = BufferCatalog.get()
         pending = []
+        total = 0
+        all_batches = chain([first, second], it)
         try:
-            for batch in chain([first, second], it):
+            for batch in all_batches:
                 pending.append(SpillableBatch(batch, catalog))
+                total += batch.device_nbytes()
                 self.add_metric("sortInputBatches", 1)
+                if total > self.ooc_threshold_bytes:
+                    # switch to out-of-core: drain the rest as host runs
+                    batches = [sb for sb in pending]
+                    pending = []
+                    self.add_metric("sortOutOfCore", 1)
+                    yield from self._ooc_stream(batches, all_batches,
+                                                catalog)
+                    return
 
             def merge_and_sort():
                 tables = [sb.get() for sb in pending]
@@ -96,6 +111,36 @@ class TpuSortExec(TpuExec):
         finally:
             for sb in pending:
                 sb.release()
+
+    @classmethod
+    def for_orders(cls, orders):
+        """Standalone sorter over ``orders`` (used by range merging and
+        the window streaming path — no child exec)."""
+        ex = cls.__new__(cls)
+        ex.orders = list(orders)
+        ex.metrics = {}
+        return ex
+
+    def _ooc_stream(self, spillables, rest_iter, catalog):
+        from spark_rapids_tpu.runtime.retry import retry_block
+        runs = []
+        try:
+            while spillables:
+                sb = spillables.pop()
+                try:
+                    with sb.pinned_batch() as dt:
+                        runs.append(retry_block(
+                            lambda d=dt: self._sort(d)).to_host())
+                finally:
+                    sb.release()
+            for batch in rest_iter:
+                runs.append(retry_block(
+                    lambda b=batch: self._sort(b)).to_host())
+                self.add_metric("sortInputBatches", 1)
+        finally:
+            for sb in spillables:  # error mid-loop: drop the rest
+                sb.release()
+        yield from sorted_run_stream(runs, self.orders)
 
     def _pos_dep(self) -> bool:
         from spark_rapids_tpu.ops.expr import has_position_dependent
@@ -278,3 +323,110 @@ class TpuTakeOrderedAndProjectExec(TpuExec):
             out = DeviceTable(self.project_names, cols, out.nrows_dev,
                               out.capacity)
         yield out
+
+
+def sorted_run_stream(runs, orders, target_rows: int = None):
+    """Merge HOST-resident sorted runs into a stream of globally ordered
+    DEVICE batches without materializing the whole table on device — the
+    reference's merge of spilled sorted runs (GpuSortExec.scala:281),
+    re-shaped for the TPU: instead of a pointer-chasing k-way merge, the
+    FIRST sort key's value space splits into quantile ranges; each range
+    gathers its slice from every run (host slicing is O(log n) per run —
+    runs are sorted), uploads, and one device sort orders the range. Peak
+    HBM = one range. Rows with EQUAL first keys always land in the same
+    output batch (bounds are cut points), which also makes the stream
+    safe for RANGE-frame window peers (execs/window.py streaming).
+
+    ``runs``: list of HostTable, each fully sorted by ``orders``."""
+    import numpy as np
+    from spark_rapids_tpu.columnar import DeviceTable, HostTable
+    from spark_rapids_tpu.runtime.retry import retry_block
+
+    o0 = orders[0]
+    asc = o0.ascending
+    nulls_first = o0.resolved_nulls_first()
+
+    # first-key host values + per-run null spans (contiguous by sortedness)
+    keys = []
+    spans = []
+    for run in runs:
+        kc = o0.expr.eval_cpu(run)
+        n = run.num_rows
+        nn = int(kc.validity.sum())
+        if nulls_first:
+            null_lo, null_hi, lo, hi = 0, n - nn, n - nn, n
+        else:
+            null_lo, null_hi, lo, hi = nn, n, 0, nn
+        vals = kc.data[lo:hi]
+        keys.append(vals if asc else vals[::-1])  # ascending view
+        spans.append((null_lo, null_hi, lo, hi))
+
+    total = sum(k.shape[0] for k in keys)
+    if target_rows is None:
+        target_rows = max((r.num_rows for r in runs), default=1)
+    nparts = max(1, -(-total // max(target_rows, 1)))
+    if total:
+        allvals = np.sort(np.concatenate([np.asarray(k) for k in keys]))
+        bounds = []
+        for i in range(1, nparts):
+            b = allvals[(total * i) // nparts]
+            if not bounds or b != bounds[-1]:
+                bounds.append(b)
+    else:
+        bounds = []
+
+    def run_slices(part_idx, lo_b, hi_b):
+        """HostTable slices of every run for value range [lo_b, hi_b)."""
+        parts = []
+        for run, k, (null_lo, null_hi, lo, hi) in zip(runs, keys, spans):
+            a = 0 if lo_b is None else int(np.searchsorted(k, lo_b, "left"))
+            b = k.shape[0] if hi_b is None else int(
+                np.searchsorted(k, hi_b, "left"))
+            if b <= a:
+                continue
+            if asc:
+                parts.append(run.slice(lo + a, b - a))
+            else:
+                # ascending view was reversed: map back from the end
+                parts.append(run.slice(hi - b, b - a))
+        return parts
+
+    ranges = [(bounds[i - 1] if i else None,
+               bounds[i] if i < len(bounds) else None)
+              for i in range(len(bounds) + 1)]
+    if not asc:
+        ranges = ranges[::-1]  # larger keys first in the output order
+
+    def null_parts():
+        out = []
+        for run, (null_lo, null_hi, lo, hi) in zip(runs, spans):
+            if null_hi > null_lo:
+                out.append(run.slice(null_lo, null_hi - null_lo))
+        return out
+
+    emitted_sorter = _RangeSorter(orders)
+    if nulls_first:
+        np_parts = null_parts()
+        if np_parts:
+            yield retry_block(lambda p=np_parts: emitted_sorter(p))
+    for lo_b, hi_b in ranges:
+        parts = run_slices(0, lo_b, hi_b)
+        if parts:
+            yield retry_block(lambda p=parts: emitted_sorter(p))
+    if not nulls_first:
+        np_parts = null_parts()
+        if np_parts:
+            yield retry_block(lambda p=np_parts: emitted_sorter(p))
+
+
+class _RangeSorter:
+    """Upload + device-sort one range's host slices."""
+
+    def __init__(self, orders):
+        self._exec = TpuSortExec.for_orders(orders)
+
+    def __call__(self, host_parts):
+        from spark_rapids_tpu.columnar import DeviceTable, HostTable
+        host = host_parts[0] if len(host_parts) == 1 else \
+            HostTable.concat(host_parts)
+        return self._exec._sort(DeviceTable.from_host(host))

@@ -144,10 +144,297 @@ class TpuWindowExec(TpuExec):
             for batch in self.children[0].execute():
                 yield retry_block(lambda b=batch: self._window(b))
             return
-        batches = list(self.children[0].execute())
-        if len(batches) != 1:
-            raise ColumnarProcessingError("TpuWindowExec requires a single batch")
-        yield retry_block(lambda: self._window(batches[0]))
+        it = self.children[0].execute()
+        if self._streamable():
+            # consume ONE batch at a time: each sorts on device and
+            # demotes to a host run before the next loads (bounded HBM)
+            yield from self._stream_running(it)
+            return
+        batches = list(it)
+        if not batches:
+            return
+        if len(batches) == 1:
+            yield retry_block(lambda: self._window(batches[0]))
+            return
+        # general multi-batch fallback: device concat (bounded by HBM) +
+        # one kernel — the pre-round-4 "requires a single batch" raise is
+        # gone; true streaming covers the running-window subset above
+        from spark_rapids_tpu.columnar.table import concat_device
+        from spark_rapids_tpu.runtime.spill import BufferCatalog, SpillableBatch
+        catalog = BufferCatalog.get()
+        spills = [SpillableBatch(b, catalog) for b in batches]
+        try:
+            merged = retry_block(
+                lambda: concat_device([sb.get() for sb in spills]))
+        finally:
+            for sb in spills:
+                sb.release()
+        yield retry_block(lambda: self._window(merged))
+
+    # -- partition-less running-window streaming ----------------------------
+    # (reference: GpuRunningWindowExec — per-batch evaluation with carried
+    # scalar state; window/GpuWindowExec.scala)
+
+    _RUNNING_FRAMES = (("range", None, 0), ("rows", None, 0))
+
+    def _streamable(self) -> bool:
+        """True when every window column is a partition-less running
+        window over ONE shared ORDER BY — these stream with cross-batch
+        carried state instead of materializing the whole input."""
+        first_orders = None
+        for _, w in self.window_cols:
+            if w.spec.partition_exprs:
+                return False
+            if not w.spec.orders:
+                return False
+            okey = tuple((o.expr.key(), o.ascending,
+                          o.resolved_nulls_first()) for o in w.spec.orders)
+            if first_orders is None:
+                first_orders = okey
+            elif okey != first_orders:
+                return False
+            fn = w.function
+            if isinstance(fn, (RowNumber, Rank, DenseRank)):
+                continue
+            if isinstance(fn, DEVICE_WINDOW_AGGS) and \
+                    w.spec.resolved_frame() in self._RUNNING_FRAMES:
+                continue
+            return False
+        return True
+
+    def _stream_running(self, batches):
+        """Sort the input ONCE into globally ordered range batches (host
+        runs + quantile range merge — execs/sort.sorted_run_stream; its
+        equal-first-key invariant keeps RANGE-frame peers within one
+        batch), then evaluate each batch with carried running state."""
+        from spark_rapids_tpu.execs.sort import TpuSortExec, sorted_run_stream
+        from spark_rapids_tpu.runtime.retry import retry_block
+
+        orders = self.window_cols[0][1].spec.orders
+        sorter = TpuSortExec.for_orders(orders)
+        runs = []
+        for b in batches:
+            runs.append(retry_block(lambda bb=b: sorter._sort(bb)).to_host())
+        if not runs:
+            return
+        state = None
+        self.add_metric("runningWindowBatches", len(runs))
+        for dt in sorted_run_stream(runs, orders):
+            out, state = retry_block(
+                lambda d=dt, st=state: self._stream_batch(d, st))
+            yield out
+
+    def _stream_batch(self, table: DeviceTable, state):
+        """One sorted batch through the running-window kernel with carried
+        state (tuple of device scalars; None = initial)."""
+        from spark_rapids_tpu.dispatch import prep_aux
+        from spark_rapids_tpu.ops.expr import shared_traces
+
+        pctx = PrepCtx(table)
+        specs = []
+        for _, w in self.window_cols:
+            op = [self._prep_tree(o.expr, pctx) for o in w.spec.orders]
+            vp = self._prep_value(w, pctx)
+            specs.append((op, vp))
+        cols = tuple(DevVal(c.data, c.validity) for c in table.columns)
+        aux = prep_aux(pctx)
+        capacity = table.capacity
+
+        self._traces = shared_traces(
+            ("runwin", tuple(w.key() for _, w in self.window_cols),
+             table.schema_key()[0]))
+        tkey = ("stream", capacity, tuple(
+            (tuple(_prep_trace_key(p) for p in op),
+             tuple(_prep_trace_key(p) for p in vp) if vp else None)
+            for op, vp in specs))
+        fn = self._traces.get(tkey)
+        if fn is None:
+            fn = tpu_jit(self._build_stream_kernel(capacity, specs))
+            self._traces[tkey] = fn
+        if state is None:
+            state = self._initial_state()
+        outs, new_state = fn(cols, aux, table.nrows_dev, state)
+        out_cols = list(table.columns)
+        names = list(table.names)
+        for (name, w), (d, v) in zip(self.window_cols, outs):
+            out_cols.append(DeviceColumn(w.data_type, d, v))
+            names.append(name)
+        return (DeviceTable(names, out_cols, table.nrows_dev, capacity),
+                new_state)
+
+    def _initial_state(self):
+        parts = []
+        for _, w in self.window_cols:
+            fn = w.function
+            if isinstance(fn, (RowNumber, Rank)):
+                parts.append((jnp.asarray(0, jnp.int64),))
+            elif isinstance(fn, DenseRank):
+                parts.append((jnp.asarray(0, jnp.int64),))
+            elif isinstance(fn, agg.Count):
+                parts.append((jnp.asarray(0, jnp.int64),))
+            elif isinstance(fn, (agg.Sum, agg.Average)):
+                is_long = (isinstance(fn, agg.Sum)
+                           and isinstance(fn.data_type, T.LongType))
+                parts.append((jnp.asarray(0, jnp.int64) if is_long
+                              else jnp.asarray(0.0, jnp.float64),
+                              jnp.asarray(0, jnp.int64)))
+            elif isinstance(fn, (agg.Min, agg.Max)):
+                dt = fn.children[0].data_type.np_dtype
+                ident = self._ident(jnp.dtype(dt), isinstance(fn, agg.Min))
+                parts.append((ident, jnp.asarray(0, jnp.int64)))
+        return tuple(parts)
+
+    def _build_stream_kernel(self, capacity: int, specs):
+        window_cols = self.window_cols
+
+        def kernel(cols, aux, nrows, state):
+            live = jnp.arange(capacity, dtype=jnp.int32) < nrows
+
+            def eval_tree(e, preps):
+                ctx = EvalCtx(cols, aux, nrows, capacity)
+                ctx._prep_iter = iter(preps)
+                return _walk_eval(e, ctx)
+
+            # shared ORDER key peer structure (all specs share orders)
+            op0 = specs[0][0]
+            orders0 = window_cols[0][1].spec.orders
+            from spark_rapids_tpu.ops.ordering import comparable_operands
+            peer_ops = []
+            for o, preps in zip(orders0, op0):
+                kv = eval_tree(o.expr, preps)
+                # canonical operands: NaNs are peers, -0.0 == 0.0 (the
+                # batch kernel's _peer_eq_break invariant)
+                zeroed = jnp.where(kv.validity, kv.data,
+                                   jnp.zeros_like(kv.data))
+                peer_ops.append((~kv.validity).astype(jnp.int32))
+                peer_ops.extend(comparable_operands(zeroed))
+            first = jnp.arange(capacity) == 0
+            new_peer = first
+            for o in peer_ops:
+                new_peer = new_peer | (o != jnp.roll(o, 1))
+            new_peer = new_peer & live
+            peer_id = jnp.cumsum(new_peer.astype(jnp.int32)) - 1
+            peer_id = jnp.where(live, peer_id, capacity - 1)
+            rows_before = jnp.cumsum(live.astype(jnp.int64)) - 1  # 0-based
+            batch_rows = jnp.sum(live.astype(jnp.int64))
+            peer_start = _seg_scan_max(
+                jnp.where(new_peer, jnp.arange(capacity, dtype=jnp.int32),
+                          0))
+
+            outs = []
+            new_state = []
+            for ((op, vp), (name, w), st) in zip(specs, window_cols, state):
+                fn = w.function
+                if isinstance(fn, RowNumber):
+                    (prev_rows,) = st
+                    d = (prev_rows + rows_before + 1).astype(jnp.int64)
+                    outs.append((jnp.where(live, d, 0), live))
+                    new_state.append((prev_rows + batch_rows,))
+                elif isinstance(fn, Rank):
+                    (prev_rows,) = st
+                    start_rows = rows_before[peer_start]
+                    d = (prev_rows + start_rows + 1).astype(jnp.int64)
+                    outs.append((jnp.where(live, d, 0), live))
+                    new_state.append((prev_rows + batch_rows,))
+                elif isinstance(fn, DenseRank):
+                    (prev_dense,) = st
+                    local = jnp.cumsum(new_peer.astype(jnp.int64))
+                    d = prev_dense + local
+                    outs.append((jnp.where(live, d, 0), live))
+                    new_state.append((prev_dense + local[capacity - 1]
+                                      if capacity else prev_dense,))
+                else:
+                    outs_st = self._stream_agg(
+                        fn, vp, eval_tree, w, live, peer_id, capacity, st)
+                    outs.append(outs_st[0])
+                    new_state.append(outs_st[1])
+            return outs, tuple(new_state)
+
+        return kernel
+
+    def _stream_agg(self, fn, vp, eval_tree, w, live, peer_id, capacity,
+                    st):
+        """Running aggregate over one sorted batch with carry. RANGE
+        frames read the running value at the END of the row's peer group
+        (per-peer totals + prefix over peers); ROWS frames are plain
+        prefixes."""
+        frame = w.spec.resolved_frame()
+        rows_frame = frame[0] == "rows"
+        v = eval_tree(fn.children[0], vp[0]) if fn.children else None
+        if isinstance(fn, agg.Count):
+            (prev_cnt,) = st
+            w_valid = (live if fn.child is None
+                       else (live & v.validity)).astype(jnp.int64)
+            if rows_frame:
+                run = jnp.cumsum(w_valid)
+            else:
+                per_peer = jax.ops.segment_sum(w_valid, peer_id,
+                                               num_segments=capacity)
+                run = jnp.cumsum(per_peer)[peer_id]
+            d = prev_cnt + run
+            return ((jnp.where(live, d, 0), live),
+                    (prev_cnt + jnp.sum(w_valid),))
+        if isinstance(fn, (agg.Sum, agg.Average)):
+            prev_sum, prev_cnt = st
+            sv = live & v.validity
+            # LongType sums stay exact in int64 (the batch kernel's
+            # invariant — f64 emulation would round beyond 2^53)
+            int_exact = (isinstance(fn, agg.Sum)
+                         and isinstance(fn.data_type, T.LongType))
+            if int_exact:
+                vv = jnp.where(sv, v.data.astype(jnp.int64), 0)
+                prev_sum = prev_sum.astype(jnp.int64)
+            else:
+                vv = jnp.where(sv, v.data.astype(jnp.float64), 0.0)
+            cnt1 = sv.astype(jnp.int64)
+            if rows_frame:
+                rsum = jnp.cumsum(vv)
+                rcnt = jnp.cumsum(cnt1)
+            else:
+                rsum = jnp.cumsum(jax.ops.segment_sum(
+                    vv, peer_id, num_segments=capacity))[peer_id]
+                rcnt = jnp.cumsum(jax.ops.segment_sum(
+                    cnt1, peer_id, num_segments=capacity))[peer_id]
+            tsum = prev_sum + rsum
+            tcnt = prev_cnt + rcnt
+            has = tcnt > 0
+            if isinstance(fn, agg.Average):
+                d = tsum / jnp.maximum(tcnt, 1).astype(jnp.float64)
+            else:
+                d = tsum
+            zero = jnp.zeros_like(d)
+            return ((jnp.where(has & live, d, zero), has & live),
+                    (prev_sum + jnp.sum(vv), prev_cnt + jnp.sum(cnt1)))
+        # Min / Max
+        prev_m, prev_cnt = st
+        is_min = isinstance(fn, agg.Min)
+        dt = jnp.dtype(v.data.dtype)
+        ident = self._ident(dt, is_min)
+        sv = live & v.validity
+        vd = jnp.where(sv, v.data, ident)
+        op = jnp.minimum if is_min else jnp.maximum
+        if frame[0] == "rows":
+            run = jax.lax.associative_scan(op, vd)
+        else:
+            per_peer = (jax.ops.segment_min if is_min
+                        else jax.ops.segment_max)(
+                vd, peer_id, num_segments=capacity)
+            run = jax.lax.associative_scan(op, per_peer)[peer_id]
+        cnt1 = sv.astype(jnp.int64)
+        if frame[0] == "rows":
+            rcnt = jnp.cumsum(cnt1)
+        else:
+            rcnt = jnp.cumsum(jax.ops.segment_sum(
+                cnt1, peer_id, num_segments=capacity))[peer_id]
+        total = op(run, prev_m.astype(run.dtype))
+        tcnt = prev_cnt + rcnt
+        has = tcnt > 0
+        zero = jnp.zeros_like(total)
+        return ((jnp.where(has & live, total, zero), has & live),
+                (op(prev_m.astype(run.dtype),
+                    jnp.where(jnp.sum(cnt1) > 0, run[capacity - 1],
+                              prev_m.astype(run.dtype))),
+                 prev_cnt + jnp.sum(cnt1)))
 
     # -----------------------------------------------------------------------
     def _window(self, table: DeviceTable) -> DeviceTable:

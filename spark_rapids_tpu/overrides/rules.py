@@ -392,8 +392,15 @@ def _convert_aggregate(node: P.Aggregate, children, conf):
 
 
 def _convert_sort(node: P.Sort, children, conf):
-    coalesced = TpuCoalesceExec(children[0], target_bytes=conf.batch_size_bytes)
-    return TpuSortExec(coalesced, node.orders)
+    from spark_rapids_tpu.conf import SORT_OOC_THRESHOLD
+    ooc = conf.get_entry(SORT_OOC_THRESHOLD)
+    # the pre-sort coalesce must not merge past the out-of-core threshold,
+    # or the sort would never see separable runs to spill
+    coalesced = TpuCoalesceExec(
+        children[0], target_bytes=min(conf.batch_size_bytes, ooc))
+    ex = TpuSortExec(coalesced, node.orders)
+    ex.ooc_threshold_bytes = ooc
+    return ex
 
 
 def _convert_limit(node: P.Limit, children, conf):
@@ -637,7 +644,15 @@ def _convert_window(node: P.WindowNode, children, conf):
         batched = TpuKeyedBatchExec(children[0],
                                     specs[0].partition_exprs, conf)
         return TpuWindowExec(batched, node.window_cols, per_batch=True)
-    coalesced = TpuCoalesceExec(children[0], require_single=True)
+    probe = TpuWindowExec.__new__(TpuWindowExec)
+    probe.window_cols = list(node.window_cols)
+    if probe._streamable():
+        # partition-less running windows STREAM with carried state
+        # (GpuRunningWindowExec analog) — no require-single concat
+        coalesced = TpuCoalesceExec(children[0],
+                                    target_bytes=conf.batch_size_bytes)
+    else:
+        coalesced = TpuCoalesceExec(children[0], require_single=True)
     return TpuWindowExec(coalesced, node.window_cols)
 
 
