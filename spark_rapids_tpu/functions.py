@@ -27,7 +27,10 @@ def max(e):  # noqa: A001
 
 
 def count(e="*"):
-    if e == "*" or e == 1:
+    # NOTE: col("x") == "*" builds an EqualTo EXPRESSION (truthy), so the
+    # star check must be type-guarded or count(col) silently becomes
+    # count(*) with different null semantics
+    if (isinstance(e, str) and e == "*") or (isinstance(e, int) and e == 1):
         return _agg.Count()
     return _agg.Count(_e(e))
 
@@ -684,3 +687,33 @@ def build_bloom_filter(df, column, num_bits=None, num_hashes=None):
 def might_contain(bloom, e):
     from spark_rapids_tpu.ops.bloom import BloomFilterMightContain
     return BloomFilterMightContain(bloom, _e(e))
+
+
+def from_json(e, schema):
+    """from_json(col, schema) -> struct (GpuJsonToStructs analog)."""
+    from spark_rapids_tpu.ops.json_structs import JsonToStructs
+    return JsonToStructs(_e(e), schema)
+
+
+def to_json(e):
+    from spark_rapids_tpu.ops.json_structs import StructsToJson
+    return StructsToJson(_e(e))
+
+
+def sequence(start, stop, step=None):
+    from spark_rapids_tpu.ops.collections import Sequence
+    args = [_e(start), _e(stop)]
+    if step is not None:
+        args.append(_e(step))
+    return Sequence(*args)
+
+
+def approx_percentile(e, percentage, accuracy: int = 10000):
+    """approx_percentile — served EXACTLY by the device sort-based
+    percentile (any answer within Spark's accuracy contract; exact
+    satisfies every accuracy)."""
+    from spark_rapids_tpu.ops.aggregates import Percentile
+    return Percentile(_e(e), percentage)
+
+
+approxPercentile = approx_percentile

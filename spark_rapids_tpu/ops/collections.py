@@ -440,3 +440,118 @@ class ExplodeOuter(Explode):
 class PosExplodeOuter(Explode):
     pos = True
     outer = True
+
+
+class Sequence(Expression):
+    """sequence(start, stop[, step]) -> array<integral> (reference:
+    GpuGenerateExec's GpuSequence / collectionOperations). Step defaults
+    to 1 or -1 by direction (Spark semantics); a zero step or a step
+    pointing away from stop is a runtime error.
+
+    TPU sizing: per-row lengths are data-dependent, so the element buffer
+    takes a STATIC speculative capacity (input capacity x
+    SEQ_ELEMENT_MULT); overflow raises through the runtime-error flag
+    channel (rides the collect fetch — ops/expr.deliver_ansi_flags) with
+    a message naming the knob."""
+
+    #: element capacity = bucket(row capacity * this)
+    SEQ_ELEMENT_MULT = 4
+
+    def __init__(self, *children: Expression):
+        if len(children) not in (2, 3):
+            raise ColumnarProcessingError("sequence(start, stop[, step])")
+        self.children = tuple(children)
+
+    @property
+    def data_type(self):
+        return T.ArrayType(T.LONG)
+
+    def key(self):
+        return ("sequence", tuple(c.key() for c in self.children))
+
+    def with_children(self, children):
+        return Sequence(*children)
+
+    def resolve(self, bound):
+        from spark_rapids_tpu.ops.cast import Cast
+        for c in bound:
+            if not isinstance(c.data_type, T.IntegralType):
+                raise ColumnarProcessingError(
+                    "sequence() boundaries must be integral, got "
+                    f"{c.data_type.simple_string()} (temporal sequences "
+                    "are not supported)")
+        out = [c if isinstance(c.data_type, T.LongType) else Cast(c, T.LONG)
+               for c in bound]
+        return Sequence(*out)
+
+    @property
+    def device_supported(self):
+        return all(isinstance(c.data_type, T.IntegralType)
+                   for c in self.children)
+
+    def eval_cpu(self, table: HostTable) -> HostColumn:
+        kids = [c.eval_cpu(table) for c in self.children]
+        n = table.num_rows
+        out = np.empty(n, dtype=object)
+        validity = np.zeros(n, dtype=np.bool_)
+        for i in range(n):
+            if not all(k.validity[i] for k in kids):
+                continue
+            start, stop = int(kids[0].data[i]), int(kids[1].data[i])
+            step = int(kids[2].data[i]) if len(kids) > 2 else (
+                1 if stop >= start else -1)
+            if step == 0 or (stop - start) * step < 0 and start != stop:
+                raise ColumnarProcessingError(
+                    "sequence step must move start toward stop")
+            if abs(stop - start) // abs(step) + 1 > 100_000_000:
+                raise ColumnarProcessingError(
+                    "sequence length exceeds the 1e8-element bound")
+            out[i] = list(range(start, stop + (1 if step > 0 else -1),
+                                step))
+            validity[i] = True
+        return HostColumn(self.data_type, out, validity)
+
+    def eval_dev(self, ctx, child_vals, prep) -> DevVal:
+        from spark_rapids_tpu.columnar import bucket_for
+        cap = ctx.capacity
+        start = child_vals[0]
+        stop = child_vals[1]
+        validity = start.validity & stop.validity
+        s64 = start.data.astype(jnp.int64)
+        e64 = stop.data.astype(jnp.int64)
+        if len(child_vals) > 2:
+            validity = validity & child_vals[2].validity
+            step = child_vals[2].data.astype(jnp.int64)
+        else:
+            step = jnp.where(e64 >= s64, 1, -1).astype(jnp.int64)
+        live = validity & ctx.row_mask()
+        bad_step = live & ((step == 0)
+                           | (((e64 - s64) * jnp.where(step == 0, 1, step)
+                               < 0) & (s64 != e64)))
+        # Spark raises for invalid steps regardless of ANSI mode: route
+        # through the runtime-error flag channel unconditionally
+        ctx.ansi_errors.append((
+            "sequence step must move start toward stop",
+            jnp.any(bad_step)))
+        safe_step = jnp.where(step == 0, 1, step)
+        lengths64 = jnp.where(
+            live & ~bad_step,
+            jnp.maximum((e64 - s64) // safe_step + 1, 0),
+            jnp.zeros_like(s64))
+        ecap = bucket_for(max(cap * self.SEQ_ELEMENT_MULT, 1))
+        # flag BEFORE narrowing: an int64 length past 2^31 would wrap
+        # negative in int32 and silently dodge the capacity check
+        over = jnp.any(lengths64 > ecap) | (jnp.sum(lengths64) > ecap)
+        ctx.ansi_errors.append((
+            "sequence output exceeded the element capacity "
+            f"(rows x {self.SEQ_ELEMENT_MULT}); reduce sequence lengths "
+            "or raise Sequence.SEQ_ELEMENT_MULT", over))
+        lengths = jnp.clip(lengths64, 0, ecap).astype(jnp.int32)
+        offsets = jnp.concatenate(
+            [jnp.zeros(1, jnp.int32), jnp.cumsum(lengths)])
+        rid = _elem_rids(offsets, ecap, cap)
+        safe_rid = jnp.clip(rid, 0, cap - 1)
+        pos = jnp.arange(ecap, dtype=jnp.int64) - offsets[safe_rid]
+        ed = s64[safe_rid] + pos * safe_step[safe_rid]
+        ev = rid < cap
+        return DevVal((offsets, ed, ev), validity)

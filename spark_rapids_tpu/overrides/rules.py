@@ -114,6 +114,10 @@ def _build_expr_sigs():
         reg(getattr(nested_ops, name), COMMON_PLUS_NESTED)
     from spark_rapids_tpu.ops.bloom import BloomFilterMightContain
     reg(BloomFilterMightContain)
+    reg(coll.Sequence, COMMON_PLUS_ARRAYS)
+    from spark_rapids_tpu.ops import json_structs as js
+    reg(js.JsonToStructs, COMMON_PLUS_NESTED)
+    reg(js.StructsToJson, COMMON_PLUS_NESTED)
     for fn in DEVICE_SUPPORTED_AGGS:
         reg(fn)
 

@@ -161,6 +161,39 @@ class DataFrame:
             "join `on` must be a column name, list of names, or a condition "
             "Expression")
 
+    def stack(self, n: int, *exprs, names=None) -> "DataFrame":
+        """stack(n, e1..ek): n output rows per input row with k/n columns
+        (reference: GpuGenerateExec Stack). TPU rewrite: a UNION of n
+        projections — fully static shapes, no generator kernel (row order
+        across generated rows is unspecified, as in Spark)."""
+        exprs = [col(e) if isinstance(e, str) else e for e in exprs]
+        if n <= 0 or len(exprs) % n != 0:
+            raise ValueError("stack(n, ...) needs a multiple of n exprs")
+        width = len(exprs) // n
+        if names is None:
+            names = [f"col{i}" for i in range(width)]
+        parts = []
+        for r in range(n):
+            row = [exprs[r * width + j].alias(names[j])
+                   for j in range(width)]
+            parts.append(self.select(*row).plan)
+        return self._wrap(parts[0] if len(parts) == 1 else P.Union(parts))
+
+    def replicate_rows(self, n_expr) -> "DataFrame":
+        """replicate_rows(n): each row repeated n times (reference:
+        GpuReplicateRows). TPU rewrite: explode(sequence(1, n)) and drop
+        the sequence column — rides the existing Generate machinery."""
+        from spark_rapids_tpu.functions import sequence
+        n_expr = col(n_expr) if isinstance(n_expr, str) else n_expr
+        from spark_rapids_tpu.ops.collections import Explode
+        keep = [c for c, _ in self.plan.output_schema()]
+        # rows with n <= 0 are DROPPED (GpuReplicateRows semantics);
+        # filtering first also pins the sequence direction to ascending
+        filtered = self.filter(n_expr > lit(0))
+        seq = sequence(lit(1), n_expr, lit(1))
+        exploded = filtered.select(*keep, Explode(seq).alias("__rep"))
+        return exploded.select(*keep)
+
     def with_windows(self, **named_exprs) -> "DataFrame":
         """Append window-function columns:
         df.with_windows(rn=F.row_number().over(W.partition_by("k").order_by("v")))"""
@@ -256,6 +289,14 @@ class GroupedData:
                     f"{what} requires plain column-name grouping keys")
             names.append(k.col_name)
         return names
+
+    def pivot(self, pivot_col: str, values) -> "PivotedData":
+        """df.group_by(k).pivot(c, [v1, v2]).agg(...) — the reference's
+        GpuPivotFirst surface. The TPU rewrite turns each (pivot value,
+        aggregate) pair into a conditionally-masked aggregate
+        (agg(when(c == v, x))) — the same rewrite Spark applies before
+        PivotFirst, with no new device kernel."""
+        return PivotedData(self, pivot_col, list(values))
 
     def agg(self, *aggs) -> DataFrame:
         from spark_rapids_tpu.plan.pandas_udf import (
@@ -365,3 +406,37 @@ def to_device_arrays(df: "DataFrame"):
         else:
             out[name] = (c.data, c.validity)
     return out, t.num_rows
+
+
+class PivotedData:
+    """group_by(...).pivot(col, values) — expands to masked aggregates."""
+
+    def __init__(self, grouped: GroupedData, pivot_col: str, values):
+        self.grouped = grouped
+        self.pivot_col = pivot_col
+        self.values = values
+
+    def agg(self, *aggs) -> DataFrame:
+        from spark_rapids_tpu.ops import aggregates as _agg
+        from spark_rapids_tpu.ops.conditional import CaseWhen
+        from spark_rapids_tpu.ops.expr import col as _col, lit as _lit
+        from spark_rapids_tpu.ops.expr import Alias, output_name
+
+        out = []
+        for pv in self.values:
+            for i, a in enumerate(aggs):
+                name = output_name(a, f"agg{i}")
+                fn = a.children[0] if isinstance(a, Alias) else a
+                if not isinstance(fn, _agg.AggregateFunction):
+                    raise ValueError(f"pivot agg must be an aggregate: {a!r}")
+                if fn.child is None:  # count(*): count matching rows
+                    masked = _agg.Count(CaseWhen(
+                        _col(self.pivot_col) == _lit(pv), _lit(1)))
+                else:
+                    # with_children preserves extra ctor params
+                    # (Percentile.percentage etc.)
+                    masked = fn.with_children([CaseWhen(
+                        _col(self.pivot_col) == _lit(pv), fn.child)])
+                label = (f"{pv}" if len(aggs) == 1 else f"{pv}_{name}")
+                out.append(Alias(masked, label))
+        return self.grouped.agg(*out)

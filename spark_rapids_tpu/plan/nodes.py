@@ -462,7 +462,10 @@ class Generate(PlanNode):
                               [full.columns[i] for i in keep])
             e_dt = self.gen_child.data_type.element_type
             rows_idx, poss, vals, vvalid, pvalid = [], [], [], [], []
-            for i in range(batch.num_rows):
+            # iterate the FULL batch: the pruned pass-through table may
+            # have zero columns (explode with nothing else selected),
+            # which would read as zero rows
+            for i in range(full.num_rows):
                 if arr.validity[i] and len(arr.data[i]):
                     for k, v in enumerate(arr.data[i]):
                         rows_idx.append(i)

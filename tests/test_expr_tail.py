@@ -1,0 +1,168 @@
+"""Expression tail: from_json/to_json, sequence, stack, replicate_rows,
+approx_percentile, pivot (reference: GpuJsonToStructs.scala,
+GpuGenerateExec Sequence/Stack/ReplicateRows, GpuApproximatePercentile,
+GpuPivotFirst — VERDICT r3 missing #6-#8)."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import functions as F
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.ops.expr import col, lit
+from spark_rapids_tpu.session import TpuSession
+
+
+@pytest.fixture(scope="module")
+def tpu():
+    return TpuSession()
+
+
+@pytest.fixture(scope="module")
+def cpu():
+    return TpuSession({"spark.rapids.sql.enabled": "false"})
+
+
+# -- from_json / to_json -----------------------------------------------------
+
+def test_from_json_device_and_oracle(tpu, cpu):
+    st = T.StructType([T.StructField("a", T.LONG),
+                       T.StructField("b", T.DOUBLE)])
+    docs = ['{"a": 1, "b": 2.5}', '{"a": 7}', "not json", None,
+            '{"a": "wrongtype", "b": 3}', '[1,2]']
+    for s in (tpu, cpu):
+        got = [r[0] for r in s.create_dataframe(
+            {"j": docs}, dtypes={"j": T.STRING}).select(
+            F.from_json(col("j"), st).alias("s")).collect()]
+        # PERMISSIVE: malformed/non-object -> all-null-fields row;
+        # only null INPUT -> null struct
+        assert got == [(1, 2.5), (7, None), (None, None), None,
+                       (None, 3.0), (None, None)]
+
+
+def test_from_json_then_get_field(tpu, cpu):
+    st = T.StructType([T.StructField("x", T.LONG)])
+    docs = ['{"x": %d}' % i for i in range(50)] + [None, "oops"]
+    q = lambda s: [r[0] for r in s.create_dataframe(
+        {"j": docs}, dtypes={"j": T.STRING}).select(
+        F.get_field(F.from_json(col("j"), st), "x").alias("v")).collect()]
+    assert q(tpu) == q(cpu) == list(range(50)) + [None, None]
+
+
+def test_to_json_roundtrip(tpu, cpu):
+    q = lambda s: [r[0] for r in s.create_dataframe(
+        {"a": np.arange(3, dtype=np.int64),
+         "b": np.asarray([1.5, 2.0, -3.25])}).select(
+        F.to_json(F.struct(col("a"), col("b"), names=["a", "b"]))
+        .alias("j")).collect()]
+    got = q(tpu)
+    assert got == q(cpu)
+    assert got[0] == '{"a":0,"b":1.5}'
+
+
+# -- sequence ----------------------------------------------------------------
+
+def test_sequence_basic(tpu, cpu):
+    data = {"a": np.asarray([1, 5, 3], dtype=np.int64),
+            "b": np.asarray([4, 1, 3], dtype=np.int64)}
+    q = lambda s: [r[0] for r in s.create_dataframe(data).select(
+        F.sequence(col("a"), col("b")).alias("s")).collect()]
+    assert q(tpu) == q(cpu) == [[1, 2, 3, 4], [5, 4, 3, 2, 1], [3]]
+
+
+def test_sequence_with_step_and_nulls(tpu, cpu):
+    data = {"a": [0, None, 10], "b": [10, 5, 0], "st": [3, 1, -5]}
+    dt = {"a": T.LONG, "b": T.LONG, "st": T.LONG}
+    q = lambda s: [r[0] for r in s.create_dataframe(data, dtypes=dt).select(
+        F.sequence(col("a"), col("b"), col("st")).alias("s")).collect()]
+    assert q(tpu) == q(cpu) == [[0, 3, 6, 9], None, [10, 5, 0]]
+
+
+def test_sequence_zero_step_raises(tpu, cpu):
+    data = {"a": np.asarray([1], dtype=np.int64),
+            "b": np.asarray([5], dtype=np.int64),
+            "st": np.asarray([0], dtype=np.int64)}
+    for s in (tpu, cpu):
+        with pytest.raises(Exception):
+            s.create_dataframe(data).select(
+                F.sequence(col("a"), col("b"), col("st")).alias("s")
+            ).collect()
+
+
+def test_explode_sequence(tpu, cpu):
+    data = {"a": np.asarray([1, 3], dtype=np.int64)}
+    q = lambda s: sorted(s.create_dataframe(data).select(
+        col("a"), F.explode(F.sequence(lit(1), col("a"))).alias("e"))
+        .collect())
+    assert q(tpu) == q(cpu) == [(1, 1), (3, 1), (3, 2), (3, 3)]
+
+
+# -- stack / replicate_rows --------------------------------------------------
+
+def test_stack(tpu, cpu):
+    data = {"a": np.asarray([1, 2], dtype=np.int64),
+            "b": np.asarray([10, 20], dtype=np.int64)}
+    q = lambda s: sorted(s.create_dataframe(data)
+                         .stack(2, col("a"), col("b"),
+                                col("a") + lit(100), col("b") + lit(100),
+                                names=["x", "y"]).collect())
+    assert q(tpu) == q(cpu) == [(1, 10), (2, 20), (101, 110), (102, 120)]
+
+
+def test_replicate_rows(tpu, cpu):
+    data = {"a": np.asarray([7, 8, 9], dtype=np.int64),
+            "n": np.asarray([3, 1, 0], dtype=np.int64)}
+    q = lambda s: sorted(s.create_dataframe(data)
+                         .replicate_rows("n").collect())
+    # n <= 0 rows are DROPPED (GpuReplicateRows semantics)
+    assert q(tpu) == q(cpu) == [(7, 3), (7, 3), (7, 3), (8, 1)]
+
+
+# -- approx_percentile / pivot ----------------------------------------------
+
+def test_approx_percentile(tpu, cpu):
+    rng = np.random.default_rng(0)
+    data = {"k": rng.integers(0, 4, 4000).astype(np.int64),
+            "v": rng.random(4000)}
+    q = lambda s: sorted(s.create_dataframe(data).group_by("k").agg(
+        F.approx_percentile(col("v"), 0.5).alias("med")).collect())
+    got, want = q(tpu), q(cpu)
+    for g, w in zip(got, want):
+        assert g[0] == w[0] and abs(g[1] - w[1]) <= 1e-9
+
+
+def test_pivot(tpu, cpu):
+    rng = np.random.default_rng(1)
+    n = 2000
+    data = {"k": rng.integers(0, 5, n).astype(np.int64),
+            "p": np.array(["x", "y", "z"], dtype=object)[
+                rng.integers(0, 3, n)],
+            "v": rng.random(n)}
+    q = lambda s: sorted(s.create_dataframe(data)
+                         .group_by("k").pivot("p", ["x", "y"])
+                         .agg(F.sum(col("v"))).collect())
+    got, want = q(tpu), q(cpu)
+    assert len(got) == len(want) == 5
+    for g, w in zip(got, want):
+        assert g[0] == w[0]
+        for a, b in zip(g[1:], w[1:]):
+            assert abs(a - b) <= 1e-6 * max(1.0, abs(b))
+
+
+def test_pivot_multiple_aggs(tpu, cpu):
+    data = {"k": np.asarray([0, 0, 1], dtype=np.int64),
+            "p": np.array(["x", "y", "x"], dtype=object),
+            "v": np.asarray([1.0, 2.0, 3.0])}
+    q = lambda s: sorted(s.create_dataframe(data)
+                         .group_by("k").pivot("p", ["x", "y"])
+                         .agg(F.sum(col("v")).alias("s"),
+                              F.count(col("v")).alias("c")).collect())
+    assert q(tpu) == q(cpu)
+
+
+def test_explode_alone_no_passthrough(tpu, cpu):
+    """explode() as the ONLY select expression (regression: the CPU
+    Generate iterated the zero-column pruned table as zero rows)."""
+    data = {"a": np.asarray([2, 1], dtype=np.int64)}
+    q = lambda s: sorted(s.create_dataframe(data).select(
+        F.explode(F.sequence(lit(1), col("a"))).alias("e")).collect())
+    assert q(tpu) == q(cpu) == [(1,), (1,), (2,)]
