@@ -152,6 +152,50 @@ MASKED_BATCHES = bool_conf(
     "split boundaries (columnar/table.py DeviceTable.live).",
     commonly_used=True)
 
+SEQUENCE_ELEMENT_MULT = int_conf(
+    "spark.rapids.tpu.sequence.elementMultiplier", 4,
+    "sequence() element buffer capacity as a multiple of the row "
+    "capacity; outputs beyond it raise with this knob's name "
+    "(static-shape sizing, ops/collections.Sequence).")
+
+COLLECT_EMBED_ROWS_CAP = int_conf(
+    "spark.rapids.tpu.collect.embedRowsCap", 1 << 16,
+    "Collects of tables up to this capacity fetch the padded bucket with "
+    "the row count embedded in the packed buffer instead of paying a "
+    "separate ~0.1s row-count sync (columnar/table.py to_host).")
+
+COLLECT_EMBED_MAX_BYTES = int_conf(
+    "spark.rapids.tpu.collect.embedMaxBytes", 4 << 20,
+    "...but only while the padded transfer stays under this many bytes "
+    "(wide schemas fall back to the row-count sync).")
+
+WINDOW_ROWS_FRAME_MAX_BOUND = int_conf(
+    "spark.rapids.sql.window.rowsFrameMaxBound", 1 << 16,
+    "Rows-frame window bounds beyond this magnitude tag CPU fallback "
+    "(sparse-table/unroll widths are bounded by the frame's endpoints).")
+
+NLJ_PAIR_BUDGET = int_conf(
+    "spark.rapids.sql.nestedLoopJoin.pairBudget", 1 << 20,
+    "Max probe-tile x build-row pairs materialized per nested-loop join "
+    "tile — bounds HBM for conditioned joins regardless of input sizes.")
+
+JOIN_MAX_SUBPARTITIONS = int_conf(
+    "spark.rapids.sql.join.maxSubPartitions", 64,
+    "Upper bound on hash sub-partitions when a join's build side "
+    "exceeds the sub-partitioning threshold.")
+
+BLOOM_DEFAULT_NUM_BITS = int_conf(
+    "spark.rapids.tpu.bloomFilter.numBits", 1 << 20,
+    "Default bit-array size for build_bloom_filter.")
+
+BLOOM_DEFAULT_NUM_HASHES = int_conf(
+    "spark.rapids.tpu.bloomFilter.numHashes", 3,
+    "Default hash-function count for build_bloom_filter.")
+
+HEARTBEAT_INTERVAL_S = float_conf(
+    "spark.rapids.shuffle.heartbeat.intervalSeconds", 5.0,
+    "Executor -> driver shuffle heartbeat period (peer discovery).")
+
 SORT_OOC_THRESHOLD = int_conf(
     "spark.rapids.sql.sort.outOfCoreThresholdBytes", 1 << 30,
     "Multi-batch sorts whose input exceeds this many device bytes merge "

@@ -401,7 +401,8 @@ class TpuJoinExec(TpuExec):
                  left_keys: Sequence[Expression], right_keys: Sequence[Expression],
                  condition: Optional[Expression],
                  left_schema, right_schema,
-                 subpartition_bytes: int = 1 << 30):
+                 subpartition_bytes: int = 1 << 30,
+                 max_subpartitions: int = 64):
         super().__init__()
         self.children = (left, right)
         self.join_type = join_type.lower().replace("_", "")
@@ -413,6 +414,7 @@ class TpuJoinExec(TpuExec):
         self._left_schema = left_schema
         self._right_schema = right_schema
         self.subpartition_bytes = subpartition_bytes
+        self.max_subpartitions = max_subpartitions
         self._kernel = JoinKernel.get(len(self.left_keys))
         self._filter_kernel = None
         self._site_base = "join:{}:{}:{}:{}:{}".format(
@@ -468,7 +470,8 @@ class TpuJoinExec(TpuExec):
         if (jt != "cross" and self.subpartition_bytes > 0
                 and build.device_nbytes() > self.subpartition_bytes):
             nparts = min(
-                -(-build.device_nbytes() // self.subpartition_bytes), 64)
+                -(-build.device_nbytes() // self.subpartition_bytes),
+                self.max_subpartitions)
         if nparts > 1:
             yield from self._execute_subpartitioned(
                 build, probe_child, swapped, int(nparts))

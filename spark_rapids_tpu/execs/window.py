@@ -59,7 +59,9 @@ DEVICE_WINDOW_AGGS = (agg.Sum, agg.Count, agg.Min, agg.Max, agg.Average)
 
 
 def device_window_supported(w: WindowExpression,
-                            variable_float_agg: bool = True) -> Tuple[bool, str]:
+                            variable_float_agg: bool = True,
+                            rows_frame_max_bound: int = 1 << 16
+                            ) -> Tuple[bool, str]:
     fn = w.function
     frame = w.spec.resolved_frame()
     if isinstance(fn, (RowNumber, Rank, DenseRank, PercentRank)):
@@ -85,9 +87,11 @@ def device_window_supported(w: WindowExpression,
             # sparse-table / unroll widths are bounded by the frame's
             # FINITE endpoints; gate them so table levels can't exhaust HBM
             for bound in (lo, hi):
-                if bound is not None and abs(bound) > (1 << 16):
-                    return False, ("rows frame bound beyond 65536 is not "
-                                   "supported on TPU")
+                if bound is not None and abs(bound) > rows_frame_max_bound:
+                    return False, (
+                        f"rows frame bound beyond {rows_frame_max_bound} "
+                        "is not supported on TPU (spark.rapids.sql."
+                        "window.rowsFrameMaxBound)")
             if (lo is not None and hi is not None and (hi - lo + 1) > 512
                     and isinstance(fn, (agg.Sum, agg.Average))
                     and isinstance(fn.data_type, (T.FloatType, T.DoubleType))
@@ -898,3 +902,125 @@ class TpuKeyedBatchExec(TpuExec):
         self.add_metric("keyBatchedPartitions", self.num_partitions)
         yield from ex.execute()
         self.metrics.update(ex.metrics)
+
+
+class TpuWindowGroupLimitExec(TpuExec):
+    """Pre-window group limit (GpuWindowGroupLimitExec analog): one sort
+    kernel ranks rows within their partition and emits a MASKED batch
+    keeping rank <= limit — at most limit(+ties) rows per partition reach
+    the window/shuffle above. Purely an optimization; the exact rank
+    filter above still applies."""
+
+    produces_masked = True
+
+    def __init__(self, child: TpuExec, partition_exprs, orders,
+                 rank_kind: str, limit: int):
+        super().__init__()
+        self.children = (child,)
+        self.partition_exprs = list(partition_exprs)
+        self.orders = list(orders)
+        self.rank_kind = rank_kind
+        self.limit = int(limit)
+
+    def output_schema(self):
+        return self.children[0].output_schema()
+
+    def describe(self):
+        return f"TpuWindowGroupLimit[{self.rank_kind} <= {self.limit}]"
+
+    def execute_masked(self):
+        from spark_rapids_tpu.runtime.retry import with_retry
+        for batch in self.children[0].execute_masked():
+            yield from with_retry(batch, self._limit_batch)
+
+    def _limit_batch(self, table: DeviceTable) -> DeviceTable:
+        from spark_rapids_tpu.dispatch import prep_aux, tpu_jit
+        from spark_rapids_tpu.ops.expr import shared_traces
+        pctx = PrepCtx(table)
+        pp = [TpuWindowExec._prep_tree(e, pctx)
+              for e in self.partition_exprs]
+        op = [TpuWindowExec._prep_tree(o.expr, pctx) for o in self.orders]
+        cols = tuple(DevVal(c.data, c.validity) for c in table.columns)
+        aux = prep_aux(pctx)
+        capacity = table.capacity
+        self._traces = shared_traces(
+            ("wingrouplimit", self.rank_kind, self.limit,
+             tuple(e.key() for e in self.partition_exprs),
+             tuple((o.expr.key(), o.ascending, o.resolved_nulls_first())
+                   for o in self.orders),
+             table.schema_key()[0]))
+        has_mask = table.live is not None
+        tkey = (capacity, has_mask,
+                tuple(_prep_trace_key(x) for x in pp),
+                tuple(_prep_trace_key(x) for x in op))
+        fn = self._traces.get(tkey)
+        if fn is None:
+            fn = tpu_jit(self._build_kernel(capacity, pp, op))
+            self._traces[tkey] = fn
+        keep, nkeep = fn(cols, aux, table.nrows_dev, table.live)
+        self.add_metric("groupLimitBatches", 1)
+        return DeviceTable(table.names, table.columns, nkeep, capacity,
+                           live=keep)
+
+    def _build_kernel(self, capacity: int, pp, op):
+        part_exprs = self.partition_exprs
+        orders = self.orders
+        rank_kind = self.rank_kind
+        limit = self.limit
+
+        def kernel(cols, aux, nrows, live_in):
+            def eval_tree(e, preps):
+                ctx = EvalCtx(cols, aux, nrows, capacity, live=live_in)
+                ctx._prep_iter = iter(preps)
+                return _walk_eval(e, ctx)
+
+            if live_in is not None:
+                live = live_in
+            else:
+                live = jnp.arange(capacity, dtype=jnp.int32) < nrows
+            from spark_rapids_tpu.execs.sort import _directional
+            from spark_rapids_tpu.ops.ordering import comparable_operands
+            operands = [(~live).astype(jnp.int32)]
+            part_ops = []
+            for e, preps in zip(part_exprs, pp):
+                kv = eval_tree(e, preps)
+                zeroed = jnp.where(kv.validity, kv.data,
+                                   jnp.zeros_like(kv.data))
+                part_ops.append((~kv.validity).astype(jnp.int32))
+                part_ops.extend(comparable_operands(zeroed))
+            operands.extend(part_ops)
+            n_part_ops = len(part_ops)
+            order_ops = []
+            for o, preps in zip(orders, op):
+                kv = eval_tree(o.expr, preps)
+                order_ops.extend(_directional(
+                    kv.data, kv.validity, o.ascending,
+                    o.resolved_nulls_first(), capacity))
+            operands.extend(order_ops)
+            payload = jnp.arange(capacity, dtype=jnp.int32)
+            res = jax.lax.sort(operands + [payload],
+                               num_keys=len(operands))
+            perm = res[-1]
+            s_live = live[perm]
+            first = jnp.arange(capacity) == 0
+            new_part = first
+            for so in res[1:1 + n_part_ops]:
+                new_part = new_part | (so != jnp.roll(so, 1))
+            new_peer = new_part
+            for so in res[1 + n_part_ops:-1]:
+                new_peer = new_peer | (so != jnp.roll(so, 1))
+            idx = jnp.arange(capacity, dtype=jnp.int32)
+            part_start = _seg_scan_max(jnp.where(new_part, idx, 0))
+            if rank_kind == "rownumber":
+                rank = idx - part_start + 1
+            elif rank_kind == "rank":
+                peer_start = _seg_scan_max(jnp.where(new_peer, idx, 0))
+                rank = peer_start - part_start + 1
+            else:  # denserank
+                rank = _segmented_cumsum(
+                    new_peer.astype(jnp.int32), part_start)
+            keep_sorted = s_live & (rank <= limit)
+            keep = jnp.zeros(capacity, jnp.bool_).at[perm].set(keep_sorted)
+            return keep, jnp.sum(keep.astype(jnp.int32))
+
+        return kernel

@@ -97,11 +97,23 @@ def _build_kernel(num_bits: int, k: int, cap: int):
 
 
 def build_bloom_filter(df, column: str,
-                       num_bits: int = DEFAULT_NUM_BITS,
-                       num_hashes: int = DEFAULT_NUM_HASHES) -> BloomFilter:
+                       num_bits: int = None,
+                       num_hashes: int = None) -> BloomFilter:
     """Aggregate ``df[column]`` (integral type) into a BloomFilter — the
     engine's bloom_filter_agg. Executes the DataFrame's plan on device and
     folds every batch into one bit array."""
+    if num_bits is None or num_hashes is None:
+        from spark_rapids_tpu.conf import (
+            BLOOM_DEFAULT_NUM_BITS,
+            BLOOM_DEFAULT_NUM_HASHES,
+        )
+        conf = getattr(df.session, "conf", None)
+        if num_bits is None:
+            num_bits = (conf.get_entry(BLOOM_DEFAULT_NUM_BITS)
+                        if conf else DEFAULT_NUM_BITS)
+        if num_hashes is None:
+            num_hashes = (conf.get_entry(BLOOM_DEFAULT_NUM_HASHES)
+                          if conf else DEFAULT_NUM_HASHES)
     schema = dict(df.select(column).plan.output_schema())
     if not isinstance(schema[column], T.IntegralType):
         raise ColumnarProcessingError(

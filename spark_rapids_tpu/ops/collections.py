@@ -467,7 +467,10 @@ class Sequence(Expression):
         return T.ArrayType(T.LONG)
 
     def key(self):
-        return ("sequence", tuple(c.key() for c in self.children))
+        # the element multiplier shapes the trace (static ecap), so it
+        # must key the compile cache — sessions set it per query
+        return ("sequence", self.SEQ_ELEMENT_MULT,
+                tuple(c.key() for c in self.children))
 
     def with_children(self, children):
         return Sequence(*children)
@@ -547,8 +550,11 @@ class Sequence(Expression):
             f"(rows x {self.SEQ_ELEMENT_MULT}); reduce sequence lengths "
             "or raise Sequence.SEQ_ELEMENT_MULT", over))
         lengths = jnp.clip(lengths64, 0, ecap).astype(jnp.int32)
-        offsets = jnp.concatenate(
-            [jnp.zeros(1, jnp.int32), jnp.cumsum(lengths)])
+        # clamp offsets into the element buffer: when the capacity flag
+        # fired the collect still decodes in-bounds (garbage content) and
+        # the flagged error raises at validation, not an IndexError
+        offsets = jnp.minimum(jnp.concatenate(
+            [jnp.zeros(1, jnp.int32), jnp.cumsum(lengths)]), ecap)
         rid = _elem_rids(offsets, ecap, cap)
         safe_rid = jnp.clip(rid, 0, cap - 1)
         pos = jnp.arange(ecap, dtype=jnp.int64) - offsets[safe_rid]

@@ -504,11 +504,13 @@ def _convert_join(node: P.Join, children, conf):
     else:
         left = TpuCoalesceExec(children[0], target_bytes=target)
         right = wrap_build(children[1])
+    from spark_rapids_tpu.conf import JOIN_MAX_SUBPARTITIONS
     join = TpuJoinExec(left, right, node.join_type, lkeys, rkeys,
                        node.condition,
                        node.children[0].output_schema(),
                        node.children[1].output_schema(),
-                       subpartition_bytes=conf.get_entry(JOIN_SUBPARTITION_BYTES))
+                       subpartition_bytes=conf.get_entry(JOIN_SUBPARTITION_BYTES),
+                       max_subpartitions=conf.get_entry(JOIN_MAX_SUBPARTITIONS))
     from spark_rapids_tpu.conf import DPP_ENABLED
     if broadcast and conf.get_entry(DPP_ENABLED) and not swapped:
         # only inner/leftsemi qualify (checked inside), so the probe is
@@ -604,6 +606,14 @@ def register_file_scan(cls):
               f"Enable {cls.format_name} scans on the accelerator.")
 
 
+from spark_rapids_tpu.overrides.docs import register_exec_sig
+
+for _cls in (P.LocalScan, P.Project, P.CachedRelation, P.Generate):
+    register_exec_sig(_cls, COMMON_PLUS_NESTED)
+for _cls in (P.Aggregate, P.Sort, P.TakeOrderedAndProject, P.Limit,
+             P.CollectLimit, P.Union, P.Expand, P.Sample, P.Exchange):
+    register_exec_sig(_cls, COMMON_PLUS_ARRAYS)
+
 exec_rule(P.LocalScan, _tag_scan, _convert_scan)
 exec_rule(P.RangeNode, _tag_simple, _convert_range)
 exec_rule(P.Project, _tag_project, _convert_project)
@@ -620,7 +630,10 @@ def _tag_window(meta, conf):
     from spark_rapids_tpu.conf import IMPROVED_FLOAT_OPS
     vfa = bool(conf.get_entry(IMPROVED_FLOAT_OPS))
     for name, w in node.window_cols:
-        ok, reason = device_window_supported(w, variable_float_agg=vfa)
+        from spark_rapids_tpu.conf import WINDOW_ROWS_FRAME_MAX_BOUND
+        ok, reason = device_window_supported(
+            w, variable_float_agg=vfa,
+            rows_frame_max_bound=conf.get_entry(WINDOW_ROWS_FRAME_MAX_BOUND))
         if not ok:
             meta.reasons.append(f"window {name}: {reason}")
             continue
@@ -667,6 +680,23 @@ exec_rule(P.TakeOrderedAndProject, _tag_take_ordered, _convert_take_ordered)
 exec_rule(P.CollectLimit, _tag_simple,
           lambda node, children, conf: TpuLimitExec(children[0], node.limit))
 exec_rule(P.CachedRelation, _tag_scan, _convert_cached)
+def _tag_window_group_limit(meta, conf):
+    _check_output_schema(meta, conf)
+    node: P.WindowGroupLimit = meta.node
+    for e in node.partition_exprs:
+        check_expr(e, conf, meta.reasons, "group-limit partition key ")
+    for o in node.orders:
+        check_expr(o.expr, conf, meta.reasons, "group-limit order key ")
+
+
+def _convert_window_group_limit(node: P.WindowGroupLimit, children, conf):
+    from spark_rapids_tpu.execs.window import TpuWindowGroupLimitExec
+    return TpuWindowGroupLimitExec(children[0], node.partition_exprs,
+                                   node.orders, node.rank_kind, node.limit)
+
+
+exec_rule(P.WindowGroupLimit, _tag_window_group_limit,
+          _convert_window_group_limit)
 exec_rule(P.WindowNode, _tag_window, _convert_window)
 exec_rule(P.Exchange, _tag_exchange, _convert_exchange)
 
@@ -805,6 +835,82 @@ def convert_plan(meta: PlanMeta):
     return out
 
 
+def _insert_window_group_limits(node: P.PlanNode) -> P.PlanNode:
+    """WindowGroupLimit rewrite (reference: GpuWindowGroupLimitExec /
+    Spark 3.5 InsertWindowGroupLimit): Filter(rank_col <= k) directly
+    above a WindowNode whose rank_col is row_number/rank/dense_rank
+    admits a pre-window group limit — at most k(+ties) rows per
+    partition need to enter the window. Builds a NEW tree (plan nodes
+    are shared across collects; never mutate)."""
+    import copy as _copy
+
+    from spark_rapids_tpu.ops.expr import BoundReference, Literal
+    from spark_rapids_tpu.ops.predicates import (
+        EqualTo,
+        LessThan,
+        LessThanOrEqual,
+    )
+    from spark_rapids_tpu.ops.window import DenseRank, Rank, RowNumber
+
+    new_children = [_insert_window_group_limits(c) for c in node.children]
+    if any(a is not b for a, b in zip(new_children, node.children)):
+        node = _copy.copy(node)
+        node.children = tuple(new_children)
+
+    if not isinstance(node, P.Filter) or not isinstance(
+            node.children[0], P.WindowNode):
+        return node
+    cond = node.condition
+    if not isinstance(cond, (LessThan, LessThanOrEqual, EqualTo)):
+        return node
+    lhs, rhs = cond.children
+    if not (isinstance(lhs, BoundReference) and isinstance(rhs, Literal)):
+        return node
+    win: P.WindowNode = node.children[0]
+    n_child = len(win.children[0].output_schema())
+    wi = lhs.ordinal - n_child
+    if wi < 0 or wi >= len(win.window_cols):
+        return node
+    w = win.window_cols[wi][1]
+    fn = w.function
+    kinds = {RowNumber: "rownumber", Rank: "rank", DenseRank: "denserank"}
+    kind = kinds.get(type(fn))
+    if kind is None or not w.spec.orders:
+        return node
+    # EVERY window column in the node must be safe under pruning: a
+    # sibling computed over a different spec (or a non-ranking function)
+    # would see only the surviving rows and produce wrong values
+    # (Spark's InferWindowGroupLimit applies the same gate)
+    spec_key = (tuple(e.key() for e in w.spec.partition_exprs),
+                tuple((o.expr.key(), o.ascending,
+                       o.resolved_nulls_first()) for o in w.spec.orders))
+    for _, other in win.window_cols:
+        if type(other.function) not in kinds:
+            return node
+        ok = (tuple(e.key() for e in other.spec.partition_exprs),
+              tuple((o.expr.key(), o.ascending, o.resolved_nulls_first())
+                    for o in other.spec.orders))
+        if ok != spec_key:
+            return node
+    try:
+        k = int(rhs.value)
+    except (TypeError, ValueError):
+        return node
+    if isinstance(cond, LessThan):
+        k -= 1
+    elif isinstance(cond, EqualTo):
+        pass  # rank == k admits keeping rank <= k
+    if k < 1:
+        return node
+    wgl = P.WindowGroupLimit(win.children[0], w.spec.partition_exprs,
+                             w.spec.orders, kind, k)
+    new_win = _copy.copy(win)
+    new_win.children = (wgl,)
+    new_filter = _copy.copy(node)
+    new_filter.children = (new_win,)
+    return new_filter
+
+
 def apply_overrides(plan: P.PlanNode, conf: RapidsConf):
     """GpuOverrides.apply analog: tag + CBO + convert (or explain-only)."""
     if not conf.sql_enabled:
@@ -813,6 +919,7 @@ def apply_overrides(plan: P.PlanNode, conf: RapidsConf):
     if conf.get_entry(COLUMN_PRUNING):
         from spark_rapids_tpu.overrides.pruning import prune_plan
         plan = prune_plan(plan)
+    plan = _insert_window_group_limits(plan)
     meta = wrap_plan(plan, conf)
     from spark_rapids_tpu.overrides.optimizer import apply_cbo
     apply_cbo(meta, conf)

@@ -567,6 +567,32 @@ class TakeOrderedAndProject(PlanNode):
         return f"TakeOrderedAndProject[limit={self.limit}]"
 
 
+class WindowGroupLimit(PlanNode):
+    """Pre-window group-limit (reference: GpuWindowGroupLimitExec, Spark
+    3.5's WindowGroupLimit): when a rank()/row_number()/dense_rank()
+    column is filtered to <= k right above the window, at most k(+ties)
+    rows per partition need to ENTER the window at all. This node is a
+    pure optimization — the exact filter stays above — so the CPU path
+    is a passthrough and the device exec prunes."""
+
+    def __init__(self, child: PlanNode, partition_exprs, orders,
+                 rank_kind: str, limit: int):
+        self.children = (child,)
+        self.partition_exprs = list(partition_exprs)
+        self.orders = list(orders)
+        self.rank_kind = rank_kind  # rownumber | rank | denserank
+        self.limit = int(limit)
+
+    def output_schema(self):
+        return self.children[0].output_schema()
+
+    def execute_cpu(self):
+        yield from self.children[0].execute_cpu()
+
+    def describe(self):
+        return f"WindowGroupLimit[{self.rank_kind} <= {self.limit}]"
+
+
 class CollectLimit(PlanNode):
     """LIMIT without ordering (reference: GpuCollectLimitExec)."""
 

@@ -192,6 +192,7 @@ class TpuSession:
         from spark_rapids_tpu.execs.join import DIRECT_TABLE_MULT
         from spark_rapids_tpu.runtime import speculation as spec
 
+        self._apply_tuning_confs()
         from spark_rapids_tpu.conf import ANSI_ENABLED
         from spark_rapids_tpu.dispatch import ANSI_MODE
         tok_m = MASKED_ENABLED.set(bool(self.conf.get_entry(MASKED_BATCHES)))
@@ -224,6 +225,20 @@ class TpuSession:
             MASKED_ENABLED.reset(tok_m)
             DIRECT_TABLE_MULT.reset(tok_d)
             ANSI_MODE.reset(tok_a)
+
+    def _apply_tuning_confs(self) -> None:
+        """Push registry-tunable constants into the modules that consume
+        them (RapidsConf -> class attrs; execs/expressions hold no conf
+        handle — same pattern as the retry/masked contextvars)."""
+        from spark_rapids_tpu import conf as C
+        from spark_rapids_tpu.columnar.table import DeviceTable
+        from spark_rapids_tpu.execs import broadcast as B
+        from spark_rapids_tpu.ops.collections import Sequence
+        get = self.conf.get_entry
+        Sequence.SEQ_ELEMENT_MULT = int(get(C.SEQUENCE_ELEMENT_MULT))
+        DeviceTable.EMBED_NROWS_CAP = int(get(C.COLLECT_EMBED_ROWS_CAP))
+        DeviceTable.EMBED_MAX_BYTES = int(get(C.COLLECT_EMBED_MAX_BYTES))
+        B.PAIR_BUDGET = int(get(C.NLJ_PAIR_BUDGET))
 
     def execute_cpu_only(self, plan: P.PlanNode) -> HostTable:
         """Run fully on the CPU path (the oracle)."""

@@ -91,9 +91,11 @@ class P2PShuffleEnv:
         self._conn_lock = threading.Lock()
         self._shuffle_id_lock = threading.Lock()
         self._next_shuffle = 0
+        from spark_rapids_tpu.conf import HEARTBEAT_INTERVAL_S
         self.driver = driver or ShuffleHeartbeatManager()
         self.heartbeat = ShuffleHeartbeatEndpoint(
-            self.driver, self.me, self._on_new_peer)
+            self.driver, self.me, self._on_new_peer,
+            interval_s=float(conf.get_entry(HEARTBEAT_INTERVAL_S)))
         self.heartbeat.start()
 
     def _on_new_peer(self, peer: PeerInfo):

@@ -1,0 +1,125 @@
+"""WindowGroupLimit (reference: GpuWindowGroupLimitExec / Spark 3.5
+InsertWindowGroupLimit) + supported-ops doc generation + conf-tuned
+constants."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import functions as F
+from spark_rapids_tpu.functions import dense_rank, rank, row_number
+from spark_rapids_tpu.ops.expr import col, lit
+from spark_rapids_tpu.ops.window import Window as W
+from spark_rapids_tpu.session import TpuSession
+
+
+@pytest.fixture(scope="module")
+def tpu():
+    return TpuSession()
+
+
+@pytest.fixture(scope="module")
+def cpu():
+    return TpuSession({"spark.rapids.sql.enabled": "false"})
+
+
+def _data(n=4000, seed=0):
+    rng = np.random.default_rng(seed)
+    return {"k": rng.integers(0, 40, n).astype(np.int64),
+            "v": rng.random(n)}
+
+
+@pytest.mark.parametrize("fn_maker,kind", [
+    (row_number, "rownumber"), (rank, "rank"), (dense_rank, "denserank")])
+def test_group_limit_inserted_and_exact(tpu, cpu, fn_maker, kind):
+    data = _data()
+    q = lambda s: sorted(
+        s.create_dataframe(data)
+        .with_windows(r=fn_maker().over(
+            W.partition_by("k").order_by("v")))
+        .filter(col("r") <= lit(3)).collect(), key=repr)
+    a, b = q(tpu), q(cpu)
+    assert len(a) == len(b)
+    assert all(repr(x) == repr(y) for x, y in zip(a, b))
+    assert "TpuWindowGroupLimit" in tpu.last_metrics()
+
+
+def test_group_limit_less_than_and_equal(tpu, cpu):
+    data = _data(seed=1)
+    for cond in (lambda c: c < lit(4), lambda c: c == lit(1)):
+        q = lambda s: sorted(
+            s.create_dataframe(data)
+            .with_windows(r=row_number().over(
+                W.partition_by("k").order_by("v")))
+            .filter(cond(col("r"))).collect(), key=repr)
+        assert q(tpu) == q(cpu)
+
+
+def test_group_limit_not_inserted_for_aggregate_window(tpu):
+    """A non-ranking window filter must not trigger the rewrite."""
+    data = _data(seed=2)
+    df = (tpu.create_dataframe(data)
+          .with_windows(s=F.sum(col("v")).over(
+              W.partition_by("k").order_by("v")))
+          .filter(col("s") <= lit(1.0)))
+    _ = df.collect()
+    assert "TpuWindowGroupLimit" not in tpu.last_metrics()
+
+
+def test_group_limit_plan_not_mutated_across_runs(tpu):
+    """The rewrite builds a new tree: re-collecting the same DataFrame
+    must not stack group-limit layers."""
+    data = _data(seed=3)
+    df = (tpu.create_dataframe(data)
+          .with_windows(r=row_number().over(
+              W.partition_by("k").order_by("v")))
+          .filter(col("r") <= lit(2)))
+    a = sorted(df.collect(), key=repr)
+    b = sorted(df.collect(), key=repr)
+    assert a == b
+    assert tpu.last_metrics().count("TpuWindowGroupLimit") == 1
+
+
+# -- generated supported-ops doc + conf-driven tuning ------------------------
+
+def test_supported_ops_doc_generates():
+    from spark_rapids_tpu.overrides.docs import generate_supported_ops
+    doc = generate_supported_ops()
+    assert "## Execs" in doc and "## Expressions" in doc
+    rows = [l for l in doc.splitlines() if l.startswith("| ")]
+    assert len(rows) > 150  # exec + expression matrix breadth
+    assert any(l.startswith("| Join ") for l in rows)
+    assert any(l.startswith("| Cast ") for l in rows)
+    # nested columns: scans support MAP/STRUCT, plain execs do not
+    scan_row = next(l for l in rows if l.startswith("| LocalScan "))
+    assert scan_row.count(" S ") >= 12
+    filt_row = next(l for l in rows if l.startswith("| Filter "))
+    assert " NS " in filt_row  # nested columns tag fallback at filters
+
+
+def test_sequence_multiplier_conf_applies():
+    from spark_rapids_tpu.errors import AnsiViolation
+    s = TpuSession({"spark.rapids.tpu.sequence.elementMultiplier": "1"})
+    data = {"a": np.full(100, 1, dtype=np.int64),
+            "b": np.full(100, 50, dtype=np.int64)}
+    with pytest.raises(AnsiViolation):
+        s.create_dataframe(data).select(
+            F.sequence(col("a"), col("b")).alias("s")).collect()
+    big = TpuSession({"spark.rapids.tpu.sequence.elementMultiplier": "64"})
+    got = big.create_dataframe(data).select(
+        F.sequence(col("a"), col("b")).alias("s")).collect()
+    assert len(got) == 100 and got[0][0] == list(range(1, 51))
+
+
+def test_group_limit_not_inserted_with_unsafe_sibling(tpu, cpu):
+    """A sibling window column over a different spec blocks the rewrite
+    (review finding: pruning would corrupt the sibling's values)."""
+    data = _data(seed=4)
+    q = lambda s: sorted(
+        s.create_dataframe(data)
+        .with_windows(
+            r=row_number().over(W.partition_by("k").order_by("v")),
+            t=F.count(col("v")).over(W.partition_by("k")))
+        .filter(col("r") <= lit(2)).collect(), key=repr)
+    a, b = q(tpu), q(cpu)
+    assert a == b
+    assert "TpuWindowGroupLimit" not in tpu.last_metrics()
