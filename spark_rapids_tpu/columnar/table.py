@@ -247,12 +247,23 @@ def concat_device(tables: Sequence["DeviceTable"]) -> "DeviceTable":
 
     # unify string dictionaries; build per-(table, col) remap aux arrays
     out_dicts: List[Optional[np.ndarray]] = []
+    out_sorted: dict = {}  # ci -> dict_sorted of a reused shared dict
     remaps: List[List[Optional[np.ndarray]]] = [[None] * ncols
                                                 for _ in tables]
     for ci in range(ncols):
         col0 = tables[0].columns[ci]
         if not isinstance(col0.dtype, T.StringType):
             out_dicts.append(None)
+            continue
+        if all(t.columns[ci].dictionary is col0.dictionary
+               for t in tables):
+            # identical dictionary OBJECT on every input (masked splits of
+            # one table, re-coalesced scan batches): codes already agree —
+            # skip the O(dict log dict) union entirely (a 1M-entry object
+            # dict costs ~seconds to re-sort). The shared dictionary may
+            # be UNSORTED (concat_ws outputs); record its real flag
+            out_sorted[ci] = col0.dict_sorted
+            out_dicts.append(col0.dictionary)
             continue
         dicts = [(t.columns[ci].dictionary if t.columns[ci].dictionary
                   is not None else np.array([], dtype=object))
@@ -320,8 +331,9 @@ def concat_device(tables: Sequence["DeviceTable"]) -> "DeviceTable":
     outs, total = fn(cols_per_table, remap_per_table, nrows_list, lives)
     out_cols = [
         DeviceColumn(c.dtype, d, v, dictionary=out_dicts[ci],
-                     dict_sorted=True if out_dicts[ci] is not None
-                     else c.dict_sorted)
+                     dict_sorted=out_sorted.get(
+                         ci, True if out_dicts[ci] is not None
+                         else c.dict_sorted))
         for ci, (c, (d, v)) in enumerate(zip(tables[0].columns, outs))]
     return DeviceTable(names, out_cols, total, out_cap)
 

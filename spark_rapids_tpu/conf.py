@@ -226,6 +226,14 @@ JOIN_DIRECT_TABLE_MULT = int_conf(
     "of the build side's capacity; build key ranges wider than that fall "
     "back to the sort-based join (speculatively validated).")
 
+SHUFFLE_LOCAL_DEVICE_SPLIT = bool_conf(
+    "spark.rapids.shuffle.localDeviceSplit.enabled", True,
+    "Single-process repartitions split ON DEVICE into per-partition "
+    "masked batches (zero host round trips, zero compaction scatters) "
+    "instead of serializing through the shuffle manager. Applies only to "
+    "MULTITHREADED mode; ICI and P2P always run their real transports. "
+    "Disable to force the file-backed shuffle (manager testing).")
+
 SHUFFLE_MANAGER_MODE = str_conf(
     "spark.rapids.shuffle.mode", "MULTITHREADED",
     "MULTITHREADED (threaded host serialization over local shuffle files), "

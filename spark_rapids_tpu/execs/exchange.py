@@ -82,11 +82,100 @@ class TpuShuffleExchangeExec(TpuExec):
                 and 1 < self.num_partitions <= len(jax.devices())
                 and (self.num_partitions & (self.num_partitions - 1)) == 0)
 
+    #: masked batches share the input buffers, but every downstream
+    #: kernel still runs at full input capacity PER partition — beyond
+    #: this many partitions the host shuffle's compacted batches win
+    LOCAL_SPLIT_MAX_PARTITIONS = 32
+
+    def _local_split_eligible(self) -> bool:
+        from spark_rapids_tpu.conf import (
+            SHUFFLE_LOCAL_DEVICE_SPLIT,
+            SHUFFLE_MANAGER_MODE,
+        )
+        from spark_rapids_tpu.execs.base import MASKED_ENABLED
+        mode = str(self.conf.get_entry(SHUFFLE_MANAGER_MODE)).upper()
+        return (mode == "MULTITHREADED"
+                and bool(self.conf.get_entry(SHUFFLE_LOCAL_DEVICE_SPLIT))
+                and MASKED_ENABLED.get()  # masked-batch kill switch
+                and self.mode in ("hash", "roundrobin", "single")
+                and self.num_partitions <= self.LOCAL_SPLIT_MAX_PARTITIONS
+                # AQE partition coalescing needs the manager's measured
+                # map-output sizes; the device split has no stats
+                and not self._aqe_coalesce_enabled())
+
+    produces_masked = True
+
     def execute(self):
+        # base-contract note: execute() must yield PREFIX batches; the
+        # masked local split therefore lives in execute_masked() and
+        # mask-unaware callers get compacted tables via the base wrapper
         if self._ici_eligible():
             yield from self._execute_ici()
             return
+        if self._local_split_eligible():
+            for b in self._execute_local_device_split():
+                yield b.compacted()
+            return
         yield from self._execute_host_shuffle()
+
+    def execute_masked(self):
+        if self._ici_eligible():
+            yield from self._execute_ici()
+            return
+        if self._local_split_eligible():
+            yield from self._execute_local_device_split()
+            return
+        yield from self._execute_host_shuffle()
+
+    def _execute_local_device_split(self):
+        """Single-process repartition entirely ON DEVICE: one partition-id
+        kernel over the coalesced input, then one MASKED batch per
+        partition sharing the input buffers — liveness masks instead of
+        per-partition compaction scatters (columnar/table.py
+        DeviceTable.live). The reference always round-trips the shuffle
+        manager because its executors are separate processes; a
+        single-chip engine has no wire to cross."""
+        import jax.numpy as jnp
+
+        from spark_rapids_tpu.columnar.table import concat_device
+        from spark_rapids_tpu.dispatch import tpu_jit
+        from spark_rapids_tpu.ops.expr import shared_traces
+        from spark_rapids_tpu.runtime.retry import retry_block
+
+        t0 = perf_counter()
+        batches = list(self.children[0].execute_masked())
+        if not batches:
+            return
+        table = retry_block(lambda: concat_device(batches)) \
+            if len(batches) > 1 else batches[0]
+        parter = make_partitioner(self.mode, self.keys, self.num_partitions)
+        nparts = self.num_partitions
+        pids = parter.partition_ids(table)
+        traces = shared_traces(("localsplit", nparts))
+        tkey = (table.capacity, table.live is not None)
+        fn = traces.get(tkey)
+        if fn is None:
+            cap = table.capacity
+
+            def masks(pids, nrows, live_in):
+                if live_in is not None:
+                    live = live_in
+                else:
+                    live = jnp.arange(cap, dtype=jnp.int32) < nrows
+                outs = []
+                for p in range(nparts):
+                    m = live & (pids == p)
+                    outs.append((m, jnp.sum(m.astype(jnp.int32))))
+                return outs
+
+            fn = tpu_jit(masks)
+            traces[tkey] = fn
+        outs = fn(pids, table.nrows_dev, table.live)
+        self.add_metric("localSplitParts", nparts)
+        self.add_metric("localSplitTime", perf_counter() - t0)
+        for mask, cnt in outs:
+            yield DeviceTable(table.names, table.columns, cnt,
+                              table.capacity, live=mask)
 
     def _execute_ici(self):
         """ONE all-to-all collective over a device mesh instead of the

@@ -410,3 +410,29 @@ def test_shuffle_manager_lz4(session):
                for t in mgr.reader(h).read_partition(p))
     assert rows == 500
     mgr.remove_shuffle(h)
+
+
+def test_local_device_split_repartition(session, cpu_session):
+    """Single-process repartition takes the on-device masked split
+    (round-4: no shuffle-manager round trip) with exact results."""
+    from spark_rapids_tpu.functions import count
+    from tests.data_gen import IntGen, gen_table
+    from spark_rapids_tpu.plan import from_host_table
+    t = gen_table({"k": IntGen(min_val=0, max_val=9), "v": IntGen()}, 500, 3)
+    q = lambda s: sorted(
+        from_host_table(t, s).repartition(4, "k").group_by("k")
+        .agg(count("v").alias("c")).collect(), key=repr)
+    got, want = q(session), q(cpu_session)
+    assert got == want
+    assert "localSplitParts" in session.last_metrics()
+
+
+def test_local_device_split_disabled_by_conf():
+    from tests.data_gen import IntGen, gen_table
+    from spark_rapids_tpu.plan import from_host_table
+    from spark_rapids_tpu.session import TpuSession
+    s = TpuSession({"spark.rapids.shuffle.localDeviceSplit.enabled": "false"})
+    t = gen_table({"k": IntGen(min_val=0, max_val=9)}, 200, 2)
+    _ = from_host_table(t, s).repartition(4, "k").collect()
+    m = s.last_metrics()
+    assert "localSplitParts" not in m and "shuffle" in m.lower()
