@@ -61,6 +61,7 @@ def main():
     t0 = time.perf_counter()
     q3_res = q3_dataframe(session, cust, orders, li).collect_table()
     q3_tpu_s = time.perf_counter() - t0
+    q3_dispatches = getattr(session, "last_dispatches", None)
     _ = q3_pandas(cust, orders, li)
     t0 = time.perf_counter()
     q3_ref = q3_pandas(cust, orders, li)
@@ -82,7 +83,8 @@ def main():
                    "tpu_cold_s": round(cold_s, 4), "cpu_s": round(cpu_s, 4),
                    "q3_join_speedup": round(q3_cpu_s / max(q3_tpu_s, 1e-9), 3),
                    "q3_tpu_s": round(q3_tpu_s, 4),
-                   "q3_cpu_s": round(q3_cpu_s, 4)},
+                   "q3_cpu_s": round(q3_cpu_s, 4),
+                   "q3_dispatches": q3_dispatches},
     }))
 
 
