@@ -77,6 +77,24 @@ _PACK_CACHE: Dict[tuple, object] = {}
 _CACHED_TABLES = None  # lazy weakref.WeakSet
 
 
+#: tables that are capacity-sharing VIEWS of one source (a local shuffle
+#: split's per-partition masks): concatenating them only multiplies
+#: capacity, so coalesce streams them (weak: dropping the table drops it)
+_SHARED_VIEWS = None
+
+
+def mark_shared_view(table: "DeviceTable") -> None:
+    global _SHARED_VIEWS
+    if _SHARED_VIEWS is None:
+        import weakref
+        _SHARED_VIEWS = weakref.WeakSet()
+    _SHARED_VIEWS.add(table)
+
+
+def is_shared_view(table: "DeviceTable") -> bool:
+    return _SHARED_VIEWS is not None and table in _SHARED_VIEWS
+
+
 def register_device_cache(host: "HostTable") -> None:
     global _CACHED_TABLES
     if _CACHED_TABLES is None:
@@ -447,7 +465,7 @@ class DeviceTable:
     GpuFilterExec compacts eagerly (basicPhysicalOperators.scala)."""
 
     __slots__ = ("names", "columns", "nrows_dev", "_nrows_host", "capacity",
-                 "live")
+                 "live", "__weakref__")
 
     def __init__(self, names: Sequence[str], columns: Sequence[DeviceColumn],
                  nrows, capacity: Optional[int] = None, live=None):

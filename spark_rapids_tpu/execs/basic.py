@@ -343,14 +343,17 @@ class TpuExpandExec(TpuExec):
 
 
 class TpuCoalesceExec(TpuExec):
-    """Concatenate child batches up to a target size — or into ONE batch when
-    ``require_single`` (reference: GpuCoalesceBatches with
+    """Concatenate child batches up to a target size — or into ONE batch
+    when ``require_single`` (reference: GpuCoalesceBatches with
     TargetSize/RequireSingleBatch goals).
 
-    v1 concatenates via host round-trip when more than one batch arrives
-    (string dictionaries must be re-merged anyway); single-batch passthrough
-    stays on device. Device-side concat for non-string columns is a planned
-    fast path."""
+    Multi-batch flushes concat ON DEVICE (columnar/table.concat_device:
+    no host round trip; string dictionaries union with O(dict) host
+    work; masked inputs fuse their deferred compaction into the concat
+    scatter). Two passthroughs: a lone buffered batch, and — under
+    TargetSize only — capacity-sharing masked VIEWS from a local shuffle
+    split (columnar/table.is_shared_view), which stream un-coalesced
+    because concatenating views of one table only multiplies capacity."""
 
     def __init__(self, child: TpuExec, target_bytes: int = 1 << 30,
                  require_single: bool = False):
@@ -372,6 +375,19 @@ class TpuCoalesceExec(TpuExec):
         pending_bytes = 0
         try:
             for batch in self.children[0].execute_masked():
+                from spark_rapids_tpu.columnar.table import is_shared_view
+                if is_shared_view(batch) and not self.require_single:
+                    # capacity-sharing views (a local split's per-partition
+                    # masks over ONE table): concatenation would only
+                    # multiply capacity and pay the very scatters masking
+                    # defers — stream them. Ordinary masked batches
+                    # (independent filter outputs) still coalesce.
+                    if pending:
+                        yield self._flush(pending)
+                        pending, pending_bytes = [], 0
+                    self.add_metric("maskedPassthrough", 1)
+                    yield batch
+                    continue
                 pending_bytes += batch.device_nbytes()
                 # buffered batches are spillable while more input streams in
                 # (reference: coalesce inputs are SpillableColumnarBatches)

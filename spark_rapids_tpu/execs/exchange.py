@@ -173,9 +173,12 @@ class TpuShuffleExchangeExec(TpuExec):
         outs = fn(pids, table.nrows_dev, table.live)
         self.add_metric("localSplitParts", nparts)
         self.add_metric("localSplitTime", perf_counter() - t0)
+        from spark_rapids_tpu.columnar.table import mark_shared_view
         for mask, cnt in outs:
-            yield DeviceTable(table.names, table.columns, cnt,
+            out = DeviceTable(table.names, table.columns, cnt,
                               table.capacity, live=mask)
+            mark_shared_view(out)  # coalesce streams capacity-sharing views
+            yield out
 
     def _execute_ici(self):
         """ONE all-to-all collective over a device mesh instead of the
