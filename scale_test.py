@@ -115,7 +115,13 @@ def build_queries(s, tables):
             "q6": q6, "q7": q7, "q8": q8, "q9": q9, "q10": q10}
 
 
-def time_query(fn, runs=2):
+def time_query(fn, runs=3):
+    """Cold run + `runs` warm trials; returns (cold, min, median).
+
+    >=3 warm trials with a median bound so tunnel-latency variance is
+    distinguishable from real regressions (the reference ScaleTest
+    harness reports per-iteration times for the same reason —
+    ref: integration_tests/ScaleTest.md)."""
     t0 = time.perf_counter()
     fn().collect_table()
     cold = time.perf_counter() - t0
@@ -124,7 +130,8 @@ def time_query(fn, runs=2):
         t0 = time.perf_counter()
         fn().collect_table()
         warms.append(time.perf_counter() - t0)
-    return cold, min(warms)
+    warms.sort()
+    return cold, warms[0], warms[len(warms) // 2]
 
 
 def main():
@@ -159,20 +166,31 @@ def main():
               "rows": {k: t.num_rows for k, t in tables.items()},
               "queries": {}}
     for name in wanted:
-        cold, warm = time_query(queries[name])
-        entry = {"cold_s": round(cold, 4), "warm_s": round(warm, 4)}
+        cold, warm, warm_med = time_query(queries[name])
+        entry = {"cold_s": round(cold, 4), "warm_s": round(warm, 4),
+                 "warm_med_s": round(warm_med, 4)}
         if cpu_queries is not None:
-            _, cpu_warm = time_query(cpu_queries[name], runs=1)
+            _, cpu_warm, cpu_med = time_query(cpu_queries[name], runs=3)
             entry["cpu_warm_s"] = round(cpu_warm, 4)
+            entry["cpu_warm_med_s"] = round(cpu_med, 4)
             entry["speedup"] = round(cpu_warm / warm, 3) if warm else None
+            entry["speedup_med"] = (round(cpu_med / warm_med, 3)
+                                    if warm_med else None)
         report["queries"][name] = entry
         print(json.dumps({"query": name, **entry}))
+    import math
+
+    def _geomean(vals):
+        return round(math.exp(sum(math.log(x) for x in vals) / len(vals)), 3)
+
     speedups = [e["speedup"] for e in report["queries"].values()
                 if e.get("speedup")]
     if speedups:
-        import math
-        report["geomean_speedup"] = round(
-            math.exp(sum(math.log(x) for x in speedups) / len(speedups)), 3)
+        report["geomean_speedup"] = _geomean(speedups)
+    med_speedups = [e["speedup_med"] for e in report["queries"].values()
+                    if e.get("speedup_med")]
+    if med_speedups:
+        report["geomean_speedup_med"] = _geomean(med_speedups)
     report["warm_total_s"] = round(
         sum(e["warm_s"] for e in report["queries"].values()), 4)
     report["cold_total_s"] = round(

@@ -25,9 +25,15 @@ from spark_rapids_tpu.execs.base import TpuExec
 from spark_rapids_tpu.plan.pandas_udf import (
     AggregateInPandas,
     ArrowEvalPython,
+    FlatMapCoGroupsInPandas,
     FlatMapGroupsInPandas,
+    MapInArrow,
     MapInPandas,
+    WindowInPandas,
     _pandas_to_host,
+    align_cogroups,
+    arrow_batch_to_host,
+    eval_window_udf,
 )
 
 CONCURRENT_PYTHON_WORKERS = int_conf(
@@ -109,6 +115,16 @@ class _PythonExecBase(TpuExec):
         self.add_metric("h2dArrowTime", time.perf_counter() - t0)
         return dt
 
+    def _download_all(self, child, schema):
+        """Drain a child exec into ONE pandas frame (empty frame keeps
+        the schema's column names)."""
+        import pandas as pd
+        batches = [self._download(b) for b in child.execute()]
+        if not batches:
+            return pd.DataFrame(columns=[n for n, _ in schema])
+        return pd.concat(batches, ignore_index=True) if len(batches) > 1 \
+            else batches[0]
+
     def describe(self):
         return f"Tpu{type(self.node).__name__}Exec"
 
@@ -138,12 +154,8 @@ class TpuMapInPandasExec(_PythonExecBase):
 class TpuFlatMapGroupsInPandasExec(_PythonExecBase):
     def execute(self) -> Iterator[DeviceTable]:
         node: FlatMapGroupsInPandas = self.node
-        batches = [self._download(b) for b in self.children[0].execute()]
-        if not batches:
-            return
-        import pandas as pd
-        pdf = pd.concat(batches, ignore_index=True) if len(batches) > 1 \
-            else batches[0]
+        pdf = self._download_all(self.children[0],
+                                 node.children[0].output_schema())
         if len(pdf) == 0:
             return
         for _key, group in pdf.groupby(node.keys, dropna=False, sort=True):
@@ -156,14 +168,9 @@ class TpuAggregateInPandasExec(_PythonExecBase):
     def execute(self) -> Iterator[DeviceTable]:
         node: AggregateInPandas = self.node
         import pandas as pd
-        batches = [self._download(b) for b in self.children[0].execute()]
         schema = node.output_schema()
-        if not batches:
-            yield self._upload(_pandas_to_host(
-                pd.DataFrame(columns=[n for n, _ in schema]), schema))
-            return
-        pdf = pd.concat(batches, ignore_index=True) if len(batches) > 1 \
-            else batches[0]
+        pdf = self._download_all(self.children[0],
+                                 node.children[0].output_schema())
         rows = []
         if len(pdf):
             for key, group in pdf.groupby(node.keys, dropna=False,
@@ -177,6 +184,72 @@ class TpuAggregateInPandasExec(_PythonExecBase):
                 rows.append(row)
         out = pd.DataFrame(rows, columns=[n for n, _ in schema])
         yield self._upload(_pandas_to_host(out, schema))
+
+
+class TpuMapInArrowExec(_PythonExecBase):
+    """Device batch → host Arrow RecordBatches → user fn → Arrow →
+    device (GpuMapInArrowExec analog; the Arrow boundary is the real
+    contract, no pandas materialization)."""
+
+    def execute(self) -> Iterator[DeviceTable]:
+        from spark_rapids_tpu.io.arrow_convert import host_table_to_arrow
+        node: MapInArrow = self.node
+
+        def rbs():
+            for batch in self.children[0].execute():
+                t0 = time.perf_counter()
+                at = host_table_to_arrow(batch.to_host())
+                self.add_metric("d2hArrowTime", time.perf_counter() - t0)
+                for rb in at.to_batches():
+                    yield rb
+
+        sem = PythonWorkerSemaphore.acquire_if_necessary(self.permits)
+        t0 = time.perf_counter()
+        try:
+            for out in node.fn(rbs()):
+                host = arrow_batch_to_host(out, node.schema)
+                if host.num_rows:
+                    yield self._upload(host)
+        finally:
+            PythonWorkerSemaphore.release(sem)
+            self.add_metric("pythonUdfTime", time.perf_counter() - t0)
+
+
+class TpuFlatMapCoGroupsInPandasExec(_PythonExecBase):
+    """Two device children download once each; groups align by key with
+    empty-side frames (GpuFlatMapCoGroupsInPandasExec analog)."""
+
+    def __init__(self, children, node, conf):
+        super().__init__(children[0], node, conf)
+        self.children = tuple(children)
+
+    def execute(self) -> Iterator[DeviceTable]:
+        node: FlatMapCoGroupsInPandas = self.node
+        left = self._download_all(self.children[0],
+                                  node.children[0].output_schema())
+        right = self._download_all(self.children[1],
+                                   node.children[1].output_schema())
+        for lg, rg in align_cogroups(left, right, node.left_keys,
+                                     node.right_keys):
+            out = self._run_udf(node.fn, lg, rg)
+            if len(out):
+                yield self._upload(_pandas_to_host(out, node.schema))
+
+
+class TpuWindowInPandasExec(_PythonExecBase):
+    """Whole input downloads once (window UDFs need full partitions, the
+    same all-batches materialization the reference's exec performs),
+    UDF columns append, result re-uploads (GpuWindowInPandasExec)."""
+
+    def execute(self) -> Iterator[DeviceTable]:
+        node: WindowInPandas = self.node
+        pdf = self._download_all(self.children[0],
+                                 node.children[0].output_schema())
+        if len(pdf) == 0:
+            return
+        for name, fn, rt, args, spec in node.udfs:
+            pdf[name] = self._run_udf(eval_window_udf, pdf, fn, args, spec)
+        yield self._upload(_pandas_to_host(pdf, node.output_schema()))
 
 
 class TpuArrowEvalPythonExec(_PythonExecBase):

@@ -1,0 +1,122 @@
+"""Hive UDF support.
+
+Reference: ``org/apache/spark/sql/hive/rapids/hiveUDFs.scala:44,60``
+(GpuHiveSimpleUDF / GpuHiveGenericUDF) — the plugin wraps a Hive
+``UDF``/``GenericUDF`` object and either runs its RapidsUDF columnar
+path on device or evaluates the original row-wise function with
+columnar transport around it.
+
+TPU mapping: there is no JVM, so the "Hive function class" is a Python
+callable registered under a function name (the ``CREATE TEMPORARY
+FUNCTION name AS 'class'`` analog). A *simple* UDF is row-at-a-time —
+``fn(*scalar args) -> scalar`` (Hive UDF.evaluate contract); a *generic*
+UDF receives whole columns as pandas Series (the batch-level
+ObjectInspector analog) and returns an aligned Series. Both evaluate on
+HOST between device columnar batches via the ArrowEvalPython transport
+(device → Arrow → fn → Arrow → device), exactly the reference's
+fallback evaluation shape, and each carries its own expression
+kill-switch so disabling it reports a per-op fallback reason."""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Sequence, Tuple
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.conf import register_op_kill_switch
+from spark_rapids_tpu.errors import ColumnarProcessingError
+from spark_rapids_tpu.plan.pandas_udf import PandasUDFExpr, _normalize_schema
+
+for _cls, _doc in (("HiveSimpleUDF", "row-at-a-time Hive UDFs"),
+                   ("HiveGenericUDF", "batch-level Hive GenericUDFs")):
+    register_op_kill_switch(
+        "expression", _cls, True,
+        f"Enable {_doc} (host-evaluated with device columnar transport).")
+
+#: name -> (callable, return type, generic?)
+_HIVE_FUNCTIONS: Dict[str, Tuple[Callable, T.DataType, bool]] = {}
+
+
+def register_hive_udf(name: str, fn: Callable, return_type,
+                      generic: bool = False) -> None:
+    """CREATE TEMPORARY FUNCTION name AS 'class' analog: make ``fn``
+    callable from queries as ``hive_udf(name)(cols...)``."""
+    rt = (_normalize_schema(f"x {return_type}")[0][1]
+          if isinstance(return_type, str) else return_type)
+    _HIVE_FUNCTIONS[name.lower()] = (fn, rt, bool(generic))
+
+
+def unregister_hive_udf(name: str) -> None:
+    _HIVE_FUNCTIONS.pop(name.lower(), None)
+
+
+class HiveUDFExpr(PandasUDFExpr):
+    """Shared base — rides the scalar-UDF extraction + ArrowEvalPython
+    columnar transport; ``series_fn`` adapts the Hive contract to the
+    series-level boundary."""
+
+    hive_kind = "HiveUDF"
+
+    def __init__(self, func_name: str, fn: Callable, return_type,
+                 children: Sequence):
+        series_fn = self._wrap(fn)
+        # the transport consults this to apply the right kill switch /
+        # fallback reason (GpuOverrides checks the wrapped class the
+        # same way)
+        series_fn._hive_udf_class = self.hive_kind
+        super().__init__(series_fn, return_type, children, "scalar",
+                         udf_name=f"{self.hive_kind}#{func_name}")
+        self.func_name = func_name
+
+    def _wrap(self, fn: Callable) -> Callable:
+        raise NotImplementedError
+
+
+class HiveSimpleUDF(HiveUDFExpr):
+    """Row-at-a-time: fn(*scalars) -> scalar, nulls pass through as None
+    (Hive UDF.evaluate semantics)."""
+
+    hive_kind = "HiveSimpleUDF"
+
+    def _wrap(self, fn):
+        def series_fn(*cols):
+            import pandas as pd
+            vals = [fn(*[None if pd.isna(v) else v for v in row])
+                    for row in zip(*cols)]
+            return pd.Series(vals, index=cols[0].index if cols else None)
+        return series_fn
+
+
+class HiveGenericUDF(HiveUDFExpr):
+    """Batch-level: fn(*pandas Series) -> aligned Series (the
+    GenericUDF/ObjectInspector batch analog)."""
+
+    hive_kind = "HiveGenericUDF"
+
+    def _wrap(self, fn):
+        def series_fn(*cols):
+            import pandas as pd
+            out = fn(*cols)
+            return out if isinstance(out, pd.Series) else pd.Series(out)
+        return series_fn
+
+
+def hive_udf(name: str):
+    """Query-side lookup: F-style factory producing the UDF expression.
+
+    >>> register_hive_udf("my_upper", str.upper, "string")
+    >>> df.select(hive_udf("my_upper")(col("s")).alias("u"))
+    """
+    entry = _HIVE_FUNCTIONS.get(name.lower())
+    if entry is None:
+        raise ColumnarProcessingError(
+            f"hive function {name!r} is not registered "
+            f"(known: {sorted(_HIVE_FUNCTIONS)})")
+    fn, rt, generic = entry
+    cls = HiveGenericUDF if generic else HiveSimpleUDF
+
+    def call(*args):
+        from spark_rapids_tpu.ops.expr import col
+        exprs = [col(a) if isinstance(a, str) else a for a in args]
+        return cls(name, fn, rt, exprs)
+    call.__name__ = name
+    return call

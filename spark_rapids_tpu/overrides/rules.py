@@ -710,8 +710,16 @@ def _tag_python_udf(meta, conf):
     # project, or the whole node falls back
     udfs = getattr(meta.node, "udfs", None)
     if udfs:
-        for name, _fn, _rt, args in udfs:
+        for name, fn, _rt, args, *_spec in udfs:
+            # hive UDFs carry their wrapped class; its expression
+            # kill-switch reports a per-op fallback (hiveUDFs.scala rules)
+            hive_cls = getattr(fn, "_hive_udf_class", None)
+            if hive_cls and not conf.is_op_enabled("expression", hive_cls):
+                meta.reasons.append(
+                    f"expression {hive_cls} ({name}) is disabled by conf")
             for a in args:
+                if isinstance(a, str):  # WindowInPandas carries col names
+                    continue
                 check_expr(a, conf, meta.reasons, f"pandas UDF {name} arg ")
 
 
@@ -741,6 +749,21 @@ def _register_pandas_udf_rules():
     exec_rule(PU.ArrowEvalPython, _tag_python_udf,
               _convert_python_exec(TpuArrowEvalPythonExec),
               "Enable scalar pandas UDF eval on the accelerator.")
+    from spark_rapids_tpu.execs.python_exec import (
+        TpuFlatMapCoGroupsInPandasExec,
+        TpuMapInArrowExec,
+        TpuWindowInPandasExec,
+    )
+    exec_rule(PU.MapInArrow, _tag_python_udf,
+              _convert_python_exec(TpuMapInArrowExec),
+              "Enable MapInArrow on the accelerator.")
+    exec_rule(PU.FlatMapCoGroupsInPandas, _tag_python_udf,
+              lambda node, children, conf:
+                  TpuFlatMapCoGroupsInPandasExec(children, node, conf),
+              "Enable FlatMapCoGroupsInPandas on the accelerator.")
+    exec_rule(PU.WindowInPandas, _tag_python_udf,
+              _convert_python_exec(TpuWindowInPandasExec),
+              "Enable WindowInPandas on the accelerator.")
 
 
 _register_pandas_udf_rules()

@@ -94,6 +94,14 @@ class DataFrame:
 
     mapInPandas = map_in_pandas
 
+    def map_in_arrow(self, fn, schema) -> "DataFrame":
+        """fn(iterator of pyarrow RecordBatches) -> iterator of pyarrow
+        RecordBatches (Spark mapInArrow; GpuMapInArrowExec analog)."""
+        from spark_rapids_tpu.plan.pandas_udf import MapInArrow
+        return self._wrap(MapInArrow(self.plan, fn, schema))
+
+    mapInArrow = map_in_arrow
+
     def with_column(self, name: str, expr: Expression) -> "DataFrame":
         existing = [col(n) for n, _ in self.plan.output_schema() if n != name]
         return self.select(*existing, expr.alias(name))
@@ -196,8 +204,39 @@ class DataFrame:
 
     def with_windows(self, **named_exprs) -> "DataFrame":
         """Append window-function columns:
-        df.with_windows(rn=F.row_number().over(W.partition_by("k").order_by("v")))"""
-        return self._wrap(P.WindowNode(self.plan, list(named_exprs.items())))
+        df.with_windows(rn=F.row_number().over(W.partition_by("k").order_by("v")))
+
+        GROUPED_AGG pandas UDFs applied .over(spec) plan separately as a
+        WindowInPandas node (GpuWindowInPandasExec analog)."""
+        from spark_rapids_tpu.plan.pandas_udf import (
+            WindowedPandasUDF,
+            WindowInPandas,
+        )
+        builtin = [(n, e) for n, e in named_exprs.items()
+                   if not isinstance(e, WindowedPandasUDF)]
+        pandas_udfs = []
+        for n, e in named_exprs.items():
+            if isinstance(e, WindowedPandasUDF):
+                args = []
+                for a in e.udf.children:
+                    if not isinstance(a, AttributeReference):
+                        raise ValueError(
+                            "window pandas UDF args must be plain columns")
+                    args.append(a.col_name)
+                for k in (list(e.spec.partition_exprs)
+                          + [o.expr for o in e.spec.orders]):
+                    if not isinstance(k, AttributeReference):
+                        raise ValueError(
+                            "window pandas UDF partition/order keys must "
+                            f"be plain columns, got {k}")
+                pandas_udfs.append((n, e.udf.fn, e.udf.data_type, args,
+                                    e.spec))
+        out = self
+        if builtin:
+            out = out._wrap(P.WindowNode(out.plan, builtin))
+        if pandas_udfs:
+            out = out._wrap(WindowInPandas(out.plan, pandas_udfs))
+        return out
 
     def repartition(self, num_partitions: int, *keys) -> "DataFrame":
         keys = [col(k) if isinstance(k, str) else k for k in keys]
@@ -338,6 +377,31 @@ class GroupedData:
         keys = self._key_names("apply_in_pandas")
         return self.df._wrap(
             FlatMapGroupsInPandas(self.df.plan, keys, fn, schema))
+
+    applyInPandas = apply_in_pandas
+
+    def cogroup(self, other: "GroupedData") -> "CoGroupedData":
+        """df1.group_by(k).cogroup(df2.group_by(k)) — Spark cogroup
+        (GpuFlatMapCoGroupsInPandasExec analog)."""
+        return CoGroupedData(self, other)
+
+
+class CoGroupedData:
+    """Pair of grouped DataFrames awaiting apply_in_pandas (pyspark's
+    PandasCogroupedOps)."""
+
+    def __init__(self, left: GroupedData, right: GroupedData):
+        self.left = left
+        self.right = right
+
+    def apply_in_pandas(self, fn, schema) -> DataFrame:
+        """fn(left pandas DataFrame, right pandas DataFrame of one
+        cogrouped key) -> pandas DataFrame."""
+        from spark_rapids_tpu.plan.pandas_udf import FlatMapCoGroupsInPandas
+        lk = self.left._key_names("cogroup")
+        rk = self.right._key_names("cogroup")
+        return self.left.df._wrap(FlatMapCoGroupsInPandas(
+            self.left.df.plan, self.right.df.plan, lk, rk, fn, schema))
 
     applyInPandas = apply_in_pandas
 

@@ -171,6 +171,244 @@ class AggregateInPandas(PlanNode):
         return f"AggregateInPandas[keys={self.keys}]"
 
 
+class MapInArrow(PlanNode):
+    """df.map_in_arrow(fn, schema): fn(iterator of pyarrow RecordBatches)
+    -> iterator of pyarrow RecordBatches (Spark mapInArrow contract;
+    reference: GpuMapInArrowExec in execution/python/)."""
+
+    def __init__(self, child: PlanNode, fn: Callable, schema):
+        self.children = (child,)
+        self.fn = fn
+        self.schema = _normalize_schema(schema)
+
+    def output_schema(self) -> Schema:
+        return self.schema
+
+    def execute_cpu(self) -> Iterator[HostTable]:
+        from spark_rapids_tpu.io.arrow_convert import host_table_to_arrow
+
+        def rbs():
+            for batch in self.children[0].execute_cpu():
+                for rb in host_table_to_arrow(batch).to_batches():
+                    yield rb
+        for out in self.fn(rbs()):
+            host = arrow_batch_to_host(out, self.schema)
+            if host.num_rows:
+                yield host
+
+    def describe(self):
+        return f"MapInArrow[{getattr(self.fn, '__name__', 'fn')}]"
+
+
+def arrow_batch_to_host(rb, schema: Schema) -> HostTable:
+    """pyarrow RecordBatch/Table → HostTable coerced to the declared
+    schema (the Arrow-read side of the MapInArrow boundary)."""
+    import pyarrow as pa
+
+    from spark_rapids_tpu.io.arrow_convert import (
+        decode_to_schema,
+        spark_type_to_arrow,
+    )
+    if isinstance(rb, pa.RecordBatch):
+        rb = pa.Table.from_batches([rb])
+    fields = [pa.field(n, spark_type_to_arrow(dt)) for n, dt in schema]
+    try:
+        rb = rb.select([n for n, _ in schema]).cast(pa.schema(fields))
+    except (pa.ArrowInvalid, pa.ArrowTypeError, KeyError) as e:
+        raise ColumnarProcessingError(
+            f"mapInArrow result does not match declared schema "
+            f"{[(n, dt.simple_string()) for n, dt in schema]}: {e}")
+    return decode_to_schema(rb, schema)
+
+
+def _drain_to_pandas(child: PlanNode):
+    """Drain a plan node's CPU path into ONE pandas frame; an empty
+    result keeps the child's column names."""
+    import pandas as pd
+    batches = list(child.execute_cpu())
+    if not batches:
+        return pd.DataFrame(columns=[n for n, _ in child.output_schema()])
+    return HostTable.concat(batches).to_pandas()
+
+
+def align_cogroups(left_pdf, right_pdf, left_keys, right_keys):
+    """Full outer alignment of two grouped frames by key (Spark cogroup
+    semantics: the UDF sees every key present on either side, with an
+    empty frame for the absent side)."""
+    import pandas as pd
+
+    def _norm(k):
+        # NaN != NaN would keep null-key groups from matching across
+        # sides; normalize to None so nulls cogroup (Spark semantics)
+        k = k if isinstance(k, tuple) else (k,)
+        return tuple(None if pd.isna(v) else v for v in k)
+
+    lgroups = ({_norm(k): g.reset_index(drop=True)
+                for k, g in left_pdf.groupby(left_keys, dropna=False,
+                                             sort=True)}
+               if len(left_pdf) else {})
+    rgroups = ({_norm(k): g.reset_index(drop=True)
+                for k, g in right_pdf.groupby(right_keys, dropna=False,
+                                              sort=True)}
+               if len(right_pdf) else {})
+    lempty = left_pdf.iloc[0:0]
+    rempty = right_pdf.iloc[0:0]
+    for key in sorted(set(lgroups) | set(rgroups), key=repr):
+        yield lgroups.get(key, lempty), rgroups.get(key, rempty)
+
+
+class FlatMapCoGroupsInPandas(PlanNode):
+    """df1.group_by(k).cogroup(df2.group_by(k)).apply_in_pandas(fn,
+    schema): fn(left pandas DataFrame, right pandas DataFrame of one
+    cogrouped key) -> pandas DataFrame. Reference:
+    execution/python/GpuFlatMapCoGroupsInPandasExec.scala."""
+
+    def __init__(self, left: PlanNode, right: PlanNode,
+                 left_keys: Sequence[str], right_keys: Sequence[str],
+                 fn: Callable, schema):
+        if len(left_keys) != len(right_keys):
+            raise ColumnarProcessingError(
+                "cogroup key lists must have the same arity "
+                f"({list(left_keys)} vs {list(right_keys)})")
+        self.children = (left, right)
+        self.left_keys = list(left_keys)
+        self.right_keys = list(right_keys)
+        self.fn = fn
+        self.schema = _normalize_schema(schema)
+
+    def output_schema(self) -> Schema:
+        return self.schema
+
+    def execute_cpu(self) -> Iterator[HostTable]:
+        left_pdf = _drain_to_pandas(self.children[0])
+        right_pdf = _drain_to_pandas(self.children[1])
+        for lg, rg in align_cogroups(left_pdf, right_pdf,
+                                     self.left_keys, self.right_keys):
+            out = self.fn(lg, rg)
+            if len(out):
+                yield _pandas_to_host(out, self.schema)
+
+    def describe(self):
+        return f"FlatMapCoGroupsInPandas[keys={self.left_keys}]"
+
+
+class WindowInPandas(PlanNode):
+    """Window-function pandas UDFs: child columns pass through, each UDF
+    column appends fn evaluated over the row's window frame (reference:
+    execution/python/GpuWindowInPandasExec.scala). ``udfs`` entries are
+    (out_name, fn, return_type, arg col names, WindowSpec)."""
+
+    def __init__(self, child: PlanNode, udfs):
+        self.children = (child,)
+        self.udfs = list(udfs)
+        child_names = {n for n, _ in child.output_schema()}
+        for name, _fn, _rt, args, spec in self.udfs:
+            keys = list(args) + [getattr(e, "col_name", None)
+                                 for e in spec.partition_exprs] \
+                + [getattr(o.expr, "col_name", None) for o in spec.orders]
+            for k in keys:
+                if k not in child_names:
+                    raise ColumnarProcessingError(
+                        f"window pandas UDF {name}: column {k!r} not in "
+                        f"{sorted(child_names)}")
+
+    def output_schema(self) -> Schema:
+        return (list(self.children[0].output_schema())
+                + [(name, rt) for name, _f, rt, _a, _s in self.udfs])
+
+    def execute_cpu(self) -> Iterator[HostTable]:
+        import pandas as pd
+        pdf = _drain_to_pandas(self.children[0])
+        out_schema = self.output_schema()
+        if len(pdf) == 0:
+            yield _pandas_to_host(
+                pd.DataFrame(columns=[n for n, _ in out_schema]),
+                out_schema)
+            return
+        for name, fn, rt, args, spec in self.udfs:
+            pdf[name] = eval_window_udf(pdf, fn, args, spec)
+        yield _pandas_to_host(pdf, out_schema)
+
+    def describe(self):
+        return f"WindowInPandas[{[n for n, *_ in self.udfs]}]"
+
+
+def _window_col_name(e) -> str:
+    name = getattr(e, "col_name", None)
+    if name is None:
+        raise ColumnarProcessingError(
+            "window pandas UDF partition/order keys must be plain "
+            f"columns, got expression {e}")
+    return name
+
+
+def eval_window_udf(pdf, fn, arg_names, spec):
+    """Evaluate one window pandas UDF over every partition of ``pdf``.
+
+    Whole-partition (unbounded) frames call fn ONCE per partition
+    (series in, scalar or aligned series out); the default ORDER BY
+    frame (RANGE UNBOUNDED PRECEDING..CURRENT ROW) is a running
+    aggregate whose frame ends at the last PEER of each row; bounded
+    rows frames slice per row — the same frame taxonomy the reference
+    implements in GpuWindowInPandasExec."""
+    import numpy as np
+    import pandas as pd
+
+    part_cols = [_window_col_name(e) for e in spec.partition_exprs]
+    kind, lo, hi = spec.resolved_frame()
+    running_range = kind == "range" and lo is None and hi == 0
+    if kind == "range" and lo is None and hi is None:
+        kind = "rows"  # RANGE fully unbounded == whole partition
+        lo = hi = None
+    elif kind == "range" and not running_range:
+        raise ColumnarProcessingError(
+            "window pandas UDFs support unbounded, running (default "
+            "ORDER BY), or rows-based frames (Spark restriction)")
+
+    out = pd.Series(index=pdf.index, dtype=object)
+    groups = (pdf.groupby(part_cols, dropna=False, sort=False).groups.items()
+              if part_cols else [((), pdf.index)])
+    for _key, idx in groups:
+        g = pdf.loc[idx]
+        if len(g) == 0:
+            continue
+        by = [_window_col_name(o.expr) for o in spec.orders]
+        if by:
+            asc = [o.ascending for o in spec.orders]
+            g = g.sort_values(by=by, ascending=asc, kind="stable")
+        arg_series = [g[a] for a in arg_names]
+        n = len(g)
+        if kind == "rows" and (lo is not None or hi is not None):
+            vals = []
+            for i in range(n):
+                a = 0 if lo is None else max(0, min(n, i + lo))
+                b = n if hi is None else max(0, min(n, i + hi + 1))
+                vals.append(fn(*[s.iloc[a:max(a, b)] for s in arg_series]))
+            res = pd.Series(vals, index=g.index)
+        elif running_range and by:
+            # frame ends at the last peer (rows tied on ALL order keys
+            # share one result — Spark RANGE CURRENT ROW semantics)
+            keys = g[by]
+            shifted = keys.shift()
+            # nulls are peers of each other (Spark null ordering)
+            new_grp = np.array((keys.ne(shifted)
+                                & ~(keys.isna() & shifted.isna())).any(
+                                    axis=1))
+            new_grp[0] = True
+            grp_ids = np.cumsum(new_grp) - 1
+            ends = np.zeros(grp_ids[-1] + 1, dtype=np.int64)
+            np.maximum.at(ends, grp_ids, np.arange(n) + 1)
+            vals = [fn(*[s.iloc[0:e] for s in arg_series])
+                    for e in ends]
+            res = pd.Series([vals[gi] for gi in grp_ids], index=g.index)
+        else:
+            r = fn(*arg_series)
+            res = (pd.Series(r, index=g.index) if np.ndim(r) else
+                   pd.Series([r] * n, index=g.index))
+        out.loc[res.index] = res
+    return out
+
+
 class ArrowEvalPython(PlanNode):
     """Scalar pandas UDFs appended as extra columns: each UDF is
     fn(*pandas Series) -> pandas Series aligned with the input
@@ -250,7 +488,25 @@ class PandasUDFExpr(Expression):
             "agg expression (optionally aliased), not nested inside other "
             "expressions")
 
+    def over(self, spec) -> "WindowedPandasUDF":
+        """Spark semantics: a GROUPED_AGG pandas UDF applied .over(window)
+        becomes a window pandas UDF (GpuWindowInPandasExec)."""
+        if self.kind != "grouped_agg":
+            raise ColumnarProcessingError(
+                "only grouped_agg pandas UDFs can be used over a window "
+                "(Spark restriction)")
+        return WindowedPandasUDF(self, spec)
+
     device_supported = False
+
+
+class WindowedPandasUDF:
+    """Marker produced by PandasUDFExpr.over(spec); consumed by
+    DataFrame.with_windows, which plans a WindowInPandas node."""
+
+    def __init__(self, udf: PandasUDFExpr, spec):
+        self.udf = udf
+        self.spec = spec
 
 
 def pandas_udf(return_type, function_type: str = "scalar"):

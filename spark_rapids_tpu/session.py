@@ -86,30 +86,43 @@ class TpuSession:
         from spark_rapids_tpu.io.orc import OrcScanNode
         return DataFrame(OrcScanNode(list(paths), self.conf, **options), self)
 
+    # connectors resolve through the provider SPI (sources.py —
+    # ExternalSource.scala analog), never by direct import here
+    @property
+    def read(self):
+        """session.read.format("delta").load(path) — reader surface
+        routed through the external-source provider SPI."""
+        from spark_rapids_tpu.sources import DataFrameReader
+        return DataFrameReader(self)
+
+    def read_format(self, fmt: str, *paths, **options) -> DataFrame:
+        from spark_rapids_tpu.sources import create_scan
+        return DataFrame(create_scan(fmt, list(paths), self.conf,
+                                     **options), self)
+
     def read_delta(self, path, version_as_of=None, **options) -> DataFrame:
-        from spark_rapids_tpu.delta import DeltaScanNode
-        return DataFrame(DeltaScanNode(path, self.conf,
-                                       version_as_of=version_as_of,
-                                       **options), self)
+        return self.read_format("delta", path,
+                                version_as_of=version_as_of, **options)
 
     def delta_table(self, path) -> "object":
-        from spark_rapids_tpu.delta import DeltaTable
-        return DeltaTable(self, path)
+        from spark_rapids_tpu.errors import ColumnarProcessingError
+        from spark_rapids_tpu.sources import provider_for
+        p = provider_for("delta")
+        if p is None:
+            raise ColumnarProcessingError(
+                "delta source provider is not available")
+        return p.create_table_api(self, path)
 
     def read_iceberg(self, path, snapshot_id=None, **options) -> DataFrame:
-        from spark_rapids_tpu.iceberg import IcebergScanNode
-        return DataFrame(IcebergScanNode(path, self.conf,
-                                         snapshot_id=snapshot_id,
-                                         **options), self)
+        return self.read_format("iceberg", path, snapshot_id=snapshot_id,
+                                **options)
 
     def read_avro(self, *paths, **options) -> DataFrame:
-        from spark_rapids_tpu.io.avro import AvroScanNode
-        return DataFrame(AvroScanNode(list(paths), self.conf, **options), self)
+        return self.read_format("avro", *paths, **options)
 
     def read_hive_text(self, *paths, schema=None, **options) -> DataFrame:
-        from spark_rapids_tpu.io.hive_text import HiveTextScanNode
-        return DataFrame(HiveTextScanNode(list(paths), self.conf,
-                                          schema=schema, **options), self)
+        return self.read_format("hive-text", *paths, schema=schema,
+                                **options)
 
     # -- execution ----------------------------------------------------------
     def execute(self, plan: P.PlanNode) -> HostTable:

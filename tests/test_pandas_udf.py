@@ -215,3 +215,295 @@ def test_nested_udf_execs_do_not_deadlock():
         lambda it: (pdf[pdf.v1 > 1.0] for pdf in it),
         [("k", T.LONG), ("v1", T.DOUBLE)])
     assert out.count() > 0
+
+
+# -- map_in_arrow (MapInArrow / GpuMapInArrowExec) ---------------------------
+
+def test_map_in_arrow(session, cpu_session):
+    import pyarrow as pa
+
+    def fn(rbs):
+        for rb in rbs:
+            t = pa.Table.from_batches([rb])
+            yield t.append_column(
+                "v2", pa.compute.multiply(t.column("v"), 2.0))
+
+    def q(s):
+        return _df(s).map_in_arrow(
+            fn, [("k", T.LONG), ("v", T.DOUBLE), ("w", T.LONG),
+                 ("v2", T.DOUBLE)])
+
+    got = sorted(q(session).collect())
+    want = sorted(q(cpu_session).collect())
+    assert got == want
+    assert len(got) == 600
+
+
+def test_map_in_arrow_runs_on_tpu(session):
+    df = _df(session).map_in_arrow(
+        lambda it: it, [("k", T.LONG), ("v", T.DOUBLE), ("w", T.LONG)])
+    assert "MapInArrow" in df.explain()
+    assert df.count() == 600
+
+
+def test_map_in_arrow_schema_mismatch_raises(session):
+    df = _df(session).map_in_arrow(
+        lambda it: it, [("missing", T.STRING)])
+    with pytest.raises(ColumnarProcessingError, match="declared schema"):
+        df.collect()
+
+
+# -- cogroup (FlatMapCoGroupsInPandas) ---------------------------------------
+
+def _cogroup_dfs(s):
+    left = s.create_dataframe(
+        {"k": np.array([0, 0, 1, 2, 2, 5], dtype=np.int64),
+         "v": np.array([1.0, 2.0, 3.0, 4.0, 5.0, 6.0])}, num_batches=2)
+    right = s.create_dataframe(
+        {"kk": np.array([0, 2, 2, 3], dtype=np.int64),
+         "w": np.array([10.0, 20.0, 30.0, 40.0])})
+    return left, right
+
+
+def test_cogroup_apply_in_pandas(session, cpu_session):
+    def merge(l, r):
+        return pd.DataFrame({
+            "k": [l.k.iloc[0] if len(l) else r.kk.iloc[0]],
+            "lsum": [float(l.v.sum())],
+            "rsum": [float(r.w.sum())]})
+
+    def q(s):
+        left, right = _cogroup_dfs(s)
+        return (left.group_by("k").cogroup(right.group_by("kk"))
+                .apply_in_pandas(
+                    merge, [("k", T.LONG), ("lsum", T.DOUBLE),
+                            ("rsum", T.DOUBLE)]))
+
+    got = sorted(q(session).collect())
+    want = sorted(q(cpu_session).collect())
+    assert got == want
+    # keys on either side: 0,1,2 from left, 3 only on right, 5 only left
+    assert [r[0] for r in got] == [0, 1, 2, 3, 5]
+    # key 3 sees an empty left frame, key 5 an empty right frame
+    by_k = {r[0]: r for r in got}
+    assert by_k[3][1] == 0.0 and by_k[3][2] == 40.0
+    assert by_k[5][1] == 6.0 and by_k[5][2] == 0.0
+
+
+def test_cogroup_key_arity_mismatch_raises(session):
+    left, right = _cogroup_dfs(session)
+    with pytest.raises(ColumnarProcessingError, match="arity"):
+        (left.group_by("k").cogroup(right.group_by("kk", "w"))
+         .apply_in_pandas(lambda l, r: l, [("k", T.LONG)]))
+
+
+def test_cogroup_runs_on_tpu(session):
+    left, right = _cogroup_dfs(session)
+    df = (left.group_by("k").cogroup(right.group_by("kk"))
+          .apply_in_pandas(
+              lambda l, r: pd.DataFrame({"n": [len(l) + len(r)]}),
+              [("n", T.LONG)]))
+    assert "FlatMapCoGroupsInPandas" in df.explain()
+    assert sum(r[0] for r in df.collect()) == 10
+
+
+# -- window pandas UDFs (WindowInPandas) -------------------------------------
+
+def test_window_in_pandas_unbounded(session, cpu_session):
+    from spark_rapids_tpu.ops.window import Window as W
+
+    @F.pandas_udf("double", "grouped_agg")
+    def gmean(v):
+        return float(v.mean())
+
+    def q(s):
+        return _df(s, n=200, batches=2).with_windows(
+            m=gmean("v").over(W.partition_by("k")))
+
+    got = sorted(q(session).collect())
+    want = sorted(q(cpu_session).collect())
+    assert len(got) == 200
+    for g, w in zip(got, want):
+        assert g[:3] == w[:3]
+        assert abs(g[3] - w[3]) < 1e-9
+
+
+def test_window_in_pandas_bounded_rows(session, cpu_session):
+    from spark_rapids_tpu.ops.window import Window as W
+
+    @F.pandas_udf("double", "grouped_agg")
+    def gsum(v):
+        return float(v.sum())
+
+    spec = (W.partition_by("k").order_by("w")
+            .rows_between(-1, 1))  # sliding 3-row frame
+
+    def q(s):
+        return _df(s, n=60, batches=1).with_windows(m=gsum("v").over(spec))
+
+    got = sorted(q(session).collect())
+    want = sorted(q(cpu_session).collect())
+    for g, w in zip(got, want):
+        assert abs(g[3] - w[3]) < 1e-9
+
+
+def test_window_in_pandas_mixed_with_builtin(session):
+    from spark_rapids_tpu.ops.window import Window as W
+
+    @F.pandas_udf("double", "grouped_agg")
+    def gmax(v):
+        return float(v.max())
+
+    df = _df(session, n=100, batches=1).with_windows(
+        rn=F.row_number().over(W.partition_by("k").order_by("v")),
+        m=gmax("v").over(W.partition_by("k")))
+    rows = df.collect()
+    assert len(rows) == 100
+    # per-k max column must equal the true group max
+    import collections
+    gm = collections.defaultdict(lambda: -1e18)
+    for r in rows:
+        gm[r[0]] = max(gm[r[0]], r[1])
+    for r in rows:
+        assert abs(r[4] - gm[r[0]]) < 1e-12
+
+
+def test_window_in_pandas_scalar_udf_over_raises(session):
+    from spark_rapids_tpu.ops.window import Window as W
+
+    @F.pandas_udf("double")
+    def sc(v):
+        return v
+
+    with pytest.raises(ColumnarProcessingError, match="grouped_agg"):
+        sc("v").over(W.partition_by("k"))
+
+
+def test_window_in_pandas_running_frame(session, cpu_session):
+    """Default ORDER BY frame = RANGE UNBOUNDED PRECEDING..CURRENT ROW:
+    a running aggregate whose frame ends at the last PEER (review fix)."""
+    from spark_rapids_tpu.ops.window import Window as W
+
+    @F.pandas_udf("double", "grouped_agg")
+    def gsum(v):
+        return float(v.sum())
+
+    def q(s):
+        df = s.create_dataframe(
+            {"k": np.array([0, 0, 0, 0, 0, 1, 1], dtype=np.int64),
+             "t": np.array([1, 2, 2, 3, 4, 1, 1], dtype=np.int64),
+             "v": np.array([1.0, 2.0, 3.0, 4.0, 5.0, 6.0, 7.0])})
+        return df.with_windows(
+            rs=gsum("v").over(W.partition_by("k").order_by("t")))
+
+    got = sorted(q(session).collect())
+    want = sorted(q(cpu_session).collect())
+    assert got == want
+    by = {(r[0], r[1], r[2]): r[3] for r in got}
+    # k=0: t=1 -> 1; t=2 peers (2,3) both see 1+2+3=6; t=3 -> 10; t=4 -> 15
+    assert by[(0, 1, 1.0)] == 1.0
+    assert by[(0, 2, 2.0)] == 6.0 and by[(0, 2, 3.0)] == 6.0
+    assert by[(0, 3, 4.0)] == 10.0 and by[(0, 4, 5.0)] == 15.0
+    # k=1: both rows are peers at t=1 -> 13
+    assert by[(1, 1, 6.0)] == 13.0 and by[(1, 1, 7.0)] == 13.0
+
+
+def test_window_in_pandas_negative_frame_is_empty(session):
+    """rows_between(-3, -2) near the partition start must yield an EMPTY
+    frame, not wrap around (review fix)."""
+    from spark_rapids_tpu.ops.window import Window as W
+
+    @F.pandas_udf("double", "grouped_agg")
+    def gsum(v):
+        return float(v.sum())
+
+    df = session.create_dataframe(
+        {"k": np.zeros(5, dtype=np.int64),
+         "t": np.arange(5, dtype=np.int64),
+         "v": np.array([1.0, 2.0, 4.0, 8.0, 16.0])})
+    rows = sorted(df.with_windows(
+        m=gsum("v").over(W.partition_by("k").order_by("t")
+                         .rows_between(-3, -2))).collect())
+    got = [r[3] for r in rows]
+    # frames: [], [], [1], [1+2], [2+4]
+    assert got == [0.0, 0.0, 1.0, 3.0, 6.0]
+
+
+def test_window_in_pandas_expr_partition_key_raises(session):
+    from spark_rapids_tpu.ops.window import Window as W
+
+    @F.pandas_udf("double", "grouped_agg")
+    def gmean(v):
+        return float(v.mean())
+
+    with pytest.raises(ValueError, match="plain columns"):
+        _df(session).with_windows(
+            m=gmean("v").over(W.partition_by(col("k") + col("w"))))
+
+
+def test_cogroup_null_keys_align(session, cpu_session):
+    """Null keys present on BOTH sides cogroup into ONE pair (review
+    fix: NaN != NaN must not split the null group)."""
+    def q(s):
+        left = s.create_dataframe(
+            {"k": np.array([1.0, np.nan, np.nan]),
+             "v": np.array([10.0, 20.0, 30.0])})
+        right = s.create_dataframe(
+            {"kk": np.array([np.nan, 2.0]),
+             "u": np.array([5.0, 7.0])})
+        return (left.group_by("k").cogroup(right.group_by("kk"))
+                .apply_in_pandas(
+                    lambda l, r: pd.DataFrame(
+                        {"nl": [len(l)], "nr": [len(r)]}),
+                    [("nl", T.LONG), ("nr", T.LONG)]))
+
+    got = sorted(q(session).collect())
+    assert got == sorted(q(cpu_session).collect())
+    # pairs: k=1 (1,0), k=2 (0,1), k=null (2,1) — exactly 3 pairs
+    assert got == [[0, 1], [1, 0], [2, 1]] or \
+        [tuple(r) for r in got] == [(0, 1), (1, 0), (2, 1)]
+
+
+def test_window_in_pandas_unknown_column_raises_at_plan(session):
+    from spark_rapids_tpu.ops.window import Window as W
+
+    @F.pandas_udf("double", "grouped_agg")
+    def gmean(v):
+        return float(v.mean())
+
+    with pytest.raises(ColumnarProcessingError, match="nope"):
+        _df(session).with_windows(
+            m=gmean("nope").over(W.partition_by("k")))
+
+
+def test_window_in_pandas_range_fully_unbounded(session):
+    """range_between(None, None) = whole partition, NOT a running frame
+    (review fix)."""
+    from spark_rapids_tpu.ops.window import Window as W
+
+    @F.pandas_udf("double", "grouped_agg")
+    def gsum(v):
+        return float(v.sum())
+
+    df = session.create_dataframe(
+        {"k": np.zeros(3, dtype=np.int64),
+         "t": np.arange(3, dtype=np.int64),
+         "v": np.array([1.0, 2.0, 4.0])})
+    rows = df.with_windows(m=gsum("v").over(
+        W.partition_by("k").order_by("t").range_between(None, None)))
+    assert [r[3] for r in sorted(rows.collect())] == [7.0, 7.0, 7.0]
+
+
+def test_window_in_pandas_empty_input(session):
+    """Zero-row child with a running frame must not crash (review fix)."""
+    from spark_rapids_tpu.ops.expr import lit
+    from spark_rapids_tpu.ops.window import Window as W
+
+    @F.pandas_udf("double", "grouped_agg")
+    def gsum(v):
+        return float(v.sum())
+
+    df = (_df(session, n=50, batches=1)
+          .filter(col("w") > lit(10**9))
+          .with_windows(m=gsum("v").over(W.order_by("w"))))
+    assert df.collect() == []

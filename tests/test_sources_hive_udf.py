@@ -1,0 +1,167 @@
+"""External-source provider SPI + Hive UDF tests
+(reference: ExternalSource.scala, hiveUDFs.scala — SURVEY.md §2.8)."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import sources
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.errors import ColumnarProcessingError
+from spark_rapids_tpu.hive_udf import (
+    hive_udf,
+    register_hive_udf,
+    unregister_hive_udf,
+)
+from spark_rapids_tpu.ops.expr import col
+
+
+# -- provider SPI ------------------------------------------------------------
+
+def test_builtin_formats_registered():
+    fmts = sources.supported_formats()
+    for f in ("parquet", "orc", "csv", "json", "avro", "delta",
+              "iceberg", "hive"):
+        assert f in fmts, f
+
+
+def test_reader_surface_parquet(session, tmp_path):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    pq.write_table(pa.table({"a": [1, 2, 3]}), tmp_path / "t.parquet")
+    df = session.read.format("parquet").load(str(tmp_path / "t.parquet"))
+    assert [r[0] for r in df.collect()] == [1, 2, 3]
+    # convenience form
+    df2 = session.read.parquet(str(tmp_path / "t.parquet"))
+    assert df2.count() == 3
+
+
+def test_reader_routes_delta_through_spi(session, tmp_path):
+    d = session.create_dataframe({"x": np.arange(5, dtype=np.int64)})
+    d.write_delta(str(tmp_path / "dt"))
+    got = session.read.format("delta").load(str(tmp_path / "dt")).collect()
+    assert sorted(r[0] for r in got) == [0, 1, 2, 3, 4]
+
+
+def test_unknown_format_lists_available(session):
+    with pytest.raises(ColumnarProcessingError, match="no available source"):
+        session.read_format("kudu", "/nope")
+
+
+def test_graceful_absence_when_module_missing():
+    class P(sources.ExternalSourceProvider):
+        name = "ghost"
+        formats = ("ghost",)
+        required_modules = ("module_that_does_not_exist_xyz",)
+
+    sources.register_provider(P())
+    try:
+        assert sources.provider_for("ghost") is None
+        assert "ghost" not in sources.supported_formats()
+    finally:
+        sources._PROVIDERS.pop("ghost", None)
+
+
+def test_custom_provider_end_to_end(session):
+    """A third-party connector plugs in with one register call."""
+    class MemScanProvider(sources.ExternalSourceProvider):
+        name = "mem"
+        formats = ("mem",)
+
+        def create_scan_node(self, paths, conf, **options):
+            from spark_rapids_tpu.columnar import HostTable
+            from spark_rapids_tpu.plan.nodes import LocalScan
+            t = HostTable.from_pydict(
+                {"p": np.array([len(p) for p in paths], dtype=np.int64)})
+            return LocalScan([t])
+
+    sources.register_provider(MemScanProvider())
+    try:
+        df = session.read.format("mem").load("abc", "de")
+        assert sorted(r[0] for r in df.collect()) == [2, 3]
+    finally:
+        sources._PROVIDERS.pop("mem", None)
+
+
+def test_capability_checked(session):
+    class WOnly(sources.ExternalSourceProvider):
+        name = "wonly"
+        formats = ("wonly",)
+        capabilities = frozenset({"write"})
+
+    sources.register_provider(WOnly())
+    try:
+        with pytest.raises(ColumnarProcessingError, match="does not support"):
+            session.read_format("wonly", "/x")
+    finally:
+        sources._PROVIDERS.pop("wonly", None)
+
+
+# -- hive UDFs ---------------------------------------------------------------
+
+def _strings_df(s):
+    return s.create_dataframe(
+        {"s": np.array(["a", "Bc", None, "dEf"], dtype=object),
+         "n": np.array([1, 2, 3, 4], dtype=np.int64)})
+
+
+def test_hive_simple_udf(session, cpu_session):
+    register_hive_udf("t_upper",
+                      lambda v: v.upper() if v is not None else None,
+                      "string")
+    try:
+        def q(s):
+            return _strings_df(s).select(
+                "n", hive_udf("t_upper")(col("s")).alias("u"))
+        got = sorted(q(session).collect())
+        want = sorted(q(cpu_session).collect())
+        assert got == want
+        assert got[0][1] == "A" and got[2][1] is None
+    finally:
+        unregister_hive_udf("t_upper")
+
+
+def test_hive_simple_udf_multi_arg(session):
+    register_hive_udf("t_addmul", lambda a, b: a * 10 + b, "long")
+    try:
+        df = _strings_df(session).select(
+            hive_udf("t_addmul")(col("n"), col("n")).alias("r"))
+        assert sorted(r[0] for r in df.collect()) == [11, 22, 33, 44]
+    finally:
+        unregister_hive_udf("t_addmul")
+
+
+def test_hive_generic_udf(session, cpu_session):
+    register_hive_udf("t_len",
+                      lambda s: s.str.len().astype("float").fillna(-1.0),
+                      "double", generic=True)
+    try:
+        def q(s):
+            return _strings_df(s).select(
+                hive_udf("t_len")(col("s")).alias("l"))
+        got = sorted(q(session).collect())
+        assert got == sorted(q(cpu_session).collect())
+        assert got == [[-1.0], [1.0], [2.0], [3.0]] or \
+            [r[0] for r in got] == [-1.0, 1.0, 2.0, 3.0]
+    finally:
+        unregister_hive_udf("t_len")
+
+
+def test_hive_udf_kill_switch_reports_fallback(session):
+    from spark_rapids_tpu.session import TpuSession
+    register_hive_udf("t_neg", lambda v: -v, "long")
+    try:
+        s = TpuSession(
+            {"spark.rapids.sql.expression.HiveSimpleUDF": "false"})
+        df_expr = hive_udf("t_neg")(col("n")).alias("m")
+        d = _strings_df(s).select("n", df_expr)
+        plan = d.explain()
+        assert "HiveSimpleUDF" in plan and "disabled by conf" in plan
+        # fallback still computes correct results on the CPU path
+        assert sorted(r[1] for r in d.collect()) == [-4, -3, -2, -1]
+    finally:
+        unregister_hive_udf("t_neg")
+
+
+def test_hive_udf_unregistered_name_raises():
+    with pytest.raises(ColumnarProcessingError, match="not registered"):
+        hive_udf("nope")
