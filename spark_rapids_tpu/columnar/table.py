@@ -83,16 +83,64 @@ _CACHED_TABLES = None  # lazy weakref.WeakSet
 _SHARED_VIEWS = None
 
 
-def mark_shared_view(table: "DeviceTable") -> None:
+def mark_shared_view(table: "DeviceTable", group=None) -> None:
+    """``group`` identifies ONE split execution: views carrying the same
+    non-None group have DISJOINT masks by construction and may merge."""
     global _SHARED_VIEWS
     if _SHARED_VIEWS is None:
         import weakref
-        _SHARED_VIEWS = weakref.WeakSet()
-    _SHARED_VIEWS.add(table)
+        _SHARED_VIEWS = weakref.WeakKeyDictionary()
+    _SHARED_VIEWS[table] = group
 
 
 def is_shared_view(table: "DeviceTable") -> bool:
     return _SHARED_VIEWS is not None and table in _SHARED_VIEWS
+
+
+def view_group(table: "DeviceTable"):
+    return _SHARED_VIEWS.get(table) if _SHARED_VIEWS is not None else None
+
+
+def mergeable_views(a: "DeviceTable", b: "DeviceTable") -> bool:
+    """May two masked views merge by mask union? Requires the SAME device
+    buffers AND the same split-execution group — same buffers alone is
+    not enough (two filters of one scan share buffers with OVERLAPPING
+    masks; OR-ing those would dedupe rows)."""
+    ga = view_group(a)
+    return (ga is not None and ga is view_group(b)
+            and a.live is not None and b.live is not None
+            and a.capacity == b.capacity
+            and len(a.columns) == len(b.columns)
+            and all(x.data is y.data and x.validity is y.validity
+                    for x, y in zip(a.columns, b.columns)))
+
+
+def union_views(a: "DeviceTable", b: "DeviceTable") -> "DeviceTable":
+    """Merge two same-split masked views by OR-ing liveness — zero data
+    movement, one downstream kernel instead of two. Masks are disjoint
+    (split partitions), so row counts add."""
+    out = DeviceTable(a.names, a.columns, a.nrows_dev + b.nrows_dev,
+                      a.capacity, live=a.live | b.live)
+    mark_shared_view(out, view_group(a))
+    return out
+
+
+def merge_split_views(batches):
+    """Generator: mask-union consecutive same-split views. For consumers
+    that are partition-structure-blind (aggregate re-groups everything
+    anyway), a repartition's k per-partition views collapse back into ONE
+    masked batch — one downstream kernel instead of k full-capacity ones
+    (q7-style repartition->agg was paying 8x)."""
+    cur = None
+    for b in batches:
+        if cur is not None and mergeable_views(cur, b):
+            cur = union_views(cur, b)
+        else:
+            if cur is not None:
+                yield cur
+            cur = b
+    if cur is not None:
+        yield cur
 
 
 def register_device_cache(host: "HostTable") -> None:

@@ -174,10 +174,13 @@ class TpuShuffleExchangeExec(TpuExec):
         self.add_metric("localSplitParts", nparts)
         self.add_metric("localSplitTime", perf_counter() - t0)
         from spark_rapids_tpu.columnar.table import mark_shared_view
+        split_group = object()  # one token per split: its masks are disjoint
         for mask, cnt in outs:
             out = DeviceTable(table.names, table.columns, cnt,
                               table.capacity, live=mask)
-            mark_shared_view(out)  # coalesce streams capacity-sharing views
+            # coalesce streams capacity-sharing views (and may mask-union
+            # same-group views back together for group-blind consumers)
+            mark_shared_view(out, split_group)
             yield out
 
     def _execute_ici(self):

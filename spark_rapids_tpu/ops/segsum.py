@@ -143,18 +143,54 @@ def batched_segment_sum_f64(cols, gid, num_segments: int, capacity: int,
                         jnp.zeros((), dtype=jnp.int32))
 
 
+def _unblocked_split_segment_sum(v, gid, num_segments: int):
+    """Split path for LARGE segment counts (sorted-path aggregates run
+    with num_segments == capacity, where per-block partials would outgrow
+    the input): plain f32 segment-sums of the exact hi/lo halves — each a
+    native 32-bit scatter-add, ~4x the speed of the emulated-f64 scatter.
+
+    The error model extends the blocked path's mass-based random-walk
+    estimate (this body is otherwise its nb=1 degenerate case — keep the
+    two guards in sync) with a per-segment COUNT term: without blocking,
+    a skewed segment may accumulate millions of rows in one f32 stream,
+    so the estimate scales by sqrt(rows/BLOCK) above one block's worth —
+    a 1M-row all-positive segment then correctly reroutes to the exact
+    emulated-f64 path instead of passing a guard calibrated for 1024-row
+    partials. Any risky segment (or non-finite/oversized input) reroutes
+    the WHOLE call via lax.cond."""
+    hi, lo = split_f64_hi_lo(v)
+    phi = jax.ops.segment_sum(hi, gid, num_segments=num_segments)
+    plo = jax.ops.segment_sum(lo, gid, num_segments=num_segments)
+    pabs = jax.ops.segment_sum(jnp.abs(hi), gid, num_segments=num_segments)
+    cnt = jax.ops.segment_sum((v != 0.0).astype(jnp.int32), gid,
+                              num_segments=num_segments)
+    split_sum = phi.astype(jnp.float64) + plo.astype(jnp.float64)
+    scale = jnp.sqrt(jnp.maximum(cnt.astype(jnp.float64) / BLOCK, 1.0))
+    err_est = ERR_PER_MASS * scale * pabs.astype(jnp.float64)
+    risky = err_est > (jnp.abs(split_sum) * RTOL + ATOL)
+    has_big = jnp.any(jnp.abs(v) > SPLIT_MAX_ABS)
+    has_nonfinite = ~jnp.all(jnp.isfinite(pabs))
+    bad = jnp.any(risky) | has_big | has_nonfinite
+
+    def exact(x):
+        return jax.ops.segment_sum(x, gid, num_segments=num_segments)
+
+    return jax.lax.cond(bad, exact, lambda x: split_sum, v)
+
+
 def segment_sum_f64(v, gid, num_segments: int, capacity: int, use_split: bool):
     """segment_sum for f64 ``v`` (invalid slots must already be zeroed).
 
-    ``gid`` must be int32 in [0, num_segments). Non-f64 dtypes and disabled/
-    oversized split configurations take the plain jax.ops.segment_sum path.
-    """
+    ``gid`` must be int32 in [0, num_segments). Non-f64 dtypes and
+    disabled split configurations take the plain jax.ops.segment_sum
+    path; oversized configurations (num_segments*blocks would outgrow
+    the input) take the guarded UNBLOCKED split path."""
     if v.dtype != jnp.float64 or not use_split:
         return jax.ops.segment_sum(v, gid, num_segments=num_segments)
     block = min(BLOCK, capacity)
     nb = max(capacity // block, 1)
     if nb * block != capacity or nb * num_segments > MAX_PARTIALS:
-        return jax.ops.segment_sum(v, gid, num_segments=num_segments)
+        return _unblocked_split_segment_sum(v, gid, num_segments)
 
     hi, lo = split_f64_hi_lo(v)
     blk = jnp.arange(capacity, dtype=jnp.int32) // block

@@ -182,3 +182,41 @@ def test_large_dict_falls_back_to_sorted(session, cpu_session):
     assert_tpu_and_cpu_are_equal(
         lambda s: _df(s, GENS).group_by("s").agg(F.count().alias("c")),
         limited, cpu_session)
+
+
+def test_unblocked_split_guard_skewed_segment():
+    """A single huge all-positive segment must reroute to the exact path:
+    the unblocked split guard scales with per-segment row count (review
+    fix — a mass-only guard calibrated for 1024-row blocks under-counts
+    sqrt(n/1024)x)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from spark_rapids_tpu.ops.segsum import _unblocked_split_segment_sum
+
+    n = 1 << 17
+    v = jnp.asarray(np.full(n, 1.0 + 2**-26))  # low bits shred in f32 sums
+    gid = jnp.zeros(n, dtype=jnp.int32)
+    got = jax.jit(
+        lambda v, g: _unblocked_split_segment_sum(v, g, n))(v, gid)
+    want = jax.ops.segment_sum(v, gid, num_segments=n)
+    rel = abs(float(got[0]) - float(want[0])) / float(want[0])
+    assert rel <= 1e-6, rel
+
+
+def test_ungrouped_agg_fast_path_empty_input(session):
+    """Global aggregates yield exactly ONE row on empty input: count=0,
+    sum NULL (Spark semantics through the new zero-key fast path)."""
+    import numpy as np
+
+    from spark_rapids_tpu import functions as F
+    from spark_rapids_tpu.ops.expr import col, lit
+
+    df = (session.create_dataframe(
+        {"v": np.arange(50, dtype=np.int64)})
+        .filter(col("v") > lit(10**9))
+        .agg(F.count("v").alias("c"), F.sum("v").alias("s"),
+             F.avg("v").alias("a"), F.max("v").alias("m")))
+    rows = df.collect()
+    assert rows == [(0, None, None, None)]
