@@ -184,6 +184,32 @@ JOIN_MAX_SUBPARTITIONS = int_conf(
     "Upper bound on hash sub-partitions when a join's build side "
     "exceeds the sub-partitioning threshold.")
 
+SEGSUM_BLOCK_ROWS = int_conf(
+    "spark.rapids.tpu.segsum.blockRows", 1024,
+    "Rows per f32 partial-sum block in the split-f64 segmented sum "
+    "(bounds f32 accumulation error; ops/segsum.BLOCK).")
+
+SEGSUM_MAX_PARTIALS = int_conf(
+    "spark.rapids.tpu.segsum.maxPartials", 1 << 22,
+    "Blocked split-f64 segment sums cap (segments x blocks) at this "
+    "many partials; beyond it the guarded unblocked path runs.")
+
+SEGSUM_MATMUL_MAX_SEGMENTS = int_conf(
+    "spark.rapids.tpu.segsum.matmulMaxSegments", 32,
+    "One-hot MXU matmul partials run for segment counts up to this "
+    "(the materialized one-hot costs capacity*segments*4 bytes of HBM "
+    "traffic).")
+
+SPLIT_SUM_MAX_ABS = float_conf(
+    "spark.rapids.tpu.sum.splitMaxAbs", 1e34,
+    "Split-f64 sums reroute to the exact path when any |value| exceeds "
+    "this (an f32 block partial could overflow).")
+
+WINDOW_STREAM_TARGET_ROWS = int_conf(
+    "spark.rapids.sql.window.streamTargetRows", 0,
+    "Target rows per streamed range batch in out-of-core window "
+    "evaluation (0 = the largest input run's size).")
+
 BLOOM_DEFAULT_NUM_BITS = int_conf(
     "spark.rapids.tpu.bloomFilter.numBits", 1 << 20,
     "Default bit-array size for build_bloom_filter.")

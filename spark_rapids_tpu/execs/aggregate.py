@@ -335,9 +335,10 @@ class TpuHashAggregateExec(TpuExec):
              tuple(fn.key() for _, fn in agg_specs),
              tuple(f.key() for f in filters),
              table.schema_key()[0]))
+        from spark_rapids_tpu.ops import segsum as _ss
         mode_key = ("fast", fast[0], fast[3]) if fast else ("sorted",)
         has_mask = table.live is not None
-        tkey = (capacity, self.use_split, mode_key, has_mask,
+        tkey = (capacity, self.use_split, _ss.trace_key(), mode_key, has_mask,
                 tuple(_prep_trace_key(p) for p in filter_preps),
                 tuple(_prep_trace_key(p) for p in key_preps),
                 tuple(tuple(_prep_trace_key(p) for p in per_child)

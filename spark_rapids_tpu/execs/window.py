@@ -103,6 +103,61 @@ def device_window_supported(w: WindowExpression,
     return False, f"window function {type(fn).__name__} is not supported on TPU"
 
 
+class _TableExec(TpuExec):
+    """Fixed device tables as an exec (two-pass composition plumbing)."""
+
+    def __init__(self, tables, schema):
+        super().__init__()
+        self.children = ()
+        self._tables = list(tables)
+        self._schema = list(schema)
+
+    def output_schema(self):
+        return self._schema
+
+    def execute(self):
+        yield from self._tables
+
+
+class _ReplayExec(TpuExec):
+    """Replays SpillableBatches, pinning each while downstream consumes
+    it (the cached-batch source of the double-pass window)."""
+
+    def __init__(self, spills, schema):
+        super().__init__()
+        self.children = ()
+        self._spills = list(spills)
+        self._schema = list(schema)
+
+    def output_schema(self):
+        return self._schema
+
+    def execute(self):
+        for sb in self._spills:
+            with sb.pinned_batch() as dt:
+                yield dt
+
+
+def _slice_rows(table: DeviceTable, a: int, b: int) -> DeviceTable:
+    """Rows [a, b) of a compacted flat-column table as a fresh
+    bucket-capacity table (the bounded-window streaming emit/carry cut)."""
+    from spark_rapids_tpu.columnar import bucket_for
+
+    n = b - a
+    cap = bucket_for(max(n, 1))
+
+    def cut(arr):
+        s = arr[a:b]
+        if cap > n:
+            pad = jnp.zeros((cap - n,) + s.shape[1:], dtype=s.dtype)
+            s = jnp.concatenate([s, pad])
+        return s
+
+    cols = [c.with_arrays(cut(c.data), cut(c.validity))
+            for c in table.columns]
+    return DeviceTable(table.names, cols, n, cap)
+
+
 def _seg_scan_max(flags_idx):
     return jax.lax.associative_scan(jnp.maximum, flags_idx)
 
@@ -127,11 +182,14 @@ def _segmented_scan(op, v, new_seg):
 
 class TpuWindowExec(TpuExec):
     def __init__(self, child: TpuExec, window_cols: Sequence[Tuple[str, WindowExpression]],
-                 per_batch: bool = False):
+                 per_batch: bool = False, use_split: bool = False,
+                 stream_target_rows: int = 0):
         super().__init__()
         self.children = (child,)
         self.window_cols = list(window_cols)
         self.per_batch = per_batch
+        self.use_split = use_split
+        self.stream_target_rows = stream_target_rows
 
     def output_schema(self):
         return (self.children[0].output_schema()
@@ -154,15 +212,33 @@ class TpuWindowExec(TpuExec):
             # demotes to a host run before the next loads (bounded HBM)
             yield from self._stream_running(it)
             return
+        bctx = self._bounded_ctx()
+        two_pass = bctx is None and self._two_pass_able()
+        if bctx is not None or two_pass:
+            first = next(it, None)
+            if first is None:
+                return
+            second = next(it, None)
+            if second is None:
+                yield retry_block(lambda: self._window(first))
+                return
+            from itertools import chain
+            rest = chain([first, second], it)
+            if two_pass:
+                yield from self._stream_two_pass(rest)
+            else:
+                yield from self._stream_bounded(rest, *bctx)
+            return
         batches = list(it)
         if not batches:
             return
         if len(batches) == 1:
             yield retry_block(lambda: self._window(batches[0]))
             return
-        # general multi-batch fallback: device concat (bounded by HBM) +
-        # one kernel — the pre-round-4 "requires a single batch" raise is
-        # gone; true streaming covers the running-window subset above
+        # multi-batch fallback (whole-partition frames with rank mixes,
+        # RANGE frames, lag/lead): device concat (bounded by HBM) + one
+        # kernel — the pre-round-4 "requires a single batch" raise is
+        # gone; running and finite-rows frames stream above
         from spark_rapids_tpu.columnar.table import concat_device
         from spark_rapids_tpu.runtime.spill import BufferCatalog, SpillableBatch
         catalog = BufferCatalog.get()
@@ -223,10 +299,238 @@ class TpuWindowExec(TpuExec):
             return
         state = None
         self.add_metric("runningWindowBatches", len(runs))
-        for dt in sorted_run_stream(runs, orders):
+        for dt in sorted_run_stream(
+                runs, orders,
+                target_rows=getattr(self, "stream_target_rows", 0) or None):
             out, state = retry_block(
                 lambda d=dt, st=state: self._stream_batch(d, st))
             yield out
+
+    # -- batched bounded-frame streaming ------------------------------------
+    # (reference: window/GpuBatchedBoundedWindowExec.scala:1-255 — batches
+    # stream with a small carried context instead of materializing the
+    # whole input; the TPU shape: globally sort into spill-backed runs,
+    # then window each run EXTENDED by `lookback` rows of kept context
+    # before it, withholding the last `lookahead` rows until the next run
+    # supplies their forward frame.)
+
+    def _bounded_ctx(self, child_schema=None):
+        """(lookback, lookahead) when every window column is a device agg
+        over a FINITE rows frame sharing one (partition, order) and all
+        child columns are flat; None otherwise (-> other paths)."""
+        from spark_rapids_tpu import types as T
+
+        if child_schema is None:
+            child_schema = self.children[0].output_schema()
+        for _, dt in child_schema:
+            if isinstance(dt, (T.ArrayType, T.StructType, T.MapType)):
+                return None  # row-slicing nested buffers is not supported
+        shared = None
+        lookback = lookahead = 0
+        for _, w in self.window_cols:
+            if not isinstance(w.function, DEVICE_WINDOW_AGGS):
+                return None
+            kind, lo, hi = w.spec.resolved_frame()
+            if kind != "rows" or lo is None or hi is None:
+                return None
+            if not w.spec.partition_exprs and not w.spec.orders:
+                return None  # nothing to sort runs by -> concat fallback
+            skey = (tuple(e.key() for e in w.spec.partition_exprs),
+                    tuple((o.expr.key(), o.ascending,
+                           o.resolved_nulls_first()) for o in w.spec.orders))
+            if shared is None:
+                shared = skey
+            elif skey != shared:
+                return None
+            lookback = max(lookback, -min(lo, 0))
+            lookahead = max(lookahead, max(hi, 0))
+        if shared is None:
+            return None
+        return lookback, lookahead
+
+    # -- cached double-pass: whole-partition aggregate windows ---------------
+    # (reference: window/GpuCachedDoublePassWindowExec.scala — one pass
+    # computes per-partition results while batches cache spillably, a
+    # second pass stitches results onto every cached batch. TPU shape:
+    # COMPOSE the existing streaming aggregate (pass 1) with a hash join
+    # back by partition key (pass 2) — no bespoke caching machinery.)
+
+    def _two_pass_able(self) -> bool:
+        """True when every window column is a device agg over the whole
+        partition (UNBOUNDED..UNBOUNDED) sharing one non-empty
+        partition_by, over flat child columns."""
+        from spark_rapids_tpu import types as T
+
+        for _, dt in self.children[0].output_schema():
+            if isinstance(dt, (T.ArrayType, T.StructType, T.MapType)):
+                return False
+        shared = None
+        for _, w in self.window_cols:
+            if not isinstance(w.function, DEVICE_WINDOW_AGGS):
+                return False
+            kind, lo, hi = w.spec.resolved_frame()
+            if not (lo is None and hi is None):
+                return False
+            if not w.spec.partition_exprs:
+                return False
+            skey = tuple(e.key() for e in w.spec.partition_exprs)
+            if shared is None:
+                shared = skey
+            elif skey != shared:
+                return False
+        return shared is not None
+
+    @staticmethod
+    def _null_sentinel(dt):
+        from spark_rapids_tpu.ops.expr import Literal
+        if isinstance(dt, T.StringType):
+            return Literal("", dt)
+        if isinstance(dt, T.BooleanType):
+            return Literal(False, dt)
+        if isinstance(dt, (T.FloatType, T.DoubleType)):
+            return Literal(0.0, dt)
+        return Literal(0, dt)
+
+    @classmethod
+    def _null_safe_keys(cls, exprs):
+        """(coalesce(k, sentinel), isnull(k)) pairs — the join kernel has
+        Spark null!=null key semantics, but window partitions group nulls
+        together; the flag key restores null-safe matching."""
+        from spark_rapids_tpu.ops.conditional import Coalesce
+        from spark_rapids_tpu.ops.predicates import IsNull
+        keys = []
+        for k in exprs:
+            keys.append(Coalesce(k, cls._null_sentinel(k.data_type)))
+            keys.append(IsNull(k))
+        return keys
+
+    def _stream_two_pass(self, batches):
+        from spark_rapids_tpu.columnar.table import concat_device
+        from spark_rapids_tpu.execs.aggregate import TpuHashAggregateExec
+        from spark_rapids_tpu.execs.join import TpuJoinExec
+        from spark_rapids_tpu.ops.expr import BoundReference
+        from spark_rapids_tpu.runtime.retry import retry_block
+        from spark_rapids_tpu.runtime.spill import BufferCatalog, SpillableBatch
+
+        catalog = BufferCatalog.get()
+        child_schema = self.children[0].output_schema()
+        grouping = list(self.window_cols[0][1].spec.partition_exprs)
+        gnames = [f"__wp{i}" for i in range(len(grouping))]
+        wnames = [n for n, _ in self.window_cols]
+        agg_specs = [(f"__wa{i}", w.function)
+                     for i, (_, w) in enumerate(self.window_cols)]
+
+        spills = [SpillableBatch(b, catalog) for b in batches]
+        try:
+            # pass 1: streaming partial/merge aggregate over the cached
+            # batches (bounded HBM — one pinned batch at a time)
+            agg_exec = TpuHashAggregateExec(
+                _ReplayExec(spills, child_schema), grouping, agg_specs,
+                gnames, use_split=self.use_split)
+            agg_batches = list(agg_exec.execute())
+            self.add_metric("twoPassPartitions", len(agg_batches))
+            agg_table = (agg_batches[0] if len(agg_batches) == 1 else
+                         retry_block(lambda: concat_device(agg_batches)))
+            agg_schema = agg_exec.output_schema()
+            right_refs = [BoundReference(i, dt, name_hint=n)
+                          for i, (n, dt) in enumerate(agg_schema)]
+
+            # pass 2: ONE probe-streaming join stitches every cached
+            # batch to its partition's results by null-safe key, then the
+            # key duplicates drop
+            join = TpuJoinExec(
+                _ReplayExec(spills, child_schema),
+                _TableExec([agg_table], agg_schema),
+                "inner",
+                self._null_safe_keys(grouping),
+                self._null_safe_keys(right_refs[:len(grouping)]),
+                None, child_schema, agg_schema)
+            keep_child = len(child_schema)
+            names = [n for n, _ in child_schema] + wnames
+            for out in join.execute():
+                cols = (list(out.columns[:keep_child])
+                        + list(out.columns[keep_child + len(grouping):]))
+                yield DeviceTable(names, cols, out.nrows_dev,
+                                  out.capacity, live=out.live)
+        finally:
+            for sb in spills:
+                sb.release()
+
+    def _stream_bounded(self, batches, lookback: int, lookahead: int):
+        """Sort ONCE into host runs, stream globally ordered ranges, and
+        window each range over [kept context ++ range], emitting only the
+        rows whose frame is complete: a row emits when `lookahead` rows
+        exist after it; `lookback` already-emitted rows stay as context.
+        Peak HBM = one range + (lookback+lookahead) rows."""
+        from spark_rapids_tpu.columnar.table import concat_device
+        from spark_rapids_tpu.execs.sort import TpuSortExec, sorted_run_stream
+        from spark_rapids_tpu.plan.nodes import SortOrder
+        from spark_rapids_tpu.runtime.retry import retry_block
+
+        spec = self.window_cols[0][1].spec
+        all_orders = ([SortOrder(e, True) for e in spec.partition_exprs]
+                      + list(spec.orders))
+        sorter = TpuSortExec.for_orders(all_orders)
+        from spark_rapids_tpu.runtime.spill import BufferCatalog, SpillableBatch
+        catalog = BufferCatalog.get()
+        # queued inputs stay SPILLABLE while each sorts (the sort exec's
+        # _ooc_stream pattern): an OOM mid-sort demotes a queued batch
+        spillables = [SpillableBatch(b, catalog) for b in batches]
+        runs = []
+        try:
+            while spillables:
+                sb = spillables.pop(0)
+                try:
+                    with sb.pinned_batch() as dt:
+                        runs.append(retry_block(
+                            lambda d=dt: sorter._sort(d)).to_host())
+                finally:
+                    sb.release()
+        finally:
+            for sb in spillables:
+                sb.release()
+        if not runs:
+            return
+        keep = lookback + lookahead
+        carry_sb = None    # last `keep`+ rows (SPILLABLE context — an OOM
+        # mid-stream can demote it and replay)
+        c_n = 0
+        unemitted = 0      # trailing carry rows still awaiting lookahead
+        try:
+            for dt in sorted_run_stream(
+                    runs, all_orders,
+                    target_rows=self.stream_target_rows or None):
+                self.add_metric("boundedWindowBatches", 1)
+                b_n = dt.num_rows
+                if carry_sb is not None:
+                    ext = retry_block(lambda d=dt: concat_device(
+                        [carry_sb.get(), d]))
+                    ext = DeviceTable(ext.names, ext.columns, c_n + b_n,
+                                      ext.capacity)
+                else:
+                    ext = dt
+                ext_n = c_n + b_n
+                emit_start = c_n - unemitted
+                emit_end = max(ext_n - lookahead, emit_start)
+                if emit_end > emit_start:
+                    out = retry_block(lambda e=ext: self._window(e))
+                    yield _slice_rows(out, emit_start, emit_end)
+                unemitted = ext_n - emit_end
+                cstart = max(0, ext_n - max(keep, unemitted))
+                new_carry = retry_block(
+                    lambda e=ext, a=cstart, b=ext_n: _slice_rows(e, a, b))
+                if carry_sb is not None:
+                    carry_sb.release()
+                carry_sb = SpillableBatch(new_carry, catalog)
+                c_n = ext_n - cstart
+            if unemitted:
+                # final rows: no further input, frames clamp at the end
+                out = retry_block(
+                    lambda: self._window(carry_sb.get()))
+                yield _slice_rows(out, c_n - unemitted, c_n)
+        finally:
+            if carry_sb is not None:
+                carry_sb.release()
 
     def _stream_batch(self, table: DeviceTable, state):
         """One sorted batch through the running-window kernel with carried

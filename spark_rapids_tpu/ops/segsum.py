@@ -52,6 +52,13 @@ ATOL = 1e-12
 MAX_PARTIALS = 1 << 22
 
 
+def trace_key():
+    """Tuning values that change the shape of a traced kernel — any
+    trace cache keyed on split-sum behavior must include this (a cached
+    trace would silently keep a superseded conf value otherwise)."""
+    return (BLOCK, MAX_PARTIALS, MATMUL_MAX_SEGMENTS, float(SPLIT_MAX_ABS))
+
+
 def split_f64_hi_lo(x):
     """EXACT hi/lo f32 decomposition of a device f64 array (TPU f64 storage
     is an (f32, f32) pair, so x == hi + lo exactly). Non-finite hi (inf from

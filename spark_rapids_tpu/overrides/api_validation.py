@@ -1,0 +1,116 @@
+"""API-drift validation.
+
+Reference: ``api_validation/.../ApiValidation.scala:26`` — the reference
+walks every Gpu* exec and compares its constructor signature against the
+Spark exec it replaces, printing drift so a Spark upgrade can't silently
+orphan a GPU operator.
+
+TPU mapping: the plan layer and the exec layer evolve independently
+here too (plan nodes in ``plan/``, device execs in ``execs/``, glued by
+the convert functions in ``overrides/rules.py``). ``validate_api()``
+audits, for every registered rule, the things that actually drift:
+
+* the plan node exposes the required PlanNode surface
+  (``output_schema``, ``children``) and the exec the required TpuExec
+  surface (``execute``, ``output_schema``);
+* the rule's convert function signature accepts (node, children, conf);
+* expression rules expose the Expression contract
+  (``with_children``, ``key``, ``eval_cpu``, ``data_type``) so plan
+  rewrites and trace caching can rely on them.
+
+Returns a list of human-readable drift findings (empty = in sync); the
+test suite asserts emptiness, the CLI prints them."""
+
+from __future__ import annotations
+
+import inspect
+from typing import List
+
+
+def _check_signature(fn, name: str, findings: List[str]) -> None:
+    try:
+        sig = inspect.signature(fn)
+    except (TypeError, ValueError):
+        return
+    params = [p for p in sig.parameters.values()
+              if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)]
+    accepts_var = any(p.kind == p.VAR_POSITIONAL
+                      for p in sig.parameters.values())
+    if not accepts_var and len(params) < 3:
+        findings.append(
+            f"{name}: convert function takes {len(params)} positional "
+            "params, needs (node, children, conf)")
+
+
+def validate_api() -> List[str]:
+    from spark_rapids_tpu.execs.base import TpuExec
+    from spark_rapids_tpu.ops.expr import Expression
+    from spark_rapids_tpu.overrides import rules as R
+    from spark_rapids_tpu.plan.nodes import PlanNode
+
+    R._build_expr_sigs()
+    findings: List[str] = []
+
+    for node_cls, rule in R._EXEC_RULES.items():
+        where = f"exec rule {node_cls.__name__}"
+        if not issubclass(node_cls, PlanNode):
+            findings.append(f"{where}: key is not a PlanNode subclass")
+            continue
+        for attr in ("output_schema",):
+            if not callable(getattr(node_cls, attr, None)):
+                findings.append(f"{where}: plan node lacks {attr}()")
+        _check_signature(rule.convert_fn, where, findings)
+
+    for cls in R._EXPR_SIGS:
+        where = f"expression rule {cls.__name__}"
+        if not issubclass(cls, Expression):
+            findings.append(f"{where}: not an Expression subclass")
+            continue
+        for attr in ("with_children", "key", "eval_cpu"):
+            impl = getattr(cls, attr, None)
+            base = getattr(Expression, attr, None)
+            if impl is None:
+                findings.append(f"{where}: lacks {attr}")
+            elif impl is base and attr in ("with_children", "key"):
+                # leaf expressions legitimately inherit; only flag
+                # multi-child classes that never override with_children
+                init = inspect.signature(cls.__init__)
+                n_params = len(init.parameters) - 1
+                if attr == "with_children" and n_params >= 1 \
+                        and getattr(base, "__isabstractmethod__", False):
+                    findings.append(f"{where}: inherits abstract {attr}")
+        if "data_type" not in dir(cls):
+            findings.append(f"{where}: lacks data_type")
+
+    # every TpuExec subclass reachable from the registry implements the
+    # exec surface
+    seen = set()
+
+    def audit_exec_cls(ecls):
+        if not (isinstance(ecls, type) and issubclass(ecls, TpuExec)) \
+                or ecls in seen:
+            return
+        seen.add(ecls)
+        if not callable(getattr(ecls, "execute", None)):
+            findings.append(f"exec {ecls.__name__}: lacks execute()")
+        if not callable(getattr(ecls, "output_schema", None)):
+            findings.append(f"exec {ecls.__name__}: lacks output_schema()")
+
+    import spark_rapids_tpu.execs as execs_pkg
+    for attr in dir(execs_pkg):
+        audit_exec_cls(getattr(execs_pkg, attr))
+    return findings
+
+
+def main() -> int:
+    findings = validate_api()
+    if not findings:
+        print("api_validation: no drift")
+        return 0
+    for f in findings:
+        print("DRIFT:", f)
+    return 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

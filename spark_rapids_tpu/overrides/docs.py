@@ -91,6 +91,16 @@ def generate_supported_ops() -> str:
         note = ""
         if getattr(cls, "device_supported", True) is False:
             note = "CPU-path expression (no device kernel)"
-        lines.append(_matrix_row(cls.__name__, sig, note))
+        # per-PARAM rows where input checks exist (ExprChecks analog —
+        # `Acos / param 0 / STRING` reads NS even though the result row
+        # is always DOUBLE)
+        from spark_rapids_tpu.overrides.typesig import lookup_mro
+        checks = lookup_mro(R._EXPR_CHECKS, cls)
+        if checks is None:
+            lines.append(_matrix_row(cls.__name__, sig, note))
+            continue
+        lines.append(_matrix_row(f"{cls.__name__} / result", sig, note))
+        for label, psig in checks.doc_param_rows():
+            lines.append(_matrix_row(f"{cls.__name__} / {label}", psig))
     lines.append("")
     return "\n".join(lines)

@@ -100,6 +100,44 @@ class MapFixedSig(TypeSig):
 STRUCT_FIXED = StructFixedSig()
 MAP_FIXED = MapFixedSig()
 
+class ExprChecks:
+    """Per-PARAMETER input signatures + an output signature for one
+    expression rule (reference: ExprChecks in TypeChecks.scala — the
+    per-param matrix is what keeps `Acos | STRING` honest: the OUTPUT of
+    Acos is always DOUBLE, so only an input-position check can reject a
+    string argument).
+
+    ``param_sigs``: leading per-child signatures; children beyond them
+    check against ``rest`` (None = no check, COMMON-equivalent docs).
+    Output types stay in the _EXPR_SIGS registry — one source of truth."""
+
+    def __init__(self, param_sigs: Iterable[TypeSig] = (),
+                 rest: TypeSig = None):
+        self.param_sigs = tuple(param_sigs)
+        self.rest = rest
+
+    def param_sig(self, i: int):
+        if i < len(self.param_sigs):
+            return self.param_sigs[i]
+        return self.rest
+
+    def doc_param_rows(self):
+        """(label, sig) rows for the generated matrix."""
+        rows = [(f"param {i}", s) for i, s in enumerate(self.param_sigs)]
+        if self.rest is not None:
+            rows.append(("param *", self.rest))
+        return rows
+
+
+def lookup_mro(registry: dict, cls: type):
+    """First MRO hit in a class-keyed registry (shared by fallback
+    checking and doc generation so lookup semantics can't diverge)."""
+    for klass in cls.__mro__:
+        if klass in registry:
+            return registry[klass]
+    return None
+
+
 #: scalar COMMON plus fixed-element arrays — the surface Scan/Project/
 #: Generate handle on device (other execs keep COMMON: their kernels
 #: compact/gather/sort flat buffers only)

@@ -252,6 +252,11 @@ class TpuSession:
         DeviceTable.EMBED_NROWS_CAP = int(get(C.COLLECT_EMBED_ROWS_CAP))
         DeviceTable.EMBED_MAX_BYTES = int(get(C.COLLECT_EMBED_MAX_BYTES))
         B.PAIR_BUDGET = int(get(C.NLJ_PAIR_BUDGET))
+        from spark_rapids_tpu.ops import segsum as SS
+        SS.BLOCK = int(get(C.SEGSUM_BLOCK_ROWS))
+        SS.MAX_PARTIALS = int(get(C.SEGSUM_MAX_PARTIALS))
+        SS.MATMUL_MAX_SEGMENTS = int(get(C.SEGSUM_MATMUL_MAX_SEGMENTS))
+        SS.SPLIT_MAX_ABS = float(get(C.SPLIT_SUM_MAX_ABS))
 
     def execute_cpu_only(self, plan: P.PlanNode) -> HostTable:
         """Run fully on the CPU path (the oracle)."""

@@ -216,3 +216,155 @@ def test_empty_edge_frames_are_null(session, cpu_session):
             ahead=F.sum("v").over(W_KO().rows_between(5, 7)),
             tcnt=F.count("v").over(W_KO().rows_between(None, -2)))
     assert_tpu_and_cpu_are_equal(build, session, cpu_session)
+
+
+# -- batched bounded-frame streaming (GpuBatchedBoundedWindowExec analog) ----
+
+@pytest.mark.parametrize("lo,hi", [(-2, 2), (-3, 0), (0, 3), (-1, 1)],
+                         ids=["pm2", "m3_0", "0_p3", "pm1"])
+def test_bounded_streaming_multibatch(session, cpu_session, lo, hi):
+    """Finite rows frames over a MULTI-batch input stream with carried
+    context (no whole-input device concat)."""
+    host = _t(1200, seed=11)
+    w = W_KO().rows_between(lo, hi)
+    assert_tpu_and_cpu_are_equal(
+        lambda s: s.create_dataframe(host, num_batches=5).with_windows(
+            bs=F.sum("v").over(w), bc=F.count("v").over(w),
+            bm=F.max("o").over(w), ba=F.avg("d").over(w)),
+        session, cpu_session, approximate_float=True)
+
+
+def test_bounded_streaming_takes_streaming_path(session):
+    """The exec reports per-range streaming batches — the whole-input
+    concat path must NOT be taken for finite-rows frames."""
+    host = _t(900, seed=3)
+    w = W_KO().rows_between(-2, 1)
+    df = session.create_dataframe(host, num_batches=4).with_windows(
+        bs=F.sum("v").over(w))
+    df.collect_table()
+    m = session.last_metrics()
+    assert "boundedWindowBatches" in m, m
+
+
+def test_bounded_streaming_partitionless(session, cpu_session):
+    """No partition_by: frames cross the whole sorted stream, so carried
+    context must span range boundaries correctly."""
+    host = _t(800, seed=13)
+    w = Window.order_by("o").rows_between(-3, 2)
+    assert_tpu_and_cpu_are_equal(
+        lambda s: s.create_dataframe(host, num_batches=4).with_windows(
+            bs=F.sum("v").over(w), bc=F.count("v").over(w)),
+        session, cpu_session, approximate_float=True)
+
+
+def test_bounded_streaming_with_injected_oom(cpu_session):
+    """Streaming bounded windows survive injected device OOM (retry
+    framework) without materializing the whole input."""
+    from spark_rapids_tpu.session import TpuSession
+    host = _t(600, seed=17)
+    w = W_KO().rows_between(-2, 2)
+    s = TpuSession({"spark.rapids.sql.test.injectRetryOOM": "retry:2"})
+    got = sorted(
+        s.create_dataframe(host, num_batches=3).with_windows(
+            bs=F.sum("v").over(w)).collect(), key=repr)
+    want = sorted(
+        cpu_session.create_dataframe(host).with_windows(
+            bs=F.sum("v").over(w)).collect(), key=repr)
+    assert got == want
+
+
+# -- cached double-pass (GpuCachedDoublePassWindowExec analog) ---------------
+
+def test_two_pass_whole_partition_aggs_multibatch(session, cpu_session):
+    """UNBOUNDED..UNBOUNDED partitioned agg windows over a multi-batch
+    input take the double-pass (aggregate + join-back) path. The avg
+    column uses BOUNDED doubles: corner-value doubles (±1e30) make float
+    sums order-dependent, which is inherent float variance (Spark's
+    variableFloatAgg caveat), not a path bug."""
+    host = gen_table(
+        {"k": IntGen(min_val=0, max_val=8, null_prob=0.05),
+         "o": LongGen(min_val=-100, max_val=100),
+         "v": LongGen(min_val=-10**6, max_val=10**6),
+         "d": DoubleGen(corner_prob=0.0)}, 1000, seed=21)
+    w = Window.partition_by("k")
+    assert_tpu_and_cpu_are_equal(
+        lambda s: s.create_dataframe(host, num_batches=4).with_windows(
+            ps=F.sum("v").over(w), pc=F.count("v").over(w),
+            pm=F.min("o").over(w), px=F.max("o").over(w),
+            pa=F.avg("d").over(w)),
+        session, cpu_session, approximate_float=True)
+
+
+def test_two_pass_null_partition_keys(session, cpu_session):
+    """Null partition keys form ONE partition (the join-back must be
+    null-safe)."""
+    import numpy as np
+    import pandas as pd
+    data = {"k": np.array([1.0, np.nan, 2.0, np.nan, 1.0, np.nan]),
+            "v": np.arange(6, dtype=np.int64)}
+
+    def build(s):
+        pdf = pd.DataFrame({"k": data["k"], "v": data["v"]})
+        return s.create_dataframe(pdf).with_windows(
+            ps=F.sum("v").over(Window.partition_by("k")))
+
+    got = sorted(build(session).collect(), key=repr)
+    want = sorted(build(cpu_session).collect(), key=repr)
+    assert got == want
+    # null rows: v = 1+3+5 = 9
+    nulls = [r for r in got if r[0] is None]
+    assert len(nulls) == 3 and all(r[2] == 9 for r in nulls)
+
+
+def test_two_pass_null_keys_multibatch(session, cpu_session):
+    import numpy as np
+    rng = np.random.default_rng(5)
+    k = rng.integers(0, 6, 400).astype(np.float64)
+    k[rng.random(400) < 0.2] = np.nan
+    data = {"k": k, "v": rng.integers(-50, 50, 400)}
+
+    def build(s):
+        import pandas as pd
+        return s.create_dataframe(
+            pd.DataFrame(data), num_batches=3).with_windows(
+            ps=F.sum("v").over(Window.partition_by("k")),
+            pc=F.count("v").over(Window.partition_by("k")))
+
+    got = sorted(build(session).collect(), key=repr)
+    want = sorted(build(cpu_session).collect(), key=repr)
+    assert got == want
+
+
+def test_two_pass_takes_double_pass_path(session):
+    host = _t(800, seed=23)
+    df = session.create_dataframe(host, num_batches=3).with_windows(
+        ps=F.sum("v").over(Window.partition_by("k")))
+    df.collect_table()
+    m = session.last_metrics()
+    assert "twoPassPartitions" in m, m
+
+
+def test_two_pass_with_injected_oom(cpu_session):
+    from spark_rapids_tpu.session import TpuSession
+    host = _t(600, seed=29)
+    s = TpuSession({"spark.rapids.sql.test.injectRetryOOM": "retry:2"})
+    w = Window.partition_by("k")
+    got = sorted(
+        s.create_dataframe(host, num_batches=3).with_windows(
+            ps=F.sum("v").over(w)).collect(), key=repr)
+    want = sorted(
+        cpu_session.create_dataframe(host).with_windows(
+            ps=F.sum("v").over(w)).collect(), key=repr)
+    assert got == want
+
+
+def test_bounded_frame_no_keys_concat_fallback(session, cpu_session):
+    """Finite rows frame with NO partition_by and NO order_by must take
+    the concat fallback, not crash in run sorting (review fix)."""
+    from spark_rapids_tpu.ops.window import WindowSpec
+    host = _t(300, seed=31)
+    w = WindowSpec().rows_between(-2, 2)
+    assert_tpu_and_cpu_are_equal(
+        lambda s: s.create_dataframe(host, num_batches=3).with_windows(
+            bs=F.count("v").over(w)),
+        session, cpu_session, approximate_float=True)
