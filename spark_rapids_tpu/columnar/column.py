@@ -83,6 +83,10 @@ class HostColumn:
         elif isinstance(dtype, T.StringType):
             data = np.empty(len(values), dtype=object)
             data[:] = [v if v is not None else None for v in values]
+        elif T.is_dec128(dtype):
+            # unscaled values beyond int64: python-int object storage
+            data = np.empty(len(values), dtype=object)
+            data[:] = [int(v) if v is not None else 0 for v in values]
         else:
             np_dtype = dtype.np_dtype
             fill = np.zeros((), dtype=np_dtype).item()
@@ -314,6 +318,10 @@ class DeviceColumn:
                                 jnp.asarray(validity))
         validity = np.zeros(cap, dtype=np.bool_)
         validity[:n] = host.validity
+        if T.is_dec128(host.dtype):
+            limbs = dec128_limbs(host.data, host.validity, cap)
+            return DeviceColumn(host.dtype, jnp.asarray(limbs),
+                                jnp.asarray(validity))
         if isinstance(host.dtype, T.StringType):
             codes, dictionary = DeviceColumn._encode_strings(host)
             data = np.zeros(cap, dtype=np.int32)
@@ -363,6 +371,10 @@ class DeviceColumn:
     def decode_host(self, data: np.ndarray, validity: np.ndarray) -> HostColumn:
         """Build the logical HostColumn from downloaded raw arrays (shared
         by the per-column path above and DeviceTable's packed to_host)."""
+        if T.is_dec128(self.dtype):
+            return HostColumn(self.dtype,
+                              dec128_unscaled(np.asarray(data), validity),
+                              validity)
         if isinstance(self.dtype, T.StringType):
             if self.dictionary is None:
                 raise ColumnarProcessingError("string column missing dictionary")
@@ -401,6 +413,47 @@ class DeviceColumn:
         return self.with_arrays(self.data[:k], self.validity[:k])
 
 
+_MASK64 = (1 << 64) - 1
+
+
+def dec128_limbs(values, validity, cap: int) -> np.ndarray:
+    """Python-int unscaled values -> (cap, 2) int64 two-limb storage:
+    [:, 0] = signed high 64 bits, [:, 1] = unsigned low 64 bits
+    reinterpreted as int64 (the DECIMAL128 device layout). Vectorized
+    over object arrays — this runs per upload AND per shuffle batch."""
+    n = len(values)
+    out = np.zeros((cap, 2), dtype=np.int64)
+    if n == 0:
+        return out
+    v = np.where(np.asarray(validity[:n], dtype=bool),
+                 np.asarray(values[:n], dtype=object), 0)
+    lo = v & _MASK64
+    lo = np.where(lo >= (1 << 63), lo - (1 << 64), lo)
+    out[:n, 0] = (v >> 64).astype(np.int64)
+    out[:n, 1] = lo.astype(np.int64)
+    return out
+
+
+def dec128_unscaled(limbs: np.ndarray, validity) -> np.ndarray:
+    """(n, 2) int64 limbs -> python-int unscaled object array."""
+    n = len(limbs)
+    out = np.empty(n, dtype=object)
+    if n == 0:
+        return out
+    vals = ((limbs[:, 0].astype(object) << 64)
+            | (limbs[:, 1].astype(object) & _MASK64))
+    out[:] = np.where(np.asarray(validity[:n], dtype=bool), vals, 0)
+    return out
+
+
+def null_data_array(dt: T.DataType, capacity: int):
+    """All-null device data of the right SHAPE for ``dt`` — dec128
+    columns are (capacity, 2) limb matrices (outer-join null sides)."""
+    if T.is_dec128(dt):
+        return jnp.zeros((capacity, 2), dtype=jnp.int64)
+    return jnp.zeros(capacity, dtype=dt.np_dtype)
+
+
 def stage_upload(host: HostColumn, cap: int, split_f64: bool):
     """Host side of the fast H2D path: turn one column into (recipe, staged
     numpy arrays, dictionary). The tunneled TPU transfers raw f32/i64/u32/i8
@@ -435,6 +488,11 @@ def stage_upload(host: HostColumn, cap: int, split_f64: bool):
             padded = np.zeros(cap, dtype=np.int32)
             padded[:n] = codes
             kind, arrays = "u32", [padded.view(np.uint32)]
+    elif T.is_dec128(host.dtype):
+        limbs = dec128_limbs(host.data, host.validity, cap)
+        dictionary = None
+        kind, arrays = "dec128", [np.ascontiguousarray(limbs[:, 0]),
+                                  np.ascontiguousarray(limbs[:, 1])]
     else:
         np_dtype = host.dtype.np_dtype
         dictionary = None

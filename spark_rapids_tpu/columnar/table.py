@@ -47,6 +47,9 @@ def _get_assemble(recipes: tuple, cap: int):
                     data = jnp.where((h64 == 0.0) & (l64 == 0.0), h64,
                                      h64 + l64)
                     i += 2
+                elif kind == "dec128":
+                    data = jnp.stack([arrays[i], arrays[i + 1]], axis=1)
+                    i += 2
                 elif kind in ("u32", "u8codes", "u16codes"):
                     data = arrays[i].astype(jnp.int32)
                     i += 1
@@ -165,6 +168,10 @@ def evict_device_caches() -> int:
 
 def _pack_kind(c: DeviceColumn) -> str:
     dt = c.data.dtype
+    if getattr(c.data, "ndim", 1) == 2:
+        if dt == jnp.int64:
+            return "dec128"
+        raise ColumnarProcessingError(f"unpackable 2-D device dtype {dt}")
     for kind, want in (("f64", jnp.float64), ("i64", jnp.int64),
                        ("i32", jnp.int32), ("f32", jnp.float32),
                        ("i16", jnp.int16), ("i8", jnp.int8),
@@ -175,7 +182,7 @@ def _pack_kind(c: DeviceColumn) -> str:
 
 
 def _u32_units(kind: str) -> int:
-    return {"f64": 2, "i64": 2, "i32": 1, "f32": 1}.get(kind, 0)
+    return {"f64": 2, "i64": 2, "dec128": 4, "i32": 1, "f32": 1}.get(kind, 0)
 
 
 def _get_pack(kinds: tuple, k: int, cap: int, n_extra: int = 0):
@@ -209,6 +216,11 @@ def _get_pack(kinds: tuple, k: int, cap: int, n_extra: int = 0):
                     lo = (d & 0xFFFFFFFF).astype(jnp.uint32)
                     u32s.append(jax.lax.bitcast_convert_type(hi, jnp.uint32))
                     u32s.append(lo)
+                elif kind == "dec128":
+                    for limb in (d[:, 0], d[:, 1]):
+                        u32s.append(jax.lax.bitcast_convert_type(
+                            (limb >> 32).astype(jnp.int32), jnp.uint32))
+                        u32s.append((limb & 0xFFFFFFFF).astype(jnp.uint32))
                 elif kind in ("i32", "f32"):
                     u32s.append(jax.lax.bitcast_convert_type(d, jnp.uint32))
                 elif kind == "i16":
@@ -266,6 +278,15 @@ def _unpack_host(buf: np.ndarray, kinds: tuple, k: int, n_extra: int = 0):
             lo = u32part[o32:o32 + k].astype(np.int64)
             o32 += k
             data = (hi << 32) | lo
+        elif kind == "dec128":
+            limbs = []
+            for _limb in range(2):
+                hi = u32part[o32:o32 + k].view(np.int32).astype(np.int64)
+                o32 += k
+                lo = u32part[o32:o32 + k].astype(np.int64)
+                o32 += k
+                limbs.append((hi << 32) | lo)
+            data = np.stack(limbs, axis=1)
         elif kind == "i32":
             data = u32part[o32:o32 + k].view(np.int32)
             o32 += k
@@ -361,7 +382,8 @@ def concat_device(tables: Sequence["DeviceTable"]) -> "DeviceTable":
                     if rm is not None:
                         data = rm[jnp.clip(data, 0, rm.shape[0] - 1)]
                     if od is None:
-                        od = jnp.zeros(out_cap, dtype=data.dtype)
+                        od = jnp.zeros((out_cap,) + data.shape[1:],
+                                       dtype=data.dtype)
                     n = nrows_list[ti]
                     if lives[ti] is not None:
                         # masked input: its deferred compaction fuses into

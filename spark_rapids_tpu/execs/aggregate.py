@@ -69,9 +69,9 @@ def _sortable(data, validity):
     ordering decomposition canonicalizes floats (-0.0 == 0.0, one NaN
     pattern — Spark NormalizeFloatingNumbers groups NaNs together) and
     keeps every compare at <=32 bits (ops/ordering.py)."""
-    from spark_rapids_tpu.ops.ordering import comparable_operands
-    zeroed = jnp.where(validity, data, jnp.zeros_like(data))
-    return [(~validity).astype(jnp.int32)] + comparable_operands(zeroed)
+    from spark_rapids_tpu.ops.ordering import comparable_operands, zero_invalid
+    return ([(~validity).astype(jnp.int32)]
+            + comparable_operands(zero_invalid(data, validity)))
 
 
 class TpuHashAggregateExec(TpuExec):

@@ -315,7 +315,7 @@ class TpuNestedLoopJoinExec(TpuExec):
                 probe_out.append(scatter_pair(cap_p, utgt, d, v))
             null_build = []
             for d, v in (lcols if swapped else rcols):
-                zd = jnp.zeros(cap_p, dtype=d.dtype)
+                zd = jnp.zeros((cap_p,) + d.shape[1:], dtype=d.dtype)
                 null_build.append((zd, jnp.zeros(cap_p, jnp.bool_)))
             if swapped:
                 un_out = tuple(null_build) + tuple(probe_out)
@@ -346,8 +346,9 @@ class TpuNestedLoopJoinExec(TpuExec):
                     dt, data, jnp.zeros(bt.capacity, jnp.bool_),
                     dictionary=np.array([], dtype=object)))
             else:
+                from spark_rapids_tpu.columnar.column import null_data_array
                 null_cols.append(DeviceColumn(
-                    dt, jnp.zeros(bt.capacity, dtype=dt.np_dtype),
+                    dt, null_data_array(dt, bt.capacity),
                     jnp.zeros(bt.capacity, jnp.bool_)))
         names = self.left_names + self.right_names
         cols = (build_cols + null_cols) if swapped else (null_cols + build_cols)

@@ -32,6 +32,26 @@ from spark_rapids_tpu.shuffle.partitioning import (
 )
 
 
+def _pad_capacity(table: DeviceTable, new_cap: int) -> DeviceTable:
+    """Extend every column with dead tail rows to ``new_cap`` (flat
+    columns only — the ICI exchange's equal-shard requirement for
+    non-pow2 partition counts)."""
+    import jax.numpy as jnp
+
+    extra = new_cap - table.capacity
+
+    def pad(arr):
+        # zeros of a bool dtype are False, so validity/live tails are dead
+        tail = jnp.zeros((extra,) + arr.shape[1:], dtype=arr.dtype)
+        return jnp.concatenate([arr, tail])
+
+    cols = [c.with_arrays(pad(c.data), pad(c.validity))
+            for c in table.columns]
+    live = pad(table.live) if table.live is not None else None
+    return DeviceTable(table.names, cols, table.nrows_dev, new_cap,
+                       live=live)
+
+
 def make_partitioner(mode: str, keys: Sequence[Expression],
                      num_partitions: int) -> Partitioner:
     mode = mode.lower()
@@ -78,9 +98,16 @@ class TpuShuffleExchangeExec(TpuExec):
         import jax
         from spark_rapids_tpu.conf import SHUFFLE_MANAGER_MODE
         mode = str(self.conf.get_entry(SHUFFLE_MANAGER_MODE)).upper()
+        # non-pow2 partition counts pad the row capacity up to a
+        # multiple of the mesh size (_pad_capacity) — no pow2 gate.
+        # DECIMAL128 payload columns take the host shuffle: MeshExchange's
+        # collective kernels scatter 1-D column arrays only (the host
+        # serializer has a two-limb branch)
+        from spark_rapids_tpu import types as T
         return (mode == "ICI" and self.mode == "hash"
                 and 1 < self.num_partitions <= len(jax.devices())
-                and (self.num_partitions & (self.num_partitions - 1)) == 0)
+                and not any(T.is_dec128(dt)
+                            for _, dt in self.output_schema()))
 
     #: masked batches share the input buffers, but every downstream
     #: kernel still runs at full input capacity PER partition — beyond
@@ -209,10 +236,11 @@ class TpuShuffleExchangeExec(TpuExec):
             if len(batches) > 1 else batches[0]
         ndev = self.num_partitions
         if table.capacity % ndev != 0:
-            # pow2 capacities and pow2 ndev: only tiny tables (< ndev rows
-            # per shard) miss this; fall back for them
-            yield from self._execute_host_shuffle(prefetched=[table])
-            return
+            # non-pow2 partition counts (or tiny tables): pad the row
+            # capacity up to a multiple of ndev with dead rows — every
+            # column extends with zero/False tails, so the collective's
+            # equal per-device shards always exist
+            table = _pad_capacity(table, -(-table.capacity // ndev) * ndev)
 
         key_cols = compile_project(self.keys, table)
         string_bytes = {}

@@ -749,9 +749,10 @@ class TpuJoinExec(TpuExec):
                     dt, data, jnp.zeros(compacted.capacity, jnp.bool_),
                     dictionary=np.array([], dtype=object)))
             else:
-                data = jnp.zeros(compacted.capacity, dtype=dt.np_dtype)
+                from spark_rapids_tpu.columnar.column import null_data_array
                 null_cols.append(DeviceColumn(
-                    dt, data, jnp.zeros(compacted.capacity, jnp.bool_)))
+                    dt, null_data_array(dt, compacted.capacity),
+                    jnp.zeros(compacted.capacity, jnp.bool_)))
         names = self.left_names + self.right_names
         cols = (list(compacted.columns) + null_cols if swapped
                 else null_cols + list(compacted.columns))

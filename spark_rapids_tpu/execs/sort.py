@@ -40,9 +40,9 @@ def _directional(data, validity, ascending: bool, nulls_first: bool, capacity: i
     from spark_rapids_tpu.ops.ordering import (
         comparable_operands,
         descending_operands,
+        zero_invalid,
     )
-    zeroed = jnp.where(validity, data, jnp.zeros_like(data))
-    ops = comparable_operands(zeroed)
+    ops = comparable_operands(zero_invalid(data, validity))
     if not ascending:
         ops = descending_operands(ops)
     # null flag sorts ahead of the key: 0 sorts first, so invalid rows get 0

@@ -612,10 +612,10 @@ class TpuWindowExec(TpuExec):
                 kv = eval_tree(o.expr, preps)
                 # canonical operands: NaNs are peers, -0.0 == 0.0 (the
                 # batch kernel's _peer_eq_break invariant)
-                zeroed = jnp.where(kv.validity, kv.data,
-                                   jnp.zeros_like(kv.data))
+                from spark_rapids_tpu.ops.ordering import zero_invalid
                 peer_ops.append((~kv.validity).astype(jnp.int32))
-                peer_ops.extend(comparable_operands(zeroed))
+                peer_ops.extend(comparable_operands(
+                    zero_invalid(kv.data, kv.validity)))
             first = jnp.arange(capacity) == 0
             new_peer = first
             for o in peer_ops:
@@ -856,6 +856,9 @@ class TpuWindowExec(TpuExec):
                         dp, vpv = jnp.roll(d, 1), jnp.roll(v, 1)
                         np_mask = jnp.roll(nan_mask, 1)
                         diff = (d != dp) | (nan_mask != np_mask)
+                    elif getattr(d, "ndim", 1) == 2:  # dec128 limbs
+                        dp, vpv = jnp.roll(d, 1, axis=0), jnp.roll(v, 1)
+                        diff = jnp.any(d != dp, axis=1)
                     else:
                         dp, vpv = jnp.roll(d, 1), jnp.roll(v, 1)
                         diff = d != dp
@@ -894,13 +897,12 @@ class TpuWindowExec(TpuExec):
 
     @staticmethod
     def _sortable(kv):
-        d = kv.data
-        if jnp.issubdtype(d.dtype, jnp.floating):
-            d = jnp.where(d == 0.0, jnp.zeros_like(d), d)
-        if d.dtype == jnp.bool_:
-            d = d.astype(jnp.int32)
-        return [(~kv.validity).astype(jnp.int32),
-                jnp.where(kv.validity, d, jnp.zeros_like(d))]
+        from spark_rapids_tpu.ops.ordering import (
+            comparable_operands,
+            zero_invalid,
+        )
+        return ([(~kv.validity).astype(jnp.int32)]
+                + comparable_operands(zero_invalid(kv.data, kv.validity)))
 
     @staticmethod
     def _rmq(op, ident, vv, a, b, width: int, capacity: int):
@@ -1288,10 +1290,10 @@ class TpuWindowGroupLimitExec(TpuExec):
             part_ops = []
             for e, preps in zip(part_exprs, pp):
                 kv = eval_tree(e, preps)
-                zeroed = jnp.where(kv.validity, kv.data,
-                                   jnp.zeros_like(kv.data))
+                from spark_rapids_tpu.ops.ordering import zero_invalid
                 part_ops.append((~kv.validity).astype(jnp.int32))
-                part_ops.extend(comparable_operands(zeroed))
+                part_ops.extend(comparable_operands(
+                    zero_invalid(kv.data, kv.validity)))
             operands.extend(part_ops)
             n_part_ops = len(part_ops)
             order_ops = []

@@ -40,10 +40,7 @@ def arrow_type_to_spark(at: pa.DataType) -> T.DataType:
     if pa.types.is_timestamp(at):
         return T.TIMESTAMP
     if pa.types.is_decimal(at):
-        if at.precision <= T.DecimalType.MAX_LONG_DIGITS:
-            return T.DecimalType(at.precision, at.scale)
-        raise ColumnarProcessingError(
-            f"decimal precision {at.precision} > 18 not yet supported on device")
+        return T.DecimalType(at.precision, at.scale)
     if pa.types.is_null(at):
         return T.NULL
     if pa.types.is_dictionary(at):
@@ -111,9 +108,19 @@ def arrow_array_to_host_column(arr, dt: T.DataType) -> HostColumn:
         vals = np.asarray(arr.fill_null(0)).astype("datetime64[D]").astype(np.int32)
         return HostColumn(dt, vals, validity)
     if isinstance(dt, T.DecimalType):
+        import decimal as _dec
+        # default context precision (28) silently ROUNDS 38-digit
+        # decimals; widen it for the exact unscaled conversion
+        ctx = _dec.Context(prec=T.DecimalType.MAX_PRECISION + 10)
+        scaled = [int(v.scaleb(dt.scale, context=ctx)) if v is not None
+                  else 0 for v in arr.to_pylist()]
+        if T.is_dec128(dt):
+            # unscaled beyond int64: python-int object storage (two-limb
+            # device columns — columnar/column.py dec128_limbs)
+            data = np.empty(n, dtype=object)
+            data[:] = scaled
+            return HostColumn(dt, data, validity)
         # int64 unscaled value, exact for p<=18
-        scaled = [int(v.scaleb(dt.scale)) if v is not None else 0
-                  for v in arr.to_pylist()]
         return HostColumn(dt, np.array(scaled, dtype=np.int64), validity)
     if isinstance(dt, T.NullType):
         return HostColumn(dt, np.zeros(n, dtype=np.int8), np.zeros(n, dtype=np.bool_))
@@ -176,8 +183,10 @@ def host_column_to_arrow(col: HostColumn) -> pa.Array:
         return pa.array(col.data.astype("datetime64[D]"), mask=mask, type=pa.date32())
     if isinstance(dt, T.DecimalType):
         import decimal
+        ctx = decimal.Context(prec=T.DecimalType.MAX_PRECISION + 10)
         q = decimal.Decimal(1).scaleb(-dt.scale)
-        vals = [decimal.Decimal(int(v)).scaleb(-dt.scale).quantize(q) if ok else None
+        vals = [decimal.Decimal(int(v)).scaleb(-dt.scale, context=ctx)
+                .quantize(q, context=ctx) if ok else None
                 for v, ok in zip(col.data, col.validity)]
         return pa.array(vals, type=pa.decimal128(dt.precision, dt.scale))
     if isinstance(dt, T.NullType):

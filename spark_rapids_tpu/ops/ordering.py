@@ -46,11 +46,25 @@ def _canon_float(d):
     return jnp.where(jnp.isnan(d), jnp.full_like(d, jnp.nan), d)
 
 
+def zero_invalid(data, validity):
+    """jnp.where(validity, data, 0) with 2-D (dec128 limb) broadcasting."""
+    v = validity[:, None] if getattr(data, "ndim", 1) == 2 else validity
+    return jnp.where(v, data, jnp.zeros_like(data))
+
+
 def comparable_operands(data) -> List[jax.Array]:
     """Decompose one key column into ascending-order operands. Callers add
     their own null-placement flag operand; invalid slots should be zeroed
-    first (jnp.where(validity, data, 0))."""
+    first (zero_invalid)."""
     d = data
+    if getattr(d, "ndim", 1) == 2 and d.dtype == jnp.int64:
+        # DECIMAL128 two-limb storage: signed high limb orders first,
+        # then the unsigned low limb as two u32 words
+        hi, lo = d[:, 0], d[:, 1]
+        return [(hi >> 32).astype(jnp.int32),
+                (hi & 0xFFFFFFFF).astype(jnp.uint32),
+                ((lo >> 32) & 0xFFFFFFFF).astype(jnp.uint32),
+                (lo & 0xFFFFFFFF).astype(jnp.uint32)]
     if d.dtype == jnp.int64:
         return [(d >> 32).astype(jnp.int32),
                 (d & 0xFFFFFFFF).astype(jnp.uint32)]

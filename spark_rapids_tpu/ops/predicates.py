@@ -55,6 +55,20 @@ def _cpu_cmp_data(left: HostColumn, right: HostColumn, op):
     return op(ld, rd)
 
 
+def _dec128_sign(l, r):
+    """Three-way compare of (n, 2) int64 two-limb decimals: -1/0/+1 as
+    i32. High limbs compare signed; low limbs compare as unsigned via a
+    top-bit flip (no u64 bitcasts — the axon x64 rewrite lacks them)."""
+    top = jnp.int64(-0x8000000000000000)
+    lhi, llo = l[:, 0], l[:, 1] ^ top
+    rhi, rlo = r[:, 0], r[:, 1] ^ top
+    hi_cmp = jnp.where(lhi < rhi, -1, jnp.where(lhi > rhi, 1, 0)
+                       ).astype(jnp.int32)
+    lo_cmp = jnp.where(llo < rlo, -1, jnp.where(llo > rlo, 1, 0)
+                       ).astype(jnp.int32)
+    return jnp.where(hi_cmp != 0, hi_cmp, lo_cmp)
+
+
 class BinaryComparison(BinaryExpression):
     op = None  # numpy/python operator
     jop = None  # jnp operator (same symbol works)
@@ -93,6 +107,10 @@ class BinaryComparison(BinaryExpression):
         validity = null_and(lval.validity, rval.validity)
         if jnp.issubdtype(ld.dtype, jnp.floating):
             data = _spark_float_cmp(type(self).op, ld, rd, jnp)
+        elif getattr(ld, "ndim", 1) == 2:
+            # DECIMAL128 two-limb storage: compare the three-way sign
+            data = type(self).op(_dec128_sign(ld, rd),
+                                 jnp.zeros(ld.shape[0], jnp.int32))
         else:
             data = type(self).op(ld, rd)
         return DevVal(jnp.where(validity, data, False), validity)
@@ -144,6 +162,8 @@ class EqualNullSafe(BinaryComparison):
             ld, rd = lval.data, rval.data
         if jnp.issubdtype(ld.dtype, jnp.floating):
             eq_data = _spark_float_cmp(operator.eq, ld, rd, jnp)
+        elif getattr(ld, "ndim", 1) == 2:  # DECIMAL128 two-limb
+            eq_data = _dec128_sign(ld, rd) == 0
         else:
             eq_data = ld == rd
         both_valid = lval.validity & rval.validity

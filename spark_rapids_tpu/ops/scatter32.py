@@ -27,20 +27,22 @@ def _split_worthwhile(dtype) -> bool:
 
 def scatter_set(out_len: int, tgt, data, mode: str = "drop"):
     """``zeros(out_len, data.dtype).at[tgt].set(data, mode=mode)`` with
-    64-bit payloads scattered as two 32-bit streams."""
+    64-bit payloads scattered as two 32-bit streams. Trailing dims ride
+    along (a DECIMAL128 column is a (rows, 2) int64 limb matrix)."""
+    shape = (out_len,) + data.shape[1:]
     if not _split_worthwhile(data.dtype):
-        return jnp.zeros(out_len, data.dtype).at[tgt].set(data, mode=mode)
+        return jnp.zeros(shape, data.dtype).at[tgt].set(data, mode=mode)
     if data.dtype == jnp.float64:
         from spark_rapids_tpu.ops.segsum import split_f64_hi_lo
         hi, lo = split_f64_hi_lo(data)
-        ohi = jnp.zeros(out_len, jnp.float32).at[tgt].set(hi, mode=mode)
-        olo = jnp.zeros(out_len, jnp.float32).at[tgt].set(lo, mode=mode)
+        ohi = jnp.zeros(shape, jnp.float32).at[tgt].set(hi, mode=mode)
+        olo = jnp.zeros(shape, jnp.float32).at[tgt].set(lo, mode=mode)
         return ohi.astype(jnp.float64) + olo.astype(jnp.float64)
     d = data.astype(jnp.int64)
     hi = (d >> 32).astype(jnp.int32)
     lo = (d & jnp.int64(0xFFFFFFFF)).astype(jnp.uint32)
-    ohi = jnp.zeros(out_len, jnp.int32).at[tgt].set(hi, mode=mode)
-    olo = jnp.zeros(out_len, jnp.uint32).at[tgt].set(lo, mode=mode)
+    ohi = jnp.zeros(shape, jnp.int32).at[tgt].set(hi, mode=mode)
+    olo = jnp.zeros(shape, jnp.uint32).at[tgt].set(lo, mode=mode)
     out = (ohi.astype(jnp.int64) << 32) | olo.astype(jnp.int64)
     return out.astype(data.dtype)
 

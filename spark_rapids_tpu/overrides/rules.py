@@ -31,10 +31,14 @@ from spark_rapids_tpu.ops import aggregates as agg
 from spark_rapids_tpu.ops.expr import Expression
 from spark_rapids_tpu.overrides.typesig import (
     COMMON,
+    COMMON_128,
     COMMON_PLUS_ARRAYS,
     COMMON_PLUS_NESTED,
+    DEC128,
     INTEGRAL,
+    NESTED_128,
     ORDERABLE,
+    AnyOfSig,
     TypeSig,
 )
 from spark_rapids_tpu.plan import nodes as P
@@ -83,9 +87,9 @@ def _build_expr_sigs():
                     and "eval_dev" in {m for kls in obj.__mro__ for m in vars(kls)}
                     and getattr(obj, "eval_dev", None) is not Expression.eval_dev):
                 reg(obj)
-    reg(expr_mod.BoundReference, COMMON_PLUS_NESTED)
+    reg(expr_mod.BoundReference, NESTED_128)
     reg(expr_mod.Literal)
-    reg(expr_mod.Alias, COMMON_PLUS_NESTED)
+    reg(expr_mod.Alias, NESTED_128)
     reg(cast.Cast)
     from spark_rapids_tpu.ops import json_fns
     reg(json_fns.GetJsonObject)
@@ -153,7 +157,7 @@ def _register_param_checks(arithmetic, math, predicates, strings,
     # family bases (MRO lookup extends them to every subclass)
     chk(arithmetic.BinaryArithmetic, NUM_DEC, NUM_DEC)
     chk(math.UnaryMath, NUMERIC)
-    chk(predicates.BinaryComparison, ORDERABLE, ORDERABLE)
+    chk(predicates.BinaryComparison, COMMON_128, COMMON_128)
 
     # arithmetic irregulars
     chk(arithmetic.Abs, NUM_DEC)
@@ -176,8 +180,8 @@ def _register_param_checks(arithmetic, math, predicates, strings,
     chk(predicates.Or, BOOL, BOOL)
     chk(predicates.Not, BOOL)
     chk(predicates.IsNaN, NUMERIC)
-    chk(predicates.IsNull, COMMON_PLUS_NESTED)
-    chk(predicates.IsNotNull, COMMON_PLUS_NESTED)
+    chk(predicates.IsNull, NESTED_128)
+    chk(predicates.IsNotNull, NESTED_128)
     # strings: data params are STRING; positions/lengths are integral
     for name in ("Upper", "Lower", "Length", "InitCap", "Reverse",
                  "Ascii", "BitLength", "OctetLength", "StringTrim",
@@ -280,7 +284,8 @@ def exec_rule(node_cls, tag_fn, convert_fn, doc=""):
     _EXEC_RULES[node_cls] = ExecRule(node_cls, tag_fn, convert_fn, doc)
 
 
-def _check_output_schema(meta: "PlanMeta", conf: RapidsConf, sig=COMMON):
+def _check_output_schema(meta: "PlanMeta", conf: RapidsConf,
+                         sig=COMMON_128):
     for name, dt in meta.node.output_schema():
         r = sig.reason_if_unsupported(dt, f"output column {name}")
         if r:
@@ -290,11 +295,11 @@ def _check_output_schema(meta: "PlanMeta", conf: RapidsConf, sig=COMMON):
 def _tag_scan(meta, conf):
     # scans may carry fixed-element arrays, fixed-field structs and
     # fixed-width maps (device representations in columnar/)
-    _check_output_schema(meta, conf, COMMON_PLUS_NESTED)
+    _check_output_schema(meta, conf, NESTED_128)
 
 
 def _tag_project(meta, conf):
-    _check_output_schema(meta, conf, COMMON_PLUS_NESTED)
+    _check_output_schema(meta, conf, NESTED_128)
     for e in meta.node.exprs:
         check_expr(e, conf, meta.reasons)
 
@@ -324,7 +329,7 @@ def _tag_filter(meta, conf):
 def _tag_aggregate(meta, conf):
     # collect_list/set OUTPUT fixed-element arrays; array-typed grouping
     # keys / other agg inputs stay CPU (flat-buffer kernels)
-    _check_output_schema(meta, conf, COMMON_PLUS_ARRAYS)
+    _check_output_schema(meta, conf, AnyOfSig(COMMON_PLUS_ARRAYS, DEC128))
     node: P.Aggregate = meta.node
     for g in node.grouping:
         check_expr(g, conf, meta.reasons, "grouping key ")
@@ -343,6 +348,14 @@ def _tag_aggregate(meta, conf):
                 meta.reasons.append(
                     f"aggregate {name} over an array input is not "
                     "supported on TPU")
+            if T.is_dec128(fn.child.data_type) and not isinstance(
+                    fn, agg.Count):
+                # two-limb agg kernels (lexicographic min/max, carried
+                # 128-bit sums) are not implemented; keys work, values
+                # fall back (count excepted)
+                meta.reasons.append(
+                    f"aggregate {name} over a decimal(>18) input is not "
+                    "supported on TPU")
 
 
 def _tag_sort(meta, conf):
@@ -350,7 +363,7 @@ def _tag_sort(meta, conf):
     for o in meta.node.orders:
         check_expr(o.expr, conf, meta.reasons, "sort key ")
         dt = o.expr.data_type
-        if not ORDERABLE.supports(dt):
+        if not COMMON_128.supports(dt):
             meta.reasons.append(f"sort key type {dt.simple_string()} not orderable on TPU")
 
 
@@ -531,6 +544,10 @@ def _tag_exchange(meta, conf):
         meta.reasons.append("hash partitioning requires keys")
     for k in node.keys:
         check_expr(k, conf, meta.reasons, "partition key ")
+        if T.is_dec128(k.data_type):
+            meta.reasons.append(
+                "hash partitioning by a decimal(>18) key is not "
+                "supported on TPU (Spark-exact 128-bit murmur3 pending)")
 
 
 def _convert_exchange(node: P.Exchange, children, conf):
@@ -738,6 +755,12 @@ def _tag_window(meta, conf):
         if not ok:
             meta.reasons.append(f"window {name}: {reason}")
             continue
+        fn_child = getattr(w.function, "children", ())
+        for cexp in fn_child:
+            if T.is_dec128(cexp.data_type):
+                meta.reasons.append(
+                    f"window {name} over a decimal(>18) input is not "
+                    "supported on TPU")
         for p in w.spec.partition_exprs:
             check_expr(p, conf, meta.reasons, f"window {name} partition key ")
         for o in w.spec.orders:

@@ -138,6 +138,16 @@ def lookup_mro(registry: dict, cls: type):
     return None
 
 
+#: full-precision decimals (p<=38): two-limb (capacity, 2) int64 device
+#: storage (reference DECIMAL_128 tier — TypeChecks.scala:613)
+DEC128 = TypeSig(T.DecimalType,
+                 max_decimal_precision=T.DecimalType.MAX_PRECISION)
+
+#: COMMON widened to full decimal precision — the surface that flows
+#: through storage-level machinery (scan/filter/sort/join/group keys,
+#: compare, shuffle); ARITHMETIC on p>18 still falls back per-op
+COMMON_128 = AnyOfSig(COMMON, DEC128)
+
 #: scalar COMMON plus fixed-element arrays — the surface Scan/Project/
 #: Generate handle on device (other execs keep COMMON: their kernels
 #: compact/gather/sort flat buffers only)
@@ -147,3 +157,7 @@ COMMON_PLUS_ARRAYS = AnyOfSig(COMMON, ARRAY_FIXED)
 #: (joins/sorts/aggs over raw nested columns tag fallback, like the
 #: reference's per-op nested carve-outs in TypeChecks.scala)
 COMMON_PLUS_NESTED = AnyOfSig(COMMON, ARRAY_FIXED, STRUCT_FIXED, MAP_FIXED)
+
+#: nested surface widened to full decimal precision (column references,
+#: aliases, scans)
+NESTED_128 = AnyOfSig(COMMON_PLUS_NESTED, DEC128)

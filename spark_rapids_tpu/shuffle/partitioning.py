@@ -292,18 +292,10 @@ def split_by_partition(table: DeviceTable, partitioner: Partitioner
         cols = []
         for c, d, v in zip(table.columns, host_datas, host_valids):
             dd = d[start:start + cnt]
-            vv = v[start:start + cnt]
-            if isinstance(c.dtype, T.StringType):
-                if c.dictionary is None:
-                    raise ColumnarProcessingError("string column missing dictionary")
-                codes = np.clip(dd, 0, max(len(c.dictionary) - 1, 0))
-                vals = np.empty(cnt, dtype=object)
-                if len(c.dictionary):
-                    vals[:] = c.dictionary[codes]
-                vals[~vv] = None
-                cols.append(HostColumn(c.dtype, vals, vv.copy()))
-            else:
-                cols.append(HostColumn(c.dtype, dd, vv))
+            vv = np.ascontiguousarray(v[start:start + cnt])
+            # decode_host rebuilds the LOGICAL host column (string
+            # dictionary decode, dec128 limb recombination)
+            cols.append(c.decode_host(dd, vv))
         results.append(HostTable(table.names, cols))
         start += cnt
     return results

@@ -72,6 +72,11 @@ def pack_table(table: HostTable) -> bytes:
             out.append(b"".join(encoded))
         elif isinstance(col.dtype, T.NullType):
             pass  # validity only
+        elif T.is_dec128(col.dtype):
+            # fixed 16 bytes/row: two little-endian int64 limbs
+            from spark_rapids_tpu.columnar.column import dec128_limbs
+            limbs = dec128_limbs(col.data, col.validity, n)
+            out.append(np.ascontiguousarray(limbs).tobytes())
         else:
             arr = np.ascontiguousarray(col.data, dtype=col.dtype.np_dtype)
             out.append(arr.tobytes())
@@ -122,6 +127,13 @@ def unpack_table(buf: bytes, offset: int = 0) -> Tuple[HostTable, int]:
             cols.append(HostColumn(dt, data, validity))
         elif isinstance(dt, T.NullType):
             cols.append(HostColumn(dt, np.zeros(nrows, dtype=np.int8), validity))
+        elif T.is_dec128(dt):
+            from spark_rapids_tpu.columnar.column import dec128_unscaled
+            limbs = np.frombuffer(view, dtype=np.int64, count=2 * nrows,
+                                  offset=pos).reshape(nrows, 2)
+            pos += int(nrows) * 16
+            cols.append(HostColumn(dt, dec128_unscaled(limbs, validity),
+                                   validity))
         else:
             np_dt = dt.np_dtype
             data = np.frombuffer(view, dtype=np_dt, count=nrows, offset=pos).copy()

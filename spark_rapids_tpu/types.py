@@ -263,6 +263,14 @@ def from_numpy(dtype) -> DataType:
     return dt
 
 
+def is_dec128(dt: DataType) -> bool:
+    """p>18 decimals: two-limb (hi i64, lo u64-bits-in-i64) device storage
+    as a (capacity, 2) int64 array (the reference's DECIMAL128 tier —
+    TypeChecks.scala:613)."""
+    return (isinstance(dt, DecimalType)
+            and dt.precision > DecimalType.MAX_LONG_DIGITS)
+
+
 def is_string(dt: DataType) -> bool:
     return isinstance(dt, StringType)
 

@@ -1,0 +1,343 @@
+"""DECIMAL128 column storage (reference: TypeChecks.scala:613 DECIMAL_128
+tier; SURVEY.md §2.9): two-limb (hi i64, lo u64) device columns flowing
+through scan/filter/compare/sort/group/join/collect, with per-op fallback
+for the still-unimplemented arithmetic/agg-value kernels."""
+
+import decimal as pydec
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import functions as F
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.ops.expr import col
+from tests.asserts import assert_runs_on_tpu
+
+P38 = T.DecimalType(38, 2)
+MAX38 = 10**38 - 1
+
+
+def _vals(n=400, seed=0, with_bounds=True):
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(n):
+        r = rng.random()
+        if r < 0.05:
+            out.append(None)
+        elif r < 0.15:
+            # beyond int64: exercise both limbs
+            out.append(int(rng.integers(-10**6, 10**6)) * 10**22 + 7)
+        else:
+            out.append(int(rng.integers(-10**9, 10**9)))
+    if with_bounds:
+        out[0] = MAX38
+        out[1] = -MAX38
+        out[2] = (1 << 64) + 1     # lo-limb carry boundary
+        out[3] = -(1 << 64) - 1
+        out[4] = (1 << 63)         # lo limb sign boundary
+    return out
+
+
+def _df(s, vals=None, name="d", extra=None):
+    data = {name: _vals() if vals is None else vals}
+    dtypes = {name: P38}
+    if extra:
+        for k, v in extra.items():
+            data[k] = v
+    return s.create_dataframe(data, dtypes=dtypes)
+
+
+# -- storage roundtrip -------------------------------------------------------
+
+def test_roundtrip_p38(session):
+    vals = _vals()
+    got = [r[0] for r in _df(session, vals).collect()]
+    assert got == vals  # decimals are BIT-exact (unscaled ints)
+
+
+def test_roundtrip_boundaries(session):
+    vals = [MAX38, -MAX38, 0, None, 1, -1, (1 << 64), -(1 << 64),
+            (1 << 63) - 1, (1 << 63), -(1 << 63), 10**19, -(10**19)]
+    got = [r[0] for r in _df(session, vals).collect()]
+    assert got == vals
+
+
+def test_scan_runs_on_tpu(session):
+    assert_runs_on_tpu(lambda s: _df(s).select("d"), session)
+
+
+# -- compare / filter --------------------------------------------------------
+
+def test_compare_two_columns(session, cpu_session):
+    a = _vals(300, seed=1)
+    b = _vals(300, seed=2)
+
+    def q(s):
+        df = s.create_dataframe({"a": a, "b": b},
+                                dtypes={"a": P38, "b": P38})
+        return df.select((col("a") < col("b")).alias("lt"),
+                         (col("a") == col("b")).alias("eq"),
+                         (col("a") >= col("b")).alias("ge"))
+
+    assert q(session).collect() == q(cpu_session).collect()
+    assert_runs_on_tpu(q, session)
+
+
+def test_filter_by_comparison(session, cpu_session):
+    a = _vals(300, seed=3)
+    b = _vals(300, seed=4)
+
+    def q(s):
+        df = s.create_dataframe({"a": a, "b": b},
+                                dtypes={"a": P38, "b": P38})
+        return df.filter(col("a") > col("b"))
+
+    got = sorted(q(session).collect(), key=repr)
+    want = sorted(q(cpu_session).collect(), key=repr)
+    assert got == want and len(got) > 0
+
+
+# -- sort --------------------------------------------------------------------
+
+def test_sort_by_p38_key(session, cpu_session):
+    vals = _vals(500, seed=5)
+
+    def q(s):
+        return _df(s, vals).sort("d")
+
+    got = [r[0] for r in q(session).collect()]
+    want = [r[0] for r in q(cpu_session).collect()]
+    assert got == want
+    assert_runs_on_tpu(q, session)
+
+
+def test_sort_descending(session, cpu_session):
+    vals = _vals(200, seed=6)
+    got = [r[0] for r in _df(session, vals)
+           .sort("d", ascending=False).collect()]
+    want = [r[0] for r in _df(cpu_session, vals)
+            .sort("d", ascending=False).collect()]
+    assert got == want
+
+
+# -- group-by key / join key -------------------------------------------------
+
+def test_group_by_p38_key(session, cpu_session):
+    keys = [MAX38, -MAX38, (1 << 64) + 5, None]
+    rng = np.random.default_rng(7)
+    n = 300
+    kcol = [keys[i] for i in rng.integers(0, len(keys), n)]
+    vcol = rng.integers(0, 100, n).astype(np.int64)
+
+    def q(s):
+        df = s.create_dataframe({"k": kcol, "v": vcol}, dtypes={"k": P38})
+        return df.group_by("k").agg(F.count("v").alias("c"),
+                                    F.sum("v").alias("sv"))
+
+    got = sorted(q(session).collect(), key=repr)
+    want = sorted(q(cpu_session).collect(), key=repr)
+    assert got == want and len(got) == 4
+
+
+def test_join_on_p38_key(session, cpu_session):
+    keys = [MAX38 - i for i in range(20)] + [-(1 << 64) - i
+                                             for i in range(20)]
+    rng = np.random.default_rng(8)
+    lk = [keys[i] for i in rng.integers(0, 40, 200)]
+    rk = keys[::2]
+
+    def q(s):
+        left = s.create_dataframe(
+            {"k": lk, "v": np.arange(200, dtype=np.int64)},
+            dtypes={"k": P38})
+        right = s.create_dataframe(
+            {"k": rk, "w": np.arange(20, dtype=np.int64)},
+            dtypes={"k": P38})
+        return left.join(right, on=["k"], how="inner")
+
+    got = sorted(q(session).collect(), key=repr)
+    want = sorted(q(cpu_session).collect(), key=repr)
+    assert got == want and len(got) > 0
+
+
+# -- multi-batch / masked flow ----------------------------------------------
+
+def test_multibatch_concat_and_filter(session, cpu_session):
+    vals = _vals(600, seed=9)
+
+    def q(s):
+        from spark_rapids_tpu.ops.predicates import IsNotNull
+        df = s.create_dataframe({"d": vals}, dtypes={"d": P38},
+                                num_batches=3)
+        return df.filter(IsNotNull(col("d"))).sort("d")
+
+    got = [r[0] for r in q(session).collect()]
+    want = [r[0] for r in q(cpu_session).collect()]
+    assert got == want
+
+
+# -- honest fallback for unimplemented kernels -------------------------------
+
+def test_sum_over_p38_falls_back_with_reason(session, cpu_session):
+    vals = [10**20, 2 * 10**20, None, 5]
+
+    def q(s):
+        return _df(s, vals).agg(F.count("d").alias("c"))
+
+    # count works on device
+    assert q(session).collect() == q(cpu_session).collect() == [(3,)]
+
+    sum_df = _df(session, vals).agg(F.sum("d").alias("s"))
+    plan = sum_df.explain()
+    assert "decimal(>18)" in plan, plan
+    # and the fallback answers exactly what the CPU oracle answers
+    assert sum_df.collect() == \
+        _df(cpu_session, vals).agg(F.sum("d").alias("s")).collect()
+
+
+def test_matrix_reports_dec128_storage(session):
+    from spark_rapids_tpu.overrides.docs import generate_supported_ops
+    md = generate_supported_ops()
+    row = next(ln for ln in md.splitlines()
+               if ln.startswith("| BoundReference"))
+    cells = [c.strip() for c in row.split("|")]
+    assert cells[13] == "S", row  # DECIMAL128 column (see _TYPE_COLUMNS)
+
+
+def test_shuffle_serializer_roundtrip():
+    from spark_rapids_tpu.columnar import HostTable
+    from spark_rapids_tpu.shuffle.serializer import pack_table, unpack_table
+    vals = _vals(100, seed=10)
+    t = HostTable.from_pydict({"d": vals, "x": list(range(100))},
+                              dtypes={"d": P38})
+    back, _ = unpack_table(pack_table(t))
+    assert back.to_pydict()["d"] == vals
+    assert back.columns[0].dtype == P38
+
+
+def test_repartition_with_p38_payload(session, cpu_session):
+    """Repartition by an INT key with a dec128 payload column rides the
+    shuffle; hash-partitioning BY a dec128 key falls back with a
+    reason."""
+    vals = _vals(300, seed=11)
+    rng = np.random.default_rng(12)
+    k = rng.integers(0, 5, 300).astype(np.int64)
+
+    def q(s):
+        df = s.create_dataframe({"k": k, "d": vals}, dtypes={"d": P38})
+        return df.repartition(4, "k")
+
+    got = sorted(q(session).collect(), key=repr)
+    want = sorted(q(cpu_session).collect(), key=repr)
+    assert got == want
+
+    by_dec = _df(session, vals).repartition(4, "d")
+    assert "decimal(>18)" in by_dec.explain()
+    assert sorted(r[0] for r in by_dec.collect() if r[0] is not None) \
+        == sorted(v for v in vals if v is not None)
+
+
+def test_null_safe_equality(session, cpu_session):
+    """<=> over p38 columns (two-limb device equality; review fix)."""
+    from spark_rapids_tpu.ops.predicates import EqualNullSafe
+    a = [MAX38, None, 5, None, (1 << 64) + 1]
+    b = [MAX38, None, 6, 7, (1 << 64) + 1]
+
+    def q(s):
+        df = s.create_dataframe({"a": a, "b": b},
+                                dtypes={"a": P38, "b": P38})
+        return df.select(EqualNullSafe(col("a"), col("b")).alias("e"))
+
+    got = [r[0] for r in q(session).collect()]
+    assert got == [r[0] for r in q(cpu_session).collect()]
+    assert got == [True, True, False, False, True]
+    assert_runs_on_tpu(q, session)
+
+
+def test_ici_mode_with_p38_payload_uses_host_shuffle(cpu_session):
+    """ICI shuffle mode + dec128 payload: the collective kernels are
+    1-D-only, so the host shuffle (with its two-limb serializer branch)
+    must serve the exchange (review fix)."""
+    from spark_rapids_tpu.session import TpuSession
+    vals = _vals(200, seed=13)
+    rng = np.random.default_rng(14)
+    k = rng.integers(0, 4, 200).astype(np.int64)
+    ici = TpuSession({"spark.rapids.shuffle.mode": "ICI"})
+
+    def q(s):
+        df = s.create_dataframe({"k": k, "d": vals}, dtypes={"d": P38})
+        return df.repartition(4, "k")
+
+    got = sorted(q(ici).collect(), key=repr)
+    want = sorted(q(cpu_session).collect(), key=repr)
+    assert got == want
+    assert "iciPartitions" not in ici.last_metrics()
+
+
+def test_parquet_scan_p38(session, cpu_session, tmp_path):
+    """Arrow ingestion of decimal(>18) parquet produces object-int host
+    columns and two-limb device columns (review fix — used to raise at
+    scan time)."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    with pydec.localcontext() as ctx:
+        ctx.prec = 50  # default 28 silently rounds 38-digit decimals
+        vals = [pydec.Decimal(v).scaleb(-2) if v is not None else None
+                for v in _vals(120, seed=15)]
+    pq.write_table(
+        pa.table({"d": pa.array(vals, type=pa.decimal128(38, 2))}),
+        tmp_path / "t.parquet")
+
+    def q(s):
+        return s.read_parquet(str(tmp_path / "t.parquet")).sort("d")
+
+    got = [r[0] for r in q(session).collect()]
+    want = [r[0] for r in q(cpu_session).collect()]
+    assert got == want
+    # unscaled int equality against the source values
+    with pydec.localcontext() as ctx:
+        ctx.prec = 50
+        src = sorted(int(v.scaleb(2)) for v in vals if v is not None)
+    assert [g for g in got if g is not None] == src
+
+
+def test_outer_join_null_side_p38(session, cpu_session):
+    """Outer-join null sides build (cap, 2) limb columns (review fix —
+    1-D zeros used to corrupt/crash the dec128 payload)."""
+    lk = np.array([0, 1, 2, 3], dtype=np.int64)
+    rk = np.array([2, 3, 4, 5], dtype=np.int64)
+    dvals = [MAX38, -(1 << 64) - 3, 7, None]
+
+    def q(s, how):
+        left = s.create_dataframe({"k": lk, "v": np.arange(4, dtype=np.int64)})
+        right = s.create_dataframe({"k": rk, "d": dvals}, dtypes={"d": P38})
+        return left.join(right, on=["k"], how=how)
+
+    for how in ("left", "full"):
+        got = sorted(q(session, how).collect(), key=repr)
+        want = sorted(q(cpu_session, how).collect(), key=repr)
+        assert got == want, how
+
+
+def test_window_partition_by_p38_key(session, cpu_session):
+    """rank() over PARTITION BY dec128 / ORDER BY dec128 (review fix —
+    the window kernels' key zeroing was 1-D-only)."""
+    keys = [MAX38, -(1 << 64), 5]
+    rng = np.random.default_rng(16)
+    n = 90
+    k = [keys[i] for i in rng.integers(0, 3, n)]
+    o = [int(x) * 10**20 for x in rng.integers(-50, 50, n)]
+
+    def q(s):
+        df = s.create_dataframe(
+            {"k": k, "o": o, "v": np.arange(n, dtype=np.int64)},
+            dtypes={"k": P38, "o": P38})
+        return df.with_windows(
+            rn=F.row_number().over(
+                __import__("spark_rapids_tpu.ops.window",
+                           fromlist=["Window"]).Window
+                .partition_by("k").order_by("o")))
+
+    got = sorted(q(session).collect(), key=repr)
+    want = sorted(q(cpu_session).collect(), key=repr)
+    assert got == want

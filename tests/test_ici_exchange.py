@@ -87,13 +87,20 @@ def test_ici_q1_over_8_shards(ici_session, cpu_session):
                                  approximate_float=True)
 
 
-def test_ici_falls_back_for_non_pow2_partitions(ici_session, cpu_session):
-    """7 partitions can't map onto the pow2 mesh: host shuffle silently
-    covers it with identical results."""
+def test_ici_non_pow2_partitions_run_the_collective(ici_session,
+                                                    cpu_session):
+    """7 partitions on the 8-device mesh: the pow2 row capacity pads up
+    to a multiple of 7 and the COLLECTIVE still runs (round-4 verdict:
+    the non-pow2 case used to silently fall back to the host shuffle)."""
     assert_tpu_and_cpu_are_equal(
         lambda s: _df(s, GENS).repartition(7, "k")
         .group_by("k").agg(F.count().alias("c")),
         ici_session, cpu_session)
+    df = _df(ici_session, GENS).repartition(7, "k").group_by("k").agg(
+        F.count().alias("c"))
+    df.collect_table()
+    m = ici_session.last_metrics()
+    assert "iciPartitions=7" in m, m
 
 
 def test_ici_preserves_rows_with_nulls(ici_session, cpu_session):

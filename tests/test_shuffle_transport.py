@@ -338,3 +338,64 @@ def test_engine_repartition_p2p_matches_cpu(transport):
     for g, w in zip(got, want):
         assert g[0] == w[0] and g[2] == w[2]
         assert abs(g[1] - w[1]) <= 1e-6 * max(1.0, abs(w[1]))
+
+
+def test_tcp_two_process_shuffle_fetch(tmp_path):
+    """TWO PROCESSES (simulated two hosts over the DCN wire): a child
+    process serves map-output blocks through TcpShuffleServerListener;
+    this process fetches them with the TcpTransport client — the
+    multi-host half of SURVEY §2.6's shuffle contract."""
+    import subprocess
+    import sys
+    import time
+
+    port_file = tmp_path / "port"
+    code = f"""
+import sys, time
+sys.path.insert(0, {repr(str(__import__('pathlib').Path(__file__).resolve().parents[1]))})
+from spark_rapids_tpu.shuffle.catalogs import ShuffleBufferCatalog
+from spark_rapids_tpu.shuffle.client_server import ShuffleServer
+from spark_rapids_tpu.shuffle.transport import BounceBufferManager
+from spark_rapids_tpu.shuffle.p2p import TcpShuffleServerListener
+catalog = ShuffleBufferCatalog()
+for m in range(3):
+    catalog.add_block((7, m, 0), bytes([m]) * 2000)
+server = ShuffleServer(catalog, BounceBufferManager(512, 2))
+listener = TcpShuffleServerListener(server)
+open({repr(str(port_file))}, "w").write(f"{{listener.host}}:{{listener.port}}")
+time.sleep(30)
+"""
+    child = subprocess.Popen([sys.executable, "-c", code],
+                             stdout=subprocess.PIPE,
+                             stderr=subprocess.PIPE)
+    try:
+        for _ in range(100):
+            if port_file.exists() and port_file.read_text():
+                break
+            if child.poll() is not None:
+                raise AssertionError(
+                    f"server process died: {child.stderr.read().decode()}")
+            time.sleep(0.1)
+        host, port = port_file.read_text().split(":")
+
+        from spark_rapids_tpu.shuffle.catalogs import (
+            ShuffleReceivedBufferCatalog,
+        )
+        from spark_rapids_tpu.shuffle.client_server import ShuffleClient
+        from spark_rapids_tpu.shuffle.transport import (
+            BounceBufferManager,
+            PeerInfo,
+            TcpTransport,
+        )
+        transport = TcpTransport(BounceBufferManager(512, 2))
+        client = ShuffleClient(
+            transport.connect(PeerInfo("remote", host, int(port))),
+            window_size=512)
+        received = ShuffleReceivedBufferCatalog()
+        blocks = client.fetch_partition(7, 0, received)
+        assert len(blocks) == 3
+        got = dict(received.drain())
+        assert got == {(7, m, 0): bytes([m]) * 2000 for m in range(3)}
+    finally:
+        child.terminate()
+        child.wait(timeout=10)
