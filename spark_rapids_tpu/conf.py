@@ -363,14 +363,29 @@ ADAPTIVE_ENABLED = bool_conf(
     "spark.rapids.sql.broadcastSizeBytes (AQE DynamicJoinSelection "
     "analog).")
 
+DELTA_LOW_SHUFFLE_MERGE = bool_conf(
+    "spark.rapids.sql.delta.lowShuffleMerge.enabled", True,
+    "MERGE rewrites only the TOUCHED ROWS of matched files: matched "
+    "target rows die via a deletion vector and updated versions land in "
+    "a small new file, so untouched rows of touched files never rewrite "
+    "(GpuLowShuffleMergeCommand analog). Disable for full-file "
+    "rewrites.")
+
+AQE_SKEW_FACTOR = float_conf(
+    "spark.rapids.sql.adaptive.skewJoin.skewedPartitionFactor", 4.0,
+    "A reduce partition whose measured map-output bytes exceed this "
+    "multiple of the median is counted skewed (skewedPartitions metric; "
+    "oversized partitions already split into target-size batches at "
+    "read time — AQE OptimizeSkewedJoin's split, measured not guessed).")
+
 AQE_COALESCE_PARTITIONS = bool_conf(
-    "spark.rapids.sql.adaptive.coalescePartitions.enabled", False,
-    "Adaptive shuffle-partition coalescing: adjacent undersized reduce "
-    "partitions merge into shared output batches at read time (AQE "
-    "CoalesceShufflePartitions analog). OFF by default because this "
-    "engine's shuffles all come from explicit repartition(n) calls, which "
-    "the reference's AQE exempts from coalescing; enable when batch count "
-    "need not match the requested partition count. Partitions larger than "
+    "spark.rapids.sql.adaptive.coalescePartitions.enabled", True,
+    "Adaptive shuffle-partition coalescing from MEASURED map-output "
+    "sizes: adjacent undersized reduce partitions merge into shared "
+    "output batches at read time (AQE CoalesceShufflePartitions "
+    "analog). Note: output batches are then not partition-aligned "
+    "(keyed co-location still holds per ROW); disable for consumers "
+    "that require one batch per requested partition. Partitions larger than "
     "the batch target still split either way.")
 
 BROADCAST_SIZE_BYTES = int_conf(

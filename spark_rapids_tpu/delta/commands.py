@@ -50,12 +50,26 @@ def _cast_col(col: HostColumn, dt) -> HostColumn:
 
 
 def _read_physical(table_path: str, add: AddFile, schema) -> HostTable:
-    """One data file's PHYSICAL rows (no DV applied) as the data schema."""
+    """One data file's PHYSICAL rows (no DV applied) as the TABLE data
+    schema; columns the file predates (mergeSchema evolution) null-fill
+    — the same contract as the scan node's read_file."""
     import pyarrow.parquet as pq
 
+    from spark_rapids_tpu.delta.table import _null_column
     from spark_rapids_tpu.io.arrow_convert import decode_to_schema
-    t = pq.read_table(os.path.join(table_path, add.path))
-    return decode_to_schema(t, schema)
+    pf = pq.ParquetFile(os.path.join(table_path, add.path))
+    have = set(pf.schema_arrow.names)
+    present = [(n, dt) for n, dt in schema if n in have]
+    missing = [(n, dt) for n, dt in schema if n not in have]
+    table = decode_to_schema(pf.read(columns=[n for n, _ in present]),
+                             present)
+    if not missing:
+        return table
+    by_name = dict(zip(table.names, table.columns))
+    for n, dt in missing:
+        by_name[n] = _null_column(dt, table.num_rows)
+    return HostTable([n for n, _ in schema],
+                     [by_name[n] for n, _ in schema])
 
 
 from spark_rapids_tpu.delta.table import attach_partition_columns as \
@@ -354,10 +368,13 @@ class MergeBuilder:
                 "MERGE source has multiple rows for at least one key "
                 "(ambiguous matched-clause application)")
 
+        from spark_rapids_tpu.conf import DELTA_LOW_SHUFFLE_MERGE
+        low_shuffle = bool(
+            t.session.conf.get_entry(DELTA_LOW_SHUFFLE_MERGE))
         txn = OptimisticTransaction(t.log, t.session.conf,
                                     read_version=snap.version)
         now = int(time.time() * 1000)
-        matched_rows = deleted_rows = 0
+        matched_rows = deleted_rows = rewritten_files = dv_files = 0
         matched_src: set = set()
         for add in snap.files:
             phys = _read_physical(t.table_path, add, data_schema)
@@ -388,6 +405,55 @@ class MergeBuilder:
                 keep = live & ~matched
             else:
                 keep = live
+            if low_shuffle and not (self._update_set or self._delete):
+                # insert-only merge: matched target rows are untouched —
+                # no file actions at all for this file
+                continue
+            if low_shuffle:
+                # LOW-SHUFFLE path (GpuLowShuffleMergeCommand analog):
+                # matched rows die via a deletion vector; updates write
+                # ONLY the touched rows to a small file — untouched rows
+                # of this file never rewrite
+                dead = ~live | matched
+                if dead.all():
+                    txn.stage(RemoveFile(add.path, now))
+                else:
+                    desc = write_dv_file(
+                        t.table_path,
+                        np.flatnonzero(dead).astype(np.int64))
+                    txn.stage(RemoveFile(add.path, now,
+                                         data_change=False))
+                    txn.stage(AddFile(
+                        path=add.path,
+                        partition_values=add.partition_values,
+                        size=add.size, modification_time=now,
+                        data_change=False, stats=add.stats,
+                        deletion_vector=desc))
+                    dv_files += 1
+                if self._update_set and not self._delete:
+                    rows = np.flatnonzero(matched)
+                    upd_cols = []
+                    for name, col in zip(full.names, full.columns):
+                        if name in self._update_set:
+                            sc = _cast_col(src.columns[src_names.index(
+                                self._update_set[name])], col.dtype)
+                            upd_cols.append(HostColumn(
+                                col.dtype, sc.data[hit[rows]],
+                                sc.validity[hit[rows]]))
+                        else:
+                            upd_cols.append(HostColumn(
+                                col.dtype, col.data[rows],
+                                col.validity[rows]))
+                    upd = HostTable(list(full.names), upd_cols)
+                    data_only = HostTable(
+                        [n for n, _ in data_schema],
+                        [upd.columns[list(upd.names).index(n)]
+                         for n, _ in data_schema])
+                    txn.stage(_write_data_file(
+                        t.table_path, data_only, add.partition_values,
+                        os.path.dirname(add.path)))
+                continue
+            rewritten_files += 1
             out_cols = []
             for name, col in zip(full.names, full.columns):
                 if (self._update_set and name in self._update_set
@@ -439,4 +505,7 @@ class MergeBuilder:
             txn.commit("MERGE")
         return {"num_matched_rows": matched_rows,
                 "num_deleted_rows": deleted_rows,
-                "num_inserted_rows": inserted}
+                "num_inserted_rows": inserted,
+                "low_shuffle": low_shuffle,
+                "num_rewritten_files": rewritten_files,
+                "num_dv_files": dv_files}

@@ -121,14 +121,16 @@ class TpuShuffleExchangeExec(TpuExec):
         )
         from spark_rapids_tpu.execs.base import MASKED_ENABLED
         mode = str(self.conf.get_entry(SHUFFLE_MANAGER_MODE)).upper()
+        # the device split wins over host-shuffle coalescing when both
+        # apply: its per-partition masked VIEWS cost no serialization and
+        # downstream group-blind consumers mask-union undersized views
+        # back together (columnar/table.merge_split_views) — the same
+        # sliver-batch problem AQE coalescing solves, without the stats
         return (mode == "MULTITHREADED"
                 and bool(self.conf.get_entry(SHUFFLE_LOCAL_DEVICE_SPLIT))
                 and MASKED_ENABLED.get()  # masked-batch kill switch
                 and self.mode in ("hash", "roundrobin", "single")
-                and self.num_partitions <= self.LOCAL_SPLIT_MAX_PARTITIONS
-                # AQE partition coalescing needs the manager's measured
-                # map-output sizes; the device split has no stats
-                and not self._aqe_coalesce_enabled())
+                and self.num_partitions <= self.LOCAL_SPLIT_MAX_PARTITIONS)
 
     produces_masked = True
 
@@ -326,6 +328,10 @@ class TpuShuffleExchangeExec(TpuExec):
             # per ROW, just not per batch). The within-partition target-
             # size split (GpuShuffleCoalesce) applies in both modes.
             coalesce_parts = self._aqe_coalesce_enabled()
+            # measured map-output stats (AQE MapOutputStatistics analog):
+            # per-partition byte sizes drive the skew metric and make the
+            # coalescing decision observable
+            part_bytes = [0] * self.num_partitions
             pending: List[HostTable] = []
             pending_bytes = 0
             nonempty_parts = 0
@@ -335,7 +341,9 @@ class TpuShuffleExchangeExec(TpuExec):
                 for t in reader.read_partition(p):
                     saw_rows = True
                     pending.append(t)
-                    pending_bytes += t.nbytes()
+                    nb = t.nbytes()
+                    part_bytes[p] += nb
+                    pending_bytes += nb
                     if pending_bytes >= self.target_batch_bytes:
                         yield self._upload(pending)
                         emitted += 1
@@ -351,6 +359,19 @@ class TpuShuffleExchangeExec(TpuExec):
             if coalesce_parts and nonempty_parts > emitted:
                 self.add_metric("aqeCoalescedPartitions",
                                 nonempty_parts - emitted)
+            live = sorted(b for b in part_bytes if b > 0)
+            if live:
+                from spark_rapids_tpu.conf import AQE_SKEW_FACTOR
+                median = live[len(live) // 2]
+                factor = float(self.conf.get_entry(AQE_SKEW_FACTOR))
+                skewed = sum(1 for b in live if b > factor * max(median, 1))
+                self.add_metric("mapOutputBytesMax", live[-1])
+                self.add_metric("mapOutputBytesMedian", median)
+                if skewed:
+                    # oversized partitions already split into target-size
+                    # batches above (OptimizeSkewedJoin's split, from
+                    # MEASURED sizes); surface how many were skewed
+                    self.add_metric("skewedPartitions", skewed)
             self.add_metric("shuffleReadTime", perf_counter() - t0)
             self.add_metric("shuffleBytesRead", reader.bytes_read)
         finally:

@@ -307,12 +307,14 @@ class DataFrame:
         return self._write("hive_text", path, partition_by, options)
 
     def write_delta(self, path: str, mode: str = "error",
-                    partition_by=None) -> int:
+                    partition_by=None, merge_schema: bool = False) -> int:
         """Write as a Delta table; returns the committed version
-        (reference: delta-lake module write path)."""
+        (reference: delta-lake module write path). ``merge_schema``
+        allows adding columns (Spark mergeSchema)."""
         from spark_rapids_tpu.delta import write_delta
         return write_delta(self.plan, self.session, path, mode=mode,
-                           partition_by=partition_by)
+                           partition_by=partition_by,
+                           merge_schema=merge_schema)
 
 
 class GroupedData:

@@ -510,3 +510,214 @@ def test_delete_dv_roundtrip_with_new_framing(tmp_path, session, cpu_session):
     got = sorted(session.read_delta(path).collect(), key=repr)
     assert len(got) == 140
     assert all(r[0] >= 60 for r in got)
+
+
+# -- low-shuffle MERGE (GpuLowShuffleMergeCommand analog; VERDICT r4 #8) -----
+
+def _two_file_table(s, tmp_path):
+    import numpy as np
+    path = str(tmp_path / "lsm")
+    s.create_dataframe({"k": np.arange(0, 50, dtype=np.int64),
+                        "v": np.arange(0, 50, dtype=np.int64)}) \
+        .write_delta(path)
+    s.create_dataframe({"k": np.arange(50, 100, dtype=np.int64),
+                        "v": np.arange(50, 100, dtype=np.int64)}) \
+        .write_delta(path, mode="append")
+    return path
+
+
+def test_low_shuffle_merge_only_touches_matched_rows(session, tmp_path):
+    """MERGE touching keys only in file 2: file 1's AddFile survives
+    untouched; file 2 keeps its PATH with a deletion vector plus a small
+    file holding just the updated rows."""
+    import numpy as np
+    from spark_rapids_tpu.delta.log import DeltaLog
+
+    path = _two_file_table(session, tmp_path)
+    before = {a.path for a in DeltaLog(path).snapshot().files}
+    src = session.create_dataframe(
+        {"k": np.array([60, 70], dtype=np.int64),
+         "nv": np.array([-1, -2], dtype=np.int64)})
+    stats = (session.delta_table(path).merge(src, on=["k"])
+             .when_matched_update(set={"v": "nv"}).execute())
+    assert stats["num_matched_rows"] == 2
+    assert stats["low_shuffle"] is True
+    assert stats["num_rewritten_files"] == 0
+    assert stats["num_dv_files"] == 1
+
+    snap = DeltaLog(path).snapshot()
+    after = {a.path for a in snap.files}
+    # both ORIGINAL paths survive (file 2 now carries a DV), plus one
+    # small file with the 2 updated rows
+    assert before <= after
+    assert len(after) == 3
+    dv_adds = [a for a in snap.files if a.deletion_vector]
+    assert len(dv_adds) == 1 and dv_adds[0].path in before
+
+    rows = dict(session.read_delta(path).collect())
+    want = {k: (-1 if k == 60 else -2 if k == 70 else k)
+            for k in range(100)}
+    assert rows == want
+
+
+def test_low_shuffle_merge_delete_writes_no_data_file(session, tmp_path):
+    import numpy as np
+    from spark_rapids_tpu.delta.log import DeltaLog
+
+    path = _two_file_table(session, tmp_path)
+    before = {a.path for a in DeltaLog(path).snapshot().files}
+    src = session.create_dataframe({"k": np.array([10, 99],
+                                                  dtype=np.int64)})
+    stats = (session.delta_table(path).merge(src, on=["k"])
+             .when_matched_delete().execute())
+    assert stats["num_deleted_rows"] == 2 and stats["num_dv_files"] == 2
+    after = {a.path for a in DeltaLog(path).snapshot().files}
+    assert after == before  # DVs only — no new files at all
+    got = sorted(r[0] for r in session.read_delta(path).collect())
+    assert got == [k for k in range(100) if k not in (10, 99)]
+
+
+def test_full_rewrite_merge_when_disabled(tmp_path, session):
+    import numpy as np
+    from spark_rapids_tpu.delta.log import DeltaLog
+    from spark_rapids_tpu.session import TpuSession
+
+    s = TpuSession({"spark.rapids.sql.delta.lowShuffleMerge.enabled":
+                    "false"})
+    path = _two_file_table(s, tmp_path)
+    src = s.create_dataframe({"k": np.array([60], dtype=np.int64),
+                              "nv": np.array([-1], dtype=np.int64)})
+    stats = (s.delta_table(path).merge(src, on=["k"])
+             .when_matched_update(set={"v": "nv"}).execute())
+    assert stats["low_shuffle"] is False
+    assert stats["num_rewritten_files"] == 1
+    rows = dict(s.read_delta(path).collect())
+    assert rows[60] == -1 and rows[0] == 0 and len(rows) == 100
+
+
+# -- schema evolution (mergeSchema; VERDICT r4 #8) ---------------------------
+
+def test_append_with_added_column_merge_schema(session, tmp_path):
+    import numpy as np
+    from spark_rapids_tpu.delta.log import DeltaLog
+
+    path = str(tmp_path / "evo")
+    session.create_dataframe(
+        {"k": np.arange(5, dtype=np.int64)}).write_delta(path)
+    # without the flag: clear error
+    df2 = session.create_dataframe(
+        {"k": np.arange(5, 8, dtype=np.int64),
+         "extra": np.array([1.5, 2.5, 3.5])})
+    import pytest as _pt
+    from spark_rapids_tpu.errors import ColumnarProcessingError
+    with _pt.raises(ColumnarProcessingError, match="merge_schema"):
+        df2.write_delta(path, mode="append")
+
+    v = df2.write_delta(path, mode="append", merge_schema=True)
+    snap = DeltaLog(path).snapshot()
+    # log-recorded schema change
+    assert [n for n, _ in snap.schema] == ["k", "extra"]
+    got = sorted(session.read_delta(path).collect(), key=repr)
+    # old files null-fill the added column
+    assert (0, None) in got and any(r == (5, 1.5) for r in got)
+    assert len(got) == 8
+    assert v == 1  # create=0, evolving append=1
+
+
+def test_merge_schema_type_conflict_raises(session, tmp_path):
+    import numpy as np
+    import pytest as _pt
+    from spark_rapids_tpu.errors import ColumnarProcessingError
+
+    path = str(tmp_path / "evo2")
+    session.create_dataframe(
+        {"k": np.arange(3, dtype=np.int64)}).write_delta(path)
+    bad = session.create_dataframe({"k": np.array([1.0, 2.0])})
+    with _pt.raises(ColumnarProcessingError, match="cannot change"):
+        bad.write_delta(path, mode="append", merge_schema=True)
+
+
+def test_low_shuffle_insert_only_keeps_matched_rows(session, tmp_path):
+    """Insert-only MERGE must not touch matched target rows (review fix:
+    the DV path was killing them)."""
+    import numpy as np
+    path = str(tmp_path / "io")
+    session.create_dataframe({"k": np.arange(5, dtype=np.int64),
+                              "v": np.arange(5, dtype=np.int64)}) \
+        .write_delta(path)
+    src = session.create_dataframe(
+        {"k": np.array([3, 7], dtype=np.int64),
+         "v": np.array([30, 70], dtype=np.int64)})
+    stats = (session.delta_table(path).merge(src, on=["k"])
+             .when_not_matched_insert().execute())
+    assert stats["num_inserted_rows"] == 1
+    rows = dict(session.read_delta(path).collect())
+    assert rows == {0: 0, 1: 1, 2: 2, 3: 3, 4: 4, 7: 70}
+
+
+def test_low_shuffle_update_casts_source_dtype(session, tmp_path):
+    """Update with a float source column into an int target casts like
+    the full-rewrite path (review fix)."""
+    import numpy as np
+    path = str(tmp_path / "cast")
+    session.create_dataframe({"k": np.arange(4, dtype=np.int64),
+                              "v": np.arange(4, dtype=np.int64)}) \
+        .write_delta(path)
+    src = session.create_dataframe(
+        {"k": np.array([2], dtype=np.int64), "nv": np.array([7.5])})
+    (session.delta_table(path).merge(src, on=["k"])
+     .when_matched_update(set={"v": "nv"}).execute())
+    rows = dict(session.read_delta(path).collect())
+    assert rows[2] == 7 and rows[0] == 0
+
+
+def test_merge_update_after_schema_evolution(session, tmp_path):
+    """MERGE updating the EVOLVED column of a pre-evolution file:
+    _read_physical null-fills (review fix — used to crash)."""
+    import numpy as np
+    path = str(tmp_path / "evo3")
+    session.create_dataframe({"k": np.arange(3, dtype=np.int64)}) \
+        .write_delta(path)
+    session.create_dataframe(
+        {"k": np.array([10], dtype=np.int64),
+         "extra": np.array([5.0])}) \
+        .write_delta(path, mode="append", merge_schema=True)
+    src = session.create_dataframe(
+        {"k": np.array([1], dtype=np.int64), "ne": np.array([9.5])})
+    (session.delta_table(path).merge(src, on=["k"])
+     .when_matched_update(set={"extra": "ne"}).execute())
+    rows = dict(session.read_delta(path).collect())
+    assert rows[1] == 9.5 and rows[0] is None and rows[10] == 5.0
+
+
+def test_merge_schema_commit_does_not_blind_retry(session, tmp_path):
+    """A concurrent winner between snapshot and commit surfaces as a
+    conflict for mergeSchema appends instead of silently reverting the
+    winner's schema (review fix)."""
+    import numpy as np
+    import pytest as _pt
+    from spark_rapids_tpu.delta.log import (
+        DeltaConcurrentModificationException,
+        DeltaLog,
+        Metadata,
+    )
+    from spark_rapids_tpu.delta.table import (
+        OptimisticTransaction,
+        schema_to_json,
+    )
+    from spark_rapids_tpu import types as T
+
+    path = str(tmp_path / "conc")
+    session.create_dataframe({"k": np.arange(3, dtype=np.int64)}) \
+        .write_delta(path)
+    log = DeltaLog(path)
+    snap = log.snapshot()
+    txn = OptimisticTransaction(log, session.conf,
+                                read_version=snap.version)
+    txn.stage(Metadata(schema_to_json(list(snap.schema) + [("x", T.LONG)]),
+                       [], table_id=snap.metadata.table_id))
+    # concurrent winner commits first
+    session.create_dataframe({"k": np.array([9], dtype=np.int64)}) \
+        .write_delta(path, mode="append")
+    with _pt.raises(DeltaConcurrentModificationException):
+        txn.commit("WRITE (append)")
