@@ -133,6 +133,9 @@ class CsvScanNode(FileScanNode):
             quote_char=self.quote if self.quote else False,
             escape_char=self.escape if self.escape else False,
             double_quote=self.escape is None,
+            # with an escape char, an ESCAPED literal newline is data
+            # (hive escape.delim round-trip), not a row terminator
+            newlines_in_values=self.escape is not None,
         )
         salvage = []
         if self.mode == "DROPMALFORMED":

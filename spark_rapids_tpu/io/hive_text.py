@@ -25,34 +25,61 @@ HIVE_NULL = "\\N"
 
 
 class HiveTextScanNode(CsvScanNode):
+    """Supports the LazySimpleSerDe property surface the reference's
+    GpuHiveTableScanExec reads from table properties: ``field.delim``
+    (-> delimiter), ``serialization.null.format`` (-> null_value), and
+    ``escape.delim`` (-> escape). Partitioned hive tables (key=value
+    directory layout) recover partition columns through the shared
+    FileScanNode machinery (io/common.py)."""
+
     format_name = "hiveText"
 
     def __init__(self, paths, conf: RapidsConf, schema: Schema,
                  columns=None, reader_type=None,
                  delimiter: str = HIVE_DELIM, null_value: str = HIVE_NULL,
-                 **options):
+                 escape: Optional[str] = None, **options):
         if schema is None:
             raise ValueError("Hive text tables require an explicit schema "
                              "(the format carries no header)")
         super().__init__(paths, conf, columns=columns,
                          reader_type=reader_type, schema=schema,
                          header=False, sep=delimiter, null_value=null_value,
-                         quote="", escape=None, **options)
+                         quote="", escape=escape, **options)
 
     def _conf_reader_type(self) -> str:
         return self.conf.get_entry(HIVE_TEXT_READER_TYPE)
 
 
+def _hive_cell(v, null_value: str, delimiter: str,
+               escape: Optional[str]) -> str:
+    """Hive LazySimpleSerDe value rendering: lowercase booleans, ``\\N``
+    nulls, ISO dates/timestamps; with escape.delim set, delimiter/
+    newline/escape bytes in the RENDERED text escape (a LONG of -5
+    under delimiter='-' needs escaping just like a string) — an escaped
+    literal newline reads back via newlines_in_values."""
+    if v is None:
+        return null_value
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    s = str(v)
+    if escape:
+        s = (s.replace(escape, escape + escape)
+             .replace(delimiter, escape + delimiter)
+             .replace("\n", escape + "\n"))
+    return s
+
+
 def write_hive_text(table: HostTable, path: str,
                     partition_by: Optional[Sequence[str]] = None,
                     delimiter: str = HIVE_DELIM,
-                    null_value: str = HIVE_NULL) -> List[str]:
+                    null_value: str = HIVE_NULL,
+                    escape: Optional[str] = None) -> List[str]:
     def _write_one(tbl: HostTable, file_path: str):
         cols = [c.to_pylist() for c in tbl.columns]
         with open(file_path, "w") as f:
             for i in range(tbl.num_rows):
                 f.write(delimiter.join(
-                    null_value if cols[j][i] is None else str(cols[j][i])
+                    _hive_cell(cols[j][i], null_value, delimiter, escape)
                     for j in range(len(cols))) + "\n")
 
     return write_partitioned(table, path, _write_one, "txt", partition_by)
