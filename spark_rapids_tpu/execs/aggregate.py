@@ -63,6 +63,72 @@ DEVICE_SUPPORTED_AGGS = (agg.Sum, agg.Min, agg.Max, agg.Count, agg.Average,
 SORT_ONLY_AGGS = (agg.CollectList, agg.CollectSet, agg.Percentile)
 
 
+_M32 = 0xFFFFFFFF
+_TOP64 = -0x8000000000000000
+
+
+def _dec_limb_words(sd):
+    """Decompose decimal storage into four 32-bit words per row such that
+    value = w0 + w1*2^32 + w2*2^64 + w3*2^96 with w0..w2 in [0, 2^32)
+    and w3 carrying the sign. Accepts (n, 2) two-limb dec128 columns and
+    plain (n,) int64 decimal64 columns (hi = sign extension)."""
+    if getattr(sd, "ndim", 1) == 2:
+        hi, lo = sd[:, 0], sd[:, 1]
+    else:
+        lo = sd.astype(jnp.int64)
+        hi = lo >> 63  # 0 / -1 sign extension
+    return (lo & _M32, (lo >> 32) & _M32, hi & _M32, hi >> 32)
+
+
+def _dec_sum_segments(out_type, sd, sv, gid, nseg, has_any):
+    """EXACT decimal segment sum (Spark sums decimals exactly; an f64
+    ride would round beyond 2^53): per-word i64 segment sums (each word
+    < 2^32 and row counts < 2^31, so partials are exact), carry
+    normalization back to two limbs, overflow -> NULL (non-ANSI
+    CheckOverflow semantics). Works for decimal64 AND dec128 inputs."""
+    from spark_rapids_tpu.ops.decimal import i128_abs_fits_pow10
+    words = _dec_limb_words(sd)
+    sums = [jax.ops.segment_sum(jnp.where(sv, w, 0), gid,
+                                num_segments=nseg) for w in words]
+    t0 = sums[0]
+    r0, c = t0 & _M32, t0 >> 32
+    t1 = sums[1] + c
+    r1, c = t1 & _M32, t1 >> 32
+    t2 = sums[2] + c
+    r2, c = t2 & _M32, t2 >> 32
+    t3 = sums[3] + c
+    hi = (t3 << 32) | r2
+    lo = (r1 << 32) | r0
+    # t3 holds bits >=96 of the TRUE sum (no i64 overflow possible at
+    # <2^31 rows), so a t3 outside i32 range means 128-bit overflow
+    ovf = (t3 > 0x7FFFFFFF) | (t3 < -0x80000000)
+    fits = i128_abs_fits_pow10(hi, lo, out_type.precision)
+    valid = has_any & ~ovf & fits
+    if out_type.precision > T.DecimalType.MAX_LONG_DIGITS:
+        return (jnp.stack([hi, lo], axis=1), valid)
+    # result precision fits int64: the low limb IS the two's-complement
+    # value when in range
+    return (jnp.where(valid, lo, 0), valid)
+
+
+def _dec128_minmax_segments(is_min, sd, sv, gid, nseg, has_any):
+    """Two-limb lexicographic segment min/max: high limbs reduce first
+    (signed); rows tying on the winning high limb break on the low limb
+    compared as UNSIGNED via a top-bit flip."""
+    seg_red = jax.ops.segment_min if is_min else jax.ops.segment_max
+    hi, lo = sd[:, 0], sd[:, 1]
+    info = jnp.iinfo(jnp.int64)
+    ident = info.max if is_min else info.min
+    hi_m = seg_red(jnp.where(sv, hi, ident), gid, num_segments=nseg)
+    cand = sv & (hi == hi_m[gid])
+    lob = lo ^ _TOP64  # unsigned order as signed
+    lo_m = seg_red(jnp.where(cand, lob, ident), gid,
+                   num_segments=nseg) ^ _TOP64
+    data = jnp.stack([jnp.where(has_any, hi_m, 0),
+                      jnp.where(has_any, lo_m, 0)], axis=1)
+    return (data, has_any)
+
+
 def _sortable(data, validity):
     """Transform (data, validity) into sort operands grouping nulls
     together: (invalid_first_flag, *native-width key operands). The
@@ -172,7 +238,7 @@ class TpuHashAggregateExec(TpuExec):
         """
         from types import SimpleNamespace
         from spark_rapids_tpu.ops.cast import Cast
-        from spark_rapids_tpu.ops.expr import BoundReference, col, lit
+        from spark_rapids_tpu.ops.expr import BoundReference, Literal, col, lit
         from spark_rapids_tpu.ops.math import Sqrt
 
         pschema = [(n, g.data_type)
@@ -197,9 +263,28 @@ class TpuHashAggregateExec(TpuExec):
                 merge_specs.append((name, agg.Sum(pref(i))))
                 final_exprs.append(col(name))
             elif isinstance(fn, agg.Sum):
-                i = add_partial(f"__p{j}s", agg.Sum(fn.child))
-                merge_specs.append((name, agg.Sum(pref(i))))
-                final_exprs.append(col(name))
+                if isinstance(fn.data_type, T.DecimalType):
+                    # a PARTIAL whose rows overflowed emits NULL; a plain
+                    # sum-of-partials would silently skip it (dropping
+                    # that batch's rows from a non-null final). Track it:
+                    # rows present + null partial sum == overflow, which
+                    # must null the FINAL (Spark non-ANSI CheckOverflow)
+                    from spark_rapids_tpu.ops.conditional import If
+                    from spark_rapids_tpu.ops.predicates import IsNull
+                    si = add_partial(f"__p{j}s", agg.Sum(fn.child))
+                    ci = add_partial(f"__p{j}n", agg.Count(fn.child))
+                    merge_specs.append((f"__m{j}s", agg.Sum(pref(si))))
+                    merge_specs.append((f"__m{j}o", agg.Sum(
+                        If(IsNull(pref(si)) & (pref(ci) > lit(0)),
+                           lit(1), lit(0)))))
+                    final_exprs.append(
+                        If(col(f"__m{j}o") > lit(0),
+                           Literal(None, fn.data_type),
+                           col(f"__m{j}s")).alias(name))
+                else:
+                    i = add_partial(f"__p{j}s", agg.Sum(fn.child))
+                    merge_specs.append((name, agg.Sum(pref(i))))
+                    final_exprs.append(col(name))
             elif isinstance(fn, (agg.Min, agg.Max)):
                 i = add_partial(f"__p{j}m", t(fn.child))
                 merge_specs.append((name, t(pref(i))))
@@ -526,7 +611,9 @@ class TpuHashAggregateExec(TpuExec):
                 elif isinstance(fnagg, agg.Average):
                     fplan.append((j, "avg"))
                 elif isinstance(fnagg, agg.Sum) and not isinstance(
-                        fnagg.data_type, T.LongType):
+                        fnagg.data_type, (T.LongType, T.DecimalType)):
+                    # decimal sums are EXACT limb sums (_agg_one), never
+                    # the f64 ride
                     fplan.append((j, "sum"))
             # sum/avg ride the split pass; variance means must be EXACT —
             # a mean error d inflates the centered pass by n*d^2 (quadratic
@@ -735,6 +822,9 @@ class TpuHashAggregateExec(TpuExec):
                 v = jnp.where(sv, sd.astype(jnp.int64), 0)
                 s = seg.segment_sum(v, gid, num_segments=nseg)
                 return (s, has_any)
+            if isinstance(fnagg.data_type, T.DecimalType):
+                return _dec_sum_segments(fnagg.data_type, sd, sv, gid,
+                                         nseg, has_any)
             v = jnp.where(sv, sd.astype(jnp.float64), 0.0)
             s = segment_sum_f64(v, gid, nseg, capacity, use_split)
             return (jnp.where(has_any, s, 0.0), has_any)
@@ -761,6 +851,11 @@ class TpuHashAggregateExec(TpuExec):
             var = m2 / denom
             out = jnp.sqrt(var) if isinstance(fnagg, (agg.StddevPop, agg.StddevSamp)) else var
             return (jnp.where(validity, out, 0.0), validity)
+
+        if isinstance(fnagg, (agg.Min, agg.Max)) \
+                and getattr(sd, "ndim", 1) == 2:
+            return _dec128_minmax_segments(
+                isinstance(fnagg, agg.Min), sd, sv, gid, nseg, has_any)
 
         if isinstance(fnagg, (agg.Min, agg.Max)):
             dt = sd.dtype

@@ -108,6 +108,9 @@ class CsvScanNode(FileScanNode):
     def _conf_reader_type(self) -> str:
         return self.conf.get_entry(CSV_READER_TYPE)
 
+    def _newlines_in_values(self) -> bool:
+        return False  # Spark CSV multiLine=false semantics
+
     def _cache_key_extra(self) -> tuple:
         return (tuple(self.user_schema or ()), self.header, self.delimiter,
                 self.quote, self.escape, self.comment, self.null_value,
@@ -133,9 +136,10 @@ class CsvScanNode(FileScanNode):
             quote_char=self.quote if self.quote else False,
             escape_char=self.escape if self.escape else False,
             double_quote=self.escape is None,
-            # with an escape char, an ESCAPED literal newline is data
-            # (hive escape.delim round-trip), not a row terminator
-            newlines_in_values=self.escape is not None,
+            # False for Spark CSV (multiLine=false: newlines always end
+            # records, and the comment pre-filter relies on it — see
+            # _load_bytes); hive text overrides when escape.delim is set
+            newlines_in_values=self._newlines_in_values(),
         )
         salvage = []
         if self.mode == "DROPMALFORMED":

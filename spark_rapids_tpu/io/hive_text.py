@@ -49,6 +49,11 @@ class HiveTextScanNode(CsvScanNode):
     def _conf_reader_type(self) -> str:
         return self.conf.get_entry(HIVE_TEXT_READER_TYPE)
 
+    def _newlines_in_values(self) -> bool:
+        # with escape.delim set, an ESCAPED literal newline is data
+        # (LazySimpleSerDe), not a row terminator
+        return self.escape is not None
+
 
 def _hive_cell(v, null_value: str, delimiter: str,
                escape: Optional[str]) -> str:

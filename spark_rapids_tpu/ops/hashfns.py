@@ -368,6 +368,13 @@ def xxhash64_host(values, seed: int = XX_SEED) -> int:
             continue
         if isinstance(dt, T.StringType):
             h = _np_xx_bytes(str(v).encode("utf-8"), h)
+        elif T.is_dec128(dt):
+            # Spark-exact: bytes of the unscaled BigInteger (see
+            # shuffle/hashing.py murmur3 dec128 note)
+            from spark_rapids_tpu.shuffle.hashing import (
+                _dec128_twos_complement_bytes,
+            )
+            h = _np_xx_bytes(_dec128_twos_complement_bytes(int(v)), h)
         elif isinstance(dt, (T.LongType, T.TimestampType, T.DecimalType)):
             h = _np_xx_long(v, h)
         elif isinstance(dt, T.DoubleType):

@@ -207,6 +207,12 @@ def _register_param_checks(arithmetic, math, predicates, strings,
                  "Quarter", "WeekDay", "LastDay", "Hour", "Minute",
                  "Second", "TsToDate"):
         chk(getattr(datetime_ops, name), DT_IN)
+    # hash EXPRESSIONS over p>18 decimals fall back: their user-visible
+    # value must be Spark's byte-array murmur3/xxhash (the CPU path is
+    # Spark-exact); the device limb-pair hash serves only partitioning
+    from spark_rapids_tpu.ops import hashfns
+    chk(hashfns.Murmur3Hash, rest=COMMON)
+    chk(hashfns.XxHash64, rest=COMMON)
     chk(datetime_ops.DateAdd, TypeSig(T.DateType), INTEGRAL)
     chk(datetime_ops.DateSub, TypeSig(T.DateType), INTEGRAL)
     chk(datetime_ops.AddMonths, TypeSig(T.DateType), INTEGRAL)
@@ -349,10 +355,10 @@ def _tag_aggregate(meta, conf):
                     f"aggregate {name} over an array input is not "
                     "supported on TPU")
             if T.is_dec128(fn.child.data_type) and not isinstance(
-                    fn, agg.Count):
-                # two-limb agg kernels (lexicographic min/max, carried
-                # 128-bit sums) are not implemented; keys work, values
-                # fall back (count excepted)
+                    fn, (agg.Count, agg.Sum, agg.Min, agg.Max)):
+                # count/sum/min/max run as two-limb device kernels
+                # (exact limb sums, lexicographic min/max); the rest
+                # (avg, collect, percentile, moments) fall back
                 meta.reasons.append(
                     f"aggregate {name} over a decimal(>18) input is not "
                     "supported on TPU")
@@ -544,10 +550,6 @@ def _tag_exchange(meta, conf):
         meta.reasons.append("hash partitioning requires keys")
     for k in node.keys:
         check_expr(k, conf, meta.reasons, "partition key ")
-        if T.is_dec128(k.data_type):
-            meta.reasons.append(
-                "hash partitioning by a decimal(>18) key is not "
-                "supported on TPU (Spark-exact 128-bit murmur3 pending)")
 
 
 def _convert_exchange(node: P.Exchange, children, conf):

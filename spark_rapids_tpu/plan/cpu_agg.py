@@ -76,6 +76,23 @@ def _agg_one(fn: agg.AggregateFunction, value: HostColumn, gid: np.ndarray,
             with np.errstate(over="ignore"):
                 np.add.at(acc, vgid, value.data[valid].astype(np.int64))
             return HostColumn(T.LONG, acc, has_any)
+        if isinstance(value.dtype, T.DecimalType) and isinstance(fn, agg.Sum):
+            # EXACT decimal sum (Spark semantics); overflow beyond the
+            # p+10 result precision -> NULL (non-ANSI CheckOverflow)
+            acc = np.zeros(ngroups, dtype=object)
+            np.add.at(acc, vgid, value.data[valid].astype(object))
+            bound = 10 ** out_type.precision
+            fits = np.array([abs(int(x)) < bound for x in acc], dtype=bool)
+            validity = has_any & fits
+            if out_type.precision <= T.DecimalType.MAX_LONG_DIGITS:
+                data = np.array([int(x) if ok else 0
+                                 for x, ok in zip(acc, validity)],
+                                dtype=np.int64)
+            else:
+                data = np.array([int(x) if ok else 0
+                                 for x, ok in zip(acc, validity)],
+                                dtype=object)
+            return HostColumn(out_type, data, validity)
         data = value.data[valid].astype(np.float64)
         s = np.zeros(ngroups, dtype=np.float64)
         np.add.at(s, vgid, data)
@@ -116,6 +133,15 @@ def _agg_one(fn: agg.AggregateFunction, value: HostColumn, gid: np.ndarray,
                 out[:] = uniq[safe]
             out[~has_any] = None
             return HostColumn(T.STRING, out, has_any)
+        if T.is_dec128(value.dtype):
+            # python-int object storage: bound sentinels beyond any p<=38
+            sentinel = 10 ** 39 if isinstance(fn, agg.Min) else -(10 ** 39)
+            acc = np.full(ngroups, sentinel, dtype=object)
+            red = np.minimum if isinstance(fn, agg.Min) else np.maximum
+            red.at(acc, vgid, value.data[valid].astype(object))
+            data = np.array([int(x) if ok else 0
+                             for x, ok in zip(acc, has_any)], dtype=object)
+            return HostColumn(value.dtype, data, has_any)
         dt = value.dtype.np_dtype
         if np.issubdtype(dt, np.floating):
             sentinel = np.inf if isinstance(fn, agg.Min) else -np.inf

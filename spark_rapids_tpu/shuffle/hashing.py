@@ -125,6 +125,12 @@ def murmur3_hash_device(cols: List[Tuple[object, object, T.DataType]],
             rows = byte_matrix[codes]
             lengths = len_vec[codes]
             nh = _hash_string_bytes(rows, lengths, h)
+        elif T.is_dec128(dt):
+            # ENGINE convention (diverges from Spark's byte-array hash of
+            # p>18 decimals, which is row-variable-length): hash the two
+            # limbs as two longs — both engine paths agree, and partition
+            # ASSIGNMENT never changes query results
+            nh = _hash_long(data[:, 1], _hash_long(data[:, 0], h))
         elif isinstance(dt, (T.LongType, T.TimestampType)) or \
                 (isinstance(dt, T.DecimalType)):
             nh = _hash_long(data.astype(jnp.int64), h)
@@ -201,6 +207,15 @@ def _np_hash_long(v, seed):
     return _np_fmix(h1, 8)
 
 
+def _dec128_twos_complement_bytes(v: int) -> bytes:
+    """java.math.BigInteger.toByteArray(): minimal-length big-endian
+    two's complement."""
+    if v == 0:
+        return b"\x00"
+    length = (v.bit_length() + 8) // 8  # +1 sign bit, rounded up
+    return v.to_bytes(length, byteorder="big", signed=True)
+
+
 def _np_hash_bytes(b: bytes, seed):
     h1 = np.uint32(seed)
     aligned = len(b) - len(b) % 4
@@ -222,6 +237,16 @@ def murmur3_hash_host(values: List[Tuple[object, bool, T.DataType]],
             continue
         if isinstance(dt, T.StringType):
             h = _np_hash_bytes(str(v).encode("utf-8"), h)
+        elif T.is_dec128(dt):
+            # Spark-exact: murmur3 over the unscaled BigInteger's
+            # minimal big-endian two's-complement bytes
+            # (HashExpression.scala decimal precision > 18 case). The
+            # DEVICE partitioner hashes the two limbs as longs instead
+            # (row-variable byte lengths don't map to static shapes);
+            # partition assignment never changes results, and the
+            # user-visible hash() expression over dec128 falls back to
+            # THIS Spark-exact path
+            h = _np_hash_bytes(_dec128_twos_complement_bytes(int(v)), h)
         elif isinstance(dt, (T.LongType, T.TimestampType, T.DecimalType)):
             h = _np_hash_long(v, h)
         elif isinstance(dt, T.DoubleType):

@@ -178,21 +178,21 @@ def test_multibatch_concat_and_filter(session, cpu_session):
 
 # -- honest fallback for unimplemented kernels -------------------------------
 
-def test_sum_over_p38_falls_back_with_reason(session, cpu_session):
+def test_avg_over_p38_falls_back_with_reason(session, cpu_session):
     vals = [10**20, 2 * 10**20, None, 5]
 
     def q(s):
         return _df(s, vals).agg(F.count("d").alias("c"))
 
-    # count works on device
+    # count (and sum/min/max — see the agg kernel tests) run on device
     assert q(session).collect() == q(cpu_session).collect() == [(3,)]
 
-    sum_df = _df(session, vals).agg(F.sum("d").alias("s"))
-    plan = sum_df.explain()
+    avg_df = _df(session, vals).agg(F.avg("d").alias("a"))
+    plan = avg_df.explain()
     assert "decimal(>18)" in plan, plan
     # and the fallback answers exactly what the CPU oracle answers
-    assert sum_df.collect() == \
-        _df(cpu_session, vals).agg(F.sum("d").alias("s")).collect()
+    assert avg_df.collect() == \
+        _df(cpu_session, vals).agg(F.avg("d").alias("a")).collect()
 
 
 def test_matrix_reports_dec128_storage(session):
@@ -231,10 +231,16 @@ def test_repartition_with_p38_payload(session, cpu_session):
     want = sorted(q(cpu_session).collect(), key=repr)
     assert got == want
 
+    # hash-partitioning BY a dec128 key: two-limb long-pair murmur3,
+    # device and host partitioners agree
     by_dec = _df(session, vals).repartition(4, "d")
-    assert "decimal(>18)" in by_dec.explain()
+    assert "decimal(>18)" not in by_dec.explain()
     assert sorted(r[0] for r in by_dec.collect() if r[0] is not None) \
         == sorted(v for v in vals if v is not None)
+    cpu_rows = sorted(
+        r[0] for r in _df(cpu_session, vals).repartition(4, "d").collect()
+        if r[0] is not None)
+    assert cpu_rows == sorted(v for v in vals if v is not None)
 
 
 def test_null_safe_equality(session, cpu_session):
@@ -341,3 +347,155 @@ def test_window_partition_by_p38_key(session, cpu_session):
     got = sorted(q(session).collect(), key=repr)
     want = sorted(q(cpu_session).collect(), key=repr)
     assert got == want
+
+
+# -- dec128 aggregate kernels (exact limb sums, two-limb min/max) ------------
+
+def test_sum_p38_exact_on_device(session, cpu_session):
+    """sum(decimal) is EXACT (limb sums, not an f64 ride) for both the
+    dec128 and decimal64 storage tiers."""
+    keys = np.array([0, 1, 0, 1, 0], dtype=np.int64)
+    vals = [10**30 + 1, -(10**25), 10**30 + 2, 5, 7]
+
+    def q(s):
+        df = s.create_dataframe({"k": keys, "d": vals}, dtypes={"d": P38})
+        return df.group_by("k").agg(F.sum("d").alias("s"),
+                                    F.min("d").alias("mn"),
+                                    F.max("d").alias("mx"))
+
+    got = sorted(q(session).collect())
+    want = sorted(q(cpu_session).collect())
+    assert got == want
+    by_k = {r[0]: r[1:] for r in got}
+    assert by_k[0] == (2 * 10**30 + 10, 7, 10**30 + 2)
+    assert by_k[1] == (-(10**25) + 5, -(10**25), 5)
+    assert_runs_on_tpu(
+        lambda s: s.create_dataframe({"k": keys, "d": vals},
+                                     dtypes={"d": P38})
+        .group_by("k").agg(F.sum("d").alias("s")), session)
+
+
+def test_sum_decimal64_exact_beyond_f53(session, cpu_session):
+    """decimal64 sums beyond 2^53 must stay exact (an f64 ride would
+    round): 1e15-scale unscaled values x 2000 rows."""
+    P15 = T.DecimalType(15, 2)
+    vals = np.full(2000, 10**14 + 3, dtype=np.int64)
+
+    def q(s):
+        df = s.create_dataframe({"d": vals}, dtypes={"d": P15})
+        return df.agg(F.sum("d").alias("s"))
+
+    got = q(session).collect()
+    assert got == q(cpu_session).collect()
+    assert got == [(2000 * (10**14 + 3),)]  # exact integer
+
+
+def test_sum_p38_overflow_nulls(session, cpu_session):
+    """A sum beyond the result precision (p=38 already maxed) nulls
+    (non-ANSI CheckOverflow semantics)."""
+    vals = [MAX38, MAX38, MAX38]
+
+    def q(s):
+        return _df(s, vals).agg(F.sum("d").alias("s"))
+
+    got = q(session).collect()
+    assert got == q(cpu_session).collect() == [(None,)]
+
+
+def test_minmax_p38_two_limb_tiebreak(session, cpu_session):
+    """Values sharing a high limb order by the UNSIGNED low limb."""
+    base = 5 << 64
+    vals = [base + 1, base + (1 << 63), base + 2, None, -(1 << 64) - 9]
+
+    def q(s):
+        return _df(s, vals).agg(F.min("d").alias("mn"),
+                                F.max("d").alias("mx"))
+
+    got = q(session).collect()
+    assert got == q(cpu_session).collect()
+    assert got == [(-(1 << 64) - 9, base + (1 << 63))]
+
+
+def test_sum_p38_multibatch_merge(session, cpu_session):
+    """Partial/merge streaming path sums dec128 exactly across batches."""
+    rng = np.random.default_rng(21)
+    vals = [int(v) * 10**20 + int(w) for v, w in
+            zip(rng.integers(-10**6, 10**6, 900),
+                rng.integers(0, 1000, 900))]
+    keys = rng.integers(0, 7, 900).astype(np.int64)
+
+    def q(s):
+        df = s.create_dataframe({"k": keys, "d": vals},
+                                dtypes={"d": P38}, num_batches=4)
+        return df.group_by("k").agg(F.sum("d").alias("s"))
+
+    got = sorted(q(session).collect())
+    want = sorted(q(cpu_session).collect())
+    assert got == want
+    import collections
+    truth = collections.defaultdict(int)
+    for k, v in zip(keys, vals):
+        truth[int(k)] += v
+    assert {r[0]: r[1] for r in got} == dict(truth)
+
+
+def test_sum_p38_overflow_in_one_batch_nulls_final(session, cpu_session):
+    """A single BATCH overflowing must null the FINAL merged sum, not
+    silently drop that batch's rows (review fix)."""
+    # batch 1 alone overflows p=38; batch 2 is tiny
+    vals = [MAX38, MAX38, 5, 7]
+
+    def q(s):
+        df = s.create_dataframe({"d": vals}, dtypes={"d": P38},
+                                num_batches=2)
+        return df.agg(F.sum("d").alias("s"))
+
+    got = q(session).collect()
+    assert got == q(cpu_session).collect() == [(None,)]
+
+
+def test_hash_expression_over_p38_is_spark_exact(session):
+    """F.hash()/xxhash64 over dec128 fall back to the Spark-exact
+    byte-array hash (review fix — the device limb hash serves only
+    partitioning)."""
+    from spark_rapids_tpu.ops.hashfns import Murmur3Hash, XxHash64
+
+    vals = [MAX38, -(1 << 64) - 3, 0, None]
+    df = _df(session, vals).select(
+        Murmur3Hash(col("d")).alias("h"), XxHash64(col("d")).alias("x"))
+    assert "unsupported type" in df.explain()
+    got = {v: (h, x) for v, (h, x) in
+           zip(vals, df.collect())}
+
+    # independent Spark-truth: murmur3/xxhash over BigInteger.toByteArray
+    import numpy as np
+    from spark_rapids_tpu.shuffle.hashing import (
+        _dec128_twos_complement_bytes,
+        _np_hash_bytes,
+    )
+    from spark_rapids_tpu.ops.hashfns import XX_SEED, _np_xx_bytes
+    for v in vals:
+        if v is None:
+            continue
+        want_h = int(np.int32(_np_hash_bytes(
+            _dec128_twos_complement_bytes(v), np.uint32(42))))
+        assert got[v][0] == want_h, v
+
+
+def test_csv_escape_newline_semantics_consistent(session, cpu_session,
+                                                 tmp_path):
+    """newlines_in_values stays False for plain CSV (review fix: it is
+    hive-text-only — it governs pyarrow's multithreaded block
+    splitting). NOTE: pyarrow's parser inherently treats an escaped
+    newline as data with escape_char set (a documented divergence from
+    Spark's unquoted multiLine=false split); both engine paths agree."""
+    from spark_rapids_tpu import types as T
+    p = tmp_path / "c.csv"
+    p.write_text("a~\nb,1\nplain,2\n")
+
+    def q(s):
+        return s.read_csv(str(p), escape="~", header=False,
+                          schema=[("s", T.STRING), ("x", T.LONG)],
+                          mode="PERMISSIVE").collect()
+
+    assert sorted(q(session), key=repr) == sorted(q(cpu_session), key=repr)
