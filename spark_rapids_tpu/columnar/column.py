@@ -10,6 +10,8 @@ import numpy as np
 from spark_rapids_tpu import types as T
 from spark_rapids_tpu.errors import ColumnarProcessingError
 
+_MISSING = object()
+
 # Lane width on TPU is 128; keep every device buffer a multiple of it so XLA
 # tiles cleanly onto the VPU/MXU.
 MIN_BUCKET = 128
@@ -64,6 +66,30 @@ class HostColumn:
     @property
     def null_count(self) -> int:
         return int(len(self.validity) - self.validity.sum())
+
+    def int_domain(self) -> Optional[Tuple[int, int]]:
+        """(min, max) over VALID rows for integer-family columns, else None.
+
+        Cheap host-side column statistics (one numpy min/max per upload,
+        cached) in the spirit of the reference's use of parquet/ORC
+        column statistics — consumed by the aggregation fast path, which
+        turns a group-by on a bounded-domain integer key into a direct
+        segment reduction with no sort (see TpuHashAggregateExec
+        _fast_layout). The result is a conservative SUPERSET contract:
+        every valid value lies in [min, max]."""
+        got = self._cache.get("int_domain", _MISSING)
+        if got is not _MISSING:
+            return got
+        dom = None
+        if (isinstance(self.dtype, (T.ByteType, T.ShortType, T.IntegerType,
+                                    T.LongType, T.DateType, T.TimestampType))
+                and isinstance(self.data, np.ndarray)
+                and self.data.dtype.kind in "iu"):
+            vals = self.data[self.validity] if not self.all_valid else self.data
+            if len(vals):
+                dom = (int(vals.min()), int(vals.max()))
+        self._cache["int_domain"] = dom
+        return dom
 
     @staticmethod
     def from_pylist(values, dtype: Optional[T.DataType] = None) -> "HostColumn":
@@ -205,15 +231,24 @@ class DeviceColumn:
                    code order == Spark UTF-8 byte order (order-preserving).
     """
 
-    __slots__ = ("dtype", "data", "validity", "dictionary", "dict_sorted")
+    __slots__ = ("dtype", "data", "validity", "dictionary", "dict_sorted",
+                 "domain")
 
     def __init__(self, dtype: T.DataType, data, validity,
-                 dictionary: Optional[np.ndarray] = None, dict_sorted: bool = True):
+                 dictionary: Optional[np.ndarray] = None, dict_sorted: bool = True,
+                 domain: Optional[Tuple[int, int]] = None):
         self.dtype = dtype
         self.data = data
         self.validity = validity
         self.dictionary = dictionary
         self.dict_sorted = dict_sorted
+        #: host-known (min, max) bound on VALID values of integer-family
+        #: columns (None = unknown). Contract: a conservative SUPERSET —
+        #: set at upload from column stats, carried only through
+        #: structural ops (with_arrays: gather/slice/permute/pad, same
+        #: logical value space as the dictionary it already carries).
+        #: Consumed by the aggregation no-sort fast path.
+        self.domain = domain
 
     @property
     def is_array(self) -> bool:
@@ -331,7 +366,8 @@ class DeviceColumn:
         np_dtype = host.dtype.np_dtype
         data = np.zeros(cap, dtype=np_dtype)
         data[:n] = host.data
-        return DeviceColumn(host.dtype, jnp.asarray(data), jnp.asarray(validity))
+        return DeviceColumn(host.dtype, jnp.asarray(data), jnp.asarray(validity),
+                            domain=host.int_domain())
 
     def to_host(self, num_rows: int) -> HostColumn:
         if self.is_array:
@@ -391,7 +427,8 @@ class DeviceColumn:
         return HostColumn(self.dtype, arr, validity)
 
     def with_arrays(self, data, validity) -> "DeviceColumn":
-        return DeviceColumn(self.dtype, data, validity, self.dictionary, self.dict_sorted)
+        return DeviceColumn(self.dtype, data, validity, self.dictionary,
+                            self.dict_sorted, domain=self.domain)
 
     def sliced_rows(self, k: int) -> "DeviceColumn":
         """First k row slots (array/map columns keep their element buffers

@@ -417,11 +417,18 @@ def concat_device(tables: Sequence["DeviceTable"]) -> "DeviceTable":
     nrows_list = tuple(t.nrows_dev for t in tables)
     lives = tuple(t.live for t in tables)
     outs, total = fn(cols_per_table, remap_per_table, nrows_list, lives)
+    def _union_domain(ci):
+        doms = [t.columns[ci].domain for t in tables]
+        if any(d is None for d in doms):
+            return None
+        return (min(d[0] for d in doms), max(d[1] for d in doms))
+
     out_cols = [
         DeviceColumn(c.dtype, d, v, dictionary=out_dicts[ci],
                      dict_sorted=out_sorted.get(
                          ci, True if out_dicts[ci] is not None
-                         else c.dict_sorted))
+                         else c.dict_sorted),
+                     domain=_union_domain(ci))
         for ci, (c, (d, v)) in enumerate(zip(tables[0].columns, outs))]
     return DeviceTable(names, out_cols, total, out_cap)
 
@@ -605,7 +612,8 @@ class DeviceTable:
         fn = _get_assemble(tuple(recipes), cap)
         outs = fn(dev_arrays, jnp.asarray(np.int32(host.num_rows)))
         cols = [
-            DeviceColumn(c.dtype, data, validity, dictionary=d)
+            DeviceColumn(c.dtype, data, validity, dictionary=d,
+                         domain=c.int_domain())
             for c, (data, validity), d in zip(host.columns, outs, dicts)
         ]
         return DeviceTable(host.names, cols, host.num_rows, cap)

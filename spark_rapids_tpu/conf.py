@@ -421,6 +421,15 @@ AGG_MAX_DICT_GROUPS = int_conf(
     "fast path (grouping keys that are dictionary-encoded strings or "
     "booleans aggregate by direct segment reduction, no sort).")
 
+AGG_MAX_KEY_DOMAIN_GROUPS = int_conf(
+    "spark.rapids.tpu.agg.maxKeyDomainGroups", 1 << 21,
+    "Max key-domain product for the no-sort INTEGER-key aggregation fast "
+    "path: when every grouping key is an integer-family column whose "
+    "(min,max) bound is known from upload-time column statistics, the "
+    "group-by runs as a direct segment reduction over the value domain "
+    "instead of a full sort. 0 disables. Domains above this (or above "
+    "16x the batch capacity) fall back to the sort-segment path.")
+
 AGG_FUSE_INPUT = bool_conf(
     "spark.rapids.tpu.agg.fuseInput", True,
     "Fuse Project/Filter chains feeding an aggregate into the aggregate "

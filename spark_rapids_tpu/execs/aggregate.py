@@ -80,13 +80,12 @@ def _dec_limb_words(sd):
     return (lo & _M32, (lo >> 32) & _M32, hi & _M32, hi >> 32)
 
 
-def _dec_sum_segments(out_type, sd, sv, gid, nseg, has_any):
-    """EXACT decimal segment sum (Spark sums decimals exactly; an f64
-    ride would round beyond 2^53): per-word i64 segment sums (each word
-    < 2^32 and row counts < 2^31, so partials are exact), carry
-    normalization back to two limbs, overflow -> NULL (non-ANSI
-    CheckOverflow semantics). Works for decimal64 AND dec128 inputs."""
-    from spark_rapids_tpu.ops.decimal import i128_abs_fits_pow10
+def _dec_wide_sum_segments(sd, sv, gid, nseg):
+    """EXACT 128-bit segment sum of unscaled decimal storage: per-word i64
+    segment sums (each word < 2^32 and row counts < 2^31, so partials are
+    exact), carry-normalized back to (hi, lo) two's-complement limbs.
+    Returns (hi, lo, t3) where t3 holds bits >=96 of the TRUE sum for
+    overflow detection. Works for decimal64 AND dec128 inputs."""
     words = _dec_limb_words(sd)
     sums = [jax.ops.segment_sum(jnp.where(sv, w, 0), gid,
                                 num_segments=nseg) for w in words]
@@ -99,6 +98,25 @@ def _dec_sum_segments(out_type, sd, sv, gid, nseg, has_any):
     t3 = sums[3] + c
     hi = (t3 << 32) | r2
     lo = (r1 << 32) | r0
+    return hi, lo, t3
+
+
+def _dec_wide_to_f64(hi, lo):
+    """(hi, lo) i128 -> f64 via sign-magnitude (a direct hi*2^64 + lo
+    combine cancels catastrophically for small negatives)."""
+    from spark_rapids_tpu.ops.decimal import i128_abs
+    ahi, alo, neg = i128_abs(hi, lo.astype(jnp.uint64))
+    mag = (ahi.astype(jnp.float64) * float(2.0 ** 64)
+           + alo.astype(jnp.float64))
+    return jnp.where(neg, -mag, mag)
+
+
+def _dec_sum_segments(out_type, sd, sv, gid, nseg, has_any):
+    """EXACT decimal segment sum (Spark sums decimals exactly; an f64
+    ride would round beyond 2^53): 128-bit word sums, overflow -> NULL
+    (non-ANSI CheckOverflow semantics)."""
+    from spark_rapids_tpu.ops.decimal import i128_abs_fits_pow10
+    hi, lo, t3 = _dec_wide_sum_segments(sd, sv, gid, nseg)
     # t3 holds bits >=96 of the TRUE sum (no i64 overflow possible at
     # <2^31 rows), so a t3 outside i32 range means 128-bit overflow
     ovf = (t3 > 0x7FFFFFFF) | (t3 < -0x80000000)
@@ -146,7 +164,8 @@ class TpuHashAggregateExec(TpuExec):
                  grouping_names: Sequence[str],
                  filters: Sequence[Expression] = (),
                  use_split: bool = False,
-                 max_dict_groups: int = 1 << 16):
+                 max_dict_groups: int = 1 << 16,
+                 max_domain_groups: int = 1 << 21):
         super().__init__()
         self.children = (child,)
         self.grouping = list(grouping)
@@ -155,6 +174,7 @@ class TpuHashAggregateExec(TpuExec):
         self.filters = list(filters)
         self.use_split = use_split
         self.max_dict_groups = max_dict_groups
+        self.max_domain_groups = max_domain_groups
 
     def output_schema(self):
         out = [(n, g.data_type) for n, g in zip(self.grouping_names, self.grouping)]
@@ -357,9 +377,16 @@ class TpuHashAggregateExec(TpuExec):
             val_preps.append(per_child)
         return pctx, filter_preps, key_preps, val_preps
 
-    def _fast_layout(self, grouping, key_preps) -> Optional[tuple]:
-        """Dictionary-code layout if every key has a small known domain:
-        (kinds, sizes, strides, padded_num_segments)."""
+    def _fast_layout(self, grouping, key_preps, capacity) -> Optional[tuple]:
+        """No-sort layout if every key has a small known domain:
+        (kinds, sizes, strides, padded_num_segments, bases).
+
+        Three key kinds aggregate by direct segment reduction (no sort):
+        dictionary-encoded strings, booleans, and — via upload-time column
+        statistics (DeviceColumn.domain) — integer-family keys whose value
+        domain is bounded. gid = sum_i (code_i * stride_i) where an int
+        key's code is ``value - base_i`` (bases ride as device operands so
+        one trace serves any same-shaped domain)."""
         if self.max_dict_groups <= 0:
             return None
         if any(isinstance(fn, SORT_ONLY_AGGS) for _, fn in self.agg_specs):
@@ -368,24 +395,44 @@ class TpuHashAggregateExec(TpuExec):
             # ungrouped aggregate: ONE segment (padded to 8) — the batched
             # one-hot pass beats _agg_one's capacity-segment scatter by ~8x
             # wall on a 1M-row q2-style global sum
-            return (), (), (), 8
+            return (), (), (), 8, ()
         kinds: List[str] = []
         sizes: List[int] = []
+        bases: List[int] = []
+        has_int = False
         for g, preps in zip(grouping, key_preps):
             dt = g.data_type
             root = preps[-1]
             if isinstance(dt, T.StringType) and root.out_dict is not None:
                 kinds.append("str")
                 sizes.append(len(root.out_dict) + 1)  # +1: null slot
+                bases.append(0)
             elif isinstance(dt, T.BooleanType):
                 kinds.append("bool")
                 sizes.append(3)  # False, True, null
+                bases.append(0)
+            elif (isinstance(dt, (T.ByteType, T.ShortType, T.IntegerType,
+                                  T.LongType, T.DateType, T.TimestampType))
+                  and root.out_domain is not None
+                  and self.max_domain_groups > 0):
+                lo, hi = root.out_domain
+                kinds.append("int")
+                sizes.append(hi - lo + 2)  # values + null slot
+                bases.append(lo)
+                has_int = True
             else:
                 return None
         total = 1
         for s in sizes:
             total *= max(s, 1)
-        if total > self.max_dict_groups:
+        cap = self.max_dict_groups
+        if has_int:
+            # int domains are data-dependent, not cardinality-bounded like
+            # a string dictionary: allow larger segment counts (scatter
+            # segment ops are O(n + gpad)) but never a domain so sparse it
+            # dwarfs the batch itself
+            cap = max(cap, min(self.max_domain_groups, 16 * capacity))
+        if total > cap:
             return None
         strides = [1] * len(sizes)
         for i in range(len(sizes) - 2, -1, -1):
@@ -394,7 +441,7 @@ class TpuHashAggregateExec(TpuExec):
         # one-hot einsum traffic scales with it, and a q1-style 12-slot
         # domain must pad to 16, not 128
         gpad = max(8, 1 << (max(total - 1, 1)).bit_length())
-        return tuple(kinds), sizes, strides, gpad
+        return tuple(kinds), sizes, strides, gpad, bases
 
     def _aggregate(self, table: DeviceTable, grouping, agg_specs,
                    grouping_names, filters) -> DeviceTable:
@@ -411,7 +458,7 @@ class TpuHashAggregateExec(TpuExec):
         aux = prep_aux(pctx)
         capacity = table.capacity
 
-        fast = self._fast_layout(grouping, key_preps)
+        fast = self._fast_layout(grouping, key_preps, capacity)
 
         from spark_rapids_tpu.ops.expr import shared_traces
         self._traces = shared_traces(
@@ -441,11 +488,12 @@ class TpuHashAggregateExec(TpuExec):
             self._traces[tkey] = fn
 
         if fast:
-            _, sizes, strides, gpad = fast
+            _, sizes, strides, gpad, bases = fast
             out_arrays, ngroups = fn(
                 cols, aux, table.nrows_dev,
                 device_const(np.asarray(sizes, dtype=np.int32)),
                 device_const(np.asarray(strides, dtype=np.int32)),
+                device_const(np.asarray(bases, dtype=np.int64)),
                 table.live)
             out_capacity = gpad
         else:
@@ -459,7 +507,8 @@ class TpuHashAggregateExec(TpuExec):
             root = key_preps[i][-1]
             out_cols.append(DeviceColumn(g.data_type, data, validity,
                                          dictionary=root.out_dict,
-                                         dict_sorted=root.dict_sorted))
+                                         dict_sorted=root.dict_sorted,
+                                         domain=root.out_domain))
             names.append(name)
         for j, (name, fnagg) in enumerate(agg_specs):
             data, validity = out_arrays[len(grouping) + j]
@@ -538,7 +587,7 @@ class TpuHashAggregateExec(TpuExec):
         value_exprs = [list(fn.children) for _, fn in agg_specs]
         use_split = self.use_split
 
-        def kernel(cols, aux, nrows, sizes, strides, live_in):
+        def kernel(cols, aux, nrows, sizes, strides, bases, live_in):
             live = self._eval_live(filters, capacity, cols, aux, nrows,
                                    filter_preps, live_in)
 
@@ -547,8 +596,19 @@ class TpuHashAggregateExec(TpuExec):
                 ctx = EvalCtx(cols, aux, nrows, capacity, live=live_in)
                 ctx._prep_iter = iter(preps)
                 kv = _walk_eval(g, ctx)
-                code = kv.data.astype(jnp.int32) if kind == "bool" else kv.data
-                code = jnp.where(kv.validity, code, sizes[i] - 1)
+                if kind == "int":
+                    # domain-coded integer key: value - base. The where
+                    # runs BEFORE the int32 narrowing — invalid/padding
+                    # slots hold arbitrary data, valid ones are inside the
+                    # stats bound by the domain superset contract.
+                    delta = kv.data.astype(jnp.int64) - bases[i]
+                    code = jnp.where(kv.validity, delta,
+                                     (sizes[i] - 1).astype(jnp.int64))
+                    code = code.astype(jnp.int32)
+                else:
+                    code = (kv.data.astype(jnp.int32)
+                            if kind == "bool" else kv.data)
+                    code = jnp.where(kv.validity, code, sizes[i] - 1)
                 gid = gid + code * strides[i]
 
             # ---- batched value aggregation ------------------------------
@@ -600,7 +660,13 @@ class TpuHashAggregateExec(TpuExec):
             for i, kind in enumerate(kinds):
                 slot = (slot_ix // strides[i]) % sizes[i]
                 kvalid = slot != (sizes[i] - 1)
-                kdata = (slot == 1) if kind == "bool" else slot
+                if kind == "bool":
+                    kdata = slot == 1
+                elif kind == "int":
+                    kdata = (slot.astype(jnp.int64) + bases[i]).astype(
+                        grouping[i].data_type.np_dtype)
+                else:
+                    kdata = slot
                 outs.append(compact(kdata, kvalid))
 
             fplan = []  # (spec index, kind) riding a batched f64 pass
@@ -609,7 +675,12 @@ class TpuHashAggregateExec(TpuExec):
                                       agg.VariancePop, agg.VarianceSamp)):
                     fplan.append((j, "var"))
                 elif isinstance(fnagg, agg.Average):
-                    fplan.append((j, "avg"))
+                    # decimal averages sum EXACTLY in i64 unscaled space
+                    # (_agg_one; Spark computes avg(decimal) from an exact
+                    # decimal sum — the split guard's 1e-6 tolerance is not
+                    # decimal semantics), so they skip the f64 ride
+                    if not isinstance(fnagg.child.data_type, T.DecimalType):
+                        fplan.append((j, "avg"))
                 elif isinstance(fnagg, agg.Sum) and not isinstance(
                         fnagg.data_type, (T.LongType, T.DecimalType)):
                     # decimal sums are EXACT limb sums (_agg_one), never
@@ -636,8 +707,9 @@ class TpuHashAggregateExec(TpuExec):
 
             # second batched pass: centered moments (positive values, so the
             # split path's relative-error guard applies cleanly)
+            var_j = vplan_j
             ccols = []
-            for j in vplan_j:
+            for j in var_j:
                 mean = fsums[j] / jnp.maximum(nonnulls[j], 1)
                 ccols.append(jnp.where(
                     svs[j],
@@ -645,7 +717,7 @@ class TpuHashAggregateExec(TpuExec):
                     0.0))
             csums = batched_segment_sum_f64(ccols, gid, gpad, capacity,
                                             use_split)
-            m2s = {j: csums[:, i2] for i2, j in enumerate(vplan_j)}
+            m2s = {j: csums[:, i2] for i2, j in enumerate(var_j)}
 
             fres = {}
             for j, kind in fplan:
@@ -830,6 +902,15 @@ class TpuHashAggregateExec(TpuExec):
             return (jnp.where(has_any, s, 0.0), has_any)
 
         if isinstance(fnagg, agg.Average):
+            if isinstance(fnagg.child.data_type, T.DecimalType):
+                # EXACT 128-bit unscaled sum (Spark computes avg(decimal)
+                # from an exact decimal sum; riding the f64 split pass
+                # would accumulate error per row), ONE sign-magnitude
+                # rounding at the final f64 convert + divide
+                hi128, lo128, _ = _dec_wide_sum_segments(sd, sv, gid, nseg)
+                tot = _dec_wide_to_f64(hi128, lo128)
+                return (jnp.where(has_any, tot / jnp.maximum(nonnull, 1),
+                                  0.0), has_any)
             v = jnp.where(sv, sd.astype(jnp.float64), 0.0)
             s = segment_sum_f64(v, gid, nseg, capacity, use_split)
             return (jnp.where(has_any, s / jnp.maximum(nonnull, 1), 0.0), has_any)
@@ -856,6 +937,15 @@ class TpuHashAggregateExec(TpuExec):
                 and getattr(sd, "ndim", 1) == 2:
             return _dec128_minmax_segments(
                 isinstance(fnagg, agg.Min), sd, sv, gid, nseg, has_any)
+
+        if isinstance(fnagg, (agg.Min, agg.Max)) \
+                and use_split and sd.dtype in (jnp.float64, jnp.int64):
+            # native-32-bit two-pass limb reduction (ops/segsum.py) — the
+            # emulated-64 scatter compare-select it replaces dominates
+            # whole queries at large segment counts
+            from spark_rapids_tpu.ops.segsum import segment_minmax_64
+            r = segment_minmax_64(isinstance(fnagg, agg.Min), sd, sv, gid, nseg)
+            return (jnp.where(has_any, r, jnp.zeros_like(r)), has_any)
 
         if isinstance(fnagg, (agg.Min, agg.Max)):
             dt = sd.dtype

@@ -230,13 +230,13 @@ class TpuNestedLoopJoinExec(TpuExec):
         names = self.left_names + self.right_names
         all_cols = list(lt.columns) + list(rt.columns)
         pair_cols = [DeviceColumn(c.dtype, d, v, dictionary=c.dictionary,
-                                  dict_sorted=c.dict_sorted)
+                                  dict_sorted=c.dict_sorted, domain=c.domain)
                      for c, (d, v) in zip(all_cols, pair_arrays)]
         outs.append(DeviceTable(names, pair_cols, n_pairs,
                                 pair_cols[0].capacity))
         if un_arrays is not None:
             un_cols = [DeviceColumn(c.dtype, d, v, dictionary=c.dictionary,
-                                    dict_sorted=c.dict_sorted)
+                                    dict_sorted=c.dict_sorted, domain=c.domain)
                        for c, (d, v) in zip(all_cols, un_arrays)]
             outs.append(DeviceTable(names, un_cols, n_un, cap_p))
         return outs, (b_match if jt in ("full", "fullouter", "outer") else None)

@@ -376,7 +376,7 @@ class _ColumnGather:
         valids = tuple(c.validity for c in table.columns)
         outs = fn(datas, valids, idx, null_mask, out_live)
         return [DeviceColumn(c.dtype, d, v, dictionary=c.dictionary,
-                             dict_sorted=c.dict_sorted)
+                             dict_sorted=c.dict_sorted, domain=c.domain)
                 for c, (d, v) in zip(table.columns, outs)]
 
 
@@ -729,7 +729,8 @@ class TpuJoinExec(TpuExec):
         rcols = []
         for c, (d, v) in zip(rt.columns, outs[len(lt.columns):]):
             rcols.append(DeviceColumn(c.dtype, d, v, dictionary=c.dictionary,
-                                      dict_sorted=c.dict_sorted))
+                                      dict_sorted=c.dict_sorted,
+                                      domain=c.domain))
         names = self.left_names + self.right_names
         cols = rcols + lcols if swapped else lcols + rcols
         return DeviceTable(names, cols, nout, lt.capacity, live=live_out)

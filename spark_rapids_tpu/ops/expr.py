@@ -30,6 +30,11 @@ class NodePrep:
     dict_sorted: bool = True
     aux_slots: Tuple[int, ...] = ()
     extra: dict = field(default_factory=dict)
+    #: (min, max) bound on valid values of an integer-family output
+    #: (DeviceColumn.domain carried through prep; per-batch data, NOT part
+    #: of the trace key — consumers must feed the bounds in as device
+    #: operands, never bake them into the trace)
+    out_domain: Optional[Tuple[int, int]] = None
 
 
 class PrepCtx:
@@ -327,7 +332,10 @@ class BoundReference(Expression):
 
     def prep(self, pctx: PrepCtx, child_preps) -> NodePrep:
         c = pctx.table.columns[self.ordinal]
-        return NodePrep(out_dict=c.dictionary, dict_sorted=c.dict_sorted)
+        # lambda-scope evaluation binds SimpleNamespace pseudo-columns
+        # (ops/nested.py), hence getattr
+        return NodePrep(out_dict=c.dictionary, dict_sorted=c.dict_sorted,
+                        out_domain=getattr(c, "domain", None))
 
     def eval_dev(self, ctx: EvalCtx, child_vals, prep) -> DevVal:
         return ctx.cols[self.ordinal]
@@ -561,7 +569,8 @@ class CompiledProject:
             root_prep = preps[-1]
             out_cols.append(DeviceColumn(
                 e.data_type, dv.data, dv.validity,
-                dictionary=root_prep.out_dict, dict_sorted=root_prep.dict_sorted))
+                dictionary=root_prep.out_dict, dict_sorted=root_prep.dict_sorted,
+                domain=root_prep.out_domain))
         return out_cols
 
 

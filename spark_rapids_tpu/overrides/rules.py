@@ -486,7 +486,8 @@ def _convert_filter(node: P.Filter, children, conf):
 
 
 def _convert_aggregate(node: P.Aggregate, children, conf):
-    from spark_rapids_tpu.conf import AGG_FUSE_INPUT, AGG_MAX_DICT_GROUPS
+    from spark_rapids_tpu.conf import (AGG_FUSE_INPUT, AGG_MAX_DICT_GROUPS,
+                                       AGG_MAX_KEY_DOMAIN_GROUPS)
     from spark_rapids_tpu.execs.fuse import peel_input_chain
     from spark_rapids_tpu.ops.segsum import resolve_split_mode
 
@@ -512,7 +513,9 @@ def _convert_aggregate(node: P.Aggregate, children, conf):
                                 node.grouping_names,
                                 filters=filters,
                                 use_split=resolve_split_mode(conf),
-                                max_dict_groups=conf.get_entry(AGG_MAX_DICT_GROUPS))
+                                max_dict_groups=conf.get_entry(AGG_MAX_DICT_GROUPS),
+                                max_domain_groups=conf.get_entry(
+                                    AGG_MAX_KEY_DOMAIN_GROUPS))
 
 
 def _convert_sort(node: P.Sort, children, conf):

@@ -212,7 +212,11 @@ def _dec128_twos_complement_bytes(v: int) -> bytes:
     two's complement."""
     if v == 0:
         return b"\x00"
-    length = (v.bit_length() + 8) // 8  # +1 sign bit, rounded up
+    # BigInteger.bitLength() is the MINIMAL two's-complement length
+    # excluding the sign bit: for negatives that is (~v).bit_length()
+    # (e.g. -128 encodes as one byte 0x80, not 0xff80)
+    bitlen = (~v).bit_length() if v < 0 else v.bit_length()
+    length = bitlen // 8 + 1
     return v.to_bytes(length, byteorder="big", signed=True)
 
 

@@ -1,0 +1,284 @@
+"""Int-domain no-sort aggregation fast path + 32-bit limb segment min/max.
+
+The reference aggregates through cudf hash aggregation
+(GpuHashAggregateExec, aggregate.scala); the TPU engine's analog for
+bounded-domain integer keys is a direct segment reduction over the value
+domain, driven by upload-time column statistics (DeviceColumn.domain).
+These tests pin:
+  - fast-path vs sort-path result parity (nulls, negatives, multi-key)
+  - domain propagation: upload -> filter -> project -> join -> concat
+  - the 64-bit min/max two-pass limb reduction (NaN/inf/-0.0, i64 extremes)
+  - cap fallback to the sorted path when the domain is too large
+"""
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import functions as F
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.column import HostColumn
+from spark_rapids_tpu.columnar.table import HostTable
+from spark_rapids_tpu.execs import aggregate as agg_mod
+from spark_rapids_tpu.ops.expr import col, lit
+from spark_rapids_tpu.plan import from_host_table
+from spark_rapids_tpu.session import TpuSession
+
+
+def _nullsafe_key(r):
+    return tuple((x is None, x) for x in r)
+
+
+def _sorted_rows(df):
+    return sorted(df.collect(), key=_nullsafe_key)
+
+
+def _mktable(n=8000, seed=0, kmax=700):
+    rng = np.random.default_rng(seed)
+    keys = rng.integers(-40, kmax, n).astype(np.int64)
+    kvalid = rng.random(n) > 0.05
+    vals = rng.normal(size=n) * 100
+    vvalid = rng.random(n) > 0.1
+    small = rng.integers(0, 5, n).astype(np.int32)
+    return HostTable(
+        ["k", "v", "i"],
+        [HostColumn(T.LongType(), keys, kvalid),
+         HostColumn(T.DoubleType(), vals, vvalid),
+         HostColumn(T.IntegerType(), small)])
+
+
+def _slow_session():
+    return TpuSession({"spark.rapids.tpu.agg.maxKeyDomainGroups": 0,
+                       "spark.rapids.tpu.agg.maxDictGroups": 0})
+
+
+def _assert_rows_close(fast, slow):
+    assert len(fast) == len(slow)
+    for a, b in zip(fast, slow):
+        assert len(a) == len(b)
+        for ca, cb in zip(a, b):
+            if isinstance(ca, float) and isinstance(cb, float):
+                if np.isnan(cb):
+                    assert np.isnan(ca)
+                else:
+                    assert ca == pytest.approx(cb, rel=1e-9, abs=1e-9)
+            else:
+                assert ca == cb
+
+
+class SpyLayout:
+    """Asserts the int fast layout fired (or not) during a collect."""
+
+    def __init__(self, monkeypatch):
+        self.layouts = []
+        orig = agg_mod.TpuHashAggregateExec._fast_layout
+
+        def spy(slf, grouping, key_preps, capacity):
+            r = orig(slf, grouping, key_preps, capacity)
+            if grouping:
+                self.layouts.append(None if r is None else r[0])
+            return r
+
+        monkeypatch.setattr(agg_mod.TpuHashAggregateExec, "_fast_layout", spy)
+
+    @property
+    def int_fired(self):
+        return any(l is not None and "int" in l for l in self.layouts)
+
+
+def test_int_key_fast_vs_sorted_parity(monkeypatch):
+    ht = _mktable()
+    spy = SpyLayout(monkeypatch)
+    q = lambda s: (from_host_table(ht, s).group_by("k")
+                   .agg(F.sum("v").alias("s"), F.count("v").alias("c"),
+                        F.min("v").alias("mn"), F.max("v").alias("mx"),
+                        F.avg("v").alias("av")))
+    fast = _sorted_rows(q(TpuSession()))
+    assert spy.int_fired
+    slow = _sorted_rows(q(_slow_session()))
+    _assert_rows_close(fast, slow)
+    # null-key group present (Spark groups null keys)
+    assert any(r[0] is None for r in fast)
+
+
+def test_multi_int_key_and_mixed_string(monkeypatch):
+    rng = np.random.default_rng(3)
+    n = 4000
+    ht = HostTable(
+        ["a", "b", "s", "v"],
+        [HostColumn(T.IntegerType(), rng.integers(0, 9, n).astype(np.int32)),
+         HostColumn(T.LongType(), rng.integers(-5, 60, n).astype(np.int64),
+                    rng.random(n) > 0.1),
+         HostColumn.from_pylist(
+             [str(x) for x in rng.integers(0, 4, n)], T.StringType()),
+         HostColumn(T.DoubleType(), rng.normal(size=n))])
+    spy = SpyLayout(monkeypatch)
+    q = lambda s: (from_host_table(ht, s).group_by("a", "b", "s")
+                   .agg(F.count("v").alias("c"), F.sum("v").alias("sv")))
+    fast = _sorted_rows(q(TpuSession()))
+    assert spy.int_fired  # int keys compose with the string-dict kind
+    slow = _sorted_rows(q(_slow_session()))
+    _assert_rows_close(fast, slow)
+
+
+def test_domain_survives_filter_project_join_concat(monkeypatch):
+    ht = _mktable(n=3000, seed=5, kmax=300)
+    dims = HostTable(
+        ["k2", "name"],
+        [HostColumn(T.LongType(), np.arange(-40, 300).astype(np.int64)),
+         HostColumn.from_pylist(
+             ["n%d" % i for i in range(340)], T.StringType())])
+    spy = SpyLayout(monkeypatch)
+    s = TpuSession()
+    df = (from_host_table(ht, s)
+          .filter(col("v") > lit(-1000.0))           # filter keeps domain
+          .with_column("k2", col("k"))               # project keeps domain
+          .join(from_host_table(dims, s), on=["k2"], how="inner")
+          .group_by("k2").agg(F.count("v").alias("c")))
+    fast = _sorted_rows(df)
+    assert spy.int_fired
+    df_slow = (from_host_table(ht, _slow_session())
+               .filter(col("v") > lit(-1000.0))
+               .with_column("k2", col("k"))
+               .join(from_host_table(dims, _slow_session()), on=["k2"],
+                     how="inner")
+               .group_by("k2").agg(F.count("v").alias("c")))
+    _assert_rows_close(fast, _sorted_rows(df_slow))
+
+
+def test_large_domain_falls_back_to_sort(monkeypatch):
+    rng = np.random.default_rng(11)
+    n = 2000
+    # domain ~2^40 >> maxKeyDomainGroups -> sorted path, still correct
+    keys = rng.integers(-(2 ** 40), 2 ** 40, n).astype(np.int64)
+    ht = HostTable(["k", "v"],
+                   [HostColumn(T.LongType(), keys),
+                    HostColumn(T.DoubleType(), rng.normal(size=n))])
+    spy = SpyLayout(monkeypatch)
+    out = _sorted_rows(from_host_table(ht, TpuSession()).group_by("k")
+                       .agg(F.count("v").alias("c")))
+    assert not spy.int_fired
+    assert len(out) == len(set(keys.tolist()))
+
+
+def test_segment_minmax_64_f64_special_values():
+    import jax.numpy as jnp
+
+    from spark_rapids_tpu.ops.segsum import segment_minmax_64
+    sd = jnp.asarray(np.array(
+        [1.5, np.nan, -np.inf, np.inf, -0.0, 0.0,
+         1e300, 1e300 * (1 + 1e-15), -3.25, np.nan],
+        dtype=np.float64))
+    sv = jnp.asarray(np.array(
+        [True, True, True, True, True, True, True, True, True, True]))
+    gid = jnp.asarray(np.array([0, 0, 1, 1, 2, 2, 3, 3, 4, 4], np.int32))
+    mx = np.asarray(segment_minmax_64(False, sd, sv, gid, 8))
+    mn = np.asarray(segment_minmax_64(True, sd, sv, gid, 8))
+    assert np.isnan(mx[0]) and mn[0] == 1.5          # NaN greatest
+    assert mx[1] == np.inf and mn[1] == -np.inf
+    assert mx[2] == 0.0 and mn[2] == 0.0
+    # hi limbs tie at f32(1e300); the lo pass must break the tie
+    assert mx[3] == 1e300 * (1 + 1e-15) and mn[3] == 1e300
+    assert np.isnan(mx[4]) and mn[4] == -3.25
+
+
+def test_segment_minmax_64_i64_extremes():
+    import jax.numpy as jnp
+
+    from spark_rapids_tpu.ops.segsum import segment_minmax_64
+    lo, hi = np.iinfo(np.int64).min, np.iinfo(np.int64).max
+    # values straddling the 32-bit limb boundary exercise the tie-break
+    sd = jnp.asarray(np.array(
+        [lo, hi, -1, 0, (5 << 32) | 7, (5 << 32) | 9, -(3 << 32) - 1,
+         -(3 << 32) - 2], dtype=np.int64))
+    sv = jnp.ones(8, dtype=bool)
+    gid = jnp.asarray(np.array([0, 0, 1, 1, 2, 2, 3, 3], np.int32))
+    mx = np.asarray(segment_minmax_64(False, sd, sv, gid, 8))
+    mn = np.asarray(segment_minmax_64(True, sd, sv, gid, 8))
+    assert mx[0] == hi and mn[0] == lo
+    assert mx[1] == 0 and mn[1] == -1
+    assert mx[2] == (5 << 32) | 9 and mn[2] == (5 << 32) | 7
+    assert mx[3] == -(3 << 32) - 1 and mn[3] == -(3 << 32) - 2
+
+
+def test_minmax_64_through_engine_split_mode():
+    """Engine-level: splitF64 forced on (the TPU default) must agree with
+    the exact emulated path on i64/f64 min/max + f64 sums at a large
+    segment count (the batched unblocked split)."""
+    ht = _mktable(n=20000, seed=9, kmax=5000)
+    split = TpuSession({"spark.rapids.tpu.sum.splitF64": "true"})
+    exact = TpuSession({"spark.rapids.tpu.sum.splitF64": "false"})
+    q = lambda s: (from_host_table(ht, s).group_by("k")
+                   .agg(F.min("v").alias("mn"), F.max("v").alias("mx"),
+                        F.sum("v").alias("sv")))
+    _assert_rows_close(_sorted_rows(q(split)), _sorted_rows(q(exact)))
+
+
+def test_upload_sets_domain_and_structural_ops_keep_it():
+    from spark_rapids_tpu.columnar.table import DeviceTable
+    ht = _mktable(n=512, seed=1)
+    dt = DeviceTable.from_host(ht)
+    k = dt.columns[0]
+    assert k.domain is not None
+    lo, hi = k.domain
+    vals = ht.columns[0].data[ht.columns[0].validity]
+    assert lo == vals.min() and hi == vals.max()
+    assert k.with_arrays(k.data, k.validity).domain == k.domain
+    assert k.sliced_rows(16).domain == k.domain
+    # doubles have no int domain
+    assert dt.columns[1].domain is None
+
+
+def test_subnormal_f64_minmax_reroutes_exact():
+    """Code-review r5: values below f32 range must not collapse to 0.0 in
+    the limb split — the lossy guard reroutes to the emulated reduction."""
+    import jax.numpy as jnp
+
+    from spark_rapids_tpu.ops.segsum import segment_minmax_64
+    sd = jnp.asarray(np.array([1e-50, 2e-50, -1e-44, 3e-44],
+                              dtype=np.float64))
+    sv = jnp.ones(4, dtype=bool)
+    gid = jnp.asarray(np.array([0, 0, 1, 1], np.int32))
+    mn = np.asarray(segment_minmax_64(True, sd, sv, gid, 2))
+    mx = np.asarray(segment_minmax_64(False, sd, sv, gid, 2))
+    assert mn[0] == 1e-50 and mx[0] == 2e-50
+    assert mn[1] == -1e-44 and mx[1] == 3e-44
+
+
+def test_decimal_avg_sums_exactly():
+    """Code-review r5: avg over decimal must not ride the lossy f64 split
+    pass — the unscaled sum is exact (128-bit word sums) with one
+    rounding at the final divide, on BOTH agg paths and at any sign."""
+    n = 2000
+    big = 10 ** 16 + 300
+    for sign in (1, -1):
+        unscaled = np.full(n, sign * big, dtype=np.int64)
+        ht = HostTable(
+            ["k", "d"],
+            [HostColumn(T.IntegerType(), (np.arange(n) % 4).astype(np.int32)),
+             HostColumn(T.DecimalType(17, 2), unscaled)])
+        s = TpuSession({"spark.rapids.tpu.sum.splitF64": "true"})
+        grouped = sorted(from_host_table(ht, s).group_by("k")
+                         .agg(F.avg("d").alias("a")).collect())
+        ungrouped = from_host_table(ht, s).agg(F.avg("d").alias("a")).collect()
+        for got in [grouped[0][1], ungrouped[0][0]]:
+            assert got == pytest.approx(float(sign * big), rel=1e-13)
+
+
+def test_dec128_twos_complement_boundary_bytes():
+    """Code-review r5: BigInteger.toByteArray parity at -2^(8n-1)
+    boundaries (minimal two's-complement length for negatives)."""
+    from spark_rapids_tpu.shuffle.hashing import (
+        _dec128_twos_complement_bytes as tb)
+    cases = [-128, -129, -(2 ** 15), -(2 ** 31), -(2 ** 63), -1, 127, 128,
+             255, 2 ** 63 - 1, 0]
+    for v in cases:
+        got = tb(v)
+        # independent oracle: minimal signed big-endian encoding
+        length = 1
+        while True:
+            try:
+                want = v.to_bytes(length, "big", signed=True)
+                break
+            except OverflowError:
+                length += 1
+        assert got == want, (v, got, want)

@@ -222,7 +222,10 @@ def test_agg_speculative_shrink_site_blocklists_once():
     site blocklists, and the immediate re-run does not replay again."""
     n = 150_000
     data = {"k": np.arange(n, dtype=np.int64)}
-    s = TpuSession()
+    # force the sort-segment path: dense int keys would otherwise take the
+    # domain fast path, which emits a domain-sized output with no shrink
+    # speculation at all
+    s = TpuSession({"spark.rapids.tpu.agg.maxKeyDomainGroups": 0})
     q = lambda: s.create_dataframe(data).group_by("k").agg(
         F.count().alias("c"))
     r1 = q().collect()
