@@ -30,11 +30,46 @@ jax.config.update("jax_enable_x64", True)
 # run ~50s each; caching them on disk amortizes across processes (the
 # reference's CUDA kernels are precompiled — this is the XLA counterpart,
 # SURVEY.md §7 "XLA compile-time amortization").
+def _effective_platform_is_cpu() -> bool:
+    """True when the PRIMARY jax platform is cpu — or UNKNOWN: a host
+    with no platform configured resolves to the cpu backend, the exact
+    case whose AOT (de)serialization aborts were observed. Only an
+    explicit non-cpu primary (the axon TPU config is 'axon,cpu')
+    enables the persistent cache."""
+    cfg = getattr(jax.config, "jax_platforms", None) or \
+        _os.environ.get("JAX_PLATFORMS", "")
+    first = cfg.split(",")[0].strip().lower()
+    return first in ("", "cpu")
+
+
 try:
+    # CPU backend: no persistent cache. The cache amortizes ~50s TPU
+    # compiles; XLA:CPU compiles are fast AND this jax's CPU AOT
+    # (de)serialization can abort/segfault on some programs and on
+    # feature-mismatched hosts — both observed in this repo's test runs.
+    if _effective_platform_is_cpu():
+        raise RuntimeError("cpu backend: skip persistent compile cache")
     _cache_dir = _os.environ.get(
         "SPARK_RAPIDS_TPU_CACHE",
         _os.path.join(_os.path.dirname(__file__), "..", ".jax_cache"))
-    jax.config.update("jax_compilation_cache_dir", _os.path.abspath(_cache_dir))
+    # XLA:CPU AOT artifacts are compiled for the BUILD host's exact CPU
+    # features and SEGFAULT when loaded on a host missing one (jax's cache
+    # key does not cover host CPU flags) — namespace the cache by a
+    # machine fingerprint so entries never cross hosts
+    import hashlib as _hashlib
+    import platform as _platform
+    _fp_src = _platform.machine() + ":" + _platform.processor()
+    try:
+        with open("/proc/cpuinfo") as _f:
+            for _line in _f:
+                if _line.startswith("flags"):
+                    _fp_src += ":" + _line.strip()
+                    break
+    except OSError:
+        pass
+    _fp = _hashlib.sha256(_fp_src.encode()).hexdigest()[:12]
+    jax.config.update("jax_compilation_cache_dir",
+                      _os.path.join(_os.path.abspath(_cache_dir), _fp))
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 except Exception:  # cache is best-effort; older jax may lack the knobs
     pass

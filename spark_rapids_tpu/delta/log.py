@@ -73,6 +73,13 @@ def schema_from_json(s: str) -> List[Tuple[str, T.DataType]]:
     return out
 
 
+def schema_fields_from_json(s: str) -> List[dict]:
+    """Raw schema field dicts incl. per-field metadata (column-mapping
+    physical names / ids live there — the Delta protocol's
+    delta.columnMapping.physicalName key)."""
+    return list(json.loads(s)["fields"])
+
+
 # -- actions -----------------------------------------------------------------
 
 @dataclass
@@ -133,6 +140,28 @@ class Metadata:
             "partitionColumns": self.partition_columns,
             "configuration": self.configuration,
             "createdTime": int(time.time() * 1000)}}
+
+    def column_mapping_mode(self) -> str:
+        return self.configuration.get("delta.columnMapping.mode", "none")
+
+    def physical_names(self) -> Dict[str, str]:
+        """logical -> physical column name map. Identity when the table
+        has no column mapping (physical names ARE logical names then).
+        Memoized — a scan calls this per file and the schema JSON parse
+        is not free at 10k files (code-review r5)."""
+        got = getattr(self, "_phys_cache", None)
+        if got is None:
+            got = {}
+            for f in schema_fields_from_json(self.schema_json):
+                md = f.get("metadata") or {}
+                got[f["name"]] = md.get(
+                    "delta.columnMapping.physicalName", f["name"])
+            self._phys_cache = got
+        return got
+
+    def cdf_enabled(self) -> bool:
+        return self.configuration.get(
+            "delta.enableChangeDataFeed", "false").lower() == "true"
 
 
 PROTOCOL_ACTION = {"protocol": {"minReaderVersion": 1, "minWriterVersion": 2}}

@@ -215,24 +215,14 @@ class DeltaScanNode(FileScanNode):
             table = HostTable(["__rows__"], [HostColumn(
                 T.LONG, np.zeros(n, dtype=np.int64))])
         else:
-            pf = pq.ParquetFile(path)
-            have = set(pf.schema_arrow.names)
-            present = [(n, dt) for n, dt in self._data_schema if n in have]
-            missing = [(n, dt) for n, dt in self._data_schema
-                       if n not in have]
-            t = pf.read(columns=[n for n, _ in present])
-            table = decode_to_schema(t, present)
-            if missing:
-                # files written before a mergeSchema evolution lack the
-                # added columns: null-fill them
-                cols = list(table.columns)
-                names = list(table.names)
-                for n, dt in missing:
-                    names.append(n)
-                    cols.append(_null_column(dt, table.num_rows))
-                by_name = dict(zip(names, cols))
-                order = [n for n, _ in self._data_schema]
-                table = HostTable(order, [by_name[n] for n in order])
+            # column mapping: files store PHYSICAL names; the engine reads
+            # by physical name and surfaces logical (Delta columnMapping
+            # mode=name/id; identity map when off)
+            phys = None
+            if self.snap.metadata is not None \
+                    and self.snap.metadata.column_mapping_mode() != "none":
+                phys = self.snap.metadata.physical_names()
+            table = read_physical_parquet(path, self._data_schema, phys)
         add = self._adds[path]
         if add.deletion_vector:
             deleted = read_dv(self.table_path, add.deletion_vector)
@@ -305,9 +295,56 @@ def _column_stats(table: HostTable) -> str:
     return json.dumps(stats)
 
 
+def read_physical_parquet(full_path: str, schema,
+                          phys_map: Optional[Dict[str, str]]) -> HostTable:
+    """ONE data/cdc parquet as the given LOGICAL schema: read by physical
+    column name (column mapping; identity when None), decode, rename to
+    logical, null-fill columns the file predates (mergeSchema evolution).
+    The single implementation behind the scan node, the DML readers and
+    the cdc reader (code-review r5: three hand-rolled copies)."""
+    import pyarrow.parquet as pq
+
+    from spark_rapids_tpu.io.arrow_convert import decode_to_schema
+    pf = pq.ParquetFile(full_path)
+    have = set(pf.schema_arrow.names)
+    pn = (lambda n: phys_map.get(n, n)) if phys_map else (lambda n: n)
+    present = [(n, dt) for n, dt in schema if pn(n) in have]
+    missing = [(n, dt) for n, dt in schema if pn(n) not in have]
+    t = pf.read(columns=[pn(n) for n, _ in present])
+    table = decode_to_schema(t, [(pn(n), dt) for n, dt in present])
+    table = HostTable([n for n, _ in present], list(table.columns))
+    if not missing:
+        return table
+    by_name = dict(zip(table.names, table.columns))
+    for n, dt in missing:
+        by_name[n] = _null_column(dt, table.num_rows)
+    return HostTable([n for n, _ in schema],
+                     [by_name[n] for n, _ in schema])
+
+
+def _evolved_metadata(old_meta: Metadata, evolved_schema,
+                      partition_by) -> Metadata:
+    """Metadata action for a schema evolution that PRESERVES table
+    configuration and per-field metadata (column-mapping physical names,
+    ids). A bare schema_to_json would wipe delta.columnMapping state and
+    delta.enableChangeDataFeed (code-review r5)."""
+    from spark_rapids_tpu.delta.log import schema_fields_from_json
+    old_fields = {f["name"]: f
+                  for f in schema_fields_from_json(old_meta.schema_json)}
+    new_json = json.loads(schema_to_json(evolved_schema))
+    merged = []
+    for f in new_json["fields"]:
+        merged.append(old_fields.get(f["name"], f))
+    return Metadata(json.dumps({"type": "struct", "fields": merged}),
+                    list(partition_by), table_id=old_meta.table_id,
+                    name=old_meta.name,
+                    configuration=dict(old_meta.configuration))
+
+
 def _write_data_file(table_path: str, table: HostTable,
                      partition_values: Dict[str, str],
-                     subdir: str = "") -> AddFile:
+                     subdir: str = "",
+                     physical: Optional[Dict[str, str]] = None) -> AddFile:
     from spark_rapids_tpu.io.arrow_convert import host_table_to_arrow
     import pyarrow.parquet as pq
     rel_dir = subdir
@@ -316,6 +353,10 @@ def _write_data_file(table_path: str, table: HostTable,
     rel = os.path.join(rel_dir, f"part-{uuid.uuid4().hex}.parquet") \
         if rel_dir else f"part-{uuid.uuid4().hex}.parquet"
     full = os.path.join(table_path, rel)
+    if physical:
+        # column mapping: data files carry PHYSICAL column names
+        table = HostTable([physical.get(n, n) for n in table.names],
+                          list(table.columns))
     pq.write_table(host_table_to_arrow(table), full)
     return AddFile(path=rel, partition_values=dict(partition_values),
                    size=os.path.getsize(full),
@@ -492,8 +533,8 @@ def write_delta(df_plan: PlanNode, session, table_path: str,
                                       table_path, "overwriting",
                                       merge_schema)
         if [n for n, _ in evolved] != [n for n, _ in snap.schema]:
-            txn.stage(Metadata(schema_to_json(evolved), partition_by,
-                               table_id=snap.metadata.table_id))
+            txn.stage(_evolved_metadata(snap.metadata, evolved,
+                                        partition_by))
         # conflict detection: the removes below are vs THIS snapshot; a
         # concurrent commit must surface, not silently survive the
         # overwrite (commit() refuses blind retry when removes are staged)
@@ -512,11 +553,17 @@ def write_delta(df_plan: PlanNode, session, table_path: str,
             # log-recorded schema change: subsequent snapshots read the
             # widened schema; old files null-fill the new columns
             txn.read_version = snap.version
-            txn.stage(Metadata(schema_to_json(evolved), partition_by,
-                               table_id=snap.metadata.table_id))
+            txn.stage(_evolved_metadata(snap.metadata, evolved,
+                                        partition_by))
 
+    phys = None
+    if exists:
+        m = log.snapshot().metadata
+        if m is not None and m.column_mapping_mode() != "none":
+            phys = m.physical_names()
     for vals, subdir, sub in _split_partitions(table, partition_by):
         if sub.num_rows == 0:
             continue
-        txn.stage(_write_data_file(table_path, sub, vals, subdir))
+        txn.stage(_write_data_file(table_path, sub, vals, subdir,
+                                   physical=phys))
     return txn.commit(op)

@@ -149,7 +149,8 @@ DISPATCH_PROFILE: list = []
 
 
 def _sync_result(res):
-    leaves = jax.tree_util.tree_leaves(res)
+    from spark_rapids_tpu.shims import get_shim
+    leaves = get_shim().tree_leaves(res)
     for leaf in leaves:
         if isinstance(leaf, jax.Array):
             jax.device_get(jnp.ravel(leaf)[:1])

@@ -707,9 +707,8 @@ class TpuHashAggregateExec(TpuExec):
 
             # second batched pass: centered moments (positive values, so the
             # split path's relative-error guard applies cleanly)
-            var_j = vplan_j
             ccols = []
-            for j in var_j:
+            for j in vplan_j:
                 mean = fsums[j] / jnp.maximum(nonnulls[j], 1)
                 ccols.append(jnp.where(
                     svs[j],
@@ -717,7 +716,7 @@ class TpuHashAggregateExec(TpuExec):
                     0.0))
             csums = batched_segment_sum_f64(ccols, gid, gpad, capacity,
                                             use_split)
-            m2s = {j: csums[:, i2] for i2, j in enumerate(var_j)}
+            m2s = {j: csums[:, i2] for i2, j in enumerate(vplan_j)}
 
             fres = {}
             for j, kind in fplan:
@@ -906,11 +905,15 @@ class TpuHashAggregateExec(TpuExec):
                 # EXACT 128-bit unscaled sum (Spark computes avg(decimal)
                 # from an exact decimal sum; riding the f64 split pass
                 # would accumulate error per row), ONE sign-magnitude
-                # rounding at the final f64 convert + divide
-                hi128, lo128, _ = _dec_wide_sum_segments(sd, sv, gid, nseg)
+                # rounding at the final f64 convert + divide. A 128-bit
+                # overflow (t3 outside i32) nulls the result, mirroring
+                # the Sum path's non-ANSI CheckOverflow semantics.
+                hi128, lo128, t3 = _dec_wide_sum_segments(sd, sv, gid, nseg)
+                ovf = (t3 > 0x7FFFFFFF) | (t3 < -0x80000000)
                 tot = _dec_wide_to_f64(hi128, lo128)
-                return (jnp.where(has_any, tot / jnp.maximum(nonnull, 1),
-                                  0.0), has_any)
+                valid = has_any & ~ovf
+                return (jnp.where(valid, tot / jnp.maximum(nonnull, 1),
+                                  0.0), valid)
             v = jnp.where(sv, sd.astype(jnp.float64), 0.0)
             s = segment_sum_f64(v, gid, nseg, capacity, use_split)
             return (jnp.where(has_any, s / jnp.maximum(nonnull, 1), 0.0), has_any)

@@ -267,36 +267,10 @@ def segment_minmax_64(is_min: bool, sd, sv, gid, num_segments: int):
 def _unblocked_split_segment_sum(v, gid, num_segments: int):
     """Split path for LARGE segment counts (sorted-path aggregates run
     with num_segments == capacity, where per-block partials would outgrow
-    the input): plain f32 segment-sums of the exact hi/lo halves — each a
-    native 32-bit scatter-add, ~4x the speed of the emulated-f64 scatter.
-
-    The error model extends the blocked path's mass-based random-walk
-    estimate (this body is otherwise its nb=1 degenerate case — keep the
-    two guards in sync) with a per-segment COUNT term: without blocking,
-    a skewed segment may accumulate millions of rows in one f32 stream,
-    so the estimate scales by sqrt(rows/BLOCK) above one block's worth —
-    a 1M-row all-positive segment then correctly reroutes to the exact
-    emulated-f64 path instead of passing a guard calibrated for 1024-row
-    partials. Any risky segment (or non-finite/oversized input) reroutes
-    the WHOLE call via lax.cond."""
-    hi, lo = split_f64_hi_lo(v)
-    phi = jax.ops.segment_sum(hi, gid, num_segments=num_segments)
-    plo = jax.ops.segment_sum(lo, gid, num_segments=num_segments)
-    pabs = jax.ops.segment_sum(jnp.abs(hi), gid, num_segments=num_segments)
-    cnt = jax.ops.segment_sum((v != 0.0).astype(jnp.int32), gid,
-                              num_segments=num_segments)
-    split_sum = phi.astype(jnp.float64) + plo.astype(jnp.float64)
-    scale = jnp.sqrt(jnp.maximum(cnt.astype(jnp.float64) / BLOCK, 1.0))
-    err_est = ERR_PER_MASS * scale * pabs.astype(jnp.float64)
-    risky = err_est > (jnp.abs(split_sum) * RTOL + ATOL)
-    has_big = jnp.any(jnp.abs(v) > SPLIT_MAX_ABS)
-    has_nonfinite = ~jnp.all(jnp.isfinite(pabs))
-    bad = jnp.any(risky) | has_big | has_nonfinite
-
-    def exact(x):
-        return jax.ops.segment_sum(x, gid, num_segments=num_segments)
-
-    return jax.lax.cond(bad, exact, lambda x: split_sum, v)
+    the input): the m=1 case of _batched_unblocked_split — ONE guard
+    implementation serves both (code-review r5: three hand-rolled copies
+    of the error model drifted apart)."""
+    return _batched_unblocked_split([v], gid, num_segments)[:, 0]
 
 
 def segment_sum_f64(v, gid, num_segments: int, capacity: int, use_split: bool):

@@ -33,10 +33,8 @@ from spark_rapids_tpu.shuffle.hashing import (
 
 
 def _shard_map():
-    sm = getattr(jax, "shard_map", None)
-    if sm is None:
-        from jax.experimental.shard_map import shard_map as sm
-    return sm
+    from spark_rapids_tpu.shims import get_shim
+    return get_shim().shard_map()
 
 
 def _bucketize(pid, live, ndev: int, cap: int):

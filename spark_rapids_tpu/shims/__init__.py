@@ -1,0 +1,95 @@
+"""Version shim layer (reference: ShimLoader.scala + build/shimplify.py —
+SURVEY.md §2.12).
+
+The reference compiles one source tree against many Spark versions and
+selects a binary shim at runtime by inspecting the Spark version string
+(ShimLoader.getShimVersion). The TPU engine's moving dependency is JAX,
+not Spark: public APIs the engine relies on have historically migrated
+(``jax.experimental.shard_map`` -> ``jax.shard_map``,
+``jax.tree_util.tree_map`` -> ``jax.tree.map``, pallas module layout), so
+the same problem — one engine tree, many runtime versions — gets the same
+shape of answer, adapted to Python:
+
+- every version-variant API goes through a ``Shim`` provider object;
+- provider classes declare the half-open version range they serve
+  (``MIN_VERSION <= jax < MAX_VERSION``), the shimplify "which shim owns
+  this file" tag turned into data;
+- the loader resolves the running JAX version against the registry ONCE,
+  lazily, and fails with an explicit supported-range message for versions
+  outside every range (ShimLoader's UnsupportedOperationException analog);
+- because Python resolves at runtime, ONE wheel ships all shims — the
+  reference needs its multi-jar ``dist/`` assembly only because the JVM
+  must pick a binary per Spark version (see pyproject.toml).
+
+The env var ``SPARK_RAPIDS_TPU_JAX_SHIM_OVERRIDE`` forces a specific
+version (the hook the reference exposes via the
+spark.rapids.shims-provider-override SYSTEM PROPERTY — an env-style
+process-global, deliberately NOT a session conf: shims resolve at module
+import, before any session can exist).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Optional, Tuple, Type
+
+from spark_rapids_tpu.shims.base import BaseShim
+from spark_rapids_tpu.shims.jax_legacy import JaxLegacyShim
+from spark_rapids_tpu.shims.jax_current import JaxCurrentShim
+
+#: ordered registry of provider classes; ranges must not overlap and are
+#: checked by tests/test_shims.py (the shimplify "shims must be disjoint"
+#: invariant)
+SHIM_PROVIDERS: List[Type[BaseShim]] = [JaxLegacyShim, JaxCurrentShim]
+
+_active: Optional[BaseShim] = None
+
+
+def parse_version(v: str) -> Tuple[int, int, int]:
+    """'0.4.35' / '0.9.0rc1' / '0.9' -> (major, minor, patch); tolerant of
+    suffixes the way ShimLoader tolerates vendor version strings like
+    '3.4.1-databricks'."""
+    parts = []
+    for piece in v.split(".")[:3]:
+        m = re.match(r"\d+", piece)
+        parts.append(int(m.group()) if m else 0)
+    while len(parts) < 3:
+        parts.append(0)
+    return tuple(parts)
+
+
+def resolve_provider(version: Tuple[int, int, int]) -> Type[BaseShim]:
+    for cls in SHIM_PROVIDERS:
+        if cls.MIN_VERSION <= version < cls.MAX_VERSION:
+            return cls
+    ranges = ", ".join(
+        f"{cls.__name__} [{'.'.join(map(str, cls.MIN_VERSION))}, "
+        f"{'.'.join(map(str, cls.MAX_VERSION))})"
+        for cls in SHIM_PROVIDERS)
+    raise RuntimeError(
+        f"No shim provider for jax {'.'.join(map(str, version))}; "
+        f"supported ranges: {ranges}. Set the env var "
+        f"SPARK_RAPIDS_TPU_JAX_SHIM_OVERRIDE to force a version "
+        f"(at your own risk).")
+
+
+def get_shim() -> BaseShim:
+    """The active shim, resolved once per process (ShimLoader caches its
+    SparkShims instance the same way). The override rides an env var,
+    NOT a session conf: shims resolve at module import, before any
+    session exists — exactly why the reference uses a system property
+    for spark.rapids.shims-provider-override."""
+    global _active
+    if _active is None:
+        import os
+
+        import jax
+        override = os.environ.get("SPARK_RAPIDS_TPU_JAX_SHIM_OVERRIDE", "")
+        version = parse_version(override or jax.__version__)
+        _active = resolve_provider(version)()
+    return _active
+
+
+def _reset_for_tests() -> None:
+    global _active
+    _active = None

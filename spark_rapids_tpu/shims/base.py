@@ -1,0 +1,66 @@
+"""Shim provider interface: every JAX API the engine uses that has moved
+(or may move) between JAX releases, in one place.
+
+Reference: the SparkShims trait (sql-plugin-api) — the reference funnels
+every version-variant Spark API through one interface so the rest of the
+plugin compiles version-agnostic. Here the variant APIs are JAX's; the
+engine calls ``shims.get_shim().<api>()`` instead of importing from a
+location that only exists in some JAX versions.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+
+class BaseShim:
+    """Canonical implementations against the CURRENT JAX API surface.
+    Version providers subclass and override only what their JAX spells
+    differently (the shimplify model: base file + per-shim deltas)."""
+
+    #: half-open [MIN_VERSION, MAX_VERSION) range this provider serves
+    MIN_VERSION: Tuple[int, int, int] = (0, 0, 0)
+    MAX_VERSION: Tuple[int, int, int] = (99, 0, 0)
+
+    @property
+    def name(self) -> str:
+        return type(self).__name__
+
+    # -- SPMD ---------------------------------------------------------------
+    def shard_map(self):
+        """jax.shard_map (top-level since 0.6; jax.experimental before)."""
+        import jax
+        return jax.shard_map
+
+    # -- pytrees ------------------------------------------------------------
+    def tree_map(self, f, tree, *rest):
+        import jax
+        return jax.tree.map(f, tree, *rest)
+
+    def tree_leaves(self, tree):
+        import jax
+        return jax.tree.leaves(tree)
+
+    def register_pytree_node(self, cls, flatten, unflatten):
+        import jax
+        jax.tree_util.register_pytree_node(cls, flatten, unflatten)
+
+    # -- devices / platform -------------------------------------------------
+    def default_backend(self) -> str:
+        import jax
+        return jax.default_backend()
+
+    def local_device_count(self) -> int:
+        import jax
+        return jax.local_device_count()
+
+    def make_mesh(self, axis_shapes, axis_names):
+        """Mesh construction (jax.make_mesh since 0.4.35; explicit Mesh
+        over mesh_utils before)."""
+        import jax
+        return jax.make_mesh(axis_shapes, axis_names)
+
+    # -- compilation --------------------------------------------------------
+    def jit(self, fn, **kw):
+        import jax
+        return jax.jit(fn, **kw)

@@ -1,0 +1,12 @@
+"""Shim provider for jax >= 0.6: the canonical (base) surface IS this
+version family's surface — top-level ``jax.shard_map``, ``jax.tree.*``,
+``jax.make_mesh`` all exist."""
+
+from __future__ import annotations
+
+from spark_rapids_tpu.shims.base import BaseShim
+
+
+class JaxCurrentShim(BaseShim):
+    MIN_VERSION = (0, 6, 0)
+    MAX_VERSION = (2, 0, 0)

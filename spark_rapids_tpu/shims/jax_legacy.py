@@ -1,0 +1,43 @@
+"""Shim provider for jax 0.4.30 - 0.5.x: shard_map lives under
+jax.experimental, jax.tree.* may be absent (tree_util spelling), and
+jax.make_mesh appears only late in the 0.4 line."""
+
+from __future__ import annotations
+
+from spark_rapids_tpu.shims.base import BaseShim
+
+
+class JaxLegacyShim(BaseShim):
+    MIN_VERSION = (0, 4, 30)
+    MAX_VERSION = (0, 6, 0)
+
+    def shard_map(self):
+        import jax
+        sm = getattr(jax, "shard_map", None)
+        if sm is None:
+            from jax.experimental.shard_map import shard_map as sm
+        return sm
+
+    def tree_map(self, f, tree, *rest):
+        import jax
+        tree_mod = getattr(jax, "tree", None)
+        if tree_mod is not None and hasattr(tree_mod, "map"):
+            return tree_mod.map(f, tree, *rest)
+        return jax.tree_util.tree_map(f, tree, *rest)
+
+    def tree_leaves(self, tree):
+        import jax
+        tree_mod = getattr(jax, "tree", None)
+        if tree_mod is not None and hasattr(tree_mod, "leaves"):
+            return tree_mod.leaves(tree)
+        return jax.tree_util.tree_leaves(tree)
+
+    def make_mesh(self, axis_shapes, axis_names):
+        import jax
+        mk = getattr(jax, "make_mesh", None)
+        if mk is not None:
+            return mk(axis_shapes, axis_names)
+        import numpy as np
+        from jax.sharding import Mesh
+        devs = np.asarray(jax.devices()[:int(np.prod(axis_shapes))])
+        return Mesh(devs.reshape(axis_shapes), axis_names)

@@ -190,7 +190,11 @@ class StructField:
 
 class StructType(DataType):
     def __init__(self, fields):
-        self.fields = tuple(fields)
+        # accept (name, dtype) pairs as a convenience — pyspark users write
+        # StructType([("a", LongType()), ...]) shapes constantly
+        self.fields = tuple(
+            f if isinstance(f, StructField) else StructField(*f)
+            for f in fields)
 
     def simple_string(self):
         inner = ",".join(

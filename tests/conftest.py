@@ -17,6 +17,22 @@ jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
+_TESTS_RUN = {"n": 0}
+
+
+@pytest.fixture(autouse=True)
+def _periodic_jax_cache_clear():
+    """Free jitted XLA:CPU executables every few hundred tests. One
+    full-suite process otherwise accumulates ~1k compiled programs;
+    on some hosts XLA's CPU compiler segfaults once that much JIT
+    state has piled up (observed at ~95% of the suite, always inside
+    backend_compile). Recompiles cost a little time; crashes cost
+    the whole run."""
+    yield
+    _TESTS_RUN["n"] += 1
+    if _TESTS_RUN["n"] % 250 == 0:
+        jax.clear_caches()
+
 
 @pytest.fixture(scope="session")
 def session():

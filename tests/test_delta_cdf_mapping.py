@@ -1,0 +1,255 @@
+"""Delta Change Data Feed + column mapping (reference:
+delta_lake_*_test.py CDF suites and the column-mapping shims; VERDICT r4
+listed both as the connector's remaining gaps).
+
+CDF: DML on a table with delta.enableChangeDataFeed=true writes cdc
+files under _change_data/ with _change_type, and table_changes() reads
+row-level changes per commit version (deriving insert/delete rows from
+plain add/remove commits that carry no cdc actions).
+
+Column mapping: rename_column() upgrades the table to
+columnMapping.mode=name (physical names pinned in field metadata,
+protocol 2/5) and renames WITHOUT touching any data file; scans, DML
+and writers resolve logical->physical from then on.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import functions as F
+from spark_rapids_tpu.ops.expr import col, lit
+
+
+def _mk(session, path, n=60):
+    data = {"id": np.arange(n, dtype=np.int64),
+            "v": (np.arange(n) % 7).astype(np.float64)}
+    session.create_dataframe(data).write_delta(path)
+    return session.delta_table(path)
+
+
+def _changes(dt, start, end=None):
+    df = dt.table_changes(start, end)
+    names = [n for n, _ in df.schema()] if hasattr(df, "schema") else None
+    rows = df.collect()
+    return names, rows
+
+
+# -- CDF ---------------------------------------------------------------------
+
+def test_cdf_delete_and_update(session, tmp_path):
+    path = str(tmp_path / "t")
+    dt = _mk(session, path)
+    dt.set_properties({"delta.enableChangeDataFeed": "true"})
+    v_del = dt.delete(col("id") < lit(5))
+    assert v_del["num_affected_rows"] == 5
+    dt.update(col("id") == lit(10), {"v": lit(99.0)})
+    ver = dt.version()
+
+    # cdc actions present in both DML commits
+    log_dir = os.path.join(path, "_delta_log")
+    acts = []
+    for v in (ver - 1, ver):
+        with open(os.path.join(log_dir, f"{v:020d}.json")) as f:
+            acts.append([json.loads(x) for x in f if x.strip()])
+    assert any("cdc" in a for a in acts[0])
+    assert any("cdc" in a for a in acts[1])
+
+    changes = dt.table_changes(ver - 1).collect()
+    by_type = {}
+    for r in changes:
+        by_type.setdefault(r[-2], []).append(r)
+    assert len(by_type["delete"]) == 5
+    assert sorted(r[0] for r in by_type["delete"]) == [0, 1, 2, 3, 4]
+    assert len(by_type["update_preimage"]) == 1
+    assert len(by_type["update_postimage"]) == 1
+    assert by_type["update_postimage"][0][1] == 99.0
+    # _commit_version distinguishes the two commits
+    assert {r[-1] for r in by_type["delete"]} == {ver - 1}
+    assert {r[-1] for r in by_type["update_postimage"]} == {ver}
+
+
+def test_cdf_derives_inserts_from_plain_writes(session, tmp_path):
+    """Version 0 (CREATE) carries adds only — table_changes derives
+    insert rows from the data files."""
+    path = str(tmp_path / "t")
+    dt = _mk(session, path, n=10)
+    changes = dt.table_changes(0, 0).collect()
+    assert len(changes) == 10
+    assert all(r[-2] == "insert" and r[-1] == 0 for r in changes)
+
+
+def test_cdf_merge_emits_all_change_types(session, tmp_path):
+    path = str(tmp_path / "t")
+    dt = _mk(session, path, n=20)
+    dt.set_properties({"delta.enableChangeDataFeed": "true"})
+    src = session.create_dataframe({
+        "id": np.array([5, 99], dtype=np.int64),
+        "v": np.array([50.0, 990.0])})
+    dt.merge(src, on=["id"]).when_matched_update(
+        set={"v": "v"}).when_not_matched_insert().execute()
+    ver = dt.version()
+    changes = dt.table_changes(ver, ver).collect()
+    types = sorted(set(r[-2] for r in changes))
+    assert types == ["insert", "update_postimage", "update_preimage"]
+    post = [r for r in changes if r[-2] == "update_postimage"]
+    assert post[0][0] == 5 and post[0][1] == 50.0
+    ins = [r for r in changes if r[-2] == "insert"]
+    assert ins[0][0] == 99
+
+
+def test_vacuum_keeps_cdc_files(session, tmp_path):
+    path = str(tmp_path / "t")
+    dt = _mk(session, path)
+    dt.set_properties({"delta.enableChangeDataFeed": "true"})
+    dt.delete(col("id") < lit(3))
+    v_delete = dt.version()
+    dt.optimize()
+    dt.vacuum()
+    cdc_dir = os.path.join(path, "_change_data")
+    assert os.path.isdir(cdc_dir) and os.listdir(cdc_dir)
+    # change feed still reads after vacuum
+    assert dt.table_changes(v_delete, v_delete).count() == 3
+
+
+# -- column mapping ----------------------------------------------------------
+
+def test_rename_column_without_rewrite(session, cpu_session, tmp_path):
+    path = str(tmp_path / "t")
+    dt = _mk(session, path, n=40)
+    files_before = sorted(
+        f for f in os.listdir(path) if f.endswith(".parquet"))
+    dt.rename_column("v", "value")
+    files_after = sorted(
+        f for f in os.listdir(path) if f.endswith(".parquet"))
+    assert files_before == files_after  # NO data file rewritten
+
+    got = sorted(session.read_delta(path).collect())
+    want = sorted(cpu_session.read_delta(path).collect())
+    assert got == want and len(got) == 40
+    names = [n for n, _ in session.read_delta(path).schema]
+    assert names == ["id", "value"]
+    # the log records mode=name + physical names + protocol 2/5
+    snap = dt.log.snapshot()
+    assert snap.metadata.column_mapping_mode() == "name"
+    assert snap.metadata.physical_names()["value"] == "v"
+
+
+def test_mapped_table_append_and_dml(session, tmp_path):
+    """After the mapping upgrade, appends write PHYSICAL column names
+    and DML keeps working end to end."""
+    path = str(tmp_path / "t")
+    dt = _mk(session, path, n=20)
+    dt.rename_column("v", "value")
+    session.create_dataframe({
+        "id": np.arange(100, 110, dtype=np.int64),
+        "value": np.full(10, 7.5)}).write_delta(path, mode="append")
+    assert session.read_delta(path).count() == 30
+
+    # the appended file stores the PHYSICAL name 'v'
+    import pyarrow.parquet as pq
+    snap = dt.log.snapshot()
+    newest = max(snap.files, key=lambda a: a.modification_time)
+    cols = pq.ParquetFile(
+        os.path.join(path, newest.path)).schema_arrow.names
+    assert "v" in cols and "value" not in cols
+
+    dt.update(col("id") >= lit(100), {"value": lit(1.25)})
+    got = sorted(session.read_delta(path)
+                 .filter(col("id") >= lit(100)).collect())
+    assert all(r[1] == 1.25 for r in got) and len(got) == 10
+    dt.delete(col("id") >= lit(100))
+    assert session.read_delta(path).count() == 20
+
+
+def test_mapped_table_cdf_roundtrip(session, tmp_path):
+    """Column mapping + CDF together: cdc files carry physical names,
+    table_changes surfaces logical ones."""
+    path = str(tmp_path / "t")
+    dt = _mk(session, path, n=15)
+    dt.rename_column("v", "value")
+    dt.set_properties({"delta.enableChangeDataFeed": "true"})
+    dt.delete(col("id") == lit(3))
+    changes = dt.table_changes(dt.version(), dt.version()).collect()
+    assert len(changes) == 1
+    assert changes[0][0] == 3 and changes[0][-2] == "delete"
+
+
+def test_rename_errors(session, tmp_path):
+    from spark_rapids_tpu.errors import ColumnarProcessingError
+    path = str(tmp_path / "t")
+    dt = _mk(session, path, n=5)
+    with pytest.raises(ColumnarProcessingError):
+        dt.rename_column("nope", "x")
+    with pytest.raises(ColumnarProcessingError):
+        dt.rename_column("v", "id")
+
+
+def test_merge_schema_append_preserves_mapping_and_cdf(session, tmp_path):
+    """Code-review r5: a mergeSchema append on a mapped/CDF table must
+    not wipe columnMapping state or delta.enableChangeDataFeed from the
+    evolved Metadata action."""
+    path = str(tmp_path / "t")
+    dt = _mk(session, path, n=10)
+    dt.rename_column("v", "value")
+    dt.set_properties({"delta.enableChangeDataFeed": "true"})
+    session.create_dataframe({
+        "id": np.arange(100, 105, dtype=np.int64),
+        "value": np.full(5, 1.0),
+        "extra": np.arange(5, dtype=np.int64)}).write_delta(
+            path, mode="append", merge_schema=True)
+    snap = dt.log.snapshot()
+    assert snap.metadata.column_mapping_mode() == "name"
+    assert snap.metadata.physical_names()["value"] == "v"
+    assert snap.metadata.cdf_enabled()
+    # renamed column still reads from OLD files after the evolution
+    got = sorted(session.read_delta(path).collect())
+    assert len(got) == 15
+    old = [r for r in got if r[0] < 100]
+    assert all(r[1] is not None for r in old)   # not null-filled
+    assert all(r[2] is None for r in old)       # evolution null-fills extra
+
+
+def test_rename_partition_column_rejected(session, tmp_path):
+    from spark_rapids_tpu.errors import ColumnarProcessingError
+    path = str(tmp_path / "t")
+    session.create_dataframe({
+        "id": np.arange(20, dtype=np.int64),
+        "p": (np.arange(20) % 3).astype(np.int64)}).write_delta(
+            path, partition_by=["p"])
+    dt = session.delta_table(path)
+    with pytest.raises(ColumnarProcessingError):
+        dt.rename_column("p", "q")
+
+
+def test_cdf_partitioned_mixed_commit_kinds(session, tmp_path):
+    """Code-review r5: cdc-derived and add-derived change tables concat
+    positionally — both branches must emit SCHEMA column order even when
+    a partition column is not last."""
+    path = str(tmp_path / "t")
+    session.create_dataframe({
+        "p": (np.arange(12) % 2).astype(np.int64),
+        "id": np.arange(12, dtype=np.int64),
+        "v": np.arange(12, dtype=np.float64)}).write_delta(
+            path, partition_by=["p"])
+    dt = session.delta_table(path)
+    dt.set_properties({"delta.enableChangeDataFeed": "true"})
+    dt.delete(col("id") == lit(3))               # cdc commit
+    session.create_dataframe({
+        "p": np.array([0], dtype=np.int64),
+        "id": np.array([100], dtype=np.int64),
+        "v": np.array([5.5])}).write_delta(
+            path, mode="append", partition_by=["p"])  # add commit
+    changes = dt.table_changes(0).collect()
+    by_type = {}
+    for r in changes:
+        by_type.setdefault(r[-2], []).append(r)
+    assert len(by_type["insert"]) == 13
+    assert len(by_type["delete"]) == 1
+    # the deleted row's values are coherent (id=3 came from partition 1)
+    d = by_type["delete"][0]
+    names = [n for n, _ in dt.to_df().schema]
+    row = dict(zip(names, d))
+    assert row["id"] == 3 and row["p"] == 1 and row["v"] == 3.0

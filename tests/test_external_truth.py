@@ -1,0 +1,258 @@
+"""External-truth oracle tests (VERDICT r4 weak #5).
+
+The engine's usual oracle compares the device path against its OWN CPU
+path — self-referential by construction. These tests pin exec-level
+semantics against values derived OUTSIDE the engine:
+
+- hand-computed literals derived from the Spark SQL specification (each
+  case documents the derivation — the analog of committing Spark-produced
+  fixtures, which this environment cannot generate without a JVM;
+  reference: integration_tests run real Spark as the truth side),
+- pyarrow-written parquet fixtures read back through the engine (an
+  independent writer exercising the scan path),
+- pandas as an independent compute engine where its semantics provably
+  match Spark's (inner-join matching, group sums over non-null ints).
+
+If one of these fails while the self-oracle agrees on both paths, the
+ENGINE pair is wrong together — exactly the failure class the
+self-oracle cannot see.
+"""
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import functions as F
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.column import HostColumn
+from spark_rapids_tpu.columnar.table import HostTable
+from spark_rapids_tpu.ops.expr import col, lit
+from spark_rapids_tpu.plan import from_host_table
+from spark_rapids_tpu.session import TpuSession
+
+
+@pytest.fixture
+def s():
+    return TpuSession()
+
+
+def rows(df):
+    return sorted(df.collect(), key=repr)
+
+
+# -- join semantics ----------------------------------------------------------
+
+def test_inner_join_drops_null_keys(s):
+    """SQL spec: `=` is null-rejecting, so an inner join NEVER matches a
+    NULL key to anything (not even another NULL). Truth: the single
+    non-null key 1 matches once -> exactly one output row."""
+    left = HostTable(["k", "l"], [
+        HostColumn(T.LongType(), np.array([1, 0, 2]),
+                   np.array([True, False, True])),
+        HostColumn(T.LongType(), np.array([10, 20, 30]))])
+    right = HostTable(["k", "r"], [
+        HostColumn(T.LongType(), np.array([1, 0]),
+                   np.array([True, False])),
+        HostColumn(T.LongType(), np.array([100, 200]))])
+    got = rows(from_host_table(left, s).join(from_host_table(right, s),
+                                             on=["k"], how="inner"))
+    # the engine surfaces BOTH key columns (no coalescing on join)
+    assert got == [(1, 10, 1, 100)]
+
+
+def test_left_join_null_keys_emit_unmatched(s):
+    """Left outer: null-keyed left rows survive with a NULL right side."""
+    left = HostTable(["k", "l"], [
+        HostColumn(T.LongType(), np.array([1, 0]),
+                   np.array([True, False])),
+        HostColumn(T.LongType(), np.array([10, 20]))])
+    right = HostTable(["k", "r"], [
+        HostColumn(T.LongType(), np.array([1])),
+        HostColumn(T.LongType(), np.array([100]))])
+    got = rows(from_host_table(left, s).join(from_host_table(right, s),
+                                             on=["k"], how="left"))
+    assert got == [(1, 10, 1, 100), (None, 20, None, None)]
+
+
+def test_join_matches_pandas_on_multiplicity(s):
+    """Duplicate keys multiply: pandas merge implements the same inner-
+    join relational semantics — an independent engine as truth."""
+    import pandas as pd
+    rng = np.random.default_rng(5)
+    lk = rng.integers(0, 20, 300)
+    rk = rng.integers(0, 20, 100)
+    left = HostTable(["k", "l"], [
+        HostColumn(T.LongType(), lk),
+        HostColumn(T.LongType(), np.arange(300))])
+    right = HostTable(["k", "r"], [
+        HostColumn(T.LongType(), rk),
+        HostColumn(T.LongType(), np.arange(100))])
+    got = rows(from_host_table(left, s).join(from_host_table(right, s),
+                                             on=["k"], how="inner")
+               .select("k", "l", "r"))
+    want = pd.merge(pd.DataFrame({"k": lk, "l": np.arange(300)}),
+                    pd.DataFrame({"k": rk, "r": np.arange(100)}), on="k")
+    assert len(got) == len(want)
+    assert sorted(got) == sorted(
+        map(tuple, want[["k", "l", "r"]].itertuples(index=False)))
+
+
+# -- aggregation semantics ---------------------------------------------------
+
+def test_global_agg_over_empty_input(s):
+    """SQL spec: a global aggregate over zero rows yields EXACTLY ONE row
+    with count=0 and null sum/min/max (not an empty result)."""
+    ht = HostTable(["v"], [HostColumn(T.LongType(), np.array([], np.int64))])
+    got = from_host_table(ht, s).agg(
+        F.count("v").alias("c"), F.sum("v").alias("sv"),
+        F.min("v").alias("mn")).collect()
+    assert got == [(0, None, None)]
+
+
+def test_grouped_agg_over_empty_input_is_empty(s):
+    """...but a GROUPED aggregate over zero rows yields zero rows."""
+    ht = HostTable(["k", "v"], [
+        HostColumn(T.LongType(), np.array([], np.int64)),
+        HostColumn(T.LongType(), np.array([], np.int64))])
+    got = from_host_table(ht, s).group_by("k").agg(
+        F.count("v").alias("c")).collect()
+    assert got == []
+
+
+def test_count_star_vs_count_col_and_avg_ignores_nulls(s):
+    """count(*)=3 counts rows; count(v)=2 counts non-nulls; avg divides
+    by the NON-NULL count: (10+30)/2 = 20.0 exactly."""
+    ht = HostTable(["v"], [
+        HostColumn(T.DoubleType(), np.array([10.0, 0.0, 30.0]),
+                   np.array([True, False, True]))])
+    got = from_host_table(ht, s).agg(
+        F.count().alias("star"), F.count("v").alias("nonnull"),
+        F.avg("v").alias("a")).collect()
+    assert got == [(3, 2, 20.0)]
+
+
+def test_sum_of_all_null_group_is_null(s):
+    """sum over a group whose every value is NULL is NULL, count is 0."""
+    ht = HostTable(["k", "v"], [
+        HostColumn(T.LongType(), np.array([1, 1, 2])),
+        HostColumn(T.LongType(), np.array([0, 0, 5]),
+                   np.array([False, False, True]))])
+    got = rows(from_host_table(ht, s).group_by("k").agg(
+        F.sum("v").alias("sv"), F.count("v").alias("c")))
+    assert got == [(1, None, 0), (2, 5, 1)]
+
+
+def test_group_sums_match_pandas(s):
+    """Independent-engine truth for exact integer group sums."""
+    import pandas as pd
+    rng = np.random.default_rng(9)
+    k = rng.integers(0, 50, 5000)
+    v = rng.integers(-1000, 1000, 5000)
+    ht = HostTable(["k", "v"], [HostColumn(T.LongType(), k),
+                                HostColumn(T.LongType(), v)])
+    got = dict((r[0], r[1]) for r in
+               from_host_table(ht, s).group_by("k")
+               .agg(F.sum("v").alias("s")).collect())
+    want = pd.DataFrame({"k": k, "v": v}).groupby("k")["v"].sum()
+    assert got == {int(kk): int(vv) for kk, vv in want.items()}
+
+
+# -- sort semantics ----------------------------------------------------------
+
+def test_sort_null_placement_spark_defaults(s):
+    """Spark: ASC -> NULLS FIRST, DESC -> NULLS LAST (the SQL standard
+    leaves this implementation-defined; Spark's choice is what the
+    reference implements in SortUtils)."""
+    ht = HostTable(["v"], [
+        HostColumn(T.LongType(), np.array([3, 0, 1]),
+                   np.array([True, False, True]))])
+    asc = [r[0] for r in from_host_table(ht, s).sort("v").collect()]
+    assert asc == [None, 1, 3]
+    desc = [r[0] for r in
+            from_host_table(ht, s).sort("v", ascending=False).collect()]
+    assert desc == [3, 1, None]
+
+
+# -- window semantics --------------------------------------------------------
+
+def test_default_window_frame_includes_peers(s):
+    """Spark's DEFAULT frame with ORDER BY is RANGE UNBOUNDED PRECEDING
+    TO CURRENT ROW: tied order keys are PEERS, so every tied row sees the
+    sum INCLUDING all its peers. Input (one partition), ordered by o:
+      o: 1, 2, 2, 3   v: 10, 20, 30, 40
+    running sum per row: 10, 60, 60, 100  (both o=2 rows include each
+    other — the classic Spark window gotcha a ROWS frame would not
+    show)."""
+    from spark_rapids_tpu.ops.window import Window as W
+    ht = HostTable(["o", "v"], [
+        HostColumn(T.LongType(), np.array([1, 2, 2, 3])),
+        HostColumn(T.LongType(), np.array([10, 20, 30, 40]))])
+    got = from_host_table(ht, s).with_windows(
+        rs=F.sum(col("v")).over(W.order_by("o"))).collect()
+    assert [r[2] for r in got] == [10, 60, 60, 100]
+
+
+def test_rows_frame_at_partition_edges(s):
+    """ROWS BETWEEN 1 PRECEDING AND 1 FOLLOWING clamps at partition
+    edges: [10,20,30] -> 30, 60, 50."""
+    from spark_rapids_tpu.ops.window import Window as W
+    ht = HostTable(["o", "v"], [
+        HostColumn(T.LongType(), np.array([1, 2, 3])),
+        HostColumn(T.LongType(), np.array([10, 20, 30]))])
+    got = from_host_table(ht, s).with_windows(
+        rs=F.sum(col("v")).over(W.order_by("o").rows_between(-1, 1))
+    ).collect()
+    assert [r[2] for r in got] == [30, 60, 50]
+
+
+def test_row_number_vs_rank_on_ties(s):
+    """o = [5, 5, 7]: row_number = 1,2,3; rank = 1,1,3 (gap after tie)."""
+    from spark_rapids_tpu.ops.window import Window as W
+    from spark_rapids_tpu.functions import rank, row_number
+    ht = HostTable(["o"], [HostColumn(T.LongType(), np.array([5, 5, 7]))])
+    got = from_host_table(ht, s).with_windows(
+        rn=row_number().over(W.order_by("o")),
+        rk=rank().over(W.order_by("o"))).collect()
+    assert [(r[1], r[2]) for r in got] == [(1, 1), (2, 1), (3, 3)]
+
+
+# -- cast / expression semantics ---------------------------------------------
+
+def test_double_to_long_cast_truncates_toward_zero(s):
+    """Spark cast(double as long) truncates toward zero: -1.9 -> -1,
+    1.9 -> 1 (NOT floor)."""
+    ht = HostTable(["v"], [
+        HostColumn(T.DoubleType(), np.array([-1.9, 1.9, -0.5]))])
+    got = [r[0] for r in from_host_table(ht, s)
+           .select(col("v").cast("bigint").alias("i")).collect()]
+    assert got == [-1, 1, 0]
+
+
+def test_integer_division_and_mod_signs(s):
+    """Spark % follows the DIVIDEND's sign (Java semantics):
+    -7 % 3 = -1, 7 % -3 = 1."""
+    ht = HostTable(["a", "b"], [
+        HostColumn(T.LongType(), np.array([-7, 7])),
+        HostColumn(T.LongType(), np.array([3, -3]))])
+    got = [r[0] for r in from_host_table(ht, s)
+           .select((col("a") % col("b")).alias("m")).collect()]
+    assert got == [-1, 1]
+
+
+# -- independent-writer parquet fixture --------------------------------------
+
+def test_parquet_written_by_pyarrow_reads_back(s, tmp_path):
+    """pyarrow (an independent implementation) writes the fixture; the
+    engine's scan must surface exactly pyarrow's values, incl. nulls,
+    dictionary-encoded strings and out-of-order row groups."""
+    pa = pytest.importorskip("pyarrow")
+    import pyarrow.parquet as pq
+    t = pa.table({
+        "i": pa.array([1, None, 3, 4], type=pa.int64()),
+        "s": pa.array(["a", "b", None, "a"]),
+        "f": pa.array([0.5, -0.5, None, 2.25], type=pa.float64()),
+    })
+    path = str(tmp_path / "fx.parquet")
+    pq.write_table(t, path, row_group_size=2)  # 2 row groups
+    got = rows(s.read_parquet(path))
+    assert got == sorted([(1, "a", 0.5), (None, "b", -0.5),
+                          (3, None, None), (4, "a", 2.25)], key=repr)

@@ -1,0 +1,95 @@
+"""Shim layer (reference: ShimLoader.scala + build/shimplify.py —
+SURVEY.md §2.12): version-range registry resolution, override hooks, and
+the engine call sites that ride the shim."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import shims
+from spark_rapids_tpu.shims.base import BaseShim
+from spark_rapids_tpu.shims.jax_current import JaxCurrentShim
+from spark_rapids_tpu.shims.jax_legacy import JaxLegacyShim
+
+
+def test_parse_version_tolerant():
+    assert shims.parse_version("0.4.35") == (0, 4, 35)
+    assert shims.parse_version("0.9.0rc1") == (0, 9, 0)
+    assert shims.parse_version("0.9") == (0, 9, 0)
+    # vendor-suffixed strings resolve like ShimLoader tolerates
+    # '3.4.1-databricks'
+    assert shims.parse_version("0.5.3+cuda12") == (0, 5, 3)
+
+
+def test_ranges_disjoint_and_ordered():
+    """The shimplify invariant: providers own disjoint version ranges."""
+    spans = sorted((c.MIN_VERSION, c.MAX_VERSION, c.__name__)
+                   for c in shims.SHIM_PROVIDERS)
+    for (lo1, hi1, n1), (lo2, hi2, n2) in zip(spans, spans[1:]):
+        assert hi1 <= lo2, f"{n1} overlaps {n2}"
+    for lo, hi, n in spans:
+        assert lo < hi, n
+
+
+def test_resolution_picks_range():
+    assert shims.resolve_provider((0, 4, 35)) is JaxLegacyShim
+    assert shims.resolve_provider((0, 5, 3)) is JaxLegacyShim
+    assert shims.resolve_provider((0, 6, 0)) is JaxCurrentShim
+    assert shims.resolve_provider((0, 9, 0)) is JaxCurrentShim
+
+
+def test_unsupported_version_names_ranges():
+    with pytest.raises(RuntimeError) as ei:
+        shims.resolve_provider((0, 3, 0))
+    msg = str(ei.value)
+    assert "JaxLegacyShim" in msg and "JaxCurrentShim" in msg
+    assert "SPARK_RAPIDS_TPU_JAX_SHIM_OVERRIDE" in msg
+
+
+def test_running_version_resolves_and_caches():
+    shims._reset_for_tests()
+    s1 = shims.get_shim()
+    assert isinstance(s1, BaseShim)
+    assert shims.get_shim() is s1  # cached, ShimLoader-style
+
+
+def test_env_override(monkeypatch):
+    shims._reset_for_tests()
+    monkeypatch.setenv("SPARK_RAPIDS_TPU_JAX_SHIM_OVERRIDE", "0.5.1")
+    try:
+        assert isinstance(shims.get_shim(), JaxLegacyShim)
+    finally:
+        shims._reset_for_tests()
+
+
+def test_no_session_conf_override_exists():
+    """The override is deliberately an ENV VAR, not a session conf: shims
+    resolve at module import (pytree registration in columnar/nested.py),
+    before any session can exist — a conf would be silently ignored.
+    This pin keeps someone from adding one back."""
+    from spark_rapids_tpu.conf import registry
+    assert not any("shims" in k for k in registry())
+
+
+def test_both_providers_apis_work():
+    """Every provider's full API surface runs against the INSTALLED jax
+    (the legacy provider's fallbacks degrade to current spellings)."""
+    import jax
+    for cls in shims.SHIM_PROVIDERS:
+        shim = cls()
+        assert callable(shim.shard_map())
+        assert shim.tree_leaves({"a": 1, "b": (2, 3)}) == [1, 2, 3]
+        doubled = shim.tree_map(lambda x: x * 2, {"a": 1, "b": 2})
+        assert doubled == {"a": 2, "b": 4}
+        assert isinstance(shim.default_backend(), str)
+        assert shim.local_device_count() >= 1
+        n = min(shim.local_device_count(), 8)
+        mesh = shim.make_mesh((n,), ("x",))
+        assert mesh.shape["x"] == n
+        assert int(shim.jit(lambda a: a + 1)(np.int32(1))) == 2
+
+
+def test_engine_ici_exchange_rides_shim():
+    """The ICI all-to-all (the engine's shard_map call site) still runs
+    through the shim indirection."""
+    from spark_rapids_tpu.parallel.exchange import _shard_map
+    assert callable(_shard_map())
