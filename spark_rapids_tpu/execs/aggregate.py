@@ -635,9 +635,19 @@ class TpuHashAggregateExec(TpuExec):
                 if sv is not None:
                     mix[j] = k
                     k += 1
-            mcnt = jax.ops.segment_sum(
-                jnp.stack(masks, axis=1).astype(jnp.int32), gid,
-                num_segments=gpad)
+            if gpad <= 4096:
+                # one 2-D scatter: reads the input once; the minor-dim
+                # 128-lane padding on the OUTPUT is cheap at small gpad
+                mcnt = jax.ops.segment_sum(
+                    jnp.stack(masks, axis=1).astype(jnp.int32), gid,
+                    num_segments=gpad)
+            else:
+                # large gpad: the padded (gpad, 128-lane) output dwarfs
+                # the input re-reads — per-mask 1-D scatters win
+                mcnt = jnp.stack(
+                    [jax.ops.segment_sum(mk.astype(jnp.int32), gid,
+                                         num_segments=gpad)
+                     for mk in masks], axis=1)
             nonnulls = {j: mcnt[:, i] for j, i in mix.items()}
 
             exists = mcnt[:, 0] > 0
@@ -693,8 +703,12 @@ class TpuHashAggregateExec(TpuExec):
             vplan_j = [j for j, kind in fplan if kind == "var"]
             fcols = [jnp.where(svs[j], vvs[j][0].data.astype(jnp.float64), 0.0)
                      for j, _ in splan]
+            # nonnull counts are already scattered (mcnt) — the split
+            # guard reuses them instead of scattering its own
+            scnt = (jnp.stack([nonnulls[j] for j, _ in splan], axis=1)
+                    if splan else None)
             fsums_s = batched_segment_sum_f64(fcols, gid, gpad, capacity,
-                                              use_split)
+                                              use_split, counts=scnt)
             vcols = [jnp.where(svs[j], vvs[j][0].data.astype(jnp.float64), 0.0)
                      for j in vplan_j]
             fsums_v = batched_segment_sum_f64(vcols, gid, gpad, capacity,
@@ -897,7 +911,8 @@ class TpuHashAggregateExec(TpuExec):
                 return _dec_sum_segments(fnagg.data_type, sd, sv, gid,
                                          nseg, has_any)
             v = jnp.where(sv, sd.astype(jnp.float64), 0.0)
-            s = segment_sum_f64(v, gid, nseg, capacity, use_split)
+            s = segment_sum_f64(v, gid, nseg, capacity, use_split,
+                                counts=nonnull)
             return (jnp.where(has_any, s, 0.0), has_any)
 
         if isinstance(fnagg, agg.Average):

@@ -93,16 +93,17 @@ MATMUL_MAX_SEGMENTS = 32
 
 
 def batched_segment_sum_f64(cols, gid, num_segments: int, capacity: int,
-                            use_split: bool):
+                            use_split: bool, counts=None):
     """Segmented sums of several f64 columns in ONE device pass.
 
     ``cols``: list of (capacity,) f64 arrays, invalid slots zeroed. Returns
-    (num_segments, len(cols)) f64. The split path stages every column's
-    hi/lo/|hi| f32 streams into a single (capacity, 3m) array and reduces it
-    with one blocked one-hot einsum on the MXU (small segment counts) or one
-    2-D scatter segment_sum — ~15x cheaper than per-column emulated-f64
-    scatters. Shares segment_sum_f64's exact-fallback guard (the whole batch
-    reroutes if ANY column is risky)."""
+    (num_segments, len(cols)) f64. Small segment counts reduce hi/lo/|hi|
+    f32 streams with one blocked one-hot einsum on the MXU; medium counts
+    use blocked 2-D scatter partials; large counts (beyond MAX_PARTIALS)
+    take _batched_unblocked_split's per-stream 1-D scatters with the
+    count-scaled guard. All paths share the exact-fallback guard (the
+    whole batch reroutes if ANY column is risky); ``counts`` optionally
+    feeds the unblocked guard a precomputed row-count bound."""
     m = len(cols)
     if m == 0:
         return jnp.zeros((num_segments, 0), dtype=jnp.float64)
@@ -115,9 +116,9 @@ def batched_segment_sum_f64(cols, gid, num_segments: int, capacity: int,
         # large segment counts (int-domain fast-path group-bys): per-block
         # partials would outgrow the input, but the emulated-f64 scatter
         # fallback is the single most expensive op on TPU — run the
-        # UNBLOCKED split instead (one 2-D f32 scatter + count-scaled
-        # guard, mirroring _unblocked_split_segment_sum)
-        return _batched_unblocked_split(cols, gid, num_segments)
+        # UNBLOCKED split instead (f32 scatters + count-scaled guard)
+        return _batched_unblocked_split(cols, gid, num_segments,
+                                        counts=counts)
 
     his, los, abss = [], [], []
     for c in cols:
@@ -156,33 +157,62 @@ def batched_segment_sum_f64(cols, gid, num_segments: int, capacity: int,
                         jnp.zeros((), dtype=jnp.int32))
 
 
-def _batched_unblocked_split(cols, gid, num_segments: int):
-    """Unblocked split for SEVERAL f64 columns at a large segment count:
-    one (capacity, 3m) f32 scatter of every column's hi/lo/|hi| streams
-    plus one shared i32 row count. The per-segment count term follows
-    _unblocked_split_segment_sum's error model; the count of rows with ANY
-    nonzero column is an upper bound for each column's own count, so the
-    estimate only over-reroutes (never under-guards)."""
+def _batched_unblocked_split(cols, gid, num_segments: int, counts=None):
+    """Unblocked split for SEVERAL f64 columns at a large segment count.
+
+    Every 1-D scatter pass over the input costs ~100ms at 4M rows on TPU
+    (XLA scatter with duplicate indices serializes), so the pass count IS
+    the cost model here:
+      - hi and lo streams: one scatter each (unavoidable — the sums);
+      - |hi| mass for the error guard: SKIPPED when every value is
+        globally non-negative (then mass == hi sum exactly — the
+        TPC-measure common case), else one scatter per column via
+        lax.cond;
+      - per-segment row count for the guard's scale term: callers that
+        already scattered nonnull counts (the aggregate kernels) pass
+        them via ``counts`` ((num_segments,) or (num_segments, m) i32,
+        an UPPER bound on contributing rows) and the scatter is skipped.
+    Per-stream 1-D scatters, never a (capacity, 3m) 2-D scatter: the TPU
+    lane width is 128 and a 2-D scatter pads the tiny minor dim to it."""
     m = len(cols)
-    his, los, abss = [], [], []
+    his, los = [], []
     for c in cols:
         hi, lo = split_f64_hi_lo(c)
         his.append(hi)
         los.append(lo)
-        abss.append(jnp.abs(hi))
-    x = jnp.stack(his + los + abss, axis=1)  # (capacity, 3m)
-    parts = jax.ops.segment_sum(x, gid, num_segments=num_segments)
-    any_nz = jnp.zeros(cols[0].shape, dtype=jnp.bool_)
-    for c in cols:
-        any_nz = any_nz | (c != 0.0)
-    cnt = jax.ops.segment_sum(any_nz.astype(jnp.int32), gid,
-                              num_segments=num_segments)
+    parts = jnp.stack(
+        [jax.ops.segment_sum(st, gid, num_segments=num_segments)
+         for st in his + los], axis=1)
+    if counts is None:
+        any_nz = jnp.zeros(cols[0].shape, dtype=jnp.bool_)
+        for c in cols:
+            any_nz = any_nz | (c != 0.0)
+        cnt2 = jax.ops.segment_sum(any_nz.astype(jnp.int32), gid,
+                                   num_segments=num_segments)[:, None]
+    else:
+        cnt2 = counts if counts.ndim == 2 else counts[:, None]
     p64 = parts.astype(jnp.float64)
-    shi, slo, mass = p64[:, :m], p64[:, m:2 * m], p64[:, 2 * m:]
+    shi, slo = p64[:, :m], p64[:, m:2 * m]
     split_sum = shi + slo
 
-    scale = jnp.sqrt(jnp.maximum(cnt.astype(jnp.float64) / BLOCK, 1.0))
-    err_est = ERR_PER_MASS * scale[:, None] * mass
+    all_nonneg = jnp.ones((), dtype=jnp.bool_)
+    for hi in his:
+        all_nonneg = all_nonneg & jnp.all(hi >= 0)
+
+    def mass_from_hi(_):
+        return shi
+
+    def mass_scatter(_):
+        return jnp.stack(
+            [jax.ops.segment_sum(jnp.abs(hi), gid,
+                                 num_segments=num_segments)
+             for hi in his], axis=1).astype(jnp.float64)
+
+    mass = jax.lax.cond(all_nonneg, mass_from_hi, mass_scatter,
+                        jnp.zeros((), dtype=jnp.int32))
+
+    scale = jnp.sqrt(jnp.maximum(cnt2.astype(jnp.float64) / BLOCK, 1.0))
+    err_est = ERR_PER_MASS * scale * mass
     risky = err_est > (jnp.abs(split_sum) * RTOL + ATOL)
     has_big = jnp.zeros((), dtype=jnp.bool_)
     for c in cols:
@@ -273,18 +303,24 @@ def _unblocked_split_segment_sum(v, gid, num_segments: int):
     return _batched_unblocked_split([v], gid, num_segments)[:, 0]
 
 
-def segment_sum_f64(v, gid, num_segments: int, capacity: int, use_split: bool):
+def segment_sum_f64(v, gid, num_segments: int, capacity: int,
+                    use_split: bool, counts=None):
     """segment_sum for f64 ``v`` (invalid slots must already be zeroed).
 
     ``gid`` must be int32 in [0, num_segments). Non-f64 dtypes and
     disabled split configurations take the plain jax.ops.segment_sum
     path; oversized configurations (num_segments*blocks would outgrow
-    the input) take the guarded UNBLOCKED split path."""
+    the input) take the guarded UNBLOCKED split path. ``counts``: an
+    optional caller-scattered per-segment row-count upper bound — the
+    unblocked guard reuses it instead of scattering its own."""
     if v.dtype != jnp.float64 or not use_split:
         return jax.ops.segment_sum(v, gid, num_segments=num_segments)
     block = min(BLOCK, capacity)
     nb = max(capacity // block, 1)
     if nb * block != capacity or nb * num_segments > MAX_PARTIALS:
+        if counts is not None:
+            return _batched_unblocked_split([v], gid, num_segments,
+                                            counts=counts)[:, 0]
         return _unblocked_split_segment_sum(v, gid, num_segments)
 
     hi, lo = split_f64_hi_lo(v)
