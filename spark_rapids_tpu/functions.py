@@ -190,6 +190,23 @@ def shiftright(e, n):
 
 # window functions: thin delegates to the single implementations in
 # ops/window.py (reference: window/ package exprs)
+def input_file_name():
+    """Name of the file feeding the current row ('' when no file scan is
+    in scope — Spark semantics)."""
+    from spark_rapids_tpu.ops.inputfile import InputFileName
+    return InputFileName()
+
+
+def input_file_block_start():
+    from spark_rapids_tpu.ops.inputfile import InputFileBlockStart
+    return InputFileBlockStart()
+
+
+def input_file_block_length():
+    from spark_rapids_tpu.ops.inputfile import InputFileBlockLength
+    return InputFileBlockLength()
+
+
 def row_number():
     from spark_rapids_tpu.ops import window as _w
     return _w.row_number()

@@ -230,8 +230,54 @@ class FileScanNode(PlanNode):
         self._data_schema = data_schema
         self._partition_schema = part_schema
 
+    #: set by overrides/input_file.py when the plan references
+    #: input_file_name()/input_file_block_*: every batch gains hidden
+    #: per-row provenance columns (reference: GpuInputFileName family +
+    #: InputFileBlockRule keeping the exprs in the scan's stage)
+    provide_file_info: bool = False
+
+    def enable_file_info(self) -> None:
+        self.provide_file_info = True
+
+    def _attach_file_info(self, table: HostTable, path: str) -> HostTable:
+        if not self.provide_file_info:
+            return table
+        from spark_rapids_tpu.ops.inputfile import (
+            FILE_LENGTH_COL,
+            FILE_NAME_COL,
+            FILE_START_COL,
+        )
+        if FILE_NAME_COL in table.names:
+            return table  # chunk already stamped
+        n = table.num_rows
+        name = np.empty(n, dtype=object)
+        name[:] = path
+        try:
+            size = os.path.getsize(path)
+            start = 0
+        except OSError:
+            # unreadable between decode and stamping: coherent Spark
+            # no-info pair, not a 0/-1 mix
+            size = start = -1
+        cols = list(table.columns) + [
+            HostColumn(T.STRING, name),
+            HostColumn(T.LONG, np.full(n, start, dtype=np.int64)),
+            HostColumn(T.LONG, np.full(n, size, dtype=np.int64))]
+        return HostTable(
+            list(table.names) + [FILE_NAME_COL, FILE_START_COL,
+                                 FILE_LENGTH_COL], cols)
+
     def output_schema(self) -> Schema:
         self._resolve_schemas()
+        if self.provide_file_info:
+            from spark_rapids_tpu.ops.inputfile import (
+                FILE_LENGTH_COL,
+                FILE_NAME_COL,
+                FILE_START_COL,
+            )
+            return list(self._schema) + [
+                (FILE_NAME_COL, T.STRING), (FILE_START_COL, T.LONG),
+                (FILE_LENGTH_COL, T.LONG)]
         return self._schema
 
     @property
@@ -241,11 +287,12 @@ class FileScanNode(PlanNode):
         return self._data_schema
 
     def _with_partition_columns(self, table: HostTable, path: str) -> HostTable:
-        """Append recovered partition-value columns and order to the output
+        """Append recovered partition-value columns (and, when enabled,
+        the input-file provenance columns) and order to the output
         schema."""
         self._resolve_schemas()
         if not self._partition_schema:
-            return table
+            return self._attach_file_info(table, path)
         spec = dict(partition_spec_of(path))
         n = table.num_rows
         names = list(table.names)
@@ -270,7 +317,8 @@ class FileScanNode(PlanNode):
             cols.append(HostColumn(dt, data, validity))
         by_name = dict(zip(names, cols))
         out_names = [n for n, _ in self._schema]
-        return HostTable(out_names, [by_name[n] for n in out_names])
+        out = HostTable(out_names, [by_name[n] for n in out_names])
+        return self._attach_file_info(out, path)
 
     # -- PlanNode -----------------------------------------------------------
     def execute_cpu(self, dynamic_prunes=None,

@@ -1,0 +1,135 @@
+"""input_file_name / input_file_block_start / input_file_block_length.
+
+Reference: InputFileBlockRule.scala + GpuInputFileName/GpuInputFileBlock*
+(org/apache/spark/sql/rapids) — the reference constrains plan chains so
+the expressions stay in the same stage as the file scan (issue #3333).
+This engine's analog (overrides/input_file.py) REWRITES the plan instead:
+the scan attaches per-row provenance columns (file name as a 1-entry
+dictionary per batch, block start/length as constants per batch) and the
+expressions become bound references to them. Granularity note: the
+engine's readers split at file / row-group level and report per-FILE
+blocks (start 0, length = file size).
+
+An expression that survives rewrite (no file scan below it, or a
+shuffle/aggregate boundary in between) evaluates to Spark's
+"no file info available" values: empty string / -1.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.column import HostColumn
+from spark_rapids_tpu.ops.expr import DevVal, Expression, NodePrep
+
+#: hidden provenance column names the scan attaches
+FILE_NAME_COL = "__input_file_name__"
+FILE_START_COL = "__input_file_block_start__"
+FILE_LENGTH_COL = "__input_file_block_length__"
+FILE_INFO_COLS = (FILE_NAME_COL, FILE_START_COL, FILE_LENGTH_COL)
+
+
+class _InputFileExpr(Expression):
+    """Base: binds to itself; evaluates to the NO-INFO constant unless the
+    plan rewrite substituted a provenance column reference."""
+
+    children = ()
+
+    def bind(self, schema):
+        return self
+
+    def with_children(self, children):
+        return self
+
+    def key(self):
+        return (self.name.lower(),)
+
+    @property
+    def nullable(self):
+        return False
+
+    def _no_info(self):  # (numpy fill value,)
+        raise NotImplementedError
+
+    def eval_cpu(self, table):
+        n = table.num_rows
+        return self._host_const(n)
+
+    def prep(self, pctx, child_preps) -> NodePrep:
+        return self._dev_prep(pctx)
+
+    def eval_dev(self, ctx, child_vals, prep) -> DevVal:
+        import jax.numpy as jnp
+        data = jnp.zeros(ctx.capacity, dtype=self._dev_dtype())
+        valid = jnp.ones(ctx.capacity, dtype=jnp.bool_)
+        return DevVal(data + self._dev_fill(), valid)
+
+
+class InputFileName(_InputFileExpr):
+    name = "InputFileName"
+
+    @property
+    def data_type(self):
+        return T.STRING
+
+    def _host_const(self, n):
+        data = np.empty(n, dtype=object)
+        data[:] = ""
+        return HostColumn(T.STRING, data)
+
+    def _dev_prep(self, pctx):
+        return NodePrep(out_dict=np.array([""], dtype=object))
+
+    def _dev_dtype(self):
+        import jax.numpy as jnp
+        return jnp.int32  # dictionary code 0 -> ""
+
+    def _dev_fill(self):
+        return 0
+
+
+class InputFileBlockStart(_InputFileExpr):
+    name = "InputFileBlockStart"
+
+    @property
+    def data_type(self):
+        return T.LONG
+
+    def _host_const(self, n):
+        return HostColumn(T.LONG, np.full(n, -1, dtype=np.int64))
+
+    def _dev_prep(self, pctx):
+        return NodePrep()
+
+    def _dev_dtype(self):
+        import jax.numpy as jnp
+        return jnp.int64
+
+    def _dev_fill(self):
+        return -1
+
+
+class InputFileBlockLength(InputFileBlockStart):
+    name = "InputFileBlockLength"
+
+
+def contains_input_file_expr(expr: Expression) -> bool:
+    if isinstance(expr, _InputFileExpr):
+        return True
+    return any(contains_input_file_expr(c) for c in expr.children)
+
+
+def substitute(expr: Expression, schema) -> Expression:
+    """Replace input_file_* nodes with bound references to the hidden
+    provenance columns present in ``schema``."""
+    from spark_rapids_tpu.ops.expr import BoundReference
+    names = [n for n, _ in schema]
+    if isinstance(expr, _InputFileExpr):
+        col = {InputFileName: FILE_NAME_COL,
+               InputFileBlockStart: FILE_START_COL,
+               InputFileBlockLength: FILE_LENGTH_COL}[type(expr)]
+        i = names.index(col)
+        return BoundReference(i, schema[i][1], name_hint=col)
+    kids = [substitute(c, schema) for c in expr.children]
+    return expr.with_children(kids)

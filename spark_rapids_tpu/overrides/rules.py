@@ -123,6 +123,10 @@ def _build_expr_sigs():
         reg(getattr(nested_ops, name), COMMON_PLUS_NESTED)
     from spark_rapids_tpu.ops.bloom import BloomFilterMightContain
     reg(BloomFilterMightContain)
+    from spark_rapids_tpu.ops import inputfile as if_ops
+    for name in ("InputFileName", "InputFileBlockStart",
+                 "InputFileBlockLength"):
+        reg(getattr(if_ops, name))
     reg(coll.Sequence, COMMON_PLUS_ARRAYS)
     from spark_rapids_tpu.ops import json_structs as js
     reg(js.JsonToStructs, COMMON_PLUS_NESTED)

@@ -130,6 +130,9 @@ class TpuSession:
         from spark_rapids_tpu.runtime import RMM_TPU, TpuSemaphore, acquired
         from spark_rapids_tpu.runtime.retry import MAX_RETRIES_VAR
 
+        from spark_rapids_tpu.overrides.input_file import \
+            rewrite_input_file_exprs
+        plan = rewrite_input_file_exprs(plan)
         executable, meta = apply_overrides(plan, self.conf)
         if meta is not None and self.conf.explain_mode in ("NOT_ON_GPU", "ALL"):
             print(meta.explain(only_fallback=self.conf.explain_mode == "NOT_ON_GPU"))

@@ -1,0 +1,152 @@
+"""input_file_name() / input_file_block_start / length (reference:
+InputFileBlockRule.scala + GpuInputFileName family). The engine rewrites
+the plan so the scan attaches per-row provenance columns; these tests pin
+selection, grouping, filtering, partitioned scans, reader modes, no-info
+fallback above joins, and the hidden-column leak guard."""
+
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_tpu import functions as F
+from spark_rapids_tpu.ops.expr import col, lit
+
+
+@pytest.fixture
+def three_files(tmp_path):
+    for i in range(3):
+        pq.write_table(
+            pa.table({"a": pa.array([i * 10 + 1, i * 10 + 2],
+                                    type=pa.int64())}),
+            str(tmp_path / f"f{i}.parquet"))
+    return str(tmp_path / "*.parquet")
+
+
+def test_select_name_start_length(session, cpu_session, three_files):
+    def q(s):
+        return sorted(s.read_parquet(three_files).select(
+            col("a"), F.input_file_name().alias("f"),
+            F.input_file_block_start().alias("st"),
+            F.input_file_block_length().alias("ln")).collect())
+    a, b = q(session), q(cpu_session)
+    assert a == b
+    assert len({r[1] for r in a}) == 3
+    assert all(r[1].endswith(".parquet") for r in a)
+    assert all(r[2] == 0 and r[3] > 0 for r in a)
+
+
+def test_group_by_file(session, three_files):
+    g = sorted(session.read_parquet(three_files).group_by(
+        F.input_file_name().alias("f")).agg(F.count().alias("c")).collect())
+    assert len(g) == 3 and all(r[1] == 2 for r in g)
+
+
+def test_filter_hides_provenance_columns(session, cpu_session, three_files):
+    def q(s):
+        return sorted(s.read_parquet(three_files).filter(
+            F.like(F.input_file_name(), "%f1%")).collect())
+    a, b = q(session), q(cpu_session)
+    assert a == b == [(11,), (12,)]
+
+
+def test_no_info_above_join(session):
+    df1 = session.create_dataframe({"k": np.array([1, 2], dtype=np.int64)})
+    df2 = session.create_dataframe({"k": np.array([1, 2], dtype=np.int64)})
+    r = df1.join(df2, on=["k"]).select(
+        F.input_file_name().alias("f"),
+        F.input_file_block_start().alias("st")).collect()
+    assert all(x == ("", -1) for x in r)
+
+
+def test_partitioned_scan_keeps_partition_and_provenance(session, tmp_path):
+    for p in (0, 1):
+        d = tmp_path / f"p={p}"
+        d.mkdir()
+        pq.write_table(pa.table({"a": pa.array([p, p + 10],
+                                               type=pa.int64())}),
+                       str(d / "x.parquet"))
+    got = sorted(session.read_parquet(str(tmp_path / "*" / "*.parquet"))
+                 .select(col("a"), col("p"),
+                         F.input_file_name().alias("f")).collect())
+    assert len(got) == 4
+    assert all(f"p={r[1]}" in r[2] for r in got)
+
+
+def test_reader_modes_agree(session, three_files):
+    want = None
+    for mode in ("PERFILE", "MULTITHREADED", "COALESCING"):
+        got = sorted(session.read_parquet(
+            three_files, reader_type=mode).select(
+            col("a"), F.input_file_name().alias("f")).collect())
+        if want is None:
+            want = got
+        else:
+            assert got == want, mode
+
+
+def test_rewrite_is_idempotent(session, three_files):
+    df = session.read_parquet(three_files).select(
+        F.input_file_name().alias("f"))
+    a = sorted(df.collect())
+    b = sorted(df.collect())  # second execute re-runs the rewrite
+    assert a == b and len(a) == 6
+
+
+def test_shared_scan_node_not_polluted(session, three_files):
+    """Code-review r5: the rewrite is copy-on-write — a base DataFrame
+    sharing the scan node with an input_file query must not grow hidden
+    columns in its own results."""
+    base = session.read_parquet(three_files)
+    with_file = base.select(F.input_file_name().alias("f"))
+    assert len(with_file.collect()) == 6
+    # the sibling query sees the ORIGINAL scan schema
+    plain = sorted(base.collect())
+    assert all(len(r) == 1 for r in plain), plain[:2]
+    from spark_rapids_tpu.io.common import FileScanNode
+
+    def find_scan(n):
+        if isinstance(n, FileScanNode):
+            return n
+        for c in getattr(n, "children", ()):
+            got = find_scan(c)
+            if got is not None:
+                return got
+        return None
+    assert find_scan(base.plan).provide_file_info is False
+
+
+def test_two_intermediate_projects(session, three_files):
+    """Code-review r5: passthrough columns thread BOTTOM-UP through
+    multiple stacked projects."""
+    got = sorted(session.read_parquet(three_files)
+                 .select(col("a"))
+                 .select(col("a"))
+                 .select(col("a"), F.input_file_name().alias("f"))
+                 .collect())
+    assert len(got) == 6 and len({r[1] for r in got}) == 3
+
+
+def test_join_above_input_file_filter(session, three_files):
+    """Code-review r5: a filter on input_file_name feeding a join must
+    not shift the join's right-side ordinals (the hidden columns are
+    dropped before the join sees them)."""
+    left = session.read_parquet(three_files).filter(
+        F.like(F.input_file_name(), "%f1%")).with_column("k", col("a"))
+    right = session.create_dataframe(
+        {"k": np.array([11, 12], dtype=np.int64),
+         "w": np.array([100, 200], dtype=np.int64)})
+    got = sorted(left.join(right, on=["k"], how="inner")
+                 .select(col("k"), col("w")).collect())
+    assert got == [(11, 100), (12, 200)]
+
+
+def test_sort_by_input_file_name(session, three_files):
+    """Code-review r5: input_file_* as a SORT key is substituted (it
+    lives in Sort.orders, not an expr list)."""
+    got = [r[0] for r in session.read_parquet(three_files)
+           .sort(F.input_file_name(), ascending=False).collect()]
+    # descending by file path: f2 rows first, then f1, then f0
+    assert got[:2] == [21, 22] and got[-2:] == [1, 2]
