@@ -421,6 +421,13 @@ AGG_MAX_DICT_GROUPS = int_conf(
     "fast path (grouping keys that are dictionary-encoded strings or "
     "booleans aggregate by direct segment reduction, no sort).")
 
+DEVICE_ORDINAL = int_conf(
+    "spark.rapids.tpu.deviceOrdinal", -1,
+    "Local device the session computes on: -1 = auto (first local "
+    "device; multi-process launches pick round-robin by process index, "
+    "the GpuDeviceManager executor-id addressing analog). An explicit "
+    "ordinal must be a valid jax local device index.", startup_only=True)
+
 AGG_MAX_KEY_DOMAIN_GROUPS = int_conf(
     "spark.rapids.tpu.agg.maxKeyDomainGroups", 1 << 21,
     "Max key-domain product for the no-sort INTEGER-key aggregation fast "

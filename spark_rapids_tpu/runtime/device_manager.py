@@ -29,6 +29,14 @@ class DeviceInfo:
     device: object
     platform: str
     hbm_limit_bytes: int
+    #: PJRT topology facts (GpuDeviceManager resource-discovery analog)
+    device_ordinal: int = 0
+    process_index: int = 0
+    num_processes: int = 1
+    local_device_count: int = 1
+    global_device_count: int = 1
+    coords: Optional[tuple] = None
+    core_on_chip: Optional[int] = None
 
 
 class TpuDeviceManager:
@@ -42,11 +50,33 @@ class TpuDeviceManager:
         self.info: Optional[DeviceInfo] = None
         self.initialized = False
 
+    def _select_device(self, local: List[object]) -> int:
+        """Device selection (reference: GpuDeviceManager.scala:243-251 —
+        explicit resource address, else round-robin by executor id).
+        TPU analog: explicit conf ordinal, else round-robin by process
+        index across multi-process launches."""
+        from spark_rapids_tpu.conf import DEVICE_ORDINAL
+        want = self.conf.get_entry(DEVICE_ORDINAL)
+        if want >= 0:
+            if want >= len(local):
+                from spark_rapids_tpu.errors import ColumnarProcessingError
+                raise ColumnarProcessingError(
+                    f"spark.rapids.tpu.deviceOrdinal={want} but only "
+                    f"{len(local)} local devices exist")
+            return want
+        try:
+            pi = jax.process_index()
+        except Exception:
+            pi = 0
+        return pi % len(local) if len(local) else 0
+
     def initialize(self):
         if self.initialized:
             return
         self.devices = list(jax.devices())
-        dev = self.devices[0]
+        local = list(jax.local_devices())
+        ordinal = self._select_device(local)
+        dev = local[ordinal]
         total = _DEFAULT_HBM_BYTES
         stats = None
         try:
@@ -58,7 +88,18 @@ class TpuDeviceManager:
         frac = self.conf.get_entry(HBM_POOL_FRACTION)
         reserve = self.conf.get_entry(HBM_RESERVE_BYTES)
         limit = max(int(total * frac) - reserve, 256 << 20)
-        self.info = DeviceInfo(device=dev, platform=dev.platform, hbm_limit_bytes=limit)
+        try:
+            nproc = jax.process_count()
+            pidx = jax.process_index()
+        except Exception:
+            nproc, pidx = 1, 0
+        self.info = DeviceInfo(
+            device=dev, platform=dev.platform, hbm_limit_bytes=limit,
+            device_ordinal=ordinal, process_index=pidx,
+            num_processes=nproc, local_device_count=len(local),
+            global_device_count=len(self.devices),
+            coords=getattr(dev, "coords", None),
+            core_on_chip=getattr(dev, "core_on_chip", None))
         from spark_rapids_tpu.conf import (
             HOST_MEMORY_LIMIT,
             HOST_SPILL_STORAGE_SIZE,
@@ -90,3 +131,19 @@ class TpuDeviceManager:
     @property
     def concurrent_tasks(self) -> int:
         return self.conf.get_entry(CONCURRENT_TPU_TASKS)
+
+    def topology(self) -> dict:
+        """Discovery summary (logged at session init; the reference logs
+        the chosen GPU + memory configuration the same way)."""
+        i = self.info
+        return {
+            "platform": i.platform,
+            "device_ordinal": i.device_ordinal,
+            "local_devices": i.local_device_count,
+            "global_devices": i.global_device_count,
+            "process_index": i.process_index,
+            "num_processes": i.num_processes,
+            "coords": i.coords,
+            "core_on_chip": i.core_on_chip,
+            "hbm_limit_bytes": i.hbm_limit_bytes,
+        }

@@ -93,3 +93,29 @@ def test_engine_ici_exchange_rides_shim():
     through the shim indirection."""
     from spark_rapids_tpu.parallel.exchange import _shard_map
     assert callable(_shard_map())
+
+
+def test_device_manager_discovery_and_selection():
+    """Resource discovery + device selection (GpuDeviceManager analog):
+    topology facts recorded, explicit ordinal honored, bad ordinal
+    rejected with a clear error."""
+    from spark_rapids_tpu.conf import RapidsConf
+    from spark_rapids_tpu.errors import ColumnarProcessingError
+    from spark_rapids_tpu.runtime.device_manager import TpuDeviceManager
+    m = TpuDeviceManager(RapidsConf())
+    m.initialize()
+    topo = m.topology()
+    assert topo["local_devices"] >= 1
+    assert 0 <= topo["device_ordinal"] < topo["local_devices"]
+    assert topo["hbm_limit_bytes"] > 0
+    assert topo["num_processes"] >= 1
+
+    m2 = TpuDeviceManager(RapidsConf(
+        {"spark.rapids.tpu.deviceOrdinal": topo["local_devices"] - 1}))
+    m2.initialize()
+    assert m2.topology()["device_ordinal"] == topo["local_devices"] - 1
+
+    bad = TpuDeviceManager(RapidsConf(
+        {"spark.rapids.tpu.deviceOrdinal": 4096}))
+    with pytest.raises(ColumnarProcessingError):
+        bad.initialize()
