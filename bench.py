@@ -19,17 +19,27 @@ import time
 
 def main():
     import spark_rapids_tpu  # noqa: F401
-    from spark_rapids_tpu.models.tpch import lineitem_table, q1_dataframe, q1_pandas
+    from spark_rapids_tpu.models.tpch import (
+        lineitem_table,
+        q1_dataframe,
+        q1_pandas,
+        q1_sql,
+    )
     from spark_rapids_tpu.session import TpuSession
 
-    rows = int(sys.argv[1]) if len(sys.argv) > 1 else 4_000_000
+    argv = [a for a in sys.argv[1:]]
+    use_sql = "--sql" in argv
+    if use_sql:
+        argv.remove("--sql")
+    rows = int(argv[0]) if argv else 4_000_000
     table = lineitem_table(rows, seed=0)
 
     session = TpuSession()
+    q1_build = q1_sql if use_sql else q1_dataframe
 
     # cold: compile + upload + first run
     t0 = time.perf_counter()
-    _ = q1_dataframe(session, table).collect_table()
+    _ = q1_build(session, table).collect_table()
     cold_s = time.perf_counter() - t0
 
     # warm (steady state): compiled, table device-resident. >=3 trials

@@ -115,6 +115,106 @@ def build_queries(s, tables):
             "q6": q6, "q7": q7, "q8": q8, "q9": q9, "q10": q10}
 
 
+def sql_texts():
+    """q1-q10 re-expressed as SQL text. Each query is written so the
+    analyzer lowers it onto the SAME plan shape as its build_queries DSL
+    form (nested selects mirror select/with_column chains; USING joins
+    mirror on=[key] joins) — test_sql_frontend.py asserts result AND
+    device-dispatch-count equality between the two forms."""
+    import datetime as _dt
+    cutoff = (_dt.date(1970, 1, 1) + _dt.timedelta(days=10500)).isoformat()
+    cut9 = (_dt.date(1970, 1, 1) + _dt.timedelta(days=9000)).isoformat()
+    return {
+        "q1": f"""
+            SELECT l_returnflag, l_linestatus,
+                   SUM(l_quantity) AS sum_qty,
+                   SUM(l_extendedprice) AS sum_base,
+                   AVG(l_discount) AS avg_disc,
+                   COUNT(l_quantity) AS cnt
+            FROM lineitem
+            WHERE l_shipdate <= DATE '{cutoff}'
+            GROUP BY l_returnflag, l_linestatus""",
+        "q2": """
+            SELECT SUM(revenue) AS total FROM (
+                SELECT l_extendedprice * l_discount AS revenue
+                FROM lineitem
+                WHERE l_discount > 0.05 AND l_quantity < 25)""",
+        "q3": """
+            SELECT o_custkey, SUM(l_extendedprice) AS spend,
+                   COUNT(l_quantity) AS items
+            FROM lineitem
+            JOIN (SELECT o_orderkey, o_custkey, o_orderdate,
+                         o_orderkey AS l_orderkey
+                  FROM (SELECT o_orderkey, o_custkey, o_orderdate
+                        FROM orders))
+              USING (l_orderkey)
+            GROUP BY o_custkey""",
+        "q4": """
+            SELECT c_nationkey, SUM(l_extendedprice) AS rev
+            FROM (SELECT *, o_custkey AS c_custkey
+                  FROM (SELECT l_orderkey, l_extendedprice FROM lineitem)
+                  JOIN (SELECT o_orderkey, o_custkey,
+                               o_orderkey AS l_orderkey
+                        FROM (SELECT o_orderkey, o_custkey FROM orders))
+                    USING (l_orderkey))
+            JOIN (SELECT c_custkey, c_nationkey FROM customer)
+              USING (c_custkey)
+            GROUP BY c_nationkey""",
+        "q5": """
+            SELECT * FROM orders ORDER BY o_totalprice DESC LIMIT 100""",
+        "q6": """
+            SELECT * FROM (
+                SELECT *, ROW_NUMBER() OVER (PARTITION BY o_custkey
+                                             ORDER BY o_totalprice) AS rn
+                FROM orders)
+            WHERE rn <= 3""",
+        "q7": """
+            SELECT /*+ REPARTITION(8, l_returnflag) */
+                   l_returnflag, COUNT(l_quantity) AS c,
+                   SUM(l_quantity) AS s
+            FROM lineitem GROUP BY l_returnflag""",
+        "q8": """
+            SELECT COUNT(m) AS n_custs FROM (
+                SELECT o_custkey, MAX(o_totalprice) AS m
+                FROM orders GROUP BY o_custkey)""",
+        "q9": f"""
+            SELECT c_nationkey, SUM(rev) AS revenue FROM (
+                SELECT c_nationkey,
+                       l_extendedprice * (1.0 - l_discount) AS rev
+                FROM (SELECT *, o_custkey AS c_custkey
+                      FROM (SELECT l_orderkey, l_extendedprice, l_discount
+                            FROM lineitem)
+                      JOIN (SELECT o_orderkey, o_custkey,
+                                   o_orderkey AS l_orderkey
+                            FROM (SELECT o_orderkey, o_custkey FROM orders
+                                  WHERE o_orderdate >= DATE '{cut9}'))
+                        USING (l_orderkey))
+                JOIN (SELECT c_custkey, c_nationkey FROM customer)
+                  USING (c_custkey))
+            GROUP BY c_nationkey
+            ORDER BY revenue DESC LIMIT 10""",
+        "q10": """
+            SELECT SUM(l_extendedprice) AS total
+            FROM (SELECT l_orderkey, l_quantity, l_extendedprice
+                  FROM lineitem)
+            JOIN (SELECT l_orderkey, AVG(l_quantity) AS avg_qty
+                  FROM lineitem GROUP BY l_orderkey)
+              USING (l_orderkey)
+            WHERE CAST(l_quantity AS double) < 0.6 * avg_qty""",
+    }
+
+
+def build_sql_queries(s, tables):
+    """q1-q10 from SQL text via session.sql() over temp views (--sql
+    mode): same queries as build_queries, entering through the parser ->
+    analyzer -> plan layer instead of the DataFrame DSL."""
+    from spark_rapids_tpu.plan import from_host_table
+    for name, table in tables.items():
+        from_host_table(table, s).create_or_replace_temp_view(name)
+    return {name: (lambda text=text: s.sql(text))
+            for name, text in sql_texts().items()}
+
+
 def time_query(fn, runs=3):
     """Cold run + `runs` warm trials; returns (cold, min, median).
 
@@ -139,6 +239,9 @@ def main():
     ap.add_argument("--sf", type=float, default=0.1)
     ap.add_argument("--queries", type=str, default="")
     ap.add_argument("--cpu-baseline", action="store_true")
+    ap.add_argument("--sql", action="store_true",
+                    help="run the q1-q10 SQL-text forms through "
+                         "session.sql() instead of the DataFrame DSL")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--out", type=str, default="")
     args = ap.parse_args()
@@ -152,17 +255,19 @@ def main():
               for name, spec in specs.items()}
     gen_s = time.perf_counter() - t0
 
+    build = build_sql_queries if args.sql else build_queries
     tpu = TpuSession()
-    queries = build_queries(tpu, tables)
+    queries = build(tpu, tables)
     wanted = ([q.strip() for q in args.queries.split(",") if q.strip()]
               or list(queries))
 
     cpu_queries = None
     if args.cpu_baseline:
         cpu = TpuSession({"spark.rapids.sql.enabled": "false"})
-        cpu_queries = build_queries(cpu, tables)
+        cpu_queries = build(cpu, tables)
 
-    report = {"scale_factor": args.sf, "datagen_s": round(gen_s, 3),
+    report = {"scale_factor": args.sf, "mode": "sql" if args.sql else "dsl",
+              "datagen_s": round(gen_s, 3),
               "rows": {k: t.num_rows for k, t in tables.items()},
               "queries": {}}
     for name in wanted:

@@ -30,49 +30,75 @@ jax.config.update("jax_enable_x64", True)
 # run ~50s each; caching them on disk amortizes across processes (the
 # reference's CUDA kernels are precompiled — this is the XLA counterpart,
 # SURVEY.md §7 "XLA compile-time amortization").
-def _effective_platform_is_cpu() -> bool:
-    """True when the PRIMARY jax platform is cpu — or UNKNOWN: a host
-    with no platform configured resolves to the cpu backend, the exact
-    case whose AOT (de)serialization aborts were observed. Only an
-    explicit non-cpu primary (the axon TPU config is 'axon,cpu')
-    enables the persistent cache."""
+def _configured_platform() -> str:
+    """The PRIMARY jax platform from explicit config ('' when the host
+    relies on JAX auto-detection). The axon TPU config is 'axon,cpu',
+    so only the first entry counts."""
     cfg = getattr(jax.config, "jax_platforms", None) or \
         _os.environ.get("JAX_PLATFORMS", "")
-    first = cfg.split(",")[0].strip().lower()
-    return first in ("", "cpu")
+    return cfg.split(",")[0].strip().lower()
 
 
-try:
-    # CPU backend: no persistent cache. The cache amortizes ~50s TPU
-    # compiles; XLA:CPU compiles are fast AND this jax's CPU AOT
-    # (de)serialization can abort/segfault on some programs and on
-    # feature-mismatched hosts — both observed in this repo's test runs.
-    if _effective_platform_is_cpu():
-        raise RuntimeError("cpu backend: skip persistent compile cache")
-    _cache_dir = _os.environ.get(
-        "SPARK_RAPIDS_TPU_CACHE",
-        _os.path.join(_os.path.dirname(__file__), "..", ".jax_cache"))
-    # XLA:CPU AOT artifacts are compiled for the BUILD host's exact CPU
-    # features and SEGFAULT when loaded on a host missing one (jax's cache
-    # key does not cover host CPU flags) — namespace the cache by a
-    # machine fingerprint so entries never cross hosts
-    import hashlib as _hashlib
-    import platform as _platform
-    _fp_src = _platform.machine() + ":" + _platform.processor()
+_compile_cache_enabled = False
+
+
+def _enable_persistent_cache() -> None:
+    global _compile_cache_enabled
     try:
-        with open("/proc/cpuinfo") as _f:
-            for _line in _f:
-                if _line.startswith("flags"):
-                    _fp_src += ":" + _line.strip()
-                    break
-    except OSError:
+        _cache_dir = _os.environ.get(
+            "SPARK_RAPIDS_TPU_CACHE",
+            _os.path.join(_os.path.dirname(__file__), "..", ".jax_cache"))
+        # XLA:CPU AOT artifacts are compiled for the BUILD host's exact
+        # CPU features and SEGFAULT when loaded on a host missing one
+        # (jax's cache key does not cover host CPU flags) — namespace the
+        # cache by a machine fingerprint so entries never cross hosts
+        import hashlib as _hashlib
+        import platform as _platform
+        _fp_src = _platform.machine() + ":" + _platform.processor()
+        try:
+            with open("/proc/cpuinfo") as _f:
+                for _line in _f:
+                    if _line.startswith("flags"):
+                        _fp_src += ":" + _line.strip()
+                        break
+        except OSError:
+            pass
+        _fp = _hashlib.sha256(_fp_src.encode()).hexdigest()[:12]
+        jax.config.update("jax_compilation_cache_dir",
+                          _os.path.join(_os.path.abspath(_cache_dir), _fp))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        _compile_cache_enabled = True
+    except Exception:  # cache is best-effort; older jax may lack the knobs
         pass
-    _fp = _hashlib.sha256(_fp_src.encode()).hexdigest()[:12]
-    jax.config.update("jax_compilation_cache_dir",
-                      _os.path.join(_os.path.abspath(_cache_dir), _fp))
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-except Exception:  # cache is best-effort; older jax may lack the knobs
-    pass
+
+
+def ensure_compile_cache() -> bool:
+    """Enable the persistent compile cache once the effective backend is
+    known to be non-CPU. Import time only trusts an EXPLICIT platform
+    config; hosts relying on JAX auto-detection (unset JAX_PLATFORMS on
+    a stock TPU VM) get the cache here, called on runtime init, via
+    jax.default_backend() — which initializes the backend, so it cannot
+    run at import (ADVICE r5). CPU stays uncached: XLA:CPU compiles are
+    fast AND this jax's CPU AOT (de)serialization can abort/segfault on
+    some programs and on feature-mismatched hosts — both observed in
+    this repo's test runs. Returns whether the cache is enabled."""
+    if _compile_cache_enabled:
+        return True
+    if _configured_platform() == "cpu":
+        return False
+    try:
+        backend = jax.default_backend()
+    except Exception:
+        return False
+    if backend == "cpu":
+        return False
+    _enable_persistent_cache()
+    return _compile_cache_enabled
+
+
+if _configured_platform() not in ("", "cpu"):
+    # explicit non-cpu primary: safe to enable before backend init
+    _enable_persistent_cache()
 
 __version__ = "0.1.0"
 

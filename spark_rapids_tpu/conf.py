@@ -511,6 +511,20 @@ def registry() -> Dict[str, ConfEntry]:
 def generate_docs() -> str:
     """Markdown table of all configs (reference: docs/configs.md generation
     from RapidsConf.help)."""
+    import importlib
+    import pkgutil
+
+    # per-op kill switches, format keys, profiler/filecache/optimizer
+    # confs all register at their module's import time; walk the whole
+    # package so the doc is complete no matter what the process
+    # imported first
+    import spark_rapids_tpu
+    for _m in pkgutil.walk_packages(spark_rapids_tpu.__path__,
+                                    "spark_rapids_tpu."):
+        try:
+            importlib.import_module(_m.name)
+        except Exception:
+            pass  # optional backends (pyarrow etc.) may be absent
     lines = [
         "# spark_rapids_tpu configuration",
         "",
@@ -522,4 +536,19 @@ def generate_docs() -> str:
         if e.internal:
             continue
         lines.append(f"| `{e.key}` | `{e.default}` | {e.doc} |")
+    lines += [
+        "",
+        "## SQL entry point",
+        "",
+        "`TpuSession.sql(text)` lowers SQL text onto the same plan layer "
+        "the DataFrame DSL builds, so every key above — overrides kill "
+        "switches, AQE, fallback — applies to SQL queries unchanged. "
+        "Temp views registered with `create_or_replace_temp_view` (or "
+        "`CREATE TEMP VIEW`) and file-format tables registered via "
+        "`CREATE TEMP VIEW v USING fmt OPTIONS (path '...')` resolve "
+        "through `session.catalog`; views capture the PLAN, live for the "
+        "session, and drop via `DROP VIEW [IF EXISTS]`. The supported "
+        "grammar table lives in README.md; `bench.py --sql` and "
+        "`scale_test.py --sql` run the TPC-H corpus from SQL text.",
+    ]
     return "\n".join(lines) + "\n"

@@ -22,7 +22,7 @@ from __future__ import annotations
 import json
 import os
 import time
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -364,9 +364,41 @@ class DeltaTable:
         latest = self.log.latest_version()
         end = latest if ending_version is None else min(ending_version,
                                                        latest)
+        # parse the range's commit jsons ONCE; the CDF pre-check and the
+        # change-derivation loop below share them (snapshot(v) per
+        # version replays the whole log each time — O(V^2) in history)
+        version_actions: List[Tuple[int, list]] = []
+        for v in range(starting_version, end + 1):
+            path = os.path.join(self.log.log_path, f"{v:020d}.json")
+            if not os.path.exists(path):
+                continue
+            with open(path) as f:
+                version_actions.append(
+                    (v, [json.loads(line) for line in f if line.strip()]))
+        # Delta CDF contract: versions where delta.enableChangeDataFeed
+        # was not set have no recorded change data. Deriving them from
+        # add/remove actions invents changes — a deletion-vector partial
+        # DELETE would surface every physical row of the file as
+        # 'delete', survivors included — so the whole range must be
+        # covered by the feed (DeltaErrors.changeDataNotRecorded). One
+        # snapshot seeds the flag; metaData actions inside the range
+        # update it forward.
+        cdf_on = self.log.snapshot(
+            min(starting_version, end)).metadata.cdf_enabled()
+        for v, actions in version_actions:
+            for a in actions:
+                if "metaData" in a:
+                    cfg = a["metaData"].get("configuration") or {}
+                    cdf_on = cfg.get("delta.enableChangeDataFeed",
+                                     "false").lower() == "true"
+            if not cdf_on:
+                raise ColumnarProcessingError(
+                    f"change data was not recorded for version {v} "
+                    f"(requested range [{starting_version}, {end}]): "
+                    "delta.enableChangeDataFeed was not set; changes "
+                    "are only readable from the version that enabled it")
         snap = self.log.snapshot(end)
         pmap = self._phys(snap)
-        pn = (lambda n: pmap.get(n, n)) if pmap else (lambda n: n)
         parts = set(snap.metadata.partition_columns)
         schema = snap.schema
         data_schema = [(n, dt) for n, dt in schema if n not in parts]
@@ -398,12 +430,7 @@ class DeltaTable:
             order = [n for n, _ in schema]
             return HostTable(order, [by[n] for n in order])
 
-        for v in range(starting_version, end + 1):
-            path = os.path.join(self.log.log_path, f"{v:020d}.json")
-            if not os.path.exists(path):
-                continue
-            with open(path) as f:
-                actions = [json.loads(line) for line in f if line.strip()]
+        for v, actions in version_actions:
             cdcs = [a["cdc"] for a in actions if "cdc" in a]
             if cdcs:
                 from spark_rapids_tpu.delta.table import \

@@ -327,18 +327,44 @@ def _evolved_metadata(old_meta: Metadata, evolved_schema,
     """Metadata action for a schema evolution that PRESERVES table
     configuration and per-field metadata (column-mapping physical names,
     ids). A bare schema_to_json would wipe delta.columnMapping state and
-    delta.enableChangeDataFeed (code-review r5)."""
+    delta.enableChangeDataFeed (code-review r5).
+
+    On a mapped table (columnMapping.mode != none) every NEW field must
+    get its own physicalName/id and maxColumnId must advance, or the
+    committed metadata violates the column-mapping protocol for external
+    readers (ADVICE r5)."""
     from spark_rapids_tpu.delta.log import schema_fields_from_json
     old_fields = {f["name"]: f
                   for f in schema_fields_from_json(old_meta.schema_json)}
     new_json = json.loads(schema_to_json(evolved_schema))
+    cfg = dict(old_meta.configuration)
+    mapped = old_meta.column_mapping_mode() != "none"
+    max_id = int(cfg.get("delta.columnMapping.maxColumnId", "0") or 0)
+    for f in old_fields.values():
+        fid = (f.get("metadata") or {}).get("delta.columnMapping.id", 0)
+        max_id = max(max_id, int(fid or 0))
     merged = []
     for f in new_json["fields"]:
-        merged.append(old_fields.get(f["name"], f))
+        have = old_fields.get(f["name"])
+        if have is not None:
+            merged.append(have)
+            continue
+        if mapped:
+            md = dict(f.get("metadata") or {})
+            max_id += 1
+            # new physical names are UUID-based so a later rename/re-add
+            # of the same logical name can never collide with this file
+            # column (Delta's DeltaColumnMapping convention)
+            md.setdefault("delta.columnMapping.physicalName",
+                          f"col-{uuid.uuid4()}")
+            md.setdefault("delta.columnMapping.id", max_id)
+            f = dict(f, metadata=md)
+        merged.append(f)
+    if mapped:
+        cfg["delta.columnMapping.maxColumnId"] = str(max_id)
     return Metadata(json.dumps({"type": "struct", "fields": merged}),
                     list(partition_by), table_id=old_meta.table_id,
-                    name=old_meta.name,
-                    configuration=dict(old_meta.configuration))
+                    name=old_meta.name, configuration=cfg)
 
 
 def _write_data_file(table_path: str, table: HostTable,
@@ -515,6 +541,7 @@ def write_delta(df_plan: PlanNode, session, table_path: str,
             f"delta table already exists at {table_path} (mode=error)")
     if exists and mode == "ignore":
         return log.latest_version()
+    new_meta: Optional[Metadata] = None
 
     os.makedirs(table_path, exist_ok=True)
     table = session.execute(df_plan) if session is not None \
@@ -533,8 +560,9 @@ def write_delta(df_plan: PlanNode, session, table_path: str,
                                       table_path, "overwriting",
                                       merge_schema)
         if [n for n, _ in evolved] != [n for n, _ in snap.schema]:
-            txn.stage(_evolved_metadata(snap.metadata, evolved,
-                                        partition_by))
+            new_meta = _evolved_metadata(snap.metadata, evolved,
+                                         partition_by)
+            txn.stage(new_meta)
         # conflict detection: the removes below are vs THIS snapshot; a
         # concurrent commit must surface, not silently survive the
         # overwrite (commit() refuses blind retry when removes are staged)
@@ -553,12 +581,15 @@ def write_delta(df_plan: PlanNode, session, table_path: str,
             # log-recorded schema change: subsequent snapshots read the
             # widened schema; old files null-fill the new columns
             txn.read_version = snap.version
-            txn.stage(_evolved_metadata(snap.metadata, evolved,
-                                        partition_by))
+            new_meta = _evolved_metadata(snap.metadata, evolved,
+                                         partition_by)
+            txn.stage(new_meta)
 
     phys = None
     if exists:
-        m = log.snapshot().metadata
+        # an evolving write must use the EVOLVED mapping so data files
+        # carry the new fields' physical names, not their logical ones
+        m = new_meta if new_meta is not None else log.snapshot().metadata
         if m is not None and m.column_mapping_mode() != "none":
             phys = m.physical_names()
     for vals, subdir, sub in _split_partitions(table, partition_by):

@@ -362,6 +362,7 @@ class _PairPrepCtx(PrepCtx):
     def __init__(self, lt: DeviceTable, rt: DeviceTable):
         self.table = _PairTableView(lt, rt)
         self.aux_arrays = []
+        self.aux_intern = []
 
 
 class _PairTableView:

@@ -734,3 +734,57 @@ def approx_percentile(e, percentage, accuracy: int = 10000):
 
 
 approxPercentile = approx_percentile
+
+
+# -- SQL front end hooks ------------------------------------------------------
+
+def expr(sql_text: str) -> Expression:
+    """Parse one SQL expression into an engine Expression (PySpark
+    F.expr analog): F.expr("l_extendedprice * (1.0 - l_discount)").
+    Column references resolve at plan-bind time like col()."""
+    from spark_rapids_tpu.sql.analyzer import Analyzer, Scope
+    from spark_rapids_tpu.sql.parser import parse_expression
+
+    node = parse_expression(sql_text)
+    analyzer = Analyzer(None, sql_text)
+
+    class _OpenScope(Scope):
+        """Unbound scope: any identifier resolves to an
+        AttributeReference; binding happens when the expression lands
+        in a plan node (exactly like col())."""
+
+        def __init__(self):
+            pass
+
+        @property
+        def columns(self):
+            return _AnyContains()
+
+        aliases: dict = {}
+        visible: list = []
+
+    class _AnyContains(list):
+        def __contains__(self, item):
+            return True
+
+    return analyzer.lower_expr(node, _OpenScope())
+
+
+#: process-wide SQL-callable function registrations (session-scoped ones
+#: live in SessionCatalog.register_function)
+_SQL_FUNCTIONS = {}
+
+
+def register_sql_function(name: str, builder) -> None:
+    """Make ``builder(*arg_exprs) -> Expression`` callable from SQL text
+    under ``name`` in every session — e.g. a compiled Python UDF:
+    ``register_sql_function("plus_one", F.udf(lambda x: x + 1))``."""
+    _SQL_FUNCTIONS[name.lower()] = builder
+
+
+def unregister_sql_function(name: str) -> None:
+    _SQL_FUNCTIONS.pop(name.lower(), None)
+
+
+def registered_sql_function(name: str):
+    return _SQL_FUNCTIONS.get(name.lower())

@@ -77,6 +77,38 @@ def q1_dataframe(session, table: HostTable, num_batches: int = 1):
     )
 
 
+#: q1 as SQL text (bench.py --sql): lowers onto the same plan shape as
+#: q1_dataframe (Sort over Aggregate over Project over Filter)
+Q1_SQL = """
+SELECT l_returnflag, l_linestatus,
+       SUM(l_quantity) AS sum_qty,
+       SUM(l_extendedprice) AS sum_base_price,
+       SUM(disc_price) AS sum_disc_price,
+       SUM(charge) AS sum_charge,
+       AVG(l_quantity) AS avg_qty,
+       AVG(l_extendedprice) AS avg_price,
+       AVG(l_discount) AS avg_disc,
+       COUNT(*) AS count_order
+FROM (SELECT l_returnflag, l_linestatus, l_quantity, l_extendedprice,
+             l_discount,
+             l_extendedprice * (1.0 - l_discount) AS disc_price,
+             l_extendedprice * (1.0 - l_discount) * (1.0 + l_tax) AS charge
+      FROM lineitem
+      WHERE l_shipdate <= DATE '1998-09-02')
+GROUP BY l_returnflag, l_linestatus
+ORDER BY l_returnflag, l_linestatus
+"""
+
+
+def q1_sql(session, table: HostTable, num_batches: int = 1):
+    """q1 from SQL text via session.sql() (the front door the reference's
+    qa_nightly corpus uses); plans identically to q1_dataframe."""
+    from spark_rapids_tpu.plan import from_host_table
+    from_host_table(table, session, num_batches)\
+        .create_or_replace_temp_view("lineitem")
+    return session.sql(Q1_SQL)
+
+
 NUM_Q1_GROUPS = 8  # 3 flags x 2 statuses padded to a static bound
 
 
@@ -208,6 +240,33 @@ def q3_dataframe(session, cust, orders, lineitem, segment: str = "BUILDING"):
                  F.count().alias("n"))
             .sort(P_REV_DESC())
             .limit(10))
+
+
+#: q3 as SQL text (bench.py --sql); nested selects mirror the
+#: filter/with_column/join chain of q3_dataframe
+Q3_SQL = """
+SELECT l_orderkey, SUM(volume) AS revenue, COUNT(*) AS n FROM (
+    SELECT l_orderkey, o_orderdate,
+           l_extendedprice * (1.0 - l_discount) AS volume
+    FROM (SELECT * FROM lineitem WHERE l_shipdate > DATE '1995-03-15')
+    JOIN (SELECT *, o_orderkey AS l_orderkey
+          FROM orders WHERE o_orderdate < DATE '1995-03-15')
+      USING (l_orderkey)
+    JOIN (SELECT *, c_custkey AS o_custkey
+          FROM customer WHERE c_mktsegment = '{segment}')
+      USING (o_custkey))
+GROUP BY l_orderkey
+ORDER BY revenue DESC LIMIT 10
+"""
+
+
+def q3_sql(session, cust, orders, lineitem, segment: str = "BUILDING"):
+    from spark_rapids_tpu.plan import from_host_table
+    from_host_table(cust, session).create_or_replace_temp_view("customer")
+    from_host_table(orders, session).create_or_replace_temp_view("orders")
+    from_host_table(lineitem, session)\
+        .create_or_replace_temp_view("lineitem")
+    return session.sql(Q3_SQL.format(segment=segment))
 
 
 def P_REV_DESC():

@@ -50,8 +50,21 @@ def _matrix_row(name: str, sig, notes: str = "") -> str:
 def generate_supported_ops() -> str:
     """supported_ops.md content: one row per exec and per expression with
     an S/NS cell per type column."""
+    import importlib
+
     from spark_rapids_tpu.overrides import rules as R
-    from spark_rapids_tpu.overrides.typesig import COMMON
+    from spark_rapids_tpu.overrides.typesig import COMMON_128
+
+    # file-format / Delta scan rules register at THEIR package's import
+    # time (register_file_scan) so the core engine never hard-requires
+    # pyarrow; pull them in here so the matrix is complete and identical
+    # no matter what the process imported first
+    for _mod in ("spark_rapids_tpu.io", "spark_rapids_tpu.delta",
+                 "spark_rapids_tpu.iceberg"):
+        try:
+            importlib.import_module(_mod)
+        except ImportError:
+            pass
     R._build_expr_sigs()
 
     header = ("| Operator | " +
@@ -77,7 +90,11 @@ def generate_supported_ops() -> str:
     ]
     for node_cls, rule in sorted(R._EXEC_RULES.items(),
                                  key=lambda kv: kv[0].__name__):
-        sig = _EXEC_SIGS.get(node_cls, COMMON)
+        # unregistered execs doc as COMMON_128: the _check_output_schema
+        # default their tag functions apply (storage-level DECIMAL128
+        # flows through; per-construct carve-outs — e.g. avg over a
+        # dec128 input — still tag fallback at the expression level)
+        sig = _EXEC_SIGS.get(node_cls, COMMON_128)
         lines.append(_matrix_row(node_cls.__name__, sig))
     lines += [
         "",

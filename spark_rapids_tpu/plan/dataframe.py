@@ -243,6 +243,17 @@ class DataFrame:
         mode = "hash" if keys else "roundrobin"
         return self._wrap(P.Exchange(self.plan, mode, num_partitions, keys))
 
+    def create_or_replace_temp_view(self, name: str) -> None:
+        """Register this DataFrame's plan as a temp view resolvable from
+        session.sql() / session.table() (requires a session)."""
+        if self.session is None:
+            raise ValueError(
+                "create_or_replace_temp_view requires a session-attached "
+                "DataFrame")
+        self.session.catalog.create_or_replace_temp_view(name, self)
+
+    createOrReplaceTempView = create_or_replace_temp_view
+
     # -- actions ------------------------------------------------------------
     @property
     def schema(self):
@@ -278,8 +289,16 @@ class DataFrame:
 
     def explain(self) -> str:
         if self.session is not None:
-            return self.session.explain(self.plan)
-        return self.plan.tree_string()
+            out = self.session.explain(self.plan)
+        else:
+            out = self.plan.tree_string()
+        # SQL-origin plans (session.sql) carry their text so the explain
+        # output ties fallback reasons back to the query
+        sql_text = getattr(self, "sql_text", None)
+        if sql_text:
+            one_line = " ".join(sql_text.split())
+            return f"-- SQL: {one_line}\n{out}"
+        return out
 
     # -- writers (reference: GpuDataWritingCommandExec + format writers) ----
     def _write(self, fmt: str, path: str, partition_by, options):

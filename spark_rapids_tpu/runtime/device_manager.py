@@ -73,6 +73,11 @@ class TpuDeviceManager:
     def initialize(self):
         if self.initialized:
             return
+        # the backend is being initialized anyway; auto-detected TPU
+        # hosts (unset JAX_PLATFORMS) pick up the persistent compile
+        # cache here rather than silently running uncached (ADVICE r5)
+        import spark_rapids_tpu
+        spark_rapids_tpu.ensure_compile_cache()
         self.devices = list(jax.devices())
         local = list(jax.local_devices())
         ordinal = self._select_device(local)

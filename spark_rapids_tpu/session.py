@@ -33,6 +33,31 @@ class TpuSession:
         self.conf = RapidsConf(conf)
         self._runtime = None
         self._profiler = None
+        self._catalog = None
+
+    # -- SQL front end -------------------------------------------------------
+    @property
+    def catalog(self):
+        """Session catalog: temp views, registered file-format tables
+        (sources SPI) and SQL-callable functions."""
+        if self._catalog is None:
+            from spark_rapids_tpu.sql.catalog import SessionCatalog
+            self._catalog = SessionCatalog(self)
+        return self._catalog
+
+    def sql(self, text: str) -> DataFrame:
+        """Run one SQL statement (SELECT / CREATE TEMP VIEW / DROP VIEW)
+        through parser -> analyzer -> the existing plan layer; the
+        resulting DataFrame flows through overrides/AQE exactly like a
+        DSL-built one."""
+        from spark_rapids_tpu.sql import lower_statement
+        df = lower_statement(self, text)
+        df.sql_text = text
+        return df
+
+    def table(self, name: str) -> DataFrame:
+        """DataFrame over a temp view or registered table."""
+        return self.catalog.table(name)
 
     @property
     def profiler(self):

@@ -1,0 +1,24 @@
+"""SQL front end: text -> lexer -> parser -> analyzer -> the existing
+DataFrame/plan layer (reference: the Spark SQL planner surface
+SQLExecPlugin hooks; here the front end is in-repo because there is no
+Spark to delegate parsing to).
+
+Entry points:
+  * ``TpuSession.sql(text)``           — run a statement
+  * ``spark_rapids_tpu.functions.expr``— parse one expression
+  * ``SessionCatalog``                 — temp views / tables / functions
+
+The analyzer lowers onto plan nodes only; every SQL query then flows
+through overrides tagging, fallback, and AQE unchanged."""
+
+from spark_rapids_tpu.sql.analyzer import lower_statement  # noqa: F401
+from spark_rapids_tpu.sql.catalog import SessionCatalog  # noqa: F401
+from spark_rapids_tpu.sql.errors import (  # noqa: F401
+    SqlAnalysisError,
+    SqlError,
+    SqlParseError,
+)
+from spark_rapids_tpu.sql.parser import (  # noqa: F401
+    parse_expression,
+    parse_statement,
+)

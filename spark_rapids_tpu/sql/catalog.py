@@ -1,0 +1,90 @@
+"""Session catalog: temp views, registered file-format tables, and
+session-scoped SQL functions.
+
+Reference: Spark's SessionCatalog slice the plugin sees — temp views
+resolve before external tables, and ``CREATE TEMP VIEW ... USING fmt``
+routes through the data-source API the way ``spark.read.format`` does.
+Here file-format tables resolve lazily through the existing provider SPI
+(``sources.create_scan``), so every registered connector is reachable
+from SQL with no new wiring."""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+from spark_rapids_tpu.errors import ColumnarProcessingError
+
+
+class SessionCatalog:
+    def __init__(self, session):
+        self._session = session
+        #: name -> PlanNode (shared subtree; plan nodes are not mutated)
+        self._views: Dict[str, object] = {}
+        #: name -> (fmt, paths, options) resolved lazily via sources SPI
+        self._tables: Dict[str, tuple] = {}
+        #: name -> expression builder (registered Python UDFs)
+        self._functions: Dict[str, Callable] = {}
+
+    # -- temp views ----------------------------------------------------------
+    def create_or_replace_temp_view(self, name: str, df) -> None:
+        plan = getattr(df, "plan", df)
+        # views and registered tables share ONE name space (lookup checks
+        # views first): replacing must evict a same-name table entry or
+        # the old relation would survive a later DROP of the new one
+        self._tables.pop(name.lower(), None)
+        self._views[name.lower()] = plan
+
+    def drop_temp_view(self, name: str) -> bool:
+        return self._views.pop(name.lower(), None) is not None
+
+    # -- file-format tables (sources SPI) -----------------------------------
+    def register_table(self, name: str, fmt: str, *paths,
+                       **options) -> None:
+        """Register ``name`` as a lazy scan of ``paths`` through the
+        external-source provider registry (ExternalSource analog)."""
+        self._views.pop(name.lower(), None)
+        self._tables[name.lower()] = (fmt, list(paths), dict(options))
+
+    def drop_table(self, name: str) -> bool:
+        return self._tables.pop(name.lower(), None) is not None
+
+    def list_tables(self) -> List[str]:
+        return sorted(set(self._views) | set(self._tables))
+
+    # -- functions -----------------------------------------------------------
+    def register_function(self, name: str, builder: Callable) -> None:
+        """Make ``builder(*arg_exprs) -> Expression`` callable from SQL
+        as ``name(...)`` — e.g. a compiled Python UDF from
+        ``spark_rapids_tpu.udf.udf`` or an F-style composition."""
+        self._functions[name.lower()] = builder
+
+    def unregister_function(self, name: str) -> bool:
+        return self._functions.pop(name.lower(), None) is not None
+
+    def lookup_function(self, name: str) -> Optional[Callable]:
+        return self._functions.get(name.lower())
+
+    # -- resolution ----------------------------------------------------------
+    def lookup_relation(self, name: str):
+        """DataFrame for a temp view or registered table, else None."""
+        from spark_rapids_tpu.plan import DataFrame
+        key = name.lower()
+        plan = self._views.get(key)
+        if plan is not None:
+            return DataFrame(plan, self._session)
+        entry = self._tables.get(key)
+        if entry is not None:
+            from spark_rapids_tpu.sources import create_scan
+            fmt, paths, options = entry
+            return DataFrame(
+                create_scan(fmt, paths, self._session.conf, **options),
+                self._session)
+        return None
+
+    def table(self, name: str):
+        df = self.lookup_relation(name)
+        if df is None:
+            raise ColumnarProcessingError(
+                f"table or view {name!r} not found "
+                f"(known: {self.list_tables()})")
+        return df

@@ -1,0 +1,273 @@
+"""SQL function-name resolution.
+
+Maps SQL call syntax onto the SAME expression builders the DataFrame API
+exposes in ``spark_rapids_tpu.functions`` (so a SQL query and its DSL
+form build identical expression trees and share compiled kernels).
+Lookup order in the analyzer: session catalog functions (registered
+Python UDFs) -> global registrations (``functions.register_sql_function``)
+-> this builtin table -> Hive UDF registry (``hive_udf.py``)."""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence
+
+from spark_rapids_tpu.ops.expr import Expression, Literal, lit
+from spark_rapids_tpu.sql.errors import SqlAnalysisError
+
+Builder = Callable[[List[Expression]], Expression]
+
+
+def _need(args: Sequence, lo: int, hi: Optional[int], name: str) -> None:
+    hi_txt = "+" if hi is None else (f"-{hi}" if hi != lo else "")
+    if len(args) < lo or (hi is not None and len(args) > hi):
+        raise SqlAnalysisError(
+            f"function {name} expects {lo}{hi_txt} argument(s), "
+            f"got {len(args)}")
+
+
+def _lit_value(e: Expression, name: str, what: str):
+    """Unwrap a literal argument (offsets, counts, seeds — parameters the
+    underlying builders take as plain Python values)."""
+    if not isinstance(e, Literal):
+        raise SqlAnalysisError(
+            f"function {name}: {what} must be a literal")
+    return e.value
+
+
+def _build_table() -> Dict[str, Builder]:
+    from spark_rapids_tpu import functions as F
+    from spark_rapids_tpu.ops import aggregates as _agg
+    from spark_rapids_tpu.ops import collections as _coll
+    from spark_rapids_tpu.ops import conditional as _cond
+    from spark_rapids_tpu.ops import datetime as _dt
+    from spark_rapids_tpu.ops import math as _math
+    from spark_rapids_tpu.ops import misc as _misc
+    from spark_rapids_tpu.ops import nested as _nested
+    from spark_rapids_tpu.ops import predicates as _pred
+    from spark_rapids_tpu.ops import strings as _str
+    from spark_rapids_tpu.ops import window as _win
+    from spark_rapids_tpu.ops.arithmetic import Abs
+    from spark_rapids_tpu.ops.hashfns import Murmur3Hash, XxHash64
+    from spark_rapids_tpu.ops.json_structs import StructsToJson
+
+    T: Dict[str, Builder] = {}
+
+    def reg(names, fn, lo, hi=-1):
+        """hi: -1 = exactly lo, None = unbounded."""
+        high = lo if hi == -1 else hi
+        if isinstance(names, str):
+            names = (names,)
+
+        def build(args, _name=names[0], _fn=fn, _lo=lo, _hi=high):
+            _need(args, _lo, _hi, _name)
+            return _fn(*args)
+        for n in names:
+            T[n] = build
+
+    # aggregates (DEVICE_SUPPORTED_AGGS + CPU-path ones; overrides tag
+    # fallback per instance exactly as for the DSL)
+    reg("sum", _agg.Sum, 1)
+    reg("min", _agg.Min, 1)
+    reg("max", _agg.Max, 1)
+    reg(("avg", "mean"), _agg.Average, 1)
+    reg("count", lambda e: _agg.Count(e), 1)
+    reg("first", lambda e: _agg.First(e, False), 1)
+    reg("last", lambda e: _agg.Last(e, False), 1)
+    reg("collect_list", _agg.CollectList, 1)
+    reg("collect_set", _agg.CollectSet, 1)
+    reg(("stddev", "stddev_samp", "std"), _agg.StddevSamp, 1)
+    reg("stddev_pop", _agg.StddevPop, 1)
+    reg(("variance", "var_samp"), _agg.VarianceSamp, 1)
+    reg("var_pop", _agg.VariancePop, 1)
+    T["percentile"] = lambda args: (
+        _need(args, 2, 2, "percentile") or
+        _agg.Percentile(args[0],
+                        _lit_value(args[1], "percentile", "percentage")))
+    T["approx_percentile"] = lambda args: (
+        _need(args, 2, 3, "approx_percentile") or
+        _agg.Percentile(args[0], _lit_value(args[1], "approx_percentile",
+                                            "percentage")))
+
+    # conditionals / null handling
+    reg("coalesce", _cond.Coalesce, 1, None)
+    reg(("nvl", "ifnull"), _cond.Coalesce, 2)
+    reg("greatest", _cond.Greatest, 2, None)
+    reg("least", _cond.Least, 2, None)
+    reg("nanvl", _cond.NaNvl, 2)
+    reg("if", _cond.If, 3)
+    reg("isnull", _pred.IsNull, 1)
+    reg("isnotnull", _pred.IsNotNull, 1)
+    reg("isnan", _pred.IsNaN, 1)
+
+    # math
+    reg("sqrt", _math.Sqrt, 1)
+    reg("exp", _math.Exp, 1)
+    reg(("log", "ln"), _math.Log, 1)
+    reg("log10", _math.Log10, 1)
+    reg("log2", _math.Log2, 1)
+    reg(("pow", "power"), _math.Pow, 2)
+    reg("abs", Abs, 1)
+    reg(("ceil", "ceiling"), _math.Ceil, 1)
+    reg("floor", _math.Floor, 1)
+    reg("round", lambda e, s=None: _math.Round(e, s or lit(0)), 1, 2)
+    reg("bround", lambda e, s=None: _math.BRound(e, s or lit(0)), 1, 2)
+    reg(("signum", "sign"), _math.Signum, 1)
+    reg("shiftleft", _math.ShiftLeft, 2)
+    reg("shiftright", _math.ShiftRight, 2)
+
+    # strings
+    reg(("upper", "ucase"), _str.Upper, 1)
+    reg(("lower", "lcase"), _str.Lower, 1)
+    reg(("length", "char_length", "character_length"), _str.Length, 1)
+    reg("bit_length", _str.BitLength, 1)
+    reg("octet_length", _str.OctetLength, 1)
+    reg("ascii", _str.Ascii, 1)
+    reg("reverse", _str.Reverse, 1)
+    reg("initcap", _str.InitCap, 1)
+    reg("trim", _str.StringTrim, 1)
+    reg("ltrim", _str.StringTrimLeft, 1)
+    reg("rtrim", _str.StringTrimRight, 1)
+    reg(("substring", "substr"), _str.Substring, 3)
+    reg("repeat", _str.StringRepeat, 2)
+    reg("replace", lambda e, s, r=None:
+        _str.StringReplace(e, s, r or lit("")), 2, 3)
+    reg("lpad", lambda e, n, p=None:
+        _str.StringLPad(e, n, p or lit(" ")), 2, 3)
+    reg("rpad", lambda e, n, p=None:
+        _str.StringRPad(e, n, p or lit(" ")), 2, 3)
+    reg("substring_index", _str.SubstringIndex, 3)
+    reg("translate", _str.StringTranslate, 3)
+    reg("concat", _str.Concat, 1, None)
+    reg("contains", _str.Contains, 2)
+    reg("startswith", _str.StartsWith, 2)
+    reg("endswith", _str.EndsWith, 2)
+    reg("instr", _str.StringInstr, 2)
+    reg("locate", lambda s, e, p=None:
+        _str.StringLocate(s, e, p or lit(1)), 2, 3)
+    reg("regexp_replace", _str.RegExpReplace, 3)
+    reg("regexp_extract", lambda e, p, i=None:
+        _str.RegExpExtract(e, p, i or lit(1)), 2, 3)
+    T["concat_ws"] = lambda args: (
+        _need(args, 1, None, "concat_ws") or
+        _misc.ConcatWs(*args))
+
+    # datetime
+    reg("year", _dt.Year, 1)
+    reg("month", _dt.Month, 1)
+    reg(("day", "dayofmonth"), _dt.DayOfMonth, 1)
+    reg("dayofweek", _dt.DayOfWeek, 1)
+    reg("weekday", _dt.WeekDay, 1)
+    reg("dayofyear", _dt.DayOfYear, 1)
+    reg("quarter", _dt.Quarter, 1)
+    reg("last_day", _dt.LastDay, 1)
+    reg("date_add", _dt.DateAdd, 2)
+    reg("date_sub", _dt.DateSub, 2)
+    reg("datediff", _dt.DateDiff, 2)
+    reg("add_months", _dt.AddMonths, 2)
+    reg("hour", _dt.Hour, 1)
+    reg("minute", _dt.Minute, 1)
+    reg("second", _dt.Second, 1)
+    reg(("to_unix_timestamp", "unix_timestamp"),
+        _dt.UnixTimestampFromTs, 1)
+    reg("timestamp_seconds", _dt.SecondsToTimestamp, 1)
+    reg("timestamp_millis", _dt.MillisToTimestamp, 1)
+    reg("timestamp_micros", _dt.MicrosToTimestamp, 1)
+    reg("to_date", _dt.TsToDate, 1)
+    reg("from_utc_timestamp", _misc.FromUTCTimestamp, 2)
+    reg("to_utc_timestamp", _misc.ToUTCTimestamp, 2)
+
+    # hash / misc
+    reg("hash", Murmur3Hash, 1, None)
+    reg("xxhash64", XxHash64, 1, None)
+    reg("md5", _misc.Md5, 1)
+    reg("monotonically_increasing_id",
+        _misc.MonotonicallyIncreasingID, 0)
+    reg("spark_partition_id", _misc.SparkPartitionID, 0)
+    T["rand"] = lambda args: (
+        _need(args, 0, 1, "rand") or
+        _misc.Rand(_lit_value(args[0], "rand", "seed") if args else 0))
+
+    # collections / nested
+    reg(("size", "cardinality"), _coll.Size, 1)
+    reg("array", _coll.CreateArray, 1, None)
+    reg("array_contains", _coll.ArrayContains, 2)
+    reg("array_min", _coll.ArrayMin, 1)
+    reg("array_max", _coll.ArrayMax, 1)
+    reg("sort_array", lambda e, a=None:
+        _coll.SortArray(e, a or lit(True)), 1, 2)
+    reg(("get_item", "element_at"), _coll.GetArrayItem, 2)
+    reg("sequence", _coll.Sequence, 2, 3)
+    reg("explode", _coll.Explode, 1)
+    reg("explode_outer", _coll.ExplodeOuter, 1)
+    reg("posexplode", _coll.PosExplode, 1)
+    reg("posexplode_outer", _coll.PosExplodeOuter, 1)
+    T["struct"] = lambda args: F.struct(*args)
+    reg("named_struct", lambda *a: F.named_struct(
+        *[x.value if isinstance(x, Literal) and i % 2 == 0 else x
+          for i, x in enumerate(a)]), 2, None)
+    reg("map_keys", _nested.MapKeys, 1)
+    reg("map_values", _nested.MapValues, 1)
+    reg("map_entries", _nested.MapEntries, 1)
+    reg("to_json", StructsToJson, 1)
+
+    # window functions (rank family / offsets); aggregate functions used
+    # with OVER come from the aggregate entries above
+    reg("row_number", _win.RowNumber, 0)
+    reg("rank", _win.Rank, 0)
+    reg("dense_rank", _win.DenseRank, 0)
+    reg("percent_rank", _win.PercentRank, 0)
+    T["nth_value"] = lambda args: (
+        _need(args, 2, 2, "nth_value") or
+        _win.NthValue(args[0], _lit_value(args[1], "nth_value", "n")))
+
+    def _offset_fn(cls, name):
+        def build(args):
+            _need(args, 1, 3, name)
+            off = (_lit_value(args[1], name, "offset")
+                   if len(args) > 1 else 1)
+            default = (_lit_value(args[2], name, "default")
+                       if len(args) > 2 else None)
+            return cls(args[0], off, default)
+        return build
+
+    T["lag"] = _offset_fn(_win.Lag, "lag")
+    T["lead"] = _offset_fn(_win.Lead, "lead")
+    return T
+
+
+_BUILTINS: Optional[Dict[str, Builder]] = None
+
+
+def builtin(name: str) -> Optional[Builder]:
+    global _BUILTINS
+    if _BUILTINS is None:
+        _BUILTINS = _build_table()
+    return _BUILTINS.get(name.lower())
+
+
+def lookup(name: str, session=None) -> Optional[Callable]:
+    """Resolve a SQL function name. Returns a callable taking a list of
+    lowered Expression args, or None when nothing matches."""
+    key = name.lower()
+    # 1. session catalog (registered Python UDFs / per-session overrides)
+    if session is not None:
+        cat = getattr(session, "_catalog", None)
+        if cat is not None:
+            fn = cat.lookup_function(key)
+            if fn is not None:
+                return lambda args: fn(*args)
+    # 2. global registrations (functions.register_sql_function)
+    from spark_rapids_tpu import functions as F
+    fn = F.registered_sql_function(key)
+    if fn is not None:
+        return lambda args: fn(*args)
+    # 3. builtins
+    b = builtin(key)
+    if b is not None:
+        return b
+    # 4. Hive UDFs (CREATE TEMPORARY FUNCTION analog)
+    from spark_rapids_tpu.hive_udf import _HIVE_FUNCTIONS, hive_udf
+    if key in _HIVE_FUNCTIONS:
+        call = hive_udf(key)
+        return lambda args: call(*args)
+    return None

@@ -72,13 +72,40 @@ def test_cdf_delete_and_update(session, tmp_path):
 
 
 def test_cdf_derives_inserts_from_plain_writes(session, tmp_path):
-    """Version 0 (CREATE) carries adds only — table_changes derives
-    insert rows from the data files."""
+    """A plain append after enablement carries adds only (no cdc
+    actions) — table_changes derives insert rows from the data files."""
     path = str(tmp_path / "t")
     dt = _mk(session, path, n=10)
-    changes = dt.table_changes(0, 0).collect()
+    dt.set_properties({"delta.enableChangeDataFeed": "true"})
+    session.create_dataframe({
+        "id": np.arange(100, 110, dtype=np.int64),
+        "v": np.full(10, 0.5)}).write_delta(path, mode="append")
+    ver = dt.version()
+    changes = dt.table_changes(ver, ver).collect()
     assert len(changes) == 10
-    assert all(r[-2] == "insert" and r[-1] == 0 for r in changes)
+    assert all(r[-2] == "insert" and r[-1] == ver for r in changes)
+
+
+def test_cdf_range_before_enablement_raises(session, tmp_path):
+    """ADVICE r5 (medium): versions predating
+    delta.enableChangeDataFeed carry no recorded change data — deriving
+    them from add/remove actions turned a deletion-vector partial
+    DELETE into a full-file delete (survivors included). The reader now
+    errors for any range touching a pre-enablement version."""
+    from spark_rapids_tpu.errors import ColumnarProcessingError
+    path = str(tmp_path / "t")
+    dt = _mk(session, path)                       # v0: CREATE
+    dt.delete(col("id") < lit(5))                 # v1: DV partial DELETE
+    dt.set_properties({"delta.enableChangeDataFeed": "true"})  # v2
+    for start, end in [(0, None), (1, 1), (0, 2), (1, None)]:
+        with pytest.raises(ColumnarProcessingError,
+                           match="enableChangeDataFeed"):
+            dt.table_changes(start, end)
+    # from the enabling version onward the feed reads fine
+    dt.delete(col("id") < lit(10))                # v3: cdc commit
+    changes = dt.table_changes(2).collect()
+    assert sorted(r[0] for r in changes) == [5, 6, 7, 8, 9]
+    assert all(r[-2] == "delete" for r in changes)
 
 
 def test_cdf_merge_emits_all_change_types(session, tmp_path):
@@ -212,6 +239,37 @@ def test_merge_schema_append_preserves_mapping_and_cdf(session, tmp_path):
     assert all(r[2] is None for r in old)       # evolution null-fills extra
 
 
+def test_merge_schema_assigns_mapping_to_new_fields(session, tmp_path):
+    """ADVICE r5 (low): on a mapped table, a mergeSchema append must
+    give NEW fields their own columnMapping.physicalName/id and bump
+    maxColumnId — and write the data file under the physical name so
+    the new column reads back (not null-filled)."""
+    from spark_rapids_tpu.delta.log import schema_fields_from_json
+    path = str(tmp_path / "t")
+    dt = _mk(session, path, n=10)
+    dt.rename_column("v", "value")       # upgrades to mapping mode=name
+    session.create_dataframe({
+        "id": np.arange(100, 105, dtype=np.int64),
+        "value": np.full(5, 1.0),
+        "extra": np.arange(5, dtype=np.int64)}).write_delta(
+            path, mode="append", merge_schema=True)
+    m = dt.log.snapshot().metadata
+    fields = {f["name"]: f
+              for f in schema_fields_from_json(m.schema_json)}
+    md = fields["extra"].get("metadata") or {}
+    pn = md.get("delta.columnMapping.physicalName")
+    fid = md.get("delta.columnMapping.id")
+    assert pn and pn != "extra" and pn.startswith("col-")
+    old_ids = [(fields[n].get("metadata") or {})
+               .get("delta.columnMapping.id", 0) for n in ("id", "value")]
+    assert fid and fid > max(old_ids)
+    assert int(m.configuration["delta.columnMapping.maxColumnId"]) >= fid
+    # the new column's values read back from the physical name
+    got = sorted(session.read_delta(path).collect())
+    new = [r for r in got if r[0] >= 100]
+    assert [r[2] for r in new] == [0, 1, 2, 3, 4]
+
+
 def test_rename_partition_column_rejected(session, tmp_path):
     from spark_rapids_tpu.errors import ColumnarProcessingError
     path = str(tmp_path / "t")
@@ -236,17 +294,18 @@ def test_cdf_partitioned_mixed_commit_kinds(session, tmp_path):
             path, partition_by=["p"])
     dt = session.delta_table(path)
     dt.set_properties({"delta.enableChangeDataFeed": "true"})
+    v_enabled = dt.version()
     dt.delete(col("id") == lit(3))               # cdc commit
     session.create_dataframe({
         "p": np.array([0], dtype=np.int64),
         "id": np.array([100], dtype=np.int64),
         "v": np.array([5.5])}).write_delta(
             path, mode="append", partition_by=["p"])  # add commit
-    changes = dt.table_changes(0).collect()
+    changes = dt.table_changes(v_enabled).collect()
     by_type = {}
     for r in changes:
         by_type.setdefault(r[-2], []).append(r)
-    assert len(by_type["insert"]) == 13
+    assert len(by_type["insert"]) == 1
     assert len(by_type["delete"]) == 1
     # the deleted row's values are coherent (id=3 came from partition 1)
     d = by_type["delete"][0]

@@ -106,6 +106,42 @@ def test_matrix_reports_param_rows():
     assert rcells[7] == "S"  # DOUBLE result supported
 
 
+def test_exec_matrix_decimal128_not_ns():
+    """VERDICT r5 weak #3: exec rows said DECIMAL128=NS while
+    tests/test_decimal128.py proves device scan/filter/sort/group-by/
+    join on p38 keys. The matrix must print S for every exec whose tag
+    function passes dec128 output columns (storage-level machinery)."""
+    from spark_rapids_tpu.overrides.docs import generate_supported_ops
+    execs_md = generate_supported_ops().split("## Expressions")[0]
+    # cells: ['', 'Name', BOOLEAN@2 ... STRING@11, DECIMAL@12, DECIMAL128@13]
+    for name in ("LocalScan", "Filter", "Sort", "Aggregate", "Join",
+                 "Exchange", "TakeOrderedAndProject", "Limit", "Union",
+                 "Project"):
+        row = next(ln for ln in execs_md.splitlines()
+                   if ln.startswith(f"| {name} "))
+        cells = [c.strip() for c in row.split("|")]
+        assert cells[13] == "S", \
+            f"{name} DECIMAL128 cell must be S: {row}"
+    # Generate's tag really does reject dec128 — NS is the truth there
+    gen = next(ln for ln in execs_md.splitlines()
+               if ln.startswith("| Generate "))
+    assert [c.strip() for c in gen.split("|")][13] == "NS", gen
+
+
+def test_supported_ops_md_is_current():
+    """The checked-in SUPPORTED_OPS.md must match the generator, or doc
+    and runtime have drifted."""
+    import pathlib
+
+    from spark_rapids_tpu.overrides.docs import generate_supported_ops
+    on_disk = (pathlib.Path(__file__).resolve().parent.parent
+               / "SUPPORTED_OPS.md")
+    assert on_disk.read_text() == generate_supported_ops(), \
+        "regenerate with: python -c \"from spark_rapids_tpu.overrides." \
+        "docs import generate_supported_ops; open('SUPPORTED_OPS.md'," \
+        "'w').write(generate_supported_ops())\""
+
+
 def test_every_registered_expr_has_sig():
     R._build_expr_sigs()
     assert len(R._EXPR_SIGS) >= 190  # breadth guard (round-4 level)
