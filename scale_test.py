@@ -17,7 +17,9 @@ import time
 
 
 def build_queries(s, tables):
-    """q1-q8: scan/filter/agg/join/window mix (ScaleTest q1-q10 style)."""
+    """q1-q22: the TPC-H-flavored golden corpus (scan/filter/agg/join/
+    window mix; the lint plan verifier and test_lint run over every one
+    of these in both DSL and SQL form)."""
     from spark_rapids_tpu import functions as F
     from spark_rapids_tpu.ops.expr import col, lit
     from spark_rapids_tpu.plan import from_host_table
@@ -111,19 +113,153 @@ def build_queries(s, tables):
                          < lit(0.6) * col("avg_qty"))
                 .agg(F.sum("l_extendedprice").alias("total")))
 
+    def q11():  # TPC-H q11-like: per-nation balance totals over a floor
+        agged = (cust().group_by("c_nationkey")
+                 .agg(F.sum("c_acctbal").alias("total_bal"),
+                      F.count("c_custkey").alias("n")))
+        return (agged.filter(col("n") > lit(5))
+                .sort("total_bal", ascending=False))
+
+    def q12():  # TPC-H q12-like: date-window join + per-flag counts
+        import datetime as _dt
+        lo = _dt.date(1970, 1, 1) + _dt.timedelta(days=9000)
+        hi = _dt.date(1970, 1, 1) + _dt.timedelta(days=10000)
+        lj = (li().filter((col("l_shipdate") >= lit(lo))
+                          & (col("l_shipdate") < lit(hi)))
+              .select("l_orderkey", "l_returnflag"))
+        oj = orders().select("o_orderkey", "o_totalprice")
+        j = lj.join(oj.with_column("l_orderkey", col("o_orderkey")),
+                    on=["l_orderkey"], how="inner")
+        return (j.group_by("l_returnflag")
+                .agg(F.count("l_orderkey").alias("n"),
+                     F.avg("o_totalprice").alias("avg_price")))
+
+    def q13():  # TPC-H q13-like: customer order-count distribution
+        per_cust = (orders().group_by("o_custkey")
+                    .agg(F.count("o_orderkey").alias("c_orders")))
+        return (per_cust.group_by("c_orders")
+                .agg(F.count("o_custkey").alias("n_custs"))
+                .sort("c_orders"))
+
+    def q14():  # TPC-H q14-like: windowed revenue ratio
+        import datetime as _dt
+        lo = _dt.date(1970, 1, 1) + _dt.timedelta(days=9500)
+        hi = _dt.date(1970, 1, 1) + _dt.timedelta(days=9700)
+        f = (li().filter((col("l_shipdate") >= lit(lo))
+                         & (col("l_shipdate") < lit(hi)))
+             .select((col("l_extendedprice")
+                      * (lit(1.0) - col("l_discount"))).alias("rev")))
+        agged = f.agg(F.sum("rev").alias("total_rev"),
+                      F.count("rev").alias("n"))
+        return agged.select((col("total_rev") / col("n")).alias("avg_rev"),
+                            col("total_rev"))
+
+    def q15():  # TPC-H q15-like: top revenue customers
+        oj = orders().select("o_orderkey", "o_custkey")
+        j = (li().select("l_orderkey", "l_extendedprice", "l_discount")
+             .join(oj.with_column("l_orderkey", col("o_orderkey")),
+                   on=["l_orderkey"], how="inner"))
+        return (j.select(col("o_custkey"),
+                         (col("l_extendedprice")
+                          * (lit(1.0) - col("l_discount"))).alias("rev"))
+                .group_by("o_custkey").agg(F.sum("rev").alias("revenue"))
+                .sort("revenue", ascending=False).limit(5))
+
+    def q16():  # TPC-H q16-like: active customers per nation
+        oc = (orders().select("o_custkey").group_by("o_custkey")
+              .agg(F.count("o_custkey").alias("x")))
+        j = oc.with_column("c_custkey", col("o_custkey")).join(
+            cust().select("c_custkey", "c_nationkey"),
+            on=["c_custkey"], how="inner")
+        return (j.group_by("c_nationkey")
+                .agg(F.count("c_custkey").alias("active_custs"))
+                .sort("c_nationkey"))
+
+    def q17():  # TPC-H q17-like: below-average-quantity revenue
+        avg_q = (li().group_by("l_orderkey")
+                 .agg(F.avg("l_quantity").alias("aq")))
+        j = (li().select("l_orderkey", "l_quantity", "l_extendedprice")
+             .join(avg_q, on=["l_orderkey"], how="inner"))
+        return (j.filter(col("l_quantity").cast("double")
+                         < lit(0.5) * col("aq"))
+                .agg(F.sum("l_extendedprice").alias("s"))
+                .select((col("s") / lit(7.0)).alias("avg_yearly")))
+
+    def q18():  # TPC-H q18-like: large-volume orders
+        big = (li().group_by("l_orderkey")
+               .agg(F.sum("l_quantity").alias("sum_qty"))
+               .filter(col("sum_qty") > lit(150)))
+        j = big.with_column("o_orderkey", col("l_orderkey")).join(
+            orders().select("o_orderkey", "o_custkey", "o_totalprice"),
+            on=["o_orderkey"], how="inner")
+        return (j.select("l_orderkey", "sum_qty", "o_custkey",
+                         "o_totalprice")
+                .sort("o_totalprice", ascending=False).limit(20))
+
+    def q19():  # TPC-H q19-like: disjunctive predicate revenue
+        f = li().filter(
+            ((col("l_quantity") >= lit(1)) & (col("l_quantity") <= lit(11))
+             & (col("l_discount") > lit(0.02)))
+            | ((col("l_quantity") >= lit(10))
+               & (col("l_quantity") <= lit(20))
+               & (col("l_discount") < lit(0.06)))
+            | (col("l_returnflag") == lit("R00000001")))
+        return (f.select((col("l_extendedprice")
+                          * (lit(1.0) - col("l_discount"))).alias("rev"))
+                .agg(F.sum("rev").alias("revenue")))
+
+    def q20():  # TPC-H q20-like: customers with big orders
+        per = (orders().filter(col("o_totalprice") > lit(400000.0))
+               .select("o_custkey").group_by("o_custkey")
+               .agg(F.count("o_custkey").alias("nbig")))
+        j = per.with_column("c_custkey", col("o_custkey")).join(
+            cust().select("c_custkey", "c_name", "c_acctbal"),
+            on=["c_custkey"], how="inner")
+        return (j.select("c_custkey", "nbig", "c_name", "c_acctbal")
+                .sort("nbig", ascending=False).limit(10))
+
+    def q21():  # TPC-H q21-like: per-nation top accounts via window rank
+        from spark_rapids_tpu.functions import row_number
+        from spark_rapids_tpu.ops.window import Window as W
+        return (cust().with_windows(
+            rn=row_number().over(
+                W.partition_by("c_nationkey").order_by("c_custkey")))
+            .filter(col("rn") <= lit(2))
+            .select("c_nationkey", "c_custkey", "rn"))
+
+    def q22():  # TPC-H q22-like: accounts above the global average
+        avg_t = (cust().select(col("c_acctbal"))
+                 .agg(F.avg("c_acctbal").alias("ab"))
+                 .with_column("k", lit(1)))
+        c = (cust().select("c_custkey", "c_nationkey", "c_acctbal")
+             .with_column("k", lit(1)))
+        j = c.join(avg_t, on=["k"], how="inner")
+        return (j.filter(col("c_acctbal").cast("double") > col("ab"))
+                .group_by("c_nationkey")
+                .agg(F.count("c_custkey").alias("numcust"),
+                     F.sum("c_acctbal").alias("totacctbal"))
+                .sort("c_nationkey"))
+
     return {"q1": q1, "q2": q2, "q3": q3, "q4": q4, "q5": q5,
-            "q6": q6, "q7": q7, "q8": q8, "q9": q9, "q10": q10}
+            "q6": q6, "q7": q7, "q8": q8, "q9": q9, "q10": q10,
+            "q11": q11, "q12": q12, "q13": q13, "q14": q14, "q15": q15,
+            "q16": q16, "q17": q17, "q18": q18, "q19": q19, "q20": q20,
+            "q21": q21, "q22": q22}
 
 
 def sql_texts():
-    """q1-q10 re-expressed as SQL text. Each query is written so the
+    """q1-q22 re-expressed as SQL text. Each query is written so the
     analyzer lowers it onto the SAME plan shape as its build_queries DSL
     form (nested selects mirror select/with_column chains; USING joins
     mirror on=[key] joins) — test_sql_frontend.py asserts result AND
     device-dispatch-count equality between the two forms."""
     import datetime as _dt
-    cutoff = (_dt.date(1970, 1, 1) + _dt.timedelta(days=10500)).isoformat()
-    cut9 = (_dt.date(1970, 1, 1) + _dt.timedelta(days=9000)).isoformat()
+
+    def _iso(days):
+        return (_dt.date(1970, 1, 1) + _dt.timedelta(days=days)).isoformat()
+
+    cutoff = _iso(10500)
+    cut9 = _iso(9000)
     return {
         "q1": f"""
             SELECT l_returnflag, l_linestatus,
@@ -201,11 +337,115 @@ def sql_texts():
                   FROM lineitem GROUP BY l_orderkey)
               USING (l_orderkey)
             WHERE CAST(l_quantity AS double) < 0.6 * avg_qty""",
+        "q11": """
+            SELECT * FROM (
+                SELECT c_nationkey, SUM(c_acctbal) AS total_bal,
+                       COUNT(c_custkey) AS n
+                FROM customer GROUP BY c_nationkey)
+            WHERE n > 5
+            ORDER BY total_bal DESC""",
+        "q12": f"""
+            SELECT l_returnflag, COUNT(l_orderkey) AS n,
+                   AVG(o_totalprice) AS avg_price
+            FROM (SELECT l_orderkey, l_returnflag FROM lineitem
+                  WHERE l_shipdate >= DATE '{_iso(9000)}'
+                    AND l_shipdate < DATE '{_iso(10000)}')
+            JOIN (SELECT o_orderkey, o_totalprice,
+                         o_orderkey AS l_orderkey
+                  FROM (SELECT o_orderkey, o_totalprice FROM orders))
+              USING (l_orderkey)
+            GROUP BY l_returnflag""",
+        "q13": """
+            SELECT c_orders, COUNT(o_custkey) AS n_custs FROM (
+                SELECT o_custkey, COUNT(o_orderkey) AS c_orders
+                FROM orders GROUP BY o_custkey)
+            GROUP BY c_orders ORDER BY c_orders""",
+        "q14": f"""
+            SELECT total_rev / n AS avg_rev, total_rev FROM (
+                SELECT SUM(rev) AS total_rev, COUNT(rev) AS n FROM (
+                    SELECT l_extendedprice * (1.0 - l_discount) AS rev
+                    FROM lineitem
+                    WHERE l_shipdate >= DATE '{_iso(9500)}'
+                      AND l_shipdate < DATE '{_iso(9700)}'))""",
+        "q15": """
+            SELECT o_custkey, SUM(rev) AS revenue FROM (
+                SELECT o_custkey,
+                       l_extendedprice * (1.0 - l_discount) AS rev
+                FROM (SELECT l_orderkey, l_extendedprice, l_discount
+                      FROM lineitem)
+                JOIN (SELECT o_orderkey, o_custkey,
+                             o_orderkey AS l_orderkey
+                      FROM (SELECT o_orderkey, o_custkey FROM orders))
+                  USING (l_orderkey))
+            GROUP BY o_custkey ORDER BY revenue DESC LIMIT 5""",
+        "q16": """
+            SELECT c_nationkey, COUNT(c_custkey) AS active_custs
+            FROM (SELECT *, o_custkey AS c_custkey FROM (
+                    SELECT o_custkey, COUNT(o_custkey) AS x
+                    FROM (SELECT o_custkey FROM orders)
+                    GROUP BY o_custkey))
+            JOIN (SELECT c_custkey, c_nationkey FROM customer)
+              USING (c_custkey)
+            GROUP BY c_nationkey ORDER BY c_nationkey""",
+        "q17": """
+            SELECT s / 7.0 AS avg_yearly FROM (
+                SELECT SUM(l_extendedprice) AS s
+                FROM (SELECT l_orderkey, l_quantity, l_extendedprice
+                      FROM lineitem)
+                JOIN (SELECT l_orderkey, AVG(l_quantity) AS aq
+                      FROM lineitem GROUP BY l_orderkey)
+                  USING (l_orderkey)
+                WHERE CAST(l_quantity AS double) < 0.5 * aq)""",
+        "q18": """
+            SELECT l_orderkey, sum_qty, o_custkey, o_totalprice FROM (
+                SELECT *, l_orderkey AS o_orderkey FROM (
+                    SELECT l_orderkey, SUM(l_quantity) AS sum_qty
+                    FROM lineitem GROUP BY l_orderkey)
+                WHERE sum_qty > 150)
+            JOIN (SELECT o_orderkey, o_custkey, o_totalprice FROM orders)
+              USING (o_orderkey)
+            ORDER BY o_totalprice DESC LIMIT 20""",
+        "q19": """
+            SELECT SUM(rev) AS revenue FROM (
+                SELECT l_extendedprice * (1.0 - l_discount) AS rev
+                FROM lineitem
+                WHERE (l_quantity >= 1 AND l_quantity <= 11
+                       AND l_discount > 0.02)
+                   OR (l_quantity >= 10 AND l_quantity <= 20
+                       AND l_discount < 0.06)
+                   OR l_returnflag = 'R00000001')""",
+        "q20": """
+            SELECT c_custkey, nbig, c_name, c_acctbal FROM (
+                SELECT *, o_custkey AS c_custkey FROM (
+                    SELECT o_custkey, COUNT(o_custkey) AS nbig
+                    FROM (SELECT o_custkey FROM orders
+                          WHERE o_totalprice > 400000.0)
+                    GROUP BY o_custkey))
+            JOIN (SELECT c_custkey, c_name, c_acctbal FROM customer)
+              USING (c_custkey)
+            ORDER BY nbig DESC LIMIT 10""",
+        "q21": """
+            SELECT c_nationkey, c_custkey, rn FROM (
+                SELECT *, ROW_NUMBER() OVER (PARTITION BY c_nationkey
+                                             ORDER BY c_custkey) AS rn
+                FROM customer)
+            WHERE rn <= 2""",
+        "q22": """
+            SELECT c_nationkey, COUNT(c_custkey) AS numcust,
+                   SUM(c_acctbal) AS totacctbal
+            FROM (SELECT *, 1 AS k
+                  FROM (SELECT c_custkey, c_nationkey, c_acctbal
+                        FROM customer))
+            JOIN (SELECT *, 1 AS k
+                  FROM (SELECT AVG(c_acctbal) AS ab FROM customer))
+              USING (k)
+            WHERE CAST(c_acctbal AS double) > ab
+            GROUP BY c_nationkey ORDER BY c_nationkey""",
     }
 
 
 def build_sql_queries(s, tables):
-    """q1-q10 from SQL text via session.sql() over temp views (--sql
+    """q1-q22 from SQL text via session.sql() over temp views (--sql
     mode): same queries as build_queries, entering through the parser ->
     analyzer -> plan layer instead of the DataFrame DSL."""
     from spark_rapids_tpu.plan import from_host_table
@@ -240,7 +480,7 @@ def main():
     ap.add_argument("--queries", type=str, default="")
     ap.add_argument("--cpu-baseline", action="store_true")
     ap.add_argument("--sql", action="store_true",
-                    help="run the q1-q10 SQL-text forms through "
+                    help="run the q1-q22 SQL-text forms through "
                          "session.sql() instead of the DataFrame DSL")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--out", type=str, default="")

@@ -448,6 +448,15 @@ SCAN_DEVICE_CACHE = bool_conf(
     "Cache the uploaded device image of in-memory scan batches on the host "
     "table (GpuInMemoryTableScanExec analog); evicted on device OOM.")
 
+PLAN_VERIFY_MODE = str_conf(
+    "spark.rapids.sql.planVerify.mode", "off",
+    "Static plan verification of every converted plan before execution "
+    "(spark_rapids_tpu.lint): off, warn (print diagnostics and "
+    "continue), or error (raise PlanVerificationError). The test suite "
+    "runs with error; `python -m spark_rapids_tpu.lint` runs the same "
+    "verifier over the TPC-H golden suite plus the registry/repo "
+    "audits.", commonly_used=True)
+
 
 class RapidsConf:
     """Immutable-ish view over a plain {key: value} dict with typed access."""
@@ -550,5 +559,25 @@ def generate_docs() -> str:
         "session, and drop via `DROP VIEW [IF EXISTS]`. The supported "
         "grammar table lives in README.md; `bench.py --sql` and "
         "`scale_test.py --sql` run the TPC-H corpus from SQL text.",
+        "",
+        "## Static analysis (`python -m spark_rapids_tpu.lint`)",
+        "",
+        "One CLI runs three tools and exits non-zero on any diagnostic: "
+        "a **plan verifier** (walks every converted plan and asserts "
+        "schema contracts, device/host transition correctness, exchange "
+        "partitioning, decimal precision/scale propagation, TypeSig "
+        "conformance and fallback-reason hygiene), a **registry "
+        "auditor** (ops/* classes vs overrides registrations, ExprChecks "
+        "arity, kill-switch keys, SQL exposure, and drift between this "
+        "file / SUPPORTED_OPS.md and their generators — regenerate with "
+        "`--write-docs`), and a **repo lint** (no host syncs in execs/ "
+        "or ops/ outside `dispatch.host_fetch`, no `jax.numpy` outside "
+        "the device layers, no undeclared conf-key string literals, no "
+        "wall-clock/unseeded randomness in kernels, no dead lambdas). "
+        "`spark.rapids.sql.planVerify.mode` additionally runs the plan "
+        "verifier inline on every `TpuSession.execute` (`off` in "
+        "production, `error` under the test suite); the CLI also "
+        "verifies the TPC-H q1-q22 golden corpus in DSL and SQL form, "
+        "with AQE on and off. `--list-rules` prints every rule id.",
     ]
     return "\n".join(lines) + "\n"

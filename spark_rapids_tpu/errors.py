@@ -49,6 +49,19 @@ class UnsupportedOnTpu(RapidsTpuError):
     operation converts such nodes back to CPU)."""
 
 
+class PlanVerificationError(RapidsTpuError):
+    """A converted plan violated a structural invariant
+    (spark.rapids.sql.planVerify.mode=error). Carries the structured
+    diagnostics in ``.diagnostics``; the message lists rule id + plan
+    path per finding."""
+
+    def __init__(self, diagnostics):
+        self.diagnostics = list(diagnostics)
+        super().__init__(
+            "plan verification failed:\n" +
+            "\n".join(f"  {d}" for d in self.diagnostics))
+
+
 class AnsiViolation(RapidsTpuError, ArithmeticError):
     """ANSI mode (spark.sql.ansi.enabled) runtime error: overflow, divide
     by zero, invalid cast, or array index out of bounds — the engine's

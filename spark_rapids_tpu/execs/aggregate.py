@@ -709,8 +709,16 @@ class TpuHashAggregateExec(TpuExec):
                     if splan else None)
             fsums_s = batched_segment_sum_f64(fcols, gid, gpad, capacity,
                                               use_split, counts=scnt)
-            vcols = [jnp.where(svs[j], vvs[j][0].data.astype(jnp.float64), 0.0)
-                     for j in vplan_j]
+            def _vdata(j):
+                # decimal variance inputs are UNSCALED ints; moments are
+                # VALUE-unit doubles (same scaling contract as cpu_agg)
+                d = vvs[j][0].data.astype(jnp.float64)
+                cdt = agg_specs[j][1].child.data_type
+                if isinstance(cdt, T.DecimalType):
+                    d = d / jnp.float64(10 ** cdt.scale)
+                return d
+
+            vcols = [jnp.where(svs[j], _vdata(j), 0.0) for j in vplan_j]
             fsums_v = batched_segment_sum_f64(vcols, gid, gpad, capacity,
                                               use_split=False)
             fsums = {}
@@ -725,9 +733,7 @@ class TpuHashAggregateExec(TpuExec):
             for j in vplan_j:
                 mean = fsums[j] / jnp.maximum(nonnulls[j], 1)
                 ccols.append(jnp.where(
-                    svs[j],
-                    (vvs[j][0].data.astype(jnp.float64) - mean[gid]) ** 2,
-                    0.0))
+                    svs[j], (_vdata(j) - mean[gid]) ** 2, 0.0))
             csums = batched_segment_sum_f64(ccols, gid, gpad, capacity,
                                             use_split)
             m2s = {j: csums[:, i2] for i2, j in enumerate(vplan_j)}
@@ -927,19 +933,29 @@ class TpuHashAggregateExec(TpuExec):
                 ovf = (t3 > 0x7FFFFFFF) | (t3 < -0x80000000)
                 tot = _dec_wide_to_f64(hi128, lo128)
                 valid = has_any & ~ovf
-                return (jnp.where(valid, tot / jnp.maximum(nonnull, 1),
-                                  0.0), valid)
+                # unscaled exact sum -> VALUE-unit double result (one
+                # rounding), matching Cast(decimal->double) semantics
+                dscale = jnp.float64(10 ** fnagg.child.data_type.scale)
+                return (jnp.where(
+                    valid, tot / (jnp.maximum(nonnull, 1) * dscale),
+                    0.0), valid)
             v = jnp.where(sv, sd.astype(jnp.float64), 0.0)
             s = segment_sum_f64(v, gid, nseg, capacity, use_split)
             return (jnp.where(has_any, s / jnp.maximum(nonnull, 1), 0.0), has_any)
 
         if isinstance(fnagg, (agg.StddevPop, agg.StddevSamp, agg.VariancePop, agg.VarianceSamp)):
-            v = jnp.where(sv, sd.astype(jnp.float64), 0.0)
+            sdf = sd.astype(jnp.float64)
+            cdt = fnagg.child.data_type
+            if isinstance(cdt, T.DecimalType):
+                # unscaled decimal ints -> VALUE-unit moments (same
+                # scaling contract as cpu_agg / the batched f64 ride)
+                sdf = sdf / jnp.float64(10 ** cdt.scale)
+            v = jnp.where(sv, sdf, 0.0)
             # EXACT mean: a split-sum mean error d would inflate the
             # centered pass by n*d^2 (quadratic amplification)
             s = segment_sum_f64(v, gid, nseg, capacity, use_split=False)
             mean = s / jnp.maximum(nonnull, 1)
-            centered = jnp.where(sv, (sd.astype(jnp.float64) - mean[gid]) ** 2, 0.0)
+            centered = jnp.where(sv, (sdf - mean[gid]) ** 2, 0.0)
             m2 = segment_sum_f64(centered, gid, nseg, capacity, use_split)
             if isinstance(fnagg, (agg.StddevPop, agg.VariancePop)):
                 denom = jnp.maximum(nonnull, 1)

@@ -556,7 +556,8 @@ class TpuJoinExec(TpuExec):
                     num_segments=nparts)
             fn = tpu_jit(counts_fn)
             self._kernel._aux_traces[key] = fn
-        counts = np.asarray(jax.device_get(fn(pids, live)))
+        from spark_rapids_tpu.dispatch import host_fetch
+        counts = np.asarray(host_fetch(fn(pids, live)))
         parts = []
         for p in range(nparts):
             compacted = self._compact(table, (pids == p) & live)
@@ -649,7 +650,8 @@ class TpuJoinExec(TpuExec):
             ctx.add_flag(size_site, self._size_flag(
                 jt, total_d, counts, live_l, out_cap, lt.capacity))
         else:
-            total = int(jax.device_get(total_d))  # one host sync per batch
+            from spark_rapids_tpu.dispatch import host_fetch
+            total = int(host_fetch(total_d))  # one host sync per batch
             if jt in ("left", "leftouter", "right", "rightouter") or full_outer:
                 # each unmatched probe row adds at most one output row; use
                 # the probe CAPACITY as the static bound rather than paying a

@@ -1,0 +1,73 @@
+"""Static-analysis layer: plan verifier, registry auditor, repo lint.
+
+Reference analogs: the spark-rapids ``spark.rapids.sql.test.enabled``
+assert-on-fallback harness and Catalyst's plan-integrity validation
+(``QueryExecution.assertAnalyzed`` / structural ``validatePlan`` checks —
+Armbrust et al.).  The reproduction's tagging layer (overrides/typesig.py,
+overrides/rules.py) decides what runs on device, but until this package
+nothing *checked* the resulting physical plan, the op registries, or the
+codebase itself.  Three tools, one diagnostic format:
+
+* ``plan_verifier.verify_converted`` — walks a converted physical plan
+  (post-overrides, including the AQE-deferred build nodes) and asserts
+  cross-layer invariants: schema contracts, device/host transition
+  correctness, exchange partitioning, decimal precision/scale
+  propagation, TypeSig conformance, fallback-reason hygiene.
+* ``registry_audit.audit_registry`` — cross-checks ops/* expression
+  classes against the overrides registries, ExprChecks signatures, SQL
+  exposure and the committed SUPPORTED_OPS.md / CONFIGS.md.
+* ``repo_lint.lint_repo`` — a Python-AST lint enforcing project
+  invariants the type system can't (host syncs in hot paths, jnp outside
+  device layers, undeclared conf keys, nondeterminism in kernels, dead
+  lambdas).
+
+All three run from one CLI (``python -m spark_rapids_tpu.lint``) and as a
+pytest module in tier-1 (tests/test_lint.py).  The plan verifier also runs
+inline on every ``TpuSession.execute`` under
+``spark.rapids.sql.planVerify.mode = off|warn|error``.
+"""
+
+from spark_rapids_tpu.lint.diagnostics import Diagnostic, RULES, rule_ids
+
+__all__ = [
+    "Diagnostic",
+    "RULES",
+    "rule_ids",
+    "verify_converted",
+    "verify_plan",
+    "audit_registry",
+    "lint_repo",
+    "run_all",
+]
+
+
+def verify_converted(executable, meta=None, conf=None):
+    from spark_rapids_tpu.lint.plan_verifier import verify_converted as _v
+    return _v(executable, meta, conf)
+
+
+def verify_plan(plan, conf=None):
+    from spark_rapids_tpu.lint.plan_verifier import verify_plan as _v
+    return _v(plan, conf)
+
+
+def audit_registry(repo_root=None):
+    from spark_rapids_tpu.lint.registry_audit import audit_registry as _a
+    return _a(repo_root)
+
+
+def lint_repo(repo_root=None):
+    from spark_rapids_tpu.lint.repo_lint import lint_repo as _l
+    return _l(repo_root)
+
+
+def run_all(repo_root=None, scale_factor: float = 0.01,
+            include_plans: bool = True):
+    """Run repo lint + registry audit (+ the golden-suite plan
+    verification) and return every diagnostic."""
+    diags = list(lint_repo(repo_root))
+    diags += list(audit_registry(repo_root))
+    if include_plans:
+        from spark_rapids_tpu.lint.golden import verify_golden_plans
+        diags += list(verify_golden_plans(scale_factor=scale_factor))
+    return diags

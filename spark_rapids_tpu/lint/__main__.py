@@ -1,0 +1,75 @@
+"""CLI: ``python -m spark_rapids_tpu.lint``.
+
+Runs the repo lint, the registry auditor and the golden-suite plan
+verification (TPC-H q1-q22, DSL + SQL, AQE on/off) and exits non-zero on
+any diagnostic — the correctness gate every PR runs under."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m spark_rapids_tpu.lint",
+        description="plan verifier + registry auditor + repo lint")
+    ap.add_argument("--skip-repo", action="store_true",
+                    help="skip the Python-AST repo lint")
+    ap.add_argument("--skip-registry", action="store_true",
+                    help="skip the registry/doc-drift audit")
+    ap.add_argument("--skip-plans", action="store_true",
+                    help="skip golden-suite (TPC-H q1-q22) plan "
+                         "verification")
+    ap.add_argument("--sf", type=float, default=0.01,
+                    help="scale factor for golden-suite table generation")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print every rule id and exit")
+    ap.add_argument("--write-docs", action="store_true",
+                    help="regenerate SUPPORTED_OPS.md and CONFIGS.md "
+                         "from the registries, then exit")
+    args = ap.parse_args(argv)
+
+    from spark_rapids_tpu.lint.diagnostics import RULES
+    if args.list_rules:
+        for rid in sorted(RULES):
+            print(f"{rid:22s} {RULES[rid]}")
+        return 0
+    if args.write_docs:
+        from spark_rapids_tpu.lint.registry_audit import regenerate_docs
+        for path in regenerate_docs():
+            print(f"wrote {path}")
+        return 0
+
+    diags = []
+    ran = []
+    if not args.skip_repo:
+        from spark_rapids_tpu.lint.repo_lint import lint_repo
+        repo = lint_repo()
+        print(f"repo lint: {len(repo)} diagnostic(s)")
+        diags += repo
+        ran.append("repo")
+    if not args.skip_registry:
+        from spark_rapids_tpu.lint.registry_audit import audit_registry
+        reg = audit_registry()
+        print(f"registry audit: {len(reg)} diagnostic(s)")
+        diags += reg
+        ran.append("registries")
+    if not args.skip_plans:
+        from spark_rapids_tpu.lint.golden import verify_golden_plans
+        plans = verify_golden_plans(scale_factor=args.sf)
+        print(f"golden-suite plan verify: {len(plans)} diagnostic(s)")
+        diags += plans
+        ran.append("golden-suite plans")
+
+    for d in diags:
+        print(str(d))
+    if diags:
+        print(f"FAILED: {len(diags)} diagnostic(s)")
+        return 1
+    print(f"OK: {', '.join(ran) if ran else 'nothing checked'} clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

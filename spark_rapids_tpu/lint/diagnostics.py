@@ -1,0 +1,84 @@
+"""Structured diagnostics + the rule registry.
+
+Every lint finding is a ``Diagnostic`` carrying a stable rule id, a
+location path (a plan path like ``Join.left.Project`` for the verifier, a
+``file:line`` for the repo lint, a registry coordinate for the auditor)
+and a human message.  Rule ids are registered here so the CLI can list
+them and tests can assert the id surface is complete."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List
+
+
+@dataclass(frozen=True)
+class Diagnostic:
+    rule_id: str
+    path: str
+    message: str
+    severity: str = "error"
+
+    def __str__(self) -> str:
+        return f"[{self.rule_id}] {self.path}: {self.message}"
+
+
+#: rule id -> one-line description (the CLI's --list-rules output; the
+#: lint tests assert every id here has at least one negative test)
+RULES: Dict[str, str] = {
+    # -- plan verifier ------------------------------------------------------
+    "PV-SCHEMA": "node output schema malformed or pass-through schema "
+                 "diverges from its child",
+    "PV-TRANSITION": "device/host boundary crossed without a "
+                     "HostToDevice / DeviceToHost / InputAdapter node",
+    "PV-EXCHANGE": "exchange partitioning inconsistent (mode, keys, "
+                   "partition count)",
+    "PV-BOUNDREF": "bound reference ordinal/type disagrees with the "
+                   "child's output schema",
+    "PV-TYPESIG": "device exec carries an expression outside its "
+                  "declared TypeSig",
+    "PV-DECIMAL": "decimal precision/scale invalid or arithmetic result "
+                  "type diverges from the Spark promotion rules",
+    "PV-NULLABLE": "expression nullability contract violated "
+                   "(non-nullable claim over nullable inputs)",
+    "PV-FALLBACK": "fallback bookkeeping broken (empty reason, reason "
+                   "missing from explain(), or convertible node without "
+                   "a rule)",
+    "PV-AGG": "aggregate contract violated (spec arity, non-aggregate "
+              "spec, unsupported device aggregate)",
+    "PV-JOIN": "join contract violated (key arity/type mismatch, "
+               "unsupported join type)",
+    # -- registry auditor ---------------------------------------------------
+    "RA-UNREGISTERED": "ops/* expression has a device kernel but no "
+                       "overrides registration (silently CPU)",
+    "RA-PARAM-ARITY": "ExprChecks parameter signature count exceeds the "
+                      "expression's constructor arity",
+    "RA-KILL-SWITCH": "per-op kill-switch conf key matches no registered "
+                      "exec rule or expression",
+    "RA-SQL-EXPOSURE": "device-supported operator not exposed through "
+                       "the SQL function registry",
+    "RA-DOC-DRIFT-OPS": "committed SUPPORTED_OPS.md differs from the "
+                        "generator output",
+    "RA-DOC-DRIFT-CONFIGS": "committed CONFIGS.md differs from the "
+                            "generator output",
+    # -- repo lint ----------------------------------------------------------
+    "RL-HOST-SYNC": "host synchronization in an execs/ or ops/ hot path "
+                    "outside the sanctioned dispatch helpers",
+    "RL-JNP-SCOPE": "jax.numpy imported outside the device layers",
+    "RL-CONF-KEY": "conf key referenced via string literal but not "
+                   "declared in the conf registry",
+    "RL-NONDETERMINISM": "wall-clock or unseeded randomness inside a "
+                         "kernel module",
+    "RL-DEAD-LAMBDA": "lambda bound to a name that is never used",
+}
+
+
+def rule_ids() -> List[str]:
+    return sorted(RULES)
+
+
+def make(rule_id: str, path: str, message: str,
+         severity: str = "error") -> Diagnostic:
+    if rule_id not in RULES:  # not an assert: must survive python -O
+        raise ValueError(f"unknown lint rule id {rule_id}")
+    return Diagnostic(rule_id, path, message, severity)

@@ -1,0 +1,65 @@
+"""Golden-suite plan verification: the TPC-H q1-q22 corpus (DSL and SQL
+forms, with AQE on and off) tagged, converted and verified — the lint
+CLI's `--plans` stage and tier-1's test_lint coverage.
+
+The corpus lives in scale_test.py (the ScaleTest harness); this module
+only builds the plans, never executes them, so verification stays fast
+enough to run on every PR."""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import Iterable, List, Tuple
+
+from spark_rapids_tpu.lint.diagnostics import Diagnostic
+
+
+def _load_scale_test():
+    try:
+        import scale_test
+    except ImportError:
+        import spark_rapids_tpu
+        root = os.path.dirname(os.path.dirname(
+            os.path.abspath(spark_rapids_tpu.__file__)))
+        if root not in sys.path:
+            sys.path.insert(0, root)
+        import scale_test
+    return scale_test
+
+
+def golden_tables(scale_factor: float = 0.01, seed: int = 0):
+    from spark_rapids_tpu.datagen import scale_test_specs
+    specs = scale_test_specs(scale_factor)
+    return {name: spec.generate_table(scale_factor, seed=seed)
+            for name, spec in specs.items()}
+
+
+def iter_golden_plans(scale_factor: float = 0.01,
+                      tables=None) -> Iterable[Tuple[str, object, object]]:
+    """Yield (query_id, logical_plan, conf) for every corpus query in
+    both DSL and SQL form, pre- and post-AQE conversion settings."""
+    from spark_rapids_tpu.session import TpuSession
+    st = _load_scale_test()
+    tables = tables if tables is not None else golden_tables(scale_factor)
+    for mode, build in (("dsl", st.build_queries),
+                        ("sql", st.build_sql_queries)):
+        for aqe in (True, False):
+            session = TpuSession({
+                "spark.rapids.sql.adaptive.enabled": str(aqe).lower(),
+            })
+            queries = build(session, tables)
+            for name, fn in queries.items():
+                qid = f"{name}[{mode},aqe={'on' if aqe else 'off'}]"
+                yield qid, fn().plan, session.conf
+
+
+def verify_golden_plans(scale_factor: float = 0.01,
+                        tables=None) -> List[Diagnostic]:
+    from spark_rapids_tpu.lint.plan_verifier import verify_plan
+    diags: List[Diagnostic] = []
+    for qid, plan, conf in iter_golden_plans(scale_factor, tables):
+        for d in verify_plan(plan, conf):
+            diags.append(Diagnostic(d.rule_id, f"{qid}:{d.path}",
+                                    d.message, d.severity))
+    return diags

@@ -1,0 +1,275 @@
+"""Python-AST repo lint: project invariants the type system can't hold.
+
+The TPU-first rule this codebase lives by (dispatch.py header): NOTHING
+transfers host<->device on a warm query outside the sanctioned sites.
+The type checker cannot see a stray ``jax.device_get`` in a kernel or a
+conf key referenced by a typo'd string — this lint can.  Rules (RL-*):
+
+* RL-HOST-SYNC — no host synchronization (``jax.device_get``,
+  ``.block_until_ready()``) inside execs/ or ops/ hot paths except via
+  the sanctioned ``dispatch.host_fetch`` helper.
+* RL-JNP-SCOPE — ``jax.numpy`` imports only in the device layers.
+* RL-CONF-KEY — every ``spark.*`` conf key referenced as a string
+  literal must be declared in the conf registry.
+* RL-NONDETERMINISM — no wall-clock or unseeded randomness in kernel
+  modules (results must replay bit-identically; LORE depends on it).
+* RL-DEAD-LAMBDA — a lambda bound to a name that is never referenced
+  again is dead code.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import List, Optional
+
+from spark_rapids_tpu.lint.diagnostics import Diagnostic, make
+
+#: directories (under spark_rapids_tpu/) whose modules are device layers
+#: and may import jax.numpy
+_DEVICE_DIRS = ("execs", "ops", "columnar", "parallel", "runtime",
+                "shuffle", "shims", "models")
+#: top-level device-layer files
+_DEVICE_FILES = ("dispatch.py", "udf.py")
+
+#: np.random attributes that construct SEEDED generators (allowed in
+#: kernels); everything else on np.random is process-global state
+_SEEDED_RANDOM_OK = {"default_rng", "Generator", "SeedSequence",
+                     "BitGenerator", "PCG64", "Philox"}
+
+_CONF_KEY_RE = re.compile(r"^spark\.(rapids|sql)\.[A-Za-z0-9_]"
+                          r"[A-Za-z0-9_.]*[A-Za-z0-9_]$")
+
+
+def _repo_root(repo_root: Optional[str]) -> str:
+    if repo_root:
+        return repo_root
+    import spark_rapids_tpu
+    return os.path.dirname(os.path.dirname(
+        os.path.abspath(spark_rapids_tpu.__file__)))
+
+
+def _iter_source_files(root: str):
+    pkg = os.path.join(root, "spark_rapids_tpu")
+    for dirpath, dirnames, filenames in os.walk(pkg):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for f in sorted(filenames):
+            if f.endswith(".py"):
+                yield os.path.join(dirpath, f)
+    for f in ("bench.py", "scale_test.py"):
+        p = os.path.join(root, f)
+        if os.path.exists(p):
+            yield p
+
+
+def _rel(root: str, path: str) -> str:
+    return os.path.relpath(path, root)
+
+
+def _attr_chain(node: ast.AST) -> str:
+    """Dotted name of an attribute/name chain ('' when not a plain chain)."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+# ---------------------------------------------------------------------------
+# per-rule visitors
+# ---------------------------------------------------------------------------
+
+
+def _is_device_expr(node: ast.AST) -> bool:
+    """Is this expression PROVABLY a device value — a jnp./jax. call not
+    already funneled through the sanctioned host_fetch wrapper (whose
+    RESULT is host data, however device-y its argument)?"""
+    if isinstance(node, ast.Call):
+        chain = _attr_chain(node.func)
+        if chain == "host_fetch" or chain.endswith(".host_fetch"):
+            return False
+        if chain.startswith(("jnp.", "jax.")):
+            return True
+    for child in ast.iter_child_nodes(node):
+        if _is_device_expr(child):
+            return True
+    return False
+
+
+def _check_host_sync(rel: str, tree: ast.AST, diags: List[Diagnostic]):
+    in_hot_path = rel.startswith(("spark_rapids_tpu/execs/",
+                                  "spark_rapids_tpu/ops/"))
+    if not in_hot_path:
+        return
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "jax":
+            # `from jax import device_get` would make the call below
+            # invisible to the chain matcher — ban the import form too
+            for a in node.names:
+                if a.name in ("device_get", "block_until_ready"):
+                    diags.append(make(
+                        "RL-HOST-SYNC", f"{rel}:{node.lineno}",
+                        f"importing jax.{a.name} into a hot path; route "
+                        "through dispatch.host_fetch so syncs are "
+                        "counted and reviewable"))
+            continue
+        if not isinstance(node, ast.Call):
+            continue
+        chain = _attr_chain(node.func)
+        if chain.endswith(".block_until_ready"):
+            diags.append(make(
+                "RL-HOST-SYNC", f"{rel}:{node.lineno}",
+                "block_until_ready() stalls the dispatch pipeline; use "
+                "dispatch.host_fetch at a sanctioned sync point"))
+        elif chain == "jax.device_get" or chain.endswith(".device_get") \
+                or chain == "device_get":
+            diags.append(make(
+                "RL-HOST-SYNC", f"{rel}:{node.lineno}",
+                "raw jax.device_get in a hot path (~0.1s tunnel stall "
+                "each); route through dispatch.host_fetch so syncs are "
+                "counted and reviewable"))
+        elif chain in ("np.asarray", "numpy.asarray", "float", "int") \
+                and node.args and _is_device_expr(node.args[0]):
+            # the statically-decidable slice of "np.asarray/float/int on
+            # device values": the argument is itself a jnp./jax. call,
+            # so the conversion provably forces a device sync (general
+            # deviceness needs dataflow a lint can't do)
+            diags.append(make(
+                "RL-HOST-SYNC", f"{rel}:{node.lineno}",
+                f"{chain}() over a jax expression synchronizes the "
+                "device; route through dispatch.host_fetch"))
+
+
+def _check_jnp_scope(rel: str, tree: ast.AST, diags: List[Diagnostic]):
+    parts = rel.split("/")
+    allowed = False
+    if parts[0] != "spark_rapids_tpu":
+        allowed = False  # bench.py / scale_test.py are host drivers
+    elif len(parts) == 2:
+        allowed = parts[1] in _DEVICE_FILES
+    else:
+        allowed = parts[1] in _DEVICE_DIRS
+    if allowed:
+        return
+    for node in ast.walk(tree):
+        hit = None
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "jax.numpy":
+                    hit = f"{a.name} imported"
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "jax.numpy" or (
+                    node.module == "jax"
+                    and any(a.name == "numpy" for a in node.names)):
+                hit = "jax.numpy imported"
+        elif isinstance(node, ast.Attribute):
+            # `import jax; jax.numpy.foo(...)` bypasses the import
+            # check — catch the attribute access form too (exact match:
+            # the inner `jax.numpy` node; avoids double-reporting the
+            # enclosing `jax.numpy.foo` chain)
+            if _attr_chain(node) == "jax.numpy":
+                hit = "jax.numpy used"
+        if hit:
+            diags.append(make(
+                "RL-JNP-SCOPE", f"{rel}:{node.lineno}",
+                f"{hit} outside the device layers "
+                f"({', '.join(_DEVICE_DIRS)}); host-side layers must "
+                "stay device-agnostic"))
+
+
+def _check_conf_keys(rel: str, tree: ast.AST, declared,
+                     diags: List[Diagnostic]):
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Constant)
+                and isinstance(node.value, str)):
+            continue
+        v = node.value
+        if not _CONF_KEY_RE.match(v):
+            continue
+        if v in declared:
+            continue
+        diags.append(make(
+            "RL-CONF-KEY", f"{rel}:{node.lineno}",
+            f"conf key {v!r} is not declared in the conf registry — "
+            "typo, or a key removed without cleaning its references"))
+
+
+def _check_nondeterminism(rel: str, tree: ast.AST,
+                          diags: List[Diagnostic]):
+    in_kernel = rel.startswith(("spark_rapids_tpu/execs/",
+                                "spark_rapids_tpu/ops/"))
+    if not in_kernel:
+        return
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        chain = _attr_chain(node.func)
+        bad = None
+        if chain in ("time.time", "datetime.now", "datetime.datetime.now",
+                     "date.today", "datetime.date.today",
+                     "datetime.utcnow", "datetime.datetime.utcnow"):
+            bad = f"{chain}() (wall clock)"
+        else:
+            parts = chain.split(".")
+            if len(parts) >= 2 and parts[-2] == "random" and \
+                    parts[0] in ("np", "numpy") and \
+                    parts[-1] not in _SEEDED_RANDOM_OK:
+                bad = f"{chain}() (process-global RNG state)"
+            elif chain.startswith("random.") and len(parts) == 2:
+                bad = f"{chain}() (unseeded stdlib RNG)"
+        if bad:
+            diags.append(make(
+                "RL-NONDETERMINISM", f"{rel}:{node.lineno}",
+                f"{bad} in a kernel module — results must replay "
+                "bit-identically (seeded default_rng only)"))
+
+
+def _check_dead_lambdas(rel: str, tree: ast.AST,
+                        diags: List[Diagnostic]):
+    lambda_defs = {}
+    used = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Lambda):
+            name = node.targets[0].id
+            lambda_defs.setdefault(name, node.lineno)
+        elif isinstance(node, ast.Name) and \
+                isinstance(node.ctx, ast.Load):
+            used.add(node.id)
+    for name, lineno in sorted(lambda_defs.items(), key=lambda kv: kv[1]):
+        if name not in used:
+            diags.append(make(
+                "RL-DEAD-LAMBDA", f"{rel}:{lineno}",
+                f"lambda bound to {name!r} is never used — dead code"))
+
+
+# ---------------------------------------------------------------------------
+# entry point
+# ---------------------------------------------------------------------------
+
+
+def lint_repo(repo_root: Optional[str] = None) -> List[Diagnostic]:
+    root = _repo_root(repo_root)
+    from spark_rapids_tpu.lint.registry_audit import _import_full_package
+    _import_full_package()
+    from spark_rapids_tpu import conf as C
+    declared = set(C.registry())
+    diags: List[Diagnostic] = []
+    for path in _iter_source_files(root):
+        rel = _rel(root, path)
+        if rel.startswith("spark_rapids_tpu/lint/"):
+            continue  # the lint's own rule tables name forbidden patterns
+        with open(path) as f:
+            src = f.read()
+        tree = ast.parse(src, filename=rel)  # unparseable repo = hard error
+        _check_host_sync(rel, tree, diags)
+        _check_jnp_scope(rel, tree, diags)
+        _check_conf_keys(rel, tree, declared, diags)
+        _check_nondeterminism(rel, tree, diags)
+        _check_dead_lambdas(rel, tree, diags)
+    return diags

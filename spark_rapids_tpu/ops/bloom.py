@@ -66,13 +66,15 @@ class BloomFilter:
     dispatch.device_const)."""
 
     def __init__(self, bits: jax.Array, num_hashes: int):
+        from spark_rapids_tpu.dispatch import host_fetch
         self.bits = bits
         self.num_bits = int(bits.shape[0])
         self.num_hashes = int(num_hashes)
-        self.host_bits = np.asarray(jax.device_get(bits))
+        self.host_bits = np.asarray(host_fetch(bits))
 
     def approx_set_bits(self) -> int:
-        return int(jax.device_get(jnp.sum(self.bits.astype(jnp.int32))))
+        from spark_rapids_tpu.dispatch import host_fetch
+        return int(host_fetch(jnp.sum(self.bits.astype(jnp.int32))))
 
 
 _BUILD_CACHE = {}
@@ -158,7 +160,9 @@ class BloomFilterMightContain(Expression):
 
     def eval_cpu(self, table: HostTable) -> HostColumn:
         c = self.children[0].eval_cpu(table)
-        bits = np.asarray(jax.device_get(self.bloom.bits))
+        # the host copy is cached at filter build; re-fetching the full
+        # bits array per batch would stall the pipeline ~0.1s each
+        bits = self.bloom.host_bits
         from spark_rapids_tpu.ops.hashfns import xxhash64_host
         n = len(c)
         out = np.zeros(n, dtype=np.bool_)

@@ -225,9 +225,13 @@ def cast_supported(src: T.DataType, dst: T.DataType) -> bool:
         if isinstance(dst, T.DecimalType):
             return (dst.precision <= dec_max
                     and isinstance(src, T.IntegralType))
+        # decimal -> double/float works at ANY precision (two-limb f64
+        # combine, same precision loss as Spark's Decimal.toDouble); the
+        # exact integral truncation stays decimal64-only
+        if isinstance(dst, (T.DoubleType, T.FloatType)):
+            return True
         return (src.precision <= dec_max
-                and isinstance(dst, (T.IntegralType, T.DoubleType,
-                                     T.FloatType)))
+                and isinstance(dst, T.IntegralType))
     if isinstance(src, T.StringType):
         # device path: dictionary-transform (host parse of dict entries +
         # device gather); timestamps stay off like the reference default
@@ -539,7 +543,18 @@ def _dev_decimal_cast(c, src: T.DataType, dst: T.DataType):
     # decimal -> double/float/integral
     scale = _POW10[src.scale]
     if isinstance(dst, (T.DoubleType, T.FloatType)):
-        data = c.data.astype(jnp.float64) / jnp.float64(scale)
+        if T.is_dec128(src):
+            # (n, 2) two-limb storage: [:,0] signed hi, [:,1] low 64 bits
+            # reinterpreted int64 — combine in f64 (Decimal.toDouble-class
+            # precision loss; the streaming decimal-average merge casts
+            # its dec128 partial sums through here)
+            hi = c.data[:, 0].astype(jnp.float64)
+            lo_i = c.data[:, 1]
+            lo = lo_i.astype(jnp.float64) + jnp.where(
+                lo_i < 0, jnp.float64(2.0 ** 64), jnp.float64(0.0))
+            data = (hi * jnp.float64(2.0 ** 64) + lo) / jnp.float64(scale)
+        else:
+            data = c.data.astype(jnp.float64) / jnp.float64(scale)
         return DevVal(jnp.where(c.validity, data.astype(dst.np_dtype),
                                 jnp.zeros((), dst.np_dtype)), c.validity)
     # integral: truncate toward zero, overflow -> null

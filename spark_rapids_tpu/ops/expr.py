@@ -586,9 +586,8 @@ def deliver_ansi_flags(labels, err_flags) -> None:
         for lbl, f in zip(labels, err_flags):
             ctx.add_flag("ansi:" + lbl, f)
         return
-    import jax
-    import numpy as _np
-    vals = jax.device_get(jnp.stack(list(err_flags)))
+    from spark_rapids_tpu.dispatch import host_fetch
+    vals = host_fetch(jnp.stack(list(err_flags)))
     spec.check_flag_values(["ansi:" + l for l in labels], vals)
 
 

@@ -234,6 +234,17 @@ class WindowExpression(Expression):
         if isinstance(self.function, agg.AggregateFunction):
             fn = type(self.function)(self.function.child.bind(schema)) \
                 if self.function.child is not None else self.function
+            if isinstance(fn, (agg.Average, agg.StddevPop, agg.StddevSamp,
+                               agg.VariancePop, agg.VarianceSamp)) and \
+                    fn.child is not None and \
+                    isinstance(fn.child.data_type, T.DecimalType):
+                # DOUBLE-typed moments over UNSCALED decimal buffers would
+                # come out in unscaled units (the lint-era probe caught
+                # window avg(decimal(4,2)) of [1,2] = 150.0); one Cast at
+                # the bind chokepoint fixes every frame mode on both the
+                # CPU and device paths
+                from spark_rapids_tpu.ops.cast import Cast
+                fn = type(fn)(Cast(fn.child, T.DOUBLE))
         else:
             bound_children = [c.bind(schema) for c in self.function.children]
             fn = self.function.with_children(bound_children) \

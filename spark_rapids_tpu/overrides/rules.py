@@ -97,8 +97,11 @@ def _build_expr_sigs():
     reg(udf_mod.ColumnarDeviceUDF)
     from spark_rapids_tpu.ops import decimal as decimal_ops
     for name in ("DecimalAdd", "DecimalSubtract", "DecimalMultiply",
-                 "DecimalDivide", "UnscaledValue", "MakeDecimal",
-                 "CheckOverflow"):
+                 "DecimalDivide", "DecimalRemainder", "DecimalPmod",
+                 "UnscaledValue", "MakeDecimal", "CheckOverflow"):
+        # DecimalRemainder/DecimalPmod were shipped with device kernels
+        # but never registered — the registry auditor (RA-UNREGISTERED)
+        # caught decimal % silently falling back to CPU
         reg(getattr(decimal_ops, name))
     from spark_rapids_tpu.ops import misc as misc_ops
     for name in ("NormalizeNaNAndZero", "KnownFloatingPointNormalized",

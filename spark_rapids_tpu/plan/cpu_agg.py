@@ -94,11 +94,15 @@ def _agg_one(fn: agg.AggregateFunction, value: HostColumn, gid: np.ndarray,
                                 dtype=object)
             return HostColumn(out_type, data, validity)
         data = value.data[valid].astype(np.float64)
+        if isinstance(value.dtype, T.DecimalType):
+            # decimal buffers hold UNSCALED ints; Average/stddev/variance
+            # results are doubles in VALUE units (Spark semantics — the
+            # lint-era probe caught avg(decimal(4,2)) of [1,2,3] = 200.0)
+            data = data / float(10 ** value.dtype.scale)
         s = np.zeros(ngroups, dtype=np.float64)
         np.add.at(s, vgid, data)
         if isinstance(fn, agg.Sum):
-            out = s if isinstance(out_type, T.DoubleType) else s
-            return HostColumn(T.DOUBLE, np.where(has_any, out, 0.0), has_any)
+            return HostColumn(T.DOUBLE, np.where(has_any, s, 0.0), has_any)
         if isinstance(fn, agg.Average):
             cnt = np.maximum(nonnull, 1)
             return HostColumn(T.DOUBLE, np.where(has_any, s / cnt, 0.0), has_any)

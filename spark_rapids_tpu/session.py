@@ -162,6 +162,26 @@ class TpuSession:
         if meta is not None and self.conf.explain_mode in ("NOT_ON_GPU", "ALL"):
             print(meta.explain(only_fallback=self.conf.explain_mode == "NOT_ON_GPU"))
 
+        # static plan verification (lint/plan_verifier): prove the
+        # converted tree's cross-layer invariants BEFORE execution
+        # (Catalyst validatePlan / assert-on-fallback analog)
+        from spark_rapids_tpu.conf import PLAN_VERIFY_MODE
+        verify_mode = str(self.conf.get_entry(PLAN_VERIFY_MODE)).lower()
+        if verify_mode not in ("off", "warn", "error"):
+            from spark_rapids_tpu.errors import ColumnarProcessingError
+            raise ColumnarProcessingError(
+                f"spark.rapids.sql.planVerify.mode must be off, warn or "
+                f"error, got {verify_mode!r}")
+        if verify_mode in ("warn", "error") and meta is not None:
+            from spark_rapids_tpu.lint.plan_verifier import verify_converted
+            diags = verify_converted(executable, meta, self.conf)
+            if diags:
+                from spark_rapids_tpu.errors import PlanVerificationError
+                if verify_mode == "error":
+                    raise PlanVerificationError(diags)
+                for d in diags:
+                    print(f"planVerify: {d}")
+
         from spark_rapids_tpu.conf import METRICS_LEVEL
         from spark_rapids_tpu.execs.base import set_metrics_level
         set_metrics_level(self.conf.get_entry(METRICS_LEVEL))

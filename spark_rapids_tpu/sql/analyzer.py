@@ -975,7 +975,10 @@ class Analyzer:
         if isinstance(v, decimal.Decimal):
             tup = v.as_tuple()
             scale = max(-tup.exponent, 0)
-            digits = len(tup.digits)
+            # positive exponents widen the integer part: 1E2BD is 100 =
+            # decimal(3,0), not decimal(1,0) (code-review fix — the old
+            # precision left CheckOverflow nulling 1E2BD + 1BD)
+            digits = len(tup.digits) + max(tup.exponent, 0)
             precision = max(digits, scale)
             unscaled = int(v.scaleb(scale))
             return Literal(unscaled, T.DecimalType(precision, scale))
@@ -1050,8 +1053,11 @@ class Analyzer:
         if op == "=":
             return left == right
         if op == "<=>":
-            # null-safe equal: both null OR equal
-            return (left.isnull() & right.isnull()) | (left == right)
+            # null-safe equal: NEVER null (code-review fix: the previous
+            # (isnull&isnull)|(==) lowering returned NULL when exactly
+            # one side was null, so NOT(a <=> b) dropped rows)
+            from spark_rapids_tpu.ops.predicates import EqualNullSafe
+            return EqualNullSafe(left, right)
         if op == "<>":
             return left != right
         if op == "<":

@@ -280,6 +280,10 @@ class Parser:
                         raise self.err("unterminated hint", tok)
                     if a.kind in (IDENT, NUMBER):
                         args.append(a.text)
+                    else:
+                        raise self.err(
+                            f"unsupported hint argument {a.text!r} "
+                            "(identifiers and integers only)", tok)
                     sub.eat_op(",")
                 sub.expect_op(")")
             out.append((name, args))
@@ -678,23 +682,42 @@ class Parser:
         if self.at_kw("ROWS", "RANGE"):
             kind = self.next().upper().lower()
             if self.eat_kw("BETWEEN"):
+                t_lo = self.peek()
                 lo = self._frame_bound()
                 self.expect_kw("AND")
+                t_hi = self.peek()
                 hi = self._frame_bound()
             else:
+                t_lo = t_hi = self.peek()
                 lo = self._frame_bound()
                 hi = 0
+            # Spark rejects backwards unbounded frames at parse time;
+            # collapsing both directions to None would silently compute
+            # a running aggregate instead
+            if lo == "unb_following":
+                raise self.err("UNBOUNDED FOLLOWING is not a valid frame "
+                               "START bound", t_lo)
+            if hi == "unb_preceding":
+                raise self.err("UNBOUNDED PRECEDING is not a valid frame "
+                               "END bound", t_hi)
+            lo = None if lo == "unb_preceding" else lo
+            hi = None if hi == "unb_following" else hi
             frame = (kind, lo, hi)
         self.expect_op(")")
         w = A.WindowDef(partition_by=partition, order_by=order, frame=frame)
         return self._at(w, t)
 
-    def _frame_bound(self) -> Optional[int]:
+    def _frame_bound(self):
+        """int offset, 0 for CURRENT ROW, or the direction-preserving
+        sentinels 'unb_preceding'/'unb_following' (validated by the
+        caller — which side UNBOUNDED is legal on depends on position)."""
         if self.eat_kw("UNBOUNDED"):
-            if not (self.eat_kw("PRECEDING") or self.eat_kw("FOLLOWING")):
-                raise self.err(
-                    "expected PRECEDING or FOLLOWING after UNBOUNDED")
-            return None
+            if self.eat_kw("PRECEDING"):
+                return "unb_preceding"
+            if self.eat_kw("FOLLOWING"):
+                return "unb_following"
+            raise self.err(
+                "expected PRECEDING or FOLLOWING after UNBOUNDED")
         if self.eat_kw("CURRENT"):
             self.expect_kw("ROW")
             return 0

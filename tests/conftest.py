@@ -17,6 +17,25 @@ jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
+# run the static plan verifier in ERROR mode for every session the test
+# suite creates (the spark-rapids `spark.rapids.sql.test.enabled`
+# assert-on-fallback pattern): any structural invariant a converted plan
+# violates fails the test that built it. Injected per-session rather
+# than flipped in the conf REGISTRY so generated docs (CONFIGS.md drift
+# tests) still show the production default.
+from spark_rapids_tpu.session import TpuSession  # noqa: E402
+
+_ORIG_SESSION_INIT = TpuSession.__init__
+
+
+def _verifying_init(self, conf=None):
+    conf = dict(conf or {})
+    conf.setdefault("spark.rapids.sql.planVerify.mode", "error")
+    _ORIG_SESSION_INIT(self, conf)
+
+
+TpuSession.__init__ = _verifying_init
+
 _TESTS_RUN = {"n": 0}
 
 

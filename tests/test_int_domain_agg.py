@@ -247,7 +247,9 @@ def test_subnormal_f64_minmax_reroutes_exact():
 def test_decimal_avg_sums_exactly():
     """Code-review r5: avg over decimal must not ride the lossy f64 split
     pass — the unscaled sum is exact (128-bit word sums) with one
-    rounding at the final divide, on BOTH agg paths and at any sign."""
+    rounding at the final divide, on BOTH agg paths and at any sign.
+    (lint-era fix: the result is in VALUE units — unscaled/10^s —
+    matching Cast(decimal->double); exactness is unchanged.)"""
     n = 2000
     big = 10 ** 16 + 300
     for sign in (1, -1):
@@ -261,7 +263,8 @@ def test_decimal_avg_sums_exactly():
                          .agg(F.avg("d").alias("a")).collect())
         ungrouped = from_host_table(ht, s).agg(F.avg("d").alias("a")).collect()
         for got in [grouped[0][1], ungrouped[0][0]]:
-            assert got == pytest.approx(float(sign * big), rel=1e-13)
+            assert got == pytest.approx(float(sign * big) / 100.0,
+                                        rel=1e-13)
 
 
 def test_dec128_twos_complement_boundary_bytes():

@@ -7,7 +7,7 @@ Three layers:
     same query built through the DataFrame DSL;
   * error surfaces: parse errors carry (line, col) + caret; analysis
     errors name the construct with an overrides-style reason;
-  * the ScaleTest q1-q10 corpus: SQL text and DSL forms produce
+  * the ScaleTest q1-q22 corpus: SQL text and DSL forms produce
     identical results AND identical device dispatch counts (the SQL
     path lowers onto the same plan layer — no parallel engine).
 """
@@ -102,6 +102,45 @@ def test_null_safe_equal(s):
           lambda s: t(s).filter(
               (col("k").isnull() & lit(None).isnull())
               | (col("k") == lit(None))).select(col("id")))
+
+
+def test_null_safe_equal_never_null(s):
+    """<=> is NEVER null (code-review fix: the old lowering returned
+    NULL when exactly one side was null, so NOT(a <=> b) dropped rows)."""
+    rows = s.sql("SELECT k <=> NULL FROM t ORDER BY id").collect()
+    assert all(v in (True, False) for (v,) in rows), rows
+    # row 7 has k NULL -> true; every other row false
+    assert [v for (v,) in rows] == [False] * 6 + [True, False]
+    n = s.sql("SELECT COUNT(*) AS n FROM t "
+              "WHERE NOT (k <=> NULL)").collect()
+    assert n == [(7,)]
+
+
+def test_decimal_literal_positive_exponent(s):
+    """1E2BD is 100 = decimal(3,0) (code-review fix: precision ignored a
+    positive exponent, so CheckOverflow nulled 1E2BD + 1BD)."""
+    assert s.sql("SELECT 1E2BD + 1BD AS v").collect() == [(101,)]
+
+
+def test_backwards_unbounded_frames_rejected(s):
+    """Spark rejects UNBOUNDED FOLLOWING as a frame START (and PRECEDING
+    as an END) at parse time; the old parser collapsed both directions
+    to None and silently computed a running aggregate."""
+    with pytest.raises(SqlParseError, match="frame START"):
+        s.sql("SELECT SUM(v) OVER (ORDER BY id ROWS UNBOUNDED FOLLOWING) "
+              "FROM t")
+    with pytest.raises(SqlParseError, match="frame END"):
+        s.sql("SELECT SUM(v) OVER (ORDER BY id ROWS BETWEEN CURRENT ROW "
+              "AND UNBOUNDED PRECEDING) FROM t")
+    # the legal directions still parse and run
+    rows = s.sql("SELECT SUM(v) OVER (ORDER BY id ROWS BETWEEN UNBOUNDED "
+                 "PRECEDING AND UNBOUNDED FOLLOWING) AS sv FROM t").collect()
+    assert len(rows) == 8
+
+
+def test_hint_rejects_unsupported_argument(s):
+    with pytest.raises(SqlParseError, match="hint argument"):
+        s.sql("SELECT /*+ REPARTITION('8', k) */ k FROM t")
 
 
 def test_between_in_like(s):
@@ -658,7 +697,7 @@ def scale_setup():
             build_sql_queries(s_sql, tables), s_dsl, s_sql)
 
 
-@pytest.mark.parametrize("name", [f"q{i}" for i in range(1, 11)])
+@pytest.mark.parametrize("name", [f"q{i}" for i in range(1, 23)])
 def test_scale_query_sql_equals_dsl(scale_setup, name):
     dsl_q, sql_q, s_dsl, s_sql = scale_setup
     a = _canon(dsl_q[name]().collect())
