@@ -3,11 +3,14 @@ datagen scaletest — SURVEY.md §2.11/§6): a parameterized join/agg/window
 query set over generated tables, emitting a JSON timing report.
 
 Usage: python scale_test.py [--sf 0.1] [--queries q1,q5] [--cpu-baseline]
+       python scale_test.py --chaos [--seed 7]
 
-Each query runs once cold (compile included) and twice warm on the TPU
-session; with --cpu-baseline the CPU-oracle session also runs and the
-report carries speedups. Results print as ONE JSON line per query plus a
-summary line (the reference harness's JSON report shape)."""
+``--chaos`` runs the corpus twice — fault-free, then under a
+randomized-but-SEEDED fault schedule (fetch errors, transport
+disconnects, corrupt frames, kernel crashes injected through
+``spark.rapids.test.faults`` — runtime/faults.py) — asserting
+bit-identical results and bounded recovery work, with per-query
+retry/recompute/demotion counts in the JSON report."""
 
 from __future__ import annotations
 
@@ -474,17 +477,202 @@ def time_query(fn, runs=3):
     return cold, warms[0], warms[len(warms) // 2]
 
 
+# ---------------------------------------------------------------------------
+# Chaos mode
+# ---------------------------------------------------------------------------
+
+
+def chaos_fault_spec(seed: int) -> str:
+    """The seeded fault schedule: every recoverable fault class fires
+    with a small per-hit probability (deterministic per seed). Kernel
+    crashes stay rare — each one costs a whole-query replay."""
+    return ";".join([
+        f"shuffle.fetch.metadata:fetch:0.15:{seed * 10 + 1}",
+        f"shuffle.fetch.stream:fetch:0.1:{seed * 10 + 2}",
+        f"shuffle.fetch.stream:corrupt:0.1:{seed * 10 + 3}",
+        f"shuffle.transport.request:disconnect:0.25:{seed * 10 + 4}",
+        f"exec.execute:crash:0.01:{seed * 10 + 5}",
+        f"dispatch.kernel:crash:0.001:{seed * 10 + 6}",
+    ])
+
+
+def chaos_conf(seed: int, faults: bool):
+    """Session conf for a chaos (or its fault-free twin) run: the P2P
+    shuffle so the full client/server/transport wire path is exercised,
+    fast retry backoff, and the circuit breaker armed. The twin differs
+    ONLY in the fault schedule so results are comparable bit-for-bit."""
+    conf = {
+        "spark.rapids.shuffle.mode": "P2P",
+        "spark.rapids.shuffle.localDeviceSplit.enabled": "false",
+        "spark.rapids.shuffle.fetch.retryWaitMs": "1",
+        "spark.rapids.shuffle.fetch.maxRetries": "3",
+        "spark.rapids.sql.runtimeFallback.enabled": "true",
+    }
+    if faults:
+        conf["spark.rapids.test.faults"] = chaos_fault_spec(seed)
+    return conf
+
+
+def tables_differ(a, b):
+    """Bit-identity check between two HostTables; returns None when
+    identical, else a description of the first divergence."""
+    import numpy as np
+    if list(a.names) != list(b.names):
+        return f"column names differ: {a.names} vs {b.names}"
+    if a.num_rows != b.num_rows:
+        return f"row counts differ: {a.num_rows} vs {b.num_rows}"
+    for name, ca, cb in zip(a.names, a.columns, b.columns):
+        if type(ca.dtype) is not type(cb.dtype):
+            return f"column {name}: dtypes differ ({ca.dtype} vs {cb.dtype})"
+        va = np.asarray(ca.validity, dtype=bool)
+        vb = np.asarray(cb.validity, dtype=bool)
+        if not np.array_equal(va, vb):
+            return f"column {name}: validity differs"
+        da, db = np.asarray(ca.data), np.asarray(cb.data)
+        if da.dtype == object or db.dtype == object:
+            for i in range(a.num_rows):
+                if va[i] and da[i] != db[i]:
+                    return (f"column {name} row {i}: "
+                            f"{da[i]!r} != {db[i]!r}")
+        else:
+            # bit identity over VALID rows only: raw bytes so NaN
+            # payloads and signed zeros count (float equality would mask
+            # them); boolean row indexing also masks multi-dim layouts
+            # (decimal128 limb pairs), whose null slots are garbage
+            if da[va].tobytes() != db[vb].tobytes():
+                return f"column {name}: valid values differ bitwise"
+    return None
+
+
+#: per-query recovery-work ceilings the chaos run asserts (a runaway
+#: retry loop must fail the run, not grind through it)
+CHAOS_BOUNDS = {"fetch_retries": 500, "recomputed_maps": 200,
+                "query_replays": 12}
+
+
+def run_chaos(sf: float = 0.02, seed: int = 7, queries=None,
+              use_sql: bool = False):
+    """Fault-free run, then the seeded-fault run, per query; returns the
+    chaos report dict (and raises AssertionError on any divergence or
+    bound violation — callers in CI want the failure loud)."""
+    from spark_rapids_tpu.datagen import scale_test_specs
+    from spark_rapids_tpu.runtime.faults import (
+        CIRCUIT_BREAKER,
+        FAULTS,
+        RECOVERY,
+    )
+    from spark_rapids_tpu.session import TpuSession
+
+    specs = scale_test_specs(sf)
+    tables = {name: spec.generate_table(sf, seed=seed)
+              for name, spec in specs.items()}
+    build = build_sql_queries if use_sql else build_queries
+
+    baseline = TpuSession(chaos_conf(seed, faults=False))
+    chaotic = TpuSession(chaos_conf(seed, faults=True))
+    base_queries = build(baseline, tables)
+    chaos_queries = build(chaotic, tables)
+    wanted = queries or list(base_queries)
+
+    report = {"mode": "chaos", "seed": seed, "scale_factor": sf,
+              "fault_spec": chaos_fault_spec(seed), "queries": {}}
+    failures = []
+    # ALL fault-free runs first: each execute() re-arms the registry from
+    # its session's conf, and interleaving arm("")/arm(spec) would reset
+    # the seeded schedule every query — the RNG must advance ACROSS the
+    # corpus for the schedule to be randomized rather than cyclic
+    expected_tables = {name: base_queries[name]().collect_table()
+                       for name in wanted}
+    for name in wanted:
+        expected = expected_tables[name]
+        before = RECOVERY.snapshot()
+        fires_before = FAULTS.counters()
+        demoted_before = set(CIRCUIT_BREAKER.demoted_ops())
+        t0 = time.perf_counter()
+        got = chaos_queries[name]().collect_table()
+        elapsed = time.perf_counter() - t0
+        recovery = {k: v - before[k] for k, v in RECOVERY.snapshot().items()}
+        entry = {
+            "chaos_s": round(elapsed, 4),
+            "identical": None,
+            **recovery,
+            "demotions_total": len(CIRCUIT_BREAKER.demoted_ops()),
+            "newly_demoted": sorted(
+                set(CIRCUIT_BREAKER.demoted_ops()) - demoted_before),
+            # per-query delta, like every other field in this entry
+            "fault_fires": {
+                k: v - fires_before.get(k, 0)
+                for k, v in FAULTS.counters().items()
+                if v - fires_before.get(k, 0)},
+        }
+        diff = tables_differ(expected, got)
+        if diff is not None and CIRCUIT_BREAKER.demoted_ops():
+            # ANY active demotion (this query's or an earlier one's) can
+            # change float reduction order vs the pre-demotion device
+            # baseline (conf: variableFloatAgg). The breaker is
+            # process-wide, so re-collecting the BASELINE now runs it
+            # through the same demoted (CPU) plan — results must be
+            # bit-identical to THAT fault-free run of the same plan.
+            # suspended(): the baseline session's arm("") must not reset
+            # the seeded schedule mid-corpus (see the comment above).
+            with FAULTS.suspended():
+                redo = base_queries[name]().collect_table()
+            diff = tables_differ(redo, got)
+            entry["compared_vs_demoted_baseline"] = True
+        entry["identical"] = diff is None
+        if diff is not None:
+            failures.append(f"{name}: {diff}")
+        for field, bound in CHAOS_BOUNDS.items():
+            if recovery.get(field, 0) > bound:
+                failures.append(
+                    f"{name}: {field}={recovery[field]} exceeds the "
+                    f"chaos bound {bound}")
+        report["queries"][name] = entry
+        print(json.dumps({"query": name, **entry}))
+    report["demoted_ops"] = CIRCUIT_BREAKER.demoted_ops()
+    report["ok"] = not failures
+    report["failures"] = failures
+    FAULTS.disarm()
+    if failures:
+        raise AssertionError("chaos run failed:\n" + "\n".join(failures))
+    return report
+
+
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--sf", type=float, default=0.1)
+    ap.add_argument("--sf", type=float, default=None,
+                    help="scale factor (default 0.1; chaos mode defaults "
+                         "to 0.02 — it exercises recovery paths, not "
+                         "throughput)")
     ap.add_argument("--queries", type=str, default="")
     ap.add_argument("--cpu-baseline", action="store_true")
     ap.add_argument("--sql", action="store_true",
                     help="run the q1-q22 SQL-text forms through "
                          "session.sql() instead of the DataFrame DSL")
-    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--seed", type=int, default=None,
+                    help="datagen / fault-schedule seed (default 0; "
+                         "chaos mode defaults to 7)")
     ap.add_argument("--out", type=str, default="")
+    ap.add_argument("--chaos", action="store_true",
+                    help="run the corpus fault-free and under a seeded "
+                         "fault schedule, asserting bit-identical "
+                         "results and bounded recovery work")
     args = ap.parse_args()
+
+    if args.chaos:
+        wanted = [q.strip() for q in args.queries.split(",") if q.strip()]
+        report = run_chaos(sf=args.sf if args.sf is not None else 0.02,
+                           seed=args.seed if args.seed is not None else 7,
+                           queries=wanted or None, use_sql=args.sql)
+        print(json.dumps(report))
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(report, f, indent=1)
+        return
+    if args.sf is None:
+        args.sf = 0.1
+    if args.seed is None:
+        args.seed = 0
 
     from spark_rapids_tpu.datagen import scale_test_specs
     from spark_rapids_tpu.session import TpuSession

@@ -335,6 +335,57 @@ TEST_INJECT_RETRY_OOM = str_conf(
     "Nth device allocation (reference: RmmSpark.forceRetryOOM).",
     internal=True)
 
+TEST_FAULTS = str_conf(
+    "spark.rapids.test.faults", "",
+    "Test-only fault injection: semicolon-separated "
+    "'<point>[@<op>]:<kind>:<prob-or-count>[:<seed>]' entries armed on "
+    "the process-wide fault registry at execute() (runtime/faults.py; "
+    "the chaos-harness generalization of RmmSpark.forceRetryOOM). "
+    "Kinds: oom, crash, fetch, disconnect, corrupt, slow. A value in "
+    "(0,1) is a seeded per-hit probability; an integer N fires the "
+    "first N hits.", internal=True)
+
+SHUFFLE_FETCH_MAX_RETRIES = int_conf(
+    "spark.rapids.shuffle.fetch.maxRetries", 3,
+    "Retries per shuffle block fetch before the map output is declared "
+    "lost and recomputed from the retained plan lineage.")
+
+SHUFFLE_FETCH_RETRY_WAIT_MS = int_conf(
+    "spark.rapids.shuffle.fetch.retryWaitMs", 50,
+    "Initial backoff between shuffle fetch retries, in milliseconds.")
+
+SHUFFLE_FETCH_BACKOFF_MULT = float_conf(
+    "spark.rapids.shuffle.fetch.backoffMultiplier", 2.0,
+    "Multiplier applied to the fetch retry wait after each failed "
+    "attempt (exponential backoff).")
+
+SHUFFLE_CONNECT_TIMEOUT_MS = int_conf(
+    "spark.rapids.shuffle.fetch.connectTimeoutMs", 30000,
+    "Timeout for establishing a transport connection to a shuffle peer; "
+    "a timed-out connect counts as a retryable fetch failure against "
+    "that peer.")
+
+SHUFFLE_BOUNCE_ACQUIRE_TIMEOUT_MS = int_conf(
+    "spark.rapids.shuffle.p2p.bounceAcquireTimeoutMs", 60000,
+    "Default timeout waiting for a free bounce buffer; expiry raises a "
+    "retryable ShuffleFetchError instead of blocking forever when a "
+    "peer dies holding buffers.")
+
+RUNTIME_FALLBACK_ENABLED = bool_conf(
+    "spark.rapids.sql.runtimeFallback.enabled", True,
+    "Per-operator circuit breaker: after repeated non-OOM device "
+    "failures of the same operator the op is runtime-demoted to the CPU "
+    "fallback path for the rest of the ENGINE PROCESS — every session "
+    "sharing the device sees the demotion, like the speculation "
+    "blocklist, since the broken kernel is process-wide state (recorded "
+    "as a fallback reason in explain/planVerify). Disable to forbid "
+    "demotion — crashes then surface to the caller.")
+
+RUNTIME_FALLBACK_MAX_FAILURES = int_conf(
+    "spark.rapids.sql.runtimeFallback.maxFailures", 2,
+    "Non-OOM device failures of the same operator before the circuit "
+    "breaker demotes it to CPU.")
+
 METRICS_LEVEL = str_conf(
     "spark.rapids.sql.metrics.level", "MODERATE",
     "ESSENTIAL, MODERATE or DEBUG metric collection.")
@@ -579,5 +630,24 @@ def generate_docs() -> str:
         "production, `error` under the test suite); the CLI also "
         "verifies the TPC-H q1-q22 golden corpus in DSL and SQL form, "
         "with AQE on and off. `--list-rules` prints every rule id.",
+        "",
+        "## Fault tolerance",
+        "",
+        "The `spark.rapids.shuffle.fetch.*` keys govern shuffle fetch "
+        "retry with exponential backoff and per-peer exclusion; a fetch "
+        "that exhausts its retries (or a peer the driver evicts) triggers "
+        "lost-map-output RECOMPUTE from the retained plan lineage instead "
+        "of query failure. `spark.rapids.sql.runtimeFallback.*` governs "
+        "the per-operator circuit breaker: repeated non-OOM device "
+        "failures demote the op to the CPU fallback path for the rest of "
+        "the engine process (every session sharing the device — the "
+        "speculation-blocklist pattern), recorded as a fallback reason "
+        "in explain()/planVerify. Fault injection for all of this is "
+        "conf-driven "
+        "(`spark.rapids.test.faults`, internal) through named fault "
+        "points audited by the RL-FAULT-POINT lint rule; "
+        "`scale_test.py --chaos` runs TPC-H q1-q22 under a seeded fault "
+        "schedule asserting bit-identical results, and the `-m chaos` "
+        "pytest slice keeps a small seeded run in tier-1.",
     ]
     return "\n".join(lines) + "\n"

@@ -189,9 +189,12 @@ def tpu_jit(fn, **kwargs):
     name = getattr(fn, "__qualname__", getattr(fn, "__name__", "kernel"))
     profile = bool(os.environ.get("SRT_PROFILE_DISPATCH"))
 
+    from spark_rapids_tpu.runtime.faults import fault_point
+
     def call(*args, **kw):
         if not _trace_state_clean():
             return jf(*args, **kw)
+        fault_point("dispatch.kernel", op=name)
         count_dispatch()
         if not profile:
             return jf(*args, **kw)

@@ -43,6 +43,54 @@ class ColumnarProcessingError(RapidsTpuError):
     """An operator failed on device in a way that is not an OOM."""
 
 
+class KernelCrashError(ColumnarProcessingError):
+    """A device kernel failed with a non-OOM runtime fault (injected by the
+    chaos harness, or a real XLA INTERNAL-class failure re-raised with op
+    attribution). Carries ``fault_op`` — the plan-node class name of the
+    nearest enclosing operator — which feeds the runtime circuit breaker
+    (runtime/faults.py)."""
+
+    def __init__(self, message: str, fault_op=None):
+        super().__init__(message)
+        if fault_op is not None:
+            self.fault_op = fault_op
+
+
+class ShuffleFetchError(ColumnarProcessingError):
+    """A shuffle block fetch failed in a RETRYABLE way (peer error frame,
+    short transfer, bounce-pool exhaustion, injected fetch fault). The
+    fetch-retry loop (shuffle manager / p2p env) replays the fetch with
+    exponential backoff before declaring the map output lost."""
+
+
+class ShuffleTransportError(ShuffleFetchError):
+    """The transport connection itself failed (socket error, peer
+    disconnect, protocol desync). Retryable like a fetch error, but the
+    connection is evicted so the retry reconnects."""
+
+
+class CorruptFrameError(ShuffleFetchError):
+    """A serialized shuffle frame failed integrity checks (bad TPAK
+    magic/version, CRC mismatch, truncated buffer). Retryable: the source
+    of truth (catalog blob / shuffle file / upstream lineage) is intact,
+    so a refetch or recompute recovers."""
+
+
+class MapOutputLostError(RapidsTpuError):
+    """Shuffle map output is unreachable — a fetch exhausted its retries or
+    the owning peer was evicted. Carries ``executor_id`` (the lost peer,
+    '' when local) and ``map_ids`` (the missing map outputs; None =
+    unknown, recompute everything). The shuffle exchange catches this and
+    re-runs the missing upstream partitions from the retained plan
+    lineage instead of failing the query."""
+
+    def __init__(self, message: str, executor_id: str = "",
+                 map_ids=None):
+        super().__init__(message)
+        self.executor_id = executor_id
+        self.map_ids = None if map_ids is None else sorted(set(map_ids))
+
+
 class UnsupportedOnTpu(RapidsTpuError):
     """Raised when an operator/expression is asked to run on device but was
     tagged unsupported; indicates a bug in the plan-rewrite layer (normal

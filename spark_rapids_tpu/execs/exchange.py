@@ -18,8 +18,9 @@ from typing import List, Sequence
 
 from spark_rapids_tpu.columnar import DeviceTable, HostTable
 from spark_rapids_tpu.conf import RapidsConf
-from spark_rapids_tpu.errors import ColumnarProcessingError
+from spark_rapids_tpu.errors import ColumnarProcessingError, MapOutputLostError
 from spark_rapids_tpu.execs.base import TpuExec
+from spark_rapids_tpu.runtime.faults import RECOVERY
 from spark_rapids_tpu.ops.expr import Expression
 from spark_rapids_tpu.shuffle.manager import get_shuffle_manager
 from spark_rapids_tpu.shuffle.partitioning import (
@@ -317,6 +318,26 @@ class TpuShuffleExchangeExec(TpuExec):
             self.add_metric("shuffleBytesWritten", handle.bytes_written)
 
             reader = manager.reader(handle)
+
+            def read_one_partition(p: int) -> List[HostTable]:
+                """Buffer one reduce partition (the recovery unit: nothing
+                is emitted downstream until the partition read succeeded,
+                so a recompute-and-retry never double-counts rows). A lost
+                map output re-runs the missing upstream partitions from
+                the RETAINED PLAN LINEAGE (self.children[0]) instead of
+                failing the query."""
+                for attempt in range(3):
+                    bytes_before = reader.bytes_read
+                    try:
+                        return list(reader.read_partition(p))
+                    except MapOutputLostError as e:
+                        # a failed attempt's partial reads must not count
+                        # toward shuffleBytesRead (the retry re-reads them)
+                        reader.bytes_read = bytes_before
+                        if attempt == 2:
+                            raise
+                        self._recompute_maps(handle, partitioner, e.map_ids)
+
             t0 = perf_counter()
             # AQE partition coalescing (reference: AQE
             # CoalesceShufflePartitions / ShufflePartitionsUtil): with the
@@ -338,7 +359,7 @@ class TpuShuffleExchangeExec(TpuExec):
             emitted = 0
             for p in range(self.num_partitions):
                 saw_rows = False
-                for t in reader.read_partition(p):
+                for t in read_one_partition(p):
                     saw_rows = True
                     pending.append(t)
                     nb = t.nbytes()
@@ -376,6 +397,43 @@ class TpuShuffleExchangeExec(TpuExec):
             self.add_metric("shuffleBytesRead", reader.bytes_read)
         finally:
             manager.remove_shuffle(handle)
+
+    def _recompute_maps(self, handle, partitioner, map_ids) -> None:
+        """Lost-map-output recovery: re-run the child plan (map output i
+        is batch i — partitioning is deterministic, so the recomputed
+        blocks are byte-identical to the lost ones) and rewrite the
+        missing maps through the manager's write handle. ``map_ids`` None
+        means the loss scope is unknown: recompute every map once."""
+        wanted = None if map_ids is None else set(map_ids)
+        already = getattr(handle, "_recomputed_maps", set())
+        # a second loss report for maps we already rewrote means the
+        # rewrite itself is unreadable — recomputing again cannot
+        # converge, so let the MapOutputLostError surface on the next try
+        if wanted is None:
+            if getattr(handle, "_recomputed_all", False):
+                return
+            handle._recomputed_all = True
+        elif wanted <= already:
+            return
+        from spark_rapids_tpu.runtime.retry import retry_block
+        total_maps = len(handle.map_outputs)
+        rewritten = 0
+        for i, batch in enumerate(self.children[0].execute()):
+            if i >= total_maps:
+                break
+            if wanted is not None:
+                if wanted <= already:
+                    break  # everything lost is rewritten: stop re-running
+                if i not in wanted:
+                    continue
+            parts = split_by_partition(batch, partitioner)
+            # host-memory pressure retries like the original write path
+            retry_block(lambda i=i, p=parts: handle.rewrite_map(i, p))
+            already = already | {i}
+            rewritten += 1
+        handle._recomputed_maps = already
+        RECOVERY.bump("recomputed_maps", rewritten)
+        self.add_metric("recomputedMapOutputs", rewritten)
 
     @staticmethod
     def _upload(tables: List[HostTable]) -> DeviceTable:

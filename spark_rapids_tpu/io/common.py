@@ -363,6 +363,8 @@ class FileScanNode(PlanNode):
             FILECACHE_ENABLED,
             FILECACHE_MAX_BYTES,
         )
+        from spark_rapids_tpu.runtime.faults import fault_point
+        fault_point("io.read.file")
         if not self.conf.get_entry(FILECACHE_ENABLED):
             return self.read_file(path)
         return FILE_CACHE.get_or_decode(

@@ -35,10 +35,12 @@ def write_partitioned(table: HostTable, path: str,
                       partition_by: Optional[Sequence[str]] = None,
                       ) -> List[str]:
     """Route rows to files; returns the list of files written."""
+    from spark_rapids_tpu.runtime.faults import fault_point
     os.makedirs(path, exist_ok=True)
     written: List[str] = []
     if not partition_by:
         out = os.path.join(path, f"part-00000.{extension}")
+        fault_point("io.write.file")
         write_one(table, out)
         return [out]
 

@@ -70,6 +70,10 @@ RULES: Dict[str, str] = {
     "RL-NONDETERMINISM": "wall-clock or unseeded randomness inside a "
                          "kernel module",
     "RL-DEAD-LAMBDA": "lambda bound to a name that is never used",
+    "RL-FAULT-POINT": "fault-point registry and fault_point() call sites "
+                      "out of sync (unregistered name, non-literal name, "
+                      "registered point with no site, or site outside "
+                      "its registered module)",
 }
 
 

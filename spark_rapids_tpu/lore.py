@@ -102,6 +102,12 @@ def _strip_for_pickle(exec_obj):
                 setattr(clone, a, None if a != "metrics" else {})
             except AttributeError:
                 pass
+    # fault-boundary wrappers (runtime/faults.install_fault_boundaries)
+    # are instance-attribute closures: unpicklable, and a replayed exec
+    # wants the plain class methods anyway. DELETE (not None) so the
+    # class methods resurface.
+    for a in ("execute", "execute_masked", "_fault_guarded"):
+        clone.__dict__.pop(a, None)
     # children are replaced by scans at replay; drop them from the pickle
     if hasattr(clone, "children"):
         clone.children = ()

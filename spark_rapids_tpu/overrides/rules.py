@@ -952,8 +952,16 @@ class PlanMeta:
 
     def tag(self):
         rule = _EXEC_RULES.get(type(self.node))
+        # runtime circuit breaker (runtime/faults.py): an op demoted after
+        # repeated non-OOM device failures falls back like any other
+        # tagged reason, so explain()/planVerify surface WHY it's on CPU
+        from spark_rapids_tpu.conf import RUNTIME_FALLBACK_ENABLED
+        from spark_rapids_tpu.runtime.faults import CIRCUIT_BREAKER
+        demoted = CIRCUIT_BREAKER.demotion_reason(type(self.node).__name__)
         if rule is None:
             self.reasons.append(f"exec {self.node.name} is not supported on TPU")
+        elif demoted and self.conf.get_entry(RUNTIME_FALLBACK_ENABLED):
+            self.reasons.append(demoted)
         elif not self.conf.is_op_enabled("exec", type(self.node).__name__):
             self.reasons.append(f"exec {self.node.name} is disabled by conf")
         else:
@@ -994,7 +1002,12 @@ def _convert(meta: PlanMeta):
                 dev_children.append(cc)
             else:
                 dev_children.append(HostToDevice(cc))
-        return rule.convert_fn(meta.node, dev_children, meta.conf)
+        out = rule.convert_fn(meta.node, dev_children, meta.conf)
+        # runtime-failure attribution unit (runtime/faults.py): the
+        # plan-node class this exec tree was converted from — what the
+        # circuit breaker demotes and PlanMeta.tag re-checks
+        out._plan_origin = type(meta.node).__name__
+        return out
     # CPU node: children must be host-side
     host_children = []
     for cc, cm in zip(converted_children, meta.children):

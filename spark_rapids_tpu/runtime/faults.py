@@ -1,0 +1,430 @@
+"""Unified fault injection + runtime recovery bookkeeping.
+
+Reference: the resilience machinery is scattered in the reference —
+``RmmSpark.forceRetryOOM`` injects OOM per thread (SURVEY §2.5),
+``RapidsShuffleHeartbeatManager`` evicts dead peers (§2.6), and
+``onTaskFailed`` handles fatal errors — but each fault class has its own
+ad-hoc test hook. This module unifies them: one conf-driven registry of
+NAMED fault points (``spark.rapids.test.faults``) threaded through
+dispatch, exec execute paths, the shuffle client/server/transport and the
+io readers/writers, each armed with a deterministic seeded schedule and a
+per-point fire counter, plus the recovery-side state the engine consults:
+
+* ``FAULTS`` — the process-wide :class:`FaultRegistry`; sites call
+  :func:`fault_point` (the greppable marker the RL-FAULT-POINT lint rule
+  audits against :data:`FAULT_POINTS`).
+* ``RECOVERY`` — counters for every recovery action (fetch retries, peer
+  exclusions, map recomputes, circuit-breaker demotions, query replays)
+  so chaos runs can assert bounded retry counts.
+* ``CIRCUIT_BREAKER`` — per-operator non-OOM failure counts; after
+  ``spark.rapids.sql.runtimeFallback.maxFailures`` failures of the same
+  op it is demoted to the CPU fallback path for the rest of the session
+  (surfaced as a fallback reason through PlanMeta/explain).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from contextlib import contextmanager
+from typing import Dict, List, Optional
+
+from spark_rapids_tpu.errors import (
+    ColumnarProcessingError,
+    KernelCrashError,
+    RetryOOM,
+    ShuffleFetchError,
+    ShuffleTransportError,
+)
+
+#: injectable fault kinds and the failure each simulates
+FAULT_KINDS = (
+    "oom",         # device allocation failure (RetryOOM; the retry framework survives it)
+    "crash",       # non-OOM kernel failure (KernelCrashError; circuit breaker territory)
+    "fetch",       # shuffle block fetch failure (ShuffleFetchError; fetch-retry loop)
+    "disconnect",  # transport connection drop (ShuffleTransportError; reconnect + retry)
+    "corrupt",     # bit-flip a data frame (CRC catches it; refetch recovers)
+    "slow",        # slow peer / stall (sleep; exercises timeouts without failing)
+)
+
+#: registered fault points: name -> (module that hosts the call site, doc).
+#: The RL-FAULT-POINT repo-lint rule asserts every entry here names an
+#: existing ``fault_point("<name>")`` call in that module and that no call
+#: site uses an unregistered name.
+FAULT_POINTS: Dict[str, tuple] = {
+    "dispatch.kernel": (
+        "spark_rapids_tpu/dispatch.py",
+        "before each jitted kernel dispatch"),
+    "exec.execute": (
+        "spark_rapids_tpu/runtime/faults.py",
+        "at each device exec's execute()/execute_masked() boundary "
+        "(installed by install_fault_boundaries; carries op context)"),
+    "shuffle.fetch.metadata": (
+        "spark_rapids_tpu/shuffle/client_server.py",
+        "client metadata round trip"),
+    "shuffle.fetch.stream": (
+        "spark_rapids_tpu/shuffle/client_server.py",
+        "client block reassembly (corrupt applies to completed blocks)"),
+    "shuffle.transport.request": (
+        "spark_rapids_tpu/shuffle/transport.py",
+        "transport request channel"),
+    "shuffle.transport.stream": (
+        "spark_rapids_tpu/shuffle/transport.py",
+        "transport data-window stream (corrupt flips window bytes)"),
+    "shuffle.read.partition": (
+        "spark_rapids_tpu/shuffle/manager.py",
+        "multithreaded manager per-map segment read"),
+    "shuffle.write.map": (
+        "spark_rapids_tpu/shuffle/manager.py",
+        "multithreaded manager map-output write"),
+    "io.read.file": (
+        "spark_rapids_tpu/io/common.py",
+        "file-source per-file decode"),
+    "io.write.file": (
+        "spark_rapids_tpu/io/writer.py",
+        "partitioned writer per-file write"),
+}
+
+_SLOW_SLEEP_S = 0.05
+
+
+class _ArmedFault:
+    """One armed '<point>[@<op>]:<kind>:<prob-or-count>[:<seed>]' entry."""
+
+    __slots__ = ("point", "op", "kind", "prob", "remaining", "rng", "fired")
+
+    def __init__(self, point: str, op: Optional[str], kind: str,
+                 prob: Optional[float], count: Optional[int], seed: int):
+        self.point = point
+        self.op = op
+        self.kind = kind
+        self.prob = prob
+        self.remaining = count
+        self.rng = random.Random(seed)
+        self.fired = 0
+
+    def should_fire(self) -> bool:
+        if self.remaining is not None:
+            if self.remaining <= 0:
+                return False
+            self.remaining -= 1
+            return True
+        return self.rng.random() < (self.prob or 0.0)
+
+
+def parse_fault_spec(spec: str) -> List[_ArmedFault]:
+    """Parse the ``spark.rapids.test.faults`` value. Raises on unknown
+    points/kinds so a typo'd chaos schedule fails loudly, not silently."""
+    out: List[_ArmedFault] = []
+    for i, entry in enumerate(e.strip() for e in spec.split(";")):
+        if not entry:
+            continue
+        parts = entry.split(":")
+        if len(parts) not in (3, 4):
+            raise ColumnarProcessingError(
+                f"bad fault spec entry {entry!r} (want "
+                "<point>[@<op>]:<kind>:<prob-or-count>[:<seed>])")
+        target, kind, amount = parts[0], parts[1].lower(), parts[2]
+        point, _, op = target.partition("@")
+        if point not in FAULT_POINTS:
+            raise ColumnarProcessingError(
+                f"unknown fault point {point!r} (known: "
+                f"{', '.join(sorted(FAULT_POINTS))})")
+        if kind not in FAULT_KINDS:
+            raise ColumnarProcessingError(
+                f"unknown fault kind {kind!r} (known: "
+                f"{', '.join(FAULT_KINDS)})")
+        prob = count = None
+        if "." in amount:
+            prob = float(amount)
+            if not 0.0 < prob <= 1.0:
+                raise ColumnarProcessingError(
+                    f"fault probability {prob} outside (0, 1]")
+        else:
+            count = int(amount)
+            if count < 1:
+                raise ColumnarProcessingError(
+                    f"fault count {count} must be >= 1")
+        seed = int(parts[3]) if len(parts) == 4 else i
+        out.append(_ArmedFault(point, op or None, kind, prob, count, seed))
+    return out
+
+
+class FaultRegistry:
+    """Process-wide armed faults + per-point fire counters."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._armed: List[_ArmedFault] = []
+        self._spec = ""
+        self._counters: Dict[str, int] = {}
+
+    def arm(self, spec: str) -> None:
+        """(Re-)arm from a spec string. Re-arming the SAME spec is a no-op
+        so per-query execute() calls don't reset seeded schedules or
+        counters mid-session; a different spec replaces everything."""
+        with self._lock:
+            if spec == self._spec:
+                return
+            self._spec = spec
+            self._armed = parse_fault_spec(spec) if spec else []
+            self._counters = {}
+
+    def disarm(self) -> None:
+        with self._lock:
+            self._spec = ""
+            self._armed = []
+            self._counters = {}
+
+    @contextmanager
+    def suspended(self):
+        """Temporarily disarm WITHOUT losing the armed entries' RNG
+        state or counters — for a fault-free interlude (e.g. the chaos
+        harness re-collecting a baseline) inside a seeded run whose
+        schedule must keep advancing, not reset."""
+        with self._lock:
+            saved = (self._spec, self._armed, self._counters)
+            self._spec, self._armed, self._counters = "", [], {}
+        try:
+            yield
+        finally:
+            with self._lock:
+                self._spec, self._armed, self._counters = saved
+
+    @property
+    def armed(self) -> bool:
+        return bool(self._armed)
+
+    def counters(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._counters)
+
+    def fire(self, point: str, op: Optional[str] = None, data=None):
+        """Evaluate every armed entry matching ``point`` (and ``op`` when
+        the entry carries an @op filter). Raises the matched kind's
+        exception; ``corrupt`` instead returns a damaged copy of
+        ``data``; ``slow`` sleeps. Returns ``data`` (possibly corrupted)
+        so corruption-capable sites can write ``data = fault_point(...,
+        data=data)``."""
+        if not self._armed:
+            return data
+        with self._lock:
+            hits = [a for a in self._armed
+                    if a.point == point
+                    and (a.op is None or a.op == op)
+                    # corruption needs bytes to corrupt: a data-less call
+                    # at the same point must not consume the schedule
+                    and (a.kind != "corrupt" or data is not None)
+                    and a.should_fire()]
+            for a in hits:
+                a.fired += 1
+                key = a.point if a.op is None else f"{a.point}@{a.op}"
+                self._counters[key] = self._counters.get(key, 0) + 1
+        for a in hits:
+            where = point if op is None else f"{point}[{op}]"
+            if a.kind == "oom":
+                raise RetryOOM(f"injected device OOM at {where}")
+            if a.kind == "crash":
+                # no fault_op here: attribution is the exec fault guards'
+                # job (_tag_fault_op), so the breaker only ever counts
+                # PLAN-NODE names — a crash injected at a helper exec or
+                # kernel propagates to the nearest rule-rooted ancestor
+                raise KernelCrashError(f"injected kernel crash at {where}")
+            if a.kind == "fetch":
+                raise ShuffleFetchError(f"injected fetch error at {where}")
+            if a.kind == "disconnect":
+                raise ShuffleTransportError(
+                    f"injected transport disconnect at {where}")
+            if a.kind == "slow":
+                time.sleep(_SLOW_SLEEP_S)
+            elif a.kind == "corrupt" and data is not None and len(data):
+                buf = bytearray(data)
+                pos = a.rng.randrange(len(buf))
+                buf[pos] ^= 0xFF
+                data = bytes(buf)
+        return data
+
+
+FAULTS = FaultRegistry()
+
+
+def fault_point(name: str, op: Optional[str] = None, data=None):
+    """THE site marker for injectable faults. Every call names a point
+    registered in :data:`FAULT_POINTS` (the RL-FAULT-POINT lint rule
+    audits both directions). Disarmed cost is one attribute read."""
+    if not FAULTS._armed:
+        return data
+    return FAULTS.fire(name, op=op, data=data)
+
+
+# ---------------------------------------------------------------------------
+# Recovery accounting
+# ---------------------------------------------------------------------------
+
+
+class RecoveryStats:
+    """Process-wide counters for every recovery action the engine takes;
+    chaos runs snapshot/diff these to report and bound recovery work."""
+
+    FIELDS = ("fetch_retries", "peer_exclusions", "recomputed_maps",
+              "demotions", "query_replays")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts = {f: 0 for f in self.FIELDS}
+
+    def bump(self, field: str, n: int = 1) -> None:
+        with self._lock:
+            self._counts[field] += n  # KeyError = typo'd field, fail loud
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._counts)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counts = {f: 0 for f in self.FIELDS}
+
+
+RECOVERY = RecoveryStats()
+
+
+def backoff_retry(fn, *, max_retries: int, wait_s: float,
+                  backoff_mult: float, retryable, on_failure=None):
+    """THE exponential-backoff retry loop both shuffle read paths share
+    (p2p peer fetches and the multithreaded manager's file reads —
+    one policy, one accounting site). Each failure bumps
+    RECOVERY.fetch_retries and calls ``on_failure(exc, attempt)``; a
+    truthy return stops retrying immediately (e.g. a chronic-flakiness
+    budget). On exhaustion the LAST exception re-raises — callers wrap
+    it in MapOutputLostError with their own context."""
+    attempt = 0
+    wait = wait_s
+    while True:
+        try:
+            return fn()
+        except retryable as e:
+            attempt += 1
+            RECOVERY.bump("fetch_retries")
+            stop = on_failure(e, attempt) if on_failure is not None else False
+            if stop or attempt > max_retries:
+                raise
+            time.sleep(wait)
+            wait *= backoff_mult
+
+
+# ---------------------------------------------------------------------------
+# Per-operator circuit breaker
+# ---------------------------------------------------------------------------
+
+
+class CircuitBreaker:
+    """After N repeated non-OOM device failures of the same operator, the
+    op is demoted to the CPU fallback path — PROCESS-WIDE, like the
+    speculation blocklist: a kernel that crashes the shared device is
+    broken for every session in this engine process, so all of them see
+    the demotion until reset(). Keys are PLAN-NODE class names (the unit
+    the overrides layer falls back at); the demotion reason feeds
+    PlanMeta.reasons so explain() and the plan verifier's
+    fallback-hygiene rule surface it."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._failures: Dict[str, int] = {}
+        self._reasons: Dict[str, str] = {}
+
+    def record_failure(self, op: str, exc: BaseException,
+                       max_failures: int) -> bool:
+        """Count one failure of ``op``; returns True when this failure
+        crossed the threshold and demoted the op."""
+        first_line = str(exc).splitlines()[0] if str(exc) else type(exc).__name__
+        with self._lock:
+            if op in self._reasons:
+                return False
+            n = self._failures.get(op, 0) + 1
+            self._failures[op] = n
+            if n < max_failures:
+                return False
+            self._reasons[op] = (
+                f"runtime circuit breaker: demoted to CPU after {n} device "
+                f"failures (last: {type(exc).__name__}: {first_line})")
+        RECOVERY.bump("demotions")
+        return True
+
+    def demotion_reason(self, op: str) -> Optional[str]:
+        with self._lock:
+            return self._reasons.get(op)
+
+    def demoted_ops(self) -> Dict[str, str]:
+        with self._lock:
+            return dict(self._reasons)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._failures = {}
+            self._reasons = {}
+
+
+CIRCUIT_BREAKER = CircuitBreaker()
+
+
+# ---------------------------------------------------------------------------
+# Exec fault boundaries (op attribution for crashes + the exec.execute
+# injection point)
+# ---------------------------------------------------------------------------
+
+
+def _tag_fault_op(exc: BaseException, op: str) -> None:
+    """Attach op attribution to a demotable failure. Innermost exec wins
+    (the first wrapper the exception crosses sets it); OOMs are excluded
+    — the retry framework owns those."""
+    from spark_rapids_tpu.runtime.crash_handler import is_fatal_device_error
+    from spark_rapids_tpu.runtime.retry import is_device_oom
+    if getattr(exc, "fault_op", None) is not None:
+        return
+    if is_device_oom(exc):
+        return
+    if isinstance(exc, KernelCrashError) or is_fatal_device_error(exc):
+        exc.fault_op = op
+
+
+def _guard(fn, op: str, tag: bool):
+    def wrapped(*args, **kwargs):
+        try:
+            # inside the try: an injected crash at THIS exec's own
+            # boundary gets tagged by this wrapper (the root exec has no
+            # ancestor wrapper to do it)
+            fault_point("exec.execute", op=op)
+            for batch in fn(*args, **kwargs):
+                yield batch
+        except Exception as exc:
+            if tag:
+                _tag_fault_op(exc, op)
+            raise
+    return wrapped
+
+
+def install_fault_boundaries(executable) -> None:
+    """Wrap every device exec's execute()/execute_masked() in the
+    converted tree with (a) the ``exec.execute`` fault point and (b)
+    op attribution for non-OOM device failures, feeding the circuit
+    breaker. Idempotent per exec instance (plans are re-executed)."""
+    from spark_rapids_tpu.execs.base import TpuExec
+    from spark_rapids_tpu.lore import _iter_tree
+    for e in _iter_tree(executable):
+        if not isinstance(e, TpuExec) or getattr(e, "_fault_guarded", False):
+            continue
+        e._fault_guarded = True
+        # attribution unit: the PLAN-NODE class this exec was converted
+        # from (set by overrides/rules._convert — the granularity the
+        # overrides layer can fall back at). Helper execs a convert
+        # function builds (coalesce wrappers etc.) carry no origin: they
+        # fire the injection point under their own class name but leave
+        # tagging to the nearest rule-rooted ancestor the exception
+        # crosses, so the breaker only ever counts demotable names.
+        origin = getattr(e, "_plan_origin", None)
+        op = origin or type(e).__name__
+        e.execute = _guard(e.execute, op, tag=origin is not None)
+        e.execute_masked = _guard(e.execute_masked, op,
+                                  tag=origin is not None)

@@ -151,6 +151,57 @@ class TpuSession:
 
     # -- execution ----------------------------------------------------------
     def execute(self, plan: P.PlanNode) -> HostTable:
+        """Plan, verify, and drain a query — wrapped in the runtime
+        circuit breaker: a non-OOM device failure (kernel crash, fatal
+        XLA error) replays the query, and once the same operator fails
+        spark.rapids.sql.runtimeFallback.maxFailures times it is demoted
+        to the CPU fallback path for the rest of the session (the replay
+        re-plans, so the demotion takes effect immediately). OOMs never
+        come through here — the retry framework owns those."""
+        from spark_rapids_tpu.conf import (
+            RUNTIME_FALLBACK_ENABLED,
+            RUNTIME_FALLBACK_MAX_FAILURES,
+            TEST_FAULTS,
+        )
+        from spark_rapids_tpu.errors import KernelCrashError
+        from spark_rapids_tpu.runtime import faults as F
+        from spark_rapids_tpu.runtime.crash_handler import (
+            handle_fatal,
+            is_fatal_device_error,
+        )
+
+        F.FAULTS.arm(str(self.conf.get_entry(TEST_FAULTS) or ""))
+        rf_enabled = bool(self.conf.get_entry(RUNTIME_FALLBACK_ENABLED))
+        max_failures = int(self.conf.get_entry(RUNTIME_FALLBACK_MAX_FAILURES))
+        # enough budget to demote every op in a pathological plan without
+        # ever replaying unboundedly on an unattributable crash
+        max_replays = 4 * max_failures + 4
+        replays = 0
+        while True:
+            try:
+                result = self._execute_attempt(plan)
+                self.last_fault_replays = replays
+                if replays and hasattr(self._last_executable, "metrics"):
+                    self._last_executable.metrics["runtimeFaultReplays"] = \
+                        replays
+                return result
+            except Exception as exc:
+                demotable = isinstance(exc, KernelCrashError) or \
+                    is_fatal_device_error(exc)
+                if not rf_enabled or not demotable or replays >= max_replays:
+                    if is_fatal_device_error(exc):
+                        ex = getattr(self, "_last_executable", None)
+                        handle_fatal(exc, self.conf,
+                                     plan_description=ex.tree_string()
+                                     if ex is not None else "")
+                    raise
+                op = getattr(exc, "fault_op", None)
+                if op is not None:
+                    F.CIRCUIT_BREAKER.record_failure(op, exc, max_failures)
+                replays += 1
+                F.RECOVERY.bump("query_replays")
+
+    def _execute_attempt(self, plan: P.PlanNode) -> HostTable:
         from spark_rapids_tpu.conf import RETRY_OOM_MAX_RETRIES, TEST_INJECT_RETRY_OOM
         from spark_rapids_tpu.runtime import RMM_TPU, TpuSemaphore, acquired
         from spark_rapids_tpu.runtime.retry import MAX_RETRIES_VAR
@@ -194,6 +245,10 @@ class TpuSession:
         from spark_rapids_tpu import lore
         lore.assign_lore_ids(executable)
         lore.install_dumpers(executable, self.conf)
+        # fault boundaries: the exec.execute injection point + op
+        # attribution for non-OOM device failures (circuit breaker input)
+        from spark_rapids_tpu.runtime.faults import install_fault_boundaries
+        install_fault_boundaries(executable)
         self._last_executable = executable
 
         inject = str(self.conf.get_entry(TEST_INJECT_RETRY_OOM) or "")
@@ -221,15 +276,6 @@ class TpuSession:
             self.last_dispatches = dispatch_count()
             if hasattr(executable, "metrics"):
                 executable.metrics["dispatches"] = self.last_dispatches
-        except Exception as exc:
-            from spark_rapids_tpu.runtime.crash_handler import (
-                handle_fatal,
-                is_fatal_device_error,
-            )
-            if is_fatal_device_error(exc):
-                handle_fatal(exc, self.conf,
-                             plan_description=executable.tree_string())
-            raise
         finally:
             MAX_RETRIES_VAR.reset(token)
         if not batches:

@@ -17,7 +17,8 @@ from __future__ import annotations
 import struct
 from typing import Iterator, List, Optional, Tuple
 
-from spark_rapids_tpu.errors import ColumnarProcessingError
+from spark_rapids_tpu.errors import ColumnarProcessingError, ShuffleFetchError
+from spark_rapids_tpu.runtime.faults import FAULTS, fault_point
 from spark_rapids_tpu.shuffle.catalogs import (
     BlockId,
     ShuffleBufferCatalog,
@@ -162,11 +163,14 @@ class ShuffleClient:
     def fetch_metadata(self, shuffle_id: int, partition_id: int,
                        map_ids: Optional[List[int]] = None
                        ) -> List[Tuple[BlockId, int]]:
+        fault_point("shuffle.fetch.metadata")
         tx = self.connection.request(
             MSG_METADATA_REQ,
             encode_metadata_request(shuffle_id, partition_id, map_ids))
         if tx.status != TX_SUCCESS:
-            raise ColumnarProcessingError(
+            # retryable: the peer may be transiently overloaded or the
+            # connection desynced — the fetch-retry loop reconnects
+            raise ShuffleFetchError(
                 f"metadata fetch failed: {tx.error_message}")
         return decode_block_list(tx.payload)
 
@@ -200,13 +204,21 @@ class ShuffleClient:
                 state["block_filled"] += take
                 consumed += take
                 if state["block_filled"] == length:
-                    received.add(blocks[i][0], bytes(state["buf"]))
+                    blob = bytes(state["buf"])
+                    if FAULTS.armed:
+                        # corrupt kind damages the completed block; the
+                        # TPAK CRC catches it at deserialization and the
+                        # fetch retries
+                        blob = fault_point("shuffle.fetch.stream",
+                                           data=blob)
+                    received.add(blocks[i][0], blob)
                     state["next_block"] += 1
                     state["block_filled"] = 0
                     if state["next_block"] < len(blocks):
                         state["buf"] = bytearray(
                             blocks[state["next_block"]][1])
 
+        fault_point("shuffle.fetch.stream")
         tx = self.connection.stream(
             MSG_TRANSFER_REQ,
             encode_transfer_request(self.window_size,
@@ -214,11 +226,11 @@ class ShuffleClient:
             on_window)
         if tx.status != TX_SUCCESS:
             received.fail(tx.error_message or "transfer failed")
-            raise ColumnarProcessingError(
+            raise ShuffleFetchError(
                 f"block transfer failed: {tx.error_message}")
         if state["next_block"] != len(blocks):
             received.fail("short transfer")
-            raise ColumnarProcessingError(
+            raise ShuffleFetchError(
                 f"short transfer: {state['next_block']}/{len(blocks)} blocks")
 
     def fetch_partition(self, shuffle_id: int, partition_id: int,

@@ -91,15 +91,23 @@ class ShuffleHeartbeatManager:
 class ShuffleHeartbeatEndpoint:
     """Executor side: registers, then heartbeats on a background thread,
     handing freshly discovered peers to ``on_new_peer`` (which typically
-    pre-connects the transport)."""
+    pre-connects the transport).
+
+    A beat rejected because the driver evicted us (a paused-then-resumed
+    executor misses its heartbeat window) invokes ``on_evicted``; the
+    default re-registers so the executor REJOINS the mesh instead of
+    going permanently deaf with its heartbeat thread dead."""
 
     def __init__(self, manager: ShuffleHeartbeatManager, me: PeerInfo,
                  on_new_peer: Callable[[PeerInfo], None],
-                 interval_s: float = 5.0):
+                 interval_s: float = 5.0,
+                 on_evicted: Optional[Callable[[], None]] = None):
         self.manager = manager
         self.me = me
         self.on_new_peer = on_new_peer
+        self.on_evicted = on_evicted if on_evicted is not None else self.rejoin
         self.interval_s = interval_s
+        self.evicted_count = 0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         for peer in manager.register_executor(me):
@@ -117,12 +125,24 @@ class ShuffleHeartbeatEndpoint:
         for peer in self.manager.heartbeat(self.me.executor_id):
             self.on_new_peer(peer)
 
+    def rejoin(self):
+        """Default eviction response: re-register with the driver (the
+        existing peers come back in the reply) and keep beating."""
+        for peer in self.manager.register_executor(self.me):
+            self.on_new_peer(peer)
+
+    def beat_or_recover(self):
+        """One heartbeat; a driver-forgot-us rejection triggers the
+        eviction callback instead of being swallowed."""
+        try:
+            self.beat_once()
+        except ColumnarProcessingError:
+            self.evicted_count += 1
+            self.on_evicted()
+
     def _loop(self):
         while not self._stop.wait(self.interval_s):
-            try:
-                self.beat_once()
-            except ColumnarProcessingError:
-                return  # driver forgot us (eviction); stop beating
+            self.beat_or_recover()
 
     def close(self):
         self._stop.set()

@@ -28,10 +28,19 @@ from spark_rapids_tpu.columnar import HostTable
 from spark_rapids_tpu.conf import (
     RapidsConf,
     SHUFFLE_COMPRESSION_CODEC,
+    SHUFFLE_FETCH_BACKOFF_MULT,
+    SHUFFLE_FETCH_MAX_RETRIES,
+    SHUFFLE_FETCH_RETRY_WAIT_MS,
     SHUFFLE_MT_READER_THREADS,
     SHUFFLE_MT_WRITER_THREADS,
 )
-from spark_rapids_tpu.errors import ColumnarProcessingError
+from spark_rapids_tpu.errors import (
+    ColumnarProcessingError,
+    CorruptFrameError,
+    MapOutputLostError,
+    ShuffleFetchError,
+)
+from spark_rapids_tpu.runtime.faults import backoff_retry, fault_point
 from spark_rapids_tpu.shuffle.serializer import pack_table, unpack_table
 
 
@@ -93,6 +102,34 @@ def _decompress(codec: str, data: bytes) -> bytes:
     raise ColumnarProcessingError(f"unresolved shuffle codec {codec}")
 
 
+def _codec_errors() -> tuple:
+    """Exception types a codec raises on CORRUPT input (zlib.error for
+    zlib/the degraded paths, ZstdError when zstandard is present,
+    ValueError for malformed lz4 framing). Deliberately narrow: a
+    programming bug (TypeError, AttributeError) must surface, not burn
+    retries and a recompute storm masquerading as data corruption."""
+    errors = (zlib.error, ValueError)
+    z = _zstd()
+    if z is not None:
+        errors += (z.ZstdError,)
+    return errors
+
+
+def decode_blob(codec: str, blob) -> HostTable:
+    """Decompress + unpack one shuffle blob, normalizing every CORRUPTION
+    signal to the retryable CorruptFrameError. For compressed blobs the
+    codec error is the ONLY corruption signal — the TPAK CRC sits under
+    the compression — so it must not escape the fetch-retry loops as a
+    query-fatal exception."""
+    try:
+        raw = _decompress(codec, blob)
+    except _codec_errors() as e:
+        raise CorruptFrameError(
+            f"corrupt compressed shuffle blob (codec {codec}): {e}") from e
+    table, _ = unpack_table(raw)  # CRC-checked; raises CorruptFrameError
+    return table
+
+
 @dataclass
 class MapOutput:
     data_path: str
@@ -131,63 +168,140 @@ class ShuffleWriteHandle:
             raise
         try:
             map_id = len(self.map_outputs)
-            path = os.path.join(self.workdir,
-                                f"shuffle_{self.shuffle_id}_{map_id}.data")
-            offsets = [0]
-            with open(path, "wb") as f:
-                for b in blobs:
-                    f.write(b)
-                    offsets.append(offsets[-1] + len(b))
-            out = MapOutput(path, offsets)
+            out = self._write_map_file(map_id, blobs)
             self.map_outputs.append(out)
-            self.bytes_written += offsets[-1]
+            self.bytes_written += out.offsets[-1]
             return out
         finally:
             grant.release()
 
+    def _write_map_file(self, map_id: int, blobs, revision: int = 0
+                        ) -> MapOutput:
+        fault_point("shuffle.write.map")
+        suffix = f"_r{revision}" if revision else ""
+        path = os.path.join(
+            self.workdir,
+            f"shuffle_{self.shuffle_id}_{map_id}{suffix}.data")
+        offsets = [0]
+        with open(path, "wb") as f:
+            for b in blobs:
+                f.write(b)
+                offsets.append(offsets[-1] + len(b))
+        return MapOutput(path, offsets)
+
+    def rewrite_map(self, map_id: int, partitions: List[HostTable]
+                    ) -> MapOutput:
+        """Recompute path: replace one LOST/CORRUPT map output with a
+        freshly serialized copy (written to a new revisioned file so
+        readers never see a half-rewritten file)."""
+        if not 0 <= map_id < len(self.map_outputs):
+            raise ColumnarProcessingError(
+                f"cannot rewrite unknown map output {map_id}")
+        if len(partitions) != self.num_partitions:
+            raise ColumnarProcessingError("partition count mismatch")
+        # same host-memory grant as write_partitions: recovery runs when
+        # the system is already degraded, so it must not overcommit the
+        # arbiter's budget either
+        from spark_rapids_tpu.runtime.host_alloc import HostMemoryArbiter
+        codec = self.codec
+        grant = HostMemoryArbiter.get().alloc(
+            sum(t.nbytes() for t in partitions))
+        try:
+            blobs = list(self.pool.map(
+                lambda t: _compress(codec, pack_table(t)), partitions))
+            old = self.map_outputs[map_id]
+            revision = 1
+            if "_r" in os.path.basename(old.data_path):
+                revision = 1 + int(
+                    os.path.basename(old.data_path).rsplit("_r", 1)[1]
+                    .split(".")[0])
+            out = self._write_map_file(map_id, blobs, revision)
+        finally:
+            grant.release()
+        self.map_outputs[map_id] = out
+        try:
+            os.unlink(old.data_path)
+        except OSError:
+            pass
+        return out
+
 
 class ShuffleReadHandle:
     def __init__(self, handle: ShuffleWriteHandle, codec: str,
-                 pool: cf.ThreadPoolExecutor):
+                 pool: cf.ThreadPoolExecutor,
+                 max_retries: int = 3, retry_wait_s: float = 0.05,
+                 backoff_mult: float = 2.0):
         self.write_handle = handle
         self.codec = codec
         self.pool = pool
         self.bytes_read = 0
+        self.max_retries = max_retries
+        self.retry_wait_s = retry_wait_s
+        self.backoff_mult = backoff_mult
+        self.retry_count = 0
+
+    def _fetch_segment(self, mo: MapOutput, p: int):
+        fault_point("shuffle.read.partition")
+        start, end = mo.offsets[p], mo.offsets[p + 1]
+        if end <= start:
+            return None, 0
+        size = end - start
+        # pinned staging for the compressed read (PinnedMemoryPool):
+        # safe only when a decompression copy follows — the codec
+        # "none" path would alias the reusable buffer
+        pinned = None
+        if self.codec != "none":
+            from spark_rapids_tpu.runtime.host_alloc import (
+                PinnedMemoryPool,
+            )
+            pool = PinnedMemoryPool.get()
+            pinned = pool.acquire(size) if pool is not None else None
+        try:
+            with open(mo.data_path, "rb") as f:
+                f.seek(start)
+                if pinned is not None:
+                    blob = memoryview(pinned)[:size]
+                    f.readinto(blob)
+                else:
+                    blob = f.read(size)
+            # decode INSIDE the pinned scope (decompression copies out);
+            # decode_blob normalizes codec errors + CRC mismatches to
+            # the retryable CorruptFrameError
+            table = decode_blob(self.codec, blob)
+        finally:
+            if pinned is not None:
+                pool.release(pinned)
+        return table, size
 
     def read_partition(self, p: int) -> Iterator[HostTable]:
         """All map outputs' segments for reduce partition p, deserialized in
-        parallel, yielded in map order."""
-        def fetch(mo: MapOutput):
-            start, end = mo.offsets[p], mo.offsets[p + 1]
-            if end <= start:
-                return None, 0
-            size = end - start
-            # pinned staging for the compressed read (PinnedMemoryPool):
-            # safe only when a decompression copy follows — the codec
-            # "none" path would alias the reusable buffer
-            pinned = None
-            if self.codec != "none":
-                from spark_rapids_tpu.runtime.host_alloc import (
-                    PinnedMemoryPool,
-                )
-                pool = PinnedMemoryPool.get()
-                pinned = pool.acquire(size) if pool is not None else None
-            try:
-                with open(mo.data_path, "rb") as f:
-                    f.seek(start)
-                    if pinned is not None:
-                        view = memoryview(pinned)[:size]
-                        f.readinto(view)
-                        raw = _decompress(self.codec, view)
-                    else:
-                        raw = _decompress(self.codec, f.read(size))
-            finally:
-                if pinned is not None:
-                    pool.release(pinned)
-            table, _ = unpack_table(raw)
-            return table, size
+        parallel, yielded in map order. A retryable failure (corrupt
+        frame, torn read, injected fault) replays that map's read with
+        exponential backoff; exhaustion raises MapOutputLostError naming
+        the map so the exchange recomputes it from lineage."""
 
-        for t, nbytes in self.pool.map(fetch, self.write_handle.map_outputs):
+        def fetch(args):
+            map_id, mo = args
+
+            def note(_exc, _attempt):
+                self.retry_count += 1
+
+            try:
+                return backoff_retry(
+                    lambda: self._fetch_segment(mo, p),
+                    max_retries=self.max_retries,
+                    wait_s=self.retry_wait_s,
+                    backoff_mult=self.backoff_mult,
+                    retryable=(ShuffleFetchError, OSError),
+                    on_failure=note)
+            except (ShuffleFetchError, OSError) as e:
+                raise MapOutputLostError(
+                    f"map output {map_id} of shuffle "
+                    f"{self.write_handle.shuffle_id} unreadable after "
+                    f"retries: {e}", map_ids=[map_id]) from e
+
+        for t, nbytes in self.pool.map(
+                fetch, enumerate(self.write_handle.map_outputs)):
             self.bytes_read += nbytes  # consumer thread only: no races
             if t is not None and t.num_rows > 0:
                 yield t
@@ -221,7 +335,13 @@ class ShuffleManager:
             return h
 
     def reader(self, handle: ShuffleWriteHandle) -> ShuffleReadHandle:
-        return ShuffleReadHandle(handle, self.codec, self._reader_pool)
+        return ShuffleReadHandle(
+            handle, self.codec, self._reader_pool,
+            max_retries=int(self.conf.get_entry(SHUFFLE_FETCH_MAX_RETRIES)),
+            retry_wait_s=self.conf.get_entry(
+                SHUFFLE_FETCH_RETRY_WAIT_MS) / 1000.0,
+            backoff_mult=float(self.conf.get_entry(
+                SHUFFLE_FETCH_BACKOFF_MULT)))
 
     def remove_shuffle(self, handle: ShuffleWriteHandle):
         with self._lock:
@@ -242,7 +362,10 @@ def get_shuffle_manager(conf: RapidsConf) -> ShuffleManager:
     session's shuffle settings always take effect."""
     key = (str(conf.get_entry(SHUFFLE_COMPRESSION_CODEC)).lower(),
            conf.get_entry(SHUFFLE_MT_WRITER_THREADS),
-           conf.get_entry(SHUFFLE_MT_READER_THREADS))
+           conf.get_entry(SHUFFLE_MT_READER_THREADS),
+           conf.get_entry(SHUFFLE_FETCH_MAX_RETRIES),
+           conf.get_entry(SHUFFLE_FETCH_RETRY_WAIT_MS),
+           conf.get_entry(SHUFFLE_FETCH_BACKOFF_MULT))
     with _MANAGER_LOCK:
         mgr = _MANAGERS.get(key)
         if mgr is None:

@@ -16,18 +16,28 @@ over TCP (tests/test_shuffle_transport.py builds 2-3 executor meshes)."""
 from __future__ import annotations
 
 import threading
-from typing import Dict, Iterator, List, Optional
+from typing import Dict, Iterator, List, Optional, Set, Tuple
 
 from spark_rapids_tpu.columnar import HostTable
 from spark_rapids_tpu.conf import (
     RapidsConf,
+    SHUFFLE_BOUNCE_ACQUIRE_TIMEOUT_MS,
     SHUFFLE_COMPRESSION_CODEC,
+    SHUFFLE_CONNECT_TIMEOUT_MS,
+    SHUFFLE_FETCH_BACKOFF_MULT,
+    SHUFFLE_FETCH_MAX_RETRIES,
+    SHUFFLE_FETCH_RETRY_WAIT_MS,
     P2P_BOUNCE_BUFFER_SIZE,
     P2P_BOUNCE_BUFFERS,
     P2P_CACHE_LIMIT,
     P2P_TRANSPORT,
 )
-from spark_rapids_tpu.errors import ColumnarProcessingError
+from spark_rapids_tpu.errors import (
+    ColumnarProcessingError,
+    MapOutputLostError,
+    ShuffleFetchError,
+)
+from spark_rapids_tpu.runtime.faults import RECOVERY, backoff_retry
 from spark_rapids_tpu.shuffle.catalogs import (
     ShuffleBufferCatalog,
     ShuffleReceivedBufferCatalog,
@@ -39,10 +49,10 @@ from spark_rapids_tpu.shuffle.heartbeat import (
 )
 from spark_rapids_tpu.shuffle.manager import (
     _compress,
-    _decompress,
+    decode_blob,
     resolve_codec,
 )
-from spark_rapids_tpu.shuffle.serializer import pack_table, unpack_table
+from spark_rapids_tpu.shuffle.serializer import pack_table
 from spark_rapids_tpu.shuffle.transport import (
     BounceBufferManager,
     Connection,
@@ -65,18 +75,32 @@ class P2PShuffleEnv:
             str(conf.get_entry(SHUFFLE_COMPRESSION_CODEC)).lower())
         bounce_size = int(conf.get_entry(P2P_BOUNCE_BUFFER_SIZE))
         bounce_n = int(conf.get_entry(P2P_BOUNCE_BUFFERS))
+        acquire_timeout = conf.get_entry(
+            SHUFFLE_BOUNCE_ACQUIRE_TIMEOUT_MS) / 1000.0
         self.catalog = ShuffleBufferCatalog(
             host_limit_bytes=int(conf.get_entry(P2P_CACHE_LIMIT)))
-        self.send_pool = BounceBufferManager(bounce_size, bounce_n)
-        self.recv_pool = BounceBufferManager(bounce_size, bounce_n)
+        self.send_pool = BounceBufferManager(
+            bounce_size, bounce_n, default_timeout=acquire_timeout)
+        self.recv_pool = BounceBufferManager(
+            bounce_size, bounce_n, default_timeout=acquire_timeout)
         self.server = ShuffleServer(self.catalog, self.send_pool)
         self.window_size = bounce_size
+        # fetch-retry policy (spark.rapids.shuffle.fetch.*)
+        self.fetch_max_retries = int(conf.get_entry(
+            SHUFFLE_FETCH_MAX_RETRIES))
+        self.fetch_retry_wait_s = conf.get_entry(
+            SHUFFLE_FETCH_RETRY_WAIT_MS) / 1000.0
+        self.fetch_backoff_mult = float(conf.get_entry(
+            SHUFFLE_FETCH_BACKOFF_MULT))
 
         kind = str(conf.get_entry(P2P_TRANSPORT)).lower()
         self._listener: Optional[TcpShuffleServerListener] = None
         if kind == "tcp":
             self._listener = TcpShuffleServerListener(self.server)
-            self.transport = TcpTransport(self.recv_pool)
+            self.transport = TcpTransport(
+                self.recv_pool,
+                connect_timeout=conf.get_entry(
+                    SHUFFLE_CONNECT_TIMEOUT_MS) / 1000.0)
             self.me = PeerInfo(executor_id, self._listener.host,
                                self._listener.port)
         elif kind == "inprocess":
@@ -91,15 +115,48 @@ class P2PShuffleEnv:
         self._conn_lock = threading.Lock()
         self._shuffle_id_lock = threading.Lock()
         self._next_shuffle = 0
+        # per-peer CUMULATIVE fetch-failure counts (session lifetime, not
+        # per fetch): a peer is excluded from fetch targets when one
+        # fetch exhausts its retries OR when its total failures cross the
+        # chronic-flakiness budget (4x maxRetries) even though each fetch
+        # eventually limped through — recompute beats endless backoff.
+        # Cleared only by an actual re-registration (_on_new_peer).
+        self._peer_failures: Dict[str, int] = {}
+        self._excluded_peers: Set[str] = set()
         from spark_rapids_tpu.conf import HEARTBEAT_INTERVAL_S
         self.driver = driver or ShuffleHeartbeatManager()
         self.heartbeat = ShuffleHeartbeatEndpoint(
             self.driver, self.me, self._on_new_peer,
-            interval_s=float(conf.get_entry(HEARTBEAT_INTERVAL_S)))
+            interval_s=float(conf.get_entry(HEARTBEAT_INTERVAL_S)),
+            on_evicted=self._rejoin_after_eviction)
         self.heartbeat.start()
 
     def _on_new_peer(self, peer: PeerInfo):
+        """Normal heartbeat delivery: entries registered SINCE the last
+        beat. For an excluded peer, seeing it here means it actually
+        RE-registered with the driver — trust it again."""
         self._peers[peer.executor_id] = peer
+        self._excluded_peers.discard(peer.executor_id)
+        self._peer_failures.pop(peer.executor_id, None)
+
+    def _rejoin_after_eviction(self):
+        """OUR eviction, not theirs: re-register and re-DISCOVER the live
+        peers, but keep our exclusion list — the driver's reply names
+        every live peer, not peers that re-registered, so it proves
+        nothing about a peer we excluded for failing fetches."""
+        for peer in self.driver.register_executor(self.me):
+            self._peers[peer.executor_id] = peer
+
+    def on_peer_evicted(self, executor_id: str):
+        """Driver-eviction hook: stop targeting the peer immediately; the
+        next read that misses its blocks recomputes them from lineage."""
+        if executor_id in self._excluded_peers:
+            return
+        self._excluded_peers.add(executor_id)
+        RECOVERY.bump("peer_exclusions")
+
+    def exclude_peer(self, executor_id: str):
+        self.on_peer_evicted(executor_id)
 
     def connection_to(self, executor_id: str) -> Connection:
         with self._conn_lock:
@@ -136,7 +193,66 @@ class P2PShuffleEnv:
                              window_size=self.window_size)
 
     def peers(self) -> List[str]:
-        return list(self._peers)
+        return [ex for ex in self._peers if ex not in self._excluded_peers]
+
+    def fetch_partition_with_retry(self, shuffle_id: int, partition_id: int,
+                                   executor_id: str
+                                   ) -> List[Tuple[tuple, int, HostTable]]:
+        """One peer's blocks for a reduce partition, through the full
+        client/server protocol, with exponential-backoff retry; returns
+        (block_id, wire_bytes, table) triples. Deserialization runs INSIDE
+        the retry so a corrupt frame (CRC mismatch) refetches. Exhaustion
+        excludes the peer and raises MapOutputLostError naming the maps we
+        know it held (the RapidsShuffleIterator retry + transport-error
+        handling analog)."""
+        local = executor_id == self.executor_id
+        if not local and executor_id in self._excluded_peers:
+            raise MapOutputLostError(
+                f"peer {executor_id} is excluded (evicted or repeatedly "
+                "failing)", executor_id=executor_id)
+        state = {"known_maps": None, "chronic": False, "attempts": 0}
+
+        def attempt():
+            client = self.client_for(executor_id)
+            blocks = client.fetch_metadata(shuffle_id, partition_id)
+            if not blocks:
+                return []
+            state["known_maps"] = [bid[1] for bid, _ in blocks]
+            received = ShuffleReceivedBufferCatalog()
+            client.fetch_blocks(blocks, received)
+            # decode inside the retry: a corrupt frame (CRC mismatch or
+            # codec error — decode_blob normalizes both to the retryable
+            # kind) refetches like any other failure
+            return [(bid, len(blob), decode_blob(self.codec, blob))
+                    for bid, blob in received.drain()]
+
+        def on_failure(_exc, attempt_no):
+            state["attempts"] = attempt_no
+            total = self._peer_failures.get(executor_id, 0) + 1
+            self._peer_failures[executor_id] = total
+            state["chronic"] = (not local
+                                and total > 4 * self.fetch_max_retries)
+            return state["chronic"]  # budget blown: stop retrying now
+
+        try:
+            return backoff_retry(
+                attempt, max_retries=self.fetch_max_retries,
+                wait_s=self.fetch_retry_wait_s,
+                backoff_mult=self.fetch_backoff_mult,
+                retryable=ShuffleFetchError, on_failure=on_failure)
+        except ShuffleFetchError as e:
+            # the LOCAL executor is never excluded — after a recompute
+            # rewrites its blocks, fetches must be able to target it again
+            if not local:
+                self.exclude_peer(executor_id)
+            why = (f"{self._peer_failures.get(executor_id)} cumulative "
+                   "failures (chronically flaky)" if state["chronic"]
+                   else f"{state['attempts']} attempts")
+            raise MapOutputLostError(
+                f"fetch of shuffle {shuffle_id} partition {partition_id} "
+                f"from {executor_id} failed after {why}: {e}",
+                executor_id=executor_id,
+                map_ids=state["known_maps"]) from e
 
     # -- engine ShuffleManager interface ------------------------------------
     def new_shuffle(self, num_partitions: int) -> "P2PWriteHandle":
@@ -170,6 +286,10 @@ class P2PWriteHandle:
         self.num_partitions = num_partitions
         self.num_maps = 0
         self.bytes_written = 0
+        # map-output tracker slice: which (map, partition) blocks exist
+        # (empty partitions write no block, so absence alone cannot
+        # distinguish "empty" from "lost")
+        self._written: Dict[int, Set[int]] = {}
 
     def write_partitions(self, partitions: List[HostTable]):
         """Idempotent under retry (ADVICE r2): all blobs are serialized
@@ -198,7 +318,35 @@ class P2PWriteHandle:
                 self.env.catalog.remove_block(bid)
             self.bytes_written -= sum(len(b) for _, b in staged[:len(added)])
             raise
+        self._written[map_id] = {p for p, _ in staged}
         self.num_maps += 1
+
+    def rewrite_map(self, map_id: int, partitions: List[HostTable]):
+        """Recompute path: replace one lost map output's blocks with
+        freshly serialized copies in the LOCAL catalog (whether the
+        originals lived here or on an evicted peer)."""
+        if not 0 <= map_id < self.num_maps:
+            raise ColumnarProcessingError(
+                f"cannot rewrite unknown map output {map_id}")
+        if len(partitions) != self.num_partitions:
+            raise ColumnarProcessingError("partition count mismatch")
+        for p in range(self.num_partitions):
+            self.env.catalog.remove_block((self.shuffle_id, map_id, p))
+        written = set()
+        for p, table in enumerate(partitions):
+            if table.num_rows == 0:
+                continue
+            blob = _compress(self.env.codec, pack_table(table))
+            self.env.catalog.add_block((self.shuffle_id, map_id, p), blob)
+            written.add(p)
+        self._written[map_id] = written
+
+    def expected_maps(self, partition_id: int) -> Set[int]:
+        """Map ids that WROTE a block for this reduce partition — the
+        completeness contract the reader verifies (a lost peer must not
+        silently drop rows)."""
+        return {m for m, parts in self._written.items()
+                if partition_id in parts}
 
     @property
     def map_outputs(self):  # parity with ShuffleWriteHandle for metrics
@@ -215,22 +363,27 @@ class P2PReadHandle:
         self.bytes_read = 0
 
     def read_partition(self, p: int) -> Iterator[HostTable]:
+        """Fetch a reduce partition from every live source with
+        per-source retry, then verify COMPLETENESS against the write
+        handle's map-output tracker: any locally-written map whose block
+        did not arrive is reported lost (the exchange recomputes it) —
+        a dead peer must fail loudly, never silently drop rows."""
         sources = [self.env.executor_id] + [
             ex for ex in self.env.peers() if ex != self.env.executor_id]
+        got_maps = set()
         for executor_id in sources:
-            client = self.env.client_for(executor_id)
-            received = ShuffleReceivedBufferCatalog()
-            blocks = client.fetch_metadata(self.handle.shuffle_id, p)
-            if not blocks:
-                continue
-            # stream on this thread; drain inline (single-peer sequential
-            # fetch — the multi-peer overlap lives in the tests' threads)
-            client.fetch_blocks(blocks, received)
-            for _bid, blob in received.drain():
-                self.bytes_read += len(blob)
-                table, _ = unpack_table(_decompress(self.env.codec, blob))
+            for bid, nbytes, table in self.env.fetch_partition_with_retry(
+                    self.handle.shuffle_id, p, executor_id):
+                self.bytes_read += nbytes
+                got_maps.add(bid[1])
                 if table.num_rows > 0:
                     yield table
+        missing = self.handle.expected_maps(p) - got_maps
+        if missing:
+            raise MapOutputLostError(
+                f"shuffle {self.handle.shuffle_id} partition {p}: map "
+                f"outputs {sorted(missing)} missing from every live "
+                "source", map_ids=missing)
 
 
 _P2P_ENVS: Dict[tuple, P2PShuffleEnv] = {}
@@ -242,7 +395,10 @@ def get_p2p_env(conf: RapidsConf) -> P2PShuffleEnv:
            str(conf.get_entry(P2P_TRANSPORT)).lower(),
            int(conf.get_entry(P2P_BOUNCE_BUFFER_SIZE)),
            int(conf.get_entry(P2P_BOUNCE_BUFFERS)),
-           int(conf.get_entry(P2P_CACHE_LIMIT)))
+           int(conf.get_entry(P2P_CACHE_LIMIT)),
+           int(conf.get_entry(SHUFFLE_FETCH_MAX_RETRIES)),
+           conf.get_entry(SHUFFLE_FETCH_RETRY_WAIT_MS),
+           float(conf.get_entry(SHUFFLE_FETCH_BACKOFF_MULT)))
     with _P2P_LOCK:
         env = _P2P_ENVS.get(key)
         if env is None:

@@ -10,24 +10,30 @@ Layout (little-endian):
   per column body:   validity bitmask ceil(n/8) bytes, then
      fixed-width: raw array bytes (n * itemsize)
      string:      offsets int64[n+1] + utf8 blob (int64: blobs may pass 2GiB)
+  footer (v2):       crc32 u32 over everything above
 
 The format is self-describing so shuffle readers need no schema exchange.
 A C++ implementation with the same layout is the planned native fast path.
+Version 2 appends a CRC32 footer so a corrupted frame (bit flip on the
+wire, torn file read, chaos-injected damage) surfaces as a RETRYABLE
+CorruptFrameError instead of silently deserializing garbage — shuffle
+blobs are ephemeral, so the version bump has no migration cost.
 """
 
 from __future__ import annotations
 
 import struct
+import zlib
 from typing import List, Tuple
 
 import numpy as np
 
 from spark_rapids_tpu import types as T
 from spark_rapids_tpu.columnar import HostColumn, HostTable
-from spark_rapids_tpu.errors import ColumnarProcessingError
+from spark_rapids_tpu.errors import CorruptFrameError
 
 MAGIC = b"TPAK"
-VERSION = 1
+VERSION = 2
 
 _TAGS = [
     (T.BooleanType, 1), (T.ByteType, 2), (T.ShortType, 3), (T.IntegerType, 4),
@@ -80,20 +86,42 @@ def pack_table(table: HostTable) -> bytes:
         else:
             arr = np.ascontiguousarray(col.data, dtype=col.dtype.np_dtype)
             out.append(arr.tobytes())
-    return b"".join(out)
+    body = b"".join(out)
+    return body + struct.pack("<I", zlib.crc32(body))
 
 
 def unpack_table(buf: bytes, offset: int = 0) -> Tuple[HostTable, int]:
-    """Returns (table, bytes consumed from offset)."""
+    """Returns (table, bytes consumed from offset). Integrity failures
+    (bad magic/version, truncation, CRC mismatch) raise the RETRYABLE
+    CorruptFrameError so the fetch-retry / recompute machinery recovers
+    instead of the query dying on garbage bytes."""
     view = memoryview(buf)
     pos = offset
-    if bytes(view[pos:pos + 4]) != MAGIC:
-        raise ColumnarProcessingError("bad TPAK magic")
+    try:
+        if bytes(view[pos:pos + 4]) != MAGIC:
+            raise CorruptFrameError("bad TPAK magic")
+        pos += 4
+        version, ncols, nrows = struct.unpack_from("<IIQ", view, pos)
+        pos += 16
+        if version != VERSION:
+            raise CorruptFrameError(f"TPAK version {version}")
+    except struct.error as e:
+        raise CorruptFrameError(f"truncated TPAK header: {e}") from e
+    try:
+        names, dtypes, cols, pos = _unpack_body(view, pos, ncols, nrows)
+    except (struct.error, ValueError, KeyError, UnicodeDecodeError) as e:
+        raise CorruptFrameError(f"corrupt TPAK frame: {e}") from e
+    try:
+        (stored_crc,) = struct.unpack_from("<I", view, pos)
+    except struct.error as e:
+        raise CorruptFrameError("TPAK frame missing CRC footer") from e
+    if zlib.crc32(view[offset:pos]) != stored_crc:
+        raise CorruptFrameError("TPAK CRC mismatch (corrupt frame)")
     pos += 4
-    version, ncols, nrows = struct.unpack_from("<IIQ", view, pos)
-    pos += 16
-    if version != VERSION:
-        raise ColumnarProcessingError(f"TPAK version {version}")
+    return HostTable(names, cols), pos - offset
+
+
+def _unpack_body(view: memoryview, pos: int, ncols: int, nrows: int):
     names: List[str] = []
     dtypes: List[T.DataType] = []
     for _ in range(ncols):
@@ -139,4 +167,4 @@ def unpack_table(buf: bytes, offset: int = 0) -> Tuple[HostTable, int]:
             data = np.frombuffer(view, dtype=np_dt, count=nrows, offset=pos).copy()
             pos += int(nrows) * np_dt.itemsize
             cols.append(HostColumn(dt, data, validity))
-    return HostTable(names, cols), pos - offset
+    return names, dtypes, cols, pos

@@ -25,7 +25,12 @@ import threading
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Tuple
 
-from spark_rapids_tpu.errors import ColumnarProcessingError
+from spark_rapids_tpu.errors import (
+    ColumnarProcessingError,
+    ShuffleFetchError,
+    ShuffleTransportError,
+)
+from spark_rapids_tpu.runtime.faults import fault_point
 
 # message types (ActiveMessage ids in the reference's UCX.scala)
 MSG_METADATA_REQ = 1
@@ -51,27 +56,43 @@ class Transaction:
     payload: Optional[bytes] = None
 
 
+#: sentinel distinguishing "no timeout passed" (use the pool default) from
+#: an explicit timeout=None (wait forever)
+_USE_DEFAULT = object()
+
+
 class BounceBufferManager:
     """Bounded pool of fixed-size reusable buffers (BounceBufferManager
     analog). acquire() blocks until a buffer frees; the pool caps how much
-    memory an in-flight fetch pipeline can hold."""
+    memory an in-flight fetch pipeline can hold.
 
-    def __init__(self, buffer_size: int, num_buffers: int):
+    ``default_timeout`` (seconds; plumbed from
+    spark.rapids.shuffle.p2p.bounceAcquireTimeoutMs by the p2p env) bounds
+    how long an acquire with no explicit timeout waits — a peer dying
+    while holding buffers must surface as a retryable ShuffleFetchError,
+    not a hang."""
+
+    def __init__(self, buffer_size: int, num_buffers: int,
+                 default_timeout: Optional[float] = None):
         if buffer_size <= 0 or num_buffers <= 0:
             raise ColumnarProcessingError("bounce pool must be non-empty")
         self.buffer_size = buffer_size
         self.num_buffers = num_buffers
+        self.default_timeout = default_timeout
         self._free: List[bytearray] = [bytearray(buffer_size)
                                        for _ in range(num_buffers)]
         self._cv = threading.Condition()
         self.acquire_count = 0
         self.high_water = 0
 
-    def acquire(self, timeout: Optional[float] = None) -> bytearray:
+    def acquire(self, timeout=_USE_DEFAULT) -> bytearray:
+        if timeout is _USE_DEFAULT:
+            timeout = self.default_timeout
         with self._cv:
             if not self._cv.wait_for(lambda: self._free, timeout=timeout):
-                raise ColumnarProcessingError(
-                    "timed out waiting for a bounce buffer")
+                raise ShuffleFetchError(
+                    f"timed out after {timeout}s waiting for a bounce "
+                    "buffer (peer holding buffers may be dead)")
             buf = self._free.pop()
             self.acquire_count += 1
             in_use = self.num_buffers - len(self._free)
@@ -213,6 +234,7 @@ class _InProcessConnection(Connection):
 
     def request(self, msg_type: int, payload: bytes) -> Transaction:
         try:
+            fault_point("shuffle.transport.request")
             resp_type, resp = self.server.handle_request(msg_type, payload)
         except Exception as e:  # transport surfaces handler faults as tx errors
             return Transaction(status=TX_ERROR, error_message=str(e))
@@ -223,9 +245,15 @@ class _InProcessConnection(Connection):
 
     def stream(self, msg_type: int, payload: bytes,
                on_window: Callable[[memoryview], None]) -> Transaction:
+        from spark_rapids_tpu.runtime.faults import FAULTS
         total = 0
         try:
             for window in self.server.handle_stream(msg_type, payload):
+                if FAULTS.armed:
+                    # disconnect/slow raise or stall here; corrupt
+                    # damages the window copy before reassembly
+                    window = fault_point("shuffle.transport.stream",
+                                         data=bytes(window))
                 buf = self.recv_pool.acquire()
                 try:
                     n = len(window)
@@ -335,8 +363,24 @@ class TcpShuffleServerListener:
 
 
 class TcpTransport(Transport):
+    """``connect_timeout`` comes from spark.rapids.shuffle.fetch
+    .connectTimeoutMs; a timed-out connect raises a retryable
+    ShuffleTransportError so the fetch-retry loop counts it against the
+    peer instead of the query dying on socket.timeout."""
+
+    def __init__(self, recv_pool: BounceBufferManager,
+                 connect_timeout: float = 30.0):
+        super().__init__(recv_pool)
+        self.connect_timeout = connect_timeout
+
     def connect(self, peer: PeerInfo) -> Connection:
-        sock = socket.create_connection((peer.host, peer.port), timeout=30)
+        try:
+            sock = socket.create_connection((peer.host, peer.port),
+                                            timeout=self.connect_timeout)
+        except OSError as e:
+            raise ShuffleTransportError(
+                f"cannot connect to shuffle peer {peer.executor_id} at "
+                f"{peer.host}:{peer.port}: {e}") from e
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         return _TcpConnection(sock, self.recv_pool)
 
@@ -363,6 +407,7 @@ class _TcpConnection(Connection):
     def request(self, msg_type: int, payload: bytes) -> Transaction:
         with self._lock:
             try:
+                fault_point("shuffle.transport.request")
                 _send_frame(self.sock, msg_type, payload)
                 resp_type, length = _recv_frame_header(self.sock)
                 resp = bytes(_recv_exact(self.sock, length)) if length else b""
@@ -375,6 +420,7 @@ class _TcpConnection(Connection):
 
     def stream(self, msg_type: int, payload: bytes,
                on_window: Callable[[memoryview], None]) -> Transaction:
+        from spark_rapids_tpu.runtime.faults import FAULTS
         total = 0
         with self._lock:
             try:
@@ -407,6 +453,10 @@ class _TcpConnection(Connection):
                                     "peer closed mid-window")
                             got += r
                         total += length
+                        if FAULTS.armed:
+                            view = memoryview(fault_point(
+                                "shuffle.transport.stream",
+                                data=bytes(view)))
                         on_window(view)
                     finally:
                         self.recv_pool.release(buf)

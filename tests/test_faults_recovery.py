@@ -1,0 +1,635 @@
+"""Chaos harness + runtime recovery tests.
+
+Covers the unified fault registry (spark.rapids.test.faults), shuffle
+fetch retry/backoff with per-peer exclusion, lost-map-output recompute
+from plan lineage (forced peer eviction included), and the per-operator
+circuit breaker demoting a deterministically crashing op to CPU."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.columnar import HostColumn, HostTable
+from spark_rapids_tpu.conf import RapidsConf
+from spark_rapids_tpu.errors import (
+    ColumnarProcessingError,
+    CorruptFrameError,
+    KernelCrashError,
+    MapOutputLostError,
+    RetryOOM,
+    ShuffleFetchError,
+    ShuffleTransportError,
+)
+from spark_rapids_tpu.runtime.faults import (
+    CIRCUIT_BREAKER,
+    FAULT_POINTS,
+    FAULTS,
+    RECOVERY,
+    FaultRegistry,
+    parse_fault_spec,
+)
+from spark_rapids_tpu import types as T
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_state():
+    """Fault/breaker state is process-global by design (a demotion lasts
+    the session); tests must not leak it into each other."""
+    FAULTS.disarm()
+    CIRCUIT_BREAKER.reset()
+    yield
+    FAULTS.disarm()
+    CIRCUIT_BREAKER.reset()
+
+
+def _table(n=64, seed=0):
+    rng = np.random.default_rng(seed)
+    return HostTable(["k", "v"], [
+        HostColumn(T.LONG, rng.integers(0, 8, n).astype(np.int64)),
+        HostColumn(T.DOUBLE, rng.random(n)),
+    ])
+
+
+# ---------------------------------------------------------------------------
+# fault registry
+# ---------------------------------------------------------------------------
+
+
+def test_fault_spec_parsing_and_validation():
+    armed = parse_fault_spec(
+        "shuffle.fetch.metadata:fetch:0.5:7;"
+        "exec.execute@Project:crash:3;"
+        "dispatch.kernel:oom:1.0:9")
+    assert [a.kind for a in armed] == ["fetch", "crash", "oom"]
+    assert armed[0].prob == 0.5 and armed[0].remaining is None
+    assert armed[1].op == "Project" and armed[1].remaining == 3
+    assert armed[2].prob == 1.0  # "1.0" is a probability, "1" a count
+    with pytest.raises(ColumnarProcessingError, match="unknown fault point"):
+        parse_fault_spec("no.such.point:fetch:1")
+    with pytest.raises(ColumnarProcessingError, match="unknown fault kind"):
+        parse_fault_spec("dispatch.kernel:frobnicate:1")
+    with pytest.raises(ColumnarProcessingError, match="bad fault spec"):
+        parse_fault_spec("dispatch.kernel")
+
+
+def test_fault_firing_kinds_and_counters():
+    reg = FaultRegistry()
+    reg.arm("dispatch.kernel:oom:1;"
+            "exec.execute:crash:1;"
+            "shuffle.fetch.metadata:fetch:1;"
+            "shuffle.transport.request:disconnect:1")
+    with pytest.raises(RetryOOM):
+        reg.fire("dispatch.kernel")
+    with pytest.raises(KernelCrashError):
+        reg.fire("exec.execute", op="Project")
+    with pytest.raises(ShuffleFetchError):
+        reg.fire("shuffle.fetch.metadata")
+    with pytest.raises(ShuffleTransportError):
+        reg.fire("shuffle.transport.request")
+    # counts exhausted: all silent now
+    reg.fire("dispatch.kernel")
+    reg.fire("exec.execute")
+    assert reg.counters() == {
+        "dispatch.kernel": 1, "exec.execute": 1,
+        "shuffle.fetch.metadata": 1, "shuffle.transport.request": 1}
+
+
+def test_fault_probability_is_seeded_deterministic():
+    def fires(seed):
+        reg = FaultRegistry()
+        reg.arm(f"dispatch.kernel:fetch:0.3:{seed}")
+        out = []
+        for _ in range(50):
+            try:
+                reg.fire("dispatch.kernel")
+                out.append(0)
+            except ShuffleFetchError:
+                out.append(1)
+        return out
+
+    a, b = fires(7), fires(7)
+    assert a == b  # deterministic replay
+    assert 0 < sum(a) < 50  # actually probabilistic
+    assert fires(8) != a  # seed matters
+
+
+def test_fault_op_filter_only_hits_matching_op():
+    reg = FaultRegistry()
+    reg.arm("exec.execute@Aggregate:crash:5")
+    reg.fire("exec.execute", op="Project")  # silent: filtered out
+    with pytest.raises(KernelCrashError) as ei:
+        reg.fire("exec.execute", op="Aggregate")
+    # attribution is the exec fault guards' job, not the registry's —
+    # a raw fire carries no fault_op (helper-exec names must never
+    # reach the circuit breaker)
+    assert getattr(ei.value, "fault_op", None) is None
+    assert reg.counters() == {"exec.execute@Aggregate": 1}
+
+
+def test_corrupt_kind_damages_data_deterministically():
+    reg = FaultRegistry()
+    reg.arm("shuffle.fetch.stream:corrupt:2:11")
+    blob = bytes(range(64))
+    out1 = reg.fire("shuffle.fetch.stream", data=blob)
+    out2 = reg.fire("shuffle.fetch.stream", data=blob)
+    assert out1 != blob and len(out1) == len(blob)
+    assert reg.fire("shuffle.fetch.stream", data=blob) == blob  # exhausted
+    reg2 = FaultRegistry()
+    reg2.arm("shuffle.fetch.stream:corrupt:2:11")
+    assert reg2.fire("shuffle.fetch.stream", data=blob) == out1
+    assert reg2.fire("shuffle.fetch.stream", data=blob) == out2
+
+
+def test_suspended_preserves_schedule_and_counters():
+    reg = FaultRegistry()
+    reg.arm("dispatch.kernel:fetch:2")
+    with pytest.raises(ShuffleFetchError):
+        reg.fire("dispatch.kernel")
+    with reg.suspended():
+        assert not reg.armed
+        reg.fire("dispatch.kernel")  # silent: nothing armed
+        reg.arm("")  # what a fault-free session's execute() does
+    # armed state, remaining count, and counters all survive intact
+    with pytest.raises(ShuffleFetchError):
+        reg.fire("dispatch.kernel")
+    reg.fire("dispatch.kernel")  # count of 2 now exhausted
+    assert reg.counters() == {"dispatch.kernel": 2}
+
+
+def test_rearming_same_spec_preserves_schedule():
+    reg = FaultRegistry()
+    reg.arm("dispatch.kernel:fetch:1")
+    with pytest.raises(ShuffleFetchError):
+        reg.fire("dispatch.kernel")
+    reg.arm("dispatch.kernel:fetch:1")  # same spec: no reset
+    reg.fire("dispatch.kernel")  # still exhausted
+    assert reg.counters()["dispatch.kernel"] == 1
+    reg.arm("dispatch.kernel:fetch:2")  # different spec: fresh
+    with pytest.raises(ShuffleFetchError):
+        reg.fire("dispatch.kernel")
+
+
+# ---------------------------------------------------------------------------
+# TPAK integrity (corrupt-frame detection)
+# ---------------------------------------------------------------------------
+
+
+def test_tpak_crc_catches_corruption():
+    from spark_rapids_tpu.shuffle.serializer import pack_table, unpack_table
+    blob = pack_table(_table())
+    t, consumed = unpack_table(blob)
+    assert consumed == len(blob) and t.num_rows == 64
+    flipped = bytearray(blob)
+    flipped[len(blob) // 2] ^= 0xFF
+    with pytest.raises(CorruptFrameError):
+        unpack_table(bytes(flipped))
+    with pytest.raises(CorruptFrameError):
+        unpack_table(blob[: len(blob) - 2])  # truncated
+
+
+# ---------------------------------------------------------------------------
+# shuffle fetch retry / backoff / exclusion (p2p)
+# ---------------------------------------------------------------------------
+
+
+def _p2p_env(executor_id, driver=None, **overrides):
+    from spark_rapids_tpu.shuffle.p2p import P2PShuffleEnv
+    conf = {"spark.rapids.shuffle.fetch.retryWaitMs": "1",
+            "spark.rapids.shuffle.fetch.maxRetries": "3"}
+    conf.update(overrides)
+    return P2PShuffleEnv(RapidsConf(conf), executor_id=executor_id,
+                         driver=driver)
+
+
+def test_fetch_retry_survives_transient_faults():
+    env = _p2p_env("exec-rt-0")
+    try:
+        handle = env.new_shuffle(2)
+        handle.write_partitions([_table(16, 1), _table(16, 2)])
+        FAULTS.arm("shuffle.fetch.metadata:fetch:2")  # first 2 hits fail
+        before = RECOVERY.snapshot()
+        reader = env.reader(handle)
+        rows = sum(t.num_rows for t in reader.read_partition(0))
+        assert rows == 16
+        assert RECOVERY.snapshot()["fetch_retries"] - \
+            before["fetch_retries"] == 2
+    finally:
+        env.close()
+
+
+def test_fetch_retry_backoff_is_exponential():
+    env = _p2p_env("exec-rt-1",
+                   **{"spark.rapids.shuffle.fetch.retryWaitMs": "20",
+                      "spark.rapids.shuffle.fetch.backoffMultiplier": "3.0"})
+    try:
+        handle = env.new_shuffle(1)
+        handle.write_partitions([_table(8, 3)])
+        FAULTS.arm("shuffle.fetch.metadata:fetch:2")
+        t0 = time.perf_counter()
+        list(env.reader(handle).read_partition(0))
+        elapsed = time.perf_counter() - t0
+        # waits: 20ms then 60ms -> at least ~80ms total
+        assert elapsed >= 0.08
+    finally:
+        env.close()
+
+
+def test_fetch_exhaustion_is_map_output_lost_and_excludes_peer():
+    driver = None
+    from spark_rapids_tpu.shuffle.heartbeat import ShuffleHeartbeatManager
+    driver = ShuffleHeartbeatManager()
+    env_a = _p2p_env("exec-ex-a", driver=driver)
+    env_b = _p2p_env("exec-ex-b", driver=driver)
+    try:
+        env_a.heartbeat.beat_once()
+        assert "exec-ex-b" in env_a.peers()
+        env_b.catalog.add_block((0, 0, 0), b"\x00" * 32)
+        FAULTS.arm("shuffle.fetch.metadata:fetch:99")
+        before = RECOVERY.snapshot()
+        with pytest.raises(MapOutputLostError) as ei:
+            env_a.fetch_partition_with_retry(0, 0, "exec-ex-b")
+        assert ei.value.executor_id == "exec-ex-b"
+        # peer is excluded from future fetch targets...
+        assert "exec-ex-b" not in env_a.peers()
+        assert RECOVERY.snapshot()["peer_exclusions"] > \
+            before["peer_exclusions"]
+        # ...and an excluded peer fails fast, without retries
+        with pytest.raises(MapOutputLostError, match="excluded"):
+            env_a.fetch_partition_with_retry(0, 0, "exec-ex-b")
+    finally:
+        env_a.close()
+        env_b.close()
+
+
+def test_chronically_flaky_peer_excluded_by_cumulative_budget():
+    """Per-peer failure-count exclusion: a peer whose every fetch limps
+    through after retries never exhausts a single call, but its
+    CUMULATIVE failures cross the 4x-maxRetries budget and it is
+    excluded anyway — recompute beats endless backoff."""
+    from spark_rapids_tpu.shuffle.heartbeat import ShuffleHeartbeatManager
+    driver = ShuffleHeartbeatManager()
+    env_a = _p2p_env("exec-fl-a", driver=driver,
+                     **{"spark.rapids.shuffle.fetch.maxRetries": "2"})
+    env_b = _p2p_env("exec-fl-b", driver=driver)
+    try:
+        from spark_rapids_tpu.shuffle.serializer import pack_table
+        env_a.heartbeat.beat_once()
+        env_b.catalog.add_block((0, 0, 0), pack_table(_table(8, 6)))
+        # budget = 4 * maxRetries = 8 cumulative failures; each fetch
+        # fails twice then succeeds (2 < maxRetries+1, never exhausts),
+        # so the NINTH failure (5th fetch) trips the budget
+        for i in range(6):
+            FAULTS.disarm()
+            FAULTS.arm(f"shuffle.fetch.metadata:fetch:2:{i}")
+            try:
+                env_a.fetch_partition_with_retry(0, 0, "exec-fl-b")
+            except MapOutputLostError as e:
+                assert "chronically flaky" in str(e)
+                break
+        else:
+            pytest.fail("cumulative failure budget never tripped")
+        assert "exec-fl-b" not in env_a.peers()
+    finally:
+        env_a.close()
+        env_b.close()
+
+
+def test_rejoin_after_own_eviction_keeps_exclusions():
+    """An executor that was itself evicted and rejoins must NOT re-trust
+    peers it excluded for failing fetches: the driver's rejoin reply
+    lists every live peer, which proves nothing about the excluded one.
+    Only an actual re-registration (heartbeat delivery) restores trust."""
+    import time as _t
+    from spark_rapids_tpu.shuffle.heartbeat import ShuffleHeartbeatManager
+    from spark_rapids_tpu.shuffle.transport import PeerInfo
+    driver = ShuffleHeartbeatManager(heartbeat_timeout_s=0.15)
+    env_a = _p2p_env("exec-rj-a", driver=driver)
+    env_b = _p2p_env("exec-rj-b", driver=driver)
+    try:
+        env_a.heartbeat.beat_once()
+        env_a.exclude_peer("exec-rj-b")
+        assert "exec-rj-b" not in env_a.peers()
+        # A misses its window; driver evicts it; B keeps beating
+        _t.sleep(0.2)
+        env_b.heartbeat.beat_once()
+        assert "exec-rj-a" in driver.evict_dead()
+        env_a.heartbeat.beat_or_recover()  # rejoin path
+        assert "exec-rj-a" in driver.live_executors()
+        # B is rediscovered but STILL excluded
+        assert "exec-rj-b" in env_a._peers
+        assert "exec-rj-b" not in env_a.peers()
+        # a true re-registration of B restores trust
+        env_a.heartbeat.beat_once()  # advance A's log cursor
+        driver.register_executor(PeerInfo("exec-rj-b"))
+        env_a.heartbeat.beat_once()
+        assert "exec-rj-b" in env_a.peers()
+    finally:
+        env_a.close()
+        env_b.close()
+
+
+def test_local_executor_is_never_excluded():
+    env = _p2p_env("exec-loc-0")
+    try:
+        handle = env.new_shuffle(1)
+        handle.write_partitions([_table(8, 4)])
+        FAULTS.arm("shuffle.fetch.metadata:fetch:99")
+        with pytest.raises(MapOutputLostError):
+            env.fetch_partition_with_retry(handle.shuffle_id, 0,
+                                           env.executor_id)
+        FAULTS.disarm()
+        # local fetches keep working after exhaustion (recompute relies
+        # on rewriting + refetching locally)
+        out = env.fetch_partition_with_retry(handle.shuffle_id, 0,
+                                             env.executor_id)
+        assert sum(t.num_rows for _, _, t in out) == 8
+    finally:
+        env.close()
+
+
+def test_corrupt_compressed_blob_is_retryable_not_fatal():
+    """With a compression codec the TPAK CRC sits UNDER the compression,
+    so the codec error is the only corruption signal — decode_blob must
+    normalize it to the retryable kind for both read paths."""
+    from spark_rapids_tpu.shuffle.manager import _compress, decode_blob
+    from spark_rapids_tpu.shuffle.serializer import pack_table
+    blob = _compress("zlib", pack_table(_table(16, 9)))
+    t = decode_blob("zlib", blob)
+    assert t.num_rows == 16
+    damaged = bytearray(blob)
+    damaged[len(blob) // 2] ^= 0xFF
+    with pytest.raises(CorruptFrameError):
+        decode_blob("zlib", bytes(damaged))
+    # and end-to-end: a corrupt delivery under zlib refetches cleanly
+    env = _p2p_env("exec-zc-0", **{
+        "spark.rapids.shuffle.compression.codec": "zlib"})
+    try:
+        handle = env.new_shuffle(1)
+        handle.write_partitions([_table(32, 12)])
+        FAULTS.arm("shuffle.fetch.stream:corrupt:1")
+        rows = sum(t.num_rows for t in env.reader(handle).read_partition(0))
+        assert rows == 32
+    finally:
+        env.close()
+
+
+def test_corrupt_frame_refetches_clean_copy():
+    env = _p2p_env("exec-crc-0")
+    try:
+        handle = env.new_shuffle(1)
+        handle.write_partitions([_table(32, 5)])
+        # corrupt exactly one completed-block delivery; the CRC rejects
+        # it and the retry refetches the intact catalog blob
+        FAULTS.arm("shuffle.fetch.stream:corrupt:1")
+        before = RECOVERY.snapshot()
+        rows = sum(t.num_rows for t in env.reader(handle).read_partition(0))
+        assert rows == 32
+        assert RECOVERY.snapshot()["fetch_retries"] > \
+            before["fetch_retries"]
+    finally:
+        env.close()
+
+
+# ---------------------------------------------------------------------------
+# bounce-buffer acquire timeout (satellite: no infinite hang)
+# ---------------------------------------------------------------------------
+
+
+def test_bounce_acquire_default_timeout_raises_retryable():
+    from spark_rapids_tpu.shuffle.transport import BounceBufferManager
+    pool = BounceBufferManager(32, 1, default_timeout=0.05)
+    buf = pool.acquire()
+    t0 = time.perf_counter()
+    with pytest.raises(ShuffleFetchError, match="bounce"):
+        pool.acquire()  # no explicit timeout -> pool default applies
+    assert time.perf_counter() - t0 < 5.0
+    pool.release(buf)
+    # explicit None still means wait-forever semantics (releaser thread)
+    got = []
+    t = threading.Thread(
+        target=lambda: got.append(pool.acquire(timeout=None)))
+    t.start()
+    t.join(timeout=2)
+    assert got and got[0] is buf
+
+
+def test_p2p_env_plumbs_bounce_timeout_from_conf():
+    env = _p2p_env("exec-bt-0", **{
+        "spark.rapids.shuffle.p2p.bounceAcquireTimeoutMs": "40"})
+    try:
+        assert env.recv_pool.default_timeout == pytest.approx(0.04)
+        assert env.send_pool.default_timeout == pytest.approx(0.04)
+    finally:
+        env.close()
+
+
+# ---------------------------------------------------------------------------
+# lost-map recompute (forced peer eviction -> recompute, not failure)
+# ---------------------------------------------------------------------------
+
+
+def test_peer_eviction_triggers_map_output_recompute():
+    """The acceptance scenario: a peer holding map output dies mid-query
+    (driver evicts it); the read detects the missing maps, the exchange
+    recomputes them from the retained lineage, and the partition read
+    completes with every row — the query never fails."""
+    from spark_rapids_tpu.shuffle.heartbeat import ShuffleHeartbeatManager
+    driver = ShuffleHeartbeatManager(heartbeat_timeout_s=30.0)
+    env_a = _p2p_env("exec-rc-a", driver=driver)
+    env_b = _p2p_env("exec-rc-b", driver=driver)
+    try:
+        env_a.heartbeat.beat_once()
+        handle = env_a.new_shuffle(2)
+        t0, t1 = _table(16, 10), _table(16, 11)
+        from spark_rapids_tpu.shuffle.partitioning import (
+            HashPartitioner,
+            split_by_partition,
+        )
+        from spark_rapids_tpu.columnar import DeviceTable
+        from spark_rapids_tpu.ops.expr import col
+
+        parter = HashPartitioner([col("k").bind([("k", T.LONG),
+                                                 ("v", T.DOUBLE)])], 2)
+        parts0 = split_by_partition(DeviceTable.from_host(t0), parter)
+        parts1 = split_by_partition(DeviceTable.from_host(t1), parter)
+        handle.write_partitions(parts0)
+        handle.write_partitions(parts1)
+        total_p0 = sum(t.num_rows
+                       for t in env_a.reader(handle).read_partition(0))
+
+        # map 1's blocks "live on" peer B: move them out of A's catalog
+        for p in sorted(handle._written[1]):
+            bid = (handle.shuffle_id, 1, p)
+            blob = env_a.catalog.get_block(bid)
+            env_b.catalog.add_block(bid, blob)
+            env_a.catalog.remove_block(bid)
+        # sanity: with B alive the full read still works (fetch from B)
+        assert sum(t.num_rows for t in
+                   env_a.reader(handle).read_partition(0)) == total_p0
+
+        # FORCE EVICTION mid-query: driver declares B dead; A stops
+        # targeting it
+        env_a.on_peer_evicted("exec-rc-b")
+        with pytest.raises(MapOutputLostError) as ei:
+            list(env_a.reader(handle).read_partition(0))
+        assert ei.value.map_ids == [1]
+
+        # the exchange-side recovery: recompute map 1 from lineage
+        # (batch 1 of the retained child) and retry the read
+        before = RECOVERY.snapshot()
+        handle.rewrite_map(1, parts1)
+        RECOVERY.bump("recomputed_maps")
+        rows = sum(t.num_rows
+                   for t in env_a.reader(handle).read_partition(0))
+        assert rows == total_p0  # every row of the dead peer's map is back
+        assert RECOVERY.snapshot()["recomputed_maps"] > \
+            before["recomputed_maps"]
+    finally:
+        env_a.close()
+        env_b.close()
+
+
+def test_exchange_recomputes_lost_maps_end_to_end(cpu_session):
+    """Engine-level: a repartition query whose fetches exhaust their
+    retries mid-read recomputes the missing map outputs from the plan
+    lineage instead of failing (metric: recomputedMapOutputs)."""
+    from spark_rapids_tpu import functions as F
+    from spark_rapids_tpu.session import TpuSession
+
+    data = {"k": (np.arange(200) % 16).astype(np.int64),
+            "v": np.arange(200, dtype=np.float64)}
+
+    def build(s):
+        return (s.create_dataframe(dict(data)).repartition(4, "k")
+                .group_by("k").agg(F.count("v").alias("c"),
+                                   F.sum("v").alias("s")))
+
+    s = TpuSession({
+        "spark.rapids.shuffle.mode": "P2P",
+        "spark.rapids.shuffle.localDeviceSplit.enabled": "false",
+        "spark.rapids.shuffle.fetch.retryWaitMs": "1",
+        "spark.rapids.shuffle.fetch.maxRetries": "1",
+        # 2 straight fetch failures exhaust maxRetries=1 and declare the
+        # (local) map outputs lost; the recompute rewrites them and the
+        # retried read succeeds
+        "spark.rapids.test.faults": "shuffle.fetch.metadata:fetch:2",
+    })
+    from tests.asserts import assert_tpu_and_cpu_are_equal
+    assert_tpu_and_cpu_are_equal(build, s, cpu_session)
+    ex = s._last_executable
+    found = []
+
+    def walk(e):
+        m = getattr(e, "metrics", None)
+        if m and "recomputedMapOutputs" in m:
+            found.append(m["recomputedMapOutputs"])
+        for c in getattr(e, "children", ()):
+            walk(c)
+        for attr in ("source", "tpu_exec", "cpu_node", "scan_node"):
+            if getattr(e, attr, None) is not None:
+                walk(getattr(e, attr))
+
+    walk(ex)
+    assert found and found[0] >= 1
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker: deterministic kernel crash -> CPU demotion
+# ---------------------------------------------------------------------------
+
+
+def test_circuit_breaker_demotes_deterministic_crasher(cpu_session):
+    """The acceptance scenario: an op that crashes EVERY time it runs on
+    device is demoted to the CPU fallback path (with a recorded reason)
+    and the query succeeds instead of failing forever."""
+    from spark_rapids_tpu.ops.expr import col, lit
+    from spark_rapids_tpu.session import TpuSession
+    data = {"a": np.arange(32, dtype=np.int64)}
+
+    def build(s):
+        return s.create_dataframe(dict(data)).filter(col("a") > lit(10))
+
+    s = TpuSession({
+        # deterministic: the Filter op crashes on device, always
+        "spark.rapids.test.faults": "exec.execute@Filter:crash:999",
+        "spark.rapids.sql.runtimeFallback.maxFailures": "2",
+    })
+    from tests.asserts import assert_tpu_and_cpu_are_equal
+    assert_tpu_and_cpu_are_equal(build, s, cpu_session)
+
+    demoted = CIRCUIT_BREAKER.demoted_ops()
+    assert "Filter" in demoted
+    assert "circuit breaker" in demoted["Filter"]
+    assert "injected kernel crash" in demoted["Filter"]
+    # the fallback reason surfaces through explain like any other
+    assert "circuit breaker" in s.explain(build(s).plan)
+    # and the replay count is observable
+    assert s.last_fault_replays >= 2
+
+
+def test_runtime_fallback_disabled_surfaces_the_crash():
+    from spark_rapids_tpu.ops.expr import col, lit
+    from spark_rapids_tpu.session import TpuSession
+    s = TpuSession({
+        "spark.rapids.test.faults": "exec.execute@Filter:crash:999",
+        "spark.rapids.sql.runtimeFallback.enabled": "false",
+    })
+    df = (s.create_dataframe({"a": np.arange(8, dtype=np.int64)})
+          .filter(col("a") > lit(3)))
+    with pytest.raises(KernelCrashError):
+        df.collect_table()
+    assert CIRCUIT_BREAKER.demoted_ops() == {}
+
+
+def test_transient_crash_replays_without_demotion(cpu_session):
+    """One-off crashes (count=1) recover by query replay alone — no
+    demotion, and the op stays on device for later queries."""
+    from spark_rapids_tpu.ops.expr import col, lit
+    from spark_rapids_tpu.session import TpuSession
+    data = {"a": np.arange(16, dtype=np.int64)}
+
+    def build(s):
+        return s.create_dataframe(dict(data)).filter(col("a") > lit(5))
+
+    s = TpuSession({
+        "spark.rapids.test.faults": "exec.execute@Filter:crash:1",
+        "spark.rapids.sql.runtimeFallback.maxFailures": "2",
+    })
+    from tests.asserts import assert_tpu_and_cpu_are_equal
+    assert_tpu_and_cpu_are_equal(build, s, cpu_session)
+    assert CIRCUIT_BREAKER.demoted_ops() == {}
+    assert s.last_fault_replays == 1
+    assert s._last_executable.metrics.get("runtimeFaultReplays") == 1
+
+
+# ---------------------------------------------------------------------------
+# registry hygiene
+# ---------------------------------------------------------------------------
+
+
+def test_every_fault_point_names_an_existing_site():
+    """The RL-FAULT-POINT contract, enforced here as well as in the lint
+    CLI: the registry and the call sites cannot drift."""
+    import ast
+    import os
+
+    import spark_rapids_tpu
+    root = os.path.dirname(os.path.dirname(
+        os.path.abspath(spark_rapids_tpu.__file__)))
+    from spark_rapids_tpu.lint.repo_lint import (
+        _check_fault_registry,
+        _check_fault_sites,
+        _iter_source_files,
+    )
+    calls, diags = {}, []
+    for path in _iter_source_files(root):
+        rel = os.path.relpath(path, root)
+        if rel.startswith("spark_rapids_tpu/lint/"):
+            continue
+        with open(path) as f:
+            _check_fault_sites(rel, ast.parse(f.read()), calls, diags)
+    _check_fault_registry(calls, diags)
+    assert diags == []
+    assert set(calls) == set(FAULT_POINTS)

@@ -479,6 +479,54 @@ def test_rl_dead_lambda():
     assert hits[0].path.endswith(":1")
 
 
+def test_rl_fault_point():
+    from spark_rapids_tpu.lint.repo_lint import (
+        _check_fault_registry,
+        _check_fault_sites,
+    )
+
+    # unregistered name + non-literal name at the site
+    src = ("from spark_rapids_tpu.runtime.faults import fault_point\n"
+           "fault_point('no.such.point')\n"
+           "name = 'dispatch.kernel'\n"
+           "fault_point(name)\n")
+    calls = {}
+    diags = _run_rl(_check_fault_sites, "spark_rapids_tpu/foo.py", src,
+                    calls)
+    hits = _find(diags, "RL-FAULT-POINT")
+    assert len(hits) == 2
+    assert "not registered" in hits[0].message
+    assert "string literal" in hits[1].message
+
+    # a registered point with NO call site anywhere -> registry-side hit
+    diags2 = []
+    _check_fault_registry({}, diags2)
+    assert diags2 and all(d.rule_id == "RL-FAULT-POINT" for d in diags2)
+    assert any("no fault_point" in d.message for d in diags2)
+
+    # a site outside the registered module -> module-drift hit
+    good_src = ("from spark_rapids_tpu.runtime.faults import fault_point\n"
+                "fault_point('dispatch.kernel')\n")
+    calls3 = {}
+    assert _run_rl(_check_fault_sites, "spark_rapids_tpu/elsewhere.py",
+                   good_src, calls3) == []
+    from spark_rapids_tpu.runtime.faults import FAULT_POINTS
+    full = {name: [f"{module}:1"]
+            for name, (module, _) in FAULT_POINTS.items()}
+    full["dispatch.kernel"] = ["spark_rapids_tpu/elsewhere.py:2"]
+    diags3 = []
+    _check_fault_registry(full, diags3)
+    assert len(diags3) == 1
+    assert "registered module" in diags3[0].message
+
+    # the real repo is clean in both directions
+    diags4 = []
+    _check_fault_registry(
+        {name: [f"{module}:1"]
+         for name, (module, _) in FAULT_POINTS.items()}, diags4)
+    assert diags4 == []
+
+
 def test_every_rule_has_a_negative_test():
     """Meta-pin: the rule surface and this module's negative coverage
     cannot drift apart (>= 12 rules required by the issue)."""

@@ -263,6 +263,96 @@ def test_semaphore_reentrant():
     assert sem.holders == 0
 
 
+def test_semaphore_live_downsizing_wakes_waiters_as_holders_release():
+    """Regression pin for shrinking max_tasks below the CURRENT holder
+    count via initialize() on the live singleton: a waiter must stay
+    blocked until holders drop BELOW the new cap, then wake promptly —
+    release's notify_all plus the waiter's len(holders) >= max_tasks
+    recheck cover the shrink correctly."""
+    import time
+    saved = TpuSemaphore._instance
+    TpuSemaphore._instance = None
+    try:
+        sem = TpuSemaphore.initialize(2)
+        hold = [threading.Event() for _ in range(2)]
+        started = [threading.Event() for _ in range(2)]
+
+        def holder(i):
+            sem.acquire_if_necessary()
+            started[i].set()
+            hold[i].wait(10)
+            sem.release_if_held()
+
+        holders = [threading.Thread(target=holder, args=(i,))
+                   for i in range(2)]
+        for t in holders:
+            t.start()
+        for s in started:
+            assert s.wait(5)
+        assert sem.holders == 2
+
+        shrunk = TpuSemaphore.initialize(1)  # live downsize, holders carry
+        assert shrunk is sem and sem.max_tasks == 1
+
+        got = threading.Event()
+
+        def waiter():
+            sem.acquire_if_necessary(timeout=8)
+            got.set()
+            sem.release_if_held()
+
+        w = threading.Thread(target=waiter)
+        w.start()
+        time.sleep(0.1)
+        assert not got.is_set()  # 2 holders >= cap 1: must block
+        hold[0].set()            # 2 -> 1 holders: still AT the cap
+        time.sleep(0.2)
+        assert not got.is_set()
+        hold[1].set()            # 1 -> 0: below cap, waiter must wake
+        w.join(8)
+        assert got.is_set()
+        for t in holders:
+            t.join(5)
+        assert sem.holders == 0
+    finally:
+        TpuSemaphore._instance = saved
+
+
+def test_fruitless_counters_are_per_catalog():
+    """Satellite pin: DeviceMemoryEventHandler keys its consecutive
+    fruitless-spill counts by id(catalog) — two threads OOM-ing on
+    DIFFERENT catalogs must not share counters (a shared count would
+    pre-escalate the second thread to split on its FIRST fruitless
+    spill), and reset_fruitless must clear only its own catalog."""
+    from spark_rapids_tpu.runtime.retry import DeviceMemoryEventHandler
+    handler = DeviceMemoryEventHandler()
+    cat_a = BufferCatalog(host_limit_bytes=1 << 20)  # empty: spills free 0
+    cat_b = BufferCatalog(host_limit_bytes=1 << 20)
+
+    results = {}
+
+    def oom_twice(name, cat, barrier):
+        out = []
+        for _ in range(2):
+            barrier.wait(timeout=5)
+            out.append(handler.on_alloc_failure(cat))
+        results[name] = out
+
+    barrier = threading.Barrier(2)
+    ta = threading.Thread(target=oom_twice, args=("a", cat_a, barrier))
+    tb = threading.Thread(target=oom_twice, args=("b", cat_b, barrier))
+    ta.start(); tb.start()
+    ta.join(10); tb.join(10)
+    # each catalog gets its OWN first-fruitless grace (True), then its
+    # own second-fruitless escalation (False) — no cross-talk
+    assert results == {"a": [True, False], "b": [True, False]}
+
+    # reset clears exactly one catalog's count
+    handler.reset_fruitless(cat_a)
+    assert handler.on_alloc_failure(cat_a) is True   # fresh grace for a
+    assert handler.on_alloc_failure(cat_b) is False  # b still escalated
+
+
 def test_semaphore_timeout():
     sem = TpuSemaphore(1)
     sem.acquire_if_necessary()

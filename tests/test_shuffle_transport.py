@@ -229,6 +229,49 @@ def test_heartbeat_unregistered_executor_rejected():
         mgr.heartbeat("ghost")
 
 
+def test_evicted_endpoint_rejoins_by_default():
+    """Satellite pin: a paused-then-resumed executor whose heartbeat the
+    driver rejected (evicted) must RE-REGISTER and keep beating instead
+    of its heartbeat loop dying silently — otherwise it goes permanently
+    deaf to new peers."""
+    import time
+    mgr = ShuffleHeartbeatManager(heartbeat_timeout_s=0.1)
+    seen_a = []
+    a = ShuffleHeartbeatEndpoint(mgr, PeerInfo("A"), seen_a.append,
+                                 interval_s=100)
+    # A pauses past the heartbeat window; the driver forgets it
+    time.sleep(0.15)
+    assert mgr.evict_dead() == ["A"]
+    # the loop's next beat hits "never registered" -> default on_evicted
+    # re-registers instead of killing the loop
+    a.beat_or_recover()
+    assert a.evicted_count == 1
+    assert "A" in mgr.live_executors()
+    # ...and the rejoined endpoint still discovers new peers
+    b = ShuffleHeartbeatEndpoint(mgr, PeerInfo("B"), lambda p: None,
+                                 interval_s=100)
+    a.beat_or_recover()
+    assert [p.executor_id for p in seen_a] == ["B"]
+    a.close()
+    b.close()
+
+
+def test_evicted_endpoint_custom_callback():
+    import time
+    mgr = ShuffleHeartbeatManager(heartbeat_timeout_s=0.1)
+    evictions = []
+    a = ShuffleHeartbeatEndpoint(mgr, PeerInfo("A"), lambda p: None,
+                                 interval_s=100,
+                                 on_evicted=lambda: evictions.append(1))
+    time.sleep(0.15)
+    mgr.evict_dead()
+    a.beat_or_recover()
+    assert evictions == [1]
+    # the custom callback chose NOT to re-register: still forgotten
+    assert "A" not in mgr.live_executors()
+    a.close()
+
+
 def test_reregistration_replaces_stale_endpoint():
     mgr = ShuffleHeartbeatManager()
     mgr.register_executor(PeerInfo("A", "h1", 1))
