@@ -31,13 +31,33 @@ def main():
     use_sql = "--sql" in argv
     if use_sql:
         argv.remove("--sql")
+    no_eventlog = "--no-eventlog" in argv
+    if no_eventlog:
+        argv.remove("--no-eventlog")
+    eventlog_dir = "/tmp/rapids_tpu_eventlog/bench"
+    if "--eventlog-dir" in argv:
+        i = argv.index("--eventlog-dir")
+        if i + 1 >= len(argv):
+            sys.exit("usage: bench.py [rows] [--sql] [--no-eventlog] "
+                     "[--eventlog-dir DIR]")
+        eventlog_dir = argv[i + 1]
+        del argv[i:i + 2]
     rows = int(argv[0]) if argv else 4_000_000
     table = lineitem_table(rows, seed=0)
 
-    session = TpuSession()
+    # event logs on by default: every bench run leaves a
+    # machine-readable artifact `python -m spark_rapids_tpu.tools`
+    # can profile/compare (disable with --no-eventlog to measure the
+    # observability-off steady state)
+    conf = {}
+    if not no_eventlog:
+        conf = {"spark.rapids.sql.eventLog.enabled": "true",
+                "spark.rapids.sql.eventLog.dir": eventlog_dir}
+    session = TpuSession(conf)
     q1_build = q1_sql if use_sql else q1_dataframe
 
     # cold: compile + upload + first run
+    session.next_query_tag = "q1_cold"
     t0 = time.perf_counter()
     _ = q1_build(session, table).collect_table()
     cold_s = time.perf_counter() - t0
@@ -47,8 +67,9 @@ def main():
     # from real regressions (VERDICT r4 weak #8)
     warms = []
     for _i in range(3):
+        session.next_query_tag = "q1"
         t0 = time.perf_counter()
-        tpu_result = q1_dataframe(session, table).collect_table()
+        tpu_result = q1_build(session, table).collect_table()
         warms.append(time.perf_counter() - t0)
     warms.sort()
     tpu_s = warms[0]
@@ -71,7 +92,9 @@ def main():
     # q3-style multi-join (broadcast-heavy plan shape): secondary detail
     from spark_rapids_tpu.models.tpch import q3_dataframe, q3_pandas, q3_tables
     cust, orders, li = q3_tables(rows // 4, seed=1)
+    session.next_query_tag = "q3_cold"
     _ = q3_dataframe(session, cust, orders, li).collect_table()  # warm
+    session.next_query_tag = "q3"
     t0 = time.perf_counter()
     q3_res = q3_dataframe(session, cust, orders, li).collect_table()
     q3_tpu_s = time.perf_counter() - t0

@@ -458,18 +458,27 @@ def build_sql_queries(s, tables):
             for name, text in sql_texts().items()}
 
 
-def time_query(fn, runs=3):
+def time_query(fn, runs=3, session=None, tag=None):
     """Cold run + `runs` warm trials; returns (cold, min, median).
 
     >=3 warm trials with a median bound so tunnel-latency variance is
     distinguishable from real regressions (the reference ScaleTest
     harness reports per-iteration times for the same reason —
-    ref: integration_tests/ScaleTest.md)."""
+    ref: integration_tests/ScaleTest.md). With a session+tag, every run
+    is tagged in the query event log (cold runs as <tag>_cold) so the
+    offline tools can match runs per query across reports."""
+
+    def _tag(suffix=""):
+        if session is not None and tag is not None:
+            session.next_query_tag = tag + suffix
+
+    _tag("_cold")
     t0 = time.perf_counter()
     fn().collect_table()
     cold = time.perf_counter() - t0
     warms = []
     for _ in range(runs):
+        _tag()
         t0 = time.perf_counter()
         fn().collect_table()
         warms.append(time.perf_counter() - t0)
@@ -653,6 +662,13 @@ def main():
                     help="datagen / fault-schedule seed (default 0; "
                          "chaos mode defaults to 7)")
     ap.add_argument("--out", type=str, default="")
+    ap.add_argument("--eventlog-dir", type=str,
+                    default="/tmp/rapids_tpu_eventlog/scale",
+                    help="directory for the per-query event log the "
+                         "offline tools analyze (written by default; "
+                         "--no-eventlog disables)")
+    ap.add_argument("--no-eventlog", action="store_true",
+                    help="disable query event logging")
     ap.add_argument("--chaos", action="store_true",
                     help="run the corpus fault-free and under a seeded "
                          "fault schedule, asserting bit-identical "
@@ -684,7 +700,13 @@ def main():
     gen_s = time.perf_counter() - t0
 
     build = build_sql_queries if args.sql else build_queries
-    tpu = TpuSession()
+    # event logs on by default so every SCALE artifact is analyzable by
+    # `python -m spark_rapids_tpu.tools profile/compare`
+    tpu_conf = {}
+    if not args.no_eventlog:
+        tpu_conf = {"spark.rapids.sql.eventLog.enabled": "true",
+                    "spark.rapids.sql.eventLog.dir": args.eventlog_dir}
+    tpu = TpuSession(tpu_conf)
     queries = build(tpu, tables)
     wanted = ([q.strip() for q in args.queries.split(",") if q.strip()]
               or list(queries))
@@ -695,11 +717,14 @@ def main():
         cpu_queries = build(cpu, tables)
 
     report = {"scale_factor": args.sf, "mode": "sql" if args.sql else "dsl",
+              "eventlog_dir": (args.eventlog_dir if not args.no_eventlog
+                               else None),
               "datagen_s": round(gen_s, 3),
               "rows": {k: t.num_rows for k, t in tables.items()},
               "queries": {}}
     for name in wanted:
-        cold, warm, warm_med = time_query(queries[name])
+        cold, warm, warm_med = time_query(queries[name], session=tpu,
+                                          tag=name)
         entry = {"cold_s": round(cold, 4), "warm_s": round(warm, 4),
                  "warm_med_s": round(warm_med, 4)}
         if cpu_queries is not None:

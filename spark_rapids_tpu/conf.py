@@ -631,6 +631,30 @@ def generate_docs() -> str:
         "verifies the TPC-H q1-q22 golden corpus in DSL and SQL form, "
         "with AQE on and off. `--list-rules` prints every rule id.",
         "",
+        "## Observability",
+        "",
+        "`spark.rapids.sql.eventLog.enabled` writes one structured "
+        "JSONL record per query under `spark.rapids.sql.eventLog.dir` "
+        "(`obs/events.py`): the executed plan tree with TYPED "
+        "per-operator metrics (timing/count/bytes at "
+        "ESSENTIAL/MODERATE/DEBUG levels — the unified registry in "
+        "`obs/metrics.py`, filtered by `spark.rapids.sql.metrics."
+        "level`), fallback reasons, circuit-breaker demotions, AQE "
+        "conversions, spill/retry/recovery counter deltas, shuffle "
+        "bytes per exchange, and query wall/phase times with span "
+        "attribution. `spark.rapids.trace.enabled` additionally "
+        "collects thread-aware host spans (exec boundaries, h2d/d2h "
+        "transfers, shuffle fetch/write/serialize, spill, kernel "
+        "dispatch) and exports a Chrome trace-event JSON per query "
+        "under `spark.rapids.trace.dir` — load it in Perfetto next to "
+        "the Xprof device trace `spark.rapids.profile.enabled` "
+        "collects. `bench.py` and `scale_test.py` write event logs by "
+        "default; `python -m spark_rapids_tpu.tools profile <log>` "
+        "builds the offline report (top operators by self time, "
+        "compute/transfer/shuffle/spill breakdown, per-exchange skew, "
+        "fallback inventory, >=95% span-attribution contract) and "
+        "`... compare A B` diffs two runs per-query/per-operator.",
+        "",
         "## Fault tolerance",
         "",
         "The `spark.rapids.shuffle.fetch.*` keys govern shuffle fetch "

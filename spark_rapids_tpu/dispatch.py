@@ -189,6 +189,7 @@ def tpu_jit(fn, **kwargs):
     name = getattr(fn, "__qualname__", getattr(fn, "__name__", "kernel"))
     profile = bool(os.environ.get("SRT_PROFILE_DISPATCH"))
 
+    from spark_rapids_tpu.obs.spans import TRACER
     from spark_rapids_tpu.runtime.faults import fault_point
 
     def call(*args, **kw):
@@ -196,14 +197,21 @@ def tpu_jit(fn, **kwargs):
             return jf(*args, **kw)
         fault_point("dispatch.kernel", op=name)
         count_dispatch()
-        if not profile:
-            return jf(*args, **kw)
-        import time
-        t0 = time.perf_counter()
-        res = jf(*args, **kw)
-        _sync_result(res)
-        DISPATCH_PROFILE.append((name, time.perf_counter() - t0))
-        return res
+        # host span per dispatch (async: covers enqueue, not device
+        # compute — Xprof owns the device timeline); one attribute read
+        # when the tracer is idle
+        sp = TRACER.begin(name, "dispatch") if TRACER.enabled else None
+        try:
+            if not profile:
+                return jf(*args, **kw)
+            import time
+            t0 = time.perf_counter()
+            res = jf(*args, **kw)
+            _sync_result(res)
+            DISPATCH_PROFILE.append((name, time.perf_counter() - t0))
+            return res
+        finally:
+            TRACER.end(sp)
 
     call.__wrapped__ = jf
     return call

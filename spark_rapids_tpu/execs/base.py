@@ -9,24 +9,17 @@ from typing import Iterator, List, Optional, Sequence, Tuple
 
 from spark_rapids_tpu import types as T
 from spark_rapids_tpu.columnar import DeviceTable, HostTable
+from spark_rapids_tpu.obs.metrics import (
+    METRIC_LEVELS,  # noqa: F401  (re-export: historical import site)
+    MetricSet,
+    set_metrics_level,  # noqa: F401  (re-export: the session's setter)
+)
 from spark_rapids_tpu.plan.nodes import PlanNode, Schema
-
-
-#: metric collection levels (reference: GpuMetric ESSENTIAL/MODERATE/DEBUG,
-#: GpuExec.scala:52-342). The session sets the active level from
-#: spark.rapids.sql.metrics.level; add_metric drops records above it.
-METRIC_LEVELS = {"ESSENTIAL": 0, "MODERATE": 1, "DEBUG": 2}
 
 #: spark.rapids.tpu.maskedBatches.enabled, set per-query by the session
 #: (execs have no conf handle — same pattern as retry.MAX_RETRIES_VAR)
 MASKED_ENABLED = contextvars.ContextVar("rapids_masked_batches",
                                         default=True)
-_ACTIVE_METRIC_LEVEL = [1]  # MODERATE default
-
-
-def set_metrics_level(name: str) -> None:
-    _ACTIVE_METRIC_LEVEL[0] = METRIC_LEVELS.get(
-        str(name).upper(), METRIC_LEVELS["MODERATE"])
 
 
 class TpuExec:
@@ -48,7 +41,7 @@ class TpuExec:
     produces_masked = False
 
     def __init__(self):
-        self.metrics = {}
+        self.metrics = MetricSet()
 
     def output_schema(self) -> Schema:
         raise NotImplementedError
@@ -75,10 +68,11 @@ class TpuExec:
             s += c.tree_string(indent + 1)
         return s
 
-    def add_metric(self, key: str, value, level: str = "MODERATE"):
-        if METRIC_LEVELS.get(level, 1) > _ACTIVE_METRIC_LEVEL[0]:
-            return
-        self.metrics[key] = self.metrics.get(key, 0) + value
+    def add_metric(self, key: str, value, level: Optional[str] = None):
+        """Record into the unified registry (obs/metrics.py). ``level``
+        None resolves from the metric's registered spec (undeclared
+        names default to MODERATE — the historical behavior)."""
+        self.metrics.add(key, value, level)
 
 
 class HostToDevice(TpuExec):
@@ -96,7 +90,7 @@ class HostToDevice(TpuExec):
         from spark_rapids_tpu.runtime.profiler import op_range
         for batch in self.cpu_node.execute_cpu():
             t0 = time.perf_counter()
-            with op_range("HostToDevice"):
+            with op_range("HostToDevice", cat="transfer"):
                 dt = DeviceTable.from_host(batch)
             self.add_metric("h2dTime", time.perf_counter() - t0)
             self.add_metric("h2dBatches", 1)
@@ -115,25 +109,27 @@ class DeviceToHost:
 
     def __init__(self, tpu_exec: TpuExec):
         self.tpu_exec = tpu_exec
-        self.metrics = {}
+        self.metrics = MetricSet()
 
     def output_schema(self):
         return self.tpu_exec.output_schema()
+
+    def add_metric(self, key: str, value, level: Optional[str] = None):
+        """Same level-honoring path as TpuExec.add_metric, so
+        spark.rapids.sql.metrics.level applies to transitions too."""
+        self.metrics.add(key, value, level)
 
     def execute_cpu(self) -> Iterator[HostTable]:
         from spark_rapids_tpu.runtime.profiler import op_range
         for dt in self.tpu_exec.execute():
             t0 = time.perf_counter()
-            with op_range("DeviceToHost"):
+            with op_range("DeviceToHost", cat="transfer"):
                 host = dt.to_host()
             # incremental so an early-terminating consumer (limit) still
             # leaves accurate numbers; measures ONLY the d2h conversion
-            self.metrics["d2hTime"] = (self.metrics.get("d2hTime", 0.0)
-                                       + time.perf_counter() - t0)
-            self.metrics["numOutputBatches"] = \
-                self.metrics.get("numOutputBatches", 0) + 1
-            self.metrics["numOutputRows"] = \
-                self.metrics.get("numOutputRows", 0) + host.num_rows
+            self.add_metric("d2hTime", time.perf_counter() - t0)
+            self.add_metric("numOutputBatches", 1)
+            self.add_metric("numOutputRows", host.num_rows)
             yield host
 
     def describe(self):

@@ -21,6 +21,9 @@ def main(argv=None) -> int:
     ap.add_argument("--skip-plans", action="store_true",
                     help="skip golden-suite (TPC-H q1-q22) plan "
                          "verification")
+    ap.add_argument("--skip-exec-metrics", action="store_true",
+                    help="skip the RA-ESSENTIAL-METRICS executed-corpus "
+                         "audit (runs a golden-corpus slice)")
     ap.add_argument("--sf", type=float, default=0.01,
                     help="scale factor for golden-suite table generation")
     ap.add_argument("--list-rules", action="store_true",
@@ -61,6 +64,12 @@ def main(argv=None) -> int:
         print(f"golden-suite plan verify: {len(plans)} diagnostic(s)")
         diags += plans
         ran.append("golden-suite plans")
+    if not args.skip_exec_metrics:
+        from spark_rapids_tpu.lint.registry_audit import audit_exec_metrics
+        em = audit_exec_metrics()
+        print(f"exec-metrics audit: {len(em)} diagnostic(s)")
+        diags += em
+        ran.append("exec metrics")
 
     for d in diags:
         print(str(d))

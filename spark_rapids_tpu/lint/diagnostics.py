@@ -61,6 +61,11 @@ RULES: Dict[str, str] = {
                         "generator output",
     "RA-DOC-DRIFT-CONFIGS": "committed CONFIGS.md differs from the "
                             "generator output",
+    "RA-ESSENTIAL-METRICS": "an executed exec failed to emit the "
+                            "ESSENTIAL opTime/numOutputRows/"
+                            "numOutputBatches metrics after a "
+                            "golden-corpus run (observation boundary "
+                            "not installed or bypassed)",
     # -- repo lint ----------------------------------------------------------
     "RL-HOST-SYNC": "host synchronization in an execs/ or ops/ hot path "
                     "outside the sanctioned dispatch helpers",

@@ -232,6 +232,70 @@ def regenerate_docs(repo_root: Optional[str] = None) -> List[str]:
     return written
 
 
+#: golden-corpus slice the metrics audit executes: one query per major
+#: exec family (agg, join, sort+limit, window, exchange) — enough to
+#: observe every hot exec class without running all 22
+_METRICS_AUDIT_QUERIES = ("q1", "q3", "q5", "q6", "q7")
+
+
+def audit_exec_metrics_tree(executable,
+                            diags: List[Diagnostic],
+                            context: str = "") -> None:
+    """RA-ESSENTIAL-METRICS over ONE executed tree: every device exec
+    (and the DeviceToHost root) that ran must carry the ESSENTIAL
+    opTime/numOutputRows/numOutputBatches metrics. An exec whose
+    metrics are entirely empty never ran (a lazily-pulled branch an
+    early-terminating consumer abandoned) — skipped, EXCEPT the root,
+    whose silence means the observation boundary was never installed."""
+    from spark_rapids_tpu.execs.base import DeviceToHost, TpuExec
+    from spark_rapids_tpu.lore import _iter_tree
+    from spark_rapids_tpu.obs.metrics import ESSENTIAL_EXEC_METRICS
+
+    root = executable
+    for e in _iter_tree(executable):
+        if not isinstance(e, (TpuExec, DeviceToHost)):
+            continue
+        name = type(e).__name__
+        where = f"{context}{name}[loreId={getattr(e, '_lore_id', '?')}]"
+        m = getattr(e, "metrics", None) or {}
+        if not m:
+            if e is root:
+                diags.append(make(
+                    "RA-ESSENTIAL-METRICS", where,
+                    "root of an executed plan has NO metrics — the "
+                    "observation boundary was never installed"))
+            continue
+        missing = [k for k in ESSENTIAL_EXEC_METRICS if k not in m]
+        if missing:
+            diags.append(make(
+                "RA-ESSENTIAL-METRICS", where,
+                f"executed exec is missing ESSENTIAL metric(s) "
+                f"{', '.join(missing)}"))
+
+
+def audit_exec_metrics(scale_factor: float = 0.005,
+                       queries=_METRICS_AUDIT_QUERIES) -> List[Diagnostic]:
+    """Execute a golden-corpus slice and assert every exec that ran
+    emitted its ESSENTIAL metrics (the obs/spans.install_observation
+    contract — an exec class overriding execute without riding the
+    boundary shows up here, not as silently-missing tool data)."""
+    from spark_rapids_tpu.lint.golden import _load_scale_test, golden_tables
+    from spark_rapids_tpu.obs.spans import finalize_observation
+    from spark_rapids_tpu.session import TpuSession
+
+    st = _load_scale_test()
+    tables = golden_tables(scale_factor)
+    session = TpuSession()
+    corpus = st.build_queries(session, tables)
+    diags: List[Diagnostic] = []
+    for name in queries:
+        corpus[name]().collect_table()
+        executable = session._last_executable
+        finalize_observation(executable)
+        audit_exec_metrics_tree(executable, diags, context=f"{name}:")
+    return diags
+
+
 def audit_registry(repo_root: Optional[str] = None) -> List[Diagnostic]:
     _import_full_package()
     diags: List[Diagnostic] = []

@@ -96,17 +96,23 @@ class _TeeChild:
 def _strip_for_pickle(exec_obj):
     import copy
     clone = copy.copy(exec_obj)
+    from spark_rapids_tpu.obs.metrics import MetricSet
     for a in _STRIP_ATTRS:
         if hasattr(clone, a):
             try:
-                setattr(clone, a, None if a != "metrics" else {})
+                # metrics must stay a MetricSet: add_metric routes
+                # through MetricSet.add, so a plain {} would crash the
+                # replayed exec's first metric record
+                setattr(clone, a, None if a != "metrics" else MetricSet())
             except AttributeError:
                 pass
     # fault-boundary wrappers (runtime/faults.install_fault_boundaries)
-    # are instance-attribute closures: unpicklable, and a replayed exec
+    # and observation wrappers (obs/spans.install_observation) are
+    # instance-attribute closures: unpicklable, and a replayed exec
     # wants the plain class methods anyway. DELETE (not None) so the
     # class methods resurface.
-    for a in ("execute", "execute_masked", "_fault_guarded"):
+    for a in ("execute", "execute_masked", "_fault_guarded",
+              "_obs_installed", "_obs_depth", "_obs_pending_rows"):
         clone.__dict__.pop(a, None)
     # children are replaced by scans at replay; drop them from the pickle
     if hasattr(clone, "children"):
@@ -184,8 +190,9 @@ def replay(dump_dir: str) -> HostTable:
             i += 1
         kids.append(TpuScanExec(batches, device_cache=False))
     exec_obj.children = tuple(kids)
-    if not hasattr(exec_obj, "metrics") or exec_obj.metrics is None:
-        exec_obj.metrics = {}
+    from spark_rapids_tpu.obs.metrics import MetricSet
+    if not isinstance(getattr(exec_obj, "metrics", None), MetricSet):
+        exec_obj.metrics = MetricSet()
     # per-process kernel caches rebuild lazily; joins re-pool their kernel
     if hasattr(exec_obj, "left_keys") and getattr(exec_obj, "_kernel", 1) is None:
         from spark_rapids_tpu.execs.join import JoinKernel

@@ -1,0 +1,211 @@
+"""Per-query structured event log (JSONL).
+
+Reference: the Spark event log that spark-rapids-tools' qualification /
+profiling analyzers consume — the machine-readable record every perf PR
+diffs instead of hand-timing (PERF.md's essay form). One JSON object per
+completed query, written by ``TpuSession.execute`` when
+``spark.rapids.sql.eventLog.enabled`` is set:
+
+* the executed plan tree with per-operator typed metrics and lore ids;
+* fallback reasons (overrides tagging) and circuit-breaker demotions;
+* AQE runtime conversions, spill / retry / fault-recovery counter
+  deltas, per-exchange shuffle bytes;
+* query wall / phase times and the span summary (category totals,
+  attribution of wall time to named spans).
+
+``python -m spark_rapids_tpu.tools`` analyzes these offline; the record
+schema is versioned and pinned by a golden test so drift breaks a test,
+not the tools.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import uuid
+from typing import Dict, List, Optional
+
+from spark_rapids_tpu.conf import bool_conf, str_conf
+
+EVENT_LOG_ENABLED = bool_conf(
+    "spark.rapids.sql.eventLog.enabled", False,
+    "Write one structured JSONL record per executed query (plan tree "
+    "with per-op metrics, fallback/demotion reasons, recovery counters, "
+    "span attribution) under spark.rapids.sql.eventLog.dir — the input "
+    "to `python -m spark_rapids_tpu.tools`.", commonly_used=True)
+
+EVENT_LOG_DIR = str_conf(
+    "spark.rapids.sql.eventLog.dir", "/tmp/rapids_tpu_eventlog",
+    "Directory for query event logs (one events-<session>.jsonl per "
+    "session).")
+
+#: bump on ANY record shape change and update the golden test — the
+#: offline tools key off this
+EVENT_SCHEMA_VERSION = 1
+
+
+def plan_tree(executable) -> dict:
+    """The executed tree as nested dicts: operator name, lore id,
+    describe() and TYPED metrics per node (children include transition/
+    adapter links, matching lore's tree walk)."""
+    from spark_rapids_tpu.obs.metrics import MetricSet
+
+    def node(e) -> dict:
+        m = getattr(e, "metrics", None)
+        if isinstance(m, MetricSet):
+            metrics = m.typed()
+        elif m:
+            metrics = {k: {"value": v, "kind": "count",
+                           "level": "MODERATE"}
+                       for k, v in sorted(m.items())}
+        else:
+            metrics = {}
+        d = {
+            "op": type(e).__name__,
+            "describe": e.describe() if hasattr(e, "describe")
+            else type(e).__name__,
+            "loreId": getattr(e, "_lore_id", None),
+            "metrics": metrics,
+            "children": [],
+        }
+        for c in getattr(e, "children", ()):
+            d["children"].append(node(c))
+        for attr in ("source", "tpu_exec", "cpu_node", "scan_node"):
+            nxt = getattr(e, attr, None)
+            if nxt is not None:
+                d["children"].append(node(nxt))
+        return d
+
+    return node(executable)
+
+
+def collect_fallbacks(meta) -> List[dict]:
+    """Flatten the overrides meta tree into [{op, reasons}] for every
+    node tagged with fallback reasons."""
+    out: List[dict] = []
+
+    def walk(m):
+        if m is None:
+            return
+        reasons = list(getattr(m, "reasons", ()) or ())
+        if reasons:
+            out.append({"op": type(getattr(m, "node", m)).__name__,
+                        "reasons": reasons})
+        for c in getattr(m, "children", ()) or ():
+            walk(c)
+
+    walk(meta)
+    return out
+
+
+def _walk_exec_tree(executable):
+    from spark_rapids_tpu.lore import _iter_tree
+    return _iter_tree(executable)
+
+
+def collect_exchanges(executable) -> List[dict]:
+    """Per-exchange shuffle summary from the executed tree's metrics —
+    bytes, times, skew and AQE coalescing per exchange node."""
+    keys = ("shuffleBytesWritten", "shuffleBytesRead", "shuffleWriteTime",
+            "shuffleReadTime", "mapOutputBytesMax", "mapOutputBytesMedian",
+            "skewedPartitions", "aqeCoalescedPartitions",
+            "recomputedMapOutputs", "iciExchangeTime", "iciPartitions",
+            "localSplitParts", "localSplitTime")
+    out = []
+    for e in _walk_exec_tree(executable):
+        m = getattr(e, "metrics", None)
+        if not m or not any(k in m for k in keys):
+            continue
+        entry = {"op": type(e).__name__,
+                 "loreId": getattr(e, "_lore_id", None)}
+        entry.update({k: m[k] for k in keys if k in m})
+        out.append(entry)
+    return out
+
+
+def collect_aqe(executable) -> Dict[str, int]:
+    """AQE runtime re-plan summary (measured broadcast conversions,
+    coalesced partitions) aggregated over the tree."""
+    totals = {"broadcastConversions": 0, "coalescedPartitions": 0}
+    for e in _walk_exec_tree(executable):
+        m = getattr(e, "metrics", None)
+        if not m:
+            continue
+        totals["broadcastConversions"] += int(m.get("aqeBroadcastConverted",
+                                                    0))
+        totals["coalescedPartitions"] += int(m.get("aqeCoalescedPartitions",
+                                                   0))
+    return totals
+
+
+def build_query_record(*, query_index: int, wall_s: float,
+                       phases: Dict[str, float], executable, meta,
+                       sql_text: Optional[str], query_tag: Optional[str],
+                       dispatches: int, recovery_delta: Dict[str, int],
+                       scope_deltas: Dict[str, dict],
+                       fault_fires: Dict[str, int],
+                       demotions: Dict[str, str],
+                       spans_summary: Optional[dict],
+                       fault_replays: int) -> dict:
+    """Assemble one event-log record. Every field is JSON-native; the
+    golden schema test normalizes timings and pins the shape."""
+    return {
+        "schema": EVENT_SCHEMA_VERSION,
+        "event": "queryCompleted",
+        "queryIndex": query_index,
+        "queryTag": query_tag,
+        "sqlText": sql_text,
+        "wallS": round(wall_s, 6),
+        "phasesS": {k: round(v, 6) for k, v in sorted(phases.items())},
+        "dispatches": dispatches,
+        "faultReplays": fault_replays,
+        "plan": plan_tree(executable),
+        "fallbacks": collect_fallbacks(meta),
+        "demotions": dict(demotions),
+        "aqe": collect_aqe(executable),
+        "exchanges": collect_exchanges(executable),
+        "recovery": dict(recovery_delta),
+        "scopes": scope_deltas,
+        "faultFires": dict(fault_fires),
+        "spans": spans_summary,
+    }
+
+
+class QueryEventWriter:
+    """Appends one JSON line per query to a per-session file under the
+    configured directory. Lazy: the file is created at the first
+    record, so enabling the conf on an idle session writes nothing."""
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        self.path = os.path.join(
+            directory, f"events-{uuid.uuid4().hex[:12]}.jsonl")
+        self._lock = threading.Lock()
+        self.records_written = 0
+
+    def write(self, record: dict) -> str:
+        line = json.dumps(record, sort_keys=True)
+        with self._lock:
+            os.makedirs(self.directory, exist_ok=True)
+            with open(self.path, "a") as f:
+                f.write(line + "\n")
+            self.records_written += 1
+        return self.path
+
+
+def scope_delta(before: Dict[str, dict],
+                after: Dict[str, dict]) -> Dict[str, dict]:
+    """Per-scope numeric deltas between two scopes_snapshot() calls —
+    only keys that moved, so idle subsystems stay out of the record."""
+    out: Dict[str, dict] = {}
+    for scope, vals in after.items():
+        prev = before.get(scope, {})
+        moved = {}
+        for k, v in vals.items():
+            d = v - prev.get(k, 0)
+            if d:
+                moved[k] = round(d, 6) if isinstance(d, float) else d
+        if moved:
+            out[scope] = moved
+    return out

@@ -1,0 +1,389 @@
+"""Thread-aware host-side span tracer + exec-boundary instrumentation.
+
+Reference (SURVEY.md §5): NVTX ranges (``NvtxWithMetrics.scala``) put
+operator ranges on the DEVICE timeline; nothing in the reference shows
+where HOST wall time goes — which on the tunneled TPU is where queries
+actually live (transfers, shuffle IO, serialization, spill). This
+tracer records host spans (enter/exit wall times, thread, parent,
+query/op attribution) and exports Chrome trace-event JSON, so a host
+timeline loads in Perfetto/chrome://tracing NEXT TO the Xprof device
+trace the profiler collects.
+
+Two layers:
+
+* :class:`SpanTracer` / the process-wide :data:`TRACER` — collection is
+  enabled per query by the session (``spark.rapids.trace.enabled``, or
+  implicitly while the event log needs attribution). Disabled cost is
+  one attribute read per site.
+* :func:`install_observation` — the per-query exec-boundary wrapper
+  (the ``install_fault_boundaries`` threading pattern from PR 3): every
+  device exec's ``execute``/``execute_masked`` and the ``DeviceToHost``
+  root get (a) a span per batch pull when tracing, and (b) the
+  ESSENTIAL ``opTime``/``numOutputRows``/``numOutputBatches`` metrics
+  ALWAYS — row counts that only exist on device are deferred and
+  resolved in ONE batched fetch by :func:`finalize_observation`, never
+  a per-batch sync.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Dict, List, Optional
+
+from spark_rapids_tpu.conf import bool_conf, str_conf
+
+TRACE_ENABLED = bool_conf(
+    "spark.rapids.trace.enabled", False,
+    "Collect host-side spans for every query and export a Chrome "
+    "trace-event JSON per query under spark.rapids.trace.dir — load it "
+    "in Perfetto next to the Xprof device trace.")
+
+TRACE_DIR = str_conf(
+    "spark.rapids.trace.dir", "/tmp/rapids_tpu_trace",
+    "Directory for exported Chrome trace JSON files (one "
+    "query_<N>.trace.json per traced query).")
+
+#: hard cap on buffered spans per query (a runaway batch loop must
+#: degrade the trace, not the process); dropped spans are counted
+_MAX_SPANS = 200_000
+
+
+class Span:
+    __slots__ = ("sid", "name", "cat", "t0", "t1", "tid", "tname",
+                 "parent", "args")
+
+    def __init__(self, sid, name, cat, t0, tid, tname, parent, args):
+        self.sid = sid
+        self.name = name
+        self.cat = cat
+        self.t0 = t0
+        self.t1 = None
+        self.tid = tid
+        self.tname = tname
+        self.parent = parent
+        self.args = args
+
+    @property
+    def dur(self) -> float:
+        return (self.t1 - self.t0) if self.t1 is not None else 0.0
+
+
+class _NoopSpan:
+    __slots__ = ()
+
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NOOP = _NoopSpan()
+
+
+class _LiveSpan:
+    __slots__ = ("tracer", "span")
+
+    def __init__(self, tracer, span):
+        self.tracer = tracer
+        self.span = span
+
+    def __enter__(self):
+        return self.span
+
+    def __exit__(self, *exc):
+        self.tracer.end(self.span)
+        return False
+
+
+class SpanTracer:
+    """Process-wide span collector. ``enabled`` gates every record path;
+    spans buffer between ``begin_query``/``end_query`` and drain into
+    the caller (the session's event-log writer / trace exporter)."""
+
+    def __init__(self):
+        self.enabled = False
+        self._lock = threading.Lock()
+        self._spans: List[Span] = []
+        self._dropped = 0
+        self._next_id = 0
+        self._tls = threading.local()
+        self.query_id: Optional[int] = None
+        self.main_tid: Optional[int] = None
+        self._query_t0: Optional[float] = None
+
+    # -- per-thread span stack ---------------------------------------------
+    def _stack(self) -> list:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    # -- collection --------------------------------------------------------
+    def begin_query(self, query_id: int) -> None:
+        # a failed prior query can leave unclosed spans on this thread's
+        # stack (exception unwound mid-phase); start clean
+        self._stack().clear()
+        with self._lock:
+            self._spans = []
+            self._dropped = 0
+            self.query_id = query_id
+            self.main_tid = threading.get_ident()
+            self._query_t0 = time.perf_counter()
+            self.enabled = True
+
+    def end_query(self) -> List[Span]:
+        """Stop collecting and return the query's finished spans."""
+        with self._lock:
+            self.enabled = False
+            spans = [s for s in self._spans if s.t1 is not None]
+            self._spans = []
+            self.query_id = None
+            return spans
+
+    @property
+    def dropped(self) -> int:
+        return self._dropped
+
+    def begin(self, name: str, cat: str = "op", **args) -> Optional[Span]:
+        if not self.enabled:
+            return None
+        st = self._stack()
+        parent = st[-1].sid if st else None
+        tid = threading.get_ident()
+        with self._lock:
+            if len(self._spans) >= _MAX_SPANS:
+                self._dropped += 1
+                return None
+            self._next_id += 1
+            sp = Span(self._next_id, name, cat, time.perf_counter(), tid,
+                      threading.current_thread().name, parent, args or None)
+            self._spans.append(sp)
+        st.append(sp)
+        return sp
+
+    def end(self, span: Optional[Span]) -> None:
+        if span is None or span.t1 is not None:
+            return  # idempotent: an error path may re-end a closed span
+        span.t1 = time.perf_counter()
+        st = self._stack()
+        if st and st[-1] is span:
+            st.pop()
+        elif span in st:        # exception unwound past nested spans
+            while st and st[-1] is not span:
+                st.pop().t1 = span.t1
+            if st:
+                st.pop()
+
+    def span(self, name: str, cat: str = "op", **args):
+        """Context manager; zero-allocation no-op when disabled."""
+        if not self.enabled:
+            return _NOOP
+        return _LiveSpan(self, self.begin(name, cat, **args))
+
+
+TRACER = SpanTracer()
+
+
+def span(name: str, cat: str = "op", **args):
+    return TRACER.span(name, cat, **args)
+
+
+# ---------------------------------------------------------------------------
+# Chrome trace-event export
+# ---------------------------------------------------------------------------
+
+
+def to_chrome_trace(spans: List[Span], query_id=None) -> dict:
+    """Chrome trace-event JSON (the ``traceEvents`` array form) — loads
+    in Perfetto / chrome://tracing. Timestamps are microseconds on the
+    perf_counter clock; complete events (``ph: "X"``) carry durations."""
+    events = []
+    threads = {}
+    for s in spans:
+        threads.setdefault(s.tid, s.tname)
+        ev = {"name": s.name, "cat": s.cat, "ph": "X",
+              "ts": round(s.t0 * 1e6, 3), "dur": round(s.dur * 1e6, 3),
+              "pid": 1, "tid": s.tid}
+        if s.args:
+            ev["args"] = dict(s.args)
+        events.append(ev)
+    for tid, tname in sorted(threads.items()):
+        events.append({"name": "thread_name", "ph": "M", "pid": 1,
+                       "tid": tid, "args": {"name": tname}})
+    trace = {"traceEvents": events, "displayTimeUnit": "ms"}
+    if query_id is not None:
+        trace["otherData"] = {"query": query_id}
+    return trace
+
+
+def write_chrome_trace(path: str, spans: List[Span], query_id=None) -> str:
+    with open(path, "w") as f:
+        json.dump(to_chrome_trace(spans, query_id), f)
+    return path
+
+
+# ---------------------------------------------------------------------------
+# Span aggregation (the event record's span summary)
+# ---------------------------------------------------------------------------
+
+
+def union_seconds(intervals) -> float:
+    """Total length covered by at least one [t0, t1) interval."""
+    total = 0.0
+    end = None
+    for t0, t1 in sorted(intervals):
+        if end is None or t0 > end:
+            total += t1 - t0
+            end = t1
+        elif t1 > end:
+            total += t1 - end
+            end = t1
+    return total
+
+
+def summarize_spans(spans: List[Span], main_tid: Optional[int],
+                    wall_s: float) -> dict:
+    """Per-query span summary: category totals (union per category, so
+    nesting never double-counts), attribution of the query wall to
+    NAMED spans on the query's main thread, and worker-thread totals."""
+    by_cat: Dict[str, list] = {}
+    main_intervals = []
+    worker: Dict[str, list] = {}
+    for s in spans:
+        by_cat.setdefault(s.cat, []).append((s.t0, s.t1))
+        if s.tid == main_tid:
+            if s.cat != "query":
+                main_intervals.append((s.t0, s.t1))
+        else:
+            worker.setdefault(s.cat, []).append((s.t0, s.t1))
+    attributed = min(union_seconds(main_intervals), wall_s)
+    return {
+        "byCategoryS": {c: round(union_seconds(iv), 6)
+                        for c, iv in sorted(by_cat.items())},
+        "workerByCategoryS": {c: round(union_seconds(iv), 6)
+                              for c, iv in sorted(worker.items())},
+        "attributedS": round(attributed, 6),
+        "untrackedS": round(max(wall_s - attributed, 0.0), 6),
+        "spanCount": len(spans),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Exec-boundary instrumentation
+# ---------------------------------------------------------------------------
+
+
+def _observed(fn, e, name: str, count_output: bool):
+    """Wrap one execute/execute_masked with per-pull spans + metrics.
+    The per-instance ``_obs_depth`` guard keeps the two protocol layers
+    of one exec (execute() delegating to execute_masked() or vice
+    versa, both instance-wrapped) from double-counting a batch."""
+
+    def wrapped(*args, **kwargs):
+        it = fn(*args, **kwargs)
+        while True:
+            if e._obs_depth:
+                # inner protocol layer of the SAME exec: pass through
+                try:
+                    batch = next(it)
+                except StopIteration:
+                    return
+                yield batch
+                continue
+            e._obs_depth = 1
+            t0 = time.perf_counter()
+            sp = TRACER.begin(name, "exec") if TRACER.enabled else None
+            stop = False
+            try:
+                try:
+                    batch = next(it)
+                except StopIteration:
+                    stop = True
+            finally:
+                TRACER.end(sp)
+                e._obs_depth = 0
+                e.metrics.add("opTime", time.perf_counter() - t0)
+            if stop:
+                if count_output:
+                    # presence contract: an exec that ran to exhaustion
+                    # always reports its output counts, even when zero
+                    e.metrics.add("numOutputBatches", 0)
+                    e.metrics.add("numOutputRows", 0)
+                return
+            if count_output:
+                e.metrics.add("numOutputBatches", 1)
+                nh = getattr(batch, "_nrows_host", None)
+                if nh is not None:
+                    e.metrics.add("numOutputRows", int(nh))
+                else:
+                    nd = getattr(batch, "nrows_dev", None)
+                    if nd is not None:
+                        # defer: nrows_dev is a tiny standalone device
+                        # scalar — holding it pins ~4 bytes, not the
+                        # table; finalize_observation fetches ALL
+                        # pending counts in one host round trip
+                        e._obs_pending_rows.append(nd)
+                    else:
+                        e.metrics.add("numOutputRows",
+                                      int(getattr(batch, "num_rows", 0)))
+            yield batch
+
+    return wrapped
+
+
+def install_observation(executable) -> None:
+    """Wrap every device exec (and the DeviceToHost root) in the
+    converted tree with the observation boundary. Installed per query by
+    the session AFTER install_fault_boundaries, so spans/metrics see the
+    fault-injected failures too. Idempotent per instance."""
+    from spark_rapids_tpu.execs.base import DeviceToHost, TpuExec
+    from spark_rapids_tpu.lore import _iter_tree
+    for e in _iter_tree(executable):
+        if getattr(e, "_obs_installed", False):
+            continue
+        if isinstance(e, TpuExec):
+            e._obs_installed = True
+            e._obs_depth = 0
+            e._obs_pending_rows = []
+            name = type(e).__name__
+            e.execute = _observed(e.execute, e, name, count_output=True)
+            e.execute_masked = _observed(e.execute_masked, e, name,
+                                         count_output=True)
+        elif isinstance(e, DeviceToHost):
+            # DeviceToHost counts its own output rows on host (they are
+            # free there) — the wrapper only adds opTime + the span
+            e._obs_installed = True
+            e._obs_depth = 0
+            e._obs_pending_rows = []
+            e.execute_cpu = _observed(e.execute_cpu, e, "DeviceToHost",
+                                      count_output=False)
+
+
+def finalize_observation(executable) -> None:
+    """Resolve every deferred device row count in the tree with ONE
+    batched host fetch (a single tunnel round trip however many execs
+    deferred), folding the sums into each exec's ``numOutputRows``.
+    Called lazily — by the event-log writer, ``session.last_metrics``
+    and the metrics audit — so a query nobody inspects never pays the
+    sync."""
+    from spark_rapids_tpu.lore import _iter_tree
+    owners = []
+    scalars = []
+    for e in _iter_tree(executable):
+        pend = getattr(e, "_obs_pending_rows", None)
+        if pend:
+            owners.append((e, len(pend)))
+            scalars.extend(pend)
+            e._obs_pending_rows = []
+    if not scalars:
+        return
+    from spark_rapids_tpu.dispatch import host_fetch
+    fetched = host_fetch(scalars)
+    i = 0
+    for e, n in owners:
+        total = sum(int(v) for v in fetched[i:i + n])
+        i += n
+        e.metrics.add("numOutputRows", total)

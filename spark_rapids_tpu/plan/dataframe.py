@@ -265,6 +265,11 @@ class DataFrame:
 
     def collect_table(self) -> HostTable:
         if self.session is not None:
+            # SQL-origin DataFrames carry their text; hand it to the
+            # session so the query event log records it
+            sql_text = getattr(self, "sql_text", None)
+            if sql_text is not None:
+                self.session.next_query_sql = sql_text
             return self.session.execute(self.plan)
         return self.plan.collect_cpu()
 

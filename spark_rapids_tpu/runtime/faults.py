@@ -265,18 +265,30 @@ def fault_point(name: str, op: Optional[str] = None, data=None):
 
 class RecoveryStats:
     """Process-wide counters for every recovery action the engine takes;
-    chaos runs snapshot/diff these to report and bound recovery work."""
+    chaos runs snapshot/diff these to report and bound recovery work.
+    Backed by the unified metric registry's ``recovery`` scope
+    (obs/metrics.py) so the event log reads the same numbers."""
 
     FIELDS = ("fetch_retries", "peer_exclusions", "recomputed_maps",
               "demotions", "query_replays")
 
     def __init__(self):
+        from spark_rapids_tpu.obs.metrics import (
+            metric_scope,
+            register_metric,
+        )
         self._lock = threading.Lock()
-        self._counts = {f: 0 for f in self.FIELDS}
+        self._counts = metric_scope("recovery")
+        for f in self.FIELDS:
+            register_metric(f, "count", "ESSENTIAL",
+                            f"recovery action counter ({f})")
+            self._counts.setdefault(f, 0)
 
     def bump(self, field: str, n: int = 1) -> None:
+        if field not in self._counts:
+            raise KeyError(field)  # typo'd field, fail loud
         with self._lock:
-            self._counts[field] += n  # KeyError = typo'd field, fail loud
+            self._counts.add(field, n)
 
     def snapshot(self) -> Dict[str, int]:
         with self._lock:
@@ -284,7 +296,8 @@ class RecoveryStats:
 
     def reset(self) -> None:
         with self._lock:
-            self._counts = {f: 0 for f in self.FIELDS}
+            for f in self.FIELDS:
+                self._counts[f] = 0
 
 
 RECOVERY = RecoveryStats()

@@ -39,7 +39,27 @@ PROFILE_QUERY_RANGES = str_conf(
 
 def parse_ranges(spec: str) -> Optional[Set[int]]:
     """\"1-3,8\" -> {1,2,3,8}; empty/blank -> None (match all)
-    (RangeConfMatcher.scala analog)."""
+    (RangeConfMatcher.scala analog).
+
+    Malformed specs raise a ValueError NAMING the conf key — the
+    profiler parses at conf-read time (TpuProfiler.__init__), so a typo
+    fails the session's first execute with an actionable message
+    instead of a bare int() traceback at the first profiled query."""
+    key = PROFILE_QUERY_RANGES.key
+
+    def _bound(text: str, part: str) -> int:
+        text = text.strip()
+        try:
+            v = int(text)
+        except ValueError:
+            raise ValueError(
+                f"{key}: range entry {part!r} has non-integer bound "
+                f"{text!r} (expected e.g. \"0-2,5\")") from None
+        if v < 0:
+            raise ValueError(
+                f"{key}: range entry {part!r} has negative bound {v}")
+        return v
+
     spec = (spec or "").strip()
     if not spec:
         return None
@@ -49,10 +69,19 @@ def parse_ranges(spec: str) -> Optional[Set[int]]:
         if not part:
             continue
         if "-" in part:
-            lo, _, hi = part.partition("-")
-            out.update(range(int(lo), int(hi) + 1))
+            lo_s, _, hi_s = part.partition("-")
+            if not lo_s.strip() or not hi_s.strip():
+                raise ValueError(
+                    f"{key}: range entry {part!r} is missing a bound "
+                    f"(expected \"<lo>-<hi>\")")
+            lo, hi = _bound(lo_s, part), _bound(hi_s, part)
+            if lo > hi:
+                raise ValueError(
+                    f"{key}: range entry {part!r} is reversed "
+                    f"({lo} > {hi})")
+            out.update(range(lo, hi + 1))
         else:
-            out.add(int(part))
+            out.add(_bound(part, part))
     return out
 
 
@@ -62,10 +91,12 @@ class TpuProfiler:
     def __init__(self, conf: RapidsConf):
         self.enabled = bool(conf.get_entry(PROFILE_ENABLED))
         self.path_prefix = str(conf.get_entry(PROFILE_PATH))
+        # conf-read-time validation: a malformed queryRanges spec fails
+        # HERE with the conf key named, not at the first profiled query
         self.ranges = parse_ranges(str(conf.get_entry(PROFILE_QUERY_RANGES)))
         self._query_index = 0
         self._lock = threading.Lock()
-        self._active_path: Optional[str] = None
+        self._active = 0
         self.sessions_written = 0
 
     def should_profile(self, query_index: int) -> bool:
@@ -75,29 +106,27 @@ class TpuProfiler:
     @contextlib.contextmanager
     def profile_query(self):
         """Wrap one query execution in a trace session; traces land under
-        <prefix>/query_<N>/."""
+        <prefix>/query_<N>/.
+
+        Only TOP-LEVEL queries advance the query index: a nested query
+        (cached-relation materialization inside an outer execute) rides
+        the outer trace session and must NOT burn a ``queryRanges``
+        slot, or every index after it would drift off the user's spec.
+        XLA allows one trace session per process anyway, so nested (and
+        concurrent) queries yield None."""
         with self._lock:
-            idx = self._query_index
-            self._query_index += 1
-        if not self.should_profile(idx):
-            yield None
-            return
-        import jax
-        path = os.path.join(self.path_prefix, f"query_{idx}")
-        with self._lock:
-            if self._active_path is not None:
-                claimed = False
-            else:
-                self._active_path = path
-                claimed = True
-        if not claimed:
-            # XLA allows one trace session per process; nested/concurrent
-            # queries (cached-relation materialization) ride the outer
-            # session — and run OUTSIDE the lock
-            yield None
-            return
-        os.makedirs(path, exist_ok=True)
+            nested = self._active > 0
+            self._active += 1
+            if not nested:
+                idx = self._query_index
+                self._query_index += 1
         try:
+            if nested or not self.should_profile(idx):
+                yield None
+                return
+            import jax
+            path = os.path.join(self.path_prefix, f"query_{idx}")
+            os.makedirs(path, exist_ok=True)
             jax.profiler.start_trace(path)
             try:
                 yield path
@@ -106,11 +135,40 @@ class TpuProfiler:
                 self.sessions_written += 1
         finally:
             with self._lock:
-                self._active_path = None
+                self._active -= 1
 
 
-def op_range(name: str):
-    """Operator range on the device timeline (NvtxRange analog). Usable
-    whether or not a trace session is active — zero-cost when inactive."""
+def op_range(name: str, cat: str = "op"):
+    """Operator range on BOTH timelines (NvtxWithMetrics analog): always
+    a jax.profiler.TraceAnnotation (device/Xprof timeline, zero-cost
+    when no trace session is active) and, while the host span tracer is
+    collecting, a host span too — so the same range shows up in the
+    Xprof trace and the exported Chrome host timeline."""
     import jax
-    return jax.profiler.TraceAnnotation(name)
+    from spark_rapids_tpu.obs.spans import TRACER
+    ann = jax.profiler.TraceAnnotation(name)
+    if not TRACER.enabled:
+        return ann
+    return _CombinedRange(ann, name, cat)
+
+
+class _CombinedRange:
+    __slots__ = ("ann", "name", "cat", "_span")
+
+    def __init__(self, ann, name, cat):
+        self.ann = ann
+        self.name = name
+        self.cat = cat
+        self._span = None
+
+    def __enter__(self):
+        from spark_rapids_tpu.obs.spans import TRACER
+        self._span = TRACER.begin(self.name, self.cat)
+        self.ann.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        from spark_rapids_tpu.obs.spans import TRACER
+        self.ann.__exit__(*exc)
+        TRACER.end(self._span)
+        return False

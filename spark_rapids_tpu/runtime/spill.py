@@ -23,6 +23,7 @@ from typing import Dict, List, Optional
 
 from spark_rapids_tpu.columnar import DeviceTable, HostTable
 from spark_rapids_tpu.errors import ColumnarProcessingError
+from spark_rapids_tpu.obs.metrics import metric_scope
 
 TIER_DEVICE = "DEVICE"
 TIER_HOST = "HOST"
@@ -189,16 +190,31 @@ class BufferCatalog:
 
     _instance: Optional["BufferCatalog"] = None
 
+    #: per-catalog counters stay instance-local (two catalogs can be
+    #: live at once — reset() mid-flight, per-catalog tests — and must
+    #: not contaminate each other); every bump ALSO mirrors into the
+    #: unified registry's process-wide ``spill`` scope (obs/metrics.py),
+    #: which the event log snapshots/diffs per query
+    _SCOPE_KEYS = {"spill_device_count": "spillDeviceCount",
+                   "spill_disk_count": "spillDiskCount",
+                   "device_spilled_bytes": "spillDeviceBytes",
+                   "disk_spilled_bytes": "spillDiskBytes"}
+
     def __init__(self, host_limit_bytes: int = 2 << 30,
                  disk_dir: Optional[str] = None):
         self._lock = threading.RLock()
         self._buffers: Dict[int, SpillableBatch] = {}
         self.host_limit_bytes = host_limit_bytes
         self.disk_dir = disk_dir
+        self._metrics = metric_scope("spill")
         self.spill_device_count = 0
         self.spill_disk_count = 0
         self.device_spilled_bytes = 0
         self.disk_spilled_bytes = 0
+
+    def _bump(self, attr: str, n) -> None:
+        setattr(self, attr, getattr(self, attr) + n)
+        self._metrics.add(self._SCOPE_KEYS[attr], n)
 
     @classmethod
     def get(cls) -> "BufferCatalog":
@@ -242,17 +258,22 @@ class BufferCatalog:
         device->host (then host->disk if the host tier overflows). Returns
         bytes actually freed (reference: synchronousSpill,
         RapidsBufferCatalog.scala:592)."""
+        from spark_rapids_tpu.obs.spans import span
         freed = 0
-        for sb in self._spill_order():
-            if freed >= target_bytes:
-                break
-            if sb.tier == TIER_DEVICE and not sb.pinned:
-                got = sb.spill_to_host()
-                if got:
-                    freed += got
-                    self.spill_device_count += 1
-                    self.device_spilled_bytes += got
-        self._enforce_host_limit()
+        t0 = time.monotonic()
+        with span("spill.device_to_host", cat="spill"):
+            for sb in self._spill_order():
+                if freed >= target_bytes:
+                    break
+                if sb.tier == TIER_DEVICE and not sb.pinned:
+                    got = sb.spill_to_host()
+                    if got:
+                        freed += got
+                        self._bump("spill_device_count", 1)
+                        self._bump("device_spilled_bytes", got)
+            self._enforce_host_limit()
+        if freed:
+            self._metrics.add("spillTime", time.monotonic() - t0)
         return freed
 
     def _enforce_host_limit(self):
@@ -262,8 +283,8 @@ class BufferCatalog:
             if sb.tier == TIER_HOST and not sb.pinned:
                 got = sb.spill_to_disk()
                 if got:
-                    self.spill_disk_count += 1
-                    self.disk_spilled_bytes += got
+                    self._bump("spill_disk_count", 1)
+                    self._bump("disk_spilled_bytes", got)
             if self.host_bytes() <= self.host_limit_bytes:
                 break
 
@@ -273,12 +294,17 @@ class BufferCatalog:
     def spill_host_to_disk(self) -> int:
         """Demote the whole HOST tier to disk (HostAlloc's free-host-memory
         hook); returns host bytes freed. Does not touch host_limit_bytes."""
+        from spark_rapids_tpu.obs.spans import span
         freed = 0
-        for sb in self._spill_order():
-            if sb.tier == TIER_HOST and not sb.pinned:
-                got = sb.spill_to_disk()
-                if got:
-                    freed += got
-                    self.spill_disk_count += 1
-                    self.disk_spilled_bytes += got
+        t0 = time.monotonic()
+        with span("spill.host_to_disk", cat="spill"):
+            for sb in self._spill_order():
+                if sb.tier == TIER_HOST and not sb.pinned:
+                    got = sb.spill_to_disk()
+                    if got:
+                        freed += got
+                        self._bump("spill_disk_count", 1)
+                        self._bump("disk_spilled_bytes", got)
+        if freed:
+            self._metrics.add("spillTime", time.monotonic() - t0)
         return freed

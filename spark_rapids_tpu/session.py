@@ -34,6 +34,17 @@ class TpuSession:
         self._runtime = None
         self._profiler = None
         self._catalog = None
+        # observability state (obs/): per-session query sequence, the
+        # lazy event-log writer, and the caller-settable attribution
+        # fields the next execute() consumes (harnesses tag queries so
+        # the offline tools can match runs per query)
+        self._exec_depth = 0
+        self._obs_query_seq = 0
+        self._event_writer = None
+        self.next_query_tag: Optional[str] = None
+        self.next_query_sql: Optional[str] = None
+        self.last_event_path: Optional[str] = None
+        self.last_event_record: Optional[dict] = None
 
     # -- SQL front end -------------------------------------------------------
     @property
@@ -151,6 +162,120 @@ class TpuSession:
 
     # -- execution ----------------------------------------------------------
     def execute(self, plan: P.PlanNode) -> HostTable:
+        """Run one query: recovery-wrapped execution plus the per-query
+        observability envelope — when the event log or host tracing is
+        enabled, spans collect for the duration and a structured record
+        (obs/events.py) is written on success. Nested executes
+        (cached-relation / broadcast materialization inside an outer
+        query) ride the outer envelope."""
+        import time as _time
+
+        from spark_rapids_tpu.obs import events as E
+        from spark_rapids_tpu.obs.spans import (
+            TRACE_DIR,
+            TRACE_ENABLED,
+            TRACER,
+        )
+
+        query_tag = self.next_query_tag
+        sql_text = self.next_query_sql
+        self.next_query_tag = None
+        self.next_query_sql = None
+
+        if self._exec_depth:
+            # nested query: no separate envelope, no index
+            self._exec_depth += 1
+            try:
+                return self._execute_with_recovery(plan)
+            finally:
+                self._exec_depth -= 1
+
+        ev_enabled = bool(self.conf.get_entry(E.EVENT_LOG_ENABLED))
+        tr_enabled = bool(self.conf.get_entry(TRACE_ENABLED))
+        obs_active = ev_enabled or tr_enabled
+        qidx = self._obs_query_seq
+        self._obs_query_seq += 1
+        if obs_active:
+            from spark_rapids_tpu.obs.metrics import scopes_snapshot
+            from spark_rapids_tpu.runtime.faults import FAULTS, RECOVERY
+            before_scopes = scopes_snapshot()
+            before_recovery = RECOVERY.snapshot()
+            before_fires = FAULTS.counters()
+            TRACER.begin_query(qidx)
+            main_tid = TRACER.main_tid
+        self._exec_depth = 1
+        t0 = _time.perf_counter()
+        try:
+            result = self._execute_with_recovery(plan)
+        except BaseException:
+            if obs_active:
+                TRACER.end_query()
+            raise
+        finally:
+            self._exec_depth = 0
+        if not obs_active:
+            return result
+        wall_s = _time.perf_counter() - t0
+        spans = TRACER.end_query()
+
+        from spark_rapids_tpu.obs.metrics import scopes_snapshot
+        from spark_rapids_tpu.obs.spans import (
+            finalize_observation,
+            summarize_spans,
+            write_chrome_trace,
+        )
+        from spark_rapids_tpu.runtime.faults import (
+            CIRCUIT_BREAKER,
+            FAULTS,
+            RECOVERY,
+        )
+        executable = getattr(self, "_last_executable", None)
+        if executable is not None:
+            finalize_observation(executable)
+        after_recovery = RECOVERY.snapshot()
+        after_fires = FAULTS.counters()
+        record = E.build_query_record(
+            query_index=qidx,
+            wall_s=wall_s,
+            phases=getattr(self, "_last_phases", {}) or {},
+            executable=executable,
+            meta=getattr(self, "_last_meta", None),
+            sql_text=sql_text,
+            query_tag=query_tag,
+            dispatches=int(getattr(self, "last_dispatches", 0) or 0),
+            recovery_delta={k: v - before_recovery.get(k, 0)
+                            for k, v in after_recovery.items()
+                            if v - before_recovery.get(k, 0)},
+            scope_deltas=E.scope_delta(before_scopes, scopes_snapshot()),
+            fault_fires={k: v - before_fires.get(k, 0)
+                         for k, v in after_fires.items()
+                         if v - before_fires.get(k, 0)},
+            demotions=CIRCUIT_BREAKER.demoted_ops(),
+            spans_summary=summarize_spans(spans, main_tid, wall_s),
+            fault_replays=int(getattr(self, "last_fault_replays", 0)),
+        )
+        self.last_event_record = record
+        # emission is best-effort: an unwritable log dir or full disk
+        # must not fail a query that already computed its result
+        try:
+            if ev_enabled:
+                if self._event_writer is None:
+                    self._event_writer = E.QueryEventWriter(
+                        str(self.conf.get_entry(E.EVENT_LOG_DIR)))
+                self.last_event_path = self._event_writer.write(record)
+            if tr_enabled:
+                import os
+                trace_dir = str(self.conf.get_entry(TRACE_DIR))
+                os.makedirs(trace_dir, exist_ok=True)
+                write_chrome_trace(
+                    os.path.join(trace_dir, f"query_{qidx}.trace.json"),
+                    spans, query_id=qidx)
+        except OSError as exc:
+            print(f"spark_rapids_tpu: event/trace emission failed "
+                  f"(query {qidx}): {exc}")
+        return result
+
+    def _execute_with_recovery(self, plan: P.PlanNode) -> HostTable:
         """Plan, verify, and drain a query — wrapped in the runtime
         circuit breaker: a non-OOM device failure (kernel crash, fatal
         XLA error) replays the query, and once the same operator fails
@@ -202,7 +327,29 @@ class TpuSession:
                 F.RECOVERY.bump("query_replays")
 
     def _execute_attempt(self, plan: P.PlanNode) -> HostTable:
-        from spark_rapids_tpu.conf import RETRY_OOM_MAX_RETRIES, TEST_INJECT_RETRY_OOM
+        import time as _time
+
+        from spark_rapids_tpu.obs.spans import TRACER
+
+        t_phase = _time.perf_counter()
+        plan_span = TRACER.begin("plan", "phase") if TRACER.enabled else None
+        try:
+            return self._plan_and_drain(plan, plan_span, t_phase)
+        except BaseException:
+            # a mid-phase failure (plan verify error, conversion bug)
+            # must not leave the phase span dangling on the stack
+            TRACER.end(plan_span)
+            raise
+
+    def _plan_and_drain(self, plan: P.PlanNode, plan_span,
+                        t_phase: float) -> HostTable:
+        import time as _time
+
+        from spark_rapids_tpu.conf import (
+            RETRY_OOM_MAX_RETRIES,
+            TEST_INJECT_RETRY_OOM,
+        )
+        from spark_rapids_tpu.obs.spans import TRACER
         from spark_rapids_tpu.runtime import RMM_TPU, TpuSemaphore, acquired
         from spark_rapids_tpu.runtime.retry import MAX_RETRIES_VAR
 
@@ -210,6 +357,7 @@ class TpuSession:
             rewrite_input_file_exprs
         plan = rewrite_input_file_exprs(plan)
         executable, meta = apply_overrides(plan, self.conf)
+        self._last_meta = meta
         if meta is not None and self.conf.explain_mode in ("NOT_ON_GPU", "ALL"):
             print(meta.explain(only_fallback=self.conf.explain_mode == "NOT_ON_GPU"))
 
@@ -249,7 +397,14 @@ class TpuSession:
         # attribution for non-OOM device failures (circuit breaker input)
         from spark_rapids_tpu.runtime.faults import install_fault_boundaries
         install_fault_boundaries(executable)
+        # observation boundaries OVER the fault guards: per-pull spans +
+        # the ESSENTIAL opTime/numOutputRows/numOutputBatches metrics on
+        # every device exec (obs/spans.py)
+        from spark_rapids_tpu.obs.spans import install_observation
+        install_observation(executable)
         self._last_executable = executable
+        TRACER.end(plan_span)
+        phases = {"planS": _time.perf_counter() - t_phase}
 
         inject = str(self.conf.get_entry(TEST_INJECT_RETRY_OOM) or "")
         if inject:
@@ -268,6 +423,9 @@ class TpuSession:
         token = MAX_RETRIES_VAR.set(self.conf.get_entry(RETRY_OOM_MAX_RETRIES))
         from spark_rapids_tpu.dispatch import dispatch_count, reset_dispatch_count
         reset_dispatch_count()
+        t_phase = _time.perf_counter()
+        exec_span = TRACER.begin("execute", "phase") \
+            if TRACER.enabled else None
         try:
             with self.profiler.profile_query():
                 with acquired(sem):
@@ -278,10 +436,20 @@ class TpuSession:
                 executable.metrics["dispatches"] = self.last_dispatches
         finally:
             MAX_RETRIES_VAR.reset(token)
-        if not batches:
-            from spark_rapids_tpu.plan.nodes import _empty_table
-            return _empty_table(plan.output_schema())
-        return HostTable.concat(batches)
+            TRACER.end(exec_span)
+            phases["executeS"] = _time.perf_counter() - t_phase
+            self._last_phases = phases
+        t_phase = _time.perf_counter()
+        collect_span = TRACER.begin("collect", "phase") \
+            if TRACER.enabled else None
+        try:
+            if not batches:
+                from spark_rapids_tpu.plan.nodes import _empty_table
+                return _empty_table(plan.output_schema())
+            return HostTable.concat(batches)
+        finally:
+            TRACER.end(collect_span)
+            phases["collectS"] = _time.perf_counter() - t_phase
 
     def _run_speculative(self, executable):
         """Drain the plan under a speculation context (speculative operator
@@ -363,6 +531,10 @@ class TpuSession:
         ex = getattr(self, "_last_executable", None)
         if ex is None:
             return "(no query executed yet)"
+        # resolve deferred device row counts (one batched fetch) so
+        # numOutputRows is complete in the rendered tree
+        from spark_rapids_tpu.obs.spans import finalize_observation
+        finalize_observation(ex)
         lines = []
 
         def walk(e, indent):

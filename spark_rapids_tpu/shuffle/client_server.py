@@ -239,6 +239,9 @@ class ShuffleClient:
                         ) -> List[Tuple[BlockId, int]]:
         """Metadata round trip + streamed transfer; returns the block list
         (what the reference's RapidsShuffleIterator drives per peer)."""
-        blocks = self.fetch_metadata(shuffle_id, partition_id, map_ids)
-        self.fetch_blocks(blocks, received)
+        from spark_rapids_tpu.obs.spans import span
+        with span("shuffle.fetch", cat="shuffle",
+                  shuffle=shuffle_id, partition=partition_id):
+            blocks = self.fetch_metadata(shuffle_id, partition_id, map_ids)
+            self.fetch_blocks(blocks, received)
         return blocks

@@ -156,21 +156,33 @@ class ShuffleWriteHandle:
         under a host-memory grant until flushed (HostAlloc integration)."""
         if len(partitions) != self.num_partitions:
             raise ColumnarProcessingError("partition count mismatch")
+        import time
+
+        from spark_rapids_tpu.obs.metrics import metric_scope
+        from spark_rapids_tpu.obs.spans import span
         from spark_rapids_tpu.runtime.host_alloc import HostMemoryArbiter
         codec = self.codec
         grant = HostMemoryArbiter.get().alloc(
             sum(t.nbytes() for t in partitions))
         try:
-            blobs = list(self.pool.map(
-                lambda t: _compress(codec, pack_table(t)), partitions))
+            t0 = time.perf_counter()
+            with span("shuffle.serialize", cat="shuffle"):
+                blobs = list(self.pool.map(
+                    lambda t: _compress(codec, pack_table(t)), partitions))
+            # recorded from the calling thread (worker adds would race)
+            metric_scope("shuffle").add("serializeTime",
+                                        time.perf_counter() - t0)
         except BaseException:
             grant.release()
             raise
         try:
             map_id = len(self.map_outputs)
-            out = self._write_map_file(map_id, blobs)
+            with span("shuffle.write.map", cat="shuffle", map=map_id):
+                out = self._write_map_file(map_id, blobs)
             self.map_outputs.append(out)
             self.bytes_written += out.offsets[-1]
+            metric_scope("shuffle").add("shuffleBytesWritten",
+                                        out.offsets[-1])
             return out
         finally:
             grant.release()
@@ -300,9 +312,19 @@ class ShuffleReadHandle:
                     f"{self.write_handle.shuffle_id} unreadable after "
                     f"retries: {e}", map_ids=[map_id]) from e
 
-        for t, nbytes in self.pool.map(
-                fetch, enumerate(self.write_handle.map_outputs)):
+        from spark_rapids_tpu.obs.metrics import metric_scope
+        from spark_rapids_tpu.obs.spans import span
+        # materialize INSIDE the span (a span held open across yields
+        # would absorb downstream consumer time and leak on
+        # abandonment); the only caller buffers the partition anyway —
+        # it is the recovery unit
+        with span("shuffle.read.partition", cat="shuffle", partition=p):
+            results = list(self.pool.map(
+                fetch, enumerate(self.write_handle.map_outputs)))
+        for t, nbytes in results:
             self.bytes_read += nbytes  # consumer thread only: no races
+            if nbytes:
+                metric_scope("shuffle").add("shuffleBytesRead", nbytes)
             if t is not None and t.num_rows > 0:
                 yield t
 

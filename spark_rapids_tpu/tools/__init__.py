@@ -1,0 +1,25 @@
+"""Offline profiling / qualification tools over query event logs.
+
+The spark-rapids-tools analog: ``python -m spark_rapids_tpu.tools
+profile <eventlog>`` turns the JSONL event logs the engine writes
+(``spark.rapids.sql.eventLog.enabled`` — obs/events.py) into a
+machine-readable profiling report (top operators by self time, compute
+vs transfer vs shuffle breakdown, per-exchange skew, spill/retry
+summary, fallback inventory, span attribution), and ``... compare A B``
+diffs two runs per-query/per-operator — the tool perf PRs cite instead
+of hand-timing.
+
+Operates purely on the JSON records — no session/runtime machinery is
+touched, so the CLI runs anywhere the logs land (it shares only the
+event-schema constant with obs/events.py).
+"""
+
+from spark_rapids_tpu.tools.report import (  # noqa: F401
+    build_profile,
+    load_events,
+    render_profile,
+)
+from spark_rapids_tpu.tools.compare import (  # noqa: F401
+    build_compare,
+    render_compare,
+)

@@ -1,0 +1,69 @@
+"""CLI: ``python -m spark_rapids_tpu.tools``.
+
+Subcommands:
+
+* ``profile <eventlog>`` — profiling report over a .jsonl event log (or
+  a directory of them): top operators by self time, compute/transfer/
+  shuffle/spill breakdown, per-exchange summary, fallback inventory,
+  span attribution with the untracked remainder.
+* ``compare <A> <B>`` — per-query/per-operator diff of two runs.
+
+``--json`` emits the raw report dict for machines; exit status 2 when a
+profile's span coverage falls below ``--coverage-floor`` (default 0.95)
+so CI can gate on attribution quality.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m spark_rapids_tpu.tools",
+        description="offline profiling / qualification tools over query "
+                    "event logs (spark.rapids.sql.eventLog.*)")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("profile", help="profiling report over one run")
+    p.add_argument("eventlog", help=".jsonl event log file or directory")
+    p.add_argument("--json", action="store_true",
+                   help="emit the raw report JSON")
+    p.add_argument("--top", type=int, default=10,
+                   help="operators to show per ranking (default 10)")
+    p.add_argument("--coverage-floor", type=float, default=0.95,
+                   help="minimum span attribution per query; below it "
+                        "the command exits 2 (default 0.95)")
+
+    c = sub.add_parser("compare", help="diff two runs per-query/per-op")
+    c.add_argument("a", help="baseline event log file or directory")
+    c.add_argument("b", help="candidate event log file or directory")
+    c.add_argument("--json", action="store_true",
+                   help="emit the raw comparison JSON")
+    c.add_argument("--top", type=int, default=5,
+                   help="op diffs to show per query (default 5)")
+
+    args = ap.parse_args(argv)
+
+    if args.cmd == "profile":
+        from spark_rapids_tpu.tools.report import (
+            build_profile,
+            load_events,
+            render_profile,
+        )
+        report = build_profile(load_events(args.eventlog), top_n=args.top,
+                               coverage_floor=args.coverage_floor)
+        print(json.dumps(report) if args.json else render_profile(report))
+        return 2 if report["queriesBelowCoverageFloor"] else 0
+
+    from spark_rapids_tpu.tools.compare import build_compare, render_compare
+    cmp = build_compare(args.a, args.b)
+    print(json.dumps(cmp) if args.json
+          else render_compare(cmp, top_n=args.top))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

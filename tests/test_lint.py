@@ -375,6 +375,28 @@ def test_ra_sql_exposure_missing_aggregate(monkeypatch):
     assert any("Sum" in d.path for d in hits)
 
 
+def test_ra_essential_metrics_missing():
+    from spark_rapids_tpu.execs.base import TpuExec
+    from spark_rapids_tpu.lint.registry_audit import audit_exec_metrics_tree
+
+    class HalfMetered(TpuExec):
+        pass
+
+    e = HalfMetered()
+    e.metrics.add("opTime", 0.1)  # ran, but never counted its output
+    diags = []
+    audit_exec_metrics_tree(e, diags)
+    hits = _find(diags, "RA-ESSENTIAL-METRICS")
+    assert any("HalfMetered" in d.path
+               and "numOutputRows" in d.message for d in hits)
+    # a metric-less ROOT means the observation boundary never installed
+    bare = HalfMetered()
+    diags2 = []
+    audit_exec_metrics_tree(bare, diags2)
+    assert any("never installed" in d.message
+               for d in _find(diags2, "RA-ESSENTIAL-METRICS"))
+
+
 def test_ra_doc_drift(tmp_path):
     from spark_rapids_tpu.lint.registry_audit import _audit_doc_drift
     (tmp_path / "SUPPORTED_OPS.md").write_text("stale\n")

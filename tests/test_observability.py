@@ -1,0 +1,429 @@
+"""Observability subsystem tests: unified metric registry + levels,
+exec observation boundary (ESSENTIAL metrics), host span tracing +
+Chrome trace export, the query event log (golden schema), and the
+offline tools (profile report, A/B compare, CLI smoke)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import functions as F
+from spark_rapids_tpu.ops.expr import col, lit
+from spark_rapids_tpu.session import TpuSession
+
+
+def _table_data(n=200):
+    return {"k": np.array(["a", "b", "a", "c"] * (n // 4), dtype=object),
+            "v": np.arange(n, dtype=np.int64)}
+
+
+def _agg_df(s, n=200):
+    df = s.create_dataframe(_table_data(n))
+    return (df.filter(col("v") > lit(10))
+            .group_by("k").agg(F.sum("v").alias("sv")))
+
+
+def _exec_tree(session):
+    from spark_rapids_tpu.lore import _iter_tree
+    return list(_iter_tree(session._last_executable))
+
+
+# ---------------------------------------------------------------------------
+# metric registry
+# ---------------------------------------------------------------------------
+
+
+def test_metric_spec_conflict_raises():
+    from spark_rapids_tpu.obs.metrics import register_metric
+    register_metric("obsTestMetricA", "count", "MODERATE")
+    register_metric("obsTestMetricA", "count", "MODERATE")  # idempotent
+    with pytest.raises(ValueError):
+        register_metric("obsTestMetricA", "timing", "MODERATE")
+    with pytest.raises(ValueError):
+        register_metric("obsTestMetricB", "weird", "MODERATE")
+
+
+def test_metric_set_spec_level_and_typed():
+    from spark_rapids_tpu.obs.metrics import (
+        MetricSet,
+        set_metrics_level,
+        spec_for,
+    )
+    m = MetricSet()
+    try:
+        set_metrics_level("ESSENTIAL")
+        m.add("opTime", 0.5)          # ESSENTIAL spec -> kept
+        m.add("somethingTime", 1.0)   # inferred MODERATE -> dropped
+        assert dict(m) == {"opTime": 0.5}
+        set_metrics_level("MODERATE")
+        m.add("somethingTime", 1.0)
+        m.add("fooBytesRead", 3)
+        t = m.typed()
+        assert t["opTime"] == {"value": 0.5, "kind": "timing",
+                               "level": "ESSENTIAL"}
+        assert t["somethingTime"]["kind"] == "timing"
+        assert t["fooBytesRead"]["kind"] == "bytes"
+        assert spec_for("randomCounter").kind == "count"
+    finally:
+        set_metrics_level("MODERATE")
+
+
+def test_metrics_level_applies_to_transitions():
+    """DeviceToHost routes through the same level machinery as execs:
+    at ESSENTIAL, its ESSENTIAL metrics survive and MODERATE exec
+    metrics (scanCacheMiss) are dropped; at DEBUG everything records."""
+    s = TpuSession({"spark.rapids.sql.metrics.level": "ESSENTIAL"})
+    _agg_df(s).collect_table()
+    tree = _exec_tree(s)
+    d2h = tree[0]
+    assert "d2hTime" in d2h.metrics
+    assert "numOutputRows" in d2h.metrics
+    all_metrics = set().union(*(t.metrics for t in tree))
+    assert "scanCacheMiss" not in all_metrics  # MODERATE, dropped
+
+    s2 = TpuSession({"spark.rapids.sql.metrics.level": "DEBUG"})
+    _agg_df(s2).collect_table()
+    all2 = set().union(*(t.metrics for t in _exec_tree(s2)))
+    assert "scanCacheMiss" in all2
+
+
+def test_every_exec_emits_essential_metrics():
+    from spark_rapids_tpu.execs.base import DeviceToHost, TpuExec
+    from spark_rapids_tpu.lint.registry_audit import audit_exec_metrics_tree
+    from spark_rapids_tpu.obs.metrics import ESSENTIAL_EXEC_METRICS
+    from spark_rapids_tpu.obs.spans import finalize_observation
+    s = TpuSession()
+    out = _agg_df(s).collect_table()
+    assert out.num_rows == 3
+    finalize_observation(s._last_executable)
+    tree = _exec_tree(s)
+    execs = [e for e in tree if isinstance(e, (TpuExec, DeviceToHost))]
+    assert len(execs) >= 3
+    for e in execs:
+        for k in ESSENTIAL_EXEC_METRICS:
+            assert k in e.metrics, (type(e).__name__, k, dict(e.metrics))
+    # the positive side of the RA-ESSENTIAL-METRICS audit
+    diags = []
+    audit_exec_metrics_tree(s._last_executable, diags)
+    assert diags == []
+    # row counts are real, not placeholders: the scan saw all 200 rows
+    scan = [e for e in execs if type(e).__name__ == "TpuScanExec"
+            or "Scan" in type(e).__name__]
+    assert scan and scan[0].metrics["numOutputRows"] == 200
+
+
+def test_subsystem_scopes_record():
+    from spark_rapids_tpu.obs.metrics import metric_scope
+    before = dict(metric_scope("shuffle"))
+    s = TpuSession({
+        "spark.rapids.shuffle.localDeviceSplit.enabled": "false"})
+    df = s.create_dataframe(_table_data(80), num_batches=2)
+    df.repartition(4, "k").group_by("k").agg(
+        F.count("v").alias("c")).collect_table()
+    after = dict(metric_scope("shuffle"))
+    assert after.get("shuffleBytesWritten", 0) > before.get(
+        "shuffleBytesWritten", 0)
+    assert after.get("shuffleBytesRead", 0) > before.get(
+        "shuffleBytesRead", 0)
+
+
+# ---------------------------------------------------------------------------
+# spans + chrome trace
+# ---------------------------------------------------------------------------
+
+
+def test_chrome_trace_export_schema(tmp_path):
+    s = TpuSession({"spark.rapids.trace.enabled": "true",
+                    "spark.rapids.trace.dir": str(tmp_path)})
+    _agg_df(s).collect_table()
+    path = tmp_path / "query_0.trace.json"
+    assert path.exists()
+    trace = json.loads(path.read_text())
+    events = trace["traceEvents"]
+    assert events, "empty trace"
+    names = set()
+    for ev in events:
+        assert ev["ph"] in ("X", "M")
+        assert isinstance(ev["pid"], int)
+        assert isinstance(ev["tid"], int)
+        if ev["ph"] == "X":
+            assert isinstance(ev["ts"], (int, float)) and ev["ts"] >= 0
+            assert isinstance(ev["dur"], (int, float)) and ev["dur"] >= 0
+            assert isinstance(ev["name"], str) and ev["name"]
+            assert isinstance(ev["cat"], str)
+            names.add(ev["name"])
+        else:
+            assert ev["name"] == "thread_name"
+    # exec boundaries, phases and the d2h transfer all show up
+    assert "TpuHashAggregateExec" in names or any(
+        "Aggregate" in n for n in names)
+    assert {"plan", "execute", "collect"} <= names
+    assert "DeviceToHost" in names
+
+
+def test_tracer_disabled_is_default_and_cheap():
+    from spark_rapids_tpu.obs.spans import TRACER, span
+    assert TRACER.enabled is False
+    with span("nothing", cat="op"):
+        pass  # no-op context manager when disabled
+    s = TpuSession()
+    _agg_df(s).collect_table()
+    assert TRACER.enabled is False
+
+
+def test_span_union_seconds():
+    from spark_rapids_tpu.obs.spans import union_seconds
+    assert union_seconds([]) == 0.0
+    assert union_seconds([(0, 1), (0.5, 2), (3, 4)]) == pytest.approx(3.0)
+    assert union_seconds([(0, 5), (1, 2)]) == pytest.approx(5.0)
+
+
+# ---------------------------------------------------------------------------
+# event log
+# ---------------------------------------------------------------------------
+
+
+_VOLATILE_INT_KEYS = {"dispatches", "spanCount", "tid"}
+
+
+def _normalize(obj, key=None):
+    """Normalize volatile values (timings, counters that shift with the
+    engine's dispatch strategy) so the golden pins SCHEMA + stable
+    semantics, not wall-clock noise."""
+    if isinstance(obj, dict):
+        return {k: _normalize(v, k) for k, v in sorted(obj.items())}
+    if isinstance(obj, list):
+        return [_normalize(v) for v in obj]
+    if isinstance(obj, bool):
+        return obj
+    if isinstance(obj, float):
+        return 0.0
+    if isinstance(obj, int) and key in _VOLATILE_INT_KEYS:
+        return 0
+    return obj
+
+
+def _run_eventlog_query(tmp_path, tag="golden"):
+    s = TpuSession({"spark.rapids.sql.eventLog.enabled": "true",
+                    "spark.rapids.sql.eventLog.dir": str(tmp_path)})
+    s.next_query_tag = tag
+    _agg_df(s).collect_table()
+    return s
+
+
+def test_event_log_written_and_valid(tmp_path):
+    s = _run_eventlog_query(tmp_path)
+    assert s.last_event_path and os.path.exists(s.last_event_path)
+    lines = open(s.last_event_path).read().strip().splitlines()
+    assert len(lines) == 1
+    rec = json.loads(lines[0])
+    assert rec["schema"] == 1
+    assert rec["event"] == "queryCompleted"
+    assert rec["queryTag"] == "golden"
+    assert rec["wallS"] > 0
+    assert rec["spans"]["attributedS"] > 0
+    # per-op metrics are typed in the plan tree
+    agg = rec["plan"]["children"][0]
+    assert agg["metrics"]["opTime"]["kind"] == "timing"
+    assert agg["metrics"]["numOutputRows"]["value"] == 3
+
+
+def test_event_log_golden_schema(tmp_path):
+    """Golden record: normalized timings, byte-stable schema. A failure
+    here means the event-log record shape changed — bump
+    EVENT_SCHEMA_VERSION, regenerate tests/golden_eventlog.json (this
+    test prints the new normalized record on mismatch) and check the
+    offline tools still read it."""
+    s = _run_eventlog_query(tmp_path)
+    got = _normalize(s.last_event_record)
+    golden_path = os.path.join(os.path.dirname(__file__),
+                               "golden_eventlog.json")
+    golden = json.load(open(golden_path))
+    assert got == golden, (
+        "event-log record drifted from the golden schema; new normalized "
+        "record:\n" + json.dumps(got, indent=1, sort_keys=True))
+
+
+def test_event_log_disabled_writes_nothing(tmp_path):
+    s = TpuSession({"spark.rapids.sql.eventLog.dir": str(tmp_path)})
+    _agg_df(s).collect_table()
+    assert s.last_event_path is None
+    assert list(tmp_path.iterdir()) == []
+
+
+def test_sql_text_recorded(tmp_path):
+    s = TpuSession({"spark.rapids.sql.eventLog.enabled": "true",
+                    "spark.rapids.sql.eventLog.dir": str(tmp_path)})
+    s.create_dataframe(_table_data()).create_or_replace_temp_view("t")
+    s.sql("SELECT k, SUM(v) AS sv FROM t GROUP BY k").collect_table()
+    rec = s.last_event_record
+    assert "SUM(v)" in rec["sqlText"]
+
+
+def test_nested_query_rides_outer_envelope(tmp_path):
+    """A broadcast-join query materializes its build side through a
+    nested execute; only ONE record per top-level query is written."""
+    s = TpuSession({"spark.rapids.sql.eventLog.enabled": "true",
+                    "spark.rapids.sql.eventLog.dir": str(tmp_path),
+                    "spark.rapids.sql.broadcastSizeBytes": str(1 << 20)})
+    left = s.create_dataframe(_table_data(100))
+    right = s.create_dataframe({"k": np.array(["a", "b"], dtype=object),
+                                "w": np.array([1, 2], dtype=np.int64)})
+    left.join(right, on=["k"], how="inner").collect_table()
+    lines = open(s.last_event_path).read().strip().splitlines()
+    assert len(lines) == 1
+    assert json.loads(lines[0])["queryIndex"] == 0
+
+
+# ---------------------------------------------------------------------------
+# offline tools
+# ---------------------------------------------------------------------------
+
+
+def _two_runs(tmp_path):
+    dir_a, dir_b = tmp_path / "a", tmp_path / "b"
+    for d in (dir_a, dir_b):
+        s = TpuSession({"spark.rapids.sql.eventLog.enabled": "true",
+                        "spark.rapids.sql.eventLog.dir": str(d)})
+        s.next_query_tag = "q"
+        _agg_df(s).collect_table()
+    return str(dir_a), str(dir_b)
+
+
+def test_tools_profile_report(tmp_path):
+    from spark_rapids_tpu.tools import (
+        build_profile,
+        load_events,
+        render_profile,
+    )
+    s = _run_eventlog_query(tmp_path, tag="q1")
+    report = build_profile(load_events(str(tmp_path)))
+    assert report["queryCount"] == 1
+    q = report["queries"][0]
+    assert q["query"] == "q1"
+    att = q["attribution"]
+    assert 0.0 < att["coverage"] <= 1.0
+    assert att["attributedS"] + att["untrackedS"] == pytest.approx(
+        q["wallS"], rel=0.01)
+    b = q["breakdown"]
+    assert b["wallS"] == pytest.approx(
+        b["computeS"] + b["transferS"] + b["shuffleS"] + b["spillS"]
+        + b["untrackedS"], rel=0.01)
+    tops = q["topOpsBySelfTime"]
+    assert tops and all(t["selfTimeS"] >= 0 for t in tops)
+    # self times nest under total: sum of self <= wall-ish envelope
+    assert sum(t["selfTimeS"] for t in tops) <= q["wallS"] * 1.05
+    text = render_profile(report)
+    assert "Top operators by self time" in text
+    assert "q1" in text
+    del s
+
+
+def test_tools_compare(tmp_path):
+    from spark_rapids_tpu.tools import build_compare, render_compare
+    dir_a, dir_b = _two_runs(tmp_path)
+    cmp = build_compare(dir_a, dir_b)
+    assert cmp["matchedQueries"] == 1
+    q = cmp["queries"][0]
+    assert q["query"] == "q"
+    assert q["aWallS"] > 0 and q["bWallS"] > 0
+    common = [e for e in q["ops"] if e["status"] == "common"]
+    assert common, "no matched ops"
+    assert all("deltaOpTimeS" in e for e in common)
+    assert q["newFallbacks"] == [] and q["resolvedFallbacks"] == []
+    text = render_compare(cmp)
+    assert "Matched queries: 1" in text
+
+
+def test_tools_schema_mismatch_rejected(tmp_path):
+    from spark_rapids_tpu.tools import load_events
+    p = tmp_path / "bad.jsonl"
+    p.write_text(json.dumps({"schema": 99, "event": "queryCompleted"})
+                 + "\n")
+    with pytest.raises(ValueError, match="schema"):
+        load_events(str(p))
+
+
+def test_tools_cli_smoke(tmp_path):
+    """The acceptance smoke: run q1 (golden corpus), analyze its event
+    log through the real CLI."""
+    import scale_test
+    from spark_rapids_tpu.lint.golden import golden_tables
+    tables = golden_tables(0.005)
+    s = TpuSession({"spark.rapids.sql.eventLog.enabled": "true",
+                    "spark.rapids.sql.eventLog.dir": str(tmp_path)})
+    queries = scale_test.build_queries(s, tables)
+    s.next_query_tag = "q1"
+    queries["q1"]().collect_table()
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, "-m", "spark_rapids_tpu.tools", "profile",
+         str(tmp_path)],
+        capture_output=True, text=True, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert out.returncode in (0, 2), out.stderr
+    assert "Queries: 1" in out.stdout
+    assert "q1" in out.stdout
+    out_json = subprocess.run(
+        [sys.executable, "-m", "spark_rapids_tpu.tools", "profile",
+         "--json", str(tmp_path)],
+        capture_output=True, text=True, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    report = json.loads(out_json.stdout)
+    assert report["queryCount"] == 1
+
+
+def test_lore_stripped_exec_keeps_metricset():
+    """A LORE-dumped exec must round-trip with a usable MetricSet —
+    add_metric on the replayed exec would crash on a plain dict."""
+    import pickle
+
+    from spark_rapids_tpu.execs.basic import TpuScanExec
+    from spark_rapids_tpu.lore import _strip_for_pickle
+    from spark_rapids_tpu.obs.metrics import MetricSet
+    s = TpuSession()
+    _agg_df(s).collect_table()
+    scan = [e for e in _exec_tree(s)
+            if isinstance(e, TpuScanExec)][0]
+    clone = pickle.loads(pickle.dumps(_strip_for_pickle(scan)))
+    assert isinstance(clone.metrics, MetricSet)
+    clone.add_metric("scanRows", 5)
+    assert clone.metrics["scanRows"] == 5
+
+
+def test_tools_compare_aggregates_duplicate_tags(tmp_path):
+    """Three warm runs per tag compare as medians, not last-run-wins."""
+    from spark_rapids_tpu.tools import build_compare
+    dirs = []
+    for sub in ("a", "b"):
+        d = tmp_path / sub
+        s = TpuSession({"spark.rapids.sql.eventLog.enabled": "true",
+                        "spark.rapids.sql.eventLog.dir": str(d)})
+        for _ in range(3):
+            s.next_query_tag = "q"
+            _agg_df(s).collect_table()
+        dirs.append(str(d))
+    cmp = build_compare(*dirs)
+    q = cmp["queries"][0]
+    assert q["aRuns"] == 3 and q["bRuns"] == 3
+    assert q["aWallMinS"] <= q["aWallS"]
+
+
+# ---------------------------------------------------------------------------
+# overhead guard
+# ---------------------------------------------------------------------------
+
+
+def test_disabled_observability_leaves_no_span_state():
+    """With event log and tracing off (the default), executing queries
+    must not accumulate span state or enable the tracer."""
+    from spark_rapids_tpu.obs.spans import TRACER
+    s = TpuSession()
+    for _ in range(3):
+        _agg_df(s).collect_table()
+    assert TRACER.enabled is False
+    assert TRACER._spans == []

@@ -17,6 +17,58 @@ def test_parse_ranges():
     assert parse_ranges(" 0-1 , 4 ") == {0, 1, 4}
 
 
+@pytest.mark.parametrize("bad", ["5-", "-3", "1-x", "x", "7-3", "-2"])
+def test_parse_ranges_malformed_named_error(bad):
+    """Malformed specs raise a clear error NAMING the conf key, at
+    conf-read time — not a raw int() ValueError at the first profiled
+    query."""
+    with pytest.raises(ValueError,
+                       match="spark.rapids.profile.queryRanges"):
+        parse_ranges(bad)
+
+
+def test_profiler_validates_ranges_at_conf_read():
+    from spark_rapids_tpu.conf import RapidsConf
+    conf = RapidsConf({"spark.rapids.profile.queryRanges": "1-x"})
+    with pytest.raises(ValueError,
+                       match="spark.rapids.profile.queryRanges"):
+        TpuProfiler(conf)
+
+
+def test_nested_query_does_not_burn_query_index():
+    """Nested/cached-relation materialization queries ride the outer
+    trace session and must NOT claim a _query_index slot — otherwise
+    queryRanges indices drift off the user's spec."""
+    from spark_rapids_tpu.conf import RapidsConf
+    p = TpuProfiler(RapidsConf({}))  # profiling disabled; indexing still runs
+    with p.profile_query() as outer:
+        assert outer is None
+        with p.profile_query() as inner:  # nested: no index
+            assert inner is None
+    with p.profile_query():
+        pass
+    assert p._query_index == 2  # two TOP-LEVEL queries, one nested
+
+
+def test_query_ranges_alignment_with_nested_queries(tmp_path):
+    """With queryRanges=1, a nested query inside query 0 must not shift
+    profiling onto the wrong top-level query: the SECOND top-level
+    query is the one traced."""
+    from spark_rapids_tpu.conf import RapidsConf
+    conf = RapidsConf({
+        "spark.rapids.profile.enabled": "true",
+        "spark.rapids.profile.pathPrefix": str(tmp_path),
+        "spark.rapids.profile.queryRanges": "1"})
+    p = TpuProfiler(conf)
+    with p.profile_query() as q0:      # index 0: not in ranges
+        assert q0 is None
+        with p.profile_query() as nested:
+            assert nested is None
+    with p.profile_query() as q1:      # index 1: profiled
+        assert q1 is not None and q1.endswith("query_1")
+    assert p.sessions_written == 1
+
+
 def test_profiler_query_ranges(tmp_path):
     from spark_rapids_tpu.conf import RapidsConf
     conf = RapidsConf({
