@@ -560,10 +560,17 @@ CHAOS_BOUNDS = {"fetch_retries": 500, "recomputed_maps": 200,
 
 
 def run_chaos(sf: float = 0.02, seed: int = 7, queries=None,
-              use_sql: bool = False):
+              use_sql: bool = False, concurrency: int = 0):
     """Fault-free run, then the seeded-fault run, per query; returns the
     chaos report dict (and raises AssertionError on any divergence or
-    bound violation — callers in CI want the failure loud)."""
+    bound violation — callers in CI want the failure loud).
+
+    ``concurrency > 1`` runs the CHAOTIC side through a QueryService
+    worker pool instead of serially — recovery (fetch retry, map
+    recompute, crash replay/demotion) and the concurrent scheduler are
+    then exercised TOGETHER, still asserting bit-identity against the
+    fault-free serial baseline. Recovery bounds apply to the whole run
+    (per-query attribution is meaningless across interleaved workers)."""
     from spark_rapids_tpu.datagen import scale_test_specs
     from spark_rapids_tpu.runtime.faults import (
         CIRCUIT_BREAKER,
@@ -592,6 +599,10 @@ def run_chaos(sf: float = 0.02, seed: int = 7, queries=None,
     # corpus for the schedule to be randomized rather than cyclic
     expected_tables = {name: base_queries[name]().collect_table()
                        for name in wanted}
+    if concurrency and concurrency > 1:
+        return _run_chaos_concurrent(
+            report, failures, wanted, expected_tables, base_queries,
+            chaos_queries, chaotic, concurrency)
     for name in wanted:
         expected = expected_tables[name]
         before = RECOVERY.snapshot()
@@ -647,6 +658,97 @@ def run_chaos(sf: float = 0.02, seed: int = 7, queries=None,
     return report
 
 
+def _run_chaos_concurrent(report, failures, wanted, expected_tables,
+                          base_queries, chaos_queries, chaotic_session,
+                          concurrency):
+    """Concurrent half of run_chaos: submit the chaotic corpus to a
+    QueryService at the requested concurrency across two simulated
+    tenants, then verify each result bit-identical to the fault-free
+    serial baseline (re-collected through the demoted plan when the
+    circuit breaker fired mid-run, exactly like the serial path)."""
+    from spark_rapids_tpu.runtime.faults import (
+        CIRCUIT_BREAKER,
+        FAULTS,
+        RECOVERY,
+    )
+    from spark_rapids_tpu.service import QueryService
+
+    report["concurrency"] = concurrency
+    before = RECOVERY.snapshot()
+    fires_before = FAULTS.counters()
+    svc = QueryService(session=chaotic_session,
+                       max_concurrent=concurrency,
+                       queue_depth=max(len(wanted), 64))
+    t0 = time.perf_counter()
+    handles = {}
+    with svc:
+        for i, name in enumerate(wanted):
+            handles[name] = svc.submit(chaos_queries[name](),
+                                       tenant=f"t{i % 2}", tag=name)
+        for name, h in handles.items():
+            if not h.wait(timeout=600):
+                failures.append(f"{name}: still {h.state} after 600s")
+    report["wall_s"] = round(time.perf_counter() - t0, 4)
+    recovery = {k: v - before[k] for k, v in RECOVERY.snapshot().items()}
+    report["recovery"] = recovery
+    report["fault_fires"] = {
+        k: v - fires_before.get(k, 0) for k, v in FAULTS.counters().items()
+        if v - fires_before.get(k, 0)}
+    report["service"] = svc.stats()
+    for name, h in handles.items():
+        got = h.result_table
+        if got is None:
+            failures.append(f"{name}: no result ({h.state}: {h.error})")
+            report["queries"][name] = {"state": h.state,
+                                       "identical": False}
+            continue
+        diff = tables_differ(expected_tables[name], got)
+        if diff is not None and CIRCUIT_BREAKER.demoted_ops():
+            with FAULTS.suspended():
+                redo = base_queries[name]().collect_table()
+            diff = tables_differ(redo, got)
+        entry = {"state": h.state, "identical": diff is None,
+                 "latency_s": round(h.latency_s or 0.0, 4),
+                 "queue_wait_s": round(h.queue_wait_s or 0.0, 4)}
+        if diff is not None:
+            failures.append(f"{name}: {diff}")
+        if h.state != "FINISHED":
+            failures.append(f"{name}: unexpected terminal state "
+                            f"{h.state} ({h.error})")
+        report["queries"][name] = entry
+    # whole-run recovery bounds: the per-query ceilings summed
+    for field, bound in CHAOS_BOUNDS.items():
+        total_bound = bound * len(wanted)
+        if recovery.get(field, 0) > total_bound:
+            failures.append(f"{field}={recovery[field]} exceeds the "
+                            f"whole-run chaos bound {total_bound}")
+    stats = report["service"]
+    if stats["cancelled"] or stats["timed_out"] or stats["rejected"]:
+        failures.append(f"spurious lifecycle events: {stats}")
+    report["demoted_ops"] = CIRCUIT_BREAKER.demoted_ops()
+    report["ok"] = not failures
+    report["failures"] = failures
+    FAULTS.disarm()
+    if failures:
+        raise AssertionError("concurrent chaos run failed:\n"
+                             + "\n".join(failures))
+    return report
+
+
+def run_concurrent(sf: float, seed: int, queries=None, use_sql=False,
+                   concurrency: int = 4, tenants: int = 2,
+                   eventlog_dir=None):
+    """Throughput mode (--concurrency without --chaos): run the corpus
+    serially for a baseline, then submit every (tenant, query) pair to a
+    QueryService and report aggregate wall, speedup, p50/p95 latency,
+    queue wait and result-cache hit rate — the same report shape the
+    `tools loadtest` CLI emits (tools/loadtest.py does the work)."""
+    from spark_rapids_tpu.tools.loadtest import run_loadtest
+    return run_loadtest(sf=sf, seed=seed, queries=queries,
+                        use_sql=use_sql, concurrency=concurrency,
+                        tenants=tenants, eventlog_dir=eventlog_dir)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--sf", type=float, default=None,
@@ -673,17 +775,41 @@ def main():
                     help="run the corpus fault-free and under a seeded "
                          "fault schedule, asserting bit-identical "
                          "results and bounded recovery work")
+    ap.add_argument("--concurrency", type=int, default=0,
+                    help="run through the QueryService at this worker "
+                         "concurrency: with --chaos, the chaotic side "
+                         "runs concurrently; alone, emits the loadtest "
+                         "throughput/latency report vs the serial "
+                         "baseline")
+    ap.add_argument("--tenants", type=int, default=2,
+                    help="simulated tenants for --concurrency runs")
     args = ap.parse_args()
 
     if args.chaos:
         wanted = [q.strip() for q in args.queries.split(",") if q.strip()]
         report = run_chaos(sf=args.sf if args.sf is not None else 0.02,
                            seed=args.seed if args.seed is not None else 7,
-                           queries=wanted or None, use_sql=args.sql)
+                           queries=wanted or None, use_sql=args.sql,
+                           concurrency=args.concurrency)
         print(json.dumps(report))
         if args.out:
             with open(args.out, "w") as f:
                 json.dump(report, f, indent=1)
+        return
+    if args.concurrency and args.concurrency > 1:
+        wanted = [q.strip() for q in args.queries.split(",") if q.strip()]
+        report = run_concurrent(
+            sf=args.sf if args.sf is not None else 0.1,
+            seed=args.seed if args.seed is not None else 0,
+            queries=wanted or None, use_sql=args.sql,
+            concurrency=args.concurrency, tenants=args.tenants,
+            eventlog_dir=(None if args.no_eventlog else args.eventlog_dir))
+        print(json.dumps(report))
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(report, f, indent=1)
+        if not report["ok"]:
+            raise SystemExit(1)
         return
     if args.sf is None:
         args.sf = 0.1

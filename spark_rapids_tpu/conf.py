@@ -655,6 +655,35 @@ def generate_docs() -> str:
         "fallback inventory, >=95% span-attribution contract) and "
         "`... compare A B` diffs two runs per-query/per-operator.",
         "",
+        "## Query service",
+        "",
+        "`spark_rapids_tpu.service.QueryService` is the concurrent "
+        "multi-tenant front end over one session: a "
+        "`spark.rapids.service.maxConcurrentQueries`-wide worker pool "
+        "executes admitted queries concurrently (device residency still "
+        "gated by `spark.rapids.sql.concurrentGpuTasks`), with named "
+        "scheduling pools (`spark.rapids.service.pools`), per-tenant "
+        "weighted fair queueing "
+        "(`spark.rapids.service.tenantWeights`), bounded queue depth "
+        "with typed rejection + retry-after backpressure "
+        "(`spark.rapids.service.queueDepth`), per-query deadlines "
+        "(`spark.rapids.service.defaultTimeoutMs` or "
+        "`submit(timeout_ms=...)`) enforced cooperatively BETWEEN "
+        "batches at every exec boundary (as is "
+        "`QueryHandle.cancel()`), and memory-pressure-aware admission "
+        "consulting the spill catalog "
+        "(`spark.rapids.service.admission.maxDeviceBytes`). "
+        "Structurally identical plans under result-identical conf are "
+        "served from the plan-fingerprint result cache "
+        "(`spark.rapids.service.resultCache.*`), invalidated on "
+        "temp-view/catalog mutation, `WriteFiles`, and Delta commits. "
+        "Event-log records carry tenant/pool/queue-wait/cache-hit "
+        "fields (schema v2); `python -m spark_rapids_tpu.tools "
+        "loadtest` and `scale_test.py --concurrency N` drive TPC-H "
+        "q1-q22 across simulated tenants, asserting bit-identical "
+        "results against serial execution and reporting "
+        "throughput/p50/p95 latency, queue wait and cache hit rate.",
+        "",
         "## Fault tolerance",
         "",
         "The `spark.rapids.shuffle.fetch.*` keys govern shuffle fetch "

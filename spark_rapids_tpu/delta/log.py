@@ -444,6 +444,13 @@ class DeltaLog:
             raise DeltaConcurrentModificationException(
                 f"concurrent commit at version {expected_version} of "
                 f"{self.table_path}")
+        # a committed table write stales every cached service result
+        # (the query-service result cache keys on pre-write state)
+        from spark_rapids_tpu.service.result_cache import (
+            bump_invalidation_epoch,
+        )
+        bump_invalidation_epoch(
+            f"delta {op_name} v{expected_version} {self.table_path}")
         return expected_version
 
     def history(self) -> List[dict]:

@@ -112,7 +112,19 @@ def clear_device_constants() -> int:
 
 # -- sanctioned host synchronization ----------------------------------------
 
-_HOST_FETCHES = [0]
+
+class _ThreadCounter(threading.local):
+    """Per-thread counter: queries execute whole on one thread (direct
+    calls on the caller's thread, service queries on their worker), so
+    thread-locality makes the per-query dispatch/sync counts correct
+    under CONCURRENT queries — a shared slot would cross-contaminate
+    every in-flight query's count on reset."""
+
+    def __init__(self):
+        self.n = 0
+
+
+_HOST_FETCHES = _ThreadCounter()
 
 
 def host_fetch(value):
@@ -124,33 +136,34 @@ def host_fetch(value):
     so funneling them here keeps them countable (``host_fetch_count``)
     and greppable in review. Returns the fetched value as host data
     (numpy array or python scalar for 0-d inputs)."""
-    _HOST_FETCHES[0] += 1
+    _HOST_FETCHES.n += 1
     fetched = jax.device_get(value)
     return fetched
 
 
 def host_fetch_count() -> int:
-    return _HOST_FETCHES[0]
+    return _HOST_FETCHES.n
 
 
 # -- dispatch accounting ----------------------------------------------------
 
-_DISPATCHES = [0]
+_DISPATCHES = _ThreadCounter()
 
 
 def count_dispatch(n: int = 1) -> None:
-    """Record ``n`` device kernel dispatches. No-op inside a jit trace
-    (an inlined sub-kernel is not a dispatch)."""
-    _DISPATCHES[0] += n
+    """Record ``n`` device kernel dispatches (on this thread — see
+    _ThreadCounter). No-op inside a jit trace (an inlined sub-kernel is
+    not a dispatch)."""
+    _DISPATCHES.n += n
 
 
 def dispatch_count() -> int:
-    return _DISPATCHES[0]
+    return _DISPATCHES.n
 
 
 def reset_dispatch_count() -> int:
-    old = _DISPATCHES[0]
-    _DISPATCHES[0] = 0
+    old = _DISPATCHES.n
+    _DISPATCHES.n = 0
     return old
 
 

@@ -110,6 +110,37 @@ class PlanVerificationError(RapidsTpuError):
             "\n".join(f"  {d}" for d in self.diagnostics))
 
 
+class SemaphoreTimeoutError(RapidsTpuError, TimeoutError):
+    """TpuSemaphore acquisition timed out: ``max_tasks`` queries already
+    hold device residency and none released within the caller's timeout.
+    A typed signal (not a bare TimeoutError, though it still IS one for
+    callers catching broadly) so the query service can report
+    backpressure distinctly from deadline expiry."""
+
+
+class QueryRejectedError(RapidsTpuError):
+    """The query service refused admission — the target pool's queue is
+    at ``spark.rapids.service.queueDepth``. Carries ``retry_after_ms``,
+    the service's backpressure hint for when capacity is likely free
+    (the HTTP 429 Retry-After analog)."""
+
+    def __init__(self, message: str, retry_after_ms: int = 100):
+        super().__init__(message)
+        self.retry_after_ms = int(retry_after_ms)
+
+
+class QueryCancelledError(RapidsTpuError):
+    """The query was cancelled via ``QueryHandle.cancel()``. Raised
+    cooperatively between batches at the exec boundary (service/query.py
+    install_cancellation), so a running plan stops at the next pull
+    instead of after the query."""
+
+
+class QueryTimeoutError(RapidsTpuError):
+    """The query's deadline (submit time + timeout) expired — while
+    queued, or cooperatively between batches while running."""
+
+
 class AnsiViolation(RapidsTpuError, ArithmeticError):
     """ANSI mode (spark.sql.ansi.enabled) runtime error: overflow, divide
     by zero, invalid cast, or array index out of bounds — the engine's

@@ -79,6 +79,10 @@ RULES: Dict[str, str] = {
                       "out of sync (unregistered name, non-literal name, "
                       "registered point with no site, or site outside "
                       "its registered module)",
+    "RL-THREAD-SHARED": "module-global or class-level mutable state in "
+                        "runtime/, shuffle/ or service/ written outside "
+                        "a lock guard (concurrent query workers share "
+                        "these modules)",
 }
 
 

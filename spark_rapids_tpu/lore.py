@@ -106,13 +106,15 @@ def _strip_for_pickle(exec_obj):
                 setattr(clone, a, None if a != "metrics" else MetricSet())
             except AttributeError:
                 pass
-    # fault-boundary wrappers (runtime/faults.install_fault_boundaries)
-    # and observation wrappers (obs/spans.install_observation) are
+    # fault-boundary wrappers (runtime/faults.install_fault_boundaries),
+    # observation wrappers (obs/spans.install_observation) and
+    # cancellation wrappers (service/query.install_cancellation) are
     # instance-attribute closures: unpicklable, and a replayed exec
     # wants the plain class methods anyway. DELETE (not None) so the
     # class methods resurface.
-    for a in ("execute", "execute_masked", "_fault_guarded",
-              "_obs_installed", "_obs_depth", "_obs_pending_rows"):
+    for a in ("execute", "execute_masked", "execute_cpu",
+              "_fault_guarded", "_obs_installed", "_obs_depth",
+              "_obs_pending_rows", "_cancel_installed"):
         clone.__dict__.pop(a, None)
     # children are replaced by scans at replay; drop them from the pickle
     if hasattr(clone, "children"):

@@ -41,8 +41,10 @@ EVENT_LOG_DIR = str_conf(
     "session).")
 
 #: bump on ANY record shape change and update the golden test — the
-#: offline tools key off this
-EVENT_SCHEMA_VERSION = 1
+#: offline tools key off this.
+#: v2 (query service PR): + tenant, pool, queueWaitS, cacheHit fields
+#: (null/false for queries executed outside the service).
+EVENT_SCHEMA_VERSION = 2
 
 
 def plan_tree(executable) -> dict:
@@ -147,15 +149,24 @@ def build_query_record(*, query_index: int, wall_s: float,
                        fault_fires: Dict[str, int],
                        demotions: Dict[str, str],
                        spans_summary: Optional[dict],
-                       fault_replays: int) -> dict:
+                       fault_replays: int,
+                       service: Optional[dict] = None) -> dict:
     """Assemble one event-log record. Every field is JSON-native; the
-    golden schema test normalizes timings and pins the shape."""
+    golden schema test normalizes timings and pins the shape.
+    ``service`` is the query-service envelope (tenant, pool, queueWaitS,
+    cacheHit) — None for queries executed outside the service, which
+    still record the fields as null/false so the schema is stable."""
+    service = service or {}
     return {
         "schema": EVENT_SCHEMA_VERSION,
         "event": "queryCompleted",
         "queryIndex": query_index,
         "queryTag": query_tag,
         "sqlText": sql_text,
+        "tenant": service.get("tenant"),
+        "pool": service.get("pool"),
+        "queueWaitS": service.get("queueWaitS"),
+        "cacheHit": bool(service.get("cacheHit", False)),
         "wallS": round(wall_s, 6),
         "phasesS": {k: round(v, 6) for k, v in sorted(phases.items())},
         "dispatches": dispatches,

@@ -122,18 +122,36 @@ class MetricSet(dict):
 # Process-wide subsystem scopes
 # ---------------------------------------------------------------------------
 
-_SCOPES: Dict[str, MetricSet] = {}
+
+class LockedMetricSet(MetricSet):
+    """A MetricSet whose ``add`` is atomic. Process-wide scopes are
+    written from many threads at once (shuffle pool workers, concurrent
+    query-service workers); the plain read-modify-write ``add`` would
+    lose increments under that interleaving. Per-EXEC metric sets stay
+    unlocked — an exec instance is drained by one thread."""
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self._lock = threading.Lock()
+
+    def add(self, key: str, value, level: Optional[str] = None) -> None:
+        with self._lock:
+            super().add(key, value, level)
+
+
+_SCOPES: Dict[str, LockedMetricSet] = {}
 _SCOPE_LOCK = threading.Lock()
 
 
-def metric_scope(name: str) -> MetricSet:
+def metric_scope(name: str) -> LockedMetricSet:
     """The named process-wide MetricSet for a non-operator subsystem
-    (``spill``, ``recovery``, ``shuffle``). Created on first use; the
-    event log snapshots/diffs these per query."""
+    (``spill``, ``recovery``, ``shuffle``, ``semaphore``, ``service``).
+    Created on first use; the event log snapshots/diffs these per
+    query. Thread-safe: ``add`` is atomic."""
     with _SCOPE_LOCK:
         s = _SCOPES.get(name)
         if s is None:
-            s = _SCOPES[name] = MetricSet()
+            s = _SCOPES[name] = LockedMetricSet()
         return s
 
 

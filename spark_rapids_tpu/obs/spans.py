@@ -52,9 +52,10 @@ _MAX_SPANS = 200_000
 
 class Span:
     __slots__ = ("sid", "name", "cat", "t0", "t1", "tid", "tname",
-                 "parent", "args")
+                 "parent", "args", "ctx")
 
-    def __init__(self, sid, name, cat, t0, tid, tname, parent, args):
+    def __init__(self, sid, name, cat, t0, tid, tname, parent, args,
+                 ctx=None):
         self.sid = sid
         self.name = name
         self.cat = cat
@@ -64,6 +65,7 @@ class Span:
         self.tname = tname
         self.parent = parent
         self.args = args
+        self.ctx = ctx
 
     @property
     def dur(self) -> float:
@@ -98,69 +100,152 @@ class _LiveSpan:
         return False
 
 
+class _QueryCtx:
+    """One query's span buffer, owned by the thread that called
+    ``begin_query``. Per-thread span stacks live ON the context (keyed by
+    thread id) so helper-thread stacks die with the query instead of
+    leaking stale parents into the next query on that thread."""
+
+    __slots__ = ("query_id", "owner_tid", "spans", "dropped", "t0",
+                 "stacks", "closed")
+
+    def __init__(self, query_id: int, owner_tid: int):
+        self.query_id = query_id
+        self.owner_tid = owner_tid
+        self.spans: List[Span] = []
+        self.dropped = 0
+        self.t0 = time.perf_counter()
+        self.stacks: Dict[int, list] = {}
+        self.closed = False
+
+
+#: sentinel bound to a thread's ctx slot while it runs an UNOBSERVED
+#: query — blocks the single-active-context adoption below
+_ADOPT_BLOCKED = object()
+
+
 class SpanTracer:
-    """Process-wide span collector. ``enabled`` gates every record path;
-    spans buffer between ``begin_query``/``end_query`` and drain into
-    the caller (the session's event-log writer / trace exporter)."""
+    """Process-wide span collector, safe for CONCURRENT queries: each
+    ``begin_query`` opens a :class:`_QueryCtx` bound to the calling
+    thread (the query service executes every query on its own worker
+    thread), and spans recorded on that thread land in that context.
+    A thread with no bound context (a shuffle/IO pool helper) adopts the
+    single active context when exactly one query is in flight — under
+    concurrency its spans are dropped rather than misattributed.
+    ``enabled`` is True while ANY query collects; record sites keep
+    their one-attribute-read disabled cost."""
 
     def __init__(self):
         self.enabled = False
         self._lock = threading.Lock()
-        self._spans: List[Span] = []
-        self._dropped = 0
+        self._ctxs: Dict[int, _QueryCtx] = {}  # owner tid -> ctx
         self._next_id = 0
         self._tls = threading.local()
-        self.query_id: Optional[int] = None
-        self.main_tid: Optional[int] = None
-        self._query_t0: Optional[float] = None
+        self._unobserved = 0  # in-flight queries with NO envelope
 
-    # -- per-thread span stack ---------------------------------------------
-    def _stack(self) -> list:
-        st = getattr(self._tls, "stack", None)
-        if st is None:
-            st = self._tls.stack = []
-        return st
-
-    # -- collection --------------------------------------------------------
-    def begin_query(self, query_id: int) -> None:
-        # a failed prior query can leave unclosed spans on this thread's
-        # stack (exception unwound mid-phase); start clean
-        self._stack().clear()
+    # -- context resolution -------------------------------------------------
+    def _ctx(self) -> Optional[_QueryCtx]:
+        ctx = getattr(self._tls, "ctx", None)
+        if ctx is _ADOPT_BLOCKED:
+            # this thread runs an UNOBSERVED query concurrently with an
+            # observed one: its spans belong to neither active ctx
+            return None
+        if ctx is not None and not ctx.closed:
+            return ctx
+        # helper thread: adopt the only active query, but ONLY while no
+        # unobserved query is in flight anywhere — an unobserved
+        # query's shuffle/IO pool work is indistinguishable from the
+        # observed query's here, and misattribution is worse than a
+        # dropped helper span
         with self._lock:
-            self._spans = []
-            self._dropped = 0
-            self.query_id = query_id
-            self.main_tid = threading.get_ident()
-            self._query_t0 = time.perf_counter()
-            self.enabled = True
+            if len(self._ctxs) == 1 and not self._unobserved:
+                return next(iter(self._ctxs.values()))
+        return None
 
-    def end_query(self) -> List[Span]:
-        """Stop collecting and return the query's finished spans."""
+    def begin_unobserved_query(self) -> None:
+        """Mark this thread as executing a query WITHOUT an observation
+        envelope (event log and tracing off for its session): neither
+        its own spans nor its helper-pool work may be adopted into some
+        other session's concurrently active query context."""
+        self._tls.ctx = _ADOPT_BLOCKED
         with self._lock:
-            self.enabled = False
-            spans = [s for s in self._spans if s.t1 is not None]
-            self._spans = []
-            self.query_id = None
-            return spans
+            self._unobserved += 1
+
+    def end_unobserved_query(self) -> None:
+        if getattr(self._tls, "ctx", None) is _ADOPT_BLOCKED:
+            self._tls.ctx = None
+            with self._lock:
+                self._unobserved -= 1
+
+    def _stack(self, ctx: _QueryCtx) -> list:
+        return ctx.stacks.setdefault(threading.get_ident(), [])
+
+    # -- compat / introspection --------------------------------------------
+    @property
+    def _spans(self) -> List[Span]:
+        """All in-flight spans across active contexts (tests/debug)."""
+        with self._lock:
+            return [s for c in self._ctxs.values() for s in c.spans]
+
+    @property
+    def main_tid(self) -> Optional[int]:
+        """Owner thread of the CURRENT thread's query context."""
+        ctx = self._ctx()
+        return ctx.owner_tid if ctx is not None else None
+
+    @property
+    def query_id(self) -> Optional[int]:
+        ctx = self._ctx()
+        return ctx.query_id if ctx is not None else None
 
     @property
     def dropped(self) -> int:
-        return self._dropped
+        ctx = self._ctx()
+        return ctx.dropped if ctx is not None else 0
+
+    # -- collection --------------------------------------------------------
+    def begin_query(self, query_id: int) -> _QueryCtx:
+        tid = threading.get_ident()
+        ctx = _QueryCtx(query_id, tid)
+        with self._lock:
+            self._ctxs[tid] = ctx
+            self.enabled = True
+        self._tls.ctx = ctx
+        return ctx
+
+    def end_query(self) -> List[Span]:
+        """Stop collecting THIS thread's query and return its finished
+        spans."""
+        tid = threading.get_ident()
+        with self._lock:
+            ctx = self._ctxs.pop(tid, None)
+            self.enabled = bool(self._ctxs)
+        self._tls.ctx = None
+        if ctx is None:
+            return []
+        ctx.closed = True
+        return [s for s in ctx.spans if s.t1 is not None]
 
     def begin(self, name: str, cat: str = "op", **args) -> Optional[Span]:
         if not self.enabled:
             return None
-        st = self._stack()
+        ctx = self._ctx()
+        if ctx is None:
+            return None
+        st = self._stack(ctx)
         parent = st[-1].sid if st else None
         tid = threading.get_ident()
         with self._lock:
-            if len(self._spans) >= _MAX_SPANS:
-                self._dropped += 1
+            if ctx.closed:
+                return None
+            if len(ctx.spans) >= _MAX_SPANS:
+                ctx.dropped += 1
                 return None
             self._next_id += 1
             sp = Span(self._next_id, name, cat, time.perf_counter(), tid,
-                      threading.current_thread().name, parent, args or None)
-            self._spans.append(sp)
+                      threading.current_thread().name, parent, args or None,
+                      ctx)
+            ctx.spans.append(sp)
         st.append(sp)
         return sp
 
@@ -168,8 +253,11 @@ class SpanTracer:
         if span is None or span.t1 is not None:
             return  # idempotent: an error path may re-end a closed span
         span.t1 = time.perf_counter()
-        st = self._stack()
-        if st and st[-1] is span:
+        ctx = span.ctx
+        st = ctx.stacks.get(span.tid) if ctx is not None else None
+        if not st:
+            return
+        if st[-1] is span:
             st.pop()
         elif span in st:        # exception unwound past nested spans
             while st and st[-1] is not span:
@@ -244,17 +332,20 @@ def union_seconds(intervals) -> float:
     return total
 
 
-def summarize_spans(spans: List[Span], main_tid: Optional[int],
+def summarize_spans(spans: List[Span], exec_tid: Optional[int],
                     wall_s: float) -> dict:
     """Per-query span summary: category totals (union per category, so
     nesting never double-counts), attribution of the query wall to
-    NAMED spans on the query's main thread, and worker-thread totals."""
+    NAMED spans on the thread that EXECUTED the query (the thread that
+    opened the query context — the process main thread for direct
+    ``session.execute`` calls, a service worker thread for scheduled
+    queries), and helper-thread totals."""
     by_cat: Dict[str, list] = {}
     main_intervals = []
     worker: Dict[str, list] = {}
     for s in spans:
         by_cat.setdefault(s.cat, []).append((s.t0, s.t1))
-        if s.tid == main_tid:
+        if s.tid == exec_tid:
             if s.cat != "query":
                 main_intervals.append((s.t0, s.t1))
         else:

@@ -9,6 +9,7 @@ tracking + spill/retry on top (runtime/catalog.py, runtime/retry.py)."""
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass
 from typing import List, Optional
 
@@ -43,6 +44,7 @@ class TpuDeviceManager:
     """Singleton-ish per-process device state."""
 
     _instance: Optional["TpuDeviceManager"] = None
+    _instance_lock = threading.Lock()
 
     def __init__(self, conf: RapidsConf):
         self.conf = conf
@@ -119,7 +121,8 @@ class TpuDeviceManager:
             self.conf.get_entry(HOST_SPILL_STORAGE_SIZE)
         HostMemoryArbiter.reset(self.conf.get_entry(HOST_MEMORY_LIMIT))
         PinnedMemoryPool.initialize(self.conf.get_entry(PINNED_POOL_SIZE))
-        TpuDeviceManager._instance = self
+        with TpuDeviceManager._instance_lock:
+            TpuDeviceManager._instance = self
         self.initialized = True
 
     @classmethod

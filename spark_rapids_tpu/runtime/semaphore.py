@@ -14,6 +14,22 @@ import time
 import traceback
 from typing import Dict, Optional
 
+from spark_rapids_tpu.errors import SemaphoreTimeoutError
+from spark_rapids_tpu.obs.metrics import metric_scope, register_metric
+
+# acquisition accounting lives in the unified registry's ``semaphore``
+# scope (obs/metrics.py) so the event log diffs it per query like the
+# spill/recovery/shuffle scopes
+register_metric("acquireWaitTime", "timing", "ESSENTIAL",
+                "wall time queries spent waiting for a device "
+                "concurrency slot (TpuSemaphore)")
+register_metric("acquires", "count", "ESSENTIAL",
+                "TpuSemaphore slot acquisitions (first acquisition per "
+                "holder; reentrant re-entries not counted)")
+register_metric("acquireTimeouts", "count", "ESSENTIAL",
+                "TpuSemaphore acquisitions abandoned on timeout "
+                "(SemaphoreTimeoutError)")
+
 
 class TpuSemaphore:
     _instance: Optional["TpuSemaphore"] = None
@@ -24,8 +40,10 @@ class TpuSemaphore:
         self.stall_dump_seconds = stall_dump_seconds
         self._lock = threading.Condition()
         self._holders: Dict[int, int] = {}  # thread id -> reentrant depth
+        self._metrics = metric_scope("semaphore")
         self.total_wait_seconds = 0.0
         self.acquire_count = 0
+        self.timeout_count = 0
 
     @classmethod
     def initialize(cls, max_tasks: int) -> "TpuSemaphore":
@@ -58,8 +76,14 @@ class TpuSemaphore:
             while len(self._holders) >= self.max_tasks:
                 remaining = None if deadline is None else deadline - time.perf_counter()
                 if remaining is not None and remaining <= 0:
-                    raise TimeoutError(
-                        f"TpuSemaphore: {self.max_tasks} tasks already on device")
+                    self.timeout_count += 1
+                    self._metrics.add("acquireTimeouts", 1)
+                    self._metrics.add("acquireWaitTime",
+                                      time.perf_counter() - t0)
+                    raise SemaphoreTimeoutError(
+                        f"TpuSemaphore: {self.max_tasks} tasks already on "
+                        f"device after waiting "
+                        f"{time.perf_counter() - t0:.3f}s")
                 waited = time.perf_counter() - t0
                 if not dumped and waited > self.stall_dump_seconds:
                     self._dump_stacks()
@@ -67,7 +91,10 @@ class TpuSemaphore:
                 self._lock.wait(timeout=min(remaining or 1.0, 1.0))
             self._holders[tid] = 1
             self.acquire_count += 1
-            self.total_wait_seconds += time.perf_counter() - t0
+            waited = time.perf_counter() - t0
+            self.total_wait_seconds += waited
+            self._metrics.add("acquires", 1)
+            self._metrics.add("acquireWaitTime", waited)
 
     def release_if_held(self):
         tid = threading.get_ident()

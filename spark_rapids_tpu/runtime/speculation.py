@@ -23,6 +23,7 @@ kernels, one round trip.
 from __future__ import annotations
 
 import contextvars
+import threading
 from typing import List, Optional, Tuple
 
 import jax
@@ -88,6 +89,11 @@ _CTX: contextvars.ContextVar[Optional[SpecContext]] = contextvars.ContextVar(
 #: sites whose speculation failed once — they take the exact path forever
 #: after (per process), so a repeated query shape never replays twice.
 _BLOCKLIST = set()
+#: guards _BLOCKLIST writes: failed attempts on CONCURRENT query
+#: workers blocklist sites at the same time (membership reads stay
+#: lock-free — set containment is atomic under the GIL, and a stale
+#: read only costs one extra speculative attempt)
+_BLOCKLIST_LOCK = threading.Lock()
 
 
 def current() -> Optional[SpecContext]:
@@ -111,7 +117,8 @@ def allowed(site_key: str) -> Optional[SpecContext]:
 
 
 def blocklist(sites) -> None:
-    _BLOCKLIST.update(sites)
+    with _BLOCKLIST_LOCK:
+        _BLOCKLIST.update(sites)
 
 
 def guard_attempt(fn):

@@ -189,6 +189,7 @@ class BufferCatalog:
     used device buffers until the byte target frees."""
 
     _instance: Optional["BufferCatalog"] = None
+    _instance_lock = threading.Lock()
 
     #: per-catalog counters stay instance-local (two catalogs can be
     #: live at once — reset() mid-flight, per-catalog tests — and must
@@ -218,14 +219,20 @@ class BufferCatalog:
 
     @classmethod
     def get(cls) -> "BufferCatalog":
+        # double-checked: two concurrent first-users must not build two
+        # catalogs (spillables registered in the loser's would never be
+        # found by a spill targeting the winner's)
         if cls._instance is None:
-            cls._instance = BufferCatalog()
+            with cls._instance_lock:
+                if cls._instance is None:
+                    cls._instance = BufferCatalog()
         return cls._instance
 
     @classmethod
     def reset(cls, host_limit_bytes: int = 2 << 30, disk_dir=None):
-        cls._instance = BufferCatalog(host_limit_bytes, disk_dir)
-        return cls._instance
+        with cls._instance_lock:
+            cls._instance = BufferCatalog(host_limit_bytes, disk_dir)
+            return cls._instance
 
     def register(self, sb: SpillableBatch):
         with self._lock:

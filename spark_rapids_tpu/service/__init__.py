@@ -1,0 +1,31 @@
+"""Multi-tenant concurrent query service.
+
+Reference: the serving layer the plugin assumes Spark provides —
+concurrent tasks sharing one device through ``GpuSemaphore``
+(``spark.rapids.sql.concurrentGpuTasks``), scheduler pools, and the
+driver's kill/timeout plumbing. This engine owns its sessions, so it
+owns the serving layer too:
+
+* :mod:`spark_rapids_tpu.service.scheduler` — ``QueryService``: a
+  worker pool in front of one ``TpuSession``, with named scheduling
+  pools, per-tenant weighted fair queueing, bounded queue depth with
+  typed rejection (``QueryRejectedError`` + retry-after), per-query
+  deadlines, and memory-pressure-aware admission consulting the spill
+  catalog. Knobs under ``spark.rapids.service.*``.
+* :mod:`spark_rapids_tpu.service.query` — ``QueryHandle``: the
+  QUEUED -> ADMITTED -> RUNNING -> {FINISHED, FAILED, CANCELLED,
+  TIMED_OUT} state machine, plus the cooperative-cancellation exec
+  boundary (third per-query wrapper in the
+  ``install_fault_boundaries`` / ``install_observation`` family).
+* :mod:`spark_rapids_tpu.service.result_cache` — plan-fingerprint LRU
+  result cache over ``HostTable`` results, invalidated on catalog
+  mutation and table writes.
+"""
+
+from spark_rapids_tpu.service.query import (  # noqa: F401
+    QueryHandle,
+    QueryState,
+    install_cancellation,
+)
+from spark_rapids_tpu.service.result_cache import ResultCache  # noqa: F401
+from spark_rapids_tpu.service.scheduler import QueryService  # noqa: F401

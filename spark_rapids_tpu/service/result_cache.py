@@ -1,0 +1,360 @@
+"""Plan-fingerprint result cache.
+
+Reference: Spark's ``CACHE TABLE`` / the reference plugin's
+``GpuInMemoryTableScanExec`` cache the INPUT of a query; a serving
+layer wants to cache the OUTPUT — the same SQL (or DSL plan) from
+another tenant should not re-run q1 over an unchanged warehouse. The
+cache keys on a CANONICAL STRUCTURAL FINGERPRINT of the submitted plan
+(expression trees hash by their structural ``repr``; source tables by
+identity token; file scans by path list) with the result-affecting conf
+keys folded in, so two structurally identical queries hit regardless of
+which tenant built them.
+
+Correctness over hit rate, everywhere:
+
+* anything the fingerprinter cannot PROVE structurally stable (a UDF
+  closure, an unknown object with an address-y repr) marks the plan
+  uncacheable — a miss, never a wrong hit;
+* every catalog mutation or table write bumps the process-wide
+  invalidation epoch (:func:`bump_invalidation_epoch`); entries
+  remember the epoch they were filled under and a stale entry is
+  evicted on lookup, never served;
+* the LRU is bounded by ``spark.rapids.service.resultCache.maxBytes``
+  of ``HostTable.nbytes()``.
+
+Hit/miss/evict/invalidation counters live in the unified metric
+registry's ``resultCache`` scope.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import weakref
+from collections import OrderedDict
+from typing import Optional
+
+from spark_rapids_tpu.obs.metrics import metric_scope, register_metric
+
+register_metric("resultCacheHits", "count", "ESSENTIAL",
+                "service queries served from the plan-fingerprint cache")
+register_metric("resultCacheMisses", "count", "ESSENTIAL",
+                "service queries that executed (fingerprint absent, "
+                "stale, or plan uncacheable)")
+register_metric("resultCacheEvictions", "count", "ESSENTIAL",
+                "entries evicted by the LRU byte bound")
+register_metric("resultCacheInvalidations", "count", "ESSENTIAL",
+                "stale entries dropped on lookup after an epoch bump")
+register_metric("resultCacheBytes", "bytes", "MODERATE",
+                "bytes currently held by the result cache")
+
+
+# ---------------------------------------------------------------------------
+# Invalidation epoch
+# ---------------------------------------------------------------------------
+
+_EPOCH_LOCK = threading.Lock()
+_EPOCH = [0]
+_EPOCH_REASON = [""]
+
+
+def invalidation_epoch() -> int:
+    with _EPOCH_LOCK:
+        return _EPOCH[0]
+
+
+def bump_invalidation_epoch(reason: str = "") -> int:
+    """Storage/catalog state changed (temp-view or table registration,
+    WriteFiles, Delta/Iceberg commit): every currently cached result is
+    stale. Called by the session's write detection, the SQL catalog's
+    mutators, and the Delta log's commit path."""
+    with _EPOCH_LOCK:
+        _EPOCH[0] += 1
+        _EPOCH_REASON[0] = reason
+        return _EPOCH[0]
+
+
+# ---------------------------------------------------------------------------
+# Plan fingerprinting
+# ---------------------------------------------------------------------------
+
+
+class Unfingerprintable(Exception):
+    """Internal: the plan holds state the fingerprinter cannot prove
+    structurally stable. The query runs uncached."""
+
+
+#: lazily resolved (datetime, np, T, HostTable, Expression, PlanNode) —
+#: module-level import would pull the whole plan layer at package
+#: import; resolving on first fingerprint keeps service importable
+#: standalone while the hot path pays one tuple unpack per call
+_FP_TYPES = None
+
+
+#: conf key prefixes that cannot change a query's RESULT — observability
+#: and service knobs are excluded from the fingerprint so flipping the
+#: event log on does not cold the cache. Everything else folds in.
+_RESULT_NEUTRAL_PREFIXES = (
+    "spark.rapids.sql.eventLog.",
+    "spark.rapids.trace.",
+    "spark.rapids.profile.",
+    "spark.rapids.sql.metrics.level",
+    "spark.rapids.sql.lore.",
+    "spark.rapids.sql.explain",
+    "spark.rapids.sql.planVerify.mode",
+    "spark.rapids.service.",
+)
+
+#: identity tokens for in-memory source tables: a HostTable object IS
+#: its data (tables are immutable after construction), so identity is a
+#: sound cache key — and the weak keying means a collected table can
+#: never alias a new one's token
+_TABLE_TOKENS: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+_TABLE_TOKEN_LOCK = threading.Lock()
+_TABLE_TOKEN_SEQ = [0]
+
+
+def _table_token(table) -> str:
+    with _TABLE_TOKEN_LOCK:
+        tok = _TABLE_TOKENS.get(table)
+        if tok is None:
+            _TABLE_TOKEN_SEQ[0] += 1
+            tok = f"tbl#{_TABLE_TOKEN_SEQ[0]}"
+            _TABLE_TOKENS[table] = tok
+        return tok
+
+
+def _fp_value(obj, depth: int = 0) -> str:
+    """One value's canonical token. Raises Unfingerprintable for
+    anything that cannot be proven stable."""
+    # deferred-but-cached: fingerprinting runs on the service's submit
+    # hot path, once per attribute of every plan node — resolve the
+    # type anchors once per process, not per call
+    global _FP_TYPES
+    if _FP_TYPES is None:
+        import datetime
+
+        import numpy as np
+
+        from spark_rapids_tpu import types as T
+        from spark_rapids_tpu.columnar import HostTable
+        from spark_rapids_tpu.ops.expr import Expression
+        from spark_rapids_tpu.plan.nodes import PlanNode
+        _FP_TYPES = (datetime, np, T, HostTable, Expression, PlanNode)
+    datetime, np, T, HostTable, Expression, PlanNode = _FP_TYPES
+
+    if depth > 64:
+        raise Unfingerprintable("plan too deep to fingerprint")
+    if obj is None or isinstance(obj, (bool, int, float, str, bytes)):
+        return f"{type(obj).__name__}:{obj!r}"
+    if isinstance(obj, (datetime.date, datetime.datetime)):
+        return f"dt:{obj.isoformat()}"
+    if isinstance(obj, T.DataType):
+        return f"type:{obj}"
+    if isinstance(obj, HostTable):
+        return _fp_value_table(obj)
+    if isinstance(obj, (Expression, PlanNode)) or \
+            type(obj).__module__.startswith("spark_rapids_tpu."):
+        # generic structural walk over instance state — plan nodes,
+        # expressions, and plain engine data holders (SortOrder,
+        # WindowSpec, ...). Unlike .key() (which drops string literal
+        # VALUES because the compile cache doesn't need them) or
+        # __repr__ (which some subclasses leave at the children-only
+        # default), this captures EVERY non-child attribute, so two
+        # nodes differing in any parameter can never collide; state the
+        # walk cannot prove stable (closures, device arrays) raises
+        # Unfingerprintable and the plan just never caches
+        return _fp_node(obj, depth + 1)
+    if isinstance(obj, np.generic):
+        return f"np:{obj.dtype}:{obj!r}"
+    if isinstance(obj, np.ndarray):
+        if obj.dtype == object:
+            raise Unfingerprintable("object ndarray in plan state")
+        return (f"nd:{obj.dtype}:{obj.shape}:"
+                f"{hashlib.sha1(np.ascontiguousarray(obj).tobytes()).hexdigest()}")
+    if isinstance(obj, dict):
+        items = sorted((str(k), _fp_value(v, depth + 1))
+                       for k, v in obj.items())
+        return "dict{" + ",".join(f"{k}={v}" for k, v in items) + "}"
+    if isinstance(obj, (list, tuple)):
+        return ("seq[" +
+                ",".join(_fp_value(v, depth + 1) for v in obj) + "]")
+    if isinstance(obj, (set, frozenset)):
+        return ("set{" +
+                ",".join(sorted(_fp_value(v, depth + 1) for v in obj)) +
+                "}")
+    raise Unfingerprintable(
+        f"{type(obj).__name__} in plan state is not fingerprintable")
+
+
+def _fp_value_table(table) -> str:
+    return f"table:{_table_token(table)}"
+
+
+#: per-node attributes that never affect results (caches, back-refs;
+#: the session conf folds into the fingerprint separately)
+_SKIP_ATTRS = {"_session", "_table", "conf", "_conf"}
+
+
+def _fp_node(node, depth: int = 0) -> str:
+    """Canonical token of one plan node or expression: class name +
+    every non-child attribute's token (sorted by name) + children in
+    order."""
+    parts = [type(node).__name__]
+    try:
+        state = vars(node)
+    except TypeError:  # __slots__ object; nothing generic to prove
+        raise Unfingerprintable(
+            f"{type(node).__name__} has no inspectable state")
+    for name in sorted(state):
+        if name in _SKIP_ATTRS or name == "children":
+            continue
+        value = state[name]
+        if callable(value) and not isinstance(value, type):
+            raise Unfingerprintable(
+                f"{type(node).__name__}.{name} holds a callable")
+        parts.append(f"{name}={_fp_value(value, depth + 1)}")
+    kids = ",".join(_fp_node(c, depth + 1)
+                    for c in getattr(node, "children", ()))
+    return "(" + ";".join(parts) + ")[" + kids + "]"
+
+
+def fingerprint(plan, conf) -> Optional[str]:
+    """Canonical fingerprint of (bound plan, result-affecting conf), or
+    None when the plan is uncacheable (side-effecting WriteFiles nodes,
+    UDF closures, unfingerprintable state)."""
+    from spark_rapids_tpu.plan.nodes import WriteFiles
+
+    stack = [plan]
+    while stack:
+        n = stack.pop()
+        if isinstance(n, WriteFiles):
+            return None  # side effects never cache
+        stack.extend(getattr(n, "children", ()))
+    try:
+        plan_tok = _fp_node(plan)
+    except Unfingerprintable:
+        return None
+    conf_items = sorted(
+        (k, str(v)) for k, v in conf.to_dict().items()
+        if not any(k.startswith(p) or k == p.rstrip(".")
+                   for p in _RESULT_NEUTRAL_PREFIXES))
+    h = hashlib.sha1()
+    h.update(plan_tok.encode())
+    h.update(repr(conf_items).encode())
+    return h.hexdigest()
+
+
+# ---------------------------------------------------------------------------
+# The LRU cache
+# ---------------------------------------------------------------------------
+
+
+class _Entry:
+    __slots__ = ("table", "nbytes", "epoch", "event_record")
+
+    def __init__(self, table, nbytes: int, epoch: int, event_record):
+        self.table = table
+        self.nbytes = nbytes
+        self.epoch = epoch
+        self.event_record = event_record
+
+
+class ResultCache:
+    """LRU HostTable cache bounded by bytes. Thread-safe; entries filled
+    under an older invalidation epoch are dropped on lookup."""
+
+    def __init__(self, max_bytes: int):
+        self.max_bytes = int(max_bytes)
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[str, _Entry]" = OrderedDict()
+        self._bytes = 0
+        self._metrics = metric_scope("resultCache")
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.invalidations = 0
+
+    def _account_miss(self):
+        self.misses += 1
+        self._metrics.add("resultCacheMisses", 1)
+
+    def get(self, key: Optional[str]):
+        """The cached (table, event_record) for ``key``, or None. A None
+        key (uncacheable plan) counts a miss."""
+        if key is None:
+            with self._lock:
+                self._account_miss()
+            return None
+        epoch = invalidation_epoch()
+        with self._lock:
+            e = self._entries.get(key)
+            if e is not None and e.epoch != epoch:
+                del self._entries[key]
+                self._bytes -= e.nbytes
+                self._metrics.add("resultCacheBytes", -e.nbytes)
+                self.invalidations += 1
+                self._metrics.add("resultCacheInvalidations", 1)
+                e = None
+            if e is None:
+                self._account_miss()
+                return None
+            self._entries.move_to_end(key)
+            self.hits += 1
+            self._metrics.add("resultCacheHits", 1)
+            return e
+
+    def put(self, key: Optional[str], table, event_record=None,
+            epoch: Optional[int] = None) -> bool:
+        """Insert a result. ``epoch`` is the invalidation epoch the
+        result was COMPUTED under (captured by the caller before
+        execution) — a write that landed mid-execution then stales the
+        entry on its first lookup instead of the entry masquerading as
+        post-write state. Defaults to the current epoch for callers
+        with no execution window. Oversized results (> max_bytes) are
+        not cached. Returns whether stored."""
+        if key is None or table is None:
+            return False
+        nbytes = int(table.nbytes())
+        if nbytes > self.max_bytes:
+            return False
+        if epoch is None:
+            epoch = invalidation_epoch()
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= old.nbytes
+                self._metrics.add("resultCacheBytes", -old.nbytes)
+            while self._bytes + nbytes > self.max_bytes and self._entries:
+                _, victim = self._entries.popitem(last=False)
+                self._bytes -= victim.nbytes
+                self._metrics.add("resultCacheBytes", -victim.nbytes)
+                self.evictions += 1
+                self._metrics.add("resultCacheEvictions", 1)
+            self._entries[key] = _Entry(table, nbytes, epoch, event_record)
+            self._bytes += nbytes
+            self._metrics.add("resultCacheBytes", nbytes)
+        return True
+
+    def clear(self) -> None:
+        with self._lock:
+            self._metrics.add("resultCacheBytes", -self._bytes)
+            self._entries.clear()
+            self._bytes = 0
+
+    @property
+    def bytes_used(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    @property
+    def entry_count(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"hits": self.hits, "misses": self.misses,
+                    "evictions": self.evictions,
+                    "invalidations": self.invalidations,
+                    "entries": len(self._entries), "bytes": self._bytes}

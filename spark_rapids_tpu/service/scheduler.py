@@ -1,0 +1,621 @@
+"""QueryService: worker pool + admission control + weighted fair queueing.
+
+Reference: the reference plugin leans on Spark's scheduler — FAIR
+scheduler pools (``spark.scheduler.pool``) queue jobs per tenant, the
+driver bounds concurrent tasks, and ``GpuSemaphore`` bounds how many of
+those touch the device at once. This engine owns its sessions, so this
+module provides that stack natively:
+
+* **Admission**: bounded per-pool queue depth; a full queue raises the
+  typed :class:`~spark_rapids_tpu.errors.QueryRejectedError` carrying a
+  ``retry_after_ms`` backpressure hint. Before a worker takes a query,
+  admission consults the spill catalog's device-resident bytes
+  (``spark.rapids.service.admission.maxDeviceBytes``): over the high
+  water mark, queued queries HOLD until a running query finishes —
+  unless nothing is running (forward progress beats the gate).
+* **Scheduling**: two-level weighted fair queueing. Pools come from
+  ``spark.rapids.service.pools`` (``name[:weight=W]`` entries); tenants
+  weight via ``spark.rapids.service.tenantWeights``. Each completed
+  query charges its wall time / weight to its pool and tenant virtual
+  clocks; the next admitted query comes from the least-charged pool,
+  then the least-charged tenant within it. A newly active tenant joins
+  at the pool's current minimum clock so it can neither starve veterans
+  nor be starved by them.
+* **Execution**: ``maxConcurrentQueries`` daemon workers share ONE
+  TpuSession — `TpuSession.execute` is concurrency-safe (thread-local
+  envelopes, worker-scoped span attribution) and the TpuSemaphore
+  finally sees real concurrent acquirers. Results optionally come from
+  / fill the plan-fingerprint result cache (result_cache.py).
+* **Lifecycle**: deadlines (``defaultTimeoutMs`` or per-submit) expire
+  queued queries at the sweep and running ones cooperatively at exec
+  boundaries; ``QueryHandle.cancel()`` likewise.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Dict, List, Optional, Tuple
+
+from spark_rapids_tpu.conf import (
+    RapidsConf,
+    bool_conf,
+    int_conf,
+    str_conf,
+)
+from spark_rapids_tpu.errors import (
+    ColumnarProcessingError,
+    QueryCancelledError,
+    QueryRejectedError,
+    QueryTimeoutError,
+)
+from spark_rapids_tpu.service.query import (
+    QueryHandle,
+    QueryState,
+    cancel_scope,
+)
+from spark_rapids_tpu.service.result_cache import (
+    ResultCache,
+    fingerprint,
+    invalidation_epoch,
+)
+
+SERVICE_POOLS = str_conf(
+    "spark.rapids.service.pools", "default",
+    "Named scheduling pools: semicolon-separated 'name[:weight=W]' "
+    "entries (weight defaults to 1.0). Submissions name a pool; the "
+    "scheduler shares workers across pools by weighted fair queueing "
+    "on measured query wall time (FAIR scheduler pools analog).",
+    commonly_used=True)
+
+SERVICE_MAX_CONCURRENT = int_conf(
+    "spark.rapids.service.maxConcurrentQueries", 4,
+    "Worker threads executing admitted queries concurrently against "
+    "the shared session. Device residency within them is still gated "
+    "by spark.rapids.sql.concurrentGpuTasks (TpuSemaphore).",
+    commonly_used=True)
+
+SERVICE_QUEUE_DEPTH = int_conf(
+    "spark.rapids.service.queueDepth", 64,
+    "Max queued (not yet running) queries per pool; submission beyond "
+    "it raises QueryRejectedError with a retry_after_ms backpressure "
+    "hint instead of queueing unboundedly.")
+
+SERVICE_DEFAULT_TIMEOUT_MS = int_conf(
+    "spark.rapids.service.defaultTimeoutMs", 0,
+    "Default per-query deadline from submission, milliseconds; expiry "
+    "times the query out while queued or cooperatively between batches "
+    "while running. 0 = no deadline; submit(timeout_ms=...) overrides "
+    "per query.")
+
+SERVICE_TENANT_WEIGHTS = str_conf(
+    "spark.rapids.service.tenantWeights", "",
+    "Per-tenant fair-share weights inside a pool: comma-separated "
+    "'tenant=W' entries; unlisted tenants weigh 1.0. A tenant with "
+    "weight 2 receives twice the service of a weight-1 tenant under "
+    "contention.")
+
+SERVICE_ADMISSION_MAX_DEVICE_BYTES = int_conf(
+    "spark.rapids.service.admission.maxDeviceBytes", 0,
+    "Memory-pressure admission gate: while the spill catalog reports "
+    "more device-resident spillable bytes than this, queued queries "
+    "hold instead of dispatching (a query is always released when "
+    "nothing is running, so the gate cannot deadlock). 0 disables.")
+
+SERVICE_RESULT_CACHE_ENABLED = bool_conf(
+    "spark.rapids.service.resultCache.enabled", True,
+    "Serve repeated queries from the plan-fingerprint result cache "
+    "(service/result_cache.py): structurally identical plans under "
+    "result-identical conf return the cached HostTable without "
+    "executing. Invalidated by catalog mutations and table writes.")
+
+SERVICE_RESULT_CACHE_MAX_BYTES = int_conf(
+    "spark.rapids.service.resultCache.maxBytes", 256 << 20,
+    "LRU byte bound on cached result tables (HostTable.nbytes sum); "
+    "results larger than this never cache.")
+
+
+def parse_pools(spec: str) -> "OrderedDict[str, float]":
+    """'name[:weight=W];...' -> {name: weight}. Raises on duplicates,
+    empty names, or non-positive weights (a typo'd pool spec must fail
+    service construction, not silently rebalance)."""
+    pools: "OrderedDict[str, float]" = OrderedDict()
+    for entry in (e.strip() for e in str(spec).split(";")):
+        if not entry:
+            continue
+        name, _, rest = entry.partition(":")
+        name = name.strip()
+        weight = 1.0
+        if rest:
+            key, _, val = rest.partition("=")
+            if key.strip() != "weight" or not val:
+                raise ColumnarProcessingError(
+                    f"bad pool spec entry {entry!r} (want "
+                    "'name[:weight=W]')")
+            try:
+                weight = float(val)
+            except ValueError:
+                raise ColumnarProcessingError(
+                    f"pool {name!r} weight {val!r} is not a number "
+                    "(spark.rapids.service.pools)")
+        if not name:
+            raise ColumnarProcessingError(
+                f"bad pool spec entry {entry!r}: empty pool name")
+        if name in pools:
+            raise ColumnarProcessingError(
+                f"duplicate pool {name!r} in spark.rapids.service.pools")
+        if weight <= 0:
+            raise ColumnarProcessingError(
+                f"pool {name!r} weight must be positive, got {weight}")
+        pools[name] = weight
+    if not pools:
+        raise ColumnarProcessingError(
+            "spark.rapids.service.pools defines no pools")
+    return pools
+
+
+def parse_tenant_weights(spec: str) -> Dict[str, float]:
+    """'tenant=W,tenant=W' -> {tenant: weight}; unlisted tenants 1.0."""
+    out: Dict[str, float] = {}
+    for entry in (e.strip() for e in str(spec).split(",")):
+        if not entry:
+            continue
+        name, sep, val = entry.partition("=")
+        if not sep or not name.strip():
+            raise ColumnarProcessingError(
+                f"bad tenant weight entry {entry!r} (want 'tenant=W')")
+        try:
+            w = float(val)
+        except ValueError:
+            raise ColumnarProcessingError(
+                f"tenant {name.strip()!r} weight {val!r} is not a "
+                "number (spark.rapids.service.tenantWeights)")
+        if w <= 0:
+            raise ColumnarProcessingError(
+                f"tenant {name.strip()!r} weight must be positive, got {w}")
+        out[name.strip()] = w
+    return out
+
+
+def _default_memory_probe() -> int:
+    from spark_rapids_tpu.runtime.spill import BufferCatalog
+    return BufferCatalog.get().device_bytes()
+
+
+class QueryService:
+    """Concurrent multi-tenant front end over one TpuSession.
+
+    >>> svc = QueryService({"spark.rapids.service.maxConcurrentQueries": 4})
+    >>> h = svc.submit(df, tenant="alice")
+    >>> table = h.result(timeout=60)
+
+    Accepts DataFrames, raw PlanNodes, or SQL text (lowered through the
+    shared session's catalog at submit time, so parse/analysis errors
+    surface to the submitter immediately)."""
+
+    #: how long an idle worker sleeps between deadline sweeps
+    _SWEEP_INTERVAL_S = 0.05
+
+    def __init__(self, conf=None, session=None,
+                 max_concurrent: Optional[int] = None,
+                 queue_depth: Optional[int] = None):
+        if session is None:
+            from spark_rapids_tpu.session import TpuSession
+            session = TpuSession(conf)
+        elif conf is not None:
+            raise ColumnarProcessingError(
+                "pass conf or a session, not both (the service reads "
+                "its knobs from the session's conf)")
+        self.session = session
+        self.conf: RapidsConf = session.conf
+        self.pools = parse_pools(self.conf.get_entry(SERVICE_POOLS))
+        self.tenant_weights = parse_tenant_weights(
+            self.conf.get_entry(SERVICE_TENANT_WEIGHTS))
+        self.max_concurrent = max(1, int(
+            max_concurrent if max_concurrent is not None
+            else self.conf.get_entry(SERVICE_MAX_CONCURRENT)))
+        self.queue_depth = max(1, int(
+            queue_depth if queue_depth is not None
+            else self.conf.get_entry(SERVICE_QUEUE_DEPTH)))
+        self.default_timeout_ms = int(
+            self.conf.get_entry(SERVICE_DEFAULT_TIMEOUT_MS))
+        self.admission_max_device_bytes = int(
+            self.conf.get_entry(SERVICE_ADMISSION_MAX_DEVICE_BYTES))
+        self.result_cache: Optional[ResultCache] = None
+        if bool(self.conf.get_entry(SERVICE_RESULT_CACHE_ENABLED)):
+            self.result_cache = ResultCache(
+                int(self.conf.get_entry(SERVICE_RESULT_CACHE_MAX_BYTES)))
+        #: injectable for tests; production consults the spill catalog
+        self._memory_probe = _default_memory_probe
+
+        self._cond = threading.Condition()
+        #: (pool, tenant) -> FIFO of queued handles
+        self._queues: Dict[Tuple[str, str], deque] = {}
+        #: per-pool queued-handle count (admission bound)
+        self._queued_per_pool: Dict[str, int] = {p: 0 for p in self.pools}
+        #: WFQ virtual clocks: seconds of service / weight
+        self._tenant_clock: Dict[Tuple[str, str], float] = {}
+        self._pool_clock: Dict[str, float] = {p: 0.0 for p in self.pools}
+        self._running = 0
+        self._held_for_memory = 0
+        self._memory_gate_was_open = True
+        self._shutdown = False
+        self._recent_run_s: deque = deque(maxlen=32)
+        self.counters = {"submitted": 0, "finished": 0, "failed": 0,
+                         "cancelled": 0, "timed_out": 0, "rejected": 0}
+
+        self._workers: List[threading.Thread] = []
+        for i in range(self.max_concurrent):
+            t = threading.Thread(target=self._worker_loop,
+                                 name=f"rapids-svc-worker-{i}",
+                                 daemon=True)
+            t.start()
+            self._workers.append(t)
+        # dedicated deadline sweeper: idle workers sweep too, but when
+        # EVERY worker is busy a queued query's deadline must still
+        # expire on time (the backpressure signal is useless late)
+        self._sweeper = threading.Thread(target=self._sweeper_loop,
+                                         name="rapids-svc-sweeper",
+                                         daemon=True)
+        self._sweeper.start()
+
+    # -- submission ----------------------------------------------------------
+    def submit(self, query, *, tenant: str = "default",
+               pool: Optional[str] = None,
+               timeout_ms: Optional[int] = None,
+               tag: Optional[str] = None) -> QueryHandle:
+        """Admit one query. ``query`` is a DataFrame, a PlanNode, or SQL
+        text. Raises QueryRejectedError when the pool queue is full."""
+        pool = pool if pool is not None else next(iter(self.pools))
+        if pool not in self.pools:
+            raise ColumnarProcessingError(
+                f"unknown scheduling pool {pool!r} "
+                f"(configured: {', '.join(self.pools)})")
+        plan, sql_text = self._resolve(query)
+        if timeout_ms is None:
+            timeout_ms = self.default_timeout_ms
+        deadline = (time.monotonic() + timeout_ms / 1000.0
+                    if timeout_ms and timeout_ms > 0 else None)
+        handle = QueryHandle(tenant=tenant, pool=pool, tag=tag,
+                             sql_text=sql_text, plan=plan,
+                             deadline=deadline)
+        handle._service = self
+        with self._cond:
+            if self._shutdown:
+                raise ColumnarProcessingError(
+                    "query service is shut down")
+            if self._queued_per_pool[pool] >= self.queue_depth:
+                self.counters["rejected"] += 1
+                raise QueryRejectedError(
+                    f"pool {pool!r} queue is full "
+                    f"({self.queue_depth} queued); retry later",
+                    retry_after_ms=self._retry_after_ms_locked(pool))
+            self._activate_locked(pool, tenant)
+            self._queues.setdefault((pool, tenant),
+                                    deque()).append(handle)
+            self._queued_per_pool[pool] += 1
+            self.counters["submitted"] += 1
+            # notify_all: the deadline sweeper shares the condition, so
+            # a single notify could wake it instead of a worker
+            self._cond.notify_all()
+        return handle
+
+    def _resolve(self, query):
+        from spark_rapids_tpu.plan import DataFrame
+        from spark_rapids_tpu.plan.nodes import PlanNode
+        if isinstance(query, str):
+            df = self.session.sql(query)
+            return df.plan, query
+        if isinstance(query, DataFrame):
+            return query.plan, getattr(query, "sql_text", None)
+        if isinstance(query, PlanNode):
+            return query, None
+        raise TypeError(
+            f"cannot submit {type(query).__name__}; want DataFrame, "
+            "PlanNode, or SQL text")
+
+    def _retry_after_ms_locked(self, pool: str) -> int:
+        mean_run = (sum(self._recent_run_s) / len(self._recent_run_s)
+                    if self._recent_run_s else 0.1)
+        backlog = self._queued_per_pool[pool] + self._running
+        est = mean_run * backlog / max(self.max_concurrent, 1)
+        return max(50, int(est * 1000))
+
+    #: tenant-clock entries kept for idle tenants before pruning — the
+    #: re-activation lift makes pruning fairness-neutral, so this only
+    #: bounds memory/scan cost under ephemeral per-user tenant ids
+    _MAX_IDLE_CLOCKS = 4096
+
+    def _activate_locked(self, pool: str, tenant: str) -> None:
+        """A tenant (re)gaining queued work joins the fair-share race at
+        no less than the pool's ACTIVE minimum clock: idle time must not
+        bank credit a returning burst could spend monopolizing workers
+        (standard WFQ virtual-time lift). Pools likewise."""
+        key = (pool, tenant)
+        busy = [c for (p, t), c in self._tenant_clock.items()
+                if p == pool and (p, t) != key
+                and self._queues.get((p, t))]
+        cur = self._tenant_clock.get(key, 0.0)
+        self._tenant_clock[key] = max(cur, min(busy)) if busy else cur
+        busy_pools = [self._pool_clock[p] for p in self.pools
+                      if p != pool and self._queued_per_pool.get(p)]
+        if busy_pools and not self._queued_per_pool.get(pool):
+            self._pool_clock[pool] = max(self._pool_clock[pool],
+                                         min(busy_pools))
+        if len(self._tenant_clock) > self._MAX_IDLE_CLOCKS:
+            # ephemeral tenant ids: drop idle entries (no queued work);
+            # if they return, the lift above restores a fair position
+            for k in [k for k in self._tenant_clock
+                      if k != key and not self._queues.get(k)]:
+                del self._tenant_clock[k]
+
+    def _drop_if_empty_locked(self, key) -> None:
+        """Empty per-tenant deques are DELETED so the sweep and pick
+        scans stay proportional to tenants with pending work, not to
+        every tenant ever seen."""
+        dq = self._queues.get(key)
+        if dq is not None and not dq:
+            del self._queues[key]
+
+    # -- scheduling ----------------------------------------------------------
+    def _remove_queued(self, handle: QueryHandle) -> bool:
+        """Pull a still-queued handle out (cancel path). True when the
+        handle was queued and is now removed."""
+        with self._cond:
+            key = (handle.pool, handle.tenant)
+            dq = self._queues.get(key)
+            if dq is not None:
+                try:
+                    dq.remove(handle)
+                except ValueError:
+                    return False
+                self._queued_per_pool[handle.pool] -= 1
+                self._drop_if_empty_locked(key)
+                return True
+            return False
+
+    def _sweep_expired_locked(self) -> None:
+        """Time out / cancel queued handles whose deadline passed or
+        whose cancel flag is set, without running them."""
+        for (pool, _tenant), dq in list(self._queues.items()):
+            kept = [h for h in dq]
+            for h in kept:
+                if h.scope.cancelled.is_set():
+                    dq.remove(h)
+                    self._queued_per_pool[pool] -= 1
+                    if h._transition(QueryState.CANCELLED,
+                                     error=QueryCancelledError(
+                                         "cancelled while queued")):
+                        self.counters["cancelled"] += 1
+                elif h.scope.expired():
+                    dq.remove(h)
+                    self._queued_per_pool[pool] -= 1
+                    if h._transition(QueryState.TIMED_OUT,
+                                     error=QueryTimeoutError(
+                                         "deadline expired while "
+                                         "queued")):
+                        self.counters["timed_out"] += 1
+            self._drop_if_empty_locked((pool, _tenant))
+
+    def _memory_gate_open_locked(self) -> bool:
+        """The spill-catalog admission gate: admit when under the high
+        water mark, or when nothing is running (forward progress)."""
+        limit = self.admission_max_device_bytes
+        if limit <= 0 or self._running == 0:
+            return True
+        try:
+            used = int(self._memory_probe())
+        except Exception:
+            return True  # a broken probe must not wedge the service
+        return used <= limit
+
+    def _pick_locked(self) -> Optional[QueryHandle]:
+        """WFQ pop: least-charged pool, then least-charged tenant
+        within it, FIFO within the tenant. The virtual clocks are
+        ALREADY weight-normalized (_charge_locked adds elapsed/weight),
+        so the pick compares them raw — dividing again here would give
+        a weight-W party a W^2 share."""
+        candidates = [(p, t, dq) for (p, t), dq in self._queues.items()
+                      if dq]
+        if not candidates:
+            return None
+        if not self._memory_gate_open_locked():
+            if self._memory_gate_was_open:
+                # count held ADMISSION EPISODES, not poll wakeups
+                self._held_for_memory += 1
+                self._memory_gate_was_open = False
+            return None
+        self._memory_gate_was_open = True
+        best = min(
+            candidates,
+            key=lambda c: (
+                self._pool_clock[c[0]],
+                self._tenant_clock[(c[0], c[1])],
+                c[2][0].query_id,
+            ))
+        pool, tenant, dq = best
+        handle = dq.popleft()
+        self._queued_per_pool[pool] -= 1
+        self._drop_if_empty_locked((pool, tenant))
+        return handle
+
+    def _count_event(self, name: str, n: int = 1) -> None:
+        """All lifecycle counter bumps funnel here: counters are read
+        under the condition lock (stats, retry-after), so every writer
+        must hold it too or concurrent workers lose increments."""
+        with self._cond:
+            self.counters[name] += n
+
+    def _charge_locked(self, handle: QueryHandle, elapsed_s: float):
+        w_t = self.tenant_weights.get(handle.tenant, 1.0)
+        key = (handle.pool, handle.tenant)
+        self._pool_clock[handle.pool] += elapsed_s / self.pools[handle.pool]
+        # .get: the idle-clock prune may have dropped the entry while
+        # this query ran (the tenant had nothing else queued)
+        self._tenant_clock[key] = (self._tenant_clock.get(key, 0.0)
+                                   + elapsed_s / w_t)
+        self._recent_run_s.append(max(elapsed_s, 1e-4))
+
+    # -- workers -------------------------------------------------------------
+    def _sweeper_loop(self):
+        while True:
+            with self._cond:
+                if self._shutdown:
+                    return
+                self._sweep_expired_locked()
+                self._cond.wait(timeout=self._SWEEP_INTERVAL_S)
+
+    def _worker_loop(self):
+        while True:
+            with self._cond:
+                handle = None
+                while handle is None:
+                    if self._shutdown:
+                        return
+                    self._sweep_expired_locked()
+                    handle = self._pick_locked()
+                    if handle is None:
+                        self._cond.wait(timeout=self._SWEEP_INTERVAL_S)
+                if not handle._transition(QueryState.ADMITTED):
+                    continue  # terminal while queued; take another
+                self._running += 1
+            try:
+                self._run(handle)
+            finally:
+                with self._cond:
+                    self._running -= 1
+                    self._cond.notify_all()
+
+    def _run(self, handle: QueryHandle):
+        if not handle._transition(QueryState.RUNNING):
+            return
+        t0 = time.monotonic()
+        try:
+            # a cancel/deadline that raced the pop must win BEFORE any
+            # serve — a cache hit is still a completion the caller was
+            # told would not happen
+            handle.scope.check()
+            # epoch BEFORE execution: a write landing while this query
+            # runs must stale the entry we fill, not be masked by it
+            epoch = invalidation_epoch()
+            fp = (fingerprint(handle.plan, self.conf)
+                  if self.result_cache is not None else None)
+            cached = (self.result_cache.get(fp)
+                      if self.result_cache is not None else None)
+            if cached is not None:
+                handle.cache_hit = True
+                self._emit_cache_hit_record(
+                    handle, cached, time.monotonic() - t0)
+                if handle._transition(QueryState.FINISHED,
+                                      result=cached.table):
+                    self._count_event("finished")
+                return
+            with cancel_scope(handle.scope):
+                self.session.next_query_tag = handle.tag
+                if handle.sql_text:
+                    self.session.next_query_sql = handle.sql_text
+                self.session.next_query_service = {
+                    "tenant": handle.tenant,
+                    "pool": handle.pool,
+                    "queueWaitS": round(handle.queue_wait_s or 0.0, 6),
+                    "cacheHit": False,
+                }
+                table = self.session.execute(handle.plan)
+            # raw thread-local read: THIS query's record or None, never
+            # the session-wide mirror of some other worker's query
+            handle.event_record = self.session._q.event_record
+            if self.result_cache is not None:
+                self.result_cache.put(fp, table, handle.event_record,
+                                      epoch=epoch)
+            if handle._transition(QueryState.FINISHED, result=table):
+                self._count_event("finished")
+        except QueryCancelledError as exc:
+            if handle._transition(QueryState.CANCELLED, error=exc):
+                self._count_event("cancelled")
+        except QueryTimeoutError as exc:
+            if handle._transition(QueryState.TIMED_OUT, error=exc):
+                self._count_event("timed_out")
+        except BaseException as exc:
+            if handle._transition(QueryState.FAILED, error=exc):
+                self._count_event("failed")
+        finally:
+            with self._cond:
+                self._charge_locked(handle, time.monotonic() - t0)
+
+    def _emit_cache_hit_record(self, handle: QueryHandle, entry,
+                               serve_s: float) -> None:
+        """A cache hit still shows up in the query event log: the
+        filling run's record replays with hit attribution (tenant,
+        pool, queue wait, cacheHit=true, serve wall time) so offline
+        tools see served traffic, not just executed traffic."""
+        from spark_rapids_tpu.obs import events as E
+        if entry.event_record is None or not bool(
+                self.conf.get_entry(E.EVENT_LOG_ENABLED)):
+            return
+        s = self.session
+        with s._obs_lock:
+            idx = s._obs_query_seq
+            s._obs_query_seq += 1
+        rec = dict(entry.event_record)
+        rec.update({
+            "queryIndex": idx,
+            "queryTag": handle.tag,
+            "wallS": round(serve_s, 6),
+            "tenant": handle.tenant,
+            "pool": handle.pool,
+            "queueWaitS": round(handle.queue_wait_s or 0.0, 6),
+            "cacheHit": True,
+        })
+        handle.event_record = rec
+        try:
+            s._write_event_record(rec)
+        except OSError as exc:  # best-effort, like the session's writer
+            print(f"spark_rapids_tpu: cache-hit event emission failed: "
+                  f"{exc}")
+
+    # -- lifecycle -----------------------------------------------------------
+    def shutdown(self, wait: bool = True) -> None:
+        """Stop accepting and stop workers after their current query.
+        Still-queued handles are CANCELLED so their waiters unblock."""
+        with self._cond:
+            self._shutdown = True
+            for (pool, _t), dq in self._queues.items():
+                while dq:
+                    h = dq.popleft()
+                    self._queued_per_pool[pool] -= 1
+                    if h._transition(QueryState.CANCELLED,
+                                     error=QueryCancelledError(
+                                         "service shut down")):
+                        self.counters["cancelled"] += 1
+            self._cond.notify_all()
+        if wait:
+            for t in self._workers:
+                t.join(timeout=30)
+            self._sweeper.join(timeout=5)
+
+    def __enter__(self) -> "QueryService":
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()
+        return False
+
+    # -- introspection -------------------------------------------------------
+    def stats(self) -> dict:
+        with self._cond:
+            out = {
+                **self.counters,
+                "running": self._running,
+                "queued": {p: n for p, n in self._queued_per_pool.items()
+                           if n},
+                "heldForMemory": self._held_for_memory,
+                "poolClocks": {p: round(c, 6)
+                               for p, c in self._pool_clock.items()},
+                "tenantClocks": {f"{p}/{t}": round(c, 6)
+                                 for (p, t), c in
+                                 self._tenant_clock.items()},
+            }
+        if self.result_cache is not None:
+            out["resultCache"] = self.result_cache.stats()
+        return out

@@ -5,6 +5,7 @@ the overrides engine."""
 
 from __future__ import annotations
 
+import threading
 from typing import Dict, Optional
 
 from spark_rapids_tpu.columnar import HostTable
@@ -12,6 +13,58 @@ from spark_rapids_tpu.conf import RapidsConf
 from spark_rapids_tpu.overrides import apply_overrides, explain_plan
 from spark_rapids_tpu.plan import DataFrame, from_host_table
 from spark_rapids_tpu.plan import nodes as P
+
+
+class _TLQueryState:
+    """Per-(session, thread) in-flight query state. A session may run
+    queries CONCURRENTLY from query-service worker threads; everything a
+    single execute() writes while running (depth, phases, the executed
+    tree, the next-query attribution fields harnesses set) must be
+    thread-local or two in-flight queries corrupt each other's
+    envelope. ``last_*`` reads fall back to the session-wide mirror so
+    serial callers on another thread still see the most recent query."""
+
+    __slots__ = ("exec_depth", "next_tag", "next_sql", "next_service",
+                 "meta", "phases", "executable", "dispatches",
+                 "fault_replays", "event_record", "event_path")
+
+    def __init__(self):
+        self.exec_depth = 0
+        self.next_tag = None
+        self.next_sql = None
+        self.next_service = None
+        self.meta = None
+        self.phases = None
+        self.executable = None
+        self.dispatches = None
+        self.fault_replays = None
+        self.event_record = None
+        self.event_path = None
+
+
+def _tl_mirrored(tls_field: str, doc: str):
+    """Property: read this thread's value, else the session-wide mirror
+    of the last completed query; writes update both."""
+
+    def _get(self):
+        v = getattr(self._q, tls_field)
+        return v if v is not None else self._mirror.get(tls_field)
+
+    def _set(self, value):
+        setattr(self._q, tls_field, value)
+        self._mirror[tls_field] = value
+
+    return property(_get, _set, doc=doc)
+
+
+def _tl_only(tls_field: str, doc: str):
+    def _get(self):
+        return getattr(self._q, tls_field)
+
+    def _set(self, value):
+        setattr(self._q, tls_field, value)
+
+    return property(_get, _set, doc=doc)
 
 
 def _uses_device(executable) -> bool:
@@ -29,6 +82,30 @@ def _uses_device(executable) -> bool:
 
 
 class TpuSession:
+    # -- per-thread query state (concurrent executes; see _TLQueryState) --
+    next_query_tag = _tl_only(
+        "next_tag", "query tag the NEXT execute() on this thread records")
+    next_query_sql = _tl_only(
+        "next_sql", "SQL text the NEXT execute() on this thread records")
+    next_query_service = _tl_only(
+        "next_service", "service envelope (tenant/pool/queue-wait/"
+        "cache-hit) the NEXT execute() on this thread records")
+    _exec_depth = _tl_only(
+        "exec_depth", "nested-execute depth on this thread")
+    _last_meta = _tl_only("meta", "overrides meta of this thread's query")
+    _last_phases = _tl_only("phases", "phase times of this thread's query")
+    _last_executable = _tl_mirrored(
+        "executable", "executed tree of the last query (thread, then "
+        "session-wide)")
+    last_dispatches = _tl_mirrored(
+        "dispatches", "device dispatches of the last query")
+    last_fault_replays = _tl_mirrored(
+        "fault_replays", "circuit-breaker replays of the last query")
+    last_event_record = _tl_mirrored(
+        "event_record", "event-log record of the last query")
+    last_event_path = _tl_mirrored(
+        "event_path", "event-log path of the last query")
+
     def __init__(self, conf: Optional[Dict] = None):
         self.conf = RapidsConf(conf)
         self._runtime = None
@@ -37,14 +114,21 @@ class TpuSession:
         # observability state (obs/): per-session query sequence, the
         # lazy event-log writer, and the caller-settable attribution
         # fields the next execute() consumes (harnesses tag queries so
-        # the offline tools can match runs per query)
-        self._exec_depth = 0
+        # the offline tools can match runs per query). In-flight query
+        # state is per-thread (_TLQueryState); _mirror keeps the
+        # last-completed-query view for readers on other threads.
+        self._tls = threading.local()
+        self._mirror: Dict[str, object] = {}
+        self._obs_lock = threading.Lock()
         self._obs_query_seq = 0
         self._event_writer = None
-        self.next_query_tag: Optional[str] = None
-        self.next_query_sql: Optional[str] = None
-        self.last_event_path: Optional[str] = None
-        self.last_event_record: Optional[dict] = None
+
+    @property
+    def _q(self) -> _TLQueryState:
+        q = getattr(self._tls, "q", None)
+        if q is None:
+            q = self._tls.q = _TLQueryState()
+        return q
 
     # -- SQL front end -------------------------------------------------------
     @property
@@ -167,7 +251,10 @@ class TpuSession:
         enabled, spans collect for the duration and a structured record
         (obs/events.py) is written on success. Nested executes
         (cached-relation / broadcast materialization inside an outer
-        query) ride the outer envelope."""
+        query) ride the outer envelope. Safe to call concurrently from
+        multiple threads (the query service's worker pool): in-flight
+        state is thread-local and the span tracer scopes each query to
+        its executing thread."""
         import time as _time
 
         from spark_rapids_tpu.obs import events as E
@@ -177,33 +264,44 @@ class TpuSession:
             TRACER,
         )
 
-        query_tag = self.next_query_tag
-        sql_text = self.next_query_sql
-        self.next_query_tag = None
-        self.next_query_sql = None
+        q = self._q
+        query_tag, q.next_tag = q.next_tag, None
+        sql_text, q.next_sql = q.next_sql, None
+        service_info, q.next_service = q.next_service, None
 
-        if self._exec_depth:
+        if q.exec_depth:
             # nested query: no separate envelope, no index
-            self._exec_depth += 1
+            q.exec_depth += 1
             try:
                 return self._execute_with_recovery(plan)
             finally:
-                self._exec_depth -= 1
+                q.exec_depth -= 1
 
         ev_enabled = bool(self.conf.get_entry(E.EVENT_LOG_ENABLED))
         tr_enabled = bool(self.conf.get_entry(TRACE_ENABLED))
         obs_active = ev_enabled or tr_enabled
-        qidx = self._obs_query_seq
-        self._obs_query_seq += 1
+        # this thread's view while THIS query is in flight: no record
+        # yet (readers fall back to the session-wide mirror of the last
+        # completed query)
+        q.event_record = None
+        q.event_path = None
+        with self._obs_lock:
+            qidx = self._obs_query_seq
+            self._obs_query_seq += 1
         if obs_active:
             from spark_rapids_tpu.obs.metrics import scopes_snapshot
             from spark_rapids_tpu.runtime.faults import FAULTS, RECOVERY
             before_scopes = scopes_snapshot()
             before_recovery = RECOVERY.snapshot()
             before_fires = FAULTS.counters()
-            TRACER.begin_query(qidx)
-            main_tid = TRACER.main_tid
-        self._exec_depth = 1
+            ctx = TRACER.begin_query(qidx)
+        else:
+            # no envelope for THIS query, but another session's
+            # observed query may be live on a worker thread: block the
+            # tracer's helper-thread adoption so this query's spans
+            # can't pollute that query's record
+            TRACER.begin_unobserved_query()
+        q.exec_depth = 1
         t0 = _time.perf_counter()
         try:
             result = self._execute_with_recovery(plan)
@@ -212,7 +310,13 @@ class TpuSession:
                 TRACER.end_query()
             raise
         finally:
-            self._exec_depth = 0
+            q.exec_depth = 0
+            if not obs_active:
+                TRACER.end_unobserved_query()
+            # success OR failure: a WriteFiles plan that failed
+            # mid-drain may still have changed on-disk contents, so
+            # cached results over its paths are stale either way
+            self._invalidate_result_cache_on_write(plan)
         if not obs_active:
             return result
         wall_s = _time.perf_counter() - t0
@@ -229,7 +333,7 @@ class TpuSession:
             FAULTS,
             RECOVERY,
         )
-        executable = getattr(self, "_last_executable", None)
+        executable = q.executable
         if executable is not None:
             finalize_observation(executable)
         after_recovery = RECOVERY.snapshot()
@@ -237,12 +341,12 @@ class TpuSession:
         record = E.build_query_record(
             query_index=qidx,
             wall_s=wall_s,
-            phases=getattr(self, "_last_phases", {}) or {},
+            phases=q.phases or {},
             executable=executable,
-            meta=getattr(self, "_last_meta", None),
+            meta=q.meta,
             sql_text=sql_text,
             query_tag=query_tag,
-            dispatches=int(getattr(self, "last_dispatches", 0) or 0),
+            dispatches=int(q.dispatches or 0),
             recovery_delta={k: v - before_recovery.get(k, 0)
                             for k, v in after_recovery.items()
                             if v - before_recovery.get(k, 0)},
@@ -251,18 +355,16 @@ class TpuSession:
                          for k, v in after_fires.items()
                          if v - before_fires.get(k, 0)},
             demotions=CIRCUIT_BREAKER.demoted_ops(),
-            spans_summary=summarize_spans(spans, main_tid, wall_s),
-            fault_replays=int(getattr(self, "last_fault_replays", 0)),
+            spans_summary=summarize_spans(spans, ctx.owner_tid, wall_s),
+            fault_replays=int(q.fault_replays or 0),
+            service=service_info,
         )
         self.last_event_record = record
         # emission is best-effort: an unwritable log dir or full disk
         # must not fail a query that already computed its result
         try:
             if ev_enabled:
-                if self._event_writer is None:
-                    self._event_writer = E.QueryEventWriter(
-                        str(self.conf.get_entry(E.EVENT_LOG_DIR)))
-                self.last_event_path = self._event_writer.write(record)
+                self._write_event_record(record)
             if tr_enabled:
                 import os
                 trace_dir = str(self.conf.get_entry(TRACE_DIR))
@@ -274,6 +376,37 @@ class TpuSession:
             print(f"spark_rapids_tpu: event/trace emission failed "
                   f"(query {qidx}): {exc}")
         return result
+
+    def _write_event_record(self, record: dict) -> str:
+        """THE event-log append path — lazily creates the per-session
+        writer under the obs lock. Used by execute() and by the query
+        service's cache-hit record emission, so writer setup can never
+        diverge between executed and served queries. Raises OSError on
+        emission failure; callers treat it as best-effort."""
+        from spark_rapids_tpu.obs import events as E
+        with self._obs_lock:
+            if self._event_writer is None:
+                self._event_writer = E.QueryEventWriter(
+                    str(self.conf.get_entry(E.EVENT_LOG_DIR)))
+        path = self._event_writer.write(record)
+        self.last_event_path = path
+        return path
+
+    def _invalidate_result_cache_on_write(self, plan: P.PlanNode) -> None:
+        """A completed write (WriteFiles / Delta / Iceberg commands ride
+        plans or commit through delta.log, which bumps the epoch itself)
+        invalidates every cached service result — contents under the
+        written paths changed."""
+        stack = [plan]
+        while stack:
+            node = stack.pop()
+            if isinstance(node, P.WriteFiles):
+                from spark_rapids_tpu.service.result_cache import (
+                    bump_invalidation_epoch,
+                )
+                bump_invalidation_epoch("WriteFiles")
+                return
+            stack.extend(getattr(node, "children", ()))
 
     def _execute_with_recovery(self, plan: P.PlanNode) -> HostTable:
         """Plan, verify, and drain a query — wrapped in the runtime
@@ -402,6 +535,17 @@ class TpuSession:
         # every device exec (obs/spans.py)
         from spark_rapids_tpu.obs.spans import install_observation
         install_observation(executable)
+        # cancellation boundaries OUTERMOST (third wrapper in the
+        # install_fault_boundaries family): when this query runs under a
+        # service cancel scope, handle.cancel() / deadline expiry raise
+        # between batches at every exec boundary (service/query.py)
+        from spark_rapids_tpu.service.query import (
+            current_cancel_scope,
+            install_cancellation,
+        )
+        scope = current_cancel_scope()
+        if scope is not None:
+            install_cancellation(executable, scope)
         self._last_executable = executable
         TRACER.end(plan_span)
         phases = {"planS": _time.perf_counter() - t_phase}

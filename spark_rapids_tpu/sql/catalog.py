@@ -15,6 +15,15 @@ from typing import Callable, Dict, List, Optional
 from spark_rapids_tpu.errors import ColumnarProcessingError
 
 
+def _invalidate_results(reason: str) -> None:
+    """Catalog mutation: cached service results may now resolve names
+    to different relations — drop them (service/result_cache.py)."""
+    from spark_rapids_tpu.service.result_cache import (
+        bump_invalidation_epoch,
+    )
+    bump_invalidation_epoch(reason)
+
+
 class SessionCatalog:
     def __init__(self, session):
         self._session = session
@@ -33,9 +42,13 @@ class SessionCatalog:
         # the old relation would survive a later DROP of the new one
         self._tables.pop(name.lower(), None)
         self._views[name.lower()] = plan
+        _invalidate_results(f"temp view {name!r} (re)defined")
 
     def drop_temp_view(self, name: str) -> bool:
-        return self._views.pop(name.lower(), None) is not None
+        dropped = self._views.pop(name.lower(), None) is not None
+        if dropped:
+            _invalidate_results(f"temp view {name!r} dropped")
+        return dropped
 
     # -- file-format tables (sources SPI) -----------------------------------
     def register_table(self, name: str, fmt: str, *paths,
@@ -44,9 +57,13 @@ class SessionCatalog:
         external-source provider registry (ExternalSource analog)."""
         self._views.pop(name.lower(), None)
         self._tables[name.lower()] = (fmt, list(paths), dict(options))
+        _invalidate_results(f"table {name!r} registered")
 
     def drop_table(self, name: str) -> bool:
-        return self._tables.pop(name.lower(), None) is not None
+        dropped = self._tables.pop(name.lower(), None) is not None
+        if dropped:
+            _invalidate_results(f"table {name!r} dropped")
+        return dropped
 
     def list_tables(self) -> List[str]:
         return sorted(set(self._views) | set(self._tables))

@@ -7,6 +7,10 @@ Subcommands:
   shuffle/spill breakdown, per-exchange summary, fallback inventory,
   span attribution with the untracked remainder.
 * ``compare <A> <B>`` — per-query/per-operator diff of two runs.
+* ``loadtest`` — TPC-H corpus through the concurrent QueryService
+  across simulated tenants; reports throughput, p50/p95 latency, queue
+  wait and result-cache hit rate vs the serial baseline, asserting
+  bit-identical results (exit 1 on any divergence).
 
 ``--json`` emits the raw report dict for machines; exit status 2 when a
 profile's span coverage falls below ``--coverage-floor`` (default 0.95)
@@ -45,7 +49,48 @@ def main(argv=None) -> int:
     c.add_argument("--top", type=int, default=5,
                    help="op diffs to show per query (default 5)")
 
+    lt = sub.add_parser(
+        "loadtest",
+        help="concurrent multi-tenant corpus run through the "
+             "QueryService, verified bit-identical to serial")
+    lt.add_argument("--sf", type=float, default=0.05,
+                    help="datagen scale factor (default 0.05)")
+    lt.add_argument("--seed", type=int, default=0)
+    lt.add_argument("--queries", type=str, default="",
+                    help="comma-separated subset (default q1-q22)")
+    lt.add_argument("--concurrency", type=int, default=4,
+                    help="service worker threads (default 4)")
+    lt.add_argument("--tenants", type=int, default=2,
+                    help="simulated tenants, each submitting every "
+                         "query (default 2)")
+    lt.add_argument("--sql", action="store_true",
+                    help="submit the SQL-text forms instead of DSL")
+    lt.add_argument("--eventlog-dir", type=str, default="",
+                    help="also write per-query event logs here")
+    lt.add_argument("--json", action="store_true",
+                    help="emit the raw report JSON")
+    lt.add_argument("--out", type=str, default="",
+                    help="write the report JSON to this file")
+
     args = ap.parse_args(argv)
+
+    if args.cmd == "loadtest":
+        from spark_rapids_tpu.tools.loadtest import (
+            render_loadtest,
+            run_loadtest,
+        )
+        wanted = [q.strip() for q in args.queries.split(",") if q.strip()]
+        report = run_loadtest(
+            sf=args.sf, seed=args.seed, queries=wanted or None,
+            use_sql=args.sql, concurrency=args.concurrency,
+            tenants=args.tenants,
+            eventlog_dir=args.eventlog_dir or None)
+        print(json.dumps(report) if args.json
+              else render_loadtest(report))
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(report, f, indent=1)
+        return 0 if report["ok"] else 1
 
     if args.cmd == "profile":
         from spark_rapids_tpu.tools.report import (

@@ -48,6 +48,8 @@ def _index(records: List[dict]) -> Dict[str, List[dict]]:
     run-to-run variance into phantom regressions)."""
     out: Dict[str, List[dict]] = {}
     for r in records:
+        if r.get("cacheHit"):
+            continue  # replayed metrics + ~0 wall would skew medians
         out.setdefault(query_label(r), []).append(r)
     return out
 

@@ -1,0 +1,188 @@
+"""Load driver: the TPC-H corpus through the concurrent query service.
+
+``python -m spark_rapids_tpu.tools loadtest`` (and
+``scale_test.py --concurrency N``) fire q1-q22 across simulated tenants
+at a configured worker concurrency and report the serving story the
+serial harnesses cannot: aggregate wall clock vs the serial sum,
+p50/p95 submit-to-finish latency, queue wait, and result-cache hit
+rate — while asserting every concurrent result BIT-IDENTICAL to its
+fault-free serial execution (the correctness bar every other harness in
+this repo holds).
+
+Workload shape: every tenant submits every selected query, so with T
+tenants the service sees T x Q submissions. The serial comparator
+models exactly what a one-at-a-time server would do with the same
+T x Q request stream: the FIRST submission of each query pays the cold
+(compile-inclusive) wall, the remaining T-1 pay the warm wall —
+serialSumS = sum(cold) + (T-1) * sum(warm). The concurrent side pays
+the same per-query compiles (on misses), so the speedup and the
+below-serial-sum acceptance gate compare like for like; both
+components are reported separately.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+
+def _percentile(values: List[float], q: float) -> float:
+    """Nearest-rank percentile (q in [0, 1]) of a non-empty list."""
+    vals = sorted(values)
+    idx = min(len(vals) - 1, max(0, int(round(q * (len(vals) - 1)))))
+    return vals[idx]
+
+
+def run_loadtest(sf: float = 0.05, seed: int = 0, queries=None,
+                 use_sql: bool = False, concurrency: int = 4,
+                 tenants: int = 2, eventlog_dir: Optional[str] = None,
+                 timeout_s: float = 600.0) -> dict:
+    """Run the loadtest and return the JSON-ready report dict.
+    ``report["ok"]`` is False when any result diverged from serial or
+    any submission failed — callers exit non-zero on it."""
+    from spark_rapids_tpu.lint.golden import _load_scale_test
+    from spark_rapids_tpu.datagen import scale_test_specs
+    from spark_rapids_tpu.service import QueryService
+    from spark_rapids_tpu.session import TpuSession
+
+    st = _load_scale_test()
+    specs = scale_test_specs(sf)
+    tables = {name: spec.generate_table(sf, seed=seed)
+              for name, spec in specs.items()}
+
+    def _conf(extra=None):
+        conf = dict(extra or {})
+        if eventlog_dir:
+            conf["spark.rapids.sql.eventLog.enabled"] = "true"
+            conf["spark.rapids.sql.eventLog.dir"] = eventlog_dir
+        return conf
+
+    build = st.build_sql_queries if use_sql else st.build_queries
+
+    # -- serial baseline: cold once + warm for the repeat submissions -------
+    serial_session = TpuSession(_conf())
+    serial_queries = build(serial_session, tables)
+    wanted = [q for q in (queries or list(serial_queries))]
+    expected: Dict[str, object] = {}
+    serial_cold: Dict[str, float] = {}
+    serial_warm: Dict[str, float] = {}
+    for name in wanted:
+        serial_session.next_query_tag = f"{name}_serial_cold"
+        t0 = time.perf_counter()
+        expected[name] = serial_queries[name]().collect_table()
+        serial_cold[name] = time.perf_counter() - t0
+        serial_session.next_query_tag = f"{name}_serial"
+        t0 = time.perf_counter()
+        serial_queries[name]().collect_table()
+        serial_warm[name] = time.perf_counter() - t0
+    serial_sum = (sum(serial_cold.values())
+                  + (tenants - 1) * sum(serial_warm.values()))
+
+    # -- concurrent run through the service ---------------------------------
+    n_submissions = len(wanted) * tenants
+    svc = QueryService(
+        _conf({
+            "spark.rapids.service.maxConcurrentQueries": str(concurrency),
+            "spark.rapids.service.queueDepth": str(max(n_submissions, 64)),
+        }))
+    svc_queries = build(svc.session, tables)
+    mismatches: List[str] = []
+    failures: List[str] = []
+    handles = []
+    t0 = time.perf_counter()
+    with svc:
+        for t in range(tenants):
+            for name in wanted:
+                handles.append((name, f"tenant{t}", svc.submit(
+                    svc_queries[name](), tenant=f"tenant{t}",
+                    tag=f"{name}@tenant{t}")))
+        for name, tenant, h in handles:
+            if not h.wait(timeout=timeout_s):
+                failures.append(
+                    f"{name}@{tenant}: still {h.state} after "
+                    f"{timeout_s}s")
+    wall = time.perf_counter() - t0
+
+    latencies, queue_waits, per_query = [], [], {}
+    cache_hits = 0
+    for name, tenant, h in handles:
+        if h.state != "FINISHED":
+            failures.append(f"{name}@{tenant}: {h.state} ({h.error})")
+            continue
+        diff = st.tables_differ(expected[name], h.result_table)
+        if diff is not None:
+            mismatches.append(f"{name}@{tenant}: {diff}")
+        latencies.append(h.latency_s)
+        queue_waits.append(h.queue_wait_s or 0.0)
+        cache_hits += 1 if h.cache_hit else 0
+        entry = per_query.setdefault(name, {
+            "serialColdS": round(serial_cold[name], 4),
+            "serialWarmS": round(serial_warm[name], 4), "runs": []})
+        entry["runs"].append({
+            "tenant": tenant, "latencyS": round(h.latency_s, 4),
+            "queueWaitS": round(h.queue_wait_s or 0.0, 4),
+            "cacheHit": h.cache_hit, "identical": diff is None})
+
+    report = {
+        "mode": "loadtest",
+        "scaleFactor": sf,
+        "seed": seed,
+        "form": "sql" if use_sql else "dsl",
+        "concurrency": concurrency,
+        "tenants": tenants,
+        "submissions": n_submissions,
+        "wallClockS": round(wall, 4),
+        "serialSumS": round(serial_sum, 4),
+        "serialColdSumS": round(sum(serial_cold.values()), 4),
+        "serialWarmSumS": round(sum(serial_warm.values()), 4),
+        "speedupVsSerial": round(serial_sum / wall, 3) if wall else None,
+        "throughputQps": round(n_submissions / wall, 3) if wall else None,
+        "latencyP50S": round(_percentile(latencies, 0.50), 4)
+        if latencies else None,
+        "latencyP95S": round(_percentile(latencies, 0.95), 4)
+        if latencies else None,
+        "queueWaitP50S": round(_percentile(queue_waits, 0.50), 4)
+        if queue_waits else None,
+        "queueWaitP95S": round(_percentile(queue_waits, 0.95), 4)
+        if queue_waits else None,
+        # over FINISHED submissions (the population hits can occur in),
+        # matching the latency/queue-wait percentile population
+        "cacheHitRate": round(cache_hits / len(latencies), 4)
+        if latencies else None,
+        "resultCache": (svc.result_cache.stats()
+                        if svc.result_cache is not None else None),
+        "service": svc.stats(),
+        "allIdentical": not mismatches and not failures,
+        "belowSerialSum": wall < serial_sum,
+        "mismatches": mismatches,
+        "failures": failures,
+        "queries": per_query,
+        "ok": not mismatches and not failures,
+    }
+    return report
+
+
+def render_loadtest(report: dict) -> str:
+    lines = [
+        f"Loadtest: {report['submissions']} submissions "
+        f"({report['tenants']} tenants x "
+        f"{len(report['queries'])} queries, {report['form']}) "
+        f"at concurrency {report['concurrency']}",
+        f"  wall clock      {report['wallClockS']:.3f}s  "
+        f"(serial sum {report['serialSumS']:.3f}s, "
+        f"speedup {report['speedupVsSerial']}x)",
+        f"  throughput      {report['throughputQps']} q/s",
+        f"  latency p50/p95 {report['latencyP50S']}s / "
+        f"{report['latencyP95S']}s",
+        f"  queue p50/p95   {report['queueWaitP50S']}s / "
+        f"{report['queueWaitP95S']}s",
+        f"  cache hit rate  {report['cacheHitRate']}",
+        f"  all identical   {report['allIdentical']}",
+    ]
+    if report["mismatches"]:
+        lines.append("  MISMATCHES:")
+        lines += [f"    {m}" for m in report["mismatches"]]
+    if report["failures"]:
+        lines.append("  FAILURES:")
+        lines += [f"    {f}" for f in report["failures"]]
+    return "\n".join(lines)

@@ -180,7 +180,15 @@ def build_profile(records: Iterable[dict], top_n: int = 10,
     attribution falls below the contract (reported, never hidden)."""
     queries = []
     agg_ops: Dict[str, dict] = {}
+    cache_hits = 0
     for r in records:
+        if r.get("cacheHit"):
+            # a cache-hit serve REPLAYS the filling run's plan metrics
+            # with a near-zero serve wall (schema v2): aggregating it
+            # would double-count every operator and produce coverage
+            # ratios far above 1 — count it as served traffic instead
+            cache_hits += 1
+            continue
         queries.append(analyze_query(r, top_n=top_n))
         # aggregate from the FULL per-record op list — truncation is
         # display-only, or an op just below every per-query top-N would
@@ -203,6 +211,7 @@ def build_profile(records: Iterable[dict], top_n: int = 10,
                     if q["attribution"]["coverage"] < coverage_floor]
     return {
         "queryCount": len(queries),
+        "cacheHitRecords": cache_hits,
         "totalWallS": total_wall,
         "minCoverage": round(min((q["attribution"]["coverage"]
                                   for q in queries), default=1.0), 4),
@@ -226,6 +235,9 @@ def _fmt_s(v: float) -> str:
 def render_profile(report: dict) -> str:
     """Human rendering of a build_profile() report."""
     lines: List[str] = []
+    if report.get("cacheHitRecords"):
+        lines.append(f"Cache-hit serves (excluded from op stats): "
+                     f"{report['cacheHitRecords']}")
     lines.append(f"Queries: {report['queryCount']}   total wall "
                  f"{report['totalWallS']:.4f}s   min span coverage "
                  f"{report['minCoverage'] * 100:.1f}%")

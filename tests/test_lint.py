@@ -501,6 +501,64 @@ def test_rl_dead_lambda():
     assert hits[0].path.endswith(":1")
 
 
+def test_rl_thread_shared():
+    from spark_rapids_tpu.lint.repo_lint import _check_thread_shared
+    src = (
+        "import threading\n"
+        "_CACHE = {}\n"
+        "_ITEMS = []\n"
+        "_LOCK = threading.Lock()\n"
+        "class Mgr:\n"
+        "    _instance = None\n"
+        "    @classmethod\n"
+        "    def get(cls):\n"
+        "        cls._instance = Mgr()\n"         # unlocked class attr
+        "        return cls._instance\n"
+        "def bad(k, v):\n"
+        "    _CACHE[k] = v\n"                     # unlocked subscript
+        "    _ITEMS.append(v)\n"                  # unlocked mutator
+        "def good(k, v):\n"
+        "    with _LOCK:\n"
+        "        _CACHE[k] = v\n"                 # guarded: clean
+        "        _ITEMS.append(v)\n"
+        "def rebind():\n"
+        "    global _CACHE\n"
+        "    _CACHE = {}\n"                       # unlocked global rebind
+    )
+    diags = _run_rl(_check_thread_shared,
+                    "spark_rapids_tpu/runtime/foo.py", src)
+    hits = _find(diags, "RL-THREAD-SHARED")
+    assert len(hits) == 4, [str(d) for d in hits]
+    msgs = " ".join(d.message for d in hits)
+    assert "_CACHE[...]" in msgs and "_ITEMS.append" in msgs
+    assert "cls._instance (class attribute)" in msgs
+    # module-level (import-time) writes and non-scanned dirs are clean
+    assert _run_rl(_check_thread_shared,
+                   "spark_rapids_tpu/ops/foo.py", src) == []
+    init_only = "_REG = {}\n_REG['x'] = 1\n"
+    assert _run_rl(_check_thread_shared,
+                   "spark_rapids_tpu/shuffle/foo.py", init_only) == []
+    # the service package is scanned too
+    assert _find(_run_rl(_check_thread_shared,
+                         "spark_rapids_tpu/service/foo.py", src),
+                 "RL-THREAD-SHARED")
+    # the allowlist keys on the CONTAINER name (or the class-attr name),
+    # suppressing every finding shape for that state and nothing else
+    import spark_rapids_tpu.lint.repo_lint as RL
+    saved = dict(RL._THREAD_SHARED_ALLOWLIST)
+    try:
+        RL._THREAD_SHARED_ALLOWLIST.update({
+            "spark_rapids_tpu/runtime/foo.py:_CACHE": "test",
+            "spark_rapids_tpu/runtime/foo.py:_instance": "test"})
+        left = _find(_run_rl(_check_thread_shared,
+                             "spark_rapids_tpu/runtime/foo.py", src),
+                     "RL-THREAD-SHARED")
+        assert len(left) == 1 and "_ITEMS.append" in left[0].message
+    finally:
+        RL._THREAD_SHARED_ALLOWLIST.clear()
+        RL._THREAD_SHARED_ALLOWLIST.update(saved)
+
+
 def test_rl_fault_point():
     from spark_rapids_tpu.lint.repo_lint import (
         _check_fault_registry,

@@ -221,11 +221,15 @@ def test_event_log_written_and_valid(tmp_path):
     lines = open(s.last_event_path).read().strip().splitlines()
     assert len(lines) == 1
     rec = json.loads(lines[0])
-    assert rec["schema"] == 1
+    # schema v2: the query-service PR added tenant/pool/queueWaitS/
+    # cacheHit (null/false outside the service) — see obs/events.py
+    assert rec["schema"] == 2
     assert rec["event"] == "queryCompleted"
     assert rec["queryTag"] == "golden"
     assert rec["wallS"] > 0
     assert rec["spans"]["attributedS"] > 0
+    assert rec["tenant"] is None and rec["pool"] is None
+    assert rec["queueWaitS"] is None and rec["cacheHit"] is False
     # per-op metrics are typed in the plan tree
     agg = rec["plan"]["children"][0]
     assert agg["metrics"]["opTime"]["kind"] == "timing"
@@ -237,7 +241,12 @@ def test_event_log_golden_schema(tmp_path):
     here means the event-log record shape changed — bump
     EVENT_SCHEMA_VERSION, regenerate tests/golden_eventlog.json (this
     test prints the new normalized record on mismatch) and check the
-    offline tools still read it."""
+    offline tools still read it.
+
+    Schema history: v1 = the PR-4 record; v2 = query-service fields
+    (tenant, pool, queueWaitS, cacheHit — null/false when the query ran
+    outside the service; a cache-hit serve replays the filling run's
+    record with cacheHit=true and its own queueWaitS/wallS)."""
     s = _run_eventlog_query(tmp_path)
     got = _normalize(s.last_event_record)
     golden_path = os.path.join(os.path.dirname(__file__),
@@ -262,6 +271,69 @@ def test_sql_text_recorded(tmp_path):
     s.sql("SELECT k, SUM(v) AS sv FROM t GROUP BY k").collect_table()
     rec = s.last_event_record
     assert "SUM(v)" in rec["sqlText"]
+
+
+def test_worker_thread_attribution_meets_floor(tmp_path):
+    """A query executed from a NON-main thread must attribute its wall
+    time against the EXECUTING thread's spans (PR 4 unioned main-thread
+    intervals, under-attributing every off-main-thread query — the
+    query service runs all queries off-main)."""
+    import threading
+
+    s = TpuSession({"spark.rapids.sql.eventLog.enabled": "true",
+                    "spark.rapids.sql.eventLog.dir": str(tmp_path)})
+    _agg_df(s).collect_table()  # warm: compile noise off the floor
+    box = {"covs": []}
+
+    def run():
+        # best of three: the attribution BUG this pins (main-thread
+        # interval union -> ~0 coverage off-main) fails every run; a
+        # millisecond scheduler hiccup on a ~15ms query only fails one
+        for _ in range(3):
+            _agg_df(s).collect_table()
+            rec = s.last_event_record  # thread-local, not a mirror
+            box["covs"].append(rec["spans"]["attributedS"]
+                               / rec["wallS"])
+
+    t = threading.Thread(target=run, name="obs-worker")
+    t.start()
+    t.join(timeout=120)
+    assert len(box["covs"]) == 3
+    cov = max(box["covs"])
+    assert cov >= 0.95, f"off-main-thread span coverage {cov:.3f} < 0.95"
+
+
+def test_concurrent_queries_write_distinct_records(tmp_path):
+    """Two sessions' queries executing CONCURRENTLY from worker threads
+    must produce self-consistent records (no cross-thread span or
+    envelope bleed): every record attributes >= 95% of its own wall."""
+    import threading
+
+    sessions = [
+        TpuSession({"spark.rapids.sql.eventLog.enabled": "true",
+                    "spark.rapids.sql.eventLog.dir": str(tmp_path)})
+        for _ in range(2)]
+    for s in sessions:  # warm: measure attribution, not XLA compiles
+        _agg_df(s, n=400).collect_table()
+    covs = {0: [], 1: []}
+
+    def run(i):
+        # best of three per session (see the off-main-thread test: the
+        # pinned bug fails every run, scheduler noise only one)
+        for _ in range(3):
+            _agg_df(sessions[i], n=400).collect_table()
+            rec = sessions[i].last_event_record
+            covs[i].append(rec["spans"]["attributedS"] / rec["wallS"])
+
+    threads = [threading.Thread(target=run, args=(i,)) for i in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    for i in (0, 1):
+        assert len(covs[i]) == 3
+        cov = max(covs[i])
+        assert cov >= 0.95, f"session {i} coverage {cov:.3f} < 0.95"
 
 
 def test_nested_query_rides_outer_envelope(tmp_path):

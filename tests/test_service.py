@@ -1,0 +1,549 @@
+"""Query-service subsystem tests: concurrent scheduler + WFQ, admission
+control (queue depth, memory pressure), lifecycle (cancellation,
+deadlines), the plan-fingerprint result cache (hits, eviction,
+invalidation on catalog mutation and table writes), semaphore metrics,
+event-log service fields, the concurrent chaos slice, and the
+`tools loadtest` CLI smoke."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import functions as F
+from spark_rapids_tpu.errors import (
+    QueryCancelledError,
+    QueryRejectedError,
+    QueryTimeoutError,
+    SemaphoreTimeoutError,
+)
+from spark_rapids_tpu.ops.expr import col, lit
+from spark_rapids_tpu.service import QueryService
+from spark_rapids_tpu.service.query import QueryState
+
+pytestmark = pytest.mark.service
+
+#: every kernel dispatch sleeps 50ms — makes queries deterministically
+#: slow (seconds across a multi-batch plan) so lifecycle races are
+#: controllable without wall-clock guessing
+_SLOW_FAULT = {"spark.rapids.test.faults": "dispatch.kernel:slow:1.0"}
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_state():
+    from spark_rapids_tpu.runtime.faults import CIRCUIT_BREAKER, FAULTS
+    FAULTS.disarm()
+    CIRCUIT_BREAKER.reset()
+    yield
+    FAULTS.disarm()
+    CIRCUIT_BREAKER.reset()
+
+
+def _data(n=240):
+    return {"k": np.array(["a", "b", "c", "d"] * (n // 4), dtype=object),
+            "v": np.arange(n, dtype=np.int64)}
+
+
+def _slow_query(svc, num_batches=24):
+    """Multi-batch agg: with the slow-dispatch fault armed, each batch
+    costs several 50ms sleeps, and the cancellation boundary runs
+    between batches."""
+    df = svc.session.create_dataframe(_data(), num_batches=num_batches)
+    return (df.filter(col("v") >= lit(0))
+            .group_by("k").agg(F.sum("v").alias("sv")))
+
+
+def _fast_query(svc, tag=0):
+    # one source DataFrame per session: the fingerprint keys source
+    # tables by IDENTITY, so repeated submissions must share the table
+    # (like the loadtest harness's shared `tables` dict)
+    df = getattr(svc.session, "_test_src_df", None)
+    if df is None:
+        df = svc.session._test_src_df = svc.session.create_dataframe(
+            _data())
+    return (df.filter(col("v") > lit(tag))
+            .group_by("k").agg(F.count("v").alias("c")))
+
+
+def _wait_state(handle, state, timeout=30.0):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if handle.state == state or handle.done:
+            return handle.state
+        time.sleep(0.005)
+    return handle.state
+
+
+# ---------------------------------------------------------------------------
+# lifecycle: cancellation + deadlines
+# ---------------------------------------------------------------------------
+
+
+def test_running_query_cancels_between_batches():
+    with QueryService(dict(_SLOW_FAULT)) as svc:
+        h = svc.submit(_slow_query(svc), tenant="a")
+        assert _wait_state(h, QueryState.RUNNING) == QueryState.RUNNING
+        t_cancel = time.monotonic()
+        assert h.cancel()
+        assert h.wait(timeout=30)
+        assert h.state == QueryState.CANCELLED
+        # cooperative: the interrupt landed at a batch boundary, not
+        # after the full (many-seconds) plan drained
+        assert time.monotonic() - t_cancel < 10.0
+        with pytest.raises(QueryCancelledError):
+            h.result(timeout=1)
+        assert h.scope.checks > 0
+        assert svc.counters["cancelled"] == 1
+
+
+def test_queued_query_cancels_without_running():
+    with QueryService(dict(_SLOW_FAULT), max_concurrent=1) as svc:
+        blocker = svc.submit(_slow_query(svc))
+        queued = svc.submit(_fast_query(svc))
+        assert queued.cancel()
+        assert queued.wait(timeout=10)
+        assert queued.state == QueryState.CANCELLED
+        assert queued.start_t is None  # never ran
+        assert svc.counters["cancelled"] == 1
+        blocker.cancel()
+
+
+def test_running_deadline_times_out():
+    with QueryService(dict(_SLOW_FAULT)) as svc:
+        h = svc.submit(_slow_query(svc), timeout_ms=300)
+        assert h.wait(timeout=30)
+        assert h.state == QueryState.TIMED_OUT
+        with pytest.raises(QueryTimeoutError):
+            h.result(timeout=1)
+        assert svc.counters["timed_out"] == 1
+
+
+def test_queued_deadline_times_out_without_running():
+    with QueryService(dict(_SLOW_FAULT), max_concurrent=1) as svc:
+        blocker = svc.submit(_slow_query(svc))
+        queued = svc.submit(_fast_query(svc), timeout_ms=100)
+        t0 = time.monotonic()
+        assert queued.wait(timeout=10)
+        # the dedicated sweeper expires it ON TIME even though the only
+        # worker is busy — not seconds later when the worker frees
+        assert time.monotonic() - t0 < 2.0
+        assert blocker.state == QueryState.RUNNING
+        assert queued.state == QueryState.TIMED_OUT
+        assert queued.start_t is None
+        blocker.cancel()
+
+
+def test_default_timeout_conf_applies():
+    conf = dict(_SLOW_FAULT)
+    conf["spark.rapids.service.defaultTimeoutMs"] = "250"
+    with QueryService(conf) as svc:
+        h = svc.submit(_slow_query(svc))
+        assert h.wait(timeout=30)
+        assert h.state == QueryState.TIMED_OUT
+
+
+# ---------------------------------------------------------------------------
+# admission: queue depth + memory pressure
+# ---------------------------------------------------------------------------
+
+
+def test_queue_full_rejection_with_retry_after():
+    conf = dict(_SLOW_FAULT)
+    conf["spark.rapids.service.queueDepth"] = "1"
+    with QueryService(conf, max_concurrent=1) as svc:
+        running = svc.submit(_slow_query(svc))
+        _wait_state(running, QueryState.RUNNING)
+        queued = svc.submit(_fast_query(svc))
+        with pytest.raises(QueryRejectedError) as ei:
+            svc.submit(_fast_query(svc, tag=1))
+        assert ei.value.retry_after_ms >= 50
+        assert svc.counters["rejected"] == 1
+        running.cancel()
+        queued.cancel()
+
+
+def test_memory_pressure_holds_admission():
+    conf = dict(_SLOW_FAULT)
+    conf["spark.rapids.service.admission.maxDeviceBytes"] = "1"
+    with QueryService(conf, max_concurrent=2) as svc:
+        svc._memory_probe = lambda: 10 ** 12  # far over the high water
+        h1 = svc.submit(_slow_query(svc))
+        _wait_state(h1, QueryState.RUNNING)
+        h2 = svc.submit(_fast_query(svc))
+        time.sleep(0.4)
+        # the gate held h2 back even though a worker was free...
+        assert h2.state == QueryState.QUEUED
+        assert svc.stats()["heldForMemory"] > 0
+        # ...but forward progress wins once nothing is running
+        h1.cancel()
+        assert h2.wait(timeout=30)
+        assert h2.state == QueryState.FINISHED
+        assert h2.start_t >= h1.end_t
+
+
+def test_unknown_pool_rejected_and_bad_specs_raise():
+    from spark_rapids_tpu.errors import ColumnarProcessingError
+    from spark_rapids_tpu.service.scheduler import (
+        parse_pools,
+        parse_tenant_weights,
+    )
+    with QueryService({}) as svc:
+        with pytest.raises(ColumnarProcessingError, match="unknown"):
+            svc.submit(_fast_query(svc), pool="nope")
+    assert parse_pools("a;b:weight=2") == {"a": 1.0, "b": 2.0}
+    with pytest.raises(ColumnarProcessingError):
+        parse_pools("a;a")
+    with pytest.raises(ColumnarProcessingError):
+        parse_pools("a:weight=0")
+    with pytest.raises(ColumnarProcessingError):
+        parse_pools("")
+    with pytest.raises(ColumnarProcessingError, match="not a number"):
+        parse_pools("a:weight=high")
+    assert parse_tenant_weights("x=2, y=0.5") == {"x": 2.0, "y": 0.5}
+    with pytest.raises(ColumnarProcessingError):
+        parse_tenant_weights("x")
+    with pytest.raises(ColumnarProcessingError, match="not a number"):
+        parse_tenant_weights("x=fast")
+
+
+# ---------------------------------------------------------------------------
+# weighted fair queueing
+# ---------------------------------------------------------------------------
+
+
+def test_wfq_prefers_underweighted_tenant():
+    """With heavy weight >> light weight, every queued heavy query runs
+    before the 2nd light one once the light tenant has been charged."""
+    conf = dict(_SLOW_FAULT)
+    conf["spark.rapids.service.tenantWeights"] = "heavy=1000,light=1"
+    conf["spark.rapids.service.resultCache.enabled"] = "false"
+    with QueryService(conf, max_concurrent=1) as svc:
+        blocker = svc.submit(_slow_query(svc), tenant="warm")
+        light = [svc.submit(_fast_query(svc, tag=i), tenant="light")
+                 for i in range(3)]
+        heavy = [svc.submit(_fast_query(svc, tag=10 + i), tenant="heavy")
+                 for i in range(3)]
+        blocker.cancel()
+        for h in light + heavy:
+            assert h.wait(timeout=60)
+            assert h.state == QueryState.FINISHED, h.error
+        # first pick ties at clock 0 (FIFO by id -> light[0]); after the
+        # light tenant is charged, all heavy queries cut ahead
+        assert max(h.end_t for h in heavy) < max(h.end_t
+                                                 for h in light[1:])
+
+
+def test_wfq_clocks_are_weight_normalized_exactly_once():
+    """_charge_locked adds elapsed/weight; the pick must compare those
+    clocks RAW (dividing by the weight again would hand a weight-W
+    tenant a W^2 share)."""
+    from collections import deque
+
+    from spark_rapids_tpu.service.query import QueryHandle
+    conf = {"spark.rapids.service.tenantWeights": "a=2,b=1"}
+    with QueryService(conf, max_concurrent=1) as svc:
+        ha = QueryHandle(tenant="a", pool="default", tag=None,
+                         sql_text=None, plan=None, deadline=None)
+        hb = QueryHandle(tenant="b", pool="default", tag=None,
+                         sql_text=None, plan=None, deadline=None)
+        with svc._cond:  # workers can't race the pick while held
+            svc._queues[("default", "a")] = deque([ha])
+            svc._queues[("default", "b")] = deque([hb])
+            svc._queued_per_pool["default"] = 2
+            # a served 2.0s at weight 2 -> clock 1.0; b served 0.9s at
+            # weight 1 -> clock 0.9: b is BEHIND its fair share
+            svc._tenant_clock[("default", "a")] = 1.0
+            svc._tenant_clock[("default", "b")] = 0.9
+            picked = svc._pick_locked()
+            # drain the other so shutdown doesn't cancel a fake handle
+            svc._pick_locked()
+        assert picked is hb
+
+
+def test_wfq_returning_tenant_cannot_spend_idle_credit():
+    """A tenant idle for a long stretch re-joins at the pool's ACTIVE
+    minimum clock — idle time banks no credit, so a returning burst
+    cannot monopolize workers (classic WFQ virtual-time lift)."""
+    from collections import deque
+
+    from spark_rapids_tpu.service.query import QueryHandle
+
+    def _handle(tenant):
+        return QueryHandle(tenant=tenant, pool="default", tag=None,
+                           sql_text=None, plan=None, deadline=None)
+
+    with QueryService({}, max_concurrent=1) as svc:
+        with svc._cond:
+            # veteran A has been served 60s; B ran 1s long ago and idled
+            svc._tenant_clock[("default", "a")] = 60.0
+            svc._tenant_clock[("default", "b")] = 1.0
+            # A has work queued when B's burst arrives
+            svc._queues[("default", "a")] = deque([_handle("a")])
+            svc._queued_per_pool["default"] = 1
+            svc._activate_locked("default", "b")
+            # B lifted to A's clock: no 59s of exclusive service
+            assert svc._tenant_clock[("default", "b")] == 60.0
+            # and empty-queue state is pruned, not accumulated forever
+            svc._pick_locked()
+            assert ("default", "a") not in svc._queues
+
+
+# ---------------------------------------------------------------------------
+# result cache
+# ---------------------------------------------------------------------------
+
+
+def test_result_cache_hit_is_bit_identical():
+    import scale_test
+    with QueryService({}) as svc:
+        h1 = svc.submit(_fast_query(svc), tenant="a")
+        t1 = h1.result(timeout=60)
+        h2 = svc.submit(_fast_query(svc), tenant="b")
+        t2 = h2.result(timeout=60)
+        assert not h1.cache_hit and h2.cache_hit
+        assert scale_test.tables_differ(t1, t2) is None
+        stats = svc.result_cache.stats()
+        assert stats["hits"] == 1 and stats["misses"] == 1
+
+
+def test_result_cache_lru_eviction():
+    from spark_rapids_tpu.columnar import HostTable
+    from spark_rapids_tpu.service.result_cache import ResultCache
+    t = HostTable.from_pydict(
+        {"v": np.arange(100, dtype=np.int64)})
+    cache = ResultCache(max_bytes=int(t.nbytes() * 2.5))
+    assert cache.put("a", t) and cache.put("b", t) and cache.put("c", t)
+    assert cache.evictions == 1 and cache.entry_count == 2
+    assert cache.get("a") is None          # the LRU victim
+    assert cache.get("c") is not None
+    assert not cache.put("huge", HostTable.from_pydict(
+        {"v": np.arange(100000, dtype=np.int64)}))  # oversized: skipped
+
+
+def test_cache_invalidated_on_temp_view_mutation():
+    with QueryService({}) as svc:
+        s = svc.session
+        s.create_dataframe(_data()).create_or_replace_temp_view("t")
+        sql = "SELECT k, COUNT(v) AS c FROM t GROUP BY k"
+        h1 = svc.submit(sql)
+        r1 = h1.result(timeout=60)
+        assert svc.submit(sql).result(timeout=60).num_rows == r1.num_rows
+        # redefine the view over different data -> epoch bump -> miss
+        d = _data()
+        d["k"] = np.array(["x"] * len(d["k"]), dtype=object)
+        s.create_dataframe(d).create_or_replace_temp_view("t")
+        h3 = svc.submit(sql)
+        r3 = h3.result(timeout=60)
+        assert not h3.cache_hit
+        assert r3.num_rows == 1  # one group now; stale entry not served
+        # resubmitting the PRE-mutation plan (same fingerprint as the
+        # cached entry) must also miss: its entry predates the epoch
+        # bump and is dropped on lookup, never served
+        h4 = svc.submit(h1.plan)
+        assert h4.result(timeout=60).num_rows == r1.num_rows
+        assert not h4.cache_hit
+        assert svc.result_cache.invalidations >= 1
+
+
+def test_cache_invalidated_on_write(tmp_path):
+    with QueryService({}) as svc:
+        h1 = svc.submit(_fast_query(svc))
+        h1.result(timeout=60)
+        h2 = svc.submit(_fast_query(svc))
+        h2.result(timeout=60)
+        assert h2.cache_hit
+        # a WriteFiles plan through the SAME session's execute bumps
+        # the invalidation epoch: contents under written paths changed
+        svc.session.create_dataframe(_data()).write_parquet(
+            str(tmp_path / "out"))
+        h3 = svc.submit(_fast_query(svc))
+        h3.result(timeout=60)
+        assert not h3.cache_hit
+
+
+def test_delta_commit_bumps_invalidation_epoch(tmp_path):
+    from spark_rapids_tpu.delta.log import DeltaLog
+    from spark_rapids_tpu.service.result_cache import invalidation_epoch
+    before = invalidation_epoch()
+    DeltaLog(str(tmp_path)).commit([], 0, op_name="WRITE")
+    assert invalidation_epoch() == before + 1
+
+
+def test_uncacheable_plans_never_cache():
+    from spark_rapids_tpu.service.result_cache import fingerprint
+    from spark_rapids_tpu.plan import nodes as P
+    from spark_rapids_tpu.session import TpuSession
+    s = TpuSession()
+    df = s.create_dataframe(_data())
+    node = P.WriteFiles(df.plan, "parquet", "/tmp/x", None, {})
+    assert fingerprint(node, s.conf) is None  # side effects never cache
+    # structurally identical plans from DIFFERENT builder calls match
+    a = fingerprint(_fast_query_plan(s, df), s.conf)
+    b = fingerprint(_fast_query_plan(s, df), s.conf)
+    assert a is not None and a == b
+    # a result-affecting conf change changes the key
+    c = fingerprint(_fast_query_plan(s, df),
+                    s.conf.set("spark.sql.ansi.enabled", "true"))
+    assert c != a
+
+
+def _fast_query_plan(s, df):
+    return (df.filter(col("v") > lit(0))
+            .group_by("k").agg(F.count("v").alias("c"))).plan
+
+
+def test_cancellation_wrapped_exec_still_pickles_for_lore():
+    """LORE dumps of a service-executed plan must survive the third
+    (cancellation) wrapper layer like the fault/observation ones."""
+    import pickle
+
+    from spark_rapids_tpu.execs.base import TpuExec
+    from spark_rapids_tpu.lore import _iter_tree, _strip_for_pickle
+    with QueryService({}) as svc:
+        svc.submit(_fast_query(svc, tag=5)).result(timeout=60)
+        ex = svc.session._last_executable  # mirror: last completed
+    assert ex is not None
+    execs = [e for e in _iter_tree(ex) if isinstance(e, TpuExec)]
+    assert execs
+    for e in execs:
+        assert "_cancel_installed" in e.__dict__  # wrapper was live
+        pickle.dumps(_strip_for_pickle(e))
+
+
+# ---------------------------------------------------------------------------
+# semaphore: typed timeout + metrics scope (two-thread contention)
+# ---------------------------------------------------------------------------
+
+
+def test_semaphore_contention_routes_metrics_and_typed_timeout():
+    from spark_rapids_tpu.obs.metrics import metric_scope
+    from spark_rapids_tpu.runtime.semaphore import TpuSemaphore
+    scope = metric_scope("semaphore")
+    before = dict(scope)
+    sem = TpuSemaphore(1)
+    sem.acquire_if_necessary()
+    errs = []
+
+    def blocked():
+        try:
+            sem.acquire_if_necessary(timeout=0.05)
+        except SemaphoreTimeoutError as e:
+            errs.append(e)
+            sem.release_if_held()  # no-op: never acquired
+
+    t = threading.Thread(target=blocked)
+    t.start()
+    t.join(10)
+    assert len(errs) == 1
+    assert isinstance(errs[0], TimeoutError)  # stays a TimeoutError too
+    assert sem.timeout_count == 1
+
+    def second():
+        sem.acquire_if_necessary(timeout=10)
+        sem.release_if_held()
+
+    t2 = threading.Thread(target=second)
+    t2.start()
+    time.sleep(0.1)
+    sem.release_if_held()
+    t2.join(10)
+    after = dict(scope)
+    assert after.get("acquireTimeouts", 0) - before.get(
+        "acquireTimeouts", 0) == 1
+    assert after.get("acquires", 0) - before.get("acquires", 0) >= 2
+    assert after.get("acquireWaitTime", 0.0) > before.get(
+        "acquireWaitTime", 0.0)
+
+
+# ---------------------------------------------------------------------------
+# event log: service fields
+# ---------------------------------------------------------------------------
+
+
+def test_event_log_records_service_fields(tmp_path):
+    conf = {"spark.rapids.sql.eventLog.enabled": "true",
+            "spark.rapids.sql.eventLog.dir": str(tmp_path)}
+    with QueryService(conf) as svc:
+        h1 = svc.submit(_fast_query(svc), tenant="alice", tag="q")
+        h1.result(timeout=60)
+        h2 = svc.submit(_fast_query(svc), tenant="bob", tag="q")
+        h2.result(timeout=60)
+    rec1, rec2 = h1.event_record, h2.event_record
+    assert rec1["tenant"] == "alice" and rec1["pool"] == "default"
+    assert rec1["cacheHit"] is False and rec1["queueWaitS"] >= 0
+    assert rec2["tenant"] == "bob" and rec2["cacheHit"] is True
+    # both the execution and the cache-hit serve landed in the log
+    files = [f for f in os.listdir(tmp_path) if f.endswith(".jsonl")]
+    assert len(files) == 1
+    lines = open(tmp_path / files[0]).read().strip().splitlines()
+    assert len(lines) == 2
+    hits = [json.loads(ln)["cacheHit"] for ln in lines]
+    assert sorted(hits) == [False, True]
+
+
+# ---------------------------------------------------------------------------
+# concurrent execution: identity + chaos slice
+# ---------------------------------------------------------------------------
+
+
+def test_concurrent_results_bit_identical_and_faster_than_serial():
+    """The tier-1-sized loadtest: 2 tenants x 2 golden queries at
+    concurrency 4 through the service, every result bit-identical to
+    serial execution and the aggregate wall below the serial sum."""
+    from spark_rapids_tpu.tools.loadtest import run_loadtest
+    report = run_loadtest(sf=0.005, queries=["q1", "q3"], concurrency=4,
+                          tenants=2)
+    assert report["ok"], (report["mismatches"], report["failures"])
+    assert report["allIdentical"]
+    assert report["submissions"] == 4
+    assert report["belowSerialSum"], (report["wallClockS"],
+                                      report["serialSumS"])
+    assert report["latencyP95S"] >= report["latencyP50S"]
+    assert 0.0 <= report["cacheHitRate"] <= 1.0
+
+
+@pytest.mark.chaos
+def test_concurrent_chaos_slice_bit_identical():
+    """scale_test --concurrency 4 --chaos --seed 7 slice: recovery and
+    the concurrent scheduler together, results bit-identical to
+    fault-free serial execution, lifecycle counters sane."""
+    from spark_rapids_tpu.lint.golden import _load_scale_test
+    st = _load_scale_test()
+    report = st.run_chaos(sf=0.01, seed=7, queries=["q1", "q3", "q7"],
+                          concurrency=4)
+    assert report["ok"]
+    assert all(e["identical"] for e in report["queries"].values())
+    assert sum(report["fault_fires"].values()) > 0  # not vacuous
+    svc = report["service"]
+    assert svc["finished"] == 3
+    assert svc["cancelled"] == svc["timed_out"] == svc["rejected"] == 0
+    for field, per_query_bound in st.CHAOS_BOUNDS.items():
+        assert report["recovery"].get(field, 0) <= per_query_bound * 3
+
+
+# ---------------------------------------------------------------------------
+# tools loadtest CLI smoke
+# ---------------------------------------------------------------------------
+
+
+def test_tools_loadtest_cli_smoke():
+    """q1 at concurrency 2 through the real CLI -> JSON report."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, "-m", "spark_rapids_tpu.tools", "loadtest",
+         "--sf", "0.002", "--queries", "q1", "--concurrency", "2",
+         "--tenants", "2", "--json"],
+        capture_output=True, text=True, env=env, timeout=300,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert out.returncode == 0, out.stderr[-2000:]
+    report = json.loads(out.stdout.strip().splitlines()[-1])
+    assert report["ok"] and report["allIdentical"]
+    assert report["concurrency"] == 2 and report["submissions"] == 2
+    for key in ("wallClockS", "serialSumS", "latencyP50S", "latencyP95S",
+                "queueWaitP50S", "cacheHitRate", "throughputQps"):
+        assert key in report
