@@ -17,16 +17,122 @@ _MISSING = object()
 MIN_BUCKET = 128
 
 
-def bucket_for(n: int) -> int:
-    """Smallest power-of-two >= n (and >= MIN_BUCKET).
+class BucketPolicy:
+    """A BOUNDED, declared set of capacity buckets.
 
-    Power-of-two buckets bound the number of distinct compiled programs per
-    (schema, expression) to log2(max_rows) — the XLA analog of cuDF's
-    precompiled kernels (SURVEY.md §7 hard parts)."""
-    b = MIN_BUCKET
-    while b < n:
-        b <<= 1
-    return b
+    Every device buffer's leading dimension is drawn from this set, so
+    the number of distinct compiled programs per (schema, expression)
+    is bounded by the set's size — the XLA analog of cuDF's precompiled
+    kernels (SURVEY.md §7 hard parts). ``spark.rapids.sql.shapeBuckets``
+    picks the policy:
+
+    * ``pow2`` — powers of two from ``minBucket`` (the historical
+      default: log2(max_rows) buckets);
+    * ``pow4`` — powers of four from ``minBucket``: half the compiled
+      shapes for at most 4x pad waste (mask-aware execs never touch the
+      dead tail rows, they only cost bandwidth);
+    * an explicit ascending comma-separated list (``'1024,16384,...'``)
+      — the exact bucket set, continuing pow2 above its largest entry
+      (a capacity must always exist for any row count).
+
+    Buckets must be multiples of 128 (the TPU lane width) and strictly
+    ascending; a bad spec raises at conf-apply time, never mid-kernel.
+    """
+
+    __slots__ = ("spec", "min_bucket", "_explicit", "_ratio")
+
+    def __init__(self, spec: str = "pow2", min_bucket: int = MIN_BUCKET):
+        self.spec = str(spec).strip().lower() or "pow2"
+        self.min_bucket = int(min_bucket)
+        if self.min_bucket < 1 or self.min_bucket % MIN_BUCKET:
+            raise ColumnarProcessingError(
+                f"spark.rapids.sql.shapeBuckets.minBucket must be a "
+                f"positive multiple of {MIN_BUCKET}, got {min_bucket}")
+        self._explicit = None
+        if self.spec == "pow2":
+            self._ratio = 2
+        elif self.spec == "pow4":
+            self._ratio = 4
+        else:
+            self._ratio = 2
+            try:
+                buckets = tuple(int(b) for b in self.spec.split(","))
+            except ValueError:
+                raise ColumnarProcessingError(
+                    f"spark.rapids.sql.shapeBuckets must be 'pow2', "
+                    f"'pow4' or an ascending comma-separated int list, "
+                    f"got {spec!r}")
+            if not buckets or any(b < 1 or b % self.min_bucket
+                                  for b in buckets):
+                # multiples of minBucket (itself a lane-width multiple):
+                # the operator's minBucket contract applies to explicit
+                # lists too, not just the geometric policies
+                raise ColumnarProcessingError(
+                    f"spark.rapids.sql.shapeBuckets entries must be "
+                    f"positive multiples of "
+                    f"spark.rapids.sql.shapeBuckets.minBucket "
+                    f"({self.min_bucket}), got {spec!r}")
+            if any(a >= b for a, b in zip(buckets, buckets[1:])):
+                raise ColumnarProcessingError(
+                    f"spark.rapids.sql.shapeBuckets entries must be "
+                    f"strictly ascending, got {spec!r}")
+            self._explicit = buckets
+
+    def bucket_for(self, n: int) -> int:
+        """Smallest declared bucket >= n (and >= the min bucket)."""
+        if self._explicit is not None:
+            for b in self._explicit:
+                if b >= n:
+                    return b
+            b = self._explicit[-1]
+        else:
+            b = self.min_bucket
+        while b < n:
+            b *= self._ratio if self._explicit is None else 2
+        return b
+
+    def buckets_up_to(self, cap: int) -> tuple:
+        """The declared bucket set covering capacities <= ``cap`` —
+        the bound on distinct compiled shapes for a workload whose
+        largest batch fits ``cap``."""
+        out = []
+        if self._explicit is not None:
+            out.extend(b for b in self._explicit if b <= cap)
+            b = self._explicit[-1] * 2
+        else:
+            b = self.min_bucket
+        while b <= cap:
+            out.append(b)
+            b *= self._ratio if self._explicit is None else 2
+        if not out or out[-1] < cap:
+            out.append(self.bucket_for(cap))
+        return tuple(sorted(set(out)))
+
+
+_POLICY = BucketPolicy()
+_POLICY_KEY = ("pow2", MIN_BUCKET)
+
+
+def set_bucket_policy(spec: str, min_bucket: int = MIN_BUCKET) -> None:
+    """Install the process-wide bucket policy (pushed from the session's
+    conf per query, the DeviceTable.EMBED_* tuning pattern). No-op when
+    unchanged; validates eagerly so a typo'd spec fails the query at
+    plan time."""
+    global _POLICY, _POLICY_KEY
+    key = (str(spec).strip().lower() or "pow2", int(min_bucket))
+    if key == _POLICY_KEY:
+        return
+    _POLICY = BucketPolicy(spec, min_bucket)
+    _POLICY_KEY = key
+
+
+def bucket_policy() -> BucketPolicy:
+    return _POLICY
+
+
+def bucket_for(n: int) -> int:
+    """Smallest declared capacity bucket >= n (see BucketPolicy)."""
+    return _POLICY.bucket_for(n)
 
 
 class HostColumn:

@@ -11,7 +11,7 @@ from __future__ import annotations
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
-from spark_rapids_tpu.dispatch import tpu_jit
+from spark_rapids_tpu.dispatch import count_pad_waste, tpu_jit
 import jax.numpy as jnp
 import numpy as np
 
@@ -520,6 +520,49 @@ class HostTable:
         return sum(c.nbytes() for c in self.columns)
 
 
+class PendingHostTable:
+    """An ENQUEUED packed download: the d2h kernel is already in flight
+    (enqueued under the device semaphore), ``resolve()`` blocks for the
+    buffer, validates any speculation flags riding the header, and
+    decodes the HostTable. Splitting enqueue from fetch lets the
+    session release the device semaphore before paying the ~0.1s
+    tunnel round trip (async result fetch) — the next admitted query's
+    kernels dispatch while this one's bytes cross the wire.
+
+    ``resolve()`` may raise SpeculationFailed exactly like the
+    synchronous path; callers must therefore resolve INSIDE the
+    speculation attempt that produced the batch."""
+
+    __slots__ = ("_table", "_buf", "_kinds", "_k", "_n_extra", "_pend")
+
+    def __init__(self, table: "DeviceTable", buf_dev, kinds: tuple,
+                 k: int, n_extra: int, pend):
+        self._table = table
+        self._buf = buf_dev
+        self._kinds = kinds
+        self._k = k
+        self._n_extra = n_extra
+        self._pend = pend
+
+    def resolve(self) -> HostTable:
+        from spark_rapids_tpu.runtime import speculation as spec
+        buf = np.asarray(self._buf)  # blocks: the one d2h round trip
+        extras, datas, valids = _unpack_host(buf, self._kinds, self._k,
+                                             self._n_extra)
+        if self._pend:
+            spec.check_flag_values([s for s, _ in self._pend], extras[1:])
+        t = self._table
+        n = int(extras[0])
+        if t._nrows_host is None:
+            t._nrows_host = n
+        n = min(n, self._k)
+        cols = []
+        for c, data, validity in zip(t.columns, datas, valids):
+            cols.append(c.decode_host(
+                data[:n], np.ascontiguousarray(validity[:n])))
+        return HostTable(t.names, cols)
+
+
 class DeviceTable:
     """Named device columns padded to a common capacity bucket.
 
@@ -594,6 +637,9 @@ class DeviceTable:
     @staticmethod
     def from_host(host: HostTable, capacity: Optional[int] = None) -> "DeviceTable":
         cap = capacity or bucket_for(host.num_rows)
+        # bucket pad waste: dead tail rows this upload carries so the
+        # kernel set stays bounded (`compile` scope, padWasteRows)
+        count_pad_waste(cap - host.num_rows)
         if not host.columns:
             return DeviceTable(host.names, [], host.num_rows, cap)
         if any(isinstance(c.dtype, (T.ArrayType, T.StructType, T.MapType))
@@ -650,10 +696,20 @@ class DeviceTable:
         pending speculation flags (runtime/speculation.py), so a warm query
         whose output bucket is small performs exactly ONE round trip —
         no separate row-count sync, no separate flag validation fetch."""
+        out = self.to_host_pending()
+        return out.resolve() if isinstance(out, PendingHostTable) else out
+
+    def to_host_pending(self):
+        """ENQUEUE the packed-download kernel and return a
+        :class:`PendingHostTable` whose ``resolve()`` completes the d2h
+        round trip — the async-result-fetch split: kernels are enqueued
+        while the caller still holds the device semaphore, the ~0.1s
+        tunnel fetch happens after it is released. Paths that cannot
+        defer (no columns, nested columns) return a plain HostTable."""
         if not self.columns:
             return HostTable(self.names, [])
         if self.live is not None:
-            return self.compacted().to_host()
+            return self.compacted().to_host_pending()
         if any(c.is_nested for c in self.columns):
             return self.to_host_per_column()
         from spark_rapids_tpu.runtime import speculation as spec
@@ -672,20 +728,9 @@ class DeviceTable:
         extras_dev = jnp.concatenate(
             [jnp.reshape(self.nrows_dev.astype(jnp.int32), (1,))]
             + [jnp.reshape(f.astype(jnp.int32), (1,)) for _, f in pend])
-        buf = np.asarray(fn(
-            tuple((c.data, c.validity) for c in self.columns), extras_dev))
-        extras, datas, valids = _unpack_host(buf, kinds, k, n_extra)
-        if pend:
-            spec.check_flag_values([s for s, _ in pend], extras[1:])
-        n = int(extras[0])
-        if self._nrows_host is None:
-            self._nrows_host = n
-        n = min(n, k)
-        cols = []
-        for c, data, validity in zip(self.columns, datas, valids):
-            cols.append(c.decode_host(
-                data[:n], np.ascontiguousarray(validity[:n])))
-        return HostTable(self.names, cols)
+        buf_dev = fn(
+            tuple((c.data, c.validity) for c in self.columns), extras_dev)
+        return PendingHostTable(self, buf_dev, kinds, k, n_extra, pend)
 
     def to_host_per_column(self) -> HostTable:
         """Low-allocation download: transfer each column's existing buffers

@@ -509,6 +509,66 @@ PLAN_VERIFY_MODE = str_conf(
     "audits.", commonly_used=True)
 
 
+SHAPE_BUCKETS = str_conf(
+    "spark.rapids.sql.shapeBuckets", "pow2",
+    "Capacity bucket policy for device batches: every batch capacity "
+    "rounds UP to the next bucket before any kernel sees it, so the "
+    "whole workload compiles to a BOUNDED kernel set instead of one "
+    "XLA program per row count (mask-aware execs tolerate the dead "
+    "tail rows). 'pow2' (default) and 'pow4' grow geometrically from "
+    "shapeBuckets.minBucket; an explicit ascending comma-separated "
+    "list (e.g. '1024,16384,262144') declares the exact set, with "
+    "pow2 growth above its largest entry. Bucket pad waste is counted "
+    "in the `compile` metric scope (padWasteRows). The policy is "
+    "PROCESS-WIDE (pushed at query start, like the other tuning "
+    "knobs): sessions executing concurrently in one process should "
+    "agree on it — a mid-drain policy switch costs extra compiled "
+    "shapes, never correctness.", commonly_used=True)
+
+SHAPE_BUCKETS_MIN = int_conf(
+    "spark.rapids.sql.shapeBuckets.minBucket", 128,
+    "Smallest capacity bucket (and the unit every bucket must be a "
+    "multiple of): 128 is the TPU lane width, so buckets tile cleanly "
+    "onto the VPU/MXU. Raising it trades pad waste for fewer distinct "
+    "compiled shapes on tiny batches.")
+
+EXECUTABLE_CACHE_ENABLED = bool_conf(
+    "spark.rapids.sql.executableCache.enabled", True,
+    "Cache the converted executable plan (lowered exec tree + "
+    "overrides meta) keyed on the literal-stripped structural "
+    "fingerprint (plan/fingerprint.py): a repeated query template "
+    "skips overrides conversion, plan verification and kernel "
+    "re-tracing entirely; distinct-literal variants of one template "
+    "share the grouped entry's compiled-kernel set. Entries drop on "
+    "warehouse invalidation (writes/commits) and on circuit-breaker "
+    "demotions. Hit/miss counters live in the `compile` metric scope.",
+    commonly_used=True)
+
+EXECUTABLE_CACHE_MAX_PLANS = int_conf(
+    "spark.rapids.sql.executableCache.maxPlans", 64,
+    "LRU bound on cached plan TEMPLATES (literal-stripped "
+    "fingerprints) in the executable cache. NOTE: a cached tree pins "
+    "its plan's in-memory source tables (scan-node references), so "
+    "this bound also bounds host memory pinned by the cache — size it "
+    "to the serving working set, not to every plan ever seen.")
+
+EXECUTABLE_CACHE_MAX_VARIANTS = int_conf(
+    "spark.rapids.sql.executableCache.maxVariantsPerPlan", 4,
+    "LRU bound on literal variants retained per cached template: each "
+    "variant pins one converted exec tree; template-mates beyond it "
+    "still share the template's compiled kernels.")
+
+ASYNC_RESULT_FETCH = bool_conf(
+    "spark.rapids.sql.asyncResultFetch", True,
+    "Move the final device->host result fetch off the device-semaphore "
+    "critical section: the collect's packed d2h kernel is ENQUEUED "
+    "under the semaphore, the semaphore releases once the last kernel "
+    "is in flight, and the ~0.1s tunnel round trip completes without "
+    "blocking the next admitted query (reference: spark-rapids async "
+    "d2h pipelining). Per-batch fetches that must validate speculation "
+    "flags stay synchronous.")
+
+
 class RapidsConf:
     """Immutable-ish view over a plain {key: value} dict with typed access."""
 

@@ -22,11 +22,14 @@ from __future__ import annotations
 
 import hashlib
 import threading
+from collections import OrderedDict
 from typing import Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from spark_rapids_tpu.obs.metrics import metric_scope, register_metric
 
 _LOCK = threading.Lock()
 
@@ -35,13 +38,17 @@ _LOCK = threading.Lock()
 import contextvars
 ANSI_MODE = contextvars.ContextVar("rapids_ansi_mode", default=False)
 
-#: content-keyed device copies of host constant arrays
-_CONST_CACHE: Dict[tuple, jax.Array] = {}
-#: interned device scalars keyed by (dtype, value)
-_SCALAR_CACHE: Dict[tuple, jax.Array] = {}
+#: content-keyed device copies of host constant arrays (LRU order)
+_CONST_CACHE: "OrderedDict[tuple, jax.Array]" = OrderedDict()
+#: interned device scalars keyed by (dtype, value) (LRU order)
+_SCALAR_CACHE: "OrderedDict[tuple, jax.Array]" = OrderedDict()
 
 #: evict the const cache above this many entries (scans are cached on their
-#: host tables, not here; these are small aux/remap arrays)
+#: host tables, not here; these are small aux/remap arrays). Eviction is
+#: LRU one-at-a-time — a wholesale clear() at the cap silently dropped
+#: every WARM scan constant and re-triggered the catastrophic
+#: mid-pipeline uploads PERF.md measured (~0.15s per tiny array on the
+#: tunneled TPU); a hot key must survive cap pressure.
 _CONST_CACHE_CAP = 8192
 
 
@@ -67,11 +74,13 @@ def device_const(arr) -> jax.Array:
     key = _content_key(arr)
     with _LOCK:
         d = _CONST_CACHE.get(key)
+        if d is not None:
+            _CONST_CACHE.move_to_end(key)
     if d is None:
         d = jnp.asarray(arr)
         with _LOCK:
-            if len(_CONST_CACHE) >= _CONST_CACHE_CAP:
-                _CONST_CACHE.clear()
+            while len(_CONST_CACHE) >= _CONST_CACHE_CAP:
+                _CONST_CACHE.popitem(last=False)
             _CONST_CACHE[key] = d
     return d
 
@@ -83,11 +92,13 @@ def device_scalar(value, dtype=np.int32) -> jax.Array:
     key = (dt.str, value)
     with _LOCK:
         d = _SCALAR_CACHE.get(key)
+        if d is not None:
+            _SCALAR_CACHE.move_to_end(key)
     if d is None:
         d = jnp.asarray(np.asarray(value, dtype=dt))
         with _LOCK:
-            if len(_SCALAR_CACHE) >= _CONST_CACHE_CAP:
-                _SCALAR_CACHE.clear()
+            while len(_SCALAR_CACHE) >= _CONST_CACHE_CAP:
+                _SCALAR_CACHE.popitem(last=False)
             _SCALAR_CACHE[key] = d
     return d
 
@@ -145,6 +156,87 @@ def host_fetch_count() -> int:
     return _HOST_FETCHES.n
 
 
+# -- compile accounting ------------------------------------------------------
+
+register_metric("kernelTraces", "count", "ESSENTIAL",
+                "XLA traces (new jit-cache entries): each is a fresh "
+                "trace + lowering + compile — the ~1-2 min cold-shape "
+                "cliff on the TPU backend")
+register_metric("kernelTraceCacheHits", "count", "MODERATE",
+                "dispatches served by an existing jit-cache entry "
+                "(no trace, no compile)")
+register_metric("kernelCompileTime", "timing", "ESSENTIAL",
+                "wall time of dispatches that triggered a new trace "
+                "(trace + lowering + backend compile)")
+register_metric("padWasteRows", "count", "MODERATE",
+                "dead tail rows uploaded to pad batches up to their "
+                "capacity bucket (the price of the bounded kernel set)")
+
+#: the process-wide `compile` scope: serving-latency observability for
+#: shape bucketing + the executable cache (which adds its own counters)
+COMPILE_SCOPE = metric_scope("compile")
+
+
+class _ThreadFloat(threading.local):
+    def __init__(self):
+        self.v = 0.0
+
+
+#: per-thread per-query accumulators (the _ThreadCounter rationale:
+#: queries execute whole on one thread, so per-query deltas stay
+#: correct under concurrent service workers)
+_COMPILE_S = _ThreadFloat()
+_TRACES = _ThreadCounter()
+_PAD_WASTE = _ThreadCounter()
+#: warm-dispatch trace-cache hits accumulate PER THREAD and flush to
+#: the scope once per query (flush_trace_cache_hits) — taking the
+#: process-wide scope lock on every warm dispatch would serialize
+#: concurrent service workers on the hottest path
+_TRACE_HITS = _ThreadCounter()
+
+
+def flush_trace_cache_hits() -> int:
+    """Move this thread's accumulated warm-dispatch counts into the
+    ``compile`` scope (called at query end by the session)."""
+    n = _TRACE_HITS.n
+    _TRACE_HITS.n = 0
+    if n:
+        COMPILE_SCOPE.add("kernelTraceCacheHits", n)
+    return n
+
+
+def count_pad_waste(n: int) -> None:
+    """Record ``n`` dead tail rows padded onto an uploaded batch."""
+    if n <= 0:
+        return
+    _PAD_WASTE.n += n
+    COMPILE_SCOPE.add("padWasteRows", n)
+
+
+def compile_stats() -> Tuple[int, float, int]:
+    """(traces, compile seconds, pad-waste rows) on THIS thread since
+    the last reset — the session snapshots these per query."""
+    return _TRACES.n, _COMPILE_S.v, _PAD_WASTE.n
+
+
+def reset_compile_stats() -> None:
+    _TRACES.n = 0
+    _COMPILE_S.v = 0.0
+    _PAD_WASTE.n = 0
+
+
+def _jit_cache_size(jf) -> Optional[int]:
+    """The jit function's trace-cache entry count, or None when this
+    jax build does not expose it (trace accounting then reports 0).
+    Callers probe capability ONCE per jitted function — raising and
+    swallowing an AttributeError on every dispatch would put exception
+    overhead on the hot path."""
+    try:
+        return jf._cache_size()
+    except Exception:
+        return None
+
+
 # -- dispatch accounting ----------------------------------------------------
 
 _DISPATCHES = _ThreadCounter()
@@ -183,6 +275,11 @@ def tracing() -> bool:
 #: force-synced via a scalar fetch, so entries ~= kernel compute + one RTT)
 DISPATCH_PROFILE: list = []
 
+#: (kernel name, thread name) per counted NEW trace when SRT_TRACE_LOG=1
+#: — identifies which kernel shapes missed the jit caches (e.g. hunting
+#: a cold-compile cliff the executable cache should have absorbed)
+TRACE_LOG: list = []
+
 
 def _sync_result(res):
     from spark_rapids_tpu.shims import get_shim
@@ -196,14 +293,34 @@ def _sync_result(res):
 def tpu_jit(fn, **kwargs):
     """jax.jit that records a dispatch per (non-traced) call — when an
     exec kernel runs inside a whole-plan fused trace (execs/fused.py) it
-    inlines into the outer program and is NOT a dispatch."""
+    inlines into the outer program and is NOT a dispatch. Also feeds the
+    ``compile`` metric scope: a call that grows the jit's trace cache is
+    a new XLA trace (counted, with its wall as kernelCompileTime — the
+    dispatch itself is async, so a cache-hit call returns in
+    microseconds while a tracing call blocks for trace + lowering +
+    backend compile); everything else is a trace-cache hit."""
     import os
+    import time
     jf = jax.jit(fn, **kwargs)
     name = getattr(fn, "__qualname__", getattr(fn, "__name__", "kernel"))
     profile = bool(os.environ.get("SRT_PROFILE_DISPATCH"))
+    trace_log = bool(os.environ.get("SRT_TRACE_LOG"))
 
     from spark_rapids_tpu.obs.spans import TRACER
     from spark_rapids_tpu.runtime.faults import fault_point
+
+    # cache sizes already credited as a trace: two threads dispatching
+    # the same COLD kernel concurrently both observe the cache growing
+    # (one traces, the other blocks on it) — only the first claimant of
+    # a given size counts it, the other records a trace-cache hit.
+    # Attribution is APPROXIMATE under that race: a warm concurrent
+    # dispatch can claim the size first and book a near-zero
+    # kernelCompileTime while the tracing thread books a hit —
+    # process-wide totals stay right, per-query/thread splits may skew.
+    # Exact attribution needs compiler hooks jax does not expose.
+    counted_sizes: set = set()
+    counted_lock = threading.Lock()
+    has_cache_size = _jit_cache_size(jf) is not None
 
     def call(*args, **kw):
         if not _trace_state_clean():
@@ -215,13 +332,30 @@ def tpu_jit(fn, **kwargs):
         # when the tracer is idle
         sp = TRACER.begin(name, "dispatch") if TRACER.enabled else None
         try:
-            if not profile:
-                return jf(*args, **kw)
-            import time
+            before = _jit_cache_size(jf) if has_cache_size else None
             t0 = time.perf_counter()
             res = jf(*args, **kw)
-            _sync_result(res)
-            DISPATCH_PROFILE.append((name, time.perf_counter() - t0))
+            if before is not None:
+                after = _jit_cache_size(jf)
+                grew = after is not None and after > before
+                if grew:
+                    with counted_lock:
+                        grew = after not in counted_sizes
+                        counted_sizes.add(after)
+                if grew:
+                    dt = time.perf_counter() - t0
+                    _TRACES.n += 1
+                    _COMPILE_S.v += dt
+                    COMPILE_SCOPE.add("kernelTraces", 1)
+                    COMPILE_SCOPE.add("kernelCompileTime", dt)
+                    if trace_log:
+                        TRACE_LOG.append(
+                            (name, threading.current_thread().name))
+                else:
+                    _TRACE_HITS.n += 1  # lock-free; flushed per query
+            if profile:
+                _sync_result(res)
+                DISPATCH_PROFILE.append((name, time.perf_counter() - t0))
             return res
         finally:
             TRACER.end(sp)

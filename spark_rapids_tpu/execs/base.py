@@ -105,11 +105,21 @@ class HostToDevice(TpuExec):
 
 
 class DeviceToHost:
-    """Transition: device exec -> host batches (GpuColumnarToRowExec analog)."""
+    """Transition: device exec -> host batches (GpuColumnarToRowExec analog).
+
+    When the session arms ``_async_fetch`` (root transition only,
+    ``spark.rapids.sql.asyncResultFetch``), batches yield as
+    :class:`~spark_rapids_tpu.columnar.table.PendingHostTable` — the
+    packed d2h kernel is ENQUEUED here (still under the device
+    semaphore) and the session completes the round trip after releasing
+    it, so the tunnel latency stops blocking the next admitted query.
+    Mid-plan transitions feeding CPU fallback nodes never arm it."""
 
     def __init__(self, tpu_exec: TpuExec):
         self.tpu_exec = tpu_exec
         self.metrics = MetricSet()
+        #: set per query by the session on the ROOT transition
+        self._async_fetch = False
 
     def output_schema(self):
         return self.tpu_exec.output_schema()
@@ -120,17 +130,24 @@ class DeviceToHost:
         self.metrics.add(key, value, level)
 
     def execute_cpu(self) -> Iterator[HostTable]:
+        from spark_rapids_tpu.columnar.table import PendingHostTable
         from spark_rapids_tpu.runtime.profiler import op_range
         for dt in self.tpu_exec.execute():
             t0 = time.perf_counter()
             with op_range("DeviceToHost", cat="transfer"):
-                host = dt.to_host()
+                out = dt.to_host_pending() if self._async_fetch \
+                    else dt.to_host()
             # incremental so an early-terminating consumer (limit) still
             # leaves accurate numbers; measures ONLY the d2h conversion
+            # (under async fetch: only the ENQUEUE — the fetch itself is
+            # recorded as resultFetchTime by the session's resolver)
             self.add_metric("d2hTime", time.perf_counter() - t0)
             self.add_metric("numOutputBatches", 1)
-            self.add_metric("numOutputRows", host.num_rows)
-            yield host
+            if isinstance(out, PendingHostTable):
+                self.add_metric("asyncFetchBatches", 1)
+            else:
+                self.add_metric("numOutputRows", out.num_rows)
+            yield out
 
     def describe(self):
         return "DeviceToHost"

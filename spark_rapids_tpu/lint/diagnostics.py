@@ -61,6 +61,8 @@ RULES: Dict[str, str] = {
                         "generator output",
     "RA-DOC-DRIFT-CONFIGS": "committed CONFIGS.md differs from the "
                             "generator output",
+    "RA-CONF-ORPHAN": "conf key declared in the registry but never "
+                      "read by the engine or its harnesses",
     "RA-ESSENTIAL-METRICS": "an executed exec failed to emit the "
                             "ESSENTIAL opTime/numOutputRows/"
                             "numOutputBatches metrics after a "

@@ -296,6 +296,87 @@ def audit_exec_metrics(scale_factor: float = 0.005,
     return diags
 
 
+#: declared keys consumed through a mechanism the text scan cannot see,
+#: or seed-era reference-compat placeholders kept so carried-over
+#: reference configs don't fail on unknown keys. Add "key: why"
+#: entries, never bare keys — NEW keys must wire a reader.
+_CONF_ORPHAN_ALLOWLIST: dict = {
+    "spark.rapids.sql.reader.batchSizeRows":
+        "seed placeholder: reference reader-batching knob; scans "
+        "currently batch by bytes only",
+    "spark.rapids.sql.hasNans":
+        "seed placeholder: reference NaN-handling knob; device kernels "
+        "handle NaN unconditionally",
+    "spark.rapids.sql.castStringToTimestamp.enabled":
+        "seed placeholder: reference cast gate; the cast is "
+        "TypeSig-gated instead",
+    "spark.rapids.sql.decimalType.enabled":
+        "seed placeholder: reference decimal master switch; decimals "
+        "gate per-op through TypeSig",
+    "spark.rapids.sql.test.strictOracle":
+        "seed placeholder: CPU-oracle strictness for a planned "
+        "test-harness mode",
+}
+
+
+def _audit_conf_referenced(diags: List[Diagnostic], root: str) -> None:
+    """RA-CONF-ORPHAN: every declared conf key must be CONSUMED by the
+    engine or its harnesses — a key whose ConfEntry variable and key
+    string both appear exactly once (their declaration) was added
+    without wiring a reader, so setting it silently does nothing
+    (the complement of RL-CONF-KEY, which catches references without a
+    declaration). Kill switches are exempt: is_op_enabled reads them
+    generically by name."""
+    import re
+    import sys
+
+    from spark_rapids_tpu.conf import ConfEntry, registry
+
+    sources = []
+    pkg_dir = os.path.join(root, "spark_rapids_tpu")
+    for dirpath, _dirs, names in os.walk(pkg_dir):
+        for n in names:
+            if n.endswith(".py"):
+                sources.append(os.path.join(dirpath, n))
+    for extra in ("bench.py", "scale_test.py"):
+        p = os.path.join(root, extra)
+        if os.path.exists(p):
+            sources.append(p)
+    text = "\n".join(open(p, encoding="utf-8").read() for p in sources)
+
+    #: key -> ConfEntry variable names bound in any engine module
+    var_names: dict = {}
+    for mod_name, mod in list(sys.modules.items()):
+        if not mod_name.startswith("spark_rapids_tpu") or mod is None:
+            continue
+        for attr, val in list(vars(mod).items()):
+            if isinstance(val, ConfEntry):
+                var_names.setdefault(val.key, set()).add(attr)
+
+    for key, entry in registry().items():
+        parts = key.split(".")
+        if (len(parts) == 5 and parts[:3] == ["spark", "rapids", "sql"]
+                and parts[3] in ("exec", "expression")):
+            continue  # kill switches: read generically by class name
+        if key in _CONF_ORPHAN_ALLOWLIST:
+            continue
+        # boundary-aware: 'a.b' must not match inside 'a.b.c' — a key
+        # that is a dotted prefix of another declared key would
+        # otherwise count its sibling's declaration as a reference
+        key_uses = len(re.findall(re.escape(key) + r"(?![.\w])", text))
+        name_uses = sum(
+            len(re.findall(rf"\b{re.escape(n)}\b", text))
+            for n in var_names.get(key, ()))
+        # one key-string occurrence (the declaration) + one occurrence
+        # per variable binding (assignment/import) is declaration-only
+        if key_uses <= 1 and name_uses <= len(var_names.get(key, ())):
+            diags.append(make(
+                "RA-CONF-ORPHAN", key,
+                "conf key is declared but never read — wire a consumer "
+                "or remove it (allowlist with a justification if it is "
+                "consumed through a mechanism this scan cannot see)"))
+
+
 def audit_registry(repo_root: Optional[str] = None) -> List[Diagnostic]:
     _import_full_package()
     diags: List[Diagnostic] = []
@@ -304,4 +385,5 @@ def audit_registry(repo_root: Optional[str] = None) -> List[Diagnostic]:
     _audit_kill_switches(diags)
     _audit_sql_exposure(diags)
     _audit_doc_drift(diags, _repo_root(repo_root))
+    _audit_conf_referenced(diags, _repo_root(repo_root))
     return diags

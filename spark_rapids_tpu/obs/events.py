@@ -44,7 +44,15 @@ EVENT_LOG_DIR = str_conf(
 #: offline tools key off this.
 #: v2 (query service PR): + tenant, pool, queueWaitS, cacheHit fields
 #: (null/false for queries executed outside the service).
-EVENT_SCHEMA_VERSION = 2
+#: v3 (serving-latency PR): + compileMs (wall spent on new XLA traces:
+#: trace + lowering + backend compile; 0.0 on fully warm queries),
+#: executableCacheHit (the query checked out a cached converted
+#: executable — false outside the cache paths / when disabled), and
+#: padWasteRows (dead tail rows uploaded to pad batches to their
+#: capacity buckets; 0 when every batch landed exactly on a bucket).
+#: Result-cache-served replays carry compileMs=0.0,
+#: executableCacheHit=false, padWasteRows=0 (nothing executed).
+EVENT_SCHEMA_VERSION = 3
 
 
 def plan_tree(executable) -> dict:
@@ -150,7 +158,10 @@ def build_query_record(*, query_index: int, wall_s: float,
                        demotions: Dict[str, str],
                        spans_summary: Optional[dict],
                        fault_replays: int,
-                       service: Optional[dict] = None) -> dict:
+                       service: Optional[dict] = None,
+                       compile_ms: float = 0.0,
+                       executable_cache_hit: bool = False,
+                       pad_waste_rows: int = 0) -> dict:
     """Assemble one event-log record. Every field is JSON-native; the
     golden schema test normalizes timings and pins the shape.
     ``service`` is the query-service envelope (tenant, pool, queueWaitS,
@@ -170,6 +181,9 @@ def build_query_record(*, query_index: int, wall_s: float,
         "wallS": round(wall_s, 6),
         "phasesS": {k: round(v, 6) for k, v in sorted(phases.items())},
         "dispatches": dispatches,
+        "compileMs": round(float(compile_ms), 3),
+        "executableCacheHit": bool(executable_cache_hit),
+        "padWasteRows": int(pad_waste_rows),
         "faultReplays": fault_replays,
         "plan": plan_tree(executable),
         "fallbacks": collect_fallbacks(meta),

@@ -180,7 +180,15 @@ register_metric("numOutputBatches", "count", "ESSENTIAL",
                 "batches this operator produced")
 register_metric("d2hTime", "timing", "ESSENTIAL",
                 "device->host conversion time at the DeviceToHost "
-                "transition")
+                "transition (under async result fetch: the kernel "
+                "ENQUEUE only — the fetch is resultFetchTime)")
+register_metric("resultFetchTime", "timing", "ESSENTIAL",
+                "async d2h completion time for the root transition's "
+                "packed result buffers, paid AFTER the device "
+                "semaphore released")
+register_metric("asyncFetchBatches", "count", "MODERATE",
+                "result batches whose download was enqueued under the "
+                "semaphore and completed asynchronously after release")
 register_metric("h2dTime", "timing", "ESSENTIAL",
                 "host->device upload time at the HostToDevice "
                 "transition")

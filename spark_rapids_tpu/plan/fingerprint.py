@@ -1,0 +1,288 @@
+"""Canonical structural plan fingerprinting (shared by both caches).
+
+One implementation, two variants:
+
+* **full** (``strip_literals=False``) — every non-child attribute of
+  every plan node and expression folds in, INCLUDING literal values.
+  This is the result-cache key (service/result_cache.py): two plans
+  differing in any literal compute different tables and must never
+  collide.
+* **template** (``strip_literals=True``) — ``Literal`` expression
+  nodes contribute only their dtype and null-ness, so distinct-literal
+  variants of one query template (``price > 5`` vs ``price > 6``)
+  share a fingerprint. This is the executable-cache grouping key
+  (plan/executable_cache.py): kernels are keyed structurally
+  (``Expression.key``), so template-mates share every compiled program
+  whose key is literal-value-free (string-literal predicates, joins,
+  aggregates, all shape-dependent kernels); numeric literal values
+  trace as XLA constants and keep per-value programs for the
+  expressions that contain them.
+
+The two keys diverge EXACTLY on literal values (pinned by
+tests/test_serving_latency.py): any other difference changes both.
+
+Correctness over hit rate, everywhere: anything the walk cannot PROVE
+structurally stable (a UDF closure, an unknown object with an
+address-y repr) raises :class:`Unfingerprintable` and the caller
+treats the plan as uncacheable — a miss, never a wrong hit.
+
+The warehouse invalidation epoch lives here too (it versions the
+state BOTH caches key against): every catalog mutation, WriteFiles
+execution, or Delta/Iceberg commit bumps it; cache entries remember
+the epoch they were filled under and stale entries drop on lookup.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import weakref
+from typing import Optional, Tuple
+
+# ---------------------------------------------------------------------------
+# Invalidation epoch
+# ---------------------------------------------------------------------------
+
+_EPOCH_LOCK = threading.Lock()
+_EPOCH = [0]
+_EPOCH_REASON = [""]
+
+
+def invalidation_epoch() -> int:
+    with _EPOCH_LOCK:
+        return _EPOCH[0]
+
+
+def bump_invalidation_epoch(reason: str = "") -> int:
+    """Storage/catalog state changed (temp-view or table registration,
+    WriteFiles, Delta/Iceberg commit): every currently cached result —
+    and every cached executable whose scans may now read different
+    bytes — is stale. Called by the session's write detection, the SQL
+    catalog's mutators, and the Delta log's commit path."""
+    with _EPOCH_LOCK:
+        _EPOCH[0] += 1
+        _EPOCH_REASON[0] = reason
+        return _EPOCH[0]
+
+
+# ---------------------------------------------------------------------------
+# Plan fingerprinting
+# ---------------------------------------------------------------------------
+
+
+class Unfingerprintable(Exception):
+    """Internal: the plan holds state the fingerprinter cannot prove
+    structurally stable. The query runs uncached."""
+
+
+#: lazily resolved (datetime, np, T, HostTable, Expression, PlanNode,
+#: Literal) — module-level import would pull the whole plan layer at
+#: package import; resolving on first fingerprint keeps the module
+#: importable standalone while the hot path pays one tuple unpack
+_FP_TYPES = None
+
+
+#: conf key prefixes that cannot change a query's RESULT — observability
+#: and service knobs are excluded from the result-cache fingerprint so
+#: flipping the event log on does not cold the cache. Everything else
+#: folds in.
+RESULT_NEUTRAL_PREFIXES = (
+    "spark.rapids.sql.eventLog.",
+    "spark.rapids.trace.",
+    "spark.rapids.profile.",
+    "spark.rapids.sql.metrics.level",
+    "spark.rapids.sql.lore.",
+    "spark.rapids.sql.explain",
+    "spark.rapids.sql.planVerify.mode",
+    "spark.rapids.service.",
+    # fetch mechanics only — the root transition's flag is re-set per
+    # query, results and the converted tree are byte-identical
+    "spark.rapids.sql.asyncResultFetch",
+    "spark.rapids.sql.executableCache.",
+)
+
+#: conf key prefixes that cannot change the CONVERTED EXECUTABLE. A
+#: strict subset of the result-neutral set: lore dump ids rewrite the
+#: tree (_TeeChild wrappers) and planVerify.mode decides whether the
+#: tree was proven, so both fold into the executable-cache key even
+#: though they cannot change results.
+EXECUTABLE_NEUTRAL_PREFIXES = (
+    "spark.rapids.sql.eventLog.",
+    "spark.rapids.trace.",
+    "spark.rapids.profile.",
+    "spark.rapids.sql.metrics.level",
+    "spark.rapids.sql.explain",
+    "spark.rapids.service.",
+    "spark.rapids.sql.asyncResultFetch",
+    "spark.rapids.sql.executableCache.",
+)
+
+#: identity tokens for in-memory source tables: a HostTable object IS
+#: its data (tables are immutable after construction), so identity is a
+#: sound cache key — and the weak keying means a collected table can
+#: never alias a new one's token
+_TABLE_TOKENS: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+_TABLE_TOKEN_LOCK = threading.Lock()
+_TABLE_TOKEN_SEQ = [0]
+
+
+def _table_token(table) -> str:
+    with _TABLE_TOKEN_LOCK:
+        tok = _TABLE_TOKENS.get(table)
+        if tok is None:
+            _TABLE_TOKEN_SEQ[0] += 1
+            tok = f"tbl#{_TABLE_TOKEN_SEQ[0]}"
+            _TABLE_TOKENS[table] = tok
+        return tok
+
+
+def _resolve_types():
+    global _FP_TYPES
+    if _FP_TYPES is None:
+        import datetime
+
+        import numpy as np
+
+        from spark_rapids_tpu import types as T
+        from spark_rapids_tpu.columnar import HostTable
+        from spark_rapids_tpu.ops.expr import Expression, Literal
+        from spark_rapids_tpu.plan.nodes import PlanNode
+        _FP_TYPES = (datetime, np, T, HostTable, Expression, PlanNode,
+                     Literal)
+    return _FP_TYPES
+
+
+def _fp_value(obj, depth: int = 0, strip_literals: bool = False) -> str:
+    """One value's canonical token. Raises Unfingerprintable for
+    anything that cannot be proven stable."""
+    # deferred-but-cached: fingerprinting runs on the service's submit
+    # hot path, once per attribute of every plan node — resolve the
+    # type anchors once per process, not per call
+    datetime, np, T, HostTable, Expression, PlanNode, Literal = \
+        _resolve_types()
+
+    if depth > 64:
+        raise Unfingerprintable("plan too deep to fingerprint")
+    if obj is None or isinstance(obj, (bool, int, float, str, bytes)):
+        return f"{type(obj).__name__}:{obj!r}"
+    if isinstance(obj, (datetime.date, datetime.datetime)):
+        return f"dt:{obj.isoformat()}"
+    if isinstance(obj, T.DataType):
+        return f"type:{obj}"
+    if isinstance(obj, HostTable):
+        return _fp_value_table(obj)
+    if isinstance(obj, (Expression, PlanNode)) or \
+            type(obj).__module__.startswith("spark_rapids_tpu."):
+        # generic structural walk over instance state — plan nodes,
+        # expressions, and plain engine data holders (SortOrder,
+        # WindowSpec, ...). Unlike .key() (which drops string literal
+        # VALUES because the compile cache doesn't need them) or
+        # __repr__ (which some subclasses leave at the children-only
+        # default), this captures EVERY non-child attribute, so two
+        # nodes differing in any parameter can never collide; state the
+        # walk cannot prove stable (closures, device arrays) raises
+        # Unfingerprintable and the plan just never caches
+        return _fp_node(obj, depth + 1, strip_literals)
+    if isinstance(obj, np.generic):
+        return f"np:{obj.dtype}:{obj!r}"
+    if isinstance(obj, np.ndarray):
+        if obj.dtype == object:
+            raise Unfingerprintable("object ndarray in plan state")
+        return (f"nd:{obj.dtype}:{obj.shape}:"
+                f"{hashlib.sha1(np.ascontiguousarray(obj).tobytes()).hexdigest()}")
+    if isinstance(obj, dict):
+        items = sorted((str(k), _fp_value(v, depth + 1, strip_literals))
+                       for k, v in obj.items())
+        return "dict{" + ",".join(f"{k}={v}" for k, v in items) + "}"
+    if isinstance(obj, (list, tuple)):
+        return ("seq[" +
+                ",".join(_fp_value(v, depth + 1, strip_literals)
+                         for v in obj) + "]")
+    if isinstance(obj, (set, frozenset)):
+        return ("set{" +
+                ",".join(sorted(_fp_value(v, depth + 1, strip_literals)
+                                for v in obj)) +
+                "}")
+    raise Unfingerprintable(
+        f"{type(obj).__name__} in plan state is not fingerprintable")
+
+
+def _fp_value_table(table) -> str:
+    return f"table:{_table_token(table)}"
+
+
+#: per-node attributes that never affect results (caches, back-refs;
+#: the session conf folds into the fingerprint separately)
+_SKIP_ATTRS = {"_session", "_table", "conf", "_conf"}
+
+
+def _fp_node(node, depth: int = 0, strip_literals: bool = False) -> str:
+    """Canonical token of one plan node or expression: class name +
+    every non-child attribute's token (sorted by name) + children in
+    order. With ``strip_literals``, a ``Literal`` contributes only its
+    dtype and null-ness — the one place the template and full
+    fingerprints are allowed to differ."""
+    Literal = _resolve_types()[6]
+    if strip_literals and isinstance(node, Literal):
+        return (f"(Literal;dtype=type:{node.data_type};"
+                f"null={node.value is None})[]")
+    parts = [type(node).__name__]
+    try:
+        state = vars(node)
+    except TypeError:  # __slots__ object; nothing generic to prove
+        raise Unfingerprintable(
+            f"{type(node).__name__} has no inspectable state")
+    for name in sorted(state):
+        if name in _SKIP_ATTRS or name == "children":
+            continue
+        value = state[name]
+        if callable(value) and not isinstance(value, type):
+            raise Unfingerprintable(
+                f"{type(node).__name__}.{name} holds a callable")
+        parts.append(
+            f"{name}={_fp_value(value, depth + 1, strip_literals)}")
+    kids = ",".join(_fp_node(c, depth + 1, strip_literals)
+                    for c in getattr(node, "children", ()))
+    return "(" + ";".join(parts) + ")[" + kids + "]"
+
+
+def fingerprint(plan, conf, *, strip_literals: bool = False,
+                neutral_prefixes: Tuple[str, ...] = RESULT_NEUTRAL_PREFIXES,
+                ) -> Optional[str]:
+    """Canonical fingerprint of (bound plan, result-affecting conf), or
+    None when the plan is uncacheable (side-effecting WriteFiles nodes,
+    UDF closures, unfingerprintable state)."""
+    from spark_rapids_tpu.plan.nodes import WriteFiles
+
+    stack = [plan]
+    while stack:
+        n = stack.pop()
+        if isinstance(n, WriteFiles):
+            return None  # side effects never cache
+        stack.extend(getattr(n, "children", ()))
+    try:
+        plan_tok = _fp_node(plan, 0, strip_literals)
+    except Unfingerprintable:
+        return None
+    conf_items = sorted(
+        (k, str(v)) for k, v in conf.to_dict().items()
+        if not any(k.startswith(p) or k == p.rstrip(".")
+                   for p in neutral_prefixes))
+    h = hashlib.sha1()
+    h.update(plan_tok.encode())
+    h.update(repr(conf_items).encode())
+    return h.hexdigest()
+
+
+def plan_fingerprints(plan, conf) -> Tuple[Optional[str], Optional[str]]:
+    """(template_fp, full_fp) for the executable cache: the template is
+    literal-stripped and conf-reduced to executable-affecting keys; the
+    full print distinguishes literal variants within the template.
+    (None, None) for uncacheable plans."""
+    template = fingerprint(plan, conf, strip_literals=True,
+                           neutral_prefixes=EXECUTABLE_NEUTRAL_PREFIXES)
+    if template is None:
+        return None, None
+    full = fingerprint(plan, conf, strip_literals=False,
+                       neutral_prefixes=EXECUTABLE_NEUTRAL_PREFIXES)
+    return template, full

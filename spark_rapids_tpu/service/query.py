@@ -94,12 +94,23 @@ class cancel_scope:
         return False
 
 
-def _cancellable(fn, scope: CancelScope):
+def _cancellable(fn):
+    """The boundary reads the ACTIVE scope from the contextvar at every
+    pull instead of closing over one: executable trees are cached and
+    reused across queries (plan/executable_cache.py), so a wrapper
+    installed for query A must check query B's scope when B reuses the
+    tree — and must check nothing at all for a query running without a
+    scope (a stale closed-over scope whose deadline passed would time
+    out every future reuse)."""
     def wrapped(*args, **kwargs):
-        scope.check()
+        scope = _SCOPE.get()
+        if scope is not None:
+            scope.check()
         it = fn(*args, **kwargs)
         while True:
-            scope.check()   # between batches: the cooperative point
+            scope = _SCOPE.get()
+            if scope is not None:
+                scope.check()   # between batches: the cooperative point
             try:
                 batch = next(it)
             except StopIteration:
@@ -109,12 +120,16 @@ def _cancellable(fn, scope: CancelScope):
     return wrapped
 
 
-def install_cancellation(executable, scope: CancelScope) -> None:
+def install_cancellation(executable,
+                         scope: Optional[CancelScope] = None) -> None:
     """Wrap every device exec's execute()/execute_masked() (and the
-    DeviceToHost root's execute_cpu) with a pre-pull ``scope.check()``.
-    Installed per query AFTER fault guards and observation, so a
-    cancellation raise is never misattributed as an operator crash and
-    never counted as operator time. Idempotent per exec instance."""
+    DeviceToHost root's execute_cpu) with a pre-pull check of the
+    executing thread's ACTIVE cancel scope (``scope`` is accepted for
+    call-site compatibility but the wrapper always resolves the scope
+    dynamically — see _cancellable). Installed per query AFTER fault
+    guards and observation, so a cancellation raise is never
+    misattributed as an operator crash and never counted as operator
+    time. Idempotent per exec instance."""
     from spark_rapids_tpu.execs.base import DeviceToHost, TpuExec
     from spark_rapids_tpu.lore import _iter_tree
     for e in _iter_tree(executable):
@@ -122,11 +137,11 @@ def install_cancellation(executable, scope: CancelScope) -> None:
             continue
         if isinstance(e, TpuExec):
             e._cancel_installed = True
-            e.execute = _cancellable(e.execute, scope)
-            e.execute_masked = _cancellable(e.execute_masked, scope)
+            e.execute = _cancellable(e.execute)
+            e.execute_masked = _cancellable(e.execute_masked)
         elif isinstance(e, DeviceToHost):
             e._cancel_installed = True
-            e.execute_cpu = _cancellable(e.execute_cpu, scope)
+            e.execute_cpu = _cancellable(e.execute_cpu)
 
 
 class QueryHandle:

@@ -28,13 +28,22 @@ registry's ``resultCache`` scope.
 
 from __future__ import annotations
 
-import hashlib
 import threading
-import weakref
 from collections import OrderedDict
 from typing import Optional
 
 from spark_rapids_tpu.obs.metrics import metric_scope, register_metric
+from spark_rapids_tpu.plan.fingerprint import (  # noqa: F401  (re-exports:
+    # the fingerprint machinery moved to plan/fingerprint.py so the
+    # executable cache keys off the SAME implementation; historical
+    # import sites — delta/log.py, sql/catalog.py, session.py, tests —
+    # keep resolving through this module)
+    RESULT_NEUTRAL_PREFIXES as _RESULT_NEUTRAL_PREFIXES,
+    Unfingerprintable,
+    bump_invalidation_epoch,
+    fingerprint,
+    invalidation_epoch,
+)
 
 register_metric("resultCacheHits", "count", "ESSENTIAL",
                 "service queries served from the plan-fingerprint cache")
@@ -47,202 +56,6 @@ register_metric("resultCacheInvalidations", "count", "ESSENTIAL",
                 "stale entries dropped on lookup after an epoch bump")
 register_metric("resultCacheBytes", "bytes", "MODERATE",
                 "bytes currently held by the result cache")
-
-
-# ---------------------------------------------------------------------------
-# Invalidation epoch
-# ---------------------------------------------------------------------------
-
-_EPOCH_LOCK = threading.Lock()
-_EPOCH = [0]
-_EPOCH_REASON = [""]
-
-
-def invalidation_epoch() -> int:
-    with _EPOCH_LOCK:
-        return _EPOCH[0]
-
-
-def bump_invalidation_epoch(reason: str = "") -> int:
-    """Storage/catalog state changed (temp-view or table registration,
-    WriteFiles, Delta/Iceberg commit): every currently cached result is
-    stale. Called by the session's write detection, the SQL catalog's
-    mutators, and the Delta log's commit path."""
-    with _EPOCH_LOCK:
-        _EPOCH[0] += 1
-        _EPOCH_REASON[0] = reason
-        return _EPOCH[0]
-
-
-# ---------------------------------------------------------------------------
-# Plan fingerprinting
-# ---------------------------------------------------------------------------
-
-
-class Unfingerprintable(Exception):
-    """Internal: the plan holds state the fingerprinter cannot prove
-    structurally stable. The query runs uncached."""
-
-
-#: lazily resolved (datetime, np, T, HostTable, Expression, PlanNode) —
-#: module-level import would pull the whole plan layer at package
-#: import; resolving on first fingerprint keeps service importable
-#: standalone while the hot path pays one tuple unpack per call
-_FP_TYPES = None
-
-
-#: conf key prefixes that cannot change a query's RESULT — observability
-#: and service knobs are excluded from the fingerprint so flipping the
-#: event log on does not cold the cache. Everything else folds in.
-_RESULT_NEUTRAL_PREFIXES = (
-    "spark.rapids.sql.eventLog.",
-    "spark.rapids.trace.",
-    "spark.rapids.profile.",
-    "spark.rapids.sql.metrics.level",
-    "spark.rapids.sql.lore.",
-    "spark.rapids.sql.explain",
-    "spark.rapids.sql.planVerify.mode",
-    "spark.rapids.service.",
-)
-
-#: identity tokens for in-memory source tables: a HostTable object IS
-#: its data (tables are immutable after construction), so identity is a
-#: sound cache key — and the weak keying means a collected table can
-#: never alias a new one's token
-_TABLE_TOKENS: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
-_TABLE_TOKEN_LOCK = threading.Lock()
-_TABLE_TOKEN_SEQ = [0]
-
-
-def _table_token(table) -> str:
-    with _TABLE_TOKEN_LOCK:
-        tok = _TABLE_TOKENS.get(table)
-        if tok is None:
-            _TABLE_TOKEN_SEQ[0] += 1
-            tok = f"tbl#{_TABLE_TOKEN_SEQ[0]}"
-            _TABLE_TOKENS[table] = tok
-        return tok
-
-
-def _fp_value(obj, depth: int = 0) -> str:
-    """One value's canonical token. Raises Unfingerprintable for
-    anything that cannot be proven stable."""
-    # deferred-but-cached: fingerprinting runs on the service's submit
-    # hot path, once per attribute of every plan node — resolve the
-    # type anchors once per process, not per call
-    global _FP_TYPES
-    if _FP_TYPES is None:
-        import datetime
-
-        import numpy as np
-
-        from spark_rapids_tpu import types as T
-        from spark_rapids_tpu.columnar import HostTable
-        from spark_rapids_tpu.ops.expr import Expression
-        from spark_rapids_tpu.plan.nodes import PlanNode
-        _FP_TYPES = (datetime, np, T, HostTable, Expression, PlanNode)
-    datetime, np, T, HostTable, Expression, PlanNode = _FP_TYPES
-
-    if depth > 64:
-        raise Unfingerprintable("plan too deep to fingerprint")
-    if obj is None or isinstance(obj, (bool, int, float, str, bytes)):
-        return f"{type(obj).__name__}:{obj!r}"
-    if isinstance(obj, (datetime.date, datetime.datetime)):
-        return f"dt:{obj.isoformat()}"
-    if isinstance(obj, T.DataType):
-        return f"type:{obj}"
-    if isinstance(obj, HostTable):
-        return _fp_value_table(obj)
-    if isinstance(obj, (Expression, PlanNode)) or \
-            type(obj).__module__.startswith("spark_rapids_tpu."):
-        # generic structural walk over instance state — plan nodes,
-        # expressions, and plain engine data holders (SortOrder,
-        # WindowSpec, ...). Unlike .key() (which drops string literal
-        # VALUES because the compile cache doesn't need them) or
-        # __repr__ (which some subclasses leave at the children-only
-        # default), this captures EVERY non-child attribute, so two
-        # nodes differing in any parameter can never collide; state the
-        # walk cannot prove stable (closures, device arrays) raises
-        # Unfingerprintable and the plan just never caches
-        return _fp_node(obj, depth + 1)
-    if isinstance(obj, np.generic):
-        return f"np:{obj.dtype}:{obj!r}"
-    if isinstance(obj, np.ndarray):
-        if obj.dtype == object:
-            raise Unfingerprintable("object ndarray in plan state")
-        return (f"nd:{obj.dtype}:{obj.shape}:"
-                f"{hashlib.sha1(np.ascontiguousarray(obj).tobytes()).hexdigest()}")
-    if isinstance(obj, dict):
-        items = sorted((str(k), _fp_value(v, depth + 1))
-                       for k, v in obj.items())
-        return "dict{" + ",".join(f"{k}={v}" for k, v in items) + "}"
-    if isinstance(obj, (list, tuple)):
-        return ("seq[" +
-                ",".join(_fp_value(v, depth + 1) for v in obj) + "]")
-    if isinstance(obj, (set, frozenset)):
-        return ("set{" +
-                ",".join(sorted(_fp_value(v, depth + 1) for v in obj)) +
-                "}")
-    raise Unfingerprintable(
-        f"{type(obj).__name__} in plan state is not fingerprintable")
-
-
-def _fp_value_table(table) -> str:
-    return f"table:{_table_token(table)}"
-
-
-#: per-node attributes that never affect results (caches, back-refs;
-#: the session conf folds into the fingerprint separately)
-_SKIP_ATTRS = {"_session", "_table", "conf", "_conf"}
-
-
-def _fp_node(node, depth: int = 0) -> str:
-    """Canonical token of one plan node or expression: class name +
-    every non-child attribute's token (sorted by name) + children in
-    order."""
-    parts = [type(node).__name__]
-    try:
-        state = vars(node)
-    except TypeError:  # __slots__ object; nothing generic to prove
-        raise Unfingerprintable(
-            f"{type(node).__name__} has no inspectable state")
-    for name in sorted(state):
-        if name in _SKIP_ATTRS or name == "children":
-            continue
-        value = state[name]
-        if callable(value) and not isinstance(value, type):
-            raise Unfingerprintable(
-                f"{type(node).__name__}.{name} holds a callable")
-        parts.append(f"{name}={_fp_value(value, depth + 1)}")
-    kids = ",".join(_fp_node(c, depth + 1)
-                    for c in getattr(node, "children", ()))
-    return "(" + ";".join(parts) + ")[" + kids + "]"
-
-
-def fingerprint(plan, conf) -> Optional[str]:
-    """Canonical fingerprint of (bound plan, result-affecting conf), or
-    None when the plan is uncacheable (side-effecting WriteFiles nodes,
-    UDF closures, unfingerprintable state)."""
-    from spark_rapids_tpu.plan.nodes import WriteFiles
-
-    stack = [plan]
-    while stack:
-        n = stack.pop()
-        if isinstance(n, WriteFiles):
-            return None  # side effects never cache
-        stack.extend(getattr(n, "children", ()))
-    try:
-        plan_tok = _fp_node(plan)
-    except Unfingerprintable:
-        return None
-    conf_items = sorted(
-        (k, str(v)) for k, v in conf.to_dict().items()
-        if not any(k.startswith(p) or k == p.rstrip(".")
-                   for p in _RESULT_NEUTRAL_PREFIXES))
-    h = hashlib.sha1()
-    h.update(plan_tok.encode())
-    h.update(repr(conf_items).encode())
-    return h.hexdigest()
 
 
 # ---------------------------------------------------------------------------

@@ -566,6 +566,11 @@ class QueryService:
             "pool": handle.pool,
             "queueWaitS": round(handle.queue_wait_s or 0.0, 6),
             "cacheHit": True,
+            # nothing executed on a result-cache serve: the filling
+            # run's compile/bucket numbers must not replay as traffic
+            "compileMs": 0.0,
+            "executableCacheHit": False,
+            "padWasteRows": 0,
         })
         handle.event_record = rec
         try:

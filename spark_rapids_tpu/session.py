@@ -26,7 +26,9 @@ class _TLQueryState:
 
     __slots__ = ("exec_depth", "next_tag", "next_sql", "next_service",
                  "meta", "phases", "executable", "dispatches",
-                 "fault_replays", "event_record", "event_path")
+                 "fault_replays", "event_record", "event_path",
+                 "exec_cache_token", "exec_cache_hit", "compile_ms",
+                 "pad_waste")
 
     def __init__(self):
         self.exec_depth = 0
@@ -40,6 +42,10 @@ class _TLQueryState:
         self.fault_replays = None
         self.event_record = None
         self.event_path = None
+        self.exec_cache_token = None
+        self.exec_cache_hit = None
+        self.compile_ms = None
+        self.pad_waste = None
 
 
 def _tl_mirrored(tls_field: str, doc: str):
@@ -105,6 +111,15 @@ class TpuSession:
         "event_record", "event-log record of the last query")
     last_event_path = _tl_mirrored(
         "event_path", "event-log path of the last query")
+    last_executable_cache_hit = _tl_mirrored(
+        "exec_cache_hit", "did the last query check out a cached "
+        "converted executable (plan/executable_cache.py)?")
+    last_compile_ms = _tl_mirrored(
+        "compile_ms", "milliseconds the last query spent on new XLA "
+        "traces (trace + lowering + backend compile)")
+    last_pad_waste_rows = _tl_mirrored(
+        "pad_waste", "dead tail rows the last query uploaded to pad "
+        "batches up to their capacity buckets")
 
     def __init__(self, conf: Optional[Dict] = None):
         self.conf = RapidsConf(conf)
@@ -308,6 +323,9 @@ class TpuSession:
         except BaseException:
             if obs_active:
                 TRACER.end_query()
+            # a failed run may have left the checked-out tree partially
+            # drained — drop the entry, never hand it to another query
+            self._release_exec_cache(drop=True)
             raise
         finally:
             q.exec_depth = 0
@@ -318,6 +336,7 @@ class TpuSession:
             # cached results over its paths are stale either way
             self._invalidate_result_cache_on_write(plan)
         if not obs_active:
+            self._release_exec_cache()
             return result
         wall_s = _time.perf_counter() - t0
         spans = TRACER.end_query()
@@ -358,8 +377,14 @@ class TpuSession:
             spans_summary=summarize_spans(spans, ctx.owner_tid, wall_s),
             fault_replays=int(q.fault_replays or 0),
             service=service_info,
+            compile_ms=float(q.compile_ms or 0.0),
+            executable_cache_hit=bool(q.exec_cache_hit),
+            pad_waste_rows=int(q.pad_waste or 0),
         )
         self.last_event_record = record
+        # the record has read the tree's metrics — the cached executable
+        # may now be handed to the next query (which resets them)
+        self._release_exec_cache()
         # emission is best-effort: an unwritable log dir or full disk
         # must not fail a query that already computed its result
         try:
@@ -391,6 +416,16 @@ class TpuSession:
         path = self._event_writer.write(record)
         self.last_event_path = path
         return path
+
+    def _release_exec_cache(self, drop: bool = False) -> None:
+        """Return this thread's checked-out executable-cache entry (if
+        any). Called once the query's envelope is fully done with the
+        tree — after the event record on observed queries — or with
+        ``drop`` when the run failed and the tree's state is suspect."""
+        tok = self._q.exec_cache_token
+        self._q.exec_cache_token = None
+        if tok is not None:
+            tok.release(drop=drop)
 
     def _invalidate_result_cache_on_write(self, plan: P.PlanNode) -> None:
         """A completed write (WriteFiles / Delta / Iceberg commands ride
@@ -456,6 +491,14 @@ class TpuSession:
                 op = getattr(exc, "fault_op", None)
                 if op is not None:
                     F.CIRCUIT_BREAKER.record_failure(op, exc, max_failures)
+                # the crashed attempt's cached/filled executable is
+                # suspect AND a recorded demotion must re-plan — drop
+                # the entry so the replay converts fresh. TOP LEVEL
+                # only: a nested execute's recovery (depth >= 2) holds
+                # no token of its own and must not release the OUTER
+                # query's mid-run
+                if self._q.exec_depth == 1:
+                    self._release_exec_cache(drop=True)
                 replays += 1
                 F.RECOVERY.bump("query_replays")
 
@@ -483,36 +526,73 @@ class TpuSession:
             TEST_INJECT_RETRY_OOM,
         )
         from spark_rapids_tpu.obs.spans import TRACER
-        from spark_rapids_tpu.runtime import RMM_TPU, TpuSemaphore, acquired
+        from spark_rapids_tpu.runtime import RMM_TPU, TpuSemaphore
         from spark_rapids_tpu.runtime.retry import MAX_RETRIES_VAR
 
         from spark_rapids_tpu.overrides.input_file import \
             rewrite_input_file_exprs
         plan = rewrite_input_file_exprs(plan)
-        executable, meta = apply_overrides(plan, self.conf)
-        self._last_meta = meta
-        if meta is not None and self.conf.explain_mode in ("NOT_ON_GPU", "ALL"):
-            print(meta.explain(only_fallback=self.conf.explain_mode == "NOT_ON_GPU"))
 
-        # static plan verification (lint/plan_verifier): prove the
-        # converted tree's cross-layer invariants BEFORE execution
-        # (Catalyst validatePlan / assert-on-fallback analog)
-        from spark_rapids_tpu.conf import PLAN_VERIFY_MODE
-        verify_mode = str(self.conf.get_entry(PLAN_VERIFY_MODE)).lower()
-        if verify_mode not in ("off", "warn", "error"):
-            from spark_rapids_tpu.errors import ColumnarProcessingError
-            raise ColumnarProcessingError(
-                f"spark.rapids.sql.planVerify.mode must be off, warn or "
-                f"error, got {verify_mode!r}")
-        if verify_mode in ("warn", "error") and meta is not None:
-            from spark_rapids_tpu.lint.plan_verifier import verify_converted
-            diags = verify_converted(executable, meta, self.conf)
-            if diags:
-                from spark_rapids_tpu.errors import PlanVerificationError
-                if verify_mode == "error":
-                    raise PlanVerificationError(diags)
-                for d in diags:
-                    print(f"planVerify: {d}")
+        # plan -> executable cache (plan/executable_cache.py): a
+        # repeated template checks out its already-converted (and
+        # already-verified: planVerify.mode folds into the fingerprint)
+        # tree — no overrides run, no verification, and every kernel
+        # already traced. Top-level queries only; a replayed attempt
+        # dropped its entry in _execute_with_recovery and plans fresh
+        # so circuit-breaker demotions take effect.
+        q = self._q
+        from spark_rapids_tpu.conf import (
+            EXECUTABLE_CACHE_ENABLED,
+            EXECUTABLE_CACHE_MAX_PLANS,
+            EXECUTABLE_CACHE_MAX_VARIANTS,
+        )
+        tok = None
+        if q.exec_depth == 1 and \
+                bool(self.conf.get_entry(EXECUTABLE_CACHE_ENABLED)):
+            from spark_rapids_tpu.plan.executable_cache import EXEC_CACHE
+            EXEC_CACHE.configure(
+                int(self.conf.get_entry(EXECUTABLE_CACHE_MAX_PLANS)),
+                int(self.conf.get_entry(EXECUTABLE_CACHE_MAX_VARIANTS)))
+            tok = EXEC_CACHE.checkout(plan, self.conf)
+            q.exec_cache_token = tok
+        if q.exec_depth == 1:
+            # top level only: a nested execute (cached-relation /
+            # broadcast materialization) must not clobber the OUTER
+            # query's hit flag
+            self.last_executable_cache_hit = bool(
+                tok is not None and tok.hit)
+
+        if tok is not None and tok.hit:
+            executable, meta = tok.executable, tok.meta
+        else:
+            executable, meta = apply_overrides(plan, self.conf)
+        self._last_meta = meta
+        if meta is not None and self.conf.explain_mode in ("NOT_ON_GPU",
+                                                           "ALL"):
+            print(meta.explain(
+                only_fallback=self.conf.explain_mode == "NOT_ON_GPU"))
+
+        if tok is None or not tok.hit:
+            # static plan verification (lint/plan_verifier): prove the
+            # converted tree's cross-layer invariants BEFORE execution
+            # (Catalyst validatePlan / assert-on-fallback analog)
+            from spark_rapids_tpu.conf import PLAN_VERIFY_MODE
+            verify_mode = str(self.conf.get_entry(PLAN_VERIFY_MODE)).lower()
+            if verify_mode not in ("off", "warn", "error"):
+                from spark_rapids_tpu.errors import ColumnarProcessingError
+                raise ColumnarProcessingError(
+                    f"spark.rapids.sql.planVerify.mode must be off, warn or "
+                    f"error, got {verify_mode!r}")
+            if verify_mode in ("warn", "error") and meta is not None:
+                from spark_rapids_tpu.lint.plan_verifier import \
+                    verify_converted
+                diags = verify_converted(executable, meta, self.conf)
+                if diags:
+                    from spark_rapids_tpu.errors import PlanVerificationError
+                    if verify_mode == "error":
+                        raise PlanVerificationError(diags)
+                    for d in diags:
+                        print(f"planVerify: {d}")
 
         from spark_rapids_tpu.conf import METRICS_LEVEL
         from spark_rapids_tpu.execs.base import set_metrics_level
@@ -523,9 +603,15 @@ class TpuSession:
         reset_nondeterministic_streams()
 
         # LORE: number every operator; arm input dumping for tagged ids
-        from spark_rapids_tpu import lore
-        lore.assign_lore_ids(executable)
-        lore.install_dumpers(executable, self.conf)
+        # — FRESH trees only. A cached tree keeps the ids and _TeeChild
+        # dumpers it was filled with (lore conf folds into the
+        # executable fingerprint, so they match this query's conf);
+        # re-numbering would shift ids across inserted dumper nodes and
+        # install_dumpers is not idempotent (wrappers would stack)
+        if tok is None or not tok.hit:
+            from spark_rapids_tpu import lore
+            lore.assign_lore_ids(executable)
+            lore.install_dumpers(executable, self.conf)
         # fault boundaries: the exec.execute injection point + op
         # attribution for non-OOM device failures (circuit breaker input)
         from spark_rapids_tpu.runtime.faults import install_fault_boundaries
@@ -536,16 +622,13 @@ class TpuSession:
         from spark_rapids_tpu.obs.spans import install_observation
         install_observation(executable)
         # cancellation boundaries OUTERMOST (third wrapper in the
-        # install_fault_boundaries family): when this query runs under a
-        # service cancel scope, handle.cancel() / deadline expiry raise
-        # between batches at every exec boundary (service/query.py)
-        from spark_rapids_tpu.service.query import (
-            current_cancel_scope,
-            install_cancellation,
-        )
-        scope = current_cancel_scope()
-        if scope is not None:
-            install_cancellation(executable, scope)
+        # install_fault_boundaries family): the boundary resolves the
+        # ACTIVE cancel scope per pull (contextvar), so it is installed
+        # unconditionally — a cached executable filled by a scopeless
+        # query still honors cancel()/deadlines when the query service
+        # reuses it (service/query.py)
+        from spark_rapids_tpu.service.query import install_cancellation
+        install_cancellation(executable)
         self._last_executable = executable
         TRACER.end(plan_span)
         phases = {"planS": _time.perf_counter() - t_phase}
@@ -559,21 +642,38 @@ class TpuSession:
             elif kind.strip().lower() == "split":
                 RMM_TPU.force_split_and_retry_oom(count)
 
+        # async result fetch: arm the ROOT transition only — mid-plan
+        # DeviceToHost nodes feed CPU fallback operators that expect
+        # plain host batches. Re-set either way so a cached executable
+        # never carries a previous query's flag.
+        from spark_rapids_tpu.conf import ASYNC_RESULT_FETCH
+        from spark_rapids_tpu.execs.base import DeviceToHost as _D2H
+        if isinstance(executable, _D2H):
+            executable._async_fetch = bool(
+                self.conf.get_entry(ASYNC_RESULT_FETCH))
+
         # the semaphore gates DEVICE residency: fully-fallen-back plans
         # must not consume a device-concurrency slot
         sem = None
         if _uses_device(executable):
             sem = TpuSemaphore.initialize(self.conf.concurrent_tpu_tasks)
         token = MAX_RETRIES_VAR.set(self.conf.get_entry(RETRY_OOM_MAX_RETRIES))
-        from spark_rapids_tpu.dispatch import dispatch_count, reset_dispatch_count
+        from spark_rapids_tpu.dispatch import (
+            dispatch_count,
+            reset_compile_stats,
+            reset_dispatch_count,
+        )
         reset_dispatch_count()
+        if q.exec_depth == 1:
+            # top level only: a NESTED execute resetting mid-drain
+            # would zero the outer query's trace/pad-waste accounting
+            reset_compile_stats()
         t_phase = _time.perf_counter()
         exec_span = TRACER.begin("execute", "phase") \
             if TRACER.enabled else None
         try:
             with self.profiler.profile_query():
-                with acquired(sem):
-                    batches = self._run_speculative(executable)
+                batches = self._run_speculative(executable, sem)
             # per-query device dispatch count (VERDICT r3: observable)
             self.last_dispatches = dispatch_count()
             if hasattr(executable, "metrics"):
@@ -589,19 +689,46 @@ class TpuSession:
         try:
             if not batches:
                 from spark_rapids_tpu.plan.nodes import _empty_table
-                return _empty_table(plan.output_schema())
-            return HostTable.concat(batches)
+                out = _empty_table(plan.output_schema())
+            else:
+                out = HostTable.concat(batches)
         finally:
             TRACER.end(collect_span)
             phases["collectS"] = _time.perf_counter() - t_phase
+            # compile accounting AFTER collect: the packed d2h kernels
+            # jit during it, and their traces belong to this query
+            # (top level only — a nested execute rides the outer's
+            # counters, mirroring the reset above)
+            if q.exec_depth == 1:
+                from spark_rapids_tpu.dispatch import (
+                    compile_stats,
+                    flush_trace_cache_hits,
+                )
+                traces, compile_s, pad = compile_stats()
+                self.last_compile_ms = round(compile_s * 1000.0, 3)
+                self.last_pad_waste_rows = pad
+                flush_trace_cache_hits()
+        # a fully successful run fills its executable-cache slot (the
+        # entry stays checked out until the query envelope releases it)
+        if tok is not None and not tok.hit:
+            tok.fill(executable, meta)
+        return out
 
-    def _run_speculative(self, executable):
+    def _run_speculative(self, executable, sem=None):
         """Drain the plan under a speculation context (speculative operator
         sizing, validated by the collect's packed fetch). A failed
         speculation blocklists the failing sites process-wide and replays
         once — the replay takes the exact sync-per-operator path there, so
         a repeated query shape never replays twice
-        (runtime/speculation.py)."""
+        (runtime/speculation.py).
+
+        The device semaphore is held around each DRAIN only: with async
+        result fetch the root transition yields enqueued
+        PendingHostTable batches, and their d2h round trips complete
+        AFTER the semaphore releases — the device slot frees as soon as
+        the last kernel is in flight. Resolution stays INSIDE the
+        speculation attempt so a flag failure riding the packed buffer
+        still replays."""
         from spark_rapids_tpu.conf import (
             JOIN_DIRECT_TABLE_MULT,
             MASKED_BATCHES,
@@ -609,7 +736,7 @@ class TpuSession:
         )
         from spark_rapids_tpu.execs.base import MASKED_ENABLED
         from spark_rapids_tpu.execs.join import DIRECT_TABLE_MULT
-        from spark_rapids_tpu.runtime import speculation as spec
+        from spark_rapids_tpu.runtime import acquired, speculation as spec
 
         self._apply_tuning_confs()
         from spark_rapids_tpu.conf import ANSI_ENABLED
@@ -618,16 +745,22 @@ class TpuSession:
         tok_d = DIRECT_TABLE_MULT.set(
             self.conf.get_entry(JOIN_DIRECT_TABLE_MULT))
         tok_a = ANSI_MODE.set(bool(self.conf.get_entry(ANSI_ENABLED)))
+
+        def drain():
+            with acquired(sem):
+                batches = list(executable.execute_cpu())
+            return self._resolve_pending_batches(executable, batches)
+
         try:
             if not self.conf.get_entry(SPECULATIVE_SIZING):
-                return list(executable.execute_cpu())
+                return drain()
             # each failed attempt blocklists its sites, so every replay
             # makes strict progress (a site never fails twice); the cap
             # guards a pathological plan by dropping to the exact path
             for _attempt in range(8):
                 tok = spec.activate()
                 try:
-                    batches = list(executable.execute_cpu())
+                    batches = drain()
                     spec.current().validate_remaining()
                     if _attempt and hasattr(executable, "metrics"):
                         # replays re-execute operators, double-counting
@@ -639,11 +772,34 @@ class TpuSession:
                     spec.blocklist(sf.sites)
                 finally:
                     spec.deactivate(tok)
-            return list(executable.execute_cpu())
+            return drain()
         finally:
             MASKED_ENABLED.reset(tok_m)
             DIRECT_TABLE_MULT.reset(tok_d)
             ANSI_MODE.reset(tok_a)
+
+    def _resolve_pending_batches(self, executable, batches):
+        """Complete enqueued async downloads — the device semaphore is
+        already released; only the tunnel round trip remains. Records
+        resultFetchTime plus the root transition's deferred output-row
+        count (plain HostTable batches pass through untouched)."""
+        from spark_rapids_tpu.columnar.table import PendingHostTable
+        if not any(isinstance(b, PendingHostTable) for b in batches):
+            return batches
+        import time as _time
+        t0 = _time.perf_counter()
+        out = []
+        rows = 0
+        for b in batches:
+            if isinstance(b, PendingHostTable):
+                b = b.resolve()
+                rows += b.num_rows
+            out.append(b)
+        if hasattr(executable, "add_metric"):
+            executable.add_metric("resultFetchTime",
+                                  _time.perf_counter() - t0)
+            executable.add_metric("numOutputRows", rows)
+        return out
 
     def _apply_tuning_confs(self) -> None:
         """Push registry-tunable constants into the modules that consume
@@ -654,6 +810,9 @@ class TpuSession:
         from spark_rapids_tpu.execs import broadcast as B
         from spark_rapids_tpu.ops.collections import Sequence
         get = self.conf.get_entry
+        from spark_rapids_tpu.columnar import column as CCol
+        CCol.set_bucket_policy(str(get(C.SHAPE_BUCKETS)),
+                               int(get(C.SHAPE_BUCKETS_MIN)))
         Sequence.SEQ_ELEMENT_MULT = int(get(C.SEQUENCE_ELEMENT_MULT))
         DeviceTable.EMBED_NROWS_CAP = int(get(C.COLLECT_EMBED_ROWS_CAP))
         DeviceTable.EMBED_MAX_BYTES = int(get(C.COLLECT_EMBED_MAX_BYTES))

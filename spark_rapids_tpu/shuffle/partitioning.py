@@ -46,9 +46,9 @@ class HashPartitioner(Partitioner):
     def __init__(self, keys: Sequence[Expression], num_partitions: int):
         self.keys = list(keys)
         self.num_partitions = num_partitions
-        self._traces = {}
 
     def partition_ids(self, table: DeviceTable):
+        from spark_rapids_tpu.ops.expr import cached_kernel
         key_cols = compile_project(self.keys, table)
         string_bytes = {}
         datas, valids, dts = [], [], []
@@ -61,21 +61,27 @@ class HashPartitioner(Partitioner):
                 string_bytes[i] = (jnp.asarray(mat), jnp.asarray(lens))
 
         n = self.num_partitions
-        tkey = (table.capacity, tuple(str(d) for d in dts),
-                tuple((i, sb[0].shape) for i, sb in string_bytes.items()), n)
-        fn = self._traces.get(tkey)
-        if fn is None:
-            dts_c = list(dts)
+        # PROCESS-WIDE kernel cache keyed by structure: partitioner
+        # instances are per-plan, and a per-instance trace dict made
+        # every fresh conversion of a repeated template re-trace the
+        # partition-id kernel (the VERDICT r1 per-instance-jit bug
+        # class, surfaced by the executable cache's racing misses)
+        tkey = ("hashpart", table.capacity,
+                tuple(str(d) for d in dts),
+                tuple((i, sb[0].shape) for i, sb in string_bytes.items()),
+                n)
+        dts_c = list(dts)
 
+        def build():
             def run(datas, valids, sbytes):
                 cols = [(d, v, dt) for d, v, dt in zip(datas, valids, dts_c)]
                 h = murmur3_hash_device(cols, SPARK_SEED, sbytes)
                 # Spark pmod: ((h % n) + n) % n
                 m = h % jnp.int32(n)
                 return jnp.where(m < 0, m + n, m)
+            return run
 
-            fn = tpu_jit(run)
-            self._traces[tkey] = fn
+        fn = cached_kernel(tkey, build)
         return fn(tuple(datas), tuple(valids), string_bytes)
 
 

@@ -9,8 +9,15 @@ Subcommands:
 * ``compare <A> <B>`` — per-query/per-operator diff of two runs.
 * ``loadtest`` — TPC-H corpus through the concurrent QueryService
   across simulated tenants; reports throughput, p50/p95 latency, queue
-  wait and result-cache hit rate vs the serial baseline, asserting
-  bit-identical results (exit 1 on any divergence).
+  wait, result-cache hit rate, cold/warm latency percentiles and the
+  per-phase compile breakdown (new traces, executable-cache hit rate)
+  vs the serial baseline, asserting bit-identical results (exit 1 on
+  any divergence). ``--warmup-from DIR`` AOT-warms from an event-log
+  corpus first, so the "cold" pass measures warmed-cold latency.
+* ``warmup`` — replay an event-log corpus's distinct plan templates to
+  populate the kernel/executable caches (and the persistent XLA
+  compile cache on device backends) before traffic arrives; reports
+  programs compiled vs skipped.
 
 ``--json`` emits the raw report dict for machines; exit status 2 when a
 profile's span coverage falls below ``--coverage-floor`` (default 0.95)
@@ -71,8 +78,38 @@ def main(argv=None) -> int:
                     help="emit the raw report JSON")
     lt.add_argument("--out", type=str, default="",
                     help="write the report JSON to this file")
+    lt.add_argument("--warmup-from", type=str, default="",
+                    help="AOT-warm from this event-log dir before the "
+                         "serial baseline (tools warmup, in-process)")
+
+    w = sub.add_parser(
+        "warmup",
+        help="AOT precompile: replay an event-log corpus's plan "
+             "templates to populate the compile + executable caches")
+    w.add_argument("--eventlog-dir", type=str, required=True,
+                   help="event-log .jsonl file or directory to replay")
+    w.add_argument("--sf", type=float, default=0.05,
+                   help="datagen scale factor for the replay warehouse "
+                        "(default 0.05)")
+    w.add_argument("--seed", type=int, default=0)
+    w.add_argument("--sql", action="store_true",
+                   help="replay corpus queries in their SQL-text forms")
+    w.add_argument("--json", action="store_true",
+                   help="emit the raw report JSON")
+    w.add_argument("--out", type=str, default="",
+                   help="write the report JSON to this file")
 
     args = ap.parse_args(argv)
+
+    if args.cmd == "warmup":
+        from spark_rapids_tpu.tools.warmup import render_warmup, run_warmup
+        report = run_warmup(args.eventlog_dir, sf=args.sf,
+                            seed=args.seed, use_sql=args.sql)
+        print(json.dumps(report) if args.json else render_warmup(report))
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(report, f, indent=1)
+        return 0 if report["ok"] else 1
 
     if args.cmd == "loadtest":
         from spark_rapids_tpu.tools.loadtest import (
@@ -84,7 +121,8 @@ def main(argv=None) -> int:
             sf=args.sf, seed=args.seed, queries=wanted or None,
             use_sql=args.sql, concurrency=args.concurrency,
             tenants=args.tenants,
-            eventlog_dir=args.eventlog_dir or None)
+            eventlog_dir=args.eventlog_dir or None,
+            warmup_from=args.warmup_from or None)
         print(json.dumps(report) if args.json
               else render_loadtest(report))
         if args.out:

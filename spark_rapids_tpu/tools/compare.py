@@ -109,6 +109,16 @@ def compare_query(a_runs: List[dict], b_runs: List[dict]) -> dict:
         "speedup": round(wall_a / wall_b, 4) if wall_b > 0 else None,
         "aDispatches": a.get("dispatches", 0),
         "bDispatches": b.get("dispatches", 0),
+        # cold-vs-warm compile breakdown (schema v3): SUMS over the
+        # tag's runs — a median would hide the one cold run per tag
+        "aCompileMs": round(sum(float(r.get("compileMs", 0.0))
+                                for r in a_runs), 3),
+        "bCompileMs": round(sum(float(r.get("compileMs", 0.0))
+                                for r in b_runs), 3),
+        "aExecutableCacheHits": sum(
+            1 for r in a_runs if r.get("executableCacheHit")),
+        "bExecutableCacheHits": sum(
+            1 for r in b_runs if r.get("executableCacheHit")),
         "ops": op_diffs,
         "newFallbacks": sorted(set(fb_b) - set(fb_a)),
         "resolvedFallbacks": sorted(set(fb_a) - set(fb_b)),
@@ -122,10 +132,15 @@ def build_compare(path_a: str, path_b: str) -> dict:
     queries = [compare_query(idx_a[k], idx_b[k]) for k in common]
     total_a = round(sum(q["aWallS"] for q in queries), 6)
     total_b = round(sum(q["bWallS"] for q in queries), 6)
+    compile_a = round(sum(q["aCompileMs"] for q in queries), 3)
+    compile_b = round(sum(q["bCompileMs"] for q in queries), 3)
     return {
         "a": path_a,
         "b": path_b,
         "matchedQueries": len(queries),
+        "totalACompileMs": compile_a,
+        "totalBCompileMs": compile_b,
+        "deltaCompileMs": round(compile_b - compile_a, 3),
         "onlyInA": sorted(set(idx_a) - set(idx_b)),
         "onlyInB": sorted(set(idx_b) - set(idx_a)),
         "totalAWallS": total_a,
@@ -146,6 +161,9 @@ def render_compare(cmp: dict, top_n: int = 5) -> str:
     for side, key in (("only in A", "onlyInA"), ("only in B", "onlyInB")):
         if cmp[key]:
             lines.append(f"  {side}: {', '.join(cmp[key])}")
+    lines.append(f"Compile: {cmp['totalACompileMs']:.1f}ms -> "
+                 f"{cmp['totalBCompileMs']:.1f}ms "
+                 f"({cmp['deltaCompileMs']:+.1f}ms)")
     for q in cmp["queries"]:
         arrow = f"{q['aWallS']:.4f}s -> {q['bWallS']:.4f}s"
         sp = f"  ({q['speedup']}x)" if q.get("speedup") else ""

@@ -33,15 +33,33 @@ def _percentile(values: List[float], q: float) -> float:
     return vals[idx]
 
 
+def _scope_delta(before: dict, after: dict) -> dict:
+    out = {}
+    for k, v in after.items():
+        d = v - before.get(k, 0)
+        if d:
+            out[k] = round(d, 4) if isinstance(d, float) else d
+    return out
+
+
 def run_loadtest(sf: float = 0.05, seed: int = 0, queries=None,
                  use_sql: bool = False, concurrency: int = 4,
                  tenants: int = 2, eventlog_dir: Optional[str] = None,
-                 timeout_s: float = 600.0) -> dict:
+                 timeout_s: float = 600.0,
+                 warmup_from: Optional[str] = None) -> dict:
     """Run the loadtest and return the JSON-ready report dict.
     ``report["ok"]`` is False when any result diverged from serial or
-    any submission failed — callers exit non-zero on it."""
+    any submission failed — callers exit non-zero on it.
+
+    ``warmup_from``: an event-log dir to AOT-warm from first
+    (``tools warmup`` in-process, sharing this run's tables/session so
+    the executable cache warms by table identity) — the serial "cold"
+    pass then measures warmed-cold latency; compare coldP95S against a
+    run without warmup to price the warmup."""
+    from spark_rapids_tpu.dispatch import COMPILE_SCOPE
     from spark_rapids_tpu.lint.golden import _load_scale_test
     from spark_rapids_tpu.datagen import scale_test_specs
+    from spark_rapids_tpu.plan.executable_cache import EXEC_CACHE
     from spark_rapids_tpu.service import QueryService
     from spark_rapids_tpu.session import TpuSession
 
@@ -61,6 +79,15 @@ def run_loadtest(sf: float = 0.05, seed: int = 0, queries=None,
 
     # -- serial baseline: cold once + warm for the repeat submissions -------
     serial_session = TpuSession(_conf())
+
+    warmup_report = None
+    if warmup_from:
+        from spark_rapids_tpu.tools.warmup import run_warmup
+        warmup_report = run_warmup(
+            warmup_from, sf=sf, seed=seed, use_sql=use_sql,
+            tables=tables, session=TpuSession())
+
+    scope_t0 = dict(COMPILE_SCOPE)
     serial_queries = build(serial_session, tables)
     wanted = [q for q in (queries or list(serial_queries))]
     expected: Dict[str, object] = {}
@@ -77,6 +104,7 @@ def run_loadtest(sf: float = 0.05, seed: int = 0, queries=None,
         serial_warm[name] = time.perf_counter() - t0
     serial_sum = (sum(serial_cold.values())
                   + (tenants - 1) * sum(serial_warm.values()))
+    scope_serial = dict(COMPILE_SCOPE)
 
     # -- concurrent run through the service ---------------------------------
     n_submissions = len(wanted) * tenants
@@ -102,6 +130,7 @@ def run_loadtest(sf: float = 0.05, seed: int = 0, queries=None,
                     f"{name}@{tenant}: still {h.state} after "
                     f"{timeout_s}s")
     wall = time.perf_counter() - t0
+    scope_conc = dict(COMPILE_SCOPE)
 
     latencies, queue_waits, per_query = [], [], {}
     cache_hits = 0
@@ -123,6 +152,36 @@ def run_loadtest(sf: float = 0.05, seed: int = 0, queries=None,
             "queueWaitS": round(h.queue_wait_s or 0.0, 4),
             "cacheHit": h.cache_hit, "identical": diff is None})
 
+    # compile-breakdown per phase: the serial pass traces every cold
+    # shape (unless warmed); the concurrent pass repeats templates and
+    # must trace NOTHING new — executable-cache hit rate 1.0 on the
+    # queries it executed (result-cache serves never look up)
+    serial_phase = _scope_delta(scope_t0, scope_serial)
+    conc_phase = _scope_delta(scope_serial, scope_conc)
+    conc_lookups = (conc_phase.get("executableCacheHits", 0)
+                    + conc_phase.get("executableCacheMisses", 0))
+    compile_report = {
+        "serialPhase": serial_phase,
+        "concurrentPhase": conc_phase,
+        "repeatPassNewTraces": int(conc_phase.get("kernelTraces", 0)),
+        # exact-tree checkouts / lookups: a burst of one query wider
+        # than the variant's tree pool converts fresh (counted a miss)
+        # but still shares every compiled kernel via its template
+        "executableCacheHitRate": (
+            round(conc_phase.get("executableCacheHits", 0)
+                  / conc_lookups, 4) if conc_lookups else None),
+        # template-known / lookups: the rate that governs TRACING —
+        # 1.0 means no executed query saw an unknown template, so the
+        # repeat pass compiles nothing (repeatPassNewTraces 0)
+        "templateHitRate": (
+            round((conc_phase.get("executableCacheHits", 0)
+                   + conc_phase.get("executableCacheTemplateHits", 0))
+                  / conc_lookups, 4) if conc_lookups else None),
+        "executableCache": EXEC_CACHE.stats(),
+    }
+    cold_vals = list(serial_cold.values())
+    warm_vals = list(serial_warm.values())
+
     report = {
         "mode": "loadtest",
         "scaleFactor": sf,
@@ -135,6 +194,16 @@ def run_loadtest(sf: float = 0.05, seed: int = 0, queries=None,
         "serialSumS": round(serial_sum, 4),
         "serialColdSumS": round(sum(serial_cold.values()), 4),
         "serialWarmSumS": round(sum(serial_warm.values()), 4),
+        "coldP50S": round(_percentile(cold_vals, 0.50), 4)
+        if cold_vals else None,
+        "coldP95S": round(_percentile(cold_vals, 0.95), 4)
+        if cold_vals else None,
+        "warmP50S": round(_percentile(warm_vals, 0.50), 4)
+        if warm_vals else None,
+        "warmP95S": round(_percentile(warm_vals, 0.95), 4)
+        if warm_vals else None,
+        "warmup": warmup_report,
+        "compile": compile_report,
         "speedupVsSerial": round(serial_sum / wall, 3) if wall else None,
         "throughputQps": round(n_submissions / wall, 3) if wall else None,
         "latencyP50S": round(_percentile(latencies, 0.50), 4)
@@ -177,8 +246,20 @@ def render_loadtest(report: dict) -> str:
         f"  queue p50/p95   {report['queueWaitP50S']}s / "
         f"{report['queueWaitP95S']}s",
         f"  cache hit rate  {report['cacheHitRate']}",
+        f"  cold p50/p95    {report['coldP50S']}s / {report['coldP95S']}s"
+        + ("  (AOT-warmed)" if report.get("warmup") else ""),
+        f"  repeat pass     {report['compile']['repeatPassNewTraces']} "
+        f"new traces, executable-cache hit rate "
+        f"{report['compile']['executableCacheHitRate']} "
+        f"(template {report['compile']['templateHitRate']})",
         f"  all identical   {report['allIdentical']}",
     ]
+    if report.get("warmup"):
+        w = report["warmup"]
+        lines.append(
+            f"  warmup          {w['programsCompiled']} compiled / "
+            f"{w['programsSkipped']} skipped in {w['wallS']:.2f}s "
+            f"({w['newTraces']} traces)")
     if report["mismatches"]:
         lines.append("  MISMATCHES:")
         lines += [f"    {m}" for m in report["mismatches"]]

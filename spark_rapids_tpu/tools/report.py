@@ -152,6 +152,9 @@ def analyze_query(rec: dict, top_n: int = 10) -> dict:
         "wallS": round(wall, 6),
         "phasesS": rec.get("phasesS") or {},
         "dispatches": rec.get("dispatches", 0),
+        "compileMs": round(float(rec.get("compileMs", 0.0)), 3),
+        "executableCacheHit": bool(rec.get("executableCacheHit", False)),
+        "padWasteRows": int(rec.get("padWasteRows", 0)),
         "attribution": {
             "attributedS": round(attributed, 6),
             "untrackedS": round(float(spans.get("untrackedS", 0.0)), 6),
@@ -209,10 +212,19 @@ def build_profile(records: Iterable[dict], top_n: int = 10,
             fallback_ops.setdefault(fb["op"], set()).update(fb["reasons"])
     low_coverage = [q["query"] for q in queries
                     if q["attribution"]["coverage"] < coverage_floor]
+    cold = [q["query"] for q in queries if q["compileMs"] > 0]
+    compile_summary = {
+        "totalCompileMs": round(sum(q["compileMs"] for q in queries), 3),
+        "coldQueries": cold,
+        "executableCacheHits": sum(
+            1 for q in queries if q["executableCacheHit"]),
+        "padWasteRows": sum(q["padWasteRows"] for q in queries),
+    }
     return {
         "queryCount": len(queries),
         "cacheHitRecords": cache_hits,
         "totalWallS": total_wall,
+        "compile": compile_summary,
         "minCoverage": round(min((q["attribution"]["coverage"]
                                   for q in queries), default=1.0), 4),
         "coverageFloor": coverage_floor,
@@ -251,6 +263,12 @@ def render_profile(report: dict) -> str:
                  f"{b['transferS']:.4f}s | shuffle {b['shuffleS']:.4f}s | "
                  f"spill {b['spillS']:.4f}s | untracked "
                  f"{b['untrackedS']:.4f}s")
+    c = report["compile"]
+    lines.append(
+        f"Compile: {c['totalCompileMs']:.1f}ms across "
+        f"{len(c['coldQueries'])} cold queries | executable-cache hits "
+        f"{c['executableCacheHits']}/{report['queryCount']} | pad waste "
+        f"{c['padWasteRows']} rows")
     lines.append("")
     lines.append("Top operators by self time:")
     for e in report["topOpsBySelfTime"]:

@@ -1,0 +1,213 @@
+"""AOT warmup: replay an event-log corpus's plan templates before
+traffic arrives.
+
+``python -m spark_rapids_tpu.tools warmup --eventlog-dir DIR`` reads
+the query event logs a previous serving period wrote
+(``spark.rapids.sql.eventLog.*``), reduces them to DISTINCT plans
+(full structural fingerprints — plan/fingerprint.py; literal variants
+each replay, because numeric literal values trace as XLA constants
+and need their own programs), and executes each once over a generated
+warehouse, so that:
+
+* every kernel shape the corpus needs is traced/lowered/compiled into
+  the process-wide kernel caches (and, on non-CPU backends, the
+  PERSISTENT XLA compile cache — the ~1-2 min/shape cold cliff is paid
+  here, not on the first user query);
+* the plan->executable cache holds each template's converted tree;
+* the report says exactly what was compiled vs already warm
+  (programsCompiled / programsSkipped, per-query compileMs).
+
+Replay identity comes from the records two ways, most-specific first:
+
+* ``queryTag`` — harness tags are ``<qname>[@tenant][_serial[_cold]]``;
+  the qname resolves against the TPC-H corpus builders
+  (scale_test.py), which is what ``tools loadtest``/``bench`` traffic
+  records;
+* ``sqlText`` — replayed through ``session.sql`` over the generated
+  tables registered as temp views (arbitrary SQL traffic, as long as
+  it binds against the warehouse).
+
+Records matching neither are reported as unmatched, never silently
+dropped.
+"""
+
+from __future__ import annotations
+
+import re
+import time
+from typing import Dict, List, Optional
+
+#: harness tag suffixes: q3@tenant1, q3_serial_cold, q3_serial, q3_cold
+_TAG_RE = re.compile(r"^(?P<name>[A-Za-z0-9_]+?)"
+                     r"(?:_serial)?(?:_cold)?(?:@[\w-]+)?$")
+
+
+def _corpus_name(tag: Optional[str], known) -> Optional[str]:
+    if not tag:
+        return None
+    m = _TAG_RE.match(tag.split("@")[0])
+    if m and m.group("name") in known:
+        return m.group("name")
+    # tolerate bare q-names with decorations the regex missed
+    base = tag.split("@")[0]
+    for suffix in ("_serial_cold", "_serial", "_cold"):
+        if base.endswith(suffix):
+            base = base[: -len(suffix)]
+    return base if base in known else None
+
+
+def run_warmup(eventlog_dir: str, sf: float = 0.05, seed: int = 0,
+               use_sql: bool = False, tables: Optional[Dict] = None,
+               session=None) -> dict:
+    """Replay the event-log corpus under ``eventlog_dir``; returns the
+    JSON-ready report. ``tables``/``session`` let an in-process caller
+    (``tools loadtest --warmup-from``) warm against ITS warehouse so
+    the executable cache (which keys in-memory tables by identity)
+    warms too; standalone runs generate their own at ``sf``/``seed``
+    and warm the structural kernel caches + the persistent compile
+    cache, which key by shape, not identity."""
+    import spark_rapids_tpu as srt
+    from spark_rapids_tpu.datagen import scale_test_specs
+    from spark_rapids_tpu.dispatch import COMPILE_SCOPE
+    from spark_rapids_tpu.lint.golden import _load_scale_test
+    from spark_rapids_tpu.plan.fingerprint import fingerprint, \
+        EXECUTABLE_NEUTRAL_PREFIXES
+    from spark_rapids_tpu.session import TpuSession
+    from spark_rapids_tpu.tools.report import load_events
+
+    records = load_events(eventlog_dir)
+    st = _load_scale_test()
+    if tables is None:
+        specs = scale_test_specs(sf)
+        tables = {name: spec.generate_table(sf, seed=seed)
+                  for name, spec in specs.items()}
+    if session is None:
+        # replays must not append records into the very corpus they
+        # read (a site conf pointing eventLog at the serving log dir
+        # would otherwise grow it with untagged junk every warmup)
+        session = TpuSession({"spark.rapids.sql.eventLog.enabled":
+                              "false"})
+    build = st.build_sql_queries if use_sql else st.build_queries
+    corpus = build(session, tables)
+
+    # distinct work units out of the record stream, preserving order
+    units: "Dict[str, dict]" = {}
+    unmatched: List[str] = []
+    for rec in records:
+        name = _corpus_name(rec.get("queryTag"), corpus)
+        if name is not None:
+            units.setdefault(f"corpus:{name}", {
+                "kind": "corpus", "name": name})
+            continue
+        sql = rec.get("sqlText")
+        if sql:
+            units.setdefault(f"sql:{sql}", {
+                "kind": "sql", "name": rec.get("queryTag") or
+                f"query_{rec.get('queryIndex')}", "sql": sql})
+            continue
+        unmatched.append(str(rec.get("queryTag") or
+                             f"query_{rec.get('queryIndex')}"))
+
+    # SQL replays bind against the warehouse as temp views
+    if any(u["kind"] == "sql" for u in units.values()):
+        from spark_rapids_tpu.plan import from_host_table
+        for tname, t in tables.items():
+            from_host_table(t, session).create_or_replace_temp_view(tname)
+
+    persistent = bool(srt.ensure_compile_cache())
+    seen_plans = set()
+    queries: List[dict] = []
+    compiled = skipped = failed = 0
+    before_all = dict(COMPILE_SCOPE)
+    t_start = time.perf_counter()
+    for unit in units.values():
+        label = unit["name"]
+        try:
+            if unit["kind"] == "corpus":
+                df = corpus[unit["name"]]()
+            else:
+                df = session.sql(unit["sql"])
+            # dedupe by the FULL fingerprint, not the stripped template:
+            # numeric literal values trace as XLA constants
+            # (Literal.key includes them), so 'price > 5' and
+            # 'price > 6' need separate traces — skipping the second as
+            # a template-duplicate would leave it cold
+            template = fingerprint(
+                df.plan, session.conf, strip_literals=False,
+                neutral_prefixes=EXECUTABLE_NEUTRAL_PREFIXES)
+            if template is not None and template in seen_plans:
+                skipped += 1
+                queries.append({"query": label, "status": "skipped",
+                                "reason": "duplicate plan"})
+                continue
+            before = dict(COMPILE_SCOPE)
+            t0 = time.perf_counter()
+            df.collect_table()
+            wall = time.perf_counter() - t0
+            traces = (COMPILE_SCOPE.get("kernelTraces", 0)
+                      - before.get("kernelTraces", 0))
+            if template is not None:
+                seen_plans.add(template)
+            entry = {
+                "query": label,
+                "status": "compiled" if traces else "warm",
+                "newTraces": int(traces),
+                "compileMs": float(session.last_compile_ms or 0.0),
+                "executableCacheHit":
+                    bool(session.last_executable_cache_hit),
+                "wallS": round(wall, 4),
+            }
+            if traces:
+                compiled += 1
+            else:
+                skipped += 1
+            queries.append(entry)
+        except Exception as exc:  # a bad replay must not stop the rest
+            failed += 1
+            queries.append({"query": label, "status": "failed",
+                            "reason": f"{type(exc).__name__}: {exc}"})
+    delta = {k: COMPILE_SCOPE.get(k, 0) - before_all.get(k, 0)
+             for k in ("kernelTraces", "kernelTraceCacheHits",
+                       "kernelCompileTime", "executableCacheHits",
+                       "executableCacheMisses")}
+    return {
+        "mode": "warmup",
+        "eventlogDir": eventlog_dir,
+        "scaleFactor": sf,
+        "seed": seed,
+        "form": "sql" if use_sql else "dsl",
+        "eventRecords": len(records),
+        "distinctUnits": len(units),
+        "unmatchedRecords": sorted(set(unmatched)),
+        "persistentCompileCache": persistent,
+        "programsCompiled": compiled,
+        "programsSkipped": skipped,
+        "failures": failed,
+        "newTraces": int(delta["kernelTraces"]),
+        "compileSTotal": round(float(delta["kernelCompileTime"]), 4),
+        "wallS": round(time.perf_counter() - t_start, 4),
+        "queries": queries,
+        "ok": failed == 0 and len(units) > 0,
+    }
+
+
+def render_warmup(report: dict) -> str:
+    lines = [
+        f"Warmup: {report['distinctUnits']} distinct templates from "
+        f"{report['eventRecords']} event records "
+        f"({report['eventlogDir']})",
+        f"  programs compiled {report['programsCompiled']}  "
+        f"(skipped {report['programsSkipped']}, "
+        f"failed {report['failures']})",
+        f"  new XLA traces    {report['newTraces']}  "
+        f"({report['compileSTotal']:.2f}s compiling, "
+        f"wall {report['wallS']:.2f}s)",
+        f"  persistent cache  {report['persistentCompileCache']}",
+    ]
+    for q in report["queries"]:
+        if q["status"] == "failed":
+            lines.append(f"    {q['query']}: FAILED {q['reason']}")
+    if report["unmatchedRecords"]:
+        lines.append("  unmatched records: "
+                     + ", ".join(report["unmatchedRecords"][:10]))
+    return "\n".join(lines)

@@ -309,6 +309,29 @@ def test_pv_join_key_type_divergence():
 # ---------------------------------------------------------------------------
 
 
+def test_ra_conf_orphan_unread_key():
+    """RA-CONF-ORPHAN: a declared key no engine source ever reads (by
+    string or by its ConfEntry variable) is flagged; wired keys and the
+    allowlist are not."""
+    from spark_rapids_tpu import conf as C
+    from spark_rapids_tpu.lint.registry_audit import (
+        _audit_conf_referenced,
+        _repo_root,
+    )
+    key = "spark.rapids.sql.test.orphanedProbeKey"
+    C.str_conf(key, "", "negative-test probe: intentionally unread")
+    try:
+        diags = []
+        _audit_conf_referenced(diags, _repo_root(None))
+        hits = _find(diags, "RA-CONF-ORPHAN")
+        assert any(d.path == key for d in hits)
+        # a heavily-wired key is never flagged
+        assert not any(d.path == "spark.rapids.sql.eventLog.enabled"
+                       for d in hits)
+    finally:
+        C._REGISTRY.pop(key, None)
+
+
 def test_ra_unregistered_device_expression():
     import spark_rapids_tpu.ops.math as math_mod
     from spark_rapids_tpu.lint.registry_audit import _audit_unregistered
