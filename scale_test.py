@@ -505,11 +505,24 @@ def chaos_fault_spec(seed: int) -> str:
     ])
 
 
-def chaos_conf(seed: int, faults: bool):
+def service_fault_spec(seed: int) -> str:
+    """Service-level survivability faults (PR 7) — THE schedule both
+    chaos harnesses share (tools/loadtest.py owns it; drift between the
+    two would mean they test different contracts)."""
+    from spark_rapids_tpu.tools.loadtest import service_chaos_spec
+    return service_chaos_spec(seed)
+
+
+def chaos_conf(seed: int, faults: bool, service_faults: bool = False,
+               concurrency: int = 4):
     """Session conf for a chaos (or its fault-free twin) run: the P2P
     shuffle so the full client/server/transport wire path is exercised,
     fast retry backoff, and the circuit breaker armed. The twin differs
-    ONLY in the fault schedule so results are comparable bit-for-bit."""
+    ONLY in the fault schedule so results are comparable bit-for-bit.
+    ``service_faults`` extends the schedule with the service-level
+    points (worker crash / device loss / wedge) plus the shared
+    survivability settings (watchdog hard limit, slots == workers,
+    strike budget — loadtest.service_chaos_settings)."""
     conf = {
         "spark.rapids.shuffle.mode": "P2P",
         "spark.rapids.shuffle.localDeviceSplit.enabled": "false",
@@ -518,7 +531,14 @@ def chaos_conf(seed: int, faults: bool):
         "spark.rapids.sql.runtimeFallback.enabled": "true",
     }
     if faults:
-        conf["spark.rapids.test.faults"] = chaos_fault_spec(seed)
+        spec = chaos_fault_spec(seed)
+        if service_faults:
+            from spark_rapids_tpu.tools.loadtest import (
+                service_chaos_settings,
+            )
+            spec = spec + ";" + service_fault_spec(seed)
+            conf.update(service_chaos_settings(concurrency))
+        conf["spark.rapids.test.faults"] = spec
     return conf
 
 
@@ -560,7 +580,8 @@ CHAOS_BOUNDS = {"fetch_retries": 500, "recomputed_maps": 200,
 
 
 def run_chaos(sf: float = 0.02, seed: int = 7, queries=None,
-              use_sql: bool = False, concurrency: int = 0):
+              use_sql: bool = False, concurrency: int = 0,
+              service_faults: bool = False):
     """Fault-free run, then the seeded-fault run, per query; returns the
     chaos report dict (and raises AssertionError on any divergence or
     bound violation — callers in CI want the failure loud).
@@ -579,19 +600,31 @@ def run_chaos(sf: float = 0.02, seed: int = 7, queries=None,
     )
     from spark_rapids_tpu.session import TpuSession
 
+    # argument sanity BEFORE the (expensive) datagen
+    if service_faults and (not concurrency or concurrency <= 1):
+        raise SystemExit(
+            "--service-faults needs --concurrency > 1 (the service "
+            "points live in the worker/watchdog machinery)")
     specs = scale_test_specs(sf)
     tables = {name: spec.generate_table(sf, seed=seed)
               for name, spec in specs.items()}
     build = build_sql_queries if use_sql else build_queries
 
     baseline = TpuSession(chaos_conf(seed, faults=False))
-    chaotic = TpuSession(chaos_conf(seed, faults=True))
+    chaotic = TpuSession(chaos_conf(seed, faults=True,
+                                    service_faults=service_faults,
+                                    concurrency=concurrency))
     base_queries = build(baseline, tables)
     chaos_queries = build(chaotic, tables)
     wanted = queries or list(base_queries)
 
     report = {"mode": "chaos", "seed": seed, "scale_factor": sf,
-              "fault_spec": chaos_fault_spec(seed), "queries": {}}
+              # the spec ACTUALLY armed (chaos_conf composed it) — not
+              # a rebuilt copy that could drift from it
+              "fault_spec": chaotic.conf.to_dict()[
+                  "spark.rapids.test.faults"],
+              "service_faults": service_faults,
+              "queries": {}}
     failures = []
     # ALL fault-free runs first: each execute() re-arms the registry from
     # its session's conf, and interleaving arm("")/arm(spec) would reset
@@ -602,7 +635,8 @@ def run_chaos(sf: float = 0.02, seed: int = 7, queries=None,
     if concurrency and concurrency > 1:
         return _run_chaos_concurrent(
             report, failures, wanted, expected_tables, base_queries,
-            chaos_queries, chaotic, concurrency)
+            chaos_queries, chaotic, concurrency,
+            service_faults=service_faults)
     for name in wanted:
         expected = expected_tables[name]
         before = RECOVERY.snapshot()
@@ -660,34 +694,67 @@ def run_chaos(sf: float = 0.02, seed: int = 7, queries=None,
 
 def _run_chaos_concurrent(report, failures, wanted, expected_tables,
                           base_queries, chaos_queries, chaotic_session,
-                          concurrency):
+                          concurrency, service_faults=False):
     """Concurrent half of run_chaos: submit the chaotic corpus to a
     QueryService at the requested concurrency across two simulated
     tenants, then verify each result bit-identical to the fault-free
     serial baseline (re-collected through the demoted plan when the
-    circuit breaker fired mid-run, exactly like the serial path)."""
+    circuit breaker fired mid-run, exactly like the serial path).
+
+    With ``service_faults`` the schedule also kills workers, loses the
+    device, and wedges a dispatch: the bar becomes the survivability
+    contract — every submission terminal (no hangs), FINISHED results
+    still bit-identical, non-FINISHED outcomes typed, recovery bounded,
+    and the service back at HEALTHY."""
+    from contextlib import ExitStack
+
     from spark_rapids_tpu.runtime.faults import (
         CIRCUIT_BREAKER,
         FAULTS,
         RECOVERY,
     )
+    from spark_rapids_tpu.runtime.health import HEALTH, QUARANTINE
     from spark_rapids_tpu.service import QueryService
+    from spark_rapids_tpu.tools.loadtest import (
+        _CHAOS_TYPED_ERRORS as typed_ok,
+        drive_health_probes,
+        wedge_stall_env,
+    )
 
     report["concurrency"] = concurrency
     before = RECOVERY.snapshot()
     fires_before = FAULTS.counters()
+    health_before = HEALTH.snapshot()
+    chaos_env = ExitStack()
+    if service_faults:
+        # stall longer than the hard limit so the watchdog provably
+        # fires; the abandoned thread exits on its own afterwards
+        chaos_env.enter_context(wedge_stall_env())
     svc = QueryService(session=chaotic_session,
                        max_concurrent=concurrency,
                        queue_depth=max(len(wanted), 64))
     t0 = time.perf_counter()
     handles = {}
-    with svc:
-        for i, name in enumerate(wanted):
-            handles[name] = svc.submit(chaos_queries[name](),
-                                       tenant=f"t{i % 2}", tag=name)
-        for name, h in handles.items():
-            if not h.wait(timeout=600):
-                failures.append(f"{name}: still {h.state} after 600s")
+    health_probes = 0
+    svc_health = None
+    try:
+        with svc:
+            hung = False
+            for i, name in enumerate(wanted):
+                handles[name] = svc.submit(chaos_queries[name](),
+                                           tenant=f"t{i % 2}", tag=name)
+            for name, h in handles.items():
+                if not h.wait(timeout=600):
+                    hung = True
+                    failures.append(f"{name}: still {h.state} after 600s")
+            # a hung run already failed — waiting out probe timeouts
+            # would only delay the verdict (loadtest guards likewise)
+            if service_faults and not hung:
+                health_probes = drive_health_probes(
+                    svc, chaos_queries[wanted[0]], timeout_s=600)
+            svc_health = svc.health()
+    finally:
+        chaos_env.close()
     report["wall_s"] = round(time.perf_counter() - t0, 4)
     recovery = {k: v - before[k] for k, v in RECOVERY.snapshot().items()}
     report["recovery"] = recovery
@@ -698,6 +765,16 @@ def _run_chaos_concurrent(report, failures, wanted, expected_tables,
     for name, h in handles.items():
         got = h.result_table
         if got is None:
+            if (service_faults
+                    and type(h.error).__name__ in typed_ok):
+                # survivable typed outcome under service faults: the
+                # contract is TERMINAL + typed, not all-finished
+                report["queries"][name] = {
+                    "state": h.state, "identical": None,
+                    "typed_error": f"{type(h.error).__name__}: "
+                                   f"{h.error}",
+                    "requeues": h.requeues}
+                continue
             failures.append(f"{name}: no result ({h.state}: {h.error})")
             report["queries"][name] = {"state": h.state,
                                        "identical": False}
@@ -709,7 +786,8 @@ def _run_chaos_concurrent(report, failures, wanted, expected_tables,
             diff = tables_differ(redo, got)
         entry = {"state": h.state, "identical": diff is None,
                  "latency_s": round(h.latency_s or 0.0, 4),
-                 "queue_wait_s": round(h.queue_wait_s or 0.0, 4)}
+                 "queue_wait_s": round(h.queue_wait_s or 0.0, 4),
+                 "requeues": h.requeues}
         if diff is not None:
             failures.append(f"{name}: {diff}")
         if h.state != "FINISHED":
@@ -723,7 +801,29 @@ def _run_chaos_concurrent(report, failures, wanted, expected_tables,
             failures.append(f"{field}={recovery[field]} exceeds the "
                             f"whole-run chaos bound {total_bound}")
     stats = report["service"]
-    if stats["cancelled"] or stats["timed_out"] or stats["rejected"]:
+    if service_faults:
+        health_after = HEALTH.snapshot()
+        if svc_health is None:
+            svc_health = svc.health()
+        report["survivability"] = {
+            "deviceReinits": health_after["deviceReinits"]
+            - health_before["deviceReinits"],
+            "workersLost": stats["workersLost"],
+            "workersRespawned": stats["workersRespawned"],
+            "requeued": stats["requeued"],
+            "hardTimeouts": stats["hardTimeouts"],
+            "quarantine": QUARANTINE.snapshot(),
+            "healthAtEnd": svc_health,
+            "healthProbes": health_probes,
+        }
+        if svc_health["state"] != "HEALTHY":
+            failures.append(
+                f"service did not return to HEALTHY: {svc_health}")
+        # the watchdog's hard timeouts are EXPECTED under the wedge
+        # fault; cancellations and rejections still are not
+        if stats["cancelled"] or stats["rejected"]:
+            failures.append(f"spurious lifecycle events: {stats}")
+    elif stats["cancelled"] or stats["timed_out"] or stats["rejected"]:
         failures.append(f"spurious lifecycle events: {stats}")
     report["demoted_ops"] = CIRCUIT_BREAKER.demoted_ops()
     report["ok"] = not failures
@@ -781,6 +881,13 @@ def main():
                          "runs concurrently; alone, emits the loadtest "
                          "throughput/latency report vs the serial "
                          "baseline")
+    ap.add_argument("--service-faults", action="store_true",
+                    help="with --chaos --concurrency N: extend the "
+                         "schedule with service-level faults (worker "
+                         "crash, device loss, wedged dispatch) and "
+                         "assert the survivability contract — all "
+                         "terminal, typed failures only, bounded "
+                         "recovery, health back to HEALTHY")
     ap.add_argument("--tenants", type=int, default=2,
                     help="simulated tenants for --concurrency runs")
     args = ap.parse_args()
@@ -790,7 +897,8 @@ def main():
         report = run_chaos(sf=args.sf if args.sf is not None else 0.02,
                            seed=args.seed if args.seed is not None else 7,
                            queries=wanted or None, use_sql=args.sql,
-                           concurrency=args.concurrency)
+                           concurrency=args.concurrency,
+                           service_faults=args.service_faults)
         print(json.dumps(report))
         if args.out:
             with open(args.out, "w") as f:

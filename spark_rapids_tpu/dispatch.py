@@ -326,6 +326,12 @@ def tpu_jit(fn, **kwargs):
         if not _trace_state_clean():
             return jf(*args, **kw)
         fault_point("dispatch.kernel", op=name)
+        # survivability injection (runtime/health.py consumers): a wedge
+        # stalls INSIDE this dispatch (the between-batch cancel check
+        # never runs — watchdog territory); a device loss raises the
+        # fatal error the health monitor recovers from
+        fault_point("dispatch.wedge", op=name)
+        fault_point("device.lost", op=name)
         count_dispatch()
         # host span per dispatch (async: covers enqueue, not device
         # compute — Xprof owns the device timeline); one attribute read

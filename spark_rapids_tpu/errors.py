@@ -110,6 +110,23 @@ class PlanVerificationError(RapidsTpuError):
             "\n".join(f"  {d}" for d in self.diagnostics))
 
 
+class DeviceLostError(RapidsTpuError):
+    """The device (or its PJRT tunnel) was lost mid-query: a fatal
+    non-OOM runtime failure classified by
+    ``runtime.crash_handler.is_fatal_device_error``. RETRYABLE — by the
+    time the caller sees this, the health monitor (runtime/health.py)
+    has already reinitialized the backend and invalidated every cache
+    that referenced dead device state, so a resubmission plans and
+    traces fresh. The query service requeues these automatically."""
+
+
+class WorkerLostError(RapidsTpuError):
+    """The service worker executing this query died (its runner
+    machinery raised outside the query) or was abandoned by the
+    watchdog. The pool respawned a replacement; the query itself was
+    requeued up to its replay budget before this error surfaced."""
+
+
 class SemaphoreTimeoutError(RapidsTpuError, TimeoutError):
     """TpuSemaphore acquisition timed out: ``max_tasks`` queries already
     hold device residency and none released within the caller's timeout.
@@ -139,6 +156,28 @@ class QueryCancelledError(RapidsTpuError):
 class QueryTimeoutError(RapidsTpuError):
     """The query's deadline (submit time + timeout) expired — while
     queued, or cooperatively between batches while running."""
+
+
+class HardTimeoutError(QueryTimeoutError):
+    """The watchdog's HARD wall limit
+    (``spark.rapids.service.hardTimeoutMs``) expired while the query was
+    RUNNING. Distinct from the cooperative deadline: that one fires at
+    exec-boundary batch pulls, so a worker wedged INSIDE a single
+    dispatch never observes it — the watchdog abandons that worker,
+    respawns a replacement, and fails the handle with this error."""
+
+
+class QueryQuarantinedError(RapidsTpuError):
+    """The query's template was quarantined: plans with this structural
+    fingerprint killed workers or the device
+    ``spark.rapids.service.quarantine.maxStrikes`` times, so the service
+    refuses to run it again. Carries ``strikes`` — the recorded strike
+    history (list of reason strings) — so the submitter can see what the
+    template did."""
+
+    def __init__(self, message: str, strikes=None):
+        super().__init__(message)
+        self.strikes = list(strikes or ())
 
 
 class AnsiViolation(RapidsTpuError, ArithmeticError):

@@ -52,7 +52,16 @@ EVENT_LOG_DIR = str_conf(
 #: capacity buckets; 0 when every batch landed exactly on a bucket).
 #: Result-cache-served replays carry compileMs=0.0,
 #: executableCacheHit=false, padWasteRows=0 (nothing executed).
-EVENT_SCHEMA_VERSION = 3
+#: v4 (survivability PR): + healthState (process health at record
+#: time: HEALTHY / DEGRADED / CPU_ONLY — runtime/health.py),
+#: quarantined (the query's template carries poison strikes; false
+#: outside the service), workerRestarts (service workers respawned
+#: during this query's wall) and deviceReinits (backend
+#: reinitializations after device loss during this query's wall) —
+#: the last two are per-record DELTAS of the ``health`` scope, 0 on a
+#: quiet process. Result-cache serves carry 0/0 and the serve-time
+#: healthState.
+EVENT_SCHEMA_VERSION = 4
 
 
 def plan_tree(executable) -> dict:
@@ -161,7 +170,10 @@ def build_query_record(*, query_index: int, wall_s: float,
                        service: Optional[dict] = None,
                        compile_ms: float = 0.0,
                        executable_cache_hit: bool = False,
-                       pad_waste_rows: int = 0) -> dict:
+                       pad_waste_rows: int = 0,
+                       health_state: str = "HEALTHY",
+                       device_reinits: int = 0,
+                       worker_restarts: int = 0) -> dict:
     """Assemble one event-log record. Every field is JSON-native; the
     golden schema test normalizes timings and pins the shape.
     ``service`` is the query-service envelope (tenant, pool, queueWaitS,
@@ -184,6 +196,10 @@ def build_query_record(*, query_index: int, wall_s: float,
         "compileMs": round(float(compile_ms), 3),
         "executableCacheHit": bool(executable_cache_hit),
         "padWasteRows": int(pad_waste_rows),
+        "healthState": str(health_state),
+        "quarantined": bool(service.get("quarantined", False)),
+        "deviceReinits": int(device_reinits),
+        "workerRestarts": int(worker_restarts),
         "faultReplays": fault_replays,
         "plan": plan_tree(executable),
         "fallbacks": collect_fallbacks(meta),

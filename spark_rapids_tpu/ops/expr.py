@@ -637,6 +637,17 @@ def shared_traces(key: tuple) -> dict:
     return _GLOBAL_KERNEL_CACHE.setdefault(key, {})
 
 
+def clear_kernel_caches() -> int:
+    """Drop every structurally-keyed kernel trace and compiled project
+    (device-loss recovery, runtime/health.py): cached jitted callables
+    hold executables and interned constants on the dead backend, so a
+    reinitialized device must trace fresh. Returns entries dropped."""
+    n = len(_GLOBAL_KERNEL_CACHE) + len(_GLOBAL_PROJECT_CACHE._cache)
+    _GLOBAL_KERNEL_CACHE.clear()
+    _GLOBAL_PROJECT_CACHE._cache.clear()
+    return n
+
+
 def compile_project(exprs: Sequence[Expression], table: DeviceTable):
     """Evaluate bound expressions over a device table, returning device
     columns. Compilation is cached globally."""

@@ -957,9 +957,19 @@ class PlanMeta:
         # tagged reason, so explain()/planVerify surface WHY it's on CPU
         from spark_rapids_tpu.conf import RUNTIME_FALLBACK_ENABLED
         from spark_rapids_tpu.runtime.faults import CIRCUIT_BREAKER
+        # device health latch (runtime/health.py): after repeated device
+        # losses the WHOLE device is demoted — every op falls back with
+        # the latch reason, the whole-device analog of the breaker.
+        # Ungated by runtimeFallback.enabled: the latch only forms via
+        # deviceLoss.maxReinits, and once it has, dispatching to the
+        # dead device cannot be the answer.
+        from spark_rapids_tpu.runtime.health import HEALTH
+        cpu_only = HEALTH.cpu_only_reason()
         demoted = CIRCUIT_BREAKER.demotion_reason(type(self.node).__name__)
         if rule is None:
             self.reasons.append(f"exec {self.node.name} is not supported on TPU")
+        elif cpu_only is not None:
+            self.reasons.append(cpu_only)
         elif demoted and self.conf.get_entry(RUNTIME_FALLBACK_ENABLED):
             self.reasons.append(demoted)
         elif not self.conf.is_op_enabled("exec", type(self.node).__name__):
@@ -1126,7 +1136,25 @@ def apply_overrides(plan: P.PlanNode, conf: RapidsConf):
 
 def explain_plan(plan: P.PlanNode, conf: RapidsConf) -> str:
     meta = wrap_plan(plan, conf)
-    return meta.explain(only_fallback=conf.explain_mode != "ALL")
+    out = meta.explain(only_fallback=conf.explain_mode != "ALL")
+    # poison-query quarantine (runtime/health.py): a template with a
+    # strike history is flagged up front. The fingerprint walk only
+    # runs when strikes exist at all — the common (clean) process pays
+    # one snapshot call
+    from spark_rapids_tpu.runtime.health import QUARANTINE
+    if QUARANTINE.snapshot()["strikes"]:
+        from spark_rapids_tpu.plan.fingerprint import template_fingerprint
+        fp = template_fingerprint(plan, conf)
+        quarantined = QUARANTINE.is_quarantined(fp)
+        if quarantined is not None:
+            out = ("!! QUARANTINED template: submissions are rejected "
+                   f"({len(quarantined)} strikes: "
+                   f"{'; '.join(quarantined)})\n" + out)
+        elif QUARANTINE.strike_count(fp):
+            out = (f"! poison suspect: {QUARANTINE.strike_count(fp)} "
+                   "worker/device kill strike(s) recorded against this "
+                   "template\n" + out)
+    return out
 
 
 # Register every expression rule (and its kill switch) at import: the

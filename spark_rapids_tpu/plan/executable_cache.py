@@ -82,8 +82,16 @@ register_metric("executableCacheEvictions", "count", "MODERATE",
 
 
 def _demotions_token() -> tuple:
+    """The coherency component of an entry's generation beyond the
+    warehouse epoch: circuit-breaker demotions reshape the converted
+    tree, and the health monitor's recovery generation bumps per
+    backend reinit (a tree converted against the pre-loss device must
+    never re-park into a post-recovery pool, even though the recovery
+    itself also cleared the cache)."""
     from spark_rapids_tpu.runtime.faults import CIRCUIT_BREAKER
-    return tuple(sorted(CIRCUIT_BREAKER.demoted_ops().items()))
+    from spark_rapids_tpu.runtime.health import HEALTH
+    return (tuple(sorted(CIRCUIT_BREAKER.demoted_ops().items())),
+            HEALTH.generation())
 
 
 def _reset_for_reuse(executable) -> None:
@@ -321,6 +329,23 @@ class ExecutableCache:
         with self._lock:
             self._templates.clear()
 
+    def invalidate_all(self) -> int:
+        """Device-loss recovery (runtime/health.py): every cached tree
+        references the dead backend's state (interned device constants,
+        compiled programs), so the whole cache drops — COUNTED as
+        invalidations, unlike the test-support clear(). Busy trees are
+        simply never returned (release discards on generation
+        mismatch). Returns entries invalidated."""
+        with self._lock:
+            n = sum(len(vv.idle) for v in self._templates.values()
+                    for vv in v.values())
+            self._templates.clear()
+            if n:
+                self.invalidations += n
+        if n:
+            COMPILE_SCOPE.add("executableCacheInvalidations", n)
+        return n
+
     def stats(self) -> dict:
         with self._lock:
             return {
@@ -334,6 +359,9 @@ class ExecutableCache:
                                 self._templates.values()),
                 "idleTrees": sum(
                     len(vv.idle) for v in self._templates.values()
+                    for vv in v.values()),
+                "busyTrees": sum(
+                    vv.busy for v in self._templates.values()
                     for vv in v.values()),
             }
 

@@ -274,13 +274,22 @@ def fingerprint(plan, conf, *, strip_literals: bool = False,
     return h.hexdigest()
 
 
+def template_fingerprint(plan, conf) -> Optional[str]:
+    """THE template key: literal-stripped, executable-neutral-conf
+    fingerprint — what the executable cache groups by and the poison
+    quarantine strikes against. One definition so the scheduler's
+    strike ledger and explain()'s quarantine flag can never key on
+    different fingerprints."""
+    return fingerprint(plan, conf, strip_literals=True,
+                       neutral_prefixes=EXECUTABLE_NEUTRAL_PREFIXES)
+
+
 def plan_fingerprints(plan, conf) -> Tuple[Optional[str], Optional[str]]:
     """(template_fp, full_fp) for the executable cache: the template is
     literal-stripped and conf-reduced to executable-affecting keys; the
     full print distinguishes literal variants within the template.
     (None, None) for uncacheable plans."""
-    template = fingerprint(plan, conf, strip_literals=True,
-                           neutral_prefixes=EXECUTABLE_NEUTRAL_PREFIXES)
+    template = template_fingerprint(plan, conf)
     if template is None:
         return None, None
     full = fingerprint(plan, conf, strip_literals=False,

@@ -38,8 +38,15 @@ EXIT_ON_FATAL = bool_conf(
 
 
 def is_fatal_device_error(exc: BaseException) -> bool:
-    """Fatal = device/runtime failure that is NOT a recoverable OOM."""
+    """Fatal = device/runtime failure that is NOT a recoverable OOM.
+    Distinct from the per-op KernelCrashError class the circuit breaker
+    owns: a fatal error means the DEVICE (or its PJRT tunnel) is gone,
+    so recovery is backend reinitialization (runtime/health.py), not
+    operator demotion."""
+    from spark_rapids_tpu.errors import DeviceLostError
     from spark_rapids_tpu.runtime.retry import is_device_oom
+    if isinstance(exc, DeviceLostError):
+        return True  # already classified (typed injection / re-raise)
     if is_device_oom(exc):
         return False
     name = type(exc).__name__
@@ -113,6 +120,14 @@ def handle_fatal(exc: BaseException, conf: RapidsConf,
         print(f"[spark-rapids-tpu] fatal device error; crash report at "
               f"{path}", file=sys.stderr)
     if bool(conf.get_entry(EXIT_ON_FATAL)):
+        # os._exit skips atexit handlers, so the disk-tier spill files
+        # must be swept HERE — the crash-exit path is exactly where
+        # they used to leak (the catalog's shutdown() never ran)
+        try:
+            from spark_rapids_tpu.runtime.spill import _atexit_spill_sweep
+            _atexit_spill_sweep()
+        except Exception:
+            pass
         sys.stderr.flush()
         os._exit(FATAL_EXIT_CODE)
 

@@ -46,6 +46,10 @@ FAULT_KINDS = (
     "disconnect",  # transport connection drop (ShuffleTransportError; reconnect + retry)
     "corrupt",     # bit-flip a data frame (CRC catches it; refetch recovers)
     "slow",        # slow peer / stall (sleep; exercises timeouts without failing)
+    "wedge",       # long stall INSIDE one dispatch (no exception; the cooperative
+                   # cancel boundary never runs — watchdog hard-timeout territory)
+    "device_lost", # fatal device/tunnel loss (DeviceLostError; health-monitor
+                   # recovery: backend reinit + cache invalidation, NOT the breaker)
 )
 
 #: registered fault points: name -> (module that hosts the call site, doc).
@@ -84,9 +88,26 @@ FAULT_POINTS: Dict[str, tuple] = {
     "io.write.file": (
         "spark_rapids_tpu/io/writer.py",
         "partitioned writer per-file write"),
+    "service.worker_crash": (
+        "spark_rapids_tpu/service/scheduler.py",
+        "service worker runner, after the RUNNING transition and "
+        "before the query executes — an exception here kills the "
+        "WORKER (not the query), exercising respawn + requeue"),
+    "device.lost": (
+        "spark_rapids_tpu/dispatch.py",
+        "before each jitted kernel dispatch; device_lost simulates a "
+        "fatal PJRT/tunnel loss (health-monitor recovery path)"),
+    "dispatch.wedge": (
+        "spark_rapids_tpu/dispatch.py",
+        "before each jitted kernel dispatch; wedge stalls INSIDE the "
+        "dispatch so only the watchdog's hard wall limit can end it"),
 }
 
 _SLOW_SLEEP_S = 0.05
+#: how long a ``wedge`` fault stalls inside one dispatch — longer than
+#: any sane spark.rapids.service.hardTimeoutMs test setting, short
+#: enough that a seeded chaos run still terminates promptly
+_WEDGE_SLEEP_S = 2.0
 
 
 class _ArmedFault:
@@ -236,7 +257,15 @@ class FaultRegistry:
             if a.kind == "disconnect":
                 raise ShuffleTransportError(
                     f"injected transport disconnect at {where}")
-            if a.kind == "slow":
+            if a.kind == "device_lost":
+                from spark_rapids_tpu.errors import DeviceLostError
+                raise DeviceLostError(
+                    f"injected device loss at {where}")
+            if a.kind == "wedge":
+                import os
+                time.sleep(float(os.environ.get("SRT_WEDGE_SLEEP_S",
+                                                _WEDGE_SLEEP_S)))
+            elif a.kind == "slow":
                 time.sleep(_SLOW_SLEEP_S)
             elif a.kind == "corrupt" and data is not None and len(data):
                 buf = bytearray(data)

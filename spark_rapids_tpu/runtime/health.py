@@ -1,0 +1,287 @@
+"""Device health monitor: device-loss recovery + poison-query quarantine.
+
+Reference (SURVEY.md §5): on a fatal CUDA error the reference captures a
+core dump and exits the executor with code 20, trusting Spark's driver
+to reschedule the work on a healthy node. ``runtime/crash_handler.py``
+implements that capture-and-exit half; this module is the RESCHEDULER
+the exit protocol assumes exists — the single-process query service
+(service/scheduler.py) has no Spark driver above it, so recovery from a
+dead device has to happen in-process:
+
+* **Device-loss recovery** — a fatal non-OOM device error
+  (:func:`~spark_rapids_tpu.runtime.crash_handler.is_fatal_device_error`
+  — classified DISTINCTLY from the per-op
+  :class:`~spark_rapids_tpu.errors.KernelCrashError` the PR-3 circuit
+  breaker owns) reinitializes the backend and invalidates every cache
+  that references dead device state: the plan→executable cache (cached
+  trees hold device-resident constants), the structural kernel-trace
+  caches, the interned device const/scalar pools, cached scan device
+  images, and jax's own jit caches. The failing query surfaces a typed
+  RETRYABLE :class:`~spark_rapids_tpu.errors.DeviceLostError`; the
+  query service requeues it against the recovered backend.
+* **CPU-only latch** — after
+  ``spark.rapids.service.deviceLoss.maxReinits`` CONSECUTIVE device
+  losses (no successful query between them) the engine stops trusting
+  the device entirely and latches CPU-only degraded mode: the overrides
+  layer (PlanMeta.tag) falls every operator back with the latch reason,
+  exactly like a circuit-breaker demotion but for the whole device.
+  Serving survives at reduced speed instead of crash-looping.
+* **Poison-query quarantine** — a template fingerprint
+  (plan/fingerprint.py) that kills workers or the device
+  ``spark.rapids.service.quarantine.maxStrikes`` times is quarantined:
+  subsequent submissions are rejected with a typed
+  :class:`~spark_rapids_tpu.errors.QueryQuarantinedError` carrying the
+  strike history, and ``explain()`` flags the template.
+
+Counters live in the unified registry's ``health`` scope so the event
+log diffs them per query like spill/recovery/shuffle.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+from spark_rapids_tpu.conf import int_conf
+from spark_rapids_tpu.obs.metrics import metric_scope, register_metric
+
+DEVICE_LOSS_MAX_REINITS = int_conf(
+    "spark.rapids.service.deviceLoss.maxReinits", 3,
+    "Consecutive device losses (fatal non-OOM device errors with no "
+    "successful query between them) tolerated before the engine stops "
+    "reinitializing the backend and latches CPU-only degraded mode for "
+    "the rest of the process (whole-device analog of the per-op "
+    "runtime circuit breaker).")
+
+QUARANTINE_MAX_STRIKES = int_conf(
+    "spark.rapids.service.quarantine.maxStrikes", 3,
+    "Times one query template (literal-stripped structural "
+    "fingerprint) may kill a service worker or the device before it is "
+    "quarantined: further submissions of the template are rejected "
+    "with QueryQuarantinedError carrying the strike history.")
+
+register_metric("deviceLost", "count", "ESSENTIAL",
+                "fatal device errors observed (each triggers a "
+                "backend reinitialization or the CPU-only latch)")
+register_metric("deviceReinits", "count", "ESSENTIAL",
+                "backend reinitializations after device loss "
+                "(caches invalidated, device re-discovered)")
+register_metric("workersLost", "count", "ESSENTIAL",
+                "service workers that died or were abandoned by the "
+                "watchdog (hard wall-limit breach)")
+register_metric("workersRespawned", "count", "ESSENTIAL",
+                "replacement service workers spawned so pool capacity "
+                "holds through worker loss")
+register_metric("hardTimeouts", "count", "ESSENTIAL",
+                "queries failed by the watchdog's hard wall limit "
+                "(spark.rapids.service.hardTimeoutMs)")
+register_metric("quarantineStrikes", "count", "MODERATE",
+                "worker/device kills recorded against query templates")
+register_metric("quarantinedTemplates", "count", "ESSENTIAL",
+                "query templates currently quarantined")
+
+
+class DeviceHealthMonitor:
+    """Process-wide device health state (the device is shared by every
+    session in the process, like the circuit breaker and the kernel
+    caches). Writes go through the instance lock; the hot-path reads
+    (``cpu_only_reason`` in PlanMeta.tag, ``generation`` in the
+    executable-cache token) are single attribute loads."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics = metric_scope("health")
+        self._consecutive_losses = 0
+        self._reinits = 0
+        self._losses = 0
+        #: read LOCK-FREE on the per-node tag() hot path — a plain
+        #: attribute load of an immutable str/None (latch is one-way
+        #: until reset(), so a torn read cannot un-latch)
+        self._cpu_only_reason: Optional[str] = None
+        #: coherency generation for the executable cache: bumped per
+        #: recovery so a tree checked out across a reinit can neither
+        #: re-park into the fresh pool nor corrupt its busy count
+        self._generation = 0
+
+    # -- hot-path reads ------------------------------------------------------
+    def cpu_only_reason(self) -> Optional[str]:
+        return self._cpu_only_reason
+
+    def generation(self) -> int:
+        return self._generation
+
+    def state(self) -> str:
+        """HEALTHY / DEGRADED / CPU_ONLY from the device's view alone
+        (the query service folds its own worker-loss recency in)."""
+        if self._cpu_only_reason is not None:
+            return "CPU_ONLY"
+        if self._consecutive_losses > 0:
+            return "DEGRADED"
+        return "HEALTHY"
+
+    # -- the recovery protocol -----------------------------------------------
+    def on_device_loss(self, exc: BaseException, conf) -> str:
+        """One observed fatal device error: count it, reinitialize the
+        backend (invalidating every device-referencing cache), and latch
+        CPU-only mode once the consecutive-loss budget is spent. Returns
+        the resulting health state. Serialized — two workers observing
+        the same dead device recover one at a time, and the second
+        recovery is a cheap re-clear of already-empty caches."""
+        max_reinits = int(conf.get_entry(DEVICE_LOSS_MAX_REINITS))
+        with self._lock:
+            self._losses += 1
+            self._consecutive_losses += 1
+            self._generation += 1
+            self._metrics.add("deviceLost", 1)
+            if self._cpu_only_reason is not None:
+                return "CPU_ONLY"
+            if self._consecutive_losses >= max_reinits:
+                self._cpu_only_reason = (
+                    f"device health: CPU-only mode latched after "
+                    f"{self._consecutive_losses} consecutive device "
+                    f"losses (last: {type(exc).__name__}: "
+                    f"{str(exc).splitlines()[0] if str(exc) else ''})")
+                # the dead device's caches still need to go — CPU-only
+                # queries must not resolve stale device constants
+                self._invalidate_device_caches_locked()
+                return "CPU_ONLY"
+            self._reinits += 1
+            self._metrics.add("deviceReinits", 1)
+            self._reinitialize_backend_locked(conf)
+            return "DEGRADED"
+
+    def note_success(self) -> None:
+        """A query completed: the device (or the CPU-only path) works,
+        so the consecutive-loss budget refills."""
+        if self._consecutive_losses:
+            with self._lock:
+                self._consecutive_losses = 0
+
+    def _invalidate_device_caches_locked(self) -> None:
+        """Drop every cache that references device state — cached
+        executables hold device-resident interned constants, kernel
+        traces point at compiled programs on the dead backend, and
+        cached scan images ARE device arrays. Today (pre-PR) these
+        would all be served stale after a reinit."""
+        from spark_rapids_tpu.columnar.table import evict_device_caches
+        from spark_rapids_tpu.dispatch import clear_device_constants
+        from spark_rapids_tpu.ops.expr import clear_kernel_caches
+        from spark_rapids_tpu.plan.executable_cache import EXEC_CACHE
+        EXEC_CACHE.invalidate_all()
+        clear_kernel_caches()
+        clear_device_constants()
+        evict_device_caches()
+        try:
+            import jax
+            jax.clear_caches()
+        except Exception:
+            pass  # recovery must never raise
+
+    def _reinitialize_backend_locked(self, conf) -> None:
+        """Re-run device discovery on the live manager (new PJRT client
+        state picks up here). Best-effort: a reinit that itself fails
+        leaves the next query to fail, bump the consecutive count, and
+        drive toward the CPU-only latch."""
+        self._invalidate_device_caches_locked()
+        try:
+            from spark_rapids_tpu.runtime.device_manager import (
+                TpuDeviceManager,
+            )
+            mgr = TpuDeviceManager.current()
+            if mgr is not None:
+                mgr.initialized = False
+                mgr.initialize()
+        except Exception:
+            pass
+
+    # -- introspection -------------------------------------------------------
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "deviceLost": self._losses,
+                "deviceReinits": self._reinits,
+                "consecutiveLosses": self._consecutive_losses,
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._consecutive_losses = 0
+            self._reinits = 0
+            self._losses = 0
+            self._cpu_only_reason = None
+            self._generation += 1
+
+
+HEALTH = DeviceHealthMonitor()
+
+
+class QuarantineRegistry:
+    """Strike ledger per query TEMPLATE (literal-stripped structural
+    fingerprint): a template that repeatedly kills workers or the
+    device is the prime poison suspect, whatever its literals. Plans
+    too dynamic to fingerprint (UDF closures) cannot be quarantined —
+    they also cannot hit any cache, so each run is independent."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics = metric_scope("health")
+        #: template_fp -> ordered strike reasons
+        self._strikes: Dict[str, List[str]] = {}
+        self._quarantined: Dict[str, List[str]] = {}
+
+    def strike(self, template_fp: Optional[str], reason: str,
+               max_strikes: int) -> bool:
+        """Record one kill against ``template_fp``; returns True when
+        this strike quarantined the template."""
+        if template_fp is None:
+            return False
+        with self._lock:
+            history = self._strikes.setdefault(template_fp, [])
+            history.append(reason)
+            self._metrics.add("quarantineStrikes", 1)
+            if template_fp in self._quarantined:
+                return False
+            if len(history) >= max(1, int(max_strikes)):
+                self._quarantined[template_fp] = list(history)
+                self._metrics.add("quarantinedTemplates", 1)
+                return True
+            return False
+
+    def is_quarantined(self, template_fp: Optional[str]) -> Optional[List[str]]:
+        """The strike history when quarantined, else None."""
+        if template_fp is None:
+            return None
+        with self._lock:
+            history = self._quarantined.get(template_fp)
+            return list(history) if history is not None else None
+
+    def strike_count(self, template_fp: Optional[str]) -> int:
+        if template_fp is None:
+            return 0
+        with self._lock:
+            return len(self._strikes.get(template_fp, ()))
+
+    def history(self, template_fp: Optional[str]) -> List[str]:
+        if template_fp is None:
+            return []
+        with self._lock:
+            return list(self._strikes.get(template_fp, ()))
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "templatesWithStrikes": len(self._strikes),
+                "strikes": sum(len(v) for v in self._strikes.values()),
+                "quarantined": len(self._quarantined),
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            n = len(self._quarantined)
+            self._strikes = {}
+            self._quarantined = {}
+            if n:
+                self._metrics.add("quarantinedTemplates", -n)
+
+
+QUARANTINE = QuarantineRegistry()

@@ -13,12 +13,15 @@ spark.rapids.memory.host.spillStorageSize; overflow goes to disk files."""
 
 from __future__ import annotations
 
+import atexit
+import glob
 import itertools
 import os
 import pickle
 import tempfile
 import threading
 import time
+import weakref
 from typing import Dict, List, Optional
 
 from spark_rapids_tpu.columnar import DeviceTable, HostTable
@@ -108,6 +111,7 @@ class SpillableBatch:
                 self._host = pickle.load(f)
             self._host_bytes = self._host.nbytes()
             os.unlink(self._disk_path)
+            self.catalog._untrack_disk_file(self._disk_path)
             self._disk_path = None
         return self._host
 
@@ -148,12 +152,16 @@ class SpillableBatch:
             if self._host is None or self._pinned:
                 return 0
             freed = self._host_bytes
-            fd, path = tempfile.mkstemp(prefix=f"rapids_spill_{self.id}_",
-                                        suffix=".bin",
-                                        dir=self.catalog.disk_dir)
+            # pid in the name: the atexit prefix sweep must be able to
+            # match THIS process's files only — a shared disk_dir may
+            # hold another live engine process's spill tier
+            fd, path = tempfile.mkstemp(
+                prefix=f"rapids_spill_{os.getpid()}_{self.id}_",
+                suffix=".bin", dir=self.catalog.disk_dir)
             with os.fdopen(fd, "wb") as f:
                 pickle.dump(self._host, f, protocol=pickle.HIGHEST_PROTOCOL)
             self._disk_path = path
+            self.catalog._track_disk_file(path)
             self._host = None
             self._host_bytes = 0
             return freed
@@ -163,8 +171,10 @@ class SpillableBatch:
             self.catalog.unregister(self)
             self._device = None
             self._host = None
-            if self._disk_path and os.path.exists(self._disk_path):
-                os.unlink(self._disk_path)
+            if self._disk_path:
+                self.catalog._untrack_disk_file(self._disk_path)
+                if os.path.exists(self._disk_path):
+                    os.unlink(self._disk_path)
             self._disk_path = None
 
     # context-manager sugar: `with sb.pinned_batch() as dt:`
@@ -201,6 +211,14 @@ class BufferCatalog:
                    "device_spilled_bytes": "spillDeviceBytes",
                    "disk_spilled_bytes": "spillDiskBytes"}
 
+    #: every catalog ever constructed (weak): the atexit sweep walks
+    #: them so disk-tier spill files from reset()-orphaned catalogs are
+    #: removed too, not just the current instance's. Guarded by its OWN
+    #: lock — get()/reset() hold _instance_lock while CONSTRUCTING a
+    #: catalog, so __init__ must not re-take it (non-reentrant)
+    _all_catalogs: "weakref.WeakSet" = weakref.WeakSet()
+    _all_catalogs_lock = threading.Lock()
+
     def __init__(self, host_limit_bytes: int = 2 << 30,
                  disk_dir: Optional[str] = None):
         self._lock = threading.RLock()
@@ -208,14 +226,32 @@ class BufferCatalog:
         self.host_limit_bytes = host_limit_bytes
         self.disk_dir = disk_dir
         self._metrics = metric_scope("spill")
+        #: live disk-tier spill file paths (cleaned on release/unspill;
+        #: whatever survives is removed by shutdown() / the atexit
+        #: sweep — before this PR they leaked on process exit)
+        self._disk_files: set = set()
         self.spill_device_count = 0
         self.spill_disk_count = 0
         self.device_spilled_bytes = 0
         self.disk_spilled_bytes = 0
+        with BufferCatalog._all_catalogs_lock:
+            BufferCatalog._all_catalogs.add(self)
 
     def _bump(self, attr: str, n) -> None:
-        setattr(self, attr, getattr(self, attr) + n)
+        # under the catalog lock: spill paths run from concurrent retry
+        # frameworks and service workers — an unlocked read-modify-write
+        # here loses increments (pinned by the concurrency test)
+        with self._lock:
+            setattr(self, attr, getattr(self, attr) + n)
         self._metrics.add(self._SCOPE_KEYS[attr], n)
+
+    def _track_disk_file(self, path: str) -> None:
+        with self._lock:
+            self._disk_files.add(path)
+
+    def _untrack_disk_file(self, path: str) -> None:
+        with self._lock:
+            self._disk_files.discard(path)
 
     @classmethod
     def get(cls) -> "BufferCatalog":
@@ -315,3 +351,54 @@ class BufferCatalog:
         if freed:
             self._metrics.add("spillTime", time.monotonic() - t0)
         return freed
+
+    # -- teardown -------------------------------------------------------------
+    def shutdown(self) -> int:
+        """Release every registered spillable and remove the disk-tier
+        spill files THIS catalog created (the reference deletes its
+        RapidsDiskStore files on executor shutdown; before this PR ours
+        outlived the process). Tracked files only — another live
+        catalog may share the disk_dir, and its files are its own.
+        Returns files removed."""
+        with self._lock:
+            buffers = list(self._buffers.values())
+        for sb in buffers:
+            sb.release()
+        return self._sweep_disk_files(prefix_sweep=False)
+
+    def _sweep_disk_files(self, prefix_sweep: bool = False) -> int:
+        """Best-effort removal of any still-tracked disk spill file.
+        ``prefix_sweep`` additionally globs a dedicated disk_dir for
+        THIS PROCESS's leftovers (pid-scoped prefix — another live
+        engine process may share the directory, and its spill tier is
+        its own) — the process-exit path only."""
+        with self._lock:
+            paths = list(self._disk_files)
+            self._disk_files.clear()
+            disk_dir = self.disk_dir
+        if prefix_sweep and disk_dir:
+            paths.extend(glob.glob(os.path.join(
+                disk_dir, f"rapids_spill_{os.getpid()}_*.bin")))
+        removed = 0
+        for p in set(paths):
+            try:
+                os.unlink(p)
+                removed += 1
+            except OSError:
+                pass
+        return removed
+
+
+@atexit.register
+def _atexit_spill_sweep() -> None:
+    """Process-exit sweep: whatever disk-tier files survive (releases
+    skipped on a hard teardown path, reset()-orphaned catalogs) are
+    removed so /tmp does not accumulate one generation of spill files
+    per process lifetime."""
+    with BufferCatalog._all_catalogs_lock:
+        catalogs = list(BufferCatalog._all_catalogs)
+    for cat in catalogs:
+        try:
+            cat._sweep_disk_files(prefix_sweep=True)
+        except Exception:
+            pass  # exit paths never raise

@@ -20,6 +20,12 @@ owns the serving layer too:
 * :mod:`spark_rapids_tpu.service.result_cache` — plan-fingerprint LRU
   result cache over ``HostTable`` results, invalidated on catalog
   mutation and table writes.
+* :mod:`spark_rapids_tpu.service.watchdog` — ``WorkerWatchdog``: hard
+  wall limits on RUNNING queries (a worker wedged inside one dispatch
+  never reaches the cooperative deadline's batch boundary), abandoned
+  workers replaced so pool capacity holds, dead-worker liveness
+  backstop. Pairs with :mod:`spark_rapids_tpu.runtime.health` (device
+  loss recovery, CPU-only latch, poison-query quarantine).
 """
 
 from spark_rapids_tpu.service.query import (  # noqa: F401
@@ -29,3 +35,4 @@ from spark_rapids_tpu.service.query import (  # noqa: F401
 )
 from spark_rapids_tpu.service.result_cache import ResultCache  # noqa: F401
 from spark_rapids_tpu.service.scheduler import QueryService  # noqa: F401
+from spark_rapids_tpu.service.watchdog import WorkerWatchdog  # noqa: F401

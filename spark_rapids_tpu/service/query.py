@@ -176,6 +176,17 @@ class QueryHandle:
         self.cache_hit = False
         self.queue_wait_s: Optional[float] = None
         self.event_record: Optional[dict] = None
+        #: literal-stripped structural fingerprint — the quarantine key
+        #: (computed LAZILY by the scheduler: only when the quarantine
+        #: ledger has strikes to check against, or at strike time —
+        #: the clean-process submit path never pays the plan walk).
+        #: None can mean "not computed yet" (_template_fp_done False)
+        #: or "unfingerprintable plan" (True)
+        self.template_fp: Optional[str] = None
+        self._template_fp_done = False
+        #: times the scheduler put this handle BACK in its queue after
+        #: its worker or the device died under it (survivability replay)
+        self.requeues = 0
         #: set by the scheduler so cancel() can pull a QUEUED handle out
         self._service = None
 

@@ -46,9 +46,18 @@ from spark_rapids_tpu.conf import (
 )
 from spark_rapids_tpu.errors import (
     ColumnarProcessingError,
+    DeviceLostError,
     QueryCancelledError,
+    QueryQuarantinedError,
     QueryRejectedError,
     QueryTimeoutError,
+    WorkerLostError,
+)
+from spark_rapids_tpu.runtime.faults import fault_point
+from spark_rapids_tpu.runtime.health import (
+    HEALTH,
+    QUARANTINE,
+    QUARANTINE_MAX_STRIKES,
 )
 from spark_rapids_tpu.service.query import (
     QueryHandle,
@@ -60,6 +69,7 @@ from spark_rapids_tpu.service.result_cache import (
     fingerprint,
     invalidation_epoch,
 )
+from spark_rapids_tpu.service.watchdog import WorkerWatchdog, _Worker
 
 SERVICE_POOLS = str_conf(
     "spark.rapids.service.pools", "default",
@@ -243,15 +253,40 @@ class QueryService:
         self._shutdown = False
         self._recent_run_s: deque = deque(maxlen=32)
         self.counters = {"submitted": 0, "finished": 0, "failed": 0,
-                         "cancelled": 0, "timed_out": 0, "rejected": 0}
+                         "cancelled": 0, "timed_out": 0, "rejected": 0,
+                         "requeued": 0, "quarantineRejected": 0,
+                         "hardTimeouts": 0}
+        # survivability state (runtime/health.py, service/watchdog.py):
+        # worker lifecycle counters, the DEGRADED latch (cleared by
+        # _DEGRADE_CLEAR_SUCCESSES completed queries — event-count
+        # based, so tests and chaos runs are wall-clock free), and the
+        # quarantine strike budget. ALL mutated under _cond.
+        from spark_rapids_tpu.obs.metrics import metric_scope
+        self._health_metrics = metric_scope("health")
+        self._workers_lost = 0
+        self._workers_respawned = 0
+        self._degraded_pending = 0
+        self.quarantine_max_strikes = int(
+            self.conf.get_entry(QUARANTINE_MAX_STRIKES))
+        #: the pool DEGRADED mode sheds first (lowest weight; name
+        #: breaks ties) — None with a single pool (nothing to shed to)
+        self._shed_pool = (min(self.pools,
+                               key=lambda p: (self.pools[p], p))
+                           if len(self.pools) > 1 else None)
 
-        self._workers: List[threading.Thread] = []
-        for i in range(self.max_concurrent):
-            t = threading.Thread(target=self._worker_loop,
-                                 name=f"rapids-svc-worker-{i}",
-                                 daemon=True)
-            t.start()
-            self._workers.append(t)
+        # arm the chaos registry NOW: the service-level fault points
+        # (service.worker_crash) fire in the scheduler BEFORE the first
+        # session.execute would have armed it from the same conf
+        # (re-arming an identical spec later is a no-op by contract)
+        from spark_rapids_tpu.conf import TEST_FAULTS
+        from spark_rapids_tpu.runtime.faults import FAULTS
+        FAULTS.arm(str(self.conf.get_entry(TEST_FAULTS) or ""))
+
+        self._worker_seq = 0
+        self._workers: List[_Worker] = []
+        with self._cond:
+            for _ in range(self.max_concurrent):
+                self._spawn_worker_locked()
         # dedicated deadline sweeper: idle workers sweep too, but when
         # EVERY worker is busy a queued query's deadline must still
         # expire on time (the backpressure signal is useless late)
@@ -259,6 +294,9 @@ class QueryService:
                                          name="rapids-svc-sweeper",
                                          daemon=True)
         self._sweeper.start()
+        # the watchdog: hard wall limits on RUNNING queries + the
+        # dead-worker liveness backstop (service/watchdog.py)
+        self._watchdog = WorkerWatchdog(self)
 
     # -- submission ----------------------------------------------------------
     def submit(self, query, *, tenant: str = "default",
@@ -266,7 +304,10 @@ class QueryService:
                timeout_ms: Optional[int] = None,
                tag: Optional[str] = None) -> QueryHandle:
         """Admit one query. ``query`` is a DataFrame, a PlanNode, or SQL
-        text. Raises QueryRejectedError when the pool queue is full."""
+        text. Raises QueryRejectedError when the pool queue is full (or
+        when DEGRADED mode is shedding this pool's load) and
+        QueryQuarantinedError when the query's template is
+        quarantined."""
         pool = pool if pool is not None else next(iter(self.pools))
         if pool not in self.pools:
             raise ColumnarProcessingError(
@@ -281,10 +322,43 @@ class QueryService:
                              sql_text=sql_text, plan=plan,
                              deadline=deadline)
         handle._service = self
+        # poison-query quarantine (runtime/health.py): templates that
+        # killed workers/the device quarantine.maxStrikes times are
+        # refused outright, with the strike history attached. The
+        # template fingerprint walk runs OUTSIDE the scheduler lock,
+        # and ONLY when something is actually quarantined — the clean
+        # process pays one snapshot call per submit
+        if QUARANTINE.snapshot()["quarantined"]:
+            quarantined = QUARANTINE.is_quarantined(
+                self._template_fp(handle))
+            if quarantined is not None:
+                with self._cond:
+                    self.counters["quarantineRejected"] += 1
+                raise QueryQuarantinedError(
+                    f"query template is quarantined after "
+                    f"{len(quarantined)} worker/device kills; "
+                    "submission refused", strikes=quarantined)
         with self._cond:
             if self._shutdown:
                 raise ColumnarProcessingError(
                     "query service is shut down")
+            # DEGRADED mode sheds the lowest-weight pool's load first:
+            # a service recovering from worker/device loss keeps its
+            # high-weight tenants served and pushes back on the rest.
+            # Forward progress beats the shed (memory-gate precedent):
+            # the DEGRADED latch only pays down as queries FINISH, so
+            # an otherwise-idle service must admit the shed pool — its
+            # completions are the only way back to HEALTHY when no
+            # higher-weight traffic is flowing
+            if (pool == self._shed_pool
+                    and (self._running > 0
+                         or any(self._queued_per_pool.values()))
+                    and self._health_state_locked() == "DEGRADED"):
+                self.counters["rejected"] += 1
+                raise QueryRejectedError(
+                    f"service is DEGRADED; shedding lowest-weight pool "
+                    f"{pool!r} load — retry later",
+                    retry_after_ms=self._retry_after_ms_locked(pool))
             if self._queued_per_pool[pool] >= self.queue_depth:
                 self.counters["rejected"] += 1
                 raise QueryRejectedError(
@@ -314,6 +388,30 @@ class QueryService:
         raise TypeError(
             f"cannot submit {type(query).__name__}; want DataFrame, "
             "PlanNode, or SQL text")
+
+    def _template_fp(self, handle: QueryHandle) -> Optional[str]:
+        """The quarantine key: the handle's literal-stripped structural
+        template (plan/fingerprint.py — PR 6), computed AT MOST ONCE
+        and only when actually needed (a clean process's submit path
+        pays no plan walk). None for plans too dynamic to fingerprint;
+        those cannot be quarantined (each run is structurally unique,
+        so a strike ledger would never match)."""
+        if not handle._template_fp_done:
+            from spark_rapids_tpu.plan.fingerprint import (
+                template_fingerprint,
+            )
+            handle.template_fp = template_fingerprint(handle.plan,
+                                                      self.conf)
+            handle._template_fp_done = True
+        return handle.template_fp
+
+    def _handle_has_strikes(self, handle: QueryHandle) -> bool:
+        """Does this handle's template carry poison strikes? (the v4
+        event-log ``quarantined`` flag). Fingerprint computed only when
+        the ledger has any strikes at all."""
+        if not QUARANTINE.snapshot()["strikes"]:
+            return False
+        return QUARANTINE.strike_count(self._template_fp(handle)) > 0
 
     def _retry_after_ms_locked(self, pool: str) -> int:
         mean_run = (sum(self._recent_run_s) / len(self._recent_run_s)
@@ -443,9 +541,13 @@ class QueryService:
     def _count_event(self, name: str, n: int = 1) -> None:
         """All lifecycle counter bumps funnel here: counters are read
         under the condition lock (stats, retry-after), so every writer
-        must hold it too or concurrent workers lose increments."""
+        must hold it too or concurrent workers lose increments. A
+        completed query also pays down the DEGRADED latch — the
+        service proved it can finish work again."""
         with self._cond:
             self.counters[name] += n
+            if name == "finished" and self._degraded_pending > 0:
+                self._degraded_pending -= 1
 
     def _charge_locked(self, handle: QueryHandle, elapsed_s: float):
         w_t = self.tenant_weights.get(handle.tenant, 1.0)
@@ -466,12 +568,156 @@ class QueryService:
                 self._sweep_expired_locked()
                 self._cond.wait(timeout=self._SWEEP_INTERVAL_S)
 
-    def _worker_loop(self):
+    # -- survivability plumbing (watchdog + health, PR 7) --------------------
+
+    #: times a handle is requeued after its worker/device died under it
+    #: before it fails with the typed error (a bound, not a conf: the
+    #: quarantine strike budget is the operator-facing knob)
+    _DEVICE_LOSS_REPLAYS = 3
+    _WORKER_LOSS_REPLAYS = 3
+    #: completed queries that clear the DEGRADED latch after a
+    #: worker/device loss (event-count based — deterministic in tests)
+    _DEGRADE_CLEAR_SUCCESSES = 2
+
+    def _spawn_worker_locked(self) -> "_Worker":
+        self._worker_seq += 1
+        w = _Worker(f"rapids-svc-worker-{self._worker_seq}")
+        w.thread = threading.Thread(target=self._worker_loop, args=(w,),
+                                    name=w.name, daemon=True)
+        self._workers.append(w)
+        w.thread.start()
+        return w
+
+    def _drop_worker_locked(self, w: "_Worker") -> None:
+        if w in self._workers:
+            self._workers.remove(w)
+
+    def _note_worker_lost_locked(self, w: "_Worker") -> None:
+        """One worker is gone (dead thread or watchdog-abandoned):
+        count it, latch DEGRADED, and spawn a replacement so pool
+        capacity holds. Caller holds the condition lock."""
+        self._drop_worker_locked(w)
+        self._workers_lost += 1
+        self._health_metrics.add("workersLost", 1)
+        self._degraded_pending = self._DEGRADE_CLEAR_SUCCESSES
+        if not self._shutdown:
+            self._spawn_worker_locked()
+            self._workers_respawned += 1
+            self._health_metrics.add("workersRespawned", 1)
+
+    def _strike_locked(self, handle: QueryHandle, reason: str) -> bool:
+        """Record a poison strike against the handle's template
+        (fingerprint computed here on first need); returns True when
+        this strike quarantined it."""
+        return QUARANTINE.strike(self._template_fp(handle), reason,
+                                 self.quarantine_max_strikes)
+
+    def _requeue_locked(self, handle: QueryHandle) -> bool:
+        """Put a handle whose worker/device died under it back at the
+        FRONT of its queue (it already waited once; retrying promptly
+        beats re-joining behind the backlog). Gated on the QUEUED
+        transition: a handle some other path already drove terminal
+        (e.g. the watchdog's hard timeout) must not be re-enqueued —
+        a worker would pop it only to discard it, and the requeued
+        counter the chaos bounds assert against would inflate."""
+        if not handle._transition(QueryState.QUEUED):
+            return False
+        handle.requeues += 1
+        self._activate_locked(handle.pool, handle.tenant)
+        self._queues.setdefault((handle.pool, handle.tenant),
+                                deque()).appendleft(handle)
+        self._queued_per_pool[handle.pool] += 1
+        self.counters["requeued"] += 1
+        self._cond.notify_all()
+        return True
+
+    def _on_worker_death(self, w: "_Worker", handle: QueryHandle,
+                         exc: BaseException) -> None:
+        """The worker's runner machinery raised OUTSIDE the query (the
+        ``service.worker_crash`` chaos point, or something genuinely
+        broken): the thread is about to exit. Correct the pool
+        accounting, respawn, strike the query's template, and requeue
+        the handle — or fail it once its replay budget (or the
+        quarantine budget) is spent."""
+        fail_with = None
+        with self._cond:
+            if not w.lost:
+                # the watchdog may have abandoned this worker already
+                # (hard timeout fired while the runner was dying) — it
+                # then owns both corrections
+                w.lost = True
+                self._running -= 1
+                self._note_worker_lost_locked(w)
+            else:
+                self._drop_worker_locked(w)
+            if not handle.done:
+                quarantined_now = self._strike_locked(
+                    handle, f"worker {w.name} killed by "
+                            f"{type(exc).__name__}: {exc}")
+                blocked = (quarantined_now or QUARANTINE.is_quarantined(
+                    handle.template_fp) is not None)
+                if (not self._shutdown and not blocked
+                        and handle.requeues < self._WORKER_LOSS_REPLAYS
+                        and self._requeue_locked(handle)):
+                    pass
+                elif blocked:
+                    fail_with = QueryQuarantinedError(
+                        "query template quarantined: it killed "
+                        f"{len(QUARANTINE.history(handle.template_fp))}"
+                        " worker(s)/device(s)",
+                        strikes=QUARANTINE.history(handle.template_fp))
+                else:
+                    fail_with = WorkerLostError(
+                        f"worker {w.name} died running this query "
+                        f"({type(exc).__name__}: {exc}); replay budget "
+                        f"spent after {handle.requeues} requeues")
+            self._cond.notify_all()
+        if fail_with is not None:
+            if handle._transition(QueryState.FAILED, error=fail_with):
+                self._count_event("failed")
+
+    def _on_device_lost(self, handle: QueryHandle,
+                        exc: DeviceLostError) -> None:
+        """The device died under this query. The session's recovery
+        (runtime/health.py) already reinitialized the backend and
+        invalidated the device-referencing caches — DeviceLostError is
+        RETRYABLE, so the service replays the query against the
+        recovered backend up to its budget (CPU-only latch included:
+        the replay then plans onto the CPU path and completes)."""
+        fail_with: BaseException = exc
+        with self._cond:
+            self._degraded_pending = self._DEGRADE_CLEAR_SUCCESSES
+            if handle.done:
+                # already terminal (the watchdog's hard timeout beat
+                # this loss to the handle): the device recovery
+                # happened, but there is nothing to strike or replay —
+                # a phantom strike would push an innocent template
+                # toward quarantine
+                return
+            quarantined_now = self._strike_locked(
+                handle, f"device loss during execution: {exc}")
+            blocked = (quarantined_now or QUARANTINE.is_quarantined(
+                handle.template_fp) is not None)
+            if (not self._shutdown and not blocked
+                    and handle.requeues < self._DEVICE_LOSS_REPLAYS
+                    and self._requeue_locked(handle)):
+                return
+            if blocked:
+                fail_with = QueryQuarantinedError(
+                    "query template quarantined: it killed the device "
+                    f"{len(QUARANTINE.history(handle.template_fp))} "
+                    "time(s)",
+                    strikes=QUARANTINE.history(handle.template_fp))
+        if handle._transition(QueryState.FAILED, error=fail_with):
+            self._count_event("failed")
+
+    def _worker_loop(self, w: "_Worker"):
         while True:
             with self._cond:
                 handle = None
                 while handle is None:
-                    if self._shutdown:
+                    if self._shutdown or w.lost:
+                        self._drop_worker_locked(w)
                         return
                     self._sweep_expired_locked()
                     handle = self._pick_locked()
@@ -480,16 +726,41 @@ class QueryService:
                 if not handle._transition(QueryState.ADMITTED):
                     continue  # terminal while queued; take another
                 self._running += 1
+                w.handle = handle
+            died = False
             try:
                 self._run(handle)
+            except BaseException as exc:
+                # the RUNNER died, not the query (_run absorbs query
+                # failures): hand off to the death protocol and exit
+                # this thread — a replacement is already spawned
+                died = True
+                self._on_worker_death(w, handle, exc)
+                return
             finally:
-                with self._cond:
-                    self._running -= 1
-                    self._cond.notify_all()
+                if not died:
+                    with self._cond:
+                        w.handle = None
+                        lost = w.lost
+                        if lost:
+                            # the watchdog abandoned us mid-query and
+                            # already corrected the running count;
+                            # this thread just disappears
+                            self._drop_worker_locked(w)
+                        else:
+                            self._running -= 1
+                        self._cond.notify_all()
+                    if lost:
+                        return
 
     def _run(self, handle: QueryHandle):
         if not handle._transition(QueryState.RUNNING):
             return
+        # RL-FAULT-POINT service.worker_crash: an exception HERE is the
+        # WORKER dying (outside the query's own try), so it propagates
+        # to _worker_loop's death protocol — respawn + requeue, not a
+        # query failure
+        fault_point("service.worker_crash")
         t0 = time.monotonic()
         try:
             # a cancel/deadline that raced the pop must win BEFORE any
@@ -520,6 +791,7 @@ class QueryService:
                     "pool": handle.pool,
                     "queueWaitS": round(handle.queue_wait_s or 0.0, 6),
                     "cacheHit": False,
+                    "quarantined": self._handle_has_strikes(handle),
                 }
                 table = self.session.execute(handle.plan)
             # raw thread-local read: THIS query's record or None, never
@@ -536,6 +808,11 @@ class QueryService:
         except QueryTimeoutError as exc:
             if handle._transition(QueryState.TIMED_OUT, error=exc):
                 self._count_event("timed_out")
+        except DeviceLostError as exc:
+            # retryable by contract: the backend already recovered
+            # (runtime/health.py) — requeue against it, or fail typed
+            # once the replay/quarantine budget is spent
+            self._on_device_lost(handle, exc)
         except BaseException as exc:
             if handle._transition(QueryState.FAILED, error=exc):
                 self._count_event("failed")
@@ -571,6 +848,12 @@ class QueryService:
             "compileMs": 0.0,
             "executableCacheHit": False,
             "padWasteRows": 0,
+            # v4 survivability fields at SERVE time (the filling run's
+            # health deltas must not replay either)
+            "healthState": HEALTH.state(),
+            "quarantined": self._handle_has_strikes(handle),
+            "deviceReinits": 0,
+            "workerRestarts": 0,
         })
         handle.event_record = rec
         try:
@@ -594,10 +877,12 @@ class QueryService:
                                          "service shut down")):
                         self.counters["cancelled"] += 1
             self._cond.notify_all()
+            workers = list(self._workers)
         if wait:
-            for t in self._workers:
-                t.join(timeout=30)
+            for w in workers:
+                w.thread.join(timeout=30)
             self._sweeper.join(timeout=5)
+            self._watchdog.join(timeout=5)
 
     def __enter__(self) -> "QueryService":
         return self
@@ -607,7 +892,42 @@ class QueryService:
         return False
 
     # -- introspection -------------------------------------------------------
+    def _health_state_locked(self) -> str:
+        """HEALTHY → DEGRADED → CPU_ONLY. CPU_ONLY comes from the
+        process-wide device latch; DEGRADED while the device is mid
+        loss-streak OR this service recently lost workers and has not
+        yet completed _DEGRADE_CLEAR_SUCCESSES queries. Caller holds
+        the condition lock (the degraded counter is mutated under
+        it)."""
+        device = HEALTH.state()
+        if device == "CPU_ONLY":
+            return "CPU_ONLY"
+        if device == "DEGRADED" or self._degraded_pending > 0:
+            return "DEGRADED"
+        return "HEALTHY"
+
+    def health(self) -> dict:
+        """The service health surface the ISSUE's states machine drives
+        admission from (and ``tools loadtest`` reports)."""
+        with self._cond:
+            out = {
+                "state": self._health_state_locked(),
+                "workersLost": self._workers_lost,
+                "workersRespawned": self._workers_respawned,
+                "workerCount": len(self._workers),
+                "degradedPendingSuccesses": self._degraded_pending,
+                "shedPool": self._shed_pool,
+            }
+        out["cpuOnlyReason"] = HEALTH.cpu_only_reason()
+        out["device"] = HEALTH.snapshot()
+        out["quarantine"] = QUARANTINE.snapshot()
+        return out
+
     def stats(self) -> dict:
+        # snapshot EVERYTHING mutated under _cond while holding it —
+        # including the survivability fields — so a concurrent worker
+        # can never hand back a torn view (pinned by the stats
+        # concurrency test)
         with self._cond:
             out = {
                 **self.counters,
@@ -615,12 +935,16 @@ class QueryService:
                 "queued": {p: n for p, n in self._queued_per_pool.items()
                            if n},
                 "heldForMemory": self._held_for_memory,
+                "healthState": self._health_state_locked(),
+                "workersLost": self._workers_lost,
+                "workersRespawned": self._workers_respawned,
                 "poolClocks": {p: round(c, 6)
                                for p, c in self._pool_clock.items()},
                 "tenantClocks": {f"{p}/{t}": round(c, 6)
                                  for (p, t), c in
                                  self._tenant_clock.items()},
             }
+        out["quarantine"] = QUARANTINE.snapshot()
         if self.result_cache is not None:
             out["resultCache"] = self.result_cache.stats()
         return out

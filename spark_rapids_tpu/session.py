@@ -306,9 +306,11 @@ class TpuSession:
         if obs_active:
             from spark_rapids_tpu.obs.metrics import scopes_snapshot
             from spark_rapids_tpu.runtime.faults import FAULTS, RECOVERY
+            from spark_rapids_tpu.runtime.health import HEALTH
             before_scopes = scopes_snapshot()
             before_recovery = RECOVERY.snapshot()
             before_fires = FAULTS.counters()
+            before_health = HEALTH.snapshot()
             ctx = TRACER.begin_query(qidx)
         else:
             # no envelope for THIS query, but another session's
@@ -352,11 +354,21 @@ class TpuSession:
             FAULTS,
             RECOVERY,
         )
+        from spark_rapids_tpu.runtime.health import HEALTH
         executable = q.executable
         if executable is not None:
             finalize_observation(executable)
         after_recovery = RECOVERY.snapshot()
         after_fires = FAULTS.counters()
+        after_health = HEALTH.snapshot()
+        after_scopes = scopes_snapshot()
+        # worker restarts ride the process-wide ``health`` scope (the
+        # service's watchdog respawns workers while queries run), so
+        # the per-record delta attributes restarts to the wall they
+        # happened under (0 on a quiet process)
+        worker_restarts = int(
+            after_scopes.get("health", {}).get("workersRespawned", 0)
+            - before_scopes.get("health", {}).get("workersRespawned", 0))
         record = E.build_query_record(
             query_index=qidx,
             wall_s=wall_s,
@@ -369,7 +381,7 @@ class TpuSession:
             recovery_delta={k: v - before_recovery.get(k, 0)
                             for k, v in after_recovery.items()
                             if v - before_recovery.get(k, 0)},
-            scope_deltas=E.scope_delta(before_scopes, scopes_snapshot()),
+            scope_deltas=E.scope_delta(before_scopes, after_scopes),
             fault_fires={k: v - before_fires.get(k, 0)
                          for k, v in after_fires.items()
                          if v - before_fires.get(k, 0)},
@@ -380,6 +392,10 @@ class TpuSession:
             compile_ms=float(q.compile_ms or 0.0),
             executable_cache_hit=bool(q.exec_cache_hit),
             pad_waste_rows=int(q.pad_waste or 0),
+            health_state=HEALTH.state(),
+            device_reinits=int(after_health["deviceReinits"]
+                               - before_health["deviceReinits"]),
+            worker_restarts=worker_restarts,
         )
         self.last_event_record = record
         # the record has read the tree's metrics — the cached executable
@@ -444,19 +460,30 @@ class TpuSession:
             stack.extend(getattr(node, "children", ()))
 
     def _execute_with_recovery(self, plan: P.PlanNode) -> HostTable:
-        """Plan, verify, and drain a query — wrapped in the runtime
-        circuit breaker: a non-OOM device failure (kernel crash, fatal
-        XLA error) replays the query, and once the same operator fails
-        spark.rapids.sql.runtimeFallback.maxFailures times it is demoted
-        to the CPU fallback path for the rest of the session (the replay
-        re-plans, so the demotion takes effect immediately). OOMs never
-        come through here — the retry framework owns those."""
+        """Plan, verify, and drain a query — wrapped in TWO distinct
+        recovery layers:
+
+        * a non-OOM KERNEL failure (KernelCrashError) replays the query
+          through the runtime circuit breaker, and once the same
+          operator fails spark.rapids.sql.runtimeFallback.maxFailures
+          times it is demoted to the CPU fallback path for the session
+          (the replay re-plans, so the demotion takes effect
+          immediately);
+        * a FATAL device error (is_fatal_device_error — the device or
+          its tunnel is gone, not one operator) captures a crash report
+          and hands recovery to the health monitor (runtime/health.py):
+          backend reinit, device-referencing caches invalidated, and
+          after deviceLoss.maxReinits consecutive losses the CPU-only
+          latch. The query surfaces a typed RETRYABLE DeviceLostError —
+          the query service requeues it against the recovered backend.
+
+        OOMs never come through here — the retry framework owns those."""
         from spark_rapids_tpu.conf import (
             RUNTIME_FALLBACK_ENABLED,
             RUNTIME_FALLBACK_MAX_FAILURES,
             TEST_FAULTS,
         )
-        from spark_rapids_tpu.errors import KernelCrashError
+        from spark_rapids_tpu.errors import DeviceLostError, KernelCrashError
         from spark_rapids_tpu.runtime import faults as F
         from spark_rapids_tpu.runtime.crash_handler import (
             handle_fatal,
@@ -477,16 +504,39 @@ class TpuSession:
                 if replays and hasattr(self._last_executable, "metrics"):
                     self._last_executable.metrics["runtimeFaultReplays"] = \
                         replays
+                from spark_rapids_tpu.runtime.health import HEALTH
+                HEALTH.note_success()
                 return result
             except Exception as exc:
-                demotable = isinstance(exc, KernelCrashError) or \
-                    is_fatal_device_error(exc)
+                if is_fatal_device_error(exc):
+                    # a nested execute already ran recovery for this
+                    # exception — the outer envelope just propagates it
+                    if getattr(exc, "_health_handled", False):
+                        raise
+                    ex = getattr(self, "_last_executable", None)
+                    handle_fatal(exc, self.conf,
+                                 plan_description=ex.tree_string()
+                                 if ex is not None else "")
+                    # the in-flight tree references the dead device —
+                    # drop it before recovery clears the cache (TOP
+                    # LEVEL only: depth >= 2 holds no token)
+                    if self._q.exec_depth == 1:
+                        self._release_exec_cache(drop=True)
+                    from spark_rapids_tpu.runtime.health import HEALTH
+                    HEALTH.on_device_loss(exc, self.conf)
+                    if isinstance(exc, DeviceLostError):
+                        exc._health_handled = True
+                        raise
+                    lost = DeviceLostError(
+                        f"device lost during execution "
+                        f"({type(exc).__name__}: {exc}); backend "
+                        f"recovered — retry the query")
+                    lost._health_handled = True
+                    if getattr(exc, "fault_op", None) is not None:
+                        lost.fault_op = exc.fault_op
+                    raise lost from exc
+                demotable = isinstance(exc, KernelCrashError)
                 if not rf_enabled or not demotable or replays >= max_replays:
-                    if is_fatal_device_error(exc):
-                        ex = getattr(self, "_last_executable", None)
-                        handle_fatal(exc, self.conf,
-                                     plan_description=ex.tree_string()
-                                     if ex is not None else "")
                     raise
                 op = getattr(exc, "fault_op", None)
                 if op is not None:

@@ -81,6 +81,13 @@ def main(argv=None) -> int:
     lt.add_argument("--warmup-from", type=str, default="",
                     help="AOT-warm from this event-log dir before the "
                          "serial baseline (tools warmup, in-process)")
+    lt.add_argument("--chaos", action="store_true",
+                    help="arm the seeded service-level fault schedule "
+                         "(worker crashes, device losses, a wedged "
+                         "dispatch) on the service session; asserts "
+                         "every submission terminal, FINISHED results "
+                         "bit-identical, failures typed, recovery "
+                         "bounded, and health back to HEALTHY")
 
     w = sub.add_parser(
         "warmup",
@@ -122,7 +129,8 @@ def main(argv=None) -> int:
             use_sql=args.sql, concurrency=args.concurrency,
             tenants=args.tenants,
             eventlog_dir=args.eventlog_dir or None,
-            warmup_from=args.warmup_from or None)
+            warmup_from=args.warmup_from or None,
+            chaos=args.chaos)
         print(json.dumps(report) if args.json
               else render_loadtest(report))
         if args.out:

@@ -119,6 +119,17 @@ def compare_query(a_runs: List[dict], b_runs: List[dict]) -> dict:
             1 for r in a_runs if r.get("executableCacheHit")),
         "bExecutableCacheHits": sum(
             1 for r in b_runs if r.get("executableCacheHit")),
+        # survivability (schema v4): recovery events under each side's
+        # runs — a perf regression explained by a device reinit mid-run
+        # is a different conversation than a plan regression
+        "aDeviceReinits": sum(int(r.get("deviceReinits", 0))
+                              for r in a_runs),
+        "bDeviceReinits": sum(int(r.get("deviceReinits", 0))
+                              for r in b_runs),
+        "aWorkerRestarts": sum(int(r.get("workerRestarts", 0))
+                               for r in a_runs),
+        "bWorkerRestarts": sum(int(r.get("workerRestarts", 0))
+                               for r in b_runs),
         "ops": op_diffs,
         "newFallbacks": sorted(set(fb_b) - set(fb_a)),
         "resolvedFallbacks": sorted(set(fb_a) - set(fb_b)),
@@ -141,6 +152,10 @@ def build_compare(path_a: str, path_b: str) -> dict:
         "totalACompileMs": compile_a,
         "totalBCompileMs": compile_b,
         "deltaCompileMs": round(compile_b - compile_a, 3),
+        "aDeviceReinits": sum(q["aDeviceReinits"] for q in queries),
+        "bDeviceReinits": sum(q["bDeviceReinits"] for q in queries),
+        "aWorkerRestarts": sum(q["aWorkerRestarts"] for q in queries),
+        "bWorkerRestarts": sum(q["bWorkerRestarts"] for q in queries),
         "onlyInA": sorted(set(idx_a) - set(idx_b)),
         "onlyInB": sorted(set(idx_b) - set(idx_a)),
         "totalAWallS": total_a,
@@ -164,6 +179,13 @@ def render_compare(cmp: dict, top_n: int = 5) -> str:
     lines.append(f"Compile: {cmp['totalACompileMs']:.1f}ms -> "
                  f"{cmp['totalBCompileMs']:.1f}ms "
                  f"({cmp['deltaCompileMs']:+.1f}ms)")
+    if (cmp["aDeviceReinits"] or cmp["bDeviceReinits"]
+            or cmp["aWorkerRestarts"] or cmp["bWorkerRestarts"]):
+        lines.append(
+            f"Survivability: device reinits "
+            f"{cmp['aDeviceReinits']} -> {cmp['bDeviceReinits']} | "
+            f"worker restarts {cmp['aWorkerRestarts']} -> "
+            f"{cmp['bWorkerRestarts']}")
     for q in cmp["queries"]:
         arrow = f"{q['aWallS']:.4f}s -> {q['bWallS']:.4f}s"
         sp = f"  ({q['speedup']}x)" if q.get("speedup") else ""

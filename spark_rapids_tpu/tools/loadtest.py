@@ -23,7 +23,113 @@ components are reported separately.
 from __future__ import annotations
 
 import time
+from contextlib import contextmanager
 from typing import Dict, List, Optional
+
+#: recovery-work ceilings a service-chaos run asserts (whole-run; the
+#: fault schedule is COUNT-based, so recovery work is bounded by
+#: construction — a violation means a retry loop ran away)
+SERVICE_CHAOS_BOUNDS = {
+    "deviceReinits": 8,
+    "workersLost": 8,
+    "workersRespawned": 8,
+    "requeued": 24,
+    "hardTimeouts": 8,
+}
+
+#: handle errors a chaos run accepts as TYPED survivability outcomes —
+#: anything else failing a submission fails the run
+_CHAOS_TYPED_ERRORS = ("HardTimeoutError", "WorkerLostError",
+                       "DeviceLostError", "QueryQuarantinedError")
+
+
+def service_chaos_spec(seed: int) -> str:
+    """The seeded SERVICE-level fault schedule: worker deaths, device
+    losses and one wedged dispatch — count-based so total disruption is
+    deterministic regardless of corpus size (probabilities would scale
+    chaos with load and unbound the recovery counters)."""
+    return ";".join([
+        f"service.worker_crash:crash:2:{seed * 100 + 1}",
+        f"device.lost:device_lost:2:{seed * 100 + 2}",
+        f"dispatch.wedge:wedge:1:{seed * 100 + 3}",
+    ])
+
+
+#: how long the injected wedge stalls its dispatch during a chaos
+#: loadtest (SRT_WEDGE_SLEEP_S) — must exceed hardTimeoutMs so the
+#: watchdog provably fires, with margin so the abandoned (still
+#: sleeping, semaphore-holding) thread outlives the verdict
+_CHAOS_WEDGE_SLEEP_S = 45.0
+
+
+def service_chaos_settings(concurrency: int) -> dict:
+    """The service conf a chaos run needs BESIDES the fault schedule —
+    shared with ``scale_test.py --service-faults`` so the two harnesses
+    cannot drift apart on the survivability contract."""
+    return {
+        # hard limit well under the wedge stall so the watchdog
+        # provably fires, but FAR above the worst legitimate run: a
+        # device loss mid-run clears every kernel cache, so
+        # post-recovery queries pay cold re-traces CONCURRENTLY (every
+        # worker compiling at once multiplies the ~3s p95 serial cold
+        # wall several-fold) — a tight limit here reads honest
+        # recovery work as a wedge and cascades worker loss
+        "spark.rapids.service.hardTimeoutMs": "25000",
+        # one semaphore slot per worker: the abandoned wedged thread
+        # keeps sleeping INSIDE its dispatch holding a slot — with
+        # slots == workers the remaining workers keep flowing (a fixed
+        # slot count below the worker count would stack semaphore wait
+        # into RUNNING wall and cascade hard timeouts)
+        "spark.rapids.sql.concurrentGpuTasks": str(max(1, concurrency)),
+        # injected faults are NOT the query's fault — a strike budget
+        # above the schedule's kill count keeps an innocent template
+        # out of quarantine (quarantine is pinned by its own tier-1
+        # tests, which inject repeat kills into ONE template)
+        "spark.rapids.service.quarantine.maxStrikes": "8",
+    }
+
+
+def _chaos_conf(seed: int, concurrency: int) -> dict:
+    conf = {"spark.rapids.test.faults": service_chaos_spec(seed)}
+    conf.update(service_chaos_settings(concurrency))
+    return conf
+
+
+@contextmanager
+def wedge_stall_env():
+    """Arm the chaos wedge stall (SRT_WEDGE_SLEEP_S) for the scope of
+    one chaos run, restoring whatever was there before — shared by both
+    harnesses so the stall/hard-limit relationship cannot drift."""
+    import os
+    before = os.environ.get("SRT_WEDGE_SLEEP_S")
+    os.environ["SRT_WEDGE_SLEEP_S"] = str(_CHAOS_WEDGE_SLEEP_S)
+    try:
+        yield
+    finally:
+        if before is None:
+            os.environ.pop("SRT_WEDGE_SLEEP_S", None)
+        else:
+            os.environ["SRT_WEDGE_SLEEP_S"] = before
+
+
+def drive_health_probes(svc, make_query, *, timeout_s: float,
+                        max_probes: int = 4) -> int:
+    """Prove return-to-HEALTHY after a chaos run: the DEGRADED latch
+    pays down on COMPLETED queries, so a loss landing on the corpus
+    tail leaves nothing to pay it — drive a few probe queries, exactly
+    what live traffic would do. Returns probes driven. Callers skip
+    this when submissions HUNG (the run already failed; waiting out
+    probe timeouts would only delay the verdict)."""
+    probes = 0
+    while svc.health()["state"] == "DEGRADED" and probes < max_probes:
+        try:
+            hp = svc.submit(make_query(), tenant="health-probe",
+                            tag=f"probe{probes}")
+        except Exception:
+            break  # probe template quarantined/shed: report as-is
+        hp.wait(timeout=timeout_s)
+        probes += 1
+    return probes
 
 
 def _percentile(values: List[float], q: float) -> float:
@@ -46,7 +152,8 @@ def run_loadtest(sf: float = 0.05, seed: int = 0, queries=None,
                  use_sql: bool = False, concurrency: int = 4,
                  tenants: int = 2, eventlog_dir: Optional[str] = None,
                  timeout_s: float = 600.0,
-                 warmup_from: Optional[str] = None) -> dict:
+                 warmup_from: Optional[str] = None,
+                 chaos: bool = False) -> dict:
     """Run the loadtest and return the JSON-ready report dict.
     ``report["ok"]`` is False when any result diverged from serial or
     any submission failed — callers exit non-zero on it.
@@ -55,11 +162,24 @@ def run_loadtest(sf: float = 0.05, seed: int = 0, queries=None,
     (``tools warmup`` in-process, sharing this run's tables/session so
     the executable cache warms by table identity) — the serial "cold"
     pass then measures warmed-cold latency; compare coldP95S against a
-    run without warmup to price the warmup."""
+    run without warmup to price the warmup.
+
+    ``chaos``: arm the seeded SERVICE-level fault schedule
+    (:func:`service_chaos_spec` — worker crashes, device losses, a
+    wedged dispatch) on the service session only. The run then asserts
+    the survivability contract instead of all-finished: every
+    submission reaches a TERMINAL state (zero hangs), every FINISHED
+    result is bit-identical to the fault-free serial baseline, any
+    failure carries a typed survivability error, recovery counters stay
+    within SERVICE_CHAOS_BOUNDS, and the service health returns to
+    HEALTHY. The report gains a ``chaos`` section with the schedule,
+    fire counts, recovery counters and terminal-state census."""
     from spark_rapids_tpu.dispatch import COMPILE_SCOPE
     from spark_rapids_tpu.lint.golden import _load_scale_test
     from spark_rapids_tpu.datagen import scale_test_specs
     from spark_rapids_tpu.plan.executable_cache import EXEC_CACHE
+    from spark_rapids_tpu.runtime.faults import FAULTS
+    from spark_rapids_tpu.runtime.health import HEALTH, QUARANTINE
     from spark_rapids_tpu.service import QueryService
     from spark_rapids_tpu.session import TpuSession
 
@@ -108,34 +228,76 @@ def run_loadtest(sf: float = 0.05, seed: int = 0, queries=None,
 
     # -- concurrent run through the service ---------------------------------
     n_submissions = len(wanted) * tenants
-    svc = QueryService(
-        _conf({
-            "spark.rapids.service.maxConcurrentQueries": str(concurrency),
-            "spark.rapids.service.queueDepth": str(max(n_submissions, 64)),
-        }))
+    svc_conf = {
+        "spark.rapids.service.maxConcurrentQueries": str(concurrency),
+        "spark.rapids.service.queueDepth": str(max(n_submissions, 64)),
+    }
+    from contextlib import ExitStack
+    health_before = HEALTH.snapshot()
+    chaos_env = ExitStack()
+    if chaos:
+        svc_conf.update(_chaos_conf(seed, concurrency))
+        chaos_env.enter_context(wedge_stall_env())
+    svc = QueryService(_conf(svc_conf))
     svc_queries = build(svc.session, tables)
     mismatches: List[str] = []
     failures: List[str] = []
+    rejected: List[str] = []
     handles = []
+    hung: List[str] = []
+    svc_health_live = None
+    health_probes = 0
     t0 = time.perf_counter()
-    with svc:
-        for t in range(tenants):
-            for name in wanted:
-                handles.append((name, f"tenant{t}", svc.submit(
-                    svc_queries[name](), tenant=f"tenant{t}",
-                    tag=f"{name}@tenant{t}")))
-        for name, tenant, h in handles:
-            if not h.wait(timeout=timeout_s):
-                failures.append(
-                    f"{name}@{tenant}: still {h.state} after "
-                    f"{timeout_s}s")
+    try:
+        with svc:
+            for t in range(tenants):
+                for name in wanted:
+                    label = f"{name}@tenant{t}"
+                    try:
+                        handles.append((name, f"tenant{t}", svc.submit(
+                            svc_queries[name](), tenant=f"tenant{t}",
+                            tag=label)))
+                    except Exception as exc:
+                        # under chaos a DEGRADED shed / quarantine can
+                        # refuse admission — a typed rejection IS a
+                        # terminal outcome, not a hang
+                        if not chaos:
+                            raise
+                        rejected.append(
+                            f"{label}: {type(exc).__name__}: {exc}")
+            for name, tenant, h in handles:
+                if not h.wait(timeout=timeout_s):
+                    hung.append(
+                        f"{name}@{tenant}: still {h.state} after "
+                        f"{timeout_s}s")
+                    failures.append(hung[-1])
+            if chaos and not hung:
+                health_probes = drive_health_probes(
+                    svc, svc_queries[wanted[0]], timeout_s=timeout_s)
+            # capture health while the pool is still up (post-shutdown
+            # the workers have deregistered and workerCount reads 0)
+            svc_health_live = svc.health()
+    finally:
+        chaos_fires = FAULTS.counters() if chaos else {}
+        if chaos:
+            FAULTS.disarm()
+        chaos_env.close()
     wall = time.perf_counter() - t0
     scope_conc = dict(COMPILE_SCOPE)
 
     latencies, queue_waits, per_query = [], [], {}
     cache_hits = 0
+    chaos_outcomes: List[dict] = []
     for name, tenant, h in handles:
         if h.state != "FINISHED":
+            typed = type(h.error).__name__ in _CHAOS_TYPED_ERRORS
+            if chaos and typed:
+                # survivable terminal outcome: reported, not a failure
+                chaos_outcomes.append({
+                    "query": f"{name}@{tenant}", "state": h.state,
+                    "error": f"{type(h.error).__name__}: {h.error}",
+                    "requeues": h.requeues})
+                continue
             failures.append(f"{name}@{tenant}: {h.state} ({h.error})")
             continue
         diff = st.tables_differ(expected[name], h.result_table)
@@ -150,7 +312,8 @@ def run_loadtest(sf: float = 0.05, seed: int = 0, queries=None,
         entry["runs"].append({
             "tenant": tenant, "latencyS": round(h.latency_s, 4),
             "queueWaitS": round(h.queue_wait_s or 0.0, 4),
-            "cacheHit": h.cache_hit, "identical": diff is None})
+            "cacheHit": h.cache_hit, "identical": diff is None,
+            "requeues": h.requeues})
 
     # compile-breakdown per phase: the serial pass traces every cold
     # shape (unless warmed); the concurrent pass repeats templates and
@@ -182,6 +345,47 @@ def run_loadtest(sf: float = 0.05, seed: int = 0, queries=None,
     cold_vals = list(serial_cold.values())
     warm_vals = list(serial_warm.values())
 
+    # -- chaos verdicts ------------------------------------------------------
+    chaos_report = None
+    if chaos:
+        health_after = HEALTH.snapshot()
+        svc_stats = svc.stats()
+        svc_health = svc_health_live or svc.health()
+        recovery = {
+            "deviceReinits": health_after["deviceReinits"]
+            - health_before["deviceReinits"],
+            "deviceLost": health_after["deviceLost"]
+            - health_before["deviceLost"],
+            "workersLost": svc_stats["workersLost"],
+            "workersRespawned": svc_stats["workersRespawned"],
+            "requeued": svc_stats["requeued"],
+            "hardTimeouts": svc_stats["hardTimeouts"],
+        }
+        bounds_violations = [
+            f"{k}={recovery[k]} exceeds the chaos bound {bound}"
+            for k, bound in SERVICE_CHAOS_BOUNDS.items()
+            if recovery.get(k, 0) > bound]
+        returned_healthy = svc_health["state"] == "HEALTHY"
+        chaos_report = {
+            "faultSpec": service_chaos_spec(seed),
+            "faultFires": chaos_fires,
+            "recovery": recovery,
+            "bounds": dict(SERVICE_CHAOS_BOUNDS),
+            "boundsViolations": bounds_violations,
+            "typedOutcomes": chaos_outcomes,
+            "rejectedSubmissions": rejected,
+            "hungSubmissions": hung,
+            "quarantine": QUARANTINE.snapshot(),
+            "healthAtEnd": svc_health,
+            "healthProbes": health_probes,
+            "returnedToHealthy": returned_healthy,
+        }
+        if bounds_violations:
+            failures.extend(bounds_violations)
+        if not returned_healthy:
+            failures.append(
+                f"service did not return to HEALTHY: {svc_health}")
+
     report = {
         "mode": "loadtest",
         "scaleFactor": sf,
@@ -204,6 +408,7 @@ def run_loadtest(sf: float = 0.05, seed: int = 0, queries=None,
         if warm_vals else None,
         "warmup": warmup_report,
         "compile": compile_report,
+        "chaos": chaos_report,
         "speedupVsSerial": round(serial_sum / wall, 3) if wall else None,
         "throughputQps": round(n_submissions / wall, 3) if wall else None,
         "latencyP50S": round(_percentile(latencies, 0.50), 4)
@@ -226,6 +431,10 @@ def run_loadtest(sf: float = 0.05, seed: int = 0, queries=None,
         "mismatches": mismatches,
         "failures": failures,
         "queries": per_query,
+        # chaos mode: typed survivable outcomes and bounded recovery
+        # are the CONTRACT, not failures — ok still requires zero
+        # hangs, zero mismatches, zero untyped failures, bounds held,
+        # and the service back at HEALTHY (folded into failures above)
         "ok": not mismatches and not failures,
     }
     return report
@@ -260,6 +469,22 @@ def render_loadtest(report: dict) -> str:
             f"  warmup          {w['programsCompiled']} compiled / "
             f"{w['programsSkipped']} skipped in {w['wallS']:.2f}s "
             f"({w['newTraces']} traces)")
+    if report.get("chaos"):
+        c = report["chaos"]
+        r = c["recovery"]
+        lines.append(
+            f"  chaos           fires {sum(c['faultFires'].values())} "
+            f"({', '.join(f'{k}={v}' for k, v in sorted(c['faultFires'].items()))})")
+        lines.append(
+            f"    recovery      deviceReinits={r['deviceReinits']} "
+            f"workersLost={r['workersLost']} respawned="
+            f"{r['workersRespawned']} requeued={r['requeued']} "
+            f"hardTimeouts={r['hardTimeouts']}")
+        lines.append(
+            f"    outcomes      {len(c['typedOutcomes'])} typed "
+            f"non-finished, {len(c['rejectedSubmissions'])} rejected, "
+            f"{len(c['hungSubmissions'])} hung; health at end: "
+            f"{c['healthAtEnd']['state']}")
     if report["mismatches"]:
         lines.append("  MISMATCHES:")
         lines += [f"    {m}" for m in report["mismatches"]]

@@ -155,6 +155,10 @@ def analyze_query(rec: dict, top_n: int = 10) -> dict:
         "compileMs": round(float(rec.get("compileMs", 0.0)), 3),
         "executableCacheHit": bool(rec.get("executableCacheHit", False)),
         "padWasteRows": int(rec.get("padWasteRows", 0)),
+        "healthState": rec.get("healthState", "HEALTHY"),
+        "quarantined": bool(rec.get("quarantined", False)),
+        "deviceReinits": int(rec.get("deviceReinits", 0)),
+        "workerRestarts": int(rec.get("workerRestarts", 0)),
         "attribution": {
             "attributedS": round(attributed, 6),
             "untrackedS": round(float(spans.get("untrackedS", 0.0)), 6),
@@ -220,11 +224,24 @@ def build_profile(records: Iterable[dict], top_n: int = 10,
             1 for q in queries if q["executableCacheHit"]),
         "padWasteRows": sum(q["padWasteRows"] for q in queries),
     }
+    # survivability (schema v4): how healthy was the process this run,
+    # and which queries rode through recovery events
+    survivability = {
+        "deviceReinits": sum(q["deviceReinits"] for q in queries),
+        "workerRestarts": sum(q["workerRestarts"] for q in queries),
+        "quarantinedQueries": sorted(
+            {q["query"] for q in queries if q["quarantined"]}),
+        "healthStates": sorted({q["healthState"] for q in queries}),
+        "nonHealthyQueries": sorted(
+            {q["query"] for q in queries
+             if q["healthState"] != "HEALTHY"}),
+    }
     return {
         "queryCount": len(queries),
         "cacheHitRecords": cache_hits,
         "totalWallS": total_wall,
         "compile": compile_summary,
+        "survivability": survivability,
         "minCoverage": round(min((q["attribution"]["coverage"]
                                   for q in queries), default=1.0), 4),
         "coverageFloor": coverage_floor,
@@ -269,6 +286,16 @@ def render_profile(report: dict) -> str:
         f"{len(c['coldQueries'])} cold queries | executable-cache hits "
         f"{c['executableCacheHits']}/{report['queryCount']} | pad waste "
         f"{c['padWasteRows']} rows")
+    sv = report["survivability"]
+    if (sv["deviceReinits"] or sv["workerRestarts"]
+            or sv["quarantinedQueries"]
+            or sv["healthStates"] != ["HEALTHY"]):
+        lines.append(
+            f"Survivability: device reinits {sv['deviceReinits']} | "
+            f"worker restarts {sv['workerRestarts']} | health states "
+            f"{','.join(sv['healthStates'])}"
+            + (f" | quarantined: {', '.join(sv['quarantinedQueries'])}"
+               if sv["quarantinedQueries"] else ""))
     lines.append("")
     lines.append("Top operators by self time:")
     for e in report["topOpsBySelfTime"]:

@@ -229,10 +229,13 @@ def test_event_log_written_and_valid(tmp_path):
     lines = open(s.last_event_path).read().strip().splitlines()
     assert len(lines) == 1
     rec = json.loads(lines[0])
-    # schema v3: the serving-latency PR added compileMs /
-    # executableCacheHit / padWasteRows on top of the v2 service
-    # fields (null/false outside their paths) — see obs/events.py
-    assert rec["schema"] == 3
+    # schema v4: the survivability PR added healthState / quarantined /
+    # deviceReinits / workerRestarts on top of the v3 serving-latency
+    # fields (HEALTHY/false/0/0 on a quiet process) — see obs/events.py
+    assert rec["schema"] == 4
+    assert rec["healthState"] == "HEALTHY"
+    assert rec["quarantined"] is False
+    assert rec["deviceReinits"] == 0 and rec["workerRestarts"] == 0
     assert rec["event"] == "queryCompleted"
     assert rec["queryTag"] == "golden"
     assert rec["wallS"] > 0
@@ -264,7 +267,11 @@ def test_event_log_golden_schema(tmp_path):
     serving-latency fields (compileMs — wall spent on new XLA traces,
     0.0 fully warm; executableCacheHit — the query checked out a cached
     converted executable; padWasteRows — dead rows padding batches to
-    their capacity buckets; result-cache serves carry 0.0/false/0)."""
+    their capacity buckets; result-cache serves carry 0.0/false/0);
+    v4 = survivability fields (healthState — HEALTHY/DEGRADED/CPU_ONLY
+    at record time; quarantined — the template carries poison strikes;
+    deviceReinits/workerRestarts — per-record deltas of the health
+    scope's recovery counters, 0 on a quiet process)."""
     s = _run_eventlog_query(tmp_path)
     got = _normalize(s.last_event_record)
     golden_path = os.path.join(os.path.dirname(__file__),

@@ -1,0 +1,476 @@
+"""Service survivability (PR 7): device-loss recovery + cache
+invalidation, the CPU-only latch, the worker watchdog (hard wall limit,
+respawn), poison-query quarantine, DEGRADED-mode load shedding, and the
+satellite fixes (spill disk-file cleanup, locked stats snapshots,
+semaphore-timeout cleanup). Seeded and small — this slice rides tier-1.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import functions as F
+from spark_rapids_tpu.errors import (
+    DeviceLostError,
+    HardTimeoutError,
+    QueryQuarantinedError,
+    QueryRejectedError,
+    SemaphoreTimeoutError,
+)
+from spark_rapids_tpu.runtime import faults as FMOD
+from spark_rapids_tpu.runtime.faults import CIRCUIT_BREAKER, FAULTS
+from spark_rapids_tpu.runtime.health import HEALTH, QUARANTINE
+from spark_rapids_tpu.service import QueryService
+from spark_rapids_tpu.session import TpuSession
+
+pytestmark = pytest.mark.survivability
+
+
+@pytest.fixture(autouse=True)
+def _clean_survivability_state():
+    """The health monitor, quarantine ledger and fault registry are
+    process-wide; a latched CPU-only mode or leftover strikes would
+    poison every later test."""
+    FAULTS.disarm()
+    CIRCUIT_BREAKER.reset()
+    HEALTH.reset()
+    QUARANTINE.reset()
+    yield
+    FAULTS.disarm()
+    CIRCUIT_BREAKER.reset()
+    HEALTH.reset()
+    QUARANTINE.reset()
+
+
+def _data(n=200):
+    return {"k": np.array(["a", "b", "c", "d"] * (n // 4), dtype=object),
+            "v": np.arange(n, dtype=np.int64)}
+
+
+def _agg(df):
+    return df.group_by("k").agg(F.sum("v").alias("s"))
+
+
+def _expected():
+    return {"a": sum(range(0, 200, 4)), "b": sum(range(1, 200, 4)),
+            "c": sum(range(2, 200, 4)), "d": sum(range(3, 200, 4))}
+
+
+def _check_result(table):
+    got = dict(zip(np.asarray(table.columns[0].data).tolist(),
+                   np.asarray(table.columns[1].data).tolist()))
+    assert got == _expected()
+
+
+# ---------------------------------------------------------------------------
+# device-loss recovery
+# ---------------------------------------------------------------------------
+
+
+def test_device_loss_recovery_invalidates_caches():
+    """THE acceptance proof: after a device loss the plan->executable
+    and kernel-trace caches are invalidated — the post-recovery repeat
+    query RE-TRACES (a stale cached program would have been served
+    otherwise), and the run after that re-warms (hit + zero traces)."""
+    from spark_rapids_tpu.dispatch import COMPILE_SCOPE
+
+    # faults key present-but-empty from the start so flipping it later
+    # and back yields the IDENTICAL conf (same executable fingerprint)
+    s = TpuSession({"spark.rapids.test.faults": ""})
+    df = s.create_dataframe(_data())
+    _agg(df).collect_table()
+    _agg(df).collect_table()
+    assert s.last_executable_cache_hit  # warm: cached tree checked out
+    health_before = HEALTH.snapshot()
+
+    s.set_conf("spark.rapids.test.faults", "device.lost:device_lost:1")
+    with pytest.raises(DeviceLostError):
+        _agg(df).collect_table()
+    assert HEALTH.snapshot()["deviceReinits"] == \
+        health_before["deviceReinits"] + 1
+    assert HEALTH.state() == "DEGRADED"  # loss streak open
+
+    # back to the ORIGINAL conf: same fingerprint as the warm entries —
+    # only the recovery's invalidation can explain a miss now
+    s.set_conf("spark.rapids.test.faults", "")
+    scope_before = dict(COMPILE_SCOPE)
+    t = _agg(df).collect_table()
+    _check_result(t)
+    retraced = COMPILE_SCOPE.get("kernelTraces", 0) \
+        - scope_before.get("kernelTraces", 0)
+    assert not s.last_executable_cache_hit  # executable cache emptied
+    assert retraced > 0  # kernel-trace caches emptied: re-traced
+    assert HEALTH.state() == "HEALTHY"  # success closed the streak
+
+    scope_before = dict(COMPILE_SCOPE)
+    t = _agg(df).collect_table()
+    _check_result(t)
+    assert s.last_executable_cache_hit  # re-warmed
+    assert COMPILE_SCOPE.get("kernelTraces", 0) \
+        == scope_before.get("kernelTraces", 0)
+
+
+def test_device_loss_requeues_in_service():
+    """The service's in-process 'rescheduler': a DeviceLostError is
+    retryable, so the handle goes BACK in its queue and completes
+    against the recovered backend."""
+    with QueryService({"spark.rapids.test.faults":
+                       "device.lost:device_lost:1"}) as svc:
+        df = svc.session.create_dataframe(_data())
+        h = svc.submit(_agg(df), tenant="a")
+        assert h.wait(timeout=60)
+        assert h.state == "FINISHED"
+        assert h.requeues == 1
+        _check_result(h.result_table)
+        st = svc.stats()
+        assert st["requeued"] == 1
+        assert QUARANTINE.snapshot()["strikes"] == 1  # loss = a strike
+        # completions clear DEGRADED
+        h2 = svc.submit(_agg(df), tenant="a")
+        h3 = svc.submit(_agg(df), tenant="a")
+        assert h2.wait(60) and h3.wait(60)
+        assert svc.health()["state"] == "HEALTHY"
+
+
+def test_max_reinits_exhaustion_latches_cpu_only(tmp_path):
+    """deviceLoss.maxReinits consecutive losses latch CPU-only mode:
+    the latch reason lands in explain() and the event log, and the
+    query then COMPLETES on the CPU path with the faults still armed
+    (no device dispatch = no injected loss = survival)."""
+    s = TpuSession({
+        "spark.rapids.test.faults": "device.lost:device_lost:99",
+        "spark.rapids.service.deviceLoss.maxReinits": "2",
+        "spark.rapids.sql.eventLog.enabled": "true",
+        "spark.rapids.sql.eventLog.dir": str(tmp_path),
+    })
+    df = s.create_dataframe(_data())
+    for _ in range(2):
+        with pytest.raises(DeviceLostError):
+            _agg(df).collect_table()
+    assert HEALTH.state() == "CPU_ONLY"
+    t = _agg(df).collect_table()  # CPU-only: completes despite faults
+    _check_result(t)
+    reason = HEALTH.cpu_only_reason()
+    assert "CPU-only mode latched" in reason
+    assert reason in s.explain(_agg(df).plan)
+    rec = s.last_event_record
+    assert rec["healthState"] == "CPU_ONLY"
+    assert any(reason in r for fb in rec["fallbacks"]
+               for r in fb["reasons"])
+
+
+# ---------------------------------------------------------------------------
+# watchdog + self-healing pool
+# ---------------------------------------------------------------------------
+
+
+def test_watchdog_abandons_wedged_worker(monkeypatch):
+    """A worker stuck INSIDE one dispatch never reaches the cooperative
+    cancel boundary — the watchdog's hard wall limit fails the handle
+    with the typed error, and a replacement worker keeps the pool at
+    capacity."""
+    monkeypatch.setattr(FMOD, "_WEDGE_SLEEP_S", 1.2)
+    # warm the kernels through a plain session first so the service
+    # query's RUNNING wall is dispatch-bound, not compile-bound
+    warm = TpuSession()
+    _agg(warm.create_dataframe(_data())).collect_table()
+    with QueryService({"spark.rapids.test.faults":
+                       "dispatch.wedge:wedge:1",
+                       "spark.rapids.service.hardTimeoutMs": "300"}) as svc:
+        df = svc.session.create_dataframe(_data())
+        t0 = time.monotonic()
+        h = svc.submit(_agg(df), tenant="a")
+        assert h.wait(timeout=30)
+        assert h.state == "TIMED_OUT"
+        assert isinstance(h.error, HardTimeoutError)
+        # the verdict came from the watchdog, not the 1.2s wedge end
+        assert time.monotonic() - t0 < 1.0
+        st = svc.stats()
+        assert st["hardTimeouts"] == 1
+        assert st["workersLost"] == 1 and st["workersRespawned"] == 1
+        assert st["healthState"] == "DEGRADED"
+        assert len(svc._workers) == svc.max_concurrent  # capacity holds
+        # the pool still serves (wedge schedule is exhausted)
+        h2 = svc.submit(_agg(df), tenant="a")
+        assert h2.wait(timeout=60) and h2.state == "FINISHED"
+        _check_result(h2.result_table)
+        # let the abandoned thread wake, notice it is lost, and exit —
+        # it must not poison the next test's semaphore accounting
+        time.sleep(max(0.0, 1.3 - (time.monotonic() - t0)))
+
+
+def test_worker_crash_respawns_and_requeues():
+    """A dying worker (runner machinery raises outside the query) is
+    replaced and its query replays on the new worker."""
+    with QueryService({"spark.rapids.test.faults":
+                       "service.worker_crash:crash:1"}) as svc:
+        df = svc.session.create_dataframe(_data())
+        h = svc.submit(_agg(df), tenant="a")
+        assert h.wait(timeout=60)
+        assert h.state == "FINISHED"
+        assert h.requeues == 1
+        _check_result(h.result_table)
+        st = svc.stats()
+        assert st["workersLost"] == 1 and st["workersRespawned"] == 1
+        assert st["requeued"] == 1
+        assert len(svc._workers) == svc.max_concurrent
+
+
+# ---------------------------------------------------------------------------
+# poison-query quarantine
+# ---------------------------------------------------------------------------
+
+
+def test_poison_query_quarantined():
+    """A template that keeps killing workers is quarantined: the
+    in-flight handle fails typed with the strike history, resubmission
+    is refused at admission, and explain() flags the template."""
+    # count 2 EXACTLY: both kills land on the poison template's two
+    # runs; the schedule is then spent, so the innocent template below
+    # runs clean (the point fires per worker run, not per template)
+    with QueryService({"spark.rapids.test.faults":
+                       "service.worker_crash:crash:2",
+                       "spark.rapids.service.quarantine.maxStrikes":
+                       "2"}) as svc:
+        df = svc.session.create_dataframe(_data())
+        h = svc.submit(_agg(df), tenant="a")
+        assert h.wait(timeout=60)
+        assert h.state == "FAILED"
+        assert isinstance(h.error, QueryQuarantinedError)
+        assert len(h.error.strikes) == 2
+        assert h.requeues == 1  # strike 1 -> requeue, strike 2 -> latch
+        with pytest.raises(QueryQuarantinedError) as ei:
+            svc.submit(_agg(df), tenant="b")
+        assert len(ei.value.strikes) == 2
+        assert svc.stats()["quarantineRejected"] == 1
+        assert svc.health()["quarantine"]["quarantined"] == 1
+        assert "QUARANTINED" in svc.session.explain(_agg(df).plan)
+        # a DIFFERENT template is unaffected
+        other = svc.submit(df.group_by("k").agg(F.count("v").alias("c")),
+                           tenant="b")
+        assert other.wait(timeout=60) and other.state == "FINISHED"
+
+
+def test_quarantine_surfaces_in_event_log(tmp_path):
+    """The v4 ``quarantined`` field: a template with strikes carries
+    true on its (executed or cache-served) records."""
+    with QueryService({"spark.rapids.sql.eventLog.enabled": "true",
+                       "spark.rapids.sql.eventLog.dir":
+                       str(tmp_path)}) as svc:
+        from spark_rapids_tpu.plan.fingerprint import template_fingerprint
+
+        df = svc.session.create_dataframe(_data())
+        h1 = svc.submit(_agg(df), tenant="a")
+        assert h1.wait(60) and h1.state == "FINISHED"
+        assert h1.event_record["quarantined"] is False
+        # the fingerprint is computed lazily (clean submissions never
+        # pay the walk) — derive the strike key the way a kill would
+        fp = template_fingerprint(h1.plan, svc.conf)
+        QUARANTINE.strike(fp, "test strike", max_strikes=99)
+        h2 = svc.submit(_agg(df), tenant="b")
+        assert h2.wait(60) and h2.state == "FINISHED"
+        assert h2.event_record["quarantined"] is True
+
+
+# ---------------------------------------------------------------------------
+# health states + degraded-mode shedding
+# ---------------------------------------------------------------------------
+
+
+def test_degraded_sheds_lowest_weight_pool():
+    """DEGRADED admission: while higher-weight work is in flight, the
+    lowest-weight pool is shed; completions clear the state and lift
+    the shed. An IDLE degraded service admits the shed pool instead
+    (forward progress — only completions pay the latch down, so
+    shedding the sole traffic source would wedge DEGRADED forever)."""
+    # one slow worker: the gold query provably stays RUNNING while the
+    # bronze submission is evaluated (50ms sleep per dispatch)
+    with QueryService({"spark.rapids.service.pools":
+                       "gold:weight=2;bronze:weight=1",
+                       "spark.rapids.test.faults":
+                       "dispatch.kernel:slow:1.0"},
+                      max_concurrent=1) as svc:
+        df = svc.session.create_dataframe(_data())
+        assert svc.health()["state"] == "HEALTHY"
+        with svc._cond:
+            svc._degraded_pending = svc._DEGRADE_CLEAR_SUCCESSES
+        assert svc.health()["state"] == "DEGRADED"
+        h1 = svc.submit(_agg(df), tenant="t", pool="gold")
+        h2 = svc.submit(_agg(df), tenant="t", pool="gold")
+        with pytest.raises(QueryRejectedError) as ei:
+            svc.submit(_agg(df), tenant="t", pool="bronze")
+        assert "DEGRADED" in str(ei.value)
+        assert ei.value.retry_after_ms >= 50
+        assert h1.wait(60) and h2.wait(60)
+        assert svc.health()["state"] == "HEALTHY"
+        # shedding lifted with the state
+        h3 = svc.submit(_agg(df), tenant="t", pool="bronze")
+        assert h3.wait(60) and h3.state == "FINISHED"
+
+    # the forward-progress escape: degraded but IDLE -> bronze admitted
+    with QueryService({"spark.rapids.service.pools":
+                       "gold:weight=2;bronze:weight=1"}) as svc:
+        df = svc.session.create_dataframe(_data())
+        with svc._cond:
+            svc._degraded_pending = svc._DEGRADE_CLEAR_SUCCESSES
+        h = svc.submit(_agg(df), tenant="t", pool="bronze")
+        assert h.wait(60) and h.state == "FINISHED"
+
+
+# ---------------------------------------------------------------------------
+# satellites
+# ---------------------------------------------------------------------------
+
+
+def test_semaphore_timeout_mid_query_leaves_no_stale_state(monkeypatch):
+    """SemaphoreTimeoutError inside execute: the per-thread query state
+    unwinds, no cancel scope leaks, and no executable-cache tree stays
+    checked out (busy count back to zero); the pool then serves the
+    same query normally."""
+    from spark_rapids_tpu.plan.executable_cache import EXEC_CACHE
+    from spark_rapids_tpu.runtime import TpuSemaphore
+    from spark_rapids_tpu.service.query import current_cancel_scope
+
+    def _timeout(self, timeout=None):
+        raise SemaphoreTimeoutError("injected semaphore timeout")
+
+    with QueryService({}) as svc:
+        df = svc.session.create_dataframe(_data())
+        monkeypatch.setattr(TpuSemaphore, "acquire_if_necessary",
+                            _timeout)
+        h = svc.submit(_agg(df), tenant="a")
+        assert h.wait(timeout=60)
+        assert h.state == "FAILED"
+        assert isinstance(h.error, SemaphoreTimeoutError)
+        monkeypatch.undo()
+        # no tree stuck checked out, no residual device holders
+        assert EXEC_CACHE.stats()["busyTrees"] == 0
+        sem = TpuSemaphore.current()
+        assert sem is None or sem.holders == 0
+        # the worker thread's scope contextvar was reset by the
+        # cancel_scope CM (same thread serves the next query)
+        h2 = svc.submit(_agg(df), tenant="a")
+        assert h2.wait(timeout=60) and h2.state == "FINISHED"
+        _check_result(h2.result_table)
+        # direct (unscoped) caller: per-thread state unwinds too
+        with pytest.raises(SemaphoreTimeoutError):
+            monkeypatch.setattr(TpuSemaphore, "acquire_if_necessary",
+                                _timeout)
+            _agg(df).collect_table()
+        monkeypatch.undo()
+        assert svc.session._q.exec_depth == 0
+        assert current_cancel_scope() is None
+        assert EXEC_CACHE.stats()["busyTrees"] == 0
+
+
+def test_spill_disk_files_removed_on_shutdown(tmp_path):
+    """Disk-tier spill files no longer outlive the catalog: release()
+    unlinks, shutdown() sweeps the rest, and the atexit sweep covers
+    hard-teardown leftovers."""
+    import os
+
+    from spark_rapids_tpu.columnar import DeviceTable, HostTable
+    from spark_rapids_tpu.runtime.spill import (
+        BufferCatalog,
+        SpillableBatch,
+        _atexit_spill_sweep,
+    )
+
+    disk_dir = str(tmp_path)
+    cat = BufferCatalog(host_limit_bytes=1, disk_dir=disk_dir)
+    host = HostTable.from_pydict({"v": np.arange(64, dtype=np.int64)})
+    sb = SpillableBatch(DeviceTable.from_host(host), cat)
+    sb.spill_to_host()
+    sb.spill_to_disk()
+    files = os.listdir(disk_dir)
+    assert len(files) == 1 and files[0].startswith("rapids_spill_")
+    # release() path unlinks its own file
+    sb2 = SpillableBatch(DeviceTable.from_host(host), cat)
+    sb2.spill_to_host()
+    sb2.spill_to_disk()
+    sb2.release()
+    assert len(os.listdir(disk_dir)) == 1
+    # shutdown releases every registered spillable (their release()
+    # unlinks) then sweeps whatever remains — nothing survives
+    cat.shutdown()
+    assert os.listdir(disk_dir) == []
+    # atexit sweep: a file that escaped release/shutdown still goes
+    sb3 = SpillableBatch(DeviceTable.from_host(host), cat)
+    sb3.spill_to_host()
+    sb3.spill_to_disk()
+    assert len(os.listdir(disk_dir)) == 1
+    _atexit_spill_sweep()
+    assert os.listdir(disk_dir) == []
+
+
+def test_buffer_catalog_counter_bumps_are_locked():
+    """The spill counters are bumped from concurrent retry/service
+    paths; the read-modify-write must hold the catalog lock (it did
+    not — increments were lost under contention)."""
+    from spark_rapids_tpu.runtime.spill import BufferCatalog
+
+    cat = BufferCatalog()
+    n, threads = 500, []
+    for _ in range(4):
+        t = threading.Thread(
+            target=lambda: [cat._bump("spill_device_count", 1)
+                            for _ in range(n)])
+        threads.append(t)
+        t.start()
+    for t in threads:
+        t.join()
+    assert cat.spill_device_count == 4 * n
+
+
+def test_stats_snapshot_consistent_under_concurrency():
+    """QueryService.stats() takes the scheduler lock for the whole
+    snapshot while workers mutate counters; lifecycle counters must
+    add up exactly afterwards and every interim snapshot must be
+    internally sane (never more running than workers)."""
+    with QueryService({}, max_concurrent=3) as svc:
+        df = svc.session.create_dataframe(_data())
+        stop = threading.Event()
+        bad = []
+
+        def hammer():
+            while not stop.is_set():
+                st = svc.stats()
+                if st["running"] > svc.max_concurrent or \
+                        st["running"] < 0:
+                    bad.append(st)
+                svc.health()
+
+        reader = threading.Thread(target=hammer)
+        reader.start()
+        handles = [svc.submit(_agg(df), tenant=f"t{i % 3}")
+                   for i in range(12)]
+        for h in handles:
+            assert h.wait(timeout=120)
+        stop.set()
+        reader.join(timeout=10)
+        assert not bad
+        st = svc.stats()
+        assert st["submitted"] == 12
+        assert (st["finished"] + st["failed"] + st["cancelled"]
+                + st["timed_out"]) == 12
+        assert st["finished"] == 12
+
+
+# ---------------------------------------------------------------------------
+# fault-spec plumbing for the new kinds/points
+# ---------------------------------------------------------------------------
+
+
+def test_new_fault_kinds_parse_and_fire():
+    from spark_rapids_tpu.runtime.faults import parse_fault_spec
+
+    armed = parse_fault_spec(
+        "device.lost:device_lost:1;dispatch.wedge:wedge:2:9;"
+        "service.worker_crash:crash:0.5:3")
+    assert [a.kind for a in armed] == ["device_lost", "wedge", "crash"]
+    with pytest.raises(Exception):
+        parse_fault_spec("device.lost:nosuchkind:1")
+    with pytest.raises(Exception):
+        parse_fault_spec("service.nosuchpoint:crash:1")
