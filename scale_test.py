@@ -10,7 +10,12 @@ randomized-but-SEEDED fault schedule (fetch errors, transport
 disconnects, corrupt frames, kernel crashes injected through
 ``spark.rapids.test.faults`` — runtime/faults.py) — asserting
 bit-identical results and bounded recovery work, with per-query
-retry/recompute/demotion counts in the JSON report."""
+retry/recompute/demotion counts in the JSON report. It also runs the
+WRITE corpus (run_write_chaos): seeded kill-mid-write scenarios
+asserting the exactly-once transactional-write contract — no torn
+file ever reader-visible, rerun-after-kill bit-identical, Delta
+concurrent commits converge through the rebase-and-retry loop, and
+vacuum reports zero orphans afterwards."""
 
 from __future__ import annotations
 
@@ -579,6 +584,212 @@ CHAOS_BOUNDS = {"fetch_retries": 500, "recomputed_maps": 200,
                 "query_replays": 12}
 
 
+# ---------------------------------------------------------------------------
+# Write chaos: the exactly-once contract under kill-mid-write
+# ---------------------------------------------------------------------------
+
+
+def run_write_chaos(seed: int = 7, base_dir=None) -> dict:
+    """Seeded kill-mid-write corpus asserting the transactional write
+    contract (io/committer.py + delta conflict retry):
+
+    * **no torn files** — a write killed at the file write or at a
+      task-commit rename leaves the destination exactly as it was
+      (old data fully intact, zero new ``part-*`` visible, staging
+      swept by abort);
+    * **rerun converges** — re-running the SAME WriteFiles plan after
+      the injected kill produces output bit-identical to a fault-free
+      write;
+    * **transparent replay** — with the runtime-fallback replay armed,
+      a crash mid-write auto-replays and the query COMPLETES with
+      exactly-once output (no doubled files);
+    * **Delta concurrency** — concurrent disjoint appends from one
+      snapshot both land via the rebase-and-retry loop; an injected
+      ``delta.commit.race`` is absorbed with commitRetries counted;
+    * **zero orphans** — after every scenario ``tools vacuum`` reports
+      a clean directory (dry-run first, then delete, then dry-run
+      again must be empty)."""
+    import os
+    import tempfile
+    import threading
+
+    from spark_rapids_tpu.io.committer import TEMP_DIR, WRITE_METRICS
+    from spark_rapids_tpu.plan import nodes as P
+    from spark_rapids_tpu.runtime.faults import FAULTS
+    from spark_rapids_tpu.session import TpuSession
+    from spark_rapids_tpu.tools.vacuum import run_vacuum
+
+    base = base_dir or tempfile.mkdtemp(prefix="rapids_write_chaos_")
+    failures = []
+    report = {"seed": seed, "dir": base, "scenarios": {}}
+
+    def _frame(s, n=200):
+        import numpy as np
+        rng = np.random.default_rng(seed)
+        return s.create_dataframe({
+            "k": [f"k{i % 5}" for i in range(n)],
+            "v": np.arange(n, dtype=np.int64),
+            "x": rng.standard_normal(n)})
+
+    def _visible(path):
+        """part-* files a scan would see (what expand_paths lists)."""
+        out = []
+        for root, dirs, files in os.walk(path):
+            dirs[:] = [d for d in dirs if not d.startswith(("_", "."))]
+            out.extend(os.path.join(root, f) for f in files
+                       if not f.startswith(("_", ".")))
+        return sorted(out)
+
+    def _assert_clean_vacuum(path, entry):
+        rep = run_vacuum(path)
+        entry["orphansAfter"] = len(rep["orphans"])
+        if rep["orphans"]:
+            failures.append(
+                f"{entry['name']}: vacuum found orphans {rep['orphans']}")
+
+    def _read_back(s, path, fmt):
+        if fmt == "parquet":
+            df = s.read_parquet(path)
+        else:
+            df = s.read_csv(path, header=True)
+        return sorted(df.collect(), key=repr)
+
+    # -- scenario: kill at the file write / at the commit rename, both
+    # formats, partitioned and not; typed failure then rerun converges
+    kill_specs = [
+        ("parquet", None, "io.write.file:crash:1:%d" % (seed * 10 + 1)),
+        ("parquet", ["k"], "io.write.file:crash:1:%d" % (seed * 10 + 2)),
+        ("parquet", ["k"], "io.write.commit:crash:1:%d" % (seed * 10 + 3)),
+        ("csv", None, "io.write.commit:crash:1:%d" % (seed * 10 + 4)),
+    ]
+    for i, (fmt, part_by, spec) in enumerate(kill_specs):
+        name = f"kill_{fmt}_{'part' if part_by else 'flat'}_{i}"
+        entry = {"name": name, "spec": spec}
+        clean_dir = os.path.join(base, name, "clean")
+        dest = os.path.join(base, name, "out")
+        s_clean = TpuSession()
+        writer = getattr(_frame(s_clean), f"write_{fmt}")
+        writer(clean_dir, partition_by=part_by)
+        expected = _read_back(s_clean, clean_dir, fmt)
+
+        # v1 of the destination: old data a killed overwrite must keep
+        # (written FAULT-FREE by the clean session — the kill is for
+        # the overwrite attempt, not the setup)
+        old = s_clean.create_dataframe({"k": ["old"], "v": [0],
+                                        "x": [0.0]})
+        getattr(old, f"write_{fmt}")(dest, partition_by=part_by)
+        before = _visible(dest)
+
+        s_kill = TpuSession({"spark.rapids.test.faults": spec,
+                             "spark.rapids.sql.runtimeFallback.enabled":
+                                 "false"})
+        df = _frame(s_kill)
+        node = P.WriteFiles(df.plan, fmt, dest, part_by, {})
+        try:
+            s_kill.execute(node)
+            failures.append(f"{name}: injected kill did not fire")
+        except Exception as exc:
+            entry["killed"] = type(exc).__name__
+        entry["oldDataIntact"] = _visible(dest) == before
+        if not entry["oldDataIntact"]:
+            failures.append(f"{name}: reader-visible files changed "
+                            "under a killed write")
+        if os.path.isdir(os.path.join(dest, TEMP_DIR)):
+            failures.append(f"{name}: staging not swept by abort")
+        # rerun the SAME plan: the armed count is spent, the job id is
+        # the same — then vacuum drops the files the new manifest no
+        # longer references (the old data's superseded partitions) and
+        # the readable output must converge bit-identically
+        s_kill.execute(node)
+        run_vacuum(dest, delete=True)
+        got = _read_back(s_kill, dest, fmt)
+        entry["rerunIdentical"] = got == expected
+        if got != expected:
+            failures.append(f"{name}: rerun-after-kill diverged")
+        _assert_clean_vacuum(dest, entry)
+        report["scenarios"][name] = entry
+
+    # -- scenario: transparent replay — crash mid-write with the
+    # runtime-fallback replay armed completes exactly-once
+    name = "replay_parquet_part"
+    spec = "io.write.file:crash:1:%d" % (seed * 10 + 5)
+    s_rep = TpuSession({"spark.rapids.test.faults": spec})
+    dest = os.path.join(base, name, "out")
+    clean_dir = os.path.join(base, name, "clean")
+    _frame(TpuSession()).write_parquet(clean_dir, partition_by=["k"])
+    stats = _frame(s_rep).write_parquet(dest, partition_by=["k"])
+    # capture BEFORE the read-backs: each later execute on this
+    # session overwrites the last-query mirror with its own 0
+    replays = int(s_rep.last_fault_replays or 0)
+    got = _read_back(s_rep, dest, "parquet")
+    expected = _read_back(s_rep, clean_dir, "parquet")
+    entry = {"name": name, "spec": spec, "replays": replays,
+             "identical": got == expected,
+             "numFiles": int(stats.to_pydict()["numFiles"][0])}
+    if not entry["replays"]:
+        failures.append(f"{name}: crash did not trigger a replay")
+    if not entry["identical"]:
+        failures.append(f"{name}: replayed write not exactly-once")
+    _assert_clean_vacuum(dest, entry)
+    report["scenarios"][name] = entry
+
+    # -- scenario: Delta — injected commit race + two real concurrent
+    # disjoint appends through the rebase-and-retry loop
+    name = "delta_concurrent"
+    from spark_rapids_tpu.delta.log import DeltaLog
+    from spark_rapids_tpu.delta.table import (
+        OptimisticTransaction,
+        _write_data_file,
+        write_delta,
+    )
+    table_dir = os.path.join(base, name)
+    spec = "delta.commit.race:race:1:%d" % (seed * 10 + 6)
+    s_d = TpuSession({"spark.rapids.test.faults": spec})
+    retries0 = WRITE_METRICS["commitRetries"]
+    write_delta(_frame(s_d, 50).plan, s_d, table_dir, mode="error")
+    entry = {"name": name, "spec": spec,
+             "raceRetries": WRITE_METRICS["commitRetries"] - retries0}
+    if entry["raceRetries"] < 1:
+        failures.append(f"{name}: injected race was not retried")
+    log = DeltaLog(table_dir)
+    snap_v = log.latest_version()
+    errs = []
+    barrier = threading.Barrier(2)
+
+    def _append(tag):
+        from spark_rapids_tpu.columnar import HostTable
+        txn = OptimisticTransaction(log, s_d.conf, read_version=snap_v)
+        txn.stage(_write_data_file(
+            table_dir, HostTable.from_pydict({
+                "k": [tag], "v": [999], "x": [0.0]}), {}))
+        barrier.wait()
+        try:
+            txn.commit("WRITE (append)")
+        except Exception as exc:  # noqa: BLE001 - report, don't hang
+            errs.append(f"{tag}: {type(exc).__name__}: {exc}")
+
+    ts = [threading.Thread(target=_append, args=(t,)) for t in ("a", "b")]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    entry["concurrentAppendErrors"] = errs
+    if errs:
+        failures.append(f"{name}: concurrent appends failed: {errs}")
+    rows = s_d.read_delta(table_dir).count()
+    entry["rows"] = rows
+    if rows != 52:
+        failures.append(f"{name}: expected 52 rows after two appends, "
+                        f"got {rows}")
+    _assert_clean_vacuum(table_dir, entry)
+    report["scenarios"][name] = entry
+
+    FAULTS.disarm()
+    report["ok"] = not failures
+    report["failures"] = failures
+    return report
+
+
 def run_chaos(sf: float = 0.02, seed: int = 7, queries=None,
               use_sql: bool = False, concurrency: int = 0,
               service_faults: bool = False):
@@ -605,6 +816,11 @@ def run_chaos(sf: float = 0.02, seed: int = 7, queries=None,
         raise SystemExit(
             "--service-faults needs --concurrency > 1 (the service "
             "points live in the worker/watchdog machinery)")
+    # write corpus FIRST, self-contained (own sessions, own fault
+    # specs, disarms at the end): the read corpus's seeded schedule
+    # must then advance uninterrupted across q1-q22
+    write_report = run_write_chaos(seed)
+
     specs = scale_test_specs(sf)
     tables = {name: spec.generate_table(sf, seed=seed)
               for name, spec in specs.items()}
@@ -624,8 +840,9 @@ def run_chaos(sf: float = 0.02, seed: int = 7, queries=None,
               "fault_spec": chaotic.conf.to_dict()[
                   "spark.rapids.test.faults"],
               "service_faults": service_faults,
+              "writes": write_report,
               "queries": {}}
-    failures = []
+    failures = list(write_report["failures"])
     # ALL fault-free runs first: each execute() re-arms the registry from
     # its session's conf, and interleaving arm("")/arm(spec) would reset
     # the seeded schedule every query — the RNG must advance ACROSS the

@@ -526,29 +526,80 @@ class DeltaTable:
         return {"files_removed": removed, "files_added": added}
 
     # -- VACUUM --------------------------------------------------------------
-    def vacuum(self) -> dict:
-        """Delete data files not referenced by the LATEST snapshot (the
-        retention check is the caller's concern in this engine)."""
-        snap = self.log.snapshot()
-        live = {a.path for a in snap.files}
-        live |= {a.deletion_vector["pathOrInlineDv"] for a in snap.files
-                 if a.deletion_vector}
-        deleted = 0
-        for root, _dirs, files in os.walk(self.table_path):
-            if "_delta_log" in root:
+    def vacuum(self, dry_run: bool = False,
+               retention_hours: Optional[float] = None) -> dict:
+        """Delete data files not referenced by the LATEST snapshot.
+        ``dry_run`` reports the orphans without touching them;
+        ``retention_hours`` (default: the
+        ``spark.rapids.delta.vacuum.retentionHours`` conf) keeps
+        orphans younger than the window — a concurrent uncommitted
+        transaction may still be about to commit them."""
+        return vacuum_table(self.table_path, conf=self.session.conf,
+                            dry_run=dry_run,
+                            retention_hours=retention_hours)
+
+
+def vacuum_table(table_path: str, conf=None, dry_run: bool = False,
+                 retention_hours: Optional[float] = None) -> dict:
+    """VACUUM over a Delta table directory: every file not referenced
+    by the latest snapshot (data files, resolved deletion-vector files)
+    is an orphan — leftovers of overwritten versions, failed/conflicted
+    transactions, or jobs that died mid-write. ``tools vacuum`` and
+    :meth:`DeltaTable.vacuum` share this implementation; no session
+    needed."""
+    from spark_rapids_tpu.conf import RapidsConf
+    from spark_rapids_tpu.delta.table import _dv_relative_path
+    from spark_rapids_tpu.io.committer import (
+        DELTA_VACUUM_RETENTION_HOURS,
+        WRITE_METRICS,
+        unlink_and_prune,
+        vacuum_protection,
+    )
+    conf = conf if conf is not None else RapidsConf()
+    if retention_hours is None:
+        retention_hours = float(
+            conf.get_entry(DELTA_VACUUM_RETENTION_HOURS))
+    log = DeltaLog(table_path)
+    snap = log.snapshot()
+    live = {a.path for a in snap.files}
+    for a in snap.files:
+        dv = a.deletion_vector
+        if not dv:
+            continue
+        # resolve the descriptor to the ON-DISK relative path ('u'
+        # storage encodes a base85 uuid, not a filename — matching the
+        # raw pathOrInlineDv would sweep every live DV file)
+        st = dv.get("storageType")
+        if st == "u":
+            live.add(_dv_relative_path(dv["pathOrInlineDv"]))
+        elif st == "p":
+            p = dv["pathOrInlineDv"]
+            if not os.path.isabs(p):
+                live.add(p)
+    protected = vacuum_protection(table_path, retention_hours)
+    orphans: List[str] = []
+    for root, dirs, files in os.walk(table_path):
+        dirs[:] = [d for d in dirs if d != "_delta_log"]
+        for f in sorted(files):
+            full = os.path.join(root, f)
+            rel = os.path.relpath(full, table_path)
+            if rel.startswith(CDF_DIR):
+                # cdc files are owned by the change feed, not the
+                # snapshot; without a retention clock vacuum leaves
+                # them for table_changes
                 continue
-            for f in files:
-                full = os.path.join(root, f)
-                rel = os.path.relpath(full, self.table_path)
-                if rel.startswith(("_delta_log", CDF_DIR)):
-                    # cdc files are owned by the change feed, not the
-                    # snapshot; without a retention clock vacuum leaves
-                    # them for table_changes
-                    continue
-                if rel not in live:
-                    os.unlink(full)
-                    deleted += 1
-        return {"files_deleted": deleted}
+            if rel in live or protected(full):
+                continue
+            orphans.append(rel)
+    deleted = 0
+    if not dry_run:
+        deleted = unlink_and_prune(table_path, orphans,
+                                   keep_dirs=("_delta_log", CDF_DIR))
+        if deleted:
+            WRITE_METRICS.add("vacuumedFiles", deleted)
+    return {"files_deleted": deleted, "orphans": orphans,
+            "dry_run": bool(dry_run),
+            "retention_hours": retention_hours}
 
 
 def _mask_permute(table: HostTable, order: np.ndarray) -> HostTable:

@@ -31,7 +31,22 @@ LOG_DIR = "_delta_log"
 
 
 class DeltaConcurrentModificationException(ColumnarProcessingError):
-    pass
+    """Lost the optimistic version race. Base class: retryable when the
+    transaction is a blind append (the commit loop rebases); the typed
+    subclasses below are TRUE conflicts that must surface."""
+
+
+class DeltaMetadataChangedException(DeltaConcurrentModificationException):
+    """A concurrent winner changed table metadata/protocol (schema
+    evolution, property change, protocol upgrade) — staged actions read
+    state that no longer holds; blind retry would revert the winner."""
+
+
+class DeltaConcurrentWriteException(DeltaConcurrentModificationException):
+    """A concurrent winner's file actions OVERLAP this transaction's
+    (both touched existing files — DELETE/UPDATE/MERGE/overwrite vs
+    anything, or colliding add paths); retrying the stale actions would
+    silently lose the winner's changes."""
 
 
 # -- schema JSON (Spark StructType JSON) -------------------------------------
@@ -426,24 +441,55 @@ class DeltaLog:
         return Snapshot(target, meta, list(adds.values()))
 
     # -- commit -------------------------------------------------------------
+    def read_actions(self, version: int) -> List[dict]:
+        """The raw action objects of one committed version (conflict
+        classification reads the winners' commits through this)."""
+        p = os.path.join(self.log_path, f"{version:020d}.json")
+        with open(p) as f:
+            return [json.loads(line) for line in f if line.strip()]
+
     def commit(self, actions: List[dict], expected_version: int,
                op_name: str = "WRITE") -> int:
         """Atomically write version ``expected_version``; raises
         DeltaConcurrentModificationException if someone else won the race
-        (optimistic concurrency — the caller re-reads and retries)."""
+        (optimistic concurrency — OptimisticTransaction.commit re-reads,
+        classifies the conflict, and rebases blind appends)."""
+        import uuid as _uuid
+
+        from spark_rapids_tpu.runtime.faults import fault_point
         os.makedirs(self.log_path, exist_ok=True)
         payload = [{"commitInfo": {
             "timestamp": int(time.time() * 1000), "operation": op_name,
             "engineInfo": "spark-rapids-tpu"}}] + actions
         path = os.path.join(self.log_path, f"{expected_version:020d}.json")
+        # 'race' here simulates losing the version race without a real
+        # concurrent writer; 'crash' dies mid-commit (the version file
+        # either fully exists or not at all)
+        fault_point("delta.commit.race")
+        # publish ATOMICALLY: the payload is fully written to a temp
+        # name (never matching *.json, so log listings ignore it), then
+        # os.link claims the version — exclusive like open('x') AND
+        # content-complete at first visibility, so a concurrent loser's
+        # conflict classification can never read an empty/truncated
+        # winner commit
+        tmp = os.path.join(self.log_path,
+                           f"{expected_version:020d}.tmp-"
+                           f"{_uuid.uuid4().hex[:8]}")
         try:
-            with open(path, "x") as f:
+            with open(tmp, "w") as f:
                 for a in payload:
                     f.write(json.dumps(a) + "\n")
-        except FileExistsError:
-            raise DeltaConcurrentModificationException(
-                f"concurrent commit at version {expected_version} of "
-                f"{self.table_path}")
+            try:
+                os.link(tmp, path)
+            except FileExistsError:
+                raise DeltaConcurrentModificationException(
+                    f"concurrent commit at version {expected_version} "
+                    f"of {self.table_path}")
+        finally:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
         # a committed table write stales every cached service result
         # (the query-service result cache keys on pre-write state)
         from spark_rapids_tpu.service.result_cache import (

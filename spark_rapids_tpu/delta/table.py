@@ -25,7 +25,9 @@ from spark_rapids_tpu.errors import ColumnarProcessingError
 from spark_rapids_tpu.delta.log import (
     AddFile,
     DeltaConcurrentModificationException,
+    DeltaConcurrentWriteException,
     DeltaLog,
+    DeltaMetadataChangedException,
     Metadata,
     PROTOCOL_ACTION,
     RemoveFile,
@@ -391,8 +393,11 @@ def _write_data_file(table_path: str, table: HostTable,
 
 
 class OptimisticTransaction:
-    """Stage file writes, then commit with conflict retry
-    (GpuOptimisticTransaction analog)."""
+    """Stage file writes, then commit with conflict classification and
+    bounded rebase-and-retry (GpuOptimisticTransaction analog). A
+    transaction that ultimately FAILS sweeps the data files it staged
+    into the table directory — they are unreferenced by any committed
+    version and would otherwise sit as orphans until vacuum."""
 
     def __init__(self, log: DeltaLog, conf: RapidsConf,
                  read_version: Optional[int] = None):
@@ -400,42 +405,170 @@ class OptimisticTransaction:
         self.conf = conf
         self.read_version = read_version
         self.actions: List[dict] = []
+        #: full paths of files this txn wrote into the table dir —
+        #: shielded from concurrent vacuum until commit resolves
+        self._created: set = set()
 
     def stage(self, *actions):
+        from spark_rapids_tpu.io.committer import protect_files
         for a in actions:
-            self.actions.append(a.to_action() if hasattr(a, "to_action")
-                                else a)
+            act = a.to_action() if hasattr(a, "to_action") else a
+            self.actions.append(act)
+            rel = None
+            if "add" in act:
+                rel = act["add"].get("path")
+            elif "cdc" in act:
+                rel = act["cdc"].get("path")
+            if rel:
+                self._created.add(
+                    os.path.join(self.log.table_path, rel))
+        if self._created:
+            protect_files(self, self.log.table_path, self._created)
 
-    def commit(self, op_name: str, max_retries: int = 10) -> int:
+    # -- conflict handling ---------------------------------------------------
+    def _classify_conflict(self, attempt: int):
+        """Examine the winners' commits in [attempt, latest]; raise the
+        typed conflict when this transaction cannot safely rebase, else
+        return (no raise) meaning a blind-append rebase is legal.
+
+        Rebase is legal exactly when this transaction is a PURE APPEND
+        (no removes, no metadata — unique new files never invalidate a
+        reader) AND no winner changed metadata/protocol AND no winner's
+        add collides with ours on path. Everything staging removes
+        (DELETE/UPDATE/MERGE/overwrite) read table state the winner may
+        have changed — retrying those stale actions would silently lose
+        the winner's commit."""
+        try:
+            latest = self.log.latest_version()
+        except ColumnarProcessingError:
+            return  # injected race on a log with no winner: plain retry
+        pure_append = all("remove" not in a and "metaData" not in a
+                          and "protocol" not in a for a in self.actions)
+        my_adds = {a["add"]["path"] for a in self.actions if "add" in a}
+        for v in range(attempt, latest + 1):
+            try:
+                winner = self.log.read_actions(v)
+            except FileNotFoundError:
+                continue  # gap in the log: nothing to conflict with
+            except (OSError, ValueError) as exc:
+                # commit files publish atomically (content-complete at
+                # first visibility), so an unreadable/unparseable
+                # winner is durable corruption or an access failure —
+                # safety is unprovable; surface typed, never
+                # blind-rebase over a winner we could not inspect
+                raise DeltaConcurrentWriteException(
+                    f"cannot verify concurrent commit v{v} of "
+                    f"{self.log.table_path} ({exc}); not rebasing "
+                    "over an unreadable winner") from exc
+            for wa in winner:
+                if "metaData" in wa or "protocol" in wa:
+                    raise DeltaMetadataChangedException(
+                        f"concurrent commit v{v} of "
+                        f"{self.log.table_path} changed table "
+                        "metadata/protocol; re-read the table and "
+                        "re-derive the write")
+                if not pure_append and ("add" in wa or "remove" in wa):
+                    raise DeltaConcurrentWriteException(
+                        f"concurrent commit v{v} of "
+                        f"{self.log.table_path} wrote files this "
+                        "transaction's removes/rewrites were derived "
+                        "without; re-read the table and retry the "
+                        "command")
+                if "add" in wa and wa["add"].get("path") in my_adds:
+                    raise DeltaConcurrentWriteException(
+                        f"concurrent commit v{v} of "
+                        f"{self.log.table_path} added the same file "
+                        f"path {wa['add'].get('path')!r}")
+
+    def _sweep_staged_files(self) -> int:
+        """Delete the DATA files this failed transaction wrote into the
+        table directory. Only files this transaction CREATED are swept:
+        an add that re-stages an existing path with a deletion vector
+        (DELETE/MERGE DV path) or that was live at the read snapshot is
+        someone's committed data and stays."""
+        pre_existing: set = set()
+        if self.read_version is not None and self.read_version >= 0:
+            try:
+                pre_existing = {
+                    a.path
+                    for a in self.log.snapshot(self.read_version).files}
+            except ColumnarProcessingError:
+                pre_existing = set()
+        swept = 0
+        for act in self.actions:
+            if "add" in act:
+                a = act["add"]
+                if a.get("deletionVector") or a["path"] in pre_existing:
+                    continue
+                rel = a["path"]
+            elif "cdc" in act:
+                rel = act["cdc"]["path"]
+            else:
+                continue
+            full = os.path.join(self.log.table_path, rel)
+            try:
+                os.unlink(full)
+                swept += 1
+            except OSError:
+                pass
+        if swept:
+            from spark_rapids_tpu.io.committer import WRITE_METRICS
+            WRITE_METRICS.add("stagingFilesSwept", swept)
+        return swept
+
+    def commit(self, op_name: str, max_retries: Optional[int] = None) -> int:
+        from spark_rapids_tpu.io.committer import unprotect_files
+        try:
+            return self._commit(op_name, max_retries)
+        finally:
+            # the txn lifecycle ends either way: committed files are in
+            # the log (vacuum's live set), failed ones were swept —
+            # drop the concurrent-vacuum shield
+            unprotect_files(self)
+
+    def _commit(self, op_name: str, max_retries: Optional[int]) -> int:
+        from spark_rapids_tpu.io.committer import (
+            WRITE_COMMIT_RETRY_WAIT_MS,
+            WRITE_MAX_COMMIT_RETRIES,
+            WRITE_METRICS,
+        )
+        if max_retries is None:
+            max_retries = int(self.conf.get_entry(WRITE_MAX_COMMIT_RETRIES))
+        wait_s = int(
+            self.conf.get_entry(WRITE_COMMIT_RETRY_WAIT_MS)) / 1000.0
         base = self.read_version
         if base is None:
             try:
                 base = self.log.latest_version()
             except ColumnarProcessingError:
                 base = -1
-        # blind retry is only safe for PURE APPENDS (unique new files can
-        # never conflict on content). Anything staging removes (DELETE/
-        # UPDATE/MERGE/overwrite) read table state a concurrent winner may
-        # have changed — retrying its stale actions would silently lose the
-        # winner's changes, so the conflict surfaces to the caller.
-        # a staged Metadata (mergeSchema evolution) read the schema from
-        # a snapshot a concurrent winner may have evolved differently —
-        # blind-retrying it would silently revert the winner's schema
-        pure_append = all("remove" not in a and "metaData" not in a
-                          for a in self.actions)
         attempt = base + 1
-        for _ in range(max_retries):
+        for retry in range(max_retries + 1):
             try:
                 v = self.log.commit(self.actions, attempt, op_name)
                 self._maybe_checkpoint(v)
                 return v
             except DeltaConcurrentModificationException:
-                if not pure_append:
+                WRITE_METRICS.add("commitConflicts", 1)
+                try:
+                    # typed metadata/overlap conflicts raise from here;
+                    # a clean blind-append race falls through to rebase
+                    self._classify_conflict(attempt)
+                except DeltaConcurrentModificationException:
+                    self._sweep_staged_files()
                     raise
-                attempt += 1
+                try:
+                    attempt = self.log.latest_version() + 1
+                except ColumnarProcessingError:
+                    pass  # injected race before any commit exists
+                if retry < max_retries:
+                    WRITE_METRICS.add("commitRetries", 1)
+                    if wait_s > 0:
+                        time.sleep(wait_s)
+        self._sweep_staged_files()
         raise DeltaConcurrentModificationException(
             f"gave up committing to {self.log.table_path} after "
-            f"{max_retries} attempts")
+            f"{max_retries} retries")
 
     def _maybe_checkpoint(self, version: int):
         interval = int(self.conf.get_entry(DELTA_CHECKPOINT_INTERVAL))

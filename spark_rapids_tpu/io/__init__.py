@@ -19,6 +19,7 @@ from spark_rapids_tpu.io.arrow_convert import (
     host_table_to_arrow,
     arrow_schema_to_spark,
 )
+from spark_rapids_tpu.io.committer import WriteJob, read_manifest
 from spark_rapids_tpu.io.common import FileScanNode, ReaderMode
 from spark_rapids_tpu.io.parquet import ParquetScanNode, write_parquet
 from spark_rapids_tpu.io.orc import OrcScanNode, write_orc
@@ -47,4 +48,6 @@ __all__ = [
     "write_orc",
     "write_csv",
     "write_json",
+    "WriteJob",
+    "read_manifest",
 ]

@@ -1,0 +1,388 @@
+"""Transactional output committer — the engine's FileCommitProtocol.
+
+Reference: Spark's HadoopMapReduceCommitProtocol under
+DataWritingCommandExec (SURVEY.md §2.3): task output stages under
+``_temporary/<jobId>/<attempt>/`` mirroring the final directory layout,
+task commit promotes each staged file into place with an atomic rename,
+job commit publishes the ``_SUCCESS`` marker, and abort deletes the
+attempt's staging tree so a killed task can never leave torn files a
+scan would read.
+
+This engine's version strengthens the marker into a MANIFEST: the
+``_SUCCESS`` file is JSON recording the job id and the committed file
+list (with row/byte totals), which buys two contracts the reference
+gets from Spark's scheduler instead:
+
+* **exactly-once under replay** — a requeued service write (PR 7's
+  worker-loss/device-loss replay machinery re-submits the SAME plan
+  node, hence the same job id) finds its own id in the manifest and
+  returns the recorded stats instead of double-writing;
+* **vacuum** — ``tools vacuum`` diffs the directory against the
+  manifest to find un-referenced/staged orphans.
+
+Every byte of table output written under ``io/`` must flow through a
+:class:`WriteJob` staging path (enforced by the RL-WRITE-COMMIT lint
+rule); a torn file can therefore only ever exist under ``_temporary/``,
+which the scan listing prunes (io/common.expand_paths).
+
+Crash story: abort() rolls back promoted files and sweeps staging on
+any in-process failure; the crash handler's exit-20 path and an atexit
+hook sweep the staging trees of jobs still in flight when the process
+dies (the committed destination is untouched — a rerun of the same job
+re-stages and re-promotes the same deterministic filenames, so reruns
+converge bit-identically).
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import shutil
+import threading
+import time
+import uuid
+import weakref
+from typing import Dict, List, Optional, Tuple
+
+from spark_rapids_tpu.conf import float_conf, int_conf
+from spark_rapids_tpu.obs.metrics import metric_scope, register_metric
+from spark_rapids_tpu.runtime.faults import fault_point
+
+#: staging root inside the destination directory; '_'-prefixed so the
+#: scan listing (io/common.expand_paths) prunes it
+TEMP_DIR = "_temporary"
+SUCCESS_MARKER = "_SUCCESS"
+
+WRITE_MAX_COMMIT_RETRIES = int_conf(
+    "spark.rapids.sql.write.maxCommitRetries", 10,
+    "Bound on the Delta optimistic-commit retry loop: a blind append "
+    "that keeps losing the version race rebases and retries at most "
+    "this many times before raising "
+    "DeltaConcurrentModificationException.", commonly_used=True)
+
+WRITE_COMMIT_RETRY_WAIT_MS = int_conf(
+    "spark.rapids.sql.write.commitRetryWaitMs", 5,
+    "Sleep between Delta optimistic-commit retries, milliseconds "
+    "(linear; the conflict window is one log-file create, not a "
+    "network round trip).")
+
+DELTA_VACUUM_RETENTION_HOURS = float_conf(
+    "spark.rapids.delta.vacuum.retentionHours", 0.0,
+    "Vacuum retention window: un-referenced files younger than this "
+    "many hours are kept (a concurrent uncommitted writer may still "
+    "reference them). 0 disables the age check and removes every "
+    "orphan.")
+
+#: the ``write`` metric scope — committer + Delta transaction counters
+#: the event log snapshots per query (filesWritten/bytesWritten/
+#: commitRetries ride the record as explicit fields)
+WRITE_METRICS = metric_scope("write")
+for _name, _kind, _doc in (
+        ("filesWritten", "count", "data files committed into place by "
+                                  "the transactional writer"),
+        ("bytesWritten", "bytes", "bytes of committed data files"),
+        ("jobsCommitted", "count", "write jobs that published their "
+                                   "_SUCCESS manifest"),
+        ("jobsAborted", "count", "write jobs rolled back (promoted "
+                                 "files deleted, staging swept)"),
+        ("stagingFilesSwept", "count", "staged files removed by write-"
+                                       "job abort/rollback and failed "
+                                       "Delta transactions (write-path "
+                                       "failure signal — vacuum "
+                                       "housekeeping counts separately "
+                                       "as vacuumedFiles)"),
+        ("vacuumedFiles", "count", "un-referenced files removed by "
+                                   "vacuum (routine housekeeping: "
+                                   "overwritten versions, superseded "
+                                   "jobs, dead staging)"),
+        ("commitRetries", "count", "Delta optimistic commits rebased "
+                                   "and retried after losing the "
+                                   "version race"),
+        ("commitConflicts", "count", "Delta commit conflicts observed "
+                                     "(retried blind appends plus "
+                                     "typed metadata/overlap raises)"),
+):
+    register_metric(_name, _kind, "ESSENTIAL", _doc)
+    WRITE_METRICS.setdefault(_name, 0)
+del _name, _kind, _doc
+
+#: in-flight jobs, keyed by (path, job_id) — the crash handler's
+#: exit-20 path and the atexit hook sweep these staging trees so a
+#: dying process cannot leak _temporary/ files into later scans
+_ACTIVE_JOBS: Dict[Tuple[str, str], "WriteJob"] = {}
+_ACTIVE_LOCK = threading.Lock()
+
+#: files other in-flight writers own, owner -> (base_path, full paths)
+#: — Delta OptimisticTransactions write data files into the table dir
+#: BEFORE their log commit lands, and vacuum must not sweep those out
+#: from under them. Weak keys: an abandoned transaction auto-expires
+#: its protection.
+_PROTECTED_OWNERS: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+def protect_files(owner, base_path: str, full_paths) -> None:
+    """Shield ``full_paths`` (under ``base_path``) from vacuum for the
+    owner's lifetime (or until :func:`unprotect_files`)."""
+    with _ACTIVE_LOCK:
+        _PROTECTED_OWNERS[owner] = (base_path, set(full_paths))
+
+
+def unprotect_files(owner) -> None:
+    with _ACTIVE_LOCK:
+        _PROTECTED_OWNERS.pop(owner, None)
+
+
+class WriteJob:
+    """One transactional write job over a destination directory.
+
+    Lifecycle: ``stage_path()`` per output file (the writer writes the
+    staged path), ``commit_task()`` promotes every staged file with an
+    atomic ``os.replace``, ``commit_job()`` publishes the ``_SUCCESS``
+    manifest and sweeps staging, ``abort()`` rolls the job back. A job
+    is single-use; the job id is the idempotency key reruns check."""
+
+    def __init__(self, path: str, job_id: Optional[str] = None,
+                 attempt: int = 0):
+        self.path = path
+        self.job_id = job_id or uuid.uuid4().hex[:16]
+        self.attempt = attempt
+        self.staging = os.path.join(path, TEMP_DIR, self.job_id,
+                                    str(attempt))
+        self._staged: List[Tuple[str, str]] = []   # (staged abs, rel)
+        #: (final abs path, backup abs path or None) per promoted file
+        self._promoted: List[Tuple[str, Optional[str]]] = []
+        self._done = False
+        os.makedirs(self.staging, exist_ok=True)
+        with _ACTIVE_LOCK:
+            _ACTIVE_JOBS[(self.path, self.job_id)] = self
+
+    # -- task side -----------------------------------------------------------
+    def stage_path(self, rel: str) -> str:
+        """Staging location for one output file at final relative path
+        ``rel`` (partition subdirs included); registers the file for
+        promotion at task commit."""
+        staged = os.path.join(self.staging, rel)
+        os.makedirs(os.path.dirname(staged), exist_ok=True)
+        self._staged.append((staged, rel))
+        return staged
+
+    def commit_task(self) -> List[str]:
+        """Promote every staged file into its final destination —
+        atomic per file (os.replace), so a reader concurrently listing
+        the directory sees each file either absent or complete, never
+        torn. A destination file that already exists (an overwrite of
+        an earlier job's output at the same relative path) is first
+        moved aside into the staging tree, so abort() can RESTORE it —
+        without the backup, a crash mid-promotion would have destroyed
+        the only copy of previously committed data."""
+        final = []
+        for staged, rel in self._staged:
+            dst = os.path.join(self.path, rel)
+            d = os.path.dirname(dst)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            fault_point("io.write.commit")
+            backup = None
+            if os.path.exists(dst):
+                backup = os.path.join(self.staging, ".backup", rel)
+                os.makedirs(os.path.dirname(backup), exist_ok=True)
+                os.replace(dst, backup)
+            # record BEFORE the promoting replace: a failure between
+            # the two renames must still restore the backup (an
+            # unrecorded backup would be swept with staging — the only
+            # copy of the old committed file gone)
+            self._promoted.append((dst, backup))
+            os.replace(staged, dst)
+            final.append(dst)
+        self._staged = []
+        return final
+
+    # -- job side ------------------------------------------------------------
+    def commit_job(self, num_rows: int = 0) -> dict:
+        """Publish the ``_SUCCESS`` manifest (atomically, via a staged
+        temp file) listing every committed file, then sweep this job's
+        staging tree. Returns the manifest dict."""
+        if self._staged:
+            self.commit_task()
+        rels = sorted(os.path.relpath(p, self.path)
+                      for p, _backup in self._promoted)
+        num_bytes = sum(os.path.getsize(p)
+                        for p, _backup in self._promoted)
+        manifest = {
+            "jobId": self.job_id,
+            "attempt": self.attempt,
+            "numFiles": len(rels),
+            "numRows": int(num_rows),
+            "numBytes": int(num_bytes),
+            "files": rels,
+            "committedAt": int(time.time() * 1000),
+        }
+        tmp = os.path.join(self.staging, SUCCESS_MARKER)
+        with open(tmp, "w") as f:
+            json.dump(manifest, f)
+        os.replace(tmp, os.path.join(self.path, SUCCESS_MARKER))
+        # routine cleanup, not a failure signal: the sweep here only
+        # discards .backup copies of files this job overwrote
+        self._sweep_staging(record=False)
+        self._finish()
+        WRITE_METRICS.add("filesWritten", len(rels))
+        WRITE_METRICS.add("bytesWritten", num_bytes)
+        WRITE_METRICS.add("jobsCommitted", 1)
+        return manifest
+
+    def abort(self) -> None:
+        """Roll the job back: every promoted file is removed and any
+        destination file it clobbered is RESTORED from its backup,
+        then the staging tree is swept. Idempotent; cleanup never
+        raises (an abort runs inside exception handlers) though an
+        armed ``io.write.abort`` fault surfaces after it."""
+        if self._done:
+            return
+        try:
+            fault_point("io.write.abort")
+        finally:
+            for dst, backup in reversed(self._promoted):
+                try:
+                    if backup is not None:
+                        os.replace(backup, dst)  # restore the original
+                    else:
+                        os.unlink(dst)
+                    WRITE_METRICS.add("stagingFilesSwept", 1)
+                except OSError:
+                    pass
+            self._promoted = []
+            self._sweep_staging()
+            self._finish()
+            WRITE_METRICS.add("jobsAborted", 1)
+
+    # -- internals -----------------------------------------------------------
+    def _sweep_staging(self, record: bool = True) -> None:
+        """``record=False`` on the SUCCESS path: stagingFilesSwept is
+        the write-path failure signal and must not count the routine
+        discard of .backup copies after a healthy commit."""
+        job_root = os.path.join(self.path, TEMP_DIR, self.job_id)
+        swept = 0
+        for _root, _dirs, files in os.walk(job_root):
+            swept += len(files)
+        shutil.rmtree(job_root, ignore_errors=True)
+        if swept and record:
+            WRITE_METRICS.add("stagingFilesSwept", swept)
+        # drop _temporary/ itself once the last job under it is gone
+        try:
+            os.rmdir(os.path.join(self.path, TEMP_DIR))
+        except OSError:
+            pass
+        self._staged = []
+
+    def _finish(self) -> None:
+        self._done = True
+        with _ACTIVE_LOCK:
+            _ACTIVE_JOBS.pop((self.path, self.job_id), None)
+
+
+def read_manifest(path: str) -> Optional[dict]:
+    """The destination's ``_SUCCESS`` manifest, or None when absent or
+    a legacy empty marker (pre-committer writes touched an empty
+    file)."""
+    p = os.path.join(path, SUCCESS_MARKER)
+    try:
+        with open(p) as f:
+            m = json.load(f)
+        return m if isinstance(m, dict) and "jobId" in m else None
+    except (OSError, ValueError):
+        return None
+
+
+def sweep_active_jobs() -> int:
+    """Abort every in-flight job — the crash-handler exit-20 path
+    (os._exit skips normal unwinding, so no abort() would run) and the
+    atexit backstop. Runs the full rollback: promoted files removed,
+    clobbered originals restored from backup, staging swept."""
+    with _ACTIVE_LOCK:
+        jobs = list(_ACTIVE_JOBS.values())
+    for job in jobs:
+        try:
+            job.abort()
+        except Exception:
+            pass  # an armed io.write.abort fault must not stop the sweep
+    return len(jobs)
+
+
+def active_staging_dirs(path: str) -> List[str]:
+    """Staging roots of jobs currently in flight over ``path`` —
+    vacuum must never sweep these out from under a live writer."""
+    with _ACTIVE_LOCK:
+        return [j.staging for j in _ACTIVE_JOBS.values()
+                if j.path == path]
+
+
+def vacuum_protection(path: str, retention_hours: float):
+    """THE keep-predicate both vacuum implementations share
+    (tools/vacuum.py and delta/commands.vacuum_table): a file must be
+    kept when (a) it belongs to a writer in flight in this process —
+    a WriteJob's staging tree, files it has promoted but not yet
+    recorded in a manifest, or a Delta transaction's staged data files
+    (protect_files) — or (b) it is younger than the retention window
+    (a writer in ANOTHER process may be about to commit it; unreadable
+    mtimes count as young). Returns ``protected(full_path) -> bool``."""
+    with _ACTIVE_LOCK:
+        staging = [j.staging for j in _ACTIVE_JOBS.values()
+                   if j.path == path]
+        promoted = {p for j in _ACTIVE_JOBS.values() if j.path == path
+                    for p, _backup in list(j._promoted)}
+        promoted |= {p for bp, paths in _PROTECTED_OWNERS.values()
+                     if bp == path for p in paths}
+    cutoff = (time.time() - retention_hours * 3600.0
+              if retention_hours > 0 else None)
+
+    def protected(full: str) -> bool:
+        if full in promoted or any(
+                full.startswith(s + os.sep) for s in staging):
+            return True
+        if cutoff is not None:
+            try:
+                return os.path.getmtime(full) > cutoff
+            except OSError:
+                return True
+        return False
+
+    return protected
+
+
+def unlink_and_prune(base: str, rels, keep_dirs=()) -> int:
+    """Delete ``rels`` (relative to ``base``) then prune emptied
+    directories bottom-up; directories whose path contains a
+    ``keep_dirs`` name are never pruned. A live job's staging keeps
+    its files, so its directories survive the rmdir attempts. Returns
+    the count actually deleted."""
+    deleted = 0
+    for rel in rels:
+        try:
+            os.unlink(os.path.join(base, rel))
+            deleted += 1
+        except OSError:
+            pass
+    for root, _dirs, _files in os.walk(base, topdown=False):
+        if root == base or any(k in root.split(os.sep)
+                               for k in keep_dirs):
+            continue
+        try:
+            os.rmdir(root)
+        except OSError:
+            pass
+    return deleted
+
+
+atexit.register(sweep_active_jobs)
+
+
+def find_staging_orphans(path: str) -> List[str]:
+    """Every file under ``<path>/_temporary/`` — staged output of jobs
+    that died without abort (vacuum removes these)."""
+    root = os.path.join(path, TEMP_DIR)
+    out: List[str] = []
+    for dirpath, _dirs, files in os.walk(root):
+        for f in sorted(files):
+            out.append(os.path.join(dirpath, f))
+    return out

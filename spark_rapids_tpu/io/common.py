@@ -44,13 +44,33 @@ class ReaderMode:
 
 
 def expand_paths(paths: Sequence[str]) -> List[str]:
-    """Expand globs and directories into a sorted file list."""
+    """Expand globs and directories into a sorted file list.
+
+    Hidden entries — ``_``/``.``-prefixed files AND directories — are
+    excluded on every listing branch (Spark's InMemoryFileIndex
+    contract). Pruning directories matters for correctness, not just
+    hygiene: the transactional writer stages in-flight output under
+    ``_temporary/<job>/<attempt>/``, and those staged ``part-*`` files
+    must never be visible to a scan. Explicitly named single files are
+    honored as given (the caller asked for that exact path)."""
     out: List[str] = []
     for p in paths:
         if any(ch in p for ch in "*?["):
-            out.extend(sorted(_glob.glob(p)))
+            # reject hidden components anywhere a WILDCARD could have
+            # matched them (a glob crossing _temporary/ must not
+            # surface staged files) while honoring hidden components
+            # the caller spelled out in the static prefix
+            comps = p.split(os.sep)
+            first_wild = next(i for i, seg in enumerate(comps)
+                              if any(ch in seg for ch in "*?["))
+            for m in sorted(_glob.glob(p)):
+                tail = m.rstrip(os.sep).split(os.sep)[first_wild:]
+                if not any(c.startswith(("_", ".")) for c in tail if c):
+                    out.append(m)
         elif os.path.isdir(p):
-            for root, _dirs, files in sorted(os.walk(p)):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if not d.startswith(("_", ".")))
                 for f in sorted(files):
                     if not f.startswith(("_", ".")):
                         out.append(os.path.join(root, f))

@@ -328,9 +328,10 @@ class CsvScanNode(FileScanNode):
 
 def write_csv(table: HostTable, path: str,
               partition_by: Optional[Sequence[str]] = None,
-              header: bool = True) -> List[str]:
+              header: bool = True, committer=None) -> List[str]:
     def _write_one(tbl: HostTable, file_path: str):
         opts = pcsv.WriteOptions(include_header=header)
         pcsv.write_csv(host_table_to_arrow(tbl), file_path, opts)
 
-    return write_partitioned(table, path, _write_one, "csv", partition_by)
+    return write_partitioned(table, path, _write_one, "csv", partition_by,
+                             committer=committer)

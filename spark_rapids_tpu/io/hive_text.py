@@ -78,7 +78,8 @@ def write_hive_text(table: HostTable, path: str,
                     partition_by: Optional[Sequence[str]] = None,
                     delimiter: str = HIVE_DELIM,
                     null_value: str = HIVE_NULL,
-                    escape: Optional[str] = None) -> List[str]:
+                    escape: Optional[str] = None,
+                    committer=None) -> List[str]:
     def _write_one(tbl: HostTable, file_path: str):
         cols = [c.to_pylist() for c in tbl.columns]
         with open(file_path, "w") as f:
@@ -87,4 +88,5 @@ def write_hive_text(table: HostTable, path: str,
                     _hive_cell(cols[j][i], null_value, delimiter, escape)
                     for j in range(len(cols))) + "\n")
 
-    return write_partitioned(table, path, _write_one, "txt", partition_by)
+    return write_partitioned(table, path, _write_one, "txt", partition_by,
+                             committer=committer)

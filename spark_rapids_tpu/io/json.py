@@ -155,7 +155,8 @@ class JsonScanNode(FileScanNode):
 
 
 def write_json(table: HostTable, path: str,
-               partition_by: Optional[Sequence[str]] = None) -> List[str]:
+               partition_by: Optional[Sequence[str]] = None,
+               committer=None) -> List[str]:
     """JSON-lines writer (Arrow has no JSON writer; rows serialize via the
     host columns directly)."""
     def _write_one(tbl: HostTable, file_path: str):
@@ -165,4 +166,5 @@ def write_json(table: HostTable, path: str,
                 row = {n: cols[j][i] for j, n in enumerate(tbl.names)
                        if cols[j][i] is not None}
                 f.write(_json.dumps(row, default=str) + "\n")
-    return write_partitioned(table, path, _write_one, "json", partition_by)
+    return write_partitioned(table, path, _write_one, "json", partition_by,
+                             committer=committer)

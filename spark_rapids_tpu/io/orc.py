@@ -59,9 +59,10 @@ class OrcScanNode(FileScanNode):
 
 def write_orc(table: HostTable, path: str,
               partition_by: Optional[Sequence[str]] = None,
-              compression: str = "zstd") -> List[str]:
+              compression: str = "zstd", committer=None) -> List[str]:
     def _write_one(tbl: HostTable, file_path: str):
         from spark_rapids_tpu.io.arrow_convert import host_table_to_arrow
         po.write_table(host_table_to_arrow(tbl), file_path,
                        compression=compression)
-    return write_partitioned(table, path, _write_one, "orc", partition_by)
+    return write_partitioned(table, path, _write_one, "orc", partition_by,
+                             committer=committer)

@@ -72,14 +72,17 @@ class ParquetScanNode(FileScanNode):
 def write_parquet(table: HostTable, path: str,
                   partition_by: Optional[Sequence[str]] = None,
                   compression: str = "snappy", row_group_rows: int = 1 << 20,
-                  ) -> List[str]:
+                  committer=None) -> List[str]:
     """Write a HostTable as parquet file(s); returns written paths.
 
     With ``partition_by``, writes Hive-style key=value directories via the
-    dynamic-partitioning writer (GpuFileFormatDataWriter analog)."""
+    dynamic-partitioning writer (GpuFileFormatDataWriter analog). All
+    output stages through the transactional committer (io/committer.py);
+    pass ``committer`` to run under a caller-owned WriteJob."""
     def _write_one(tbl: HostTable, file_path: str):
         from spark_rapids_tpu.io.arrow_convert import host_table_to_arrow
         pq.write_table(host_table_to_arrow(tbl), file_path,
                        compression=compression, row_group_size=row_group_rows)
 
-    return write_partitioned(table, path, _write_one, "parquet", partition_by)
+    return write_partitioned(table, path, _write_one, "parquet",
+                             partition_by, committer=committer)

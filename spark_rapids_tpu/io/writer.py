@@ -3,7 +3,15 @@
 Reference: GpuFileFormatDataWriter.scala — the dynamic partition writer splits
 each batch by the partition-key tuple and routes rows to per-partition files
 under Hive-style key=value/ directories; single-partition writes emit
-part-00000 files. SURVEY.md §2.3 (DataWritingCommandExec row)."""
+part-00000 files. SURVEY.md §2.3 (DataWritingCommandExec row).
+
+Every file is written to a :mod:`~spark_rapids_tpu.io.committer`
+staging path, never to its final destination — a crash mid-write can
+only leave debris under ``_temporary/`` (which scans prune), never a
+torn ``part-*`` file. With an external ``committer`` (WriteFiles owns
+the job lifecycle) this function only STAGES; standalone calls run the
+whole task-commit/job-commit protocol themselves and return final
+paths."""
 
 from __future__ import annotations
 
@@ -33,50 +41,78 @@ def write_partitioned(table: HostTable, path: str,
                       write_one: Callable[[HostTable, str], None],
                       extension: str,
                       partition_by: Optional[Sequence[str]] = None,
+                      committer=None,
                       ) -> List[str]:
-    """Route rows to files; returns the list of files written."""
+    """Route rows to files through the transactional committer; returns
+    the list of files written (final paths when this call owns the job,
+    staged paths when the caller passed its own ``committer`` and will
+    commit the task/job itself)."""
+    from spark_rapids_tpu.io.committer import WriteJob
     from spark_rapids_tpu.runtime.faults import fault_point
     os.makedirs(path, exist_ok=True)
-    written: List[str] = []
-    if not partition_by:
-        out = os.path.join(path, f"part-00000.{extension}")
-        fault_point("io.write.file")
-        write_one(table, out)
-        return [out]
+    job = committer if committer is not None else WriteJob(path)
+    own_job = committer is None
 
-    for k in partition_by:
-        if k not in table.names:
-            raise ColumnarProcessingError(f"partition column {k!r} not in table")
-    data_names = [n for n in table.names if n not in partition_by]
-    key_cols = [table.column(k) for k in partition_by]
-    n = table.num_rows
+    def _finish(staged: List[str]) -> List[str]:
+        if not own_job:
+            return staged
+        final = job.commit_task()
+        job.commit_job(num_rows=table.num_rows)
+        return final
 
-    # group rows by partition tuple (host-side; the device path partitions
-    # on device then routes per-partition slices here)
-    keys = []
-    for i in range(n):
-        keys.append(tuple(
-            None if not c.validity[i] else
-            (c.data[i].item() if isinstance(c.data[i], np.generic) else c.data[i])
-            for c in key_cols))
-    order = {}
-    for i, k in enumerate(keys):
-        order.setdefault(k, []).append(i)
+    try:
+        if not partition_by:
+            rel = f"part-00000.{extension}"
+            fault_point("io.write.file")
+            staged_path = job.stage_path(rel)
+            write_one(table, staged_path)
+            return _finish([staged_path])
 
-    file_idx = 0
-    for key_tuple, rows in order.items():
-        idx = np.asarray(rows, dtype=np.int64)
-        sub_cols = []
-        for name in data_names:
-            c = table.column(name)
-            sub_cols.append(HostColumn(c.dtype, c.data[idx], c.validity[idx]))
-        sub = HostTable(data_names, sub_cols)
-        part_dir = os.path.join(path, *[
-            f"{k}={_escape_partition_value(v)}"
-            for k, v in zip(partition_by, key_tuple)])
-        os.makedirs(part_dir, exist_ok=True)
-        out = os.path.join(part_dir, f"part-{file_idx:05d}.{extension}")
-        write_one(sub, out)
-        written.append(out)
-        file_idx += 1
-    return written
+        for k in partition_by:
+            if k not in table.names:
+                raise ColumnarProcessingError(
+                    f"partition column {k!r} not in table")
+        data_names = [n for n in table.names if n not in partition_by]
+        key_cols = [table.column(k) for k in partition_by]
+        n = table.num_rows
+
+        # group rows by partition tuple (host-side; the device path
+        # partitions on device then routes per-partition slices here)
+        keys = []
+        for i in range(n):
+            keys.append(tuple(
+                None if not c.validity[i] else
+                (c.data[i].item() if isinstance(c.data[i], np.generic)
+                 else c.data[i])
+                for c in key_cols))
+        order = {}
+        for i, k in enumerate(keys):
+            order.setdefault(k, []).append(i)
+
+        staged: List[str] = []
+        file_idx = 0
+        for key_tuple, rows in order.items():
+            idx = np.asarray(rows, dtype=np.int64)
+            sub_cols = []
+            for name in data_names:
+                c = table.column(name)
+                sub_cols.append(HostColumn(c.dtype, c.data[idx],
+                                           c.validity[idx]))
+            sub = HostTable(data_names, sub_cols)
+            rel = os.path.join(*[
+                f"{k}={_escape_partition_value(v)}"
+                for k, v in zip(partition_by, key_tuple)],
+                f"part-{file_idx:05d}.{extension}")
+            # the fault point fires on EVERY file, partitioned writes
+            # included — they were invisible to the chaos harness when
+            # only the single-file branch carried it
+            fault_point("io.write.file")
+            staged_path = job.stage_path(rel)
+            write_one(sub, staged_path)
+            staged.append(staged_path)
+            file_idx += 1
+        return _finish(staged)
+    except BaseException:
+        if own_job:
+            job.abort()
+        raise

@@ -85,6 +85,10 @@ RULES: Dict[str, str] = {
                         "runtime/, shuffle/ or service/ written outside "
                         "a lock guard (concurrent query workers share "
                         "these modules)",
+    "RL-WRITE-COMMIT": "io/ writer opens an output file or promotes a "
+                       "path outside the transactional committer (all "
+                       "table output must stage through io/committer.py "
+                       "so a crash can never leave a torn final file)",
 }
 
 

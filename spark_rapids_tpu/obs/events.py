@@ -61,7 +61,13 @@ EVENT_LOG_DIR = str_conf(
 #: the last two are per-record DELTAS of the ``health`` scope, 0 on a
 #: quiet process. Result-cache serves carry 0/0 and the serve-time
 #: healthState.
-EVENT_SCHEMA_VERSION = 4
+#: v5 (transactional-write PR): + filesWritten (data files committed
+#: into place by the transactional output committer during this
+#: query's wall), bytesWritten (their bytes), and commitRetries
+#: (Delta optimistic commits rebased and retried after losing the
+#: version race) — per-record DELTAS of the ``write`` scope, all 0
+#: for read-only queries and result-cache serves.
+EVENT_SCHEMA_VERSION = 5
 
 
 def plan_tree(executable) -> dict:
@@ -173,7 +179,10 @@ def build_query_record(*, query_index: int, wall_s: float,
                        pad_waste_rows: int = 0,
                        health_state: str = "HEALTHY",
                        device_reinits: int = 0,
-                       worker_restarts: int = 0) -> dict:
+                       worker_restarts: int = 0,
+                       files_written: int = 0,
+                       bytes_written: int = 0,
+                       commit_retries: int = 0) -> dict:
     """Assemble one event-log record. Every field is JSON-native; the
     golden schema test normalizes timings and pins the shape.
     ``service`` is the query-service envelope (tenant, pool, queueWaitS,
@@ -200,6 +209,9 @@ def build_query_record(*, query_index: int, wall_s: float,
         "quarantined": bool(service.get("quarantined", False)),
         "deviceReinits": int(device_reinits),
         "workerRestarts": int(worker_restarts),
+        "filesWritten": int(files_written),
+        "bytesWritten": int(bytes_written),
+        "commitRetries": int(commit_retries),
         "faultReplays": fault_replays,
         "plan": plan_tree(executable),
         "fallbacks": collect_fallbacks(meta),

@@ -655,18 +655,31 @@ class WriteFiles(PlanNode):
     """Data-writing command (reference: GpuDataWritingCommandExec +
     GpuFileFormatDataWriter): runs the child (on device when convertible —
     this node itself stays host-side like the reference's write encode),
-    writes files under a Spark-style COMMIT PROTOCOL (stage into
-    _temporary/<uuid>, atomic rename on success, _SUCCESS marker), and
-    returns one stats row (numFiles, numRows, numBytes)."""
+    writes files under the TRANSACTIONAL commit protocol
+    (io/committer.py: stage into _temporary/<job>/<attempt>/, atomic
+    per-file promotion at task commit, a _SUCCESS MANIFEST at job
+    commit, full rollback on abort), and returns one stats row
+    (numFiles, numRows, numBytes).
+
+    The job id is fixed at plan time, so re-executing the SAME node —
+    the query service's worker-loss/device-loss replay resubmits the
+    handle's original plan — is idempotent: a rerun that finds its own
+    job id in the destination manifest returns the recorded stats
+    instead of writing twice; a rerun after a mid-write crash
+    re-stages and re-promotes the same deterministic filenames."""
 
     def __init__(self, child: PlanNode, fmt: str, path: str,
                  partition_by: Optional[Sequence[str]] = None,
                  options: Optional[dict] = None):
+        import uuid as _uuid
         self.children = (child,)
         self.fmt = fmt
         self.path = path
         self.partition_by = list(partition_by) if partition_by else None
         self.options = dict(options or {})
+        #: idempotency key: stable across replays of this plan node
+        self.job_id = _uuid.uuid4().hex[:16]
+        self._attempt = 0
 
     def output_schema(self):
         return [("numFiles", T.LONG), ("numRows", T.LONG),
@@ -682,37 +695,44 @@ class WriteFiles(PlanNode):
             "hive_text": _io_pkg.write_hive_text,
         }[self.fmt]
 
+    def _stats_row(self, num_files: int, num_rows: int, num_bytes: int):
+        return HostTable(
+            ["numFiles", "numRows", "numBytes"],
+            [HostColumn(T.LONG, np.asarray([num_files], dtype=np.int64)),
+             HostColumn(T.LONG, np.asarray([num_rows], dtype=np.int64)),
+             HostColumn(T.LONG, np.asarray([num_bytes], dtype=np.int64))])
+
     def execute_cpu(self):
-        import shutil
-        import uuid
+        from spark_rapids_tpu.io.committer import WriteJob, read_manifest
+
+        # exactly-once replay: this job already committed (the service
+        # requeued a write whose worker died AFTER job commit) — serve
+        # the manifest's stats, do not double-write
+        manifest = read_manifest(self.path)
+        if manifest is not None and manifest.get("jobId") == self.job_id:
+            yield self._stats_row(manifest["numFiles"],
+                                  manifest["numRows"],
+                                  manifest["numBytes"])
+            return
 
         table = self.children[0].collect_cpu()
-        staging = os.path.join(self.path,
-                               f"_temporary-{uuid.uuid4().hex[:12]}")
-        os.makedirs(staging, exist_ok=True)
+        job = WriteJob(self.path, job_id=self.job_id,
+                       attempt=self._attempt)
+        self._attempt += 1
         try:
-            files = self._writer()(table, staging,
-                                   partition_by=self.partition_by,
-                                   **self.options)
-            os.makedirs(self.path, exist_ok=True)
-            final_files = []
-            for f in files:
-                rel = os.path.relpath(f, staging)
-                dst = os.path.join(self.path, rel)
-                os.makedirs(os.path.dirname(dst), exist_ok=True)
-                os.replace(f, dst)  # atomic per-file commit
-                final_files.append(dst)
-            with open(os.path.join(self.path, "_SUCCESS"), "w"):
-                pass
-        finally:
-            shutil.rmtree(staging, ignore_errors=True)
-
-        nbytes = sum(os.path.getsize(f) for f in final_files)
-        yield HostTable(
-            ["numFiles", "numRows", "numBytes"],
-            [HostColumn(T.LONG, np.asarray([len(final_files)], dtype=np.int64)),
-             HostColumn(T.LONG, np.asarray([table.num_rows], dtype=np.int64)),
-             HostColumn(T.LONG, np.asarray([nbytes], dtype=np.int64))])
+            self._writer()(table, self.path,
+                           partition_by=self.partition_by,
+                           committer=job, **self.options)
+            final_files = job.commit_task()
+            manifest = job.commit_job(num_rows=table.num_rows)
+        except BaseException:
+            # any failure — injected fault, device loss mid-drain of a
+            # downstream re-read, a full disk — rolls the job back:
+            # promoted files deleted, staging swept
+            job.abort()
+            raise
+        yield self._stats_row(len(final_files), table.num_rows,
+                              manifest["numBytes"])
 
     def describe(self):
         part = f", partitionBy={self.partition_by}" if self.partition_by else ""

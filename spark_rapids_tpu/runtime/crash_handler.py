@@ -128,6 +128,16 @@ def handle_fatal(exc: BaseException, conf: RapidsConf,
             _atexit_spill_sweep()
         except Exception:
             pass
+        # likewise the transactional writer's staging trees: a write
+        # job in flight when the device dies must not leave
+        # _temporary/ debris for the rescheduled executor's scans
+        # (the committed destination is untouched — the replayed job
+        # re-stages and re-promotes the same deterministic names)
+        try:
+            from spark_rapids_tpu.io.committer import sweep_active_jobs
+            sweep_active_jobs()
+        except Exception:
+            pass
         sys.stderr.flush()
         os._exit(FATAL_EXIT_CODE)
 

@@ -50,6 +50,8 @@ FAULT_KINDS = (
                    # cancel boundary never runs — watchdog hard-timeout territory)
     "device_lost", # fatal device/tunnel loss (DeviceLostError; health-monitor
                    # recovery: backend reinit + cache invalidation, NOT the breaker)
+    "race",        # lost optimistic-concurrency race (DeltaConcurrentModification-
+                   # Exception; the transaction's rebase-and-retry loop owns it)
 )
 
 #: registered fault points: name -> (module that hosts the call site, doc).
@@ -87,7 +89,22 @@ FAULT_POINTS: Dict[str, tuple] = {
         "file-source per-file decode"),
     "io.write.file": (
         "spark_rapids_tpu/io/writer.py",
-        "partitioned writer per-file write"),
+        "writer per-file write (BOTH branches: single-file part-00000 "
+        "and every dynamic-partition file), before the staged write"),
+    "io.write.commit": (
+        "spark_rapids_tpu/io/committer.py",
+        "task commit, before each staged file's atomic promotion "
+        "(os.replace into the final destination)"),
+    "io.write.abort": (
+        "spark_rapids_tpu/io/committer.py",
+        "write-job abort, before the rollback + staging sweep (a crash "
+        "here exercises the crash-handler/atexit sweep backstop)"),
+    "delta.commit.race": (
+        "spark_rapids_tpu/delta/log.py",
+        "immediately before the atomic commit-file create; kind "
+        "'race' injects a DeltaConcurrentModificationException so the "
+        "optimistic rebase-and-retry loop is exercisable without a "
+        "real concurrent writer, 'crash' dies mid-commit"),
     "service.worker_crash": (
         "spark_rapids_tpu/service/scheduler.py",
         "service worker runner, after the RUNNING transition and "
@@ -261,6 +278,12 @@ class FaultRegistry:
                 from spark_rapids_tpu.errors import DeviceLostError
                 raise DeviceLostError(
                     f"injected device loss at {where}")
+            if a.kind == "race":
+                from spark_rapids_tpu.delta.log import (
+                    DeltaConcurrentModificationException,
+                )
+                raise DeltaConcurrentModificationException(
+                    f"injected optimistic-concurrency race at {where}")
             if a.kind == "wedge":
                 import os
                 time.sleep(float(os.environ.get("SRT_WEDGE_SLEEP_S",

@@ -369,6 +369,16 @@ class TpuSession:
         worker_restarts = int(
             after_scopes.get("health", {}).get("workersRespawned", 0)
             - before_scopes.get("health", {}).get("workersRespawned", 0))
+
+        # transactional-write accounting: per-record deltas of the
+        # ``write`` scope (io/committer.py) — the committer/Delta
+        # transaction counters are process-wide, so the delta
+        # attributes files/bytes/retries to the query whose wall they
+        # happened under (all 0 for read-only queries)
+        def _wdelta(key: str) -> int:
+            return int(after_scopes.get("write", {}).get(key, 0)
+                       - before_scopes.get("write", {}).get(key, 0))
+
         record = E.build_query_record(
             query_index=qidx,
             wall_s=wall_s,
@@ -396,6 +406,9 @@ class TpuSession:
             device_reinits=int(after_health["deviceReinits"]
                                - before_health["deviceReinits"]),
             worker_restarts=worker_restarts,
+            files_written=_wdelta("filesWritten"),
+            bytes_written=_wdelta("bytesWritten"),
+            commit_retries=_wdelta("commitRetries"),
         )
         self.last_event_record = record
         # the record has read the tree's metrics — the cached executable

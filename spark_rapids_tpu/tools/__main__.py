@@ -18,6 +18,10 @@ Subcommands:
   populate the kernel/executable caches (and the persistent XLA
   compile cache on device backends) before traffic arrives; reports
   programs compiled vs skipped.
+* ``vacuum <dir>`` — find un-referenced/staged output files: Delta
+  orphans vs the latest snapshot, committed-write-dir orphans vs the
+  _SUCCESS manifest, and _temporary/ staging debris of jobs that died
+  mid-write. DRY RUN by default; ``--delete`` removes.
 
 ``--json`` emits the raw report dict for machines; exit status 2 when a
 profile's span coverage falls below ``--coverage-floor`` (default 0.95)
@@ -106,7 +110,30 @@ def main(argv=None) -> int:
     w.add_argument("--out", type=str, default="",
                    help="write the report JSON to this file")
 
+    v = sub.add_parser(
+        "vacuum",
+        help="find (and with --delete, remove) un-referenced or "
+             "staged output files under a table/write directory; "
+             "dry-run by default")
+    v.add_argument("path", help="delta table or write output directory")
+    v.add_argument("--delete", action="store_true",
+                   help="actually remove the orphans (default: report "
+                        "only)")
+    v.add_argument("--retention-hours", type=float, default=None,
+                   help="keep orphans younger than this (delta mode; "
+                        "default: spark.rapids.delta.vacuum."
+                        "retentionHours)")
+    v.add_argument("--json", action="store_true",
+                   help="emit the raw report JSON")
+
     args = ap.parse_args(argv)
+
+    if args.cmd == "vacuum":
+        from spark_rapids_tpu.tools.vacuum import render_vacuum, run_vacuum
+        report = run_vacuum(args.path, delete=args.delete,
+                            retention_hours=args.retention_hours)
+        print(json.dumps(report) if args.json else render_vacuum(report))
+        return 0
 
     if args.cmd == "warmup":
         from spark_rapids_tpu.tools.warmup import render_warmup, run_warmup

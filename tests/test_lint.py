@@ -582,6 +582,41 @@ def test_rl_thread_shared():
         RL._THREAD_SHARED_ALLOWLIST.update(saved)
 
 
+def test_rl_write_commit():
+    from spark_rapids_tpu.lint.repo_lint import _check_write_commit
+    src = (
+        "import os\n"
+        "import pyarrow.parquet as pq\n"
+        "def write_stuff(t, path):\n"
+        "    pq.write_table(t, path)\n"            # outside _write_one
+        "    with open(path, 'w') as f:\n"         # write-mode open
+        "        f.write('x')\n"
+        "    os.replace(path + '.tmp', path)\n"    # promotion
+        "def _write_one(tbl, file_path):\n"
+        "    pq.write_table(tbl, file_path)\n"     # sanctioned callback
+        "    with open(file_path, 'w') as f:\n"
+        "        f.write('x')\n"
+        "def read_stuff(path):\n"
+        "    with open(path) as f:\n"              # default 'r': clean
+        "        return f.read()\n"
+        "    with open(path, 'rb') as f:\n"
+        "        return f.read()\n"
+    )
+    diags = _run_rl(_check_write_commit, "spark_rapids_tpu/io/foo.py", src)
+    hits = _find(diags, "RL-WRITE-COMMIT")
+    assert len(hits) == 3, [str(d) for d in hits]
+    msgs = " ".join(d.message for d in hits)
+    assert "os.replace" in msgs and "committer" in msgs
+    # the committer itself and the file cache are exempt, as is
+    # anything outside io/
+    assert _run_rl(_check_write_commit,
+                   "spark_rapids_tpu/io/committer.py", src) == []
+    assert _run_rl(_check_write_commit,
+                   "spark_rapids_tpu/io/filecache.py", src) == []
+    assert _run_rl(_check_write_commit,
+                   "spark_rapids_tpu/delta/foo.py", src) == []
+
+
 def test_rl_fault_point():
     from spark_rapids_tpu.lint.repo_lint import (
         _check_fault_registry,

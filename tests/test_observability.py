@@ -229,13 +229,17 @@ def test_event_log_written_and_valid(tmp_path):
     lines = open(s.last_event_path).read().strip().splitlines()
     assert len(lines) == 1
     rec = json.loads(lines[0])
-    # schema v4: the survivability PR added healthState / quarantined /
-    # deviceReinits / workerRestarts on top of the v3 serving-latency
-    # fields (HEALTHY/false/0/0 on a quiet process) — see obs/events.py
-    assert rec["schema"] == 4
+    # schema v5: the transactional-write PR added filesWritten /
+    # bytesWritten / commitRetries (write-scope deltas; 0 for
+    # read-only queries) on top of v4's survivability fields
+    # (healthState / quarantined / deviceReinits / workerRestarts —
+    # HEALTHY/false/0/0 on a quiet process) — see obs/events.py
+    assert rec["schema"] == 5
     assert rec["healthState"] == "HEALTHY"
     assert rec["quarantined"] is False
     assert rec["deviceReinits"] == 0 and rec["workerRestarts"] == 0
+    assert rec["filesWritten"] == 0 and rec["bytesWritten"] == 0
+    assert rec["commitRetries"] == 0
     assert rec["event"] == "queryCompleted"
     assert rec["queryTag"] == "golden"
     assert rec["wallS"] > 0
@@ -271,7 +275,12 @@ def test_event_log_golden_schema(tmp_path):
     v4 = survivability fields (healthState — HEALTHY/DEGRADED/CPU_ONLY
     at record time; quarantined — the template carries poison strikes;
     deviceReinits/workerRestarts — per-record deltas of the health
-    scope's recovery counters, 0 on a quiet process)."""
+    scope's recovery counters, 0 on a quiet process);
+    v5 = transactional-write fields (filesWritten/bytesWritten — data
+    files the committer promoted during this query's wall and their
+    bytes; commitRetries — Delta optimistic commits rebased after
+    losing the version race; per-record deltas of the write scope,
+    all 0 for read-only queries and result-cache serves)."""
     s = _run_eventlog_query(tmp_path)
     got = _normalize(s.last_event_record)
     golden_path = os.path.join(os.path.dirname(__file__),
