@@ -1052,6 +1052,102 @@ def _run_chaos_concurrent(report, failures, wanted, expected_tables,
     return report
 
 
+# ---------------------------------------------------------------------------
+# Mesh mode: the corpus executed mesh-native, bit-identical to single-chip
+# ---------------------------------------------------------------------------
+
+
+def _ensure_host_mesh(n: int) -> None:
+    """Force an n-device virtual host-platform mesh BEFORE the JAX
+    backend initializes (shared with the dryrun_multichip entry): real
+    multi-host pods bring their own devices; set
+    SPARK_RAPIDS_TPU_DRYRUN_REAL=1 to use whatever the process has."""
+    from spark_rapids_tpu.parallel.mesh import ensure_host_devices
+    have = ensure_host_devices(n)
+    if have < n:
+        raise SystemExit(
+            f"--mesh {n} needs {n} devices but only {have} are available "
+            "(the JAX backend initialized before the host device-count "
+            "flag could take effect)")
+
+
+def run_mesh(sf: float, seed: int, ndev: int, queries=None,
+             use_sql: bool = False, shape: str = ""):
+    """Mesh-native corpus run: q1-q22 single-chip for the baseline, the
+    SAME corpus with ``spark.rapids.mesh.enabled`` over an ndev-device
+    mesh, asserting BIT-IDENTITY per query and reporting per-exchange
+    ICI accounting (collective count, payload bytes, host-shuffle
+    fallbacks with reasons, re-land rows) from the mesh metric scope
+    and the per-exchange metrics. Raises AssertionError on any
+    divergence — this is the MULTICHIP_r06 acceptance harness."""
+    _ensure_host_mesh(ndev)
+    from spark_rapids_tpu.datagen import scale_test_specs
+    from spark_rapids_tpu.obs.events import collect_exchanges
+    from spark_rapids_tpu.obs.metrics import scopes_snapshot
+    from spark_rapids_tpu.session import TpuSession
+
+    specs = scale_test_specs(sf)
+    tables = {name: spec.generate_table(sf, seed=seed)
+              for name, spec in specs.items()}
+    build = build_sql_queries if use_sql else build_queries
+
+    chip = TpuSession()
+    mesh = TpuSession({
+        "spark.rapids.mesh.enabled": "true",
+        "spark.rapids.mesh.shape": shape or str(ndev),
+    })
+    chip_queries = build(chip, tables)
+    mesh_queries = build(mesh, tables)
+    wanted = queries or list(chip_queries)
+
+    report = {"mode": "mesh", "n_devices": ndev,
+              "mesh_shape": shape or str(ndev), "scale_factor": sf,
+              "seed": seed, "sql": use_sql, "queries": {}}
+    failures = []
+    for name in wanted:
+        expected = chip_queries[name]().collect_table()
+        before = dict(scopes_snapshot().get("mesh", {}))
+        t0 = time.perf_counter()
+        got = mesh_queries[name]().collect_table()
+        wall = time.perf_counter() - t0
+        after = dict(scopes_snapshot().get("mesh", {}))
+        delta = {k: int(after.get(k, 0) - before.get(k, 0))
+                 for k in ("shardsDispatched", "iciExchanges", "iciBytes",
+                           "hostShuffleFallbacks", "meshHostUploads",
+                           "meshRelandRows", "meshDictInterns",
+                           "meshGatherRows")}
+        diff = tables_differ(expected, got)
+        exchanges = []
+        for e in collect_exchanges(mesh._last_executable):
+            exchanges.append({k: e[k] for k in
+                              ("op", "loreId", "iciPartitions", "iciBytes",
+                               "iciExchangeTime", "hostShuffleFallbacks",
+                               "mapOutputBytesMax", "mapOutputBytesMedian",
+                               "skewedPartitions")
+                              if k in e})
+        entry = {"identical": diff is None, "mesh_wall_s": round(wall, 4),
+                 "mesh": delta, "exchanges": exchanges}
+        if diff is not None:
+            failures.append(f"{name}: {diff}")
+        report["queries"][name] = entry
+        print(json.dumps({"query": name, **entry}))
+    report["totals"] = {
+        k: sum(q["mesh"][k] for q in report["queries"].values())
+        for k in ("iciExchanges", "iciBytes", "hostShuffleFallbacks",
+                  "meshHostUploads", "shardsDispatched")}
+    report["ok"] = not failures
+    report["failures"] = failures
+    if failures:
+        # the report IS the diagnostic (per-query identical flags, mesh
+        # deltas, exchange accounting) — carry it on the error so the
+        # CLI can still write --out before exiting non-zero
+        err = AssertionError("mesh run diverged from single-chip:\n"
+                             + "\n".join(failures))
+        err.report = report
+        raise err
+    return report
+
+
 def run_concurrent(sf: float, seed: int, queries=None, use_sql=False,
                    concurrency: int = 4, tenants: int = 2,
                    eventlog_dir=None):
@@ -1107,7 +1203,39 @@ def main():
                          "recovery, health back to HEALTHY")
     ap.add_argument("--tenants", type=int, default=2,
                     help="simulated tenants for --concurrency runs")
+    ap.add_argument("--mesh", type=int, default=0, metavar="N",
+                    help="run the corpus MESH-NATIVE over an N-device "
+                         "mesh (virtual host-platform devices unless "
+                         "SPARK_RAPIDS_TPU_DRYRUN_REAL=1), asserting "
+                         "bit-identity vs single-chip plus per-exchange "
+                         "ICI accounting (the MULTICHIP_r06 harness)")
+    ap.add_argument("--mesh-shape", type=str, default="",
+                    help="with --mesh: explicit spark.rapids.mesh.shape "
+                         "('8' or '2x4'; default N on one flat axis)")
     args = ap.parse_args()
+
+    if args.mesh:
+        wanted = [q.strip() for q in args.queries.split(",") if q.strip()]
+
+        def dump_mesh_report(report):
+            print(json.dumps(report))
+            if args.out:
+                with open(args.out, "w") as f:
+                    json.dump(report, f, indent=1)
+
+        try:
+            report = run_mesh(sf=args.sf if args.sf is not None else 0.05,
+                              seed=args.seed if args.seed is not None else 0,
+                              ndev=args.mesh, queries=wanted or None,
+                              use_sql=args.sql, shape=args.mesh_shape)
+        except AssertionError as e:
+            # divergence: the failure report carries exactly what we
+            # need to debug it — write it before exiting non-zero
+            if getattr(e, "report", None) is not None:
+                dump_mesh_report(e.report)
+            raise SystemExit(f"FAILED: {e}")
+        dump_mesh_report(report)
+        return
 
     if args.chaos:
         wanted = [q.strip() for q in args.queries.split(",") if q.strip()]

@@ -310,6 +310,14 @@ def _unpack_host(buf: np.ndarray, kinds: tuple, k: int, n_extra: int = 0):
     return extras, datas, valids
 
 
+def _multi_device(a) -> bool:
+    """True when ``a`` is a jax.Array physically laid out across more
+    than one device — the buffer-level predicate behind
+    ``DeviceTable.physically_sharded``/``unsharded``. Non-arrays (None,
+    host scalars) and single-device arrays are False."""
+    return isinstance(a, jax.Array) and len(a.sharding.device_set) > 1
+
+
 #: jitted concat kernels keyed by (schema kinds, input caps, out cap)
 _CONCAT_CACHE: Dict[tuple, object] = {}
 
@@ -585,13 +593,20 @@ class DeviceTable:
     GpuFilterExec compacts eagerly (basicPhysicalOperators.scala)."""
 
     __slots__ = ("names", "columns", "nrows_dev", "_nrows_host", "capacity",
-                 "live", "__weakref__")
+                 "live", "shard_spec", "__weakref__")
 
     def __init__(self, names: Sequence[str], columns: Sequence[DeviceColumn],
-                 nrows, capacity: Optional[int] = None, live=None):
+                 nrows, capacity: Optional[int] = None, live=None,
+                 shard_spec=None):
         self.names: Tuple[str, ...] = tuple(names)
         self.columns: Tuple[DeviceColumn, ...] = tuple(columns)
         self.live = live
+        #: plan-carried sharding descriptor (jax.sharding.NamedSharding
+        #: over the row axis, or None for single-device tables): set
+        #: when a mesh-native scan lands shards per device; narrow
+        #: kernels preserve the layout through GSPMD propagation and
+        #: exchanges re-shard explicitly (parallel/mesh.py)
+        self.shard_spec = shard_spec
         if self.columns:
             caps = {c.capacity for c in self.columns}
             if len(caps) != 1:
@@ -635,8 +650,20 @@ class DeviceTable:
         return sum(c.device_nbytes() for c in self.columns)
 
     @staticmethod
-    def from_host(host: HostTable, capacity: Optional[int] = None) -> "DeviceTable":
+    def from_host(host: HostTable, capacity: Optional[int] = None,
+                  sharding=None) -> "DeviceTable":
+        """Upload ``host`` as one staged transfer. With ``sharding`` (a
+        NamedSharding over the row axis — mesh-native scans), every
+        staged column lands SPLIT across the mesh devices by
+        ``jax.device_put``: each device receives only its row shard, no
+        single-device concat ever materializes, and the assemble
+        kernel's outputs inherit the sharded layout (GSPMD)."""
         cap = capacity or bucket_for(host.num_rows)
+        if sharding is not None:
+            # even per-device shards: round the capacity up to a mesh
+            # multiple (pow2 buckets >= 128 already divide pow2 meshes)
+            ndev = len(sharding.mesh.devices.flat)
+            cap = -(-cap // ndev) * ndev
         # bucket pad waste: dead tail rows this upload carries so the
         # kernel set stays bounded (`compile` scope, padWasteRows)
         count_pad_waste(cap - host.num_rows)
@@ -644,7 +671,9 @@ class DeviceTable:
             return DeviceTable(host.names, [], host.num_rows, cap)
         if any(isinstance(c.dtype, (T.ArrayType, T.StructType, T.MapType))
                for c in host.columns):
-            # nested columns bypass the staged fast path (per-column upload)
+            # nested columns bypass the staged fast path (per-column
+            # upload) and stay single-device — the exchange layer
+            # excludes them from collectives for the same reason
             cols = [DeviceColumn.from_host(c, cap) for c in host.columns]
             return DeviceTable(host.names, cols, host.num_rows, cap)
         split_f64 = jax.default_backend() != "cpu"
@@ -654,7 +683,10 @@ class DeviceTable:
             recipes.append(recipe)
             staged.extend(arrays)
             dicts.append(dictionary)
-        dev_arrays = tuple(jnp.asarray(a) for a in staged)
+        if sharding is None:
+            dev_arrays = tuple(jnp.asarray(a) for a in staged)
+        else:
+            dev_arrays = tuple(jax.device_put(a, sharding) for a in staged)
         fn = _get_assemble(tuple(recipes), cap)
         outs = fn(dev_arrays, jnp.asarray(np.int32(host.num_rows)))
         cols = [
@@ -662,7 +694,8 @@ class DeviceTable:
                          domain=c.int_domain())
             for c, (data, validity), d in zip(host.columns, outs, dicts)
         ]
-        return DeviceTable(host.names, cols, host.num_rows, cap)
+        return DeviceTable(host.names, cols, host.num_rows, cap,
+                           shard_spec=sharding)
 
     #: capacity up to which an unknown row count is fetched by embedding it
     #: in the packed buffer (fetching the padded bucket) instead of paying a
@@ -785,7 +818,57 @@ class DeviceTable:
         outs = fn(tuple(c.data for c in self.columns),
                   tuple(c.validity for c in self.columns), self.live)
         cols = [c.with_arrays(d, v) for c, (d, v) in zip(self.columns, outs)]
-        out = DeviceTable(self.names, cols, self.nrows_dev, self.capacity)
+        out = DeviceTable(self.names, cols, self.nrows_dev, self.capacity,
+                          shard_spec=self.shard_spec)
+        out._nrows_host = self._nrows_host
+        return out
+
+    def physically_sharded(self) -> bool:
+        """True when any buffer is laid out across more than one device
+        — the predicate ``unsharded()`` gathers on. A bare shard_spec
+        descriptor over single-device buffers (e.g. a 1-device mesh)
+        does not count: dropping it moves no data."""
+        return bool(_multi_device(self.live)
+                    or _multi_device(self.nrows_dev)
+                    or any(_multi_device(c.data)
+                           or _multi_device(c.validity)
+                           for c in self.columns))
+
+    def unsharded(self) -> "DeviceTable":
+        """Re-land a row-sharded table into the single-device layout —
+        the merge-boundary gather of mesh-native execution. Wide kernels
+        (aggregate/sort/join/window) must see exactly the layout the
+        single-chip path computes on: a GSPMD-partitioned reduction over
+        mesh shards changes float accumulation order, breaking the
+        bit-identity contract. The move is DEVICE-to-device (ICI on a
+        real pod) — data never round-trips through the host, so the
+        RL-MESH-HOST zero-host-transfer invariant holds; no-op for
+        tables that are not physically sharded."""
+        # one traversal: per-buffer verdicts drive both the early-out
+        # and the selective re-land below
+        live_m = _multi_device(self.live)
+        nrows_m = _multi_device(self.nrows_dev)
+        col_m = [(_multi_device(c.data), _multi_device(c.validity))
+                 for c in self.columns]
+        if not (live_m or nrows_m or any(d or v for d, v in col_m)):
+            if self.shard_spec is None:
+                return self
+            out = DeviceTable(self.names, self.columns, self.nrows_dev,
+                              self.capacity, live=self.live)
+            out._nrows_host = self._nrows_host
+            return out
+        dev = jax.devices()[0]
+
+        def _land(a, multi):
+            return jax.device_put(a, dev) if multi else a
+
+        cols = [c.with_arrays(_land(c.data, d), _land(c.validity, v))
+                for c, (d, v) in zip(self.columns, col_m)]
+        # the row-count scalar rides replicated across the mesh on
+        # sharded tables — re-land it with the columns or a downstream
+        # jit sees mixed committed devices
+        out = DeviceTable(self.names, cols, _land(self.nrows_dev, nrows_m),
+                          self.capacity, live=_land(self.live, live_m))
         out._nrows_host = self._nrows_host
         return out
 

@@ -29,6 +29,53 @@ from spark_rapids_tpu.ops.expr import (
 )
 
 
+def _scan_sharding(exec_node: TpuExec):
+    """(row sharding, cache token) this scan may land under — (None,
+    None) when its tree was not converted mesh-aware. Sharded placement
+    is BOUND AT CONVERSION: insert_mesh_relands stamps every scan with
+    the mesh generation its re-land boundaries were planned against
+    (``_mesh_scan_gen``), and an unstamped or stale-stamped scan lands
+    single-device — a tree converted with the mesh off carries no
+    boundaries, so feeding it physically sharded batches would let
+    GSPMD repartition a wide float kernel and break bit-identity when
+    a concurrent session flips the process mesh mid-query. The token
+    keys cached device images to the mesh GENERATION, so a
+    reconfiguration invalidates every cached placement. Read atomically
+    (MeshRuntime.scan_placement) so a concurrent reconfiguration cannot
+    pair an old mesh's sharding with the new generation token."""
+    gen = getattr(exec_node, "_mesh_scan_gen", None)
+    if gen is None:
+        return None, None
+    from spark_rapids_tpu.parallel.mesh import MESH
+    sharding, token = MESH.scan_placement()
+    if token != gen:
+        return None, None
+    return sharding, token
+
+
+def _upload_sharded(exec_node: TpuExec, host: HostTable,
+                    sharding) -> DeviceTable:
+    """Land one scan batch — split per device over the mesh row sharding
+    when mesh-native execution is on (one jax.device_put per staged
+    column delivers every device exactly its row shard, no single-host
+    concat) — and account the dispatched shards on both the exec and
+    the mesh scope."""
+    dt = DeviceTable.from_host(host, sharding=sharding)
+    # count what from_host actually DID: nested-type and zero-column
+    # batches bypass the staged split and land single-device (no
+    # shard_spec), so they must not claim distributed placement. The
+    # shard count comes from the sharding the batch LANDED under — a
+    # concurrent reconfiguration between the scan's atomic placement
+    # read and this point must not pair the old mesh's placement with
+    # the new mesh's device count
+    if dt.shard_spec is not None:
+        from spark_rapids_tpu.parallel.mesh import MESH_SCOPE
+        nshards = int(dt.shard_spec.mesh.devices.size)
+        MESH_SCOPE.add("shardsDispatched", nshards)
+        exec_node.add_metric("shardsDispatched", nshards)
+    return dt
+
+
 class TpuScanExec(TpuExec):
     """Uploads pre-built host batches (LocalScan analog).
 
@@ -49,18 +96,23 @@ class TpuScanExec(TpuExec):
 
     def execute(self):
         from spark_rapids_tpu.columnar.table import register_device_cache
+        sharding, shard_token = _scan_sharding(self)
         for b in self.batches:
             if not self.device_cache:
-                yield DeviceTable.from_host(b)
+                yield _upload_sharded(self, b, sharding)
                 continue
-            dt = b._cache.get("device")
-            if dt is None:
-                dt = DeviceTable.from_host(b)
-                b._cache["device"] = dt
-                register_device_cache(b)
-                self.add_metric("scanCacheMiss", 1)
-            else:
+            entry = b._cache.get("device")
+            # the cached image must match the CURRENT mesh layout — a
+            # reconfigured (or newly enabled/disabled) mesh re-lands
+            # the shards rather than serving a stale placement
+            if entry is not None and entry[1] == shard_token:
                 self.add_metric("scanCacheHit", 1)
+                yield entry[0]
+                continue
+            dt = _upload_sharded(self, b, sharding)
+            b._cache["device"] = (dt, shard_token)
+            register_device_cache(b)
+            self.add_metric("scanCacheMiss", 1)
             yield dt
 
     def describe(self):
@@ -89,11 +141,14 @@ class TpuFileScanExec(TpuExec):
 
     def execute(self):
         import time
+        sharding, _ = _scan_sharding(self)
         for batch in self.scan_node.execute_cpu(
                 dynamic_prunes=self._dynamic_prunes or None,
                 metrics=self.metrics):
             t0 = time.perf_counter()
-            dt = DeviceTable.from_host(batch)
+            # mesh-native: each decoded file/row-group batch lands SPLIT
+            # across the mesh (execs/basic._upload_sharded)
+            dt = _upload_sharded(self, batch, sharding)
             self.add_metric("scanUploadTime", time.perf_counter() - t0)
             self.add_metric("scanBatches", 1)
             self.add_metric("scanRows", batch.num_rows)

@@ -14,8 +14,9 @@ below the partition count)."""
 from __future__ import annotations
 
 from time import perf_counter
-from typing import List, Sequence
+from typing import List, Optional, Sequence
 
+from spark_rapids_tpu import types as T
 from spark_rapids_tpu.columnar import DeviceTable, HostTable
 from spark_rapids_tpu.conf import RapidsConf
 from spark_rapids_tpu.errors import ColumnarProcessingError, MapOutputLostError
@@ -53,6 +54,55 @@ def _pad_capacity(table: DeviceTable, new_cap: int) -> DeviceTable:
                        live=live)
 
 
+def ici_requested(conf: RapidsConf) -> bool:
+    """Did the session ask for collective shuffles — either the legacy
+    ``spark.rapids.shuffle.mode=ICI`` or mesh-native execution
+    (``spark.rapids.mesh.enabled``)?"""
+    from spark_rapids_tpu.conf import SHUFFLE_MANAGER_MODE
+    from spark_rapids_tpu.parallel.mesh import MESH_ENABLED
+    return (str(conf.get_entry(SHUFFLE_MANAGER_MODE)).upper() == "ICI"
+            or bool(conf.get_entry(MESH_ENABLED)))
+
+
+def collective_applicable(mode: str, num_partitions: int) -> bool:
+    """Whether an exchange of this shape has a collective form AT ALL.
+    A single output partition is a gather, not an all-to-all — taking
+    the host path there is not a demotion, so it neither counts toward
+    hostShuffleFallbacks nor earns a fallback note in explain()."""
+    return mode != "single" and num_partitions > 1
+
+
+def ici_demotion_reason(conf: RapidsConf, mode: str, num_partitions: int,
+                        schema) -> Optional[str]:
+    """Why an ICI-requested exchange takes the host-file shuffle, or
+    None when the collective path will run. STATIC facts only (mode,
+    partition count, device count, column dtypes), so the overrides
+    tagger surfaces the same reason in explain() that the exec acts on
+    at execution (the demotion analog for shuffles: the exchange still
+    runs on device, just through the host path). Callers gate on
+    ``collective_applicable`` first — shapes with no collective form
+    are not demotions."""
+    import jax
+    from spark_rapids_tpu.parallel.mesh import MESH
+    if mode != "hash":
+        return (f"{mode} partitioning has no deterministic per-row "
+                f"device target; host shuffle computes it row-by-row")
+    # ONE atomic snapshot: separate enabled/ndev reads racing a
+    # concurrent reconfiguration could see enabled=True then ndev=0
+    ndev = MESH.effective_ndev()
+    if ndev is None:
+        ndev = len(jax.devices())
+    if num_partitions > ndev:
+        return (f"partition count {num_partitions} exceeds the "
+                f"{ndev}-device mesh")
+    nested = [n for n, dt in schema
+              if isinstance(dt, (T.ArrayType, T.StructType, T.MapType))]
+    if nested:
+        return (f"nested-type columns ({', '.join(nested[:3])}) have no "
+                f"collective-exchangeable device layout")
+    return None
+
+
 def make_partitioner(mode: str, keys: Sequence[Expression],
                      num_partitions: int) -> Partitioner:
     mode = mode.lower()
@@ -80,35 +130,48 @@ class TpuShuffleExchangeExec(TpuExec):
         self.keys = list(keys)
         self.conf = conf
         self.target_batch_bytes = target_batch_bytes
+        #: why an ICI-requested exchange demoted to the host shuffle
+        #: (None while on the collective path or when never requested)
+        self.ici_fallback_reason: Optional[str] = None
 
     def output_schema(self):
         return self.children[0].output_schema()
 
     def describe(self):
-        return f"TpuShuffleExchange[{self.mode}, n={self.num_partitions}]"
+        extra = (f", hostShuffleFallback={self.ici_fallback_reason!r}"
+                 if self.ici_fallback_reason else "")
+        return f"TpuShuffleExchange[{self.mode}, n={self.num_partitions}{extra}]"
 
     def _aqe_coalesce_enabled(self) -> bool:
         from spark_rapids_tpu.conf import AQE_COALESCE_PARTITIONS
         return bool(self.conf.get_entry(AQE_COALESCE_PARTITIONS))
 
     def _ici_eligible(self) -> bool:
-        """The collective path runs when the user asked for ICI mode, the
-        partitioning is hash, and every partition maps onto one device of
-        the local slice (SURVEY §2.6: 'partitions on one slice ->
-        collective, else host shuffle')."""
-        import jax
-        from spark_rapids_tpu.conf import SHUFFLE_MANAGER_MODE
-        mode = str(self.conf.get_entry(SHUFFLE_MANAGER_MODE)).upper()
-        # non-pow2 partition counts pad the row capacity up to a
-        # multiple of the mesh size (_pad_capacity) — no pow2 gate.
-        # DECIMAL128 payload columns take the host shuffle: MeshExchange's
-        # collective kernels scatter 1-D column arrays only (the host
-        # serializer has a two-limb branch)
-        from spark_rapids_tpu import types as T
-        return (mode == "ICI" and self.mode == "hash"
-                and 1 < self.num_partitions <= len(jax.devices())
-                and not any(T.is_dec128(dt)
-                            for _, dt in self.output_schema()))
+        """The collective path runs when the session asked for it (ICI
+        shuffle mode or mesh-native execution), the partitioning is
+        hash, and every partition maps onto one mesh device (SURVEY
+        §2.6: 'partitions on one slice -> collective, else host
+        shuffle'). Supports EVERY non-nested column type — decimal128's
+        two-limb layout rides the collective as a trailing dim — and
+        non-pow2 partition counts pad the row capacity up to a multiple
+        of the mesh size (_pad_capacity). A requested-but-demoted
+        exchange counts hostShuffleFallbacks with the reason surfaced
+        in explain() (overrides._tag_exchange notes the same static
+        reason this check acts on)."""
+        if not ici_requested(self.conf):
+            return False
+        if not collective_applicable(self.mode, self.num_partitions):
+            return False
+        reason = ici_demotion_reason(self.conf, self.mode,
+                                     self.num_partitions,
+                                     self.output_schema())
+        if reason is not None:
+            from spark_rapids_tpu.parallel.mesh import MESH_SCOPE
+            self.ici_fallback_reason = reason
+            self.add_metric("hostShuffleFallbacks", 1)
+            MESH_SCOPE.add("hostShuffleFallbacks", 1)
+            return False
+        return True
 
     #: masked batches share the input buffers, but every downstream
     #: kernel still runs at full input capacity PER partition — beyond
@@ -214,21 +277,22 @@ class TpuShuffleExchangeExec(TpuExec):
             yield out
 
     def _execute_ici(self):
-        """ONE all-to-all collective over a device mesh instead of the
+        """ONE all-to-all collective over the device mesh instead of the
         host-file shuffle: coalesce input, evaluate key columns, exchange
         every column's rows to its murmur3 partition's device, emit one
-        front-compacted batch per partition (parallel/exchange.py)."""
-        import jax
-        import jax.numpy as jnp
-        import numpy as np
-        from jax.sharding import Mesh
-
-        from spark_rapids_tpu import types as T
+        front-compacted batch per partition (parallel/exchange.py).
+        Input shards stay DEVICE-RESIDENT end to end — the only host
+        traffic is the per-shard live-count fetch, which doubles as the
+        AQE map-output statistic (skew/coalesce decisions see the real
+        shard distribution instead of the host path's file sizes)."""
         from spark_rapids_tpu.columnar import DeviceColumn, bucket_for
         from spark_rapids_tpu.columnar.table import concat_device
         from spark_rapids_tpu.ops.expr import compile_project
-        from spark_rapids_tpu.parallel.exchange import MeshExchange
-        from spark_rapids_tpu.shuffle.partitioning import string_dict_bytes
+        from spark_rapids_tpu.parallel.exchange import (
+            MeshExchange,
+            interned_dict_bytes,
+        )
+        from spark_rapids_tpu.parallel.mesh import MESH, MESH_SCOPE
         from spark_rapids_tpu.runtime.retry import retry_block
 
         t0 = perf_counter()
@@ -246,20 +310,22 @@ class TpuShuffleExchangeExec(TpuExec):
             table = _pad_capacity(table, -(-table.capacity // ndev) * ndev)
 
         key_cols = compile_project(self.keys, table)
+        mesh, axis = MESH.exchange_mesh(ndev)
         string_bytes = {}
         for i, c in enumerate(key_cols):
             if isinstance(c.dtype, T.StringType):
-                mat, lens = string_dict_bytes(c.dictionary)
-                string_bytes[i] = (jnp.asarray(mat), jnp.asarray(lens))
+                # replicated byte matrix, interned by dictionary
+                # identity: repeated exchanges over one dictionary pay
+                # the replication upload once
+                string_bytes[i] = interned_dict_bytes(c.dictionary, mesh)
 
-        mesh = Mesh(np.array(jax.devices()[:ndev]), ("data",))
         ex = MeshExchange.get(
             mesh,
             tuple(str(c.dtype) for c in table.columns),
             tuple(range(len(key_cols))),
             tuple(c.dtype for c in key_cols),
             tuple(sorted((i, v[0].shape) for i, v in string_bytes.items())),
-            table.capacity)
+            table.capacity, axis_name=axis)
         out_d, out_v, counts = ex.run(
             [c.data for c in table.columns],
             [c.validity for c in table.columns],
@@ -269,6 +335,28 @@ class TpuShuffleExchangeExec(TpuExec):
             string_bytes)
         self.add_metric("iciExchangeTime", perf_counter() - t0)
         self.add_metric("iciPartitions", ndev)
+        # exchanged payload bytes (static shapes: no device sync)
+        ici_bytes = sum(a.nbytes for a in out_d) + \
+            sum(a.nbytes for a in out_v)
+        self.add_metric("iciBytes", ici_bytes)
+        MESH_SCOPE.add("iciExchanges", 1)
+        MESH_SCOPE.add("iciBytes", ici_bytes)
+
+        # AQE exchange statistics from the MEASURED per-shard live
+        # counts (MapOutputStatistics analog): rows x packed row bytes
+        # approximates per-partition output size, driving the same
+        # skew metric the host shuffle records from file sizes
+        row_bytes = max(self._packed_row_bytes_for(table), 1)
+        live = sorted(int(c) * row_bytes for c in counts if int(c) > 0)
+        if live:
+            from spark_rapids_tpu.conf import AQE_SKEW_FACTOR
+            median = live[len(live) // 2]
+            factor = float(self.conf.get_entry(AQE_SKEW_FACTOR))
+            skewed = sum(1 for b in live if b > factor * max(median, 1))
+            self.add_metric("mapOutputBytesMax", live[-1])
+            self.add_metric("mapOutputBytesMedian", median)
+            if skewed:
+                self.add_metric("skewedPartitions", skewed)
 
         shard = len(out_d[0]) // ndev if out_d else 0
         for p in range(ndev):
@@ -283,6 +371,18 @@ class TpuShuffleExchangeExec(TpuExec):
                                          dictionary=c.dictionary,
                                          dict_sorted=c.dict_sorted))
             yield DeviceTable(table.names, cols, n, k)
+
+    @staticmethod
+    def _packed_row_bytes_for(table: DeviceTable) -> int:
+        """Approximate serialized bytes per row of ``table`` (column
+        data words + validity) for the AQE map-output statistic."""
+        total = 0
+        for c in table.columns:
+            itemsize = getattr(c.data.dtype, "itemsize", 4)
+            if getattr(c.data, "ndim", 1) == 2:
+                itemsize *= c.data.shape[1]
+            total += itemsize + 1
+        return total
 
     def _shuffle_manager(self):
         """MULTITHREADED -> file-backed manager; P2P -> cached blocks

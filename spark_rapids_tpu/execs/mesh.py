@@ -1,0 +1,139 @@
+"""Mesh re-land boundary: where sharded residency ends inside a plan.
+
+Mesh-native execution (parallel/mesh.py) lands scan shards per-device
+and lets the narrow pipeline — filter/project/masked ops, and the ICI
+shuffle exchange — run on the resident shards (GSPMD partitions those
+kernels; they are elementwise or pure data movement, so their results
+are bitwise independent of the layout). Wide kernels are NOT layout-
+independent: a float reduction partitioned over 8 shards accumulates in
+a different order than the single-chip kernel, and the contract for
+this engine is BIT-IDENTITY with single-chip results (scale_test
+--mesh, MULTICHIP_r06). So every wide consumer (aggregate, sort, join,
+window, ...) takes its input through a :class:`TpuMeshRelandExec`
+boundary inserted at conversion time: one device-side gather (ICI on a
+real pod — the host is never touched, pinned by RL-MESH-HOST and the
+meshHostUploads counter) that re-lands the shards into the single-
+device layout the wide kernel compiles against.
+
+Post-exchange inputs are already per-device (the all-to-all emits each
+partition on its owner device), so the boundary is a no-op there — the
+distributed path through scan -> narrow ops -> ICI exchange ->
+per-partition wide ops pays zero re-lands and zero host transfers.
+"""
+
+from __future__ import annotations
+
+from spark_rapids_tpu.columnar import DeviceTable
+from spark_rapids_tpu.execs.base import (
+    DeviceToHost,
+    HostToDevice,
+    InputAdapter,
+    TpuExec,
+)
+
+
+class TpuMeshRelandExec(TpuExec):
+    """Schema-preserving residency boundary: re-lands physically
+    sharded batches into the single-device layout (DeviceTable.
+    unsharded) so the parent's kernels bitwise-match single-chip
+    execution. Transparent to both batch protocols — masked batches
+    stay masked (their live mask re-lands with the columns)."""
+
+    def __init__(self, child: TpuExec):
+        super().__init__()
+        self.children = (child,)
+        # mirror the child's protocol so mask-aware parents keep
+        # consuming masked batches through the boundary
+        self.produces_masked = bool(getattr(child, "produces_masked",
+                                            False))
+
+    def output_schema(self):
+        return self.children[0].output_schema()
+
+    def execute(self):
+        for b in self.children[0].execute():
+            yield self._reland(b)
+
+    def execute_masked(self):
+        for b in self.children[0].execute_masked():
+            yield self._reland(b)
+
+    def _reland(self, table: DeviceTable) -> DeviceTable:
+        # count only PHYSICAL gathers: unsharded() also returns a new
+        # object when it merely drops a shard_spec descriptor from
+        # single-device buffers (1-device mesh) — no data moved there
+        if table.physically_sharded() and table.columns:
+            from spark_rapids_tpu.parallel.mesh import MESH_SCOPE
+            self.add_metric("meshRelandRows", table.capacity)
+            MESH_SCOPE.add("meshRelandRows", table.capacity)
+        return table.unsharded()
+
+    def describe(self):
+        return "MeshReland"
+
+
+#: consumers that accept physically sharded input: elementwise /
+#: data-movement execs whose results are bitwise layout-independent
+#: (GSPMD partitions them across the resident shards), the ICI
+#: exchange (it re-shards explicitly via shard_put), and the re-land
+#: boundary itself. Everything else sees the single-device layout.
+def _shard_safe_consumers() -> tuple:
+    from spark_rapids_tpu.execs.basic import TpuFilterExec, TpuProjectExec
+    from spark_rapids_tpu.execs.exchange import TpuShuffleExchangeExec
+    return (TpuFilterExec, TpuProjectExec, TpuShuffleExchangeExec,
+            TpuMeshRelandExec)
+
+
+def insert_mesh_relands(executable):
+    """Conversion-time pass (applied by apply_overrides when mesh-
+    native execution is on): wrap the TpuExec children of every
+    non-shard-safe consumer in a re-land boundary, and stamp every scan
+    with the mesh generation the boundaries were planned against
+    (``_mesh_scan_gen`` — execs/basic._scan_sharding). Sharded
+    placement is therefore BOUND to the converted tree: an unstamped
+    tree (converted with the mesh off) never lands sharded batches even
+    if a concurrent session flips the process mesh on mid-query — it
+    has no boundaries, so sharded input would let GSPMD repartition a
+    wide float kernel and break bit-identity. The boundary is a no-op
+    on unsharded batches, so liberal insertion is correct — the
+    whitelist only determines where sharded residency may FLOW, and
+    default-deny means a new exec is bit-identical by construction
+    until it is proven layout-independent."""
+    from spark_rapids_tpu.execs.basic import TpuFileScanExec, TpuScanExec
+    from spark_rapids_tpu.parallel.mesh import MESH
+
+    safe = _shard_safe_consumers()
+    gen = MESH.generation()
+
+    def rec(node):
+        if isinstance(node, (TpuScanExec, TpuFileScanExec)):
+            node._mesh_scan_gen = gen
+        if isinstance(node, DeviceToHost):
+            # the root/mid-plan transition gathers to host anyway (the
+            # sanctioned materialization point) — sharded input is fine
+            rec(node.tpu_exec)
+            return
+        if isinstance(node, HostToDevice):
+            rec(node.cpu_node)
+            return
+        if isinstance(node, InputAdapter):
+            rec(node.source)
+            return
+        scan_node = getattr(node, "scan_node", None)
+        if scan_node is not None:
+            rec(scan_node)
+        children = tuple(getattr(node, "children", ()) or ())
+        if not children:
+            return
+        if isinstance(node, TpuExec) and not isinstance(node, safe):
+            node.children = tuple(
+                TpuMeshRelandExec(c)
+                if isinstance(c, TpuExec)
+                and not isinstance(c, TpuMeshRelandExec) else c
+                for c in node.children)
+            children = node.children
+        for c in children:
+            rec(c)
+
+    rec(executable)
+    return executable

@@ -89,6 +89,12 @@ RULES: Dict[str, str] = {
                        "path outside the transactional committer (all "
                        "table output must stage through io/committer.py "
                        "so a crash can never leave a torn final file)",
+    "RL-MESH-HOST": "host materialization (np.asarray / jax.device_get "
+                    "/ host_fetch / .block_until_ready / "
+                    ".addressable_shards) inside parallel/ or the "
+                    "shard-dispatch placement layer outside a "
+                    "sanctioned gather point (device shards must stay "
+                    "resident between exchanges)",
 }
 
 

@@ -227,6 +227,7 @@ _PASS_THROUGH = {
     "TpuFilterExec", "TpuLimitExec", "TpuCoalesceExec", "TpuSortExec",
     "TpuShuffleExchangeExec", "TpuBroadcastExchangeExec",
     "TpuAdaptiveBuildExec", "TpuWindowGroupLimitExec", "TpuSampleExec",
+    "TpuMeshRelandExec",
     "Filter", "Sort", "Limit", "CollectLimit", "Exchange", "Sample",
     "WindowGroupLimit", "CachedRelation",
 }

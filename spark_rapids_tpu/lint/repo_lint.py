@@ -27,6 +27,13 @@ conf key referenced by a typo'd string — this lint can.  Rules (RL-*):
   slots) written inside a function must be written under a lock guard
   (a ``with <something named *lock*/*cond*>:`` block) or appear in the
   sanctioned allowlist with a justification.
+* RL-MESH-HOST — mesh-native execution keeps shards device-resident
+  BETWEEN exchanges (the PERF.md upload cost class this PR removes):
+  inside ``parallel/`` and the shard-dispatch placement layer, host
+  materialization (``np.asarray``, ``jax.device_get``, ``host_fetch``,
+  ``.block_until_ready()``, ``.addressable_shards`` reads) may appear
+  only at sanctioned gather points (``_MESH_HOST_ALLOWLIST``, each
+  entry justified).
 * RL-WRITE-COMMIT — the exactly-once write contract holds only if
   every byte of table output stages through the transactional
   committer (io/committer.py): in ``io/`` modules, file-creating calls
@@ -484,6 +491,76 @@ def _check_write_commit(rel: str, tree: ast.AST,
     walk(tree, False)
 
 
+#: sanctioned mesh->host materialization points: "<rel>:<function>" ->
+#: justification. The hook for new gather points — add an entry HERE
+#: with a reason, never a bare suppression.
+_MESH_HOST_ALLOWLIST = {
+    "spark_rapids_tpu/parallel/mesh.py:mesh_gather":
+        "THE sanctioned mesh->host gather point (routes through "
+        "dispatch.host_fetch and counts meshGatherRows; the ICI "
+        "exchange's per-shard live-count fetch comes through here)",
+    "spark_rapids_tpu/parallel/mesh.py:MeshRuntime.configure":
+        "np.array over a list of jax DEVICE HANDLES (building the Mesh "
+        "topology array) — no device data is materialized",
+    "spark_rapids_tpu/parallel/mesh.py:MeshRuntime.exchange_mesh":
+        "np.array over jax device handles (submesh construction) — no "
+        "device data is materialized",
+}
+
+
+def _check_mesh_host(rel: str, tree: ast.AST, diags: List[Diagnostic]):
+    """RL-MESH-HOST: inside parallel/ and the shard-dispatch placement
+    layer, host materialization of device data (np.asarray on arrays,
+    jax.device_get, dispatch.host_fetch, .block_until_ready(),
+    .addressable_shards reads) is forbidden outside the sanctioned
+    gather points — the static guard for 'zero host round-trips
+    between exchanges': shards land once at the scan and stay
+    device-resident until a sanctioned gather."""
+    if not (rel.startswith("spark_rapids_tpu/parallel/")
+            or rel == "spark_rapids_tpu/runtime/placement.py"):
+        return
+
+    def flag(node, what: str, func: Optional[str]):
+        if f"{rel}:{func}" in _MESH_HOST_ALLOWLIST:
+            return
+        diags.append(make(
+            "RL-MESH-HOST", f"{rel}:{node.lineno}",
+            f"{what} in mesh/shard-dispatch code"
+            + (f" (function {func!r})" if func else " (module level)")
+            + " — device shards must stay resident between exchanges; "
+            "gather through parallel.mesh.mesh_gather or allowlist the "
+            "function in _MESH_HOST_ALLOWLIST with a justification"))
+
+    def walk(node, func: Optional[str]):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            # QUALIFIED name (Class.method / outer.inner): a bare-name
+            # key would exempt EVERY function sharing the allowlisted
+            # name anywhere in the file
+            func = f"{func}.{node.name}" if func else node.name
+        if isinstance(node, ast.Call):
+            chain = _attr_chain(node.func)
+            if chain in ("np.asarray", "numpy.asarray", "asarray",
+                         "np.array", "numpy.array"):
+                # bare 'asarray' covers `from numpy import asarray`;
+                # np.array() forces the same device->host copy
+                flag(node, f"{chain}()", func)
+            elif chain.endswith("device_get") and chain.startswith(
+                    ("jax.", "jax")):
+                flag(node, f"{chain}()", func)
+            elif chain == "host_fetch" or chain.endswith(".host_fetch"):
+                flag(node, f"{chain}()", func)
+            elif chain.endswith(".block_until_ready"):
+                flag(node, f"{chain}()", func)
+        elif isinstance(node, ast.Attribute) \
+                and node.attr == "addressable_shards":
+            flag(node, ".addressable_shards read", func)
+        for child in ast.iter_child_nodes(node):
+            walk(child, func)
+
+    walk(tree, None)
+
+
 def _check_dead_lambdas(rel: str, tree: ast.AST,
                         diags: List[Diagnostic]):
     lambda_defs = {}
@@ -531,6 +608,7 @@ def lint_repo(repo_root: Optional[str] = None) -> List[Diagnostic]:
         _check_dead_lambdas(rel, tree, diags)
         _check_thread_shared(rel, tree, diags)
         _check_write_commit(rel, tree, diags)
+        _check_mesh_host(rel, tree, diags)
         _check_fault_sites(rel, tree, fault_calls, diags)
     _check_fault_registry(fault_calls, diags)
     return diags

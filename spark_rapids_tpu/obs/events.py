@@ -67,7 +67,15 @@ EVENT_LOG_DIR = str_conf(
 #: (Delta optimistic commits rebased and retried after losing the
 #: version race) — per-record DELTAS of the ``write`` scope, all 0
 #: for read-only queries and result-cache serves.
-EVENT_SCHEMA_VERSION = 5
+#: v6 (mesh-native execution PR): + meshShape (the active device-mesh
+#: topology — '8' / '2x4' — null when mesh-native execution is off),
+#: iciBytes (payload bytes this query moved through ICI all-to-all
+#: collectives; per-record DELTA of the ``mesh`` scope, 0 off-mesh)
+#: and shardSkew (max over the query's ICI exchanges of per-shard
+#: map-output max/median bytes — the AQE skew signal measured from
+#: REAL shard distributions; 0.0 when no collective exchange ran).
+#: Result-cache serves carry the serve-time meshShape and 0/0.0.
+EVENT_SCHEMA_VERSION = 6
 
 
 def plan_tree(executable) -> dict:
@@ -136,6 +144,7 @@ def collect_exchanges(executable) -> List[dict]:
             "shuffleReadTime", "mapOutputBytesMax", "mapOutputBytesMedian",
             "skewedPartitions", "aqeCoalescedPartitions",
             "recomputedMapOutputs", "iciExchangeTime", "iciPartitions",
+            "iciBytes", "hostShuffleFallbacks",
             "localSplitParts", "localSplitTime")
     out = []
     for e in _walk_exec_tree(executable):
@@ -182,13 +191,24 @@ def build_query_record(*, query_index: int, wall_s: float,
                        worker_restarts: int = 0,
                        files_written: int = 0,
                        bytes_written: int = 0,
-                       commit_retries: int = 0) -> dict:
+                       commit_retries: int = 0,
+                       mesh_shape: Optional[str] = None,
+                       ici_bytes: int = 0) -> dict:
     """Assemble one event-log record. Every field is JSON-native; the
     golden schema test normalizes timings and pins the shape.
     ``service`` is the query-service envelope (tenant, pool, queueWaitS,
     cacheHit) — None for queries executed outside the service, which
     still record the fields as null/false so the schema is stable."""
     service = service or {}
+    exchanges = collect_exchanges(executable)
+    # per-shard skew of this query's ICI exchanges (measured from the
+    # collective's live counts, not file sizes): max over exchanges of
+    # max/median per-shard map-output bytes
+    shard_skew = 0.0
+    for e in exchanges:
+        if "iciBytes" in e and e.get("mapOutputBytesMedian"):
+            shard_skew = max(shard_skew, e["mapOutputBytesMax"]
+                             / max(e["mapOutputBytesMedian"], 1))
     return {
         "schema": EVENT_SCHEMA_VERSION,
         "event": "queryCompleted",
@@ -212,12 +232,15 @@ def build_query_record(*, query_index: int, wall_s: float,
         "filesWritten": int(files_written),
         "bytesWritten": int(bytes_written),
         "commitRetries": int(commit_retries),
+        "meshShape": mesh_shape,
+        "iciBytes": int(ici_bytes),
+        "shardSkew": round(float(shard_skew), 4),
         "faultReplays": fault_replays,
         "plan": plan_tree(executable),
         "fallbacks": collect_fallbacks(meta),
         "demotions": dict(demotions),
         "aqe": collect_aqe(executable),
-        "exchanges": collect_exchanges(executable),
+        "exchanges": exchanges,
         "recovery": dict(recovery_delta),
         "scopes": scope_deltas,
         "faultFires": dict(fault_fires),

@@ -560,6 +560,23 @@ def _tag_exchange(meta, conf):
         meta.reasons.append("hash partitioning requires keys")
     for k in node.keys:
         check_expr(k, conf, meta.reasons, "partition key ")
+    if not meta.reasons:
+        # mesh/ICI demotion note: the exchange still runs on device, but
+        # an ICI-requested collective that must take the host-file
+        # shuffle surfaces WHY here — the exec acts on the same static
+        # reason at execution (hostShuffleFallbacks metric)
+        from spark_rapids_tpu.execs.exchange import (
+            collective_applicable,
+            ici_demotion_reason,
+            ici_requested,
+        )
+        if ici_requested(conf) and collective_applicable(
+                node.partitioning, node.num_partitions):
+            reason = ici_demotion_reason(
+                conf, node.partitioning, node.num_partitions,
+                node.children[0].output_schema())
+            if reason is not None:
+                meta.notes.append(f"host-shuffle fallback: {reason}")
 
 
 def _convert_exchange(node: P.Exchange, children, conf):
@@ -941,6 +958,11 @@ class PlanMeta:
         self.conf = conf
         self.parent = parent
         self.reasons: List[str] = []
+        #: advisory demotion notes: the op still runs ON DEVICE but a
+        #: requested fast path demoted (e.g. an ICI-requested exchange
+        #: taking the host-file shuffle). Rendered by explain() like
+        #: fallback reasons but never forcing CPU conversion.
+        self.notes: List[str] = []
         # CachedRelation is a planning LEAF: its child executes through its
         # own session at materialize() time; tagging/converting the subtree
         # here would duplicate planning and (on fallback) re-point the
@@ -988,7 +1010,10 @@ class PlanMeta:
         line = "  " * indent + f"{mark} {self.node.describe()}"
         if self.reasons:
             line += "  <-- " + "; ".join(self.reasons)
-        out = [line] if (not only_fallback or self.reasons or indent == 0) else [
+        if self.notes:
+            line += "  (" + "; ".join(self.notes) + ")"
+        out = [line] if (not only_fallback or self.reasons or self.notes
+                         or indent == 0) else [
             "  " * indent + f"{mark} {self.node.describe()}"]
         for c in self.children:
             out.append(c.explain(indent + 1, only_fallback))
@@ -1121,6 +1146,11 @@ def apply_overrides(plan: P.PlanNode, conf: RapidsConf):
     """GpuOverrides.apply analog: tag + CBO + convert (or explain-only)."""
     if not conf.sql_enabled:
         return plan, None
+    # the mesh runtime must reflect THIS conf before tagging: the
+    # exchange demotion notes and the reland pass below both read it
+    # (idempotent when the session's placement layer already prepared)
+    from spark_rapids_tpu.parallel.mesh import MESH
+    MESH.configure(conf)
     from spark_rapids_tpu.conf import COLUMN_PRUNING
     if conf.get_entry(COLUMN_PRUNING):
         from spark_rapids_tpu.overrides.pruning import prune_plan
@@ -1131,10 +1161,23 @@ def apply_overrides(plan: P.PlanNode, conf: RapidsConf):
     apply_cbo(meta, conf)
     if conf.is_explain_only:
         return plan, meta
-    return convert_plan(meta), meta
+    executable = convert_plan(meta)
+    if MESH.enabled:
+        # mesh-native execution: bound sharded residency at wide-kernel
+        # boundaries (execs/mesh.py) — part of the converted tree, so
+        # the executable cache parks the boundaries with it (and its
+        # mesh-generation stamp keeps them coherent)
+        from spark_rapids_tpu.execs.mesh import insert_mesh_relands
+        executable = insert_mesh_relands(executable)
+    return executable, meta
 
 
 def explain_plan(plan: P.PlanNode, conf: RapidsConf) -> str:
+    # same mesh realization as apply_overrides: an explain() before the
+    # first execute must report the demotion reasons the exec will act
+    # on, not a stale (or never-configured) mesh
+    from spark_rapids_tpu.parallel.mesh import MESH
+    MESH.configure(conf)
     meta = wrap_plan(plan, conf)
     out = meta.explain(only_fallback=conf.explain_mode != "ALL")
     # poison-query quarantine (runtime/health.py): a template with a

@@ -9,5 +9,7 @@ from spark_rapids_tpu.parallel.exchange import (
     mesh_hash_exchange,
     mesh_partial_then_merge,
 )
+from spark_rapids_tpu.parallel.mesh import MESH, MeshRuntime
 
-__all__ = ["mesh_hash_exchange", "mesh_partial_then_merge"]
+__all__ = ["MESH", "MeshRuntime", "mesh_hash_exchange",
+           "mesh_partial_then_merge"]

@@ -17,6 +17,7 @@ mesh — O(dict) bytes), so Spark-exact murmur3 applies to strings too.
 
 from __future__ import annotations
 
+import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -25,6 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from spark_rapids_tpu import types as T
+from spark_rapids_tpu.parallel.mesh import MESH_SCOPE, count_mesh_upload
 from spark_rapids_tpu.shuffle.hashing import (
     SPARK_SEED,
     murmur3_hash_device,
@@ -35,6 +37,122 @@ from spark_rapids_tpu.shuffle.hashing import (
 def _shard_map():
     from spark_rapids_tpu.shims import get_shim
     return get_shim().shard_map()
+
+
+def _axis_size(mesh, axis) -> int:
+    """Device count of ``axis`` — a single axis name or a tuple of them
+    (the hierarchical (dcn, ici) mesh exchanges over both)."""
+    if isinstance(axis, tuple):
+        n = 1
+        for a in axis:
+            n *= mesh.shape[a]
+        return n
+    return mesh.shape[axis]
+
+
+#: replicated string-dictionary byte matrices, interned by DICTIONARY
+#: IDENTITY per device set (the dispatch.device_const pattern lifted to
+#: the mesh): repeated exchanges over one dictionary pay the replication
+#: upload once. ndarrays are not weakref-able, so the bounded LRU keys
+#: on id() and pins the dictionary with a strong reference — the pin is
+#: exactly what makes the id key sound (a live object's id can't be
+#: reused), and the cap bounds the pinned host memory.
+from collections import OrderedDict
+
+_DICT_INTERN: "OrderedDict[int, tuple]" = OrderedDict()
+_DICT_INTERN_LOCK = threading.Lock()
+_DICT_INTERN_CAP = 256
+#: (id(dict), dev_ids) -> Event while one thread replicates that entry:
+#: concurrent first-exchangers over one dictionary wait for the winner
+#: instead of each paying the upload (and each counting meshDictInterns/
+#: meshHostUploads — the warm-path-zero contract must hold under a
+#: concurrent QueryService too)
+_DICT_INFLIGHT: dict = {}
+#: bumped by clear_mesh_caches (under _DICT_INTERN_LOCK): a builder
+#: that started against the pre-invalidation backend must not PUBLISH
+#: its entry after the clear — device ids survive a reinit unchanged,
+#: so a late insert would permanently re-seed the cache with the dead
+#: backend's buffers (the executable cache's generation-stamp-at-
+#: re-park contract, applied to these two caches)
+_MESH_CACHE_EPOCH = 0
+
+
+def clear_mesh_caches() -> int:
+    """Drop every mesh-exchange cache that references device state: the
+    interned replicated dictionary matrices ARE device arrays and a
+    MeshExchange instance holds the mesh's Device objects plus a jitted
+    program compiled against them. Both key on device IDS, which
+    survive a device-loss backend reinit unchanged — without this hook
+    a recovered backend would keep serving buffers of the dead one
+    (runtime/health.py calls here alongside the exec/kernel/const/scan
+    caches) — and the OOM eviction path (runtime/retry.py) frees the
+    pinned replicated matrices like any other evictable device cache.
+    Returns the number of entries dropped."""
+    global _MESH_CACHE_EPOCH
+    with _DICT_INTERN_LOCK:
+        n = len(_DICT_INTERN)
+        _DICT_INTERN.clear()
+        n += len(MeshExchange._cache)
+        MeshExchange._cache.clear()
+        # reject in-flight builders' late publishes (their device state
+        # predates the invalidation)
+        _MESH_CACHE_EPOCH += 1
+    return n
+
+
+def interned_dict_bytes(dictionary: np.ndarray, mesh) -> tuple:
+    """(byte_matrix, lengths) of ``dictionary`` as device arrays
+    replicated across ``mesh``, interned by dictionary identity. The
+    replication happens OUTSIDE the lock (it is the slow part), so a
+    per-(dictionary, device set) in-flight marker closes the
+    check-then-act window: concurrent first-exchangers wait for the
+    winner's entry instead of each paying — and counting — the upload.
+    A winner that fails clears its marker in the finally, so a waiter
+    loops back, misses, and becomes the uploader itself."""
+    from jax.sharding import NamedSharding, PartitionSpec as P_
+    dev_ids = tuple(d.id for d in mesh.devices.flat)
+    key = id(dictionary)
+    flight_key = (key, dev_ids)
+    while True:
+        with _DICT_INTERN_LOCK:
+            entry = _DICT_INTERN.get(key)
+            if entry is not None and entry[0] is dictionary:
+                _DICT_INTERN.move_to_end(key)
+                hit = entry[1].get(dev_ids)
+                if hit is not None:
+                    return hit
+            ev = _DICT_INFLIGHT.get(flight_key)
+            if ev is None:
+                ev = threading.Event()
+                _DICT_INFLIGHT[flight_key] = ev
+                break  # this thread replicates
+        ev.wait()
+    try:
+        with _DICT_INTERN_LOCK:
+            epoch = _MESH_CACHE_EPOCH
+        mat, lens = string_dict_bytes(dictionary)
+        rep = NamedSharding(mesh, P_())
+        out = (jax.device_put(mat, rep), jax.device_put(lens, rep))
+        count_mesh_upload(2)
+        MESH_SCOPE.add("meshDictInterns", 1)
+        with _DICT_INTERN_LOCK:
+            if epoch != _MESH_CACHE_EPOCH:
+                # clear_mesh_caches ran mid-build (device-loss reinit):
+                # this entry references the dead backend — serve it to
+                # THIS caller only, never publish it
+                return out
+            entry = _DICT_INTERN.get(key)
+            if entry is None or entry[0] is not dictionary:
+                entry = (dictionary, {})
+                _DICT_INTERN[key] = entry
+                while len(_DICT_INTERN) > _DICT_INTERN_CAP:
+                    _DICT_INTERN.popitem(last=False)
+            entry[1][dev_ids] = out
+        return out
+    finally:
+        with _DICT_INTERN_LOCK:
+            _DICT_INFLIGHT.pop(flight_key, None)
+        ev.set()
 
 
 def _bucketize(pid, live, ndev: int, cap: int):
@@ -68,19 +186,29 @@ class MeshExchange:
     def get(cls, mesh, col_dtypes: Tuple[str, ...], key_cols: Tuple[int, ...],
             key_dtypes, string_key_shapes: tuple, cap: int,
             axis_name: str = "data"):
-        dev_ids = tuple(d.id for d in np.asarray(mesh.devices).flat)
+        dev_ids = tuple(d.id for d in mesh.devices.flat)
         key = (dev_ids, col_dtypes, key_cols, tuple(map(str, key_dtypes)),
                string_key_shapes, cap, axis_name)
-        inst = cls._cache.get(key)
+        with _DICT_INTERN_LOCK:
+            inst = cls._cache.get(key)
+            epoch = _MESH_CACHE_EPOCH
         if inst is None:
             inst = cls(mesh, key_dtypes, axis_name)
-            cls._cache[key] = inst
+            with _DICT_INTERN_LOCK:
+                if epoch == _MESH_CACHE_EPOCH:
+                    cls._cache[key] = inst
+                # else: clear_mesh_caches ran mid-build (device-loss
+                # reinit) — the instance holds the dead backend's mesh;
+                # serve it to this caller only, never publish
         return inst
 
-    def __init__(self, mesh, key_dtypes, axis_name: str = "data"):
+    def __init__(self, mesh, key_dtypes, axis_name="data"):
         self.mesh = mesh
+        #: a single axis name, or a tuple of names for the hierarchical
+        #: (dcn, ici) mesh — the all-to-all then rides the fast inner
+        #: axis within each dcn group (one collective, two mesh dims)
         self.axis_name = axis_name
-        self.ndev = mesh.shape[axis_name]
+        self.ndev = _axis_size(mesh, axis_name)
         self.key_dtypes = list(key_dtypes)
         self._fn = None
 
@@ -111,20 +239,24 @@ class MeshExchange:
             pid = jnp.where(pid < 0, pid + ndev, pid)
             tgt = _bucketize(pid, live, ndev, cap)
 
-            send_live = jnp.zeros((ndev * cap,), jnp.bool_).at[tgt].set(
+            def exchange(arr):
+                """Scatter into the (ndev, cap) send buffer and run the
+                all-to-all — trailing dims (the decimal128 two-limb
+                layout) ride along, indexed on the row axis only."""
+                tail = arr.shape[1:]
+                send = jnp.zeros((ndev * cap,) + tail, arr.dtype).at[
+                    tgt].set(arr, mode="drop").reshape((ndev, cap) + tail)
+                return jax.lax.all_to_all(send, axis, 0, 0).reshape(
+                    (ndev * cap,) + tail)
+
+            recv_live = jnp.zeros((ndev * cap,), jnp.bool_).at[tgt].set(
                 True, mode="drop").reshape(ndev, cap)
-            recv_live = jax.lax.all_to_all(send_live, axis, 0, 0)
+            recv_live = jax.lax.all_to_all(recv_live, axis, 0, 0)
 
             out_datas, out_valids = [], []
             for d, v in zip(datas, valids):
-                send = jnp.zeros((ndev * cap,), d.dtype).at[tgt].set(
-                    d, mode="drop").reshape(ndev, cap)
-                send_v = jnp.zeros((ndev * cap,), jnp.bool_).at[tgt].set(
-                    v, mode="drop").reshape(ndev, cap)
-                out_datas.append(jax.lax.all_to_all(
-                    send, axis, 0, 0).reshape(ndev * cap))
-                out_valids.append(jax.lax.all_to_all(
-                    send_v, axis, 0, 0).reshape(ndev * cap))
+                out_datas.append(exchange(d))
+                out_valids.append(exchange(v))
 
             # per-shard compaction: received blocks are front-compacted per
             # source device but gapped between blocks; one scatter compacts
@@ -158,23 +290,33 @@ class MeshExchange:
         one live count per partition."""
         from jax.sharding import NamedSharding, PartitionSpec as P_
 
+        from spark_rapids_tpu.parallel.mesh import shard_put
+
         string_bytes = string_bytes or {}
         has_sbytes = tuple(i in string_bytes for i in range(len(key_datas)))
         if self._fn is None:
             self._fn = self._build(len(datas), len(key_datas), has_sbytes)
         sharding = NamedSharding(self.mesh, P_(self.axis_name))
         rep = NamedSharding(self.mesh, P_())
-        flat = [jax.device_put(x, sharding)
+        # shard_put counts host uploads: on a warm mesh query every
+        # input is already device-resident (scans landed sharded, the
+        # previous exchange's outputs never left the device), so the
+        # puts below are device-side reshards only
+        flat = [shard_put(x, sharding)
                 for x in (*datas, *valids, *key_datas, *key_valids, live)]
         for i, has in enumerate(has_sbytes):
             if has:
                 mat, lens = string_bytes[i]
-                flat.append(jax.device_put(mat, rep))
-                flat.append(jax.device_put(lens, rep))
+                flat.append(shard_put(mat, rep))
+                flat.append(shard_put(lens, rep))
         out = self._fn(*flat)
         ncols = len(datas)
+        # the ONE host materialization an ICI exchange pays: the
+        # per-partition live counts, through the sanctioned gather
+        # point (they double as the AQE map-output statistic)
+        from spark_rapids_tpu.parallel.mesh import mesh_gather
         return (list(out[:ncols]), list(out[ncols:2 * ncols]),
-                np.asarray(out[2 * ncols]))
+                mesh_gather(out[2 * ncols]))
 
 
 def mesh_hash_exchange(mesh, dtypes: Sequence[T.DataType],

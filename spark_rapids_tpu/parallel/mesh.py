@@ -1,0 +1,388 @@
+"""Mesh runtime: the engine's device mesh as a FIRST-CLASS runtime object.
+
+The paper's target is a v5e-256 pod; the dryrun harness
+(``__graft_entry__.dryrun_multichip``) already models the hierarchical
+``(dcn, ici)`` mesh shape but the engine itself ran every query on one
+chip.  This module promotes the mesh to conf-driven engine state, owned
+by :class:`~spark_rapids_tpu.runtime.device_manager.TpuDeviceManager`:
+
+* ``spark.rapids.mesh.enabled`` turns mesh-native execution on;
+* ``spark.rapids.mesh.shape`` declares the topology — ``""`` (all local
+  devices on one flat axis), ``"8"`` (explicit 1-D size) or ``"2x4"``
+  (hierarchical: ``dcn`` x ``ici``, the multi-host slice layout — heavy
+  all-to-alls ride the fast inner axis, only merged partials cross dcn);
+* ``spark.rapids.mesh.axis`` names the flat row axis (default ``data``).
+
+Reconfiguration bumps a **generation** counter: the executable cache
+folds it into its coherency token, so a converted tree checked out
+before a mesh change can neither serve nor re-park after it, and the
+plan fingerprint folds the mesh **identity token** so cached plans never
+cross mesh configs.
+
+Host-transfer discipline (the PERF.md cost model: every h2d upload
+mid-pipeline is a ~0.15-3.3s stall on the tunneled TPU): shards land
+per-device with ``jax.device_put`` once at the scan, stay device-resident
+between exchanges, and the only sanctioned device->host materialization
+point in mesh code is :func:`mesh_gather` (the exchange's live-count
+fetch routes through it) — enforced statically by the RL-MESH-HOST
+lint rule.
+
+The mesh, like the device topology it models, is PROCESS state (one
+MeshRuntime, owned by TpuDeviceManager — the same contract as HEALTH
+and the circuit breaker). Concurrent sessions whose confs disagree on
+the mesh reconfigure it per query: results stay bit-identical either
+way (the re-land boundaries guarantee layout independence), but each
+effective change bumps the generation — alternating mesh/non-mesh
+sessions therefore thrash the executable cache by design (cached
+trees never cross mesh configs). Tenants of one QueryService share
+one session/conf and never hit this.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+from spark_rapids_tpu.conf import RapidsConf, bool_conf, str_conf
+from spark_rapids_tpu.obs.metrics import metric_scope, register_metric
+
+MESH_ENABLED = bool_conf(
+    "spark.rapids.mesh.enabled", False,
+    "Mesh-native distributed execution: partitioned scans land their "
+    "shards directly per-device over the conf-declared device mesh "
+    "(spark.rapids.mesh.shape), tables carry a NamedSharding row "
+    "descriptor through the plan, and every supported shuffle exchange "
+    "lowers to the ICI all-to-all collective (host-file shuffle stays "
+    "the fallback, with the demotion reason surfaced in explain()). "
+    "Mesh identity folds into the plan fingerprint and the executable "
+    "cache's generation, so cached plans never cross mesh configs.",
+    commonly_used=True)
+
+MESH_SHAPE = str_conf(
+    "spark.rapids.mesh.shape", "",
+    "Device-mesh topology for mesh-native execution: '' uses every "
+    "local device on one flat axis, 'N' is an explicit 1-D size, and "
+    "'DxI' builds the hierarchical (dcn, ici) mesh the multichip "
+    "dryrun models — all-to-all shuffles ride the fast inner ici axis. "
+    "The device count must not exceed the backend's local device count.")
+
+MESH_AXIS = str_conf(
+    "spark.rapids.mesh.axis", "data",
+    "Name of the flat row axis of a 1-D mesh (hierarchical 'DxI' "
+    "shapes always use ('dcn', 'ici')). Row-sharded tables carry a "
+    "PartitionSpec over this axis.")
+
+# -- the `mesh` metric scope -------------------------------------------------
+
+register_metric("shardsDispatched", "count", "ESSENTIAL",
+                "table shards landed per-device by mesh-native scans "
+                "(one per device per sharded upload)")
+register_metric("iciExchanges", "count", "ESSENTIAL",
+                "shuffle exchanges lowered to the ICI all-to-all "
+                "collective instead of the host-file shuffle")
+register_metric("iciBytes", "bytes", "ESSENTIAL",
+                "payload bytes moved through ICI all-to-all collectives "
+                "(column data + validity, the exchanged row shards)")
+register_metric("meshGatherRows", "count", "MODERATE",
+                "elements materialized to host through the sanctioned "
+                "mesh_gather point (per-partition live counts of each "
+                "ICI exchange — the one host sync a collective pays)")
+register_metric("hostShuffleFallbacks", "count", "ESSENTIAL",
+                "shuffle exchanges that requested the mesh/ICI path but "
+                "demoted to the host-file shuffle (reason surfaced in "
+                "explain() and the exchange's describe())")
+register_metric("meshHostUploads", "count", "MODERATE",
+                "host->device transfers performed inside mesh exchange "
+                "dispatch — 0 on a warm mesh query (shards device-"
+                "resident, dictionary bytes interned)")
+register_metric("meshRelandRows", "count", "MODERATE",
+                "row slots re-landed from the sharded layout into the "
+                "single-device layout at wide-kernel boundaries "
+                "(execs/mesh.py — device-to-device, never host)")
+register_metric("meshDictInterns", "count", "MODERATE",
+                "string-dictionary byte matrices replicated across the "
+                "mesh and interned by dictionary identity (repeated "
+                "exchanges over one dictionary pay replication once)")
+
+MESH_SCOPE = metric_scope("mesh")
+
+
+def _parse_shape(shape: str, avail: int) -> Tuple[int, ...]:
+    """'', 'N' or 'DxI' -> dims tuple. Raises on malformed shapes or
+    shapes wider than the available device count."""
+    from spark_rapids_tpu.errors import ColumnarProcessingError
+    s = shape.strip().lower()
+    if not s:
+        return (avail,)
+    parts = s.replace("*", "x").split("x")
+    try:
+        dims = tuple(int(p) for p in parts)
+    except ValueError:
+        raise ColumnarProcessingError(
+            f"spark.rapids.mesh.shape must be '', 'N' or 'DxI', got "
+            f"{shape!r}")
+    if len(dims) > 2 or any(d < 1 for d in dims):
+        raise ColumnarProcessingError(
+            f"spark.rapids.mesh.shape supports 1-D 'N' or 2-D 'DxI' "
+            f"positive dims, got {shape!r}")
+    total = 1
+    for d in dims:
+        total *= d
+    if total > avail:
+        raise ColumnarProcessingError(
+            f"spark.rapids.mesh.shape={shape!r} needs {total} devices "
+            f"but only {avail} are available")
+    return dims
+
+
+class MeshRuntime:
+    """Process-wide mesh state (owned by TpuDeviceManager, configured
+    per query by the placement layer). Reconfiguration is coherency-
+    relevant: the generation bumps whenever the effective (enabled,
+    dims, axis, devices) tuple changes, and both caches consult it."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._mesh = None
+        self._dims: Tuple[int, ...] = ()
+        self._axes: Tuple[str, ...] = ()
+        self._enabled = False
+        self._config_key = None
+        self._generation = 0
+
+    # -- configuration -------------------------------------------------------
+    def configure(self, conf: RapidsConf) -> None:
+        """Apply the session's mesh conf. Cheap when unchanged; a real
+        change rebuilds the mesh and bumps the generation. The config
+        key folds HEALTH's backend generation: a device-loss reinit
+        (runtime/health.py) replaces every jax Device object, and a
+        mesh built from the dead backend must be rebuilt on the next
+        prepare even though the conf tuple — and the surviving device
+        IDS the identity token hashes — are unchanged."""
+        from spark_rapids_tpu.runtime.health import HEALTH
+        enabled = bool(conf.get_entry(MESH_ENABLED))
+        shape = str(conf.get_entry(MESH_SHAPE))
+        axis = str(conf.get_entry(MESH_AXIS)).strip() or "data"
+        key = (enabled, shape.strip().lower(), axis, HEALTH.generation())
+        with self._lock:
+            if key == self._config_key:
+                return
+        # build OUTSIDE the lock (jax device discovery can be slow); the
+        # publish below re-checks the key so racing configurers converge
+        mesh = None
+        dims: Tuple[int, ...] = ()
+        axes: Tuple[str, ...] = ()
+        if enabled:
+            import jax
+            from jax.sharding import Mesh
+            devices = list(jax.devices())
+            dims = _parse_shape(shape, len(devices))
+            axes = ("dcn", "ici") if len(dims) == 2 else (axis,)
+            total = 1
+            for d in dims:
+                total *= d
+            mesh = Mesh(np.array(devices[:total]).reshape(dims), axes)
+        with self._lock:
+            if key == self._config_key:
+                return
+            self._mesh = mesh
+            self._dims = dims
+            self._axes = axes
+            self._enabled = enabled
+            self._config_key = key
+            self._generation += 1
+
+    # -- state ---------------------------------------------------------------
+    @property
+    def enabled(self) -> bool:
+        with self._lock:
+            return self._enabled and self._mesh is not None
+
+    def mesh(self):
+        with self._lock:
+            return self._mesh
+
+    @property
+    def ndev(self) -> int:
+        with self._lock:
+            if self._mesh is None:
+                return 0
+            n = 1
+            for d in self._dims:
+                n *= d
+            return n
+
+    def effective_ndev(self) -> Optional[int]:
+        """Mesh device count read under ONE lock hold — None when mesh-
+        native execution is off. The enabled/ndev pair must be a single
+        snapshot: two separate locked reads racing a concurrent
+        reconfiguration can observe enabled=True then ndev=0 (the
+        scan_placement atomicity argument, applied to the exchange's
+        demotion check)."""
+        with self._lock:
+            if not self._enabled or self._mesh is None:
+                return None
+            n = 1
+            for d in self._dims:
+                n *= d
+            return n
+
+    def row_axes(self) -> Tuple[str, ...]:
+        """The axes a row-sharded table partitions over — the flat axis
+        of a 1-D mesh, or both axes of the hierarchical (dcn, ici) one
+        (rows stripe the whole pod; collectives still address each axis
+        independently)."""
+        with self._lock:
+            return self._axes
+
+    def shape_str(self) -> Optional[str]:
+        """Human/event-log mesh shape ('8' or '2x4'); None when off."""
+        with self._lock:
+            if not self._enabled or self._mesh is None:
+                return None
+            return "x".join(str(d) for d in self._dims)
+
+    def generation(self) -> int:
+        """Coherency counter: bumps on every effective reconfiguration.
+        Folded into the executable cache's generation token, so a tree
+        checked out under one mesh can neither serve nor re-park under
+        another."""
+        with self._lock:
+            return self._generation
+
+    def identity_token(self) -> str:
+        """Stable token of the CURRENT mesh identity (enabled, dims,
+        axes, device ids) — folded into the plan fingerprint so cached
+        plans never cross mesh configs."""
+        with self._lock:
+            if not self._enabled or self._mesh is None:
+                return "mesh:off"
+            ids = ",".join(str(d.id) for d in self._mesh.devices.flat)
+            return (f"mesh:{'x'.join(map(str, self._dims))}/"
+                    f"{'+'.join(self._axes)}/{ids}")
+
+    # -- sharding ------------------------------------------------------------
+    def row_sharding(self):
+        """NamedSharding partitioning the row axis across the mesh —
+        THE plan-carried table sharding descriptor."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        with self._lock:
+            if self._mesh is None:
+                return None
+            spec = P(self._axes if len(self._axes) > 1 else self._axes[0])
+            return NamedSharding(self._mesh, spec)
+
+    def scan_placement(self):
+        """(row sharding, generation) read under ONE lock hold — the
+        scan device-cache pairs the sharding it lands under with the
+        token it caches under, and two separate locked reads could pair
+        an old mesh's sharding with a post-reconfiguration token,
+        serving that stale placement on every later cache hit.
+        ``(None, None)`` when mesh-native execution is off."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        with self._lock:
+            if not self._enabled or self._mesh is None:
+                return None, None
+            spec = P(self._axes if len(self._axes) > 1 else self._axes[0])
+            return NamedSharding(self._mesh, spec), self._generation
+
+    def replicated_sharding(self):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        with self._lock:
+            if self._mesh is None:
+                return None
+            return NamedSharding(self._mesh, P())
+
+    def exchange_mesh(self, nparts: int):
+        """(mesh, axis-or-axes) for an nparts-way all-to-all. The full
+        runtime mesh when nparts covers it (a 2-D mesh exchanges over
+        BOTH axes — partition id = flat device index, the all-to-all
+        rides ici within each dcn group); a leading 1-D submesh when the
+        exchange is narrower than the pod."""
+        import jax
+        from jax.sharding import Mesh
+        with self._lock:
+            mesh = self._mesh
+            dims, axes = self._dims, self._axes
+        if mesh is not None:
+            total = 1
+            for d in dims:
+                total *= d
+            if nparts == total:
+                return mesh, (axes if len(axes) > 1 else axes[0])
+            if nparts < total:
+                flat = list(mesh.devices.flat)[:nparts]
+                return Mesh(np.array(flat), ("data",)), "data"
+        return Mesh(np.array(jax.devices()[:nparts]), ("data",)), "data"
+
+#: THE process-wide mesh runtime (device topology is process state, like
+#: the device manager that owns it)
+MESH = MeshRuntime()
+
+
+def count_mesh_upload(n: int = 1) -> None:
+    """Record ``n`` host->device transfers on the mesh dispatch path —
+    the warm-path contract is that this stays 0 between exchanges."""
+    if n > 0:
+        MESH_SCOPE.add("meshHostUploads", n)
+
+
+def shard_put(arr, sharding):
+    """Land one array onto the mesh under ``sharding`` — per-shard
+    device transfers for host arrays (no single-device concat), a
+    device-side reshard for arrays already resident. Host uploads are
+    counted (the warm path must not pay any)."""
+    import jax
+    if not isinstance(arr, jax.Array):
+        count_mesh_upload(1)
+    return jax.device_put(arr, sharding)
+
+
+def ensure_host_devices(n_devices: int) -> int:
+    """Force an ``n_devices``-wide virtual host-platform backend BEFORE
+    the JAX backend initializes — the shared bootstrap of the multichip
+    dryrun (``__graft_entry__.dryrun_multichip``) and the mesh harness
+    (``scale_test --mesh``): bumps ``--xla_force_host_platform_device_count``
+    in ``XLA_FLAGS`` (never shrinking an existing setting) and pins the
+    cpu platform so one process models an N-chip pod. Real pods bring
+    their own devices: ``SPARK_RAPIDS_TPU_DRYRUN_REAL=1`` skips the
+    forcing entirely. Returns the live device count; callers decide how
+    to fail when it is short (the flag cannot take effect if the
+    backend initialized before this ran). Importing this module is
+    deliberately backend-init-safe, so callers may import first and
+    bootstrap after."""
+    import os
+    import re
+    if os.environ.get("SPARK_RAPIDS_TPU_DRYRUN_REAL", "") != "1":
+        want = max(n_devices, 8)
+        flags = os.environ.get("XLA_FLAGS", "")
+        m = re.search(r"--xla_force_host_platform_device_count=(\d+)",
+                      flags)
+        if m is None:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={want}")
+        elif int(m.group(1)) < want:
+            os.environ["XLA_FLAGS"] = flags.replace(
+                m.group(0), f"--xla_force_host_platform_device_count={want}")
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+        # site packages may pin JAX_PLATFORMS at interpreter start; the
+        # config update overrides it even when jax is already imported
+        jax.config.update("jax_platforms", "cpu")
+    else:
+        import jax
+    return len(jax.devices())
+
+
+def mesh_gather(value):
+    """THE sanctioned mesh->host materialization point (RL-MESH-HOST):
+    fetches a device value to host and counts the gathered elements.
+    Every ICI exchange routes its per-partition live-count fetch
+    through here; any future mesh-code host gather must too (the lint
+    rule flags direct fetches)."""
+    from spark_rapids_tpu.dispatch import host_fetch
+    arr = np.asarray(host_fetch(value))
+    MESH_SCOPE.add("meshGatherRows", int(arr.shape[0]) if arr.ndim else 1)
+    return arr

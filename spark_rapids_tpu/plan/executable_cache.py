@@ -84,14 +84,18 @@ register_metric("executableCacheEvictions", "count", "MODERATE",
 def _demotions_token() -> tuple:
     """The coherency component of an entry's generation beyond the
     warehouse epoch: circuit-breaker demotions reshape the converted
-    tree, and the health monitor's recovery generation bumps per
-    backend reinit (a tree converted against the pre-loss device must
-    never re-park into a post-recovery pool, even though the recovery
-    itself also cleared the cache)."""
+    tree, the health monitor's recovery generation bumps per backend
+    reinit (a tree converted against the pre-loss device must never
+    re-park into a post-recovery pool, even though the recovery itself
+    also cleared the cache), and the MESH generation bumps per mesh
+    reconfiguration — a tree whose scans landed shards under one mesh
+    can neither serve nor re-park under another (its cached device
+    tables and sharded layouts reference the old placement)."""
+    from spark_rapids_tpu.parallel.mesh import MESH
     from spark_rapids_tpu.runtime.faults import CIRCUIT_BREAKER
     from spark_rapids_tpu.runtime.health import HEALTH
     return (tuple(sorted(CIRCUIT_BREAKER.demoted_ops().items())),
-            HEALTH.generation())
+            HEALTH.generation(), MESH.generation())
 
 
 def _reset_for_reuse(executable) -> None:

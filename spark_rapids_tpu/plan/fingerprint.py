@@ -271,6 +271,12 @@ def fingerprint(plan, conf, *, strip_literals: bool = False,
     h = hashlib.sha1()
     h.update(plan_tok.encode())
     h.update(repr(conf_items).encode())
+    # mesh identity (parallel/mesh.py): shape/axes/device ids of the
+    # ACTIVE mesh fold in beyond the spark.rapids.mesh.* conf keys
+    # above — a backend whose device set changed (reinit after device
+    # loss) must not serve plans cached against the old placement
+    from spark_rapids_tpu.parallel.mesh import MESH
+    h.update(MESH.identity_token().encode())
     return h.hexdigest()
 
 

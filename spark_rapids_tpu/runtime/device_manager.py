@@ -129,6 +129,14 @@ class TpuDeviceManager:
     def current(cls) -> Optional["TpuDeviceManager"]:
         return cls._instance
 
+    @property
+    def mesh_runtime(self):
+        """The process-wide mesh runtime (parallel/mesh.py) — device
+        topology is process state like the manager itself; the
+        placement layer configures it from the session conf per query."""
+        from spark_rapids_tpu.parallel.mesh import MESH
+        return MESH
+
     def bytes_in_use(self) -> int:
         try:
             stats = self.info.device.memory_stats()
@@ -144,7 +152,9 @@ class TpuDeviceManager:
         """Discovery summary (logged at session init; the reference logs
         the chosen GPU + memory configuration the same way)."""
         i = self.info
+        from spark_rapids_tpu.parallel.mesh import MESH
         return {
+            "mesh_shape": MESH.shape_str(),
             "platform": i.platform,
             "device_ordinal": i.device_ordinal,
             "local_devices": i.local_device_count,

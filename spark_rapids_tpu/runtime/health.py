@@ -166,11 +166,15 @@ class DeviceHealthMonitor:
         from spark_rapids_tpu.columnar.table import evict_device_caches
         from spark_rapids_tpu.dispatch import clear_device_constants
         from spark_rapids_tpu.ops.expr import clear_kernel_caches
+        from spark_rapids_tpu.parallel.exchange import clear_mesh_caches
         from spark_rapids_tpu.plan.executable_cache import EXEC_CACHE
         EXEC_CACHE.invalidate_all()
         clear_kernel_caches()
         clear_device_constants()
         evict_device_caches()
+        # mesh-exchange caches key on device IDS, which survive a reinit
+        # unchanged — they'd serve the dead backend's buffers without this
+        clear_mesh_caches()
         try:
             import jax
             jax.clear_caches()

@@ -159,9 +159,11 @@ class DeviceMemoryEventHandler:
                          ) -> bool:
         from spark_rapids_tpu.columnar.table import evict_device_caches
         from spark_rapids_tpu.dispatch import clear_device_constants
+        from spark_rapids_tpu.parallel.exchange import clear_mesh_caches
         catalog = catalog or self._default_catalog or BufferCatalog.get()
         evict_device_caches()
         clear_device_constants()  # interned aux/remap arrays re-upload lazily
+        clear_mesh_caches()  # pinned replicated dict matrices re-intern lazily
         freed = catalog.synchronous_spill(1 << 62)
         with self._lock:
             self.alloc_failure_count += 1

@@ -71,6 +71,14 @@ from spark_rapids_tpu.service.result_cache import (
 )
 from spark_rapids_tpu.service.watchdog import WorkerWatchdog, _Worker
 
+
+def _mesh_shape():
+    """The active mesh topology for serve-time event records (None when
+    mesh-native execution is off)."""
+    from spark_rapids_tpu.parallel.mesh import MESH
+    return MESH.shape_str()
+
+
 SERVICE_POOLS = str_conf(
     "spark.rapids.service.pools", "default",
     "Named scheduling pools: semicolon-separated 'name[:weight=W]' "
@@ -854,6 +862,11 @@ class QueryService:
             "quarantined": self._handle_has_strikes(handle),
             "deviceReinits": 0,
             "workerRestarts": 0,
+            # v6 mesh fields at SERVE time: nothing crossed ICI for a
+            # cached serve; meshShape reflects the mesh now active
+            "meshShape": _mesh_shape(),
+            "iciBytes": 0,
+            "shardSkew": 0.0,
         })
         handle.event_record = rec
         try:

@@ -73,20 +73,6 @@ def _tl_only(tls_field: str, doc: str):
     return property(_get, _set, doc=doc)
 
 
-def _uses_device(executable) -> bool:
-    """Does a converted plan contain any device exec? (Transitions wrap
-    TpuExec trees in DeviceToHost; CPU nodes may hold them via InputAdapter.)"""
-    from spark_rapids_tpu.execs.base import DeviceToHost, InputAdapter, TpuExec
-    if isinstance(executable, (DeviceToHost, TpuExec)):
-        return True
-    if isinstance(executable, InputAdapter):
-        return _uses_device(executable.source)
-    for c in getattr(executable, "children", ()):
-        if _uses_device(c):
-            return True
-    return False
-
-
 class TpuSession:
     # -- per-thread query state (concurrent executes; see _TLQueryState) --
     next_query_tag = _tl_only(
@@ -137,6 +123,7 @@ class TpuSession:
         self._obs_lock = threading.Lock()
         self._obs_query_seq = 0
         self._event_writer = None
+        self._placement = None
 
     @property
     def _q(self) -> _TLQueryState:
@@ -168,6 +155,18 @@ class TpuSession:
     def table(self, name: str) -> DataFrame:
         """DataFrame over a temp view or registered table."""
         return self.catalog.table(name)
+
+    @property
+    def placement(self):
+        """The placement half of the session split
+        (runtime/placement.py): mesh realization, device-residency
+        gating, the speculative drain and async-fetch resolution. This
+        class keeps the DRIVER half — SQL/catalog, planning,
+        overrides/AQE, verification, caches, observability."""
+        if self._placement is None:
+            from spark_rapids_tpu.runtime.placement import PlacementLayer
+            self._placement = PlacementLayer(self)
+        return self._placement
 
     @property
     def profiler(self):
@@ -375,9 +374,11 @@ class TpuSession:
         # transaction counters are process-wide, so the delta
         # attributes files/bytes/retries to the query whose wall they
         # happened under (all 0 for read-only queries)
-        def _wdelta(key: str) -> int:
-            return int(after_scopes.get("write", {}).get(key, 0)
-                       - before_scopes.get("write", {}).get(key, 0))
+        def _wdelta(key: str, scope: str = "write") -> int:
+            return int(after_scopes.get(scope, {}).get(key, 0)
+                       - before_scopes.get(scope, {}).get(key, 0))
+
+        from spark_rapids_tpu.parallel.mesh import MESH
 
         record = E.build_query_record(
             query_index=qidx,
@@ -409,6 +410,8 @@ class TpuSession:
             files_written=_wdelta("filesWritten"),
             bytes_written=_wdelta("bytesWritten"),
             commit_retries=_wdelta("commitRetries"),
+            mesh_shape=MESH.shape_str(),
+            ici_bytes=_wdelta("iciBytes", "mesh"),
         )
         self.last_event_record = record
         # the record has read the tree's metrics — the cached executable
@@ -589,12 +592,18 @@ class TpuSession:
             TEST_INJECT_RETRY_OOM,
         )
         from spark_rapids_tpu.obs.spans import TRACER
-        from spark_rapids_tpu.runtime import RMM_TPU, TpuSemaphore
+        from spark_rapids_tpu.runtime import RMM_TPU
         from spark_rapids_tpu.runtime.retry import MAX_RETRIES_VAR
 
         from spark_rapids_tpu.overrides.input_file import \
             rewrite_input_file_exprs
         plan = rewrite_input_file_exprs(plan)
+
+        # placement first: the mesh runtime must reflect THIS query's
+        # spark.rapids.mesh.* conf before the fingerprint folds the
+        # mesh identity token and the executable cache stamps its
+        # generation (a reconfiguration invalidates cached trees)
+        self.placement.prepare()
 
         # plan -> executable cache (plan/executable_cache.py): a
         # repeated template checks out its already-converted (and
@@ -715,11 +724,6 @@ class TpuSession:
             executable._async_fetch = bool(
                 self.conf.get_entry(ASYNC_RESULT_FETCH))
 
-        # the semaphore gates DEVICE residency: fully-fallen-back plans
-        # must not consume a device-concurrency slot
-        sem = None
-        if _uses_device(executable):
-            sem = TpuSemaphore.initialize(self.conf.concurrent_tpu_tasks)
         token = MAX_RETRIES_VAR.set(self.conf.get_entry(RETRY_OOM_MAX_RETRIES))
         from spark_rapids_tpu.dispatch import (
             dispatch_count,
@@ -736,7 +740,9 @@ class TpuSession:
             if TRACER.enabled else None
         try:
             with self.profiler.profile_query():
-                batches = self._run_speculative(executable, sem)
+                # placement owns the drain: device-residency gating
+                # (semaphore), speculation, async-fetch resolution
+                batches = self.placement.drain(executable)
             # per-query device dispatch count (VERDICT r3: observable)
             self.last_dispatches = dispatch_count()
             if hasattr(executable, "metrics"):
@@ -776,115 +782,6 @@ class TpuSession:
         if tok is not None and not tok.hit:
             tok.fill(executable, meta)
         return out
-
-    def _run_speculative(self, executable, sem=None):
-        """Drain the plan under a speculation context (speculative operator
-        sizing, validated by the collect's packed fetch). A failed
-        speculation blocklists the failing sites process-wide and replays
-        once — the replay takes the exact sync-per-operator path there, so
-        a repeated query shape never replays twice
-        (runtime/speculation.py).
-
-        The device semaphore is held around each DRAIN only: with async
-        result fetch the root transition yields enqueued
-        PendingHostTable batches, and their d2h round trips complete
-        AFTER the semaphore releases — the device slot frees as soon as
-        the last kernel is in flight. Resolution stays INSIDE the
-        speculation attempt so a flag failure riding the packed buffer
-        still replays."""
-        from spark_rapids_tpu.conf import (
-            JOIN_DIRECT_TABLE_MULT,
-            MASKED_BATCHES,
-            SPECULATIVE_SIZING,
-        )
-        from spark_rapids_tpu.execs.base import MASKED_ENABLED
-        from spark_rapids_tpu.execs.join import DIRECT_TABLE_MULT
-        from spark_rapids_tpu.runtime import acquired, speculation as spec
-
-        self._apply_tuning_confs()
-        from spark_rapids_tpu.conf import ANSI_ENABLED
-        from spark_rapids_tpu.dispatch import ANSI_MODE
-        tok_m = MASKED_ENABLED.set(bool(self.conf.get_entry(MASKED_BATCHES)))
-        tok_d = DIRECT_TABLE_MULT.set(
-            self.conf.get_entry(JOIN_DIRECT_TABLE_MULT))
-        tok_a = ANSI_MODE.set(bool(self.conf.get_entry(ANSI_ENABLED)))
-
-        def drain():
-            with acquired(sem):
-                batches = list(executable.execute_cpu())
-            return self._resolve_pending_batches(executable, batches)
-
-        try:
-            if not self.conf.get_entry(SPECULATIVE_SIZING):
-                return drain()
-            # each failed attempt blocklists its sites, so every replay
-            # makes strict progress (a site never fails twice); the cap
-            # guards a pathological plan by dropping to the exact path
-            for _attempt in range(8):
-                tok = spec.activate()
-                try:
-                    batches = drain()
-                    spec.current().validate_remaining()
-                    if _attempt and hasattr(executable, "metrics"):
-                        # replays re-execute operators, double-counting
-                        # their metrics; record how many times so the
-                        # numbers can be interpreted (ADVICE r3)
-                        executable.metrics["speculationReplays"] = _attempt
-                    return batches
-                except spec.SpeculationFailed as sf:
-                    spec.blocklist(sf.sites)
-                finally:
-                    spec.deactivate(tok)
-            return drain()
-        finally:
-            MASKED_ENABLED.reset(tok_m)
-            DIRECT_TABLE_MULT.reset(tok_d)
-            ANSI_MODE.reset(tok_a)
-
-    def _resolve_pending_batches(self, executable, batches):
-        """Complete enqueued async downloads — the device semaphore is
-        already released; only the tunnel round trip remains. Records
-        resultFetchTime plus the root transition's deferred output-row
-        count (plain HostTable batches pass through untouched)."""
-        from spark_rapids_tpu.columnar.table import PendingHostTable
-        if not any(isinstance(b, PendingHostTable) for b in batches):
-            return batches
-        import time as _time
-        t0 = _time.perf_counter()
-        out = []
-        rows = 0
-        for b in batches:
-            if isinstance(b, PendingHostTable):
-                b = b.resolve()
-                rows += b.num_rows
-            out.append(b)
-        if hasattr(executable, "add_metric"):
-            executable.add_metric("resultFetchTime",
-                                  _time.perf_counter() - t0)
-            executable.add_metric("numOutputRows", rows)
-        return out
-
-    def _apply_tuning_confs(self) -> None:
-        """Push registry-tunable constants into the modules that consume
-        them (RapidsConf -> class attrs; execs/expressions hold no conf
-        handle — same pattern as the retry/masked contextvars)."""
-        from spark_rapids_tpu import conf as C
-        from spark_rapids_tpu.columnar.table import DeviceTable
-        from spark_rapids_tpu.execs import broadcast as B
-        from spark_rapids_tpu.ops.collections import Sequence
-        get = self.conf.get_entry
-        from spark_rapids_tpu.columnar import column as CCol
-        CCol.set_bucket_policy(str(get(C.SHAPE_BUCKETS)),
-                               int(get(C.SHAPE_BUCKETS_MIN)))
-        Sequence.SEQ_ELEMENT_MULT = int(get(C.SEQUENCE_ELEMENT_MULT))
-        DeviceTable.EMBED_NROWS_CAP = int(get(C.COLLECT_EMBED_ROWS_CAP))
-        DeviceTable.EMBED_MAX_BYTES = int(get(C.COLLECT_EMBED_MAX_BYTES))
-        B.PAIR_BUDGET = int(get(C.NLJ_PAIR_BUDGET))
-        from spark_rapids_tpu.ops import segsum as SS
-        SS.BLOCK = int(get(C.SEGSUM_BLOCK_ROWS))
-        SS.MAX_PARTIALS = int(get(C.SEGSUM_MAX_PARTIALS))
-        SS.MATMUL_MAX_SEGMENTS = int(get(C.SEGSUM_MATMUL_MAX_SEGMENTS))
-        SS.SPLIT_MAX_ABS = float(get(C.SPLIT_SUM_MAX_ABS))
 
     def execute_cpu_only(self, plan: P.PlanNode) -> HostTable:
         """Run fully on the CPU path (the oracle)."""

@@ -130,6 +130,11 @@ def compare_query(a_runs: List[dict], b_runs: List[dict]) -> dict:
                                for r in a_runs),
         "bWorkerRestarts": sum(int(r.get("workerRestarts", 0))
                                for r in b_runs),
+        # mesh-native execution (schema v6): ICI payload per side — a
+        # wall delta between an on-mesh and an off-mesh run shows up
+        # here before anyone blames the plan
+        "aIciBytes": sum(int(r.get("iciBytes", 0)) for r in a_runs),
+        "bIciBytes": sum(int(r.get("iciBytes", 0)) for r in b_runs),
         "ops": op_diffs,
         "newFallbacks": sorted(set(fb_b) - set(fb_a)),
         "resolvedFallbacks": sorted(set(fb_a) - set(fb_b)),
@@ -156,6 +161,8 @@ def build_compare(path_a: str, path_b: str) -> dict:
         "bDeviceReinits": sum(q["bDeviceReinits"] for q in queries),
         "aWorkerRestarts": sum(q["aWorkerRestarts"] for q in queries),
         "bWorkerRestarts": sum(q["bWorkerRestarts"] for q in queries),
+        "aIciBytes": sum(q["aIciBytes"] for q in queries),
+        "bIciBytes": sum(q["bIciBytes"] for q in queries),
         "onlyInA": sorted(set(idx_a) - set(idx_b)),
         "onlyInB": sorted(set(idx_b) - set(idx_a)),
         "totalAWallS": total_a,
@@ -179,6 +186,9 @@ def render_compare(cmp: dict, top_n: int = 5) -> str:
     lines.append(f"Compile: {cmp['totalACompileMs']:.1f}ms -> "
                  f"{cmp['totalBCompileMs']:.1f}ms "
                  f"({cmp['deltaCompileMs']:+.1f}ms)")
+    if cmp["aIciBytes"] or cmp["bIciBytes"]:
+        lines.append(f"Mesh: ICI bytes {cmp['aIciBytes']} -> "
+                     f"{cmp['bIciBytes']}")
     if (cmp["aDeviceReinits"] or cmp["bDeviceReinits"]
             or cmp["aWorkerRestarts"] or cmp["bWorkerRestarts"]):
         lines.append(

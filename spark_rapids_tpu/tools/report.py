@@ -159,6 +159,9 @@ def analyze_query(rec: dict, top_n: int = 10) -> dict:
         "quarantined": bool(rec.get("quarantined", False)),
         "deviceReinits": int(rec.get("deviceReinits", 0)),
         "workerRestarts": int(rec.get("workerRestarts", 0)),
+        "meshShape": rec.get("meshShape"),
+        "iciBytes": int(rec.get("iciBytes", 0)),
+        "shardSkew": float(rec.get("shardSkew", 0.0)),
         "attribution": {
             "attributedS": round(attributed, 6),
             "untrackedS": round(float(spans.get("untrackedS", 0.0)), 6),
@@ -224,6 +227,21 @@ def build_profile(records: Iterable[dict], top_n: int = 10,
             1 for q in queries if q["executableCacheHit"]),
         "padWasteRows": sum(q["padWasteRows"] for q in queries),
     }
+    # mesh-native execution (schema v6): which queries ran on the mesh,
+    # how much payload rode ICI collectives, the worst per-shard skew
+    # the collectives measured, and how many requested exchanges
+    # demoted to the host shuffle (from the per-record mesh scope)
+    mesh_summary = {
+        "meshShapes": sorted({q["meshShape"] for q in queries
+                              if q["meshShape"]}),
+        "meshQueries": sum(1 for q in queries if q["meshShape"]),
+        "iciBytes": sum(q["iciBytes"] for q in queries),
+        "maxShardSkew": round(max((q["shardSkew"] for q in queries),
+                                  default=0.0), 4),
+        "hostShuffleFallbacks": sum(
+            int((q["scopes"].get("mesh") or {})
+                .get("hostShuffleFallbacks", 0)) for q in queries),
+    }
     # survivability (schema v4): how healthy was the process this run,
     # and which queries rode through recovery events
     survivability = {
@@ -241,6 +259,7 @@ def build_profile(records: Iterable[dict], top_n: int = 10,
         "cacheHitRecords": cache_hits,
         "totalWallS": total_wall,
         "compile": compile_summary,
+        "mesh": mesh_summary,
         "survivability": survivability,
         "minCoverage": round(min((q["attribution"]["coverage"]
                                   for q in queries), default=1.0), 4),
@@ -286,6 +305,14 @@ def render_profile(report: dict) -> str:
         f"{len(c['coldQueries'])} cold queries | executable-cache hits "
         f"{c['executableCacheHits']}/{report['queryCount']} | pad waste "
         f"{c['padWasteRows']} rows")
+    me = report["mesh"]
+    if me["meshQueries"]:
+        lines.append(
+            f"Mesh: {me['meshQueries']}/{report['queryCount']} queries "
+            f"on {','.join(me['meshShapes'])} | ICI "
+            f"{me['iciBytes']} bytes | max shard skew "
+            f"{me['maxShardSkew']:.2f} | host-shuffle fallbacks "
+            f"{me['hostShuffleFallbacks']}")
     sv = report["survivability"]
     if (sv["deviceReinits"] or sv["workerRestarts"]
             or sv["quarantinedQueries"]

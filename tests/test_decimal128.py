@@ -260,10 +260,12 @@ def test_null_safe_equality(session, cpu_session):
     assert_runs_on_tpu(q, session)
 
 
-def test_ici_mode_with_p38_payload_uses_host_shuffle(cpu_session):
-    """ICI shuffle mode + dec128 payload: the collective kernels are
-    1-D-only, so the host shuffle (with its two-limb serializer branch)
-    must serve the exchange (review fix)."""
+def test_ici_mode_with_p38_payload_rides_the_collective(cpu_session):
+    """ICI shuffle mode + dec128 payload: the mesh-native exchange
+    scatters trailing dims along for the ride, so the two-limb layout
+    now RIDES the collective instead of demoting to the host shuffle
+    (the pre-mesh 1-D-only limitation is gone — results must still be
+    exact)."""
     from spark_rapids_tpu.session import TpuSession
     vals = _vals(200, seed=13)
     rng = np.random.default_rng(14)
@@ -277,7 +279,7 @@ def test_ici_mode_with_p38_payload_uses_host_shuffle(cpu_session):
     got = sorted(q(ici).collect(), key=repr)
     want = sorted(q(cpu_session).collect(), key=repr)
     assert got == want
-    assert "iciPartitions" not in ici.last_metrics()
+    assert "iciPartitions=4" in ici.last_metrics()
 
 
 def test_parquet_scan_p38(session, cpu_session, tmp_path):

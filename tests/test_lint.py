@@ -617,6 +617,61 @@ def test_rl_write_commit():
                    "spark_rapids_tpu/delta/foo.py", src) == []
 
 
+def test_rl_mesh_host():
+    """RL-MESH-HOST: host materialization inside parallel/ (or the
+    placement layer) outside a sanctioned gather point — the static
+    guard for 'zero host round-trips between exchanges'."""
+    from spark_rapids_tpu.lint.repo_lint import _check_mesh_host
+    src = (
+        "import jax\n"
+        "import numpy as np\n"
+        "from spark_rapids_tpu.dispatch import host_fetch\n"
+        "def bad(x):\n"
+        "    a = np.asarray(x)\n"            # host materialization
+        "    b = jax.device_get(x)\n"        # raw device fetch
+        "    c = host_fetch(x)\n"            # unsanctioned fetch helper
+        "    d = x.block_until_ready()\n"    # device sync
+        "    return list(x.addressable_shards)\n"  # per-shard host read
+        "def mesh_gather(x):\n"              # allowlisted gather point
+        "    return host_fetch(x)\n"
+    )
+    diags = _run_rl(_check_mesh_host, "spark_rapids_tpu/parallel/foo.py",
+                    src)
+    hits = _find(diags, "RL-MESH-HOST")
+    # 5 in bad() plus foo.py's OWN mesh_gather (the allowlist keys on
+    # rel:function, so only mesh.py's gather is sanctioned)
+    assert len(hits) == 6, [str(d) for d in hits]
+    msgs = " ".join(d.message for d in hits)
+    assert "np.asarray" in msgs and "addressable_shards" in msgs
+    # the allowlist hook keys on rel:function — mesh.py's mesh_gather
+    # is sanctioned, foo.py's is not... and outside the mesh dirs the
+    # rule does not apply at all
+    allowed = _run_rl(_check_mesh_host,
+                      "spark_rapids_tpu/parallel/mesh.py",
+                      "from spark_rapids_tpu.dispatch import host_fetch\n"
+                      "def mesh_gather(x):\n"
+                      "    return host_fetch(x)\n")
+    assert allowed == []
+    # the allowlist keys on QUALIFIED names: a method merely NAMED
+    # mesh_gather (qualname Foo.mesh_gather) is not the sanctioned
+    # module-level gather point
+    nested = _run_rl(_check_mesh_host,
+                     "spark_rapids_tpu/parallel/mesh.py",
+                     "from spark_rapids_tpu.dispatch import host_fetch\n"
+                     "class Foo:\n"
+                     "    def mesh_gather(self, x):\n"
+                     "        return host_fetch(x)\n")
+    assert len(_find(nested, "RL-MESH-HOST")) == 1
+    assert _run_rl(_check_mesh_host, "spark_rapids_tpu/execs/foo.py",
+                   src) == []
+    # the placement layer is shard-dispatch code: covered
+    placed = _run_rl(_check_mesh_host,
+                     "spark_rapids_tpu/runtime/placement.py",
+                     "import numpy as np\n"
+                     "def f(x):\n    return np.asarray(x)\n")
+    assert len(_find(placed, "RL-MESH-HOST")) == 1
+
+
 def test_rl_fault_point():
     from spark_rapids_tpu.lint.repo_lint import (
         _check_fault_registry,

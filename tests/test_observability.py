@@ -229,17 +229,19 @@ def test_event_log_written_and_valid(tmp_path):
     lines = open(s.last_event_path).read().strip().splitlines()
     assert len(lines) == 1
     rec = json.loads(lines[0])
-    # schema v5: the transactional-write PR added filesWritten /
-    # bytesWritten / commitRetries (write-scope deltas; 0 for
-    # read-only queries) on top of v4's survivability fields
-    # (healthState / quarantined / deviceReinits / workerRestarts —
-    # HEALTHY/false/0/0 on a quiet process) — see obs/events.py
-    assert rec["schema"] == 5
+    # schema v6: the mesh-native execution PR added meshShape /
+    # iciBytes / shardSkew (null/0/0.0 off-mesh) on top of v5's
+    # transactional-write fields (filesWritten / bytesWritten /
+    # commitRetries — write-scope deltas; 0 for read-only queries)
+    # and v4's survivability fields — see obs/events.py
+    assert rec["schema"] == 6
     assert rec["healthState"] == "HEALTHY"
     assert rec["quarantined"] is False
     assert rec["deviceReinits"] == 0 and rec["workerRestarts"] == 0
     assert rec["filesWritten"] == 0 and rec["bytesWritten"] == 0
     assert rec["commitRetries"] == 0
+    assert rec["meshShape"] is None
+    assert rec["iciBytes"] == 0 and rec["shardSkew"] == 0.0
     assert rec["event"] == "queryCompleted"
     assert rec["queryTag"] == "golden"
     assert rec["wallS"] > 0
@@ -280,7 +282,13 @@ def test_event_log_golden_schema(tmp_path):
     files the committer promoted during this query's wall and their
     bytes; commitRetries — Delta optimistic commits rebased after
     losing the version race; per-record deltas of the write scope,
-    all 0 for read-only queries and result-cache serves)."""
+    all 0 for read-only queries and result-cache serves);
+    v6 = mesh-native execution fields (meshShape — the active device
+    mesh topology, null off-mesh; iciBytes — payload bytes through ICI
+    all-to-all collectives, a per-record delta of the mesh scope;
+    shardSkew — max per-shard map-output max/median over the query's
+    collective exchanges, measured from real shard live counts;
+    result-cache serves carry serve-time meshShape and 0/0.0)."""
     s = _run_eventlog_query(tmp_path)
     got = _normalize(s.last_event_record)
     golden_path = os.path.join(os.path.dirname(__file__),
