@@ -4,6 +4,7 @@ query set over generated tables, emitting a JSON timing report.
 
 Usage: python scale_test.py [--sf 0.1] [--queries q1,q5] [--cpu-baseline]
        python scale_test.py --chaos [--seed 7]
+       python scale_test.py --mesh 8 [--chaos] [--seed 7]
 
 ``--chaos`` runs the corpus twice — fault-free, then under a
 randomized-but-SEEDED fault schedule (fetch errors, transport
@@ -15,7 +16,16 @@ WRITE corpus (run_write_chaos): seeded kill-mid-write scenarios
 asserting the exactly-once transactional-write contract — no torn
 file ever reader-visible, rerun-after-kill bit-identical, Delta
 concurrent commits converge through the rebase-and-retry loop, and
-vacuum reports zero orphans afterwards."""
+vacuum reports zero orphans afterwards.
+
+``--mesh N --chaos`` composes both modes (run_mesh_chaos): the corpus
+runs MESH-NATIVE under a seeded mesh-fault schedule firing every
+``mesh.*`` point — shard-put crashes, checksummed-fetch corruption,
+partial device losses walking the degradation ladder down to a mesh
+shrink — asserting bit-identity to fault-free single-chip, bounded
+recovery counters, and the mesh back at full strength at the end
+(MULTICHIP_r07.json). Unsupported flag combinations fail fast
+(validate_flags) instead of silently ignoring a mode."""
 
 from __future__ import annotations
 
@@ -1053,6 +1063,211 @@ def _run_chaos_concurrent(report, failures, wanted, expected_tables,
 
 
 # ---------------------------------------------------------------------------
+# Mesh chaos: the distributed path under a seeded mesh-fault schedule
+# ---------------------------------------------------------------------------
+
+
+def mesh_chaos_fault_spec(seed: int) -> str:
+    """The seeded mesh-fault schedule: every ``mesh.*`` point fires at
+    least once (asserted by run_mesh_chaos), exercising all four
+    recovery mechanisms — query replay (crash), checksum-validated
+    refetch (corrupt at the ICI counts fetch and at the re-land
+    gather), the partial-loss degradation ladder down to a mesh shrink
+    (device_lost x4: retry -> single-device -> shrink -> retry), and
+    plain slowness. COUNT-based entries only, so the seeded schedule is
+    deterministic and the end-of-run restore probe runs fault-free."""
+    return ";".join([
+        f"mesh.shard.put:crash:1:{seed * 10 + 1}",
+        f"mesh.shard.put:slow:2:{seed * 10 + 2}",
+        f"mesh.ici.exchange:corrupt:2:{seed * 10 + 3}",
+        f"mesh.ici.exchange:crash:1:{seed * 10 + 4}",
+        f"mesh.gather:corrupt:2:{seed * 10 + 5}",
+        f"mesh.gather:device_lost:4:{seed * 10 + 6}",
+        f"mesh.dict.upload:slow:1:{seed * 10 + 7}",
+    ])
+
+
+#: whole-run recovery-work ceilings for the mesh chaos closure (a
+#: runaway retry loop must fail the run, not grind through it)
+MESH_CHAOS_BOUNDS = {"query_replays": 30, "shardRetries": 40,
+                     "gatherChecksFailed": 40, "fetch_retries": 100}
+
+
+def run_mesh_chaos(sf: float, seed: int, ndev: int, queries=None,
+                   use_sql: bool = False, shape: str = ""):
+    """``--mesh N --chaos``: q1-q22 MESH-NATIVE under the seeded
+    mesh-fault schedule, asserting every query bit-identical to the
+    fault-free single-chip baseline, every ``mesh.*`` fault point fired
+    at least once, recovery counters within MESH_CHAOS_BOUNDS, and the
+    mesh back at full strength at the end (a degraded end state is
+    tolerated only EXPLAINED — shrink reason + excluded devices in the
+    report). This is the MULTICHIP_r07 acceptance harness: the newest,
+    most distributed layer of the engine under the same chaos contract
+    the host shuffle has carried since PR 3."""
+    _ensure_host_mesh(ndev)
+    from spark_rapids_tpu.datagen import scale_test_specs
+    from spark_rapids_tpu.obs.metrics import scopes_snapshot
+    from spark_rapids_tpu.runtime.faults import (
+        CIRCUIT_BREAKER,
+        FAULTS,
+        RECOVERY,
+    )
+    from spark_rapids_tpu.runtime.health import HEALTH, QUARANTINE
+    from spark_rapids_tpu.parallel.mesh import MESH
+    from spark_rapids_tpu.session import TpuSession
+
+    specs = scale_test_specs(sf)
+    tables = {name: spec.generate_table(sf, seed=seed)
+              for name, spec in specs.items()}
+    build = build_sql_queries if use_sql else build_queries
+
+    spec = mesh_chaos_fault_spec(seed)
+    chip = TpuSession()
+    mesh = TpuSession({
+        "spark.rapids.mesh.enabled": "true",
+        "spark.rapids.mesh.shape": shape or str(ndev),
+        "spark.rapids.sql.runtimeFallback.enabled": "true",
+        "spark.rapids.test.faults": spec,
+    })
+    chip_queries = build(chip, tables)
+    mesh_queries = build(mesh, tables)
+    wanted = queries or list(chip_queries)
+    # the collective-bearing query (q7, the corpus's one explicit
+    # repartition) runs FIRST: the seeded ladder may legitimately
+    # shrink the mesh mid-corpus, and a shrunken mesh demotes the
+    # 8-way exchange to the host shuffle — the ICI fault points must
+    # see traffic before that can happen or the closure assertion
+    # below ("every armed point fired") could never pass
+    wanted = sorted(wanted, key=lambda n: (n != "q7", wanted.index(n)))
+
+    report = {"mode": "mesh-chaos", "n_devices": ndev,
+              "mesh_shape": shape or str(ndev), "scale_factor": sf,
+              "seed": seed, "sql": use_sql,
+              "fault_spec": mesh.conf.to_dict()[
+                  "spark.rapids.test.faults"],
+              "queries": {}}
+    failures = []
+    # ALL fault-free baselines first: interleaving the baseline
+    # session's arm("") with the chaotic arm(spec) would reset the
+    # seeded schedule every query (run_chaos's discipline)
+    expected_tables = {name: chip_queries[name]().collect_table()
+                       for name in wanted}
+
+    def _scopes():
+        snap = scopes_snapshot()
+        return dict(snap.get("mesh", {})), dict(snap.get("health", {}))
+
+    recovery_before = RECOVERY.snapshot()
+    mesh_before_all, health_before_all = _scopes()
+    for name in wanted:
+        before_m, before_h = _scopes()
+        fires_before = FAULTS.counters()
+        t0 = time.perf_counter()
+        got = mesh_queries[name]().collect_table()
+        wall = time.perf_counter() - t0
+        after_m, after_h = _scopes()
+        diff = tables_differ(expected_tables[name], got)
+        recollected = False
+        if diff is not None and (CIRCUIT_BREAKER.demoted_ops()
+                                 or HEALTH.state() != "HEALTHY"):
+            # an active demotion or the CPU-only latch changes float
+            # accumulation order vs the pre-demotion baseline; both are
+            # process-wide, so re-collecting the baseline NOW runs it
+            # through the same demoted/latched plan (run_chaos pattern;
+            # suspended() keeps the seeded schedule from resetting)
+            with FAULTS.suspended():
+                redo = chip_queries[name]().collect_table()
+            diff = tables_differ(redo, got)
+            recollected = True
+        entry = {
+            "chaos_s": round(wall, 4),
+            "identical": diff is None,
+            "mesh": {k: int(after_m.get(k, 0) - before_m.get(k, 0))
+                     for k in ("shardsDispatched", "iciExchanges",
+                               "hostShuffleFallbacks", "shardRetries",
+                               "gatherChecksFailed", "meshRelandRows")
+                     if after_m.get(k, 0) != before_m.get(k, 0)},
+            "ladder": {k: int(after_h.get(k, 0) - before_h.get(k, 0))
+                       for k in ("meshDeviceLost", "meshDegradations",
+                                 "meshShrinks", "deviceReinits")
+                       if after_h.get(k, 0) != before_h.get(k, 0)},
+            "fault_fires": {
+                k: v - fires_before.get(k, 0)
+                for k, v in FAULTS.counters().items()
+                if v - fires_before.get(k, 0)},
+            "mesh_shape_now": MESH.shape_str(),
+        }
+        if recollected:
+            entry["compared_vs_demoted_baseline"] = True
+        if diff is not None:
+            failures.append(f"{name}: {diff}")
+        report["queries"][name] = entry
+        print(json.dumps({"query": name, **entry}))
+
+    # -- closure assertions ---------------------------------------------------
+    fires = FAULTS.counters()
+    armed_points = {e.split(":")[0] for e in spec.split(";")}
+    for point in sorted(armed_points):
+        if not fires.get(point):
+            failures.append(
+                f"armed mesh fault point {point} never fired — the "
+                f"schedule does not cover the distributed path")
+    report["fault_fires_total"] = dict(fires)
+    recovery = {k: v - recovery_before[k]
+                for k, v in RECOVERY.snapshot().items()}
+    mesh_after_all, health_after_all = _scopes()
+    recovery["shardRetries"] = int(
+        mesh_after_all.get("shardRetries", 0)
+        - mesh_before_all.get("shardRetries", 0))
+    recovery["gatherChecksFailed"] = int(
+        mesh_after_all.get("gatherChecksFailed", 0)
+        - mesh_before_all.get("gatherChecksFailed", 0))
+    report["recovery"] = recovery
+    for field, bound in MESH_CHAOS_BOUNDS.items():
+        if recovery.get(field, 0) > bound:
+            failures.append(f"{field}={recovery[field]} exceeds the "
+                            f"mesh chaos bound {bound}")
+    report["ladder"] = HEALTH.mesh_snapshot()
+    report["quarantine"] = QUARANTINE.snapshot()
+
+    # -- end state: full strength, or an explained degraded state ------------
+    end_state = MESH.health_snapshot()
+    report["mesh_end_state"] = end_state
+    if end_state["excludedDeviceIds"]:
+        # the schedule is count-based and spent: restoring and probing
+        # must succeed — a mesh that cannot return to full strength
+        # after the faults stopped would be a real (reported) problem
+        MESH.restore("mesh chaos run complete; probing full strength")
+        probe = wanted[0]
+        with FAULTS.suspended():
+            redo = chip_queries[probe]().collect_table()
+        got = mesh_queries[probe]().collect_table()
+        restored = MESH.health_snapshot()
+        report["restore_probe"] = {
+            "query": probe,
+            "identical": tables_differ(redo, got) is None,
+            "mesh": restored,
+        }
+        if tables_differ(redo, got) is not None:
+            failures.append(f"restore probe {probe} diverged")
+        if restored["excludedDeviceIds"]:
+            failures.append(
+                "mesh did not return to full strength after restore: "
+                f"{restored}")
+    report["demoted_ops"] = CIRCUIT_BREAKER.demoted_ops()
+    report["health_state"] = HEALTH.state()
+    report["ok"] = not failures
+    report["failures"] = failures
+    FAULTS.disarm()
+    if failures:
+        err = AssertionError("mesh chaos run failed:\n"
+                             + "\n".join(failures))
+        err.report = report
+        raise err
+    return report
+
+
+# ---------------------------------------------------------------------------
 # Mesh mode: the corpus executed mesh-native, bit-identical to single-chip
 # ---------------------------------------------------------------------------
 
@@ -1162,6 +1377,43 @@ def run_concurrent(sf: float, seed: int, queries=None, use_sql=False,
                         tenants=tenants, eventlog_dir=eventlog_dir)
 
 
+#: the harness's supported mode combinations — named in every flag-
+#: validation error so a bad invocation is a one-line fix, not an
+#: archaeology session through silently-ignored flags
+SUPPORTED_MODES = (
+    "supported modes: (default timing run) | --cpu-baseline | "
+    "--chaos [--concurrency N [--service-faults]] | --concurrency N | "
+    "--mesh N [--mesh-shape DxI] [--chaos]")
+
+
+def validate_flags(args) -> None:
+    """Fail fast on flag combinations the harness does not implement —
+    a silently-ignored mode flag reads as a passing run of a contract
+    that was never exercised."""
+    def bad(msg):
+        raise SystemExit(f"{msg} ({SUPPORTED_MODES})")
+
+    if args.mesh:
+        if args.mesh < 2:
+            bad(f"--mesh {args.mesh}: a mesh needs at least 2 devices")
+        if args.concurrency:
+            bad("--mesh does not compose with --concurrency: the mesh "
+                "harness asserts per-query bit-identity serially")
+        if args.service_faults:
+            bad("--mesh does not compose with --service-faults: "
+                "service-level faults need --chaos --concurrency N")
+        if args.cpu_baseline:
+            bad("--mesh does not compose with --cpu-baseline: the mesh "
+                "baseline is fault-free single-chip, not the CPU path")
+    if args.service_faults and not (args.chaos and args.concurrency > 1):
+        bad("--service-faults needs --chaos --concurrency > 1 (the "
+            "service fault points live in the worker/watchdog "
+            "machinery)")
+    if args.cpu_baseline and (args.chaos or args.concurrency):
+        bad("--cpu-baseline is a timing-run flag; it does not compose "
+            "with --chaos or --concurrency")
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--sf", type=float, default=None,
@@ -1208,11 +1460,15 @@ def main():
                          "mesh (virtual host-platform devices unless "
                          "SPARK_RAPIDS_TPU_DRYRUN_REAL=1), asserting "
                          "bit-identity vs single-chip plus per-exchange "
-                         "ICI accounting (the MULTICHIP_r06 harness)")
+                         "ICI accounting (the MULTICHIP_r06 harness); "
+                         "with --chaos, the corpus runs under the "
+                         "seeded MESH-fault schedule instead (the "
+                         "MULTICHIP_r07 closure)")
     ap.add_argument("--mesh-shape", type=str, default="",
                     help="with --mesh: explicit spark.rapids.mesh.shape "
                          "('8' or '2x4'; default N on one flat axis)")
     args = ap.parse_args()
+    validate_flags(args)
 
     if args.mesh:
         wanted = [q.strip() for q in args.queries.split(",") if q.strip()]
@@ -1224,10 +1480,20 @@ def main():
                     json.dump(report, f, indent=1)
 
         try:
-            report = run_mesh(sf=args.sf if args.sf is not None else 0.05,
-                              seed=args.seed if args.seed is not None else 0,
-                              ndev=args.mesh, queries=wanted or None,
-                              use_sql=args.sql, shape=args.mesh_shape)
+            if args.chaos:
+                # mesh + chaos COMPOSED: the corpus mesh-native under
+                # the seeded mesh-fault schedule (MULTICHIP_r07)
+                report = run_mesh_chaos(
+                    sf=args.sf if args.sf is not None else 0.02,
+                    seed=args.seed if args.seed is not None else 7,
+                    ndev=args.mesh, queries=wanted or None,
+                    use_sql=args.sql, shape=args.mesh_shape)
+            else:
+                report = run_mesh(
+                    sf=args.sf if args.sf is not None else 0.05,
+                    seed=args.seed if args.seed is not None else 0,
+                    ndev=args.mesh, queries=wanted or None,
+                    use_sql=args.sql, shape=args.mesh_shape)
         except AssertionError as e:
             # divergence: the failure report carries exactly what we
             # need to debug it — write it before exiting non-zero

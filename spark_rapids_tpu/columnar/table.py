@@ -686,6 +686,12 @@ class DeviceTable:
         if sharding is None:
             dev_arrays = tuple(jnp.asarray(a) for a in staged)
         else:
+            # the shard-landing fault site (the second registered
+            # mesh.shard.put call site — parallel/mesh.shard_put covers
+            # the exchange reshards): one evaluation per sharded batch,
+            # before any per-device transfer starts
+            from spark_rapids_tpu.runtime.faults import fault_point
+            fault_point("mesh.shard.put")
             dev_arrays = tuple(jax.device_put(a, sharding) for a in staged)
         fn = _get_assemble(tuple(recipes), cap)
         outs = fn(dev_arrays, jnp.asarray(np.int32(host.num_rows)))

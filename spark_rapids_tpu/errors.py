@@ -120,6 +120,34 @@ class DeviceLostError(RapidsTpuError):
     traces fresh. The query service requeues these automatically."""
 
 
+class MeshDeviceLostError(DeviceLostError):
+    """PARTIAL device loss: one device of the execution mesh died (or
+    its ICI link to it) while the backend as a whole is still alive —
+    classified DISTINCTLY from whole-backend :class:`DeviceLostError`
+    so recovery can walk the mesh degradation ladder
+    (runtime/health.py ``on_mesh_device_loss``: retry → re-land
+    single-device → mesh reconfiguration onto surviving devices →
+    full backend reinit → CPU-only latch) instead of jumping straight
+    to a backend reinitialization. Carries ``device_id`` when the
+    failing device is known (None for injected losses — the ladder
+    then excludes the mesh's last device)."""
+
+    def __init__(self, message: str, device_id=None):
+        super().__init__(message)
+        self.device_id = device_id
+
+
+class MeshGatherError(KernelCrashError):
+    """The row-count + checksum validation at a mesh gather boundary
+    (MeshReland / the ICI exchange's live-count fetch — the TPAK-v2
+    frame-CRC pattern applied to device-to-device relands) kept
+    failing past ``spark.rapids.mesh.maxShardRetries`` local
+    re-gathers. A KernelCrashError subclass on purpose: the still-
+    sharded source (or the still-resident device value) is intact, so
+    the query-replay machinery re-lands from the scan cache rather
+    than surfacing silently wrong results."""
+
+
 class WorkerLostError(RapidsTpuError):
     """The service worker executing this query died (its runner
     machinery raised outside the query) or was abandoned by the

@@ -83,7 +83,14 @@ def ici_demotion_reason(conf: RapidsConf, mode: str, num_partitions: int,
     ``collective_applicable`` first — shapes with no collective form
     are not demotions."""
     import jax
-    from spark_rapids_tpu.parallel.mesh import MESH
+    from spark_rapids_tpu.parallel.mesh import MESH, suppression_reason
+    sup = suppression_reason()
+    if sup is not None:
+        # the degradation ladder suppressed mesh landing for THIS
+        # attempt (partial device loss, retry failed): the collective
+        # demotes with the ladder's reason so hostShuffleFallbacks and
+        # explain() surface WHY the exchange took the host path
+        return sup
     if mode != "hash":
         return (f"{mode} partitioning has no deterministic per-row "
                 f"device target; host shuffle computes it row-by-row")

@@ -23,13 +23,68 @@ per-partition wide ops pays zero re-lands and zero host transfers.
 
 from __future__ import annotations
 
+import jax
+import jax.numpy as jnp
+
 from spark_rapids_tpu.columnar import DeviceTable
+from spark_rapids_tpu.dispatch import tpu_jit
 from spark_rapids_tpu.execs.base import (
     DeviceToHost,
     HostToDevice,
     InputAdapter,
     TpuExec,
 )
+
+
+def _table_digest(table: DeviceTable):
+    """Device-side (row count + checksum) of one table — the TPAK-v2
+    validation pair for the re-land gather: an order-independent uint32
+    word-sum over every column's data and validity words, the live
+    mask, and the row-count scalar. The gather (DeviceTable.unsharded)
+    is pure data movement, so the digest of the landed copy must equal
+    the digest of the sharded source EXACTLY; integer summation makes
+    the GSPMD-partitioned evaluation bitwise equal to the single-device
+    one, so one cached kernel (epoch-guarded in parallel/exchange.py —
+    a device-loss reinit mid-build must not re-seed the cleared cache)
+    serves both sides."""
+    from spark_rapids_tpu.parallel.exchange import digest_kernel
+    from spark_rapids_tpu.parallel.mesh import wordsum_u32
+
+    key = ("reland-digest", table.schema_key()[0], table.capacity,
+           table.live is not None)
+
+    def build():
+        def digest(datas, valids, live, nrows):
+            acc = nrows.astype(jnp.uint32)
+            for d in datas:
+                acc = acc + wordsum_u32(d)
+            for v in valids:
+                acc = acc + wordsum_u32(v)
+            if live is not None:
+                acc = acc + wordsum_u32(live)
+            return acc
+        return tpu_jit(digest)
+
+    fn = digest_kernel(key, build)
+    return fn(tuple(c.data for c in table.columns),
+              tuple(c.validity for c in table.columns),
+              table.live, table.nrows_dev)
+
+
+def _taint_landed(table: DeviceTable) -> DeviceTable:
+    """Damage the LANDED copy the way an in-flight gather corruption
+    would (validity of slot 0 flips: a row silently becomes null/non-
+    null — exactly the class of wrong-results bug the digest exists to
+    catch). Driven by the ``mesh.gather`` corrupt kind through a
+    sentinel byte: the sharded source is untouched, so the bounded
+    re-gather converges."""
+    c0 = table.columns[0]
+    flipped = c0.with_arrays(
+        c0.data, c0.validity.at[0].set(~c0.validity[0]))
+    out = DeviceTable(table.names, (flipped,) + tuple(table.columns[1:]),
+                      table.nrows_dev, table.capacity, live=table.live)
+    out._nrows_host = table._nrows_host
+    return out
 
 
 class TpuMeshRelandExec(TpuExec):
@@ -62,11 +117,54 @@ class TpuMeshRelandExec(TpuExec):
         # count only PHYSICAL gathers: unsharded() also returns a new
         # object when it merely drops a shard_spec descriptor from
         # single-device buffers (1-device mesh) — no data moved there
-        if table.physically_sharded() and table.columns:
-            from spark_rapids_tpu.parallel.mesh import MESH_SCOPE
-            self.add_metric("meshRelandRows", table.capacity)
-            MESH_SCOPE.add("meshRelandRows", table.capacity)
-        return table.unsharded()
+        from spark_rapids_tpu.runtime.faults import fault_point
+        if not (table.physically_sharded() and table.columns):
+            return table.unsharded()
+        from spark_rapids_tpu.parallel import mesh as PM
+        from spark_rapids_tpu.parallel.mesh import MESH_SCOPE, mesh_gather
+        self.add_metric("meshRelandRows", table.capacity)
+        MESH_SCOPE.add("meshRelandRows", table.capacity)
+        # crash / device_lost / slow fire here, BEFORE the gather (the
+        # ladder's mesh.gather injection site); corrupt is consumed by
+        # the sentinel inside the verified loop below
+        fault_point("mesh.gather")
+        if not PM.GATHER_VERIFY:
+            return table.unsharded()
+        # TPAK-v2 gather integrity: (row count + checksum) of the
+        # sharded source vs the landed copy, compared in ONE tiny host
+        # fetch through the sanctioned gather point. A mismatch is a
+        # corrupted shard CAUGHT — re-land from the still-intact
+        # sharded source instead of feeding the wide kernel above this
+        # boundary silently wrong buffers.
+        from spark_rapids_tpu.errors import MeshGatherError
+        # the source digest evaluates GSPMD-partitioned on the shards
+        # (replicated output); re-land the scalar once so the compare
+        # pair below shares one committed device — device-to-device,
+        # like the gather it validates
+        pre = jax.device_put(_table_digest(table), jax.devices()[0])
+        retries = 0
+        while True:
+            out = table.unsharded()
+            if fault_point("mesh.gather", data=b"\x00") != b"\x00":
+                out = _taint_landed(out)  # injected in-flight corruption
+            post = _table_digest(out)
+            # rows=0: a digest-pair compare is validation overhead,
+            # not gathered table data — meshGatherRows must keep
+            # meaning 'elements gathered'
+            pair = mesh_gather(jax.lax.bitcast_convert_type(
+                jnp.stack([pre, post]), jnp.int32), rows=0)
+            if int(pair[0]) == int(pair[1]):
+                return out
+            MESH_SCOPE.add("gatherChecksFailed", 1)
+            self.add_metric("gatherChecksFailed", 1)
+            if retries >= PM.MAX_SHARD_RETRIES:
+                raise MeshGatherError(
+                    f"mesh re-land gather failed its row-count/checksum "
+                    f"validation {retries + 1} times (source digest "
+                    f"{int(pair[0])} vs landed {int(pair[1])})")
+            retries += 1
+            MESH_SCOPE.add("shardRetries", 1)
+            self.add_metric("shardRetries", 1)
 
     def describe(self):
         return "MeshReland"

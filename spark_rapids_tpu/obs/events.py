@@ -75,7 +75,16 @@ EVENT_LOG_DIR = str_conf(
 #: map-output max/median bytes — the AQE skew signal measured from
 #: REAL shard distributions; 0.0 when no collective exchange ran).
 #: Result-cache serves carry the serve-time meshShape and 0/0.0.
-EVENT_SCHEMA_VERSION = 6
+#: v7 (mesh fault-domain PR): + meshDegradations (degradation-ladder
+#: demotions — single-device re-lands and mesh shrinks — during this
+#: query's wall; per-record DELTA of the ``health`` scope),
+#: shardRetries (local re-gathers paid at mesh gather boundaries after
+#: failed row-count/checksum validations) and gatherChecksFailed
+#: (validations that TRIPPED — corrupted shards caught instead of
+#: served) — the latter two per-record DELTAS of the ``mesh`` scope.
+#: All 0 on a healthy mesh (and off-mesh); result-cache serves carry
+#: 0/0/0 (nothing gathered).
+EVENT_SCHEMA_VERSION = 7
 
 
 def plan_tree(executable) -> dict:
@@ -193,7 +202,10 @@ def build_query_record(*, query_index: int, wall_s: float,
                        bytes_written: int = 0,
                        commit_retries: int = 0,
                        mesh_shape: Optional[str] = None,
-                       ici_bytes: int = 0) -> dict:
+                       ici_bytes: int = 0,
+                       mesh_degradations: int = 0,
+                       shard_retries: int = 0,
+                       gather_checks_failed: int = 0) -> dict:
     """Assemble one event-log record. Every field is JSON-native; the
     golden schema test normalizes timings and pins the shape.
     ``service`` is the query-service envelope (tenant, pool, queueWaitS,
@@ -235,6 +247,9 @@ def build_query_record(*, query_index: int, wall_s: float,
         "meshShape": mesh_shape,
         "iciBytes": int(ici_bytes),
         "shardSkew": round(float(shard_skew), 4),
+        "meshDegradations": int(mesh_degradations),
+        "shardRetries": int(shard_retries),
+        "gatherChecksFailed": int(gather_checks_failed),
         "faultReplays": fault_replays,
         "plan": plan_tree(executable),
         "fallbacks": collect_fallbacks(meta),

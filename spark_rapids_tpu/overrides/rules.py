@@ -987,6 +987,29 @@ class PlanMeta:
         # dead device cannot be the answer.
         from spark_rapids_tpu.runtime.health import HEALTH
         cpu_only = HEALTH.cpu_only_reason()
+        if self.parent is None:
+            # mesh fault domain (ROOT note, advisory): a mesh running
+            # below declared strength after partial device losses, or
+            # an attempt the degradation ladder suppressed to single-
+            # device landing, is visible in explain() like every other
+            # demotion — the query still runs on device
+            from spark_rapids_tpu.parallel.mesh import (
+                MESH,
+                MESH_ENABLED,
+                suppression_reason,
+            )
+            if bool(self.conf.get_entry(MESH_ENABLED)):
+                sup = suppression_reason()
+                degraded = MESH.degraded_reason()
+                if sup is not None:
+                    self.notes.append(f"mesh demoted: {sup}")
+                elif degraded is not None:
+                    snap = MESH.health_snapshot()
+                    self.notes.append(
+                        f"mesh degraded: running on the "
+                        f"{snap['shape']}-device surviving mesh "
+                        f"(excluded device ids "
+                        f"{snap['excludedDeviceIds']}): {degraded}")
         demoted = CIRCUIT_BREAKER.demotion_reason(type(self.node).__name__)
         if rule is None:
             self.reasons.append(f"exec {self.node.name} is not supported on TPU")

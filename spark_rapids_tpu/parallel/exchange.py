@@ -62,6 +62,13 @@ from collections import OrderedDict
 _DICT_INTERN: "OrderedDict[int, tuple]" = OrderedDict()
 _DICT_INTERN_LOCK = threading.Lock()
 _DICT_INTERN_CAP = 256
+#: jitted gather-digest kernels (the TPAK-v2 row-count/checksum
+#: validation at mesh gather boundaries — execs/mesh.py and the
+#: verified live-count fetch below). Device-referencing once traced,
+#: so invalidation clears it with the other two mesh caches — and a
+#: publish is epoch-guarded like theirs (a builder that started before
+#: clear_mesh_caches ran must not re-seed the cleared cache)
+_DIGEST_CACHE: Dict[tuple, object] = {}
 #: (id(dict), dev_ids) -> Event while one thread replicates that entry:
 #: concurrent first-exchangers over one dictionary wait for the winner
 #: instead of each paying the upload (and each counting meshDictInterns/
@@ -94,10 +101,33 @@ def clear_mesh_caches() -> int:
         _DICT_INTERN.clear()
         n += len(MeshExchange._cache)
         MeshExchange._cache.clear()
+        n += len(_DIGEST_CACHE)
+        _DIGEST_CACHE.clear()
         # reject in-flight builders' late publishes (their device state
         # predates the invalidation)
         _MESH_CACHE_EPOCH += 1
     return n
+
+
+def digest_kernel(key: tuple, build):
+    """Epoch-guarded intern of one jitted gather-digest kernel: the
+    check-then-build-then-publish window is closed the same way the
+    other two mesh caches close it — a builder that started before
+    clear_mesh_caches ran (a device-loss reinit racing an in-flight
+    gather) serves its kernel to THIS caller only and never re-seeds
+    the cleared cache with programs traced against the dead backend
+    (pinned by a two-thread test)."""
+    with _DICT_INTERN_LOCK:
+        fn = _DIGEST_CACHE.get(key)
+        if fn is not None:
+            return fn
+        epoch = _MESH_CACHE_EPOCH
+    fn = build()
+    with _DICT_INTERN_LOCK:
+        if epoch == _MESH_CACHE_EPOCH:
+            # a concurrent builder may have won; keep one canonical fn
+            fn = _DIGEST_CACHE.setdefault(key, fn)
+    return fn
 
 
 def interned_dict_bytes(dictionary: np.ndarray, mesh) -> tuple:
@@ -130,6 +160,8 @@ def interned_dict_bytes(dictionary: np.ndarray, mesh) -> tuple:
     try:
         with _DICT_INTERN_LOCK:
             epoch = _MESH_CACHE_EPOCH
+        from spark_rapids_tpu.runtime.faults import fault_point
+        fault_point("mesh.dict.upload")
         mat, lens = string_dict_bytes(dictionary)
         rep = NamedSharding(mesh, P_())
         out = (jax.device_put(mat, rep), jax.device_put(lens, rep))
@@ -309,14 +341,62 @@ class MeshExchange:
                 mat, lens = string_bytes[i]
                 flat.append(shard_put(mat, rep))
                 flat.append(shard_put(lens, rep))
+        # the collective's fault site: crash exercises the replay path,
+        # device_lost the partial-loss degradation ladder; corrupt is
+        # consumed by the verified counts fetch below (it needs bytes)
+        from spark_rapids_tpu.runtime.faults import fault_point
+        fault_point("mesh.ici.exchange")
         out = self._fn(*flat)
         ncols = len(datas)
-        # the ONE host materialization an ICI exchange pays: the
-        # per-partition live counts, through the sanctioned gather
-        # point (they double as the AQE map-output statistic)
-        from spark_rapids_tpu.parallel.mesh import mesh_gather
         return (list(out[:ncols]), list(out[ncols:2 * ncols]),
-                mesh_gather(out[2 * ncols]))
+                self._verified_counts(out[2 * ncols]))
+
+    def _verified_counts(self, counts_dev):
+        """The ONE host materialization an ICI exchange pays — the
+        per-partition live counts (they double as the AQE map-output
+        statistic) — fetched CHECKSUMMED (TPAK-v2 pattern): a device-
+        side uint32 word-sum digest rides the same fetch, the host
+        recomputes it over the fetched bytes, and a mismatch (a
+        corrupted wire fetch; the ``mesh.ici.exchange`` corrupt kind
+        injects exactly this) refetches the intact device value —
+        bounded by spark.rapids.mesh.maxShardRetries, counted in
+        gatherChecksFailed/shardRetries — instead of feeding AQE and
+        the batch slicer garbage counts."""
+        import jax
+
+        from spark_rapids_tpu.errors import MeshGatherError
+        from spark_rapids_tpu.parallel import mesh as PM
+        from spark_rapids_tpu.parallel.mesh import mesh_gather, wordsum_u32
+        from spark_rapids_tpu.runtime.faults import fault_point
+
+        if not PM.GATHER_VERIFY:
+            return mesh_gather(counts_dev)
+        counts_i32 = counts_dev.astype(jnp.int32).reshape(-1)
+        digest = jax.lax.bitcast_convert_type(
+            wordsum_u32(counts_i32), jnp.int32).reshape(1)
+        packed = jnp.concatenate([counts_i32, digest])
+        retries = 0
+        while True:
+            # count the COUNTS as gathered elements, not the digest
+            # word riding along (meshGatherRows stays comparable with
+            # pre-verification artifact rounds)
+            arr = mesh_gather(packed,
+                              rows=int(packed.shape[0]) - 1).astype(np.int32)
+            raw = fault_point("mesh.ici.exchange", data=arr.tobytes())
+            arr = np.frombuffer(raw, dtype=np.int32)
+            counts, got = arr[:-1], arr[-1:].view(np.uint32)[0]
+            want = np.uint32(
+                counts.view(np.uint32).sum(dtype=np.uint64) & 0xFFFFFFFF)
+            if got == want:
+                return counts
+            MESH_SCOPE.add("gatherChecksFailed", 1)
+            if retries >= PM.MAX_SHARD_RETRIES:
+                raise MeshGatherError(
+                    f"ICI exchange live-count fetch failed its checksum "
+                    f"{retries + 1} times (device digest {int(got)} vs "
+                    f"recomputed {int(want)})")
+            retries += 1
+            MESH_SCOPE.add("shardRetries", 1)
 
 
 def mesh_hash_exchange(mesh, dtypes: Sequence[T.DataType],

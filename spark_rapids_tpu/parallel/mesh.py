@@ -41,11 +41,13 @@ one session/conf and never hit this.
 from __future__ import annotations
 
 import threading
+from contextlib import contextmanager
+from contextvars import ContextVar
 from typing import Optional, Tuple
 
 import numpy as np
 
-from spark_rapids_tpu.conf import RapidsConf, bool_conf, str_conf
+from spark_rapids_tpu.conf import RapidsConf, bool_conf, int_conf, str_conf
 from spark_rapids_tpu.obs.metrics import metric_scope, register_metric
 
 MESH_ENABLED = bool_conf(
@@ -73,6 +75,37 @@ MESH_AXIS = str_conf(
     "Name of the flat row axis of a 1-D mesh (hierarchical 'DxI' "
     "shapes always use ('dcn', 'ici')). Row-sharded tables carry a "
     "PartitionSpec over this axis.")
+
+MESH_MAX_SHARD_RETRIES = int_conf(
+    "spark.rapids.mesh.maxShardRetries", 2,
+    "Local re-gathers a mesh gather boundary may pay before failing "
+    "typed: when the row-count+checksum validation at a MeshReland "
+    "(or the ICI exchange's verified live-count fetch) trips, the "
+    "boundary re-lands from the still-intact sharded source up to "
+    "this many times (shardRetries counter) and then raises "
+    "MeshGatherError — which the query-replay machinery re-lands "
+    "from the scan cache rather than surfacing wrong results.")
+
+MESH_DEGRADE_MAX_SHRINKS = int_conf(
+    "spark.rapids.mesh.degrade.maxShrinks", 2,
+    "Mesh reconfigurations onto surviving devices the degradation "
+    "ladder (runtime/health.py) may perform after repeated PARTIAL "
+    "device losses (one mesh device dead, backend otherwise alive) "
+    "before escalating to a full backend reinitialization and, "
+    "ultimately, the CPU-only latch. Each shrink excludes the "
+    "suspect device, bumps the mesh generation (fencing every "
+    "cached tree/dictionary) and is surfaced in QueryService."
+    "health(), explain() and the event log.")
+
+MESH_GATHER_VERIFY = bool_conf(
+    "spark.rapids.mesh.gather.verify", True,
+    "Row-count + checksum validation at mesh gather boundaries (the "
+    "TPAK-v2 frame-CRC pattern applied to the MeshReland device-to-"
+    "device gather and the ICI exchange's live-count fetch): a "
+    "corrupted shard raises a retryable error and re-lands from the "
+    "intact sharded source instead of producing silently wrong "
+    "results. Costs two tiny digest kernels plus one small host "
+    "fetch per physical re-land; disable only for benchmarking.")
 
 # -- the `mesh` metric scope -------------------------------------------------
 
@@ -105,8 +138,22 @@ register_metric("meshDictInterns", "count", "MODERATE",
                 "string-dictionary byte matrices replicated across the "
                 "mesh and interned by dictionary identity (repeated "
                 "exchanges over one dictionary pay replication once)")
+register_metric("shardRetries", "count", "ESSENTIAL",
+                "local re-gathers paid at mesh gather boundaries after "
+                "a failed row-count/checksum validation (bounded by "
+                "spark.rapids.mesh.maxShardRetries)")
+register_metric("gatherChecksFailed", "count", "ESSENTIAL",
+                "row-count/checksum validations that tripped at a mesh "
+                "gather boundary (MeshReland or the ICI live-count "
+                "fetch) — each one is a corrupted shard CAUGHT instead "
+                "of served")
 
 MESH_SCOPE = metric_scope("mesh")
+
+#: runtime tunables pushed by PlacementLayer.apply_tuning_confs (execs
+#: and the exchange hold no conf handle — the SS.BLOCK pattern)
+MAX_SHARD_RETRIES = 2
+GATHER_VERIFY = True
 
 
 def _parse_shape(shape: str, avail: int) -> Tuple[int, ...]:
@@ -137,11 +184,50 @@ def _parse_shape(shape: str, avail: int) -> Tuple[int, ...]:
     return dims
 
 
+#: per-ATTEMPT mesh suppression (the "re-land single-device" rung of
+#: the degradation ladder): a session replaying a query after repeated
+#: mesh device losses sets this around the attempt, and every
+#: placement-relevant reader below (enabled / scan_placement /
+#: effective_ndev / identity_token / shape_str) reports the mesh OFF
+#: for THIS THREAD only — the process mesh, and concurrent workers'
+#: queries, are untouched. The demotion reason surfaces through the
+#: existing hostShuffleFallbacks / explain() machinery
+#: (execs/exchange.ici_demotion_reason reads it).
+_SUPPRESS: "ContextVar[Optional[str]]" = ContextVar(
+    "mesh_suppress", default=None)
+
+
+def suppression_reason() -> Optional[str]:
+    """Why THIS thread's in-flight attempt must land single-device
+    (None when mesh execution is not suppressed)."""
+    return _SUPPRESS.get()
+
+
+@contextmanager
+def suppressed_mesh(reason: str):
+    """Scope one execution attempt's single-device demotion (the
+    degradation ladder's middle rung)."""
+    tok = _SUPPRESS.set(reason)
+    try:
+        yield
+    finally:
+        _SUPPRESS.reset(tok)
+
+
 class MeshRuntime:
     """Process-wide mesh state (owned by TpuDeviceManager, configured
     per query by the placement layer). Reconfiguration is coherency-
     relevant: the generation bumps whenever the effective (enabled,
-    dims, axis, devices) tuple changes, and both caches consult it."""
+    dims, axis, devices) tuple changes, and both caches consult it.
+
+    The FAULT-DOMAIN half (this PR): ``_excluded_ids`` holds devices
+    the degradation ladder evicted after partial losses — configure()
+    builds the mesh from the survivors (collapsing to a flat 1-D mesh
+    when the declared shape no longer fits), ``shrink_excluding``/
+    ``restore`` walk the set, and the exclusion folds into the config
+    key so every shrink/restore rebuilds and bumps the generation
+    (fencing stale cached trees and dictionaries exactly like a conf
+    reconfiguration)."""
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -151,6 +237,14 @@ class MeshRuntime:
         self._enabled = False
         self._config_key = None
         self._generation = 0
+        #: devices evicted by the degradation ladder (persist across
+        #: queries until restore(); folded into the config key)
+        self._excluded_ids: frozenset = frozenset()
+        #: why the mesh is running below declared strength (None at
+        #: full strength) — surfaced in health()/explain()/event log
+        self._degraded_reason: Optional[str] = None
+        #: the declared shape the degraded mesh fell back from
+        self._declared_shape: Optional[str] = None
 
     # -- configuration -------------------------------------------------------
     def configure(self, conf: RapidsConf) -> None:
@@ -161,11 +255,15 @@ class MeshRuntime:
         mesh built from the dead backend must be rebuilt on the next
         prepare even though the conf tuple — and the surviving device
         IDS the identity token hashes — are unchanged."""
+        from spark_rapids_tpu.errors import ColumnarProcessingError
         from spark_rapids_tpu.runtime.health import HEALTH
         enabled = bool(conf.get_entry(MESH_ENABLED))
         shape = str(conf.get_entry(MESH_SHAPE))
         axis = str(conf.get_entry(MESH_AXIS)).strip() or "data"
-        key = (enabled, shape.strip().lower(), axis, HEALTH.generation())
+        with self._lock:
+            excluded = self._excluded_ids
+        key = (enabled, shape.strip().lower(), axis, HEALTH.generation(),
+               excluded)
         with self._lock:
             if key == self._config_key:
                 return
@@ -177,8 +275,20 @@ class MeshRuntime:
         if enabled:
             import jax
             from jax.sharding import Mesh
-            devices = list(jax.devices())
-            dims = _parse_shape(shape, len(devices))
+            devices = [d for d in jax.devices()
+                       if d.id not in excluded]
+            try:
+                dims = _parse_shape(shape, len(devices))
+            except ColumnarProcessingError:
+                if not (excluded and devices):
+                    raise
+                # the declared shape no longer fits the SURVIVORS: the
+                # degraded mesh collapses to one flat axis over every
+                # remaining device (hierarchical shapes included — a
+                # partial pod cannot honor the declared (dcn, ici)
+                # factorization, and correctness never depended on it:
+                # wide kernels re-land regardless of mesh width)
+                dims = (len(devices),)
             axes = ("dcn", "ici") if len(dims) == 2 else (axis,)
             total = 1
             for d in dims:
@@ -192,11 +302,74 @@ class MeshRuntime:
             self._axes = axes
             self._enabled = enabled
             self._config_key = key
+            self._declared_shape = shape.strip() or None
             self._generation += 1
+
+    # -- the degradation ladder's mesh half ----------------------------------
+    def shrink_excluding(self, device_id: Optional[int],
+                         reason: str) -> bool:
+        """Evict one device from the mesh fault domain: ``device_id``
+        when the failure named it, else the mesh's LAST device (the
+        deterministic choice for injected losses). The exclusion folds
+        into the config key, so the next configure() rebuilds the mesh
+        from the survivors and bumps the generation — every cached
+        tree, scan image and replicated dictionary is fenced exactly
+        like a conf reconfiguration. Returns False when there is no
+        mesh to shrink or only one device remains (the ladder then
+        escalates to the whole-backend rungs)."""
+        with self._lock:
+            if self._mesh is None or not self._enabled:
+                return False
+            ids = [d.id for d in self._mesh.devices.flat]
+            if len(ids) <= 1:
+                return False
+            victim = device_id if device_id in ids else ids[-1]
+            self._excluded_ids = self._excluded_ids | {victim}
+            self._degraded_reason = reason
+            # force the next configure() to rebuild even under an
+            # unchanged conf tuple
+            self._config_key = None
+            return True
+
+    def restore(self, reason: str = "") -> bool:
+        """Clear every ladder exclusion (the mesh returns to declared
+        strength on the next configure()). Returns whether anything
+        was excluded. The chaos harness probes this at end of run;
+        a device that is genuinely still dead simply re-walks the
+        ladder and gets excluded again."""
+        with self._lock:
+            had = bool(self._excluded_ids)
+            self._excluded_ids = frozenset()
+            self._degraded_reason = None
+            if had:
+                self._config_key = None
+            return had
+
+    def degraded_reason(self) -> Optional[str]:
+        """Why the mesh runs below declared strength (None at full
+        strength) — the explain()/health() surfacing hook."""
+        with self._lock:
+            return self._degraded_reason
+
+    def health_snapshot(self) -> dict:
+        """The mesh fault-domain state QueryService.health() reports."""
+        with self._lock:
+            shape = ("x".join(str(d) for d in self._dims)
+                     if self._enabled and self._mesh is not None else None)
+            return {
+                "enabled": self._enabled and self._mesh is not None,
+                "shape": shape,
+                "declaredShape": self._declared_shape,
+                "excludedDeviceIds": sorted(self._excluded_ids),
+                "degradedReason": self._degraded_reason,
+                "generation": self._generation,
+            }
 
     # -- state ---------------------------------------------------------------
     @property
     def enabled(self) -> bool:
+        if _SUPPRESS.get() is not None:
+            return False  # this attempt lands single-device
         with self._lock:
             return self._enabled and self._mesh is not None
 
@@ -221,6 +394,8 @@ class MeshRuntime:
         reconfiguration can observe enabled=True then ndev=0 (the
         scan_placement atomicity argument, applied to the exchange's
         demotion check)."""
+        if _SUPPRESS.get() is not None:
+            return None
         with self._lock:
             if not self._enabled or self._mesh is None:
                 return None
@@ -239,6 +414,8 @@ class MeshRuntime:
 
     def shape_str(self) -> Optional[str]:
         """Human/event-log mesh shape ('8' or '2x4'); None when off."""
+        if _SUPPRESS.get() is not None:
+            return None
         with self._lock:
             if not self._enabled or self._mesh is None:
                 return None
@@ -255,7 +432,11 @@ class MeshRuntime:
     def identity_token(self) -> str:
         """Stable token of the CURRENT mesh identity (enabled, dims,
         axes, device ids) — folded into the plan fingerprint so cached
-        plans never cross mesh configs."""
+        plans never cross mesh configs. A ladder-suppressed attempt
+        gets its own token: its single-device tree must not collide
+        with mesh-native variants of the same template."""
+        if _SUPPRESS.get() is not None:
+            return "mesh:suppressed"
         with self._lock:
             if not self._enabled or self._mesh is None:
                 return "mesh:off"
@@ -282,6 +463,8 @@ class MeshRuntime:
         serving that stale placement on every later cache hit.
         ``(None, None)`` when mesh-native execution is off."""
         from jax.sharding import NamedSharding, PartitionSpec as P
+        if _SUPPRESS.get() is not None:
+            return None, None
         with self._lock:
             if not self._enabled or self._mesh is None:
                 return None, None
@@ -333,8 +516,13 @@ def shard_put(arr, sharding):
     """Land one array onto the mesh under ``sharding`` — per-shard
     device transfers for host arrays (no single-device concat), a
     device-side reshard for arrays already resident. Host uploads are
-    counted (the warm path must not pay any)."""
+    counted (the warm path must not pay any). THE shard-landing fault
+    point: crash exercises the query-replay path, device_lost the
+    partial-loss degradation ladder (runtime/health.py)."""
     import jax
+
+    from spark_rapids_tpu.runtime.faults import fault_point
+    fault_point("mesh.shard.put")
     if not isinstance(arr, jax.Array):
         count_mesh_upload(1)
     return jax.device_put(arr, sharding)
@@ -376,13 +564,40 @@ def ensure_host_devices(n_devices: int) -> int:
     return len(jax.devices())
 
 
-def mesh_gather(value):
+def mesh_gather(value, rows: Optional[int] = None):
     """THE sanctioned mesh->host materialization point (RL-MESH-HOST):
     fetches a device value to host and counts the gathered elements.
     Every ICI exchange routes its per-partition live-count fetch
     through here; any future mesh-code host gather must too (the lint
-    rule flags direct fetches)."""
+    rule flags direct fetches). ``rows`` overrides the counted element
+    number for fetches that carry validation overhead alongside the
+    payload (a checksummed counts fetch counts its counts, not its
+    digest word; a pure digest-pair compare counts 0) so
+    meshGatherRows keeps meaning 'elements gathered', comparable
+    across artifact rounds."""
     from spark_rapids_tpu.dispatch import host_fetch
     arr = np.asarray(host_fetch(value))
-    MESH_SCOPE.add("meshGatherRows", int(arr.shape[0]) if arr.ndim else 1)
+    if rows is None:
+        rows = int(arr.shape[0]) if arr.ndim else 1
+    if rows:
+        MESH_SCOPE.add("meshGatherRows", rows)
     return arr
+
+
+def wordsum_u32(a):
+    """Order-independent uint32 word-sum digest of one device array —
+    THE checksum both sides of a verified mesh gather compute (the
+    TPAK-v2 frame CRC lifted to device buffers): bitcast every element
+    to 32-bit words and wrap-sum them. Integer addition is associative
+    and commutative, so a GSPMD-partitioned sum over mesh shards
+    equals the single-device sum bit for bit — the digest is layout-
+    independent by construction. Runs eagerly/inside jit; host code
+    recomputes the same value with numpy views."""
+    import jax
+    import jax.numpy as jnp
+    if a.dtype == jnp.bool_:
+        return jnp.sum(a.astype(jnp.uint32), dtype=jnp.uint32)
+    if a.dtype in (jnp.int8, jnp.int16):
+        a = a.astype(jnp.int32)
+    return jnp.sum(jax.lax.bitcast_convert_type(a, jnp.uint32),
+                   dtype=jnp.uint32)

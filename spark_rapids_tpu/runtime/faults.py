@@ -118,6 +118,34 @@ FAULT_POINTS: Dict[str, tuple] = {
         "spark_rapids_tpu/dispatch.py",
         "before each jitted kernel dispatch; wedge stalls INSIDE the "
         "dispatch so only the watchdog's hard wall limit can end it"),
+    # -- the mesh fault domain: every stage of the distributed path is
+    # injectable, and ``device_lost`` at any ``mesh.*`` point raises the
+    # PARTIAL MeshDeviceLostError (one mesh device dead, backend alive)
+    # that walks the degradation ladder instead of the whole-backend
+    # reinit (runtime/health.py on_mesh_device_loss)
+    "mesh.shard.put": (
+        "spark_rapids_tpu/parallel/mesh.py",
+        "per-shard device landing (jax.device_put under the row "
+        "sharding): every mesh-native scan upload and exchange reshard "
+        "passes through here, before the transfer"),
+    "mesh.ici.exchange": (
+        "spark_rapids_tpu/parallel/exchange.py",
+        "the ICI all-to-all: a data-less site before the collective "
+        "dispatch (crash/device_lost/slow) plus the checksummed "
+        "per-partition live-count fetch (corrupt flips the fetched "
+        "bytes; the TPAK-v2 digest riding the same fetch catches the "
+        "damage and the intact device value is refetched)"),
+    "mesh.gather": (
+        "spark_rapids_tpu/execs/mesh.py",
+        "the MeshReland device-to-device gather (DeviceTable."
+        "unsharded): corrupt damages the LANDED copy (sentinel-driven "
+        "device bit-flip) and the row-count+checksum validation trips, "
+        "re-landing from the still-sharded source instead of feeding a "
+        "wide kernel silently wrong shards"),
+    "mesh.dict.upload": (
+        "spark_rapids_tpu/parallel/exchange.py",
+        "replicated string-dictionary upload (interned_dict_bytes), "
+        "before the device_put replication across the mesh"),
 }
 
 _SLOW_SLEEP_S = 0.05
@@ -275,6 +303,14 @@ class FaultRegistry:
                 raise ShuffleTransportError(
                     f"injected transport disconnect at {where}")
             if a.kind == "device_lost":
+                if point.startswith("mesh."):
+                    # PARTIAL loss: one mesh device died, the backend
+                    # is otherwise alive — the degradation ladder
+                    # (runtime/health.py) owns recovery, not the
+                    # whole-backend reinit
+                    from spark_rapids_tpu.errors import MeshDeviceLostError
+                    raise MeshDeviceLostError(
+                        f"injected mesh device loss at {where}")
                 from spark_rapids_tpu.errors import DeviceLostError
                 raise DeviceLostError(
                     f"injected device loss at {where}")

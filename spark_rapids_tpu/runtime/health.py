@@ -79,6 +79,18 @@ register_metric("quarantineStrikes", "count", "MODERATE",
                 "worker/device kills recorded against query templates")
 register_metric("quarantinedTemplates", "count", "ESSENTIAL",
                 "query templates currently quarantined")
+register_metric("meshDeviceLost", "count", "ESSENTIAL",
+                "PARTIAL device losses observed (one mesh device dead, "
+                "backend otherwise alive — each walks one rung of the "
+                "mesh degradation ladder)")
+register_metric("meshDegradations", "count", "ESSENTIAL",
+                "times the degradation ladder demoted mesh execution "
+                "(single-device re-land of an attempt, or a mesh "
+                "shrink onto surviving devices)")
+register_metric("meshShrinks", "count", "ESSENTIAL",
+                "mesh reconfigurations onto surviving devices after "
+                "partial device loss (bounded by "
+                "spark.rapids.mesh.degrade.maxShrinks)")
 
 
 class DeviceHealthMonitor:
@@ -102,6 +114,16 @@ class DeviceHealthMonitor:
         #: recovery so a tree checked out across a reinit can neither
         #: re-park into the fresh pool nor corrupt its busy count
         self._generation = 0
+        # -- the mesh fault domain (partial device loss) ------------------
+        #: consecutive PARTIAL mesh-device losses with no mesh-NATIVE
+        #: success between them — drives the degradation ladder. A
+        #: success achieved under single-device suppression does NOT
+        #: reset it (the mesh was not exercised, so there is no
+        #: evidence it recovered)
+        self._mesh_consecutive = 0
+        self._mesh_losses = 0
+        self._mesh_shrinks = 0
+        self._mesh_degradations = 0
 
     # -- hot-path reads ------------------------------------------------------
     def cpu_only_reason(self) -> Optional[str]:
@@ -150,12 +172,106 @@ class DeviceHealthMonitor:
             self._reinitialize_backend_locked(conf)
             return "DEGRADED"
 
-    def note_success(self) -> None:
+    def note_success(self, mesh_native: bool = False) -> None:
         """A query completed: the device (or the CPU-only path) works,
-        so the consecutive-loss budget refills."""
-        if self._consecutive_losses:
+        so the consecutive-loss budget refills. The MESH ladder only
+        resets on a mesh-NATIVE success (``mesh_native``): a query
+        that converged under single-device suppression proves nothing
+        about the mesh, and resetting on it would ping-pong a truly
+        dead device between retry and single-device forever instead of
+        walking down to the shrink rung."""
+        if self._consecutive_losses or (mesh_native
+                                        and self._mesh_consecutive):
             with self._lock:
                 self._consecutive_losses = 0
+                if mesh_native:
+                    self._mesh_consecutive = 0
+
+    def on_mesh_device_loss(self, exc: BaseException, conf) -> str:
+        """One observed PARTIAL device loss (a ``mesh.*`` fault point's
+        device_lost, or a real per-device failure classified as
+        MeshDeviceLostError): walk the degradation ladder one rung and
+        return the recovery action the session should take —
+
+        * ``"retry"`` — first consecutive loss: replay the query on the
+          unchanged mesh (transient ICI hiccups are routine on a pod);
+        * ``"single_device"`` — second loss: replay THIS query with
+          mesh landing suppressed (parallel/mesh.suppressed_mesh), the
+          demotion reason riding the hostShuffleFallbacks/explain()
+          machinery — the query converges while the mesh is suspect;
+        * ``"shrink"`` — third loss on: reconfigure the mesh onto the
+          surviving devices (MESH.shrink_excluding — the generation
+          bump fences every stale cached tree/dictionary), bounded by
+          spark.rapids.mesh.degrade.maxShrinks;
+        * ``"DEGRADED"`` / ``"CPU_ONLY"`` — shrink budget spent (or
+          nothing left to shrink): escalate to the whole-backend
+          ladder (:meth:`on_device_loss` — backend reinit, then the
+          CPU-only latch).
+        """
+        from spark_rapids_tpu.parallel.mesh import (
+            MESH,
+            MESH_DEGRADE_MAX_SHRINKS,
+        )
+        max_shrinks = int(conf.get_entry(MESH_DEGRADE_MAX_SHRINKS))
+        first = str(exc).splitlines()[0] if str(exc) else type(exc).__name__
+        with self._lock:
+            if self._cpu_only_reason is not None:
+                return "CPU_ONLY"
+            self._mesh_losses += 1
+            self._mesh_consecutive += 1
+            n = self._mesh_consecutive
+            self._metrics.add("meshDeviceLost", 1)
+            if n == 1:
+                return "retry"
+            if n == 2:
+                self._mesh_degradations += 1
+                self._metrics.add("meshDegradations", 1)
+                return "single_device"
+            # RESERVE the shrink slot while still holding the lock:
+            # two workers observing losses concurrently must not both
+            # pass a read-only budget check and shrink maxShrinks+1
+            # times between them
+            budget = self._mesh_shrinks < max(0, max_shrinks)
+            if budget:
+                self._mesh_shrinks += 1
+        shrunk = False
+        if budget:
+            reason = (f"mesh degraded after {n} consecutive mesh-device "
+                      f"losses (last: {type(exc).__name__}: {first})")
+            shrunk = MESH.shrink_excluding(
+                getattr(exc, "device_id", None), reason)
+            if not shrunk:
+                with self._lock:
+                    self._mesh_shrinks -= 1  # nothing to shrink: return it
+        if shrunk:
+            with self._lock:
+                self._mesh_degradations += 1
+                # a fresh ladder for the smaller mesh: its first loss
+                # is a retry again, not an instant escalation
+                self._mesh_consecutive = 0
+                self._metrics.add("meshShrinks", 1)
+                self._metrics.add("meshDegradations", 1)
+            return "shrink"
+        # nothing left to shrink (or budget spent): the whole-backend
+        # ladder owns it from here — reinit, then the CPU-only latch
+        return self.on_device_loss(exc, conf)
+
+    def mesh_demotion_note(self) -> str:
+        """The reason string a single-device-suppressed attempt carries
+        (surfaced by ici_demotion_reason / explain())."""
+        with self._lock:
+            return (f"mesh degraded to single-device landing after "
+                    f"{self._mesh_consecutive} consecutive mesh-device "
+                    f"losses")
+
+    def mesh_snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "meshDeviceLost": self._mesh_losses,
+                "meshConsecutiveLosses": self._mesh_consecutive,
+                "meshShrinks": self._mesh_shrinks,
+                "meshDegradations": self._mesh_degradations,
+            }
 
     def _invalidate_device_caches_locked(self) -> None:
         """Drop every cache that references device state — cached
@@ -214,6 +330,10 @@ class DeviceHealthMonitor:
             self._losses = 0
             self._cpu_only_reason = None
             self._generation += 1
+            self._mesh_consecutive = 0
+            self._mesh_losses = 0
+            self._mesh_shrinks = 0
+            self._mesh_degradations = 0
 
 
 HEALTH = DeviceHealthMonitor()

@@ -187,3 +187,8 @@ class PlacementLayer:
         SS.MAX_PARTIALS = int(get(C.SEGSUM_MAX_PARTIALS))
         SS.MATMUL_MAX_SEGMENTS = int(get(C.SEGSUM_MATMUL_MAX_SEGMENTS))
         SS.SPLIT_MAX_ABS = float(get(C.SPLIT_SUM_MAX_ABS))
+        # mesh fault-domain tunables (the gather-integrity boundary and
+        # the ICI exchange hold no conf handle, like every other exec)
+        from spark_rapids_tpu.parallel import mesh as PM
+        PM.MAX_SHARD_RETRIES = int(get(PM.MESH_MAX_SHARD_RETRIES))
+        PM.GATHER_VERIFY = bool(get(PM.MESH_GATHER_VERIFY))

@@ -867,6 +867,11 @@ class QueryService:
             "meshShape": _mesh_shape(),
             "iciBytes": 0,
             "shardSkew": 0.0,
+            # v7 mesh fault-domain fields: a cached serve gathers
+            # nothing, so it can neither retry nor trip a checksum
+            "meshDegradations": 0,
+            "shardRetries": 0,
+            "gatherChecksFailed": 0,
         })
         handle.event_record = rec
         try:
@@ -934,6 +939,12 @@ class QueryService:
         out["cpuOnlyReason"] = HEALTH.cpu_only_reason()
         out["device"] = HEALTH.snapshot()
         out["quarantine"] = QUARANTINE.snapshot()
+        # the mesh fault domain: current topology (shrunken shape and
+        # excluded devices after partial losses, with the degradation
+        # reason) plus the ladder's counters — a degraded-but-serving
+        # mesh is VISIBLE here, not silently smaller
+        from spark_rapids_tpu.parallel.mesh import MESH
+        out["mesh"] = {**MESH.health_snapshot(), **HEALTH.mesh_snapshot()}
         return out
 
     def stats(self) -> dict:

@@ -412,6 +412,9 @@ class TpuSession:
             commit_retries=_wdelta("commitRetries"),
             mesh_shape=MESH.shape_str(),
             ici_bytes=_wdelta("iciBytes", "mesh"),
+            mesh_degradations=_wdelta("meshDegradations", "health"),
+            shard_retries=_wdelta("shardRetries", "mesh"),
+            gather_checks_failed=_wdelta("gatherChecksFailed", "mesh"),
         )
         self.last_event_record = record
         # the record has read the tree's metrics — the cached executable
@@ -513,17 +516,62 @@ class TpuSession:
         # ever replaying unboundedly on an unattributable crash
         max_replays = 4 * max_failures + 4
         replays = 0
+        # mesh degradation ladder (runtime/health.py): PARTIAL device
+        # losses replay internally — enough budget to walk every rung
+        # (retry -> single-device -> every shrink -> every reinit ->
+        # the CPU-only latch) without replaying unboundedly
+        from contextlib import nullcontext
+
+        from spark_rapids_tpu.errors import MeshDeviceLostError
+        from spark_rapids_tpu.parallel import mesh as _mesh
+        from spark_rapids_tpu.runtime.health import DEVICE_LOSS_MAX_REINITS
+        max_mesh_replays = (
+            int(self.conf.get_entry(_mesh.MESH_DEGRADE_MAX_SHRINKS))
+            + int(self.conf.get_entry(DEVICE_LOSS_MAX_REINITS)) + 6)
+        mesh_replays = 0
+        suppress_reason = None
         while True:
+            was_suppressed = suppress_reason is not None
+            attempt_ctx = (_mesh.suppressed_mesh(suppress_reason)
+                           if was_suppressed else nullcontext())
+            suppress_reason = None
             try:
-                result = self._execute_attempt(plan)
+                with attempt_ctx:
+                    result = self._execute_attempt(plan)
                 self.last_fault_replays = replays
                 if replays and hasattr(self._last_executable, "metrics"):
                     self._last_executable.metrics["runtimeFaultReplays"] = \
                         replays
                 from spark_rapids_tpu.runtime.health import HEALTH
-                HEALTH.note_success()
+                # the MESH ladder only resets on a mesh-NATIVE success:
+                # a suppressed (single-device) convergence proves
+                # nothing about the mesh's health
+                HEALTH.note_success(
+                    mesh_native=not was_suppressed and _mesh.MESH.enabled)
                 return result
             except Exception as exc:
+                if isinstance(exc, MeshDeviceLostError) and \
+                        not getattr(exc, "_health_handled", False):
+                    # PARTIAL loss (one mesh device dead, backend
+                    # alive): the degradation ladder owns recovery —
+                    # classified DISTINCTLY from the whole-backend
+                    # is_fatal branch below
+                    from spark_rapids_tpu.runtime.health import HEALTH
+                    action = HEALTH.on_mesh_device_loss(exc, self.conf)
+                    self._strike_mesh_template(plan, exc, action)
+                    if mesh_replays >= max_mesh_replays:
+                        exc._health_handled = True
+                        raise
+                    if self._q.exec_depth == 1:
+                        self._release_exec_cache(drop=True)
+                    mesh_replays += 1
+                    F.RECOVERY.bump("query_replays")
+                    if action == "single_device":
+                        suppress_reason = HEALTH.mesh_demotion_note()
+                    # "retry"/"shrink"/"DEGRADED"/"CPU_ONLY" all replay
+                    # plain: the re-plan sees the shrunken mesh, the
+                    # reinitialized backend, or the CPU-only latch
+                    continue
                 if is_fatal_device_error(exc):
                     # a nested execute already ran recovery for this
                     # exception — the outer envelope just propagates it
@@ -567,6 +615,34 @@ class TpuSession:
                     self._release_exec_cache(drop=True)
                 replays += 1
                 F.RECOVERY.bump("query_replays")
+
+    def _strike_mesh_template(self, plan: P.PlanNode, exc: BaseException,
+                              action: str) -> None:
+        """A template that repeatedly kills mesh execution is a poison
+        suspect like any worker/device killer: every ladder action past
+        the plain retry records a quarantine strike (the service then
+        refuses the template at admission once it crosses
+        spark.rapids.service.quarantine.maxStrikes). Best-effort —
+        strike accounting must never mask recovery."""
+        if action == "retry":
+            return
+        try:
+            from spark_rapids_tpu.plan.fingerprint import (
+                template_fingerprint,
+            )
+            from spark_rapids_tpu.runtime.health import (
+                QUARANTINE,
+                QUARANTINE_MAX_STRIKES,
+            )
+            first = (str(exc).splitlines()[0] if str(exc)
+                     else type(exc).__name__)
+            QUARANTINE.strike(
+                template_fingerprint(plan, self.conf),
+                f"mesh execution killed ({action}): "
+                f"{type(exc).__name__}: {first}",
+                int(self.conf.get_entry(QUARANTINE_MAX_STRIKES)))
+        except Exception:
+            pass
 
     def _execute_attempt(self, plan: P.PlanNode) -> HostTable:
         import time as _time

@@ -135,6 +135,21 @@ def compare_query(a_runs: List[dict], b_runs: List[dict]) -> dict:
         # here before anyone blames the plan
         "aIciBytes": sum(int(r.get("iciBytes", 0)) for r in a_runs),
         "bIciBytes": sum(int(r.get("iciBytes", 0)) for r in b_runs),
+        # mesh fault domain (schema v7): recovery work the distributed
+        # path paid per side — a wall regression explained by shard
+        # retries or a mid-run degradation is not a plan regression
+        "aShardRetries": sum(int(r.get("shardRetries", 0))
+                             for r in a_runs),
+        "bShardRetries": sum(int(r.get("shardRetries", 0))
+                             for r in b_runs),
+        "aMeshDegradations": sum(int(r.get("meshDegradations", 0))
+                                 for r in a_runs),
+        "bMeshDegradations": sum(int(r.get("meshDegradations", 0))
+                                 for r in b_runs),
+        "aGatherChecksFailed": sum(int(r.get("gatherChecksFailed", 0))
+                                   for r in a_runs),
+        "bGatherChecksFailed": sum(int(r.get("gatherChecksFailed", 0))
+                                   for r in b_runs),
         "ops": op_diffs,
         "newFallbacks": sorted(set(fb_b) - set(fb_a)),
         "resolvedFallbacks": sorted(set(fb_a) - set(fb_b)),
@@ -163,6 +178,14 @@ def build_compare(path_a: str, path_b: str) -> dict:
         "bWorkerRestarts": sum(q["bWorkerRestarts"] for q in queries),
         "aIciBytes": sum(q["aIciBytes"] for q in queries),
         "bIciBytes": sum(q["bIciBytes"] for q in queries),
+        "aShardRetries": sum(q["aShardRetries"] for q in queries),
+        "bShardRetries": sum(q["bShardRetries"] for q in queries),
+        "aMeshDegradations": sum(q["aMeshDegradations"] for q in queries),
+        "bMeshDegradations": sum(q["bMeshDegradations"] for q in queries),
+        "aGatherChecksFailed": sum(q["aGatherChecksFailed"]
+                                   for q in queries),
+        "bGatherChecksFailed": sum(q["bGatherChecksFailed"]
+                                   for q in queries),
         "onlyInA": sorted(set(idx_a) - set(idx_b)),
         "onlyInB": sorted(set(idx_b) - set(idx_a)),
         "totalAWallS": total_a,
@@ -189,6 +212,17 @@ def render_compare(cmp: dict, top_n: int = 5) -> str:
     if cmp["aIciBytes"] or cmp["bIciBytes"]:
         lines.append(f"Mesh: ICI bytes {cmp['aIciBytes']} -> "
                      f"{cmp['bIciBytes']}")
+    if (cmp.get("aShardRetries") or cmp.get("bShardRetries")
+            or cmp.get("aMeshDegradations")
+            or cmp.get("bMeshDegradations")
+            or cmp.get("aGatherChecksFailed")
+            or cmp.get("bGatherChecksFailed")):
+        lines.append(
+            f"Mesh resilience: shard retries {cmp['aShardRetries']} -> "
+            f"{cmp['bShardRetries']} | degradations "
+            f"{cmp['aMeshDegradations']} -> {cmp['bMeshDegradations']} | "
+            f"gather checks failed {cmp['aGatherChecksFailed']} -> "
+            f"{cmp['bGatherChecksFailed']}")
     if (cmp["aDeviceReinits"] or cmp["bDeviceReinits"]
             or cmp["aWorkerRestarts"] or cmp["bWorkerRestarts"]):
         lines.append(

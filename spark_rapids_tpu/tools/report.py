@@ -162,6 +162,9 @@ def analyze_query(rec: dict, top_n: int = 10) -> dict:
         "meshShape": rec.get("meshShape"),
         "iciBytes": int(rec.get("iciBytes", 0)),
         "shardSkew": float(rec.get("shardSkew", 0.0)),
+        "meshDegradations": int(rec.get("meshDegradations", 0)),
+        "shardRetries": int(rec.get("shardRetries", 0)),
+        "gatherChecksFailed": int(rec.get("gatherChecksFailed", 0)),
         "attribution": {
             "attributedS": round(attributed, 6),
             "untrackedS": round(float(spans.get("untrackedS", 0.0)), 6),
@@ -242,6 +245,17 @@ def build_profile(records: Iterable[dict], top_n: int = 10,
             int((q["scopes"].get("mesh") or {})
                 .get("hostShuffleFallbacks", 0)) for q in queries),
     }
+    # mesh resilience (schema v7): the fault-domain counters — how much
+    # recovery work the distributed path paid and which queries rode
+    # through a degradation
+    mesh_resilience = {
+        "meshDegradations": sum(q["meshDegradations"] for q in queries),
+        "shardRetries": sum(q["shardRetries"] for q in queries),
+        "gatherChecksFailed": sum(
+            q["gatherChecksFailed"] for q in queries),
+        "degradedQueries": sorted(
+            {q["query"] for q in queries if q["meshDegradations"]}),
+    }
     # survivability (schema v4): how healthy was the process this run,
     # and which queries rode through recovery events
     survivability = {
@@ -260,6 +274,7 @@ def build_profile(records: Iterable[dict], top_n: int = 10,
         "totalWallS": total_wall,
         "compile": compile_summary,
         "mesh": mesh_summary,
+        "meshResilience": mesh_resilience,
         "survivability": survivability,
         "minCoverage": round(min((q["attribution"]["coverage"]
                                   for q in queries), default=1.0), 4),
@@ -313,6 +328,15 @@ def render_profile(report: dict) -> str:
             f"{me['iciBytes']} bytes | max shard skew "
             f"{me['maxShardSkew']:.2f} | host-shuffle fallbacks "
             f"{me['hostShuffleFallbacks']}")
+    mr = report.get("meshResilience") or {}
+    if (mr.get("meshDegradations") or mr.get("shardRetries")
+            or mr.get("gatherChecksFailed")):
+        lines.append(
+            f"Mesh resilience: degradations {mr['meshDegradations']} | "
+            f"shard retries {mr['shardRetries']} | gather checks failed "
+            f"{mr['gatherChecksFailed']}"
+            + (f" | degraded: {', '.join(mr['degradedQueries'])}"
+               if mr.get("degradedQueries") else ""))
     sv = report["survivability"]
     if (sv["deviceReinits"] or sv["workerRestarts"]
             or sv["quarantinedQueries"]

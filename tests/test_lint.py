@@ -720,6 +720,41 @@ def test_rl_fault_point():
     assert diags4 == []
 
 
+def test_rl_fault_point_mesh_domain():
+    """The mesh fault domain rides the SAME two-direction audit as
+    every other point class: an UNREGISTERED mesh point at a call site
+    is flagged, and a registered ``mesh.*`` point whose call site
+    disappears (the distributed path silently losing chaos coverage —
+    exactly the pre-PR state this issue fixed) is flagged from the
+    registry side."""
+    from spark_rapids_tpu.lint.repo_lint import (
+        _check_fault_registry,
+        _check_fault_sites,
+    )
+    from spark_rapids_tpu.runtime.faults import FAULT_POINTS
+
+    # direction 1: a mesh-looking point nobody registered
+    src = ("from spark_rapids_tpu.runtime.faults import fault_point\n"
+           "fault_point('mesh.reland.unregistered')\n")
+    diags = _run_rl(_check_fault_sites, "spark_rapids_tpu/parallel/foo.py",
+                    src, {})
+    hits = _find(diags, "RL-FAULT-POINT")
+    assert len(hits) == 1 and "not registered" in hits[0].message
+
+    # direction 2: every registered mesh.* point with NO call site ->
+    # one registry-side diagnostic each (the points exist)
+    mesh_points = [n for n in FAULT_POINTS if n.startswith("mesh.")]
+    assert len(mesh_points) == 4, mesh_points
+    calls = {name: [f"{module}:1"]
+             for name, (module, _) in FAULT_POINTS.items()
+             if not name.startswith("mesh.")}
+    diags2 = []
+    _check_fault_registry(calls, diags2)
+    uncalled = [d for d in diags2 if "no fault_point" in d.message]
+    assert len(uncalled) == len(mesh_points)
+    assert any("mesh.gather" in d.message for d in uncalled)
+
+
 def test_every_rule_has_a_negative_test():
     """Meta-pin: the rule surface and this module's negative coverage
     cannot drift apart (>= 12 rules required by the issue)."""

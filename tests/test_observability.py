@@ -229,12 +229,13 @@ def test_event_log_written_and_valid(tmp_path):
     lines = open(s.last_event_path).read().strip().splitlines()
     assert len(lines) == 1
     rec = json.loads(lines[0])
-    # schema v6: the mesh-native execution PR added meshShape /
-    # iciBytes / shardSkew (null/0/0.0 off-mesh) on top of v5's
-    # transactional-write fields (filesWritten / bytesWritten /
-    # commitRetries — write-scope deltas; 0 for read-only queries)
-    # and v4's survivability fields — see obs/events.py
-    assert rec["schema"] == 6
+    # schema v7: the mesh fault-domain PR added meshDegradations /
+    # shardRetries / gatherChecksFailed (all 0 on a healthy mesh and
+    # off-mesh) on top of v6's mesh-native fields (meshShape /
+    # iciBytes / shardSkew — null/0/0.0 off-mesh), v5's
+    # transactional-write fields and v4's survivability fields — see
+    # obs/events.py
+    assert rec["schema"] == 7
     assert rec["healthState"] == "HEALTHY"
     assert rec["quarantined"] is False
     assert rec["deviceReinits"] == 0 and rec["workerRestarts"] == 0
@@ -242,6 +243,8 @@ def test_event_log_written_and_valid(tmp_path):
     assert rec["commitRetries"] == 0
     assert rec["meshShape"] is None
     assert rec["iciBytes"] == 0 and rec["shardSkew"] == 0.0
+    assert rec["meshDegradations"] == 0
+    assert rec["shardRetries"] == 0 and rec["gatherChecksFailed"] == 0
     assert rec["event"] == "queryCompleted"
     assert rec["queryTag"] == "golden"
     assert rec["wallS"] > 0
@@ -288,7 +291,12 @@ def test_event_log_golden_schema(tmp_path):
     all-to-all collectives, a per-record delta of the mesh scope;
     shardSkew — max per-shard map-output max/median over the query's
     collective exchanges, measured from real shard live counts;
-    result-cache serves carry serve-time meshShape and 0/0.0)."""
+    result-cache serves carry serve-time meshShape and 0/0.0);
+    v7 = mesh fault-domain fields (meshDegradations — degradation-
+    ladder demotions during this query's wall, a health-scope delta;
+    shardRetries / gatherChecksFailed — local re-gathers paid and
+    checksum validations tripped at mesh gather boundaries, mesh-scope
+    deltas; all 0 on a healthy mesh and for result-cache serves)."""
     s = _run_eventlog_query(tmp_path)
     got = _normalize(s.last_event_record)
     golden_path = os.path.join(os.path.dirname(__file__),
