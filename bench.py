@@ -34,6 +34,17 @@ def main():
     no_eventlog = "--no-eventlog" in argv
     if no_eventlog:
         argv.remove("--no-eventlog")
+    require_tpu = "--require-tpu" in argv
+    if require_tpu:
+        argv.remove("--require-tpu")
+    # the resolved backend is recorded in the artifact AND gateable
+    # (tools.require_tpu_backend: the shared BENCH_r06-lesson gate)
+    if require_tpu:
+        from spark_rapids_tpu.tools import require_tpu_backend
+        backend = require_tpu_backend()
+    else:
+        import jax
+        backend = jax.default_backend()
     eventlog_dir = "/tmp/rapids_tpu_eventlog/bench"
     if "--eventlog-dir" in argv:
         i = argv.index("--eventlog-dir")
@@ -116,6 +127,7 @@ def main():
         "value": round(speedup, 3),
         "unit": "x",
         "vs_baseline": round(speedup / 3.0, 3),
+        "backend": backend,
         "detail": {"rows": rows, "tpu_s": round(tpu_s, 4),
                    "tpu_med_s": round(tpu_med_s, 4),
                    "tpu_cold_s": round(cold_s, 4), "cpu_s": round(cpu_s, 4),

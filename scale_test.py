@@ -631,7 +631,8 @@ def run_write_chaos(seed: int = 7, base_dir=None) -> dict:
 
     base = base_dir or tempfile.mkdtemp(prefix="rapids_write_chaos_")
     failures = []
-    report = {"seed": seed, "dir": base, "scenarios": {}}
+    report = {"seed": seed, "dir": base, "backend": _resolved_backend(),
+              "scenarios": {}}
 
     def _frame(s, n=200):
         import numpy as np
@@ -845,6 +846,7 @@ def run_chaos(sf: float = 0.02, seed: int = 7, queries=None,
     wanted = queries or list(base_queries)
 
     report = {"mode": "chaos", "seed": seed, "scale_factor": sf,
+              "backend": _resolved_backend(),
               # the spec ACTUALLY armed (chaos_conf composed it) — not
               # a rebuilt copy that could drift from it
               "fault_spec": chaotic.conf.to_dict()[
@@ -1141,6 +1143,7 @@ def run_mesh_chaos(sf: float, seed: int, ndev: int, queries=None,
     wanted = sorted(wanted, key=lambda n: (n != "q7", wanted.index(n)))
 
     report = {"mode": "mesh-chaos", "n_devices": ndev,
+              "backend": _resolved_backend(),
               "mesh_shape": shape or str(ndev), "scale_factor": sf,
               "seed": seed, "sql": use_sql,
               "fault_spec": mesh.conf.to_dict()[
@@ -1316,6 +1319,7 @@ def run_mesh(sf: float, seed: int, ndev: int, queries=None,
     wanted = queries or list(chip_queries)
 
     report = {"mode": "mesh", "n_devices": ndev,
+              "backend": _resolved_backend(),
               "mesh_shape": shape or str(ndev), "scale_factor": sf,
               "seed": seed, "sql": use_sql, "queries": {}}
     failures = []
@@ -1386,6 +1390,14 @@ SUPPORTED_MODES = (
     "--mesh N [--mesh-shape DxI] [--chaos]")
 
 
+def _resolved_backend() -> str:
+    """The JAX backend this run actually measured — stamped into every
+    report artifact so a CPU-backend number can never masquerade as a
+    TPU one (the BENCH_r06 lesson)."""
+    import jax
+    return jax.default_backend()
+
+
 def validate_flags(args) -> None:
     """Fail fast on flag combinations the harness does not implement —
     a silently-ignored mode flag reads as a passing run of a contract
@@ -1405,6 +1417,11 @@ def validate_flags(args) -> None:
         if args.cpu_baseline:
             bad("--mesh does not compose with --cpu-baseline: the mesh "
                 "baseline is fault-free single-chip, not the CPU path")
+        if args.require_tpu:
+            bad("--mesh does not compose with --require-tpu: the mesh "
+                "harness pins virtual host-platform (cpu) devices, and "
+                "the gate would initialize the backend before the "
+                "device-count flag can take effect")
     if args.service_faults and not (args.chaos and args.concurrency > 1):
         bad("--service-faults needs --chaos --concurrency > 1 (the "
             "service fault points live in the worker/watchdog "
@@ -1467,8 +1484,22 @@ def main():
     ap.add_argument("--mesh-shape", type=str, default="",
                     help="with --mesh: explicit spark.rapids.mesh.shape "
                          "('8' or '2x4'; default N on one flat axis)")
+    ap.add_argument("--require-tpu", action="store_true",
+                    help="exit non-zero when the resolved JAX backend is "
+                         "'cpu' — a perf run that meant to hit the TPU "
+                         "must fail loudly, not commit CPU numbers "
+                         "(BENCH_r06 did exactly that)")
     args = ap.parse_args()
     validate_flags(args)
+
+    # the require-tpu gate resolves the backend ONLY when asked: an
+    # unconditional jax.default_backend() here would initialize the
+    # backend before --mesh's _ensure_host_mesh can force the virtual
+    # host-device count (the report dicts each stamp _resolved_backend()
+    # themselves, after any mesh setup)
+    if args.require_tpu:
+        from spark_rapids_tpu.tools import require_tpu_backend
+        require_tpu_backend()
 
     if args.mesh:
         wanted = [q.strip() for q in args.queries.split(",") if q.strip()]
@@ -1562,6 +1593,7 @@ def main():
         cpu_queries = build(cpu, tables)
 
     report = {"scale_factor": args.sf, "mode": "sql" if args.sql else "dsl",
+              "backend": _resolved_backend(),
               "eventlog_dir": (args.eventlog_dir if not args.no_eventlog
                                else None),
               "datagen_s": round(gen_s, 3),

@@ -805,18 +805,16 @@ class DeviceTable:
         per column word this representation exists to defer."""
         if self.live is None:
             return self
-        key = ("tablecompact", self.capacity, self.schema_key()[0])
+        from spark_rapids_tpu import kernels
+        key = ("tablecompact", self.capacity, self.schema_key()[0],
+               kernels.trace_token())
         fn = _PACK_CACHE.get(key)
         if fn is None:
             cap = self.capacity
 
             def compact(datas, valids, keep):
-                from spark_rapids_tpu.ops.scatter32 import scatter_pair
-                pos = jnp.cumsum(keep.astype(jnp.int32)) - 1
-                tgt = jnp.where(keep, pos, cap)
-                outs = []
-                for d, v in zip(datas, valids):
-                    outs.append(scatter_pair(cap, tgt, d, v))
+                from spark_rapids_tpu.ops.scatter32 import compact_pairs
+                outs, _ = compact_pairs(datas, valids, keep, cap)
                 return outs
 
             fn = tpu_jit(compact)

@@ -466,6 +466,64 @@ SPLIT_F64_SUM = str_conf(
     "force the mode. The same trade the reference gates with "
     "variableFloatAgg.enabled.")
 
+KERNELS_SORT_ENABLED = str_conf(
+    "spark.rapids.tpu.kernels.sort.enabled", "auto",
+    "Pallas multi-column sort kernel (kernels/sort.py): a bitonic "
+    "network over packed two-limb key operands + payload permutation "
+    "in ONE fused device program, replacing the multi-operand "
+    "lexicographic lax.sort. 'auto' enables it on non-CPU backends "
+    "(CPU runs Pallas in interpret mode — correct but slow); "
+    "'true'/'false' force. Bit-identity with the HLO path is pinned; "
+    "ineligible shapes (non-power-of-two capacity, VMEM budget) fall "
+    "back per call, and a kernel crash demotes the primitive to HLO "
+    "for the process (reason in explain()/event log).")
+
+KERNELS_SEGREDUCE_ENABLED = str_conf(
+    "spark.rapids.tpu.kernels.segreduce.enabled", "auto",
+    "Pallas segmented-reduction kernels (kernels/segreduce.py): fused "
+    "two-limb 64-bit segment min/max (hi-limb reduce + lo-limb "
+    "tiebreak in one two-pass program instead of 4+ scatter/gather "
+    "passes) and the blocked one-hot split-sum partials built in VMEM "
+    "instead of materializing the one-hot in HBM. 'auto'/'true'/"
+    "'false' as for kernels.sort.enabled.")
+
+KERNELS_HASHPROBE_ENABLED = str_conf(
+    "spark.rapids.tpu.kernels.hashprobe.enabled", "auto",
+    "Pallas hash-probe join kernel (kernels/hashprobe.py): a bounded-"
+    "attempt open-addressing table over two-limb keys replaces the "
+    "dense-code prefix chain (two full sorts) for single-integer-key "
+    "joins with unique build keys; duplicate/overflowing builds set a "
+    "device flag and the sort-based probe replays (speculation "
+    "machinery). 'auto'/'true'/'false' as for kernels.sort.enabled.")
+
+KERNELS_COMPACT_ENABLED = str_conf(
+    "spark.rapids.tpu.kernels.compact.enabled", "auto",
+    "Pallas row-compaction kernel (kernels/compact.py): one i32 "
+    "gather-map scatter + ONE fused kernel gathering every column's "
+    "32-bit limb streams, replacing 2-3 scatter passes per 64-bit "
+    "column in every filter/join-output/split compaction. "
+    "'auto'/'true'/'false' as for kernels.sort.enabled.")
+
+KERNELS_VMEM_BUDGET = int_conf(
+    "spark.rapids.tpu.kernels.vmemBudgetBytes", 64 << 20,
+    "Per-call VMEM working-set bound for the Pallas kernels: a "
+    "primitive whose resident operands would exceed this falls back "
+    "to the HLO path for that call (counted as an hloFallback in the "
+    "compile metric scope).")
+
+KERNELS_SEGREDUCE_MAX_SEGMENTS = int_conf(
+    "spark.rapids.tpu.kernels.segreduce.maxSegments", 8192,
+    "Segment-count bound for the Pallas segmented min/max kernel (the "
+    "per-block accumulator is segment-sized in VMEM); wider segment "
+    "spaces keep the native-32-bit HLO scatter path.")
+
+KERNELS_HASHPROBE_ATTEMPTS = int_conf(
+    "spark.rapids.tpu.kernels.hashprobe.attempts", 4,
+    "Rehash attempts for the Pallas hash-probe table: build rows that "
+    "cannot place within this many alternative slots (or duplicate "
+    "build keys) set the failure flag and the join replays on the "
+    "sort-based probe.")
+
 AGG_MAX_DICT_GROUPS = int_conf(
     "spark.rapids.tpu.agg.maxDictGroups", 1 << 16,
     "Max key-domain product for the no-sort dictionary-code aggregation "

@@ -467,10 +467,12 @@ class TpuHashAggregateExec(TpuExec):
              tuple(fn.key() for _, fn in agg_specs),
              tuple(f.key() for f in filters),
              table.schema_key()[0]))
+        from spark_rapids_tpu import kernels
         from spark_rapids_tpu.ops import segsum as _ss
         mode_key = ("fast", fast[0], fast[3]) if fast else ("sorted",)
         has_mask = table.live is not None
-        tkey = (capacity, self.use_split, _ss.trace_key(), mode_key, has_mask,
+        tkey = (capacity, self.use_split, _ss.trace_key(),
+                kernels.trace_token(), mode_key, has_mask,
                 tuple(_prep_trace_key(p) for p in filter_preps),
                 tuple(_prep_trace_key(p) for p in key_preps),
                 tuple(tuple(_prep_trace_key(p) for p in per_child)
@@ -656,16 +658,11 @@ class TpuHashAggregateExec(TpuExec):
                 # input is empty (count=0, sums NULL — Spark semantics)
                 exists = jnp.arange(gpad, dtype=jnp.int32) == 0
             ngroups = jnp.sum(exists.astype(jnp.int32))
-            pos = jnp.cumsum(exists.astype(jnp.int32)) - 1
-            tgt = jnp.where(exists, pos, gpad)  # compact: slot -> dense rank
-            out_live = jnp.arange(gpad, dtype=jnp.int32) < ngroups
 
-            def compact(data, validity):
-                from spark_rapids_tpu.ops.scatter32 import scatter_pair
-                cd, cv = scatter_pair(gpad, tgt, data, validity)
-                return cd, cv & out_live
-
-            outs = []
+            # every output column compacts slot -> dense rank through ONE
+            # shared call (the Pallas compact kernel fuses the whole
+            # column set into one gather pass when enabled)
+            pairs = []
             slot_ix = jnp.arange(gpad, dtype=jnp.int32)
             for i, kind in enumerate(kinds):
                 slot = (slot_ix // strides[i]) % sizes[i]
@@ -677,7 +674,7 @@ class TpuHashAggregateExec(TpuExec):
                         grouping[i].data_type.np_dtype)
                 else:
                     kdata = slot
-                outs.append(compact(kdata, kvalid))
+                pairs.append((kdata, kvalid))
 
             fplan = []  # (spec index, kind) riding a batched f64 pass
             for j, (_, fnagg) in enumerate(agg_specs):
@@ -775,8 +772,11 @@ class TpuHashAggregateExec(TpuExec):
                     data, validity = self._agg_one(
                         fnagg, sd, svs[j], live, gid, gpad, exists,
                         capacity, use_split)
-                outs.append(compact(data, validity))
-            return outs, ngroups
+                pairs.append((data, validity))
+            from spark_rapids_tpu.ops.scatter32 import compact_pairs
+            outs, _ = compact_pairs([d for d, _ in pairs],
+                                    [v for _, v in pairs], exists, gpad)
+            return list(outs), ngroups
 
         return kernel
 
@@ -817,9 +817,9 @@ class TpuHashAggregateExec(TpuExec):
                 operands = [(~live).astype(jnp.int32)]  # dead rows last
                 for kv in key_vals:
                     operands.extend(_sortable(kv.data, kv.validity))
-                nk = len(operands)
+                from spark_rapids_tpu.ops.ordering import lex_sort
                 payload = jnp.arange(capacity, dtype=jnp.int32)
-                sorted_all = jax.lax.sort(operands + [payload], num_keys=nk)
+                sorted_all = lex_sort(operands, payload)
                 perm = sorted_all[-1]
                 s_live = live[perm]
                 s_keys = [DevVal(kv.data[perm], kv.validity[perm])
@@ -1008,14 +1008,14 @@ class TpuHashAggregateExec(TpuExec):
             sdv = sd
             gidv = gid
             if isinstance(fnagg, agg.CollectSet):
+                from spark_rapids_tpu.ops.ordering import lex_sort
                 # distinct: re-sort by (gid, value) and keep group-local
                 # first occurrences
                 ops = comparable_operands(
                     jnp.where(sv, sd, jnp.zeros_like(sd)))
-                res = jax.lax.sort(
-                    [gid, (~sv).astype(jnp.int32)] + ops +
-                    [jnp.arange(capacity, dtype=jnp.int32)],
-                    num_keys=2 + len(ops))
+                res = lex_sort(
+                    [gid, (~sv).astype(jnp.int32)] + ops,
+                    jnp.arange(capacity, dtype=jnp.int32))
                 gidv = res[0]
                 sflag = res[1] == 0
                 perm2 = res[-1]
@@ -1040,12 +1040,11 @@ class TpuHashAggregateExec(TpuExec):
             return ((offsets, elements, evalid), group_live)
 
         if isinstance(fnagg, agg.Percentile):
-            from spark_rapids_tpu.ops.ordering import comparable_operands
+            from spark_rapids_tpu.ops.ordering import comparable_operands, lex_sort
             ops = comparable_operands(jnp.where(sv, sd, jnp.zeros_like(sd)))
-            res = jax.lax.sort(
-                [gid, (~sv).astype(jnp.int32)] + ops +
-                [jnp.arange(capacity, dtype=jnp.int32)],
-                num_keys=2 + len(ops))
+            res = lex_sort(
+                [gid, (~sv).astype(jnp.int32)] + ops,
+                jnp.arange(capacity, dtype=jnp.int32))
             gidv = res[0]
             perm2 = res[-1]
             sdv = sd[perm2].astype(jnp.float64)

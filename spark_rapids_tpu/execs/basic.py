@@ -252,10 +252,11 @@ class _FilterKernel:
         has_mask = table.live is not None
         ansi = ANSI_MODE.get()
 
+        from spark_rapids_tpu import kernels
         self._traces = shared_traces(
             ("filter", self.condition.key(), table.schema_key()[0]))
         tkey = (capacity, emit_mask, has_mask, ansi,
-                _prep_trace_key(preps))
+                kernels.trace_token(), _prep_trace_key(preps))
         got = self._traces.get(tkey)
         if got is None:
             cond = self.condition
@@ -277,12 +278,10 @@ class _FilterKernel:
                 new_n = jnp.sum(keep.astype(jnp.int32))
                 if emit_mask:
                     return keep, new_n, errs
-                pos = jnp.cumsum(keep.astype(jnp.int32)) - 1
-                tgt = jnp.where(keep, pos, capacity)
-                from spark_rapids_tpu.ops.scatter32 import scatter_pair
-                outs = []
-                for data, validity in cols:
-                    outs.append(scatter_pair(capacity, tgt, data, validity))
+                from spark_rapids_tpu.ops.scatter32 import compact_pairs
+                outs, new_n = compact_pairs([d for d, _ in cols],
+                                            [v for _, v in cols],
+                                            keep, capacity)
                 return outs, new_n, errs
 
             got = (tpu_jit(run), labels)
@@ -531,18 +530,13 @@ _COMPACT_KERNELS = {}
 
 
 def _compaction_kernel(capacity: int, schema_key):
-    key = (capacity, schema_key)
+    from spark_rapids_tpu import kernels
+    key = (capacity, schema_key, kernels.trace_token())
     fn = _COMPACT_KERNELS.get(key)
     if fn is None:
         def run(datas, valids, keep):
-            from spark_rapids_tpu.ops.scatter32 import scatter_pair
-            pos = jnp.cumsum(keep.astype(jnp.int32)) - 1
-            tgt = jnp.where(keep, pos, capacity)
-            new_n = jnp.sum(keep.astype(jnp.int32))
-            outs = []
-            for d, v in zip(datas, valids):
-                outs.append(scatter_pair(capacity, tgt, d, v))
-            return outs, new_n
+            from spark_rapids_tpu.ops.scatter32 import compact_pairs
+            return compact_pairs(datas, valids, keep, capacity)
 
         fn = tpu_jit(run)
         _COMPACT_KERNELS[key] = fn

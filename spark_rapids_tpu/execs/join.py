@@ -54,14 +54,14 @@ DIRECT_TABLE_MULT = contextvars.ContextVar("rapids_direct_join_mult",
 
 def _dense_rank_ops(ops, valid):
     """Dense ranks [0, nvalid) over valid entries; -1 for invalid. One
-    multi-operand native-width lax.sort (ops/ordering.py — no emulated
+    multi-operand native-width sort (ops/ordering.lex_sort — no emulated
     64-bit compares) + adjacent-change cumsum + scatter-back. Output ranks
     are i32: row counts never exceed 2^31 (power-of-two row buckets)."""
+    from spark_rapids_tpu.ops.ordering import lex_sort
     n = ops[0].shape[0]
     zops = [jnp.where(valid, o, jnp.zeros_like(o)) for o in ops]
-    operands = [(~valid).astype(jnp.int32)] + zops + [
-        jnp.arange(n, dtype=jnp.int32)]
-    res = jax.lax.sort(operands, num_keys=1 + len(zops))
+    res = lex_sort([(~valid).astype(jnp.int32)] + zops,
+                   jnp.arange(n, dtype=jnp.int32))
     perm = res[-1]
     s_valid = res[0] == 0
     first = jnp.arange(n) == 0
@@ -98,7 +98,9 @@ class JoinKernel:
     # -- phase A: shared code space + probe ranges --------------------------
     def probe(self, lkeys: List[DevVal], rkeys, nl_dev, nr_dev,
               cap_l: int, cap_r: int, live_l_mask=None):
+        from spark_rapids_tpu import kernels
         tkey = (cap_l, cap_r, live_l_mask is not None,
+                kernels.trace_token(),
                 tuple(str(k[0].dtype) for k in lkeys),
                 tuple(str(k[0].dtype) for k in rkeys))
         fn = self._probe_traces.get(tkey)
@@ -144,9 +146,10 @@ class JoinKernel:
             l_codes = jnp.where(valid_l, l_codes, -1)
 
             # sort build-side codes; invalid/dead rows park at +inf
+            from spark_rapids_tpu.ops.ordering import lex_sort
             r_sortable = jnp.where(valid_r, r_codes, INT32_MAX)
-            _, rs_perm = jax.lax.sort(
-                [r_sortable, jnp.arange(cap_r, dtype=jnp.int32)], num_keys=1)
+            _, rs_perm = lex_sort([r_sortable],
+                                  jnp.arange(cap_r, dtype=jnp.int32))
 
             # codes are DENSE ranks < cap_l + cap_r, so per-code build
             # counts + an exclusive prefix give each probe code's sorted
@@ -266,8 +269,9 @@ class _DirectJoinKernel:
         output stays IN PLACE (live rows marked by the returned mask — no
         compaction scatter at all, columnar/table.py DeviceTable.live);
         otherwise inner/semi/anti compact as before."""
+        from spark_rapids_tpu import kernels
         key = (jt, H, lt.capacity, rt.capacity, masked_out,
-               lt.live is not None,
+               lt.live is not None, kernels.trace_token(),
                lt.schema_key()[0], rt.schema_key()[0],
                str(lkey[0].dtype), str(rkey[0].dtype))
         fn = cls._traces.get(key)
@@ -336,16 +340,13 @@ class _DirectJoinKernel:
                     for d, v in r_cols:
                         outs.append((d[safe_ri], v[safe_ri] & matched))
                 return tuple(outs), keep, nout, fail
-            from spark_rapids_tpu.ops.scatter32 import scatter_pair
-            cpos = jnp.cumsum(keep.astype(jnp.int32)) - 1
-            tgt = jnp.where(keep, cpos, cap_l)
-            outs = []
-            for d, v in l_cols:
-                outs.append(scatter_pair(cap_l, tgt, d, v))
+            from spark_rapids_tpu.ops.scatter32 import compact_pairs
+            pairs = list(l_cols)
             if jt == "inner":
-                for d, v in r_cols:
-                    outs.append(scatter_pair(cap_l, tgt, d[safe_ri],
-                                             v[safe_ri] & matched))
+                pairs += [(d[safe_ri], v[safe_ri] & matched)
+                          for d, v in r_cols]
+            outs, _ = compact_pairs([d for d, _ in pairs],
+                                    [v for _, v in pairs], keep, cap_l)
             return tuple(outs), None, nout, fail
 
         return kernel
@@ -619,9 +620,12 @@ class TpuJoinExec(TpuExec):
         if direct is not None:
             return direct, None
 
-        (lo, counts, total_d, matched_l, rs_perm, live_l, live_r) = \
-            self._kernel.probe(lkeys, rkeys, lt.nrows_dev, rt.nrows_dev,
-                               lt.capacity, rt.capacity, lt.live)
+        probe_out = self._try_hashprobe(lt, rt, lkeys, rkeys)
+        if probe_out is None:
+            probe_out = self._kernel.probe(
+                lkeys, rkeys, lt.nrows_dev, rt.nrows_dev,
+                lt.capacity, rt.capacity, lt.live)
+        (lo, counts, total_d, matched_l, rs_perm, live_l, live_r) = probe_out
 
         r_matched = None
         if full_outer:
@@ -697,6 +701,105 @@ class TpuJoinExec(TpuExec):
             fn = tpu_jit(flag)
             self._kernel._aux_traces[key] = fn
         return fn(total_d, counts, live_l)
+
+    def _try_hashprobe(self, lt, rt, lkeys, rkeys):
+        """Pallas hash-probe (kernels/hashprobe.py): for single
+        integer-key joins, one bounded-attempt hash table replaces the
+        dense-rank sort chain. Outputs are probe()-compatible ranges
+        (counts in {0,1}, identity perm) so every downstream consumer —
+        expand, outer nulls, the full-outer match bitmap — runs
+        unchanged. Unique-build-key speculation: the device ``fail``
+        flag (duplicate keys or table overflow) rides the collect's
+        packed fetch; a miss blocklists this site and replays on the
+        sort-based probe — the _DirectJoinKernel protocol. Returns None
+        when the shape doesn't qualify."""
+        from spark_rapids_tpu import kernels
+        if len(lkeys) != 1:
+            return None
+        if not (getattr(lkeys[0][0], "ndim", 1) == 1
+                and getattr(rkeys[0][0], "ndim", 1) == 1):
+            # decimal128 keys are (rows, 2) limb MATRICES — the scalar
+            # two-limb split does not apply; sorted probe handles them
+            return None
+        if not (jnp.issubdtype(lkeys[0][0].dtype, jnp.integer)
+                and jnp.issubdtype(rkeys[0][0].dtype, jnp.integer)):
+            return None
+        if not kernels.enabled("hashprobe"):
+            # qualifying shape, primitive disabled/demoted: counted
+            # ONCE per exec per query (this runs per probe BATCH; the
+            # other routers count once per trace — a per-batch count
+            # would swamp the fallback ratio)
+            if not getattr(self, "_hashprobe_off_counted", False):
+                self._hashprobe_off_counted = True
+                return kernels.count_fallback("hashprobe", lambda: None)
+            return None
+        from spark_rapids_tpu.runtime import speculation as spec
+        site = self._site_key + ":hashprobe"
+        ctx = spec.allowed(site)
+        if ctx is None:
+            return None
+        H = 1 << max(2 * rt.capacity - 1, 1).bit_length()
+        attempts = kernels.config().attempts
+        tkey = ("hashprobe", H, lt.capacity, rt.capacity,
+                lt.live is not None, attempts, kernels.trace_token(),
+                str(lkeys[0][0].dtype), str(rkeys[0][0].dtype))
+        fn = self._kernel._probe_traces.get(tkey, "absent")
+        if fn is None:
+            return None  # memoized ineligible shape: sorted path
+        if fn == "absent":
+            cap_l, cap_r = lt.capacity, rt.capacity
+
+            def hashprobe(lk, rk, nl, nr, live_l_mask):
+                from spark_rapids_tpu.kernels import hashprobe as khash
+                if live_l_mask is not None:
+                    live_l = live_l_mask
+                else:
+                    live_l = jnp.arange(cap_l, dtype=jnp.int32) < nl
+                live_r = jnp.arange(cap_r, dtype=jnp.int32) < nr
+                lo, counts, total, matched, rs_perm, fail = \
+                    khash.probe_ranges(lk, rk, live_l, live_r, H,
+                                       attempts)
+                return (lo, counts, total, matched, rs_perm,
+                        live_l, live_r, fail)
+
+            # resolution is counted ONCE per trace key (trace-time
+            # semantics, like the other primitives' routers) and an
+            # ineligible shape is MEMOIZED — without the sentinel every
+            # probe batch would re-trace probe_ranges just to raise and
+            # fall back again
+            from spark_rapids_tpu.dispatch import COMPILE_SCOPE
+            from spark_rapids_tpu.kernels import KernelIneligible
+            fn = tpu_jit(hashprobe)
+            try:
+                out = fn(lkeys[0], rkeys[0], lt.nrows_dev, rt.nrows_dev,
+                         lt.live)
+            except KernelIneligible:
+                COMPILE_SCOPE.add("hloFallbacks", 1)
+                self._kernel._probe_traces[tkey] = None
+                return None
+            except Exception as exc:
+                from spark_rapids_tpu.runtime.crash_handler import (
+                    is_fatal_device_error,
+                )
+                from spark_rapids_tpu.runtime.retry import is_device_oom
+                if is_device_oom(exc) or is_fatal_device_error(exc):
+                    # OOMs belong to the retry framework; a dead
+                    # device/tunnel is the health monitor's to recover
+                    # — neither is the kernel's fault (the tpu_jit
+                    # capture handler makes the same exemptions)
+                    raise
+                # idempotent when tpu_jit's capture frame already did it
+                kernels.demote("hashprobe", exc)
+                COMPILE_SCOPE.add("hloFallbacks", 1)
+                return None
+            COMPILE_SCOPE.add("pallasKernels", 1)
+            self._kernel._probe_traces[tkey] = fn
+        else:
+            out = fn(lkeys[0], rkeys[0], lt.nrows_dev, rt.nrows_dev,
+                     lt.live)
+        ctx.add_flag(site, out[-1])
+        self.add_metric("hashProbeBatches", 1)
+        return out[:-1]
 
     def _try_direct(self, jt, lt, rt, lkeys, rkeys, swapped, full_outer):
         """Dense-domain direct-address fast path (see _DirectJoinKernel).
@@ -792,20 +895,16 @@ class TpuJoinExec(TpuExec):
     def _compact(self, table: DeviceTable, keep) -> DeviceTable:
         """Semi/anti: compact kept rows (static capacity, like the filter
         kernel's scatter-to-cumsum compaction)."""
-        key = ("compact", table.capacity, table.schema_key()[0])
+        from spark_rapids_tpu import kernels
+        key = ("compact", table.capacity, table.schema_key()[0],
+               kernels.trace_token())
         fn = self._kernel._aux_traces.get(key)
         if fn is None:
             cap = table.capacity
 
             def compact(datas, valids, keep):
-                from spark_rapids_tpu.ops.scatter32 import scatter_pair
-                pos = jnp.cumsum(keep.astype(jnp.int32)) - 1
-                tgt = jnp.where(keep, pos, cap)
-                new_n = jnp.sum(keep.astype(jnp.int32))
-                outs = []
-                for d, v in zip(datas, valids):
-                    outs.append(scatter_pair(cap, tgt, d, v))
-                return outs, new_n
+                from spark_rapids_tpu.ops.scatter32 import compact_pairs
+                return compact_pairs(datas, valids, keep, cap)
 
             fn = tpu_jit(compact)
             self._kernel._aux_traces[key] = fn

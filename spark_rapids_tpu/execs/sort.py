@@ -166,14 +166,16 @@ class TpuSortExec(TpuExec):
         aux = prep_aux(pctx)
         capacity = table.capacity
 
+        from spark_rapids_tpu import kernels
         has_mask = table.live is not None
-        tkey = (capacity, has_mask,
+        tkey = (capacity, has_mask, kernels.trace_token(),
                 tuple(_prep_trace_key(p) for p in key_preps))
         fn = self._traces.get(tkey)
         if fn is None:
             orders = self.orders
 
             def run(cols, aux, nrows, live_in):
+                from spark_rapids_tpu.ops.ordering import lex_sort
                 # masked input: dead rows park last via the liveness
                 # operand, so the sort doubles as the deferred compaction
                 if live_in is not None:
@@ -188,7 +190,7 @@ class TpuSortExec(TpuExec):
                     operands.extend(_directional(kv.data, kv.validity, o.ascending,
                                                  o.resolved_nulls_first(), capacity))
                 payload = jnp.arange(capacity, dtype=jnp.int32)
-                res = jax.lax.sort(operands + [payload], num_keys=len(operands))
+                res = lex_sort(operands, payload)
                 perm = res[-1]
                 return [(d[perm], v[perm]) for d, v in cols]
 
@@ -227,8 +229,9 @@ class TpuSortExec(TpuExec):
         from spark_rapids_tpu.dispatch import prep_aux
         cols = tuple(DevVal(c.data, c.validity) for c in table.columns)
         aux = prep_aux(pctx)
+        from spark_rapids_tpu import kernels
         has_mask = table.live is not None
-        tkey = (capacity, has_mask, k,
+        tkey = (capacity, has_mask, k, kernels.trace_token(),
                 tuple(_prep_trace_key(p) for p in key_preps))
         fn = self._traces.get(tkey)
         if fn is None:
@@ -249,9 +252,9 @@ class TpuSortExec(TpuExec):
                     operands.extend(_directional(
                         kv.data, kv.validity, o.ascending,
                         o.resolved_nulls_first(), capacity))
+                from spark_rapids_tpu.ops.ordering import lex_sort
                 payload = jnp.arange(capacity, dtype=jnp.int32)
-                res = jax.lax.sort(operands + [payload],
-                                   num_keys=len(operands))
+                res = lex_sort(operands, payload)
                 idx = res[-1][:kcap]
                 n_out = jnp.minimum(n_live, jnp.asarray(k, jnp.int32))
                 out_live = jnp.arange(kcap, dtype=jnp.int32) < n_out

@@ -42,6 +42,13 @@ conf key referenced by a typo'd string — this lint can.  Rules (RL-*):
   ``os.replace``/``os.rename`` promotion belongs to the committer
   alone. ``committer.py`` itself and ``filecache.py`` (cache files are
   not table output) are exempt.
+* RL-KERNEL-HOST — the Pallas kernel layer (``kernels/``) is pure
+  device code that executes INSIDE other traces: any numpy
+  materialization (``import numpy`` at all) or host synchronization
+  (``jax.device_get``, ``host_fetch``, ``.block_until_ready()``)
+  there would stall the trace or smuggle device data to the host
+  mid-kernel. Sanctioned exceptions go in ``_KERNEL_HOST_ALLOWLIST``
+  with a justification (same hook shape as RL-MESH-HOST).
 """
 
 from __future__ import annotations
@@ -56,7 +63,7 @@ from spark_rapids_tpu.lint.diagnostics import Diagnostic, make
 #: directories (under spark_rapids_tpu/) whose modules are device layers
 #: and may import jax.numpy
 _DEVICE_DIRS = ("execs", "ops", "columnar", "parallel", "runtime",
-                "shuffle", "shims", "models")
+                "shuffle", "shims", "models", "kernels")
 #: top-level device-layer files
 _DEVICE_FILES = ("dispatch.py", "udf.py")
 
@@ -491,6 +498,17 @@ def _check_write_commit(rel: str, tree: ast.AST,
     walk(tree, False)
 
 
+def _host_sync_call(chain: str) -> bool:
+    """THE host-synchronization call set shared by the device-residency
+    rules (RL-MESH-HOST and RL-KERNEL-HOST walk different scopes but
+    must agree on what a host sync IS — a spelling added to one and not
+    the other would silently diverge)."""
+    return ((chain.endswith("device_get") and chain.startswith(
+                ("jax.", "jax")))
+            or chain == "host_fetch" or chain.endswith(".host_fetch")
+            or chain.endswith(".block_until_ready"))
+
+
 #: sanctioned mesh->host materialization points: "<rel>:<function>" ->
 #: justification. The hook for new gather points — add an entry HERE
 #: with a reason, never a bare suppression.
@@ -545,16 +563,61 @@ def _check_mesh_host(rel: str, tree: ast.AST, diags: List[Diagnostic]):
                 # bare 'asarray' covers `from numpy import asarray`;
                 # np.array() forces the same device->host copy
                 flag(node, f"{chain}()", func)
-            elif chain.endswith("device_get") and chain.startswith(
-                    ("jax.", "jax")):
-                flag(node, f"{chain}()", func)
-            elif chain == "host_fetch" or chain.endswith(".host_fetch"):
-                flag(node, f"{chain}()", func)
-            elif chain.endswith(".block_until_ready"):
+            elif _host_sync_call(chain):
                 flag(node, f"{chain}()", func)
         elif isinstance(node, ast.Attribute) \
                 and node.attr == "addressable_shards":
             flag(node, ".addressable_shards read", func)
+        for child in ast.iter_child_nodes(node):
+            walk(child, func)
+
+    walk(tree, None)
+
+
+#: sanctioned host-side operations inside kernels/:
+#: "<rel>:<qualified function>" -> justification. The hook for new
+#: exceptions — add an entry HERE with a reason, never a bare
+#: suppression.
+_KERNEL_HOST_ALLOWLIST = {}
+
+
+def _check_kernel_host(rel: str, tree: ast.AST, diags: List[Diagnostic]):
+    """RL-KERNEL-HOST: kernels/ modules run inside other traces — no
+    numpy at all (materialization happens the moment an np.* call sees
+    a device array) and no host syncs. The static guard for 'a Pallas
+    primitive never stalls the program that embeds it'."""
+    if not rel.startswith("spark_rapids_tpu/kernels/"):
+        return
+
+    def flag(node, what: str, func: Optional[str]):
+        if f"{rel}:{func}" in _KERNEL_HOST_ALLOWLIST:
+            return
+        diags.append(make(
+            "RL-KERNEL-HOST", f"{rel}:{node.lineno}",
+            f"{what} in the Pallas kernel layer"
+            + (f" (function {func!r})" if func else " (module level)")
+            + " — kernels/ is pure device code traced into other "
+            "programs; keep host work at the dispatch sites or "
+            "allowlist the function in _KERNEL_HOST_ALLOWLIST with a "
+            "justification"))
+
+    def walk(node, func: Optional[str]):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            func = f"{func}.{node.name}" if func else node.name
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            mod = getattr(node, "module", None)
+            names = [a.name for a in node.names]
+            if mod == "numpy" or "numpy" in names \
+                    or any(n.startswith("numpy.") for n in names) \
+                    or (mod or "").startswith("numpy."):
+                flag(node, "numpy import", func)
+        elif isinstance(node, ast.Call):
+            chain = _attr_chain(node.func)
+            if chain.startswith(("np.", "numpy.")):
+                flag(node, f"{chain}()", func)
+            elif _host_sync_call(chain):
+                flag(node, f"{chain}()", func)
         for child in ast.iter_child_nodes(node):
             walk(child, func)
 
@@ -609,6 +672,7 @@ def lint_repo(repo_root: Optional[str] = None) -> List[Diagnostic]:
         _check_thread_shared(rel, tree, diags)
         _check_write_commit(rel, tree, diags)
         _check_mesh_host(rel, tree, diags)
+        _check_kernel_host(rel, tree, diags)
         _check_fault_sites(rel, tree, fault_calls, diags)
     _check_fault_registry(fault_calls, diags)
     return diags

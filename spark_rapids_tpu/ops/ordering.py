@@ -32,13 +32,11 @@ from typing import List
 import jax
 import jax.numpy as jnp
 
-
-def _f32_sortable_u32(x) -> jax.Array:
-    """Monotone map f32 -> u32 (IEEE sortable-bits trick)."""
-    b = jax.lax.bitcast_convert_type(x, jnp.int32)
-    return jnp.where(b < 0,
-                     (~b).astype(jnp.uint32),
-                     b.astype(jnp.uint32) | jnp.uint32(0x80000000))
+from spark_rapids_tpu.ops.limbs import (
+    f32_sortable_u32 as _f32_sortable_u32,
+    split_f64_hi_lo,
+    split_i64_hi_lo,
+)
 
 
 def _canon_float(d):
@@ -66,8 +64,7 @@ def comparable_operands(data) -> List[jax.Array]:
                 ((lo >> 32) & 0xFFFFFFFF).astype(jnp.uint32),
                 (lo & 0xFFFFFFFF).astype(jnp.uint32)]
     if d.dtype == jnp.int64:
-        return [(d >> 32).astype(jnp.int32),
-                (d & 0xFFFFFFFF).astype(jnp.uint32)]
+        return list(split_i64_hi_lo(d))
     if d.dtype == jnp.float64:
         d = _canon_float(d)
         if jax.default_backend() == "cpu":
@@ -80,7 +77,6 @@ def comparable_operands(data) -> List[jax.Array]:
                              raw ^ jnp.int64(-0x8000000000000000))
             return [((bits >> 32) & 0xFFFFFFFF).astype(jnp.uint32),
                     (bits & 0xFFFFFFFF).astype(jnp.uint32)]
-        from spark_rapids_tpu.ops.segsum import split_f64_hi_lo
         hi, lo = split_f64_hi_lo(d)
         return [_f32_sortable_u32(hi), _f32_sortable_u32(lo)]
     if d.dtype == jnp.float32:
@@ -95,6 +91,31 @@ def descending_operands(ops: List[jax.Array]) -> List[jax.Array]:
     both signed i32 and unsigned u32 order component-wise, and equal tuples
     stay equal — so lexicographic order reverses exactly."""
     return [~o for o in ops]
+
+
+def lex_sort(operands: List[jax.Array], payload: jax.Array) -> List[jax.Array]:
+    """THE engine-wide lexicographic sort dispatch point:
+    ``jax.lax.sort(operands + [payload], num_keys=len(operands))`` with
+    the Pallas multi-column sort kernel substituted when the ``sort``
+    primitive is enabled and the shape qualifies (kernels/sort.py).
+
+    ``payload`` must be a UNIQUE i32 row-index iota (every call site
+    passes ``jnp.arange(capacity)``): lax.sort is stable, and the
+    bitonic kernel recovers exactly the stable order by using the
+    payload as the final tiebreak key — so the two paths are
+    bit-identical. Callers whose jitted kernels embed this choice must
+    fold ``kernels.trace_token()`` into their trace cache keys."""
+    from spark_rapids_tpu import kernels
+
+    def hlo():
+        return jax.lax.sort(list(operands) + [payload],
+                            num_keys=len(operands))
+
+    def kern():
+        from spark_rapids_tpu.kernels import sort as ksort
+        return ksort.sort_with_payload(list(operands), payload)
+
+    return kernels.dispatch("sort", kern, hlo)
 
 
 def operands_equal_adjacent(ops: List[jax.Array]) -> jax.Array:

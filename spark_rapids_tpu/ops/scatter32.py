@@ -32,19 +32,21 @@ def scatter_set(out_len: int, tgt, data, mode: str = "drop"):
     shape = (out_len,) + data.shape[1:]
     if not _split_worthwhile(data.dtype):
         return jnp.zeros(shape, data.dtype).at[tgt].set(data, mode=mode)
+    from spark_rapids_tpu.ops.limbs import (
+        combine_f64,
+        combine_i64,
+        split_f64_hi_lo,
+        split_i64_hi_lo,
+    )
     if data.dtype == jnp.float64:
-        from spark_rapids_tpu.ops.segsum import split_f64_hi_lo
         hi, lo = split_f64_hi_lo(data)
         ohi = jnp.zeros(shape, jnp.float32).at[tgt].set(hi, mode=mode)
         olo = jnp.zeros(shape, jnp.float32).at[tgt].set(lo, mode=mode)
-        return ohi.astype(jnp.float64) + olo.astype(jnp.float64)
-    d = data.astype(jnp.int64)
-    hi = (d >> 32).astype(jnp.int32)
-    lo = (d & jnp.int64(0xFFFFFFFF)).astype(jnp.uint32)
+        return combine_f64(ohi, olo)
+    hi, lo = split_i64_hi_lo(data)
     ohi = jnp.zeros(shape, jnp.int32).at[tgt].set(hi, mode=mode)
     olo = jnp.zeros(shape, jnp.uint32).at[tgt].set(lo, mode=mode)
-    out = (ohi.astype(jnp.int64) << 32) | olo.astype(jnp.int64)
-    return out.astype(data.dtype)
+    return combine_i64(ohi, olo).astype(data.dtype)
 
 
 def scatter_pair(out_len: int, tgt, data, validity, mode: str = "drop"):
@@ -52,3 +54,30 @@ def scatter_pair(out_len: int, tgt, data, validity, mode: str = "drop"):
     od = scatter_set(out_len, tgt, data, mode=mode)
     ov = jnp.zeros(out_len, jnp.bool_).at[tgt].set(validity, mode=mode)
     return od, ov
+
+
+def compact_pairs(datas, valids, keep, capacity: int):
+    """THE row-compaction dispatch point: compact every column's
+    (data, validity) to the kept-row prefix. Returns ([(data,
+    validity)...], new_n). The HLO path is the classic per-column
+    scatter_pair loop; with the ``compact`` Pallas kernel enabled the
+    whole table compacts through ONE i32 gather-map scatter plus one
+    fused gather kernel (kernels/compact.py) — bit-identical. Callers
+    whose jitted kernels embed this choice fold
+    ``kernels.trace_token()`` into their trace cache keys."""
+    from spark_rapids_tpu import kernels
+    keep_i = keep.astype(jnp.int32)
+    new_n = jnp.sum(keep_i)
+    pos = jnp.cumsum(keep_i) - 1
+
+    def hlo():
+        tgt = jnp.where(keep, pos, capacity)
+        return [scatter_pair(capacity, tgt, d, v)
+                for d, v in zip(datas, valids)]
+
+    def kern():
+        from spark_rapids_tpu.kernels import compact as kcompact
+        return kcompact.gather_compact(list(datas), list(valids), keep,
+                                       pos, new_n, capacity)
+
+    return kernels.dispatch("compact", kern, hlo), new_n

@@ -34,6 +34,16 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+# the limb split/recombine recipes live in ops/limbs.py (single source
+# of truth for kernels/ and the HLO paths); re-exported here because
+# half the engine historically imported them from this module
+from spark_rapids_tpu.ops.limbs import (  # noqa: F401
+    combine_f64,
+    combine_i64,
+    split_f64_hi_lo,
+    split_i64_hi_lo,
+)
+
 #: rows per f32 partial-sum block — bounds f32 accumulation error
 BLOCK = 1024
 
@@ -57,22 +67,6 @@ def trace_key():
     trace cache keyed on split-sum behavior must include this (a cached
     trace would silently keep a superseded conf value otherwise)."""
     return (BLOCK, MAX_PARTIALS, MATMUL_MAX_SEGMENTS, float(SPLIT_MAX_ABS))
-
-
-def split_f64_hi_lo(x):
-    """EXACT hi/lo f32 decomposition of a device f64 array (TPU f64 storage
-    is an (f32, f32) pair, so x == hi + lo exactly). Non-finite hi (inf from
-    overflow, NaN) gets lo=0 so hi+lo reproduces the special value instead
-    of inf-inf=NaN. Single source of truth for every device-side split
-    (segmented sums here, the d2h pack in columnar/table.py); the numpy
-    staging variant lives in columnar/column.py stage_upload."""
-    hi = x.astype(jnp.float32)
-    lo = jnp.where(jnp.isfinite(hi),
-                   (x - hi.astype(jnp.float64)).astype(jnp.float32), 0.0)
-    # signed zero: -0.0 - (-0.0) = +0.0, and -0.0 + 0.0 = +0.0 would lose
-    # the sign on reconstruction; carry the signed zero in lo too
-    lo = jnp.where(x == 0.0, hi, lo)
-    return hi, lo
 
 
 def resolve_split_mode(conf) -> bool:
@@ -129,10 +123,18 @@ def batched_segment_sum_f64(cols, gid, num_segments: int, capacity: int,
     x = jnp.stack(his + los + abss, axis=1)  # (capacity, 3m)
 
     if num_segments <= MATMUL_MAX_SEGMENTS:
-        oh = jax.nn.one_hot(gid.reshape(nb, block), num_segments,
-                            dtype=jnp.float32)
-        parts = jnp.einsum('nbc,nbg->ngc', x.reshape(nb, block, 3 * m), oh,
-                           precision='highest')
+        def hlo_parts():
+            oh = jax.nn.one_hot(gid.reshape(nb, block), num_segments,
+                                dtype=jnp.float32)
+            return jnp.einsum('nbc,nbg->ngc', x.reshape(nb, block, 3 * m),
+                              oh, precision='highest')
+
+        def kern_parts():
+            from spark_rapids_tpu.kernels import segreduce as kseg
+            return kseg.onehot_partials(x, gid, num_segments, nb, block)
+
+        from spark_rapids_tpu import kernels
+        parts = kernels.dispatch("segreduce", kern_parts, hlo_parts)
     else:
         blk = jnp.arange(capacity, dtype=jnp.int32) // block
         ids = blk * num_segments + gid
@@ -248,6 +250,28 @@ def segment_minmax_64(is_min: bool, sd, sv, gid, num_segments: int):
     their own has_any). reference: GpuMin/GpuMax in aggregate.scala run
     cudf device reductions; this is the TPU-shaped equivalent."""
     red = jax.ops.segment_min if is_min else jax.ops.segment_max
+
+    def _limb_minmax(hi, lo, use, hi_ident, lo_ident):
+        """(per-segment hi winner, lo tiebreak) — the Pallas fused
+        two-pass kernel when enabled, else the two HLO segment
+        reductions; bit-identical either way (min/max reductions are
+        exactly associative)."""
+        def hlo():
+            mhi = red(jnp.where(use, hi, hi_ident), gid,
+                      num_segments=num_segments)
+            cand = use & (hi == mhi[gid])
+            mlo = red(jnp.where(cand, lo, lo_ident), gid,
+                      num_segments=num_segments)
+            return mhi, mlo
+
+        def kern():
+            from spark_rapids_tpu.kernels import segreduce as kseg
+            return kseg.fused_minmax(is_min, hi, lo, use, gid,
+                                     num_segments, hi_ident, lo_ident)
+
+        from spark_rapids_tpu import kernels
+        return kernels.dispatch("segreduce", kern, hlo)
+
     if sd.dtype == jnp.float64:
         isnan = jnp.isnan(sd) & sv
         use = sv & ~isnan
@@ -255,12 +279,8 @@ def segment_minmax_64(is_min: bool, sd, sv, gid, num_segments: int):
 
         def fast(_):
             ident = jnp.float32(jnp.inf if is_min else -jnp.inf)
-            mhi = red(jnp.where(use, hi, ident), gid,
-                      num_segments=num_segments)
-            cand = use & (hi == mhi[gid])
-            mlo = red(jnp.where(cand, lo, ident), gid,
-                      num_segments=num_segments)
-            return mhi.astype(jnp.float64) + mlo.astype(jnp.float64)
+            mhi, mlo = _limb_minmax(hi, lo, use, ident, ident)
+            return combine_f64(mhi, mlo)
 
         def exact(_):
             ident = jnp.float64(jnp.inf if is_min else -jnp.inf)
@@ -283,15 +303,12 @@ def segment_minmax_64(is_min: bool, sd, sv, gid, num_segments: int):
                                         num_segments=num_segments)
             return jnp.where(any_nan & (n_use == 0), jnp.float64(jnp.nan), out)
         return jnp.where(any_nan, jnp.float64(jnp.nan), out)
-    hi = (sd >> 32).astype(jnp.int32)
-    lo = sd.astype(jnp.uint32)  # truncating cast = low 32 bits
+    hi, lo = split_i64_hi_lo(sd)
     info = jnp.iinfo(jnp.int32)
-    mhi = red(jnp.where(sv, hi, info.max if is_min else info.min), gid,
-              num_segments=num_segments)
-    cand = sv & (hi == mhi[gid])
+    hi_ident = jnp.int32(info.max if is_min else info.min)
     lo_ident = jnp.uint32(0xFFFFFFFF if is_min else 0)
-    mlo = red(jnp.where(cand, lo, lo_ident), gid, num_segments=num_segments)
-    return (mhi.astype(jnp.int64) << 32) | mlo.astype(jnp.int64)
+    mhi, mlo = _limb_minmax(hi, lo, sv, hi_ident, lo_ident)
+    return combine_i64(mhi, mlo)
 
 
 def _unblocked_split_segment_sum(v, gid, num_segments: int):

@@ -277,6 +277,12 @@ def fingerprint(plan, conf, *, strip_literals: bool = False,
     # loss) must not serve plans cached against the old placement
     from spark_rapids_tpu.parallel.mesh import MESH
     h.update(MESH.identity_token().encode())
+    # Pallas kernel demotions are runtime state the conf cannot see
+    # (the kernels.* conf keys fold in above): a cached tree traced
+    # with a kernel embedded must never serve a query after that
+    # primitive demoted to HLO, and vice versa
+    from spark_rapids_tpu import kernels
+    h.update(kernels.demotion_token().encode())
     return h.hexdigest()
 
 

@@ -15,6 +15,13 @@ from spark_rapids_tpu.plan import DataFrame, from_host_table
 from spark_rapids_tpu.plan import nodes as P
 
 
+def _kernel_demotions() -> Dict[str, str]:
+    """Pallas primitive->HLO demotions for the event record (lazy
+    import: the session module must stay importable standalone)."""
+    from spark_rapids_tpu import kernels
+    return kernels.demoted_ops()
+
+
 class _TLQueryState:
     """Per-(session, thread) in-flight query state. A session may run
     queries CONCURRENTLY from query-service worker threads; everything a
@@ -396,7 +403,11 @@ class TpuSession:
             fault_fires={k: v - before_fires.get(k, 0)
                          for k, v in after_fires.items()
                          if v - before_fires.get(k, 0)},
-            demotions=CIRCUIT_BREAKER.demoted_ops(),
+            # exec circuit-breaker demotions + Pallas kernel->HLO
+            # demotions in one map (keys 'pallas:<primitive>'), so the
+            # offline tools see both without a schema change
+            demotions={**CIRCUIT_BREAKER.demoted_ops(),
+                       **_kernel_demotions()},
             spans_summary=summarize_spans(spans, ctx.owner_tid, wall_s),
             fault_replays=int(q.fault_replays or 0),
             service=service_info,

@@ -23,3 +23,25 @@ from spark_rapids_tpu.tools.compare import (  # noqa: F401
     build_compare,
     render_compare,
 )
+
+
+def require_tpu_backend() -> str:
+    """THE --require-tpu gate shared by bench.py and scale_test.py:
+    resolve the JAX backend (initializes it — call only after any
+    virtual-device/mesh environment setup) and exit 2 with a
+    machine-readable error when it is 'cpu'. Returns the backend name.
+    Exists because BENCH_r06 silently committed CPU-backend numbers: a
+    perf run that meant to hit the TPU must fail loudly, with one
+    error contract, not two hand-synced copies."""
+    import json
+    import sys
+
+    import jax
+    backend = jax.default_backend()
+    if backend == "cpu":
+        print(json.dumps({
+            "error": "backend is 'cpu' but --require-tpu was given "
+                     "(no TPU backend resolved)",
+            "backend": backend}))
+        sys.exit(2)
+    return backend

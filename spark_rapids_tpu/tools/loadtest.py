@@ -386,10 +386,14 @@ def run_loadtest(sf: float = 0.05, seed: int = 0, queries=None,
             failures.append(
                 f"service did not return to HEALTHY: {svc_health}")
 
+    import jax
     report = {
         "mode": "loadtest",
         "scaleFactor": sf,
         "seed": seed,
+        # which backend these numbers measured (the BENCH_r06 lesson:
+        # a CPU-backend artifact must say so in-band, not in prose)
+        "backend": jax.default_backend(),
         "form": "sql" if use_sql else "dsl",
         "concurrency": concurrency,
         "tenants": tenants,
