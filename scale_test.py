@@ -34,17 +34,25 @@ import json
 import time
 
 
-def build_queries(s, tables):
+def build_queries(s, tables, paths=None):
     """q1-q22: the TPC-H-flavored golden corpus (scan/filter/agg/join/
     window mix; the lint plan verifier and test_lint run over every one
-    of these in both DSL and SQL form)."""
+    of these in both DSL and SQL form). With ``paths`` (the --hosts
+    harness), each table comes from its parquet directory through the
+    file-scan path instead of an in-memory HostTable — same queries,
+    but scans can partition their source files BY HOST."""
     from spark_rapids_tpu import functions as F
     from spark_rapids_tpu.ops.expr import col, lit
     from spark_rapids_tpu.plan import from_host_table
 
-    cust = lambda: from_host_table(tables["customer"], s)  # noqa: E731
-    orders = lambda: from_host_table(tables["orders"], s)  # noqa: E731
-    li = lambda: from_host_table(tables["lineitem"], s)    # noqa: E731
+    if paths is not None:
+        cust = lambda: s.read_parquet(paths["customer"])   # noqa: E731
+        orders = lambda: s.read_parquet(paths["orders"])   # noqa: E731
+        li = lambda: s.read_parquet(paths["lineitem"])     # noqa: E731
+    else:
+        cust = lambda: from_host_table(tables["customer"], s)  # noqa: E731
+        orders = lambda: from_host_table(tables["orders"], s)  # noqa: E731
+        li = lambda: from_host_table(tables["lineitem"], s)    # noqa: E731
 
     def q1():  # pricing summary (TPC-H q1 shape)
         import datetime as _dt
@@ -462,13 +470,19 @@ def sql_texts():
     }
 
 
-def build_sql_queries(s, tables):
+def build_sql_queries(s, tables, paths=None):
     """q1-q22 from SQL text via session.sql() over temp views (--sql
     mode): same queries as build_queries, entering through the parser ->
-    analyzer -> plan layer instead of the DataFrame DSL."""
+    analyzer -> plan layer instead of the DataFrame DSL. With ``paths``
+    the views sit over parquet scans (the --hosts harness) instead of
+    in-memory tables."""
     from spark_rapids_tpu.plan import from_host_table
-    for name, table in tables.items():
-        from_host_table(table, s).create_or_replace_temp_view(name)
+    if paths is not None:
+        for name, tdir in paths.items():
+            s.read_parquet(tdir).create_or_replace_temp_view(name)
+    else:
+        for name, table in tables.items():
+            from_host_table(table, s).create_or_replace_temp_view(name)
     return {name: (lambda text=text: s.sql(text))
             for name, text in sql_texts().items()}
 
@@ -1367,6 +1381,375 @@ def run_mesh(sf: float, seed: int, ndev: int, queries=None,
     return report
 
 
+# ---------------------------------------------------------------------------
+# Multi-host mode: the corpus over the driver/executor protocol,
+# bit-identical to single-process (runtime/cluster.py)
+# ---------------------------------------------------------------------------
+
+
+def write_host_corpus(tables, base_dir, files_per_table: int) -> dict:
+    """Write each generated table as ``files_per_table`` parquet files
+    (contiguous row slices, one file per chunk subdir so the sorted
+    file walk preserves row order) — the source-file layout the
+    by-host scan partitioner distributes. Returns name -> table dir."""
+    import os
+
+    from spark_rapids_tpu.io.parquet import write_parquet
+    paths = {}
+    for name, table in tables.items():
+        tdir = os.path.join(base_dir, name)
+        n = table.num_rows
+        chunk = max(1, (n + files_per_table - 1) // files_per_table)
+        start = i = 0
+        while start < n:
+            write_parquet(table.slice(start, min(chunk, n - start)),
+                          os.path.join(tdir, f"c{i:03d}"))
+            start += chunk
+            i += 1
+        paths[name] = tdir
+    return paths
+
+
+def host_chaos_fault_spec(seed: int) -> str:
+    """The seeded HOST-fault schedule: every ``host.*`` point fires at
+    least once (asserted by run_hosts), exercising the full ladder
+    surface — dispatch crash (query replay), corrupt shard landings
+    (CRC-caught re-lands), injected host losses walking retry ->
+    re-land-on-survivors, DCN-exchange faults, and dropped executor
+    heartbeats. COUNT-based entries only, so the schedule is
+    deterministic and the end-of-run restore probe runs fault-free.
+    The scripted mid-corpus host KILL (a real SIGKILL of an executor
+    process) rides on top of this schedule."""
+    return ";".join([
+        # raising kinds get their own points: co-located raising
+        # entries mask each other (the first raise wins the call and
+        # the other's schedule is consumed), so corrupt lives ALONE on
+        # the landing point — its CRC-retry path must actually run
+        f"host.dispatch:crash:1:{seed * 10 + 1}",
+        f"host.dispatch:device_lost:3:{seed * 10 + 2}",
+        f"host.shard.land:corrupt:2:{seed * 10 + 3}",
+        f"host.dcn.exchange:slow:1:{seed * 10 + 4}",
+        f"host.dcn.exchange:crash:1:{seed * 10 + 5}",
+        f"host.heartbeat:crash:2:{seed * 10 + 6}",
+    ])
+
+
+#: whole-run recovery-work ceilings for the host chaos closure
+HOST_CHAOS_BOUNDS = {"query_replays": 30, "hostShardRetries": 20,
+                     "hostsLost": 10, "fetch_retries": 100}
+
+#: harness heartbeat settings: a VERY generous missed-beat budget —
+#: the driver shares its process with jax compilation, which can hold
+#: the GIL for whole seconds at a time, and a spurious eviction would
+#: walk the ladder for no reason. A real SIGKILL is still detected
+#: promptly through the beat-connection EOF path, not this window.
+_HOSTS_HEARTBEAT_MS = 250
+_HOSTS_MISSED_BEATS = 120
+
+
+def _boot_cluster(nhosts: int):
+    """Driver + N subprocess executors, registered and attached."""
+    from spark_rapids_tpu.conf import RapidsConf
+    from spark_rapids_tpu.runtime.cluster import (
+        CLUSTER,
+        ClusterDriver,
+        spawn_executor,
+    )
+    driver = ClusterDriver(nhosts, RapidsConf({
+        "spark.rapids.cluster.heartbeatIntervalMs":
+            str(_HOSTS_HEARTBEAT_MS),
+        "spark.rapids.cluster.missedBeats": str(_HOSTS_MISSED_BEATS),
+    }))
+    executors = {
+        f"h{i}": spawn_executor(driver.address, f"h{i}",
+                                heartbeat_ms=_HOSTS_HEARTBEAT_MS,
+                                mode="process")
+        for i in range(nhosts)}
+    driver.wait_ready(nhosts, timeout_s=120.0)
+    CLUSTER.attach_driver(driver)
+    return driver, executors
+
+
+def _teardown_cluster(driver, executors) -> None:
+    from spark_rapids_tpu.runtime.cluster import CLUSTER
+    CLUSTER.attach_driver(None)
+    driver.shutdown()
+    for h in executors.values():
+        try:
+            h.terminate()
+        except Exception:
+            pass
+
+
+def _wait_for(predicate, timeout_s: float) -> bool:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.1)
+    return predicate()
+
+
+def run_hosts(sf: float, seed: int, nhosts: int, queries=None,
+              use_sql: bool = False, chaos: bool = False):
+    """``--hosts N [--chaos]``: q1-q22 through the multi-process
+    simulation harness — N REAL executor subprocesses scanning their
+    by-host file assignments and shipping shards back over the
+    driver/executor socket protocol, the corpus running mesh-native on
+    the hierarchical (hosts x devices-per-host) mesh so all-to-alls
+    physically model ICI-within-a-host / DCN-across. Asserts every
+    query bit-identical to a fault-free single-process run over the
+    SAME files.
+
+    With ``chaos``, the corpus additionally runs under the seeded
+    ``host.*`` fault schedule PLUS a scripted mid-corpus host KILL
+    (SIGKILL of one executor): the missed-beat sweep must declare the
+    host lost, scans must re-land its shards onto survivors, the
+    respawned executor must REJOIN through the heartbeat re-register
+    path, and the end-of-run restore probe must return the topology to
+    full strength — the MULTIHOST_r01 acceptance harness."""
+    _ensure_host_mesh(8)
+    import os
+    import tempfile
+
+    import jax
+
+    from spark_rapids_tpu.datagen import scale_test_specs
+    from spark_rapids_tpu.obs.metrics import scopes_snapshot
+    from spark_rapids_tpu.runtime.cluster import CLUSTER
+    from spark_rapids_tpu.runtime.faults import (
+        CIRCUIT_BREAKER,
+        FAULTS,
+        RECOVERY,
+    )
+    from spark_rapids_tpu.runtime.health import HEALTH
+    from spark_rapids_tpu.session import TpuSession
+
+    ndev = len(jax.devices())
+    if ndev % nhosts:
+        raise SystemExit(
+            f"--hosts {nhosts} must divide the {ndev}-device pool so "
+            f"every host owns an equal dcn row")
+    shape = f"{nhosts}x{ndev // nhosts}"
+
+    specs = scale_test_specs(sf)
+    tables = {name: spec.generate_table(sf, seed=seed)
+              for name, spec in specs.items()}
+    base = tempfile.mkdtemp(prefix="rapids_hosts_")
+    paths = write_host_corpus(tables, base, files_per_table=2 * nhosts)
+
+    spec = host_chaos_fault_spec(seed) if chaos else ""
+    driver, executors = _boot_cluster(nhosts)
+    report = {"mode": "hosts-chaos" if chaos else "hosts",
+              "hosts": nhosts, "n_devices": ndev, "mesh_shape": shape,
+              "backend": _resolved_backend(), "scale_factor": sf,
+              "seed": seed, "sql": use_sql, "corpus_dir": base,
+              "files_per_table": 2 * nhosts, "queries": {}}
+    failures = []
+    try:
+        single = TpuSession()
+        conf = {
+            "spark.rapids.cluster.enabled": "true",
+            "spark.rapids.cluster.hosts": str(nhosts),
+            "spark.rapids.cluster.heartbeatIntervalMs":
+                str(_HOSTS_HEARTBEAT_MS),
+            "spark.rapids.cluster.missedBeats":
+                str(_HOSTS_MISSED_BEATS),
+            "spark.rapids.mesh.enabled": "true",
+            "spark.rapids.mesh.shape": shape,
+            "spark.rapids.sql.runtimeFallback.enabled": "true",
+        }
+        if spec:
+            conf["spark.rapids.test.faults"] = spec
+            report["fault_spec"] = spec
+        clus = TpuSession(conf)
+        build = build_sql_queries if use_sql else build_queries
+        single_queries = build(single, tables, paths=paths)
+        clus_queries = build(clus, tables, paths=paths)
+        wanted = queries or list(single_queries)
+        # the collective-bearing query runs FIRST (run_mesh_chaos's
+        # discipline): the dcn-exchange fault points must see traffic
+        # before the ladder may legitimately degrade the topology
+        wanted = sorted(wanted, key=lambda n: (n != "q7",
+                                               wanted.index(n)))
+        # ALL fault-free baselines first: the seeded schedule must
+        # advance uninterrupted across the chaotic corpus
+        expected_tables = {name: single_queries[name]().collect_table()
+                           for name in wanted}
+
+        recovery_before = RECOVERY.snapshot()
+        cluster_before_all = dict(
+            scopes_snapshot().get("cluster", {}))
+        # the kill lands mid-corpus and the rejoin ALWAYS fits before
+        # the last query — a --queries subset too short for the script
+        # must not leave the victim dead into the closure assertions
+        kill_at = min(len(wanted) // 2,
+                      len(wanted) - 2) if chaos else None
+        rejoin_at = (min(kill_at + 2, len(wanted) - 1)
+                     if chaos and kill_at is not None and kill_at >= 0
+                     else None)
+        if chaos and (kill_at is None or kill_at < 0
+                      or rejoin_at <= kill_at):
+            kill_at = rejoin_at = None  # corpus too short to script
+        victim = f"h{nhosts - 1}"
+        kill_info = {}
+        for qi, name in enumerate(wanted):
+            if chaos and qi == kill_at:
+                # scripted mid-corpus HOST KILL: a real SIGKILL; the
+                # missed-beat sweep must declare the host lost
+                t0 = time.time()
+                executors[victim].terminate()
+                detected = _wait_for(
+                    lambda: victim in CLUSTER.health_snapshot()[
+                        "lostHosts"]
+                    or victim in CLUSTER.health_snapshot()[
+                        "excludedHosts"],
+                    timeout_s=30.0)
+                kill_info = {"host": victim, "atQuery": name,
+                             "detected": detected,
+                             "detectS": round(time.time() - t0, 3)}
+                if not detected:
+                    failures.append(
+                        f"killed host {victim} never declared lost by "
+                        f"the heartbeat sweep")
+            if chaos and qi == rejoin_at:
+                # respawn: the fresh registration is the rejoin path
+                t0 = time.time()
+                from spark_rapids_tpu.runtime.cluster import (
+                    spawn_executor,
+                )
+                executors[victim] = spawn_executor(
+                    driver.address, victim,
+                    heartbeat_ms=_HOSTS_HEARTBEAT_MS, mode="process")
+                rejoined = _wait_for(
+                    lambda: victim not in CLUSTER.health_snapshot()[
+                        "lostHosts"]
+                    and victim not in CLUSTER.health_snapshot()[
+                        "excludedHosts"],
+                    timeout_s=60.0)
+                kill_info["rejoined"] = rejoined
+                kill_info["rejoinS"] = round(time.time() - t0, 3)
+                if not rejoined:
+                    failures.append(
+                        f"respawned host {victim} never rejoined the "
+                        f"topology")
+            before_c = dict(scopes_snapshot().get("cluster", {}))
+            before_h = HEALTH.host_snapshot()
+            fires_before = FAULTS.counters()
+            t0 = time.perf_counter()
+            got = clus_queries[name]().collect_table()
+            wall = time.perf_counter() - t0
+            after_c = dict(scopes_snapshot().get("cluster", {}))
+            after_h = HEALTH.host_snapshot()
+            diff = tables_differ(expected_tables[name], got)
+            recollected = False
+            if diff is not None and (CIRCUIT_BREAKER.demoted_ops()
+                                     or HEALTH.state() != "HEALTHY"):
+                with FAULTS.suspended():
+                    redo = single_queries[name]().collect_table()
+                diff = tables_differ(redo, got)
+                recollected = True
+            entry = {
+                "chaos_s" if chaos else "wall_s": round(wall, 4),
+                "identical": diff is None,
+                "cluster": {k: int(after_c.get(k, 0)
+                                   - before_c.get(k, 0))
+                            for k in ("hostShardsLanded", "hostsLost",
+                                      "hostRelands", "hostShrinks",
+                                      "hostRestores", "dcnExchanges",
+                                      "hostShardRetries",
+                                      "executorBeatsDropped",
+                                      "clusterScanFallbacks")
+                            if after_c.get(k, 0) != before_c.get(k, 0)},
+                "ladder": {k: int(after_h[k] - before_h[k])
+                           for k in after_h
+                           if after_h[k] != before_h[k]},
+                "host_topology": CLUSTER.topology_str(),
+            }
+            if chaos:
+                entry["fault_fires"] = {
+                    k: v - fires_before.get(k, 0)
+                    for k, v in FAULTS.counters().items()
+                    if v - fires_before.get(k, 0)}
+            if recollected:
+                entry["compared_vs_demoted_baseline"] = True
+            if diff is not None:
+                failures.append(f"{name}: {diff}")
+            report["queries"][name] = entry
+            print(json.dumps({"query": name, **entry}))
+        if chaos:
+            report["kill"] = kill_info
+
+        # -- closure assertions ----------------------------------------------
+        fires = FAULTS.counters()
+        if chaos:
+            armed_points = {e.split(":")[0] for e in spec.split(";")}
+            for point in sorted(armed_points):
+                if not fires.get(point):
+                    failures.append(
+                        f"armed host fault point {point} never fired — "
+                        f"the schedule does not cover the multi-host "
+                        f"path")
+            report["fault_fires_total"] = dict(fires)
+        recovery = {k: v - recovery_before[k]
+                    for k, v in RECOVERY.snapshot().items()}
+        cluster_after_all = dict(scopes_snapshot().get("cluster", {}))
+        for k in ("hostShardRetries", "hostsLost"):
+            recovery[k] = int(cluster_after_all.get(k, 0)
+                              - cluster_before_all.get(k, 0))
+        report["recovery"] = recovery
+        if chaos:
+            for field, bound in HOST_CHAOS_BOUNDS.items():
+                if recovery.get(field, 0) > bound:
+                    failures.append(
+                        f"{field}={recovery[field]} exceeds the host "
+                        f"chaos bound {bound}")
+        report["cluster_totals"] = {
+            k: int(cluster_after_all.get(k, 0)
+                   - cluster_before_all.get(k, 0))
+            for k in sorted(cluster_after_all)}
+        report["ladder"] = HEALTH.host_snapshot()
+
+        # -- end state: full strength, or restore and prove it ---------------
+        end_state = CLUSTER.health_snapshot()
+        report["hosts_end_state"] = end_state
+        if (end_state["lostHosts"] or end_state["excludedHosts"]
+                or end_state["singleProcessReason"]):
+            # the count-based schedule is spent: restore and probe —
+            # a topology that cannot return to full strength after the
+            # faults stopped is a real (reported) problem
+            CLUSTER.restore()
+            probe = wanted[0]
+            with FAULTS.suspended():
+                redo = single_queries[probe]().collect_table()
+                got = clus_queries[probe]().collect_table()
+            restored = CLUSTER.health_snapshot()
+            report["restore_probe"] = {
+                "query": probe,
+                "identical": tables_differ(redo, got) is None,
+                "hosts": restored,
+            }
+            if tables_differ(redo, got) is not None:
+                failures.append(f"restore probe {probe} diverged")
+            if (restored["lostHosts"] or restored["excludedHosts"]
+                    or restored["singleProcessReason"]):
+                failures.append(
+                    "cluster did not return to full strength after "
+                    f"restore: {restored}")
+        report["demoted_ops"] = CIRCUIT_BREAKER.demoted_ops()
+        report["health_state"] = HEALTH.state()
+    finally:
+        FAULTS.disarm()
+        _teardown_cluster(driver, executors)
+    report["ok"] = not failures
+    report["failures"] = failures
+    if failures:
+        err = AssertionError("hosts run failed:\n" + "\n".join(failures))
+        err.report = report
+        raise err
+    return report
+
+
 def run_concurrent(sf: float, seed: int, queries=None, use_sql=False,
                    concurrency: int = 4, tenants: int = 2,
                    eventlog_dir=None):
@@ -1387,7 +1770,7 @@ def run_concurrent(sf: float, seed: int, queries=None, use_sql=False,
 SUPPORTED_MODES = (
     "supported modes: (default timing run) | --cpu-baseline | "
     "--chaos [--concurrency N [--service-faults]] | --concurrency N | "
-    "--mesh N [--mesh-shape DxI] [--chaos]")
+    "--mesh N [--mesh-shape DxI] [--chaos] | --hosts N [--chaos]")
 
 
 def _resolved_backend() -> str:
@@ -1422,6 +1805,30 @@ def validate_flags(args) -> None:
                 "harness pins virtual host-platform (cpu) devices, and "
                 "the gate would initialize the backend before the "
                 "device-count flag can take effect")
+    if args.hosts:
+        if args.hosts < 2:
+            bad(f"--hosts {args.hosts}: a cluster needs at least 2 "
+                "executor hosts")
+        if args.mesh:
+            bad("--hosts does not compose with --mesh: the hosts "
+                "harness builds its own hierarchical (hosts x "
+                "devices-per-host) mesh")
+        if args.concurrency:
+            bad("--hosts does not compose with --concurrency: the "
+                "hosts harness asserts per-query bit-identity "
+                "serially")
+        if args.service_faults:
+            bad("--hosts does not compose with --service-faults: "
+                "service-level faults need --chaos --concurrency N")
+        if args.cpu_baseline:
+            bad("--hosts does not compose with --cpu-baseline: the "
+                "hosts baseline is fault-free single-process over the "
+                "same files, not the CPU path")
+        if args.require_tpu:
+            bad("--hosts does not compose with --require-tpu: the "
+                "hosts harness pins virtual host-platform (cpu) "
+                "devices, and the gate would initialize the backend "
+                "before the device-count flag can take effect")
     if args.service_faults and not (args.chaos and args.concurrency > 1):
         bad("--service-faults needs --chaos --concurrency > 1 (the "
             "service fault points live in the worker/watchdog "
@@ -1484,6 +1891,17 @@ def main():
     ap.add_argument("--mesh-shape", type=str, default="",
                     help="with --mesh: explicit spark.rapids.mesh.shape "
                          "('8' or '2x4'; default N on one flat axis)")
+    ap.add_argument("--hosts", type=int, default=0, metavar="N",
+                    help="run the corpus through the MULTI-HOST "
+                         "simulation harness: N executor subprocesses "
+                         "scan their by-host parquet assignments and "
+                         "ship shards over the driver/executor socket "
+                         "protocol, the corpus mesh-native on the "
+                         "hierarchical (N x dev/N) mesh, asserting "
+                         "bit-identity vs single-process over the same "
+                         "files; with --chaos, adds the seeded host.* "
+                         "fault schedule plus a scripted mid-corpus "
+                         "host KILL + rejoin restore (MULTIHOST_r01)")
     ap.add_argument("--require-tpu", action="store_true",
                     help="exit non-zero when the resolved JAX backend is "
                          "'cpu' — a perf run that meant to hit the TPU "
@@ -1500,6 +1918,30 @@ def main():
     if args.require_tpu:
         from spark_rapids_tpu.tools import require_tpu_backend
         require_tpu_backend()
+
+    if args.hosts:
+        wanted = [q.strip() for q in args.queries.split(",") if q.strip()]
+
+        def dump_hosts_report(report):
+            print(json.dumps(report))
+            if args.out:
+                with open(args.out, "w") as f:
+                    json.dump(report, f, indent=1)
+
+        try:
+            report = run_hosts(
+                sf=args.sf if args.sf is not None else (
+                    0.02 if args.chaos else 0.05),
+                seed=args.seed if args.seed is not None else (
+                    7 if args.chaos else 0),
+                nhosts=args.hosts, queries=wanted or None,
+                use_sql=args.sql, chaos=args.chaos)
+        except AssertionError as e:
+            if getattr(e, "report", None) is not None:
+                dump_hosts_report(e.report)
+            raise SystemExit(f"FAILED: {e}")
+        dump_hosts_report(report)
+        return
 
     if args.mesh:
         wanted = [q.strip() for q in args.queries.split(",") if q.strip()]

@@ -137,6 +137,25 @@ class MeshDeviceLostError(DeviceLostError):
         self.device_id = device_id
 
 
+class HostLostError(DeviceLostError):
+    """A whole executor HOST (process) of the cluster died or went
+    unreachable — a dead dispatch socket, a missed-heartbeat eviction,
+    or an injected ``device_lost`` at a ``host.*`` fault point.
+    Classified DISTINCTLY from whole-backend :class:`DeviceLostError`
+    (the local backend is fine) and from partial
+    :class:`MeshDeviceLostError` (a device died, not a process):
+    recovery walks the HOST degradation ladder (runtime/health.py
+    ``on_host_loss``: retry → re-land the dead host's shards onto
+    survivors → shrink the dcn axis → single-process fallback →
+    escalate to the whole-backend ladder). Carries ``host_id`` when
+    the failing host is known (None for injected losses — the ladder
+    then marks the last usable host)."""
+
+    def __init__(self, message: str, host_id=None):
+        super().__init__(message)
+        self.host_id = host_id
+
+
 class MeshGatherError(KernelCrashError):
     """The row-count + checksum validation at a mesh gather boundary
     (MeshReland / the ICI exchange's live-count fetch — the TPAK-v2

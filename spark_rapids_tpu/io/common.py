@@ -351,6 +351,17 @@ class FileScanNode(PlanNode):
             from spark_rapids_tpu.plan.nodes import _empty_table
             yield _empty_table(self.output_schema())
             return
+        # multi-host cluster routing (runtime/cluster.py): with an
+        # active cluster, source files partition BY HOST and each
+        # executor process scans only its subset, shipping the decoded
+        # shards back over the driver/executor wire — batch-per-file in
+        # path order, byte-identical to the local PERFILE walk below.
+        # Inactive/unroutable scans fall through to the local modes.
+        from spark_rapids_tpu.runtime.cluster import CLUSTER
+        routed = CLUSTER.scan_route(self, paths)
+        if routed is not None:
+            yield from routed
+            return
         mode = self.reader_type
         if mode == ReaderMode.AUTO:
             mode = (ReaderMode.MULTITHREADED if len(paths) > 1

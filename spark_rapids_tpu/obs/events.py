@@ -84,7 +84,19 @@ EVENT_LOG_DIR = str_conf(
 #: served) — the latter two per-record DELTAS of the ``mesh`` scope.
 #: All 0 on a healthy mesh (and off-mesh); result-cache serves carry
 #: 0/0/0 (nothing gathered).
-EVENT_SCHEMA_VERSION = 7
+#: v8 (multi-host fault-domain PR): + hostTopology (the active cluster
+#: host topology at record time — '2' at full strength, '1/2' with a
+#: host lost/excluded, '0/2' under the single-process latch; null when
+#: cluster execution is off), hostsLost (executor hosts declared lost
+#: during this query's wall — missed-beat sweep, dead dispatch socket,
+#: or the host ladder's re-land rung), hostRelands (scans that
+#: re-assigned a lost host's source files onto survivors) and
+#: dcnExchanges (shuffle collectives whose mesh spanned more than one
+#: cluster host group — the all-to-all crossed the DCN axis) — the
+#: last three per-record DELTAS of the new ``cluster`` scope
+#: (runtime/cluster.py). All 0/null off-cluster; result-cache serves
+#: carry the serve-time hostTopology and 0/0/0.
+EVENT_SCHEMA_VERSION = 8
 
 
 def plan_tree(executable) -> dict:
@@ -205,7 +217,11 @@ def build_query_record(*, query_index: int, wall_s: float,
                        ici_bytes: int = 0,
                        mesh_degradations: int = 0,
                        shard_retries: int = 0,
-                       gather_checks_failed: int = 0) -> dict:
+                       gather_checks_failed: int = 0,
+                       host_topology: Optional[str] = None,
+                       hosts_lost: int = 0,
+                       host_relands: int = 0,
+                       dcn_exchanges: int = 0) -> dict:
     """Assemble one event-log record. Every field is JSON-native; the
     golden schema test normalizes timings and pins the shape.
     ``service`` is the query-service envelope (tenant, pool, queueWaitS,
@@ -250,6 +266,10 @@ def build_query_record(*, query_index: int, wall_s: float,
         "meshDegradations": int(mesh_degradations),
         "shardRetries": int(shard_retries),
         "gatherChecksFailed": int(gather_checks_failed),
+        "hostTopology": host_topology,
+        "hostsLost": int(hosts_lost),
+        "hostRelands": int(host_relands),
+        "dcnExchanges": int(dcn_exchanges),
         "faultReplays": fault_replays,
         "plan": plan_tree(executable),
         "fallbacks": collect_fallbacks(meta),

@@ -346,6 +346,13 @@ class MeshExchange:
         # consumed by the verified counts fetch below (it needs bytes)
         from spark_rapids_tpu.runtime.faults import fault_point
         fault_point("mesh.ici.exchange")
+        # cross-HOST marker: when this exchange's mesh spans more than
+        # one cluster host group the all-to-all crosses the DCN axis —
+        # the host.dcn.exchange fault point fires there (device_lost
+        # raises HostLostError into the host ladder) and dcnExchanges
+        # counts (runtime/cluster.py; no-op without an active cluster)
+        from spark_rapids_tpu.runtime.cluster import dcn_exchange_point
+        dcn_exchange_point(self.mesh)
         out = self._fn(*flat)
         ncols = len(datas)
         return (list(out[:ncols]), list(out[ncols:2 * ncols]),

@@ -331,6 +331,30 @@ class MeshRuntime:
             self._config_key = None
             return True
 
+    def exclude_devices(self, device_ids, reason: str) -> bool:
+        """Evict a whole device GROUP from the mesh fault domain — the
+        cluster layer's host-shrink rung (runtime/cluster.py): a lost
+        HOST takes its entire dcn row of devices with it. Same
+        contract as shrink_excluding: the exclusion folds into the
+        config key, the next configure() rebuilds from the survivors
+        (collapsing to a flat axis when the declared hierarchical
+        shape no longer fits) and bumps the generation. Returns False
+        when the eviction would leave no devices."""
+        ids = frozenset(device_ids)
+        if not ids:
+            return False
+        with self._lock:
+            if self._mesh is None or not self._enabled:
+                return False
+            live = [d.id for d in self._mesh.devices.flat
+                    if d.id not in ids]
+            if not live:
+                return False
+            self._excluded_ids = self._excluded_ids | ids
+            self._degraded_reason = reason
+            self._config_key = None
+            return True
+
     def restore(self, reason: str = "") -> bool:
         """Clear every ladder exclusion (the mesh returns to declared
         strength on the next configure()). Returns whether anything

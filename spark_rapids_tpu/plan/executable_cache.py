@@ -92,10 +92,11 @@ def _demotions_token() -> tuple:
     can neither serve nor re-park under another (its cached device
     tables and sharded layouts reference the old placement)."""
     from spark_rapids_tpu.parallel.mesh import MESH
+    from spark_rapids_tpu.runtime.cluster import CLUSTER
     from spark_rapids_tpu.runtime.faults import CIRCUIT_BREAKER
     from spark_rapids_tpu.runtime.health import HEALTH
     return (tuple(sorted(CIRCUIT_BREAKER.demoted_ops().items())),
-            HEALTH.generation(), MESH.generation())
+            HEALTH.generation(), MESH.generation(), CLUSTER.generation())
 
 
 def _reset_for_reuse(executable) -> None:

@@ -277,6 +277,12 @@ def fingerprint(plan, conf, *, strip_literals: bool = False,
     # loss) must not serve plans cached against the old placement
     from spark_rapids_tpu.parallel.mesh import MESH
     h.update(MESH.identity_token().encode())
+    # host topology token (runtime/cluster.py): the cluster's declared/
+    # lost/excluded host set folds in beyond the spark.rapids.cluster.*
+    # conf keys — a plan cached while host h1 was lost (its scans
+    # re-landed on survivors) must not serve the full-strength topology
+    from spark_rapids_tpu.runtime.cluster import CLUSTER
+    h.update(CLUSTER.identity_token().encode())
     # Pallas kernel demotions are runtime state the conf cannot see
     # (the kernels.* conf keys fold in above): a cached tree traced
     # with a kernel embedded must never serve a query after that

@@ -1,0 +1,12 @@
+"""Executor-process entry point: ``python -m
+spark_rapids_tpu.runtime.cluster_exec``.
+
+A separate module on purpose: running ``runtime/cluster.py`` itself
+with ``-m`` would execute it as ``__main__`` AND import it again as
+``spark_rapids_tpu.runtime.cluster`` from the scan path — two module
+instances, double-registered conf keys. This shim holds no state."""
+
+from spark_rapids_tpu.runtime.cluster import executor_main
+
+if __name__ == "__main__":
+    raise SystemExit(executor_main())

@@ -163,6 +163,34 @@ FAULT_POINTS: Dict[str, tuple] = {
         "spark_rapids_tpu/parallel/exchange.py",
         "replicated string-dictionary upload (interned_dict_bytes), "
         "before the device_put replication across the mesh"),
+    # -- the HOST fault domain: every stage of the multi-host
+    # driver/executor protocol is injectable, and ``device_lost`` at any
+    # ``host.*`` point raises the typed HostLostError (a whole executor
+    # PROCESS died, not a device) that walks the HOST degradation
+    # ladder (runtime/health.py on_host_loss) instead of the mesh
+    # ladder or a whole-backend reinit
+    "host.dispatch": (
+        "spark_rapids_tpu/runtime/cluster.py",
+        "driver->executor scan dispatch, before the request round "
+        "trip (ClusterDriver.scan_host): crash exercises the query-"
+        "replay path, device_lost the host degradation ladder"),
+    "host.shard.land": (
+        "spark_rapids_tpu/runtime/cluster.py",
+        "per host-shard landing of an executor's scan response "
+        "(ClusterDriver.scan): corrupt damages the landed TPAK frame "
+        "and the CRC catches it — the intact received frame re-lands "
+        "(hostShardRetries) instead of feeding a scan garbage rows"),
+    "host.dcn.exchange": (
+        "spark_rapids_tpu/runtime/cluster.py",
+        "before a shuffle collective whose mesh spans more than one "
+        "cluster host group (the all-to-all crosses the DCN axis; "
+        "dcn_exchange_point, called by the ICI exchange)"),
+    "host.heartbeat": (
+        "spark_rapids_tpu/runtime/cluster.py",
+        "executor heartbeat receipt at the driver's ledger: an "
+        "injected fault DROPS the beat (counted) — enough dropped "
+        "beats and the missed-beat sweep declares the host lost, the "
+        "exact path a wedged executor takes"),
 }
 
 _SLOW_SLEEP_S = 0.05
@@ -320,6 +348,14 @@ class FaultRegistry:
                 raise ShuffleTransportError(
                     f"injected transport disconnect at {where}")
             if a.kind == "device_lost":
+                if point.startswith("host."):
+                    # a whole executor PROCESS died (the backend and
+                    # its devices are fine) — the HOST degradation
+                    # ladder (runtime/health.py on_host_loss) owns
+                    # recovery
+                    from spark_rapids_tpu.errors import HostLostError
+                    raise HostLostError(
+                        f"injected host loss at {where}")
                 if point.startswith("mesh."):
                     # PARTIAL loss: one mesh device died, the backend
                     # is otherwise alive — the degradation ladder

@@ -124,6 +124,14 @@ class DeviceHealthMonitor:
         self._mesh_losses = 0
         self._mesh_shrinks = 0
         self._mesh_degradations = 0
+        # -- the host fault domain (a dead executor PROCESS) --------------
+        #: consecutive HOST losses with no cluster-NATIVE success
+        #: between them — drives the host degradation ladder. A success
+        #: achieved with the cluster inactive (suppressed / latched
+        #: single-process) does NOT reset it.
+        self._host_consecutive = 0
+        self._host_losses = 0
+        self._host_shrinks = 0
 
     # -- hot-path reads ------------------------------------------------------
     def cpu_only_reason(self) -> Optional[str]:
@@ -172,20 +180,25 @@ class DeviceHealthMonitor:
             self._reinitialize_backend_locked(conf)
             return "DEGRADED"
 
-    def note_success(self, mesh_native: bool = False) -> None:
+    def note_success(self, mesh_native: bool = False,
+                     cluster_native: bool = False) -> None:
         """A query completed: the device (or the CPU-only path) works,
         so the consecutive-loss budget refills. The MESH ladder only
         resets on a mesh-NATIVE success (``mesh_native``): a query
         that converged under single-device suppression proves nothing
         about the mesh, and resetting on it would ping-pong a truly
         dead device between retry and single-device forever instead of
-        walking down to the shrink rung."""
-        if self._consecutive_losses or (mesh_native
-                                        and self._mesh_consecutive):
+        walking down to the shrink rung. The HOST ladder resets only
+        on a cluster-NATIVE success for the same reason."""
+        if (self._consecutive_losses
+                or (mesh_native and self._mesh_consecutive)
+                or (cluster_native and self._host_consecutive)):
             with self._lock:
                 self._consecutive_losses = 0
                 if mesh_native:
                     self._mesh_consecutive = 0
+                if cluster_native:
+                    self._host_consecutive = 0
 
     def on_mesh_device_loss(self, exc: BaseException, conf) -> str:
         """One observed PARTIAL device loss (a ``mesh.*`` fault point's
@@ -273,6 +286,95 @@ class DeviceHealthMonitor:
                 "meshDegradations": self._mesh_degradations,
             }
 
+    def on_host_loss(self, exc: BaseException, conf) -> str:
+        """One observed HOST loss (a dead executor process — a
+        ``host.*`` fault point's device_lost, a dead dispatch socket,
+        or the missed-beat sweep's verdict surfacing as a typed
+        HostLostError): walk the HOST degradation ladder one rung and
+        return the recovery action the session should take —
+
+        * ``"retry"`` — first consecutive loss: replay the query
+          against the unchanged topology (a dropped message or a
+          transient DCN hiccup is routine across hosts);
+        * ``"reland"`` — second loss: declare the host LOST
+          (CLUSTER.mark_host_lost) and replay — the replay's scans
+          re-land the dead host's shards onto the survivors, and the
+          host rejoins later via the heartbeat re-register path;
+        * ``"shrink"`` — third loss on: evict the host from the
+          topology (CLUSTER.shrink_excluding — its device group
+          leaves the mesh's dcn axis, the generation bump fences
+          every cached tree), bounded by
+          spark.rapids.cluster.maxHostLosses;
+        * ``"single_process"`` — shrink budget spent (or one host
+          left): latch single-process fallback — every scan lands
+          locally, still serving, until a host rejoins;
+        * ``"DEGRADED"`` / ``"CPU_ONLY"`` — host losses keep coming
+          even under the single-process latch: escalate to the
+          whole-backend ladder (:meth:`on_device_loss`).
+        """
+        from spark_rapids_tpu.runtime.cluster import (
+            CLUSTER,
+            CLUSTER_MAX_HOST_LOSSES,
+        )
+        max_losses = int(conf.get_entry(CLUSTER_MAX_HOST_LOSSES))
+        first = str(exc).splitlines()[0] if str(exc) else type(exc).__name__
+        host_id = getattr(exc, "host_id", None)
+        already_latched = (
+            CLUSTER.health_snapshot()["singleProcessReason"] is not None)
+        budget = False
+        with self._lock:
+            if self._cpu_only_reason is not None:
+                return "CPU_ONLY"
+            self._host_losses += 1
+            self._host_consecutive += 1
+            n = self._host_consecutive
+            if not already_latched and n >= 3:
+                # RESERVE the shrink slot under the lock (the mesh
+                # ladder's two-worker argument applies here too)
+                budget = self._host_shrinks < max(0, max_losses)
+                if budget:
+                    self._host_shrinks += 1
+        if already_latched:
+            # the cluster is already out of the picture and hosts are
+            # STILL being lost (injected schedules can do this): the
+            # whole-backend ladder owns it from here
+            return self.on_device_loss(exc, conf)
+        reason = (f"cluster degraded after {n} consecutive host losses "
+                  f"(last: {type(exc).__name__}: {first})")
+        if n == 1:
+            return "retry"
+        if n == 2:
+            CLUSTER.mark_host_lost(host_id, reason)
+            return "reland"
+        if budget:
+            shrunk = CLUSTER.shrink_excluding(host_id, reason)
+            if shrunk:
+                with self._lock:
+                    # a fresh ladder for the smaller topology
+                    self._host_consecutive = 0
+                return "shrink"
+            with self._lock:
+                self._host_shrinks -= 1  # nothing to shrink: return it
+        CLUSTER.latch_single_process(
+            f"cluster latched single-process after {n} consecutive "
+            f"host losses (last: {type(exc).__name__}: {first})")
+        return "single_process"
+
+    def host_demotion_note(self) -> str:
+        """The reason string a host-ladder replay carries (surfaced in
+        explain()/event log alongside the mesh demotion notes)."""
+        with self._lock:
+            return (f"cluster degraded after {self._host_consecutive} "
+                    f"consecutive host losses")
+
+    def host_snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "hostsLost": self._host_losses,
+                "hostConsecutiveLosses": self._host_consecutive,
+                "hostShrinks": self._host_shrinks,
+            }
+
     def _invalidate_device_caches_locked(self) -> None:
         """Drop every cache that references device state — cached
         executables hold device-resident interned constants, kernel
@@ -338,6 +440,9 @@ class DeviceHealthMonitor:
             self._mesh_losses = 0
             self._mesh_shrinks = 0
             self._mesh_degradations = 0
+            self._host_consecutive = 0
+            self._host_losses = 0
+            self._host_shrinks = 0
 
 
 HEALTH = DeviceHealthMonitor()

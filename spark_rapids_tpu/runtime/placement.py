@@ -66,6 +66,10 @@ class PlacementLayer:
         cache stamps its generation."""
         from spark_rapids_tpu.parallel.mesh import MESH
         MESH.configure(self._conf)
+        # the host topology above the mesh (runtime/cluster.py): the
+        # fingerprint folds its identity token right next to the mesh's
+        from spark_rapids_tpu.runtime.cluster import CLUSTER
+        CLUSTER.configure(self._conf)
 
     # -- drain ---------------------------------------------------------------
     def drain(self, executable) -> List:

@@ -945,6 +945,13 @@ class QueryService:
         # mesh is VISIBLE here, not silently smaller
         from spark_rapids_tpu.parallel.mesh import MESH
         out["mesh"] = {**MESH.health_snapshot(), **HEALTH.mesh_snapshot()}
+        # the host fault domain above the mesh: current topology
+        # (declared/live/lost/excluded hosts, the single-process latch)
+        # plus the host ladder's counters — a cluster serving below
+        # declared strength is VISIBLE here, not silently smaller
+        from spark_rapids_tpu.runtime.cluster import CLUSTER
+        out["hosts"] = {**CLUSTER.health_snapshot(),
+                        **HEALTH.host_snapshot()}
         return out
 
     def stats(self) -> dict:

@@ -94,6 +94,20 @@ class WorkerWatchdog:
 
     def _sweep_locked(self):
         svc = self.service
+        # executor heartbeat sweep (runtime/cluster.py): hosts that
+        # missed spark.rapids.cluster.missedBeats beats are declared
+        # lost here too — the service's watchdog is the cross-host
+        # health authority when a cluster driver is attached (the
+        # driver's own sweeper covers driverless harness runs).
+        # Best-effort and lock-free on our side: the cluster never
+        # takes the service lock, so no ordering cycle.
+        try:
+            from spark_rapids_tpu.runtime.cluster import (
+                sweep_cluster_hosts,
+            )
+            sweep_cluster_hosts()
+        except Exception:
+            pass  # host health must never break worker health
         now = time.monotonic()
         for w in list(svc._workers):
             if w.lost:

@@ -386,6 +386,7 @@ class TpuSession:
                        - before_scopes.get(scope, {}).get(key, 0))
 
         from spark_rapids_tpu.parallel.mesh import MESH
+        from spark_rapids_tpu.runtime.cluster import CLUSTER
 
         record = E.build_query_record(
             query_index=qidx,
@@ -426,6 +427,10 @@ class TpuSession:
             mesh_degradations=_wdelta("meshDegradations", "health"),
             shard_retries=_wdelta("shardRetries", "mesh"),
             gather_checks_failed=_wdelta("gatherChecksFailed", "mesh"),
+            host_topology=CLUSTER.topology_str(),
+            hosts_lost=_wdelta("hostsLost", "cluster"),
+            host_relands=_wdelta("hostRelands", "cluster"),
+            dcn_exchanges=_wdelta("dcnExchanges", "cluster"),
         )
         self.last_event_record = record
         # the record has read the tree's metrics — the cached executable
@@ -533,21 +538,37 @@ class TpuSession:
         # the CPU-only latch) without replaying unboundedly
         from contextlib import nullcontext
 
-        from spark_rapids_tpu.errors import MeshDeviceLostError
+        from spark_rapids_tpu.errors import (
+            HostLostError,
+            MeshDeviceLostError,
+        )
         from spark_rapids_tpu.parallel import mesh as _mesh
+        from spark_rapids_tpu.runtime import cluster as _cluster
         from spark_rapids_tpu.runtime.health import DEVICE_LOSS_MAX_REINITS
         max_mesh_replays = (
             int(self.conf.get_entry(_mesh.MESH_DEGRADE_MAX_SHRINKS))
             + int(self.conf.get_entry(DEVICE_LOSS_MAX_REINITS)) + 6)
         mesh_replays = 0
+        # host degradation ladder (runtime/health.py on_host_loss):
+        # enough budget to walk every rung (retry -> reland -> every
+        # shrink -> the single-process latch) plus escalation slack
+        max_host_replays = (
+            int(self.conf.get_entry(_cluster.CLUSTER_MAX_HOST_LOSSES))
+            + int(self.conf.get_entry(DEVICE_LOSS_MAX_REINITS)) + 6)
+        host_replays = 0
         suppress_reason = None
+        suppress_cluster = None
         while True:
             was_suppressed = suppress_reason is not None
+            was_csuppressed = suppress_cluster is not None
             attempt_ctx = (_mesh.suppressed_mesh(suppress_reason)
                            if was_suppressed else nullcontext())
+            cluster_ctx = (_cluster.suppressed_cluster(suppress_cluster)
+                           if was_csuppressed else nullcontext())
             suppress_reason = None
+            suppress_cluster = None
             try:
-                with attempt_ctx:
+                with attempt_ctx, cluster_ctx:
                     result = self._execute_attempt(plan)
                 self.last_fault_replays = replays
                 if replays and hasattr(self._last_executable, "metrics"):
@@ -556,11 +577,45 @@ class TpuSession:
                 from spark_rapids_tpu.runtime.health import HEALTH
                 # the MESH ladder only resets on a mesh-NATIVE success:
                 # a suppressed (single-device) convergence proves
-                # nothing about the mesh's health
+                # nothing about the mesh's health — and the HOST ladder
+                # likewise only on a cluster-NATIVE success
                 HEALTH.note_success(
-                    mesh_native=not was_suppressed and _mesh.MESH.enabled)
+                    mesh_native=not was_suppressed and _mesh.MESH.enabled,
+                    cluster_native=(not was_csuppressed
+                                    and _cluster.CLUSTER.active()))
                 return result
             except Exception as exc:
+                if isinstance(exc, HostLostError) and \
+                        not getattr(exc, "_health_handled", False):
+                    # a whole executor HOST died (the local backend is
+                    # fine): the HOST degradation ladder owns recovery
+                    # — classified before the whole-backend is_fatal
+                    # branch (HostLostError IS a DeviceLostError)
+                    from spark_rapids_tpu.runtime.health import HEALTH
+                    action = HEALTH.on_host_loss(exc, self.conf)
+                    self._strike_fault_template(
+                        plan, exc, action, domain="host",
+                        benign=("retry",))
+                    if host_replays >= max_host_replays:
+                        exc._health_handled = True
+                        raise
+                    if self._q.exec_depth == 1:
+                        self._release_exec_cache(drop=True)
+                    host_replays += 1
+                    F.RECOVERY.bump("query_replays")
+                    if action in ("single_process", "DEGRADED",
+                                  "CPU_ONLY"):
+                        # pin the replay to local scans even if a host
+                        # rejoins (clearing the latch) mid-attempt —
+                        # the attempt must be deterministic. The
+                        # escalated actions replay too (the mesh
+                        # branch's contract): the re-plan sees the
+                        # reinitialized backend or the CPU-only latch
+                        # and serves the query without the cluster.
+                        suppress_cluster = HEALTH.host_demotion_note()
+                    # "retry"/"reland"/"shrink" replay plain: the
+                    # re-plan's scans see the re-routed topology
+                    continue
                 if isinstance(exc, MeshDeviceLostError) and \
                         not getattr(exc, "_health_handled", False):
                     # PARTIAL loss (one mesh device dead, backend
@@ -569,7 +624,8 @@ class TpuSession:
                     # is_fatal branch below
                     from spark_rapids_tpu.runtime.health import HEALTH
                     action = HEALTH.on_mesh_device_loss(exc, self.conf)
-                    self._strike_mesh_template(plan, exc, action)
+                    self._strike_fault_template(plan, exc, action,
+                                                domain="mesh")
                     if mesh_replays >= max_mesh_replays:
                         exc._health_handled = True
                         raise
@@ -627,15 +683,16 @@ class TpuSession:
                 replays += 1
                 F.RECOVERY.bump("query_replays")
 
-    def _strike_mesh_template(self, plan: P.PlanNode, exc: BaseException,
-                              action: str) -> None:
-        """A template that repeatedly kills mesh execution is a poison
-        suspect like any worker/device killer: every ladder action past
-        the plain retry records a quarantine strike (the service then
-        refuses the template at admission once it crosses
-        spark.rapids.service.quarantine.maxStrikes). Best-effort —
-        strike accounting must never mask recovery."""
-        if action == "retry":
+    def _strike_fault_template(self, plan: P.PlanNode, exc: BaseException,
+                               action: str, domain: str = "mesh",
+                               benign=("retry",)) -> None:
+        """A template that repeatedly kills mesh or cluster execution
+        is a poison suspect like any worker/device killer: every
+        ladder action past the plain retry records a quarantine strike
+        (the service then refuses the template at admission once it
+        crosses spark.rapids.service.quarantine.maxStrikes).
+        Best-effort — strike accounting must never mask recovery."""
+        if action in benign:
             return
         try:
             from spark_rapids_tpu.plan.fingerprint import (
@@ -649,7 +706,7 @@ class TpuSession:
                      else type(exc).__name__)
             QUARANTINE.strike(
                 template_fingerprint(plan, self.conf),
-                f"mesh execution killed ({action}): "
+                f"{domain} execution killed ({action}): "
                 f"{type(exc).__name__}: {first}",
                 int(self.conf.get_entry(QUARANTINE_MAX_STRIKES)))
         except Exception:

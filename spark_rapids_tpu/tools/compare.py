@@ -150,6 +150,19 @@ def compare_query(a_runs: List[dict], b_runs: List[dict]) -> dict:
                                    for r in a_runs),
         "bGatherChecksFailed": sum(int(r.get("gatherChecksFailed", 0))
                                    for r in b_runs),
+        # host fault domain (schema v8): per-side host losses / shard
+        # re-lands / DCN crossings — a wall regression explained by a
+        # mid-run host loss is not a plan regression
+        "aHostsLost": sum(int(r.get("hostsLost", 0)) for r in a_runs),
+        "bHostsLost": sum(int(r.get("hostsLost", 0)) for r in b_runs),
+        "aHostRelands": sum(int(r.get("hostRelands", 0))
+                            for r in a_runs),
+        "bHostRelands": sum(int(r.get("hostRelands", 0))
+                            for r in b_runs),
+        "aDcnExchanges": sum(int(r.get("dcnExchanges", 0))
+                             for r in a_runs),
+        "bDcnExchanges": sum(int(r.get("dcnExchanges", 0))
+                             for r in b_runs),
         "ops": op_diffs,
         "newFallbacks": sorted(set(fb_b) - set(fb_a)),
         "resolvedFallbacks": sorted(set(fb_a) - set(fb_b)),

@@ -165,6 +165,10 @@ def analyze_query(rec: dict, top_n: int = 10) -> dict:
         "meshDegradations": int(rec.get("meshDegradations", 0)),
         "shardRetries": int(rec.get("shardRetries", 0)),
         "gatherChecksFailed": int(rec.get("gatherChecksFailed", 0)),
+        "hostTopology": rec.get("hostTopology"),
+        "hostsLost": int(rec.get("hostsLost", 0)),
+        "hostRelands": int(rec.get("hostRelands", 0)),
+        "dcnExchanges": int(rec.get("dcnExchanges", 0)),
         "attribution": {
             "attributedS": round(attributed, 6),
             "untrackedS": round(float(spans.get("untrackedS", 0.0)), 6),
@@ -266,6 +270,19 @@ def build_profile(records: Iterable[dict], top_n: int = 10,
         "degradedQueries": sorted(
             {q["query"] for q in queries if q["meshDegradations"]}),
     }
+    # host resilience (schema v8): the multi-host fault-domain counters
+    # — hosts lost and shards re-landed during the run, plus how many
+    # collectives crossed the DCN axis (cluster-spanning meshes)
+    host_resilience = {
+        "hostTopologies": sorted({q["hostTopology"] for q in queries
+                                  if q["hostTopology"]}),
+        "hostsLost": sum(q["hostsLost"] for q in queries),
+        "hostRelands": sum(q["hostRelands"] for q in queries),
+        "dcnExchanges": sum(q["dcnExchanges"] for q in queries),
+        "degradedQueries": sorted(
+            {q["query"] for q in queries
+             if q["hostsLost"] or q["hostRelands"]}),
+    }
     # survivability (schema v4): how healthy was the process this run,
     # and which queries rode through recovery events
     survivability = {
@@ -285,6 +302,7 @@ def build_profile(records: Iterable[dict], top_n: int = 10,
         "compile": compile_summary,
         "mesh": mesh_summary,
         "meshResilience": mesh_resilience,
+        "hostResilience": host_resilience,
         "survivability": survivability,
         "minCoverage": round(min((q["attribution"]["coverage"]
                                   for q in queries), default=1.0), 4),
@@ -353,6 +371,17 @@ def render_profile(report: dict) -> str:
             f"{mr['gatherChecksFailed']}"
             + (f" | degraded: {', '.join(mr['degradedQueries'])}"
                if mr.get("degradedQueries") else ""))
+    hr = report.get("hostResilience") or {}
+    if (hr.get("hostsLost") or hr.get("hostRelands")
+            or hr.get("dcnExchanges")):
+        lines.append(
+            f"Host resilience: hosts lost {hr['hostsLost']} | shard "
+            f"re-lands {hr['hostRelands']} | DCN exchanges "
+            f"{hr['dcnExchanges']}"
+            + (f" | topologies {','.join(hr['hostTopologies'])}"
+               if hr.get("hostTopologies") else "")
+            + (f" | degraded: {', '.join(hr['degradedQueries'])}"
+               if hr.get("degradedQueries") else ""))
     sv = report["survivability"]
     if (sv["deviceReinits"] or sv["workerRestarts"]
             or sv["quarantinedQueries"]

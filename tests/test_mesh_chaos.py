@@ -271,10 +271,12 @@ def test_scale_test_flag_validation():
 
     class A:
         mesh = 8
+        hosts = 0
         chaos = False
         concurrency = 0
         service_faults = False
         cpu_baseline = False
+        require_tpu = False
 
     ST.validate_flags(A())  # plain --mesh: fine
     A.chaos = True

@@ -229,13 +229,13 @@ def test_event_log_written_and_valid(tmp_path):
     lines = open(s.last_event_path).read().strip().splitlines()
     assert len(lines) == 1
     rec = json.loads(lines[0])
-    # schema v7: the mesh fault-domain PR added meshDegradations /
-    # shardRetries / gatherChecksFailed (all 0 on a healthy mesh and
-    # off-mesh) on top of v6's mesh-native fields (meshShape /
-    # iciBytes / shardSkew — null/0/0.0 off-mesh), v5's
-    # transactional-write fields and v4's survivability fields — see
-    # obs/events.py
-    assert rec["schema"] == 7
+    # schema v8: the multi-host fault-domain PR added hostTopology /
+    # hostsLost / hostRelands / dcnExchanges (null/0/0/0 off-cluster)
+    # on top of v7's mesh fault-domain fields (meshDegradations /
+    # shardRetries / gatherChecksFailed — all 0 on a healthy mesh and
+    # off-mesh), v6's mesh-native fields, v5's transactional-write
+    # fields and v4's survivability fields — see obs/events.py
+    assert rec["schema"] == 8
     assert rec["healthState"] == "HEALTHY"
     assert rec["quarantined"] is False
     assert rec["deviceReinits"] == 0 and rec["workerRestarts"] == 0
@@ -245,6 +245,9 @@ def test_event_log_written_and_valid(tmp_path):
     assert rec["iciBytes"] == 0 and rec["shardSkew"] == 0.0
     assert rec["meshDegradations"] == 0
     assert rec["shardRetries"] == 0 and rec["gatherChecksFailed"] == 0
+    assert rec["hostTopology"] is None
+    assert rec["hostsLost"] == 0 and rec["hostRelands"] == 0
+    assert rec["dcnExchanges"] == 0
     assert rec["event"] == "queryCompleted"
     assert rec["queryTag"] == "golden"
     assert rec["wallS"] > 0
@@ -296,7 +299,15 @@ def test_event_log_golden_schema(tmp_path):
     ladder demotions during this query's wall, a health-scope delta;
     shardRetries / gatherChecksFailed — local re-gathers paid and
     checksum validations tripped at mesh gather boundaries, mesh-scope
-    deltas; all 0 on a healthy mesh and for result-cache serves)."""
+    deltas; all 0 on a healthy mesh and for result-cache serves);
+    v8 = multi-host fault-domain fields (hostTopology — the active
+    cluster host topology at record time, '2' full / '1/2' degraded /
+    '0/2' latched single-process, null off-cluster; hostsLost /
+    hostRelands / dcnExchanges — executor hosts declared lost, lost
+    hosts' shards re-landed onto survivors, and collectives that
+    crossed the DCN axis during this query's wall — per-record deltas
+    of the cluster scope; all 0/null off-cluster and for result-cache
+    serves)."""
     s = _run_eventlog_query(tmp_path)
     got = _normalize(s.last_event_record)
     golden_path = os.path.join(os.path.dirname(__file__),
