@@ -1138,12 +1138,20 @@ def run_mesh_chaos(sf: float, seed: int, ndev: int, queries=None,
     build = build_sql_queries if use_sql else build_queries
 
     spec = mesh_chaos_fault_spec(seed)
+    # flight-recorder closure (ISSUE 14): every injected mesh ladder
+    # action must dump an incident bundle into this run's fresh dir
+    import os
+    import tempfile
+    flight_dir = tempfile.mkdtemp(prefix="rapids_mesh_flightrec_")
     chip = TpuSession()
     mesh = TpuSession({
         "spark.rapids.mesh.enabled": "true",
         "spark.rapids.mesh.shape": shape or str(ndev),
         "spark.rapids.sql.runtimeFallback.enabled": "true",
         "spark.rapids.test.faults": spec,
+        "spark.rapids.obs.telemetry.enabled": "true",
+        "spark.rapids.obs.telemetry.intervalMs": "200",
+        "spark.rapids.obs.flightRecorder.dir": flight_dir,
     })
     chip_queries = build(chip, tables)
     mesh_queries = build(mesh, tables)
@@ -1176,6 +1184,9 @@ def run_mesh_chaos(sf: float, seed: int, ndev: int, queries=None,
 
     recovery_before = RECOVERY.snapshot()
     mesh_before_all, health_before_all = _scopes()
+    #: on_mesh_device_loss invocations == mesh ladder actions (each
+    #: bumps the cumulative count) — the incident-bundle floor
+    mesh_ladder_before = HEALTH.mesh_snapshot()["meshDeviceLost"]
     for name in wanted:
         before_m, before_h = _scopes()
         fires_before = FAULTS.counters()
@@ -1271,6 +1282,33 @@ def run_mesh_chaos(sf: float, seed: int, ndev: int, queries=None,
             failures.append(
                 "mesh did not return to full strength after restore: "
                 f"{restored}")
+    # -- flight-recorder closure (ISSUE 14) ----------------------------------
+    from spark_rapids_tpu.tools.incident import (
+        load_bundles,
+        render_incident,
+    )
+    ladder_actions = (HEALTH.mesh_snapshot()["meshDeviceLost"]
+                      - mesh_ladder_before)
+    bundles = load_bundles(flight_dir) if os.path.isdir(flight_dir) \
+        and os.listdir(flight_dir) else []
+    mesh_bundles = [b for b in bundles if b.get("kind") == "mesh.ladder"]
+    report["incident_bundles"] = len(bundles)
+    report["mesh_ladder_bundles"] = len(mesh_bundles)
+    report["mesh_ladder_actions"] = ladder_actions
+    report["flight_recorder_dir"] = flight_dir
+    if len(mesh_bundles) < ladder_actions:
+        failures.append(
+            f"only {len(mesh_bundles)} mesh-ladder incident bundles "
+            f"for {ladder_actions} injected ladder actions")
+    elif mesh_bundles:
+        rendered = render_incident(mesh_bundles, last=1)
+        for marker in ("trigger:", "ladder:", "telemetry tail:"):
+            if marker not in rendered:
+                failures.append(f"tools incident render missing its "
+                                f"{marker!r} section")
+        report["incident_actions"] = sorted(
+            {b.get("action") for b in mesh_bundles})
+
     report["demoted_ops"] = CIRCUIT_BREAKER.demoted_ops()
     report["health_state"] = HEALTH.state()
     report["ok"] = not failures
@@ -1540,11 +1578,25 @@ def run_hosts(sf: float, seed: int, nhosts: int, queries=None,
 
     spec = host_chaos_fault_spec(seed) if chaos else ""
     driver, executors = _boot_cluster(nhosts)
+    # the observability closure (ISSUE 14): the cluster session runs
+    # with event log + host tracing + the telemetry sampler on, and
+    # the flight recorder pointed at a fresh dir — the run then
+    # asserts executor-host spans per routed scan, the tools-profile
+    # per-host breakdown over the 95% coverage floor, and (chaos) one
+    # incident bundle per injected host ladder action
+    obs_dir = tempfile.mkdtemp(prefix="rapids_hosts_obs_")
+    eventlog_dir = os.path.join(obs_dir, "eventlog")
+    trace_dir = os.path.join(obs_dir, "trace")
+    flight_dir = os.path.join(obs_dir, "flightrec")
     report = {"mode": "hosts-chaos" if chaos else "hosts",
               "hosts": nhosts, "n_devices": ndev, "mesh_shape": shape,
               "backend": _resolved_backend(), "scale_factor": sf,
               "seed": seed, "sql": use_sql, "corpus_dir": base,
-              "files_per_table": 2 * nhosts, "queries": {}}
+              "files_per_table": 2 * nhosts,
+              "observability": {"eventlog_dir": eventlog_dir,
+                                "trace_dir": trace_dir,
+                                "flight_recorder_dir": flight_dir},
+              "queries": {}}
     failures = []
     try:
         single = TpuSession()
@@ -1558,6 +1610,13 @@ def run_hosts(sf: float, seed: int, nhosts: int, queries=None,
             "spark.rapids.mesh.enabled": "true",
             "spark.rapids.mesh.shape": shape,
             "spark.rapids.sql.runtimeFallback.enabled": "true",
+            "spark.rapids.sql.eventLog.enabled": "true",
+            "spark.rapids.sql.eventLog.dir": eventlog_dir,
+            "spark.rapids.trace.enabled": "true",
+            "spark.rapids.trace.dir": trace_dir,
+            "spark.rapids.obs.telemetry.enabled": "true",
+            "spark.rapids.obs.telemetry.intervalMs": "200",
+            "spark.rapids.obs.flightRecorder.dir": flight_dir,
         }
         if spec:
             conf["spark.rapids.test.faults"] = spec
@@ -1580,6 +1639,9 @@ def run_hosts(sf: float, seed: int, nhosts: int, queries=None,
         recovery_before = RECOVERY.snapshot()
         cluster_before_all = dict(
             scopes_snapshot().get("cluster", {}))
+        #: on_host_loss invocations == host ladder actions (each bumps
+        #: the cumulative loss count) — the incident-bundle floor
+        host_ladder_before = HEALTH.host_snapshot()["hostsLost"]
         # the kill lands mid-corpus and the rejoin ALWAYS fits before
         # the last query — a --queries subset too short for the script
         # must not leave the victim dead into the closure assertions
@@ -1649,6 +1711,7 @@ def run_hosts(sf: float, seed: int, nhosts: int, queries=None,
                     redo = single_queries[name]().collect_table()
                 diff = tables_differ(redo, got)
                 recollected = True
+            rec = clus.last_event_record or {}
             entry = {
                 "chaos_s" if chaos else "wall_s": round(wall, 4),
                 "identical": diff is None,
@@ -1665,6 +1728,8 @@ def run_hosts(sf: float, seed: int, nhosts: int, queries=None,
                            for k in after_h
                            if after_h[k] != before_h[k]},
                 "host_topology": CLUSTER.topology_str(),
+                "query_index": rec.get("queryIndex"),
+                "host_scans": sorted(rec.get("hostScans") or {}),
             }
             if chaos:
                 entry["fault_fires"] = {
@@ -1736,6 +1801,99 @@ def run_hosts(sf: float, seed: int, nhosts: int, queries=None,
                 failures.append(
                     "cluster did not return to full strength after "
                     f"restore: {restored}")
+        # -- observability closure (ISSUE 14) --------------------------------
+        # (a) the merged Chrome trace carries executor-host spans for
+        # every cluster-routed scan: the driver's per-host cluster.scan
+        # span AND the executor's own spans merged onto an
+        # executor-<host> lane
+        for name, entry in report["queries"].items():
+            landed = entry["cluster"].get("hostShardsLanded", 0)
+            qi = entry.get("query_index")
+            if not landed or qi is None:
+                continue
+            tpath = os.path.join(trace_dir, f"query_{qi}.trace.json")
+            if not os.path.exists(tpath):
+                failures.append(f"{name}: cluster-routed scan has no "
+                                f"Chrome trace at {tpath}")
+                continue
+            with open(tpath) as f:
+                events = json.load(f)["traceEvents"]
+            cluster_spans = [e for e in events
+                             if e.get("name") == "cluster.scan"]
+            exec_lanes = sorted(
+                {str((e.get("args") or {}).get("name", ""))
+                 for e in events if e.get("ph") == "M"
+                 and str((e.get("args") or {}).get("name", ""))
+                 .startswith("executor-")})
+            exec_spans = [e for e in events
+                          if e.get("cat") == "exec-scan"]
+            if not cluster_spans:
+                failures.append(f"{name}: no cluster.scan span in the "
+                                f"merged trace")
+            if not exec_lanes or not exec_spans:
+                failures.append(f"{name}: no executor-host spans "
+                                f"merged into the trace")
+            entry["trace"] = {"clusterScanSpans": len(cluster_spans),
+                              "executorLanes": exec_lanes,
+                              "executorSpans": len(exec_spans)}
+
+        # (b) tools profile over the run's event log: the per-host
+        # breakdown exists and telemetry/trace overhead stays above
+        # the existing 95% span-coverage floor
+        from spark_rapids_tpu.tools.report import (
+            build_profile,
+            load_events,
+        )
+        profile = build_profile(load_events(eventlog_dir))
+        report["profile"] = {
+            "minCoverage": profile["minCoverage"],
+            "queriesBelowCoverageFloor":
+                profile["queriesBelowCoverageFloor"],
+            "perHost": profile["hostResilience"]["perHost"],
+        }
+        if profile["queriesBelowCoverageFloor"]:
+            failures.append(
+                "span coverage fell below the 95% floor under "
+                f"telemetry: {profile['queriesBelowCoverageFloor']}")
+        if not profile["hostResilience"]["perHost"]:
+            failures.append("tools profile has no per-host breakdown "
+                            "(hostScans never recorded)")
+
+        # (c) flight recorder: every injected host ladder action
+        # produced an incident bundle, and tools incident renders them
+        from spark_rapids_tpu.tools.incident import (
+            load_bundles,
+            render_incident,
+        )
+        ladder_actions = (HEALTH.host_snapshot()["hostsLost"]
+                          - host_ladder_before)
+        bundles = (load_bundles(flight_dir)
+                   if os.path.isdir(flight_dir) else [])
+        host_bundles = [b for b in bundles
+                        if b.get("kind") == "host.ladder"]
+        report["incident_bundles"] = len(bundles)
+        report["host_ladder_bundles"] = len(host_bundles)
+        report["host_ladder_actions"] = ladder_actions
+        if chaos:
+            if len(host_bundles) < ladder_actions:
+                failures.append(
+                    f"only {len(host_bundles)} host-ladder incident "
+                    f"bundles for {ladder_actions} injected ladder "
+                    f"actions")
+            if host_bundles:
+                rendered = render_incident(host_bundles, last=1)
+                for marker in ("trigger:", "ladder:",
+                               "telemetry tail:"):
+                    if marker not in rendered:
+                        failures.append(
+                            f"tools incident render missing its "
+                            f"{marker!r} section")
+                report["incident_actions"] = sorted(
+                    {b.get("action") for b in host_bundles})
+            elif ladder_actions:
+                failures.append("no host-ladder incident bundles were "
+                                "recorded")
+
         report["demoted_ops"] = CIRCUIT_BREAKER.demoted_ops()
         report["health_state"] = HEALTH.state()
     finally:

@@ -129,10 +129,18 @@ def demote(name: str, exc: BaseException) -> None:
     with _LOCK:
         if name in _DEMOTED:
             return
-        _DEMOTED[name] = (f"pallas kernel '{name}' demoted to HLO: "
-                          f"{type(exc).__name__}: {first_line}")
+        reason = (f"pallas kernel '{name}' demoted to HLO: "
+                  f"{type(exc).__name__}: {first_line}")
+        _DEMOTED[name] = reason
     from spark_rapids_tpu.runtime.faults import RECOVERY
     RECOVERY.bump("demotions")
+    # flight-recorder hook (obs/telemetry.py): a kernel demotion is an
+    # incident like a ladder action — best-effort, outside _LOCK
+    try:
+        from spark_rapids_tpu.obs.telemetry import record_incident
+        record_incident("kernel.demotion", name, reason, error=exc)
+    except Exception:
+        pass
 
 
 def demotion_reason(name: str) -> Optional[str]:

@@ -101,6 +101,11 @@ RULES: Dict[str, str] = {
                       "layer (kernels/) outside the sanctioned "
                       "allowlist — kernels are pure device code "
                       "traced into other programs",
+    "RL-OBS-PASSIVE": "the passive telemetry module (obs/telemetry.py) "
+                      "touches the device (jax/jnp/host syncs/"
+                      "finalize_observation), drives query execution, "
+                      "or takes a query-path lock — sampling must "
+                      "never perturb the execution it observes",
 }
 
 

@@ -17,6 +17,11 @@ analyzer — SURVEY.md §5):
 * :mod:`spark_rapids_tpu.obs.events` — the per-query structured event
   log (JSONL) that `python -m spark_rapids_tpu.tools` analyzes
   offline.
+* :mod:`spark_rapids_tpu.obs.telemetry` — the BETWEEN-queries layer:
+  a passive background telemetry ring (per-scope metric deltas +
+  topology at a conf-driven interval) and the flight recorder that
+  dumps bounded incident bundles on every ladder action, quarantine
+  strike, and kernel demotion (`tools incident` renders them).
 """
 
 from spark_rapids_tpu.obs.metrics import (  # noqa: F401

@@ -24,6 +24,7 @@ import json
 import os
 import threading
 import uuid
+from collections import deque
 from typing import Dict, List, Optional
 
 from spark_rapids_tpu.conf import bool_conf, str_conf
@@ -96,7 +97,14 @@ EVENT_LOG_DIR = str_conf(
 #: last three per-record DELTAS of the new ``cluster`` scope
 #: (runtime/cluster.py). All 0/null off-cluster; result-cache serves
 #: carry the serve-time hostTopology and 0/0/0.
-EVENT_SCHEMA_VERSION = 8
+#: v9 (flight-recorder PR): + hostScans — per-executor-host scan
+#: attribution merged from cluster scan replies ({host: {scans, files,
+#: bytes, wallS, execWallS, crcRetries}}: dispatch round trips, TPAK
+#: frames landed and their bytes, driver-side round-trip wall,
+#: executor-reported scan wall, CRC-caught re-lands). {} off-cluster,
+#: for local-fallback scans, and for result-cache serves (nothing
+#: dispatched).
+EVENT_SCHEMA_VERSION = 9
 
 
 def plan_tree(executable) -> dict:
@@ -221,7 +229,8 @@ def build_query_record(*, query_index: int, wall_s: float,
                        host_topology: Optional[str] = None,
                        hosts_lost: int = 0,
                        host_relands: int = 0,
-                       dcn_exchanges: int = 0) -> dict:
+                       dcn_exchanges: int = 0,
+                       host_scans: Optional[Dict[str, dict]] = None) -> dict:
     """Assemble one event-log record. Every field is JSON-native; the
     golden schema test normalizes timings and pins the shape.
     ``service`` is the query-service envelope (tenant, pool, queueWaitS,
@@ -270,6 +279,8 @@ def build_query_record(*, query_index: int, wall_s: float,
         "hostsLost": int(hosts_lost),
         "hostRelands": int(host_relands),
         "dcnExchanges": int(dcn_exchanges),
+        "hostScans": {h: dict(v)
+                      for h, v in sorted((host_scans or {}).items())},
         "faultReplays": fault_replays,
         "plan": plan_tree(executable),
         "fallbacks": collect_fallbacks(meta),
@@ -303,6 +314,41 @@ class QueryEventWriter:
                 f.write(line + "\n")
             self.records_written += 1
         return self.path
+
+
+# ---------------------------------------------------------------------------
+# Recent-record ring (the flight recorder's "what was the engine doing
+# just before the incident" context — obs/telemetry.py embeds it in
+# every incident bundle)
+# ---------------------------------------------------------------------------
+
+#: slimmed summaries of the most recent event records, process-wide
+#: (full records carry whole plan trees — the bundle only needs the
+#: headline facts)
+_RECENT_KEEP = 32
+_RECENT_LOCK = threading.Lock()
+_RECENT = deque(maxlen=_RECENT_KEEP)
+_RECENT_FIELDS = ("queryIndex", "queryTag", "wallS", "healthState",
+                  "hostTopology", "meshShape", "dispatches",
+                  "faultReplays", "hostsLost", "hostRelands",
+                  "meshDegradations", "deviceReinits", "cacheHit")
+
+
+def note_recent_record(record: dict) -> None:
+    """Remember a slim summary of one written event record (called by
+    the session's event-log append path)."""
+    slim = {k: record.get(k) for k in _RECENT_FIELDS}
+    slim["demotions"] = sorted(record.get("demotions") or {})
+    slim["faultFires"] = dict(record.get("faultFires") or {})
+    with _RECENT_LOCK:
+        _RECENT.append(slim)
+
+
+def recent_records(n: int = _RECENT_KEEP) -> List[dict]:
+    if n <= 0:
+        return []  # [-0:] would return ALL
+    with _RECENT_LOCK:
+        return list(_RECENT)[-int(n):]
 
 
 def scope_delta(before: Dict[str, dict],

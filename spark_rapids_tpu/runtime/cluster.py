@@ -171,6 +171,40 @@ SHARD_LAND_RETRIES = 2
 _EXECUTOR_SCAN_FORMATS = ("parquet",)
 
 
+# -- per-query per-host scan attribution -------------------------------------
+# Thread-local like the dispatch counters: the drain pulls cluster-
+# routed scans on the executing thread, so per-host stats accumulated
+# here belong to exactly one in-flight query. The session resets at
+# top-level execute and folds the result into the v9 event record's
+# ``hostScans`` field.
+
+_TL_SCAN_STATS = threading.local()
+
+
+def reset_host_scan_stats() -> None:
+    _TL_SCAN_STATS.stats = {}
+
+
+def host_scan_stats() -> Dict[str, dict]:
+    """This thread's accumulated per-host scan attribution:
+    {host: {scans, files, bytes, wallS, execWallS, crcRetries}}."""
+    return {h: dict(v)
+            for h, v in getattr(_TL_SCAN_STATS, "stats", {}).items()}
+
+
+def _bump_host_stat(host_id: str, **deltas) -> None:
+    stats = getattr(_TL_SCAN_STATS, "stats", None)
+    if stats is None:
+        stats = _TL_SCAN_STATS.stats = {}
+    e = stats.setdefault(host_id, {"scans": 0, "files": 0, "bytes": 0,
+                                   "wallS": 0.0, "execWallS": 0.0,
+                                   "crcRetries": 0})
+    for k, v in deltas.items():
+        cur = e.get(k, 0)
+        e[k] = (round(cur + v, 6) if isinstance(cur, float)
+                else cur + int(v))
+
+
 #: per-ATTEMPT cluster suppression (the session's replay machinery sets
 #: this when an attempt must not touch the cluster at all); distinct
 #: from the single-process LATCH, which is process state until a host
@@ -902,14 +936,27 @@ class ClusterDriver:
         path subset (the ``host.dispatch`` fault point), receive one
         TPAK frame per file. A socket failure/timeout mid-round-trip
         is a HOST loss (the process, not one request, is presumed
-        gone) — typed HostLostError, channel dropped, ladder recovers."""
+        gone) — typed HostLostError, channel dropped, ladder recovers.
+
+        Cross-host trace propagation: when the driver's span tracer is
+        live, the dispatch frame carries a ``trace`` flag — the
+        executor runs its own SpanTracer around the scan and ships the
+        span summaries (plus per-scan wall/bytes) back in the reply
+        header, which merge into this query's trace on an
+        ``executor-<host>`` lane and into the per-host ``hostScans``
+        event-record attribution."""
         from spark_rapids_tpu.errors import HostLostError
+        from spark_rapids_tpu.obs.spans import TRACER
         from spark_rapids_tpu.runtime.faults import fault_point
         ch = self._channel(host_id)
         fault_point("host.dispatch")
+        spec = _scan_spec(scan_node, paths)
+        if TRACER.enabled:
+            spec["trace"] = True
+        t0 = time.perf_counter()
         try:
             with ch.lock:
-                _send_msg(ch.sock, _scan_spec(scan_node, paths))
+                _send_msg(ch.sock, spec)
                 reply, _ = _recv_msg(ch.sock)
                 if reply.get("type") == "error":
                     # a QUERY-scoped executor error (unreadable file,
@@ -923,7 +970,6 @@ class ClusterDriver:
                 for _ in range(int(reply.get("n", 0))):
                     _head, payload = _recv_msg(ch.sock)
                     frames.append(payload)
-                return frames
         except HostLostError:
             raise  # channel intact (error reply / injected fault)
         except (OSError, ValueError, ConnectionError) as exc:
@@ -934,6 +980,18 @@ class ClusterDriver:
                 f"executor host {host_id} lost mid-dispatch "
                 f"({type(exc).__name__}: {exc})",
                 host_id=host_id) from exc
+        wall = time.perf_counter() - t0
+        exec_scan = reply.get("scan") or {}
+        _bump_host_stat(host_id, scans=1, files=len(frames),
+                        bytes=sum(len(f) for f in frames), wallS=wall,
+                        execWallS=float(exec_scan.get("wallS", 0.0)))
+        spans = reply.get("spans")
+        if spans:
+            # anchor the executor's relative span clock at the dispatch
+            # send: durations are exact, offsets shifted by the one-way
+            # wire latency (different perf_counter domains)
+            TRACER.add_remote_spans(host_id, spans, t0)
+        return frames
 
     def scan(self, scan_node, paths: List[str]):
         """Partition ``paths`` BY HOST (contiguous slices over the
@@ -944,6 +1002,7 @@ class ClusterDriver:
         ever covers usable hosts (hostRelands counts each lost host
         whose work was re-assigned)."""
         from spark_rapids_tpu.errors import CorruptFrameError, HostLostError
+        from spark_rapids_tpu.obs.spans import TRACER
         from spark_rapids_tpu.runtime.faults import fault_point
         from spark_rapids_tpu.shuffle.serializer import unpack_table
 
@@ -965,7 +1024,15 @@ class ClusterDriver:
             sub = paths[i * per:(i + 1) * per]
             if not sub:
                 continue
-            frames = self.scan_host(host_id, scan_node, sub)
+            # one driver-side span per dispatched host: the dispatch
+            # round trip is attributed wall (executing thread), and the
+            # executor's own spans nest under an executor-<host> lane
+            sp = (TRACER.begin("cluster.scan", "cluster", host=host_id,
+                               files=len(sub)) if TRACER.enabled else None)
+            try:
+                frames = self.scan_host(host_id, scan_node, sub)
+            finally:
+                TRACER.end(sp)
             for frame in frames:
                 # THE host shard landing point: corrupt damages the
                 # landed copy and the TPAK CRC catches it — the intact
@@ -979,6 +1046,7 @@ class ClusterDriver:
                         break
                     except CorruptFrameError as exc:
                         CLUSTER_SCOPE.add("hostShardRetries", 1)
+                        _bump_host_stat(host_id, crcRetries=1)
                         if attempt >= SHARD_LAND_RETRIES:
                             raise HostLostError(
                                 f"host {host_id} shard landing failed "
@@ -993,28 +1061,86 @@ class ClusterDriver:
 # ---------------------------------------------------------------------------
 
 
+def _executor_scan(msg: dict, host_id: str):
+    """Run one dispatched scan on the executor, optionally under the
+    executor's OWN SpanTracer (the driver's dispatch frame carries a
+    ``trace`` flag when its tracer is live): per-file decode + pack
+    spans collect locally and ship back as compact summaries — t0
+    relative to scan start, so the driver can merge them into ITS
+    query trace on an executor lane. Returns (frames, scan_summary,
+    span_payload)."""
+    from spark_rapids_tpu.obs.spans import TRACER
+    from spark_rapids_tpu.shuffle.serializer import pack_table
+    want_trace = bool(msg.get("trace"))
+    node = _build_scan_node(msg)
+    t_q0 = time.perf_counter()
+    frames: List[bytes] = []
+    span_payload: List[dict] = []
+    # the executor's scan is ALWAYS local: in thread mode (tests) this
+    # process also hosts the driver, and an unsuppressed scan would
+    # recurse through scan_route back to this very executor — deadlock
+    # by construction
+    with suppressed_cluster("executor-local scan"):
+        if not want_trace:
+            frames = [pack_table(t) for t in node.execute_cpu()]
+        else:
+            TRACER.begin_query(0)
+            try:
+                it = node.execute_cpu()
+                i = 0
+                while True:
+                    t_f0 = time.perf_counter()
+                    try:
+                        table = next(it)
+                    except StopIteration:
+                        break
+                    sp = TRACER.begin("executor.scan.file", "exec-scan",
+                                      index=i)
+                    if sp is not None:
+                        sp.t0 = t_f0  # decode happened inside next()
+                    TRACER.end(sp)
+                    sp = TRACER.begin("executor.pack", "exec-scan",
+                                      index=i)
+                    frames.append(pack_table(table))
+                    TRACER.end(sp)
+                    i += 1
+            finally:
+                spans = TRACER.end_query()
+            span_payload = [
+                {"name": s.name, "cat": s.cat,
+                 "t0": round(s.t0 - t_q0, 6), "dur": round(s.dur, 6),
+                 "args": s.args}
+                for s in spans][:256]
+    scan_summary = {
+        "wallS": round(time.perf_counter() - t_q0, 6),
+        "files": len(frames),
+        "bytes": sum(len(f) for f in frames),
+        "host": host_id,
+        "pid": os.getpid(),
+    }
+    return frames, scan_summary, span_payload
+
+
 def _executor_serve_data(sock: socket.socket, host_id: str) -> None:
     """Executor data loop: serve driver scan requests until shutdown.
     One frame per file batch (PERFILE), TPAK-serialized — the same
     bytes the P2P shuffle moves."""
-    from spark_rapids_tpu.shuffle.serializer import pack_table
     while True:
         msg, _ = _recv_msg(sock)
         kind = msg.get("type")
         if kind == "scan":
             try:
-                node = _build_scan_node(msg)
-                # the executor's scan is ALWAYS local: in thread mode
-                # (tests) this process also hosts the driver, and an
-                # unsuppressed scan would recurse through scan_route
-                # back to this very executor — deadlock by construction
-                with suppressed_cluster("executor-local scan"):
-                    frames = [pack_table(t) for t in node.execute_cpu()]
+                frames, scan_summary, span_payload = _executor_scan(
+                    msg, host_id)
             except Exception as exc:  # noqa: BLE001 - report to driver
                 _send_msg(sock, {"type": "error",
                                  "error": f"{type(exc).__name__}: {exc}"})
                 continue
-            _send_msg(sock, {"type": "scan_result", "n": len(frames)})
+            reply = {"type": "scan_result", "n": len(frames),
+                     "scan": scan_summary}
+            if span_payload:
+                reply["spans"] = span_payload
+            _send_msg(sock, reply)
             for frame in frames:
                 _send_msg(sock, {"type": "frame"}, payload=frame)
         elif kind == "ping":

@@ -93,6 +93,24 @@ register_metric("meshShrinks", "count", "ESSENTIAL",
                 "spark.rapids.mesh.degrade.maxShrinks)")
 
 
+def _record_ladder_incident(kind: str, action: str, exc: BaseException,
+                            conf) -> None:
+    """Flight-recorder hook for every degradation-ladder action
+    (obs/telemetry.py). Called AFTER the monitor's lock is released —
+    the bundle re-reads the health snapshots — and strictly
+    best-effort: the black box must never mask the recovery it
+    documents."""
+    try:
+        from spark_rapids_tpu.obs.telemetry import record_incident
+        first = (str(exc).splitlines()[0] if str(exc)
+                 else type(exc).__name__)
+        record_incident(kind, action,
+                        f"{type(exc).__name__}: {first}",
+                        conf=conf, error=exc)
+    except Exception:
+        pass
+
+
 class DeviceHealthMonitor:
     """Process-wide device health state (the device is shared by every
     session in the process, like the circuit breaker and the kernel
@@ -157,6 +175,11 @@ class DeviceHealthMonitor:
         the resulting health state. Serialized — two workers observing
         the same dead device recover one at a time, and the second
         recovery is a cheap re-clear of already-empty caches."""
+        state = self._on_device_loss_inner(exc, conf)
+        _record_ladder_incident("backend.ladder", state, exc, conf)
+        return state
+
+    def _on_device_loss_inner(self, exc: BaseException, conf) -> str:
         max_reinits = int(conf.get_entry(DEVICE_LOSS_MAX_REINITS))
         with self._lock:
             self._losses += 1
@@ -221,6 +244,11 @@ class DeviceHealthMonitor:
           ladder (:meth:`on_device_loss` — backend reinit, then the
           CPU-only latch).
         """
+        action = self._on_mesh_device_loss_inner(exc, conf)
+        _record_ladder_incident("mesh.ladder", action, exc, conf)
+        return action
+
+    def _on_mesh_device_loss_inner(self, exc: BaseException, conf) -> str:
         from spark_rapids_tpu.parallel.mesh import (
             MESH,
             MESH_DEGRADE_MAX_SHRINKS,
@@ -312,6 +340,11 @@ class DeviceHealthMonitor:
           even under the single-process latch: escalate to the
           whole-backend ladder (:meth:`on_device_loss`).
         """
+        action = self._on_host_loss_inner(exc, conf)
+        _record_ladder_incident("host.ladder", action, exc, conf)
+        return action
+
+    def _on_host_loss_inner(self, exc: BaseException, conf) -> str:
         from spark_rapids_tpu.runtime.cluster import (
             CLUSTER,
             CLUSTER_MAX_HOST_LOSSES,
@@ -471,14 +504,30 @@ class QuarantineRegistry:
         with self._lock:
             history = self._strikes.setdefault(template_fp, [])
             history.append(reason)
+            strikes = len(history)
             self._metrics.add("quarantineStrikes", 1)
-            if template_fp in self._quarantined:
-                return False
-            if len(history) >= max(1, int(max_strikes)):
+            already = template_fp in self._quarantined
+            quarantined = (not already
+                           and strikes >= max(1, int(max_strikes)))
+            if quarantined:
                 self._quarantined[template_fp] = list(history)
                 self._metrics.add("quarantinedTemplates", 1)
-                return True
-            return False
+        # flight-recorder hook OUTSIDE the registry lock (the bundle
+        # re-reads this registry's snapshot) and ASYNC: callers hold
+        # the scheduler's condition lock here (worker-loss handling),
+        # and a bundle write to a slow dir must not stall the
+        # service's submit/pick/finish paths for its duration
+        try:
+            from spark_rapids_tpu.obs.telemetry import (
+                record_incident_async,
+            )
+            record_incident_async(
+                "quarantine", "quarantined" if quarantined else "strike",
+                reason, extra={"template": template_fp,
+                               "strikes": strikes})
+        except Exception:
+            pass
+        return quarantined
 
     def is_quarantined(self, template_fp: Optional[str]) -> Optional[List[str]]:
         """The strike history when quarantined, else None."""

@@ -79,6 +79,13 @@ def _mesh_shape():
     return MESH.shape_str()
 
 
+def _host_topology():
+    """The active cluster host topology for serve-time event records
+    (None when cluster execution is off)."""
+    from spark_rapids_tpu.runtime.cluster import CLUSTER
+    return CLUSTER.topology_str()
+
+
 SERVICE_POOLS = str_conf(
     "spark.rapids.service.pools", "default",
     "Named scheduling pools: semicolon-separated 'name[:weight=W]' "
@@ -132,6 +139,19 @@ SERVICE_RESULT_CACHE_MAX_BYTES = int_conf(
     "spark.rapids.service.resultCache.maxBytes", 256 << 20,
     "LRU byte bound on cached result tables (HostTable.nbytes sum); "
     "results larger than this never cache.")
+
+SERVICE_INTROSPECT_ENABLED = bool_conf(
+    "spark.rapids.service.introspect.enabled", False,
+    "Serve the service's live surface (health/stats/SLOs/query table/"
+    "telemetry tail) as JSON on a loopback-only HTTP endpoint "
+    "(service/introspect.py) polled by `python -m spark_rapids_tpu."
+    "tools top`. The bound port is QueryService.introspect_port.",
+    commonly_used=True)
+
+SERVICE_INTROSPECT_PORT = int_conf(
+    "spark.rapids.service.introspect.port", 0,
+    "Port for the loopback introspection endpoint; 0 (default) binds "
+    "an ephemeral port, reported as QueryService.introspect_port.")
 
 
 def parse_pools(spec: str) -> "OrderedDict[str, float]":
@@ -305,6 +325,34 @@ class QueryService:
         # the watchdog: hard wall limits on RUNNING queries + the
         # dead-worker liveness backstop (service/watchdog.py)
         self._watchdog = WorkerWatchdog(self)
+
+        # rolling SLO window: (pool, tenant) -> deque of
+        # (latency_s, run_s) for recently FINISHED handles — the
+        # introspection endpoint's p50/p95 source. Mutated under _cond.
+        self._finished_lat: Dict[Tuple[str, str], deque] = {}
+
+        # observability plumbing (obs/telemetry.py): the sampler +
+        # flight-recorder defaults follow this service's conf, and the
+        # recorder embeds this service's live query table in incident
+        # bundles (weak registration — shutdown just drops out)
+        from spark_rapids_tpu.obs.telemetry import (
+            TELEMETRY,
+            register_service,
+        )
+        TELEMETRY.configure(self.conf)
+        register_service(self)
+
+        # live introspection endpoint (service/introspect.py):
+        # loopback-only HTTP JSON, polled by `tools top`
+        self.introspect = None
+        self.introspect_port: Optional[int] = None
+        if bool(self.conf.get_entry(SERVICE_INTROSPECT_ENABLED)):
+            from spark_rapids_tpu.service.introspect import (
+                IntrospectionServer,
+            )
+            self.introspect = IntrospectionServer(
+                self, int(self.conf.get_entry(SERVICE_INTROSPECT_PORT)))
+            self.introspect_port = self.introspect.port
 
     # -- submission ----------------------------------------------------------
     def submit(self, query, *, tenant: str = "default",
@@ -789,6 +837,7 @@ class QueryService:
                 if handle._transition(QueryState.FINISHED,
                                       result=cached.table):
                     self._count_event("finished")
+                    self._note_finished(handle)
                 return
             with cancel_scope(handle.scope):
                 self.session.next_query_tag = handle.tag
@@ -810,6 +859,7 @@ class QueryService:
                                       epoch=epoch)
             if handle._transition(QueryState.FINISHED, result=table):
                 self._count_event("finished")
+                self._note_finished(handle)
         except QueryCancelledError as exc:
             if handle._transition(QueryState.CANCELLED, error=exc):
                 self._count_event("cancelled")
@@ -872,6 +922,15 @@ class QueryService:
             "meshDegradations": 0,
             "shardRetries": 0,
             "gatherChecksFailed": 0,
+            # v8 host fault-domain fields at SERVE time (the schema's
+            # documented contract — the filling run's host losses must
+            # not replay as this serve's degradation events) and the
+            # v9 per-host scan table: a cached serve dispatches nothing
+            "hostTopology": _host_topology(),
+            "hostsLost": 0,
+            "hostRelands": 0,
+            "dcnExchanges": 0,
+            "hostScans": {},
         })
         handle.event_record = rec
         try:
@@ -901,6 +960,9 @@ class QueryService:
                 w.thread.join(timeout=30)
             self._sweeper.join(timeout=5)
             self._watchdog.join(timeout=5)
+        if self.introspect is not None:
+            self.introspect.shutdown()
+            self.introspect = None
 
     def __enter__(self) -> "QueryService":
         return self
@@ -910,6 +972,94 @@ class QueryService:
         return False
 
     # -- introspection -------------------------------------------------------
+
+    #: FINISHED handles retained per (pool, tenant) for the rolling
+    #: SLO percentiles (a window, not a conf: the introspection
+    #: surface is an operator tool, not a tuning target)
+    _SLO_WINDOW = 512
+
+    def _note_finished(self, handle: QueryHandle) -> None:
+        """Record a FINISHED handle's latency/run wall into the rolling
+        SLO window (the /slo endpoint's source)."""
+        lat, run = handle.latency_s, handle.run_s
+        with self._cond:
+            dq = self._finished_lat.setdefault(
+                (handle.pool, handle.tenant),
+                deque(maxlen=self._SLO_WINDOW))
+            dq.append((lat or 0.0, run or 0.0))
+
+    @staticmethod
+    def _pcts(vals: List[float]) -> Dict[str, float]:
+        ordered = sorted(vals)
+        n = len(ordered)
+
+        def pct(q: float) -> float:
+            return ordered[min(n - 1, int(q * n))]
+
+        return {"p50S": round(pct(0.50), 6), "p95S": round(pct(0.95), 6)}
+
+    def slo_snapshot(self) -> dict:
+        """Rolling per-pool and per-tenant p50/p95 over recently
+        FINISHED handles: ``latency`` = submit->finish (queue wait
+        included — what a caller experiences), ``run`` = running wall
+        only. Empty dicts before any query finishes."""
+        with self._cond:
+            windows = [((p, t), list(dq))
+                       for (p, t), dq in self._finished_lat.items() if dq]
+        pools: Dict[str, dict] = {}
+        tenants: Dict[str, dict] = {}
+        by_pool: Dict[str, list] = {}
+        for (pool, tenant), samples in windows:
+            by_pool.setdefault(pool, []).extend(samples)
+            tenants[f"{pool}/{tenant}"] = {
+                "count": len(samples),
+                "latency": self._pcts([s[0] for s in samples]),
+                "run": self._pcts([s[1] for s in samples]),
+            }
+        for pool, samples in by_pool.items():
+            pools[pool] = {
+                "count": len(samples),
+                "latency": self._pcts([s[0] for s in samples]),
+                "run": self._pcts([s[1] for s in samples]),
+            }
+        return {"window": self._SLO_WINDOW, "pools": pools,
+                "tenants": dict(sorted(tenants.items()))}
+
+    def query_table(self, blocking: bool = True) -> Optional[List[dict]]:
+        """The live query table: RUNNING handles (from the workers)
+        plus QUEUED handles in pick order context. ``blocking=False``
+        is the flight recorder's no-wait contract: the recorder must
+        never stall behind a busy scheduler, so a contended condition
+        lock yields None ("table unavailable") instead of queueing the
+        bundle write on it. (Condition wraps an RLock, so a same-
+        thread caller re-enters successfully either way.)"""
+        if not self._cond.acquire(blocking=blocking):
+            return None
+        try:
+            now = time.monotonic()
+            out: List[dict] = []
+            for w in self._workers:
+                h = w.handle
+                if h is None:
+                    continue
+                out.append({
+                    "id": h.query_id, "state": h.state,
+                    "tenant": h.tenant, "pool": h.pool, "tag": h.tag,
+                    "worker": w.name,
+                    "runningS": (round(now - h.start_t, 3)
+                                 if h.start_t is not None else None),
+                })
+            for (pool, tenant), dq in self._queues.items():
+                for h in dq:
+                    out.append({
+                        "id": h.query_id, "state": "QUEUED",
+                        "tenant": tenant, "pool": pool, "tag": h.tag,
+                        "queuedS": round(now - h.submit_t, 3),
+                    })
+        finally:
+            self._cond.release()
+        return out
+
     def _health_state_locked(self) -> str:
         """HEALTHY → DEGRADED → CPU_ONLY. CPU_ONLY comes from the
         process-wide device latch; DEGRADED while the device is mid

@@ -290,6 +290,15 @@ class TpuSession:
         sql_text, q.next_sql = q.next_sql, None
         service_info, q.next_service = q.next_service, None
 
+        if not q.exec_depth:
+            # fresh per-host scan attribution for this top-level query
+            # (thread-local, like the dispatch counters — nested
+            # executes accumulate into the outer query's table)
+            from spark_rapids_tpu.runtime.cluster import (
+                reset_host_scan_stats,
+            )
+            reset_host_scan_stats()
+
         if q.exec_depth:
             # nested query: no separate envelope, no index
             q.exec_depth += 1
@@ -386,7 +395,10 @@ class TpuSession:
                        - before_scopes.get(scope, {}).get(key, 0))
 
         from spark_rapids_tpu.parallel.mesh import MESH
-        from spark_rapids_tpu.runtime.cluster import CLUSTER
+        from spark_rapids_tpu.runtime.cluster import (
+            CLUSTER,
+            host_scan_stats,
+        )
 
         record = E.build_query_record(
             query_index=qidx,
@@ -431,6 +443,7 @@ class TpuSession:
             hosts_lost=_wdelta("hostsLost", "cluster"),
             host_relands=_wdelta("hostRelands", "cluster"),
             dcn_exchanges=_wdelta("dcnExchanges", "cluster"),
+            host_scans=host_scan_stats(),
         )
         self.last_event_record = record
         # the record has read the tree's metrics — the cached executable
@@ -464,6 +477,9 @@ class TpuSession:
             if self._event_writer is None:
                 self._event_writer = E.QueryEventWriter(
                     str(self.conf.get_entry(E.EVENT_LOG_DIR)))
+        # the flight recorder's "recent events" context rides the same
+        # funnel (slim summary, bounded ring — obs/events.py)
+        E.note_recent_record(record)
         path = self._event_writer.write(record)
         self.last_event_path = path
         return path
@@ -526,6 +542,10 @@ class TpuSession:
         )
 
         F.FAULTS.arm(str(self.conf.get_entry(TEST_FAULTS) or ""))
+        # telemetry sampler + flight-recorder defaults follow this
+        # session's conf (cheap no-op when unchanged, the arm contract)
+        from spark_rapids_tpu.obs.telemetry import TELEMETRY
+        TELEMETRY.configure(self.conf)
         rf_enabled = bool(self.conf.get_entry(RUNTIME_FALLBACK_ENABLED))
         max_failures = int(self.conf.get_entry(RUNTIME_FALLBACK_MAX_FAILURES))
         # enough budget to demote every op in a pathological plan without

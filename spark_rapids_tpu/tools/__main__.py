@@ -22,6 +22,14 @@ Subcommands:
   orphans vs the latest snapshot, committed-write-dir orphans vs the
   _SUCCESS manifest, and _temporary/ staging debris of jobs that died
   mid-write. DRY RUN by default; ``--delete`` removes.
+* ``top`` — live view of a running QueryService: polls the loopback
+  introspection endpoint (spark.rapids.service.introspect.enabled)
+  and renders health/topology, rolling per-pool/tenant p50/p95 SLOs,
+  the live query table, and the telemetry ring's latest deltas.
+* ``incident`` — render flight-recorder bundles (spark.rapids.obs.
+  flightRecorder.dir): the triggering fault point and ladder action,
+  topology at the instant of the incident, recovery counters, the
+  telemetry tail, and recent/live query context.
 
 ``--json`` emits the raw report dict for machines; exit status 2 when a
 profile's span coverage falls below ``--coverage-floor`` (default 0.95)
@@ -126,7 +134,60 @@ def main(argv=None) -> int:
     v.add_argument("--json", action="store_true",
                    help="emit the raw report JSON")
 
+    t = sub.add_parser(
+        "top",
+        help="live service view over the loopback introspection "
+             "endpoint (health, SLOs, query table, telemetry)")
+    t.add_argument("--url", type=str, default="",
+                   help="endpoint URL (default "
+                        "http://127.0.0.1:<port>/top from --port)")
+    t.add_argument("--port", type=int, default=0,
+                   help="introspection port (QueryService."
+                        "introspect_port)")
+    t.add_argument("--watch", type=float, default=0.0, metavar="SEC",
+                   help="poll every SEC seconds instead of one-shot")
+    t.add_argument("--iterations", type=int, default=0,
+                   help="with --watch: stop after N polls (0 = forever)")
+    t.add_argument("--json", action="store_true",
+                   help="emit the raw /top JSON per poll")
+
+    inc = sub.add_parser(
+        "incident",
+        help="render flight-recorder incident bundles "
+             "(spark.rapids.obs.flightRecorder.dir)")
+    inc.add_argument("path", nargs="?", default="",
+                     help="bundle .json file or flight-recorder dir "
+                          "(default: the conf default dir)")
+    inc.add_argument("--last", type=int, default=0,
+                     help="render only the newest N bundles")
+    inc.add_argument("--json", action="store_true",
+                     help="emit the raw bundle list JSON")
+
     args = ap.parse_args(argv)
+
+    if args.cmd == "top":
+        from spark_rapids_tpu.tools.top import run_top
+        return run_top(url=args.url or None,
+                       port=args.port or None,
+                       watch_s=args.watch,
+                       iterations=args.iterations or None,
+                       as_json=args.json)
+
+    if args.cmd == "incident":
+        from spark_rapids_tpu.obs.telemetry import FLIGHT_RECORDER_DIR
+        from spark_rapids_tpu.tools.incident import (
+            load_bundles,
+            render_incident,
+        )
+        path = args.path or str(FLIGHT_RECORDER_DIR.default)
+        try:
+            bundles = load_bundles(path)
+        except FileNotFoundError as exc:
+            print(str(exc), file=sys.stderr)
+            return 1
+        print(json.dumps(bundles) if args.json
+              else render_incident(bundles, last=args.last))
+        return 0
 
     if args.cmd == "vacuum":
         from spark_rapids_tpu.tools.vacuum import render_vacuum, run_vacuum

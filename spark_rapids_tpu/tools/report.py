@@ -20,8 +20,12 @@ from spark_rapids_tpu.obs.events import EVENT_SCHEMA_VERSION
 
 def load_events(path: str) -> List[dict]:
     """Load event records from a .jsonl file or a directory of them
-    (recursive). Unknown record shapes raise — the tools refuse to
-    silently misread a newer schema."""
+    (recursive). A schema NEWER than this build raises (the tools
+    refuse to silently misread fields they don't know about); OLDER
+    schemas load with one warning for the whole call — the analyzers
+    treat every per-version field as 0/absent via ``.get`` defaults,
+    so a mixed-version dir (a long-lived eventlog dir spanning an
+    engine upgrade) compares/profiles instead of crashing."""
     files: List[str] = []
     if os.path.isdir(path):
         for dirpath, _dirs, names in os.walk(path):
@@ -35,6 +39,7 @@ def load_events(path: str) -> List[dict]:
     if not files:
         raise FileNotFoundError(f"no .jsonl event logs under {path}")
     records: List[dict] = []
+    old_schemas: set = set()
     for f in files:
         with open(f) as fh:
             for lineno, line in enumerate(fh, 1):
@@ -43,12 +48,22 @@ def load_events(path: str) -> List[dict]:
                     continue
                 rec = json.loads(line)
                 schema = rec.get("schema")
-                if schema != EVENT_SCHEMA_VERSION:
+                if not isinstance(schema, int) or schema < 1 \
+                        or schema > EVENT_SCHEMA_VERSION:
                     raise ValueError(
                         f"{f}:{lineno}: unsupported event schema "
-                        f"{schema!r} (this tools build reads schema "
-                        f"{EVENT_SCHEMA_VERSION})")
+                        f"{schema!r} (this tools build reads schemas "
+                        f"1..{EVENT_SCHEMA_VERSION})")
+                if schema < EVENT_SCHEMA_VERSION:
+                    old_schemas.add(schema)
                 records.append(rec)
+    if old_schemas:
+        import sys
+        print(
+            f"tools: {path} contains records with older event "
+            f"schema(s) {sorted(old_schemas)} (current "
+            f"{EVENT_SCHEMA_VERSION}); fields those versions lack "
+            "are treated as 0/absent", file=sys.stderr)
     return records
 
 
@@ -169,6 +184,7 @@ def analyze_query(rec: dict, top_n: int = 10) -> dict:
         "hostsLost": int(rec.get("hostsLost", 0)),
         "hostRelands": int(rec.get("hostRelands", 0)),
         "dcnExchanges": int(rec.get("dcnExchanges", 0)),
+        "hostScans": rec.get("hostScans") or {},
         "attribution": {
             "attributedS": round(attributed, 6),
             "untrackedS": round(float(spans.get("untrackedS", 0.0)), 6),
@@ -273,6 +289,20 @@ def build_profile(records: Iterable[dict], top_n: int = 10,
     # host resilience (schema v8): the multi-host fault-domain counters
     # — hosts lost and shards re-landed during the run, plus how many
     # collectives crossed the DCN axis (cluster-spanning meshes)
+    # per-executor-host scan attribution (schema v9): each host's
+    # dispatch/frame/byte/wall totals summed over the run — the
+    # per-host breakdown a skewed or flaky executor shows up in
+    per_host: Dict[str, dict] = {}
+    for q in queries:
+        for host, st in (q["hostScans"] or {}).items():
+            agg = per_host.setdefault(
+                host, {"scans": 0, "files": 0, "bytes": 0,
+                       "wallS": 0.0, "execWallS": 0.0, "crcRetries": 0})
+            for k in agg:
+                v = st.get(k, 0)
+                agg[k] = (round(agg[k] + float(v), 6)
+                          if isinstance(agg[k], float)
+                          else agg[k] + int(v))
     host_resilience = {
         "hostTopologies": sorted({q["hostTopology"] for q in queries
                                   if q["hostTopology"]}),
@@ -282,6 +312,7 @@ def build_profile(records: Iterable[dict], top_n: int = 10,
         "degradedQueries": sorted(
             {q["query"] for q in queries
              if q["hostsLost"] or q["hostRelands"]}),
+        "perHost": {h: per_host[h] for h in sorted(per_host)},
     }
     # survivability (schema v4): how healthy was the process this run,
     # and which queries rode through recovery events
@@ -373,7 +404,7 @@ def render_profile(report: dict) -> str:
                if mr.get("degradedQueries") else ""))
     hr = report.get("hostResilience") or {}
     if (hr.get("hostsLost") or hr.get("hostRelands")
-            or hr.get("dcnExchanges")):
+            or hr.get("dcnExchanges") or hr.get("perHost")):
         lines.append(
             f"Host resilience: hosts lost {hr['hostsLost']} | shard "
             f"re-lands {hr['hostRelands']} | DCN exchanges "
@@ -382,6 +413,13 @@ def render_profile(report: dict) -> str:
                if hr.get("hostTopologies") else "")
             + (f" | degraded: {', '.join(hr['degradedQueries'])}"
                if hr.get("degradedQueries") else ""))
+        for host, st in (hr.get("perHost") or {}).items():
+            lines.append(
+                f"  host {host}: {st['scans']} dispatches, "
+                f"{st['files']} frames, {st['bytes']} bytes, wall "
+                f"{st['wallS']:.4f}s (executor {st['execWallS']:.4f}s)"
+                + (f", CRC retries {st['crcRetries']}"
+                   if st.get("crcRetries") else ""))
     sv = report["survivability"]
     if (sv["deviceReinits"] or sv["workerRestarts"]
             or sv["quarantinedQueries"]

@@ -800,6 +800,56 @@ def test_rl_fault_point_mesh_domain():
     assert any("mesh.gather" in d.message for d in uncalled)
 
 
+def test_rl_obs_passive():
+    """RL-OBS-PASSIVE: the telemetry sampler may not call host_fetch /
+    device syncs, touch jax, drive query execution, or take the
+    query-path locks — sampling must never perturb execution (ISSUE 14
+    satellite)."""
+    from spark_rapids_tpu.lint.repo_lint import _check_obs_passive
+    rel = "spark_rapids_tpu/obs/telemetry.py"
+    src = (
+        "import jax\n"                                     # device work
+        "from spark_rapids_tpu.dispatch import host_fetch\n"
+        "def bad_sample(session, svc, exe, table):\n"
+        "    a = host_fetch(table)\n"                      # host sync
+        "    b = jax.device_get(table)\n"                  # host sync
+        "    finalize_observation(exe)\n"                  # device fetch
+        "    session.execute(table)\n"                     # drives a query
+        "    with session._obs_lock:\n"                    # query-path lock
+        "        pass\n"
+        "    svc._cond.acquire()\n"                        # query-path lock
+    )
+    diags = _run_rl(_check_obs_passive, rel, src)
+    hits = _find(diags, "RL-OBS-PASSIVE")
+    assert len(hits) == 7, [str(d) for d in hits]
+    msgs = " ".join(d.message for d in hits)
+    assert "host sync" in msgs and "query-path lock" in msgs
+    assert "drives query execution" in msgs
+    # the sampler's own bounded reads are clean: snapshot surfaces,
+    # its private ring lock, plain time/json work
+    ok = (
+        "import threading, time\n"
+        "from spark_rapids_tpu.obs.metrics import scopes_snapshot\n"
+        "_lock = threading.Lock()\n"
+        "def sample():\n"
+        "    snap = scopes_snapshot()\n"
+        "    with _lock:\n"
+        "        return dict(snap)\n"
+    )
+    assert _run_rl(_check_obs_passive, rel, ok) == []
+    # scoped to the telemetry module only
+    assert _run_rl(_check_obs_passive,
+                   "spark_rapids_tpu/obs/events.py", src) == []
+    # and the REAL module is clean under the rule
+    import os
+
+    import spark_rapids_tpu
+    root = os.path.dirname(os.path.dirname(
+        os.path.abspath(spark_rapids_tpu.__file__)))
+    real = open(os.path.join(root, rel)).read()
+    assert _run_rl(_check_obs_passive, rel, real) == []
+
+
 def test_every_rule_has_a_negative_test():
     """Meta-pin: the rule surface and this module's negative coverage
     cannot drift apart (>= 12 rules required by the issue)."""

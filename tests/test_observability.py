@@ -229,13 +229,14 @@ def test_event_log_written_and_valid(tmp_path):
     lines = open(s.last_event_path).read().strip().splitlines()
     assert len(lines) == 1
     rec = json.loads(lines[0])
-    # schema v8: the multi-host fault-domain PR added hostTopology /
-    # hostsLost / hostRelands / dcnExchanges (null/0/0/0 off-cluster)
-    # on top of v7's mesh fault-domain fields (meshDegradations /
-    # shardRetries / gatherChecksFailed — all 0 on a healthy mesh and
-    # off-mesh), v6's mesh-native fields, v5's transactional-write
-    # fields and v4's survivability fields — see obs/events.py
-    assert rec["schema"] == 8
+    # schema v9: the flight-recorder PR added hostScans (per-executor-
+    # host scan attribution merged from cluster scan replies; {}
+    # off-cluster) on top of v8's multi-host fault-domain fields
+    # (hostTopology / hostsLost / hostRelands / dcnExchanges —
+    # null/0/0/0 off-cluster), v7's mesh fault-domain fields, v6's
+    # mesh-native fields, v5's transactional-write fields and v4's
+    # survivability fields — see obs/events.py
+    assert rec["schema"] == 9
     assert rec["healthState"] == "HEALTHY"
     assert rec["quarantined"] is False
     assert rec["deviceReinits"] == 0 and rec["workerRestarts"] == 0
@@ -248,6 +249,7 @@ def test_event_log_written_and_valid(tmp_path):
     assert rec["hostTopology"] is None
     assert rec["hostsLost"] == 0 and rec["hostRelands"] == 0
     assert rec["dcnExchanges"] == 0
+    assert rec["hostScans"] == {}
     assert rec["event"] == "queryCompleted"
     assert rec["queryTag"] == "golden"
     assert rec["wallS"] > 0
@@ -307,7 +309,12 @@ def test_event_log_golden_schema(tmp_path):
     hosts' shards re-landed onto survivors, and collectives that
     crossed the DCN axis during this query's wall — per-record deltas
     of the cluster scope; all 0/null off-cluster and for result-cache
-    serves)."""
+    serves);
+    v9 = flight-recorder fields (hostScans — per-executor-host scan
+    attribution merged from cluster scan replies: {host: {scans,
+    files, bytes, wallS, execWallS, crcRetries}}; {} off-cluster, for
+    local-fallback scans and for result-cache serves — a cached serve
+    dispatches nothing)."""
     s = _run_eventlog_query(tmp_path)
     got = _normalize(s.last_event_record)
     golden_path = os.path.join(os.path.dirname(__file__),
