@@ -1083,6 +1083,505 @@ def _run_chaos_concurrent(report, failures, wanted, expected_tables,
 # ---------------------------------------------------------------------------
 
 
+def memory_chaos_fault_spec(seed: int) -> str:
+    """The seeded memory-fault schedule: every ``mem.*`` point fires at
+    least once (asserted by run_memory_chaos) — a budget squeeze
+    mid-query (the retry framework spills and replays), a spill
+    FAILURE (the demotion path dies; circuit-breaker/replay recovers),
+    and an unspill CORRUPTION (the disk frame's CRC footer trips;
+    typed SpillCorruptionError re-lands from the scan cache via query
+    replay). COUNT-based entries only, so the schedule is
+    deterministic and the post-corpus phases run fault-free."""
+    return ";".join([
+        f"mem.reserve:oom:2:{seed * 10 + 1}",
+        f"mem.spill:crash:1:{seed * 10 + 2}",
+        f"mem.unspill:corrupt:1:{seed * 10 + 3}",
+    ])
+
+
+#: whole-run recovery-work ceilings for the memory chaos closure (a
+#: runaway spill/retry loop must fail the run, not grind through it)
+MEMORY_CHAOS_BOUNDS = {"query_replays": 30, "oomRetries": 4000,
+                       "splitRetries": 200, "spillCorruptions": 4,
+                       "budgetRaises": 2000}
+
+
+def tables_differ_unordered(a, b):
+    """Bitwise row-MULTISET comparison: chunked/budgeted execution
+    legitimately changes the ROW ORDER of unsorted output (group-by
+    emission order follows batching), but every row must still exist
+    bitwise-identically on both sides. repr() round-trips floats
+    exactly (and distinguishes -0.0), so sorting the repr'd rows
+    compares value bits, not approximations."""
+    if a.names != b.names:
+        return f"column names differ: {a.names} vs {b.names}"
+    if a.num_rows != b.num_rows:
+        return f"row counts differ: {a.num_rows} vs {b.num_rows}"
+    rows_a = sorted(map(repr, zip(*[c.to_pylist() for c in a.columns])))
+    rows_b = sorted(map(repr, zip(*[c.to_pylist() for c in b.columns])))
+    if rows_a != rows_b:
+        for i, (ra, rb) in enumerate(zip(rows_a, rows_b)):
+            if ra != rb:
+                return f"row multiset differs (first at sorted #{i}: " \
+                       f"{ra} vs {rb})"
+    return None
+
+
+def tables_close(a, b, rtol=1e-9):
+    """Order-insensitive SEMANTIC comparison: non-float values exact,
+    floats within rtol. Used to pin that chunked execution computes
+    the same ANSWER as unchunked — f64 partial merges over different
+    batch structures legitimately differ in final ulps (addition is
+    not associative), which is exactly why the bitwise contract runs
+    against the same-shape baseline instead."""
+    if a.names != b.names:
+        return f"column names differ: {a.names} vs {b.names}"
+    if a.num_rows != b.num_rows:
+        return f"row counts differ: {a.num_rows} vs {b.num_rows}"
+
+    def key(row):
+        return tuple(f"{v:.6g}" if isinstance(v, float) else repr(v)
+                     for v in row)
+
+    rows_a = sorted(zip(*[c.to_pylist() for c in a.columns]), key=key)
+    rows_b = sorted(zip(*[c.to_pylist() for c in b.columns]), key=key)
+    for i, (ra, rb) in enumerate(zip(rows_a, rows_b)):
+        for va, vb in zip(ra, rb):
+            if isinstance(va, float) and isinstance(vb, float):
+                if va != vb and not (
+                        abs(va - vb) <= rtol * max(abs(va), abs(vb))):
+                    return f"row {i}: {va!r} !~ {vb!r}"
+            elif va != vb:
+                return f"row {i}: {va!r} != {vb!r}"
+    return None
+
+
+def run_memory_chaos(sf: float, seed: int, budget: int, queries=None,
+                     use_sql: bool = False, chaos: bool = True):
+    """``--device-budget BYTES [--chaos]``: q1-q22 under a hard device
+    budget well below the working set — every landing accounted by the
+    MemoryArbiter, scans chunked, intermediates spilled through the
+    device->host->disk tiers (host tier squeezed so the DISK tier and
+    its CRC footers see traffic) — asserting every query bit-identical
+    to unbudgeted execution, spillBytes > 0, zero budget violations,
+    recovery within MEMORY_CHAOS_BOUNDS and (with --chaos) every
+    ``mem.*`` fault point fired, a full memory-ladder walk with one
+    incident bundle per action, and a QueryService ending HEALTHY.
+    This is the OOC_r01 acceptance harness — ROADMAP item 2's
+    out-of-core half exercised end to end."""
+    from spark_rapids_tpu.datagen import scale_test_specs
+    from spark_rapids_tpu.obs.metrics import scopes_snapshot
+    from spark_rapids_tpu.runtime.faults import (
+        CIRCUIT_BREAKER,
+        FAULTS,
+        RECOVERY,
+    )
+    from spark_rapids_tpu.runtime.health import HEALTH
+    from spark_rapids_tpu.runtime.memory import MEMORY
+    from spark_rapids_tpu.runtime.spill import BufferCatalog
+    from spark_rapids_tpu.session import TpuSession
+
+    specs = scale_test_specs(sf)
+    tables = {name: spec.generate_table(sf, seed=seed)
+              for name, spec in specs.items()}
+    build = build_sql_queries if use_sql else build_queries
+
+    # 16KB host tier: device spills overflow to DISK almost instantly,
+    # so the CRC-footed frames and the mem.unspill point see traffic
+    BufferCatalog.reset(host_limit_bytes=16 * 1024)
+    MEMORY.reset()
+
+    import os
+    import tempfile
+    flight_dir = tempfile.mkdtemp(prefix="rapids_mem_flightrec_")
+    spec = memory_chaos_fault_spec(seed) if chaos else ""
+    plain = TpuSession()
+    # chunk share at a TENTH of the budget: the join/agg pipeline's
+    # irreducible live set (current probe chunk + join output + build
+    # + coalesce pending) is a few chunk shares wide — keeping it well
+    # under the budget is what makes ZERO violations achievable while
+    # spill pressure still builds across the query
+    chunk_fraction = 0.1
+    budgeted = TpuSession({
+        "spark.rapids.memory.device.budgetBytes": str(int(budget)),
+        "spark.rapids.memory.device.scanChunkFraction":
+            str(chunk_fraction),
+        "spark.rapids.sql.runtimeFallback.enabled": "true",
+        "spark.rapids.test.faults": spec,
+        "spark.rapids.obs.telemetry.enabled": "true",
+        "spark.rapids.obs.telemetry.intervalMs": "200",
+        "spark.rapids.obs.flightRecorder.dir": flight_dir,
+    })
+    plain_queries = build(plain, tables)
+    budget_queries = build(budgeted, tables)
+    wanted = queries or list(plain_queries)
+
+    report = {"mode": "memory-chaos", "backend": _resolved_backend(),
+              "scale_factor": sf, "seed": seed, "sql": use_sql,
+              "device_budget_bytes": int(budget),
+              "chaos": bool(chaos),
+              "fault_spec": spec, "queries": {}}
+    failures = []
+
+    # ALL baselines first (run_mesh_chaos's discipline: the baseline
+    # session's arm('') must not reset the seeded schedule). TWO
+    # baselines per query:
+    #
+    # * UNBUDGETED (plain): measures the working set — the arbiter's
+    #   peak accounted bytes over the whole corpus is what the budget
+    #   must sit well below for the run to prove anything.
+    # * SHAPE baseline (plain session under forced_chunking at the
+    #   budget's chunk share, NO enforcement): executes the exact
+    #   batching structure the budgeted run will take — chunked scans,
+    #   capped coalesce flushes, sub-partitioned builds — with zero
+    #   spills/retries. The budgeted run must be BITWISE IDENTICAL to
+    #   it: multi-batch f64 partial merges are only reproducible
+    #   against the same batch structure (the MeshReland bit-identity
+    #   argument), so this is the comparison that isolates what the
+    #   PR adds — enforcement, spill round trips, retries — and
+    #   proves it corrupts nothing.
+    from spark_rapids_tpu.runtime.memory import forced_chunking
+    expected_plain = {name: plain_queries[name]().collect_table()
+                      for name in wanted}
+    working_set = MEMORY.snapshot()["peakBytes"]
+    report["working_set_peak_bytes"] = int(working_set)
+    if budget >= working_set:
+        failures.append(
+            f"--device-budget {budget} is not below the measured "
+            f"unbudgeted working-set peak {working_set} — the run "
+            "would prove nothing")
+    chunk_share = max(1, int(budget * chunk_fraction))
+    report["chunk_share_bytes"] = chunk_share
+    expected_chunked = {}
+    with forced_chunking(chunk_share):
+        for name in wanted:
+            expected_chunked[name] = plain_queries[name]().collect_table()
+    # chunking must not change the ANSWER (row multiset, float ulps
+    # aside the values are the same computation): pin the shape
+    # baseline against the plain one order-insensitively before
+    # trusting it as the identity reference
+    for name in wanted:
+        sem = tables_close(expected_plain[name], expected_chunked[name])
+        if sem is not None:
+            failures.append(f"{name}: chunked execution changed the "
+                            f"answer vs unchunked: {sem}")
+    # a fresh ledger + clean caches for the budgeted phase (the
+    # baseline scans' cached unchunked device images would otherwise
+    # start the budgeted run already over budget)
+    from spark_rapids_tpu.columnar.table import evict_device_caches
+    evict_device_caches()
+    MEMORY.reset()
+
+    def _mem():
+        return dict(scopes_snapshot().get("memory", {}))
+
+    recovery_before = RECOVERY.snapshot()
+    mem_before_all = _mem()
+
+    # -- spill round-trip closure ---------------------------------------------
+    # The full demotion chain on the REAL corpus data, bitwise: every
+    # lineitem chunk lands (budget-enforced, OOM-retried), registers as
+    # a SpillableDeviceTable, is forced through device->host->disk
+    # (the 16KB host tier overflows to CRC-footed disk frames
+    # immediately), and re-lands via get() — the armed mem.unspill
+    # corruption fires at the first disk read here, and the phase
+    # demonstrates the documented recovery: typed SpillCorruptionError,
+    # frame dropped, data re-landed from the source chunk, still
+    # bitwise identical. The armed mem.reserve squeezes fire at these
+    # landings too (survived by the retry framework).
+    from spark_rapids_tpu.errors import (
+        KernelCrashError,
+        SpillCorruptionError,
+    )
+    from spark_rapids_tpu.runtime.memory import scan_chunks
+    from spark_rapids_tpu.runtime.retry import retry_block
+    from spark_rapids_tpu.runtime.spill import SpillableDeviceTable
+    from spark_rapids_tpu.columnar import DeviceTable
+
+    def _spill_all_tolerant(counter: dict) -> None:
+        """One forced demotion pass, surviving the armed mem.spill
+        CRASH (the spill path itself dying leaves the buffer resident
+        — the documented failure mode); the immediate retry drains
+        the rest of the demotion."""
+        try:
+            catalog.spill_all_device()
+        except KernelCrashError:
+            counter["spillCrashesSurvived"] = counter.get(
+                "spillCrashesSurvived", 0) + 1
+            catalog.spill_all_device()
+    if chaos:
+        FAULTS.arm(spec)
+    catalog = BufferCatalog.get()
+    roundtrip = {"chunks": 0, "unspillsBitIdentical": 0,
+                 "corruptionsRelanded": 0}
+    budgeted.set_conf("spark.rapids.memory.device.budgetBytes",
+                      str(int(budget)))
+    MEMORY.configure(budgeted.conf)
+    with forced_chunking(chunk_share):
+        li_chunks = scan_chunks(tables["lineitem"])
+    sbs = []
+    try:
+        for ch in li_chunks:
+            dt = retry_block(lambda c=ch: DeviceTable.from_host(c))
+            sbs.append((ch, SpillableDeviceTable(dt, catalog)))
+            del dt
+        _spill_all_tolerant(roundtrip)  # host tier overflows to disk
+        for ch, sb in sbs:
+            roundtrip["chunks"] += 1
+            try:
+                got_dt = sb.get()
+            except SpillCorruptionError:
+                # the corrupt frame was dropped, never served: re-land
+                # from the source chunk (the scan-cache re-land path)
+                got_dt = retry_block(
+                    lambda c=ch: DeviceTable.from_host(c))
+                roundtrip["corruptionsRelanded"] += 1
+            rt_diff = tables_differ(ch, got_dt.to_host())
+            if rt_diff is not None:
+                failures.append(
+                    f"spill round trip chunk {roundtrip['chunks']} not "
+                    f"bit-identical: {rt_diff}")
+            else:
+                roundtrip["unspillsBitIdentical"] += 1
+            del got_dt
+            _spill_all_tolerant(roundtrip)
+    finally:
+        for _, sb in sbs:
+            sb.release()
+    report["spill_roundtrip"] = roundtrip
+    if chaos and roundtrip["corruptionsRelanded"] != 1:
+        failures.append(
+            f"expected exactly 1 corrupt unspill re-landed in the "
+            f"round-trip phase, got {roundtrip['corruptionsRelanded']}")
+
+    for name in wanted:
+        before = _mem()
+        fires_before = FAULTS.counters()
+        t0 = time.perf_counter()
+        got = budget_queries[name]().collect_table()
+        wall = time.perf_counter() - t0
+        after = _mem()
+        # BITWISE identity against the same-shape baseline: the
+        # budgeted run's spills/unspills/retries must not change one
+        # bit of what the identical batch structure computes clean
+        diff = tables_differ(expected_chunked[name], got)
+        compare_mode = "bitwise"
+        if diff is not None and (CIRCUIT_BREAKER.demoted_ops()
+                                 or HEALTH.state() != "HEALTHY"):
+            # an active demotion changes float accumulation order vs
+            # the pre-demotion baseline (process-wide): re-collect the
+            # baseline through the same demoted plan (run_chaos
+            # pattern; suspended() keeps the schedule from resetting)
+            with FAULTS.suspended(), forced_chunking(chunk_share):
+                redo = plain_queries[name]().collect_table()
+            diff = tables_differ(redo, got)
+            compare_mode = "bitwise_vs_demoted"
+        if diff is not None:
+            # a mid-query split-and-retry legitimately changes the
+            # batch structure (halved inputs re-accumulate): fall back
+            # to the order-insensitive multiset view before declaring
+            # divergence, and report which contract held
+            if tables_differ_unordered(expected_chunked[name],
+                                       got) is None:
+                diff = None
+                compare_mode = "multiset"
+        entry = {
+            "chaos_s": round(wall, 4),
+            "identical": diff is None,
+            "compare_mode": compare_mode,
+            "memory": {k: int(after.get(k, 0) - before.get(k, 0))
+                       for k in ("oomRetries", "splitRetries",
+                                 "spillBytes", "unspills", "scanChunks",
+                                 "arbiterSpills", "budgetRaises",
+                                 "spillCorruptions", "budgetViolations")
+                       if after.get(k, 0) != before.get(k, 0)},
+            "fault_fires": {
+                k: v - fires_before.get(k, 0)
+                for k, v in FAULTS.counters().items()
+                if v - fires_before.get(k, 0)},
+            "budget_peak": MEMORY.snapshot()["peakBytes"],
+        }
+        if diff is not None:
+            failures.append(f"{name}: {diff}")
+        report["queries"][name] = entry
+        print(json.dumps({"query": name, **entry}))
+
+    # -- closure assertions ---------------------------------------------------
+    mem_after_all = _mem()
+    moved = {k: int(mem_after_all.get(k, 0) - mem_before_all.get(k, 0))
+             for k in set(mem_after_all) | set(mem_before_all)}
+    report["memory_totals"] = {k: v for k, v in sorted(moved.items())
+                               if v}
+    if moved.get("spillBytes", 0) <= 0:
+        failures.append("spillBytes == 0: the budget never forced a "
+                        "spill — it is not below the working set")
+    if moved.get("unspills", 0) <= 0:
+        failures.append("unspills == 0: spilled data never round-"
+                        "tripped back to the device")
+    if moved.get("scanChunks", 0) <= 0:
+        failures.append("scanChunks == 0: no scan ever chunked")
+    if moved.get("budgetViolations", 0) != 0:
+        failures.append(
+            f"budgetViolations={moved['budgetViolations']}: a landing "
+            "exceeded the budget after spilling — enforcement leaked")
+    arb = MEMORY.snapshot()
+    report["arbiter"] = arb
+    report["budgeted_peak_bytes"] = arb["peakBytes"]
+    if arb["budgetViolations"] != 0:
+        # (redundant with the scope delta above, but the snapshot is
+        # the arbiter's own ground truth for the budgeted phase)
+        failures.append(
+            f"arbiter recorded {arb['budgetViolations']} budget "
+            "violations in the budgeted phase")
+    if chaos:
+        fires = FAULTS.counters()
+        for point in sorted(e.split(":")[0] for e in spec.split(";")):
+            if not fires.get(point):
+                failures.append(
+                    f"armed memory fault point {point} never fired — "
+                    "the schedule does not cover the out-of-core path")
+        report["fault_fires_total"] = dict(fires)
+    recovery = {k: v - recovery_before[k]
+                for k, v in RECOVERY.snapshot().items()}
+    for k in ("oomRetries", "splitRetries", "spillCorruptions",
+              "budgetRaises"):
+        recovery[k] = moved.get(k, 0)
+    report["recovery"] = recovery
+    for field, bound in MEMORY_CHAOS_BOUNDS.items():
+        if recovery.get(field, 0) > bound:
+            failures.append(f"{field}={recovery[field]} exceeds the "
+                            f"memory chaos bound {bound}")
+
+    # -- ladder closure: the full walk, one incident bundle per action -------
+    if chaos:
+        from spark_rapids_tpu.tools.incident import (
+            load_bundles,
+            render_incident,
+        )
+        FAULTS.disarm()
+        ladder_before = HEALTH.memory_snapshot()["memoryPressureEvents"]
+        # a sustained squeeze (every reservation refused for 10 grants)
+        # walks retry -> chunk -> cpu_demote end to end and STILL
+        # completes; compared against a baseline re-collected through
+        # the same demoted plan
+        ladder = TpuSession({
+            "spark.rapids.memory.device.budgetBytes": str(int(budget)),
+            "spark.rapids.memory.device.scanChunkFraction":
+                str(chunk_fraction),
+            "spark.rapids.sql.runtimeFallback.enabled": "true",
+            "spark.rapids.test.faults":
+                f"mem.reserve:oom:10:{seed * 10 + 9}",
+            "spark.rapids.obs.flightRecorder.dir": flight_dir,
+        })
+        ladder_queries = build(ladder, tables)
+        probe = wanted[0]
+        # the WALK itself: sustained refusals drive retry -> chunk ->
+        # cpu_demote; completion (not identity) is the contract here —
+        # attempts mid-walk mix demotion states by design
+        got = ladder_queries[probe]().collect_table()
+        assert got is not None
+        # the POST-WALK contract: with the demotions now in place and
+        # the schedule spent, a clean re-run of the same query is
+        # bitwise identical to a plain-session run through the same
+        # demoted plan at the same chunk share
+        FAULTS.disarm()
+        got = ladder_queries[probe]().collect_table()
+        with forced_chunking(chunk_share):
+            redo = plain_queries[probe]().collect_table()
+        ladder_snap = HEALTH.memory_snapshot()
+        actions_taken = (ladder_snap["memoryPressureEvents"]
+                         - ladder_before)
+        bundles = load_bundles(flight_dir) if os.path.isdir(flight_dir) \
+            and os.listdir(flight_dir) else []
+        mem_bundles = [b for b in bundles
+                       if b.get("kind") == "memory.ladder"]
+        ladder_diff = tables_differ(redo, got)
+        report["ladder_probe"] = {
+            "query": probe,
+            "identical": ladder_diff is None,
+            "ladder": ladder_snap,
+            "demoted_ops": CIRCUIT_BREAKER.demoted_ops(),
+            "actions_taken": actions_taken,
+            "memory_ladder_bundles": len(mem_bundles),
+            "actions_seen": sorted({b.get("action")
+                                    for b in mem_bundles}),
+        }
+        if ladder_diff is not None:
+            failures.append(f"ladder probe {probe} diverged: "
+                            f"{ladder_diff}")
+        if ladder_snap["memoryChunkedReexecutions"] < 1:
+            failures.append("ladder never reached the chunked "
+                            "re-execution rung")
+        if ladder_snap["memoryCpuDemotions"] < 1:
+            failures.append("ladder never reached the per-op CPU "
+                            "demotion rung")
+        if len(mem_bundles) < actions_taken:
+            failures.append(
+                f"only {len(mem_bundles)} memory-ladder incident "
+                f"bundles for {actions_taken} ladder actions")
+        elif mem_bundles:
+            rendered = render_incident(mem_bundles, last=1)
+            for marker in ("trigger:", "ladder:"):
+                if marker not in rendered:
+                    failures.append(f"tools incident render missing "
+                                    f"its {marker!r} section")
+        # leave a clean process for the service phase: the ladder's
+        # deliberate demotions are this probe's, not the service's
+        FAULTS.disarm()
+        CIRCUIT_BREAKER.reset()
+        HEALTH.reset()
+    report["incident_bundles_total"] = len(
+        os.listdir(flight_dir)) if os.path.isdir(flight_dir) else 0
+    report["flight_recorder_dir"] = flight_dir
+
+    # -- service closure: budgeted serving ends HEALTHY ----------------------
+    from spark_rapids_tpu.service.scheduler import QueryService
+    svc = QueryService({
+        "spark.rapids.memory.device.budgetBytes": str(int(budget)),
+        "spark.rapids.memory.device.scanChunkFraction":
+            str(chunk_fraction),
+        "spark.rapids.service.maxConcurrentQueries": "2",
+    })
+    try:
+        svc_probe = wanted[0]
+        svc_queries = (build_sql_queries if use_sql
+                       else build_queries)(svc.session, tables)
+        # the corpus closures return DataFrames when called; submit
+        # the plan through the service and compare to the baseline
+        handle = svc.submit(svc_queries[svc_probe]())
+        out = handle.result(timeout=120)
+        health = svc.health()
+        report["service"] = {
+            "state": health["state"],
+            "memory": health["memory"],
+        }
+        if health["state"] != "HEALTHY":
+            failures.append(
+                f"service ended {health['state']}, not HEALTHY")
+        if "memory" not in health:
+            failures.append("health() lacks the memory surface")
+        # the service session runs the same budget -> same chunk share
+        # -> the same-shape baseline applies bitwise here too
+        diff = tables_differ(expected_chunked[svc_probe], out)
+        if diff is not None:
+            failures.append(f"service probe {svc_probe} diverged: "
+                            f"{diff}")
+    finally:
+        svc.shutdown()
+
+    report["demoted_ops"] = CIRCUIT_BREAKER.demoted_ops()
+    report["health_state"] = HEALTH.state()
+    report["ok"] = not failures
+    report["failures"] = failures
+    FAULTS.disarm()
+    if failures:
+        err = AssertionError("memory chaos run failed:\n"
+                             + "\n".join(failures))
+        err.report = report
+        raise err
+    return report
+
+
 def mesh_chaos_fault_spec(seed: int) -> str:
     """The seeded mesh-fault schedule: every ``mesh.*`` point fires at
     least once (asserted by run_mesh_chaos), exercising all four
@@ -1987,6 +2486,26 @@ def validate_flags(args) -> None:
                 "hosts harness pins virtual host-platform (cpu) "
                 "devices, and the gate would initialize the backend "
                 "before the device-count flag can take effect")
+    if args.device_budget:
+        if args.device_budget < 4096:
+            bad(f"--device-budget {args.device_budget}: below 4KB not "
+                "even a MIN_BUCKET chunk of one column fits")
+        if args.mesh or args.hosts:
+            bad("--device-budget does not compose with --mesh/--hosts: "
+                "the memory harness asserts single-process bit-"
+                "identity against unbudgeted execution")
+        if args.concurrency or args.service_faults:
+            bad("--device-budget does not compose with --concurrency/"
+                "--service-faults: the memory harness runs serially "
+                "(its own service phase asserts HEALTHY)")
+        if args.cpu_baseline:
+            bad("--device-budget does not compose with --cpu-baseline: "
+                "the memory baseline is unbudgeted device execution, "
+                "not the CPU path")
+        if args.require_tpu:
+            bad("--device-budget does not compose with --require-tpu: "
+                "the out-of-core contract is backend-independent and "
+                "the artifact records the resolved backend in-band")
     if args.service_faults and not (args.chaos and args.concurrency > 1):
         bad("--service-faults needs --chaos --concurrency > 1 (the "
             "service fault points live in the worker/watchdog "
@@ -2060,6 +2579,16 @@ def main():
                          "files; with --chaos, adds the seeded host.* "
                          "fault schedule plus a scripted mid-corpus "
                          "host KILL + rejoin restore (MULTIHOST_r01)")
+    ap.add_argument("--device-budget", type=int, default=0,
+                    metavar="BYTES",
+                    help="run q1-q22 under a hard device-memory budget "
+                         "(runtime/memory.py MemoryArbiter) asserting "
+                         "bit-identity to unbudgeted execution with "
+                         "spillBytes > 0 and zero budget violations; "
+                         "with --chaos, adds the seeded mem.* fault "
+                         "schedule, the full memory-ladder walk with "
+                         "incident bundles, and a HEALTHY service "
+                         "closure (OOC_r01)")
     ap.add_argument("--require-tpu", action="store_true",
                     help="exit non-zero when the resolved JAX backend is "
                          "'cpu' — a perf run that meant to hit the TPU "
@@ -2076,6 +2605,28 @@ def main():
     if args.require_tpu:
         from spark_rapids_tpu.tools import require_tpu_backend
         require_tpu_backend()
+
+    if args.device_budget:
+        wanted = [q.strip() for q in args.queries.split(",") if q.strip()]
+
+        def dump_memory_report(report):
+            print(json.dumps(report))
+            if args.out:
+                with open(args.out, "w") as f:
+                    json.dump(report, f, indent=1)
+
+        try:
+            report = run_memory_chaos(
+                sf=args.sf if args.sf is not None else 0.02,
+                seed=args.seed if args.seed is not None else 7,
+                budget=args.device_budget, queries=wanted or None,
+                use_sql=args.sql, chaos=args.chaos)
+        except AssertionError as e:
+            if getattr(e, "report", None) is not None:
+                dump_memory_report(e.report)
+            raise SystemExit(f"FAILED: {e}")
+        dump_memory_report(report)
+        return
 
     if args.hosts:
         wanted = [q.strip() for q in args.queries.split(",") if q.strip()]

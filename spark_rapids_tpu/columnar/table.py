@@ -667,15 +667,47 @@ class DeviceTable:
         # bucket pad waste: dead tail rows this upload carries so the
         # kernel set stays bounded (`compile` scope, padWasteRows)
         count_pad_waste(cap - host.num_rows)
-        if not host.columns:
-            return DeviceTable(host.names, [], host.num_rows, cap)
-        if any(isinstance(c.dtype, (T.ArrayType, T.StructType, T.MapType))
-               for c in host.columns):
-            # nested columns bypass the staged fast path (per-column
-            # upload) and stay single-device — the exchange layer
-            # excludes them from collectives for the same reason
-            cols = [DeviceColumn.from_host(c, cap) for c in host.columns]
-            return DeviceTable(host.names, cols, host.num_rows, cap)
+        # the device memory arbiter (runtime/memory.py): every landing
+        # reserves its estimated device bytes against the hard budget
+        # FIRST — an over-budget reservation spills idle spillables and,
+        # when spilling cannot make room, raises RetryOOM into the
+        # retry framework — then accounts the landed table at its
+        # actual bytes for as long as the object lives
+        from spark_rapids_tpu.runtime.memory import (
+            MEMORY,
+            estimate_device_nbytes,
+        )
+        reservation = MEMORY.reserve(
+            estimate_device_nbytes(host, cap), label="from_host")
+        try:
+            if not host.columns:
+                return MEMORY.account(
+                    DeviceTable(host.names, [], host.num_rows, cap),
+                    reservation)
+            if any(isinstance(c.dtype,
+                              (T.ArrayType, T.StructType, T.MapType))
+                   for c in host.columns):
+                # nested columns bypass the staged fast path (per-column
+                # upload) and stay single-device — the exchange layer
+                # excludes them from collectives for the same reason
+                cols = [DeviceColumn.from_host(c, cap)
+                        for c in host.columns]
+                return MEMORY.account(
+                    DeviceTable(host.names, cols, host.num_rows, cap),
+                    reservation)
+            return MEMORY.account(
+                DeviceTable._from_host_staged(host, cap, sharding),
+                reservation)
+        finally:
+            # a failed upload returns the grant; a successful account()
+            # already consumed it (release is idempotent)
+            reservation.release()
+
+    @staticmethod
+    def _from_host_staged(host: HostTable, cap: int,
+                          sharding) -> "DeviceTable":
+        """The staged fast-path upload body of :meth:`from_host` (all
+        budget accounting happens in the caller)."""
         split_f64 = jax.default_backend() != "cpu"
         recipes, staged, dicts = [], [], []
         for c in host.columns:

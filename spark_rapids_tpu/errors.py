@@ -167,6 +167,15 @@ class MeshGatherError(KernelCrashError):
     than surfacing silently wrong results."""
 
 
+class SpillCorruptionError(KernelCrashError):
+    """A disk-tier spill frame failed its CRC footer on unspill (bit
+    rot, a torn write, or an injected ``mem.unspill`` corruption). A
+    KernelCrashError subclass on purpose — the MeshGatherError
+    pattern: the corrupt frame is dropped (never served), and the
+    query-replay machinery re-lands the data from the scan cache /
+    source lineage rather than surfacing silently wrong bytes."""
+
+
 class WorkerLostError(RapidsTpuError):
     """The service worker executing this query died (its runner
     machinery raised outside the query) or was abandoned by the

@@ -216,7 +216,17 @@ class TpuHashAggregateExec(TpuExec):
                 pt = retry_block(lambda b=batch: self._aggregate(
                     b, self.grouping, plan.partial_specs,
                     self.grouping_names, self.filters))
-                partials.append(SpillableBatch(pt, catalog))
+                # SHRINK each partial to its live-group bucket before
+                # it buffers: a partial carries its input's full
+                # capacity for a handful of group rows, and the merge
+                # concat below buckets the SUM of partial capacities —
+                # unshrunk, a chunked scan's N partials concat into an
+                # N-fold over-capacity table, which is exactly the
+                # over-budget resident the out-of-core contract
+                # forbids. Pays one row-count sync per partial (the
+                # merge is a sync point anyway; shrink's docstring
+                # case: after cardinality-collapsing ops).
+                partials.append(SpillableBatch(pt.shrink(), catalog))
                 self.add_metric("partialAggBatches", 1)
 
             from spark_rapids_tpu.columnar.table import concat_device

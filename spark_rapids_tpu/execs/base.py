@@ -87,14 +87,22 @@ class HostToDevice(TpuExec):
         return self.cpu_node.output_schema()
 
     def execute(self):
+        from spark_rapids_tpu.runtime.memory import scan_chunks
         from spark_rapids_tpu.runtime.profiler import op_range
+        from spark_rapids_tpu.runtime.retry import retry_block
         for batch in self.cpu_node.execute_cpu():
-            t0 = time.perf_counter()
-            with op_range("HostToDevice", cat="transfer"):
-                dt = DeviceTable.from_host(batch)
-            self.add_metric("h2dTime", time.perf_counter() - t0)
-            self.add_metric("h2dBatches", 1)
-            yield dt
+            # transitions are device landings like scans: batches over
+            # their budget share land as bounded partitions, and a
+            # budget squeeze (arbiter RetryOOM) spills and replays
+            # instead of failing the query at the upload
+            for ch in scan_chunks(batch):
+                t0 = time.perf_counter()
+                with op_range("HostToDevice", cat="transfer"):
+                    dt = retry_block(
+                        lambda c=ch: DeviceTable.from_host(c))
+                self.add_metric("h2dTime", time.perf_counter() - t0)
+                self.add_metric("h2dBatches", 1)
+                yield dt
 
     def describe(self):
         return f"HostToDevice[{self.cpu_node.describe()}]"

@@ -96,10 +96,24 @@ class TpuScanExec(TpuExec):
 
     def execute(self):
         from spark_rapids_tpu.columnar.table import register_device_cache
+        from spark_rapids_tpu.runtime.memory import scan_chunks
+        from spark_rapids_tpu.runtime.retry import retry_block
         sharding, shard_token = _scan_sharding(self)
         for b in self.batches:
-            if not self.device_cache:
-                yield _upload_sharded(self, b, sharding)
+            # out-of-core scan: a batch whose estimated device bytes
+            # exceed its budget share lands as bounded partitions
+            # (runtime/memory.py scan_chunks); chunked landings bypass
+            # the device cache — a multi-chunk image would pin the very
+            # budget the chunking protects. Each landing is wrapped in
+            # the OOM retry loop so a budget squeeze spills and
+            # replays instead of failing the query at the scan.
+            chunks = scan_chunks(b)
+            if len(chunks) > 1 or not self.device_cache:
+                if len(chunks) > 1:
+                    self.add_metric("scanChunks", len(chunks))
+                for ch in chunks:
+                    yield retry_block(
+                        lambda c=ch: _upload_sharded(self, c, sharding))
                 continue
             entry = b._cache.get("device")
             # the cached image must match the CURRENT mesh layout — a
@@ -109,7 +123,8 @@ class TpuScanExec(TpuExec):
                 self.add_metric("scanCacheHit", 1)
                 yield entry[0]
                 continue
-            dt = _upload_sharded(self, b, sharding)
+            dt = retry_block(
+                lambda: _upload_sharded(self, b, sharding))
             b._cache["device"] = (dt, shard_token)
             register_device_cache(b)
             self.add_metric("scanCacheMiss", 1)
@@ -141,18 +156,29 @@ class TpuFileScanExec(TpuExec):
 
     def execute(self):
         import time
+        from spark_rapids_tpu.runtime.memory import scan_chunks
+        from spark_rapids_tpu.runtime.retry import retry_block
         sharding, _ = _scan_sharding(self)
         for batch in self.scan_node.execute_cpu(
                 dynamic_prunes=self._dynamic_prunes or None,
                 metrics=self.metrics):
-            t0 = time.perf_counter()
-            # mesh-native: each decoded file/row-group batch lands SPLIT
-            # across the mesh (execs/basic._upload_sharded)
-            dt = _upload_sharded(self, batch, sharding)
-            self.add_metric("scanUploadTime", time.perf_counter() - t0)
-            self.add_metric("scanBatches", 1)
-            self.add_metric("scanRows", batch.num_rows)
-            yield dt
+            # out-of-core scan: decoded batches over the budget share
+            # land as bounded partitions (runtime/memory.py), each
+            # upload OOM-retryable (budget squeezes spill and replay)
+            chunks = scan_chunks(batch)
+            if len(chunks) > 1:
+                self.add_metric("scanChunks", len(chunks))
+            for ch in chunks:
+                t0 = time.perf_counter()
+                # mesh-native: each decoded file/row-group batch lands
+                # SPLIT across the mesh (execs/basic._upload_sharded)
+                dt = retry_block(
+                    lambda c=ch: _upload_sharded(self, c, sharding))
+                self.add_metric("scanUploadTime",
+                                time.perf_counter() - t0)
+                self.add_metric("scanBatches", 1)
+                self.add_metric("scanRows", ch.num_rows)
+                yield dt
 
     def describe(self):
         return f"TpuFileScan[{self.scan_node.describe()}]"
@@ -422,9 +448,19 @@ class TpuCoalesceExec(TpuExec):
     produces_masked = True
 
     def execute_masked(self):
+        from spark_rapids_tpu.runtime.memory import MEMORY
         from spark_rapids_tpu.runtime.spill import BufferCatalog, SpillableBatch
 
         catalog = BufferCatalog.get()
+        # spill-aware TargetSize: the flush target never exceeds the
+        # device budget's chunk share, so a coalesce below a streaming
+        # consumer cannot re-concatenate chunked scans back into one
+        # over-budget resident batch (RequireSingleBatch consumers —
+        # join builds — still get their single batch; the join then
+        # sub-partitions it spillably)
+        target = self.target_bytes
+        if not self.require_single:
+            target = min(target, MEMORY.scan_chunk_bytes())
         pending: List[SpillableBatch] = []
         pending_bytes = 0
         try:
@@ -446,7 +482,7 @@ class TpuCoalesceExec(TpuExec):
                 # buffered batches are spillable while more input streams in
                 # (reference: coalesce inputs are SpillableColumnarBatches)
                 pending.append(SpillableBatch(batch, catalog))
-                if not self.require_single and pending_bytes >= self.target_bytes:
+                if not self.require_single and pending_bytes >= target:
                     yield self._flush(pending)
                     pending, pending_bytes = [], 0
             if pending:

@@ -544,5 +544,9 @@ class TpuShuffleExchangeExec(TpuExec):
 
     @staticmethod
     def _upload(tables: List[HostTable]) -> DeviceTable:
+        from spark_rapids_tpu.runtime.retry import retry_block
         host = tables[0] if len(tables) == 1 else HostTable.concat(tables)
-        return DeviceTable.from_host(host)
+        # shuffle re-landings are device landings like scans: a budget
+        # squeeze (arbiter RetryOOM) spills and replays here instead
+        # of failing the query with an unhandled OOM
+        return retry_block(lambda: DeviceTable.from_host(host))

@@ -467,33 +467,65 @@ class TpuJoinExec(TpuExec):
 
         build = self._single(build_child)
 
+        # spill-aware threshold: a build side past the device budget's
+        # chunk share sub-partitions even when the conf threshold is
+        # higher — each partition rides the spill tiers independently
+        # instead of pinning one over-budget resident table
+        from spark_rapids_tpu.runtime.memory import MEMORY
+        sub_bytes = self.subpartition_bytes
+        if sub_bytes > 0:
+            sub_bytes = min(sub_bytes, MEMORY.scan_chunk_bytes())
         nparts = 1
-        if (jt != "cross" and self.subpartition_bytes > 0
-                and build.device_nbytes() > self.subpartition_bytes):
+        if (jt != "cross" and sub_bytes > 0
+                and build.device_nbytes() > sub_bytes):
             nparts = min(
-                -(-build.device_nbytes() // self.subpartition_bytes),
+                -(-build.device_nbytes() // sub_bytes),
                 self.max_subpartitions)
         if nparts > 1:
             yield from self._execute_subpartitioned(
                 build, probe_child, swapped, int(nparts))
             return
 
+        # the build side registers as a SpillableDeviceTable (ISSUE 15):
+        # pinned only while one probe batch joins, so between batches —
+        # while the probe child computes, possibly paying its own
+        # memory pressure — the idle build table may ride the
+        # device->host->disk tiers and re-land at its original
+        # capacity for the next probe (traces and the full-outer match
+        # bitmap key on that capacity staying put)
+        from spark_rapids_tpu.runtime.spill import (
+            BufferCatalog,
+            PRIORITY_ACTIVE,
+            SpillableDeviceTable,
+        )
+        build_sb = SpillableDeviceTable(build, BufferCatalog.get(),
+                                        priority=PRIORITY_ACTIVE)
+        build_cap = build.capacity
+        del build
         full_outer = jt in ("full", "fullouter", "outer")
         r_matched_accum = None
-        for pb in probe_child.execute_masked():
-            out, r_matched = retry_block(
-                lambda b=pb: self._join_batch(b, build, swapped))
-            if full_outer:
-                r_matched_accum = (r_matched if r_matched_accum is None
-                                   else r_matched_accum | r_matched)
-            if out is not None:
-                yield self._apply_condition(out)
-            self.add_metric("probeBatches", 1)
+        try:
+            for pb in probe_child.execute_masked():
+                with build_sb.pinned_batch() as bt:
+                    out, r_matched = retry_block(
+                        lambda b=pb, bb=bt: self._join_batch(
+                            b, bb, swapped))
+                if full_outer:
+                    r_matched_accum = (
+                        r_matched if r_matched_accum is None
+                        else r_matched_accum | r_matched)
+                if out is not None:
+                    yield self._apply_condition(out)
+                self.add_metric("probeBatches", 1)
 
-        if full_outer:
-            if r_matched_accum is None:
-                r_matched_accum = jnp.zeros(build.capacity, jnp.bool_)
-            yield self._unmatched_build_batch(build, r_matched_accum, swapped)
+            if full_outer:
+                if r_matched_accum is None:
+                    r_matched_accum = jnp.zeros(build_cap, jnp.bool_)
+                with build_sb.pinned_batch() as bt:
+                    yield self._unmatched_build_batch(
+                        bt, r_matched_accum, swapped)
+        finally:
+            build_sb.release()
 
     def _execute_subpartitioned(self, build: DeviceTable, probe_child,
                                 swapped: bool, nparts: int):

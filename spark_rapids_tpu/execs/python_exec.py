@@ -110,8 +110,11 @@ class _PythonExecBase(TpuExec):
         return pdf
 
     def _upload(self, host: HostTable) -> DeviceTable:
+        from spark_rapids_tpu.runtime.retry import retry_block
         t0 = time.perf_counter()
-        dt = DeviceTable.from_host(host)
+        # UDF result re-landings are device landings like scans: a
+        # budget squeeze spills and replays instead of failing
+        dt = retry_block(lambda: DeviceTable.from_host(host))
         self.add_metric("h2dArrowTime", time.perf_counter() - t0)
         return dt
 

@@ -85,16 +85,23 @@ class TpuSortExec(TpuExec):
 
         from itertools import chain
         from spark_rapids_tpu.columnar.table import concat_device
+        from spark_rapids_tpu.runtime.memory import MEMORY
         catalog = BufferCatalog.get()
         pending = []
         total = 0
+        # spill-aware threshold: a multi-batch sort past the device
+        # budget's chunk share goes out of core even when the conf
+        # threshold is higher — the spilled-run range merge keeps peak
+        # HBM at one output range
+        threshold = min(self.ooc_threshold_bytes,
+                        MEMORY.scan_chunk_bytes())
         all_batches = chain([first, second], it)
         try:
             for batch in all_batches:
                 pending.append(SpillableBatch(batch, catalog))
                 total += batch.device_nbytes()
                 self.add_metric("sortInputBatches", 1)
-                if total > self.ooc_threshold_bytes:
+                if total > threshold:
                     # switch to out-of-core: drain the rest as host runs
                     batches = [sb for sb in pending]
                     pending = []

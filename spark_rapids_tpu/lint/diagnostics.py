@@ -106,6 +106,11 @@ RULES: Dict[str, str] = {
                       "finalize_observation), drives query execution, "
                       "or takes a query-path lock — sampling must "
                       "never perturb the execution it observes",
+    "RL-MEM-ACCOUNT": "raw jax.device_put inside execs//ops/ outside "
+                      "the sanctioned allowlist — device landings must "
+                      "route through the memory-arbiter-accounted "
+                      "DeviceTable.from_host path or the hard device "
+                      "budget silently leaks",
 }
 
 

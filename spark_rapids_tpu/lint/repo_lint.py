@@ -58,6 +58,13 @@ conf key referenced by a typo'd string — this lint can.  Rules (RL-*):
   scheduler condition, the session obs lock) — sampling must never
   perturb the execution it observes. Sanctioned exceptions go in
   ``_OBS_PASSIVE_ALLOWLIST`` with a justification.
+* RL-MEM-ACCOUNT — the device memory budget (runtime/memory.py
+  MemoryArbiter) only holds if every device landing is ACCOUNTED:
+  inside ``execs/`` and ``ops/``, raw ``jax.device_put`` calls are
+  forbidden — landings route through ``DeviceTable.from_host`` (which
+  reserves against the budget and accounts the landed bytes) or
+  appear in ``_MEM_ACCOUNT_ALLOWLIST`` with a justification (tiny
+  non-table transfers like digest scalars).
 """
 
 from __future__ import annotations
@@ -633,6 +640,64 @@ def _check_kernel_host(rel: str, tree: ast.AST, diags: List[Diagnostic]):
     walk(tree, None)
 
 
+#: sanctioned raw device_put sites inside execs//ops/:
+#: "<rel>:<qualified function>" -> justification. The hook for new
+#: exceptions — add an entry HERE with a reason, never a bare
+#: suppression. Table-sized landings are NEVER eligible: they belong
+#: on the arbiter-accounted DeviceTable.from_host path.
+_MEM_ACCOUNT_ALLOWLIST = {
+    "spark_rapids_tpu/execs/mesh.py:TpuMeshRelandExec._reland":
+        "re-lands a 4-element uint32 DIGEST scalar (gather-integrity "
+        "checksum, ~16 bytes) onto device 0 — validation overhead, "
+        "not a table landing; budget accounting at this size would be "
+        "pure ledger noise",
+}
+
+
+def _check_mem_account(rel: str, tree: ast.AST,
+                       diags: List[Diagnostic]):
+    """RL-MEM-ACCOUNT: device landings in execs//ops/ must route
+    through arbiter-accounted paths — a raw jax.device_put there lands
+    bytes the MemoryArbiter never sees, and the hard budget contract
+    (zero violations under scale_test --device-budget) silently
+    breaks."""
+    if not rel.startswith(("spark_rapids_tpu/execs/",
+                           "spark_rapids_tpu/ops/")):
+        return
+
+    def flag(node, what: str, func):
+        if f"{rel}:{func}" in _MEM_ACCOUNT_ALLOWLIST:
+            return
+        diags.append(make(
+            "RL-MEM-ACCOUNT", f"{rel}:{node.lineno}",
+            f"{what} in a device-landing layer"
+            + (f" (function {func!r})" if func else " (module level)")
+            + " — land through DeviceTable.from_host so the memory "
+            "arbiter accounts the bytes, or allowlist the function in "
+            "_MEM_ACCOUNT_ALLOWLIST with a justification"))
+
+    def walk(node, func):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            func = f"{func}.{node.name}" if func else node.name
+        if isinstance(node, ast.ImportFrom) and node.module == "jax":
+            # `from jax import device_put` would make the call below
+            # invisible to the chain matcher — ban the import form too
+            for a in node.names:
+                if a.name == "device_put":
+                    flag(node, "importing jax.device_put", func)
+        elif isinstance(node, ast.Call):
+            chain = _attr_chain(node.func)
+            if chain == "jax.device_put" \
+                    or chain.endswith(".device_put") \
+                    or chain == "device_put":
+                flag(node, f"{chain}()", func)
+        for child in ast.iter_child_nodes(node):
+            walk(child, func)
+
+    walk(tree, None)
+
+
 #: the module RL-OBS-PASSIVE governs (the telemetry sampler + flight
 #: recorder — both run off the query path by contract)
 _OBS_PASSIVE_MODULE = "spark_rapids_tpu/obs/telemetry.py"
@@ -770,6 +835,7 @@ def lint_repo(repo_root: Optional[str] = None) -> List[Diagnostic]:
         _check_mesh_host(rel, tree, diags)
         _check_kernel_host(rel, tree, diags)
         _check_obs_passive(rel, tree, diags)
+        _check_mem_account(rel, tree, diags)
         _check_fault_sites(rel, tree, fault_calls, diags)
     _check_fault_registry(fault_calls, diags)
     return diags

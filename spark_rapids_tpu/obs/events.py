@@ -104,7 +104,16 @@ EVENT_LOG_DIR = str_conf(
 #: executor-reported scan wall, CRC-caught re-lands). {} off-cluster,
 #: for local-fallback scans, and for result-cache serves (nothing
 #: dispatched).
-EVENT_SCHEMA_VERSION = 9
+#: v10 (out-of-core PR): + oomRetries (spill-and-replay retries the
+#: OOM retry framework performed during this query's wall),
+#: splitRetries (split-and-retry escalations — an input halved by rows
+#: and both halves replayed), spillBytes (device bytes freed by spill
+#: demotions) and unspills (spilled batches re-landed on device) —
+#: per-record DELTAS of the new ``memory`` scope (runtime/memory.py);
+#: plus budgetPeak (the memory arbiter's PEAK accounted device bytes
+#: at record time — absolute, process-wide, not a delta). All deltas 0
+#: on an unbudgeted quiet process and for result-cache serves.
+EVENT_SCHEMA_VERSION = 10
 
 
 def plan_tree(executable) -> dict:
@@ -230,7 +239,12 @@ def build_query_record(*, query_index: int, wall_s: float,
                        hosts_lost: int = 0,
                        host_relands: int = 0,
                        dcn_exchanges: int = 0,
-                       host_scans: Optional[Dict[str, dict]] = None) -> dict:
+                       host_scans: Optional[Dict[str, dict]] = None,
+                       oom_retries: int = 0,
+                       split_retries: int = 0,
+                       spill_bytes: int = 0,
+                       unspills: int = 0,
+                       budget_peak: int = 0) -> dict:
     """Assemble one event-log record. Every field is JSON-native; the
     golden schema test normalizes timings and pins the shape.
     ``service`` is the query-service envelope (tenant, pool, queueWaitS,
@@ -281,6 +295,11 @@ def build_query_record(*, query_index: int, wall_s: float,
         "dcnExchanges": int(dcn_exchanges),
         "hostScans": {h: dict(v)
                       for h, v in sorted((host_scans or {}).items())},
+        "oomRetries": int(oom_retries),
+        "splitRetries": int(split_retries),
+        "spillBytes": int(spill_bytes),
+        "unspills": int(unspills),
+        "budgetPeak": int(budget_peak),
         "faultReplays": fault_replays,
         "plan": plan_tree(executable),
         "fallbacks": collect_fallbacks(meta),

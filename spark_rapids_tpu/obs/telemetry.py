@@ -187,7 +187,9 @@ class TelemetryRing:
             from spark_rapids_tpu.runtime.cluster import CLUSTER
             from spark_rapids_tpu.runtime.faults import FAULTS
             from spark_rapids_tpu.runtime.health import HEALTH
+            from spark_rapids_tpu.runtime.memory import MEMORY
             snap = scopes_snapshot()
+            mem = MEMORY.snapshot()  # bounded dict copy, no locks held
             sample = {
                 "t": round(time.time(), 3),
                 "deltas": _scope_delta(self._prev_scopes, snap),
@@ -195,6 +197,10 @@ class TelemetryRing:
                 "meshShape": MESH.shape_str(),
                 "hostTopology": CLUSTER.topology_str(),
                 "faultFires": sum(FAULTS.counters().values()),
+                # device-budget occupancy riding every sample: the
+                # between-queries view of out-of-core pressure
+                "memOccupancy": mem["occupancyBytes"],
+                "memBudget": mem["budgetBytes"],
             }
             with self._lock:
                 self._prev_scopes = snap
@@ -377,9 +383,11 @@ def record_incident(kind: str, action: str, reason: str,
                 "backend": HEALTH.snapshot(),
                 "meshLadder": HEALTH.mesh_snapshot(),
                 "hostLadder": HEALTH.host_snapshot(),
+                "memoryLadder": HEALTH.memory_snapshot(),
             },
             "mesh": MESH.health_snapshot(),
             "cluster": CLUSTER.health_snapshot(),
+            "memory": _memory_snapshot(),
             "quarantine": QUARANTINE.snapshot(),
             # exec circuit-breaker + Pallas kernel demotions in one
             # map, the event record's convention (keys 'pallas:<name>')
@@ -442,3 +450,8 @@ def _recent_event_summaries() -> List[dict]:
 def _kernel_demotions() -> Dict[str, str]:
     from spark_rapids_tpu import kernels
     return kernels.demoted_ops()
+
+
+def _memory_snapshot() -> dict:
+    from spark_rapids_tpu.runtime.memory import MEMORY
+    return MEMORY.snapshot()

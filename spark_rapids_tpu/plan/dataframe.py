@@ -465,11 +465,13 @@ def to_device_arrays(df: "DataFrame"):
     columns export as (codes, validity, dictionary)."""
     from spark_rapids_tpu.overrides.rules import apply_overrides
     from spark_rapids_tpu.execs.base import DeviceToHost
+    from spark_rapids_tpu.runtime.retry import retry_block
     if df.session is None:
         # session-less DataFrame: CPU plan, one upload at the end
+        # (retry_block: a device-budget squeeze spills and replays)
         from spark_rapids_tpu.columnar import DeviceTable, HostTable
         host = HostTable.concat(list(df.plan.execute_cpu()))
-        t = DeviceTable.from_host(host)
+        t = retry_block(lambda: DeviceTable.from_host(host))
         out = {}
         for name, c in zip(t.names, t.columns):
             out[name] = ((c.data, c.validity, c.dictionary)
@@ -484,7 +486,7 @@ def to_device_arrays(df: "DataFrame"):
         # fully-fallen-back plan: upload the host result once
         from spark_rapids_tpu.columnar import DeviceTable, HostTable
         host = HostTable.concat(list(executable.execute_cpu()))
-        batches = [DeviceTable.from_host(host)]
+        batches = [retry_block(lambda: DeviceTable.from_host(host))]
     if len(batches) != 1:
         from spark_rapids_tpu.columnar.table import concat_device
         batches = [concat_device(batches)]

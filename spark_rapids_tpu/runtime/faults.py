@@ -191,6 +191,26 @@ FAULT_POINTS: Dict[str, tuple] = {
         "injected fault DROPS the beat (counted) — enough dropped "
         "beats and the missed-beat sweep declares the host lost, the "
         "exact path a wedged executor takes"),
+    # -- the MEMORY fault domain: out-of-core execution under the hard
+    # device budget (runtime/memory.py MemoryArbiter) is injectable at
+    # every stage of the reserve->spill->unspill cycle
+    "mem.reserve": (
+        "spark_rapids_tpu/runtime/memory.py",
+        "before the arbiter grants a device-landing reservation: 'oom' "
+        "simulates a budget squeeze mid-query (RetryOOM into the "
+        "retry framework: spill-replay, split-and-retry, then the "
+        "memory degradation ladder)"),
+    "mem.spill": (
+        "spark_rapids_tpu/runtime/spill.py",
+        "before a device->host spill demotion: 'crash' simulates a "
+        "spill FAILURE (the demotion path itself dies — circuit-"
+        "breaker/replay territory, the buffer stays device-resident)"),
+    "mem.unspill": (
+        "spark_rapids_tpu/runtime/spill.py",
+        "at the disk-tier unspill read: 'corrupt' flips frame bytes "
+        "and the TPAK-convention CRC footer catches it — typed "
+        "SpillCorruptionError re-lands from the scan cache via query "
+        "replay instead of serving wrong bytes"),
 }
 
 _SLOW_SLEEP_S = 0.05
@@ -531,15 +551,19 @@ CIRCUIT_BREAKER = CircuitBreaker()
 
 def _tag_fault_op(exc: BaseException, op: str) -> None:
     """Attach op attribution to a demotable failure. Innermost exec wins
-    (the first wrapper the exception crosses sets it); OOMs are excluded
-    — the retry framework owns those."""
+    (the first wrapper the exception crosses sets it); RETRYABLE OOMs
+    are excluded — the retry framework owns those. A FatalDeviceOOM
+    (retries + splits exhausted) IS tagged: the memory degradation
+    ladder's last rung demotes exactly that operator to the CPU path."""
+    from spark_rapids_tpu.errors import FatalDeviceOOM
     from spark_rapids_tpu.runtime.crash_handler import is_fatal_device_error
     from spark_rapids_tpu.runtime.retry import is_device_oom
     if getattr(exc, "fault_op", None) is not None:
         return
     if is_device_oom(exc):
         return
-    if isinstance(exc, KernelCrashError) or is_fatal_device_error(exc):
+    if (isinstance(exc, (KernelCrashError, FatalDeviceOOM))
+            or is_fatal_device_error(exc)):
         exc.fault_op = op
 
 

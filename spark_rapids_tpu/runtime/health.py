@@ -91,6 +91,17 @@ register_metric("meshShrinks", "count", "ESSENTIAL",
                 "mesh reconfigurations onto surviving devices after "
                 "partial device loss (bounded by "
                 "spark.rapids.mesh.degrade.maxShrinks)")
+register_metric("memoryPressure", "count", "ESSENTIAL",
+                "FatalDeviceOOM escalations the memory degradation "
+                "ladder handled (each walks one rung: full-spill "
+                "retry, chunked re-execution, per-op CPU demotion)")
+register_metric("memoryChunkedReexecutions", "count", "ESSENTIAL",
+                "query replays forced onto chunked scans by the "
+                "memory ladder's 'chunk' rung")
+register_metric("memoryCpuDemotions", "count", "ESSENTIAL",
+                "operators demoted to the CPU path by the memory "
+                "ladder after chunked re-execution still could not "
+                "fit the device budget")
 
 
 def _record_ladder_incident(kind: str, action: str, exc: BaseException,
@@ -104,9 +115,14 @@ def _record_ladder_incident(kind: str, action: str, exc: BaseException,
         from spark_rapids_tpu.obs.telemetry import record_incident
         first = (str(exc).splitlines()[0] if str(exc)
                  else type(exc).__name__)
-        record_incident(kind, action,
-                        f"{type(exc).__name__}: {first}",
-                        conf=conf, error=exc)
+        reason = f"{type(exc).__name__}: {first}"
+        cause = exc.__cause__
+        if cause is not None and str(cause):
+            # a wrapped escalation (FatalDeviceOOM from a RetryOOM)
+            # names the triggering fault point only in its cause — ride
+            # it along so the bundle's faultPoint parse still works
+            reason += f" (cause: {str(cause).splitlines()[0]})"
+        record_incident(kind, action, reason, conf=conf, error=exc)
     except Exception:
         pass
 
@@ -150,6 +166,16 @@ class DeviceHealthMonitor:
         self._host_consecutive = 0
         self._host_losses = 0
         self._host_shrinks = 0
+        # -- the memory fault domain (device budget exhaustion) ------------
+        #: consecutive FatalDeviceOOMs with no success between them —
+        #: drives the memory degradation ladder (retry-after-full-
+        #: spill -> chunked re-execution -> per-op CPU demotion). Any
+        #: completed query resets it (memory pressure is workload
+        #: pressure, not broken hardware).
+        self._mem_consecutive = 0
+        self._mem_events = 0
+        self._mem_chunked = 0
+        self._mem_cpu_demotions = 0
 
     # -- hot-path reads ------------------------------------------------------
     def cpu_only_reason(self) -> Optional[str]:
@@ -214,10 +240,14 @@ class DeviceHealthMonitor:
         walking down to the shrink rung. The HOST ladder resets only
         on a cluster-NATIVE success for the same reason."""
         if (self._consecutive_losses
+                or self._mem_consecutive
                 or (mesh_native and self._mesh_consecutive)
                 or (cluster_native and self._host_consecutive)):
             with self._lock:
                 self._consecutive_losses = 0
+                # ANY success resets the memory ladder: the budget
+                # squeeze was this workload's, not the hardware's
+                self._mem_consecutive = 0
                 if mesh_native:
                     self._mesh_consecutive = 0
                 if cluster_native:
@@ -408,6 +438,87 @@ class DeviceHealthMonitor:
                 "hostShrinks": self._host_shrinks,
             }
 
+    def on_memory_pressure(self, exc: BaseException, conf) -> str:
+        """One FatalDeviceOOM that escaped the retry framework (spill
+        replays AND split-and-retry both exhausted — the working set
+        truly does not fit the device budget at this execution shape):
+        walk the MEMORY degradation ladder one rung and return the
+        recovery action the session should take —
+
+        * ``"retry"`` — first escalation: spill EVERYTHING spillable
+          (whole device tier + cached scan images) and replay at the
+          same shape — transient co-resident pressure (a concurrent
+          query's working set) may have passed;
+        * ``"chunk"`` — second escalation: replay with scans FORCED
+          onto smaller chunks (runtime/memory.forced_chunking at half
+          the normal chunk share) — bounded partitions stream where
+          one batch could not fit;
+        * ``"cpu_demote"`` — third escalation on: demote the
+          attributed operator (``exc.fault_op``) to the CPU path via
+          the runtime circuit breaker — the replay re-plans with that
+          op off-device, the reason surfaced in explain()/event log
+          like every other demotion;
+        * ``"abort"`` — no operator attribution to demote (or the
+          ladder is exhausted): the session re-raises the typed OOM.
+
+        Each action records a flight-recorder incident bundle
+        (``memory.ladder``), like every other domain's ladder."""
+        action = self._on_memory_pressure_inner(exc, conf)
+        _record_ladder_incident("memory.ladder", action, exc, conf)
+        return action
+
+    def _on_memory_pressure_inner(self, exc: BaseException, conf) -> str:
+        with self._lock:
+            self._mem_events += 1
+            self._mem_consecutive += 1
+            n = self._mem_consecutive
+            self._metrics.add("memoryPressure", 1)
+        if n == 1:
+            # make maximum room before the same-shape replay
+            try:
+                from spark_rapids_tpu.columnar.table import (
+                    evict_device_caches,
+                )
+                from spark_rapids_tpu.runtime.spill import BufferCatalog
+                evict_device_caches()
+                BufferCatalog.get().spill_all_device()
+            except Exception:
+                pass  # recovery must never raise
+            return "retry"
+        if n == 2:
+            with self._lock:
+                self._mem_chunked += 1
+                self._metrics.add("memoryChunkedReexecutions", 1)
+            try:
+                from spark_rapids_tpu.columnar.table import (
+                    evict_device_caches,
+                )
+                evict_device_caches()  # a cached unchunked image would
+                # serve the replay the very batch that did not fit
+            except Exception:
+                pass
+            return "chunk"
+        op = getattr(exc, "fault_op", None)
+        if op is None:
+            return "abort"
+        from spark_rapids_tpu.runtime.faults import CIRCUIT_BREAKER
+        # force-demote: one recorded failure at threshold 1 trips the
+        # breaker, and the replay's re-plan falls the op back to CPU
+        CIRCUIT_BREAKER.record_failure(op, exc, max_failures=1)
+        with self._lock:
+            self._mem_cpu_demotions += 1
+            self._metrics.add("memoryCpuDemotions", 1)
+        return "cpu_demote"
+
+    def memory_snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "memoryPressureEvents": self._mem_events,
+                "memoryConsecutive": self._mem_consecutive,
+                "memoryChunkedReexecutions": self._mem_chunked,
+                "memoryCpuDemotions": self._mem_cpu_demotions,
+            }
+
     def _invalidate_device_caches_locked(self) -> None:
         """Drop every cache that references device state — cached
         executables hold device-resident interned constants, kernel
@@ -476,6 +587,10 @@ class DeviceHealthMonitor:
             self._host_consecutive = 0
             self._host_losses = 0
             self._host_shrinks = 0
+            self._mem_consecutive = 0
+            self._mem_events = 0
+            self._mem_chunked = 0
+            self._mem_cpu_demotions = 0
 
 
 HEALTH = DeviceHealthMonitor()

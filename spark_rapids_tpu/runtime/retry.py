@@ -78,9 +78,15 @@ class RetryStateMachine:
 
     def note_retry(self):
         self._state()["retry_count"] += 1
+        # the process-wide memory scope mirrors retry traffic so the
+        # event log (schema v10) attributes oomRetries per query
+        from spark_rapids_tpu.runtime.memory import MEM_SCOPE
+        MEM_SCOPE.add("oomRetries", 1)
 
     def note_split(self):
         self._state()["split_count"] += 1
+        from spark_rapids_tpu.runtime.memory import MEM_SCOPE
+        MEM_SCOPE.add("splitRetries", 1)
 
     @property
     def retry_count(self) -> int:
@@ -153,6 +159,7 @@ class DeviceMemoryEventHandler:
         self._lock = threading.Lock()
         self.alloc_failure_count = 0
         self.spilled_bytes = 0
+        self.spill_crashes = 0
         self._fruitless: dict = {}  # id(catalog) -> consecutive count
 
     def on_alloc_failure(self, catalog: Optional[BufferCatalog] = None
@@ -164,7 +171,17 @@ class DeviceMemoryEventHandler:
         evict_device_caches()
         clear_device_constants()  # interned aux/remap arrays re-upload lazily
         clear_mesh_caches()  # pinned replicated dict matrices re-intern lazily
-        freed = catalog.synchronous_spill(1 << 62)
+        try:
+            freed = catalog.synchronous_spill(1 << 62)
+        except Exception:
+            # the spill pass itself died mid-demotion (a real I/O
+            # failure, or the mem.spill chaos point): OOM RECOVERY
+            # MUST NOT DIE RECOVERING — whatever the pass freed before
+            # failing stays freed, the crash is counted, and the
+            # replay proceeds (bounded by the caller's max_retries)
+            with self._lock:
+                self.spill_crashes += 1
+            freed = 0
         with self._lock:
             self.alloc_failure_count += 1
             self.spilled_bytes += freed
@@ -318,11 +335,16 @@ def retry_block(fn: Callable[[], object], *, max_retries: Optional[int] = None,
             if is_device_oom(exc) and attempts < max_retries:
                 attempts += 1
                 RMM_TPU.note_retry()
-                if _free_memory_for(exc, catalog):
-                    continue
-                raise FatalDeviceOOM(
-                    "OOM and spilling freed nothing (no spillable "
-                    "buffers remain)") from exc
+                # replay even when the spill pass freed nothing: a
+                # retry_block has no split escalation, the replay
+                # budget is already bounded by max_retries, and a
+                # blocked-then-raised budget reservation (or an
+                # injected OOM) can succeed on replay without new
+                # spillables appearing — the with_retry fruitless
+                # check exists to stop SAME-SIZE replays when a split
+                # is the better move, which has no analog here
+                _free_memory_for(exc, catalog)
+                continue
             if is_device_oom(exc):
                 tier = "host" if isinstance(exc, CpuRetryOOM) else "device"
                 raise FatalDeviceOOM(

@@ -18,19 +18,87 @@ import glob
 import itertools
 import os
 import pickle
+import struct
 import tempfile
 import threading
 import time
 import weakref
+import zlib
 from typing import Dict, List, Optional
 
 from spark_rapids_tpu.columnar import DeviceTable, HostTable
-from spark_rapids_tpu.errors import ColumnarProcessingError
+from spark_rapids_tpu.errors import (
+    ColumnarProcessingError,
+    SpillCorruptionError,
+)
 from spark_rapids_tpu.obs.metrics import metric_scope
+from spark_rapids_tpu.runtime.faults import fault_point
 
 TIER_DEVICE = "DEVICE"
 TIER_HOST = "HOST"
 TIER_DISK = "DISK"
+
+#: CRC32 footer width on disk-tier spill frames (TPAK convention)
+_CRC_LEN = 4
+
+
+class _RawSpill:
+    """Raw-buffer host copy of a spilled DeviceTable — the exact device
+    arrays as numpy (data, validity, live mask), NO decode/re-encode.
+    The reference's RapidsDeviceMemoryStore copies device buffers
+    byte-for-byte to host for the same reason this exists: a
+    decode->re-encode round trip through HostTable COMPACTS masked
+    batches and re-normalizes payload bits, so a batch that spilled
+    mid-retry would re-land in a different layout and change the
+    accumulation order of the kernel that replays over it — breaking
+    the bit-identity contract budget enforcement must preserve.
+    Nested columns keep the legacy HostTable detour (their buffers are
+    composite); they are never masked."""
+
+    __slots__ = ("names", "cols", "live", "nrows", "capacity")
+
+    def __init__(self, names, cols, live, nrows, capacity):
+        self.names = names
+        self.cols = cols  # [(dtype, data, validity, dict, sorted, domain)]
+        self.live = live
+        self.nrows = nrows
+        self.capacity = capacity
+
+    def nbytes(self) -> int:
+        total = 0 if self.live is None else self.live.nbytes
+        for _dt, data, validity, _d, _s, _dom in self.cols:
+            total += data.nbytes + validity.nbytes
+        return total
+
+    def to_device(self) -> DeviceTable:
+        import jax.numpy as jnp
+        from spark_rapids_tpu.columnar import DeviceColumn
+        cols = [DeviceColumn(dt, jnp.asarray(data), jnp.asarray(validity),
+                             dictionary=dictionary, dict_sorted=srt,
+                             domain=domain)
+                for dt, data, validity, dictionary, srt, domain
+                in self.cols]
+        live = None if self.live is None else jnp.asarray(self.live)
+        return DeviceTable(self.names, cols, self.nrows, self.capacity,
+                           live=live)
+
+    @staticmethod
+    def from_device(table: DeviceTable) -> "_RawSpill":
+        import numpy as np
+        cols = [(c.dtype, np.asarray(c.data), np.asarray(c.validity),
+                 c.dictionary, c.dict_sorted, c.domain)
+                for c in table.columns]
+        live = None if table.live is None else np.asarray(table.live)
+        return _RawSpill(table.names, cols, live, table.num_rows,
+                         table.capacity)
+
+
+def _check_spill_crc(frame: bytes):
+    """Split a disk spill frame into (body, crc_ok)."""
+    if len(frame) < _CRC_LEN:
+        return b"", False
+    body, footer = frame[:-_CRC_LEN], frame[-_CRC_LEN:]
+    return body, struct.pack("<I", zlib.crc32(body)) == footer
 
 # Spill priorities (reference: SpillPriorities.scala): lower value spills
 # first. Inputs buffered for later re-reads spill before actively-used ones.
@@ -55,7 +123,17 @@ class SpillableBatch:
         self._device: Optional[DeviceTable] = table
         self._host: Optional[HostTable] = None
         self._disk_path: Optional[str] = None
+        #: landing capacity, preserved across spill round trips so an
+        #: unspilled table re-buckets to the SAME capacity it left with
+        #: (downstream traces and full-outer match bitmaps key on it)
+        self._capacity = table.capacity
         self._device_bytes = table.device_nbytes()
+        # the device memory arbiter accounts every spillable's resident
+        # table (kernel outputs registered here never went through a
+        # from_host landing); spilling drops the reference, which
+        # releases the bytes through the ledger's weak finalizer
+        from spark_rapids_tpu.runtime.memory import MEMORY
+        MEMORY.account(table)
         self._host_bytes = 0
         self._lock = threading.RLock()
         self._pinned = 0
@@ -63,56 +141,107 @@ class SpillableBatch:
         catalog.register(self)
 
     # -- state --------------------------------------------------------------
+    # tier/byte reads are LOCK-FREE on purpose: the catalog's spill
+    # walk and accounting sums read them while other threads hold
+    # batch locks mid-unspill — a blocking read here closes an ABBA
+    # cycle (catalog/arbiter pass -> batch lock vs unspill's batch
+    # lock -> catalog lock). A torn read costs at most one slightly
+    # stale byte count or a wasted spill attempt (the demotion calls
+    # re-check under a NON-blocking acquire), never a wrong result.
     @property
     def tier(self) -> str:
-        with self._lock:
-            if self._device is not None:
-                return TIER_DEVICE
-            if self._host is not None:
-                return TIER_HOST
-            return TIER_DISK
+        if self._device is not None:
+            return TIER_DEVICE
+        if self._host is not None:
+            return TIER_HOST
+        return TIER_DISK
 
     @property
     def device_bytes(self) -> int:
-        with self._lock:
-            return self._device_bytes if self._device is not None else 0
+        return self._device_bytes if self._device is not None else 0
 
     @property
     def host_bytes(self) -> int:
-        with self._lock:
-            return self._host_bytes if self._host is not None else 0
+        return self._host_bytes if self._host is not None else 0
 
     # -- access -------------------------------------------------------------
     def get(self) -> DeviceTable:
-        """Materialize on device (unspilling as needed) and touch LRU."""
+        """Materialize on device (unspilling as needed) and touch LRU.
+        Raw-buffer unspill: the table re-lands with the EXACT arrays
+        it left with (layout, capacity, mask, padding bits), so a
+        kernel replaying over it accumulates bit-identically to the
+        never-spilled run."""
+        from spark_rapids_tpu.runtime.memory import MEMORY
         with self._lock:
             self.last_touch = time.monotonic()
             if self._device is None:
-                host = self._ensure_host_locked()
-                self._device = DeviceTable.from_host(host)
-                self._device_bytes = self._device.device_nbytes()
-                self._host = None
-                self._host_bytes = 0
-                self.catalog.on_unspill(self)
+                # PINNED across the whole rebuild: from_host's budget
+                # reserve (legacy path) and account() both may run a
+                # spill pass, and the same-thread reentrant RLock would
+                # otherwise let that pass demote THIS batch mid-unspill
+                # — re-spilling the payload being uploaded (leaking its
+                # old disk frame) or nulling _device before the return
+                self._pinned += 1
+                try:
+                    payload = self._ensure_host_locked()
+                    if isinstance(payload, _RawSpill):
+                        dt = payload.to_device()
+                    else:  # legacy HostTable detour (nested columns)
+                        cap = (self._capacity
+                               if self._capacity >= payload.num_rows
+                               else None)
+                        dt = DeviceTable.from_host(payload, capacity=cap)
+                    self._device = dt
+                    self._device_bytes = dt.device_nbytes()
+                    self._host = None
+                    self._host_bytes = 0
+                    self.catalog.on_unspill(self)
+                    # from_host accounts its own landings; the raw
+                    # re-land needs explicit accounting
+                    MEMORY.account(dt)
+                finally:
+                    self._pinned -= 1
             return self._device
 
     def get_host(self) -> HostTable:
-        """Materialize on host WITHOUT promoting to device (shuffle reads)."""
+        """Materialize on host WITHOUT promoting to device when
+        possible (a raw-spilled masked payload has no HostTable form
+        and takes the device detour)."""
         with self._lock:
             if self._device is not None:
                 return self._device.to_host()
-            return self._ensure_host_locked()
+            payload = self._ensure_host_locked()
+            if isinstance(payload, _RawSpill):
+                return self.get().to_host()
+            return payload
 
     def _ensure_host_locked(self) -> HostTable:
         if self._host is None:
             if self._disk_path is None:
                 raise ColumnarProcessingError("spillable batch lost all tiers")
             with open(self._disk_path, "rb") as f:
-                self._host = pickle.load(f)
-            self._host_bytes = self._host.nbytes()
-            os.unlink(self._disk_path)
-            self.catalog._untrack_disk_file(self._disk_path)
+                frame = f.read()
+            # injected corruption flips frame bytes BEFORE the CRC
+            # check — exactly what bit rot / a torn write looks like
+            frame = fault_point("mem.unspill", data=frame)
+            body, crc_ok = _check_spill_crc(frame)
+            path = self._disk_path
+            os.unlink(path)
+            self.catalog._untrack_disk_file(path)
             self._disk_path = None
+            if not crc_ok:
+                # the corrupt frame is DROPPED, never unpickled: the
+                # typed error replays the query, which re-lands this
+                # data from the scan cache / source lineage
+                self.catalog._metrics.add("spillCorruptions", 1)
+                from spark_rapids_tpu.runtime.memory import MEM_SCOPE
+                MEM_SCOPE.add("spillCorruptions", 1)
+                raise SpillCorruptionError(
+                    f"disk spill frame {os.path.basename(path)} failed "
+                    "its CRC footer on unspill — corrupt bytes dropped; "
+                    "replay re-lands from the scan cache")
+            self._host = pickle.loads(body)
+            self._host_bytes = self._host.nbytes()
         return self._host
 
     def pin(self):
@@ -132,22 +261,54 @@ class SpillableBatch:
 
     # -- demotion -----------------------------------------------------------
     def spill_to_host(self) -> int:
-        """DEVICE -> HOST; returns device bytes freed."""
+        """DEVICE -> HOST; returns device bytes freed. Non-blocking on
+        the batch lock: a batch another thread is actively getting or
+        demoting is not IDLE — skipping it (instead of blocking) also
+        breaks the lock cycle between an unspill whose device landing
+        triggers an arbiter spill pass and a concurrent spill pass
+        walking this batch."""
+        if not self._lock.acquire(blocking=False):
+            return 0
+        try:
+            return self._spill_to_host_locked()
+        finally:
+            self._lock.release()
+
+    def _spill_to_host_locked(self) -> int:
         with self._lock:
             if self._device is None or self._pinned:
                 return 0
+            # the spill-FAILURE injection site ('crash' kind): the
+            # demotion path itself dies, the buffer stays resident
+            fault_point("mem.spill")
             freed = self._device_bytes
-            # per-column transfer: spill runs on an exhausted device, and
-            # the packed to_host would have to ALLOCATE a table-sized
-            # staging buffer there
-            self._host = self._device.to_host_per_column()
+            if any(c.is_nested for c in self._device.columns):
+                # nested buffers are composite: the legacy HostTable
+                # decode detour (never masked, so layout survives)
+                self._host = self._device.to_host_per_column()
+            else:
+                # raw per-buffer copy: exact arrays, no re-encode —
+                # the unspilled table is bit-identical in layout AND
+                # padding, and spilling never allocates a table-sized
+                # staging buffer on the exhausted device
+                self._host = _RawSpill.from_device(self._device)
             self._host_bytes = self._host.nbytes()
             self._device = None
             self._device_bytes = 0
             return freed
 
     def spill_to_disk(self) -> int:
-        """HOST -> DISK; returns host bytes freed."""
+        """HOST -> DISK; returns host bytes freed. Non-blocking on the
+        batch lock like :meth:`spill_to_host` (a busy batch is not
+        idle)."""
+        if not self._lock.acquire(blocking=False):
+            return 0
+        try:
+            return self._spill_to_disk_locked()
+        finally:
+            self._lock.release()
+
+    def _spill_to_disk_locked(self) -> int:
         with self._lock:
             if self._host is None or self._pinned:
                 return 0
@@ -158,8 +319,14 @@ class SpillableBatch:
             fd, path = tempfile.mkstemp(
                 prefix=f"rapids_spill_{os.getpid()}_{self.id}_",
                 suffix=".bin", dir=self.catalog.disk_dir)
+            # CRC32 footer over the payload (the cluster TPAK frame
+            # convention): unspill verifies before unpickling, so a
+            # rotted/torn frame raises typed SpillCorruptionError
+            # instead of serving wrong bytes
+            body = pickle.dumps(self._host,
+                                protocol=pickle.HIGHEST_PROTOCOL)
             with os.fdopen(fd, "wb") as f:
-                pickle.dump(self._host, f, protocol=pickle.HIGHEST_PROTOCOL)
+                f.write(body + struct.pack("<I", zlib.crc32(body)))
             self._disk_path = path
             self.catalog._track_disk_file(path)
             self._host = None
@@ -191,6 +358,13 @@ class SpillableBatch:
                 return False
 
         return _Pin()
+
+
+#: the operator-facing name (ISSUE 15): the hash-join build side and
+#: aggregate partials register their device intermediates under this
+#: alias so the probe/merge phase streams while idle tables ride the
+#: device->host->disk tiers
+SpillableDeviceTable = SpillableBatch
 
 
 class BufferCatalog:
@@ -244,6 +418,11 @@ class BufferCatalog:
         with self._lock:
             setattr(self, attr, getattr(self, attr) + n)
         self._metrics.add(self._SCOPE_KEYS[attr], n)
+        if attr == "device_spilled_bytes":
+            # the memory scope mirrors device bytes freed by spills —
+            # the out-of-core work a budgeted query paid (schema v10)
+            from spark_rapids_tpu.runtime.memory import MEM_SCOPE
+            MEM_SCOPE.add("spillBytes", n)
 
     def _track_disk_file(self, path: str) -> None:
         with self._lock:
@@ -279,7 +458,10 @@ class BufferCatalog:
             self._buffers.pop(sb.id, None)
 
     def on_unspill(self, sb: SpillableBatch):
-        pass  # hook for accounting/metrics
+        # spilled data brought back to the device: the out-of-core
+        # round trip completed (memory scope, event-log schema v10)
+        from spark_rapids_tpu.runtime.memory import MEM_SCOPE
+        MEM_SCOPE.add("unspills", 1)
 
     # -- accounting ---------------------------------------------------------
     def device_bytes(self) -> int:

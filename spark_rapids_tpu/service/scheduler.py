@@ -86,6 +86,13 @@ def _host_topology():
     return CLUSTER.topology_str()
 
 
+def _mem_budget_peak() -> int:
+    """The memory arbiter's peak accounted device bytes for serve-time
+    event records (schema v10 budgetPeak)."""
+    from spark_rapids_tpu.runtime.memory import MEMORY
+    return int(MEMORY.peak_bytes())
+
+
 SERVICE_POOLS = str_conf(
     "spark.rapids.service.pools", "default",
     "Named scheduling pools: semicolon-separated 'name[:weight=W]' "
@@ -217,8 +224,15 @@ def parse_tenant_weights(spec: str) -> Dict[str, float]:
 
 
 def _default_memory_probe() -> int:
+    """Admission's device-occupancy read: the memory arbiter's LIVE
+    ledger (every accounted landing and kernel intermediate, not only
+    spill-catalog-registered buffers) — the max with the catalog's own
+    view covers any spillable registered before its table was ever
+    accounted. The forward-progress escape (admit when nothing runs)
+    lives in the gate, unchanged."""
+    from spark_rapids_tpu.runtime.memory import MEMORY
     from spark_rapids_tpu.runtime.spill import BufferCatalog
-    return BufferCatalog.get().device_bytes()
+    return max(BufferCatalog.get().device_bytes(), MEMORY.occupancy())
 
 
 class QueryService:
@@ -341,6 +355,10 @@ class QueryService:
         )
         TELEMETRY.configure(self.conf)
         register_service(self)
+        # the device memory arbiter's budget follows this service's
+        # conf too (admission consults its live occupancy)
+        from spark_rapids_tpu.runtime.memory import MEMORY
+        MEMORY.configure(self.conf)
 
         # live introspection endpoint (service/introspect.py):
         # loopback-only HTTP JSON, polled by `tools top`
@@ -931,6 +949,14 @@ class QueryService:
             "hostRelands": 0,
             "dcnExchanges": 0,
             "hostScans": {},
+            # v10 out-of-core fields: a cached serve lands nothing, so
+            # no retries/spills replay; budgetPeak reads the arbiter's
+            # serve-time peak like healthState reads serve-time health
+            "oomRetries": 0,
+            "splitRetries": 0,
+            "spillBytes": 0,
+            "unspills": 0,
+            "budgetPeak": _mem_budget_peak(),
         })
         handle.event_record = rec
         try:
@@ -1102,6 +1128,12 @@ class QueryService:
         from spark_rapids_tpu.runtime.cluster import CLUSTER
         out["hosts"] = {**CLUSTER.health_snapshot(),
                         **HEALTH.host_snapshot()}
+        # the memory fault domain: arbiter budget/occupancy/peak plus
+        # the memory degradation ladder's counters — a query surviving
+        # out-of-core is VISIBLE here, not silently slower
+        from spark_rapids_tpu.runtime.memory import MEMORY
+        out["memory"] = {**MEMORY.snapshot(),
+                         **HEALTH.memory_snapshot()}
         return out
 
     def stats(self) -> dict:

@@ -22,6 +22,13 @@ def _kernel_demotions() -> Dict[str, str]:
     return kernels.demoted_ops()
 
 
+def _mem_budget_peak() -> int:
+    """The memory arbiter's peak accounted device bytes (event-log
+    schema v10 budgetPeak field; lazy import like _kernel_demotions)."""
+    from spark_rapids_tpu.runtime.memory import MEMORY
+    return int(MEMORY.peak_bytes())
+
+
 class _TLQueryState:
     """Per-(session, thread) in-flight query state. A session may run
     queries CONCURRENTLY from query-service worker threads; everything a
@@ -444,6 +451,11 @@ class TpuSession:
             host_relands=_wdelta("hostRelands", "cluster"),
             dcn_exchanges=_wdelta("dcnExchanges", "cluster"),
             host_scans=host_scan_stats(),
+            oom_retries=_wdelta("oomRetries", "memory"),
+            split_retries=_wdelta("splitRetries", "memory"),
+            spill_bytes=_wdelta("spillBytes", "memory"),
+            unspills=_wdelta("unspills", "memory"),
+            budget_peak=_mem_budget_peak(),
         )
         self.last_event_record = record
         # the record has read the tree's metrics — the cached executable
@@ -546,6 +558,9 @@ class TpuSession:
         # session's conf (cheap no-op when unchanged, the arm contract)
         from spark_rapids_tpu.obs.telemetry import TELEMETRY
         TELEMETRY.configure(self.conf)
+        # the device memory arbiter's hard budget follows it too
+        from spark_rapids_tpu.runtime import memory as _memory
+        _memory.MEMORY.configure(self.conf)
         rf_enabled = bool(self.conf.get_entry(RUNTIME_FALLBACK_ENABLED))
         max_failures = int(self.conf.get_entry(RUNTIME_FALLBACK_MAX_FAILURES))
         # enough budget to demote every op in a pathological plan without
@@ -576,8 +591,16 @@ class TpuSession:
             int(self.conf.get_entry(_cluster.CLUSTER_MAX_HOST_LOSSES))
             + int(self.conf.get_entry(DEVICE_LOSS_MAX_REINITS)) + 6)
         host_replays = 0
+        # memory degradation ladder (runtime/health.py
+        # on_memory_pressure): FatalDeviceOOMs that escaped the retry
+        # framework replay internally — enough budget to walk every
+        # rung (full-spill retry -> chunked re-execution -> one CPU
+        # demotion per plan operator) without replaying unboundedly
+        max_mem_replays = 4 * max_failures + 4
+        mem_replays = 0
         suppress_reason = None
         suppress_cluster = None
+        force_chunk = None
         while True:
             was_suppressed = suppress_reason is not None
             was_csuppressed = suppress_cluster is not None
@@ -585,10 +608,14 @@ class TpuSession:
                            if was_suppressed else nullcontext())
             cluster_ctx = (_cluster.suppressed_cluster(suppress_cluster)
                            if was_csuppressed else nullcontext())
+            from spark_rapids_tpu.runtime import memory as _memory
+            chunk_ctx = (_memory.forced_chunking(force_chunk)
+                         if force_chunk is not None else nullcontext())
             suppress_reason = None
             suppress_cluster = None
+            force_chunk = None
             try:
-                with attempt_ctx, cluster_ctx:
+                with attempt_ctx, cluster_ctx, chunk_ctx:
                     result = self._execute_attempt(plan)
                 self.last_fault_replays = replays
                 if replays and hasattr(self._last_executable, "metrics"):
@@ -605,6 +632,50 @@ class TpuSession:
                                     and _cluster.CLUSTER.active()))
                 return result
             except Exception as exc:
+                from spark_rapids_tpu.errors import FatalDeviceOOM
+                from spark_rapids_tpu.runtime.retry import is_device_oom
+                if (is_device_oom(exc)
+                        and not isinstance(exc, FatalDeviceOOM)
+                        and not getattr(exc, "_mem_handled", False)):
+                    # a RETRYABLE OOM that escaped every retry wrapper
+                    # (a landing site without its own retry_block):
+                    # the memory ladder is strictly better than
+                    # failing the query — normalize and fall through
+                    # to the FatalDeviceOOM branch below
+                    wrapped = FatalDeviceOOM(
+                        f"unhandled retryable OOM escaped to the "
+                        f"session ({type(exc).__name__}: {exc})")
+                    wrapped.__cause__ = exc
+                    if getattr(exc, "fault_op", None) is not None:
+                        wrapped.fault_op = exc.fault_op
+                    exc = wrapped
+                if isinstance(exc, FatalDeviceOOM) and \
+                        not getattr(exc, "_mem_handled", False):
+                    # the retry framework is out of moves (spill
+                    # replays AND split-and-retry both exhausted): the
+                    # MEMORY degradation ladder owns the attempt —
+                    # full-spill retry, then chunked re-execution,
+                    # then per-op CPU demotion, each action recording
+                    # a flight-recorder incident bundle
+                    from spark_rapids_tpu.runtime.health import HEALTH
+                    action = HEALTH.on_memory_pressure(exc, self.conf)
+                    if action == "abort" or mem_replays >= max_mem_replays:
+                        exc._mem_handled = True
+                        raise
+                    if self._q.exec_depth == 1:
+                        self._release_exec_cache(drop=True)
+                    mem_replays += 1
+                    F.RECOVERY.bump("query_replays")
+                    if action == "chunk":
+                        # replay with scans forced onto chunks half
+                        # the normal budget share — bounded partitions
+                        # stream where one batch could not fit
+                        force_chunk = max(
+                            1, _memory.MEMORY.scan_chunk_bytes() // 2)
+                    # "retry" replays same-shape after the full spill;
+                    # "cpu_demote" re-plans with the attributed op
+                    # demoted to the CPU path (circuit breaker)
+                    continue
                 if isinstance(exc, HostLostError) and \
                         not getattr(exc, "_health_handled", False):
                     # a whole executor HOST died (the local backend is

@@ -163,6 +163,19 @@ def compare_query(a_runs: List[dict], b_runs: List[dict]) -> dict:
                              for r in a_runs),
         "bDcnExchanges": sum(int(r.get("dcnExchanges", 0))
                              for r in b_runs),
+        # memory fault domain (schema v10): per-side spill/retry work —
+        # a wall regression explained by out-of-core spilling under a
+        # tighter budget is not a plan regression
+        "aOomRetries": sum(int(r.get("oomRetries", 0)) for r in a_runs),
+        "bOomRetries": sum(int(r.get("oomRetries", 0)) for r in b_runs),
+        "aSplitRetries": sum(int(r.get("splitRetries", 0))
+                             for r in a_runs),
+        "bSplitRetries": sum(int(r.get("splitRetries", 0))
+                             for r in b_runs),
+        "aSpillBytes": sum(int(r.get("spillBytes", 0)) for r in a_runs),
+        "bSpillBytes": sum(int(r.get("spillBytes", 0)) for r in b_runs),
+        "aUnspills": sum(int(r.get("unspills", 0)) for r in a_runs),
+        "bUnspills": sum(int(r.get("unspills", 0)) for r in b_runs),
         "ops": op_diffs,
         "newFallbacks": sorted(set(fb_b) - set(fb_a)),
         "resolvedFallbacks": sorted(set(fb_a) - set(fb_b)),
@@ -199,6 +212,14 @@ def build_compare(path_a: str, path_b: str) -> dict:
                                    for q in queries),
         "bGatherChecksFailed": sum(q["bGatherChecksFailed"]
                                    for q in queries),
+        "aOomRetries": sum(q["aOomRetries"] for q in queries),
+        "bOomRetries": sum(q["bOomRetries"] for q in queries),
+        "aSplitRetries": sum(q["aSplitRetries"] for q in queries),
+        "bSplitRetries": sum(q["bSplitRetries"] for q in queries),
+        "aSpillBytes": sum(q["aSpillBytes"] for q in queries),
+        "bSpillBytes": sum(q["bSpillBytes"] for q in queries),
+        "aUnspills": sum(q["aUnspills"] for q in queries),
+        "bUnspills": sum(q["bUnspills"] for q in queries),
         "onlyInA": sorted(set(idx_a) - set(idx_b)),
         "onlyInB": sorted(set(idx_b) - set(idx_a)),
         "totalAWallS": total_a,
@@ -236,6 +257,15 @@ def render_compare(cmp: dict, top_n: int = 5) -> str:
             f"{cmp['aMeshDegradations']} -> {cmp['bMeshDegradations']} | "
             f"gather checks failed {cmp['aGatherChecksFailed']} -> "
             f"{cmp['bGatherChecksFailed']}")
+    if (cmp.get("aOomRetries") or cmp.get("bOomRetries")
+            or cmp.get("aSpillBytes") or cmp.get("bSpillBytes")
+            or cmp.get("aSplitRetries") or cmp.get("bSplitRetries")):
+        lines.append(
+            f"Memory: oom retries {cmp['aOomRetries']} -> "
+            f"{cmp['bOomRetries']} | split retries "
+            f"{cmp['aSplitRetries']} -> {cmp['bSplitRetries']} | "
+            f"spilled {cmp['aSpillBytes']} -> {cmp['bSpillBytes']} "
+            f"bytes | unspills {cmp['aUnspills']} -> {cmp['bUnspills']}")
     if (cmp["aDeviceReinits"] or cmp["bDeviceReinits"]
             or cmp["aWorkerRestarts"] or cmp["bWorkerRestarts"]):
         lines.append(

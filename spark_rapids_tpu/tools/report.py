@@ -185,6 +185,12 @@ def analyze_query(rec: dict, top_n: int = 10) -> dict:
         "hostRelands": int(rec.get("hostRelands", 0)),
         "dcnExchanges": int(rec.get("dcnExchanges", 0)),
         "hostScans": rec.get("hostScans") or {},
+        # schema v10 (out-of-core): the per-query memory-scope deltas
+        "oomRetries": int(rec.get("oomRetries", 0)),
+        "splitRetries": int(rec.get("splitRetries", 0)),
+        "spillBytes": int(rec.get("spillBytes", 0)),
+        "unspills": int(rec.get("unspills", 0)),
+        "budgetPeak": int(rec.get("budgetPeak", 0)),
         "attribution": {
             "attributedS": round(attributed, 6),
             "untrackedS": round(float(spans.get("untrackedS", 0.0)), 6),
@@ -314,6 +320,18 @@ def build_profile(records: Iterable[dict], top_n: int = 10,
              if q["hostsLost"] or q["hostRelands"]}),
         "perHost": {h: per_host[h] for h in sorted(per_host)},
     }
+    # out-of-core memory (schema v10): retry/split/spill/unspill work
+    # the run paid under the device budget, and which queries paid it
+    memory_summary = {
+        "oomRetries": sum(q["oomRetries"] for q in queries),
+        "splitRetries": sum(q["splitRetries"] for q in queries),
+        "spillBytes": sum(q["spillBytes"] for q in queries),
+        "unspills": sum(q["unspills"] for q in queries),
+        "budgetPeak": max((q["budgetPeak"] for q in queries), default=0),
+        "spilledQueries": sorted(
+            {q["query"] for q in queries
+             if q["spillBytes"] or q["oomRetries"]}),
+    }
     # survivability (schema v4): how healthy was the process this run,
     # and which queries rode through recovery events
     survivability = {
@@ -334,6 +352,7 @@ def build_profile(records: Iterable[dict], top_n: int = 10,
         "mesh": mesh_summary,
         "meshResilience": mesh_resilience,
         "hostResilience": host_resilience,
+        "memory": memory_summary,
         "survivability": survivability,
         "minCoverage": round(min((q["attribution"]["coverage"]
                                   for q in queries), default=1.0), 4),
@@ -420,6 +439,16 @@ def render_profile(report: dict) -> str:
                 f"{st['wallS']:.4f}s (executor {st['execWallS']:.4f}s)"
                 + (f", CRC retries {st['crcRetries']}"
                    if st.get("crcRetries") else ""))
+    mm = report.get("memory") or {}
+    if (mm.get("oomRetries") or mm.get("splitRetries")
+            or mm.get("spillBytes") or mm.get("unspills")):
+        lines.append(
+            f"Memory: oom retries {mm['oomRetries']} | split retries "
+            f"{mm['splitRetries']} | spilled {mm['spillBytes']} bytes | "
+            f"unspills {mm['unspills']} | budget peak "
+            f"{mm['budgetPeak']} bytes"
+            + (f" | spilled: {', '.join(mm['spilledQueries'])}"
+               if mm.get("spilledQueries") else ""))
     sv = report["survivability"]
     if (sv["deviceReinits"] or sv["workerRestarts"]
             or sv["quarantinedQueries"]

@@ -850,6 +850,49 @@ def test_rl_obs_passive():
     assert _run_rl(_check_obs_passive, rel, real) == []
 
 
+def test_rl_mem_account():
+    """RL-MEM-ACCOUNT: raw jax.device_put inside execs//ops/ lands
+    bytes the memory arbiter never accounts — the static guard for the
+    hard device budget's zero-violation contract (ISSUE 15)."""
+    from spark_rapids_tpu.lint.repo_lint import _check_mem_account
+    src = (
+        "import jax\n"
+        "from jax import device_put\n"              # banned import form
+        "def bad(a, dev):\n"
+        "    x = jax.device_put(a, dev)\n"          # raw landing
+        "    y = device_put(a, dev)\n"              # bare-name call
+        "    return x, y\n"
+    )
+    for rel in ("spark_rapids_tpu/execs/foo.py",
+                "spark_rapids_tpu/ops/foo.py"):
+        hits = _find(_run_rl(_check_mem_account, rel, src),
+                     "RL-MEM-ACCOUNT")
+        assert len(hits) == 3, [str(d) for d in hits]
+        assert "from_host" in hits[0].message
+    # the accounted landing path itself is clean
+    ok = ("from spark_rapids_tpu.columnar import DeviceTable\n"
+          "def good(host):\n"
+          "    return DeviceTable.from_host(host)\n")
+    assert _run_rl(_check_mem_account,
+                   "spark_rapids_tpu/execs/foo.py", ok) == []
+    # outside execs//ops/ the rule does not apply (columnar/table.py
+    # and parallel/mesh.py ARE the sanctioned landing layers)
+    assert _run_rl(_check_mem_account,
+                   "spark_rapids_tpu/columnar/table.py", src) == []
+    # the allowlist hook keys on rel:qualified-function — the mesh
+    # re-land's digest-scalar put stays sanctioned with justification
+    from spark_rapids_tpu.lint.repo_lint import _MEM_ACCOUNT_ALLOWLIST
+    key = ("spark_rapids_tpu/execs/mesh.py:"
+           "TpuMeshRelandExec._reland")
+    assert key in _MEM_ACCOUNT_ALLOWLIST
+    allow = ("import jax\n"
+             "class TpuMeshRelandExec:\n"
+             "    def _reland(self, t):\n"
+             "        return jax.device_put(t, None)\n")
+    assert _run_rl(_check_mem_account,
+                   "spark_rapids_tpu/execs/mesh.py", allow) == []
+
+
 def test_every_rule_has_a_negative_test():
     """Meta-pin: the rule surface and this module's negative coverage
     cannot drift apart (>= 12 rules required by the issue)."""

@@ -277,6 +277,7 @@ def test_scale_test_flag_validation():
         service_faults = False
         cpu_baseline = False
         require_tpu = False
+        device_budget = 0
 
     ST.validate_flags(A())  # plain --mesh: fine
     A.chaos = True

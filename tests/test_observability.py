@@ -187,7 +187,9 @@ def test_span_union_seconds():
 # ---------------------------------------------------------------------------
 
 
-_VOLATILE_INT_KEYS = {"dispatches", "spanCount", "tid"}
+#: budgetPeak is the memory arbiter's PROCESS-WIDE peak — earlier tests
+#: in the same process move it, so the golden pins presence, not value
+_VOLATILE_INT_KEYS = {"dispatches", "spanCount", "tid", "budgetPeak"}
 
 #: scopes whose per-query delta depends on PROCESS WARMTH, not the
 #: query (the compile scope reports kernelTraces on a cold process and
@@ -229,14 +231,15 @@ def test_event_log_written_and_valid(tmp_path):
     lines = open(s.last_event_path).read().strip().splitlines()
     assert len(lines) == 1
     rec = json.loads(lines[0])
-    # schema v9: the flight-recorder PR added hostScans (per-executor-
-    # host scan attribution merged from cluster scan replies; {}
-    # off-cluster) on top of v8's multi-host fault-domain fields
-    # (hostTopology / hostsLost / hostRelands / dcnExchanges —
-    # null/0/0/0 off-cluster), v7's mesh fault-domain fields, v6's
-    # mesh-native fields, v5's transactional-write fields and v4's
-    # survivability fields — see obs/events.py
-    assert rec["schema"] == 9
+    # schema v10: the out-of-core PR added the memory-scope deltas
+    # (oomRetries / splitRetries / spillBytes / unspills — all 0 on an
+    # unbudgeted quiet process) and budgetPeak (the arbiter's peak
+    # accounted device bytes) on top of v9's hostScans, v8's multi-host
+    # fault-domain fields (hostTopology / hostsLost / hostRelands /
+    # dcnExchanges — null/0/0/0 off-cluster), v7's mesh fault-domain
+    # fields, v6's mesh-native fields, v5's transactional-write fields
+    # and v4's survivability fields — see obs/events.py
+    assert rec["schema"] == 10
     assert rec["healthState"] == "HEALTHY"
     assert rec["quarantined"] is False
     assert rec["deviceReinits"] == 0 and rec["workerRestarts"] == 0
@@ -250,6 +253,9 @@ def test_event_log_written_and_valid(tmp_path):
     assert rec["hostsLost"] == 0 and rec["hostRelands"] == 0
     assert rec["dcnExchanges"] == 0
     assert rec["hostScans"] == {}
+    assert rec["oomRetries"] == 0 and rec["splitRetries"] == 0
+    assert rec["spillBytes"] == 0 and rec["unspills"] == 0
+    assert isinstance(rec["budgetPeak"], int) and rec["budgetPeak"] >= 0
     assert rec["event"] == "queryCompleted"
     assert rec["queryTag"] == "golden"
     assert rec["wallS"] > 0
@@ -314,7 +320,14 @@ def test_event_log_golden_schema(tmp_path):
     attribution merged from cluster scan replies: {host: {scans,
     files, bytes, wallS, execWallS, crcRetries}}; {} off-cluster, for
     local-fallback scans and for result-cache serves — a cached serve
-    dispatches nothing)."""
+    dispatches nothing);
+    v10 = out-of-core fields (oomRetries / splitRetries / spillBytes /
+    unspills — per-record deltas of the memory scope: spill-and-replay
+    retries survived, split-and-retry escalations, device bytes freed
+    by spill demotions, spilled batches re-landed; all 0 on an
+    unbudgeted quiet process and for result-cache serves; budgetPeak —
+    the memory arbiter's peak accounted device bytes, absolute and
+    process-wide, normalized in the golden)."""
     s = _run_eventlog_query(tmp_path)
     got = _normalize(s.last_event_record)
     golden_path = os.path.join(os.path.dirname(__file__),
