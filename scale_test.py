@@ -5,6 +5,7 @@ query set over generated tables, emitting a JSON timing report.
 Usage: python scale_test.py [--sf 0.1] [--queries q1,q5] [--cpu-baseline]
        python scale_test.py --chaos [--seed 7]
        python scale_test.py --mesh 8 [--chaos] [--seed 7]
+       python scale_test.py --streaming [--chaos] [--seed 7]
 
 ``--chaos`` runs the corpus twice — fault-free, then under a
 randomized-but-SEEDED fault schedule (fetch errors, transport
@@ -17,6 +18,16 @@ asserting the exactly-once transactional-write contract — no torn
 file ever reader-visible, rerun-after-kill bit-identical, Delta
 concurrent commits converge through the rebase-and-retry loop, and
 vacuum reports zero orphans afterwards.
+
+``--streaming`` runs the micro-batch streaming + materialized-view
+harness (run_streaming, STREAM_r01.json): rate, file-watch and Delta
+CDF-tail streams over corpus-derived tables into exactly-once Delta
+sinks, plus two incrementally-maintained MVs, asserting sink row sets
+bit-identical to a fault-free twin and every MV read bit-identical to a
+from-scratch recompute at the same epoch; with ``--chaos`` each stream
+is killed once mid-micro-batch (after its offsets are durably logged,
+before the commit) under the seeded streaming fault schedule and must
+resume exactly-once from its checkpoint.
 
 ``--mesh N --chaos`` composes both modes (run_mesh_chaos): the corpus
 runs MESH-NATIVE under a seeded mesh-fault schedule firing every
@@ -2421,13 +2432,281 @@ def run_concurrent(sf: float, seed: int, queries=None, use_sql=False,
                         tenants=tenants, eventlog_dir=eventlog_dir)
 
 
+def streaming_fault_spec(seed: int) -> str:
+    """The seeded streaming fault schedule: one scripted mid-micro-batch
+    kill per stream — the rate and file-watch streams die after their
+    offsets are durably logged but before the batch executes, the CDF
+    tail dies inside the harder window (sink commit staged, marker not
+    yet written) — plus the rare seeded kernel crash the retry framework
+    absorbs transparently."""
+    return ";".join([
+        "stream.batch@rate:crash:1",
+        "stream.batch@files:crash:1",
+        "stream.sink.commit@cdf:crash:1",
+        f"exec.execute:crash:0.02:{seed * 10 + 9}",
+    ])
+
+
+def _sink_rows(session, path):
+    from spark_rapids_tpu.delta.commands import DeltaTable
+    return DeltaTable(session, path).to_df().collect_table()
+
+
+def run_streaming(sf: float = 0.02, seed: int = 7, chaos: bool = False):
+    """``--streaming [--chaos]``: rate + file-watch + CDF-tail streams
+    over corpus-derived tables, sinking through the exactly-once Delta
+    txn protocol, plus two incrementally-maintained MVs (re-aggregate +
+    append strategies) refreshed across every commit epoch.
+
+    The fault-free twin runs FIRST (its own QueryService, no faults
+    armed) to record the expected sink row sets; the measured side then
+    runs under the seeded streaming schedule when ``chaos`` — each
+    stream killed once mid-micro-batch and resumed from its checkpoint
+    — asserting: every sink row set bit-identical to the twin, every MV
+    read bit-identical to a from-scratch recompute at its epoch with
+    >= 1 incremental refresh, the service ending HEALTHY, and the
+    ``streaming`` metric scope populated (the STREAM_r01 closure)."""
+    import os
+    import shutil
+    import tempfile
+
+    import spark_rapids_tpu.functions as F
+    from spark_rapids_tpu.datagen import scale_test_specs
+    from spark_rapids_tpu.delta.commands import DeltaTable
+    from spark_rapids_tpu.delta.table import write_delta
+    from spark_rapids_tpu.io.parquet import write_parquet
+    from spark_rapids_tpu.obs.metrics import scopes_snapshot
+    from spark_rapids_tpu.ops.expr import col, lit
+    from spark_rapids_tpu.plan import nodes as P
+    from spark_rapids_tpu.runtime.faults import FAULTS
+    from spark_rapids_tpu.service.scheduler import QueryService
+    from spark_rapids_tpu.streaming import (
+        DeltaCDFSource,
+        DeltaStreamSink,
+        FileWatchSource,
+        RateSource,
+        StreamingQuery,
+    )
+
+    base = tempfile.mkdtemp(prefix="rapids_streaming_")
+    specs = scale_test_specs(sf)
+    orders = specs["orders"].generate_table(sf, seed=seed)
+    lineitem = specs["lineitem"].generate_table(sf, seed=seed)
+
+    # the file-watch corpus: three contiguous lineitem slices, staged
+    # through the transactional parquet writer then renamed into the
+    # watched directory (one file per micro-batch at maxFiles=1)
+    watch_dir = os.path.join(base, "watch")
+    os.makedirs(watch_dir)
+    rows_per_file = max(1, min(1500, lineitem.num_rows // 3))
+    for i in range(3):
+        stage = os.path.join(base, f"stage{i}")
+        written = write_parquet(
+            lineitem.slice(i * rows_per_file, rows_per_file), stage)
+        os.replace(written[0],
+                   os.path.join(watch_dir, f"batch-{i:05d}.parquet"))
+        shutil.rmtree(stage, ignore_errors=True)
+
+    # the CDF corpus: an orders-derived events table created at version
+    # 0, CDF enabled at 1, then two appends the tail consumes
+    ev_head = orders.slice(0, max(1, min(1000, orders.num_rows // 2)))
+    ev_tail = [orders.slice(1000, 500), orders.slice(1500, 500)] \
+        if orders.num_rows >= 2000 else [orders.slice(0, 1)] * 2
+
+    def make_events(session, path):
+        write_delta(P.LocalScan([ev_head]), session, path, mode="error")
+        DeltaTable(session, path).set_properties(
+            {"delta.enableChangeDataFeed": "true"})
+
+    def cdf_transform(df):
+        # a projection transform: drop the CDF metadata + date columns
+        return df.select(col("o_orderkey"), col("o_custkey"),
+                         col("o_totalprice"))
+
+    def drive_streams(svc, tag):
+        """Run all three streams to completion on ``svc``; when a
+        scripted kill fires, restart the stream from its checkpoint
+        (fresh StreamingQuery, same offset log). Returns per-stream
+        {killedBy, batches} plus the sink paths."""
+        session = svc.session
+        events = os.path.join(base, f"{tag}_events")
+        make_events(session, events)
+        sinks = {name: os.path.join(base, f"{tag}_{name}_sink")
+                 for name in ("rate", "files", "cdf")}
+        cks = {name: os.path.join(base, f"{tag}_{name}_ck")
+               for name in ("rate", "files", "cdf")}
+
+        def mk(name):
+            src = {
+                "rate": lambda: RateSource(rows_per_batch=500, seed=seed,
+                                           total_rows=1500, num_keys=32),
+                "files": lambda: FileWatchSource(watch_dir, session.conf,
+                                                 max_files_per_trigger=1),
+                "cdf": lambda: DeltaCDFSource(events, starting_version=1),
+            }[name]()
+            return StreamingQuery(
+                svc, src, DeltaStreamSink(sinks[name], name), cks[name],
+                name=name,
+                transform=cdf_transform if name == "cdf" else None)
+
+        last_q = {}
+
+        def drain(name, out):
+            q = mk(name)
+            try:
+                out["batches"] += q.process_available()
+            except Exception as e:
+                # the scripted mid-micro-batch kill: the batch is
+                # pending (offsets logged, no commit marker) — a fresh
+                # stream over the same checkpoint resumes exactly-once
+                out["killedBy"] = type(e).__name__
+                q = mk(name)
+                out["batches"] += q.process_available()
+            last_q[name] = q
+
+        results = {n: {"killedBy": None, "batches": 0}
+                   for n in ("rate", "files", "cdf")}
+        drain("rate", results["rate"])
+        drain("files", results["files"])
+        # the CDF tail interleaves with commits to the events table
+        for delta in ev_tail:
+            write_delta(P.LocalScan([delta]), session, events,
+                        mode="append")
+            drain("cdf", results["cdf"])
+        for q in last_q.values():
+            svc.register_stream(q)
+        return results, sinks, events
+
+    report = {"mode": "streaming", "seed": seed, "scale_factor": sf,
+              "backend": _resolved_backend(), "chaos": chaos,
+              "fault_spec": streaming_fault_spec(seed) if chaos else "",
+              "streams": {}, "mvs": {}}
+    failures = []
+
+    # -- fault-free twin: records the expected sink row sets -----------------
+    FAULTS.disarm()
+    twin = QueryService({"spark.rapids.service.maxConcurrentQueries": 2})
+    try:
+        _, twin_sinks, _ = drive_streams(twin, "twin")
+        expected = {name: _sink_rows(twin.session, path)
+                    for name, path in twin_sinks.items()}
+    finally:
+        twin.shutdown()
+
+    # -- measured side: seeded kills (with --chaos), MVs across epochs -------
+    conf = {"spark.rapids.service.maxConcurrentQueries": 2,
+            # a 500-row orders append touches ~1 group per customer;
+            # keep the re-aggregate path open at this corpus scale
+            "spark.rapids.streaming.mv.maxTouchedGroups": 2048}
+    if chaos:
+        conf["spark.rapids.test.faults"] = report["fault_spec"]
+    svc = QueryService(conf)
+    try:
+        session = svc.session
+        if chaos:
+            # arm BEFORE the first stream batch: fault_point fires ahead
+            # of the batch's execute (which would otherwise arm from
+            # conf too late); same spec string, so per-query re-arms
+            # are no-ops and the one-shot kill counters survive
+            FAULTS.arm(report["fault_spec"])
+        events = os.path.join(base, "mv_events")
+        make_events(session, events)
+        reg = svc.mv_registry()
+        ev_df = DeltaTable(session, events).to_df()
+        mv_agg = reg.register(
+            "rev_by_cust", ev_df.group_by(col("o_custkey")).agg(
+                F.sum(col("o_totalprice")).alias("rev"),
+                F.count(col("o_orderkey")).alias("n")))
+        mv_proj = reg.register(
+            "big_orders", ev_df.filter(
+                col("o_totalprice") > lit(250_000.0)).select(
+                    col("o_orderkey"), col("o_totalprice")))
+        mv_epochs_ok = {m.name: 0 for m in (mv_agg, mv_proj)}
+
+        results, sinks, _ = drive_streams(svc, "run")
+        if chaos:
+            for name, entry in results.items():
+                if entry["killedBy"] is None:
+                    failures.append(f"{name}: scripted kill never fired")
+
+        # every commit epoch: each MV read must be bit-identical to a
+        # from-scratch recompute of its registered plan at that epoch
+        for delta in ev_tail:
+            write_delta(P.LocalScan([delta]), session, events,
+                        mode="append")
+            for mv in (mv_agg, mv_proj):
+                diff = tables_differ_unordered(mv.read(),
+                                               mv.recompute_at_epoch())
+                if diff is not None:
+                    failures.append(
+                        f"mv {mv.name} diverged at epoch {mv.epoch()}: "
+                        f"{diff}")
+                else:
+                    mv_epochs_ok[mv.name] += 1
+
+        for name, path in sinks.items():
+            got = _sink_rows(session, path)
+            diff = tables_differ_unordered(expected[name], got)
+            entry = dict(results[name])
+            entry["rows"] = got.num_rows
+            entry["identical"] = diff is None
+            if diff is not None:
+                failures.append(f"{name}: sink diverged: {diff}")
+            report["streams"][name] = entry
+            print(json.dumps({"stream": name, **entry}))
+        for mv in (mv_agg, mv_proj):
+            entry = {"strategy": mv.strategy,
+                     "epochsVerified": mv_epochs_ok[mv.name],
+                     "incrementalRefreshes": mv.incremental_refreshes,
+                     "fullRecomputes": mv.full_recomputes,
+                     "lastRefreshMode": mv.last_refresh_mode,
+                     "fallbackReason": mv.fallback_reason}
+            if mv.incremental_refreshes < 1:
+                failures.append(
+                    f"mv {mv.name}: no refresh took the incremental "
+                    f"path (strategy={mv.strategy})")
+            report["mvs"][mv.name] = entry
+            print(json.dumps({"mv": mv.name, **entry}))
+
+        health = svc.health()
+        report["service"] = {"health": health,
+                             "streams": svc.streams()}
+        if health["state"] != "HEALTHY":
+            failures.append(
+                f"service ended {health['state']}, not HEALTHY")
+        scope = dict(scopes_snapshot().get("streaming", {}))
+        report["streaming_scope"] = scope
+        for key in ("microBatches", "sinkCommits", "mvRefreshes",
+                    "mvIncrementalRefreshes"):
+            if not scope.get(key):
+                failures.append(
+                    f"streaming scope not populated: {key}="
+                    f"{scope.get(key, 0)}")
+        if chaos:
+            report["fault_fires"] = {
+                k: v for k, v in FAULTS.counters().items() if v}
+    finally:
+        svc.shutdown()
+        FAULTS.disarm()
+        shutil.rmtree(base, ignore_errors=True)
+    report["ok"] = not failures
+    report["failures"] = failures
+    if failures:
+        err = AssertionError(
+            "streaming run failed:\n" + "\n".join(failures))
+        err.report = report
+        raise err
+    return report
+
+
 #: the harness's supported mode combinations — named in every flag-
 #: validation error so a bad invocation is a one-line fix, not an
 #: archaeology session through silently-ignored flags
 SUPPORTED_MODES = (
     "supported modes: (default timing run) | --cpu-baseline | "
     "--chaos [--concurrency N [--service-faults]] | --concurrency N | "
-    "--mesh N [--mesh-shape DxI] [--chaos] | --hosts N [--chaos]")
+    "--mesh N [--mesh-shape DxI] [--chaos] | --hosts N [--chaos] | "
+    "--streaming [--chaos]")
 
 
 def _resolved_backend() -> str:
@@ -2506,6 +2785,22 @@ def validate_flags(args) -> None:
             bad("--device-budget does not compose with --require-tpu: "
                 "the out-of-core contract is backend-independent and "
                 "the artifact records the resolved backend in-band")
+    if args.streaming:
+        if args.mesh or args.hosts:
+            bad("--streaming does not compose with --mesh/--hosts: the "
+                "streaming harness drives its own recurring tenants "
+                "through a single-process QueryService")
+        if args.device_budget:
+            bad("--streaming does not compose with --device-budget: "
+                "the memory harness runs the one-shot corpus, not "
+                "recurring streams")
+        if args.concurrency or args.service_faults:
+            bad("--streaming does not compose with --concurrency/"
+                "--service-faults: streams ARE the concurrent tenants, "
+                "and the streaming fault schedule owns the kill points")
+        if args.cpu_baseline:
+            bad("--streaming does not compose with --cpu-baseline: the "
+                "streaming baseline is its own fault-free twin run")
     if args.service_faults and not (args.chaos and args.concurrency > 1):
         bad("--service-faults needs --chaos --concurrency > 1 (the "
             "service fault points live in the worker/watchdog "
@@ -2589,6 +2884,15 @@ def main():
                          "schedule, the full memory-ladder walk with "
                          "incident bundles, and a HEALTHY service "
                          "closure (OOC_r01)")
+    ap.add_argument("--streaming", action="store_true",
+                    help="run the streaming + materialized-view harness "
+                         "(rate / file-watch / Delta-CDF streams into "
+                         "exactly-once Delta sinks, two incrementally-"
+                         "maintained MVs verified bit-identical to a "
+                         "from-scratch recompute at every epoch); with "
+                         "--chaos, each stream is killed once mid-"
+                         "micro-batch under the seeded schedule and "
+                         "must resume exactly-once (STREAM_r01)")
     ap.add_argument("--require-tpu", action="store_true",
                     help="exit non-zero when the resolved JAX backend is "
                          "'cpu' — a perf run that meant to hit the TPU "
@@ -2605,6 +2909,25 @@ def main():
     if args.require_tpu:
         from spark_rapids_tpu.tools import require_tpu_backend
         require_tpu_backend()
+
+    if args.streaming:
+        def dump_stream_report(report):
+            print(json.dumps(report))
+            if args.out:
+                with open(args.out, "w") as f:
+                    json.dump(report, f, indent=1)
+
+        try:
+            report = run_streaming(
+                sf=args.sf if args.sf is not None else 0.02,
+                seed=args.seed if args.seed is not None else 7,
+                chaos=args.chaos)
+        except AssertionError as e:
+            if getattr(e, "report", None) is not None:
+                dump_stream_report(e.report)
+            raise SystemExit(f"FAILED: {e}")
+        dump_stream_report(report)
+        return
 
     if args.device_budget:
         wanted = [q.strip() for q in args.queries.split(",") if q.strip()]

@@ -298,6 +298,38 @@ SHUFFLE_COMPRESSION_CODEC = str_conf(
     "codec), zstd, or zlib. lz4/zstd degrade to zlib when their backend "
     "is unavailable; the resolved codec is what gets recorded on disk.")
 
+# -- streaming ingestion + materialized views (streaming/) -------------------
+
+STREAMING_POOL = str_conf(
+    "spark.rapids.streaming.pool", "default",
+    "Scheduling pool StreamingQuery micro-batches submit to on the "
+    "query service (must name a configured service pool); streams are "
+    "recurring tenants, so their pool/tenant SLOs roll up on /slo like "
+    "any other traffic.")
+
+STREAMING_TRIGGER_INTERVAL_MS = int_conf(
+    "spark.rapids.streaming.triggerIntervalMs", 50,
+    "Micro-batch trigger cadence: how long a running stream sleeps "
+    "between an empty poll and the next source check.")
+
+STREAMING_MAX_FILES_PER_TRIGGER = int_conf(
+    "spark.rapids.streaming.maxFilesPerTrigger", 16,
+    "File-watch source batch bound: at most this many newly-seen files "
+    "enter one micro-batch; the rest wait for the next trigger.")
+
+STREAMING_MV_INCREMENTAL = bool_conf(
+    "spark.rapids.streaming.mv.incremental.enabled", True,
+    "Maintain materialized views from the CDF delta (append for "
+    "projections/filters, touched-group re-aggregation for "
+    "aggregates). Off: every refresh is a full recompute of the "
+    "registered plan.")
+
+STREAMING_MV_MAX_TOUCHED_GROUPS = int_conf(
+    "spark.rapids.streaming.mv.maxTouchedGroups", 64,
+    "Re-aggregation bound: when one refresh's CDF delta touches more "
+    "distinct group keys than this, the refresh falls back to a full "
+    "recompute instead of building an oversized touched-key filter.")
+
 PARQUET_READER_TYPE = str_conf(
     "spark.rapids.sql.format.parquet.reader.type", "AUTO",
     "PERFILE, COALESCING, MULTITHREADED or AUTO (reference: "

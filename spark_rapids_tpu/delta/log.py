@@ -140,6 +140,27 @@ class RemoveFile:
 
 
 @dataclass
+class SetTransaction:
+    """The Delta protocol's ``txn`` action: an application-scoped
+    watermark (appId -> monotonically increasing version) committed
+    ATOMICALLY with the data it covers. THE exactly-once primitive for
+    streaming sinks: a micro-batch's append commits
+    ``txn(streamId, batchId)`` alongside its add actions, so a replay
+    after a mid-write death reads the watermark back and skips the
+    batch instead of double-appending (Structured Streaming's
+    DeltaSink idempotency contract)."""
+
+    app_id: str
+    version: int
+    last_updated: int = 0
+
+    def to_action(self) -> dict:
+        return {"txn": {"appId": self.app_id, "version": self.version,
+                        "lastUpdated": self.last_updated
+                        or int(time.time() * 1000)}}
+
+
+@dataclass
 class Metadata:
     schema_json: str
     partition_columns: List[str] = field(default_factory=list)
@@ -440,6 +461,31 @@ class DeltaLog:
                         adds.pop(action["remove"]["path"], None)
         return Snapshot(target, meta, list(adds.values()))
 
+    def last_txn_version(self, app_id: str) -> Optional[int]:
+        """The newest committed ``txn`` watermark for ``app_id``, or
+        None if the application never committed one. Walks the log
+        newest-first so the common case (watermark in the tail) is
+        O(1) commits; txn actions replay like any action, so a
+        watermark is durable exactly when its data is."""
+        try:
+            latest = self.latest_version()
+        except ColumnarProcessingError:
+            return None
+        best: Optional[int] = None
+        for v in range(latest, -1, -1):
+            try:
+                actions = self.read_actions(v)
+            except (FileNotFoundError, OSError):
+                continue
+            for a in actions:
+                t = a.get("txn")
+                if t and t.get("appId") == app_id:
+                    best = int(t["version"])
+                    break
+            if best is not None:
+                return best
+        return None
+
     # -- commit -------------------------------------------------------------
     def read_actions(self, version: int) -> List[dict]:
         """The raw action objects of one committed version (conflict
@@ -490,12 +536,17 @@ class DeltaLog:
                 os.unlink(tmp)
             except OSError:
                 pass
-        # a committed table write stales every cached service result
-        # (the query-service result cache keys on pre-write state)
+        # a committed table write stales cached service results over
+        # THIS table (the result cache keys entries on the epoch vector
+        # of the tables their plan read) — scoped, so a hot cache over
+        # an unrelated table survives, and the per-table bump is the
+        # incremental-MV refresh trigger (epoch listeners)
         from spark_rapids_tpu.service.result_cache import (
-            bump_invalidation_epoch,
+            bump_table_epoch,
+            delta_table_id,
         )
-        bump_invalidation_epoch(
+        bump_table_epoch(
+            delta_table_id(self.table_path),
             f"delta {op_name} v{expected_version} {self.table_path}")
         return expected_version
 

@@ -654,10 +654,13 @@ def _check_write_compat(snap: Snapshot, schema, partition_by,
 def write_delta(df_plan: PlanNode, session, table_path: str,
                 mode: str = "error",
                 partition_by: Optional[List[str]] = None,
-                merge_schema: bool = False) -> int:
+                merge_schema: bool = False,
+                txn_action=None) -> int:
     """modes: error | append | overwrite (Spark writer semantics).
     ``merge_schema`` allows the write to ADD columns; the widened schema
-    commits as a Metadata action (Spark mergeSchema)."""
+    commits as a Metadata action (Spark mergeSchema). ``txn_action``
+    (a SetTransaction) commits a streaming watermark atomically with the
+    data — the exactly-once sink contract rides on it."""
     if mode not in ("error", "append", "overwrite", "ignore"):
         raise ColumnarProcessingError(
             f"unknown write mode {mode!r} (error|append|overwrite|ignore)")
@@ -730,4 +733,6 @@ def write_delta(df_plan: PlanNode, session, table_path: str,
             continue
         txn.stage(_write_data_file(table_path, sub, vals, subdir,
                                    physical=phys))
+    if txn_action is not None:
+        txn.stage(txn_action)
     return txn.commit(op)

@@ -111,6 +111,13 @@ RULES: Dict[str, str] = {
                       "route through the memory-arbiter-accounted "
                       "DeviceTable.from_host path or the hard device "
                       "budget silently leaks",
+    "RL-MV-EPOCH": "streaming/ touches the service result cache "
+                   "directly (mutator call, _entries access, or a "
+                   "non-epoch import from service/result_cache) — MV "
+                   "and stream maintenance must go through the "
+                   "invalidation-epoch API (bump_table_epoch/"
+                   "epoch listeners) so cache coherence has exactly "
+                   "one write path",
 }
 
 

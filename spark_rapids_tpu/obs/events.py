@@ -113,7 +113,19 @@ EVENT_LOG_DIR = str_conf(
 #: plus budgetPeak (the memory arbiter's PEAK accounted device bytes
 #: at record time — absolute, process-wide, not a delta). All deltas 0
 #: on an unbudgeted quiet process and for result-cache serves.
-EVENT_SCHEMA_VERSION = 10
+#: v11 (streaming PR): + microBatches (streaming micro-batches whose
+#: execution rode this query's wall), mvRefreshes (materialized-view
+#: refreshes taken), mvIncrementalRefreshes (refreshes satisfied from
+#: the CDF delta instead of a full recompute), mvFullRecomputes
+#: (refreshes that fell back to recomputing the whole plan),
+#: sinkCommits (transactional micro-batch sink commits) and
+#: sinkReplays (micro-batches skipped at the sink because their txn
+#: watermark was already committed — the exactly-once dedupe firing) —
+#: per-record DELTAS of the new ``streaming`` scope (streaming/), all
+#: 0 for non-streaming queries and result-cache serves; plus mvEpoch
+#: (the maintained table's Delta version when this query was served
+#: FROM a materialized view; null for every other query).
+EVENT_SCHEMA_VERSION = 11
 
 
 def plan_tree(executable) -> dict:
@@ -244,7 +256,14 @@ def build_query_record(*, query_index: int, wall_s: float,
                        split_retries: int = 0,
                        spill_bytes: int = 0,
                        unspills: int = 0,
-                       budget_peak: int = 0) -> dict:
+                       budget_peak: int = 0,
+                       micro_batches: int = 0,
+                       mv_refreshes: int = 0,
+                       mv_incremental_refreshes: int = 0,
+                       mv_full_recomputes: int = 0,
+                       sink_commits: int = 0,
+                       sink_replays: int = 0,
+                       mv_epoch: Optional[int] = None) -> dict:
     """Assemble one event-log record. Every field is JSON-native; the
     golden schema test normalizes timings and pins the shape.
     ``service`` is the query-service envelope (tenant, pool, queueWaitS,
@@ -300,6 +319,13 @@ def build_query_record(*, query_index: int, wall_s: float,
         "spillBytes": int(spill_bytes),
         "unspills": int(unspills),
         "budgetPeak": int(budget_peak),
+        "microBatches": int(micro_batches),
+        "mvRefreshes": int(mv_refreshes),
+        "mvIncrementalRefreshes": int(mv_incremental_refreshes),
+        "mvFullRecomputes": int(mv_full_recomputes),
+        "sinkCommits": int(sink_commits),
+        "sinkReplays": int(sink_replays),
+        "mvEpoch": mv_epoch if mv_epoch is None else int(mv_epoch),
         "faultReplays": fault_replays,
         "plan": plan_tree(executable),
         "fallbacks": collect_fallbacks(meta),

@@ -35,17 +35,40 @@ the epoch they were filled under and stale entries drop on lookup.
 from __future__ import annotations
 
 import hashlib
+import os
 import threading
 import weakref
-from typing import Optional, Tuple
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
 # ---------------------------------------------------------------------------
-# Invalidation epoch
+# Invalidation epochs
 # ---------------------------------------------------------------------------
+#
+# Two granularities version the warehouse state the caches key against:
+#
+# * the GLOBAL epoch — catalog-wide changes (temp-view/table
+#   registration, WriteFiles to arbitrary paths) where the affected
+#   table set is unknowable; a bump stales EVERY entry;
+# * PER-TABLE epochs — a Delta commit names exactly the table it
+#   changed (:func:`delta_table_id`), so only entries whose plans READ
+#   that table (:func:`plan_table_ids`) go stale, and a hot cache over
+#   an unrelated table survives the commit.
+#
+# Cache entries snapshot the vector they were filled under
+# (:func:`epoch_snapshot`) and drop on lookup when any component moved
+# (:func:`epochs_current`). Listeners (:func:`register_epoch_listener`)
+# observe every bump — the materialized-view registry's refresh trigger
+# (streaming/mv.py) rides this hook instead of losing its state.
 
 _EPOCH_LOCK = threading.Lock()
 _EPOCH = [0]
 _EPOCH_REASON = [""]
+_TABLE_EPOCHS: Dict[str, int] = {}
+_EPOCH_LISTENERS: List[Callable] = []
+
+#: the global component's key inside an epoch-snapshot dict (never a
+#: valid table id — table ids always carry a "<kind>:" prefix)
+GLOBAL_EPOCH_KEY = ""
 
 
 def invalidation_epoch() -> int:
@@ -53,16 +76,113 @@ def invalidation_epoch() -> int:
         return _EPOCH[0]
 
 
+def table_epoch(table_id: str) -> int:
+    """Current epoch of one table identity (0 until its first bump)."""
+    with _EPOCH_LOCK:
+        return _TABLE_EPOCHS.get(table_id, 0)
+
+
+def _notify_listeners(table_id: Optional[str], epoch: int,
+                      reason: str) -> None:
+    # outside _EPOCH_LOCK: listeners run arbitrary user code (the MV
+    # registry marks views stale) and must never deadlock a concurrent
+    # epoch read; snapshot under the lock, call without it
+    with _EPOCH_LOCK:
+        listeners = list(_EPOCH_LISTENERS)
+    for fn in listeners:
+        try:
+            fn(table_id, epoch, reason)
+        except Exception:
+            pass  # a broken listener must not fail the commit path
+
+
+def register_epoch_listener(fn: Callable) -> None:
+    """Subscribe ``fn(table_id_or_None, new_epoch, reason)`` to every
+    epoch bump (``table_id`` is None for global bumps). THE hook for
+    maintenance that must react to commits without being dropped by
+    them (incremental MV refresh)."""
+    with _EPOCH_LOCK:
+        if fn not in _EPOCH_LISTENERS:
+            _EPOCH_LISTENERS.append(fn)
+
+
+def unregister_epoch_listener(fn: Callable) -> None:
+    with _EPOCH_LOCK:
+        try:
+            _EPOCH_LISTENERS.remove(fn)
+        except ValueError:
+            pass
+
+
 def bump_invalidation_epoch(reason: str = "") -> int:
-    """Storage/catalog state changed (temp-view or table registration,
-    WriteFiles, Delta/Iceberg commit): every currently cached result —
-    and every cached executable whose scans may now read different
-    bytes — is stale. Called by the session's write detection, the SQL
-    catalog's mutators, and the Delta log's commit path."""
+    """Catalog-wide state changed (temp-view or table registration,
+    WriteFiles, schema mutation): every currently cached result — and
+    every cached executable whose scans may now read different bytes —
+    is stale. Called by the session's write detection and the SQL
+    catalog's mutators; Delta commits use the table-scoped
+    :func:`bump_table_epoch` instead."""
     with _EPOCH_LOCK:
         _EPOCH[0] += 1
         _EPOCH_REASON[0] = reason
-        return _EPOCH[0]
+        new = _EPOCH[0]
+    _notify_listeners(None, new, reason)
+    return new
+
+
+def bump_table_epoch(table_id: str, reason: str = "") -> int:
+    """ONE table's state changed (a Delta commit): entries whose plans
+    read ``table_id`` are stale; everything else keeps serving. The
+    global epoch does not move."""
+    with _EPOCH_LOCK:
+        _TABLE_EPOCHS[table_id] = _TABLE_EPOCHS.get(table_id, 0) + 1
+        new = _TABLE_EPOCHS[table_id]
+    _notify_listeners(table_id, new, reason)
+    return new
+
+
+def delta_table_id(table_path: str) -> str:
+    """Canonical epoch identity of a Delta table (path-normalized so
+    the commit path and the scan walk agree on relative paths)."""
+    return "delta:" + os.path.abspath(table_path)
+
+
+def plan_table_ids(plan) -> frozenset:
+    """The epoch-scoped table identities a plan reads: every node
+    carrying a ``table_path`` (DeltaScanNode and the other
+    log-backed scans). File scans and in-memory tables key structurally
+    through the fingerprint itself, so only the global epoch governs
+    them."""
+    ids = set()
+    stack = [plan]
+    while stack:
+        n = stack.pop()
+        tp = getattr(n, "table_path", None)
+        if isinstance(tp, str) and tp:
+            ids.add(delta_table_id(tp))
+        stack.extend(getattr(n, "children", ()))
+    return frozenset(ids)
+
+
+def epoch_snapshot(table_ids: Iterable[str] = ()) -> Dict[str, int]:
+    """One atomic view of the global epoch plus the named tables'
+    epochs — what a cache entry remembers it was filled under."""
+    with _EPOCH_LOCK:
+        snap = {GLOBAL_EPOCH_KEY: _EPOCH[0]}
+        for t in table_ids:
+            snap[t] = _TABLE_EPOCHS.get(t, 0)
+    return snap
+
+
+def epochs_current(snap: Dict[str, int]) -> bool:
+    """Is a remembered epoch snapshot still the live state? False as
+    soon as ANY component (global or per-table) moved."""
+    with _EPOCH_LOCK:
+        for k, v in snap.items():
+            cur = _EPOCH[0] if k == GLOBAL_EPOCH_KEY \
+                else _TABLE_EPOCHS.get(k, 0)
+            if cur != v:
+                return False
+    return True
 
 
 # ---------------------------------------------------------------------------
@@ -95,6 +215,7 @@ RESULT_NEUTRAL_PREFIXES = (
     "spark.rapids.sql.explain",
     "spark.rapids.sql.planVerify.mode",
     "spark.rapids.service.",
+    "spark.rapids.streaming.",
     # fetch mechanics only — the root transition's flag is re-set per
     # query, results and the converted tree are byte-identical
     "spark.rapids.sql.asyncResultFetch",
@@ -113,6 +234,7 @@ EXECUTABLE_NEUTRAL_PREFIXES = (
     "spark.rapids.sql.metrics.level",
     "spark.rapids.sql.explain",
     "spark.rapids.service.",
+    "spark.rapids.streaming.",
     "spark.rapids.sql.asyncResultFetch",
     "spark.rapids.sql.executableCache.",
 )

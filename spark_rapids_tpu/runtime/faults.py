@@ -62,6 +62,16 @@ FAULT_POINTS: Dict[str, tuple] = {
     "dispatch.kernel": (
         "spark_rapids_tpu/dispatch.py",
         "before each jitted kernel dispatch"),
+    "stream.batch": (
+        "spark_rapids_tpu/streaming/query.py",
+        "after a micro-batch's offsets are durably logged, before it "
+        "executes (a crash here leaves a pending batch; resume re-runs "
+        "the SAME offsets)"),
+    "stream.sink.commit": (
+        "spark_rapids_tpu/streaming/sink.py",
+        "after the sink's replay check, before the transactional "
+        "commit (a crash here re-runs the batch; the txn watermark "
+        "dedupes the replay)"),
     "exec.execute": (
         "spark_rapids_tpu/runtime/faults.py",
         "at each device exec's execute()/execute_masked() boundary "

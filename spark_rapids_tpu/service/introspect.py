@@ -43,6 +43,7 @@ def _routes(service, path: str, query: dict) -> Optional[dict]:
             "stats": service.stats(),
             "slo": service.slo_snapshot(),
             "queries": service.query_table(),
+            "streams": service.streams(),
             "telemetry": {
                 "sampler": TELEMETRY.stats(),
                 "tail": TELEMETRY.tail(
@@ -57,6 +58,8 @@ def _routes(service, path: str, query: dict) -> Optional[dict]:
         return service.slo_snapshot()
     if path == "/queries":
         return {"queries": service.query_table()}
+    if path == "/streams":
+        return {"streams": service.streams()}
     if path == "/telemetry":
         n = query.get("n")
         return {
@@ -89,7 +92,7 @@ class IntrospectionServer:
                         doc = {"error": f"no route {parsed.path!r}",
                                "routes": ["/top", "/health", "/stats",
                                           "/slo", "/queries",
-                                          "/telemetry"]}
+                                          "/streams", "/telemetry"]}
                 except Exception as exc:  # surface, never crash the srv
                     status, doc = 500, {
                         "error": f"{type(exc).__name__}: {exc}"}

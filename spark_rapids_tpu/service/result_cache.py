@@ -15,10 +15,12 @@ Correctness over hit rate, everywhere:
 * anything the fingerprinter cannot PROVE structurally stable (a UDF
   closure, an unknown object with an address-y repr) marks the plan
   uncacheable — a miss, never a wrong hit;
-* every catalog mutation or table write bumps the process-wide
-  invalidation epoch (:func:`bump_invalidation_epoch`); entries
-  remember the epoch they were filled under and a stale entry is
-  evicted on lookup, never served;
+* every catalog mutation bumps the process-wide invalidation epoch
+  (:func:`bump_invalidation_epoch`) and every Delta commit bumps its
+  TABLE's epoch (:func:`bump_table_epoch`); entries remember the epoch
+  vector (global + the tables their plan read) they were filled under
+  and a stale entry is evicted on lookup, never served — while a
+  commit to an unrelated table leaves hot entries serving;
 * the LRU is bounded by ``spark.rapids.service.resultCache.maxBytes``
   of ``HostTable.nbytes()``.
 
@@ -38,11 +40,20 @@ from spark_rapids_tpu.plan.fingerprint import (  # noqa: F401  (re-exports:
     # executable cache keys off the SAME implementation; historical
     # import sites — delta/log.py, sql/catalog.py, session.py, tests —
     # keep resolving through this module)
+    GLOBAL_EPOCH_KEY,
     RESULT_NEUTRAL_PREFIXES as _RESULT_NEUTRAL_PREFIXES,
     Unfingerprintable,
     bump_invalidation_epoch,
+    bump_table_epoch,
+    delta_table_id,
+    epoch_snapshot,
+    epochs_current,
     fingerprint,
     invalidation_epoch,
+    plan_table_ids,
+    register_epoch_listener,
+    table_epoch,
+    unregister_epoch_listener,
 )
 
 register_metric("resultCacheHits", "count", "ESSENTIAL",
@@ -64,13 +75,22 @@ register_metric("resultCacheBytes", "bytes", "MODERATE",
 
 
 class _Entry:
-    __slots__ = ("table", "nbytes", "epoch", "event_record")
+    __slots__ = ("table", "nbytes", "epochs", "event_record")
 
-    def __init__(self, table, nbytes: int, epoch: int, event_record):
+    def __init__(self, table, nbytes: int, epochs: dict, event_record):
         self.table = table
         self.nbytes = nbytes
-        self.epoch = epoch
+        #: the epoch VECTOR the result was computed under: the global
+        #: epoch keyed by GLOBAL_EPOCH_KEY plus one component per table
+        #: the plan read — staleness is "any component moved", so a
+        #: commit to an unrelated table leaves this entry serving
+        self.epochs = epochs
         self.event_record = event_record
+
+    @property
+    def epoch(self) -> int:
+        """The global component (back-compat for introspection)."""
+        return self.epochs.get(GLOBAL_EPOCH_KEY, 0)
 
 
 class ResultCache:
@@ -99,10 +119,9 @@ class ResultCache:
             with self._lock:
                 self._account_miss()
             return None
-        epoch = invalidation_epoch()
         with self._lock:
             e = self._entries.get(key)
-            if e is not None and e.epoch != epoch:
+            if e is not None and not epochs_current(e.epochs):
                 del self._entries[key]
                 self._bytes -= e.nbytes
                 self._metrics.add("resultCacheBytes", -e.nbytes)
@@ -118,21 +137,25 @@ class ResultCache:
             return e
 
     def put(self, key: Optional[str], table, event_record=None,
-            epoch: Optional[int] = None) -> bool:
-        """Insert a result. ``epoch`` is the invalidation epoch the
-        result was COMPUTED under (captured by the caller before
-        execution) — a write that landed mid-execution then stales the
-        entry on its first lookup instead of the entry masquerading as
-        post-write state. Defaults to the current epoch for callers
-        with no execution window. Oversized results (> max_bytes) are
-        not cached. Returns whether stored."""
+            epoch: Optional[int] = None,
+            epochs: Optional[dict] = None) -> bool:
+        """Insert a result. ``epochs`` is the epoch VECTOR the result
+        was COMPUTED under (global + per-table components, captured by
+        the caller before execution via ``epoch_snapshot``) — a write
+        that landed mid-execution then stales the entry on its first
+        lookup instead of the entry masquerading as post-write state.
+        ``epoch`` (global-only) is the legacy spelling; both default to
+        the current state for callers with no execution window.
+        Oversized results (> max_bytes) are not cached. Returns
+        whether stored."""
         if key is None or table is None:
             return False
         nbytes = int(table.nbytes())
         if nbytes > self.max_bytes:
             return False
-        if epoch is None:
-            epoch = invalidation_epoch()
+        if epochs is None:
+            epochs = epoch_snapshot() if epoch is None \
+                else {GLOBAL_EPOCH_KEY: int(epoch)}
         with self._lock:
             old = self._entries.pop(key, None)
             if old is not None:
@@ -144,7 +167,7 @@ class ResultCache:
                 self._metrics.add("resultCacheBytes", -victim.nbytes)
                 self.evictions += 1
                 self._metrics.add("resultCacheEvictions", 1)
-            self._entries[key] = _Entry(table, nbytes, epoch, event_record)
+            self._entries[key] = _Entry(table, nbytes, epochs, event_record)
             self._bytes += nbytes
             self._metrics.add("resultCacheBytes", nbytes)
         return True

@@ -66,8 +66,10 @@ from spark_rapids_tpu.service.query import (
 )
 from spark_rapids_tpu.service.result_cache import (
     ResultCache,
+    epoch_snapshot,
     fingerprint,
-    invalidation_epoch,
+    invalidation_epoch,  # noqa: F401  (stable import surface for tests)
+    plan_table_ids,
 )
 from spark_rapids_tpu.service.watchdog import WorkerWatchdog, _Worker
 
@@ -280,6 +282,14 @@ class QueryService:
                 int(self.conf.get_entry(SERVICE_RESULT_CACHE_MAX_BYTES)))
         #: injectable for tests; production consults the spill catalog
         self._memory_probe = _default_memory_probe
+        #: recurring tenants (streaming/query.py StreamingQuery
+        #: registers itself for its lifetime): name -> stream object
+        #: exposing describe() — surfaced by streams()/stats()//top so
+        #: long-lived micro-batch streams are visible next to one-shot
+        #: queries
+        self._streams_lock = threading.Lock()
+        self._streams: Dict[str, object] = {}
+        self._mvs = None
 
         self._cond = threading.Condition()
         #: (pool, tenant) -> FIFO of queued handles
@@ -841,9 +851,13 @@ class QueryService:
             # serve — a cache hit is still a completion the caller was
             # told would not happen
             handle.scope.check()
-            # epoch BEFORE execution: a write landing while this query
-            # runs must stale the entry we fill, not be masked by it
-            epoch = invalidation_epoch()
+            # epoch VECTOR before execution (global + the epochs of
+            # every table this plan reads): a write landing while this
+            # query runs must stale the entry we fill, not be masked by
+            # it — and entries scoped to their read set survive commits
+            # to unrelated tables
+            epochs = (epoch_snapshot(plan_table_ids(handle.plan))
+                      if self.result_cache is not None else None)
             fp = (fingerprint(handle.plan, self.conf)
                   if self.result_cache is not None else None)
             cached = (self.result_cache.get(fp)
@@ -874,7 +888,7 @@ class QueryService:
             handle.event_record = self.session._q.event_record
             if self.result_cache is not None:
                 self.result_cache.put(fp, table, handle.event_record,
-                                      epoch=epoch)
+                                      epochs=epochs)
             if handle._transition(QueryState.FINISHED, result=table):
                 self._count_event("finished")
                 self._note_finished(handle)
@@ -957,6 +971,16 @@ class QueryService:
             "spillBytes": 0,
             "unspills": 0,
             "budgetPeak": _mem_budget_peak(),
+            # v11 streaming fields: a cached serve runs no micro-batch
+            # and refreshes no view, so every delta is 0; mvEpoch stays
+            # the filling run's — it describes the DATA being served,
+            # which a valid cache entry still reflects
+            "microBatches": 0,
+            "mvRefreshes": 0,
+            "mvIncrementalRefreshes": 0,
+            "mvFullRecomputes": 0,
+            "sinkCommits": 0,
+            "sinkReplays": 0,
         })
         handle.event_record = rec
         try:
@@ -989,6 +1013,19 @@ class QueryService:
         if self.introspect is not None:
             self.introspect.shutdown()
             self.introspect = None
+        # stop recurring streams + detach the MV registry's epoch
+        # listener so neither outlives the service
+        with self._streams_lock:
+            streams, mvs = list(self._streams.values()), self._mvs
+            self._streams.clear()
+            self._mvs = None
+        for s in streams:
+            try:
+                s.stop(wait=wait)
+            except Exception:
+                pass
+        if mvs is not None:
+            mvs.close()
 
     def __enter__(self) -> "QueryService":
         return self
@@ -1161,3 +1198,40 @@ class QueryService:
         if self.result_cache is not None:
             out["resultCache"] = self.result_cache.stats()
         return out
+
+    # -- recurring streams ---------------------------------------------------
+    def register_stream(self, stream) -> None:
+        """Register a recurring tenant (a StreamingQuery) for the
+        introspection surfaces; latest registration wins a name."""
+        with self._streams_lock:
+            self._streams[stream.name] = stream
+
+    def unregister_stream(self, name: str) -> None:
+        with self._streams_lock:
+            self._streams.pop(name, None)
+
+    def streams(self) -> List[dict]:
+        """Descriptors of every registered recurring stream (name,
+        source kind, pool/tenant, batch/offset progress, state) —
+        rendered by ``tools top`` and served on /top."""
+        with self._streams_lock:
+            items = sorted(self._streams.items())
+        out = []
+        for _, s in items:
+            try:
+                out.append(s.describe())
+            except Exception:
+                pass  # a dying stream must not break introspection
+        return out
+
+    def mv_registry(self):
+        """The service's MaterializedViewRegistry (streaming/mv.py),
+        created on first use over the shared session and torn down with
+        the service (its epoch listener must not outlive it)."""
+        with self._streams_lock:
+            if self._mvs is None:
+                from spark_rapids_tpu.streaming.mv import (
+                    MaterializedViewRegistry,
+                )
+                self._mvs = MaterializedViewRegistry(self.session)
+            return self._mvs

@@ -39,16 +39,19 @@ class _TLQueryState:
     serial callers on another thread still see the most recent query."""
 
     __slots__ = ("exec_depth", "next_tag", "next_sql", "next_service",
-                 "meta", "phases", "executable", "dispatches",
-                 "fault_replays", "event_record", "event_path",
-                 "exec_cache_token", "exec_cache_hit", "compile_ms",
-                 "pad_waste")
+                 "next_mv_epoch", "stream_deltas", "meta", "phases",
+                 "executable",
+                 "dispatches", "fault_replays", "event_record",
+                 "event_path", "exec_cache_token", "exec_cache_hit",
+                 "compile_ms", "pad_waste")
 
     def __init__(self):
         self.exec_depth = 0
         self.next_tag = None
         self.next_sql = None
         self.next_service = None
+        self.next_mv_epoch = None
+        self.stream_deltas = None
         self.meta = None
         self.phases = None
         self.executable = None
@@ -96,6 +99,22 @@ class TpuSession:
     next_query_service = _tl_only(
         "next_service", "service envelope (tenant/pool/queue-wait/"
         "cache-hit) the NEXT execute() on this thread records")
+    next_query_mv_epoch = _tl_only(
+        "next_mv_epoch", "materialized-view epoch (the maintained "
+        "table's Delta version) the NEXT execute() on this thread "
+        "records as mvEpoch — set by MV serve paths, null otherwise")
+
+    def stage_stream_delta(self, key: str, n: int = 1) -> None:
+        """Attribute streaming work (microBatches/mvRefreshes/.../
+        sinkReplays) to the NEXT execute() on this thread: the streaming
+        subsystem's bookkeeping runs BETWEEN query envelopes (after one
+        execute returns, before the next starts), so the process-wide
+        scope deltas alone would never land inside a record's window.
+        Drained (and zeroed) by the next record built on this thread."""
+        q = self._q
+        d = q.stream_deltas or {}
+        d[key] = d.get(key, 0) + n
+        q.stream_deltas = d
     _exec_depth = _tl_only(
         "exec_depth", "nested-execute depth on this thread")
     _last_meta = _tl_only("meta", "overrides meta of this thread's query")
@@ -296,6 +315,8 @@ class TpuSession:
         query_tag, q.next_tag = q.next_tag, None
         sql_text, q.next_sql = q.next_sql, None
         service_info, q.next_service = q.next_service, None
+        mv_epoch, q.next_mv_epoch = q.next_mv_epoch, None
+        stream_deltas, q.stream_deltas = (q.stream_deltas or {}), None
 
         if not q.exec_depth:
             # fresh per-host scan attribution for this top-level query
@@ -456,6 +477,23 @@ class TpuSession:
             spill_bytes=_wdelta("spillBytes", "memory"),
             unspills=_wdelta("unspills", "memory"),
             budget_peak=_mem_budget_peak(),
+            # streaming attribution: scope deltas (work done INSIDE
+            # this window) plus the deltas the streaming subsystem
+            # staged on this thread between envelopes
+            micro_batches=_wdelta("microBatches", "streaming")
+            + stream_deltas.get("microBatches", 0),
+            mv_refreshes=_wdelta("mvRefreshes", "streaming")
+            + stream_deltas.get("mvRefreshes", 0),
+            mv_incremental_refreshes=_wdelta(
+                "mvIncrementalRefreshes", "streaming")
+            + stream_deltas.get("mvIncrementalRefreshes", 0),
+            mv_full_recomputes=_wdelta("mvFullRecomputes", "streaming")
+            + stream_deltas.get("mvFullRecomputes", 0),
+            sink_commits=_wdelta("sinkCommits", "streaming")
+            + stream_deltas.get("sinkCommits", 0),
+            sink_replays=_wdelta("sinkReplays", "streaming")
+            + stream_deltas.get("sinkReplays", 0),
+            mv_epoch=mv_epoch,
         )
         self.last_event_record = record
         # the record has read the tree's metrics — the cached executable

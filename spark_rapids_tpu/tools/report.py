@@ -191,6 +191,14 @@ def analyze_query(rec: dict, top_n: int = 10) -> dict:
         "spillBytes": int(rec.get("spillBytes", 0)),
         "unspills": int(rec.get("unspills", 0)),
         "budgetPeak": int(rec.get("budgetPeak", 0)),
+        # schema v11 (streaming): micro-batch/MV/sink work under this wall
+        "microBatches": int(rec.get("microBatches", 0)),
+        "mvRefreshes": int(rec.get("mvRefreshes", 0)),
+        "mvIncrementalRefreshes": int(rec.get("mvIncrementalRefreshes", 0)),
+        "mvFullRecomputes": int(rec.get("mvFullRecomputes", 0)),
+        "sinkCommits": int(rec.get("sinkCommits", 0)),
+        "sinkReplays": int(rec.get("sinkReplays", 0)),
+        "mvEpoch": rec.get("mvEpoch"),
         "attribution": {
             "attributedS": round(attributed, 6),
             "untrackedS": round(float(spans.get("untrackedS", 0.0)), 6),
@@ -332,6 +340,19 @@ def build_profile(records: Iterable[dict], top_n: int = 10,
             {q["query"] for q in queries
              if q["spillBytes"] or q["oomRetries"]}),
     }
+    # streaming (schema v11): micro-batches, MV maintenance strategy
+    # split, and the sink's exactly-once replay count
+    streaming_summary = {
+        "microBatches": sum(q["microBatches"] for q in queries),
+        "mvRefreshes": sum(q["mvRefreshes"] for q in queries),
+        "mvIncrementalRefreshes": sum(
+            q["mvIncrementalRefreshes"] for q in queries),
+        "mvFullRecomputes": sum(q["mvFullRecomputes"] for q in queries),
+        "sinkCommits": sum(q["sinkCommits"] for q in queries),
+        "sinkReplays": sum(q["sinkReplays"] for q in queries),
+        "mvServes": sorted(
+            {q["query"] for q in queries if q["mvEpoch"] is not None}),
+    }
     # survivability (schema v4): how healthy was the process this run,
     # and which queries rode through recovery events
     survivability = {
@@ -353,6 +374,7 @@ def build_profile(records: Iterable[dict], top_n: int = 10,
         "meshResilience": mesh_resilience,
         "hostResilience": host_resilience,
         "memory": memory_summary,
+        "streaming": streaming_summary,
         "survivability": survivability,
         "minCoverage": round(min((q["attribution"]["coverage"]
                                   for q in queries), default=1.0), 4),
@@ -449,6 +471,17 @@ def render_profile(report: dict) -> str:
             f"{mm['budgetPeak']} bytes"
             + (f" | spilled: {', '.join(mm['spilledQueries'])}"
                if mm.get("spilledQueries") else ""))
+    sm = report.get("streaming") or {}
+    if (sm.get("microBatches") or sm.get("mvRefreshes")
+            or sm.get("sinkCommits") or sm.get("sinkReplays")):
+        lines.append(
+            f"Streaming: micro-batches {sm['microBatches']} | sink "
+            f"commits {sm['sinkCommits']} (replays {sm['sinkReplays']}) "
+            f"| MV refreshes {sm['mvRefreshes']} "
+            f"(incremental {sm['mvIncrementalRefreshes']}, full "
+            f"{sm['mvFullRecomputes']})"
+            + (f" | MV serves: {', '.join(sm['mvServes'])}"
+               if sm.get("mvServes") else ""))
     sv = report["survivability"]
     if (sv["deviceReinits"] or sv["workerRestarts"]
             or sv["quarantinedQueries"]

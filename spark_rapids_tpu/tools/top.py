@@ -76,6 +76,18 @@ def render_top(doc: dict) -> str:
             lines.append(f"  pool   {pool:20s} {_fmt_slo(entry)}")
         for tenant, entry in (slo.get("tenants") or {}).items():
             lines.append(f"  tenant {tenant:20s} {_fmt_slo(entry)}")
+    streams = doc.get("streams") or []
+    if streams:
+        lines.append("")
+        lines.append(f"Streams: {len(streams)} recurring")
+        for st in streams:
+            src = (st.get("source") or {}).get("kind", "?")
+            lines.append(
+                f"  {st.get('name', '?'):20s} {st.get('state', '?'):9s} "
+                f"{st.get('pool')}/{st.get('tenant')}  src={src}  "
+                f"batches={st.get('batchesRun', 0)} "
+                f"(committed #{st.get('lastCommittedId', -1)}) "
+                f"rows={st.get('rowsSunk', 0)}")
     queries = doc.get("queries") or []
     lines.append("")
     lines.append(f"Live queries: {len(queries)}")

@@ -170,7 +170,7 @@ def test_two_process_scan_bit_identity(cluster2, corpus, tmp_path):
     assert after.get("hostShardsLanded", 0) - before.get(
         "hostShardsLanded", 0) == 8
     rec = s.last_event_record
-    assert rec["schema"] == 10
+    assert rec["schema"] == 11
     assert rec["hostTopology"] == "2"
     assert rec["hostsLost"] == 0 and rec["hostRelands"] == 0
 
@@ -385,7 +385,8 @@ def test_hosts_flag_validation():
     import scale_test as st
 
     def args(**kw):
-        base = dict(mesh=0, hosts=0, concurrency=0, service_faults=False,
+        base = dict(mesh=0, hosts=0, streaming=False, concurrency=0,
+                    service_faults=False,
                     cpu_baseline=False, require_tpu=False, chaos=False,
                     device_budget=0)
         base.update(kw)

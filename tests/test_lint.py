@@ -893,6 +893,42 @@ def test_rl_mem_account():
                    "spark_rapids_tpu/execs/mesh.py", allow) == []
 
 
+def test_rl_mv_epoch():
+    """RL-MV-EPOCH: streaming/ (micro-batch + MV maintenance) may only
+    reach the result cache through the invalidation-epoch API — a
+    direct mutation there is a second write path into cache coherence
+    (ISSUE 16)."""
+    from spark_rapids_tpu.lint.repo_lint import _check_mv_epoch
+    src = (
+        "from spark_rapids_tpu.service.result_cache import ResultCache\n"
+        "def bad(service, key, table):\n"
+        "    service.result_cache.put(key, table)\n"       # mutator call
+        "    service.result_cache._entries.clear()\n"      # raw entries
+    )
+    hits = _find(_run_rl(_check_mv_epoch,
+                         "spark_rapids_tpu/streaming/mv.py", src),
+                 "RL-MV-EPOCH")
+    # ResultCache import + put() + _entries access (+ the clear() on
+    # the _entries chain) each flag
+    assert len(hits) >= 3, [str(d) for d in hits]
+    assert any("epoch" in h.message for h in hits)
+    # the epoch API itself is the sanctioned crossing
+    ok = (
+        "from spark_rapids_tpu.service.result_cache import (\n"
+        "    bump_table_epoch,\n"
+        "    register_epoch_listener,\n"
+        ")\n"
+        "def good(path):\n"
+        "    bump_table_epoch('delta:' + path, 'refresh')\n"
+    )
+    assert _run_rl(_check_mv_epoch,
+                   "spark_rapids_tpu/streaming/mv.py", ok) == []
+    # outside streaming/ the rule does not apply (the scheduler OWNS
+    # the cache and mutates it legitimately)
+    assert _run_rl(_check_mv_epoch,
+                   "spark_rapids_tpu/service/scheduler.py", src) == []
+
+
 def test_every_rule_has_a_negative_test():
     """Meta-pin: the rule surface and this module's negative coverage
     cannot drift apart (>= 12 rules required by the issue)."""

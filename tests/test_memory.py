@@ -323,7 +323,7 @@ def test_memory_ladder_end_to_end_cpu_demotion(tmp_path):
                for b in mem_bundles)
     # event record carries the demotion map (explain() convention)
     rec = s.last_event_record
-    assert rec["schema"] == 10
+    assert rec["schema"] == 11
     assert any(op in rec["demotions"] for op in demoted)
     assert rec["oomRetries"] > 0
 
@@ -466,7 +466,8 @@ def test_device_budget_flag_validation():
     import scale_test as st
 
     def args(**kw):
-        base = dict(mesh=0, hosts=0, concurrency=0, service_faults=False,
+        base = dict(mesh=0, hosts=0, streaming=False, concurrency=0,
+                    service_faults=False,
                     cpu_baseline=False, require_tpu=False, chaos=False,
                     device_budget=0)
         base.update(kw)
@@ -496,7 +497,7 @@ def test_event_log_v10_memory_fields(tmp_path):
     }))
     _join_q(s, left, right)
     rec = s.last_event_record
-    assert rec["schema"] == 10
+    assert rec["schema"] == 11
     assert rec["spillBytes"] > 0
     assert rec["unspills"] > 0
     assert rec["budgetPeak"] > 0

@@ -272,6 +272,7 @@ def test_scale_test_flag_validation():
     class A:
         mesh = 8
         hosts = 0
+        streaming = False
         chaos = False
         concurrency = 0
         service_faults = False

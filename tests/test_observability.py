@@ -231,15 +231,16 @@ def test_event_log_written_and_valid(tmp_path):
     lines = open(s.last_event_path).read().strip().splitlines()
     assert len(lines) == 1
     rec = json.loads(lines[0])
-    # schema v10: the out-of-core PR added the memory-scope deltas
-    # (oomRetries / splitRetries / spillBytes / unspills — all 0 on an
-    # unbudgeted quiet process) and budgetPeak (the arbiter's peak
-    # accounted device bytes) on top of v9's hostScans, v8's multi-host
-    # fault-domain fields (hostTopology / hostsLost / hostRelands /
-    # dcnExchanges — null/0/0/0 off-cluster), v7's mesh fault-domain
-    # fields, v6's mesh-native fields, v5's transactional-write fields
-    # and v4's survivability fields — see obs/events.py
-    assert rec["schema"] == 10
+    # schema v11: the streaming PR added the streaming-scope deltas
+    # (microBatches / mvRefreshes / mvIncrementalRefreshes /
+    # mvFullRecomputes / sinkCommits / sinkReplays — all 0 on a
+    # stream-free process) and mvEpoch (null unless the record serves
+    # a materialized view) on top of v10's out-of-core fields, v9's
+    # hostScans, v8's multi-host fault-domain fields, v7's mesh
+    # fault-domain fields, v6's mesh-native fields, v5's
+    # transactional-write fields and v4's survivability fields — see
+    # obs/events.py
+    assert rec["schema"] == 11
     assert rec["healthState"] == "HEALTHY"
     assert rec["quarantined"] is False
     assert rec["deviceReinits"] == 0 and rec["workerRestarts"] == 0
@@ -256,6 +257,11 @@ def test_event_log_written_and_valid(tmp_path):
     assert rec["oomRetries"] == 0 and rec["splitRetries"] == 0
     assert rec["spillBytes"] == 0 and rec["unspills"] == 0
     assert isinstance(rec["budgetPeak"], int) and rec["budgetPeak"] >= 0
+    assert rec["microBatches"] == 0 and rec["mvRefreshes"] == 0
+    assert rec["mvIncrementalRefreshes"] == 0
+    assert rec["mvFullRecomputes"] == 0
+    assert rec["sinkCommits"] == 0 and rec["sinkReplays"] == 0
+    assert rec["mvEpoch"] is None
     assert rec["event"] == "queryCompleted"
     assert rec["queryTag"] == "golden"
     assert rec["wallS"] > 0
@@ -327,7 +333,15 @@ def test_event_log_golden_schema(tmp_path):
     by spill demotions, spilled batches re-landed; all 0 on an
     unbudgeted quiet process and for result-cache serves; budgetPeak —
     the memory arbiter's peak accounted device bytes, absolute and
-    process-wide, normalized in the golden)."""
+    process-wide, normalized in the golden);
+    v11 = streaming fields (microBatches / mvRefreshes /
+    mvIncrementalRefreshes / mvFullRecomputes / sinkCommits /
+    sinkReplays — per-record deltas of the streaming scope: micro-batch
+    executions, materialized-view refreshes split by maintenance
+    strategy, and the exactly-once sink's commits and deduped replays;
+    all 0 on a stream-free process and zeroed on result-cache serves;
+    mvEpoch — the Delta version a served materialized view reflects,
+    null for everything that is not an MV serve)."""
     s = _run_eventlog_query(tmp_path)
     got = _normalize(s.last_event_record)
     golden_path = os.path.join(os.path.dirname(__file__),

@@ -367,11 +367,23 @@ def test_cache_invalidated_on_write(tmp_path):
 
 
 def test_delta_commit_bumps_invalidation_epoch(tmp_path):
+    """A Delta commit bumps ITS table's epoch — scoped, so an unrelated
+    table's cached results keep serving — while the global epoch (the
+    catalog-wide invalidation hammer) stays put."""
     from spark_rapids_tpu.delta.log import DeltaLog
+    from spark_rapids_tpu.plan.fingerprint import (
+        delta_table_id,
+        table_epoch,
+    )
     from spark_rapids_tpu.service.result_cache import invalidation_epoch
-    before = invalidation_epoch()
+    tid = delta_table_id(str(tmp_path))
+    other = delta_table_id(str(tmp_path) + "-other")
+    global_before = invalidation_epoch()
+    before, other_before = table_epoch(tid), table_epoch(other)
     DeltaLog(str(tmp_path)).commit([], 0, op_name="WRITE")
-    assert invalidation_epoch() == before + 1
+    assert table_epoch(tid) == before + 1
+    assert table_epoch(other) == other_before
+    assert invalidation_epoch() == global_before
 
 
 def test_uncacheable_plans_never_cache():
