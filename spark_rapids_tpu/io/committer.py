@@ -48,6 +48,7 @@ from typing import Dict, List, Optional, Tuple
 from spark_rapids_tpu.conf import float_conf, int_conf
 from spark_rapids_tpu.obs.metrics import metric_scope, register_metric
 from spark_rapids_tpu.runtime.faults import fault_point
+from spark_rapids_tpu.lockorder import ordered_lock
 
 #: staging root inside the destination directory; '_'-prefixed so the
 #: scan listing (io/common.expand_paths) prunes it
@@ -111,7 +112,7 @@ del _name, _kind, _doc
 #: exit-20 path and the atexit hook sweep these staging trees so a
 #: dying process cannot leak _temporary/ files into later scans
 _ACTIVE_JOBS: Dict[Tuple[str, str], "WriteJob"] = {}
-_ACTIVE_LOCK = threading.Lock()
+_ACTIVE_LOCK = ordered_lock("io.committer.jobs")
 
 #: files other in-flight writers own, owner -> (base_path, full paths)
 #: — Delta OptimisticTransactions write data files into the table dir

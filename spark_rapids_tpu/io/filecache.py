@@ -17,6 +17,7 @@ from typing import Callable, Optional
 
 from spark_rapids_tpu.columnar import HostTable
 from spark_rapids_tpu.conf import bool_conf, int_conf
+from spark_rapids_tpu.lockorder import ordered_lock
 
 FILECACHE_ENABLED = bool_conf(
     "spark.rapids.filecache.enabled", False,
@@ -30,7 +31,7 @@ FILECACHE_MAX_BYTES = int_conf(
 
 class _FileCache:
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = ordered_lock("io.filecache")
         self._entries: "OrderedDict[tuple, HostTable]" = OrderedDict()
         self._bytes = 0
         self.hits = 0

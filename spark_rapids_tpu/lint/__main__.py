@@ -2,11 +2,23 @@
 
 Runs the repo lint, the registry auditor and the golden-suite plan
 verification (TPC-H q1-q22, DSL + SQL, AQE on/off) and exits non-zero on
-any diagnostic — the correctness gate every PR runs under."""
+any diagnostic — the correctness gate every PR runs under.
+
+Exit status: 0 when every phase ran clean, 1 when ANY diagnostic was
+produced (CI gates on it).  ``--json`` swaps the human output for one
+machine-readable JSON object on stdout::
+
+    {"phases": {"repo": 0, ...},
+     "diagnostics": [{"rule_id": ..., "path": ..., "message": ...,
+                      "severity": ...}, ...],
+     "ok": true/false}
+
+with the same exit-status contract."""
 
 from __future__ import annotations
 
 import argparse
+import json
 import sys
 
 
@@ -28,9 +40,17 @@ def main(argv=None) -> int:
                     help="scale factor for golden-suite table generation")
     ap.add_argument("--list-rules", action="store_true",
                     help="print every rule id and exit")
+    ap.add_argument("--json", action="store_true",
+                    help="emit one JSON object (phases, diagnostics, "
+                         "ok) instead of human-readable lines; exit "
+                         "status stays 1 on any diagnostic")
+    ap.add_argument("--repo-root", default=None, metavar="DIR",
+                    help="root directory the repo lint scans (default: "
+                         "the installed checkout; the smoke tests "
+                         "point it at tiny synthetic trees)")
     ap.add_argument("--write-docs", action="store_true",
-                    help="regenerate SUPPORTED_OPS.md and CONFIGS.md "
-                         "from the registries, then exit")
+                    help="regenerate SUPPORTED_OPS.md, CONFIGS.md and "
+                         "LOCKS.md from the registries, then exit")
     args = ap.parse_args(argv)
 
     from spark_rapids_tpu.lint.diagnostics import RULES
@@ -44,32 +64,42 @@ def main(argv=None) -> int:
             print(f"wrote {path}")
         return 0
 
+    quiet = args.json
     diags = []
     ran = []
+    phases = {}
+
+    def phase(name: str, label: str, found):
+        if not quiet:
+            print(f"{label}: {len(found)} diagnostic(s)")
+        diags.extend(found)
+        ran.append(label)
+        phases[name] = len(found)
+
     if not args.skip_repo:
         from spark_rapids_tpu.lint.repo_lint import lint_repo
-        repo = lint_repo()
-        print(f"repo lint: {len(repo)} diagnostic(s)")
-        diags += repo
-        ran.append("repo")
+        phase("repo", "repo lint", lint_repo(repo_root=args.repo_root))
     if not args.skip_registry:
         from spark_rapids_tpu.lint.registry_audit import audit_registry
-        reg = audit_registry()
-        print(f"registry audit: {len(reg)} diagnostic(s)")
-        diags += reg
-        ran.append("registries")
+        phase("registry", "registry audit", audit_registry())
     if not args.skip_plans:
         from spark_rapids_tpu.lint.golden import verify_golden_plans
-        plans = verify_golden_plans(scale_factor=args.sf)
-        print(f"golden-suite plan verify: {len(plans)} diagnostic(s)")
-        diags += plans
-        ran.append("golden-suite plans")
+        phase("plans", "golden-suite plan verify",
+              verify_golden_plans(scale_factor=args.sf))
     if not args.skip_exec_metrics:
         from spark_rapids_tpu.lint.registry_audit import audit_exec_metrics
-        em = audit_exec_metrics()
-        print(f"exec-metrics audit: {len(em)} diagnostic(s)")
-        diags += em
-        ran.append("exec metrics")
+        phase("exec_metrics", "exec-metrics audit", audit_exec_metrics())
+
+    if args.json:
+        print(json.dumps({
+            "phases": phases,
+            "diagnostics": [
+                {"rule_id": d.rule_id, "path": d.path,
+                 "message": d.message, "severity": d.severity}
+                for d in diags],
+            "ok": not diags,
+        }, indent=2, sort_keys=True))
+        return 1 if diags else 0
 
     for d in diags:
         print(str(d))

@@ -63,6 +63,8 @@ RULES: Dict[str, str] = {
                             "generator output",
     "RA-CONF-ORPHAN": "conf key declared in the registry but never "
                       "read by the engine or its harnesses",
+    "RA-DOC-DRIFT-LOCKS": "committed LOCKS.md differs from the "
+                          "lockorder registry generator output",
     "RA-ESSENTIAL-METRICS": "an executed exec failed to emit the "
                             "ESSENTIAL opTime/numOutputRows/"
                             "numOutputBatches metrics after a "
@@ -118,6 +120,24 @@ RULES: Dict[str, str] = {
                    "invalidation-epoch API (bump_table_epoch/"
                    "epoch listeners) so cache coherence has exactly "
                    "one write path",
+    "RL-LOCK-DECL": "threading.Lock/RLock/Condition/Semaphore "
+                    "constructed in a concurrent package outside the "
+                    "lockorder.py ordered_* factories, a "
+                    "factory called with a non-literal/undeclared "
+                    "name or at a site other than the declared one, "
+                    "or a LOCK_ORDER entry with no construction site "
+                    "(the rank hierarchy must cover every lock)",
+    "RL-LOCK-ORDER": "a code path blocking-acquires a declared lock "
+                     "while holding one of equal or higher rank (or "
+                     "the acquisition graph closes a cycle) — "
+                     "acquisition must strictly ascend the LOCK_ORDER "
+                     "ranks; try-acquires (blocking=False) are exempt",
+    "RL-LOCK-EFFECT": "a blocking operation (host sync, socket "
+                      "send/recv, subprocess, fault_point raise site, "
+                      "record_incident, wait on a different "
+                      "Condition) runs while a declared lock is held "
+                      "— move the effect outside the critical "
+                      "section or allowlist it with a justification",
 }
 
 

@@ -191,10 +191,12 @@ def _audit_sql_exposure(diags: List[Diagnostic]) -> None:
 def _audit_doc_drift(diags: List[Diagnostic], root: str) -> None:
     from spark_rapids_tpu.conf import generate_docs
     from spark_rapids_tpu.overrides.docs import generate_supported_ops
+    from spark_rapids_tpu.lockorder import generate_locks_md
     for fname, gen, rule in (
             ("SUPPORTED_OPS.md", generate_supported_ops,
              "RA-DOC-DRIFT-OPS"),
-            ("CONFIGS.md", generate_docs, "RA-DOC-DRIFT-CONFIGS")):
+            ("CONFIGS.md", generate_docs, "RA-DOC-DRIFT-CONFIGS"),
+            ("LOCKS.md", generate_locks_md, "RA-DOC-DRIFT-LOCKS")):
         path = os.path.join(root, fname)
         if not os.path.exists(path):
             diags.append(make(rule, fname, "committed file is missing"))
@@ -217,14 +219,16 @@ def _audit_doc_drift(diags: List[Diagnostic], root: str) -> None:
 
 
 def regenerate_docs(repo_root: Optional[str] = None) -> List[str]:
-    """Write SUPPORTED_OPS.md and CONFIGS.md from their generators;
-    returns the files written (the CLI's --write-docs)."""
+    """Write SUPPORTED_OPS.md, CONFIGS.md and LOCKS.md from their
+    generators; returns the files written (the CLI's --write-docs)."""
     from spark_rapids_tpu.conf import generate_docs
     from spark_rapids_tpu.overrides.docs import generate_supported_ops
+    from spark_rapids_tpu.lockorder import generate_locks_md
     root = _repo_root(repo_root)
     written = []
     for fname, gen in (("SUPPORTED_OPS.md", generate_supported_ops),
-                       ("CONFIGS.md", generate_docs)):
+                       ("CONFIGS.md", generate_docs),
+                       ("LOCKS.md", generate_locks_md)):
         path = os.path.join(root, fname)
         with open(path, "w") as f:
             f.write(gen())

@@ -3,871 +3,59 @@
 The TPU-first rule this codebase lives by (dispatch.py header): NOTHING
 transfers host<->device on a warm query outside the sanctioned sites.
 The type checker cannot see a stray ``jax.device_get`` in a kernel or a
-conf key referenced by a typo'd string — this lint can.  Rules (RL-*):
+conf key referenced by a typo'd string — this lint can.
 
-* RL-HOST-SYNC — no host synchronization (``jax.device_get``,
-  ``.block_until_ready()``) inside execs/ or ops/ hot paths except via
-  the sanctioned ``dispatch.host_fetch`` helper.
-* RL-JNP-SCOPE — ``jax.numpy`` imports only in the device layers.
-* RL-CONF-KEY — every ``spark.*`` conf key referenced as a string
-  literal must be declared in the conf registry.
-* RL-NONDETERMINISM — no wall-clock or unseeded randomness in kernel
-  modules (results must replay bit-identically; LORE depends on it).
-* RL-DEAD-LAMBDA — a lambda bound to a name that is never referenced
-  again is dead code.
-* RL-FAULT-POINT — the chaos harness's fault-point registry
-  (runtime/faults.FAULT_POINTS) and the ``fault_point("<name>")`` call
-  sites must agree in both directions: every registered point names an
-  existing site in its registered module, every site uses a registered
-  name, and names are string literals (a computed name would dodge the
-  audit).
-* RL-THREAD-SHARED — the query service executes queries from a worker
-  pool, so runtime/, shuffle/ and service/ modules are concurrent by
-  contract: module-global mutable containers (and class-level singleton
-  slots) written inside a function must be written under a lock guard
-  (a ``with <something named *lock*/*cond*>:`` block) or appear in the
-  sanctioned allowlist with a justification.
-* RL-MESH-HOST — mesh-native execution keeps shards device-resident
-  BETWEEN exchanges (the PERF.md upload cost class this PR removes):
-  inside ``parallel/`` and the shard-dispatch placement layer, host
-  materialization (``np.asarray``, ``jax.device_get``, ``host_fetch``,
-  ``.block_until_ready()``, ``.addressable_shards`` reads) may appear
-  only at sanctioned gather points (``_MESH_HOST_ALLOWLIST``, each
-  entry justified).
-* RL-WRITE-COMMIT — the exactly-once write contract holds only if
-  every byte of table output stages through the transactional
-  committer (io/committer.py): in ``io/`` modules, file-creating calls
-  (write-mode ``open``, ``*.write_table``, ``*.write_csv``) may appear
-  only inside the ``_write_one`` staged-path callbacks, and
-  ``os.replace``/``os.rename`` promotion belongs to the committer
-  alone. ``committer.py`` itself and ``filecache.py`` (cache files are
-  not table output) are exempt.
-* RL-KERNEL-HOST — the Pallas kernel layer (``kernels/``) is pure
-  device code that executes INSIDE other traces: any numpy
-  materialization (``import numpy`` at all) or host synchronization
-  (``jax.device_get``, ``host_fetch``, ``.block_until_ready()``)
-  there would stall the trace or smuggle device data to the host
-  mid-kernel. Sanctioned exceptions go in ``_KERNEL_HOST_ALLOWLIST``
-  with a justification (same hook shape as RL-MESH-HOST).
-* RL-OBS-PASSIVE — the telemetry sampler (``obs/telemetry.py``) runs
-  on a background thread BETWEEN queries by design: it may not touch
-  the device (no jax/jnp at all, no host syncs, no
-  ``finalize_observation`` — that forces the deferred row-count
-  fetch), may not drive query execution (``execute``/``collect*``),
-  and may not take the query-path locks (the device semaphore, the
-  scheduler condition, the session obs lock) — sampling must never
-  perturb the execution it observes. Sanctioned exceptions go in
-  ``_OBS_PASSIVE_ALLOWLIST`` with a justification.
-* RL-MEM-ACCOUNT — the device memory budget (runtime/memory.py
-  MemoryArbiter) only holds if every device landing is ACCOUNTED:
-  inside ``execs/`` and ``ops/``, raw ``jax.device_put`` calls are
-  forbidden — landings route through ``DeviceTable.from_host`` (which
-  reserves against the budget and accounts the landed bytes) or
-  appear in ``_MEM_ACCOUNT_ALLOWLIST`` with a justification (tiny
-  non-table transfers like digest scalars).
+The rules themselves live in per-rule modules under ``lint/rules/``
+(see each module's docstring for its contract) plus the concurrency
+pass in ``lint/concurrency.py``; this module is the driver —
+``lint_repo()`` parses every source file once and runs the shared rule
+registry (``lint.rules.REGISTRY``) over the trees — and the stable
+import surface: every ``_check_*`` checker and allowlist keeps its
+historical name HERE (same objects, re-exported), so callers and tests
+are unaffected by the package split.
+
+Rules (RL-*): RL-HOST-SYNC, RL-JNP-SCOPE, RL-CONF-KEY,
+RL-NONDETERMINISM, RL-DEAD-LAMBDA, RL-FAULT-POINT, RL-THREAD-SHARED,
+RL-MESH-HOST, RL-WRITE-COMMIT, RL-KERNEL-HOST, RL-OBS-PASSIVE,
+RL-MEM-ACCOUNT, RL-MV-EPOCH, and the concurrency contract
+(RL-LOCK-DECL, RL-LOCK-ORDER, RL-LOCK-EFFECT — see
+``lint/concurrency.py``).
 """
 
 from __future__ import annotations
 
 import ast
-import os
-import re
 from typing import List, Optional
 
-from spark_rapids_tpu.lint.diagnostics import Diagnostic, make
-
-#: directories (under spark_rapids_tpu/) whose modules are device layers
-#: and may import jax.numpy
-_DEVICE_DIRS = ("execs", "ops", "columnar", "parallel", "runtime",
-                "shuffle", "shims", "models", "kernels")
-#: top-level device-layer files
-_DEVICE_FILES = ("dispatch.py", "udf.py")
-
-#: np.random attributes that construct SEEDED generators (allowed in
-#: kernels); everything else on np.random is process-global state
-_SEEDED_RANDOM_OK = {"default_rng", "Generator", "SeedSequence",
-                     "BitGenerator", "PCG64", "Philox"}
-
-_CONF_KEY_RE = re.compile(r"^spark\.(rapids|sql)\.[A-Za-z0-9_]"
-                          r"[A-Za-z0-9_.]*[A-Za-z0-9_]$")
-
-
-def _repo_root(repo_root: Optional[str]) -> str:
-    if repo_root:
-        return repo_root
-    import spark_rapids_tpu
-    return os.path.dirname(os.path.dirname(
-        os.path.abspath(spark_rapids_tpu.__file__)))
-
-
-def _iter_source_files(root: str):
-    pkg = os.path.join(root, "spark_rapids_tpu")
-    for dirpath, dirnames, filenames in os.walk(pkg):
-        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
-        for f in sorted(filenames):
-            if f.endswith(".py"):
-                yield os.path.join(dirpath, f)
-    for f in ("bench.py", "scale_test.py"):
-        p = os.path.join(root, f)
-        if os.path.exists(p):
-            yield p
-
-
-def _rel(root: str, path: str) -> str:
-    return os.path.relpath(path, root)
-
-
-def _attr_chain(node: ast.AST) -> str:
-    """Dotted name of an attribute/name chain ('' when not a plain chain)."""
-    parts = []
-    while isinstance(node, ast.Attribute):
-        parts.append(node.attr)
-        node = node.value
-    if isinstance(node, ast.Name):
-        parts.append(node.id)
-        return ".".join(reversed(parts))
-    return ""
-
-
-# ---------------------------------------------------------------------------
-# per-rule visitors
-# ---------------------------------------------------------------------------
-
-
-def _is_device_expr(node: ast.AST) -> bool:
-    """Is this expression PROVABLY a device value — a jnp./jax. call not
-    already funneled through the sanctioned host_fetch wrapper (whose
-    RESULT is host data, however device-y its argument)?"""
-    if isinstance(node, ast.Call):
-        chain = _attr_chain(node.func)
-        if chain == "host_fetch" or chain.endswith(".host_fetch"):
-            return False
-        if chain.startswith(("jnp.", "jax.")):
-            return True
-    for child in ast.iter_child_nodes(node):
-        if _is_device_expr(child):
-            return True
-    return False
-
-
-def _check_host_sync(rel: str, tree: ast.AST, diags: List[Diagnostic]):
-    in_hot_path = rel.startswith(("spark_rapids_tpu/execs/",
-                                  "spark_rapids_tpu/ops/"))
-    if not in_hot_path:
-        return
-    for node in ast.walk(tree):
-        if isinstance(node, ast.ImportFrom) and node.module == "jax":
-            # `from jax import device_get` would make the call below
-            # invisible to the chain matcher — ban the import form too
-            for a in node.names:
-                if a.name in ("device_get", "block_until_ready"):
-                    diags.append(make(
-                        "RL-HOST-SYNC", f"{rel}:{node.lineno}",
-                        f"importing jax.{a.name} into a hot path; route "
-                        "through dispatch.host_fetch so syncs are "
-                        "counted and reviewable"))
-            continue
-        if not isinstance(node, ast.Call):
-            continue
-        chain = _attr_chain(node.func)
-        if chain.endswith(".block_until_ready"):
-            diags.append(make(
-                "RL-HOST-SYNC", f"{rel}:{node.lineno}",
-                "block_until_ready() stalls the dispatch pipeline; use "
-                "dispatch.host_fetch at a sanctioned sync point"))
-        elif chain == "jax.device_get" or chain.endswith(".device_get") \
-                or chain == "device_get":
-            diags.append(make(
-                "RL-HOST-SYNC", f"{rel}:{node.lineno}",
-                "raw jax.device_get in a hot path (~0.1s tunnel stall "
-                "each); route through dispatch.host_fetch so syncs are "
-                "counted and reviewable"))
-        elif chain in ("np.asarray", "numpy.asarray", "float", "int") \
-                and node.args and _is_device_expr(node.args[0]):
-            # the statically-decidable slice of "np.asarray/float/int on
-            # device values": the argument is itself a jnp./jax. call,
-            # so the conversion provably forces a device sync (general
-            # deviceness needs dataflow a lint can't do)
-            diags.append(make(
-                "RL-HOST-SYNC", f"{rel}:{node.lineno}",
-                f"{chain}() over a jax expression synchronizes the "
-                "device; route through dispatch.host_fetch"))
-
-
-def _check_jnp_scope(rel: str, tree: ast.AST, diags: List[Diagnostic]):
-    parts = rel.split("/")
-    allowed = False
-    if parts[0] != "spark_rapids_tpu":
-        allowed = False  # bench.py / scale_test.py are host drivers
-    elif len(parts) == 2:
-        allowed = parts[1] in _DEVICE_FILES
-    else:
-        allowed = parts[1] in _DEVICE_DIRS
-    if allowed:
-        return
-    for node in ast.walk(tree):
-        hit = None
-        if isinstance(node, ast.Import):
-            for a in node.names:
-                if a.name == "jax.numpy":
-                    hit = f"{a.name} imported"
-        elif isinstance(node, ast.ImportFrom):
-            if node.module == "jax.numpy" or (
-                    node.module == "jax"
-                    and any(a.name == "numpy" for a in node.names)):
-                hit = "jax.numpy imported"
-        elif isinstance(node, ast.Attribute):
-            # `import jax; jax.numpy.foo(...)` bypasses the import
-            # check — catch the attribute access form too (exact match:
-            # the inner `jax.numpy` node; avoids double-reporting the
-            # enclosing `jax.numpy.foo` chain)
-            if _attr_chain(node) == "jax.numpy":
-                hit = "jax.numpy used"
-        if hit:
-            diags.append(make(
-                "RL-JNP-SCOPE", f"{rel}:{node.lineno}",
-                f"{hit} outside the device layers "
-                f"({', '.join(_DEVICE_DIRS)}); host-side layers must "
-                "stay device-agnostic"))
-
-
-def _check_conf_keys(rel: str, tree: ast.AST, declared,
-                     diags: List[Diagnostic]):
-    for node in ast.walk(tree):
-        if not (isinstance(node, ast.Constant)
-                and isinstance(node.value, str)):
-            continue
-        v = node.value
-        if not _CONF_KEY_RE.match(v):
-            continue
-        if v in declared:
-            continue
-        diags.append(make(
-            "RL-CONF-KEY", f"{rel}:{node.lineno}",
-            f"conf key {v!r} is not declared in the conf registry — "
-            "typo, or a key removed without cleaning its references"))
-
-
-def _check_nondeterminism(rel: str, tree: ast.AST,
-                          diags: List[Diagnostic]):
-    in_kernel = rel.startswith(("spark_rapids_tpu/execs/",
-                                "spark_rapids_tpu/ops/"))
-    if not in_kernel:
-        return
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        chain = _attr_chain(node.func)
-        bad = None
-        if chain in ("time.time", "datetime.now", "datetime.datetime.now",
-                     "date.today", "datetime.date.today",
-                     "datetime.utcnow", "datetime.datetime.utcnow"):
-            bad = f"{chain}() (wall clock)"
-        else:
-            parts = chain.split(".")
-            if len(parts) >= 2 and parts[-2] == "random" and \
-                    parts[0] in ("np", "numpy") and \
-                    parts[-1] not in _SEEDED_RANDOM_OK:
-                bad = f"{chain}() (process-global RNG state)"
-            elif chain.startswith("random.") and len(parts) == 2:
-                bad = f"{chain}() (unseeded stdlib RNG)"
-        if bad:
-            diags.append(make(
-                "RL-NONDETERMINISM", f"{rel}:{node.lineno}",
-                f"{bad} in a kernel module — results must replay "
-                "bit-identically (seeded default_rng only)"))
-
-
-def _is_fault_point_call(node: ast.AST) -> bool:
-    if not isinstance(node, ast.Call):
-        return False
-    chain = _attr_chain(node.func)
-    return chain == "fault_point" or chain.endswith(".fault_point")
-
-
-def _check_fault_sites(rel: str, tree: ast.AST, calls,
-                       diags: List[Diagnostic]):
-    """Per-file half of RL-FAULT-POINT: record every fault_point call
-    into ``calls`` (name -> [file:line]) and flag non-literal or
-    unregistered names at the site."""
-    from spark_rapids_tpu.runtime.faults import FAULT_POINTS
-    for node in ast.walk(tree):
-        if not _is_fault_point_call(node):
-            continue
-        arg = node.args[0] if node.args else None
-        if not (isinstance(arg, ast.Constant) and isinstance(arg.value, str)):
-            diags.append(make(
-                "RL-FAULT-POINT", f"{rel}:{node.lineno}",
-                "fault_point() name must be a string literal so the "
-                "registry audit can see it"))
-            continue
-        name = arg.value
-        if name not in FAULT_POINTS:
-            diags.append(make(
-                "RL-FAULT-POINT", f"{rel}:{node.lineno}",
-                f"fault_point({name!r}) is not registered in "
-                "runtime/faults.FAULT_POINTS"))
-            continue
-        calls.setdefault(name, []).append(f"{rel}:{node.lineno}")
-
-
-def _check_fault_registry(calls, diags: List[Diagnostic]):
-    """Cross-file half of RL-FAULT-POINT: every registered point must
-    name at least one existing call site, and a site must live in the
-    module the registry claims hosts it (stale registry entries would
-    otherwise advertise injectable faults that never fire)."""
-    from spark_rapids_tpu.runtime.faults import FAULT_POINTS
-    for name, (module, _doc) in sorted(FAULT_POINTS.items()):
-        sites = calls.get(name, [])
-        if not sites:
-            diags.append(make(
-                "RL-FAULT-POINT", f"faults.FAULT_POINTS[{name!r}]",
-                f"registered fault point has no fault_point({name!r}) "
-                "call site anywhere in the repo"))
-        elif not any(s.rsplit(":", 1)[0] == module for s in sites):
-            diags.append(make(
-                "RL-FAULT-POINT", f"faults.FAULT_POINTS[{name!r}]",
-                f"no call site in the registered module {module} "
-                f"(found: {', '.join(sites)})"))
-
-
-#: directories whose modules must be thread-safe (the query service's
-#: worker pool runs through all three concurrently)
-_THREAD_SHARED_DIRS = ("spark_rapids_tpu/runtime/",
-                       "spark_rapids_tpu/shuffle/",
-                       "spark_rapids_tpu/service/",
-                       "spark_rapids_tpu/streaming/")
-
-#: sanctioned unlocked writes: "file:name" -> why the pattern is safe.
-#: Additions need a justification a reviewer can check.
-_THREAD_SHARED_ALLOWLIST = {
-    # speculation's per-attempt context is a contextvar; only the
-    # blocklist is shared — and it is lock-guarded after this PR.
-}
-
-#: container-mutating method names on dict/list/set/deque
-_MUTATING_METHODS = {"append", "extend", "add", "update", "pop",
-                     "popitem", "remove", "discard", "clear",
-                     "setdefault", "insert", "appendleft", "popleft",
-                     "move_to_end"}
-
-_MUTABLE_CTORS = {"dict", "list", "set", "OrderedDict", "defaultdict",
-                  "deque", "Counter", "WeakKeyDictionary",
-                  "WeakValueDictionary"}
-
-
-def _is_mutable_container(node: ast.AST) -> bool:
-    if isinstance(node, (ast.Dict, ast.List, ast.Set,
-                         ast.ListComp, ast.DictComp, ast.SetComp)):
-        return True
-    if isinstance(node, ast.Call):
-        chain = _attr_chain(node.func)
-        return chain.split(".")[-1] in _MUTABLE_CTORS
-    return False
-
-
-def _is_lock_guard(with_node: ast.With) -> bool:
-    for item in with_node.items:
-        chain = _attr_chain(item.context_expr).lower()
-        if isinstance(item.context_expr, ast.Call):
-            chain = _attr_chain(item.context_expr.func).lower()
-        if "lock" in chain or "cond" in chain:
-            return True
-    return False
-
-
-def _check_thread_shared(rel: str, tree: ast.AST,
-                         diags: List[Diagnostic]):
-    if not rel.startswith(_THREAD_SHARED_DIRS):
-        return
-    shared_globals: dict = {}
-    class_names = set()
-    for node in tree.body:
-        if isinstance(node, ast.ClassDef):
-            class_names.add(node.name)
-        target = value = None
-        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
-                and isinstance(node.targets[0], ast.Name):
-            target, value = node.targets[0].id, node.value
-        elif isinstance(node, ast.AnnAssign) \
-                and isinstance(node.target, ast.Name) \
-                and node.value is not None:
-            target, value = node.target.id, node.value
-        if target is not None and _is_mutable_container(value):
-            shared_globals[target] = node.lineno
-
-    def _flag(node, what, name):
-        """``name`` is the allowlist key: the container's global name,
-        or the attribute name for class-level singleton slots."""
-        if f"{rel}:{name}" in _THREAD_SHARED_ALLOWLIST:
-            return
-        diags.append(make(
-            "RL-THREAD-SHARED", f"{rel}:{node.lineno}",
-            f"{what} written outside a lock guard in a module shared "
-            "by concurrent query workers; hold a lock (with "
-            "<..lock..>:), use threading.local, or allowlist "
-            f"{rel}:{name} with a justification"))
-
-    def _root_name(node: ast.AST):
-        while isinstance(node, ast.Subscript):
-            node = node.value
-        return node.id if isinstance(node, ast.Name) else None
-
-    def _is_class_attr_target(node: ast.AST):
-        return (isinstance(node, ast.Attribute)
-                and isinstance(node.value, ast.Name)
-                and (node.value.id == "cls"
-                     or node.value.id in class_names))
-
-    def walk(node, in_func: bool, guarded: bool, fn_globals):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            in_func = True
-            fn_globals = {n for g in ast.walk(node)
-                          if isinstance(g, ast.Global) for n in g.names}
-        elif isinstance(node, ast.With) and _is_lock_guard(node):
-            guarded = True
-        if in_func and not guarded:
-            if isinstance(node, (ast.Assign, ast.AugAssign)):
-                targets = (node.targets
-                           if isinstance(node, ast.Assign)
-                           else [node.target])
-                for t in targets:
-                    if isinstance(t, ast.Subscript):
-                        root = _root_name(t)
-                        if root in shared_globals:
-                            _flag(node, f"{root}[...]", root)
-                    elif isinstance(t, ast.Name) and t.id in fn_globals \
-                            and t.id in shared_globals:
-                        _flag(node, t.id, t.id)
-                    elif _is_class_attr_target(t):
-                        _flag(node, f"{_attr_chain(t)} (class attribute)",
-                              t.attr)
-            elif isinstance(node, ast.Call) \
-                    and isinstance(node.func, ast.Attribute) \
-                    and node.func.attr in _MUTATING_METHODS:
-                root = _root_name(node.func.value)
-                if root in shared_globals:
-                    _flag(node, f"{root}.{node.func.attr}(...)", root)
-        for child in ast.iter_child_nodes(node):
-            walk(child, in_func, guarded, fn_globals)
-
-    walk(tree, False, False, set())
-
-
-#: io/ modules exempt from RL-WRITE-COMMIT: the committer IS the
-#: sanctioned writer, and the file cache's files are not table output
-_WRITE_COMMIT_EXEMPT = ("spark_rapids_tpu/io/committer.py",
-                        "spark_rapids_tpu/io/filecache.py")
-
-#: the sanctioned callback name: write_partitioned hands these a
-#: committer staging path, never a final destination
-_WRITE_ONE = "_write_one"
-
-
-def _open_mode_writes(node: ast.Call) -> bool:
-    """Is this an ``open()`` call with a write/append/exclusive mode?
-    A non-literal mode is treated as writing (it would dodge the
-    audit)."""
-    mode = None
-    if len(node.args) >= 2:
-        mode = node.args[1]
-    for kw in node.keywords:
-        if kw.arg == "mode":
-            mode = kw.value
-    if mode is None:
-        return False  # default 'r'
-    if isinstance(mode, ast.Constant) and isinstance(mode.value, str):
-        return any(c in mode.value for c in "wxa")
-    return True
-
-
-def _check_write_commit(rel: str, tree: ast.AST,
-                        diags: List[Diagnostic]):
-    if not rel.startswith("spark_rapids_tpu/io/") \
-            or rel in _WRITE_COMMIT_EXEMPT:
-        return
-
-    def walk(node, in_write_one: bool):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            in_write_one = in_write_one or node.name == _WRITE_ONE
-        if isinstance(node, ast.Call):
-            chain = _attr_chain(node.func)
-            if chain in ("os.replace", "os.rename") \
-                    or chain.endswith((".replace", ".rename")) \
-                    and chain.startswith("os."):
-                diags.append(make(
-                    "RL-WRITE-COMMIT", f"{rel}:{node.lineno}",
-                    f"{chain}() in an io/ writer module — promotion "
-                    "into final destinations is the committer's job "
-                    "(io/committer.py WriteJob.commit_task)"))
-            elif not in_write_one and (
-                    chain.endswith((".write_table", ".write_csv"))
-                    or (chain == "open" and _open_mode_writes(node))):
-                diags.append(make(
-                    "RL-WRITE-COMMIT", f"{rel}:{node.lineno}",
-                    f"{chain}() creates an output file outside a "
-                    f"{_WRITE_ONE} staged-path callback — table "
-                    "output must stage through the transactional "
-                    "committer, never open a final destination"))
-        for child in ast.iter_child_nodes(node):
-            walk(child, in_write_one)
-
-    walk(tree, False)
-
-
-def _host_sync_call(chain: str) -> bool:
-    """THE host-synchronization call set shared by the device-residency
-    rules (RL-MESH-HOST and RL-KERNEL-HOST walk different scopes but
-    must agree on what a host sync IS — a spelling added to one and not
-    the other would silently diverge)."""
-    return ((chain.endswith("device_get") and chain.startswith(
-                ("jax.", "jax")))
-            or chain == "host_fetch" or chain.endswith(".host_fetch")
-            or chain.endswith(".block_until_ready"))
-
-
-#: sanctioned mesh->host materialization points: "<rel>:<function>" ->
-#: justification. The hook for new gather points — add an entry HERE
-#: with a reason, never a bare suppression.
-_MESH_HOST_ALLOWLIST = {
-    "spark_rapids_tpu/parallel/mesh.py:mesh_gather":
-        "THE sanctioned mesh->host gather point (routes through "
-        "dispatch.host_fetch and counts meshGatherRows; the ICI "
-        "exchange's per-shard live-count fetch comes through here)",
-    "spark_rapids_tpu/parallel/mesh.py:MeshRuntime.configure":
-        "np.array over a list of jax DEVICE HANDLES (building the Mesh "
-        "topology array) — no device data is materialized",
-    "spark_rapids_tpu/parallel/mesh.py:MeshRuntime.exchange_mesh":
-        "np.array over jax device handles (submesh construction) — no "
-        "device data is materialized",
-}
-
-
-def _check_mesh_host(rel: str, tree: ast.AST, diags: List[Diagnostic]):
-    """RL-MESH-HOST: inside parallel/ and the shard-dispatch placement
-    layer, host materialization of device data (np.asarray on arrays,
-    jax.device_get, dispatch.host_fetch, .block_until_ready(),
-    .addressable_shards reads) is forbidden outside the sanctioned
-    gather points — the static guard for 'zero host round-trips
-    between exchanges': shards land once at the scan and stay
-    device-resident until a sanctioned gather."""
-    if not (rel.startswith("spark_rapids_tpu/parallel/")
-            or rel == "spark_rapids_tpu/runtime/placement.py"):
-        return
-
-    def flag(node, what: str, func: Optional[str]):
-        if f"{rel}:{func}" in _MESH_HOST_ALLOWLIST:
-            return
-        diags.append(make(
-            "RL-MESH-HOST", f"{rel}:{node.lineno}",
-            f"{what} in mesh/shard-dispatch code"
-            + (f" (function {func!r})" if func else " (module level)")
-            + " — device shards must stay resident between exchanges; "
-            "gather through parallel.mesh.mesh_gather or allowlist the "
-            "function in _MESH_HOST_ALLOWLIST with a justification"))
-
-    def walk(node, func: Optional[str]):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
-                             ast.ClassDef)):
-            # QUALIFIED name (Class.method / outer.inner): a bare-name
-            # key would exempt EVERY function sharing the allowlisted
-            # name anywhere in the file
-            func = f"{func}.{node.name}" if func else node.name
-        if isinstance(node, ast.Call):
-            chain = _attr_chain(node.func)
-            if chain in ("np.asarray", "numpy.asarray", "asarray",
-                         "np.array", "numpy.array"):
-                # bare 'asarray' covers `from numpy import asarray`;
-                # np.array() forces the same device->host copy
-                flag(node, f"{chain}()", func)
-            elif _host_sync_call(chain):
-                flag(node, f"{chain}()", func)
-        elif isinstance(node, ast.Attribute) \
-                and node.attr == "addressable_shards":
-            flag(node, ".addressable_shards read", func)
-        for child in ast.iter_child_nodes(node):
-            walk(child, func)
-
-    walk(tree, None)
-
-
-#: sanctioned host-side operations inside kernels/:
-#: "<rel>:<qualified function>" -> justification. The hook for new
-#: exceptions — add an entry HERE with a reason, never a bare
-#: suppression.
-_KERNEL_HOST_ALLOWLIST = {}
-
-
-def _check_kernel_host(rel: str, tree: ast.AST, diags: List[Diagnostic]):
-    """RL-KERNEL-HOST: kernels/ modules run inside other traces — no
-    numpy at all (materialization happens the moment an np.* call sees
-    a device array) and no host syncs. The static guard for 'a Pallas
-    primitive never stalls the program that embeds it'."""
-    if not rel.startswith("spark_rapids_tpu/kernels/"):
-        return
-
-    def flag(node, what: str, func: Optional[str]):
-        if f"{rel}:{func}" in _KERNEL_HOST_ALLOWLIST:
-            return
-        diags.append(make(
-            "RL-KERNEL-HOST", f"{rel}:{node.lineno}",
-            f"{what} in the Pallas kernel layer"
-            + (f" (function {func!r})" if func else " (module level)")
-            + " — kernels/ is pure device code traced into other "
-            "programs; keep host work at the dispatch sites or "
-            "allowlist the function in _KERNEL_HOST_ALLOWLIST with a "
-            "justification"))
-
-    def walk(node, func: Optional[str]):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
-                             ast.ClassDef)):
-            func = f"{func}.{node.name}" if func else node.name
-        if isinstance(node, (ast.Import, ast.ImportFrom)):
-            mod = getattr(node, "module", None)
-            names = [a.name for a in node.names]
-            if mod == "numpy" or "numpy" in names \
-                    or any(n.startswith("numpy.") for n in names) \
-                    or (mod or "").startswith("numpy."):
-                flag(node, "numpy import", func)
-        elif isinstance(node, ast.Call):
-            chain = _attr_chain(node.func)
-            if chain.startswith(("np.", "numpy.")):
-                flag(node, f"{chain}()", func)
-            elif _host_sync_call(chain):
-                flag(node, f"{chain}()", func)
-        for child in ast.iter_child_nodes(node):
-            walk(child, func)
-
-    walk(tree, None)
-
-
-#: sanctioned raw device_put sites inside execs//ops/:
-#: "<rel>:<qualified function>" -> justification. The hook for new
-#: exceptions — add an entry HERE with a reason, never a bare
-#: suppression. Table-sized landings are NEVER eligible: they belong
-#: on the arbiter-accounted DeviceTable.from_host path.
-_MEM_ACCOUNT_ALLOWLIST = {
-    "spark_rapids_tpu/execs/mesh.py:TpuMeshRelandExec._reland":
-        "re-lands a 4-element uint32 DIGEST scalar (gather-integrity "
-        "checksum, ~16 bytes) onto device 0 — validation overhead, "
-        "not a table landing; budget accounting at this size would be "
-        "pure ledger noise",
-}
-
-
-def _check_mem_account(rel: str, tree: ast.AST,
-                       diags: List[Diagnostic]):
-    """RL-MEM-ACCOUNT: device landings in execs//ops/ must route
-    through arbiter-accounted paths — a raw jax.device_put there lands
-    bytes the MemoryArbiter never sees, and the hard budget contract
-    (zero violations under scale_test --device-budget) silently
-    breaks."""
-    if not rel.startswith(("spark_rapids_tpu/execs/",
-                           "spark_rapids_tpu/ops/")):
-        return
-
-    def flag(node, what: str, func):
-        if f"{rel}:{func}" in _MEM_ACCOUNT_ALLOWLIST:
-            return
-        diags.append(make(
-            "RL-MEM-ACCOUNT", f"{rel}:{node.lineno}",
-            f"{what} in a device-landing layer"
-            + (f" (function {func!r})" if func else " (module level)")
-            + " — land through DeviceTable.from_host so the memory "
-            "arbiter accounts the bytes, or allowlist the function in "
-            "_MEM_ACCOUNT_ALLOWLIST with a justification"))
-
-    def walk(node, func):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
-                             ast.ClassDef)):
-            func = f"{func}.{node.name}" if func else node.name
-        if isinstance(node, ast.ImportFrom) and node.module == "jax":
-            # `from jax import device_put` would make the call below
-            # invisible to the chain matcher — ban the import form too
-            for a in node.names:
-                if a.name == "device_put":
-                    flag(node, "importing jax.device_put", func)
-        elif isinstance(node, ast.Call):
-            chain = _attr_chain(node.func)
-            if chain == "jax.device_put" \
-                    or chain.endswith(".device_put") \
-                    or chain == "device_put":
-                flag(node, f"{chain}()", func)
-        for child in ast.iter_child_nodes(node):
-            walk(child, func)
-
-    walk(tree, None)
-
-
-#: the module RL-OBS-PASSIVE governs (the telemetry sampler + flight
-#: recorder — both run off the query path by contract)
-_OBS_PASSIVE_MODULE = "spark_rapids_tpu/obs/telemetry.py"
-
-#: sanctioned exceptions: "<rel>:<qualified function>" -> justification
-_OBS_PASSIVE_ALLOWLIST: dict = {}
-
-#: lock-name fragments that mark a QUERY-PATH lock (the device
-#: semaphore, the scheduler's condition, the session's obs lock) —
-#: the sampler's own ring lock and the snapshot surfaces' internal
-#: locks are fine (each bounds its hold to a dict copy)
-_OBS_PASSIVE_LOCK_TOKENS = ("semaphore", "_cond", "_obs_lock")
-
-#: call names that DRIVE execution — the passive module may read
-#: state, never create it
-_OBS_PASSIVE_EXEC_CALLS = {"execute", "execute_cpu", "execute_masked",
-                           "collect", "collect_table", "collect_cpu"}
-
-
-def _check_obs_passive(rel: str, tree: ast.AST,
-                       diags: List[Diagnostic]):
-    """RL-OBS-PASSIVE: the telemetry sampler thread may not call
-    host_fetch/device syncs, touch jax at all, drive query execution,
-    or take query-path locks — sampling must never perturb the
-    execution it observes."""
-    if rel != _OBS_PASSIVE_MODULE:
-        return
-
-    def flag(node, what: str, func: Optional[str]):
-        if f"{rel}:{func}" in _OBS_PASSIVE_ALLOWLIST:
-            return
-        diags.append(make(
-            "RL-OBS-PASSIVE", f"{rel}:{node.lineno}",
-            f"{what} in the passive telemetry module"
-            + (f" (function {func!r})" if func else " (module level)")
-            + " — the sampler must never perturb execution: read the "
-            "bounded snapshot surfaces only, or allowlist the function "
-            "in _OBS_PASSIVE_ALLOWLIST with a justification"))
-
-    def _names_query_lock(expr: ast.AST) -> Optional[str]:
-        chain = _attr_chain(expr)
-        if isinstance(expr, ast.Call):
-            chain = _attr_chain(expr.func)
-        low = chain.lower()
-        for tok in _OBS_PASSIVE_LOCK_TOKENS:
-            if tok in low:
-                return chain
-        return None
-
-    def walk(node, func: Optional[str]):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
-                             ast.ClassDef)):
-            func = f"{func}.{node.name}" if func else node.name
-        if isinstance(node, (ast.Import, ast.ImportFrom)):
-            mod = getattr(node, "module", None) or ""
-            names = [a.name for a in node.names]
-            if mod == "jax" or mod.startswith("jax.") \
-                    or any(n == "jax" or n.startswith("jax.")
-                           for n in names):
-                flag(node, "jax import (device work)", func)
-        elif isinstance(node, ast.Call):
-            chain = _attr_chain(node.func)
-            if chain.startswith(("jax.", "jnp.")):
-                flag(node, f"{chain}() (device work)", func)
-            elif _host_sync_call(chain):
-                flag(node, f"{chain}() (host sync)", func)
-            elif chain.split(".")[-1] == "finalize_observation":
-                flag(node, f"{chain}() (forces the deferred device "
-                           "row-count fetch)", func)
-            elif chain.split(".")[-1] in _OBS_PASSIVE_EXEC_CALLS:
-                flag(node, f"{chain}() (drives query execution)", func)
-            elif chain.split(".")[-1] == "acquire":
-                locked = _names_query_lock(node.func.value) \
-                    if isinstance(node.func, ast.Attribute) else None
-                if locked:
-                    flag(node, f"{chain}() (query-path lock)", func)
-        elif isinstance(node, ast.With):
-            for item in node.items:
-                locked = _names_query_lock(item.context_expr)
-                if locked:
-                    flag(node, f"with {locked} (query-path lock)", func)
-        for child in ast.iter_child_nodes(node):
-            walk(child, func)
-
-    walk(tree, None)
-
-
-def _check_dead_lambdas(rel: str, tree: ast.AST,
-                        diags: List[Diagnostic]):
-    lambda_defs = {}
-    used = set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
-                and isinstance(node.targets[0], ast.Name) \
-                and isinstance(node.value, ast.Lambda):
-            name = node.targets[0].id
-            lambda_defs.setdefault(name, node.lineno)
-        elif isinstance(node, ast.Name) and \
-                isinstance(node.ctx, ast.Load):
-            used.add(node.id)
-    for name, lineno in sorted(lambda_defs.items(), key=lambda kv: kv[1]):
-        if name not in used:
-            diags.append(make(
-                "RL-DEAD-LAMBDA", f"{rel}:{lineno}",
-                f"lambda bound to {name!r} is never used — dead code"))
-
-
-#: the ONLY names streaming/ may import from service/result_cache — the
-#: invalidation-epoch API (all re-exported from plan/fingerprint).
-#: Anything else (ResultCache itself, its mutators) is a second write
-#: path into cache coherence.
-_MV_EPOCH_ALLOWED_IMPORTS = frozenset({
-    "GLOBAL_EPOCH_KEY",
-    "bump_invalidation_epoch",
-    "bump_table_epoch",
-    "delta_table_id",
-    "epoch_snapshot",
-    "epochs_current",
-    "invalidation_epoch",
-    "plan_table_ids",
-    "register_epoch_listener",
-    "table_epoch",
-    "unregister_epoch_listener",
-})
-
-_MV_CACHE_MUTATORS = ("put", "clear", "pop", "evict", "invalidate")
-
-
-def _check_mv_epoch(rel: str, tree: ast.AST, diags: List[Diagnostic]):
-    """RL-MV-EPOCH: MV/stream maintenance lives in streaming/ and must
-    drive cache coherence through the invalidation-epoch API only —
-    a direct result-cache mutation there would race the scheduler's
-    epoch-vector staleness checks."""
-    if not rel.startswith("spark_rapids_tpu/streaming/"):
-        return
-    for node in ast.walk(tree):
-        if isinstance(node, ast.ImportFrom) and node.module \
-                and node.module.endswith("service.result_cache"):
-            for alias in node.names:
-                if alias.name not in _MV_EPOCH_ALLOWED_IMPORTS:
-                    diags.append(make(
-                        "RL-MV-EPOCH", f"{rel}:{node.lineno}",
-                        f"import of {alias.name!r} from service/"
-                        "result_cache in streaming/ — only the "
-                        "invalidation-epoch API may cross this "
-                        "boundary"))
-        elif isinstance(node, ast.Attribute) and node.attr == "_entries":
-            diags.append(make(
-                "RL-MV-EPOCH", f"{rel}:{node.lineno}",
-                "direct access to a result cache's _entries from "
-                "streaming/ — mark staleness via bump_table_epoch, "
-                "never by reaching into the cache"))
-        elif isinstance(node, ast.Call):
-            chain = _attr_chain(node.func)
-            parts = chain.split(".")
-            if len(parts) >= 2 and parts[-1] in _MV_CACHE_MUTATORS \
-                    and any("result_cache" in p or p == "cache"
-                            for p in parts[:-1]):
-                diags.append(make(
-                    "RL-MV-EPOCH", f"{rel}:{node.lineno}",
-                    f"{chain}() mutates a result cache from "
-                    "streaming/ — MV maintenance owns its own "
-                    "tables; cache invalidation goes through the "
-                    "epoch API"))
-
-
-# ---------------------------------------------------------------------------
-# entry point
-# ---------------------------------------------------------------------------
+from spark_rapids_tpu.lint.diagnostics import Diagnostic
+from spark_rapids_tpu.lint.rules import REGISTRY, LintContext
+# re-exports: the stable import surface (tests and callers patch the
+# allowlist DICTS in place — these must stay the same objects the rule
+# modules read)
+from spark_rapids_tpu.lint.rules.common import (  # noqa: F401
+    _attr_chain, _host_sync_call, _is_device_expr, _iter_source_files,
+    _rel, _repo_root)
+from spark_rapids_tpu.lint.rules.conf_keys import (  # noqa: F401
+    _CONF_KEY_RE, _check_conf_keys)
+from spark_rapids_tpu.lint.rules.determinism import (  # noqa: F401
+    _SEEDED_RANDOM_OK, _check_dead_lambdas, _check_nondeterminism)
+from spark_rapids_tpu.lint.rules.device_residency import (  # noqa: F401
+    _DEVICE_DIRS, _DEVICE_FILES, _KERNEL_HOST_ALLOWLIST,
+    _MEM_ACCOUNT_ALLOWLIST, _MESH_HOST_ALLOWLIST, _check_host_sync,
+    _check_jnp_scope, _check_kernel_host, _check_mem_account,
+    _check_mesh_host)
+from spark_rapids_tpu.lint.rules.fault_points import (  # noqa: F401
+    _check_fault_registry, _check_fault_sites, _is_fault_point_call)
+from spark_rapids_tpu.lint.rules.io_write import (  # noqa: F401
+    _WRITE_COMMIT_EXEMPT, _WRITE_ONE, _check_write_commit,
+    _open_mode_writes)
+from spark_rapids_tpu.lint.rules.obs_passive import (  # noqa: F401
+    _OBS_PASSIVE_ALLOWLIST, _OBS_PASSIVE_MODULE, _check_obs_passive)
+from spark_rapids_tpu.lint.rules.streaming_epoch import (  # noqa: F401
+    _MV_EPOCH_ALLOWED_IMPORTS, _check_mv_epoch)
+from spark_rapids_tpu.lint.rules.thread_shared import (  # noqa: F401
+    _THREAD_SHARED_ALLOWLIST, _THREAD_SHARED_DIRS, _check_thread_shared,
+    _is_lock_guard, _is_mutable_container)
 
 
 def lint_repo(repo_root: Optional[str] = None) -> List[Diagnostic]:
@@ -875,9 +63,8 @@ def lint_repo(repo_root: Optional[str] = None) -> List[Diagnostic]:
     from spark_rapids_tpu.lint.registry_audit import _import_full_package
     _import_full_package()
     from spark_rapids_tpu import conf as C
-    declared = set(C.registry())
+    ctx = LintContext(declared=set(C.registry()))
     diags: List[Diagnostic] = []
-    fault_calls: dict = {}
     for path in _iter_source_files(root):
         rel = _rel(root, path)
         if rel.startswith("spark_rapids_tpu/lint/"):
@@ -885,18 +72,11 @@ def lint_repo(repo_root: Optional[str] = None) -> List[Diagnostic]:
         with open(path) as f:
             src = f.read()
         tree = ast.parse(src, filename=rel)  # unparseable repo = hard error
-        _check_host_sync(rel, tree, diags)
-        _check_jnp_scope(rel, tree, diags)
-        _check_conf_keys(rel, tree, declared, diags)
-        _check_nondeterminism(rel, tree, diags)
-        _check_dead_lambdas(rel, tree, diags)
-        _check_thread_shared(rel, tree, diags)
-        _check_write_commit(rel, tree, diags)
-        _check_mesh_host(rel, tree, diags)
-        _check_kernel_host(rel, tree, diags)
-        _check_obs_passive(rel, tree, diags)
-        _check_mem_account(rel, tree, diags)
-        _check_mv_epoch(rel, tree, diags)
-        _check_fault_sites(rel, tree, fault_calls, diags)
-    _check_fault_registry(fault_calls, diags)
+        ctx.trees[rel] = tree
+        for rule in REGISTRY:
+            if rule.file_check is not None:
+                rule.file_check(ctx, rel, tree, diags)
+    for rule in REGISTRY:
+        if rule.finalizer is not None:
+            rule.finalizer(ctx, diags)
     return diags

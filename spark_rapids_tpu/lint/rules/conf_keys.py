@@ -1,0 +1,31 @@
+"""RL-CONF-KEY — every ``spark.*`` conf key referenced as a string
+literal must be declared in the conf registry (a typo'd key string
+silently falls back to the default at runtime)."""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List
+
+from spark_rapids_tpu.lint.diagnostics import Diagnostic, make
+
+_CONF_KEY_RE = re.compile(r"^spark\.(rapids|sql)\.[A-Za-z0-9_]"
+                          r"[A-Za-z0-9_.]*[A-Za-z0-9_]$")
+
+
+def _check_conf_keys(rel: str, tree: ast.AST, declared,
+                     diags: List[Diagnostic]):
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Constant)
+                and isinstance(node.value, str)):
+            continue
+        v = node.value
+        if not _CONF_KEY_RE.match(v):
+            continue
+        if v in declared:
+            continue
+        diags.append(make(
+            "RL-CONF-KEY", f"{rel}:{node.lineno}",
+            f"conf key {v!r} is not declared in the conf registry — "
+            "typo, or a key removed without cleaning its references"))

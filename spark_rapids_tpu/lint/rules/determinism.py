@@ -1,0 +1,70 @@
+"""Determinism + dead-code rules.
+
+* RL-NONDETERMINISM — no wall-clock or unseeded randomness in kernel
+  modules (results must replay bit-identically; LORE depends on it).
+* RL-DEAD-LAMBDA — a lambda bound to a name that is never referenced
+  again is dead code.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from spark_rapids_tpu.lint.diagnostics import Diagnostic, make
+from spark_rapids_tpu.lint.rules.common import _attr_chain
+
+#: np.random attributes that construct SEEDED generators (allowed in
+#: kernels); everything else on np.random is process-global state
+_SEEDED_RANDOM_OK = {"default_rng", "Generator", "SeedSequence",
+                     "BitGenerator", "PCG64", "Philox"}
+
+
+def _check_nondeterminism(rel: str, tree: ast.AST,
+                          diags: List[Diagnostic]):
+    in_kernel = rel.startswith(("spark_rapids_tpu/execs/",
+                                "spark_rapids_tpu/ops/"))
+    if not in_kernel:
+        return
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        chain = _attr_chain(node.func)
+        bad = None
+        if chain in ("time.time", "datetime.now", "datetime.datetime.now",
+                     "date.today", "datetime.date.today",
+                     "datetime.utcnow", "datetime.datetime.utcnow"):
+            bad = f"{chain}() (wall clock)"
+        else:
+            parts = chain.split(".")
+            if len(parts) >= 2 and parts[-2] == "random" and \
+                    parts[0] in ("np", "numpy") and \
+                    parts[-1] not in _SEEDED_RANDOM_OK:
+                bad = f"{chain}() (process-global RNG state)"
+            elif chain.startswith("random.") and len(parts) == 2:
+                bad = f"{chain}() (unseeded stdlib RNG)"
+        if bad:
+            diags.append(make(
+                "RL-NONDETERMINISM", f"{rel}:{node.lineno}",
+                f"{bad} in a kernel module — results must replay "
+                "bit-identically (seeded default_rng only)"))
+
+
+def _check_dead_lambdas(rel: str, tree: ast.AST,
+                        diags: List[Diagnostic]):
+    lambda_defs = {}
+    used = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Lambda):
+            name = node.targets[0].id
+            lambda_defs.setdefault(name, node.lineno)
+        elif isinstance(node, ast.Name) and \
+                isinstance(node.ctx, ast.Load):
+            used.add(node.id)
+    for name, lineno in sorted(lambda_defs.items(), key=lambda kv: kv[1]):
+        if name not in used:
+            diags.append(make(
+                "RL-DEAD-LAMBDA", f"{rel}:{lineno}",
+                f"lambda bound to {name!r} is never used — dead code"))

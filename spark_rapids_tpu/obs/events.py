@@ -28,6 +28,7 @@ from collections import deque
 from typing import Dict, List, Optional
 
 from spark_rapids_tpu.conf import bool_conf, str_conf
+from spark_rapids_tpu.lockorder import ordered_lock
 
 EVENT_LOG_ENABLED = bool_conf(
     "spark.rapids.sql.eventLog.enabled", False,
@@ -348,7 +349,7 @@ class QueryEventWriter:
         self.directory = directory
         self.path = os.path.join(
             directory, f"events-{uuid.uuid4().hex[:12]}.jsonl")
-        self._lock = threading.Lock()
+        self._lock = ordered_lock("obs.events.writer")
         self.records_written = 0
 
     def write(self, record: dict) -> str:
@@ -371,7 +372,7 @@ class QueryEventWriter:
 #: (full records carry whole plan trees — the bundle only needs the
 #: headline facts)
 _RECENT_KEEP = 32
-_RECENT_LOCK = threading.Lock()
+_RECENT_LOCK = ordered_lock("obs.events.recent")
 _RECENT = deque(maxlen=_RECENT_KEEP)
 _RECENT_FIELDS = ("queryIndex", "queryTag", "wallS", "healthState",
                   "hostTopology", "meshShape", "dispatches",

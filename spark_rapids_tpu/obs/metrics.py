@@ -21,6 +21,7 @@ from __future__ import annotations
 import threading
 from dataclasses import dataclass
 from typing import Dict, Optional
+from spark_rapids_tpu.lockorder import ordered_lock
 
 #: collection levels, ordered (reference: GpuMetric ESSENTIAL/MODERATE/
 #: DEBUG). The session sets the active level from
@@ -50,7 +51,7 @@ class MetricSpec:
 
 
 _SPECS: Dict[str, MetricSpec] = {}
-_SPEC_LOCK = threading.Lock()
+_SPEC_LOCK = ordered_lock("obs.metrics.spec")
 
 
 def register_metric(name: str, kind: str = "count",
@@ -132,7 +133,7 @@ class LockedMetricSet(MetricSet):
 
     def __init__(self, *a, **kw):
         super().__init__(*a, **kw)
-        self._lock = threading.Lock()
+        self._lock = ordered_lock("obs.metrics.scope")
 
     def add(self, key: str, value, level: Optional[str] = None) -> None:
         with self._lock:
@@ -140,7 +141,7 @@ class LockedMetricSet(MetricSet):
 
 
 _SCOPES: Dict[str, LockedMetricSet] = {}
-_SCOPE_LOCK = threading.Lock()
+_SCOPE_LOCK = ordered_lock("obs.metrics.scopes")
 
 
 def metric_scope(name: str) -> LockedMetricSet:

@@ -33,6 +33,7 @@ import time
 from typing import Dict, List, Optional
 
 from spark_rapids_tpu.conf import bool_conf, str_conf
+from spark_rapids_tpu.lockorder import ordered_lock
 
 TRACE_ENABLED = bool_conf(
     "spark.rapids.trace.enabled", False,
@@ -137,7 +138,7 @@ class SpanTracer:
 
     def __init__(self):
         self.enabled = False
-        self._lock = threading.Lock()
+        self._lock = ordered_lock("obs.spans")
         self._ctxs: Dict[int, _QueryCtx] = {}  # owner tid -> ctx
         self._next_id = 0
         self._tls = threading.local()

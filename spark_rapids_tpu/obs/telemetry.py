@@ -46,6 +46,7 @@ from typing import Dict, List, Optional
 
 from spark_rapids_tpu.conf import RapidsConf, bool_conf, int_conf, str_conf
 from spark_rapids_tpu.obs.metrics import scopes_snapshot
+from spark_rapids_tpu.lockorder import ordered_lock
 
 TELEMETRY_ENABLED = bool_conf(
     "spark.rapids.obs.telemetry.enabled", False,
@@ -114,7 +115,7 @@ class TelemetryRing:
     conf's recorder settings for conf-less trigger sites."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = ordered_lock("obs.telemetry.ring")
         self._cfg = None
         self._interval_s = 0.5
         self._ring: deque = deque(maxlen=720)
@@ -262,7 +263,7 @@ TELEMETRY = TelemetryRing()
 #: registered QueryServices (weak — a shut-down service just drops
 #: out); the recorder snapshots their live query tables best-effort
 _SERVICES: "weakref.WeakSet" = weakref.WeakSet()
-_SERVICES_LOCK = threading.Lock()
+_SERVICES_LOCK = ordered_lock("obs.telemetry.services")
 
 
 def register_service(service) -> None:
@@ -274,7 +275,7 @@ def register_service(service) -> None:
 
 #: process defaults for conf-less trigger sites (quarantine strikes,
 #: kernel demotions), refreshed by TELEMETRY.configure
-_FR_LOCK = threading.Lock()
+_FR_LOCK = ordered_lock("obs.flightrec")
 _FR_STATE = {
     "enabled": bool(FLIGHT_RECORDER_ENABLED.default),
     "dir": str(FLIGHT_RECORDER_DIR.default),

@@ -58,9 +58,10 @@ def _axis_size(mesh, axis) -> int:
 #: exactly what makes the id key sound (a live object's id can't be
 #: reused), and the cap bounds the pinned host memory.
 from collections import OrderedDict
+from spark_rapids_tpu.lockorder import ordered_lock
 
 _DICT_INTERN: "OrderedDict[int, tuple]" = OrderedDict()
-_DICT_INTERN_LOCK = threading.Lock()
+_DICT_INTERN_LOCK = ordered_lock("mesh.dict_intern")
 _DICT_INTERN_CAP = 256
 #: jitted gather-digest kernels (the TPAK-v2 row-count/checksum
 #: validation at mesh gather boundaries — execs/mesh.py and the

@@ -49,6 +49,7 @@ import numpy as np
 
 from spark_rapids_tpu.conf import RapidsConf, bool_conf, int_conf, str_conf
 from spark_rapids_tpu.obs.metrics import metric_scope, register_metric
+from spark_rapids_tpu.lockorder import ordered_lock
 
 MESH_ENABLED = bool_conf(
     "spark.rapids.mesh.enabled", False,
@@ -230,7 +231,7 @@ class MeshRuntime:
     reconfiguration)."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = ordered_lock("mesh.runtime")
         self._mesh = None
         self._dims: Tuple[int, ...] = ()
         self._axes: Tuple[str, ...] = ()

@@ -216,6 +216,9 @@ RESULT_NEUTRAL_PREFIXES = (
     "spark.rapids.sql.planVerify.mode",
     "spark.rapids.service.",
     "spark.rapids.streaming.",
+    # the lock witness wraps lock ACQUISITION bookkeeping only — query
+    # results are byte-identical with it armed
+    "spark.rapids.lint.",
     # fetch mechanics only — the root transition's flag is re-set per
     # query, results and the converted tree are byte-identical
     "spark.rapids.sql.asyncResultFetch",
@@ -235,6 +238,7 @@ EXECUTABLE_NEUTRAL_PREFIXES = (
     "spark.rapids.sql.explain",
     "spark.rapids.service.",
     "spark.rapids.streaming.",
+    "spark.rapids.lint.",
     "spark.rapids.sql.asyncResultFetch",
     "spark.rapids.sql.executableCache.",
 )

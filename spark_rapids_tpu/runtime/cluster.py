@@ -65,6 +65,7 @@ from typing import Dict, List, Optional, Tuple
 
 from spark_rapids_tpu.conf import RapidsConf, bool_conf, int_conf
 from spark_rapids_tpu.obs.metrics import metric_scope, register_metric
+from spark_rapids_tpu.lockorder import ordered_lock
 
 CLUSTER_ENABLED = bool_conf(
     "spark.rapids.cluster.enabled", False,
@@ -239,7 +240,7 @@ class ClusterRuntime:
     host rejoins."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = ordered_lock("cluster.runtime")
         self._enabled = False
         self._declared_hosts = 0
         self._config_key = None
@@ -683,7 +684,7 @@ class _HostChannel:
     def __init__(self, host_id: str, sock: socket.socket):
         self.host_id = host_id
         self.sock = sock
-        self.lock = threading.Lock()
+        self.lock = ordered_lock("cluster.channel")
 
 
 class ClusterDriver:
@@ -707,7 +708,7 @@ class ClusterDriver:
         self._hb = ShuffleHeartbeatManager(
             heartbeat_timeout_s=self.missed_beats * self.heartbeat_ms
             / 1000.0)
-        self._lock = threading.Lock()
+        self._lock = ordered_lock("cluster.driver")
         self._channels: Dict[str, _HostChannel] = {}
         self._registered: set = set()
         #: hosts with an OPEN beat connection right now — beat-conn EOF

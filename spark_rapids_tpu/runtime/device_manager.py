@@ -21,6 +21,7 @@ from spark_rapids_tpu.conf import (
     HBM_RESERVE_BYTES,
     RapidsConf,
 )
+from spark_rapids_tpu.lockorder import ordered_lock
 
 _DEFAULT_HBM_BYTES = 16 << 30  # v5e has 16 GiB per chip
 
@@ -44,7 +45,7 @@ class TpuDeviceManager:
     """Singleton-ish per-process device state."""
 
     _instance: Optional["TpuDeviceManager"] = None
-    _instance_lock = threading.Lock()
+    _instance_lock = ordered_lock("device.manager.instance")
 
     def __init__(self, conf: RapidsConf):
         self.conf = conf

@@ -37,6 +37,7 @@ from spark_rapids_tpu.errors import (
     ShuffleFetchError,
     ShuffleTransportError,
 )
+from spark_rapids_tpu.lockorder import ordered_lock
 
 #: injectable fault kinds and the failure each simulates
 FAULT_KINDS = (
@@ -296,7 +297,7 @@ class FaultRegistry:
     """Process-wide armed faults + per-point fire counters."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = ordered_lock("faults.registry")
         self._armed: List[_ArmedFault] = []
         self._spec = ""
         self._counters: Dict[str, int] = {}
@@ -448,7 +449,7 @@ class RecoveryStats:
             metric_scope,
             register_metric,
         )
-        self._lock = threading.Lock()
+        self._lock = ordered_lock("faults.recovery")
         self._counts = metric_scope("recovery")
         for f in self.FIELDS:
             register_metric(f, "count", "ESSENTIAL",
@@ -514,7 +515,7 @@ class CircuitBreaker:
     fallback-hygiene rule surface it."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = ordered_lock("faults.breaker")
         self._failures: Dict[str, int] = {}
         self._reasons: Dict[str, str] = {}
 

@@ -44,6 +44,7 @@ from typing import Dict, List, Optional
 
 from spark_rapids_tpu.conf import int_conf
 from spark_rapids_tpu.obs.metrics import metric_scope, register_metric
+from spark_rapids_tpu.lockorder import ordered_lock
 
 DEVICE_LOSS_MAX_REINITS = int_conf(
     "spark.rapids.service.deviceLoss.maxReinits", 3,
@@ -135,7 +136,7 @@ class DeviceHealthMonitor:
     executable-cache token) are single attribute loads."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = ordered_lock("health.monitor")
         self._metrics = metric_scope("health")
         self._consecutive_losses = 0
         self._reinits = 0
@@ -604,7 +605,7 @@ class QuarantineRegistry:
     they also cannot hit any cache, so each run is independent."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = ordered_lock("health.quarantine")
         self._metrics = metric_scope("health")
         #: template_fp -> ordered strike reasons
         self._strikes: Dict[str, List[str]] = {}

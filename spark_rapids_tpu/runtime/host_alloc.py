@@ -18,6 +18,7 @@ import threading
 from typing import Optional
 
 from spark_rapids_tpu.errors import ColumnarProcessingError, CpuRetryOOM
+from spark_rapids_tpu.lockorder import ordered_condition, ordered_lock
 
 
 class HostAllocation:
@@ -46,12 +47,12 @@ class HostMemoryArbiter:
     """Process-wide host-memory budget (HostAlloc analog)."""
 
     _instance: Optional["HostMemoryArbiter"] = None
-    _instance_lock = threading.Lock()
+    _instance_lock = ordered_lock("host_alloc.instance")
 
     def __init__(self, limit_bytes: int):
         self.limit_bytes = limit_bytes
         self._used = 0
-        self._cv = threading.Condition()
+        self._cv = ordered_condition("host_alloc.cv")
         self.alloc_count = 0
         self.blocked_count = 0
         self.spill_triggered_count = 0
@@ -128,13 +129,13 @@ class PinnedMemoryPool:
     and the caller allocates unpooled (the reference's fallback)."""
 
     _instance: Optional["PinnedMemoryPool"] = None
-    _instance_lock = threading.Lock()
+    _instance_lock = ordered_lock("pinned_pool.instance")
 
     def __init__(self, total_bytes: int, buffer_bytes: int = 8 << 20):
         self.buffer_bytes = buffer_bytes
         n = max(total_bytes // buffer_bytes, 0)
         self._free = [bytearray(buffer_bytes) for _ in range(n)]
-        self._lock = threading.Lock()
+        self._lock = ordered_lock("pinned_pool")
         self.total_buffers = n
         self.hits = 0
         self.misses = 0

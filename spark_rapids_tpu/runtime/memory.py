@@ -48,6 +48,7 @@ from typing import Dict, Optional
 from spark_rapids_tpu.conf import float_conf, int_conf
 from spark_rapids_tpu.errors import RetryOOM
 from spark_rapids_tpu.obs.metrics import metric_scope, register_metric
+from spark_rapids_tpu.lockorder import ordered_lock
 
 DEVICE_BUDGET_BYTES = int_conf(
     "spark.rapids.memory.device.budgetBytes", 0,
@@ -197,7 +198,7 @@ class MemoryArbiter:
     bounded dict work — safe from the passive telemetry sampler."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = ordered_lock("memory.arbiter")
         self._cfg = None
         #: resolved hard budget; <=0 means "not yet configured" and
         #: enforcement resolves the backend HBM limit lazily

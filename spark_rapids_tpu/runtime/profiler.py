@@ -21,6 +21,7 @@ import threading
 from typing import Optional, Set
 
 from spark_rapids_tpu.conf import RapidsConf, bool_conf, str_conf
+from spark_rapids_tpu.lockorder import ordered_lock
 
 PROFILE_ENABLED = bool_conf(
     "spark.rapids.profile.enabled", False,
@@ -95,7 +96,7 @@ class TpuProfiler:
         # HERE with the conf key named, not at the first profiled query
         self.ranges = parse_ranges(str(conf.get_entry(PROFILE_QUERY_RANGES)))
         self._query_index = 0
-        self._lock = threading.Lock()
+        self._lock = ordered_lock("profiler")
         self._active = 0
         self.sessions_written = 0
 

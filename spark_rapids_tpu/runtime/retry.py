@@ -28,6 +28,7 @@ from spark_rapids_tpu.errors import (
     SplitAndRetryOOM,
 )
 from spark_rapids_tpu.runtime.spill import BufferCatalog, SpillableBatch
+from spark_rapids_tpu.lockorder import ordered_lock
 
 
 def is_device_oom(exc: BaseException) -> bool:
@@ -156,7 +157,7 @@ class DeviceMemoryEventHandler:
 
     def __init__(self, catalog: Optional[BufferCatalog] = None):
         self._default_catalog = catalog
-        self._lock = threading.Lock()
+        self._lock = ordered_lock("memory.retry_handler")
         self.alloc_failure_count = 0
         self.spilled_bytes = 0
         self.spill_crashes = 0

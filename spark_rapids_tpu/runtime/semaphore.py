@@ -16,6 +16,7 @@ from typing import Dict, Optional
 
 from spark_rapids_tpu.errors import SemaphoreTimeoutError
 from spark_rapids_tpu.obs.metrics import metric_scope, register_metric
+from spark_rapids_tpu.lockorder import ordered_condition, ordered_lock
 
 # acquisition accounting lives in the unified registry's ``semaphore``
 # scope (obs/metrics.py) so the event log diffs it per query like the
@@ -33,12 +34,12 @@ register_metric("acquireTimeouts", "count", "ESSENTIAL",
 
 class TpuSemaphore:
     _instance: Optional["TpuSemaphore"] = None
-    _instance_lock = threading.Lock()
+    _instance_lock = ordered_lock("semaphore.instance")
 
     def __init__(self, max_tasks: int, stall_dump_seconds: float = 60.0):
         self.max_tasks = max_tasks
         self.stall_dump_seconds = stall_dump_seconds
-        self._lock = threading.Condition()
+        self._lock = ordered_condition("semaphore.cond")
         self._holders: Dict[int, int] = {}  # thread id -> reentrant depth
         self._metrics = metric_scope("semaphore")
         self.total_wait_seconds = 0.0

@@ -27,6 +27,7 @@ import threading
 from typing import List, Optional, Tuple
 
 import jax
+from spark_rapids_tpu.lockorder import ordered_lock
 
 
 class SpeculationFailed(Exception):
@@ -93,7 +94,7 @@ _BLOCKLIST = set()
 #: workers blocklist sites at the same time (membership reads stay
 #: lock-free — set containment is atomic under the GIL, and a stale
 #: read only costs one extra speculative attempt)
-_BLOCKLIST_LOCK = threading.Lock()
+_BLOCKLIST_LOCK = ordered_lock("speculation.blocklist")
 
 
 def current() -> Optional[SpecContext]:

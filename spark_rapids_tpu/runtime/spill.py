@@ -33,6 +33,7 @@ from spark_rapids_tpu.errors import (
 )
 from spark_rapids_tpu.obs.metrics import metric_scope
 from spark_rapids_tpu.runtime.faults import fault_point
+from spark_rapids_tpu.lockorder import ordered_lock, ordered_rlock
 
 TIER_DEVICE = "DEVICE"
 TIER_HOST = "HOST"
@@ -135,7 +136,7 @@ class SpillableBatch:
         from spark_rapids_tpu.runtime.memory import MEMORY
         MEMORY.account(table)
         self._host_bytes = 0
-        self._lock = threading.RLock()
+        self._lock = ordered_rlock("spill.batch")
         self._pinned = 0
         self.last_touch = time.monotonic()
         catalog.register(self)
@@ -373,7 +374,7 @@ class BufferCatalog:
     used device buffers until the byte target frees."""
 
     _instance: Optional["BufferCatalog"] = None
-    _instance_lock = threading.Lock()
+    _instance_lock = ordered_lock("spill.catalog.instance")
 
     #: per-catalog counters stay instance-local (two catalogs can be
     #: live at once — reset() mid-flight, per-catalog tests — and must
@@ -391,11 +392,11 @@ class BufferCatalog:
     #: lock — get()/reset() hold _instance_lock while CONSTRUCTING a
     #: catalog, so __init__ must not re-take it (non-reentrant)
     _all_catalogs: "weakref.WeakSet" = weakref.WeakSet()
-    _all_catalogs_lock = threading.Lock()
+    _all_catalogs_lock = ordered_lock("spill.catalog.registry")
 
     def __init__(self, host_limit_bytes: int = 2 << 30,
                  disk_dir: Optional[str] = None):
-        self._lock = threading.RLock()
+        self._lock = ordered_rlock("spill.catalog")
         self._buffers: Dict[int, SpillableBatch] = {}
         self.host_limit_bytes = host_limit_bytes
         self.disk_dir = disk_dir

@@ -23,6 +23,7 @@ import time
 from typing import Optional
 
 from spark_rapids_tpu.errors import QueryCancelledError, QueryTimeoutError
+from spark_rapids_tpu.lockorder import ordered_lock
 
 
 class QueryState:
@@ -150,7 +151,7 @@ class QueryHandle:
     through :meth:`_transition` under the handle's lock and terminal
     states latch (a cancel racing a finish cannot un-finish it)."""
 
-    _seq_lock = threading.Lock()
+    _seq_lock = ordered_lock("service.handle.seq")
     _seq = 0
 
     def __init__(self, *, tenant: str, pool: str, tag: Optional[str],
@@ -165,7 +166,7 @@ class QueryHandle:
         self.sql_text = sql_text
         self.plan = plan
         self.scope = CancelScope(deadline)
-        self._lock = threading.Lock()
+        self._lock = ordered_lock("service.handle")
         self._done = threading.Event()
         self._state = QueryState.QUEUED
         self.submit_t = time.monotonic()

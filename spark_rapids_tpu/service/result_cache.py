@@ -55,6 +55,7 @@ from spark_rapids_tpu.plan.fingerprint import (  # noqa: F401  (re-exports:
     table_epoch,
     unregister_epoch_listener,
 )
+from spark_rapids_tpu.lockorder import ordered_lock
 
 register_metric("resultCacheHits", "count", "ESSENTIAL",
                 "service queries served from the plan-fingerprint cache")
@@ -99,7 +100,7 @@ class ResultCache:
 
     def __init__(self, max_bytes: int):
         self.max_bytes = int(max_bytes)
-        self._lock = threading.Lock()
+        self._lock = ordered_lock("service.result_cache")
         self._entries: "OrderedDict[str, _Entry]" = OrderedDict()
         self._bytes = 0
         self._metrics = metric_scope("resultCache")

@@ -72,6 +72,7 @@ from spark_rapids_tpu.service.result_cache import (
     plan_table_ids,
 )
 from spark_rapids_tpu.service.watchdog import WorkerWatchdog, _Worker
+from spark_rapids_tpu.lockorder import ordered_condition, ordered_lock
 
 
 def _mesh_shape():
@@ -263,6 +264,12 @@ class QueryService:
                 "its knobs from the session's conf)")
         self.session = session
         self.conf: RapidsConf = session.conf
+        # arm the runtime lock witness FIRST (construction-time
+        # election): every lock this __init__ builds — the scheduler
+        # condition, the streams lock, the result cache's — is wrapped
+        # iff the conf arms it
+        from spark_rapids_tpu import lockorder
+        lockorder.configure(self.conf)
         self.pools = parse_pools(self.conf.get_entry(SERVICE_POOLS))
         self.tenant_weights = parse_tenant_weights(
             self.conf.get_entry(SERVICE_TENANT_WEIGHTS))
@@ -287,11 +294,11 @@ class QueryService:
         #: exposing describe() — surfaced by streams()/stats()//top so
         #: long-lived micro-batch streams are visible next to one-shot
         #: queries
-        self._streams_lock = threading.Lock()
+        self._streams_lock = ordered_lock("service.scheduler.streams")
         self._streams: Dict[str, object] = {}
         self._mvs = None
 
-        self._cond = threading.Condition()
+        self._cond = ordered_condition("service.scheduler.cond")
         #: (pool, tenant) -> FIFO of queued handles
         self._queues: Dict[Tuple[str, str], deque] = {}
         #: per-pool queued-handle count (admission bound)

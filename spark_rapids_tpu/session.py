@@ -599,6 +599,10 @@ class TpuSession:
         # the device memory arbiter's hard budget follows it too
         from spark_rapids_tpu.runtime import memory as _memory
         _memory.MEMORY.configure(self.conf)
+        # runtime lock witness (construction-time election — locks
+        # built after this point are wrapped iff the conf arms it)
+        from spark_rapids_tpu import lockorder as _lockorder
+        _lockorder.configure(self.conf)
         rf_enabled = bool(self.conf.get_entry(RUNTIME_FALLBACK_ENABLED))
         max_failures = int(self.conf.get_entry(RUNTIME_FALLBACK_MAX_FAILURES))
         # enough budget to demote every op in a pathological plan without

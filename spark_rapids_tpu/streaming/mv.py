@@ -43,6 +43,7 @@ from spark_rapids_tpu.plan.fingerprint import (
     unregister_epoch_listener,
 )
 from spark_rapids_tpu.streaming.metrics import STREAM_METRICS
+from spark_rapids_tpu.lockorder import ordered_lock
 
 __all__ = ["MaterializedView", "MaterializedViewRegistry"]
 
@@ -79,7 +80,7 @@ class MaterializedView:
             raise ColumnarProcessingError(
                 f"materialized view {name!r} reads no Delta table; "
                 "register a plan with at least one Delta scan")
-        self._refresh_lock = threading.Lock()
+        self._refresh_lock = ordered_lock("streaming.mv.refresh")
         self._stale = threading.Event()
         self._stale.set()
         self.table: Optional[HostTable] = None
@@ -376,7 +377,7 @@ class MaterializedViewRegistry:
 
     def __init__(self, session):
         self.session = session
-        self._lock = threading.Lock()
+        self._lock = ordered_lock("streaming.mv.registry")
         self._views: Dict[str, MaterializedView] = {}
         register_epoch_listener(self._on_epoch)
         self._closed = False

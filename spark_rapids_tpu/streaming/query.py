@@ -24,6 +24,7 @@ from spark_rapids_tpu.streaming.metrics import STREAM_METRICS
 from spark_rapids_tpu.streaming.offsets import OffsetLog
 from spark_rapids_tpu.streaming.sink import DeltaStreamSink
 from spark_rapids_tpu.streaming.source import StreamingSource
+from spark_rapids_tpu.lockorder import ordered_lock
 
 __all__ = ["StreamingQuery"]
 
@@ -58,7 +59,7 @@ class StreamingQuery:
         self.offsets = OffsetLog(checkpoint_dir)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        self._lock = threading.Lock()
+        self._lock = ordered_lock("streaming.query")
         self._state = "INITIALIZED"
         self._error: Optional[BaseException] = None
         self._batches_run = 0

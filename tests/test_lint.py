@@ -103,6 +103,7 @@ def test_committed_docs_are_byte_identical_to_generators():
 
     import spark_rapids_tpu
     from spark_rapids_tpu.conf import generate_docs
+    from spark_rapids_tpu.lockorder import generate_locks_md
     from spark_rapids_tpu.overrides.docs import generate_supported_ops
     root = os.path.dirname(os.path.dirname(
         os.path.abspath(spark_rapids_tpu.__file__)))
@@ -110,6 +111,8 @@ def test_committed_docs_are_byte_identical_to_generators():
         assert f.read() == generate_supported_ops()
     with open(os.path.join(root, "CONFIGS.md")) as f:
         assert f.read() == generate_docs()
+    with open(os.path.join(root, "LOCKS.md")) as f:
+        assert f.read() == generate_locks_md()
 
 
 def test_cli_lists_every_rule(capsys):
@@ -423,6 +426,7 @@ def test_ra_essential_metrics_missing():
 def test_ra_doc_drift(tmp_path):
     from spark_rapids_tpu.lint.registry_audit import _audit_doc_drift
     (tmp_path / "SUPPORTED_OPS.md").write_text("stale\n")
+    (tmp_path / "LOCKS.md").write_text("stale\n")
     # CONFIGS.md missing entirely
     diags = []
     _audit_doc_drift(diags, str(tmp_path))
@@ -430,6 +434,8 @@ def test_ra_doc_drift(tmp_path):
                and "differs from the generator" in d.message for d in diags)
     assert any(d.rule_id == "RA-DOC-DRIFT-CONFIGS"
                and "missing" in d.message for d in diags)
+    assert any(d.rule_id == "RA-DOC-DRIFT-LOCKS"
+               and "differs from the generator" in d.message for d in diags)
 
 
 # ---------------------------------------------------------------------------
@@ -927,6 +933,343 @@ def test_rl_mv_epoch():
     # the cache and mutates it legitimately)
     assert _run_rl(_check_mv_epoch,
                    "spark_rapids_tpu/service/scheduler.py", src) == []
+
+
+# ---------------------------------------------------------------------------
+# concurrency contracts (ISSUE 17): RL-LOCK-DECL / RL-LOCK-ORDER /
+# RL-LOCK-EFFECT negatives over synthetic registries, plus the runtime
+# lock witness
+# ---------------------------------------------------------------------------
+
+
+def _cc_registry(*decls):
+    """Synthetic LOCK_ORDER: (name, rank, site, kind) tuples."""
+    from spark_rapids_tpu.lockorder import LockDecl
+    return {name: LockDecl(name, rank, site, kind, "test lock")
+            for name, rank, site, kind in decls}
+
+
+def _run_cc(files, registry, order_allow=None, effect_allow=None):
+    from spark_rapids_tpu.lint.concurrency import check_concurrency
+    diags = []
+    check_concurrency({rel: ast.parse(src) for rel, src in files.items()},
+                      diags, registry=registry,
+                      order_allow=order_allow or {},
+                      effect_allow=effect_allow or {})
+    return diags
+
+
+#: one in-scope module + two declared locks, A(10) under B(20) — the
+#: shared fixture most order/effect sub-cases build on
+_CC_REL = "spark_rapids_tpu/runtime/cc_mod.py"
+_CC_TWO = (("t.a", 10, f"{_CC_REL}:A", "Lock"),
+           ("t.b", 20, f"{_CC_REL}:B", "Lock"))
+_CC_HDR = ("from spark_rapids_tpu.lockorder import ordered_lock\n"
+           'A = ordered_lock("t.a")\n'
+           'B = ordered_lock("t.b")\n')
+
+
+def test_rl_lock_decl_raw_construction():
+    """An undeclared raw threading primitive in a concurrent package is
+    the core RL-LOCK-DECL negative (and the witness smoke's seed)."""
+    src = "import threading\nL = threading.Lock()\n"
+    hits = _find(_run_cc({"spark_rapids_tpu/runtime/bad.py": src},
+                         _cc_registry()), "RL-LOCK-DECL")
+    assert len(hits) == 1 and "raw threading.Lock()" in hits[0].message
+    assert hits[0].path == "spark_rapids_tpu/runtime/bad.py:2"
+    # the from-import alias spelling cannot slip past
+    alias = "from threading import RLock as RL\nx = RL()\n"
+    ahits = _find(_run_cc({"spark_rapids_tpu/obs/bad.py": alias},
+                          _cc_registry()), "RL-LOCK-DECL")
+    assert len(ahits) == 1 and "raw RL()" in ahits[0].message
+    # outside the concurrency-scoped packages the rule does not apply
+    assert _run_cc({"spark_rapids_tpu/plan/fine.py": src},
+                   _cc_registry()) == []
+
+
+def test_rl_lock_decl_factory_contract():
+    reg = _cc_registry(("t.a", 10, _CC_REL + ":A", "Lock"))
+    head = "from spark_rapids_tpu.lockorder import ordered_lock\n"
+    # undeclared name
+    hits = _find(_run_cc({_CC_REL: head + 'X = ordered_lock("nope")\n'},
+                         reg), "RL-LOCK-DECL")
+    assert any("not declared" in d.message for d in hits)
+    # non-literal name defeats the audit
+    hits = _find(_run_cc({_CC_REL: head + "X = ordered_lock(name)\n"},
+                         reg), "RL-LOCK-DECL")
+    assert any("string literal" in d.message for d in hits)
+    # declared site and construction site must agree
+    hits = _find(_run_cc({_CC_REL: head + 'WRONG = ordered_lock("t.a")\n'},
+                         reg), "RL-LOCK-DECL")
+    assert any("one declared construction site" in d.message for d in hits)
+    # declared kind and factory must agree
+    rl = ("from spark_rapids_tpu.lockorder import ordered_rlock\n"
+          'A = ordered_rlock("t.a")\n')
+    hits = _find(_run_cc({_CC_REL: rl}, reg), "RL-LOCK-DECL")
+    assert any("declared as Lock but constructed" in d.message
+               for d in hits)
+    # a declared lock never constructed at its site = stale entry
+    hits = _find(_run_cc({_CC_REL: "x = 1\n"}, reg), "RL-LOCK-DECL")
+    assert any("stale registry entry" in d.message for d in hits)
+    # ...and the clean construction is exactly zero findings
+    assert _run_cc({_CC_REL: head + 'A = ordered_lock("t.a")\n'},
+                   reg) == []
+
+
+def test_rl_lock_order_with_nesting():
+    reg = _cc_registry(*_CC_TWO)
+    # ascending ranks: clean
+    ok = _CC_HDR + "def f():\n    with A:\n        with B:\n            pass\n"
+    assert _run_cc({_CC_REL: ok}, reg) == []
+    # descending ranks: the inversion finding
+    bad = _CC_HDR + "def f():\n    with B:\n        with A:\n            pass\n"
+    hits = _find(_run_cc({_CC_REL: bad}, reg), "RL-LOCK-ORDER")
+    assert any("'t.a' (rank 10) while holding 't.b' (rank 20)"
+               in d.message for d in hits)
+    # try-acquire is the sanctioned out-of-order shape
+    tryacq = (_CC_HDR + "def f():\n    with B:\n"
+              "        if A.acquire(blocking=False):\n"
+              "            A.release()\n")
+    assert _run_cc({_CC_REL: tryacq}, reg) == []
+    # the allowlist hook suppresses a justified site (RL-MESH-HOST shape)
+    assert _run_cc({_CC_REL: bad}, reg,
+                   order_allow={f"{_CC_REL}:f": "test justification"}) == []
+
+
+def test_rl_lock_order_through_call_graph():
+    """The inversion two frames deep: f holds B and calls g, which
+    blocking-acquires A — the bounded call-graph closure reports it at
+    f's call site with the `via` evidence."""
+    reg = _cc_registry(*_CC_TWO)
+    src = (_CC_HDR
+           + "def g():\n    with A:\n        pass\n"
+           + "def f():\n    with B:\n        g()\n")
+    hits = _find(_run_cc({_CC_REL: src}, reg), "RL-LOCK-ORDER")
+    assert any("via g()" in d.message for d in hits)
+
+
+def test_rl_lock_order_cycle_defeats_allowlist():
+    """f ascends A->B (clean); g's B->A inversion is allowlisted — but
+    the two edges compose into a deadlock cycle, which is reported
+    regardless of allowlisting."""
+    reg = _cc_registry(*_CC_TWO)
+    src = (_CC_HDR
+           + "def f():\n    with A:\n        with B:\n            pass\n"
+           + "def g():\n    with B:\n        with A:\n            pass\n")
+    diags = _run_cc({_CC_REL: src}, reg,
+                    order_allow={f"{_CC_REL}:g": "test justification"})
+    hits = _find(diags, "RL-LOCK-ORDER")
+    assert any(d.path == "lockorder:cycle"
+               and "allowlisting cannot suppress" in d.message
+               for d in hits)
+    # the allowlisted LOCAL finding stayed suppressed: only the cycle
+    assert len(hits) == 1
+
+
+def test_rl_lock_effect():
+    reg = _cc_registry(*_CC_TWO)
+    src = (_CC_HDR
+           + "import subprocess\n"
+           + "from spark_rapids_tpu.runtime.faults import fault_point\n"
+           + "def f():\n    with A:\n"
+           + "        subprocess.run(['x'])\n"
+           + "        fault_point('t.point')\n")
+    hits = _find(_run_cc({_CC_REL: src}, reg), "RL-LOCK-EFFECT")
+    msgs = " | ".join(d.message for d in hits)
+    assert "subprocess.run()" in msgs
+    assert "fault_point() raise site" in msgs
+    assert all("holding lock 't.a'" in d.message for d in hits)
+    # the allowlist hook keys on the HOLDER function
+    assert _run_cc({_CC_REL: src}, reg,
+                   effect_allow={f"{_CC_REL}:f": "test justification"}) == []
+
+
+def test_rl_lock_effect_condition_wait():
+    """Waiting on a Condition while holding a DIFFERENT lock is a
+    finding; waiting on the condition you hold is how conditions
+    work."""
+    rel = _CC_REL
+    reg = _cc_registry(("t.a", 10, f"{rel}:A", "Lock"),
+                       ("t.cv", 20, f"{rel}:CV", "Condition"))
+    head = ("from spark_rapids_tpu.lockorder import ordered_lock, "
+            "ordered_condition\n"
+            'A = ordered_lock("t.a")\n'
+            'CV = ordered_condition("t.cv")\n')
+    bad = head + ("def f():\n    with A:\n        with CV:\n"
+                  "            CV.wait()\n")
+    hits = _find(_run_cc({rel: bad}, reg), "RL-LOCK-EFFECT")
+    assert any("wait on Condition 't.cv'" in d.message
+               and "'t.a'" in d.message for d in hits)
+    ok = head + "def f():\n    with CV:\n        CV.wait()\n"
+    assert _run_cc({rel: ok}, reg) == []
+
+
+def test_lock_witness_rank_inversion_raises():
+    """The armed witness turns a would-be deadlock interleaving into a
+    typed LockOrderViolation carrying the held chain."""
+    from spark_rapids_tpu import lockorder
+    lockorder.arm_witness()
+    try:
+        low = lockorder.ordered_lock("streaming.query")     # rank 100
+        high = lockorder.ordered_lock("memory.arbiter")     # rank 740
+        # ascending is silent, and the held snapshot tracks it
+        with low:
+            with high:
+                assert lockorder.held_snapshot() == [
+                    "streaming.query", "memory.arbiter"]
+        assert lockorder.held_snapshot() == []
+        # descending raises BEFORE touching the inner lock
+        with high:
+            with pytest.raises(lockorder.LockOrderViolation) as ei:
+                low.acquire()
+            assert "held chain" in str(ei.value)
+            assert "memory.arbiter" in str(ei.value)
+            # try-acquire stays exempt at runtime too
+            assert low.acquire(blocking=False)
+            low.release()
+        assert lockorder.held_snapshot() == []
+        # re-acquiring a held non-reentrant lock = self-deadlock
+        with pytest.raises(lockorder.LockOrderViolation,
+                           match="self-deadlock"):
+            with low:
+                low.acquire()
+    finally:
+        lockorder.disarm_witness()
+
+
+def test_lock_witness_condition_wait_releases():
+    from spark_rapids_tpu import lockorder
+    lockorder.arm_witness()
+    try:
+        cv = lockorder.ordered_condition("service.scheduler.cond")
+        with cv:
+            assert lockorder.held_snapshot() == ["service.scheduler.cond"]
+            cv.wait(timeout=0.01)
+            # wait() re-acquired: the held stack is restored
+            assert lockorder.held_snapshot() == ["service.scheduler.cond"]
+        assert lockorder.held_snapshot() == []
+    finally:
+        lockorder.disarm_witness()
+
+
+def test_lock_witness_construction_time_election():
+    """configure() arms from conf; disarmed factories hand back RAW
+    primitives (zero steady-state overhead), armed ones the witness
+    wrappers — elected at construction, not per-acquire."""
+    from spark_rapids_tpu import lockorder
+    try:
+        lockorder.configure(RapidsConf(
+            {"spark.rapids.lint.lockWitness": "true"}))
+        assert lockorder.witness_armed()
+        wrapped = lockorder.ordered_lock("streaming.query")
+        assert "witnessed" in repr(wrapped)
+        lockorder.configure(RapidsConf())
+        assert not lockorder.witness_armed()
+        raw = lockorder.ordered_lock("streaming.query")
+        assert not hasattr(raw, "_decl")
+        # a pre-arming lock stays raw even while the witness is armed
+        lockorder.arm_witness()
+        with raw:
+            pass
+        # undeclared names fail fast regardless of arming
+        with pytest.raises(lockorder.LockDeclError, match="not declared"):
+            lockorder.ordered_lock("no.such.lock")
+        with pytest.raises(lockorder.LockDeclError, match="declared as"):
+            lockorder.ordered_rlock("streaming.query")
+    finally:
+        lockorder.disarm_witness()
+
+
+def test_lock_registry_known_suspects_ranked():
+    """ISSUE 17 satellite: the sites previous PRs fixed by hand are now
+    pinned by rank so the ordering cannot silently regress."""
+    from spark_rapids_tpu.lockorder import LOCK_ORDER, LOCK_WITNESS
+    r = {n: d.rank for n, d in LOCK_ORDER.items()}
+    # scheduler condition is acquired before the per-query handle lock
+    assert r["service.scheduler.cond"] < r["service.handle"]
+    # arbiter work happens UNDER a SpillableBatch lock (account/spill)
+    assert r["spill.batch"] < r["memory.arbiter"]
+    # catalog singleton access sits between batch and the catalog maps
+    assert r["spill.batch"] < r["spill.catalog.instance"] \
+        < r["spill.catalog.registry"]
+    # telemetry/observability rings are leaf locks: above every
+    # runtime/service lock they are reached from
+    assert r["obs.telemetry.ring"] > r["memory.arbiter"]
+    assert r["obs.telemetry.ring"] > r["service.scheduler.cond"]
+    # fault registry is consulted from inside every subsystem
+    assert r["faults.registry"] > r["memory.arbiter"]
+    # ranks form a total order (no ties to hide behind)
+    assert len(set(r.values())) == len(r)
+    assert LOCK_WITNESS.key == "spark.rapids.lint.lockWitness"
+
+
+@pytest.mark.chaos
+def test_lock_witness_chaos_service_scenario():
+    """Tier-1 chaos pin: a concurrent service run under memory pressure
+    (admission, scheduler condition, result cache, arbiter, telemetry)
+    completes with the witness armed — every blocking acquisition on
+    every thread respected LOCK_ORDER, or this raises
+    LockOrderViolation."""
+    from spark_rapids_tpu import lockorder
+    from spark_rapids_tpu.ops.expr import lit
+    from spark_rapids_tpu.service import QueryService
+    conf = {
+        "spark.rapids.lint.lockWitness": "true",
+        # a small device budget forces arbiter/spill traffic under load
+        "spark.rapids.memory.device.budgetBytes": str(256 * 1024),
+    }
+    try:
+        with QueryService(conf, max_concurrent=3) as svc:
+            data = {"k": np.array(["a", "b", "c", "d"] * 60, dtype=object),
+                    "v": np.arange(240, dtype=np.int64)}
+            df = svc.session.create_dataframe(data, num_batches=6)
+            handles = [
+                svc.submit(df.filter(col("v") >= lit(i))
+                           .group_by("k").agg(F.sum("v").alias("sv")))
+                for i in range(8)]
+            for h in handles:
+                assert h.wait(timeout=60)
+            assert all(h.result() is not None for h in handles)
+        assert lockorder.held_snapshot() == []
+    finally:
+        lockorder.disarm_witness()
+
+
+def test_cli_json_smoke(tmp_path):
+    """Satellite: the --json contract in a real subprocess, within the
+    5s budget — a clean all-skip run exits 0, and a tiny synthetic tree
+    with an undeclared lock exits 1 with machine-readable diagnostics."""
+    import json
+    import subprocess
+    import sys
+    import time
+    t0 = time.monotonic()
+    clean = subprocess.run(
+        [sys.executable, "-m", "spark_rapids_tpu.lint", "--json",
+         "--skip-repo", "--skip-registry", "--skip-plans",
+         "--skip-exec-metrics"],
+        capture_output=True, text=True)
+    assert clean.returncode == 0, clean.stderr
+    out = json.loads(clean.stdout)
+    assert out == {"phases": {}, "diagnostics": [], "ok": True}
+
+    bad = tmp_path / "spark_rapids_tpu" / "runtime"
+    bad.mkdir(parents=True)
+    (bad / "bad.py").write_text("import threading\nL = threading.Lock()\n")
+    failing = subprocess.run(
+        [sys.executable, "-m", "spark_rapids_tpu.lint", "--json",
+         "--repo-root", str(tmp_path), "--skip-registry",
+         "--skip-plans", "--skip-exec-metrics"],
+        capture_output=True, text=True)
+    assert failing.returncode == 1, failing.stderr
+    out = json.loads(failing.stdout)
+    assert out["ok"] is False and out["phases"]["repo"] >= 1
+    assert any(
+        d["rule_id"] == "RL-LOCK-DECL"
+        and d["path"] == "spark_rapids_tpu/runtime/bad.py:2"
+        and d["severity"] == "error"
+        for d in out["diagnostics"])
+    assert time.monotonic() - t0 < 5.0, "CLI smoke blew the 5s budget"
 
 
 def test_every_rule_has_a_negative_test():
