@@ -569,6 +569,10 @@ def chaos_conf(seed: int, faults: bool, service_faults: bool = False,
         "spark.rapids.shuffle.fetch.retryWaitMs": "1",
         "spark.rapids.shuffle.fetch.maxRetries": "3",
         "spark.rapids.sql.runtimeFallback.enabled": "true",
+        # every chaos closure runs with the lock witness armed: a rank
+        # inversion under fault pressure fails the run (the committed
+        # artifact records the violation count in-band)
+        "spark.rapids.lint.lockWitness": "true",
     }
     if faults:
         spec = chaos_fault_spec(seed)
@@ -580,6 +584,24 @@ def chaos_conf(seed: int, faults: bool, service_faults: bool = False,
             conf.update(service_chaos_settings(concurrency))
         conf["spark.rapids.test.faults"] = spec
     return conf
+
+
+def _record_lock_witness(report: dict, failures: list) -> None:
+    """Record the runtime lock witness verdict in-band in a chaos
+    artifact. Every chaos closure arms ``spark.rapids.lint.lockWitness``
+    in its session conf, so locks constructed for the run are
+    rank-checked at every blocking acquire; a nonzero count here is a
+    rank inversion OBSERVED under fault pressure — a run failure the
+    committed artifact must carry as evidence, not a warning."""
+    from spark_rapids_tpu import lockorder
+    n = int(lockorder.witness_violations())
+    report["lockWitnessViolations"] = n
+    report["lockWitnessArmed"] = lockorder.witness_armed()
+    if n:
+        report["lockWitnessRecords"] = (
+            lockorder.witness_violation_records())
+        failures.append(
+            f"lock witness observed {n} rank inversion(s) during the run")
 
 
 def tables_differ(a, b):
@@ -938,6 +960,7 @@ def run_chaos(sf: float = 0.02, seed: int = 7, queries=None,
         report["queries"][name] = entry
         print(json.dumps({"query": name, **entry}))
     report["demoted_ops"] = CIRCUIT_BREAKER.demoted_ops()
+    _record_lock_witness(report, failures)
     report["ok"] = not failures
     report["failures"] = failures
     FAULTS.disarm()
@@ -1080,6 +1103,7 @@ def _run_chaos_concurrent(report, failures, wanted, expected_tables,
     elif stats["cancelled"] or stats["timed_out"] or stats["rejected"]:
         failures.append(f"spurious lifecycle events: {stats}")
     report["demoted_ops"] = CIRCUIT_BREAKER.demoted_ops()
+    _record_lock_witness(report, failures)
     report["ok"] = not failures
     report["failures"] = failures
     FAULTS.disarm()
@@ -1218,6 +1242,7 @@ def run_memory_chaos(sf: float, seed: int, budget: int, queries=None,
         "spark.rapids.memory.device.scanChunkFraction":
             str(chunk_fraction),
         "spark.rapids.sql.runtimeFallback.enabled": "true",
+        "spark.rapids.lint.lockWitness": "true",
         "spark.rapids.test.faults": spec,
         "spark.rapids.obs.telemetry.enabled": "true",
         "spark.rapids.obs.telemetry.intervalMs": "200",
@@ -1480,6 +1505,7 @@ def run_memory_chaos(sf: float, seed: int, budget: int, queries=None,
             "spark.rapids.memory.device.scanChunkFraction":
                 str(chunk_fraction),
             "spark.rapids.sql.runtimeFallback.enabled": "true",
+            "spark.rapids.lint.lockWitness": "true",
             "spark.rapids.test.faults":
                 f"mem.reserve:oom:10:{seed * 10 + 9}",
             "spark.rapids.obs.flightRecorder.dir": flight_dir,
@@ -1552,6 +1578,7 @@ def run_memory_chaos(sf: float, seed: int, budget: int, queries=None,
         "spark.rapids.memory.device.scanChunkFraction":
             str(chunk_fraction),
         "spark.rapids.service.maxConcurrentQueries": "2",
+        "spark.rapids.lint.lockWitness": "true",
     })
     try:
         svc_probe = wanted[0]
@@ -1582,6 +1609,7 @@ def run_memory_chaos(sf: float, seed: int, budget: int, queries=None,
 
     report["demoted_ops"] = CIRCUIT_BREAKER.demoted_ops()
     report["health_state"] = HEALTH.state()
+    _record_lock_witness(report, failures)
     report["ok"] = not failures
     report["failures"] = failures
     FAULTS.disarm()
@@ -1658,6 +1686,7 @@ def run_mesh_chaos(sf: float, seed: int, ndev: int, queries=None,
         "spark.rapids.mesh.enabled": "true",
         "spark.rapids.mesh.shape": shape or str(ndev),
         "spark.rapids.sql.runtimeFallback.enabled": "true",
+        "spark.rapids.lint.lockWitness": "true",
         "spark.rapids.test.faults": spec,
         "spark.rapids.obs.telemetry.enabled": "true",
         "spark.rapids.obs.telemetry.intervalMs": "200",
@@ -1821,6 +1850,7 @@ def run_mesh_chaos(sf: float, seed: int, ndev: int, queries=None,
 
     report["demoted_ops"] = CIRCUIT_BREAKER.demoted_ops()
     report["health_state"] = HEALTH.state()
+    _record_lock_witness(report, failures)
     report["ok"] = not failures
     report["failures"] = failures
     FAULTS.disarm()
@@ -2130,6 +2160,7 @@ def run_hosts(sf: float, seed: int, nhosts: int, queries=None,
         }
         if spec:
             conf["spark.rapids.test.faults"] = spec
+            conf["spark.rapids.lint.lockWitness"] = "true"
             report["fault_spec"] = spec
         clus = TpuSession(conf)
         build = build_sql_queries if use_sql else build_queries
@@ -2409,10 +2440,870 @@ def run_hosts(sf: float, seed: int, nhosts: int, queries=None,
     finally:
         FAULTS.disarm()
         _teardown_cluster(driver, executors)
+    _record_lock_witness(report, failures)
     report["ok"] = not failures
     report["failures"] = failures
     if failures:
         err = AssertionError("hosts run failed:\n" + "\n".join(failures))
+        err.report = report
+        raise err
+    return report
+
+
+# ---------------------------------------------------------------------------
+# Fleet closure: composable chaos planes through the QueryService-as-
+# cluster-driver — multi-host serving under combined fault domains
+# ---------------------------------------------------------------------------
+
+
+#: scheduling pools for the fleet run: two weights so DEGRADED-mode
+#: shedding has a lowest-weight pool to push back on while the
+#: interactive pool keeps serving (scheduler.py's shed contract)
+FLEET_POOLS = "interactive:weight=2;batch:weight=1"
+
+#: fault-POINT prefix -> fault domain, for the per-domain fleet
+#: closure asserts. Distinct from obs.telemetry.fault_domain (which
+#: classifies incident KINDS like "memory.ladder"): injection points
+#: spell memory "mem.*", and the service plane's points spread over
+#: the service./device./dispatch. prefixes (all -> "service").
+_FLEET_POINT_DOMAINS = (("host.", "host"), ("mesh.", "mesh"),
+                        ("mem.", "memory"), ("stream.", "stream"))
+
+
+def _fleet_point_domain(point: str) -> str:
+    for prefix, domain in _FLEET_POINT_DOMAINS:
+        if point.startswith(prefix):
+            return domain
+    return "service"
+
+
+def fleet_planes(seed: int) -> dict:
+    """The composable chaos PLANES: each contributes fault points,
+    recovery-work ceilings and the HEALTH ladder counter its injected
+    losses bump, all merged into ONE seeded cross-domain schedule —
+    planes COMPOSE instead of the older mutually-exclusive chaos
+    modes. COUNT-based entries only (run_hosts's discipline): total
+    disruption is deterministic regardless of corpus size, and the
+    end-of-run restore probes run fault-free once the schedule is
+    spent. Seed offsets are disjoint per plane so composing planes
+    never aliases two RNG streams."""
+    from spark_rapids_tpu.tools.loadtest import (
+        SERVICE_CHAOS_BOUNDS,
+        service_chaos_spec,
+    )
+    return {
+        "host": {
+            "spec": ";".join([
+                f"host.dispatch:crash:1:{seed * 100 + 11}",
+                f"host.shard.land:corrupt:1:{seed * 100 + 12}",
+                f"host.dispatch:device_lost:2:{seed * 100 + 13}",
+            ]),
+            "bounds": {"query_replays": 30, "hostShardRetries": 20,
+                       "hostsLost": 10, "fetch_retries": 100},
+            "ladder_counter": "hostsLost",
+            "description": "executor-host faults: dispatch crash "
+                           "(query replay), corrupt shard landing "
+                           "(CRC re-land), injected host losses "
+                           "walking the host ladder; the scripted "
+                           "SIGKILL + rejoin rides on top",
+        },
+        "mesh": {
+            "spec": ";".join([
+                f"mesh.gather:corrupt:1:{seed * 100 + 21}",
+                f"mesh.gather:device_lost:2:{seed * 100 + 22}",
+            ]),
+            "bounds": {"query_replays": 30, "shardRetries": 40,
+                       "gatherChecksFailed": 40, "fetch_retries": 100},
+            "ladder_counter": "meshDeviceLost",
+            "description": "mesh-device faults: checksummed-gather "
+                           "corruption (re-fetch) and partial device "
+                           "losses walking the mesh ladder",
+        },
+        "memory": {
+            "spec": ";".join([
+                f"mem.reserve:oom:12:{seed * 100 + 31}",
+                f"mem.spill:crash:1:{seed * 100 + 32}",
+            ]),
+            "bounds": {"query_replays": 30, "oomRetries": 4000,
+                       "splitRetries": 200, "budgetRaises": 2000},
+            "ladder_counter": "memoryPressureEvents",
+            "description": "arbiter pressure under the hard device "
+                           "budget: sustained reservation refusals "
+                           "(retry -> chunk -> cpu_demote) and a "
+                           "spill-path crash",
+        },
+        "service": {
+            "spec": service_chaos_spec(seed),
+            "bounds": dict(SERVICE_CHAOS_BOUNDS),
+            "ladder_counter": "deviceLost",
+            "description": "service-level survivability: worker "
+                           "crashes, device losses (backend ladder), "
+                           "one wedged dispatch the watchdog must "
+                           "hard-time-out",
+        },
+        "exec": {
+            "spec": f"exec.execute:crash:1:{seed * 100 + 41}",
+            "bounds": {"query_replays": 30},
+            "ladder_counter": None,
+            "description": "the seeded kernel/exec schedule: one "
+                           "executor crash absorbed by query replay",
+        },
+    }
+
+
+def fleet_fault_spec(seed: int) -> str:
+    """The merged cross-domain schedule: every plane's points in one
+    ``spark.rapids.test.faults`` string."""
+    return ";".join(p["spec"] for p in fleet_planes(seed).values())
+
+
+def fleet_bounds(planes: dict) -> dict:
+    """Merged recovery-work ceilings: when two planes bound the same
+    counter, the LOOSEST wins — each plane's bound was calibrated for
+    its own schedule alone and the merged schedule fires them all."""
+    merged = {}
+    for plane in planes.values():
+        for field, bound in plane["bounds"].items():
+            merged[field] = max(bound, merged.get(field, 0))
+    return merged
+
+
+def fleet_plan(nhosts: int, seed: int, tenants: int = 2,
+               concurrency: int = 2, budget: int = 0,
+               sf: float = 0.02, queries=None) -> dict:
+    """The --fleet run plan as a JSON document (what ``--dry-run``
+    prints after validating the merged schedule parses): planes,
+    merged spec + bounds, topology and tenancy — everything the run
+    will arm, with no backend initialization."""
+    planes = fleet_planes(seed)
+    return {
+        "mode": "fleet-plan",
+        "hosts": nhosts,
+        "tenants": tenants,
+        "pools": FLEET_POOLS,
+        "concurrency": concurrency,
+        "scale_factor": sf,
+        "seed": seed,
+        "device_budget_bytes": (int(budget) if budget else
+                                "auto: 0.6 x measured working-set "
+                                "peak"),
+        "queries": list(queries) if queries else "q1-q22",
+        "planes": {name: {"fault_spec": p["spec"],
+                          "bounds": p["bounds"],
+                          "ladder_counter": p["ladder_counter"],
+                          "description": p["description"]}
+                   for name, p in planes.items()},
+        "merged_fault_spec": fleet_fault_spec(seed),
+        "merged_bounds": fleet_bounds(planes),
+        "scripted": {
+            "sigkill": "one executor host SIGKILLed mid-run, "
+                       "respawned two submissions later; the missed-"
+                       "beat sweep must declare it lost and the "
+                       "rejoin must restore full strength",
+            "wedge_stall_env": "SRT_WEDGE_SLEEP_S armed for the "
+                               "service plane's wedged dispatch",
+        },
+    }
+
+
+def run_fleet(sf: float, seed: int, nhosts: int, tenants: int = 2,
+              concurrency: int = 2, budget: int = 0, queries=None,
+              use_sql: bool = False, timeout_s: float = 300.0):
+    """``--fleet``: the fleet closure (FLEET_r01) — N executor hosts x
+    concurrent tenant pools x a hard device budget x the merged
+    cross-domain fault schedule, served through a QueryService that IS
+    the cluster driver (scheduler.py configures the shared topology;
+    DEGRADED/shedding decisions consult live host strength and arbiter
+    occupancy). One run, every plane: a scripted SIGKILL + rejoin,
+    injected host/mesh device losses, sustained memory pressure under
+    the budget, worker crash / device loss / wedged dispatch.
+
+    Asserts: every submission reaches a terminal state (zero hangs),
+    every FINISHED result bit-identical to the fault-free twin (the
+    shape baseline at the budget's chunk share; demoted-baseline and
+    row-multiset escalation recorded per query), at least one fault
+    fired in each of the host/mesh/memory/service domains, per-tenant
+    p95 SLOs served from the live ``/slo`` endpoint, one incident
+    bundle per tripped ladder action (matched by seq id + faultDomain,
+    seq ids unique), recovery within the merged bounds, ZERO lock
+    witness violations, and the service back to HEALTHY at the end."""
+    _ensure_host_mesh(8)
+    import os
+    import tempfile
+    import urllib.request
+
+    import jax
+
+    from spark_rapids_tpu.columnar.table import evict_device_caches
+    from spark_rapids_tpu.datagen import scale_test_specs
+    from spark_rapids_tpu.errors import (
+        QueryQuarantinedError,
+        QueryRejectedError,
+    )
+    from spark_rapids_tpu.obs.metrics import scopes_snapshot
+    from spark_rapids_tpu.parallel.mesh import MESH
+    from spark_rapids_tpu.runtime.cluster import CLUSTER, spawn_executor
+    from spark_rapids_tpu.runtime.faults import (
+        CIRCUIT_BREAKER,
+        FAULTS,
+        RECOVERY,
+    )
+    from spark_rapids_tpu.runtime.health import HEALTH
+    from spark_rapids_tpu.runtime.memory import MEMORY, forced_chunking
+    from spark_rapids_tpu.runtime.spill import BufferCatalog
+    from spark_rapids_tpu.service.scheduler import QueryService
+    from spark_rapids_tpu.session import TpuSession
+    from spark_rapids_tpu.tools.incident import load_bundles
+    from spark_rapids_tpu.tools.loadtest import (
+        _CHAOS_TYPED_ERRORS,
+        drive_health_probes,
+        service_chaos_settings,
+        wedge_stall_env,
+    )
+
+    ndev = len(jax.devices())
+    if ndev % nhosts:
+        raise SystemExit(
+            f"--fleet with --hosts {nhosts} must divide the "
+            f"{ndev}-device pool so every host owns an equal dcn row")
+    shape = f"{nhosts}x{ndev // nhosts}"
+
+    planes = fleet_planes(seed)
+    spec = fleet_fault_spec(seed)
+    bounds = fleet_bounds(planes)
+
+    specs = scale_test_specs(sf)
+    tables = {name: s.generate_table(sf, seed=seed)
+              for name, s in specs.items()}
+    base = tempfile.mkdtemp(prefix="rapids_fleet_")
+    paths = write_host_corpus(tables, base, files_per_table=2 * nhosts)
+    flight_dir = tempfile.mkdtemp(prefix="rapids_fleet_flightrec_")
+
+    build = build_sql_queries if use_sql else build_queries
+    report = {"mode": "fleet", "backend": _resolved_backend(),
+              "hosts": nhosts, "n_devices": ndev, "mesh_shape": shape,
+              "tenants": tenants, "pools": FLEET_POOLS,
+              "concurrency": concurrency,
+              "scale_factor": sf, "seed": seed, "sql": use_sql,
+              "fault_spec": spec,
+              "planes": {name: {"fault_spec": p["spec"],
+                                "bounds": p["bounds"]}
+                         for name, p in planes.items()},
+              "merged_bounds": bounds,
+              "flight_recorder_dir": flight_dir,
+              "queries": {}}
+    failures = []
+
+    driver, executors = _boot_cluster(nhosts)
+    BufferCatalog.reset()
+    MEMORY.reset()
+    try:
+        cluster_conf = {
+            "spark.rapids.cluster.enabled": "true",
+            "spark.rapids.cluster.hosts": str(nhosts),
+            "spark.rapids.cluster.heartbeatIntervalMs":
+                str(_HOSTS_HEARTBEAT_MS),
+            "spark.rapids.cluster.missedBeats":
+                str(_HOSTS_MISSED_BEATS),
+            "spark.rapids.mesh.enabled": "true",
+            "spark.rapids.mesh.shape": shape,
+            "spark.rapids.sql.runtimeFallback.enabled": "true",
+        }
+        # -- fault-free twin (cluster+mesh, UNBUDGETED): the expected
+        # results plus the measured working set the budget must sit
+        # below for the memory plane to prove anything ------------------
+        twin = TpuSession(dict(cluster_conf))
+        twin_queries = build(twin, tables, paths=paths)
+        wanted = queries or list(twin_queries)
+        # the collective-bearing query first (run_mesh_chaos's
+        # discipline): the mesh fault points must see gather traffic
+        # before the ladder may legitimately shrink the topology
+        wanted = sorted(wanted, key=lambda n: (n != "q7",
+                                               wanted.index(n)))
+        expected_plain = {name: twin_queries[name]().collect_table()
+                          for name in wanted}
+        working_set = MEMORY.snapshot()["peakBytes"]
+        report["working_set_peak_bytes"] = int(working_set)
+        if not budget:
+            budget = max(4096, int(working_set * 0.6))
+        report["device_budget_bytes"] = int(budget)
+        if budget >= working_set:
+            failures.append(
+                f"device budget {budget} is not below the measured "
+                f"unbudgeted working-set peak {working_set} — the "
+                "fleet run would prove nothing about memory pressure")
+        chunk_fraction = 0.1
+        chunk_share = max(1, int(budget * chunk_fraction))
+        report["chunk_share_bytes"] = chunk_share
+        # the SHAPE baseline (run_memory_chaos's discipline): forced
+        # chunking at the service's share, still unbudgeted — what a
+        # CPU-demoted storm run reproduces (demoted ops bypass the
+        # arbiter, so they never split)
+        expected_chunked = {}
+        with forced_chunking(chunk_share):
+            for name in wanted:
+                expected_chunked[name] = (
+                    twin_queries[name]().collect_table())
+        for name in wanted:
+            sem = tables_close(expected_plain[name],
+                               expected_chunked[name])
+            if sem is not None:
+                failures.append(f"{name}: chunked twin changed the "
+                                f"answer vs unchunked: {sem}")
+        evict_device_caches()
+        MEMORY.reset()
+        # the EXECUTION baseline: the service enforces this budget for
+        # real — reserve refusals split batches and the memory ladder's
+        # chunk rung may halve a share mid-collect, all deterministic
+        # for a serial run but structurally unlike ANY unbudgeted twin.
+        # Collect expected results through a session wearing the exact
+        # service memory conf so the recovered-fleet wave has a
+        # bit-identical reference (and a warm kernel cache: the wave's
+        # first on-device query must not pay whole-pipeline compiles
+        # inside its hard wall)
+        budgeted_twin = TpuSession(dict(
+            cluster_conf, **{
+                "spark.rapids.memory.device.budgetBytes":
+                    str(int(budget)),
+                "spark.rapids.memory.device.scanChunkFraction":
+                    str(chunk_fraction)}))
+        btwin_queries = build(budgeted_twin, tables, paths=paths)
+        expected_budgeted = {}
+        for name in wanted:
+            expected_budgeted[name] = (
+                btwin_queries[name]().collect_table())
+        for name in wanted:
+            sem = tables_close(expected_plain[name],
+                               expected_budgeted[name])
+            if sem is not None:
+                failures.append(f"{name}: budgeted twin changed the "
+                                f"answer vs unbudgeted: {sem}")
+        # walking the ladder during that collect is expected (the wave
+        # walks the same rungs) — but its demotions are the TWIN's, not
+        # the service's; record and clear them
+        report["budgeted_twin_ladder"] = HEALTH.memory_snapshot()
+        report["budgeted_twin_demoted_ops"] = (
+            CIRCUIT_BREAKER.demoted_ops())
+        CIRCUIT_BREAKER.reset()
+        # a fresh ledger + clean caches for the budgeted service phase
+        evict_device_caches()
+        MEMORY.reset()
+
+        # -- the service AS the cluster driver ---------------------------
+        svc_conf = dict(cluster_conf)
+        svc_conf.update({
+            "spark.rapids.memory.device.budgetBytes": str(int(budget)),
+            "spark.rapids.memory.device.scanChunkFraction":
+                str(chunk_fraction),
+            "spark.rapids.lint.lockWitness": "true",
+            # the closure verifies EXECUTION identity: a fingerprint
+            # cache hit would replay the storm's (possibly diverged)
+            # table straight back to the recovery wave and mask it
+            "spark.rapids.service.resultCache.enabled": "false",
+            "spark.rapids.service.pools": FLEET_POOLS,
+            "spark.rapids.service.maxConcurrentQueries":
+                str(concurrency),
+            "spark.rapids.service.queueDepth":
+                str(max(64, 2 * len(wanted) * tenants)),
+            "spark.rapids.service.introspect.enabled": "true",
+            "spark.rapids.service.introspect.port": "0",
+            "spark.rapids.obs.telemetry.enabled": "true",
+            "spark.rapids.obs.telemetry.intervalMs": "200",
+            "spark.rapids.obs.flightRecorder.dir": flight_dir,
+            "spark.rapids.test.faults": spec,
+        })
+        svc_conf.update(service_chaos_settings(concurrency))
+
+        recovery_before = RECOVERY.snapshot()
+        health_before = HEALTH.snapshot()
+        cluster_before = dict(scopes_snapshot().get("cluster", {}))
+        mesh_before = dict(scopes_snapshot().get("mesh", {}))
+        ladder_before = {
+            "host": HEALTH.host_snapshot()["hostsLost"],
+            "mesh": HEALTH.mesh_snapshot()["meshDeviceLost"],
+            "memory": HEALTH.memory_snapshot()["memoryPressureEvents"],
+            "service": health_before["deviceLost"],
+        }
+
+        pools_cycle = tuple(
+            p.split(":")[0] for p in FLEET_POOLS.split(";"))
+        subs = [(name, pools_cycle[(qi + ti) % len(pools_cycle)],
+                 f"tenant{ti}")
+                for ti in range(tenants)
+                for qi, name in enumerate(wanted)]
+        kill_at = len(subs) // 3 if len(subs) >= 6 else None
+        rejoin_at = kill_at + 2 if kill_at is not None else None
+        victim = f"h{nhosts - 1}"
+        kill_info = {}
+        shed_rejections = [0]
+        typed_outcomes = []
+        handles = []
+        hung = []
+        resubmit = []
+
+        def _submit_retry(name, pool, tenant, label):
+            """Submit with bounded retry across the DEGRADED shed
+            window: a QueryRejectedError is the scheduler pushing back
+            on the lowest-weight pool while the fleet is below
+            strength — live traffic retries after the hinted delay.
+            Quarantine refusals and a still-shed submission after the
+            retry budget are TYPED terminal outcomes, not hangs."""
+            for _ in range(20):
+                try:
+                    return svc.submit(svc_queries[name](),
+                                      tenant=tenant, pool=pool,
+                                      tag=label)
+                except QueryRejectedError as exc:
+                    shed_rejections[0] += 1
+                    delay = (getattr(exc, "retry_after_ms", None)
+                             or 250) / 1000.0
+                    time.sleep(min(1.0, max(0.05, delay)))
+                except QueryQuarantinedError as exc:
+                    typed_outcomes.append({
+                        "query": label, "state": "QUARANTINED",
+                        "error": f"{type(exc).__name__}: {exc}"})
+                    return None
+            typed_outcomes.append({
+                "query": label, "state": "REJECTED",
+                "error": "QueryRejectedError: still shed after the "
+                         "retry budget"})
+            return None
+
+        t0_run = time.perf_counter()
+        with wedge_stall_env():
+            svc = QueryService(svc_conf)
+            try:
+                svc_queries = build(svc.session, tables, paths=paths)
+                # arm BEFORE the first submit (run_streaming's
+                # discipline): per-query re-arms from the same conf
+                # string are no-ops, so the one-shot counters survive
+                FAULTS.arm(spec)
+                for si, (name, pool, tenant) in enumerate(subs):
+                    if si == kill_at:
+                        # scripted mid-run HOST KILL: a real SIGKILL
+                        # while the service is dispatching; the
+                        # missed-beat sweep must declare the host lost
+                        t0 = time.time()
+                        executors[victim].terminate()
+                        detected = _wait_for(
+                            lambda: victim in CLUSTER.health_snapshot()[
+                                "lostHosts"]
+                            or victim in CLUSTER.health_snapshot()[
+                                "excludedHosts"],
+                            timeout_s=30.0)
+                        kill_info = {"host": victim, "atSubmission": si,
+                                     "detected": detected,
+                                     "detectS": round(
+                                         time.time() - t0, 3)}
+                        if not detected:
+                            failures.append(
+                                f"SIGKILLed host {victim} never "
+                                f"declared lost by the heartbeat sweep")
+                    if si == rejoin_at:
+                        t0 = time.time()
+                        executors[victim] = spawn_executor(
+                            driver.address, victim,
+                            heartbeat_ms=_HOSTS_HEARTBEAT_MS,
+                            mode="process")
+                        rejoined = _wait_for(
+                            lambda: victim not in
+                            CLUSTER.health_snapshot()["lostHosts"]
+                            and victim not in
+                            CLUSTER.health_snapshot()["excludedHosts"],
+                            timeout_s=60.0)
+                        kill_info["rejoined"] = rejoined
+                        kill_info["rejoinS"] = round(
+                            time.time() - t0, 3)
+                        if not rejoined:
+                            failures.append(
+                                f"respawned host {victim} never "
+                                f"rejoined the topology")
+                    label = f"{name}@{tenant}/{pool}"
+                    h = _submit_retry(name, pool, tenant, label)
+                    if h is not None:
+                        handles.append((name, pool, tenant, label, h))
+                    else:
+                        # shed/quarantined to exhaustion mid-storm
+                        # (recorded typed): owed a clean run on the
+                        # recovered fleet below
+                        resubmit.append((name, pool, tenant))
+                for name, pool, tenant, label, h in handles:
+                    if not h.wait(timeout=timeout_s):
+                        hung.append(f"{label}: still {h.state} after "
+                                    f"{timeout_s}s")
+                        failures.append(hung[-1])
+                # the count-based schedule is spent: return the
+                # topology to full strength
+                end_hosts = CLUSTER.health_snapshot()
+                if (end_hosts["lostHosts"] or end_hosts["excludedHosts"]
+                        or end_hosts["singleProcessReason"]):
+                    CLUSTER.restore()
+                if MESH.health_snapshot()["excludedDeviceIds"]:
+                    MESH.restore("fleet schedule spent; probing full "
+                                 "strength")
+
+                # -- mid-storm verdicts (demotion state still live) --
+                compare_modes = {}
+                finished = 0
+                for name, pool, tenant, label, h in handles:
+                    if h.state != "FINISHED":
+                        if (type(h.error).__name__
+                                in _CHAOS_TYPED_ERRORS):
+                            typed_outcomes.append({
+                                "query": label, "state": h.state,
+                                "error": f"{type(h.error).__name__}: "
+                                         f"{h.error}",
+                                "requeues": h.requeues})
+                            resubmit.append((name, pool, tenant))
+                            continue
+                        failures.append(
+                            f"{label}: {h.state} ({h.error})")
+                        continue
+                    finished += 1
+                    got = h.result_table
+                    # verdict ladder: the budgeted twin is THE
+                    # reference (same memory conf, same splits); a
+                    # CPU-demoted storm run bypasses the arbiter and
+                    # reproduces the forced-chunk twin instead
+                    mode = "bitwise-budgeted-twin"
+                    diff = tables_differ(expected_budgeted[name], got)
+                    if diff is not None:
+                        if tables_differ(expected_chunked[name],
+                                         got) is None:
+                            diff, mode = None, "bitwise-chunked-twin"
+                    if diff is not None and (
+                            CIRCUIT_BREAKER.demoted_ops()
+                            or HEALTH.state() != "HEALTHY"):
+                        # an active demotion changes float reduction
+                        # order vs the pre-demotion twin: re-collect
+                        # the twin through the SAME demoted plan at
+                        # the same chunk share
+                        with FAULTS.suspended(), \
+                                forced_chunking(chunk_share):
+                            redo = twin_queries[name]().collect_table()
+                        diff = tables_differ(redo, got)
+                        mode = "bitwise-demoted-twin"
+                    if diff is not None:
+                        # concurrent budgeted execution may emit rows
+                        # in a different ORDER (batching under
+                        # pressure); every row must still exist
+                        # bitwise on both sides
+                        if tables_differ_unordered(
+                                expected_plain[name], got) is None:
+                            diff, mode = None, "row-multiset"
+                    if diff is not None:
+                        # a demotion that landed MID-query (the
+                        # breaker moved while this ran concurrently)
+                        # matches no static twin — record the storm
+                        # divergence and require the post-recovery
+                        # resubmission below to come back bitwise
+                        mode = "diverged-mid-storm"
+                        resubmit.append((name, pool, tenant))
+                    compare_modes[mode] = (
+                        compare_modes.get(mode, 0) + 1)
+                    entry = report["queries"].setdefault(
+                        name, {"runs": []})
+                    entry["runs"].append({
+                        "tenant": tenant, "pool": pool,
+                        "identical": diff is None,
+                        "compare_mode": mode,
+                        "latencyS": round(h.latency_s, 4),
+                        "queueWaitS": round(h.queue_wait_s or 0.0, 4),
+                        "requeues": h.requeues})
+
+                # the storm is over: record what it demoted, reset the
+                # breaker (run_memory_chaos's discipline — the ladder's
+                # deliberate demotions are the STORM's, not the
+                # recovered fleet's), and pay the DEGRADED latch down
+                # with live probes (what real traffic does)
+                report["storm_demoted_ops"] = (
+                    CIRCUIT_BREAKER.demoted_ops())
+                CIRCUIT_BREAKER.reset()
+                probes = 0
+                if not hung:
+                    probes = drive_health_probes(
+                        svc, svc_queries[wanted[0]],
+                        timeout_s=timeout_s)
+                report["health_probes"] = probes
+
+                # -- post-recovery wave: every shed-rejected or storm-
+                # diverged query resubmits against the recovered fleet
+                # and must come back FINISHED and bitwise — rejection
+                # during the storm is backpressure, not data loss ----
+                recovered = 0
+                # the recovered-fleet verdict RE-EXECUTES (the result
+                # cache is off): drop the storm's cached scan images —
+                # built under ladder-forced chunk shares and OOM
+                # splits, they would replay storm-era batch structures
+                # into the re-scan and diverge the f64 merge order
+                evict_device_caches()
+                # the storm's schedule is spent and the breaker reset:
+                # the recovered-fleet verdict must be about the FLEET,
+                # not about a leftover one-shot fault landing on it
+                recovery_retries = 0
+                with FAULTS.suspended():
+                    for name, pool, tenant in resubmit:
+                        label = f"{name}@{tenant}/{pool}#recovery"
+                        h = None
+                        for attempt in range(2):
+                            h = _submit_retry(name, pool, tenant, label)
+                            if h is None:
+                                break
+                            if not h.wait(timeout=timeout_s):
+                                hung.append(f"{label}: still {h.state} "
+                                            f"after {timeout_s}s")
+                                failures.append(hung[-1])
+                                h = None
+                                break
+                            if h.state == "FINISHED":
+                                break
+                            if attempt == 0:
+                                # the last storm wedge can still be
+                                # sleeping inside an abandoned dispatch
+                                # when the wave starts: its zombie
+                                # thread drains through the launch gate
+                                # and can push the FIRST wave execution
+                                # over the hard wall. That is the
+                                # watchdog doing its job — the verdict
+                                # is whether the fleet serves the
+                                # RETRY, not whether the first probe
+                                # threads the drain.
+                                recovery_retries += 1
+                                continue
+                            failures.append(f"{label}: {h.state} "
+                                            f"({h.error}) on the "
+                                            f"recovered fleet")
+                            h = None
+                        if h is None:
+                            if not any(label in f for f in failures):
+                                failures.append(
+                                    f"{label}: still refused after "
+                                    f"recovery")
+                            continue
+                        # bit-identity against the fault-free twin
+                        # wearing the SAME memory conf; the forced-
+                        # chunk twin stays a valid secondary identity
+                        # (a query whose working set fits never splits)
+                        mode = "bitwise-after-recovery"
+                        diff = tables_differ(expected_budgeted[name],
+                                             h.result_table)
+                        if diff is not None and tables_differ(
+                                expected_chunked[name],
+                                h.result_table) is not None:
+                            # the arbiter splits by LIVE occupancy, so
+                            # a wave run late in the sequence can chunk
+                            # where the pre-storm twin did not — a
+                            # fault-free execution the static twins
+                            # cannot represent. Re-collect the twin NOW
+                            # (same process, same arbiter state): the
+                            # service result must be bit-identical to a
+                            # fault-free session execution at the same
+                            # instant, or the fleet diverged.
+                            live = btwin_queries[name]().collect_table()
+                            diff = tables_differ(live, h.result_table)
+                            mode = "bitwise-live-twin"
+                        if diff is not None:
+                            failures.append(f"{label}: {diff}")
+                            continue
+                        recovered += 1
+                        compare_modes[mode] = (
+                            compare_modes.get(mode, 0) + 1)
+                        entry = report["queries"].setdefault(
+                            name, {"runs": []})
+                        entry["runs"].append({
+                            "tenant": tenant, "pool": pool,
+                            "identical": True,
+                            "compare_mode": mode,
+                            "latencyS": round(h.latency_s, 4),
+                            "queueWaitS": round(h.queue_wait_s or 0.0, 4),
+                            "requeues": h.requeues})
+                report["recovery_retries"] = recovery_retries
+                report["recovered_after_storm"] = recovered
+
+                svc_health_live = svc.health()
+                topo_live = svc.topology_snapshot()
+                svc_stats = svc.stats()
+                # live HTTP surfaces: the SLOs come from /slo, the
+                # shared-topology snapshot from /topology
+                url = f"http://127.0.0.1:{svc.introspect_port}"
+
+                def _get(route):
+                    with urllib.request.urlopen(url + route,
+                                                timeout=30) as resp:
+                        return json.loads(resp.read().decode("utf-8"))
+                slo = _get("/slo")
+                http_topology = _get("/topology")
+                http_health = _get("/health")
+            finally:
+                fires = FAULTS.counters()
+                FAULTS.disarm()
+                svc.shutdown()
+        report["wall_s"] = round(time.perf_counter() - t0_run, 3)
+
+        report["finished"] = finished
+        report["compare_modes"] = compare_modes
+        report["typed_outcomes"] = typed_outcomes
+        report["shed_rejections"] = shed_rejections[0]
+        report["submissions"] = len(subs)
+        report["hung"] = hung
+        if not finished:
+            failures.append("no submission FINISHED mid-storm — the "
+                            "fleet run proves nothing")
+        # every pool must end with served, verified traffic: a pool
+        # that only ever shed proved admission control, not serving
+        pool_cover = {}
+        for entry in report["queries"].values():
+            for run in entry["runs"]:
+                if run["identical"]:
+                    pool_cover[run["pool"]] = (
+                        pool_cover.get(run["pool"], 0) + 1)
+        report["pool_coverage"] = pool_cover
+        for pool in pools_cycle:
+            if not pool_cover.get(pool):
+                failures.append(
+                    f"pool {pool!r} ended with zero verified runs")
+        if kill_at is not None:
+            report["kill"] = kill_info
+
+        # -- every plane's domain fired ----------------------------------
+        domain_fires = {}
+        for point, n in fires.items():
+            if n:
+                d = _fleet_point_domain(point)
+                domain_fires[d] = domain_fires.get(d, 0) + n
+        report["fault_fires_total"] = {k: v for k, v in
+                                       sorted(fires.items()) if v}
+        report["domain_fires"] = domain_fires
+        for domain in ("host", "mesh", "memory", "service"):
+            if not domain_fires.get(domain):
+                failures.append(
+                    f"no {domain}-domain fault fired — the merged "
+                    f"schedule did not cover the {domain} plane")
+
+        # -- recovery within the merged bounds ---------------------------
+        recovery = {k: v - recovery_before.get(k, 0)
+                    for k, v in RECOVERY.snapshot().items()}
+        cluster_after = dict(scopes_snapshot().get("cluster", {}))
+        mesh_after = dict(scopes_snapshot().get("mesh", {}))
+        for k in ("hostShardRetries", "hostsLost"):
+            recovery[k] = int(cluster_after.get(k, 0)
+                              - cluster_before.get(k, 0))
+        for k in ("shardRetries", "gatherChecksFailed"):
+            recovery[k] = int(mesh_after.get(k, 0)
+                              - mesh_before.get(k, 0))
+        health_after = HEALTH.snapshot()
+        recovery["deviceReinits"] = (health_after["deviceReinits"]
+                                     - health_before["deviceReinits"])
+        for k in ("workersLost", "workersRespawned", "requeued",
+                  "hardTimeouts"):
+            recovery[k] = svc_stats[k]
+        report["recovery"] = {k: v for k, v in sorted(recovery.items())
+                              if v}
+        for field, bound in bounds.items():
+            if recovery.get(field, 0) > bound:
+                failures.append(f"{field}={recovery[field]} exceeds "
+                                f"the merged fleet bound {bound}")
+
+        # -- ladder actions <-> incident bundles (seq + faultDomain) -----
+        ladder_after = {
+            "host": HEALTH.host_snapshot()["hostsLost"],
+            "mesh": HEALTH.mesh_snapshot()["meshDeviceLost"],
+            "memory": HEALTH.memory_snapshot()["memoryPressureEvents"],
+            "service": health_after["deviceLost"],
+        }
+        actions = {d: int(ladder_after[d] - ladder_before[d])
+                   for d in ladder_after}
+        bundles = (load_bundles(flight_dir)
+                   if os.path.isdir(flight_dir) else [])
+        seqs = [b["seq"] for b in bundles if "seq" in b]
+        ladder_by_domain = {}
+        for b in bundles:
+            if str(b.get("kind", "")).endswith(".ladder"):
+                d = b.get("faultDomain")
+                ladder_by_domain[d] = ladder_by_domain.get(d, 0) + 1
+        report["incident_bundles"] = {
+            "total": len(bundles),
+            "ladder_by_domain": ladder_by_domain,
+            "ladder_actions": actions,
+            "seq_ids_unique": len(seqs) == len(set(seqs)),
+        }
+        if len(seqs) != len(set(seqs)):
+            failures.append("incident bundle seq ids are not unique")
+        if len(seqs) != len(bundles):
+            failures.append("incident bundle(s) missing the seq id "
+                            "(schema 2)")
+        for b in bundles:
+            if "faultDomain" not in b:
+                failures.append(
+                    f"incident bundle kind={b.get('kind')} lacks "
+                    f"faultDomain")
+                break
+        for domain, n_actions in actions.items():
+            if n_actions and ladder_by_domain.get(domain,
+                                                  0) < n_actions:
+                failures.append(
+                    f"{domain}: only "
+                    f"{ladder_by_domain.get(domain, 0)} ladder "
+                    f"bundles for {n_actions} ladder actions")
+        report["ladders_tripped"] = sorted(
+            d for d, n in actions.items() if n)
+
+        # -- per-tenant SLOs from the live /slo endpoint -----------------
+        report["slo"] = slo
+        if not slo.get("tenants"):
+            failures.append("/slo served no per-tenant percentiles")
+        for key, tentry in (slo.get("tenants") or {}).items():
+            p95 = tentry.get("latency", {}).get("p95S")
+            if p95 is None:
+                failures.append(f"/slo tenant {key} lacks p95 latency")
+            elif p95 > timeout_s:
+                failures.append(f"/slo tenant {key} p95 {p95}s "
+                                f"exceeds the {timeout_s}s ceiling")
+        # the shared-topology path: generation-stamped, served both
+        # in-process and over HTTP, fleet reason wired into health()
+        report["topology"] = {
+            "generation": topo_live["generation"],
+            "state": topo_live["state"],
+            "hosts": topo_live["hosts"],
+        }
+        if http_topology.get("generation") is None:
+            failures.append("/topology lacks the generation stamp")
+        if "fleetDegradedReason" not in http_health:
+            failures.append("health() lacks fleetDegradedReason — the "
+                            "service is not consulting the fleet "
+                            "topology")
+
+        # -- end state: HEALTHY, full strength ---------------------------
+        report["service_end"] = {
+            "state": svc_health_live["state"],
+            "fleetDegradedReason":
+                svc_health_live.get("fleetDegradedReason"),
+            "workerCount": svc_health_live.get("workerCount"),
+        }
+        if svc_health_live["state"] != "HEALTHY":
+            failures.append(f"service ended "
+                            f"{svc_health_live['state']}, not HEALTHY")
+        end_hosts = CLUSTER.health_snapshot()
+        report["hosts_end_state"] = end_hosts
+        if (end_hosts["lostHosts"] or end_hosts["excludedHosts"]
+                or end_hosts["singleProcessReason"]):
+            failures.append(f"cluster not at full strength at the end: "
+                            f"{end_hosts}")
+        end_mesh = MESH.health_snapshot()
+        if end_mesh["excludedDeviceIds"]:
+            failures.append(f"mesh not at full strength at the end: "
+                            f"{end_mesh}")
+        report["demoted_ops"] = CIRCUIT_BREAKER.demoted_ops()
+        report["health_state"] = HEALTH.state()
+    finally:
+        FAULTS.disarm()
+        _teardown_cluster(driver, executors)
+    _record_lock_witness(report, failures)
+    report["ok"] = not failures
+    report["failures"] = failures
+    if failures:
+        err = AssertionError("fleet run failed:\n"
+                             + "\n".join(failures))
         err.report = report
         raise err
     return report
@@ -2600,6 +3491,7 @@ def run_streaming(sf: float = 0.02, seed: int = 7, chaos: bool = False):
             "spark.rapids.streaming.mv.maxTouchedGroups": 2048}
     if chaos:
         conf["spark.rapids.test.faults"] = report["fault_spec"]
+        conf["spark.rapids.lint.lockWitness"] = "true"
     svc = QueryService(conf)
     try:
         session = svc.session
@@ -2689,6 +3581,7 @@ def run_streaming(sf: float = 0.02, seed: int = 7, chaos: bool = False):
         svc.shutdown()
         FAULTS.disarm()
         shutil.rmtree(base, ignore_errors=True)
+    _record_lock_witness(report, failures)
     report["ok"] = not failures
     report["failures"] = failures
     if failures:
@@ -2706,7 +3599,8 @@ SUPPORTED_MODES = (
     "supported modes: (default timing run) | --cpu-baseline | "
     "--chaos [--concurrency N [--service-faults]] | --concurrency N | "
     "--mesh N [--mesh-shape DxI] [--chaos] | --hosts N [--chaos] | "
-    "--streaming [--chaos]")
+    "--streaming [--chaos] | --fleet [--hosts N] [--device-budget B] "
+    "[--concurrency N] [--tenants N] [--dry-run]")
 
 
 def _resolved_backend() -> str:
@@ -2720,10 +3614,49 @@ def _resolved_backend() -> str:
 def validate_flags(args) -> None:
     """Fail fast on flag combinations the harness does not implement —
     a silently-ignored mode flag reads as a passing run of a contract
-    that was never exercised."""
+    that was never exercised.
+
+    Fault PLANES compose: --fleet (or any two of --hosts /
+    --device-budget / --concurrency together) routes to the fleet
+    closure, where host, mesh-device, memory, service and exec faults
+    merge into one seeded schedule. The single-plane modes keep their
+    original harnesses (and their original rejections) — a lone
+    --hosts run is still the serial bit-identity harness, not a fleet
+    run that happens to have one plane."""
     def bad(msg):
         raise SystemExit(f"{msg} ({SUPPORTED_MODES})")
 
+    fleet = getattr(args, "fleet", False)
+    combo = sum(1 for v in (args.hosts, args.device_budget,
+                            args.concurrency) if v)
+    if fleet or combo >= 2:
+        if args.mesh:
+            bad("--fleet does not compose with --mesh: the fleet "
+                "harness builds its own hierarchical (hosts x "
+                "devices-per-host) mesh")
+        if args.streaming:
+            bad("--fleet does not compose with --streaming: recurring "
+                "streams own their kill points; the fleet corpus is "
+                "the one-shot q1-q22 set")
+        if args.cpu_baseline:
+            bad("--fleet does not compose with --cpu-baseline: the "
+                "fleet baseline is its own fault-free twin over the "
+                "same cluster topology, not the CPU path")
+        if args.require_tpu:
+            bad("--fleet does not compose with --require-tpu: the "
+                "fleet harness pins virtual host-platform (cpu) "
+                "devices, and the gate would initialize the backend "
+                "before the device-count flag can take effect")
+        if args.hosts and args.hosts < 2:
+            bad(f"--hosts {args.hosts}: a cluster needs at least 2 "
+                "executor hosts")
+        if args.device_budget and args.device_budget < 4096:
+            bad(f"--device-budget {args.device_budget}: below 4KB not "
+                "even a MIN_BUCKET chunk of one column fits")
+        return
+    if getattr(args, "dry_run", False):
+        bad("--dry-run only applies to --fleet: the single-plane "
+            "harnesses have no plan document to print")
     if args.mesh:
         if args.mesh < 2:
             bad(f"--mesh {args.mesh}: a mesh needs at least 2 devices")
@@ -2893,6 +3826,25 @@ def main():
                          "--chaos, each stream is killed once mid-"
                          "micro-batch under the seeded schedule and "
                          "must resume exactly-once (STREAM_r01)")
+    ap.add_argument("--fleet", action="store_true",
+                    help="the FLEET closure: N executor hosts x "
+                         "concurrent tenant pools x a hard device "
+                         "budget x the merged cross-domain fault "
+                         "schedule (host + mesh + memory + service + "
+                         "exec planes COMPOSED), served through a "
+                         "QueryService acting as the cluster driver; "
+                         "asserts all-terminal, bit-identity vs the "
+                         "fault-free twin, per-tenant /slo p95s, one "
+                         "incident bundle per ladder action, zero "
+                         "lock-witness violations, HEALTHY at the end "
+                         "(FLEET_r01); any two of --hosts/"
+                         "--device-budget/--concurrency also route "
+                         "here")
+    ap.add_argument("--dry-run", action="store_true",
+                    help="with --fleet: build the run plan, validate "
+                         "the merged fault schedule parses, print the "
+                         "plan JSON and exit 0 — no backend "
+                         "initialization, no cluster boot")
     ap.add_argument("--require-tpu", action="store_true",
                     help="exit non-zero when the resolved JAX backend is "
                          "'cpu' — a perf run that meant to hit the TPU "
@@ -2909,6 +3861,52 @@ def main():
     if args.require_tpu:
         from spark_rapids_tpu.tools import require_tpu_backend
         require_tpu_backend()
+
+    fleet_combo = sum(1 for v in (args.hosts, args.device_budget,
+                                  args.concurrency) if v)
+    if args.fleet or fleet_combo >= 2:
+        nhosts = args.hosts or 2
+        fleet_tenants = args.tenants or 2
+        fleet_conc = args.concurrency or 2
+        wanted = [q.strip() for q in args.queries.split(",")
+                  if q.strip()]
+        seed = args.seed if args.seed is not None else 7
+        sf = args.sf if args.sf is not None else 0.02
+        if args.dry_run:
+            # plan + validate only: parse the merged cross-domain
+            # schedule through the real spec parser (no arming, no
+            # jax), print the plan, exit 0 — the under-5s smoke
+            from spark_rapids_tpu.runtime.faults import parse_fault_spec
+            plan = fleet_plan(nhosts, seed, tenants=fleet_tenants,
+                              concurrency=fleet_conc,
+                              budget=args.device_budget, sf=sf,
+                              queries=wanted or None)
+            plan["merged_fault_points"] = len(
+                parse_fault_spec(plan["merged_fault_spec"]))
+            print(json.dumps(plan))
+            if args.out:
+                with open(args.out, "w") as f:
+                    json.dump(plan, f, indent=1)
+            return
+
+        def dump_fleet_report(report):
+            print(json.dumps(report))
+            if args.out:
+                with open(args.out, "w") as f:
+                    json.dump(report, f, indent=1)
+
+        try:
+            report = run_fleet(
+                sf=sf, seed=seed, nhosts=nhosts,
+                tenants=fleet_tenants, concurrency=fleet_conc,
+                budget=args.device_budget, queries=wanted or None,
+                use_sql=args.sql)
+        except AssertionError as e:
+            if getattr(e, "report", None) is not None:
+                dump_fleet_report(e.report)
+            raise SystemExit(f"FAILED: {e}")
+        dump_fleet_report(report)
+        return
 
     if args.streaming:
         def dump_stream_report(report):

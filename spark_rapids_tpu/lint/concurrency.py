@@ -118,6 +118,15 @@ _LOCK_EFFECT_ALLOWLIST: Dict[str, str] = {
         "SpillableBatch._spill_to_disk_locked":
         "fault_point('mem.spill.disk') under the batch RLock — the "
         "disk demotion variant of the mem.spill contract above",
+    "spark_rapids_tpu/service/scheduler.py:QueryService._run":
+        "the mesh gate EXISTS to serialize the whole device-launch "
+        "window — execute (and the worker_crash fault point on its "
+        "path) under it IS the protected operation: two concurrent "
+        "multi-device launches interleave their collective rendezvous "
+        "per-device and deadlock. The gate is taken holding nothing "
+        "and ranks below the service band, so a wedged holder stalls "
+        "only the launch queue (booked as queue wait, not hard-wall "
+        "time), never extends a deadlock chain",
 }
 
 _SOCKET_CALL_SUFFIXES = (".sendall", ".recv", ".recv_into", ".accept",

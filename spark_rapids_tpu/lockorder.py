@@ -121,6 +121,18 @@ _DECLS: Tuple[LockDecl, ...] = (
              "MaterializedView._refresh_lock",
              "Lock", "one refresh (incremental or full recompute) at a "
                      "time per view"),
+    LockDecl("service.mesh_gate", 150,
+             "spark_rapids_tpu/service/scheduler.py:"
+             "QueryService._mesh_gate",
+             "Lock", "exclusive mesh occupancy: one multi-device "
+                     "computation launch at a time when the service "
+                     "drives a mesh/cluster topology (two concurrent "
+                     "launches interleave their collective rendezvous "
+                     "per-device and deadlock); single-chip services "
+                     "never construct it. Ranks BELOW the service band "
+                     "because it is held across the whole launch "
+                     "window, inside which ladder incident capture "
+                     "legitimately reads scheduler/handle state"),
     # -- query service -------------------------------------------------
     LockDecl("service.scheduler.cond", 200,
              "spark_rapids_tpu/service/scheduler.py:QueryService._cond",
@@ -334,6 +346,13 @@ _validate_registry()
 #: a plain attribute load; writes happen in arm/disarm only.
 _WITNESS_ARMED = False
 
+#: process-monotonic count of witness violations DETECTED (each one also
+#: raises LockOrderViolation at the acquire site).  Chaos closures record
+#: the delta in-band — a committed artifact carries
+#: ``lockWitnessViolations: 0`` as evidence, not as a vibe.
+_WITNESS_VIOLATIONS = [0]
+_WITNESS_VIOLATIONS_LOCK = threading.Lock()
+
 _held_local = threading.local()
 
 
@@ -378,12 +397,55 @@ def held_snapshot() -> List[str]:
     return [d.name for _oid, d, _r in _held()]
 
 
+def witness_violations() -> int:
+    """Process-monotonic count of detected lock-order violations.
+    Closures sample it before/after and assert the delta is zero."""
+    with _WITNESS_VIOLATIONS_LOCK:
+        return _WITNESS_VIOLATIONS[0]
+
+
+def reset_witness_violations() -> None:
+    """Test hook: zero the counter and drop the evidence records. A
+    test that PROVOKES violations on purpose must reset afterwards or
+    every later in-process closure reads its deliberate inversions as
+    real ones."""
+    with _WITNESS_VIOLATIONS_LOCK:
+        _WITNESS_VIOLATIONS[0] = 0
+        _WITNESS_RECORDS.clear()
+
+
+#: evidence for the counter: the first N violations' (lock, held
+#: chain, acquiring call site) — a raised LockOrderViolation often
+#: lands in a best-effort except (telemetry, flight recorder) and
+#: vanishes, so the count alone is undebuggable
+_WITNESS_RECORDS: List[dict] = []
+_WITNESS_RECORDS_MAX = 20
+
+
+def witness_violation_records() -> List[dict]:
+    """The recorded evidence behind :func:`witness_violations` (first
+    ``_WITNESS_RECORDS_MAX`` only) — what a failing closure dumps."""
+    with _WITNESS_VIOLATIONS_LOCK:
+        return [dict(r) for r in _WITNESS_RECORDS]
+
+
+def _count_violation(lock_name: str, chain: str) -> None:
+    import traceback
+    site = "".join(traceback.format_stack(limit=8)[:-2])
+    with _WITNESS_VIOLATIONS_LOCK:
+        _WITNESS_VIOLATIONS[0] += 1
+        if len(_WITNESS_RECORDS) < _WITNESS_RECORDS_MAX:
+            _WITNESS_RECORDS.append(
+                {"lock": lock_name, "heldChain": chain, "site": site})
+
+
 def _check_blocking_acquire(decl: LockDecl, oid: int,
                             reentrant: bool) -> None:
     for hoid, hdecl, hreent in _held():
         if hoid == oid:
             if reentrant:
                 continue
+            _count_violation(decl.name, decl.name)
             raise LockOrderViolation(
                 f"witness: thread re-acquiring non-reentrant lock "
                 f"{decl.name!r} (rank {decl.rank}) it already holds — "
@@ -391,6 +453,7 @@ def _check_blocking_acquire(decl: LockDecl, oid: int,
         if hdecl.rank >= decl.rank:
             chain = " -> ".join(
                 f"{d.name}({d.rank})" for _o, d, _r in _held())
+            _count_violation(decl.name, chain)
             raise LockOrderViolation(
                 f"witness: blocking acquire of {decl.name!r} (rank "
                 f"{decl.rank}) while holding {hdecl.name!r} (rank "

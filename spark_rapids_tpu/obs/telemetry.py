@@ -288,6 +288,26 @@ _FR_SEQ = [0]
 #: at host.dispatch") — parsed into the bundle's triggering fault point
 _FAULT_POINT_RE = re.compile(r"\bat ([a-z][a-z0-9_]*(?:\.[a-z0-9_]+)+)")
 
+#: bundle-kind prefix → fault domain.  Cross-domain closures match
+#: "one bundle per ladder action" by (seq, faultDomain) instead of
+#: timestamp windows, so the attribution must be total: anything not
+#: claimed by a hardware/memory/stream prefix belongs to the service
+#: plane (backend ladder, quarantine, kernel demotion).
+_FAULT_DOMAIN_PREFIXES = (
+    ("host.", "host"),
+    ("mesh.", "mesh"),
+    ("memory.", "memory"),
+    ("stream.", "stream"),
+)
+
+
+def fault_domain(kind: str) -> str:
+    kind = str(kind)
+    for prefix, domain in _FAULT_DOMAIN_PREFIXES:
+        if kind.startswith(prefix):
+            return domain
+    return "service"
+
 
 def _configure_flight_recorder(conf: RapidsConf) -> None:
     with _FR_LOCK:
@@ -368,8 +388,17 @@ def record_incident(kind: str, action: str, reason: str,
         from spark_rapids_tpu.runtime.health import HEALTH, QUARANTINE
         reason = str(reason)
         m = _FAULT_POINT_RE.search(reason)
+        # the sequence id is allocated BEFORE the bundle is built and
+        # embedded in-band: process-monotonic, so a closure can assert
+        # exact bundle↔ladder-action correspondence even when wall
+        # clocks collide across domains
+        with _FR_LOCK:
+            _FR_SEQ[0] += 1
+            seq = _FR_SEQ[0]
         bundle = {
-            "schema": 1,
+            "schema": 2,
+            "seq": seq,
+            "faultDomain": fault_domain(kind),
             "kind": str(kind),
             "action": str(action),
             "reason": reason[:2000],
@@ -408,9 +437,6 @@ def record_incident(kind: str, action: str, reason: str,
             bundle["extra"] = extra
         directory = settings["dir"]
         os.makedirs(directory, exist_ok=True)
-        with _FR_LOCK:
-            _FR_SEQ[0] += 1
-            seq = _FR_SEQ[0]
         safe_kind = re.sub(r"[^A-Za-z0-9._-]", "_", str(kind))
         path = os.path.join(
             directory,

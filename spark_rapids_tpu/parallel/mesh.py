@@ -379,16 +379,21 @@ class MeshRuntime:
     def health_snapshot(self) -> dict:
         """The mesh fault-domain state QueryService.health() reports."""
         with self._lock:
-            shape = ("x".join(str(d) for d in self._dims)
-                     if self._enabled and self._mesh is not None else None)
-            return {
-                "enabled": self._enabled and self._mesh is not None,
-                "shape": shape,
-                "declaredShape": self._declared_shape,
-                "excludedDeviceIds": sorted(self._excluded_ids),
-                "degradedReason": self._degraded_reason,
-                "generation": self._generation,
-            }
+            return self._health_snapshot_locked()
+
+    def _health_snapshot_locked(self) -> dict:
+        """Snapshot body for callers already holding ``self._lock``
+        (the shared-topology path in runtime/health.py)."""
+        shape = ("x".join(str(d) for d in self._dims)
+                 if self._enabled and self._mesh is not None else None)
+        return {
+            "enabled": self._enabled and self._mesh is not None,
+            "shape": shape,
+            "declaredShape": self._declared_shape,
+            "excludedDeviceIds": sorted(self._excluded_ids),
+            "degradedReason": self._degraded_reason,
+            "generation": self._generation,
+        }
 
     # -- state ---------------------------------------------------------------
     @property

@@ -511,17 +511,24 @@ class ClusterRuntime:
         """The host-topology state QueryService.health()['hosts']
         reports (mirroring the PR 10 mesh section)."""
         with self._lock:
-            live = self._usable_hosts_locked() if self._enabled else []
-            return {
-                "enabled": self._enabled and self._driver is not None,
-                "declaredHosts": self._declared_hosts,
-                "liveHosts": live,
-                "lostHosts": sorted(self._lost),
-                "excludedHosts": sorted(self._excluded),
-                "singleProcessReason": self._single_process_reason,
-                "degradedReason": self._degraded_reason,
-                "generation": self._generation,
-            }
+            return self._health_snapshot_locked()
+
+    def _health_snapshot_locked(self) -> dict:
+        """Snapshot body for callers that already hold ``self._lock``
+        — the shared-topology path (health.consistent_topology_snapshot)
+        nests cluster→health→mesh→memory in declared rank order so one
+        view can't tear across a mid-query shrink."""
+        live = self._usable_hosts_locked() if self._enabled else []
+        return {
+            "enabled": self._enabled and self._driver is not None,
+            "declaredHosts": self._declared_hosts,
+            "liveHosts": live,
+            "lostHosts": sorted(self._lost),
+            "excludedHosts": sorted(self._excluded),
+            "singleProcessReason": self._single_process_reason,
+            "degradedReason": self._degraded_reason,
+            "generation": self._generation,
+        }
 
     # -- scan routing --------------------------------------------------------
     def scan_route(self, scan_node, paths: List[str]):

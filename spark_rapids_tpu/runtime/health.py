@@ -338,12 +338,15 @@ class DeviceHealthMonitor:
 
     def mesh_snapshot(self) -> Dict[str, int]:
         with self._lock:
-            return {
-                "meshDeviceLost": self._mesh_losses,
-                "meshConsecutiveLosses": self._mesh_consecutive,
-                "meshShrinks": self._mesh_shrinks,
-                "meshDegradations": self._mesh_degradations,
-            }
+            return self._mesh_snapshot_locked()
+
+    def _mesh_snapshot_locked(self) -> Dict[str, int]:
+        return {
+            "meshDeviceLost": self._mesh_losses,
+            "meshConsecutiveLosses": self._mesh_consecutive,
+            "meshShrinks": self._mesh_shrinks,
+            "meshDegradations": self._mesh_degradations,
+        }
 
     def on_host_loss(self, exc: BaseException, conf) -> str:
         """One observed HOST loss (a dead executor process — a
@@ -433,11 +436,14 @@ class DeviceHealthMonitor:
 
     def host_snapshot(self) -> Dict[str, int]:
         with self._lock:
-            return {
-                "hostsLost": self._host_losses,
-                "hostConsecutiveLosses": self._host_consecutive,
-                "hostShrinks": self._host_shrinks,
-            }
+            return self._host_snapshot_locked()
+
+    def _host_snapshot_locked(self) -> Dict[str, int]:
+        return {
+            "hostsLost": self._host_losses,
+            "hostConsecutiveLosses": self._host_consecutive,
+            "hostShrinks": self._host_shrinks,
+        }
 
     def on_memory_pressure(self, exc: BaseException, conf) -> str:
         """One FatalDeviceOOM that escaped the retry framework (spill
@@ -513,12 +519,15 @@ class DeviceHealthMonitor:
 
     def memory_snapshot(self) -> Dict[str, int]:
         with self._lock:
-            return {
-                "memoryPressureEvents": self._mem_events,
-                "memoryConsecutive": self._mem_consecutive,
-                "memoryChunkedReexecutions": self._mem_chunked,
-                "memoryCpuDemotions": self._mem_cpu_demotions,
-            }
+            return self._memory_snapshot_locked()
+
+    def _memory_snapshot_locked(self) -> Dict[str, int]:
+        return {
+            "memoryPressureEvents": self._mem_events,
+            "memoryConsecutive": self._mem_consecutive,
+            "memoryChunkedReexecutions": self._mem_chunked,
+            "memoryCpuDemotions": self._mem_cpu_demotions,
+        }
 
     def _invalidate_device_caches_locked(self) -> None:
         """Drop every cache that references device state — cached
@@ -568,11 +577,14 @@ class DeviceHealthMonitor:
     # -- introspection -------------------------------------------------------
     def snapshot(self) -> Dict[str, int]:
         with self._lock:
-            return {
-                "deviceLost": self._losses,
-                "deviceReinits": self._reinits,
-                "consecutiveLosses": self._consecutive_losses,
-            }
+            return self._snapshot_locked()
+
+    def _snapshot_locked(self) -> Dict[str, int]:
+        return {
+            "deviceLost": self._losses,
+            "deviceReinits": self._reinits,
+            "consecutiveLosses": self._consecutive_losses,
+        }
 
     def reset(self) -> None:
         with self._lock:
@@ -667,11 +679,14 @@ class QuarantineRegistry:
 
     def snapshot(self) -> dict:
         with self._lock:
-            return {
-                "templatesWithStrikes": len(self._strikes),
-                "strikes": sum(len(v) for v in self._strikes.values()),
-                "quarantined": len(self._quarantined),
-            }
+            return self._snapshot_locked()
+
+    def _snapshot_locked(self) -> dict:
+        return {
+            "templatesWithStrikes": len(self._strikes),
+            "strikes": sum(len(v) for v in self._strikes.values()),
+            "quarantined": len(self._quarantined),
+        }
 
     def reset(self) -> None:
         with self._lock:
@@ -683,3 +698,51 @@ class QuarantineRegistry:
 
 
 QUARANTINE = QuarantineRegistry()
+
+
+def consistent_topology_snapshot() -> dict:
+    """ONE coherent view of the whole fleet topology — host cluster,
+    device health ladders, quarantine ledger, mesh, memory arbiter —
+    taken with every owning lock held simultaneously, so the sections
+    cannot tear against each other across a mid-query shrink (a host
+    loss updates the cluster under its own lock, releases it, and only
+    THEN excludes the host's devices from the mesh; independent
+    section reads can observe the half-applied shrink).
+
+    This is the shared-topology path: ``QueryService.health()``, the
+    ``/topology`` introspection route, and the fleet closure all read
+    it, so admission control and the degradation ladders argue about
+    the same topology. Locks nest in declared ascending rank —
+    cluster.runtime(300) → health.monitor(400) → health.quarantine(410)
+    → mesh.runtime(530) → memory.arbiter(740) — and every body under
+    the nest is a pure dict read (RL-LOCK-EFFECT clean). The memory
+    budget is resolved BEFORE the nest: budget_bytes() self-acquires
+    the arbiter lock, which is non-reentrant by contract."""
+    from spark_rapids_tpu.parallel.mesh import MESH
+    from spark_rapids_tpu.runtime.cluster import CLUSTER
+    from spark_rapids_tpu.runtime.memory import MEMORY
+    mem_budget = MEMORY.budget_bytes()
+    with CLUSTER._lock:
+        with HEALTH._lock:
+            with QUARANTINE._lock:
+                with MESH._lock:
+                    with MEMORY._lock:
+                        return {
+                            "generation": HEALTH._generation,
+                            "state": HEALTH.state(),
+                            "cpuOnlyReason": HEALTH.cpu_only_reason(),
+                            "backend": HEALTH._snapshot_locked(),
+                            "hosts": {
+                                **CLUSTER._health_snapshot_locked(),
+                                **HEALTH._host_snapshot_locked(),
+                            },
+                            "mesh": {
+                                **MESH._health_snapshot_locked(),
+                                **HEALTH._mesh_snapshot_locked(),
+                            },
+                            "memory": {
+                                **MEMORY._snapshot_locked(mem_budget),
+                                **HEALTH._memory_snapshot_locked(),
+                            },
+                            "quarantine": QUARANTINE._snapshot_locked(),
+                        }

@@ -362,16 +362,23 @@ class MemoryArbiter:
     def snapshot(self) -> dict:
         budget = self.budget_bytes()
         with self._lock:
-            ledger = self._ledger_total
-            return {
-                "budgetBytes": budget,
-                "occupancyBytes": self._reserved + ledger,
-                "ledgerBytes": ledger,
-                "reservedBytes": self._reserved,
-                "peakBytes": self._peak,
-                "accountedTables": len(self._ledger),
-                "budgetViolations": self._violations,
-            }
+            return self._snapshot_locked(budget)
+
+    def _snapshot_locked(self, budget: int) -> dict:
+        """Snapshot body for callers already holding ``self._lock``.
+        ``budget`` must be computed BEFORE entering the lock
+        (budget_bytes() self-acquires, and ordered locks are
+        non-reentrant by contract)."""
+        ledger = self._ledger_total
+        return {
+            "budgetBytes": budget,
+            "occupancyBytes": self._reserved + ledger,
+            "ledgerBytes": ledger,
+            "reservedBytes": self._reserved,
+            "peakBytes": self._peak,
+            "accountedTables": len(self._ledger),
+            "budgetViolations": self._violations,
+        }
 
     def peak_bytes(self) -> int:
         with self._lock:

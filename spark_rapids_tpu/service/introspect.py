@@ -11,6 +11,9 @@ Routes (all GET, all JSON):
 
 * ``/health``     — ``QueryService.health()`` (device/mesh/cluster
   topology, ladder counters, quarantine);
+* ``/topology``   — the consistent fleet-topology snapshot (hosts +
+  mesh + memory + ladders under every owning lock at once — the
+  shared-topology path in runtime/health.py);
 * ``/stats``      — ``QueryService.stats()`` (lifecycle counters, WFQ
   clocks, result-cache stats);
 * ``/slo``        — rolling per-pool / per-tenant p50/p95 latency and
@@ -52,6 +55,8 @@ def _routes(service, path: str, query: dict) -> Optional[dict]:
         }
     if path == "/health":
         return service.health()
+    if path == "/topology":
+        return service.topology_snapshot()
     if path == "/stats":
         return service.stats()
     if path == "/slo":
@@ -90,7 +95,8 @@ class IntrospectionServer:
                     status = 200 if doc is not None else 404
                     if doc is None:
                         doc = {"error": f"no route {parsed.path!r}",
-                               "routes": ["/top", "/health", "/stats",
+                               "routes": ["/top", "/health",
+                                          "/topology", "/stats",
                                           "/slo", "/queries",
                                           "/streams", "/telemetry"]}
                 except Exception as exc:  # surface, never crash the srv

@@ -41,6 +41,7 @@ from typing import Dict, List, Optional, Tuple
 from spark_rapids_tpu.conf import (
     RapidsConf,
     bool_conf,
+    float_conf,
     int_conf,
     str_conf,
 )
@@ -163,6 +164,22 @@ SERVICE_INTROSPECT_PORT = int_conf(
     "Port for the loopback introspection endpoint; 0 (default) binds "
     "an ephemeral port, reported as QueryService.introspect_port.")
 
+SERVICE_DEGRADE_ON_HOST_LOSS = bool_conf(
+    "spark.rapids.service.degrade.onHostLoss", True,
+    "Driver/service unification: while the cluster runtime serves "
+    "below its declared host strength (lost or excluded hosts, or the "
+    "single-process latch), the service reports DEGRADED and sheds "
+    "its lowest-weight pool under load, exactly as it does for its "
+    "own worker losses. Off restores the pre-fleet behavior where "
+    "the service was blind to host topology.")
+
+SERVICE_DEGRADE_MEMORY_FRACTION = float_conf(
+    "spark.rapids.service.degrade.memoryOccupancyFraction", 0.0,
+    "While the memory arbiter's live occupancy exceeds this fraction "
+    "of its device budget, the service reports DEGRADED and sheds its "
+    "lowest-weight pool under load — backpressure from the memory "
+    "fault domain into admission control. 0 (default) disables.")
+
 
 def parse_pools(spec: str) -> "OrderedDict[str, float]":
     """'name[:weight=W];...' -> {name: weight}. Raises on duplicates,
@@ -283,6 +300,24 @@ class QueryService:
             self.conf.get_entry(SERVICE_DEFAULT_TIMEOUT_MS))
         self.admission_max_device_bytes = int(
             self.conf.get_entry(SERVICE_ADMISSION_MAX_DEVICE_BYTES))
+        # fleet-degrade knobs — read BEFORE workers spawn (workers
+        # consult _health_state_locked from their first pick)
+        self._degrade_on_host_loss = bool(
+            self.conf.get_entry(SERVICE_DEGRADE_ON_HOST_LOSS))
+        self._degrade_memory_fraction = float(
+            self.conf.get_entry(SERVICE_DEGRADE_MEMORY_FRACTION))
+        # exclusive mesh occupancy: a multi-device computation's
+        # collective rendezvous requires every device to reach ITS
+        # launch, but each device executes launches in arrival order —
+        # two concurrent mesh queries can interleave arrival per-device
+        # and deadlock both rendezvous. When this service drives a
+        # mesh topology, workers serialize the device-launch window
+        # (admission, queues, watchdog and SLO machinery stay fully
+        # concurrent); single-chip services skip the gate entirely.
+        from spark_rapids_tpu.parallel.mesh import MESH_ENABLED
+        self._mesh_gate = None
+        if bool(self.conf.get_entry(MESH_ENABLED)):
+            self._mesh_gate = ordered_lock("service.mesh_gate")
         self.result_cache: Optional[ResultCache] = None
         if bool(self.conf.get_entry(SERVICE_RESULT_CACHE_ENABLED)):
             self.result_cache = ResultCache(
@@ -376,6 +411,14 @@ class QueryService:
         # conf too (admission consults its live occupancy)
         from spark_rapids_tpu.runtime.memory import MEMORY
         MEMORY.configure(self.conf)
+        # the service runs AS the cluster driver: constructing it
+        # configures the host-cluster runtime from the same conf, so
+        # admission control, quarantine, the /slo surface, and the
+        # three degradation ladders all see ONE topology — and the
+        # DEGRADED/shedding decision below consults live host strength
+        # and arbiter occupancy from that shared view
+        from spark_rapids_tpu.runtime.cluster import CLUSTER
+        CLUSTER.configure(self.conf)
 
         # live introspection endpoint (service/introspect.py):
         # loopback-only HTTP JSON, polled by `tools top`
@@ -845,6 +888,19 @@ class QueryService:
                         return
 
     def _run(self, handle: QueryHandle):
+        # mesh services serialize the WHOLE launch window, and do it
+        # BEFORE the RUNNING transition: the hard wall measures from
+        # RUNNING, so gate wait books as queue time — one wedged
+        # holder (abandoned by the watchdog mid-dispatch) must not
+        # cascade-abandon every worker queued behind the gate while
+        # its stalled dispatch drains
+        if self._mesh_gate is not None:
+            with self._mesh_gate:
+                self._run_exclusive(handle)
+        else:
+            self._run_exclusive(handle)
+
+    def _run_exclusive(self, handle: QueryHandle):
         if not handle._transition(QueryState.RUNNING):
             return
         # RL-FAULT-POINT service.worker_crash: an exception HERE is the
@@ -1130,23 +1186,74 @@ class QueryService:
             self._cond.release()
         return out
 
+    def _fleet_degraded_reason(self) -> Optional[str]:
+        """The driver/service unification's shedding input: live host
+        strength and arbiter occupancy, read from the same singletons
+        the degradation ladders mutate. Legal under the condition lock
+        — cluster.runtime(300) and memory.arbiter(740) both rank above
+        service.scheduler.cond(200), so these reads only ever acquire
+        upward."""
+        if self._degrade_on_host_loss:
+            from spark_rapids_tpu.runtime.cluster import CLUSTER
+            hosts = CLUSTER.health_snapshot()
+            if hosts["enabled"]:
+                if hosts["singleProcessReason"]:
+                    return ("cluster latched single-process: "
+                            f"{hosts['singleProcessReason']}")
+                if hosts["lostHosts"] or hosts["excludedHosts"]:
+                    return (
+                        "cluster below declared strength: "
+                        f"{len(hosts['liveHosts'])}/"
+                        f"{hosts['declaredHosts']} live (lost="
+                        f"{hosts['lostHosts']}, excluded="
+                        f"{hosts['excludedHosts']})")
+        frac = self._degrade_memory_fraction
+        if frac > 0.0:
+            from spark_rapids_tpu.runtime.memory import MEMORY
+            budget = MEMORY.budget_bytes()
+            occupancy = MEMORY.occupancy()
+            if budget > 0 and occupancy > frac * budget:
+                return (f"arbiter occupancy {occupancy}B over "
+                        f"{frac:g} x budget {budget}B")
+        return None
+
     def _health_state_locked(self) -> str:
         """HEALTHY → DEGRADED → CPU_ONLY. CPU_ONLY comes from the
         process-wide device latch; DEGRADED while the device is mid
-        loss-streak OR this service recently lost workers and has not
-        yet completed _DEGRADE_CLEAR_SUCCESSES queries. Caller holds
-        the condition lock (the degraded counter is mutated under
-        it)."""
+        loss-streak, this service recently lost workers and has not
+        yet completed _DEGRADE_CLEAR_SUCCESSES queries, OR the shared
+        topology reports the fleet below strength (host loss, arbiter
+        over occupancy) — the service IS the cluster driver, so its
+        shedding decision consults the cluster's live state. Caller
+        holds the condition lock (the degraded counter is mutated
+        under it)."""
         device = HEALTH.state()
         if device == "CPU_ONLY":
             return "CPU_ONLY"
-        if device == "DEGRADED" or self._degraded_pending > 0:
+        if (device == "DEGRADED" or self._degraded_pending > 0
+                or self._fleet_degraded_reason() is not None):
             return "DEGRADED"
         return "HEALTHY"
 
+    def topology_snapshot(self) -> dict:
+        """ONE coherent fleet-topology view (hosts + mesh + memory +
+        ladders + quarantine) taken with every owning lock held — the
+        shared-topology path (runtime/health.py); also served as the
+        ``/topology`` introspection route."""
+        from spark_rapids_tpu.runtime.health import (
+            consistent_topology_snapshot,
+        )
+        return consistent_topology_snapshot()
+
     def health(self) -> dict:
         """The service health surface the ISSUE's states machine drives
-        admission from (and ``tools loadtest`` reports)."""
+        admission from (and ``tools loadtest`` reports). The hosts /
+        mesh / memory sections come from ONE consistent topology
+        snapshot — all owning locks held together — so the view cannot
+        tear across a mid-query shrink (a host loss excludes mesh
+        devices only after dropping the cluster lock; independent
+        section reads could observe the gap)."""
+        topo = self.topology_snapshot()
         with self._cond:
             out = {
                 "state": self._health_state_locked(),
@@ -1155,29 +1262,26 @@ class QueryService:
                 "workerCount": len(self._workers),
                 "degradedPendingSuccesses": self._degraded_pending,
                 "shedPool": self._shed_pool,
+                "fleetDegradedReason": self._fleet_degraded_reason(),
             }
-        out["cpuOnlyReason"] = HEALTH.cpu_only_reason()
-        out["device"] = HEALTH.snapshot()
-        out["quarantine"] = QUARANTINE.snapshot()
+        out["cpuOnlyReason"] = topo["cpuOnlyReason"]
+        out["device"] = topo["backend"]
+        out["quarantine"] = topo["quarantine"]
         # the mesh fault domain: current topology (shrunken shape and
         # excluded devices after partial losses, with the degradation
         # reason) plus the ladder's counters — a degraded-but-serving
         # mesh is VISIBLE here, not silently smaller
-        from spark_rapids_tpu.parallel.mesh import MESH
-        out["mesh"] = {**MESH.health_snapshot(), **HEALTH.mesh_snapshot()}
+        out["mesh"] = topo["mesh"]
         # the host fault domain above the mesh: current topology
         # (declared/live/lost/excluded hosts, the single-process latch)
         # plus the host ladder's counters — a cluster serving below
         # declared strength is VISIBLE here, not silently smaller
-        from spark_rapids_tpu.runtime.cluster import CLUSTER
-        out["hosts"] = {**CLUSTER.health_snapshot(),
-                        **HEALTH.host_snapshot()}
+        out["hosts"] = topo["hosts"]
         # the memory fault domain: arbiter budget/occupancy/peak plus
         # the memory degradation ladder's counters — a query surviving
         # out-of-core is VISIBLE here, not silently slower
-        from spark_rapids_tpu.runtime.memory import MEMORY
-        out["memory"] = {**MEMORY.snapshot(),
-                         **HEALTH.memory_snapshot()}
+        out["memory"] = topo["memory"]
+        out["topologyGeneration"] = topo["generation"]
         return out
 
     def stats(self) -> dict:

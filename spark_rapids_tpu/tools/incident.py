@@ -58,6 +58,9 @@ def render_incident(bundles: List[dict], last: int = 0) -> str:
         lines.append(f"== {os.path.basename(b.get('_path', '?'))}")
         lines.append(
             f"   kind={b.get('kind')} action={b.get('action')}"
+            + (f" seq={b['seq']}" if b.get("seq") else "")
+            + (f" domain={b['faultDomain']}" if b.get("faultDomain")
+               else "")
             + (f" faultPoint={b['faultPoint']}" if b.get("faultPoint")
                else ""))
         lines.append(f"   trigger: {b.get('reason')}")

@@ -21,6 +21,9 @@ def _clean_fault_state():
 
 
 @pytest.mark.chaos
+@pytest.mark.slow  # strict subset of test_service.py's concurrent
+# chaos slice: same seed/sf/queries, minus the scheduler — tier-1
+# keeps the superset and the full corpus runs keep this one
 def test_seeded_chaos_slice_bit_identical():
     from spark_rapids_tpu.lint.golden import _load_scale_test
     st = _load_scale_test()

@@ -475,10 +475,12 @@ def test_device_budget_flag_validation():
 
     st.validate_flags(args(device_budget=4_000_000))  # supported
     st.validate_flags(args(device_budget=4_000_000, chaos=True))
+    # planes COMPOSE now: budget x hosts / budget x concurrency route
+    # to the fleet closure instead of being rejected
+    st.validate_flags(args(device_budget=4_000_000, hosts=2))
+    st.validate_flags(args(device_budget=4_000_000, concurrency=4))
     for bad in (args(device_budget=100),
                 args(device_budget=4_000_000, mesh=8),
-                args(device_budget=4_000_000, hosts=2),
-                args(device_budget=4_000_000, concurrency=4),
                 args(device_budget=4_000_000, cpu_baseline=True),
                 args(device_budget=4_000_000, require_tpu=True)):
         with pytest.raises(SystemExit) as ei:

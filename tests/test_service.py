@@ -543,6 +543,9 @@ def test_concurrent_chaos_slice_bit_identical():
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.slow  # a fresh-process jax import just to re-prove the
+# arg wiring: run_loadtest's logic is covered in-process above, and
+# the tools CLI surface is covered by the telemetry/warmup CLI smokes
 def test_tools_loadtest_cli_smoke():
     """q1 at concurrency 2 through the real CLI -> JSON report."""
     env = dict(os.environ, JAX_PLATFORMS="cpu")

@@ -348,6 +348,9 @@ def test_event_log_carries_compile_fields(tmp_path):
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.slow  # fresh-process jax import + compile; the warmup
+# logic (trace/skip/compile accounting) is covered in-process by
+# test_warmup_in_process_skips_warm_templates
 def test_warmup_cli_subprocess_smoke(tmp_path):
     """End-to-end: write a tiny tagged event log, then `python -m
     spark_rapids_tpu.tools warmup` replays it in a FRESH process and
